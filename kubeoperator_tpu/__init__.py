@@ -1,0 +1,32 @@
+"""kubeoperator_tpu — a TPU-native Kubernetes cluster lifecycle framework.
+
+A ground-up rebuild of the capability surface of KubeOperator
+(reference: ghl1024/KubeOperator; see SURVEY.md — note §0: the reference
+mount was empty, so parity citations point at SURVEY.md sections and
+upstream-repo paths tagged [upstream — UNVERIFIED], never at fabricated
+/root/reference file:line pairs).
+
+Layering (SURVEY.md §2):
+
+    api/         L6  REST API + koctl CLI
+    service/     L5  cluster lifecycle orchestration (one service per capability)
+    adm/         L4  resumable phase state-machine (create/upgrade/scale/reset)
+    provisioner/ L3a Terraform wrapper (IaaS VM / TPU-VM create+destroy)
+    executor/    L3b kobe-equivalent runner (playbook + adhoc, streamed results,
+                     dynamic inventory; fake/local/ansible backends)
+    content/     L2  Ansible roles & playbooks (node mutation content)
+    repository/  L1  SQLite state store + versioned migrations
+    models/          domain model incl. the TPU-first cluster-plan schema
+    parallel/        TPU pod-slice topology & ICI mesh math, jax.sharding.Mesh
+    ops/             JAX validation workloads (psum bus-bandwidth smoke test —
+                     the TPU-native replacement for the NCCL-tests GPU path)
+    utils/           config / logging / errors / i18n / RBAC glue
+
+North star (BASELINE.json): `koctl cluster create --plan tpu-v5e-16` yields a
+Ready cluster passing a 16-chip `jax.lax.psum` smoke test, with no GPU package
+anywhere in the build.
+"""
+
+from kubeoperator_tpu.version import __version__
+
+__all__ = ["__version__"]

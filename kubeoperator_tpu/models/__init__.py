@@ -1,0 +1,44 @@
+"""Domain model (reference parity: `pkg/model` + `pkg/dto` GORM structs
+[upstream — UNVERIFIED], SURVEY.md §2.1 row 1d and §2.2).
+
+The cluster-plan schema is the single most load-bearing structure: Region →
+Zone → Plan → Cluster/ClusterSpec → Host/Node/Credential, with
+ClusterStatus(Condition) driving UI progress and phase-engine resumability.
+TPU-first extension (BASELINE.json): plans carry accelerator/tpu_type/
+slice_topology/ici_mesh as first-class fields.
+"""
+
+from kubeoperator_tpu.models.base import Entity
+from kubeoperator_tpu.models.infra import (
+    Credential,
+    Host,
+    Plan,
+    PlanProvider,
+    Region,
+    Zone,
+)
+from kubeoperator_tpu.models.cluster import (
+    Cluster,
+    ClusterPhaseStatus,
+    ClusterSpec,
+    ClusterStatus,
+    ClusterStatusCondition,
+    Node,
+    NodeRole,
+    ProvisionMode,
+)
+from kubeoperator_tpu.models.backup import BackupAccount, BackupFile, BackupStrategy
+from kubeoperator_tpu.models.tenancy import Project, ProjectMember, Role, User
+from kubeoperator_tpu.models.event import Event, Message, TaskLogChunk
+from kubeoperator_tpu.models.component import ClusterComponent
+
+__all__ = [
+    "Entity",
+    "Region", "Zone", "Plan", "PlanProvider", "Host", "Credential",
+    "Cluster", "ClusterSpec", "ClusterStatus", "ClusterStatusCondition",
+    "ClusterPhaseStatus", "Node", "NodeRole", "ProvisionMode",
+    "BackupAccount", "BackupFile", "BackupStrategy",
+    "Project", "ProjectMember", "Role", "User",
+    "Event", "Message", "TaskLogChunk",
+    "ClusterComponent",
+]

@@ -1,0 +1,62 @@
+"""Backup schema: accounts (object-store endpoints), strategies (cron),
+files (snapshots taken). Parity: SURVEY.md §3.5 / §5.4 — etcd snapshot on a
+master, uploaded to an S3/OSS/SFTP-style backup account, cron-driven;
+restore is the inverse playbook + cluster restart.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from kubeoperator_tpu.models.base import Entity
+from kubeoperator_tpu.utils.errors import ValidationError
+
+BACKUP_ACCOUNT_TYPES = ("s3", "oss", "sftp", "local")
+
+
+@dataclass
+class BackupAccount(Entity):
+    name: str = ""
+    type: str = "local"
+    bucket: str = ""
+    # endpoint/credential vars per type (endpoint, access_key, secret_key,
+    # or sftp host/user/key, or local dir)
+    vars: dict = field(default_factory=dict)
+    status: str = "Valid"
+
+    def validate(self) -> None:
+        if not self.name:
+            raise ValidationError("backup account name required")
+        if self.type not in BACKUP_ACCOUNT_TYPES:
+            raise ValidationError(f"unknown backup account type {self.type}")
+        if self.type != "local" and not self.bucket:
+            raise ValidationError("bucket required for remote backup accounts")
+
+
+@dataclass
+class BackupStrategy(Entity):
+    """Per-cluster cron schedule + retention."""
+
+    cluster_id: str = ""
+    account_id: str = ""
+    cron: str = "0 3 * * *"     # daily 03:00 by default
+    save_num: int = 7           # retention count
+    enabled: bool = True
+
+    def validate(self) -> None:
+        if not self.cluster_id or not self.account_id:
+            raise ValidationError("backup strategy needs cluster and account")
+        if len(self.cron.split()) != 5:
+            raise ValidationError(f"cron {self.cron!r} must have 5 fields")
+        if self.save_num < 1:
+            raise ValidationError("save_num must be >= 1")
+
+
+@dataclass
+class BackupFile(Entity):
+    cluster_id: str = ""
+    account_id: str = ""
+    name: str = ""              # object key / file name
+    size_bytes: int = 0
+    status: str = "Created"     # Created | Uploaded | Restored | Failed
+    message: str = ""

@@ -1,0 +1,79 @@
+"""Entity base: UUID PK + timestamps + dict (de)serialization.
+
+Stands in for the reference's GORM `BaseModel` (ID/CreatedAt/UpdatedAt
+[upstream — UNVERIFIED]). Serialization is plain dicts so the repository can
+persist JSON columns and the API can emit DTOs without a parallel dto/ tree —
+one deliberate simplification over the reference's model/dto split.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any, Type, TypeVar
+
+from kubeoperator_tpu.utils.ids import new_id, now_ts
+
+T = TypeVar("T", bound="Entity")
+
+
+@dataclass
+class Entity:
+    id: str = field(default_factory=new_id)
+    created_at: float = field(default_factory=now_ts)
+    updated_at: float = field(default_factory=now_ts)
+
+    # Field names redacted by to_public_dict(); subclasses override. The API
+    # layer must emit entities ONLY through to_public_dict so credentials,
+    # kubeconfigs and password hashes never cross the HTTP boundary.
+    __secret_fields__: frozenset[str] = frozenset()
+
+    def touch(self) -> None:
+        self.updated_at = now_ts()
+
+    def to_dict(self) -> dict[str, Any]:
+        def convert(v: Any) -> Any:
+            if dataclasses.is_dataclass(v) and not isinstance(v, type):
+                return {f.name: convert(getattr(v, f.name)) for f in dataclasses.fields(v)}
+            if isinstance(v, (list, tuple)):
+                return [convert(x) for x in v]
+            if isinstance(v, dict):
+                return {k: convert(x) for k, x in v.items()}
+            return v
+
+        return convert(self)  # type: ignore[return-value]
+
+    def to_public_dict(self) -> dict[str, Any]:
+        """to_dict() minus secret fields — the only shape the API may emit."""
+        d = self.to_dict()
+        for name in type(self).__secret_fields__:
+            d.pop(name, None)
+        return d
+
+    @classmethod
+    def from_dict(cls: Type[T], data: dict[str, Any]) -> T:
+        """Rebuild an entity, recursing into nested dataclass fields and
+        ignoring unknown keys (forward/backward schema compatibility)."""
+        return dataclass_from_dict(cls, data)
+
+
+def dataclass_from_dict(cls: Type[T], data: dict[str, Any]) -> T:
+    """Generic dataclass hydration: nested types come from the class's
+    `__nested__` map (field name -> dataclass, applied to dicts and to list
+    elements); unknown keys are dropped."""
+    nested_map: dict[str, type] = getattr(cls, "__nested__", {})
+    kwargs: dict[str, Any] = {}
+    for f in dataclasses.fields(cls):
+        if f.name not in data:
+            continue
+        v = data[f.name]
+        nested = nested_map.get(f.name)
+        if nested is not None and isinstance(v, dict):
+            v = dataclass_from_dict(nested, v)
+        elif nested is not None and isinstance(v, list):
+            v = [
+                dataclass_from_dict(nested, x) if isinstance(x, dict) else x
+                for x in v
+            ]
+        kwargs[f.name] = v
+    return cls(**kwargs)

@@ -1,0 +1,206 @@
+"""Cluster, ClusterSpec, Node, ClusterStatus(Condition) (SURVEY.md §2.2).
+
+ClusterStatusCondition is the resumability contract: the phase engine (adm/)
+writes exactly one condition row per phase, and a failed create/upgrade/scale
+re-enters at the first non-OK condition (SURVEY.md §3.1).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from enum import Enum
+
+from kubeoperator_tpu.models.base import Entity
+from kubeoperator_tpu.utils.errors import ValidationError
+from kubeoperator_tpu.utils.ids import now_ts
+from kubeoperator_tpu.version import SUPPORTED_K8S_VERSIONS
+
+
+class ProvisionMode(str, Enum):
+    MANUAL = "manual"       # bare-metal: user-registered hosts
+    PLAN = "plan"           # IaaS: Terraform provisions from a deploy plan
+
+
+class NodeRole(str, Enum):
+    MASTER = "master"
+    WORKER = "worker"
+
+
+class ClusterPhaseStatus(str, Enum):
+    """Lifecycle states surfaced in the UI/API and koctl exit codes."""
+
+    INITIALIZING = "Initializing"
+    PROVISIONING = "Provisioning"   # Terraform running (plan mode)
+    DEPLOYING = "Deploying"         # adm phases running
+    SMOKE_TESTING = "SmokeTesting"  # TPU psum gate (TPU plans only)
+    RUNNING = "Running"
+    READY = "Ready"
+    FAILED = "Failed"
+    UPGRADING = "Upgrading"
+    SCALING = "Scaling"
+    TERMINATING = "Terminating"
+    TERMINATED = "Terminated"
+
+
+class ConditionStatus(str, Enum):
+    UNKNOWN = "Unknown"
+    RUNNING = "Running"
+    OK = "OK"
+    FAILED = "Failed"
+
+
+@dataclass
+class ClusterSpec:
+    """What to deploy (versions/runtime/CNI/net) — the extra-vars source."""
+
+    k8s_version: str = ""
+    runtime: str = "containerd"            # containerd | docker
+    cni: str = "calico"                    # calico | flannel | cilium
+    ingress: str = "nginx"                 # nginx | traefik | none
+    service_cidr: str = "10.96.0.0/16"
+    pod_cidr: str = "10.244.0.0/16"
+    lb_mode: str = "internal"              # internal haproxy+keepalived | external
+    lb_endpoint: str = ""                  # required when lb_mode == external
+    helm_enabled: bool = True
+    metrics_server_enabled: bool = True
+    worker_count: int = 1
+    # ---- TPU runtime phase vars (replaces reference GPU flag) ----
+    tpu_enabled: bool = False
+    tpu_device_plugin_version: str = "v1.0"
+    jobset_enabled: bool = False           # multislice launcher
+    smoke_test_gbps_threshold: float = 0.0  # 0 = report-only, >0 gates Ready
+
+    def validate(self) -> None:
+        if self.k8s_version and self.k8s_version not in SUPPORTED_K8S_VERSIONS:
+            raise ValidationError(
+                f"k8s_version {self.k8s_version} unsupported "
+                f"(bundle ships {', '.join(SUPPORTED_K8S_VERSIONS)})"
+            )
+        if self.runtime not in ("containerd", "docker"):
+            raise ValidationError(f"unknown runtime {self.runtime}")
+        if self.cni not in ("calico", "flannel", "cilium"):
+            raise ValidationError(f"unknown cni {self.cni}")
+        if self.ingress not in ("nginx", "traefik", "none"):
+            raise ValidationError(f"unknown ingress {self.ingress}")
+        if self.lb_mode not in ("internal", "external"):
+            raise ValidationError(f"unknown lb_mode {self.lb_mode}")
+        if self.lb_mode == "external" and not self.lb_endpoint:
+            raise ValidationError("external lb_mode needs lb_endpoint")
+
+
+@dataclass
+class ClusterStatusCondition:
+    """One row per adm phase; ordered by `order_index`."""
+
+    name: str = ""                              # phase name
+    status: str = ConditionStatus.UNKNOWN.value
+    message: str = ""
+    order_index: int = 0
+    started_at: float = 0.0
+    finished_at: float = 0.0
+
+    @property
+    def duration_s(self) -> float:
+        """Phase wall-clock span — the create-to-Ready trace is the sum of
+        these (BASELINE metric 1; SURVEY.md §5.1)."""
+        if self.started_at and self.finished_at:
+            return self.finished_at - self.started_at
+        return 0.0
+
+
+@dataclass
+class ClusterStatus:
+    phase: str = ClusterPhaseStatus.INITIALIZING.value
+    message: str = ""
+    conditions: list = field(default_factory=list)  # [ClusterStatusCondition]
+    # smoke-test results (TPU plans)
+    smoke_gbps: float = 0.0
+    smoke_chips: int = 0
+    smoke_passed: bool = False
+
+    __nested__ = {"conditions": ClusterStatusCondition}
+
+    def condition(self, name: str) -> ClusterStatusCondition | None:
+        for c in self.conditions:
+            if c.name == name:
+                return c
+        return None
+
+    def upsert_condition(
+        self, name: str, status: ConditionStatus, message: str = ""
+    ) -> ClusterStatusCondition:
+        cond = self.condition(name)
+        if cond is None:
+            cond = ClusterStatusCondition(name=name, order_index=len(self.conditions))
+            self.conditions.append(cond)
+        if status is ConditionStatus.RUNNING:
+            # A retry of a previously-finished/failed phase restarts its span;
+            # otherwise duration_s would absorb the idle gap and corrupt the
+            # create-to-Ready trace (BASELINE metric 1).
+            if cond.status != ConditionStatus.RUNNING.value:
+                cond.started_at = now_ts()
+                cond.finished_at = 0.0
+        if status in (ConditionStatus.OK, ConditionStatus.FAILED):
+            if not cond.started_at:
+                cond.started_at = now_ts()
+            cond.finished_at = now_ts()
+        cond.status = status.value
+        cond.message = message
+        return cond
+
+    def first_unfinished(self) -> str | None:
+        """Resume point: first condition that isn't OK (or None if all OK)."""
+        for c in sorted(self.conditions, key=lambda c: c.order_index):
+            if c.status != ConditionStatus.OK.value:
+                return c.name
+        return None
+
+    def total_duration_s(self) -> float:
+        return sum(c.duration_s for c in self.conditions)
+
+
+# base.py's Entity dataclass ordering requires defaults; ClusterStatus needs a
+# factory so each cluster owns its own status object.
+@dataclass
+class Cluster(Entity):
+    name: str = ""
+    project_id: str = ""
+    provision_mode: str = ProvisionMode.MANUAL.value
+    plan_id: str = ""                       # set in plan mode
+    spec: ClusterSpec = field(default_factory=ClusterSpec)
+    status: ClusterStatus = field(default_factory=ClusterStatus)
+    kubeconfig: str = ""                    # stored after deploy; never leaves
+    api_endpoint: str = ""                  # the API except via explicit download
+
+    __nested__ = {"spec": ClusterSpec, "status": ClusterStatus}
+    __secret_fields__ = frozenset({"kubeconfig"})
+
+    def validate(self) -> None:
+        # RFC1123 label: lowercase alnum + '-', no edge hyphens, <= 63 chars —
+        # the name becomes K8s object names and DNS records downstream.
+        if not re.fullmatch(r"[a-z0-9]([a-z0-9-]{0,61}[a-z0-9])?", self.name or ""):
+            raise ValidationError(
+                f"cluster name {self.name!r} must be an RFC1123 DNS label"
+            )
+        ProvisionMode(self.provision_mode)
+        if self.provision_mode == ProvisionMode.PLAN.value and not self.plan_id:
+            raise ValidationError("plan-mode cluster must reference a plan")
+        self.spec.validate()
+
+
+@dataclass
+class Node(Entity):
+    """A host bound into a cluster with a role (reference joins Host↔Cluster
+    through Node rows [upstream — UNVERIFIED])."""
+
+    name: str = ""
+    cluster_id: str = ""
+    host_id: str = ""
+    role: str = NodeRole.WORKER.value
+    status: str = "Pending"   # Pending | Joining | Ready | Draining | Removed | Failed
+
+    def validate(self) -> None:
+        NodeRole(self.role)
+        if not self.cluster_id or not self.host_id:
+            raise ValidationError("node must bind a cluster and a host")

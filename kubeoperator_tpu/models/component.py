@@ -1,0 +1,49 @@
+"""Installable per-cluster components/addons (SURVEY.md §2.1 row 9).
+
+Reference set: prometheus, grafana, loki/logging, ingress controllers,
+metrics-server, gpu. The TPU build replaces `gpu` with `tpu` (device plugin +
+JobSet + smoke workload) and forbids GPU components entirely [BASELINE].
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from kubeoperator_tpu.models.base import Entity
+from kubeoperator_tpu.utils.errors import ValidationError
+
+# name -> (playbook that installs it, default vars)
+COMPONENT_CATALOG: dict[str, dict] = {
+    "prometheus": {"playbook": "component-prometheus.yml", "vars": {}},
+    "grafana": {"playbook": "component-grafana.yml", "vars": {"tpu_dashboards": True}},
+    "loki": {"playbook": "component-loki.yml", "vars": {}},
+    "metrics-server": {"playbook": "component-metrics-server.yml", "vars": {}},
+    "ingress-nginx": {"playbook": "component-ingress-nginx.yml", "vars": {}},
+    "traefik": {"playbook": "component-traefik.yml", "vars": {}},
+    # The TPU runtime as a re-installable component (also runs as a create
+    # phase for TPU plans): device plugin + JobSet controller + smoke job.
+    "tpu-runtime": {"playbook": "16-tpu-runtime.yml", "vars": {}},
+}
+
+
+@dataclass
+class ClusterComponent(Entity):
+    cluster_id: str = ""
+    name: str = ""
+    version: str = "bundled"
+    vars: dict = field(default_factory=dict)
+    status: str = "Pending"    # Pending | Installing | Installed | Failed | Uninstalled
+    message: str = ""
+
+    def validate(self) -> None:
+        # Checked before catalog membership so GPU-family names get the
+        # explicit policy error (and so future catalog additions can never
+        # reintroduce one) [BASELINE: "no GPU package in the build"].
+        forbidden = ("gpu", "nvidia", "cuda", "nccl")
+        if any(t in self.name.lower() for t in forbidden):
+            raise ValidationError("GPU components are excluded from this build")
+        if self.name not in COMPONENT_CATALOG:
+            raise ValidationError(
+                f"unknown component {self.name!r} "
+                f"(catalog: {sorted(COMPONENT_CATALOG)})"
+            )

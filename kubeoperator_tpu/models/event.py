@@ -1,0 +1,47 @@
+"""Events, messages, and streamed task logs.
+
+Parity: the reference persists per-operation ansible output (kobe
+`WatchResult` streams) for the UI log viewer, raises cluster events, and
+fans out notifications through a message center (email/webhook)
+(SURVEY.md §5.1, §1).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from kubeoperator_tpu.models.base import Entity
+
+
+@dataclass
+class Event(Entity):
+    """Cluster-scoped audit/event row (create started, phase failed, backup
+    done, health degraded, smoke test result...)."""
+
+    cluster_id: str = ""
+    type: str = "Normal"       # Normal | Warning
+    reason: str = ""           # stable machine-readable reason code
+    message: str = ""          # human text (pre-localized by i18n at read time)
+
+
+@dataclass
+class Message(Entity):
+    """Message-center notification to a user (in-app; email/webhook senders
+    attach via service/message.py subscriptions)."""
+
+    user_id: str = ""
+    title: str = ""
+    content: str = ""
+    level: str = "info"        # info | warning | error
+    read: bool = False
+
+
+@dataclass
+class TaskLogChunk(Entity):
+    """One streamed chunk of executor output for a (cluster, task) pair —
+    the persistence behind the UI live log viewer and `koctl logs`."""
+
+    cluster_id: str = ""
+    task_id: str = ""
+    seq: int = 0
+    line: str = ""

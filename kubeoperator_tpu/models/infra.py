@@ -1,0 +1,189 @@
+"""IaaS-side schema: Region, Zone, Plan, Host, Credential (SURVEY.md §2.2).
+
+The deploy Plan is what the Terraform layer consumes (provisioner/) and what
+`koctl cluster create --plan <name>` names. TPU-first extension
+(BASELINE.json): `accelerator="tpu"` plans carry tpu_type/slice_topology/
+ici_mesh/num_slices and GCP TPU-VM provider fields as first-class columns —
+replacing the reference's boolean "GPU yes/no" component flag.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from enum import Enum
+
+from kubeoperator_tpu.models.base import Entity
+from kubeoperator_tpu.parallel.topology import SliceTopology, parse_accelerator_type
+from kubeoperator_tpu.utils.errors import ValidationError
+
+
+class PlanProvider(str, Enum):
+    """IaaS providers the Terraform layer has templates for.
+
+    vsphere/openstack = upstream parity [upstream — UNVERIFIED];
+    gcp_tpu_vm = the north-star addition [BASELINE].
+    bare_metal = manual mode (no Terraform; user-registered hosts).
+    """
+
+    BARE_METAL = "bare_metal"
+    VSPHERE = "vsphere"
+    OPENSTACK = "openstack"
+    GCP_TPU_VM = "gcp_tpu_vm"
+
+
+@dataclass
+class Credential(Entity):
+    """SSH credential bound to hosts (reference `pkg/model/credential.go`
+    [upstream — UNVERIFIED])."""
+
+    name: str = ""
+    username: str = "root"
+    # exactly one of password / private_key is set
+    password: str = ""
+    private_key: str = ""
+    port: int = 22
+
+    __secret_fields__ = frozenset({"password", "private_key"})
+
+    def validate(self) -> None:
+        if not self.name:
+            raise ValidationError("credential name required")
+        if bool(self.password) == bool(self.private_key):
+            raise ValidationError(
+                "credential needs exactly one of password or private_key"
+            )
+
+
+@dataclass
+class Region(Entity):
+    """Cloud datacenter + provider connection vars."""
+
+    name: str = ""
+    provider: str = PlanProvider.GCP_TPU_VM.value
+    # provider connection/auth vars (e.g. gcp project id + SA key ref,
+    # vCenter URL + creds). Stored as an opaque vars blob like the reference.
+    vars: dict = field(default_factory=dict)
+
+    def validate(self) -> None:
+        if not self.name:
+            raise ValidationError("region name required")
+        PlanProvider(self.provider)
+
+
+@dataclass
+class Zone(Entity):
+    """Subnet / resource pool inside a region; owns the VM IP pool."""
+
+    name: str = ""
+    region_id: str = ""
+    vars: dict = field(default_factory=dict)  # e.g. gcp zone, subnet, rp
+    ip_pool: list = field(default_factory=list)  # static IPs for providers that need them
+
+    def validate(self) -> None:
+        if not self.name:
+            raise ValidationError("zone name required")
+        if not self.region_id:
+            raise ValidationError("zone must belong to a region")
+
+
+@dataclass
+class Plan(Entity):
+    """Deploy plan — instance shapes/counts + accelerator topology.
+
+    `vars` carries provider-specific instance shapes (cpu/mem/disk or machine
+    types); TPU plans derive machine shapes from the slice topology instead.
+    """
+
+    name: str = ""
+    provider: str = PlanProvider.BARE_METAL.value
+    region_id: str = ""
+    zone_ids: list = field(default_factory=list)
+    master_count: int = 1
+    worker_count: int = 1
+    vars: dict = field(default_factory=dict)
+
+    # ---- TPU-first fields (BASELINE north_star) ----
+    accelerator: str = "none"          # "none" | "tpu"  (never "gpu" — by design)
+    tpu_type: str = ""                 # e.g. "v5e-16", "v5p-64"
+    slice_topology: str = ""           # explicit chips-per-axis ICI mesh, e.g.
+                                       # "4x4"; empty = derive from tpu_type
+    num_slices: int = 1                # >1 => multislice via JobSet
+    tpu_runtime_version: str = ""      # override; default from generation
+
+    def has_tpu(self) -> bool:
+        return self.accelerator == "tpu"
+
+    def topology(self) -> SliceTopology:
+        if not self.has_tpu():
+            raise ValidationError(f"plan {self.name} has no TPU accelerator")
+        return parse_accelerator_type(
+            self.tpu_type,
+            ici_mesh=self.slice_topology or None,
+            num_slices=self.num_slices,
+        )
+
+    def tpu_worker_count(self) -> int:
+        """TPU hosts the plan will provision — derived, never user-entered."""
+        return self.topology().total_hosts if self.has_tpu() else 0
+
+    def validate(self) -> None:
+        if not self.name:
+            raise ValidationError("plan name required")
+        provider = PlanProvider(self.provider)
+        if self.accelerator not in ("none", "tpu"):
+            # "no GPU package in the build" starts at the schema [BASELINE].
+            raise ValidationError(
+                f"accelerator must be 'none' or 'tpu', got {self.accelerator!r}"
+            )
+        if self.master_count < 1:
+            raise ValidationError("plan needs >= 1 master")
+        if self.master_count not in (1, 3, 5):
+            raise ValidationError("HA requires 1, 3 or 5 masters")
+        if provider is not PlanProvider.BARE_METAL and not self.region_id:
+            raise ValidationError("IaaS plans must reference a region")
+        if self.has_tpu():
+            if provider is not PlanProvider.GCP_TPU_VM:
+                raise ValidationError(
+                    "TPU plans require the gcp_tpu_vm provider"
+                )
+            if not self.tpu_type:
+                raise ValidationError("TPU plan needs tpu_type (e.g. 'v5e-16')")
+            topo = self.topology()  # raises TopologyError on bad topology
+            # Workers and slice hosts are the same machines on TPU plans:
+            # the plan's worker_count must equal the derived host count
+            # (v5e-16 => 4). 0 means "derive for me".
+            if self.worker_count not in (0, topo.total_hosts):
+                raise ValidationError(
+                    f"plan {self.name}: {self.tpu_type} x{self.num_slices} "
+                    f"slices need exactly {topo.total_hosts} TPU hosts, "
+                    f"worker_count says {self.worker_count}"
+                )
+
+
+@dataclass
+class Host(Entity):
+    """A machine: user-registered (manual mode) or Terraform-created (plan
+    mode). TPU hosts additionally record their slice coordinates."""
+
+    name: str = ""
+    ip: str = ""
+    port: int = 22
+    credential_id: str = ""
+    cluster_id: str = ""
+    zone_id: str = ""
+    status: str = "Pending"   # Pending | Ready | Failed
+    # gathered facts
+    os: str = ""
+    arch: str = "amd64"
+    cpu_cores: int = 0
+    memory_mb: int = 0
+    # ---- TPU placement (empty for non-TPU hosts) ----
+    tpu_worker_id: int = -1    # worker index inside its slice (0..hosts-1)
+    tpu_slice_id: int = 0
+    tpu_chips: int = 0         # chips attached to this host
+
+    def validate(self) -> None:
+        if not self.name:
+            raise ValidationError("host name required")
+        if not self.ip:
+            raise ValidationError(f"host {self.name}: ip required")

@@ -1,0 +1,94 @@
+"""Multi-tenancy & auth: projects, members, users, roles (SURVEY.md §1
+"Multi-tenancy & auth": projects/workspaces, RBAC admin/manager/viewer,
+local users + LDAP).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import os
+from dataclasses import dataclass, field
+from enum import Enum
+
+from kubeoperator_tpu.models.base import Entity
+from kubeoperator_tpu.utils.errors import ValidationError
+
+
+class Role(str, Enum):
+    ADMIN = "admin"          # platform-wide
+    MANAGER = "manager"      # project-scoped write
+    VIEWER = "viewer"        # project-scoped read
+
+    @property
+    def rank(self) -> int:
+        return {"viewer": 0, "manager": 1, "admin": 2}[self.value]
+
+    def allows(self, required: "Role") -> bool:
+        return self.rank >= required.rank
+
+
+def hash_password(password: str, salt: bytes | None = None) -> str:
+    salt = salt or os.urandom(16)
+    digest = hashlib.pbkdf2_hmac("sha256", password.encode(), salt, 100_000)
+    return salt.hex() + "$" + digest.hex()
+
+
+def verify_password(password: str, stored: str) -> bool:
+    try:
+        salt_hex, digest_hex = stored.split("$", 1)
+    except ValueError:
+        return False
+    check = hashlib.pbkdf2_hmac(
+        "sha256", password.encode(), bytes.fromhex(salt_hex), 100_000
+    )
+    return hmac.compare_digest(check.hex(), digest_hex)
+
+
+@dataclass
+class User(Entity):
+    name: str = ""
+    email: str = ""
+    password_hash: str = ""
+    is_admin: bool = False
+    # "local" users authenticate against password_hash; "ldap" users against
+    # the configured directory (service/user.py gates on this source field —
+    # parity with the reference's LDAP support, stubbed until a directory
+    # client is wired).
+    source: str = "local"
+    locale: str = "en-US"
+    active: bool = True
+
+    __secret_fields__ = frozenset({"password_hash"})
+
+    def validate(self) -> None:
+        if not self.name:
+            raise ValidationError("user name required")
+        if self.source not in ("local", "ldap"):
+            raise ValidationError(f"unknown user source {self.source}")
+        if self.source == "local" and not self.password_hash:
+            raise ValidationError("local user needs a password")
+
+
+@dataclass
+class Project(Entity):
+    """Workspace owning clusters/plans; RBAC is evaluated per-project."""
+
+    name: str = ""
+    description: str = ""
+
+    def validate(self) -> None:
+        if not self.name:
+            raise ValidationError("project name required")
+
+
+@dataclass
+class ProjectMember(Entity):
+    project_id: str = ""
+    user_id: str = ""
+    role: str = Role.VIEWER.value
+
+    def validate(self) -> None:
+        Role(self.role)
+        if not self.project_id or not self.user_id:
+            raise ValidationError("member needs project and user")

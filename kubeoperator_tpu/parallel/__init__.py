@@ -1,0 +1,23 @@
+"""TPU pod-slice topology, ICI mesh math, and jax.sharding.Mesh builders.
+
+This package is the framework's "parallelism dimension" (SURVEY.md §2.1):
+where the reference exposes GPU-count-per-node through the NVIDIA device
+plugin, we make pod-slice topology and the ICI mesh first-class plan-schema
+objects, and give workloads a ready-made `jax.sharding.Mesh` over them.
+"""
+
+from kubeoperator_tpu.parallel.topology import (
+    GENERATIONS,
+    SliceTopology,
+    TpuGeneration,
+    parse_accelerator_type,
+    parse_ici_mesh,
+)
+
+__all__ = [
+    "GENERATIONS",
+    "SliceTopology",
+    "TpuGeneration",
+    "parse_accelerator_type",
+    "parse_ici_mesh",
+]

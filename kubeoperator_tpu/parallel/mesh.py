@@ -1,0 +1,89 @@
+"""jax.sharding.Mesh construction over a SliceTopology.
+
+TPU-first design note (vs the reference's NCCL path): the GPU validation
+workload (NCCL-tests) discovers peers at runtime via NCCL bootstrap; the
+TPU-native equivalent declares the topology up front — the plan's SliceTopology
+becomes a `jax.sharding.Mesh` whose axes line up with the physical ICI mesh,
+and XLA inserts the collectives. Workloads (ops/) and the graft entry build
+their meshes exclusively through here.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+
+from kubeoperator_tpu.parallel.topology import SliceTopology
+from kubeoperator_tpu.utils.errors import TopologyError
+
+
+def build_mesh(
+    axis_names: Sequence[str] = ("data", "model"),
+    axis_shape: Sequence[int] | None = None,
+    devices: Sequence[jax.Device] | None = None,
+) -> jax.sharding.Mesh:
+    """Build a Mesh over `devices` (default: all visible).
+
+    If `axis_shape` is omitted, all devices land on the first axis and the
+    rest get size 1 — the right default for a pure-DP/all-reduce validation
+    workload.
+    """
+    devs = list(devices) if devices is not None else list(jax.devices())
+    n = len(devs)
+    if axis_shape is None:
+        axis_shape = (n,) + (1,) * (len(axis_names) - 1)
+    if int(np.prod(axis_shape)) != n:
+        raise TopologyError(
+            f"axis_shape {tuple(axis_shape)} needs {int(np.prod(axis_shape))} "
+            f"devices, have {n}"
+        )
+    try:
+        dev_array = mesh_utils.create_device_mesh(
+            tuple(axis_shape), devices=devs, allow_split_physical_axes=True
+        )
+    except (ValueError, NotImplementedError, AssertionError):
+        # CPU/virtual devices or shapes mesh_utils won't map — plain reshape.
+        dev_array = np.asarray(devs).reshape(tuple(axis_shape))
+    return jax.sharding.Mesh(dev_array, tuple(axis_names))
+
+
+def mesh_for_topology(
+    topo: SliceTopology,
+    axis_names: Sequence[str] | None = None,
+    devices: Sequence[jax.Device] | None = None,
+) -> jax.sharding.Mesh:
+    """Mesh whose logical axes mirror the slice's physical ICI mesh.
+
+    For a v5e-16 (4x4) slice this yields axes (ici_0=4, ici_1=4) so that
+    per-axis collectives ride one physical ring each; multislice adds a
+    leading 'dcn' axis (one entry per slice) so cross-slice traffic is
+    explicitly on the slow axis — the scaling-book layout recipe.
+    """
+    devs = list(devices) if devices is not None else list(jax.devices())
+    expected = topo.jax_device_count
+    if len(devs) != expected:
+        raise TopologyError(
+            f"topology {topo.accelerator_type} x{topo.num_slices} expects "
+            f"{expected} devices, found {len(devs)}"
+        )
+    shape: list[int] = list(topo.ici_mesh)
+    if axis_names is None:
+        axis_names = [f"ici_{i}" for i in range(len(shape))]
+        if topo.is_multislice:
+            axis_names = ["dcn"] + list(axis_names)
+    axis_names = list(axis_names)
+    if topo.is_multislice:
+        shape = [topo.num_slices] + shape
+    if len(axis_names) != len(shape):
+        raise TopologyError(
+            f"{len(shape)} mesh axes but {len(axis_names)} names given"
+        )
+    return build_mesh(axis_names, shape, devs)
+
+
+def flat_axis_mesh(name: str = "devices") -> jax.sharding.Mesh:
+    """1-D mesh over every visible device — the all-reduce smoke-test mesh."""
+    return build_mesh((name,), None, None)

@@ -1,0 +1,85 @@
+"""Multi-host / multislice process bootstrap env wiring.
+
+Parity target (SURVEY.md §5.7, §7 hard part (a)): every host in a slice must
+run the same program in lockstep. The content layer launches one process per
+host (K8s Job for single-slice, JobSet for multislice) and this module defines
+the env-var contract those manifests template in, plus the in-process
+`jax.distributed` bootstrap the workload calls first.
+
+No NCCL/MPI anywhere: ICI carries intra-slice collectives, DCN (megascale)
+carries inter-slice — both via XLA.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+from kubeoperator_tpu.parallel.topology import SliceTopology
+
+
+@dataclass(frozen=True)
+class HostEnv:
+    """Env contract for one worker process (one per TPU host)."""
+
+    coordinator_address: str      # "<host0>:<port>"
+    num_processes: int            # total processes across all slices
+    process_id: int               # global rank
+    slice_id: int = 0             # which slice (multislice)
+    num_slices: int = 1
+    megascale_coordinator: str | None = None  # multislice DCN coordinator
+
+    def to_env(self) -> dict[str, str]:
+        env = {
+            "KO_TPU_COORDINATOR_ADDRESS": self.coordinator_address,
+            "KO_TPU_NUM_PROCESSES": str(self.num_processes),
+            "KO_TPU_PROCESS_ID": str(self.process_id),
+            "KO_TPU_SLICE_ID": str(self.slice_id),
+        }
+        if self.megascale_coordinator:
+            # libtpu multislice (DCN) wiring; consumed by libtpu, not JAX.
+            env["MEGASCALE_COORDINATOR_ADDRESS"] = self.megascale_coordinator
+            env["MEGASCALE_NUM_SLICES"] = str(self.num_slices)
+            env["MEGASCALE_SLICE_ID"] = str(self.slice_id)
+        return env
+
+
+def host_envs(
+    topo: SliceTopology, coordinator_host: str, port: int = 8476
+) -> list[HostEnv]:
+    """Env blocks for every host process across the (multi)slice, rank 0 first."""
+    total = topo.total_hosts
+    envs = []
+    for rank in range(total):
+        envs.append(
+            HostEnv(
+                coordinator_address=f"{coordinator_host}:{port}",
+                num_processes=total,
+                process_id=rank,
+                slice_id=rank // topo.hosts_per_slice,
+                num_slices=topo.num_slices,
+                megascale_coordinator=(
+                    f"{coordinator_host}:{port + 1}" if topo.is_multislice else None
+                ),
+            )
+        )
+    return envs
+
+
+def initialize_from_env() -> None:
+    """Call `jax.distributed.initialize` from the env contract, if present.
+
+    Single-process (and driver dry-run) invocations simply skip — JAX local
+    mode already sees every chip on a single-host slice.
+    """
+    addr = os.environ.get("KO_TPU_COORDINATOR_ADDRESS")
+    nproc = int(os.environ.get("KO_TPU_NUM_PROCESSES", "1"))
+    if not addr or nproc <= 1:
+        return
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=addr,
+        num_processes=nproc,
+        process_id=int(os.environ.get("KO_TPU_PROCESS_ID", "0")),
+    )

@@ -1,0 +1,344 @@
+"""TPU generation registry and pod-slice topology math.
+
+The single most load-bearing schema element in the framework (SURVEY.md §2.2):
+a deploy plan names an accelerator type like ``v5e-16``; everything else —
+host count, chips per host, the ICI mesh shape, the GCP machine/runtime
+versions, the expected `jax.device_count()` — is derived here and validated
+against the rest of the plan (e.g. v5e-16 ⇒ exactly 4 TPU hosts).
+
+Naming conventions (public Cloud TPU facts, encoded as data):
+
+* v2/v3/v4/v5p accelerator-type suffixes count **TensorCores**
+  (``v5p-64`` = 32 chips); v5e/v6e suffixes count **chips** (``v5e-16`` =
+  16 chips). JAX exposes one device per chip on all of these (megacore on
+  v4/v5p, single-core chips on v5e/v6e).
+* Multi-host v5e/v6e slices use 4-chip hosts; single-host machine shapes are
+  1, 4 or 8 chips. v4/v5p hosts always carry 4 chips.
+* v5e/v6e ICI is a 2-D mesh (axes ≤ 16 wrap into a torus on v5e-256 etc.);
+  v4/v5p ICI is a 3-D torus.
+
+The GPU path this replaces — nvidia device plugin's flat ``nvidia.com/gpu``
+count — has no topology notion at all; exposing the mesh is the whole point
+of the TPU-first redesign (BASELINE.json north_star).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+from kubeoperator_tpu.utils.errors import TopologyError
+
+
+@dataclass(frozen=True)
+class TpuGeneration:
+    """Static facts about one TPU generation."""
+
+    name: str                       # canonical short name, e.g. "v5e"
+    aliases: tuple[str, ...]        # accepted spellings in plans/API
+    suffix_unit: str                # "chips" | "cores" — accelerator-type suffix
+    cores_per_chip: int
+    chips_per_host: int             # chips per host in multi-host slices
+    single_host_chip_sizes: tuple[int, ...]  # slice sizes servable by one host
+    topology_ndim: int              # 2 (mesh/torus) or 3 (torus)
+    max_chips: int
+    hbm_gib_per_chip: float
+    bf16_tflops_per_chip: float
+    gcp_accelerator_prefix: str     # GCP acceleratorType prefix, e.g. "v5litepod"
+    default_runtime_version: str    # TPU-VM runtime image
+    ici_gbps_per_link: float        # per-direction ICI link bandwidth, GB/s
+
+    def chips_from_suffix(self, suffix: int) -> int:
+        if self.suffix_unit == "cores":
+            if suffix % self.cores_per_chip:
+                raise TopologyError(
+                    f"{self.name}-{suffix}: suffix counts cores and must be "
+                    f"divisible by {self.cores_per_chip}"
+                )
+            return suffix // self.cores_per_chip
+        return suffix
+
+    def suffix_from_chips(self, chips: int) -> int:
+        return chips * (self.cores_per_chip if self.suffix_unit == "cores" else 1)
+
+
+GENERATIONS: dict[str, TpuGeneration] = {
+    g.name: g
+    for g in (
+        TpuGeneration(
+            name="v4",
+            aliases=("v4",),
+            suffix_unit="cores",
+            cores_per_chip=2,
+            chips_per_host=4,
+            single_host_chip_sizes=(4,),
+            topology_ndim=3,
+            max_chips=4096,
+            hbm_gib_per_chip=32.0,
+            bf16_tflops_per_chip=275.0,
+            gcp_accelerator_prefix="v4",
+            default_runtime_version="tpu-vm-v4-base",
+            ici_gbps_per_link=50.0,
+        ),
+        TpuGeneration(
+            name="v5e",
+            aliases=("v5e", "v5litepod", "v5lite"),
+            suffix_unit="chips",
+            cores_per_chip=1,
+            chips_per_host=4,
+            single_host_chip_sizes=(1, 4, 8),
+            topology_ndim=2,
+            max_chips=256,
+            hbm_gib_per_chip=16.0,
+            bf16_tflops_per_chip=197.0,
+            gcp_accelerator_prefix="v5litepod",
+            default_runtime_version="v2-alpha-tpuv5-lite",
+            ici_gbps_per_link=50.0,
+        ),
+        TpuGeneration(
+            name="v5p",
+            aliases=("v5p", "v5"),
+            suffix_unit="cores",
+            cores_per_chip=2,
+            chips_per_host=4,
+            single_host_chip_sizes=(4,),
+            topology_ndim=3,
+            max_chips=8960,
+            hbm_gib_per_chip=95.0,
+            bf16_tflops_per_chip=459.0,
+            gcp_accelerator_prefix="v5p",
+            default_runtime_version="v2-alpha-tpuv5",
+            ici_gbps_per_link=100.0,
+        ),
+        TpuGeneration(
+            name="v6e",
+            aliases=("v6e", "trillium"),
+            suffix_unit="chips",
+            cores_per_chip=1,
+            chips_per_host=4,
+            single_host_chip_sizes=(1, 4, 8),
+            topology_ndim=2,
+            max_chips=256,
+            hbm_gib_per_chip=32.0,
+            bf16_tflops_per_chip=918.0,
+            gcp_accelerator_prefix="v6e",
+            default_runtime_version="v2-alpha-tpuv6e",
+            ici_gbps_per_link=100.0,
+        ),
+    )
+}
+
+_ALIAS_TO_GEN: dict[str, str] = {
+    alias: gen.name for gen in GENERATIONS.values() for alias in gen.aliases
+}
+
+
+def _default_topology(chips: int, ndim: int) -> tuple[int, ...]:
+    """Most-balanced power-of-2-ish factorization of `chips` into `ndim` axes.
+
+    Matches the shapes GCP actually provisions for the common sizes
+    (16→4x4, 32→4x8, 64→8x8 in 2-D; 8→2x2x2, 16→2x2x4, 32→2x4x4 in 3-D)
+    without a lookup table, so arbitrary valid sizes also resolve.
+    """
+    if chips == 1:
+        return (1,) * ndim
+    dims = [1] * ndim
+    remaining = chips
+    # Peel factors largest-prime-first onto the currently smallest axis; for
+    # powers of two this yields the balanced near-square/near-cube shapes.
+    factors: list[int] = []
+    n = remaining
+    d = 2
+    while d * d <= n:
+        while n % d == 0:
+            factors.append(d)
+            n //= d
+        d += 1
+    if n > 1:
+        factors.append(n)
+    for f in sorted(factors, reverse=True):
+        dims[dims.index(min(dims))] *= f
+    return tuple(sorted(dims))
+
+
+def parse_ici_mesh(text: str) -> tuple[int, ...]:
+    """Parse '4x4' / '2x2x4' into a dim tuple."""
+    try:
+        dims = tuple(int(p) for p in text.lower().replace("×", "x").split("x"))
+    except ValueError as e:
+        raise TopologyError(f"unparseable ici_mesh {text!r}") from e
+    if not dims or any(d < 1 for d in dims):
+        raise TopologyError(f"ici_mesh {text!r} must be positive ints")
+    return dims
+
+
+def format_ici_mesh(dims: tuple[int, ...]) -> str:
+    return "x".join(str(d) for d in dims)
+
+
+@dataclass(frozen=True)
+class SliceTopology:
+    """A fully-resolved TPU pod slice: the plan schema's TPU heart.
+
+    Derived once from (tpu_type, optional explicit topology) and then treated
+    as ground truth by the provisioner (machine shapes), the content layer
+    (device-plugin/JobSet vars), the smoke test (expected device count and
+    mesh), and plan validation (host count).
+    """
+
+    generation: TpuGeneration
+    chips: int
+    ici_mesh: tuple[int, ...]
+    num_slices: int = 1  # >1 = multislice (DCN-connected, JobSet-launched)
+
+    # ---- derived ----
+    @property
+    def accelerator_type(self) -> str:
+        """Framework-canonical name, e.g. 'v5e-16' or 'v5p-64'."""
+        return f"{self.generation.name}-{self.generation.suffix_from_chips(self.chips)}"
+
+    @property
+    def gcp_accelerator_type(self) -> str:
+        """GCP API acceleratorType, e.g. 'v5litepod-16'."""
+        return (
+            f"{self.generation.gcp_accelerator_prefix}-"
+            f"{self.generation.suffix_from_chips(self.chips)}"
+        )
+
+    @property
+    def gcp_topology(self) -> str:
+        """GCP API topology string, e.g. '4x4' or '2x4x4' (chips per axis)."""
+        return format_ici_mesh(self.ici_mesh)
+
+    @property
+    def hosts_per_slice(self) -> int:
+        if self.chips in self.generation.single_host_chip_sizes:
+            return 1
+        return self.chips // self.generation.chips_per_host
+
+    @property
+    def total_hosts(self) -> int:
+        return self.hosts_per_slice * self.num_slices
+
+    @property
+    def total_chips(self) -> int:
+        return self.chips * self.num_slices
+
+    @property
+    def jax_device_count(self) -> int:
+        """Expected len(jax.devices()) across the whole (multi)slice — one JAX
+        device per chip on every supported generation (megacore on v4/v5p)."""
+        return self.total_chips
+
+    @property
+    def local_device_count(self) -> int:
+        """JAX devices visible per host process."""
+        return self.chips if self.hosts_per_slice == 1 else self.generation.chips_per_host
+
+    @property
+    def is_multihost(self) -> bool:
+        return self.hosts_per_slice > 1
+
+    @property
+    def is_multislice(self) -> bool:
+        return self.num_slices > 1
+
+    @property
+    def hbm_gib_total(self) -> float:
+        return self.generation.hbm_gib_per_chip * self.total_chips
+
+    @property
+    def bf16_tflops_total(self) -> float:
+        return self.generation.bf16_tflops_per_chip * self.total_chips
+
+    def theoretical_allreduce_busbw_gbps(self) -> float:
+        """Upper bound on all-reduce bus bandwidth over the ICI mesh.
+
+        Bidirectional ring over the slowest mesh axis gives the standard
+        2·link-bw bound per chip pair direction; used only to sanity-band the
+        measured smoke-test number (BASELINE metric 2), never as a pass value.
+        """
+        return 2.0 * self.generation.ici_gbps_per_link
+
+    def validate(self) -> None:
+        gen = self.generation
+        if self.chips < 1:
+            raise TopologyError("slice must have >= 1 chip")
+        if self.chips > gen.max_chips:
+            raise TopologyError(
+                f"{gen.name} slices max out at {gen.max_chips} chips, got {self.chips}"
+            )
+        if math.prod(self.ici_mesh) != self.chips:
+            raise TopologyError(
+                f"ici_mesh {format_ici_mesh(self.ici_mesh)} has "
+                f"{math.prod(self.ici_mesh)} chips but slice is {self.chips}"
+            )
+        if (
+            self.chips not in gen.single_host_chip_sizes
+            and self.chips % gen.chips_per_host
+        ):
+            raise TopologyError(
+                f"{self.accelerator_type}: multi-host slices must be a multiple "
+                f"of {gen.chips_per_host} chips/host"
+            )
+        if len(self.ici_mesh) != gen.topology_ndim and self.chips > 1:
+            raise TopologyError(
+                f"{gen.name} ICI is {gen.topology_ndim}-D; "
+                f"got {format_ici_mesh(self.ici_mesh)}"
+            )
+        if self.num_slices < 1:
+            raise TopologyError("num_slices must be >= 1")
+
+    def to_dict(self) -> dict:
+        return {
+            "tpu_type": self.generation.name,
+            "accelerator_type": self.accelerator_type,
+            "gcp_accelerator_type": self.gcp_accelerator_type,
+            "chips": self.chips,
+            "ici_mesh": format_ici_mesh(self.ici_mesh),
+            "num_slices": self.num_slices,
+            "hosts_per_slice": self.hosts_per_slice,
+            "total_hosts": self.total_hosts,
+            "jax_device_count": self.jax_device_count,
+            "runtime_version": self.generation.default_runtime_version,
+        }
+
+
+def parse_accelerator_type(
+    accelerator_type: str,
+    ici_mesh: str | None = None,
+    num_slices: int = 1,
+) -> SliceTopology:
+    """Resolve 'v5e-16' (+ optional explicit 'ici_mesh') into a SliceTopology.
+
+    Accepts canonical ('v5e-16', 'v5p-64'), GCP ('v5litepod-16'), and alias
+    spellings. This is the entry point plan validation calls (models/plan.py).
+    """
+    text = accelerator_type.strip().lower()
+    if "-" not in text:
+        raise TopologyError(f"accelerator type {text!r} must look like 'v5e-16'")
+    prefix, _, suffix_s = text.rpartition("-")
+    gen_name = _ALIAS_TO_GEN.get(prefix)
+    if gen_name is None:
+        raise TopologyError(
+            f"unknown TPU generation {prefix!r} "
+            f"(known: {sorted(_ALIAS_TO_GEN)})"
+        )
+    try:
+        suffix = int(suffix_s)
+    except ValueError as e:
+        raise TopologyError(f"bad size suffix in {text!r}") from e
+    gen = GENERATIONS[gen_name]
+    chips = gen.chips_from_suffix(suffix)
+
+    if ici_mesh:
+        dims = parse_ici_mesh(ici_mesh)
+    elif chips == 1:
+        dims = (1,) * gen.topology_ndim
+    else:
+        dims = _default_topology(chips, gen.topology_ndim)
+    topo = SliceTopology(
+        generation=gen, chips=chips, ici_mesh=dims, num_slices=num_slices
+    )
+    topo.validate()
+    return topo

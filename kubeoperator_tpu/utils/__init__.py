@@ -1,0 +1,1 @@
+"""Infra glue: config, logging, errors, i18n, ids (SURVEY.md §2.1 row 1f)."""

@@ -1,0 +1,141 @@
+"""Three-tier configuration (SURVEY.md §5.6).
+
+Parity with the reference's viper-style config (`pkg/config` + `app.yaml`
+under /etc/ko/ [upstream — UNVERIFIED]):
+
+  tier 1 — process config: built-in defaults < YAML file < env overrides
+           (``KO_TPU_`` prefix, ``__`` as nesting separator, e.g.
+           ``KO_TPU_DB__PATH=/var/ko/ko.db`` sets ``db.path``).
+  tier 2 — per-cluster config: the plan schema persisted in the repository
+           (models/plan.py), NOT here.
+  tier 3 — the vars contract carried to nodes as executor extra-vars
+           (executor/inventory.py), NOT here.
+"""
+
+from __future__ import annotations
+
+import copy
+import os
+from typing import Any
+
+import yaml
+
+DEFAULTS: dict[str, Any] = {
+    "server": {
+        "bind_host": "127.0.0.1",
+        "bind_port": 8080,
+        "session_ttl_s": 3600,
+    },
+    "db": {
+        # SQLite stands in for the reference's MySQL (SURVEY.md §7.1 allows
+        # SQLite-or-MySQL); ":memory:" for tests.
+        "path": "ko_tpu.db",
+    },
+    "executor": {
+        # "auto": ansible binary if present, else the built-in local engine.
+        "backend": "auto",
+        "project_dir": None,  # defaults to bundled content/ dir
+        "fork_limit": 32,
+        "task_timeout_s": 3600,
+    },
+    "provisioner": {
+        "terraform_bin": "terraform",
+        "work_dir": "terraform_runs",
+    },
+    "registry": {
+        # nexus-equivalent offline artifact registry (SURVEY.md §1 "Offline
+        # registry"); consumed as an artifact, addressed by URL.
+        "url": "http://127.0.0.1:8081",
+        "architectures": ["amd64", "arm64"],
+    },
+    "cron": {
+        "backup_enabled": True,
+        "health_check_interval_s": 300,
+    },
+    "logging": {
+        "level": "INFO",
+        "dir": None,  # None -> stderr only
+    },
+    "i18n": {
+        "default_locale": "en-US",
+    },
+}
+
+ENV_PREFIX = "KO_TPU_"
+
+
+def _deep_merge(base: dict, override: dict) -> dict:
+    out = copy.deepcopy(base)
+    for k, v in override.items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = _deep_merge(out[k], v)
+        else:
+            out[k] = copy.deepcopy(v)
+    return out
+
+
+def _coerce(raw: str) -> Any:
+    """Env values arrive as strings; YAML-parse them so ints/bools/lists work."""
+    try:
+        return yaml.safe_load(raw)
+    except yaml.YAMLError:
+        return raw
+
+
+class Config:
+    """Immutable-ish layered config with dotted-path access."""
+
+    def __init__(self, data: dict[str, Any]) -> None:
+        self._data = data
+
+    def get(self, dotted: str, default: Any = None) -> Any:
+        node: Any = self._data
+        for part in dotted.split("."):
+            if not isinstance(node, dict) or part not in node:
+                return default
+            node = node[part]
+        return node
+
+    def section(self, name: str) -> dict[str, Any]:
+        return copy.deepcopy(self._data.get(name, {}))
+
+    def to_dict(self) -> dict[str, Any]:
+        return copy.deepcopy(self._data)
+
+
+def load_config(
+    path: str | None = None,
+    env: dict[str, str] | None = None,
+    overrides: dict[str, Any] | None = None,
+) -> Config:
+    """defaults < yaml file < env (KO_TPU_*) < explicit overrides."""
+    data = copy.deepcopy(DEFAULTS)
+
+    if path is None:
+        path = os.environ.get(ENV_PREFIX + "CONFIG", "/etc/ko-tpu/app.yaml")
+    if path and os.path.exists(path):
+        with open(path, encoding="utf-8") as f:
+            file_data = yaml.safe_load(f) or {}
+        if not isinstance(file_data, dict):
+            raise ValueError(f"config file {path} must contain a mapping")
+        data = _deep_merge(data, file_data)
+
+    env = dict(os.environ if env is None else env)
+    for key, raw in env.items():
+        if not key.startswith(ENV_PREFIX) or key == ENV_PREFIX + "CONFIG":
+            continue
+        dotted = key[len(ENV_PREFIX):].lower().split("__")
+        node = data
+        for part in dotted[:-1]:
+            node = node.setdefault(part, {})
+            if not isinstance(node, dict):
+                # Loud failure beats a silently-ignored operator override.
+                raise ValueError(
+                    f"env override {key} descends through non-mapping "
+                    f"config key {part!r}"
+                )
+        node[dotted[-1]] = _coerce(raw)
+
+    if overrides:
+        data = _deep_merge(data, overrides)
+    return Config(data)

@@ -1,0 +1,86 @@
+"""Typed errors with i18n-able codes.
+
+Parity: the reference carries a small error package (`pkg/errorf`
+[upstream — UNVERIFIED], SURVEY.md §2.1 row 1f) whose codes feed the i18n
+message center and HTTP responses. We keep the same contract: every
+user-facing failure has a stable ``code`` the API/UI/i18n layers key off,
+plus interpolation args.
+"""
+
+from __future__ import annotations
+
+
+class KoError(Exception):
+    """Base error: stable code + args for i18n interpolation."""
+
+    code = "ERR_INTERNAL"
+    http_status = 500
+
+    def __init__(self, message: str = "", **args: object) -> None:
+        self.args_map = dict(args)
+        self.message = message or self.code
+        super().__init__(self.message)
+
+    def to_dict(self) -> dict:
+        return {"code": self.code, "message": self.message, "args": self.args_map}
+
+
+class ValidationError(KoError):
+    code = "ERR_VALIDATION"
+    http_status = 400
+
+
+class NotFoundError(KoError):
+    code = "ERR_NOT_FOUND"
+    http_status = 404
+
+
+class ConflictError(KoError):
+    code = "ERR_CONFLICT"
+    http_status = 409
+
+
+class AuthError(KoError):
+    code = "ERR_UNAUTHORIZED"
+    http_status = 401
+
+
+class ForbiddenError(KoError):
+    code = "ERR_FORBIDDEN"
+    http_status = 403
+
+
+class PhaseError(KoError):
+    """A deploy/upgrade/scale phase failed; cluster remains resumable."""
+
+    code = "ERR_PHASE_FAILED"
+    http_status = 500
+
+    def __init__(self, phase: str, message: str = "", **args: object) -> None:
+        super().__init__(message or f"phase {phase} failed", phase=phase, **args)
+        self.phase = phase
+
+
+class ExecutorError(KoError):
+    """The runner (kobe-equivalent) could not execute a playbook/adhoc task."""
+
+    code = "ERR_EXECUTOR"
+    http_status = 502
+
+
+class ProvisionerError(KoError):
+    """Terraform-layer failure (init/apply/destroy or output parsing)."""
+
+    code = "ERR_PROVISIONER"
+    http_status = 502
+
+
+class UpgradeError(KoError):
+    code = "ERR_UPGRADE"
+    http_status = 400
+
+
+class TopologyError(ValidationError):
+    """Invalid TPU slice topology / plan-topology mismatch."""
+
+    code = "ERR_TPU_TOPOLOGY"

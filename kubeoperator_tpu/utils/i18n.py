@@ -1,0 +1,63 @@
+"""Minimal zh/en i18n catalog (reference: `pkg/i18n` with zh-CN/en-US message
+files [upstream — UNVERIFIED], SURVEY.md §1 "Multi-tenancy & auth").
+
+Messages are keyed by error/status code; interpolation uses ``{name}`` args.
+The catalog intentionally covers the codes the API/UI surface — add keys next
+to the feature that emits them.
+"""
+
+from __future__ import annotations
+
+CATALOG: dict[str, dict[str, str]] = {
+    "en-US": {
+        "ERR_INTERNAL": "internal server error",
+        "ERR_VALIDATION": "invalid request: {message}",
+        "ERR_NOT_FOUND": "{kind} '{name}' not found",
+        "ERR_CONFLICT": "{kind} '{name}' already exists",
+        "ERR_UNAUTHORIZED": "authentication required",
+        "ERR_FORBIDDEN": "permission denied for {action}",
+        "ERR_PHASE_FAILED": "cluster phase '{phase}' failed",
+        "ERR_EXECUTOR": "task runner error: {message}",
+        "ERR_PROVISIONER": "provisioner error: {message}",
+        "ERR_UPGRADE": "upgrade rejected: {message}",
+        "ERR_TPU_TOPOLOGY": "invalid TPU topology: {message}",
+        "MSG_CLUSTER_READY": "cluster {name} is Ready",
+        "MSG_CLUSTER_FAILED": "cluster {name} failed at phase {phase}",
+        "MSG_BACKUP_DONE": "etcd backup for {name} uploaded to {account}",
+        "MSG_HEALTH_DEGRADED": "cluster {name} health degraded: {detail}",
+        "MSG_SMOKE_PASSED": "TPU smoke test passed: {gbps} GB/s over {chips} chips",
+        "MSG_SMOKE_FAILED": "TPU smoke test FAILED on cluster {name}: {detail}",
+    },
+    "zh-CN": {
+        "ERR_INTERNAL": "服务器内部错误",
+        "ERR_VALIDATION": "无效请求: {message}",
+        "ERR_NOT_FOUND": "{kind} '{name}' 不存在",
+        "ERR_CONFLICT": "{kind} '{name}' 已存在",
+        "ERR_UNAUTHORIZED": "需要登录认证",
+        "ERR_FORBIDDEN": "没有 {action} 的权限",
+        "ERR_PHASE_FAILED": "集群阶段 '{phase}' 执行失败",
+        "ERR_EXECUTOR": "任务执行器错误: {message}",
+        "ERR_PROVISIONER": "资源供给错误: {message}",
+        "ERR_UPGRADE": "升级被拒绝: {message}",
+        "ERR_TPU_TOPOLOGY": "无效的 TPU 拓扑: {message}",
+        "MSG_CLUSTER_READY": "集群 {name} 已就绪",
+        "MSG_CLUSTER_FAILED": "集群 {name} 在阶段 {phase} 失败",
+        "MSG_BACKUP_DONE": "集群 {name} 的 etcd 备份已上传到 {account}",
+        "MSG_HEALTH_DEGRADED": "集群 {name} 健康状态下降: {detail}",
+        "MSG_SMOKE_PASSED": "TPU 冒烟测试通过: {chips} 芯片 {gbps} GB/s",
+        "MSG_SMOKE_FAILED": "集群 {name} 的 TPU 冒烟测试失败: {detail}",
+    },
+}
+
+DEFAULT_LOCALE = "en-US"
+
+
+class _SafeDict(dict):
+    def __missing__(self, key: str) -> str:  # leave unknown placeholders visible
+        return "{" + key + "}"
+
+
+def translate(code: str, locale: str = DEFAULT_LOCALE, **args: object) -> str:
+    table = CATALOG.get(locale) or CATALOG[DEFAULT_LOCALE]
+    template = table.get(code) or CATALOG[DEFAULT_LOCALE].get(code) or code
+    return template.format_map(_SafeDict(**{k: str(v) for k, v in args.items()}))

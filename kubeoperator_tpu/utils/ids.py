@@ -1,0 +1,21 @@
+"""ID and time helpers used across models/repository."""
+
+from __future__ import annotations
+
+import time
+import uuid
+
+
+def new_id() -> str:
+    """Random UUID4 string — primary key for every entity (reference uses
+    UUID char(36) PKs via GORM [upstream — UNVERIFIED], SURVEY.md §2.1 1d)."""
+    return str(uuid.uuid4())
+
+
+def now_ts() -> float:
+    """Wall-clock seconds; single definition so tests can monkeypatch."""
+    return time.time()
+
+
+def now_iso() -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(now_ts()))

@@ -1,0 +1,36 @@
+"""Structured logging (reference: logrus server logs — SURVEY.md §5.5).
+
+One process-wide logger tree under ``ko_tpu``; phase/task logs additionally
+flow through the executor's streamed-result store (executor/results.py), which
+is the reference's kobe ``WatchResult`` persistence analog.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+
+def setup_logging(level: str = "INFO", log_dir: str | None = None) -> logging.Logger:
+    root = logging.getLogger("ko_tpu")
+    root.setLevel(getattr(logging, level.upper(), logging.INFO))
+    if root.handlers:  # idempotent across repeated service construction
+        return root
+    fmt = logging.Formatter(
+        "%(asctime)s %(levelname)-7s %(name)s: %(message)s", "%H:%M:%S"
+    )
+    sh = logging.StreamHandler(sys.stderr)
+    sh.setFormatter(fmt)
+    root.addHandler(sh)
+    if log_dir:
+        os.makedirs(log_dir, exist_ok=True)
+        fh = logging.FileHandler(os.path.join(log_dir, "ko-tpu-server.log"))
+        fh.setFormatter(fmt)
+        root.addHandler(fh)
+    root.propagate = False
+    return root
+
+
+def get_logger(name: str) -> logging.Logger:
+    return logging.getLogger(f"ko_tpu.{name}")

@@ -1,0 +1,19 @@
+"""Framework version.
+
+The reference platform versions the server, UI and content bundle together
+(upstream v3.x line — SURVEY.md §0.4); we do the same with a single version.
+"""
+
+__version__ = "0.1.0"
+
+# Kubernetes versions this content bundle can deploy/upgrade between.
+# The reference gates upgrades to one minor hop (SURVEY.md §3.4); the
+# supported list is what the offline registry bundles.
+SUPPORTED_K8S_VERSIONS = (
+    "v1.27.16",
+    "v1.28.15",
+    "v1.29.10",
+    "v1.30.6",
+)
+
+DEFAULT_K8S_VERSION = "v1.29.10"

@@ -1,0 +1,22 @@
+"""Test harness config.
+
+Per SURVEY.md §4: the multi-chip path is CI-tested on a virtual 8-device CPU
+mesh via `xla_force_host_platform_device_count`; real-TPU runs are reserved
+for bench.py. Env must be set before the first `import jax` anywhere in the
+test process, hence module scope here.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flag = "--xla_force_host_platform_device_count=8"
+if _flag not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") + " " + _flag).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def tmp_db(tmp_path):
+    """Fresh on-disk SQLite DB path (``:memory:`` breaks across threads)."""
+    return str(tmp_path / "ko_test.db")

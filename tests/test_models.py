@@ -1,0 +1,167 @@
+"""Plan-schema validation and entity round-tripping (SURVEY.md §7.1)."""
+
+import pytest
+
+from kubeoperator_tpu.models import (
+    BackupStrategy,
+    Cluster,
+    ClusterSpec,
+    ClusterStatus,
+    Credential,
+    Plan,
+    Role,
+)
+from kubeoperator_tpu.models.cluster import ConditionStatus
+from kubeoperator_tpu.models.component import ClusterComponent
+from kubeoperator_tpu.models.tenancy import hash_password, verify_password
+from kubeoperator_tpu.utils.errors import ValidationError
+
+
+def tpu_plan(**kw) -> Plan:
+    defaults = dict(
+        name="tpu-v5e-16",
+        provider="gcp_tpu_vm",
+        region_id="r1",
+        accelerator="tpu",
+        tpu_type="v5e-16",
+        worker_count=0,
+    )
+    defaults.update(kw)
+    return Plan(**defaults)
+
+
+class TestPlan:
+    def test_tpu_plan_derives_worker_count(self):
+        p = tpu_plan()
+        p.validate()
+        assert p.tpu_worker_count() == 4
+
+    def test_tpu_plan_host_count_mismatch_rejected(self):
+        with pytest.raises(ValidationError):
+            tpu_plan(worker_count=3).validate()
+        tpu_plan(worker_count=4).validate()  # exact match OK
+
+    def test_tpu_requires_gcp_provider(self):
+        with pytest.raises(ValidationError):
+            tpu_plan(provider="vsphere").validate()
+
+    def test_gpu_accelerator_is_schema_invalid(self):
+        # "no GPU package in the build" begins at the schema [BASELINE].
+        with pytest.raises(ValidationError):
+            tpu_plan(accelerator="gpu").validate()
+
+    def test_ha_master_counts(self):
+        with pytest.raises(ValidationError):
+            Plan(name="p", provider="bare_metal", master_count=2).validate()
+        Plan(name="p", provider="bare_metal", master_count=3).validate()
+
+    def test_multislice_plan(self):
+        p = tpu_plan(tpu_type="v5p-64", num_slices=2, worker_count=0)
+        p.validate()
+        assert p.tpu_worker_count() == 16
+        assert p.topology().is_multislice
+
+
+class TestClusterSpec:
+    def test_unsupported_k8s_version(self):
+        with pytest.raises(ValidationError):
+            ClusterSpec(k8s_version="v1.11.0").validate()
+
+    def test_external_lb_needs_endpoint(self):
+        with pytest.raises(ValidationError):
+            ClusterSpec(lb_mode="external").validate()
+
+
+class TestConditions:
+    def test_upsert_and_resume_point(self):
+        st = ClusterStatus()
+        st.upsert_condition("base", ConditionStatus.OK)
+        st.upsert_condition("etcd", ConditionStatus.FAILED, "boom")
+        st.upsert_condition("runtime", ConditionStatus.UNKNOWN)
+        assert st.first_unfinished() == "etcd"
+        st.upsert_condition("etcd", ConditionStatus.OK)
+        assert st.first_unfinished() == "runtime"
+
+    def test_duration_tracked(self):
+        st = ClusterStatus()
+        c = st.upsert_condition("base", ConditionStatus.RUNNING)
+        st.upsert_condition("base", ConditionStatus.OK)
+        assert c.duration_s >= 0
+        assert st.total_duration_s() == c.duration_s
+
+
+class TestClusterName:
+    def test_rfc1123_enforced(self):
+        for bad in ("Prod-Cluster", "-demo-", "a" * 64, "", "has_underscore"):
+            with pytest.raises(ValidationError):
+                Cluster(name=bad).validate()
+        Cluster(name="demo-1").validate()
+
+
+class TestRedaction:
+    def test_secrets_stripped_from_public_dict(self):
+        c = Credential(name="c", password="hunter2", private_key="PEM")
+        pub = c.to_public_dict()
+        assert "password" not in pub and "private_key" not in pub
+        cl = Cluster(name="demo", kubeconfig="apiVersion: v1 ...")
+        assert "kubeconfig" not in cl.to_public_dict()
+        assert cl.to_dict()["kubeconfig"]  # persistence path keeps it
+
+
+class TestRetrySpans:
+    def test_rerun_resets_duration(self, monkeypatch):
+        import kubeoperator_tpu.models.cluster as mc
+
+        clock = {"t": 100.0}
+        monkeypatch.setattr(mc, "now_ts", lambda: clock["t"])
+        st = ClusterStatus()
+        st.upsert_condition("etcd", ConditionStatus.RUNNING)
+        clock["t"] = 110.0
+        st.upsert_condition("etcd", ConditionStatus.FAILED, "boom")
+        clock["t"] = 400.0  # long idle gap before the retry
+        st.upsert_condition("etcd", ConditionStatus.RUNNING)
+        clock["t"] = 430.0
+        c = st.upsert_condition("etcd", ConditionStatus.OK)
+        assert c.duration_s == 30.0  # retry span only, not 320s
+
+
+class TestRoundTrip:
+    def test_cluster_round_trips_nested(self):
+        c = Cluster(name="demo", spec=ClusterSpec(tpu_enabled=True))
+        c.status.upsert_condition("base", ConditionStatus.OK)
+        d = c.to_dict()
+        c2 = Cluster.from_dict(d)
+        assert c2.spec.tpu_enabled
+        assert c2.status.conditions[0].name == "base"
+        assert c2.status.conditions[0].status == "OK"
+        assert isinstance(c2.spec, ClusterSpec)
+
+    def test_unknown_keys_ignored(self):
+        c = Cluster.from_dict({"name": "x", "bogus_future_field": 1})
+        assert c.name == "x"
+
+
+class TestMisc:
+    def test_credential_xor(self):
+        with pytest.raises(ValidationError):
+            Credential(name="c").validate()
+        with pytest.raises(ValidationError):
+            Credential(name="c", password="p", private_key="k").validate()
+        Credential(name="c", password="p").validate()
+
+    def test_password_hashing(self):
+        h = hash_password("s3cret")
+        assert verify_password("s3cret", h)
+        assert not verify_password("wrong", h)
+
+    def test_role_ordering(self):
+        assert Role.ADMIN.allows(Role.VIEWER)
+        assert not Role.VIEWER.allows(Role.MANAGER)
+
+    def test_backup_cron_validation(self):
+        with pytest.raises(ValidationError):
+            BackupStrategy(cluster_id="c", account_id="a", cron="bad").validate()
+
+    def test_gpu_component_forbidden(self):
+        with pytest.raises(ValidationError):
+            ClusterComponent(cluster_id="c", name="gpu").validate()

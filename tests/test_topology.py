@@ -1,0 +1,91 @@
+"""Slice-topology math — the plan-validation ground truth (SURVEY.md §2.2,
+§7.1: "topology ↔ host count consistency: v5e-16 ⇒ 4 TPU hosts")."""
+
+import pytest
+
+from kubeoperator_tpu.parallel.topology import (
+    GENERATIONS,
+    parse_accelerator_type,
+    parse_ici_mesh,
+)
+from kubeoperator_tpu.utils.errors import TopologyError
+
+
+def test_v5e_16_north_star_shape():
+    topo = parse_accelerator_type("v5e-16")
+    assert topo.chips == 16
+    assert topo.hosts_per_slice == 4          # 4 hosts x 4 chips [BASELINE]
+    assert topo.ici_mesh == (4, 4)
+    assert topo.jax_device_count == 16
+    assert topo.local_device_count == 4
+    assert topo.is_multihost
+    assert not topo.is_multislice
+    assert topo.gcp_accelerator_type == "v5litepod-16"
+    assert topo.gcp_topology == "4x4"
+
+
+def test_v5p_64_is_cores_not_chips():
+    topo = parse_accelerator_type("v5p-64")
+    assert topo.chips == 32                   # suffix counts TensorCores
+    assert topo.hosts_per_slice == 8
+    assert sorted(topo.ici_mesh) == [2, 4, 4]  # 3-D torus
+    assert topo.accelerator_type == "v5p-64"
+
+
+def test_single_host_sizes():
+    assert parse_accelerator_type("v5e-4").hosts_per_slice == 1
+    assert parse_accelerator_type("v5e-8").hosts_per_slice == 1
+    assert parse_accelerator_type("v5e-1").hosts_per_slice == 1
+    assert parse_accelerator_type("v4-8").hosts_per_slice == 1  # 4 chips, 1 host
+
+
+def test_common_2d_topologies():
+    assert parse_accelerator_type("v5e-32").ici_mesh == (4, 8)
+    assert parse_accelerator_type("v5e-64").ici_mesh == (8, 8)
+    assert parse_accelerator_type("v5e-256").ici_mesh == (16, 16)
+    assert parse_accelerator_type("v6e-16").ici_mesh == (4, 4)
+
+
+def test_gcp_alias_accepted():
+    topo = parse_accelerator_type("v5litepod-16")
+    assert topo.generation.name == "v5e"
+    assert topo.chips == 16
+
+
+def test_explicit_topology_override():
+    topo = parse_accelerator_type("v5e-16", ici_mesh="2x8")
+    assert topo.ici_mesh == (2, 8)
+    with pytest.raises(TopologyError):
+        parse_accelerator_type("v5e-16", ici_mesh="4x8")  # 32 != 16
+
+
+def test_multislice_hosts_and_devices():
+    topo = parse_accelerator_type("v5p-64", num_slices=2)
+    assert topo.total_hosts == 16
+    assert topo.total_chips == 64
+    assert topo.is_multislice
+
+
+def test_rejects_odd_cores_and_unknown_gen():
+    with pytest.raises(TopologyError):
+        parse_accelerator_type("v5p-63")      # cores not divisible by 2
+    with pytest.raises(TopologyError):
+        parse_accelerator_type("v7z-8")
+    with pytest.raises(TopologyError):
+        parse_accelerator_type("v5e")         # no size suffix
+    with pytest.raises(TopologyError):
+        parse_accelerator_type("v5e-10")      # not single-host, not /4
+
+
+def test_parse_ici_mesh():
+    assert parse_ici_mesh("2x2x4") == (2, 2, 4)
+    assert parse_ici_mesh("4×4") == (4, 4)    # unicode ×
+    with pytest.raises(TopologyError):
+        parse_ici_mesh("4xfour")
+
+
+def test_registry_sanity():
+    for gen in GENERATIONS.values():
+        assert gen.chips_per_host == 4        # all supported gens: 4-chip hosts
+        assert gen.bf16_tflops_per_chip > 0
+        assert gen.suffix_unit in ("chips", "cores")
