@@ -1,0 +1,24 @@
+"""ClusterAdm — the resumable phase state-machine (SURVEY.md §2.1 row 1c).
+
+Pure orchestration: knows phase *order* and *conditions*, delegates every
+side effect to the executor/provisioner. One ClusterStatusCondition row per
+phase; a failed operation re-enters at the first non-OK condition
+(SURVEY.md §3.1).
+"""
+
+from kubeoperator_tpu.adm.engine import AdmContext, ClusterAdm, Phase
+from kubeoperator_tpu.adm.phases import (
+    backup_phases,
+    create_phases,
+    reset_phases,
+    restore_phases,
+    scale_down_phases,
+    scale_up_phases,
+    upgrade_phases,
+)
+
+__all__ = [
+    "AdmContext", "ClusterAdm", "Phase",
+    "create_phases", "upgrade_phases", "scale_up_phases", "scale_down_phases",
+    "backup_phases", "restore_phases", "reset_phases",
+]

@@ -1,0 +1,188 @@
+"""The phase engine.
+
+Parity model (SURVEY.md §3.1): for each phase — build inventory + extra-vars
+from ClusterSpec, run the phase playbook through the executor, stream output
+to the log sink, write ClusterStatusCondition(phase, OK|Failed); Failed halts
+and a retry re-enters at the failed phase. Phase wall-clock spans land in the
+conditions, so the create-to-Ready trace (BASELINE metric 1) falls out of the
+condition rows for free (SURVEY.md §5.1).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable
+
+from kubeoperator_tpu.executor.base import Executor, TaskResult
+from kubeoperator_tpu.models import Cluster, Credential, Host, Node, Plan
+from kubeoperator_tpu.models.cluster import ConditionStatus
+from kubeoperator_tpu.executor.inventory import build_inventory
+from kubeoperator_tpu.utils.errors import PhaseError
+from kubeoperator_tpu.utils.logging import get_logger
+
+log = get_logger("adm")
+
+
+@dataclass(frozen=True)
+class Phase:
+    """One ordered step of an operation."""
+
+    name: str                         # condition name, e.g. "etcd"
+    playbook: str                     # content playbook file
+    enabled: Callable[["AdmContext"], bool] = lambda ctx: True
+    tags: tuple[str, ...] = ()
+    limit_new_nodes: bool = False     # restrict to the joining nodes (scale-up)
+    post: Callable[["AdmContext", TaskResult, list[str]], None] | None = None
+
+
+@dataclass
+class AdmContext:
+    """Everything a phase run needs; assembled by the service layer."""
+
+    cluster: Cluster
+    nodes: list[Node]
+    hosts_by_id: dict[str, Host]
+    credentials_by_id: dict[str, Credential]
+    plan: Plan | None = None
+    new_node_names: set[str] = field(default_factory=set)
+    extra_vars: dict = field(default_factory=dict)
+    # sinks wired by the service layer
+    log_sink: Callable[[str, str], None] = lambda task_id, line: None
+    save_cluster: Callable[[Cluster], None] = lambda cluster: None
+
+    def inventory(self) -> dict:
+        return build_inventory(
+            self.nodes, self.hosts_by_id, self.credentials_by_id,
+            self.new_node_names or None,
+        )
+
+    def build_extra_vars(self) -> dict:
+        """Tier-3 vars contract (SURVEY.md §5.6): ClusterSpec + plan TPU
+        topology flattened for the content layer."""
+        spec = self.cluster.spec
+        ev: dict = {
+            "cluster_name": self.cluster.name,
+            "k8s_version": spec.k8s_version,
+            "container_runtime": spec.runtime,
+            "network_plugin": spec.cni,
+            "ingress_controller": spec.ingress,
+            "service_cidr": spec.service_cidr,
+            "pod_cidr": spec.pod_cidr,
+            "lb_mode": spec.lb_mode,
+            "lb_endpoint": spec.lb_endpoint,
+            "helm_enabled": spec.helm_enabled,
+            "metrics_server_enabled": spec.metrics_server_enabled,
+            "tpu_enabled": spec.tpu_enabled,
+            "jobset_enabled": spec.jobset_enabled,
+        }
+        if self.plan is not None and self.plan.has_tpu():
+            topo = self.plan.topology()
+            ev.update(
+                tpu_type=topo.generation.name,
+                tpu_accelerator_type=topo.accelerator_type,
+                tpu_gcp_accelerator_type=topo.gcp_accelerator_type,
+                tpu_slice_topology=topo.gcp_topology,
+                tpu_num_slices=topo.num_slices,
+                tpu_hosts_per_slice=topo.hosts_per_slice,
+                tpu_chips_total=topo.total_chips,
+                tpu_chips_per_host=topo.local_device_count,
+                tpu_runtime_version=(
+                    self.plan.tpu_runtime_version
+                    or topo.generation.default_runtime_version
+                ),
+                smoke_test_gbps_threshold=spec.smoke_test_gbps_threshold,
+            )
+        ev.update(self.extra_vars)
+        return ev
+
+
+class ClusterAdm:
+    """Runs an ordered phase list against a context, resumably."""
+
+    def __init__(self, executor: Executor) -> None:
+        self.executor = executor
+
+    def run(self, ctx: AdmContext, phases: list[Phase]) -> None:
+        """Execute phases in order; on failure raise PhaseError leaving the
+        failed condition in place so the next run re-enters there.
+
+        Resume semantics: if any of this operation's phases is unfinished
+        (Unknown/Running/Failed), this is a retry — completed phases are
+        skipped and execution re-enters at the first unfinished one. If all
+        phases are OK (a *previous* run of this operation completed), the
+        conditions are reset and the operation runs fresh — so a second
+        scale-up or backup is never a silent no-op."""
+        status = ctx.cluster.status
+        active = [p for p in phases if p.enabled(ctx)]
+        names = [p.name for p in active]
+
+        existing = [status.condition(n) for n in names]
+        all_ok = existing and all(
+            c is not None and c.status == ConditionStatus.OK.value for c in existing
+        )
+        if all_ok:
+            status.reset_conditions(names)
+
+        # Pre-register conditions in order so the UI shows the full pipeline
+        # up front (reference behavior: all conditions visible as Unknown).
+        for p in active:
+            if status.condition(p.name) is None:
+                status.upsert_condition(p.name, ConditionStatus.UNKNOWN)
+        ctx.save_cluster(ctx.cluster)
+
+        for p in active:
+            cond = status.condition(p.name)
+            if cond is not None and cond.status == ConditionStatus.OK.value:
+                log.info("cluster %s: phase %s already OK, skipping",
+                         ctx.cluster.name, p.name)
+                continue
+            self._run_phase(ctx, p)
+
+    def _run_phase(self, ctx: AdmContext, phase: Phase) -> None:
+        cluster = ctx.cluster
+        status = cluster.status
+        log.info("cluster %s: phase %s starting (%s)",
+                 cluster.name, phase.name, phase.playbook)
+        status.upsert_condition(phase.name, ConditionStatus.RUNNING)
+        ctx.save_cluster(cluster)
+
+        try:
+            task_id = self.executor.run_playbook(
+                phase.playbook,
+                ctx.inventory(),
+                ctx.build_extra_vars(),
+                tags=list(phase.tags),
+                limit="new-workers" if phase.limit_new_nodes else "",
+            )
+            lines: list[str] = []
+            for line in self.executor.watch(task_id):
+                lines.append(line)
+                ctx.log_sink(task_id, line)
+            result = self.executor.result(task_id)
+            if result.ok and phase.post is not None:
+                # post-hooks parse phase output (e.g. smoke-test GB/s) and may
+                # veto success by raising PhaseError.
+                phase.post(ctx, result, lines)
+        except PhaseError as e:
+            status.upsert_condition(phase.name, ConditionStatus.FAILED, e.message)
+            ctx.save_cluster(cluster)
+            raise
+        except Exception as e:
+            # Anything else (watch timeout, post-hook bug, runner crash) must
+            # still land the condition in Failed — a condition stuck at
+            # Running would wedge resumability forever.
+            status.upsert_condition(phase.name, ConditionStatus.FAILED, str(e))
+            ctx.save_cluster(cluster)
+            raise PhaseError(phase.name, str(e)) from e
+
+        if result.ok:
+            status.upsert_condition(phase.name, ConditionStatus.OK)
+            ctx.save_cluster(cluster)
+            log.info("cluster %s: phase %s OK (%.1fs)", cluster.name, phase.name,
+                     status.condition(phase.name).duration_s)
+        else:
+            status.upsert_condition(
+                phase.name, ConditionStatus.FAILED, result.message
+            )
+            ctx.save_cluster(cluster)
+            raise PhaseError(phase.name, result.message)

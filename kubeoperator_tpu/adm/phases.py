@@ -1,0 +1,132 @@
+"""Phase lists per operation (SURVEY.md §3.1/§3.3/§3.4/§3.5).
+
+Create order mirrors the reference's numbered playbooks — prepare/base →
+etcd → runtime → kube-master → kube-worker → network-plugin → post/addons —
+with the north-star delta: the GPU phase is replaced by `tpu-runtime`
+(libtpu env + TPU device plugin + JobSet) followed by `tpu-smoke-test`
+(psum bus-bandwidth gate) [BASELINE].
+"""
+
+from __future__ import annotations
+
+import json
+import re
+
+from kubeoperator_tpu.adm.engine import AdmContext, Phase
+from kubeoperator_tpu.executor.base import TaskResult
+from kubeoperator_tpu.utils.errors import PhaseError
+
+SMOKE_MARKER = "KO_TPU_SMOKE_RESULT"
+_SMOKE_RE = re.compile(re.escape(SMOKE_MARKER) + r"\s*(\{.*\})")
+
+
+def _tpu(ctx: AdmContext) -> bool:
+    return ctx.cluster.spec.tpu_enabled
+
+
+def parse_smoke_result(lines: list[str]) -> dict | None:
+    """Find the smoke Job's result line in phase output.
+
+    The tpu-smoke-test role prints the psum Job's final log line, which the
+    workload (ops/psum_smoke.py) emits as `KO_TPU_SMOKE_RESULT {json}`."""
+    for line in reversed(lines):
+        m = _SMOKE_RE.search(line)
+        if m:
+            try:
+                return json.loads(m.group(1))
+            except json.JSONDecodeError:
+                continue
+    return None
+
+
+def smoke_post(ctx: AdmContext, result: TaskResult, lines: list[str]) -> None:
+    """Gate Ready on the measured psum bandwidth (BASELINE metric 2)."""
+    data = parse_smoke_result(lines)
+    status = ctx.cluster.status
+    if data is None:
+        raise PhaseError("tpu-smoke-test", "no smoke-test result in job output")
+    try:
+        gbps = float(data.get("gbps") or 0.0)
+        chips = int(data.get("chips") or 0)
+    except (TypeError, ValueError):
+        raise PhaseError(
+            "tpu-smoke-test", f"malformed smoke-test result: {data!r}"
+        )
+    status.smoke_gbps = gbps
+    status.smoke_chips = chips
+    expected_chips = (
+        ctx.plan.topology().total_chips if ctx.plan and ctx.plan.has_tpu() else 0
+    )
+    threshold = ctx.cluster.spec.smoke_test_gbps_threshold
+    if expected_chips and chips != expected_chips:
+        raise PhaseError(
+            "tpu-smoke-test",
+            f"smoke test saw {chips} chips, expected {expected_chips}",
+        )
+    if threshold > 0 and gbps < threshold:
+        raise PhaseError(
+            "tpu-smoke-test",
+            f"psum bandwidth {gbps:.1f} GB/s below threshold {threshold:.1f}",
+        )
+    status.smoke_passed = True
+
+
+def create_phases() -> list[Phase]:
+    return [
+        Phase("base", "01-base.yml"),
+        Phase("runtime", "02-runtime.yml"),
+        Phase("etcd", "05-etcd.yml"),
+        Phase("lb", "06-lb.yml",
+              enabled=lambda ctx: ctx.cluster.spec.lb_mode == "internal"),
+        Phase("kube-master", "07-kube-master.yml"),
+        Phase("kube-worker", "08-kube-worker.yml"),
+        Phase("network", "09-network.yml"),
+        Phase("post", "10-post.yml"),
+        Phase("tpu-runtime", "16-tpu-runtime.yml", enabled=_tpu),
+        Phase("tpu-smoke-test", "17-tpu-smoke-test.yml", enabled=_tpu,
+              post=smoke_post),
+    ]
+
+
+def upgrade_phases() -> list[Phase]:
+    """Masters serially, then workers rolling (SURVEY.md §3.4)."""
+    return [
+        Phase("upgrade-prepare", "20-upgrade-prepare.yml"),
+        Phase("upgrade-masters", "21-upgrade-masters.yml"),
+        Phase("upgrade-workers", "22-upgrade-workers.yml"),
+        Phase("upgrade-verify", "23-upgrade-verify.yml"),
+    ]
+
+
+def scale_up_phases() -> list[Phase]:
+    """Join phases limited to the new nodes only (SURVEY.md §3.3)."""
+    return [
+        Phase("scale-base", "01-base.yml", limit_new_nodes=True),
+        Phase("scale-runtime", "02-runtime.yml", limit_new_nodes=True),
+        Phase("scale-join", "08-kube-worker.yml", limit_new_nodes=True),
+        Phase("scale-network", "09-network.yml", limit_new_nodes=True),
+        Phase("scale-tpu-runtime", "16-tpu-runtime.yml", enabled=_tpu,
+              limit_new_nodes=True),
+    ]
+
+
+def scale_down_phases() -> list[Phase]:
+    return [
+        Phase("drain", "30-drain-node.yml"),
+        Phase("remove", "31-remove-node.yml"),
+    ]
+
+
+def backup_phases() -> list[Phase]:
+    return [Phase("backup-etcd", "40-backup-etcd.yml")]
+
+
+def restore_phases() -> list[Phase]:
+    return [
+        Phase("restore-etcd", "41-restore-etcd.yml"),
+        Phase("restore-verify", "42-restore-verify.yml"),
+    ]
+
+
+def reset_phases() -> list[Phase]:
+    return [Phase("reset", "90-reset.yml")]

@@ -1,0 +1,42 @@
+"""Executor layer — the kobe-equivalent Ansible runner (SURVEY.md §2.1 row 3).
+
+Contract parity with kobe's gRPC surface (`RunPlaybook`, `RunAdhoc`,
+`WatchResult` streamed stdout, `GetResult`): an `Executor` accepts a TaskSpec
+(playbook or adhoc + dynamic inventory + extra-vars), returns a task id
+immediately, streams output lines, and exposes a final per-host result.
+
+Backends:
+  - FakeExecutor       scripted results; the test double SURVEY.md §4 demands
+  - SimulationExecutor walks real playbook YAML and simulates host execution —
+                       powers air-gapped demos/e2e without SSH targets
+  - AnsibleExecutor    forks `ansible-playbook` (gated on the binary existing)
+
+The gRPC service wrapper (runner_service.py) runs any backend as a separate
+process the way kobe runs beside ko-server.
+"""
+
+from kubeoperator_tpu.executor.base import Executor, TaskSpec, TaskResult, TaskStatus
+from kubeoperator_tpu.executor.fake import FakeExecutor
+from kubeoperator_tpu.executor.simulation import SimulationExecutor
+from kubeoperator_tpu.executor.ansible import AnsibleExecutor, ansible_available
+from kubeoperator_tpu.executor.inventory import build_inventory
+
+__all__ = [
+    "Executor", "TaskSpec", "TaskResult", "TaskStatus",
+    "FakeExecutor", "SimulationExecutor", "AnsibleExecutor",
+    "ansible_available", "build_inventory",
+]
+
+
+def make_executor(backend: str = "auto", project_dir: str | None = None) -> Executor:
+    """Backend factory honoring config `executor.backend` (auto|ansible|
+    simulation|fake)."""
+    if backend == "auto":
+        backend = "ansible" if ansible_available() else "simulation"
+    if backend == "ansible":
+        return AnsibleExecutor(project_dir=project_dir)
+    if backend == "simulation":
+        return SimulationExecutor(project_dir=project_dir)
+    if backend == "fake":
+        return FakeExecutor()
+    raise ValueError(f"unknown executor backend {backend!r}")

@@ -1,0 +1,134 @@
+"""AnsibleExecutor — forks `ansible-playbook`/`ansible` like kobe does
+(SURVEY.md §2.1 row 3: "forks ansible-playbook", process boundary §3.1).
+
+Gated on the binary being installed; environments without ansible use the
+simulation backend (make_executor("auto")). Inventory is materialized as a
+YAML file per task; extra-vars via a JSON file (`-e @vars.json`) so values
+with spaces/quotes survive. Private keys from credentials are written to a
+0600 temp file and referenced via ansible_ssh_private_key_file.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+import tempfile
+
+import yaml
+
+from kubeoperator_tpu.executor.base import (
+    Executor,
+    HostStats,
+    TaskSpec,
+    TaskStatus,
+    _TaskState,
+)
+from kubeoperator_tpu.executor.simulation import DEFAULT_PROJECT_DIR
+
+_RECAP_MARK = "PLAY RECAP"
+
+
+def ansible_available() -> bool:
+    return shutil.which("ansible-playbook") is not None
+
+
+class AnsibleExecutor(Executor):
+    def __init__(
+        self, project_dir: str | None = None, fork_limit: int = 32
+    ) -> None:
+        super().__init__()
+        self.project_dir = project_dir or DEFAULT_PROJECT_DIR
+        self.fork_limit = fork_limit
+
+    def _materialize(self, spec: TaskSpec, workdir: str) -> tuple[list[str], dict]:
+        """Write inventory/vars files; return (argv, env)."""
+        inventory = json.loads(json.dumps(spec.inventory))  # deep copy
+        # private_key content -> key file + standard ansible var
+        for hv in inventory.get("all", {}).get("hosts", {}).values():
+            key = hv.pop("ansible_ssh_private_key_content", None)
+            if key:
+                fd, keypath = tempfile.mkstemp(dir=workdir, suffix=".pem")
+                with os.fdopen(fd, "w") as f:
+                    f.write(key)
+                os.chmod(keypath, 0o600)
+                hv["ansible_ssh_private_key_file"] = keypath
+        inv_path = os.path.join(workdir, "inventory.yml")
+        with open(inv_path, "w", encoding="utf-8") as f:
+            yaml.safe_dump(inventory, f)
+        vars_path = os.path.join(workdir, "extra_vars.json")
+        with open(vars_path, "w", encoding="utf-8") as f:
+            json.dump(spec.extra_vars, f)
+
+        if spec.playbook:
+            argv = [
+                "ansible-playbook",
+                os.path.join(self.project_dir, "playbooks", spec.playbook),
+                "-i", inv_path,
+                "-e", f"@{vars_path}",
+                "--forks", str(self.fork_limit),
+            ]
+            if spec.tags:
+                argv += ["--tags", ",".join(spec.tags)]
+            if spec.limit:
+                argv += ["--limit", spec.limit]
+        else:
+            argv = [
+                "ansible", spec.adhoc_pattern,
+                "-m", spec.adhoc_module,
+                "-a", spec.adhoc_args,
+                "-i", inv_path,
+                "--forks", str(self.fork_limit),
+            ]
+        env = dict(os.environ)
+        env.update(
+            ANSIBLE_HOST_KEY_CHECKING="False",
+            ANSIBLE_ROLES_PATH=os.path.join(self.project_dir, "roles"),
+            ANSIBLE_FORCE_COLOR="false",
+        )
+        return argv, env
+
+    def _execute(self, spec: TaskSpec, state: _TaskState) -> None:
+        with tempfile.TemporaryDirectory(prefix="ko-task-") as workdir:
+            argv, env = self._materialize(spec, workdir)
+            proc = subprocess.Popen(
+                argv,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+                env=env,
+                cwd=self.project_dir,
+            )
+            in_recap = False
+            assert proc.stdout is not None
+            for line in proc.stdout:
+                line = line.rstrip("\n")
+                state.emit(line)
+                if _RECAP_MARK in line:
+                    in_recap = True
+                    continue
+                if in_recap and ":" in line:
+                    self._parse_recap_line(line, state)
+            rc = proc.wait()
+            if rc == 0:
+                state.finish(TaskStatus.SUCCESS, rc=0)
+            else:
+                state.finish(
+                    TaskStatus.FAILED, rc=rc, message=f"ansible exited {rc}"
+                )
+
+    @staticmethod
+    def _parse_recap_line(line: str, state: _TaskState) -> None:
+        """Parse 'host : ok=3 changed=1 failed=0 ...' recap rows."""
+        host, _, rest = line.partition(":")
+        stats = HostStats()
+        found = False
+        for token in rest.split():
+            if "=" in token:
+                k, _, v = token.partition("=")
+                if hasattr(stats, k) and v.isdigit():
+                    setattr(stats, k, int(v))
+                    found = True
+        if found:
+            state.result.host_stats[host.strip()] = stats

@@ -1,0 +1,210 @@
+"""Executor contract + shared task bookkeeping.
+
+Mirrors kobe's task model (SURVEY.md §2.1 row 3): submit returns immediately
+with a task id; output is consumed as a line stream (`watch`); the final
+result carries per-host stats like ansible's recap. All backends share the
+thread-per-task runner + buffered stream implemented here.
+"""
+
+from __future__ import annotations
+
+import abc
+import threading
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Callable, Iterator
+
+from kubeoperator_tpu.utils.errors import ExecutorError
+from kubeoperator_tpu.utils.ids import new_id, now_ts
+
+
+class TaskStatus(str, Enum):
+    PENDING = "Pending"
+    RUNNING = "Running"
+    SUCCESS = "Success"
+    FAILED = "Failed"
+
+
+@dataclass
+class TaskSpec:
+    """One unit of execution — a named playbook from the project dir, or an
+    adhoc module call (kobe `RunPlaybook` / `RunAdhoc` parity)."""
+
+    project: str = "ko-tpu"
+    playbook: str = ""                 # e.g. "05-etcd.yml"
+    adhoc_module: str = ""             # e.g. "ping" (exclusive with playbook)
+    adhoc_args: str = ""
+    adhoc_pattern: str = "all"
+    inventory: dict = field(default_factory=dict)   # ansible-shape groups/hosts
+    extra_vars: dict = field(default_factory=dict)  # the ClusterSpec vars contract
+    tags: list = field(default_factory=list)
+    limit: str = ""                    # host-pattern limit (scale-up joins)
+
+    def validate(self) -> None:
+        if bool(self.playbook) == bool(self.adhoc_module):
+            raise ExecutorError(
+                message="task needs exactly one of playbook or adhoc_module"
+            )
+
+
+@dataclass
+class HostStats:
+    ok: int = 0
+    changed: int = 0
+    failed: int = 0
+    unreachable: int = 0
+    skipped: int = 0
+
+
+@dataclass
+class TaskResult:
+    task_id: str
+    status: str = TaskStatus.PENDING.value
+    rc: int = -1
+    message: str = ""
+    host_stats: dict = field(default_factory=dict)  # host -> HostStats
+    started_at: float = 0.0
+    finished_at: float = 0.0
+
+    @property
+    def ok(self) -> bool:
+        return self.status == TaskStatus.SUCCESS.value
+
+
+class _TaskState:
+    """Buffered line stream + completion latch for one task."""
+
+    def __init__(self, task_id: str) -> None:
+        self.result = TaskResult(task_id=task_id)
+        self.lines: list[str] = []
+        self.cond = threading.Condition()
+        self.done = threading.Event()
+
+    def emit(self, line: str) -> None:
+        with self.cond:
+            self.lines.append(line.rstrip("\n"))
+            self.cond.notify_all()
+
+    def finish(self, status: TaskStatus, rc: int, message: str = "") -> None:
+        self.result.status = status.value
+        self.result.rc = rc
+        self.result.message = message
+        self.result.finished_at = now_ts()
+        with self.cond:
+            self.done.set()
+            self.cond.notify_all()
+
+
+class Executor(abc.ABC):
+    """Base executor: task registry + streaming; backends implement _execute.
+
+    Finished tasks are retained (for late GetResult calls, kobe parity) up to
+    `max_retained` and then evicted oldest-first, so a long-lived runner
+    process doesn't accumulate every playbook's buffered output forever.
+    """
+
+    def __init__(self, max_retained: int = 256) -> None:
+        self._tasks: dict[str, _TaskState] = {}
+        self._order: list[str] = []
+        self._max_retained = max_retained
+        self._lock = threading.Lock()
+
+    # ---- public contract (kobe parity) ----
+    def run(self, spec: TaskSpec) -> str:
+        spec.validate()
+        task_id = new_id()
+        state = _TaskState(task_id)
+        with self._lock:
+            self._tasks[task_id] = state
+            self._order.append(task_id)
+            self._evict_locked()
+        state.result.status = TaskStatus.RUNNING.value
+        state.result.started_at = now_ts()
+        thread = threading.Thread(
+            target=self._run_guarded, args=(spec, state), daemon=True
+        )
+        thread.start()
+        return task_id
+
+    def run_playbook(
+        self, playbook: str, inventory: dict, extra_vars: dict | None = None, **kw
+    ) -> str:
+        return self.run(
+            TaskSpec(
+                playbook=playbook,
+                inventory=inventory,
+                extra_vars=extra_vars or {},
+                **kw,
+            )
+        )
+
+    def run_adhoc(
+        self, module: str, args: str, inventory: dict, pattern: str = "all"
+    ) -> str:
+        return self.run(
+            TaskSpec(
+                adhoc_module=module,
+                adhoc_args=args,
+                adhoc_pattern=pattern,
+                inventory=inventory,
+            )
+        )
+
+    def watch(self, task_id: str, timeout_s: float = 7200.0) -> Iterator[str]:
+        """Yield output lines until the task finishes (kobe WatchResult)."""
+        state = self._state(task_id)
+        idx = 0
+        deadline = now_ts() + timeout_s
+        while True:
+            with state.cond:
+                while idx >= len(state.lines) and not state.done.is_set():
+                    remaining = deadline - now_ts()
+                    if remaining <= 0:
+                        raise ExecutorError(message=f"watch timeout on {task_id}")
+                    state.cond.wait(min(remaining, 1.0))
+                new_lines = state.lines[idx:]
+                idx = len(state.lines)
+                finished = state.done.is_set() and idx >= len(state.lines)
+            yield from new_lines
+            if finished:
+                return
+
+    def result(self, task_id: str) -> TaskResult:
+        return self._state(task_id).result
+
+    def wait(self, task_id: str, timeout_s: float = 7200.0) -> TaskResult:
+        state = self._state(task_id)
+        if not state.done.wait(timeout_s):
+            raise ExecutorError(message=f"task {task_id} timed out")
+        return state.result
+
+    # ---- backend plumbing ----
+    def _evict_locked(self) -> None:
+        if len(self._order) <= self._max_retained:
+            return
+        kept: list[str] = []
+        excess = len(self._order) - self._max_retained
+        for tid in self._order:
+            if excess > 0 and self._tasks[tid].done.is_set():
+                del self._tasks[tid]
+                excess -= 1
+            else:
+                kept.append(tid)
+        self._order = kept
+
+    def _state(self, task_id: str) -> _TaskState:
+        with self._lock:
+            if task_id not in self._tasks:
+                raise ExecutorError(message=f"unknown task {task_id}")
+            return self._tasks[task_id]
+
+    def _run_guarded(self, spec: TaskSpec, state: _TaskState) -> None:
+        try:
+            self._execute(spec, state)
+        except Exception as e:  # backend bug or environment failure
+            state.emit(f"EXECUTOR ERROR: {e}")
+            state.finish(TaskStatus.FAILED, rc=250, message=str(e))
+
+    @abc.abstractmethod
+    def _execute(self, spec: TaskSpec, state: _TaskState) -> None:
+        """Run to completion, emitting lines and calling state.finish()."""

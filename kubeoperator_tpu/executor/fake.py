@@ -1,0 +1,69 @@
+"""FakeExecutor — the scripted test double SURVEY.md §4 calls for.
+
+Records every (playbook, inventory, extra_vars) call so adm-flow tests can
+assert phase ordering and vars contracts without SSH or clusters; outcomes
+are scripted per playbook name (default: success). `fail_times` lets a test
+script "fail twice then succeed" to exercise resume/retry paths.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from dataclasses import dataclass, field
+
+from kubeoperator_tpu.executor.base import (
+    Executor,
+    HostStats,
+    TaskSpec,
+    TaskStatus,
+    _TaskState,
+)
+from kubeoperator_tpu.executor.inventory import inventory_host_names
+
+
+@dataclass
+class ScriptedOutcome:
+    success: bool = True
+    lines: list[str] = field(default_factory=list)
+    fail_times: int = 0   # fail this many runs, then apply `success`
+
+
+class FakeExecutor(Executor):
+    def __init__(self) -> None:
+        super().__init__()
+        self.calls: list[TaskSpec] = []
+        self.outcomes: dict[str, ScriptedOutcome] = {}
+        self._runs: dict[str, int] = defaultdict(int)
+
+    def script(self, playbook: str, **kw) -> ScriptedOutcome:
+        out = ScriptedOutcome(**kw)
+        self.outcomes[playbook] = out
+        return out
+
+    def _execute(self, spec: TaskSpec, state: _TaskState) -> None:
+        self.calls.append(spec)
+        name = spec.playbook or f"adhoc:{spec.adhoc_module}"
+        outcome = self.outcomes.get(name, ScriptedOutcome())
+        self._runs[name] += 1
+        attempt = self._runs[name]
+        success = outcome.success and attempt > outcome.fail_times
+
+        state.emit(f"PLAY [{name}] " + "*" * 40)
+        for line in outcome.lines:
+            state.emit(line)
+        hosts = inventory_host_names(spec.inventory) or ["localhost"]
+        for h in hosts:
+            stats = HostStats(ok=3, changed=1, failed=0 if success else 1)
+            state.result.host_stats[h] = stats
+            state.emit(
+                f"{h} : ok={stats.ok} changed={stats.changed} failed={stats.failed}"
+            )
+        if success:
+            state.finish(TaskStatus.SUCCESS, rc=0)
+        else:
+            state.emit(f"fatal: scripted failure for {name} (attempt {attempt})")
+            state.finish(TaskStatus.FAILED, rc=2, message=f"scripted failure {name}")
+
+    # ---- assertion helpers ----
+    def playbooks_run(self) -> list[str]:
+        return [c.playbook for c in self.calls if c.playbook]

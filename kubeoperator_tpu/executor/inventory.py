@@ -1,0 +1,85 @@
+"""Dynamic inventory builder (kobe inventory-provider parity, SURVEY.md
+§2.1 row 3: "dynamic inventory fed per-task").
+
+Builds the ansible-shape inventory dict from cluster state: role groups the
+content layer expects (kube-master / kube-worker / etcd / lb / tpu-hosts /
+new-workers), per-host connection vars from credentials, and TPU placement
+vars (worker id, slice id, chips) that the TPU runtime role templates into
+the device-plugin/JobSet manifests.
+"""
+
+from __future__ import annotations
+
+from kubeoperator_tpu.models import Credential, Host, Node, NodeRole
+
+
+def _host_vars(host: Host, credential: Credential | None) -> dict:
+    hv: dict = {
+        "ansible_host": host.ip,
+        "ansible_port": host.port,
+        "arch": host.arch,
+    }
+    if credential:
+        hv["ansible_user"] = credential.username
+        if credential.password:
+            hv["ansible_password"] = credential.password
+        if credential.private_key:
+            hv["ansible_ssh_private_key_content"] = credential.private_key
+    if host.tpu_chips > 0:
+        hv.update(
+            tpu_worker_id=host.tpu_worker_id,
+            tpu_slice_id=host.tpu_slice_id,
+            tpu_chips=host.tpu_chips,
+        )
+    return hv
+
+
+def build_inventory(
+    nodes: list[Node],
+    hosts_by_id: dict[str, Host],
+    credentials_by_id: dict[str, Credential],
+    new_node_names: set[str] | None = None,
+) -> dict:
+    """Ansible-shape inventory:
+
+    groups: all, kube-master (first master doubles as bootstrap), kube-worker,
+    etcd (co-located on masters, reference default), lb (masters when internal
+    HA), tpu-hosts (hosts with chips), new-workers (scale-up limit group).
+    """
+    inv: dict = {
+        "all": {"hosts": {}, "children": {}},
+    }
+    groups: dict[str, list[str]] = {
+        "kube-master": [],
+        "kube-worker": [],
+        "etcd": [],
+        "lb": [],
+        "tpu-hosts": [],
+        "new-workers": [],
+    }
+    for node in nodes:
+        host = hosts_by_id.get(node.host_id)
+        if host is None:
+            continue
+        cred = credentials_by_id.get(host.credential_id)
+        inv["all"]["hosts"][node.name] = _host_vars(host, cred)
+        if node.role == NodeRole.MASTER.value:
+            groups["kube-master"].append(node.name)
+            groups["etcd"].append(node.name)
+            groups["lb"].append(node.name)
+        else:
+            groups["kube-worker"].append(node.name)
+        if host.tpu_chips > 0:
+            groups["tpu-hosts"].append(node.name)
+        if new_node_names and node.name in new_node_names:
+            groups["new-workers"].append(node.name)
+    for gname, members in groups.items():
+        inv["all"]["children"][gname] = {"hosts": {m: {} for m in members}}
+    return inv
+
+
+def inventory_host_names(inventory: dict, group: str = "all") -> list[str]:
+    if group == "all":
+        return sorted(inventory.get("all", {}).get("hosts", {}).keys())
+    children = inventory.get("all", {}).get("children", {})
+    return sorted(children.get(group, {}).get("hosts", {}).keys())

@@ -1,0 +1,136 @@
+"""gRPC runner service — kobe's process boundary (SURVEY.md §2 "server↔kobe
+(gRPC, streamed task output)").
+
+Exposes any Executor backend as a standalone service with the kobe method
+set: Run (unary), Watch (server-streaming lines), Result (unary). Messages
+are JSON-over-bytes via grpc generic handlers — wire-compatible across our
+client/server pair without a protoc codegen step, keeping the air-gapped
+build dependency-free. `RunnerClient` implements the Executor interface so
+the service layer is oblivious to in-process vs remote execution.
+"""
+
+from __future__ import annotations
+
+import json
+from concurrent import futures
+from typing import Iterator
+
+import grpc
+
+from kubeoperator_tpu.executor.base import (
+    Executor,
+    HostStats,
+    TaskResult,
+    TaskSpec,
+)
+from kubeoperator_tpu.utils.errors import ExecutorError
+from kubeoperator_tpu.utils.logging import get_logger
+
+log = get_logger("runner")
+
+SERVICE = "ko.tpu.Runner"
+
+
+def _dumps(obj: dict) -> bytes:
+    return json.dumps(obj).encode()
+
+
+def _loads(raw: bytes) -> dict:
+    return json.loads(raw.decode())
+
+
+# ---------------------------------------------------------------- server ----
+class _Handler(grpc.GenericRpcHandler):
+    def __init__(self, executor: Executor) -> None:
+        self.executor = executor
+
+    def service(self, handler_call_details):
+        method = handler_call_details.method
+        if method == f"/{SERVICE}/Run":
+            return grpc.unary_unary_rpc_method_handler(
+                self._run, request_deserializer=_loads, response_serializer=_dumps
+            )
+        if method == f"/{SERVICE}/Watch":
+            return grpc.unary_stream_rpc_method_handler(
+                self._watch, request_deserializer=_loads, response_serializer=_dumps
+            )
+        if method == f"/{SERVICE}/Result":
+            return grpc.unary_unary_rpc_method_handler(
+                self._result, request_deserializer=_loads, response_serializer=_dumps
+            )
+        return None
+
+    def _run(self, request: dict, context) -> dict:
+        spec = TaskSpec(**request)
+        task_id = self.executor.run(spec)
+        log.info("runner: task %s started (%s)", task_id,
+                 spec.playbook or spec.adhoc_module)
+        return {"task_id": task_id}
+
+    def _watch(self, request: dict, context) -> Iterator[dict]:
+        for line in self.executor.watch(request["task_id"]):
+            yield {"line": line}
+
+    def _result(self, request: dict, context) -> dict:
+        r = self.executor.result(request["task_id"])
+        d = r.__dict__.copy()
+        d["host_stats"] = {h: s.__dict__ for h, s in r.host_stats.items()}
+        return d
+
+
+def serve(
+    executor: Executor, bind: str = "127.0.0.1:8790", max_workers: int = 16
+) -> grpc.Server:
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+    server.add_generic_rpc_handlers((_Handler(executor),))
+    server.add_insecure_port(bind)
+    server.start()
+    log.info("runner service listening on %s", bind)
+    return server
+
+
+# ---------------------------------------------------------------- client ----
+class RunnerClient(Executor):
+    """Executor facade over a remote runner service."""
+
+    def __init__(self, target: str = "127.0.0.1:8790") -> None:
+        super().__init__()
+        self.channel = grpc.insecure_channel(target)
+        self._run_rpc = self.channel.unary_unary(
+            f"/{SERVICE}/Run", request_serializer=_dumps, response_deserializer=_loads
+        )
+        self._watch_rpc = self.channel.unary_stream(
+            f"/{SERVICE}/Watch", request_serializer=_dumps, response_deserializer=_loads
+        )
+        self._result_rpc = self.channel.unary_unary(
+            f"/{SERVICE}/Result", request_serializer=_dumps, response_deserializer=_loads
+        )
+
+    def run(self, spec: TaskSpec) -> str:
+        spec.validate()
+        try:
+            return self._run_rpc(spec.__dict__)["task_id"]
+        except grpc.RpcError as e:
+            raise ExecutorError(message=f"runner RPC failed: {e}") from e
+
+    def watch(self, task_id: str, timeout_s: float = 7200.0) -> Iterator[str]:
+        try:
+            for msg in self._watch_rpc({"task_id": task_id}, timeout=timeout_s):
+                yield msg["line"]
+        except grpc.RpcError as e:
+            raise ExecutorError(message=f"runner watch failed: {e}") from e
+
+    def result(self, task_id: str) -> TaskResult:
+        d = self._result_rpc({"task_id": task_id})
+        d["host_stats"] = {
+            h: HostStats(**s) for h, s in d.get("host_stats", {}).items()
+        }
+        return TaskResult(**d)
+
+    def wait(self, task_id: str, timeout_s: float = 7200.0) -> TaskResult:
+        for _ in self.watch(task_id, timeout_s):
+            pass
+        return self.result(task_id)
+
+    def _execute(self, spec, state):  # pragma: no cover - remote only
+        raise NotImplementedError

@@ -1,0 +1,144 @@
+"""SimulationExecutor — executes real playbook YAML against a simulated fleet.
+
+Air-gapped/e2e-demo backend: it loads the actual playbook from the content
+project dir, resolves roles to their task lists, and "runs" each task per
+inventory host, emitting ansible-style output. No SSH, no mutation — but the
+playbook/role/inventory/vars plumbing is the real thing, so the whole
+L4→L3→L2 stack is exercised end-to-end (this is how the minimum e2e slice of
+SURVEY.md §7.4 runs in environments with no target machines).
+
+Failure injection: extra_vars["__fail_at_task__"] = "<task name>" makes that
+task fail on every host — used by resume/retry tests and chaos demos.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import yaml
+
+from kubeoperator_tpu.executor.base import (
+    Executor,
+    HostStats,
+    TaskSpec,
+    TaskStatus,
+    _TaskState,
+)
+from kubeoperator_tpu.executor.inventory import inventory_host_names
+from kubeoperator_tpu.utils.errors import ExecutorError
+
+DEFAULT_PROJECT_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "content"
+)
+
+
+class SimulationExecutor(Executor):
+    def __init__(
+        self, project_dir: str | None = None, task_delay_s: float = 0.0
+    ) -> None:
+        super().__init__()
+        self.project_dir = project_dir or DEFAULT_PROJECT_DIR
+        self.task_delay_s = task_delay_s
+
+    # ---- content resolution ----
+    def _load_playbook(self, name: str) -> list[dict]:
+        path = os.path.join(self.project_dir, "playbooks", name)
+        if not os.path.exists(path):
+            raise ExecutorError(message=f"playbook {name} not found in project dir")
+        with open(path, encoding="utf-8") as f:
+            plays = yaml.safe_load(f) or []
+        if not isinstance(plays, list):
+            raise ExecutorError(message=f"playbook {name} must be a list of plays")
+        return plays
+
+    def _role_tasks(self, role: str) -> list[dict]:
+        path = os.path.join(self.project_dir, "roles", role, "tasks", "main.yml")
+        if not os.path.exists(path):
+            return [{"name": f"{role} : (no tasks file)"}]
+        with open(path, encoding="utf-8") as f:
+            tasks = yaml.safe_load(f) or []
+        return [t if isinstance(t, dict) else {"name": str(t)} for t in tasks]
+
+    @staticmethod
+    def _when_excluded(task: dict, extra_vars: dict) -> bool:
+        """Honor the subset of `when:` used by our content: bare var names
+        and 'var' / 'not var' checks against extra-vars truthiness."""
+        cond = task.get("when")
+        if cond is None:
+            return False
+        conds = cond if isinstance(cond, list) else [cond]
+        for c in conds:
+            text = str(c).strip()
+            negate = text.startswith("not ")
+            var = text[4:].strip() if negate else text
+            val = bool(extra_vars.get(var))
+            if negate:
+                val = not val
+            if not val:
+                return True
+        return False
+
+    # ---- execution ----
+    def _execute(self, spec: TaskSpec, state: _TaskState) -> None:
+        hosts = inventory_host_names(spec.inventory) or ["localhost"]
+        stats = {h: HostStats() for h in hosts}
+        fail_at = str(spec.extra_vars.get("__fail_at_task__", ""))
+
+        if spec.adhoc_module:
+            state.emit(f"ADHOC [{spec.adhoc_module}] {spec.adhoc_args}")
+            for h in hosts:
+                state.emit(f"{h} | SUCCESS => {{\"module\": \"{spec.adhoc_module}\"}}")
+                stats[h].ok += 1
+            self._finish(state, stats, failed=False)
+            return
+
+        plays = self._load_playbook(spec.playbook)
+        failed = False
+        for play in plays:
+            group = str(play.get("hosts", "all"))
+            play_hosts = inventory_host_names(spec.inventory, group) or (
+                hosts if group in ("all", "localhost") else []
+            )
+            state.emit(f"PLAY [{play.get('name', group)}] " + "*" * 40)
+            tasks: list[dict] = []
+            for role in play.get("roles", []):
+                role_name = role["role"] if isinstance(role, dict) else str(role)
+                tasks.extend(self._role_tasks(role_name))
+            tasks.extend(play.get("tasks", []) or [])
+            for task in tasks:
+                tname = str(task.get("name", "unnamed task"))
+                if self._when_excluded(task, spec.extra_vars):
+                    for h in play_hosts:
+                        stats[h].skipped += 1
+                    continue
+                state.emit(f"TASK [{tname}] " + "*" * 40)
+                if self.task_delay_s:
+                    time.sleep(self.task_delay_s)
+                for h in play_hosts:
+                    if fail_at and fail_at in tname:
+                        state.emit(f"fatal: [{h}]: FAILED! => simulated failure")
+                        stats[h].failed += 1
+                        failed = True
+                    else:
+                        state.emit(f"ok: [{h}]")
+                        stats[h].ok += 1
+                if failed:
+                    break
+            if failed:
+                break
+        self._finish(state, stats, failed)
+
+    @staticmethod
+    def _finish(state: _TaskState, stats: dict, failed: bool) -> None:
+        state.emit("PLAY RECAP " + "*" * 50)
+        for h, s in stats.items():
+            state.emit(
+                f"{h} : ok={s.ok} changed={s.changed} unreachable="
+                f"{s.unreachable} failed={s.failed} skipped={s.skipped}"
+            )
+        state.result.host_stats.update(stats)
+        if failed:
+            state.finish(TaskStatus.FAILED, rc=2, message="task failed")
+        else:
+            state.finish(TaskStatus.SUCCESS, rc=0)

@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import dataclasses
 from dataclasses import dataclass, field
-from typing import Any, Type, TypeVar
+from typing import Any, ClassVar, Type, TypeVar
 
 from kubeoperator_tpu.utils.ids import new_id, now_ts
 
@@ -26,7 +26,7 @@ class Entity:
     # Field names redacted by to_public_dict(); subclasses override. The API
     # layer must emit entities ONLY through to_public_dict so credentials,
     # kubeconfigs and password hashes never cross the HTTP boundary.
-    __secret_fields__: frozenset[str] = frozenset()
+    __secret_fields__: ClassVar[frozenset[str]] = frozenset()
 
     def touch(self) -> None:
         self.updated_at = now_ts()

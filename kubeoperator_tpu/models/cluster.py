@@ -149,6 +149,14 @@ class ClusterStatus:
         cond.message = message
         return cond
 
+    def reset_conditions(self, names: list[str]) -> None:
+        """Drop the named conditions (start of a fresh run of an operation
+        whose previous run completed — see ClusterAdm.run)."""
+        keep = [c for c in self.conditions if c.name not in set(names)]
+        self.conditions = keep
+        for i, c in enumerate(self.conditions):
+            c.order_index = i
+
     def first_unfinished(self) -> str | None:
         """Resume point: first condition that isn't OK (or None if all OK)."""
         for c in sorted(self.conditions, key=lambda c: c.order_index):

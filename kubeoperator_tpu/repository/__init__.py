@@ -1,0 +1,17 @@
+"""Persistence: SQLite + versioned migrations + per-entity repositories.
+
+Parity (SURVEY.md §2.1 rows 1d/1e): the reference persists through GORM over
+MySQL with SQL migration files applied at boot. We keep the shape — versioned
+migrations in `repository/migrations/*.sql`, one repository per entity — over
+SQLite (§7.1 allows SQLite-or-MySQL; SQLite keeps the framework dependency-
+free and air-gap friendly, matching the offline-first posture).
+
+Row layout: stable/query columns are real columns; the full entity document
+rides a JSON `data` column, so schema migrations are only needed when a
+*queried* field changes.
+"""
+
+from kubeoperator_tpu.repository.db import Database
+from kubeoperator_tpu.repository.repos import Repositories
+
+__all__ = ["Database", "Repositories"]

@@ -1,0 +1,114 @@
+"""SQLite database handle + boot-time migration runner.
+
+Reference parity: `migration/` SQL files applied at server boot
+[upstream — UNVERIFIED], SURVEY.md §2.1 row 1e. Applied versions are recorded
+in `schema_migrations`; files are applied in lexical order inside one
+transaction each, so a failed migration leaves the previous version intact.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sqlite3
+import threading
+from contextlib import contextmanager
+from typing import Iterator
+
+from kubeoperator_tpu.utils.logging import get_logger
+
+log = get_logger("repository")
+
+MIGRATIONS_DIR = os.path.join(os.path.dirname(__file__), "migrations")
+_MIGRATION_RE = re.compile(r"^(\d{3})_[\w-]+\.sql$")
+
+
+def _split_statements(script: str) -> list[str]:
+    """Split a SQL script into complete statements (';'-aware via
+    sqlite3.complete_statement, so literals containing ';' survive)."""
+    statements: list[str] = []
+    buf = ""
+    for line in script.splitlines():
+        stripped = line.strip()
+        if not buf and (not stripped or stripped.startswith("--")):
+            continue
+        buf += line + "\n"
+        if sqlite3.complete_statement(buf):
+            statements.append(buf.strip())
+            buf = ""
+    if buf.strip():
+        statements.append(buf.strip())
+    return statements
+
+
+class Database:
+    """Process-wide SQLite handle, safe for the server's mixed
+    event-loop + worker-thread usage (WAL + serialized access)."""
+
+    def __init__(self, path: str = "ko_tpu.db") -> None:
+        self.path = path
+        self._lock = threading.RLock()
+        self._conn = sqlite3.connect(
+            path, check_same_thread=False, isolation_level=None
+        )
+        self._conn.row_factory = sqlite3.Row
+        self._conn.execute("PRAGMA journal_mode=WAL")
+        self._conn.execute("PRAGMA foreign_keys=ON")
+        self.migrate()
+
+    @contextmanager
+    def tx(self) -> Iterator[sqlite3.Connection]:
+        """Serialized transaction scope."""
+        with self._lock:
+            self._conn.execute("BEGIN")
+            try:
+                yield self._conn
+            except BaseException:
+                self._conn.execute("ROLLBACK")
+                raise
+            self._conn.execute("COMMIT")
+
+    def query(self, sql: str, params: tuple = ()) -> list[sqlite3.Row]:
+        with self._lock:
+            return list(self._conn.execute(sql, params))
+
+    def execute(self, sql: str, params: tuple = ()) -> None:
+        with self.tx() as conn:
+            conn.execute(sql, params)
+
+    # ---- migrations ----
+    def applied_versions(self) -> set[str]:
+        with self._lock:
+            self._conn.execute(
+                "CREATE TABLE IF NOT EXISTS schema_migrations ("
+                " version TEXT PRIMARY KEY, applied_at REAL)"
+            )
+            rows = self._conn.execute("SELECT version FROM schema_migrations")
+            return {r["version"] for r in rows}
+
+    def migrate(self, migrations_dir: str = MIGRATIONS_DIR) -> list[str]:
+        applied = self.applied_versions()
+        ran: list[str] = []
+        for fname in sorted(os.listdir(migrations_dir)):
+            m = _MIGRATION_RE.match(fname)
+            if not m or m.group(1) in applied:
+                continue
+            with open(os.path.join(migrations_dir, fname), encoding="utf-8") as f:
+                script = f.read()
+            # Statement-by-statement inside one explicit tx: SQLite DDL is
+            # transactional, and executescript() would auto-COMMIT and break
+            # the all-or-nothing guarantee.
+            with self.tx() as conn:
+                for stmt in _split_statements(script):
+                    conn.execute(stmt)
+                conn.execute(
+                    "INSERT INTO schema_migrations VALUES (?, strftime('%s','now'))",
+                    (m.group(1),),
+                )
+            log.info("applied migration %s", fname)
+            ran.append(fname)
+        return ran
+
+    def close(self) -> None:
+        with self._lock:
+            self._conn.close()

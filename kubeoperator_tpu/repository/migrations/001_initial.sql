@@ -1,0 +1,145 @@
+-- 001: initial schema (reference parity: migration/ SQL at boot,
+-- SURVEY.md §2.1 row 1e; entity set per §2.2).
+-- Query columns are real; the full entity document lives in `data` (JSON).
+
+CREATE TABLE IF NOT EXISTS credentials (
+  id TEXT PRIMARY KEY,
+  name TEXT UNIQUE NOT NULL,
+  data TEXT NOT NULL,
+  created_at REAL, updated_at REAL
+);
+
+CREATE TABLE IF NOT EXISTS regions (
+  id TEXT PRIMARY KEY,
+  name TEXT UNIQUE NOT NULL,
+  provider TEXT NOT NULL,
+  data TEXT NOT NULL,
+  created_at REAL, updated_at REAL
+);
+
+CREATE TABLE IF NOT EXISTS zones (
+  id TEXT PRIMARY KEY,
+  name TEXT UNIQUE NOT NULL,
+  region_id TEXT NOT NULL REFERENCES regions(id),
+  data TEXT NOT NULL,
+  created_at REAL, updated_at REAL
+);
+
+CREATE TABLE IF NOT EXISTS plans (
+  id TEXT PRIMARY KEY,
+  name TEXT UNIQUE NOT NULL,
+  provider TEXT NOT NULL,
+  accelerator TEXT NOT NULL DEFAULT 'none',
+  data TEXT NOT NULL,
+  created_at REAL, updated_at REAL
+);
+
+CREATE TABLE IF NOT EXISTS hosts (
+  id TEXT PRIMARY KEY,
+  name TEXT UNIQUE NOT NULL,
+  ip TEXT NOT NULL,
+  cluster_id TEXT NOT NULL DEFAULT '',
+  status TEXT NOT NULL DEFAULT 'Pending',
+  data TEXT NOT NULL,
+  created_at REAL, updated_at REAL
+);
+
+CREATE TABLE IF NOT EXISTS clusters (
+  id TEXT PRIMARY KEY,
+  name TEXT UNIQUE NOT NULL,
+  project_id TEXT NOT NULL DEFAULT '',
+  phase TEXT NOT NULL DEFAULT 'Initializing',
+  data TEXT NOT NULL,
+  created_at REAL, updated_at REAL
+);
+
+CREATE TABLE IF NOT EXISTS nodes (
+  id TEXT PRIMARY KEY,
+  name TEXT NOT NULL,
+  cluster_id TEXT NOT NULL REFERENCES clusters(id),
+  host_id TEXT NOT NULL,
+  role TEXT NOT NULL,
+  status TEXT NOT NULL DEFAULT 'Pending',
+  data TEXT NOT NULL,
+  created_at REAL, updated_at REAL,
+  UNIQUE(cluster_id, name)
+);
+
+CREATE TABLE IF NOT EXISTS backup_accounts (
+  id TEXT PRIMARY KEY,
+  name TEXT UNIQUE NOT NULL,
+  data TEXT NOT NULL,
+  created_at REAL, updated_at REAL
+);
+
+CREATE TABLE IF NOT EXISTS backup_strategies (
+  id TEXT PRIMARY KEY,
+  cluster_id TEXT UNIQUE NOT NULL,
+  data TEXT NOT NULL,
+  created_at REAL, updated_at REAL
+);
+
+CREATE TABLE IF NOT EXISTS backup_files (
+  id TEXT PRIMARY KEY,
+  cluster_id TEXT NOT NULL,
+  name TEXT NOT NULL,
+  data TEXT NOT NULL,
+  created_at REAL, updated_at REAL
+);
+
+CREATE TABLE IF NOT EXISTS projects (
+  id TEXT PRIMARY KEY,
+  name TEXT UNIQUE NOT NULL,
+  data TEXT NOT NULL,
+  created_at REAL, updated_at REAL
+);
+
+CREATE TABLE IF NOT EXISTS project_members (
+  id TEXT PRIMARY KEY,
+  project_id TEXT NOT NULL REFERENCES projects(id),
+  user_id TEXT NOT NULL,
+  data TEXT NOT NULL,
+  created_at REAL, updated_at REAL,
+  UNIQUE(project_id, user_id)
+);
+
+CREATE TABLE IF NOT EXISTS users (
+  id TEXT PRIMARY KEY,
+  name TEXT UNIQUE NOT NULL,
+  data TEXT NOT NULL,
+  created_at REAL, updated_at REAL
+);
+
+CREATE TABLE IF NOT EXISTS events (
+  id TEXT PRIMARY KEY,
+  cluster_id TEXT NOT NULL,
+  data TEXT NOT NULL,
+  created_at REAL, updated_at REAL
+);
+CREATE INDEX IF NOT EXISTS idx_events_cluster ON events(cluster_id, created_at);
+
+CREATE TABLE IF NOT EXISTS messages (
+  id TEXT PRIMARY KEY,
+  user_id TEXT NOT NULL,
+  data TEXT NOT NULL,
+  created_at REAL, updated_at REAL
+);
+
+CREATE TABLE IF NOT EXISTS task_log_chunks (
+  id TEXT PRIMARY KEY,
+  cluster_id TEXT NOT NULL,
+  task_id TEXT NOT NULL,
+  seq INTEGER NOT NULL,
+  data TEXT NOT NULL,
+  created_at REAL, updated_at REAL
+);
+CREATE INDEX IF NOT EXISTS idx_logs_task ON task_log_chunks(task_id, seq);
+
+CREATE TABLE IF NOT EXISTS components (
+  id TEXT PRIMARY KEY,
+  cluster_id TEXT NOT NULL,
+  name TEXT NOT NULL,
+  data TEXT NOT NULL,
+  created_at REAL, updated_at REAL,
+  UNIQUE(cluster_id, name)
+);

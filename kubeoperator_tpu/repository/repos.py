@@ -1,0 +1,252 @@
+"""Per-entity repositories (reference parity: `pkg/repository`
+[upstream — UNVERIFIED], SURVEY.md §2.1 row 1d).
+
+A generic JSON-document repo supplies CRUD; subclasses pin the table, entity
+class, and which entity fields mirror into real query columns.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Generic, Iterable, Type, TypeVar
+
+from kubeoperator_tpu.models import (
+    BackupAccount,
+    BackupFile,
+    BackupStrategy,
+    Cluster,
+    ClusterComponent,
+    Credential,
+    Event,
+    Host,
+    Message,
+    Node,
+    Plan,
+    Project,
+    ProjectMember,
+    Region,
+    TaskLogChunk,
+    User,
+    Zone,
+)
+from kubeoperator_tpu.models.base import Entity
+from kubeoperator_tpu.repository.db import Database
+from kubeoperator_tpu.utils.errors import ConflictError, NotFoundError
+
+E = TypeVar("E", bound=Entity)
+
+
+class EntityRepo(Generic[E]):
+    table: str = ""
+    entity: Type[E] = Entity  # type: ignore[assignment]
+    # entity attribute -> column name mirrored for querying
+    columns: tuple[str, ...] = ("name",)
+
+    def __init__(self, db: Database) -> None:
+        self.db = db
+
+    # ---- CRUD ----
+    def save(self, obj: E) -> E:
+        obj.touch()
+        cols = ["id", *self.columns, "data", "created_at", "updated_at"]
+        vals = [
+            obj.id,
+            *[self._column_value(obj, c) for c in self.columns],
+            json.dumps(obj.to_dict()),
+            obj.created_at,
+            obj.updated_at,
+        ]
+        placeholders = ",".join("?" for _ in cols)
+        # Upsert keyed on id only: a UNIQUE(name) collision from a *different*
+        # entity must surface as ConflictError, not silently replace the row.
+        updates = ",".join(f"{c}=excluded.{c}" for c in cols if c != "id")
+        try:
+            self.db.execute(
+                f"INSERT INTO {self.table} ({','.join(cols)}) "
+                f"VALUES ({placeholders}) "
+                f"ON CONFLICT(id) DO UPDATE SET {updates}",
+                tuple(vals),
+            )
+        except Exception as e:  # sqlite3.IntegrityError (UNIQUE name, FK)
+            if "UNIQUE" in str(e):
+                raise ConflictError(kind=self.table, name=getattr(obj, "name", obj.id))
+            raise
+        return obj
+
+    def get(self, id: str) -> E:
+        rows = self.db.query(f"SELECT data FROM {self.table} WHERE id=?", (id,))
+        if not rows:
+            raise NotFoundError(kind=self.table, name=id)
+        return self._hydrate(rows[0]["data"])
+
+    def find(self, **where: object) -> list[E]:
+        """Query by mirrored columns only."""
+        clauses, params = [], []
+        for k, v in where.items():
+            if k not in self.columns and k != "id":
+                raise ValueError(f"{self.table}: column {k} is not queryable")
+            clauses.append(f"{k}=?")
+            params.append(v)
+        sql = f"SELECT data FROM {self.table}"
+        if clauses:
+            sql += " WHERE " + " AND ".join(clauses)
+        sql += " ORDER BY created_at"
+        return [self._hydrate(r["data"]) for r in self.db.query(sql, tuple(params))]
+
+    def get_by_name(self, name: str) -> E:
+        if "name" not in self.columns:
+            raise ValueError(f"{self.table} entities are not addressable by name")
+        rows = self.db.query(f"SELECT data FROM {self.table} WHERE name=?", (name,))
+        if not rows:
+            raise NotFoundError(kind=self.table, name=name)
+        return self._hydrate(rows[0]["data"])
+
+    def list(self) -> list[E]:
+        return self.find()
+
+    def delete(self, id: str) -> None:
+        self.get(id)  # NotFound if absent
+        self.db.execute(f"DELETE FROM {self.table} WHERE id=?", (id,))
+
+    def _column_value(self, obj: E, column: str) -> object:
+        return getattr(obj, column)
+
+    def _hydrate(self, blob: str) -> E:
+        return self.entity.from_dict(json.loads(blob))
+
+
+class CredentialRepo(EntityRepo[Credential]):
+    table, entity, columns = "credentials", Credential, ("name",)
+
+
+class RegionRepo(EntityRepo[Region]):
+    table, entity, columns = "regions", Region, ("name", "provider")
+
+
+class ZoneRepo(EntityRepo[Zone]):
+    table, entity, columns = "zones", Zone, ("name", "region_id")
+
+
+class PlanRepo(EntityRepo[Plan]):
+    table, entity, columns = "plans", Plan, ("name", "provider", "accelerator")
+
+
+class HostRepo(EntityRepo[Host]):
+    table, entity, columns = "hosts", Host, ("name", "ip", "cluster_id", "status")
+
+
+class ClusterRepo(EntityRepo[Cluster]):
+    table, entity, columns = "clusters", Cluster, ("name", "project_id", "phase")
+
+    def _column_value(self, obj: Cluster, column: str) -> object:
+        if column == "phase":  # mirror the nested status.phase for queries
+            return obj.status.phase
+        return super()._column_value(obj, column)
+
+
+class NodeRepo(EntityRepo[Node]):
+    table, entity, columns = "nodes", Node, ("name", "cluster_id", "host_id", "role", "status")
+
+
+class BackupAccountRepo(EntityRepo[BackupAccount]):
+    table, entity, columns = "backup_accounts", BackupAccount, ("name",)
+
+
+class BackupStrategyRepo(EntityRepo[BackupStrategy]):
+    table, entity, columns = "backup_strategies", BackupStrategy, ("cluster_id",)
+
+
+class BackupFileRepo(EntityRepo[BackupFile]):
+    table, entity, columns = "backup_files", BackupFile, ("cluster_id", "name")
+
+
+class ProjectRepo(EntityRepo[Project]):
+    table, entity, columns = "projects", Project, ("name",)
+
+
+class ProjectMemberRepo(EntityRepo[ProjectMember]):
+    table, entity, columns = "project_members", ProjectMember, ("project_id", "user_id")
+
+
+class UserRepo(EntityRepo[User]):
+    table, entity, columns = "users", User, ("name",)
+
+
+class EventRepo(EntityRepo[Event]):
+    table, entity, columns = "events", Event, ("cluster_id",)
+
+
+class MessageRepo(EntityRepo[Message]):
+    table, entity, columns = "messages", Message, ("user_id",)
+
+
+class TaskLogChunkRepo(EntityRepo[TaskLogChunk]):
+    table, entity, columns = "task_log_chunks", TaskLogChunk, ("cluster_id", "task_id", "seq")
+
+    def append(self, cluster_id: str, task_id: str, lines: Iterable[str]) -> None:
+        """Batch-insert a chunk of lines in ONE transaction; the seq base is
+        read inside the same tx so concurrent appenders can't collide."""
+        lines = list(lines)
+        if not lines:
+            return
+        with self.db.tx() as conn:
+            row = conn.execute(
+                "SELECT COALESCE(MAX(seq),-1)+1 AS n FROM task_log_chunks "
+                "WHERE task_id=?",
+                (task_id,),
+            ).fetchone()
+            start = int(row["n"])
+            chunks = [
+                TaskLogChunk(
+                    cluster_id=cluster_id, task_id=task_id, seq=start + i, line=line
+                )
+                for i, line in enumerate(lines)
+            ]
+            conn.executemany(
+                "INSERT INTO task_log_chunks "
+                "(id, cluster_id, task_id, seq, data, created_at, updated_at) "
+                "VALUES (?,?,?,?,?,?,?)",
+                [
+                    (
+                        c.id, c.cluster_id, c.task_id, c.seq,
+                        json.dumps(c.to_dict()), c.created_at, c.updated_at,
+                    )
+                    for c in chunks
+                ],
+            )
+
+    def tail(self, task_id: str, after_seq: int = -1) -> list[TaskLogChunk]:
+        rows = self.db.query(
+            "SELECT data FROM task_log_chunks WHERE task_id=? AND seq>? ORDER BY seq",
+            (task_id, after_seq),
+        )
+        return [self._hydrate(r["data"]) for r in rows]
+
+
+class ComponentRepo(EntityRepo[ClusterComponent]):
+    table, entity, columns = "components", ClusterComponent, ("cluster_id", "name")
+
+
+class Repositories:
+    """One bundle handed to every service (the reference injects repos into
+    services the same way, SURVEY.md §2.1 row 1b)."""
+
+    def __init__(self, db: Database) -> None:
+        self.db = db
+        self.credentials = CredentialRepo(db)
+        self.regions = RegionRepo(db)
+        self.zones = ZoneRepo(db)
+        self.plans = PlanRepo(db)
+        self.hosts = HostRepo(db)
+        self.clusters = ClusterRepo(db)
+        self.nodes = NodeRepo(db)
+        self.backup_accounts = BackupAccountRepo(db)
+        self.backup_strategies = BackupStrategyRepo(db)
+        self.backup_files = BackupFileRepo(db)
+        self.projects = ProjectRepo(db)
+        self.project_members = ProjectMemberRepo(db)
+        self.users = UserRepo(db)
+        self.events = EventRepo(db)
+        self.messages = MessageRepo(db)
+        self.task_logs = TaskLogChunkRepo(db)
+        self.components = ComponentRepo(db)

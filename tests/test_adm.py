@@ -1,0 +1,193 @@
+"""Phase engine: ordering, conditional phases, resume-at-failure, smoke
+gating (SURVEY.md §3.1 and §7 hard part (b))."""
+
+import pytest
+
+from kubeoperator_tpu.adm import ClusterAdm, AdmContext, create_phases, scale_up_phases
+from kubeoperator_tpu.adm.phases import SMOKE_MARKER
+from kubeoperator_tpu.executor import FakeExecutor
+from kubeoperator_tpu.models import Cluster, ClusterSpec, Credential, Host, Node, Plan
+from kubeoperator_tpu.utils.errors import PhaseError
+
+from tests.test_executor import make_fleet
+
+
+def make_ctx(tpu=False, **spec_kw) -> AdmContext:
+    spec = ClusterSpec(tpu_enabled=tpu, **spec_kw)
+    cluster = Cluster(name="demo", spec=spec)
+    nodes, hosts, creds = make_fleet(n_masters=1, n_workers=4 if tpu else 2,
+                                     tpu_chips=4 if tpu else 0)
+    plan = None
+    if tpu:
+        plan = Plan(name="tpu-v5e-16", provider="gcp_tpu_vm", region_id="r",
+                    accelerator="tpu", tpu_type="v5e-16", worker_count=0)
+    return AdmContext(cluster=cluster, nodes=nodes, hosts_by_id=hosts,
+                      credentials_by_id=creds, plan=plan)
+
+
+CPU_CREATE_ORDER = [
+    "01-base.yml", "02-runtime.yml", "05-etcd.yml", "06-lb.yml",
+    "07-kube-master.yml", "08-kube-worker.yml", "09-network.yml", "10-post.yml",
+]
+
+
+def test_cpu_create_runs_in_order_without_tpu_phases():
+    ex = FakeExecutor()
+    ctx = make_ctx(tpu=False)
+    ClusterAdm(ex).run(ctx, create_phases())
+    assert ex.playbooks_run() == CPU_CREATE_ORDER
+    names = [c.name for c in ctx.cluster.status.conditions]
+    assert "tpu-runtime" not in names and "tpu-smoke-test" not in names
+    assert all(c.status == "OK" for c in ctx.cluster.status.conditions)
+
+
+def test_external_lb_skips_lb_phase():
+    ex = FakeExecutor()
+    ctx = make_ctx(tpu=False, lb_mode="external", lb_endpoint="10.9.9.9:6443")
+    ClusterAdm(ex).run(ctx, create_phases())
+    assert "06-lb.yml" not in ex.playbooks_run()
+
+
+def test_failure_halts_and_resume_reenters_at_failed_phase():
+    ex = FakeExecutor()
+    ex.script("05-etcd.yml", fail_times=1)
+    ctx = make_ctx(tpu=False)
+    adm = ClusterAdm(ex)
+    with pytest.raises(PhaseError) as ei:
+        adm.run(ctx, create_phases())
+    assert ei.value.phase == "etcd"
+    assert ctx.cluster.status.first_unfinished() == "etcd"
+    # phases after the failure never ran
+    assert "07-kube-master.yml" not in ex.playbooks_run()
+
+    # resume: completed phases are skipped, re-enters at etcd
+    adm.run(ctx, create_phases())
+    runs = ex.playbooks_run()
+    assert runs.count("01-base.yml") == 1          # not re-run
+    assert runs.count("05-etcd.yml") == 2          # retried
+    assert ctx.cluster.status.first_unfinished() is None
+
+
+def test_tpu_create_gates_on_smoke_result():
+    ex = FakeExecutor()
+    ex.script("17-tpu-smoke-test.yml", lines=[
+        f'{SMOKE_MARKER} {{"gbps": 84.3, "chips": 16, "ok": true}}',
+    ])
+    ctx = make_ctx(tpu=True)
+    ClusterAdm(ex).run(ctx, create_phases())
+    st = ctx.cluster.status
+    assert st.smoke_passed and st.smoke_gbps == 84.3 and st.smoke_chips == 16
+    # TPU topology flowed into the vars contract
+    smoke_call = [c for c in ex.calls if c.playbook == "17-tpu-smoke-test.yml"][0]
+    assert smoke_call.extra_vars["tpu_slice_topology"] == "4x4"
+    assert smoke_call.extra_vars["tpu_chips_total"] == 16
+    assert smoke_call.extra_vars["tpu_runtime_version"] == "v2-alpha-tpuv5-lite"
+
+
+def test_smoke_chip_count_mismatch_fails_phase():
+    ex = FakeExecutor()
+    ex.script("17-tpu-smoke-test.yml", lines=[
+        f'{SMOKE_MARKER} {{"gbps": 80.0, "chips": 12}}',  # lost a host
+    ])
+    ctx = make_ctx(tpu=True)
+    with pytest.raises(PhaseError) as ei:
+        ClusterAdm(ex).run(ctx, create_phases())
+    assert "expected 16" in ei.value.message
+    assert not ctx.cluster.status.smoke_passed
+
+
+def test_smoke_threshold_gate():
+    ex = FakeExecutor()
+    ex.script("17-tpu-smoke-test.yml", lines=[
+        f'{SMOKE_MARKER} {{"gbps": 10.0, "chips": 16}}',
+    ])
+    ctx = make_ctx(tpu=True, smoke_test_gbps_threshold=50.0)
+    with pytest.raises(PhaseError) as ei:
+        ClusterAdm(ex).run(ctx, create_phases())
+    assert "below threshold" in ei.value.message
+
+
+def test_missing_smoke_marker_fails():
+    ex = FakeExecutor()  # default success but no marker line
+    ctx = make_ctx(tpu=True)
+    with pytest.raises(PhaseError):
+        ClusterAdm(ex).run(ctx, create_phases())
+
+
+def test_scale_up_limits_to_new_nodes():
+    ex = FakeExecutor()
+    ctx = make_ctx(tpu=False)
+    ctx.new_node_names = {"n2"}
+    ClusterAdm(ex).run(ctx, scale_up_phases())
+    assert all(c.limit == "new-workers" for c in ex.calls)
+    inv = ex.calls[0].inventory
+    assert list(inv["all"]["children"]["new-workers"]["hosts"]) == ["n2"]
+
+
+def test_repeated_operation_is_not_a_noop():
+    """A second scale-up (new node set) must run the phases again, not skip
+    them because the first run left OK conditions behind."""
+    ex = FakeExecutor()
+    ctx = make_ctx(tpu=False)
+    adm = ClusterAdm(ex)
+    ctx.new_node_names = {"n1"}
+    adm.run(ctx, scale_up_phases())
+    first_count = len(ex.calls)
+    ctx.new_node_names = {"n2"}
+    adm.run(ctx, scale_up_phases())
+    assert len(ex.calls) == 2 * first_count
+    inv = ex.calls[-1].inventory
+    assert list(inv["all"]["children"]["new-workers"]["hosts"]) == ["n2"]
+
+
+def test_malformed_smoke_payload_fails_cleanly():
+    ex = FakeExecutor()
+    ex.script("17-tpu-smoke-test.yml", lines=[
+        f'{SMOKE_MARKER} {{"gbps": "fast", "chips": 16}}',  # unparseable
+    ])
+    ctx = make_ctx(tpu=True)
+    with pytest.raises(PhaseError) as ei:
+        ClusterAdm(ex).run(ctx, create_phases())
+    assert "malformed" in ei.value.message
+    assert ctx.cluster.status.condition("tpu-smoke-test").status == "Failed"
+
+
+def test_posthook_crash_lands_condition_in_failed():
+    """A non-PhaseError post-hook exception must not leave Running behind."""
+    from kubeoperator_tpu.adm import Phase
+
+    def bad_post(ctx, result, lines):
+        raise RuntimeError("post hook bug")
+
+    ex = FakeExecutor()
+    ctx = make_ctx(tpu=False)
+    with pytest.raises(PhaseError) as ei:
+        ClusterAdm(ex).run(ctx, [Phase("custom", "01-base.yml", post=bad_post)])
+    assert "post hook bug" in ei.value.message
+    assert ctx.cluster.status.condition("custom").status == "Failed"
+
+
+def test_finished_task_eviction():
+    from kubeoperator_tpu.utils.errors import ExecutorError
+
+    ex = FakeExecutor()
+    ex._max_retained = 2
+    ids = []
+    for i in range(3):  # wait each so older tasks are evictable when the
+        tid = ex.run_playbook(f"p{i}.yml", {})  # registry overflows
+        ex.wait(tid)
+        ids.append(tid)
+    with pytest.raises(ExecutorError):
+        ex.result(ids[0])  # oldest finished task evicted
+    assert ex.result(ids[1]).ok and ex.result(ids[2]).ok
+
+
+def test_save_cluster_called_on_transitions():
+    saves = []
+    ex = FakeExecutor()
+    ctx = make_ctx(tpu=False)
+    ctx.save_cluster = lambda c: saves.append(c.status.conditions[0].status
+                                              if c.status.conditions else None)
+    ClusterAdm(ex).run(ctx, create_phases())
+    # at least pre-registration + 2 saves per phase (Running, OK)
+    assert len(saves) >= 1 + 2 * len(CPU_CREATE_ORDER)

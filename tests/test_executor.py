@@ -1,0 +1,171 @@
+"""Executor layer: streaming contract, fake scripting, simulation engine,
+dynamic inventory, gRPC runner service round-trip (SURVEY.md §2.1 row 3)."""
+
+import textwrap
+
+import pytest
+
+from kubeoperator_tpu.executor import (
+    FakeExecutor,
+    SimulationExecutor,
+    TaskSpec,
+    build_inventory,
+    make_executor,
+)
+from kubeoperator_tpu.executor.runner_service import RunnerClient, serve
+from kubeoperator_tpu.models import Credential, Host, Node
+from kubeoperator_tpu.utils.errors import ExecutorError
+
+
+def make_fleet(n_masters=1, n_workers=2, tpu_chips=0):
+    creds = Credential(name="ssh", username="ubuntu", password="pw")
+    nodes, hosts = [], {}
+    for i in range(n_masters + n_workers):
+        role = "master" if i < n_masters else "worker"
+        h = Host(
+            name=f"h{i}", ip=f"10.0.0.{i+1}", credential_id=creds.id,
+            tpu_chips=tpu_chips if role == "worker" else 0,
+            tpu_worker_id=(i - n_masters) if role == "worker" else -1,
+        )
+        hosts[h.id] = h
+        nodes.append(Node(name=f"n{i}", cluster_id="c1", host_id=h.id, role=role))
+    return nodes, hosts, {creds.id: creds}
+
+
+class TestInventory:
+    def test_groups_and_vars(self):
+        nodes, hosts, creds = make_fleet(n_masters=1, n_workers=4, tpu_chips=4)
+        inv = build_inventory(nodes, hosts, creds)
+        assert sorted(inv["all"]["hosts"]) == ["n0", "n1", "n2", "n3", "n4"]
+        ch = inv["all"]["children"]
+        assert list(ch["kube-master"]["hosts"]) == ["n0"]
+        assert list(ch["etcd"]["hosts"]) == ["n0"]
+        assert len(ch["kube-worker"]["hosts"]) == 4
+        assert len(ch["tpu-hosts"]["hosts"]) == 4
+        hv = inv["all"]["hosts"]["n1"]
+        assert hv["ansible_host"] == "10.0.0.2"
+        assert hv["ansible_user"] == "ubuntu"
+        assert hv["tpu_chips"] == 4 and hv["tpu_worker_id"] == 0
+
+
+class TestFake:
+    def test_stream_and_result(self):
+        ex = FakeExecutor()
+        nodes, hosts, creds = make_fleet()
+        inv = build_inventory(nodes, hosts, creds)
+        tid = ex.run_playbook("05-etcd.yml", inv, {"k8s_version": "v1.29.10"})
+        lines = list(ex.watch(tid))
+        assert any("PLAY [05-etcd.yml]" in l for l in lines)
+        assert ex.wait(tid).ok
+        assert ex.playbooks_run() == ["05-etcd.yml"]
+        assert ex.calls[0].extra_vars["k8s_version"] == "v1.29.10"
+
+    def test_fail_times_then_success(self):
+        ex = FakeExecutor()
+        ex.script("09-network.yml", fail_times=2)
+        inv = {}
+        assert not ex.wait(ex.run_playbook("09-network.yml", inv)).ok
+        assert not ex.wait(ex.run_playbook("09-network.yml", inv)).ok
+        assert ex.wait(ex.run_playbook("09-network.yml", inv)).ok
+
+    def test_spec_validation(self):
+        with pytest.raises(ExecutorError):
+            TaskSpec().validate()  # neither playbook nor adhoc
+        with pytest.raises(ExecutorError):
+            TaskSpec(playbook="x.yml", adhoc_module="ping").validate()
+
+
+@pytest.fixture()
+def project_dir(tmp_path):
+    (tmp_path / "playbooks").mkdir()
+    (tmp_path / "roles" / "etcd" / "tasks").mkdir(parents=True)
+    (tmp_path / "playbooks" / "05-etcd.yml").write_text(textwrap.dedent("""\
+        - name: deploy etcd
+          hosts: etcd
+          roles:
+            - etcd
+          tasks:
+            - name: verify etcd healthy
+            - name: tpu only step
+              when: tpu_enabled
+    """))
+    (tmp_path / "roles" / "etcd" / "tasks" / "main.yml").write_text(textwrap.dedent("""\
+        - name: install etcd binary
+        - name: render etcd systemd unit
+    """))
+    return str(tmp_path)
+
+
+class TestSimulation:
+    def test_runs_real_playbook_structure(self, project_dir):
+        ex = SimulationExecutor(project_dir=project_dir)
+        nodes, hosts, creds = make_fleet(n_masters=3, n_workers=0)
+        inv = build_inventory(nodes, hosts, creds)
+        tid = ex.run_playbook("05-etcd.yml", inv, {"tpu_enabled": False})
+        lines = list(ex.watch(tid))
+        res = ex.result(tid)
+        assert res.ok
+        assert any("install etcd binary" in l for l in lines)
+        # `when: tpu_enabled` false -> skipped for all three etcd hosts
+        assert res.host_stats["n0"].skipped == 1
+        assert res.host_stats["n0"].ok == 3  # 2 role tasks + 1 play task
+
+    def test_when_condition_true(self, project_dir):
+        ex = SimulationExecutor(project_dir=project_dir)
+        tid = ex.run_playbook(
+            "05-etcd.yml",
+            build_inventory(*make_fleet(3, 0)),
+            {"tpu_enabled": True},
+        )
+        res = ex.wait(tid)
+        assert res.host_stats["n0"].ok == 4 and res.host_stats["n0"].skipped == 0
+
+    def test_failure_injection_stops_play(self, project_dir):
+        ex = SimulationExecutor(project_dir=project_dir)
+        tid = ex.run_playbook(
+            "05-etcd.yml",
+            build_inventory(*make_fleet(3, 0)),
+            {"__fail_at_task__": "render etcd"},
+        )
+        res = ex.wait(tid)
+        assert not res.ok
+        assert res.host_stats["n0"].failed == 1
+        assert res.host_stats["n0"].ok == 1  # only the first task ran
+
+    def test_missing_playbook(self, project_dir):
+        ex = SimulationExecutor(project_dir=project_dir)
+        res = ex.wait(ex.run_playbook("nope.yml", {}))
+        assert not res.ok and "not found" in res.message
+
+    def test_adhoc(self, project_dir):
+        ex = SimulationExecutor(project_dir=project_dir)
+        tid = ex.run_adhoc("ping", "", build_inventory(*make_fleet(1, 1)))
+        assert ex.wait(tid).ok
+
+
+class TestRunnerService:
+    def test_grpc_round_trip(self, project_dir):
+        server = serve(SimulationExecutor(project_dir=project_dir), "127.0.0.1:18790")
+        try:
+            client = RunnerClient("127.0.0.1:18790")
+            inv = build_inventory(*make_fleet(1, 1))
+            tid = client.run(TaskSpec(playbook="05-etcd.yml", inventory=inv))
+            lines = list(client.watch(tid))
+            assert any("PLAY" in l for l in lines)
+            res = client.result(tid)
+            assert res.ok
+            assert res.host_stats["n0"].ok > 0
+        finally:
+            server.stop(0)
+
+
+def test_make_executor_auto_backend_selection(monkeypatch):
+    import kubeoperator_tpu.executor as exmod
+
+    monkeypatch.setattr(exmod, "ansible_available", lambda: False)
+    assert isinstance(make_executor("auto"), SimulationExecutor)
+    monkeypatch.setattr(exmod, "ansible_available", lambda: True)
+    from kubeoperator_tpu.executor import AnsibleExecutor
+    assert isinstance(make_executor("auto"), AnsibleExecutor)
+    with pytest.raises(ValueError):
+        make_executor("bogus")

@@ -1,0 +1,89 @@
+"""Repository layer: migrations, CRUD, query columns, uniqueness, log tail."""
+
+import pytest
+
+from kubeoperator_tpu.models import (
+    Cluster,
+    ClusterSpec,
+    Credential,
+    Host,
+    Plan,
+    Region,
+)
+from kubeoperator_tpu.models.cluster import ClusterPhaseStatus, ConditionStatus
+from kubeoperator_tpu.repository import Database, Repositories
+from kubeoperator_tpu.utils.errors import ConflictError, NotFoundError
+
+
+@pytest.fixture()
+def repos(tmp_db):
+    db = Database(tmp_db)
+    yield Repositories(db)
+    db.close()
+
+
+def test_migrations_apply_once(tmp_db):
+    db = Database(tmp_db)
+    assert db.migrate() == []  # second run is a no-op
+    assert "001" in db.applied_versions()
+    db.close()
+
+
+def test_crud_round_trip(repos):
+    p = Plan(name="tpu-v5e-16", provider="gcp_tpu_vm", region_id="r1",
+             accelerator="tpu", tpu_type="v5e-16", worker_count=0)
+    repos.plans.save(p)
+    got = repos.plans.get_by_name("tpu-v5e-16")
+    assert got.tpu_type == "v5e-16"
+    assert got.topology().total_hosts == 4
+
+    got.num_slices = 2
+    repos.plans.save(got)  # update via same id
+    assert repos.plans.get(p.id).num_slices == 2
+    assert len(repos.plans.list()) == 1
+
+    repos.plans.delete(p.id)
+    with pytest.raises(NotFoundError):
+        repos.plans.get(p.id)
+
+
+def test_unique_name_conflict(repos):
+    repos.regions.save(Region(name="gcp-us", provider="gcp_tpu_vm"))
+    with pytest.raises(ConflictError):
+        repos.regions.save(Region(name="gcp-us", provider="gcp_tpu_vm"))
+
+
+def test_query_columns(repos):
+    repos.hosts.save(Host(name="h1", ip="10.0.0.1", cluster_id="c1"))
+    repos.hosts.save(Host(name="h2", ip="10.0.0.2", cluster_id="c1"))
+    repos.hosts.save(Host(name="h3", ip="10.0.0.3", cluster_id="c2"))
+    assert len(repos.hosts.find(cluster_id="c1")) == 2
+    with pytest.raises(ValueError):
+        repos.hosts.find(bogus="x")
+
+
+def test_cluster_phase_mirrored(repos):
+    c = Cluster(name="demo", spec=ClusterSpec())
+    c.status.phase = ClusterPhaseStatus.READY.value
+    repos.clusters.save(c)
+    assert [x.name for x in repos.clusters.find(phase="Ready")] == ["demo"]
+    # nested conditions survive the round trip
+    c.status.upsert_condition("base", ConditionStatus.OK)
+    repos.clusters.save(c)
+    assert repos.clusters.get(c.id).status.conditions[0].status == "OK"
+
+
+def test_task_log_append_tail(repos):
+    repos.task_logs.append("c1", "t1", ["line one", "line two"])
+    repos.task_logs.append("c1", "t1", ["line three"])
+    chunks = repos.task_logs.tail("t1")
+    assert [c.line for c in chunks] == ["line one", "line two", "line three"]
+    assert [c.seq for c in chunks] == [0, 1, 2]
+    assert [c.line for c in repos.task_logs.tail("t1", after_seq=1)] == ["line three"]
+
+
+def test_secret_round_trip_persists_but_redacts(repos):
+    repos.credentials.save(Credential(name="ssh", password="pw"))
+    got = repos.credentials.get_by_name("ssh")
+    assert got.password == "pw"                      # persistence keeps it
+    assert "password" not in got.to_public_dict()    # API shape drops it
