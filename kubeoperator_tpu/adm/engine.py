@@ -74,6 +74,9 @@ class AdmContext:
             "metrics_server_enabled": spec.metrics_server_enabled,
             "tpu_enabled": spec.tpu_enabled,
             "jobset_enabled": spec.jobset_enabled,
+            # real executors must see an explicit False so `when: ko_simulation`
+            # guards never hit an undefined var; SimulationExecutor overrides.
+            "ko_simulation": False,
         }
         if self.plan is not None and self.plan.has_tpu():
             topo = self.plan.topology()

@@ -16,7 +16,12 @@ from __future__ import annotations
 import os
 import time
 
+import jinja2
 import yaml
+
+
+def _jinja_env() -> "jinja2.Environment":
+    return jinja2.Environment(undefined=jinja2.ChainableUndefined)
 
 from kubeoperator_tpu.executor.base import (
     Executor,
@@ -61,29 +66,61 @@ class SimulationExecutor(Executor):
         return [t if isinstance(t, dict) else {"name": str(t)} for t in tasks]
 
     @staticmethod
-    def _when_excluded(task: dict, extra_vars: dict) -> bool:
-        """Honor the subset of `when:` used by our content: bare var names
-        and 'var' / 'not var' checks against extra-vars truthiness."""
+    def _render_debug(task: dict, context: dict) -> str | None:
+        """Render an `ansible.builtin.debug: msg=...` task's message with the
+        vars context (jinja2). This is how content communicates results to the
+        platform in simulation mode (e.g. the smoke-test marker line) while
+        remaining valid real-ansible content."""
+        module = task.get("ansible.builtin.debug") or task.get("debug")
+        if not isinstance(module, dict) or "msg" not in module:
+            return None
+        try:
+            return _jinja_env().from_string(str(module["msg"])).render(**context)
+        except jinja2.TemplateError:
+            return str(module["msg"])
+
+    @staticmethod
+    def _when_excluded(task: dict, context: dict) -> bool:
+        """Evaluate `when:` as a real jinja2 expression against the host's
+        vars context (extra-vars + inventory_hostname/groups/hostvars), so
+        comparisons like `container_runtime == "containerd"` and
+        `inventory_hostname == groups['kube-master'][0]` behave as on real
+        ansible. Vars the simulation can't know (e.g. registered results)
+        are ChainableUndefined -> falsy, which is what `when: not
+        ko_simulation` guards rely on."""
         cond = task.get("when")
         if cond is None:
             return False
         conds = cond if isinstance(cond, list) else [cond]
-        for c in conds:
-            text = str(c).strip()
-            negate = text.startswith("not ")
-            var = text[4:].strip() if negate else text
-            val = bool(extra_vars.get(var))
-            if negate:
-                val = not val
-            if not val:
-                return True
-        return False
+        expr = " and ".join(f"({c})" for c in conds)
+        try:
+            rendered = _jinja_env().from_string(
+                "{% if " + expr + " %}1{% endif %}"
+            ).render(**context)
+        except jinja2.TemplateError:
+            return False  # unparseable condition: run the task (visible) rather
+            # than silently skipping simulated coverage
+        return rendered != "1"
 
     # ---- execution ----
+    @staticmethod
+    def _inventory_context(inventory: dict) -> dict:
+        """groups/hostvars as ansible exposes them to templating."""
+        groups = {"all": sorted(inventory.get("all", {}).get("hosts", {}))}
+        for gname, g in inventory.get("all", {}).get("children", {}).items():
+            groups[gname] = sorted(g.get("hosts", {}))
+        hostvars = dict(inventory.get("all", {}).get("hosts", {}))
+        return {"groups": groups, "hostvars": hostvars}
+
     def _execute(self, spec: TaskSpec, state: _TaskState) -> None:
         hosts = inventory_host_names(spec.inventory) or ["localhost"]
         stats = {h: HostStats() for h in hosts}
-        fail_at = str(spec.extra_vars.get("__fail_at_task__", ""))
+        extra_vars = {**spec.extra_vars, "ko_simulation": True}
+        base_ctx = {**extra_vars, **self._inventory_context(spec.inventory)}
+        fail_at = str(extra_vars.get("__fail_at_task__", ""))
+        limit = set(
+            inventory_host_names(spec.inventory, spec.limit)
+        ) if spec.limit else None
 
         if spec.adhoc_module:
             state.emit(f"ADHOC [{spec.adhoc_module}] {spec.adhoc_args}")
@@ -100,6 +137,10 @@ class SimulationExecutor(Executor):
             play_hosts = inventory_host_names(spec.inventory, group) or (
                 hosts if group in ("all", "localhost") else []
             )
+            if limit is not None:
+                play_hosts = [h for h in play_hosts if h in limit]
+            if not play_hosts:
+                continue
             state.emit(f"PLAY [{play.get('name', group)}] " + "*" * 40)
             tasks: list[dict] = []
             for role in play.get("roles", []):
@@ -108,14 +149,32 @@ class SimulationExecutor(Executor):
             tasks.extend(play.get("tasks", []) or [])
             for task in tasks:
                 tname = str(task.get("name", "unnamed task"))
-                if self._when_excluded(task, spec.extra_vars):
-                    for h in play_hosts:
+                host_ctxs = {
+                    h: {
+                        **base_ctx,
+                        **base_ctx["hostvars"].get(h, {}),
+                        "inventory_hostname": h,
+                    }
+                    for h in play_hosts
+                }
+                active = [
+                    h for h in play_hosts
+                    if not self._when_excluded(task, host_ctxs[h])
+                ]
+                for h in play_hosts:
+                    if h not in active:
                         stats[h].skipped += 1
+                if not active:
                     continue
+                if task.get("run_once"):
+                    active = active[:1]
                 state.emit(f"TASK [{tname}] " + "*" * 40)
                 if self.task_delay_s:
                     time.sleep(self.task_delay_s)
-                for h in play_hosts:
+                debug_msg = self._render_debug(task, host_ctxs[active[0]])
+                if debug_msg is not None:
+                    state.emit(debug_msg)
+                for h in active:
                     if fail_at and fail_at in tname:
                         state.emit(f"fatal: [{h}]: FAILED! => simulated failure")
                         stats[h].failed += 1

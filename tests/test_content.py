@@ -1,0 +1,127 @@
+"""Content layer: playbook/role integrity, the full simulated TPU create on
+REAL bundled content, and the transitive "no GPU package" guarantee
+[BASELINE; SURVEY.md §7 hard part (d)]."""
+
+import os
+
+import pytest
+import yaml
+
+from kubeoperator_tpu.adm import ClusterAdm, AdmContext, create_phases
+from kubeoperator_tpu.adm import (
+    backup_phases,
+    reset_phases,
+    restore_phases,
+    scale_down_phases,
+    scale_up_phases,
+    upgrade_phases,
+)
+from kubeoperator_tpu.executor import SimulationExecutor
+from kubeoperator_tpu.executor.simulation import DEFAULT_PROJECT_DIR
+from kubeoperator_tpu.models import Cluster, ClusterSpec, Plan
+
+from tests.test_executor import make_fleet
+
+CONTENT = DEFAULT_PROJECT_DIR
+PLAYBOOKS = os.path.join(CONTENT, "playbooks")
+ROLES = os.path.join(CONTENT, "roles")
+
+
+def all_playbooks():
+    return sorted(f for f in os.listdir(PLAYBOOKS) if f.endswith(".yml"))
+
+
+def test_all_playbooks_parse_and_reference_existing_roles():
+    assert all_playbooks(), "content/playbooks is empty"
+    for pb in all_playbooks():
+        with open(os.path.join(PLAYBOOKS, pb)) as f:
+            plays = yaml.safe_load(f)
+        assert isinstance(plays, list), f"{pb} must be a list of plays"
+        for play in plays:
+            assert "hosts" in play, f"{pb}: play missing hosts"
+            for role in play.get("roles", []):
+                rname = role["role"] if isinstance(role, dict) else role
+                path = os.path.join(ROLES, rname, "tasks", "main.yml")
+                assert os.path.exists(path), f"{pb} references missing role {rname}"
+
+
+def test_all_role_task_files_parse():
+    for role in sorted(d for d in os.listdir(ROLES) if not d.startswith(".")):
+        path = os.path.join(ROLES, role, "tasks", "main.yml")
+        with open(path) as f:
+            tasks = yaml.safe_load(f)
+        assert isinstance(tasks, list) and tasks, f"role {role} has no tasks"
+        for t in tasks:
+            assert "name" in t, f"role {role}: unnamed task {t}"
+
+
+def test_every_phase_playbook_exists():
+    phase_lists = [
+        create_phases(), upgrade_phases(), scale_up_phases(),
+        scale_down_phases(), backup_phases(), restore_phases(), reset_phases(),
+    ]
+    for phases in phase_lists:
+        for p in phases:
+            assert os.path.exists(os.path.join(PLAYBOOKS, p.playbook)), (
+                f"phase {p.name} references missing playbook {p.playbook}"
+            )
+
+
+def test_no_gpu_package_anywhere_in_content():
+    """BASELINE: 'no GPU package in the build' — transitively enforced over
+    every content/manifest/template file."""
+    forbidden = ("nvidia", "cuda", "nccl", "gpu-operator", "dcgm")
+    hits = []
+    for root, _, files in os.walk(CONTENT):
+        for fname in files:
+            path = os.path.join(root, fname)
+            with open(path, encoding="utf-8", errors="ignore") as f:
+                # comment lines may *mention* the replaced GPU path; no
+                # functional line (package, image, command, var) may.
+                text = "\n".join(
+                    l for l in f.read().lower().splitlines()
+                    if not l.strip().startswith("#")
+                )
+            for token in forbidden:
+                if token in text:
+                    hits.append(f"{path}: {token}")
+    assert not hits, f"GPU artifacts found in content: {hits}"
+
+
+def tpu_ctx(sim_gbps=85.0):
+    spec = ClusterSpec(tpu_enabled=True, jobset_enabled=False)
+    cluster = Cluster(name="tpu-demo", spec=spec)
+    nodes, hosts, creds = make_fleet(n_masters=1, n_workers=4, tpu_chips=4)
+    plan = Plan(name="tpu-v5e-16", provider="gcp_tpu_vm", region_id="r",
+                accelerator="tpu", tpu_type="v5e-16", worker_count=0)
+    return AdmContext(
+        cluster=cluster, nodes=nodes, hosts_by_id=hosts,
+        credentials_by_id=creds, plan=plan,
+        extra_vars={"sim_smoke_gbps": sim_gbps},
+    )
+
+
+def test_full_tpu_create_on_real_content_simulated():
+    """The north-star pipeline over the real bundled playbooks: all create
+    phases incl. tpu-runtime and the smoke gate complete, and the smoke
+    result parsed from the real role's debug task lands in cluster status."""
+    ex = SimulationExecutor()  # bundled content dir
+    ctx = tpu_ctx(sim_gbps=85.0)
+    ClusterAdm(ex).run(ctx, create_phases())
+    st = ctx.cluster.status
+    assert st.first_unfinished() is None
+    assert st.smoke_passed and st.smoke_chips == 16
+    assert st.smoke_gbps == pytest.approx(85.0)
+    names = [c.name for c in st.conditions]
+    assert names.index("tpu-runtime") < names.index("tpu-smoke-test")
+
+
+def test_simulated_smoke_threshold_fails_cluster():
+    from kubeoperator_tpu.utils.errors import PhaseError
+
+    ex = SimulationExecutor()
+    ctx = tpu_ctx(sim_gbps=3.0)
+    ctx.cluster.spec.smoke_test_gbps_threshold = 50.0
+    with pytest.raises(PhaseError):
+        ClusterAdm(ex).run(ctx, create_phases())
+    assert not ctx.cluster.status.smoke_passed

@@ -143,6 +143,52 @@ class TestSimulation:
         assert ex.wait(tid).ok
 
 
+class TestSimulationWhenEval:
+    @pytest.fixture()
+    def cmp_project(self, tmp_path):
+        (tmp_path / "playbooks").mkdir()
+        (tmp_path / "playbooks" / "run.yml").write_text(textwrap.dedent("""\
+            - name: runtime play
+              hosts: all
+              tasks:
+                - name: containerd task
+                  when: container_runtime == "containerd"
+                - name: docker task
+                  when: container_runtime == "docker"
+                - name: bootstrap only
+                  when: inventory_hostname == groups['kube-master'][0]
+                - name: once for everyone
+                  run_once: true
+        """))
+        return str(tmp_path)
+
+    def test_comparison_and_group_index_conditions(self, cmp_project):
+        ex = SimulationExecutor(project_dir=cmp_project)
+        inv = build_inventory(*make_fleet(n_masters=1, n_workers=1))
+        res = ex.wait(ex.run_playbook("run.yml", inv,
+                                      {"container_runtime": "containerd"}))
+        assert res.ok
+        master, worker = res.host_stats["n0"], res.host_stats["n1"]
+        # containerd task ran, docker skipped, bootstrap only on master,
+        # run_once counted exactly once (on the first host)
+        assert master.ok == 3 and master.skipped == 1
+        # worker: containerd ok; docker + bootstrap skipped; run_once executed
+        # on the first host only and (like ansible) doesn't mark others skipped
+        assert worker.ok == 1 and worker.skipped == 2
+
+    def test_limit_restricts_hosts(self, cmp_project):
+        ex = SimulationExecutor(project_dir=cmp_project)
+        nodes, hosts, creds = make_fleet(n_masters=1, n_workers=2)
+        inv = build_inventory(nodes, hosts, creds, new_node_names={"n2"})
+        res = ex.wait(ex.run(TaskSpec(
+            playbook="run.yml", inventory=inv,
+            extra_vars={"container_runtime": "containerd"},
+            limit="new-workers",
+        )))
+        assert res.host_stats["n2"].ok > 0
+        assert res.host_stats["n0"].ok == 0 and res.host_stats["n1"].ok == 0
+
+
 class TestRunnerService:
     def test_grpc_round_trip(self, project_dir):
         server = serve(SimulationExecutor(project_dir=project_dir), "127.0.0.1:18790")
