@@ -1,0 +1,92 @@
+#!/usr/bin/env python3
+"""Driver benchmark entry — prints ONE JSON line.
+
+Metric selection (BASELINE.md: the reference publishes no numbers; baselines
+are datasheet-derived envelopes, so vs_baseline = measured/envelope):
+
+  >= 2 visible TPU devices : psum all-reduce bus bandwidth (BASELINE metric 2,
+                             the NCCL-tests-replacement headline) vs the ICI
+                             bidirectional-ring envelope.
+  1 visible device         : single-chip MXU sustained bf16 TFLOP/s vs the
+                             generation datasheet — the densest health signal
+                             one chip can give (ICI is unexercisable).
+
+Timing is differential with scalar readback (ops/timing.py) so relay RTT on
+tunneled TPUs cannot inflate results. Extra context rides in "details".
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+
+def _generation_for_device(dev) -> str:
+    kind = getattr(dev, "device_kind", "").lower()
+    if "v5 lite" in kind or "v5e" in kind or "v5litepod" in kind:
+        return "v5e"
+    if "v5p" in kind or "v5" in kind:
+        return "v5p"
+    if "v6" in kind or "trillium" in kind:
+        return "v6e"
+    if "v4" in kind:
+        return "v4"
+    return "v5e"
+
+
+def main() -> int:
+    import jax
+
+    from kubeoperator_tpu.ops.collectives import (
+        bench_collective,
+        verify_psum_correctness,
+    )
+    from kubeoperator_tpu.ops.hbm import hbm_bandwidth_gbps
+    from kubeoperator_tpu.ops.matmul import mxu_matmul_tflops
+    from kubeoperator_tpu.parallel.mesh import flat_axis_mesh
+    from kubeoperator_tpu.parallel.topology import GENERATIONS
+
+    devices = jax.devices()
+    n = len(devices)
+    gen = GENERATIONS[_generation_for_device(devices[0])]
+    details: dict = {
+        "devices": n,
+        "device_kind": getattr(devices[0], "device_kind", str(devices[0])),
+        "generation": gen.name,
+    }
+
+    if n >= 2:
+        mesh = flat_axis_mesh()
+        details["psum_correct"] = verify_psum_correctness(mesh)
+        best = None
+        for size in (8.0, 32.0, 64.0):
+            r = bench_collective("psum", size_mb=size, mesh=mesh, iters=16)
+            details[f"psum_busbw_{int(size)}mb"] = round(r.busbw_gbps, 2)
+            if best is None or r.busbw_gbps > best:
+                best = r.busbw_gbps
+        envelope = 2.0 * gen.ici_gbps_per_link
+        result = {
+            "metric": "psum_allreduce_busbw_gbps",
+            "value": round(best, 2),
+            "unit": "GB/s",
+            "vs_baseline": round(best / envelope, 3),
+        }
+    else:
+        m = mxu_matmul_tflops(size=2048, iters=400)
+        details["mxu_tflops_2048"] = round(m.tflops, 1)
+        h = hbm_bandwidth_gbps(size_mb=256, iters=50)
+        details["hbm_triad_gbps"] = round(h.gbps, 1)
+        result = {
+            "metric": f"{gen.name}_single_chip_mxu_bf16_tflops",
+            "value": round(m.tflops, 1),
+            "unit": "TFLOP/s",
+            "vs_baseline": round(m.tflops / gen.bf16_tflops_per_chip, 3),
+        }
+
+    result["details"] = details
+    print(json.dumps(result), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,34 @@
+"""TPU validation workloads — the NCCL-tests replacement family
+(SURVEY.md §2.1 row 4a / §5.8).
+
+Where the reference's GPU role deployed the prebuilt NCCL-tests CUDA binary
+as its validation workload, this package ships pure-JAX/XLA workloads that
+exercise the same hardware axes TPU-natively:
+
+  collectives.py  ICI/DCN collective bus-bandwidth (psum, all_gather,
+                  reduce_scatter, ppermute, all_to_all) over an explicit
+                  jax.sharding.Mesh via shard_map
+  matmul.py       MXU sustained bf16 throughput (systolic-array health)
+  hbm.py          HBM stream bandwidth (pallas triad kernel)
+  psum_smoke.py   the cluster smoke test: correctness + psum bus-bandwidth
+                  across the full slice, emitting KO_TPU_SMOKE_RESULT
+
+Everything here runs on CPU meshes for CI (virtual devices) and on real TPU
+for the metric runs; no NCCL/MPI anywhere [BASELINE].
+"""
+
+from kubeoperator_tpu.ops.collectives import (
+    CollectiveResult,
+    bench_collective,
+    run_collective_suite,
+)
+from kubeoperator_tpu.ops.matmul import mxu_matmul_tflops
+from kubeoperator_tpu.ops.hbm import hbm_bandwidth_gbps
+
+__all__ = [
+    "CollectiveResult",
+    "bench_collective",
+    "run_collective_suite",
+    "mxu_matmul_tflops",
+    "hbm_bandwidth_gbps",
+]

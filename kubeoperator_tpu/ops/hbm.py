@@ -1,0 +1,87 @@
+"""HBM stream bandwidth via a pallas triad kernel.
+
+Single-chip memory-health probe (o = a + s*b streams 3 buffers through HBM;
+STREAM-triad convention). The kernel is a real pallas TPU kernel — VMEM
+blocks aligned to the (8,128) f32 tile, 1-D grid over row blocks — with
+`interpret=True` on CPU so CI exercises the same code path
+(/opt/skills/guides/pallas_guide.md patterns).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from kubeoperator_tpu.ops.timing import differential_time_per_iter
+
+BLOCK_ROWS = 256
+COLS = 1024  # lane-aligned (multiple of 128)
+
+
+def _triad_kernel(a_ref, b_ref, o_ref):
+    o_ref[...] = a_ref[...] + 2.5 * b_ref[...]
+
+
+@dataclass(frozen=True)
+class HbmResult:
+    bytes_streamed: int
+    time_s: float
+    gbps: float
+
+    def to_dict(self) -> dict:
+        return dict(self.__dict__)
+
+
+def _triad(x, y, interpret: bool):
+    rows = x.shape[0]
+    return pl.pallas_call(
+        _triad_kernel,
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        grid=(rows // BLOCK_ROWS,),
+        in_specs=[
+            pl.BlockSpec((BLOCK_ROWS, COLS), lambda i: (i, 0)),
+            pl.BlockSpec((BLOCK_ROWS, COLS), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((BLOCK_ROWS, COLS), lambda i: (i, 0)),
+        interpret=interpret,
+    )(x, y)
+
+
+def hbm_bandwidth_gbps(
+    size_mb: float = 256.0, iters: int = 10, device: jax.Device | None = None
+) -> HbmResult:
+    """Sustained triad bandwidth; on CPU a tiny interpreted run (CI only)."""
+    device = device or jax.devices()[0]
+    interpret = device.platform != "tpu"
+    if interpret:
+        size_mb = min(size_mb, 2.0)  # interpreter is slow; keep CI fast
+        iters = min(iters, 2)
+    elem = 4
+    rows = max(int(size_mb * 1e6) // (COLS * elem) // BLOCK_ROWS, 1) * BLOCK_ROWS
+    x = jax.device_put(jnp.ones((rows, COLS), jnp.float32), device)
+    y = jax.device_put(jnp.ones((rows, COLS), jnp.float32), device)
+
+    from functools import partial
+
+    @partial(jax.jit, static_argnums=(2,))
+    def chain(a, b, n):
+        def step(_, v):
+            # scale keeps values bounded; the multiply rides the same stream
+            return _triad(v, b, interpret) * 0.5
+        out = jax.lax.fori_loop(0, n, step, a)
+        return out.sum()  # scalar readback (ops/timing.py rationale)
+
+    def run(n: int) -> float:
+        return float(chain(x, y, n))
+
+    dt = differential_time_per_iter(
+        run, lo=max(iters // 8, 1), hi=max(iters, iters // 8 + 2)
+    )
+    bytes_streamed = 3 * rows * COLS * elem  # read a, read b, write o
+    return HbmResult(
+        bytes_streamed=bytes_streamed, time_s=dt,
+        gbps=bytes_streamed / dt / 1e9,
+    )

@@ -1,0 +1,68 @@
+"""MXU sustained-throughput check.
+
+Per-chip systolic-array health probe: chained bf16 matmuls sized to the MXU
+(multiples of 128x128, bf16 native input dtype), iterated inside one jit'd
+`lax.fori_loop` so only device time is measured. The result is compared
+against the generation's datasheet bf16 TFLOP/s to flag degraded chips —
+the TPU analog of the per-GPU compute check NCCL-tests runs implicitly.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from kubeoperator_tpu.ops.timing import differential_time_per_iter
+
+
+@dataclass(frozen=True)
+class MatmulResult:
+    size: int
+    dtype: str
+    iters: int
+    time_s: float
+    tflops: float
+
+    def to_dict(self) -> dict:
+        return dict(self.__dict__)
+
+
+def mxu_matmul_tflops(
+    size: int = 4096,
+    iters: int = 30,
+    dtype=jnp.bfloat16,
+    device: jax.Device | None = None,
+) -> MatmulResult:
+    """Sustained TFLOP/s of `iters` chained [size,size] matmuls on one device."""
+    device = device or jax.devices()[0]
+    key = jax.random.PRNGKey(0)
+    a = jax.device_put(
+        jax.random.normal(key, (size, size), jnp.float32).astype(dtype), device
+    )
+    w = jax.device_put(
+        jax.random.normal(key, (size, size), jnp.float32).astype(dtype), device
+    )
+
+    @partial(jax.jit, static_argnums=(2,))
+    def chain(x, w, n):
+        def step(_, v):
+            # normalize cheaply to keep values finite; fuses into the matmul
+            y = jnp.dot(v, w, preferred_element_type=jnp.float32)
+            return (y * (1.0 / size)).astype(dtype)
+        out = jax.lax.fori_loop(0, n, step, x)
+        return out.astype(jnp.float32).sum()  # scalar readback proves completion
+
+    def run(n: int) -> float:
+        return float(chain(a, w, n))  # float() forces host fetch
+
+    dt = differential_time_per_iter(
+        run, lo=max(iters // 8, 1), hi=max(iters, iters // 8 + 2)
+    )
+    flops = 2.0 * size * size * size
+    return MatmulResult(
+        size=size, dtype=jnp.dtype(dtype).name, iters=iters, time_s=dt,
+        tflops=flops / dt / 1e12,
+    )

@@ -1,0 +1,75 @@
+"""The cluster smoke test — gates Ready on TPU plans (BASELINE metrics).
+
+Runs on every host of the slice (one process per host, launched by the
+tpu-smoke-test role's Job/JobSet): bootstrap `jax.distributed` from the env
+contract, verify the expected chip count is visible, check psum correctness,
+sweep psum bus-bandwidth, and emit the one-line machine-readable result the
+adm post-hook parses:
+
+    KO_TPU_SMOKE_RESULT {"gbps": ..., "chips": ..., "ok": true, ...}
+
+Exit code 0 only if correctness holds and the chip count matches — bandwidth
+thresholds are enforced server-side (ClusterSpec.smoke_test_gbps_threshold)
+so policy changes don't need an image rebuild.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+from kubeoperator_tpu.parallel.multislice import initialize_from_env
+
+
+def run_smoke(
+    sizes_mb: tuple[float, ...] = (1.0, 8.0, 32.0, 64.0),
+    iters: int = 10,
+) -> dict:
+    import jax
+
+    from kubeoperator_tpu.ops.collectives import (
+        bench_collective,
+        verify_psum_correctness,
+    )
+    from kubeoperator_tpu.parallel.mesh import flat_axis_mesh
+
+    chips = jax.device_count()
+    expected = int(os.environ.get("KO_TPU_EXPECTED_CHIPS", "0"))
+    mesh = flat_axis_mesh()
+    ok = verify_psum_correctness(mesh)
+
+    best = 0.0
+    table = []
+    for size in sizes_mb:
+        r = bench_collective("psum", size_mb=size, mesh=mesh, iters=iters)
+        table.append({"size_mb": size, "busbw_gbps": round(r.busbw_gbps, 3)})
+        best = max(best, r.busbw_gbps)
+
+    result = {
+        "gbps": round(best, 3),
+        "chips": chips,
+        "ok": bool(ok) and (expected == 0 or chips == expected),
+        "correctness": bool(ok),
+        "expected_chips": expected,
+        "process_index": jax.process_index(),
+        "num_processes": jax.process_count(),
+        "table": table,
+    }
+    return result
+
+
+def main() -> int:
+    initialize_from_env()
+    import jax
+
+    result = run_smoke()
+    # every process validates; only process 0 speaks (its pod's logs are what
+    # the tpu-smoke-test role collects)
+    if jax.process_index() == 0:
+        print("KO_TPU_SMOKE_RESULT " + json.dumps(result), flush=True)
+    return 0 if result["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

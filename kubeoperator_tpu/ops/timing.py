@@ -1,0 +1,47 @@
+"""Honest device timing over asymmetric transports.
+
+Two realities this framework measures under:
+  * real TPU behind a relay/tunnel: dispatch+readback RTT can dwarf device
+    time, and `block_until_ready` may complete before remote execution does —
+    only a host readback proves completion;
+  * CI CPU meshes: RTT ~ 0, classic timing works.
+
+The one method correct in both: reduce the result to a scalar ON DEVICE
+(4-byte readback), and time the same computation at two iteration counts —
+the RTT cancels in the difference:
+
+    t_per_iter = (t(hi) - t(lo)) / (hi - lo)
+
+Runs `trials` rounds and takes the median delta for noise robustness.
+"""
+
+from __future__ import annotations
+
+import time
+from statistics import median
+from typing import Callable
+
+
+def differential_time_per_iter(
+    run: Callable[[int], object],
+    lo: int,
+    hi: int,
+    trials: int = 3,
+) -> float:
+    """`run(iters)` must execute iters chained device iterations and block on
+    a scalar readback. Returns seconds per iteration (>= 1ns)."""
+    if hi <= lo:
+        raise ValueError("hi must exceed lo")
+    # warm both compilations before any timing
+    run(lo)
+    run(hi)
+    deltas = []
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        run(lo)
+        t_lo = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        run(hi)
+        t_hi = time.perf_counter() - t0
+        deltas.append((t_hi - t_lo) / (hi - lo))
+    return max(median(deltas), 1e-9)

@@ -45,6 +45,7 @@ class TpuGeneration:
     hbm_gib_per_chip: float
     bf16_tflops_per_chip: float
     gcp_accelerator_prefix: str     # GCP acceleratorType prefix, e.g. "v5litepod"
+    gcp_accelerator_config_type: str  # AcceleratorConfig.type enum, e.g. "V5LITE_POD"
     default_runtime_version: str    # TPU-VM runtime image
     ici_gbps_per_link: float        # per-direction ICI link bandwidth, GB/s
 
@@ -77,6 +78,7 @@ GENERATIONS: dict[str, TpuGeneration] = {
             hbm_gib_per_chip=32.0,
             bf16_tflops_per_chip=275.0,
             gcp_accelerator_prefix="v4",
+            gcp_accelerator_config_type="V4",
             default_runtime_version="tpu-vm-v4-base",
             ici_gbps_per_link=50.0,
         ),
@@ -92,6 +94,7 @@ GENERATIONS: dict[str, TpuGeneration] = {
             hbm_gib_per_chip=16.0,
             bf16_tflops_per_chip=197.0,
             gcp_accelerator_prefix="v5litepod",
+            gcp_accelerator_config_type="V5LITE_POD",
             default_runtime_version="v2-alpha-tpuv5-lite",
             ici_gbps_per_link=50.0,
         ),
@@ -107,6 +110,7 @@ GENERATIONS: dict[str, TpuGeneration] = {
             hbm_gib_per_chip=95.0,
             bf16_tflops_per_chip=459.0,
             gcp_accelerator_prefix="v5p",
+            gcp_accelerator_config_type="V5P",
             default_runtime_version="v2-alpha-tpuv5",
             ici_gbps_per_link=100.0,
         ),
@@ -122,6 +126,7 @@ GENERATIONS: dict[str, TpuGeneration] = {
             hbm_gib_per_chip=32.0,
             bf16_tflops_per_chip=918.0,
             gcp_accelerator_prefix="v6e",
+            gcp_accelerator_config_type="V6E",
             default_runtime_version="v2-alpha-tpuv6e",
             ici_gbps_per_link=100.0,
         ),

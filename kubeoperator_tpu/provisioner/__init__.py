@@ -1,0 +1,17 @@
+"""Provisioner — the Terraform wrapper layer (SURVEY.md §2.1 row 5).
+
+Parity: render tfvars from Plan+Zone+Region, run `terraform init/apply/
+destroy` in a per-cluster working dir, parse created-VM IPs back into Host
+rows. Providers: vsphere + openstack (upstream parity) and gcp_tpu_vm — the
+north-star addition [BASELINE] where TPU slices are first-class Terraform
+resources (one `google_tpu_v2_vm` per slice; its per-worker network
+endpoints become the cluster's TPU hosts).
+"""
+
+from kubeoperator_tpu.provisioner.terraform import (
+    FakeProvisioner,
+    TerraformProvisioner,
+    terraform_available,
+)
+
+__all__ = ["TerraformProvisioner", "FakeProvisioner", "terraform_available"]

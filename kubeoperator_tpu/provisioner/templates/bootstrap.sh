@@ -1,0 +1,10 @@
+#!/usr/bin/env bash
+# Minimal first-boot bootstrap for provisioned nodes: ensure SSH is up and
+# python3 exists for the executor; all real configuration arrives via the
+# content layer (playbooks), never via startup scripts — keeping the
+# Terraform/Ansible responsibility split of the reference (SURVEY.md §2).
+set -euo pipefail
+if ! command -v python3 >/dev/null 2>&1; then
+  apt-get update -y && apt-get install -y python3 python3-pip || true
+fi
+systemctl enable --now ssh || systemctl enable --now sshd || true

@@ -1,0 +1,253 @@
+"""Terraform wrapper: template rendering, lifecycle subprocess calls, and
+output→Host parsing.
+
+TPU-first design notes (SURVEY.md §7 hard part (e)): TPU VMs are not GCE
+VMs — a multi-host slice is ONE `google_tpu_v2_vm` resource whose
+`network_endpoints` list yields one IP per TPU host; there is no custom
+image (runtime version instead) and bootstrap runs via metadata startup
+script. Control-plane masters ride ordinary GCE instances beside the slice.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+
+import jinja2
+
+from kubeoperator_tpu.models import Host, Plan, Region, Zone
+from kubeoperator_tpu.utils.errors import ProvisionerError
+from kubeoperator_tpu.utils.logging import get_logger
+
+log = get_logger("provisioner")
+
+TEMPLATES_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "templates")
+
+
+def terraform_available(binary: str = "terraform") -> bool:
+    return shutil.which(binary) is not None
+
+
+def build_tfvars(plan: Plan, region: Region, zones: list[Zone]) -> dict:
+    """Flatten Plan+Zone+Region into the tfvars contract the templates use."""
+    zone = zones[0] if zones else Zone(name="default", region_id=region.id)
+    tfvars: dict = {
+        "cluster_name": "",  # filled by render()
+        "master_count": plan.master_count,
+        "worker_count": plan.worker_count,
+        "region_vars": region.vars,
+        "zone_vars": zone.vars,
+    }
+    tfvars.update({f"region_{k}": v for k, v in region.vars.items()})
+    tfvars.update({f"zone_{k}": v for k, v in zone.vars.items()})
+    tfvars.update(plan.vars)
+    if plan.has_tpu():
+        topo = plan.topology()
+        tfvars.update(
+            tpu_enabled=True,
+            tpu_generation=topo.generation.name,
+            tpu_accelerator_config_type=topo.generation.gcp_accelerator_config_type,
+            gcp_accelerator_type=topo.gcp_accelerator_type,
+            slice_topology=topo.gcp_topology,
+            num_slices=topo.num_slices,
+            hosts_per_slice=topo.hosts_per_slice,
+            chips_per_host=topo.local_device_count,
+            tpu_runtime_version=(
+                plan.tpu_runtime_version or topo.generation.default_runtime_version
+            ),
+            # worker_count for TPU plans is the derived host count
+            worker_count=topo.total_hosts,
+        )
+    else:
+        tfvars["tpu_enabled"] = False
+    return tfvars
+
+
+class TerraformProvisioner:
+    """One instance per server; per-cluster state lives in work_dir/<name>."""
+
+    def __init__(
+        self,
+        work_dir: str = "terraform_runs",
+        terraform_bin: str = "terraform",
+        templates_dir: str = TEMPLATES_DIR,
+    ) -> None:
+        self.work_dir = work_dir
+        self.terraform_bin = terraform_bin
+        self.env = jinja2.Environment(
+            loader=jinja2.FileSystemLoader(templates_dir),
+            undefined=jinja2.StrictUndefined,
+            keep_trailing_newline=True,
+        )
+
+    # ---- rendering ----
+    def render(
+        self, cluster_name: str, plan: Plan, region: Region, zones: list[Zone]
+    ) -> str:
+        """Write main.tf + terraform.tfvars.json for this cluster; returns the
+        cluster work dir. Idempotent — re-render before retry/scale."""
+        provider = plan.provider
+        template_name = f"{provider}/main.tf.j2"
+        try:
+            template = self.env.get_template(template_name)
+        except jinja2.TemplateNotFound:
+            raise ProvisionerError(
+                message=f"no terraform template for provider {provider!r}"
+            )
+        tfvars = build_tfvars(plan, region, zones)
+        tfvars["cluster_name"] = cluster_name
+        cluster_dir = os.path.join(self.work_dir, cluster_name)
+        os.makedirs(cluster_dir, exist_ok=True)
+        rendered = template.render(**tfvars)
+        with open(os.path.join(cluster_dir, "main.tf"), "w", encoding="utf-8") as f:
+            f.write(rendered)
+        # ship module-relative support files beside main.tf so
+        # file("${path.module}/...") resolves inside the work dir
+        bootstrap_src = os.path.join(
+            os.path.dirname(os.path.dirname(template.filename or "")),
+            "bootstrap.sh",
+        )
+        if os.path.exists(bootstrap_src):
+            shutil.copy(bootstrap_src, os.path.join(cluster_dir, "bootstrap.sh"))
+        with open(
+            os.path.join(cluster_dir, "terraform.tfvars.json"), "w", encoding="utf-8"
+        ) as f:
+            json.dump(tfvars, f, indent=2, default=str)
+        log.info("rendered terraform for %s (%s)", cluster_name, provider)
+        return cluster_dir
+
+    # ---- lifecycle ----
+    def _run(self, cluster_dir: str, *args: str) -> str:
+        if not terraform_available(self.terraform_bin):
+            raise ProvisionerError(
+                message="terraform binary not available in this environment"
+            )
+        cmd = [self.terraform_bin, *args]
+        try:
+            proc = subprocess.run(
+                cmd, cwd=cluster_dir, capture_output=True, text=True, timeout=3600
+            )
+        except subprocess.TimeoutExpired as e:
+            raise ProvisionerError(
+                message=f"{' '.join(cmd)} timed out after 3600s"
+            ) from e
+        if proc.returncode != 0:
+            raise ProvisionerError(
+                message=f"{' '.join(cmd)} failed: {proc.stderr[-2000:]}"
+            )
+        return proc.stdout
+
+    def apply(self, cluster_dir: str) -> None:
+        self._run(cluster_dir, "init", "-input=false", "-no-color")
+        self._run(
+            cluster_dir, "apply", "-auto-approve", "-input=false", "-no-color"
+        )
+
+    def destroy(self, cluster_dir: str) -> None:
+        # init first: the delete flow may run on a fresh disk/re-rendered dir
+        self._run(cluster_dir, "init", "-input=false", "-no-color")
+        self._run(
+            cluster_dir, "destroy", "-auto-approve", "-input=false", "-no-color"
+        )
+
+    def outputs(self, cluster_dir: str) -> dict:
+        raw = self._run(cluster_dir, "output", "-json")
+        return {k: v.get("value") for k, v in json.loads(raw).items()}
+
+    # ---- output -> Host parsing ----
+    @staticmethod
+    def hosts_from_outputs(
+        outputs: dict, plan: Plan, cluster_name: str, credential_id: str = ""
+    ) -> list[Host]:
+        """Terraform outputs contract -> Host rows.
+
+        Expected outputs: `master_ips` (list), `worker_ips` (list, non-TPU),
+        `tpu_endpoints` (dict slice_idx -> list of per-worker IPs, TPU).
+        """
+        hosts: list[Host] = []
+        for i, ip in enumerate(outputs.get("master_ips") or []):
+            hosts.append(Host(
+                name=f"{cluster_name}-master-{i}", ip=str(ip),
+                credential_id=credential_id,
+            ))
+        for i, ip in enumerate(outputs.get("worker_ips") or []):
+            hosts.append(Host(
+                name=f"{cluster_name}-worker-{i}", ip=str(ip),
+                credential_id=credential_id,
+            ))
+        tpu_endpoints = outputs.get("tpu_endpoints") or {}
+        if tpu_endpoints and not plan.has_tpu():
+            raise ProvisionerError(message="tpu_endpoints from a non-TPU plan")
+        if plan.has_tpu():
+            topo = plan.topology()
+            if len(tpu_endpoints) != topo.num_slices:
+                raise ProvisionerError(
+                    message=(
+                        f"terraform returned {len(tpu_endpoints)} slices, "
+                        f"plan needs {topo.num_slices}"
+                    )
+                )
+            for slice_key in sorted(tpu_endpoints, key=lambda k: int(k)):
+                slice_id = int(slice_key)
+                ips = tpu_endpoints[slice_key]
+                if len(ips) != topo.hosts_per_slice:
+                    raise ProvisionerError(
+                        message=(
+                            f"slice {slice_id} returned {len(ips)} endpoints, "
+                            f"topology needs {topo.hosts_per_slice}"
+                        )
+                    )
+                for worker_id, ip in enumerate(ips):
+                    hosts.append(Host(
+                        name=f"{cluster_name}-tpu-{slice_id}-{worker_id}",
+                        ip=str(ip),
+                        credential_id=credential_id,
+                        tpu_worker_id=worker_id,
+                        tpu_slice_id=slice_id,
+                        tpu_chips=topo.local_device_count,
+                    ))
+        return hosts
+
+
+class FakeProvisioner(TerraformProvisioner):
+    """Test/simulation double: renders real templates but fabricates apply/
+    outputs so the create flow runs end-to-end with no cloud (SURVEY.md §4:
+    'terraform plan-only golden tests' + fake boundary)."""
+
+    def __init__(self, work_dir: str = "terraform_runs", **kw) -> None:
+        super().__init__(work_dir=work_dir, **kw)
+        self.applied: list[str] = []
+        self.destroyed: list[str] = []
+
+    def apply(self, cluster_dir: str) -> None:
+        self.applied.append(cluster_dir)
+
+    def destroy(self, cluster_dir: str) -> None:
+        self.destroyed.append(cluster_dir)
+
+    def outputs(self, cluster_dir: str) -> dict:
+        with open(
+            os.path.join(cluster_dir, "terraform.tfvars.json"), encoding="utf-8"
+        ) as f:
+            tfvars = json.load(f)
+        octet = 10
+        outputs: dict = {
+            "master_ips": [
+                f"10.200.0.{octet + i}" for i in range(tfvars["master_count"])
+            ]
+        }
+        if tfvars.get("tpu_enabled"):
+            outputs["tpu_endpoints"] = {
+                str(s): [
+                    f"10.200.{s + 1}.{octet + w}"
+                    for w in range(tfvars["hosts_per_slice"])
+                ]
+                for s in range(tfvars["num_slices"])
+            }
+        else:
+            outputs["worker_ips"] = [
+                f"10.200.9.{octet + i}" for i in range(tfvars["worker_count"])
+            ]
+        return outputs

@@ -8,10 +8,16 @@ test process, hence module scope here.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flag = "--xla_force_host_platform_device_count=8"
 if _flag not in os.environ.get("XLA_FLAGS", ""):
     os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") + " " + _flag).strip()
+
+# Force CPU AFTER import: the image's sitecustomize registers the real-TPU
+# tunnel backend at interpreter start and pins jax_platforms itself, so an
+# env var set here is too late — the config update is not.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

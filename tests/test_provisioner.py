@@ -1,0 +1,148 @@
+"""Terraform layer: golden rendering, tfvars contract, output->Host parsing
+(SURVEY.md §4: 'terraform plan-only golden tests for the GCP TPU-VM
+templates')."""
+
+import json
+import os
+
+import pytest
+
+from kubeoperator_tpu.models import Plan, Region, Zone
+from kubeoperator_tpu.provisioner import FakeProvisioner, TerraformProvisioner
+from kubeoperator_tpu.provisioner.terraform import build_tfvars
+from kubeoperator_tpu.utils.errors import ProvisionerError
+
+
+@pytest.fixture()
+def gcp_setup():
+    region = Region(name="gcp-us-central1", provider="gcp_tpu_vm",
+                    vars={"project": "ko-tpu-proj", "name": "us-central1"})
+    zone = Zone(name="us-central1-a", region_id=region.id,
+                vars={"gcp_zone": "us-central1-a"})
+    plan = Plan(name="tpu-v5e-16", provider="gcp_tpu_vm", region_id=region.id,
+                zone_ids=[zone.id], accelerator="tpu", tpu_type="v5e-16",
+                worker_count=0, master_count=1,
+                vars={"ssh_user": "ubuntu", "ssh_public_key": "ssh-ed25519 AAAA"})
+    return plan, region, zone
+
+
+class TestTfvars:
+    def test_tpu_plan_tfvars_derivation(self, gcp_setup):
+        plan, region, zone = gcp_setup
+        tfvars = build_tfvars(plan, region, [zone])
+        assert tfvars["tpu_enabled"] is True
+        assert tfvars["gcp_accelerator_type"] == "v5litepod-16"
+        assert tfvars["tpu_accelerator_config_type"] == "V5LITE_POD"
+        assert tfvars["slice_topology"] == "4x4"
+        assert tfvars["hosts_per_slice"] == 4
+        assert tfvars["worker_count"] == 4  # derived from topology
+        assert tfvars["zone_gcp_zone"] == "us-central1-a"
+        assert tfvars["region_project"] == "ko-tpu-proj"
+
+
+class TestRendering:
+    def test_gcp_tpu_template_golden(self, gcp_setup, tmp_path):
+        plan, region, zone = gcp_setup
+        prov = TerraformProvisioner(work_dir=str(tmp_path))
+        cluster_dir = prov.render("northstar", plan, region, [zone])
+        tf = open(os.path.join(cluster_dir, "main.tf")).read()
+        # TPU slice is ONE resource with accelerator_config, not N VMs
+        assert 'resource "google_tpu_v2_vm" "slice"' in tf
+        assert 'type     = "V5LITE_POD"' in tf
+        assert 'topology = "4x4"' in tf
+        assert 'runtime_version  = "v2-alpha-tpuv5-lite"' in tf
+        assert 'count            = 1' in tf  # one slice
+        assert 'output "tpu_endpoints"' in tf
+        assert "network_endpoints" in tf
+        # control plane on ordinary GCE
+        assert 'resource "google_compute_instance" "master"' in tf
+        # no GPU residue in rendered IaC
+        assert "nvidia" not in tf.lower() and "gpu" not in tf.lower()
+        tfvars = json.load(open(os.path.join(cluster_dir, "terraform.tfvars.json")))
+        assert tfvars["cluster_name"] == "northstar"
+
+    def test_cpu_plan_renders_without_tpu_block(self, tmp_path):
+        region = Region(name="gcp", provider="gcp_tpu_vm", vars={})
+        plan = Plan(name="cpu-only", provider="gcp_tpu_vm", region_id=region.id,
+                    master_count=3, worker_count=3)
+        prov = TerraformProvisioner(work_dir=str(tmp_path))
+        tf = open(os.path.join(
+            prov.render("cpu", plan, region, []), "main.tf")).read()
+        assert 'resource "google_tpu_v2_vm"' not in tf
+        assert "count        = 3" in tf
+        # non-TPU gcp plans get GCE workers + outputs (workers not dropped)
+        assert 'resource "google_compute_instance" "worker"' in tf
+        assert 'output "worker_ips"' in tf
+
+    def test_bootstrap_shipped_beside_main_tf(self, gcp_setup, tmp_path):
+        plan, region, zone = gcp_setup
+        prov = TerraformProvisioner(work_dir=str(tmp_path))
+        d = prov.render("bs", plan, region, [zone])
+        # file("${path.module}/bootstrap.sh") must resolve in the work dir
+        assert os.path.exists(os.path.join(d, "bootstrap.sh"))
+        assert '${path.module}/bootstrap.sh' in open(os.path.join(d, "main.tf")).read()
+
+    def test_vsphere_and_openstack_render(self, tmp_path):
+        for provider, marker in [
+            ("vsphere", 'resource "vsphere_virtual_machine" "worker"'),
+            ("openstack", 'resource "openstack_compute_instance_v2" "worker"'),
+        ]:
+            region = Region(name=f"r-{provider}", provider=provider, vars={})
+            plan = Plan(name=f"p-{provider}", provider=provider,
+                        region_id=region.id, master_count=3, worker_count=3)
+            prov = TerraformProvisioner(work_dir=str(tmp_path))
+            tf = open(os.path.join(
+                prov.render(f"c-{provider}", plan, region, []), "main.tf")).read()
+            assert marker in tf
+            assert 'output "master_ips"' in tf
+
+    def test_unknown_provider_rejected(self, tmp_path):
+        region = Region(name="r", provider="vsphere", vars={})
+        plan = Plan(name="p", provider="bare_metal", master_count=1)
+        prov = TerraformProvisioner(work_dir=str(tmp_path))
+        with pytest.raises(ProvisionerError):
+            prov.render("c", plan, region, [])
+
+
+class TestOutputsToHosts:
+    def test_tpu_endpoints_become_tpu_hosts(self, gcp_setup, tmp_path):
+        plan, region, zone = gcp_setup
+        prov = FakeProvisioner(work_dir=str(tmp_path))
+        cluster_dir = prov.render("ns", plan, region, [zone])
+        prov.apply(cluster_dir)
+        outputs = prov.outputs(cluster_dir)
+        hosts = prov.hosts_from_outputs(outputs, plan, "ns", credential_id="cred")
+        masters = [h for h in hosts if h.tpu_chips == 0]
+        tpu = [h for h in hosts if h.tpu_chips > 0]
+        assert len(masters) == 1 and len(tpu) == 4
+        assert [h.tpu_worker_id for h in tpu] == [0, 1, 2, 3]
+        assert all(h.tpu_chips == 4 for h in tpu)
+        assert all(h.tpu_slice_id == 0 for h in tpu)
+
+    def test_multislice_outputs(self, tmp_path):
+        region = Region(name="gcp", provider="gcp_tpu_vm", vars={})
+        plan = Plan(name="ms", provider="gcp_tpu_vm", region_id=region.id,
+                    accelerator="tpu", tpu_type="v5p-64", num_slices=2,
+                    worker_count=0)
+        prov = FakeProvisioner(work_dir=str(tmp_path))
+        d = prov.render("ms", plan, region, [])
+        hosts = prov.hosts_from_outputs(prov.outputs(d), plan, "ms")
+        tpu = [h for h in hosts if h.tpu_chips > 0]
+        assert len(tpu) == 16  # 8 hosts x 2 slices
+        assert {h.tpu_slice_id for h in tpu} == {0, 1}
+
+    def test_missing_slice_rejected(self):
+        plan = Plan(name="ms", provider="gcp_tpu_vm", region_id="r",
+                    accelerator="tpu", tpu_type="v5p-64", num_slices=2,
+                    worker_count=0)
+        outputs = {"master_ips": [],
+                   "tpu_endpoints": {"0": [f"10.1.0.{i}" for i in range(8)]}}
+        with pytest.raises(ProvisionerError):
+            TerraformProvisioner.hosts_from_outputs(outputs, plan, "ms")
+
+    def test_short_slice_rejected(self, gcp_setup):
+        plan, region, zone = gcp_setup
+        outputs = {"master_ips": ["10.0.0.1"],
+                   "tpu_endpoints": {"0": ["10.1.0.1", "10.1.0.2"]}}  # 2 of 4
+        with pytest.raises(ProvisionerError):
+            TerraformProvisioner.hosts_from_outputs(outputs, plan, "x")
