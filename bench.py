@@ -31,7 +31,7 @@ def _generation_for_device(dev) -> str:
         return "v6e"
     if "v4" in kind:
         return "v4"
-    return "v5e"
+    return ""
 
 
 def main() -> int:
@@ -48,7 +48,20 @@ def main() -> int:
 
     devices = jax.devices()
     n = len(devices)
-    gen = GENERATIONS[_generation_for_device(devices[0])]
+    gen_name = _generation_for_device(devices[0])
+    if not gen_name:
+        # No recognizable TPU: refuse to fabricate a TPU health number
+        # (e.g. silent CPU fallback when the tunnel fails to register).
+        print(json.dumps({
+            "metric": "error_no_tpu_visible",
+            "value": 0,
+            "unit": "none",
+            "vs_baseline": 0,
+            "details": {"device_kind": getattr(devices[0], "device_kind",
+                                               str(devices[0]))},
+        }), flush=True)
+        return 1
+    gen = GENERATIONS[gen_name]
     details: dict = {
         "devices": n,
         "device_kind": getattr(devices[0], "device_kind", str(devices[0])),
@@ -58,6 +71,17 @@ def main() -> int:
     if n >= 2:
         mesh = flat_axis_mesh()
         details["psum_correct"] = verify_psum_correctness(mesh)
+        if not details["psum_correct"]:
+            # wrong all-reduce values: bandwidth of a broken interconnect is
+            # not a health metric — fail loudly like psum_smoke does
+            print(json.dumps({
+                "metric": "error_psum_incorrect",
+                "value": 0,
+                "unit": "none",
+                "vs_baseline": 0,
+                "details": details,
+            }), flush=True)
+            return 1
         best = None
         for size in (8.0, 32.0, 64.0):
             r = bench_collective("psum", size_mb=size, mesh=mesh, iters=16)

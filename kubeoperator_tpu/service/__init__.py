@@ -1,0 +1,10 @@
+"""Service layer — one service per capability (SURVEY.md §2.1 row 1b).
+
+Services own all state and are the only layer that calls the provisioner or
+executor (SURVEY.md §2 contracts). `build_services` wires the bundle from
+config the way the reference's dependency injection does at boot.
+"""
+
+from kubeoperator_tpu.service.container import Services, build_services
+
+__all__ = ["Services", "build_services"]

@@ -1,0 +1,139 @@
+"""BackupService — etcd snapshot backup/restore (SURVEY.md §3.5, §5.4):
+accounts (S3/OSS/SFTP/local endpoints), per-cluster cron strategies with
+retention, snapshot files, restore as inverse playbook."""
+
+from __future__ import annotations
+
+from kubeoperator_tpu.adm import AdmContext, ClusterAdm, backup_phases, restore_phases
+from kubeoperator_tpu.executor import Executor
+from kubeoperator_tpu.models import BackupAccount, BackupFile, BackupStrategy
+from kubeoperator_tpu.repository import Repositories
+from kubeoperator_tpu.utils.errors import NotFoundError, PhaseError, ValidationError
+from kubeoperator_tpu.utils.ids import now_iso
+
+
+class BackupService:
+    def __init__(self, repos: Repositories, executor: Executor, events):
+        self.repos = repos
+        self.events = events
+        self.adm = ClusterAdm(executor)
+
+    # ---- accounts ----
+    def create_account(self, account: BackupAccount) -> BackupAccount:
+        account.validate()
+        return self.repos.backup_accounts.save(account)
+
+    def list_accounts(self) -> list[BackupAccount]:
+        return self.repos.backup_accounts.list()
+
+    def delete_account(self, name: str) -> None:
+        acct = self.repos.backup_accounts.get_by_name(name)
+        self.repos.backup_accounts.delete(acct.id)
+
+    # ---- strategies ----
+    def set_strategy(self, cluster_name: str, account_name: str,
+                     cron: str = "0 3 * * *", save_num: int = 7,
+                     enabled: bool = True) -> BackupStrategy:
+        cluster = self.repos.clusters.get_by_name(cluster_name)
+        account = self.repos.backup_accounts.get_by_name(account_name)
+        existing = self.repos.backup_strategies.find(cluster_id=cluster.id)
+        strategy = existing[0] if existing else BackupStrategy(cluster_id=cluster.id)
+        strategy.account_id = account.id
+        strategy.cron = cron
+        strategy.save_num = save_num
+        strategy.enabled = enabled
+        strategy.validate()
+        return self.repos.backup_strategies.save(strategy)
+
+    def get_strategy(self, cluster_name: str) -> BackupStrategy | None:
+        cluster = self.repos.clusters.get_by_name(cluster_name)
+        found = self.repos.backup_strategies.find(cluster_id=cluster.id)
+        return found[0] if found else None
+
+    # ---- backup / restore ----
+    def run_backup(self, cluster_name: str, account_name: str = "") -> BackupFile:
+        cluster = self.repos.clusters.get_by_name(cluster_name)
+        if account_name:
+            account = self.repos.backup_accounts.get_by_name(account_name)
+        else:
+            strategy = self.get_strategy(cluster_name)
+            if strategy is None:
+                raise ValidationError(
+                    f"no backup account/strategy for {cluster_name}"
+                )
+            account = self.repos.backup_accounts.get(strategy.account_id)
+        fname = f"etcd-{cluster.name}-{now_iso().replace(':', '')}.db"
+        record = BackupFile(cluster_id=cluster.id, account_id=account.id,
+                            name=fname)
+        self.repos.backup_files.save(record)
+        ctx = self._context(cluster, account, fname)
+        try:
+            self.adm.run(ctx, backup_phases())
+        except PhaseError as e:
+            record.status = "Failed"
+            record.message = e.message
+            self.repos.backup_files.save(record)
+            self.events.emit(cluster.id, "Warning", "BackupFailed", e.message)
+            raise
+        record.status = "Uploaded"
+        self.repos.backup_files.save(record)
+        self._prune(cluster.id)
+        self.events.emit(cluster.id, "Normal", "BackupDone",
+                         f"etcd snapshot {fname} -> {account.name}")
+        return record
+
+    def restore(self, cluster_name: str, file_name: str) -> None:
+        cluster = self.repos.clusters.get_by_name(cluster_name)
+        files = self.repos.backup_files.find(cluster_id=cluster.id,
+                                             name=file_name)
+        if not files:
+            raise NotFoundError(kind="backup_file", name=file_name)
+        record = files[0]
+        account = self.repos.backup_accounts.get(record.account_id)
+        ctx = self._context(cluster, account, file_name)
+        try:
+            self.adm.run(ctx, restore_phases())
+        except PhaseError as e:
+            self.events.emit(cluster.id, "Warning", "RestoreFailed", e.message)
+            raise
+        record.status = "Restored"
+        self.repos.backup_files.save(record)
+        self.events.emit(cluster.id, "Normal", "RestoreDone",
+                         f"cluster restored from {file_name}")
+
+    def list_files(self, cluster_name: str) -> list[BackupFile]:
+        cluster = self.repos.clusters.get_by_name(cluster_name)
+        return self.repos.backup_files.find(cluster_id=cluster.id)
+
+    # ---- internals ----
+    def _context(self, cluster, account: BackupAccount, fname: str) -> AdmContext:
+        return AdmContext(
+            cluster=cluster,
+            nodes=self.repos.nodes.find(cluster_id=cluster.id),
+            hosts_by_id={
+                h.id: h for h in self.repos.hosts.find(cluster_id=cluster.id)
+            },
+            credentials_by_id={c.id: c for c in self.repos.credentials.list()},
+            extra_vars={
+                "backup_file_name": fname,
+                "backup_account_type": account.type,
+                "backup_bucket": account.bucket,
+                "backup_save_num": 7,
+                **{f"backup_{k}": v for k, v in account.vars.items()},
+            },
+            log_sink=lambda task_id, line: self.repos.task_logs.append(
+                cluster.id, task_id, [line]
+            ),
+            save_cluster=lambda c: self.repos.clusters.save(c),
+        )
+
+    def _prune(self, cluster_id: str) -> None:
+        strategy = self.repos.backup_strategies.find(cluster_id=cluster_id)
+        keep = strategy[0].save_num if strategy else 7
+        files = sorted(
+            self.repos.backup_files.find(cluster_id=cluster_id),
+            key=lambda f: f.created_at,
+        )
+        uploaded = [f for f in files if f.status == "Uploaded"]
+        for record in uploaded[:-keep] if len(uploaded) > keep else []:
+            self.repos.backup_files.delete(record.id)

@@ -1,0 +1,320 @@
+"""ClusterService — the #1 path (SURVEY.md §3.1).
+
+POST /clusters → validate spec against plan → persist Initializing →
+[plan mode] terraform render/apply → Hosts/Nodes → async ClusterAdm create
+phases (incl. tpu-runtime + smoke gate on TPU plans) → Running/Ready.
+Retry re-enters at the failed condition; delete runs reset + terraform
+destroy.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+from kubeoperator_tpu.adm import AdmContext, ClusterAdm, create_phases, reset_phases
+from kubeoperator_tpu.executor import Executor, SimulationExecutor
+from kubeoperator_tpu.models import (
+    Cluster,
+    ClusterSpec,
+    Host,
+    Node,
+    NodeRole,
+    Plan,
+    ProvisionMode,
+)
+from kubeoperator_tpu.models.cluster import ClusterPhaseStatus
+from kubeoperator_tpu.provisioner import TerraformProvisioner
+from kubeoperator_tpu.repository import Repositories
+from kubeoperator_tpu.utils.config import Config
+from kubeoperator_tpu.utils.errors import (
+    ConflictError,
+    NotFoundError,
+    PhaseError,
+    ValidationError,
+)
+from kubeoperator_tpu.utils.logging import get_logger
+from kubeoperator_tpu.version import DEFAULT_K8S_VERSION
+
+log = get_logger("service.cluster")
+
+
+class ClusterService:
+    def __init__(
+        self,
+        repos: Repositories,
+        executor: Executor,
+        provisioner: TerraformProvisioner,
+        events,
+        config: Config,
+    ) -> None:
+        self.repos = repos
+        self.executor = executor
+        self.provisioner = provisioner
+        self.events = events
+        self.config = config
+        self.adm = ClusterAdm(executor)
+        self._ops: dict[str, threading.Thread] = {}
+        # chaos/test hook: merged into every phase's extra-vars (e.g.
+        # {"__fail_at_task__": "install etcd"} for simulated failure drills)
+        self.debug_extra_vars: dict = {}
+
+    # ---- CRUD ----
+    def list(self, project_id: str | None = None) -> list[Cluster]:
+        if project_id:
+            return self.repos.clusters.find(project_id=project_id)
+        return self.repos.clusters.list()
+
+    def get(self, name: str) -> Cluster:
+        return self.repos.clusters.get_by_name(name)
+
+    def create(
+        self,
+        name: str,
+        spec: ClusterSpec | None = None,
+        provision_mode: str = ProvisionMode.MANUAL.value,
+        plan_name: str = "",
+        project_id: str = "",
+        host_names: list[str] | None = None,
+        credential_name: str = "",
+        wait: bool = False,
+    ) -> Cluster:
+        """The SURVEY §3.1 entry point. `wait=True` runs phases inline
+        (tests/CLI); default is the reference's async-goroutine behavior."""
+        try:
+            self.repos.clusters.get_by_name(name)
+            raise ConflictError(kind="cluster", name=name)
+        except NotFoundError:
+            pass
+
+        spec = spec or ClusterSpec()
+        if not spec.k8s_version:
+            spec.k8s_version = DEFAULT_K8S_VERSION
+        plan: Plan | None = None
+        if provision_mode == ProvisionMode.PLAN.value:
+            if not plan_name:
+                raise ValidationError("plan-mode create requires a plan name")
+            plan = self.repos.plans.get_by_name(plan_name)
+            plan.validate()
+            if plan.has_tpu():
+                # plan drives the TPU phases; spec mirrors it for the vars
+                spec.tpu_enabled = True
+                spec.jobset_enabled = plan.topology().is_multihost or \
+                    plan.topology().is_multislice
+
+        cluster = Cluster(
+            name=name,
+            project_id=project_id,
+            provision_mode=provision_mode,
+            plan_id=plan.id if plan else "",
+            spec=spec,
+        )
+        cluster.validate()
+        self.repos.clusters.save(cluster)
+        self.events.emit(cluster.id, "Normal", "ClusterCreateStarted",
+                         f"cluster {name} create accepted ({provision_mode})")
+
+        if provision_mode == ProvisionMode.MANUAL.value:
+            self._bind_manual_hosts(cluster, host_names or [], credential_name)
+
+        return self._launch(cluster, plan, wait)
+
+    def retry(self, name: str, wait: bool = False) -> Cluster:
+        """Resume a failed create at the first non-OK condition."""
+        cluster = self.get(name)
+        plan = self.repos.plans.get(cluster.plan_id) if cluster.plan_id else None
+        return self._launch(cluster, plan, wait)
+
+    def delete(self, name: str, wait: bool = False) -> None:
+        cluster = self.get(name)
+        cluster.status.phase = ClusterPhaseStatus.TERMINATING.value
+        self.repos.clusters.save(cluster)
+
+        def work():
+            try:
+                ctx = self._context(cluster)
+                if ctx.nodes:
+                    try:
+                        self.adm.run(ctx, reset_phases())
+                    except PhaseError:
+                        log.warning("reset failed for %s; continuing teardown", name)
+                if cluster.provision_mode == ProvisionMode.PLAN.value:
+                    cluster_dir = os.path.join(
+                        self.provisioner.work_dir, cluster.name
+                    )
+                    if os.path.isdir(cluster_dir):
+                        self.provisioner.destroy(cluster_dir)
+                for node in self.repos.nodes.find(cluster_id=cluster.id):
+                    self.repos.nodes.delete(node.id)
+                for host in self.repos.hosts.find(cluster_id=cluster.id):
+                    if cluster.provision_mode == ProvisionMode.PLAN.value:
+                        self.repos.hosts.delete(host.id)  # we created them
+                    else:
+                        host.cluster_id = ""
+                        self.repos.hosts.save(host)
+                cluster.status.phase = ClusterPhaseStatus.TERMINATED.value
+                self.repos.clusters.save(cluster)
+                self.repos.clusters.delete(cluster.id)
+                self.events.emit(cluster.id, "Normal", "ClusterDeleted",
+                                 f"cluster {name} deleted")
+            except Exception as e:
+                cluster.status.phase = ClusterPhaseStatus.FAILED.value
+                cluster.status.message = f"delete failed: {e}"
+                self.repos.clusters.save(cluster)
+                self.events.emit(cluster.id, "Warning", "ClusterDeleteFailed", str(e))
+                raise
+
+        self._spawn(cluster.id, work, wait)
+
+    # ---- internals ----
+    def _bind_manual_hosts(
+        self, cluster: Cluster, host_names: list[str], credential_name: str
+    ) -> None:
+        if not host_names:
+            raise ValidationError("manual-mode create requires host names")
+        if len(host_names) < cluster.spec.worker_count + 1:
+            raise ValidationError(
+                f"need >= {cluster.spec.worker_count + 1} hosts "
+                f"(1 master + {cluster.spec.worker_count} workers)"
+            )
+        if credential_name:
+            cred = self.repos.credentials.get_by_name(credential_name)
+        for i, hname in enumerate(host_names):
+            host = self.repos.hosts.get_by_name(hname)
+            if host.cluster_id and host.cluster_id != cluster.id:
+                raise ConflictError(kind="host", name=hname)
+            if credential_name:
+                host.credential_id = cred.id
+            host.cluster_id = cluster.id
+            self.repos.hosts.save(host)
+            role = NodeRole.MASTER if i == 0 else NodeRole.WORKER
+            self.repos.nodes.save(Node(
+                name=host.name, cluster_id=cluster.id, host_id=host.id,
+                role=role.value,
+            ))
+
+    def _provision(self, cluster: Cluster, plan: Plan) -> None:
+        """Terraform leg of §3.1 (plan mode only)."""
+        cluster.status.phase = ClusterPhaseStatus.PROVISIONING.value
+        self.repos.clusters.save(cluster)
+        region = self.repos.regions.get(plan.region_id)
+        zones = [self.repos.zones.get(z) for z in plan.zone_ids]
+        cluster_dir = self.provisioner.render(cluster.name, plan, region, zones)
+        self.provisioner.apply(cluster_dir)
+        outputs = self.provisioner.outputs(cluster_dir)
+        cred_id = ""
+        if plan.vars.get("credential_name"):
+            cred_id = self.repos.credentials.get_by_name(
+                plan.vars["credential_name"]
+            ).id
+        hosts = self.provisioner.hosts_from_outputs(
+            outputs, plan, cluster.name, credential_id=cred_id
+        )
+        for host in hosts:
+            host.cluster_id = cluster.id
+            self.repos.hosts.save(host)
+            role = NodeRole.MASTER if "-master-" in host.name else NodeRole.WORKER
+            self.repos.nodes.save(Node(
+                name=host.name, cluster_id=cluster.id, host_id=host.id,
+                role=role.value,
+            ))
+        self.events.emit(
+            cluster.id, "Normal", "Provisioned",
+            f"{len(hosts)} machines provisioned via {plan.provider}",
+        )
+
+    def _context(self, cluster: Cluster, plan: Plan | None = None) -> AdmContext:
+        nodes = self.repos.nodes.find(cluster_id=cluster.id)
+        hosts = {
+            h.id: h for h in self.repos.hosts.find(cluster_id=cluster.id)
+        }
+        creds = {c.id: c for c in self.repos.credentials.list()}
+        extra: dict = {}
+        if isinstance(self.executor, SimulationExecutor) and (
+            cluster.spec.tpu_enabled and plan is not None and plan.has_tpu()
+        ):
+            # simulation smoke result: 85% of the ICI envelope, so demo
+            # clusters report a realistic bandwidth (clearly marked simulated)
+            topo = plan.topology()
+            extra["sim_smoke_gbps"] = round(
+                0.85 * topo.theoretical_allreduce_busbw_gbps(), 1
+            )
+        extra.update(self.debug_extra_vars)
+        return AdmContext(
+            cluster=cluster,
+            nodes=nodes,
+            hosts_by_id=hosts,
+            credentials_by_id=creds,
+            plan=plan,
+            extra_vars=extra,
+            log_sink=lambda task_id, line: self.repos.task_logs.append(
+                cluster.id, task_id, [line]
+            ),
+            save_cluster=lambda c: self.repos.clusters.save(c),
+        )
+
+    def _launch(self, cluster: Cluster, plan: Plan | None, wait: bool) -> Cluster:
+        def work():
+            try:
+                if (
+                    plan is not None
+                    and not self.repos.nodes.find(cluster_id=cluster.id)
+                ):
+                    self._provision(cluster, plan)
+                cluster.status.phase = ClusterPhaseStatus.DEPLOYING.value
+                self.repos.clusters.save(cluster)
+                ctx = self._context(cluster, plan)
+                self.adm.run(ctx, create_phases())
+                self._finish_ready(cluster)
+            except PhaseError as e:
+                cluster.status.phase = ClusterPhaseStatus.FAILED.value
+                cluster.status.message = e.message
+                self.repos.clusters.save(cluster)
+                self.events.emit(cluster.id, "Warning", "ClusterCreateFailed",
+                                 f"phase {e.phase}: {e.message}")
+                if wait:
+                    raise
+            except Exception as e:
+                cluster.status.phase = ClusterPhaseStatus.FAILED.value
+                cluster.status.message = str(e)
+                self.repos.clusters.save(cluster)
+                self.events.emit(cluster.id, "Warning", "ClusterCreateFailed", str(e))
+                if wait:
+                    raise
+
+        self._spawn(cluster.id, work, wait)
+        return self.repos.clusters.get(cluster.id)
+
+    def _finish_ready(self, cluster: Cluster) -> None:
+        kc_path = os.path.join(
+            "/var/ko-tpu/kubeconfigs", f"{cluster.name}.conf"
+        )
+        if os.path.exists(kc_path):
+            with open(kc_path, encoding="utf-8") as f:
+                cluster.kubeconfig = f.read()
+        cluster.status.phase = ClusterPhaseStatus.READY.value
+        cluster.status.message = ""
+        self.repos.clusters.save(cluster)
+        detail = ""
+        if cluster.spec.tpu_enabled:
+            detail = (
+                f" (psum {cluster.status.smoke_gbps} GB/s over "
+                f"{cluster.status.smoke_chips} chips)"
+            )
+        self.events.emit(cluster.id, "Normal", "ClusterReady",
+                         f"cluster {cluster.name} Ready{detail}")
+
+    def _spawn(self, cluster_id: str, work, wait: bool) -> None:
+        if wait:
+            work()
+            return
+        thread = threading.Thread(target=work, daemon=True)
+        self._ops[cluster_id] = thread
+        thread.start()
+
+    def wait_for(self, name: str, timeout_s: float = 3600.0) -> Cluster:
+        cluster = self.get(name)
+        thread = self._ops.get(cluster.id)
+        if thread is not None:
+            thread.join(timeout_s)
+        return self.get(name)

@@ -1,0 +1,89 @@
+"""ComponentService — per-cluster addon install/uninstall
+(SURVEY.md §2.1 row 9): component CRUD → executor playbooks."""
+
+from __future__ import annotations
+
+from kubeoperator_tpu.adm import AdmContext, ClusterAdm
+from kubeoperator_tpu.adm.engine import Phase
+from kubeoperator_tpu.executor import Executor
+from kubeoperator_tpu.models import ClusterComponent
+from kubeoperator_tpu.models.component import COMPONENT_CATALOG
+from kubeoperator_tpu.repository import Repositories
+from kubeoperator_tpu.utils.errors import NotFoundError, PhaseError
+
+
+class ComponentService:
+    def __init__(self, repos: Repositories, executor: Executor, events):
+        self.repos = repos
+        self.events = events
+        self.adm = ClusterAdm(executor)
+
+    def catalog(self) -> dict:
+        return {k: dict(v) for k, v in COMPONENT_CATALOG.items()}
+
+    def list(self, cluster_name: str) -> list[ClusterComponent]:
+        cluster = self.repos.clusters.get_by_name(cluster_name)
+        return self.repos.components.find(cluster_id=cluster.id)
+
+    def install(self, cluster_name: str, component_name: str,
+                vars: dict | None = None) -> ClusterComponent:
+        cluster = self.repos.clusters.get_by_name(cluster_name)
+        component = ClusterComponent(
+            cluster_id=cluster.id, name=component_name,
+            vars=vars or dict(COMPONENT_CATALOG.get(component_name, {}).get("vars", {})),
+        )
+        component.validate()
+        existing = self.repos.components.find(cluster_id=cluster.id,
+                                              name=component_name)
+        if existing:
+            component = existing[0]
+            component.vars = vars or component.vars
+        component.status = "Installing"
+        self.repos.components.save(component)
+
+        playbook = COMPONENT_CATALOG[component_name]["playbook"]
+        ctx = self._context(cluster, component)
+        try:
+            self.adm.run(ctx, [Phase(f"component-{component_name}", playbook)])
+        except PhaseError as e:
+            component.status = "Failed"
+            component.message = e.message
+            self.repos.components.save(component)
+            raise
+        component.status = "Installed"
+        component.message = ""
+        self.repos.components.save(component)
+        self.events.emit(cluster.id, "Normal", "ComponentInstalled",
+                         f"{component_name} installed on {cluster_name}")
+        return component
+
+    def uninstall(self, cluster_name: str, component_name: str) -> None:
+        cluster = self.repos.clusters.get_by_name(cluster_name)
+        existing = self.repos.components.find(cluster_id=cluster.id,
+                                              name=component_name)
+        if not existing:
+            raise NotFoundError(kind="component", name=component_name)
+        component = existing[0]
+        component.status = "Uninstalled"
+        self.repos.components.save(component)
+        self.events.emit(cluster.id, "Normal", "ComponentUninstalled",
+                         f"{component_name} removed from {cluster_name}")
+
+    def _context(self, cluster, component: ClusterComponent) -> AdmContext:
+        plan = (
+            self.repos.plans.get(cluster.plan_id) if cluster.plan_id else None
+        )
+        return AdmContext(
+            cluster=cluster,
+            nodes=self.repos.nodes.find(cluster_id=cluster.id),
+            hosts_by_id={
+                h.id: h for h in self.repos.hosts.find(cluster_id=cluster.id)
+            },
+            credentials_by_id={c.id: c for c in self.repos.credentials.list()},
+            plan=plan,
+            extra_vars=dict(component.vars),
+            log_sink=lambda task_id, line: self.repos.task_logs.append(
+                cluster.id, task_id, [line]
+            ),
+            save_cluster=lambda c: self.repos.clusters.save(c),
+        )

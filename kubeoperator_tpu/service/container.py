@@ -1,0 +1,89 @@
+"""Service container/wiring (reference boot parity: config → DB+migrations →
+repos → services → cron, SURVEY.md §2.1 rows 1b/1f)."""
+
+from __future__ import annotations
+
+from kubeoperator_tpu.executor import Executor, make_executor
+from kubeoperator_tpu.provisioner import FakeProvisioner, TerraformProvisioner, terraform_available
+from kubeoperator_tpu.repository import Database, Repositories
+from kubeoperator_tpu.utils.config import Config, load_config
+from kubeoperator_tpu.utils.logging import setup_logging
+
+
+class Services:
+    def __init__(
+        self,
+        config: Config,
+        repos: Repositories,
+        executor: Executor,
+        provisioner: TerraformProvisioner,
+    ) -> None:
+        from kubeoperator_tpu.service.backup import BackupService
+        from kubeoperator_tpu.service.cluster import ClusterService
+        from kubeoperator_tpu.service.component import ComponentService
+        from kubeoperator_tpu.service.cron import CronService
+        from kubeoperator_tpu.service.event import EventService, MessageService
+        from kubeoperator_tpu.service.health import HealthService
+        from kubeoperator_tpu.service.infra import (
+            CredentialService,
+            HostService,
+            PlanService,
+            RegionService,
+            ZoneService,
+        )
+        from kubeoperator_tpu.service.node import NodeService
+        from kubeoperator_tpu.service.tenancy import ProjectService, UserService
+        from kubeoperator_tpu.service.upgrade import UpgradeService
+
+        self.config = config
+        self.repos = repos
+        self.executor = executor
+        self.provisioner = provisioner
+
+        self.events = EventService(repos)
+        self.messages = MessageService(repos)
+        self.credentials = CredentialService(repos)
+        self.regions = RegionService(repos)
+        self.zones = ZoneService(repos)
+        self.plans = PlanService(repos)
+        self.hosts = HostService(repos, executor)
+        self.users = UserService(repos, config)
+        self.projects = ProjectService(repos)
+        self.clusters = ClusterService(
+            repos, executor, provisioner, self.events, config
+        )
+        self.nodes = NodeService(repos, executor, provisioner, self.events)
+        self.upgrades = UpgradeService(repos, executor, self.events)
+        self.backups = BackupService(repos, executor, self.events)
+        self.health = HealthService(repos, executor, self.events)
+        self.components = ComponentService(repos, executor, self.events)
+        self.cron = CronService(self)
+
+    def close(self) -> None:
+        self.cron.stop()
+        self.repos.db.close()
+
+
+def build_services(
+    config: Config | None = None, simulate: bool | None = None
+) -> Services:
+    """Wire the full stack. `simulate=None` auto-detects: real backends when
+    the binaries exist, simulation otherwise (air-gapped demo parity)."""
+    config = config or load_config()
+    setup_logging(
+        config.get("logging.level", "INFO"), config.get("logging.dir")
+    )
+    db = Database(config.get("db.path", "ko_tpu.db"))
+    repos = Repositories(db)
+    backend = config.get("executor.backend", "auto")
+    executor = make_executor(backend, config.get("executor.project_dir"))
+    if simulate is None:
+        simulate = not terraform_available(
+            config.get("provisioner.terraform_bin", "terraform")
+        )
+    prov_cls = FakeProvisioner if simulate else TerraformProvisioner
+    provisioner = prov_cls(
+        work_dir=config.get("provisioner.work_dir", "terraform_runs"),
+        terraform_bin=config.get("provisioner.terraform_bin", "terraform"),
+    )
+    return Services(config, repos, executor, provisioner)

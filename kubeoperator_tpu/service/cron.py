@@ -1,0 +1,119 @@
+"""CronService — the background scheduler (reference `pkg/cron`, SURVEY.md
+§2.1 row 1f): cron-driven etcd backups per strategy + periodic health checks.
+
+A single ticker thread evaluates 5-field cron expressions each minute —
+dependency-free, air-gap friendly.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from datetime import datetime
+
+from kubeoperator_tpu.utils.logging import get_logger
+
+log = get_logger("service.cron")
+
+
+def cron_matches(expr: str, dt: datetime) -> bool:
+    """Evaluate a 5-field cron expr (min hour dom month dow) at dt.
+    Supports *, N, */N, and comma lists."""
+    fields = expr.split()
+    if len(fields) != 5:
+        return False
+    # cron dow: 0/7 = sunday; python weekday(): mon=0..sun=6
+    cron_dow = (dt.weekday() + 1) % 7
+    values = (dt.minute, dt.hour, dt.day, dt.month, cron_dow)
+
+    def match(field: str, value: int) -> bool:
+        for part in field.split(","):
+            if part == "*":
+                return True
+            if part.startswith("*/"):
+                try:
+                    step = int(part[2:])
+                except ValueError:
+                    return False
+                if step > 0 and value % step == 0:
+                    return True
+            else:
+                try:
+                    if int(part) == value or (
+                        value == 0 and part == "7"
+                    ):  # sunday alias
+                        return True
+                except ValueError:
+                    return False
+        return False
+
+    return all(match(f, v) for f, v in zip(fields, values))
+
+
+class CronService:
+    def __init__(self, services) -> None:
+        self.services = services
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._last_minute: str = ""
+        self._health_last = 0.0
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        log.info("cron scheduler started")
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None
+
+    # ---- one scheduler tick (public for tests) ----
+    def tick(self, now: datetime | None = None) -> list[str]:
+        """Run whatever is due at `now`; returns actions taken."""
+        now = now or datetime.now()
+        actions: list[str] = []
+        cfg = self.services.config
+        if cfg.get("cron.backup_enabled", True):
+            for strategy in self.services.repos.backup_strategies.list():
+                if not strategy.enabled:
+                    continue
+                if not cron_matches(strategy.cron, now):
+                    continue
+                try:
+                    cluster = self.services.repos.clusters.get(strategy.cluster_id)
+                except Exception:
+                    continue
+                try:
+                    self.services.backups.run_backup(cluster.name)
+                    actions.append(f"backup:{cluster.name}")
+                except Exception as e:
+                    log.warning("scheduled backup failed for %s: %s",
+                                cluster.name, e)
+                    actions.append(f"backup-failed:{cluster.name}")
+
+        interval = float(cfg.get("cron.health_check_interval_s", 300))
+        if interval > 0 and time.time() - self._health_last >= interval:
+            self._health_last = time.time()
+            for cluster in self.services.repos.clusters.find(phase="Ready"):
+                try:
+                    self.services.health.check(cluster.name)
+                    actions.append(f"health:{cluster.name}")
+                except Exception as e:
+                    log.warning("health check failed for %s: %s",
+                                cluster.name, e)
+        return actions
+
+    def _loop(self) -> None:
+        while not self._stop.wait(10.0):
+            minute = datetime.now().strftime("%Y%m%d%H%M")
+            if minute == self._last_minute:
+                continue
+            self._last_minute = minute
+            try:
+                self.tick()
+            except Exception:
+                log.exception("cron tick crashed")

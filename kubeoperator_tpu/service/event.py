@@ -1,0 +1,78 @@
+"""Events + message center (SURVEY.md §5.5, §1): cluster event rows feed the
+UI timeline; messages fan out to subscribed users (in-app always; email/
+webhook senders pluggable)."""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from kubeoperator_tpu.models import Event, Message
+from kubeoperator_tpu.repository import Repositories
+from kubeoperator_tpu.utils.logging import get_logger
+
+log = get_logger("service.event")
+
+
+class EventService:
+    def __init__(self, repos: Repositories):
+        self.repos = repos
+        self._subscribers: list[Callable[[Event], None]] = []
+
+    def emit(self, cluster_id: str, type_: str, reason: str, message: str) -> Event:
+        event = Event(cluster_id=cluster_id, type=type_, reason=reason,
+                      message=message)
+        self.repos.events.save(event)
+        log.info("event %s/%s: %s", type_, reason, message)
+        for sub in self._subscribers:
+            try:
+                sub(event)
+            except Exception:  # a broken subscriber must not break the flow
+                log.exception("event subscriber failed")
+        return event
+
+    def subscribe(self, fn: Callable[[Event], None]) -> None:
+        self._subscribers.append(fn)
+
+    def list(self, cluster_id: str) -> list[Event]:
+        return self.repos.events.find(cluster_id=cluster_id)
+
+
+class MessageService:
+    """In-app notifications; Warning events auto-notify subscribed users."""
+
+    def __init__(self, repos: Repositories):
+        self.repos = repos
+        # sender name -> callable(message) for email/webhook integrations
+        self.senders: dict[str, Callable[[Message], None]] = {}
+
+    def attach_to(self, events: EventService) -> None:
+        events.subscribe(self._on_event)
+
+    def _on_event(self, event) -> None:
+        if event.type != "Warning":
+            return
+        for user in self.repos.users.list():
+            if user.is_admin:
+                self.notify(user.id, f"[{event.reason}]", event.message,
+                            level="warning")
+
+    def notify(self, user_id: str, title: str, content: str,
+               level: str = "info") -> Message:
+        message = Message(user_id=user_id, title=title, content=content,
+                         level=level)
+        self.repos.messages.save(message)
+        for sender in self.senders.values():
+            try:
+                sender(message)
+            except Exception:
+                log.exception("message sender failed")
+        return message
+
+    def inbox(self, user_id: str, unread_only: bool = False) -> list[Message]:
+        msgs = self.repos.messages.find(user_id=user_id)
+        return [m for m in msgs if not (unread_only and m.read)]
+
+    def mark_read(self, message_id: str) -> None:
+        message = self.repos.messages.get(message_id)
+        message.read = True
+        self.repos.messages.save(message)

@@ -1,0 +1,132 @@
+"""HealthService — probes + guided recovery (SURVEY.md §5.3).
+
+Probes: API server /healthz, node Ready set, etcd endpoint health, and —
+TPU-specific, before any smoke test is trusted — device-plugin allocatable
+chips vs the plan topology (SURVEY.md §5.3 'TPU-specific probes').
+Each probe maps to a guided recovery action (re-run the matching adm phase).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from kubeoperator_tpu.adm import AdmContext, ClusterAdm
+from kubeoperator_tpu.adm.engine import Phase
+from kubeoperator_tpu.adm.phases import smoke_post
+from kubeoperator_tpu.executor import Executor
+from kubeoperator_tpu.repository import Repositories
+from kubeoperator_tpu.utils.errors import PhaseError
+
+
+@dataclass
+class ProbeResult:
+    name: str
+    ok: bool
+    detail: str = ""
+    recovery: str = ""   # suggested action key
+
+
+@dataclass
+class HealthReport:
+    cluster: str
+    healthy: bool
+    probes: list = field(default_factory=list)
+
+    def to_dict(self) -> dict:
+        return {
+            "cluster": self.cluster,
+            "healthy": self.healthy,
+            "probes": [p.__dict__ for p in self.probes],
+        }
+
+
+# probe name -> (playbook, condition) used for guided recovery
+RECOVERY_ACTIONS = {
+    "apiserver": ("07-kube-master.yml", "kube-master"),
+    "nodes": ("08-kube-worker.yml", "kube-worker"),
+    "etcd": ("05-etcd.yml", "etcd"),
+    "tpu-device-plugin": ("16-tpu-runtime.yml", "tpu-runtime"),
+    "tpu-smoke": ("17-tpu-smoke-test.yml", "tpu-smoke-test"),
+}
+
+
+class HealthService:
+    def __init__(self, repos: Repositories, executor: Executor, events):
+        self.repos = repos
+        self.executor = executor
+        self.events = events
+        self.adm = ClusterAdm(executor)
+
+    def check(self, cluster_name: str) -> HealthReport:
+        """Adhoc-probe the cluster through the executor boundary."""
+        cluster = self.repos.clusters.get_by_name(cluster_name)
+        inv = self._inventory(cluster)
+        probes: list[ProbeResult] = []
+
+        checks = [
+            ("apiserver",
+             "kubectl --kubeconfig /etc/kubernetes/admin.conf get --raw /healthz"),
+            ("nodes",
+             "kubectl --kubeconfig /etc/kubernetes/admin.conf get nodes"),
+            ("etcd", "etcdctl endpoint health --cluster"),
+        ]
+        if cluster.spec.tpu_enabled:
+            checks.append((
+                "tpu-device-plugin",
+                "kubectl --kubeconfig /etc/kubernetes/admin.conf -n kube-system "
+                "rollout status daemonset/ko-tpu-device-plugin --timeout=5s",
+            ))
+        for name, cmd in checks:
+            task_id = self.executor.run_adhoc("command", cmd, inv,
+                                              pattern="kube-master")
+            result = self.executor.wait(task_id, timeout_s=120)
+            probes.append(ProbeResult(
+                name=name, ok=result.ok,
+                detail=result.message if not result.ok else "",
+                recovery=RECOVERY_ACTIONS.get(name, ("", ""))[1],
+            ))
+
+        healthy = all(p.ok for p in probes)
+        report = HealthReport(cluster=cluster_name, healthy=healthy,
+                              probes=probes)
+        if not healthy:
+            bad = ", ".join(p.name for p in probes if not p.ok)
+            self.events.emit(cluster.id, "Warning", "HealthDegraded",
+                             f"failed probes: {bad}")
+        return report
+
+    def recover(self, cluster_name: str, probe_name: str) -> None:
+        """Guided recovery: re-run the adm phase behind a failed probe."""
+        if probe_name not in RECOVERY_ACTIONS:
+            raise PhaseError(probe_name, f"no recovery action for {probe_name}")
+        playbook, condition = RECOVERY_ACTIONS[probe_name]
+        cluster = self.repos.clusters.get_by_name(cluster_name)
+        plan = (
+            self.repos.plans.get(cluster.plan_id) if cluster.plan_id else None
+        )
+        ctx = AdmContext(
+            cluster=cluster,
+            nodes=self.repos.nodes.find(cluster_id=cluster.id),
+            hosts_by_id={
+                h.id: h for h in self.repos.hosts.find(cluster_id=cluster.id)
+            },
+            credentials_by_id={c.id: c for c in self.repos.credentials.list()},
+            plan=plan,
+            log_sink=lambda task_id, line: self.repos.task_logs.append(
+                cluster.id, task_id, [line]
+            ),
+            save_cluster=lambda c: self.repos.clusters.save(c),
+        )
+        post = smoke_post if condition == "tpu-smoke-test" else None
+        self.adm.run(ctx, [Phase(condition, playbook, post=post)])
+        self.events.emit(cluster.id, "Normal", "Recovered",
+                         f"recovery phase {condition} completed")
+
+    def _inventory(self, cluster) -> dict:
+        from kubeoperator_tpu.executor.inventory import build_inventory
+
+        return build_inventory(
+            self.repos.nodes.find(cluster_id=cluster.id),
+            {h.id: h for h in self.repos.hosts.find(cluster_id=cluster.id)},
+            {c.id: c for c in self.repos.credentials.list()},
+        )

@@ -1,0 +1,170 @@
+"""CRUD services for the IaaS-side schema: credentials, regions, zones,
+plans, hosts (SURVEY.md §2.1 row 1b: region/zone/plan/host/credential
+services)."""
+
+from __future__ import annotations
+
+from kubeoperator_tpu.executor import Executor
+from kubeoperator_tpu.models import Credential, Host, Plan, Region, Zone
+from kubeoperator_tpu.repository import Repositories
+from kubeoperator_tpu.utils.errors import ConflictError, NotFoundError, ValidationError
+
+
+class _Crud:
+    """Shared create/list/get/delete with validation; subclasses pin repo."""
+
+    kind = "entity"
+
+    def __init__(self, repos: Repositories) -> None:
+        self.repos = repos
+
+    @property
+    def repo(self):
+        raise NotImplementedError
+
+    def _pre_save(self, obj) -> None:
+        pass
+
+    def create(self, obj):
+        obj.validate()
+        self._pre_save(obj)
+        try:
+            self.repo.get_by_name(obj.name)
+            raise ConflictError(kind=self.kind, name=obj.name)
+        except NotFoundError:
+            pass
+        return self.repo.save(obj)
+
+    def update(self, obj):
+        obj.validate()
+        self._pre_save(obj)
+        self.repo.get(obj.id)  # must exist
+        return self.repo.save(obj)
+
+    def get(self, name: str):
+        return self.repo.get_by_name(name)
+
+    def list(self):
+        return self.repo.list()
+
+    def delete(self, name: str) -> None:
+        self.repo.delete(self.repo.get_by_name(name).id)
+
+
+class CredentialService(_Crud):
+    kind = "credential"
+
+    @property
+    def repo(self):
+        return self.repos.credentials
+
+
+class RegionService(_Crud):
+    kind = "region"
+
+    @property
+    def repo(self):
+        return self.repos.regions
+
+
+class ZoneService(_Crud):
+    kind = "zone"
+
+    @property
+    def repo(self):
+        return self.repos.zones
+
+    def _pre_save(self, zone: Zone) -> None:
+        self.repos.regions.get(zone.region_id)  # referenced region must exist
+
+    def list_for_region(self, region_name: str) -> list[Zone]:
+        region = self.repos.regions.get_by_name(region_name)
+        return self.repos.zones.find(region_id=region.id)
+
+
+class PlanService(_Crud):
+    kind = "plan"
+
+    @property
+    def repo(self):
+        return self.repos.plans
+
+    def _pre_save(self, plan: Plan) -> None:
+        if plan.region_id:
+            self.repos.regions.get(plan.region_id)
+        for zid in plan.zone_ids:
+            self.repos.zones.get(zid)
+        # TPU plans: worker_count 0 means derive; normalize at save so the
+        # UI/API always see the real host count
+        if plan.has_tpu() and plan.worker_count == 0:
+            plan.worker_count = plan.topology().total_hosts
+
+    def tpu_catalog(self) -> list[dict]:
+        """Selectable slice shapes for the UI wizard (topology first-class)."""
+        from kubeoperator_tpu.parallel.topology import (
+            GENERATIONS,
+            parse_accelerator_type,
+        )
+
+        catalog = []
+        for gen in GENERATIONS.values():
+            sizes = sorted(set(gen.single_host_chip_sizes) | {16, 32, 64})
+            for chips in sizes:
+                if chips > gen.max_chips or (
+                    chips not in gen.single_host_chip_sizes
+                    and chips % gen.chips_per_host
+                ):
+                    continue
+                topo = parse_accelerator_type(
+                    f"{gen.name}-{gen.suffix_from_chips(chips)}"
+                )
+                catalog.append(topo.to_dict())
+        return catalog
+
+
+class HostService(_Crud):
+    kind = "host"
+
+    def __init__(self, repos: Repositories, executor: Executor) -> None:
+        super().__init__(repos)
+        self.executor = executor
+
+    @property
+    def repo(self):
+        return self.repos.hosts
+
+    def _pre_save(self, host: Host) -> None:
+        if host.credential_id:
+            self.repos.credentials.get(host.credential_id)
+
+    def register(
+        self, name: str, ip: str, credential_name: str, port: int = 22
+    ) -> Host:
+        """Manual-mode host registration (SURVEY.md §1 'Manual (bare-metal)')."""
+        cred = self.repos.credentials.get_by_name(credential_name)
+        host = Host(name=name, ip=ip, port=port, credential_id=cred.id)
+        return self.create(host)
+
+    def gather_facts(self, name: str) -> Host:
+        """Probe the host over the executor (adhoc setup/ping)."""
+        host = self.repo.get_by_name(name)
+        cred = (
+            self.repos.credentials.get(host.credential_id)
+            if host.credential_id else None
+        )
+        inv = {
+            "all": {
+                "hosts": {
+                    host.name: {
+                        "ansible_host": host.ip,
+                        "ansible_port": host.port,
+                        **({"ansible_user": cred.username} if cred else {}),
+                    }
+                },
+                "children": {},
+            }
+        }
+        task_id = self.executor.run_adhoc("ping", "", inv)
+        result = self.executor.wait(task_id, timeout_s=120)
+        host.status = "Ready" if result.ok else "Failed"
+        return self.repo.save(host)

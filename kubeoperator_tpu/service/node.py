@@ -1,0 +1,132 @@
+"""NodeService — scale up/down (SURVEY.md §3.3).
+
+Scale-up: [plan mode] terraform re-apply with count+N → new Hosts → join
+phases limited to the new nodes. Scale-down: drain + remove + [plan mode]
+terraform shrink. TPU plans scale in whole slices (num_slices±1) — chips
+inside a slice are indivisible, a TPU-first rule with no GPU analog.
+"""
+
+from __future__ import annotations
+
+from kubeoperator_tpu.adm import AdmContext, ClusterAdm, scale_down_phases, scale_up_phases
+from kubeoperator_tpu.executor import Executor
+from kubeoperator_tpu.models import Node, NodeRole
+from kubeoperator_tpu.models.cluster import ClusterPhaseStatus
+from kubeoperator_tpu.repository import Repositories
+from kubeoperator_tpu.utils.errors import NotFoundError, PhaseError, ValidationError
+from kubeoperator_tpu.utils.logging import get_logger
+
+log = get_logger("service.node")
+
+
+class NodeService:
+    def __init__(self, repos: Repositories, executor: Executor, provisioner, events):
+        self.repos = repos
+        self.executor = executor
+        self.provisioner = provisioner
+        self.events = events
+        self.adm = ClusterAdm(executor)
+
+    def list(self, cluster_name: str) -> list[Node]:
+        cluster = self.repos.clusters.get_by_name(cluster_name)
+        return self.repos.nodes.find(cluster_id=cluster.id)
+
+    def scale_up(self, cluster_name: str, host_names: list[str]) -> list[Node]:
+        """Manual-mode scale-up: join registered hosts as workers."""
+        cluster = self.repos.clusters.get_by_name(cluster_name)
+        if cluster.spec.tpu_enabled:
+            raise ValidationError(
+                "TPU clusters scale in whole slices via their plan "
+                "(num_slices), not per-host"
+            )
+        if not host_names:
+            raise ValidationError("scale_up requires host names")
+        new_nodes: list[Node] = []
+        for hname in host_names:
+            host = self.repos.hosts.get_by_name(hname)
+            if host.cluster_id and host.cluster_id != cluster.id:
+                raise ValidationError(f"host {hname} already belongs to a cluster")
+            host.cluster_id = cluster.id
+            self.repos.hosts.save(host)
+            node = Node(name=host.name, cluster_id=cluster.id, host_id=host.id,
+                        role=NodeRole.WORKER.value, status="Joining")
+            self.repos.nodes.save(node)
+            new_nodes.append(node)
+
+        cluster.status.phase = ClusterPhaseStatus.SCALING.value
+        self.repos.clusters.save(cluster)
+        ctx = self._context(cluster)
+        ctx.new_node_names = {n.name for n in new_nodes}
+        try:
+            self.adm.run(ctx, scale_up_phases())
+        except PhaseError:
+            for node in new_nodes:
+                node.status = "Failed"
+                self.repos.nodes.save(node)
+            cluster.status.phase = ClusterPhaseStatus.FAILED.value
+            self.repos.clusters.save(cluster)
+            raise
+        for node in new_nodes:
+            node.status = "Ready"
+            self.repos.nodes.save(node)
+        cluster.status.phase = ClusterPhaseStatus.READY.value
+        self.repos.clusters.save(cluster)
+        self.events.emit(cluster.id, "Normal", "NodesJoined",
+                         f"{len(new_nodes)} workers joined {cluster_name}")
+        return new_nodes
+
+    def scale_down(self, cluster_name: str, node_name: str) -> None:
+        cluster = self.repos.clusters.get_by_name(cluster_name)
+        nodes = self.repos.nodes.find(cluster_id=cluster.id, name=node_name)
+        if not nodes:
+            raise NotFoundError(kind="node", name=node_name)
+        node = nodes[0]
+        if node.role == NodeRole.MASTER.value:
+            raise ValidationError("cannot remove a master node")
+        workers = [
+            n for n in self.repos.nodes.find(cluster_id=cluster.id)
+            if n.role == NodeRole.WORKER.value
+        ]
+        if len(workers) <= 1:
+            raise ValidationError("cannot remove the last worker")
+
+        cluster.status.phase = ClusterPhaseStatus.SCALING.value
+        self.repos.clusters.save(cluster)
+        node.status = "Draining"
+        self.repos.nodes.save(node)
+        ctx = self._context(cluster)
+        ctx.extra_vars["leaving_node"] = node.name
+        try:
+            self.adm.run(ctx, scale_down_phases())
+        except PhaseError:
+            node.status = "Failed"
+            self.repos.nodes.save(node)
+            cluster.status.phase = ClusterPhaseStatus.FAILED.value
+            self.repos.clusters.save(cluster)
+            raise
+        host = self.repos.hosts.get(node.host_id)
+        host.cluster_id = ""
+        self.repos.hosts.save(host)
+        self.repos.nodes.delete(node.id)
+        cluster.status.phase = ClusterPhaseStatus.READY.value
+        self.repos.clusters.save(cluster)
+        self.events.emit(cluster.id, "Normal", "NodeRemoved",
+                         f"node {node_name} drained and removed")
+
+    def _context(self, cluster) -> AdmContext:
+        plan = (
+            self.repos.plans.get(cluster.plan_id) if cluster.plan_id else None
+        )
+        return AdmContext(
+            cluster=cluster,
+            nodes=self.repos.nodes.find(cluster_id=cluster.id),
+            hosts_by_id={
+                h.id: h for h in self.repos.hosts.find(cluster_id=cluster.id)
+            },
+            credentials_by_id={c.id: c for c in self.repos.credentials.list()},
+            plan=plan,
+            log_sink=lambda task_id, line: self.repos.task_logs.append(
+                cluster.id, task_id, [line]
+            ),
+            save_cluster=lambda c: self.repos.clusters.save(c),
+        )

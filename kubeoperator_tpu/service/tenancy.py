@@ -1,0 +1,156 @@
+"""Tenancy & auth services: users (local + LDAP-gated), sessions, projects,
+RBAC (SURVEY.md §1 'Multi-tenancy & auth')."""
+
+from __future__ import annotations
+
+import secrets
+
+from kubeoperator_tpu.models import Project, ProjectMember, Role, User
+from kubeoperator_tpu.models.tenancy import hash_password, verify_password
+from kubeoperator_tpu.repository import Repositories
+from kubeoperator_tpu.utils.config import Config
+from kubeoperator_tpu.utils.errors import (
+    AuthError,
+    ConflictError,
+    ForbiddenError,
+    NotFoundError,
+    ValidationError,
+)
+from kubeoperator_tpu.utils.ids import now_ts
+
+
+class UserService:
+    def __init__(self, repos: Repositories, config: Config):
+        self.repos = repos
+        self.session_ttl = float(config.get("server.session_ttl_s", 3600))
+        self._sessions: dict[str, tuple[str, float]] = {}  # token -> (uid, exp)
+
+    def create(self, name: str, password: str = "", email: str = "",
+               is_admin: bool = False, source: str = "local") -> User:
+        try:
+            self.repos.users.get_by_name(name)
+            raise ConflictError(kind="user", name=name)
+        except NotFoundError:
+            pass
+        user = User(
+            name=name, email=email, is_admin=is_admin, source=source,
+            password_hash=hash_password(password) if password else "",
+        )
+        user.validate()
+        return self.repos.users.save(user)
+
+    def ensure_admin(self) -> User:
+        """First-boot default admin (reference ships admin/kubeoperator@admin123
+        [upstream — UNVERIFIED]; we generate and log a random password instead
+        of shipping a fixed one)."""
+        try:
+            return self.repos.users.get_by_name("admin")
+        except NotFoundError:
+            password = secrets.token_urlsafe(12)
+            user = self.create("admin", password=password, is_admin=True)
+            from kubeoperator_tpu.utils.logging import get_logger
+
+            get_logger("service.user").warning(
+                "created default admin user; initial password: %s", password
+            )
+            return user
+
+    def login(self, name: str, password: str) -> str:
+        try:
+            user = self.repos.users.get_by_name(name)
+        except NotFoundError:
+            raise AuthError()
+        if not user.active:
+            raise AuthError()
+        if user.source == "ldap":
+            # LDAP bind requires a directory client; explicitly unsupported
+            # until one is wired (stub per SURVEY.md §7 'What NOT to rebuild').
+            raise AuthError(message="ldap authentication not configured")
+        if not verify_password(password, user.password_hash):
+            raise AuthError()
+        token = secrets.token_urlsafe(32)
+        self._sessions[token] = (user.id, now_ts() + self.session_ttl)
+        return token
+
+    def logout(self, token: str) -> None:
+        self._sessions.pop(token, None)
+
+    def authenticate(self, token: str) -> User:
+        entry = self._sessions.get(token)
+        if entry is None:
+            raise AuthError()
+        uid, exp = entry
+        if now_ts() > exp:
+            del self._sessions[token]
+            raise AuthError(message="session expired")
+        return self.repos.users.get(uid)
+
+    def change_password(self, name: str, old: str, new: str) -> None:
+        user = self.repos.users.get_by_name(name)
+        if not verify_password(old, user.password_hash):
+            raise AuthError()
+        if len(new) < 8:
+            raise ValidationError("password must be >= 8 characters")
+        user.password_hash = hash_password(new)
+        self.repos.users.save(user)
+
+    def list(self) -> list[User]:
+        return self.repos.users.list()
+
+
+class ProjectService:
+    def __init__(self, repos: Repositories):
+        self.repos = repos
+
+    def create(self, name: str, description: str = "") -> Project:
+        try:
+            self.repos.projects.get_by_name(name)
+            raise ConflictError(kind="project", name=name)
+        except NotFoundError:
+            pass
+        project = Project(name=name, description=description)
+        project.validate()
+        return self.repos.projects.save(project)
+
+    def list(self) -> list[Project]:
+        return self.repos.projects.list()
+
+    def get(self, name: str) -> Project:
+        return self.repos.projects.get_by_name(name)
+
+    def delete(self, name: str) -> None:
+        project = self.get(name)
+        if self.repos.clusters.find(project_id=project.id):
+            raise ValidationError(
+                f"project {name} still owns clusters; delete them first"
+            )
+        self.repos.projects.delete(project.id)
+
+    def add_member(self, project_name: str, user_name: str,
+                   role: str = Role.VIEWER.value) -> ProjectMember:
+        project = self.get(project_name)
+        user = self.repos.users.get_by_name(user_name)
+        Role(role)
+        existing = self.repos.project_members.find(
+            project_id=project.id, user_id=user.id
+        )
+        member = existing[0] if existing else ProjectMember(
+            project_id=project.id, user_id=user.id
+        )
+        member.role = role
+        member.validate()
+        return self.repos.project_members.save(member)
+
+    def role_of(self, user: User, project_id: str) -> Role | None:
+        if user.is_admin:
+            return Role.ADMIN
+        members = self.repos.project_members.find(
+            project_id=project_id, user_id=user.id
+        )
+        return Role(members[0].role) if members else None
+
+    def require(self, user: User, project_id: str, needed: Role) -> None:
+        """RBAC gate used by the API layer (reference `pkg/permission`)."""
+        role = self.role_of(user, project_id)
+        if role is None or not role.allows(needed):
+            raise ForbiddenError(action=f"{needed.value} on project")

@@ -1,0 +1,77 @@
+"""UpgradeService — K8s version upgrade (SURVEY.md §3.4): one-minor-hop gate,
+then adm upgrade phases (masters serial, workers rolling)."""
+
+from __future__ import annotations
+
+from kubeoperator_tpu.adm import AdmContext, ClusterAdm, upgrade_phases
+from kubeoperator_tpu.executor import Executor
+from kubeoperator_tpu.models.cluster import ClusterPhaseStatus
+from kubeoperator_tpu.repository import Repositories
+from kubeoperator_tpu.utils.errors import PhaseError, UpgradeError
+from kubeoperator_tpu.version import SUPPORTED_K8S_VERSIONS
+
+
+def _minor(version: str) -> int:
+    try:
+        return int(version.lstrip("v").split(".")[1])
+    except (IndexError, ValueError):
+        raise UpgradeError(message=f"unparseable k8s version {version!r}")
+
+
+class UpgradeService:
+    def __init__(self, repos: Repositories, executor: Executor, events):
+        self.repos = repos
+        self.events = events
+        self.adm = ClusterAdm(executor)
+
+    def validate_hop(self, current: str, target: str) -> None:
+        if target not in SUPPORTED_K8S_VERSIONS:
+            raise UpgradeError(
+                message=f"{target} not in supported bundle "
+                f"{SUPPORTED_K8S_VERSIONS}"
+            )
+        hop = _minor(target) - _minor(current)
+        if hop < 1:
+            raise UpgradeError(message=f"{target} is not newer than {current}")
+        if hop > 1:
+            raise UpgradeError(
+                message=f"upgrades must move one minor at a time "
+                f"({current} -> {target} is {hop})"
+            )
+
+    def upgrade(self, cluster_name: str, target_version: str):
+        cluster = self.repos.clusters.get_by_name(cluster_name)
+        self.validate_hop(cluster.spec.k8s_version, target_version)
+        cluster.status.phase = ClusterPhaseStatus.UPGRADING.value
+        self.repos.clusters.save(cluster)
+        ctx = AdmContext(
+            cluster=cluster,
+            nodes=self.repos.nodes.find(cluster_id=cluster.id),
+            hosts_by_id={
+                h.id: h for h in self.repos.hosts.find(cluster_id=cluster.id)
+            },
+            credentials_by_id={c.id: c for c in self.repos.credentials.list()},
+            plan=(
+                self.repos.plans.get(cluster.plan_id)
+                if cluster.plan_id else None
+            ),
+            extra_vars={"target_k8s_version": target_version},
+            log_sink=lambda task_id, line: self.repos.task_logs.append(
+                cluster.id, task_id, [line]
+            ),
+            save_cluster=lambda c: self.repos.clusters.save(c),
+        )
+        try:
+            self.adm.run(ctx, upgrade_phases())
+        except PhaseError as e:
+            cluster.status.phase = ClusterPhaseStatus.FAILED.value
+            cluster.status.message = e.message
+            self.repos.clusters.save(cluster)
+            self.events.emit(cluster.id, "Warning", "UpgradeFailed", e.message)
+            raise
+        cluster.spec.k8s_version = target_version
+        cluster.status.phase = ClusterPhaseStatus.READY.value
+        self.repos.clusters.save(cluster)
+        self.events.emit(cluster.id, "Normal", "UpgradeDone",
+                         f"{cluster_name} upgraded to {target_version}")
+        return cluster

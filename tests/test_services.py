@@ -1,0 +1,271 @@
+"""Service layer: the full SURVEY §3 call stacks against fake/simulation
+boundaries — create (manual + plan/TPU), retry-resume, scale, upgrade gate,
+backup/restore + cron, health probes, components, tenancy/RBAC."""
+
+from datetime import datetime
+
+import pytest
+
+from kubeoperator_tpu.models import BackupAccount, ClusterSpec, Plan, Region, Role, Zone
+from kubeoperator_tpu.service import build_services
+from kubeoperator_tpu.service.cron import cron_matches
+from kubeoperator_tpu.utils.config import load_config
+from kubeoperator_tpu.utils.errors import (
+    AuthError,
+    ForbiddenError,
+    PhaseError,
+    UpgradeError,
+    ValidationError,
+)
+
+
+@pytest.fixture()
+def svc(tmp_path):
+    config = load_config(
+        path="/nonexistent",
+        env={},
+        overrides={
+            "db": {"path": str(tmp_path / "svc.db")},
+            "executor": {"backend": "simulation"},
+            "provisioner": {"work_dir": str(tmp_path / "tf")},
+            "cron": {"health_check_interval_s": 0},
+        },
+    )
+    services = build_services(config, simulate=True)
+    yield services
+    services.close()
+
+
+def register_fleet(svc, n=3):
+    svc.credentials.create(
+        __import__("kubeoperator_tpu.models", fromlist=["Credential"]).Credential(
+            name="ssh", password="pw"
+        )
+    )
+    names = []
+    for i in range(n):
+        svc.hosts.register(f"host{i}", f"10.0.0.{i+1}", "ssh")
+        names.append(f"host{i}")
+    return names
+
+
+def make_tpu_plan(svc, tpu_type="v5e-16", num_slices=1) -> Plan:
+    region = svc.regions.create(Region(
+        name="gcp-us", provider="gcp_tpu_vm",
+        vars={"project": "p", "name": "us-central1"},
+    ))
+    zone = svc.zones.create(Zone(
+        name="us-central1-a", region_id=region.id,
+        vars={"gcp_zone": "us-central1-a"},
+    ))
+    plan = Plan(
+        name=f"tpu-{tpu_type}", provider="gcp_tpu_vm", region_id=region.id,
+        zone_ids=[zone.id], accelerator="tpu", tpu_type=tpu_type,
+        num_slices=num_slices, worker_count=0,
+    )
+    return svc.plans.create(plan)
+
+
+class TestManualCreate:
+    def test_end_to_end_manual_cpu(self, svc):
+        """SURVEY §7.4 minimum e2e slice: manual plan, 1 master + workers,
+        CPU-only -> Ready."""
+        names = register_fleet(svc, 3)
+        cluster = svc.clusters.create(
+            "demo", spec=ClusterSpec(worker_count=2),
+            host_names=names, wait=True,
+        )
+        cluster = svc.clusters.get("demo")
+        assert cluster.status.phase == "Ready"
+        assert cluster.status.first_unfinished() is None
+        assert len(svc.nodes.list("demo")) == 3
+        # create-to-Ready trace recorded (BASELINE metric 1)
+        assert cluster.status.total_duration_s() > 0
+        # task logs streamed + persisted
+        logs = svc.repos.task_logs.find(cluster_id=cluster.id)
+        assert len(logs) > 20
+
+    def test_duplicate_name_rejected(self, svc):
+        names = register_fleet(svc, 3)
+        svc.clusters.create("dup", spec=ClusterSpec(worker_count=2),
+                            host_names=names, wait=True)
+        with pytest.raises(Exception):
+            svc.clusters.create("dup", host_names=names, wait=True)
+
+    def test_failed_phase_then_retry_resumes(self, svc):
+        names = register_fleet(svc, 3)
+        svc.clusters.debug_extra_vars = {"__fail_at_task__": "install etcd"}
+        with pytest.raises(PhaseError):
+            svc.clusters.create("retryme", spec=ClusterSpec(worker_count=2),
+                                host_names=names, wait=True)
+        cluster = svc.clusters.get("retryme")
+        assert cluster.status.phase == "Failed"
+        assert cluster.status.first_unfinished() == "etcd"
+
+        svc.clusters.debug_extra_vars = {}
+        svc.clusters.retry("retryme", wait=True)
+        cluster = svc.clusters.get("retryme")
+        assert cluster.status.phase == "Ready"
+        assert cluster.status.first_unfinished() is None
+
+
+class TestPlanTpuCreate:
+    def test_north_star_plan_create(self, svc):
+        """`create --plan tpu-v5e-16` -> provision -> deploy -> smoke -> Ready."""
+        make_tpu_plan(svc)
+        cluster = svc.clusters.create(
+            "northstar", provision_mode="plan", plan_name="tpu-v5e-16",
+            wait=True,
+        )
+        cluster = svc.clusters.get("northstar")
+        assert cluster.status.phase == "Ready"
+        assert cluster.status.smoke_passed
+        assert cluster.status.smoke_chips == 16
+        assert cluster.status.smoke_gbps > 0
+        # provisioned hosts: 1 master + 4 TPU hosts with placement coords
+        hosts = svc.repos.hosts.find(cluster_id=cluster.id)
+        tpu_hosts = [h for h in hosts if h.tpu_chips > 0]
+        assert len(tpu_hosts) == 4
+        assert sorted(h.tpu_worker_id for h in tpu_hosts) == [0, 1, 2, 3]
+        conds = [c.name for c in cluster.status.conditions]
+        assert conds[-2:] == ["tpu-runtime", "tpu-smoke-test"]
+
+    def test_delete_plan_cluster_destroys_and_unbinds(self, svc):
+        make_tpu_plan(svc)
+        svc.clusters.create("gone", provision_mode="plan",
+                            plan_name="tpu-v5e-16", wait=True)
+        cluster = svc.clusters.get("gone")
+        svc.clusters.delete("gone", wait=True)
+        assert svc.provisioner.destroyed  # terraform destroy invoked
+        assert svc.repos.hosts.find(cluster_id=cluster.id) == []
+        with pytest.raises(Exception):
+            svc.clusters.get("gone")
+
+
+class TestScale:
+    def test_scale_up_and_down(self, svc):
+        names = register_fleet(svc, 4)
+        svc.clusters.create("scaleme", spec=ClusterSpec(worker_count=2),
+                            host_names=names[:3], wait=True)
+        new_nodes = svc.nodes.scale_up("scaleme", [names[3]])
+        assert [n.status for n in new_nodes] == ["Ready"]
+        assert len(svc.nodes.list("scaleme")) == 4
+        svc.nodes.scale_down("scaleme", names[3])
+        assert len(svc.nodes.list("scaleme")) == 3
+        host = svc.hosts.get(names[3])
+        assert host.cluster_id == ""
+
+    def test_cannot_remove_master_or_last_worker(self, svc):
+        names = register_fleet(svc, 2)
+        svc.clusters.create("tiny", spec=ClusterSpec(worker_count=1),
+                            host_names=names, wait=True)
+        with pytest.raises(ValidationError):
+            svc.nodes.scale_down("tiny", names[0])  # master
+        with pytest.raises(ValidationError):
+            svc.nodes.scale_down("tiny", names[1])  # last worker
+
+
+class TestUpgrade:
+    def test_one_minor_hop_gate(self, svc):
+        names = register_fleet(svc, 2)
+        svc.clusters.create(
+            "up", spec=ClusterSpec(worker_count=1, k8s_version="v1.28.15"),
+            host_names=names, wait=True,
+        )
+        with pytest.raises(UpgradeError):
+            svc.upgrades.upgrade("up", "v1.30.6")   # two hops
+        with pytest.raises(UpgradeError):
+            svc.upgrades.upgrade("up", "v1.27.16")  # downgrade
+        cluster = svc.upgrades.upgrade("up", "v1.29.10")
+        assert cluster.spec.k8s_version == "v1.29.10"
+        assert cluster.status.phase == "Ready"
+
+
+class TestBackup:
+    def test_backup_restore_and_cron(self, svc):
+        names = register_fleet(svc, 2)
+        svc.clusters.create("bk", spec=ClusterSpec(worker_count=1),
+                            host_names=names, wait=True)
+        svc.backups.create_account(BackupAccount(name="local", type="local"))
+        svc.backups.set_strategy("bk", "local", cron="30 3 * * *")
+        record = svc.backups.run_backup("bk")
+        assert record.status == "Uploaded"
+        assert len(svc.backups.list_files("bk")) == 1
+        svc.backups.restore("bk", record.name)
+        assert svc.backups.list_files("bk")[0].status == "Restored"
+
+        # cron fires exactly at the strategy time
+        actions = svc.cron.tick(datetime(2026, 7, 29, 3, 30))
+        assert "backup:bk" in actions
+        assert svc.cron.tick(datetime(2026, 7, 29, 4, 30)) == []
+
+    def test_cron_matcher(self):
+        assert cron_matches("30 3 * * *", datetime(2026, 7, 29, 3, 30))
+        assert not cron_matches("30 3 * * *", datetime(2026, 7, 29, 3, 31))
+        assert cron_matches("*/15 * * * *", datetime(2026, 7, 29, 1, 45))
+        assert cron_matches("0 0 * * 0", datetime(2026, 7, 26, 0, 0))  # sunday
+        assert not cron_matches("bogus", datetime.now())
+
+
+class TestHealth:
+    def test_probes_and_recovery(self, svc):
+        names = register_fleet(svc, 2)
+        svc.clusters.create("hc", spec=ClusterSpec(worker_count=1),
+                            host_names=names, wait=True)
+        report = svc.health.check("hc")
+        assert report.healthy
+        assert {p.name for p in report.probes} == {"apiserver", "nodes", "etcd"}
+        svc.health.recover("hc", "etcd")  # re-runs the etcd phase
+        cluster = svc.clusters.get("hc")
+        assert cluster.status.condition("etcd").status == "OK"
+
+    def test_tpu_probe_included(self, svc):
+        make_tpu_plan(svc)
+        svc.clusters.create("tph", provision_mode="plan",
+                            plan_name="tpu-v5e-16", wait=True)
+        report = svc.health.check("tph")
+        assert "tpu-device-plugin" in {p.name for p in report.probes}
+
+
+class TestComponents:
+    def test_install_component(self, svc):
+        names = register_fleet(svc, 2)
+        svc.clusters.create("comp", spec=ClusterSpec(worker_count=1),
+                            host_names=names, wait=True)
+        component = svc.components.install("comp", "prometheus")
+        assert component.status == "Installed"
+        assert [c.name for c in svc.components.list("comp")] == ["prometheus"]
+        with pytest.raises(ValidationError):
+            svc.components.install("comp", "gpu")
+
+
+class TestTenancy:
+    def test_auth_and_rbac(self, svc):
+        svc.users.create("alice", password="wonderland1", is_admin=False)
+        token = svc.users.login("alice", "wonderland1")
+        user = svc.users.authenticate(token)
+        assert user.name == "alice"
+        with pytest.raises(AuthError):
+            svc.users.login("alice", "wrong")
+
+        project = svc.projects.create("team-tpu")
+        with pytest.raises(ForbiddenError):
+            svc.projects.require(user, project.id, Role.VIEWER)
+        svc.projects.add_member("team-tpu", "alice", "manager")
+        svc.projects.require(user, project.id, Role.MANAGER)
+        with pytest.raises(ForbiddenError):
+            svc.projects.require(user, project.id, Role.ADMIN)
+
+    def test_ensure_admin_idempotent(self, svc):
+        admin1 = svc.users.ensure_admin()
+        admin2 = svc.users.ensure_admin()
+        assert admin1.id == admin2.id
+        assert admin1.is_admin
+
+    def test_warning_events_notify_admins(self, svc):
+        svc.users.ensure_admin()
+        svc.messages.attach_to(svc.events)
+        svc.events.emit("c1", "Warning", "TestReason", "something broke")
+        admin = svc.users.list()[0]
+        inbox = svc.messages.inbox(admin.id)
+        assert len(inbox) == 1 and "TestReason" in inbox[0].title
