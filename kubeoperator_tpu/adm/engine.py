@@ -50,6 +50,26 @@ class AdmContext:
     log_sink: Callable[[str, str], None] = lambda task_id, line: None
     save_cluster: Callable[[Cluster], None] = lambda cluster: None
 
+    @classmethod
+    def for_cluster(cls, repos, cluster: Cluster, plan: Plan | None = None,
+                    extra_vars: dict | None = None) -> "AdmContext":
+        """Standard wiring every service uses: cluster fleet from the repos,
+        log sink into task_logs, save_cluster persisting status."""
+        return cls(
+            cluster=cluster,
+            nodes=repos.nodes.find(cluster_id=cluster.id),
+            hosts_by_id={
+                h.id: h for h in repos.hosts.find(cluster_id=cluster.id)
+            },
+            credentials_by_id={c.id: c for c in repos.credentials.list()},
+            plan=plan,
+            extra_vars=extra_vars or {},
+            log_sink=lambda task_id, line: repos.task_logs.append(
+                cluster.id, task_id, [line]
+            ),
+            save_cluster=lambda c: repos.clusters.save(c),
+        )
+
     def inventory(self) -> dict:
         return build_inventory(
             self.nodes, self.hosts_by_id, self.credentials_by_id,

@@ -1,0 +1,6 @@
+"""REST API (SURVEY.md §2.1 row 1a): /api/v1/* over aiohttp, session auth,
+SSE task-log streaming (the reference's websocket log viewer equivalent)."""
+
+from kubeoperator_tpu.api.server import create_app, run_server
+
+__all__ = ["create_app", "run_server"]

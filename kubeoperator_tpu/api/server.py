@@ -1,0 +1,590 @@
+"""ko-server REST API.
+
+Route parity with the reference's iris router (`/api/v1/*`: clusters, hosts,
+plans, regions, zones, projects, users, backup, events, components —
+SURVEY.md §2.1 row 1a), plus TPU-first additions: `/plans/tpu-catalog`
+(selectable slice shapes) and per-cluster smoke results in status.
+
+Service calls that block (create with wait, phase runs) execute in a thread
+pool so the event loop keeps streaming logs. Errors map KoError.http_status →
+HTTP; bodies are i18n-translated using the session user's locale.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import functools
+import json
+
+from aiohttp import web
+
+from kubeoperator_tpu.models import (
+    BackupAccount,
+    ClusterSpec,
+    Credential,
+    Plan,
+    Region,
+    Role,
+    Zone,
+)
+from kubeoperator_tpu.service import Services
+from kubeoperator_tpu.utils.errors import AuthError, KoError, NotFoundError
+from kubeoperator_tpu.utils.i18n import translate
+from kubeoperator_tpu.utils.logging import get_logger
+
+log = get_logger("api")
+
+AUTH_EXEMPT = {("POST", "/api/v1/auth/login"), ("GET", "/api/v1/version"),
+               ("GET", "/healthz")}
+
+
+# ---------------------------------------------------------------- helpers ----
+def json_response(data, status: int = 200) -> web.Response:
+    return web.json_response(data, status=status, dumps=functools.partial(
+        json.dumps, default=str))
+
+
+async def run_sync(request: web.Request, fn, *args, **kw):
+    """Run a blocking service call off the event loop."""
+    loop = asyncio.get_running_loop()
+    return await loop.run_in_executor(
+        None, functools.partial(fn, *args, **kw)
+    )
+
+
+@web.middleware
+async def error_middleware(request: web.Request, handler):
+    locale = request.headers.get("Accept-Language", "en-US").split(",")[0]
+    if locale not in ("en-US", "zh-CN"):
+        locale = "zh-CN" if locale.startswith("zh") else "en-US"
+    try:
+        return await handler(request)
+    except KoError as e:
+        return json_response(
+            {"error": e.code,
+             "message": translate(e.code, locale, message=e.message,
+                                  **e.args_map)},
+            status=e.http_status,
+        )
+    except web.HTTPException:
+        raise
+    except Exception as e:  # pragma: no cover - last resort
+        log.exception("unhandled API error")
+        return json_response(
+            {"error": "ERR_INTERNAL", "message": str(e)}, status=500
+        )
+
+
+@web.middleware
+async def auth_middleware(request: web.Request, handler):
+    if (request.method, request.path) in AUTH_EXEMPT or \
+            not request.path.startswith("/api/"):
+        return await handler(request)
+    services: Services = request.app["services"]
+    token = request.headers.get("Authorization", "").removeprefix("Bearer ").strip()
+    if not token:
+        token = request.cookies.get("ko_session", "")
+    if not token:
+        raise AuthError()
+    request["user"] = await run_sync(request, services.users.authenticate, token)
+    return await handler(request)
+
+
+def _require_admin(request: web.Request) -> None:
+    user = request.get("user")
+    if user is not None and not user.is_admin:
+        from kubeoperator_tpu.utils.errors import ForbiddenError
+
+        raise ForbiddenError(action="admin operation")
+
+
+def admin_guard(handler):
+    """Admin-only route (infra CRUD writes: credentials/regions/zones/plans/
+    hosts/backup-accounts — reference: platform-level resources)."""
+    @functools.wraps(handler)
+    async def wrapped(request: web.Request):
+        _require_admin(request)
+        return await handler(request)
+    return wrapped
+
+
+def cluster_guard(handler, needed: Role):
+    """Project RBAC on /clusters/{name}/* routes (reference `pkg/permission`):
+    admin passes; project-scoped clusters check the member role; unscoped
+    clusters are viewable by any authenticated user but writable only by
+    admins."""
+    @functools.wraps(handler)
+    async def wrapped(request: web.Request):
+        from kubeoperator_tpu.utils.errors import ForbiddenError
+
+        services: Services = request.app["services"]
+        user = request["user"]
+        if not user.is_admin:
+            cluster = await run_sync(request, services.clusters.get,
+                                     request.match_info["name"])
+            if cluster.project_id:
+                await run_sync(request, services.projects.require,
+                               user, cluster.project_id, needed)
+            elif needed is not Role.VIEWER:
+                raise ForbiddenError(action=f"{needed.value} on cluster")
+        return await handler(request)
+    return wrapped
+
+
+# ---------------------------------------------------------------- handlers ---
+class Handlers:
+    def __init__(self, services: Services):
+        self.s = services
+
+    # ---- auth / users ----
+    async def login(self, request):
+        body = await request.json()
+        token = await run_sync(request, self.s.users.login,
+                               body.get("username", ""), body.get("password", ""))
+        resp = json_response({"token": token})
+        resp.set_cookie("ko_session", token, httponly=True, samesite="Lax")
+        return resp
+
+    async def logout(self, request):
+        token = request.headers.get("Authorization", "").removeprefix("Bearer ")
+        await run_sync(request, self.s.users.logout, token.strip())
+        return json_response({"ok": True})
+
+    async def whoami(self, request):
+        return json_response(request["user"].to_public_dict())
+
+    async def list_users(self, request):
+        _require_admin(request)
+        users = await run_sync(request, self.s.users.list)
+        return json_response([u.to_public_dict() for u in users])
+
+    async def create_user(self, request):
+        _require_admin(request)
+        body = await request.json()
+        user = await run_sync(
+            request, self.s.users.create, body["name"],
+            body.get("password", ""), body.get("email", ""),
+            body.get("is_admin", False), body.get("source", "local"),
+        )
+        return json_response(user.to_public_dict(), status=201)
+
+    # ---- version / health ----
+    async def version(self, request):
+        from kubeoperator_tpu.version import SUPPORTED_K8S_VERSIONS, __version__
+
+        return json_response({
+            "version": __version__,
+            "supported_k8s_versions": list(SUPPORTED_K8S_VERSIONS),
+        })
+
+    async def healthz(self, request):
+        return json_response({"status": "ok"})
+
+    # ---- clusters (§3.1) ----
+    async def list_clusters(self, request):
+        clusters = await run_sync(request, self.s.clusters.list,
+                                  request.query.get("project") or None)
+        user = request["user"]
+        if not user.is_admin:
+            def visible(c):
+                return bool(c.project_id) and \
+                    self.s.projects.role_of(user, c.project_id) is not None
+            clusters = [c for c in clusters if visible(c)]
+        return json_response([c.to_public_dict() for c in clusters])
+
+    async def create_cluster(self, request):
+        body = await request.json()
+        user = request["user"]
+        if not user.is_admin:
+            project_id = body.get("project_id", "")
+            if not project_id:
+                from kubeoperator_tpu.utils.errors import ForbiddenError
+
+                raise ForbiddenError(
+                    action="creating a cluster outside a project"
+                )
+            await run_sync(request, request.app["services"].projects.require,
+                           user, project_id, Role.MANAGER)
+        spec = ClusterSpec(**{
+            k: v for k, v in body.get("spec", {}).items()
+            if k in ClusterSpec.__dataclass_fields__
+        })
+        cluster = await run_sync(
+            request, self.s.clusters.create,
+            body["name"],
+            spec=spec,
+            provision_mode=body.get("provision_mode", "manual"),
+            plan_name=body.get("plan", ""),
+            project_id=body.get("project_id", ""),
+            host_names=body.get("hosts", []),
+            credential_name=body.get("credential", ""),
+            wait=False,
+        )
+        return json_response(cluster.to_public_dict(), status=201)
+
+    async def get_cluster(self, request):
+        cluster = await run_sync(request, self.s.clusters.get,
+                                 request.match_info["name"])
+        return json_response(cluster.to_public_dict())
+
+    async def cluster_status(self, request):
+        cluster = await run_sync(request, self.s.clusters.get,
+                                 request.match_info["name"])
+        data = cluster.to_public_dict()["status"]
+        data["total_duration_s"] = cluster.status.total_duration_s()
+        return json_response(data)
+
+    async def delete_cluster(self, request):
+        await run_sync(request, self.s.clusters.delete,
+                       request.match_info["name"], False)
+        return json_response({"ok": True}, status=202)
+
+    async def retry_cluster(self, request):
+        cluster = await run_sync(request, self.s.clusters.retry,
+                                 request.match_info["name"], False)
+        return json_response(cluster.to_public_dict(), status=202)
+
+    async def cluster_kubeconfig(self, request):
+        cluster = await run_sync(request, self.s.clusters.get,
+                                 request.match_info["name"])
+        if not cluster.kubeconfig:
+            raise NotFoundError(kind="kubeconfig", name=cluster.name)
+        return web.Response(text=cluster.kubeconfig,
+                            content_type="application/yaml")
+
+    async def cluster_logs(self, request):
+        """Task-log streaming: SSE when `follow=1`, else JSON page.
+
+        The SSE stream is the reference's websocket log viewer analog
+        (SURVEY.md §5.1)."""
+        name = request.match_info["name"]
+        cluster = await run_sync(request, self.s.clusters.get, name)
+        task_id = request.query.get("task", "")
+        cursor = int(request.query.get("after", "-1" if task_id else "0"))
+
+        def fetch(after: int):
+            """(chunks, new_cursor): per-task seq cursor, or the cluster-wide
+            rowid cursor — both O(new rows) in SQL."""
+            if task_id:
+                chunks = self.s.repos.task_logs.tail(task_id, after)
+                return chunks, (chunks[-1].seq if chunks else after)
+            return self.s.repos.task_logs.tail_cluster(cluster.id, after)
+
+        if request.query.get("follow") != "1":
+            chunks, _ = await run_sync(request, fetch, cursor)
+            return json_response([
+                {"seq": c.seq, "task_id": c.task_id, "line": c.line}
+                for c in chunks
+            ])
+
+        resp = web.StreamResponse(headers={
+            "Content-Type": "text/event-stream",
+            "Cache-Control": "no-cache",
+        })
+        await resp.prepare(request)
+        idle = 0.0
+        while idle < 30.0:
+            chunks, cursor = await run_sync(request, fetch, cursor)
+            if chunks:
+                idle = 0.0
+                for c in chunks:
+                    await resp.write(
+                        f"data: {json.dumps({'seq': c.seq, 'line': c.line})}\n\n"
+                        .encode()
+                    )
+            else:
+                idle += 0.5
+                await asyncio.sleep(0.5)
+        await resp.write(b"event: end\ndata: {}\n\n")
+        return resp
+
+    # ---- nodes / scale (§3.3) ----
+    async def list_nodes(self, request):
+        nodes = await run_sync(request, self.s.nodes.list,
+                               request.match_info["name"])
+        return json_response([n.to_public_dict() for n in nodes])
+
+    async def scale_up(self, request):
+        body = await request.json()
+        nodes = await run_sync(request, self.s.nodes.scale_up,
+                               request.match_info["name"], body.get("hosts", []))
+        return json_response([n.to_public_dict() for n in nodes], status=201)
+
+    async def scale_down(self, request):
+        await run_sync(request, self.s.nodes.scale_down,
+                       request.match_info["name"],
+                       request.match_info["node"])
+        return json_response({"ok": True})
+
+    # ---- upgrade (§3.4) ----
+    async def upgrade(self, request):
+        body = await request.json()
+        cluster = await run_sync(request, self.s.upgrades.upgrade,
+                                 request.match_info["name"], body["version"])
+        return json_response(cluster.to_public_dict())
+
+    # ---- backup (§3.5) ----
+    async def list_backup_accounts(self, request):
+        accounts = await run_sync(request, self.s.backups.list_accounts)
+        return json_response([a.to_public_dict() for a in accounts])
+
+    async def create_backup_account(self, request):
+        body = await request.json()
+        account = await run_sync(
+            request, self.s.backups.create_account,
+            BackupAccount(name=body["name"], type=body.get("type", "local"),
+                          bucket=body.get("bucket", ""),
+                          vars=body.get("vars", {})),
+        )
+        return json_response(account.to_public_dict(), status=201)
+
+    async def run_backup(self, request):
+        body = await request.json() if request.can_read_body else {}
+        record = await run_sync(request, self.s.backups.run_backup,
+                                request.match_info["name"],
+                                body.get("account", ""))
+        return json_response(record.to_public_dict(), status=201)
+
+    async def list_backups(self, request):
+        files = await run_sync(request, self.s.backups.list_files,
+                               request.match_info["name"])
+        return json_response([f.to_public_dict() for f in files])
+
+    async def restore(self, request):
+        body = await request.json()
+        await run_sync(request, self.s.backups.restore,
+                       request.match_info["name"], body["file"])
+        return json_response({"ok": True})
+
+    async def backup_strategy(self, request):
+        if request.method == "GET":
+            strategy = await run_sync(request, self.s.backups.get_strategy,
+                                      request.match_info["name"])
+            return json_response(
+                strategy.to_public_dict() if strategy else None
+            )
+        body = await request.json()
+        strategy = await run_sync(
+            request, self.s.backups.set_strategy,
+            request.match_info["name"], body["account"],
+            body.get("cron", "0 3 * * *"), body.get("save_num", 7),
+            body.get("enabled", True),
+        )
+        return json_response(strategy.to_public_dict())
+
+    # ---- health ----
+    async def health(self, request):
+        report = await run_sync(request, self.s.health.check,
+                                request.match_info["name"])
+        return json_response(report.to_dict())
+
+    async def recover(self, request):
+        body = await request.json()
+        await run_sync(request, self.s.health.recover,
+                       request.match_info["name"], body["probe"])
+        return json_response({"ok": True})
+
+    # ---- components ----
+    async def component_catalog(self, request):
+        return json_response(self.s.components.catalog())
+
+    async def list_components(self, request):
+        comps = await run_sync(request, self.s.components.list,
+                               request.match_info["name"])
+        return json_response([c.to_public_dict() for c in comps])
+
+    async def install_component(self, request):
+        body = await request.json()
+        comp = await run_sync(request, self.s.components.install,
+                              request.match_info["name"], body["component"],
+                              body.get("vars"))
+        return json_response(comp.to_public_dict(), status=201)
+
+    async def uninstall_component(self, request):
+        await run_sync(request, self.s.components.uninstall,
+                       request.match_info["name"],
+                       request.match_info["component"])
+        return json_response({"ok": True})
+
+    # ---- events ----
+    async def cluster_events(self, request):
+        cluster = await run_sync(request, self.s.clusters.get,
+                                 request.match_info["name"])
+        events = await run_sync(request, self.s.events.list, cluster.id)
+        return json_response([e.to_public_dict() for e in events])
+
+    # ---- infra CRUD ----
+    def _crud_routes(self, app, path, service, entity_cls, fields):
+        async def list_(request):
+            items = await run_sync(request, service.list)
+            return json_response([i.to_public_dict() for i in items])
+
+        async def create(request):
+            body = await request.json()
+            obj = entity_cls(**{k: body[k] for k in fields if k in body})
+            item = await run_sync(request, service.create, obj)
+            return json_response(item.to_public_dict(), status=201)
+
+        async def get(request):
+            item = await run_sync(request, service.get,
+                                  request.match_info["name"])
+            return json_response(item.to_public_dict())
+
+        async def delete(request):
+            await run_sync(request, service.delete, request.match_info["name"])
+            return json_response({"ok": True})
+
+        app.router.add_get(path, list_)
+        app.router.add_post(path, admin_guard(create))
+        app.router.add_get(path + "/{name}", get)
+        app.router.add_delete(path + "/{name}", admin_guard(delete))
+
+    # ---- hosts / plans extras ----
+    async def register_host(self, request):
+        body = await request.json()
+        host = await run_sync(request, self.s.hosts.register,
+                              body["name"], body["ip"], body["credential"],
+                              body.get("port", 22))
+        return json_response(host.to_public_dict(), status=201)
+
+    async def host_facts(self, request):
+        host = await run_sync(request, self.s.hosts.gather_facts,
+                              request.match_info["name"])
+        return json_response(host.to_public_dict())
+
+    async def tpu_catalog(self, request):
+        return json_response(await run_sync(request, self.s.plans.tpu_catalog))
+
+    # ---- projects ----
+    async def list_projects(self, request):
+        projects = await run_sync(request, self.s.projects.list)
+        return json_response([p.to_public_dict() for p in projects])
+
+    async def create_project(self, request):
+        _require_admin(request)
+        body = await request.json()
+        project = await run_sync(request, self.s.projects.create,
+                                 body["name"], body.get("description", ""))
+        return json_response(project.to_public_dict(), status=201)
+
+    async def add_member(self, request):
+        _require_admin(request)
+        body = await request.json()
+        member = await run_sync(request, self.s.projects.add_member,
+                                request.match_info["name"], body["user"],
+                                body.get("role", Role.VIEWER.value))
+        return json_response(member.to_public_dict(), status=201)
+
+    # ---- messages ----
+    async def inbox(self, request):
+        msgs = await run_sync(request, self.s.messages.inbox,
+                              request["user"].id,
+                              request.query.get("unread") == "1")
+        return json_response([m.to_public_dict() for m in msgs])
+
+
+def create_app(services: Services) -> web.Application:
+    app = web.Application(middlewares=[error_middleware, auth_middleware])
+    app["services"] = services
+    h = Handlers(services)
+
+    r = app.router
+    r.add_get("/healthz", h.healthz)
+    r.add_get("/api/v1/version", h.version)
+    r.add_post("/api/v1/auth/login", h.login)
+    r.add_post("/api/v1/auth/logout", h.logout)
+    r.add_get("/api/v1/auth/whoami", h.whoami)
+    r.add_get("/api/v1/users", h.list_users)
+    r.add_post("/api/v1/users", h.create_user)
+
+    view, manage = Role.VIEWER, Role.MANAGER
+    r.add_get("/api/v1/clusters", h.list_clusters)
+    r.add_post("/api/v1/clusters", h.create_cluster)
+    r.add_get("/api/v1/clusters/{name}", cluster_guard(h.get_cluster, view))
+    r.add_delete("/api/v1/clusters/{name}",
+                 cluster_guard(h.delete_cluster, manage))
+    r.add_get("/api/v1/clusters/{name}/status",
+              cluster_guard(h.cluster_status, view))
+    r.add_post("/api/v1/clusters/{name}/retry",
+               cluster_guard(h.retry_cluster, manage))
+    r.add_get("/api/v1/clusters/{name}/kubeconfig",
+              cluster_guard(h.cluster_kubeconfig, manage))
+    r.add_get("/api/v1/clusters/{name}/logs",
+              cluster_guard(h.cluster_logs, view))
+    r.add_get("/api/v1/clusters/{name}/nodes",
+              cluster_guard(h.list_nodes, view))
+    r.add_post("/api/v1/clusters/{name}/nodes",
+               cluster_guard(h.scale_up, manage))
+    r.add_delete("/api/v1/clusters/{name}/nodes/{node}",
+                 cluster_guard(h.scale_down, manage))
+    r.add_post("/api/v1/clusters/{name}/upgrade",
+               cluster_guard(h.upgrade, manage))
+    r.add_post("/api/v1/clusters/{name}/backup",
+               cluster_guard(h.run_backup, manage))
+    r.add_get("/api/v1/clusters/{name}/backups",
+              cluster_guard(h.list_backups, view))
+    r.add_post("/api/v1/clusters/{name}/restore",
+               cluster_guard(h.restore, manage))
+    r.add_get("/api/v1/clusters/{name}/backup-strategy",
+              cluster_guard(h.backup_strategy, view))
+    r.add_post("/api/v1/clusters/{name}/backup-strategy",
+               cluster_guard(h.backup_strategy, manage))
+    r.add_get("/api/v1/clusters/{name}/health",
+              cluster_guard(h.health, view))
+    r.add_post("/api/v1/clusters/{name}/recover",
+               cluster_guard(h.recover, manage))
+    r.add_get("/api/v1/clusters/{name}/components",
+              cluster_guard(h.list_components, view))
+    r.add_post("/api/v1/clusters/{name}/components",
+               cluster_guard(h.install_component, manage))
+    r.add_delete("/api/v1/clusters/{name}/components/{component}",
+                 cluster_guard(h.uninstall_component, manage))
+    r.add_get("/api/v1/clusters/{name}/events",
+              cluster_guard(h.cluster_events, view))
+
+    r.add_get("/api/v1/backup-accounts", h.list_backup_accounts)
+    r.add_post("/api/v1/backup-accounts", admin_guard(h.create_backup_account))
+
+    h._crud_routes(app, "/api/v1/credentials", services.credentials,
+                   Credential, ("name", "username", "password",
+                                "private_key", "port"))
+    h._crud_routes(app, "/api/v1/regions", services.regions, Region,
+                   ("name", "provider", "vars"))
+    h._crud_routes(app, "/api/v1/zones", services.zones, Zone,
+                   ("name", "region_id", "vars", "ip_pool"))
+    h._crud_routes(app, "/api/v1/plans", services.plans, Plan,
+                   ("name", "provider", "region_id", "zone_ids",
+                    "master_count", "worker_count", "vars", "accelerator",
+                    "tpu_type", "slice_topology", "num_slices",
+                    "tpu_runtime_version"))
+    r.add_post("/api/v1/hosts/register", admin_guard(h.register_host))
+    r.add_post("/api/v1/hosts/{name}/facts", admin_guard(h.host_facts))
+    r.add_get("/api/v1/plans-tpu-catalog", h.tpu_catalog)
+
+    r.add_get("/api/v1/projects", h.list_projects)
+    r.add_post("/api/v1/projects", h.create_project)
+    r.add_post("/api/v1/projects/{name}/members", h.add_member)
+    r.add_get("/api/v1/messages", h.inbox)
+
+    # static UI (kubeoperator_tpu/ui/) mounted at /
+    import os
+
+    ui_dir = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "ui")
+    if os.path.isdir(ui_dir):
+        async def index(request):
+            return web.FileResponse(os.path.join(ui_dir, "index.html"))
+
+        r.add_get("/", index)
+        r.add_static("/ui/", ui_dir)
+    return app
+
+
+def run_server(services: Services, host: str = "127.0.0.1",
+               port: int = 8080) -> None:
+    services.users.ensure_admin()
+    services.messages.attach_to(services.events)
+    services.cron.start()
+    app = create_app(services)
+    log.info("ko-tpu server listening on http://%s:%d", host, port)
+    web.run_app(app, host=host, port=port, print=None)

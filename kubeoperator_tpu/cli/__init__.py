@@ -1,0 +1,1 @@
+"""koctl CLI (SURVEY.md §2.1 row 6 + §3.2)."""

@@ -1,0 +1,448 @@
+"""koctl — the operator CLI.
+
+Parity (SURVEY.md §2.1 row 6): platform lifecycle (`server`, `status`) and
+the north-star extension `koctl cluster create --plan tpu-v5e-16` (§3.2):
+resolve plan by name → POST /clusters → poll conditions → exit code from
+final status + smoke-test result [BASELINE].
+
+Two transports, same commands:
+  * REST (default): talks to a running ko-tpu server (`--server URL`).
+  * `--local`: builds the service stack in-process (air-gapped demo /
+    single-box usage; also what the test suite drives).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import requests as _requests
+import yaml
+
+from kubeoperator_tpu.utils.errors import KoError
+from kubeoperator_tpu.version import __version__
+
+SESSION_FILE = os.path.expanduser("~/.ko-tpu-session")
+
+
+# ---------------------------------------------------------------- transports -
+class RestClient:
+    def __init__(self, server: str):
+        self.base = server.rstrip("/")
+        self.http = _requests.Session()
+        if os.path.exists(SESSION_FILE):
+            with open(SESSION_FILE, encoding="utf-8") as f:
+                self.http.headers["Authorization"] = f"Bearer {f.read().strip()}"
+
+    def call(self, method: str, path: str, body: dict | None = None):
+        resp = self.http.request(method, self.base + path, json=body,
+                                 timeout=600)
+        if resp.status_code >= 400:
+            try:
+                err = resp.json()
+            except ValueError:
+                err = {"message": resp.text}
+            raise SystemExit(f"error: {err.get('message', resp.status_code)}")
+        if resp.headers.get("Content-Type", "").startswith("application/json"):
+            return resp.json()
+        return resp.text
+
+    def login(self, username: str, password: str) -> None:
+        data = self.call("POST", "/api/v1/auth/login",
+                         {"username": username, "password": password})
+        with open(SESSION_FILE, "w", encoding="utf-8") as f:
+            f.write(data["token"])
+        os.chmod(SESSION_FILE, 0o600)
+
+
+class LocalClient:
+    """In-process transport: same verb surface as the REST API."""
+
+    def __init__(self):
+        from kubeoperator_tpu.service import build_services
+
+        self.services = build_services()
+        self.services.users.ensure_admin()
+
+    def call(self, method: str, path: str, body: dict | None = None):
+        """Translate the REST surface onto services (subset koctl uses)."""
+        s = self.services
+        body = body or {}
+        parts = [p for p in path.split("/") if p][2:]  # drop api/v1
+        try:
+            return self._dispatch(s, method, parts, body)
+        except KoError as e:
+            raise SystemExit(f"error: {e.message}")
+
+    def _dispatch(self, s, method, parts, body):
+        def pub(x):
+            if isinstance(x, list):
+                return [pub(i) for i in x]
+            return x.to_public_dict() if hasattr(x, "to_public_dict") else x
+
+        match (method, parts):
+            case ("GET", ["version"]):
+                from kubeoperator_tpu.version import SUPPORTED_K8S_VERSIONS
+
+                return {"version": __version__,
+                        "supported_k8s_versions": list(SUPPORTED_K8S_VERSIONS)}
+            case ("GET", ["clusters"]):
+                return pub(s.clusters.list())
+            case ("POST", ["clusters"]):
+                from kubeoperator_tpu.models import ClusterSpec
+
+                spec = ClusterSpec(**{
+                    k: v for k, v in body.get("spec", {}).items()
+                    if k in ClusterSpec.__dataclass_fields__
+                })
+                return pub(s.clusters.create(
+                    body["name"], spec=spec,
+                    provision_mode=body.get("provision_mode", "manual"),
+                    plan_name=body.get("plan", ""),
+                    host_names=body.get("hosts", []),
+                    credential_name=body.get("credential", ""),
+                    wait=False,
+                ))
+            case ("GET", ["clusters", name]):
+                return pub(s.clusters.get(name))
+            case ("GET", ["clusters", name, "status"]):
+                cluster = s.clusters.get(name)
+                data = pub(cluster)["status"]
+                data["total_duration_s"] = cluster.status.total_duration_s()
+                return data
+            case ("DELETE", ["clusters", name]):
+                s.clusters.delete(name, wait=True)
+                return {"ok": True}
+            case ("POST", ["clusters", name, "retry"]):
+                return pub(s.clusters.retry(name, wait=False))
+            case ("GET", ["clusters", name, "logs"]):
+                cluster = s.clusters.get(name)
+                chunks = s.repos.task_logs.find(cluster_id=cluster.id)
+                return [{"seq": c.seq, "task_id": c.task_id, "line": c.line}
+                        for c in chunks]
+            case ("GET", ["clusters", name, "nodes"]):
+                return pub(s.nodes.list(name))
+            case ("POST", ["clusters", name, "nodes"]):
+                return pub(s.nodes.scale_up(name, body.get("hosts", [])))
+            case ("DELETE", ["clusters", name, "nodes", node]):
+                s.nodes.scale_down(name, node)
+                return {"ok": True}
+            case ("POST", ["clusters", name, "upgrade"]):
+                return pub(s.upgrades.upgrade(name, body["version"]))
+            case ("POST", ["clusters", name, "backup"]):
+                return pub(s.backups.run_backup(name, body.get("account", "")))
+            case ("GET", ["clusters", name, "backups"]):
+                return pub(s.backups.list_files(name))
+            case ("POST", ["clusters", name, "restore"]):
+                s.backups.restore(name, body["file"])
+                return {"ok": True}
+            case ("GET", ["clusters", name, "health"]):
+                return s.health.check(name).to_dict()
+            case ("GET", ["clusters", name, "events"]):
+                return pub(s.events.list(s.clusters.get(name).id))
+            case ("POST", ["clusters", name, "components"]):
+                return pub(s.components.install(name, body["component"],
+                                                body.get("vars")))
+            case ("GET", ["plans"]):
+                return pub(s.plans.list())
+            case ("POST", ["plans"]):
+                from kubeoperator_tpu.models import Plan
+
+                fields = (
+                    "name provider region_id zone_ids master_count "
+                    "worker_count vars accelerator tpu_type slice_topology "
+                    "num_slices tpu_runtime_version"
+                ).split()
+                return pub(s.plans.create(Plan(**{
+                    k: body[k] for k in fields if k in body
+                })))
+            case ("GET", ["plans-tpu-catalog"]):
+                return s.plans.tpu_catalog()
+            case ("POST", ["hosts", "register"]):
+                return pub(s.hosts.register(body["name"], body["ip"],
+                                            body["credential"],
+                                            body.get("port", 22)))
+            case ("GET", ["hosts"]):
+                return pub(s.hosts.list())
+            case ("POST", ["credentials"]):
+                from kubeoperator_tpu.models import Credential
+
+                return pub(s.credentials.create(Credential(**body)))
+            case ("POST", ["regions"]):
+                from kubeoperator_tpu.models import Region
+
+                return pub(s.regions.create(Region(**body)))
+            case ("POST", ["zones"]):
+                from kubeoperator_tpu.models import Zone
+
+                return pub(s.zones.create(Zone(**body)))
+            case ("POST", ["backup-accounts"]):
+                from kubeoperator_tpu.models import BackupAccount
+
+                return pub(s.backups.create_account(BackupAccount(**body)))
+            case _:
+                raise SystemExit(
+                    f"error: local transport has no route {method} "
+                    f"/{'/'.join(parts)}"
+                )
+
+
+# ---------------------------------------------------------------- commands ---
+def _print(data) -> None:
+    print(json.dumps(data, indent=2, default=str))
+
+
+def _poll_to_ready(client, name: str, timeout_s: float, quiet: bool) -> int:
+    """§3.2: poll conditions until Ready/Failed; exit code from final
+    status + smoke result."""
+    deadline = time.time() + timeout_s
+    seen: set[str] = set()
+    while time.time() < deadline:
+        status = client.call("GET", f"/api/v1/clusters/{name}/status")
+        for cond in status.get("conditions", []):
+            key = f"{cond['name']}:{cond['status']}"
+            if key not in seen and cond["status"] != "Unknown":
+                seen.add(key)
+                if not quiet:
+                    print(f"  phase {cond['name']}: {cond['status']}"
+                          + (f" ({cond['message']})" if cond.get("message") else ""))
+        phase = status.get("phase")
+        if phase == "Ready":
+            if not quiet:
+                extra = ""
+                if status.get("smoke_chips"):
+                    extra = (f" — psum {status['smoke_gbps']} GB/s over "
+                             f"{status['smoke_chips']} chips")
+                print(f"cluster {name} is Ready"
+                      f" ({status.get('total_duration_s', 0):.1f}s){extra}")
+            return 0
+        if phase == "Failed":
+            print(f"cluster {name} FAILED: {status.get('message', '')}",
+                  file=sys.stderr)
+            return 1
+        time.sleep(1.0)
+    print(f"timed out waiting for {name}", file=sys.stderr)
+    return 2
+
+
+def cmd_cluster(client, args) -> int:
+    if args.cluster_cmd == "create":
+        body: dict = {"name": args.name}
+        if args.plan:
+            body["provision_mode"] = "plan"
+            body["plan"] = args.plan
+        else:
+            body["provision_mode"] = "manual"
+            body["hosts"] = (args.hosts or "").split(",") if args.hosts else []
+            if args.credential:
+                body["credential"] = args.credential
+        spec = {}
+        if args.k8s_version:
+            spec["k8s_version"] = args.k8s_version
+        if args.workers is not None:
+            spec["worker_count"] = args.workers
+        if spec:
+            body["spec"] = spec
+        client.call("POST", "/api/v1/clusters", body)
+        if args.no_wait:
+            print(f"cluster {args.name} create accepted")
+            return 0
+        return _poll_to_ready(client, args.name, args.timeout, args.quiet)
+    if args.cluster_cmd == "list":
+        _print(client.call("GET", "/api/v1/clusters"))
+        return 0
+    if args.cluster_cmd == "status":
+        _print(client.call("GET", f"/api/v1/clusters/{args.name}/status"))
+        return 0
+    if args.cluster_cmd == "delete":
+        client.call("DELETE", f"/api/v1/clusters/{args.name}")
+        print(f"cluster {args.name} deletion started")
+        return 0
+    if args.cluster_cmd == "retry":
+        client.call("POST", f"/api/v1/clusters/{args.name}/retry")
+        return _poll_to_ready(client, args.name, args.timeout, args.quiet)
+    if args.cluster_cmd == "logs":
+        for chunk in client.call("GET", f"/api/v1/clusters/{args.name}/logs"):
+            print(chunk["line"])
+        return 0
+    if args.cluster_cmd == "events":
+        _print(client.call("GET", f"/api/v1/clusters/{args.name}/events"))
+        return 0
+    if args.cluster_cmd == "health":
+        report = client.call("GET", f"/api/v1/clusters/{args.name}/health")
+        _print(report)
+        return 0 if report.get("healthy") else 1
+    if args.cluster_cmd == "scale":
+        if args.add:
+            _print(client.call("POST", f"/api/v1/clusters/{args.name}/nodes",
+                               {"hosts": args.add.split(",")}))
+        if args.remove:
+            client.call("DELETE",
+                        f"/api/v1/clusters/{args.name}/nodes/{args.remove}")
+            print(f"node {args.remove} removed")
+        return 0
+    if args.cluster_cmd == "upgrade":
+        _print(client.call("POST", f"/api/v1/clusters/{args.name}/upgrade",
+                           {"version": args.version}))
+        return 0
+    if args.cluster_cmd == "backup":
+        _print(client.call("POST", f"/api/v1/clusters/{args.name}/backup",
+                           {"account": args.account or ""}))
+        return 0
+    if args.cluster_cmd == "restore":
+        client.call("POST", f"/api/v1/clusters/{args.name}/restore",
+                    {"file": args.file})
+        print("restore complete")
+        return 0
+    raise SystemExit(f"unknown cluster command {args.cluster_cmd}")
+
+
+def cmd_apply(client, args) -> int:
+    """Declarative setup: apply a YAML of credentials/regions/zones/plans/
+    hosts/backup-accounts (koctl's bulk bootstrap; no upstream analog but
+    the natural CLI face for the plan schema)."""
+    with open(args.file, encoding="utf-8") as f:
+        doc = yaml.safe_load(f) or {}
+    order = [
+        ("credentials", "/api/v1/credentials"),
+        ("regions", "/api/v1/regions"),
+        ("zones", "/api/v1/zones"),
+        ("plans", "/api/v1/plans"),
+        ("backup_accounts", "/api/v1/backup-accounts"),
+    ]
+    created = []
+    name_to_id: dict[str, str] = {}
+    for key, path in order:
+        for item in doc.get(key, []):
+            # allow region/zone references by name
+            if "region" in item and "region_id" not in item:
+                item["region_id"] = name_to_id[item.pop("region")]
+            if "zones" in item and "zone_ids" not in item:
+                item["zone_ids"] = [name_to_id[z] for z in item.pop("zones")]
+            out = client.call("POST", path, item)
+            name_to_id[out["name"]] = out["id"]
+            created.append(f"{key[:-1]}/{out['name']}")
+    for item in doc.get("hosts", []):
+        out = client.call("POST", "/api/v1/hosts/register", item)
+        created.append(f"host/{out['name']}")
+    for line in created:
+        print("created", line)
+    return 0
+
+
+def cmd_tpu(client, args) -> int:
+    if args.tpu_cmd == "catalog":
+        catalog = client.call("GET", "/api/v1/plans-tpu-catalog")
+        for entry in catalog:
+            print(f"{entry['accelerator_type']:>10}  chips={entry['chips']:<4} "
+                  f"hosts={entry['total_hosts']:<3} ici={entry['ici_mesh']:<8} "
+                  f"runtime={entry['runtime_version']}")
+        return 0
+    raise SystemExit(f"unknown tpu command {args.tpu_cmd}")
+
+
+def cmd_server(args) -> int:
+    from kubeoperator_tpu.api import run_server
+    from kubeoperator_tpu.service import build_services
+    from kubeoperator_tpu.utils.config import load_config
+
+    config = load_config(args.config)
+    services = build_services(config)
+    run_server(services, config.get("server.bind_host", "127.0.0.1"),
+               int(config.get("server.bind_port", 8080)))
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="koctl",
+        description="TPU-native Kubernetes cluster lifecycle CLI",
+    )
+    p.add_argument("--server", default=os.environ.get(
+        "KO_TPU_SERVER", "http://127.0.0.1:8080"))
+    p.add_argument("--local", action="store_true",
+                   help="run against an in-process service stack (no server)")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    sub.add_parser("version")
+
+    login = sub.add_parser("login")
+    login.add_argument("username")
+    login.add_argument("--password", required=True)
+
+    server = sub.add_parser("server", help="run the ko-tpu API server")
+    server.add_argument("--config", default=None)
+
+    cluster = sub.add_parser("cluster")
+    csub = cluster.add_subparsers(dest="cluster_cmd", required=True)
+    create = csub.add_parser("create")
+    create.add_argument("name")
+    create.add_argument("--plan", default="")
+    create.add_argument("--hosts", default="")
+    create.add_argument("--credential", default="")
+    create.add_argument("--k8s-version", default="")
+    create.add_argument("--workers", type=int, default=None)
+    create.add_argument("--no-wait", action="store_true")
+    create.add_argument("--quiet", action="store_true")
+    create.add_argument("--timeout", type=float, default=3600.0)
+    for name in ("status", "delete", "logs", "events", "health"):
+        sp = csub.add_parser(name)
+        sp.add_argument("name")
+    retry = csub.add_parser("retry")
+    retry.add_argument("name")
+    retry.add_argument("--quiet", action="store_true")
+    retry.add_argument("--timeout", type=float, default=3600.0)
+    csub.add_parser("list")
+    scale = csub.add_parser("scale")
+    scale.add_argument("name")
+    scale.add_argument("--add", default="")
+    scale.add_argument("--remove", default="")
+    upgrade = csub.add_parser("upgrade")
+    upgrade.add_argument("name")
+    upgrade.add_argument("--version", required=True)
+    backup = csub.add_parser("backup")
+    backup.add_argument("name")
+    backup.add_argument("--account", default="")
+    restore = csub.add_parser("restore")
+    restore.add_argument("name")
+    restore.add_argument("--file", required=True)
+
+    apply_p = sub.add_parser("apply", help="apply a setup YAML")
+    apply_p.add_argument("-f", "--file", required=True)
+
+    tpu = sub.add_parser("tpu")
+    tsub = tpu.add_subparsers(dest="tpu_cmd", required=True)
+    tsub.add_parser("catalog")
+
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.cmd == "version":
+        print(f"koctl {__version__}")
+        return 0
+    if args.cmd == "server":
+        return cmd_server(args)
+
+    client = LocalClient() if args.local else RestClient(args.server)
+    if args.cmd == "login":
+        if args.local:
+            raise SystemExit("login is for REST mode")
+        client.login(args.username, args.password)
+        print("logged in")
+        return 0
+    if args.cmd == "cluster":
+        return cmd_cluster(client, args)
+    if args.cmd == "apply":
+        return cmd_apply(client, args)
+    if args.cmd == "tpu":
+        return cmd_tpu(client, args)
+    raise SystemExit(f"unknown command {args.cmd}")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -222,6 +222,20 @@ class TaskLogChunkRepo(EntityRepo[TaskLogChunk]):
         )
         return [self._hydrate(r["data"]) for r in rows]
 
+    def tail_cluster(
+        self, cluster_id: str, after_rowid: int = 0
+    ) -> tuple[list[TaskLogChunk], int]:
+        """Cluster-wide stream cursor on sqlite rowid: O(new rows) per poll
+        (insertion order == stream order). Returns (chunks, last_rowid)."""
+        rows = self.db.query(
+            "SELECT rowid, data FROM task_log_chunks "
+            "WHERE cluster_id=? AND rowid>? ORDER BY rowid",
+            (cluster_id, after_rowid),
+        )
+        chunks = [self._hydrate(r["data"]) for r in rows]
+        last = rows[-1]["rowid"] if rows else after_rowid
+        return chunks, last
+
 
 class ComponentRepo(EntityRepo[ClusterComponent]):
     table, entity, columns = "components", ClusterComponent, ("cluster_id", "name")

@@ -107,25 +107,17 @@ class BackupService:
 
     # ---- internals ----
     def _context(self, cluster, account: BackupAccount, fname: str) -> AdmContext:
-        return AdmContext(
-            cluster=cluster,
-            nodes=self.repos.nodes.find(cluster_id=cluster.id),
-            hosts_by_id={
-                h.id: h for h in self.repos.hosts.find(cluster_id=cluster.id)
-            },
-            credentials_by_id={c.id: c for c in self.repos.credentials.list()},
-            extra_vars={
-                "backup_file_name": fname,
-                "backup_account_type": account.type,
-                "backup_bucket": account.bucket,
-                "backup_save_num": 7,
-                **{f"backup_{k}": v for k, v in account.vars.items()},
-            },
-            log_sink=lambda task_id, line: self.repos.task_logs.append(
-                cluster.id, task_id, [line]
-            ),
-            save_cluster=lambda c: self.repos.clusters.save(c),
-        )
+        strategy = self.repos.backup_strategies.find(cluster_id=cluster.id)
+        save_num = strategy[0].save_num if strategy else 7
+        return AdmContext.for_cluster(self.repos, cluster, None, {
+            "backup_file_name": fname,
+            "backup_account_type": account.type,
+            "backup_bucket": account.bucket,
+            # remote-side retention must track the strategy, or the endpoint
+            # prunes snapshots the server still lists as restorable
+            "backup_save_num": save_num,
+            **{f"backup_{k}": v for k, v in account.vars.items()},
+        })
 
     def _prune(self, cluster_id: str) -> None:
         strategy = self.repos.backup_strategies.find(cluster_id=cluster_id)

@@ -55,6 +55,7 @@ class ClusterService:
         self.config = config
         self.adm = ClusterAdm(executor)
         self._ops: dict[str, threading.Thread] = {}
+        self._ops_lock = threading.Lock()
         # chaos/test hook: merged into every phase's extra-vars (e.g.
         # {"__fail_at_task__": "install etcd"} for simulated failure drills)
         self.debug_extra_vars: dict = {}
@@ -110,12 +111,21 @@ class ClusterService:
             spec=spec,
         )
         cluster.validate()
+        # Validate the host set BEFORE persisting: a rejected manual create
+        # must not leave a phantom Initializing row squatting the name.
+        if provision_mode == ProvisionMode.MANUAL.value:
+            self._check_manual_hosts(cluster, host_names or [], credential_name)
         self.repos.clusters.save(cluster)
         self.events.emit(cluster.id, "Normal", "ClusterCreateStarted",
                          f"cluster {name} create accepted ({provision_mode})")
-
         if provision_mode == ProvisionMode.MANUAL.value:
-            self._bind_manual_hosts(cluster, host_names or [], credential_name)
+            try:
+                self._bind_manual_hosts(cluster, host_names or [],
+                                        credential_name)
+            except Exception:
+                self._unbind_hosts(cluster)
+                self.repos.clusters.delete(cluster.id)
+                raise
 
         return self._launch(cluster, plan, wait)
 
@@ -167,9 +177,10 @@ class ClusterService:
         self._spawn(cluster.id, work, wait)
 
     # ---- internals ----
-    def _bind_manual_hosts(
+    def _check_manual_hosts(
         self, cluster: Cluster, host_names: list[str], credential_name: str
     ) -> None:
+        """Read-only validation pass (no writes) before the cluster exists."""
         if not host_names:
             raise ValidationError("manual-mode create requires host names")
         if len(host_names) < cluster.spec.worker_count + 1:
@@ -178,12 +189,22 @@ class ClusterService:
                 f"(1 master + {cluster.spec.worker_count} workers)"
             )
         if credential_name:
-            cred = self.repos.credentials.get_by_name(credential_name)
+            self.repos.credentials.get_by_name(credential_name)
+        for hname in host_names:
+            host = self.repos.hosts.get_by_name(hname)
+            if host.cluster_id:
+                raise ConflictError(kind="host", name=hname)
+
+    def _bind_manual_hosts(
+        self, cluster: Cluster, host_names: list[str], credential_name: str
+    ) -> None:
+        cred = (
+            self.repos.credentials.get_by_name(credential_name)
+            if credential_name else None
+        )
         for i, hname in enumerate(host_names):
             host = self.repos.hosts.get_by_name(hname)
-            if host.cluster_id and host.cluster_id != cluster.id:
-                raise ConflictError(kind="host", name=hname)
-            if credential_name:
+            if cred is not None:
                 host.credential_id = cred.id
             host.cluster_id = cluster.id
             self.repos.hosts.save(host)
@@ -192,6 +213,13 @@ class ClusterService:
                 name=host.name, cluster_id=cluster.id, host_id=host.id,
                 role=role.value,
             ))
+
+    def _unbind_hosts(self, cluster: Cluster) -> None:
+        for node in self.repos.nodes.find(cluster_id=cluster.id):
+            self.repos.nodes.delete(node.id)
+        for host in self.repos.hosts.find(cluster_id=cluster.id):
+            host.cluster_id = ""
+            self.repos.hosts.save(host)
 
     def _provision(self, cluster: Cluster, plan: Plan) -> None:
         """Terraform leg of §3.1 (plan mode only)."""
@@ -224,11 +252,6 @@ class ClusterService:
         )
 
     def _context(self, cluster: Cluster, plan: Plan | None = None) -> AdmContext:
-        nodes = self.repos.nodes.find(cluster_id=cluster.id)
-        hosts = {
-            h.id: h for h in self.repos.hosts.find(cluster_id=cluster.id)
-        }
-        creds = {c.id: c for c in self.repos.credentials.list()}
         extra: dict = {}
         if isinstance(self.executor, SimulationExecutor) and (
             cluster.spec.tpu_enabled and plan is not None and plan.has_tpu()
@@ -240,18 +263,7 @@ class ClusterService:
                 0.85 * topo.theoretical_allreduce_busbw_gbps(), 1
             )
         extra.update(self.debug_extra_vars)
-        return AdmContext(
-            cluster=cluster,
-            nodes=nodes,
-            hosts_by_id=hosts,
-            credentials_by_id=creds,
-            plan=plan,
-            extra_vars=extra,
-            log_sink=lambda task_id, line: self.repos.task_logs.append(
-                cluster.id, task_id, [line]
-            ),
-            save_cluster=lambda c: self.repos.clusters.save(c),
-        )
+        return AdmContext.for_cluster(self.repos, cluster, plan, extra)
 
     def _launch(self, cluster: Cluster, plan: Plan | None, wait: bool) -> Cluster:
         def work():
@@ -305,11 +317,30 @@ class ClusterService:
                          f"cluster {cluster.name} Ready{detail}")
 
     def _spawn(self, cluster_id: str, work, wait: bool) -> None:
+        """One in-flight operation per cluster; entries self-remove on
+        completion so the registry stays bounded and delete can't race a
+        still-running create."""
+        with self._ops_lock:
+            existing = self._ops.get(cluster_id)
+            if existing is not None and existing.is_alive():
+                raise ConflictError(
+                    kind="cluster-operation", name=cluster_id,
+                    message="another operation is still running on this cluster",
+                )
+
+        def guarded():
+            try:
+                work()
+            finally:
+                with self._ops_lock:
+                    self._ops.pop(cluster_id, None)
+
         if wait:
-            work()
+            guarded()
             return
-        thread = threading.Thread(target=work, daemon=True)
-        self._ops[cluster_id] = thread
+        thread = threading.Thread(target=guarded, daemon=True)
+        with self._ops_lock:
+            self._ops[cluster_id] = thread
         thread.start()
 
     def wait_for(self, name: str, timeout_s: float = 3600.0) -> Cluster:

@@ -9,7 +9,7 @@ from __future__ import annotations
 
 import threading
 import time
-from datetime import datetime
+from datetime import datetime, timedelta
 
 from kubeoperator_tpu.utils.logging import get_logger
 
@@ -18,15 +18,19 @@ log = get_logger("service.cron")
 
 def cron_matches(expr: str, dt: datetime) -> bool:
     """Evaluate a 5-field cron expr (min hour dom month dow) at dt.
-    Supports *, N, */N, and comma lists."""
+    Supports *, N, */N, and comma lists, with standard-cron */N semantics:
+    steps start at the field's minimum (day-of-month/month are 1-based, so
+    '*/2' in dom fires on days 1,3,5,... like a real crontab)."""
     fields = expr.split()
     if len(fields) != 5:
         return False
     # cron dow: 0/7 = sunday; python weekday(): mon=0..sun=6
     cron_dow = (dt.weekday() + 1) % 7
-    values = (dt.minute, dt.hour, dt.day, dt.month, cron_dow)
+    # (value, field minimum) per cron field
+    values = ((dt.minute, 0), (dt.hour, 0), (dt.day, 1), (dt.month, 1),
+              (cron_dow, 0))
 
-    def match(field: str, value: int) -> bool:
+    def match(field: str, value: int, minval: int) -> bool:
         for part in field.split(","):
             if part == "*":
                 return True
@@ -35,7 +39,7 @@ def cron_matches(expr: str, dt: datetime) -> bool:
                     step = int(part[2:])
                 except ValueError:
                     return False
-                if step > 0 and value % step == 0:
+                if step > 0 and (value - minval) % step == 0:
                     return True
             else:
                 try:
@@ -47,7 +51,7 @@ def cron_matches(expr: str, dt: datetime) -> bool:
                     return False
         return False
 
-    return all(match(f, v) for f, v in zip(fields, values))
+    return all(match(f, v, m) for f, (v, m) in zip(fields, values))
 
 
 class CronService:
@@ -55,7 +59,7 @@ class CronService:
         self.services = services
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
-        self._last_minute: str = ""
+        self._last_tick: datetime | None = None
         self._health_last = 0.0
 
     def start(self) -> None:
@@ -109,11 +113,20 @@ class CronService:
 
     def _loop(self) -> None:
         while not self._stop.wait(10.0):
-            minute = datetime.now().strftime("%Y%m%d%H%M")
-            if minute == self._last_minute:
-                continue
-            self._last_minute = minute
-            try:
-                self.tick()
-            except Exception:
-                log.exception("cron tick crashed")
+            now = datetime.now().replace(second=0, microsecond=0)
+            if self._last_tick is None:
+                self._last_tick = now - timedelta(minutes=1)
+            # Catch up every minute since the last evaluated one, so a tick
+            # that runs long (a slow backup) cannot silently skip another
+            # strategy's fire time. Cap the catch-up window at one hour.
+            pending = []
+            cursor = self._last_tick + timedelta(minutes=1)
+            while cursor <= now and len(pending) < 60:
+                pending.append(cursor)
+                cursor += timedelta(minutes=1)
+            for minute in pending:
+                self._last_tick = minute
+                try:
+                    self.tick(minute)
+                except Exception:
+                    log.exception("cron tick crashed")

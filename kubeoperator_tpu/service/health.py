@@ -104,29 +104,11 @@ class HealthService:
         plan = (
             self.repos.plans.get(cluster.plan_id) if cluster.plan_id else None
         )
-        ctx = AdmContext(
-            cluster=cluster,
-            nodes=self.repos.nodes.find(cluster_id=cluster.id),
-            hosts_by_id={
-                h.id: h for h in self.repos.hosts.find(cluster_id=cluster.id)
-            },
-            credentials_by_id={c.id: c for c in self.repos.credentials.list()},
-            plan=plan,
-            log_sink=lambda task_id, line: self.repos.task_logs.append(
-                cluster.id, task_id, [line]
-            ),
-            save_cluster=lambda c: self.repos.clusters.save(c),
-        )
+        ctx = AdmContext.for_cluster(self.repos, cluster, plan)
         post = smoke_post if condition == "tpu-smoke-test" else None
         self.adm.run(ctx, [Phase(condition, playbook, post=post)])
         self.events.emit(cluster.id, "Normal", "Recovered",
                          f"recovery phase {condition} completed")
 
     def _inventory(self, cluster) -> dict:
-        from kubeoperator_tpu.executor.inventory import build_inventory
-
-        return build_inventory(
-            self.repos.nodes.find(cluster_id=cluster.id),
-            {h.id: h for h in self.repos.hosts.find(cluster_id=cluster.id)},
-            {c.id: c for c in self.repos.credentials.list()},
-        )
+        return AdmContext.for_cluster(self.repos, cluster).inventory()

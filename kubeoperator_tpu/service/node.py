@@ -117,16 +117,4 @@ class NodeService:
         plan = (
             self.repos.plans.get(cluster.plan_id) if cluster.plan_id else None
         )
-        return AdmContext(
-            cluster=cluster,
-            nodes=self.repos.nodes.find(cluster_id=cluster.id),
-            hosts_by_id={
-                h.id: h for h in self.repos.hosts.find(cluster_id=cluster.id)
-            },
-            credentials_by_id={c.id: c for c in self.repos.credentials.list()},
-            plan=plan,
-            log_sink=lambda task_id, line: self.repos.task_logs.append(
-                cluster.id, task_id, [line]
-            ),
-            save_cluster=lambda c: self.repos.clusters.save(c),
-        )
+        return AdmContext.for_cluster(self.repos, cluster, plan)

@@ -44,22 +44,10 @@ class UpgradeService:
         self.validate_hop(cluster.spec.k8s_version, target_version)
         cluster.status.phase = ClusterPhaseStatus.UPGRADING.value
         self.repos.clusters.save(cluster)
-        ctx = AdmContext(
-            cluster=cluster,
-            nodes=self.repos.nodes.find(cluster_id=cluster.id),
-            hosts_by_id={
-                h.id: h for h in self.repos.hosts.find(cluster_id=cluster.id)
-            },
-            credentials_by_id={c.id: c for c in self.repos.credentials.list()},
-            plan=(
-                self.repos.plans.get(cluster.plan_id)
-                if cluster.plan_id else None
-            ),
-            extra_vars={"target_k8s_version": target_version},
-            log_sink=lambda task_id, line: self.repos.task_logs.append(
-                cluster.id, task_id, [line]
-            ),
-            save_cluster=lambda c: self.repos.clusters.save(c),
+        ctx = AdmContext.for_cluster(
+            self.repos, cluster,
+            self.repos.plans.get(cluster.plan_id) if cluster.plan_id else None,
+            {"target_k8s_version": target_version},
         )
         try:
             self.adm.run(ctx, upgrade_phases())
