@@ -147,8 +147,11 @@ class Handlers:
 
     async def logout(self, request):
         token = request.headers.get("Authorization", "").removeprefix("Bearer ")
-        await run_sync(request, self.s.users.logout, token.strip())
-        return json_response({"ok": True})
+        token = token.strip() or request.cookies.get("ko_session", "")
+        await run_sync(request, self.s.users.logout, token)
+        resp = json_response({"ok": True})
+        resp.del_cookie("ko_session")
+        return resp
 
     async def whoami(self, request):
         return json_response(request["user"].to_public_dict())
@@ -186,10 +189,13 @@ class Handlers:
                                   request.query.get("project") or None)
         user = request["user"]
         if not user.is_admin:
-            def visible(c):
-                return bool(c.project_id) and \
-                    self.s.projects.role_of(user, c.project_id) is not None
-            clusters = [c for c in clusters if visible(c)]
+            # one membership query off-loop, then a set filter — never N
+            # per-cluster lookups on the event loop
+            memberships = await run_sync(
+                request, self.s.repos.project_members.find, user_id=user.id
+            )
+            member_of = {m.project_id for m in memberships}
+            clusters = [c for c in clusters if c.project_id in member_of]
         return json_response([c.to_public_dict() for c in clusters])
 
     async def create_cluster(self, request):
@@ -557,8 +563,19 @@ def create_app(services: Services) -> web.Application:
                     "master_count", "worker_count", "vars", "accelerator",
                     "tpu_type", "slice_topology", "num_slices",
                     "tpu_runtime_version"))
+    async def list_hosts(request):
+        hosts = await run_sync(request, services.hosts.list)
+        return json_response([x.to_public_dict() for x in hosts])
+
+    async def delete_host(request):
+        await run_sync(request, services.hosts.delete,
+                       request.match_info["name"])
+        return json_response({"ok": True})
+
+    r.add_get("/api/v1/hosts", list_hosts)
     r.add_post("/api/v1/hosts/register", admin_guard(h.register_host))
     r.add_post("/api/v1/hosts/{name}/facts", admin_guard(h.host_facts))
+    r.add_delete("/api/v1/hosts/{name}", admin_guard(delete_host))
     r.add_get("/api/v1/plans-tpu-catalog", h.tpu_catalog)
 
     r.add_get("/api/v1/projects", h.list_projects)

@@ -417,6 +417,19 @@ def build_parser() -> argparse.ArgumentParser:
     tsub = tpu.add_subparsers(dest="tpu_cmd", required=True)
     tsub.add_parser("catalog")
 
+    install_p = sub.add_parser("install", help="render/start the platform bundle")
+    install_p.add_argument("--dir", default="/opt/ko-tpu")
+    install_p.add_argument("--no-start", action="store_true")
+    status_p = sub.add_parser("status", help="platform health")
+    uninstall_p = sub.add_parser("uninstall")
+    uninstall_p.add_argument("--dir", default="/opt/ko-tpu")
+    uninstall_p.add_argument("--purge", action="store_true")
+    registry_p = sub.add_parser("registry")
+    rsub = registry_p.add_subparsers(dest="registry_cmd", required=True)
+    rverify = rsub.add_parser("verify", help="check an offline bundle dir")
+    rverify.add_argument("--bundle", required=True)
+    rsub.add_parser("manifest", help="print the offline artifact manifest")
+
     return p
 
 
@@ -427,6 +440,31 @@ def main(argv: list[str] | None = None) -> int:
         return 0
     if args.cmd == "server":
         return cmd_server(args)
+    if args.cmd == "install":
+        from kubeoperator_tpu.installer import install
+
+        _print(install(args.dir, start=not args.no_start))
+        return 0
+    if args.cmd == "status":
+        from kubeoperator_tpu.installer import status as platform_status
+
+        info = platform_status(args.server)
+        _print(info)
+        return 0 if info["healthy"] else 1
+    if args.cmd == "uninstall":
+        from kubeoperator_tpu.installer import uninstall
+
+        _print(uninstall(args.dir, purge_data=args.purge))
+        return 0
+    if args.cmd == "registry":
+        from kubeoperator_tpu.registry import bundle_manifest, verify_bundle
+
+        if args.registry_cmd == "manifest":
+            _print(bundle_manifest())
+            return 0
+        report = verify_bundle(args.bundle)
+        _print(report)
+        return 0 if not report["missing"] else 1
 
     client = LocalClient() if args.local else RestClient(args.server)
     if args.cmd == "login":

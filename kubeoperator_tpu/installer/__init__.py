@@ -1,0 +1,19 @@
+"""Platform installer (SURVEY.md §2.1 row 6: koctl + installer).
+
+The reference installs the platform air-gapped via docker-compose (server,
+ui, mysql, kobe, nexus, webkubectl, grafana). Our bundle composes: ko-server
+(API+UI), runner (gRPC executor), registry (offline artifacts), and an
+optional grafana. `koctl install` renders the compose file + app config into
+a target dir and starts it when a compose binary exists; `status`/`uninstall`
+manage the deployment. Single-box installs can skip docker entirely:
+`koctl server` runs the whole control plane in one process.
+"""
+
+from kubeoperator_tpu.installer.install import (
+    install,
+    render_bundle,
+    status,
+    uninstall,
+)
+
+__all__ = ["install", "render_bundle", "status", "uninstall"]

@@ -1,0 +1,136 @@
+"""koctl install/status/uninstall implementation."""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+
+import yaml
+
+from kubeoperator_tpu.utils.logging import get_logger
+from kubeoperator_tpu.version import __version__
+
+log = get_logger("installer")
+
+COMPOSE_TEMPLATE = {
+    "services": {
+        "ko-server": {
+            "image": "ko-tpu/server:{version}",
+            "restart": "always",
+            "ports": ["8080:8080"],
+            "volumes": [
+                "{data_dir}/db:/var/ko-tpu/db",
+                "{data_dir}/kubeconfigs:/var/ko-tpu/kubeconfigs",
+                "{data_dir}/config:/etc/ko-tpu",
+            ],
+            "environment": {
+                "KO_TPU_DB__PATH": "/var/ko-tpu/db/ko.db",
+                "KO_TPU_EXECUTOR__BACKEND": "auto",
+            },
+            "depends_on": ["ko-runner", "ko-registry"],
+        },
+        "ko-runner": {
+            # kobe-parity: the gRPC ansible runner as its own container
+            "image": "ko-tpu/runner:{version}",
+            "restart": "always",
+            "ports": ["8790:8790"],
+            "volumes": ["{data_dir}/ssh:/root/.ssh:ro"],
+        },
+        "ko-registry": {
+            # nexus-equivalent offline artifact registry (consumed, not built)
+            "image": "ko-tpu/registry:{version}",
+            "restart": "always",
+            "ports": ["8081:8081"],
+            "volumes": ["{bundle_dir}:/bundle:ro"],
+        },
+        "grafana": {
+            "image": "ko-tpu/grafana-bundled:{version}",
+            "restart": "always",
+            "ports": ["3000:3000"],
+            "profiles": ["observability"],
+        },
+    },
+}
+
+
+def render_bundle(target_dir: str, data_dir: str | None = None,
+                  bundle_dir: str | None = None) -> str:
+    """Write docker-compose.yml + default app.yaml into target_dir."""
+    os.makedirs(target_dir, exist_ok=True)
+    data_dir = data_dir or os.path.join(target_dir, "data")
+    bundle_dir = bundle_dir or os.path.join(target_dir, "bundle")
+    for sub in ("db", "kubeconfigs", "config", "ssh"):
+        os.makedirs(os.path.join(data_dir, sub), exist_ok=True)
+    os.makedirs(bundle_dir, exist_ok=True)
+
+    def _fmt(value):
+        if isinstance(value, str):
+            return value.format(version=__version__, data_dir=data_dir,
+                                bundle_dir=bundle_dir)
+        if isinstance(value, dict):
+            return {k: _fmt(v) for k, v in value.items()}
+        if isinstance(value, list):
+            return [_fmt(v) for v in value]
+        return value
+
+    compose = _fmt(COMPOSE_TEMPLATE)
+    compose_path = os.path.join(target_dir, "docker-compose.yml")
+    with open(compose_path, "w", encoding="utf-8") as f:
+        yaml.safe_dump(compose, f, sort_keys=False)
+
+    app_yaml = os.path.join(data_dir, "config", "app.yaml")
+    if not os.path.exists(app_yaml):
+        with open(app_yaml, "w", encoding="utf-8") as f:
+            yaml.safe_dump({
+                "server": {"bind_host": "0.0.0.0", "bind_port": 8080},
+                "registry": {"url": "http://ko-registry:8081"},
+            }, f)
+    log.info("installer bundle rendered at %s", target_dir)
+    return compose_path
+
+
+def _compose_cmd() -> list[str] | None:
+    if shutil.which("docker"):
+        return ["docker", "compose"]
+    if shutil.which("docker-compose"):
+        return ["docker-compose"]
+    return None
+
+
+def install(target_dir: str, start: bool = True) -> dict:
+    compose_path = render_bundle(target_dir)
+    result = {"compose": compose_path, "started": False}
+    cmd = _compose_cmd()
+    if start and cmd:
+        subprocess.run([*cmd, "-f", compose_path, "up", "-d"], check=True)
+        result["started"] = True
+    elif start:
+        result["note"] = (
+            "no docker/docker-compose binary found — bundle rendered only; "
+            "run `koctl server` for a single-process install"
+        )
+    return result
+
+
+def status(server_url: str = "http://127.0.0.1:8080") -> dict:
+    import requests
+
+    try:
+        resp = requests.get(f"{server_url}/healthz", timeout=5)
+        healthy = resp.status_code == 200
+    except requests.RequestException:
+        healthy = False
+    return {"server": server_url, "healthy": healthy, "version": __version__}
+
+
+def uninstall(target_dir: str, purge_data: bool = False) -> dict:
+    compose_path = os.path.join(target_dir, "docker-compose.yml")
+    cmd = _compose_cmd()
+    stopped = False
+    if cmd and os.path.exists(compose_path):
+        subprocess.run([*cmd, "-f", compose_path, "down"], check=False)
+        stopped = True
+    if purge_data:
+        shutil.rmtree(target_dir, ignore_errors=True)
+    return {"stopped": stopped, "purged": purge_data}

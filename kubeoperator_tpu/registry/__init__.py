@@ -1,0 +1,17 @@
+"""Offline artifact registry story (SURVEY.md §1 'Offline registry', §7 hard
+part (c)).
+
+nexus itself is consumed as an artifact, not rebuilt (§7 'What NOT to
+rebuild'). What the framework owns is the *contract*: the manifest of every
+artifact an air-gapped install needs — with the TPU additions (pinned
+jax[tpu] wheels per runtime version, TPU device-plugin and JobSet images)
+replacing every GPU artifact [BASELINE: no GPU package] — plus a bundle
+verifier and a minimal HTTP server for single-box demos.
+"""
+
+from kubeoperator_tpu.registry.manifest import (
+    bundle_manifest,
+    verify_bundle,
+)
+
+__all__ = ["bundle_manifest", "verify_bundle"]

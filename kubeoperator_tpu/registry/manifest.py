@@ -1,0 +1,83 @@
+"""Offline bundle manifest + verifier.
+
+The manifest is derived from the version module's K8s support matrix and the
+TPU generation registry, so adding a runtime version or generation updates
+the offline contract automatically — no hand-maintained artifact list to
+drift (the reference tracks this in nexus repo configs by hand).
+"""
+
+from __future__ import annotations
+
+import os
+
+from kubeoperator_tpu.parallel.topology import GENERATIONS
+from kubeoperator_tpu.version import SUPPORTED_K8S_VERSIONS, __version__
+
+# wheel versions pinned against each TPU-VM runtime (the tpu-host role
+# installs exactly these — SURVEY.md §7 hard part (c))
+JAX_PIN_PER_RUNTIME = {
+    gen.default_runtime_version: "0.9.0" for gen in GENERATIONS.values()
+}
+
+ARCHITECTURES = ("amd64", "arm64")
+
+
+def bundle_manifest() -> dict:
+    """Everything an air-gapped install must be able to serve."""
+    k8s_debs = []
+    for version in SUPPORTED_K8S_VERSIONS:
+        bare = version.lstrip("v")
+        for arch in ARCHITECTURES:
+            k8s_debs += [
+                f"apt/{arch}/kubeadm_{bare}_{arch}.deb",
+                f"apt/{arch}/kubelet_{bare}_{arch}.deb",
+                f"apt/{arch}/kubectl_{bare}_{arch}.deb",
+            ]
+    base_debs = [
+        f"apt/{arch}/{pkg}.deb"
+        for arch in ARCHITECTURES
+        for pkg in ("containerd", "etcd", "haproxy", "keepalived", "helm",
+                    "cri-tools", "socat", "conntrack", "ipset", "ipvsadm",
+                    "chrony")
+    ]
+    images = [
+        "images/pause-3.9.tar",
+        "images/calico-node.tar",
+        "images/flannel.tar",
+        "images/cilium.tar",
+        "images/metrics-server.tar",
+        "images/ingress-nginx.tar",
+        "images/traefik.tar",
+        "images/prometheus.tar",
+        "images/grafana.tar",
+        "images/loki.tar",
+        # TPU path (replaces nvidia-device-plugin / dcgm / nccl-tests images)
+        f"images/ko-tpu-device-plugin-v1.0.tar",
+        "images/jobset-controller.tar",
+        f"images/ko-tpu-jax-runtime-{__version__}.tar",
+    ]
+    wheels = [
+        f"pypi/jax_tpu-{pin}-{runtime}.whl"
+        for runtime, pin in sorted(JAX_PIN_PER_RUNTIME.items())
+    ]
+    charts = ["charts/prometheus.tgz", "charts/grafana.tgz",
+              "charts/loki.tgz", "charts/cilium.tgz"]
+    return {
+        "version": __version__,
+        "k8s_versions": list(SUPPORTED_K8S_VERSIONS),
+        "artifacts": sorted(k8s_debs + base_debs + images + wheels + charts),
+    }
+
+
+def verify_bundle(bundle_dir: str) -> dict:
+    """Check a bundle dir against the manifest; returns {present, missing}."""
+    manifest = bundle_manifest()
+    present, missing = [], []
+    for artifact in manifest["artifacts"]:
+        (present if os.path.exists(os.path.join(bundle_dir, artifact))
+         else missing).append(artifact)
+    return {
+        "total": len(manifest["artifacts"]),
+        "present": len(present),
+        "missing": missing,
+    }

@@ -1,0 +1,64 @@
+"""Minimal offline-registry HTTP server for single-box/demo installs:
+`python -m kubeoperator_tpu.registry.serve --bundle DIR --port 8081`.
+
+Production installs point `registry.url` at the bundled nexus instead; this
+server only speaks plain file GET + /manifest + /healthz, which is all the
+content roles' templates require of a mirror.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+from http.server import SimpleHTTPRequestHandler, ThreadingHTTPServer
+
+from kubeoperator_tpu.registry.manifest import bundle_manifest, verify_bundle
+
+
+def make_handler(bundle_dir: str):
+    class Handler(SimpleHTTPRequestHandler):
+        def __init__(self, *args, **kw):
+            super().__init__(*args, directory=bundle_dir, **kw)
+
+        def do_GET(self):  # noqa: N802 (stdlib API)
+            if self.path == "/healthz":
+                self._json({"status": "ok"})
+            elif self.path == "/manifest":
+                self._json(bundle_manifest())
+            elif self.path == "/verify":
+                self._json(verify_bundle(bundle_dir))
+            else:
+                super().do_GET()
+
+        def _json(self, data: dict) -> None:
+            body = json.dumps(data).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, fmt, *args):  # quiet
+            pass
+
+    return Handler
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--bundle", default="bundle")
+    parser.add_argument("--port", type=int, default=8081)
+    parser.add_argument("--host", default="0.0.0.0")
+    args = parser.parse_args()
+    os.makedirs(args.bundle, exist_ok=True)
+    server = ThreadingHTTPServer((args.host, args.port),
+                                 make_handler(args.bundle))
+    print(f"ko-tpu offline registry serving {args.bundle} "
+          f"on {args.host}:{args.port}")
+    server.serve_forever()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

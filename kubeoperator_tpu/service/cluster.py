@@ -183,6 +183,8 @@ class ClusterService:
         """Read-only validation pass (no writes) before the cluster exists."""
         if not host_names:
             raise ValidationError("manual-mode create requires host names")
+        if len(set(host_names)) != len(host_names):
+            raise ValidationError("duplicate host names in cluster create")
         if len(host_names) < cluster.spec.worker_count + 1:
             raise ValidationError(
                 f"need >= {cluster.spec.worker_count + 1} hosts "
@@ -320,14 +322,6 @@ class ClusterService:
         """One in-flight operation per cluster; entries self-remove on
         completion so the registry stays bounded and delete can't race a
         still-running create."""
-        with self._ops_lock:
-            existing = self._ops.get(cluster_id)
-            if existing is not None and existing.is_alive():
-                raise ConflictError(
-                    kind="cluster-operation", name=cluster_id,
-                    message="another operation is still running on this cluster",
-                )
-
         def guarded():
             try:
                 work()
@@ -335,13 +329,22 @@ class ClusterService:
                 with self._ops_lock:
                     self._ops.pop(cluster_id, None)
 
+        thread = (threading.current_thread() if wait
+                  else threading.Thread(target=guarded, daemon=True))
+        # check + register under ONE lock hold, or two concurrent calls both
+        # pass the check and race each other on the same cluster
+        with self._ops_lock:
+            existing = self._ops.get(cluster_id)
+            if existing is not None and existing.is_alive():
+                raise ConflictError(
+                    kind="cluster-operation", name=cluster_id,
+                    message="another operation is still running on this cluster",
+                )
+            self._ops[cluster_id] = thread
         if wait:
             guarded()
-            return
-        thread = threading.Thread(target=guarded, daemon=True)
-        with self._ops_lock:
-            self._ops[cluster_id] = thread
-        thread.start()
+        else:
+            thread.start()
 
     def wait_for(self, name: str, timeout_s: float = 3600.0) -> Cluster:
         cluster = self.get(name)

@@ -118,10 +118,19 @@ class CronService:
                 self._last_tick = now - timedelta(minutes=1)
             # Catch up every minute since the last evaluated one, so a tick
             # that runs long (a slow backup) cannot silently skip another
-            # strategy's fire time. Cap the catch-up window at one hour.
+            # strategy's fire time. Anything older than one hour is dropped
+            # (a resumed laptop must not replay a day of stale backups).
+            window_start = now - timedelta(minutes=60)
+            if self._last_tick < window_start:
+                dropped = int(
+                    (window_start - self._last_tick).total_seconds() // 60
+                )
+                log.warning("cron: dropping %d stale minutes after suspend",
+                            dropped)
+                self._last_tick = window_start
             pending = []
             cursor = self._last_tick + timedelta(minutes=1)
-            while cursor <= now and len(pending) < 60:
+            while cursor <= now:
                 pending.append(cursor)
                 cursor += timedelta(minutes=1)
             for minute in pending:

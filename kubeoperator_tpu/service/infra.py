@@ -137,6 +137,14 @@ class HostService(_Crud):
         if host.credential_id:
             self.repos.credentials.get(host.credential_id)
 
+    def delete(self, name: str) -> None:
+        host = self.repo.get_by_name(name)
+        if host.cluster_id:
+            raise ValidationError(
+                f"host {name} is bound to a cluster; remove the node first"
+            )
+        self.repo.delete(host.id)
+
     def register(
         self, name: str, ip: str, credential_name: str, port: int = 22
     ) -> Host:

@@ -1,0 +1,257 @@
+/* KO-TPU console logic — vanilla JS against /api/v1 (cookie session). */
+"use strict";
+
+const $ = (sel) => document.querySelector(sel);
+const api = async (method, path, body) => {
+  const resp = await fetch(path, {
+    method,
+    headers: body ? { "Content-Type": "application/json" } : {},
+    body: body ? JSON.stringify(body) : undefined,
+    credentials: "same-origin",
+  });
+  if (resp.status === 401) { showLogin(); throw new Error("unauthenticated"); }
+  const data = resp.headers.get("Content-Type")?.includes("json")
+    ? await resp.json() : await resp.text();
+  if (!resp.ok) throw new Error(data.message || resp.statusText);
+  return data;
+};
+
+/* ---------- auth ---------- */
+function showLogin() {
+  $("#login-view").hidden = false;
+  $("#app-view").hidden = true;
+}
+async function boot() {
+  try {
+    const me = await api("GET", "/api/v1/auth/whoami");
+    $("#whoami").textContent = me.name + (me.is_admin ? " (admin)" : "");
+    $("#login-view").hidden = true;
+    $("#app-view").hidden = false;
+    refreshAll();
+    setInterval(refreshClusters, 4000);
+  } catch { /* login shown */ }
+}
+$("#login-btn").addEventListener("click", async () => {
+  try {
+    await api("POST", "/api/v1/auth/login", {
+      username: $("#login-user").value, password: $("#login-pass").value,
+    });
+    $("#login-error").textContent = "";
+    boot();
+  } catch (e) { $("#login-error").textContent = e.message; }
+});
+
+/* ---------- tabs ---------- */
+document.querySelectorAll(".tab").forEach((b) =>
+  b.addEventListener("click", () => {
+    document.querySelectorAll(".tab").forEach((x) => x.classList.remove("active"));
+    b.classList.add("active");
+    ["clusters", "hosts", "plans", "events"].forEach((t) => {
+      $("#tab-" + t).hidden = t !== b.dataset.tab;
+    });
+  }));
+
+/* ---------- clusters ---------- */
+let logStream = null;
+async function refreshClusters() {
+  if ($("#tab-clusters").hidden || !$("#cluster-detail").hidden) return;
+  const clusters = await api("GET", "/api/v1/clusters");
+  const list = $("#cluster-list");
+  list.innerHTML = "";
+  if (!clusters.length) {
+    list.innerHTML = '<div class="muted">No clusters yet — create one.</div>';
+  }
+  for (const c of clusters) {
+    const card = document.createElement("div");
+    card.className = "card";
+    const conds = (c.status.conditions || []).map((x) =>
+      `<span class="cond ${x.status}">${x.name}</span>`).join("");
+    const smoke = c.status.smoke_chips
+      ? `<div class="smoke">psum ${c.status.smoke_gbps} GB/s · ${c.status.smoke_chips} chips</div>`
+      : "";
+    card.innerHTML = `
+      <h4>${c.name}</h4>
+      <div><span class="phase ${c.status.phase}">${c.status.phase}</span>
+        <span class="muted"> · ${c.spec.k8s_version} · ${c.spec.cni}</span></div>
+      <div class="conds">${conds}</div>${smoke}
+      <div class="row">
+        <button data-open="${c.name}">Open</button>
+        <button data-del="${c.name}">Delete</button>
+      </div>`;
+    card.querySelector("[data-open]").addEventListener("click", () => openCluster(c.name));
+    card.querySelector("[data-del]").addEventListener("click", async () => {
+      if (confirm(`Delete cluster ${c.name}?`)) {
+        await api("DELETE", `/api/v1/clusters/${c.name}`);
+        refreshClusters();
+      }
+    });
+    list.appendChild(card);
+  }
+}
+
+async function openCluster(name) {
+  const c = await api("GET", `/api/v1/clusters/${name}`);
+  const nodes = await api("GET", `/api/v1/clusters/${name}/nodes`);
+  const events = await api("GET", `/api/v1/clusters/${name}/events`);
+  const detail = $("#cluster-detail");
+  $("#cluster-list").hidden = true;
+  detail.hidden = false;
+  const conds = (c.status.conditions || []).map((x) =>
+    `<span class="cond ${x.status}" title="${x.message || ""}">${x.name}` +
+    (x.finished_at && x.started_at
+      ? ` ${(x.finished_at - x.started_at).toFixed(1)}s` : "") +
+    `</span>`).join("");
+  detail.innerHTML = `
+    <div class="detail-head">
+      <h3>${c.name} — <span class="phase ${c.status.phase}">${c.status.phase}</span></h3>
+      <div class="row">
+        <button id="d-retry">Retry</button>
+        <button id="d-health">Health</button>
+        <button id="d-back">← Back</button>
+      </div>
+    </div>
+    <div class="conds">${conds}</div>
+    ${c.status.smoke_chips ? `<div class="smoke">smoke: psum ${c.status.smoke_gbps} GB/s over ${c.status.smoke_chips} chips</div>` : ""}
+    <div id="d-health-out"></div>
+    <h3>Nodes</h3>
+    <table class="grid"><tr><th>name</th><th>role</th><th>status</th></tr>
+    ${nodes.map((n) => `<tr><td>${n.name}</td><td>${n.role}</td><td>${n.status}</td></tr>`).join("")}
+    </table>
+    <h3>Live logs</h3>
+    <div class="logbox" id="d-logs"></div>
+    <h3>Events</h3>
+    <div>${events.map((e) =>
+      `<div class="feed-item ${e.type}"><span class="when">${new Date(e.created_at * 1000).toLocaleTimeString()}</span>[${e.reason}] ${e.message}</div>`
+    ).join("")}</div>`;
+  $("#d-back").addEventListener("click", () => {
+    detail.hidden = true;
+    $("#cluster-list").hidden = false;
+    if (logStream) { logStream.close(); logStream = null; }
+    refreshClusters();
+  });
+  $("#d-retry").addEventListener("click", async () => {
+    await api("POST", `/api/v1/clusters/${name}/retry`);
+    openCluster(name);
+  });
+  $("#d-health").addEventListener("click", async () => {
+    const h = await api("GET", `/api/v1/clusters/${name}/health`);
+    $("#d-health-out").innerHTML = '<div class="conds">' + h.probes.map((p) =>
+      `<span class="cond ${p.ok ? "OK" : "Failed"}">${p.name}</span>`).join("") + "</div>";
+  });
+  // live logs over SSE
+  const box = $("#d-logs");
+  box.textContent = "";
+  if (logStream) logStream.close();
+  logStream = new EventSource(`/api/v1/clusters/${name}/logs?follow=1`);
+  logStream.onmessage = (ev) => {
+    const { line } = JSON.parse(ev.data);
+    box.textContent += line + "\n";
+    box.scrollTop = box.scrollHeight;
+  };
+  logStream.addEventListener("end", () => logStream.close());
+}
+
+/* ---------- wizard ---------- */
+let planCache = [];
+$("#new-cluster-btn").addEventListener("click", async () => {
+  planCache = await api("GET", "/api/v1/plans");
+  const sel = $("#wz-plan");
+  sel.innerHTML = planCache.map((p) =>
+    `<option value="${p.name}">${p.name} (${p.provider}${p.accelerator === "tpu" ? " · " + p.tpu_type : ""})</option>`).join("");
+  const vers = await api("GET", "/api/v1/version");
+  $("#wz-k8s").innerHTML = vers.supported_k8s_versions.map((v) =>
+    `<option>${v}</option>`).join("");
+  $("#wz-k8s").value = vers.supported_k8s_versions[2] || vers.supported_k8s_versions[0];
+  renderTopology();
+  $("#wizard").showModal();
+});
+$("#wz-cancel").addEventListener("click", () => $("#wizard").close());
+$("#wz-mode").addEventListener("change", () => {
+  const manual = $("#wz-mode").value === "manual";
+  $("#wz-plan-row").hidden = manual;
+  $("#wz-manual-row").hidden = !manual;
+});
+$("#wz-plan").addEventListener("change", renderTopology);
+
+function renderTopology() {
+  const plan = planCache.find((p) => p.name === $("#wz-plan").value);
+  const box = $("#wz-topology");
+  box.innerHTML = "";
+  if (!plan || plan.accelerator !== "tpu") return;
+  // visualize the ICI mesh: one square per chip, grid per topology
+  api("GET", "/api/v1/plans-tpu-catalog").then((catalog) => {
+    const topo = catalog.find((t) => t.accelerator_type === plan.tpu_type);
+    if (!topo) return;
+    const dims = topo.ici_mesh.split("x").map(Number);
+    const cols = dims.length >= 2 ? dims[1] * (dims[2] || 1) : dims[0];
+    const mesh = document.createElement("div");
+    mesh.className = "mesh";
+    mesh.style.gridTemplateColumns = `repeat(${cols}, 16px)`;
+    for (let i = 0; i < topo.chips; i++) {
+      const chip = document.createElement("div");
+      chip.className = "chip";
+      mesh.appendChild(chip);
+    }
+    const meta = document.createElement("div");
+    meta.className = "topo-meta";
+    meta.innerHTML = `${topo.accelerator_type} — ${topo.chips} chips · ` +
+      `${topo.total_hosts} host${topo.total_hosts > 1 ? "s" : ""} · ` +
+      `ICI ${topo.ici_mesh}<br>runtime ${topo.runtime_version}`;
+    box.append(mesh, meta);
+  });
+}
+
+$("#wz-create").addEventListener("click", async () => {
+  const body = { name: $("#wz-name").value, spec: { k8s_version: $("#wz-k8s").value } };
+  if ($("#wz-mode").value === "plan") {
+    body.provision_mode = "plan";
+    body.plan = $("#wz-plan").value;
+  } else {
+    body.provision_mode = "manual";
+    body.hosts = $("#wz-hosts").value.split(",").map((s) => s.trim()).filter(Boolean);
+    body.spec.worker_count = parseInt($("#wz-workers").value || "1", 10);
+  }
+  try {
+    await api("POST", "/api/v1/clusters", body);
+    $("#wz-error").textContent = "";
+    $("#wizard").close();
+    refreshClusters();
+  } catch (e) { $("#wz-error").textContent = e.message; }
+});
+
+/* ---------- hosts / plans / events tabs ---------- */
+async function refreshAll() {
+  refreshClusters();
+  const hosts = await api("GET", "/api/v1/hosts").catch(() => []);
+  $("#hosts-table").innerHTML =
+    "<tr><th>name</th><th>ip</th><th>status</th><th>TPU</th></tr>" +
+    hosts.map((h) => `<tr><td>${h.name}</td><td>${h.ip}</td><td>${h.status}</td>
+      <td>${h.tpu_chips > 0 ? `${h.tpu_chips} chips · slice ${h.tpu_slice_id} · worker ${h.tpu_worker_id}` : "—"}</td></tr>`).join("");
+
+  const plans = await api("GET", "/api/v1/plans").catch(() => []);
+  $("#plan-list").innerHTML = plans.map((p) => `
+    <div class="card"><h4>${p.name}</h4>
+      <div class="muted">${p.provider} · masters ${p.master_count} · workers ${p.worker_count}</div>
+      ${p.accelerator === "tpu" ? `<div class="smoke">${p.tpu_type} · ${p.num_slices} slice(s)</div>` : ""}
+    </div>`).join("") || '<div class="muted">No plans defined.</div>';
+
+  const catalog = await api("GET", "/api/v1/plans-tpu-catalog").catch(() => []);
+  $("#tpu-catalog").innerHTML =
+    "<tr><th>type</th><th>chips</th><th>hosts</th><th>ICI mesh</th><th>runtime</th></tr>" +
+    catalog.map((t) => `<tr><td>${t.accelerator_type}</td><td>${t.chips}</td>
+      <td>${t.total_hosts}</td><td>${t.ici_mesh}</td><td>${t.runtime_version}</td></tr>`).join("");
+
+  const clusters = await api("GET", "/api/v1/clusters").catch(() => []);
+  const feeds = [];
+  for (const c of clusters.slice(0, 10)) {
+    const events = await api("GET", `/api/v1/clusters/${c.name}/events`).catch(() => []);
+    events.forEach((e) => feeds.push({ ...e, cluster: c.name }));
+  }
+  feeds.sort((a, b) => b.created_at - a.created_at);
+  $("#event-feed").innerHTML = feeds.map((e) =>
+    `<div class="feed-item ${e.type}"><span class="when">${new Date(e.created_at * 1000).toLocaleString()}</span>
+     <b>${e.cluster}</b> [${e.reason}] ${e.message}</div>`).join("") ||
+    '<div class="muted">No activity yet.</div>';
+}
+
+boot();

@@ -1,0 +1,87 @@
+"""Installer bundle rendering + offline registry manifest/verify/serve
+(SURVEY.md §2.1 rows 6/8, §7 hard part (c))."""
+
+import os
+import threading
+
+import requests
+import yaml
+
+from kubeoperator_tpu.installer import install, render_bundle, uninstall
+from kubeoperator_tpu.registry import bundle_manifest, verify_bundle
+
+
+class TestInstaller:
+    def test_render_bundle(self, tmp_path):
+        compose_path = render_bundle(str(tmp_path / "opt"))
+        compose = yaml.safe_load(open(compose_path))
+        services = compose["services"]
+        assert set(services) == {"ko-server", "ko-runner", "ko-registry",
+                                 "grafana"}
+        assert services["ko-server"]["depends_on"] == ["ko-runner",
+                                                       "ko-registry"]
+        # no GPU runtime hooks in the platform compose
+        text = open(compose_path).read().lower()
+        assert "nvidia" not in text and "gpu" not in text
+        # app config rendered
+        assert os.path.exists(tmp_path / "opt" / "data" / "config" / "app.yaml")
+
+    def test_install_without_docker_degrades(self, tmp_path):
+        result = install(str(tmp_path / "opt"), start=True)
+        assert result["started"] is False
+        assert "note" in result
+
+    def test_uninstall(self, tmp_path):
+        install(str(tmp_path / "opt"), start=False)
+        result = uninstall(str(tmp_path / "opt"), purge_data=True)
+        assert result["purged"]
+        assert not os.path.exists(tmp_path / "opt")
+
+
+class TestRegistry:
+    def test_manifest_covers_tpu_and_no_gpu(self):
+        manifest = bundle_manifest()
+        arts = "\n".join(manifest["artifacts"])
+        assert "ko-tpu-device-plugin" in arts
+        assert "jobset-controller" in arts
+        assert "jax_tpu" in arts
+        for bad in ("nvidia", "cuda", "nccl"):
+            assert bad not in arts.lower()
+        # every supported k8s version has kubeadm/kubelet/kubectl per arch
+        for version in manifest["k8s_versions"]:
+            bare = version.lstrip("v")
+            assert f"apt/amd64/kubeadm_{bare}_amd64.deb" in arts
+            assert f"apt/arm64/kubelet_{bare}_arm64.deb" in arts
+
+    def test_verify_bundle_reports_missing_and_present(self, tmp_path):
+        report = verify_bundle(str(tmp_path))
+        assert report["present"] == 0 and len(report["missing"]) == report["total"]
+        first = bundle_manifest()["artifacts"][0]
+        path = tmp_path / first
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_bytes(b"x")
+        report = verify_bundle(str(tmp_path))
+        assert report["present"] == 1
+
+    def test_serve_endpoints(self, tmp_path):
+        from kubeoperator_tpu.registry.serve import make_handler
+        from http.server import ThreadingHTTPServer
+
+        (tmp_path / "images").mkdir()
+        (tmp_path / "images" / "pause-3.9.tar").write_bytes(b"tarball")
+        server = ThreadingHTTPServer(("127.0.0.1", 0),
+                                     make_handler(str(tmp_path)))
+        port = server.server_address[1]
+        thread = threading.Thread(target=server.serve_forever, daemon=True)
+        thread.start()
+        try:
+            base = f"http://127.0.0.1:{port}"
+            assert requests.get(f"{base}/healthz", timeout=5).json()["status"] == "ok"
+            manifest = requests.get(f"{base}/manifest", timeout=5).json()
+            assert manifest["artifacts"]
+            verify = requests.get(f"{base}/verify", timeout=5).json()
+            assert verify["present"] == 1
+            resp = requests.get(f"{base}/images/pause-3.9.tar", timeout=5)
+            assert resp.content == b"tarball"
+        finally:
+            server.shutdown()

@@ -165,6 +165,45 @@ class TestScale:
             svc.nodes.scale_down("tiny", names[1])  # last worker
 
 
+class TestGuards:
+    def test_duplicate_host_names_rejected(self, svc):
+        names = register_fleet(svc, 2)
+        with pytest.raises(ValidationError):
+            svc.clusters.create("dupes", spec=ClusterSpec(worker_count=1),
+                                host_names=[names[0], names[0]], wait=True)
+        # and no phantom cluster/bindings were left behind
+        with pytest.raises(Exception):
+            svc.clusters.get("dupes")
+        assert svc.hosts.get(names[0]).cluster_id == ""
+
+    def test_bound_host_cannot_be_deleted(self, svc):
+        names = register_fleet(svc, 2)
+        svc.clusters.create("hostdel", spec=ClusterSpec(worker_count=1),
+                            host_names=names, wait=True)
+        with pytest.raises(ValidationError):
+            svc.hosts.delete(names[1])
+        svc.clusters.delete("hostdel", wait=True)
+        svc.hosts.delete(names[1])  # unbound now -> allowed
+
+    def test_concurrent_ops_on_same_cluster_conflict(self, svc):
+        import threading
+
+        names = register_fleet(svc, 2)
+        svc.clusters.create("busy", spec=ClusterSpec(worker_count=1),
+                            host_names=names, wait=True)
+        cluster = svc.clusters.get("busy")
+        # simulate an in-flight op by registering a live foreign thread
+        blocker = threading.Event()
+        t = threading.Thread(target=blocker.wait, daemon=True)
+        t.start()
+        svc.clusters._ops[cluster.id] = t
+        from kubeoperator_tpu.utils.errors import ConflictError
+
+        with pytest.raises(ConflictError):
+            svc.clusters.retry("busy", wait=True)
+        blocker.set()
+
+
 class TestUpgrade:
     def test_one_minor_hop_gate(self, svc):
         names = register_fleet(svc, 2)
