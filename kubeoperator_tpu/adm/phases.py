@@ -17,26 +17,31 @@ from kubeoperator_tpu.executor.base import TaskResult
 from kubeoperator_tpu.utils.errors import PhaseError
 
 SMOKE_MARKER = "KO_TPU_SMOKE_RESULT"
-_SMOKE_RE = re.compile(re.escape(SMOKE_MARKER) + r"\s*(\{.*\})")
 
 
 def _tpu(ctx: AdmContext) -> bool:
     return ctx.cluster.spec.tpu_enabled
 
 
-def parse_smoke_result(lines: list[str]) -> dict | None:
-    """Find the smoke Job's result line in phase output.
-
-    The tpu-smoke-test role prints the psum Job's final log line, which the
-    workload (ops/psum_smoke.py) emits as `KO_TPU_SMOKE_RESULT {json}`."""
+def parse_marker_json(marker: str, lines: list[str]) -> dict | None:
+    """Find the last `<MARKER> {json}` line in phase output — the contract
+    content roles use to hand structured results (smoke GB/s, CIS totals)
+    back to the platform."""
+    pattern = re.compile(re.escape(marker) + r"\s*(\{.*\})")
     for line in reversed(lines):
-        m = _SMOKE_RE.search(line)
+        m = pattern.search(line)
         if m:
             try:
                 return json.loads(m.group(1))
             except json.JSONDecodeError:
                 continue
     return None
+
+
+def parse_smoke_result(lines: list[str]) -> dict | None:
+    """The tpu-smoke-test role prints the psum Job's final log line, which
+    the workload (ops/psum_smoke.py) emits as `KO_TPU_SMOKE_RESULT {json}`."""
+    return parse_marker_json(SMOKE_MARKER, lines)
 
 
 def smoke_post(ctx: AdmContext, result: TaskResult, lines: list[str]) -> None:

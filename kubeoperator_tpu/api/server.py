@@ -412,6 +412,28 @@ class Handlers:
                        request.match_info["component"])
         return json_response({"ok": True})
 
+    # ---- cis scans ----
+    async def run_cis_scan(self, request):
+        scan = await run_sync(request, self.s.cis.run_scan,
+                              request.match_info["name"])
+        return json_response(scan.to_public_dict(), status=201)
+
+    async def list_cis_scans(self, request):
+        scans = await run_sync(request, self.s.cis.list,
+                               request.match_info["name"])
+        return json_response([s.to_public_dict() for s in scans])
+
+    async def get_cis_scan(self, request):
+        scan = await run_sync(request, self.s.cis.get,
+                              request.match_info["name"],
+                              request.match_info["scan"])
+        return json_response(scan.to_public_dict())
+
+    async def delete_cis_scan(self, request):
+        await run_sync(request, self.s.cis.delete,
+                       request.match_info["name"], request.match_info["scan"])
+        return json_response({"ok": True})
+
     # ---- events ----
     async def cluster_events(self, request):
         cluster = await run_sync(request, self.s.clusters.get,
@@ -547,6 +569,14 @@ def create_app(services: Services) -> web.Application:
                  cluster_guard(h.uninstall_component, manage))
     r.add_get("/api/v1/clusters/{name}/events",
               cluster_guard(h.cluster_events, view))
+    r.add_post("/api/v1/clusters/{name}/cis-scans",
+               cluster_guard(h.run_cis_scan, manage))
+    r.add_get("/api/v1/clusters/{name}/cis-scans",
+              cluster_guard(h.list_cis_scans, view))
+    r.add_get("/api/v1/clusters/{name}/cis-scans/{scan}",
+              cluster_guard(h.get_cis_scan, view))
+    r.add_delete("/api/v1/clusters/{name}/cis-scans/{scan}",
+                 cluster_guard(h.delete_cis_scan, manage))
 
     r.add_get("/api/v1/backup-accounts", h.list_backup_accounts)
     r.add_post("/api/v1/backup-accounts", admin_guard(h.create_backup_account))

@@ -143,6 +143,10 @@ class LocalClient:
                 return s.health.check(name).to_dict()
             case ("GET", ["clusters", name, "events"]):
                 return pub(s.events.list(s.clusters.get(name).id))
+            case ("POST", ["clusters", name, "cis-scans"]):
+                return pub(s.cis.run_scan(name))
+            case ("GET", ["clusters", name, "cis-scans"]):
+                return pub(s.cis.list(name))
             case ("POST", ["clusters", name, "components"]):
                 return pub(s.components.install(name, body["component"],
                                                 body.get("vars")))
@@ -284,6 +288,17 @@ def cmd_cluster(client, args) -> int:
                         f"/api/v1/clusters/{args.name}/nodes/{args.remove}")
             print(f"node {args.remove} removed")
         return 0
+    if args.cluster_cmd == "cis-scan":
+        if args.list:
+            _print(client.call("GET", f"/api/v1/clusters/{args.name}/cis-scans"))
+            return 0
+        scan = client.call("POST", f"/api/v1/clusters/{args.name}/cis-scans")
+        print(f"CIS scan {scan['status']} ({scan['policy']}): "
+              f"pass={scan['total_pass']} fail={scan['total_fail']} "
+              f"warn={scan['total_warn']}")
+        for check in scan.get("checks", []):
+            print(f"  [{check['status']}] {check['id']} {check['text']}")
+        return 0 if scan["status"] != "Failed" else 1
     if args.cluster_cmd == "upgrade":
         _print(client.call("POST", f"/api/v1/clusters/{args.name}/upgrade",
                            {"version": args.version}))
@@ -400,6 +415,10 @@ def build_parser() -> argparse.ArgumentParser:
     scale.add_argument("name")
     scale.add_argument("--add", default="")
     scale.add_argument("--remove", default="")
+    cis = csub.add_parser("cis-scan")
+    cis.add_argument("name")
+    cis.add_argument("--list", action="store_true",
+                     help="list past scans instead of running one")
     upgrade = csub.add_parser("upgrade")
     upgrade.add_argument("name")
     upgrade.add_argument("--version", required=True)

@@ -1,0 +1,62 @@
+#!/usr/bin/env python3
+"""Condense kube-bench --json output (possibly several concatenated JSON
+documents, one per scan Job) into ONE `KO_CIS_RESULT {json}` line with
+aggregated totals plus the non-passing checks. Runs on the master node via
+the cis-scan role; stdin = raw job logs, stdout = the marker line."""
+
+import json
+import sys
+
+
+def iter_json_docs(text):
+    decoder = json.JSONDecoder()
+    i = 0
+    while i < len(text):
+        while i < len(text) and text[i] not in "{[":
+            i += 1
+        if i >= len(text):
+            return
+        try:
+            doc, end = decoder.raw_decode(text, i)
+        except ValueError:
+            i += 1
+            continue
+        yield doc
+        i = end
+
+
+def main():
+    totals = {"pass": 0, "fail": 0, "warn": 0, "info": 0}
+    checks = []
+    policy = ""
+    for doc in iter_json_docs(sys.stdin.read()):
+        for control in doc.get("Controls", []):
+            policy = policy or control.get("version", "")
+            for group in control.get("tests", []):
+                for check in group.get("results", []):
+                    state = str(check.get("status", "")).lower()
+                    if state in totals:
+                        totals[state] += 1
+                    if state in ("fail", "warn"):
+                        checks.append({
+                            "id": check.get("test_number", ""),
+                            "text": check.get("test_desc", ""),
+                            "status": state.upper(),
+                            "node": doc.get("node_type", ""),
+                            "remediation": (check.get("remediation", "") or "")[:500],
+                        })
+        t = doc.get("Totals", {})
+        if t and not doc.get("Controls"):
+            totals["pass"] += int(t.get("total_pass", 0))
+            totals["fail"] += int(t.get("total_fail", 0))
+            totals["warn"] += int(t.get("total_warn", 0))
+            totals["info"] += int(t.get("total_info", 0))
+    print("KO_CIS_RESULT " + json.dumps({
+        "policy": policy or "cis",
+        **totals,
+        "checks": checks,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -31,6 +31,7 @@ from kubeoperator_tpu.models.backup import BackupAccount, BackupFile, BackupStra
 from kubeoperator_tpu.models.tenancy import Project, ProjectMember, Role, User
 from kubeoperator_tpu.models.event import Event, Message, TaskLogChunk
 from kubeoperator_tpu.models.component import ClusterComponent
+from kubeoperator_tpu.models.security import CisCheck, CisScan
 
 __all__ = [
     "Entity",
@@ -41,4 +42,5 @@ __all__ = [
     "Project", "ProjectMember", "Role", "User",
     "Event", "Message", "TaskLogChunk",
     "ClusterComponent",
+    "CisCheck", "CisScan",
 ]

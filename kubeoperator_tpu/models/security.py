@@ -1,0 +1,57 @@
+"""CIS security-scan schema (SURVEY.md §1 'Day-2 operations': CIS security
+scans via kube-bench).
+
+One `CisScan` row per run: the kube-bench Job's aggregated totals plus the
+individual non-passing checks, so the UI/CLI can render a findings table
+without storing the full benchmark output.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from kubeoperator_tpu.models.base import Entity
+from kubeoperator_tpu.utils.errors import ValidationError
+
+CIS_SCAN_STATUSES = ("Running", "Passed", "Warn", "Failed", "Error")
+
+
+@dataclass
+class CisCheck:
+    """One non-passing benchmark check (failures and warnings only)."""
+
+    id: str = ""          # benchmark check id, e.g. "1.2.16"
+    text: str = ""        # check description
+    status: str = ""      # FAIL | WARN
+    node: str = ""        # node the finding came from ("" = cluster-wide)
+    remediation: str = ""
+
+
+@dataclass
+class CisScan(Entity):
+    __nested__ = {"checks": CisCheck}
+
+    cluster_id: str = ""
+    policy: str = "cis-1.8"    # benchmark version kube-bench ran
+    status: str = "Running"    # Running | Passed | Warn | Failed | Error
+    total_pass: int = 0
+    total_fail: int = 0
+    total_warn: int = 0
+    total_info: int = 0
+    checks: list = field(default_factory=list)   # non-passing CisChecks
+    message: str = ""
+
+    def validate(self) -> None:
+        if not self.cluster_id:
+            raise ValidationError("cis scan requires a cluster")
+        if self.status not in CIS_SCAN_STATUSES:
+            raise ValidationError(f"unknown cis scan status {self.status}")
+
+    def grade(self) -> str:
+        """Overall result from the totals: any FAIL ⇒ Failed, else any WARN ⇒
+        Warn, else Passed."""
+        if self.total_fail > 0:
+            return "Failed"
+        if self.total_warn > 0:
+            return "Warn"
+        return "Passed"

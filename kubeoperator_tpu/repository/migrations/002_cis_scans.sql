@@ -1,0 +1,10 @@
+-- CIS security scans (kube-bench runs) — SURVEY.md §1 Day-2 operations.
+CREATE TABLE IF NOT EXISTS cis_scans (
+    id TEXT PRIMARY KEY,
+    cluster_id TEXT NOT NULL,
+    status TEXT NOT NULL,
+    data TEXT NOT NULL,
+    created_at REAL NOT NULL,
+    updated_at REAL NOT NULL
+);
+CREATE INDEX IF NOT EXISTS idx_cis_scans_cluster ON cis_scans (cluster_id);

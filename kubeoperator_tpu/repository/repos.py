@@ -14,6 +14,7 @@ from kubeoperator_tpu.models import (
     BackupAccount,
     BackupFile,
     BackupStrategy,
+    CisScan,
     Cluster,
     ClusterComponent,
     Credential,
@@ -241,6 +242,10 @@ class ComponentRepo(EntityRepo[ClusterComponent]):
     table, entity, columns = "components", ClusterComponent, ("cluster_id", "name")
 
 
+class CisScanRepo(EntityRepo[CisScan]):
+    table, entity, columns = "cis_scans", CisScan, ("cluster_id", "status")
+
+
 class Repositories:
     """One bundle handed to every service (the reference injects repos into
     services the same way, SURVEY.md §2.1 row 1b)."""
@@ -264,3 +269,4 @@ class Repositories:
         self.messages = MessageRepo(db)
         self.task_logs = TaskLogChunkRepo(db)
         self.components = ComponentRepo(db)
+        self.cis_scans = CisScanRepo(db)

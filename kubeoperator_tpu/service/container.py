@@ -32,6 +32,7 @@ class Services:
             ZoneService,
         )
         from kubeoperator_tpu.service.node import NodeService
+        from kubeoperator_tpu.service.security import CisService
         from kubeoperator_tpu.service.tenancy import ProjectService, UserService
         from kubeoperator_tpu.service.upgrade import UpgradeService
 
@@ -57,6 +58,7 @@ class Services:
         self.backups = BackupService(repos, executor, self.events)
         self.health = HealthService(repos, executor, self.events)
         self.components = ComponentService(repos, executor, self.events)
+        self.cis = CisService(repos, executor, self.events)
         self.cron = CronService(self)
 
     def close(self) -> None:

@@ -1,0 +1,106 @@
+"""CisService — CIS benchmark scans via kube-bench (SURVEY.md §1 'Day-2
+operations: CIS security scans (kube-bench)').
+
+Flow mirrors the smoke test's marker contract: the cis-scan role condenses
+kube-bench output into one `KO_CIS_RESULT {json}` line; the adm post-hook
+parses it into the scan row. Scans persist per cluster so the UI/CLI can show
+a findings history; a Failed grade raises a Warning event (message center
+fan-out picks it up).
+"""
+
+from __future__ import annotations
+
+from kubeoperator_tpu.adm import AdmContext, ClusterAdm
+from kubeoperator_tpu.adm.engine import Phase
+from kubeoperator_tpu.adm.phases import parse_marker_json
+from kubeoperator_tpu.executor import Executor
+from kubeoperator_tpu.models import CisCheck, CisScan
+from kubeoperator_tpu.repository import Repositories
+from kubeoperator_tpu.utils.errors import NotFoundError, PhaseError, ValidationError
+
+CIS_MARKER = "KO_CIS_RESULT"
+
+
+def parse_cis_result(lines: list[str]) -> dict | None:
+    return parse_marker_json(CIS_MARKER, lines)
+
+
+class CisService:
+    def __init__(self, repos: Repositories, executor: Executor, events):
+        self.repos = repos
+        self.events = events
+        self.adm = ClusterAdm(executor)
+
+    def run_scan(self, cluster_name: str) -> CisScan:
+        cluster = self.repos.clusters.get_by_name(cluster_name)
+        if not self.repos.nodes.find(cluster_id=cluster.id):
+            raise ValidationError(
+                f"cluster {cluster_name} has no nodes to scan"
+            )
+        scan = CisScan(cluster_id=cluster.id)
+        scan.validate()
+        self.repos.cis_scans.save(scan)
+
+        def post(ctx: AdmContext, result, lines: list[str]) -> None:
+            data = parse_cis_result(lines)
+            if data is None:
+                raise PhaseError("cis-scan", "no KO_CIS_RESULT in scan output")
+            scan.policy = str(data.get("policy") or scan.policy)
+            scan.total_pass = int(data.get("pass") or 0)
+            scan.total_fail = int(data.get("fail") or 0)
+            scan.total_warn = int(data.get("warn") or 0)
+            scan.total_info = int(data.get("info") or 0)
+            scan.checks = [
+                CisCheck(
+                    id=str(c.get("id", "")), text=str(c.get("text", "")),
+                    status=str(c.get("status", "")), node=str(c.get("node", "")),
+                    remediation=str(c.get("remediation", "")),
+                )
+                for c in data.get("checks", [])
+                if isinstance(c, dict)
+            ]
+
+        plan = self.repos.plans.get(cluster.plan_id) if cluster.plan_id else None
+        ctx = AdmContext.for_cluster(self.repos, cluster, plan)
+        try:
+            self.adm.run(ctx, [Phase("cis-scan", "50-cis-scan.yml", post=post)])
+        except PhaseError as e:
+            scan.status = "Error"
+            scan.message = e.message
+            self.repos.cis_scans.save(scan)
+            raise
+        scan.status = scan.grade()
+        self.repos.cis_scans.save(scan)
+        if scan.status == "Failed":
+            self.events.emit(
+                cluster.id, "Warning", "CisScanFailed",
+                f"CIS scan found {scan.total_fail} failing checks on "
+                f"{cluster_name}",
+            )
+        else:
+            self.events.emit(
+                cluster.id, "Normal", "CisScanCompleted",
+                f"CIS scan {scan.status.lower()} on {cluster_name} "
+                f"(pass={scan.total_pass} warn={scan.total_warn})",
+            )
+        return scan
+
+    def list(self, cluster_name: str) -> list[CisScan]:
+        cluster = self.repos.clusters.get_by_name(cluster_name)
+        return self.repos.cis_scans.find(cluster_id=cluster.id)
+
+    def get(self, cluster_name: str, scan_id: str) -> CisScan:
+        """Scan lookup scoped to the cluster the caller was authorized for —
+        a scan id from another cluster must 404, not leak findings."""
+        return self._owned_scan(cluster_name, scan_id)
+
+    def delete(self, cluster_name: str, scan_id: str) -> None:
+        self._owned_scan(cluster_name, scan_id)
+        self.repos.cis_scans.delete(scan_id)
+
+    def _owned_scan(self, cluster_name: str, scan_id: str) -> CisScan:
+        cluster = self.repos.clusters.get_by_name(cluster_name)
+        scan = self.repos.cis_scans.get(scan_id)
+        if scan.cluster_id != cluster.id:
+            raise NotFoundError(kind="cis_scan", name=scan_id)
+        return scan

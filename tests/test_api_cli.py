@@ -145,6 +145,14 @@ class TestClusterFlow:
         health = http.get(f"{base}/api/v1/clusters/northstar/health").json()
         assert health["healthy"]
 
+        # CIS scan over HTTP (simulation emits the canned cis-1.8 result)
+        scan = http.post(
+            f"{base}/api/v1/clusters/northstar/cis-scans").json()
+        assert scan["status"] in ("Passed", "Warn")
+        scans = http.get(
+            f"{base}/api/v1/clusters/northstar/cis-scans").json()
+        assert scans and scans[0]["policy"] == "cis-1.8"
+
         assert http.delete(
             f"{base}/api/v1/clusters/northstar").status_code == 202
 

@@ -1,0 +1,157 @@
+"""CIS scan subsystem: marker parsing, scan flow against the simulation
+executor, grading, failure path, and the condense helper the role ships."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+import kubeoperator_tpu
+
+from kubeoperator_tpu.models import CisScan, ClusterSpec, Credential
+from kubeoperator_tpu.service import build_services
+from kubeoperator_tpu.service.security import parse_cis_result
+from kubeoperator_tpu.utils.config import load_config
+from kubeoperator_tpu.utils.errors import PhaseError, ValidationError
+
+CONDENSE = os.path.join(
+    os.path.dirname(kubeoperator_tpu.__file__),
+    "content", "roles", "cis-scan", "files", "ko-cis-condense.py",
+)
+
+
+@pytest.fixture()
+def svc(tmp_path):
+    config = load_config(
+        path="/nonexistent",
+        env={},
+        overrides={
+            "db": {"path": str(tmp_path / "svc.db")},
+            "executor": {"backend": "simulation"},
+            "provisioner": {"work_dir": str(tmp_path / "tf")},
+            "cron": {"health_check_interval_s": 0},
+        },
+    )
+    services = build_services(config, simulate=True)
+    yield services
+    services.close()
+
+
+def make_cluster(svc, name="sec"):
+    try:
+        svc.credentials.create(Credential(name="ssh", password="pw"))
+    except Exception:
+        pass  # fleet already registered by a prior call in this test
+    names = []
+    for i in range(3):
+        hname = f"{name}-h{i}"
+        svc.hosts.register(hname, f"10.1.{len(name)}.{i + 1}", "ssh")
+        names.append(hname)
+    svc.clusters.create(name, spec=ClusterSpec(worker_count=2),
+                        host_names=names, wait=True)
+    return svc.clusters.get(name)
+
+
+class TestParse:
+    def test_parse_marker(self):
+        lines = [
+            "TASK [emit cis result line] ****",
+            'KO_CIS_RESULT {"policy": "cis-1.8", "pass": 10, "fail": 1, '
+            '"warn": 2, "info": 0, "checks": []}',
+            "PLAY RECAP ****",
+        ]
+        data = parse_cis_result(lines)
+        assert data["fail"] == 1 and data["policy"] == "cis-1.8"
+
+    def test_parse_missing(self):
+        assert parse_cis_result(["nothing here"]) is None
+
+    def test_grade(self):
+        assert CisScan(cluster_id="c", total_fail=1).grade() == "Failed"
+        assert CisScan(cluster_id="c", total_warn=3).grade() == "Warn"
+        assert CisScan(cluster_id="c", total_pass=9).grade() == "Passed"
+
+
+class TestScanFlow:
+    def test_scan_on_simulated_cluster(self, svc):
+        make_cluster(svc)
+        scan = svc.cis.run_scan("sec")
+        # simulation emits the canned cis-1.8 result with 2 warnings
+        assert scan.status == "Warn"
+        assert scan.total_pass > 0 and scan.total_fail == 0
+        assert len(scan.checks) == 2
+        assert scan.checks[0].status == "WARN"
+        assert svc.cis.list("sec")[0].id == scan.id
+        assert svc.cis.get("sec", scan.id).policy == "cis-1.8"
+
+    def test_scan_requires_nodes(self, svc):
+        with pytest.raises(Exception):
+            svc.cis.run_scan("missing")
+        # cluster row with no nodes
+        svc.repos.clusters.save(
+            __import__("kubeoperator_tpu.models", fromlist=["Cluster"])
+            .Cluster(name="empty")
+        )
+        with pytest.raises(ValidationError):
+            svc.cis.run_scan("empty")
+
+    def test_failed_scan_run_marks_error(self, svc, monkeypatch):
+        """A phase failure must land the scan row in Error with the message
+        persisted (not leave it stuck Running)."""
+        make_cluster(svc)
+
+        def boom(ctx, phases):
+            raise PhaseError("cis-scan", "kube-bench job did not complete")
+
+        monkeypatch.setattr(svc.cis.adm, "run", boom)
+        with pytest.raises(PhaseError):
+            svc.cis.run_scan("sec")
+        scans = svc.cis.list("sec")
+        assert len(scans) == 1
+        assert scans[0].status == "Error"
+        assert "kube-bench" in scans[0].message
+
+    def test_delete_scan_scoped_to_cluster(self, svc):
+        make_cluster(svc)
+        scan = svc.cis.run_scan("sec")
+        other = make_cluster(svc, "sec2")
+        assert other is not None
+        # cross-cluster scan ids must 404 for both read and delete (IDOR)
+        with pytest.raises(Exception):
+            svc.cis.get("sec2", scan.id)
+        with pytest.raises(Exception):
+            svc.cis.delete("sec2", scan.id)
+        svc.cis.delete("sec", scan.id)
+        assert svc.cis.list("sec") == []
+
+
+class TestCondenseHelper:
+    def test_condense_kube_bench_json(self):
+        doc = {
+            "Controls": [{
+                "version": "cis-1.8",
+                "tests": [{
+                    "results": [
+                        {"test_number": "1.1.1", "test_desc": "ok check",
+                         "status": "PASS"},
+                        {"test_number": "1.2.3", "test_desc": "bad check",
+                         "status": "FAIL", "remediation": "fix it"},
+                        {"test_number": "1.4.5", "test_desc": "meh check",
+                         "status": "WARN"},
+                    ],
+                }],
+            }],
+            "node_type": "master",
+        }
+        out = subprocess.run(
+            [sys.executable, CONDENSE], input=json.dumps(doc) + "\n" +
+            json.dumps(doc),
+            capture_output=True, text=True, check=True,
+        ).stdout
+        data = parse_cis_result(out.splitlines())
+        assert data["pass"] == 2 and data["fail"] == 2 and data["warn"] == 2
+        assert data["policy"] == "cis-1.8"
+        assert {c["id"] for c in data["checks"]} == {"1.2.3", "1.4.5"}
+        assert data["checks"][0]["remediation"] == "fix it"
