@@ -434,6 +434,99 @@ class Handlers:
                        request.match_info["name"], request.match_info["scan"])
         return json_response({"ok": True})
 
+    # ---- web terminal (webkubectl analog) ----
+    def _owned_terminal(self, request):
+        """Attach guard: only the opener (or an admin) may touch a session."""
+        session = self.s.terminals.get(request.match_info["session"])
+        user = request["user"]
+        if not user.is_admin and session.user_id != user.id:
+            from kubeoperator_tpu.utils.errors import ForbiddenError
+
+            raise ForbiddenError(action="attach to another user's terminal")
+        return session
+
+    async def open_terminal(self, request):
+        # The shell runs as the server process (containerized in the platform
+        # bundle, but still the control-plane trust domain), so opening is
+        # admin-only unless the operator explicitly extends it to project
+        # managers via terminal.allow_project_managers.
+        if not request["user"].is_admin and not self.s.config.get(
+            "terminal.allow_project_managers", False
+        ):
+            from kubeoperator_tpu.utils.errors import ForbiddenError
+
+            raise ForbiddenError(action="opening a terminal (admin-only)")
+        session = await run_sync(request, self.s.terminals.open,
+                                 request.match_info["name"],
+                                 request["user"].id)
+        return json_response(
+            {"id": session.id, "cluster": session.cluster_name}, status=201
+        )
+
+    async def list_terminals(self, request):
+        _require_admin(request)
+        return json_response(await run_sync(request, self.s.terminals.list))
+
+    async def terminal_input(self, request):
+        session = self._owned_terminal(request)
+        body = await request.json()
+        data = body.get("data", "")
+        await run_sync(request, session.write, data.encode())
+        return json_response({"ok": True})
+
+    async def terminal_output(self, request):
+        session = self._owned_terminal(request)
+        after = int(request.query.get("after", "-1"))
+        if request.query.get("follow") != "1":
+            chunks = await run_sync(request, session.read_since, after)
+            return json_response({
+                "alive": session.alive,
+                "chunks": [
+                    {"seq": s, "data": d.decode("utf-8", "replace")}
+                    for s, d in chunks
+                ],
+            })
+        resp = web.StreamResponse(headers={
+            "Content-Type": "text/event-stream",
+            "Cache-Control": "no-cache",
+        })
+        await resp.prepare(request)
+        async def flush(after_seq: int) -> int:
+            chunks = await run_sync(request, session.read_since, after_seq)
+            for s, d in chunks:
+                payload = json.dumps(
+                    {"seq": s, "data": d.decode("utf-8", "replace")}
+                )
+                await resp.write(f"data: {payload}\n\n".encode())
+            return chunks[-1][0] if chunks else after_seq
+
+        idle = 0.0
+        while idle < 60.0 and session.alive:
+            new_after = await flush(after)
+            if new_after != after:
+                idle = 0.0
+                after = new_after
+            else:
+                idle += 0.2
+                await asyncio.sleep(0.2)
+        # final drain: the shell's last output lands in the buffer just
+        # before `alive` flips, after the loop's last read
+        await flush(after)
+        await resp.write(b"event: end\ndata: {}\n\n")
+        return resp
+
+    async def terminal_resize(self, request):
+        session = self._owned_terminal(request)
+        body = await request.json()
+        session.resize(int(body.get("rows", 24)), int(body.get("cols", 80)))
+        return json_response({"ok": True})
+
+    async def close_terminal(self, request):
+        self._owned_terminal(request)
+        await run_sync(request, self.s.terminals.close,
+                       request.match_info["session"])
+        return json_response({"ok": True})
+
     # ---- events ----
     async def cluster_events(self, request):
         cluster = await run_sync(request, self.s.clusters.get,
@@ -577,6 +670,13 @@ def create_app(services: Services) -> web.Application:
               cluster_guard(h.get_cis_scan, view))
     r.add_delete("/api/v1/clusters/{name}/cis-scans/{scan}",
                  cluster_guard(h.delete_cis_scan, manage))
+    r.add_post("/api/v1/clusters/{name}/terminal",
+               cluster_guard(h.open_terminal, manage))
+    r.add_get("/api/v1/terminal", h.list_terminals)
+    r.add_post("/api/v1/terminal/{session}/input", h.terminal_input)
+    r.add_get("/api/v1/terminal/{session}/output", h.terminal_output)
+    r.add_post("/api/v1/terminal/{session}/resize", h.terminal_resize)
+    r.add_delete("/api/v1/terminal/{session}", h.close_terminal)
 
     r.add_get("/api/v1/backup-accounts", h.list_backup_accounts)
     r.add_post("/api/v1/backup-accounts", admin_guard(h.create_backup_account))

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 
 from kubeoperator_tpu.adm import AdmContext, ClusterAdm, create_phases, reset_phases
 from kubeoperator_tpu.executor import Executor, SimulationExecutor
@@ -352,3 +353,17 @@ class ClusterService:
         if thread is not None:
             thread.join(timeout_s)
         return self.get(name)
+
+    def wait_all(self, timeout_s: float = 30.0) -> None:
+        """Join every in-flight operation thread — graceful-shutdown hook so
+        closing the DB can never yank it out from under a running op."""
+        deadline = time.monotonic() + timeout_s
+        with self._ops_lock:
+            threads = list(self._ops.values())
+        for thread in threads:
+            if thread is threading.current_thread():
+                continue
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            thread.join(remaining)

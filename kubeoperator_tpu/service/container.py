@@ -60,9 +60,14 @@ class Services:
         self.components = ComponentService(repos, executor, self.events)
         self.cis = CisService(repos, executor, self.events)
         self.cron = CronService(self)
+        from kubeoperator_tpu.terminal import TerminalManager
+
+        self.terminals = TerminalManager(repos, config)
 
     def close(self) -> None:
         self.cron.stop()
+        self.terminals.shutdown()
+        self.clusters.wait_all()
         self.repos.db.close()
 
 

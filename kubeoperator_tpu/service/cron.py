@@ -99,6 +99,10 @@ class CronService:
                                 cluster.name, e)
                     actions.append(f"backup-failed:{cluster.name}")
 
+        reaped = self.services.terminals.reap()
+        if reaped:
+            actions.append(f"terminal-reap:{reaped}")
+
         interval = float(cfg.get("cron.health_check_interval_s", 300))
         if interval > 0 and time.time() - self._health_last >= interval:
             self._health_last = time.time()

@@ -1,0 +1,13 @@
+"""Web terminal into managed clusters (SURVEY.md §2.1 row 7: the reference
+ships webkubectl — a gotty-based browser terminal with kubectl preloaded
+against the cluster's kubeconfig).
+
+Our equivalent: a PTY session manager (`TerminalManager`) the API layer
+exposes as create/input/output/resize/close endpoints; output is polled or
+SSE-streamed the same way task logs are, so the web console needs no
+websocket stack.
+"""
+
+from kubeoperator_tpu.terminal.manager import TerminalManager, TerminalSession
+
+__all__ = ["TerminalManager", "TerminalSession"]

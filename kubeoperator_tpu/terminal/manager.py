@@ -1,0 +1,235 @@
+"""PTY session manager behind the web terminal.
+
+Each session is a real PTY running a kubectl-ready shell: the cluster's
+kubeconfig is materialized to a 0600 temp file and exported as KUBECONFIG,
+so `kubectl get nodes` works immediately (the reference's webkubectl does the
+same inside its container). A reader thread drains the PTY master into a
+bounded, seq-numbered chunk buffer the API polls/streams; sessions die on
+idle timeout (reaped by the cron tick), process exit, or explicit close.
+
+Trust model: the shell runs as the server process — inside the platform
+bundle's server container in production, but always in the control-plane
+trust domain. The API therefore gates opening to admins by default
+(`terminal.allow_project_managers` widens it), and attach/input/output are
+restricted to the opening user.
+"""
+
+from __future__ import annotations
+
+import fcntl
+import os
+import pty
+import signal
+import struct
+import subprocess
+import tempfile
+import termios
+import threading
+import time
+
+from kubeoperator_tpu.utils.errors import NotFoundError, ValidationError
+from kubeoperator_tpu.utils.ids import new_id, now_ts
+from kubeoperator_tpu.utils.logging import get_logger
+
+log = get_logger("terminal")
+
+# Bounded scrollback per session: the web client keeps its own history; the
+# server buffer only has to cover poll gaps.
+MAX_BUFFERED_CHUNKS = 2048
+
+
+class TerminalSession:
+    def __init__(self, session_id: str, cluster_name: str, argv: list[str],
+                 env: dict[str, str], kubeconfig_path: str = "",
+                 user_id: str = "") -> None:
+        self.id = session_id
+        self.cluster_name = cluster_name
+        self.user_id = user_id  # opener; only they (or an admin) may attach
+        self.created_at = now_ts()
+        self.last_active = now_ts()
+        self._kubeconfig_path = kubeconfig_path
+        self._lock = threading.Lock()
+        self._chunks: list[tuple[int, bytes]] = []
+        self._next_seq = 0
+        self._closed = False
+
+        master, slave = pty.openpty()
+        self._master = master
+        try:
+            self.process = subprocess.Popen(
+                argv, stdin=slave, stdout=slave, stderr=slave,
+                env=env, start_new_session=True, close_fds=True,
+            )
+        except OSError:
+            os.close(master)
+            raise
+        finally:
+            os.close(slave)
+        self._reader = threading.Thread(target=self._drain, daemon=True)
+        self._reader.start()
+
+    # ---- IO ----
+    def _drain(self) -> None:
+        while True:
+            try:
+                data = os.read(self._master, 4096)
+            except OSError:
+                break
+            if not data:
+                break
+            with self._lock:
+                self._chunks.append((self._next_seq, data))
+                self._next_seq += 1
+                if len(self._chunks) > MAX_BUFFERED_CHUNKS:
+                    del self._chunks[: len(self._chunks) - MAX_BUFFERED_CHUNKS]
+        self.close()
+
+    def write(self, data: bytes) -> None:
+        # under the lock so close() can never shut the fd mid-write (a
+        # reused fd number would silently receive the keystrokes)
+        with self._lock:
+            if self._closed:
+                raise ValidationError("terminal session is closed")
+            self.last_active = now_ts()
+            os.write(self._master, data)
+
+    def read_since(self, after_seq: int = -1) -> list[tuple[int, bytes]]:
+        self.last_active = now_ts()
+        with self._lock:
+            return [(s, d) for s, d in self._chunks if s > after_seq]
+
+    def resize(self, rows: int, cols: int) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            winsize = struct.pack("HHHH", max(1, rows), max(1, cols), 0, 0)
+            fcntl.ioctl(self._master, termios.TIOCSWINSZ, winsize)
+
+    @property
+    def alive(self) -> bool:
+        return not self._closed and self.process.poll() is None
+
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        if self.process.poll() is None:
+            try:
+                os.killpg(self.process.pid, signal.SIGHUP)
+            except ProcessLookupError:
+                pass
+            try:
+                self.process.wait(timeout=2)
+            except subprocess.TimeoutExpired:
+                os.killpg(self.process.pid, signal.SIGKILL)
+                self.process.wait(timeout=2)
+        # fd close back under the lock: write()/resize() hold it, and _closed
+        # is already set, so no thread can race the fd from here on
+        with self._lock:
+            try:
+                os.close(self._master)
+            except OSError:
+                pass
+        if self._kubeconfig_path:
+            try:
+                os.unlink(self._kubeconfig_path)
+            except OSError:
+                pass
+
+
+class TerminalManager:
+    """Owns all live sessions; enforces limits and idle reaping."""
+
+    def __init__(self, repos, config) -> None:
+        self.repos = repos
+        self.shell = config.get("terminal.shell", "/bin/bash")
+        self.max_sessions = int(config.get("terminal.max_sessions", 16))
+        self.idle_timeout_s = float(config.get("terminal.idle_timeout_s", 900))
+        self._sessions: dict[str, TerminalSession] = {}
+        self._lock = threading.Lock()
+
+    def open(self, cluster_name: str, user_id: str = "") -> TerminalSession:
+        cluster = self.repos.clusters.get_by_name(cluster_name)
+        if not cluster.kubeconfig:
+            raise ValidationError(
+                f"cluster {cluster_name} has no kubeconfig; "
+                "terminal requires a deployed cluster"
+            )
+        self.reap()
+        fd, kc_path = tempfile.mkstemp(prefix="ko-term-", suffix=".conf")
+        with os.fdopen(fd, "w", encoding="utf-8") as f:
+            f.write(cluster.kubeconfig)
+        os.chmod(kc_path, 0o600)
+        env = {
+            "TERM": "xterm-256color",
+            "PATH": os.environ.get("PATH", "/usr/bin:/bin"),
+            "HOME": os.environ.get("HOME", "/tmp"),
+            "KUBECONFIG": kc_path,
+            "PS1": f"[{cluster_name}] \\w $ ",
+        }
+        # check + spawn + register under ONE lock hold so concurrent opens
+        # cannot overshoot max_sessions; the spawn is fast (fork+exec)
+        with self._lock:
+            if len(self._sessions) >= self.max_sessions:
+                os.unlink(kc_path)
+                raise ValidationError(
+                    f"terminal session limit ({self.max_sessions}) reached"
+                )
+            try:
+                session = TerminalSession(
+                    new_id(), cluster_name, [self.shell, "-i"], env, kc_path,
+                    user_id=user_id,
+                )
+            except OSError as e:
+                os.unlink(kc_path)  # never leave a kubeconfig behind
+                raise ValidationError(
+                    f"terminal shell {self.shell!r} failed to start: {e}"
+                )
+            self._sessions[session.id] = session
+        log.info("terminal session %s opened into %s", session.id, cluster_name)
+        return session
+
+    def get(self, session_id: str) -> TerminalSession:
+        with self._lock:
+            session = self._sessions.get(session_id)
+        if session is None:
+            raise NotFoundError(kind="terminal", name=session_id)
+        return session
+
+    def close(self, session_id: str) -> None:
+        with self._lock:
+            session = self._sessions.pop(session_id, None)
+        if session is not None:
+            session.close()
+            log.info("terminal session %s closed", session_id)
+
+    def reap(self) -> int:
+        """Close dead/idle sessions; returns how many were reaped."""
+        cutoff = now_ts() - self.idle_timeout_s
+        with self._lock:
+            doomed = [
+                sid for sid, s in self._sessions.items()
+                if not s.alive or s.last_active < cutoff
+            ]
+        for sid in doomed:
+            self.close(sid)
+        return len(doomed)
+
+    def list(self) -> list[dict]:
+        self.reap()
+        with self._lock:
+            return [
+                {
+                    "id": s.id, "cluster": s.cluster_name,
+                    "created_at": s.created_at, "alive": s.alive,
+                }
+                for s in self._sessions.values()
+            ]
+
+    def shutdown(self) -> None:
+        with self._lock:
+            sessions = list(self._sessions.values())
+            self._sessions.clear()
+        for s in sessions:
+            s.close()

@@ -26,3 +26,70 @@ import pytest  # noqa: E402
 def tmp_db(tmp_path):
     """Fresh on-disk SQLite DB path (``:memory:`` breaks across threads)."""
     return str(tmp_path / "ko_test.db")
+
+
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.fixture()
+def server(tmp_path):
+    """Live API server on a real socket in a background thread (shared by the
+    API, CLI, and terminal suites)."""
+    import asyncio
+    import threading
+
+    from aiohttp import web
+
+    from kubeoperator_tpu.api import create_app
+    from kubeoperator_tpu.service import build_services
+    from kubeoperator_tpu.utils.config import load_config
+
+    config = load_config(path="/nonexistent", env={}, overrides={
+        "db": {"path": str(tmp_path / "api.db")},
+        "executor": {"backend": "simulation"},
+        "provisioner": {"work_dir": str(tmp_path / "tf")},
+        "cron": {"health_check_interval_s": 0},
+    })
+    services = build_services(config, simulate=True)
+    services.users.create("root", password="secret123", is_admin=True)
+    app = create_app(services)
+    port = _free_port()
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+
+    async def _start():
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", port)
+        await site.start()
+        started.set()
+
+    def run():
+        asyncio.set_event_loop(loop)
+        loop.run_until_complete(_start())
+        loop.run_forever()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    assert started.wait(10)
+    yield f"http://127.0.0.1:{port}", services
+    loop.call_soon_threadsafe(loop.stop)
+    services.close()
+
+
+@pytest.fixture()
+def client(server):
+    import requests
+
+    base, services = server
+    session = requests.Session()
+    resp = session.post(f"{base}/api/v1/auth/login",
+                        json={"username": "root", "password": "secret123"})
+    assert resp.status_code == 200
+    session.headers["Authorization"] = f"Bearer {resp.json()['token']}"
+    return base, session, services

@@ -1,0 +1,187 @@
+"""Web terminal: PTY session lifecycle against a real shell, kubeconfig
+materialization, idle reaping, and the HTTP surface (open → input → output →
+close) over a live server."""
+
+import time
+
+import pytest
+
+from kubeoperator_tpu.repository import Database, Repositories
+from kubeoperator_tpu.models import Cluster
+from kubeoperator_tpu.terminal import TerminalManager
+from kubeoperator_tpu.utils.config import load_config
+from kubeoperator_tpu.utils.errors import NotFoundError, ValidationError
+
+FAKE_KUBECONFIG = "apiVersion: v1\nkind: Config\nclusters: []\n"
+
+
+@pytest.fixture()
+def repos(tmp_db):
+    db = Database(tmp_db)
+    yield Repositories(db)
+    db.close()
+
+
+@pytest.fixture()
+def manager(repos, tmp_path):
+    config = load_config(path="/nonexistent", env={}, overrides={
+        "terminal": {"shell": "/bin/sh", "idle_timeout_s": 900,
+                     "max_sessions": 4},
+    })
+    mgr = TerminalManager(repos, config)
+    yield mgr
+    mgr.shutdown()
+
+
+def make_cluster(repos, name="termc", kubeconfig=FAKE_KUBECONFIG) -> Cluster:
+    cluster = Cluster(name=name, kubeconfig=kubeconfig)
+    repos.clusters.save(cluster)
+    return cluster
+
+
+def read_until(session, needle: str, timeout_s: float = 10.0) -> str:
+    deadline = time.time() + timeout_s
+    text = ""
+    seq = -1
+    while time.time() < deadline:
+        chunks = session.read_since(seq)
+        if chunks:
+            seq = chunks[-1][0]
+            text += "".join(d.decode("utf-8", "replace") for _, d in chunks)
+            if needle in text:
+                return text
+        time.sleep(0.05)
+    raise AssertionError(f"{needle!r} not seen in terminal output:\n{text}")
+
+
+class TestSessionLifecycle:
+    def test_echo_round_trip(self, repos, manager):
+        make_cluster(repos)
+        session = manager.open("termc")
+        assert session.alive
+        session.write(b"echo KO_$((40+2))\n")
+        out = read_until(session, "KO_42")
+        assert "KO_42" in out
+        manager.close(session.id)
+        assert not session.alive
+        with pytest.raises(NotFoundError):
+            manager.get(session.id)
+
+    def test_kubeconfig_env_exported(self, repos, manager):
+        make_cluster(repos)
+        session = manager.open("termc")
+        session.write(b"cat \"$KUBECONFIG\"\n")
+        out = read_until(session, "kind: Config")
+        assert "kind: Config" in out
+        manager.close(session.id)
+
+    def test_requires_kubeconfig(self, repos, manager):
+        make_cluster(repos, name="bare", kubeconfig="")
+        with pytest.raises(ValidationError):
+            manager.open("bare")
+
+    def test_session_limit(self, repos, manager):
+        make_cluster(repos)
+        manager.max_sessions = 2
+        s1 = manager.open("termc")
+        s2 = manager.open("termc")
+        with pytest.raises(ValidationError):
+            manager.open("termc")
+        manager.close(s1.id)
+        manager.close(s2.id)
+
+    def test_reap_idle_and_dead(self, repos, manager):
+        make_cluster(repos)
+        session = manager.open("termc")
+        session.write(b"exit\n")
+        deadline = time.time() + 5
+        while session.alive and time.time() < deadline:
+            time.sleep(0.05)
+        assert manager.reap() == 1
+        assert manager.list() == []
+
+    def test_idle_timeout_reaps_live_shell(self, repos, manager):
+        make_cluster(repos)
+        session = manager.open("termc")
+        manager.idle_timeout_s = 0.0  # everything is instantly idle
+        assert manager.reap() == 1
+        assert not session.alive
+
+    def test_failed_shell_spawn_cleans_up(self, repos, manager, tmp_path):
+        import glob
+
+        make_cluster(repos)
+        manager.shell = str(tmp_path / "no-such-shell")
+        before = set(glob.glob("/tmp/ko-term-*"))
+        with pytest.raises(ValidationError):
+            manager.open("termc")
+        assert set(glob.glob("/tmp/ko-term-*")) == before  # no kubeconfig leak
+
+    def test_resize_does_not_crash(self, repos, manager):
+        make_cluster(repos)
+        session = manager.open("termc")
+        session.resize(50, 120)
+        session.write(b"stty size\n")
+        read_until(session, "50 120")
+        manager.close(session.id)
+
+
+class TestTerminalHttp:
+    def test_open_write_read_close(self, client):
+        base, http, services = client
+        # a "deployed" cluster: row with kubeconfig, no real nodes needed
+        services.repos.clusters.save(
+            Cluster(name="webterm", kubeconfig=FAKE_KUBECONFIG)
+        )
+        services.terminals.shell = "/bin/sh"
+        sid = http.post(f"{base}/api/v1/clusters/webterm/terminal").json()["id"]
+        assert http.post(f"{base}/api/v1/terminal/{sid}/input",
+                         json={"data": "echo WEB_$((20+3))\n"}).status_code == 200
+        deadline = time.time() + 10
+        text = ""
+        while time.time() < deadline and "WEB_23" not in text:
+            out = http.get(
+                f"{base}/api/v1/terminal/{sid}/output?after=-1").json()
+            text = "".join(c["data"] for c in out["chunks"])
+            time.sleep(0.1)
+        assert "WEB_23" in text
+        assert http.post(f"{base}/api/v1/terminal/{sid}/resize",
+                         json={"rows": 30, "cols": 100}).status_code == 200
+        assert http.delete(f"{base}/api/v1/terminal/{sid}").status_code == 200
+        assert http.get(
+            f"{base}/api/v1/terminal/{sid}/output").status_code == 404
+
+    def test_non_admin_denied_by_default(self, client):
+        import requests
+
+        base, http, services = client
+        services.repos.clusters.save(
+            Cluster(name="lockedterm", kubeconfig=FAKE_KUBECONFIG)
+        )
+        services.users.create("dev", password="devpass123")
+        dev = requests.Session()
+        tok = dev.post(f"{base}/api/v1/auth/login", json={
+            "username": "dev", "password": "devpass123"}).json()["token"]
+        dev.headers["Authorization"] = f"Bearer {tok}"
+        resp = dev.post(f"{base}/api/v1/clusters/lockedterm/terminal")
+        assert resp.status_code == 403
+
+    def test_attach_restricted_to_opener(self, client):
+        import requests
+
+        base, http, services = client
+        services.repos.clusters.save(
+            Cluster(name="ownterm", kubeconfig=FAKE_KUBECONFIG)
+        )
+        services.terminals.shell = "/bin/sh"
+        sid = http.post(f"{base}/api/v1/clusters/ownterm/terminal").json()["id"]
+        services.users.create("peer", password="peerpass123")
+        peer = requests.Session()
+        tok = peer.post(f"{base}/api/v1/auth/login", json={
+            "username": "peer", "password": "peerpass123"}).json()["token"]
+        peer.headers["Authorization"] = f"Bearer {tok}"
+        assert peer.post(f"{base}/api/v1/terminal/{sid}/input",
+                         json={"data": "id\n"}).status_code == 403
+        assert peer.get(
+            f"{base}/api/v1/terminal/{sid}/output").status_code == 403
+        http.delete(f"{base}/api/v1/terminal/{sid}")
