@@ -171,6 +171,15 @@ class Handlers:
         )
         return json_response(user.to_public_dict(), status=201)
 
+    # ---- ldap (admin) ----
+    async def ldap_test(self, request):
+        _require_admin(request)
+        return json_response(await run_sync(request, self.s.ldap.test_connection))
+
+    async def ldap_sync(self, request):
+        _require_admin(request)
+        return json_response(await run_sync(request, self.s.ldap.sync_users))
+
     # ---- version / health ----
     async def version(self, request):
         from kubeoperator_tpu.version import SUPPORTED_K8S_VERSIONS, __version__
@@ -617,6 +626,8 @@ def create_app(services: Services) -> web.Application:
     r.add_get("/api/v1/auth/whoami", h.whoami)
     r.add_get("/api/v1/users", h.list_users)
     r.add_post("/api/v1/users", h.create_user)
+    r.add_post("/api/v1/ldap/test", h.ldap_test)
+    r.add_post("/api/v1/ldap/sync", h.ldap_sync)
 
     view, manage = Role.VIEWER, Role.MANAGER
     r.add_get("/api/v1/clusters", h.list_clusters)

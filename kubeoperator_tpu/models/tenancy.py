@@ -52,9 +52,8 @@ class User(Entity):
     password_hash: str = ""
     is_admin: bool = False
     # "local" users authenticate against password_hash; "ldap" users against
-    # the configured directory (service/user.py gates on this source field —
-    # parity with the reference's LDAP support, stubbed until a directory
-    # client is wired).
+    # the configured directory (UserService.login gates on this source field
+    # and round-trips to service/ldap.py for a verification bind).
     source: str = "local"
     locale: str = "en-US"
     active: bool = True

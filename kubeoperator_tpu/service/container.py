@@ -31,6 +31,7 @@ class Services:
             RegionService,
             ZoneService,
         )
+        from kubeoperator_tpu.service.ldap import LdapService
         from kubeoperator_tpu.service.node import NodeService
         from kubeoperator_tpu.service.security import CisService
         from kubeoperator_tpu.service.tenancy import ProjectService, UserService
@@ -41,14 +42,18 @@ class Services:
         self.executor = executor
         self.provisioner = provisioner
 
+        from kubeoperator_tpu.service.notify import configure_senders
+
         self.events = EventService(repos)
         self.messages = MessageService(repos)
+        configure_senders(self.messages, repos, config)
         self.credentials = CredentialService(repos)
         self.regions = RegionService(repos)
         self.zones = ZoneService(repos)
         self.plans = PlanService(repos)
         self.hosts = HostService(repos, executor)
-        self.users = UserService(repos, config)
+        self.ldap = LdapService(repos, config)
+        self.users = UserService(repos, config, ldap=self.ldap)
         self.projects = ProjectService(repos)
         self.clusters = ClusterService(
             repos, executor, provisioner, self.events, config

@@ -1,0 +1,116 @@
+"""LdapService — directory auth + user sync (SURVEY.md §1 'local users +
+LDAP').
+
+Flow (the reference's model): bind with the manager DN → search the base DN
+for the user entry → verification bind with the entry's own DN. `sync_users`
+imports directory users as `source="ldap"` platform users (no password hash;
+their login path always round-trips to the directory via `authenticate`).
+"""
+
+from __future__ import annotations
+
+from kubeoperator_tpu.models import User
+from kubeoperator_tpu.repository import Repositories
+from kubeoperator_tpu.utils.config import Config
+from kubeoperator_tpu.utils.errors import ValidationError
+from kubeoperator_tpu.utils.ldapclient import LdapClient, LdapError
+from kubeoperator_tpu.utils.logging import get_logger
+
+log = get_logger("service.ldap")
+
+
+class LdapService:
+    def __init__(self, repos: Repositories, config: Config):
+        self.repos = repos
+        self.config = config
+
+    # ---- config ----
+    @property
+    def enabled(self) -> bool:
+        return bool(self.config.get("ldap.enabled", False))
+
+    def _client(self) -> LdapClient:
+        host = self.config.get("ldap.host", "")
+        if not host:
+            raise ValidationError("ldap.host is not configured")
+        return LdapClient(
+            host,
+            int(self.config.get("ldap.port", 389)),
+            use_ssl=bool(self.config.get("ldap.ssl", False)),
+            timeout_s=float(self.config.get("ldap.timeout_s", 10)),
+            verify_tls=bool(self.config.get("ldap.verify_tls", True)),
+        )
+
+    def _settings(self) -> dict:
+        return {
+            "manager_dn": self.config.get("ldap.manager_dn", ""),
+            "manager_password": self.config.get("ldap.manager_password", ""),
+            "base_dn": self.config.get("ldap.base_dn", ""),
+            "username_attr": self.config.get("ldap.username_attr", "uid"),
+            "email_attr": self.config.get("ldap.email_attr", "mail"),
+        }
+
+    # ---- operations ----
+    def test_connection(self) -> dict:
+        """Manager bind + base search; the UI's 'test LDAP settings' button."""
+        s = self._settings()
+        with self._client() as client:
+            if not client.bind(s["manager_dn"], s["manager_password"]):
+                return {"ok": False, "message": "manager bind rejected"}
+            entries = client.search(
+                s["base_dn"], attributes=(s["username_attr"],), size_limit=5
+            )
+        return {"ok": True, "users_sampled": len(entries)}
+
+    def _find_user(self, client: LdapClient, s: dict, name: str):
+        entries = client.search(
+            s["base_dn"], attr=s["username_attr"], value=name,
+            attributes=(s["username_attr"], s["email_attr"]),
+        )
+        return entries[0] if entries else None
+
+    def authenticate(self, name: str, password: str) -> bool:
+        """Directory-verify a platform user with source='ldap'."""
+        if not self.enabled:
+            return False
+        if not password:
+            return False  # RFC 4513: empty password = unauthenticated bind
+        s = self._settings()
+        with self._client() as client:
+            if not client.bind(s["manager_dn"], s["manager_password"]):
+                raise LdapError("ldap manager bind rejected")
+            entry = self._find_user(client, s, name)
+            if entry is None:
+                return False
+        # verification bind on a fresh connection: some servers refuse
+        # rebinding an authenticated connection downward
+        with self._client() as client:
+            return client.bind(entry.dn, password)
+
+    def sync_users(self) -> dict:
+        """Import directory users as platform users (source='ldap')."""
+        s = self._settings()
+        with self._client() as client:
+            if not client.bind(s["manager_dn"], s["manager_password"]):
+                raise LdapError("ldap manager bind rejected")
+            entries = client.search(
+                s["base_dn"], attributes=(s["username_attr"], s["email_attr"]),
+            )
+        created, skipped = 0, 0
+        existing_names = {u.name for u in self.repos.users.list()}
+        for entry in entries:
+            name = entry.first(s["username_attr"])
+            if not name or name in existing_names:
+                skipped += 1
+                continue
+            existing_names.add(name)
+            user = User(
+                name=name, email=entry.first(s["email_attr"]),
+                source="ldap", password_hash="",
+            )
+            user.validate()
+            self.repos.users.save(user)
+            created += 1
+        log.info("ldap sync: %d created, %d skipped", created, skipped)
+        return {"created": created, "skipped": skipped,
+                "total_directory_users": len(entries)}

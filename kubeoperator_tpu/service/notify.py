@@ -1,0 +1,100 @@
+"""Message-center delivery channels (SURVEY.md §1 'message center
+(email/webhook notifications)', §5.5).
+
+`MessageService.senders` is the fan-out registry; this module supplies the
+two reference channels — SMTP email and JSON webhook — and wires them from
+config at boot (`configure_senders`). Sender failures are logged and
+swallowed by MessageService so a dead mail relay can never block an event
+flow.
+"""
+
+from __future__ import annotations
+
+import json
+import smtplib
+import urllib.request
+from email.message import EmailMessage
+
+from kubeoperator_tpu.models import Message
+from kubeoperator_tpu.utils.config import Config
+from kubeoperator_tpu.utils.logging import get_logger
+
+log = get_logger("service.notify")
+
+
+class SmtpSender:
+    """Email channel. Recipient resolution: the message's user row email."""
+
+    def __init__(self, repos, host: str, port: int = 25, username: str = "",
+                 password: str = "", sender: str = "ko-tpu@localhost",
+                 use_tls: bool = False, timeout_s: float = 10.0):
+        self.repos = repos
+        self.host = host
+        self.port = port
+        self.username = username
+        self.password = password
+        self.sender = sender
+        self.use_tls = use_tls
+        self.timeout_s = timeout_s
+
+    def __call__(self, message: Message) -> None:
+        user = self.repos.users.get(message.user_id)
+        if not user.email:
+            return  # nothing to deliver to; in-app copy already stored
+        mail = EmailMessage()
+        mail["From"] = self.sender
+        mail["To"] = user.email
+        mail["Subject"] = f"[ko-tpu][{message.level}] {message.title}"
+        mail.set_content(message.content)
+        with smtplib.SMTP(self.host, self.port,
+                          timeout=self.timeout_s) as smtp:
+            if self.use_tls:
+                smtp.starttls()
+            if self.username:
+                smtp.login(self.username, self.password)
+            smtp.send_message(mail)
+        log.info("mailed %s to %s", message.title, user.email)
+
+
+class WebhookSender:
+    """POSTs the message as JSON to a fixed endpoint (chat-ops integrations)."""
+
+    def __init__(self, url: str, timeout_s: float = 10.0,
+                 headers: dict | None = None):
+        self.url = url
+        self.timeout_s = timeout_s
+        self.headers = {"Content-Type": "application/json", **(headers or {})}
+
+    def __call__(self, message: Message) -> None:
+        payload = json.dumps({
+            "title": message.title,
+            "content": message.content,
+            "level": message.level,
+            "user_id": message.user_id,
+            "ts": message.created_at,
+        }).encode()
+        req = urllib.request.Request(self.url, data=payload,
+                                     headers=self.headers, method="POST")
+        with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+            if resp.status >= 300:
+                raise RuntimeError(f"webhook returned {resp.status}")
+        log.info("webhook delivered %s", message.title)
+
+
+def configure_senders(messages, repos, config: Config) -> None:
+    """Attach the channels the operator enabled in config."""
+    if config.get("notify.smtp.enabled", False):
+        messages.senders["smtp"] = SmtpSender(
+            repos,
+            host=config.get("notify.smtp.host", "localhost"),
+            port=int(config.get("notify.smtp.port", 25)),
+            username=config.get("notify.smtp.username", ""),
+            password=config.get("notify.smtp.password", ""),
+            sender=config.get("notify.smtp.from", "ko-tpu@localhost"),
+            use_tls=bool(config.get("notify.smtp.tls", False)),
+        )
+    if config.get("notify.webhook.url", ""):
+        messages.senders["webhook"] = WebhookSender(
+            config.get("notify.webhook.url"),
+            headers=config.get("notify.webhook.headers", {}) or {},
+        )

@@ -20,8 +20,9 @@ from kubeoperator_tpu.utils.ids import now_ts
 
 
 class UserService:
-    def __init__(self, repos: Repositories, config: Config):
+    def __init__(self, repos: Repositories, config: Config, ldap=None):
         self.repos = repos
+        self.ldap = ldap  # LdapService; directory-verifies source='ldap' users
         self.session_ttl = float(config.get("server.session_ttl_s", 3600))
         self._sessions: dict[str, tuple[str, float]] = {}  # token -> (uid, exp)
 
@@ -63,10 +64,11 @@ class UserService:
         if not user.active:
             raise AuthError()
         if user.source == "ldap":
-            # LDAP bind requires a directory client; explicitly unsupported
-            # until one is wired (stub per SURVEY.md §7 'What NOT to rebuild').
-            raise AuthError(message="ldap authentication not configured")
-        if not verify_password(password, user.password_hash):
+            if self.ldap is None or not self.ldap.enabled:
+                raise AuthError(message="ldap authentication not configured")
+            if not self.ldap.authenticate(name, password):
+                raise AuthError()
+        elif not verify_password(password, user.password_hash):
             raise AuthError()
         token = secrets.token_urlsafe(32)
         self._sessions[token] = (user.id, now_ts() + self.session_ttl)

@@ -1,0 +1,112 @@
+"""Minimal BER (Basic Encoding Rules) TLV codec — just enough ASN.1 for the
+LDAPv3 subset the platform speaks (utils/ldapclient.py): definite lengths,
+universal INTEGER/OCTET STRING/ENUMERATED/BOOLEAN/SEQUENCE/SET plus
+context/application-tagged constructed types. Dependency-free by design: the
+platform must authenticate against a directory inside air-gapped installs
+where no LDAP wheel is available.
+"""
+
+from __future__ import annotations
+
+# Universal tags
+INTEGER = 0x02
+OCTET_STRING = 0x04
+ENUMERATED = 0x0A
+BOOLEAN = 0x01
+SEQUENCE = 0x30          # constructed
+SET = 0x31               # constructed
+
+
+def encode_length(n: int) -> bytes:
+    if n < 0x80:
+        return bytes([n])
+    body = n.to_bytes((n.bit_length() + 7) // 8, "big")
+    return bytes([0x80 | len(body)]) + body
+
+
+def encode_tlv(tag: int, value: bytes) -> bytes:
+    return bytes([tag]) + encode_length(len(value)) + value
+
+
+def encode_int(value: int, tag: int = INTEGER) -> bytes:
+    if value == 0:
+        return encode_tlv(tag, b"\x00")
+    out = value.to_bytes((value.bit_length() + 8) // 8, "big", signed=True)
+    # strip redundant sign octets while keeping the sign bit correct
+    while len(out) > 1 and (
+        (out[0] == 0x00 and not out[1] & 0x80)
+        or (out[0] == 0xFF and out[1] & 0x80)
+    ):
+        out = out[1:]
+    return encode_tlv(tag, out)
+
+
+def encode_str(value: str | bytes, tag: int = OCTET_STRING) -> bytes:
+    if isinstance(value, str):
+        value = value.encode("utf-8")
+    return encode_tlv(tag, value)
+
+
+def encode_bool(value: bool) -> bytes:
+    return encode_tlv(BOOLEAN, b"\xff" if value else b"\x00")
+
+
+def encode_seq(*parts: bytes, tag: int = SEQUENCE) -> bytes:
+    return encode_tlv(tag, b"".join(parts))
+
+
+class BerReader:
+    """Sequential TLV reader over a bytes buffer."""
+
+    def __init__(self, data: bytes, pos: int = 0, end: int | None = None):
+        self.data = data
+        self.pos = pos
+        self.end = len(data) if end is None else end
+
+    @property
+    def remaining(self) -> int:
+        return self.end - self.pos
+
+    def peek_tag(self) -> int:
+        if self.pos >= self.end:
+            raise ValueError("BER: read past end")
+        return self.data[self.pos]
+
+    def read_tlv(self) -> tuple[int, bytes]:
+        """Returns (tag, value) and advances."""
+        tag = self.peek_tag()
+        pos = self.pos + 1
+        if pos >= self.end:
+            raise ValueError("BER: truncated length")
+        first = self.data[pos]
+        pos += 1
+        if first < 0x80:
+            length = first
+        else:
+            n = first & 0x7F
+            if n == 0 or pos + n > self.end:
+                raise ValueError("BER: bad long-form length")
+            length = int.from_bytes(self.data[pos:pos + n], "big")
+            pos += n
+        if pos + length > self.end:
+            raise ValueError("BER: value extends past buffer")
+        value = self.data[pos:pos + length]
+        self.pos = pos + length
+        return tag, value
+
+    def read_int(self, expect: int = INTEGER) -> int:
+        tag, value = self.read_tlv()
+        if tag != expect:
+            raise ValueError(f"BER: expected tag {expect:#x}, got {tag:#x}")
+        return int.from_bytes(value, "big", signed=True)
+
+    def read_str(self, expect: int = OCTET_STRING) -> str:
+        tag, value = self.read_tlv()
+        if tag != expect:
+            raise ValueError(f"BER: expected tag {expect:#x}, got {tag:#x}")
+        return value.decode("utf-8", "replace")
+
+    def enter(self) -> "BerReader":
+        """Read one constructed TLV and return a reader scoped to its body."""
+        _, value = self.read_tlv()
+        return BerReader(value)

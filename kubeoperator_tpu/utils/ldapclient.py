@@ -1,0 +1,211 @@
+"""Minimal LDAPv3 client: simple bind + subtree search over TCP (optionally
+TLS), built on utils/ber.py.
+
+Parity: the reference authenticates platform users against LDAP/AD with a
+manager-DN bind followed by a user search and a verification bind
+[upstream — UNVERIFIED, SURVEY.md §1 'local users + LDAP']. The subset
+implemented here is exactly what that flow needs: BindRequest/Response,
+SearchRequest (equality filter) /ResultEntry/ResultDone, Unbind. Stdlib-only
+so air-gapped installs need no directory SDK wheel.
+"""
+
+from __future__ import annotations
+
+import socket
+import ssl as ssl_mod
+
+from kubeoperator_tpu.utils import ber
+from kubeoperator_tpu.utils.errors import KoError
+
+# LDAP application tags (constructed unless noted)
+APP_BIND_REQUEST = 0x60
+APP_BIND_RESPONSE = 0x61
+APP_UNBIND_REQUEST = 0x42   # primitive NULL
+APP_SEARCH_REQUEST = 0x63
+APP_SEARCH_ENTRY = 0x64
+APP_SEARCH_DONE = 0x65
+CTX_SIMPLE_AUTH = 0x80      # context 0, primitive: simple password
+FILTER_AND = 0xA0           # context 0, constructed
+FILTER_EQUALITY = 0xA3      # context 3, constructed
+FILTER_PRESENT = 0x87       # context 7, primitive
+
+SCOPE_SUBTREE = 2
+DEREF_NEVER = 0
+
+RESULT_SUCCESS = 0
+RESULT_SIZE_LIMIT_EXCEEDED = 4
+RESULT_INVALID_CREDENTIALS = 49
+
+
+class LdapError(KoError):
+    code = "ERR_LDAP"
+    http_status = 502
+
+
+class LdapEntry:
+    def __init__(self, dn: str, attrs: dict[str, list[str]]):
+        self.dn = dn
+        self.attrs = attrs
+
+    def first(self, attr: str, default: str = "") -> str:
+        values = self.attrs.get(attr.lower(), [])
+        return values[0] if values else default
+
+
+class LdapClient:
+    """One connection; message ids increment per request."""
+
+    def __init__(self, host: str, port: int = 389, use_ssl: bool = False,
+                 timeout_s: float = 10.0, verify_tls: bool = True) -> None:
+        self.host = host
+        self.port = port
+        self.timeout_s = timeout_s
+        try:
+            raw = socket.create_connection((host, port), timeout=timeout_s)
+        except OSError as e:
+            raise LdapError(f"ldap connect to {host}:{port} failed: {e}")
+        if use_ssl:
+            context = ssl_mod.create_default_context()
+            if not verify_tls:
+                # explicit operator opt-out (ldap.verify_tls: false) for
+                # private-CA / IP-SAN directory certs in air-gapped networks
+                context.check_hostname = False
+                context.verify_mode = ssl_mod.CERT_NONE
+            raw = context.wrap_socket(raw, server_hostname=host)
+        self.sock = raw
+        self._msg_id = 0
+
+    # ---- wire ----
+    def _send(self, protocol_op: bytes) -> int:
+        self._msg_id += 1
+        msg = ber.encode_seq(ber.encode_int(self._msg_id), protocol_op)
+        try:
+            self.sock.sendall(msg)
+        except OSError as e:
+            raise LdapError(f"ldap send failed: {e}")
+        return self._msg_id
+
+    def _recv_message(self) -> tuple[int, int, bytes]:
+        """Returns (message_id, op_tag, op_value)."""
+        header = self._recv_exact(2)
+        length = header[1]
+        extra = b""
+        if length & 0x80:
+            n = length & 0x7F
+            extra = self._recv_exact(n)
+            length = int.from_bytes(extra, "big")
+        body = self._recv_exact(length)
+        reader = ber.BerReader(header + extra + body)
+        envelope = reader.enter()
+        msg_id = envelope.read_int()
+        op_tag, op_value = envelope.read_tlv()
+        return msg_id, op_tag, op_value
+
+    def _recv_exact(self, n: int) -> bytes:
+        chunks = b""
+        while len(chunks) < n:
+            try:
+                chunk = self.sock.recv(n - len(chunks))
+            except OSError as e:
+                raise LdapError(f"ldap recv failed: {e}")
+            if not chunk:
+                raise LdapError("ldap connection closed by server")
+            chunks += chunk
+        return chunks
+
+    # ---- operations ----
+    def bind(self, dn: str, password: str) -> bool:
+        """Simple bind; True on success, False on invalidCredentials.
+        Anything else raises (server/protocol trouble must not read as just
+        a wrong password)."""
+        op = ber.encode_seq(
+            ber.encode_int(3),                        # LDAP protocol version
+            ber.encode_str(dn),
+            ber.encode_str(password, tag=CTX_SIMPLE_AUTH),
+            tag=APP_BIND_REQUEST,
+        )
+        self._send(op)
+        _, op_tag, op_value = self._recv_message()
+        if op_tag != APP_BIND_RESPONSE:
+            raise LdapError(f"unexpected response tag {op_tag:#x} to bind")
+        result = ber.BerReader(op_value).read_int(expect=ber.ENUMERATED)
+        if result == RESULT_SUCCESS:
+            return True
+        if result == RESULT_INVALID_CREDENTIALS:
+            return False
+        raise LdapError(f"ldap bind failed with resultCode={result}")
+
+    def search(self, base_dn: str, attr: str = "", value: str = "",
+               attributes: tuple[str, ...] = (),
+               size_limit: int = 1000) -> list[LdapEntry]:
+        """Subtree search with an equality filter (or objectClass presence
+        when no attr given)."""
+        if attr:
+            filter_ = ber.encode_seq(
+                ber.encode_str(attr), ber.encode_str(value),
+                tag=FILTER_EQUALITY,
+            )
+        else:
+            filter_ = ber.encode_str("objectClass", tag=FILTER_PRESENT)
+        op = ber.encode_seq(
+            ber.encode_str(base_dn),
+            ber.encode_int(SCOPE_SUBTREE, tag=ber.ENUMERATED),
+            ber.encode_int(DEREF_NEVER, tag=ber.ENUMERATED),
+            ber.encode_int(size_limit),
+            ber.encode_int(int(self.timeout_s)),
+            ber.encode_bool(False),                   # typesOnly
+            filter_,
+            ber.encode_seq(*[ber.encode_str(a) for a in attributes]),
+            tag=APP_SEARCH_REQUEST,
+        )
+        self._send(op)
+        entries: list[LdapEntry] = []
+        while True:
+            _, op_tag, op_value = self._recv_message()
+            if op_tag == APP_SEARCH_ENTRY:
+                entries.append(self._parse_entry(op_value))
+            elif op_tag == APP_SEARCH_DONE:
+                result = ber.BerReader(op_value).read_int(expect=ber.ENUMERATED)
+                # sizeLimitExceeded still delivered everything under the
+                # limit — a partial page is a result, not a failure
+                if result not in (RESULT_SUCCESS, RESULT_SIZE_LIMIT_EXCEEDED):
+                    raise LdapError(f"ldap search resultCode={result}")
+                return entries
+            else:
+                raise LdapError(f"unexpected tag {op_tag:#x} during search")
+
+    @staticmethod
+    def _parse_entry(op_value: bytes) -> LdapEntry:
+        reader = ber.BerReader(op_value)
+        dn = reader.read_str()
+        attrs: dict[str, list[str]] = {}
+        attr_list = reader.enter()                    # PartialAttributeList
+        while attr_list.remaining:
+            one = attr_list.enter()                   # PartialAttribute
+            name = one.read_str().lower()
+            values: list[str] = []
+            value_set = one.enter()                   # SET OF value
+            while value_set.remaining:
+                _, v = value_set.read_tlv()
+                values.append(v.decode("utf-8", "replace"))
+            attrs[name] = values
+        return LdapEntry(dn, attrs)
+
+    def unbind(self) -> None:
+        try:
+            self._send(ber.encode_tlv(APP_UNBIND_REQUEST, b""))
+        except LdapError:
+            pass
+
+    def close(self) -> None:
+        self.unbind()
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+    def __enter__(self) -> "LdapClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

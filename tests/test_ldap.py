@@ -1,0 +1,273 @@
+"""LDAP auth: BER codec round-trips, the LDAPv3 client against a fake
+in-process directory server, LdapService sync/auth, and the UserService
+login path for source='ldap' users."""
+
+import socket
+import threading
+
+import pytest
+
+from kubeoperator_tpu.repository import Database, Repositories
+from kubeoperator_tpu.service.ldap import LdapService
+from kubeoperator_tpu.service.tenancy import UserService
+from kubeoperator_tpu.utils import ber
+from kubeoperator_tpu.utils.config import load_config
+from kubeoperator_tpu.utils.errors import AuthError
+from kubeoperator_tpu.utils.ldapclient import (
+    APP_BIND_REQUEST,
+    APP_BIND_RESPONSE,
+    APP_SEARCH_DONE,
+    APP_SEARCH_ENTRY,
+    APP_SEARCH_REQUEST,
+    CTX_SIMPLE_AUTH,
+    FILTER_EQUALITY,
+    LdapClient,
+    LdapError,
+)
+
+BASE_DN = "ou=people,dc=example,dc=org"
+MANAGER_DN = "cn=admin,dc=example,dc=org"
+MANAGER_PW = "managerpw"
+DIRECTORY = {
+    # dn -> (password, attrs)
+    f"uid=alice,{BASE_DN}": ("alicepw", {"uid": ["alice"],
+                                         "mail": ["alice@example.org"]}),
+    f"uid=bob,{BASE_DN}": ("bobpw", {"uid": ["bob"],
+                                     "mail": ["bob@example.org"]}),
+}
+
+
+class FakeLdapServer:
+    """Speaks just enough LDAPv3 BER to serve bind + equality/presence
+    search for the DIRECTORY above."""
+
+    def __init__(self):
+        self.sock = socket.socket()
+        self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.listen(8)
+        self.port = self.sock.getsockname()[1]
+        self._stop = False
+        self.thread = threading.Thread(target=self._serve, daemon=True)
+        self.thread.start()
+
+    def _serve(self):
+        while not self._stop:
+            try:
+                conn, _ = self.sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._handle, args=(conn,),
+                             daemon=True).start()
+
+    def _handle(self, conn):
+        try:
+            buf = b""
+            while True:
+                data = conn.recv(4096)
+                if not data:
+                    return
+                buf += data
+                while True:
+                    msg, rest = self._try_parse(buf)
+                    if msg is None:
+                        break
+                    buf = rest
+                    reply = self._dispatch(msg)
+                    if reply is None:   # unbind
+                        return
+                    if reply:
+                        conn.sendall(reply)
+        except Exception:
+            pass
+        finally:
+            conn.close()
+
+    @staticmethod
+    def _try_parse(buf):
+        if len(buf) < 2:
+            return None, buf
+        try:
+            reader = ber.BerReader(buf)
+            start = reader.pos
+            reader.read_tlv()
+            consumed = reader.pos - start
+        except ValueError:
+            return None, buf
+        return buf[:consumed], buf[consumed:]
+
+    def _dispatch(self, raw):
+        envelope = ber.BerReader(raw).enter()
+        msg_id = envelope.read_int()
+        op_tag, op_value = envelope.read_tlv()
+        if op_tag == APP_BIND_REQUEST:
+            return self._bind(msg_id, op_value)
+        if op_tag == APP_SEARCH_REQUEST:
+            return self._search(msg_id, op_value)
+        return None  # unbind or unknown: close
+
+    @staticmethod
+    def _result(msg_id, app_tag, code):
+        op = ber.encode_seq(
+            ber.encode_int(code, tag=ber.ENUMERATED),
+            ber.encode_str(""), ber.encode_str(""),
+            tag=app_tag,
+        )
+        return ber.encode_seq(ber.encode_int(msg_id), op)
+
+    def _bind(self, msg_id, op_value):
+        reader = ber.BerReader(op_value)
+        reader.read_int()                       # version
+        dn = reader.read_str()
+        password = reader.read_str(expect=CTX_SIMPLE_AUTH)
+        ok = (dn == MANAGER_DN and password == MANAGER_PW) or (
+            dn in DIRECTORY and DIRECTORY[dn][0] == password
+        )
+        return self._result(msg_id, APP_BIND_RESPONSE, 0 if ok else 49)
+
+    def _search(self, msg_id, op_value):
+        reader = ber.BerReader(op_value)
+        reader.read_str()                       # baseObject
+        reader.read_int(expect=ber.ENUMERATED)  # scope
+        reader.read_int(expect=ber.ENUMERATED)  # deref
+        reader.read_int()                       # sizeLimit
+        reader.read_int()                       # timeLimit
+        reader.read_tlv()                       # typesOnly
+        filter_tag, filter_value = reader.read_tlv()
+        matches = []
+        if filter_tag == FILTER_EQUALITY:
+            f = ber.BerReader(filter_value)
+            attr, value = f.read_str().lower(), f.read_str()
+            for dn, (_, attrs) in DIRECTORY.items():
+                if value in attrs.get(attr, []):
+                    matches.append((dn, attrs))
+        else:  # presence: return everything
+            matches = [(dn, attrs) for dn, (_, attrs) in DIRECTORY.items()]
+        out = b""
+        for dn, attrs in matches:
+            attr_seq = b"".join(
+                ber.encode_seq(
+                    ber.encode_str(k),
+                    ber.encode_seq(*[ber.encode_str(v) for v in vs],
+                                   tag=ber.SET),
+                )
+                for k, vs in attrs.items()
+            )
+            entry = ber.encode_seq(
+                ber.encode_str(dn), ber.encode_seq(attr_seq),
+                tag=APP_SEARCH_ENTRY,
+            )
+            out += ber.encode_seq(ber.encode_int(msg_id), entry)
+        return out + self._result(msg_id, APP_SEARCH_DONE, 0)
+
+    def close(self):
+        self._stop = True
+        self.sock.close()
+
+
+@pytest.fixture()
+def directory():
+    server = FakeLdapServer()
+    yield server
+    server.close()
+
+
+def ldap_config(server, tmp_path, **extra):
+    return load_config(path="/nonexistent", env={}, overrides={
+        "db": {"path": str(tmp_path / "ldap.db")},
+        "ldap": {
+            "enabled": True, "host": "127.0.0.1", "port": server.port,
+            "manager_dn": MANAGER_DN, "manager_password": MANAGER_PW,
+            "base_dn": BASE_DN, **extra,
+        },
+    })
+
+
+class TestBer:
+    def test_int_round_trip(self):
+        for n in (0, 1, 127, 128, 255, 256, 65535, -1, -129):
+            encoded = ber.encode_int(n)
+            assert ber.BerReader(encoded).read_int() == n
+
+    def test_long_form_length(self):
+        payload = b"x" * 300
+        encoded = ber.encode_str(payload)
+        tag, value = ber.BerReader(encoded).read_tlv()
+        assert tag == ber.OCTET_STRING and value == payload
+
+    def test_truncated_raises(self):
+        encoded = ber.encode_str("hello")[:-2]
+        with pytest.raises(ValueError):
+            ber.BerReader(encoded).read_tlv()
+
+
+class TestLdapClient:
+    def test_bind_success_and_failure(self, directory):
+        with LdapClient("127.0.0.1", directory.port) as client:
+            assert client.bind(MANAGER_DN, MANAGER_PW)
+        with LdapClient("127.0.0.1", directory.port) as client:
+            assert not client.bind(MANAGER_DN, "wrong")
+
+    def test_search_equality(self, directory):
+        with LdapClient("127.0.0.1", directory.port) as client:
+            assert client.bind(MANAGER_DN, MANAGER_PW)
+            entries = client.search(BASE_DN, attr="uid", value="alice",
+                                    attributes=("uid", "mail"))
+        assert len(entries) == 1
+        assert entries[0].first("mail") == "alice@example.org"
+
+    def test_search_presence_returns_all(self, directory):
+        with LdapClient("127.0.0.1", directory.port) as client:
+            assert client.bind(MANAGER_DN, MANAGER_PW)
+            assert len(client.search(BASE_DN)) == 2
+
+    def test_connect_refused_raises_ldap_error(self):
+        with pytest.raises(LdapError):
+            LdapClient("127.0.0.1", 1, timeout_s=0.5)
+
+
+class TestLdapService:
+    def test_test_connection(self, directory, tmp_path):
+        config = ldap_config(directory, tmp_path)
+        db = Database(config.get("db.path"))
+        try:
+            service = LdapService(Repositories(db), config)
+            report = service.test_connection()
+            assert report["ok"] and report["users_sampled"] == 2
+        finally:
+            db.close()
+
+    def test_sync_and_login(self, directory, tmp_path):
+        config = ldap_config(directory, tmp_path)
+        db = Database(config.get("db.path"))
+        try:
+            repos = Repositories(db)
+            ldap = LdapService(repos, config)
+            result = ldap.sync_users()
+            assert result["created"] == 2
+            assert ldap.sync_users()["created"] == 0  # idempotent
+
+            users = UserService(repos, config, ldap=ldap)
+            token = users.login("alice", "alicepw")
+            assert users.authenticate(token).name == "alice"
+            with pytest.raises(AuthError):
+                users.login("alice", "wrongpw")
+            with pytest.raises(AuthError):
+                users.login("alice", "")  # unauthenticated bind must not pass
+        finally:
+            db.close()
+
+    def test_ldap_login_without_directory_configured(self, tmp_path):
+        config = load_config(path="/nonexistent", env={}, overrides={
+            "db": {"path": str(tmp_path / "noldap.db")},
+        })
+        db = Database(config.get("db.path"))
+        try:
+            repos = Repositories(db)
+            users = UserService(repos, config,
+                                ldap=LdapService(repos, config))
+            users.create("carol", source="ldap")
+            with pytest.raises(AuthError):
+                users.login("carol", "whatever")
+        finally:
+            db.close()

@@ -1,0 +1,157 @@
+"""Message-center delivery channels: SMTP sender against a minimal fake SMTP
+server, webhook sender against a local HTTP server, config-driven wiring,
+and sender-failure isolation."""
+
+import json
+import socket
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from kubeoperator_tpu.models import Message, User
+from kubeoperator_tpu.repository import Database, Repositories
+from kubeoperator_tpu.service.event import EventService, MessageService
+from kubeoperator_tpu.service.notify import (
+    SmtpSender,
+    WebhookSender,
+    configure_senders,
+)
+from kubeoperator_tpu.utils.config import load_config
+
+
+class FakeSmtpServer:
+    """Accepts one SMTP conversation and records the DATA payload."""
+
+    def __init__(self):
+        self.sock = socket.socket()
+        self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.listen(4)
+        self.port = self.sock.getsockname()[1]
+        self.messages = []
+        self.thread = threading.Thread(target=self._serve, daemon=True)
+        self.thread.start()
+
+    def _serve(self):
+        while True:
+            try:
+                conn, _ = self.sock.accept()
+            except OSError:
+                return
+            with conn:
+                f = conn.makefile("rb")
+                conn.sendall(b"220 fake ESMTP\r\n")
+                data_mode = False
+                body = []
+                while True:
+                    line = f.readline()
+                    if not line:
+                        break
+                    if data_mode:
+                        if line.rstrip() == b".":
+                            self.messages.append(b"\n".join(body))
+                            conn.sendall(b"250 OK\r\n")
+                            data_mode = False
+                        else:
+                            body.append(line.rstrip())
+                        continue
+                    cmd = line.strip().upper()
+                    if cmd.startswith(b"EHLO") or cmd.startswith(b"HELO"):
+                        conn.sendall(b"250-fake\r\n250 OK\r\n")
+                    elif cmd.startswith(b"DATA"):
+                        conn.sendall(b"354 go\r\n")
+                        data_mode = True
+                    elif cmd.startswith(b"QUIT"):
+                        conn.sendall(b"221 bye\r\n")
+                        break
+                    else:
+                        conn.sendall(b"250 OK\r\n")
+
+    def close(self):
+        self.sock.close()
+
+
+class WebhookHandler(BaseHTTPRequestHandler):
+    received = []
+
+    def do_POST(self):
+        length = int(self.headers.get("Content-Length", 0))
+        WebhookHandler.received.append(json.loads(self.rfile.read(length)))
+        self.send_response(200)
+        self.end_headers()
+
+    def log_message(self, *args):
+        pass
+
+
+@pytest.fixture()
+def repos(tmp_db):
+    db = Database(tmp_db)
+    yield Repositories(db)
+    db.close()
+
+
+class TestSmtp:
+    def test_send_email(self, repos):
+        server = FakeSmtpServer()
+        try:
+            user = repos.users.save(User(name="ops", email="ops@example.org"))
+            sender = SmtpSender(repos, "127.0.0.1", server.port)
+            sender(Message(user_id=user.id, title="ClusterFailed",
+                           content="phase etcd failed", level="warning"))
+            deadline = threading.Event()
+            deadline.wait(0.2)
+            assert server.messages, "no mail captured"
+            mail = server.messages[0].decode()
+            assert "ClusterFailed" in mail and "ops@example.org" in mail
+        finally:
+            server.close()
+
+    def test_no_email_is_noop(self, repos):
+        user = repos.users.save(User(name="noaddr"))
+        sender = SmtpSender(repos, "127.0.0.1", 1)  # would fail if contacted
+        sender(Message(user_id=user.id, title="x", content="y"))
+
+
+class TestWebhook:
+    def test_post_payload(self):
+        WebhookHandler.received = []
+        httpd = HTTPServer(("127.0.0.1", 0), WebhookHandler)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        try:
+            sender = WebhookSender(
+                f"http://127.0.0.1:{httpd.server_port}/hook")
+            sender(Message(user_id="u1", title="HealthDegraded",
+                           content="etcd down", level="warning"))
+            assert WebhookHandler.received[0]["title"] == "HealthDegraded"
+            assert WebhookHandler.received[0]["level"] == "warning"
+        finally:
+            httpd.shutdown()
+
+
+class TestWiring:
+    def test_configure_from_config(self, repos):
+        config = load_config(path="/nonexistent", env={}, overrides={
+            "notify": {
+                "smtp": {"enabled": True, "host": "mail.local"},
+                "webhook": {"url": "http://hooks.local/x"},
+            },
+        })
+        messages = MessageService(repos)
+        configure_senders(messages, repos, config)
+        assert set(messages.senders) == {"smtp", "webhook"}
+
+    def test_broken_sender_does_not_block_notify(self, repos):
+        user = repos.users.save(User(name="admin2", is_admin=True))
+        events = EventService(repos)
+        messages = MessageService(repos)
+        messages.attach_to(events)
+
+        def explode(message):
+            raise RuntimeError("relay down")
+
+        messages.senders["smtp"] = explode
+        events.emit("c1", "Warning", "HealthDegraded", "node lost")
+        inbox = messages.inbox(user.id)
+        assert len(inbox) == 1  # in-app copy delivered despite sender crash
