@@ -371,6 +371,20 @@ class Handlers:
                        request.match_info["name"], body["file"])
         return json_response({"ok": True})
 
+    async def app_backup(self, request):
+        body = await request.json() if request.can_read_body else {}
+        name = await run_sync(request, self.s.backups.app_backup,
+                              request.match_info["name"],
+                              body.get("backup_name", ""),
+                              body.get("namespaces", ""))
+        return json_response({"backup": name}, status=201)
+
+    async def app_restore(self, request):
+        body = await request.json()
+        await run_sync(request, self.s.backups.app_restore,
+                       request.match_info["name"], body["backup"])
+        return json_response({"ok": True})
+
     async def backup_strategy(self, request):
         if request.method == "GET":
             strategy = await run_sync(request, self.s.backups.get_strategy,
@@ -657,6 +671,10 @@ def create_app(services: Services) -> web.Application:
               cluster_guard(h.list_backups, view))
     r.add_post("/api/v1/clusters/{name}/restore",
                cluster_guard(h.restore, manage))
+    r.add_post("/api/v1/clusters/{name}/app-backup",
+               cluster_guard(h.app_backup, manage))
+    r.add_post("/api/v1/clusters/{name}/app-restore",
+               cluster_guard(h.app_restore, manage))
     r.add_get("/api/v1/clusters/{name}/backup-strategy",
               cluster_guard(h.backup_strategy, view))
     r.add_post("/api/v1/clusters/{name}/backup-strategy",

@@ -20,6 +20,20 @@ COMPONENT_CATALOG: dict[str, dict] = {
     "metrics-server": {"playbook": "component-metrics-server.yml", "vars": {}},
     "ingress-nginx": {"playbook": "component-ingress-nginx.yml", "vars": {}},
     "traefik": {"playbook": "component-traefik.yml", "vars": {}},
+    "nfs-provisioner": {
+        "playbook": "component-nfs-provisioner.yml",
+        "vars": {"nfs_server": "", "nfs_path": "/export",
+                 "storage_class_name": "nfs-client"},
+    },
+    "rook-ceph": {
+        "playbook": "component-rook-ceph.yml",
+        "vars": {"ceph_use_all_devices": True, "ceph_mon_count": 3},
+    },
+    "velero": {
+        "playbook": "component-velero.yml",
+        # velero_* vars resolved from the cluster's BackupAccount at install
+        "vars": {"velero_bucket": "velero"},
+    },
     # The TPU runtime as a re-installable component (also runs as a create
     # phase for TPU plans): device plugin + JobSet controller + smoke job.
     "tpu-runtime": {"playbook": "16-tpu-runtime.yml", "vars": {}},

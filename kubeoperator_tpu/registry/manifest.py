@@ -51,6 +51,11 @@ def bundle_manifest() -> dict:
         "images/prometheus.tar",
         "images/grafana.tar",
         "images/loki.tar",
+        "images/kube-bench.tar",
+        "images/nfs-subdir-external-provisioner.tar",
+        "images/rook-ceph-operator.tar",
+        "images/ceph.tar",
+        "images/velero.tar",
         # TPU path (replaces nvidia-device-plugin / dcgm / nccl-tests images)
         f"images/ko-tpu-device-plugin-v1.0.tar",
         "images/jobset-controller.tar",
@@ -61,7 +66,10 @@ def bundle_manifest() -> dict:
         for runtime, pin in sorted(JAX_PIN_PER_RUNTIME.items())
     ]
     charts = ["charts/prometheus.tgz", "charts/grafana.tgz",
-              "charts/loki.tgz", "charts/cilium.tgz"]
+              "charts/loki.tgz", "charts/cilium.tgz",
+              "charts/nfs-subdir-external-provisioner.tgz",
+              "charts/rook-ceph.tgz", "charts/rook-ceph-cluster.tgz",
+              "charts/velero.tgz"]
     return {
         "version": __version__,
         "k8s_versions": list(SUPPORTED_K8S_VERSIONS),

@@ -4,12 +4,23 @@ retention, snapshot files, restore as inverse playbook."""
 
 from __future__ import annotations
 
+import re
+
 from kubeoperator_tpu.adm import AdmContext, ClusterAdm, backup_phases, restore_phases
 from kubeoperator_tpu.executor import Executor
 from kubeoperator_tpu.models import BackupAccount, BackupFile, BackupStrategy
 from kubeoperator_tpu.repository import Repositories
 from kubeoperator_tpu.utils.errors import NotFoundError, PhaseError, ValidationError
 from kubeoperator_tpu.utils.ids import now_iso
+
+# DNS-1123-ish: what velero/k8s accept for backup and namespace names; also
+# exactly what keeps user input shell/ansible-argument-inert
+_K8S_NAME_RE = re.compile(r"^[a-z0-9]([-a-z0-9.]{0,251}[a-z0-9])?$")
+
+
+def _check_k8s_name(value: str, what: str) -> None:
+    if not _K8S_NAME_RE.match(value):
+        raise ValidationError(f"invalid {what} {value!r}")
 
 
 class BackupService:
@@ -104,6 +115,56 @@ class BackupService:
     def list_files(self, cluster_name: str) -> list[BackupFile]:
         cluster = self.repos.clusters.get_by_name(cluster_name)
         return self.repos.backup_files.find(cluster_id=cluster.id)
+
+    # ---- velero application backups (SURVEY.md §5.4(b)) ----
+    def _require_velero(self, cluster) -> None:
+        comps = self.repos.components.find(cluster_id=cluster.id,
+                                           name="velero")
+        if not comps or comps[0].status != "Installed":
+            raise ValidationError(
+                "velero component is not installed on this cluster"
+            )
+
+    def app_backup(self, cluster_name: str, backup_name: str = "",
+                   namespaces: str = "") -> str:
+        """`velero backup create` on a master; returns the backup name."""
+        cluster = self.repos.clusters.get_by_name(cluster_name)
+        self._require_velero(cluster)
+        backup_name = backup_name or \
+            f"app-{cluster.name}-{now_iso().replace(':', '').lower()}"
+        _check_k8s_name(backup_name, "backup name")
+        cmd = f"velero backup create {backup_name} --wait"
+        if namespaces:
+            for ns in namespaces.split(","):
+                _check_k8s_name(ns, "namespace")
+            cmd += f" --include-namespaces {namespaces}"
+        self._velero_exec(cluster, cmd, "AppBackupFailed")
+        self.events.emit(cluster.id, "Normal", "AppBackupDone",
+                         f"velero backup {backup_name} created")
+        return backup_name
+
+    def app_restore(self, cluster_name: str, backup_name: str) -> None:
+        cluster = self.repos.clusters.get_by_name(cluster_name)
+        self._require_velero(cluster)
+        _check_k8s_name(backup_name, "backup name")
+        self._velero_exec(
+            cluster,
+            f"velero restore create --from-backup {backup_name} --wait",
+            "AppRestoreFailed",
+        )
+        self.events.emit(cluster.id, "Normal", "AppRestoreDone",
+                         f"velero restore from {backup_name} completed")
+
+    def _velero_exec(self, cluster, cmd: str, fail_reason: str) -> None:
+        ctx = AdmContext.for_cluster(self.repos, cluster)
+        task_id = self.adm.executor.run_adhoc(
+            "command", cmd, ctx.inventory(), pattern="kube-master"
+        )
+        result = self.adm.executor.wait(task_id, timeout_s=1800)
+        if not result.ok:
+            self.events.emit(cluster.id, "Warning", fail_reason,
+                             result.message)
+            raise PhaseError("velero", result.message)
 
     # ---- internals ----
     def _context(self, cluster, account: BackupAccount, fname: str) -> AdmContext:

@@ -9,7 +9,11 @@ from kubeoperator_tpu.executor import Executor
 from kubeoperator_tpu.models import ClusterComponent
 from kubeoperator_tpu.models.component import COMPONENT_CATALOG
 from kubeoperator_tpu.repository import Repositories
-from kubeoperator_tpu.utils.errors import NotFoundError, PhaseError
+from kubeoperator_tpu.utils.errors import (
+    NotFoundError,
+    PhaseError,
+    ValidationError,
+)
 
 
 class ComponentService:
@@ -28,21 +32,35 @@ class ComponentService:
     def install(self, cluster_name: str, component_name: str,
                 vars: dict | None = None) -> ClusterComponent:
         cluster = self.repos.clusters.get_by_name(cluster_name)
-        component = ClusterComponent(
-            cluster_id=cluster.id, name=component_name,
-            vars=vars or dict(COMPONENT_CATALOG.get(component_name, {}).get("vars", {})),
-        )
-        component.validate()
         existing = self.repos.components.find(cluster_id=cluster.id,
                                               name=component_name)
         if existing:
             component = existing[0]
-            component.vars = vars or component.vars
+            # a bare reinstall (no vars) must keep the customized vars, not
+            # reset them to catalog defaults
+            if vars is not None:
+                component.vars = dict(vars)
+        else:
+            component = ClusterComponent(
+                cluster_id=cluster.id, name=component_name,
+                vars=dict(vars) if vars is not None else dict(
+                    COMPONENT_CATALOG.get(component_name, {}).get("vars", {})
+                ),
+            )
+        # secret material (object-store keys) rides only in the phase's
+        # extra-vars; it is never persisted on the component row, which the
+        # API emits to view-role users
+        secret_vars: dict = {}
+        if component_name == "velero":
+            component.vars, secret_vars = self._resolve_velero_vars(
+                component.vars
+            )
+        component.validate()
         component.status = "Installing"
         self.repos.components.save(component)
 
         playbook = COMPONENT_CATALOG[component_name]["playbook"]
-        ctx = self._context(cluster, component)
+        ctx = self._context(cluster, component, secret_vars)
         try:
             self.adm.run(ctx, [Phase(f"component-{component_name}", playbook)])
         except PhaseError as e:
@@ -69,9 +87,37 @@ class ComponentService:
         self.events.emit(cluster.id, "Normal", "ComponentUninstalled",
                          f"{component_name} removed from {cluster_name}")
 
-    def _context(self, cluster, component: ClusterComponent) -> AdmContext:
+    def _resolve_velero_vars(self, vars: dict) -> tuple[dict, dict]:
+        """`account: <backup-account-name>` expands to the velero_* chart
+        values from that BackupAccount (S3-compatible endpoints only).
+        Returns (persistable vars, secret-only vars)."""
+        vars = dict(vars)
+        account_name = vars.pop("account", "")
+        if not account_name:
+            return vars, {}
+        account = self.repos.backup_accounts.get_by_name(account_name)
+        if account.type not in ("s3", "oss"):
+            raise ValidationError(
+                f"velero needs an s3/oss backup account, got {account.type}"
+            )
+        persisted = {
+            "velero_bucket": account.bucket,
+            "velero_s3_url": account.vars.get("endpoint", ""),
+            "velero_region": account.vars.get("region", "minio"),
+            **vars,
+        }
+        secrets = {
+            "velero_access_key": account.vars.get("access_key", ""),
+            "velero_secret_key": account.vars.get("secret_key", ""),
+        }
+        return persisted, secrets
+
+    def _context(self, cluster, component: ClusterComponent,
+                 secret_vars: dict | None = None) -> AdmContext:
         plan = (
             self.repos.plans.get(cluster.plan_id) if cluster.plan_id else None
         )
-        return AdmContext.for_cluster(self.repos, cluster, plan,
-                                      dict(component.vars))
+        return AdmContext.for_cluster(
+            self.repos, cluster, plan,
+            {**component.vars, **(secret_vars or {})},
+        )

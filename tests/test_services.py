@@ -277,6 +277,61 @@ class TestComponents:
         with pytest.raises(ValidationError):
             svc.components.install("comp", "gpu")
 
+    def test_storage_components_install(self, svc):
+        names = register_fleet(svc, 2)
+        svc.clusters.create("stor", spec=ClusterSpec(worker_count=1),
+                            host_names=names, wait=True)
+        nfs = svc.components.install("stor", "nfs-provisioner",
+                                     {"nfs_server": "10.0.0.50"})
+        assert nfs.status == "Installed"
+        # bare reinstall (repair) keeps customized vars, not catalog defaults
+        nfs = svc.components.install("stor", "nfs-provisioner")
+        assert nfs.vars["nfs_server"] == "10.0.0.50"
+        ceph = svc.components.install("stor", "rook-ceph")
+        assert ceph.status == "Installed"
+
+    def test_velero_app_backup_flow(self, svc):
+        names = register_fleet(svc, 2)
+        svc.clusters.create("vel", spec=ClusterSpec(worker_count=1),
+                            host_names=names, wait=True)
+        # app backup refuses before the component is installed
+        with pytest.raises(ValidationError):
+            svc.backups.app_backup("vel")
+        svc.backups.create_account(BackupAccount(
+            name="minio", type="s3", bucket="velero-bkt",
+            vars={"endpoint": "http://minio.local:9000",
+                  "access_key": "ak", "secret_key": "sk"},
+        ))
+        component = svc.components.install("vel", "velero",
+                                           {"account": "minio"})
+        assert component.status == "Installed"
+        # account resolved into chart values; secret material stays server-side
+        assert component.vars["velero_bucket"] == "velero-bkt"
+        assert component.vars["velero_s3_url"] == "http://minio.local:9000"
+        assert "velero_secret_key" not in component.vars  # never persisted
+        assert "velero_secret_key" not in component.to_public_dict().get(
+            "vars", {})
+
+        backup_name = svc.backups.app_backup("vel", namespaces="default")
+        assert backup_name.startswith("app-vel-")
+        # argument injection rejected before anything reaches a master
+        with pytest.raises(ValidationError):
+            svc.backups.app_backup("vel", backup_name="x --from-schedule s")
+        with pytest.raises(ValidationError):
+            svc.backups.app_backup("vel", namespaces="default --all")
+        svc.backups.app_restore("vel", backup_name)
+        events = {e.reason for e in svc.events.list(
+            svc.clusters.get("vel").id)}
+        assert {"AppBackupDone", "AppRestoreDone"} <= events
+
+    def test_velero_requires_object_store_account(self, svc):
+        names = register_fleet(svc, 2)
+        svc.clusters.create("vel2", spec=ClusterSpec(worker_count=1),
+                            host_names=names, wait=True)
+        svc.backups.create_account(BackupAccount(name="localdir", type="local"))
+        with pytest.raises(ValidationError):
+            svc.components.install("vel2", "velero", {"account": "localdir"})
+
 
 class TestTenancy:
     def test_auth_and_rbac(self, svc):
