@@ -20,14 +20,15 @@ from kubeoperator_tpu.utils.errors import ValidationError
 class PlanProvider(str, Enum):
     """IaaS providers the Terraform layer has templates for.
 
-    vsphere/openstack = upstream parity [upstream — UNVERIFIED];
-    gcp_tpu_vm = the north-star addition [BASELINE].
+    vsphere/openstack/fusioncompute = upstream parity [upstream —
+    UNVERIFIED]; gcp_tpu_vm = the north-star addition [BASELINE].
     bare_metal = manual mode (no Terraform; user-registered hosts).
     """
 
     BARE_METAL = "bare_metal"
     VSPHERE = "vsphere"
     OPENSTACK = "openstack"
+    FUSIONCOMPUTE = "fusioncompute"
     GCP_TPU_VM = "gcp_tpu_vm"
 
 

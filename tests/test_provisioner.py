@@ -86,6 +86,7 @@ class TestRendering:
         for provider, marker in [
             ("vsphere", 'resource "vsphere_virtual_machine" "worker"'),
             ("openstack", 'resource "openstack_compute_instance_v2" "worker"'),
+            ("fusioncompute", 'resource "fusioncompute_vm" "worker"'),
         ]:
             region = Region(name=f"r-{provider}", provider=provider, vars={})
             plan = Plan(name=f"p-{provider}", provider=provider,
