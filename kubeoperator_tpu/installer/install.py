@@ -79,6 +79,12 @@ def render_bundle(target_dir: str, data_dir: str | None = None,
     with open(compose_path, "w", encoding="utf-8") as f:
         yaml.safe_dump(compose, f, sort_keys=False)
 
+    # generated TPU observability manifests join the bundle so nodes can
+    # pull /opt/ko-manifests/* from the offline registry
+    from kubeoperator_tpu.registry.k8s_manifests import write_manifests
+
+    write_manifests(os.path.join(bundle_dir, "manifests"))
+
     app_yaml = os.path.join(data_dir, "config", "app.yaml")
     if not os.path.exists(app_yaml):
         with open(app_yaml, "w", encoding="utf-8") as f:

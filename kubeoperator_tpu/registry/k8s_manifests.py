@@ -1,0 +1,153 @@
+"""TPU observability K8s manifests the offline bundle ships to nodes.
+
+The content roles apply files from `/opt/ko-manifests/` (see
+`content/roles/component-grafana`, `component-prometheus`, `tpu-runtime`,
+`post`). Third-party manifests (metrics-server, ingress controllers, jobset
+controller) are consumed as prebuilt artifacts — listed in the bundle
+contract, not generated here. The TPU-specific ones are OURS (they replace
+the reference's nvidia-dcgm dashboards/exporter wiring [BASELINE "no GPU
+package"]) and are generated from the generation registry so a new TPU
+generation updates the dashboards automatically.
+"""
+
+from __future__ import annotations
+
+import json
+
+from kubeoperator_tpu.parallel.topology import GENERATIONS
+
+# every file roles reference under /opt/ko-manifests/, ours or third-party
+BUNDLED_MANIFESTS = (
+    "metrics-server.yaml",
+    "ingress-nginx.yaml",
+    "traefik.yaml",
+    "jobset-controller.yaml",
+    "grafana-tpu-dashboards.yaml",
+    "tpu-metrics-servicemonitor.yaml",
+)
+
+# metrics exposed by the device plugin / libtpu metrics endpoint that the
+# dashboards and the ServiceMonitor scrape contract agree on
+TPU_METRICS = {
+    "duty_cycle": "ko_tpu_duty_cycle_percent",
+    "hbm_used": "ko_tpu_hbm_used_bytes",
+    "hbm_total": "ko_tpu_hbm_total_bytes",
+    "ici_tx": "ko_tpu_ici_transmitted_bytes_total",
+    "ici_rx": "ko_tpu_ici_received_bytes_total",
+    "tensorcore_util": "ko_tpu_tensorcore_utilization_percent",
+}
+
+
+def _panel(panel_id: int, title: str, expr: str, unit: str, y: int) -> dict:
+    return {
+        "id": panel_id,
+        "title": title,
+        "type": "timeseries",
+        "datasource": {"type": "prometheus", "uid": "prometheus"},
+        "fieldConfig": {"defaults": {"unit": unit}},
+        "gridPos": {"h": 8, "w": 12, "x": (panel_id % 2) * 12, "y": y},
+        "targets": [{"expr": expr, "legendFormat": "{{node}} chip {{chip}}"}],
+    }
+
+
+def tpu_dashboard() -> dict:
+    """Grafana dashboard: per-chip utilization, ICI bandwidth, HBM."""
+    m = TPU_METRICS
+    panels = [
+        _panel(0, "TPU duty cycle", m["duty_cycle"], "percent", 0),
+        _panel(1, "TensorCore utilization", m["tensorcore_util"], "percent", 0),
+        _panel(
+            2,
+            "ICI bandwidth (tx+rx)",
+            f"rate({m['ici_tx']}[1m]) + rate({m['ici_rx']}[1m])",
+            "Bps",
+            8,
+        ),
+        _panel(
+            3,
+            "HBM usage",
+            f"{m['hbm_used']} / {m['hbm_total']}",
+            "percentunit",
+            8,
+        ),
+    ]
+    return {
+        "title": "TPU slices",
+        "uid": "ko-tpu-slices",
+        "tags": ["kubeoperator-tpu"],
+        "timezone": "browser",
+        "templating": {
+            "list": [
+                {
+                    "name": "generation",
+                    "type": "custom",
+                    "options": [
+                        {"text": g, "value": g} for g in sorted(GENERATIONS)
+                    ],
+                }
+            ]
+        },
+        "panels": panels,
+        "schemaVersion": 39,
+    }
+
+
+def grafana_dashboards_manifest() -> str:
+    """ConfigMap the grafana sidecar provisions dashboards from."""
+    dashboard_json = json.dumps(tpu_dashboard(), indent=1)
+    indented = "\n".join(
+        "    " + line for line in dashboard_json.splitlines()
+    )
+    return f"""apiVersion: v1
+kind: ConfigMap
+metadata:
+  name: ko-tpu-grafana-dashboards
+  namespace: monitoring
+  labels:
+    grafana_dashboard: "1"
+data:
+  tpu-slices.json: |
+{indented}
+"""
+
+
+def tpu_servicemonitor_manifest() -> str:
+    """Prometheus-operator ServiceMonitor scraping the device-plugin
+    metrics endpoint on every TPU host (replaces dcgm-exporter scrape)."""
+    return """apiVersion: monitoring.coreos.com/v1
+kind: ServiceMonitor
+metadata:
+  name: ko-tpu-device-plugin
+  namespace: monitoring
+  labels:
+    app: ko-tpu-device-plugin
+spec:
+  namespaceSelector:
+    matchNames: ["kube-system"]
+  selector:
+    matchLabels:
+      app: ko-tpu-device-plugin
+  endpoints:
+    - port: metrics
+      interval: 15s
+"""
+
+
+GENERATED = {
+    "grafana-tpu-dashboards.yaml": grafana_dashboards_manifest,
+    "tpu-metrics-servicemonitor.yaml": tpu_servicemonitor_manifest,
+}
+
+
+def write_manifests(dest_dir: str) -> list:
+    """Write the generated manifests into a bundle's manifests/ dir."""
+    import os
+
+    os.makedirs(dest_dir, exist_ok=True)
+    written = []
+    for name, gen in GENERATED.items():
+        path = os.path.join(dest_dir, name)
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(gen())
+        written.append(path)
+    return written

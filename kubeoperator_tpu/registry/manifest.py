@@ -65,6 +65,9 @@ def bundle_manifest() -> dict:
         f"pypi/jax_tpu-{pin}-{runtime}.whl"
         for runtime, pin in sorted(JAX_PIN_PER_RUNTIME.items())
     ]
+    from kubeoperator_tpu.registry.k8s_manifests import BUNDLED_MANIFESTS
+
+    k8s_manifests = [f"manifests/{name}" for name in BUNDLED_MANIFESTS]
     charts = ["charts/prometheus.tgz", "charts/grafana.tgz",
               "charts/loki.tgz", "charts/cilium.tgz",
               "charts/nfs-subdir-external-provisioner.tgz",
@@ -73,7 +76,8 @@ def bundle_manifest() -> dict:
     return {
         "version": __version__,
         "k8s_versions": list(SUPPORTED_K8S_VERSIONS),
-        "artifacts": sorted(k8s_debs + base_debs + images + wheels + charts),
+        "artifacts": sorted(k8s_debs + base_debs + images + wheels + charts
+                            + k8s_manifests),
     }
 
 

@@ -85,3 +85,41 @@ class TestRegistry:
             assert resp.content == b"tarball"
         finally:
             server.shutdown()
+
+
+class TestK8sManifests:
+    def test_dashboard_configmap_parses_and_covers_tpu_panels(self):
+        import json
+
+        from kubeoperator_tpu.registry.k8s_manifests import (
+            grafana_dashboards_manifest,
+            tpu_servicemonitor_manifest,
+        )
+
+        cm = yaml.safe_load(grafana_dashboards_manifest())
+        assert cm["kind"] == "ConfigMap"
+        assert cm["metadata"]["labels"]["grafana_dashboard"] == "1"
+        dash = json.loads(cm["data"]["tpu-slices.json"])
+        titles = {p["title"] for p in dash["panels"]}
+        assert {"TPU duty cycle", "ICI bandwidth (tx+rx)",
+                "HBM usage"} <= titles
+        # no GPU metric anywhere [BASELINE: no GPU package]
+        assert "nvidia" not in json.dumps(dash).lower()
+
+        sm = yaml.safe_load(tpu_servicemonitor_manifest())
+        assert sm["kind"] == "ServiceMonitor"
+        assert sm["spec"]["selector"]["matchLabels"]["app"] == (
+            "ko-tpu-device-plugin")
+
+    def test_bundle_lists_every_role_referenced_manifest(self):
+        from kubeoperator_tpu.registry.k8s_manifests import BUNDLED_MANIFESTS
+
+        arts = bundle_manifest()["artifacts"]
+        for name in BUNDLED_MANIFESTS:
+            assert f"manifests/{name}" in arts
+
+    def test_installer_bundle_ships_generated_manifests(self, tmp_path):
+        render_bundle(str(tmp_path / "t"))
+        generated = tmp_path / "t" / "bundle" / "manifests"
+        assert (generated / "grafana-tpu-dashboards.yaml").exists()
+        assert (generated / "tpu-metrics-servicemonitor.yaml").exists()
