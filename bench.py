@@ -96,15 +96,26 @@ def main() -> int:
             "vs_baseline": round(best / envelope, 3),
         }
     else:
-        m = mxu_matmul_tflops(size=2048, iters=400)
-        details["mxu_tflops_2048"] = round(m.tflops, 1)
+        # sweep matmul sizes: bigger operands amortize loop/readback overhead
+        # and raise MXU occupancy, but VMEM pressure varies by generation —
+        # measure, don't guess, and report the best sustained rate
+        # iteration counts sized so hi-run device time is ~100s of ms —
+        # differential timing cancels constant relay RTT, but only a device
+        # time >> RTT jitter keeps the delta noise-free (a 27ms run behind a
+        # tunnel measured 1.3x datasheet peak; physically impossible)
+        best_m = None
+        for size, iters in ((2048, 3000), (4096, 400), (8192, 60)):
+            m = mxu_matmul_tflops(size=size, iters=iters)
+            details[f"mxu_tflops_{size}"] = round(m.tflops, 1)
+            if best_m is None or m.tflops > best_m.tflops:
+                best_m = m
         h = hbm_bandwidth_gbps(size_mb=256, iters=50)
         details["hbm_triad_gbps"] = round(h.gbps, 1)
         result = {
             "metric": f"{gen.name}_single_chip_mxu_bf16_tflops",
-            "value": round(m.tflops, 1),
+            "value": round(best_m.tflops, 1),
             "unit": "TFLOP/s",
-            "vs_baseline": round(m.tflops / gen.bf16_tflops_per_chip, 3),
+            "vs_baseline": round(best_m.tflops / gen.bf16_tflops_per_chip, 3),
         }
 
     result["details"] = details

@@ -59,7 +59,7 @@ def mxu_matmul_tflops(
         return float(chain(a, w, n))  # float() forces host fetch
 
     dt = differential_time_per_iter(
-        run, lo=max(iters // 8, 1), hi=max(iters, iters // 8 + 2)
+        run, lo=max(iters // 8, 1), hi=max(iters, iters // 8 + 2), trials=5
     )
     flops = 2.0 * size * size * size
     return MatmulResult(
