@@ -24,6 +24,9 @@ COMPONENT_CATALOG: dict[str, dict] = {
         "playbook": "component-nfs-provisioner.yml",
         "vars": {"nfs_server": "", "nfs_path": "/export",
                  "storage_class_name": "nfs-client"},
+        # empty nfs.server deploys a provisioner that can never bind a PV —
+        # fail at install time instead
+        "required": ("nfs_server",),
     },
     "rook-ceph": {
         "playbook": "component-rook-ceph.yml",

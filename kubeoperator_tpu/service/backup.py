@@ -19,7 +19,9 @@ _K8S_NAME_RE = re.compile(r"^[a-z0-9]([-a-z0-9.]{0,251}[a-z0-9])?$")
 
 
 def _check_k8s_name(value: str, what: str) -> None:
-    if not _K8S_NAME_RE.match(value):
+    # fullmatch: `$` alone would accept a trailing newline, letting the
+    # recorded name diverge from what velero actually creates
+    if not _K8S_NAME_RE.fullmatch(value):
         raise ValidationError(f"invalid {what} {value!r}")
 
 

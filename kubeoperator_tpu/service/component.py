@@ -3,6 +3,8 @@
 
 from __future__ import annotations
 
+import re
+
 from kubeoperator_tpu.adm import AdmContext, ClusterAdm
 from kubeoperator_tpu.adm.engine import Phase
 from kubeoperator_tpu.executor import Executor
@@ -14,6 +16,23 @@ from kubeoperator_tpu.utils.errors import (
     PhaseError,
     ValidationError,
 )
+
+
+# Component vars are rendered into role command lines (`--set k={{ v }}`).
+# No whitespace or shell metacharacters means the ansible command module's
+# shlex split can never turn one value into extra helm/kubectl arguments.
+_INERT_VALUE_RE = re.compile(r"[A-Za-z0-9._:/@+=-]*")
+
+
+def _check_vars_inert(vars: dict, origin: str) -> None:
+    for key, value in vars.items():
+        if isinstance(value, (bool, int, float)) or value is None:
+            continue
+        if not isinstance(value, str) or not _INERT_VALUE_RE.fullmatch(value):
+            raise ValidationError(
+                f"{origin} var {key!r} has a non-argument-inert value"
+                f" {value!r}"
+            )
 
 
 class ComponentService:
@@ -56,6 +75,15 @@ class ComponentService:
                 component.vars
             )
         component.validate()
+        _check_vars_inert(component.vars, component_name)
+        _check_vars_inert(secret_vars, f"{component_name} account")
+        for required in COMPONENT_CATALOG.get(component_name, {}).get(
+            "required", ()
+        ):
+            if not component.vars.get(required):
+                raise ValidationError(
+                    f"{component_name} requires var {required!r}"
+                )
         component.status = "Installing"
         self.repos.components.save(component)
 
@@ -92,7 +120,12 @@ class ComponentService:
         values from that BackupAccount (S3-compatible endpoints only).
         Returns (persistable vars, secret-only vars)."""
         vars = dict(vars)
-        account_name = vars.pop("account", "")
+        # `velero_account` is the persisted form, so a bare repair reinstall
+        # (vars=None) can re-resolve the object-store keys instead of
+        # overwriting the credentials file with empty strings
+        account_name = vars.pop("account", "") or vars.get(
+            "velero_account", ""
+        )
         if not account_name:
             return vars, {}
         account = self.repos.backup_accounts.get_by_name(account_name)
@@ -101,6 +134,7 @@ class ComponentService:
                 f"velero needs an s3/oss backup account, got {account.type}"
             )
         persisted = {
+            "velero_account": account.name,
             "velero_bucket": account.bucket,
             "velero_s3_url": account.vars.get("endpoint", ""),
             "velero_region": account.vars.get("region", "minio"),

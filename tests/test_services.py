@@ -324,6 +324,47 @@ class TestComponents:
             svc.clusters.get("vel").id)}
         assert {"AppBackupDone", "AppRestoreDone"} <= events
 
+    def test_velero_bare_reinstall_keeps_account_secrets(self, svc):
+        """Repair reinstall (vars=None) re-resolves object-store keys from
+        the persisted account name instead of wiping the credentials file."""
+        names = register_fleet(svc, 2)
+        svc.clusters.create("vel3", spec=ClusterSpec(worker_count=1),
+                            host_names=names, wait=True)
+        svc.backups.create_account(BackupAccount(
+            name="minio3", type="s3", bucket="b",
+            vars={"endpoint": "http://m:9000",
+                  "access_key": "AK", "secret_key": "SK"},
+        ))
+        svc.components.install("vel3", "velero", {"account": "minio3"})
+        component = svc.components.install("vel3", "velero")  # bare repair
+        assert component.vars["velero_account"] == "minio3"
+        assert component.vars["velero_bucket"] == "b"
+        assert "velero_secret_key" not in component.vars
+
+    def test_component_vars_must_be_argument_inert(self, svc):
+        names = register_fleet(svc, 2)
+        svc.clusters.create("inj", spec=ClusterSpec(worker_count=1),
+                            host_names=names, wait=True)
+        with pytest.raises(ValidationError):
+            svc.components.install("inj", "nfs-provisioner", {
+                "nfs_server": "1.2.3.4 --set-file x=/etc/kubernetes/admin.conf",
+                "nfs_path": "/export",
+            })
+        # required var enforced: empty nfs.server can never bind a PV
+        with pytest.raises(ValidationError):
+            svc.components.install("inj", "nfs-provisioner", {"nfs_path": "/e"})
+
+    def test_backup_name_rejects_trailing_newline(self, svc):
+        names = register_fleet(svc, 2)
+        svc.clusters.create("nl", spec=ClusterSpec(worker_count=1),
+                            host_names=names, wait=True)
+        svc.backups.create_account(BackupAccount(
+            name="m", type="s3", bucket="b",
+            vars={"endpoint": "http://m:9000"}))
+        svc.components.install("nl", "velero", {"account": "m"})
+        with pytest.raises(ValidationError):
+            svc.backups.app_backup("nl", backup_name="abc\n")
+
     def test_velero_requires_object_store_account(self, svc):
         names = register_fleet(svc, 2)
         svc.clusters.create("vel2", spec=ClusterSpec(worker_count=1),
