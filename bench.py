@@ -84,7 +84,7 @@ def main() -> int:
             return 1
         best = None
         for size in (8.0, 32.0, 64.0):
-            r = bench_collective("psum", size_mb=size, mesh=mesh, iters=16)
+            r = bench_collective("psum", size_mb=size, mesh=mesh, iters=48)
             details[f"psum_busbw_{int(size)}mb"] = round(r.busbw_gbps, 2)
             if best is None or r.busbw_gbps > best:
                 best = r.busbw_gbps
@@ -109,7 +109,7 @@ def main() -> int:
             details[f"mxu_tflops_{size}"] = round(m.tflops, 1)
             if best_m is None or m.tflops > best_m.tflops:
                 best_m = m
-        h = hbm_bandwidth_gbps(size_mb=256, iters=50)
+        h = hbm_bandwidth_gbps(size_mb=256, iters=200)
         details["hbm_triad_gbps"] = round(h.gbps, 1)
         result = {
             "metric": f"{gen.name}_single_chip_mxu_bf16_tflops",

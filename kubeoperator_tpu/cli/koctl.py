@@ -356,7 +356,37 @@ def cmd_tpu(client, args) -> int:
                   f"hosts={entry['total_hosts']:<3} ici={entry['ici_mesh']:<8} "
                   f"runtime={entry['runtime_version']}")
         return 0
+    if args.tpu_cmd == "diag":
+        return cmd_tpu_diag(args)
     raise SystemExit(f"unknown tpu command {args.tpu_cmd}")
+
+
+def cmd_tpu_diag(args) -> int:
+    """Local-host TPU diagnostics (runs on THIS machine's visible devices,
+    no server needed): MXU throughput, HBM stream, explicit-DMA read and —
+    with >=2 devices — the XLA collective suite plus the pallas ICI ring.
+    The node-side analog of the smoke test; ops/__init__.py rationale."""
+    import jax
+
+    from kubeoperator_tpu import ops
+
+    devices = jax.devices()
+    report: dict = {
+        "devices": len(devices),
+        "device_kind": getattr(devices[0], "device_kind", str(devices[0])),
+    }
+    report["mxu"] = ops.mxu_matmul_tflops(
+        size=args.size, iters=args.iters).to_dict()
+    report["hbm_triad"] = ops.hbm_bandwidth_gbps().to_dict()
+    report["dma_read"] = ops.dma_read_bandwidth_gbps().to_dict()
+    if len(devices) >= 2:
+        report["collectives"] = [
+            r.to_dict() for r in ops.run_collective_suite()
+        ]
+        report["ring_all_gather_correct"] = ops.verify_ring_all_gather()
+        report["pallas_ring"] = ops.bench_ring_all_gather().to_dict()
+    print(json.dumps(report, indent=2))
+    return 0
 
 
 def cmd_server(args) -> int:
@@ -435,6 +465,11 @@ def build_parser() -> argparse.ArgumentParser:
     tpu = sub.add_parser("tpu")
     tsub = tpu.add_subparsers(dest="tpu_cmd", required=True)
     tsub.add_parser("catalog")
+    diag_p = tsub.add_parser(
+        "diag", help="local-device diagnostics (MXU/HBM/DMA/ICI)"
+    )
+    diag_p.add_argument("--size", type=int, default=4096)
+    diag_p.add_argument("--iters", type=int, default=30)
 
     install_p = sub.add_parser("install", help="render/start the platform bundle")
     install_p.add_argument("--dir", default="/opt/ko-tpu")

@@ -10,6 +10,8 @@ exercise the same hardware axes TPU-natively:
                   jax.sharding.Mesh via shard_map
   matmul.py       MXU sustained bf16 throughput (systolic-array health)
   hbm.py          HBM stream bandwidth (pallas triad kernel)
+  pallas_kernels.py  hand-scheduled diagnostics: double-buffered DMA read
+                  stream + explicit remote-DMA ICI ring all-gather
   psum_smoke.py   the cluster smoke test: correctness + psum bus-bandwidth
                   across the full slice, emitting KO_TPU_SMOKE_RESULT
 
@@ -24,6 +26,12 @@ from kubeoperator_tpu.ops.collectives import (
 )
 from kubeoperator_tpu.ops.matmul import mxu_matmul_tflops
 from kubeoperator_tpu.ops.hbm import hbm_bandwidth_gbps
+from kubeoperator_tpu.ops.pallas_kernels import (
+    bench_ring_all_gather,
+    dma_read_bandwidth_gbps,
+    ring_all_gather,
+    verify_ring_all_gather,
+)
 
 __all__ = [
     "CollectiveResult",
@@ -31,4 +39,8 @@ __all__ = [
     "run_collective_suite",
     "mxu_matmul_tflops",
     "hbm_bandwidth_gbps",
+    "bench_ring_all_gather",
+    "dma_read_bandwidth_gbps",
+    "ring_all_gather",
+    "verify_ring_all_gather",
 ]

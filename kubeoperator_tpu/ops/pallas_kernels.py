@@ -1,0 +1,275 @@
+"""Hand-scheduled pallas diagnostics: explicit-DMA HBM reads and an ICI
+ring all-gather over remote DMA.
+
+The XLA-level benches (collectives.py, hbm.py) measure what the compiler's
+schedule achieves; these two kernels measure what the raw engines achieve
+when driven directly (/opt/skills/guides/pallas_guide.md patterns 17/18):
+
+  dma_read_bandwidth_gbps  double-buffered `make_async_copy` HBM→VMEM
+                           stream — isolates the DMA engines from XLA's
+                           fusion choices; a gap vs hbm.py's triad points
+                           at scheduling, a gap vs datasheet at memory.
+  ring_all_gather          neighbor-to-neighbor `make_async_remote_copy`
+                           ring — the ICI-health analog: XLA's all_gather
+                           may route differently; the explicit ring pins
+                           traffic to adjacent links, so a slow link shows
+                           up instead of being averaged away.
+
+Both run `interpret=True` on CPU so CI exercises the identical kernel code
+(multi-device interpret emulates the remote DMAs on the host mesh).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.experimental.shard_map import shard_map
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from kubeoperator_tpu.ops.collectives import CollectiveResult
+from kubeoperator_tpu.ops.timing import differential_time_per_iter
+from kubeoperator_tpu.parallel.mesh import flat_axis_mesh
+
+AXIS = "devices"
+COLS = 1024        # lane-aligned
+CHUNK_ROWS = 256   # f32 tile-aligned (multiple of 8)
+
+
+# ------------------------------------------------------------ DMA stream ----
+def _dma_read_kernel(seed_ref, hbm_ref, out_ref):
+    """Sum `hbm_ref` chunk-wise, double-buffering HBM→VMEM copies so the
+    next chunk's DMA overlaps the current chunk's reduction."""
+    num_chunks = hbm_ref.shape[0] // CHUNK_ROWS
+
+    def body(scratch, sem):
+        def get_dma(slot, idx):
+            return pltpu.make_async_copy(
+                hbm_ref.at[pl.ds(idx * CHUNK_ROWS, CHUNK_ROWS)],
+                scratch.at[slot],
+                sem.at[slot],
+            )
+
+        get_dma(0, 0).start()
+
+        def loop(idx, acc):
+            cur = jax.lax.rem(idx, 2)
+            nxt = jax.lax.rem(idx + 1, 2)
+
+            @pl.when(idx + 1 < num_chunks)
+            def _():
+                get_dma(nxt, idx + 1).start()
+
+            get_dma(cur, idx).wait()
+            return acc + scratch[cur].reshape(-1, 8, COLS).sum(axis=0)
+
+        # seed varies per bench iteration so chained calls can never be
+        # collapsed into one by the compiler
+        acc0 = jnp.full((8, COLS), seed_ref[0], jnp.float32)
+        out_ref[...] = jax.lax.fori_loop(0, num_chunks, loop, acc0)
+
+    pl.run_scoped(
+        body,
+        scratch=pltpu.VMEM((2, CHUNK_ROWS, COLS), jnp.float32),
+        sem=pltpu.SemaphoreType.DMA((2,)),
+    )
+
+
+def _dma_read(x, seed, interpret: bool):
+    return pl.pallas_call(
+        _dma_read_kernel,
+        out_shape=jax.ShapeDtypeStruct((8, COLS), jnp.float32),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.ANY),  # stays in HBM; DMA'd manually
+        ],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(seed, x)
+
+
+@dataclass(frozen=True)
+class DmaReadResult:
+    bytes_read: int
+    time_s: float
+    gbps: float
+
+    def to_dict(self) -> dict:
+        return dict(self.__dict__)
+
+
+def dma_read_bandwidth_gbps(
+    size_mb: float = 256.0, iters: int = 20, device: jax.Device | None = None
+) -> DmaReadResult:
+    """Sustained HBM read bandwidth through explicit double-buffered DMA."""
+    device = device or jax.devices()[0]
+    interpret = device.platform != "tpu"
+    if interpret:
+        size_mb = min(size_mb, 1.0)  # interpreter is slow; CI only
+        iters = min(iters, 2)
+    else:
+        # a sub-10ms window behind the TPU relay reads above datasheet —
+        # keep device time in the 100ms range so RTT jitter cancels
+        iters = max(iters, 300)
+    rows = max(int(size_mb * 1e6) // (COLS * 4) // CHUNK_ROWS, 1) * CHUNK_ROWS
+    x = jax.device_put(jnp.ones((rows, COLS), jnp.float32), device)
+
+    @partial(jax.jit, static_argnums=(1,))
+    def chain(v, n):
+        def step(i, acc):
+            seed = jnp.full((1,), i, jnp.float32)
+            return acc + _dma_read(v, seed, interpret)[0, 0]
+        return jax.lax.fori_loop(0, n, step, jnp.float32(0))
+
+    def run(n: int) -> float:
+        return float(chain(x, n))
+
+    dt = differential_time_per_iter(
+        run, lo=max(iters // 8, 1), hi=max(iters, iters // 8 + 2)
+    )
+    bytes_read = rows * COLS * 4
+    return DmaReadResult(
+        bytes_read=bytes_read, time_s=dt, gbps=bytes_read / dt / 1e9
+    )
+
+
+# ------------------------------------------------------- ICI ring gather ----
+def _ring_all_gather_kernel(local_ref, out_ref, comm_ref, send_sem, recv_sem):
+    """Each step: pass the chunk received last step to the right neighbor
+    while copying it into the local output (bidirectional-ring upgrade is a
+    follow-up; one direction already pins traffic to adjacent ICI links)."""
+    ndev = jax.lax.axis_size(AXIS)
+    my_id = jax.lax.axis_index(AXIS)
+    chunk = local_ref.shape[0]
+
+    out_ref[pl.ds(my_id * chunk, chunk), :] = local_ref[...]
+    comm_ref[0] = local_ref[...]
+
+    def step(i, _):
+        send_slot = jax.lax.rem(i, 2)
+        recv_slot = jax.lax.rem(i + 1, 2)
+        dst = jax.lax.rem(my_id + 1, ndev)
+        rdma = pltpu.make_async_remote_copy(
+            src_ref=comm_ref.at[send_slot],
+            dst_ref=comm_ref.at[recv_slot],
+            send_sem=send_sem.at[send_slot],
+            recv_sem=recv_sem.at[recv_slot],
+            device_id=dst,
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+        rdma.start()
+        rdma.wait()
+        src_dev = jax.lax.rem(my_id - i - 1 + ndev, ndev)
+        out_ref[pl.ds(src_dev * chunk, chunk), :] = comm_ref[recv_slot]
+        return 0
+
+    jax.lax.fori_loop(0, ndev - 1, step, 0)
+
+
+def ring_all_gather(x, mesh=None, interpret: bool | None = None):
+    """All-gather a row-sharded [n*chunk, COLS] array via an explicit ICI
+    ring. Returns the fully-gathered array (replicated)."""
+    mesh = mesh or flat_axis_mesh()
+    n = mesh.devices.size
+    if interpret is None:
+        interpret = mesh.devices.flat[0].platform != "tpu"
+    rows, cols = x.shape
+    if rows % n:
+        raise ValueError(f"rows {rows} not divisible by {n} devices")
+    chunk = rows // n
+
+    def gather(v):
+        return pl.pallas_call(
+            _ring_all_gather_kernel,
+            out_shape=jax.ShapeDtypeStruct((rows, cols), x.dtype),
+            scratch_shapes=[
+                pltpu.VMEM((2, chunk, cols), x.dtype),
+                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.DMA((2,)),
+            ],
+            interpret=interpret,
+            compiler_params=pltpu.CompilerParams(collective_id=0),
+        )(v)
+
+    x = jax.device_put(x, NamedSharding(mesh, P(AXIS, None)))
+    return jax.jit(
+        shard_map(gather, mesh=mesh, in_specs=P(AXIS, None),
+                  out_specs=P(None, None), check_rep=False)
+    )(x)
+
+
+def bench_ring_all_gather(
+    size_mb: float = 16.0, mesh=None, iters: int = 10
+) -> CollectiveResult:
+    """Bus bandwidth of the explicit ring (nccl-tests all_gather convention:
+    busbw = (n-1) * shard_bytes / t)."""
+    mesh = mesh or flat_axis_mesh()
+    n = mesh.devices.size
+    interpret = mesh.devices.flat[0].platform != "tpu"
+    if interpret:
+        size_mb = min(size_mb, 0.5)
+        iters = min(iters, 2)
+    shard_rows = max(int(size_mb * 1e6) // (COLS * 4) // 8, 1) * 8
+    rows = shard_rows * n
+    x = jax.device_put(
+        jnp.ones((rows, COLS), jnp.float32),
+        NamedSharding(mesh, P(AXIS, None)),
+    )
+    chunk = shard_rows
+
+    def gather(v):
+        return pl.pallas_call(
+            _ring_all_gather_kernel,
+            out_shape=jax.ShapeDtypeStruct((rows, COLS), jnp.float32),
+            scratch_shapes=[
+                pltpu.VMEM((2, chunk, COLS), jnp.float32),
+                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.DMA((2,)),
+            ],
+            interpret=interpret,
+            compiler_params=pltpu.CompilerParams(collective_id=0),
+        )(v)
+
+    @partial(jax.jit, static_argnums=(1,))
+    def run_iters(v, k):
+        @partial(shard_map, mesh=mesh, in_specs=P(AXIS, None),
+                 out_specs=P(AXIS, None), check_rep=False)
+        def body(u):
+            def step(_, w):
+                g = gather(w)
+                # keep only the local shard so iterations chain at shard size
+                return jax.lax.dynamic_slice_in_dim(
+                    g, jax.lax.axis_index(AXIS) * chunk, chunk
+                ) * (1.0 / n)
+            return jax.lax.fori_loop(0, k, step, u)
+
+        return body(v).sum()
+
+    def run(k: int) -> float:
+        return float(run_iters(x, k))
+
+    dt = differential_time_per_iter(
+        run, lo=max(iters // 4, 1), hi=max(iters, iters // 4 + 2)
+    )
+    shard_bytes = chunk * COLS * 4
+    algbw = shard_bytes / dt / 1e9
+    return CollectiveResult(
+        op="pallas_ring_all_gather", n_devices=n,
+        bytes_per_device=shard_bytes, time_per_iter_s=dt,
+        algbw_gbps=algbw, busbw_gbps=algbw * (n - 1),
+    )
+
+
+def verify_ring_all_gather(mesh=None) -> bool:
+    """Correctness gate: explicit ring must agree with the XLA collective."""
+    mesh = mesh or flat_axis_mesh()
+    n = mesh.devices.size
+    rows = 8 * n
+    x = jnp.arange(rows * COLS, dtype=jnp.float32).reshape(rows, COLS)
+    out = ring_all_gather(x, mesh)
+    return bool(np.array_equal(np.asarray(out), np.asarray(x)))

@@ -243,3 +243,37 @@ class TestKoctlLocal:
         assert rc == 0
         assert "is Ready" in out
         assert "psum" in out and "16 chips" in out
+
+
+class TestKoctlTpuDiag:
+    def test_diag_reports_all_families(self, capsys, monkeypatch):
+        """Wiring check: heavy benches stubbed, JSON covers every family
+        (the real kernels are exercised directly in test_ops.py)."""
+        import json as _json
+        from types import SimpleNamespace
+
+        from kubeoperator_tpu import ops
+        from kubeoperator_tpu.cli import koctl
+
+        def fake(**fields):
+            return SimpleNamespace(to_dict=lambda: dict(fields))
+
+        monkeypatch.setattr(ops, "mxu_matmul_tflops",
+                            lambda **kw: fake(tflops=1.0))
+        monkeypatch.setattr(ops, "hbm_bandwidth_gbps",
+                            lambda **kw: fake(gbps=2.0))
+        monkeypatch.setattr(ops, "dma_read_bandwidth_gbps",
+                            lambda **kw: fake(gbps=3.0))
+        monkeypatch.setattr(ops, "run_collective_suite",
+                            lambda **kw: [fake(op="psum")])
+        monkeypatch.setattr(ops, "verify_ring_all_gather", lambda **kw: True)
+        monkeypatch.setattr(ops, "bench_ring_all_gather",
+                            lambda **kw: fake(busbw_gbps=4.0))
+
+        assert koctl.main(["tpu", "diag"]) == 0
+        report = _json.loads(capsys.readouterr().out)
+        assert report["devices"] == 8
+        assert report["mxu"]["tflops"] == 1.0
+        assert report["dma_read"]["gbps"] == 3.0
+        assert report["ring_all_gather_correct"] is True
+        assert report["pallas_ring"]["busbw_gbps"] == 4.0

@@ -87,3 +87,35 @@ def test_smoke_chip_mismatch_fails(monkeypatch):
     monkeypatch.setenv("KO_TPU_EXPECTED_CHIPS", "16")
     result = run_smoke(sizes_mb=(0.1,), iters=2)
     assert not result["ok"] and result["correctness"]
+
+
+def test_dma_read_interpreted():
+    from kubeoperator_tpu.ops import dma_read_bandwidth_gbps
+
+    r = dma_read_bandwidth_gbps(size_mb=1.0, iters=2)
+    assert r.gbps > 0 and r.bytes_read > 0
+
+
+def test_ring_all_gather_matches_xla():
+    from kubeoperator_tpu.ops import verify_ring_all_gather
+
+    assert verify_ring_all_gather()
+
+
+def test_ring_all_gather_rejects_indivisible_rows():
+    import jax.numpy as jnp
+
+    from kubeoperator_tpu.ops import ring_all_gather
+    from kubeoperator_tpu.ops.pallas_kernels import COLS
+
+    with pytest.raises(ValueError):
+        ring_all_gather(jnp.ones((9, COLS), jnp.float32))
+
+
+def test_bench_ring_all_gather_reports_busbw():
+    from kubeoperator_tpu.ops import bench_ring_all_gather
+
+    r = bench_ring_all_gather(size_mb=0.25, iters=2)
+    assert r.op == "pallas_ring_all_gather"
+    assert r.n_devices == 8
+    assert r.busbw_gbps == pytest.approx(r.algbw_gbps * 7)
