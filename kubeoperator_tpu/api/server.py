@@ -736,6 +736,7 @@ def create_app(services: Services) -> web.Application:
     r.add_post("/api/v1/hosts/{name}/facts", admin_guard(h.host_facts))
     r.add_delete("/api/v1/hosts/{name}", admin_guard(delete_host))
     r.add_get("/api/v1/plans-tpu-catalog", h.tpu_catalog)
+    r.add_get("/api/v1/components-catalog", h.component_catalog)
 
     r.add_get("/api/v1/projects", h.list_projects)
     r.add_post("/api/v1/projects", h.create_project)

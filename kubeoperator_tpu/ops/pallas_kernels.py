@@ -139,10 +139,21 @@ def dma_read_bandwidth_gbps(
 
 
 # ------------------------------------------------------- ICI ring gather ----
-def _ring_all_gather_kernel(local_ref, out_ref, comm_ref, send_sem, recv_sem):
+def _ring_all_gather_kernel(local_ref, out_ref, comm_ref, send_sem, recv_sem,
+                            ready_sem, *, flow_control: bool):
     """Each step: pass the chunk received last step to the right neighbor
     while copying it into the local output (bidirectional-ring upgrade is a
-    follow-up; one direction already pins traffic to adjacent ICI links)."""
+    follow-up; one direction already pins traffic to adjacent ICI links).
+
+    Flow control: my right neighbor writes into MY comm slots, so after
+    finishing step i (send drained, received chunk consumed) each device
+    signals its LEFT neighbor, and before the step-i send (i>0) waits for
+    its RIGHT neighbor's step-(i-1) signal. Without it a fast device's
+    step-i remote write can clobber the slot a lagging neighbor is still
+    reading for its step-(i-1) send — a skew exactly as large as the slow
+    ICI link this diagnostic exists to expose. `flow_control` is False only
+    under interpret mode (lockstep emulation; remote semaphore_signal is
+    not implemented there)."""
     ndev = jax.lax.axis_size(AXIS)
     my_id = jax.lax.axis_index(AXIS)
     chunk = local_ref.shape[0]
@@ -154,6 +165,13 @@ def _ring_all_gather_kernel(local_ref, out_ref, comm_ref, send_sem, recv_sem):
         send_slot = jax.lax.rem(i, 2)
         recv_slot = jax.lax.rem(i + 1, 2)
         dst = jax.lax.rem(my_id + 1, ndev)
+        left = jax.lax.rem(my_id - 1 + ndev, ndev)
+
+        if flow_control:
+            @pl.when(i > 0)
+            def _():
+                pltpu.semaphore_wait(ready_sem, 1)
+
         rdma = pltpu.make_async_remote_copy(
             src_ref=comm_ref.at[send_slot],
             dst_ref=comm_ref.at[recv_slot],
@@ -166,6 +184,11 @@ def _ring_all_gather_kernel(local_ref, out_ref, comm_ref, send_sem, recv_sem):
         rdma.wait()
         src_dev = jax.lax.rem(my_id - i - 1 + ndev, ndev)
         out_ref[pl.ds(src_dev * chunk, chunk), :] = comm_ref[recv_slot]
+        if flow_control:
+            pltpu.semaphore_signal(
+                ready_sem, inc=1, device_id=left,
+                device_id_type=pltpu.DeviceIdType.LOGICAL,
+            )
         return 0
 
     jax.lax.fori_loop(0, ndev - 1, step, 0)
@@ -185,12 +208,13 @@ def ring_all_gather(x, mesh=None, interpret: bool | None = None):
 
     def gather(v):
         return pl.pallas_call(
-            _ring_all_gather_kernel,
+            partial(_ring_all_gather_kernel, flow_control=not interpret),
             out_shape=jax.ShapeDtypeStruct((rows, cols), x.dtype),
             scratch_shapes=[
                 pltpu.VMEM((2, chunk, cols), x.dtype),
                 pltpu.SemaphoreType.DMA((2,)),
                 pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.REGULAR,
             ],
             interpret=interpret,
             compiler_params=pltpu.CompilerParams(collective_id=0),
@@ -224,12 +248,13 @@ def bench_ring_all_gather(
 
     def gather(v):
         return pl.pallas_call(
-            _ring_all_gather_kernel,
+            partial(_ring_all_gather_kernel, flow_control=not interpret),
             out_shape=jax.ShapeDtypeStruct((rows, COLS), jnp.float32),
             scratch_shapes=[
                 pltpu.VMEM((2, chunk, COLS), jnp.float32),
                 pltpu.SemaphoreType.DMA((2,)),
                 pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.REGULAR,
             ],
             interpret=interpret,
             compiler_params=pltpu.CompilerParams(collective_id=0),

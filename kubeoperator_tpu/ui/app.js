@@ -1,4 +1,6 @@
-/* KO-TPU console logic — vanilla JS against /api/v1 (cookie session). */
+/* KO-TPU console logic — vanilla JS against /api/v1 (cookie session).
+   Tabs: clusters (wizard + day-2 detail), hosts, infra editors, backups,
+   admin (tenancy + inbox), activity. zh/en i18n, no dependencies. */
 "use strict";
 
 const $ = (sel) => document.querySelector(sel);
@@ -15,15 +17,91 @@ const api = async (method, path, body) => {
   if (!resp.ok) throw new Error(data.message || resp.statusText);
   return data;
 };
+const esc = (s) => String(s ?? "").replace(/[&<>"']/g, (c) => ({
+  "&": "&amp;", "<": "&lt;", ">": "&gt;", '"': "&quot;", "'": "&#39;" }[c]));
+
+/* ---------- i18n (upstream parity: zh/en message center) ---------- */
+const I18N = {
+  en: {
+    sign_in: "Sign in", clusters: "Clusters", hosts: "Hosts", infra: "Infra",
+    backups: "Backups", admin: "Admin", activity: "Activity",
+    new_cluster: "＋ New cluster", register_host: "＋ Register host",
+    plans: "Deploy plans", new_plan: "＋ New plan",
+    tpu_catalog: "TPU slice catalog", regions_zones: "Regions & zones",
+    new_region: "＋ Region", new_zone: "＋ Zone",
+    credentials: "SSH credentials", new_credential: "＋ Credential",
+    backup_accounts: "Backup accounts", new_backup_account: "＋ Backup account",
+    projects: "Projects", new_project: "＋ Project", users: "Users",
+    new_user: "＋ User", messages: "Message inbox",
+    create_cluster: "Create cluster", name: "Name", mode: "Mode",
+    mode_plan: "From deploy plan (IaaS / TPU)",
+    mode_manual: "Manual (registered hosts)", plan: "Plan",
+    hosts_csv: "Hosts (comma-separated)", workers: "Workers",
+    k8s_version: "K8s version", create: "Create", cancel: "Cancel",
+    open: "Open", del: "Delete", retry: "Retry", health: "Health",
+    back: "← Back", upgrade: "Upgrade", nodes: "Nodes", components: "Components",
+    install: "Install", uninstall: "Uninstall", etcd_backups: "etcd backups",
+    backup_now: "Backup now", restore: "Restore", security: "Security (CIS)",
+    run_scan: "Run scan", terminal: "Terminal", open_terminal: "Open terminal",
+    send: "Send", live_logs: "Live logs", events: "Events",
+    no_clusters: "No clusters yet — create one.", no_plans: "No plans defined.",
+    no_activity: "No activity yet.", confirm_delete: "Delete cluster",
+    scale_up: "＋ Add nodes", remove: "Remove",
+  },
+  zh: {
+    sign_in: "登录", clusters: "集群", hosts: "主机", infra: "基础设施",
+    backups: "备份", admin: "系统管理", activity: "操作记录",
+    new_cluster: "＋ 创建集群", register_host: "＋ 注册主机",
+    plans: "部署计划", new_plan: "＋ 新建计划",
+    tpu_catalog: "TPU 切片目录", regions_zones: "区域与可用区",
+    new_region: "＋ 区域", new_zone: "＋ 可用区",
+    credentials: "SSH 凭据", new_credential: "＋ 凭据",
+    backup_accounts: "备份账号", new_backup_account: "＋ 备份账号",
+    projects: "项目", new_project: "＋ 项目", users: "用户",
+    new_user: "＋ 用户", messages: "消息中心",
+    create_cluster: "创建集群", name: "名称", mode: "模式",
+    mode_plan: "从部署计划（IaaS / TPU）", mode_manual: "手动（已注册主机）",
+    plan: "计划", hosts_csv: "主机（逗号分隔）", workers: "工作节点",
+    k8s_version: "K8s 版本", create: "创建", cancel: "取消",
+    open: "打开", del: "删除", retry: "重试", health: "健康检查",
+    back: "← 返回", upgrade: "升级", nodes: "节点", components: "组件",
+    install: "安装", uninstall: "卸载", etcd_backups: "etcd 备份",
+    backup_now: "立即备份", restore: "恢复", security: "安全扫描 (CIS)",
+    run_scan: "执行扫描", terminal: "终端", open_terminal: "打开终端",
+    send: "发送", live_logs: "实时日志", events: "事件",
+    no_clusters: "暂无集群 — 创建一个。", no_plans: "暂无部署计划。",
+    no_activity: "暂无操作记录。", confirm_delete: "删除集群",
+    scale_up: "＋ 扩容节点", remove: "移除",
+  },
+};
+let lang = localStorage.getItem("ko-lang") || "en";
+const t = (key) => I18N[lang][key] || I18N.en[key] || key;
+function applyI18n() {
+  document.documentElement.lang = lang === "zh" ? "zh-CN" : "en";
+  document.querySelectorAll("[data-i18n]").forEach((el) => {
+    el.textContent = t(el.dataset.i18n);
+  });
+  $("#lang-toggle").textContent = lang === "zh" ? "EN" : "中文";
+}
+$("#lang-toggle").addEventListener("click", () => {
+  lang = lang === "zh" ? "en" : "zh";
+  localStorage.setItem("ko-lang", lang);
+  applyI18n();
+  // an open detail view renders its own strings — rebuild it too
+  if (currentDetailCluster) openCluster(currentDetailCluster);
+  refreshAll();
+});
 
 /* ---------- auth ---------- */
+let me = null;
 function showLogin() {
   $("#login-view").hidden = false;
   $("#app-view").hidden = true;
 }
 async function boot() {
+  applyI18n();
   try {
-    const me = await api("GET", "/api/v1/auth/whoami");
+    me = await api("GET", "/api/v1/auth/whoami");
     $("#whoami").textContent = me.name + (me.is_admin ? " (admin)" : "");
     $("#login-view").hidden = true;
     $("#app-view").hidden = false;
@@ -42,45 +120,86 @@ $("#login-btn").addEventListener("click", async () => {
 });
 
 /* ---------- tabs ---------- */
+const TABS = ["clusters", "hosts", "infra", "backups", "admin", "events"];
 document.querySelectorAll(".tab").forEach((b) =>
   b.addEventListener("click", () => {
     document.querySelectorAll(".tab").forEach((x) => x.classList.remove("active"));
     b.classList.add("active");
-    ["clusters", "hosts", "plans", "events"].forEach((t) => {
-      $("#tab-" + t).hidden = t !== b.dataset.tab;
-    });
+    TABS.forEach((tab) => { $("#tab-" + tab).hidden = tab !== b.dataset.tab; });
+    refreshAll();
   }));
+
+/* ---------- generic object dialog ---------- */
+function objDialog(titleKey, fields, onSave) {
+  $("#obj-title").textContent = t(titleKey);
+  const box = $("#obj-fields");
+  box.innerHTML = fields.map((f) => {
+    if (f.type === "select") {
+      return `<label>${esc(f.label)} <select id="obj-${f.key}">` +
+        f.options.map((o) => `<option value="${esc(o)}">${esc(o)}</option>`).join("") +
+        `</select></label>`;
+    }
+    return `<label>${esc(f.label)} <input id="obj-${f.key}" ` +
+      `type="${f.type || "text"}" value="${esc(f.value ?? "")}" ` +
+      `placeholder="${esc(f.placeholder ?? "")}"></label>`;
+  }).join("");
+  $("#obj-error").textContent = "";
+  const save = async () => {
+    const out = {};
+    for (const f of fields) {
+      let v = $("#obj-" + f.key).value;
+      if (f.type === "number") v = parseInt(v || "0", 10);
+      if (f.json) {
+        try { v = v ? JSON.parse(v) : {}; }
+        catch (e) {
+          $("#obj-error").textContent = `${f.label}: ${e.message}`;
+          return;
+        }
+      }
+      out[f.key] = v;
+    }
+    try {
+      await onSave(out);
+      $("#obj-dialog").close();
+      refreshAll();
+    } catch (e) { $("#obj-error").textContent = e.message; }
+  };
+  $("#obj-save").onclick = save;
+  $("#obj-cancel").onclick = () => $("#obj-dialog").close();
+  $("#obj-dialog").showModal();
+}
 
 /* ---------- clusters ---------- */
 let logStream = null;
+let termTimer = null;
 async function refreshClusters() {
   if ($("#tab-clusters").hidden || !$("#cluster-detail").hidden) return;
-  const clusters = await api("GET", "/api/v1/clusters");
+  const clusters = await api("GET", "/api/v1/clusters").catch(() => []);
   const list = $("#cluster-list");
   list.innerHTML = "";
   if (!clusters.length) {
-    list.innerHTML = '<div class="muted">No clusters yet — create one.</div>';
+    list.innerHTML = `<div class="muted">${t("no_clusters")}</div>`;
   }
   for (const c of clusters) {
     const card = document.createElement("div");
     card.className = "card";
     const conds = (c.status.conditions || []).map((x) =>
-      `<span class="cond ${x.status}">${x.name}</span>`).join("");
+      `<span class="cond ${x.status}">${esc(x.name)}</span>`).join("");
     const smoke = c.status.smoke_chips
       ? `<div class="smoke">psum ${c.status.smoke_gbps} GB/s · ${c.status.smoke_chips} chips</div>`
       : "";
     card.innerHTML = `
-      <h4>${c.name}</h4>
+      <h4>${esc(c.name)}</h4>
       <div><span class="phase ${c.status.phase}">${c.status.phase}</span>
-        <span class="muted"> · ${c.spec.k8s_version} · ${c.spec.cni}</span></div>
+        <span class="muted"> · ${esc(c.spec.k8s_version)} · ${esc(c.spec.cni)}</span></div>
       <div class="conds">${conds}</div>${smoke}
       <div class="row">
-        <button data-open="${c.name}">Open</button>
-        <button data-del="${c.name}">Delete</button>
+        <button data-open="${esc(c.name)}">${t("open")}</button>
+        <button data-del="${esc(c.name)}">${t("del")}</button>
       </div>`;
     card.querySelector("[data-open]").addEventListener("click", () => openCluster(c.name));
     card.querySelector("[data-del]").addEventListener("click", async () => {
-      if (confirm(`Delete cluster ${c.name}?`)) {
+      if (confirm(`${t("confirm_delete")} ${c.name}?`)) {
         await api("DELETE", `/api/v1/clusters/${c.name}`);
         refreshClusters();
       }
@@ -89,46 +208,101 @@ async function refreshClusters() {
   }
 }
 
+let currentDetailCluster = null;
 async function openCluster(name) {
+  currentDetailCluster = name;
+  // the detail DOM is rebuilt below: stop any poll loop bound to it
+  if (termTimer) { clearInterval(termTimer); termTimer = null; }
   const c = await api("GET", `/api/v1/clusters/${name}`);
   const nodes = await api("GET", `/api/v1/clusters/${name}/nodes`);
   const events = await api("GET", `/api/v1/clusters/${name}/events`);
+  const comps = await api("GET", `/api/v1/clusters/${name}/components`).catch(() => []);
+  const catalog = await api("GET", "/api/v1/components-catalog").catch(() => ({}));
+  const backups = await api("GET", `/api/v1/clusters/${name}/backups`).catch(() => []);
+  const scans = await api("GET", `/api/v1/clusters/${name}/cis-scans`).catch(() => []);
+  const vers = await api("GET", "/api/v1/version");
   const detail = $("#cluster-detail");
   $("#cluster-list").hidden = true;
   detail.hidden = false;
   const conds = (c.status.conditions || []).map((x) =>
-    `<span class="cond ${x.status}" title="${x.message || ""}">${x.name}` +
+    `<span class="cond ${x.status}" title="${esc(x.message || "")}">${esc(x.name)}` +
     (x.finished_at && x.started_at
       ? ` ${(x.finished_at - x.started_at).toFixed(1)}s` : "") +
     `</span>`).join("");
   detail.innerHTML = `
     <div class="detail-head">
-      <h3>${c.name} — <span class="phase ${c.status.phase}">${c.status.phase}</span></h3>
+      <h3>${esc(name)} — <span class="phase ${c.status.phase}">${c.status.phase}</span></h3>
       <div class="row">
-        <button id="d-retry">Retry</button>
-        <button id="d-health">Health</button>
-        <button id="d-back">← Back</button>
+        <button id="d-retry">${t("retry")}</button>
+        <button id="d-health">${t("health")}</button>
+        <button id="d-upgrade">${t("upgrade")}</button>
+        <button id="d-back">${t("back")}</button>
       </div>
     </div>
     <div class="conds">${conds}</div>
     ${c.status.smoke_chips ? `<div class="smoke">smoke: psum ${c.status.smoke_gbps} GB/s over ${c.status.smoke_chips} chips</div>` : ""}
     <div id="d-health-out"></div>
-    <h3>Nodes</h3>
-    <table class="grid"><tr><th>name</th><th>role</th><th>status</th></tr>
-    ${nodes.map((n) => `<tr><td>${n.name}</td><td>${n.role}</td><td>${n.status}</td></tr>`).join("")}
+
+    <h3>${t("nodes")}</h3>
+    <table class="grid"><tr><th>name</th><th>role</th><th>status</th><th></th></tr>
+    ${nodes.map((n) => `<tr><td>${esc(n.name)}</td><td>${n.role}</td><td>${n.status}</td>
+      <td>${n.role === "worker" ? `<button data-rm-node="${esc(n.name)}" class="ghost">${t("remove")}</button>` : ""}</td></tr>`).join("")}
     </table>
-    <h3>Live logs</h3>
+    <div class="row"><button id="d-scale-up">${t("scale_up")}</button></div>
+
+    <h3>${t("components")}</h3>
+    <table class="grid"><tr><th>name</th><th>status</th><th></th></tr>
+    ${comps.map((x) => `<tr><td>${esc(x.name)}</td><td>${x.status}</td>
+      <td><button data-un-comp="${esc(x.name)}" class="ghost">${t("uninstall")}</button></td></tr>`).join("")}
+    </table>
+    <div class="row">
+      <select id="d-comp-select">${Object.keys(catalog).map((k) =>
+        `<option>${esc(k)}</option>`).join("")}</select>
+      <button id="d-comp-install">${t("install")}</button>
+    </div>
+
+    <h3>${t("etcd_backups")}</h3>
+    <table class="grid"><tr><th>file</th><th>created</th><th></th></tr>
+    ${backups.map((f) => `<tr><td>${esc(f.file_name || f.name)}</td>
+      <td>${esc(f.created_at || "")}</td>
+      <td><button data-restore="${esc(f.file_name || f.name)}" class="ghost">${t("restore")}</button></td></tr>`).join("")}
+    </table>
+    <div class="row"><button id="d-backup-now">${t("backup_now")}</button></div>
+
+    <h3>${t("security")}</h3>
+    <table class="grid"><tr><th>scan</th><th>status</th><th>pass</th><th>fail</th><th>warn</th></tr>
+    ${scans.map((s) => `<tr><td>${esc(s.id || s.name)}</td><td>${s.status}</td>
+      <td>${s.passed ?? ""}</td><td>${s.failed ?? ""}</td><td>${s.warned ?? ""}</td></tr>`).join("")}
+    </table>
+    <div class="row"><button id="d-cis-run">${t("run_scan")}</button></div>
+
+    ${me?.is_admin ? `
+    <h3>${t("terminal")}</h3>
+    <div class="row"><button id="d-term-open">${t("open_terminal")}</button></div>
+    <div id="d-term" hidden>
+      <div class="logbox" id="d-term-out"></div>
+      <div class="row">
+        <input id="d-term-in" placeholder="kubectl get nodes">
+        <button id="d-term-send">${t("send")}</button>
+      </div>
+    </div>` : ""}
+
+    <h3>${t("live_logs")}</h3>
     <div class="logbox" id="d-logs"></div>
-    <h3>Events</h3>
+    <h3>${t("events")}</h3>
     <div>${events.map((e) =>
-      `<div class="feed-item ${e.type}"><span class="when">${new Date(e.created_at * 1000).toLocaleTimeString()}</span>[${e.reason}] ${e.message}</div>`
+      `<div class="feed-item ${e.type}"><span class="when">${new Date(e.created_at * 1000).toLocaleTimeString()}</span>[${esc(e.reason)}] ${esc(e.message)}</div>`
     ).join("")}</div>`;
-  $("#d-back").addEventListener("click", () => {
+
+  const closeDetail = () => {
+    currentDetailCluster = null;
     detail.hidden = true;
     $("#cluster-list").hidden = false;
     if (logStream) { logStream.close(); logStream = null; }
+    if (termTimer) { clearInterval(termTimer); termTimer = null; }
     refreshClusters();
-  });
+  };
+  $("#d-back").addEventListener("click", closeDetail);
   $("#d-retry").addEventListener("click", async () => {
     await api("POST", `/api/v1/clusters/${name}/retry`);
     openCluster(name);
@@ -136,8 +310,88 @@ async function openCluster(name) {
   $("#d-health").addEventListener("click", async () => {
     const h = await api("GET", `/api/v1/clusters/${name}/health`);
     $("#d-health-out").innerHTML = '<div class="conds">' + h.probes.map((p) =>
-      `<span class="cond ${p.ok ? "OK" : "Failed"}">${p.name}</span>`).join("") + "</div>";
+      `<span class="cond ${p.ok ? "OK" : "Failed"}">${esc(p.name)}</span>`).join("") + "</div>";
   });
+  $("#d-upgrade").addEventListener("click", () => {
+    objDialog("upgrade", [
+      { key: "version", label: t("k8s_version"), type: "select",
+        options: vers.supported_k8s_versions },
+    ], (out) => api("POST", `/api/v1/clusters/${name}/upgrade`, out)
+        .then(() => openCluster(name)));
+  });
+  $("#d-scale-up").addEventListener("click", () => {
+    objDialog("scale_up", [
+      { key: "hosts", label: t("hosts_csv") },
+    ], (out) => api("POST", `/api/v1/clusters/${name}/nodes`, {
+      hosts: out.hosts.split(",").map((s) => s.trim()).filter(Boolean),
+    }).then(() => openCluster(name)));
+  });
+  detail.querySelectorAll("[data-rm-node]").forEach((b) =>
+    b.addEventListener("click", async () => {
+      await api("DELETE", `/api/v1/clusters/${name}/nodes/${b.dataset.rmNode}`);
+      openCluster(name);
+    }));
+  $("#d-comp-install").addEventListener("click", async () => {
+    await api("POST", `/api/v1/clusters/${name}/components`,
+              { component: $("#d-comp-select").value });
+    openCluster(name);
+  });
+  detail.querySelectorAll("[data-un-comp]").forEach((b) =>
+    b.addEventListener("click", async () => {
+      await api("DELETE", `/api/v1/clusters/${name}/components/${b.dataset.unComp}`);
+      openCluster(name);
+    }));
+  $("#d-backup-now").addEventListener("click", async () => {
+    await api("POST", `/api/v1/clusters/${name}/backup`, {});
+    openCluster(name);
+  });
+  detail.querySelectorAll("[data-restore]").forEach((b) =>
+    b.addEventListener("click", async () => {
+      await api("POST", `/api/v1/clusters/${name}/restore`,
+                { file: b.dataset.restore });
+      openCluster(name);
+    }));
+  $("#d-cis-run").addEventListener("click", async () => {
+    await api("POST", `/api/v1/clusters/${name}/cis-scans`, {});
+    openCluster(name);
+  });
+  if (me?.is_admin) {
+    $("#d-term-open").addEventListener("click", async () => {
+      $("#d-term-open").disabled = true;  // one session per detail view
+      const session = await api("POST", `/api/v1/clusters/${name}/terminal`, {})
+        .catch((e) => { $("#d-term-open").disabled = false; throw e; });
+      $("#d-term").hidden = false;
+      const out = $("#d-term-out");
+      let after = -1;
+      let polling = false;  // overlapping polls would re-fetch the same seq
+      const poll = async () => {
+        if (polling) return;
+        polling = true;
+        try {
+          const r = await api(
+            "GET", `/api/v1/terminal/${session.id}/output?after=${after}`
+          ).catch(() => null);
+          if (!r) return;
+          for (const chunk of r.chunks) {
+            out.textContent += chunk.data;
+            after = chunk.seq;
+          }
+          if (r.chunks.length) out.scrollTop = out.scrollHeight;
+          if (!r.alive && termTimer) { clearInterval(termTimer); termTimer = null; }
+        } finally { polling = false; }
+      };
+      if (termTimer) clearInterval(termTimer);
+      termTimer = setInterval(poll, 1000);
+      const send = async () => {
+        await api("POST", `/api/v1/terminal/${session.id}/input`,
+                  { data: $("#d-term-in").value + "\n" });
+        $("#d-term-in").value = "";
+      };
+      // onclick/onkeydown assignment: reopening can never stack handlers
+      $("#d-term-send").onclick = send;
+      $("#d-term-in").onkeydown = (ev) => { if (ev.key === "Enter") send(); };
+    });
+  }
   // live logs over SSE
   const box = $("#d-logs");
   box.textContent = "";
@@ -157,7 +411,7 @@ $("#new-cluster-btn").addEventListener("click", async () => {
   planCache = await api("GET", "/api/v1/plans");
   const sel = $("#wz-plan");
   sel.innerHTML = planCache.map((p) =>
-    `<option value="${p.name}">${p.name} (${p.provider}${p.accelerator === "tpu" ? " · " + p.tpu_type : ""})</option>`).join("");
+    `<option value="${esc(p.name)}">${esc(p.name)} (${p.provider}${p.accelerator === "tpu" ? " · " + p.tpu_type : ""})</option>`).join("");
   const vers = await api("GET", "/api/v1/version");
   $("#wz-k8s").innerHTML = vers.supported_k8s_versions.map((v) =>
     `<option>${v}</option>`).join("");
@@ -180,7 +434,7 @@ function renderTopology() {
   if (!plan || plan.accelerator !== "tpu") return;
   // visualize the ICI mesh: one square per chip, grid per topology
   api("GET", "/api/v1/plans-tpu-catalog").then((catalog) => {
-    const topo = catalog.find((t) => t.accelerator_type === plan.tpu_type);
+    const topo = catalog.find((x) => x.accelerator_type === plan.tpu_type);
     if (!topo) return;
     const dims = topo.ici_mesh.split("x").map(Number);
     const cols = dims.length >= 2 ? dims[1] * (dims[2] || 1) : dims[0];
@@ -219,28 +473,163 @@ $("#wz-create").addEventListener("click", async () => {
   } catch (e) { $("#wz-error").textContent = e.message; }
 });
 
-/* ---------- hosts / plans / events tabs ---------- */
+/* ---------- infra / hosts / backups / admin editors ---------- */
+$("#register-host-btn").addEventListener("click", () => {
+  objDialog("register_host", [
+    { key: "name", label: t("name") },
+    { key: "ip", label: "IP" },
+    { key: "credential", label: t("credentials") },
+    { key: "port", label: "SSH port", type: "number", value: 22 },
+  ], (out) => api("POST", "/api/v1/hosts/register", out));
+});
+$("#new-plan-btn").addEventListener("click", async () => {
+  const regions = await api("GET", "/api/v1/regions").catch(() => []);
+  const catalog = await api("GET", "/api/v1/plans-tpu-catalog").catch(() => []);
+  objDialog("new_plan", [
+    { key: "name", label: t("name") },
+    { key: "provider", label: "Provider", type: "select",
+      options: ["gcp_tpu_vm", "vsphere", "openstack", "fusioncompute", "bare_metal"] },
+    { key: "region", label: "Region", type: "select",
+      options: regions.map((r) => r.name) },
+    { key: "accelerator", label: "Accelerator", type: "select",
+      options: ["tpu", "none"] },
+    { key: "tpu_type", label: "TPU slice", type: "select",
+      options: catalog.map((x) => x.accelerator_type) },
+    { key: "master_count", label: "Masters", type: "number", value: 1 },
+    { key: "worker_count", label: t("workers"), type: "number", value: 0 },
+  ], async (out) => {
+    const region = regions.find((r) => r.name === out.region);
+    const body = {
+      name: out.name, provider: out.provider,
+      region_id: region ? region.id : "",
+      master_count: out.master_count, worker_count: out.worker_count,
+    };
+    if (out.accelerator === "tpu") {
+      body.accelerator = "tpu";
+      body.tpu_type = out.tpu_type;
+    }
+    await api("POST", "/api/v1/plans", body);
+  });
+});
+$("#new-region-btn").addEventListener("click", () => {
+  objDialog("new_region", [
+    { key: "name", label: t("name") },
+    { key: "provider", label: "Provider", type: "select",
+      options: ["gcp_tpu_vm", "vsphere", "openstack", "fusioncompute"] },
+    { key: "vars", label: "Vars (JSON)", json: true, placeholder: "{\"project\": \"...\"}" },
+  ], (out) => api("POST", "/api/v1/regions", out));
+});
+$("#new-zone-btn").addEventListener("click", async () => {
+  const regions = await api("GET", "/api/v1/regions").catch(() => []);
+  objDialog("new_zone", [
+    { key: "name", label: t("name") },
+    { key: "region", label: "Region", type: "select",
+      options: regions.map((r) => r.name) },
+    { key: "vars", label: "Vars (JSON)", json: true, placeholder: "{\"gcp_zone\": \"...\"}" },
+  ], async (out) => {
+    const region = regions.find((r) => r.name === out.region);
+    await api("POST", "/api/v1/zones", {
+      name: out.name, region_id: region ? region.id : "", vars: out.vars,
+    });
+  });
+});
+$("#new-credential-btn").addEventListener("click", () => {
+  objDialog("new_credential", [
+    { key: "name", label: t("name") },
+    { key: "username", label: "Username", value: "root" },
+    { key: "password", label: "Password", type: "password" },
+    { key: "port", label: "SSH port", type: "number", value: 22 },
+  ], (out) => api("POST", "/api/v1/credentials", out));
+});
+$("#new-backup-account-btn").addEventListener("click", () => {
+  objDialog("new_backup_account", [
+    { key: "name", label: t("name") },
+    { key: "type", label: "Type", type: "select",
+      options: ["s3", "oss", "sftp", "local"] },
+    { key: "bucket", label: "Bucket", },
+    { key: "vars", label: "Vars (JSON)", json: true,
+      placeholder: "{\"endpoint\": \"...\", \"access_key\": \"...\"}" },
+  ], (out) => api("POST", "/api/v1/backup-accounts", out));
+});
+$("#new-project-btn").addEventListener("click", () => {
+  objDialog("new_project", [
+    { key: "name", label: t("name") },
+    { key: "description", label: "Description" },
+  ], (out) => api("POST", "/api/v1/projects", out));
+});
+$("#new-user-btn").addEventListener("click", () => {
+  objDialog("new_user", [
+    { key: "name", label: t("name") },
+    { key: "password", label: "Password", type: "password" },
+    { key: "email", label: "Email" },
+  ], (out) => api("POST", "/api/v1/users", out));
+});
+
+/* ---------- tab refreshers ---------- */
 async function refreshAll() {
   refreshClusters();
-  const hosts = await api("GET", "/api/v1/hosts").catch(() => []);
-  $("#hosts-table").innerHTML =
-    "<tr><th>name</th><th>ip</th><th>status</th><th>TPU</th></tr>" +
-    hosts.map((h) => `<tr><td>${h.name}</td><td>${h.ip}</td><td>${h.status}</td>
-      <td>${h.tpu_chips > 0 ? `${h.tpu_chips} chips · slice ${h.tpu_slice_id} · worker ${h.tpu_worker_id}` : "—"}</td></tr>`).join("");
+  if (!$("#tab-hosts").hidden) {
+    const hosts = await api("GET", "/api/v1/hosts").catch(() => []);
+    $("#hosts-table").innerHTML =
+      "<tr><th>name</th><th>ip</th><th>status</th><th>TPU</th></tr>" +
+      hosts.map((h) => `<tr><td>${esc(h.name)}</td><td>${esc(h.ip)}</td><td>${h.status}</td>
+        <td>${h.tpu_chips > 0 ? `${h.tpu_chips} chips · slice ${h.tpu_slice_id} · worker ${h.tpu_worker_id}` : "—"}</td></tr>`).join("");
+  }
+  if (!$("#tab-infra").hidden) refreshInfra();
+  if (!$("#tab-backups").hidden) {
+    const accounts = await api("GET", "/api/v1/backup-accounts").catch(() => []);
+    $("#backup-account-table").innerHTML =
+      "<tr><th>name</th><th>type</th><th>bucket</th></tr>" +
+      accounts.map((a) => `<tr><td>${esc(a.name)}</td><td>${a.type}</td><td>${esc(a.bucket)}</td></tr>`).join("");
+  }
+  if (!$("#tab-admin").hidden) refreshAdmin();
+  if (!$("#tab-events").hidden) refreshEvents();
+}
 
+async function refreshInfra() {
   const plans = await api("GET", "/api/v1/plans").catch(() => []);
   $("#plan-list").innerHTML = plans.map((p) => `
-    <div class="card"><h4>${p.name}</h4>
+    <div class="card"><h4>${esc(p.name)}</h4>
       <div class="muted">${p.provider} · masters ${p.master_count} · workers ${p.worker_count}</div>
       ${p.accelerator === "tpu" ? `<div class="smoke">${p.tpu_type} · ${p.num_slices} slice(s)</div>` : ""}
-    </div>`).join("") || '<div class="muted">No plans defined.</div>';
+    </div>`).join("") || `<div class="muted">${t("no_plans")}</div>`;
 
   const catalog = await api("GET", "/api/v1/plans-tpu-catalog").catch(() => []);
   $("#tpu-catalog").innerHTML =
     "<tr><th>type</th><th>chips</th><th>hosts</th><th>ICI mesh</th><th>runtime</th></tr>" +
-    catalog.map((t) => `<tr><td>${t.accelerator_type}</td><td>${t.chips}</td>
-      <td>${t.total_hosts}</td><td>${t.ici_mesh}</td><td>${t.runtime_version}</td></tr>`).join("");
+    catalog.map((x) => `<tr><td>${x.accelerator_type}</td><td>${x.chips}</td>
+      <td>${x.total_hosts}</td><td>${x.ici_mesh}</td><td>${x.runtime_version}</td></tr>`).join("");
 
+  const regions = await api("GET", "/api/v1/regions").catch(() => []);
+  const zones = await api("GET", "/api/v1/zones").catch(() => []);
+  $("#region-table").innerHTML =
+    "<tr><th>region</th><th>provider</th><th>zones</th></tr>" +
+    regions.map((r) => `<tr><td>${esc(r.name)}</td><td>${r.provider}</td>
+      <td>${zones.filter((z) => z.region_id === r.id).map((z) => esc(z.name)).join(", ") || "—"}</td></tr>`).join("");
+
+  const creds = await api("GET", "/api/v1/credentials").catch(() => []);
+  $("#credential-table").innerHTML =
+    "<tr><th>name</th><th>username</th><th>port</th></tr>" +
+    creds.map((x) => `<tr><td>${esc(x.name)}</td><td>${esc(x.username)}</td><td>${x.port}</td></tr>`).join("");
+}
+
+async function refreshAdmin() {
+  const projects = await api("GET", "/api/v1/projects").catch(() => []);
+  $("#project-table").innerHTML =
+    "<tr><th>name</th><th>description</th></tr>" +
+    projects.map((p) => `<tr><td>${esc(p.name)}</td><td>${esc(p.description || "")}</td></tr>`).join("");
+  const users = await api("GET", "/api/v1/users").catch(() => []);
+  $("#user-table").innerHTML =
+    "<tr><th>name</th><th>email</th><th>role</th><th>source</th></tr>" +
+    users.map((u) => `<tr><td>${esc(u.name)}</td><td>${esc(u.email || "")}</td>
+      <td>${u.is_admin ? "admin" : "user"}</td><td>${u.source || "local"}</td></tr>`).join("");
+  const msgs = await api("GET", "/api/v1/messages").catch(() => []);
+  $("#message-feed").innerHTML = msgs.map((m) =>
+    `<div class="feed-item ${m.level || ""}"><span class="when">${new Date((m.created_at || 0) * 1000).toLocaleString()}</span>${esc(m.title || m.reason || "")} — ${esc(m.body || m.message || "")}</div>`
+  ).join("") || `<div class="muted">${t("no_activity")}</div>`;
+}
+
+async function refreshEvents() {
   const clusters = await api("GET", "/api/v1/clusters").catch(() => []);
   const feeds = [];
   for (const c of clusters.slice(0, 10)) {
@@ -250,8 +639,8 @@ async function refreshAll() {
   feeds.sort((a, b) => b.created_at - a.created_at);
   $("#event-feed").innerHTML = feeds.map((e) =>
     `<div class="feed-item ${e.type}"><span class="when">${new Date(e.created_at * 1000).toLocaleString()}</span>
-     <b>${e.cluster}</b> [${e.reason}] ${e.message}</div>`).join("") ||
-    '<div class="muted">No activity yet.</div>';
+     <b>${esc(e.cluster)}</b> [${esc(e.reason)}] ${esc(e.message)}</div>`).join("") ||
+    `<div class="muted">${t("no_activity")}</div>`;
 }
 
 boot();

@@ -277,3 +277,18 @@ class TestKoctlTpuDiag:
         assert report["dma_read"]["gbps"] == 3.0
         assert report["ring_all_gather_correct"] is True
         assert report["pallas_ring"]["busbw_gbps"] == 4.0
+
+
+class TestConsoleSurface:
+    def test_components_catalog_and_ui_assets(self, client):
+        base, session, _ = client
+        catalog = session.get(f"{base}/api/v1/components-catalog").json()
+        assert "grafana" in catalog and "tpu-runtime" in catalog
+        assert not any(t in name for name in catalog
+                       for t in ("gpu", "nvidia"))
+        # static console ships with the server (air-gapped, no build step)
+        index = session.get(f"{base}/").text
+        assert "data-i18n" in index
+        app_js = session.get(f"{base}/ui/app.js").text
+        # every endpoint the console calls exists as a registered route
+        assert "components-catalog" in app_js
