@@ -557,6 +557,19 @@ class Handlers:
         events = await run_sync(request, self.s.events.list, cluster.id)
         return json_response([e.to_public_dict() for e in events])
 
+    async def sync_cluster_events(self, request):
+        from kubeoperator_tpu.adm import AdmContext
+
+        def sync():
+            cluster = self.s.clusters.get(request.match_info["name"])
+            inv = AdmContext.for_cluster(self.s.repos, cluster).inventory()
+            return self.s.events.sync_from_cluster(
+                cluster, self.s.executor, inv
+            )
+
+        imported = await run_sync(request, sync)
+        return json_response({"imported": imported})
+
     # ---- infra CRUD ----
     def _crud_routes(self, app, path, service, entity_cls, fields):
         async def list_(request):
@@ -691,6 +704,8 @@ def create_app(services: Services) -> web.Application:
                  cluster_guard(h.uninstall_component, manage))
     r.add_get("/api/v1/clusters/{name}/events",
               cluster_guard(h.cluster_events, view))
+    r.add_post("/api/v1/clusters/{name}/events/sync",
+               cluster_guard(h.sync_cluster_events, manage))
     r.add_post("/api/v1/clusters/{name}/cis-scans",
                cluster_guard(h.run_cis_scan, manage))
     r.add_get("/api/v1/clusters/{name}/cis-scans",
@@ -760,7 +775,6 @@ def create_app(services: Services) -> web.Application:
 def run_server(services: Services, host: str = "127.0.0.1",
                port: int = 8080) -> None:
     services.users.ensure_admin()
-    services.messages.attach_to(services.events)
     services.cron.start()
     app = create_app(services)
     log.info("ko-tpu server listening on http://%s:%d", host, port)

@@ -475,6 +475,10 @@ def build_parser() -> argparse.ArgumentParser:
     install_p.add_argument("--dir", default="/opt/ko-tpu")
     install_p.add_argument("--no-start", action="store_true")
     status_p = sub.add_parser("status", help="platform health")
+    upgrade_p = sub.add_parser("upgrade",
+                               help="re-render + restart the platform bundle")
+    upgrade_p.add_argument("--dir", default="/opt/ko-tpu")
+    upgrade_p.add_argument("--no-start", action="store_true")
     uninstall_p = sub.add_parser("uninstall")
     uninstall_p.add_argument("--dir", default="/opt/ko-tpu")
     uninstall_p.add_argument("--purge", action="store_true")
@@ -505,6 +509,11 @@ def main(argv: list[str] | None = None) -> int:
         info = platform_status(args.server)
         _print(info)
         return 0 if info["healthy"] else 1
+    if args.cmd == "upgrade":
+        from kubeoperator_tpu.installer import upgrade as platform_upgrade
+
+        _print(platform_upgrade(args.dir, start=not args.no_start))
+        return 0
     if args.cmd == "uninstall":
         from kubeoperator_tpu.installer import uninstall
 

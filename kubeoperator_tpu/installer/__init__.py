@@ -14,6 +14,7 @@ from kubeoperator_tpu.installer.install import (
     render_bundle,
     status,
     uninstall,
+    upgrade,
 )
 
-__all__ = ["install", "render_bundle", "status", "uninstall"]
+__all__ = ["install", "render_bundle", "status", "uninstall", "upgrade"]

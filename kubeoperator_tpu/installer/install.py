@@ -130,6 +130,16 @@ def status(server_url: str = "http://127.0.0.1:8080") -> dict:
     return {"server": server_url, "healthy": healthy, "version": __version__}
 
 
+def upgrade(target_dir: str, start: bool = True) -> dict:
+    """Platform self-upgrade (`koctl upgrade` parity, SURVEY.md §1 'CLI'):
+    re-render the compose file + bundle at this package's version — data
+    dir and app.yaml are preserved (render only writes app.yaml when
+    missing) — then restart the stack so new images take effect."""
+    result = install(target_dir, start=start)
+    result["upgraded_to"] = __version__
+    return result
+
+
 def uninstall(target_dir: str, purge_data: bool = False) -> dict:
     compose_path = os.path.join(target_dir, "docker-compose.yml")
     cmd = _compose_cmd()

@@ -32,6 +32,7 @@ COMPONENT_CATALOG: dict[str, dict] = {
         "playbook": "component-rook-ceph.yml",
         "vars": {"ceph_use_all_devices": True, "ceph_mon_count": 3},
     },
+    "istio": {"playbook": "component-istio.yml", "vars": {}},
     "velero": {
         "playbook": "component-velero.yml",
         # velero_* vars resolved from the cluster's BackupAccount at install

@@ -56,6 +56,8 @@ def bundle_manifest() -> dict:
         "images/rook-ceph-operator.tar",
         "images/ceph.tar",
         "images/velero.tar",
+        "images/istiod.tar",
+        "images/istio-proxyv2.tar",
         # TPU path (replaces nvidia-device-plugin / dcgm / nccl-tests images)
         f"images/ko-tpu-device-plugin-v1.0.tar",
         "images/jobset-controller.tar",
@@ -72,7 +74,8 @@ def bundle_manifest() -> dict:
               "charts/loki.tgz", "charts/cilium.tgz",
               "charts/nfs-subdir-external-provisioner.tgz",
               "charts/rook-ceph.tgz", "charts/rook-ceph-cluster.tgz",
-              "charts/velero.tgz"]
+              "charts/velero.tgz", "charts/istio-base.tgz",
+              "charts/istiod.tgz"]
     return {
         "version": __version__,
         "k8s_versions": list(SUPPORTED_K8S_VERSIONS),

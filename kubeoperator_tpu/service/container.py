@@ -46,6 +46,9 @@ class Services:
 
         self.events = EventService(repos)
         self.messages = MessageService(repos)
+        # wired here (not in run_server) so every entry point — server, CLI
+        # local stack, tests — gets event→notification fan-out exactly once
+        self.messages.attach_to(self.events)
         configure_senders(self.messages, repos, config)
         self.credentials = CredentialService(repos)
         self.regions = RegionService(repos)

@@ -61,6 +61,7 @@ class CronService:
         self._thread: threading.Thread | None = None
         self._last_tick: datetime | None = None
         self._health_last = 0.0
+        self._event_sync_last = 0.0
 
     def start(self) -> None:
         if self._thread is not None:
@@ -112,6 +113,25 @@ class CronService:
                     actions.append(f"health:{cluster.name}")
                 except Exception as e:
                     log.warning("health check failed for %s: %s",
+                                cluster.name, e)
+
+        # drift/event monitoring: pull managed clusters' K8s events
+        interval = float(cfg.get("cron.event_sync_interval_s", 300))
+        if interval > 0 and time.time() - self._event_sync_last >= interval:
+            self._event_sync_last = time.time()
+            from kubeoperator_tpu.adm import AdmContext
+
+            for cluster in self.services.repos.clusters.find(phase="Ready"):
+                try:
+                    inv = AdmContext.for_cluster(
+                        self.services.repos, cluster
+                    ).inventory()
+                    n = self.services.events.sync_from_cluster(
+                        cluster, self.services.executor, inv
+                    )
+                    actions.append(f"event-sync:{cluster.name}:{n}")
+                except Exception as e:
+                    log.warning("event sync failed for %s: %s",
                                 cluster.name, e)
         return actions
 

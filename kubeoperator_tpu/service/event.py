@@ -4,6 +4,7 @@ webhook senders pluggable)."""
 
 from __future__ import annotations
 
+import json
 from typing import Callable
 
 from kubeoperator_tpu.models import Event, Message
@@ -11,6 +12,14 @@ from kubeoperator_tpu.repository import Repositories
 from kubeoperator_tpu.utils.logging import get_logger
 
 log = get_logger("service.event")
+
+# drift/event monitoring (SURVEY.md §1): pull the managed cluster's own
+# K8s events into the platform timeline so apiserver-visible drift
+# (evictions, failed scheduling, crash loops) reaches the message center
+KUBECTL_EVENTS_CMD = (
+    "kubectl --kubeconfig /etc/kubernetes/admin.conf get events "
+    "--all-namespaces -o json"
+)
 
 
 class EventService:
@@ -36,6 +45,47 @@ class EventService:
     def list(self, cluster_id: str) -> list[Event]:
         return self.repos.events.find(cluster_id=cluster_id)
 
+    def sync_from_cluster(self, cluster, executor, inventory) -> int:
+        """Import the cluster's K8s events (dedup by reason+message);
+        Warning events ride the normal emit path, so the message center
+        notifies on cluster-side drift exactly like platform warnings."""
+        task_id = executor.run_adhoc(
+            "command", KUBECTL_EVENTS_CMD, inventory, pattern="kube-master"
+        )
+        result = executor.wait(task_id, timeout_s=120)
+        if not result.ok:
+            log.warning("event sync failed for %s: %s",
+                        cluster.name, result.message)
+            return 0
+        payload = "\n".join(executor.watch(task_id))
+        start = payload.find("{")
+        if start < 0:
+            return 0
+        try:
+            # raw_decode: the JSON document is embedded in executor output
+            # (play headers before, host recap after)
+            doc, _ = json.JSONDecoder().raw_decode(payload[start:])
+        except ValueError:
+            return 0
+        existing = {(e.reason, e.message) for e in self.list(cluster.id)}
+        imported = 0
+        for item in doc.get("items", []):
+            obj = item.get("involvedObject", {})
+            reason = f"K8s/{item.get('reason', 'Unknown')}"
+            message = (
+                f"[{obj.get('namespace', '')}/{obj.get('kind', '?')}/"
+                f"{obj.get('name', '?')}] {item.get('message', '')}"
+            )
+            if (reason, message) in existing:
+                continue
+            type_ = "Warning" if item.get("type") == "Warning" else "Normal"
+            self.emit(cluster.id, type_, reason, message)
+            existing.add((reason, message))
+            imported += 1
+        if imported:
+            log.info("synced %d k8s events from %s", imported, cluster.name)
+        return imported
+
 
 class MessageService:
     """In-app notifications; Warning events auto-notify subscribed users."""
@@ -46,7 +96,10 @@ class MessageService:
         self.senders: dict[str, Callable[[Message], None]] = {}
 
     def attach_to(self, events: EventService) -> None:
-        events.subscribe(self._on_event)
+        # idempotent: the container wires this once; a second attach (old
+        # entry points, tests) must not double-deliver notifications
+        if self._on_event not in events._subscribers:
+            events.subscribe(self._on_event)
 
     def _on_event(self, event) -> None:
         if event.type != "Warning":

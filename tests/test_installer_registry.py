@@ -123,3 +123,24 @@ class TestK8sManifests:
         generated = tmp_path / "t" / "bundle" / "manifests"
         assert (generated / "grafana-tpu-dashboards.yaml").exists()
         assert (generated / "tpu-metrics-servicemonitor.yaml").exists()
+
+
+class TestPlatformUpgrade:
+    def test_upgrade_rerenders_and_preserves_config(self, tmp_path,
+                                                    monkeypatch):
+        import importlib
+
+        from kubeoperator_tpu.installer import upgrade
+        install_mod = importlib.import_module(
+            "kubeoperator_tpu.installer.install")
+
+        monkeypatch.setattr(install_mod, "_compose_cmd", lambda: None)
+        target = tmp_path / "platform"
+        install_mod.install(str(target))
+        app_yaml = target / "data" / "config" / "app.yaml"
+        app_yaml.write_text("server: {bind_port: 9999}\n")
+        result = upgrade(str(target))
+        assert result["upgraded_to"]
+        # operator config survives the upgrade re-render
+        assert "9999" in app_yaml.read_text()
+        assert (target / "docker-compose.yml").exists()

@@ -404,3 +404,65 @@ class TestTenancy:
         admin = svc.users.list()[0]
         inbox = svc.messages.inbox(admin.id)
         assert len(inbox) == 1 and "TestReason" in inbox[0].title
+
+
+class TestEventDriftSync:
+    def _k8s_events_payload(self):
+        import json
+        return json.dumps({"items": [
+            {"type": "Warning", "reason": "FailedScheduling",
+             "involvedObject": {"namespace": "default", "kind": "Pod",
+                                "name": "web-0"},
+             "message": "0/3 nodes are available"},
+            {"type": "Normal", "reason": "Pulled",
+             "involvedObject": {"namespace": "kube-system", "kind": "Pod",
+                                "name": "coredns-1"},
+             "message": "Container image pulled"},
+        ]})
+
+    def test_sync_imports_dedups_and_notifies(self, svc):
+        from kubeoperator_tpu.executor.fake import FakeExecutor
+
+        names = register_fleet(svc, 2)
+        svc.clusters.create("drift", spec=ClusterSpec(worker_count=1),
+                            host_names=names, wait=True)
+        cluster = svc.clusters.get("drift")
+        svc.users.create("boss", "secret123", "b@x", True)
+        fake = FakeExecutor()
+        fake.script("adhoc:command",
+                    lines=["PLAY [adhoc]", self._k8s_events_payload()])
+        inv = {"all": {"hosts": {names[0]: {}}},
+               "kube-master": {"hosts": {names[0]: {}}}}
+        imported = svc.events.sync_from_cluster(cluster, fake, inv)
+        assert imported == 2
+        reasons = {e.reason for e in svc.events.list(cluster.id)}
+        assert "K8s/FailedScheduling" in reasons and "K8s/Pulled" in reasons
+        # the Warning rode the emit path -> message center notified admins
+        admin = next(u for u in svc.repos.users.list()
+                     if u.is_admin and u.name == "boss")
+        assert any("FailedScheduling" in m.title
+                   for m in svc.messages.inbox(admin.id))
+        # second sync is a no-op (dedup by reason+message)
+        assert svc.events.sync_from_cluster(cluster, fake, inv) == 0
+
+    def test_sync_tolerates_failure_and_garbage(self, svc):
+        from kubeoperator_tpu.executor.fake import FakeExecutor
+
+        names = register_fleet(svc, 2)
+        svc.clusters.create("drift2", spec=ClusterSpec(worker_count=1),
+                            host_names=names, wait=True)
+        cluster = svc.clusters.get("drift2")
+        inv = {"all": {"hosts": {names[0]: {}}}}
+        failing = FakeExecutor()
+        failing.script("adhoc:command", success=False)
+        assert svc.events.sync_from_cluster(cluster, failing, inv) == 0
+        garbage = FakeExecutor()
+        garbage.script("adhoc:command", lines=["not json at all"])
+        assert svc.events.sync_from_cluster(cluster, garbage, inv) == 0
+
+    def test_istio_component_installs(self, svc):
+        names = register_fleet(svc, 2)
+        svc.clusters.create("mesh", spec=ClusterSpec(worker_count=1),
+                            host_names=names, wait=True)
+        comp = svc.components.install("mesh", "istio")
+        assert comp.status == "Installed"
