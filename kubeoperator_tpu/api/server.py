@@ -557,6 +557,15 @@ class Handlers:
         events = await run_sync(request, self.s.events.list, cluster.id)
         return json_response([e.to_public_dict() for e in events])
 
+    async def cluster_trace(self, request):
+        """Create-to-Ready wall-clock as a native trace (SURVEY.md §5.1:
+        the BASELINE metric is literally a span over the adm phases)."""
+        cluster = await run_sync(request, self.s.clusters.get,
+                                 request.match_info["name"])
+        return json_response(
+            {"cluster": cluster.name, **cluster.status.trace()}
+        )
+
     async def sync_cluster_events(self, request):
         from kubeoperator_tpu.adm import AdmContext
 
@@ -706,6 +715,8 @@ def create_app(services: Services) -> web.Application:
               cluster_guard(h.cluster_events, view))
     r.add_post("/api/v1/clusters/{name}/events/sync",
                cluster_guard(h.sync_cluster_events, manage))
+    r.add_get("/api/v1/clusters/{name}/trace",
+              cluster_guard(h.cluster_trace, view))
     r.add_post("/api/v1/clusters/{name}/cis-scans",
                cluster_guard(h.run_cis_scan, manage))
     r.add_get("/api/v1/clusters/{name}/cis-scans",

@@ -167,6 +167,25 @@ class ClusterStatus:
     def total_duration_s(self) -> float:
         return sum(c.duration_s for c in self.conditions)
 
+    def trace(self) -> dict:
+        """Phase spans as a native trace (SURVEY.md §5.1: the BASELINE
+        create-to-Ready metric is a span over the adm phases)."""
+        spans = [{
+            "name": c.name,
+            "status": c.status,
+            "started_at": c.started_at,
+            "finished_at": c.finished_at,
+            "duration_s": round(c.duration_s, 3) if c.duration_s else None,
+        } for c in sorted(self.conditions, key=lambda c: c.order_index)]
+        started = [s["started_at"] for s in spans if s["started_at"]]
+        finished = [s["finished_at"] for s in spans if s["finished_at"]]
+        return {
+            "phase": self.phase,
+            "total_s": (round(max(finished) - min(started), 3)
+                        if started and finished else None),
+            "spans": spans,
+        }
+
 
 # base.py's Entity dataclass ordering requires defaults; ClusterStatus needs a
 # factory so each cluster owns its own status object.

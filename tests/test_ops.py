@@ -119,3 +119,31 @@ def test_bench_ring_all_gather_reports_busbw():
     assert r.op == "pallas_ring_all_gather"
     assert r.n_devices == 8
     assert r.busbw_gbps == pytest.approx(r.algbw_gbps * 7)
+
+
+def test_multislice_dcn_ici_hierarchy_collectives():
+    """Multislice mesh: leading dcn axis (one entry per slice) + ici axes.
+    psum over ici stays intra-slice; psum over dcn crosses slices — the
+    scaling-book layout this framework's JobSet workloads assume."""
+    import jax.numpy as jnp
+    from functools import partial
+
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from kubeoperator_tpu.parallel.mesh import mesh_for_topology
+
+    topo = parse_accelerator_type("v5e-4", num_slices=2)  # 2 x (2x2) = 8
+    mesh = mesh_for_topology(topo)
+    assert dict(mesh.shape) == {"dcn": 2, "ici_0": 2, "ici_1": 2}
+
+    @jax.jit
+    @partial(shard_map, mesh=mesh,
+             in_specs=P(("dcn", "ici_0", "ici_1")), out_specs=P(),
+             check_rep=False)
+    def hierarchical(x):
+        local = jnp.sum(x)
+        intra = jax.lax.psum(local, ("ici_0", "ici_1"))  # rides ICI
+        return jax.lax.psum(intra, "dcn")                # crosses slices
+    out = float(hierarchical(jnp.ones((8,), jnp.float32)))
+    assert out == 8.0
