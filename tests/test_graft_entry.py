@@ -1,8 +1,13 @@
 """Driver-contract checks: entry() compiles, dryrun_multichip runs on the
-virtual 8-device mesh."""
+virtual 8-device mesh — and stays backend-hermetic (the round-1 driver
+failure: inputs built with jax.random executed on a broken default TPU
+backend; see MULTICHIP_r01.json and VERDICT.md weak#1)."""
 
 import importlib.util
 import os
+import subprocess
+import sys
+import textwrap
 
 import jax
 import numpy as np
@@ -36,3 +41,99 @@ def test_dryrun_multichip_8():
 def test_dryrun_multichip_4():
     mod = _load_graft()
     mod.dryrun_multichip(4)
+
+
+def _run_subprocess(code: str, extra_env: dict | None = None) -> subprocess.CompletedProcess:
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)  # the driver does NOT pin jax_platforms
+    _flag = "--xla_force_host_platform_device_count=8"
+    if _flag not in env.get("XLA_FLAGS", ""):  # append, don't clobber (conftest pattern)
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") + " " + _flag).strip()
+    env.update(extra_env or {})
+    return subprocess.run(
+        [sys.executable, "-c", textwrap.dedent(code)],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=600,
+    )
+
+
+def test_dryrun_subprocess_without_cpu_pin():
+    """Exactly the driver's environment: virtual CPU fleet via XLA_FLAGS, no
+    jax_platforms pin, default backend = whatever the image registers (a real
+    or broken TPU). Round 1 crashed here; must pass now."""
+    proc = _run_subprocess(
+        """
+        import importlib.util
+        spec = importlib.util.spec_from_file_location("ge", "__graft_entry__.py")
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        mod.dryrun_multichip(8)
+        print("DRYRUN-OK")
+        """
+    )
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    assert "DRYRUN-OK" in proc.stdout
+
+
+def test_dryrun_is_backend_hermetic():
+    """Regression guard for MULTICHIP_r01: run dryrun under
+    jax_transfer_guard=disallow, which makes every IMPLICIT host↔device
+    transfer raise — exactly what eager op dispatch on the default backend
+    does with numpy operands (round 1's `jax.random.normal` input build died
+    this way). The hermetic dryrun only ever moves data via explicit
+    device_put/device_get, so it must pass. A canary first proves the guard
+    is actually armed in this jax version."""
+    proc = _run_subprocess(
+        """
+        import numpy as np
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_transfer_guard", "disallow")
+
+        # canary: an eager op on a numpy operand MUST trip the guard,
+        # otherwise this test proves nothing
+        import jax.numpy as jnp
+        try:
+            jnp.asarray(np.ones(3)) * 2.0
+            raise SystemExit("transfer guard inactive: canary op did not raise")
+        except SystemExit:
+            raise
+        except Exception:
+            pass
+
+        import importlib.util
+        spec = importlib.util.spec_from_file_location("ge", "__graft_entry__.py")
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        fn, args = mod.entry()   # input build must not touch any backend
+        mod.dryrun_multichip(8)
+        print("HERMETIC-OK")
+        """
+    )
+    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-4000:])
+    assert "HERMETIC-OK" in proc.stdout
+
+
+def test_dryrun_with_pinned_non_cpu_platforms():
+    """JAX_PLATFORMS pinned to a non-cpu plugin (the image pins 'axon'):
+    exercises _pick_devices' platforms-append branch — the CPU virtual fleet
+    must still be reachable and the dryrun must complete. Skipped when the
+    image's tpu plugin isn't importable (pure-CPU CI)."""
+    import pytest
+
+    proc = _run_subprocess(
+        """
+        import importlib.util
+        spec = importlib.util.spec_from_file_location("ge", "__graft_entry__.py")
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        mod.dryrun_multichip(8)
+        print("PINNED-OK")
+        """,
+        extra_env={"JAX_PLATFORMS": "axon"},
+    )
+    if proc.returncode != 0 and "Unable to initialize backend 'axon'" in (
+        proc.stderr + proc.stdout
+    ):
+        pytest.skip("axon plugin not available in this environment")
+    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-4000:])
+    assert "PINNED-OK" in proc.stdout
