@@ -80,7 +80,7 @@ class SimulationExecutor(Executor):
             return str(module["msg"])
 
     @staticmethod
-    def _when_excluded(task: dict, context: dict) -> bool:
+    def _when_excluded(task: dict, context: dict, warn=None) -> bool:
         """Evaluate `when:` as a real jinja2 expression against the host's
         vars context (extra-vars + inventory_hostname/groups/hostvars), so
         comparisons like `container_runtime == "containerd"` and
@@ -97,10 +97,44 @@ class SimulationExecutor(Executor):
             rendered = _jinja_env().from_string(
                 "{% if " + expr + " %}1{% endif %}"
             ).render(**context)
-        except jinja2.TemplateError:
-            return False  # unparseable condition: run the task (visible) rather
-            # than silently skipping simulated coverage
+        except jinja2.TemplateError as e:
+            # unparseable condition: run the task (visible coverage) but
+            # warn LOUDLY in the stream — a `when:` typo that passes
+            # simulation silently would only explode on real ansible
+            if warn is not None:
+                warn(
+                    f"[WARNING]: unparseable when: {cond!r} on task "
+                    f"{task.get('name', 'unnamed')!r}: {e}; running task"
+                )
+            return False
         return rendered != "1"
+
+    @staticmethod
+    def _materialize_fetch(task: dict, context: dict) -> None:
+        """`ansible.builtin.fetch` pulls a node file back to the platform —
+        the one content side effect the platform itself consumes (the post
+        role's admin.conf → kubeconfig_dest). Materialize it with simulated
+        content so downstream consumers (_finish_ready kubeconfig storage,
+        web terminal) see the real file-flow end-to-end."""
+        module = task.get("ansible.builtin.fetch") or task.get("fetch")
+        if not isinstance(module, dict) or "dest" not in module:
+            return
+        try:
+            dest = _jinja_env().from_string(str(module["dest"])).render(**context)
+            # only absolute file dests: undefined jinja vars render to ""
+            # (ChainableUndefined), which would otherwise drop a stray file
+            # relative to the server CWD
+            if not dest or dest.endswith("/") or not os.path.isabs(dest):
+                return
+            src = str(module.get("src", ""))
+            os.makedirs(os.path.dirname(dest) or ".", exist_ok=True)
+            with open(dest, "w", encoding="utf-8") as f:
+                f.write(
+                    "apiVersion: v1\nkind: Config\n"
+                    f"# simulated fetch of {src}\n"
+                )
+        except (jinja2.TemplateError, OSError):
+            return  # best-effort: the simulated task itself still succeeds
 
     # ---- execution ----
     @staticmethod
@@ -157,9 +191,16 @@ class SimulationExecutor(Executor):
                     }
                     for h in play_hosts
                 }
+                warned: list[str] = []
+
+                def _warn_once(msg: str) -> None:
+                    if msg not in warned:
+                        warned.append(msg)
+                        state.emit(msg)
+
                 active = [
                     h for h in play_hosts
-                    if not self._when_excluded(task, host_ctxs[h])
+                    if not self._when_excluded(task, host_ctxs[h], _warn_once)
                 ]
                 for h in play_hosts:
                     if h not in active:
@@ -184,6 +225,9 @@ class SimulationExecutor(Executor):
                         stats[h].ok += 1
                 if failed:
                     break
+                # side effects only for tasks that succeeded — an injected
+                # fetch failure must not leave the fetched file behind
+                self._materialize_fetch(task, host_ctxs[active[0]])
             if failed:
                 break
         self._finish(state, stats, failed)

@@ -185,10 +185,15 @@ def _ring_all_gather_kernel(local_ref, out_ref, comm_ref, send_sem, recv_sem,
         src_dev = jax.lax.rem(my_id - i - 1 + ndev, ndev)
         out_ref[pl.ds(src_dev * chunk, chunk), :] = comm_ref[recv_slot]
         if flow_control:
-            pltpu.semaphore_signal(
-                ready_sem, inc=1, device_id=left,
-                device_id_type=pltpu.DeviceIdType.LOGICAL,
-            )
+            # the last step's signal has no matching wait (the neighbor's
+            # loop is over) — skip it so ready_sem is drained at kernel
+            # exit, as Mosaic requires of scratch semaphores
+            @pl.when(i < ndev - 2)
+            def _():
+                pltpu.semaphore_signal(
+                    ready_sem, inc=1, device_id=left,
+                    device_id_type=pltpu.DeviceIdType.LOGICAL,
+                )
         return 0
 
     jax.lax.fori_loop(0, ndev - 1, step, 0)

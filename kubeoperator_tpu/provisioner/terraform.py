@@ -10,6 +10,7 @@ script. Control-plane masters ride ordinary GCE instances beside the slice.
 
 from __future__ import annotations
 
+import ipaddress
 import json
 import os
 import shutil
@@ -25,12 +26,52 @@ log = get_logger("provisioner")
 
 TEMPLATES_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "templates")
 
+# Providers that assign VM addresses from the zone's static IP pool (no cloud
+# DHCP/metadata service): the reference's on-prem virtualization path.
+STATIC_IP_PROVIDERS = frozenset({"vsphere", "fusioncompute"})
+
 
 def terraform_available(binary: str = "terraform") -> bool:
     return shutil.which(binary) is not None
 
 
-def build_tfvars(plan: Plan, region: Region, zones: list[Zone]) -> dict:
+def allocate_static_ips(zone: Zone, count: int, in_use: set[str]) -> list[str]:
+    """Pick `count` free addresses from the zone's ip_pool.
+
+    Conflict check: addresses already bound to ANY registered/provisioned
+    Host are excluded, so two clusters sharing a zone can never be handed
+    the same IP. Pool entries must be valid addresses (fail loudly at
+    allocation, not at terraform apply)."""
+    free: list[str] = []
+    seen: set[str] = set()
+    for entry in zone.ip_pool:
+        try:
+            ip = str(ipaddress.ip_address(str(entry)))
+        except ValueError as e:
+            raise ProvisionerError(
+                message=f"zone {zone.name!r} ip_pool entry {entry!r} is not "
+                        f"a valid IP address: {e}"
+            )
+        # dedupe: a pool typo listing the same address twice must not hand
+        # one IP to two nodes
+        if ip not in in_use and ip not in seen:
+            seen.add(ip)
+            free.append(ip)
+    if len(free) < count:
+        raise ProvisionerError(
+            message=(
+                f"zone {zone.name!r} ip_pool exhausted: need {count} free "
+                f"addresses, have {len(free)} (pool size "
+                f"{len(zone.ip_pool)}, in use {len(zone.ip_pool) - len(free)})"
+            )
+        )
+    return free[:count]
+
+
+def build_tfvars(
+    plan: Plan, region: Region, zones: list[Zone],
+    in_use_ips: set[str] | None = None,
+) -> dict:
     """Flatten Plan+Zone+Region into the tfvars contract the templates use."""
     zone = zones[0] if zones else Zone(name="default", region_id=region.id)
     tfvars: dict = {
@@ -39,9 +80,19 @@ def build_tfvars(plan: Plan, region: Region, zones: list[Zone]) -> dict:
         "worker_count": plan.worker_count,
         "region_vars": region.vars,
         "zone_vars": zone.vars,
+        "static_ips_enabled": False,
     }
     tfvars.update({f"region_{k}": v for k, v in region.vars.items()})
     tfvars.update({f"zone_{k}": v for k, v in zone.vars.items()})
+    if plan.provider in STATIC_IP_PROVIDERS and zone.ip_pool:
+        ips = allocate_static_ips(
+            zone, plan.master_count + plan.worker_count, in_use_ips or set()
+        )
+        tfvars.update(
+            static_ips_enabled=True,
+            master_static_ips=ips[: plan.master_count],
+            worker_static_ips=ips[plan.master_count:],
+        )
     tfvars.update(plan.vars)
     if plan.has_tpu():
         topo = plan.topology()
@@ -84,10 +135,13 @@ class TerraformProvisioner:
 
     # ---- rendering ----
     def render(
-        self, cluster_name: str, plan: Plan, region: Region, zones: list[Zone]
+        self, cluster_name: str, plan: Plan, region: Region, zones: list[Zone],
+        in_use_ips: set[str] | None = None,
     ) -> str:
         """Write main.tf + terraform.tfvars.json for this cluster; returns the
-        cluster work dir. Idempotent — re-render before retry/scale."""
+        cluster work dir. Idempotent — re-render before retry/scale.
+        `in_use_ips`: addresses already held by Hosts, excluded from any
+        static-IP-pool allocation."""
         provider = plan.provider
         template_name = f"{provider}/main.tf.j2"
         try:
@@ -96,7 +150,7 @@ class TerraformProvisioner:
             raise ProvisionerError(
                 message=f"no terraform template for provider {provider!r}"
             )
-        tfvars = build_tfvars(plan, region, zones)
+        tfvars = build_tfvars(plan, region, zones, in_use_ips=in_use_ips)
         tfvars["cluster_name"] = cluster_name
         cluster_dir = os.path.join(self.work_dir, cluster_name)
         os.makedirs(cluster_dir, exist_ok=True)
@@ -232,6 +286,14 @@ class FakeProvisioner(TerraformProvisioner):
             os.path.join(cluster_dir, "terraform.tfvars.json"), encoding="utf-8"
         ) as f:
             tfvars = json.load(f)
+        if tfvars.get("static_ips_enabled"):
+            # static-IP providers report exactly the addresses they were
+            # given — so the fake faithfully exercises the pool-allocation
+            # flow down to Host rows
+            return {
+                "master_ips": tfvars["master_static_ips"],
+                "worker_ips": tfvars["worker_static_ips"],
+            }
         octet = 10
         outputs: dict = {
             "master_ips": [

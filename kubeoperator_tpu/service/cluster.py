@@ -57,6 +57,12 @@ class ClusterService:
         self.adm = ClusterAdm(executor)
         self._ops: dict[str, threading.Thread] = {}
         self._ops_lock = threading.Lock()
+        # static-IP pool reservations: addresses allocated at render time but
+        # not yet persisted on Host rows. Concurrent creates in one zone each
+        # hold _ip_lock across snapshot+render+reserve, so two provisions can
+        # never be handed the same pool address (TOCTOU guard).
+        self._reserved_ips: set[str] = set()
+        self._ip_lock = threading.Lock()
         # chaos/test hook: merged into every phase's extra-vars (e.g.
         # {"__fail_at_task__": "install etcd"} for simulated failure drills)
         self.debug_extra_vars: dict = {}
@@ -230,32 +236,78 @@ class ClusterService:
         self.repos.clusters.save(cluster)
         region = self.repos.regions.get(plan.region_id)
         zones = [self.repos.zones.get(z) for z in plan.zone_ids]
-        cluster_dir = self.provisioner.render(cluster.name, plan, region, zones)
-        self.provisioner.apply(cluster_dir)
-        outputs = self.provisioner.outputs(cluster_dir)
-        cred_id = ""
-        if plan.vars.get("credential_name"):
-            cred_id = self.repos.credentials.get_by_name(
-                plan.vars["credential_name"]
-            ).id
-        hosts = self.provisioner.hosts_from_outputs(
-            outputs, plan, cluster.name, credential_id=cred_id
-        )
-        for host in hosts:
-            host.cluster_id = cluster.id
-            self.repos.hosts.save(host)
-            role = NodeRole.MASTER if "-master-" in host.name else NodeRole.WORKER
-            self.repos.nodes.save(Node(
-                name=host.name, cluster_id=cluster.id, host_id=host.id,
-                role=role.value,
-            ))
+        # Static-IP pool conflict check: every address any Host already
+        # holds (manual or provisioned, any cluster) is off the table, as is
+        # any address a CONCURRENT provision has reserved but not yet saved.
+        # snapshot + render + reserve happen under one lock hold (render is
+        # local jinja, fast); terraform apply runs outside the lock.
+        with self._ip_lock:
+            in_use = {h.ip for h in self.repos.hosts.list() if h.ip}
+            in_use |= self._reserved_ips
+            cluster_dir = self.provisioner.render(
+                cluster.name, plan, region, zones, in_use_ips=in_use
+            )
+            allocated = self._rendered_static_ips(cluster_dir)
+            self._reserved_ips |= allocated
+        try:
+            self.provisioner.apply(cluster_dir)
+            outputs = self.provisioner.outputs(cluster_dir)
+            cred_id = ""
+            if plan.vars.get("credential_name"):
+                cred_id = self.repos.credentials.get_by_name(
+                    plan.vars["credential_name"]
+                ).id
+            hosts = self.provisioner.hosts_from_outputs(
+                outputs, plan, cluster.name, credential_id=cred_id
+            )
+            for host in hosts:
+                host.cluster_id = cluster.id
+                self.repos.hosts.save(host)
+                role = NodeRole.MASTER if "-master-" in host.name else NodeRole.WORKER
+                self.repos.nodes.save(Node(
+                    name=host.name, cluster_id=cluster.id, host_id=host.id,
+                    role=role.value,
+                ))
+        finally:
+            # saved hosts now carry the IPs (or the provision failed and the
+            # addresses are free again) — either way the reservation is done
+            with self._ip_lock:
+                self._reserved_ips -= allocated
         self.events.emit(
             cluster.id, "Normal", "Provisioned",
             f"{len(hosts)} machines provisioned via {plan.provider}",
         )
 
+    @staticmethod
+    def _rendered_static_ips(cluster_dir: str) -> set[str]:
+        """The pool addresses render() just allocated (empty for DHCP/cloud
+        plans) — read back from the tfvars contract file."""
+        import json
+
+        try:
+            with open(
+                os.path.join(cluster_dir, "terraform.tfvars.json"),
+                encoding="utf-8",
+            ) as f:
+                tfvars = json.load(f)
+        except (OSError, ValueError):
+            return set()
+        if not tfvars.get("static_ips_enabled"):
+            return set()
+        return set(tfvars.get("master_static_ips") or []) | set(
+            tfvars.get("worker_static_ips") or []
+        )
+
     def _context(self, cluster: Cluster, plan: Plan | None = None) -> AdmContext:
         extra: dict = {}
+        # content contract: the post role fetches admin.conf to
+        # `{{ kubeconfig_dest }}{{ cluster_name }}.conf`; point it at the
+        # SAME configured dir _finish_ready reads, so a non-default install
+        # still stores kubeconfig (round-1 bug: the path was hardcoded twice)
+        kc_dir = self.config.get(
+            "cluster.kubeconfig_dir", "/var/ko-tpu/kubeconfigs"
+        )
+        extra["kubeconfig_dest"] = kc_dir.rstrip("/") + "/"
         if isinstance(self.executor, SimulationExecutor) and (
             cluster.spec.tpu_enabled and plan is not None and plan.has_tpu()
         ):
@@ -302,7 +354,8 @@ class ClusterService:
 
     def _finish_ready(self, cluster: Cluster) -> None:
         kc_path = os.path.join(
-            "/var/ko-tpu/kubeconfigs", f"{cluster.name}.conf"
+            self.config.get("cluster.kubeconfig_dir", "/var/ko-tpu/kubeconfigs"),
+            f"{cluster.name}.conf",
         )
         if os.path.exists(kc_path):
             with open(kc_path, encoding="utf-8") as f:

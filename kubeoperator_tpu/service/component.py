@@ -24,14 +24,18 @@ from kubeoperator_tpu.utils.errors import (
 _INERT_VALUE_RE = re.compile(r"[A-Za-z0-9._:/@+=-]*")
 
 
-def _check_vars_inert(vars: dict, origin: str) -> None:
+def _check_vars_inert(vars: dict, origin: str, redact: bool = False) -> None:
+    """`redact=True` for secret-origin vars (backup-account keys): the error
+    must name only the offending key, never echo the value into API
+    responses or logs."""
     for key, value in vars.items():
         if isinstance(value, (bool, int, float)) or value is None:
             continue
         if not isinstance(value, str) or not _INERT_VALUE_RE.fullmatch(value):
+            shown = "<redacted>" if redact else repr(value)
             raise ValidationError(
                 f"{origin} var {key!r} has a non-argument-inert value"
-                f" {value!r}"
+                f" {shown}"
             )
 
 
@@ -76,7 +80,7 @@ class ComponentService:
             )
         component.validate()
         _check_vars_inert(component.vars, component_name)
-        _check_vars_inert(secret_vars, f"{component_name} account")
+        _check_vars_inert(secret_vars, f"{component_name} account", redact=True)
         for required in COMPONENT_CATALOG.get(component_name, {}).get(
             "required", ()
         ):

@@ -121,13 +121,18 @@ class CronService:
             self._event_sync_last = time.time()
             from kubeoperator_tpu.adm import AdmContext
 
+            # short per-cluster wait: the cron thread is shared with health
+            # checks and backups, so one unreachable master may cost at most
+            # event_sync_timeout_s, not the interactive 120s default
+            sync_timeout = float(cfg.get("cron.event_sync_timeout_s", 30))
             for cluster in self.services.repos.clusters.find(phase="Ready"):
                 try:
                     inv = AdmContext.for_cluster(
                         self.services.repos, cluster
                     ).inventory()
                     n = self.services.events.sync_from_cluster(
-                        cluster, self.services.executor, inv
+                        cluster, self.services.executor, inv,
+                        timeout_s=sync_timeout,
                     )
                     actions.append(f"event-sync:{cluster.name}:{n}")
                 except Exception as e:

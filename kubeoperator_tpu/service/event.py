@@ -45,14 +45,21 @@ class EventService:
     def list(self, cluster_id: str) -> list[Event]:
         return self.repos.events.find(cluster_id=cluster_id)
 
-    def sync_from_cluster(self, cluster, executor, inventory) -> int:
-        """Import the cluster's K8s events (dedup by reason+message);
-        Warning events ride the normal emit path, so the message center
-        notifies on cluster-side drift exactly like platform warnings."""
+    # dedup horizon: a warning that recurs after being quiet this long is a
+    # NEW incident and must re-notify (permanent (reason, message) dedup
+    # would suppress e.g. the same FailedScheduling message weeks later)
+    DEDUP_WINDOW_S = 6 * 3600.0
+
+    def sync_from_cluster(self, cluster, executor, inventory,
+                          timeout_s: float = 120.0) -> int:
+        """Import the cluster's K8s events (dedup by reason+message against
+        the last DEDUP_WINDOW_S only); Warning events ride the normal emit
+        path, so the message center notifies on cluster-side drift exactly
+        like platform warnings."""
         task_id = executor.run_adhoc(
             "command", KUBECTL_EVENTS_CMD, inventory, pattern="kube-master"
         )
-        result = executor.wait(task_id, timeout_s=120)
+        result = executor.wait(task_id, timeout_s=timeout_s)
         if not result.ok:
             log.warning("event sync failed for %s: %s",
                         cluster.name, result.message)
@@ -67,7 +74,14 @@ class EventService:
             doc, _ = json.JSONDecoder().raw_decode(payload[start:])
         except ValueError:
             return 0
-        existing = {(e.reason, e.message) for e in self.list(cluster.id)}
+        import time as _time
+
+        horizon = _time.time() - self.DEDUP_WINDOW_S
+        existing = {
+            (e.reason, e.message)
+            for e in self.list(cluster.id)
+            if e.created_at >= horizon
+        }
         imported = 0
         for item in doc.get("items", []):
             obj = item.get("involvedObject", {})

@@ -51,6 +51,16 @@ DEFAULTS: dict[str, Any] = {
     "cron": {
         "backup_enabled": True,
         "health_check_interval_s": 300,
+        "event_sync_interval_s": 300,
+        # per-cluster wait inside the shared cron thread — deliberately
+        # shorter than the interactive 120s so one unreachable master
+        # cannot stall the whole tick
+        "event_sync_timeout_s": 30,
+    },
+    "cluster": {
+        # where deploy playbooks drop fetched admin kubeconfigs; the
+        # installer bind-mounts {data_dir}/kubeconfigs here
+        "kubeconfig_dir": "/var/ko-tpu/kubeconfigs",
     },
     "logging": {
         "level": "INFO",

@@ -176,6 +176,52 @@ class TestSimulationWhenEval:
         # on the first host only and (like ansible) doesn't mark others skipped
         assert worker.ok == 1 and worker.skipped == 2
 
+    def test_unparseable_when_warns_loudly(self, tmp_path):
+        """A `when:` typo must not pass simulation silently: the task runs
+        (visible coverage) but a WARNING line lands in the task stream."""
+        (tmp_path / "playbooks").mkdir()
+        (tmp_path / "playbooks" / "bad.yml").write_text(textwrap.dedent("""\
+            - name: bad when play
+              hosts: all
+              tasks:
+                - name: typo guard
+                  when: container_runtime ==== "containerd"
+        """))
+        ex = SimulationExecutor(project_dir=str(tmp_path))
+        inv = build_inventory(*make_fleet(n_masters=1, n_workers=0))
+        tid = ex.run_playbook("bad.yml", inv, {})
+        lines = list(ex.watch(tid))
+        res = ex.result(tid)
+        assert res.ok
+        assert res.host_stats["n0"].ok == 1  # ran, not skipped
+        warnings = [l for l in lines if "unparseable when" in l]
+        assert len(warnings) == 1 and "typo guard" in warnings[0]
+
+    def test_fetch_task_materializes_dest(self, tmp_path):
+        """ansible.builtin.fetch writes the dest file on the platform side —
+        the kubeconfig flow the post role and _finish_ready rely on."""
+        (tmp_path / "playbooks").mkdir()
+        (tmp_path / "playbooks" / "f.yml").write_text(textwrap.dedent("""\
+            - name: fetch play
+              hosts: kube-master
+              tasks:
+                - name: fetch kubeconfig to platform
+                  run_once: true
+                  ansible.builtin.fetch:
+                    src: /etc/kubernetes/admin.conf
+                    flat: yes
+                    dest: "{{ kubeconfig_dest }}{{ cluster_name }}.conf"
+        """))
+        ex = SimulationExecutor(project_dir=str(tmp_path))
+        inv = build_inventory(*make_fleet(n_masters=1, n_workers=0))
+        dest_dir = tmp_path / "kc"
+        res = ex.wait(ex.run_playbook("f.yml", inv, {
+            "kubeconfig_dest": str(dest_dir) + "/", "cluster_name": "c1",
+        }))
+        assert res.ok
+        content = (dest_dir / "c1.conf").read_text()
+        assert "kind: Config" in content and "admin.conf" in content
+
     def test_limit_restricts_hosts(self, cmp_project):
         ex = SimulationExecutor(project_dir=cmp_project)
         nodes, hosts, creds = make_fleet(n_masters=1, n_workers=2)

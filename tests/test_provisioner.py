@@ -105,6 +105,82 @@ class TestRendering:
             prov.render("c", plan, region, [])
 
 
+class TestStaticIpPool:
+    """Zone.ip_pool → static-IP VM provisioning for the on-prem providers
+    (reference zone IP-pool mechanism, SURVEY.md §2.2)."""
+
+    def _setup(self, provider="vsphere", pool=None, masters=1, workers=2):
+        region = Region(name=f"r-{provider}", provider=provider, vars={})
+        zone = Zone(name="z1", region_id=region.id,
+                    vars={"gateway": "10.1.0.1", "netmask_prefix": 24},
+                    ip_pool=pool if pool is not None else
+                    [f"10.1.0.{i}" for i in range(10, 20)])
+        plan = Plan(name=f"p-{provider}", provider=provider,
+                    region_id=region.id, zone_ids=[zone.id],
+                    master_count=masters, worker_count=workers)
+        return plan, region, zone
+
+    def test_allocator_skips_in_use_and_orders(self):
+        from kubeoperator_tpu.provisioner.terraform import allocate_static_ips
+        plan, region, zone = self._setup()
+        ips = allocate_static_ips(zone, 3, in_use={"10.1.0.10", "10.1.0.12"})
+        assert ips == ["10.1.0.11", "10.1.0.13", "10.1.0.14"]
+
+    def test_allocator_rejects_bad_entry(self):
+        from kubeoperator_tpu.provisioner.terraform import allocate_static_ips
+        _, _, zone = self._setup(pool=["10.1.0.10", "not-an-ip"])
+        with pytest.raises(ProvisionerError, match="not-an-ip"):
+            allocate_static_ips(zone, 1, in_use=set())
+
+    def test_allocator_dedupes_pool_typos(self):
+        from kubeoperator_tpu.provisioner.terraform import allocate_static_ips
+        _, _, zone = self._setup(
+            pool=["10.1.0.10", "10.1.0.10", "10.1.0.11"]
+        )
+        assert allocate_static_ips(zone, 2, in_use=set()) == [
+            "10.1.0.10", "10.1.0.11"
+        ]
+
+    def test_allocator_pool_exhaustion(self):
+        from kubeoperator_tpu.provisioner.terraform import allocate_static_ips
+        _, _, zone = self._setup(pool=["10.1.0.10", "10.1.0.11"])
+        with pytest.raises(ProvisionerError, match="exhausted"):
+            allocate_static_ips(zone, 3, in_use=set())
+
+    @pytest.mark.parametrize("provider,ip_marker", [
+        ("vsphere", "ipv4_address = local.master_static_ips[count.index]"),
+        ("fusioncompute", "ip      = local.master_static_ips[count.index]"),
+    ])
+    def test_rendered_template_customizes_ips(self, tmp_path, provider,
+                                              ip_marker):
+        plan, region, zone = self._setup(provider)
+        prov = TerraformProvisioner(work_dir=str(tmp_path))
+        d = prov.render(f"st-{provider}", plan, region, [zone])
+        tf = open(os.path.join(d, "main.tf")).read()
+        assert '"10.1.0.10"' in tf  # allocated pool address in locals
+        assert ip_marker in tf
+        tfvars = json.load(open(os.path.join(d, "terraform.tfvars.json")))
+        assert tfvars["static_ips_enabled"] is True
+        assert tfvars["master_static_ips"] == ["10.1.0.10"]
+        assert tfvars["worker_static_ips"] == ["10.1.0.11", "10.1.0.12"]
+
+    def test_empty_pool_falls_back_to_dhcp(self, tmp_path):
+        plan, region, zone = self._setup(pool=[])
+        prov = TerraformProvisioner(work_dir=str(tmp_path))
+        d = prov.render("dhcp", plan, region, [zone])
+        tf = open(os.path.join(d, "main.tf")).read()
+        assert "customize" not in tf and "static_ips" not in tf
+
+    def test_in_use_ips_excluded_at_render(self, tmp_path):
+        plan, region, zone = self._setup()
+        prov = FakeProvisioner(work_dir=str(tmp_path))
+        d = prov.render("c2", plan, region, [zone],
+                        in_use_ips={"10.1.0.10", "10.1.0.11"})
+        outputs = prov.outputs(d)
+        assert outputs["master_ips"] == ["10.1.0.12"]
+        assert outputs["worker_ips"] == ["10.1.0.13", "10.1.0.14"]
+
+
 class TestOutputsToHosts:
     def test_tpu_endpoints_become_tpu_hosts(self, gcp_setup, tmp_path):
         plan, region, zone = gcp_setup

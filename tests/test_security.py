@@ -32,6 +32,7 @@ def svc(tmp_path):
             "executor": {"backend": "simulation"},
             "provisioner": {"work_dir": str(tmp_path / "tf")},
             "cron": {"health_check_interval_s": 0},
+            "cluster": {"kubeconfig_dir": str(tmp_path / "kubeconfigs")},
         },
     )
     services = build_services(config, simulate=True)

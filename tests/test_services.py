@@ -29,6 +29,7 @@ def svc(tmp_path):
             "executor": {"backend": "simulation"},
             "provisioner": {"work_dir": str(tmp_path / "tf")},
             "cron": {"health_check_interval_s": 0},
+            "cluster": {"kubeconfig_dir": str(tmp_path / "kubeconfigs")},
         },
     )
     services = build_services(config, simulate=True)
@@ -84,6 +85,9 @@ class TestManualCreate:
         # task logs streamed + persisted
         logs = svc.repos.task_logs.find(cluster_id=cluster.id)
         assert len(logs) > 20
+        # kubeconfig flowed content→platform: the post role fetched
+        # admin.conf into the CONFIGURED dir and _finish_ready stored it
+        assert "kind: Config" in cluster.kubeconfig
 
     def test_duplicate_name_rejected(self, svc):
         names = register_fleet(svc, 3)
@@ -129,6 +133,82 @@ class TestPlanTpuCreate:
         assert sorted(h.tpu_worker_id for h in tpu_hosts) == [0, 1, 2, 3]
         conds = [c.name for c in cluster.status.conditions]
         assert conds[-2:] == ["tpu-runtime", "tpu-smoke-test"]
+
+    def test_static_ip_pool_cluster_create(self, svc):
+        """vSphere plan with a zone ip_pool: provisioned Hosts get POOL
+        addresses, and a second cluster in the same zone never reuses them
+        (the reference's zone IP-pool mechanism, SURVEY §2.2)."""
+        region = svc.regions.create(Region(
+            name="dc1", provider="vsphere", vars={"vcenter_host": "vc.local"},
+        ))
+        zone = svc.zones.create(Zone(
+            name="pool-zone", region_id=region.id,
+            vars={"gateway": "10.9.0.1"},
+            ip_pool=[f"10.9.0.{i}" for i in range(10, 16)],  # 6 addresses
+        ))
+        plan = svc.plans.create(Plan(
+            name="vs-ha", provider="vsphere", region_id=region.id,
+            zone_ids=[zone.id], master_count=1, worker_count=2,
+        ))
+        svc.clusters.create("vs1", provision_mode="plan", plan_name="vs-ha",
+                            wait=True)
+        c1 = svc.clusters.get("vs1")
+        assert c1.status.phase == "Ready"
+        ips1 = {h.ip for h in svc.repos.hosts.find(cluster_id=c1.id)}
+        assert ips1 == {"10.9.0.10", "10.9.0.11", "10.9.0.12"}
+        # second cluster: allocator must skip the three in-use addresses
+        svc.clusters.create("vs2", provision_mode="plan", plan_name="vs-ha",
+                            wait=True)
+        c2 = svc.clusters.get("vs2")
+        ips2 = {h.ip for h in svc.repos.hosts.find(cluster_id=c2.id)}
+        assert ips2 == {"10.9.0.13", "10.9.0.14", "10.9.0.15"}
+        # third cluster: pool is exhausted -> create fails loudly
+        with pytest.raises(Exception, match="exhausted"):
+            svc.clusters.create("vs3", provision_mode="plan",
+                                plan_name="vs-ha", wait=True)
+
+    def test_concurrent_static_creates_get_disjoint_ips(self, svc):
+        """Two async creates racing in one zone: the reservation lock must
+        hand them disjoint pool addresses (TOCTOU guard — both snapshots
+        happen before either saves Host rows)."""
+        import time as _time
+
+        region = svc.regions.create(Region(
+            name="dc2", provider="vsphere", vars={},
+        ))
+        zone = svc.zones.create(Zone(
+            name="race-zone", region_id=region.id,
+            ip_pool=[f"10.8.0.{i}" for i in range(10, 16)],
+        ))
+        svc.plans.create(Plan(
+            name="vs-race", provider="vsphere", region_id=region.id,
+            zone_ids=[zone.id], master_count=1, worker_count=2,
+        ))
+        # slow down terraform apply so both provisions overlap between
+        # render (allocation) and host save
+        orig_apply = svc.provisioner.apply
+
+        def slow_apply(cluster_dir):
+            _time.sleep(0.3)
+            orig_apply(cluster_dir)
+
+        svc.provisioner.apply = slow_apply
+        try:
+            svc.clusters.create("ra", provision_mode="plan",
+                                plan_name="vs-race", wait=False)
+            svc.clusters.create("rb", provision_mode="plan",
+                                plan_name="vs-race", wait=False)
+            ca = svc.clusters.wait_for("ra", timeout_s=60)
+            cb = svc.clusters.wait_for("rb", timeout_s=60)
+        finally:
+            svc.provisioner.apply = orig_apply
+        assert ca.status.phase == "Ready" and cb.status.phase == "Ready"
+        ips_a = {h.ip for h in svc.repos.hosts.find(cluster_id=ca.id)}
+        ips_b = {h.ip for h in svc.repos.hosts.find(cluster_id=cb.id)}
+        assert len(ips_a) == 3 and len(ips_b) == 3
+        assert not (ips_a & ips_b), f"IP conflict: {ips_a & ips_b}"
+        # all reservations released once hosts persisted
+        assert svc.clusters._reserved_ips == set()
 
     def test_delete_plan_cluster_destroys_and_unbinds(self, svc):
         make_tpu_plan(svc)
@@ -444,6 +524,28 @@ class TestEventDriftSync:
                    for m in svc.messages.inbox(admin.id))
         # second sync is a no-op (dedup by reason+message)
         assert svc.events.sync_from_cluster(cluster, fake, inv) == 0
+
+    def test_recurring_warning_renotifies_after_dedup_window(self, svc):
+        """A warning that recurs after DEDUP_WINDOW_S of quiet is a new
+        incident: it must be re-imported, not permanently suppressed."""
+        from kubeoperator_tpu.executor.fake import FakeExecutor
+
+        names = register_fleet(svc, 2)
+        svc.clusters.create("drift3", spec=ClusterSpec(worker_count=1),
+                            host_names=names, wait=True)
+        cluster = svc.clusters.get("drift3")
+        fake = FakeExecutor()
+        fake.script("adhoc:command",
+                    lines=["PLAY [adhoc]", self._k8s_events_payload()])
+        inv = {"all": {"hosts": {names[0]: {}}},
+               "kube-master": {"hosts": {names[0]: {}}}}
+        assert svc.events.sync_from_cluster(cluster, fake, inv) == 2
+        assert svc.events.sync_from_cluster(cluster, fake, inv) == 0
+        # age every imported event past the dedup horizon
+        for e in svc.events.list(cluster.id):
+            e.created_at -= svc.events.DEDUP_WINDOW_S + 1
+            svc.repos.events.save(e)
+        assert svc.events.sync_from_cluster(cluster, fake, inv) == 2
 
     def test_sync_tolerates_failure_and_garbage(self, svc):
         from kubeoperator_tpu.executor.fake import FakeExecutor
