@@ -9,6 +9,7 @@ phase; a failed operation re-enters at the first non-OK condition
 from kubeoperator_tpu.adm.engine import AdmContext, ClusterAdm, Phase
 from kubeoperator_tpu.adm.phases import (
     backup_phases,
+    cert_renew_phases,
     create_phases,
     reset_phases,
     restore_phases,
@@ -20,5 +21,5 @@ from kubeoperator_tpu.adm.phases import (
 __all__ = [
     "AdmContext", "ClusterAdm", "Phase",
     "create_phases", "upgrade_phases", "scale_up_phases", "scale_down_phases",
-    "backup_phases", "restore_phases", "reset_phases",
+    "backup_phases", "restore_phases", "reset_phases", "cert_renew_phases",
 ]

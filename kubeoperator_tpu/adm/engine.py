@@ -23,6 +23,15 @@ from kubeoperator_tpu.utils.logging import get_logger
 log = get_logger("adm")
 
 
+def platform_vars_from_config(config) -> dict:
+    """Derive the content-facing platform vars from process config."""
+    url = str(config.get("registry.url", "http://127.0.0.1:8081"))
+    # image references need a bare host:port (scheme-less); apt/yum/pip
+    # repos need the full URL — content templates use whichever fits.
+    host = url.split("://", 1)[-1].rstrip("/")
+    return {"registry_url": url.rstrip("/"), "registry_host": host}
+
+
 @dataclass(frozen=True)
 class Phase:
     """One ordered step of an operation."""
@@ -170,10 +179,19 @@ class ClusterAdm:
         ctx.save_cluster(cluster)
 
         try:
+            # executor-scoped platform vars (tier 1 → tier 3, SURVEY.md §5.6):
+            # the service container stamps the configured offline-registry
+            # address onto its executor, so every phase in that stack renders
+            # content against the right registry — lowest precedence, and
+            # scoped per Services instance (no process-global state).
+            extra_vars = {
+                **getattr(self.executor, "platform_vars", {}),
+                **ctx.build_extra_vars(),
+            }
             task_id = self.executor.run_playbook(
                 phase.playbook,
                 ctx.inventory(),
-                ctx.build_extra_vars(),
+                extra_vars,
                 tags=list(phase.tags),
                 limit="new-workers" if phase.limit_new_nodes else "",
             )

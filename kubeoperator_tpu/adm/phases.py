@@ -80,6 +80,7 @@ def create_phases() -> list[Phase]:
     return [
         Phase("base", "01-base.yml"),
         Phase("runtime", "02-runtime.yml"),
+        Phase("pki", "03-pki.yml"),
         Phase("etcd", "05-etcd.yml"),
         Phase("lb", "06-lb.yml",
               enabled=lambda ctx: ctx.cluster.spec.lb_mode == "internal"),
@@ -131,6 +132,13 @@ def restore_phases() -> list[Phase]:
         Phase("restore-etcd", "41-restore-etcd.yml"),
         Phase("restore-verify", "42-restore-verify.yml"),
     ]
+
+
+def cert_renew_phases() -> list[Phase]:
+    """Day-2 PKI rotation (content playbook 24; pairs with the pki create
+    phase). Re-fetches the rotated admin kubeconfig, so callers must refresh
+    the stored cluster kubeconfig afterwards."""
+    return [Phase("renew-certs", "24-renew-certs.yml")]
 
 
 def reset_phases() -> list[Phase]:

@@ -259,6 +259,11 @@ class Handlers:
                                  request.match_info["name"], False)
         return json_response(cluster.to_public_dict(), status=202)
 
+    async def renew_certs(self, request):
+        cluster = await run_sync(request, self.s.clusters.renew_certs,
+                                 request.match_info["name"], False)
+        return json_response(cluster.to_public_dict(), status=202)
+
     async def cluster_kubeconfig(self, request):
         cluster = await run_sync(request, self.s.clusters.get,
                                  request.match_info["name"])
@@ -687,6 +692,8 @@ def create_app(services: Services) -> web.Application:
                  cluster_guard(h.scale_down, manage))
     r.add_post("/api/v1/clusters/{name}/upgrade",
                cluster_guard(h.upgrade, manage))
+    r.add_post("/api/v1/clusters/{name}/renew-certs",
+               cluster_guard(h.renew_certs, manage))
     r.add_post("/api/v1/clusters/{name}/backup",
                cluster_guard(h.run_backup, manage))
     r.add_get("/api/v1/clusters/{name}/backups",

@@ -132,6 +132,8 @@ class LocalClient:
                 return {"ok": True}
             case ("POST", ["clusters", name, "upgrade"]):
                 return pub(s.upgrades.upgrade(name, body["version"]))
+            case ("POST", ["clusters", name, "renew-certs"]):
+                return pub(s.clusters.renew_certs(name, wait=False))
             case ("POST", ["clusters", name, "backup"]):
                 return pub(s.backups.run_backup(name, body.get("account", "")))
             case ("GET", ["clusters", name, "backups"]):
@@ -303,6 +305,10 @@ def cmd_cluster(client, args) -> int:
         _print(client.call("POST", f"/api/v1/clusters/{args.name}/upgrade",
                            {"version": args.version}))
         return 0
+    if args.cluster_cmd == "renew-certs":
+        _print(client.call("POST",
+                           f"/api/v1/clusters/{args.name}/renew-certs"))
+        return 0
     if args.cluster_cmd == "backup":
         _print(client.call("POST", f"/api/v1/clusters/{args.name}/backup",
                            {"account": args.account or ""}))
@@ -433,7 +439,8 @@ def build_parser() -> argparse.ArgumentParser:
     create.add_argument("--no-wait", action="store_true")
     create.add_argument("--quiet", action="store_true")
     create.add_argument("--timeout", type=float, default=3600.0)
-    for name in ("status", "delete", "logs", "events", "health"):
+    for name in ("status", "delete", "logs", "events", "health",
+                 "renew-certs"):
         sp = csub.add_parser(name)
         sp.add_argument("name")
     retry = csub.add_parser("retry")

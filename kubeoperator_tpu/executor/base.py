@@ -108,6 +108,9 @@ class Executor(abc.ABC):
         self._order: list[str] = []
         self._max_retained = max_retained
         self._lock = threading.Lock()
+        # lowest-precedence extra-vars stamped by the owning service stack
+        # (offline registry address); merged into every phase run by ClusterAdm
+        self.platform_vars: dict = {}
 
     # ---- public contract (kobe parity) ----
     def run(self, spec: TaskSpec) -> str:

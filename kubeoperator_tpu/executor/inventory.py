@@ -79,6 +79,13 @@ def build_inventory(
 
 
 def inventory_host_names(inventory: dict, group: str = "all") -> list[str]:
+    """Resolve a host pattern to names. Supports the ansible union pattern
+    `a:b` (hosts in either group), which playbooks like 03-pki.yml use."""
+    if ":" in group:
+        names: set[str] = set()
+        for part in group.split(":"):
+            names.update(inventory_host_names(inventory, part))
+        return sorted(names)
     if group == "all":
         return sorted(inventory.get("all", {}).get("hosts", {}).keys())
     children = inventory.get("all", {}).get("children", {})

@@ -120,10 +120,14 @@ class SimulationExecutor(Executor):
         if not isinstance(module, dict) or "dest" not in module:
             return
         try:
-            dest = _jinja_env().from_string(str(module["dest"])).render(**context)
-            # only absolute file dests: undefined jinja vars render to ""
-            # (ChainableUndefined), which would otherwise drop a stray file
-            # relative to the server CWD
+            # StrictUndefined: a dest the simulation can't fully resolve
+            # (loop `item`, registered vars) must be skipped, not written to
+            # a half-rendered path
+            dest = jinja2.Environment(
+                undefined=jinja2.StrictUndefined
+            ).from_string(str(module["dest"])).render(**context)
+            # only materialize absolute file dests (dir-shaped or relative
+            # dests are not the platform-consumed kubeconfig contract)
             if not dest or dest.endswith("/") or not os.path.isabs(dest):
                 return
             src = str(module.get("src", ""))
@@ -133,7 +137,7 @@ class SimulationExecutor(Executor):
                     "apiVersion: v1\nkind: Config\n"
                     f"# simulated fetch of {src}\n"
                 )
-        except (jinja2.TemplateError, OSError):
+        except (jinja2.TemplateError, jinja2.UndefinedError, OSError):
             return  # best-effort: the simulated task itself still succeeds
 
     # ---- execution ----
@@ -188,6 +192,11 @@ class SimulationExecutor(Executor):
                         **base_ctx,
                         **base_ctx["hostvars"].get(h, {}),
                         "inventory_hostname": h,
+                        # real-ansible magic var: groups this host belongs to
+                        "group_names": sorted(
+                            g for g, members in base_ctx["groups"].items()
+                            if g != "all" and h in members
+                        ),
                     }
                     for h in play_hosts
                 }

@@ -13,7 +13,13 @@ import os
 import threading
 import time
 
-from kubeoperator_tpu.adm import AdmContext, ClusterAdm, create_phases, reset_phases
+from kubeoperator_tpu.adm import (
+    AdmContext,
+    ClusterAdm,
+    cert_renew_phases,
+    create_phases,
+    reset_phases,
+)
 from kubeoperator_tpu.executor import Executor, SimulationExecutor
 from kubeoperator_tpu.models import (
     Cluster,
@@ -141,6 +147,37 @@ class ClusterService:
         cluster = self.get(name)
         plan = self.repos.plans.get(cluster.plan_id) if cluster.plan_id else None
         return self._launch(cluster, plan, wait)
+
+    def renew_certs(self, name: str, wait: bool = False) -> Cluster:
+        """Day-2 PKI rotation (content playbook 24): rotate every
+        kubeadm-managed control-plane cert, masters serially. The rotation
+        replaces admin.conf, so the stored kubeconfig is refreshed from the
+        re-fetched copy afterwards."""
+        cluster = self.get(name)
+        if cluster.status.phase != ClusterPhaseStatus.READY.value:
+            raise ValidationError("cert renewal requires a Ready cluster")
+        plan = self.repos.plans.get(cluster.plan_id) if cluster.plan_id else None
+
+        def work():
+            try:
+                ctx = self._context(cluster, plan)
+                self.adm.run(ctx, cert_renew_phases())
+                self._store_kubeconfig(cluster)
+                self.repos.clusters.save(cluster)
+                self.events.emit(cluster.id, "Normal", "CertsRenewed",
+                                 f"cluster {name} control-plane certs rotated")
+            except PhaseError as e:
+                self.events.emit(cluster.id, "Warning", "CertRenewFailed",
+                                 f"phase {e.phase}: {e.message}")
+                if wait:
+                    raise
+            except Exception as e:
+                self.events.emit(cluster.id, "Warning", "CertRenewFailed", str(e))
+                if wait:
+                    raise
+
+        self._spawn(cluster.id, work, wait)
+        return self.repos.clusters.get(cluster.id)
 
     def delete(self, name: str, wait: bool = False) -> None:
         cluster = self.get(name)
@@ -308,6 +345,9 @@ class ClusterService:
             "cluster.kubeconfig_dir", "/var/ko-tpu/kubeconfigs"
         )
         extra["kubeconfig_dest"] = kc_dir.rstrip("/") + "/"
+        # pki role's platform-side cert cache (fetch dest + copy src)
+        pki_dir = self.config.get("cluster.pki_dir", "/var/ko-tpu/pki")
+        extra["pki_cache_dest"] = pki_dir.rstrip("/") + "/"
         if isinstance(self.executor, SimulationExecutor) and (
             cluster.spec.tpu_enabled and plan is not None and plan.has_tpu()
         ):
@@ -352,7 +392,10 @@ class ClusterService:
         self._spawn(cluster.id, work, wait)
         return self.repos.clusters.get(cluster.id)
 
-    def _finish_ready(self, cluster: Cluster) -> None:
+    def _store_kubeconfig(self, cluster: Cluster) -> None:
+        """Refresh cluster.kubeconfig from the fetched admin.conf — the ONE
+        place the platform-side kubeconfig path is derived (round-1 bug:
+        it was hardcoded in multiple places)."""
         kc_path = os.path.join(
             self.config.get("cluster.kubeconfig_dir", "/var/ko-tpu/kubeconfigs"),
             f"{cluster.name}.conf",
@@ -360,6 +403,9 @@ class ClusterService:
         if os.path.exists(kc_path):
             with open(kc_path, encoding="utf-8") as f:
                 cluster.kubeconfig = f.read()
+
+    def _finish_ready(self, cluster: Cluster) -> None:
+        self._store_kubeconfig(cluster)
         cluster.status.phase = ClusterPhaseStatus.READY.value
         cluster.status.message = ""
         self.repos.clusters.save(cluster)

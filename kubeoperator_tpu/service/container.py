@@ -42,6 +42,13 @@ class Services:
         self.executor = executor
         self.provisioner = provisioner
 
+        from kubeoperator_tpu.adm.engine import platform_vars_from_config
+
+        # tier-1 process config → tier-3 vars contract: every phase run
+        # through this stack's executor sees the configured offline-registry
+        # address (scoped to the executor, not process-global)
+        executor.platform_vars = platform_vars_from_config(config)
+
         from kubeoperator_tpu.service.notify import configure_senders
 
         self.events = EventService(repos)

@@ -61,6 +61,8 @@ DEFAULTS: dict[str, Any] = {
         # where deploy playbooks drop fetched admin kubeconfigs; the
         # installer bind-mounts {data_dir}/kubeconfigs here
         "kubeconfig_dir": "/var/ko-tpu/kubeconfigs",
+        # platform-side cache for cluster CA material (pki role fetch dest)
+        "pki_dir": "/var/ko-tpu/pki",
     },
     "logging": {
         "level": "INFO",

@@ -26,7 +26,7 @@ def make_ctx(tpu=False, **spec_kw) -> AdmContext:
 
 
 CPU_CREATE_ORDER = [
-    "01-base.yml", "02-runtime.yml", "05-etcd.yml", "06-lb.yml",
+    "01-base.yml", "02-runtime.yml", "03-pki.yml", "05-etcd.yml", "06-lb.yml",
     "07-kube-master.yml", "08-kube-worker.yml", "09-network.yml", "10-post.yml",
 ]
 

@@ -88,6 +88,108 @@ def test_no_gpu_package_anywhere_in_content():
     assert not hits, f"GPU artifacts found in content: {hits}"
 
 
+def walk_content_files(suffixes=(".yml", ".j2")):
+    for root, _, files in os.walk(CONTENT):
+        for fname in files:
+            if fname.endswith(suffixes):
+                yield os.path.join(root, fname)
+
+
+def test_every_image_reference_is_registry_sourced():
+    """Air-gap invariant (SURVEY.md §1 offline registry): every container
+    image reference anywhere in content must resolve through the platform
+    registry vars — a hardcoded public image would break offline installs."""
+    bad = []
+    for path in walk_content_files():
+        with open(path, encoding="utf-8") as f:
+            for i, line in enumerate(f, 1):
+                s = line.strip()
+                if s.startswith("#"):
+                    continue
+                if s.startswith("image:") or " image:" in f" {s}":
+                    if "registry_host" not in s and "registry_url" not in s:
+                        bad.append(f"{path}:{i}: {s}")
+    assert not bad, f"unsourced image references: {bad}"
+
+
+def test_every_pip_and_download_is_registry_sourced():
+    """pip installs must use the offline index; get_url/downloads must pull
+    from the registry, never the internet."""
+    bad = []
+    for path in walk_content_files((".yml",)):
+        with open(path, encoding="utf-8") as f:
+            tasks = yaml.safe_load(f)
+        if not isinstance(tasks, list):
+            continue
+        for t in tasks:
+            if not isinstance(t, dict):
+                continue
+            pip = t.get("ansible.builtin.pip") or t.get("pip")
+            if isinstance(pip, dict):
+                extra = str(pip.get("extra_args", ""))
+                if "registry_url" not in extra:
+                    bad.append(f"{path}: pip task {t.get('name')!r} "
+                               "does not use the offline index")
+            gu = t.get("ansible.builtin.get_url") or t.get("get_url")
+            if isinstance(gu, dict) and "registry_url" not in str(gu.get("url", "")):
+                bad.append(f"{path}: get_url task {t.get('name')!r} "
+                           "does not pull from the registry")
+    assert not bad, "\n".join(bad)
+
+
+def test_helm_installs_use_bundled_charts_only():
+    """Component charts ship in the platform bundle (/opt/ko-charts); a
+    `helm repo add <internet>` or chart-by-URL would break air-gap."""
+    bad = []
+    for path in walk_content_files((".yml",)):
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+        for i, line in enumerate(text.splitlines(), 1):
+            s = line.strip()
+            if s.startswith("#") or "helm" not in s:
+                continue
+            if "helm repo add" in s or ("helm" in s and "https://" in s):
+                bad.append(f"{path}:{i}: {s}")
+            if "helm upgrade" in s and "/opt/ko-charts" not in s:
+                bad.append(f"{path}:{i}: chart not from bundled /opt/ko-charts")
+    assert not bad, "\n".join(bad)
+
+
+def test_pinned_kube_installs_cover_both_distro_families():
+    """Multi-distro invariant: every role that installs version-pinned
+    kubeadm/kubelet must carry both the Debian (apt pin syntax, apt-mark
+    hold) and RedHat (dnf name-version, versionlock) branches."""
+    roles_with_kube_install = []
+    for role in sorted(os.listdir(ROLES)):
+        path = os.path.join(ROLES, role, "tasks", "main.yml")
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+        if "kubeadm=" in text or "kubeadm-{{" in text:
+            roles_with_kube_install.append((role, text))
+    assert roles_with_kube_install, "no roles install pinned kube packages?"
+    for role, text in roles_with_kube_install:
+        assert "kubeadm={{" in text, f"{role}: missing Debian pin syntax"
+        assert "kubeadm-{{" in text, f"{role}: missing RedHat pin syntax"
+        assert "'Debian'" in text and "'RedHat'" in text, (
+            f"{role}: pinned install not conditioned on both distro families"
+        )
+
+
+def test_base_role_configures_both_package_mirrors():
+    with open(os.path.join(ROLES, "base", "tasks", "main.yml")) as f:
+        text = f.read()
+    assert "registry-mirror.repo.j2" in text          # apt (Debian)
+    assert "registry-mirror.yum.repo.j2" in text      # yum/dnf (RedHat)
+    for tpl in ("registry-mirror.repo.j2", "registry-mirror.yum.repo.j2"):
+        with open(os.path.join(ROLES, "base", "templates", tpl)) as f:
+            assert "registry_url" in f.read(), f"{tpl} not registry-sourced"
+
+
+def test_pki_phase_runs_before_etcd_and_masters():
+    names = [p.name for p in create_phases()]
+    assert names.index("pki") < names.index("etcd") < names.index("kube-master")
+
+
 def tpu_ctx(sim_gbps=85.0):
     spec = ClusterSpec(tpu_enabled=True, jobset_enabled=False)
     cluster = Cluster(name="tpu-demo", spec=spec)

@@ -89,6 +89,32 @@ class TestManualCreate:
         # admin.conf into the CONFIGURED dir and _finish_ready stored it
         assert "kind: Config" in cluster.kubeconfig
 
+    def test_renew_certs_rotates_and_restores_kubeconfig(self, svc):
+        """Day-2 PKI rotation: the renew-certs phase runs on a Ready
+        cluster, re-fetches the rotated admin.conf, and the stored
+        kubeconfig is refreshed; a non-Ready cluster is rejected."""
+        names = register_fleet(svc, 3)
+        svc.clusters.create("pki-demo", spec=ClusterSpec(worker_count=2),
+                            host_names=names, wait=True)
+        cluster = svc.clusters.get("pki-demo")
+        cluster.kubeconfig = "stale"
+        svc.repos.clusters.save(cluster)
+        svc.clusters.renew_certs("pki-demo", wait=True)
+        cluster = svc.clusters.get("pki-demo")
+        assert cluster.status.condition("renew-certs").status == "OK"
+        assert "kind: Config" in cluster.kubeconfig  # refreshed, not stale
+        events = [e.reason for e in svc.events.list(cluster.id)]
+        assert "CertsRenewed" in events
+
+    def test_renew_certs_requires_ready_cluster(self, svc):
+        names = register_fleet(svc, 3)
+        svc.clusters.debug_extra_vars = {"__fail_at_task__": "start etcd"}
+        with pytest.raises(PhaseError):
+            svc.clusters.create("pki-bad", spec=ClusterSpec(worker_count=2),
+                                host_names=names, wait=True)
+        with pytest.raises(ValidationError):
+            svc.clusters.renew_certs("pki-bad", wait=True)
+
     def test_duplicate_name_rejected(self, svc):
         names = register_fleet(svc, 3)
         svc.clusters.create("dup", spec=ClusterSpec(worker_count=2),
