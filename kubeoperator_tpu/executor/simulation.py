@@ -13,6 +13,7 @@ task fail on every host — used by resume/retry tests and chaos demos.
 
 from __future__ import annotations
 
+import functools
 import os
 import time
 
@@ -20,8 +21,14 @@ import jinja2
 import yaml
 
 
+@functools.lru_cache(maxsize=None)
 def _jinja_env() -> "jinja2.Environment":
     return jinja2.Environment(undefined=jinja2.ChainableUndefined)
+
+
+@functools.lru_cache(maxsize=None)
+def _strict_jinja_env() -> "jinja2.Environment":
+    return jinja2.Environment(undefined=jinja2.StrictUndefined)
 
 from kubeoperator_tpu.executor.base import (
     Executor,
@@ -123,9 +130,9 @@ class SimulationExecutor(Executor):
             # StrictUndefined: a dest the simulation can't fully resolve
             # (loop `item`, registered vars) must be skipped, not written to
             # a half-rendered path
-            dest = jinja2.Environment(
-                undefined=jinja2.StrictUndefined
-            ).from_string(str(module["dest"])).render(**context)
+            dest = _strict_jinja_env().from_string(
+                str(module["dest"])
+            ).render(**context)
             # only materialize absolute file dests (dir-shaped or relative
             # dests are not the platform-consumed kubeconfig contract)
             if not dest or dest.endswith("/") or not os.path.isabs(dest):
@@ -137,7 +144,7 @@ class SimulationExecutor(Executor):
                     "apiVersion: v1\nkind: Config\n"
                     f"# simulated fetch of {src}\n"
                 )
-        except (jinja2.TemplateError, jinja2.UndefinedError, OSError):
+        except (jinja2.TemplateError, OSError):
             return  # best-effort: the simulated task itself still succeeds
 
     # ---- execution ----
