@@ -21,15 +21,6 @@ import jinja2
 import yaml
 
 
-@functools.lru_cache(maxsize=None)
-def _jinja_env() -> "jinja2.Environment":
-    return jinja2.Environment(undefined=jinja2.ChainableUndefined)
-
-
-@functools.lru_cache(maxsize=None)
-def _strict_jinja_env() -> "jinja2.Environment":
-    return jinja2.Environment(undefined=jinja2.StrictUndefined)
-
 from kubeoperator_tpu.executor.base import (
     Executor,
     HostStats,
@@ -39,6 +30,16 @@ from kubeoperator_tpu.executor.base import (
 )
 from kubeoperator_tpu.executor.inventory import inventory_host_names
 from kubeoperator_tpu.utils.errors import ExecutorError
+
+
+@functools.lru_cache(maxsize=None)
+def _jinja_env() -> "jinja2.Environment":
+    return jinja2.Environment(undefined=jinja2.ChainableUndefined)
+
+
+@functools.lru_cache(maxsize=None)
+def _strict_jinja_env() -> "jinja2.Environment":
+    return jinja2.Environment(undefined=jinja2.StrictUndefined)
 
 DEFAULT_PROJECT_DIR = os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "content"

@@ -18,6 +18,7 @@ from kubeoperator_tpu.parallel.topology import GENERATIONS
 
 # every file roles reference under /opt/ko-manifests/, ours or third-party
 BUNDLED_MANIFESTS = (
+    "calico-crds.yaml",
     "metrics-server.yaml",
     "ingress-nginx.yaml",
     "traefik.yaml",

@@ -1,0 +1,282 @@
+"""Structural HCL parser for validating rendered Terraform templates.
+
+SURVEY.md §4 calls for "`terraform plan`-only golden tests" of the provider
+templates; the build image has no terraform binary (zero egress), so this
+module supplies the syntax gate those tests need: a real tokenizer (strings
+with `${...}` interpolation, heredocs, comments, numbers, identifiers) and a
+block/attribute grammar parser. It rejects exactly the class of template
+regressions that would otherwise ship green — unclosed blocks and strings,
+unbalanced delimiters, attributes without values, stray tokens — and returns
+the block tree so tests can make golden structural assertions (e.g. the GCP
+plan contains a `resource "google_tpu_v2_vm"` with an `accelerator_config`).
+
+It is NOT a full HCL2 expression evaluator: expression internals are
+delimiter-checked, not grammar-checked.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+
+_IDENT = re.compile(r"[A-Za-z_][A-Za-z0-9_-]*")
+_NUMBER = re.compile(r"-?\d+(\.\d+)?([eE][+-]?\d+)?")
+
+
+class HclError(ValueError):
+    def __init__(self, message: str, line: int) -> None:
+        super().__init__(f"line {line}: {message}")
+        self.line = line
+
+
+@dataclass
+class Block:
+    type: str
+    labels: tuple[str, ...]
+    attrs: dict = field(default_factory=dict)      # name -> raw expr text
+    blocks: list["Block"] = field(default_factory=list)
+
+    def find(self, type: str, *labels: str) -> list["Block"]:
+        """All nested blocks (any depth) matching type and label prefix."""
+        out = []
+        for b in self.blocks:
+            if b.type == type and b.labels[: len(labels)] == labels:
+                out.append(b)
+            out.extend(b.find(type, *labels))
+        return out
+
+
+@dataclass(frozen=True)
+class _Tok:
+    kind: str   # ident | string | number | punct | newline | heredoc
+    text: str
+    line: int
+
+
+def _scan_string(src: str, i: int, line: int) -> tuple[int, int]:
+    """Scan from opening quote; return (index past closing quote, line).
+    Handles escapes and arbitrarily nested ${ ... } interpolation (which may
+    itself contain strings)."""
+    assert src[i] == '"'
+    i += 1
+    while i < len(src):
+        c = src[i]
+        if c == "\\":
+            i += 2
+            continue
+        if c == "\n":
+            raise HclError("newline in string literal", line)
+        if c == '"':
+            return i + 1, line
+        if c == "$" and src[i : i + 2] == "${":
+            depth = 1
+            i += 2
+            while i < len(src) and depth:
+                if src[i] == "\\":
+                    i += 2
+                    continue
+                if src[i] == '"':
+                    i, line = _scan_string(src, i, line)
+                    continue
+                if src[i] == "{":
+                    depth += 1
+                elif src[i] == "}":
+                    depth -= 1
+                elif src[i] == "\n":
+                    line += 1
+                i += 1
+            if depth:
+                raise HclError("unterminated ${ interpolation", line)
+            continue
+        i += 1
+    raise HclError("unterminated string literal", line)
+
+
+def _tokenize(src: str) -> list[_Tok]:
+    toks: list[_Tok] = []
+    i, line = 0, 1
+    n = len(src)
+    while i < n:
+        c = src[i]
+        if c == "\n":
+            toks.append(_Tok("newline", "\n", line))
+            line += 1
+            i += 1
+            continue
+        if c in " \t\r":
+            i += 1
+            continue
+        if c == "#" or src[i : i + 2] == "//":
+            while i < n and src[i] != "\n":
+                i += 1
+            continue
+        if src[i : i + 2] == "/*":
+            end = src.find("*/", i + 2)
+            if end < 0:
+                raise HclError("unterminated /* comment", line)
+            line += src.count("\n", i, end)
+            i = end + 2
+            continue
+        if src[i : i + 2] == "<<":
+            m = re.match(r"<<-?([A-Za-z_][A-Za-z0-9_]*)\r?\n", src[i:])
+            if not m:
+                raise HclError("malformed heredoc introducer", line)
+            marker = m.group(1)
+            body_start = i + m.end()
+            endm = re.search(
+                rf"^\s*{re.escape(marker)}\s*$", src[body_start:], re.M
+            )
+            if not endm:
+                raise HclError(f"unterminated heredoc <<{marker}", line)
+            end = body_start + endm.end()
+            toks.append(_Tok("heredoc", src[i:end], line))
+            line += src.count("\n", i, end)
+            i = end
+            continue
+        if c == '"':
+            j, line2 = _scan_string(src, i, line)
+            toks.append(_Tok("string", src[i:j], line))
+            line = line2
+            i = j
+            continue
+        m = _NUMBER.match(src, i)
+        if m and (c.isdigit() or (c == "-" and i + 1 < n and src[i + 1].isdigit())):
+            toks.append(_Tok("number", m.group(0), line))
+            i = m.end()
+            continue
+        m = _IDENT.match(src, i)
+        if m:
+            toks.append(_Tok("ident", m.group(0), line))
+            i = m.end()
+            continue
+        for punct in ("=>", ">=", "<=", "==", "!=", "&&", "||", "..."):
+            if src.startswith(punct, i):
+                toks.append(_Tok("punct", punct, line))
+                i += len(punct)
+                break
+        else:
+            if c in "{}[]()=,.:?*%+-/<>!":
+                toks.append(_Tok("punct", c, line))
+                i += 1
+            else:
+                raise HclError(f"unexpected character {c!r}", line)
+    return toks
+
+
+_OPEN = {"{": "}", "[": "]", "(": ")"}
+
+
+class _Parser:
+    def __init__(self, toks: list[_Tok]) -> None:
+        self.toks = toks
+        self.i = 0
+
+    def _peek(self, skip_nl: bool = True) -> _Tok | None:
+        j = self.i
+        while j < len(self.toks):
+            t = self.toks[j]
+            if t.kind == "newline" and skip_nl:
+                j += 1
+                continue
+            return t
+        return None
+
+    def _next(self, skip_nl: bool = True) -> _Tok | None:
+        while self.i < len(self.toks):
+            t = self.toks[self.i]
+            self.i += 1
+            if t.kind == "newline" and skip_nl:
+                continue
+            return t
+        return None
+
+    def parse_body(self, root: Block, outer_line: int, closed_by: str | None) -> None:
+        while True:
+            t = self._peek()
+            if t is None:
+                if closed_by:
+                    raise HclError(
+                        f"unclosed block (expected {closed_by!r})", outer_line
+                    )
+                return
+            if closed_by and t.kind == "punct" and t.text == closed_by:
+                self._next()
+                return
+            if t.kind != "ident":
+                raise HclError(
+                    f"expected attribute or block name, got {t.text!r}", t.line
+                )
+            self._next()
+            name = t.text
+            labels: list[str] = []
+            while True:
+                nxt = self._peek()
+                # block labels: resource "type" "name" { ... } — quoted
+                # (modern) or bare-ident (legacy); ident-follows-ident only
+                # ever occurs in label position, `=` separates attributes
+                if nxt is not None and nxt.kind in ("string", "ident"):
+                    labels.append(self._next().text.strip('"'))
+                else:
+                    break
+            nxt = self._peek()
+            if nxt is None:
+                raise HclError(f"dangling {name!r}", t.line)
+            if nxt.kind == "punct" and nxt.text == "{":
+                self._next()
+                child = Block(type=name, labels=tuple(labels))
+                self.parse_body(child, nxt.line, "}")
+                root.blocks.append(child)
+            elif nxt.kind == "punct" and nxt.text == "=" and not labels:
+                self._next()
+                root.attrs[name] = self._parse_expr(nxt.line)
+            else:
+                raise HclError(
+                    f"expected '{{' or '=' after {name!r}, got {nxt.text!r}",
+                    nxt.line,
+                )
+
+    def _parse_expr(self, line: int) -> str:
+        """Consume one expression: ends at newline when no delimiter is
+        open. Validates delimiter balance; returns raw text."""
+        parts: list[str] = []
+        stack: list[tuple[str, int]] = []
+        while True:
+            t = self._next(skip_nl=False)
+            if t is None:
+                if stack:
+                    raise HclError(
+                        f"unclosed {stack[-1][0]!r} in expression", stack[-1][1]
+                    )
+                break
+            if t.kind == "newline":
+                if not stack:
+                    break
+                continue
+            if t.kind == "punct":
+                if t.text in _OPEN:
+                    stack.append((t.text, t.line))
+                elif t.text in _OPEN.values():
+                    if not stack:
+                        # closes the ENCLOSING one-line block
+                        # (`output "x" { value = expr }`): push back so
+                        # parse_body consumes it as the block terminator
+                        self.i -= 1
+                        break
+                    if _OPEN[stack[-1][0]] != t.text:
+                        raise HclError(
+                            f"unbalanced {t.text!r} in expression", t.line
+                        )
+                    stack.pop()
+            parts.append(t.text)
+        expr = " ".join(parts)
+        if not expr:
+            raise HclError("attribute has no value", line)
+        return expr
+
+
+def parse_hcl(src: str) -> Block:
+    """Parse HCL source into a Block tree; raises HclError on bad syntax."""
+    root = Block(type="<root>", labels=())
+    parser = _Parser(_tokenize(src))
+    parser.parse_body(root, 1, None)
+    return root

@@ -1,0 +1,331 @@
+"""Schema validation for rendered Kubernetes manifests (kubeconform-style).
+
+SURVEY.md §4: "kind-based integration for the K8s-facing pieces
+(device-plugin/JobSet manifests)". No cluster or kubeconform binary exists in
+the build image, so this vendors jsonschema documents for every kind the
+content layer renders — workload pod specs are checked down to container
+level (name/image required, selector labels must match template labels),
+which is exactly where a template regression would brick a real apply.
+
+Unknown kinds fail loudly rather than pass silently: every manifest the
+platform ships must have a schema here.
+"""
+
+from __future__ import annotations
+
+import jsonschema
+import yaml
+
+_METADATA = {
+    "type": "object",
+    "properties": {
+        "name": {"type": "string", "minLength": 1},
+        "namespace": {"type": "string"},
+        "labels": {"type": "object"},
+        "annotations": {"type": "object"},
+    },
+    "required": ["name"],
+}
+
+_CONTAINER = {
+    "type": "object",
+    "properties": {
+        "name": {"type": "string", "minLength": 1},
+        "image": {"type": "string", "minLength": 1},
+        "command": {"type": "array", "items": {"type": "string"}},
+        "args": {"type": "array", "items": {"type": "string"}},
+        "env": {
+            "type": "array",
+            "items": {
+                "type": "object",
+                "properties": {"name": {"type": "string", "minLength": 1}},
+                "required": ["name"],
+            },
+        },
+        "ports": {
+            "type": "array",
+            "items": {
+                "type": "object",
+                "properties": {
+                    "containerPort": {
+                        "type": "integer", "minimum": 1, "maximum": 65535,
+                    }
+                },
+                "required": ["containerPort"],
+            },
+        },
+        "resources": {"type": "object"},
+        "volumeMounts": {
+            "type": "array",
+            "items": {
+                "type": "object",
+                "properties": {
+                    "name": {"type": "string"},
+                    "mountPath": {"type": "string", "minLength": 1},
+                },
+                "required": ["name", "mountPath"],
+            },
+        },
+        "securityContext": {"type": "object"},
+    },
+    "required": ["name", "image"],
+}
+
+_POD_SPEC = {
+    "type": "object",
+    "properties": {
+        "containers": {
+            "type": "array", "minItems": 1, "items": _CONTAINER,
+        },
+        "initContainers": {"type": "array", "items": _CONTAINER},
+        "volumes": {
+            "type": "array",
+            "items": {
+                "type": "object",
+                "properties": {"name": {"type": "string", "minLength": 1}},
+                "required": ["name"],
+            },
+        },
+        "nodeSelector": {"type": "object"},
+        "tolerations": {"type": "array"},
+        "hostNetwork": {"type": "boolean"},
+        "restartPolicy": {
+            "enum": ["Always", "OnFailure", "Never"],
+        },
+        "serviceAccountName": {"type": "string"},
+        "priorityClassName": {"type": "string"},
+        "subdomain": {"type": "string"},
+    },
+    "required": ["containers"],
+}
+
+_POD_TEMPLATE = {
+    "type": "object",
+    "properties": {
+        "metadata": {"type": "object"},
+        "spec": _POD_SPEC,
+    },
+    "required": ["spec"],
+}
+
+_JOB_SPEC = {
+    "type": "object",
+    "properties": {
+        "template": _POD_TEMPLATE,
+        "backoffLimit": {"type": "integer", "minimum": 0},
+        "completions": {"type": "integer", "minimum": 0},
+        "parallelism": {"type": "integer", "minimum": 0},
+        "completionMode": {"enum": ["NonIndexed", "Indexed"]},
+        "activeDeadlineSeconds": {"type": "integer", "minimum": 1},
+        "ttlSecondsAfterFinished": {"type": "integer", "minimum": 0},
+    },
+    "required": ["template"],
+}
+
+
+def _workload(spec_extra: dict, required: list[str]) -> dict:
+    spec = {
+        "type": "object",
+        "properties": {
+            "selector": {
+                "type": "object",
+                "properties": {"matchLabels": {"type": "object"}},
+                "required": ["matchLabels"],
+            },
+            "template": _POD_TEMPLATE,
+            **spec_extra,
+        },
+        "required": required,
+    }
+    return {
+        "type": "object",
+        "properties": {
+            "apiVersion": {"type": "string"},
+            "kind": {"type": "string"},
+            "metadata": _METADATA,
+            "spec": spec,
+        },
+        "required": ["apiVersion", "kind", "metadata", "spec"],
+    }
+
+
+_TOP = {
+    "type": "object",
+    "properties": {
+        "apiVersion": {"type": "string", "minLength": 1},
+        "kind": {"type": "string", "minLength": 1},
+        "metadata": _METADATA,
+    },
+    "required": ["apiVersion", "kind", "metadata"],
+}
+
+SCHEMAS: dict[str, dict] = {
+    "DaemonSet": _workload(
+        {"updateStrategy": {"type": "object"}}, ["selector", "template"]
+    ),
+    "Deployment": _workload(
+        {"replicas": {"type": "integer", "minimum": 0},
+         "strategy": {"type": "object"}},
+        ["selector", "template"],
+    ),
+    "Job": {
+        **_TOP,
+        "properties": {**_TOP["properties"], "spec": _JOB_SPEC},
+        "required": ["apiVersion", "kind", "metadata", "spec"],
+    },
+    "JobSet": {
+        **_TOP,
+        "properties": {
+            **_TOP["properties"],
+            "spec": {
+                "type": "object",
+                "properties": {
+                    "replicatedJobs": {
+                        "type": "array",
+                        "minItems": 1,
+                        "items": {
+                            "type": "object",
+                            "properties": {
+                                "name": {"type": "string", "minLength": 1},
+                                "replicas": {"type": "integer", "minimum": 1},
+                                "template": {
+                                    "type": "object",
+                                    "properties": {"spec": _JOB_SPEC},
+                                    "required": ["spec"],
+                                },
+                            },
+                            "required": ["name", "template"],
+                        },
+                    },
+                    "network": {"type": "object"},
+                    "successPolicy": {"type": "object"},
+                    "failurePolicy": {"type": "object"},
+                },
+                "required": ["replicatedJobs"],
+            },
+        },
+        "required": ["apiVersion", "kind", "metadata", "spec"],
+    },
+    "ConfigMap": {
+        **_TOP,
+        "properties": {
+            **_TOP["properties"],
+            "data": {
+                "type": "object",
+                "additionalProperties": {"type": "string"},
+            },
+            "binaryData": {"type": "object"},
+        },
+    },
+    "Service": {
+        **_TOP,
+        "properties": {
+            **_TOP["properties"],
+            "spec": {
+                "type": "object",
+                "properties": {
+                    "selector": {"type": "object"},
+                    "clusterIP": {"type": "string"},
+                    "ports": {
+                        "type": "array",
+                        "items": {
+                            "type": "object",
+                            "properties": {
+                                "port": {
+                                    "type": "integer",
+                                    "minimum": 1, "maximum": 65535,
+                                },
+                            },
+                            "required": ["port"],
+                        },
+                    },
+                },
+            },
+        },
+        "required": ["apiVersion", "kind", "metadata", "spec"],
+    },
+    "Namespace": _TOP,
+    "ServiceAccount": _TOP,
+    "ClusterRole": {
+        **_TOP,
+        "properties": {**_TOP["properties"], "rules": {"type": "array"}},
+    },
+    "ClusterRoleBinding": {
+        **_TOP,
+        "properties": {
+            **_TOP["properties"],
+            "roleRef": {"type": "object"},
+            "subjects": {"type": "array"},
+        },
+        "required": ["apiVersion", "kind", "metadata", "roleRef"],
+    },
+    "ServiceMonitor": {
+        **_TOP,
+        "properties": {
+            **_TOP["properties"],
+            "spec": {
+                "type": "object",
+                "properties": {
+                    "selector": {"type": "object"},
+                    "endpoints": {"type": "array", "minItems": 1},
+                },
+                "required": ["selector", "endpoints"],
+            },
+        },
+        "required": ["apiVersion", "kind", "metadata", "spec"],
+    },
+}
+
+
+class ManifestError(ValueError):
+    pass
+
+
+def _selector_matches_template(doc: dict) -> None:
+    sel = (doc.get("spec") or {}).get("selector", {}).get("matchLabels")
+    tpl_labels = (
+        ((doc.get("spec") or {}).get("template") or {})
+        .get("metadata", {})
+        .get("labels", {})
+    )
+    if sel:
+        for k, v in sel.items():
+            if tpl_labels.get(k) != v:
+                raise ManifestError(
+                    f"{doc.get('kind')}/{doc['metadata'].get('name')}: "
+                    f"selector {k}={v} does not match template labels "
+                    f"{tpl_labels} — pods would never be adopted"
+                )
+
+
+def validate_manifest(doc: dict) -> None:
+    """Validate one manifest document; raises ManifestError."""
+    if not isinstance(doc, dict):
+        raise ManifestError(f"manifest is not a mapping: {type(doc).__name__}")
+    kind = doc.get("kind")
+    schema = SCHEMAS.get(str(kind))
+    if schema is None:
+        raise ManifestError(
+            f"no schema for kind {kind!r} — add it to k8s_validate.SCHEMAS"
+        )
+    try:
+        jsonschema.validate(doc, schema)
+    except jsonschema.ValidationError as e:
+        name = (doc.get("metadata") or {}).get("name", "?")
+        path = "/".join(str(p) for p in e.absolute_path)
+        raise ManifestError(f"{kind}/{name}: {path}: {e.message}") from e
+    if kind in ("DaemonSet", "Deployment"):
+        _selector_matches_template(doc)
+
+
+def validate_yaml_stream(text: str) -> int:
+    """Validate every document in a rendered multi-doc YAML; returns count."""
+    try:
+        docs = [d for d in yaml.safe_load_all(text) if d is not None]
+    except yaml.YAMLError as e:
+        raise ManifestError(f"invalid YAML: {e}") from e
+    if not docs:
+        raise ManifestError("no manifest documents in stream")
+    for doc in docs:
+        validate_manifest(doc)
+    return len(docs)

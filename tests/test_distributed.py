@@ -1,0 +1,80 @@
+"""True multi-process `jax.distributed` bootstrap over the HostEnv contract.
+
+VERDICT r1 item 7: the smoke Job relies on `initialize_from_env` wiring N
+per-host processes into one global JAX runtime (SURVEY.md §7 hard part (a) —
+every host in a slice runs the same program in lockstep). The single-process
+skip path was the only one CI exercised; this spawns two real OS processes,
+hands each the env block `host_envs` generates for a 2-host slice, and
+proves a cross-process `lax.psum` returns the global sum.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+from kubeoperator_tpu.parallel.multislice import host_envs
+from kubeoperator_tpu.parallel.topology import parse_accelerator_type
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Each worker: bootstrap from the env contract FIRST (before any jax op),
+# then psum a per-process value over every device in the global mesh.
+WORKER = """
+import os
+from kubeoperator_tpu.parallel.multislice import initialize_from_env
+initialize_from_env()
+import jax
+import numpy as np
+assert jax.process_count() == 2, jax.process_count()
+assert jax.device_count() == 4, jax.device_count()   # 2 procs x 2 local cpu
+x = np.full((jax.local_device_count(),),
+            float(jax.process_index() + 1), np.float32)
+out = jax.pmap(lambda v: jax.lax.psum(v, "i"), axis_name="i")(x)
+print("PSUM_RESULT", float(out[0]), flush=True)
+"""
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_psum_over_hostenv_contract():
+    topo = parse_accelerator_type("v5p-16")  # 2 hosts x 4 chips
+    assert topo.total_hosts == 2
+    envs = host_envs(topo, "127.0.0.1", port=_free_port())
+
+    procs = []
+    for henv in envs:
+        env = {
+            k: v for k, v in os.environ.items()
+            # scrub the image's TPU-tunnel plumbing: its sitecustomize
+            # registers a remote axon backend whenever these are set, and
+            # the workers must be pure-CPU processes
+            if not k.startswith(("PALLAS_AXON", "AXON_", "TPU_", "MEGASCALE"))
+        }
+        env.update(henv.to_env())
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", WORKER], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        ))
+
+    results = []
+    for p in procs:
+        out, err = p.communicate(timeout=150)
+        assert p.returncode == 0, f"worker failed:\n{err[-2000:]}"
+        for line in out.splitlines():
+            if line.startswith("PSUM_RESULT"):
+                results.append(float(line.split()[1]))
+
+    # psum over 4 global devices: 2 hold 1.0 (rank 0), 2 hold 2.0 (rank 1)
+    assert results == [6.0, 6.0]
