@@ -785,7 +785,19 @@ def create_app(services: Services) -> web.Application:
         async def index(request):
             return web.FileResponse(os.path.join(ui_dir, "index.html"))
 
+        # /ui/logic.js is generated from ui/logic.py (the tested single
+        # source of truth for client-side validation) — registered before
+        # the static mount so it wins, and cached for the process lifetime.
+        from kubeoperator_tpu.ui.transpile import generate_logic_js
+
+        logic_js = generate_logic_js()
+
+        async def logic(request):
+            return web.Response(text=logic_js,
+                                content_type="application/javascript")
+
         r.add_get("/", index)
+        r.add_get("/ui/logic.js", logic)
         r.add_static("/ui/", ui_dir)
     return app
 

@@ -47,6 +47,9 @@ const I18N = {
     no_clusters: "No clusters yet — create one.", no_plans: "No plans defined.",
     no_activity: "No activity yet.", confirm_delete: "Delete cluster",
     scale_up: "＋ Add nodes", remove: "Remove",
+    phase_timings: "Phase timings", follow: "Follow",
+    filter_logs: "filter logs…", total: "total",
+    num_slices: "Slices", slice_topology: "ICI topology (e.g. 4x4)",
   },
   zh: {
     sign_in: "登录", clusters: "集群", hosts: "主机", infra: "基础设施",
@@ -72,10 +75,14 @@ const I18N = {
     no_clusters: "暂无集群 — 创建一个。", no_plans: "暂无部署计划。",
     no_activity: "暂无操作记录。", confirm_delete: "删除集群",
     scale_up: "＋ 扩容节点", remove: "移除",
+    phase_timings: "阶段耗时", follow: "跟随",
+    filter_logs: "过滤日志…", total: "总计",
+    num_slices: "切片数", slice_topology: "ICI 拓扑（如 4x4）",
   },
 };
 let lang = localStorage.getItem("ko-lang") || "en";
-const t = (key) => I18N[lang][key] || I18N.en[key] || key;
+// lookup/toggle rules live in ui/logic.py (served as /ui/logic.js, tested)
+const t = (key) => KOLogic.i18n_get(I18N, lang, key);
 function applyI18n() {
   document.documentElement.lang = lang === "zh" ? "zh-CN" : "en";
   document.querySelectorAll("[data-i18n]").forEach((el) => {
@@ -84,7 +91,7 @@ function applyI18n() {
   $("#lang-toggle").textContent = lang === "zh" ? "EN" : "中文";
 }
 $("#lang-toggle").addEventListener("click", () => {
-  lang = lang === "zh" ? "en" : "zh";
+  lang = KOLogic.i18n_next(lang);
   localStorage.setItem("ko-lang", lang);
   applyI18n();
   // an open detail view renders its own strings — rebuild it too
@@ -130,7 +137,7 @@ document.querySelectorAll(".tab").forEach((b) =>
   }));
 
 /* ---------- generic object dialog ---------- */
-function objDialog(titleKey, fields, onSave) {
+function objDialog(titleKey, fields, onSave, validate) {
   $("#obj-title").textContent = t(titleKey);
   const box = $("#obj-fields");
   box.innerHTML = fields.map((f) => {
@@ -157,6 +164,15 @@ function objDialog(titleKey, fields, onSave) {
         }
       }
       out[f.key] = v;
+    }
+    if (validate) {
+      // client-side gate (ui/logic.py rules) — the POST never fires
+      // while the form would be rejected by the server anyway
+      const errors = validate(out);
+      if (errors.length) {
+        $("#obj-error").textContent = errors.join(" · ");
+        return;
+      }
     }
     try {
       await onSave(out);
@@ -243,6 +259,9 @@ async function openCluster(name) {
     ${c.status.smoke_chips ? `<div class="smoke">smoke: psum ${c.status.smoke_gbps} GB/s over ${c.status.smoke_chips} chips</div>` : ""}
     <div id="d-health-out"></div>
 
+    <h3>${t("phase_timings")}</h3>
+    <div id="d-trace" class="trace"></div>
+
     <h3>${t("nodes")}</h3>
     <table class="grid"><tr><th>name</th><th>role</th><th>status</th><th></th></tr>
     ${nodes.map((n) => `<tr><td>${esc(n.name)}</td><td>${n.role}</td><td>${n.status}</td>
@@ -288,6 +307,11 @@ async function openCluster(name) {
     </div>` : ""}
 
     <h3>${t("live_logs")}</h3>
+    <div class="row">
+      <input id="d-log-filter" placeholder="${t("filter_logs")}">
+      <label class="muted"><input type="checkbox" id="d-log-follow" checked>
+        ${t("follow")}</label>
+    </div>
     <div class="logbox" id="d-logs"></div>
     <h3>${t("events")}</h3>
     <div>${events.map((e) =>
@@ -392,15 +416,44 @@ async function openCluster(name) {
       $("#d-term-in").onkeydown = (ev) => { if (ev.key === "Enter") send(); };
     });
   }
-  // live logs over SSE
+  // per-phase duration bars from the native trace (SURVEY §5.1 spans)
+  api("GET", `/api/v1/clusters/${name}/trace`).then((trace) => {
+    const tr = KOLogic.trace_rows(trace);
+    $("#d-trace").innerHTML = tr.rows.map((r) => `
+      <div class="trace-row">
+        <span class="trace-name">${esc(r.name)}</span>
+        <span class="trace-track"><span class="trace-bar ${r.status}"
+          style="width:${r.pct}%"></span></span>
+        <span class="trace-dur">${r.duration_s != null
+          ? r.duration_s.toFixed(1) + "s" : "—"}</span>
+      </div>`).join("") +
+      (tr.total_s != null
+        ? `<div class="trace-total">${t("total")} ${tr.total_s.toFixed(1)}s</div>`
+        : "");
+  }).catch(() => { $("#d-trace").textContent = "—"; });
+
+  // live logs over SSE: full buffer kept client-side, re-rendered through
+  // the tested filter (ui/logic.py filter_log_lines); follow toggles
+  // autoscroll without stopping the stream
   const box = $("#d-logs");
-  box.textContent = "";
+  const logLines = [];
+  const renderLogs = () => {  // full re-render: filter/follow changes only
+    box.textContent =
+      KOLogic.filter_log_lines(logLines, $("#d-log-filter").value).join("\n");
+    if ($("#d-log-follow").checked) box.scrollTop = box.scrollHeight;
+  };
+  $("#d-log-filter").addEventListener("input", renderLogs);
+  $("#d-log-follow").addEventListener("change", renderLogs);
   if (logStream) logStream.close();
   logStream = new EventSource(`/api/v1/clusters/${name}/logs?follow=1`);
   logStream.onmessage = (ev) => {
     const { line } = JSON.parse(ev.data);
-    box.textContent += line + "\n";
-    box.scrollTop = box.scrollHeight;
+    logLines.push(line);
+    // streaming stays O(1) per line: append only the (filtered) new line
+    if (KOLogic.filter_log_lines([line], $("#d-log-filter").value).length) {
+      box.textContent += (box.textContent ? "\n" : "") + line;
+      if ($("#d-log-follow").checked) box.scrollTop = box.scrollHeight;
+    }
   };
   logStream.addEventListener("end", () => logStream.close());
 }
@@ -417,6 +470,7 @@ $("#new-cluster-btn").addEventListener("click", async () => {
     `<option>${v}</option>`).join("");
   $("#wz-k8s").value = vers.supported_k8s_versions[2] || vers.supported_k8s_versions[0];
   renderTopology();
+  wizardCheck();
   $("#wizard").showModal();
 });
 $("#wz-cancel").addEventListener("click", () => $("#wizard").close());
@@ -424,8 +478,22 @@ $("#wz-mode").addEventListener("change", () => {
   const manual = $("#wz-mode").value === "manual";
   $("#wz-plan-row").hidden = manual;
   $("#wz-manual-row").hidden = !manual;
+  wizardCheck();
 });
-$("#wz-plan").addEventListener("change", renderTopology);
+$("#wz-plan").addEventListener("change", () => { renderTopology(); wizardCheck(); });
+
+// live gate: Create stays disabled while ui/logic.py's rules reject the form
+function wizardCheck() {
+  const errors = KOLogic.wizard_errors(
+    $("#wz-mode").value, $("#wz-name").value, $("#wz-plan").value,
+    $("#wz-hosts").value, $("#wz-workers").value);
+  $("#wz-error").textContent = errors.join(" · ");
+  $("#wz-create").disabled = errors.length > 0;
+  return errors;
+}
+for (const id of ["#wz-name", "#wz-hosts", "#wz-workers"]) {
+  $(id).addEventListener("input", wizardCheck);
+}
 
 function renderTopology() {
   const plan = planCache.find((p) => p.name === $("#wz-plan").value);
@@ -434,9 +502,9 @@ function renderTopology() {
   if (!plan || plan.accelerator !== "tpu") return;
   // visualize the ICI mesh: one square per chip, grid per topology
   api("GET", "/api/v1/plans-tpu-catalog").then((catalog) => {
-    const topo = catalog.find((x) => x.accelerator_type === plan.tpu_type);
+    const topo = KOLogic.catalog_entry(catalog, plan.tpu_type);
     if (!topo) return;
-    const dims = topo.ici_mesh.split("x").map(Number);
+    const dims = KOLogic.parse_mesh(topo.ici_mesh) || [topo.chips];
     const cols = dims.length >= 2 ? dims[1] * (dims[2] || 1) : dims[0];
     const mesh = document.createElement("div");
     mesh.className = "mesh";
@@ -446,17 +514,23 @@ function renderTopology() {
       chip.className = "chip";
       mesh.appendChild(chip);
     }
+    const sum = KOLogic.tpu_plan_summary(topo, plan.num_slices || 1);
     const meta = document.createElement("div");
     meta.className = "topo-meta";
-    meta.innerHTML = `${topo.accelerator_type} — ${topo.chips} chips · ` +
-      `${topo.total_hosts} host${topo.total_hosts > 1 ? "s" : ""} · ` +
-      `ICI ${topo.ici_mesh}<br>runtime ${topo.runtime_version}`;
+    meta.innerHTML = `${topo.accelerator_type} — ${sum.total_chips} chips · ` +
+      `${sum.total_hosts} host${sum.total_hosts > 1 ? "s" : ""} · ` +
+      `ICI ${sum.ici_mesh}` +
+      (sum.num_slices > 1 ? ` × ${sum.num_slices} slices (DCN)` : "") +
+      `<br>runtime ${sum.runtime_version}`;
     box.append(mesh, meta);
   });
 }
 
 $("#wz-create").addEventListener("click", async () => {
-  const body = { name: $("#wz-name").value, spec: { k8s_version: $("#wz-k8s").value } };
+  if (wizardCheck().length) return;
+  // validation ran on the trimmed name — send exactly what was validated
+  const body = { name: $("#wz-name").value.trim(),
+                 spec: { k8s_version: $("#wz-k8s").value } };
   if ($("#wz-mode").value === "plan") {
     body.provision_mode = "plan";
     body.plan = $("#wz-plan").value;
@@ -495,21 +569,25 @@ $("#new-plan-btn").addEventListener("click", async () => {
       options: ["tpu", "none"] },
     { key: "tpu_type", label: "TPU slice", type: "select",
       options: catalog.map((x) => x.accelerator_type) },
+    { key: "num_slices", label: t("num_slices"), type: "number", value: 1 },
+    { key: "slice_topology", label: t("slice_topology"), placeholder: "4x4" },
     { key: "master_count", label: "Masters", type: "number", value: 1 },
     { key: "worker_count", label: t("workers"), type: "number", value: 0 },
   ], async (out) => {
     const region = regions.find((r) => r.name === out.region);
     const body = {
-      name: out.name, provider: out.provider,
+      name: out.name.trim(), provider: out.provider,
       region_id: region ? region.id : "",
       master_count: out.master_count, worker_count: out.worker_count,
     };
     if (out.accelerator === "tpu") {
       body.accelerator = "tpu";
       body.tpu_type = out.tpu_type;
+      body.num_slices = out.num_slices;
+      if (out.slice_topology.trim()) body.slice_topology = out.slice_topology.trim();
     }
     await api("POST", "/api/v1/plans", body);
-  });
+  }, (out) => KOLogic.plan_form_errors(out, catalog));
 });
 $("#new-region-btn").addEventListener("click", () => {
   objDialog("new_region", [

@@ -1,0 +1,64 @@
+"""Runtime helpers shared by ui/logic.py and its transpiled JS form.
+
+Every function here has a hand-written JS twin in ``transpile.JS_PRELUDE``
+(the ``_rt`` object). The pair must behave identically on the value shapes
+the UI logic uses (strings, numbers, lists, string-keyed dicts, None) —
+that equivalence is what lets `tests/test_ui_logic.py` test the *browser's*
+wizard validation by exercising the Python source. Keep both sides in
+lock-step; the test suite checks the JS side structurally and pins each
+helper's semantics here behaviorally.
+
+Deliberate deviations from plain Python, chosen for portability:
+* ``parse_int`` is stricter than ``int()`` (no '+4', no '_', no unicode
+  digits) because the JS twin uses ``/^-?\\d+$/``.
+* ``round2`` uses floor(x*100+0.5)/100 — identical in both languages,
+  unlike Python's banker's rounding.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+
+_INT_RE = re.compile(r"-?[0-9]+")
+
+
+def parse_int(s):
+    """Strict base-10 int parse; None on anything else (JS: regex + parseInt)."""
+    t = str(s).strip()
+    if _INT_RE.fullmatch(t):
+        return int(t)
+    return None
+
+
+def contains(container, item):
+    """Python ``in`` with JS-reachable semantics: substring for strings,
+    membership for lists, key-presence for dicts. None container -> False."""
+    if container is None:
+        return False
+    return item in container
+
+
+def get(obj, key, default):
+    """dict.get (JS: hasOwnProperty guard). A key present with value None
+    returns None on both sides — only a *missing* key hits the default."""
+    if obj is None:
+        return default
+    return obj.get(key, default)
+
+
+def round2(x):
+    """Round to 2 decimals, half-away-from-zero for positives — identical
+    formula both sides (Python round() would use banker's rounding)."""
+    return math.floor(x * 100.0 + 0.5) / 100.0
+
+
+def to_str(x):
+    """str() twin: JS String(null) is 'null', so both sides map None->'None'."""
+    if x is None:
+        return "None"
+    if x is True:
+        return "true"
+    if x is False:
+        return "false"
+    return str(x)

@@ -1,0 +1,277 @@
+"""UI client logic — single source of truth, executed in BOTH runtimes.
+
+This module is written in a deliberately restricted Python subset that
+``ui/transpile.py`` converts 1:1 into the ``/ui/logic.js`` the browser
+loads (exposed as ``window.KOLogic``). That design is how the console gets
+*tested* client-side behavior in an environment with no JS engine: the
+functions the wizard runs in the browser are these functions, so
+``tests/test_ui_logic.py`` can behaviorally pin them (including a parity
+grid against the server's ``Plan.validate`` — the client must reject
+exactly what the server would) without a headless browser.
+
+Mirrors (client-checkable subset):
+* ``models/infra.py`` ``Plan.validate`` — master HA counts, region
+  requirement, TPU/provider coupling, worker-count-vs-topology rule
+  (the "v5e-16 needs exactly 4 hosts" check).
+* ``parallel/topology.py`` mesh parsing/product math, via the
+  ``/api/v1/plans-tpu-catalog`` rows the browser already fetches.
+
+Subset rules (enforced by the transpiler, which raises on anything else):
+functions + if/for/while/assign/return, f-strings, list/dict literals,
+``jsrt.*`` helpers for everything runtime-sensitive. No classes, no
+imports beyond jsrt, no try/except, no comprehensions.
+"""
+
+from kubeoperator_tpu.ui import jsrt
+
+DNS_ALNUM = "abcdefghijklmnopqrstuvwxyz0123456789"
+
+
+def dns_label_ok(name):
+    """RFC1123 label: the rule cluster/plan names must satisfy to become
+    K8s object names and TPU-VM instance prefixes."""
+    n = str(name)
+    if len(n) < 1 or len(n) > 63:
+        return False
+    i = 0
+    for ch in n:
+        if not jsrt.contains(DNS_ALNUM, ch):
+            if ch != "-":
+                return False
+            if i == 0 or i == len(n) - 1:
+                return False
+        i += 1
+    return True
+
+
+def parse_mesh(text):
+    """'4x4' / '2x2x4' -> [4, 4] / [2, 2, 4]; None if unparseable.
+    Mirrors parallel/topology.py parse_ici_mesh (x / unicode-times)."""
+    parts = str(text).lower().split("×")
+    joined = "x".join(parts)
+    dims = []
+    for p in joined.split("x"):
+        n = jsrt.parse_int(p)
+        if n is None or n < 1:
+            return None
+        dims.append(n)
+    if len(dims) == 0:
+        return None
+    return dims
+
+
+def mesh_product(dims):
+    total = 1
+    for d in dims:
+        total = total * d
+    return total
+
+
+def catalog_entry(catalog, tpu_type):
+    """Row of /api/v1/plans-tpu-catalog for an accelerator type, or None."""
+    want = str(tpu_type).strip().lower()
+    for row in catalog:
+        if str(jsrt.get(row, "accelerator_type", "")).lower() == want:
+            return row
+    return None
+
+
+def tpu_plan_summary(entry, num_slices):
+    """Wizard topology caption: derived hosts/chips for a catalog row."""
+    slices = num_slices
+    if slices is None or slices < 1:
+        slices = 1
+    hosts = jsrt.get(entry, "hosts_per_slice", 0) * slices
+    chips = jsrt.get(entry, "chips", 0) * slices
+    return {
+        "total_hosts": hosts,
+        "total_chips": chips,
+        "num_slices": slices,
+        "ici_mesh": jsrt.get(entry, "ici_mesh", ""),
+        "runtime_version": jsrt.get(entry, "runtime_version", ""),
+    }
+
+
+def plan_form_errors(form, catalog):
+    """Client-side mirror of Plan.validate (models/infra.py): everything the
+    browser can check before POST /api/v1/plans. Returns a list of error
+    strings; empty means the server would accept the same fields."""
+    errors = []
+    name = str(jsrt.get(form, "name", "")).strip()
+    if name == "":
+        errors.append("plan name required")
+    elif not dns_label_ok(name):
+        errors.append(f"plan name {name} must be a lowercase DNS label")
+
+    provider = str(jsrt.get(form, "provider", "")).strip()
+    masters = jsrt.parse_int(jsrt.get(form, "master_count", 1))
+    if masters is None or masters < 1:
+        errors.append("plan needs >= 1 master")
+    elif not jsrt.contains([1, 3, 5], masters):
+        errors.append("HA requires 1, 3 or 5 masters")
+
+    if provider != "bare_metal" and str(jsrt.get(form, "region", "")).strip() == "":
+        errors.append("IaaS plans must reference a region")
+
+    accelerator = jsrt.get(form, "accelerator", "none")
+    if accelerator != "none" and accelerator != "tpu":
+        errors.append("accelerator must be 'none' or 'tpu'")
+    if accelerator != "tpu":
+        return errors
+
+    if provider != "gcp_tpu_vm":
+        errors.append("TPU plans require the gcp_tpu_vm provider")
+    tpu_type = str(jsrt.get(form, "tpu_type", "")).strip()
+    if tpu_type == "":
+        errors.append("TPU plan needs tpu_type (e.g. 'v5e-16')")
+        return errors
+    entry = catalog_entry(catalog, tpu_type)
+    if entry is None:
+        errors.append(f"unknown TPU slice type {tpu_type}")
+        return errors
+
+    slices = jsrt.parse_int(jsrt.get(form, "num_slices", 1))
+    if slices is None or slices < 1:
+        errors.append("num_slices must be >= 1")
+        slices = 1
+
+    topology = str(jsrt.get(form, "slice_topology", "")).strip()
+    if topology != "":
+        dims = parse_mesh(topology)
+        chips = jsrt.get(entry, "chips", 0)
+        default_dims = parse_mesh(jsrt.get(entry, "ici_mesh", ""))
+        if dims is None:
+            errors.append(f"unparseable slice topology {topology}")
+        elif mesh_product(dims) != chips:
+            errors.append(
+                f"topology {topology} has {mesh_product(dims)} chips "
+                f"but {tpu_type} is {chips}"
+            )
+        elif chips > 1 and default_dims is not None \
+                and len(dims) != len(default_dims):
+            # ICI rank is fixed per generation (2-D mesh on v5e/v6e, 3-D
+            # torus on v4/v5p) — the catalog row's default mesh carries it
+            errors.append(
+                f"{tpu_type} ICI is {len(default_dims)}-D; got {topology}"
+            )
+
+    # The load-bearing rule: TPU workers ARE the slice hosts. v5e-16 x1
+    # => worker_count must be exactly 4 (0 = "derive for me").
+    workers = jsrt.parse_int(jsrt.get(form, "worker_count", 0))
+    expected = jsrt.get(entry, "hosts_per_slice", 0) * slices
+    if workers is None or workers < 0:
+        errors.append("worker count must be a non-negative integer")
+    elif workers != 0 and workers != expected:
+        errors.append(
+            f"{tpu_type} x{slices} slice(s) need exactly {expected} "
+            f"TPU hosts, worker_count says {workers}"
+        )
+    return errors
+
+
+def wizard_errors(mode, name, plan_name, hosts_csv, workers):
+    """Create-cluster wizard gate: blocks the POST (and disables the Create
+    button) while invalid. Manual mode mirrors the service-side rule that a
+    cluster needs >= 1 reachable host and a sane worker count."""
+    errors = []
+    if not dns_label_ok(str(name).strip()):
+        errors.append("cluster name must be a lowercase DNS label (1-63 chars)")
+    if mode == "plan":
+        if str(plan_name).strip() == "":
+            errors.append("select a deploy plan")
+        return errors
+    hosts = []
+    seen_dup = False
+    for part in str(hosts_csv).split(","):
+        h = part.strip()
+        if h != "":
+            if jsrt.contains(hosts, h):
+                seen_dup = True
+            hosts.append(h)
+    if len(hosts) == 0:
+        errors.append("manual mode needs at least one registered host")
+    if seen_dup:
+        errors.append("duplicate host names")
+    w = jsrt.parse_int(workers)
+    if w is None or w < 0:
+        errors.append("worker count must be a non-negative integer")
+    elif len(hosts) > 0 and len(hosts) < w + 1:
+        # mirror of service/cluster.py's manual-mode rule: one host is the
+        # master, so N hosts carry at most N-1 workers
+        errors.append(
+            f"need at least {w + 1} hosts (1 master + {w} workers), "
+            f"got {len(hosts)}"
+        )
+    return errors
+
+
+def filter_log_lines(lines, query):
+    """Log-viewer filter: case-insensitive substring over raw lines. The
+    viewer keeps the full line buffer and re-renders through this, so
+    clearing the query restores everything."""
+    q = str(query).strip().lower()
+    if q == "":
+        return lines
+    out = []
+    for line in lines:
+        if jsrt.contains(str(line).lower(), q):
+            out.append(line)
+    return out
+
+
+def trace_rows(trace):
+    """/clusters/{name}/trace -> renderable per-phase duration rows with
+    percent widths for the pipeline bar chart (SURVEY §5.1 spans)."""
+    spans = jsrt.get(trace, "spans", [])
+    total = 0.0
+    for s in spans:
+        d = jsrt.get(s, "duration_s", None)
+        if d is not None:
+            total = total + d
+    rows = []
+    for s in spans:
+        d = jsrt.get(s, "duration_s", None)
+        pct = 0
+        if d is not None and total > 0:
+            pct = jsrt.round2(d * 100.0 / total)
+        rows.append({
+            "name": jsrt.get(s, "name", ""),
+            "status": jsrt.get(s, "status", ""),
+            "duration_s": d,
+            "pct": pct,
+        })
+    return {"total_s": jsrt.get(trace, "total_s", None), "rows": rows}
+
+
+def i18n_next(lang):
+    if lang == "zh":
+        return "en"
+    return "zh"
+
+
+def i18n_get(tables, lang, key):
+    """Message lookup with en fallback, then the key itself (so a missing
+    translation degrades visibly instead of blanking the element)."""
+    table = jsrt.get(tables, lang, None)
+    if table is not None and jsrt.contains(table, key):
+        return jsrt.get(table, key, key)
+    en = jsrt.get(tables, "en", None)
+    if en is not None and jsrt.contains(en, key):
+        return jsrt.get(en, key, key)
+    return key
+
+
+# Exported to window.KOLogic.<name> — order is the generated file's order.
+PUBLIC = [
+    dns_label_ok,
+    parse_mesh,
+    mesh_product,
+    catalog_entry,
+    tpu_plan_summary,
+    plan_form_errors,
+    wizard_errors,
+    filter_log_lines,
+    trace_rows,
+    i18n_next,
+    i18n_get,
+]

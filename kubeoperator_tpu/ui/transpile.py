@@ -1,0 +1,376 @@
+"""Python-subset -> JS transpiler for the console's client logic.
+
+``ui/logic.py`` is the single source of truth for everything the browser
+validates/formats; this module turns its AST into the ``/ui/logic.js``
+the web console loads. The subset is deliberately tiny — anything outside
+it raises ``TranspileError`` at generation time (i.e. in CI, via
+tests/test_ui_logic.py), never silently mis-translates.
+
+Why a transpiler instead of hand-written JS: the build environment has no
+JS engine, so hand-written JS would be untestable. Generated-from-Python
+JS means the behavioral tests that pin ``logic.py`` (including the parity
+grid against ``Plan.validate``) are tests of the exact logic the browser
+executes; only this emitter and the 6-function ``_rt`` prelude (mirrored
+1:1 by ``ui/jsrt.py``) must be reviewed by eye.
+"""
+
+from __future__ import annotations
+
+import ast
+import inspect
+import json
+import textwrap
+
+
+class TranspileError(Exception):
+    pass
+
+
+# Hand-written JS twins of ui/jsrt.py — keep in lock-step (see jsrt.py).
+JS_PRELUDE = textwrap.dedent("""\
+    /* GENERATED from kubeoperator_tpu/ui/logic.py — do not edit by hand. */
+    "use strict";
+    const _rt = {
+      parse_int: function (s) {
+        const t = String(s).trim();
+        return /^-?[0-9]+$/.test(t) ? parseInt(t, 10) : null;
+      },
+      contains: function (c, x) {
+        if (c === null || c === undefined) return false;
+        if (Array.isArray(c) || typeof c === "string") return c.includes(x);
+        return Object.prototype.hasOwnProperty.call(c, x);
+      },
+      get: function (o, k, d) {
+        if (o === null || o === undefined) return d;
+        return Object.prototype.hasOwnProperty.call(o, k) ? o[k] : d;
+      },
+      round2: function (x) { return Math.floor(x * 100.0 + 0.5) / 100.0; },
+      len: function (x) {
+        if (x === null || x === undefined) return 0;
+        if (Array.isArray(x) || typeof x === "string") return x.length;
+        return Object.keys(x).length;
+      },
+      str: function (x) {
+        if (x === null || x === undefined) return "None";
+        if (x === true) return "true";
+        if (x === false) return "false";
+        return String(x);
+      },
+    };
+""")
+
+_METHOD_MAP = {
+    "append": "push",
+    "strip": "trim",
+    "lower": "toLowerCase",
+    "upper": "toUpperCase",
+    "startswith": "startsWith",
+    "endswith": "endsWith",
+    "split": "split",
+}
+
+_CMP_MAP = {
+    ast.Eq: "===", ast.NotEq: "!==",
+    ast.Lt: "<", ast.LtE: "<=", ast.Gt: ">", ast.GtE: ">=",
+}
+
+_BIN_MAP = {ast.Add: "+", ast.Sub: "-", ast.Mult: "*", ast.Div: "/",
+            ast.Mod: "%"}
+
+
+def _err(node: ast.AST, msg: str) -> TranspileError:
+    return TranspileError(f"line {getattr(node, 'lineno', '?')}: {msg}")
+
+
+class _FunctionEmitter:
+    """Emits one module-level function. Locals are hoisted to a single
+    ``let`` at the top so Python's function scoping survives JS block
+    scoping."""
+
+    def __init__(self, fn: ast.FunctionDef, known_functions: set[str]):
+        self.fn = fn
+        self.known = known_functions
+        self.args = [a.arg for a in fn.args.args]
+        if fn.args.vararg or fn.args.kwarg or fn.args.kwonlyargs or fn.args.defaults:
+            raise _err(fn, f"{fn.name}: only plain positional args supported")
+        self.locals: list[str] = []
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+                for t in targets:
+                    if isinstance(t, ast.Name) and t.id not in self.args \
+                            and t.id not in self.locals:
+                        self.locals.append(t.id)
+            if isinstance(node, ast.For) and isinstance(node.target, ast.Name):
+                if node.target.id not in self.args and node.target.id not in self.locals:
+                    self.locals.append(node.target.id)
+
+    def emit(self) -> str:
+        lines = [f"function {self.fn.name}({', '.join(self.args)}) {{"]
+        if self.locals:
+            lines.append(f"  let {', '.join(self.locals)};")
+        body = self.fn.body
+        if body and isinstance(body[0], ast.Expr) \
+                and isinstance(body[0].value, ast.Constant) \
+                and isinstance(body[0].value.value, str):
+            body = body[1:]  # docstring
+        for stmt in body:
+            lines.extend(self.stmt(stmt, 1))
+        lines.append("}")
+        return "\n".join(lines)
+
+    # ---- statements ----
+    def stmt(self, node: ast.stmt, depth: int) -> list[str]:
+        pad = "  " * depth
+        if isinstance(node, ast.Return):
+            if node.value is None:
+                return [f"{pad}return null;"]
+            return [f"{pad}return {self.expr(node.value)};"]
+        if isinstance(node, ast.Assign):
+            if len(node.targets) != 1:
+                raise _err(node, "single-target assignment only")
+            t = node.targets[0]
+            val = self.expr(node.value)
+            if isinstance(t, ast.Name):
+                return [f"{pad}{t.id} = {val};"]
+            if isinstance(t, ast.Subscript):
+                return [f"{pad}{self.expr(t.value)}[{self.expr(t.slice)}] = {val};"]
+            raise _err(node, f"unsupported assignment target {type(t).__name__}")
+        if isinstance(node, ast.AugAssign):
+            if not isinstance(node.target, ast.Name):
+                raise _err(node, "augassign to names only")
+            op = _BIN_MAP.get(type(node.op))
+            if op is None:
+                raise _err(node, f"unsupported augassign op {type(node.op).__name__}")
+            return [f"{pad}{node.target.id} {op}= {self.expr(node.value)};"]
+        if isinstance(node, ast.If):
+            lines = [f"{pad}if ({self.expr(node.test)}) {{"]
+            for s in node.body:
+                lines.extend(self.stmt(s, depth + 1))
+            while len(node.orelse) == 1 and isinstance(node.orelse[0], ast.If):
+                node = node.orelse[0]
+                lines.append(f"{pad}}} else if ({self.expr(node.test)}) {{")
+                for s in node.body:
+                    lines.extend(self.stmt(s, depth + 1))
+            if node.orelse:
+                lines.append(f"{pad}}} else {{")
+                for s in node.orelse:
+                    lines.extend(self.stmt(s, depth + 1))
+            lines.append(f"{pad}}}")
+            return lines
+        if isinstance(node, ast.For):
+            if node.orelse:
+                raise _err(node, "for-else unsupported")
+            if not isinstance(node.target, ast.Name):
+                raise _err(node, "loop target must be a bare name")
+            v = node.target.id
+            it = node.iter
+            if isinstance(it, ast.Call) and isinstance(it.func, ast.Name) \
+                    and it.func.id == "range":
+                bounds = [self.expr(a) for a in it.args]
+                if len(bounds) == 1:
+                    lo, hi = "0", bounds[0]
+                elif len(bounds) == 2:
+                    lo, hi = bounds
+                else:
+                    raise _err(node, "range() step unsupported")
+                head = f"{pad}for ({v} = {lo}; {v} < {hi}; {v}++) {{"
+            else:
+                head = f"{pad}for ({v} of {self.expr(it)}) {{"
+            lines = [head]
+            for s in node.body:
+                lines.extend(self.stmt(s, depth + 1))
+            lines.append(f"{pad}}}")
+            return lines
+        if isinstance(node, ast.While):
+            if node.orelse:
+                raise _err(node, "while-else unsupported")
+            lines = [f"{pad}while ({self.expr(node.test)}) {{"]
+            for s in node.body:
+                lines.extend(self.stmt(s, depth + 1))
+            lines.append(f"{pad}}}")
+            return lines
+        if isinstance(node, ast.Expr):
+            if isinstance(node.value, ast.Constant):
+                return []  # stray docstring
+            return [f"{pad}{self.expr(node.value)};"]
+        if isinstance(node, ast.Break):
+            return [f"{pad}break;"]
+        if isinstance(node, ast.Continue):
+            return [f"{pad}continue;"]
+        if isinstance(node, ast.Pass):
+            return []
+        raise _err(node, f"unsupported statement {type(node).__name__}")
+
+    # ---- expressions ----
+    def expr(self, node: ast.expr) -> str:
+        if isinstance(node, ast.Constant):
+            v = node.value
+            if v is None:
+                return "null"
+            if v is True:
+                return "true"
+            if v is False:
+                return "false"
+            if isinstance(v, str):
+                return json.dumps(v, ensure_ascii=False)
+            if isinstance(v, (int, float)):
+                return repr(v)
+            raise _err(node, f"unsupported constant {v!r}")
+        if isinstance(node, ast.Name):
+            return node.id
+        if isinstance(node, ast.List):
+            return "[" + ", ".join(self.expr(e) for e in node.elts) + "]"
+        if isinstance(node, ast.Dict):
+            pairs = []
+            for k, v in zip(node.keys, node.values):
+                if not (isinstance(k, ast.Constant) and isinstance(k.value, str)):
+                    raise _err(node, "dict keys must be string literals")
+                pairs.append(f"{json.dumps(k.value, ensure_ascii=False)}: {self.expr(v)}")
+            return "{" + ", ".join(pairs) + "}"
+        if isinstance(node, ast.Subscript):
+            return f"{self.expr(node.value)}[{self.expr(node.slice)}]"
+        if isinstance(node, ast.BoolOp):
+            op = " && " if isinstance(node.op, ast.And) else " || "
+            return "(" + op.join(self.expr(v) for v in node.values) + ")"
+        if isinstance(node, ast.UnaryOp):
+            if isinstance(node.op, ast.Not):
+                return f"!({self.expr(node.operand)})"
+            if isinstance(node.op, ast.USub):
+                return f"(-{self.expr(node.operand)})"
+            raise _err(node, f"unsupported unary op {type(node.op).__name__}")
+        if isinstance(node, ast.BinOp):
+            if isinstance(node.op, ast.FloorDiv):
+                return f"Math.floor(({self.expr(node.left)}) / ({self.expr(node.right)}))"
+            op = _BIN_MAP.get(type(node.op))
+            if op is None:
+                raise _err(node, f"unsupported operator {type(node.op).__name__}")
+            return f"({self.expr(node.left)} {op} {self.expr(node.right)})"
+        if isinstance(node, ast.Compare):
+            parts = []
+            left = node.left
+            for op, right in zip(node.ops, node.comparators):
+                parts.append(self._compare_one(node, left, op, right))
+                left = right
+            return parts[0] if len(parts) == 1 else "(" + " && ".join(parts) + ")"
+        if isinstance(node, ast.IfExp):
+            return (f"({self.expr(node.test)} ? {self.expr(node.body)}"
+                    f" : {self.expr(node.orelse)})")
+        if isinstance(node, ast.JoinedStr):
+            return self._fstring(node)
+        if isinstance(node, ast.Call):
+            return self._call(node)
+        raise _err(node, f"unsupported expression {type(node).__name__}")
+
+    def _compare_one(self, node, left, op, right) -> str:
+        l, r = self.expr(left), self.expr(right)
+        if isinstance(op, ast.In):
+            return f"_rt.contains({r}, {l})"
+        if isinstance(op, ast.NotIn):
+            return f"!_rt.contains({r}, {l})"
+        if isinstance(op, (ast.Is, ast.IsNot)):
+            # only `is None` / `is not None` make it through review
+            if not (isinstance(right, ast.Constant) and right.value is None):
+                raise _err(node, "`is` only supported against None")
+            return f"({l} {'===' if isinstance(op, ast.Is) else '!=='} null)"
+        sym = _CMP_MAP.get(type(op))
+        if sym is None:
+            raise _err(node, f"unsupported comparison {type(op).__name__}")
+        return f"({l} {sym} {r})"
+
+    def _fstring(self, node: ast.JoinedStr) -> str:
+        out = ["`"]
+        for part in node.values:
+            if isinstance(part, ast.Constant):
+                out.append(str(part.value)
+                           .replace("\\", "\\\\").replace("`", "\\`")
+                           .replace("${", "\\${"))
+            elif isinstance(part, ast.FormattedValue):
+                if part.format_spec is not None or part.conversion != -1:
+                    raise _err(node, "f-string format specs unsupported")
+                out.append("${" + self.expr(part.value) + "}")
+            else:
+                raise _err(node, "unsupported f-string part")
+        out.append("`")
+        return "".join(out)
+
+    def _call(self, node: ast.Call) -> str:
+        if node.keywords:
+            raise _err(node, "keyword arguments unsupported")
+        args = [self.expr(a) for a in node.args]
+        f = node.func
+        if isinstance(f, ast.Name):
+            if f.id == "len":
+                return f"_rt.len({args[0]})"
+            if f.id == "str":
+                return f"_rt.str({args[0]})"
+            if f.id in ("min", "max", "abs"):
+                return f"Math.{f.id}({', '.join(args)})"
+            if f.id in self.known:
+                return f"{f.id}({', '.join(args)})"
+            raise _err(node, f"unknown function {f.id}()")
+        if isinstance(f, ast.Attribute):
+            if isinstance(f.value, ast.Name) and f.value.id == "jsrt":
+                name = "str" if f.attr == "to_str" else f.attr
+                return f"_rt.{name}({', '.join(args)})"
+            obj = self.expr(f.value)
+            if f.attr == "join":
+                # Python sep.join(xs) -> JS xs.join(sep)
+                if len(args) != 1:
+                    raise _err(node, "join takes one iterable")
+                return f"{args[0]}.join({obj})"
+            if f.attr == "replace":
+                if len(args) != 2:
+                    raise _err(node, "replace takes (old, new)")
+                # JS String.replace only hits the first match for string pats
+                return f"{obj}.split({args[0]}).join({args[1]})"
+            mapped = _METHOD_MAP.get(f.attr)
+            if mapped is None:
+                raise _err(node, f"unsupported method .{f.attr}() — add to "
+                                 "_METHOD_MAP or use a jsrt helper")
+            return f"{obj}.{mapped}({', '.join(args)})"
+        raise _err(node, "unsupported call target")
+
+
+def transpile_source(source: str, public_names: list[str]) -> str:
+    """Transpile a logic-subset module's source into a complete JS file."""
+    tree = ast.parse(source)
+    functions = [n for n in tree.body if isinstance(n, ast.FunctionDef)]
+    known = {fn.name for fn in functions}
+    chunks = [JS_PRELUDE]
+    for node in tree.body:
+        if isinstance(node, (ast.ImportFrom, ast.Import)):
+            continue
+        if isinstance(node, ast.Expr) and isinstance(node.value, ast.Constant):
+            continue  # module docstring
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            name = node.targets[0].id
+            if name == "PUBLIC":
+                continue  # export list handled below
+            if isinstance(node.value, ast.Constant):
+                emitter = _FunctionEmitter(
+                    ast.parse("def _c(): pass").body[0], known)
+                chunks.append(f"const {name} = {emitter.expr(node.value)};")
+                continue
+            raise _err(node, "module-level assignments must be constants")
+        if isinstance(node, ast.FunctionDef):
+            chunks.append(_FunctionEmitter(node, known).emit())
+            continue
+        raise _err(node, f"unsupported module statement {type(node).__name__}")
+    missing = [n for n in public_names if n not in known]
+    if missing:
+        raise TranspileError(f"PUBLIC names not defined: {missing}")
+    exports = ", ".join(f"{n}: {n}" for n in public_names)
+    chunks.append(f"const KOLogic = {{{exports}}};")
+    chunks.append('(typeof window !== "undefined" ? window : globalThis)'
+                  ".KOLogic = KOLogic;")
+    return "\n\n".join(chunks) + "\n"
+
+
+def generate_logic_js() -> str:
+    """The /ui/logic.js the server serves (api/server.py static section)."""
+    from kubeoperator_tpu.ui import logic
+
+    source = inspect.getsource(logic)
+    return transpile_source(source, [f.__name__ for f in logic.PUBLIC])
