@@ -1,0 +1,163 @@
+"""Concurrency hardening (SURVEY §5.2): hammer the single-op-per-cluster
+lock discipline and the executor's multi-watcher fan-out from many threads
+at once. The service layer has no `go test -race` equivalent, so these
+tests substitute brute concurrency + invariant checks: every racing call
+must either win cleanly or fail with a *typed* error, and the final state
+must be consistent (no orphan host bindings, no stuck op registry, no
+watcher seeing a torn line stream)."""
+
+import threading
+
+import pytest
+
+from kubeoperator_tpu.executor.base import TaskSpec
+from kubeoperator_tpu.models import ClusterSpec
+from kubeoperator_tpu.service import build_services
+from kubeoperator_tpu.utils.config import load_config
+from kubeoperator_tpu.utils.errors import (
+    ConflictError,
+    NotFoundError,
+    ValidationError,
+)
+
+from tests.test_services import register_fleet, svc  # noqa: F401  (fixture)
+
+KNOWN = (ConflictError, NotFoundError, ValidationError)
+
+
+def hammer(n_threads, fn):
+    """Run fn(i) from n_threads at once (barrier start); collect results or
+    exceptions. Asserts nothing deadlocks (30s join budget)."""
+    results = [None] * n_threads
+    barrier = threading.Barrier(n_threads)
+
+    def worker(i):
+        barrier.wait()
+        try:
+            results[i] = ("ok", fn(i))
+        except Exception as e:  # typed-ness asserted by callers
+            results[i] = ("err", e)
+
+    threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+        assert not t.is_alive(), "worker deadlocked"
+    return results
+
+
+class TestClusterOpHammer:
+    def test_concurrent_create_same_name_single_winner(self, svc):  # noqa: F811
+        register_fleet(svc, 3)
+        results = hammer(6, lambda i: svc.clusters.create(
+            "dup", spec=ClusterSpec(worker_count=1),
+            host_names=["host0", "host1"], wait=True))
+        oks = [r for r in results if r[0] == "ok"]
+        errs = [r for r in results if r[0] == "err"]
+        # exactly one create may win the name; every loser fails typed
+        assert len(oks) == 1, f"expected 1 winner, got {len(oks)}"
+        assert all(isinstance(e, KNOWN) for _, e in errs), errs
+        cluster = svc.clusters.get("dup")
+        assert cluster.status.phase in ("Ready", "Failed")
+        # losers must not have half-bound hosts: exactly the winner's two
+        bound = [h for h in svc.hosts.list() if h.cluster_id]
+        assert {h.cluster_id for h in bound} == {cluster.id}
+        assert len(bound) == 2
+
+    def test_retry_delete_storm_on_one_cluster(self, svc):  # noqa: F811
+        register_fleet(svc, 3)
+        svc.clusters.create("storm", spec=ClusterSpec(worker_count=1),
+                            host_names=["host0", "host1"], wait=True)
+
+        def op(i):
+            if i % 2 == 0:
+                svc.clusters.retry("storm", wait=True)
+            else:
+                svc.clusters.delete("storm", wait=True)
+            return i
+
+        results = hammer(8, op)
+        for kind, val in results:
+            if kind == "err":
+                assert isinstance(val, KNOWN), val
+        # terminal state: either fully deleted (all hosts unbound) or a
+        # consistent surviving cluster — never a zombie binding
+        try:
+            cluster = svc.clusters.get("storm")
+            assert cluster.status.phase in (
+                "Ready", "Failed", "Terminating")
+        except NotFoundError:
+            assert all(not h.cluster_id for h in svc.hosts.list())
+        # op registry must drain — a leaked thread would block later ops
+        svc.clusters.wait_all(timeout_s=30)
+        assert not svc.clusters._ops
+
+    def test_create_delete_recreate_cycles(self, svc):  # noqa: F811
+        """Sequential lifecycle under a concurrent health-prober thread:
+        the read path must never observe torn state."""
+        register_fleet(svc, 3)
+        stop = threading.Event()
+        seen_bad = []
+
+        from kubeoperator_tpu.models.cluster import ClusterPhaseStatus
+        valid_phases = {p.value for p in ClusterPhaseStatus}
+
+        def prober():
+            while not stop.is_set():
+                try:
+                    for c in svc.clusters.list():
+                        if c.status.phase not in valid_phases:
+                            seen_bad.append(c.status.phase)
+                except KNOWN:
+                    pass
+
+        t = threading.Thread(target=prober, daemon=True)
+        t.start()
+        try:
+            for _ in range(3):
+                svc.clusters.create("cycle", spec=ClusterSpec(worker_count=1),
+                                    host_names=["host0", "host1"], wait=True)
+                svc.clusters.delete("cycle", wait=True)
+        finally:
+            stop.set()
+            t.join(timeout=10)
+        assert not seen_bad, f"prober saw invalid phases: {seen_bad}"
+        with pytest.raises(NotFoundError):
+            svc.clusters.get("cycle")
+
+
+class TestExecutorWatchFanout:
+    def test_many_watchers_one_task_all_see_full_stream(self, svc):  # noqa: F811
+        ex = svc.executor
+        task_id = ex.run(TaskSpec(
+            playbook="01-base.yml",
+            inventory={"all": {"hosts": {"localhost": {}}}},
+            extra_vars={},
+        ))
+        results = hammer(8, lambda i: list(ex.watch(task_id, timeout_s=60)))
+        streams = []
+        for kind, val in results:
+            assert kind == "ok", f"watcher raised: {val}"
+            streams.append(val)
+        # every watcher sees the identical, complete, ordered stream
+        assert all(s == streams[0] for s in streams[1:])
+        assert len(streams[0]) > 0
+        result = ex.result(task_id)
+        assert result.status in ("Success", "Failed")
+
+    def test_watchers_joining_mid_flight(self, svc):  # noqa: F811
+        """Watchers attaching while lines are still being produced must
+        catch up from line 0 and still drain to the end."""
+        ex = svc.executor
+        task_id = ex.run(TaskSpec(
+            playbook="01-base.yml",
+            inventory={"all": {"hosts": {"localhost": {}}}},
+            extra_vars={},
+        ))
+        early = list(ex.watch(task_id, timeout_s=60))
+        # task done; late watcher must replay the full buffer
+        late = list(ex.watch(task_id, timeout_s=60))
+        assert late == early
+        assert len(late) > 0
