@@ -1,0 +1,148 @@
+"""Long-context sequence/context parallelism — first-class, TPU-native.
+
+The reference platform's long-sequence story is NCCL point-to-point under
+frameworks it merely hosts; this framework owns the TPU-native equivalents
+directly, as validation workloads and as primitives the smoke/diag family
+composes (SURVEY.md §5.7/§5.8):
+
+* **Ring attention** (`ring_attention_local` / `ring_attention`): each
+  device holds a sequence shard; K/V blocks rotate around an ICI ring via
+  `lax.ppermute` while a flash-style online-softmax accumulator keeps the
+  exact result — memory per device stays O(seq/n), the ring rides one
+  physical ICI axis, and compute/communication overlap is XLA's to
+  schedule. Exact (not approximate) and causal-capable.
+* **Ulysses-style all-to-all resharding** (`seq_to_heads` / `heads_to_seq`):
+  `lax.all_to_all` flips a [batch, seq/n, heads, dim] layout into
+  [batch, seq, heads/n, dim] and back, trading a sequence shard for a head
+  shard so any off-the-shelf full-attention kernel can run unmodified in
+  the middle. On TPU the a2a is a single XLA collective over the chosen
+  mesh axis (ICI within a slice, DCN across slices).
+
+Everything here is functionally pure, jit-safe (static shapes, `lax.scan`
+control flow), and differentiable — `ppermute`/`all_to_all`/`psum` all have
+transposes, so these primitives drop straight into a training step (the
+driver's `dryrun_multichip` does exactly that).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from kubeoperator_tpu.parallel.mesh import axis_size, shard_map_compat
+
+_NEG = -1e30  # finite -inf stand-in: masked logits underflow exp() to 0.0
+
+
+def reference_attention(q, k, v, causal: bool = False):
+    """Plain full softmax attention — the single-device ground truth the
+    parallel forms are tested against. [batch, seq, heads, dim] layout."""
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        qpos = jnp.arange(q.shape[1])[:, None]
+        kpos = jnp.arange(k.shape[1])[None, :]
+        s = jnp.where(kpos <= qpos, s, _NEG)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def ring_attention_local(q, k, v, axis_name: str, n: int,
+                         causal: bool = False):
+    """Per-device body of ring attention (call inside shard_map).
+
+    q/k/v: the LOCAL sequence shard, [batch, seq_local, heads, dim].
+    `n` is the static ring size (mesh axis size). K/V blocks hop to the next
+    rank each step (n steps total) while q stays put; the online-softmax
+    carry (o, m, l) is accumulated in f32 regardless of input dtype.
+    """
+    seq_local = q.shape[1]
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    rank = lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    qf = q.astype(jnp.float32)
+    o0 = jnp.zeros(q.shape, jnp.float32)
+    m0 = jnp.full((q.shape[0], q.shape[2], seq_local, 1), _NEG, jnp.float32)
+    l0 = jnp.zeros((q.shape[0], q.shape[2], seq_local, 1), jnp.float32)
+    qpos = rank * seq_local + jnp.arange(seq_local)
+
+    def step(carry, t):
+        kb, vb, o, m, l = carry
+        s = jnp.einsum("bqhd,bkhd->bhqk", qf, kb.astype(jnp.float32)) * scale
+        if causal:
+            # after t hops this block originated at rank (rank - t) mod n
+            src = (rank - t) % n
+            kpos = src * seq_local + jnp.arange(seq_local)
+            s = jnp.where(kpos[None, None, None, :]
+                          <= qpos[None, None, :, None], s, _NEG)
+        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)                       # masked entries -> 0
+        correction = jnp.exp(m - m_new)
+        l = l * correction + p.sum(axis=-1, keepdims=True)
+        o = (o * jnp.moveaxis(correction, 1, 2)      # [b,s,h,1] for o layout
+             + jnp.einsum("bhqk,bkhd->bqhd", p, vb.astype(jnp.float32)))
+        kb = lax.ppermute(kb, axis_name, perm)
+        vb = lax.ppermute(vb, axis_name, perm)
+        return (kb, vb, o, m_new, l), None
+
+    (_, _, o, _, l), _ = lax.scan(step, (k, v, o0, m0, l0), jnp.arange(n))
+    return (o / jnp.moveaxis(l, 1, 2)).astype(q.dtype)
+
+
+def ring_attention(q, k, v, mesh, axis_name: str = "sp",
+                   batch_axis: str | None = None, causal: bool = False):
+    """Sharded entry point: q/k/v are global arrays sequence-sharded over
+    `axis_name` (and optionally batch-sharded over `batch_axis`). Returns
+    the exact attention output with the same sharding."""
+    n = axis_size(mesh, axis_name)
+    spec = jax.sharding.PartitionSpec(batch_axis, axis_name, None, None)
+    body = partial(ring_attention_local, axis_name=axis_name, n=n,
+                   causal=causal)
+    fn = shard_map_compat(body, mesh, in_specs=(spec, spec, spec),
+                          out_specs=spec)
+    return jax.jit(fn)(q, k, v)
+
+
+def seq_to_heads(x, axis_name: str):
+    """Ulysses reshard inside shard_map: [b, seq/n, H, d] -> [b, seq, H/n, d]
+    via one all-to-all over `axis_name`. Heads must divide the axis size."""
+    return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                          tiled=True)
+
+
+def heads_to_seq(x, axis_name: str):
+    """Inverse Ulysses reshard: [b, seq, H/n, d] -> [b, seq/n, H, d]."""
+    return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                          tiled=True)
+
+
+def ulysses_attention_local(q, k, v, axis_name: str,
+                            causal: bool = False):
+    """Per-device Ulysses sequence parallelism: a2a to head-sharded layout,
+    run ordinary full attention on the complete sequence for the local head
+    subset, a2a back to sequence-sharded. Exact, two collectives total."""
+    qh = seq_to_heads(q, axis_name)
+    kh = seq_to_heads(k, axis_name)
+    vh = seq_to_heads(v, axis_name)
+    oh = reference_attention(qh, kh, vh, causal=causal)
+    return heads_to_seq(oh, axis_name)
+
+
+def ulysses_attention(q, k, v, mesh, axis_name: str = "sp",
+                      batch_axis: str | None = None, causal: bool = False):
+    """Sharded Ulysses entry point (same contract as `ring_attention`)."""
+    if q.shape[2] % axis_size(mesh, axis_name):
+        raise ValueError(
+            f"{q.shape[2]} heads not divisible by axis {axis_name!r} "
+            f"size {axis_size(mesh, axis_name)}"
+        )
+    spec = jax.sharding.PartitionSpec(batch_axis, axis_name, None, None)
+    body = partial(ulysses_attention_local, axis_name=axis_name,
+                   causal=causal)
+    fn = shard_map_compat(body, mesh, in_specs=(spec, spec, spec),
+                          out_specs=spec)
+    return jax.jit(fn)(q, k, v)

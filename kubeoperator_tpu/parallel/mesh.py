@@ -87,3 +87,27 @@ def mesh_for_topology(
 def flat_axis_mesh(name: str = "devices") -> jax.sharding.Mesh:
     """1-D mesh over every visible device — the all-reduce smoke-test mesh."""
     return build_mesh((name,), None, None)
+
+
+def shard_map_compat(fn, mesh, in_specs, out_specs):
+    """`jax.shard_map` across the jax versions this image family ships:
+    new API (check_vma) vs the experimental module (check_rep). Both flags
+    disabled — validation workloads use collectives whose replication
+    bookkeeping the older checker rejects."""
+    try:
+        from jax import shard_map as sm
+
+        return sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_vma=False)
+    except (ImportError, TypeError):
+        from jax.experimental.shard_map import shard_map as sme
+
+        return sme(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   check_rep=False)
+
+
+def axis_size(mesh: jax.sharding.Mesh, name: str) -> int:
+    """Static size of a named mesh axis."""
+    if name not in mesh.shape:
+        raise TopologyError(f"mesh has no axis {name!r} (axes: {mesh.axis_names})")
+    return int(mesh.shape[name])

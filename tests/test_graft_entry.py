@@ -43,6 +43,17 @@ def test_dryrun_multichip_4():
     mod.dryrun_multichip(4)
 
 
+def test_dryrun_multichip_6_non_power_of_two():
+    """Odd factors must land on dp (batch shards any size) — a factor of 3
+    on tp/sp would break the d_ff/expert divisibility (review regression)."""
+    mod = _load_graft()
+    assert mod._axis_sizes(6) == (3, 1, 1, 2)
+    assert mod._axis_sizes(12) == (3, 1, 2, 2)
+    assert mod._axis_sizes(8) == (1, 2, 2, 2)
+    assert mod._axis_sizes(64) == (2, 2, 4, 4)
+    mod.dryrun_multichip(6)
+
+
 def _run_subprocess(code: str, extra_env: dict | None = None) -> subprocess.CompletedProcess:
     env = dict(os.environ)
     env.pop("JAX_PLATFORMS", None)  # the driver does NOT pin jax_platforms

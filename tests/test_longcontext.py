@@ -1,0 +1,151 @@
+"""Long-context parallelism: ring attention and Ulysses a2a resharding must
+be EXACT — every test checks the sharded result against single-device full
+attention on the gathered arrays, causal and non-causal, on 1-D and 2-D
+(dp x sp) virtual meshes. Differentiability is pinned too: these primitives
+feed the driver's multichip training-step dryrun."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeoperator_tpu.parallel.longcontext import (
+    heads_to_seq,
+    reference_attention,
+    ring_attention,
+    seq_to_heads,
+    ulysses_attention,
+)
+from kubeoperator_tpu.parallel.mesh import build_mesh, shard_map_compat
+
+B, S, H, D = 2, 64, 8, 16
+
+
+def make_qkv(seed=0, dtype=jnp.float32):
+    rng = np.random.default_rng(seed)
+    mk = lambda: jnp.asarray(
+        rng.standard_normal((B, S, H, D)).astype(np.float32), dtype)
+    return mk(), mk(), mk()
+
+
+@pytest.fixture(scope="module")
+def sp_mesh():
+    return build_mesh(("sp",), (8,), jax.devices()[:8])
+
+
+@pytest.fixture(scope="module")
+def dp_sp_mesh():
+    return build_mesh(("dp", "sp"), (2, 4), jax.devices()[:8])
+
+
+def put(mesh, x, spec):
+    return jax.device_put(x, jax.sharding.NamedSharding(mesh, spec))
+
+
+class TestRingAttention:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_reference_1d(self, sp_mesh, causal):
+        q, k, v = make_qkv()
+        P = jax.sharding.PartitionSpec
+        qs = put(sp_mesh, q, P(None, "sp"))
+        ks = put(sp_mesh, k, P(None, "sp"))
+        vs = put(sp_mesh, v, P(None, "sp"))
+        out = ring_attention(qs, ks, vs, sp_mesh, causal=causal)
+        want = reference_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_reference_2d_batch_sharded(self, dp_sp_mesh, causal):
+        q, k, v = make_qkv(seed=1)
+        P = jax.sharding.PartitionSpec
+        qs = put(dp_sp_mesh, q, P("dp", "sp"))
+        ks = put(dp_sp_mesh, k, P("dp", "sp"))
+        vs = put(dp_sp_mesh, v, P("dp", "sp"))
+        out = ring_attention(qs, ks, vs, dp_sp_mesh, axis_name="sp",
+                             batch_axis="dp", causal=causal)
+        want = reference_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_bf16_inputs_f32_accumulation(self, sp_mesh):
+        q, k, v = make_qkv(seed=2, dtype=jnp.bfloat16)
+        P = jax.sharding.PartitionSpec
+        qs = put(sp_mesh, q, P(None, "sp"))
+        ks = put(sp_mesh, k, P(None, "sp"))
+        vs = put(sp_mesh, v, P(None, "sp"))
+        out = ring_attention(qs, ks, vs, sp_mesh)
+        assert out.dtype == jnp.bfloat16
+        want = reference_attention(q.astype(jnp.float32),
+                                   k.astype(jnp.float32),
+                                   v.astype(jnp.float32))
+        np.testing.assert_allclose(
+            np.asarray(out, dtype=np.float32), np.asarray(want),
+            rtol=0.05, atol=0.05)  # bf16 I/O tolerance; accumulators are f32
+
+    def test_differentiable(self, sp_mesh):
+        """ppermute/scan carry must transpose cleanly: grads flow and a
+        shifted input changes the loss (non-degenerate gradient)."""
+        q, k, v = make_qkv(seed=3)
+        P = jax.sharding.PartitionSpec
+        args = tuple(put(sp_mesh, x, P(None, "sp")) for x in (q, k, v))
+
+        def loss(q, k, v):
+            out = ring_attention(q, k, v, sp_mesh, causal=True)
+            return jnp.sum(out.astype(jnp.float32) ** 2)
+
+        grads = jax.grad(loss, argnums=(0, 1, 2))(*args)
+        for g in grads:
+            gh = np.asarray(g)
+            assert gh.shape == (B, S, H, D)
+            assert np.all(np.isfinite(gh))
+            assert np.abs(gh).max() > 0
+
+
+class TestUlysses:
+    def test_roundtrip_identity(self, sp_mesh):
+        x, _, _ = make_qkv(seed=4)
+        P = jax.sharding.PartitionSpec
+        xs = put(sp_mesh, x, P(None, "sp"))
+        fn = shard_map_compat(
+            lambda a: heads_to_seq(seq_to_heads(a, "sp"), "sp"),
+            sp_mesh, in_specs=(P(None, "sp"),), out_specs=P(None, "sp"))
+        out = jax.jit(fn)(xs)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_reference(self, sp_mesh, causal):
+        q, k, v = make_qkv(seed=5)
+        P = jax.sharding.PartitionSpec
+        qs = put(sp_mesh, q, P(None, "sp"))
+        ks = put(sp_mesh, k, P(None, "sp"))
+        vs = put(sp_mesh, v, P(None, "sp"))
+        out = ulysses_attention(qs, ks, vs, sp_mesh, causal=causal)
+        want = reference_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_head_divisibility_enforced(self, sp_mesh):
+        rng = np.random.default_rng(6)
+        bad = jnp.asarray(rng.standard_normal((B, S, 6, D)),
+                          jnp.float32)  # 6 heads, 8-way axis
+        P = jax.sharding.PartitionSpec
+        xs = put(sp_mesh, bad, P(None, "sp"))
+        with pytest.raises(ValueError, match="not divisible"):
+            ulysses_attention(xs, xs, xs, sp_mesh)
+
+    def test_ring_and_ulysses_agree(self, dp_sp_mesh):
+        """The two sequence-parallel strategies are interchangeable on the
+        same mesh — the property the diag family relies on when picking
+        per-topology (ring rides one ICI axis; a2a is one fused collective)."""
+        q, k, v = make_qkv(seed=7)
+        P = jax.sharding.PartitionSpec
+        qs = put(dp_sp_mesh, q, P("dp", "sp"))
+        ks = put(dp_sp_mesh, k, P("dp", "sp"))
+        vs = put(dp_sp_mesh, v, P("dp", "sp"))
+        ring = ring_attention(qs, ks, vs, dp_sp_mesh, axis_name="sp",
+                              batch_axis="dp", causal=True)
+        uly = ulysses_attention(qs, ks, vs, dp_sp_mesh, axis_name="sp",
+                                batch_axis="dp", causal=True)
+        np.testing.assert_allclose(np.asarray(ring), np.asarray(uly),
+                                   rtol=2e-5, atol=2e-5)
