@@ -391,6 +391,10 @@ def cmd_tpu_diag(args) -> int:
         ]
         report["ring_all_gather_correct"] = ops.verify_ring_all_gather()
         report["pallas_ring"] = ops.bench_ring_all_gather().to_dict()
+        # composed long-context path: exact ring attention over the ring
+        report["ring_attention_correct"] = ops.verify_ring_attention()
+        report["ring_attention"] = ops.bench_ring_attention(
+            seq_per_device=256, iters=4).to_dict()
     print(json.dumps(report, indent=2))
     return 0
 

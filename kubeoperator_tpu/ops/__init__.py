@@ -14,6 +14,8 @@ exercise the same hardware axes TPU-natively:
                   stream + explicit remote-DMA ICI ring all-gather
   psum_smoke.py   the cluster smoke test: correctness + psum bus-bandwidth
                   across the full slice, emitting KO_TPU_SMOKE_RESULT
+  longcontext_check.py  ring-attention exactness + throughput over the ICI
+                  ring (the long-context path of parallel/longcontext.py)
 
 Everything here runs on CPU meshes for CI (virtual devices) and on real TPU
 for the metric runs; no NCCL/MPI anywhere [BASELINE].
@@ -32,6 +34,11 @@ from kubeoperator_tpu.ops.pallas_kernels import (
     ring_all_gather,
     verify_ring_all_gather,
 )
+from kubeoperator_tpu.ops.longcontext_check import (
+    RingAttentionResult,
+    bench_ring_attention,
+    verify_ring_attention,
+)
 
 __all__ = [
     "CollectiveResult",
@@ -43,4 +50,7 @@ __all__ = [
     "dma_read_bandwidth_gbps",
     "ring_all_gather",
     "verify_ring_all_gather",
+    "RingAttentionResult",
+    "bench_ring_attention",
+    "verify_ring_attention",
 ]

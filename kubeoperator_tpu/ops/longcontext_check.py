@@ -1,0 +1,140 @@
+"""Long-context validation workload — ring attention as a slice health check.
+
+The collective suite (collectives.py) proves raw ICI bandwidth; this module
+proves the *composed* long-context path the framework ships
+(parallel/longcontext.py): exact causal ring attention with K/V rotating
+over a mesh axis. It joins the smoke/diag family (SURVEY.md §5.7's
+long-context analog) as:
+
+* ``verify_ring_attention``  — sharded result must match single-device full
+  attention bit-for-tolerance; any ICI permute ordering bug, stale-block
+  reuse, or mask off-by-one fails it.
+* ``bench_ring_attention``   — sustained attention TFLOP/s over the ring,
+  differential-timed like every other workload (ops/timing.py rationale).
+
+Like the rest of ops/, runs on CPU meshes for CI and real slices for the
+metric runs.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from kubeoperator_tpu.ops.timing import differential_time_per_iter
+from kubeoperator_tpu.parallel.longcontext import (
+    reference_attention,
+    ring_attention,
+    ring_attention_local,
+)
+from kubeoperator_tpu.parallel.mesh import (
+    axis_size,
+    flat_axis_mesh,
+    shard_map_compat,
+)
+
+AXIS = "sp"
+
+
+def verify_ring_attention(mesh=None, causal: bool = True,
+                          tol: float = 2e-4) -> bool:
+    """Exactness gate: ring attention over the mesh vs reference attention
+    on the gathered arrays. Small f32 shapes — this is a correctness probe,
+    not a throughput number."""
+    mesh = mesh or flat_axis_mesh(AXIS)
+    n = axis_size(mesh, AXIS)
+    b, s_local, h, dh = 2, 8, 4, 16
+    rng = np.random.default_rng(0)
+    shape = (b, s_local * n, h, dh)
+    q_h, k_h, v_h = (rng.standard_normal(shape).astype(np.float32)
+                     for _ in range(3))
+    spec = P(None, AXIS, None, None)
+    q, k, v = (jax.device_put(a, NamedSharding(mesh, spec))
+               for a in (q_h, k_h, v_h))
+    out = np.asarray(jax.device_get(
+        ring_attention(q, k, v, mesh, axis_name=AXIS, causal=causal)))
+    want = np.asarray(reference_attention(
+        jnp.asarray(q_h), jnp.asarray(k_h), jnp.asarray(v_h), causal=causal))
+    return bool(np.allclose(out, want, rtol=tol, atol=tol))
+
+
+@dataclass(frozen=True)
+class RingAttentionResult:
+    n_devices: int
+    seq_global: int
+    heads: int
+    head_dim: int
+    causal: bool
+    time_per_iter_s: float
+    tflops: float
+
+    def to_dict(self) -> dict:
+        return {
+            "n_devices": self.n_devices,
+            "seq_global": self.seq_global,
+            "heads": self.heads,
+            "head_dim": self.head_dim,
+            "causal": self.causal,
+            "time_per_iter_s": round(self.time_per_iter_s, 6),
+            "tflops": round(self.tflops, 3),
+        }
+
+
+def bench_ring_attention(
+    seq_per_device: int = 512,
+    heads: int = 8,
+    head_dim: int = 64,
+    batch: int = 1,
+    causal: bool = True,
+    mesh=None,
+    iters: int = 8,
+    trials: int = 3,
+    dtype=jnp.bfloat16,
+) -> RingAttentionResult:
+    """Sustained ring-attention throughput. FLOP count is the standard
+    4·b·h·dh·s² (QKᵀ + PV, both 2·…); the causal variant computes the full
+    score block and masks, so the count is not halved."""
+    mesh = mesh or flat_axis_mesh(AXIS)
+    n = axis_size(mesh, AXIS)
+    s_global = seq_per_device * n
+    rng = np.random.default_rng(0)
+    shape = (batch, s_global, heads, head_dim)
+    spec = P(None, AXIS, None, None)
+    q, k, v = (
+        jax.device_put(
+            rng.standard_normal(shape).astype(np.float32).astype(dtype),
+            NamedSharding(mesh, spec))
+        for _ in range(3)
+    )
+
+    @partial(jax.jit, static_argnums=(3,))
+    def run_iters(qq, kk, vv, m):
+        def shard_body(qb, kb, vb):
+            def step(_, u):
+                return ring_attention_local(u, kb, vb, AXIS, n, causal)
+            return jax.lax.fori_loop(0, m, step, qb)
+
+        out = shard_map_compat(
+            shard_body, mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        )(qq, kk, vv)
+        # scalar readback proves remote completion (ops/timing.py rationale)
+        return out.astype(jnp.float32).sum()
+
+    def run(m: int) -> float:
+        return float(run_iters(q, k, v, m))
+
+    dt = differential_time_per_iter(
+        run, lo=max(iters // 4, 1), hi=max(iters, iters // 4 + 2),
+        trials=max(trials, 1),
+    )
+    flops = 4.0 * batch * heads * head_dim * float(s_global) ** 2
+    return RingAttentionResult(
+        n_devices=n, seq_global=s_global, heads=heads, head_dim=head_dim,
+        causal=causal, time_per_iter_s=dt, tflops=flops / dt / 1e12,
+    )

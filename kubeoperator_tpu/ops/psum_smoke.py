@@ -39,6 +39,16 @@ def run_smoke(
     mesh = flat_axis_mesh()
     ok = verify_psum_correctness(mesh)
 
+    # long-context path: exact ring attention over the same mesh axis —
+    # catches ICI permute-ordering/mask bugs raw psum can't see
+    ring_ok = True
+    if chips >= 2:
+        from kubeoperator_tpu.ops.longcontext_check import (
+            verify_ring_attention,
+        )
+
+        ring_ok = verify_ring_attention(flat_axis_mesh("sp"))
+
     best = 0.0
     table = []
     for size in sizes_mb:
@@ -49,8 +59,10 @@ def run_smoke(
     result = {
         "gbps": round(best, 3),
         "chips": chips,
-        "ok": bool(ok) and (expected == 0 or chips == expected),
+        "ok": bool(ok) and bool(ring_ok)
+              and (expected == 0 or chips == expected),
         "correctness": bool(ok),
+        "ring_attention_correct": bool(ring_ok),
         "expected_chips": expected,
         "process_index": jax.process_index(),
         "num_processes": jax.process_count(),

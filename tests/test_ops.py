@@ -147,3 +147,32 @@ def test_multislice_dcn_ici_hierarchy_collectives():
         return jax.lax.psum(intra, "dcn")                # crosses slices
     out = float(hierarchical(jnp.ones((8,), jnp.float32)))
     assert out == 8.0
+
+
+class TestLongContextWorkload:
+    """longcontext_check: the composed ring-attention health probe joining
+    the smoke/diag family (§5.7 long-context analog)."""
+
+    def test_verify_ring_attention_on_virtual_mesh(self):
+        from kubeoperator_tpu.ops import verify_ring_attention
+
+        assert verify_ring_attention() is True
+        assert verify_ring_attention(causal=False) is True
+
+    def test_bench_ring_attention_reports_sane_numbers(self):
+        from kubeoperator_tpu.ops import bench_ring_attention
+
+        r = bench_ring_attention(seq_per_device=32, heads=2, head_dim=8,
+                                 iters=2, trials=1)
+        d = r.to_dict()
+        assert d["n_devices"] == 8
+        assert d["seq_global"] == 256
+        assert d["tflops"] > 0
+        assert d["time_per_iter_s"] > 0
+
+    def test_smoke_includes_ring_attention_gate(self):
+        from kubeoperator_tpu.ops.psum_smoke import run_smoke
+
+        result = run_smoke(sizes_mb=(0.1,), iters=2)
+        assert result["ring_attention_correct"] is True
+        assert result["ok"] is True
