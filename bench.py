@@ -88,6 +88,16 @@ def main() -> int:
             details[f"psum_busbw_{int(size)}mb"] = round(r.busbw_gbps, 2)
             if best is None or r.busbw_gbps > best:
                 best = r.busbw_gbps
+        # composed long-context path over the same ring (detail metric):
+        # exactness gate + sustained ring-attention TFLOP/s
+        from kubeoperator_tpu.ops.longcontext_check import (
+            bench_ring_attention,
+            verify_ring_attention,
+        )
+
+        details["ring_attention_correct"] = verify_ring_attention()
+        details["ring_attention_tflops"] = bench_ring_attention(
+            seq_per_device=1024, iters=6).to_dict()["tflops"]
         envelope = 2.0 * gen.ici_gbps_per_link
         result = {
             "metric": "psum_allreduce_busbw_gbps",

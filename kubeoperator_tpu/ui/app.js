@@ -341,7 +341,9 @@ async function openCluster(name) {
       { key: "version", label: t("k8s_version"), type: "select",
         options: vers.supported_k8s_versions },
     ], (out) => api("POST", `/api/v1/clusters/${name}/upgrade`, out)
-        .then(() => openCluster(name)));
+        .then(() => openCluster(name)),
+    (out) => KOLogic.upgrade_errors(         // one-minor-hop gate, tested
+      c.spec.k8s_version, out.version, vers.supported_k8s_versions));
   });
   $("#d-scale-up").addEventListener("click", () => {
     objDialog("scale_up", [

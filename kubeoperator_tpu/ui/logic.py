@@ -243,6 +243,43 @@ def trace_rows(trace):
     return {"total_s": jsrt.get(trace, "total_s", None), "rows": rows}
 
 
+def k8s_minor(version):
+    """'v1.28.15' -> 28; None when unparseable. Mirrors
+    service/upgrade.py _minor (lstrip('v') there strips chars, but every
+    supported version has a single leading 'v')."""
+    v = str(version).strip()
+    if v.startswith("v"):
+        v = v[1:]
+    parts = v.split(".")
+    if len(parts) < 2:
+        return None
+    return jsrt.parse_int(parts[1])
+
+
+def upgrade_errors(current, target, supported):
+    """Client-side mirror of UpgradeService.validate_hop: target must be in
+    the supported bundle, strictly newer, and exactly one minor hop. The
+    dialog disables Upgrade while this returns errors."""
+    errors = []
+    if not jsrt.contains(supported, target):
+        errors.append(f"{target} is not in the supported bundle")
+        return errors
+    cm = k8s_minor(current)
+    tm = k8s_minor(target)
+    if cm is None or tm is None:
+        errors.append("unparseable k8s version")
+        return errors
+    hop = tm - cm
+    if hop < 1:
+        errors.append(f"{target} is not newer than {current}")
+    elif hop > 1:
+        errors.append(
+            f"upgrades must move one minor at a time "
+            f"({current} -> {target} is {hop} hops)"
+        )
+    return errors
+
+
 def i18n_next(lang):
     if lang == "zh":
         return "en"
@@ -270,6 +307,8 @@ PUBLIC = [
     tpu_plan_summary,
     plan_form_errors,
     wizard_errors,
+    k8s_minor,
+    upgrade_errors,
     filter_log_lines,
     trace_rows,
     i18n_next,

@@ -229,6 +229,16 @@ class _FunctionEmitter:
                 pairs.append(f"{json.dumps(k.value, ensure_ascii=False)}: {self.expr(v)}")
             return "{" + ", ".join(pairs) + "}"
         if isinstance(node, ast.Subscript):
+            if isinstance(node.slice, ast.Slice):
+                # x[a:b] -> x.slice(a, b): same semantics on strings/arrays
+                # for positive, negative, and omitted bounds (no step)
+                if node.slice.step is not None:
+                    raise _err(node, "slice step unsupported")
+                lo = self.expr(node.slice.lower) if node.slice.lower else "0"
+                if node.slice.upper is None:
+                    return f"{self.expr(node.value)}.slice({lo})"
+                return (f"{self.expr(node.value)}.slice({lo}, "
+                        f"{self.expr(node.slice.upper)})")
             return f"{self.expr(node.value)}[{self.expr(node.slice)}]"
         if isinstance(node, ast.BoolOp):
             op = " && " if isinstance(node.op, ast.And) else " || "

@@ -269,6 +269,9 @@ class TestKoctlTpuDiag:
         monkeypatch.setattr(ops, "verify_ring_all_gather", lambda **kw: True)
         monkeypatch.setattr(ops, "bench_ring_all_gather",
                             lambda **kw: fake(busbw_gbps=4.0))
+        monkeypatch.setattr(ops, "verify_ring_attention", lambda **kw: True)
+        monkeypatch.setattr(ops, "bench_ring_attention",
+                            lambda **kw: fake(tflops=5.0))
 
         assert koctl.main(["tpu", "diag"]) == 0
         report = _json.loads(capsys.readouterr().out)
@@ -277,6 +280,8 @@ class TestKoctlTpuDiag:
         assert report["dma_read"]["gbps"] == 3.0
         assert report["ring_all_gather_correct"] is True
         assert report["pallas_ring"]["busbw_gbps"] == 4.0
+        assert report["ring_attention_correct"] is True
+        assert report["ring_attention"]["tflops"] == 5.0
 
 
 class TestConsoleSurface:

@@ -176,3 +176,47 @@ class TestLongContextWorkload:
         result = run_smoke(sizes_mb=(0.1,), iters=2)
         assert result["ring_attention_correct"] is True
         assert result["ok"] is True
+
+
+class TestBenchScriptMultiDevice:
+    def test_multi_device_branch_wiring(self, capsys, monkeypatch):
+        """bench.py's >=2-device path can never run before the driver has a
+        multi-chip slice, so its wiring is pinned here: simulate a TPU
+        generation on the virtual fleet, stub the heavy sweeps, run the
+        real correctness gates, and check the emitted JSON line."""
+        import importlib.util
+        import json as _json
+        import os
+        from types import SimpleNamespace
+
+        import kubeoperator_tpu.ops.collectives as coll
+        import kubeoperator_tpu.ops.longcontext_check as lcc
+
+        spec = importlib.util.spec_from_file_location(
+            "bench_script",
+            os.path.join(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))), "bench.py"))
+        bench = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(bench)
+
+        monkeypatch.setattr(bench, "_generation_for_device",
+                            lambda dev: "v5e")
+        monkeypatch.setattr(
+            coll, "bench_collective",
+            lambda op, size_mb, mesh, iters: SimpleNamespace(
+                busbw_gbps=70.0 + size_mb))
+        monkeypatch.setattr(
+            lcc, "bench_ring_attention",
+            lambda **kw: SimpleNamespace(to_dict=lambda: {"tflops": 9.9}))
+
+        rc = bench.main()
+        line = capsys.readouterr().out.strip().splitlines()[-1]
+        out = _json.loads(line)
+        assert rc == 0
+        assert out["metric"] == "psum_allreduce_busbw_gbps"
+        assert out["value"] == 134.0                    # best sweep point
+        assert out["vs_baseline"] == round(134.0 / 100.0, 3)
+        d = out["details"]
+        assert d["psum_correct"] is True                # real gate, 8 devs
+        assert d["ring_attention_correct"] is True      # real gate, 8 devs
+        assert d["ring_attention_tflops"] == 9.9

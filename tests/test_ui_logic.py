@@ -151,6 +151,38 @@ class TestWizardForm:
         assert logic.wizard_errors("manual", "c1", "", "h1", "0") == []
 
 
+class TestUpgradeGate:
+    SUPPORTED = ["v1.27.16", "v1.28.15", "v1.29.10", "v1.30.6"]
+
+    def test_one_hop_accepted(self):
+        assert logic.upgrade_errors("v1.28.15", "v1.29.10",
+                                    self.SUPPORTED) == []
+
+    def test_two_hops_and_downgrade_rejected(self):
+        assert logic.upgrade_errors("v1.28.15", "v1.30.6", self.SUPPORTED)
+        assert logic.upgrade_errors("v1.28.15", "v1.27.16", self.SUPPORTED)
+        assert logic.upgrade_errors("v1.28.15", "v1.28.15", self.SUPPORTED)
+
+    def test_unsupported_target_rejected(self):
+        assert logic.upgrade_errors("v1.28.15", "v1.31.0", self.SUPPORTED)
+
+    def test_parity_with_server_validate_hop(self):
+        """Client accepts exactly when UpgradeService.validate_hop does."""
+        from kubeoperator_tpu.service.upgrade import UpgradeService
+
+        svc = UpgradeService.__new__(UpgradeService)  # validate_hop is pure
+        for current in self.SUPPORTED:
+            for target in self.SUPPORTED + ["v1.31.0"]:
+                client_ok = logic.upgrade_errors(
+                    current, target, self.SUPPORTED) == []
+                try:
+                    svc.validate_hop(current, target)
+                    server_ok = True
+                except Exception:
+                    server_ok = False
+                assert client_ok == server_ok, (current, target)
+
+
 class TestViewers:
     def test_log_filter_case_insensitive_and_resettable(self):
         lines = ["TASK [kube-master] ok", "fatal: etcd timeout", "ok: done"]
