@@ -29,7 +29,7 @@ def test_entry_compiles_and_runs():
     fn, args = mod.entry()
     out = jax.jit(fn)(*args)
     host = np.asarray(out, dtype=np.float32)
-    assert host.shape == (mod.SIZE, mod.SIZE)
+    assert host.shape == (mod.BATCH, mod.SEQ, mod.D_MODEL)
     assert np.all(np.isfinite(host))
 
 

@@ -149,3 +149,25 @@ class TestUlysses:
                                 batch_axis="dp", causal=True)
         np.testing.assert_allclose(np.asarray(ring), np.asarray(uly),
                                    rtol=2e-5, atol=2e-5)
+
+
+class TestMultisliceLongContext:
+    def test_ring_attention_across_dcn_axis(self):
+        """Multislice reach: the same ring attention rides the hierarchical
+        multislice mesh — sequence sharded over the (slow) dcn axis while
+        batch shards over an intra-slice ici axis. This is the long-context
+        configuration a 2x v5e-4 multislice JobSet would run."""
+        from kubeoperator_tpu.parallel.mesh import mesh_for_topology
+        from kubeoperator_tpu.parallel.topology import parse_accelerator_type
+
+        topo = parse_accelerator_type("v5e-4", num_slices=2)  # 2 x (2x2)
+        mesh = mesh_for_topology(topo)                        # dcn,ici_0,ici_1
+        q, k, v = make_qkv(seed=8)
+        P = jax.sharding.PartitionSpec
+        spec = P("ici_0", "dcn")
+        qs, ks, vs = (put(mesh, a, spec) for a in (q, k, v))
+        out = ring_attention(qs, ks, vs, mesh, axis_name="dcn",
+                             batch_axis="ici_0", causal=True)
+        want = reference_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
