@@ -12,14 +12,44 @@ from dataclasses import dataclass, field
 from kubeoperator_tpu.models.base import Entity
 from kubeoperator_tpu.utils.errors import ValidationError
 
-# name -> (playbook that installs it, default vars)
+# name -> install playbook, default vars, and teardown data: the uninstall
+# playbook (component-uninstall.yml) consumes "uninstall" as extra-vars —
+# helm releases as [release, namespace] pairs, manifest paths to
+# kubectl-delete, node files to remove, namespaces to remove last. Keeping
+# teardown next to the install definition means a new component can't ship
+# install-only.
 COMPONENT_CATALOG: dict[str, dict] = {
-    "prometheus": {"playbook": "component-prometheus.yml", "vars": {}},
-    "grafana": {"playbook": "component-grafana.yml", "vars": {"tpu_dashboards": True}},
-    "loki": {"playbook": "component-loki.yml", "vars": {}},
-    "metrics-server": {"playbook": "component-metrics-server.yml", "vars": {}},
-    "ingress-nginx": {"playbook": "component-ingress-nginx.yml", "vars": {}},
-    "traefik": {"playbook": "component-traefik.yml", "vars": {}},
+    "prometheus": {
+        "playbook": "component-prometheus.yml", "vars": {},
+        "uninstall": {
+            "helm": [["prometheus", "monitoring"]],
+            "manifests": ["/opt/ko-manifests/tpu-metrics-servicemonitor.yaml"],
+        },
+    },
+    "grafana": {
+        "playbook": "component-grafana.yml",
+        "vars": {"tpu_dashboards": True},
+        "uninstall": {
+            "helm": [["grafana", "monitoring"]],
+            "manifests": ["/opt/ko-manifests/grafana-tpu-dashboards.yaml"],
+        },
+    },
+    "loki": {
+        "playbook": "component-loki.yml", "vars": {},
+        "uninstall": {"helm": [["loki", "monitoring"]]},
+    },
+    "metrics-server": {
+        "playbook": "component-metrics-server.yml", "vars": {},
+        "uninstall": {"manifests": ["/opt/ko-manifests/metrics-server.yaml"]},
+    },
+    "ingress-nginx": {
+        "playbook": "component-ingress-nginx.yml", "vars": {},
+        "uninstall": {"manifests": ["/opt/ko-manifests/ingress-nginx.yaml"]},
+    },
+    "traefik": {
+        "playbook": "component-traefik.yml", "vars": {},
+        "uninstall": {"manifests": ["/opt/ko-manifests/traefik.yaml"]},
+    },
     "nfs-provisioner": {
         "playbook": "component-nfs-provisioner.yml",
         "vars": {"nfs_server": "", "nfs_path": "/export",
@@ -27,19 +57,52 @@ COMPONENT_CATALOG: dict[str, dict] = {
         # empty nfs.server deploys a provisioner that can never bind a PV —
         # fail at install time instead
         "required": ("nfs_server",),
+        # release lives in the install role's `--namespace storage`; the
+        # namespace itself is kept — it may hold PVC-backed user data
+        "uninstall": {"helm": [["nfs-provisioner", "storage"]]},
     },
     "rook-ceph": {
         "playbook": "component-rook-ceph.yml",
         "vars": {"ceph_use_all_devices": True, "ceph_mon_count": 3},
+        "uninstall": {
+            # cluster before operator: the operator must still be running to
+            # finalize the CephCluster deletion
+            "helm": [["rook-ceph-cluster", "rook-ceph"],
+                     ["rook-ceph", "rook-ceph"]],
+        },
     },
-    "istio": {"playbook": "component-istio.yml", "vars": {}},
+    "istio": {
+        "playbook": "component-istio.yml",
+        # mtls_mode: PERMISSIVE (migration) | STRICT (locked mesh);
+        # injection_namespaces: colon-separated list to label for sidecar
+        # injection; ingress gateway optional
+        "vars": {"istio_mtls_mode": "PERMISSIVE",
+                 "istio_ingress_enabled": False,
+                 "istio_injection_namespaces": "default"},
+        # enum-checked at install: a typo'd mode would only explode at
+        # kubectl-apply time on a real cluster (simulation can't catch it)
+        "allowed": {"istio_mtls_mode": ("PERMISSIVE", "STRICT")},
+        "uninstall": {
+            "helm": [["istio-ingressgateway", "istio-system"],
+                     ["istiod", "istio-system"],
+                     ["istio-base", "istio-system"]],
+            "namespaces": ["istio-system"],
+        },
+    },
     "velero": {
         "playbook": "component-velero.yml",
         # velero_* vars resolved from the cluster's BackupAccount at install
         "vars": {"velero_bucket": "velero"},
+        "uninstall": {"helm": [["velero", "velero"]],
+                      "namespaces": ["velero"],
+                      # the 0600 S3 credentials file the install role wrote
+                      "files": ["/etc/kubernetes/addons/velero-credentials"]},
     },
     # The TPU runtime as a re-installable component (also runs as a create
     # phase for TPU plans): device plugin + JobSet controller + smoke job.
+    # Deliberately NO uninstall teardown: removing the device plugin from a
+    # live TPU cluster would strand every TPU workload — the runtime goes
+    # away with the cluster, not by component uninstall.
     "tpu-runtime": {"playbook": "16-tpu-runtime.yml", "vars": {}},
 }
 
@@ -50,7 +113,8 @@ class ClusterComponent(Entity):
     name: str = ""
     version: str = "bundled"
     vars: dict = field(default_factory=dict)
-    status: str = "Pending"    # Pending | Installing | Installed | Failed | Uninstalled
+    status: str = "Pending"    # Pending | Installing | Installed | Failed |
+                               # Uninstalling | UninstallFailed | Uninstalled
     message: str = ""
 
     def validate(self) -> None:

@@ -75,7 +75,7 @@ def bundle_manifest() -> dict:
               "charts/nfs-subdir-external-provisioner.tgz",
               "charts/rook-ceph.tgz", "charts/rook-ceph-cluster.tgz",
               "charts/velero.tgz", "charts/istio-base.tgz",
-              "charts/istiod.tgz"]
+              "charts/istiod.tgz", "charts/istio-gateway.tgz"]
     return {
         "version": __version__,
         "k8s_versions": list(SUPPORTED_K8S_VERSIONS),

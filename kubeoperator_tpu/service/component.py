@@ -88,6 +88,15 @@ class ComponentService:
                 raise ValidationError(
                     f"{component_name} requires var {required!r}"
                 )
+        for var, allowed in COMPONENT_CATALOG.get(component_name, {}).get(
+            "allowed", {}
+        ).items():
+            value = component.vars.get(var)
+            if value is not None and value not in allowed:
+                raise ValidationError(
+                    f"{component_name} var {var!r} must be one of "
+                    f"{sorted(allowed)}, got {value!r}"
+                )
         component.status = "Installing"
         self.repos.components.save(component)
 
@@ -108,13 +117,42 @@ class ComponentService:
         return component
 
     def uninstall(self, cluster_name: str, component_name: str) -> None:
+        """Real teardown, not a status flip: runs component-uninstall.yml
+        with the catalog's declared helm releases / manifests / namespaces
+        (models/component.py "uninstall"). Components without teardown data
+        (tpu-runtime — see catalog rationale) skip straight to the status
+        change."""
         cluster = self.repos.clusters.get_by_name(cluster_name)
         existing = self.repos.components.find(cluster_id=cluster.id,
                                               name=component_name)
         if not existing:
             raise NotFoundError(kind="component", name=component_name)
         component = existing[0]
+        teardown = COMPONENT_CATALOG.get(component_name, {}).get("uninstall")
+        if teardown:
+            component.status = "Uninstalling"
+            self.repos.components.save(component)
+            ctx = self._context(cluster, component)
+            ctx.extra_vars.update({
+                "component_name": component_name,
+                "uninstall_helm": list(teardown.get("helm", [])),
+                "uninstall_manifests": list(teardown.get("manifests", [])),
+                "uninstall_files": list(teardown.get("files", [])),
+                "uninstall_namespaces": list(teardown.get("namespaces", [])),
+            })
+            try:
+                self.adm.run(ctx, [Phase(f"uninstall-{component_name}",
+                                         "component-uninstall.yml")])
+            except PhaseError as e:
+                component.status = "UninstallFailed"
+                component.message = e.message
+                self.repos.components.save(component)
+                self.events.emit(
+                    cluster.id, "Warning", "ComponentUninstallFailed",
+                    f"{component_name} teardown failed: {e.message}")
+                raise
         component.status = "Uninstalled"
+        component.message = ""
         self.repos.components.save(component)
         self.events.emit(cluster.id, "Normal", "ComponentUninstalled",
                          f"{component_name} removed from {cluster_name}")

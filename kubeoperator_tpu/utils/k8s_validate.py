@@ -274,6 +274,29 @@ SCHEMAS: dict[str, dict] = {
         },
         "required": ["apiVersion", "kind", "metadata", "spec"],
     },
+    # istio CRD used by the component-istio role's mesh-wide mTLS policy
+    "PeerAuthentication": {
+        **_TOP,
+        "properties": {
+            **_TOP["properties"],
+            "spec": {
+                "type": "object",
+                "properties": {
+                    "mtls": {
+                        "type": "object",
+                        "properties": {
+                            "mode": {"enum": ["PERMISSIVE", "STRICT",
+                                              "DISABLE", "UNSET"]},
+                        },
+                        "required": ["mode"],
+                    },
+                    "selector": {"type": "object"},
+                },
+                "required": ["mtls"],
+            },
+        },
+        "required": ["apiVersion", "kind", "metadata", "spec"],
+    },
 }
 
 
