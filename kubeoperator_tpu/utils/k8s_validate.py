@@ -274,6 +274,28 @@ SCHEMAS: dict[str, dict] = {
         },
         "required": ["apiVersion", "kind", "metadata", "spec"],
     },
+    # apiserver audit policy (audit.k8s.io): a config FILE kind, not an API
+    # object — no metadata; every rule needs a level
+    "Policy": {
+        "type": "object",
+        "properties": {
+            "apiVersion": {"type": "string", "pattern": "^audit\\.k8s\\.io/"},
+            "kind": {"const": "Policy"},
+            "rules": {
+                "type": "array",
+                "minItems": 1,
+                "items": {
+                    "type": "object",
+                    "properties": {
+                        "level": {"enum": ["None", "Metadata", "Request",
+                                           "RequestResponse"]},
+                    },
+                    "required": ["level"],
+                },
+            },
+        },
+        "required": ["apiVersion", "kind", "rules"],
+    },
     # istio CRD used by the component-istio role's mesh-wide mTLS policy
     "PeerAuthentication": {
         **_TOP,

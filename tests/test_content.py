@@ -227,3 +227,58 @@ def test_simulated_smoke_threshold_fails_cluster():
     with pytest.raises(PhaseError):
         ClusterAdm(ex).run(ctx, create_phases())
     assert not ctx.cluster.status.smoke_passed
+
+
+def test_apiserver_hardening_wired_end_to_end():
+    """Encryption-at-rest + audit logging (CIS 1.2.x family): the pki role
+    must generate AND distribute the encryption config (every HA apiserver
+    needs the same key), and the kubeadm template must point the apiserver
+    at both files with profiling disabled across the control plane."""
+    pki = open(os.path.join(CONTENT, "roles/pki/tasks/main.yml"),
+               encoding="utf-8").read()
+    assert "encryption-config.yaml" in pki
+    assert "secretbox" in pki
+    docs = yaml.safe_load(pki)
+    fetch = [t for t in docs if "fetch" in str(t.get("name", "")).lower()
+             and "trust material" in t.get("name", "")]
+    dist = [t for t in docs if str(t.get("name", "")).startswith(
+        "distribute shared CAs")]
+    assert any("encryption-config.yaml" in t["loop"] for t in fetch)
+    assert any("encryption-config.yaml" in t["loop"] for t in dist)
+
+    tpl = open(os.path.join(
+        CONTENT, "roles/kube-master/templates/kubeadm-config.yaml.j2"),
+        encoding="utf-8").read()
+    for needle in ("encryption-provider-config", "audit-policy-file",
+                   "audit-log-path"):
+        assert needle in tpl, f"kubeadm config missing {needle}"
+    assert tpl.count('profiling: "false"') == 3  # apiserver + cm + scheduler
+
+    tasks = open(os.path.join(CONTENT, "roles/kube-master/tasks/main.yml"),
+                 encoding="utf-8").read()
+    # policy must be laid down before init/join renders the static pods
+    assert tasks.index("render apiserver audit policy") \
+        < tasks.index("kubeadm init on bootstrap master")
+
+
+def test_audit_policy_never_logs_secret_bodies():
+    """The audit policy may record secrets access at Metadata level only —
+    a Request/RequestResponse rule matching secrets would write secret
+    payloads into the audit log."""
+    import jinja2
+
+    path = os.path.join(
+        CONTENT, "roles/kube-master/templates/audit-policy.yaml.j2")
+    doc = yaml.safe_load(
+        jinja2.Environment(undefined=jinja2.StrictUndefined)
+        .from_string(open(path, encoding="utf-8").read()).render())
+    for rule in doc["rules"]:
+        touches_secrets = any(
+            "secrets" in r.get("resources", [])
+            for r in rule.get("resources", [])
+        )
+        if touches_secrets:
+            assert rule["level"] in ("None", "Metadata"), rule
+        if rule["level"] in ("Request", "RequestResponse"):
+            # body-recording rules must name no secret-bearing resource
+            assert not touches_secrets
