@@ -152,6 +152,13 @@ class LocalClient:
             case ("POST", ["clusters", name, "components"]):
                 return pub(s.components.install(name, body["component"],
                                                 body.get("vars")))
+            case ("GET", ["clusters", name, "components"]):
+                return pub(s.components.list(name))
+            case ("DELETE", ["clusters", name, "components", comp]):
+                s.components.uninstall(name, comp)
+                return {"ok": True}
+            case ("GET", ["components-catalog"]):
+                return s.components.catalog()
             case ("GET", ["plans"]):
                 return pub(s.plans.list())
             case ("POST", ["plans"]):
@@ -321,6 +328,33 @@ def cmd_cluster(client, args) -> int:
     raise SystemExit(f"unknown cluster command {args.cluster_cmd}")
 
 
+def cmd_component(client, args) -> int:
+    """Day-2 addon verbs (SURVEY §2.1 row 9): catalog / list / install /
+    uninstall against one cluster, mirroring the console's component
+    panel."""
+    if args.component_cmd == "catalog":
+        _print(client.call("GET", "/api/v1/components-catalog"))
+        return 0
+    if args.component_cmd == "list":
+        _print(client.call(
+            "GET", f"/api/v1/clusters/{args.cluster}/components"))
+        return 0
+    if args.component_cmd == "install":
+        body: dict = {"component": args.name}
+        if args.vars:
+            body["vars"] = json.loads(args.vars)
+        _print(client.call(
+            "POST", f"/api/v1/clusters/{args.cluster}/components", body))
+        return 0
+    if args.component_cmd == "uninstall":
+        client.call(
+            "DELETE",
+            f"/api/v1/clusters/{args.cluster}/components/{args.name}")
+        print(f"{args.name} uninstalled from {args.cluster}")
+        return 0
+    raise SystemExit(f"unknown component command {args.component_cmd}")
+
+
 def cmd_apply(client, args) -> int:
     """Declarative setup: apply a YAML of credentials/regions/zones/plans/
     hosts/backup-accounts (koctl's bulk bootstrap; no upstream analog but
@@ -470,6 +504,20 @@ def build_parser() -> argparse.ArgumentParser:
     restore.add_argument("name")
     restore.add_argument("--file", required=True)
 
+    component = sub.add_parser("component", help="cluster addon verbs")
+    compsub = component.add_subparsers(dest="component_cmd", required=True)
+    compsub.add_parser("catalog")
+    comp_list = compsub.add_parser("list")
+    comp_list.add_argument("cluster")
+    comp_install = compsub.add_parser("install")
+    comp_install.add_argument("cluster")
+    comp_install.add_argument("name")
+    comp_install.add_argument("--vars", default="",
+                              help='JSON vars, e.g. \'{"istio_mtls_mode": "STRICT"}\'')
+    comp_un = compsub.add_parser("uninstall")
+    comp_un.add_argument("cluster")
+    comp_un.add_argument("name")
+
     apply_p = sub.add_parser("apply", help="apply a setup YAML")
     apply_p.add_argument("-f", "--file", required=True)
 
@@ -549,6 +597,8 @@ def main(argv: list[str] | None = None) -> int:
         return 0
     if args.cmd == "cluster":
         return cmd_cluster(client, args)
+    if args.cmd == "component":
+        return cmd_component(client, args)
     if args.cmd == "apply":
         return cmd_apply(client, args)
     if args.cmd == "tpu":

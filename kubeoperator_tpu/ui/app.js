@@ -357,10 +357,17 @@ async function openCluster(name) {
       await api("DELETE", `/api/v1/clusters/${name}/nodes/${b.dataset.rmNode}`);
       openCluster(name);
     }));
-  $("#d-comp-install").addEventListener("click", async () => {
-    await api("POST", `/api/v1/clusters/${name}/components`,
-              { component: $("#d-comp-select").value });
-    openCluster(name);
+  $("#d-comp-install").addEventListener("click", () => {
+    const comp = $("#d-comp-select").value;
+    const defaults = catalog[comp]?.vars || {};
+    objDialog("install", [
+      { key: "vars", label: `${comp} vars (JSON)`, json: true,
+        value: JSON.stringify(defaults) },
+    ], async (out) => {
+      await api("POST", `/api/v1/clusters/${name}/components`,
+                { component: comp, vars: out.vars });
+      openCluster(name);
+    });
   });
   detail.querySelectorAll("[data-un-comp]").forEach((b) =>
     b.addEventListener("click", async () => {

@@ -245,6 +245,54 @@ class TestKoctlLocal:
         assert "psum" in out and "16 chips" in out
 
 
+    def test_component_verbs_local(self, capsys, monkeypatch, tmp_path):
+        """koctl component catalog/install/list/uninstall over the local
+        transport — the CLI face of the day-2 addon surface incl. the real
+        teardown path."""
+        import json as _json
+
+        from kubeoperator_tpu.cli import koctl
+
+        monkeypatch.setenv("KO_TPU_DB__PATH", str(tmp_path / "cli3.db"))
+        monkeypatch.setenv("KO_TPU_EXECUTOR__BACKEND", "simulation")
+        monkeypatch.setenv("KO_TPU_PROVISIONER__WORK_DIR", str(tmp_path / "tf"))
+
+        setup = tmp_path / "setup.yaml"
+        setup.write_text(
+            "credentials:\n"
+            "  - {name: ssh, password: pw}\n"
+            "hosts:\n"
+            "  - {name: h1, ip: 10.0.0.1, credential: ssh}\n"
+            "  - {name: h2, ip: 10.0.0.2, credential: ssh}\n"
+        )
+        assert koctl.main(["--local", "apply", "-f", str(setup)]) == 0
+        assert koctl.main([
+            "--local", "cluster", "create", "c1", "--hosts", "h1,h2",
+            "--credential", "ssh", "--workers", "1", "--timeout", "60",
+        ]) == 0
+        capsys.readouterr()
+
+        assert koctl.main(["--local", "component", "catalog"]) == 0
+        assert "istio" in capsys.readouterr().out
+
+        assert koctl.main([
+            "--local", "component", "install", "c1", "istio",
+            "--vars", '{"istio_mtls_mode": "STRICT"}',
+        ]) == 0
+        out = _json.loads(capsys.readouterr().out)
+        assert out["status"] == "Installed"
+        assert out["vars"]["istio_mtls_mode"] == "STRICT"
+
+        assert koctl.main(["--local", "component", "list", "c1"]) == 0
+        assert "Installed" in capsys.readouterr().out
+
+        assert koctl.main(
+            ["--local", "component", "uninstall", "c1", "istio"]) == 0
+        assert "uninstalled" in capsys.readouterr().out
+        assert koctl.main(["--local", "component", "list", "c1"]) == 0
+        assert "Uninstalled" in capsys.readouterr().out
+
+
 class TestKoctlTpuDiag:
     def test_diag_reports_all_families(self, capsys, monkeypatch):
         """Wiring check: heavy benches stubbed, JSON covers every family
