@@ -50,6 +50,7 @@ const I18N = {
     phase_timings: "Phase timings", follow: "Follow",
     filter_logs: "filter logs…", total: "total",
     num_slices: "Slices", slice_topology: "ICI topology (e.g. 4x4)",
+    filter_events: "filter activity…", findings: "Findings",
   },
   zh: {
     sign_in: "登录", clusters: "集群", hosts: "主机", infra: "基础设施",
@@ -78,6 +79,7 @@ const I18N = {
     phase_timings: "阶段耗时", follow: "跟随",
     filter_logs: "过滤日志…", total: "总计",
     num_slices: "切片数", slice_topology: "ICI 拓扑（如 4x4）",
+    filter_events: "过滤操作记录…", findings: "检查发现",
   },
 };
 let lang = localStorage.getItem("ko-lang") || "en";
@@ -87,6 +89,9 @@ function applyI18n() {
   document.documentElement.lang = lang === "zh" ? "zh-CN" : "en";
   document.querySelectorAll("[data-i18n]").forEach((el) => {
     el.textContent = t(el.dataset.i18n);
+  });
+  document.querySelectorAll("[data-i18n-ph]").forEach((el) => {
+    el.placeholder = t(el.dataset.i18nPh);
   });
   $("#lang-toggle").textContent = lang === "zh" ? "EN" : "中文";
 }
@@ -289,10 +294,12 @@ async function openCluster(name) {
     <div class="row"><button id="d-backup-now">${t("backup_now")}</button></div>
 
     <h3>${t("security")}</h3>
-    <table class="grid"><tr><th>scan</th><th>status</th><th>pass</th><th>fail</th><th>warn</th></tr>
-    ${scans.map((s) => `<tr><td>${esc(s.id || s.name)}</td><td>${s.status}</td>
-      <td>${s.passed ?? ""}</td><td>${s.failed ?? ""}</td><td>${s.warned ?? ""}</td></tr>`).join("")}
+    <table class="grid"><tr><th>scan</th><th>status</th><th>pass</th><th>fail</th><th>warn</th><th></th></tr>
+    ${scans.map((s, i) => `<tr><td>${esc(s.policy || s.id || s.name)}</td><td>${s.status}</td>
+      <td>${s.total_pass ?? s.passed ?? ""}</td><td>${s.total_fail ?? s.failed ?? ""}</td><td>${s.total_warn ?? s.warned ?? ""}</td>
+      <td>${(s.checks || []).length ? `<button data-cis-findings="${i}" class="ghost">${t("findings")}</button>` : ""}</td></tr>`).join("")}
     </table>
+    <div id="d-cis-findings" hidden></div>
     <div class="row"><button id="d-cis-run">${t("run_scan")}</button></div>
 
     ${me?.is_admin ? `
@@ -388,6 +395,21 @@ async function openCluster(name) {
     await api("POST", `/api/v1/clusters/${name}/cis-scans`, {});
     openCluster(name);
   });
+  // kube-bench findings drill-down: each non-passing check with its
+  // remediation, the detail the counts row can't convey
+  detail.querySelectorAll("[data-cis-findings]").forEach((b) =>
+    b.addEventListener("click", () => {
+      const scan = scans[parseInt(b.dataset.cisFindings, 10)];
+      const box = $("#d-cis-findings");
+      box.hidden = false;
+      box.innerHTML = `<table class="grid">
+        <tr><th>check</th><th>status</th><th>node</th><th>finding</th><th>remediation</th></tr>
+        ${(scan.checks || []).map((c) => `<tr>
+          <td>${esc(c.id)}</td><td class="${c.status === "FAIL" ? "cis-fail" : "cis-warn"}">${esc(c.status)}</td>
+          <td>${esc(c.node || "—")}</td><td>${esc(c.text)}</td>
+          <td class="muted">${esc(c.remediation || "")}</td></tr>`).join("")}
+      </table>`;
+    }));
   if (me?.is_admin) {
     $("#d-term-open").addEventListener("click", async () => {
       $("#d-term-open").disabled = true;  // one session per detail view
@@ -716,6 +738,15 @@ async function refreshAdmin() {
   ).join("") || `<div class="muted">${t("no_activity")}</div>`;
 }
 
+let eventCache = [];
+function renderEvents() {
+  const shown = KOLogic.filter_events(eventCache, $("#event-filter").value);
+  $("#event-feed").innerHTML = shown.map((e) =>
+    `<div class="feed-item ${e.type}"><span class="when">${new Date(e.created_at * 1000).toLocaleString()}</span>
+     <b>${esc(e.cluster)}</b> [${esc(e.reason)}] ${esc(e.message)}</div>`).join("") ||
+    `<div class="muted">${t("no_activity")}</div>`;
+}
+$("#event-filter").addEventListener("input", renderEvents);
 async function refreshEvents() {
   const clusters = await api("GET", "/api/v1/clusters").catch(() => []);
   const feeds = [];
@@ -724,10 +755,8 @@ async function refreshEvents() {
     events.forEach((e) => feeds.push({ ...e, cluster: c.name }));
   }
   feeds.sort((a, b) => b.created_at - a.created_at);
-  $("#event-feed").innerHTML = feeds.map((e) =>
-    `<div class="feed-item ${e.type}"><span class="when">${new Date(e.created_at * 1000).toLocaleString()}</span>
-     <b>${esc(e.cluster)}</b> [${esc(e.reason)}] ${esc(e.message)}</div>`).join("") ||
-    `<div class="muted">${t("no_activity")}</div>`;
+  eventCache = feeds;
+  renderEvents();
 }
 
 boot();

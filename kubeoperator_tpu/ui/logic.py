@@ -219,6 +219,23 @@ def filter_log_lines(lines, query):
     return out
 
 
+def filter_events(events, query):
+    """Activity-feed filter: case-insensitive substring across cluster,
+    reason, message and type — same reset semantics as the log filter."""
+    q = str(query).strip().lower()
+    if q == "":
+        return events
+    out = []
+    for e in events:
+        hay = str(jsrt.get(e, "cluster", "")) + " " \
+            + str(jsrt.get(e, "reason", "")) + " " \
+            + str(jsrt.get(e, "message", "")) + " " \
+            + str(jsrt.get(e, "type", ""))
+        if jsrt.contains(hay.lower(), q):
+            out.append(e)
+    return out
+
+
 def trace_rows(trace):
     """/clusters/{name}/trace -> renderable per-phase duration rows with
     percent widths for the pipeline bar chart (SURVEY §5.1 spans)."""
@@ -310,6 +327,7 @@ PUBLIC = [
     k8s_minor,
     upgrade_errors,
     filter_log_lines,
+    filter_events,
     trace_rows,
     i18n_next,
     i18n_get,

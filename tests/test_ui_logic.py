@@ -202,6 +202,19 @@ class TestViewers:
         assert pcts == [66.67, 33.33, 0]
         assert out["rows"][2]["duration_s"] is None
 
+    def test_filter_events(self):
+        events = [
+            {"cluster": "prod", "reason": "ClusterReady", "message": "ok",
+             "type": "Normal"},
+            {"cluster": "dev", "reason": "SmokeFailed",
+             "message": "psum below threshold", "type": "Warning"},
+        ]
+        assert logic.filter_events(events, "PSUM") == [events[1]]
+        assert logic.filter_events(events, "prod") == [events[0]]
+        assert logic.filter_events(events, "warning") == [events[1]]
+        assert logic.filter_events(events, "  ") == events
+        assert logic.filter_events(events, "nope") == []
+
     def test_trace_rows_empty(self):
         assert logic.trace_rows({"spans": []})["rows"] == []
 
