@@ -78,3 +78,73 @@ def test_two_process_psum_over_hostenv_contract():
 
     # psum over 4 global devices: 2 hold 1.0 (rank 0), 2 hold 2.0 (rank 1)
     assert results == [6.0, 6.0]
+
+
+# Ring attention with the sequence axis SPANNING the process boundary: each
+# of the two processes holds half the devices of a 4-way "sp" mesh, so two
+# of the ppermute hops cross processes — exactly the multi-host JobSet
+# long-context configuration (parallel/longcontext.py over DCN/ICI).
+RING_WORKER = """
+import os
+from kubeoperator_tpu.parallel.multislice import initialize_from_env
+initialize_from_env()
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+from kubeoperator_tpu.parallel.longcontext import (
+    reference_attention, ring_attention)
+from kubeoperator_tpu.parallel.mesh import build_mesh
+
+assert jax.device_count() == 4, jax.device_count()
+mesh = build_mesh(("sp",), (4,), jax.devices())
+b, s, h, d = 2, 32, 4, 8
+rng = np.random.default_rng(0)          # same seed in both processes
+q_h, k_h, v_h = (rng.standard_normal((b, s, h, d)).astype(np.float32)
+                 for _ in range(3))
+spec = P(None, "sp", None, None)
+def put_global(a):
+    # multi-process device_put: assemble the global array from the
+    # per-process local shards (jax.make_array_from_callback)
+    sharding = NamedSharding(mesh, spec)
+    return jax.make_array_from_callback(
+        a.shape, sharding, lambda idx: a[idx])
+q, k, v = (put_global(a) for a in (q_h, k_h, v_h))
+out = ring_attention(q, k, v, mesh, causal=True)
+# every process checks its addressable shards against the local slice of
+# the single-device reference
+want = np.asarray(reference_attention(q_h, k_h, v_h, causal=True))
+ok = True
+for shard in out.addressable_shards:
+    got = np.asarray(shard.data)
+    exp = want[shard.index]
+    if not np.allclose(got, exp, rtol=2e-5, atol=2e-5):
+        ok = False
+print("RING_RESULT", "OK" if ok else "MISMATCH", flush=True)
+"""
+
+
+def test_two_process_ring_attention():
+    topo = parse_accelerator_type("v5p-16")  # 2 hosts
+    envs = host_envs(topo, "127.0.0.1", port=_free_port())
+    procs = []
+    for henv in envs:
+        env = {
+            k: v for k, v in os.environ.items()
+            if not k.startswith(("PALLAS_AXON", "AXON_", "TPU_", "MEGASCALE"))
+        }
+        env.update(henv.to_env())
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", RING_WORKER], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        ))
+    results = []
+    for p in procs:
+        out, err = p.communicate(timeout=240)
+        assert p.returncode == 0, f"ring worker failed:\n{err[-3000:]}"
+        for line in out.splitlines():
+            if line.startswith("RING_RESULT"):
+                results.append(line.split()[1])
+    assert results == ["OK", "OK"]
