@@ -51,6 +51,7 @@ const I18N = {
     filter_logs: "filter logs…", total: "total",
     num_slices: "Slices", slice_topology: "ICI topology (e.g. 4x4)",
     filter_events: "filter activity…", findings: "Findings",
+    kubeconfig: "Kubeconfig", details: "Details",
   },
   zh: {
     sign_in: "登录", clusters: "集群", hosts: "主机", infra: "基础设施",
@@ -80,6 +81,7 @@ const I18N = {
     filter_logs: "过滤日志…", total: "总计",
     num_slices: "切片数", slice_topology: "ICI 拓扑（如 4x4）",
     filter_events: "过滤操作记录…", findings: "检查发现",
+    kubeconfig: "Kubeconfig", details: "详情",
   },
 };
 let lang = localStorage.getItem("ko-lang") || "en";
@@ -257,6 +259,7 @@ async function openCluster(name) {
         <button id="d-retry">${t("retry")}</button>
         <button id="d-health">${t("health")}</button>
         <button id="d-upgrade">${t("upgrade")}</button>
+        ${me?.is_admin ? `<button id="d-kubeconfig">${t("kubeconfig")}</button>` : ""}
         <button id="d-back">${t("back")}</button>
       </div>
     </div>
@@ -338,6 +341,20 @@ async function openCluster(name) {
     await api("POST", `/api/v1/clusters/${name}/retry`);
     openCluster(name);
   });
+  if (me?.is_admin) {
+    $("#d-kubeconfig").addEventListener("click", async () => {
+      // admin-only (server enforces): fetch and save as a file download
+      const resp = await fetch(`/api/v1/clusters/${name}/kubeconfig`,
+                               { credentials: "same-origin" });
+      if (!resp.ok) { alert((await resp.json()).message || resp.statusText); return; }
+      const blob = await resp.blob();
+      const a = document.createElement("a");
+      a.href = URL.createObjectURL(blob);
+      a.download = `${name}.kubeconfig`;
+      a.click();
+      URL.revokeObjectURL(a.href);
+    });
+  }
   $("#d-health").addEventListener("click", async () => {
     const h = await api("GET", `/api/v1/clusters/${name}/health`);
     $("#d-health-out").innerHTML = '<div class="conds">' + h.probes.map((p) =>
@@ -680,9 +697,21 @@ async function refreshAll() {
   if (!$("#tab-hosts").hidden) {
     const hosts = await api("GET", "/api/v1/hosts").catch(() => []);
     $("#hosts-table").innerHTML =
-      "<tr><th>name</th><th>ip</th><th>status</th><th>TPU</th></tr>" +
-      hosts.map((h) => `<tr><td>${esc(h.name)}</td><td>${esc(h.ip)}</td><td>${h.status}</td>
-        <td>${h.tpu_chips > 0 ? `${h.tpu_chips} chips · slice ${h.tpu_slice_id} · worker ${h.tpu_worker_id}` : "—"}</td></tr>`).join("");
+      "<tr><th>name</th><th>ip</th><th>status</th><th>TPU</th><th></th></tr>" +
+      hosts.map((h, i) => `<tr><td>${esc(h.name)}</td><td>${esc(h.ip)}</td><td>${h.status}</td>
+        <td>${h.tpu_chips > 0 ? `${h.tpu_chips} chips · slice ${h.tpu_slice_id} · worker ${h.tpu_worker_id}` : "—"}</td>
+        <td><button data-host-detail="${i}" class="ghost">${t("details")}</button></td></tr>` +
+        `<tr class="host-detail" id="host-detail-${i}" hidden><td colspan="5">
+          <div class="muted">
+            os ${esc(h.os || "?")} · arch ${esc(h.arch || "?")} ·
+            ${h.cpu_cores || "?"} cores · ${h.memory_mb ? (h.memory_mb / 1024).toFixed(1) + " GiB" : "?"}
+            · ssh ${esc(h.ip)}:${h.port} · cluster ${esc(h.cluster_id ? "bound" : "free")}
+          </div></td></tr>`).join("");
+    document.querySelectorAll("[data-host-detail]").forEach((b) =>
+      b.addEventListener("click", () => {
+        const row = $("#host-detail-" + b.dataset.hostDetail);
+        row.hidden = !row.hidden;
+      }));
   }
   if (!$("#tab-infra").hidden) refreshInfra();
   if (!$("#tab-backups").hidden) {
