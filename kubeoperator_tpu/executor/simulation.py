@@ -118,6 +118,46 @@ class SimulationExecutor(Executor):
         return rendered != "1"
 
     @staticmethod
+    def _resolve_loop(task: dict, context: dict, warn=None):
+        """Resolve `loop:`/`with_items:` to its items so the stream shows
+        the per-item `ok: [h] => (item=...)` lines real ansible emits —
+        content tests can then assert that a templated loop (e.g. istio's
+        namespace split) actually expands to the expected items."""
+        raw = task.get("loop", task.get("with_items"))
+        if raw is None:
+            return None
+        if isinstance(raw, list):
+            out = []
+            for item in raw:
+                if isinstance(item, str) and "{{" in item:
+                    try:
+                        out.append(
+                            _jinja_env().from_string(item).render(**context))
+                    except jinja2.TemplateError:
+                        out.append(item)
+                else:
+                    out.append(item)
+            return out
+        text = str(raw).strip()
+        if text.startswith("{{") and text.endswith("}}"):
+            try:
+                value = _jinja_env().compile_expression(
+                    text[2:-2], undefined_to_none=False)(**context)
+            except Exception as e:
+                if warn is not None:
+                    warn(f"[WARNING]: unresolvable loop: {raw!r} on task "
+                         f"{task.get('name', 'unnamed')!r}: {e}")
+                return [raw]
+            if isinstance(value, (list, tuple)):
+                return list(value)
+            if value is None or isinstance(value, jinja2.Undefined):
+                # registered-var loops the simulation can't know: keep the
+                # task visible as a single opaque iteration
+                return [raw]
+            return [value]
+        return [raw]
+
+    @staticmethod
     def _materialize_fetch(task: dict, context: dict) -> None:
         """`ansible.builtin.fetch` pulls a node file back to the platform —
         the one content side effect the platform itself consumes (the post
@@ -232,11 +272,19 @@ class SimulationExecutor(Executor):
                 debug_msg = self._render_debug(task, host_ctxs[active[0]])
                 if debug_msg is not None:
                     state.emit(debug_msg)
+                loop_items = self._resolve_loop(
+                    task, host_ctxs[active[0]], _warn_once)
                 for h in active:
                     if fail_at and fail_at in tname:
                         state.emit(f"fatal: [{h}]: FAILED! => simulated failure")
                         stats[h].failed += 1
                         failed = True
+                    elif loop_items is not None:
+                        # real-ansible shape; recap still counts the task
+                        # once per host, matching ansible's play recap
+                        for item in loop_items:
+                            state.emit(f"ok: [{h}] => (item={item})")
+                        stats[h].ok += 1
                     else:
                         state.emit(f"ok: [{h}]")
                         stats[h].ok += 1

@@ -261,3 +261,47 @@ def test_make_executor_auto_backend_selection(monkeypatch):
     assert isinstance(make_executor("auto"), AnsibleExecutor)
     with pytest.raises(ValueError):
         make_executor("bogus")
+
+
+class TestSimulationLoops:
+    """`loop:` fidelity: templated loops expand to real-ansible-style
+    per-item lines, so a loop over the wrong variable is visible in tests
+    instead of hiding behind a single `ok:` line."""
+
+    def test_loop_items_rendered(self, tmp_path):
+        from kubeoperator_tpu.executor.base import TaskSpec
+        from kubeoperator_tpu.executor.simulation import SimulationExecutor
+
+        proj = tmp_path / "proj"
+        (proj / "playbooks").mkdir(parents=True)
+        (proj / "playbooks" / "loopy.yml").write_text(
+            "- name: loopy\n"
+            "  hosts: all\n"
+            "  tasks:\n"
+            "    - name: literal loop\n"
+            "      ansible.builtin.command: echo {{ item }}\n"
+            "      loop: [alpha, beta]\n"
+            "    - name: templated loop\n"
+            "      ansible.builtin.command: touch {{ item }}\n"
+            "      loop: \"{{ (namespaces | default('default')).split(':') }}\"\n"
+            "    - name: unresolvable loop\n"
+            "      ansible.builtin.command: echo {{ item }}\n"
+            "      loop: \"{{ totally_unknown_registered.results }}\"\n"
+        )
+        ex = SimulationExecutor(project_dir=str(proj))
+        task_id = ex.run(TaskSpec(
+            playbook="loopy.yml",
+            inventory={"all": {"hosts": {"h1": {}}}},
+            extra_vars={"namespaces": "default:payments"},
+        ))
+        result = ex.wait(task_id, timeout_s=30)
+        lines = "\n".join(ex.watch(task_id, timeout_s=5))
+        assert result.status == "Success"
+        assert "ok: [h1] => (item=alpha)" in lines
+        assert "ok: [h1] => (item=beta)" in lines
+        assert "ok: [h1] => (item=default)" in lines
+        assert "ok: [h1] => (item=payments)" in lines
+        # registered-var loops stay visible as one opaque iteration
+        assert "(item={{ totally_unknown_registered.results }})" in lines
+        # recap counts tasks once per host, like ansible
+        assert "h1 : ok=3" in lines

@@ -430,8 +430,11 @@ class TestComponents:
         # through the vars contract into the role's `when:` (the default
         # install below proves the negative)
         assert "TASK [install ingress gateway via bundled chart]" in joined
-        assert "TASK [label namespaces for sidecar injection]" in joined
         assert "TASK [apply mesh-wide mTLS policy]" in joined
+        # the colon-separated var expands through the role's split(':')
+        # loop — per-item lines prove it, not just task presence
+        assert "(item=default)" in joined
+        assert "(item=payments)" in joined
 
     def test_istio_mtls_mode_enum_checked_at_install(self, svc):
         names = register_fleet(svc, 2)
