@@ -398,6 +398,12 @@ def cmd_tpu(client, args) -> int:
         return 0
     if args.tpu_cmd == "diag":
         return cmd_tpu_diag(args)
+    if args.tpu_cmd == "train-smoke":
+        from kubeoperator_tpu.ops import run_train_smoke
+
+        result = run_train_smoke(steps=args.steps)
+        print(json.dumps(result, indent=2))
+        return 0 if result["ok"] else 1
     raise SystemExit(f"unknown tpu command {args.tpu_cmd}")
 
 
@@ -524,6 +530,11 @@ def build_parser() -> argparse.ArgumentParser:
     tpu = sub.add_parser("tpu")
     tsub = tpu.add_subparsers(dest="tpu_cmd", required=True)
     tsub.add_parser("catalog")
+    train_p = tsub.add_parser(
+        "train-smoke",
+        help="run a few sharded training steps of the validation net",
+    )
+    train_p.add_argument("--steps", type=int, default=4)
     diag_p = tsub.add_parser(
         "diag", help="local-device diagnostics (MXU/HBM/DMA/ICI)"
     )

@@ -16,6 +16,8 @@ exercise the same hardware axes TPU-natively:
                   across the full slice, emitting KO_TPU_SMOKE_RESULT
   longcontext_check.py  ring-attention exactness + throughput over the ICI
                   ring (the long-context path of parallel/longcontext.py)
+  train_smoke.py  a few real sharded training steps of the validation net
+                  (parallel/validation_net.py) — loss must descend
 
 Everything here runs on CPU meshes for CI (virtual devices) and on real TPU
 for the metric runs; no NCCL/MPI anywhere [BASELINE].
@@ -39,6 +41,7 @@ from kubeoperator_tpu.ops.longcontext_check import (
     bench_ring_attention,
     verify_ring_attention,
 )
+from kubeoperator_tpu.ops.train_smoke import run_train_smoke
 
 __all__ = [
     "CollectiveResult",
@@ -53,4 +56,5 @@ __all__ = [
     "RingAttentionResult",
     "bench_ring_attention",
     "verify_ring_attention",
+    "run_train_smoke",
 ]

@@ -1,0 +1,69 @@
+"""Training smoke: a few REAL sharded training steps as a slice health
+workload.
+
+The psum smoke proves the interconnect moves bytes; this proves the whole
+TPU training loop — MXU matmuls, ring-attention collectives, MoE
+all-to-all, pipeline ppermute, backward pass, remat, SGD update —
+compiles and RUNS on the actual slice, using the same validation net the
+driver's multichip dryrun exercises (parallel/validation_net.py). Pass
+criteria: every loss finite, and the loss after the last step is below
+the first (a tiny net on a fixed batch must descend; a slice with a sick
+chip or a miswired ICI ring either diverges, NaNs, or hangs).
+
+Emits the same one-line machine contract style as psum_smoke:
+
+    KO_TPU_TRAIN_RESULT {"ok": true, "losses": [...], "steps_per_s": ...}
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+from kubeoperator_tpu.parallel.multislice import initialize_from_env
+
+
+def run_train_smoke(steps: int = 4, devices=None) -> dict:
+    import jax
+
+    from kubeoperator_tpu.parallel import validation_net as vnet
+
+    devices = list(devices) if devices is not None else list(jax.devices())
+    mesh = vnet.build_mesh_for(devices)
+    params, x, _ = vnet.build_params_and_batch(mesh)
+    train_step = vnet.make_train_step(mesh)
+
+    # compile outside the timed window
+    loss, params = train_step(params, x)
+    losses = [float(jax.device_get(loss))]
+    t0 = time.perf_counter()
+    for _ in range(max(steps - 1, 1)):
+        loss, params = train_step(params, x)
+        losses.append(float(jax.device_get(loss)))
+    dt = time.perf_counter() - t0
+
+    finite = all(l == l and abs(l) != float("inf") for l in losses)
+    ok = finite and losses[-1] < losses[0]
+    return {
+        "ok": ok,
+        "finite": finite,
+        "descending": losses[-1] < losses[0],
+        "losses": [round(l, 6) for l in losses],
+        "steps_per_s": round((len(losses) - 1) / dt, 3) if dt > 0 else 0.0,
+        "devices": len(devices),
+        "mesh": {a: int(mesh.shape[a]) for a in mesh.axis_names},
+    }
+
+
+def main() -> int:
+    """Job/JobSet entrypoint (mirrors psum_smoke.main): bootstrap
+    jax.distributed from the env contract, run, emit the marker line."""
+    initialize_from_env()
+    result = run_train_smoke()
+    print("KO_TPU_TRAIN_RESULT " + json.dumps(result), flush=True)
+    return 0 if result["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

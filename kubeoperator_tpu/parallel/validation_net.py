@@ -1,0 +1,196 @@
+"""The flagship validation net: a tiny transformer trained with EVERY
+parallelism family the framework owns, as a library component.
+
+One model, three consumers:
+* ``__graft_entry__.dryrun_multichip`` — the driver's multi-chip compile
+  gate (virtual CPU fleet);
+* ``ops/train_smoke.py`` — the slice health workload: a few real training
+  steps on hardware, loss must be finite and decreasing;
+* tests — shape/loss invariants on the 8-device virtual mesh.
+
+Parallelism map over a (dp, pp, sp, tp) mesh:
+  dp — batch data-parallel (loss psum across dp)
+  pp — circular pipeline: pp ranks own microbatch streams whose
+       activations hop stages via a ppermute ring schedule
+  sp — sequence parallel: exact causal ring attention
+       (parallel/longcontext.py), plus MoE expert-parallel token routing
+       via all_to_all over the same axis (ep)
+  tp — Megatron-style tensor-parallel FFN (partial matmuls + psum)
+Stages run under ``jax.checkpoint`` so rematerialisation is validated
+under grad (the standard HBM-for-FLOPs trade on TPU).
+
+Everything is backend-hermetic by construction: inputs/params are built
+in numpy and ``device_put`` straight onto the caller's mesh, so no op
+ever lands on a default backend the caller didn't choose.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+# model dims: (8,128)-friendly, and every sharded dim divides any
+# power-of-two axis size up to 8 (see axis_sizes)
+D_MODEL, D_FF, HEADS = 64, 128, 8
+B_LOCAL, S_LOCAL = 2, 16
+
+
+def axis_sizes(n_devices: int) -> tuple[int, int, int, int]:
+    """Factor n into (dp, pp, sp, tp). tp shards d_ff and sp shards
+    seq/experts, so those two axes only take powers of two (capped at 8 —
+    the model dims divide any such size); pp stacks a per-stage leading dim
+    and dp shards batch, so they absorb everything else, odd factors
+    included. 8 -> (1,2,2,2), 16 -> (2,2,2,2), 12 -> (3,1,2,2)."""
+    twos = 0
+    m = n_devices
+    while m % 2 == 0:
+        twos += 1
+        m //= 2
+    sizes = {"tp": 1, "sp": 1, "pp": 1, "dp": 1}
+    order = ["tp", "sp", "pp", "dp"]
+    i = 0
+    for _ in range(twos):
+        while order[i % 4] in ("tp", "sp") and sizes[order[i % 4]] >= 8:
+            i += 1
+        sizes[order[i % 4]] *= 2
+        i += 1
+    sizes["dp"] *= m  # odd remainder: batch shards any size
+    return sizes["dp"], sizes["pp"], sizes["sp"], sizes["tp"]
+
+
+def build_mesh_for(devices):
+    """(dp, pp, sp, tp) mesh over an explicit device list."""
+    from kubeoperator_tpu.parallel.mesh import build_mesh
+
+    dp, pp, sp, tp = axis_sizes(len(devices))
+    return build_mesh(("dp", "pp", "sp", "tp"), (dp, pp, sp, tp), devices)
+
+
+def param_specs(mesh):
+    """NamedSharding spec per parameter (leading stage dim on pp)."""
+    from jax.sharding import PartitionSpec as P
+
+    return {
+        "wqkv": P("pp", None, None),          # [pp, d, 3d] per-stage
+        "w_in": P("pp", None, "tp"),          # [pp, d, d_ff] col-parallel
+        "w_out": P("pp", "tp", None),         # [pp, d_ff, d] row-parallel
+        "w_gate": P("pp", None, None),        # [pp, d, n_exp]
+        "w_exp": P("pp", "sp", None, None),   # [pp, n_exp, d, d] ep-sharded
+        "w_head": P(None, None),              # [d, d] replicated readout
+    }
+
+
+def build_params_and_batch(mesh, seed: int = 0):
+    """numpy-built params + input batch, device_put onto the mesh with the
+    canonical shardings. Returns (params, x, host_params)."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    dp, pp, sp, tp = (int(mesh.shape[a]) for a in ("dp", "pp", "sp", "tp"))
+    n_exp = sp
+    rng = np.random.default_rng(seed)
+
+    def w(*shape, scale=0.05):
+        return (rng.standard_normal(shape) * scale).astype(np.float32)
+
+    host = {
+        "wqkv": w(pp, D_MODEL, 3 * D_MODEL),
+        "w_in": w(pp, D_MODEL, D_FF),
+        "w_out": w(pp, D_FF, D_MODEL),
+        "w_gate": w(pp, D_MODEL, n_exp),
+        "w_exp": w(pp, n_exp, D_MODEL, D_MODEL),
+        "w_head": w(D_MODEL, D_MODEL),
+    }
+    specs = param_specs(mesh)
+    params = {
+        k: jax.device_put(v, NamedSharding(mesh, specs[k]))
+        for k, v in host.items()
+    }
+    from jax.sharding import PartitionSpec as P
+
+    x = jax.device_put(
+        rng.standard_normal(
+            (B_LOCAL * dp, S_LOCAL * sp, D_MODEL)).astype(np.float32),
+        NamedSharding(mesh, P("dp", "sp", None)),
+    )
+    return params, x, host
+
+
+def make_train_step(mesh, lr: float = 0.1):
+    """jitted (params, x) -> (loss, new_params) over the mesh."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from kubeoperator_tpu.parallel.longcontext import ring_attention_local
+    from kubeoperator_tpu.parallel.mesh import shard_map_compat
+
+    dp, pp, sp, tp = (int(mesh.shape[a]) for a in ("dp", "pp", "sp", "tp"))
+    n_exp = sp
+    tokens_local = B_LOCAL * S_LOCAL
+    cap = tokens_local // n_exp     # static capacity routing (no dyn shapes)
+    batch, seq = B_LOCAL * dp, S_LOCAL * sp
+
+    def rms(h):
+        return h * lax.rsqrt(jnp.mean(h * h, axis=-1, keepdims=True) + 1e-6)
+
+    def stage_block(h, wqkv, w_in, w_out, w_gate, w_exp):
+        """One pipeline stage: ring attention (sp) + megatron FFN (tp) +
+        MoE token routing (ep == sp axis). Weights are this device's local
+        shards (leading stage dim already indexed away)."""
+        qkv = rms(h) @ wqkv                                # [b, s, 3d]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        shape4 = (B_LOCAL, S_LOCAL, HEADS, D_MODEL // HEADS)
+        a = ring_attention_local(
+            q.reshape(shape4), k.reshape(shape4), v.reshape(shape4),
+            axis_name="sp", n=sp, causal=True,
+        ).reshape(B_LOCAL, S_LOCAL, D_MODEL)
+        h = h + a
+        f = jax.nn.gelu(rms(h) @ w_in)                     # [b, s, d_ff/tp]
+        h = h + lax.psum(f @ w_out, "tp")                  # row-parallel
+        t = rms(h).reshape(tokens_local, D_MODEL)
+        g = jax.nn.softmax(t @ w_gate, axis=-1)            # [T, n_exp]
+        gsel = jnp.diagonal(                               # token i -> expert
+            g.reshape(cap, n_exp, n_exp), axis1=1, axis2=2)  # i % n_exp
+        xs = t.reshape(cap, n_exp, D_MODEL).transpose(1, 0, 2)
+        xr = lax.all_to_all(xs, "sp", 0, 0)                # tokens to experts
+        ye = jax.nn.gelu(xr @ w_exp[0])                    # my expert's FFN
+        yt = lax.all_to_all(ye, "sp", 0, 0)                # results back
+        routed = yt.transpose(1, 0, 2).reshape(tokens_local, D_MODEL)
+        moe = gsel.reshape(tokens_local, 1) * routed
+        return h + moe.reshape(B_LOCAL, S_LOCAL, D_MODEL)
+
+    def loss_local(p, xb):
+        """Per-device loss body (inside shard_map). Circular pipeline: this
+        pp rank's microbatch stream hops through every stage via the
+        ppermute ring schedule (pp steps), each device always applying its
+        own stage weights to whatever activation arrives."""
+        perm = [(i, (i + 1) % pp) for i in range(pp)]
+        block = jax.checkpoint(stage_block)   # remat validated under grad
+
+        def hop(h, _):
+            h = block(h, p["wqkv"][0], p["w_in"][0], p["w_out"][0],
+                      p["w_gate"][0], p["w_exp"][0])
+            if pp > 1:
+                h = lax.ppermute(h, "pp", perm)
+            return h, None
+
+        h, _ = lax.scan(hop, xb, None, length=pp)
+        y = h @ p["w_head"]
+        # sum over the local shard, then the sharded axes; y is replicated
+        # across tp (post-psum), so tp joins no reduction
+        part = jnp.sum(y * y) / (batch * seq * D_MODEL * pp)
+        return lax.psum(part, ("dp", "sp", "pp"))
+
+    loss_fn = shard_map_compat(loss_local, mesh,
+                               in_specs=(param_specs(mesh),
+                                         P("dp", "sp", None)),
+                               out_specs=P())
+
+    @jax.jit
+    def train_step(p, xb):
+        loss, grads = jax.value_and_grad(loss_fn)(p, xb)
+        new_p = jax.tree_util.tree_map(lambda a, g: a - lr * g, p, grads)
+        return loss, new_p
+
+    return train_step
