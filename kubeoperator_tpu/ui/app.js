@@ -724,10 +724,23 @@ async function refreshAll() {
   if (!$("#tab-events").hidden) refreshEvents();
 }
 
+const delBtn = (kind, name) =>
+  `<button data-del-infra="${esc(kind)}:${esc(name)}" class="ghost">✕</button>`;
+function wireInfraDeletes(root) {
+  root.querySelectorAll("[data-del-infra]").forEach((b) =>
+    b.addEventListener("click", async () => {
+      const [kind, name] = b.dataset.delInfra.split(":");
+      if (!confirm(`${t("del")} ${kind} ${name}?`)) return;
+      try {
+        await api("DELETE", `/api/v1/${kind}/${name}`);
+      } catch (e) { alert(e.message); }
+      refreshInfra();
+    }));
+}
 async function refreshInfra() {
   const plans = await api("GET", "/api/v1/plans").catch(() => []);
   $("#plan-list").innerHTML = plans.map((p) => `
-    <div class="card"><h4>${esc(p.name)}</h4>
+    <div class="card"><h4>${esc(p.name)} ${delBtn("plans", p.name)}</h4>
       <div class="muted">${p.provider} · masters ${p.master_count} · workers ${p.worker_count}</div>
       ${p.accelerator === "tpu" ? `<div class="smoke">${p.tpu_type} · ${p.num_slices} slice(s)</div>` : ""}
     </div>`).join("") || `<div class="muted">${t("no_plans")}</div>`;
@@ -741,14 +754,18 @@ async function refreshInfra() {
   const regions = await api("GET", "/api/v1/regions").catch(() => []);
   const zones = await api("GET", "/api/v1/zones").catch(() => []);
   $("#region-table").innerHTML =
-    "<tr><th>region</th><th>provider</th><th>zones</th></tr>" +
+    "<tr><th>region</th><th>provider</th><th>zones</th><th></th></tr>" +
     regions.map((r) => `<tr><td>${esc(r.name)}</td><td>${r.provider}</td>
-      <td>${zones.filter((z) => z.region_id === r.id).map((z) => esc(z.name)).join(", ") || "—"}</td></tr>`).join("");
+      <td>${zones.filter((z) => z.region_id === r.id).map((z) =>
+        `${esc(z.name)} ${delBtn("zones", z.name)}`).join(", ") || "—"}</td>
+      <td>${delBtn("regions", r.name)}</td></tr>`).join("");
 
   const creds = await api("GET", "/api/v1/credentials").catch(() => []);
   $("#credential-table").innerHTML =
-    "<tr><th>name</th><th>username</th><th>port</th></tr>" +
-    creds.map((x) => `<tr><td>${esc(x.name)}</td><td>${esc(x.username)}</td><td>${x.port}</td></tr>`).join("");
+    "<tr><th>name</th><th>username</th><th>port</th><th></th></tr>" +
+    creds.map((x) => `<tr><td>${esc(x.name)}</td><td>${esc(x.username)}</td><td>${x.port}</td>
+      <td>${delBtn("credentials", x.name)}</td></tr>`).join("");
+  wireInfraDeletes($("#tab-infra"));
 }
 
 async function refreshAdmin() {
