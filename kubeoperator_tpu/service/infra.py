@@ -58,6 +58,17 @@ class CredentialService(_Crud):
     def repo(self):
         return self.repos.credentials
 
+    def delete(self, name: str) -> None:
+        cred = self.repo.get_by_name(name)
+        used_by = [h for h in self.repos.hosts.list()
+                   if h.credential_id == cred.id]
+        if used_by:
+            raise ValidationError(
+                f"credential {name} is used by {len(used_by)} host(s) "
+                f"(e.g. {used_by[0].name}); reassign them first"
+            )
+        self.repo.delete(cred.id)
+
 
 class RegionService(_Crud):
     kind = "region"
@@ -65,6 +76,22 @@ class RegionService(_Crud):
     @property
     def repo(self):
         return self.repos.regions
+
+    def delete(self, name: str) -> None:
+        region = self.repo.get_by_name(name)
+        zones = self.repos.zones.find(region_id=region.id)
+        if zones:
+            raise ValidationError(
+                f"region {name} still has {len(zones)} zone(s); "
+                f"delete those first"
+            )
+        plans = [p for p in self.repos.plans.list()
+                 if p.region_id == region.id]
+        if plans:
+            raise ValidationError(
+                f"region {name} is referenced by plan {plans[0].name}"
+            )
+        self.repo.delete(region.id)
 
 
 class ZoneService(_Crud):
@@ -80,6 +107,22 @@ class ZoneService(_Crud):
     def list_for_region(self, region_name: str) -> list[Zone]:
         region = self.repos.regions.get_by_name(region_name)
         return self.repos.zones.find(region_id=region.id)
+
+    def delete(self, name: str) -> None:
+        zone = self.repo.get_by_name(name)
+        plans = [p for p in self.repos.plans.list()
+                 if zone.id in (p.zone_ids or [])]
+        if plans:
+            raise ValidationError(
+                f"zone {name} is referenced by plan {plans[0].name}"
+            )
+        hosts = [h for h in self.repos.hosts.list()
+                 if h.zone_id == zone.id]
+        if hosts:
+            raise ValidationError(
+                f"zone {name} still carries {len(hosts)} host(s)"
+            )
+        self.repo.delete(zone.id)
 
 
 class PlanService(_Crud):
@@ -98,6 +141,17 @@ class PlanService(_Crud):
         # UI/API always see the real host count
         if plan.has_tpu() and plan.worker_count == 0:
             plan.worker_count = plan.topology().total_hosts
+
+    def delete(self, name: str) -> None:
+        plan = self.repo.get_by_name(name)
+        clusters = [c for c in self.repos.clusters.list()
+                    if c.plan_id == plan.id]
+        if clusters:
+            raise ValidationError(
+                f"plan {name} is used by cluster {clusters[0].name}; "
+                f"delete the cluster first"
+            )
+        self.repo.delete(plan.id)
 
     def tpu_catalog(self) -> list[dict]:
         """Selectable slice shapes for the UI wizard (topology first-class)."""

@@ -669,3 +669,39 @@ class TestEventDriftSync:
                             host_names=names, wait=True)
         comp = svc.components.install("mesh", "istio")
         assert comp.status == "Installed"
+
+
+class TestInfraDeleteGuards:
+    """In-use infra objects must refuse deletion — a deleted credential/
+    region/zone/plan under a live reference would orphan it silently (the
+    console now exposes delete on all four)."""
+
+    def test_all_four_guards(self, svc):
+        from kubeoperator_tpu.models import Credential
+
+        svc.credentials.create(Credential(name="ssh", password="pw"))
+        svc.hosts.register("g1", "10.9.0.1", "ssh")
+        with pytest.raises(ValidationError, match="used by"):
+            svc.credentials.delete("ssh")
+
+        plan = make_tpu_plan(svc)
+        region = svc.regions.get("gcp-us")
+        with pytest.raises(ValidationError, match="zone"):
+            svc.regions.delete("gcp-us")
+        with pytest.raises(ValidationError, match="referenced by plan"):
+            svc.zones.delete("us-central1-a")
+
+        svc.clusters.create("guardc", provision_mode="plan",
+                            plan_name=plan.name, wait=True)
+        with pytest.raises(ValidationError, match="used by cluster"):
+            svc.plans.delete(plan.name)
+
+        # teardown order works: cluster -> plan -> zone -> region -> host/cred
+        svc.clusters.delete("guardc", wait=True)
+        svc.plans.delete(plan.name)
+        svc.zones.delete("us-central1-a")
+        svc.regions.delete("gcp-us")
+        svc.hosts.delete("g1")
+        svc.credentials.delete("ssh")
+        assert svc.plans.list() == []
+        assert region.id not in [r.id for r in svc.regions.list()]
