@@ -412,6 +412,8 @@ def cmd_tpu_diag(args) -> int:
     no server needed): MXU throughput, HBM stream, explicit-DMA read and —
     with >=2 devices — the XLA collective suite plus the pallas ICI ring.
     The node-side analog of the smoke test; ops/__init__.py rationale."""
+    import contextlib
+
     import jax
 
     from kubeoperator_tpu import ops
@@ -421,20 +423,28 @@ def cmd_tpu_diag(args) -> int:
         "devices": len(devices),
         "device_kind": getattr(devices[0], "device_kind", str(devices[0])),
     }
-    report["mxu"] = ops.mxu_matmul_tflops(
-        size=args.size, iters=args.iters).to_dict()
-    report["hbm_triad"] = ops.hbm_bandwidth_gbps().to_dict()
-    report["dma_read"] = ops.dma_read_bandwidth_gbps().to_dict()
-    if len(devices) >= 2:
-        report["collectives"] = [
-            r.to_dict() for r in ops.run_collective_suite()
-        ]
-        report["ring_all_gather_correct"] = ops.verify_ring_all_gather()
-        report["pallas_ring"] = ops.bench_ring_all_gather().to_dict()
-        # composed long-context path: exact ring attention over the ring
-        report["ring_attention_correct"] = ops.verify_ring_attention()
-        report["ring_attention"] = ops.bench_ring_attention(
-            seq_per_device=256, iters=4).to_dict()
+    # --profile-dir captures an XLA/TensorBoard trace of the whole suite
+    # (xprof-readable) — the operator's "why is this chip slow" artifact
+    profile = (jax.profiler.trace(args.profile_dir)
+               if getattr(args, "profile_dir", "") else
+               contextlib.nullcontext())
+    with profile:
+        report["mxu"] = ops.mxu_matmul_tflops(
+            size=args.size, iters=args.iters).to_dict()
+        report["hbm_triad"] = ops.hbm_bandwidth_gbps().to_dict()
+        report["dma_read"] = ops.dma_read_bandwidth_gbps().to_dict()
+        if len(devices) >= 2:
+            report["collectives"] = [
+                r.to_dict() for r in ops.run_collective_suite()
+            ]
+            report["ring_all_gather_correct"] = ops.verify_ring_all_gather()
+            report["pallas_ring"] = ops.bench_ring_all_gather().to_dict()
+            # composed long-context path: exact ring attention over the ring
+            report["ring_attention_correct"] = ops.verify_ring_attention()
+            report["ring_attention"] = ops.bench_ring_attention(
+                seq_per_device=256, iters=4).to_dict()
+    if getattr(args, "profile_dir", ""):
+        report["profile_dir"] = args.profile_dir
     print(json.dumps(report, indent=2))
     return 0
 
@@ -540,6 +550,8 @@ def build_parser() -> argparse.ArgumentParser:
     )
     diag_p.add_argument("--size", type=int, default=4096)
     diag_p.add_argument("--iters", type=int, default=30)
+    diag_p.add_argument("--profile-dir", default="",
+                        help="capture an XLA profiler trace of the suite")
 
     install_p = sub.add_parser("install", help="render/start the platform bundle")
     install_p.add_argument("--dir", default="/opt/ko-tpu")

@@ -68,6 +68,17 @@ def run_smoke(
         "num_processes": jax.process_count(),
         "table": table,
     }
+
+    # optional deeper gate (smoke_train_steps var -> KO_TPU_TRAIN_STEPS):
+    # a few real sharded training steps of the validation net; loss must
+    # be finite and descending on the actual slice
+    train_steps = int(os.environ.get("KO_TPU_TRAIN_STEPS", "0"))
+    if train_steps > 0:
+        from kubeoperator_tpu.ops.train_smoke import run_train_smoke
+
+        train = run_train_smoke(steps=train_steps)
+        result["train"] = train
+        result["ok"] = bool(result["ok"]) and bool(train["ok"])
     return result
 
 

@@ -235,6 +235,17 @@ class TestTrainSmoke:
         assert result["losses"][-1] < result["losses"][0]
         assert result["mesh"] == {"dp": 1, "pp": 2, "sp": 2, "tp": 2}
 
+    def test_smoke_gate_folds_train_result(self, monkeypatch):
+        """smoke_train_steps > 0 (KO_TPU_TRAIN_STEPS) deepens the Ready
+        gate: the psum result carries the train block and its ok."""
+        from kubeoperator_tpu.ops.psum_smoke import run_smoke
+
+        monkeypatch.setenv("KO_TPU_TRAIN_STEPS", "2")
+        result = run_smoke(sizes_mb=(0.1,), iters=2)
+        assert result["train"]["ok"] is True
+        assert len(result["train"]["losses"]) == 2
+        assert result["ok"] is True
+
     def test_cli_train_smoke(self, capsys):
         import json as _json
 
