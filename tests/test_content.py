@@ -282,3 +282,18 @@ def test_audit_policy_never_logs_secret_bodies():
         if rule["level"] in ("Request", "RequestResponse"):
             # body-recording rules must name no secret-bearing resource
             assert not touches_secrets
+
+
+def test_etcd_restore_rebuilds_full_cluster_membership():
+    """HA restore correctness: each member must be restored with the FULL
+    initial-cluster map and a fresh token — a bare snapshot restore makes
+    single-node data dirs that never re-form a multi-master cluster."""
+    role = open(os.path.join(CONTENT, "roles/restore-etcd/tasks/main.yml"),
+                encoding="utf-8").read()
+    assert "--initial-cluster " in role
+    assert "--initial-advertise-peer-urls" in role
+    assert "--initial-cluster-token" in role
+    assert "groups['etcd']" in role
+    # idempotent re-run: the stash from a failed attempt is cleared first
+    assert role.index("clear any previous restore stash") \
+        < role.index("move aside old data dir")
