@@ -297,3 +297,16 @@ def test_etcd_restore_rebuilds_full_cluster_membership():
     # idempotent re-run: the stash from a failed attempt is cleared first
     assert role.index("clear any previous restore stash") \
         < role.index("move aside old data dir")
+
+
+def test_etcd_backup_authenticates_against_tls_etcd():
+    """The deployed etcd requires TLS client auth, so snapshot save must
+    carry endpoint + cert flags — a bare `etcdctl snapshot save` only works
+    against plaintext etcd and fails on every real cluster this content
+    builds."""
+    role = open(os.path.join(CONTENT, "roles/backup-etcd/tasks/main.yml"),
+                encoding="utf-8").read()
+    assert "--endpoints https://127.0.0.1:2379" in role
+    assert "--cacert /etc/etcd/pki/ca.crt" in role
+    assert role.index("ensure backup directory exists") \
+        < role.index("take etcd snapshot")
