@@ -310,3 +310,18 @@ def test_etcd_backup_authenticates_against_tls_etcd():
     assert "--cacert /etc/etcd/pki/ca.crt" in role
     assert role.index("ensure backup directory exists") \
         < role.index("take etcd snapshot")
+
+
+def test_haproxy_is_tcp_passthrough_with_tracked_vip():
+    """The apiserver terminates its own TLS: haproxy must run mode tcp
+    (http mode breaks client-cert auth), and keepalived must shed the VIP
+    when haproxy dies, not only when the node does."""
+    hap = open(os.path.join(CONTENT, "roles/lb/templates/haproxy.cfg.j2"),
+               encoding="utf-8").read()
+    assert "mode tcp" in hap
+    assert "timeout client 4h" in hap      # long-lived watch streams
+    assert "defaults" in hap
+    keep = open(os.path.join(CONTENT, "roles/lb/templates/keepalived.conf.j2"),
+                encoding="utf-8").read()
+    assert "track_script" in keep
+    assert "lb_interface | default('eth0')" in keep
