@@ -325,3 +325,14 @@ def test_haproxy_is_tcp_passthrough_with_tracked_vip():
                 encoding="utf-8").read()
     assert "track_script" in keep
     assert "lb_interface | default('eth0')" in keep
+
+
+def test_master_upgrade_drains_and_uncordons():
+    """Serial master upgrade follows the drain -> upgrade -> Ready ->
+    uncordon discipline the worker path already had."""
+    role = open(os.path.join(CONTENT, "roles/upgrade-master/tasks/main.yml"),
+                encoding="utf-8").read()
+    assert role.index("drain master before upgrade") \
+        < role.index("kubeadm upgrade apply")
+    assert role.index("wait for master Ready again") \
+        < role.index("uncordon master")
