@@ -132,6 +132,17 @@ def main() -> int:
             details["dma_read_gbps"] = round(d.gbps, 1)
         except Exception as e:  # diagnostics must not sink the headline
             details["dma_read_gbps"] = f"error: {type(e).__name__}"
+        # end-to-end training signal: a few validation-net steps (attention
+        # + FFN + MoE + backward + SGD) — the framework-health number, not
+        # just raw-op ceilings
+        try:
+            from kubeoperator_tpu.ops.train_smoke import run_train_smoke
+
+            tr = run_train_smoke(steps=4)
+            details["train_smoke_steps_per_s"] = tr["steps_per_s"]
+            details["train_smoke_ok"] = tr["ok"]
+        except Exception as e:
+            details["train_smoke_ok"] = f"error: {type(e).__name__}"
         result = {
             "metric": f"{gen.name}_single_chip_mxu_bf16_tflops",
             "value": round(best_m.tflops, 1),
