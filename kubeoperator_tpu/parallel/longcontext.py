@@ -41,13 +41,15 @@ def reference_attention(q, k, v, causal: bool = False):
     """Plain full softmax attention — the single-device ground truth the
     parallel forms are tested against. [batch, seq, heads, dim] layout."""
     scale = 1.0 / (q.shape[-1] ** 0.5)
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   precision=lax.Precision.HIGHEST) * scale
     if causal:
         qpos = jnp.arange(q.shape[1])[:, None]
         kpos = jnp.arange(k.shape[1])[None, :]
         s = jnp.where(kpos <= qpos, s, _NEG)
     p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v,
+                      precision=lax.Precision.HIGHEST)
 
 
 def ring_attention_local(q, k, v, axis_name: str, n: int,
@@ -64,7 +66,6 @@ def ring_attention_local(q, k, v, axis_name: str, n: int,
     rank = lax.axis_index(axis_name)
     perm = [(i, (i + 1) % n) for i in range(n)]
 
-    qf = q.astype(jnp.float32)
     o0 = jnp.zeros(q.shape, jnp.float32)
     m0 = jnp.full((q.shape[0], q.shape[2], seq_local, 1), _NEG, jnp.float32)
     l0 = jnp.zeros((q.shape[0], q.shape[2], seq_local, 1), jnp.float32)
@@ -72,7 +73,15 @@ def ring_attention_local(q, k, v, axis_name: str, n: int,
 
     def step(carry, t):
         kb, vb, o, m, l = carry
-        s = jnp.einsum("bqhd,bkhd->bhqk", qf, kb.astype(jnp.float32)) * scale
+        # operands stay in their input dtype (bf16 rides the MXU natively);
+        # accumulation is f32 via preferred_element_type — the standard
+        # flash-attention dtype discipline
+        # HIGHEST precision: free for bf16 operands (already exact on the
+        # MXU) and exact for f32 — TPU's DEFAULT would silently multiply
+        # f32 operands in bf16 and fail the exactness probes on hardware
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, kb,
+                       preferred_element_type=jnp.float32,
+                       precision=lax.Precision.HIGHEST) * scale
         if causal:
             # after t hops this block originated at rank (rank - t) mod n
             src = (rank - t) % n
@@ -84,7 +93,9 @@ def ring_attention_local(q, k, v, axis_name: str, n: int,
         correction = jnp.exp(m - m_new)
         l = l * correction + p.sum(axis=-1, keepdims=True)
         o = (o * jnp.moveaxis(correction, 1, 2)      # [b,s,h,1] for o layout
-             + jnp.einsum("bhqk,bkhd->bqhd", p, vb.astype(jnp.float32)))
+             + jnp.einsum("bhqk,bkhd->bqhd", p.astype(vb.dtype), vb,
+                          preferred_element_type=jnp.float32,
+                          precision=lax.Precision.HIGHEST))
         kb = lax.ppermute(kb, axis_name, perm)
         vb = lax.ppermute(vb, axis_name, perm)
         return (kb, vb, o, m_new, l), None
