@@ -259,6 +259,17 @@ class Handlers:
                                  request.match_info["name"], False)
         return json_response(cluster.to_public_dict(), status=202)
 
+    async def scale_slices(self, request):
+        body = await request.json()
+        raw = body.get("num_slices")
+        if isinstance(raw, bool) or not isinstance(raw, int) or raw < 1:
+            from kubeoperator_tpu.utils.errors import ValidationError
+
+            raise ValidationError("num_slices must be a positive integer")
+        cluster = await run_sync(request, self.s.clusters.scale_slices,
+                                 request.match_info["name"], raw, False)
+        return json_response(cluster.to_public_dict(), status=202)
+
     async def renew_certs(self, request):
         cluster = await run_sync(request, self.s.clusters.renew_certs,
                                  request.match_info["name"], False)
@@ -678,6 +689,8 @@ def create_app(services: Services) -> web.Application:
                  cluster_guard(h.delete_cluster, manage))
     r.add_get("/api/v1/clusters/{name}/status",
               cluster_guard(h.cluster_status, view))
+    r.add_post("/api/v1/clusters/{name}/scale-slices",
+               cluster_guard(h.scale_slices, manage))
     r.add_post("/api/v1/clusters/{name}/retry",
                cluster_guard(h.retry_cluster, manage))
     r.add_get("/api/v1/clusters/{name}/kubeconfig",

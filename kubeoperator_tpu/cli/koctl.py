@@ -130,6 +130,9 @@ class LocalClient:
             case ("DELETE", ["clusters", name, "nodes", node]):
                 s.nodes.scale_down(name, node)
                 return {"ok": True}
+            case ("POST", ["clusters", name, "scale-slices"]):
+                return pub(s.clusters.scale_slices(
+                    name, int(body.get("num_slices", 0)), wait=False))
             case ("POST", ["clusters", name, "upgrade"]):
                 return pub(s.upgrades.upgrade(name, body["version"]))
             case ("POST", ["clusters", name, "renew-certs"]):
@@ -296,6 +299,13 @@ def cmd_cluster(client, args) -> int:
             client.call("DELETE",
                         f"/api/v1/clusters/{args.name}/nodes/{args.remove}")
             print(f"node {args.remove} removed")
+        return 0
+    if args.cluster_cmd == "scale-slices":
+        client.call(
+            "POST", f"/api/v1/clusters/{args.name}/scale-slices",
+            {"num_slices": args.slices})
+        if not args.no_wait:
+            return _poll_to_ready(client, args.name, args.timeout, False)
         return 0
     if args.cluster_cmd == "cis-scan":
         if args.list:
@@ -502,6 +512,11 @@ def build_parser() -> argparse.ArgumentParser:
     retry.add_argument("--quiet", action="store_true")
     retry.add_argument("--timeout", type=float, default=3600.0)
     csub.add_parser("list")
+    sslices = csub.add_parser("scale-slices")
+    sslices.add_argument("name")
+    sslices.add_argument("--slices", type=int, required=True)
+    sslices.add_argument("--timeout", type=int, default=1800)
+    sslices.add_argument("--no-wait", action="store_true")
     scale = csub.add_parser("scale")
     scale.add_argument("name")
     scale.add_argument("--add", default="")

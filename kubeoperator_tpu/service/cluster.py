@@ -143,10 +143,116 @@ class ClusterService:
         return self._launch(cluster, plan, wait)
 
     def retry(self, name: str, wait: bool = False) -> Cluster:
-        """Resume a failed create at the first non-OK condition."""
+        """Resume a failed create at the first non-OK condition. Plan-mode
+        clusters always re-apply terraform first — _provision reconciles
+        machines by name, so this is a no-op when the fleet is complete and
+        heals a half-provisioned one (e.g. an interrupted slice scale)."""
         cluster = self.get(name)
         plan = self.repos.plans.get(cluster.plan_id) if cluster.plan_id else None
-        return self._launch(cluster, plan, wait)
+        return self._launch(cluster, plan, wait, force_provision=plan is not None)
+
+    def scale_slices(self, name: str, num_slices: int,
+                     wait: bool = False) -> Cluster:
+        """Slice scaling (SURVEY §5.7 — the TPU-first scale axis): grow a
+        plan-mode TPU cluster by whole slices. Terraform re-applies with the
+        new slice count (existing machines are reconciled by name, new ones
+        created), the full phase list re-runs (kubeadm joins are
+        `creates:`-guarded, so existing nodes no-op), and the smoke test
+        re-gates Ready against the LARGER topology's chip count. Scale-down
+        is refused: shrinking tears down specific slices' machines — delete
+        and recreate, or scale nodes off manually.
+
+        Everything before _spawn is read-only validation: the plan/cluster
+        mutations happen inside the ADMITTED work thread, so a concurrent-op
+        ConflictError (or a crash before admission) leaves no half-scaled
+        state. A failed scale resumes: re-calling with the same target (or
+        retry()) re-applies terraform idempotently and re-runs the phases.
+        """
+        cluster = self.get(name)
+        if cluster.provision_mode != ProvisionMode.PLAN.value \
+                or not cluster.spec.tpu_enabled:
+            raise ValidationError(
+                "slice scaling applies to plan-mode TPU clusters only"
+            )
+        if cluster.status.phase not in (
+            ClusterPhaseStatus.READY.value, ClusterPhaseStatus.FAILED.value
+        ):
+            raise ValidationError(
+                f"cluster {name} is {cluster.status.phase}; slice scaling "
+                f"needs Ready or Failed"
+            )
+        plan = self.repos.plans.get(cluster.plan_id)
+        sharers = [c for c in self.repos.clusters.list()
+                   if c.plan_id == plan.id and c.id != cluster.id]
+        if sharers:
+            raise ValidationError(
+                f"plan {plan.name} is shared with cluster "
+                f"{sharers[0].name}; clone the plan before scaling slices"
+            )
+        # same-target on a Failed cluster = resume of an interrupted scale
+        if num_slices == plan.num_slices \
+                and cluster.status.phase == ClusterPhaseStatus.READY.value:
+            raise ValidationError(
+                f"cluster {name} already runs {num_slices} slice(s)"
+            )
+        if num_slices < plan.num_slices:
+            raise ValidationError(
+                "slice scale-down is not supported: delete and recreate, "
+                "or remove nodes manually"
+            )
+        from kubeoperator_tpu.parallel.topology import parse_accelerator_type
+
+        new_topo = parse_accelerator_type(
+            plan.tpu_type, ici_mesh=plan.slice_topology or None,
+            num_slices=num_slices,
+        )
+
+        def admit():
+            # persisted synchronously post-admission: the caller's very next
+            # status poll must see Scaling (not a stale Ready), and a
+            # ConflictError must leave plan/cluster untouched
+            plan.num_slices = num_slices
+            plan.worker_count = new_topo.total_hosts
+            plan.validate()
+            self.repos.plans.save(plan)
+            cluster.spec.jobset_enabled = (
+                new_topo.is_multihost or new_topo.is_multislice
+            )
+            cluster.status.phase = ClusterPhaseStatus.SCALING.value
+            self.repos.clusters.save(cluster)
+            self.events.emit(
+                cluster.id, "Normal", "SliceScaleStarted",
+                f"scaling {name} to {num_slices}x {plan.tpu_type} "
+                f"({new_topo.total_chips} chips)",
+            )
+
+        def work():
+            try:
+                self._provision(cluster, plan)
+                cluster.status.phase = ClusterPhaseStatus.DEPLOYING.value
+                self.repos.clusters.save(cluster)
+                ctx = self._context(cluster, plan)
+                self.adm.run(ctx, create_phases())
+                self._finish_ready(cluster)
+            except PhaseError as e:
+                cluster.status.phase = ClusterPhaseStatus.FAILED.value
+                cluster.status.message = e.message
+                self.repos.clusters.save(cluster)
+                self.events.emit(cluster.id, "Warning", "SliceScaleFailed",
+                                 f"phase {e.phase}: {e.message}")
+                if wait:
+                    raise
+            except Exception as e:
+                cluster.status.phase = ClusterPhaseStatus.FAILED.value
+                cluster.status.message = str(e)
+                self.repos.clusters.save(cluster)
+                self.events.emit(cluster.id, "Warning", "SliceScaleFailed",
+                                 str(e))
+                if wait:
+                    raise
+
+        self._spawn(cluster.id, work, wait, pre_start=admit)
+        return self.repos.clusters.get(cluster.id)
 
     def renew_certs(self, name: str, wait: bool = False) -> Cluster:
         """Day-2 PKI rotation (content playbook 24): rotate every
@@ -298,6 +404,35 @@ class ClusterService:
                 outputs, plan, cluster.name, credential_id=cred_id
             )
             for host in hosts:
+                # idempotent by name: terraform re-apply (retry, slice
+                # scale-up) reports ALL machines — only bind the new ones
+                try:
+                    existing = self.repos.hosts.get_by_name(host.name)
+                except NotFoundError:
+                    existing = None
+                if existing is not None:
+                    if existing.cluster_id and existing.cluster_id != cluster.id:
+                        raise ValidationError(
+                            f"provisioned name {host.name} collides with a "
+                            f"host of another cluster"
+                        )
+                    if not existing.cluster_id:
+                        # pre-registered or orphaned record with this name:
+                        # adopt it — terraform did create the machine, so it
+                        # needs a binding and a Node like any new host
+                        existing.ip = host.ip or existing.ip
+                        existing.tpu_worker_id = host.tpu_worker_id
+                        existing.tpu_slice_id = host.tpu_slice_id
+                        existing.tpu_chips = host.tpu_chips
+                        existing.cluster_id = cluster.id
+                        self.repos.hosts.save(existing)
+                        role = (NodeRole.MASTER if "-master-" in existing.name
+                                else NodeRole.WORKER)
+                        self.repos.nodes.save(Node(
+                            name=existing.name, cluster_id=cluster.id,
+                            host_id=existing.id, role=role.value,
+                        ))
+                    continue
                 host.cluster_id = cluster.id
                 self.repos.hosts.save(host)
                 role = NodeRole.MASTER if "-master-" in host.name else NodeRole.WORKER
@@ -360,12 +495,13 @@ class ClusterService:
         extra.update(self.debug_extra_vars)
         return AdmContext.for_cluster(self.repos, cluster, plan, extra)
 
-    def _launch(self, cluster: Cluster, plan: Plan | None, wait: bool) -> Cluster:
+    def _launch(self, cluster: Cluster, plan: Plan | None, wait: bool,
+                force_provision: bool = False) -> Cluster:
         def work():
             try:
-                if (
-                    plan is not None
-                    and not self.repos.nodes.find(cluster_id=cluster.id)
+                if plan is not None and (
+                    force_provision
+                    or not self.repos.nodes.find(cluster_id=cluster.id)
                 ):
                     self._provision(cluster, plan)
                 cluster.status.phase = ClusterPhaseStatus.DEPLOYING.value
@@ -418,10 +554,17 @@ class ClusterService:
         self.events.emit(cluster.id, "Normal", "ClusterReady",
                          f"cluster {cluster.name} Ready{detail}")
 
-    def _spawn(self, cluster_id: str, work, wait: bool) -> None:
+    def _spawn(self, cluster_id: str, work, wait: bool,
+               pre_start=None) -> None:
         """One in-flight operation per cluster; entries self-remove on
         completion so the registry stays bounded and delete can't race a
-        still-running create."""
+        still-running create.
+
+        `pre_start` runs synchronously AFTER admission but BEFORE the work
+        thread starts: state the caller's poll loop must observe (a phase
+        flip, a persisted plan change) goes there — inside the thread it
+        races the first poll, before admission it leaks on ConflictError.
+        A pre_start failure releases the registration."""
         def guarded():
             try:
                 work()
@@ -441,6 +584,13 @@ class ClusterService:
                     message="another operation is still running on this cluster",
                 )
             self._ops[cluster_id] = thread
+        if pre_start is not None:
+            try:
+                pre_start()
+            except Exception:
+                with self._ops_lock:
+                    self._ops.pop(cluster_id, None)
+                raise
         if wait:
             guarded()
         else:

@@ -52,6 +52,7 @@ const I18N = {
     num_slices: "Slices", slice_topology: "ICI topology (e.g. 4x4)",
     filter_events: "filter activity…", findings: "Findings",
     kubeconfig: "Kubeconfig", details: "Details",
+    scale_slices: "＋ Add slices",
   },
   zh: {
     sign_in: "登录", clusters: "集群", hosts: "主机", infra: "基础设施",
@@ -82,6 +83,7 @@ const I18N = {
     num_slices: "切片数", slice_topology: "ICI 拓扑（如 4x4）",
     filter_events: "过滤操作记录…", findings: "检查发现",
     kubeconfig: "Kubeconfig", details: "详情",
+    scale_slices: "＋ 扩容切片",
   },
 };
 let lang = localStorage.getItem("ko-lang") || "en";
@@ -275,7 +277,10 @@ async function openCluster(name) {
     ${nodes.map((n) => `<tr><td>${esc(n.name)}</td><td>${n.role}</td><td>${n.status}</td>
       <td>${n.role === "worker" ? `<button data-rm-node="${esc(n.name)}" class="ghost">${t("remove")}</button>` : ""}</td></tr>`).join("")}
     </table>
-    <div class="row"><button id="d-scale-up">${t("scale_up")}</button></div>
+    <div class="row">
+      <button id="d-scale-up">${t("scale_up")}</button>
+      ${c.spec.tpu_enabled ? `<button id="d-scale-slices">${t("scale_slices")}</button>` : ""}
+    </div>
 
     <h3>${t("components")}</h3>
     <table class="grid"><tr><th>name</th><th>status</th><th></th></tr>
@@ -376,6 +381,17 @@ async function openCluster(name) {
       hosts: out.hosts.split(",").map((s) => s.trim()).filter(Boolean),
     }).then(() => openCluster(name)));
   });
+  if (c.spec.tpu_enabled) {
+    // TPU clusters scale in whole slices (chips inside a slice are
+    // indivisible) — the slice count drives a terraform re-apply + re-gate
+    $("#d-scale-slices").addEventListener("click", () => {
+      objDialog("scale_slices", [
+        { key: "num_slices", label: t("num_slices"), type: "number", value: 2 },
+      ], (out) => api("POST", `/api/v1/clusters/${name}/scale-slices`,
+                      { num_slices: out.num_slices })
+          .then(() => openCluster(name)));
+    });
+  }
   detail.querySelectorAll("[data-rm-node]").forEach((b) =>
     b.addEventListener("click", async () => {
       await api("DELETE", `/api/v1/clusters/${name}/nodes/${b.dataset.rmNode}`);

@@ -326,6 +326,98 @@ class TestUpgrade:
         assert cluster.status.phase == "Ready"
 
 
+class TestSliceScaling:
+    def test_scale_up_slices_end_to_end(self, svc):
+        """SURVEY §5.7's scale axis as a day-2 operation: 1x v5e-16 ->
+        2x v5e-16. Terraform re-applies (existing machines reconciled by
+        name), the phase list re-runs, and the smoke gate re-validates the
+        DOUBLED chip count."""
+        plan = make_tpu_plan(svc)
+        svc.clusters.create("slices", provision_mode="plan",
+                            plan_name=plan.name, wait=True)
+        cluster = svc.clusters.get("slices")
+        assert cluster.status.phase == "Ready"
+        assert cluster.status.smoke_chips == 16
+        # 1 master VM + 4 TPU hosts
+        assert len(svc.repos.hosts.find(cluster_id=cluster.id)) == 5
+
+        svc.clusters.scale_slices("slices", 2, wait=True)
+        cluster = svc.clusters.get("slices")
+        assert cluster.status.phase == "Ready"
+        assert cluster.status.smoke_chips == 32        # re-gated larger
+        hosts = svc.repos.hosts.find(cluster_id=cluster.id)
+        assert len(hosts) == 9                         # master + 2x4 TPU
+        assert len({h.name for h in hosts}) == 9       # no dup binds
+        assert len([h for h in hosts if h.tpu_chips > 0]) == 8
+        assert svc.plans.get(plan.name).num_slices == 2
+        assert cluster.spec.jobset_enabled
+
+    def test_scale_slices_guards(self, svc):
+        plan = make_tpu_plan(svc)
+        svc.clusters.create("g1", provision_mode="plan",
+                            plan_name=plan.name, wait=True)
+        with pytest.raises(ValidationError, match="already runs"):
+            svc.clusters.scale_slices("g1", 1)
+        with pytest.raises(ValidationError, match="scale-down"):
+            svc.clusters.scale_slices("g1", 0)
+        # shared plan refused
+        svc.clusters.create("g2", provision_mode="plan",
+                            plan_name=plan.name, wait=True)
+        with pytest.raises(ValidationError, match="shared"):
+            svc.clusters.scale_slices("g1", 2)
+        # manual/non-TPU cluster refused
+        names = register_fleet(svc, 2)
+        svc.clusters.create("manual", spec=ClusterSpec(worker_count=1),
+                            host_names=names, wait=True)
+        with pytest.raises(ValidationError, match="plan-mode TPU"):
+            svc.clusters.scale_slices("manual", 2)
+
+    def test_conflict_before_any_mutation(self, svc):
+        """An in-flight op rejects the scale BEFORE plan/phase persist —
+        a stranded 'Scaling' cluster with a bumped plan was review finding
+        3; state must be untouched on ConflictError."""
+        import threading
+
+        from kubeoperator_tpu.utils.errors import ConflictError
+
+        plan = make_tpu_plan(svc)
+        svc.clusters.create("busy2", provision_mode="plan",
+                            plan_name=plan.name, wait=True)
+        cluster = svc.clusters.get("busy2")
+        blocker = threading.Event()
+        t = threading.Thread(target=blocker.wait, daemon=True)
+        t.start()
+        svc.clusters._ops[cluster.id] = t
+        try:
+            with pytest.raises(ConflictError):
+                svc.clusters.scale_slices("busy2", 2, wait=True)
+        finally:
+            blocker.set()
+            svc.clusters._ops.pop(cluster.id, None)
+        assert svc.plans.get(plan.name).num_slices == 1   # untouched
+        assert svc.clusters.get("busy2").status.phase == "Ready"
+
+    def test_failed_scale_resumes(self, svc):
+        """Review finding 2: a scale that dies mid-phase must be
+        resumable — same-target scale_slices on the Failed cluster (and
+        plain retry) re-applies terraform and completes."""
+        plan = make_tpu_plan(svc)
+        svc.clusters.create("resume", provision_mode="plan",
+                            plan_name=plan.name, wait=True)
+        svc.clusters.debug_extra_vars = {"__fail_at_task__": "device plugin"}
+        with pytest.raises(Exception):
+            svc.clusters.scale_slices("resume", 2, wait=True)
+        svc.clusters.debug_extra_vars = {}
+        cluster = svc.clusters.get("resume")
+        assert cluster.status.phase == "Failed"
+        assert svc.plans.get(plan.name).num_slices == 2   # mid-scale state
+        # resume with the same target completes the interrupted scale
+        svc.clusters.scale_slices("resume", 2, wait=True)
+        cluster = svc.clusters.get("resume")
+        assert cluster.status.phase == "Ready"
+        assert cluster.status.smoke_chips == 32
+
+
 class TestBackup:
     def test_backup_restore_and_cron(self, svc):
         names = register_fleet(svc, 2)
