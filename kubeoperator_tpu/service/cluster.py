@@ -19,6 +19,7 @@ from kubeoperator_tpu.adm import (
     cert_renew_phases,
     create_phases,
     reset_phases,
+    scale_down_phases,
 )
 from kubeoperator_tpu.executor import Executor, SimulationExecutor
 from kubeoperator_tpu.models import (
@@ -158,9 +159,9 @@ class ClusterService:
         new slice count (existing machines are reconciled by name, new ones
         created), the full phase list re-runs (kubeadm joins are
         `creates:`-guarded, so existing nodes no-op), and the smoke test
-        re-gates Ready against the LARGER topology's chip count. Scale-down
-        is refused: shrinking tears down specific slices' machines — delete
-        and recreate, or scale nodes off manually.
+        re-gates Ready against the NEW topology's chip count. Scale-down
+        drains and removes every host of the leaving slices first, then
+        lets the terraform re-apply destroy their machines.
 
         Everything before _spawn is read-only validation: the plan/cluster
         mutations happen inside the ADMITTED work thread, so a concurrent-op
@@ -195,29 +196,18 @@ class ClusterService:
             raise ValidationError(
                 f"cluster {name} already runs {num_slices} slice(s)"
             )
-        if num_slices < plan.num_slices:
-            raise ValidationError(
-                "slice scale-down is not supported: delete and recreate, "
-                "or remove nodes manually"
-            )
         from kubeoperator_tpu.parallel.topology import parse_accelerator_type
 
         new_topo = parse_accelerator_type(
             plan.tpu_type, ici_mesh=plan.slice_topology or None,
             num_slices=num_slices,
         )
+        shrinking = num_slices < plan.num_slices
 
         def admit():
             # persisted synchronously post-admission: the caller's very next
             # status poll must see Scaling (not a stale Ready), and a
             # ConflictError must leave plan/cluster untouched
-            plan.num_slices = num_slices
-            plan.worker_count = new_topo.total_hosts
-            plan.validate()
-            self.repos.plans.save(plan)
-            cluster.spec.jobset_enabled = (
-                new_topo.is_multihost or new_topo.is_multislice
-            )
             cluster.status.phase = ClusterPhaseStatus.SCALING.value
             self.repos.clusters.save(cluster)
             self.events.emit(
@@ -228,6 +218,35 @@ class ClusterService:
 
         def work():
             try:
+                if shrinking:
+                    # drain+remove every host of the leaving slices BEFORE
+                    # the plan changes or terraform destroys the machines;
+                    # a failed drain leaves the plan intact, so the same
+                    # call (or retry) resumes where it stopped
+                    leaving = [
+                        h for h in self.repos.hosts.find(cluster_id=cluster.id)
+                        if h.tpu_chips > 0 and h.tpu_slice_id >= num_slices
+                    ]
+                    ctx = self._context(cluster, plan)
+                    for host in sorted(leaving, key=lambda h: h.name):
+                        nodes = self.repos.nodes.find(
+                            cluster_id=cluster.id, name=host.name)
+                        if nodes:
+                            ctx.extra_vars["leaving_node"] = host.name
+                            self.adm.run(ctx, scale_down_phases())
+                            self.repos.nodes.delete(nodes[0].id)
+                        self.repos.hosts.delete(host.id)
+                # plan changes AFTER shrink-drains, BEFORE terraform: the
+                # re-render needs the new count to create (or destroy) the
+                # right machines
+                plan.num_slices = num_slices
+                plan.worker_count = new_topo.total_hosts
+                plan.validate()
+                self.repos.plans.save(plan)
+                cluster.spec.jobset_enabled = (
+                    new_topo.is_multihost or new_topo.is_multislice
+                )
+                self.repos.clusters.save(cluster)
                 self._provision(cluster, plan)
                 cluster.status.phase = ClusterPhaseStatus.DEPLOYING.value
                 self.repos.clusters.save(cluster)

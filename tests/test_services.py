@@ -352,14 +352,38 @@ class TestSliceScaling:
         assert svc.plans.get(plan.name).num_slices == 2
         assert cluster.spec.jobset_enabled
 
+    def test_scale_down_slices_end_to_end(self, svc):
+        """2x -> 1x: leaving slices' hosts are drained/removed before the
+        terraform re-apply destroys them, and the smoke gate re-validates
+        the SMALLER chip count."""
+        plan = make_tpu_plan(svc, num_slices=2)
+        svc.clusters.create("shrink", provision_mode="plan",
+                            plan_name=plan.name, wait=True)
+        cluster = svc.clusters.get("shrink")
+        assert cluster.status.smoke_chips == 32
+        assert len(svc.repos.hosts.find(cluster_id=cluster.id)) == 9
+
+        svc.clusters.scale_slices("shrink", 1, wait=True)
+        cluster = svc.clusters.get("shrink")
+        assert cluster.status.phase == "Ready"
+        assert cluster.status.smoke_chips == 16
+        hosts = svc.repos.hosts.find(cluster_id=cluster.id)
+        assert len(hosts) == 5                         # master + 1x4 TPU
+        assert all(h.tpu_slice_id == 0 for h in hosts if h.tpu_chips > 0)
+        assert svc.plans.get(plan.name).num_slices == 1
+        # drain ran for the leaving hosts
+        logs = "\n".join(l.line for l in svc.repos.task_logs.find(
+            cluster_id=cluster.id))
+        assert "drain leaving node" in logs
+
     def test_scale_slices_guards(self, svc):
         plan = make_tpu_plan(svc)
         svc.clusters.create("g1", provision_mode="plan",
                             plan_name=plan.name, wait=True)
         with pytest.raises(ValidationError, match="already runs"):
             svc.clusters.scale_slices("g1", 1)
-        with pytest.raises(ValidationError, match="scale-down"):
-            svc.clusters.scale_slices("g1", 0)
+        with pytest.raises(Exception, match="num_slices"):
+            svc.clusters.scale_slices("g1", 0)   # topology rejects < 1
         # shared plan refused
         svc.clusters.create("g2", provision_mode="plan",
                             plan_name=plan.name, wait=True)
