@@ -336,3 +336,18 @@ def test_master_upgrade_drains_and_uncordons():
         < role.index("kubeadm upgrade apply")
     assert role.index("wait for master Ready again") \
         < role.index("uncordon master")
+
+
+def test_containerd_runc_runtime_type_declared():
+    """Defining runtimes.runc.options without runtime_type leaves containerd
+    with an unusable runc entry ('no runtime for runc is configured') — the
+    type must be declared whenever the runc table is redefined."""
+    tpl = open(os.path.join(
+        CONTENT, "roles/runtime/templates/containerd-config.toml.j2"),
+        encoding="utf-8").read()
+    assert 'runtime_type = "io.containerd.runc.v2"' in tpl
+    assert tpl.index("runtime_type") < tpl.index("SystemdCgroup")
+    # air-gap: control-plane images (registry.k8s.io) mirror through the
+    # offline registry too, and its plain-http endpoint is trusted
+    assert 'registry.mirrors."registry.k8s.io"' in tpl
+    assert "insecure_skip_verify = true" in tpl
