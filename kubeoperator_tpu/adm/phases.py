@@ -134,6 +134,12 @@ def restore_phases() -> list[Phase]:
     ]
 
 
+def encryption_rotate_phases() -> list[Phase]:
+    """Day-2 secrets-at-rest key rotation (content playbook 25; pairs with
+    the pki role's initial secretbox generation)."""
+    return [Phase("rotate-encryption-key", "25-rotate-encryption-key.yml")]
+
+
 def cert_renew_phases() -> list[Phase]:
     """Day-2 PKI rotation (content playbook 24; pairs with the pki create
     phase). Re-fetches the rotated admin kubeconfig, so callers must refresh

@@ -270,6 +270,12 @@ class Handlers:
                                  request.match_info["name"], raw, False)
         return json_response(cluster.to_public_dict(), status=202)
 
+    async def rotate_encryption(self, request):
+        cluster = await run_sync(
+            request, self.s.clusters.rotate_encryption_key,
+            request.match_info["name"], False)
+        return json_response(cluster.to_public_dict(), status=202)
+
     async def renew_certs(self, request):
         cluster = await run_sync(request, self.s.clusters.renew_certs,
                                  request.match_info["name"], False)
@@ -705,6 +711,8 @@ def create_app(services: Services) -> web.Application:
                  cluster_guard(h.scale_down, manage))
     r.add_post("/api/v1/clusters/{name}/upgrade",
                cluster_guard(h.upgrade, manage))
+    r.add_post("/api/v1/clusters/{name}/rotate-encryption",
+               cluster_guard(h.rotate_encryption, manage))
     r.add_post("/api/v1/clusters/{name}/renew-certs",
                cluster_guard(h.renew_certs, manage))
     r.add_post("/api/v1/clusters/{name}/backup",

@@ -135,6 +135,8 @@ class LocalClient:
                     name, int(body.get("num_slices", 0)), wait=False))
             case ("POST", ["clusters", name, "upgrade"]):
                 return pub(s.upgrades.upgrade(name, body["version"]))
+            case ("POST", ["clusters", name, "rotate-encryption"]):
+                return pub(s.clusters.rotate_encryption_key(name, wait=False))
             case ("POST", ["clusters", name, "renew-certs"]):
                 return pub(s.clusters.renew_certs(name, wait=False))
             case ("POST", ["clusters", name, "backup"]):
@@ -322,6 +324,10 @@ def cmd_cluster(client, args) -> int:
         _print(client.call("POST", f"/api/v1/clusters/{args.name}/upgrade",
                            {"version": args.version}))
         return 0
+    if args.cluster_cmd == "rotate-encryption":
+        _print(client.call(
+            "POST", f"/api/v1/clusters/{args.name}/rotate-encryption"))
+        return 0
     if args.cluster_cmd == "renew-certs":
         _print(client.call("POST",
                            f"/api/v1/clusters/{args.name}/renew-certs"))
@@ -504,7 +510,7 @@ def build_parser() -> argparse.ArgumentParser:
     create.add_argument("--quiet", action="store_true")
     create.add_argument("--timeout", type=float, default=3600.0)
     for name in ("status", "delete", "logs", "events", "health",
-                 "renew-certs"):
+                 "renew-certs", "rotate-encryption"):
         sp = csub.add_parser(name)
         sp.add_argument("name")
     retry = csub.add_parser("retry")

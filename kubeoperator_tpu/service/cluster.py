@@ -18,6 +18,7 @@ from kubeoperator_tpu.adm import (
     ClusterAdm,
     cert_renew_phases,
     create_phases,
+    encryption_rotate_phases,
     reset_phases,
     scale_down_phases,
 )
@@ -298,6 +299,39 @@ class ClusterService:
                     raise
             except Exception as e:
                 self.events.emit(cluster.id, "Warning", "CertRenewFailed", str(e))
+                if wait:
+                    raise
+
+        self._spawn(cluster.id, work, wait)
+        return self.repos.clusters.get(cluster.id)
+
+    def rotate_encryption_key(self, name: str, wait: bool = False) -> Cluster:
+        """Day-2 secrets-at-rest key rotation (content playbook 25): prepend
+        a fresh secretbox key on every apiserver (old keys kept for
+        decryption), restart them, then rewrite all secrets so they
+        re-encrypt under the new key."""
+        cluster = self.get(name)
+        if cluster.status.phase != ClusterPhaseStatus.READY.value:
+            raise ValidationError("key rotation requires a Ready cluster")
+        plan = self.repos.plans.get(cluster.plan_id) if cluster.plan_id else None
+
+        def work():
+            try:
+                ctx = self._context(cluster, plan)
+                self.adm.run(ctx, encryption_rotate_phases())
+                self.repos.clusters.save(cluster)
+                self.events.emit(
+                    cluster.id, "Normal", "EncryptionKeyRotated",
+                    f"cluster {name} secrets-at-rest key rotated")
+            except PhaseError as e:
+                self.events.emit(cluster.id, "Warning",
+                                 "EncryptionKeyRotateFailed",
+                                 f"phase {e.phase}: {e.message}")
+                if wait:
+                    raise
+            except Exception as e:
+                self.events.emit(cluster.id, "Warning",
+                                 "EncryptionKeyRotateFailed", str(e))
                 if wait:
                     raise
 

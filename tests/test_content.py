@@ -351,3 +351,18 @@ def test_containerd_runc_runtime_type_declared():
     # offline registry too, and its plain-http endpoint is trusted
     assert 'registry.mirrors."registry.k8s.io"' in tpl
     assert "insecure_skip_verify = true" in tpl
+
+
+def test_encryption_rotation_is_two_phase_safe():
+    """Rotation must PREPEND the new key (encrypt path) while preserving
+    old keys (decrypt path) and end by rewriting secrets — dropping old
+    keys before the rewrite would brick every existing secret."""
+    role = open(os.path.join(
+        CONTENT, "roles/rotate-encryption-key/tasks/main.yml"),
+        encoding="utf-8").read()
+    assert "old_secrets" in role and "identity: {}" in role
+    assert role.index("prepend a fresh secretbox key") \
+        < role.index("restart apiserver static pods")
+    assert role.index("restart apiserver static pods") \
+        < role.index("re-encrypt every secret")
+    assert "distribute rotated encryption config" in role

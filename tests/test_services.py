@@ -442,6 +442,34 @@ class TestSliceScaling:
         assert cluster.status.smoke_chips == 32
 
 
+class TestEncryptionRotation:
+    def test_rotation_runs_playbook_and_emits(self, svc):
+        names = register_fleet(svc, 2)
+        svc.clusters.create("rot", spec=ClusterSpec(worker_count=1),
+                            host_names=names, wait=True)
+        svc.clusters.rotate_encryption_key("rot", wait=True)
+        cluster = svc.clusters.get("rot")
+        logs = "\n".join(l.line for l in svc.repos.task_logs.find(
+            cluster_id=cluster.id))
+        assert "TASK [prepend a fresh secretbox key on bootstrap master]" in logs
+        assert "TASK [fetch rotated encryption config" in logs
+        events = svc.events.list(cluster.id)
+        assert any(e.reason == "EncryptionKeyRotated" for e in events)
+
+    def test_rotation_requires_ready(self, svc):
+        names = register_fleet(svc, 2)
+        svc.clusters.debug_extra_vars = {"__fail_at_task__": "start etcd"}
+        try:
+            with pytest.raises(Exception):
+                svc.clusters.create(
+                    "rotbad", spec=ClusterSpec(worker_count=1),
+                    host_names=names, wait=True)
+        finally:
+            svc.clusters.debug_extra_vars = {}
+        with pytest.raises(ValidationError, match="Ready"):
+            svc.clusters.rotate_encryption_key("rotbad")
+
+
 class TestBackup:
     def test_backup_restore_and_cron(self, svc):
         names = register_fleet(svc, 2)
