@@ -118,6 +118,8 @@ class LocalClient:
                 return {"ok": True}
             case ("POST", ["clusters", name, "retry"]):
                 return pub(s.clusters.retry(name, wait=False))
+            case ("GET", ["clusters", name, "trace"]):
+                return s.clusters.get(name).status.trace()
             case ("GET", ["clusters", name, "logs"]):
                 cluster = s.clusters.get(name)
                 chunks = s.repos.task_logs.find(cluster_id=cluster.id)
@@ -282,6 +284,9 @@ def cmd_cluster(client, args) -> int:
     if args.cluster_cmd == "retry":
         client.call("POST", f"/api/v1/clusters/{args.name}/retry")
         return _poll_to_ready(client, args.name, args.timeout, args.quiet)
+    if args.cluster_cmd == "trace":
+        _print(client.call("GET", f"/api/v1/clusters/{args.name}/trace"))
+        return 0
     if args.cluster_cmd == "logs":
         for chunk in client.call("GET", f"/api/v1/clusters/{args.name}/logs"):
             print(chunk["line"])
@@ -510,7 +515,7 @@ def build_parser() -> argparse.ArgumentParser:
     create.add_argument("--quiet", action="store_true")
     create.add_argument("--timeout", type=float, default=3600.0)
     for name in ("status", "delete", "logs", "events", "health",
-                 "renew-certs", "rotate-encryption"):
+                 "renew-certs", "rotate-encryption", "trace"):
         sp = csub.add_parser(name)
         sp.add_argument("name")
     retry = csub.add_parser("retry")

@@ -53,6 +53,7 @@ const I18N = {
     filter_events: "filter activity…", findings: "Findings",
     kubeconfig: "Kubeconfig", details: "Details",
     scale_slices: "＋ Add slices",
+    renew_certs: "Renew certs", rotate_key: "Rotate secrets key",
   },
   zh: {
     sign_in: "登录", clusters: "集群", hosts: "主机", infra: "基础设施",
@@ -84,6 +85,7 @@ const I18N = {
     filter_events: "过滤操作记录…", findings: "检查发现",
     kubeconfig: "Kubeconfig", details: "详情",
     scale_slices: "＋ 扩容切片",
+    renew_certs: "轮换证书", rotate_key: "轮换加密密钥",
   },
 };
 let lang = localStorage.getItem("ko-lang") || "en";
@@ -261,7 +263,9 @@ async function openCluster(name) {
         <button id="d-retry">${t("retry")}</button>
         <button id="d-health">${t("health")}</button>
         <button id="d-upgrade">${t("upgrade")}</button>
-        ${me?.is_admin ? `<button id="d-kubeconfig">${t("kubeconfig")}</button>` : ""}
+        ${me?.is_admin ? `<button id="d-kubeconfig">${t("kubeconfig")}</button>
+        <button id="d-renew-certs" class="ghost">${t("renew_certs")}</button>
+        <button id="d-rotate-key" class="ghost">${t("rotate_key")}</button>` : ""}
         <button id="d-back">${t("back")}</button>
       </div>
     </div>
@@ -347,6 +351,16 @@ async function openCluster(name) {
     openCluster(name);
   });
   if (me?.is_admin) {
+    $("#d-renew-certs").addEventListener("click", async () => {
+      if (!confirm(`${t("renew_certs")} — ${name}?`)) return;
+      await api("POST", `/api/v1/clusters/${name}/renew-certs`);
+      openCluster(name);
+    });
+    $("#d-rotate-key").addEventListener("click", async () => {
+      if (!confirm(`${t("rotate_key")} — ${name}?`)) return;
+      await api("POST", `/api/v1/clusters/${name}/rotate-encryption`);
+      openCluster(name);
+    });
     $("#d-kubeconfig").addEventListener("click", async () => {
       // admin-only (server enforces): fetch and save as a file download
       const resp = await fetch(`/api/v1/clusters/${name}/kubeconfig`,
