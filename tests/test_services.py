@@ -376,6 +376,25 @@ class TestSliceScaling:
             cluster_id=cluster.id))
         assert "drain leaving node" in logs
 
+    def test_failed_scale_down_leaves_plan_and_resumes(self, svc):
+        """A drain failure mid-shrink must leave the plan at the OLD count
+        (machines still exist) and the same call must resume the shrink."""
+        plan = make_tpu_plan(svc, num_slices=2)
+        svc.clusters.create("shr2", provision_mode="plan",
+                            plan_name=plan.name, wait=True)
+        svc.clusters.debug_extra_vars = {
+            "__fail_at_task__": "drain leaving node"}
+        with pytest.raises(Exception):
+            svc.clusters.scale_slices("shr2", 1, wait=True)
+        svc.clusters.debug_extra_vars = {}
+        assert svc.plans.get(plan.name).num_slices == 2   # untouched
+        assert svc.clusters.get("shr2").status.phase == "Failed"
+        svc.clusters.scale_slices("shr2", 1, wait=True)
+        cluster = svc.clusters.get("shr2")
+        assert cluster.status.phase == "Ready"
+        assert cluster.status.smoke_chips == 16
+        assert svc.plans.get(plan.name).num_slices == 1
+
     def test_scale_slices_guards(self, svc):
         plan = make_tpu_plan(svc)
         svc.clusters.create("g1", provision_mode="plan",
