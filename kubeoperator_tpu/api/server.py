@@ -771,6 +771,14 @@ def create_app(services: Services) -> web.Application:
                    ("name", "provider", "vars"))
     h._crud_routes(app, "/api/v1/zones", services.zones, Zone,
                    ("name", "region_id", "vars", "ip_pool"))
+    async def clone_plan(request):
+        body = await request.json()
+        plan = await run_sync(request, services.plans.clone,
+                              request.match_info["name"],
+                              str(body.get("name", "")).strip())
+        return json_response(plan.to_public_dict(), status=201)
+
+    r.add_post("/api/v1/plans/{name}/clone", admin_guard(clone_plan))
     h._crud_routes(app, "/api/v1/plans", services.plans, Plan,
                    ("name", "provider", "region_id", "zone_ids",
                     "master_count", "worker_count", "vars", "accelerator",

@@ -179,6 +179,8 @@ class LocalClient:
                 return pub(s.plans.create(Plan(**{
                     k: body[k] for k in fields if k in body
                 })))
+            case ("POST", ["plans", name, "clone"]):
+                return pub(s.plans.clone(name, body.get("name", "")))
             case ("GET", ["plans-tpu-catalog"]):
                 return s.plans.tpu_catalog()
             case ("POST", ["hosts", "register"]):

@@ -153,6 +153,25 @@ class PlanService(_Crud):
             )
         self.repo.delete(plan.id)
 
+    def clone(self, name: str, new_name: str) -> Plan:
+        """Copy a plan under a new name (the affordance the slice-scaling
+        shared-plan guard points at: clusters needing independent scaling
+        get their own plan without retyping it)."""
+        import dataclasses
+
+        from kubeoperator_tpu.utils.ids import new_id, now_ts
+
+        source = self.repo.get_by_name(name)
+        copy = dataclasses.replace(
+            source, id=new_id(), created_at=now_ts(),
+            name=new_name, zone_ids=list(source.zone_ids),
+            vars=dict(source.vars))
+        try:
+            self.repo.get_by_name(new_name)
+        except NotFoundError:
+            return self.create(copy)
+        raise ValidationError(f"plan {new_name} already exists")
+
     def tpu_catalog(self) -> list[dict]:
         """Selectable slice shapes for the UI wizard (topology first-class)."""
         from kubeoperator_tpu.parallel.topology import (

@@ -461,6 +461,25 @@ class TestSliceScaling:
         assert cluster.status.smoke_chips == 32
 
 
+class TestPlanClone:
+    def test_clone_then_independent_scale(self, svc):
+        """The shared-plan guard's pointer works end-to-end: clone, repoint
+        nothing (new cluster uses the clone), scale only the clone."""
+        plan = make_tpu_plan(svc)
+        svc.clusters.create("orig", provision_mode="plan",
+                            plan_name=plan.name, wait=True)
+        clone = svc.plans.clone(plan.name, "tpu-v5e-16-b")
+        assert clone.id != plan.id
+        assert clone.tpu_type == plan.tpu_type
+        svc.clusters.create("other", provision_mode="plan",
+                            plan_name="tpu-v5e-16-b", wait=True)
+        svc.clusters.scale_slices("other", 2, wait=True)
+        assert svc.plans.get("tpu-v5e-16-b").num_slices == 2
+        assert svc.plans.get(plan.name).num_slices == 1   # original intact
+        with pytest.raises(ValidationError, match="already exists"):
+            svc.plans.clone(plan.name, "tpu-v5e-16-b")
+
+
 class TestEncryptionRotation:
     def test_rotation_runs_playbook_and_emits(self, svc):
         names = register_fleet(svc, 2)
