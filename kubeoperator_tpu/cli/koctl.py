@@ -179,6 +179,8 @@ class LocalClient:
                 return pub(s.plans.create(Plan(**{
                     k: body[k] for k in fields if k in body
                 })))
+            case ("GET", ["plans", name]):
+                return pub(s.plans.get(name))
             case ("POST", ["plans", name, "clone"]):
                 return pub(s.plans.clone(name, body.get("name", "")))
             case ("GET", ["plans-tpu-catalog"]):
@@ -349,6 +351,22 @@ def cmd_cluster(client, args) -> int:
         print("restore complete")
         return 0
     raise SystemExit(f"unknown cluster command {args.cluster_cmd}")
+
+
+def cmd_plan(client, args) -> int:
+    """Deploy-plan verbs: list / show / clone (bulk creation stays in
+    `koctl apply`)."""
+    if args.plan_cmd == "list":
+        _print(client.call("GET", "/api/v1/plans"))
+        return 0
+    if args.plan_cmd == "show":
+        _print(client.call("GET", f"/api/v1/plans/{args.name}"))
+        return 0
+    if args.plan_cmd == "clone":
+        _print(client.call("POST", f"/api/v1/plans/{args.name}/clone",
+                           {"name": args.new_name}))
+        return 0
+    raise SystemExit(f"unknown plan command {args.plan_cmd}")
 
 
 def cmd_component(client, args) -> int:
@@ -548,6 +566,15 @@ def build_parser() -> argparse.ArgumentParser:
     restore.add_argument("name")
     restore.add_argument("--file", required=True)
 
+    plan_p = sub.add_parser("plan", help="deploy-plan verbs")
+    plansub = plan_p.add_subparsers(dest="plan_cmd", required=True)
+    plansub.add_parser("list")
+    plan_show = plansub.add_parser("show")
+    plan_show.add_argument("name")
+    plan_clone = plansub.add_parser("clone")
+    plan_clone.add_argument("name")
+    plan_clone.add_argument("new_name")
+
     component = sub.add_parser("component", help="cluster addon verbs")
     compsub = component.add_subparsers(dest="component_cmd", required=True)
     compsub.add_parser("catalog")
@@ -648,6 +675,8 @@ def main(argv: list[str] | None = None) -> int:
         return 0
     if args.cmd == "cluster":
         return cmd_cluster(client, args)
+    if args.cmd == "plan":
+        return cmd_plan(client, args)
     if args.cmd == "component":
         return cmd_component(client, args)
     if args.cmd == "apply":
