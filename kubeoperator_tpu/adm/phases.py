@@ -95,12 +95,17 @@ def create_phases() -> list[Phase]:
 
 
 def upgrade_phases() -> list[Phase]:
-    """Masters serially, then workers rolling (SURVEY.md §3.4)."""
+    """Masters serially, then workers rolling (SURVEY.md §3.4). TPU
+    clusters re-run the smoke gate at the end: the upgrade drained and
+    restarted every kubelet, which can break device-plugin registration —
+    an upgraded TPU cluster isn't done until the chips prove out again."""
     return [
         Phase("upgrade-prepare", "20-upgrade-prepare.yml"),
         Phase("upgrade-masters", "21-upgrade-masters.yml"),
         Phase("upgrade-workers", "22-upgrade-workers.yml"),
         Phase("upgrade-verify", "23-upgrade-verify.yml"),
+        Phase("upgrade-tpu-smoke", "17-tpu-smoke-test.yml", enabled=_tpu,
+              post=smoke_post),
     ]
 
 

@@ -508,6 +508,31 @@ class TestEncryptionRotation:
             svc.clusters.rotate_encryption_key("rotbad")
 
 
+class TestTpuUpgradeRegate:
+    def test_tpu_upgrade_reruns_smoke(self, svc):
+        plan = make_tpu_plan(svc)
+        svc.clusters.create("uptpu", provision_mode="plan",
+                            plan_name=plan.name, wait=True)
+        cluster = svc.clusters.get("uptpu")
+        assert cluster.spec.k8s_version  # default assigned
+        current = cluster.spec.k8s_version
+        from kubeoperator_tpu.version import SUPPORTED_K8S_VERSIONS
+
+        nxt = [v for v in SUPPORTED_K8S_VERSIONS
+               if int(v.split(".")[1]) == int(current.split(".")[1]) + 1]
+        if not nxt:
+            import pytest as _pytest
+
+            _pytest.skip("default version is the newest in the bundle")
+        svc.upgrades.upgrade("uptpu", nxt[0])
+        cluster = svc.clusters.get("uptpu")
+        assert cluster.status.phase == "Ready"
+        names = [c.name for c in cluster.status.conditions]
+        assert "upgrade-tpu-smoke" in names
+        cond = cluster.status.condition("upgrade-tpu-smoke")
+        assert cond.status == "OK"
+
+
 class TestBackup:
     def test_backup_restore_and_cron(self, svc):
         names = register_fleet(svc, 2)
