@@ -254,6 +254,15 @@ class Handlers:
                        request.match_info["name"], False)
         return json_response({"ok": True}, status=202)
 
+    async def import_cluster(self, request):
+        _require_admin(request)
+        body = await request.json()
+        cluster = await run_sync(
+            request, self.s.clusters.import_cluster,
+            str(body.get("name", "")).strip(), body.get("kubeconfig", ""),
+            body.get("project_id", ""))
+        return json_response(cluster.to_public_dict(), status=201)
+
     async def retry_cluster(self, request):
         cluster = await run_sync(request, self.s.clusters.retry,
                                  request.match_info["name"], False)
@@ -695,6 +704,7 @@ def create_app(services: Services) -> web.Application:
                  cluster_guard(h.delete_cluster, manage))
     r.add_get("/api/v1/clusters/{name}/status",
               cluster_guard(h.cluster_status, view))
+    r.add_post("/api/v1/clusters/import", h.import_cluster)
     r.add_post("/api/v1/clusters/{name}/scale-slices",
                cluster_guard(h.scale_slices, manage))
     r.add_post("/api/v1/clusters/{name}/retry",

@@ -106,6 +106,10 @@ class LocalClient:
                     credential_name=body.get("credential", ""),
                     wait=False,
                 ))
+            case ("POST", ["clusters", "import"]):
+                return pub(s.clusters.import_cluster(
+                    body["name"], body.get("kubeconfig", ""),
+                    body.get("project_id", "")))
             case ("GET", ["clusters", name]):
                 return pub(s.clusters.get(name))
             case ("GET", ["clusters", name, "status"]):
@@ -284,6 +288,12 @@ def cmd_cluster(client, args) -> int:
     if args.cluster_cmd == "delete":
         client.call("DELETE", f"/api/v1/clusters/{args.name}")
         print(f"cluster {args.name} deletion started")
+        return 0
+    if args.cluster_cmd == "import":
+        with open(args.kubeconfig_file, encoding="utf-8") as f:
+            kc = f.read()
+        _print(client.call("POST", "/api/v1/clusters/import",
+                           {"name": args.name, "kubeconfig": kc}))
         return 0
     if args.cluster_cmd == "retry":
         client.call("POST", f"/api/v1/clusters/{args.name}/retry")
@@ -538,6 +548,9 @@ def build_parser() -> argparse.ArgumentParser:
                  "renew-certs", "rotate-encryption", "trace"):
         sp = csub.add_parser(name)
         sp.add_argument("name")
+    imp = csub.add_parser("import")
+    imp.add_argument("name")
+    imp.add_argument("--kubeconfig-file", required=True)
     retry = csub.add_parser("retry")
     retry.add_argument("name")
     retry.add_argument("--quiet", action="store_true")

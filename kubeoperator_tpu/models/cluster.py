@@ -20,6 +20,7 @@ from kubeoperator_tpu.version import SUPPORTED_K8S_VERSIONS
 class ProvisionMode(str, Enum):
     MANUAL = "manual"       # bare-metal: user-registered hosts
     PLAN = "plan"           # IaaS: Terraform provisions from a deploy plan
+    IMPORTED = "imported"   # existing cluster managed via kubeconfig only
 
 
 class NodeRole(str, Enum):
@@ -202,6 +203,18 @@ class Cluster(Entity):
 
     __nested__ = {"spec": ClusterSpec, "status": ClusterStatus}
     __secret_fields__ = frozenset({"kubeconfig"})
+
+    def require_managed(self, operation: str) -> None:
+        """Imported clusters are reachable only through their kubeconfig —
+        every operation that needs SSH onto the nodes (playbooks, terraform)
+        must refuse with a clear reason instead of failing mid-phase."""
+        if self.provision_mode == ProvisionMode.IMPORTED.value:
+            from kubeoperator_tpu.utils.errors import ValidationError
+
+            raise ValidationError(
+                f"cluster {self.name} was imported (kubeconfig-only); "
+                f"{operation} requires SSH-managed nodes"
+            )
 
     def validate(self) -> None:
         # RFC1123 label: lowercase alnum + '-', no edge hyphens, <= 63 chars —

@@ -66,6 +66,7 @@ class BackupService:
     # ---- backup / restore ----
     def run_backup(self, cluster_name: str, account_name: str = "") -> BackupFile:
         cluster = self.repos.clusters.get_by_name(cluster_name)
+        cluster.require_managed("etcd backup")
         if account_name:
             account = self.repos.backup_accounts.get_by_name(account_name)
         else:
@@ -97,6 +98,7 @@ class BackupService:
 
     def restore(self, cluster_name: str, file_name: str) -> None:
         cluster = self.repos.clusters.get_by_name(cluster_name)
+        cluster.require_managed("etcd restore")
         files = self.repos.backup_files.find(cluster_id=cluster.id,
                                              name=file_name)
         if not files:
@@ -131,6 +133,7 @@ class BackupService:
                    namespaces: str = "") -> str:
         """`velero backup create` on a master; returns the backup name."""
         cluster = self.repos.clusters.get_by_name(cluster_name)
+        cluster.require_managed("application backup")
         self._require_velero(cluster)
         backup_name = backup_name or \
             f"app-{cluster.name}-{now_iso().replace(':', '').lower()}"
@@ -147,6 +150,7 @@ class BackupService:
 
     def app_restore(self, cluster_name: str, backup_name: str) -> None:
         cluster = self.repos.clusters.get_by_name(cluster_name)
+        cluster.require_managed("application restore")
         self._require_velero(cluster)
         _check_k8s_name(backup_name, "backup name")
         self._velero_exec(

@@ -32,7 +32,7 @@ from kubeoperator_tpu.models import (
     Plan,
     ProvisionMode,
 )
-from kubeoperator_tpu.models.cluster import ClusterPhaseStatus
+from kubeoperator_tpu.models.cluster import ClusterPhaseStatus, ConditionStatus
 from kubeoperator_tpu.provisioner import TerraformProvisioner
 from kubeoperator_tpu.repository import Repositories
 from kubeoperator_tpu.utils.config import Config
@@ -144,12 +144,53 @@ class ClusterService:
 
         return self._launch(cluster, plan, wait)
 
+    def import_cluster(self, name: str, kubeconfig: str,
+                       project_id: str = "") -> Cluster:
+        """Register an EXISTING cluster by kubeconfig (reference feature:
+        import). The platform gets read/observe surfaces (terminal, events,
+        logs, trace, kubeconfig download) immediately; operations that need
+        SSH onto the nodes (playbook phases, terraform) stay gated with a
+        clear error — see Cluster.require_managed."""
+        try:
+            self.repos.clusters.get_by_name(name)
+            raise ConflictError(kind="cluster", name=name)
+        except NotFoundError:
+            pass
+        text = (kubeconfig or "").strip()
+        if not text:
+            raise ValidationError("import requires a kubeconfig")
+        import yaml as _yaml
+
+        try:
+            doc = _yaml.safe_load(text)
+        except _yaml.YAMLError as e:
+            raise ValidationError(f"kubeconfig is not valid YAML: {e}")
+        if not isinstance(doc, dict) or not doc.get("clusters"):
+            raise ValidationError(
+                "kubeconfig must be a YAML mapping with a non-empty "
+                "'clusters' section"
+            )
+        cluster = Cluster(
+            name=name, project_id=project_id,
+            provision_mode=ProvisionMode.IMPORTED.value,
+            kubeconfig=text,
+        )
+        cluster.validate()
+        cluster.status.phase = ClusterPhaseStatus.READY.value
+        cluster.status.upsert_condition("imported", ConditionStatus.OK,
+                                        "registered via kubeconfig")
+        self.repos.clusters.save(cluster)
+        self.events.emit(cluster.id, "Normal", "ClusterImported",
+                         f"existing cluster {name} imported (kubeconfig-only)")
+        return cluster
+
     def retry(self, name: str, wait: bool = False) -> Cluster:
         """Resume a failed create at the first non-OK condition. Plan-mode
         clusters always re-apply terraform first — _provision reconciles
         machines by name, so this is a no-op when the fleet is complete and
         heals a half-provisioned one (e.g. an interrupted slice scale)."""
         cluster = self.get(name)
+        cluster.require_managed("retry")
         plan = self.repos.plans.get(cluster.plan_id) if cluster.plan_id else None
         return self._launch(cluster, plan, wait, force_provision=plan is not None)
 
@@ -171,6 +212,7 @@ class ClusterService:
         retry()) re-applies terraform idempotently and re-runs the phases.
         """
         cluster = self.get(name)
+        cluster.require_managed("slice scaling")
         if cluster.provision_mode != ProvisionMode.PLAN.value \
                 or not cluster.spec.tpu_enabled:
             raise ValidationError(
@@ -280,6 +322,7 @@ class ClusterService:
         replaces admin.conf, so the stored kubeconfig is refreshed from the
         re-fetched copy afterwards."""
         cluster = self.get(name)
+        cluster.require_managed("cert renewal")
         if cluster.status.phase != ClusterPhaseStatus.READY.value:
             raise ValidationError("cert renewal requires a Ready cluster")
         plan = self.repos.plans.get(cluster.plan_id) if cluster.plan_id else None
@@ -311,6 +354,7 @@ class ClusterService:
         decryption), restart them, then rewrite all secrets so they
         re-encrypt under the new key."""
         cluster = self.get(name)
+        cluster.require_managed("encryption key rotation")
         if cluster.status.phase != ClusterPhaseStatus.READY.value:
             raise ValidationError("key rotation requires a Ready cluster")
         plan = self.repos.plans.get(cluster.plan_id) if cluster.plan_id else None

@@ -55,6 +55,7 @@ class ComponentService:
     def install(self, cluster_name: str, component_name: str,
                 vars: dict | None = None) -> ClusterComponent:
         cluster = self.repos.clusters.get_by_name(cluster_name)
+        cluster.require_managed("component install")
         existing = self.repos.components.find(cluster_id=cluster.id,
                                               name=component_name)
         if existing:
@@ -123,6 +124,7 @@ class ComponentService:
         (tpu-runtime — see catalog rationale) skip straight to the status
         change."""
         cluster = self.repos.clusters.get_by_name(cluster_name)
+        cluster.require_managed("component uninstall")
         existing = self.repos.components.find(cluster_id=cluster.id,
                                               name=component_name)
         if not existing:

@@ -108,6 +108,10 @@ class CronService:
         if interval > 0 and time.time() - self._health_last >= interval:
             self._health_last = time.time()
             for cluster in self.services.repos.clusters.find(phase="Ready"):
+                if cluster.provision_mode == "imported":
+                    # kubeconfig-only clusters have no SSH inventory: the
+                    # adhoc probe/sync paths would fail every tick forever
+                    continue
                 try:
                     self.services.health.check(cluster.name)
                     actions.append(f"health:{cluster.name}")
@@ -126,6 +130,10 @@ class CronService:
             # event_sync_timeout_s, not the interactive 120s default
             sync_timeout = float(cfg.get("cron.event_sync_timeout_s", 30))
             for cluster in self.services.repos.clusters.find(phase="Ready"):
+                if cluster.provision_mode == "imported":
+                    # kubeconfig-only clusters have no SSH inventory: the
+                    # adhoc probe/sync paths would fail every tick forever
+                    continue
                 try:
                     inv = AdmContext.for_cluster(
                         self.services.repos, cluster

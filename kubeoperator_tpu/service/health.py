@@ -60,6 +60,7 @@ class HealthService:
     def check(self, cluster_name: str) -> HealthReport:
         """Adhoc-probe the cluster through the executor boundary."""
         cluster = self.repos.clusters.get_by_name(cluster_name)
+        cluster.require_managed("health probes")
         inv = self._inventory(cluster)
         probes: list[ProbeResult] = []
 
@@ -101,6 +102,7 @@ class HealthService:
             raise PhaseError(probe_name, f"no recovery action for {probe_name}")
         playbook, condition = RECOVERY_ACTIONS[probe_name]
         cluster = self.repos.clusters.get_by_name(cluster_name)
+        cluster.require_managed("guided recovery")
         plan = (
             self.repos.plans.get(cluster.plan_id) if cluster.plan_id else None
         )

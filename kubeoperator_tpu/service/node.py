@@ -34,6 +34,7 @@ class NodeService:
     def scale_up(self, cluster_name: str, host_names: list[str]) -> list[Node]:
         """Manual-mode scale-up: join registered hosts as workers."""
         cluster = self.repos.clusters.get_by_name(cluster_name)
+        cluster.require_managed("node scale-up")
         if cluster.spec.tpu_enabled:
             raise ValidationError(
                 "TPU clusters scale in whole slices via their plan "
@@ -77,6 +78,7 @@ class NodeService:
 
     def scale_down(self, cluster_name: str, node_name: str) -> None:
         cluster = self.repos.clusters.get_by_name(cluster_name)
+        cluster.require_managed("node scale-down")
         nodes = self.repos.nodes.find(cluster_id=cluster.id, name=node_name)
         if not nodes:
             raise NotFoundError(kind="node", name=node_name)

@@ -33,6 +33,7 @@ class CisService:
 
     def run_scan(self, cluster_name: str) -> CisScan:
         cluster = self.repos.clusters.get_by_name(cluster_name)
+        cluster.require_managed("CIS scan")
         if not self.repos.nodes.find(cluster_id=cluster.id):
             raise ValidationError(
                 f"cluster {cluster_name} has no nodes to scan"

@@ -41,6 +41,7 @@ class UpgradeService:
 
     def upgrade(self, cluster_name: str, target_version: str):
         cluster = self.repos.clusters.get_by_name(cluster_name)
+        cluster.require_managed("upgrade")
         self.validate_hop(cluster.spec.k8s_version, target_version)
         cluster.status.phase = ClusterPhaseStatus.UPGRADING.value
         self.repos.clusters.save(cluster)

@@ -54,6 +54,7 @@ const I18N = {
     kubeconfig: "Kubeconfig", details: "Details",
     scale_slices: "＋ Add slices",
     renew_certs: "Renew certs", rotate_key: "Rotate secrets key",
+    import_cluster: "Import cluster",
   },
   zh: {
     sign_in: "登录", clusters: "集群", hosts: "主机", infra: "基础设施",
@@ -86,6 +87,7 @@ const I18N = {
     kubeconfig: "Kubeconfig", details: "详情",
     scale_slices: "＋ 扩容切片",
     renew_certs: "轮换证书", rotate_key: "轮换加密密钥",
+    import_cluster: "导入集群",
   },
 };
 let lang = localStorage.getItem("ko-lang") || "en";
@@ -156,6 +158,10 @@ function objDialog(titleKey, fields, onSave, validate) {
       return `<label>${esc(f.label)} <select id="obj-${f.key}">` +
         f.options.map((o) => `<option value="${esc(o)}">${esc(o)}</option>`).join("") +
         `</select></label>`;
+    }
+    if (f.type === "textarea") {
+      return `<label>${esc(f.label)} <textarea id="obj-${f.key}" rows="8" ` +
+        `placeholder="${esc(f.placeholder ?? "")}"></textarea></label>`;
     }
     return `<label>${esc(f.label)} <input id="obj-${f.key}" ` +
       `type="${f.type || "text"}" value="${esc(f.value ?? "")}" ` +
@@ -535,6 +541,16 @@ async function openCluster(name) {
   };
   logStream.addEventListener("end", () => logStream.close());
 }
+
+$("#import-cluster-btn").addEventListener("click", () => {
+  // existing cluster by kubeconfig: observe/terminal surfaces immediately;
+  // SSH-dependent day-2 ops stay server-gated with a clear error
+  objDialog("import_cluster", [
+    { key: "name", label: t("name") },
+    { key: "kubeconfig", label: "Kubeconfig", type: "textarea",
+      placeholder: "apiVersion: v1\nkind: Config\n..." },
+  ], (out) => api("POST", "/api/v1/clusters/import", out));
+});
 
 /* ---------- wizard ---------- */
 let planCache = [];

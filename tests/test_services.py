@@ -461,6 +461,58 @@ class TestSliceScaling:
         assert cluster.status.smoke_chips == 32
 
 
+KUBECONFIG_DOC = """apiVersion: v1
+kind: Config
+clusters:
+  - name: ext
+    cluster: {server: "https://10.5.0.1:6443"}
+contexts: []
+users: []
+"""
+
+
+class TestClusterImport:
+    def test_import_and_capability_gating(self, svc):
+        cluster = svc.clusters.import_cluster("ext", KUBECONFIG_DOC)
+        assert cluster.status.phase == "Ready"
+        assert cluster.provision_mode == "imported"
+        assert svc.clusters.get("ext").kubeconfig.startswith("apiVersion")
+        events = svc.events.list(cluster.id)
+        assert any(e.reason == "ClusterImported" for e in events)
+        # every SSH-dependent operation refuses with a clear reason
+        for call in (
+            lambda: svc.clusters.retry("ext"),
+            lambda: svc.clusters.renew_certs("ext"),
+            lambda: svc.clusters.rotate_encryption_key("ext"),
+            lambda: svc.clusters.scale_slices("ext", 2),
+            lambda: svc.upgrades.upgrade("ext", "v1.30.6"),
+            lambda: svc.nodes.scale_up("ext", ["h1"]),
+            lambda: svc.components.install("ext", "prometheus"),
+            lambda: svc.backups.run_backup("ext", ""),
+            lambda: svc.cis.run_scan("ext"),
+            lambda: svc.health.check("ext"),
+            lambda: svc.health.recover("ext", "etcd"),
+        ):
+            with pytest.raises(ValidationError, match="imported"):
+                call()
+        # delete works (no reset/terraform needed)
+        svc.clusters.delete("ext", wait=True)
+
+    def test_import_validates_inputs(self, svc):
+        with pytest.raises(ValidationError, match="kubeconfig"):
+            svc.clusters.import_cluster("bad", "   ")
+        with pytest.raises(ValidationError, match="clusters"):
+            svc.clusters.import_cluster("bad", "just: a-scalar-doc")
+        with pytest.raises(ValidationError, match="non-empty"):
+            svc.clusters.import_cluster(
+                "bad", "apiVersion: v1\nkind: Config\nclusters: []\n")
+        svc.clusters.import_cluster("dup", KUBECONFIG_DOC)
+        from kubeoperator_tpu.utils.errors import ConflictError
+
+        with pytest.raises(ConflictError):
+            svc.clusters.import_cluster("dup", KUBECONFIG_DOC)
+
+
 class TestPlanClone:
     def test_clone_then_independent_scale(self, svc):
         """The shared-plan guard's pointer works end-to-end: clone, repoint
