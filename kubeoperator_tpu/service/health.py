@@ -58,9 +58,12 @@ class HealthService:
         self.adm = ClusterAdm(executor)
 
     def check(self, cluster_name: str) -> HealthReport:
-        """Adhoc-probe the cluster through the executor boundary."""
+        """Adhoc-probe the cluster through the executor boundary. Imported
+        (kubeconfig-only) clusters are probed from the platform host with
+        their stored kubeconfig instead — no SSH exists for them."""
         cluster = self.repos.clusters.get_by_name(cluster_name)
-        cluster.require_managed("health probes")
+        if cluster.provision_mode == "imported":
+            return self._check_via_kubeconfig(cluster)
         inv = self._inventory(cluster)
         probes: list[ProbeResult] = []
 
@@ -95,6 +98,46 @@ class HealthService:
             self.events.emit(cluster.id, "Warning", "HealthDegraded",
                              f"failed probes: {bad}")
         return report
+
+    def _check_via_kubeconfig(self, cluster) -> HealthReport:
+        """Local kubectl probes against the imported cluster's apiserver.
+        The kubeconfig is materialized 0600 and removed immediately (same
+        trust posture as the web terminal). A missing kubectl binary is an
+        honest probe failure, not an exception."""
+        import os
+        import subprocess
+        import tempfile
+
+        probes: list[ProbeResult] = []
+        fd, path = tempfile.mkstemp(prefix="ko-health-", suffix=".conf")
+        try:
+            with os.fdopen(fd, "w", encoding="utf-8") as f:
+                f.write(cluster.kubeconfig)
+            os.chmod(path, 0o600)
+            for name, args in (
+                ("apiserver", ["get", "--raw", "/healthz"]),
+                ("nodes", ["get", "nodes", "--no-headers"]),
+            ):
+                try:
+                    proc = subprocess.run(
+                        ["kubectl", "--kubeconfig", path,
+                         "--request-timeout=10s", *args],
+                        capture_output=True, text=True, timeout=30,
+                    )
+                    ok = proc.returncode == 0
+                    detail = (proc.stdout if ok else proc.stderr).strip()[:300]
+                except FileNotFoundError:
+                    ok, detail = False, "kubectl binary not available on the platform host"
+                except subprocess.TimeoutExpired:
+                    ok, detail = False, "probe timed out after 30s"
+                probes.append(ProbeResult(name=name, ok=ok, detail=detail))
+        finally:
+            os.unlink(path)
+        return HealthReport(
+            cluster=cluster.name,
+            healthy=all(p.ok for p in probes),
+            probes=probes,
+        )
 
     def recover(self, cluster_name: str, probe_name: str) -> None:
         """Guided recovery: re-run the adm phase behind a failed probe."""

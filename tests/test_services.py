@@ -490,11 +490,17 @@ class TestClusterImport:
             lambda: svc.components.install("ext", "prometheus"),
             lambda: svc.backups.run_backup("ext", ""),
             lambda: svc.cis.run_scan("ext"),
-            lambda: svc.health.check("ext"),
             lambda: svc.health.recover("ext", "etcd"),
         ):
             with pytest.raises(ValidationError, match="imported"):
                 call()
+        # health probes go through the kubeconfig path, not SSH: with no
+        # kubectl binary (or unreachable apiserver) the report is honest
+        # probe failures, never an exception or a phantom playbook run
+        report = svc.health.check("ext")
+        assert report.healthy is False
+        assert {p.name for p in report.probes} == {"apiserver", "nodes"}
+        assert all(p.detail for p in report.probes)
         # delete works (no reset/terraform needed)
         svc.clusters.delete("ext", wait=True)
 
