@@ -56,6 +56,7 @@ class EventService:
         the last DEDUP_WINDOW_S only); Warning events ride the normal emit
         path, so the message center notifies on cluster-side drift exactly
         like platform warnings."""
+        cluster.require_managed("K8s event sync")
         task_id = executor.run_adhoc(
             "command", KUBECTL_EVENTS_CMD, inventory, pattern="kube-master"
         )

@@ -491,6 +491,8 @@ class TestClusterImport:
             lambda: svc.backups.run_backup("ext", ""),
             lambda: svc.cis.run_scan("ext"),
             lambda: svc.health.recover("ext", "etcd"),
+            lambda: svc.events.sync_from_cluster(
+                svc.clusters.get("ext"), svc.executor, {}),
         ):
             with pytest.raises(ValidationError, match="imported"):
                 call()
