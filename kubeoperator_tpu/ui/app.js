@@ -549,7 +549,8 @@ $("#import-cluster-btn").addEventListener("click", () => {
     { key: "name", label: t("name") },
     { key: "kubeconfig", label: "Kubeconfig", type: "textarea",
       placeholder: "apiVersion: v1\nkind: Config\n..." },
-  ], (out) => api("POST", "/api/v1/clusters/import", out));
+  ], (out) => api("POST", "/api/v1/clusters/import", out),
+  (out) => KOLogic.import_form_errors(out.name, out.kubeconfig));
 });
 
 /* ---------- wizard ---------- */

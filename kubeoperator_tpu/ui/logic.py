@@ -205,6 +205,22 @@ def wizard_errors(mode, name, plan_name, hosts_csv, workers):
     return errors
 
 
+def import_form_errors(name, kubeconfig):
+    """Client-side mirror of ClusterService.import_cluster's checks: DNS
+    name, non-empty kubeconfig that at least carries a clusters section.
+    (Full YAML parsing stays server-side; this catches the obvious paste
+    mistakes before the POST.)"""
+    errors = []
+    if not dns_label_ok(str(name).strip()):
+        errors.append("cluster name must be a lowercase DNS label (1-63 chars)")
+    text = str(kubeconfig).strip()
+    if text == "":
+        errors.append("paste the cluster's kubeconfig")
+    elif not jsrt.contains(text, "clusters:"):
+        errors.append("kubeconfig must contain a 'clusters:' section")
+    return errors
+
+
 def filter_log_lines(lines, query):
     """Log-viewer filter: case-insensitive substring over raw lines. The
     viewer keeps the full line buffer and re-renders through this, so
@@ -326,6 +342,7 @@ PUBLIC = [
     wizard_errors,
     k8s_minor,
     upgrade_errors,
+    import_form_errors,
     filter_log_lines,
     filter_events,
     trace_rows,

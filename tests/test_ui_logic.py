@@ -183,6 +183,16 @@ class TestUpgradeGate:
                 assert client_ok == server_ok, (current, target)
 
 
+class TestImportForm:
+    def test_import_gate(self):
+        kc = "apiVersion: v1\nkind: Config\nclusters:\n  - name: x\n"
+        assert logic.import_form_errors("ext", kc) == []
+        assert logic.import_form_errors("Bad_Name", kc)
+        assert logic.import_form_errors("ext", "   ")
+        errors = logic.import_form_errors("ext", "apiVersion: v1\n")
+        assert any("clusters" in e for e in errors)
+
+
 class TestViewers:
     def test_log_filter_case_insensitive_and_resettable(self):
         lines = ["TASK [kube-master] ok", "fatal: etcd timeout", "ok: done"]
