@@ -216,7 +216,10 @@ async function refreshClusters() {
   for (const c of clusters) {
     const card = document.createElement("div");
     card.className = "card";
-    const conds = (c.status.conditions || []).map((x) =>
+    // imported (kubeconfig-only) clusters: observe surfaces only — the
+  // SSH-gated day-2 sections are hidden rather than offered-and-refused
+  const imported = c.provision_mode === "imported";
+  const conds = (c.status.conditions || []).map((x) =>
       `<span class="cond ${x.status}">${esc(x.name)}</span>`).join("");
     const smoke = c.status.smoke_chips
       ? `<div class="smoke">psum ${c.status.smoke_gbps} GB/s · ${c.status.smoke_chips} chips</div>`
@@ -257,6 +260,9 @@ async function openCluster(name) {
   const detail = $("#cluster-detail");
   $("#cluster-list").hidden = true;
   detail.hidden = false;
+  // imported (kubeconfig-only) clusters: observe surfaces only — the
+  // SSH-gated day-2 sections are hidden rather than offered-and-refused
+  const imported = c.provision_mode === "imported";
   const conds = (c.status.conditions || []).map((x) =>
     `<span class="cond ${x.status}" title="${esc(x.message || "")}">${esc(x.name)}` +
     (x.finished_at && x.started_at
@@ -266,10 +272,11 @@ async function openCluster(name) {
     <div class="detail-head">
       <h3>${esc(name)} — <span class="phase ${c.status.phase}">${c.status.phase}</span></h3>
       <div class="row">
-        <button id="d-retry">${t("retry")}</button>
+        ${imported ? "" : `<button id="d-retry">${t("retry")}</button>`}
         <button id="d-health">${t("health")}</button>
-        <button id="d-upgrade">${t("upgrade")}</button>
-        ${me?.is_admin ? `<button id="d-kubeconfig">${t("kubeconfig")}</button>
+        ${imported ? "" : `<button id="d-upgrade">${t("upgrade")}</button>`}
+        ${me?.is_admin ? `<button id="d-kubeconfig">${t("kubeconfig")}</button>` : ""}
+        ${me?.is_admin && !imported ? `
         <button id="d-renew-certs" class="ghost">${t("renew_certs")}</button>
         <button id="d-rotate-key" class="ghost">${t("rotate_key")}</button>` : ""}
         <button id="d-back">${t("back")}</button>
@@ -287,21 +294,21 @@ async function openCluster(name) {
     ${nodes.map((n) => `<tr><td>${esc(n.name)}</td><td>${n.role}</td><td>${n.status}</td>
       <td>${n.role === "worker" ? `<button data-rm-node="${esc(n.name)}" class="ghost">${t("remove")}</button>` : ""}</td></tr>`).join("")}
     </table>
-    <div class="row">
+    ${imported ? "" : `<div class="row">
       <button id="d-scale-up">${t("scale_up")}</button>
       ${c.spec.tpu_enabled ? `<button id="d-scale-slices">${t("scale_slices")}</button>` : ""}
-    </div>
+    </div>`}
 
     <h3>${t("components")}</h3>
     <table class="grid"><tr><th>name</th><th>status</th><th></th></tr>
     ${comps.map((x) => `<tr><td>${esc(x.name)}</td><td>${x.status}</td>
       <td><button data-un-comp="${esc(x.name)}" class="ghost">${t("uninstall")}</button></td></tr>`).join("")}
     </table>
-    <div class="row">
+    ${imported ? "" : `<div class="row">
       <select id="d-comp-select">${Object.keys(catalog).map((k) =>
         `<option>${esc(k)}</option>`).join("")}</select>
       <button id="d-comp-install">${t("install")}</button>
-    </div>
+    </div>`}
 
     <h3>${t("etcd_backups")}</h3>
     <table class="grid"><tr><th>file</th><th>created</th><th></th></tr>
@@ -309,7 +316,7 @@ async function openCluster(name) {
       <td>${esc(f.created_at || "")}</td>
       <td><button data-restore="${esc(f.file_name || f.name)}" class="ghost">${t("restore")}</button></td></tr>`).join("")}
     </table>
-    <div class="row"><button id="d-backup-now">${t("backup_now")}</button></div>
+    ${imported ? "" : `<div class="row"><button id="d-backup-now">${t("backup_now")}</button></div>`}
 
     <h3>${t("security")}</h3>
     <table class="grid"><tr><th>scan</th><th>status</th><th>pass</th><th>fail</th><th>warn</th><th></th></tr>
@@ -318,7 +325,7 @@ async function openCluster(name) {
       <td>${(s.checks || []).length ? `<button data-cis-findings="${i}" class="ghost">${t("findings")}</button>` : ""}</td></tr>`).join("")}
     </table>
     <div id="d-cis-findings" hidden></div>
-    <div class="row"><button id="d-cis-run">${t("run_scan")}</button></div>
+    ${imported ? "" : `<div class="row"><button id="d-cis-run">${t("run_scan")}</button></div>`}
 
     ${me?.is_admin ? `
     <h3>${t("terminal")}</h3>
@@ -352,11 +359,11 @@ async function openCluster(name) {
     refreshClusters();
   };
   $("#d-back").addEventListener("click", closeDetail);
-  $("#d-retry").addEventListener("click", async () => {
+  if (!imported) $("#d-retry").addEventListener("click", async () => {
     await api("POST", `/api/v1/clusters/${name}/retry`);
     openCluster(name);
   });
-  if (me?.is_admin) {
+  if (me?.is_admin && !imported) {
     $("#d-renew-certs").addEventListener("click", async () => {
       if (!confirm(`${t("renew_certs")} — ${name}?`)) return;
       await api("POST", `/api/v1/clusters/${name}/renew-certs`);
@@ -367,6 +374,8 @@ async function openCluster(name) {
       await api("POST", `/api/v1/clusters/${name}/rotate-encryption`);
       openCluster(name);
     });
+  }
+  if (me?.is_admin) {
     $("#d-kubeconfig").addEventListener("click", async () => {
       // admin-only (server enforces): fetch and save as a file download
       const resp = await fetch(`/api/v1/clusters/${name}/kubeconfig`,
@@ -385,7 +394,7 @@ async function openCluster(name) {
     $("#d-health-out").innerHTML = '<div class="conds">' + h.probes.map((p) =>
       `<span class="cond ${p.ok ? "OK" : "Failed"}">${esc(p.name)}</span>`).join("") + "</div>";
   });
-  $("#d-upgrade").addEventListener("click", () => {
+  if (!imported) $("#d-upgrade").addEventListener("click", () => {
     objDialog("upgrade", [
       { key: "version", label: t("k8s_version"), type: "select",
         options: vers.supported_k8s_versions },
@@ -394,14 +403,14 @@ async function openCluster(name) {
     (out) => KOLogic.upgrade_errors(         // one-minor-hop gate, tested
       c.spec.k8s_version, out.version, vers.supported_k8s_versions));
   });
-  $("#d-scale-up").addEventListener("click", () => {
+  if (!imported) $("#d-scale-up").addEventListener("click", () => {
     objDialog("scale_up", [
       { key: "hosts", label: t("hosts_csv") },
     ], (out) => api("POST", `/api/v1/clusters/${name}/nodes`, {
       hosts: out.hosts.split(",").map((s) => s.trim()).filter(Boolean),
     }).then(() => openCluster(name)));
   });
-  if (c.spec.tpu_enabled) {
+  if (c.spec.tpu_enabled && !imported) {
     // TPU clusters scale in whole slices (chips inside a slice are
     // indivisible) — the slice count drives a terraform re-apply + re-gate
     $("#d-scale-slices").addEventListener("click", () => {
@@ -417,7 +426,7 @@ async function openCluster(name) {
       await api("DELETE", `/api/v1/clusters/${name}/nodes/${b.dataset.rmNode}`);
       openCluster(name);
     }));
-  $("#d-comp-install").addEventListener("click", () => {
+  if (!imported) $("#d-comp-install").addEventListener("click", () => {
     const comp = $("#d-comp-select").value;
     const defaults = catalog[comp]?.vars || {};
     objDialog("install", [
@@ -434,7 +443,7 @@ async function openCluster(name) {
       await api("DELETE", `/api/v1/clusters/${name}/components/${b.dataset.unComp}`);
       openCluster(name);
     }));
-  $("#d-backup-now").addEventListener("click", async () => {
+  if (!imported) $("#d-backup-now").addEventListener("click", async () => {
     await api("POST", `/api/v1/clusters/${name}/backup`, {});
     openCluster(name);
   });
@@ -444,7 +453,7 @@ async function openCluster(name) {
                 { file: b.dataset.restore });
       openCluster(name);
     }));
-  $("#d-cis-run").addEventListener("click", async () => {
+  if (!imported) $("#d-cis-run").addEventListener("click", async () => {
     await api("POST", `/api/v1/clusters/${name}/cis-scans`, {});
     openCluster(name);
   });
