@@ -55,6 +55,7 @@ const I18N = {
     scale_slices: "＋ Add slices",
     renew_certs: "Renew certs", rotate_key: "Rotate secrets key",
     import_cluster: "Import cluster",
+    backup_schedule: "Schedule", retention: "Keep (count)", enabled: "Enabled",
   },
   zh: {
     sign_in: "登录", clusters: "集群", hosts: "主机", infra: "基础设施",
@@ -88,6 +89,7 @@ const I18N = {
     scale_slices: "＋ 扩容切片",
     renew_certs: "轮换证书", rotate_key: "轮换加密密钥",
     import_cluster: "导入集群",
+    backup_schedule: "定时策略", retention: "保留份数", enabled: "启用",
   },
 };
 let lang = localStorage.getItem("ko-lang") || "en";
@@ -316,7 +318,10 @@ async function openCluster(name) {
       <td>${esc(f.created_at || "")}</td>
       <td><button data-restore="${esc(f.file_name || f.name)}" class="ghost">${t("restore")}</button></td></tr>`).join("")}
     </table>
-    ${imported ? "" : `<div class="row"><button id="d-backup-now">${t("backup_now")}</button></div>`}
+    ${imported ? "" : `<div class="row">
+      <button id="d-backup-now">${t("backup_now")}</button>
+      <button id="d-backup-schedule" class="ghost">${t("backup_schedule")}</button>
+    </div>`}
 
     <h3>${t("security")}</h3>
     <table class="grid"><tr><th>scan</th><th>status</th><th>pass</th><th>fail</th><th>warn</th><th></th></tr>
@@ -446,6 +451,23 @@ async function openCluster(name) {
   if (!imported) $("#d-backup-now").addEventListener("click", async () => {
     await api("POST", `/api/v1/clusters/${name}/backup`, {});
     openCluster(name);
+  });
+  if (!imported) $("#d-backup-schedule").addEventListener("click", async () => {
+    const accounts = await api("GET", "/api/v1/backup-accounts").catch(() => []);
+    const current = await api(
+      "GET", `/api/v1/clusters/${name}/backup-strategy`).catch(() => null);
+    objDialog("backup_schedule", [
+      { key: "account", label: t("backup_accounts"), type: "select",
+        options: accounts.map((a) => a.name) },
+      { key: "cron", label: "Cron", value: current?.cron || "0 3 * * *" },
+      { key: "save_num", label: t("retention"), type: "number",
+        value: current?.save_num ?? 7 },
+      { key: "enabled", label: t("enabled"), type: "select",
+        options: ["true", "false"] },
+    ], (out) => api("POST", `/api/v1/clusters/${name}/backup-strategy`, {
+      account: out.account, cron: out.cron,
+      save_num: out.save_num, enabled: out.enabled === "true",
+    }).then(() => openCluster(name)));
   });
   detail.querySelectorAll("[data-restore]").forEach((b) =>
     b.addEventListener("click", async () => {
