@@ -152,6 +152,9 @@ class LocalClient:
             case ("POST", ["clusters", name, "restore"]):
                 s.backups.restore(name, body["file"])
                 return {"ok": True}
+            case ("POST", ["clusters", name, "recover"]):
+                s.health.recover(name, body["probe"])
+                return {"ok": True}
             case ("GET", ["clusters", name, "health"]):
                 return s.health.check(name).to_dict()
             case ("GET", ["clusters", name, "events"]):
@@ -327,6 +330,11 @@ def cmd_cluster(client, args) -> int:
             {"num_slices": args.slices})
         if not args.no_wait:
             return _poll_to_ready(client, args.name, args.timeout, False)
+        return 0
+    if args.cluster_cmd == "recover":
+        client.call("POST", f"/api/v1/clusters/{args.name}/recover",
+                    {"probe": args.probe})
+        print(f"recovery for probe {args.probe} completed")
         return 0
     if args.cluster_cmd == "cis-scan":
         if args.list:
@@ -565,6 +573,9 @@ def build_parser() -> argparse.ArgumentParser:
     scale.add_argument("name")
     scale.add_argument("--add", default="")
     scale.add_argument("--remove", default="")
+    rec = csub.add_parser("recover")
+    rec.add_argument("name")
+    rec.add_argument("probe", help="failed probe name from `cluster health`")
     cis = csub.add_parser("cis-scan")
     cis.add_argument("name")
     cis.add_argument("--list", action="store_true",

@@ -56,6 +56,7 @@ const I18N = {
     renew_certs: "Renew certs", rotate_key: "Rotate secrets key",
     import_cluster: "Import cluster",
     backup_schedule: "Schedule", retention: "Keep (count)", enabled: "Enabled",
+    recover: "Recover",
   },
   zh: {
     sign_in: "登录", clusters: "集群", hosts: "主机", infra: "基础设施",
@@ -90,6 +91,7 @@ const I18N = {
     renew_certs: "轮换证书", rotate_key: "轮换加密密钥",
     import_cluster: "导入集群",
     backup_schedule: "定时策略", retention: "保留份数", enabled: "启用",
+    recover: "修复",
   },
 };
 let lang = localStorage.getItem("ko-lang") || "en";
@@ -397,7 +399,17 @@ async function openCluster(name) {
   $("#d-health").addEventListener("click", async () => {
     const h = await api("GET", `/api/v1/clusters/${name}/health`);
     $("#d-health-out").innerHTML = '<div class="conds">' + h.probes.map((p) =>
-      `<span class="cond ${p.ok ? "OK" : "Failed"}">${esc(p.name)}</span>`).join("") + "</div>";
+      `<span class="cond ${p.ok ? "OK" : "Failed"}" title="${esc(p.detail || "")}">${esc(p.name)}` +
+      (!p.ok && p.recovery && !imported
+        ? ` <button data-recover="${esc(p.name)}" class="ghost">${t("recover")}</button>`
+        : "") + `</span>`).join("") + "</div>";
+    // guided recovery: re-runs the adm phase matching the failed probe
+    $("#d-health-out").querySelectorAll("[data-recover]").forEach((b) =>
+      b.addEventListener("click", async () => {
+        await api("POST", `/api/v1/clusters/${name}/recover`,
+                  { probe: b.dataset.recover });
+        openCluster(name);
+      }));
   });
   if (!imported) $("#d-upgrade").addEventListener("click", () => {
     objDialog("upgrade", [
