@@ -56,7 +56,9 @@ const I18N = {
     renew_certs: "Renew certs", rotate_key: "Rotate secrets key",
     import_cluster: "Import cluster",
     backup_schedule: "Schedule", retention: "Keep (count)", enabled: "Enabled",
-    recover: "Recover",
+    recover: "Recover", sign_out: "Sign out",
+    app_backup: "App backup", app_restore: "App restore",
+    gather_facts: "Gather facts", add_member: "＋ Member",
   },
   zh: {
     sign_in: "登录", clusters: "集群", hosts: "主机", infra: "基础设施",
@@ -91,7 +93,9 @@ const I18N = {
     renew_certs: "轮换证书", rotate_key: "轮换加密密钥",
     import_cluster: "导入集群",
     backup_schedule: "定时策略", retention: "保留份数", enabled: "启用",
-    recover: "修复",
+    recover: "修复", sign_out: "退出登录",
+    app_backup: "应用备份", app_restore: "应用恢复",
+    gather_facts: "采集信息", add_member: "＋ 成员",
   },
 };
 let lang = localStorage.getItem("ko-lang") || "en";
@@ -122,6 +126,11 @@ function showLogin() {
   $("#login-view").hidden = false;
   $("#app-view").hidden = true;
 }
+$("#logout-btn").addEventListener("click", async () => {
+  await api("POST", "/api/v1/auth/logout").catch(() => {});
+  me = null;
+  showLogin();
+});
 async function boot() {
   applyI18n();
   try {
@@ -323,6 +332,9 @@ async function openCluster(name) {
     ${imported ? "" : `<div class="row">
       <button id="d-backup-now">${t("backup_now")}</button>
       <button id="d-backup-schedule" class="ghost">${t("backup_schedule")}</button>
+      ${comps.some((x) => x.name === "velero" && x.status === "Installed") ? `
+      <button id="d-app-backup" class="ghost">${t("app_backup")}</button>
+      <button id="d-app-restore" class="ghost">${t("app_restore")}</button>` : ""}
     </div>`}
 
     <h3>${t("security")}</h3>
@@ -464,6 +476,21 @@ async function openCluster(name) {
     await api("POST", `/api/v1/clusters/${name}/backup`, {});
     openCluster(name);
   });
+  if (!imported && comps.some((x) => x.name === "velero" && x.status === "Installed")) {
+    $("#d-app-backup").addEventListener("click", () => {
+      objDialog("app_backup", [
+        { key: "backup_name", label: t("name"), placeholder: "apps-1" },
+        { key: "namespaces", label: "Namespaces (csv, empty = all)" },
+      ], (out) => api("POST", `/api/v1/clusters/${name}/app-backup`, out)
+          .then(() => openCluster(name)));
+    });
+    $("#d-app-restore").addEventListener("click", () => {
+      objDialog("app_restore", [
+        { key: "backup_name", label: t("name") },
+      ], (out) => api("POST", `/api/v1/clusters/${name}/app-restore`, out)
+          .then(() => openCluster(name)));
+    });
+  }
   if (!imported) $("#d-backup-schedule").addEventListener("click", async () => {
     const accounts = await api("GET", "/api/v1/backup-accounts").catch(() => []);
     const current = await api(
@@ -790,7 +817,8 @@ async function refreshAll() {
       "<tr><th>name</th><th>ip</th><th>status</th><th>TPU</th><th></th></tr>" +
       hosts.map((h, i) => `<tr><td>${esc(h.name)}</td><td>${esc(h.ip)}</td><td>${h.status}</td>
         <td>${h.tpu_chips > 0 ? `${h.tpu_chips} chips · slice ${h.tpu_slice_id} · worker ${h.tpu_worker_id}` : "—"}</td>
-        <td><button data-host-detail="${i}" class="ghost">${t("details")}</button></td></tr>` +
+        <td><button data-host-detail="${i}" class="ghost">${t("details")}</button>
+            ${me?.is_admin && !h.cluster_id ? `<button data-host-facts="${esc(h.name)}" class="ghost">${t("gather_facts")}</button>` : ""}</td></tr>` +
         `<tr class="host-detail" id="host-detail-${i}" hidden><td colspan="5">
           <div class="muted">
             os ${esc(h.os || "?")} · arch ${esc(h.arch || "?")} ·
@@ -801,6 +829,12 @@ async function refreshAll() {
       b.addEventListener("click", () => {
         const row = $("#host-detail-" + b.dataset.hostDetail);
         row.hidden = !row.hidden;
+      }));
+    document.querySelectorAll("[data-host-facts]").forEach((b) =>
+      b.addEventListener("click", async () => {
+        await api("POST", `/api/v1/hosts/${b.dataset.hostFacts}/facts`)
+          .catch((e) => alert(e.message));
+        refreshAll();
       }));
   }
   if (!$("#tab-infra").hidden) refreshInfra();
@@ -861,8 +895,19 @@ async function refreshInfra() {
 async function refreshAdmin() {
   const projects = await api("GET", "/api/v1/projects").catch(() => []);
   $("#project-table").innerHTML =
-    "<tr><th>name</th><th>description</th></tr>" +
-    projects.map((p) => `<tr><td>${esc(p.name)}</td><td>${esc(p.description || "")}</td></tr>`).join("");
+    "<tr><th>name</th><th>description</th><th></th></tr>" +
+    projects.map((p) => `<tr><td>${esc(p.name)}</td><td>${esc(p.description || "")}</td>
+      <td><button data-add-member="${esc(p.name)}" class="ghost">${t("add_member")}</button></td></tr>`).join("");
+  const allUsers = await api("GET", "/api/v1/users").catch(() => []);
+  $("#project-table").querySelectorAll("[data-add-member]").forEach((b) =>
+    b.addEventListener("click", () => {
+      objDialog("add_member", [
+        { key: "user", label: t("users"), type: "select",
+          options: allUsers.map((u) => u.name) },
+        { key: "role", label: "Role", type: "select",
+          options: ["viewer", "manager"] },
+      ], (out) => api("POST", `/api/v1/projects/${b.dataset.addMember}/members`, out));
+    }));
   const users = await api("GET", "/api/v1/users").catch(() => []);
   $("#user-table").innerHTML =
     "<tr><th>name</th><th>email</th><th>role</th><th>source</th></tr>" +
