@@ -59,6 +59,8 @@ const I18N = {
     recover: "Recover", sign_out: "Sign out",
     app_backup: "App backup", app_restore: "App restore",
     gather_facts: "Gather facts", add_member: "＋ Member",
+    ldap: "LDAP", ldap_test: "Test connection", ldap_sync: "Sync users",
+    ldap_ok: "connection OK", ldap_synced: "synced",
   },
   zh: {
     sign_in: "登录", clusters: "集群", hosts: "主机", infra: "基础设施",
@@ -96,6 +98,8 @@ const I18N = {
     recover: "修复", sign_out: "退出登录",
     app_backup: "应用备份", app_restore: "应用恢复",
     gather_facts: "采集信息", add_member: "＋ 成员",
+    ldap: "LDAP", ldap_test: "测试连接", ldap_sync: "同步用户",
+    ldap_ok: "连接正常", ldap_synced: "已同步",
   },
 };
 let lang = localStorage.getItem("ko-lang") || "en";
@@ -806,6 +810,17 @@ $("#new-user-btn").addEventListener("click", () => {
     { key: "password", label: "Password", type: "password" },
     { key: "email", label: "Email" },
   ], (out) => api("POST", "/api/v1/users", out));
+});
+
+$("#ldap-test-btn").addEventListener("click", async () => {
+  const r = await api("POST", "/api/v1/ldap/test").catch((e) => ({ error: e.message }));
+  $("#ldap-out").textContent = r.error || (r.ok ? t("ldap_ok") : r.message || JSON.stringify(r));
+});
+$("#ldap-sync-btn").addEventListener("click", async () => {
+  const r = await api("POST", "/api/v1/ldap/sync").catch((e) => ({ error: e.message }));
+  $("#ldap-out").textContent = r.error ||
+    `${t("ldap_synced")}: ${r.created ?? 0} + ${r.updated ?? 0}`;
+  refreshAll();
 });
 
 /* ---------- tab refreshers ---------- */
