@@ -1,0 +1,101 @@
+"""Shared plumbing for the fake ansible binaries in this directory.
+
+The shims run with `python3 -S` (site processing costs ~2s per fork in this
+image's ML venv; the lifecycle sweep forks ~35 times), so site-packages is
+not on sys.path. `import_yaml()` finds PyYAML across layouts — venv
+(lib/pythonX.Y/site-packages), Debian (dist-packages), user site — and as a
+last resort re-execs the shim without -S so an exotic layout degrades to
+slow-but-correct instead of an ImportError masquerading as 'ansible exited
+1'.
+"""
+import json
+import os
+import sys
+
+
+def import_yaml():
+    try:
+        import yaml  # exotic setups where -S still sees site-packages
+        return yaml
+    except ImportError:
+        pass
+    ver = "python%d.%d" % sys.version_info[:2]
+    prefix = os.path.dirname(os.path.dirname(sys.executable))
+    candidates = [
+        os.path.join(prefix, "lib", ver, "site-packages"),
+        "/usr/lib/python3/dist-packages",
+        os.path.expanduser(os.path.join("~", ".local", "lib", ver, "site-packages")),
+    ]
+    for cand in candidates:
+        if os.path.isdir(os.path.join(cand, "yaml")):
+            sys.path.append(cand)
+            try:
+                import yaml
+                return yaml
+            except ImportError:
+                sys.path.remove(cand)
+    # degrade: re-exec with full site processing (slow but correct)
+    if os.environ.get("KO_SHIM_NO_REEXEC"):
+        sys.stderr.write("shim: PyYAML not found in any known layout\n")
+        sys.exit(250)
+    os.environ["KO_SHIM_NO_REEXEC"] = "1"
+    os.execv(sys.executable, [sys.executable] + sys.argv)
+
+
+def fail(msg):
+    sys.stdout.write("SHIM-ARGV-ERROR: %s\n" % msg)
+    sys.stdout.flush()
+    sys.exit(250)
+
+
+def opt(argv, flag):
+    if flag not in argv:
+        fail("missing required flag %s" % flag)
+    idx = argv.index(flag)
+    if idx + 1 >= len(argv):
+        fail("flag %s has no value" % flag)
+    return argv[idx + 1]
+
+
+def load_inventory(yaml, argv):
+    """Read the `-i` inventory file; return (inventory, sorted host names).
+    Fails the way real ansible would on a missing/unparseable/empty one."""
+    inv_path = opt(argv, "-i")
+    if not os.path.isfile(inv_path):
+        fail("inventory not found: %s" % inv_path)
+    try:
+        with open(inv_path, encoding="utf-8") as f:
+            inventory = yaml.safe_load(f) or {}
+    except yaml.YAMLError as e:
+        fail("inventory does not parse: %s" % e)
+    hosts = sorted(inventory.get("all", {}).get("hosts", {}) or {})
+    if not hosts:
+        fail("inventory has no hosts under all.hosts")
+    return inventory, hosts
+
+
+def require_int_flag(argv, flag):
+    value = opt(argv, flag)
+    if not value.isdigit():
+        fail("%s must be an integer, got %r" % (flag, value))
+    return value
+
+
+def capture_invocation(binary, argv):
+    path = os.environ.get("KO_SHIM_CAPTURE")
+    if not path:
+        return
+    with open(path, "w") as f:
+        json.dump(
+            {
+                "binary": binary,
+                "argv": argv,
+                "cwd": os.getcwd(),
+                "env": {
+                    k: v
+                    for k, v in os.environ.items()
+                    if k.startswith("ANSIBLE_")
+                },
+            },
+            f,
+        )

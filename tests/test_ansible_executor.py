@@ -135,6 +135,158 @@ class TestRecapParse:
         assert state.result.host_stats == {}
 
 
+SHIM_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "shims")
+
+
+@pytest.fixture
+def shimmed_ansible(monkeypatch, tmp_path):
+    """Prepend the fake ansible binaries to PATH (VERDICT r2 #1) so the full
+    materialize->fork->stream->recap-parse pipeline executes in this image.
+    Returns a helper that reads back what the shim captured about its
+    invocation (argv, cwd, ANSIBLE_* env)."""
+    capture = tmp_path / "shim_capture.json"
+    monkeypatch.setenv("PATH", SHIM_DIR + os.pathsep + os.environ["PATH"])
+    monkeypatch.setenv("KO_SHIM_CAPTURE", str(capture))
+    monkeypatch.delenv("KO_SHIM_SCENARIO", raising=False)
+
+    def read_capture():
+        with open(capture, encoding="utf-8") as f:
+            return json.load(f)
+
+    return read_capture
+
+
+class TestShimmedPipelineE2E:
+    """AnsibleExecutor end-to-end against the real content project dir, with
+    `ansible-playbook` replaced by tests/shims/ansible-playbook — a script
+    that validates its argv/inventory/extra-vars the way the real binary
+    would and replays captured real-ansible stdout (success, failing-host
+    recap, unreachable host). This is the only place the real fork path
+    (`_execute`: Popen, line streaming, recap parsing, rc mapping) runs in
+    images without ansible (SURVEY.md §2.1 row 3)."""
+
+    def _executor(self):
+        return AnsibleExecutor(fork_limit=13)  # real content dir
+
+    def test_success_run_streams_and_parses_recap(self, shimmed_ansible):
+        ex = self._executor()
+        task_id = ex.run(TaskSpec(
+            playbook="05-etcd.yml",
+            inventory=_inventory(),
+            extra_vars={"k8s_version": "v1.29.4", "msg": 'q"uo te'},
+        ))
+        lines = list(ex.watch(task_id, timeout_s=60))
+        result = ex.result(task_id)
+
+        assert result.status == TaskStatus.SUCCESS.value and result.rc == 0
+        # streamed stdout reached watch() in ansible shape
+        assert any(line.startswith("TASK [") for line in lines)
+        assert any(line.startswith("ok: [m1]") for line in lines)
+        assert not any("SHIM-ARGV-ERROR" in line for line in lines)
+        # recap parsed into per-host stats through the live stream
+        assert result.host_stats["m1"].ok == 3
+        assert result.host_stats["w1"].changed == 1
+        assert result.host_stats["w1"].failed == 0
+
+        # the shim saw exactly what a real ansible-playbook would have
+        cap = shimmed_ansible()
+        assert cap["binary"] == "ansible-playbook"
+        assert cap["argv"][1].endswith(os.path.join("playbooks", "05-etcd.yml"))
+        assert cap["argv"][cap["argv"].index("--forks") + 1] == "13"
+        assert cap["cwd"] == ex.project_dir
+        assert cap["env"]["ANSIBLE_HOST_KEY_CHECKING"] == "False"
+        assert cap["env"]["ANSIBLE_ROLES_PATH"].endswith("roles")
+
+    def test_failing_host_recap(self, shimmed_ansible, monkeypatch):
+        monkeypatch.setenv("KO_SHIM_SCENARIO", "failed_host")
+        ex = self._executor()
+        task_id = ex.run(TaskSpec(
+            playbook="07-kube-master.yml", inventory=_inventory(),
+        ))
+        result = ex.wait(task_id, timeout_s=60)
+        lines = list(ex.watch(task_id, timeout_s=5))
+
+        assert result.status == TaskStatus.FAILED.value
+        assert result.rc == 2 and "exited 2" in result.message
+        assert any("FAILED! =>" in line for line in lines)
+        # the failing host is identifiable from parsed stats (adm uses this)
+        assert result.host_stats["w1"].failed == 1
+        assert result.host_stats["m1"].failed == 0
+        assert result.host_stats["m1"].ok > 0
+
+    def test_unreachable_host_recap(self, shimmed_ansible, monkeypatch):
+        monkeypatch.setenv("KO_SHIM_SCENARIO", "unreachable")
+        ex = self._executor()
+        task_id = ex.run(TaskSpec(
+            playbook="01-base.yml", inventory=_inventory(),
+        ))
+        result = ex.wait(task_id, timeout_s=60)
+        lines = list(ex.watch(task_id, timeout_s=5))
+
+        assert result.status == TaskStatus.FAILED.value and result.rc == 4
+        assert any("UNREACHABLE!" in line for line in lines)
+        assert result.host_stats["w1"].unreachable == 1
+        assert result.host_stats["m1"].unreachable == 0
+
+    def test_missing_playbook_fails_like_real_ansible(self, shimmed_ansible):
+        ex = self._executor()
+        task_id = ex.run(TaskSpec(
+            playbook="does-not-exist.yml", inventory=_inventory(),
+        ))
+        result = ex.wait(task_id, timeout_s=60)
+        assert result.status == TaskStatus.FAILED.value
+        assert any(
+            "SHIM-ARGV-ERROR" in line and "playbook not found" in line
+            for line in ex.watch(task_id, timeout_s=5)
+        )
+
+    def test_key_material_never_reaches_argv_and_is_0600(self, shimmed_ansible):
+        """The shim itself rejects raw key content in the inventory and
+        non-0600 key files (it exits 250), so a green run proves the
+        credential-handling contract held at the process boundary."""
+        ex = self._executor()
+        task_id = ex.run(TaskSpec(
+            playbook="03-pki.yml", inventory=_inventory(),
+        ))
+        result = ex.wait(task_id, timeout_s=60)
+        assert result.status == TaskStatus.SUCCESS.value
+        cap = shimmed_ansible()
+        assert not any("OPENSSH PRIVATE KEY" in a for a in cap["argv"])
+
+    def test_adhoc_e2e_through_fake_ansible(self, shimmed_ansible):
+        ex = self._executor()
+        task_id = ex.run_adhoc(
+            "ping", "", inventory=_inventory(), pattern="kube-master",
+        )
+        result = ex.wait(task_id, timeout_s=60)
+        lines = list(ex.watch(task_id, timeout_s=5))
+
+        assert result.status == TaskStatus.SUCCESS.value
+        assert any('m1 | SUCCESS' in line for line in lines)
+        assert not any("w1 |" in line for line in lines)  # pattern honored
+        cap = shimmed_ansible()
+        assert cap["binary"] == "ansible"
+        assert cap["argv"][1] == "kube-master"
+
+    def test_every_lifecycle_playbook_materializes_and_runs(self, shimmed_ansible):
+        """Sweep the real content dir: every numbered lifecycle playbook must
+        survive the shim's real-binary-style validation (playbook parses as
+        plays, inventory/vars files well-formed). Catches a playbook that
+        simulation never reaches but real ansible would reject at load."""
+        ex = self._executor()
+        playbooks = sorted(
+            p for p in os.listdir(os.path.join(ex.project_dir, "playbooks"))
+            if p.endswith(".yml")
+        )
+        assert len(playbooks) >= 20
+        for pb in playbooks:
+            task_id = ex.run(TaskSpec(playbook=pb, inventory=_inventory()))
+            result = ex.wait(task_id, timeout_s=60)
+            assert result.status == TaskStatus.SUCCESS.value, (
+                pb, list(ex.watch(task_id, timeout_s=5)),
+            )
+
+
 @pytest.mark.skipif(not ansible_available(), reason="ansible not installed")
 def test_localhost_playbook_e2e(tmp_path):
     """Real fork of ansible-playbook against localhost (runs where the
