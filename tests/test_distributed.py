@@ -45,11 +45,10 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_psum_over_hostenv_contract():
-    topo = parse_accelerator_type("v5p-16")  # 2 hosts x 4 chips
-    assert topo.total_hosts == 2
-    envs = host_envs(topo, "127.0.0.1", port=_free_port())
-
+def _run_workers(envs, worker_src, local_devices, marker, timeout=150):
+    """Spawn one pure-CPU worker process per HostEnv and collect the values
+    each printed after `marker`. Kills every sibling on any failure so a
+    crashed rank can't leave the other blocked in jax.distributed.initialize."""
     procs = []
     for henv in envs:
         env = {
@@ -61,23 +60,37 @@ def test_two_process_psum_over_hostenv_contract():
         }
         env.update(henv.to_env())
         env["JAX_PLATFORMS"] = "cpu"
-        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+        env["XLA_FLAGS"] = (
+            f"--xla_force_host_platform_device_count={local_devices}"
+        )
         env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
         procs.append(subprocess.Popen(
-            [sys.executable, "-c", WORKER], env=env,
+            [sys.executable, "-c", worker_src], env=env,
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
         ))
-
     results = []
-    for p in procs:
-        out, err = p.communicate(timeout=150)
-        assert p.returncode == 0, f"worker failed:\n{err[-2000:]}"
-        for line in out.splitlines():
-            if line.startswith("PSUM_RESULT"):
-                results.append(float(line.split()[1]))
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=timeout)
+            assert p.returncode == 0, f"worker failed:\n{err[-3000:]}"
+            for line in out.splitlines():
+                if line.startswith(marker):
+                    results.append(line[len(marker):].strip())
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    return results
 
+
+def test_two_process_psum_over_hostenv_contract():
+    topo = parse_accelerator_type("v5p-16")  # 2 hosts x 4 chips
+    assert topo.total_hosts == 2
+    envs = host_envs(topo, "127.0.0.1", port=_free_port())
+    results = _run_workers(envs, WORKER, local_devices=2, marker="PSUM_RESULT")
     # psum over 4 global devices: 2 hold 1.0 (rank 0), 2 hold 2.0 (rank 1)
-    assert results == [6.0, 6.0]
+    assert [float(r) for r in results] == [6.0, 6.0]
 
 
 # Ring attention with the sequence axis SPANNING the process boundary: each
@@ -126,25 +139,96 @@ print("RING_RESULT", "OK" if ok else "MISMATCH", flush=True)
 def test_two_process_ring_attention():
     topo = parse_accelerator_type("v5p-16")  # 2 hosts
     envs = host_envs(topo, "127.0.0.1", port=_free_port())
-    procs = []
-    for henv in envs:
-        env = {
-            k: v for k, v in os.environ.items()
-            if not k.startswith(("PALLAS_AXON", "AXON_", "TPU_", "MEGASCALE"))
-        }
-        env.update(henv.to_env())
-        env["JAX_PLATFORMS"] = "cpu"
-        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
-        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-        procs.append(subprocess.Popen(
-            [sys.executable, "-c", RING_WORKER], env=env,
-            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-        ))
-    results = []
-    for p in procs:
-        out, err = p.communicate(timeout=240)
-        assert p.returncode == 0, f"ring worker failed:\n{err[-3000:]}"
-        for line in out.splitlines():
-            if line.startswith("RING_RESULT"):
-                results.append(line.split()[1])
+    results = _run_workers(
+        envs, RING_WORKER, local_devices=2, marker="RING_RESULT", timeout=240
+    )
     assert results == ["OK", "OK"]
+
+
+# --- multislice across real process boundaries (VERDICT r2 #2) ---
+#
+# Two v5e-4 slices, one process per slice: the exact bootstrap the
+# multislice JobSet ships (BASELINE config #5). Each worker must see the
+# MEGASCALE_*/slice-id env contract materialize, join a 2-process global
+# runtime, build the dcn-leading mesh from the SAME SliceTopology the plan
+# layer resolves, and prove a dcn-axis psum crosses the slice boundary.
+MULTISLICE_WORKER = """
+import os
+# the env contract host_envs emitted for this rank, as the JobSet would
+slice_id = int(os.environ["KO_TPU_SLICE_ID"])
+assert os.environ["MEGASCALE_NUM_SLICES"] == "2"
+assert int(os.environ["MEGASCALE_SLICE_ID"]) == slice_id
+assert os.environ["MEGASCALE_COORDINATOR_ADDRESS"].startswith("127.0.0.1:")
+# DCN coordinator is a distinct endpoint from the jax.distributed one
+assert (os.environ["MEGASCALE_COORDINATOR_ADDRESS"]
+        != os.environ["KO_TPU_COORDINATOR_ADDRESS"])
+
+from kubeoperator_tpu.parallel.multislice import initialize_from_env
+initialize_from_env()
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+from kubeoperator_tpu.parallel.mesh import mesh_for_topology, shard_map_compat
+from kubeoperator_tpu.parallel.topology import parse_accelerator_type
+
+topo = parse_accelerator_type("v5e-4", num_slices=2)
+assert jax.process_count() == 2, jax.process_count()
+assert jax.device_count() == topo.jax_device_count == 8, jax.device_count()
+
+mesh = mesh_for_topology(topo)
+assert mesh.axis_names == ("dcn", "ici_0", "ici_1"), mesh.axis_names
+assert dict(mesh.shape) == {"dcn": 2, "ici_0": 2, "ici_1": 2}
+
+# the dcn axis must fall on the process (= slice) boundary: every device
+# this process can address sits at dcn coordinate == its slice_id
+local = set(jax.local_devices())
+dcn_rows = mesh.devices  # shape (2, 2, 2)
+for dcn_idx in range(2):
+    for dev in dcn_rows[dcn_idx].flat:
+        if dev in local:
+            assert dcn_idx == slice_id, (dcn_idx, slice_id)
+
+# each slice contributes (slice_id + 1); psum over "dcn" crosses DCN only
+arr = jax.make_array_from_callback(
+    (2,), NamedSharding(mesh, P("dcn")),
+    lambda idx: np.full((1,), float(slice_id + 1), np.float32))
+summed = shard_map_compat(
+    lambda a: jax.lax.psum(a, "dcn"), mesh, in_specs=P("dcn"), out_specs=P())
+out = jax.jit(summed)(arr)
+print("DCN_PSUM", float(np.asarray(out)[0]), flush=True)
+"""
+
+
+def test_multislice_two_process_dcn_psum():
+    topo = parse_accelerator_type("v5e-4", num_slices=2)
+    assert topo.is_multislice and topo.total_hosts == 2
+    assert topo.hosts_per_slice == 1
+    envs = host_envs(topo, "127.0.0.1", port=_free_port())
+    assert [e.slice_id for e in envs] == [0, 1]
+    results = _run_workers(
+        envs, MULTISLICE_WORKER, local_devices=4, marker="DCN_PSUM"
+    )
+    # cross-slice sum: slice 0 held 1.0, slice 1 held 2.0 -> 3.0 on both
+    assert [float(r) for r in results] == [3.0, 3.0]
+
+
+def test_multislice_host_env_contract():
+    """The env blocks the JobSet templates in, for a multi-host multislice
+    (2 x v5e-16 = 8 host processes): global ranks are contiguous, slice_id
+    advances every hosts_per_slice ranks, and MEGASCALE_* appears only for
+    multislice topologies."""
+    topo = parse_accelerator_type("v5e-16", num_slices=2)
+    envs = host_envs(topo, "10.0.0.2", port=9000)
+    assert len(envs) == 8
+    assert [e.process_id for e in envs] == list(range(8))
+    assert [e.slice_id for e in envs] == [0, 0, 0, 0, 1, 1, 1, 1]
+    blocks = [e.to_env() for e in envs]
+    for b in blocks:
+        assert b["KO_TPU_COORDINATOR_ADDRESS"] == "10.0.0.2:9000"
+        assert b["KO_TPU_NUM_PROCESSES"] == "8"
+        assert b["MEGASCALE_COORDINATOR_ADDRESS"] == "10.0.0.2:9001"
+        assert b["MEGASCALE_NUM_SLICES"] == "2"
+
+    single = host_envs(parse_accelerator_type("v5e-16"), "10.0.0.2")
+    assert len(single) == 4
+    assert all("MEGASCALE_NUM_SLICES" not in e.to_env() for e in single)
