@@ -71,7 +71,50 @@ class SimulationExecutor(Executor):
             return [{"name": f"{role} : (no tasks file)"}]
         with open(path, encoding="utf-8") as f:
             tasks = yaml.safe_load(f) or []
-        return [t if isinstance(t, dict) else {"name": str(t)} for t in tasks]
+        tasks = [t if isinstance(t, dict) else {"name": str(t)} for t in tasks]
+        return self._expand_includes(tasks, os.path.dirname(path))
+
+    def _expand_includes(self, tasks: list[dict], base_dir: str) -> list[dict]:
+        """Splice `include_tasks:`/`import_tasks:` entries in place, the way
+        real ansible executes them. The include's own `when:` is prepended
+        onto every included task (real ansible semantics for both forms: the
+        condition is re-evaluated per child task, not once at include
+        time)."""
+        out: list[dict] = []
+        for task in tasks:
+            inc = None
+            for key in ("include_tasks", "ansible.builtin.include_tasks",
+                        "import_tasks", "ansible.builtin.import_tasks"):
+                if key in task:
+                    inc = task[key]
+                    break
+            if inc is None:
+                out.append(task)
+                continue
+            fname = inc.get("file") if isinstance(inc, dict) else inc
+            path = os.path.join(base_dir, str(fname))
+            if not os.path.exists(path):
+                raise ExecutorError(
+                    message=f"include_tasks file {fname!r} not found in {base_dir}"
+                )
+            with open(path, encoding="utf-8") as f:
+                sub = yaml.safe_load(f) or []
+            sub = [t if isinstance(t, dict) else {"name": str(t)} for t in sub]
+            inc_when = task.get("when")
+            for child in self._expand_includes(sub, base_dir):
+                if inc_when is not None:
+                    child = dict(child)
+                    own = child.get("when")
+                    own_list = (
+                        own if isinstance(own, list)
+                        else [] if own is None else [own]
+                    )
+                    inc_list = (
+                        inc_when if isinstance(inc_when, list) else [inc_when]
+                    )
+                    child["when"] = inc_list + own_list
+                out.append(child)
+        return out
 
     @staticmethod
     def _render_debug(task: dict, context: dict) -> str | None:
@@ -232,7 +275,13 @@ class SimulationExecutor(Executor):
             for role in play.get("roles", []):
                 role_name = role["role"] if isinstance(role, dict) else str(role)
                 tasks.extend(self._role_tasks(role_name))
-            tasks.extend(play.get("tasks", []) or [])
+            play_tasks = [
+                t if isinstance(t, dict) else {"name": str(t)}
+                for t in play.get("tasks", []) or []
+            ]
+            tasks.extend(self._expand_includes(
+                play_tasks, os.path.join(self.project_dir, "playbooks")
+            ))
             for task in tasks:
                 tname = str(task.get("name", "unnamed task"))
                 host_ctxs = {

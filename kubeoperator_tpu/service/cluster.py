@@ -170,6 +170,31 @@ class ClusterService:
                 "kubeconfig must be a YAML mapping with a non-empty "
                 "'clusters' section"
             )
+        # The stored document is later fed to kubectl on the platform host
+        # (health probes, terminal). An exec:/auth-provider stanza would run
+        # arbitrary commands here with the server's privileges, and a
+        # file-path credential (tokenFile, client-certificate, client-key)
+        # would make kubectl read any platform-host file and send it to the
+        # kubeconfig's (attacker-chosen) server as the bearer token/cert.
+        # Admin-gated or not, refuse both classes at the door; inline
+        # *-data credentials and static tokens remain fine.
+        _forbidden_user_keys = (
+            "exec", "auth-provider", "tokenFile",
+            "client-certificate", "client-key",
+        )
+        for entry in doc.get("users") or []:
+            user = (entry or {}).get("user") if isinstance(entry, dict) else None
+            if not isinstance(user, dict):
+                continue
+            bad = [k for k in _forbidden_user_keys if k in user]
+            if bad:
+                uname = entry.get("name") or "?"
+                raise ValidationError(
+                    f"kubeconfig user {uname!r} uses {'/'.join(bad)}; "
+                    "import requires self-contained static credentials "
+                    "(token, client-certificate-data/client-key-data, or "
+                    "basic auth) — no credential plugins or host file paths"
+                )
         cluster = Cluster(
             name=name, project_id=project_id,
             provision_mode=ProvisionMode.IMPORTED.value,

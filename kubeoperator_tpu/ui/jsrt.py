@@ -47,6 +47,13 @@ def get(obj, key, default):
     return obj.get(key, default)
 
 
+def num(x):
+    """Numeric assertion (JS: Number(x)). Identity for numbers; raises in
+    Python (and yields NaN in JS) for lists/dicts — used to mark an operand
+    of ==/!= as provably scalar for the transpiler's equality guard."""
+    return x + 0
+
+
 def round2(x):
     """Round to 2 decimals, half-away-from-zero for positives — identical
     formula both sides (Python round() would use banker's rounding)."""

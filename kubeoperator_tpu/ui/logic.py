@@ -142,7 +142,7 @@ def plan_form_errors(form, catalog):
         default_dims = parse_mesh(jsrt.get(entry, "ici_mesh", ""))
         if dims is None:
             errors.append(f"unparseable slice topology {topology}")
-        elif mesh_product(dims) != chips:
+        elif jsrt.num(mesh_product(dims)) != chips:
             errors.append(
                 f"topology {topology} has {mesh_product(dims)} chips "
                 f"but {tpu_type} is {chips}"
@@ -161,7 +161,7 @@ def plan_form_errors(form, catalog):
     expected = jsrt.get(entry, "hosts_per_slice", 0) * slices
     if workers is None or workers < 0:
         errors.append("worker count must be a non-negative integer")
-    elif workers != 0 and workers != expected:
+    elif workers != 0 and workers != jsrt.num(expected):
         errors.append(
             f"{tpu_type} x{slices} slice(s) need exactly {expected} "
             f"TPU hosts, worker_count says {workers}"

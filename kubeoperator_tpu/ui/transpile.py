@@ -44,6 +44,7 @@ JS_PRELUDE = textwrap.dedent("""\
         if (o === null || o === undefined) return d;
         return Object.prototype.hasOwnProperty.call(o, k) ? o[k] : d;
       },
+      num: function (x) { return Number(x); },
       round2: function (x) { return Math.floor(x * 100.0 + 0.5) / 100.0; },
       len: function (x) {
         if (x === null || x === undefined) return 0;
@@ -74,12 +75,54 @@ _CMP_MAP = {
     ast.Lt: "<", ast.LtE: "<=", ast.Gt: ">", ast.GtE: ">=",
 }
 
-_BIN_MAP = {ast.Add: "+", ast.Sub: "-", ast.Mult: "*", ast.Div: "/",
-            ast.Mod: "%"}
+# Mod is deliberately absent: Python's floored modulo and JS's truncated
+# modulo diverge on negative operands, and no JS engine executes the output
+# under test — a divergence would ship silently. Use _rt helpers if needed.
+_BIN_MAP = {ast.Add: "+", ast.Sub: "-", ast.Mult: "*", ast.Div: "/"}
 
 
 def _err(node: ast.AST, msg: str) -> TranspileError:
     return TranspileError(f"line {getattr(node, 'lineno', '?')}: {msg}")
+
+
+_SCALAR_CALLS = {"len", "str", "min", "max", "abs"}  # the builtins _call maps
+_SCALAR_METHODS = {"strip", "lower", "upper", "startswith", "endswith"}
+
+
+def _scalar_operand(node: ast.AST) -> bool:
+    """True when `node` provably evaluates to a scalar (string/number/bool/
+    None) in both runtimes, making ==/!= safe to map onto JS ===/!==."""
+    if isinstance(node, ast.Constant):
+        return not isinstance(node.value, (tuple, frozenset))
+    if isinstance(node, ast.JoinedStr):
+        return True
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, (ast.USub, ast.Not)):
+        return _scalar_operand(node.operand)
+    if isinstance(node, ast.BinOp) and not isinstance(node.op, (ast.Add, ast.Mult)):
+        # -, /, // only ever produce numbers; + and * concatenate/repeat
+        # sequences in Python but not JS, so they don't prove scalarness.
+        return True
+    if isinstance(node, ast.Compare):
+        return True  # comparisons yield bools
+    if isinstance(node, ast.BoolOp):
+        # and/or return an OPERAND (possibly a list/dict), not a bool —
+        # scalar only when every operand is
+        return all(_scalar_operand(v) for v in node.values)
+    if isinstance(node, ast.Call):
+        if isinstance(node.func, ast.Name) and node.func.id in _SCALAR_CALLS:
+            return True
+        if isinstance(node.func, ast.Attribute):
+            if node.func.attr in _SCALAR_METHODS:
+                return True
+            # every jsrt helper except get() returns a scalar by contract
+            # (jsrt.num exists precisely to mark an operand scalar here)
+            if (
+                isinstance(node.func.value, ast.Name)
+                and node.func.value.id == "jsrt"
+                and node.func.attr != "get"
+            ):
+                return True
+    return False
 
 
 class _FunctionEmitter:
@@ -286,6 +329,19 @@ class _FunctionEmitter:
         sym = _CMP_MAP.get(type(op))
         if sym is None:
             raise _err(node, f"unsupported comparison {type(op).__name__}")
+        if isinstance(op, (ast.Eq, ast.NotEq)) and not (
+            _scalar_operand(right) or _scalar_operand(left)
+        ):
+            # Python == is value equality for lists/dicts; JS === is
+            # reference equality. Allow only comparisons where one side is
+            # provably scalar so the divergence can't ship untested.
+            raise _err(
+                node,
+                "==/!= needs one provably-scalar operand (literal, "
+                "f-string, len()/str()/abs() call, or jsrt.num()/"
+                "jsrt.parse_int()); list/dict equality diverges between "
+                "Python and JS",
+            )
         return f"({l} {sym} {r})"
 
     def _fstring(self, node: ast.JoinedStr) -> str:

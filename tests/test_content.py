@@ -336,6 +336,10 @@ def test_master_upgrade_drains_and_uncordons():
         < role.index("kubeadm upgrade apply")
     assert role.index("wait for master Ready again") \
         < role.index("uncordon master")
+    # ADVICE r2: an unmanaged pod on the master must not abort the upgrade
+    # before anything changed — drain carries --force
+    drain_block = role[role.index("drain master"):role.index("unhold kube")]
+    assert "--force" in drain_block
 
 
 def test_containerd_runc_runtime_type_declared():
@@ -360,9 +364,55 @@ def test_encryption_rotation_is_two_phase_safe():
     role = open(os.path.join(
         CONTENT, "roles/rotate-encryption-key/tasks/main.yml"),
         encoding="utf-8").read()
-    assert "old_secrets" in role and "identity: {}" in role
+    assert "identity: {}" in role
     assert role.index("prepend a fresh secretbox key") \
-        < role.index("restart apiserver static pods")
-    assert role.index("restart apiserver static pods") \
+        < role.index("roll out prepended encryption config")
+    assert role.index("roll out prepended encryption config") \
         < role.index("re-encrypt every secret")
-    assert "distribute rotated encryption config" in role
+    # kubernetes looks decryption keys up BY NAME from the ciphertext
+    # prefix — both rewrites must carry existing (name, secret) pairs over
+    # verbatim, never rename them
+    assert role.count("awk '/- name:/{n=$NF} /secret:/{print n\"=\"$NF}'") == 2
+    assert 'name: ${p%%=*}' in role and 'secret: ${p#*=}' in role
+    assert "old$n" not in role and "name: prev" not in role
+    # ADVICE r2: superseded keys must NOT be retained forever (each one is
+    # a live decryption oracle) — after the rewrite the role prunes down to
+    # head + one predecessor, and only AFTER re-encrypt succeeded
+    assert role.index("re-encrypt every secret") \
+        < role.index("prune superseded keys")
+    prune = role[role.index("prune superseded keys"):]
+    assert "sed -n '1,2p'" in prune           # keep exactly two pairs
+    assert "roll out pruned encryption config" in prune
+    # the shared rollout include restarts apiservers and waits healthy
+    dist = open(os.path.join(
+        CONTENT, "roles/rotate-encryption-key/tasks/distribute.yml"),
+        encoding="utf-8").read()
+    assert "restart apiserver static pods" in dist
+    assert "wait for apiserver healthy" in dist
+    assert dist.index("distribute encryption config") \
+        < dist.index("restart apiserver static pods")
+
+
+def test_rotation_include_expands_in_simulation(tmp_path):
+    """The simulator executes include_tasks like real ansible: the rotation
+    playbook's stream shows the shared rollout block twice (after prepend,
+    after prune), in order."""
+    from kubeoperator_tpu.executor.simulation import SimulationExecutor
+    ex = SimulationExecutor()
+    task_id = ex.run_playbook(
+        "25-rotate-encryption-key.yml",
+        inventory={"all": {"hosts": {"m1": {}, "m2": {}},
+                           "children": {"kube-master": {"hosts": {"m1": {}, "m2": {}}}}}},
+        extra_vars={"ko_simulation": True, "cluster_name": "c1",
+                    "pki_cache_dest": str(tmp_path) + "/"},
+    )
+    result = ex.wait(task_id, timeout_s=30)
+    assert result.ok, list(ex.watch(task_id, timeout_s=5))
+    lines = "\n".join(ex.watch(task_id, timeout_s=5))
+    assert lines.count("fetch encryption config to the platform cache") == 2
+    # (tasks skipped by `when: not ko_simulation` emit no TASK header)
+    prepend_at = lines.index("prepend a fresh secretbox key")
+    first_roll = lines.index("fetch encryption config")
+    prune_at = lines.index("prune superseded keys")
+    second_roll = lines.rindex("fetch encryption config")
+    assert prepend_at < first_roll < prune_at < second_roll

@@ -235,6 +235,17 @@ class TestTrainSmoke:
         assert result["losses"][-1] < result["losses"][0]
         assert result["mesh"] == {"dp": 1, "pp": 2, "sp": 2, "tp": 2}
 
+    def test_single_step_runs_exactly_once(self):
+        """ADVICE r2: steps=1 must execute one step (not two) and gate on
+        finiteness alone — no loss pair exists to compare."""
+        from kubeoperator_tpu.ops import run_train_smoke
+
+        result = run_train_smoke(steps=1)
+        assert len(result["losses"]) == 1
+        assert result["finite"] is True
+        assert result["descending"] is True   # vacuous for a single loss
+        assert result["ok"] is True
+
     def test_smoke_gate_folds_train_result(self, monkeypatch):
         """smoke_train_steps > 0 (KO_TPU_TRAIN_STEPS) deepens the Ready
         gate: the psum result carries the train block and its ok."""

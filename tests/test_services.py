@@ -520,6 +520,60 @@ class TestClusterImport:
         with pytest.raises(ConflictError):
             svc.clusters.import_cluster("dup", KUBECONFIG_DOC)
 
+    def test_import_rejects_credential_plugin_kubeconfigs(self, svc):
+        """ADVICE r2: a kubeconfig whose user entry carries an exec: or
+        auth-provider: stanza would execute arbitrary commands on the
+        platform host whenever kubectl probes the cluster — refuse at
+        import time, before the document is ever stored."""
+        exec_doc = KUBECONFIG_DOC.replace(
+            "users: []",
+            "users:\n"
+            "  - name: evil\n"
+            "    user:\n"
+            "      exec:\n"
+            "        apiVersion: client.authentication.k8s.io/v1\n"
+            "        command: /tmp/pwn.sh\n",
+        )
+        with pytest.raises(ValidationError, match="uses exec"):
+            svc.clusters.import_cluster("evil", exec_doc)
+
+        ap_doc = KUBECONFIG_DOC.replace(
+            "users: []",
+            "users:\n"
+            "  - name: legacy\n"
+            "    user:\n"
+            "      auth-provider:\n"
+            "        name: gcp\n",
+        )
+        with pytest.raises(ValidationError, match="uses auth-provider"):
+            svc.clusters.import_cluster("legacy", ap_doc)
+
+        # file-path credentials exfiltrate arbitrary platform-host files to
+        # the kubeconfig's server — equally refused
+        for key in ("tokenFile", "client-certificate", "client-key"):
+            doc = KUBECONFIG_DOC.replace(
+                "users: []",
+                f"users:\n  - name: filey\n    user:\n      {key}: /etc/shadow\n",
+            )
+            with pytest.raises(ValidationError, match="host file paths"):
+                svc.clusters.import_cluster("filey", doc)
+
+        # nothing was persisted for any attempt
+        from kubeoperator_tpu.utils.errors import NotFoundError
+        for name in ("evil", "legacy", "filey"):
+            with pytest.raises(NotFoundError):
+                svc.clusters.get(name)
+
+        # static-credential users still import fine
+        ok_doc = KUBECONFIG_DOC.replace(
+            "users: []",
+            "users:\n"
+            "  - name: fine\n"
+            "    user:\n"
+            "      token: abc123\n",
+        )
+        assert svc.clusters.import_cluster("fine", ok_doc).name == "fine"
+
 
 class TestPlanClone:
     def test_clone_then_independent_scale(self, svc):
@@ -550,7 +604,7 @@ class TestEncryptionRotation:
         logs = "\n".join(l.line for l in svc.repos.task_logs.find(
             cluster_id=cluster.id))
         assert "TASK [prepend a fresh secretbox key on bootstrap master]" in logs
-        assert "TASK [fetch rotated encryption config" in logs
+        assert "TASK [fetch encryption config to the platform cache" in logs
         events = svc.events.list(cluster.id)
         assert any(e.reason == "EncryptionKeyRotated" for e in events)
 

@@ -315,6 +315,36 @@ class TestTranspiler:
             with pytest.raises(TranspileError):
                 self.golden(src, [])
 
+    def test_runtime_divergent_constructs_rejected(self):
+        """ADVICE r2: constructs whose Python and JS semantics diverge must
+        fail at generation time, not ship untested — % (floored vs truncated
+        modulo) and ==/!= with no provably-scalar side (value vs reference
+        equality for lists/dicts)."""
+        cases = [
+            "def f(x):\n    return x % 3\n",
+            "def f(a, b):\n    return a == b\n",           # two bare names
+            "def f(a, b):\n    return a != b\n",
+            "def f(a, b):\n    return a == b.c\n",          # attribute side
+            # and/or return an operand, not a bool — a list can flow through
+            "def f(a, b, c):\n    return (a or b) == c\n",
+        ]
+        for src in cases:
+            with pytest.raises(TranspileError):
+                self.golden(src, [])
+
+    def test_scalar_sided_equality_accepted(self):
+        ok = [
+            "def f(a):\n    return a == 'ready'\n",         # literal
+            "def f(a, b):\n    return len(a) == b\n",       # len() call
+            "def f(a, b):\n    return a == len(b) - 1\n",   # scalar arithmetic
+            "import kubeoperator_tpu.ui.jsrt as jsrt\n"
+            "def f(a, b):\n    return a == jsrt.num(b)\n",  # explicit marker
+            # BoolOp over all-scalar operands stays allowed
+            "def f(a, b, c):\n    return (len(a) > 0 or b == 1) == c\n",
+        ]
+        for src in ok:
+            assert "function f(" in self.golden(src, [])
+
     def test_missing_public_name_rejected(self):
         with pytest.raises(TranspileError):
             self.golden("def f(x):\n    return x\n", ["f", "ghost"])
