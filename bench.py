@@ -138,11 +138,29 @@ def main() -> int:
         try:
             from kubeoperator_tpu.ops.train_smoke import run_train_smoke
 
-            tr = run_train_smoke(steps=4)
+            tr = run_train_smoke(
+                steps=4, peak_tflops_per_chip=gen.bf16_tflops_per_chip
+            )
             details["train_smoke_steps_per_s"] = tr["steps_per_s"]
             details["train_smoke_ok"] = tr["ok"]
         except Exception as e:
             details["train_smoke_ok"] = f"error: {type(e).__name__}"
+        # MFU at chip-filling scale (bf16, ~4.3 model-TFLOPs/step): the
+        # efficiency number comparable across configs (VERDICT r2 #9).
+        # Own try-block: an OOM here must not clobber the smoke verdict.
+        try:
+            from kubeoperator_tpu.ops.train_smoke import run_train_smoke
+            from kubeoperator_tpu.parallel.validation_net import BENCH_CONFIG
+
+            trb = run_train_smoke(
+                steps=12, peak_tflops_per_chip=gen.bf16_tflops_per_chip,
+                cfg=BENCH_CONFIG,
+            )
+            details["train_model_tflops_per_s"] = trb["model_tflops_per_s"]
+            details["train_mfu_pct"] = trb["mfu_pct"]
+            details["train_bench_ok"] = trb["ok"]
+        except Exception as e:
+            details["train_bench_ok"] = f"error: {type(e).__name__}"
         result = {
             "metric": f"{gen.name}_single_chip_mxu_bf16_tflops",
             "value": round(best_m.tflops, 1),

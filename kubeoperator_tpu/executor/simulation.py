@@ -74,12 +74,16 @@ class SimulationExecutor(Executor):
         tasks = [t if isinstance(t, dict) else {"name": str(t)} for t in tasks]
         return self._expand_includes(tasks, os.path.dirname(path))
 
-    def _expand_includes(self, tasks: list[dict], base_dir: str) -> list[dict]:
+    def _expand_includes(
+        self, tasks: list[dict], base_dir: str,
+        _chain: tuple[str, ...] = (),
+    ) -> list[dict]:
         """Splice `include_tasks:`/`import_tasks:` entries in place, the way
         real ansible executes them. The include's own `when:` is prepended
         onto every included task (real ansible semantics for both forms: the
         condition is re-evaluated per child task, not once at include
-        time)."""
+        time). `_chain` detects include cycles, which get the same typed
+        ExecutorError treatment as a missing file — not a RecursionError."""
         out: list[dict] = []
         for task in tasks:
             inc = None
@@ -92,7 +96,12 @@ class SimulationExecutor(Executor):
                 out.append(task)
                 continue
             fname = inc.get("file") if isinstance(inc, dict) else inc
-            path = os.path.join(base_dir, str(fname))
+            path = os.path.abspath(os.path.join(base_dir, str(fname)))
+            if path in _chain:
+                raise ExecutorError(
+                    message="include_tasks cycle: "
+                    + " -> ".join(_chain + (path,))
+                )
             if not os.path.exists(path):
                 raise ExecutorError(
                     message=f"include_tasks file {fname!r} not found in {base_dir}"
@@ -101,7 +110,9 @@ class SimulationExecutor(Executor):
                 sub = yaml.safe_load(f) or []
             sub = [t if isinstance(t, dict) else {"name": str(t)} for t in sub]
             inc_when = task.get("when")
-            for child in self._expand_includes(sub, base_dir):
+            for child in self._expand_includes(
+                sub, base_dir, _chain + (path,)
+            ):
                 if inc_when is not None:
                     child = dict(child)
                     own = child.get("when")

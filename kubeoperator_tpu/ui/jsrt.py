@@ -48,9 +48,10 @@ def get(obj, key, default):
 
 
 def num(x):
-    """Numeric assertion (JS: Number(x)). Identity for numbers; raises in
-    Python (and yields NaN in JS) for lists/dicts — used to mark an operand
-    of ==/!= as provably scalar for the transpiler's equality guard."""
+    """Numeric assertion: identity for numbers/bools, THROWS for everything
+    else on BOTH sides (JS twin type-checks rather than coercing — a
+    Number() coercion of '8' or [5] would silently re-open the value-vs-
+    reference divergence the transpiler's equality guard exists to stop)."""
     return x + 0
 
 

@@ -44,7 +44,12 @@ JS_PRELUDE = textwrap.dedent("""\
         if (o === null || o === undefined) return d;
         return Object.prototype.hasOwnProperty.call(o, k) ? o[k] : d;
       },
-      num: function (x) { return Number(x); },
+      num: function (x) {
+        if (typeof x !== "number" && typeof x !== "boolean") {
+          throw new TypeError("num() needs a number, got " + typeof x);
+        }
+        return Number(x);
+      },
       round2: function (x) { return Math.floor(x * 100.0 + 0.5) / 100.0; },
       len: function (x) {
         if (x === null || x === undefined) return 0;

@@ -31,6 +31,98 @@ def all_playbooks():
     return sorted(f for f in os.listdir(PLAYBOOKS) if f.endswith(".yml"))
 
 
+def _walk_task_files():
+    """Every YAML task list in content: playbooks (plays' inline tasks) and
+    every roles/*/tasks/*.yml (main.yml plus any include files)."""
+    for pb in all_playbooks():
+        path = os.path.join(PLAYBOOKS, pb)
+        with open(path, encoding="utf-8") as f:
+            plays = yaml.safe_load(f) or []
+        for play in plays:
+            if isinstance(play, dict):
+                yield path, [t for t in play.get("tasks") or []
+                             if isinstance(t, dict)]
+    for role in sorted(os.listdir(ROLES)):
+        tasks_dir = os.path.join(ROLES, role, "tasks")
+        if not os.path.isdir(tasks_dir):
+            continue
+        for fn in sorted(os.listdir(tasks_dir)):
+            if not fn.endswith(".yml"):
+                continue
+            path = os.path.join(tasks_dir, fn)
+            with open(path, encoding="utf-8") as f:
+                tasks = yaml.safe_load(f) or []
+            yield path, [t for t in tasks if isinstance(t, dict)]
+
+
+def _iter_strings(value):
+    if isinstance(value, str):
+        yield value
+    elif isinstance(value, dict):
+        for v in value.values():
+            yield from _iter_strings(v)
+    elif isinstance(value, list):
+        for v in value:
+            yield from _iter_strings(v)
+
+
+def test_every_content_expression_parses():
+    """VERDICT r2 #5: a typo'd `when:`/`failed_when:`/`until:`/loop or a
+    broken `{{ }}` anywhere in ANY task's args must fail here — not on a
+    real cluster that simulation flows happened never to reach. This is a
+    jinja2 *parse* gate (syntax), deliberately independent of which tasks
+    the simulated e2e executes."""
+    import jinja2
+
+    env = jinja2.Environment()
+    checked_exprs = 0
+    checked_templates = 0
+    errors = []
+    conditional_keys = ("when", "failed_when", "changed_when", "until")
+    for path, tasks in _walk_task_files():
+        rel = os.path.relpath(path, CONTENT)
+        for task in tasks:
+            for key in conditional_keys:
+                cond = task.get(key)
+                if cond is None:
+                    continue
+                conds = cond if isinstance(cond, list) else [cond]
+                for c in conds:
+                    if isinstance(c, bool):
+                        continue
+                    try:
+                        env.parse("{% if (" + str(c) + ") %}1{% endif %}")
+                        checked_exprs += 1
+                    except jinja2.TemplateError as e:
+                        errors.append(f"{rel}: {key}: {c!r}: {e}")
+            for text in _iter_strings(
+                {k: v for k, v in task.items() if k not in conditional_keys}
+            ):
+                if "{{" in text or "{%" in text:
+                    try:
+                        env.parse(text)
+                        checked_exprs += 1
+                    except jinja2.TemplateError as e:
+                        errors.append(f"{rel}: {text[:60]!r}: {e}")
+    # every template file must parse as jinja too
+    for role in sorted(os.listdir(ROLES)):
+        tdir = os.path.join(ROLES, role, "templates")
+        if not os.path.isdir(tdir):
+            continue
+        for fn in sorted(os.listdir(tdir)):
+            path = os.path.join(tdir, fn)
+            with open(path, encoding="utf-8") as f:
+                try:
+                    env.parse(f.read())
+                    checked_templates += 1
+                except jinja2.TemplateError as e:
+                    errors.append(f"roles/{role}/templates/{fn}: {e}")
+    assert not errors, "\n".join(errors)
+    # the gate is only meaningful if it actually saw the content
+    assert checked_exprs > 200, checked_exprs
+    assert checked_templates > 15, checked_templates
+
+
 def test_all_playbooks_parse_and_reference_existing_roles():
     assert all_playbooks(), "content/playbooks is empty"
     for pb in all_playbooks():

@@ -235,6 +235,27 @@ class TestTrainSmoke:
         assert result["losses"][-1] < result["losses"][0]
         assert result["mesh"] == {"dp": 1, "pp": 2, "sp": 2, "tp": 2}
 
+    def test_analytic_flops_and_mfu_reporting(self):
+        """VERDICT r2 #9: steps/s converts to achieved model TFLOP/s via the
+        net's analytic FLOPs, and to MFU% when a datasheet peak is given."""
+        from kubeoperator_tpu.ops import run_train_smoke
+        from kubeoperator_tpu.parallel import validation_net as vnet
+        from kubeoperator_tpu.parallel.validation_net import analytic_train_flops
+
+        result = run_train_smoke(steps=3, peak_tflops_per_chip=197.0)
+        import jax
+        mesh = vnet.build_mesh_for(jax.devices())
+        flops = analytic_train_flops(mesh)
+        assert flops > 0
+        want = result["steps_per_s"] * flops / 1e12
+        assert abs(result["model_tflops_per_s"] - want) < max(1e-4, want * 0.01)
+        peak = 197.0 * len(jax.devices())
+        assert abs(
+            result["mfu_pct"] - 100.0 * result["model_tflops_per_s"] / peak
+        ) < 0.01
+        # without a peak, no mfu key is fabricated
+        assert "mfu_pct" not in run_train_smoke(steps=1)
+
     def test_single_step_runs_exactly_once(self):
         """ADVICE r2: steps=1 must execute one step (not two) and gate on
         finiteness alone — no loss pair exists to compare."""
