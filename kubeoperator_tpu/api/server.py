@@ -384,6 +384,14 @@ class Handlers:
         )
         return json_response(account.to_public_dict(), status=201)
 
+    async def test_backup_account(self, request):
+        # reachability probe (socket-level), like LDAP's test button: a bad
+        # endpoint surfaces here, not in the 3am cron backup
+        result = await run_sync(
+            request, self.s.backups.test_account, request.match_info["name"]
+        )
+        return json_response(result)
+
     async def run_backup(self, request):
         body = await request.json() if request.can_read_body else {}
         record = await run_sync(request, self.s.backups.run_backup,
@@ -773,6 +781,8 @@ def create_app(services: Services) -> web.Application:
 
     r.add_get("/api/v1/backup-accounts", h.list_backup_accounts)
     r.add_post("/api/v1/backup-accounts", admin_guard(h.create_backup_account))
+    r.add_post("/api/v1/backup-accounts/{name}/test",
+               admin_guard(h.test_backup_account))
 
     h._crud_routes(app, "/api/v1/credentials", services.credentials,
                    Credential, ("name", "username", "password",

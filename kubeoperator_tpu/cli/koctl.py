@@ -69,9 +69,13 @@ class LocalClient:
 
     def call(self, method: str, path: str, body: dict | None = None):
         """Translate the REST surface onto services (subset koctl uses)."""
+        from urllib.parse import unquote
+
         s = self.services
         body = body or {}
-        parts = [p for p in path.split("/") if p][2:]  # drop api/v1
+        # unquote each segment so callers can percent-encode names exactly
+        # as they must for the REST transport
+        parts = [unquote(p) for p in path.split("/") if p][2:]  # drop api/v1
         try:
             return self._dispatch(s, method, parts, body)
         except KoError as e:
@@ -214,6 +218,10 @@ class LocalClient:
                 from kubeoperator_tpu.models import BackupAccount
 
                 return pub(s.backups.create_account(BackupAccount(**body)))
+            case ("GET", ["backup-accounts"]):
+                return pub(s.backups.list_accounts())
+            case ("POST", ["backup-accounts", name, "test"]):
+                return s.backups.test_account(name)
             case _:
                 raise SystemExit(
                     f"error: local transport has no route {method} "
@@ -616,6 +624,14 @@ def build_parser() -> argparse.ArgumentParser:
     apply_p = sub.add_parser("apply", help="apply a setup YAML")
     apply_p.add_argument("-f", "--file", required=True)
 
+    ba = sub.add_parser("backup-account", help="backup endpoint verbs")
+    basub = ba.add_subparsers(dest="ba_cmd", required=True)
+    basub.add_parser("list")
+    ba_test = basub.add_parser(
+        "test", help="probe the endpoint (like the console's test button)"
+    )
+    ba_test.add_argument("name")
+
     tpu = sub.add_parser("tpu")
     tsub = tpu.add_subparsers(dest="tpu_cmd", required=True)
     tsub.add_parser("catalog")
@@ -705,6 +721,17 @@ def main(argv: list[str] | None = None) -> int:
         return cmd_component(client, args)
     if args.cmd == "apply":
         return cmd_apply(client, args)
+    if args.cmd == "backup-account":
+        if args.ba_cmd == "list":
+            _print(client.call("GET", "/api/v1/backup-accounts"))
+            return 0
+        from urllib.parse import quote
+
+        result = client.call(
+            "POST", f"/api/v1/backup-accounts/{quote(args.name, safe='')}/test"
+        )
+        _print(result)
+        return 0 if result.get("ok") else 1
     if args.cmd == "tpu":
         return cmd_tpu(client, args)
     raise SystemExit(f"unknown command {args.cmd}")

@@ -43,6 +43,122 @@ class BackupService:
         acct = self.repos.backup_accounts.get_by_name(name)
         self.repos.backup_accounts.delete(acct.id)
 
+    def test_account(self, name: str, timeout_s: float = 5.0) -> dict:
+        """Reachability probe for a backup endpoint — the 'test connection'
+        button (VERDICT r2 #6): catch a bad endpoint at configure time, not
+        when the 3am cron backup fails. Socket-level by design (works
+        air-gapped, no cloud SDKs): S3/OSS endpoints must accept a TCP
+        connect and answer HTTP; SFTP must present an SSH banner; local
+        must be a writable directory. Updates the account's stored status.
+
+        Never raises on a broken *configuration* (bad port string,
+        unparseable endpoint, weird banner bytes) — a diagnostic that
+        crashes on exactly the malformed input it exists to diagnose would
+        be useless; everything maps to ok=False with the parse error."""
+        import time as _time
+
+        account = self.repos.backup_accounts.get_by_name(name)
+        t0 = _time.perf_counter()
+        try:
+            result = self._probe_account(account, timeout_s)
+        except (ValueError, TypeError, UnicodeError) as e:
+            result = {"ok": False,
+                      "message": f"account config invalid: {e}"}
+        result["latency_ms"] = round((_time.perf_counter() - t0) * 1000, 1)
+        result["type"] = account.type
+        account.status = "Valid" if result["ok"] else "Invalid"
+        self.repos.backup_accounts.save(account)
+        return result
+
+    def _probe_account(self, account: BackupAccount,
+                       timeout_s: float) -> dict:
+        import os as _os
+        import urllib.parse
+
+        if account.type == "local":
+            path = str(account.vars.get("dir", "")).strip()
+            if not path:
+                return {"ok": False, "message": "local account has no dir"}
+            if not _os.path.isdir(path):
+                return {"ok": False, "message": f"{path} is not a directory"}
+            if not _os.access(path, _os.W_OK):
+                return {"ok": False, "message": f"{path} is not writable"}
+            return {"ok": True, "message": f"{path} writable"}
+        if account.type in ("s3", "oss"):
+            endpoint = str(account.vars.get("endpoint", "")).strip()
+            if not endpoint:
+                return {"ok": False, "message": "account has no endpoint"}
+            if "//" not in endpoint:
+                endpoint = "https://" + endpoint
+            url = urllib.parse.urlsplit(endpoint)
+            port = url.port or (80 if url.scheme == "http" else 443)
+            return self._probe_tcp(
+                url.hostname or "", port, timeout_s,
+                expect="http" if url.scheme == "http" else "tls",
+            )
+        if account.type == "sftp":
+            host = str(account.vars.get("host", "")).strip()
+            port = int(account.vars.get("port", 22) or 22)
+            if not host:
+                return {"ok": False, "message": "account has no host"}
+            return self._probe_tcp(host, port, timeout_s, expect="ssh")
+        # pragma: no cover - validate() forbids other types
+        return {"ok": False, "message": f"untestable type {account.type}"}
+
+    @staticmethod
+    def _probe_tcp(host: str, port: int, timeout_s: float,
+                   expect: str | None = None) -> dict:
+        """TCP connect + protocol sniff: 'ssh' reads the server banner,
+        'http' sends a minimal HEAD and wants an HTTP status line back,
+        'tls' completes a TLS handshake (certificate NOT verified — this is
+        a reachability probe, not an authenticity check)."""
+        import socket
+        import ssl
+
+        try:
+            with socket.create_connection((host, port), timeout=timeout_s) as s:
+                s.settimeout(timeout_s)
+                if expect == "ssh":
+                    banner = s.recv(64)
+                    if not banner.startswith(b"SSH-"):
+                        return {
+                            "ok": False,
+                            "message": f"{host}:{port} answered but is not an "
+                                       f"SSH server ({banner[:20]!r})",
+                        }
+                    proto = banner.split()[0].decode(errors="replace")
+                    return {"ok": True, "message": f"{host}:{port} {proto}"}
+                if expect == "http":
+                    s.sendall(b"HEAD / HTTP/1.0\r\nHost: " +
+                              host.encode(errors="replace") + b"\r\n\r\n")
+                    status = s.recv(64)
+                    if not status.startswith(b"HTTP/"):
+                        return {
+                            "ok": False,
+                            "message": f"{host}:{port} answered but not HTTP "
+                                       f"({status[:20]!r})",
+                        }
+                    line = status.split(b"\r")[0].decode(errors="replace")
+                    return {"ok": True, "message": f"{host}:{port} {line}"}
+                if expect == "tls":
+                    ctx = ssl.create_default_context()
+                    ctx.check_hostname = False
+                    ctx.verify_mode = ssl.CERT_NONE
+                    try:
+                        with ctx.wrap_socket(s, server_hostname=host) as tls:
+                            proto = tls.version() or "TLS"
+                    except ssl.SSLError as e:
+                        return {
+                            "ok": False,
+                            "message": f"{host}:{port} answered but TLS "
+                                       f"handshake failed: {e}",
+                        }
+                    return {"ok": True,
+                            "message": f"{host}:{port} {proto} handshake OK"}
+                return {"ok": True, "message": f"{host}:{port} reachable"}
+        except (OSError, socket.timeout) as e:
+            return {"ok": False, "message": f"{host}:{port}: {e}"}
+
     # ---- strategies ----
     def set_strategy(self, cluster_name: str, account_name: str,
                      cron: str = "0 3 * * *", save_num: int = 7,

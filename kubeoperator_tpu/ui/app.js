@@ -856,8 +856,21 @@ async function refreshAll() {
   if (!$("#tab-backups").hidden) {
     const accounts = await api("GET", "/api/v1/backup-accounts").catch(() => []);
     $("#backup-account-table").innerHTML =
-      "<tr><th>name</th><th>type</th><th>bucket</th></tr>" +
-      accounts.map((a) => `<tr><td>${esc(a.name)}</td><td>${a.type}</td><td>${esc(a.bucket)}</td></tr>`).join("");
+      "<tr><th>name</th><th>type</th><th>bucket</th><th>status</th><th></th></tr>" +
+      accounts.map((a) => `<tr><td>${esc(a.name)}</td><td>${a.type}</td><td>${esc(a.bucket)}</td>` +
+        `<td>${esc(a.status || "")}</td>` +
+        `<td><button data-test-account="${esc(a.name)}" class="ghost">test</button></td></tr>`).join("");
+    $("#backup-account-table").querySelectorAll("[data-test-account]").forEach((b) =>
+      b.addEventListener("click", async () => {
+        b.disabled = true;
+        const r = await api("POST",
+          `/api/v1/backup-accounts/${encodeURIComponent(b.dataset.testAccount)}/test`)
+          .catch((e) => ({ ok: false, message: e.message }));
+        alert(`${b.dataset.testAccount}: ${r.ok ? "OK" : "FAILED"} — ` +
+              `${r.message || ""}${r.latency_ms ? ` (${r.latency_ms} ms)` : ""}`);
+        b.disabled = false;
+        refreshAll();
+      }));
   }
   if (!$("#tab-admin").hidden) refreshAdmin();
   if (!$("#tab-events").hidden) refreshEvents();

@@ -332,6 +332,54 @@ class TestKoctlTpuDiag:
         assert report["ring_attention"]["tflops"] == 5.0
 
 
+class TestBackupAccountTest:
+    def test_probe_route_and_console_button(self, client, tmp_path):
+        base, http, _ = client
+        assert http.post(f"{base}/api/v1/backup-accounts", json={
+            "name": "loc", "type": "local",
+            "vars": {"dir": str(tmp_path)}}).status_code == 201
+        r = http.post(f"{base}/api/v1/backup-accounts/loc/test")
+        assert r.status_code == 200
+        body = r.json()
+        assert body["ok"] is True and body["type"] == "local"
+        assert "latency_ms" in body
+        # unknown account maps 404
+        assert http.post(
+            f"{base}/api/v1/backup-accounts/ghost/test").status_code == 404
+        # the console wires the button against this exact route
+        app_js = http.get(f"{base}/ui/app.js").text
+        assert "/test" in app_js and "data-test-account" in app_js
+
+    def test_koctl_backup_account_verbs(self, capsys, monkeypatch, tmp_path):
+        from kubeoperator_tpu.cli.koctl import main as koctl
+
+        monkeypatch.chdir(tmp_path)
+        monkeypatch.setenv("KO_TPU_DB__PATH", str(tmp_path / "koctl.db"))
+        monkeypatch.setenv("KO_TPU_EXECUTOR__BACKEND", "simulation")
+        monkeypatch.setenv("KO_TPU_PROVISIONER__WORK_DIR", str(tmp_path / "tf"))
+        setup = tmp_path / "setup.yaml"
+        setup.write_text(
+            "backup_accounts:\n"
+            f"  - name: loc\n    type: local\n    vars: {{dir: {tmp_path}}}\n"
+        )
+        assert koctl(["--local", "apply", "-f", str(setup)]) == 0
+        assert koctl(["--local", "backup-account", "list"]) == 0
+        out = capsys.readouterr().out
+        assert "loc" in out
+        assert koctl(["--local", "backup-account", "test", "loc"]) == 0
+        out = capsys.readouterr().out
+        assert "writable" in out
+        # a dead endpoint exits non-zero (scriptable health check)
+        setup.write_text(
+            "backup_accounts:\n"
+            "  - name: dead\n    type: s3\n    bucket: b\n"
+            "    vars: {endpoint: 'http://127.0.0.1:1'}\n"
+        )
+        assert koctl(["--local", "apply", "-f", str(setup)]) == 0
+        capsys.readouterr()
+        assert koctl(["--local", "backup-account", "test", "dead"]) == 1
+
+
 class TestConsoleSurface:
     def test_components_catalog_and_ui_assets(self, client):
         base, session, _ = client

@@ -647,6 +647,106 @@ class TestTpuUpgradeRegate:
         assert cond.status == "OK"
 
 
+class TestBackupAccountProbe:
+    """VERDICT r2 #6: endpoint reachability at configure time (the console's
+    'test' button), against real local listeners — no cloud SDKs."""
+
+    @staticmethod
+    def _listener(respond):
+        import socket
+        import threading
+
+        srv = socket.socket()
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(1)
+        port = srv.getsockname()[1]
+
+        def serve():
+            try:
+                conn, _ = srv.accept()
+                with conn:
+                    respond(conn)
+            except OSError:
+                pass
+            finally:
+                srv.close()
+
+        threading.Thread(target=serve, daemon=True).start()
+        return port
+
+    def test_local_dir_probe(self, svc, tmp_path):
+        svc.backups.create_account(BackupAccount(
+            name="loc", type="local", vars={"dir": str(tmp_path)}))
+        r = svc.backups.test_account("loc")
+        assert r["ok"] and "writable" in r["message"]
+        assert r["latency_ms"] >= 0
+        svc.backups.create_account(BackupAccount(
+            name="locbad", type="local", vars={"dir": str(tmp_path / "nope")}))
+        r = svc.backups.test_account("locbad")
+        assert not r["ok"] and "not a directory" in r["message"]
+        # status persisted on the account row
+        by_name = {a.name: a for a in svc.backups.list_accounts()}
+        assert by_name["loc"].status == "Valid"
+        assert by_name["locbad"].status == "Invalid"
+
+    def test_sftp_banner_probe(self, svc):
+        port = self._listener(lambda c: c.sendall(b"SSH-2.0-KoTest\r\n"))
+        svc.backups.create_account(BackupAccount(
+            name="sftp-ok", type="sftp", bucket="b",
+            vars={"host": "127.0.0.1", "port": port}))
+        r = svc.backups.test_account("sftp-ok")
+        assert r["ok"] and "SSH-2.0-KoTest" in r["message"]
+
+        # something answers, but it isn't ssh
+        port2 = self._listener(lambda c: c.sendall(b"220 smtp ready\r\n"))
+        svc.backups.create_account(BackupAccount(
+            name="sftp-imposter", type="sftp", bucket="b",
+            vars={"host": "127.0.0.1", "port": port2}))
+        r = svc.backups.test_account("sftp-imposter")
+        assert not r["ok"] and "not an SSH server" in r["message"]
+
+    def test_s3_http_probe_and_refused(self, svc):
+        def http_respond(conn):
+            conn.recv(256)
+            conn.sendall(b"HTTP/1.1 403 Forbidden\r\n\r\n")
+
+        port = self._listener(http_respond)
+        svc.backups.create_account(BackupAccount(
+            name="s3-ok", type="s3", bucket="b",
+            vars={"endpoint": f"http://127.0.0.1:{port}"}))
+        r = svc.backups.test_account("s3-ok")
+        # any HTTP answer (even 403 without creds) proves the endpoint
+        assert r["ok"] and "403" in r["message"]
+
+        svc.backups.create_account(BackupAccount(
+            name="s3-dead", type="s3", bucket="b",
+            vars={"endpoint": "http://127.0.0.1:1"}))  # nothing listens on 1
+        r = svc.backups.test_account("s3-dead")
+        assert not r["ok"]
+        assert svc.backups.test_account("s3-dead")["type"] == "s3"
+
+    def test_missing_endpoint_fields(self, svc):
+        svc.backups.create_account(BackupAccount(
+            name="noep", type="s3", bucket="b"))
+        assert not svc.backups.test_account("noep")["ok"]
+        svc.backups.create_account(BackupAccount(
+            name="nohost", type="sftp", bucket="b"))
+        assert not svc.backups.test_account("nohost")["ok"]
+
+    def test_malformed_config_is_ok_false_not_a_crash(self, svc):
+        """The probe must diagnose broken config, not crash on it."""
+        svc.backups.create_account(BackupAccount(
+            name="badport", type="sftp", bucket="b",
+            vars={"host": "127.0.0.1", "port": "ssh"}))
+        r = svc.backups.test_account("badport")
+        assert not r["ok"] and "config invalid" in r["message"]
+        svc.backups.create_account(BackupAccount(
+            name="badep", type="s3", bucket="b",
+            vars={"endpoint": "https://host:notaport"}))
+        r = svc.backups.test_account("badep")
+        assert not r["ok"] and "config invalid" in r["message"]
+
+
 class TestBackup:
     def test_backup_restore_and_cron(self, svc):
         names = register_fleet(svc, 2)
