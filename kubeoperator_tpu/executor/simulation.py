@@ -110,11 +110,13 @@ class SimulationExecutor(Executor):
                 sub = yaml.safe_load(f) or []
             sub = [t if isinstance(t, dict) else {"name": str(t)} for t in sub]
             inc_when = task.get("when")
+            inc_vars = task.get("vars") or {}
             for child in self._expand_includes(
                 sub, base_dir, _chain + (path,)
             ):
-                if inc_when is not None:
+                if inc_when is not None or inc_vars:
                     child = dict(child)
+                if inc_when is not None:
                     own = child.get("when")
                     own_list = (
                         own if isinstance(own, list)
@@ -124,6 +126,10 @@ class SimulationExecutor(Executor):
                         inc_when if isinstance(inc_when, list) else [inc_when]
                     )
                     child["when"] = inc_list + own_list
+                if inc_vars:
+                    # include vars are visible to every child; a child's own
+                    # vars win (real ansible precedence)
+                    child["vars"] = {**inc_vars, **(child.get("vars") or {})}
                 out.append(child)
         return out
 
@@ -295,8 +301,9 @@ class SimulationExecutor(Executor):
             ))
             for task in tasks:
                 tname = str(task.get("name", "unnamed task"))
-                host_ctxs = {
-                    h: {
+
+                def _ctx_for(h: str) -> dict:
+                    ctx = {
                         **base_ctx,
                         **base_ctx["hostvars"].get(h, {}),
                         "inventory_hostname": h,
@@ -306,8 +313,27 @@ class SimulationExecutor(Executor):
                             if g != "all" and h in members
                         ),
                     }
-                    for h in play_hosts
-                }
+                    # task/include vars: templated lazily in real ansible, so
+                    # render their string values against the host context.
+                    # Real precedence: hostvars < task vars < -e extra-vars
+                    # (magic vars always win).
+                    tvars = {}
+                    for k, v in (task.get("vars") or {}).items():
+                        if isinstance(v, str) and "{{" in v:
+                            try:
+                                v = _jinja_env().from_string(v).render(**ctx)
+                            except jinja2.TemplateError:
+                                pass
+                        tvars[k] = v
+                    return {
+                        **ctx, **tvars, **extra_vars,
+                        "inventory_hostname": h,
+                        "group_names": ctx["group_names"],
+                        "groups": ctx["groups"],
+                        "hostvars": ctx["hostvars"],
+                    }
+
+                host_ctxs = {h: _ctx_for(h) for h in play_hosts}
                 warned: list[str] = []
 
                 def _warn_once(msg: str) -> None:
@@ -326,6 +352,13 @@ class SimulationExecutor(Executor):
                     continue
                 if task.get("run_once"):
                     active = active[:1]
+                if "{{" in tname:
+                    # real ansible renders templated task names in its output
+                    try:
+                        tname = _jinja_env().from_string(tname).render(
+                            **host_ctxs[active[0]])
+                    except jinja2.TemplateError:
+                        pass
                 state.emit(f"TASK [{tname}] " + "*" * 40)
                 if self.task_delay_s:
                     time.sleep(self.task_delay_s)

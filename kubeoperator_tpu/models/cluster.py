@@ -63,6 +63,8 @@ class ClusterSpec:
     pod_cidr: str = "10.244.0.0/16"
     lb_mode: str = "internal"              # internal haproxy+keepalived | external
     lb_endpoint: str = ""                  # required when lb_mode == external
+    kube_proxy_mode: str = "iptables"      # iptables | ipvs
+    nodelocaldns_enabled: bool = True      # per-node DNS cache DaemonSet
     helm_enabled: bool = True
     metrics_server_enabled: bool = True
     worker_count: int = 1
@@ -88,6 +90,22 @@ class ClusterSpec:
             raise ValidationError(f"unknown lb_mode {self.lb_mode}")
         if self.lb_mode == "external" and not self.lb_endpoint:
             raise ValidationError("external lb_mode needs lb_endpoint")
+        if self.kube_proxy_mode not in ("iptables", "ipvs"):
+            raise ValidationError(
+                f"unknown kube_proxy_mode {self.kube_proxy_mode}"
+            )
+        import ipaddress
+
+        for what, cidr in (("service_cidr", self.service_cidr),
+                           ("pod_cidr", self.pod_cidr)):
+            try:
+                net = ipaddress.ip_network(cidr, strict=False)
+            except ValueError as e:
+                raise ValidationError(f"{what} {cidr!r} is not a CIDR: {e}")
+            if net.num_addresses < 16:
+                # the DNS service ClusterIP is the tenth address of the
+                # service range; a tighter mask has no room for it
+                raise ValidationError(f"{what} {cidr!r} is too small (< /28)")
 
 
 @dataclass

@@ -21,6 +21,19 @@ JAX_PIN_PER_RUNTIME = {
 
 ARCHITECTURES = ("amd64", "arm64")
 
+# Single source of truth for component image tags (VERDICT r2 #4): the
+# content templates receive these as `<name>_version` extra-vars from
+# ClusterAdm, so the version an air-gapped cluster runs is exactly the
+# version this manifest bundles — no drift between inline template strings
+# and the offline registry.
+COMPONENT_VERSIONS = {
+    "calico": "v3.27.3",
+    "flannel": "v0.25.4",
+    "flannel_cni_plugin": "v1.4.1",
+    "node_local_dns": "1.23.1",
+    "pause": "3.9",
+}
+
 
 def bundle_manifest() -> dict:
     """Everything an air-gapped install must be able to serve."""
@@ -41,9 +54,10 @@ def bundle_manifest() -> dict:
                     "chrony")
     ]
     images = [
-        "images/pause-3.9.tar",
-        "images/calico-node.tar",
-        "images/flannel.tar",
+        f"images/pause-{COMPONENT_VERSIONS['pause']}.tar",
+        f"images/calico-node-{COMPONENT_VERSIONS['calico']}.tar",
+        f"images/flannel-{COMPONENT_VERSIONS['flannel']}.tar",
+        f"images/node-local-dns-{COMPONENT_VERSIONS['node_local_dns']}.tar",
         "images/cilium.tar",
         "images/metrics-server.tar",
         "images/ingress-nginx.tar",

@@ -48,7 +48,16 @@ MANIFEST_VARS = {
     "tpu_runtime_version": "v2-alpha-tpuv5-lite",
     "tpu_device_plugin_version": "v1.0",
     "tpu_smoke_min_gbps": 10,
+    "cluster_dns_ip": "10.96.0.10",
+    "nodelocaldns_ip": "169.254.20.10",
 }
+# image tags are pinned by the offline bundle (VERDICT r2 #4) — render with
+# exactly what ClusterAdm injects
+from kubeoperator_tpu.registry.manifest import COMPONENT_VERSIONS
+
+MANIFEST_VARS.update(
+    {f"{k}_version": v for k, v in COMPONENT_VERSIONS.items()}
+)
 
 
 def _gcp_setup(tpu: bool):

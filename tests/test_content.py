@@ -277,6 +277,157 @@ def test_base_role_configures_both_package_mirrors():
             assert "registry_url" in f.read(), f"{tpl} not registry-sourced"
 
 
+def test_kube_proxy_mode_threads_into_kubeadm_config():
+    """VERDICT r2 #4: plan -> extra-vars -> kubeadm template. Both modes
+    render a valid KubeProxyConfiguration document; ipvs adds strictARP."""
+    import jinja2
+
+    tpl = open(os.path.join(
+        ROLES, "kube-master", "templates", "kubeadm-config.yaml.j2"),
+        encoding="utf-8").read()
+    env = jinja2.Environment(undefined=jinja2.StrictUndefined)
+    base_ctx = {
+        "container_runtime": "containerd", "k8s_version": "v1.29.4",
+        "lb_mode": "internal", "lb_endpoint": "",
+        "registry_host": "127.0.0.1:8081",
+        "service_cidr": "10.96.0.0/16", "pod_cidr": "10.244.0.0/16",
+        "nodelocaldns_ip": "169.254.20.10",
+        "groups": {"etcd": ["m1"]},
+        "hostvars": {"m1": {"ansible_host": "10.0.0.11"}},
+    }
+    for mode, expect_arp in (("iptables", False), ("ipvs", True)):
+        rendered = env.from_string(tpl).render(
+            **base_ctx, kube_proxy_mode=mode)
+        docs = [d for d in yaml.safe_load_all(rendered) if d]
+        proxy = [d for d in docs
+                 if d.get("kind") == "KubeProxyConfiguration"]
+        assert len(proxy) == 1, f"mode {mode}: no KubeProxyConfiguration doc"
+        assert proxy[0]["mode"] == mode
+        assert ("ipvs" in proxy[0]) is expect_arp
+        if expect_arp:
+            assert proxy[0]["ipvs"]["strictARP"] is True
+
+
+def _network_extra_vars(**spec_kw):
+    from kubeoperator_tpu.adm import AdmContext
+    spec = ClusterSpec(**spec_kw)
+    cluster = Cluster(name="netdemo", spec=spec)
+    nodes, hosts, creds = make_fleet(n_masters=1, n_workers=1)
+    ctx = AdmContext(cluster=cluster, nodes=nodes, hosts_by_id=hosts,
+                     credentials_by_id=creds)
+    return ctx.inventory(), ctx.build_extra_vars()
+
+
+def test_ipvs_and_nodelocaldns_variants_in_simulation():
+    """The simulated e2e exercises both new knobs end-to-end: ipvs module
+    loading in the base phase, nodelocaldns rollout in the network phase,
+    and the off-switches skip cleanly."""
+    ex = SimulationExecutor()
+
+    inv, ev = _network_extra_vars(kube_proxy_mode="ipvs")
+    ev["ko_simulation"] = True
+    base = "\n".join(ex.watch(ex.run_playbook("01-base.yml", inv, ev)))
+    assert "load ipvs kernel modules" in base
+    net = "\n".join(ex.watch(ex.run_playbook("09-network.yml", inv, ev)))
+    assert "render nodelocaldns manifest" in net
+    assert "apply nodelocaldns" in net
+
+    inv, ev = _network_extra_vars(nodelocaldns_enabled=False)
+    ev["ko_simulation"] = True
+    assert ev["kube_proxy_mode"] == "iptables"   # default
+    base = "\n".join(ex.watch(ex.run_playbook("01-base.yml", inv, ev)))
+    assert "load ipvs kernel modules" not in base
+    net = "\n".join(ex.watch(ex.run_playbook("09-network.yml", inv, ev)))
+    assert "nodelocaldns" not in net
+
+
+def test_cluster_dns_ip_derivation():
+    from kubeoperator_tpu.adm.engine import _cluster_dns_ip
+    assert _cluster_dns_ip("10.96.0.0/16") == "10.96.0.10"
+    assert _cluster_dns_ip("172.20.0.0/20") == "172.20.0.10"
+    assert _cluster_dns_ip("garbage") == "10.96.0.10"   # safe fallback
+
+
+def test_component_image_tags_pinned_by_offline_manifest():
+    """VERDICT r2 #4: CNI/dns image tags come from registry/manifest.py's
+    COMPONENT_VERSIONS via extra-vars — no inline version defaults left to
+    drift from what the offline bundle actually serves."""
+    import jinja2
+
+    from kubeoperator_tpu.registry.manifest import COMPONENT_VERSIONS
+
+    _, ev = _network_extra_vars()
+    for key, version in COMPONENT_VERSIONS.items():
+        assert ev[f"{key}_version"] == version
+
+    env = jinja2.Environment(undefined=jinja2.ChainableUndefined)
+    calico = open(os.path.join(
+        ROLES, "cni", "templates", "calico.yaml.j2"), encoding="utf-8").read()
+    rendered = env.from_string(calico).render(**ev)
+    assert f"calico/node:{COMPONENT_VERSIONS['calico']}" in rendered
+    flannel = open(os.path.join(
+        ROLES, "cni", "templates", "flannel.yaml.j2"), encoding="utf-8").read()
+    rendered = env.from_string(flannel).render(**ev)
+    assert f"flannel/flannel:{COMPONENT_VERSIONS['flannel']}" in rendered
+    nld = open(os.path.join(
+        ROLES, "nodelocaldns", "templates", "nodelocaldns.yaml.j2"),
+        encoding="utf-8").read()
+    rendered = env.from_string(nld).render(**ev)
+    assert (
+        f"dns/k8s-dns-node-cache:{COMPONENT_VERSIONS['node_local_dns']}"
+        in rendered
+    )
+    assert ev["cluster_dns_ip"] in rendered   # forwards to kube-dns svc IP
+
+    # the pins are the SINGLE source: no `<component>_version | default(`
+    # escape hatches left in any template
+    for role in sorted(os.listdir(ROLES)):
+        tdir = os.path.join(ROLES, role, "templates")
+        if not os.path.isdir(tdir):
+            continue
+        for fn in os.listdir(tdir):
+            text = open(os.path.join(tdir, fn), encoding="utf-8").read()
+            for key in COMPONENT_VERSIONS:
+                assert f"{key}_version | default(" not in text, (role, fn)
+
+
+def test_storage_components_wire_a_single_default_class():
+    """Both storage components include the SHARED default-class tasks (one
+    copy to maintain) with auto/true/false semantics: auto claims only when
+    no default exists, true takes over (stripping others first), false
+    leaves annotations alone."""
+    shared = open(os.path.join(
+        ROLES, "storage-default-class", "tasks", "main.yml"),
+        encoding="utf-8").read()
+    assert "is-default-class=true" in shared
+    assert "is-default-class-" in shared            # strip-others path
+    assert "auto)" in shared and "exit 0" in shared   # first-wins path
+    assert "unknown storage_default_class" in shared  # typo'd mode fails loud
+    assert "storage_default_class | default('auto')" in shared
+    for role, cls in (("component-nfs-provisioner", "nfs-client"),
+                      ("component-rook-ceph", "ceph-block")):
+        text = open(os.path.join(ROLES, role, "tasks", "main.yml"),
+                    encoding="utf-8").read()
+        assert "storage-default-class/tasks/main.yml" in text, role
+        assert cls in text, role
+        # no duplicated annotate logic left in the component roles
+        assert "is-default-class" not in text, role
+
+
+def test_storage_default_include_expands_with_vars_in_simulation():
+    """The include_tasks + vars plumbing works end-to-end in the simulator:
+    the shared task appears in the component playbook's stream with the
+    per-component class name rendered into its templated name."""
+    ex = SimulationExecutor()
+    inv, ev = _network_extra_vars()
+    ev["ko_simulation"] = True
+    task_id = ex.run_playbook("component-nfs-provisioner.yml", inv, ev)
+    result = ex.wait(task_id, timeout_s=30)
+    assert result.ok
+    lines = "\n".join(ex.watch(task_id, timeout_s=5))
+    assert "make nfs-client the default StorageClass" in lines
+
+
 def test_pki_phase_runs_before_etcd_and_masters():
     names = [p.name for p in create_phases()]
     assert names.index("pki") < names.index("etcd") < names.index("kube-master")
