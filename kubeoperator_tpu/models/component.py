@@ -78,14 +78,30 @@ COMPONENT_CATALOG: dict[str, dict] = {
         # injection; ingress gateway optional
         "vars": {"istio_mtls_mode": "PERMISSIVE",
                  "istio_ingress_enabled": False,
-                 "istio_injection_namespaces": "default"},
+                 "istio_injection_namespaces": "default",
+                 # colon-separated hosts for the default Gateway (empty =
+                 # wildcard '*' — the literal star would trip the
+                 # argument-inertness guard); TLS server added when a
+                 # credential secret name is set
+                 "istio_gateway_hosts": "",
+                 "istio_gateway_tls_secret": ""},
         # enum-checked at install: a typo'd mode would only explode at
         # kubectl-apply time on a real cluster (simulation can't catch it)
         "allowed": {"istio_mtls_mode": ("PERMISSIVE", "STRICT")},
         "uninstall": {
+            # Gateway/mTLS objects first (the uninstall role orders
+            # manifests before charts — chart removal deletes the CRDs),
+            # then charts in reverse install order, then labels + namespace
+            "manifests": ["/etc/kubernetes/addons/istio-gateway.yaml",
+                          "/etc/kubernetes/addons/istio-mtls.yaml"],
             "helm": [["istio-ingressgateway", "istio-system"],
                      ["istiod", "istio-system"],
                      ["istio-base", "istio-system"]],
+            "files": ["/etc/kubernetes/addons/istio-gateway.yaml",
+                      "/etc/kubernetes/addons/istio-mtls.yaml"],
+            # sidecar-injection labels come off the (var-driven) namespaces
+            # — the service resolves the list from the component's vars
+            "unlabel_var": ["istio_injection_namespaces", "istio-injection"],
             "namespaces": ["istio-system"],
         },
     },

@@ -32,6 +32,10 @@ COMPONENT_VERSIONS = {
     "flannel_cni_plugin": "v1.4.1",
     "node_local_dns": "1.23.1",
     "pause": "3.9",
+    # istio charts are consumed from the bundle by path (helm ignores
+    # --version for local charts), so the install role VERIFIES the bundled
+    # Chart.yaml version against this pin and refuses a mismatched bundle
+    "istio": "1.22.3",
 }
 
 

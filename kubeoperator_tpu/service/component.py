@@ -135,11 +135,23 @@ class ComponentService:
             component.status = "Uninstalling"
             self.repos.components.save(component)
             ctx = self._context(cluster, component)
+            unlabel: list = [list(pair) for pair in teardown.get("unlabel", [])]
+            if "unlabel_var" in teardown:
+                # label applied to a VAR-driven namespace list at install
+                # time (e.g. istio sidecar injection): resolve the installed
+                # component's actual namespaces, not the catalog default
+                var_name, label = teardown["unlabel_var"]
+                namespaces = str(component.vars.get(
+                    var_name,
+                    COMPONENT_CATALOG[component_name]["vars"].get(var_name, ""),
+                ))
+                unlabel += [[ns, label] for ns in namespaces.split(":") if ns]
             ctx.extra_vars.update({
                 "component_name": component_name,
                 "uninstall_helm": list(teardown.get("helm", [])),
                 "uninstall_manifests": list(teardown.get("manifests", [])),
                 "uninstall_files": list(teardown.get("files", [])),
+                "uninstall_unlabel": unlabel,
                 "uninstall_namespaces": list(teardown.get("namespaces", [])),
             })
             try:

@@ -296,6 +296,57 @@ SCHEMAS: dict[str, dict] = {
         },
         "required": ["apiVersion", "kind", "rules"],
     },
+    # istio CRD used by the component-istio role's default mesh Gateway
+    "Gateway": {
+        **_TOP,
+        "properties": {
+            **_TOP["properties"],
+            "spec": {
+                "type": "object",
+                "properties": {
+                    "selector": {"type": "object"},
+                    "servers": {
+                        "type": "array",
+                        "minItems": 1,
+                        "items": {
+                            "type": "object",
+                            "properties": {
+                                "port": {
+                                    "type": "object",
+                                    "properties": {
+                                        "number": {"type": "integer"},
+                                        "name": {"type": "string"},
+                                        "protocol": {
+                                            "enum": ["HTTP", "HTTPS", "TCP",
+                                                     "TLS", "GRPC", "MONGO"],
+                                        },
+                                    },
+                                    "required": ["number", "name", "protocol"],
+                                },
+                                "hosts": {
+                                    "type": "array",
+                                    "minItems": 1,
+                                    "items": {"type": "string"},
+                                },
+                                "tls": {
+                                    "type": "object",
+                                    "properties": {
+                                        "mode": {"enum": ["SIMPLE", "MUTUAL",
+                                                          "PASSTHROUGH",
+                                                          "ISTIO_MUTUAL"]},
+                                        "credentialName": {"type": "string"},
+                                    },
+                                },
+                            },
+                            "required": ["port", "hosts"],
+                        },
+                    },
+                },
+                "required": ["selector", "servers"],
+            },
+        },
+        "required": ["apiVersion", "kind", "metadata", "spec"],
+    },
     # istio CRD used by the component-istio role's mesh-wide mTLS policy
     "PeerAuthentication": {
         **_TOP,

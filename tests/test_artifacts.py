@@ -156,6 +156,32 @@ class TestK8sManifests:
         ).render(**MANIFEST_VARS)
         assert validate_yaml_stream(rendered) >= 1
 
+    def test_istio_gateway_renders_hosts_and_tls(self):
+        tpl = open(os.path.join(
+            CONTENT, "roles", "component-istio", "templates",
+            "gateway.yaml.j2"), encoding="utf-8").read()
+        env = jinja2.Environment(undefined=jinja2.StrictUndefined)
+        plain = env.from_string(tpl).render(
+            istio_gateway_hosts="a.example.com:b.example.com",
+            istio_gateway_tls_secret="")
+        assert validate_yaml_stream(plain) == 1
+        doc = yaml.safe_load(plain)
+        assert doc["spec"]["servers"][0]["hosts"] == [
+            "a.example.com", "b.example.com"]
+        assert len(doc["spec"]["servers"]) == 1   # no TLS server w/o secret
+        # empty var -> wildcard; trailing colon never yields an empty host
+        wild = yaml.safe_load(env.from_string(tpl).render(
+            istio_gateway_hosts="", istio_gateway_tls_secret=""))
+        assert wild["spec"]["servers"][0]["hosts"] == ["*"]
+        trailing = yaml.safe_load(env.from_string(tpl).render(
+            istio_gateway_hosts="a.example.com:",
+            istio_gateway_tls_secret=""))
+        assert trailing["spec"]["servers"][0]["hosts"] == ["a.example.com"]
+        tls = yaml.safe_load(env.from_string(tpl).render(
+            istio_gateway_hosts="", istio_gateway_tls_secret="site-cert"))
+        assert len(tls["spec"]["servers"]) == 2
+        assert tls["spec"]["servers"][1]["tls"]["credentialName"] == "site-cert"
+
     def test_registry_manifests_validate(self, tmp_path):
         from kubeoperator_tpu.registry.k8s_manifests import (
             grafana_dashboards_manifest,
