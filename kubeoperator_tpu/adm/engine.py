@@ -136,6 +136,15 @@ class AdmContext:
         ev.update({f"{k}_version": v for k, v in COMPONENT_VERSIONS.items()})
         if self.plan is not None and self.plan.has_tpu():
             topo = self.plan.topology()
+            # simulated smoke bandwidth: 85% of the ICI envelope, so demo
+            # clusters report a realistic number (the emitting task is gated
+            # `when: ko_simulation`, so real runs never consume this).
+            # Injected HERE, not per-service, so every smoke-bearing flow —
+            # create, upgrade re-gate, slice scale, guided recovery — gets
+            # the same value instead of silently recording 0.0.
+            ev.setdefault("sim_smoke_gbps", round(
+                0.85 * topo.theoretical_allreduce_busbw_gbps(), 1
+            ))
             ev.update(
                 tpu_type=topo.generation.name,
                 tpu_accelerator_type=topo.accelerator_type,

@@ -15,6 +15,7 @@ import re
 from kubeoperator_tpu.adm.engine import AdmContext, Phase
 from kubeoperator_tpu.executor.base import TaskResult
 from kubeoperator_tpu.utils.errors import PhaseError
+from kubeoperator_tpu.utils.ids import now_ts
 
 SMOKE_MARKER = "KO_TPU_SMOKE_RESULT"
 
@@ -63,6 +64,13 @@ def smoke_post(ctx: AdmContext, result: TaskResult, lines: list[str]) -> None:
         ctx.plan.topology().total_chips if ctx.plan and ctx.plan.has_tpu() else 0
     )
     threshold = ctx.cluster.spec.smoke_test_gbps_threshold
+    # record the measurement BEFORE gating: a failing run is exactly the
+    # data point the console's trend should show. The pass flag also resets
+    # here — a re-gate that fails must not leave a stale True from create.
+    status.smoke_passed = False
+    entry = {"ts": now_ts(), "gbps": gbps, "chips": chips, "passed": False}
+    status.smoke_history.append(entry)
+    del status.smoke_history[:-20]   # bounded trend window
     if expected_chips and chips != expected_chips:
         raise PhaseError(
             "tpu-smoke-test",
@@ -74,6 +82,7 @@ def smoke_post(ctx: AdmContext, result: TaskResult, lines: list[str]) -> None:
             f"psum bandwidth {gbps:.1f} GB/s below threshold {threshold:.1f}",
         )
     status.smoke_passed = True
+    entry["passed"] = True
 
 
 def create_phases() -> list[Phase]:

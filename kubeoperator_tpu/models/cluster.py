@@ -137,6 +137,10 @@ class ClusterStatus:
     smoke_gbps: float = 0.0
     smoke_chips: int = 0
     smoke_passed: bool = False
+    # every smoke measurement ever gated on (create, upgrade re-gate, slice
+    # scale, guided recovery), newest last, capped — the console's GB/s
+    # trend; plain dicts: {ts, gbps, chips, passed}
+    smoke_history: list = field(default_factory=list)
 
     __nested__ = {"conditions": ClusterStatusCondition}
 

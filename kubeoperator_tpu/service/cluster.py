@@ -22,7 +22,7 @@ from kubeoperator_tpu.adm import (
     reset_phases,
     scale_down_phases,
 )
-from kubeoperator_tpu.executor import Executor, SimulationExecutor
+from kubeoperator_tpu.executor import Executor
 from kubeoperator_tpu.models import (
     Cluster,
     ClusterSpec,
@@ -605,15 +605,8 @@ class ClusterService:
         # pki role's platform-side cert cache (fetch dest + copy src)
         pki_dir = self.config.get("cluster.pki_dir", "/var/ko-tpu/pki")
         extra["pki_cache_dest"] = pki_dir.rstrip("/") + "/"
-        if isinstance(self.executor, SimulationExecutor) and (
-            cluster.spec.tpu_enabled and plan is not None and plan.has_tpu()
-        ):
-            # simulation smoke result: 85% of the ICI envelope, so demo
-            # clusters report a realistic bandwidth (clearly marked simulated)
-            topo = plan.topology()
-            extra["sim_smoke_gbps"] = round(
-                0.85 * topo.theoretical_allreduce_busbw_gbps(), 1
-            )
+        # (sim_smoke_gbps now rides AdmContext.build_extra_vars so upgrade/
+        # scale/recovery smoke re-gates get it too, not just create)
         extra.update(self.debug_extra_vars)
         return AdmContext.for_cluster(self.repos, cluster, plan, extra)
 

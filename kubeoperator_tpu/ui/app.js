@@ -61,6 +61,8 @@ const I18N = {
     gather_facts: "Gather facts", add_member: "＋ Member",
     ldap: "LDAP", ldap_test: "Test connection", ldap_sync: "Sync users",
     ldap_ok: "connection OK", ldap_synced: "synced",
+    needs_attention: "needs attention", chips_mismatch: "chip count mismatch",
+    filter_hosts: "filter hosts…", smoke_trend: "psum trend",
   },
   zh: {
     sign_in: "登录", clusters: "集群", hosts: "主机", infra: "基础设施",
@@ -100,6 +102,8 @@ const I18N = {
     gather_facts: "采集信息", add_member: "＋ 成员",
     ldap: "LDAP", ldap_test: "测试连接", ldap_sync: "同步用户",
     ldap_ok: "连接正常", ldap_synced: "已同步",
+    needs_attention: "需要关注", chips_mismatch: "芯片数不符",
+    filter_hosts: "过滤主机…", smoke_trend: "psum 趋势",
   },
 };
 let lang = localStorage.getItem("ko-lang") || "en";
@@ -230,19 +234,24 @@ async function refreshClusters() {
   if (!clusters.length) {
     list.innerHTML = `<div class="muted">${t("no_clusters")}</div>`;
   }
-  for (const c of clusters) {
+  // ops ordering comes from the tested logic module: unhealthy first
+  for (const c of KOLogic.rank_clusters(clusters)) {
     const card = document.createElement("div");
     card.className = "card";
     // imported (kubeconfig-only) clusters: observe surfaces only — the
   // SSH-gated day-2 sections are hidden rather than offered-and-refused
   const imported = c.provision_mode === "imported";
+  const score = KOLogic.cluster_attention_score(c);
+  const badge = score > 0
+    ? `<span class="attention ${score >= 100 ? "crit" : "warn"}">${t("needs_attention")}</span>`
+    : "";
   const conds = (c.status.conditions || []).map((x) =>
       `<span class="cond ${x.status}">${esc(x.name)}</span>`).join("");
     const smoke = c.status.smoke_chips
       ? `<div class="smoke">psum ${c.status.smoke_gbps} GB/s · ${c.status.smoke_chips} chips</div>`
       : "";
     card.innerHTML = `
-      <h4>${esc(c.name)}</h4>
+      <h4>${esc(c.name)} ${badge}</h4>
       <div><span class="phase ${c.status.phase}">${c.status.phase}</span>
         <span class="muted"> · ${esc(c.spec.k8s_version)} · ${esc(c.spec.cni)}</span></div>
       <div class="conds">${conds}</div>${smoke}
@@ -274,6 +283,22 @@ async function openCluster(name) {
   const backups = await api("GET", `/api/v1/clusters/${name}/backups`).catch(() => []);
   const scans = await api("GET", `/api/v1/clusters/${name}/cis-scans`).catch(() => []);
   const vers = await api("GET", "/api/v1/version");
+  // TPU ops panel inputs: expected chips derived from the plan's catalog
+  // row through the tested logic module (plan topology vs smoke-proven)
+  let expectedChips = 0;
+  if (c.plan_id) {
+    const plans = await api("GET", "/api/v1/plans").catch(() => []);
+    const plan = plans.find((p) => p.id === c.plan_id);
+    if (plan && plan.accelerator === "tpu") {
+      const cat = await api("GET", "/api/v1/plans-tpu-catalog").catch(() => []);
+      const entry = KOLogic.catalog_entry(cat, plan.tpu_type);
+      if (entry) {
+        expectedChips =
+          KOLogic.tpu_plan_summary(entry, plan.num_slices).total_chips;
+      }
+    }
+  }
+  const tpuPanel = KOLogic.tpu_panel(c, expectedChips);
   const detail = $("#cluster-detail");
   $("#cluster-list").hidden = true;
   detail.hidden = false;
@@ -300,7 +325,19 @@ async function openCluster(name) {
       </div>
     </div>
     <div class="conds">${conds}</div>
-    ${c.status.smoke_chips ? `<div class="smoke">smoke: psum ${c.status.smoke_gbps} GB/s over ${c.status.smoke_chips} chips</div>` : ""}
+    ${tpuPanel.chips || tpuPanel.expected_chips ? `
+    <div class="tpu-panel ${tpuPanel.ok ? "ok" : "bad"}">
+      <b>TPU</b>
+      ${tpuPanel.chips}${tpuPanel.expected_chips ? ` / ${tpuPanel.expected_chips}` : ""} chips
+      ${tpuPanel.chips_ok ? "" : `<span class="crit">${t("chips_mismatch")}</span>`}
+      · psum ${tpuPanel.gbps} GB/s
+      ${tpuPanel.trend.delta_pct !== null
+        ? `<span class="delta ${tpuPanel.trend.delta_pct < 0 ? "down" : "up"}">${tpuPanel.trend.delta_pct > 0 ? "+" : ""}${tpuPanel.trend.delta_pct}%</span>`
+        : ""}
+      ${tpuPanel.trend.bars.length > 1
+        ? `<span class="spark" title="${t("smoke_trend")}">${tpuPanel.trend.bars.map((b) => `<i style="height:${Math.max(b, 6)}%"></i>`).join("")}</span>`
+        : ""}
+    </div>` : ""}
     <div id="d-health-out"></div>
 
     <h3>${t("phase_timings")}</h3>
@@ -824,33 +861,64 @@ $("#ldap-sync-btn").addEventListener("click", async () => {
 });
 
 /* ---------- tab refreshers ---------- */
+// shared pager strip: prev/next + "page/pages · total" (data from
+// KOLogic.paginate — the DOM here is render-only)
+function renderPager(el, page, onNav) {
+  if (page.pages <= 1) {
+    el.innerHTML = page.total
+      ? `<span class="muted">${page.total} ${t("total")}</span>` : "";
+    return;
+  }
+  el.innerHTML =
+    `<button data-nav="prev" class="ghost" ${page.has_prev ? "" : "disabled"}>‹</button>
+     <span class="muted">${page.page}/${page.pages} · ${page.total} ${t("total")}</span>
+     <button data-nav="next" class="ghost" ${page.has_next ? "" : "disabled"}>›</button>`;
+  el.querySelectorAll("[data-nav]").forEach((b) =>
+    b.addEventListener("click", () =>
+      onNav(b.dataset.nav === "next" ? 1 : -1)));
+}
+
+let hostCache = [];
+let hostPage = 1;
+function renderHosts() {
+  const filtered = KOLogic.filter_hosts(hostCache, $("#host-filter").value);
+  const page = KOLogic.paginate(filtered, hostPage, 25);
+  hostPage = page.page;
+  $("#hosts-table").innerHTML =
+    "<tr><th>name</th><th>ip</th><th>status</th><th>TPU</th><th></th></tr>" +
+    page.rows.map((h, i) => `<tr><td>${esc(h.name)}</td><td>${esc(h.ip)}</td><td>${h.status}</td>
+      <td>${h.tpu_chips > 0 ? `${h.tpu_chips} chips · slice ${h.tpu_slice_id} · worker ${h.tpu_worker_id}` : "—"}</td>
+      <td><button data-host-detail="${i}" class="ghost">${t("details")}</button>
+          ${me?.is_admin && !h.cluster_id ? `<button data-host-facts="${esc(h.name)}" class="ghost">${t("gather_facts")}</button>` : ""}</td></tr>` +
+      `<tr class="host-detail" id="host-detail-${i}" hidden><td colspan="5">
+        <div class="muted">
+          os ${esc(h.os || "?")} · arch ${esc(h.arch || "?")} ·
+          ${h.cpu_cores || "?"} cores · ${h.memory_mb ? (h.memory_mb / 1024).toFixed(1) + " GiB" : "?"}
+          · ssh ${esc(h.ip)}:${h.port} · cluster ${esc(h.cluster_id ? "bound" : "free")}
+        </div></td></tr>`).join("");
+  document.querySelectorAll("[data-host-detail]").forEach((b) =>
+    b.addEventListener("click", () => {
+      const row = $("#host-detail-" + b.dataset.hostDetail);
+      row.hidden = !row.hidden;
+    }));
+  document.querySelectorAll("[data-host-facts]").forEach((b) =>
+    b.addEventListener("click", async () => {
+      await api("POST", `/api/v1/hosts/${b.dataset.hostFacts}/facts`)
+        .catch((e) => alert(e.message));
+      refreshAll();
+    }));
+  renderPager($("#host-pager"), page, (d) => { hostPage += d; renderHosts(); });
+}
+$("#host-filter").addEventListener("input", () => { hostPage = 1; renderHosts(); });
+
 async function refreshAll() {
   refreshClusters();
   if (!$("#tab-hosts").hidden) {
     const hosts = await api("GET", "/api/v1/hosts").catch(() => []);
-    $("#hosts-table").innerHTML =
-      "<tr><th>name</th><th>ip</th><th>status</th><th>TPU</th><th></th></tr>" +
-      hosts.map((h, i) => `<tr><td>${esc(h.name)}</td><td>${esc(h.ip)}</td><td>${h.status}</td>
-        <td>${h.tpu_chips > 0 ? `${h.tpu_chips} chips · slice ${h.tpu_slice_id} · worker ${h.tpu_worker_id}` : "—"}</td>
-        <td><button data-host-detail="${i}" class="ghost">${t("details")}</button>
-            ${me?.is_admin && !h.cluster_id ? `<button data-host-facts="${esc(h.name)}" class="ghost">${t("gather_facts")}</button>` : ""}</td></tr>` +
-        `<tr class="host-detail" id="host-detail-${i}" hidden><td colspan="5">
-          <div class="muted">
-            os ${esc(h.os || "?")} · arch ${esc(h.arch || "?")} ·
-            ${h.cpu_cores || "?"} cores · ${h.memory_mb ? (h.memory_mb / 1024).toFixed(1) + " GiB" : "?"}
-            · ssh ${esc(h.ip)}:${h.port} · cluster ${esc(h.cluster_id ? "bound" : "free")}
-          </div></td></tr>`).join("");
-    document.querySelectorAll("[data-host-detail]").forEach((b) =>
-      b.addEventListener("click", () => {
-        const row = $("#host-detail-" + b.dataset.hostDetail);
-        row.hidden = !row.hidden;
-      }));
-    document.querySelectorAll("[data-host-facts]").forEach((b) =>
-      b.addEventListener("click", async () => {
-        await api("POST", `/api/v1/hosts/${b.dataset.hostFacts}/facts`)
-          .catch((e) => alert(e.message));
-        refreshAll();
-      }));
+    // searchable "cluster" facet: bound/free (the raw row only has an id)
+    hostCache = hosts.map((h) =>
+      ({ ...h, cluster: h.cluster_id ? "bound" : "free" }));
+    renderHosts();
   }
   if (!$("#tab-infra").hidden) refreshInfra();
   if (!$("#tab-backups").hidden) {
@@ -948,14 +1016,18 @@ async function refreshAdmin() {
 }
 
 let eventCache = [];
+let eventPage = 1;
 function renderEvents() {
   const shown = KOLogic.filter_events(eventCache, $("#event-filter").value);
-  $("#event-feed").innerHTML = shown.map((e) =>
+  const page = KOLogic.paginate(shown, eventPage, 50);
+  eventPage = page.page;
+  $("#event-feed").innerHTML = page.rows.map((e) =>
     `<div class="feed-item ${e.type}"><span class="when">${new Date(e.created_at * 1000).toLocaleString()}</span>
      <b>${esc(e.cluster)}</b> [${esc(e.reason)}] ${esc(e.message)}</div>`).join("") ||
     `<div class="muted">${t("no_activity")}</div>`;
+  renderPager($("#event-pager"), page, (d) => { eventPage += d; renderEvents(); });
 }
-$("#event-filter").addEventListener("input", renderEvents);
+$("#event-filter").addEventListener("input", () => { eventPage = 1; renderEvents(); });
 async function refreshEvents() {
   const clusters = await api("GET", "/api/v1/clusters").catch(() => []);
   const feeds = [];

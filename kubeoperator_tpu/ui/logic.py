@@ -313,6 +313,156 @@ def upgrade_errors(current, target, supported):
     return errors
 
 
+def cluster_attention_score(cluster):
+    """Ops-overview ranking weight: bigger = needs eyes sooner. Pure
+    function of the cluster's stored status (phase, per-phase conditions,
+    smoke gate) so the overview ranks without N live health probes."""
+    status = jsrt.get(cluster, "status", {})
+    phase = str(jsrt.get(status, "phase", ""))
+    score = 0
+    if phase == "Failed":
+        score = score + 100
+    if jsrt.contains(["Initializing", "Provisioning", "Deploying",
+                      "SmokeTesting", "Upgrading", "Scaling",
+                      "Terminating"], phase):
+        score = score + 30
+    for c in jsrt.get(status, "conditions", []):
+        cstatus = str(jsrt.get(c, "status", ""))
+        if cstatus == "Failed":
+            score = score + 25
+        if cstatus == "Running":
+            score = score + 5
+    chips = jsrt.get(status, "smoke_chips", 0)
+    if chips > 0 and not jsrt.get(status, "smoke_passed", False):
+        score = score + 40
+    return score
+
+
+def rank_clusters(clusters):
+    """Overview order: attention score descending, name ascending on ties —
+    an unhealthy cluster must never rank below a healthy one."""
+    rows = []
+    for c in clusters:
+        rows.append({
+            "cluster": c,
+            "score": cluster_attention_score(c),
+            "name": str(jsrt.get(c, "name", "")),
+        })
+    out = []
+    while len(rows) > 0:
+        best = 0
+        i = 1
+        while i < len(rows):
+            better = jsrt.num(rows[i]["score"]) > rows[best]["score"]
+            tie = jsrt.num(rows[i]["score"]) == rows[best]["score"] \
+                and rows[i]["name"] < rows[best]["name"]
+            if better or tie:
+                best = i
+            i = i + 1
+        out.append(rows[best]["cluster"])
+        rest = []
+        j = 0
+        for r in rows:
+            if jsrt.num(j) != best:
+                rest.append(r)
+            j = j + 1
+        rows = rest
+    return out
+
+
+def smoke_trend(history):
+    """GB/s trend over the stored smoke measurements (newest last):
+    percent delta vs the previous run and 0-100 bar heights for a
+    sparkline, peak-normalized."""
+    vals = []
+    for h in history:
+        g = jsrt.get(h, "gbps", None)
+        if g is not None:
+            vals.append(g)
+    if len(vals) == 0:
+        return {"last_gbps": None, "delta_pct": None, "bars": []}
+    peak = 0.0
+    for v in vals:
+        if v > peak:
+            peak = v
+    bars = []
+    for v in vals:
+        if peak > 0:
+            bars.append(jsrt.round2(v * 100.0 / peak))
+        else:
+            bars.append(0)
+    delta = None
+    if len(vals) > 1 and vals[len(vals) - 2] > 0:
+        prev = vals[len(vals) - 2]
+        delta = jsrt.round2((vals[len(vals) - 1] - prev) * 100.0 / prev)
+    return {"last_gbps": vals[len(vals) - 1], "delta_pct": delta, "bars": bars}
+
+
+def tpu_panel(cluster, expected_chips):
+    """Detail-view TPU ops panel: chips the smoke test actually drove
+    (allocatable, proven end-to-end) vs the plan topology, the latest
+    bandwidth + trend, and whether the gate passed. `expected_chips` comes
+    from tpu_plan_summary over the plan's catalog row (0 = non-TPU)."""
+    status = jsrt.get(cluster, "status", {})
+    chips = jsrt.get(status, "smoke_chips", 0)
+    trend = smoke_trend(jsrt.get(status, "smoke_history", []))
+    chips_ok = expected_chips == 0 or jsrt.num(chips) == expected_chips
+    passed = jsrt.get(status, "smoke_passed", False)
+    return {
+        "chips": chips,
+        "expected_chips": expected_chips,
+        "chips_ok": chips_ok,
+        "gbps": jsrt.get(status, "smoke_gbps", 0),
+        "passed": passed,
+        "trend": trend,
+        "ok": chips_ok and (chips == 0 or passed == True),
+    }
+
+
+def paginate(rows, page, page_size):
+    """Clamped pagination over an already-filtered row list — reference-
+    scale installs have hundreds of hosts/events; full-table re-render
+    does not survive that."""
+    size = jsrt.parse_int(page_size)
+    if size is None or size < 1:
+        size = 25
+    total = len(rows)
+    pages = (total + size - 1) // size
+    if pages < 1:
+        pages = 1
+    p = jsrt.parse_int(page)
+    if p is None or p < 1:
+        p = 1
+    if p > pages:
+        p = pages
+    start = (p - 1) * size
+    return {
+        "rows": rows[start:start + size],
+        "page": p,
+        "pages": pages,
+        "total": total,
+        "has_prev": p > 1,
+        "has_next": p < pages,
+    }
+
+
+def filter_hosts(hosts, query):
+    """Hosts-table search: case-insensitive substring across name, ip,
+    status, and bound cluster — same reset semantics as the log filter."""
+    q = str(query).strip().lower()
+    if q == "":
+        return hosts
+    out = []
+    for h in hosts:
+        hay = str(jsrt.get(h, "name", "")) + " " \
+            + str(jsrt.get(h, "ip", "")) + " " \
+            + str(jsrt.get(h, "status", "")) + " " \
+            + str(jsrt.get(h, "cluster", ""))
+        if jsrt.contains(hay.lower(), q):
+            out.append(h)
+    return out
+
+
 def i18n_next(lang):
     if lang == "zh":
         return "en"
@@ -345,7 +495,13 @@ PUBLIC = [
     import_form_errors,
     filter_log_lines,
     filter_events,
+    filter_hosts,
     trace_rows,
+    cluster_attention_score,
+    rank_clusters,
+    smoke_trend,
+    tpu_panel,
+    paginate,
     i18n_next,
     i18n_get,
 ]

@@ -82,6 +82,41 @@ def test_tpu_create_gates_on_smoke_result():
     assert smoke_call.extra_vars["tpu_slice_topology"] == "4x4"
     assert smoke_call.extra_vars["tpu_chips_total"] == 16
     assert smoke_call.extra_vars["tpu_runtime_version"] == "v2-alpha-tpuv5-lite"
+    # the measurement lands in the trend history (console GB/s sparkline)
+    assert len(st.smoke_history) == 1
+    entry = st.smoke_history[0]
+    assert (entry["gbps"], entry["chips"], entry["passed"]) == (84.3, 16, True)
+    assert entry["ts"] > 0
+
+
+def test_smoke_history_records_failures_and_is_bounded():
+    """A gated-out run is exactly the data point the trend must show; the
+    window stays bounded across many re-gates."""
+    ex = FakeExecutor()
+    ex.script("17-tpu-smoke-test.yml", lines=[
+        f'{SMOKE_MARKER} {{"gbps": 80.0, "chips": 12}}',  # lost a host
+    ])
+    ctx = make_ctx(tpu=True)
+    with pytest.raises(PhaseError):
+        ClusterAdm(ex).run(ctx, create_phases())
+    assert len(ctx.cluster.status.smoke_history) == 1
+    assert ctx.cluster.status.smoke_history[0]["passed"] is False
+
+    # bounded window: only the newest 20 survive
+    from kubeoperator_tpu.adm.phases import smoke_post
+    for i in range(30):
+        smoke_post(ctx, None, [
+            f'{SMOKE_MARKER} {{"gbps": {80 + i}.0, "chips": 16}}'])
+    hist = ctx.cluster.status.smoke_history
+    assert len(hist) == 20
+    assert hist[-1]["gbps"] == 109.0 and hist[0]["gbps"] == 90.0
+
+    # a failing re-gate must RESET the stale pass flag from the last good
+    # run — the console's ok-state reads it
+    assert ctx.cluster.status.smoke_passed is True
+    with pytest.raises(PhaseError):
+        smoke_post(ctx, None, [f'{SMOKE_MARKER} {{"gbps": 85.0, "chips": 12}}'])
+    assert ctx.cluster.status.smoke_passed is False
 
 
 def test_smoke_chip_count_mismatch_fails_phase():

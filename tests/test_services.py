@@ -645,6 +645,13 @@ class TestTpuUpgradeRegate:
         assert "upgrade-tpu-smoke" in names
         cond = cluster.status.condition("upgrade-tpu-smoke")
         assert cond.status == "OK"
+        # the re-gate measured REAL bandwidth (regression: sim_smoke_gbps
+        # was only injected on create, so re-gates recorded 0.0) and the
+        # measurement extended the console trend history
+        assert cluster.status.smoke_gbps > 0
+        assert len(cluster.status.smoke_history) == 2
+        assert all(h["gbps"] > 0 and h["passed"]
+                   for h in cluster.status.smoke_history)
 
 
 class TestBackupAccountProbe:

@@ -238,6 +238,116 @@ class TestViewers:
         assert logic.i18n_get(tables, "fr", "a") == "A"
 
 
+def _mk_cluster(name, phase="Ready", conditions=(), smoke_chips=0,
+                smoke_passed=False, smoke_gbps=0.0, history=()):
+    return {
+        "name": name,
+        "status": {
+            "phase": phase,
+            "conditions": [dict(c) for c in conditions],
+            "smoke_chips": smoke_chips,
+            "smoke_passed": smoke_passed,
+            "smoke_gbps": smoke_gbps,
+            "smoke_history": [dict(h) for h in history],
+        },
+    }
+
+
+class TestOpsOverview:
+    def test_unhealthy_cluster_never_ranks_below_healthy(self):
+        """VERDICT r2 #3's acceptance line: a test fails if the panel
+        mis-ranks an unhealthy cluster."""
+        healthy = _mk_cluster("aaa-healthy", smoke_chips=16,
+                              smoke_passed=True)
+        failed = _mk_cluster("zzz-broken", phase="Failed",
+                             conditions=[{"status": "Failed"}])
+        smoke_bad = _mk_cluster("mid-smoke", smoke_chips=16,
+                                smoke_passed=False)
+        ranked = logic.rank_clusters([healthy, failed, smoke_bad])
+        names = [c["name"] for c in ranked]
+        assert names.index("zzz-broken") < names.index("aaa-healthy")
+        assert names.index("mid-smoke") < names.index("aaa-healthy")
+        assert names[0] == "zzz-broken"   # hard failure outranks soft
+
+    def test_rank_is_deterministic_on_ties(self):
+        a, b, c = (_mk_cluster(n) for n in ("bravo", "alpha", "charlie"))
+        assert [x["name"] for x in logic.rank_clusters([a, b, c])] == [
+            "alpha", "bravo", "charlie"]
+
+    def test_score_components(self):
+        assert logic.cluster_attention_score(_mk_cluster("ok")) == 0
+        assert logic.cluster_attention_score(
+            _mk_cluster("f", phase="Failed")) == 100
+        assert logic.cluster_attention_score(
+            _mk_cluster("c", conditions=[{"status": "Failed"},
+                                         {"status": "Running"}])) == 30
+        assert logic.cluster_attention_score(
+            _mk_cluster("s", smoke_chips=4, smoke_passed=False)) == 40
+        assert logic.cluster_attention_score(
+            _mk_cluster("busy", phase="Upgrading")) == 30
+        # every transitional phase carries the in-progress weight
+        for phase in ("Provisioning", "Deploying", "SmokeTesting",
+                      "Scaling", "Terminating"):
+            assert logic.cluster_attention_score(
+                _mk_cluster("t", phase=phase)) == 30, phase
+
+
+class TestTpuPanel:
+    def test_allocatable_vs_plan_topology(self):
+        good = _mk_cluster("g", smoke_chips=16, smoke_passed=True,
+                           smoke_gbps=85.0)
+        panel = logic.tpu_panel(good, 16)
+        assert panel["chips_ok"] and panel["ok"]
+        assert panel["gbps"] == 85.0
+        # a chip short of the plan topology: flagged even though the gate
+        # field claims passed (e.g. stale status after a scale)
+        short = _mk_cluster("s", smoke_chips=12, smoke_passed=True)
+        panel = logic.tpu_panel(short, 16)
+        assert not panel["chips_ok"] and not panel["ok"]
+        # non-TPU cluster: nothing expected, nothing flagged
+        assert logic.tpu_panel(_mk_cluster("cpu"), 0)["ok"]
+
+    def test_smoke_trend_delta_and_bars(self):
+        hist = [{"gbps": 80.0}, {"gbps": 100.0}, {"gbps": 90.0}]
+        trend = logic.smoke_trend(hist)
+        assert trend["last_gbps"] == 90.0
+        assert trend["delta_pct"] == -10.0        # vs previous run
+        assert trend["bars"] == [80.0, 100.0, 90.0]  # peak-normalized
+        assert logic.smoke_trend([]) == {
+            "last_gbps": None, "delta_pct": None, "bars": []}
+        # single measurement: no delta to report
+        assert logic.smoke_trend([{"gbps": 50.0}])["delta_pct"] is None
+
+
+class TestTablePaging:
+    def test_paginate_clamps_and_slices(self):
+        rows = list(range(53))
+        page = logic.paginate(rows, 1, 25)
+        assert page["rows"] == list(range(25))
+        assert (page["pages"], page["total"]) == (3, 53)
+        assert not page["has_prev"] and page["has_next"]
+        last = logic.paginate(rows, 99, 25)    # clamped to last page
+        assert last["page"] == 3 and last["rows"] == list(range(50, 53))
+        assert last["has_prev"] and not last["has_next"]
+        assert logic.paginate([], 1, 25)["pages"] == 1
+        # junk inputs fall back instead of exploding mid-render
+        junk = logic.paginate(rows, "x", "y")
+        assert junk["page"] == 1 and len(junk["rows"]) == 25
+
+    def test_filter_hosts_across_fields(self):
+        hosts = [
+            {"name": "tpu-w0", "ip": "10.0.0.7", "status": "Ready",
+             "cluster": "prod"},
+            {"name": "cpu-m0", "ip": "10.0.1.9", "status": "Ready",
+             "cluster": "stage"},
+        ]
+        assert logic.filter_hosts(hosts, "tpu")[0]["name"] == "tpu-w0"
+        assert logic.filter_hosts(hosts, "10.0.1")[0]["name"] == "cpu-m0"
+        assert logic.filter_hosts(hosts, "STAGE")[0]["name"] == "cpu-m0"
+        assert logic.filter_hosts(hosts, "") == hosts
+        assert logic.filter_hosts(hosts, "nope") == []
+
+
 class TestJsrtSemantics:
     """Pin the Python side of the jsrt/_rt pair to the JS-reachable
     semantics documented in ui/jsrt.py."""
