@@ -145,9 +145,10 @@ def main() -> int:
             details["train_smoke_ok"] = tr["ok"]
         except Exception as e:
             details["train_smoke_ok"] = f"error: {type(e).__name__}"
-        # MFU at chip-filling scale (bf16, ~4.3 model-TFLOPs/step): the
-        # efficiency number comparable across configs (VERDICT r2 #9).
-        # Own try-block: an OOM here must not clobber the smoke verdict.
+        # MFU at chip-filling scale (bf16; see BENCH_CONFIG for the swept
+        # shape): the efficiency number comparable across configs
+        # (VERDICT r2 #9). Own try-block: an OOM here must not clobber the
+        # smoke verdict.
         try:
             from kubeoperator_tpu.ops.train_smoke import run_train_smoke
             from kubeoperator_tpu.parallel.validation_net import BENCH_CONFIG

@@ -43,16 +43,20 @@ def run_train_smoke(
     # compile outside the timed window; this is also step 1 of `steps`
     loss, params = train_step(params, x)
     device_losses = [loss]
-    jax.block_until_ready(loss)
+    float(jax.device_get(loss))                   # warm-up fence
+    float(jax.device_get(params["w_head"][0, 0]))  # compile the end fence too
     t0 = time.perf_counter()
     for _ in range(max(steps - 1, 0)):
         loss, params = train_step(params, x)
         device_losses.append(loss)
-    # block ONCE at the end: steps dispatch asynchronously and pipeline on
-    # device, so a tunneled/remote runtime's per-call RTT doesn't masquerade
-    # as step time (the old per-step readback made a 2ms step look like
-    # 100ms behind the axon tunnel)
-    jax.block_until_ready((loss, params))
+    # fence ONCE at the end via a value transfer that depends on the LAST
+    # step's parameter UPDATE (not just its loss — the loss only proves the
+    # forward pass ran): steps dispatch asynchronously and pipeline on
+    # device, so a tunneled/remote runtime's per-call RTT doesn't
+    # masquerade as step time — and unlike block_until_ready (which the
+    # experimental axon backend acks early), a scalar fetch cannot
+    # complete before the compute it depends on has.
+    float(jax.device_get(params["w_head"][0, 0]))
     dt = time.perf_counter() - t0
     losses = [float(jax.device_get(l)) for l in device_losses]
 

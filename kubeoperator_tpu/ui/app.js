@@ -276,26 +276,28 @@ async function openCluster(name) {
   // the detail DOM is rebuilt below: stop any poll loop bound to it
   if (termTimer) { clearInterval(termTimer); termTimer = null; }
   const c = await api("GET", `/api/v1/clusters/${name}`);
-  const nodes = await api("GET", `/api/v1/clusters/${name}/nodes`);
-  const events = await api("GET", `/api/v1/clusters/${name}/events`);
-  const comps = await api("GET", `/api/v1/clusters/${name}/components`).catch(() => []);
-  const catalog = await api("GET", "/api/v1/components-catalog").catch(() => ({}));
-  const backups = await api("GET", `/api/v1/clusters/${name}/backups`).catch(() => []);
-  const scans = await api("GET", `/api/v1/clusters/${name}/cis-scans`).catch(() => []);
-  const vers = await api("GET", "/api/v1/version");
+  // the remaining reads are independent — one round-trip of latency, not 9
+  const [nodes, events, comps, catalog, backups, scans, vers, plans,
+         tpuCatalog] = await Promise.all([
+    api("GET", `/api/v1/clusters/${name}/nodes`),
+    api("GET", `/api/v1/clusters/${name}/events`),
+    api("GET", `/api/v1/clusters/${name}/components`).catch(() => []),
+    api("GET", "/api/v1/components-catalog").catch(() => ({})),
+    api("GET", `/api/v1/clusters/${name}/backups`).catch(() => []),
+    api("GET", `/api/v1/clusters/${name}/cis-scans`).catch(() => []),
+    api("GET", "/api/v1/version"),
+    c.plan_id ? api("GET", "/api/v1/plans").catch(() => []) : [],
+    c.plan_id ? api("GET", "/api/v1/plans-tpu-catalog").catch(() => []) : [],
+  ]);
   // TPU ops panel inputs: expected chips derived from the plan's catalog
   // row through the tested logic module (plan topology vs smoke-proven)
   let expectedChips = 0;
-  if (c.plan_id) {
-    const plans = await api("GET", "/api/v1/plans").catch(() => []);
-    const plan = plans.find((p) => p.id === c.plan_id);
-    if (plan && plan.accelerator === "tpu") {
-      const cat = await api("GET", "/api/v1/plans-tpu-catalog").catch(() => []);
-      const entry = KOLogic.catalog_entry(cat, plan.tpu_type);
-      if (entry) {
-        expectedChips =
-          KOLogic.tpu_plan_summary(entry, plan.num_slices).total_chips;
-      }
+  const plan = plans.find?.((p) => p.id === c.plan_id);
+  if (plan && plan.accelerator === "tpu") {
+    const entry = KOLogic.catalog_entry(tpuCatalog, plan.tpu_type);
+    if (entry) {
+      expectedChips =
+        KOLogic.tpu_plan_summary(entry, plan.num_slices).total_chips;
     }
   }
   const tpuPanel = KOLogic.tpu_panel(c, expectedChips);

@@ -235,21 +235,25 @@ def filter_log_lines(lines, query):
     return out
 
 
-def filter_events(events, query):
-    """Activity-feed filter: case-insensitive substring across cluster,
-    reason, message and type — same reset semantics as the log filter."""
+def filter_rows(rows, query, fields):
+    """Shared table search: case-insensitive substring across the named
+    fields; empty query returns everything (filter-reset semantics)."""
     q = str(query).strip().lower()
     if q == "":
-        return events
+        return rows
     out = []
-    for e in events:
-        hay = str(jsrt.get(e, "cluster", "")) + " " \
-            + str(jsrt.get(e, "reason", "")) + " " \
-            + str(jsrt.get(e, "message", "")) + " " \
-            + str(jsrt.get(e, "type", ""))
+    for row in rows:
+        hay = ""
+        for f in fields:
+            hay = hay + str(jsrt.get(row, f, "")) + " "
         if jsrt.contains(hay.lower(), q):
-            out.append(e)
+            out.append(row)
     return out
+
+
+def filter_events(events, query):
+    """Activity-feed filter across cluster, reason, message and type."""
+    return filter_rows(events, query, ["cluster", "reason", "message", "type"])
 
 
 def trace_rows(trace):
@@ -447,20 +451,8 @@ def paginate(rows, page, page_size):
 
 
 def filter_hosts(hosts, query):
-    """Hosts-table search: case-insensitive substring across name, ip,
-    status, and bound cluster — same reset semantics as the log filter."""
-    q = str(query).strip().lower()
-    if q == "":
-        return hosts
-    out = []
-    for h in hosts:
-        hay = str(jsrt.get(h, "name", "")) + " " \
-            + str(jsrt.get(h, "ip", "")) + " " \
-            + str(jsrt.get(h, "status", "")) + " " \
-            + str(jsrt.get(h, "cluster", ""))
-        if jsrt.contains(hay.lower(), q):
-            out.append(h)
-    return out
+    """Hosts-table search across name, ip, status, and bound cluster."""
+    return filter_rows(hosts, query, ["name", "ip", "status", "cluster"])
 
 
 def i18n_next(lang):
