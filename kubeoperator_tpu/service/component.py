@@ -152,6 +152,9 @@ class ComponentService:
                 "uninstall_manifests": list(teardown.get("manifests", [])),
                 "uninstall_files": list(teardown.get("files", [])),
                 "uninstall_unlabel": unlabel,
+                "uninstall_secrets": [
+                    list(pair) for pair in teardown.get("secrets", [])
+                ],
                 "uninstall_namespaces": list(teardown.get("namespaces", [])),
             })
             try:

@@ -811,6 +811,39 @@ class TestComponents:
         with pytest.raises(ValidationError):
             svc.components.install("comp", "gpu")
 
+    def test_observability_components_run_their_operational_tasks(self, svc):
+        """The monitoring/ingress roles are operations, not bare helm
+        one-liners: datasource provisioning, admin-secret generation path,
+        controller tuning, default IngressClass — all visible in the
+        simulated stream."""
+        names = register_fleet(svc, 2)
+        svc.clusters.create("obs", spec=ClusterSpec(worker_count=1),
+                            host_names=names, wait=True)
+        cluster = svc.clusters.get("obs")
+
+        def joined():
+            return "\n".join(l.line for l in svc.repos.task_logs.find(
+                cluster_id=cluster.id))
+
+        svc.components.install("obs", "prometheus")
+        assert "TASK [install prometheus via bundled chart]" in joined()
+
+        svc.components.install("obs", "grafana")
+        out = joined()
+        assert "TASK [render grafana datasource provisioning]" in out
+        assert "TASK [apply grafana datasource provisioning]" in out
+
+        svc.components.install("obs", "loki")
+        assert "TASK [install loki logging stack via bundled chart]" in joined()
+
+        svc.components.install("obs", "ingress-nginx")
+        out = joined()
+        assert "TASK [render controller tuning]" in out
+        assert "TASK [mark nginx the ONLY default IngressClass]" in out
+
+        svc.components.install("obs", "metrics-server")
+        assert "TASK [apply metrics-server manifests]" in joined()
+
     def test_uninstall_runs_catalog_teardown(self, svc):
         """Uninstall is a real operation: the component-uninstall playbook
         runs with the catalog's helm/manifest/namespace teardown data and
