@@ -63,6 +63,9 @@ const I18N = {
     ldap_ok: "connection OK", ldap_synced: "synced",
     needs_attention: "needs attention", chips_mismatch: "chip count mismatch",
     filter_hosts: "filter hosts…", smoke_trend: "psum trend",
+    advanced: "Advanced", cni: "CNI", runtime: "Runtime",
+    kube_proxy: "kube-proxy", ingress: "Ingress",
+    nodelocaldns: "Node-local DNS cache",
   },
   zh: {
     sign_in: "登录", clusters: "集群", hosts: "主机", infra: "基础设施",
@@ -104,6 +107,9 @@ const I18N = {
     ldap_ok: "连接正常", ldap_synced: "已同步",
     needs_attention: "需要关注", chips_mismatch: "芯片数不符",
     filter_hosts: "过滤主机…", smoke_trend: "psum 趋势",
+    advanced: "高级选项", cni: "网络插件", runtime: "容器运行时",
+    kube_proxy: "kube-proxy 模式", ingress: "Ingress 控制器",
+    nodelocaldns: "节点本地 DNS 缓存",
   },
 };
 let lang = localStorage.getItem("ko-lang") || "en";
@@ -694,13 +700,29 @@ $("#wz-plan").addEventListener("change", () => { renderTopology(); wizardCheck()
 function wizardCheck() {
   const errors = KOLogic.wizard_errors(
     $("#wz-mode").value, $("#wz-name").value, $("#wz-plan").value,
-    $("#wz-hosts").value, $("#wz-workers").value);
+    $("#wz-hosts").value, $("#wz-workers").value)
+    .concat(KOLogic.spec_choice_errors(
+      $("#wz-cni").value, $("#wz-runtime").value,
+      $("#wz-proxy").value, $("#wz-ingress").value));
   $("#wz-error").textContent = errors.join(" · ");
   $("#wz-create").disabled = errors.length > 0;
   return errors;
 }
 for (const id of ["#wz-name", "#wz-hosts", "#wz-workers"]) {
   $(id).addEventListener("input", wizardCheck);
+}
+// advanced selects: options come from the logic module's enum source, so
+// they cannot drift from what the validators (client AND server) accept
+{
+  const choices = KOLogic.spec_choices();
+  const opt = (vals) => vals.map((v) => `<option>${esc(v)}</option>`).join("");
+  $("#wz-cni").innerHTML = opt(choices.cni);
+  $("#wz-runtime").innerHTML = opt(choices.runtime);
+  $("#wz-proxy").innerHTML = opt(choices.kube_proxy_mode);
+  $("#wz-ingress").innerHTML = opt(choices.ingress);
+}
+for (const id of ["#wz-cni", "#wz-runtime", "#wz-proxy", "#wz-ingress"]) {
+  $(id).addEventListener("change", wizardCheck);
 }
 
 function renderTopology() {
@@ -738,7 +760,12 @@ $("#wz-create").addEventListener("click", async () => {
   if (wizardCheck().length) return;
   // validation ran on the trimmed name — send exactly what was validated
   const body = { name: $("#wz-name").value.trim(),
-                 spec: { k8s_version: $("#wz-k8s").value } };
+                 spec: { k8s_version: $("#wz-k8s").value,
+                         cni: $("#wz-cni").value,
+                         runtime: $("#wz-runtime").value,
+                         kube_proxy_mode: $("#wz-proxy").value,
+                         ingress: $("#wz-ingress").value,
+                         nodelocaldns_enabled: $("#wz-nodelocaldns").checked } };
   if ($("#wz-mode").value === "plan") {
     body.provision_mode = "plan";
     body.plan = $("#wz-plan").value;

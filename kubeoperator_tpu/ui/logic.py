@@ -205,6 +205,36 @@ def wizard_errors(mode, name, plan_name, hosts_csv, workers):
     return errors
 
 
+def spec_choices():
+    """The wizard's advanced-select enums — SINGLE source for both the
+    rendered <option> lists and the validation below (parity-tested
+    against ClusterSpec.validate)."""
+    return {
+        "cni": ["calico", "flannel", "cilium"],
+        "runtime": ["containerd", "docker"],
+        "kube_proxy_mode": ["iptables", "ipvs"],
+        "ingress": ["nginx", "traefik", "none"],
+    }
+
+
+def spec_choice_errors(cni, runtime, proxy_mode, ingress):
+    """Client-side mirror of ClusterSpec.validate's enum checks (the
+    wizard's advanced section). Selects constrain these in the console,
+    but the logic layer is the contract — a future free-text client (or a
+    tampered DOM) must reject exactly what the server would."""
+    choices = spec_choices()
+    errors = []
+    if not jsrt.contains(choices["cni"], str(cni)):
+        errors.append(f"unknown cni {cni}")
+    if not jsrt.contains(choices["runtime"], str(runtime)):
+        errors.append(f"unknown runtime {runtime}")
+    if not jsrt.contains(choices["kube_proxy_mode"], str(proxy_mode)):
+        errors.append(f"unknown kube_proxy_mode {proxy_mode}")
+    if not jsrt.contains(choices["ingress"], str(ingress)):
+        errors.append(f"unknown ingress {ingress}")
+    return errors
+
+
 def import_form_errors(name, kubeconfig):
     """Client-side mirror of ClusterService.import_cluster's checks: DNS
     name, non-empty kubeconfig that at least carries a clusters section.
@@ -482,6 +512,8 @@ PUBLIC = [
     tpu_plan_summary,
     plan_form_errors,
     wizard_errors,
+    spec_choices,
+    spec_choice_errors,
     k8s_minor,
     upgrade_errors,
     import_form_errors,
