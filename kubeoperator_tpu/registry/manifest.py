@@ -32,10 +32,14 @@ COMPONENT_VERSIONS = {
     "flannel_cni_plugin": "v1.4.1",
     "node_local_dns": "1.23.1",
     "pause": "3.9",
-    # istio charts are consumed from the bundle by path (helm ignores
-    # --version for local charts), so the install role VERIFIES the bundled
-    # Chart.yaml version against this pin and refuses a mismatched bundle
+    # istio/rook charts are consumed from the bundle by path (helm ignores
+    # --version for local charts), so the install roles VERIFY the bundled
+    # Chart.yaml version against this pin and refuse a mismatched bundle
     "istio": "1.22.3",
+    "rook": "v1.14.8",
+    # ceph/ceph image the CephCluster CR pins (rook decouples operator and
+    # ceph versions; both must come from the offline registry)
+    "ceph": "v18.2.2",
 }
 
 
@@ -71,8 +75,8 @@ def bundle_manifest() -> dict:
         "images/loki.tar",
         "images/kube-bench.tar",
         "images/nfs-subdir-external-provisioner.tar",
-        "images/rook-ceph-operator.tar",
-        "images/ceph.tar",
+        f"images/rook-ceph-operator-{COMPONENT_VERSIONS['rook']}.tar",
+        f"images/ceph-{COMPONENT_VERSIONS['ceph']}.tar",
         "images/velero.tar",
         "images/istiod.tar",
         "images/istio-proxyv2.tar",
@@ -91,7 +95,10 @@ def bundle_manifest() -> dict:
     charts = ["charts/prometheus.tgz", "charts/grafana.tgz",
               "charts/loki.tgz", "charts/cilium.tgz",
               "charts/nfs-subdir-external-provisioner.tgz",
-              "charts/rook-ceph.tgz", "charts/rook-ceph-cluster.tgz",
+              # rook-ceph-cluster chart deliberately absent: the CephCluster
+              # CR is a templated manifest so teardown can confirm + await
+              # its deletion (roles/component-rook-ceph)
+              "charts/rook-ceph.tgz",
               "charts/velero.tgz", "charts/istio-base.tgz",
               "charts/istiod.tgz", "charts/istio-gateway.tgz"]
     return {

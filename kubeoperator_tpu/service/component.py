@@ -23,19 +23,30 @@ from kubeoperator_tpu.utils.errors import (
 # shlex split can never turn one value into extra helm/kubectl arguments.
 _INERT_VALUE_RE = re.compile(r"[A-Za-z0-9._:/@+=-]*")
 
+# Catalog "template_only" vars (e.g. rook's device-filter regex) never reach
+# a command line, so regex metacharacters are fine — but they render inside
+# a double-quoted YAML scalar in a kubectl-applied manifest, so anything
+# that could break out of that scalar (quotes, backslash, whitespace,
+# braces) would be manifest injection and is rejected.
+_TEMPLATE_SAFE_RE = re.compile(r"[A-Za-z0-9._^$\[\]()|*+?/-]*")
 
-def _check_vars_inert(vars: dict, origin: str, redact: bool = False) -> None:
+
+def _check_vars_inert(vars: dict, origin: str, redact: bool = False,
+                      template_only: tuple = ()) -> None:
     """`redact=True` for secret-origin vars (backup-account keys): the error
     must name only the offending key, never echo the value into API
-    responses or logs."""
+    responses or logs. Keys in `template_only` get the manifest-safety rule
+    instead of the (stricter) shell-argument rule."""
     for key, value in vars.items():
         if isinstance(value, (bool, int, float)) or value is None:
             continue
-        if not isinstance(value, str) or not _INERT_VALUE_RE.fullmatch(value):
+        rule = _TEMPLATE_SAFE_RE if key in template_only else _INERT_VALUE_RE
+        if not isinstance(value, str) or not rule.fullmatch(value):
             shown = "<redacted>" if redact else repr(value)
+            kind = ("unsafe to render into a manifest"
+                    if key in template_only else "non-argument-inert")
             raise ValidationError(
-                f"{origin} var {key!r} has a non-argument-inert value"
-                f" {shown}"
+                f"{origin} var {key!r} has a {kind} value {shown}"
             )
 
 
@@ -56,6 +67,7 @@ class ComponentService:
                 vars: dict | None = None) -> ClusterComponent:
         cluster = self.repos.clusters.get_by_name(cluster_name)
         cluster.require_managed("component install")
+        entry = COMPONENT_CATALOG.get(component_name, {})
         existing = self.repos.components.find(cluster_id=cluster.id,
                                               name=component_name)
         if existing:
@@ -68,7 +80,7 @@ class ComponentService:
             component = ClusterComponent(
                 cluster_id=cluster.id, name=component_name,
                 vars=dict(vars) if vars is not None else dict(
-                    COMPONENT_CATALOG.get(component_name, {}).get("vars", {})
+                    entry.get("vars", {})
                 ),
             )
         # secret material (object-store keys) rides only in the phase's
@@ -80,18 +92,15 @@ class ComponentService:
                 component.vars
             )
         component.validate()
-        _check_vars_inert(component.vars, component_name)
+        _check_vars_inert(component.vars, component_name,
+                          template_only=tuple(entry.get("template_only", ())))
         _check_vars_inert(secret_vars, f"{component_name} account", redact=True)
-        for required in COMPONENT_CATALOG.get(component_name, {}).get(
-            "required", ()
-        ):
+        for required in entry.get("required", ()):
             if not component.vars.get(required):
                 raise ValidationError(
                     f"{component_name} requires var {required!r}"
                 )
-        for var, allowed in COMPONENT_CATALOG.get(component_name, {}).get(
-            "allowed", {}
-        ).items():
+        for var, allowed in entry.get("allowed", {}).items():
             value = component.vars.get(var)
             if value is not None and value not in allowed:
                 raise ValidationError(
@@ -101,7 +110,7 @@ class ComponentService:
         component.status = "Installing"
         self.repos.components.save(component)
 
-        playbook = COMPONENT_CATALOG[component_name]["playbook"]
+        playbook = entry["playbook"]
         ctx = self._context(cluster, component, secret_vars)
         try:
             self.adm.run(ctx, [Phase(f"component-{component_name}", playbook)])
@@ -119,10 +128,11 @@ class ComponentService:
 
     def uninstall(self, cluster_name: str, component_name: str) -> None:
         """Real teardown, not a status flip: runs component-uninstall.yml
-        with the catalog's declared helm releases / manifests / namespaces
-        (models/component.py "uninstall"). Components without teardown data
-        (tpu-runtime — see catalog rationale) skip straight to the status
-        change."""
+        (or the catalog's "uninstall_playbook" override for components whose
+        teardown is an ordered protocol, e.g. rook-ceph) with the declared
+        helm releases / manifests / namespaces (models/component.py
+        "uninstall"). Components without teardown data (tpu-runtime — see
+        catalog rationale) skip straight to the status change."""
         cluster = self.repos.clusters.get_by_name(cluster_name)
         cluster.require_managed("component uninstall")
         existing = self.repos.components.find(cluster_id=cluster.id,
@@ -130,7 +140,8 @@ class ComponentService:
         if not existing:
             raise NotFoundError(kind="component", name=component_name)
         component = existing[0]
-        teardown = COMPONENT_CATALOG.get(component_name, {}).get("uninstall")
+        entry = COMPONENT_CATALOG.get(component_name, {})
+        teardown = entry.get("uninstall")
         if teardown:
             component.status = "Uninstalling"
             self.repos.components.save(component)
@@ -142,8 +153,7 @@ class ComponentService:
                 # component's actual namespaces, not the catalog default
                 var_name, label = teardown["unlabel_var"]
                 namespaces = str(component.vars.get(
-                    var_name,
-                    COMPONENT_CATALOG[component_name]["vars"].get(var_name, ""),
+                    var_name, entry["vars"].get(var_name, ""),
                 ))
                 unlabel += [[ns, label] for ns in namespaces.split(":") if ns]
             ctx.extra_vars.update({
@@ -157,9 +167,11 @@ class ComponentService:
                 ],
                 "uninstall_namespaces": list(teardown.get("namespaces", [])),
             })
+            playbook = entry.get("uninstall_playbook",
+                                 "component-uninstall.yml")
             try:
                 self.adm.run(ctx, [Phase(f"uninstall-{component_name}",
-                                         "component-uninstall.yml")])
+                                         playbook)])
             except PhaseError as e:
                 component.status = "UninstallFailed"
                 component.message = e.message

@@ -963,6 +963,45 @@ class TestComponents:
         ceph = svc.components.install("stor", "rook-ceph")
         assert ceph.status == "Installed"
 
+    def test_storage_component_knob_validation(self, svc):
+        """Shape-checkable knobs fail at configure time: even mon counts
+        can't form a ceph quorum, and a typo'd reclaim policy would only
+        explode at provision time on a real cluster."""
+        names = register_fleet(svc, 2)
+        svc.clusters.create("storval", spec=ClusterSpec(worker_count=1),
+                            host_names=names, wait=True)
+        with pytest.raises(ValidationError, match="ceph_mon_count"):
+            svc.components.install("storval", "rook-ceph",
+                                   {"ceph_mon_count": 4})
+        with pytest.raises(ValidationError, match="nfs_reclaim_policy"):
+            svc.components.install(
+                "storval", "nfs-provisioner",
+                {"nfs_server": "10.0.0.50", "nfs_reclaim_policy": "Recycle"})
+        # template-only vars (manifest-rendered, never shell) accept regex
+        # metacharacters the inertness check would otherwise reject...
+        ceph = svc.components.install("storval", "rook-ceph",
+                                      {"ceph_device_filter": "^sd[b-z]"})
+        assert ceph.status == "Installed"
+        # ...but NOT characters that could break out of the YAML scalar
+        # they render into (manifest injection via the device filter)
+        for evil in ('x"\n  cleanupPolicy: armed', "x\\", "a b"):
+            with pytest.raises(ValidationError, match="ceph_device_filter"):
+                svc.components.install("storval", "rook-ceph",
+                                       {"ceph_device_filter": evil})
+
+    def test_rook_ceph_uninstall_runs_teardown_protocol(self, svc):
+        """rook's catalog uninstall_playbook override resolves end-to-end:
+        the dedicated protocol playbook (CR deletion dance + generic
+        teardown + hostpath wipe) loads and runs under simulation."""
+        names = register_fleet(svc, 2)
+        svc.clusters.create("storun", spec=ClusterSpec(worker_count=1),
+                            host_names=names, wait=True)
+        ceph = svc.components.install("storun", "rook-ceph")
+        assert ceph.status == "Installed"
+        svc.components.uninstall("storun", "rook-ceph")
+        comp = svc.components.list("storun")[0]
+        assert comp.status == "Uninstalled"
+
     def test_velero_app_backup_flow(self, svc):
         names = register_fleet(svc, 2)
         svc.clusters.create("vel", spec=ClusterSpec(worker_count=1),
