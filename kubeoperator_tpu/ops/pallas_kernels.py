@@ -86,7 +86,7 @@ def _dma_read(x, seed, interpret: bool):
         out_shape=jax.ShapeDtypeStruct((8, COLS), jnp.float32),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec(memory_space=pltpu.ANY),  # stays in HBM; DMA'd manually
+            pl.BlockSpec(memory_space=pl.ANY),  # stays in HBM; DMA'd manually
         ],
         out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
         interpret=interpret,

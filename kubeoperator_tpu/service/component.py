@@ -107,6 +107,19 @@ class ComponentService:
                     f"{component_name} var {var!r} must be one of "
                     f"{sorted(allowed)}, got {value!r}"
                 )
+        # bool-defaulted knobs must arrive as booleans: the string "false"
+        # renders as false to helm (`| lower`) but TRUTHY to jinja `when:`
+        # gates, and that split brain fails installs in ways only a live
+        # cluster would surface (e.g. waiting on a daemonset helm never
+        # deployed)
+        for var, default in entry.get("vars", {}).items():
+            value = component.vars.get(var)
+            if isinstance(default, bool) and value is not None \
+                    and not isinstance(value, bool):
+                raise ValidationError(
+                    f"{component_name} var {var!r} must be a boolean, "
+                    f"got {value!r}"
+                )
         component.status = "Installing"
         self.repos.components.save(component)
 

@@ -831,3 +831,52 @@ def test_template_only_vars_stay_out_of_command_lines():
                 if key in task:
                     for var in exempt:
                         assert var not in str(task[key]), (path, var)
+
+
+def test_velero_gates_on_backup_location_available():
+    """Deployment Running is not the success condition for velero — the
+    BackupStorageLocation must turn Available (velero listing the bucket
+    with the supplied credentials), or wrong endpoint/bucket/keys surface
+    at the first 2am scheduled backup instead of at install."""
+    text = open(os.path.join(
+        ROLES, "component-velero", "tasks", "main.yml"),
+        encoding="utf-8").read()
+    tasks = yaml.safe_load(text)
+    names = [t["name"] for t in tasks]
+    assert names.index("install velero via bundled chart") \
+        < names.index("wait for velero CRDs to register") \
+        < names.index("wait for velero rollout") \
+        < names.index("gate on the backup location becoming Available")
+    bsl = next(t for t in tasks
+               if t["name"] == "gate on the backup location becoming Available")
+    assert "backupstoragelocation" in str(bsl)
+    assert bsl["retries"] >= 10
+    # node agent (fs-level backup daemonset) is opt-in; its rollout wait
+    # only runs when the knob armed it
+    na = next(t for t in tasks if t["name"] == "wait for node agent rollout")
+    assert "velero_node_agent" in str(na["when"])
+    assert "deployNodeAgent" in text
+    assert "s3ForcePathStyle=true" in text   # minio-style endpoints
+
+
+def test_traefik_tuning_is_idempotent_and_gated_on_routability():
+    """Tuning rides TRAEFIK_* env via `kubectl set env` (replace semantics:
+    reinstalls with changed knobs don't accumulate duplicate args), and the
+    install only passes once the Service has ready endpoints and /ping
+    answers — Running pods with an unparsed entrypoint config would
+    otherwise blackhole every Ingress."""
+    text = open(os.path.join(
+        ROLES, "component-traefik", "tasks", "main.yml"),
+        encoding="utf-8").read()
+    assert "set env deployment/traefik" in text
+    assert "TRAEFIK_LOG_LEVEL={{ traefik_log_level | default('INFO') }}" in text
+    assert "TRAEFIK_PING=true" in text       # the gate's endpoint
+    tasks = yaml.safe_load(text)
+    ping = next(t for t in tasks if t["name"] == "verify traefik is routable")
+    assert "healthcheck --ping" in str(ping)
+    assert "no ready endpoints" in str(ping)
+    assert ping["retries"] >= 5
+    names = [t["name"] for t in tasks]
+    assert names.index("tune traefik via environment") \
+        < names.index("wait for traefik rollout") \
+        < names.index("verify traefik is routable")

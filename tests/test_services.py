@@ -989,6 +989,25 @@ class TestComponents:
                 svc.components.install("storval", "rook-ceph",
                                        {"ceph_device_filter": evil})
 
+    def test_traefik_log_level_enum(self, svc):
+        names = register_fleet(svc, 2)
+        svc.clusters.create("ingval", spec=ClusterSpec(worker_count=1),
+                            host_names=names, wait=True)
+        with pytest.raises(ValidationError, match="traefik_log_level"):
+            svc.components.install("ingval", "traefik",
+                                   {"traefik_log_level": "verbose"})
+        tr = svc.components.install("ingval", "traefik",
+                                    {"traefik_log_level": "DEBUG"})
+        assert tr.status == "Installed"
+        # bool-defaulted knobs reject the stringly-typed trap: "false" is
+        # false to helm (`| lower`) but truthy to jinja `when:` gates
+        with pytest.raises(ValidationError, match="must be a boolean"):
+            svc.components.install("ingval", "traefik",
+                                   {"traefik_access_log": "yes"})
+        with pytest.raises(ValidationError, match="velero_node_agent"):
+            svc.components.install("ingval", "velero",
+                                   {"velero_node_agent": "false"})
+
     def test_rook_ceph_uninstall_runs_teardown_protocol(self, svc):
         """rook's catalog uninstall_playbook override resolves end-to-end:
         the dedicated protocol playbook (CR deletion dance + generic
