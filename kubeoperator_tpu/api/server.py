@@ -194,18 +194,19 @@ class Handlers:
 
     # ---- clusters (§3.1) ----
     async def list_clusters(self, request):
-        clusters = await run_sync(request, self.s.clusters.list,
-                                  request.query.get("project") or None)
+        project = request.query.get("project") or None
         user = request["user"]
-        if not user.is_admin:
-            # one membership query off-loop, then a set filter — never N
-            # per-cluster lookups on the event loop
-            memberships = await run_sync(
-                request, self.s.repos.project_members.find, user_id=user.id
-            )
-            member_of = {m.project_id for m in memberships}
-            clusters = [c for c in clusters if c.project_id in member_of]
-        return json_response([c.to_public_dict() for c in clusters])
+
+        def gather():
+            # one membership query off-loop via _visible_clusters, then a
+            # set filter — never N per-cluster lookups on the event loop
+            clusters = self._visible_clusters(user)
+            if project:
+                wanted = {c.id for c in self.s.clusters.list(project)}
+                clusters = [c for c in clusters if c.id in wanted]
+            return [c.to_public_dict() for c in clusters]
+
+        return json_response(await run_sync(request, gather))
 
     async def create_cluster(self, request):
         body = await request.json()
@@ -596,6 +597,49 @@ class Handlers:
         events = await run_sync(request, self.s.events.list, cluster.id)
         return json_response([e.to_public_dict() for e in events])
 
+    def _visible_clusters(self, user):
+        """The ONE visibility rule (admin: all; member: own projects) —
+        shared by the cluster list and the activity feed so what a user
+        can list and whose events they can read never diverge. Sync;
+        callers wrap in run_sync."""
+        clusters = self.s.clusters.list(None)
+        if user.is_admin:
+            return clusters
+        member_of = {
+            m.project_id
+            for m in self.s.repos.project_members.find(user_id=user.id)
+        }
+        return [c for c in clusters if c.project_id in member_of]
+
+    async def all_events(self, request):
+        """Cross-cluster activity feed scoped to the caller's visibility
+        (same membership filter as the cluster list). One call replaces the
+        console's per-cluster fan-out; `total` rides along so the client
+        can SAY when the feed is truncated instead of presenting a capped
+        sample as the whole fleet."""
+        from kubeoperator_tpu.utils.errors import ValidationError
+
+        user = request["user"]
+        try:
+            limit = int(request.query.get("limit", "500") or 500)
+        except ValueError:
+            raise ValidationError("limit must be an integer")
+        limit = max(1, min(limit, 2000))
+
+        def gather():
+            clusters = self._visible_clusters(user)
+            names = {c.id: c.name for c in clusters}
+            events = self.s.repos.events.find_recent(names, limit)
+            total = self.s.repos.events.count_for(names)
+            rows = []
+            for e in events:
+                row = e.to_public_dict()
+                row["cluster"] = names.get(e.cluster_id, "")
+                rows.append(row)
+            return {"events": rows, "total": total}
+
+        return json_response(await run_sync(request, gather))
+
     async def cluster_trace(self, request):
         """Create-to-Ready wall-clock as a native trace (SURVEY.md §5.1:
         the BASELINE metric is literally a span over the adm phases)."""
@@ -757,6 +801,7 @@ def create_app(services: Services) -> web.Application:
                cluster_guard(h.install_component, manage))
     r.add_delete("/api/v1/clusters/{name}/components/{component}",
                  cluster_guard(h.uninstall_component, manage))
+    r.add_get("/api/v1/events", h.all_events)
     r.add_get("/api/v1/clusters/{name}/events",
               cluster_guard(h.cluster_events, view))
     r.add_post("/api/v1/clusters/{name}/events/sync",

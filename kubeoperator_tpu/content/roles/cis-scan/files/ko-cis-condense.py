@@ -29,7 +29,17 @@ def main():
     totals = {"pass": 0, "fail": 0, "warn": 0, "info": 0}
     checks = []
     policy = ""
+    node = ""
     for doc in iter_json_docs(sys.stdin.read()):
+        # each scan pod prints a {"ko_node": "<hostname>"} marker before its
+        # kube-bench output (job template); kubectl prints logs per-pod, so
+        # the marker scopes every following doc until the next marker.
+        # Checks then carry a REAL node name — the console's drift logic
+        # keys on (id, node), and "same control, new node" must register as
+        # a regression, which node_type alone ("master"/"node") cannot.
+        if "ko_node" in doc and not doc.get("Controls"):
+            node = str(doc.get("ko_node", ""))
+            continue
         for control in doc.get("Controls", []):
             policy = policy or control.get("version", "")
             for group in control.get("tests", []):
@@ -42,7 +52,7 @@ def main():
                             "id": check.get("test_number", ""),
                             "text": check.get("test_desc", ""),
                             "status": state.upper(),
-                            "node": doc.get("node_type", ""),
+                            "node": node or doc.get("node_type", ""),
                             "remediation": (check.get("remediation", "") or "")[:500],
                         })
         t = doc.get("Totals", {})

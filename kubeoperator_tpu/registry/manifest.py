@@ -36,6 +36,7 @@ COMPONENT_VERSIONS = {
     # --version for local charts), so the install roles VERIFY the bundled
     # Chart.yaml version against this pin and refuse a mismatched bundle
     "istio": "1.22.3",
+    "kube_bench": "v0.7.3",
     "rook": "v1.14.8",
     # ceph/ceph image the CephCluster CR pins (rook decouples operator and
     # ceph versions; both must come from the offline registry)
@@ -73,7 +74,7 @@ def bundle_manifest() -> dict:
         "images/prometheus.tar",
         "images/grafana.tar",
         "images/loki.tar",
-        "images/kube-bench.tar",
+        f"images/kube-bench-{COMPONENT_VERSIONS['kube_bench']}.tar",
         "images/nfs-subdir-external-provisioner.tar",
         f"images/rook-ceph-operator-{COMPONENT_VERSIONS['rook']}.tar",
         f"images/ceph-{COMPONENT_VERSIONS['ceph']}.tar",

@@ -176,6 +176,35 @@ class UserRepo(EntityRepo[User]):
 class EventRepo(EntityRepo[Event]):
     table, entity, columns = "events", Event, ("cluster_id",)
 
+    def find_recent(self, cluster_ids: Iterable[str],
+                    limit: int) -> list[Event]:
+        """Newest-first feed across clusters, capped IN SQL — the activity
+        endpoint must not hydrate every event ever emitted just to keep
+        the newest few hundred."""
+        ids = list(cluster_ids)
+        if not ids or limit < 1:
+            return []
+        placeholders = ",".join("?" for _ in ids)
+        rows = self.db.query(
+            f"SELECT data FROM {self.table} "
+            f"WHERE cluster_id IN ({placeholders}) "
+            f"ORDER BY created_at DESC LIMIT ?",
+            (*ids, limit),
+        )
+        return [self._hydrate(r["data"]) for r in rows]
+
+    def count_for(self, cluster_ids: Iterable[str]) -> int:
+        ids = list(cluster_ids)
+        if not ids:
+            return 0
+        placeholders = ",".join("?" for _ in ids)
+        rows = self.db.query(
+            f"SELECT COUNT(*) AS n FROM {self.table} "
+            f"WHERE cluster_id IN ({placeholders})",
+            tuple(ids),
+        )
+        return int(rows[0]["n"])
+
 
 class MessageRepo(EntityRepo[Message]):
     table, entity, columns = "messages", Message, ("user_id",)

@@ -51,6 +51,10 @@ const I18N = {
     filter_logs: "filter logs…", total: "total",
     num_slices: "Slices", slice_topology: "ICI topology (e.g. 4x4)",
     filter_events: "filter activity…", findings: "Findings",
+    since_last_scan: "Since last scan", cis_new: "new",
+    cis_resolved: "resolved", cis_persisting: "persisting",
+    last_24h: "Last 24h", warnings: "warnings", normals: "normal",
+    newest: "newest",
     kubeconfig: "Kubeconfig", details: "Details",
     scale_slices: "＋ Add slices",
     renew_certs: "Renew certs", rotate_key: "Rotate secrets key",
@@ -95,6 +99,10 @@ const I18N = {
     filter_logs: "过滤日志…", total: "总计",
     num_slices: "切片数", slice_topology: "ICI 拓扑（如 4x4）",
     filter_events: "过滤操作记录…", findings: "检查发现",
+    since_last_scan: "与上次扫描相比", cis_new: "新增",
+    cis_resolved: "已修复", cis_persisting: "持续存在",
+    last_24h: "最近24小时", warnings: "告警", normals: "正常",
+    newest: "最新",
     kubeconfig: "Kubeconfig", details: "详情",
     scale_slices: "＋ 扩容切片",
     renew_certs: "轮换证书", rotate_key: "轮换加密密钥",
@@ -387,6 +395,7 @@ async function openCluster(name) {
     </div>`}
 
     <h3>${t("security")}</h3>
+    ${cisDriftHtml(scans)}
     <table class="grid"><tr><th>scan</th><th>status</th><th>pass</th><th>fail</th><th>warn</th><th></th></tr>
     ${scans.map((s, i) => `<tr><td>${esc(s.policy || s.id || s.name)}</td><td>${s.status}</td>
       <td>${s.total_pass ?? s.passed ?? ""}</td><td>${s.total_fail ?? s.failed ?? ""}</td><td>${s.total_warn ?? s.warned ?? ""}</td>
@@ -414,6 +423,7 @@ async function openCluster(name) {
     </div>
     <div class="logbox" id="d-logs"></div>
     <h3>${t("events")}</h3>
+    ${eventPulse(events)}
     <div>${events.map((e) =>
       `<div class="feed-item ${e.type}"><span class="when">${new Date(e.created_at * 1000).toLocaleTimeString()}</span>[${esc(e.reason)}] ${esc(e.message)}</div>`
     ).join("")}</div>`;
@@ -1044,12 +1054,42 @@ async function refreshAdmin() {
   ).join("") || `<div class="muted">${t("no_activity")}</div>`;
 }
 
+// scan-over-scan CIS drift badge: regressions/resolved/persisting (data
+// from KOLogic.cis_delta_from_scans, tested; the DOM here is render-only)
+function cisDriftHtml(scans) {
+  const d = KOLogic.cis_delta_from_scans(scans);
+  if (!d.comparable) return "";
+  const badge = `<div class="muted">${t("since_last_scan")}:
+    <span class="${d.regressions.length ? "cis-fail" : ""}">▲ ${d.regressions.length} ${t("cis_new")}</span>
+    · ✓ ${d.resolved.length} ${t("cis_resolved")} · ${d.persisting} ${t("cis_persisting")}</div>`;
+  if (!d.regressions.length) return badge;
+  return badge + `<div class="muted">${d.regressions.map((c) =>
+    `${esc(c.id)}@${esc(c.node || "?")}`).join(" · ")}</div>`;
+}
+
+// 24h warning/normal pulse + top repeating warning reasons (data from
+// KOLogic.event_rollup, tested; the DOM here is render-only)
+function eventPulse(events) {
+  const r = KOLogic.event_rollup(events, Date.now() / 1000, 86400);
+  if (!r.warnings && !r.normals) return "";
+  const reasons = r.top_warning_reasons.map((x) =>
+    `${esc(x.reason)}×${x.count}`).join(" · ");
+  return `<div class="muted">${t("last_24h")}:
+    <span class="${r.warnings ? "cis-fail" : ""}">${r.warnings} ${t("warnings")}</span>
+    · ${r.normals} ${t("normals")}${reasons ? ` · ${reasons}` : ""}</div>`;
+}
+
 let eventCache = [];
+let eventTotal = 0;
 let eventPage = 1;
 function renderEvents() {
   const shown = KOLogic.filter_events(eventCache, $("#event-filter").value);
   const page = KOLogic.paginate(shown, eventPage, 50);
   eventPage = page.page;
+  // the pulse must never present a capped sample as the whole fleet
+  const trunc = eventTotal > eventCache.length
+    ? `<span class="muted"> (${t("newest")} ${eventCache.length}/${eventTotal})</span>` : "";
+  $("#event-pulse").innerHTML = eventPulse(eventCache) + trunc;
   $("#event-feed").innerHTML = page.rows.map((e) =>
     `<div class="feed-item ${e.type}"><span class="when">${new Date(e.created_at * 1000).toLocaleString()}</span>
      <b>${esc(e.cluster)}</b> [${esc(e.reason)}] ${esc(e.message)}</div>`).join("") ||
@@ -1058,14 +1098,12 @@ function renderEvents() {
 }
 $("#event-filter").addEventListener("input", () => { eventPage = 1; renderEvents(); });
 async function refreshEvents() {
-  const clusters = await api("GET", "/api/v1/clusters").catch(() => []);
-  const feeds = [];
-  for (const c of clusters.slice(0, 10)) {
-    const events = await api("GET", `/api/v1/clusters/${c.name}/events`).catch(() => []);
-    events.forEach((e) => feeds.push({ ...e, cluster: c.name }));
-  }
-  feeds.sort((a, b) => b.created_at - a.created_at);
-  eventCache = feeds;
+  // one visibility-scoped call (server sorts + caps in SQL) — the 24h
+  // pulse summarizes the whole accessible fleet or says it couldn't
+  const feed = await api("GET", "/api/v1/events")
+    .catch(() => ({ events: [], total: 0 }));
+  eventCache = feed.events || [];
+  eventTotal = feed.total || 0;
   renderEvents();
 }
 

@@ -485,6 +485,120 @@ def filter_hosts(hosts, query):
     return filter_rows(hosts, query, ["name", "ip", "status", "cluster"])
 
 
+def completed_cis_scans(scans):
+    """Scans that actually produced results — Running/Error rows carry no
+    checks and must not participate in drift comparison."""
+    done = []
+    for s in scans:
+        st = str(jsrt.get(s, "status", ""))
+        if st == "Passed" or st == "Warn" or st == "Failed":
+            done.append(s)
+    return done
+
+
+def _check_key(c):
+    return str(jsrt.get(c, "id", "")) + "@" + str(jsrt.get(c, "node", ""))
+
+
+def cis_delta(latest, previous):
+    """Security drift between two completed scans: which non-passing checks
+    are NEW (regressions — the question after every upgrade), which were
+    resolved, and how many persist. Check identity is (id, node): the same
+    control failing on a NEW node is a regression on that node, not
+    'unchanged'. Comparison is a MULTISET: when node names collapse (the
+    condense script falls back to kube-bench's node_type if no hostname
+    marker was captured), a second occurrence of an already-failing key is
+    still a regression, not absorbed by the first."""
+    if latest is None:
+        return {"regressions": [], "resolved": [], "persisting": 0,
+                "comparable": False}
+    latest_checks = jsrt.get(latest, "checks", [])
+    if previous is None:
+        return {"regressions": [], "resolved": [],
+                "persisting": len(latest_checks), "comparable": False}
+    prev_remaining = {}
+    for c in jsrt.get(previous, "checks", []):
+        k = _check_key(c)
+        prev_remaining[k] = jsrt.num(jsrt.get(prev_remaining, k, 0)) + 1
+    regressions = []
+    persisting = 0
+    latest_counts = {}
+    for c in latest_checks:
+        k = _check_key(c)
+        latest_counts[k] = jsrt.num(jsrt.get(latest_counts, k, 0)) + 1
+        if jsrt.num(jsrt.get(prev_remaining, k, 0)) > 0:
+            prev_remaining[k] = jsrt.num(jsrt.get(prev_remaining, k, 0)) - 1
+            persisting = persisting + 1
+        else:
+            regressions.append(c)
+    resolved = []
+    for c in jsrt.get(previous, "checks", []):
+        k = _check_key(c)
+        if jsrt.num(jsrt.get(latest_counts, k, 0)) > 0:
+            latest_counts[k] = jsrt.num(jsrt.get(latest_counts, k, 0)) - 1
+        else:
+            resolved.append(c)
+    return {"regressions": regressions, "resolved": resolved,
+            "persisting": persisting, "comparable": True}
+
+
+def cis_delta_from_scans(scans):
+    """Drift badge input for the security table: latest completed scan vs
+    the one before it, in the list's stored order (oldest first)."""
+    done = completed_cis_scans(scans)
+    if len(done) == 0:
+        return cis_delta(None, None)
+    if len(done) == 1:
+        return cis_delta(done[len(done) - 1], None)
+    return cis_delta(done[len(done) - 1], done[len(done) - 2])
+
+
+def event_rollup(events, now_s, window_s):
+    """Operational pulse of the event timeline: Warning/Normal counts
+    inside the window plus the top repeating Warning reasons — 300
+    identical FailedScheduling warnings are ONE story, not 300 rows."""
+    warnings = 0
+    normals = 0
+    reasons = []
+    for e in events:
+        ts = jsrt.num(jsrt.get(e, "created_at", 0))
+        if jsrt.num(now_s) - ts > jsrt.num(window_s):
+            continue
+        if str(jsrt.get(e, "type", "")) == "Warning":
+            warnings = warnings + 1
+            r = str(jsrt.get(e, "reason", ""))
+            found = False
+            for row in reasons:
+                if str(jsrt.get(row, "reason", "")) == r:
+                    row["count"] = jsrt.num(jsrt.get(row, "count", 0)) + 1
+                    found = True
+            if not found:
+                reasons.append({"reason": r, "count": 1})
+        else:
+            normals = normals + 1
+    # top three reasons by count, selection-style (tiny lists; the
+    # transpiled subset has no sort-with-key)
+    top = []
+    while len(reasons) > 0 and len(top) < 3:
+        best = 0
+        i = 1
+        while i < len(reasons):
+            if jsrt.num(jsrt.get(reasons[i], "count", 0)) \
+                    > jsrt.num(jsrt.get(reasons[best], "count", 0)):
+                best = i
+            i = i + 1
+        top.append(reasons[best])
+        rest = []
+        j = 0
+        for row in reasons:
+            if jsrt.num(j) != best:
+                rest.append(row)
+            j = j + 1
+        reasons = rest
+    return {"warnings": warnings, "normals": normals,
+            "top_warning_reasons": top}
+
+
 def i18n_next(lang):
     if lang == "zh":
         return "en"
@@ -526,6 +640,10 @@ PUBLIC = [
     smoke_trend,
     tpu_panel,
     paginate,
+    completed_cis_scans,
+    cis_delta,
+    cis_delta_from_scans,
+    event_rollup,
     i18n_next,
     i18n_get,
 ]

@@ -370,6 +370,85 @@ SCHEMAS: dict[str, dict] = {
         },
         "required": ["apiVersion", "kind", "metadata", "spec"],
     },
+    # rook CRDs used by the component-rook-ceph role's templated cluster;
+    # the schema pins the operational promises the role makes: odd mon
+    # counts (quorum math), a string cleanup confirmation (armed only by
+    # the teardown protocol), and a registry-sourced ceph image
+    "CephCluster": {
+        **_TOP,
+        "properties": {
+            **_TOP["properties"],
+            "spec": {
+                "type": "object",
+                "properties": {
+                    "cephVersion": {
+                        "type": "object",
+                        "properties": {"image": {"type": "string"}},
+                        "required": ["image"],
+                    },
+                    "dataDirHostPath": {"type": "string"},
+                    "mon": {
+                        "type": "object",
+                        "properties": {
+                            "count": {"enum": [1, 3, 5]},
+                            "allowMultiplePerNode": {"type": "boolean"},
+                        },
+                        "required": ["count"],
+                    },
+                    "mgr": {"type": "object"},
+                    "dashboard": {"type": "object"},
+                    "storage": {"type": "object"},
+                    "disruptionManagement": {"type": "object"},
+                    "cleanupPolicy": {
+                        "type": "object",
+                        "properties": {
+                            "confirmation": {"type": "string"},
+                            "sanitizeDisks": {"type": "object"},
+                        },
+                    },
+                },
+                "required": ["cephVersion", "mon", "storage"],
+            },
+        },
+        "required": ["apiVersion", "kind", "metadata", "spec"],
+    },
+    "StorageClass": {
+        **_TOP,
+        "properties": {
+            **_TOP["properties"],
+            "provisioner": {"type": "string"},
+            "parameters": {"type": "object"},
+            "allowVolumeExpansion": {"type": "boolean"},
+            "reclaimPolicy": {"enum": ["Delete", "Retain"]},
+            "volumeBindingMode": {"enum": ["Immediate",
+                                           "WaitForFirstConsumer"]},
+        },
+        "required": ["apiVersion", "kind", "metadata", "provisioner"],
+    },
+    "CephBlockPool": {
+        **_TOP,
+        "properties": {
+            **_TOP["properties"],
+            "spec": {
+                "type": "object",
+                "properties": {
+                    "failureDomain": {"enum": ["host", "osd", "rack",
+                                               "zone"]},
+                    "replicated": {
+                        "type": "object",
+                        "properties": {
+                            "size": {"type": "integer", "minimum": 1},
+                            # the role's anti-undersized-pool promise
+                            "requireSafeReplicaSize": {"enum": [True]},
+                        },
+                        "required": ["size", "requireSafeReplicaSize"],
+                    },
+                },
+                "required": ["replicated"],
+            },
+        },
+        "required": ["apiVersion", "kind", "metadata", "spec"],
+    },
 }
 
 

@@ -156,3 +156,39 @@ class TestCondenseHelper:
         assert data["policy"] == "cis-1.8"
         assert {c["id"] for c in data["checks"]} == {"1.2.3", "1.4.5"}
         assert data["checks"][0]["remediation"] == "fix it"
+
+
+class TestCondenseNodeAttribution:
+    def test_marker_docs_scope_following_checks_to_real_nodes(self):
+        """Each scan pod echoes {"ko_node": <hostname>} before kube-bench
+        output; the condensed checks must carry real node names — drift
+        logic keys on (id, node) and 'same control, new node' must be
+        distinguishable."""
+        def bench_doc(node_type, test_id):
+            return {"Controls": [{"version": "cis-1.8", "tests": [{
+                "results": [{"test_number": test_id, "test_desc": "d",
+                             "status": "FAIL"}]}]}],
+                    "node_type": node_type}
+        stream = "\n".join([
+            json.dumps({"ko_node": "master-1"}),
+            json.dumps(bench_doc("master", "1.1.1")),
+            json.dumps({"ko_node": "worker-2"}),
+            json.dumps(bench_doc("node", "4.1.1")),
+        ])
+        out = subprocess.run(
+            [sys.executable, CONDENSE], input=stream,
+            capture_output=True, text=True, check=True).stdout
+        data = parse_cis_result(out.splitlines())
+        nodes = {c["id"]: c["node"] for c in data["checks"]}
+        assert nodes == {"1.1.1": "master-1", "4.1.1": "worker-2"}
+
+    def test_missing_marker_falls_back_to_node_type(self):
+        doc = {"Controls": [{"version": "cis-1.8", "tests": [{
+            "results": [{"test_number": "1.1.1", "test_desc": "d",
+                         "status": "FAIL"}]}]}],
+               "node_type": "master"}
+        out = subprocess.run(
+            [sys.executable, CONDENSE], input=json.dumps(doc),
+            capture_output=True, text=True, check=True).stdout
+        data = parse_cis_result(out.splitlines())
+        assert data["checks"][0]["node"] == "master"

@@ -554,3 +554,101 @@ class TestServedLogic:
         index = requests.get(f"{base}/").text
         # logic.js must load before app.js (app.js calls KOLogic at parse)
         assert index.index("/ui/logic.js") < index.index("/ui/app.js")
+
+
+class TestCisDrift:
+    """Security drift between scans: the post-upgrade question ('did this
+    regress CIS posture?') answered client-side from stored scans."""
+
+    def _scan(self, status, checks):
+        return {"status": status,
+                "checks": [{"id": i, "node": n, "status": "FAIL"}
+                           for i, n in checks]}
+
+    def test_regressions_resolved_and_persisting(self):
+        prev = self._scan("Warn", [("1.1.1", "m1"), ("1.2.4", "m1")])
+        latest = self._scan("Failed", [("1.2.4", "m1"), ("4.1.1", "w1")])
+        d = logic.cis_delta(latest, prev)
+        assert d["comparable"] is True
+        assert [c["id"] for c in d["regressions"]] == ["4.1.1"]
+        assert [c["id"] for c in d["resolved"]] == ["1.1.1"]
+        assert d["persisting"] == 1
+
+    def test_same_check_on_new_node_is_a_regression(self):
+        """A mis-classification here would hide a real regression: control
+        1.2.4 was already failing on m1, but it NEWLY fails on m2."""
+        prev = self._scan("Warn", [("1.2.4", "m1")])
+        latest = self._scan("Warn", [("1.2.4", "m1"), ("1.2.4", "m2")])
+        d = logic.cis_delta(latest, prev)
+        assert len(d["regressions"]) == 1
+        assert d["regressions"][0]["node"] == "m2"
+        assert d["persisting"] == 1
+        assert d["resolved"] == []
+
+    def test_running_and_error_scans_excluded_from_comparison(self):
+        scans = [
+            self._scan("Warn", [("1.1.1", "m1")]),
+            self._scan("Failed", [("1.1.1", "m1"), ("4.1.1", "w1")]),
+            self._scan("Error", [])   # kube-bench crashed: no results
+        ] + [{"status": "Running", "checks": []}]
+        d = logic.cis_delta_from_scans(scans)
+        # compares the two COMPLETED scans, not Failed-vs-Error
+        assert d["comparable"] is True
+        assert [c["id"] for c in d["regressions"]] == ["4.1.1"]
+
+    def test_single_or_no_completed_scan_not_comparable(self):
+        assert logic.cis_delta_from_scans([])["comparable"] is False
+        one = logic.cis_delta_from_scans(
+            [self._scan("Warn", [("1.1.1", "m1")])])
+        assert one["comparable"] is False
+        assert one["persisting"] == 1   # still counts current findings
+
+
+class TestEventRollup:
+    def _ev(self, type_, reason, age_s, now=1000000.0):
+        return {"type": type_, "reason": reason, "created_at": now - age_s}
+
+    def test_window_and_type_split(self):
+        now = 1000000.0
+        events = [
+            self._ev("Warning", "PhaseFailed", 100),
+            self._ev("Warning", "PhaseFailed", 200),
+            self._ev("Normal", "ClusterReady", 50),
+            self._ev("Warning", "BackupFailed", 90000),   # outside 24h
+        ]
+        r = logic.event_rollup(events, now, 86400)
+        assert r["warnings"] == 2 and r["normals"] == 1
+        assert r["top_warning_reasons"] == [
+            {"reason": "PhaseFailed", "count": 2}]
+
+    def test_top_reasons_ranked_and_capped(self):
+        now = 1000000.0
+        events = (
+            [self._ev("Warning", "A", 10)] * 1
+            + [self._ev("Warning", "B", 10)] * 3
+            + [self._ev("Warning", "C", 10)] * 2
+            + [self._ev("Warning", "D", 10)] * 5
+        )
+        r = logic.event_rollup(events, now, 86400)
+        top = r["top_warning_reasons"]
+        assert [x["reason"] for x in top] == ["D", "B", "C"]   # capped at 3
+        assert [x["count"] for x in top] == [5, 3, 2]
+
+
+class TestCisDriftMultiset:
+    def _scan(self, status, checks):
+        return TestCisDrift._scan(None, status, checks)
+
+    def test_duplicate_keys_compare_as_multiset(self):
+        """When node names collapse to a shared label (node_type fallback),
+        a SECOND occurrence of an already-failing key must still register
+        as a regression — contains()-style matching would absorb it."""
+        prev = self._scan("Warn", [("1.2.4", "node")])
+        latest = self._scan("Warn", [("1.2.4", "node"), ("1.2.4", "node")])
+        d = logic.cis_delta(latest, prev)
+        assert len(d["regressions"]) == 1
+        assert d["persisting"] == 1
+        assert d["resolved"] == []
+        # and shrinking occurrences shows up as resolved
+        back = logic.cis_delta(prev, latest)
+        assert len(back["resolved"]) == 1 and back["persisting"] == 1
