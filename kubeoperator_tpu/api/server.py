@@ -202,8 +202,7 @@ class Handlers:
             # set filter — never N per-cluster lookups on the event loop
             clusters = self._visible_clusters(user)
             if project:
-                wanted = {c.id for c in self.s.clusters.list(project)}
-                clusters = [c for c in clusters if c.id in wanted]
+                clusters = [c for c in clusters if c.project_id == project]
             return [c.to_public_dict() for c in clusters]
 
         return json_response(await run_sync(request, gather))
@@ -598,10 +597,13 @@ class Handlers:
         return json_response([e.to_public_dict() for e in events])
 
     def _visible_clusters(self, user):
-        """The ONE visibility rule (admin: all; member: own projects) —
-        shared by the cluster list and the activity feed so what a user
-        can list and whose events they can read never diverge. Sync;
-        callers wrap in run_sync."""
+        """The LIST visibility rule (admin: all; member: own projects) —
+        shared by the cluster list and the activity feed so the activity
+        tab always summarizes exactly the clusters rendered beside it.
+        Deliberately narrower than cluster_guard's per-cluster VIEW rule
+        (which also lets any authenticated user read an unscoped cluster
+        by name): the fleet views show what you belong to; direct reads
+        reach what you may inspect. Sync; callers wrap in run_sync."""
         clusters = self.s.clusters.list(None)
         if user.is_admin:
             return clusters

@@ -49,6 +49,15 @@ class NetConfig:
     s_local: int = S_LOCAL
     dtype: str = "float32"     # "bfloat16" for MXU-rate benching
     lr: float = 0.1            # SGD step; scale-appropriate per config
+    # rematerialisation policy for the stage block under grad:
+    #   full — jax.checkpoint, recompute everything (the multi-chip
+    #          HBM-for-FLOPs trade this net exists to validate; CI default)
+    #   dots — checkpoint with dots_with_no_batch_dims_saveable: weight
+    #          matmul outputs are saved, only cheap elementwise/batched ops
+    #          recompute — the right trade when HBM has headroom, since
+    #          full remat re-pays ~1/3 of the model FLOPs in recompute
+    #   none — no checkpoint; XLA keeps what backward needs
+    remat: str = "full"
 
     def np_dtype(self):
         import ml_dtypes
@@ -57,14 +66,18 @@ class NetConfig:
 
 
 # chip-filling shape for single-host benching, picked by an on-device sweep
-# (v5e, r3): d_h=512 heads keep the attention matmuls MXU-sized, the 4x FFN
-# dominates FLOPs, and b=12 fills the remat-bounded HBM envelope —
-# measured 116.7 model-TFLOP/s = 59.3% MFU (d2048/h16/b8 shape: 33%).
-# Remat recompute is excluded from the FLOP count, so hardware utilization
-# is ~4/3 of reported MFU.
+# (v5e, r3): d_h=512 heads keep the attention matmuls MXU-sized and the 8x
+# FFN dominates FLOPs. remat="none" is the single biggest lever — full
+# remat re-pays a forward pass in backward, taxing ~1/4 of the achievable
+# rate (57.8% -> 73.7% MFU at the same shape) — and the HBM it frees lets
+# batch and FFN grow to the measured knee: b=12/ff16384/full 113.9 ->
+# b=48/ff32768/none 164.9 model-TFLOP/s = 83.7% MFU (full bench.py run;
+# the sweep's 12-step probe of the same shape read 164.4). One step past
+# in either direction (ff49152 or b=64) drops to ~72-73% on HBM pressure —
+# measured, not guessed; re-sweep per generation.
 BENCH_CONFIG = NetConfig(
-    d_model=4096, d_ff=16384, heads=8, b_local=12, s_local=1024,
-    dtype="bfloat16", lr=5e-4,
+    d_model=4096, d_ff=32768, heads=8, b_local=48, s_local=1024,
+    dtype="bfloat16", lr=5e-4, remat="none",
 )
 
 
@@ -235,7 +248,21 @@ def make_train_step(mesh, lr: float | None = None, cfg: NetConfig | None = None)
         ppermute ring schedule (pp steps), each device always applying its
         own stage weights to whatever activation arrives."""
         perm = [(i, (i + 1) % pp) for i in range(pp)]
-        block = jax.checkpoint(stage_block)   # remat validated under grad
+        if cfg.remat == "full":
+            block = jax.checkpoint(stage_block)   # remat validated under grad
+        elif cfg.remat == "dots":
+            block = jax.checkpoint(
+                stage_block,
+                policy=jax.checkpoint_policies
+                .dots_with_no_batch_dims_saveable,
+            )
+        elif cfg.remat == "none":
+            block = stage_block
+        else:
+            # a typo'd policy silently running without checkpointing would
+            # OOM HBM-bound runs or misattribute benchmark numbers
+            raise ValueError(f"unknown remat policy {cfg.remat!r} "
+                             "(full|dots|none)")
 
         def hop(h, _):
             h = block(h, p["wqkv"][0], p["w_in"][0], p["w_out"][0],

@@ -235,6 +235,26 @@ class TestTrainSmoke:
         assert result["losses"][-1] < result["losses"][0]
         assert result["mesh"] == {"dp": 1, "pp": 2, "sp": 2, "tp": 2}
 
+    def test_remat_policies_agree_on_losses(self):
+        """The remat knob changes WHAT is recomputed, never the math: all
+        three policies must produce identical loss trajectories on the
+        virtual mesh (full remat recompute, dots-saveable, no checkpoint)."""
+        from kubeoperator_tpu.ops import run_train_smoke
+        from kubeoperator_tpu.parallel.validation_net import NetConfig
+
+        trajectories = {}
+        for remat in ("full", "dots", "none"):
+            result = run_train_smoke(steps=3, cfg=NetConfig(remat=remat))
+            assert result["ok"] is True, remat
+            trajectories[remat] = result["losses"]
+        assert trajectories["full"] == pytest.approx(
+            trajectories["dots"], rel=1e-5)
+        assert trajectories["full"] == pytest.approx(
+            trajectories["none"], rel=1e-5)
+        # a typo'd policy must raise, not silently run uncheckpointed
+        with pytest.raises(ValueError, match="remat"):
+            run_train_smoke(steps=1, cfg=NetConfig(remat="Full"))
+
     def test_analytic_flops_and_mfu_reporting(self):
         """VERDICT r2 #9: steps/s converts to achieved model TFLOP/s via the
         net's analytic FLOPs, and to MFU% when a datasheet peak is given."""
