@@ -547,11 +547,11 @@ def test_etcd_backup_authenticates_against_tls_etcd():
     carry endpoint + cert flags — a bare `etcdctl snapshot save` only works
     against plaintext etcd and fails on every real cluster this content
     builds."""
-    role = open(os.path.join(CONTENT, "roles/backup-etcd/tasks/main.yml"),
+    role = open(os.path.join(CONTENT, "roles/backup-etcd/tasks/snapshot.yml"),
                 encoding="utf-8").read()
     assert "--endpoints https://127.0.0.1:2379" in role
     assert "--cacert /etc/etcd/pki/ca.crt" in role
-    assert role.index("ensure backup directory exists") \
+    assert role.index("ensure snapshot directory exists") \
         < role.index("take etcd snapshot")
 
 
@@ -880,3 +880,108 @@ def test_traefik_tuning_is_idempotent_and_gated_on_routability():
     assert names.index("tune traefik via environment") \
         < names.index("wait for traefik rollout") \
         < names.index("verify traefik is routable")
+
+
+# ---------------------------------------------------------------------------
+# day-2 lifecycle depth: drain / upgrade-prepare / upgrade-verify / reset
+# ---------------------------------------------------------------------------
+
+def _role_tasks(role):
+    return yaml.safe_load(open(os.path.join(
+        ROLES, role, "tasks", "main.yml"), encoding="utf-8"))
+
+
+def test_drain_is_budget_aware_with_uncordon_rollback():
+    """Eviction order: polite (PDBs respected, retried) -> force for
+    unmanaged pods only (never --disable-eviction) -> uncordon + fail, so
+    an aborted scale-down never strands a node unschedulable."""
+    tasks = _role_tasks("drain")
+    names = [t["name"] for t in tasks]
+    assert names.index("cordon leaving node") \
+        < names.index("drain leaving node (respecting disruption budgets)") \
+        < names.index("force-drain unmanaged pods") \
+        < names.index("uncordon the undrainable node") \
+        < names.index("fail when the node could not be drained")
+    polite = tasks[names.index(
+        "drain leaving node (respecting disruption budgets)")]
+    assert "--force" not in str(polite.values())
+    assert polite["retries"] >= 3
+    # the historic marker the scale-down failure drill injects must still
+    # match (executor __fail_at_task__ is a substring match)
+    assert "drain leaving node" in polite["name"]
+    for t in tasks:   # flag absent from every COMMAND (comments may name it)
+        for key in ("ansible.builtin.command", "ansible.builtin.shell"):
+            assert "--disable-eviction" not in str(t.get(key, "")), t["name"]
+    for guarded in ("force-drain unmanaged pods",
+                    "uncordon the undrainable node",
+                    "fail when the node could not be drained"):
+        assert "drain_polite.rc != 0" in str(tasks[names.index(guarded)]["when"])
+
+
+def test_upgrade_prepare_snapshots_etcd_before_touching_nodes():
+    """Preflight order: health -> disk -> etcd snapshot (the undo button)
+    -> artifact downloads. The snapshot is the SHARED TLS+integrity block
+    (one copy with the backup flow, so the discipline cannot drift), into
+    a subdirectory the scheduled-backup retention prune cannot reach."""
+    tasks = _role_tasks("upgrade-prepare")
+    names = [t["name"] for t in tasks]
+    assert names.index("preflight current cluster healthy") \
+        < names.index("preflight disk headroom on every node") \
+        < names.index("snapshot etcd before anything changes") \
+        < names.index("download pinned packages for target version (Debian family)")
+    snap = tasks[names.index("snapshot etcd before anything changes")]
+    assert "backup-etcd/tasks/snapshot.yml" in str(snap)
+    # the prune in backup-etcd globs /var/backups/etcd-*.db; the rollback
+    # point must live where that glob cannot match
+    assert "/var/backups/pre-upgrade/" in str(snap["vars"])
+    disk = tasks[names.index("preflight disk headroom on every node")]
+    assert "2097152" in str(disk)   # 2GiB in KB
+    assert "/var/lib/containerd" in str(disk)   # not just the root fs
+
+    shared = yaml.safe_load(open(os.path.join(
+        ROLES, "backup-etcd", "tasks", "snapshot.yml"), encoding="utf-8"))
+    shared_names = [t["name"] for t in shared]
+    assert shared_names.index("take etcd snapshot") \
+        < shared_names.index("verify snapshot integrity")
+    cmd = str(shared[shared_names.index("take etcd snapshot")])
+    assert "--cacert" in cmd and "--cert" in cmd and "--key" in cmd
+    # both consumers include the one copy
+    backup = open(os.path.join(ROLES, "backup-etcd", "tasks", "main.yml"),
+                  encoding="utf-8").read()
+    assert "snapshot.yml" in backup
+    assert "etcdctl snapshot save" not in backup   # no duplicated copy left
+
+
+def test_upgrade_verify_covers_distinct_failure_modes():
+    """Version-match alone is not 'upgraded': the apiserver may still run
+    the old image, coredns is the classic casualty, and crash-loops in
+    kube-system need a swept retry, not a point-in-time glance."""
+    tasks = _role_tasks("upgrade-verify")
+    names = [t["name"] for t in tasks]
+    for required in ("all nodes Ready",
+                     "verify node versions match target",
+                     "verify apiserver reports the target version",
+                     "verify control plane static pods healthy on every master",
+                     "verify cluster DNS rollout",
+                     "verify nothing in kube-system is crash-looping"):
+        assert required in names, required
+    sweep = tasks[names.index("verify nothing in kube-system is crash-looping")]
+    assert sweep["retries"] >= 3
+    assert "CrashLoopBackOff" in str(sweep)
+
+
+def test_reset_leaves_no_network_or_storage_residue():
+    """A half reset poisons the NEXT cluster: CNI interfaces, ipvs tables,
+    and rook's hostpath must all go; operator-owned firewall rules must
+    NOT (only kube/CNI chains are filtered out of the restore)."""
+    text = open(os.path.join(ROLES, "reset", "tasks", "main.yml"),
+                encoding="utf-8").read()
+    for iface in ("cni0", "flannel.1", "vxlan.calico", "kube-ipvs0"):
+        assert iface in text, iface
+    assert "ipvsadm --clear" in text
+    assert "grep -v KUBE-" in text        # surgical, not iptables -F
+    tasks = _role_tasks("reset")
+    clean = next(t for t in tasks if t["name"] == "clean residual state")
+    for path in ("/var/lib/cni", "/run/flannel", "/var/lib/calico",
+                 "/var/lib/rook"):
+        assert path in clean["loop"], path
