@@ -191,8 +191,14 @@ function objDialog(titleKey, fields, onSave, validate) {
   box.innerHTML = fields.map((f) => {
     if (f.type === "select") {
       return `<label>${esc(f.label)} <select id="obj-${f.key}">` +
-        f.options.map((o) => `<option value="${esc(o)}">${esc(o)}</option>`).join("") +
+        f.options.map((o) => `<option value="${esc(o)}"` +
+          `${String(o) === String(f.value ?? "") ? " selected" : ""}>` +
+          `${esc(o)}</option>`).join("") +
         `</select></label>`;
+    }
+    if (f.type === "checkbox") {
+      return `<label>${esc(f.label)} <input id="obj-${f.key}" ` +
+        `type="checkbox"${f.value ? " checked" : ""}></label>`;
     }
     if (f.type === "textarea") {
       return `<label>${esc(f.label)} <textarea id="obj-${f.key}" rows="8" ` +
@@ -206,7 +212,8 @@ function objDialog(titleKey, fields, onSave, validate) {
   const save = async () => {
     const out = {};
     for (const f of fields) {
-      let v = $("#obj-" + f.key).value;
+      let v = f.type === "checkbox"
+        ? $("#obj-" + f.key).checked : $("#obj-" + f.key).value;
       if (f.type === "number") v = parseInt(v || "0", 10);
       if (f.json) {
         try { v = v ? JSON.parse(v) : {}; }
@@ -516,15 +523,27 @@ async function openCluster(name) {
     }));
   if (!imported) $("#d-comp-install").addEventListener("click", () => {
     const comp = $("#d-comp-select").value;
-    const defaults = catalog[comp]?.vars || {};
-    objDialog("install", [
-      { key: "vars", label: `${comp} vars (JSON)`, json: true,
-        value: JSON.stringify(defaults) },
-    ], async (out) => {
+    // typed per-knob form from the catalog entry (KOLogic, tested):
+    // checkboxes for bool knobs, selects for enum knobs, required flags —
+    // the JSON-textarea era let users submit exactly what the service
+    // rejects
+    const fields = KOLogic.component_form_fields(catalog[comp] || {});
+    objDialog("install", fields.map((f) => ({
+      key: f.key,
+      label: f.key + (f.required ? " *" : ""),
+      // number knobs stay text inputs: component_vars_from_form owns ALL
+      // coercion (objDialog's own parseInt would turn a cleared field
+      // into 0 instead of falling back to the catalog default)
+      type: f.type === "bool" ? "checkbox"
+        : f.type === "select" ? "select" : "text",
+      options: f.type === "select" ? f.choices : undefined,
+      value: f.value,
+    })), async (out) => {
       await api("POST", `/api/v1/clusters/${name}/components`,
-                { component: comp, vars: out.vars });
+                { component: comp,
+                  vars: KOLogic.component_vars_from_form(fields, out).vars });
       openCluster(name);
-    });
+    }, (out) => KOLogic.component_vars_from_form(fields, out).errors);
   });
   detail.querySelectorAll("[data-un-comp]").forEach((b) =>
     b.addEventListener("click", async () => {

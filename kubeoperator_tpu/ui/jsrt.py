@@ -61,6 +61,34 @@ def round2(x):
     return math.floor(x * 100.0 + 0.5) / 100.0
 
 
+def keys(obj):
+    """Sorted key list of a string-keyed dict (JS: Object.keys().sort()).
+    Sorted on BOTH sides: JS object key order is insertion-dependent in
+    ways Python dicts aren't obliged to match, so deterministic order is
+    part of the contract. None -> []."""
+    if obj is None:
+        return []
+    return sorted(obj.keys())
+
+
+def kind(x):
+    """Portable type tag: 'none' | 'bool' | 'number' | 'string' | 'list' |
+    'dict'. The bool-before-number check matters on the Python side
+    (bool subclasses int) and both sides must agree so form logic can
+    branch on a catalog default's type identically in test and browser."""
+    if x is None:
+        return "none"
+    if x is True or x is False:
+        return "bool"
+    if isinstance(x, (int, float)):
+        return "number"
+    if isinstance(x, str):
+        return "string"
+    if isinstance(x, (list, tuple)):
+        return "list"
+    return "dict"
+
+
 def to_str(x):
     """str() twin: JS String(null) is 'null', so both sides map None->'None'."""
     if x is None:

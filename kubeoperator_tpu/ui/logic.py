@@ -599,6 +599,85 @@ def event_rollup(events, now_s, window_s):
             "top_warning_reasons": top}
 
 
+def component_form_fields(entry):
+    """Typed install-form fields from a components-catalog entry, mirroring
+    the server's validation rules so the form cannot submit what
+    ComponentService rejects: a bool default means checkbox (the service
+    rejects non-boolean values for those), an `allowed` list means select,
+    `required` means the field must be non-empty. A raw JSON textarea
+    cannot encode any of that — the knobs earned typed inputs."""
+    fields = []
+    vars = jsrt.get(entry, "vars", {})
+    allowed = jsrt.get(entry, "allowed", {})
+    required = jsrt.get(entry, "required", [])
+    for key in jsrt.keys(vars):
+        default = jsrt.get(vars, key, None)
+        k = jsrt.kind(default)
+        field = {"key": key, "value": default,
+                 "required": jsrt.contains(required, key)}
+        if k == "bool":
+            field["type"] = "bool"
+        elif jsrt.contains(allowed, key):
+            field["type"] = "select"
+            choices = []
+            for c in jsrt.get(allowed, key, []):
+                choices.append(c)
+            field["choices"] = choices
+        elif k == "number":
+            field["type"] = "number"
+        else:
+            field["type"] = "text"
+        fields.append(field)
+    return fields
+
+
+def component_vars_from_form(fields, raw):
+    """Coerce raw form output (strings from inputs, booleans from
+    checkboxes) back into the typed vars the service expects, and report
+    field errors the way the wizard does. Number fields parse strictly;
+    empty optional fields fall back to the catalog default; empty REQUIRED
+    fields are an error here, before any network round-trip."""
+    out = {}
+    errors = []
+    for f in fields:
+        key = f["key"]
+        value = jsrt.get(raw, key, None)
+        if f["type"] == "bool":
+            # checkbox: anything but literal true means unchecked (the
+            # transpiled subset has no `is`, and == True is portable)
+            out[key] = jsrt.kind(value) == "bool" and value == True  # noqa: E712
+            continue
+        s = "" if value is None else str(value).strip()
+        if s == "":
+            if f["required"]:
+                errors.append(key + " is required")
+            else:
+                out[key] = f["value"]
+            continue
+        if f["type"] == "number":
+            n = jsrt.parse_int(s)
+            if n is None:
+                errors.append(key + " must be an integer")
+            else:
+                out[key] = n
+        elif f["type"] == "select":
+            if not jsrt.contains(f["choices"], s) \
+                    and not jsrt.contains(f["choices"], jsrt.parse_int(s)):
+                shown = []
+                for c in f["choices"]:
+                    shown.append(jsrt.to_str(c))
+                errors.append(key + " must be one of " + ", ".join(shown))
+            else:
+                n = jsrt.parse_int(s)
+                if n is not None and jsrt.contains(f["choices"], n):
+                    out[key] = n
+                else:
+                    out[key] = s
+        else:
+            out[key] = s
+    return {"vars": out, "errors": errors}
+
+
 def i18n_next(lang):
     if lang == "zh":
         return "en"
@@ -644,6 +723,8 @@ PUBLIC = [
     cis_delta,
     cis_delta_from_scans,
     event_rollup,
+    component_form_fields,
+    component_vars_from_form,
     i18n_next,
     i18n_get,
 ]

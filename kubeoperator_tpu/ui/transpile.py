@@ -62,6 +62,18 @@ JS_PRELUDE = textwrap.dedent("""\
         if (x === false) return "false";
         return String(x);
       },
+      keys: function (o) {
+        if (o === null || o === undefined) return [];
+        return Object.keys(o).sort();
+      },
+      kind: function (x) {
+        if (x === null || x === undefined) return "none";
+        if (typeof x === "boolean") return "bool";
+        if (typeof x === "number") return "number";
+        if (typeof x === "string") return "string";
+        if (Array.isArray(x)) return "list";
+        return "dict";
+      },
     };
 """)
 
@@ -119,12 +131,13 @@ def _scalar_operand(node: ast.AST) -> bool:
         if isinstance(node.func, ast.Attribute):
             if node.func.attr in _SCALAR_METHODS:
                 return True
-            # every jsrt helper except get() returns a scalar by contract
-            # (jsrt.num exists precisely to mark an operand scalar here)
+            # every jsrt helper except get() and keys() returns a scalar
+            # by contract (jsrt.num exists precisely to mark an operand
+            # scalar here; keys() returns a list)
             if (
                 isinstance(node.func.value, ast.Name)
                 and node.func.value.id == "jsrt"
-                and node.func.attr != "get"
+                and node.func.attr not in ("get", "keys")
             ):
                 return True
     return False

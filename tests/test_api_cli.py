@@ -397,12 +397,14 @@ class TestConsoleSurface:
         # ad-hoc JS (VERDICT r2 #3): ranking, TPU panel, search, paging
         for fn in ("rank_clusters", "cluster_attention_score", "tpu_panel",
                    "filter_hosts", "paginate", "cis_delta_from_scans",
-                   "event_rollup"):
+                   "event_rollup", "component_form_fields",
+                   "component_vars_from_form"):
             assert f"KOLogic.{fn}(" in app_js, fn
         # and the served logic.js actually exports them
         logic_js = session.get(f"{base}/ui/logic.js").text
         for fn in ("rank_clusters", "tpu_panel", "paginate", "filter_hosts",
-                   "smoke_trend", "cis_delta_from_scans", "event_rollup"):
+                   "smoke_trend", "cis_delta_from_scans", "event_rollup",
+                   "component_form_fields", "component_vars_from_form"):
             assert f"function {fn}(" in logic_js, fn
         index = session.get(f"{base}/").text
         assert "host-filter" in index and "host-pager" in index

@@ -652,3 +652,105 @@ class TestCisDriftMultiset:
         # and shrinking occurrences shows up as resolved
         back = logic.cis_delta(prev, latest)
         assert len(back["resolved"]) == 1 and back["persisting"] == 1
+
+
+class TestJsrtKeysKind:
+    """Semantics pins for the runtime pair's newest helpers (the JS twins
+    are hand-written; these behaviors are the contract)."""
+
+    def test_keys_sorted_and_none_safe(self):
+        assert jsrt.keys({"b": 1, "a": 2}) == ["a", "b"]
+        assert jsrt.keys(None) == []
+
+    def test_kind_tags(self):
+        assert jsrt.kind(None) == "none"
+        assert jsrt.kind(True) == "bool"       # before number: bool is int
+        assert jsrt.kind(3) == "number"
+        assert jsrt.kind(3.5) == "number"
+        assert jsrt.kind("x") == "string"
+        assert jsrt.kind([1]) == "list"
+        assert jsrt.kind({"a": 1}) == "dict"
+
+
+def _catalog_entry_as_json(name):
+    """The entry as the /components-catalog API serves it (tuples become
+    JSON arrays) — the exact shape the browser form logic receives."""
+    import json as _json
+    from kubeoperator_tpu.models.component import COMPONENT_CATALOG
+    return _json.loads(_json.dumps(COMPONENT_CATALOG[name]))
+
+
+class TestComponentForm:
+    """The component install form mirrors ComponentService's validation:
+    bool defaults -> checkboxes (the service rejects non-boolean values),
+    `allowed` -> selects, `required` -> required flags. Parity grid over
+    the WHOLE catalog so a new knob cannot ship with a lying form."""
+
+    def test_field_types_mirror_service_rules_for_every_component(self):
+        from kubeoperator_tpu.models.component import COMPONENT_CATALOG
+        for name in COMPONENT_CATALOG:
+            entry = _catalog_entry_as_json(name)
+            fields = {f["key"]: f
+                      for f in logic.component_form_fields(entry)}
+            assert set(fields) == set(entry.get("vars", {})), name
+            for key, default in entry.get("vars", {}).items():
+                f = fields[key]
+                if isinstance(default, bool):
+                    assert f["type"] == "bool", (name, key)
+                elif key in entry.get("allowed", {}):
+                    assert f["type"] == "select", (name, key)
+                    assert f["choices"] == list(entry["allowed"][key])
+                assert f["required"] == (
+                    key in entry.get("required", [])), (name, key)
+
+    def test_default_round_trip_is_service_clean(self):
+        """Submitting the form untouched (raw = rendered defaults) must
+        coerce back to vars the service accepts for every component —
+        except required-empty fields, which must error CLIENT-side."""
+        from kubeoperator_tpu.models.component import COMPONENT_CATALOG
+        for name in COMPONENT_CATALOG:
+            entry = _catalog_entry_as_json(name)
+            fields = logic.component_form_fields(entry)
+            raw = {f["key"]: f["value"] for f in fields}
+            r = logic.component_vars_from_form(fields, raw)
+            required_empty = [k for k in entry.get("required", [])
+                              if not entry["vars"].get(k)]
+            if required_empty:
+                assert r["errors"], name
+            else:
+                assert r["errors"] == [], (name, r["errors"])
+                for key, default in entry.get("vars", {}).items():
+                    assert r["vars"][key] == default, (name, key)
+
+    def test_coercions_match_service_expectations(self):
+        entry = _catalog_entry_as_json("rook-ceph")
+        fields = logic.component_form_fields(entry)
+        raw = {f["key"]: f["value"] for f in fields}
+        # select with int choices coerces the input string back to int
+        raw["ceph_mon_count"] = "5"
+        r = logic.component_vars_from_form(fields, raw)
+        assert r["errors"] == [] and r["vars"]["ceph_mon_count"] == 5
+        # an out-of-enum value errors client-side (service parity)
+        raw["ceph_mon_count"] = "4"
+        assert any("ceph_mon_count" in e for e in
+                   logic.component_vars_from_form(fields, raw)["errors"])
+        # checkboxes produce real booleans — the service rejects strings
+        raw["ceph_mon_count"] = "3"
+        raw["ceph_sanitize_disks"] = True
+        out = logic.component_vars_from_form(fields, raw)["vars"]
+        assert out["ceph_sanitize_disks"] is True
+        # number fields parse strictly
+        raw["ceph_pool_replicas"] = "two"
+        assert any("ceph_pool_replicas" in e for e in
+                   logic.component_vars_from_form(fields, raw)["errors"])
+
+    def test_required_empty_field_errors_before_any_network_call(self):
+        entry = _catalog_entry_as_json("nfs-provisioner")
+        fields = logic.component_form_fields(entry)
+        raw = {f["key"]: f["value"] for f in fields}
+        r = logic.component_vars_from_form(fields, raw)
+        assert any("nfs_server is required" in e for e in r["errors"])
+        raw["nfs_server"] = "10.0.0.50"
+        r = logic.component_vars_from_form(fields, raw)
+        assert r["errors"] == []
+        assert r["vars"]["nfs_server"] == "10.0.0.50"
