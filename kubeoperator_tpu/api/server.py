@@ -456,6 +456,14 @@ class Handlers:
     async def component_catalog(self, request):
         return json_response(self.s.components.catalog())
 
+    async def providers_catalog(self, request):
+        """The declared provider-vars contract (provisioner/providers.py):
+        the console renders region/zone forms from this instead of a raw
+        JSON textarea, so typos and missing credentials die client-side."""
+        from kubeoperator_tpu.provisioner.providers import PROVIDER_VARS
+
+        return json_response(PROVIDER_VARS)
+
     async def list_components(self, request):
         comps = await run_sync(request, self.s.components.list,
                                request.match_info["name"])
@@ -866,6 +874,7 @@ def create_app(services: Services) -> web.Application:
     r.add_delete("/api/v1/hosts/{name}", admin_guard(delete_host))
     r.add_get("/api/v1/plans-tpu-catalog", h.tpu_catalog)
     r.add_get("/api/v1/components-catalog", h.component_catalog)
+    r.add_get("/api/v1/providers-catalog", h.providers_catalog)
 
     r.add_get("/api/v1/projects", h.list_projects)
     r.add_post("/api/v1/projects", h.create_project)

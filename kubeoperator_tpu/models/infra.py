@@ -61,14 +61,32 @@ class Region(Entity):
 
     name: str = ""
     provider: str = PlanProvider.GCP_TPU_VM.value
-    # provider connection/auth vars (e.g. gcp project id + SA key ref,
-    # vCenter URL + creds). Stored as an opaque vars blob like the reference.
+    # provider connection/auth vars, validated against the declared
+    # contract in provisioner/providers.py at service save time (the
+    # reference stores an opaque blob; opaque is how typos reach the cloud)
     vars: dict = field(default_factory=dict)
 
     def validate(self) -> None:
         if not self.name:
             raise ValidationError("region name required")
         PlanProvider(self.provider)
+
+    def to_public_dict(self) -> dict:
+        """Per-KEY secret masking inside vars: the read API serves region
+        rows to view-role users, and vcenter/openstack/fc passwords live
+        inside the vars blob, not in a dedicated field __secret_fields__
+        could cover."""
+        from kubeoperator_tpu.provisioner.providers import (
+            secret_region_keys,
+        )
+
+        d = super().to_public_dict()
+        masked = dict(d.get("vars", {}))
+        for key in secret_region_keys(self.provider):
+            if masked.get(key):
+                masked[key] = "********"
+        d["vars"] = masked
+        return d
 
 
 @dataclass

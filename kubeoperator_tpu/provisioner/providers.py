@@ -1,0 +1,135 @@
+"""The declared provider-vars contract for regions and zones.
+
+The reference stores region/zone vars as an opaque blob the provider
+templates consume (SURVEY.md §2.2); the failure mode of "opaque" is that a
+typo'd key or a missing credential renders into the terraform template's
+placeholder default and fails — or silently provisions against
+'my-project' — at APPLY time, on the cloud. This module makes the
+contract explicit so it can fail at CONFIGURE time instead, and gives the
+console enough structure to render typed forms:
+
+* every key a provider's template consumes, with required flags (the
+  fields whose template defaults are placeholder lies: credentials,
+  endpoints, project ids) and hints (the template's actual fallback);
+* secret keys (passwords) that must never leave the server through the
+  read API — Region.to_public_dict masks them per-key;
+* CI cross-checks (tests/test_provisioner.py) that this table and the
+  templates agree in BOTH directions, so neither can drift alone.
+"""
+
+from __future__ import annotations
+
+from kubeoperator_tpu.utils.errors import ValidationError
+
+
+def _f(key: str, required: bool = False, secret: bool = False,
+       hint: str = "") -> dict:
+    return {"key": key, "required": required, "secret": secret,
+            "hint": hint}
+
+
+# provider -> {"region": [field...], "zone": [field...]}; field keys map to
+# template vars as region_<key> / zone_<key> (provisioner/terraform.py)
+PROVIDER_VARS: dict[str, dict[str, list[dict]]] = {
+    "gcp_tpu_vm": {
+        "region": [
+            _f("project", required=True, hint="GCP project id"),
+            _f("name", required=True, hint="GCP region, e.g. us-central1"),
+        ],
+        "zone": [
+            _f("gcp_zone", required=True, hint="e.g. us-central1-a"),
+        ],
+    },
+    "vsphere": {
+        "region": [
+            _f("vcenter_host", required=True, hint="vcenter.example.com"),
+            _f("vcenter_user", required=True,
+               hint="administrator@vsphere.local"),
+            _f("vcenter_password", required=True, secret=True),
+            _f("datacenter", hint="Datacenter"),
+        ],
+        "zone": [
+            _f("datastore", hint="datastore1"),
+            _f("network", hint="VM Network"),
+            _f("resource_pool", hint="Resources"),
+            _f("vm_template", hint="ubuntu-2204-template"),
+            _f("gateway", hint="static-IP gateway (with ip_pool)"),
+            _f("netmask_prefix", hint="24"),
+            _f("dns", hint="nameserver list"),
+            _f("domain", hint="cluster.local"),
+        ],
+    },
+    "openstack": {
+        "region": [
+            _f("auth_url", required=True,
+               hint="http://keystone:5000/v3"),
+            _f("os_user", required=True),
+            _f("os_password", required=True, secret=True),
+            _f("os_tenant", hint="admin"),
+            _f("os_domain", hint="Default"),
+        ],
+        "zone": [
+            _f("image", hint="ubuntu-22.04"),
+            _f("network", hint="private"),
+            _f("key_pair", hint="ko-tpu"),
+        ],
+    },
+    "fusioncompute": {
+        "region": [
+            _f("fc_server", required=True,
+               hint="https://fusioncompute.local:7443"),
+            _f("fc_user", required=True),
+            _f("fc_password", required=True, secret=True),
+            _f("site", hint="site"),
+        ],
+        "zone": [
+            _f("cluster", hint="ManagementCluster"),
+            _f("datastore", hint="autoDS"),
+            _f("port_group", hint="managePortgroup"),
+            _f("vm_template", hint="ubuntu-2204-template"),
+            _f("gateway", hint="static-IP gateway (with ip_pool)"),
+            _f("netmask", hint="255.255.255.0"),
+        ],
+    },
+    # manual hosts: nothing to provision, nothing to configure
+    "bare_metal": {"region": [], "zone": []},
+}
+
+
+def _check(provider: str, scope: str, vars: dict) -> None:
+    spec = PROVIDER_VARS.get(provider)
+    if spec is None:
+        # Plan/Region validate the enum; unknown here means a new provider
+        # was added without declaring its contract — fail loudly
+        raise ValidationError(
+            f"provider {provider!r} has no declared vars contract"
+        )
+    fields = {f["key"]: f for f in spec[scope]}
+    for key in vars:
+        if key not in fields:
+            raise ValidationError(
+                f"{provider} {scope} var {key!r} is not consumed by the "
+                f"{provider} template (known: {sorted(fields) or 'none'})"
+            )
+    for key, f in fields.items():
+        if f["required"] and not vars.get(key):
+            raise ValidationError(
+                f"{provider} {scope} requires var {key!r} ({f['hint']})"
+                if f["hint"] else
+                f"{provider} {scope} requires var {key!r}"
+            )
+
+
+def validate_region_vars(provider: str, vars: dict) -> None:
+    """Reject unknown keys (typos reach terraform as silent placeholder
+    fallbacks otherwise) and missing required fields, at configure time."""
+    _check(provider, "region", vars)
+
+
+def validate_zone_vars(provider: str, vars: dict) -> None:
+    _check(provider, "zone", vars)
+
+
+def secret_region_keys(provider: str) -> frozenset[str]:
+    spec = PROVIDER_VARS.get(provider, {"region": []})
+    return frozenset(f["key"] for f in spec["region"] if f["secret"])

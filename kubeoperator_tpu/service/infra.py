@@ -77,6 +77,29 @@ class RegionService(_Crud):
     def repo(self):
         return self.repos.regions
 
+    def _pre_save(self, region: Region) -> None:
+        # the declared provider contract (provisioner/providers.py): a
+        # typo'd key or missing credential must fail HERE, not render into
+        # a terraform template's placeholder default at apply time
+        from kubeoperator_tpu.provisioner.providers import (
+            validate_region_vars,
+        )
+
+        validate_region_vars(region.provider, region.vars)
+
+    def update(self, region: Region):
+        # the read API masks secret vars per-key; a round-tripped mask
+        # means "unchanged", not a new password of literal asterisks
+        from kubeoperator_tpu.provisioner.providers import (
+            secret_region_keys,
+        )
+
+        stored = self.repo.get(region.id)
+        for key in secret_region_keys(region.provider):
+            if region.vars.get(key) == "********":
+                region.vars[key] = stored.vars.get(key, "")
+        return super().update(region)
+
     def delete(self, name: str) -> None:
         region = self.repo.get_by_name(name)
         zones = self.repos.zones.find(region_id=region.id)
@@ -102,7 +125,12 @@ class ZoneService(_Crud):
         return self.repos.zones
 
     def _pre_save(self, zone: Zone) -> None:
-        self.repos.regions.get(zone.region_id)  # referenced region must exist
+        region = self.repos.regions.get(zone.region_id)  # must exist
+        from kubeoperator_tpu.provisioner.providers import (
+            validate_zone_vars,
+        )
+
+        validate_zone_vars(region.provider, zone.vars)
 
     def list_for_region(self, region_name: str) -> list[Zone]:
         region = self.repos.regions.get_by_name(region_name)

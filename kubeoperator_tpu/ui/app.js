@@ -55,6 +55,7 @@ const I18N = {
     cis_resolved: "resolved", cis_persisting: "persisting",
     last_24h: "Last 24h", warnings: "warnings", normals: "normal",
     newest: "newest",
+    catalog_load_failed: "Could not load the provider catalog — try again.",
     kubeconfig: "Kubeconfig", details: "Details",
     scale_slices: "＋ Add slices",
     renew_certs: "Renew certs", rotate_key: "Rotate secrets key",
@@ -103,6 +104,7 @@ const I18N = {
     cis_resolved: "已修复", cis_persisting: "持续存在",
     last_24h: "最近24小时", warnings: "告警", normals: "正常",
     newest: "最新",
+    catalog_load_failed: "无法加载供应商目录，请重试。",
     kubeconfig: "Kubeconfig", details: "详情",
     scale_slices: "＋ 扩容切片",
     renew_certs: "轮换证书", rotate_key: "轮换加密密钥",
@@ -209,6 +211,9 @@ function objDialog(titleKey, fields, onSave, validate) {
       `placeholder="${esc(f.placeholder ?? "")}"></label>`;
   }).join("");
   $("#obj-error").textContent = "";
+  // re-invocation with the dialog already open (provider/region change
+  // re-renders the fields) must not re-showModal — that throws
+  if (!$("#obj-dialog").open) $("#obj-dialog").showModal();
   const save = async () => {
     const out = {};
     for (const f of fields) {
@@ -241,7 +246,6 @@ function objDialog(titleKey, fields, onSave, validate) {
   };
   $("#obj-save").onclick = save;
   $("#obj-cancel").onclick = () => $("#obj-dialog").close();
-  $("#obj-dialog").showModal();
 }
 
 /* ---------- clusters ---------- */
@@ -853,27 +857,77 @@ $("#new-plan-btn").addEventListener("click", async () => {
     await api("POST", "/api/v1/plans", body);
   }, (out) => KOLogic.plan_form_errors(out, catalog));
 });
-$("#new-region-btn").addEventListener("click", () => {
+// region/zone dialogs: typed per-field forms from the declared provider
+// contract (/providers-catalog + KOLogic.provider_form_fields, tested) —
+// switching the provider/region select re-renders the var fields for the
+// newly selected provider, preserving everything already typed. The
+// "var_" key prefix keeps provider var keys (gcp's region var is
+// literally `name`) from colliding with the entity's own dialog fields.
+function providerFields(spec, keepVars) {
+  return KOLogic.provider_form_fields(spec).map((f) => ({
+    key: "var_" + f.key, label: f.key + (f.required ? " *" : ""),
+    type: f.type, placeholder: f.hint,
+    value: (keepVars || {})[f.key] ?? "",
+  }));
+}
+function providerVarsOut(spec, out) {
+  const raw = {};
+  for (const f of spec) raw[f.key] = out["var_" + f.key];
+  return KOLogic.provider_vars_from_form(spec, raw);
+}
+function collectVarValues(spec) {
+  const vals = {};
+  for (const f of spec) {
+    const el = $("#obj-var_" + f.key);
+    if (el && el.value) vals[f.key] = el.value;
+  }
+  return vals;
+}
+function regionDialog(cat, provider, keepName, keepVars) {
+  const spec = (cat[provider] || { region: [] }).region;
   objDialog("new_region", [
-    { key: "name", label: t("name") },
+    { key: "name", label: t("name"), value: keepName || "" },
     { key: "provider", label: "Provider", type: "select",
-      options: ["gcp_tpu_vm", "vsphere", "openstack", "fusioncompute"] },
-    { key: "vars", label: "Vars (JSON)", json: true, placeholder: "{\"project\": \"...\"}" },
-  ], (out) => api("POST", "/api/v1/regions", out));
+      options: Object.keys(cat).filter((p) => p !== "bare_metal"),
+      value: provider },
+  ].concat(providerFields(spec, keepVars)), (out) =>
+    api("POST", "/api/v1/regions", {
+      name: out.name.trim(), provider: out.provider,
+      vars: providerVarsOut(spec, out).vars,
+    }), (out) => providerVarsOut(spec, out).errors);
+  $("#obj-provider").addEventListener("change", (e) =>
+    regionDialog(cat, e.target.value, $("#obj-name").value,
+                 collectVarValues(spec)));
+}
+$("#new-region-btn").addEventListener("click", async () => {
+  const cat = await api("GET", "/api/v1/providers-catalog").catch(() => null);
+  if (!cat) { alert(t("catalog_load_failed")); return; }
+  regionDialog(cat, "gcp_tpu_vm");
 });
-$("#new-zone-btn").addEventListener("click", async () => {
-  const regions = await api("GET", "/api/v1/regions").catch(() => []);
+function zoneDialog(cat, regions, regionName, keepName, keepVars) {
+  const region = regions.find((r) => r.name === regionName) || regions[0];
+  const provider = region ? region.provider : "gcp_tpu_vm";
+  const spec = (cat[provider] || { zone: [] }).zone;
   objDialog("new_zone", [
-    { key: "name", label: t("name") },
+    { key: "name", label: t("name"), value: keepName || "" },
     { key: "region", label: "Region", type: "select",
-      options: regions.map((r) => r.name) },
-    { key: "vars", label: "Vars (JSON)", json: true, placeholder: "{\"gcp_zone\": \"...\"}" },
-  ], async (out) => {
-    const region = regions.find((r) => r.name === out.region);
+      options: regions.map((r) => r.name),
+      value: region ? region.name : "" },
+  ].concat(providerFields(spec, keepVars)), async (out) => {
     await api("POST", "/api/v1/zones", {
-      name: out.name, region_id: region ? region.id : "", vars: out.vars,
+      name: out.name.trim(), region_id: region ? region.id : "",
+      vars: providerVarsOut(spec, out).vars,
     });
-  });
+  }, (out) => providerVarsOut(spec, out).errors);
+  $("#obj-region").addEventListener("change", (e) =>
+    zoneDialog(cat, regions, e.target.value, $("#obj-name").value,
+               collectVarValues(spec)));
+}
+$("#new-zone-btn").addEventListener("click", async () => {
+  const cat = await api("GET", "/api/v1/providers-catalog").catch(() => null);
+  if (!cat) { alert(t("catalog_load_failed")); return; }
+  const regions = await api("GET", "/api/v1/regions").catch(() => []);
+  zoneDialog(cat, regions, regions[0] ? regions[0].name : "");
 });
 $("#new-credential-btn").addEventListener("click", () => {
   objDialog("new_credential", [

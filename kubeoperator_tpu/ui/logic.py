@@ -678,6 +678,46 @@ def component_vars_from_form(fields, raw):
     return {"vars": out, "errors": errors}
 
 
+def provider_form_fields(spec_fields):
+    """Typed region/zone form fields from one provider's declared contract
+    (the /providers-catalog shape, provisioner/providers.py): secrets
+    render as password inputs, hints as placeholders, required flagged —
+    the form mirrors the server's configure-time validation."""
+    fields = []
+    for f in spec_fields:
+        field = {
+            "key": jsrt.get(f, "key", ""),
+            "required": jsrt.get(f, "required", False),
+            "secret": jsrt.get(f, "secret", False),
+            "hint": jsrt.get(f, "hint", ""),
+        }
+        if jsrt.get(f, "secret", False):
+            field["type"] = "password"
+        else:
+            field["type"] = "text"
+        fields.append(field)
+    return fields
+
+
+def provider_vars_from_form(spec_fields, raw):
+    """Collect vars from the typed form. Optional empties stay OUT of the
+    vars blob (the template's documented default applies, rather than
+    storing empty strings); required empties error here, before any
+    network call — the same rule validate_region_vars enforces."""
+    out = {}
+    errors = []
+    for f in spec_fields:
+        key = jsrt.get(f, "key", "")
+        value = jsrt.get(raw, key, None)
+        s = "" if value is None else str(value).strip()
+        if s == "":
+            if jsrt.get(f, "required", False):
+                errors.append(key + " is required")
+            continue
+        out[key] = s
+    return {"vars": out, "errors": errors}
+
+
 def i18n_next(lang):
     if lang == "zh":
         return "en"
@@ -725,6 +765,8 @@ PUBLIC = [
     event_rollup,
     component_form_fields,
     component_vars_from_form,
+    provider_form_fields,
+    provider_vars_from_form,
     i18n_next,
     i18n_get,
 ]

@@ -387,6 +387,13 @@ class TestConsoleSurface:
         assert "grafana" in catalog and "tpu-runtime" in catalog
         assert not any(t in name for name in catalog
                        for t in ("gpu", "nvidia"))
+        providers = session.get(f"{base}/api/v1/providers-catalog").json()
+        assert providers["vsphere"]["region"][0]["key"] == "vcenter_host"
+        # the contract is field METADATA only — no value slot to leak into
+        for spec in providers.values():
+            for scope_fields in spec.values():
+                for f in scope_fields:
+                    assert set(f) == {"key", "required", "secret", "hint"}
         # static console ships with the server (air-gapped, no build step)
         index = session.get(f"{base}/").text
         assert "data-i18n" in index

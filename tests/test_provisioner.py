@@ -223,3 +223,59 @@ class TestOutputsToHosts:
                    "tpu_endpoints": {"0": ["10.1.0.1", "10.1.0.2"]}}  # 2 of 4
         with pytest.raises(ProvisionerError):
             TerraformProvisioner.hosts_from_outputs(outputs, plan, "x")
+
+
+class TestProviderVarsContract:
+    """provisioner/providers.py is only trustworthy if it and the
+    templates cannot drift apart — checked in BOTH directions."""
+
+    def test_spec_and_templates_agree_both_directions(self):
+        import os
+        import re
+
+        from kubeoperator_tpu.provisioner.providers import PROVIDER_VARS
+
+        base = os.path.join("kubeoperator_tpu", "provisioner", "templates")
+        for provider, spec in PROVIDER_VARS.items():
+            if provider == "bare_metal":
+                continue
+            tpl = open(os.path.join(base, provider, "main.tf.j2"),
+                       encoding="utf-8").read()
+            declared = {f"region_{f['key']}" for f in spec["region"]} \
+                | {f"zone_{f['key']}" for f in spec["zone"]}
+            referenced = set(re.findall(r"\b(?:region|zone)_[a-z_]+\b", tpl))
+            # necessity: a template var nobody can configure is a landmine
+            assert referenced <= declared, (
+                provider, "template uses undeclared", referenced - declared)
+            # sufficiency: a declared field no template reads is a lying form
+            assert declared <= referenced, (
+                provider, "spec declares unused", declared - referenced)
+
+    def test_configure_time_rejection(self):
+        from kubeoperator_tpu.provisioner.providers import (
+            validate_region_vars,
+            validate_zone_vars,
+        )
+        from kubeoperator_tpu.utils.errors import ValidationError
+        # typo'd key: would silently hit the template's placeholder default
+        with pytest.raises(ValidationError, match="not consumed"):
+            validate_region_vars("gcp_tpu_vm", {"projcet": "p", "name": "r"})
+        # missing credential: would provision against 'my-project'
+        with pytest.raises(ValidationError, match="requires var"):
+            validate_region_vars("gcp_tpu_vm", {"name": "us-central1"})
+        with pytest.raises(ValidationError, match="requires var"):
+            validate_region_vars("vsphere", {"vcenter_host": "vc"})
+        validate_zone_vars("vsphere", {"datastore": "ds1"})   # optional ok
+        with pytest.raises(ValidationError, match="not consumed"):
+            validate_zone_vars("gcp_tpu_vm", {"zone": "us-central1-a"})
+
+    def test_secret_vars_masked_in_public_dict_but_stored_intact(self):
+        region = Region(name="dc", provider="vsphere",
+                        vars={"vcenter_host": "vc", "vcenter_user": "u",
+                              "vcenter_password": "hunter2"})
+        public = region.to_public_dict()
+        assert public["vars"]["vcenter_password"] == "********"
+        assert public["vars"]["vcenter_host"] == "vc"
+        # the entity itself keeps the real value (terraform needs it)
+        assert region.vars["vcenter_password"] == "hunter2"
+        assert region.to_dict()["vars"]["vcenter_password"] == "hunter2"

@@ -165,7 +165,9 @@ class TestPlanTpuCreate:
         addresses, and a second cluster in the same zone never reuses them
         (the reference's zone IP-pool mechanism, SURVEY §2.2)."""
         region = svc.regions.create(Region(
-            name="dc1", provider="vsphere", vars={"vcenter_host": "vc.local"},
+            name="dc1", provider="vsphere",
+            vars={"vcenter_host": "vc.local", "vcenter_user": "admin",
+                  "vcenter_password": "pw"},
         ))
         zone = svc.zones.create(Zone(
             name="pool-zone", region_id=region.id,
@@ -200,7 +202,9 @@ class TestPlanTpuCreate:
         import time as _time
 
         region = svc.regions.create(Region(
-            name="dc2", provider="vsphere", vars={},
+            name="dc2", provider="vsphere",
+            vars={"vcenter_host": "vc.local", "vcenter_user": "admin",
+                  "vcenter_password": "pw"},
         ))
         zone = svc.zones.create(Zone(
             name="race-zone", region_id=region.id,

@@ -754,3 +754,53 @@ class TestComponentForm:
         r = logic.component_vars_from_form(fields, raw)
         assert r["errors"] == []
         assert r["vars"]["nfs_server"] == "10.0.0.50"
+
+
+class TestProviderForm:
+    """Region/zone forms mirror the declared provider contract
+    (provisioner/providers.py) — the same grid discipline as the plan
+    wizard: the client errors exactly when the server would reject."""
+
+    def test_fields_and_vars_parity_with_server(self):
+        import json as _json
+
+        from kubeoperator_tpu.provisioner.providers import (
+            PROVIDER_VARS,
+            validate_region_vars,
+            validate_zone_vars,
+        )
+        cat = _json.loads(_json.dumps(PROVIDER_VARS))  # the API's shape
+        for provider, spec in cat.items():
+            for scope, validate in (("region", validate_region_vars),
+                                    ("zone", validate_zone_vars)):
+                fields = logic.provider_form_fields(spec[scope])
+                for f, s in zip(fields, spec[scope]):
+                    assert f["key"] == s["key"]
+                    assert f["type"] == (
+                        "password" if s["secret"] else "text")
+                    assert f["required"] == s["required"]
+                # a fully-filled form validates server-side, verbatim
+                raw = {f["key"]: "v1" for f in fields}
+                r = logic.provider_vars_from_form(spec[scope], raw)
+                assert r["errors"] == []
+                validate(provider, r["vars"])
+                # an empty form: client errors exactly when the server
+                # rejects (providers with no required fields pass both)
+                r_empty = logic.provider_vars_from_form(spec[scope], {})
+                try:
+                    validate(provider, r_empty["vars"])
+                    server_ok = True
+                except Exception:
+                    server_ok = False
+                assert (r_empty["errors"] == []) == server_ok, (
+                    provider, scope, r_empty["errors"])
+
+    def test_optional_empties_stay_out_of_vars(self):
+        """An empty optional field must NOT become an empty-string var —
+        the template's documented default applies instead."""
+        from kubeoperator_tpu.provisioner.providers import PROVIDER_VARS
+        spec = PROVIDER_VARS["vsphere"]["zone"]
+        r = logic.provider_vars_from_form(
+            [dict(f) for f in spec], {"datastore": "ds1", "network": "  "})
+        assert r["vars"] == {"datastore": "ds1"}
+        assert r["errors"] == []
