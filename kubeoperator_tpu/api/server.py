@@ -456,6 +456,21 @@ class Handlers:
     async def component_catalog(self, request):
         return json_response(self.s.components.catalog())
 
+    async def get_notify_settings(self, request):
+        return json_response(
+            await run_sync(request, self.s.notify_settings.get_public))
+
+    async def update_notify_settings(self, request):
+        body = await request.json()
+        return json_response(
+            await run_sync(request, self.s.notify_settings.update, body))
+
+    async def test_notify_channel(self, request):
+        body = await request.json()
+        return json_response(await run_sync(
+            request, self.s.notify_settings.test,
+            body.get("channel", ""), request["user"].id))
+
     async def providers_catalog(self, request):
         """The declared provider-vars contract (provisioner/providers.py):
         the console renders region/zone forms from this instead of a raw
@@ -875,6 +890,11 @@ def create_app(services: Services) -> web.Application:
     r.add_get("/api/v1/plans-tpu-catalog", h.tpu_catalog)
     r.add_get("/api/v1/components-catalog", h.component_catalog)
     r.add_get("/api/v1/providers-catalog", h.providers_catalog)
+    r.add_get("/api/v1/settings/notify", admin_guard(h.get_notify_settings))
+    r.add_put("/api/v1/settings/notify",
+              admin_guard(h.update_notify_settings))
+    r.add_post("/api/v1/settings/notify/test",
+               admin_guard(h.test_notify_channel))
 
     r.add_get("/api/v1/projects", h.list_projects)
     r.add_post("/api/v1/projects", h.create_project)

@@ -37,6 +37,18 @@ class Message(Entity):
 
 
 @dataclass
+class Setting(Entity):
+    """One named system-settings document (e.g. 'notify') — the
+    runtime-editable configuration tier above app.yaml (SURVEY.md §5.6;
+    the reference keeps system settings in a DB table behind an admin
+    UI). Secrets inside vars are masked per-key by the owning service's
+    public view, not here — which keys are secret is domain knowledge."""
+
+    name: str = ""
+    vars: dict = field(default_factory=dict)
+
+
+@dataclass
 class TaskLogChunk(Entity):
     """One streamed chunk of executor output for a (cluster, task) pair —
     the persistence behind the UI live log viewer and `koctl logs`."""

@@ -1,0 +1,9 @@
+-- Named system-settings documents (message-center channels first) — the
+-- runtime-editable tier above app.yaml (SURVEY.md §5.6 config tiers).
+CREATE TABLE IF NOT EXISTS settings (
+    id TEXT PRIMARY KEY,
+    name TEXT NOT NULL UNIQUE,
+    data TEXT NOT NULL,
+    created_at REAL NOT NULL,
+    updated_at REAL NOT NULL
+);

@@ -26,6 +26,7 @@ from kubeoperator_tpu.models import (
     Project,
     ProjectMember,
     Region,
+    Setting,
     TaskLogChunk,
     User,
     Zone,
@@ -275,6 +276,10 @@ class CisScanRepo(EntityRepo[CisScan]):
     table, entity, columns = "cis_scans", CisScan, ("cluster_id", "status")
 
 
+class SettingRepo(EntityRepo[Setting]):
+    table, entity, columns = "settings", Setting, ("name",)
+
+
 class Repositories:
     """One bundle handed to every service (the reference injects repos into
     services the same way, SURVEY.md §2.1 row 1b)."""
@@ -299,3 +304,4 @@ class Repositories:
         self.task_logs = TaskLogChunkRepo(db)
         self.components = ComponentRepo(db)
         self.cis_scans = CisScanRepo(db)
+        self.settings = SettingRepo(db)

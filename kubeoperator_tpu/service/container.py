@@ -49,14 +49,18 @@ class Services:
         # address (scoped to the executor, not process-global)
         executor.platform_vars = platform_vars_from_config(config)
 
-        from kubeoperator_tpu.service.notify import configure_senders
+        from kubeoperator_tpu.service.notify import NotifySettingsService
 
         self.events = EventService(repos)
         self.messages = MessageService(repos)
         # wired here (not in run_server) so every entry point — server, CLI
         # local stack, tests — gets event→notification fan-out exactly once
         self.messages.attach_to(self.events)
-        configure_senders(self.messages, repos, config)
+        # channel wiring: stored 'notify' settings row over app.yaml
+        # bootstrap values; runtime updates re-apply through this service
+        self.notify_settings = NotifySettingsService(repos, self.messages,
+                                                     config)
+        self.notify_settings.apply()
         self.credentials = CredentialService(repos)
         self.regions = RegionService(repos)
         self.zones = ZoneService(repos)

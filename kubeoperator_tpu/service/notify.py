@@ -81,20 +81,195 @@ class WebhookSender:
         log.info("webhook delivered %s", message.title)
 
 
-def configure_senders(messages, repos, config: Config) -> None:
-    """Attach the channels the operator enabled in config."""
-    if config.get("notify.smtp.enabled", False):
-        messages.senders["smtp"] = SmtpSender(
-            repos,
-            host=config.get("notify.smtp.host", "localhost"),
-            port=int(config.get("notify.smtp.port", 25)),
-            username=config.get("notify.smtp.username", ""),
-            password=config.get("notify.smtp.password", ""),
-            sender=config.get("notify.smtp.from", "ko-tpu@localhost"),
-            use_tls=bool(config.get("notify.smtp.tls", False)),
-        )
-    if config.get("notify.webhook.url", ""):
-        messages.senders["webhook"] = WebhookSender(
-            config.get("notify.webhook.url"),
-            headers=config.get("notify.webhook.headers", {}) or {},
-        )
+# ---------------------------------------------------------------------------
+# runtime-editable channel settings (SURVEY.md §5.6: the reference keeps
+# message-center settings in a DB table behind an admin UI, not app.yaml)
+# ---------------------------------------------------------------------------
+
+NOTIFY_DEFAULTS = {
+    "smtp": {"enabled": False, "host": "localhost", "port": 25,
+             "username": "", "password": "", "sender": "ko-tpu@localhost",
+             "use_tls": False},
+    # headers: extra HTTP headers (auth tokens for chat-ops endpoints) —
+    # settable via the API; header VALUES are masked on read
+    "webhook": {"enabled": False, "url": "", "headers": {}},
+}
+# (channel, key) pairs whose values the read API must mask — same
+# discipline as the provider-vars contract's secret keys. webhook.headers
+# is masked per-header-value (Authorization tokens live there).
+NOTIFY_SECRET_KEYS = (("smtp", "password"),)
+
+_MASK = "********"
+
+
+class NotifySettingsService:
+    """Get/update/test the message-center channels at runtime.
+
+    Storage model: the 'notify' settings row holds ONLY the operator's
+    explicit overrides; reads merge defaults <- app.yaml <- overrides.
+    Persisting the merged document instead would freeze every app.yaml
+    value (including its SMTP password) into the DB at first save, and a
+    later config rotation would silently lose to the stale copy. Every
+    update re-wires MessageService.senders immediately, and `test` pushes
+    a real probe through the chosen sender so a dead relay is discovered
+    at configure time, not at the next 2am Warning."""
+
+    def __init__(self, repos, messages, config: Config):
+        self.repos = repos
+        self.messages = messages
+        self.config = config
+
+    # ---- settings document ----
+    def _stored_overrides(self) -> dict:
+        from kubeoperator_tpu.utils.errors import NotFoundError
+
+        try:
+            return self.repos.settings.get_by_name("notify").vars
+        except NotFoundError:
+            # ONLY not-found means "no overrides yet" — a sick DB must
+            # surface, not silently wire channels from defaults alone
+            return {}
+
+    def effective(self) -> dict:
+        out = {ch: dict(defaults) for ch, defaults in NOTIFY_DEFAULTS.items()}
+        # bootstrap tier: app.yaml (the historical config keys), so an
+        # existing deployment keeps working untouched
+        out["smtp"].update({
+            "enabled": bool(self.config.get("notify.smtp.enabled", False)),
+            "host": self.config.get("notify.smtp.host", "localhost"),
+            "port": int(self.config.get("notify.smtp.port", 25)),
+            "username": self.config.get("notify.smtp.username", ""),
+            "password": self.config.get("notify.smtp.password", ""),
+            "sender": self.config.get("notify.smtp.from", "ko-tpu@localhost"),
+            "use_tls": bool(self.config.get("notify.smtp.tls", False)),
+        })
+        url = self.config.get("notify.webhook.url", "")
+        if url:
+            out["webhook"].update({
+                "enabled": True, "url": url,
+                "headers": self.config.get("notify.webhook.headers", {})
+                or {},
+            })
+        # runtime tier: the operator's explicit overrides win
+        for channel, values in self._stored_overrides().items():
+            if channel in out and isinstance(values, dict):
+                out[channel].update(values)
+        return out
+
+    def get_public(self) -> dict:
+        doc = self.effective()
+        for channel, key in NOTIFY_SECRET_KEYS:
+            if doc.get(channel, {}).get(key):
+                doc[channel][key] = _MASK
+        doc["webhook"]["headers"] = {
+            name: _MASK for name in doc["webhook"].get("headers", {})
+        }
+        return doc
+
+    def update(self, body: dict) -> dict:
+        from kubeoperator_tpu.models import Setting
+        from kubeoperator_tpu.utils.errors import NotFoundError, ValidationError
+
+        stored = {ch: dict(v) for ch, v in self._stored_overrides().items()}
+        for channel, values in body.items():
+            if channel not in NOTIFY_DEFAULTS:
+                raise ValidationError(f"unknown notify channel {channel!r}")
+            if not isinstance(values, dict):
+                raise ValidationError(f"{channel} settings must be an object")
+            overrides = stored.setdefault(channel, {})
+            for key, value in values.items():
+                if key not in NOTIFY_DEFAULTS[channel]:
+                    raise ValidationError(
+                        f"unknown {channel} setting {key!r}")
+                default = NOTIFY_DEFAULTS[channel][key]
+                if isinstance(default, bool) and not isinstance(value, bool):
+                    raise ValidationError(
+                        f"{channel}.{key} must be a boolean, got {value!r}")
+                if isinstance(default, dict) and not isinstance(value, dict):
+                    raise ValidationError(
+                        f"{channel}.{key} must be an object, got {value!r}")
+                # a round-tripped mask means "unchanged": keep the stored
+                # override if one exists, else DROP the key so app.yaml
+                # keeps supplying it (never copy config secrets into the DB)
+                if (channel, key) in NOTIFY_SECRET_KEYS and value == _MASK:
+                    continue
+                if key == "headers" and isinstance(value, dict):
+                    value = {
+                        name: (overrides.get("headers", {}).get(name, "")
+                               if v == _MASK else str(v))
+                        for name, v in value.items()
+                    }
+                overrides[key] = value
+
+        # validate the EFFECTIVE result of applying these overrides
+        merged = {ch: dict(d) for ch, d in NOTIFY_DEFAULTS.items()}
+        for ch in merged:
+            merged[ch].update(self.effective()[ch])
+            merged[ch].update(stored.get(ch, {}))
+        port = merged["smtp"].get("port")
+        if not isinstance(port, int) or not 1 <= port <= 65535:
+            raise ValidationError(f"smtp.port must be 1-65535, got {port!r}")
+        if merged["webhook"]["enabled"] and not str(
+                merged["webhook"]["url"]).startswith(
+                ("http://", "https://")):
+            raise ValidationError("webhook.url must be http(s)://...")
+
+        try:
+            row = self.repos.settings.get_by_name("notify")
+        except NotFoundError:
+            row = Setting(name="notify")
+        row.vars = stored
+        self.repos.settings.save(row)
+        self.apply()
+        return self.get_public()
+
+    # ---- live wiring ----
+    def apply(self) -> None:
+        """The ONE channel-wiring path (boot + every runtime update)."""
+        doc = self.effective()
+        self.messages.senders.pop("smtp", None)
+        self.messages.senders.pop("webhook", None)
+        if doc["smtp"]["enabled"]:
+            self.messages.senders["smtp"] = SmtpSender(
+                self.repos,
+                host=doc["smtp"]["host"], port=int(doc["smtp"]["port"]),
+                username=doc["smtp"]["username"],
+                password=doc["smtp"]["password"],
+                sender=doc["smtp"]["sender"],
+                use_tls=bool(doc["smtp"]["use_tls"]),
+            )
+        if doc["webhook"]["enabled"] and doc["webhook"]["url"]:
+            self.messages.senders["webhook"] = WebhookSender(
+                doc["webhook"]["url"],
+                headers=doc["webhook"].get("headers", {}) or {},
+            )
+
+    def test(self, channel: str, user_id: str) -> dict:
+        """Push a real probe through one sender NOW. Errors come back as
+        data (not exceptions): a failed relay is the expected case this
+        exists to surface."""
+        from kubeoperator_tpu.utils.errors import ValidationError
+
+        if channel not in NOTIFY_DEFAULTS:
+            raise ValidationError(f"unknown notify channel {channel!r}")
+        sender = self.messages.senders.get(channel)
+        if sender is None:
+            return {"ok": False,
+                    "error": f"{channel} channel is not enabled"}
+        if channel == "smtp":
+            # SmtpSender silently no-ops for address-less users — correct
+            # for the event fan-out, but a TEST that no-ops would report a
+            # dead relay as healthy
+            user = self.repos.users.get(user_id)
+            if not getattr(user, "email", ""):
+                return {"ok": False,
+                        "error": "your account has no email address; "
+                                 "set one to receive mail"}
+        probe = Message(user_id=user_id, title="Test notification",
+                        content="ko-tpu message-center connectivity test",
+                        level="info")
+        try:
+            sender(probe)
+        except Exception as e:
+            return {"ok": False, "error": f"{type(e).__name__}: {e}"}
+        return {"ok": True}

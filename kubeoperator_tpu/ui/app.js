@@ -55,7 +55,9 @@ const I18N = {
     cis_resolved: "resolved", cis_persisting: "persisting",
     last_24h: "Last 24h", warnings: "warnings", normals: "normal",
     newest: "newest",
-    catalog_load_failed: "Could not load the provider catalog — try again.",
+    catalog_load_failed: "Could not load — try again.",
+    notify_settings: "Message center", notify_edit: "Configure channels",
+    enabled: "enabled",
     kubeconfig: "Kubeconfig", details: "Details",
     scale_slices: "＋ Add slices",
     renew_certs: "Renew certs", rotate_key: "Rotate secrets key",
@@ -104,7 +106,9 @@ const I18N = {
     cis_resolved: "已修复", cis_persisting: "持续存在",
     last_24h: "最近24小时", warnings: "告警", normals: "正常",
     newest: "最新",
-    catalog_load_failed: "无法加载供应商目录，请重试。",
+    catalog_load_failed: "加载失败，请重试。",
+    notify_settings: "消息中心", notify_edit: "配置通知渠道",
+    enabled: "启用",
     kubeconfig: "Kubeconfig", details: "详情",
     scale_slices: "＋ 扩容切片",
     renew_certs: "轮换证书", rotate_key: "轮换加密密钥",
@@ -965,6 +969,44 @@ $("#ldap-test-btn").addEventListener("click", async () => {
   const r = await api("POST", "/api/v1/ldap/test").catch((e) => ({ error: e.message }));
   $("#ldap-out").textContent = r.error || (r.ok ? t("ldap_ok") : r.message || JSON.stringify(r));
 });
+// message-center channels: typed settings dialog (GET masks the password;
+// sending the mask back means "unchanged" server-side) + live test-sends
+$("#notify-edit-btn").addEventListener("click", async () => {
+  const s = await api("GET", "/api/v1/settings/notify").catch(() => null);
+  if (!s) { alert(t("catalog_load_failed")); return; }
+  objDialog("notify_edit", [
+    { key: "smtp_enabled", label: "SMTP " + t("enabled"), type: "checkbox",
+      value: s.smtp.enabled },
+    { key: "smtp_host", label: "SMTP host", value: s.smtp.host },
+    { key: "smtp_port", label: "SMTP port", value: s.smtp.port },
+    { key: "smtp_username", label: "SMTP user", value: s.smtp.username },
+    { key: "smtp_password", label: "SMTP password", type: "password",
+      value: s.smtp.password },
+    { key: "smtp_sender", label: "From", value: s.smtp.sender },
+    { key: "smtp_use_tls", label: "STARTTLS", type: "checkbox",
+      value: s.smtp.use_tls },
+    { key: "webhook_enabled", label: "Webhook " + t("enabled"),
+      type: "checkbox", value: s.webhook.enabled },
+    { key: "webhook_url", label: "Webhook URL", value: s.webhook.url,
+      placeholder: "https://chat.example.com/hook" },
+  ], (out) => api("PUT", "/api/v1/settings/notify", {
+    smtp: {
+      enabled: out.smtp_enabled, host: out.smtp_host.trim(),
+      port: parseInt(out.smtp_port, 10) || 0,
+      username: out.smtp_username, password: out.smtp_password,
+      sender: out.smtp_sender, use_tls: out.smtp_use_tls,
+    },
+    webhook: { enabled: out.webhook_enabled, url: out.webhook_url.trim() },
+  }));
+});
+for (const ch of ["smtp", "webhook"]) {
+  $(`#notify-test-${ch}`).addEventListener("click", async () => {
+    $("#notify-out").textContent = "…";
+    const r = await api("POST", "/api/v1/settings/notify/test",
+                        { channel: ch }).catch((e) => ({ ok: false, error: e.message }));
+    $("#notify-out").textContent = r.ok ? `${ch} ✓` : `${ch}: ${r.error}`;
+  });
+}
 $("#ldap-sync-btn").addEventListener("click", async () => {
   const r = await api("POST", "/api/v1/ldap/sync").catch((e) => ({ error: e.message }));
   $("#ldap-out").textContent = r.error ||

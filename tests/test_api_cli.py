@@ -463,3 +463,44 @@ class TestGlobalEvents:
         mal.headers["Authorization"] = f"Bearer {token}"
         assert mal.get(f"{base}/api/v1/events").json() == {
             "events": [], "total": 0}
+
+
+class TestNotifySettingsApi:
+    def test_admin_guarded_masked_and_updatable(self, client):
+        base, http, services = client
+        s = http.get(f"{base}/api/v1/settings/notify").json()
+        assert s["smtp"]["enabled"] is False
+        r = http.put(f"{base}/api/v1/settings/notify", json={
+            "smtp": {"enabled": True, "host": "mail.local",
+                     "password": "hunter2"}})
+        assert r.status_code == 200
+        assert r.json()["smtp"]["password"] == "********"   # masked on read
+        # live rewire happened
+        assert "smtp" in services.messages.senders
+        # test endpoint returns failure as data — first for the missing
+        # email (a silent no-op must not read as a healthy relay)...
+        t = http.post(f"{base}/api/v1/settings/notify/test",
+                      json={"channel": "smtp"}).json()
+        assert t["ok"] is False and "email" in t["error"]
+        # ...then for the dead relay itself once an address exists
+        admin = services.repos.users.get_by_name("root")
+        admin.email = "admin@example.org"
+        services.repos.users.save(admin)
+        t = http.post(f"{base}/api/v1/settings/notify/test",
+                      json={"channel": "smtp"}).json()
+        assert t["ok"] is False and "email" not in t["error"]
+        # garbage is a 400
+        assert http.put(f"{base}/api/v1/settings/notify", json={
+            "smtp": {"port": "25"}}).status_code == 400
+
+        # non-admin: 403 on every settings route
+        import requests as _rq
+        services.users.create("norm", password="password1")
+        norm = _rq.Session()
+        token = norm.post(f"{base}/api/v1/auth/login", json={
+            "username": "norm", "password": "password1"}).json()["token"]
+        norm.headers["Authorization"] = f"Bearer {token}"
+        assert norm.get(
+            f"{base}/api/v1/settings/notify").status_code == 403
+        assert norm.put(f"{base}/api/v1/settings/notify",
+                        json={}).status_code == 403

@@ -13,9 +13,9 @@ from kubeoperator_tpu.models import Message, User
 from kubeoperator_tpu.repository import Database, Repositories
 from kubeoperator_tpu.service.event import EventService, MessageService
 from kubeoperator_tpu.service.notify import (
+    NotifySettingsService,
     SmtpSender,
     WebhookSender,
-    configure_senders,
 )
 from kubeoperator_tpu.utils.config import load_config
 
@@ -132,15 +132,21 @@ class TestWebhook:
 
 class TestWiring:
     def test_configure_from_config(self, repos):
+        """app.yaml is the bootstrap tier: NotifySettingsService.apply()
+        (the ONE wiring path, boot + runtime) builds senders from it when
+        no overrides are stored — including webhook auth headers."""
         config = load_config(path="/nonexistent", env={}, overrides={
             "notify": {
                 "smtp": {"enabled": True, "host": "mail.local"},
-                "webhook": {"url": "http://hooks.local/x"},
+                "webhook": {"url": "http://hooks.local/x",
+                            "headers": {"Authorization": "Bearer tok"}},
             },
         })
         messages = MessageService(repos)
-        configure_senders(messages, repos, config)
+        NotifySettingsService(repos, messages, config).apply()
         assert set(messages.senders) == {"smtp", "webhook"}
+        assert messages.senders["webhook"].headers["Authorization"] == \
+            "Bearer tok" 
 
     def test_broken_sender_does_not_block_notify(self, repos):
         user = repos.users.save(User(name="admin2", is_admin=True))
@@ -155,3 +161,138 @@ class TestWiring:
         events.emit("c1", "Warning", "HealthDegraded", "node lost")
         inbox = messages.inbox(user.id)
         assert len(inbox) == 1  # in-app copy delivered despite sender crash
+
+
+class TestNotifySettings:
+    """Runtime channel settings (SURVEY §5.6): stored row over app.yaml,
+    live sender rewiring, per-key secret masking, and probe sends."""
+
+    def _svc(self, repos, overrides=None):
+        from kubeoperator_tpu.service.event import EventService, MessageService
+        config = load_config(path="/nonexistent", env={},
+                             overrides=overrides or {})
+        messages = MessageService(repos)
+        messages.attach_to(EventService(repos))
+        return NotifySettingsService(repos, messages, config), messages
+
+    def test_update_rewires_senders_and_delivers(self, repos):
+        svc, messages = self._svc(repos)
+        assert messages.senders == {}          # nothing enabled at boot
+        server = FakeSmtpServer()
+        try:
+            user = repos.users.save(User(name="adm", email="a@x.org",
+                                         is_admin=True))
+            svc.update({"smtp": {"enabled": True, "host": "127.0.0.1",
+                                 "port": server.port}})
+            assert "smtp" in messages.senders
+            # the probe flows through the REAL sender to the fake relay
+            result = svc.test("smtp", user.id)
+            assert result["ok"] is True, result
+            deadline = threading.Event()
+            deadline.wait(0.2)
+            assert any(b"Test notification" in m for m in server.messages)
+        finally:
+            server.close()
+        # disabling removes the sender
+        svc.update({"smtp": {"enabled": False}})
+        assert "smtp" not in messages.senders
+
+    def test_secret_masked_on_read_and_mask_roundtrip(self, repos):
+        svc, _ = self._svc(repos)
+        svc.update({"smtp": {"enabled": True, "password": "hunter2"}})
+        public = svc.get_public()
+        assert public["smtp"]["password"] == "********"
+        # a round-tripped mask means "unchanged"
+        svc.update({"smtp": {"password": "********", "host": "mail.x"}})
+        assert svc.effective()["smtp"]["password"] == "hunter2"
+        assert svc.effective()["smtp"]["host"] == "mail.x"
+        # a real new value replaces it
+        svc.update({"smtp": {"password": "newpw"}})
+        assert svc.effective()["smtp"]["password"] == "newpw"
+
+    def test_validation_rejects_garbage_at_configure_time(self, repos):
+        from kubeoperator_tpu.utils.errors import ValidationError
+        svc, _ = self._svc(repos)
+        with pytest.raises(ValidationError, match="unknown notify channel"):
+            svc.update({"pager": {"enabled": True}})
+        with pytest.raises(ValidationError, match="unknown smtp setting"):
+            svc.update({"smtp": {"hots": "x"}})
+        with pytest.raises(ValidationError, match="must be a boolean"):
+            svc.update({"smtp": {"enabled": "yes"}})
+        with pytest.raises(ValidationError, match="smtp.port"):
+            svc.update({"smtp": {"port": 70000}})
+        with pytest.raises(ValidationError, match="http"):
+            svc.update({"webhook": {"enabled": True, "url": "chat.x/hook"}})
+
+    def test_probe_failures_are_data_not_exceptions(self, repos):
+        svc, _ = self._svc(repos)
+        user = repos.users.save(User(name="adm2", is_admin=True))
+        # disabled channel
+        r = svc.test("webhook", user.id)
+        assert r["ok"] is False and "not enabled" in r["error"]
+        # enabled but dead endpoint: the error comes back as data
+        svc.update({"webhook": {"enabled": True,
+                                "url": "http://127.0.0.1:1/hook"}})
+        r = svc.test("webhook", user.id)
+        assert r["ok"] is False and r["error"]
+
+    def test_webhook_probe_roundtrip(self, repos):
+        svc, _ = self._svc(repos)
+        user = repos.users.save(User(name="adm3", is_admin=True))
+        WebhookHandler.received = []
+        httpd = HTTPServer(("127.0.0.1", 0), WebhookHandler)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        try:
+            svc.update({"webhook": {
+                "enabled": True,
+                "url": f"http://127.0.0.1:{httpd.server_port}/hook"}})
+            r = svc.test("webhook", user.id)
+            assert r["ok"] is True
+            assert WebhookHandler.received[0]["title"] == "Test notification"
+        finally:
+            httpd.shutdown()
+
+
+class TestNotifyOverrideStorage:
+    def _svc(self, repos, overrides=None):
+        from kubeoperator_tpu.service.event import EventService, MessageService
+        config = load_config(path="/nonexistent", env={},
+                             overrides=overrides or {})
+        messages = MessageService(repos)
+        messages.attach_to(EventService(repos))
+        return NotifySettingsService(repos, messages, config)
+
+    def test_config_values_never_freeze_into_the_db(self, repos):
+        """The stored row holds ONLY explicit overrides: saving an
+        unrelated channel must not copy app.yaml's SMTP password into the
+        DB, and a config rotation (restart with new app.yaml) must win."""
+        cfg = {"notify": {"smtp": {"enabled": True,
+                                   "password": "cfg-secret"}}}
+        svc = self._svc(repos, overrides=cfg)
+        svc.update({"webhook": {"enabled": False}})
+        stored = repos.settings.get_by_name("notify").vars
+        assert "password" not in stored.get("smtp", {})
+        # rotate the config (same DB = a restart with a new app.yaml)
+        svc2 = self._svc(repos, overrides={
+            "notify": {"smtp": {"enabled": True, "password": "rotated"}}})
+        assert svc2.effective()["smtp"]["password"] == "rotated"
+        # a round-tripped mask with no stored override stores nothing
+        svc2.update({"smtp": {"password": "********", "host": "m2"}})
+        assert "password" not in \
+            repos.settings.get_by_name("notify").vars["smtp"]
+        assert svc2.effective()["smtp"]["password"] == "rotated"
+        assert svc2.effective()["smtp"]["host"] == "m2"
+
+    def test_webhook_headers_set_masked_and_roundtripped(self, repos):
+        svc = self._svc(repos)
+        svc.update({"webhook": {
+            "enabled": True, "url": "http://hooks.local/x",
+            "headers": {"Authorization": "Bearer tok"}}})
+        assert svc.messages.senders["webhook"].headers["Authorization"] == \
+            "Bearer tok"
+        public = svc.get_public()
+        assert public["webhook"]["headers"]["Authorization"] == "********"
+        # masked header value round-trips as "unchanged"
+        svc.update({"webhook": {"headers": {"Authorization": "********"}}})
+        assert svc.effective()["webhook"]["headers"]["Authorization"] == \
+            "Bearer tok"
