@@ -222,6 +222,18 @@ class LocalClient:
                 return pub(s.backups.list_accounts())
             case ("POST", ["backup-accounts", name, "test"]):
                 return s.backups.test_account(name)
+            case ("GET", ["settings", "notify"]):
+                return s.notify_settings.get_public()
+            case ("PUT", ["settings", "notify"]):
+                return s.notify_settings.update(body)
+            case ("POST", ["settings", "notify", "test"]):
+                # local transport runs as the machine operator: probe to
+                # the first admin account (the REST transport uses the
+                # authenticated caller)
+                admins = [u for u in s.repos.users.list() if u.is_admin]
+                return s.notify_settings.test(
+                    body.get("channel", ""),
+                    admins[0].id if admins else "")
             case _:
                 raise SystemExit(
                     f"error: local transport has no route {method} "
@@ -420,6 +432,50 @@ def cmd_component(client, args) -> int:
         print(f"{args.name} uninstalled from {args.cluster}")
         return 0
     raise SystemExit(f"unknown component command {args.component_cmd}")
+
+
+def cmd_notify(client, args) -> int:
+    """Message-center channel verbs: show / set channel.key=value... /
+    test <channel> — mirror of the console admin panel."""
+    if args.notify_cmd == "show":
+        _print(client.call("GET", "/api/v1/settings/notify"))
+        return 0
+    if args.notify_cmd == "set":
+        # coerce by the DECLARED default's type, not by what the raw text
+        # looks like — "smtp.username=12345" is a string username, and an
+        # int there would only explode (swallowed) at delivery time
+        from kubeoperator_tpu.service.notify import NOTIFY_DEFAULTS
+
+        body: dict = {}
+        for pair in args.values:
+            key, sep, raw = pair.partition("=")
+            channel, dot, setting = key.partition(".")
+            if not sep or not dot:
+                raise SystemExit(
+                    f"error: expected channel.key=value, got {pair!r}")
+            default = NOTIFY_DEFAULTS.get(channel, {}).get(setting)
+            value: object = raw
+            if isinstance(default, bool):
+                if raw.lower() not in ("true", "false"):
+                    raise SystemExit(
+                        f"error: {key} expects true/false, got {raw!r}")
+                value = raw.lower() == "true"
+            elif isinstance(default, int):
+                try:
+                    value = int(raw)
+                except ValueError:
+                    raise SystemExit(
+                        f"error: {key} expects an integer, got {raw!r}")
+            body.setdefault(channel, {})[setting] = value
+        _print(client.call("PUT", "/api/v1/settings/notify", body))
+        return 0
+    result = client.call("POST", "/api/v1/settings/notify/test",
+                         {"channel": args.channel})
+    if result.get("ok"):
+        print(f"{args.channel}: ok")
+        return 0
+    print(f"{args.channel}: FAILED — {result.get('error')}")
+    return 1
 
 
 def cmd_apply(client, args) -> int:
@@ -632,6 +688,16 @@ def build_parser() -> argparse.ArgumentParser:
     )
     ba_test.add_argument("name")
 
+    notify = sub.add_parser("notify", help="message-center channel verbs")
+    nsub = notify.add_subparsers(dest="notify_cmd", required=True)
+    nsub.add_parser("show")
+    n_set = nsub.add_parser(
+        "set", help="e.g. smtp.enabled=true smtp.host=mail.local")
+    n_set.add_argument("values", nargs="+", metavar="channel.key=value")
+    n_test = nsub.add_parser(
+        "test", help="push a probe through one channel NOW")
+    n_test.add_argument("channel", choices=["smtp", "webhook"])
+
     tpu = sub.add_parser("tpu")
     tsub = tpu.add_subparsers(dest="tpu_cmd", required=True)
     tsub.add_parser("catalog")
@@ -732,6 +798,8 @@ def main(argv: list[str] | None = None) -> int:
         )
         _print(result)
         return 0 if result.get("ok") else 1
+    if args.cmd == "notify":
+        return cmd_notify(client, args)
     if args.cmd == "tpu":
         return cmd_tpu(client, args)
     raise SystemExit(f"unknown command {args.cmd}")

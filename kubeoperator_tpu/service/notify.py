@@ -2,10 +2,11 @@
 (email/webhook notifications)', §5.5).
 
 `MessageService.senders` is the fan-out registry; this module supplies the
-two reference channels — SMTP email and JSON webhook — and wires them from
-config at boot (`configure_senders`). Sender failures are logged and
-swallowed by MessageService so a dead mail relay can never block an event
-flow.
+two reference channels — SMTP email and JSON webhook — plus
+NotifySettingsService, the ONE wiring path: defaults <- app.yaml <- the
+stored 'notify' overrides row, applied at boot and re-applied on every
+runtime update. Sender failures are logged and swallowed by
+MessageService so a dead mail relay can never block an event flow.
 """
 
 from __future__ import annotations
@@ -150,10 +151,18 @@ class NotifySettingsService:
                 "headers": self.config.get("notify.webhook.headers", {})
                 or {},
             })
-        # runtime tier: the operator's explicit overrides win
+        # runtime tier: the operator's explicit overrides win. headers
+        # merge PER NAME over the config tier (a flat replace would let a
+        # stored {X-Extra: v} silently drop app.yaml's Authorization);
+        # an empty-string value deletes that header at apply time.
         for channel, values in self._stored_overrides().items():
             if channel in out and isinstance(values, dict):
-                out[channel].update(values)
+                for key, value in values.items():
+                    if key == "headers" and isinstance(value, dict):
+                        out[channel]["headers"] = {
+                            **out[channel].get("headers", {}), **value}
+                    else:
+                        out[channel][key] = value
         return out
 
     def get_public(self) -> dict:
@@ -188,23 +197,42 @@ class NotifySettingsService:
                 if isinstance(default, dict) and not isinstance(value, dict):
                     raise ValidationError(
                         f"{channel}.{key} must be an object, got {value!r}")
+                # non-bool/dict settings are typed by their default too: an
+                # int where smtplib expects a username string would only
+                # explode (swallowed) at delivery time
+                if isinstance(default, int) and not isinstance(default, bool) \
+                        and not isinstance(value, int):
+                    raise ValidationError(
+                        f"{channel}.{key} must be an integer, got {value!r}")
+                if isinstance(default, str) and not isinstance(value, str):
+                    raise ValidationError(
+                        f"{channel}.{key} must be a string, got {value!r}")
                 # a round-tripped mask means "unchanged": keep the stored
                 # override if one exists, else DROP the key so app.yaml
                 # keeps supplying it (never copy config secrets into the DB)
                 if (channel, key) in NOTIFY_SECRET_KEYS and value == _MASK:
                     continue
                 if key == "headers" and isinstance(value, dict):
-                    value = {
-                        name: (overrides.get("headers", {}).get(name, "")
-                               if v == _MASK else str(v))
-                        for name, v in value.items()
-                    }
+                    stored_headers = overrides.get("headers", {})
+                    cleaned = {}
+                    for name, v in value.items():
+                        if v == _MASK:
+                            # same mask rule per header: keep the stored
+                            # override; a masked config-sourced header
+                            # stays config-sourced (never copied, never
+                            # blanked)
+                            if name in stored_headers:
+                                cleaned[name] = stored_headers[name]
+                        else:
+                            cleaned[name] = str(v)
+                    if not cleaned and value:
+                        continue   # all masked+config-sourced: no-op
+                    value = cleaned
                 overrides[key] = value
 
         # validate the EFFECTIVE result of applying these overrides
-        merged = {ch: dict(d) for ch, d in NOTIFY_DEFAULTS.items()}
+        merged = self.effective()
         for ch in merged:
-            merged[ch].update(self.effective()[ch])
             merged[ch].update(stored.get(ch, {}))
         port = merged["smtp"].get("port")
         if not isinstance(port, int) or not 1 <= port <= 65535:
@@ -239,9 +267,13 @@ class NotifySettingsService:
                 use_tls=bool(doc["smtp"]["use_tls"]),
             )
         if doc["webhook"]["enabled"] and doc["webhook"]["url"]:
+            # empty-valued headers are deletions (the override tier's way
+            # to remove a config-supplied header)
+            headers = {name: v for name, v in
+                       (doc["webhook"].get("headers", {}) or {}).items()
+                       if v}
             self.messages.senders["webhook"] = WebhookSender(
-                doc["webhook"]["url"],
-                headers=doc["webhook"].get("headers", {}) or {},
+                doc["webhook"]["url"], headers=headers,
             )
 
     def test(self, channel: str, user_id: str) -> dict:

@@ -989,15 +989,32 @@ $("#notify-edit-btn").addEventListener("click", async () => {
       type: "checkbox", value: s.webhook.enabled },
     { key: "webhook_url", label: "Webhook URL", value: s.webhook.url,
       placeholder: "https://chat.example.com/hook" },
-  ], (out) => api("PUT", "/api/v1/settings/notify", {
-    smtp: {
+  ], (out) => {
+    // PUT only what CHANGED vs the fetched document: sending the merged
+    // doc back would freeze every app.yaml value into DB overrides, the
+    // exact drift the overrides-only storage model exists to prevent
+    const diff = (next, prev) => {
+      const changed = {};
+      for (const k of Object.keys(next)) {
+        if (next[k] !== prev[k]) changed[k] = next[k];
+      }
+      return changed;
+    };
+    const smtp = diff({
       enabled: out.smtp_enabled, host: out.smtp_host.trim(),
       port: parseInt(out.smtp_port, 10) || 0,
       username: out.smtp_username, password: out.smtp_password,
       sender: out.smtp_sender, use_tls: out.smtp_use_tls,
-    },
-    webhook: { enabled: out.webhook_enabled, url: out.webhook_url.trim() },
-  }));
+    }, s.smtp);
+    const webhook = diff(
+      { enabled: out.webhook_enabled, url: out.webhook_url.trim() },
+      s.webhook);
+    const body = {};
+    if (Object.keys(smtp).length) body.smtp = smtp;
+    if (Object.keys(webhook).length) body.webhook = webhook;
+    if (!Object.keys(body).length) return Promise.resolve();
+    return api("PUT", "/api/v1/settings/notify", body);
+  });
 });
 for (const ch of ["smtp", "webhook"]) {
   $(`#notify-test-${ch}`).addEventListener("click", async () => {

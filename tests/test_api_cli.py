@@ -4,6 +4,7 @@ middleware, error mapping, SSE logs; koctl local transport north-star flow."""
 import json
 import time
 
+import pytest
 import requests
 
 
@@ -504,3 +505,31 @@ class TestNotifySettingsApi:
             f"{base}/api/v1/settings/notify").status_code == 403
         assert norm.put(f"{base}/api/v1/settings/notify",
                         json={}).status_code == 403
+
+
+class TestKoctlNotify:
+    def test_show_set_and_test_over_local_transport(self, capsys,
+                                                    monkeypatch, tmp_path):
+        from kubeoperator_tpu.cli import koctl
+
+        monkeypatch.setenv("KO_TPU_DB__PATH", str(tmp_path / "nf.db"))
+        monkeypatch.setenv("KO_TPU_EXECUTOR__BACKEND", "simulation")
+        monkeypatch.setenv("KO_TPU_PROVISIONER__WORK_DIR",
+                           str(tmp_path / "tf"))
+        assert koctl.main(["--local", "notify", "set",
+                           "smtp.enabled=true", "smtp.host=mail.local",
+                           "smtp.port=2525",
+                           "smtp.password=hunter2"]) == 0
+        out = capsys.readouterr().out
+        assert '"host": "mail.local"' in out
+        assert "hunter2" not in out           # masked on read
+        assert koctl.main(["--local", "notify", "show"]) == 0
+        out = capsys.readouterr().out
+        assert '"port": 2525' in out          # coerced to int, persisted
+        # probe failure is exit code 1 with the reason printed
+        assert koctl.main(["--local", "notify", "test", "smtp"]) == 1
+        out = capsys.readouterr().out
+        assert "FAILED" in out
+        # garbage shape dies with the service's message
+        with pytest.raises(SystemExit, match="unknown smtp setting"):
+            koctl.main(["--local", "notify", "set", "smtp.hots=x"])

@@ -296,3 +296,25 @@ class TestNotifyOverrideStorage:
         svc.update({"webhook": {"headers": {"Authorization": "********"}}})
         assert svc.effective()["webhook"]["headers"]["Authorization"] == \
             "Bearer tok"
+
+    def test_config_headers_survive_mask_merge_and_delete(self, repos):
+        """Header overrides: a masked config-sourced header is neither
+        copied nor blanked; names merge over the config tier; an empty
+        string deletes the header at apply time."""
+        svc = self._svc(repos, overrides={"notify": {"webhook": {
+            "url": "http://hooks.local/x",
+            "headers": {"Authorization": "Bearer cfg"}}}})
+        # read-modify-write with the mask: nothing stored, nothing blanked
+        svc.update({"webhook": {"headers": {"Authorization": "********"}}})
+        assert svc.effective()["webhook"]["headers"]["Authorization"] == \
+            "Bearer cfg"
+        assert "headers" not in \
+            repos.settings.get_by_name("notify").vars.get("webhook", {})
+        # a new header merges per NAME over config, not dict-replace
+        svc.update({"webhook": {"headers": {"X-Extra": "v"}}})
+        assert svc.effective()["webhook"]["headers"] == {
+            "Authorization": "Bearer cfg", "X-Extra": "v"}
+        # empty string = delete: the live sender omits the header
+        svc.update({"webhook": {"enabled": True,
+                                "headers": {"Authorization": ""}}})
+        assert "Authorization" not in svc.messages.senders["webhook"].headers
