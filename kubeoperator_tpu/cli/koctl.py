@@ -228,12 +228,16 @@ class LocalClient:
                 return s.notify_settings.update(body)
             case ("POST", ["settings", "notify", "test"]):
                 # local transport runs as the machine operator: probe to
-                # the first admin account (the REST transport uses the
-                # authenticated caller)
+                # an admin that can actually RECEIVE mail (the REST
+                # transport uses the authenticated caller); fall back to
+                # any admin so the no-email error still explains itself
                 admins = [u for u in s.repos.users.list() if u.is_admin]
+                target = next(
+                    (u for u in admins if getattr(u, "email", "")),
+                    admins[0] if admins else None,
+                )
                 return s.notify_settings.test(
-                    body.get("channel", ""),
-                    admins[0].id if admins else "")
+                    body.get("channel", ""), target.id if target else "")
             case _:
                 raise SystemExit(
                     f"error: local transport has no route {method} "

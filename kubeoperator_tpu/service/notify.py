@@ -201,7 +201,10 @@ class NotifySettingsService:
                 # int where smtplib expects a username string would only
                 # explode (swallowed) at delivery time
                 if isinstance(default, int) and not isinstance(default, bool) \
-                        and not isinstance(value, int):
+                        and (not isinstance(value, int)
+                             or isinstance(value, bool)):
+                    # bool subclasses int: port=true would pass a bare
+                    # isinstance and connect to port 1
                     raise ValidationError(
                         f"{channel}.{key} must be an integer, got {value!r}")
                 if isinstance(default, str) and not isinstance(value, str):
@@ -225,9 +228,11 @@ class NotifySettingsService:
                                 cleaned[name] = stored_headers[name]
                         else:
                             cleaned[name] = str(v)
-                    if not cleaned and value:
-                        continue   # all masked+config-sourced: no-op
-                    value = cleaned
+                    # merge per NAME into the stored overrides — the write
+                    # path must honor the same semantics the read path
+                    # promises, or a partial update silently drops every
+                    # header override it didn't mention
+                    value = {**stored_headers, **cleaned}
                 overrides[key] = value
 
         # validate the EFFECTIVE result of applying these overrides

@@ -221,6 +221,9 @@ class TestNotifySettings:
             svc.update({"smtp": {"enabled": "yes"}})
         with pytest.raises(ValidationError, match="smtp.port"):
             svc.update({"smtp": {"port": 70000}})
+        # bool subclasses int: port=true would otherwise connect to port 1
+        with pytest.raises(ValidationError, match="must be an integer"):
+            svc.update({"smtp": {"port": True}})
         with pytest.raises(ValidationError, match="http"):
             svc.update({"webhook": {"enabled": True, "url": "chat.x/hook"}})
 
@@ -308,13 +311,17 @@ class TestNotifyOverrideStorage:
         svc.update({"webhook": {"headers": {"Authorization": "********"}}})
         assert svc.effective()["webhook"]["headers"]["Authorization"] == \
             "Bearer cfg"
-        assert "headers" not in \
-            repos.settings.get_by_name("notify").vars.get("webhook", {})
+        assert "Authorization" not in repos.settings.get_by_name(
+            "notify").vars.get("webhook", {}).get("headers", {})
         # a new header merges per NAME over config, not dict-replace
         svc.update({"webhook": {"headers": {"X-Extra": "v"}}})
         assert svc.effective()["webhook"]["headers"] == {
             "Authorization": "Bearer cfg", "X-Extra": "v"}
-        # empty string = delete: the live sender omits the header
+        # empty string = delete: the live sender omits the header — and
+        # the WRITE path merges per name too, so the stored X-Extra
+        # override survives an update that doesn't mention it
         svc.update({"webhook": {"enabled": True,
                                 "headers": {"Authorization": ""}}})
-        assert "Authorization" not in svc.messages.senders["webhook"].headers
+        headers = svc.messages.senders["webhook"].headers
+        assert "Authorization" not in headers
+        assert headers["X-Extra"] == "v"
