@@ -892,30 +892,41 @@ def _role_tasks(role):
 
 
 def test_drain_is_budget_aware_with_uncordon_rollback():
-    """Eviction order: polite (PDBs respected, retried) -> force for
-    unmanaged pods only (never --disable-eviction) -> uncordon + fail, so
-    an aborted scale-down never strands a node unschedulable."""
-    tasks = _role_tasks("drain")
-    names = [t["name"] for t in tasks]
-    assert names.index("cordon leaving node") \
-        < names.index("drain leaving node (respecting disruption budgets)") \
+    """The SHARED eviction chain (roles/drain/tasks/evict.yml): polite
+    (PDBs respected, retried) -> force for unmanaged pods only (never
+    --disable-eviction) -> uncordon + fail, so no flow ever strands a node
+    unschedulable. One copy, consumed by scale-down AND worker upgrade."""
+    chain = yaml.safe_load(open(os.path.join(
+        ROLES, "drain", "tasks", "evict.yml"), encoding="utf-8"))
+    names = [t["name"] for t in chain]
+    assert names.index("drain leaving node (respecting disruption budgets)") \
         < names.index("force-drain unmanaged pods") \
         < names.index("uncordon the undrainable node") \
         < names.index("fail when the node could not be drained")
-    polite = tasks[names.index(
+    polite = chain[names.index(
         "drain leaving node (respecting disruption budgets)")]
     assert "--force" not in str(polite.values())
-    assert polite["retries"] >= 3
+    assert polite["retries"] >= 3 and polite["ignore_errors"] is True
     # the historic marker the scale-down failure drill injects must still
     # match (executor __fail_at_task__ is a substring match)
     assert "drain leaving node" in polite["name"]
-    for t in tasks:   # flag absent from every COMMAND (comments may name it)
+    for t in chain:   # flag absent from every COMMAND (comments may name it)
         for key in ("ansible.builtin.command", "ansible.builtin.shell"):
             assert "--disable-eviction" not in str(t.get(key, "")), t["name"]
     for guarded in ("force-drain unmanaged pods",
                     "uncordon the undrainable node",
                     "fail when the node could not be drained"):
-        assert "drain_polite.rc != 0" in str(tasks[names.index(guarded)]["when"])
+        assert "drain_polite.rc != 0" in str(chain[names.index(guarded)]["when"])
+    # every kubectl in the chain runs on the first master
+    for t in chain:
+        if "ansible.builtin.command" in t:
+            assert "kube-master" in str(t["delegate_to"]), t["name"]
+    # the scale-down role cordons first, then includes the chain once
+    main = _role_tasks("drain")
+    assert main[0]["name"] == "cordon leaving node"
+    include = main[1]
+    assert "evict.yml" in str(include)
+    assert "groups['kube-master'][0]" in str(include["when"])
 
 
 def test_upgrade_prepare_snapshots_etcd_before_touching_nodes():
@@ -985,3 +996,31 @@ def test_reset_leaves_no_network_or_storage_residue():
     for path in ("/var/lib/cni", "/run/flannel", "/var/lib/calico",
                  "/var/lib/rook"):
         assert path in clean["loop"], path
+
+
+def test_worker_upgrade_uses_the_shared_eviction_chain():
+    """The rolling worker upgrade includes the ONE eviction discipline
+    (roles/drain/tasks/evict.yml) before touching the node — no duplicated
+    drain logic to drift — and the simulated upgrade stream shows the
+    chain expanding per worker."""
+    tasks = _role_tasks("upgrade-worker")
+    names = [t["name"] for t in tasks]
+    include = tasks[names.index("evict pods from this worker")]
+    assert "drain/tasks/evict.yml" in str(include)
+    assert "inventory_hostname" in str(include["vars"]["drain_target"])
+    assert names.index("evict pods from this worker") \
+        < names.index("kubeadm upgrade node") \
+        < names.index("uncordon worker")
+    # no leftover inline drain commands in the role
+    for t in tasks:
+        assert "drain" not in str(t.get("ansible.builtin.command", "")), \
+            t["name"]
+
+    ex = SimulationExecutor()
+    inv, ev = _network_extra_vars()
+    ev.update({"ko_simulation": True, "target_k8s_version": "v1.30.6"})
+    tid = ex.run_playbook("22-upgrade-workers.yml", inv, ev)
+    assert ex.wait(tid, timeout_s=30).ok
+    lines = "\n".join(ex.watch(tid, timeout_s=5))
+    assert "drain leaving node (respecting disruption budgets)" in lines
+    assert "TASK [kubeadm upgrade node]" in lines
