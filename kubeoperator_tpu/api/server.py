@@ -75,12 +75,17 @@ async def error_middleware(request: web.Request, handler):
         )
 
 
+# typed app-state key (aiohttp AppKey): silences NotAppKeyWarning and
+# gives every request.app[SERVICES_KEY] read a real type
+SERVICES_KEY: "web.AppKey[Services]" = web.AppKey("services", object)
+
+
 @web.middleware
 async def auth_middleware(request: web.Request, handler):
     if (request.method, request.path) in AUTH_EXEMPT or \
             not request.path.startswith("/api/"):
         return await handler(request)
-    services: Services = request.app["services"]
+    services: Services = request.app[SERVICES_KEY]
     token = request.headers.get("Authorization", "").removeprefix("Bearer ").strip()
     if not token:
         token = request.cookies.get("ko_session", "")
@@ -117,7 +122,7 @@ def cluster_guard(handler, needed: Role):
     async def wrapped(request: web.Request):
         from kubeoperator_tpu.utils.errors import ForbiddenError
 
-        services: Services = request.app["services"]
+        services: Services = request.app[SERVICES_KEY]
         user = request["user"]
         if not user.is_admin:
             cluster = await run_sync(request, services.clusters.get,
@@ -155,6 +160,16 @@ class Handlers:
 
     async def whoami(self, request):
         return json_response(request["user"].to_public_dict())
+
+    async def change_password(self, request):
+        """Self-service: re-proves the OLD password (a stolen session token
+        must not be enough to lock the real owner out), then invalidates
+        nothing but the credential — existing sessions stay valid."""
+        body = await request.json()
+        await run_sync(request, self.s.users.change_password,
+                       request["user"].name,
+                       body.get("old", ""), body.get("new", ""))
+        return json_response({"ok": True})
 
     async def list_users(self, request):
         _require_admin(request)
@@ -218,7 +233,7 @@ class Handlers:
                 raise ForbiddenError(
                     action="creating a cluster outside a project"
                 )
-            await run_sync(request, request.app["services"].projects.require,
+            await run_sync(request, request.app[SERVICES_KEY].projects.require,
                            user, project_id, Role.MANAGER)
         spec = ClusterSpec(**{
             k: v for k, v in body.get("spec", {}).items()
@@ -759,7 +774,7 @@ class Handlers:
 
 def create_app(services: Services) -> web.Application:
     app = web.Application(middlewares=[error_middleware, auth_middleware])
-    app["services"] = services
+    app[SERVICES_KEY] = services
     h = Handlers(services)
 
     r = app.router
@@ -767,6 +782,7 @@ def create_app(services: Services) -> web.Application:
     r.add_get("/api/v1/version", h.version)
     r.add_post("/api/v1/auth/login", h.login)
     r.add_post("/api/v1/auth/logout", h.logout)
+    r.add_post("/api/v1/auth/password", h.change_password)
     r.add_get("/api/v1/auth/whoami", h.whoami)
     r.add_get("/api/v1/users", h.list_users)
     r.add_post("/api/v1/users", h.create_user)

@@ -312,6 +312,11 @@ class SimulationExecutor(Executor):
                             g for g, members in base_ctx["groups"].items()
                             if g != "all" and h in members
                         ),
+                        # real-ansible magic var: the play's ACTIVE hosts —
+                        # content pins single-execution chains to
+                        # ansible_play_hosts[0] (run_once semantics that
+                        # survive an unreachable first inventory host)
+                        "ansible_play_hosts": list(play_hosts),
                     }
                     # task/include vars: templated lazily in real ansible, so
                     # render their string values against the host context.

@@ -26,11 +26,10 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.experimental.shard_map import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from kubeoperator_tpu.ops.timing import differential_time_per_iter
-from kubeoperator_tpu.parallel.mesh import flat_axis_mesh
+from kubeoperator_tpu.parallel.mesh import flat_axis_mesh, shard_map_compat
 
 AXIS = "devices"
 
@@ -94,8 +93,8 @@ def _collective_fn(op: str, mesh):
 
     @partial(jax.jit, static_argnums=(1,))
     def run_iters(x, n):
-        @partial(shard_map, mesh=mesh, in_specs=P(AXIS), out_specs=P(AXIS),
-                 check_rep=False)
+        @partial(shard_map_compat, mesh=mesh, in_specs=P(AXIS),
+                 out_specs=P(AXIS))
         def shard_body(v):
             def step(_, u):
                 return body(u)
@@ -153,8 +152,8 @@ def verify_psum_correctness(mesh=None) -> bool:
     mesh = mesh or flat_axis_mesh(AXIS)
     n = int(mesh.devices.size)
 
-    @partial(shard_map, mesh=mesh, in_specs=P(AXIS), out_specs=P(AXIS),
-             check_rep=False)
+    @partial(shard_map_compat, mesh=mesh, in_specs=P(AXIS),
+             out_specs=P(AXIS))
     def ranks_sum(x):
         mine = jnp.full_like(x, jax.lax.axis_index(AXIS), dtype=jnp.float32)
         return jax.lax.psum(mine, AXIS)

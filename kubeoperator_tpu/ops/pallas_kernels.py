@@ -29,12 +29,11 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
-from jax.experimental.shard_map import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from kubeoperator_tpu.ops.collectives import CollectiveResult
 from kubeoperator_tpu.ops.timing import differential_time_per_iter
-from kubeoperator_tpu.parallel.mesh import flat_axis_mesh
+from kubeoperator_tpu.parallel.mesh import flat_axis_mesh, shard_map_compat
 
 AXIS = "devices"
 COLS = 1024        # lane-aligned
@@ -227,8 +226,8 @@ def ring_all_gather(x, mesh=None, interpret: bool | None = None):
 
     x = jax.device_put(x, NamedSharding(mesh, P(AXIS, None)))
     return jax.jit(
-        shard_map(gather, mesh=mesh, in_specs=P(AXIS, None),
-                  out_specs=P(None, None), check_rep=False)
+        shard_map_compat(gather, mesh=mesh, in_specs=P(AXIS, None),
+                         out_specs=P(None, None))
     )(x)
 
 
@@ -267,8 +266,8 @@ def bench_ring_all_gather(
 
     @partial(jax.jit, static_argnums=(1,))
     def run_iters(v, k):
-        @partial(shard_map, mesh=mesh, in_specs=P(AXIS, None),
-                 out_specs=P(AXIS, None), check_rep=False)
+        @partial(shard_map_compat, mesh=mesh, in_specs=P(AXIS, None),
+                 out_specs=P(AXIS, None))
         def body(u):
             def step(_, w):
                 g = gather(w)

@@ -11,7 +11,13 @@ const api = async (method, path, body) => {
     body: body ? JSON.stringify(body) : undefined,
     credentials: "same-origin",
   });
-  if (resp.status === 401) { showLogin(); throw new Error("unauthenticated"); }
+  // 401 normally means the SESSION died — bounce to login. The password
+  // endpoint is the exception: it re-proves the old password and a typo
+  // there is a dialog error for a still-valid session, not a logout.
+  if (resp.status === 401 && path !== "/api/v1/auth/password") {
+    showLogin();
+    throw new Error("unauthenticated");
+  }
   const data = resp.headers.get("Content-Type")?.includes("json")
     ? await resp.json() : await resp.text();
   if (!resp.ok) throw new Error(data.message || resp.statusText);
@@ -58,6 +64,10 @@ const I18N = {
     catalog_load_failed: "Could not load — try again.",
     notify_settings: "Message center", notify_edit: "Configure channels",
     enabled: "enabled",
+    change_password: "Change password", old_password: "Current password",
+    new_password: "New password", confirm_password: "Confirm new password",
+    password_mismatch: "passwords do not match",
+    password_too_short: "password must be at least 8 characters",
     kubeconfig: "Kubeconfig", details: "Details",
     scale_slices: "＋ Add slices",
     renew_certs: "Renew certs", rotate_key: "Rotate secrets key",
@@ -109,6 +119,10 @@ const I18N = {
     catalog_load_failed: "加载失败，请重试。",
     notify_settings: "消息中心", notify_edit: "配置通知渠道",
     enabled: "启用",
+    change_password: "修改密码", old_password: "当前密码",
+    new_password: "新密码", confirm_password: "确认新密码",
+    password_mismatch: "两次输入的密码不一致",
+    password_too_short: "密码长度至少8个字符",
     kubeconfig: "Kubeconfig", details: "详情",
     scale_slices: "＋ 扩容切片",
     renew_certs: "轮换证书", rotate_key: "轮换加密密钥",
@@ -158,6 +172,16 @@ $("#logout-btn").addEventListener("click", async () => {
   await api("POST", "/api/v1/auth/logout").catch(() => {});
   me = null;
   showLogin();
+});
+$("#passwd-btn").addEventListener("click", () => {
+  objDialog("change_password", [
+    { key: "old", label: t("old_password"), type: "password" },
+    { key: "new", label: t("new_password"), type: "password" },
+    { key: "confirm", label: t("confirm_password"), type: "password" },
+  ], (out) => api("POST", "/api/v1/auth/password",
+                  { old: out.old, new: out.new }),
+  (out) => out.new !== out.confirm ? [t("password_mismatch")]
+    : out.new.length < 8 ? [t("password_too_short")] : []);
 });
 async function boot() {
   applyI18n();

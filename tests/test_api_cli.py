@@ -533,3 +533,26 @@ class TestKoctlNotify:
         # garbage shape dies with the service's message
         with pytest.raises(SystemExit, match="unknown smtp setting"):
             koctl.main(["--local", "notify", "set", "smtp.hots=x"])
+
+
+class TestPasswordChange:
+    def test_self_service_requires_old_password(self, client):
+        base, http, services = client
+        services.users.create("pat", password="password1")
+        pat = requests.Session()
+        token = pat.post(f"{base}/api/v1/auth/login", json={
+            "username": "pat", "password": "password1"}).json()["token"]
+        pat.headers["Authorization"] = f"Bearer {token}"
+        # wrong old password: a stolen session token is not enough
+        assert pat.post(f"{base}/api/v1/auth/password", json={
+            "old": "wrong", "new": "password2"}).status_code == 401
+        # too-short new password rejected
+        assert pat.post(f"{base}/api/v1/auth/password", json={
+            "old": "password1", "new": "short"}).status_code == 400
+        # the real change
+        assert pat.post(f"{base}/api/v1/auth/password", json={
+            "old": "password1", "new": "password2"}).status_code == 200
+        assert requests.post(f"{base}/api/v1/auth/login", json={
+            "username": "pat", "password": "password1"}).status_code == 401
+        assert requests.post(f"{base}/api/v1/auth/login", json={
+            "username": "pat", "password": "password2"}).status_code == 200

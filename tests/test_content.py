@@ -917,16 +917,21 @@ def test_drain_is_budget_aware_with_uncordon_rollback():
                     "uncordon the undrainable node",
                     "fail when the node could not be drained"):
         assert "drain_polite.rc != 0" in str(chain[names.index(guarded)]["when"])
-    # every kubectl in the chain runs on the first master
+    # every kubectl in the chain delegates to a master (live-master
+    # override via drain_delegate, first-master default)
     for t in chain:
         if "ansible.builtin.command" in t:
-            assert "kube-master" in str(t["delegate_to"]), t["name"]
-    # the scale-down role cordons first, then includes the chain once
+            d = str(t["delegate_to"])
+            assert "drain_delegate" in d and "kube-master" in d, t["name"]
+    # the scale-down role cordons first, then includes the chain pinned to
+    # the play's first ACTIVE host (run_once semantics that survive an
+    # unreachable first inventory master)
     main = _role_tasks("drain")
     assert main[0]["name"] == "cordon leaving node"
     include = main[1]
     assert "evict.yml" in str(include)
-    assert "groups['kube-master'][0]" in str(include["when"])
+    assert "ansible_play_hosts[0]" in str(include["when"])
+    assert "ansible_play_hosts[0]" in str(include["vars"]["drain_delegate"])
 
 
 def test_upgrade_prepare_snapshots_etcd_before_touching_nodes():

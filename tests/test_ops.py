@@ -128,19 +128,20 @@ def test_multislice_dcn_ici_hierarchy_collectives():
     import jax.numpy as jnp
     from functools import partial
 
-    from jax.experimental.shard_map import shard_map
     from jax.sharding import PartitionSpec as P
 
-    from kubeoperator_tpu.parallel.mesh import mesh_for_topology
+    from kubeoperator_tpu.parallel.mesh import (
+        mesh_for_topology,
+        shard_map_compat,
+    )
 
     topo = parse_accelerator_type("v5e-4", num_slices=2)  # 2 x (2x2) = 8
     mesh = mesh_for_topology(topo)
     assert dict(mesh.shape) == {"dcn": 2, "ici_0": 2, "ici_1": 2}
 
     @jax.jit
-    @partial(shard_map, mesh=mesh,
-             in_specs=P(("dcn", "ici_0", "ici_1")), out_specs=P(),
-             check_rep=False)
+    @partial(shard_map_compat, mesh=mesh,
+             in_specs=P(("dcn", "ici_0", "ici_1")), out_specs=P())
     def hierarchical(x):
         local = jnp.sum(x)
         intra = jax.lax.psum(local, ("ici_0", "ici_1"))  # rides ICI
