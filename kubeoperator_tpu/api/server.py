@@ -187,6 +187,15 @@ class Handlers:
         return json_response(user.to_public_dict(), status=201)
 
     # ---- ldap (admin) ----
+    async def get_ldap_settings(self, request):
+        return json_response(
+            await run_sync(request, self.s.ldap.settings.get_public))
+
+    async def update_ldap_settings(self, request):
+        body = await request.json()
+        return json_response(
+            await run_sync(request, self.s.ldap.settings.update, body))
+
     async def ldap_test(self, request):
         _require_admin(request)
         return json_response(await run_sync(request, self.s.ldap.test_connection))
@@ -911,6 +920,8 @@ def create_app(services: Services) -> web.Application:
               admin_guard(h.update_notify_settings))
     r.add_post("/api/v1/settings/notify/test",
                admin_guard(h.test_notify_channel))
+    r.add_get("/api/v1/settings/ldap", admin_guard(h.get_ldap_settings))
+    r.add_put("/api/v1/settings/ldap", admin_guard(h.update_ldap_settings))
 
     r.add_get("/api/v1/projects", h.list_projects)
     r.add_post("/api/v1/projects", h.create_project)

@@ -15,46 +15,74 @@ from kubeoperator_tpu.utils.config import Config
 from kubeoperator_tpu.utils.errors import ValidationError
 from kubeoperator_tpu.utils.ldapclient import LdapClient, LdapError
 from kubeoperator_tpu.utils.logging import get_logger
+from kubeoperator_tpu.service.settings import OverlaySettings
 
 log = get_logger("service.ldap")
 
+LDAP_DEFAULTS = {
+    "enabled": False,
+    "host": "",
+    "port": 389,
+    "ssl": False,
+    "verify_tls": True,
+    "timeout_s": 10.0,
+    "manager_dn": "",
+    "manager_password": "",
+    "base_dn": "",
+    "username_attr": "uid",
+    "email_attr": "mail",
+}
+
+
+class _LdapSettings(OverlaySettings):
+    def validate_effective(self, merged: dict) -> None:
+        port = merged.get("port")
+        if not isinstance(port, int) or not 1 <= port <= 65535:
+            raise ValidationError(f"ldap.port must be 1-65535, got {port!r}")
+        if merged.get("enabled") and not merged.get("host"):
+            raise ValidationError("enabling ldap requires a host")
+
 
 class LdapService:
+    """Directory settings are runtime-editable (OverlaySettings: defaults
+    <- app.yaml <- the stored 'ldap' overrides row) — the reference
+    manages LDAP from the system-settings UI, and the existing
+    test-connection button is the configure-time probe."""
+
     def __init__(self, repos: Repositories, config: Config):
         self.repos = repos
         self.config = config
+        self.settings = _LdapSettings(
+            repos, "ldap", LDAP_DEFAULTS,
+            config_paths={k: f"ldap.{k}" for k in LDAP_DEFAULTS},
+            secret_keys=frozenset({"manager_password"}),
+            config=config,
+        )
 
     # ---- config ----
     @property
     def enabled(self) -> bool:
-        return bool(self.config.get("ldap.enabled", False))
+        return bool(self.settings.effective()["enabled"])
 
-    def _client(self) -> LdapClient:
-        host = self.config.get("ldap.host", "")
-        if not host:
+    def _client(self, s: dict) -> LdapClient:
+        """Build a client from an ALREADY-FETCHED settings document — each
+        operation fetches once and threads the dict through, keeping the
+        hot auth path at one settings read instead of four."""
+        if not s["host"]:
             raise ValidationError("ldap.host is not configured")
         return LdapClient(
-            host,
-            int(self.config.get("ldap.port", 389)),
-            use_ssl=bool(self.config.get("ldap.ssl", False)),
-            timeout_s=float(self.config.get("ldap.timeout_s", 10)),
-            verify_tls=bool(self.config.get("ldap.verify_tls", True)),
+            s["host"],
+            int(s["port"]),
+            use_ssl=bool(s["ssl"]),
+            timeout_s=float(s["timeout_s"]),
+            verify_tls=bool(s["verify_tls"]),
         )
-
-    def _settings(self) -> dict:
-        return {
-            "manager_dn": self.config.get("ldap.manager_dn", ""),
-            "manager_password": self.config.get("ldap.manager_password", ""),
-            "base_dn": self.config.get("ldap.base_dn", ""),
-            "username_attr": self.config.get("ldap.username_attr", "uid"),
-            "email_attr": self.config.get("ldap.email_attr", "mail"),
-        }
 
     # ---- operations ----
     def test_connection(self) -> dict:
         """Manager bind + base search; the UI's 'test LDAP settings' button."""
-        s = self._settings()
-        with self._client() as client:
+        s = self.settings.effective()
+        with self._client(s) as client:
             if not client.bind(s["manager_dn"], s["manager_password"]):
                 return {"ok": False, "message": "manager bind rejected"}
             entries = client.search(
@@ -71,12 +99,12 @@ class LdapService:
 
     def authenticate(self, name: str, password: str) -> bool:
         """Directory-verify a platform user with source='ldap'."""
-        if not self.enabled:
+        s = self.settings.effective()
+        if not s["enabled"]:
             return False
         if not password:
             return False  # RFC 4513: empty password = unauthenticated bind
-        s = self._settings()
-        with self._client() as client:
+        with self._client(s) as client:
             if not client.bind(s["manager_dn"], s["manager_password"]):
                 raise LdapError("ldap manager bind rejected")
             entry = self._find_user(client, s, name)
@@ -84,13 +112,13 @@ class LdapService:
                 return False
         # verification bind on a fresh connection: some servers refuse
         # rebinding an authenticated connection downward
-        with self._client() as client:
+        with self._client(s) as client:
             return client.bind(entry.dn, password)
 
     def sync_users(self) -> dict:
         """Import directory users as platform users (source='ldap')."""
-        s = self._settings()
-        with self._client() as client:
+        s = self.settings.effective()
+        with self._client(s) as client:
             if not client.bind(s["manager_dn"], s["manager_password"]):
                 raise LdapError("ldap manager bind rejected")
             entries = client.search(

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import json
 import smtplib
+import threading
 import urllib.request
 from email.message import EmailMessage
 
@@ -119,6 +120,9 @@ class NotifySettingsService:
         self.repos = repos
         self.messages = messages
         self.config = config
+        # update() is read-modify-write over one row; concurrent admin
+        # PUTs (thread-pool handlers) must not lose each other's overrides
+        self._write_lock = threading.Lock()
 
     # ---- settings document ----
     def _stored_overrides(self) -> dict:
@@ -176,6 +180,10 @@ class NotifySettingsService:
         return doc
 
     def update(self, body: dict) -> dict:
+        with self._write_lock:
+            return self._update_locked(body)
+
+    def _update_locked(self, body: dict) -> dict:
         from kubeoperator_tpu.models import Setting
         from kubeoperator_tpu.utils.errors import NotFoundError, ValidationError
 

@@ -1,0 +1,123 @@
+"""OverlaySettings — the shared runtime-settings discipline (SURVEY.md
+§5.6: the reference keeps system settings in a DB table behind an admin
+UI, app.yaml is only the bootstrap tier).
+
+One flat settings document per consumer: reads merge
+defaults <- app.yaml <- the stored overrides row; writes validate every
+key against its default's TYPE and persist ONLY the submitted overrides
+(persisting the merged doc would freeze config values — including
+secrets — into the DB, and a later config rotation would silently lose).
+Secret keys are masked on read, and a round-tripped mask means
+"unchanged": keep the stored override if one exists, else drop the key so
+app.yaml keeps supplying it.
+
+NotifySettingsService predates this helper and keeps its own channelled
+implementation (nested channels + per-name header merge don't fit a flat
+document); new flat settings consumers (LDAP first) build on this one.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Mapping
+
+from kubeoperator_tpu.utils.errors import NotFoundError, ValidationError
+
+MASK = "********"
+
+
+class OverlaySettings:
+    def __init__(self, repos, name: str, defaults: Mapping,
+                 config_paths: Mapping[str, str], secret_keys: frozenset,
+                 config) -> None:
+        self.repos = repos
+        self.name = name
+        self.defaults = dict(defaults)
+        self.config_paths = dict(config_paths)   # key -> app.yaml path
+        self.secret_keys = frozenset(secret_keys)
+        self.config = config
+        # update() is read-modify-write over one row; concurrent admin
+        # PUTs (thread-pool handlers) must not lose each other's overrides
+        self._write_lock = threading.Lock()
+
+    # ---- reads ----
+    def _stored(self) -> dict:
+        try:
+            return dict(self.repos.settings.get_by_name(self.name).vars)
+        except NotFoundError:
+            # ONLY not-found means "no overrides yet" — a sick DB must
+            # surface, not silently fall back to config
+            return {}
+
+    def effective(self) -> dict:
+        out = dict(self.defaults)
+        for key, path in self.config_paths.items():
+            value = self.config.get(path, None)
+            if value is not None:
+                default = self.defaults[key]
+                # config files are YAML-typed already; coerce the numeric
+                # tiers the way the historical boot wiring did
+                if isinstance(default, bool):
+                    value = bool(value)
+                elif isinstance(default, int):
+                    value = int(value)
+                elif isinstance(default, float):
+                    value = float(value)
+                out[key] = value
+        for key, value in self._stored().items():
+            if key in out:
+                out[key] = value
+        return out
+
+    def get_public(self) -> dict:
+        doc = self.effective()
+        for key in self.secret_keys:
+            if doc.get(key):
+                doc[key] = MASK
+        return doc
+
+    # ---- writes ----
+    def update(self, body: Mapping) -> dict:
+        with self._write_lock:
+            return self._update_locked(body)
+
+    def _update_locked(self, body: Mapping) -> dict:
+        from kubeoperator_tpu.models import Setting
+
+        stored = self._stored()
+        for key, value in dict(body).items():
+            if key not in self.defaults:
+                raise ValidationError(
+                    f"unknown {self.name} setting {key!r}")
+            default = self.defaults[key]
+            if isinstance(default, bool):
+                if not isinstance(value, bool):
+                    raise ValidationError(
+                        f"{self.name}.{key} must be a boolean, got {value!r}")
+            elif isinstance(default, int):
+                if not isinstance(value, int) or isinstance(value, bool):
+                    raise ValidationError(
+                        f"{self.name}.{key} must be an integer, got {value!r}")
+            elif isinstance(default, float):
+                if isinstance(value, bool) or \
+                        not isinstance(value, (int, float)):
+                    raise ValidationError(
+                        f"{self.name}.{key} must be a number, got {value!r}")
+            elif isinstance(default, str) and not isinstance(value, str):
+                raise ValidationError(
+                    f"{self.name}.{key} must be a string, got {value!r}")
+            if key in self.secret_keys and value == MASK:
+                continue   # mask means "unchanged"; config keeps supplying
+            stored[key] = value
+        self.validate_effective({**self.effective(), **stored})
+        try:
+            row = self.repos.settings.get_by_name(self.name)
+        except NotFoundError:
+            row = Setting(name=self.name)
+        row.vars = stored
+        self.repos.settings.save(row)
+        return self.get_public()
+
+    def validate_effective(self, merged: dict) -> None:
+        """Subclass hook: cross-key checks over the would-be effective
+        document (port ranges, URL schemes) — raise ValidationError."""

@@ -63,7 +63,7 @@ const I18N = {
     newest: "newest",
     catalog_load_failed: "Could not load — try again.",
     notify_settings: "Message center", notify_edit: "Configure channels",
-    enabled: "enabled",
+    enabled: "enabled", ldap_edit: "Configure",
     change_password: "Change password", old_password: "Current password",
     new_password: "New password", confirm_password: "Confirm new password",
     password_mismatch: "passwords do not match",
@@ -118,7 +118,7 @@ const I18N = {
     newest: "最新",
     catalog_load_failed: "加载失败，请重试。",
     notify_settings: "消息中心", notify_edit: "配置通知渠道",
-    enabled: "启用",
+    enabled: "启用", ldap_edit: "配置",
     change_password: "修改密码", old_password: "当前密码",
     new_password: "新密码", confirm_password: "确认新密码",
     password_mismatch: "两次输入的密码不一致",
@@ -1048,6 +1048,43 @@ for (const ch of ["smtp", "webhook"]) {
     $("#notify-out").textContent = r.ok ? `${ch} ✓` : `${ch}: ${r.error}`;
   });
 }
+$("#ldap-edit-btn").addEventListener("click", async () => {
+  const s = await api("GET", "/api/v1/settings/ldap").catch(() => null);
+  if (!s) { alert(t("catalog_load_failed")); return; }
+  objDialog("ldap_edit", [
+    { key: "enabled", label: t("enabled"), type: "checkbox",
+      value: s.enabled },
+    { key: "host", label: "Host", value: s.host },
+    { key: "port", label: "Port", value: s.port },
+    { key: "ssl", label: "LDAPS", type: "checkbox", value: s.ssl },
+    { key: "manager_dn", label: "Manager DN", value: s.manager_dn,
+      placeholder: "cn=admin,dc=example,dc=org" },
+    { key: "manager_password", label: "Manager password", type: "password",
+      value: s.manager_password },
+    { key: "base_dn", label: "Base DN", value: s.base_dn,
+      placeholder: "ou=people,dc=example,dc=org" },
+    { key: "username_attr", label: "Username attribute",
+      value: s.username_attr },
+    { key: "email_attr", label: "Email attribute", value: s.email_attr },
+  ], (out) => {
+    // diff-only PUT: same overrides-only discipline as the notify dialog
+    const next = {
+      enabled: out.enabled, host: out.host.trim(),
+      port: parseInt(out.port, 10) || 0, ssl: out.ssl,
+      manager_dn: out.manager_dn.trim(),
+      manager_password: out.manager_password,
+      base_dn: out.base_dn.trim(),
+      username_attr: out.username_attr.trim(),
+      email_attr: out.email_attr.trim(),
+    };
+    const body = {};
+    for (const k of Object.keys(next)) {
+      if (next[k] !== s[k]) body[k] = next[k];
+    }
+    if (!Object.keys(body).length) return Promise.resolve();
+    return api("PUT", "/api/v1/settings/ldap", body);
+  });
+});
 $("#ldap-sync-btn").addEventListener("click", async () => {
   const r = await api("POST", "/api/v1/ldap/sync").catch((e) => ({ error: e.message }));
   $("#ldap-out").textContent = r.error ||

@@ -556,3 +556,25 @@ class TestPasswordChange:
             "username": "pat", "password": "password1"}).status_code == 401
         assert requests.post(f"{base}/api/v1/auth/login", json={
             "username": "pat", "password": "password2"}).status_code == 200
+
+
+class TestLdapSettingsApi:
+    def test_admin_guarded_and_masked(self, client):
+        base, http, services = client
+        s = http.get(f"{base}/api/v1/settings/ldap").json()
+        assert s["enabled"] is False and s["username_attr"] == "uid"
+        r = http.put(f"{base}/api/v1/settings/ldap", json={
+            "host": "ldap.local", "manager_password": "s3cret"})
+        assert r.status_code == 200
+        assert r.json()["manager_password"] == "********"
+        assert r.json()["host"] == "ldap.local"
+        assert http.put(f"{base}/api/v1/settings/ldap", json={
+            "port": "389"}).status_code == 400
+
+        import requests as _rq
+        services.users.create("lou", password="password1")
+        lou = _rq.Session()
+        token = lou.post(f"{base}/api/v1/auth/login", json={
+            "username": "lou", "password": "password1"}).json()["token"]
+        lou.headers["Authorization"] = f"Bearer {token}"
+        assert lou.get(f"{base}/api/v1/settings/ldap").status_code == 403

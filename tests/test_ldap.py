@@ -271,3 +271,70 @@ class TestLdapService:
                 users.login("carol", "whatever")
         finally:
             db.close()
+
+
+class TestLdapRuntimeSettings:
+    """Directory settings are runtime-editable (OverlaySettings): a fresh
+    install can be pointed at a directory entirely through the API, the
+    stored row holds ONLY overrides, and secrets mask on read."""
+
+    def test_configure_at_runtime_without_config_file(self, directory,
+                                                      tmp_path):
+        config = load_config(path="/nonexistent", env={}, overrides={
+            "db": {"path": str(tmp_path / "rt.db")}})
+        db = Database(config.get("db.path"))
+        try:
+            repos = Repositories(db)
+            service = LdapService(repos, config)
+            assert service.enabled is False
+            service.settings.update({
+                "enabled": True, "host": "127.0.0.1",
+                "port": directory.port, "manager_dn": MANAGER_DN,
+                "manager_password": MANAGER_PW, "base_dn": BASE_DN})
+            assert service.enabled is True
+            report = service.test_connection()
+            assert report["ok"] and report["users_sampled"] == 2
+            # secrets mask on read; mask round-trips as "unchanged"
+            public = service.settings.get_public()
+            assert public["manager_password"] == "********"
+            service.settings.update({"manager_password": "********",
+                                     "username_attr": "uid"})
+            assert service.test_connection()["ok"]
+        finally:
+            db.close()
+
+    def test_overrides_win_over_config_and_stay_minimal(self, directory,
+                                                        tmp_path):
+        config = ldap_config(directory, tmp_path)
+        db = Database(config.get("db.path"))
+        try:
+            repos = Repositories(db)
+            service = LdapService(repos, config)
+            # config tier supplies everything; one override flips a knob
+            service.settings.update({"email_attr": "mailPrimary"})
+            stored = repos.settings.get_by_name("ldap").vars
+            assert stored == {"email_attr": "mailPrimary"}  # overrides ONLY
+            assert service.settings.effective()["manager_password"] == \
+                MANAGER_PW   # config tier intact, not frozen into the DB
+        finally:
+            db.close()
+
+    def test_validation(self, directory, tmp_path):
+        from kubeoperator_tpu.utils.errors import ValidationError
+        config = load_config(path="/nonexistent", env={}, overrides={
+            "db": {"path": str(tmp_path / "rv.db")}})
+        db = Database(config.get("db.path"))
+        try:
+            service = LdapService(Repositories(db), config)
+            with pytest.raises(ValidationError, match="unknown ldap"):
+                service.settings.update({"hots": "x"})
+            with pytest.raises(ValidationError, match="must be an integer"):
+                service.settings.update({"port": "389"})
+            with pytest.raises(ValidationError, match="must be a boolean"):
+                service.settings.update({"ssl": "yes"})
+            with pytest.raises(ValidationError, match="requires a host"):
+                service.settings.update({"enabled": True})
+            with pytest.raises(ValidationError, match="ldap.port"):
+                service.settings.update({"host": "h", "port": 0})
+        finally:
+            db.close()
