@@ -417,6 +417,13 @@ class TestConsoleSurface:
         index = session.get(f"{base}/").text
         assert "host-filter" in index and "host-pager" in index
         assert "event-pager" in index and "event-pulse" in index
+        # r3 admin surfaces: runtime settings dialogs + password change
+        for el in ("notify-edit-btn", "notify-test-smtp", "ldap-edit-btn",
+                   "passwd-btn"):
+            assert el in index, el
+        for route in ("/api/v1/settings/notify", "/api/v1/settings/ldap",
+                      "/api/v1/auth/password", "/api/v1/providers-catalog"):
+            assert route in app_js, route
 
 
 class TestGlobalEvents:
