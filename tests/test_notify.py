@@ -325,3 +325,41 @@ class TestNotifyOverrideStorage:
         headers = svc.messages.senders["webhook"].headers
         assert "Authorization" not in headers
         assert headers["X-Extra"] == "v"
+
+
+class TestSettingsConcurrency:
+    def test_concurrent_updates_lose_nothing(self, repos):
+        """Barrier-started admin PUT storm: every writer's override must
+        survive (the read-modify-write is lock-serialized; without it,
+        writers overwrite each other's snapshots)."""
+        svc = TestNotifyOverrideStorage._svc(None, repos)
+
+        n = 8
+        barrier = threading.Barrier(n)
+        errors = []
+
+        def writer(i):
+            try:
+                barrier.wait()
+                if i % 2 == 0:
+                    svc.update({"smtp": {"host": f"m{i}.local"}})
+                else:
+                    svc.update({"webhook": {
+                        "headers": {f"X-H{i}": f"v{i}"}}})
+            except Exception as e:   # pragma: no cover - failure detail
+                errors.append(e)
+
+        threads = [threading.Thread(target=writer, args=(i,))
+                   for i in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+        eff = svc.effective()
+        # one of the smtp hosts won (last-writer-wins per KEY is fine)...
+        assert eff["smtp"]["host"].endswith(".local")
+        # ...but every header override survived — none was dropped by a
+        # concurrent writer's stale snapshot
+        for i in (1, 3, 5, 7):
+            assert eff["webhook"]["headers"].get(f"X-H{i}") == f"v{i}", i
