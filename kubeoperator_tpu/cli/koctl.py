@@ -222,6 +222,14 @@ class LocalClient:
                 return pub(s.backups.list_accounts())
             case ("POST", ["backup-accounts", name, "test"]):
                 return s.backups.test_account(name)
+            case ("GET", ["settings", "ldap"]):
+                return s.ldap.settings.get_public()
+            case ("PUT", ["settings", "ldap"]):
+                return s.ldap.settings.update(body)
+            case ("POST", ["ldap", "test"]):
+                return s.ldap.test_connection()
+            case ("POST", ["ldap", "sync"]):
+                return s.ldap.sync_users()
             case ("GET", ["settings", "notify"]):
                 return s.notify_settings.get_public()
             case ("PUT", ["settings", "notify"]):
@@ -438,6 +446,53 @@ def cmd_component(client, args) -> int:
     raise SystemExit(f"unknown component command {args.component_cmd}")
 
 
+def _coerce_by_default(key: str, raw: str, default) -> object:
+    """CLI key=value coercion by the DECLARED default's type (bool before
+    int: bool subclasses int) — shared by the settings verbs so the typed
+    contract cannot drift between them. Unknown keys pass through as
+    strings; the server rejects them with the field named."""
+    if isinstance(default, bool):
+        if raw.lower() not in ("true", "false"):
+            raise SystemExit(f"error: {key} expects true/false, got {raw!r}")
+        return raw.lower() == "true"
+    if isinstance(default, float):
+        try:
+            return float(raw)
+        except ValueError:
+            raise SystemExit(f"error: {key} expects a number, got {raw!r}")
+    if isinstance(default, int):
+        try:
+            return int(raw)
+        except ValueError:
+            raise SystemExit(f"error: {key} expects an integer, got {raw!r}")
+    return raw
+
+
+def cmd_ldap(client, args) -> int:
+    """Directory verbs: show / set key=value... / test / sync — the CLI
+    face of the console's LDAP admin panel."""
+    if args.ldap_cmd == "show":
+        _print(client.call("GET", "/api/v1/settings/ldap"))
+        return 0
+    if args.ldap_cmd == "set":
+        from kubeoperator_tpu.service.ldap import LDAP_DEFAULTS
+
+        body: dict = {}
+        for pair in args.values:
+            key, sep, raw = pair.partition("=")
+            if not sep:
+                raise SystemExit(f"error: expected key=value, got {pair!r}")
+            body[key] = _coerce_by_default(key, raw, LDAP_DEFAULTS.get(key))
+        _print(client.call("PUT", "/api/v1/settings/ldap", body))
+        return 0
+    if args.ldap_cmd == "sync":
+        _print(client.call("POST", "/api/v1/ldap/sync"))
+        return 0
+    result = client.call("POST", "/api/v1/ldap/test")
+    _print(result)
+    return 0 if result.get("ok") else 1
+
+
 def cmd_notify(client, args) -> int:
     """Message-center channel verbs: show / set channel.key=value... /
     test <channel> — mirror of the console admin panel."""
@@ -457,20 +512,8 @@ def cmd_notify(client, args) -> int:
             if not sep or not dot:
                 raise SystemExit(
                     f"error: expected channel.key=value, got {pair!r}")
-            default = NOTIFY_DEFAULTS.get(channel, {}).get(setting)
-            value: object = raw
-            if isinstance(default, bool):
-                if raw.lower() not in ("true", "false"):
-                    raise SystemExit(
-                        f"error: {key} expects true/false, got {raw!r}")
-                value = raw.lower() == "true"
-            elif isinstance(default, int):
-                try:
-                    value = int(raw)
-                except ValueError:
-                    raise SystemExit(
-                        f"error: {key} expects an integer, got {raw!r}")
-            body.setdefault(channel, {})[setting] = value
+            body.setdefault(channel, {})[setting] = _coerce_by_default(
+                key, raw, NOTIFY_DEFAULTS.get(channel, {}).get(setting))
         _print(client.call("PUT", "/api/v1/settings/notify", body))
         return 0
     result = client.call("POST", "/api/v1/settings/notify/test",
@@ -692,6 +735,15 @@ def build_parser() -> argparse.ArgumentParser:
     )
     ba_test.add_argument("name")
 
+    ldap_p = sub.add_parser("ldap", help="directory integration verbs")
+    lsub = ldap_p.add_subparsers(dest="ldap_cmd", required=True)
+    lsub.add_parser("show")
+    l_set = lsub.add_parser(
+        "set", help="e.g. enabled=true host=ldap.example.org")
+    l_set.add_argument("values", nargs="+", metavar="key=value")
+    lsub.add_parser("test", help="manager bind + base search probe")
+    lsub.add_parser("sync", help="import directory users")
+
     notify = sub.add_parser("notify", help="message-center channel verbs")
     nsub = notify.add_subparsers(dest="notify_cmd", required=True)
     nsub.add_parser("show")
@@ -802,6 +854,8 @@ def main(argv: list[str] | None = None) -> int:
         )
         _print(result)
         return 0 if result.get("ok") else 1
+    if args.cmd == "ldap":
+        return cmd_ldap(client, args)
     if args.cmd == "notify":
         return cmd_notify(client, args)
     if args.cmd == "tpu":

@@ -585,3 +585,36 @@ class TestLdapSettingsApi:
             "username": "lou", "password": "password1"}).json()["token"]
         lou.headers["Authorization"] = f"Bearer {token}"
         assert lou.get(f"{base}/api/v1/settings/ldap").status_code == 403
+
+
+class TestKoctlLdap:
+    def test_configure_and_probe_a_real_directory(self, capsys, monkeypatch,
+                                                  tmp_path):
+        """Full CLI path against the in-process LDAP server: configure at
+        runtime, probe, sync — no config file involved."""
+        from kubeoperator_tpu.cli import koctl
+        from tests.test_ldap import (
+            BASE_DN, MANAGER_DN, MANAGER_PW, FakeLdapServer)
+
+        monkeypatch.setenv("KO_TPU_DB__PATH", str(tmp_path / "lc.db"))
+        monkeypatch.setenv("KO_TPU_EXECUTOR__BACKEND", "simulation")
+        monkeypatch.setenv("KO_TPU_PROVISIONER__WORK_DIR",
+                           str(tmp_path / "tf"))
+        server = FakeLdapServer()
+        try:
+            assert koctl.main([
+                "--local", "ldap", "set", "enabled=true", "host=127.0.0.1",
+                f"port={server.port}", f"manager_dn={MANAGER_DN}",
+                f"manager_password={MANAGER_PW}", f"base_dn={BASE_DN}"]) == 0
+            out = capsys.readouterr().out
+            assert '"enabled": true' in out
+            assert MANAGER_PW not in out          # masked on read
+            assert koctl.main(["--local", "ldap", "test"]) == 0
+            assert '"users_sampled": 2' in capsys.readouterr().out
+            assert koctl.main(["--local", "ldap", "sync"]) == 0
+            assert '"created": 2' in capsys.readouterr().out
+        finally:
+            server.close()
+        # typed coercion errors die with a clear message
+        with pytest.raises(SystemExit, match="expects an integer"):
+            koctl.main(["--local", "ldap", "set", "port=abc"])
