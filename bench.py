@@ -21,19 +21,6 @@ import json
 import sys
 
 
-def _generation_for_device(dev) -> str:
-    kind = getattr(dev, "device_kind", "").lower()
-    if "v5 lite" in kind or "v5e" in kind or "v5litepod" in kind:
-        return "v5e"
-    if "v5p" in kind or "v5" in kind:
-        return "v5p"
-    if "v6" in kind or "trillium" in kind:
-        return "v6e"
-    if "v4" in kind:
-        return "v4"
-    return ""
-
-
 def main() -> int:
     import jax
 
@@ -44,12 +31,12 @@ def main() -> int:
     from kubeoperator_tpu.ops.hbm import hbm_bandwidth_gbps
     from kubeoperator_tpu.ops.matmul import mxu_matmul_tflops
     from kubeoperator_tpu.parallel.mesh import flat_axis_mesh
-    from kubeoperator_tpu.parallel.topology import GENERATIONS
+    from kubeoperator_tpu.parallel.topology import generation_for_device
 
     devices = jax.devices()
     n = len(devices)
-    gen_name = _generation_for_device(devices[0])
-    if not gen_name:
+    gen = generation_for_device(devices[0])
+    if gen is None:
         # No recognizable TPU: refuse to fabricate a TPU health number
         # (e.g. silent CPU fallback when the tunnel fails to register).
         print(json.dumps({
@@ -61,7 +48,6 @@ def main() -> int:
                                                str(devices[0]))},
         }), flush=True)
         return 1
-    gen = GENERATIONS[gen_name]
     details: dict = {
         "devices": n,
         "device_kind": getattr(devices[0], "device_kind", str(devices[0])),

@@ -601,6 +601,19 @@ def cmd_tpu_diag(args) -> int:
     with profile:
         report["mxu"] = ops.mxu_matmul_tflops(
             size=args.size, iters=args.iters).to_dict()
+        # honesty guard: a short device-time window behind the TPU relay
+        # can read ABOVE datasheet peak (differential timing cancels
+        # constant RTT, not its jitter) — a physically impossible number
+        # must carry a flag, not masquerade as a healthy chip
+        from kubeoperator_tpu.parallel.topology import generation_for_device
+
+        gen = generation_for_device(devices[0])
+        if gen is not None and report["mxu"]["tflops"] > \
+                gen.bf16_tflops_per_chip * 1.05:
+            report["mxu"]["suspect_short_window"] = (
+                f"reading exceeds the {gen.name} datasheet peak "
+                f"({gen.bf16_tflops_per_chip} TFLOP/s); increase --iters "
+                "until device time dominates relay jitter")
         report["hbm_triad"] = ops.hbm_bandwidth_gbps().to_dict()
         report["dma_read"] = ops.dma_read_bandwidth_gbps().to_dict()
         if len(devices) >= 2:

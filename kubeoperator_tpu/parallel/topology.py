@@ -347,3 +347,19 @@ def parse_accelerator_type(
     )
     topo.validate()
     return topo
+
+
+def generation_for_device(dev) -> TpuGeneration | None:
+    """Map a jax.Device to its generation registry entry by device_kind —
+    shared by bench.py's metric selection and `koctl tpu diag`'s
+    datasheet honesty guard. None for unrecognized/CPU devices."""
+    kind = getattr(dev, "device_kind", "").lower()
+    if "v5 lite" in kind or "v5e" in kind or "v5litepod" in kind:
+        return GENERATIONS["v5e"]
+    if "v5p" in kind or "v5" in kind:
+        return GENERATIONS["v5p"]
+    if "v6" in kind or "trillium" in kind:
+        return GENERATIONS["v6e"]
+    if "v4" in kind:
+        return GENERATIONS["v4"]
+    return None

@@ -200,8 +200,11 @@ class TestBenchScriptMultiDevice:
         bench = importlib.util.module_from_spec(spec)
         spec.loader.exec_module(bench)
 
-        monkeypatch.setattr(bench, "_generation_for_device",
-                            lambda dev: "v5e")
+        import kubeoperator_tpu.parallel.topology as topo
+        # main() imports generation_for_device at call time, so patching
+        # the topology module attribute redirects it
+        monkeypatch.setattr(topo, "generation_for_device",
+                            lambda dev: topo.GENERATIONS["v5e"])
         monkeypatch.setattr(
             coll, "bench_collective",
             lambda op, size_mb, mesh, iters: SimpleNamespace(

@@ -89,3 +89,21 @@ def test_registry_sanity():
         assert gen.chips_per_host == 4        # all supported gens: 4-chip hosts
         assert gen.bf16_tflops_per_chip > 0
         assert gen.suffix_unit in ("chips", "cores")
+
+
+class TestGenerationForDevice:
+    def test_device_kind_mapping(self):
+        from types import SimpleNamespace
+
+        from kubeoperator_tpu.parallel.topology import generation_for_device
+
+        cases = {"TPU v5 lite": "v5e", "TPU v5litepod": "v5e",
+                 "TPU v5p chip": "v5p", "TPU v5": "v5p",
+                 "TPU v6e": "v6e", "trillium": "v6e", "TPU v4": "v4"}
+        for kind, want in cases.items():
+            gen = generation_for_device(SimpleNamespace(device_kind=kind))
+            assert gen is not None and gen.name == want, kind
+        # CPU / unknown: None — callers must refuse to fabricate numbers
+        assert generation_for_device(
+            SimpleNamespace(device_kind="cpu")) is None
+        assert generation_for_device(object()) is None
