@@ -608,8 +608,15 @@ def cmd_tpu_diag(args) -> int:
         from kubeoperator_tpu.parallel.topology import generation_for_device
 
         gen = generation_for_device(devices[0])
-        if gen is not None and report["mxu"]["tflops"] > \
-                gen.bf16_tflops_per_chip * 1.05:
+        if gen is None:
+            # silent CPU fallback (tunnel failed to register) or an
+            # unrecognized device: these are NOT TPU health numbers —
+            # same refusal bench.py makes, flagged rather than fatal
+            # since diag is also useful for eyeballing CI hosts
+            report["not_a_tpu"] = (
+                f"device kind {report['device_kind']!r} is not a known "
+                "TPU generation; readings are not chip health numbers")
+        elif report["mxu"]["tflops"] > gen.bf16_tflops_per_chip * 1.05:
             report["mxu"]["suspect_short_window"] = (
                 f"reading exceeds the {gen.name} datasheet peak "
                 f"({gen.bf16_tflops_per_chip} TFLOP/s); increase --iters "

@@ -331,6 +331,45 @@ class TestKoctlTpuDiag:
         assert report["pallas_ring"]["busbw_gbps"] == 4.0
         assert report["ring_attention_correct"] is True
         assert report["ring_attention"]["tflops"] == 5.0
+        # honesty guards: CPU devices are flagged as not-a-TPU (bench.py's
+        # refusal, as a flag) and no suspect_short_window is fabricated
+        assert "not_a_tpu" in report
+        assert "suspect_short_window" not in report["mxu"]
+
+    def test_diag_flags_impossible_readings(self, capsys, monkeypatch):
+        """A reading above the generation's datasheet peak must carry the
+        suspect flag — a short device-time window behind the relay can
+        produce physically impossible numbers."""
+        import json as _json
+        from types import SimpleNamespace
+
+        import kubeoperator_tpu.parallel.topology as topo
+        from kubeoperator_tpu import ops
+        from kubeoperator_tpu.cli import koctl
+
+        def fake(**fields):
+            return SimpleNamespace(to_dict=lambda: dict(fields))
+
+        monkeypatch.setattr(ops, "mxu_matmul_tflops",
+                            lambda **kw: fake(tflops=271.0))
+        monkeypatch.setattr(ops, "hbm_bandwidth_gbps",
+                            lambda **kw: fake(gbps=2.0))
+        monkeypatch.setattr(ops, "dma_read_bandwidth_gbps",
+                            lambda **kw: fake(gbps=3.0))
+        monkeypatch.setattr(ops, "run_collective_suite", lambda **kw: [])
+        monkeypatch.setattr(ops, "verify_ring_all_gather", lambda **kw: True)
+        monkeypatch.setattr(ops, "bench_ring_all_gather",
+                            lambda **kw: fake(busbw_gbps=4.0))
+        monkeypatch.setattr(ops, "verify_ring_attention", lambda **kw: True)
+        monkeypatch.setattr(ops, "bench_ring_attention",
+                            lambda **kw: fake(tflops=5.0))
+        monkeypatch.setattr(topo, "generation_for_device",
+                            lambda dev: topo.GENERATIONS["v5e"])
+
+        assert koctl.main(["tpu", "diag"]) == 0
+        report = _json.loads(capsys.readouterr().out)
+        assert "datasheet peak" in report["mxu"]["suspect_short_window"]
+        assert "not_a_tpu" not in report
 
 
 class TestBackupAccountTest:

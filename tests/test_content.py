@@ -571,18 +571,15 @@ def test_haproxy_is_tcp_passthrough_with_tracked_vip():
 
 
 def test_master_upgrade_drains_and_uncordons():
-    """Serial master upgrade follows the drain -> upgrade -> Ready ->
-    uncordon discipline the worker path already had."""
+    """Serial master upgrade follows the evict -> upgrade -> Ready ->
+    uncordon discipline (eviction via the shared chain, which carries the
+    ADVICE-r2 unmanaged-pod --force fallback)."""
     role = open(os.path.join(CONTENT, "roles/upgrade-master/tasks/main.yml"),
                 encoding="utf-8").read()
-    assert role.index("drain master before upgrade") \
+    assert role.index("evict pods from this master") \
         < role.index("kubeadm upgrade apply")
     assert role.index("wait for master Ready again") \
         < role.index("uncordon master")
-    # ADVICE r2: an unmanaged pod on the master must not abort the upgrade
-    # before anything changed — drain carries --force
-    drain_block = role[role.index("drain master"):role.index("unhold kube")]
-    assert "--force" in drain_block
 
 
 def test_containerd_runc_runtime_type_declared():
@@ -1029,3 +1026,26 @@ def test_worker_upgrade_uses_the_shared_eviction_chain():
     lines = "\n".join(ex.watch(tid, timeout_s=5))
     assert "drain leaving node (respecting disruption budgets)" in lines
     assert "TASK [kubeadm upgrade node]" in lines
+
+
+def test_master_upgrade_uses_the_shared_eviction_chain():
+    """All three eviction sites (scale-down, worker upgrade, master
+    upgrade) include the ONE chain; the master variant delegates kubectl
+    to ITSELF — every master carries admin.conf, and the first inventory
+    master may be the one mid-upgrade."""
+    tasks = _role_tasks("upgrade-master")
+    names = [t["name"] for t in tasks]
+    include = tasks[names.index("evict pods from this master")]
+    assert "drain/tasks/evict.yml" in str(include)
+    assert include["vars"]["drain_delegate"] == "{{ inventory_hostname }}"
+    for t in tasks:
+        assert " drain" not in str(t.get("ansible.builtin.command", "")), \
+            t["name"]
+    # the simulated master upgrade stream shows the chain expanding
+    ex = SimulationExecutor()
+    inv, ev = _network_extra_vars()
+    ev.update({"ko_simulation": True, "target_k8s_version": "v1.30.6"})
+    tid = ex.run_playbook("21-upgrade-masters.yml", inv, ev)
+    assert ex.wait(tid, timeout_s=30).ok
+    lines = "\n".join(ex.watch(tid, timeout_s=5))
+    assert "drain leaving node (respecting disruption budgets)" in lines
