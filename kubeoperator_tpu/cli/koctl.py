@@ -307,6 +307,14 @@ def cmd_cluster(client, args) -> int:
             spec["k8s_version"] = args.k8s_version
         if args.workers is not None:
             spec["worker_count"] = args.workers
+        for flag, key in (("cni", "cni"), ("runtime", "runtime"),
+                          ("kube_proxy_mode", "kube_proxy_mode"),
+                          ("ingress", "ingress")):
+            value = getattr(args, flag)
+            if value:
+                spec[key] = value
+        if args.no_nodelocaldns:
+            spec["nodelocaldns_enabled"] = False
         if spec:
             body["spec"] = spec
         client.call("POST", "/api/v1/clusters", body)
@@ -680,6 +688,18 @@ def build_parser() -> argparse.ArgumentParser:
     create.add_argument("--credential", default="")
     create.add_argument("--k8s-version", default="")
     create.add_argument("--workers", type=int, default=None)
+    # the wizard's advanced spec knobs, argparse-enum'd to the same values
+    # ClusterSpec.validate accepts (a typo dies in the parser, not a 400)
+    create.add_argument("--cni", default="",
+                        choices=["", "calico", "flannel", "cilium"])
+    create.add_argument("--runtime", default="",
+                        choices=["", "containerd", "docker"])
+    create.add_argument("--kube-proxy-mode", default="",
+                        choices=["", "iptables", "ipvs"])
+    create.add_argument("--ingress", default="",
+                        choices=["", "nginx", "traefik", "none"])
+    create.add_argument("--no-nodelocaldns", action="store_true",
+                        help="skip the per-node DNS cache DaemonSet")
     create.add_argument("--no-wait", action="store_true")
     create.add_argument("--quiet", action="store_true")
     create.add_argument("--timeout", type=float, default=3600.0)

@@ -657,3 +657,39 @@ class TestKoctlLdap:
         # typed coercion errors die with a clear message
         with pytest.raises(SystemExit, match="expects an integer"):
             koctl.main(["--local", "ldap", "set", "port=abc"])
+
+
+class TestKoctlSpecKnobs:
+    def test_create_threads_advanced_spec_flags(self, capsys, monkeypatch,
+                                                tmp_path):
+        """CLI parity with the wizard's advanced knobs: the flags thread
+        into ClusterSpec and the deployed content reflects them (ipvs
+        module load in the simulated stream)."""
+        from kubeoperator_tpu.cli import koctl
+
+        monkeypatch.setenv("KO_TPU_DB__PATH", str(tmp_path / "sk.db"))
+        monkeypatch.setenv("KO_TPU_EXECUTOR__BACKEND", "simulation")
+        monkeypatch.setenv("KO_TPU_PROVISIONER__WORK_DIR",
+                           str(tmp_path / "tf"))
+        setup = tmp_path / "setup.yaml"
+        setup.write_text(
+            "credentials:\n  - name: ssh\n    password: pw\n"
+            "hosts:\n" + "".join(
+                f"  - name: k{i}\n    ip: 10.4.0.{i+1}\n    credential: ssh\n"
+                for i in range(3)))
+        assert koctl.main(["--local", "apply", "-f", str(setup)]) == 0
+        capsys.readouterr()
+        assert koctl.main([
+            "--local", "cluster", "create", "knobs", "--hosts", "k0,k1,k2",
+            "--workers", "2", "--cni", "cilium", "--kube-proxy-mode", "ipvs",
+            "--ingress", "none", "--no-nodelocaldns", "--quiet"]) == 0
+        capsys.readouterr()
+        assert koctl.main(["--local", "cluster", "logs", "knobs"]) == 0
+        logs = capsys.readouterr().out
+        assert "load ipvs kernel modules" in logs        # ipvs threaded
+        assert "install cilium via bundled chart" in logs  # cni threaded
+        assert "apply nodelocaldns" not in logs             # knob off
+        # the parser itself rejects typo'd enums (exit 2, no request made)
+        with pytest.raises(SystemExit):
+            koctl.main(["--local", "cluster", "create", "x",
+                        "--cni", "weave"])
