@@ -124,9 +124,11 @@ class TerraformProvisioner:
         work_dir: str = "terraform_runs",
         terraform_bin: str = "terraform",
         templates_dir: str = TEMPLATES_DIR,
+        timeout_s: float = 3600,
     ) -> None:
         self.work_dir = work_dir
         self.terraform_bin = terraform_bin
+        self.timeout_s = timeout_s
         self.env = jinja2.Environment(
             loader=jinja2.FileSystemLoader(templates_dir),
             undefined=jinja2.StrictUndefined,
@@ -181,11 +183,12 @@ class TerraformProvisioner:
         cmd = [self.terraform_bin, *args]
         try:
             proc = subprocess.run(
-                cmd, cwd=cluster_dir, capture_output=True, text=True, timeout=3600
+                cmd, cwd=cluster_dir, capture_output=True, text=True,
+                timeout=self.timeout_s,
             )
         except subprocess.TimeoutExpired as e:
             raise ProvisionerError(
-                message=f"{' '.join(cmd)} timed out after 3600s"
+                message=f"{' '.join(cmd)} timed out after {self.timeout_s:g}s"
             ) from e
         if proc.returncode != 0:
             raise ProvisionerError(

@@ -108,8 +108,19 @@ def build_services(
             config.get("provisioner.terraform_bin", "terraform")
         )
     prov_cls = FakeProvisioner if simulate else TerraformProvisioner
+    raw_timeout = config.get("provisioner.timeout_s", 3600)
+    try:
+        timeout_s = float(raw_timeout)
+    except (TypeError, ValueError):
+        from kubeoperator_tpu.utils.errors import ValidationError
+
+        raise ValidationError(
+            f"provisioner.timeout_s must be a number of seconds, "
+            f"got {raw_timeout!r}"
+        )
     provisioner = prov_cls(
         work_dir=config.get("provisioner.work_dir", "terraform_runs"),
         terraform_bin=config.get("provisioner.terraform_bin", "terraform"),
+        timeout_s=timeout_s,
     )
     return Services(config, repos, executor, provisioner)

@@ -41,6 +41,7 @@ DEFAULTS: dict[str, Any] = {
     "provisioner": {
         "terraform_bin": "terraform",
         "work_dir": "terraform_runs",
+        "timeout_s": 3600,
     },
     "registry": {
         # nexus-equivalent offline artifact registry (SURVEY.md §1 "Offline

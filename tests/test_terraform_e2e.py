@@ -1,0 +1,278 @@
+"""The terraform subprocess boundary, executed end-to-end (VERDICT r3 #1).
+
+`TerraformProvisioner._run/apply/outputs/destroy` is the second of the two
+process boundaries that ever touch the real world (SURVEY.md §3.1 "PROCESS
+BOUNDARY → cloud API"); until this file it had never executed anywhere. The
+tests run it unskipped against `tests/shims/terraform` — a PATH-shimmed
+binary that validates argv/workdir the way real terraform would, requires
+the rendered main.tf to parse as HCL (utils/hcl.py) and the module-relative
+`file()` references to resolve, keeps real init/apply/state lifecycle rules
+(apply refuses to run uninitialized), and replays realistic transcripts
+including an apply quota failure and a hang. Service-level tests drive
+plan-mode ClusterService create/retry/delete through the REAL
+TerraformProvisioner (not the Fake) across this boundary.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+from kubeoperator_tpu.models import ClusterSpec, Plan, Region, Zone
+from kubeoperator_tpu.provisioner import TerraformProvisioner
+from kubeoperator_tpu.service import build_services
+from kubeoperator_tpu.utils.config import load_config
+from kubeoperator_tpu.utils.errors import ProvisionerError
+
+SHIM_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "shims")
+
+
+@pytest.fixture
+def shimmed_terraform(monkeypatch, tmp_path):
+    """Prepend the fake terraform binary to PATH and capture its
+    invocations. Returns a helper that reads back the captured call
+    sequence (one JSON record per process fork)."""
+    capture = tmp_path / "tf_capture.jsonl"
+    monkeypatch.setenv("PATH", SHIM_DIR + os.pathsep + os.environ["PATH"])
+    monkeypatch.setenv("KO_SHIM_TF_CAPTURE", str(capture))
+    monkeypatch.delenv("KO_SHIM_TF_SCENARIO", raising=False)
+
+    def read_capture():
+        if not capture.exists():
+            return []
+        with open(capture, encoding="utf-8") as f:
+            return [json.loads(line) for line in f if line.strip()]
+
+    return read_capture
+
+
+def gcp_objects():
+    region = Region(name="gcp-us-central1", provider="gcp_tpu_vm",
+                    vars={"project": "ko-tpu-proj", "name": "us-central1"})
+    zone = Zone(name="us-central1-a", region_id=region.id,
+                vars={"gcp_zone": "us-central1-a"})
+    plan = Plan(name="tpu-v5e-16", provider="gcp_tpu_vm", region_id=region.id,
+                zone_ids=[zone.id], accelerator="tpu", tpu_type="v5e-16",
+                worker_count=0, master_count=1,
+                vars={"ssh_user": "ubuntu", "ssh_public_key": "ssh-ed25519 A"})
+    return plan, region, zone
+
+
+class TestProvisionerLifecycleE2E:
+    """The subprocess methods themselves, against the shimmed binary."""
+
+    def test_full_lifecycle_init_apply_outputs_destroy(
+        self, shimmed_terraform, tmp_path
+    ):
+        plan, region, zone = gcp_objects()
+        prov = TerraformProvisioner(work_dir=str(tmp_path / "tf"))
+        cluster_dir = prov.render("northstar", plan, region, [zone])
+
+        prov.apply(cluster_dir)
+        # init left real on-disk state; apply wrote a version-4 tfstate
+        assert os.path.isdir(os.path.join(cluster_dir, ".terraform"))
+        with open(os.path.join(cluster_dir, "terraform.tfstate")) as f:
+            state = json.load(f)
+        assert state["version"] == 4
+
+        outputs = prov.outputs(cluster_dir)
+        # outputs rode the real `output -json` {name: {value,...}} contract
+        assert len(outputs["master_ips"]) == 1
+        assert set(outputs["tpu_endpoints"]) == {"0"}
+        assert len(outputs["tpu_endpoints"]["0"]) == 4  # v5e-16: 4 hosts
+
+        hosts = prov.hosts_from_outputs(outputs, plan, "northstar")
+        tpu_hosts = [h for h in hosts if h.tpu_chips > 0]
+        assert len(hosts) == 5 and len(tpu_hosts) == 4
+        assert sorted(h.tpu_worker_id for h in tpu_hosts) == [0, 1, 2, 3]
+
+        prov.destroy(cluster_dir)
+        calls = [c["subcommand"] for c in shimmed_terraform()]
+        # apply() = init+apply; outputs() = output; destroy() = init+destroy
+        assert calls == ["init", "apply", "output", "init", "destroy"]
+        assert prov.outputs(cluster_dir) == {}  # destroyed state is empty
+
+    def test_apply_without_init_refused_at_boundary(
+        self, shimmed_terraform, tmp_path
+    ):
+        """The shim enforces real terraform's init-before-apply rule, so a
+        provisioner regression that drops the init call fails loudly."""
+        plan, region, zone = gcp_objects()
+        prov = TerraformProvisioner(work_dir=str(tmp_path / "tf"))
+        cluster_dir = prov.render("noinit", plan, region, [zone])
+        with pytest.raises(ProvisionerError, match="terraform init"):
+            prov._run(cluster_dir, "apply", "-auto-approve", "-input=false",
+                      "-no-color")
+
+    def test_apply_failure_surfaces_cloud_error(
+        self, shimmed_terraform, tmp_path, monkeypatch
+    ):
+        monkeypatch.setenv("KO_SHIM_TF_SCENARIO", "apply_fail")
+        plan, region, zone = gcp_objects()
+        prov = TerraformProvisioner(work_dir=str(tmp_path / "tf"))
+        cluster_dir = prov.render("quotafail", plan, region, [zone])
+        with pytest.raises(ProvisionerError, match="Quota 'NETWORKS' exceeded"):
+            prov.apply(cluster_dir)
+        # the failed apply left no state — outputs stay empty, a retry
+        # re-applies from scratch instead of reading half-created machines
+        assert not os.path.exists(
+            os.path.join(cluster_dir, "terraform.tfstate"))
+
+    def test_apply_timeout_kills_subprocess(
+        self, shimmed_terraform, tmp_path, monkeypatch
+    ):
+        monkeypatch.setenv("KO_SHIM_TF_SCENARIO", "apply_timeout")
+        monkeypatch.setenv("KO_SHIM_TF_HANG_S", "30")
+        plan, region, zone = gcp_objects()
+        prov = TerraformProvisioner(work_dir=str(tmp_path / "tf"),
+                                    timeout_s=1.5)
+        cluster_dir = prov.render("hangs", plan, region, [zone])
+        prov._run(cluster_dir, "init", "-input=false", "-no-color")
+        with pytest.raises(ProvisionerError, match="timed out after 1.5s"):
+            prov._run(cluster_dir, "apply", "-auto-approve", "-input=false",
+                      "-no-color")
+
+    def test_corrupt_rendered_hcl_rejected_like_real_terraform(
+        self, shimmed_terraform, tmp_path
+    ):
+        """The shim parses main.tf with the in-repo HCL grammar — a template
+        regression that renders invalid HCL fails at the process boundary
+        (exit 1, terraform-style syntax error), not silently."""
+        plan, region, zone = gcp_objects()
+        prov = TerraformProvisioner(work_dir=str(tmp_path / "tf"))
+        cluster_dir = prov.render("badhcl", plan, region, [zone])
+        with open(os.path.join(cluster_dir, "main.tf"), "a") as f:
+            f.write('\nresource "google_compute_instance" "broken" {\n')
+        with pytest.raises(ProvisionerError, match="Invalid configuration"):
+            prov.apply(cluster_dir)
+
+    def test_static_ip_provider_lifecycle(self, shimmed_terraform, tmp_path):
+        """vSphere static-pool plan through the real subprocess path: the
+        cloud echoes exactly the pool addresses it was handed."""
+        region = Region(name="dc1", provider="vsphere",
+                        vars={"vcenter_host": "vc.local",
+                              "vcenter_user": "admin",
+                              "vcenter_password": "pw"})
+        zone = Zone(name="pool-zone", region_id=region.id,
+                    vars={"gateway": "10.9.0.1"},
+                    ip_pool=[f"10.9.0.{i}" for i in range(10, 16)])
+        plan = Plan(name="vs-ha", provider="vsphere", region_id=region.id,
+                    zone_ids=[zone.id], master_count=1, worker_count=2)
+        prov = TerraformProvisioner(work_dir=str(tmp_path / "tf"))
+        cluster_dir = prov.render("vs1", plan, region, [zone])
+        prov.apply(cluster_dir)
+        outputs = prov.outputs(cluster_dir)
+        assert outputs["master_ips"] == ["10.9.0.10"]
+        assert outputs["worker_ips"] == ["10.9.0.11", "10.9.0.12"]
+
+
+@pytest.fixture
+def svc_real_tf(shimmed_terraform, tmp_path):
+    """Full service stack with the REAL TerraformProvisioner driving the
+    shimmed binary (executor stays simulation — the ansible boundary has its
+    own shim suite in test_ansible_executor.py)."""
+    config = load_config(
+        path="/nonexistent",
+        env={},
+        overrides={
+            "db": {"path": str(tmp_path / "svc.db")},
+            "executor": {"backend": "simulation"},
+            "provisioner": {"work_dir": str(tmp_path / "tfruns"),
+                            "timeout_s": 60},
+            "cron": {"health_check_interval_s": 0},
+            "cluster": {"kubeconfig_dir": str(tmp_path / "kubeconfigs")},
+        },
+    )
+    services = build_services(config, simulate=False)
+    assert type(services.provisioner).__name__ == "TerraformProvisioner"
+    yield services
+    services.close()
+
+
+def make_tpu_plan(svc):
+    region = svc.regions.create(Region(
+        name="gcp-us", provider="gcp_tpu_vm",
+        vars={"project": "p", "name": "us-central1"},
+    ))
+    zone = svc.zones.create(Zone(
+        name="us-central1-a", region_id=region.id,
+        vars={"gcp_zone": "us-central1-a"},
+    ))
+    return svc.plans.create(Plan(
+        name="tpu-v5e-16", provider="gcp_tpu_vm", region_id=region.id,
+        zone_ids=[zone.id], accelerator="tpu", tpu_type="v5e-16",
+        num_slices=1, worker_count=0,
+    ))
+
+
+class TestClusterServiceOverRealTerraform:
+    """SURVEY §3.1 plan-mode create with every terraform call a real
+    subprocess — the last never-executed boundary, now driven from the
+    service layer."""
+
+    def test_plan_create_to_ready_over_subprocess(
+        self, svc_real_tf, shimmed_terraform
+    ):
+        make_tpu_plan(svc_real_tf)
+        svc_real_tf.clusters.create(
+            "northstar", provision_mode="plan", plan_name="tpu-v5e-16",
+            wait=True,
+        )
+        cluster = svc_real_tf.clusters.get("northstar")
+        assert cluster.status.phase == "Ready"
+        assert cluster.status.smoke_chips == 16
+        # Host rows carry the IPs the shim's "cloud" handed back via the
+        # real `output -json` parse (10.210.x.y = shim address space)
+        hosts = svc_real_tf.repos.hosts.find(cluster_id=cluster.id)
+        tpu_hosts = sorted((h for h in hosts if h.tpu_chips > 0),
+                           key=lambda h: h.tpu_worker_id)
+        assert len(tpu_hosts) == 4
+        assert all(h.ip.startswith("10.210.1.") for h in tpu_hosts)
+        calls = [c["subcommand"] for c in shimmed_terraform()]
+        assert calls == ["init", "apply", "output"]
+
+    def test_apply_failure_lands_failed_resumable_then_retry_reapplies(
+        self, svc_real_tf, shimmed_terraform, monkeypatch
+    ):
+        """VERDICT r3 #1 'Done =' condition: an apply failure lands the
+        cluster Failed-resumable and a retry re-applies."""
+        make_tpu_plan(svc_real_tf)
+        monkeypatch.setenv("KO_SHIM_TF_SCENARIO", "apply_fail")
+        with pytest.raises(ProvisionerError, match="Quota"):
+            svc_real_tf.clusters.create(
+                "flaky", provision_mode="plan", plan_name="tpu-v5e-16",
+                wait=True,
+            )
+        cluster = svc_real_tf.clusters.get("flaky")
+        assert cluster.status.phase == "Failed"
+        assert "Quota 'NETWORKS' exceeded" in cluster.status.message
+        # no phantom hosts from the failed apply
+        assert svc_real_tf.repos.hosts.find(cluster_id=cluster.id) == []
+
+        # quota freed -> retry() re-enters: terraform re-applies, then the
+        # phase list resumes and the cluster reaches Ready
+        monkeypatch.setenv("KO_SHIM_TF_SCENARIO", "success")
+        svc_real_tf.clusters.retry("flaky", wait=True)
+        cluster = svc_real_tf.clusters.get("flaky")
+        assert cluster.status.phase == "Ready"
+        applies = [c for c in shimmed_terraform()
+                   if c["subcommand"] == "apply"]
+        assert len(applies) == 2  # failed apply + retry's re-apply
+
+    def test_delete_runs_destroy_subprocess(
+        self, svc_real_tf, shimmed_terraform
+    ):
+        make_tpu_plan(svc_real_tf)
+        svc_real_tf.clusters.create(
+            "gone", provision_mode="plan", plan_name="tpu-v5e-16", wait=True,
+        )
+        svc_real_tf.clusters.delete("gone", wait=True)
+        calls = [c["subcommand"] for c in shimmed_terraform()]
+        assert calls[-1] == "destroy"
+        # the machines' Host rows went with them
+        assert all(
+            not h.name.startswith("gone-")
+            for h in svc_real_tf.repos.hosts.list()
+        )
