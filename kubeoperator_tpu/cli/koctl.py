@@ -277,8 +277,9 @@ def _poll_to_ready(client, name: str, timeout_s: float, quiet: bool) -> int:
             if not quiet:
                 extra = ""
                 if status.get("smoke_chips"):
+                    sim = " [simulated]" if status.get("smoke_simulated") else ""
                     extra = (f" — psum {status['smoke_gbps']} GB/s over "
-                             f"{status['smoke_chips']} chips")
+                             f"{status['smoke_chips']} chips{sim}")
                 print(f"cluster {name} is Ready"
                       f" ({status.get('total_duration_s', 0):.1f}s){extra}")
             return 0

@@ -137,9 +137,13 @@ class ClusterStatus:
     smoke_gbps: float = 0.0
     smoke_chips: int = 0
     smoke_passed: bool = False
+    # True when the latest gate ran under ko_simulation (demo clusters):
+    # fabricated GB/s must never be readable as measured, anywhere the
+    # number surfaces (console trend, TPU panel, koctl, events)
+    smoke_simulated: bool = False
     # every smoke measurement ever gated on (create, upgrade re-gate, slice
     # scale, guided recovery), newest last, capped — the console's GB/s
-    # trend; plain dicts: {ts, gbps, chips, passed}
+    # trend; plain dicts: {ts, gbps, chips, passed, simulated}
     smoke_history: list = field(default_factory=list)
 
     __nested__ = {"conditions": ClusterStatusCondition}

@@ -662,9 +662,10 @@ class ClusterService:
         self.repos.clusters.save(cluster)
         detail = ""
         if cluster.spec.tpu_enabled:
+            sim = " simulated" if cluster.status.smoke_simulated else ""
             detail = (
                 f" (psum {cluster.status.smoke_gbps} GB/s over "
-                f"{cluster.status.smoke_chips} chips)"
+                f"{cluster.status.smoke_chips} chips{sim})"
             )
         self.events.emit(cluster.id, "Normal", "ClusterReady",
                          f"cluster {cluster.name} Ready{detail}")

@@ -80,6 +80,8 @@ const I18N = {
     ldap_ok: "connection OK", ldap_synced: "synced",
     needs_attention: "needs attention", chips_mismatch: "chip count mismatch",
     filter_hosts: "filter hosts…", smoke_trend: "psum trend",
+    simulated: "SIMULATED",
+    simulated_hint: "demo value from simulation — not a hardware measurement",
     advanced: "Advanced", cni: "CNI", runtime: "Runtime",
     kube_proxy: "kube-proxy", ingress: "Ingress",
     nodelocaldns: "Node-local DNS cache",
@@ -135,6 +137,8 @@ const I18N = {
     ldap_ok: "连接正常", ldap_synced: "已同步",
     needs_attention: "需要关注", chips_mismatch: "芯片数不符",
     filter_hosts: "过滤主机…", smoke_trend: "psum 趋势",
+    simulated: "模拟值",
+    simulated_hint: "仿真演示数据 — 非硬件实测",
     advanced: "高级选项", cni: "网络插件", runtime: "容器运行时",
     kube_proxy: "kube-proxy 模式", ingress: "Ingress 控制器",
     nodelocaldns: "节点本地 DNS 缓存",
@@ -301,7 +305,7 @@ async function refreshClusters() {
   const conds = (c.status.conditions || []).map((x) =>
       `<span class="cond ${x.status}">${esc(x.name)}</span>`).join("");
     const smoke = c.status.smoke_chips
-      ? `<div class="smoke">psum ${c.status.smoke_gbps} GB/s · ${c.status.smoke_chips} chips</div>`
+      ? `<div class="smoke">psum ${c.status.smoke_gbps} GB/s · ${c.status.smoke_chips} chips${c.status.smoke_simulated ? ` <span class="sim-badge" title="${t("simulated_hint")}">${t("simulated")}</span>` : ""}</div>`
       : "";
     card.innerHTML = `
       <h4>${esc(c.name)} ${badge}</h4>
@@ -386,11 +390,12 @@ async function openCluster(name) {
       ${tpuPanel.chips}${tpuPanel.expected_chips ? ` / ${tpuPanel.expected_chips}` : ""} chips
       ${tpuPanel.chips_ok ? "" : `<span class="crit">${t("chips_mismatch")}</span>`}
       · psum ${tpuPanel.gbps} GB/s
+      ${tpuPanel.simulated ? `<span class="sim-badge" title="${t("simulated_hint")}">${t("simulated")}</span>` : ""}
       ${tpuPanel.trend.delta_pct !== null
         ? `<span class="delta ${tpuPanel.trend.delta_pct < 0 ? "down" : "up"}">${tpuPanel.trend.delta_pct > 0 ? "+" : ""}${tpuPanel.trend.delta_pct}%</span>`
         : ""}
       ${tpuPanel.trend.bars.length > 1
-        ? `<span class="spark" title="${t("smoke_trend")}">${tpuPanel.trend.bars.map((b) => `<i style="height:${Math.max(b, 6)}%"></i>`).join("")}</span>`
+        ? `<span class="spark" title="${t("smoke_trend")}">${tpuPanel.trend.bars.map((b, i) => `<i class="${tpuPanel.trend.sim[i] ? "sim" : ""}" style="height:${Math.max(b, 6)}%"></i>`).join("")}</span>`
         : ""}
     </div>` : ""}
     <div id="d-health-out"></div>

