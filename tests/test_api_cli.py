@@ -580,6 +580,51 @@ class TestKoctlNotify:
         with pytest.raises(SystemExit, match="unknown smtp setting"):
             koctl.main(["--local", "notify", "set", "smtp.hots=x"])
 
+    def test_webhook_headers_take_json_on_the_cli(self, capsys,
+                                                  monkeypatch, tmp_path):
+        """ADVICE r3: dict-defaulted keys (webhook.headers) accept JSON —
+        without the dict branch the CLI could not configure webhook auth
+        headers at all."""
+        from kubeoperator_tpu.cli import koctl
+
+        monkeypatch.setenv("KO_TPU_DB__PATH", str(tmp_path / "wh.db"))
+        monkeypatch.setenv("KO_TPU_EXECUTOR__BACKEND", "simulation")
+        monkeypatch.setenv("KO_TPU_PROVISIONER__WORK_DIR",
+                           str(tmp_path / "tf"))
+        assert koctl.main([
+            "--local", "notify", "set", "webhook.enabled=true",
+            "webhook.url=https://hooks.local/x",
+            'webhook.headers={"X-Token": "secret7"}',
+        ]) == 0
+        out = capsys.readouterr().out
+        assert '"X-Token"' in out
+        assert "secret7" not in out           # header values masked on read
+        # non-JSON and non-object values die with a pointed message
+        with pytest.raises(SystemExit, match="expects a JSON object"):
+            koctl.main(["--local", "notify", "set", "webhook.headers=x: y"])
+        with pytest.raises(SystemExit, match="expects a JSON object"):
+            koctl.main(["--local", "notify", "set", 'webhook.headers=["a"]'])
+
+    def test_notify_probe_without_admin_explains_itself(self, capsys,
+                                                        monkeypatch,
+                                                        tmp_path):
+        """ADVICE r3: no admin account -> friendly no-recipient error, not
+        a NotFoundError crash from users.get("")."""
+        from kubeoperator_tpu.cli import koctl
+
+        monkeypatch.setenv("KO_TPU_DB__PATH", str(tmp_path / "na.db"))
+        monkeypatch.setenv("KO_TPU_EXECUTOR__BACKEND", "simulation")
+        monkeypatch.setenv("KO_TPU_PROVISIONER__WORK_DIR",
+                           str(tmp_path / "tf"))
+        # simulate a deleted/never-bootstrapped admin: the local transport
+        # normally ensure_admin()s, so bypass it for this run
+        from kubeoperator_tpu.service.tenancy import UserService
+
+        monkeypatch.setattr(UserService, "ensure_admin", lambda self: None)
+        assert koctl.main(["--local", "notify", "test", "smtp"]) == 1
+        out = capsys.readouterr().out
+        assert "no admin account" in out
+
 
 class TestPasswordChange:
     def test_self_service_requires_old_password(self, client):

@@ -808,8 +808,10 @@ def test_nfs_probe_is_leak_free():
     assert "trap cleanup EXIT" in text
     assert 'archiveOnDelete: "false"' in text
     assert "storageClassName: ko-nfs-probe" in text
-    # the probe class targets the pinned provisioner name the chart installs
-    assert text.count("ko.io/nfs-subdir") == 2
+    # the probe class targets the pinned provisioner name the chart
+    # installs; third occurrence = the immutable-fields compare that
+    # decides whether the existing class must be dropped (ADVICE r3)
+    assert text.count("ko.io/nfs-subdir") == 3
 
 
 def test_template_only_vars_stay_out_of_command_lines():
@@ -972,15 +974,35 @@ def test_upgrade_verify_covers_distinct_failure_modes():
     tasks = _role_tasks("upgrade-verify")
     names = [t["name"] for t in tasks]
     for required in ("all nodes Ready",
-                     "verify node versions match target",
                      "verify apiserver reports the target version",
                      "verify control plane static pods healthy on every master",
                      "verify cluster DNS rollout",
-                     "verify nothing in kube-system is crash-looping"):
+                     "verify nothing in kube-system is crash-looping",
+                     "collect node versions for attestation",
+                     "report upgrade verification"):
         assert required in names, required
     sweep = tasks[names.index("verify nothing in kube-system is crash-looping")]
     assert sweep["retries"] >= 3
     assert "CrashLoopBackOff" in str(sweep)
+    # attestation contract (VERDICT r3 weak #6): each check registers and
+    # tolerates failure so its result reaches the platform as a NAMED flag
+    # in the marker — the platform, not this role's rc, decides READY
+    for check in ("all nodes Ready",
+                  "verify apiserver reports the target version",
+                  "verify control plane static pods healthy on every master",
+                  "verify cluster DNS rollout",
+                  "verify nothing in kube-system is crash-looping"):
+        t = tasks[names.index(check)]
+        assert t.get("register"), check
+        assert t.get("ignore_errors") is True, check
+    report = tasks[names.index("report upgrade verification")]
+    # flags are DERIVED from the registered rcs, not literal true
+    for reg in ("ko_nodes_ready.rc", "ko_apiserver.rc", "ko_cp_ready.rc",
+                "ko_coredns.rc", "ks_sweep.rc"):
+        assert reg in str(report), reg
+    # the collect task must hard-fail (no attestation beats a fake one)
+    collect = tasks[names.index("collect node versions for attestation")]
+    assert not collect.get("ignore_errors")
 
 
 def test_reset_leaves_no_network_or_storage_residue():
