@@ -94,3 +94,11 @@ def client(server):
     assert resp.status_code == 200
     session.headers["Authorization"] = f"Bearer {resp.json()['token']}"
     return base, session, services
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: budgeted heavy tests (multi-process bootstraps); run in CI, "
+        "deselect locally with -m 'not slow'",
+    )
