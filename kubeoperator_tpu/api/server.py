@@ -587,9 +587,14 @@ class Handlers:
         session = self._owned_terminal(request)
         after = int(request.query.get("after", "-1"))
         if request.query.get("follow") != "1":
-            chunks = await run_sync(request, session.read_since, after)
+            missed, chunks = await run_sync(request, session.read_with_gap,
+                                            after)
             return json_response({
                 "alive": session.alive,
+                # chunks the scrollback cap dropped before this poll could
+                # read them — the client renders a gap marker, never a
+                # silent splice
+                "missed": missed,
                 "chunks": [
                     {"seq": s, "data": d.decode("utf-8", "replace")}
                     for s, d in chunks
@@ -601,7 +606,13 @@ class Handlers:
         })
         await resp.prepare(request)
         async def flush(after_seq: int) -> int:
-            chunks = await run_sync(request, session.read_since, after_seq)
+            missed, chunks = await run_sync(request, session.read_with_gap,
+                                            after_seq)
+            if missed and chunks:
+                # the gap precedes the chunks about to stream
+                await resp.write(
+                    f"event: gap\ndata: {json.dumps({'missed': missed})}\n\n"
+                    .encode())
             for s, d in chunks:
                 payload = json.dumps(
                     {"seq": s, "data": d.decode("utf-8", "replace")}

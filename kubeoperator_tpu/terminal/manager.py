@@ -34,8 +34,13 @@ from kubeoperator_tpu.utils.logging import get_logger
 log = get_logger("terminal")
 
 # Bounded scrollback per session: the web client keeps its own history; the
-# server buffer only has to cover poll gaps.
+# server buffer only has to cover poll gaps. Policy is DROP-OLDEST with
+# accounting — under a flooding child (`kubectl logs -f` on a busy pod, a
+# stray `yes`) the buffer pins at MAX_BUFFERED_BYTES, the oldest chunks go,
+# and read_since() reports how many chunks the caller missed so the client
+# can show a gap marker instead of silently splicing output.
 MAX_BUFFERED_CHUNKS = 2048
+MAX_BUFFERED_BYTES = 1 << 20  # 1 MiB of scrollback per session, hard cap
 
 
 class TerminalSession:
@@ -51,6 +56,9 @@ class TerminalSession:
         self._lock = threading.Lock()
         self._chunks: list[tuple[int, bytes]] = []
         self._next_seq = 0
+        self._first_seq = 0          # seq of the oldest RETAINED chunk
+        self._buffered_bytes = 0
+        self.dropped_chunks = 0      # lifetime drop count (observability)
         self._closed = False
 
         master, slave = pty.openpty()
@@ -80,8 +88,18 @@ class TerminalSession:
             with self._lock:
                 self._chunks.append((self._next_seq, data))
                 self._next_seq += 1
-                if len(self._chunks) > MAX_BUFFERED_CHUNKS:
-                    del self._chunks[: len(self._chunks) - MAX_BUFFERED_CHUNKS]
+                self._buffered_bytes += len(data)
+                # drop-oldest until back under BOTH caps; byte cap is the
+                # one that binds under a flood (4KiB reads fill the chunk
+                # cap 8x slower than the byte cap)
+                while self._chunks and (
+                    self._buffered_bytes > MAX_BUFFERED_BYTES
+                    or len(self._chunks) > MAX_BUFFERED_CHUNKS
+                ):
+                    seq, dropped = self._chunks.pop(0)
+                    self._buffered_bytes -= len(dropped)
+                    self.dropped_chunks += 1
+                    self._first_seq = seq + 1
         self.close()
 
     def write(self, data: bytes) -> None:
@@ -97,6 +115,36 @@ class TerminalSession:
         self.last_active = now_ts()
         with self._lock:
             return [(s, d) for s, d in self._chunks if s > after_seq]
+
+    def missed_since(self, after_seq: int = -1) -> int:
+        """How many chunks between `after_seq` and the oldest retained one
+        were dropped by the scrollback cap — the caller's output gap. 0 for
+        a fresh session or a caller keeping up."""
+        with self._lock:
+            return self._missed_locked(after_seq)
+
+    def _missed_locked(self, after_seq: int) -> int:
+        if self._first_seq == 0:
+            return 0
+        return max(0, self._first_seq - (after_seq + 1))
+
+    def read_with_gap(
+        self, after_seq: int = -1
+    ) -> tuple[int, list[tuple[int, bytes]]]:
+        """(missed, chunks) under ONE lock hold — the poll/SSE handlers use
+        this, not two separate calls, so a drop landing between a gap query
+        and the read can never be spliced with an undercounted gap."""
+        self.last_active = now_ts()
+        with self._lock:
+            return (
+                self._missed_locked(after_seq),
+                [(s, d) for s, d in self._chunks if s > after_seq],
+            )
+
+    @property
+    def buffered_bytes(self) -> int:
+        with self._lock:
+            return self._buffered_bytes
 
     def resize(self, rows: int, cols: int) -> None:
         with self._lock:

@@ -665,6 +665,11 @@ async function openCluster(name) {
             "GET", `/api/v1/terminal/${session.id}/output?after=${after}`
           ).catch(() => null);
           if (!r) return;
+          if (r.missed > 0 && r.chunks.length) {
+            // scrollback cap dropped output between polls: show the gap,
+            // never silently splice
+            out.textContent += `\n[… ${r.missed} output chunk(s) dropped …]\n`;
+          }
           for (const chunk of r.chunks) {
             out.textContent += chunk.data;
             after = chunk.seq;
