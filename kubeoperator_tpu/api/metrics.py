@@ -1,0 +1,155 @@
+"""Platform self-metrics: Prometheus text exposition, no dependencies.
+
+VERDICT r3 missing #5 — the installer bundles grafana, but the platform
+could not observe itself. This registry is the data source: process-lifetime
+counters (HTTP requests, SSE consumers) updated by the API layer, plus
+scrape-time collectors that read the live stack (clusters by phase, phase
+durations from condition spans, executor task stats and queue depth,
+terminal sessions, smoke bandwidth with its honesty label).
+
+Exposition format reference: prometheus.io/docs/instrumenting/exposition_formats
+(text format 0.0.4) — counters end in `_total`, label values escape
+backslash/quote/newline, HELP/TYPE precede each family.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+def _escape(value: str) -> str:
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _fmt(name: str, labels: dict | None, value) -> str:
+    if labels:
+        inner = ",".join(
+            f'{k}="{_escape(v)}"' for k, v in sorted(labels.items())
+        )
+        return f"{name}{{{inner}}} {value}"
+    return f"{name} {value}"
+
+
+class MetricsRegistry:
+    """One per server process. Thread-safe: counters are touched from the
+    request thread-pool; render() reads everything under the same lock."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._started = time.time()
+        self._http: dict[tuple[str, int], int] = {}
+        self._sse_consumers = 0
+
+    # ---- process counters (hot path: O(1) under a short lock) ----
+    def observe_http(self, method: str, status: int) -> None:
+        key = (method, int(status))
+        with self._lock:
+            self._http[key] = self._http.get(key, 0) + 1
+
+    def sse_started(self) -> None:
+        with self._lock:
+            self._sse_consumers += 1
+
+    def sse_finished(self) -> None:
+        with self._lock:
+            self._sse_consumers -= 1
+
+    # ---- exposition ----
+    def render(self, services) -> str:
+        from kubeoperator_tpu.version import __version__
+
+        out: list[str] = []
+
+        def family(name: str, mtype: str, help_: str, rows: list[str]):
+            out.append(f"# HELP {name} {help_}")
+            out.append(f"# TYPE {name} {mtype}")
+            out.extend(rows)
+
+        with self._lock:
+            http = dict(self._http)
+            sse = self._sse_consumers
+        family("ko_tpu_info", "gauge", "Build info.",
+               [_fmt("ko_tpu_info", {"version": __version__}, 1)])
+        family("ko_tpu_uptime_seconds", "gauge",
+               "Seconds since the server process started.",
+               [_fmt("ko_tpu_uptime_seconds", None,
+                     round(time.time() - self._started, 1))])
+        family("ko_tpu_http_requests_total", "counter",
+               "API requests served, by method and status code.",
+               [_fmt("ko_tpu_http_requests_total",
+                     {"method": m, "code": str(c)}, n)
+                for (m, c), n in sorted(http.items())])
+        family("ko_tpu_sse_consumers", "gauge",
+               "Live SSE streams (log followers, event feeds, terminals).",
+               [_fmt("ko_tpu_sse_consumers", None, sse)])
+
+        # ---- scrape-time collectors over the live stack ----
+        clusters = services.repos.clusters.list()
+        by_phase: dict[str, int] = {}
+        for c in clusters:
+            by_phase[c.status.phase] = by_phase.get(c.status.phase, 0) + 1
+        family("ko_tpu_clusters", "gauge", "Clusters by lifecycle phase.",
+               [_fmt("ko_tpu_clusters", {"phase": p}, n)
+                for p, n in sorted(by_phase.items())])
+
+        # phase durations from condition spans (SURVEY §5.1: the native
+        # trace) — sum+count per phase name lets dashboards chart averages
+        span_sum: dict[str, float] = {}
+        span_count: dict[str, int] = {}
+        for c in clusters:
+            for cond in c.status.conditions:
+                if cond.finished_at and cond.started_at:
+                    d = cond.finished_at - cond.started_at
+                    span_sum[cond.name] = span_sum.get(cond.name, 0.0) + d
+                    span_count[cond.name] = span_count.get(cond.name, 0) + 1
+        # gauges, not counters: recomputed over RETAINED clusters each
+        # scrape, so a cluster delete lowers them — rate()/increase()
+        # would misread that as a counter reset. sum/count still chart
+        # the average cleanly.
+        family("ko_tpu_phase_duration_seconds_sum", "gauge",
+               "Seconds spent in each adm phase, summed over retained "
+               "clusters' condition spans.",
+               [_fmt("ko_tpu_phase_duration_seconds_sum", {"phase": p},
+                     round(s, 3))
+                for p, s in sorted(span_sum.items())])
+        family("ko_tpu_phase_duration_seconds_count", "gauge",
+               "Completed phase runs recorded on retained clusters.",
+               [_fmt("ko_tpu_phase_duration_seconds_count", {"phase": p}, n)
+                for p, n in sorted(span_count.items())])
+
+        stats = services.executor.task_stats()
+        family("ko_tpu_executor_tasks_started_total", "counter",
+               "Playbook/adhoc tasks launched since process start.",
+               [_fmt("ko_tpu_executor_tasks_started_total", None,
+                     stats["started_total"])])
+        family("ko_tpu_executor_tasks", "gauge",
+               "Retained executor tasks by status (RUNNING = queue depth).",
+               [_fmt("ko_tpu_executor_tasks", {"status": s}, n)
+                for s, n in sorted(stats["by_status"].items())])
+
+        term = services.terminals.stats()
+        family("ko_tpu_terminal_sessions", "gauge",
+               "Live web-terminal PTY sessions (reaped before counting).",
+               [_fmt("ko_tpu_terminal_sessions", None, term["sessions"])])
+        family("ko_tpu_terminal_dropped_chunks_total", "counter",
+               "Output chunks dropped by the per-session scrollback cap "
+               "(monotonic: closed sessions' drops are retained).",
+               [_fmt("ko_tpu_terminal_dropped_chunks_total", None,
+                     term["dropped_chunks_total"])])
+
+        smoke_rows = []
+        for c in clusters:
+            if c.status.smoke_chips:
+                smoke_rows.append(_fmt(
+                    "ko_tpu_smoke_gbps",
+                    {"cluster": c.name,
+                     "simulated": str(bool(c.status.smoke_simulated)).lower()},
+                    c.status.smoke_gbps,
+                ))
+        family("ko_tpu_smoke_gbps", "gauge",
+               "Latest psum smoke bandwidth per TPU cluster (simulated "
+               "label marks ko_simulation-fabricated values).", smoke_rows)
+
+        return "\n".join(out) + "\n"

@@ -35,7 +35,7 @@ from kubeoperator_tpu.utils.logging import get_logger
 log = get_logger("api")
 
 AUTH_EXEMPT = {("POST", "/api/v1/auth/login"), ("GET", "/api/v1/version"),
-               ("GET", "/healthz")}
+               ("GET", "/healthz"), ("GET", "/metrics")}
 
 
 # ---------------------------------------------------------------- helpers ----
@@ -57,19 +57,37 @@ async def error_middleware(request: web.Request, handler):
     locale = request.headers.get("Accept-Language", "en-US").split(",")[0]
     if locale not in ("en-US", "zh-CN"):
         locale = "zh-CN" if locale.startswith("zh") else "en-US"
+    metrics = request.app.get(METRICS_KEY)
+
+    def observe(status: int):
+        # /metrics scrapes would dominate their own counter; skip them
+        if metrics is not None and request.path != "/metrics":
+            metrics.observe_http(request.method, status)
+
     try:
-        return await handler(request)
+        resp = await handler(request)
+        observe(resp.status)
+        return resp
     except KoError as e:
+        observe(e.http_status)
         return json_response(
             {"error": e.code,
              "message": translate(e.code, locale, message=e.message,
                                   **e.args_map)},
             status=e.http_status,
         )
-    except web.HTTPException:
+    except web.HTTPException as e:
+        observe(e.status)
+        raise
+    except (ConnectionResetError, BrokenPipeError):
+        # routine SSE/terminal client disconnect mid-stream — 499 (client
+        # closed request), NOT a 500: a steady error rate proportional to
+        # SSE usage would mask real failures on the dashboard
+        observe(499)
         raise
     except Exception as e:  # pragma: no cover - last resort
         log.exception("unhandled API error")
+        observe(500)
         return json_response(
             {"error": "ERR_INTERNAL", "message": str(e)}, status=500
         )
@@ -78,6 +96,7 @@ async def error_middleware(request: web.Request, handler):
 # typed app-state key (aiohttp AppKey): silences NotAppKeyWarning and
 # gives every request.app[SERVICES_KEY] read a real type
 SERVICES_KEY: "web.AppKey[Services]" = web.AppKey("services", object)
+METRICS_KEY = web.AppKey("metrics", object)
 
 
 @web.middleware
@@ -140,6 +159,15 @@ def cluster_guard(handler, needed: Role):
 class Handlers:
     def __init__(self, services: Services):
         self.s = services
+        from kubeoperator_tpu.api.metrics import MetricsRegistry
+
+        self.metrics = MetricsRegistry()
+
+    async def metrics_endpoint(self, request):
+        text = await run_sync(request, self.metrics.render, self.s)
+        return web.Response(
+            text=text, content_type="text/plain", charset="utf-8"
+        )
 
     # ---- auth / users ----
     async def login(self, request):
@@ -352,20 +380,24 @@ class Handlers:
             "Cache-Control": "no-cache",
         })
         await resp.prepare(request)
-        idle = 0.0
-        while idle < 30.0:
-            chunks, cursor = await run_sync(request, fetch, cursor)
-            if chunks:
-                idle = 0.0
-                for c in chunks:
-                    await resp.write(
-                        f"data: {json.dumps({'seq': c.seq, 'line': c.line})}\n\n"
-                        .encode()
-                    )
-            else:
-                idle += 0.5
-                await asyncio.sleep(0.5)
-        await resp.write(b"event: end\ndata: {}\n\n")
+        self.metrics.sse_started()
+        try:
+            idle = 0.0
+            while idle < 30.0:
+                chunks, cursor = await run_sync(request, fetch, cursor)
+                if chunks:
+                    idle = 0.0
+                    for c in chunks:
+                        await resp.write(
+                            f"data: {json.dumps({'seq': c.seq, 'line': c.line})}\n\n"
+                            .encode()
+                        )
+                else:
+                    idle += 0.5
+                    await asyncio.sleep(0.5)
+            await resp.write(b"event: end\ndata: {}\n\n")
+        finally:
+            self.metrics.sse_finished()
         return resp
 
     # ---- nodes / scale (§3.3) ----
@@ -620,19 +652,23 @@ class Handlers:
                 await resp.write(f"data: {payload}\n\n".encode())
             return chunks[-1][0] if chunks else after_seq
 
-        idle = 0.0
-        while idle < 60.0 and session.alive:
-            new_after = await flush(after)
-            if new_after != after:
-                idle = 0.0
-                after = new_after
-            else:
-                idle += 0.2
-                await asyncio.sleep(0.2)
-        # final drain: the shell's last output lands in the buffer just
-        # before `alive` flips, after the loop's last read
-        await flush(after)
-        await resp.write(b"event: end\ndata: {}\n\n")
+        self.metrics.sse_started()
+        try:
+            idle = 0.0
+            while idle < 60.0 and session.alive:
+                new_after = await flush(after)
+                if new_after != after:
+                    idle = 0.0
+                    after = new_after
+                else:
+                    idle += 0.2
+                    await asyncio.sleep(0.2)
+            # final drain: the shell's last output lands in the buffer just
+            # before `alive` flips, after the loop's last read
+            await flush(after)
+            await resp.write(b"event: end\ndata: {}\n\n")
+        finally:
+            self.metrics.sse_finished()
         return resp
 
     async def terminal_resize(self, request):
@@ -796,9 +832,11 @@ def create_app(services: Services) -> web.Application:
     app = web.Application(middlewares=[error_middleware, auth_middleware])
     app[SERVICES_KEY] = services
     h = Handlers(services)
+    app[METRICS_KEY] = h.metrics
 
     r = app.router
     r.add_get("/healthz", h.healthz)
+    r.add_get("/metrics", h.metrics_endpoint)
     r.add_get("/api/v1/version", h.version)
     r.add_post("/api/v1/auth/login", h.login)
     r.add_post("/api/v1/auth/logout", h.logout)

@@ -107,6 +107,7 @@ class Executor(abc.ABC):
         self._tasks: dict[str, _TaskState] = {}
         self._order: list[str] = []
         self._max_retained = max_retained
+        self._started_total = 0   # lifetime launches (survives eviction)
         self._lock = threading.Lock()
         # lowest-precedence extra-vars stamped by the owning service stack
         # (offline registry address); merged into every phase run by ClusterAdm
@@ -120,6 +121,7 @@ class Executor(abc.ABC):
         with self._lock:
             self._tasks[task_id] = state
             self._order.append(task_id)
+            self._started_total += 1
             self._evict_locked()
         state.result.status = TaskStatus.RUNNING.value
         state.result.started_at = now_ts()
@@ -180,6 +182,20 @@ class Executor(abc.ABC):
         if not state.done.wait(timeout_s):
             raise ExecutorError(message=f"task {task_id} timed out")
         return state.result
+
+    def task_stats(self) -> dict:
+        """Observability snapshot (platform /metrics): retained tasks by
+        status — RUNNING is the live queue depth — plus the lifetime launch
+        counter, which eviction never decrements."""
+        with self._lock:
+            by_status: dict[str, int] = {}
+            for state in self._tasks.values():
+                s = state.result.status
+                by_status[s] = by_status.get(s, 0) + 1
+            return {
+                "started_total": self._started_total,
+                "by_status": by_status,
+            }
 
     # ---- backend plumbing ----
     def _evict_locked(self) -> None:

@@ -195,6 +195,9 @@ class TerminalManager:
         self.max_sessions = int(config.get("terminal.max_sessions", 16))
         self.idle_timeout_s = float(config.get("terminal.idle_timeout_s", 900))
         self._sessions: dict[str, TerminalSession] = {}
+        # lifetime drop count from CLOSED sessions: /metrics needs a
+        # monotonic counter, and reap/close would otherwise erase history
+        self._dropped_closed = 0
         self._lock = threading.Lock()
 
     def open(self, cluster_name: str, user_id: str = "") -> TerminalSession:
@@ -248,6 +251,8 @@ class TerminalManager:
     def close(self, session_id: str) -> None:
         with self._lock:
             session = self._sessions.pop(session_id, None)
+            if session is not None:
+                self._dropped_closed += session.dropped_chunks
         if session is not None:
             session.close()
             log.info("terminal session %s closed", session_id)
@@ -275,9 +280,24 @@ class TerminalManager:
                 for s in self._sessions.values()
             ]
 
+    def stats(self) -> dict:
+        """Observability snapshot (platform /metrics), reaping first so
+        dead/idle sessions are never counted live. dropped_chunks_total is
+        monotonic: closed sessions' drops accumulate in _dropped_closed."""
+        self.reap()
+        with self._lock:
+            live = list(self._sessions.values())
+            return {
+                "sessions": len(live),
+                "dropped_chunks_total": self._dropped_closed + sum(
+                    s.dropped_chunks for s in live
+                ),
+            }
+
     def shutdown(self) -> None:
         with self._lock:
             sessions = list(self._sessions.values())
             self._sessions.clear()
+            self._dropped_closed += sum(s.dropped_chunks for s in sessions)
         for s in sessions:
             s.close()

@@ -17,7 +17,7 @@ class TestInstaller:
         compose = yaml.safe_load(open(compose_path))
         services = compose["services"]
         assert set(services) == {"ko-server", "ko-runner", "ko-registry",
-                                 "grafana"}
+                                 "prometheus", "grafana"}
         assert services["ko-server"]["depends_on"] == ["ko-runner",
                                                        "ko-registry"]
         # no GPU runtime hooks in the platform compose
@@ -25,6 +25,47 @@ class TestInstaller:
         assert "nvidia" not in text and "gpu" not in text
         # app config rendered
         assert os.path.exists(tmp_path / "opt" / "data" / "config" / "app.yaml")
+
+    def test_platform_observability_provisioning(self, tmp_path):
+        """VERDICT r3 missing #5 'Done =': compose-up yields a platform
+        dashboard with real series — prometheus scrapes the server's own
+        /metrics, grafana is provisioned with that datasource and one
+        shipped dashboard whose every panel queries ko_tpu_* families the
+        /metrics endpoint actually exposes."""
+        import json as _json
+
+        target = tmp_path / "opt"
+        compose_path = render_bundle(str(target))
+        compose = yaml.safe_load(open(compose_path))
+        services = compose["services"]
+        data = target / "data" / "observability"
+
+        # prometheus: mounted config exists and targets the server
+        prom_cfg = yaml.safe_load(open(data / "prometheus.yml"))
+        targets = prom_cfg["scrape_configs"][0]["static_configs"][0]["targets"]
+        assert targets == ["ko-server:8080"]
+        assert prom_cfg["scrape_configs"][0]["metrics_path"] == "/metrics"
+        assert any("prometheus.yml" in v
+                   for v in services["prometheus"]["volumes"])
+
+        # grafana: datasource + provider + dashboard all render and the
+        # compose mounts the provisioning dirs
+        ds = yaml.safe_load(open(
+            data / "grafana" / "provisioning" / "datasources" / "ko-tpu.yml"))
+        assert ds["datasources"][0]["uid"] == "ko-prom"
+        assert ds["datasources"][0]["url"] == "http://prometheus:9090"
+        dash = _json.load(open(
+            data / "grafana" / "dashboards" / "ko-tpu-platform.json"))
+        assert dash["uid"] == "ko-tpu-platform"
+        exprs = [t["expr"] for p in dash["panels"] for t in p["targets"]]
+        assert all("ko_tpu_" in e for e in exprs)
+        for family in ("ko_tpu_clusters", "ko_tpu_executor_tasks",
+                       "ko_tpu_phase_duration_seconds",
+                       "ko_tpu_http_requests_total", "ko_tpu_sse_consumers",
+                       "ko_tpu_terminal_sessions", "ko_tpu_smoke_gbps"):
+            assert any(family in e for e in exprs), family
+        assert any("provisioning" in v for v in services["grafana"]["volumes"])
+        assert any("dashboards" in v for v in services["grafana"]["volumes"])
 
     def test_install_without_docker_degrades(self, tmp_path):
         result = install(str(tmp_path / "opt"), start=True)
