@@ -41,6 +41,8 @@ COMPONENT_VERSIONS = {
     # ceph/ceph image the CephCluster CR pins (rook decouples operator and
     # ceph versions; both must come from the offline registry)
     "ceph": "v18.2.2",
+    # vSphere CSI driver + syncer ship as one release train
+    "vsphere_csi": "v3.3.1",
 }
 
 
@@ -76,6 +78,8 @@ def bundle_manifest() -> dict:
         "images/loki.tar",
         f"images/kube-bench-{COMPONENT_VERSIONS['kube_bench']}.tar",
         "images/nfs-subdir-external-provisioner.tar",
+        f"images/vsphere-csi-driver-{COMPONENT_VERSIONS['vsphere_csi']}.tar",
+        f"images/vsphere-csi-syncer-{COMPONENT_VERSIONS['vsphere_csi']}.tar",
         f"images/rook-ceph-operator-{COMPONENT_VERSIONS['rook']}.tar",
         f"images/ceph-{COMPONENT_VERSIONS['ceph']}.tar",
         "images/velero.tar",

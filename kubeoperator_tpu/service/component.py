@@ -23,12 +23,31 @@ from kubeoperator_tpu.utils.errors import (
 # shlex split can never turn one value into extra helm/kubectl arguments.
 _INERT_VALUE_RE = re.compile(r"[A-Za-z0-9._:/@+=-]*")
 
-# Catalog "template_only" vars (e.g. rook's device-filter regex) never reach
-# a command line, so regex metacharacters are fine — but they render inside
-# a double-quoted YAML scalar in a kubectl-applied manifest, so anything
-# that could break out of that scalar (quotes, backslash, whitespace,
-# braces) would be manifest injection and is rejected.
-_TEMPLATE_SAFE_RE = re.compile(r"[A-Za-z0-9._^$\[\]()|*+?/-]*")
+# Catalog "template_only" vars (e.g. rook's device-filter regex, vSphere
+# storage-policy names) never reach a command line, so regex metacharacters
+# and spaces are fine — but they render inside a double-quoted YAML scalar
+# in a kubectl-applied manifest, so anything that could break out of that
+# scalar (quotes, backslash, newlines, braces) would be manifest injection
+# and is rejected. Space is included: 'vSAN Default Storage Policy' is the
+# de-facto default policy name on every greenfield vSphere cluster.
+_TEMPLATE_SAFE_RE = re.compile(r"[A-Za-z0-9 ._:^$\[\]()|*+?/-]*")
+
+
+def _check_conf_safe(vars: dict, origin: str) -> None:
+    """For secret values that render ONLY into a quoted key = "value" conf
+    template (csi-vsphere.conf): arbitrary passwords must pass — the only
+    dangerous characters are the ones that escape the quoted value or add
+    conf lines. Errors never echo the value (these are credentials)."""
+    for key, value in vars.items():
+        if isinstance(value, (bool, int, float)) or value is None:
+            continue
+        if not isinstance(value, str) or any(
+            c in value for c in ('"', "\\", "\n", "\r")
+        ):
+            raise ValidationError(
+                f"{origin} var {key!r} contains characters unsafe for the "
+                f"connection config (quote/backslash/newline)"
+            )
 
 
 def _check_vars_inert(vars: dict, origin: str, redact: bool = False,
@@ -91,10 +110,21 @@ class ComponentService:
             component.vars, secret_vars = self._resolve_velero_vars(
                 component.vars
             )
+        elif component_name == "vsphere-csi":
+            component.vars, secret_vars = self._resolve_vsphere_vars(
+                cluster, component.vars
+            )
         component.validate()
         _check_vars_inert(component.vars, component_name,
                           template_only=tuple(entry.get("template_only", ())))
-        _check_vars_inert(secret_vars, f"{component_name} account", redact=True)
+        if component_name == "vsphere-csi":
+            # vCenter credentials render only into the csi-vsphere.conf
+            # template — the shell-argument rule would reject ordinary
+            # passwords ('P4ss!word') and datacenter names with spaces
+            _check_conf_safe(secret_vars, f"{component_name} vcenter")
+        else:
+            _check_vars_inert(secret_vars, f"{component_name} account",
+                              redact=True)
         for required in entry.get("required", ()):
             if not component.vars.get(required):
                 raise ValidationError(
@@ -227,6 +257,58 @@ class ComponentService:
         secrets = {
             "velero_access_key": account.vars.get("access_key", ""),
             "velero_secret_key": account.vars.get("secret_key", ""),
+        }
+        return persisted, secrets
+
+    def _resolve_vsphere_vars(self, cluster, vars: dict) -> tuple[dict, dict]:
+        """vCenter connection from the named (or the plan's own) vSphere
+        region — same discipline as velero's backup account: credentials
+        ride only the phase extra-vars, never the persisted component row.
+        Returns (persistable vars, secret-only vars)."""
+        vars = dict(vars)
+        region = None
+        region_name = vars.get("vcenter_region", "")
+        if region_name:
+            region = self.repos.regions.get_by_name(region_name)
+        elif cluster.plan_id:
+            plan = self.repos.plans.get(cluster.plan_id)
+            if plan.provider == "vsphere":
+                region = self.repos.regions.get(plan.region_id)
+        if region is None:
+            raise ValidationError(
+                "vsphere-csi needs a vCenter: set vcenter_region to a "
+                "vsphere region (plan-mode vSphere clusters default to "
+                "their plan's region)"
+            )
+        if region.provider != "vsphere":
+            raise ValidationError(
+                f"region {region.name!r} is {region.provider}, "
+                "vsphere-csi needs a vsphere region"
+            )
+        # fail at install, not 300s into a live-cluster rollout: a region
+        # missing its connection vars renders [VirtualCenter ""] with an
+        # empty password and dies in the CSI controller with an opaque
+        # auth error
+        missing = [k for k in ("vcenter_host", "vcenter_user",
+                               "vcenter_password")
+                   if not region.vars.get(k)]
+        if missing:
+            raise ValidationError(
+                f"region {region.name!r} is missing {', '.join(missing)}; "
+                "vsphere-csi cannot connect without them"
+            )
+        if not (vars.get("vsphere_datastore_url")
+                or vars.get("vsphere_storage_policy")):
+            raise ValidationError(
+                "vsphere-csi needs vsphere_datastore_url or "
+                "vsphere_storage_policy to place volumes"
+            )
+        persisted = {**vars, "vcenter_region": region.name}
+        secrets = {
+            "vcenter_host": region.vars.get("vcenter_host", ""),
+            "vcenter_user": region.vars.get("vcenter_user", ""),
+            "vcenter_password": region.vars.get("vcenter_password", ""),
+            "vcenter_datacenter": region.vars.get("datacenter", "Datacenter"),
         }
         return persisted, secrets
 

@@ -412,6 +412,23 @@ SCHEMAS: dict[str, dict] = {
         },
         "required": ["apiVersion", "kind", "metadata", "spec"],
     },
+    "Namespace": _TOP,
+    "ServiceAccount": _TOP,
+    "CSIDriver": {
+        **_TOP,
+        "properties": {
+            **_TOP["properties"],
+            "spec": {
+                "type": "object",
+                "properties": {
+                    "attachRequired": {"type": "boolean"},
+                    "podInfoOnMount": {"type": "boolean"},
+                    "volumeLifecycleModes": {"type": "array"},
+                },
+            },
+        },
+        "required": ["apiVersion", "kind", "metadata", "spec"],
+    },
     "StorageClass": {
         **_TOP,
         "properties": {

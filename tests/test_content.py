@@ -405,7 +405,8 @@ def test_storage_components_wire_a_single_default_class():
     assert "unknown storage_default_class" in shared  # typo'd mode fails loud
     assert "storage_default_class | default('auto')" in shared
     for role, cls in (("component-nfs-provisioner", "nfs-client"),
-                      ("component-rook-ceph", "ceph-block")):
+                      ("component-rook-ceph", "ceph-block"),
+                      ("component-vsphere-csi", "vsphere-block")):
         text = open(os.path.join(ROLES, role, "tasks", "main.yml"),
                     encoding="utf-8").read()
         assert "storage-default-class/tasks/main.yml" in text, role

@@ -993,12 +993,99 @@ class TestComponents:
         ceph = svc.components.install("storval", "rook-ceph",
                                       {"ceph_device_filter": "^sd[b-z]"})
         assert ceph.status == "Installed"
-        # ...but NOT characters that could break out of the YAML scalar
-        # they render into (manifest injection via the device filter)
-        for evil in ('x"\n  cleanupPolicy: armed', "x\\", "a b"):
+        # ...but NOT characters that could break out of the double-quoted
+        # YAML scalar they render into (manifest injection via the device
+        # filter) — only quote/backslash/newline can escape it; a space is
+        # harmless (and legal in vSphere policy names sharing this rule)
+        for evil in ('x"\n  cleanupPolicy: armed', "x\\"):
             with pytest.raises(ValidationError, match="ceph_device_filter"):
                 svc.components.install("storval", "rook-ceph",
                                        {"ceph_device_filter": evil})
+
+    def test_vsphere_csi_resolves_region_and_installs(self, svc):
+        """VERDICT r3 missing #4: plan-mode vSphere clusters get a storage
+        story. The component resolves the vCenter from the plan's own
+        region; credentials ride extra-vars only, never the persisted row."""
+        region = svc.regions.create(Region(
+            name="dc-csi", provider="vsphere",
+            vars={"vcenter_host": "vc.local", "vcenter_user": "admin",
+                  "vcenter_password": "s3cr3t", "datacenter": "DC1"},
+        ))
+        zone = svc.zones.create(Zone(
+            name="csi-zone", region_id=region.id,
+            vars={"gateway": "10.9.1.1"},
+            ip_pool=[f"10.9.1.{i}" for i in range(10, 14)],
+        ))
+        svc.plans.create(Plan(
+            name="vs-csi", provider="vsphere", region_id=region.id,
+            zone_ids=[zone.id], master_count=1, worker_count=2,
+        ))
+        svc.clusters.create("vscsi", provision_mode="plan",
+                            plan_name="vs-csi", wait=True)
+        comp = svc.components.install(
+            "vscsi", "vsphere-csi", {"vsphere_storage_policy": "gold"})
+        assert comp.status == "Installed"
+        # region resolved from the plan; password never persisted
+        assert comp.vars["vcenter_region"] == "dc-csi"
+        assert "vcenter_password" not in comp.vars
+        assert "s3cr3t" not in str(comp.vars)
+        # the conf/driver/class pipeline actually ran through content
+        cluster = svc.clusters.get("vscsi")
+        joined = "\n".join(
+            l.line for l in svc.repos.task_logs.find(cluster_id=cluster.id))
+        assert "TASK [render csi-vsphere.conf]" in joined
+        assert "TASK [apply vsphere csi driver]" in joined
+        assert "TASK [apply StorageClass]" in joined
+
+    def test_vsphere_csi_validation(self, svc):
+        names = register_fleet(svc, 2)
+        svc.clusters.create("novc", spec=ClusterSpec(worker_count=1),
+                            host_names=names, wait=True)
+        # manual cluster, no region named -> pointed error
+        with pytest.raises(ValidationError, match="vcenter_region"):
+            svc.components.install("novc", "vsphere-csi",
+                                   {"vsphere_storage_policy": "gold"})
+        region = svc.regions.create(Region(
+            name="gcp-not-vc", provider="gcp_tpu_vm",
+            vars={"project": "p", "name": "us"}))
+        with pytest.raises(ValidationError, match="needs a vsphere region"):
+            svc.components.install(
+                "novc", "vsphere-csi",
+                {"vcenter_region": "gcp-not-vc",
+                 "vsphere_storage_policy": "gold"})
+        # a region missing its connection vars can't even be created (the
+        # provider-vars contract enforces them); the resolver re-checks as
+        # defense-in-depth for rows edited out-of-band
+        with pytest.raises(ValidationError, match="vcenter_user"):
+            svc.regions.create(Region(name="dc-empty", provider="vsphere",
+                                      vars={"vcenter_host": "vc.local"}))
+        vc = svc.regions.create(Region(
+            name="dc-val", provider="vsphere",
+            vars={"vcenter_host": "vc.local", "vcenter_user": "a",
+                  # ordinary vCenter password: shell-inertness must not
+                  # apply — it renders only into csi-vsphere.conf
+                  "vcenter_password": "P4ss!word {weird}"}))
+        # neither datastore url nor storage policy -> no placement
+        with pytest.raises(ValidationError, match="place volumes"):
+            svc.components.install("novc", "vsphere-csi",
+                                   {"vcenter_region": "dc-val"})
+        # ...but a quote could escape the conf's quoted value
+        svc.regions.create(Region(
+            name="dc-evil", provider="vsphere",
+            vars={"vcenter_host": "vc.local", "vcenter_user": "a",
+                  "vcenter_password": 'p"w'}))
+        with pytest.raises(ValidationError, match="vcenter_password"):
+            svc.components.install(
+                "novc", "vsphere-csi",
+                {"vcenter_region": "dc-evil",
+                 "vsphere_storage_policy": "gold"})
+        # the de-facto default policy name contains spaces and must work
+        comp = svc.components.install(
+            "novc", "vsphere-csi",
+            {"vcenter_region": "dc-val",
+             "vsphere_storage_policy": "vSAN Default Storage Policy",
+             "vsphere_datastore_url": "ds:///vmfs/volumes/5f1d/"})
+        assert comp.status == "Installed"
 
     def test_traefik_log_level_enum(self, svc):
         names = register_fleet(svc, 2)
