@@ -291,31 +291,15 @@ async function refreshClusters() {
   if (!clusters.length) {
     list.innerHTML = `<div class="muted">${t("no_clusters")}</div>`;
   }
-  // ops ordering comes from the tested logic module: unhealthy first
+  // ops ordering comes from the tested logic module: unhealthy first;
+  // the card markup itself is built (and escaped) in tested logic.py
   for (const c of KOLogic.rank_clusters(clusters)) {
     const card = document.createElement("div");
     card.className = "card";
-    // imported (kubeconfig-only) clusters: observe surfaces only — the
-  // SSH-gated day-2 sections are hidden rather than offered-and-refused
-  const imported = c.provision_mode === "imported";
-  const score = KOLogic.cluster_attention_score(c);
-  const badge = score > 0
-    ? `<span class="attention ${score >= 100 ? "crit" : "warn"}">${t("needs_attention")}</span>`
-    : "";
-  const conds = (c.status.conditions || []).map((x) =>
-      `<span class="cond ${x.status}">${esc(x.name)}</span>`).join("");
-    const smoke = c.status.smoke_chips
-      ? `<div class="smoke">psum ${c.status.smoke_gbps} GB/s · ${c.status.smoke_chips} chips${c.status.smoke_simulated ? ` <span class="sim-badge" title="${t("simulated_hint")}">${t("simulated")}</span>` : ""}</div>`
-      : "";
-    card.innerHTML = `
-      <h4>${esc(c.name)} ${badge}</h4>
-      <div><span class="phase ${c.status.phase}">${c.status.phase}</span>
-        <span class="muted"> · ${esc(c.spec.k8s_version)} · ${esc(c.spec.cni)}</span></div>
-      <div class="conds">${conds}</div>${smoke}
-      <div class="row">
-        <button data-open="${esc(c.name)}">${t("open")}</button>
-        <button data-del="${esc(c.name)}">${t("del")}</button>
-      </div>`;
+    card.innerHTML = KOLogic.render_cluster_card(c, {
+      needs_attention: t("needs_attention"), open: t("open"), del: t("del"),
+      simulated: t("simulated"), simulated_hint: t("simulated_hint"),
+    });
     card.querySelector("[data-open]").addEventListener("click", () => openCluster(c.name));
     card.querySelector("[data-del]").addEventListener("click", async () => {
       if (confirm(`${t("confirm_delete")} ${c.name}?`)) {
@@ -364,11 +348,6 @@ async function openCluster(name) {
   // imported (kubeconfig-only) clusters: observe surfaces only — the
   // SSH-gated day-2 sections are hidden rather than offered-and-refused
   const imported = c.provision_mode === "imported";
-  const conds = (c.status.conditions || []).map((x) =>
-    `<span class="cond ${x.status}" title="${esc(x.message || "")}">${esc(x.name)}` +
-    (x.finished_at && x.started_at
-      ? ` ${(x.finished_at - x.started_at).toFixed(1)}s` : "") +
-    `</span>`).join("");
   detail.innerHTML = `
     <div class="detail-head">
       <h3>${esc(name)} — <span class="phase ${c.status.phase}">${c.status.phase}</span></h3>
@@ -383,7 +362,7 @@ async function openCluster(name) {
         <button id="d-back">${t("back")}</button>
       </div>
     </div>
-    <div class="conds">${conds}</div>
+    <div class="conds">${KOLogic.render_condition_spans(c.status.conditions || [])}</div>
     ${tpuPanel.chips || tpuPanel.expected_chips ? `
     <div class="tpu-panel ${tpuPanel.ok ? "ok" : "bad"}">
       <b>TPU</b>
@@ -405,7 +384,7 @@ async function openCluster(name) {
 
     <h3>${t("nodes")}</h3>
     <table class="grid"><tr><th>name</th><th>role</th><th>status</th><th></th></tr>
-    ${nodes.map((n) => `<tr><td>${esc(n.name)}</td><td>${n.role}</td><td>${n.status}</td>
+    ${nodes.map((n) => `<tr><td>${esc(n.name)}</td><td>${esc(n.role)}</td><td>${esc(n.status)}</td>
       <td>${n.role === "worker" ? `<button data-rm-node="${esc(n.name)}" class="ghost">${t("remove")}</button>` : ""}</td></tr>`).join("")}
     </table>
     ${imported ? "" : `<div class="row">
@@ -415,7 +394,7 @@ async function openCluster(name) {
 
     <h3>${t("components")}</h3>
     <table class="grid"><tr><th>name</th><th>status</th><th></th></tr>
-    ${comps.map((x) => `<tr><td>${esc(x.name)}</td><td>${x.status}</td>
+    ${comps.map((x) => `<tr><td>${esc(x.name)}</td><td>${esc(x.status)}</td>
       <td><button data-un-comp="${esc(x.name)}" class="ghost">${t("uninstall")}</button></td></tr>`).join("")}
     </table>
     ${imported ? "" : `<div class="row">
@@ -441,7 +420,7 @@ async function openCluster(name) {
     <h3>${t("security")}</h3>
     ${cisDriftHtml(scans)}
     <table class="grid"><tr><th>scan</th><th>status</th><th>pass</th><th>fail</th><th>warn</th><th></th></tr>
-    ${scans.map((s, i) => `<tr><td>${esc(s.policy || s.id || s.name)}</td><td>${s.status}</td>
+    ${scans.map((s, i) => `<tr><td>${esc(s.policy || s.id || s.name)}</td><td>${esc(s.status)}</td>
       <td>${s.total_pass ?? s.passed ?? ""}</td><td>${s.total_fail ?? s.failed ?? ""}</td><td>${s.total_warn ?? s.warned ?? ""}</td>
       <td>${(s.checks || []).length ? `<button data-cis-findings="${i}" class="ghost">${t("findings")}</button>` : ""}</td></tr>`).join("")}
     </table>
@@ -469,7 +448,7 @@ async function openCluster(name) {
     <h3>${t("events")}</h3>
     ${eventPulse(events)}
     <div>${events.map((e) =>
-      `<div class="feed-item ${e.type}"><span class="when">${new Date(e.created_at * 1000).toLocaleTimeString()}</span>[${esc(e.reason)}] ${esc(e.message)}</div>`
+      `<div class="feed-item ${esc(e.type)}"><span class="when">${new Date(e.created_at * 1000).toLocaleTimeString()}</span>[${esc(e.reason)}] ${esc(e.message)}</div>`
     ).join("")}</div>`;
 
   const closeDetail = () => {
@@ -513,11 +492,8 @@ async function openCluster(name) {
   }
   $("#d-health").addEventListener("click", async () => {
     const h = await api("GET", `/api/v1/clusters/${name}/health`);
-    $("#d-health-out").innerHTML = '<div class="conds">' + h.probes.map((p) =>
-      `<span class="cond ${p.ok ? "OK" : "Failed"}" title="${esc(p.detail || "")}">${esc(p.name)}` +
-      (!p.ok && p.recovery && !imported
-        ? ` <button data-recover="${esc(p.name)}" class="ghost">${t("recover")}</button>`
-        : "") + `</span>`).join("") + "</div>";
+    $("#d-health-out").innerHTML = KOLogic.render_health_probes(
+      h.probes, !imported, { recover: t("recover") });
     // guided recovery: re-runs the adm phase matching the failed probe
     $("#d-health-out").querySelectorAll("[data-recover]").forEach((b) =>
       b.addEventListener("click", async () => {
@@ -640,13 +616,7 @@ async function openCluster(name) {
       const scan = scans[parseInt(b.dataset.cisFindings, 10)];
       const box = $("#d-cis-findings");
       box.hidden = false;
-      box.innerHTML = `<table class="grid">
-        <tr><th>check</th><th>status</th><th>node</th><th>finding</th><th>remediation</th></tr>
-        ${(scan.checks || []).map((c) => `<tr>
-          <td>${esc(c.id)}</td><td class="${c.status === "FAIL" ? "cis-fail" : "cis-warn"}">${esc(c.status)}</td>
-          <td>${esc(c.node || "—")}</td><td>${esc(c.text)}</td>
-          <td class="muted">${esc(c.remediation || "")}</td></tr>`).join("")}
-      </table>`;
+      box.innerHTML = KOLogic.render_cis_findings(scan.checks || []);
     }));
   if (me?.is_admin) {
     $("#d-term-open").addEventListener("click", async () => {
@@ -692,18 +662,8 @@ async function openCluster(name) {
   }
   // per-phase duration bars from the native trace (SURVEY §5.1 spans)
   api("GET", `/api/v1/clusters/${name}/trace`).then((trace) => {
-    const tr = KOLogic.trace_rows(trace);
-    $("#d-trace").innerHTML = tr.rows.map((r) => `
-      <div class="trace-row">
-        <span class="trace-name">${esc(r.name)}</span>
-        <span class="trace-track"><span class="trace-bar ${r.status}"
-          style="width:${r.pct}%"></span></span>
-        <span class="trace-dur">${r.duration_s != null
-          ? r.duration_s.toFixed(1) + "s" : "—"}</span>
-      </div>`).join("") +
-      (tr.total_s != null
-        ? `<div class="trace-total">${t("total")} ${tr.total_s.toFixed(1)}s</div>`
-        : "");
+    $("#d-trace").innerHTML = KOLogic.render_trace(
+      KOLogic.trace_rows(trace), { total: t("total") });
   }).catch(() => { $("#d-trace").textContent = "—"; });
 
   // live logs over SSE: full buffer kept client-side, re-rendered through
@@ -749,10 +709,10 @@ $("#new-cluster-btn").addEventListener("click", async () => {
   planCache = await api("GET", "/api/v1/plans");
   const sel = $("#wz-plan");
   sel.innerHTML = planCache.map((p) =>
-    `<option value="${esc(p.name)}">${esc(p.name)} (${p.provider}${p.accelerator === "tpu" ? " · " + p.tpu_type : ""})</option>`).join("");
+    `<option value="${esc(p.name)}">${esc(p.name)} (${esc(p.provider)}${p.accelerator === "tpu" ? " · " + esc(p.tpu_type) : ""})</option>`).join("");
   const vers = await api("GET", "/api/v1/version");
   $("#wz-k8s").innerHTML = vers.supported_k8s_versions.map((v) =>
-    `<option>${v}</option>`).join("");
+    `<option>${esc(v)}</option>`).join("");
   $("#wz-k8s").value = vers.supported_k8s_versions[2] || vers.supported_k8s_versions[0];
   renderTopology();
   wizardCheck();
@@ -818,11 +778,11 @@ function renderTopology() {
     const sum = KOLogic.tpu_plan_summary(topo, plan.num_slices || 1);
     const meta = document.createElement("div");
     meta.className = "topo-meta";
-    meta.innerHTML = `${topo.accelerator_type} — ${sum.total_chips} chips · ` +
+    meta.innerHTML = `${esc(topo.accelerator_type)} — ${sum.total_chips} chips · ` +
       `${sum.total_hosts} host${sum.total_hosts > 1 ? "s" : ""} · ` +
-      `ICI ${sum.ici_mesh}` +
+      `ICI ${esc(sum.ici_mesh)}` +
       (sum.num_slices > 1 ? ` × ${sum.num_slices} slices (DCN)` : "") +
-      `<br>runtime ${sum.runtime_version}`;
+      `<br>runtime ${esc(sum.runtime_version)}`;
     box.append(mesh, meta);
   });
 }
@@ -1106,15 +1066,7 @@ $("#ldap-sync-btn").addEventListener("click", async () => {
 // shared pager strip: prev/next + "page/pages · total" (data from
 // KOLogic.paginate — the DOM here is render-only)
 function renderPager(el, page, onNav) {
-  if (page.pages <= 1) {
-    el.innerHTML = page.total
-      ? `<span class="muted">${page.total} ${t("total")}</span>` : "";
-    return;
-  }
-  el.innerHTML =
-    `<button data-nav="prev" class="ghost" ${page.has_prev ? "" : "disabled"}>‹</button>
-     <span class="muted">${page.page}/${page.pages} · ${page.total} ${t("total")}</span>
-     <button data-nav="next" class="ghost" ${page.has_next ? "" : "disabled"}>›</button>`;
+  el.innerHTML = KOLogic.render_pager(page, { total: t("total") });
   el.querySelectorAll("[data-nav]").forEach((b) =>
     b.addEventListener("click", () =>
       onNav(b.dataset.nav === "next" ? 1 : -1)));
@@ -1126,18 +1078,9 @@ function renderHosts() {
   const filtered = KOLogic.filter_hosts(hostCache, $("#host-filter").value);
   const page = KOLogic.paginate(filtered, hostPage, 25);
   hostPage = page.page;
-  $("#hosts-table").innerHTML =
-    "<tr><th>name</th><th>ip</th><th>status</th><th>TPU</th><th></th></tr>" +
-    page.rows.map((h, i) => `<tr><td>${esc(h.name)}</td><td>${esc(h.ip)}</td><td>${h.status}</td>
-      <td>${h.tpu_chips > 0 ? `${h.tpu_chips} chips · slice ${h.tpu_slice_id} · worker ${h.tpu_worker_id}` : "—"}</td>
-      <td><button data-host-detail="${i}" class="ghost">${t("details")}</button>
-          ${me?.is_admin && !h.cluster_id ? `<button data-host-facts="${esc(h.name)}" class="ghost">${t("gather_facts")}</button>` : ""}</td></tr>` +
-      `<tr class="host-detail" id="host-detail-${i}" hidden><td colspan="5">
-        <div class="muted">
-          os ${esc(h.os || "?")} · arch ${esc(h.arch || "?")} ·
-          ${h.cpu_cores || "?"} cores · ${h.memory_mb ? (h.memory_mb / 1024).toFixed(1) + " GiB" : "?"}
-          · ssh ${esc(h.ip)}:${h.port} · cluster ${esc(h.cluster_id ? "bound" : "free")}
-        </div></td></tr>`).join("");
+  $("#hosts-table").innerHTML = KOLogic.render_hosts_rows(
+    page.rows, !!me?.is_admin,
+    { details: t("details"), gather_facts: t("gather_facts") });
   document.querySelectorAll("[data-host-detail]").forEach((b) =>
     b.addEventListener("click", () => {
       const row = $("#host-detail-" + b.dataset.hostDetail);
@@ -1166,10 +1109,7 @@ async function refreshAll() {
   if (!$("#tab-backups").hidden) {
     const accounts = await api("GET", "/api/v1/backup-accounts").catch(() => []);
     $("#backup-account-table").innerHTML =
-      "<tr><th>name</th><th>type</th><th>bucket</th><th>status</th><th></th></tr>" +
-      accounts.map((a) => `<tr><td>${esc(a.name)}</td><td>${a.type}</td><td>${esc(a.bucket)}</td>` +
-        `<td>${esc(a.status || "")}</td>` +
-        `<td><button data-test-account="${esc(a.name)}" class="ghost">test</button></td></tr>`).join("");
+      KOLogic.render_backup_accounts(accounts);
     $("#backup-account-table").querySelectorAll("[data-test-account]").forEach((b) =>
       b.addEventListener("click", async () => {
         b.disabled = true;
@@ -1186,8 +1126,6 @@ async function refreshAll() {
   if (!$("#tab-events").hidden) refreshEvents();
 }
 
-const delBtn = (kind, name) =>
-  `<button data-del-infra="${esc(kind)}:${esc(name)}" class="ghost">✕</button>`;
 function wireInfraDeletes(root) {
   root.querySelectorAll("[data-del-infra]").forEach((b) =>
     b.addEventListener("click", async () => {
@@ -1201,41 +1139,25 @@ function wireInfraDeletes(root) {
 }
 async function refreshInfra() {
   const plans = await api("GET", "/api/v1/plans").catch(() => []);
-  $("#plan-list").innerHTML = plans.map((p) => `
-    <div class="card"><h4>${esc(p.name)} ${delBtn("plans", p.name)}</h4>
-      <div class="muted">${p.provider} · masters ${p.master_count} · workers ${p.worker_count}</div>
-      ${p.accelerator === "tpu" ? `<div class="smoke">${p.tpu_type} · ${p.num_slices} slice(s)</div>` : ""}
-    </div>`).join("") || `<div class="muted">${t("no_plans")}</div>`;
+  $("#plan-list").innerHTML =
+    KOLogic.render_plan_cards(plans, { no_plans: t("no_plans") });
 
   const catalog = await api("GET", "/api/v1/plans-tpu-catalog").catch(() => []);
-  $("#tpu-catalog").innerHTML =
-    "<tr><th>type</th><th>chips</th><th>hosts</th><th>ICI mesh</th><th>runtime</th></tr>" +
-    catalog.map((x) => `<tr><td>${x.accelerator_type}</td><td>${x.chips}</td>
-      <td>${x.total_hosts}</td><td>${x.ici_mesh}</td><td>${x.runtime_version}</td></tr>`).join("");
+  $("#tpu-catalog").innerHTML = KOLogic.render_tpu_catalog(catalog);
 
   const regions = await api("GET", "/api/v1/regions").catch(() => []);
   const zones = await api("GET", "/api/v1/zones").catch(() => []);
-  $("#region-table").innerHTML =
-    "<tr><th>region</th><th>provider</th><th>zones</th><th></th></tr>" +
-    regions.map((r) => `<tr><td>${esc(r.name)}</td><td>${r.provider}</td>
-      <td>${zones.filter((z) => z.region_id === r.id).map((z) =>
-        `${esc(z.name)} ${delBtn("zones", z.name)}`).join(", ") || "—"}</td>
-      <td>${delBtn("regions", r.name)}</td></tr>`).join("");
+  $("#region-table").innerHTML = KOLogic.render_region_rows(regions, zones);
 
   const creds = await api("GET", "/api/v1/credentials").catch(() => []);
-  $("#credential-table").innerHTML =
-    "<tr><th>name</th><th>username</th><th>port</th><th></th></tr>" +
-    creds.map((x) => `<tr><td>${esc(x.name)}</td><td>${esc(x.username)}</td><td>${x.port}</td>
-      <td>${delBtn("credentials", x.name)}</td></tr>`).join("");
+  $("#credential-table").innerHTML = KOLogic.render_credentials(creds);
   wireInfraDeletes($("#tab-infra"));
 }
 
 async function refreshAdmin() {
   const projects = await api("GET", "/api/v1/projects").catch(() => []);
   $("#project-table").innerHTML =
-    "<tr><th>name</th><th>description</th><th></th></tr>" +
-    projects.map((p) => `<tr><td>${esc(p.name)}</td><td>${esc(p.description || "")}</td>
-      <td><button data-add-member="${esc(p.name)}" class="ghost">${t("add_member")}</button></td></tr>`).join("");
+    KOLogic.render_projects(projects, { add_member: t("add_member") });
   const allUsers = await api("GET", "/api/v1/users").catch(() => []);
   $("#project-table").querySelectorAll("[data-add-member]").forEach((b) =>
     b.addEventListener("click", () => {
@@ -1247,14 +1169,13 @@ async function refreshAdmin() {
       ], (out) => api("POST", `/api/v1/projects/${b.dataset.addMember}/members`, out));
     }));
   const users = await api("GET", "/api/v1/users").catch(() => []);
-  $("#user-table").innerHTML =
-    "<tr><th>name</th><th>email</th><th>role</th><th>source</th></tr>" +
-    users.map((u) => `<tr><td>${esc(u.name)}</td><td>${esc(u.email || "")}</td>
-      <td>${u.is_admin ? "admin" : "user"}</td><td>${u.source || "local"}</td></tr>`).join("");
+  $("#user-table").innerHTML = KOLogic.render_users(users);
   const msgs = await api("GET", "/api/v1/messages").catch(() => []);
-  $("#message-feed").innerHTML = msgs.map((m) =>
-    `<div class="feed-item ${m.level || ""}"><span class="when">${new Date((m.created_at || 0) * 1000).toLocaleString()}</span>${esc(m.title || m.reason || "")} — ${esc(m.body || m.message || "")}</div>`
-  ).join("") || `<div class="muted">${t("no_activity")}</div>`;
+  // locale datetime formatting is DOM-side; the markup is tested logic
+  $("#message-feed").innerHTML = KOLogic.render_message_feed(
+    msgs.map((m) => ({
+      ...m, when: new Date((m.created_at || 0) * 1000).toLocaleString(),
+    })), { no_activity: t("no_activity") });
 }
 
 // scan-over-scan CIS drift badge: regressions/resolved/persisting (data
@@ -1293,10 +1214,10 @@ function renderEvents() {
   const trunc = eventTotal > eventCache.length
     ? `<span class="muted"> (${t("newest")} ${eventCache.length}/${eventTotal})</span>` : "";
   $("#event-pulse").innerHTML = eventPulse(eventCache) + trunc;
-  $("#event-feed").innerHTML = page.rows.map((e) =>
-    `<div class="feed-item ${e.type}"><span class="when">${new Date(e.created_at * 1000).toLocaleString()}</span>
-     <b>${esc(e.cluster)}</b> [${esc(e.reason)}] ${esc(e.message)}</div>`).join("") ||
-    `<div class="muted">${t("no_activity")}</div>`;
+  $("#event-feed").innerHTML = KOLogic.render_event_feed(
+    page.rows.map((e) => ({
+      ...e, when: new Date(e.created_at * 1000).toLocaleString(),
+    })), { no_activity: t("no_activity") });
   renderPager($("#event-pager"), page, (d) => { eventPage += d; renderEvents(); });
 }
 $("#event-filter").addEventListener("input", () => { eventPage = 1; renderEvents(); });

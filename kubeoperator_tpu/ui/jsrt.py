@@ -89,6 +89,42 @@ def kind(x):
     return "dict"
 
 
+def fixed1(x):
+    """One-decimal string (JS twin: floor-based half-up then pad) — used by
+    render functions for durations; Python's format() and JS toFixed round
+    differently on halves, so both sides share the round2-style formula."""
+    v = math.floor(x * 10 + 0.5) / 10.0
+    s = str(v)
+    if "." not in s:
+        s = s + ".0"
+    return s
+
+
+def esc(x):
+    """HTML-escape for render functions: None -> "", everything else
+    stringified then &<>"' entity-escaped — matching the browser-side esc()
+    in app.js and the _rt.esc twin. EVERY dynamic value a logic.py render
+    function interpolates into markup must pass through here.
+
+    Integral floats stringify WITHOUT the trailing .0 (JS has one number
+    type: String(85.0) is "85") so a Python-side test can never pin output
+    the browser would render differently."""
+    if x is None:
+        s = ""
+    elif x is True:
+        s = "true"
+    elif x is False:
+        s = "false"
+    elif isinstance(x, float) and not math.isinf(x) and not math.isnan(x) \
+            and x == math.floor(x) and abs(x) < 1e15:
+        s = str(int(x))
+    else:
+        s = str(x)
+    return (s.replace("&", "&amp;").replace("<", "&lt;")
+             .replace(">", "&gt;").replace('"', "&quot;")
+             .replace("'", "&#39;"))
+
+
 def to_str(x):
     """str() twin: JS String(null) is 'null', so both sides map None->'None'."""
     if x is None:

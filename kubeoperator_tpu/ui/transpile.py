@@ -74,6 +74,19 @@ JS_PRELUDE = textwrap.dedent("""\
         if (Array.isArray(x)) return "list";
         return "dict";
       },
+      fixed1: function (x) {
+        const v = Math.floor(x * 10 + 0.5) / 10;
+        return Number.isInteger(v) ? v + ".0" : String(v);
+      },
+      esc: function (x) {
+        // split/join rather than a regex char-class so the JS-shape
+        // string scanner in tests can lex this prelude (no quote chars
+        // outside string literals, no apostrophes in comments)
+        const s = (x === null || x === undefined) ? "" : String(x);
+        return s.split("&").join("&amp;").split("<").join("&lt;")
+                .split(">").join("&gt;").split('"').join("&quot;")
+                .split("'").join("&#39;");
+      },
     };
 """)
 
