@@ -17,8 +17,56 @@ tunneled TPUs cannot inflate results. Extra context rides in "details".
 
 from __future__ import annotations
 
+import glob
 import json
+import os
 import sys
+
+
+def prior_run_comparison(result: dict, here: str | None = None) -> dict | None:
+    """Run-over-run visibility (VERDICT r3 #4/weak #2): read the newest
+    driver-recorded BENCH_r*.json beside this script and report the
+    headline delta plus deltas for the drift-prone details. A >1% headline
+    drop is flagged — with ~2% tunnel variance it is a WATCH signal, not
+    proof of regression, and the flag says so."""
+    here = here or os.path.dirname(os.path.abspath(__file__))
+    runs = sorted(glob.glob(os.path.join(here, "BENCH_r*.json")))
+    # newest PARSEABLE run wins: one crashed round (empty "parsed" in the
+    # driver wrapper) must not erase the comparison against the round
+    # before it. Everything here is best-effort diagnostics — no exception
+    # may sink the headline JSON after the multi-minute sweep already ran.
+    for path in reversed(runs):
+        try:
+            with open(path, encoding="utf-8") as f:
+                prior = json.load(f)
+            parsed = prior.get("parsed") or prior  # driver wraps; raw ok
+            prev_value = float(parsed["value"])
+            prev_details = parsed.get("details", {})
+            if not isinstance(prev_details, dict):
+                prev_details = {}
+            out: dict = {"file": os.path.basename(path),
+                         "metric": parsed.get("metric", "?"),
+                         "value": prev_value}
+            if parsed.get("metric") == result["metric"] and prev_value > 0:
+                delta = (result["value"] - prev_value) / prev_value * 100.0
+                out["headline_delta_pct"] = round(delta, 2)
+                # ~2% is known tunnel/clock variance (MXU rerun
+                # rationale); past 1% it is a WATCH signal, not proof
+                out["headline_watch"] = delta < -1.0
+            detail_deltas = {}
+            for key in ("hbm_triad_gbps", "dma_read_gbps", "train_mfu_pct",
+                        "train_model_tflops_per_s"):
+                prev = prev_details.get(key)
+                cur = result["details"].get(key)
+                if isinstance(prev, (int, float)) \
+                        and isinstance(cur, (int, float)) and prev > 0:
+                    detail_deltas[key] = round((cur - prev) / prev * 100.0, 2)
+            if detail_deltas:
+                out["detail_delta_pct"] = detail_deltas
+            return out
+        except Exception:
+            continue
+    return None
 
 
 def main() -> int:
@@ -113,10 +161,21 @@ def main() -> int:
         m = mxu_matmul_tflops(size=best_size_iters[0],
                               iters=best_size_iters[1])
         details[f"mxu_tflops_{best_size_iters[0]}_rerun"] = round(m.tflops, 1)
+        # headline variance band: the winning shape's two draws — the
+        # honest way to read a run-over-run delta (VERDICT r3 weak #2)
+        details["mxu_headline_band"] = sorted(
+            [round(best_m.tflops, 1), round(m.tflops, 1)])
         if m.tflops > best_m.tflops:
             best_m = m
-        h = hbm_bandwidth_gbps(size_mb=256, iters=200)
-        details["hbm_triad_gbps"] = round(h.gbps, 1)
+        # best-of-2 with the spread recorded: the r4 sweep showed ±4%
+        # run-to-run tunnel variance at a ~670-720 plateau (ops/hbm.py
+        # ceiling analysis) — a single draw reads as drift
+        h1 = hbm_bandwidth_gbps(size_mb=256, iters=200)
+        h2 = hbm_bandwidth_gbps(size_mb=256, iters=200)
+        details["hbm_triad_gbps"] = round(max(h1.gbps, h2.gbps), 1)
+        details["hbm_triad_band_gbps"] = [
+            round(min(h1.gbps, h2.gbps), 1), round(max(h1.gbps, h2.gbps), 1),
+        ]
         # manual-DMA peak read bandwidth (double-buffered pallas stream) —
         # reported beside the triad so both the fused-XLA sustained number
         # and the copy-engine ceiling are visible (VERDICT r1 item 5)
@@ -166,6 +225,9 @@ def main() -> int:
         }
 
     result["details"] = details
+    prior = prior_run_comparison(result)
+    if prior is not None:
+        details["prior_run"] = prior
     print(json.dumps(result), flush=True)
     return 0
 

@@ -10,7 +10,37 @@ double-buffered pipeline cannot overlap the three streams as aggressively
 as XLA's fused loop. Streaming elementwise is exactly what the guide says
 to leave to the compiler ("let XLA fuse — don't hand-schedule what the
 compiler already does"); manual-DMA peak bandwidth is reported separately
-by ops/pallas_kernels.py::dma_read_bandwidth_gbps (~735 GB/s, 90%).
+by ops/pallas_kernels.py::dma_read_bandwidth_gbps (~735-761 GB/s, 90-93%).
+
+MEASURED CEILING ANALYSIS (r4 sweep, real v5e behind the axon tunnel —
+the VERDICT r3 #4 knee investigation; all long-loop differential timing,
+hi=40, trials=5, values in GB/s):
+
+* size sweep (f32, cols=1024): 256MB buffers sit on the plateau; 512MB
+  → 619, 1024MB → 550 (sustained decline at large working sets — refresh/
+  page pressure). Below ~128MB per buffer the number INFLATES past the
+  819 datasheet (821-1095 observed, physically impossible): v5e's large
+  VMEM lets XLA keep part of the working set on-chip, so small-buffer
+  runs are not HBM measurements at all. 256MB/buffer (768MB traffic per
+  iteration) is the smallest size that provably streams.
+* layout sweep (cols 512/1024/2048/4096/8192 at 256MB): short-loop runs
+  suggested cols=4096 wins (751); long-loop repeats collapse the spread —
+  672-722 across ALL layouts with ±4% run-to-run tunnel variance. Layout
+  is not a lever here.
+* dtype: bf16 triad is WORSE (611-639) — halving element size doubles
+  element count for the same bytes and the VPU-side loop, not HBM,
+  becomes the limiter.
+* stream decomposition (same buffers, same timing): read-only 623-651,
+  write-only 536-624, copy 1R1W 667-710, triad 2R1W 650-682. Mixed
+  read+write traffic BEATS either pure direction — the HBM controller
+  overlaps directions — so no access-mix rebalancing can lift the triad:
+  copy, the best mix, peaks ~710.
+
+Conclusion: ~670-720 sustained (82-88% of datasheet) IS the fused-XLA
+3-stream ceiling on this part; the 761 GB/s manual-DMA read shows the
+remaining headroom belongs to read-dominated manual pipelines, not to any
+triad. The bench reports best-of-2 with the spread so tunnel variance is
+visible instead of reading as progress/regression.
 """
 
 from __future__ import annotations
