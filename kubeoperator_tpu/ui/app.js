@@ -27,6 +27,9 @@ const esc = (s) => String(s ?? "").replace(/[&<>"']/g, (c) => ({
   "&": "&amp;", "<": "&lt;", ">": "&gt;", '"': "&quot;", "'": "&#39;" }[c]));
 
 /* ---------- i18n (upstream parity: zh/en message center) ---------- */
+// full active-locale table (en fallback per key) handed to the
+// logic.py render functions — headers and labels localize there
+const L = () => ({ ...I18N.en, ...I18N[lang] });
 const I18N = {
   en: {
     sign_in: "Sign in", clusters: "Clusters", hosts: "Hosts", infra: "Infra",
@@ -85,6 +88,15 @@ const I18N = {
     advanced: "Advanced", cni: "CNI", runtime: "Runtime",
     kube_proxy: "kube-proxy", ingress: "Ingress",
     nodelocaldns: "Node-local DNS cache",
+    th_name: "name", th_ip: "ip", th_status: "status", th_type: "type",
+    th_bucket: "bucket", th_check: "check", th_node: "node",
+    th_finding: "finding", th_remediation: "remediation",
+    th_chips: "chips", th_hosts: "hosts", th_ici_mesh: "ICI mesh",
+    th_runtime: "runtime", th_region: "region", th_provider: "provider",
+    th_zones: "zones", th_username: "username", th_port: "port",
+    th_description: "description", th_email: "email", th_role: "role",
+    th_source: "source", th_file: "file", th_created: "created",
+    th_scan: "scan", th_pass: "pass", th_fail: "fail", th_warn: "warn",
   },
   zh: {
     sign_in: "登录", clusters: "集群", hosts: "主机", infra: "基础设施",
@@ -142,6 +154,15 @@ const I18N = {
     advanced: "高级选项", cni: "网络插件", runtime: "容器运行时",
     kube_proxy: "kube-proxy 模式", ingress: "Ingress 控制器",
     nodelocaldns: "节点本地 DNS 缓存",
+    th_name: "名称", th_ip: "IP", th_status: "状态", th_type: "类型",
+    th_bucket: "存储桶", th_check: "检查项", th_node: "节点",
+    th_finding: "发现", th_remediation: "修复建议",
+    th_chips: "芯片数", th_hosts: "主机数", th_ici_mesh: "ICI 网格",
+    th_runtime: "运行时", th_region: "区域", th_provider: "提供商",
+    th_zones: "可用区", th_username: "用户名", th_port: "端口",
+    th_description: "描述", th_email: "邮箱", th_role: "角色",
+    th_source: "来源", th_file: "文件", th_created: "创建时间",
+    th_scan: "扫描", th_pass: "通过", th_fail: "失败", th_warn: "警告",
   },
 };
 let lang = localStorage.getItem("ko-lang") || "en";
@@ -292,14 +313,13 @@ async function refreshClusters() {
     list.innerHTML = `<div class="muted">${t("no_clusters")}</div>`;
   }
   // ops ordering comes from the tested logic module: unhealthy first;
-  // the card markup itself is built (and escaped) in tested logic.py
+  // the card markup itself is built (and escaped) in tested logic.py.
+  // one locale-table merge for the whole refresh, not one per card
+  const labels = L();
   for (const c of KOLogic.rank_clusters(clusters)) {
     const card = document.createElement("div");
     card.className = "card";
-    card.innerHTML = KOLogic.render_cluster_card(c, {
-      needs_attention: t("needs_attention"), open: t("open"), del: t("del"),
-      simulated: t("simulated"), simulated_hint: t("simulated_hint"),
-    });
+    card.innerHTML = KOLogic.render_cluster_card(c, labels);
     card.querySelector("[data-open]").addEventListener("click", () => openCluster(c.name));
     card.querySelector("[data-del]").addEventListener("click", async () => {
       if (confirm(`${t("confirm_delete")} ${c.name}?`)) {
@@ -383,7 +403,7 @@ async function openCluster(name) {
     <div id="d-trace" class="trace"></div>
 
     <h3>${t("nodes")}</h3>
-    <table class="grid"><tr><th>name</th><th>role</th><th>status</th><th></th></tr>
+    <table class="grid"><tr><th>${t("th_name")}</th><th>${t("th_role")}</th><th>${t("th_status")}</th><th></th></tr>
     ${nodes.map((n) => `<tr><td>${esc(n.name)}</td><td>${esc(n.role)}</td><td>${esc(n.status)}</td>
       <td>${n.role === "worker" ? `<button data-rm-node="${esc(n.name)}" class="ghost">${t("remove")}</button>` : ""}</td></tr>`).join("")}
     </table>
@@ -393,7 +413,7 @@ async function openCluster(name) {
     </div>`}
 
     <h3>${t("components")}</h3>
-    <table class="grid"><tr><th>name</th><th>status</th><th></th></tr>
+    <table class="grid"><tr><th>${t("th_name")}</th><th>${t("th_status")}</th><th></th></tr>
     ${comps.map((x) => `<tr><td>${esc(x.name)}</td><td>${esc(x.status)}</td>
       <td><button data-un-comp="${esc(x.name)}" class="ghost">${t("uninstall")}</button></td></tr>`).join("")}
     </table>
@@ -404,7 +424,7 @@ async function openCluster(name) {
     </div>`}
 
     <h3>${t("etcd_backups")}</h3>
-    <table class="grid"><tr><th>file</th><th>created</th><th></th></tr>
+    <table class="grid"><tr><th>${t("th_file")}</th><th>${t("th_created")}</th><th></th></tr>
     ${backups.map((f) => `<tr><td>${esc(f.file_name || f.name)}</td>
       <td>${esc(f.created_at || "")}</td>
       <td><button data-restore="${esc(f.file_name || f.name)}" class="ghost">${t("restore")}</button></td></tr>`).join("")}
@@ -419,7 +439,7 @@ async function openCluster(name) {
 
     <h3>${t("security")}</h3>
     ${cisDriftHtml(scans)}
-    <table class="grid"><tr><th>scan</th><th>status</th><th>pass</th><th>fail</th><th>warn</th><th></th></tr>
+    <table class="grid"><tr><th>${t("th_scan")}</th><th>${t("th_status")}</th><th>${t("th_pass")}</th><th>${t("th_fail")}</th><th>${t("th_warn")}</th><th></th></tr>
     ${scans.map((s, i) => `<tr><td>${esc(s.policy || s.id || s.name)}</td><td>${esc(s.status)}</td>
       <td>${s.total_pass ?? s.passed ?? ""}</td><td>${s.total_fail ?? s.failed ?? ""}</td><td>${s.total_warn ?? s.warned ?? ""}</td>
       <td>${(s.checks || []).length ? `<button data-cis-findings="${i}" class="ghost">${t("findings")}</button>` : ""}</td></tr>`).join("")}
@@ -492,8 +512,7 @@ async function openCluster(name) {
   }
   $("#d-health").addEventListener("click", async () => {
     const h = await api("GET", `/api/v1/clusters/${name}/health`);
-    $("#d-health-out").innerHTML = KOLogic.render_health_probes(
-      h.probes, !imported, { recover: t("recover") });
+    $("#d-health-out").innerHTML = KOLogic.render_health_probes(h.probes, !imported, L());
     // guided recovery: re-runs the adm phase matching the failed probe
     $("#d-health-out").querySelectorAll("[data-recover]").forEach((b) =>
       b.addEventListener("click", async () => {
@@ -616,7 +635,7 @@ async function openCluster(name) {
       const scan = scans[parseInt(b.dataset.cisFindings, 10)];
       const box = $("#d-cis-findings");
       box.hidden = false;
-      box.innerHTML = KOLogic.render_cis_findings(scan.checks || []);
+      box.innerHTML = KOLogic.render_cis_findings(scan.checks || [], L());
     }));
   if (me?.is_admin) {
     $("#d-term-open").addEventListener("click", async () => {
@@ -662,8 +681,7 @@ async function openCluster(name) {
   }
   // per-phase duration bars from the native trace (SURVEY §5.1 spans)
   api("GET", `/api/v1/clusters/${name}/trace`).then((trace) => {
-    $("#d-trace").innerHTML = KOLogic.render_trace(
-      KOLogic.trace_rows(trace), { total: t("total") });
+    $("#d-trace").innerHTML = KOLogic.render_trace(KOLogic.trace_rows(trace), L());
   }).catch(() => { $("#d-trace").textContent = "—"; });
 
   // live logs over SSE: full buffer kept client-side, re-rendered through
@@ -1066,7 +1084,7 @@ $("#ldap-sync-btn").addEventListener("click", async () => {
 // shared pager strip: prev/next + "page/pages · total" (data from
 // KOLogic.paginate — the DOM here is render-only)
 function renderPager(el, page, onNav) {
-  el.innerHTML = KOLogic.render_pager(page, { total: t("total") });
+  el.innerHTML = KOLogic.render_pager(page, L());
   el.querySelectorAll("[data-nav]").forEach((b) =>
     b.addEventListener("click", () =>
       onNav(b.dataset.nav === "next" ? 1 : -1)));
@@ -1078,9 +1096,7 @@ function renderHosts() {
   const filtered = KOLogic.filter_hosts(hostCache, $("#host-filter").value);
   const page = KOLogic.paginate(filtered, hostPage, 25);
   hostPage = page.page;
-  $("#hosts-table").innerHTML = KOLogic.render_hosts_rows(
-    page.rows, !!me?.is_admin,
-    { details: t("details"), gather_facts: t("gather_facts") });
+  $("#hosts-table").innerHTML = KOLogic.render_hosts_rows(page.rows, !!me?.is_admin, L());
   document.querySelectorAll("[data-host-detail]").forEach((b) =>
     b.addEventListener("click", () => {
       const row = $("#host-detail-" + b.dataset.hostDetail);
@@ -1109,7 +1125,7 @@ async function refreshAll() {
   if (!$("#tab-backups").hidden) {
     const accounts = await api("GET", "/api/v1/backup-accounts").catch(() => []);
     $("#backup-account-table").innerHTML =
-      KOLogic.render_backup_accounts(accounts);
+      KOLogic.render_backup_accounts(accounts, L());
     $("#backup-account-table").querySelectorAll("[data-test-account]").forEach((b) =>
       b.addEventListener("click", async () => {
         b.disabled = true;
@@ -1140,24 +1156,24 @@ function wireInfraDeletes(root) {
 async function refreshInfra() {
   const plans = await api("GET", "/api/v1/plans").catch(() => []);
   $("#plan-list").innerHTML =
-    KOLogic.render_plan_cards(plans, { no_plans: t("no_plans") });
+    KOLogic.render_plan_cards(plans, L());
 
   const catalog = await api("GET", "/api/v1/plans-tpu-catalog").catch(() => []);
-  $("#tpu-catalog").innerHTML = KOLogic.render_tpu_catalog(catalog);
+  $("#tpu-catalog").innerHTML = KOLogic.render_tpu_catalog(catalog, L());
 
   const regions = await api("GET", "/api/v1/regions").catch(() => []);
   const zones = await api("GET", "/api/v1/zones").catch(() => []);
-  $("#region-table").innerHTML = KOLogic.render_region_rows(regions, zones);
+  $("#region-table").innerHTML = KOLogic.render_region_rows(regions, zones, L());
 
   const creds = await api("GET", "/api/v1/credentials").catch(() => []);
-  $("#credential-table").innerHTML = KOLogic.render_credentials(creds);
+  $("#credential-table").innerHTML = KOLogic.render_credentials(creds, L());
   wireInfraDeletes($("#tab-infra"));
 }
 
 async function refreshAdmin() {
   const projects = await api("GET", "/api/v1/projects").catch(() => []);
   $("#project-table").innerHTML =
-    KOLogic.render_projects(projects, { add_member: t("add_member") });
+    KOLogic.render_projects(projects, L());
   const allUsers = await api("GET", "/api/v1/users").catch(() => []);
   $("#project-table").querySelectorAll("[data-add-member]").forEach((b) =>
     b.addEventListener("click", () => {
@@ -1169,13 +1185,13 @@ async function refreshAdmin() {
       ], (out) => api("POST", `/api/v1/projects/${b.dataset.addMember}/members`, out));
     }));
   const users = await api("GET", "/api/v1/users").catch(() => []);
-  $("#user-table").innerHTML = KOLogic.render_users(users);
+  $("#user-table").innerHTML = KOLogic.render_users(users, L());
   const msgs = await api("GET", "/api/v1/messages").catch(() => []);
   // locale datetime formatting is DOM-side; the markup is tested logic
   $("#message-feed").innerHTML = KOLogic.render_message_feed(
     msgs.map((m) => ({
       ...m, when: new Date((m.created_at || 0) * 1000).toLocaleString(),
-    })), { no_activity: t("no_activity") });
+    })), L());
 }
 
 // scan-over-scan CIS drift badge: regressions/resolved/persisting (data
@@ -1217,7 +1233,7 @@ function renderEvents() {
   $("#event-feed").innerHTML = KOLogic.render_event_feed(
     page.rows.map((e) => ({
       ...e, when: new Date(e.created_at * 1000).toLocaleString(),
-    })), { no_activity: t("no_activity") });
+    })), L());
   renderPager($("#event-pager"), page, (d) => { eventPage += d; renderEvents(); });
 }
 $("#event-filter").addEventListener("input", () => { eventPage = 1; renderEvents(); });

@@ -831,10 +831,16 @@ def render_health_probes(probes, can_recover, labels):
     return "".join(parts)
 
 
-def render_cis_findings(checks):
+def render_cis_findings(checks, labels):
     """Failed/warn kube-bench rows for one scan."""
-    parts = ['<table class="grid"><tr><th>check</th><th>status</th>'
-             '<th>node</th><th>finding</th><th>remediation</th></tr>']
+    h_check = jsrt.esc(jsrt.get(labels, "th_check", "check"))
+    h_status = jsrt.esc(jsrt.get(labels, "th_status", "status"))
+    h_node = jsrt.esc(jsrt.get(labels, "th_node", "node"))
+    h_finding = jsrt.esc(jsrt.get(labels, "th_finding", "finding"))
+    h_fix = jsrt.esc(jsrt.get(labels, "th_remediation", "remediation"))
+    parts = [f'<table class="grid"><tr><th>{h_check}</th>'
+             f'<th>{h_status}</th><th>{h_node}</th><th>{h_finding}</th>'
+             f'<th>{h_fix}</th></tr>']
     for c in checks:
         status = jsrt.get(c, "status", "")
         cls = "cis-fail" if status == "FAIL" else "cis-warn"
@@ -880,8 +886,11 @@ def render_trace(tr, labels):
 def render_hosts_rows(rows, is_admin, labels):
     """Host table rows + collapsible detail rows (data-host-detail ids are
     unique per render — each render replaces the whole table)."""
-    parts = ["<tr><th>name</th><th>ip</th><th>status</th><th>TPU</th>"
-             "<th></th></tr>"]
+    h_name = jsrt.esc(jsrt.get(labels, "th_name", "name"))
+    h_ip = jsrt.esc(jsrt.get(labels, "th_ip", "ip"))
+    h_status = jsrt.esc(jsrt.get(labels, "th_status", "status"))
+    parts = [f"<tr><th>{h_name}</th><th>{h_ip}</th><th>{h_status}</th>"
+             f"<th>TPU</th><th></th></tr>"]
     i = 0
     for h in rows:
         name = jsrt.esc(jsrt.get(h, "name", ""))
@@ -925,9 +934,13 @@ def render_hosts_rows(rows, is_admin, labels):
     return "".join(parts)
 
 
-def render_backup_accounts(accounts):
-    parts = ["<tr><th>name</th><th>type</th><th>bucket</th><th>status</th>"
-             "<th></th></tr>"]
+def render_backup_accounts(accounts, labels):
+    h_name = jsrt.esc(jsrt.get(labels, "th_name", "name"))
+    h_type = jsrt.esc(jsrt.get(labels, "th_type", "type"))
+    h_bucket = jsrt.esc(jsrt.get(labels, "th_bucket", "bucket"))
+    h_status = jsrt.esc(jsrt.get(labels, "th_status", "status"))
+    parts = [f"<tr><th>{h_name}</th><th>{h_type}</th><th>{h_bucket}</th>"
+             f"<th>{h_status}</th><th></th></tr>"]
     for a in accounts:
         name = jsrt.esc(jsrt.get(a, "name", ""))
         type_ = jsrt.esc(jsrt.get(a, "type", ""))
@@ -999,9 +1012,14 @@ def render_plan_cards(plans, labels):
     return "".join(parts)
 
 
-def render_tpu_catalog(catalog):
-    parts = ["<tr><th>type</th><th>chips</th><th>hosts</th>"
-             "<th>ICI mesh</th><th>runtime</th></tr>"]
+def render_tpu_catalog(catalog, labels):
+    h_type = jsrt.esc(jsrt.get(labels, "th_type", "type"))
+    h_chips = jsrt.esc(jsrt.get(labels, "th_chips", "chips"))
+    h_hosts = jsrt.esc(jsrt.get(labels, "th_hosts", "hosts"))
+    h_mesh = jsrt.esc(jsrt.get(labels, "th_ici_mesh", "ICI mesh"))
+    h_runtime = jsrt.esc(jsrt.get(labels, "th_runtime", "runtime"))
+    parts = [f"<tr><th>{h_type}</th><th>{h_chips}</th><th>{h_hosts}</th>"
+             f"<th>{h_mesh}</th><th>{h_runtime}</th></tr>"]
     for x in catalog:
         acc = jsrt.esc(jsrt.get(x, "accelerator_type", ""))
         chips = jsrt.esc(jsrt.get(x, "chips", 0))
@@ -1013,11 +1031,14 @@ def render_tpu_catalog(catalog):
     return "".join(parts)
 
 
-def render_region_rows(regions, zones):
+def render_region_rows(regions, zones, labels):
     """Region table with the region's zones (and their delete buttons)
     grouped into one cell."""
-    parts = ["<tr><th>region</th><th>provider</th><th>zones</th>"
-             "<th></th></tr>"]
+    h_region = jsrt.esc(jsrt.get(labels, "th_region", "region"))
+    h_provider = jsrt.esc(jsrt.get(labels, "th_provider", "provider"))
+    h_zones = jsrt.esc(jsrt.get(labels, "th_zones", "zones"))
+    parts = [f"<tr><th>{h_region}</th><th>{h_provider}</th>"
+             f"<th>{h_zones}</th><th></th></tr>"]
     for r in regions:
         name = jsrt.esc(jsrt.get(r, "name", ""))
         provider = jsrt.esc(jsrt.get(r, "provider", ""))
@@ -1039,8 +1060,12 @@ def render_region_rows(regions, zones):
     return "".join(parts)
 
 
-def render_credentials(creds):
-    parts = ["<tr><th>name</th><th>username</th><th>port</th><th></th></tr>"]
+def render_credentials(creds, labels):
+    h_name = jsrt.esc(jsrt.get(labels, "th_name", "name"))
+    h_user = jsrt.esc(jsrt.get(labels, "th_username", "username"))
+    h_port = jsrt.esc(jsrt.get(labels, "th_port", "port"))
+    parts = [f"<tr><th>{h_name}</th><th>{h_user}</th><th>{h_port}</th>"
+             f"<th></th></tr>"]
     for x in creds:
         name = jsrt.esc(jsrt.get(x, "name", ""))
         username = jsrt.esc(jsrt.get(x, "username", ""))
@@ -1052,7 +1077,9 @@ def render_credentials(creds):
 
 
 def render_projects(projects, labels):
-    parts = ["<tr><th>name</th><th>description</th><th></th></tr>"]
+    h_name = jsrt.esc(jsrt.get(labels, "th_name", "name"))
+    h_desc = jsrt.esc(jsrt.get(labels, "th_description", "description"))
+    parts = [f"<tr><th>{h_name}</th><th>{h_desc}</th><th></th></tr>"]
     add = jsrt.esc(jsrt.get(labels, "add_member", "+"))
     for p in projects:
         name = jsrt.esc(jsrt.get(p, "name", ""))
@@ -1063,9 +1090,13 @@ def render_projects(projects, labels):
     return "".join(parts)
 
 
-def render_users(users):
-    parts = ["<tr><th>name</th><th>email</th><th>role</th><th>source</th>"
-             "</tr>"]
+def render_users(users, labels):
+    h_name = jsrt.esc(jsrt.get(labels, "th_name", "name"))
+    h_email = jsrt.esc(jsrt.get(labels, "th_email", "email"))
+    h_role = jsrt.esc(jsrt.get(labels, "th_role", "role"))
+    h_source = jsrt.esc(jsrt.get(labels, "th_source", "source"))
+    parts = [f"<tr><th>{h_name}</th><th>{h_email}</th><th>{h_role}</th>"
+             f"<th>{h_source}</th></tr>"]
     for u in users:
         name = jsrt.esc(jsrt.get(u, "name", ""))
         email = jsrt.esc(jsrt.get(u, "email", ""))

@@ -431,7 +431,7 @@ class TestRenderLayer:
 
         html = logic.render_cis_findings([{
             "id": EVIL, "status": "FAIL", "node": EVIL, "text": EVIL,
-            "remediation": EVIL}])
+            "remediation": EVIL}], {})
         assert "<img" not in html and 'class="cis-fail"' in html
 
         html = logic.render_hosts_rows([{
@@ -457,7 +457,15 @@ class TestRenderLayer:
              [{"name": EVIL, "email": EVIL, "is_admin": False,
                "source": EVIL}]),
         ):
-            assert "<img" not in fn(rows), fn.__name__
+            assert "<img" not in fn(rows, {}), fn.__name__
+            # localized headers flow from the labels table; no single
+            # header key is shared by all four tables, so pick per
+            # function (accounts/catalog have a type column, creds/users
+            # key off name)
+            header_key = ("th_type"
+                          if fn is not logic.render_credentials
+                          and fn is not logic.render_users else "th_name")
+            assert "本地化" in fn(rows, {header_key: "本地化"}), fn.__name__
 
     def test_feeds_and_plans_and_regions_escape(self):
         html = logic.render_event_feed([{
@@ -481,11 +489,11 @@ class TestRenderLayer:
 
         html = logic.render_region_rows(
             [{"id": "r1", "name": EVIL, "provider": "vsphere"}],
-            [{"region_id": "r1", "name": EVIL}])
+            [{"region_id": "r1", "name": EVIL}], {})
         assert "<img" not in html and "data-del-infra=" in html
         # zone grouped under its region, empty group renders a dash
         assert "—" in logic.render_region_rows(
-            [{"id": "r2", "name": "dc", "provider": "vsphere"}], [])
+            [{"id": "r2", "name": "dc", "provider": "vsphere"}], [], {})
 
     def test_trace_and_pager_render(self):
         tr = {"rows": [{"name": EVIL, "status": "OK", "pct": 40,
