@@ -185,6 +185,14 @@ def main() -> int:
             )
             d = dma_read_bandwidth_gbps()
             details["dma_read_gbps"] = round(d.gbps, 1)
+            details["hbm_datasheet_gbps"] = gen.hbm_gbps_per_chip
+            if d.gbps > gen.hbm_gbps_per_chip:
+                # a reading past the physical envelope is timing noise on
+                # the tunnel, not a discovery — say so in the data
+                details["dma_read_note"] = (
+                    "exceeds datasheet envelope; treat as ~ceiling "
+                    "(differential-timing noise)"
+                )
         except Exception as e:  # diagnostics must not sink the headline
             details["dma_read_gbps"] = f"error: {type(e).__name__}"
         # end-to-end training signal: a few validation-net steps (attention

@@ -43,6 +43,7 @@ class TpuGeneration:
     topology_ndim: int              # 2 (mesh/torus) or 3 (torus)
     max_chips: int
     hbm_gib_per_chip: float
+    hbm_gbps_per_chip: float        # datasheet HBM bandwidth, GB/s
     bf16_tflops_per_chip: float
     gcp_accelerator_prefix: str     # GCP acceleratorType prefix, e.g. "v5litepod"
     gcp_accelerator_config_type: str  # AcceleratorConfig.type enum, e.g. "V5LITE_POD"
@@ -76,6 +77,7 @@ GENERATIONS: dict[str, TpuGeneration] = {
             topology_ndim=3,
             max_chips=4096,
             hbm_gib_per_chip=32.0,
+            hbm_gbps_per_chip=1228.0,
             bf16_tflops_per_chip=275.0,
             gcp_accelerator_prefix="v4",
             gcp_accelerator_config_type="V4",
@@ -92,6 +94,7 @@ GENERATIONS: dict[str, TpuGeneration] = {
             topology_ndim=2,
             max_chips=256,
             hbm_gib_per_chip=16.0,
+            hbm_gbps_per_chip=819.0,
             bf16_tflops_per_chip=197.0,
             gcp_accelerator_prefix="v5litepod",
             gcp_accelerator_config_type="V5LITE_POD",
@@ -108,6 +111,7 @@ GENERATIONS: dict[str, TpuGeneration] = {
             topology_ndim=3,
             max_chips=8960,
             hbm_gib_per_chip=95.0,
+            hbm_gbps_per_chip=2765.0,
             bf16_tflops_per_chip=459.0,
             gcp_accelerator_prefix="v5p",
             gcp_accelerator_config_type="V5P",
@@ -124,6 +128,7 @@ GENERATIONS: dict[str, TpuGeneration] = {
             topology_ndim=2,
             max_chips=256,
             hbm_gib_per_chip=32.0,
+            hbm_gbps_per_chip=1638.0,
             bf16_tflops_per_chip=918.0,
             gcp_accelerator_prefix="v6e",
             gcp_accelerator_config_type="V6E",
