@@ -52,6 +52,46 @@ async def run_sync(request: web.Request, fn, *args, **kw):
     )
 
 
+# keystrokes and window resizes are high-volume and (input) sensitive —
+# the audit trail records OPERATIONS, not terminal traffic. The skip is
+# scoped to the terminal routes: a CLUSTER literally named "input" is a
+# valid RFC1123 name and its deletion must still be audited.
+_AUDIT_SKIP_PREFIX = "/api/v1/terminal/"
+_AUDIT_SKIP_SUFFIXES = ("/input", "/resize")
+
+
+def _audit_skipped(path: str) -> bool:
+    return (path.startswith(_AUDIT_SKIP_PREFIX)
+            and path.endswith(_AUDIT_SKIP_SUFFIXES))
+
+
+async def _audit(request: web.Request, status: int) -> None:
+    """Operation audit (reference parity: the operation-log screen): every
+    mutating API call lands a who/what/when/status row. Bodies are never
+    recorded — they can carry credentials. Best-effort: an audit failure
+    must never fail the request it describes."""
+    if request.method not in ("POST", "PUT", "DELETE"):
+        return
+    path = request.path
+    if not path.startswith("/api/v1/") or _audit_skipped(path):
+        return
+    services = request.app.get(SERVICES_KEY)
+    if services is None:
+        return
+    from kubeoperator_tpu.models import AuditRecord
+
+    user = request.get("user")
+    rec = AuditRecord(
+        user_name=user.name if user is not None else "-",
+        method=request.method, path=path, status=int(status),
+        remote=request.remote or "",
+    )
+    try:
+        await run_sync(request, services.repos.audit.record, rec)
+    except Exception:  # pragma: no cover - diagnostics never sink requests
+        log.exception("audit write failed")
+
+
 @web.middleware
 async def error_middleware(request: web.Request, handler):
     locale = request.headers.get("Accept-Language", "en-US").split(",")[0]
@@ -67,9 +107,11 @@ async def error_middleware(request: web.Request, handler):
     try:
         resp = await handler(request)
         observe(resp.status)
+        await _audit(request, resp.status)
         return resp
     except KoError as e:
         observe(e.http_status)
+        await _audit(request, e.http_status)
         return json_response(
             {"error": e.code,
              "message": translate(e.code, locale, message=e.message,
@@ -78,6 +120,7 @@ async def error_middleware(request: web.Request, handler):
         )
     except web.HTTPException as e:
         observe(e.status)
+        await _audit(request, e.status)
         raise
     except (ConnectionResetError, BrokenPipeError):
         # routine SSE/terminal client disconnect mid-stream — 499 (client
@@ -88,6 +131,7 @@ async def error_middleware(request: web.Request, handler):
     except Exception as e:  # pragma: no cover - last resort
         log.exception("unhandled API error")
         observe(500)
+        await _audit(request, 500)
         return json_response(
             {"error": "ERR_INTERNAL", "message": str(e)}, status=500
         )
@@ -162,6 +206,12 @@ class Handlers:
         from kubeoperator_tpu.api.metrics import MetricsRegistry
 
         self.metrics = MetricsRegistry()
+
+    async def audit_log(self, request):
+        _require_admin(request)
+        limit = int(request.query.get("limit", "200"))
+        rows = await run_sync(request, self.s.repos.audit.tail, limit)
+        return json_response([r.to_dict() for r in rows])
 
     async def metrics_endpoint(self, request):
         text = await run_sync(request, self.metrics.render, self.s)
@@ -846,6 +896,7 @@ def create_app(services: Services) -> web.Application:
     r.add_post("/api/v1/users", h.create_user)
     r.add_post("/api/v1/ldap/test", h.ldap_test)
     r.add_post("/api/v1/ldap/sync", h.ldap_sync)
+    r.add_get("/api/v1/audit", h.audit_log)
 
     view, manage = Role.VIEWER, Role.MANAGER
     r.add_get("/api/v1/clusters", h.list_clusters)

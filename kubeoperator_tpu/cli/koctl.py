@@ -75,11 +75,44 @@ class LocalClient:
         body = body or {}
         # unquote each segment so callers can percent-encode names exactly
         # as they must for the REST transport
+        # query string -> body keys (the REST transport's ?limit=N etc.)
+        path, _, query = path.partition("?")
+        if query:
+            from urllib.parse import parse_qsl
+
+            for k, v in parse_qsl(query):
+                body.setdefault(k, v)
         parts = [unquote(p) for p in path.split("/") if p][2:]  # drop api/v1
         try:
-            return self._dispatch(s, method, parts, body)
+            result = self._dispatch(s, method, parts, body)
+            self._audit(method, path, 200)
+            return result
         except KoError as e:
+            self._audit(method, path, e.http_status)
             raise SystemExit(f"error: {e.message}")
+
+    def _audit(self, method: str, path: str, status: int) -> None:
+        """Mirror of the API middleware's operation audit: local-transport
+        mutations are platform mutations and must land in the same trail
+        (attributed to the machine operator). Same exemptions (terminal
+        traffic only — a resource literally named "input" still audits),
+        same no-body rule; never fails the operation. Success normalizes
+        to status 200: the local transport has no HTTP status concept
+        (REST rows carry the real 201/204 etc.)."""
+        if method not in ("POST", "PUT", "DELETE"):
+            return
+        if path.startswith("/api/v1/terminal/") and \
+                path.endswith(("/input", "/resize")):
+            return
+        try:
+            from kubeoperator_tpu.models import AuditRecord
+
+            self.services.repos.audit.record(AuditRecord(
+                user_name="local-operator", method=method, path=path,
+                status=int(status), remote="local",
+            ))
+        except Exception:
+            pass
 
     def _dispatch(self, s, method, parts, body):
         def pub(x):
@@ -177,6 +210,10 @@ class LocalClient:
                 return {"ok": True}
             case ("GET", ["components-catalog"]):
                 return s.components.catalog()
+            case ("GET", ["audit"]):
+                # local transport runs as the operator (admin-equivalent)
+                limit = int(body.get("limit", 200))
+                return [r.to_dict() for r in s.repos.audit.tail(limit)]
             case ("GET", ["plans"]):
                 return pub(s.plans.list())
             case ("POST", ["plans"]):
@@ -830,6 +867,10 @@ def build_parser() -> argparse.ArgumentParser:
     diag_p.add_argument("--profile-dir", default="",
                         help="capture an XLA profiler trace of the suite")
 
+    audit_p = sub.add_parser("audit", help="operation audit trail "
+                                           "(who did what, newest first)")
+    audit_p.add_argument("-n", "--limit", type=int, default=50)
+
     install_p = sub.add_parser("install", help="render/start the platform bundle")
     install_p.add_argument("--dir", default="/opt/ko-tpu")
     install_p.add_argument("--no-start", action="store_true")
@@ -877,6 +918,19 @@ def main(argv: list[str] | None = None) -> int:
         from kubeoperator_tpu.installer import uninstall
 
         _print(uninstall(args.dir, purge_data=args.purge))
+        return 0
+    if args.cmd == "audit":
+        from datetime import datetime
+
+        client = LocalClient() if args.local else RestClient(args.server)
+        rows = client.call(
+            "GET", f"/api/v1/audit?limit={args.limit}")[: args.limit]
+        for r in rows:
+            when = datetime.fromtimestamp(r.get("created_at", 0)).isoformat(
+                sep=" ", timespec="seconds")
+            print(f"{when}  {r.get('user_name', '-'):16s} "
+                  f"{r.get('method', ''):6s} {r.get('status', 0):3d}  "
+                  f"{r.get('path', '')}")
         return 0
     if args.cmd == "registry":
         from kubeoperator_tpu.registry import bundle_manifest, verify_bundle

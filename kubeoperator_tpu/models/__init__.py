@@ -29,7 +29,7 @@ from kubeoperator_tpu.models.cluster import (
 )
 from kubeoperator_tpu.models.backup import BackupAccount, BackupFile, BackupStrategy
 from kubeoperator_tpu.models.tenancy import Project, ProjectMember, Role, User
-from kubeoperator_tpu.models.event import Event, Message, Setting, TaskLogChunk
+from kubeoperator_tpu.models.event import AuditRecord, Event, Message, Setting, TaskLogChunk
 from kubeoperator_tpu.models.component import ClusterComponent
 from kubeoperator_tpu.models.security import CisCheck, CisScan
 
@@ -40,7 +40,7 @@ __all__ = [
     "ClusterPhaseStatus", "Node", "NodeRole", "ProvisionMode",
     "BackupAccount", "BackupFile", "BackupStrategy",
     "Project", "ProjectMember", "Role", "User",
-    "Event", "Message", "Setting", "TaskLogChunk",
+    "AuditRecord", "Event", "Message", "Setting", "TaskLogChunk",
     "ClusterComponent",
     "CisCheck", "CisScan",
 ]

@@ -8,6 +8,23 @@ one deliberate simplification over the reference's model/dto split.
 
 from __future__ import annotations
 
+import re as _re
+
+# RFC1123 label: lowercase alnum + '-', no edge hyphens, <= 63 chars. ONE
+# copy server-side (Cluster + Plan names both become K8s object names and
+# TPU-VM instance prefixes); ui/logic.py dns_label_ok mirrors it client-side
+# and the parity tests pin the two against each other.
+RFC1123_LABEL_RE = _re.compile(r"[a-z0-9]([a-z0-9-]{0,61}[a-z0-9])?")
+
+
+def validate_dns_label(name: str, what: str) -> None:
+    from kubeoperator_tpu.utils.errors import ValidationError
+
+    if not RFC1123_LABEL_RE.fullmatch(name or ""):
+        raise ValidationError(
+            f"{what} {name!r} must be an RFC1123 DNS label"
+        )
+
 import dataclasses
 from dataclasses import dataclass, field
 from typing import Any, ClassVar, Type, TypeVar

@@ -7,7 +7,6 @@ re-enters at the first non-OK condition (SURVEY.md §3.1).
 
 from __future__ import annotations
 
-import re
 from dataclasses import dataclass, field
 from enum import Enum
 
@@ -243,12 +242,11 @@ class Cluster(Entity):
             )
 
     def validate(self) -> None:
-        # RFC1123 label: lowercase alnum + '-', no edge hyphens, <= 63 chars —
-        # the name becomes K8s object names and DNS records downstream.
-        if not re.fullmatch(r"[a-z0-9]([a-z0-9-]{0,61}[a-z0-9])?", self.name or ""):
-            raise ValidationError(
-                f"cluster name {self.name!r} must be an RFC1123 DNS label"
-            )
+        # shared RFC1123 gate (models/base.py): the name becomes K8s
+        # object names and DNS records downstream
+        from kubeoperator_tpu.models.base import validate_dns_label
+
+        validate_dns_label(self.name, "cluster name")
         ProvisionMode(self.provision_mode)
         if self.provision_mode == ProvisionMode.PLAN.value and not self.plan_id:
             raise ValidationError("plan-mode cluster must reference a plan")

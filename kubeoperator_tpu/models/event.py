@@ -25,6 +25,21 @@ class Event(Entity):
 
 
 @dataclass
+class AuditRecord(Entity):
+    """Operation audit row: WHO did WHAT against the platform API (the
+    reference ships an operation-log screen; multi-tenant platforms need
+    who-deleted-that-cluster answerable). Written by the API layer for
+    every mutating request; request BODIES are never recorded — they can
+    carry credentials."""
+
+    user_name: str = "-"       # "-" = unauthenticated (e.g. failed login)
+    method: str = ""           # POST | PUT | DELETE
+    path: str = ""             # /api/v1/... as requested
+    status: int = 0            # final HTTP status (after error mapping)
+    remote: str = ""           # peer address
+
+
+@dataclass
 class Message(Entity):
     """Message-center notification to a user (in-app; email/webhook senders
     attach via service/message.py subscriptions)."""

@@ -148,6 +148,13 @@ class Plan(Entity):
     def validate(self) -> None:
         if not self.name:
             raise ValidationError("plan name required")
+        # shared RFC1123 gate: plan names become TPU-VM instance prefixes
+        # and K8s object names — the wizard already rejects this
+        # client-side, and accept-side drift here was a real parity hole
+        # (r4: the server took "x x" and would only explode at apply time)
+        from kubeoperator_tpu.models.base import validate_dns_label
+
+        validate_dns_label(self.name, "plan name")
         provider = PlanProvider(self.provider)
         if self.accelerator not in ("none", "tpu"):
             # "no GPU package in the build" starts at the schema [BASELINE].

@@ -1,0 +1,10 @@
+-- Operation audit log (who did what against the platform API) —
+-- reference parity: the operation-log screen; SURVEY.md §1 multi-tenancy.
+CREATE TABLE IF NOT EXISTS audit_log (
+    id TEXT PRIMARY KEY,
+    user_name TEXT NOT NULL,
+    data TEXT NOT NULL,
+    created_at REAL NOT NULL,
+    updated_at REAL NOT NULL
+);
+CREATE INDEX IF NOT EXISTS idx_audit_created ON audit_log (created_at);

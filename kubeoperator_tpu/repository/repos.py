@@ -11,6 +11,7 @@ import json
 from typing import Generic, Iterable, Type, TypeVar
 
 from kubeoperator_tpu.models import (
+    AuditRecord,
     BackupAccount,
     BackupFile,
     BackupStrategy,
@@ -174,6 +175,50 @@ class UserRepo(EntityRepo[User]):
     table, entity, columns = "users", User, ("name",)
 
 
+class AuditRepo(EntityRepo[AuditRecord]):
+    table, entity, columns = "audit_log", AuditRecord, ("user_name",)
+
+    _PRUNE_EVERY = 500
+    _KEEP = 5000
+
+    def record(self, rec: AuditRecord) -> None:
+        """Append + amortized bound: every _PRUNE_EVERY writes the trail is
+        trimmed back to the newest _KEEP rows, so the table stays bounded
+        without a cron dependency."""
+        self.save(rec)
+        self._writes = getattr(self, "_writes", 0) + 1
+        if self._writes % self._PRUNE_EVERY == 0:
+            self.prune(self._KEEP)
+
+    def tail(self, limit: int = 200) -> list[AuditRecord]:
+        """Newest-first, capped IN SQL (an audit trail grows forever).
+        rowid tiebreak: a burst of writes can share one time.time() stamp
+        and the order must still be deterministic."""
+        rows = self.db.query(
+            f"SELECT data FROM {self.table} "
+            f"ORDER BY created_at DESC, rowid DESC LIMIT ?",
+            (max(1, min(limit, 1000)),),
+        )
+        return [self.entity.from_dict(json.loads(r[0])) for r in rows]
+
+    def prune(self, keep: int = 5000) -> int:
+        """Bounded trail: drop everything past the newest `keep` rows.
+        Deletes by rowid (oldest-first with rowid tiebreak), never by a
+        created_at cutoff — timestamp ties at the boundary must not take
+        rows the bound promised to keep."""
+        total = self.db.query(f"SELECT COUNT(*) FROM {self.table}")[0][0]
+        excess = int(total) - keep
+        if excess <= 0:
+            return 0
+        self.db.execute(
+            f"DELETE FROM {self.table} WHERE rowid IN ("
+            f"SELECT rowid FROM {self.table} "
+            f"ORDER BY created_at ASC, rowid ASC LIMIT ?)",
+            (excess,),
+        )
+        return excess
+
+
 class EventRepo(EntityRepo[Event]):
     table, entity, columns = "events", Event, ("cluster_id",)
 
@@ -305,3 +350,4 @@ class Repositories:
         self.components = ComponentRepo(db)
         self.cis_scans = CisScanRepo(db)
         self.settings = SettingRepo(db)
+        self.audit = AuditRepo(db)

@@ -97,6 +97,7 @@ const I18N = {
     th_description: "description", th_email: "email", th_role: "role",
     th_source: "source", th_file: "file", th_created: "created",
     th_scan: "scan", th_pass: "pass", th_fail: "fail", th_warn: "warn",
+    audit: "Operation audit",
   },
   zh: {
     sign_in: "登录", clusters: "集群", hosts: "主机", infra: "基础设施",
@@ -163,6 +164,7 @@ const I18N = {
     th_description: "描述", th_email: "邮箱", th_role: "角色",
     th_source: "来源", th_file: "文件", th_created: "创建时间",
     th_scan: "扫描", th_pass: "通过", th_fail: "失败", th_warn: "警告",
+    audit: "操作审计",
   },
 };
 let lang = localStorage.getItem("ko-lang") || "en";
@@ -1191,6 +1193,11 @@ async function refreshAdmin() {
   $("#message-feed").innerHTML = KOLogic.render_message_feed(
     msgs.map((m) => ({
       ...m, when: new Date((m.created_at || 0) * 1000).toLocaleString(),
+    })), L());
+  const audit = await api("GET", "/api/v1/audit?limit=100").catch(() => []);
+  $("#audit-feed").innerHTML = KOLogic.render_audit_feed(
+    audit.map((r) => ({
+      ...r, when: new Date((r.created_at || 0) * 1000).toLocaleString(),
     })), L());
 }
 

@@ -1107,6 +1107,28 @@ def render_users(users, labels):
     return "".join(parts)
 
 
+def render_audit_feed(rows, labels):
+    """Operation audit rows (admin tab), newest first; rows pre-mapped
+    with a locale-formatted `when` like the other feeds. Failed calls
+    (4xx/5xx) carry the warning style so denied/errored operations pop."""
+    if len(rows) == 0:
+        quiet = jsrt.esc(jsrt.get(labels, "no_activity", ""))
+        return f'<div class="muted">{quiet}</div>'
+    parts = []
+    for r in rows:
+        status = jsrt.get(r, "status", 0)
+        cls = "warning" if jsrt.num(status) >= 400 else ""
+        when = jsrt.esc(jsrt.get(r, "when", ""))
+        user = jsrt.esc(jsrt.get(r, "user_name", "-"))
+        method = jsrt.esc(jsrt.get(r, "method", ""))
+        path = jsrt.esc(jsrt.get(r, "path", ""))
+        parts.append(f'<div class="feed-item {cls}">'
+                     f'<span class="when">{when}</span>'
+                     f'<b>{user}</b> {method} {path} → {jsrt.esc(status)}'
+                     f'</div>')
+    return "".join(parts)
+
+
 def render_pager(page, labels):
     """Pager strip from paginate() output; buttons carry data-nav."""
     total_label = jsrt.esc(jsrt.get(labels, "total", "total"))
@@ -1173,5 +1195,6 @@ PUBLIC = [
     render_credentials,
     render_projects,
     render_users,
+    render_audit_feed,
     render_pager,
 ]

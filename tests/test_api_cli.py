@@ -28,6 +28,67 @@ class TestAuth:
         assert "认证" in resp.json()["message"]
 
 
+class TestOperationAudit:
+    def test_mutations_audited_with_attribution(self, client):
+        """Operation-log parity: every mutating API call lands a
+        who/what/status row; reads don't; terminal keystrokes never."""
+        base, http, services = client
+        assert http.post(f"{base}/api/v1/credentials",
+                         json={"name": "aud-ssh",
+                               "password": "pw"}).status_code == 201
+        http.get(f"{base}/api/v1/clusters")          # read: not audited
+        # failed mutation is audited WITH its status (duplicate -> 409)
+        assert http.post(f"{base}/api/v1/credentials",
+                         json={"name": "aud-ssh",
+                               "password": "pw"}).status_code == 409
+        rows = http.get(f"{base}/api/v1/audit").json()
+        by_path = {(r["method"], r["path"], r["status"]) for r in rows}
+        assert ("POST", "/api/v1/credentials", 201) in by_path
+        assert ("POST", "/api/v1/credentials", 409) in by_path
+        assert not any(r["method"] == "GET" for r in rows)
+        assert all(r["user_name"] == "root" for r in rows
+                   if r["path"] == "/api/v1/credentials")
+        # newest first
+        times = [r["created_at"] for r in rows]
+        assert times == sorted(times, reverse=True)
+
+    def test_terminal_keystrokes_never_audited(self, client):
+        base, http, services = client
+        from kubeoperator_tpu.models import Cluster
+
+        services.repos.clusters.save(Cluster(
+            name="aud-term",
+            kubeconfig="apiVersion: v1\nkind: Config\nclusters: []\n"))
+        services.terminals.shell = "/bin/sh"
+        sid = http.post(f"{base}/api/v1/clusters/aud-term/terminal",
+                        json={}).json()["id"]
+        http.post(f"{base}/api/v1/terminal/{sid}/input",
+                  json={"data": "echo secret-command\n"})
+        http.post(f"{base}/api/v1/terminal/{sid}/resize",
+                  json={"rows": 40, "cols": 100})
+        rows = http.get(f"{base}/api/v1/audit").json()
+        # opening the terminal IS an operation; its traffic is not
+        assert any(r["path"].endswith("/terminal") for r in rows)
+        assert not any(r["path"].endswith(("/input", "/resize"))
+                       for r in rows)
+        assert "secret-command" not in json.dumps(rows)
+
+    def test_audit_requires_admin_and_login_attempts_recorded(self, client):
+        base, http, services = client
+        services.users.create("aud-viewer", password="password1")
+        viewer = requests.Session()
+        tok = viewer.post(f"{base}/api/v1/auth/login", json={
+            "username": "aud-viewer", "password": "password1"}).json()["token"]
+        viewer.headers["Authorization"] = f"Bearer {tok}"
+        assert viewer.get(f"{base}/api/v1/audit").status_code == 403
+        # failed login recorded as unauthenticated ("-") with 401
+        requests.post(f"{base}/api/v1/auth/login", json={
+            "username": "aud-viewer", "password": "wrong"})
+        rows = http.get(f"{base}/api/v1/audit").json()
+        assert any(r["path"] == "/api/v1/auth/login" and r["status"] == 401
+                   and r["user_name"] == "-" for r in rows)
+
+
 class TestPlatformMetrics:
     def test_metrics_endpoint_exposes_real_series(self, client):
         """VERDICT r3 missing #5: the platform observes itself. Drive real

@@ -87,3 +87,45 @@ def test_secret_round_trip_persists_but_redacts(repos):
     got = repos.credentials.get_by_name("ssh")
     assert got.password == "pw"                      # persistence keeps it
     assert "password" not in got.to_public_dict()    # API shape drops it
+
+
+class TestAuditRepo:
+    def test_tail_newest_first_and_bounded_prune(self, tmp_db):
+        from kubeoperator_tpu.models import AuditRecord
+        from kubeoperator_tpu.repository import Database, Repositories
+
+        db = Database(tmp_db)
+        repos = Repositories(db)
+        for i in range(30):
+            rec = AuditRecord(user_name=f"u{i}", method="POST",
+                              path=f"/api/v1/x/{i}", status=200)
+            rec.created_at = rec.updated_at = 1000.0 + i
+            repos.audit.save(rec)
+        tail = repos.audit.tail(10)
+        assert len(tail) == 10
+        assert tail[0].user_name == "u29"          # newest first
+        assert [r.user_name for r in tail] == [f"u{i}" for i in
+                                               range(29, 19, -1)]
+        # prune keeps the newest N
+        dropped = repos.audit.prune(keep=5)
+        assert dropped == 25
+        assert len(repos.audit.tail(100)) == 5
+        assert repos.audit.tail(1)[0].user_name == "u29"
+        # timestamp TIES at the prune boundary: rows the bound promised
+        # to keep must survive (rowid tiebreak, not a created_at cutoff)
+        from kubeoperator_tpu.models import AuditRecord as AR
+        for i in range(4):
+            rec = AR(user_name=f"tie{i}", method="POST", path="/t",
+                     status=200)
+            rec.created_at = rec.updated_at = 2000.0   # same stamp
+            repos.audit.save(rec)
+        repos.audit.prune(keep=2)
+        kept = [r.user_name for r in repos.audit.tail(10)]
+        assert kept == ["tie3", "tie2"]               # newest two, stable
+
+        # record() amortizes the bound without a cron
+        repos.audit._writes = repos.audit._PRUNE_EVERY - 1
+        repos.audit.record(AuditRecord(user_name="last", method="POST",
+                                       path="/x", status=200))
+        assert len(repos.audit.tail(1000)) <= repos.audit._KEEP
+        db.close()

@@ -90,6 +90,25 @@ class TestPlanValidateParity:
     drift turns the wizard into a lie, reject-only drift blocks valid
     plans."""
 
+    def test_name_parity_including_invalid_labels(self):
+        """r4 regression: the client rejected "x x" while the server
+        accepted it (only valid names ever rode the grid) — plan names
+        become TPU-VM instance prefixes, so both sides must gate."""
+        for name, ok in (("p1", True), ("x x", False), ("Bad_Name", False),
+                         ("-edge", False), ("a" * 64, False),
+                         ("ok-name", True)):
+            form = {"name": name, "provider": "bare_metal",
+                    "master_count": 1, "worker_count": 1}
+            client_ok = logic.plan_form_errors(form, CATALOG) == []
+            plan = Plan(name=name, provider="bare_metal",
+                        master_count=1, worker_count=1)
+            try:
+                plan.validate()
+                server_ok = True
+            except Exception:
+                server_ok = False
+            assert client_ok == server_ok == ok, (name, client_ok, server_ok)
+
     def test_grid(self):
         grid = itertools.product(
             ["gcp_tpu_vm", "vsphere", "bare_metal"],      # provider
