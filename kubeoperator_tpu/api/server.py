@@ -716,7 +716,13 @@ class Handlers:
             # final drain: the shell's last output lands in the buffer just
             # before `alive` flips, after the loop's last read
             await flush(after)
-            await resp.write(b"event: end\ndata: {}\n\n")
+            # the client must know WHY the stream ended: idle-timeout
+            # (reconnect, carrying the cursor) vs dead session (stop —
+            # otherwise an exited shell becomes a tight reconnect loop
+            # until the reaper catches up)
+            await resp.write(
+                f"event: end\ndata: "
+                f"{json.dumps({'alive': session.alive})}\n\n".encode())
         finally:
             self.metrics.sse_finished()
         return resp

@@ -306,6 +306,7 @@ function objDialog(titleKey, fields, onSave, validate) {
 /* ---------- clusters ---------- */
 let logStream = null;
 let termTimer = null;
+let termStream = null;
 async function refreshClusters() {
   if ($("#tab-clusters").hidden || !$("#cluster-detail").hidden) return;
   const clusters = await api("GET", "/api/v1/clusters").catch(() => []);
@@ -338,6 +339,7 @@ async function openCluster(name) {
   currentDetailCluster = name;
   // the detail DOM is rebuilt below: stop any poll loop bound to it
   if (termTimer) { clearInterval(termTimer); termTimer = null; }
+  if (termStream) { termStream.close(); termStream = null; }
   const c = await api("GET", `/api/v1/clusters/${name}`);
   // the remaining reads are independent — one round-trip of latency, not 9
   const [nodes, events, comps, catalog, backups, scans, vers, plans,
@@ -405,20 +407,14 @@ async function openCluster(name) {
     <div id="d-trace" class="trace"></div>
 
     <h3>${t("nodes")}</h3>
-    <table class="grid"><tr><th>${t("th_name")}</th><th>${t("th_role")}</th><th>${t("th_status")}</th><th></th></tr>
-    ${nodes.map((n) => `<tr><td>${esc(n.name)}</td><td>${esc(n.role)}</td><td>${esc(n.status)}</td>
-      <td>${n.role === "worker" ? `<button data-rm-node="${esc(n.name)}" class="ghost">${t("remove")}</button>` : ""}</td></tr>`).join("")}
-    </table>
+    ${KOLogic.render_nodes_table(nodes, imported, L())}
     ${imported ? "" : `<div class="row">
       <button id="d-scale-up">${t("scale_up")}</button>
       ${c.spec.tpu_enabled ? `<button id="d-scale-slices">${t("scale_slices")}</button>` : ""}
     </div>`}
 
     <h3>${t("components")}</h3>
-    <table class="grid"><tr><th>${t("th_name")}</th><th>${t("th_status")}</th><th></th></tr>
-    ${comps.map((x) => `<tr><td>${esc(x.name)}</td><td>${esc(x.status)}</td>
-      <td><button data-un-comp="${esc(x.name)}" class="ghost">${t("uninstall")}</button></td></tr>`).join("")}
-    </table>
+    ${KOLogic.render_components_table(comps, imported, L())}
     ${imported ? "" : `<div class="row">
       <select id="d-comp-select">${Object.keys(catalog).map((k) =>
         `<option>${esc(k)}</option>`).join("")}</select>
@@ -426,11 +422,7 @@ async function openCluster(name) {
     </div>`}
 
     <h3>${t("etcd_backups")}</h3>
-    <table class="grid"><tr><th>${t("th_file")}</th><th>${t("th_created")}</th><th></th></tr>
-    ${backups.map((f) => `<tr><td>${esc(f.file_name || f.name)}</td>
-      <td>${esc(f.created_at || "")}</td>
-      <td><button data-restore="${esc(f.file_name || f.name)}" class="ghost">${t("restore")}</button></td></tr>`).join("")}
-    </table>
+    ${KOLogic.render_backups_table(backups, imported, L())}
     ${imported ? "" : `<div class="row">
       <button id="d-backup-now">${t("backup_now")}</button>
       <button id="d-backup-schedule" class="ghost">${t("backup_schedule")}</button>
@@ -441,11 +433,7 @@ async function openCluster(name) {
 
     <h3>${t("security")}</h3>
     ${cisDriftHtml(scans)}
-    <table class="grid"><tr><th>${t("th_scan")}</th><th>${t("th_status")}</th><th>${t("th_pass")}</th><th>${t("th_fail")}</th><th>${t("th_warn")}</th><th></th></tr>
-    ${scans.map((s, i) => `<tr><td>${esc(s.policy || s.id || s.name)}</td><td>${esc(s.status)}</td>
-      <td>${s.total_pass ?? s.passed ?? ""}</td><td>${s.total_fail ?? s.failed ?? ""}</td><td>${s.total_warn ?? s.warned ?? ""}</td>
-      <td>${(s.checks || []).length ? `<button data-cis-findings="${i}" class="ghost">${t("findings")}</button>` : ""}</td></tr>`).join("")}
-    </table>
+    ${KOLogic.render_scans_table(scans, L())}
     <div id="d-cis-findings" hidden></div>
     ${imported ? "" : `<div class="row"><button id="d-cis-run">${t("run_scan")}</button></div>`}
 
@@ -479,6 +467,7 @@ async function openCluster(name) {
     $("#cluster-list").hidden = false;
     if (logStream) { logStream.close(); logStream = null; }
     if (termTimer) { clearInterval(termTimer); termTimer = null; }
+    if (termStream) { termStream.close(); termStream = null; }
     refreshClusters();
   };
   $("#d-back").addEventListener("click", closeDetail);
@@ -646,31 +635,52 @@ async function openCluster(name) {
         .catch((e) => { $("#d-term-open").disabled = false; throw e; });
       $("#d-term").hidden = false;
       const out = $("#d-term-out");
+      // SSE transport (webkubectl parity: a stream, not a poll). The
+      // server ends a stream after 60s idle; reconnect carries the seq
+      // cursor so nothing replays. A dead session 404s the reconnect ->
+      // onerror stops the loop.
       let after = -1;
-      let polling = false;  // overlapping polls would re-fetch the same seq
-      const poll = async () => {
-        if (polling) return;
-        polling = true;
-        try {
-          const r = await api(
-            "GET", `/api/v1/terminal/${session.id}/output?after=${after}`
-          ).catch(() => null);
-          if (!r) return;
-          if (r.missed > 0 && r.chunks.length) {
-            // scrollback cap dropped output between polls: show the gap,
-            // never silently splice
-            out.textContent += `\n[… ${r.missed} output chunk(s) dropped …]\n`;
-          }
-          for (const chunk of r.chunks) {
-            out.textContent += chunk.data;
-            after = chunk.seq;
-          }
-          if (r.chunks.length) out.scrollTop = out.scrollHeight;
-          if (!r.alive && termTimer) { clearInterval(termTimer); termTimer = null; }
-        } finally { polling = false; }
+      let retries = 0;
+      const stop = () => {
+        if (termStream) { termStream.close(); termStream = null; }
+        $("#d-term-open").disabled = false;   // allow reopening
       };
-      if (termTimer) clearInterval(termTimer);
-      termTimer = setInterval(poll, 1000);
+      const connect = () => {
+        if (termStream) termStream.close();
+        termStream = new EventSource(
+          `/api/v1/terminal/${session.id}/output?follow=1&after=${after}`);
+        termStream.onmessage = (ev) => {
+          const d = JSON.parse(ev.data);
+          out.textContent += d.data;
+          after = d.seq;
+          retries = 0;                        // healthy stream
+          out.scrollTop = out.scrollHeight;
+        };
+        termStream.addEventListener("gap", (ev) => {
+          // scrollback cap dropped output between reads: show the gap,
+          // never silently splice
+          const g = JSON.parse(ev.data);
+          out.textContent += `\n[… ${g.missed} output chunk(s) dropped …]\n`;
+        });
+        termStream.addEventListener("end", (ev) => {
+          // the server says WHY: idle-timeout (alive) -> resume from the
+          // cursor; dead shell -> stop (no reconnect loop until reap)
+          let alive = true;
+          try { alive = JSON.parse(ev.data).alive !== false; } catch {}
+          termStream.close();
+          if (alive) connect(); else stop();
+        });
+        termStream.onerror = () => {
+          // transient blip vs gone session: manual backed-off reconnect
+          // carrying the cursor (EventSource auto-reconnect would replay
+          // from the fixed URL seq); a dead session keeps erroring and
+          // runs out of retries
+          termStream.close();
+          if (retries++ < 5) setTimeout(connect, 500 * retries);
+          else stop();
+        };
+      };
+      connect();
       const send = async () => {
         await api("POST", `/api/v1/terminal/${session.id}/input`,
                   { data: $("#d-term-in").value + "\n" });

@@ -1107,6 +1107,111 @@ def render_users(users, labels):
     return "".join(parts)
 
 
+def render_nodes_table(nodes, imported, labels):
+    """Detail-view node table; workers of a managed cluster get a remove
+    button (data-rm-node) for app.js to wire — never for imported
+    clusters (no SSH path to drain them)."""
+    h_name = jsrt.esc(jsrt.get(labels, "th_name", "name"))
+    h_role = jsrt.esc(jsrt.get(labels, "th_role", "role"))
+    h_status = jsrt.esc(jsrt.get(labels, "th_status", "status"))
+    parts = [f'<table class="grid"><tr><th>{h_name}</th><th>{h_role}</th>'
+             f'<th>{h_status}</th><th></th></tr>']
+    remove = jsrt.esc(jsrt.get(labels, "remove", "remove"))
+    for n in nodes:
+        name = jsrt.esc(jsrt.get(n, "name", ""))
+        role = jsrt.esc(jsrt.get(n, "role", ""))
+        status = jsrt.esc(jsrt.get(n, "status", ""))
+        btn = ""
+        if jsrt.get(n, "role", "") == "worker" and not imported:
+            btn = (f'<button data-rm-node="{name}" class="ghost">'
+                   f'{remove}</button>')
+        parts.append(f'<tr><td>{name}</td><td>{role}</td><td>{status}</td>'
+                     f'<td>{btn}</td></tr>')
+    parts.append("</table>")
+    return "".join(parts)
+
+
+def render_components_table(comps, imported, labels):
+    """Installed components with uninstall buttons (data-un-comp)."""
+    h_name = jsrt.esc(jsrt.get(labels, "th_name", "name"))
+    h_status = jsrt.esc(jsrt.get(labels, "th_status", "status"))
+    parts = [f'<table class="grid"><tr><th>{h_name}</th>'
+             f'<th>{h_status}</th><th></th></tr>']
+    uninstall = jsrt.esc(jsrt.get(labels, "uninstall", "uninstall"))
+    for x in comps:
+        name = jsrt.esc(jsrt.get(x, "name", ""))
+        status = jsrt.esc(jsrt.get(x, "status", ""))
+        message = jsrt.esc(jsrt.get(x, "message", ""))
+        btn = ""
+        if not imported:
+            btn = (f'<button data-un-comp="{name}" class="ghost">'
+                   f'{uninstall}</button>')
+        parts.append(f'<tr><td>{name}</td><td title="{message}">{status}'
+                     f'</td><td>{btn}</td></tr>')
+    parts.append("</table>")
+    return "".join(parts)
+
+
+def render_backups_table(backups, imported, labels):
+    """etcd snapshot rows with restore buttons (data-restore)."""
+    h_file = jsrt.esc(jsrt.get(labels, "th_file", "file"))
+    h_created = jsrt.esc(jsrt.get(labels, "th_created", "created"))
+    parts = [f'<table class="grid"><tr><th>{h_file}</th>'
+             f'<th>{h_created}</th><th></th></tr>']
+    restore = jsrt.esc(jsrt.get(labels, "restore", "restore"))
+    for f in backups:
+        name = jsrt.esc(jsrt.get(f, "file_name", "") or jsrt.get(f, "name",
+                                                                 ""))
+        created = jsrt.esc(jsrt.get(f, "created_at", ""))
+        btn = ""
+        if not imported:
+            btn = (f'<button data-restore="{name}" class="ghost">'
+                   f'{restore}</button>')
+        parts.append(f'<tr><td>{name}</td><td>{created}</td>'
+                     f'<td>{btn}</td></tr>')
+    parts.append("</table>")
+    return "".join(parts)
+
+
+def render_scans_table(scans, labels):
+    """CIS scan summary rows; scans with stored checks get a findings
+    button carrying the scan INDEX (data-cis-findings)."""
+    h_scan = jsrt.esc(jsrt.get(labels, "th_scan", "scan"))
+    h_status = jsrt.esc(jsrt.get(labels, "th_status", "status"))
+    h_pass = jsrt.esc(jsrt.get(labels, "th_pass", "pass"))
+    h_fail = jsrt.esc(jsrt.get(labels, "th_fail", "fail"))
+    h_warn = jsrt.esc(jsrt.get(labels, "th_warn", "warn"))
+    parts = [f'<table class="grid"><tr><th>{h_scan}</th><th>{h_status}</th>'
+             f'<th>{h_pass}</th><th>{h_fail}</th><th>{h_warn}</th>'
+             f'<th></th></tr>']
+    findings = jsrt.esc(jsrt.get(labels, "findings", "findings"))
+    i = 0
+    for s in scans:
+        label = (jsrt.get(s, "policy", "") or jsrt.get(s, "id", "")
+                 or jsrt.get(s, "name", ""))
+        status = jsrt.esc(jsrt.get(s, "status", ""))
+        # tolerate both the stored field names and older row shapes
+        p = jsrt.get(s, "total_pass", None)
+        if p is None:
+            p = jsrt.get(s, "passed", "")
+        f_ = jsrt.get(s, "total_fail", None)
+        if f_ is None:
+            f_ = jsrt.get(s, "failed", "")
+        w = jsrt.get(s, "total_warn", None)
+        if w is None:
+            w = jsrt.get(s, "warned", "")
+        btn = ""
+        if len(jsrt.get(s, "checks", [])) > 0:
+            btn = (f'<button data-cis-findings="{i}" class="ghost">'
+                   f'{findings}</button>')
+        parts.append(f'<tr><td>{jsrt.esc(label)}</td><td>{status}</td>'
+                     f'<td>{jsrt.esc(p)}</td><td>{jsrt.esc(f_)}</td>'
+                     f'<td>{jsrt.esc(w)}</td><td>{btn}</td></tr>')
+        i = i + 1
+    parts.append("</table>")
+    return "".join(parts)
+
+
 def render_audit_feed(rows, labels):
     """Operation audit rows (admin tab), newest first; rows pre-mapped
     with a locale-formatted `when` like the other feeds. Failed calls
@@ -1196,5 +1301,9 @@ PUBLIC = [
     render_projects,
     render_users,
     render_audit_feed,
+    render_nodes_table,
+    render_components_table,
+    render_backups_table,
+    render_scans_table,
     render_pager,
 ]

@@ -582,7 +582,9 @@ class TestConsoleSurface:
                    "render_message_feed", "render_plan_cards",
                    "render_tpu_catalog", "render_region_rows",
                    "render_credentials", "render_projects", "render_users",
-                   "render_pager"):
+                   "render_pager", "render_nodes_table",
+                   "render_components_table", "render_backups_table",
+                   "render_scans_table", "render_audit_feed"):
             assert f"KOLogic.{fn}(" in app_js, fn
         # and the served logic.js actually exports them
         logic_js = session.get(f"{base}/ui/logic.js").text

@@ -2,6 +2,7 @@
 materialization, idle reaping, and the HTTP surface (open → input → output →
 close) over a live server."""
 
+import json
 import time
 
 import pytest
@@ -182,6 +183,81 @@ class TestTerminalHttp:
         assert http.delete(f"{base}/api/v1/terminal/{sid}").status_code == 200
         assert http.get(
             f"{base}/api/v1/terminal/{sid}/output").status_code == 404
+
+    def test_sse_follow_streams_output_gap_and_cursor_resume(self, client):
+        """The console's terminal transport (webkubectl parity: a stream,
+        not a poll): follow=1 delivers chunks as SSE data events, a flood
+        beyond the scrollback cap yields a `gap` event before the spliced
+        chunks, and a reconnect carrying ?after= resumes without replay."""
+        base, http, services = client
+        services.repos.clusters.save(
+            Cluster(name="sseterm", kubeconfig=FAKE_KUBECONFIG))
+        services.terminals.shell = "/bin/sh"
+        sid = http.post(f"{base}/api/v1/clusters/sseterm/terminal"
+                        ).json()["id"]
+        http.post(f"{base}/api/v1/terminal/{sid}/input",
+                  json={"data": "echo SSE_$((40+2))\n"})
+
+        def read_events(after, want, timeout_s=15):
+            """Minimal SSE client over requests' streaming response."""
+            events, ev = [], {"event": "message", "data": ""}
+            with http.get(
+                f"{base}/api/v1/terminal/{sid}/output?follow=1&after={after}",
+                stream=True, timeout=timeout_s,
+            ) as resp:
+                assert resp.headers["Content-Type"].startswith(
+                    "text/event-stream")
+                for raw in resp.iter_lines(decode_unicode=True):
+                    if raw is None:
+                        continue
+                    if raw.startswith("event: "):
+                        ev["event"] = raw[7:]
+                    elif raw.startswith("data: "):
+                        ev["data"] = raw[6:]
+                    elif raw == "":
+                        if ev["data"]:
+                            events.append(dict(ev))
+                        ev = {"event": "message", "data": ""}
+                        if want(events):
+                            return events
+            return events
+
+        events = read_events(-1, lambda evs: any(
+            "SSE_42" in json.loads(e["data"]).get("data", "")
+            for e in evs if e["event"] == "message"))
+        msgs = [json.loads(e["data"]) for e in events
+                if e["event"] == "message"]
+        assert any("SSE_42" in m["data"] for m in msgs)
+        last_seq = msgs[-1]["seq"]
+
+        # flood past the cap, then reconnect from the stale cursor: the
+        # stream must announce the gap before the surviving chunks
+        http.post(f"{base}/api/v1/terminal/{sid}/input", json={
+            "data": "yes FLOODFLOODFLOOD | head -c 4194304; echo;"
+                    " echo AFTER_$((40+3))\n"})
+        session = services.terminals.get(sid)
+        deadline = time.time() + 30
+        while session.dropped_chunks == 0 and time.time() < deadline:
+            time.sleep(0.2)
+        assert session.dropped_chunks > 0
+        events = read_events(last_seq, lambda evs: any(
+            e["event"] == "gap" for e in evs))
+        gap = next(e for e in events if e["event"] == "gap")
+        assert json.loads(gap["data"])["missed"] > 0
+
+        # the end event carries WHY the stream closed: a dead shell says
+        # alive=false so the client stops instead of reconnect-looping
+        http.post(f"{base}/api/v1/terminal/{sid}/input",
+                  json={"data": "exit\n"})
+        session = services.terminals.get(sid)
+        deadline = time.time() + 10
+        while session.alive and time.time() < deadline:
+            time.sleep(0.1)
+        events = read_events(-1, lambda evs: any(
+            e["event"] == "end" for e in evs), timeout_s=10)
+        end = next(e for e in events if e["event"] == "end")
+        assert json.loads(end["data"])["alive"] is False
+        http.delete(f"{base}/api/v1/terminal/{sid}")
 
     def test_non_admin_denied_by_default(self, client):
         import requests

@@ -514,6 +514,51 @@ class TestRenderLayer:
         assert "—" in logic.render_region_rows(
             [{"id": "r2", "name": "dc", "provider": "vsphere"}], [], {})
 
+    def test_detail_view_tables_escape_and_gate_buttons(self):
+        """The detail view's nodes/components/backups/scans tables ride
+        the tested layer too (r4 continuation): hostile data escapes, and
+        mutation buttons never render for imported clusters."""
+        nodes = [{"name": EVIL, "role": "worker", "status": "Ready"},
+                 {"name": "m1", "role": "master", "status": "Ready"}]
+        html = logic.render_nodes_table(nodes, False, {})
+        assert "<img" not in html
+        assert html.count("data-rm-node=") == 1      # workers only
+        assert "data-rm-node" not in logic.render_nodes_table(
+            nodes, True, {})                          # imported: read-only
+
+        comps = [{"name": EVIL, "status": "Installed", "message": EVIL}]
+        html = logic.render_components_table(comps, False, {})
+        assert "<img" not in html and "data-un-comp=" in html
+        assert "data-un-comp" not in logic.render_components_table(
+            comps, True, {})
+
+        backups = [{"file_name": EVIL, "created_at": "2026-07-30"},
+                   {"name": "legacy.db", "created_at": ""}]
+        html = logic.render_backups_table(backups, False, {})
+        assert "<img" not in html
+        assert html.count("data-restore=") == 2
+        assert "legacy.db" in html                    # name fallback
+
+        scans = [{"policy": EVIL, "status": "Failed", "total_pass": 10,
+                  "total_fail": 2, "total_warn": 1,
+                  "checks": [{"id": "c1"}]},
+                 {"id": "old", "status": "Passed", "passed": 5,
+                  "failed": 0, "warned": 0, "checks": []}]
+        html = logic.render_scans_table(scans, {})
+        assert "<img" not in html
+        assert 'data-cis-findings="0"' in html        # has stored checks
+        assert 'data-cis-findings="1"' not in html    # none stored
+        assert "<td>5</td>" in html                   # legacy field names
+
+        feed = logic.render_audit_feed([{
+            "user_name": EVIL, "method": "DELETE", "path": EVIL,
+            "status": 403, "when": "now"}], {})
+        assert "<img" not in feed and 'class="feed-item warning"' in feed
+        ok = logic.render_audit_feed([{
+            "user_name": "root", "method": "POST", "path": "/x",
+            "status": 201, "when": "now"}], {})
+        assert 'class="feed-item "' in ok             # non-error unstyled
+
     def test_trace_and_pager_render(self):
         tr = {"rows": [{"name": EVIL, "status": "OK", "pct": 40,
                         "duration_s": 3.21},

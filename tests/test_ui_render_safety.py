@@ -50,10 +50,7 @@ APPROVED = {
     # server-enum class/text slot in the detail head (phase enum)
     'c.status.phase',
     # numbers / indices
-    'i', 'sum.total_chips', 'sum.total_hosts', 'sum.num_slices',
-    # cis scan numeric cells (server-computed counts)
-    's.total_pass ?? s.passed ?? ""', 's.total_fail ?? s.failed ?? ""',
-    's.total_warn ?? s.warned ?? ""',
+    'sum.total_chips', 'sum.total_hosts', 'sum.num_slices',
     # locale timestamp (Date output carries no user text)
     'new Date(e.created_at * 1000).toLocaleTimeString()',
     # helpers that build their own markup with esc() inside, over data
