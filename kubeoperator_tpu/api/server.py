@@ -207,6 +207,28 @@ class Handlers:
 
         self.metrics = MetricsRegistry()
 
+    async def bundle_manifest_view(self, request):
+        """Version-management screen data (reference parity: the console's
+        version/manifest page): platform version, supported K8s hops,
+        pinned component versions, and the offline artifact counts — what
+        an air-gapped operator can actually install."""
+        _require_admin(request)
+        from kubeoperator_tpu.registry import bundle_manifest
+        from kubeoperator_tpu.registry.manifest import COMPONENT_VERSIONS
+
+        manifest = await run_sync(request, bundle_manifest)
+        by_kind: dict = {}
+        for artifact in manifest.get("artifacts", []):
+            kind = str(artifact).split("/", 1)[0]
+            by_kind[kind] = by_kind.get(kind, 0) + 1
+        return json_response({
+            "version": manifest.get("version", ""),
+            "k8s_versions": manifest.get("k8s_versions", []),
+            "component_versions": dict(COMPONENT_VERSIONS),
+            "artifact_counts": by_kind,
+            "artifact_total": len(manifest.get("artifacts", [])),
+        })
+
     async def audit_log(self, request):
         _require_admin(request)
         limit = int(request.query.get("limit", "200"))
@@ -903,6 +925,7 @@ def create_app(services: Services) -> web.Application:
     r.add_post("/api/v1/ldap/test", h.ldap_test)
     r.add_post("/api/v1/ldap/sync", h.ldap_sync)
     r.add_get("/api/v1/audit", h.audit_log)
+    r.add_get("/api/v1/bundle-manifest", h.bundle_manifest_view)
 
     view, manage = Role.VIEWER, Role.MANAGER
     r.add_get("/api/v1/clusters", h.list_clusters)

@@ -97,7 +97,10 @@ const I18N = {
     th_description: "description", th_email: "email", th_role: "role",
     th_source: "source", th_file: "file", th_created: "created",
     th_scan: "scan", th_pass: "pass", th_fail: "fail", th_warn: "warn",
-    audit: "Operation audit",
+    audit: "Operation audit", bundle: "Offline bundle",
+    platform_version: "platform", k8s_versions: "K8s versions",
+    th_component: "component", th_version: "version",
+    offline_artifacts: "offline artifacts",
   },
   zh: {
     sign_in: "登录", clusters: "集群", hosts: "主机", infra: "基础设施",
@@ -164,7 +167,10 @@ const I18N = {
     th_description: "描述", th_email: "邮箱", th_role: "角色",
     th_source: "来源", th_file: "文件", th_created: "创建时间",
     th_scan: "扫描", th_pass: "通过", th_fail: "失败", th_warn: "警告",
-    audit: "操作审计",
+    audit: "操作审计", bundle: "离线资源包",
+    platform_version: "平台版本", k8s_versions: "K8s 版本",
+    th_component: "组件", th_version: "版本",
+    offline_artifacts: "离线制品",
   },
 };
 let lang = localStorage.getItem("ko-lang") || "en";
@@ -305,8 +311,8 @@ function objDialog(titleKey, fields, onSave, validate) {
 
 /* ---------- clusters ---------- */
 let logStream = null;
-let termTimer = null;
 let termStream = null;
+let termRetryTimer = null;
 async function refreshClusters() {
   if ($("#tab-clusters").hidden || !$("#cluster-detail").hidden) return;
   const clusters = await api("GET", "/api/v1/clusters").catch(() => []);
@@ -337,8 +343,8 @@ async function refreshClusters() {
 let currentDetailCluster = null;
 async function openCluster(name) {
   currentDetailCluster = name;
-  // the detail DOM is rebuilt below: stop any poll loop bound to it
-  if (termTimer) { clearInterval(termTimer); termTimer = null; }
+  // the detail DOM is rebuilt below: stop any stream bound to it
+  if (termRetryTimer) { clearTimeout(termRetryTimer); termRetryTimer = null; }
   if (termStream) { termStream.close(); termStream = null; }
   const c = await api("GET", `/api/v1/clusters/${name}`);
   // the remaining reads are independent — one round-trip of latency, not 9
@@ -466,7 +472,7 @@ async function openCluster(name) {
     detail.hidden = true;
     $("#cluster-list").hidden = false;
     if (logStream) { logStream.close(); logStream = null; }
-    if (termTimer) { clearInterval(termTimer); termTimer = null; }
+    if (termRetryTimer) { clearTimeout(termRetryTimer); termRetryTimer = null; }
     if (termStream) { termStream.close(); termStream = null; }
     refreshClusters();
   };
@@ -642,18 +648,23 @@ async function openCluster(name) {
       let after = -1;
       let retries = 0;
       const stop = () => {
+        if (termRetryTimer) { clearTimeout(termRetryTimer); termRetryTimer = null; }
         if (termStream) { termStream.close(); termStream = null; }
         $("#d-term-open").disabled = false;   // allow reopening
       };
       const connect = () => {
+        termRetryTimer = null;
         if (termStream) termStream.close();
         termStream = new EventSource(
           `/api/v1/terminal/${session.id}/output?follow=1&after=${after}`);
+        // a successful (re)connect is health, message or not — an IDLE
+        // shell behind a connection-dropping proxy must never run out
+        // of retries
+        termStream.onopen = () => { retries = 0; };
         termStream.onmessage = (ev) => {
           const d = JSON.parse(ev.data);
           out.textContent += d.data;
           after = d.seq;
-          retries = 0;                        // healthy stream
           out.scrollTop = out.scrollHeight;
         };
         termStream.addEventListener("gap", (ev) => {
@@ -674,9 +685,11 @@ async function openCluster(name) {
           // transient blip vs gone session: manual backed-off reconnect
           // carrying the cursor (EventSource auto-reconnect would replay
           // from the fixed URL seq); a dead session keeps erroring and
-          // runs out of retries
+          // runs out of retries. The timer is tracked globally so
+          // closing the detail view cancels it — an orphaned reconnect
+          // must never resurrect and steal the next terminal's stream.
           termStream.close();
-          if (retries++ < 5) setTimeout(connect, 500 * retries);
+          if (retries++ < 5) termRetryTimer = setTimeout(connect, 500 * retries);
           else stop();
         };
       };
@@ -1209,6 +1222,11 @@ async function refreshAdmin() {
     audit.map((r) => ({
       ...r, when: new Date((r.created_at || 0) * 1000).toLocaleString(),
     })), L());
+  const bundle = await api("GET", "/api/v1/bundle-manifest")
+    .catch(() => null);
+  if (bundle) {
+    $("#bundle-panel").innerHTML = KOLogic.render_bundle_panel(bundle, L());
+  }
 }
 
 // scan-over-scan CIS drift badge: regressions/resolved/persisting (data

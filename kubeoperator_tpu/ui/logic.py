@@ -1212,6 +1212,39 @@ def render_scans_table(scans, labels):
     return "".join(parts)
 
 
+def render_bundle_panel(manifest, labels):
+    """Version-management panel (admin tab): platform version, supported
+    K8s hops, pinned component versions, offline artifact counts."""
+    version = jsrt.esc(jsrt.get(manifest, "version", ""))
+    platform = jsrt.esc(jsrt.get(labels, "platform_version", "platform"))
+    k8s = jsrt.esc(jsrt.get(labels, "k8s_versions", "K8s versions"))
+    vers = []
+    for v in jsrt.get(manifest, "k8s_versions", []):
+        vers.append(jsrt.esc(v))
+    parts = [f'<div class="muted">{platform} {version} · {k8s}: '
+             f'{", ".join(vers)}</div>']
+    h_comp = jsrt.esc(jsrt.get(labels, "th_component", "component"))
+    h_ver = jsrt.esc(jsrt.get(labels, "th_version", "version"))
+    parts.append(f'<table class="grid"><tr><th>{h_comp}</th>'
+                 f'<th>{h_ver}</th></tr>')
+    comps = jsrt.get(manifest, "component_versions", {})
+    for key in jsrt.keys(comps):
+        parts.append(f'<tr><td>{jsrt.esc(key)}</td>'
+                     f'<td>{jsrt.esc(jsrt.get(comps, key, ""))}</td></tr>')
+    parts.append("</table>")
+    counts = jsrt.get(manifest, "artifact_counts", {})
+    if len(jsrt.keys(counts)) > 0:
+        bits = []
+        for kind in jsrt.keys(counts):
+            bits.append(f"{jsrt.esc(kind)} {jsrt.esc(jsrt.get(counts, kind, 0))}")
+        total = jsrt.esc(jsrt.get(manifest, "artifact_total", 0))
+        offline = jsrt.esc(jsrt.get(labels, "offline_artifacts",
+                                    "offline artifacts"))
+        parts.append(f'<div class="muted">{offline}: {total} · '
+                     f'{" · ".join(bits)}</div>')
+    return "".join(parts)
+
+
 def render_audit_feed(rows, labels):
     """Operation audit rows (admin tab), newest first; rows pre-mapped
     with a locale-formatted `when` like the other feeds. Failed calls
@@ -1301,6 +1334,7 @@ PUBLIC = [
     render_projects,
     render_users,
     render_audit_feed,
+    render_bundle_panel,
     render_nodes_table,
     render_components_table,
     render_backups_table,

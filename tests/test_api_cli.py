@@ -879,3 +879,26 @@ class TestKoctlSpecKnobs:
         with pytest.raises(SystemExit):
             koctl.main(["--local", "cluster", "create", "x",
                         "--cni", "weave"])
+
+
+class TestBundleManifestView:
+    def test_admin_sees_versions_and_counts(self, client):
+        """Version-management screen data (reference parity): platform
+        version, K8s hops, component pins, offline artifact counts."""
+        base, http, services = client
+        m = http.get(f"{base}/api/v1/bundle-manifest").json()
+        from kubeoperator_tpu.registry.manifest import COMPONENT_VERSIONS
+        from kubeoperator_tpu.version import SUPPORTED_K8S_VERSIONS
+
+        assert m["k8s_versions"] == list(SUPPORTED_K8S_VERSIONS)
+        assert m["component_versions"] == COMPONENT_VERSIONS
+        assert m["artifact_total"] == sum(m["artifact_counts"].values())
+        assert m["artifact_counts"]["images"] > 10
+        # admin-gated like the rest of the admin tab
+        services.users.create("bm-viewer", password="password1")
+        viewer = requests.Session()
+        tok = viewer.post(f"{base}/api/v1/auth/login", json={
+            "username": "bm-viewer",
+            "password": "password1"}).json()["token"]
+        viewer.headers["Authorization"] = f"Bearer {tok}"
+        assert viewer.get(f"{base}/api/v1/bundle-manifest").status_code == 403

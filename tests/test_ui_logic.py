@@ -1023,3 +1023,22 @@ class TestProviderForm:
             [dict(f) for f in spec], {"datastore": "ds1", "network": "  "})
         assert r["vars"] == {"datastore": "ds1"}
         assert r["errors"] == []
+
+
+def test_render_bundle_panel():
+    manifest = {
+        "version": "0.1.0",
+        "k8s_versions": ["v1.29.10", "v1.30.6"],
+        "component_versions": {"calico": "v3.27.3", "rook": EVIL},
+        "artifact_counts": {"images": 20, "apt": 40},
+        "artifact_total": 60,
+    }
+    html = logic.render_bundle_panel(manifest, {})
+    assert "<img" not in html
+    assert "v1.29.10, v1.30.6" in html
+    assert "<td>calico</td><td>v3.27.3</td>" in html
+    assert "offline artifacts: 60" in html and "images 20" in html
+    # empty counts: no artifacts line at all
+    assert "offline artifacts" not in logic.render_bundle_panel(
+        {"version": "x", "k8s_versions": [], "component_versions": {},
+         "artifact_counts": {}, "artifact_total": 0}, {})
