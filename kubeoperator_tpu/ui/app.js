@@ -393,20 +393,7 @@ async function openCluster(name) {
       </div>
     </div>
     <div class="conds">${KOLogic.render_condition_spans(c.status.conditions || [])}</div>
-    ${tpuPanel.chips || tpuPanel.expected_chips ? `
-    <div class="tpu-panel ${tpuPanel.ok ? "ok" : "bad"}">
-      <b>TPU</b>
-      ${tpuPanel.chips}${tpuPanel.expected_chips ? ` / ${tpuPanel.expected_chips}` : ""} chips
-      ${tpuPanel.chips_ok ? "" : `<span class="crit">${t("chips_mismatch")}</span>`}
-      · psum ${tpuPanel.gbps} GB/s
-      ${tpuPanel.simulated ? `<span class="sim-badge" title="${t("simulated_hint")}">${t("simulated")}</span>` : ""}
-      ${tpuPanel.trend.delta_pct !== null
-        ? `<span class="delta ${tpuPanel.trend.delta_pct < 0 ? "down" : "up"}">${tpuPanel.trend.delta_pct > 0 ? "+" : ""}${tpuPanel.trend.delta_pct}%</span>`
-        : ""}
-      ${tpuPanel.trend.bars.length > 1
-        ? `<span class="spark" title="${t("smoke_trend")}">${tpuPanel.trend.bars.map((b, i) => `<i class="${tpuPanel.trend.sim[i] ? "sim" : ""}" style="height:${Math.max(b, 6)}%"></i>`).join("")}</span>`
-        : ""}
-    </div>` : ""}
+    ${KOLogic.render_tpu_panel(tpuPanel, L())}
     <div id="d-health-out"></div>
 
     <h3>${t("phase_timings")}</h3>
@@ -438,7 +425,7 @@ async function openCluster(name) {
     </div>`}
 
     <h3>${t("security")}</h3>
-    ${cisDriftHtml(scans)}
+    ${KOLogic.render_cis_drift(KOLogic.cis_delta_from_scans(scans), L())}
     ${KOLogic.render_scans_table(scans, L())}
     <div id="d-cis-findings" hidden></div>
     ${imported ? "" : `<div class="row"><button id="d-cis-run">${t("run_scan")}</button></div>`}
@@ -462,7 +449,9 @@ async function openCluster(name) {
     </div>
     <div class="logbox" id="d-logs"></div>
     <h3>${t("events")}</h3>
-    ${eventPulse(events)}
+    ${KOLogic.render_event_pulse(
+      KOLogic.event_rollup(events, Date.now() / 1000, 86400),
+      events.length, events.length, L())}
     <div>${events.map((e) =>
       `<div class="feed-item ${esc(e.type)}"><span class="when">${new Date(e.created_at * 1000).toLocaleTimeString()}</span>[${esc(e.reason)}] ${esc(e.message)}</div>`
     ).join("")}</div>`;
@@ -1229,31 +1218,6 @@ async function refreshAdmin() {
   }
 }
 
-// scan-over-scan CIS drift badge: regressions/resolved/persisting (data
-// from KOLogic.cis_delta_from_scans, tested; the DOM here is render-only)
-function cisDriftHtml(scans) {
-  const d = KOLogic.cis_delta_from_scans(scans);
-  if (!d.comparable) return "";
-  const badge = `<div class="muted">${t("since_last_scan")}:
-    <span class="${d.regressions.length ? "cis-fail" : ""}">▲ ${d.regressions.length} ${t("cis_new")}</span>
-    · ✓ ${d.resolved.length} ${t("cis_resolved")} · ${d.persisting} ${t("cis_persisting")}</div>`;
-  if (!d.regressions.length) return badge;
-  return badge + `<div class="muted">${d.regressions.map((c) =>
-    `${esc(c.id)}@${esc(c.node || "?")}`).join(" · ")}</div>`;
-}
-
-// 24h warning/normal pulse + top repeating warning reasons (data from
-// KOLogic.event_rollup, tested; the DOM here is render-only)
-function eventPulse(events) {
-  const r = KOLogic.event_rollup(events, Date.now() / 1000, 86400);
-  if (!r.warnings && !r.normals) return "";
-  const reasons = r.top_warning_reasons.map((x) =>
-    `${esc(x.reason)}×${x.count}`).join(" · ");
-  return `<div class="muted">${t("last_24h")}:
-    <span class="${r.warnings ? "cis-fail" : ""}">${r.warnings} ${t("warnings")}</span>
-    · ${r.normals} ${t("normals")}${reasons ? ` · ${reasons}` : ""}</div>`;
-}
-
 let eventCache = [];
 let eventTotal = 0;
 let eventPage = 1;
@@ -1261,10 +1225,11 @@ function renderEvents() {
   const shown = KOLogic.filter_events(eventCache, $("#event-filter").value);
   const page = KOLogic.paginate(shown, eventPage, 50);
   eventPage = page.page;
-  // the pulse must never present a capped sample as the whole fleet
-  const trunc = eventTotal > eventCache.length
-    ? `<span class="muted"> (${t("newest")} ${eventCache.length}/${eventTotal})</span>` : "";
-  $("#event-pulse").innerHTML = eventPulse(eventCache) + trunc;
+  // the pulse must never present a capped sample as the whole fleet —
+  // the tested render appends the newest-N/total label when capped
+  $("#event-pulse").innerHTML = KOLogic.render_event_pulse(
+    KOLogic.event_rollup(eventCache, Date.now() / 1000, 86400),
+    eventCache.length, eventTotal, L());
   $("#event-feed").innerHTML = KOLogic.render_event_feed(
     page.rows.map((e) => ({
       ...e, when: new Date(e.created_at * 1000).toLocaleString(),

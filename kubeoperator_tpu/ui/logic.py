@@ -1267,6 +1267,116 @@ def render_audit_feed(rows, labels):
     return "".join(parts)
 
 
+def render_tpu_panel(panel, labels):
+    """The detail view's TPU ops panel from tpu_panel() output: proven
+    chips vs plan, psum GB/s with the SIMULATED badge, delta vs previous
+    gate, and the sparkline with hollow simulated points. Empty string for
+    non-TPU clusters (no chips anywhere)."""
+    chips = jsrt.get(panel, "chips", 0)
+    expected = jsrt.get(panel, "expected_chips", 0)
+    if not chips and not expected:
+        return ""
+    cls = "ok" if jsrt.get(panel, "ok", False) else "bad"
+    exp_txt = ""
+    if expected:
+        exp_txt = f" / {jsrt.esc(expected)}"
+    mismatch = ""
+    if not jsrt.get(panel, "chips_ok", True):
+        warn = jsrt.esc(jsrt.get(labels, "chips_mismatch", "chip mismatch"))
+        mismatch = f'<span class="crit">{warn}</span>'
+    sim = ""
+    if jsrt.get(panel, "simulated", False):
+        hint = jsrt.esc(jsrt.get(labels, "simulated_hint", ""))
+        word = jsrt.esc(jsrt.get(labels, "simulated", "SIMULATED"))
+        sim = f'<span class="sim-badge" title="{hint}">{word}</span>'
+    trend = jsrt.get(panel, "trend", {})
+    delta = jsrt.get(trend, "delta_pct", None)
+    delta_html = ""
+    if delta is not None:
+        direction = "down" if jsrt.num(delta) < 0 else "up"
+        sign = "+" if jsrt.num(delta) > 0 else ""
+        delta_html = (f'<span class="delta {direction}">{sign}'
+                      f'{jsrt.esc(delta)}%</span>')
+    bars = jsrt.get(trend, "bars", [])
+    sims = jsrt.get(trend, "sim", [])
+    spark = ""
+    if len(bars) > 1:
+        title = jsrt.esc(jsrt.get(labels, "smoke_trend", "trend"))
+        cells = []
+        i = 0
+        for b in bars:
+            height = max(jsrt.num(b), 6)
+            bar_cls = ""
+            if i < len(sims):
+                if sims[i] == True:
+                    bar_cls = "sim"
+            cells.append(f'<i class="{bar_cls}" '
+                         f'style="height:{jsrt.esc(height)}%"></i>')
+            i = i + 1
+        spark = (f'<span class="spark" title="{title}">'
+                 f'{"".join(cells)}</span>')
+    gbps = jsrt.esc(jsrt.get(panel, "gbps", 0))
+    return (f'<div class="tpu-panel {cls}"><b>TPU</b> '
+            f'{jsrt.esc(chips)}{exp_txt} chips {mismatch}'
+            f' · psum {gbps} GB/s {sim}{delta_html}{spark}</div>')
+
+
+def render_event_pulse(rollup, truncated_shown, truncated_total, labels):
+    """24h warning/normal pulse from event_rollup() output, with the
+    honest truncation label when the feed is a capped sample."""
+    trunc = ""
+    if jsrt.num(truncated_total) > jsrt.num(truncated_shown):
+        newest = jsrt.esc(jsrt.get(labels, "newest", "newest"))
+        trunc = (f'<span class="muted"> ({newest} '
+                 f'{jsrt.esc(truncated_shown)}/{jsrt.esc(truncated_total)})'
+                 f'</span>')
+    warnings = jsrt.get(rollup, "warnings", 0)
+    normals = jsrt.get(rollup, "normals", 0)
+    if not warnings and not normals:
+        # a quiet 24h window must STILL disclose a capped sample — the
+        # truncation label never rides on the pulse having content
+        return trunc
+    reasons = []
+    for x in jsrt.get(rollup, "top_warning_reasons", []):
+        r = jsrt.esc(jsrt.get(x, "reason", ""))
+        reasons.append(f"{r}×{jsrt.esc(jsrt.get(x, 'count', 0))}")
+    reason_txt = ""
+    if len(reasons) > 0:
+        reason_txt = " · " + " · ".join(reasons)
+    warn_cls = "cis-fail" if warnings else ""
+    last_24h = jsrt.esc(jsrt.get(labels, "last_24h", "Last 24h"))
+    w_label = jsrt.esc(jsrt.get(labels, "warnings", "warnings"))
+    n_label = jsrt.esc(jsrt.get(labels, "normals", "normal"))
+    return (f'<div class="muted">{last_24h}: '
+            f'<span class="{warn_cls}">{jsrt.esc(warnings)} {w_label}</span>'
+            f' · {jsrt.esc(normals)} {n_label}{reason_txt}</div>{trunc}')
+
+
+def render_cis_drift(delta, labels):
+    """Scan-over-scan drift badge from cis_delta_from_scans() output."""
+    if not jsrt.get(delta, "comparable", False):
+        return ""
+    regressions = jsrt.get(delta, "regressions", [])
+    resolved = jsrt.get(delta, "resolved", [])
+    since = jsrt.esc(jsrt.get(labels, "since_last_scan", "Since last scan"))
+    new_l = jsrt.esc(jsrt.get(labels, "cis_new", "new"))
+    res_l = jsrt.esc(jsrt.get(labels, "cis_resolved", "resolved"))
+    per_l = jsrt.esc(jsrt.get(labels, "cis_persisting", "persisting"))
+    reg_cls = "cis-fail" if len(regressions) else ""
+    badge = (f'<div class="muted">{since}: '
+             f'<span class="{reg_cls}">▲ {jsrt.esc(len(regressions))} '
+             f'{new_l}</span> · ✓ {jsrt.esc(len(resolved))} {res_l} · '
+             f'{jsrt.esc(jsrt.get(delta, "persisting", 0))} {per_l}</div>')
+    if len(regressions) == 0:
+        return badge
+    items = []
+    for c in regressions:
+        cid = jsrt.esc(jsrt.get(c, "id", ""))
+        node = jsrt.esc(jsrt.get(c, "node", "") or "?")
+        items.append(f"{cid}@{node}")
+    return badge + f'<div class="muted">{" · ".join(items)}</div>'
+
+
 def render_pager(page, labels):
     """Pager strip from paginate() output; buttons carry data-nav."""
     total_label = jsrt.esc(jsrt.get(labels, "total", "total"))
@@ -1339,5 +1449,8 @@ PUBLIC = [
     render_components_table,
     render_backups_table,
     render_scans_table,
+    render_tpu_panel,
+    render_event_pulse,
+    render_cis_drift,
     render_pager,
 ]

@@ -584,7 +584,9 @@ class TestConsoleSurface:
                    "render_credentials", "render_projects", "render_users",
                    "render_pager", "render_nodes_table",
                    "render_components_table", "render_backups_table",
-                   "render_scans_table", "render_audit_feed"):
+                   "render_scans_table", "render_audit_feed",
+                   "render_tpu_panel", "render_event_pulse",
+                   "render_cis_drift", "render_bundle_panel"):
             assert f"KOLogic.{fn}(" in app_js, fn
         # and the served logic.js actually exports them
         logic_js = session.get(f"{base}/ui/logic.js").text

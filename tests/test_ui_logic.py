@@ -1042,3 +1042,69 @@ def test_render_bundle_panel():
     assert "offline artifacts" not in logic.render_bundle_panel(
         {"version": "x", "k8s_versions": [], "component_versions": {},
          "artifact_counts": {}, "artifact_total": 0}, {})
+
+
+class TestMigratedPanels:
+    """r4 final migration: TPU panel, event pulse, CIS drift badge render
+    in tested logic — the app.js allowlist shrank accordingly."""
+
+    def _panel(self, **status_over):
+        status = {"phase": "Ready", "smoke_chips": 16, "smoke_passed": True,
+                  "smoke_gbps": 85.0, "smoke_simulated": True,
+                  "smoke_history": [{"gbps": 80.0, "simulated": True},
+                                    {"gbps": 85.0}]}
+        status.update(status_over)
+        return logic.tpu_panel({"name": "c", "status": status}, 16)
+
+    def test_render_tpu_panel(self):
+        html = logic.render_tpu_panel(self._panel(), {
+            "chips_mismatch": "<b>bad</b>", "simulated": "SIM",
+            "simulated_hint": EVIL, "smoke_trend": "trend"})
+        assert "<img" not in html and 'class="tpu-panel ok"' in html
+        assert "16 / 16 chips" in html and "psum 85 GB/s" in html
+        assert 'class="sim-badge"' in html
+        assert 'class="delta up">+6.25%' in html
+        # sparkline: first point simulated -> hollow
+        assert '<i class="sim"' in html and '<i class=""' in html
+        # chip mismatch flags and flips the panel class
+        bad = logic.render_tpu_panel(self._panel(smoke_chips=12), {})
+        assert 'class="tpu-panel bad"' in bad and 'class="crit"' in bad
+        # non-TPU cluster renders nothing
+        assert logic.render_tpu_panel(
+            logic.tpu_panel({"name": "c", "status": {}}, 0), {}) == ""
+
+    def test_render_event_pulse(self):
+        rollup = logic.event_rollup(
+            [{"type": "Warning", "reason": EVIL, "created_at": 100.0},
+             {"type": "Normal", "reason": "ok", "created_at": 100.0}],
+            101.0, 86400)
+        html = logic.render_event_pulse(rollup, 2, 2, {})
+        assert "<img" not in html
+        assert 'class="cis-fail">1 warnings' in html and "1 normal" in html
+        # capped sample carries the honest truncation label
+        capped = logic.render_event_pulse(rollup, 200, 1000, {})
+        assert "200/1000" in capped
+        assert "200/1000" not in html
+        # empty window renders nothing...
+        assert logic.render_event_pulse(
+            logic.event_rollup([], 0, 86400), 0, 0, {}) == ""
+        # ...UNLESS the sample is capped: a quiet 24h window must still
+        # disclose that the feed shows newest-N of total
+        quiet_capped = logic.render_event_pulse(
+            logic.event_rollup([], 0, 86400), 200, 1000, {})
+        assert "200/1000" in quiet_capped
+
+    def test_render_cis_drift(self):
+        delta = {"comparable": True, "persisting": 3,
+                 "regressions": [{"id": EVIL, "node": ""}],
+                 "resolved": [{"id": "x", "node": "n1"}]}
+        html = logic.render_cis_drift(delta, {})
+        assert "<img" not in html
+        assert "▲ 1 new" in html and "✓ 1 resolved" in html
+        assert "@?" in html                      # empty node -> ?
+        assert logic.render_cis_drift({"comparable": False}, {}) == ""
+        # no regressions: badge only, no detail line, no fail styling
+        clean = logic.render_cis_drift(
+            {"comparable": True, "persisting": 0, "regressions": [],
+             "resolved": []}, {})
+        assert "cis-fail" not in clean and "@" not in clean

@@ -42,20 +42,12 @@ APPROVED = {
     # objDialog: f.key/f.type come from CALLER-SUPPLIED literal field
     # specs (not user data); user values echo through esc() separately
     'f.key', 'f.type || "text"',
-    # detail view: tpu panel numbers from tested tpu_panel()/smoke_trend()
-    'tpuPanel.chips', 'tpuPanel.expected_chips', 'tpuPanel.gbps',
-    'tpuPanel.trend.delta_pct', 'tpuPanel.trend.delta_pct > 0 ? "+" : ""',
-    'tpuPanel.trend.delta_pct < 0 ? "down" : "up"',
-    'Math.max(b, 6)', 'tpuPanel.trend.sim[i] ? "sim" : ""',
     # server-enum class/text slot in the detail head (phase enum)
     'c.status.phase',
     # numbers / indices
     'sum.total_chips', 'sum.total_hosts', 'sum.num_slices',
     # locale timestamp (Date output carries no user text)
     'new Date(e.created_at * 1000).toLocaleTimeString()',
-    # helpers that build their own markup with esc() inside, over data
-    # from tested KOLogic functions (cis_delta_from_scans, event_rollup)
-    'cisDriftHtml(scans)', 'eventPulse(events)',
 }
 
 
