@@ -688,6 +688,16 @@ def cmd_tpu_diag(args) -> int:
                 "until device time dominates relay jitter")
         report["hbm_triad"] = ops.hbm_bandwidth_gbps().to_dict()
         report["dma_read"] = ops.dma_read_bandwidth_gbps().to_dict()
+        # same honesty guard for the memory numbers: a triad reading past
+        # the HBM datasheet envelope is relay-jitter garbage (observed
+        # 3+ TB/s on short windows), never a healthy-chip number
+        if gen is not None:
+            for key in ("hbm_triad", "dma_read"):
+                if report[key]["gbps"] > gen.hbm_gbps_per_chip * 1.05:
+                    report[key]["suspect_short_window"] = (
+                        f"reading exceeds the {gen.name} HBM datasheet "
+                        f"({gen.hbm_gbps_per_chip:g} GB/s); rerun — "
+                        "short windows behind the relay read garbage")
         if len(devices) >= 2:
             report["collectives"] = [
                 r.to_dict() for r in ops.run_collective_suite()

@@ -1303,16 +1303,13 @@ def render_tpu_panel(panel, labels):
     if len(bars) > 1:
         title = jsrt.esc(jsrt.get(labels, "smoke_trend", "trend"))
         cells = []
-        i = 0
-        for b in bars:
-            height = max(jsrt.num(b), 6)
+        for i in range(len(bars)):
+            height = max(jsrt.num(bars[i]), 6)
             bar_cls = ""
-            if i < len(sims):
-                if sims[i] == True:
-                    bar_cls = "sim"
+            if i < len(sims) and sims[i] == True:
+                bar_cls = "sim"
             cells.append(f'<i class="{bar_cls}" '
                          f'style="height:{jsrt.esc(height)}%"></i>')
-            i = i + 1
         spark = (f'<span class="spark" title="{title}">'
                  f'{"".join(cells)}</span>')
     gbps = jsrt.esc(jsrt.get(panel, "gbps", 0))

@@ -479,10 +479,12 @@ class TestKoctlTpuDiag:
 
         monkeypatch.setattr(ops, "mxu_matmul_tflops",
                             lambda **kw: fake(tflops=271.0))
+        # hbm past the 819 GB/s envelope (observed: short windows read
+        # 3+ TB/s), dma within it — only the impossible one gets flagged
         monkeypatch.setattr(ops, "hbm_bandwidth_gbps",
-                            lambda **kw: fake(gbps=2.0))
+                            lambda **kw: fake(gbps=3161.0))
         monkeypatch.setattr(ops, "dma_read_bandwidth_gbps",
-                            lambda **kw: fake(gbps=3.0))
+                            lambda **kw: fake(gbps=761.0))
         monkeypatch.setattr(ops, "run_collective_suite", lambda **kw: [])
         monkeypatch.setattr(ops, "verify_ring_all_gather", lambda **kw: True)
         monkeypatch.setattr(ops, "bench_ring_all_gather",
@@ -496,6 +498,8 @@ class TestKoctlTpuDiag:
         assert koctl.main(["tpu", "diag"]) == 0
         report = _json.loads(capsys.readouterr().out)
         assert "datasheet peak" in report["mxu"]["suspect_short_window"]
+        assert "HBM datasheet" in report["hbm_triad"]["suspect_short_window"]
+        assert "suspect_short_window" not in report["dma_read"]
         assert "not_a_tpu" not in report
 
 
