@@ -686,7 +686,11 @@ def cmd_tpu_diag(args) -> int:
                 f"reading exceeds the {gen.name} datasheet peak "
                 f"({gen.bf16_tflops_per_chip} TFLOP/s); increase --iters "
                 "until device time dominates relay jitter")
-        report["hbm_triad"] = ops.hbm_bandwidth_gbps().to_dict()
+        # --iters plumbs here too (floored at the honest-window minimum):
+        # the guard's own remediation is "increase --iters", and it must
+        # actually lengthen the triad window it flags
+        report["hbm_triad"] = ops.hbm_bandwidth_gbps(
+            iters=max(args.iters, 200)).to_dict()
         report["dma_read"] = ops.dma_read_bandwidth_gbps().to_dict()
         # same honesty guard for the memory numbers: a triad reading past
         # the HBM datasheet envelope is relay-jitter garbage (observed
@@ -873,7 +877,10 @@ def build_parser() -> argparse.ArgumentParser:
         "diag", help="local-device diagnostics (MXU/HBM/DMA/ICI)"
     )
     diag_p.add_argument("--size", type=int, default=4096)
-    diag_p.add_argument("--iters", type=int, default=30)
+    # default sized so device time dominates relay jitter at --size 4096
+    # (bench.py uses 400 there; short windows read past datasheet and
+    # trip the honesty flags)
+    diag_p.add_argument("--iters", type=int, default=200)
     diag_p.add_argument("--profile-dir", default="",
                         help="capture an XLA profiler trace of the suite")
 
