@@ -908,3 +908,90 @@ class TestBundleManifestView:
             "password": "password1"}).json()["token"]
         viewer.headers["Authorization"] = f"Bearer {tok}"
         assert viewer.get(f"{base}/api/v1/bundle-manifest").status_code == 403
+
+
+def test_metrics_output_is_valid_prometheus_exposition(client):
+    """Strict text-format 0.0.4 lint over a live scrape: every non-comment
+    line must be `name{labels} value` (label pairs parsed for real —
+    commas required, quotes escaped, no trailing comma), every series must
+    follow its own HELP/TYPE header, counters end in _total, and no
+    duplicate series appear — a malformed line silently drops the family
+    at scrape time."""
+    import re
+
+    base, http, services = client
+    text = requests.get(f"{base}/metrics").text
+
+    name_re = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*")
+    value_re = re.compile(r"-?[0-9]+(\.[0-9]+)?([eE][+-]?[0-9]+)?"
+                          r"|NaN|[+-]Inf")
+    pair_re = re.compile(r'[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\\n]|\\.)*"')
+
+    def parse_labels(block):
+        """'{a="x",b="y"}' -> validated; raises AssertionError on the
+        malformed shapes prometheus rejects (missing/trailing commas,
+        empty braces, unquoted values)."""
+        inner = block[1:-1]
+        assert inner != "", f"empty label block: {block!r}"
+        pairs = []
+        i = 0
+        while i < len(inner):
+            m = pair_re.match(inner, i)
+            assert m, f"malformed label pair at {inner[i:]!r}"
+            pairs.append(m.group(0))
+            i = m.end()
+            if i < len(inner):
+                assert inner[i] == ",", f"missing comma in {block!r}"
+                i += 1
+                assert i < len(inner), f"trailing comma in {block!r}"
+        return pairs
+
+    typed: dict = {}
+    seen_series = set()
+    current_family = None
+    for line in text.strip().splitlines():
+        if line.startswith("# HELP "):
+            current_family = line.split()[2]
+            assert name_re.fullmatch(current_family), line
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split()
+            assert parts[2] == current_family, (
+                f"TYPE for {parts[2]} does not follow its HELP")
+            assert parts[3] in ("counter", "gauge"), line
+            typed[parts[2]] = parts[3]
+            continue
+        assert not line.startswith("#"), f"unknown comment: {line}"
+        # split series/value at the LAST space: label values may contain
+        # spaces legally
+        series, _, value = line.rpartition(" ")
+        assert series and value_re.fullmatch(value), (
+            f"malformed sample line: {line!r}")
+        brace = series.find("{")
+        metric = series if brace < 0 else series[:brace]
+        assert name_re.fullmatch(metric), f"bad metric name: {line!r}"
+        if brace >= 0:
+            assert series.endswith("}"), f"unclosed labels: {line!r}"
+            parse_labels(series[brace:])
+        assert current_family and metric.startswith(current_family), (
+            f"sample {metric} outside its family block {current_family}")
+        assert series not in seen_series, f"duplicate series: {line}"
+        seen_series.add(series)
+    # counters follow the naming convention (sum/count pairs are declared
+    # gauges on purpose — see api/metrics.py rationale)
+    for family, mtype in typed.items():
+        if mtype == "counter":
+            assert family.endswith("_total"), (
+                f"counter {family} must end in _total")
+    assert len(typed) >= 10
+
+    # the linter itself must reject the malformed shapes it claims to
+    # (mutation guard — an always-green lint is worse than none)
+    for bad in ('{a="1"b="2"}', '{a="1",}', "{}", '{a=1}'):
+        try:
+            parse_labels(bad)
+            raise RuntimeError(f"lint accepted malformed {bad!r}")
+        except AssertionError:
+            pass
+    assert not value_re.fullmatch("1.2.3")
+    assert value_re.fullmatch("1.5e+05") and value_re.fullmatch("1e-9")
