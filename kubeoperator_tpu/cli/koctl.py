@@ -335,6 +335,56 @@ def _poll_to_ready(client, name: str, timeout_s: float, quiet: bool) -> int:
     return 2
 
 
+def _follow_logs_sse(client, name: str) -> None:
+    """Stream the server's SSE log feed, printing lines as they land.
+    Reconnects are deliberately NOT attempted: the server closes the
+    stream after 30s idle, which for a CLI tail means "deploy went
+    quiet" — exiting beats pretending the stream is live."""
+    url = f"{client.base}/api/v1/clusters/{name}/logs?follow=1"
+    with client.http.get(url, stream=True, timeout=600) as resp:
+        if resp.status_code >= 400:
+            # surface the server's message like RestClient.call does —
+            # "error: 404" explains nothing
+            try:
+                message = resp.json().get("message", resp.status_code)
+            except ValueError:
+                message = resp.status_code
+            raise SystemExit(f"error: {message}")
+        for raw in resp.iter_lines(decode_unicode=True):
+            if not raw or not raw.startswith("data: "):
+                continue
+            try:
+                print(json.loads(raw[6:])["line"], flush=True)
+            except (ValueError, KeyError):
+                continue
+
+
+def _follow_logs_local(client, name: str) -> None:
+    """Local-transport tail: poll the persisted log store with the
+    cluster-wide cursor the SSE endpoint uses. Exits after the same 30s
+    idle window the REST stream has — both transports mean the same thing
+    by -f, and a script waiting on the tail must not hang forever."""
+    s = client.services
+    try:
+        cluster = s.clusters.get(name)
+    except KoError as e:
+        from kubeoperator_tpu.utils.i18n import translate
+
+        raise SystemExit(
+            f"error: {translate(e.code, message=e.message, **e.args_map)}")
+    cursor = 0
+    idle = 0.0
+    while idle < 30.0:
+        chunks, cursor = s.repos.task_logs.tail_cluster(cluster.id, cursor)
+        if chunks:
+            idle = 0.0
+            for c in chunks:
+                print(c.line, flush=True)
+        else:
+            idle += 1.0
+        time.sleep(1.0)
+
+
 def cmd_cluster(client, args) -> int:
     if args.cluster_cmd == "create":
         body: dict = {"name": args.name}
@@ -389,8 +439,21 @@ def cmd_cluster(client, args) -> int:
         _print(client.call("GET", f"/api/v1/clusters/{args.name}/trace"))
         return 0
     if args.cluster_cmd == "logs":
-        for chunk in client.call("GET", f"/api/v1/clusters/{args.name}/logs"):
-            print(chunk["line"])
+        if not getattr(args, "follow", False):
+            for chunk in client.call("GET",
+                                     f"/api/v1/clusters/{args.name}/logs"):
+                print(chunk["line"])
+            return 0
+        # --follow: live stream (kubectl-logs-f UX). REST rides the
+        # server's SSE endpoint; the local transport polls the log store
+        # with a cursor — both stop on Ctrl-C.
+        try:
+            if isinstance(client, RestClient):
+                _follow_logs_sse(client, args.name)
+            else:
+                _follow_logs_local(client, args.name)
+        except KeyboardInterrupt:
+            pass
         return 0
     if args.cluster_cmd == "events":
         _print(client.call("GET", f"/api/v1/clusters/{args.name}/events"))
@@ -778,6 +841,9 @@ def build_parser() -> argparse.ArgumentParser:
                  "renew-certs", "rotate-encryption", "trace"):
         sp = csub.add_parser(name)
         sp.add_argument("name")
+        if name == "logs":
+            sp.add_argument("-f", "--follow", action="store_true",
+                            help="stream new log lines (Ctrl-C to stop)")
     imp = csub.add_parser("import")
     imp.add_argument("name")
     imp.add_argument("--kubeconfig-file", required=True)

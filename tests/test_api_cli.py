@@ -995,3 +995,87 @@ def test_metrics_output_is_valid_prometheus_exposition(client):
             pass
     assert not value_re.fullmatch("1.2.3")
     assert value_re.fullmatch("1.5e+05") and value_re.fullmatch("1e-9")
+
+
+class TestKoctlLogsFollow:
+    def test_follow_local_tails_new_lines(self, capsys, monkeypatch,
+                                          tmp_path):
+        """`koctl --local cluster logs -f`: prints the stored lines via the
+        cluster-wide cursor, then keeps polling until interrupted."""
+        from kubeoperator_tpu.cli import koctl
+
+        monkeypatch.setenv("KO_TPU_DB__PATH", str(tmp_path / "lf.db"))
+        monkeypatch.setenv("KO_TPU_EXECUTOR__BACKEND", "simulation")
+        monkeypatch.setenv("KO_TPU_PROVISIONER__WORK_DIR",
+                           str(tmp_path / "tf"))
+        client = koctl.LocalClient()
+        s = client.services
+        from kubeoperator_tpu.models import Credential
+
+        s.credentials.create(Credential(name="ssh", password="pw"))
+        for i in range(2):
+            s.hosts.register(f"h{i}", f"10.0.0.{i+1}", "ssh")
+        from kubeoperator_tpu.models import ClusterSpec
+
+        s.clusters.create("lf", spec=ClusterSpec(worker_count=1),
+                          host_names=["h0", "h1"], wait=True)
+        # stop after the second poll tick
+        ticks = {"n": 0}
+
+        def tired_sleep(_):
+            ticks["n"] += 1
+            if ticks["n"] >= 2:
+                raise KeyboardInterrupt
+
+        monkeypatch.setattr(koctl.time, "sleep", tired_sleep)
+        with pytest.raises(KeyboardInterrupt):
+            koctl._follow_logs_local(client, "lf")
+        out = capsys.readouterr().out
+        assert "TASK [" in out and out.count("\n") > 20
+        # missing cluster: CLI error, not a traceback
+        monkeypatch.setattr(koctl.time, "sleep", lambda _: None)
+        with pytest.raises(SystemExit, match="not found"):
+            koctl._follow_logs_local(client, "nosuch")
+        # quiet stream: exits after the 30s idle window on its own
+        ticks["n"] = -10_000  # disarm the interrupt
+        koctl._follow_logs_local(client, "lf")
+        s.close()
+
+    def test_follow_sse_parses_stream(self):
+        """The REST follow helper consumes the server's SSE shape and
+        prints line payloads, ignoring comments/keepalives/end events."""
+        import io
+        from contextlib import redirect_stdout
+
+        from kubeoperator_tpu.cli import koctl
+
+        class FakeResp:
+            status_code = 200
+
+            def __enter__(self):
+                return self
+
+            def __exit__(self, *a):
+                return False
+
+            def iter_lines(self, decode_unicode=True):
+                yield 'data: {"seq": 1, "line": "TASK [etcd] ok"}'
+                yield ""
+                yield ": keepalive"
+                yield 'data: {"seq": 2, "line": "PLAY RECAP"}'
+                yield "event: end"
+                yield "data: {}"
+
+        class FakeHttp:
+            def get(self, url, stream, timeout):
+                assert url.endswith("/api/v1/clusters/c1/logs?follow=1")
+                return FakeResp()
+
+        class FakeClient:
+            base = "http://x"
+            http = FakeHttp()
+
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            koctl._follow_logs_sse(FakeClient(), "c1")
+        assert buf.getvalue() == "TASK [etcd] ok\nPLAY RECAP\n"
