@@ -314,7 +314,30 @@ class Handlers:
         })
 
     async def healthz(self, request):
-        return json_response({"status": "ok"})
+        """Liveness WITH substance: `koctl status` and the compose
+        healthcheck learn whether the state store answers and which
+        executor backend is live, not just that aiohttp accepts TCP. A
+        dead DB turns the status to 503 — a server that cannot read state
+        is not healthy, whatever its socket says."""
+        from kubeoperator_tpu.version import __version__
+
+        def probe():
+            try:
+                self.s.repos.db.query("SELECT 1")
+                return True
+            except Exception:
+                # the 503 alone says "degraded"; the WHY belongs in the log
+                log.exception("healthz: state store probe failed")
+                return False
+
+        db_ok = await run_sync(request, probe)
+        body = {
+            "status": "ok" if db_ok else "degraded",
+            "version": __version__,
+            "db": db_ok,
+            "executor": type(self.s.executor).__name__,
+        }
+        return json_response(body, status=200 if db_ok else 503)
 
     # ---- clusters (§3.1) ----
     async def list_clusters(self, request):

@@ -28,6 +28,16 @@ COMPOSE_TEMPLATE = {
                 "KO_TPU_DB__PATH": "/var/ko-tpu/db/ko.db",
                 "KO_TPU_EXECUTOR__BACKEND": "auto",
             },
+            # /healthz answers 503 when the state store is dead — compose
+            # restarts a server that cannot read state
+            "healthcheck": {
+                "test": ["CMD-SHELL",
+                         "python3 -c \"import urllib.request,sys; "
+                         "sys.exit(0 if urllib.request.urlopen("
+                         "'http://127.0.0.1:8080/healthz', timeout=4)"
+                         ".status == 200 else 1)\""],
+                "interval": "30s", "timeout": "5s", "retries": 3,
+            },
             "depends_on": ["ko-runner", "ko-registry"],
         },
         "ko-runner": {

@@ -1079,3 +1079,25 @@ class TestKoctlLogsFollow:
         with redirect_stdout(buf):
             koctl._follow_logs_sse(FakeClient(), "c1")
         assert buf.getvalue() == "TASK [etcd] ok\nPLAY RECAP\n"
+
+
+def test_healthz_reports_substance_and_degrades_on_dead_db(client):
+    """Liveness with substance: version + db + executor, and a server
+    that cannot read its state store answers 503, not ok."""
+    base, http, services = client
+    r = requests.get(f"{base}/healthz")
+    assert r.status_code == 200
+    body = r.json()
+    assert body["status"] == "ok" and body["db"] is True
+    assert body["executor"] == "SimulationExecutor"
+    assert body["version"]
+
+    orig = services.repos.db.query
+    services.repos.db.query = lambda *a, **kw: (_ for _ in ()).throw(
+        RuntimeError("db gone"))
+    try:
+        r = requests.get(f"{base}/healthz")
+        assert r.status_code == 503
+        assert r.json()["status"] == "degraded"
+    finally:
+        services.repos.db.query = orig

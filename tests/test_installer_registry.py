@@ -20,6 +20,9 @@ class TestInstaller:
                                  "prometheus", "grafana"}
         assert services["ko-server"]["depends_on"] == ["ko-runner",
                                                        "ko-registry"]
+        # ko-server is health-gated on its own /healthz (503 = dead DB)
+        hc = services["ko-server"]["healthcheck"]
+        assert "/healthz" in hc["test"][1] and hc["retries"] >= 3
         # no GPU runtime hooks in the platform compose
         text = open(compose_path).read().lower()
         assert "nvidia" not in text and "gpu" not in text
