@@ -119,15 +119,27 @@ class MetricsRegistry:
                [_fmt("ko_tpu_phase_duration_seconds_count", {"phase": p}, n)
                 for p, n in sorted(span_count.items())])
 
-        stats = services.executor.task_stats()
-        family("ko_tpu_executor_tasks_started_total", "counter",
-               "Playbook/adhoc tasks launched since process start.",
-               [_fmt("ko_tpu_executor_tasks_started_total", None,
-                     stats["started_total"])])
-        family("ko_tpu_executor_tasks", "gauge",
-               "Retained executor tasks by status (RUNNING = queue depth).",
-               [_fmt("ko_tpu_executor_tasks", {"status": s}, n)
-                for s, n in sorted(stats["by_status"].items())])
+        try:
+            stats = services.executor.task_stats()
+        except Exception:
+            # grpc backend with ko-runner down: scrape must not 500, and a
+            # fabricated zero would read as "idle" — export up=0 and omit
+            # the task families instead
+            stats = None
+        family("ko_tpu_executor_up", "gauge",
+               "1 when the executor backend answers (for backend=grpc this "
+               "is a liveness RPC against ko-runner).",
+               [_fmt("ko_tpu_executor_up", None,
+                     1 if stats is not None else 0)])
+        if stats is not None:
+            family("ko_tpu_executor_tasks_started_total", "counter",
+                   "Playbook/adhoc tasks launched since process start.",
+                   [_fmt("ko_tpu_executor_tasks_started_total", None,
+                         stats["started_total"])])
+            family("ko_tpu_executor_tasks", "gauge",
+                   "Retained executor tasks by status (RUNNING = queue depth).",
+                   [_fmt("ko_tpu_executor_tasks", {"status": s}, n)
+                    for s, n in sorted(stats["by_status"].items())])
 
         term = services.terminals.stats()
         family("ko_tpu_terminal_sessions", "gauge",

@@ -330,14 +330,34 @@ class Handlers:
                 log.exception("healthz: state store probe failed")
                 return False
 
-        db_ok = await run_sync(request, probe)
+        def probe_executor():
+            # in-process backends answer from their own registry; the grpc
+            # backend turns this into a liveness RPC against ko-runner — a
+            # server whose runner is down cannot mutate clusters, so it
+            # degrades just like a dead DB does
+            try:
+                self.s.executor.task_stats()
+                return True
+            except Exception:
+                log.exception("healthz: executor probe failed")
+                return False
+
+        import asyncio
+
+        # concurrent probes: a hung runner (5s Stats deadline) must not
+        # stack on top of the DB probe's latency
+        db_ok, exec_ok = await asyncio.gather(
+            run_sync(request, probe), run_sync(request, probe_executor)
+        )
+        healthy = db_ok and exec_ok
         body = {
-            "status": "ok" if db_ok else "degraded",
+            "status": "ok" if healthy else "degraded",
             "version": __version__,
             "db": db_ok,
             "executor": type(self.s.executor).__name__,
+            "executor_ok": exec_ok,
         }
-        return json_response(body, status=200 if db_ok else 503)
+        return json_response(body, status=200 if healthy else 503)
 
     # ---- clusters (§3.1) ----
     async def list_clusters(self, request):

@@ -28,9 +28,28 @@ __all__ = [
 ]
 
 
-def make_executor(backend: str = "auto", project_dir: str | None = None) -> Executor:
+def make_executor(
+    backend: str = "auto",
+    project_dir: str | None = None,
+    runner_address: str | None = None,
+) -> Executor:
     """Backend factory honoring config `executor.backend` (auto|ansible|
-    simulation|fake)."""
+    simulation|fake|grpc).
+
+    `grpc` crosses the kobe-parity process boundary: phases run in the
+    ko-runner process at `executor.runner_address`, not in-process — the
+    topology the installer's compose file ships (installer/install.py).
+    """
+    if backend == "grpc":
+        if not runner_address:
+            # the one default lives in utils/config.py DEFAULTS — callers
+            # must pass it through rather than this factory duplicating it
+            raise ValueError(
+                "executor.backend=grpc requires executor.runner_address"
+            )
+        from kubeoperator_tpu.executor.runner_service import RunnerClient
+
+        return RunnerClient(runner_address)
     if backend == "auto":
         backend = "ansible" if ansible_available() else "simulation"
     if backend == "ansible":

@@ -114,11 +114,18 @@ class Executor(abc.ABC):
         self.platform_vars: dict = {}
 
     # ---- public contract (kobe parity) ----
-    def run(self, spec: TaskSpec) -> str:
+    def run(self, spec: TaskSpec, task_id: str | None = None) -> str:
+        """Submit a task. `task_id` is an optional caller-chosen idempotency
+        key (the gRPC client sends one): resubmitting an id that is already
+        registered returns it WITHOUT launching again, which makes
+        Run-with-retry safe across a runner restart — a delivered-but-
+        unacknowledged Run cannot double-launch a playbook."""
         spec.validate()
-        task_id = new_id()
+        task_id = task_id or new_id()
         state = _TaskState(task_id)
         with self._lock:
+            if task_id in self._tasks:
+                return task_id
             self._tasks[task_id] = state
             self._order.append(task_id)
             self._started_total += 1

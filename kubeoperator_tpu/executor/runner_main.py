@@ -1,0 +1,91 @@
+"""Standalone runner process — what the installer's `ko-runner` container
+runs (kobe parity: SURVEY.md §2 "server↔kobe (gRPC, streamed task output)"
+is a PROCESS boundary; this module is the far side of it).
+
+`python -m kubeoperator_tpu.executor.runner_main --bind 0.0.0.0:8790`
+serves any local backend (auto|ansible|simulation|fake) behind the gRPC
+runner service. ko-server points at it with::
+
+    executor:
+      backend: grpc
+      runner_address: ko-runner:8790
+
+Environment overrides mirror the server's config tier-1 convention
+(KO_TPU_RUNNER_BIND / KO_TPU_RUNNER_BACKEND / KO_TPU_RUNNER_PROJECT_DIR),
+so the compose file can configure the container without a config volume.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import threading
+
+from kubeoperator_tpu.utils.logging import get_logger, setup_logging
+
+log = get_logger("runner-main")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    env = os.environ
+    p = argparse.ArgumentParser(
+        prog="ko-tpu-runner",
+        description="gRPC ansible runner (kobe-parity process boundary)",
+    )
+    p.add_argument("--bind", default=env.get("KO_TPU_RUNNER_BIND", "0.0.0.0:8790"))
+    p.add_argument(
+        "--backend",
+        default=env.get("KO_TPU_RUNNER_BACKEND", "auto"),
+        choices=["auto", "ansible", "simulation", "fake"],
+        help="local backend to serve (grpc-to-grpc chaining is refused)",
+    )
+    p.add_argument(
+        "--project-dir", default=env.get("KO_TPU_RUNNER_PROJECT_DIR") or None
+    )
+    p.add_argument("--max-workers", type=int, default=16)
+    p.add_argument(
+        "--task-delay-s", type=float,
+        default=float(env.get("KO_TPU_RUNNER_TASK_DELAY_S", "0") or 0),
+        help="simulation pacing (tests/demos); ignored by other backends",
+    )
+    p.add_argument("--log-level", default=env.get("KO_TPU_RUNNER_LOG_LEVEL", "INFO"))
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    setup_logging(args.log_level)
+
+    from kubeoperator_tpu.executor import SimulationExecutor, make_executor
+    from kubeoperator_tpu.executor.runner_service import serve
+
+    if args.backend == "simulation" and args.task_delay_s:
+        executor = SimulationExecutor(
+            project_dir=args.project_dir, task_delay_s=args.task_delay_s
+        )
+    else:
+        executor = make_executor(args.backend, args.project_dir)
+
+    server = serve(executor, bind=args.bind, max_workers=args.max_workers)
+    log.info(
+        "runner up: backend=%s bind=%s project_dir=%s",
+        type(executor).__name__, args.bind, args.project_dir or "(bundled)",
+    )
+
+    stop = threading.Event()
+
+    def _term(signum, frame):
+        log.info("runner: signal %s, draining", signum)
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _term)
+    signal.signal(signal.SIGINT, _term)
+    stop.wait()
+    # grace period lets in-flight Watch streams flush their tails
+    server.stop(grace=5.0).wait(timeout=10.0)
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover - exercised via subprocess e2e
+    raise SystemExit(main())

@@ -58,11 +58,18 @@ class _Handler(grpc.GenericRpcHandler):
             return grpc.unary_unary_rpc_method_handler(
                 self._result, request_deserializer=_loads, response_serializer=_dumps
             )
+        if method == f"/{SERVICE}/Stats":
+            return grpc.unary_unary_rpc_method_handler(
+                self._stats, request_deserializer=_loads, response_serializer=_dumps
+            )
         return None
 
     def _run(self, request: dict, context) -> dict:
+        # client-generated idempotency key (absent from legacy clients):
+        # a retried Run whose first attempt WAS delivered dedupes here
+        task_id = request.pop("task_id", None)
         spec = TaskSpec(**request)
-        task_id = self.executor.run(spec)
+        task_id = self.executor.run(spec, task_id=task_id)
         log.info("runner: task %s started (%s)", task_id,
                  spec.playbook or spec.adhoc_module)
         return {"task_id": task_id}
@@ -76,6 +83,11 @@ class _Handler(grpc.GenericRpcHandler):
         d = r.__dict__.copy()
         d["host_stats"] = {h: s.__dict__ for h, s in r.host_stats.items()}
         return d
+
+    def _stats(self, request: dict, context) -> dict:
+        # liveness + observability in one RPC: the server's /metrics and
+        # /healthz reach the REMOTE task registry, not the client's empty one
+        return self.executor.task_stats()
 
 
 def serve(
@@ -105,13 +117,40 @@ class RunnerClient(Executor):
         self._result_rpc = self.channel.unary_unary(
             f"/{SERVICE}/Result", request_serializer=_dumps, response_deserializer=_loads
         )
+        self._stats_rpc = self.channel.unary_unary(
+            f"/{SERVICE}/Stats", request_serializer=_dumps, response_deserializer=_loads
+        )
 
-    def run(self, spec: TaskSpec) -> str:
+    # How long Run tolerates an UNAVAILABLE runner before giving up. The
+    # compose ships ko-runner with `restart: always`; a task submitted
+    # while the container is bouncing should ride out the gap, not fail
+    # the phase. Retrying is SAFE here — every attempt carries the same
+    # client-generated idempotency task_id, and the server dedupes on it,
+    # so a first attempt that WAS delivered (UNAVAILABLE raced the
+    # response) cannot double-launch a playbook. wait_for_ready alone is
+    # not enough: a stale-READY channel whose socket died fails the RPC
+    # immediately instead of waiting out the restart (verified live).
+    # Watch/Result/Stats stay fail-fast: a broken mid-task stream cannot
+    # be resumed, and liveness probes must not lie.
+    connect_retry_s: float = 10.0
+
+    def run(self, spec: TaskSpec, task_id: str | None = None) -> str:
         spec.validate()
-        try:
-            return self._run_rpc(spec.__dict__)["task_id"]
-        except grpc.RpcError as e:
-            raise ExecutorError(message=f"runner RPC failed: {e}") from e
+        from kubeoperator_tpu.utils.ids import new_id
+        import time as _time
+
+        request = dict(spec.__dict__, task_id=task_id or new_id())
+        deadline = _time.monotonic() + self.connect_retry_s
+        while True:
+            try:
+                return self._run_rpc(request)["task_id"]
+            except grpc.RpcError as e:
+                code = e.code() if hasattr(e, "code") else None
+                if (code == grpc.StatusCode.UNAVAILABLE
+                        and _time.monotonic() < deadline):
+                    _time.sleep(0.3)
+                    continue
+                raise ExecutorError(message=f"runner RPC failed: {e}") from e
 
     def watch(self, task_id: str, timeout_s: float = 7200.0) -> Iterator[str]:
         try:
@@ -121,11 +160,24 @@ class RunnerClient(Executor):
             raise ExecutorError(message=f"runner watch failed: {e}") from e
 
     def result(self, task_id: str) -> TaskResult:
-        d = self._result_rpc({"task_id": task_id})
+        try:
+            d = self._result_rpc({"task_id": task_id})
+        except grpc.RpcError as e:
+            raise ExecutorError(message=f"runner result failed: {e}") from e
         d["host_stats"] = {
             h: HostStats(**s) for h, s in d.get("host_stats", {}).items()
         }
         return TaskResult(**d)
+
+    def task_stats(self) -> dict:
+        """Remote registry stats (Stats RPC) — the tasks live in the runner
+        process, not here; raises ExecutorError when the runner is down so
+        /healthz and /metrics can degrade honestly instead of reporting a
+        truthful-looking zero."""
+        try:
+            return self._stats_rpc({}, timeout=5.0)
+        except grpc.RpcError as e:
+            raise ExecutorError(message=f"runner unreachable: {e}") from e
 
     def wait(self, task_id: str, timeout_s: float = 7200.0) -> TaskResult:
         for _ in self.watch(task_id, timeout_s):

@@ -26,7 +26,10 @@ COMPOSE_TEMPLATE = {
             ],
             "environment": {
                 "KO_TPU_DB__PATH": "/var/ko-tpu/db/ko.db",
-                "KO_TPU_EXECUTOR__BACKEND": "auto",
+                # phases cross the kobe-parity process boundary: ko-server
+                # holds no ansible — it RPCs the ko-runner container
+                "KO_TPU_EXECUTOR__BACKEND": "grpc",
+                "KO_TPU_EXECUTOR__RUNNER_ADDRESS": "ko-runner:8790",
             },
             # /healthz answers 503 when the state store is dead — compose
             # restarts a server that cannot read state
@@ -41,9 +44,13 @@ COMPOSE_TEMPLATE = {
             "depends_on": ["ko-runner", "ko-registry"],
         },
         "ko-runner": {
-            # kobe-parity: the gRPC ansible runner as its own container
+            # kobe-parity: the gRPC ansible runner as its own container;
+            # ko-server reaches it via executor.backend=grpc (see its env)
             "image": "ko-tpu/runner:{version}",
             "restart": "always",
+            "command": ["python3", "-m",
+                        "kubeoperator_tpu.executor.runner_main",
+                        "--bind", "0.0.0.0:8790"],
             "ports": ["8790:8790"],
             "volumes": ["{data_dir}/ssh:/root/.ssh:ro"],
         },

@@ -60,3 +60,10 @@ class BackupFile(Entity):
     size_bytes: int = 0
     status: str = "Created"     # Created | Uploaded | Restored | Failed
     message: str = ""
+    # True when the backup role wrote the ko-tpu/backup-sentinel key into
+    # etcd before snapshotting — restore verification then REQUIRES the
+    # restored keyspace to answer with this file's name. Rows persisted
+    # before sentinel support deserialize False and are grandfathered
+    # (restore still gates on version/nodes/etcd/apiserver, just not the
+    # data sentinel, which their snapshots cannot contain).
+    has_sentinel: bool = False

@@ -193,8 +193,11 @@ class BackupService:
                 )
             account = self.repos.backup_accounts.get(strategy.account_id)
         fname = f"etcd-{cluster.name}-{now_iso().replace(':', '')}.db"
+        # every backup taken by this version embeds the sentinel (the
+        # backup role writes it before snapshotting) — recorded on the
+        # file row so restore knows whether to demand it back
         record = BackupFile(cluster_id=cluster.id, account_id=account.id,
-                            name=fname)
+                            name=fname, has_sentinel=True)
         self.repos.backup_files.save(record)
         ctx = self._context(cluster, account, fname)
         try:
@@ -222,6 +225,10 @@ class BackupService:
         record = files[0]
         account = self.repos.backup_accounts.get(record.account_id)
         ctx = self._context(cluster, account, file_name)
+        # legacy snapshots (taken before sentinel support) cannot contain
+        # the sentinel key — restore_verify_post skips that one check for
+        # them instead of condemning every old backup as unrestorable
+        ctx.extra_vars["restore_expect_sentinel"] = record.has_sentinel
         try:
             self.adm.run(ctx, restore_phases())
         except PhaseError as e:

@@ -102,7 +102,11 @@ def build_services(
     db = Database(config.get("db.path", "ko_tpu.db"))
     repos = Repositories(db)
     backend = config.get("executor.backend", "auto")
-    executor = make_executor(backend, config.get("executor.project_dir"))
+    executor = make_executor(
+        backend,
+        config.get("executor.project_dir"),
+        runner_address=config.get("executor.runner_address"),
+    )
     if simulate is None:
         simulate = not terraform_available(
             config.get("provisioner.terraform_bin", "terraform")

@@ -32,8 +32,10 @@ DEFAULTS: dict[str, Any] = {
         "path": "ko_tpu.db",
     },
     "executor": {
-        # "auto": ansible binary if present, else the built-in local engine.
+        # "auto": ansible binary if present, else the built-in local engine;
+        # "grpc": the ko-runner process at runner_address (compose topology).
         "backend": "auto",
+        "runner_address": "127.0.0.1:8790",
         "project_dir": None,  # defaults to bundled content/ dir
         "fork_limit": 32,
         "task_timeout_s": 3600,

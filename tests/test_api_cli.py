@@ -1101,3 +1101,16 @@ def test_healthz_reports_substance_and_degrades_on_dead_db(client):
         assert r.json()["status"] == "degraded"
     finally:
         services.repos.db.query = orig
+
+    # executor probe (grpc backend with ko-runner down): 503, and the WHY
+    # is in the body — db fine, executor not
+    orig_stats = services.executor.task_stats
+    services.executor.task_stats = lambda: (_ for _ in ()).throw(
+        RuntimeError("runner unreachable"))
+    try:
+        r = requests.get(f"{base}/healthz")
+        assert r.status_code == 503
+        body = r.json()
+        assert body["db"] is True and body["executor_ok"] is False
+    finally:
+        services.executor.task_stats = orig_stats

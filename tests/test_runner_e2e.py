@@ -1,0 +1,239 @@
+"""The server↔runner process boundary, executed for real (VERDICT r4 #1).
+
+SURVEY §3.1 marks server↔kobe as a PROCESS boundary of the #1 path. These
+tests boot the runner the installer's compose file ships —
+`python -m kubeoperator_tpu.executor.runner_main` in a SEPARATE OS process —
+point a full service stack at it via `executor.backend: grpc`, and drive the
+north-star create through it:
+
+  - create --plan tpu-v5e-16 → all phases stream over gRPC → Ready, with
+    the smoke gate and the runner's remote task registry as proof;
+  - the failure drill: kill -9 the runner mid-create → the cluster lands
+    Failed-resumable; a RESTARTED runner on the same address serves the
+    retry, which resumes at the failed phase (completed phases not re-run).
+
+This is the compose topology (installer/install.py ko-server env →
+ko-runner) executing, not just the RPC pair in isolation
+(tests/test_executor.py covers that).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+from kubeoperator_tpu.executor.runner_service import RunnerClient
+from kubeoperator_tpu.models import Credential, Plan, Region, Zone
+from kubeoperator_tpu.service import build_services
+from kubeoperator_tpu.utils.config import load_config
+from kubeoperator_tpu.utils.errors import ExecutorError
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _wait_port(port: int, timeout_s: float = 20.0) -> None:
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        try:
+            with socket.create_connection(("127.0.0.1", port), timeout=1):
+                return
+        except OSError:
+            time.sleep(0.05)
+    raise TimeoutError(f"runner never listened on {port}")
+
+
+def spawn_runner(port: int, task_delay_s: float = 0.0) -> subprocess.Popen:
+    """The ko-runner container process, minus docker: same module, same
+    argv shape as the compose `command:`."""
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "kubeoperator_tpu.executor.runner_main",
+         "--bind", f"127.0.0.1:{port}",
+         "--backend", "simulation",
+         "--task-delay-s", str(task_delay_s),
+         "--log-level", "WARNING"],
+        cwd=REPO_ROOT,
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+    )
+    _wait_port(port)
+    return proc
+
+
+@pytest.fixture()
+def grpc_stack(tmp_path):
+    """Runner subprocess + a service stack configured the way the compose
+    file configures ko-server (backend=grpc, runner_address)."""
+    port = _free_port()
+    proc = spawn_runner(port)
+    config = load_config(
+        path="/nonexistent",
+        env={},
+        overrides={
+            "db": {"path": str(tmp_path / "svc.db")},
+            "executor": {"backend": "grpc",
+                         "runner_address": f"127.0.0.1:{port}"},
+            "provisioner": {"work_dir": str(tmp_path / "tf")},
+            "cron": {"health_check_interval_s": 0},
+            "cluster": {"kubeconfig_dir": str(tmp_path / "kubeconfigs")},
+        },
+    )
+    svc = build_services(config, simulate=True)
+    yield svc, proc, port
+    svc.close()
+    if proc.poll() is None:
+        proc.kill()
+    proc.wait(timeout=10)
+
+
+def make_tpu_plan(svc) -> Plan:
+    region = svc.regions.create(Region(
+        name="gcp-us", provider="gcp_tpu_vm",
+        vars={"project": "p", "name": "us-central1"},
+    ))
+    zone = svc.zones.create(Zone(
+        name="us-central1-a", region_id=region.id,
+        vars={"gcp_zone": "us-central1-a"},
+    ))
+    return svc.plans.create(Plan(
+        name="tpu-v5e-16", provider="gcp_tpu_vm", region_id=region.id,
+        zone_ids=[zone.id], accelerator="tpu", tpu_type="v5e-16",
+        num_slices=1, worker_count=0,
+    ))
+
+
+class TestNorthStarOverGrpcRunner:
+    def test_create_to_ready_through_separate_process(self, grpc_stack):
+        svc, proc, _port = grpc_stack
+        assert isinstance(svc.executor, RunnerClient)
+        make_tpu_plan(svc)
+
+        svc.clusters.create(
+            "ns-grpc", provision_mode="plan", plan_name="tpu-v5e-16",
+            wait=True,
+        )
+        cluster = svc.clusters.get("ns-grpc")
+        assert cluster.status.phase == "Ready"
+        assert cluster.status.smoke_passed and cluster.status.smoke_chips == 16
+
+        # proof the boundary was crossed: every phase ran in the RUNNER
+        # process's registry (Stats RPC), while the client-side registry —
+        # which in-process backends would have populated — stayed empty
+        remote = svc.executor.task_stats()
+        n_phases = len(cluster.status.conditions)
+        assert remote["started_total"] == n_phases > 5
+        assert svc.executor._tasks == {}
+        assert proc.poll() is None  # same runner served the whole create
+
+        # streamed Watch output was persisted through the boundary
+        logs = svc.repos.task_logs.find(cluster_id=cluster.id)
+        assert len(logs) > 20
+
+    def test_manual_create_and_adhoc_ping_over_grpc(self, grpc_stack):
+        svc, _proc, _port = grpc_stack
+        from kubeoperator_tpu.models import ClusterSpec
+
+        svc.credentials.create(Credential(name="ssh", password="pw"))
+        for i in range(2):
+            svc.hosts.register(f"host{i}", f"10.0.0.{i+1}", "ssh")
+        svc.clusters.create(
+            "manual-grpc", spec=ClusterSpec(worker_count=1),
+            host_names=["host0", "host1"], wait=True,
+        )
+        assert svc.clusters.get("manual-grpc").status.phase == "Ready"
+
+
+class TestRunnerKillResumeDrill:
+    def test_kill_mid_create_then_retry_on_restarted_runner(self, tmp_path):
+        port = _free_port()
+        # pace the simulation so the kill deterministically lands mid-create
+        proc = spawn_runner(port, task_delay_s=0.03)
+        config = load_config(
+            path="/nonexistent", env={},
+            overrides={
+                "db": {"path": str(tmp_path / "svc.db")},
+                "executor": {"backend": "grpc",
+                             "runner_address": f"127.0.0.1:{port}"},
+                "provisioner": {"work_dir": str(tmp_path / "tf")},
+                "cron": {"health_check_interval_s": 0},
+                "cluster": {"kubeconfig_dir": str(tmp_path / "kubeconfigs")},
+            },
+        )
+        svc = build_services(config, simulate=True)
+        try:
+            make_tpu_plan(svc)
+            svc.clusters.create(
+                "ns-kill", provision_mode="plan", plan_name="tpu-v5e-16",
+                wait=False,
+            )
+
+            # wait until at least one phase finished OK and a later one is
+            # streaming, then SIGKILL the runner process mid-Watch
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                c = svc.clusters.get("ns-kill")
+                ok = [x for x in c.status.conditions if x.status == "OK"]
+                running = [x for x in c.status.conditions
+                           if x.status == "Running"]
+                if len(ok) >= 1 and running:
+                    break
+                time.sleep(0.05)
+            else:
+                pytest.fail("create never reached a mid-phase state")
+            proc.kill()
+            proc.wait(timeout=10)
+
+            # the async create thread must land Failed-resumable, not hang
+            svc.clusters.wait_all(timeout_s=60)
+            cluster = svc.clusters.get("ns-kill")
+            assert cluster.status.phase == "Failed"
+            failed_at = cluster.status.first_unfinished()
+            assert failed_at is not None
+            ok_before = {
+                x.name: x.finished_at
+                for x in cluster.status.conditions if x.status == "OK"
+            }
+            assert ok_before  # at least one phase survived as a checkpoint
+
+            # while the runner is dead the boundary reports itself dead
+            with pytest.raises(ExecutorError, match="unreachable"):
+                svc.executor.task_stats()
+
+            # restart the runner on the SAME address (compose `restart:
+            # always` behavior) and retry: resumes at the failed phase.
+            # Poll until the server's channel has reconnected — compose
+            # models this with the healthcheck/depends_on gate.
+            proc = spawn_runner(port)
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline:
+                try:
+                    svc.executor.task_stats()
+                    break
+                except ExecutorError:
+                    time.sleep(0.2)
+            svc.clusters.retry("ns-kill", wait=True)
+            cluster = svc.clusters.get("ns-kill")
+            assert cluster.status.phase == "Ready"
+            assert cluster.status.smoke_passed
+
+            # completed phases were NOT re-run: their condition spans are
+            # untouched, and the new runner only ever saw the resumed tail
+            for name, stamp in ok_before.items():
+                assert cluster.status.condition(name).finished_at == stamp
+            resumed = svc.executor.task_stats()["started_total"]
+            assert 0 < resumed < len(cluster.status.conditions)
+        finally:
+            svc.close()
+            if proc.poll() is None:
+                proc.kill()
+            proc.wait(timeout=10)
