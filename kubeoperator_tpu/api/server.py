@@ -342,8 +342,6 @@ class Handlers:
                 log.exception("healthz: executor probe failed")
                 return False
 
-        import asyncio
-
         # concurrent probes: a hung runner (5s Stats deadline) must not
         # stack on top of the DB probe's latency
         db_ok, exec_ok = await asyncio.gather(

@@ -57,15 +57,24 @@ def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
     setup_logging(args.log_level)
 
-    from kubeoperator_tpu.executor import SimulationExecutor, make_executor
+    from kubeoperator_tpu.executor import (
+        SimulationExecutor,
+        ansible_available,
+        make_executor,
+    )
     from kubeoperator_tpu.executor.runner_service import serve
 
-    if args.backend == "simulation" and args.task_delay_s:
+    # resolve 'auto' BEFORE the delay branch, so a pacing delay set on an
+    # auto-resolved simulation backend is honored, not silently dropped
+    backend = args.backend
+    if backend == "auto":
+        backend = "ansible" if ansible_available() else "simulation"
+    if backend == "simulation" and args.task_delay_s:
         executor = SimulationExecutor(
             project_dir=args.project_dir, task_delay_s=args.task_delay_s
         )
     else:
-        executor = make_executor(args.backend, args.project_dir)
+        executor = make_executor(backend, args.project_dir)
 
     server = serve(executor, bind=args.bind, max_workers=args.max_workers)
     log.info(

@@ -1,0 +1,273 @@
+"""BASELINE config matrix — metric 1 as a published, tracked artifact.
+
+Drives ALL FIVE BASELINE.json configs through create→Ready (VERDICT r4
+next #2) and records each config's create-to-Ready wall-clock into
+`PERF.json` (machine history, round-over-round) + `PERF.md` (rendered
+table with deltas), the way metric 2 already works via BENCH_r*.json.
+
+The five configs and what each proves:
+
+  1. manual-cpu-1x1     — SURVEY §7.4 minimum slice: manual plan, 1 master
+                          + 1 worker, containerd, CPU only.
+  2. vsphere-ha-3m3w    — vSphere IaaS plan, 3-master HA + 3 workers
+                          through the REAL TerraformProvisioner subprocess
+                          (PATH-shimmed binary), internal haproxy/
+                          keepalived LB phase executing on 3 masters. An
+                          external-LB variant asserts the phase skip.
+  3. tpu-v5e-4          — GCP TPU-VM plan, single-host v5e-4 slice; the
+                          GPU-addon baseline config ported per the north
+                          star (no GPU package anywhere in the build).
+  4. tpu-v5e-16         — the north star: 4-host v5e-16 pod slice, psum
+                          smoke gate over 16 chips.
+  5. tpu-v5p-64-x2      — multi-host v5p-64 pod slices ×2 (multislice,
+                          JobSet path), 64 chips total.
+
+Wall-clock here measures the PLATFORM's orchestration cost (provision →
+phase engine → smoke gate) over the simulation executor + shimmed
+terraform: no SSH or package installs, so numbers are comparable
+round-over-round as a regression trace of the control plane itself. The
+phase-span portion (trace total_s) is recorded alongside.
+
+Run: `python perf_matrix.py` (writes PERF.json + PERF.md at repo root).
+The pytest twin (tests/test_baseline_matrix.py) drives the same five
+configs in CI and asserts the Ready/topology/LB invariants.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+REPO_ROOT = os.path.dirname(os.path.abspath(__file__))
+SHIM_DIR = os.path.join(REPO_ROOT, "tests", "shims")
+
+CONFIG_NAMES = [
+    "manual-cpu-1x1",
+    "vsphere-ha-3m3w",
+    "tpu-v5e-4",
+    "tpu-v5e-16",
+    "tpu-v5p-64-x2",
+]
+
+
+def build_stack(base_dir: str, real_terraform: bool):
+    """Service stack over the simulation executor; plan-mode configs run
+    the REAL TerraformProvisioner against the PATH-shimmed binary."""
+    from kubeoperator_tpu.service import build_services
+    from kubeoperator_tpu.utils.config import load_config
+
+    os.makedirs(base_dir, exist_ok=True)
+    config = load_config(
+        path="/nonexistent",
+        env={},
+        overrides={
+            "db": {"path": os.path.join(base_dir, "svc.db")},
+            "executor": {"backend": "simulation"},
+            "provisioner": {"work_dir": os.path.join(base_dir, "tfruns"),
+                            "timeout_s": 60},
+            "cron": {"health_check_interval_s": 0},
+            "cluster": {"kubeconfig_dir": os.path.join(base_dir, "kc")},
+        },
+    )
+    return build_services(config, simulate=not real_terraform)
+
+
+# ---------------------------------------------------------------- drivers ----
+def run_manual_cpu(svc):
+    """Config #1: manual 1 master + 1 worker, CPU-only, containerd."""
+    from kubeoperator_tpu.models import ClusterSpec, Credential
+
+    svc.credentials.create(Credential(name="perf-ssh", password="pw"))
+    for i in range(2):
+        svc.hosts.register(f"perf-host{i}", f"10.40.0.{i+1}", "perf-ssh")
+    svc.clusters.create(
+        "perf-manual", spec=ClusterSpec(worker_count=1, runtime="containerd"),
+        host_names=["perf-host0", "perf-host1"], wait=True,
+    )
+    return svc.clusters.get("perf-manual")
+
+
+def run_vsphere_ha(svc, lb_mode: str = "internal"):
+    """Config #2: vSphere 3-master HA + 3 workers, terraform subprocess,
+    internal LB phase on 3 masters (or external variant skipping it)."""
+    from kubeoperator_tpu.models import ClusterSpec, Plan, Region, Zone
+
+    suffix = lb_mode
+    region = svc.regions.create(Region(
+        name=f"dc1-{suffix}", provider="vsphere",
+        vars={"vcenter_host": "vc.local", "vcenter_user": "admin",
+              "vcenter_password": "pw"},
+    ))
+    zone = svc.zones.create(Zone(
+        name=f"pool-{suffix}", region_id=region.id,
+        vars={"gateway": "10.9.0.1"},
+        ip_pool=[f"10.9.{10 if lb_mode == 'internal' else 20}.{i}"
+                 for i in range(10, 20)],
+    ))
+    svc.plans.create(Plan(
+        name=f"vs-ha-{suffix}", provider="vsphere", region_id=region.id,
+        zone_ids=[zone.id], master_count=3, worker_count=3,
+    ))
+    spec = ClusterSpec(lb_mode=lb_mode,
+                       lb_endpoint="10.9.0.100" if lb_mode == "external" else "")
+    svc.clusters.create(
+        f"perf-vsha-{suffix}", spec=spec, provision_mode="plan",
+        plan_name=f"vs-ha-{suffix}", wait=True,
+    )
+    return svc.clusters.get(f"perf-vsha-{suffix}")
+
+
+def run_tpu(svc, tpu_type: str, num_slices: int = 1):
+    """Configs #3/#4/#5: GCP TPU-VM plans through the terraform subprocess,
+    smoke gate over the slice topology."""
+    from kubeoperator_tpu.models import Plan, Region, Zone
+
+    tag = f"{tpu_type}-x{num_slices}"
+    region = svc.regions.create(Region(
+        name=f"gcp-{tag}", provider="gcp_tpu_vm",
+        vars={"project": "perf", "name": "us-central1"},
+    ))
+    zone = svc.zones.create(Zone(
+        name=f"us-central1-a-{tag}", region_id=region.id,
+        vars={"gcp_zone": "us-central1-a"},
+    ))
+    svc.plans.create(Plan(
+        name=f"perf-{tag}", provider="gcp_tpu_vm", region_id=region.id,
+        zone_ids=[zone.id], accelerator="tpu", tpu_type=tpu_type,
+        num_slices=num_slices, worker_count=0,
+    ))
+    svc.clusters.create(
+        f"perf-{tag}", provision_mode="plan", plan_name=f"perf-{tag}",
+        wait=True,
+    )
+    return svc.clusters.get(f"perf-{tag}")
+
+
+def _timed(fn, *args, **kw):
+    t0 = time.monotonic()
+    cluster = fn(*args, **kw)
+    wall_s = time.monotonic() - t0
+    if cluster.status.phase != "Ready":
+        raise RuntimeError(
+            f"{cluster.name} ended {cluster.status.phase}: "
+            f"{cluster.status.message}"
+        )
+    trace = cluster.status.trace()
+    return {
+        "wall_s": round(wall_s, 3),
+        "phases_s": trace["total_s"],
+        "phases": len(trace["spans"]),
+        "smoke_chips": cluster.status.smoke_chips or None,
+    }
+
+
+def run_matrix() -> dict:
+    """All five configs; returns {config_name: metrics}."""
+    os.environ["PATH"] = SHIM_DIR + os.pathsep + os.environ["PATH"]
+    os.environ.pop("KO_SHIM_TF_SCENARIO", None)
+    results: dict[str, dict] = {}
+    with tempfile.TemporaryDirectory(prefix="ko-perf-") as base:
+        svc = build_stack(os.path.join(base, "manual"), real_terraform=False)
+        try:
+            results["manual-cpu-1x1"] = _timed(run_manual_cpu, svc)
+        finally:
+            svc.close()
+        svc = build_stack(os.path.join(base, "plans"), real_terraform=True)
+        try:
+            results["vsphere-ha-3m3w"] = _timed(run_vsphere_ha, svc)
+            results["tpu-v5e-4"] = _timed(run_tpu, svc, "v5e-4")
+            results["tpu-v5e-16"] = _timed(run_tpu, svc, "v5e-16")
+            results["tpu-v5p-64-x2"] = _timed(run_tpu, svc, "v5p-64",
+                                              num_slices=2)
+        finally:
+            svc.close()
+    return results
+
+
+# -------------------------------------------------------------- artifacts ----
+def current_round(default: int = 5) -> int:
+    path = os.path.join(REPO_ROOT, "PROGRESS.jsonl")
+    try:
+        with open(path, encoding="utf-8") as f:
+            lines = [l for l in f if l.strip()]
+        return int(json.loads(lines[-1]).get("round", default))
+    except Exception:
+        return default
+
+
+def write_artifacts(results: dict, round_no: int) -> None:
+    hist_path = os.path.join(REPO_ROOT, "PERF.json")
+    history: dict = {"metric": "create-to-Ready wall-clock (s) per "
+                               "BASELINE config", "rounds": {}}
+    if os.path.exists(hist_path):
+        try:
+            with open(hist_path, encoding="utf-8") as f:
+                history = json.load(f)
+        except ValueError:
+            pass
+    history.setdefault("rounds", {})[str(round_no)] = results
+    with open(hist_path, "w", encoding="utf-8") as f:
+        json.dump(history, f, indent=2)
+
+    prev = None
+    for r in sorted((int(k) for k in history["rounds"]), reverse=True):
+        if r < round_no:
+            prev = history["rounds"][str(r)]
+            break
+
+    lines = [
+        "# PERF — BASELINE config matrix (metric 1)",
+        "",
+        "Create-to-Ready wall-clock per BASELINE.json config, recorded by",
+        "`python perf_matrix.py` (simulation executor + PATH-shimmed",
+        "terraform subprocess: measures the PLATFORM's orchestration cost —",
+        "provision, phase engine, smoke gate — with no SSH/package time, so",
+        "rounds are comparable as a control-plane regression trace).",
+        "`phases_s` is the phase-span portion from the cluster's /trace.",
+        "",
+        f"## round {round_no}",
+        "",
+        "| config | wall-clock (s) | phases (s) | phases | smoke chips |"
+        " prev round (s) | delta |",
+        "|---|---|---|---|---|---|---|",
+    ]
+    for name in CONFIG_NAMES:
+        m = results.get(name)
+        if m is None:
+            lines.append(f"| {name} | — | — | — | — | — | — |")
+            continue
+        prev_wall = (prev or {}).get(name, {}).get("wall_s")
+        if prev_wall:
+            delta = f"{(m['wall_s'] - prev_wall) / prev_wall * 100:+.1f}%"
+            prev_txt = f"{prev_wall:.3f}"
+        else:
+            delta, prev_txt = "n/a", "n/a"
+        chips = m["smoke_chips"] if m["smoke_chips"] else "—"
+        lines.append(
+            f"| {name} | {m['wall_s']:.3f} | {m['phases_s']:.3f} | "
+            f"{m['phases']} | {chips} | {prev_txt} | {delta} |"
+        )
+    lines += [
+        "",
+        "History (all rounds) lives in `PERF.json`; CI drives the same five",
+        "configs in `tests/test_baseline_matrix.py` so no BASELINE config",
+        "can regress to never-executed again.",
+        "",
+    ]
+    with open(os.path.join(REPO_ROOT, "PERF.md"), "w", encoding="utf-8") as f:
+        f.write("\n".join(lines))
+
+
+def main() -> int:
+    results = run_matrix()
+    round_no = current_round()
+    write_artifacts(results, round_no)
+    print(json.dumps({"round": round_no, "results": results}, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -7,6 +7,14 @@ test process, hence module scope here.
 """
 
 import os
+import sys
+
+# repo root on sys.path regardless of invocation style: a plain `pytest
+# tests/` (no `python -m`) must still import root-level driver modules
+# (perf_matrix) and the package itself
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
 
 _flag = "--xla_force_host_platform_device_count=8"
 if _flag not in os.environ.get("XLA_FLAGS", ""):

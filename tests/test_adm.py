@@ -289,9 +289,15 @@ class TestRestoreVerifyGate:
         with pytest.raises(PhaseError, match="apiserver reports"):
             self._run([_rv_line(k8s="v1.29.10")])
 
-    def test_node_count_mismatch_fails(self):
-        with pytest.raises(PhaseError, match="sees 2 nodes, cluster has 3"):
-            self._run([_rv_line(n=2)])
+    def test_backup_time_topology_is_tolerated_but_zero_nodes_fails(self):
+        """An etcd restore legitimately reverts Node objects to backup-time
+        topology (and kubelets may still be re-registering), so a count
+        mismatch vs current records passes — but an apiserver serving ZERO
+        nodes is a failed restore, whatever the playbook rc said."""
+        ctx = self._run([_rv_line(n=2)])   # backup taken pre-scale-up
+        assert ctx.cluster.status.condition("restore-verify").status == "OK"
+        with pytest.raises(PhaseError, match="serves no nodes"):
+            self._run([_rv_line(n=0)])
 
     def test_unhealthy_etcd_flag_fails(self):
         with pytest.raises(PhaseError, match="etcd_healthy=false"):
@@ -371,6 +377,19 @@ class TestMarkerCallbackEscaping:
         assert parse_marker_json(
             marker, self._escape_like_default_callback(raw)
         ) == payload
+
+    def test_later_mention_of_marker_does_not_shadow_attestation(self):
+        """Only whitespace may separate marker and payload brace: a later
+        diagnostic line that merely MENTIONS the marker (with junk before
+        its first '{') must not shadow the genuine attestation in the
+        reversed-line scan."""
+        from kubeoperator_tpu.adm.phases import parse_marker_json
+
+        got = parse_marker_json("KO_TPU_SMOKE_RESULT", [
+            'KO_TPU_SMOKE_RESULT {"gbps": 84.3, "chips": 16}',
+            'diag: KO_TPU_SMOKE_RESULT emitted, ctx: {"phase": "smoke"}',
+        ])
+        assert got == {"gbps": 84.3, "chips": 16}
 
     def test_train_result_embedded_in_smoke_survives(self):
         """The train gate's numbers ride inside the smoke payload
