@@ -1,0 +1,1439 @@
+"""Tree-walking interpreter for the JS subset ``ui/transpile.py`` emits.
+
+VERDICT r4 #3: no JS engine exists in the build environment, so until now
+the generated ``/ui/logic.js`` had never been parsed or executed with real
+JS semantics — a transpiler bug producing valid-but-different JS (number
+formatting, truthiness, sort order, string coercion) would ship green
+because the Python twin (``ui/jsrt.py``) was the only runtime the "JS"
+ever had. This module executes the ENTIRE generated file — including the
+hand-written ``_rt`` prelude — with JS semantics implemented from the
+spec where they differ from Python:
+
+  * every number is a double; ``String(5.0)`` is ``"5"``, not ``"5.0"``
+  * ``===`` is strict (bool is not number, objects compare by identity)
+  * truthiness: ``[]`` and ``{}`` are truthy, ``""``/``0``/``NaN`` falsy
+  * ``+`` concatenates when either primitive operand is a string
+  * ``Array.prototype.sort()`` is lexicographic on ToString
+  * ``undefined`` is distinct from ``null``; missing properties read as
+    ``undefined``
+
+The grammar is STRICT: any construct outside what the transpiler (or its
+fixed prelude) emits raises ``JSInterpError`` instead of guessing — the
+interpreter must never silently mis-execute the file it exists to gate.
+``tests/test_ui_js_execution.py`` replays the whole ``test_ui_logic``
+parity grid through this interpreter differentially against the Python
+originals.
+"""
+
+from __future__ import annotations
+
+import math
+import re as _re
+
+
+class JSInterpError(Exception):
+    """Parse-time or unsupported-construct failure (a CI gate trip)."""
+
+
+class JSThrow(Exception):
+    """A JS `throw` in flight."""
+
+    def __init__(self, value):
+        self.value = value
+        super().__init__(to_string(value))
+
+
+class _Undefined:
+    _instance = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+        return cls._instance
+
+    def __repr__(self):
+        return "undefined"
+
+
+UNDEFINED = _Undefined()
+
+
+class JSFunction:
+    def __init__(self, name, params, body, env):
+        self.name = name or "(anonymous)"
+        self.params = params
+        self.body = body
+        self.env = env
+
+
+class JSRegex:
+    def __init__(self, pattern: str, flags: str):
+        if flags:
+            raise JSInterpError(f"regex flags unsupported: /{pattern}/{flags}")
+        self.pattern = pattern
+        self.rx = _re.compile(pattern)
+
+
+class JSError:
+    """A constructed Error/TypeError value."""
+
+    def __init__(self, kind: str, message: str):
+        self.kind = kind
+        self.message = message
+
+    def __repr__(self):
+        return f"{self.kind}: {self.message}"
+
+
+# ------------------------------------------------------------- semantics ----
+def js_typeof(v) -> str:
+    if v is UNDEFINED:
+        return "undefined"
+    if isinstance(v, bool):
+        return "boolean"
+    if isinstance(v, (int, float)):
+        return "number"
+    if isinstance(v, str):
+        return "string"
+    if isinstance(v, (JSFunction,)) or callable(v):
+        return "function"
+    return "object"  # null, arrays, dicts, regex, errors
+
+
+def truthy(v) -> bool:
+    if v is UNDEFINED or v is None:
+        return False
+    if isinstance(v, bool):
+        return v
+    if isinstance(v, (int, float)):
+        return v != 0 and not math.isnan(v)
+    if isinstance(v, str):
+        return v != ""
+    return True  # arrays, objects, functions — [] and {} are truthy in JS
+
+
+def num_to_string(v: float) -> str:
+    """The ECMAScript Number::toString(10) algorithm: shortest digits via
+    repr (Python and JS both use shortest-round-trip), then the spec's
+    form selection — decimal for 1e-6 <= |x| < 1e21, exponential outside,
+    with unpadded exponents (`1e-7`, not `1e-07`)."""
+    if isinstance(v, bool):  # guard: bools are not numbers here
+        raise JSInterpError("num_to_string on bool")
+    v = float(v)
+    if math.isnan(v):
+        return "NaN"
+    if v == math.inf:
+        return "Infinity"
+    if v == -math.inf:
+        return "-Infinity"
+    if v == 0:
+        return "0"  # covers -0 like JS String(-0)
+    sign = "-" if v < 0 else ""
+    r = repr(abs(v))
+    mant, _, e = r.partition("e")
+    exp10 = int(e) if e else 0
+    ip, _, fp = mant.partition(".")
+    all_digits = ip + fp
+    point = len(ip) + exp10          # value = 0.<digits> * 10^point
+    stripped = all_digits.lstrip("0")
+    point -= len(all_digits) - len(stripped)
+    digits = stripped.rstrip("0")
+    k, n = len(digits), point
+    if 0 < n <= 21:
+        if k <= n:
+            return sign + digits + "0" * (n - k)
+        return sign + digits[:n] + "." + digits[n:]
+    if -6 < n <= 0:
+        return sign + "0." + "0" * (-n) + digits
+    exp = n - 1
+    m = digits[0] + ("." + digits[1:] if k > 1 else "")
+    return f"{sign}{m}e{'+' if exp >= 0 else '-'}{abs(exp)}"
+
+
+def to_string(v) -> str:
+    if v is UNDEFINED:
+        return "undefined"
+    if v is None:
+        return "null"
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, (int, float)):
+        return num_to_string(float(v))
+    if isinstance(v, str):
+        return v
+    if isinstance(v, list):  # Array.prototype.toString == join(",")
+        return ",".join(
+            "" if e is None or e is UNDEFINED else to_string(e) for e in v
+        )
+    if isinstance(v, dict):
+        return "[object Object]"
+    if isinstance(v, JSError):
+        return f"{v.kind}: {v.message}"
+    if isinstance(v, JSFunction) or callable(v):
+        return f"function {getattr(v, 'name', '')}() {{ [native] }}"
+    raise JSInterpError(f"ToString on {type(v).__name__}")
+
+
+def to_number(v) -> float:
+    if isinstance(v, bool):
+        return 1.0 if v else 0.0
+    if isinstance(v, (int, float)):
+        return float(v)
+    if v is None:
+        return 0.0
+    if v is UNDEFINED:
+        return math.nan
+    if isinstance(v, str):
+        t = v.strip()
+        if t == "":
+            return 0.0
+        try:
+            return float(t)
+        except ValueError:
+            return math.nan
+    return math.nan  # objects (no valueOf support needed)
+
+
+def to_primitive(v):
+    if isinstance(v, (list, dict)):
+        return to_string(v)
+    return v
+
+
+def strict_eq(a, b) -> bool:
+    if a is UNDEFINED or b is UNDEFINED:
+        return a is b
+    if a is None or b is None:
+        return a is b
+    a_bool, b_bool = isinstance(a, bool), isinstance(b, bool)
+    if a_bool != b_bool:
+        return False
+    if a_bool:
+        return a == b
+    a_num = isinstance(a, (int, float))
+    b_num = isinstance(b, (int, float))
+    if a_num != b_num:
+        return False
+    if a_num:
+        return float(a) == float(b)  # NaN != NaN falls out naturally
+    if isinstance(a, str) and isinstance(b, str):
+        return a == b
+    return a is b  # objects/arrays/functions: reference identity
+
+
+def js_add(a, b):
+    pa, pb = to_primitive(a), to_primitive(b)
+    if isinstance(pa, str) or isinstance(pb, str):
+        return to_string(pa) + to_string(pb)
+    return to_number(pa) + to_number(pb)
+
+
+def js_div(x: float, y: float) -> float:
+    """JS `/`: 0/0 and NaN/0 are NaN, x/0 is signed Infinity — shared by
+    the binary operator AND `/=` so neither path can raise
+    ZeroDivisionError."""
+    if y == 0:
+        if x == 0 or math.isnan(x):
+            return math.nan
+        return math.copysign(math.inf, x) * math.copysign(1, y)
+    return x / y
+
+
+def js_arith(op: str, a, b):
+    """Numeric `-`/`*`/`/` (and their compound forms) under JS coercion."""
+    x, y = to_number(a), to_number(b)
+    if op == "-":
+        return x - y
+    if op == "*":
+        return x * y
+    if op == "/":
+        return js_div(x, y)
+    raise JSInterpError(f"unknown arithmetic op {op}")
+
+
+def js_compare(op: str, a, b):
+    pa, pb = to_primitive(a), to_primitive(b)
+    if isinstance(pa, str) and isinstance(pb, str):
+        pass  # lexicographic
+    else:
+        pa, pb = to_number(pa), to_number(pb)
+        if math.isnan(pa) or math.isnan(pb):
+            return False
+    if op == "<":
+        return pa < pb
+    if op == "<=":
+        return pa <= pb
+    if op == ">":
+        return pa > pb
+    return pa >= pb
+
+
+# ------------------------------------------------------------- tokenizer ----
+_PUNCT = [
+    "===", "!==", "<=", ">=", "&&", "||", "++", "+=", "-=", "*=", "/=",
+    "{", "}", "(", ")", "[", "]", ";", ",", ":", "?", ".", "<", ">",
+    "=", "+", "-", "*", "/", "!",
+]
+
+_KEYWORDS = {
+    "function", "return", "if", "else", "for", "while", "break", "continue",
+    "let", "const", "var", "new", "throw", "typeof", "of", "true", "false",
+    "null", "undefined",
+}
+
+_ID_RE = _re.compile(r"[A-Za-z_$][A-Za-z0-9_$]*")
+_NUM_RE = _re.compile(r"(?:[0-9]+\.[0-9]*|\.[0-9]+|[0-9]+)(?:[eE][+-]?[0-9]+)?")
+
+
+class Tok:
+    __slots__ = ("kind", "value", "pos")
+
+    def __init__(self, kind, value, pos):
+        self.kind = kind      # id | kw | num | str | template | regex | punct | eof
+        self.value = value
+        self.pos = pos
+
+    def __repr__(self):
+        return f"{self.kind}:{self.value!r}"
+
+
+def _lex_string(src: str, i: int, quote: str) -> tuple[str, int]:
+    out = []
+    i += 1
+    while i < len(src):
+        c = src[i]
+        if c == "\\":
+            n = src[i + 1]
+            mapping = {"n": "\n", "t": "\t", "r": "\r", "\\": "\\",
+                       "'": "'", '"': '"', "`": "`", "$": "$", "0": "\0",
+                       "/": "/"}
+            if n == "u":
+                out.append(chr(int(src[i + 2:i + 6], 16)))
+                i += 6
+                continue
+            if n not in mapping:
+                raise JSInterpError(f"unsupported escape \\{n}")
+            out.append(mapping[n])
+            i += 2
+            continue
+        if c == quote:
+            return "".join(out), i + 1
+        if c == "\n" and quote != "`":
+            raise JSInterpError("newline in string literal")
+        out.append(c)
+        i += 1
+    raise JSInterpError("unterminated string")
+
+
+def _lex_template(src: str, i: int) -> tuple[list, int]:
+    """Returns template parts: list of ('str', s) / ('expr', source)."""
+    parts = []
+    buf = []
+    i += 1
+    while i < len(src):
+        c = src[i]
+        if c == "\\":
+            n = src[i + 1]
+            mapping = {"n": "\n", "t": "\t", "r": "\r", "\\": "\\",
+                       "`": "`", "$": "$", "'": "'", '"': '"'}
+            if n not in mapping:
+                raise JSInterpError(f"unsupported template escape \\{n}")
+            buf.append(mapping[n])
+            i += 2
+            continue
+        if c == "`":
+            if buf:
+                parts.append(("str", "".join(buf)))
+            return parts, i + 1
+        if c == "$" and i + 1 < len(src) and src[i + 1] == "{":
+            if buf:
+                parts.append(("str", "".join(buf)))
+                buf = []
+            depth = 1
+            j = i + 2
+            start = j
+            while j < len(src) and depth:
+                ch = src[j]
+                if ch == "{":
+                    depth += 1
+                elif ch == "}":
+                    depth -= 1
+                elif ch == "`":  # nested template literal
+                    _, j = _lex_template(src, j)
+                    continue
+                elif ch in "\"'":
+                    _, j = _lex_string(src, j, ch)
+                    continue
+                j += 1
+            if depth:
+                raise JSInterpError("unterminated ${} in template")
+            parts.append(("expr", src[start:j - 1]))
+            i = j
+            continue
+        buf.append(c)
+        i += 1
+    raise JSInterpError("unterminated template literal")
+
+
+def tokenize(src: str) -> list[Tok]:
+    toks: list[Tok] = []
+    i = 0
+    n = len(src)
+
+    def prev_is_operand() -> bool:
+        if not toks:
+            return False
+        t = toks[-1]
+        if t.kind in ("id", "num", "str", "template", "regex"):
+            return True
+        if t.kind == "kw":  # literal keywords end an operand; others don't
+            return t.value in ("true", "false", "null", "undefined")
+        return t.kind == "punct" and t.value in (")", "]")
+
+    while i < n:
+        c = src[i]
+        if c in " \t\r\n":
+            i += 1
+            continue
+        if src.startswith("//", i):
+            j = src.find("\n", i)
+            i = n if j == -1 else j
+            continue
+        if src.startswith("/*", i):
+            j = src.find("*/", i)
+            if j == -1:
+                raise JSInterpError("unterminated block comment")
+            i = j + 2
+            continue
+        if c in "\"'":
+            s, i2 = _lex_string(src, i, c)
+            toks.append(Tok("str", s, i))
+            i = i2
+            continue
+        if c == "`":
+            parts, i2 = _lex_template(src, i)
+            toks.append(Tok("template", parts, i))
+            i = i2
+            continue
+        if c == "/" and not prev_is_operand():
+            # regex literal
+            j = i + 1
+            buf = []
+            in_class = False
+            while j < n:
+                ch = src[j]
+                if ch == "\\":
+                    buf.append(src[j:j + 2])
+                    j += 2
+                    continue
+                if ch == "[":
+                    in_class = True
+                elif ch == "]":
+                    in_class = False
+                elif ch == "/" and not in_class:
+                    break
+                buf.append(ch)
+                j += 1
+            if j >= n:
+                raise JSInterpError("unterminated regex literal")
+            j += 1
+            fm = _ID_RE.match(src, j)
+            flags = fm.group(0) if fm else ""
+            toks.append(Tok("regex", ("".join(buf), flags), i))
+            i = j + len(flags)
+            continue
+        m = _NUM_RE.match(src, i)
+        if m and (c.isdigit() or (c == "." and i + 1 < n and src[i + 1].isdigit())):
+            toks.append(Tok("num", float(m.group(0)), i))
+            i = m.end()
+            continue
+        m = _ID_RE.match(src, i)
+        if m:
+            word = m.group(0)
+            toks.append(Tok("kw" if word in _KEYWORDS else "id", word, i))
+            i = m.end()
+            continue
+        for p in _PUNCT:
+            if src.startswith(p, i):
+                if p == "=" and src.startswith("==", i):
+                    raise JSInterpError("loose == is not in the subset")
+                toks.append(Tok("punct", p, i))
+                i += len(p)
+                break
+        else:
+            raise JSInterpError(f"unexpected character {c!r} at {i}")
+    toks.append(Tok("eof", None, n))
+    return toks
+
+
+# ---------------------------------------------------------------- parser ----
+# AST nodes are tuples: (tag, ...). Kept flat for a small walker.
+class Parser:
+    def __init__(self, toks: list[Tok]):
+        self.toks = toks
+        self.i = 0
+
+    def peek(self, k=0) -> Tok:
+        return self.toks[min(self.i + k, len(self.toks) - 1)]
+
+    def next(self) -> Tok:
+        t = self.toks[self.i]
+        self.i += 1
+        return t
+
+    def eat(self, kind, value=None) -> Tok:
+        t = self.next()
+        if t.kind != kind or (value is not None and t.value != value):
+            raise JSInterpError(
+                f"expected {kind} {value!r}, got {t.kind} {t.value!r} "
+                f"at pos {t.pos}"
+            )
+        return t
+
+    def at(self, kind, value=None) -> bool:
+        t = self.peek()
+        return t.kind == kind and (value is None or t.value == value)
+
+    # ---- program / statements ----
+    def parse_program(self) -> list:
+        stmts = []
+        if self.at("str", "use strict"):
+            self.next()
+            if self.at("punct", ";"):
+                self.next()
+        while not self.at("eof"):
+            stmts.append(self.statement())
+        return stmts
+
+    def statement(self):
+        t = self.peek()
+        if t.kind == "kw":
+            if t.value == "function":
+                return self.function_decl()
+            if t.value in ("let", "const", "var"):
+                return self.var_decl()
+            if t.value == "return":
+                self.next()
+                if self.at("punct", ";"):
+                    self.next()
+                    return ("return", None)
+                e = self.expression()
+                self.semi()
+                return ("return", e)
+            if t.value == "if":
+                return self.if_stmt()
+            if t.value == "while":
+                self.next()
+                self.eat("punct", "(")
+                test = self.expression()
+                self.eat("punct", ")")
+                body = self.block()
+                return ("while", test, body)
+            if t.value == "for":
+                return self.for_stmt()
+            if t.value == "break":
+                self.next()
+                self.semi()
+                return ("break",)
+            if t.value == "continue":
+                self.next()
+                self.semi()
+                return ("continue",)
+            if t.value == "throw":
+                self.next()
+                e = self.expression()
+                self.semi()
+                return ("throw", e)
+        e = self.expression()
+        self.semi()
+        return ("expr", e)
+
+    def semi(self):
+        if self.at("punct", ";"):
+            self.next()
+        # tolerate ASI at block close / eof
+        elif not (self.at("punct", "}") or self.at("eof")):
+            t = self.peek()
+            raise JSInterpError(f"missing ; before {t.kind} {t.value!r}")
+
+    def block(self) -> list:
+        self.eat("punct", "{")
+        out = []
+        while not self.at("punct", "}"):
+            out.append(self.statement())
+        self.next()
+        return out
+
+    def body_or_block(self) -> list:
+        """`{ ... }` or a single braceless statement (the prelude's
+        `if (x) return y;` style)."""
+        if self.at("punct", "{"):
+            return self.block()
+        return [self.statement()]
+
+    def function_decl(self):
+        self.eat("kw", "function")
+        name = self.eat("id").value
+        params, body = self._function_rest()
+        return ("funcdecl", name, params, body)
+
+    def _function_rest(self):
+        self.eat("punct", "(")
+        params = []
+        while not self.at("punct", ")"):
+            params.append(self.eat("id").value)
+            if self.at("punct", ","):
+                self.next()
+        self.next()
+        body = self.block()
+        return params, body
+
+    def var_decl(self):
+        kind = self.next().value
+        decls = []
+        while True:
+            name = self.eat("id").value
+            init = None
+            if self.at("punct", "="):
+                self.next()
+                init = self.assignment_expr()
+            decls.append((name, init))
+            if self.at("punct", ","):
+                self.next()
+                continue
+            break
+        self.semi()
+        return ("vardecl", kind, decls)
+
+    def if_stmt(self):
+        self.eat("kw", "if")
+        self.eat("punct", "(")
+        test = self.expression()
+        self.eat("punct", ")")
+        body = self.body_or_block()
+        orelse = []
+        if self.at("kw", "else"):
+            self.next()
+            if self.at("kw", "if"):
+                orelse = [self.if_stmt()]
+            else:
+                orelse = self.body_or_block()
+        return ("if", test, body, orelse)
+
+    def for_stmt(self):
+        self.eat("kw", "for")
+        self.eat("punct", "(")
+        # for (x of expr)  |  for (init; test; update)
+        if self.peek().kind == "id" and self.peek(1).kind == "kw" \
+                and self.peek(1).value == "of":
+            var = self.next().value
+            self.next()
+            it = self.expression()
+            self.eat("punct", ")")
+            return ("forof", var, it, self.body_or_block())
+        init = None
+        if not self.at("punct", ";"):
+            init = ("expr", self.expression())
+        self.eat("punct", ";")
+        test = None if self.at("punct", ";") else self.expression()
+        self.eat("punct", ";")
+        update = None if self.at("punct", ")") else self.expression()
+        self.eat("punct", ")")
+        return ("for", init, test, update, self.body_or_block())
+
+    # ---- expressions (precedence climbing) ----
+    def expression(self):
+        return self.assignment_expr()
+
+    def assignment_expr(self):
+        left = self.conditional()
+        t = self.peek()
+        if t.kind == "punct" and t.value in ("=", "+=", "-=", "*=", "/="):
+            self.next()
+            right = self.assignment_expr()
+            if left[0] not in ("name", "member", "index"):
+                raise JSInterpError("invalid assignment target")
+            return ("assign", t.value, left, right)
+        return left
+
+    def conditional(self):
+        cond = self.logical_or()
+        if self.at("punct", "?"):
+            self.next()
+            a = self.assignment_expr()
+            self.eat("punct", ":")
+            b = self.assignment_expr()
+            return ("cond", cond, a, b)
+        return cond
+
+    def logical_or(self):
+        left = self.logical_and()
+        while self.at("punct", "||"):
+            self.next()
+            left = ("or", left, self.logical_and())
+        return left
+
+    def logical_and(self):
+        left = self.equality()
+        while self.at("punct", "&&"):
+            self.next()
+            left = ("and", left, self.equality())
+        return left
+
+    def equality(self):
+        left = self.relational()
+        while self.peek().kind == "punct" and self.peek().value in ("===", "!=="):
+            op = self.next().value
+            left = ("eq", op, left, self.relational())
+        return left
+
+    def relational(self):
+        left = self.additive()
+        while self.peek().kind == "punct" and \
+                self.peek().value in ("<", "<=", ">", ">="):
+            op = self.next().value
+            left = ("rel", op, left, self.additive())
+        return left
+
+    def additive(self):
+        left = self.multiplicative()
+        while self.peek().kind == "punct" and self.peek().value in ("+", "-"):
+            op = self.next().value
+            left = ("bin", op, left, self.multiplicative())
+        return left
+
+    def multiplicative(self):
+        left = self.unary()
+        while self.peek().kind == "punct" and self.peek().value in ("*", "/"):
+            op = self.next().value
+            left = ("bin", op, left, self.unary())
+        return left
+
+    def unary(self):
+        t = self.peek()
+        if t.kind == "punct" and t.value == "!":
+            self.next()
+            return ("not", self.unary())
+        if t.kind == "punct" and t.value == "-":
+            self.next()
+            return ("neg", self.unary())
+        if t.kind == "kw" and t.value == "typeof":
+            self.next()
+            return ("typeof", self.unary())
+        if t.kind == "kw" and t.value == "new":
+            self.next()
+            callee = self.postfix(no_call=True)
+            self.eat("punct", "(")
+            args = self.arg_list()
+            return ("new", callee, args)
+        return self.postfix()
+
+    def arg_list(self):
+        args = []
+        while not self.at("punct", ")"):
+            args.append(self.assignment_expr())
+            if self.at("punct", ","):
+                self.next()
+        self.next()
+        return args
+
+    def postfix(self, no_call=False):
+        e = self.primary()
+        while True:
+            if self.at("punct", "."):
+                self.next()
+                name = self.next()
+                if name.kind not in ("id", "kw"):
+                    raise JSInterpError(f"bad property {name.value!r}")
+                e = ("member", e, name.value)
+                continue
+            if self.at("punct", "["):
+                self.next()
+                idx = self.expression()
+                self.eat("punct", "]")
+                e = ("index", e, idx)
+                continue
+            if self.at("punct", "(") and not no_call:
+                self.next()
+                e = ("call", e, self.arg_list())
+                continue
+            if self.at("punct", "++"):
+                self.next()
+                e = ("postinc", e)
+                continue
+            return e
+
+    def primary(self):
+        t = self.next()
+        if t.kind == "num":
+            return ("num", t.value)
+        if t.kind == "str":
+            return ("str", t.value)
+        if t.kind == "template":
+            parts = []
+            for kind, payload in t.value:
+                if kind == "str":
+                    parts.append(("str", payload))
+                else:
+                    sub = Parser(tokenize(payload))
+                    expr = sub.expression()
+                    if not sub.at("eof"):
+                        raise JSInterpError("junk after ${} expression")
+                    parts.append(("expr", expr))
+            return ("template", parts)
+        if t.kind == "regex":
+            return ("regex", t.value[0], t.value[1])
+        if t.kind == "id":
+            return ("name", t.value)
+        if t.kind == "kw":
+            if t.value == "true":
+                return ("bool", True)
+            if t.value == "false":
+                return ("bool", False)
+            if t.value == "null":
+                return ("null",)
+            if t.value == "undefined":
+                return ("undef",)
+            if t.value == "function":
+                name = None
+                if self.peek().kind == "id":
+                    name = self.next().value
+                params, body = self._function_rest()
+                return ("funcexpr", name, params, body)
+        if t.kind == "punct":
+            if t.value == "(":
+                e = self.expression()
+                self.eat("punct", ")")
+                return e
+            if t.value == "[":
+                elts = []
+                while not self.at("punct", "]"):
+                    elts.append(self.assignment_expr())
+                    if self.at("punct", ","):
+                        self.next()
+                self.next()
+                return ("array", elts)
+            if t.value == "{":
+                pairs = []
+                while not self.at("punct", "}"):
+                    k = self.next()
+                    if k.kind not in ("id", "str", "kw"):
+                        raise JSInterpError(f"bad object key {k.value!r}")
+                    self.eat("punct", ":")
+                    pairs.append((k.value, self.assignment_expr()))
+                    if self.at("punct", ","):
+                        self.next()
+                self.next()
+                return ("object", pairs)
+        raise JSInterpError(f"unexpected token {t.kind} {t.value!r} at {t.pos}")
+
+
+# ----------------------------------------------------------- environment ----
+class Env:
+    __slots__ = ("vars", "parent")
+
+    def __init__(self, parent=None):
+        self.vars: dict = {}
+        self.parent = parent
+
+    def lookup(self, name: str):
+        env = self
+        while env is not None:
+            if name in env.vars:
+                return env.vars[name]
+            env = env.parent
+        raise JSInterpError(f"undeclared variable {name}")
+
+    def has(self, name: str) -> bool:
+        env = self
+        while env is not None:
+            if name in env.vars:
+                return True
+            env = env.parent
+        return False
+
+    def assign(self, name: str, value):
+        env = self
+        while env is not None:
+            if name in env.vars:
+                env.vars[name] = value
+                return
+            env = env.parent
+        raise JSInterpError(f"assignment to undeclared {name}")
+
+    def declare(self, name: str, value):
+        self.vars[name] = value
+
+
+class _Return(Exception):
+    def __init__(self, value):
+        self.value = value
+
+
+class _Break(Exception):
+    pass
+
+
+class _Continue(Exception):
+    pass
+
+
+# ------------------------------------------------------------ interpreter ----
+class Interpreter:
+    def __init__(self):
+        self.globals = Env()
+        self._install_builtins()
+
+    # ---- builtin host objects (exactly what the emitted subset touches) ----
+    def _install_builtins(self):
+        g = self.globals
+
+        def native(fn):
+            fn.js_native = True
+            return fn
+
+        class _HasOwn:
+            name = "hasOwnProperty"
+
+            @staticmethod
+            def call(o, k):
+                key = to_string(k) if not isinstance(k, str) else k
+                if isinstance(o, dict):
+                    return key in o
+                if isinstance(o, list):
+                    if key == "length":
+                        return True
+                    try:
+                        idx = int(key)
+                    except ValueError:
+                        return False
+                    return 0 <= idx < len(o)
+                if isinstance(o, str):
+                    # JS boxes the primitive: own props are indices + length
+                    if key == "length":
+                        return True
+                    try:
+                        idx = int(key)
+                    except ValueError:
+                        return False
+                    return 0 <= idx < len(o)
+                if isinstance(o, (bool, int, float)):
+                    return False  # boxed Number/Boolean: no own properties
+                raise JSThrow(JSError(
+                    "TypeError", "hasOwnProperty.call on non-object"))
+
+        hasown = _HasOwn()
+
+        g.declare("Object", {
+            "prototype": {"hasOwnProperty": hasown},
+            "keys": native(lambda o: list(o.keys()) if isinstance(o, dict)
+                           else [num_to_string(float(i))
+                                 for i in range(len(o))]
+                           if isinstance(o, list)
+                           else self._type_error("Object.keys on non-object")),
+        })
+        g.declare("Array", {
+            "isArray": native(lambda x: isinstance(x, list)),
+        })
+        def _floor(x):
+            v = to_number(x)
+            if math.isnan(v) or math.isinf(v):
+                return v  # JS Math.floor passes NaN/±Infinity through
+            return float(math.floor(v))
+
+        def _minmax(py_fn, empty):
+            def fn(*a):
+                vals = [to_number(x) for x in a]
+                if any(math.isnan(v) for v in vals):
+                    return math.nan  # JS propagates NaN; Python would not
+                return py_fn(vals, default=empty)
+            return native(fn)
+
+        g.declare("Math", {
+            "floor": native(_floor),
+            "abs": native(lambda x: abs(to_number(x))),
+            "min": _minmax(min, math.inf),
+            "max": _minmax(max, -math.inf),
+        })
+        class _Callable:
+            """A native that is both callable (Number(x), String(x)) and
+            carries static properties (Number.isInteger) — like the real
+            constructor objects."""
+
+            def __init__(self, name, fn, props=None):
+                self.name = name
+                self._fn = fn
+                self.props = props or {}
+
+            def __call__(self, *args):
+                return self._fn(*args)
+
+        # *args (not default params): String(undefined) is "undefined" and
+        # Number(undefined) is NaN — only the ZERO-arg calls yield ""/0
+        g.declare("Number", _Callable(
+            "Number",
+            lambda *a: 0.0 if not a else to_number(a[0]),
+            {"isInteger": native(
+                lambda x: isinstance(x, (int, float))
+                and not isinstance(x, bool)
+                and not math.isnan(x) and not math.isinf(x)
+                and float(x).is_integer()
+            )},
+        ))
+        g.declare("String", _Callable(
+            "String",
+            lambda *a: "" if not a else to_string(a[0]),
+        ))
+        g.declare("parseInt", native(self._parse_int))
+        g.declare("TypeError", "TypeError")   # constructor tag for `new`
+        g.declare("Error", "Error")
+        g.declare("globalThis", {})
+        # note: `window` stays undeclared — `typeof window` must yield
+        # "undefined" exactly like a non-browser JS runtime
+
+    @staticmethod
+    def _type_error(msg):
+        raise JSThrow(JSError("TypeError", msg))
+
+    @staticmethod
+    def _parse_int(s=UNDEFINED, radix=UNDEFINED):
+        t = to_string(s).strip()
+        r = 10 if radix is UNDEFINED else int(to_number(radix))
+        if r != 10:
+            raise JSInterpError("parseInt radix != 10 unsupported")
+        m = _re.match(r"[+-]?[0-9]+", t)
+        if not m:
+            return math.nan
+        return float(int(m.group(0)))
+
+    # ---- program ----
+    def run(self, source: str) -> Env:
+        program = Parser(tokenize(source)).parse_program()
+        # hoist function declarations (the emitted file calls helpers that
+        # may be declared later in the file)
+        for node in program:
+            if node[0] == "funcdecl":
+                _, name, params, body = node
+                self.globals.declare(
+                    name, JSFunction(name, params, body, self.globals))
+        for node in program:
+            if node[0] != "funcdecl":
+                self.exec_stmt(node, self.globals)
+        return self.globals
+
+    # ---- statements ----
+    def exec_block(self, stmts, env):
+        for s in stmts:
+            self.exec_stmt(s, env)
+
+    def exec_stmt(self, node, env):
+        tag = node[0]
+        if tag == "expr":
+            self.eval(node[1], env)
+        elif tag == "vardecl":
+            for name, init in node[2]:
+                env.declare(
+                    name, UNDEFINED if init is None else self.eval(init, env))
+        elif tag == "return":
+            raise _Return(
+                UNDEFINED if node[1] is None else self.eval(node[1], env))
+        elif tag == "if":
+            _, test, body, orelse = node
+            if truthy(self.eval(test, env)):
+                self.exec_block(body, env)
+            else:
+                self.exec_block(orelse, env)
+        elif tag == "while":
+            _, test, body = node
+            while truthy(self.eval(test, env)):
+                try:
+                    self.exec_block(body, env)
+                except _Break:
+                    break
+                except _Continue:
+                    continue
+        elif tag == "for":
+            _, init, test, update, body = node
+            if init is not None:
+                self.exec_stmt(init, env)
+            while test is None or truthy(self.eval(test, env)):
+                try:
+                    self.exec_block(body, env)
+                except _Break:
+                    break
+                except _Continue:
+                    pass
+                if update is not None:
+                    self.eval(update, env)
+        elif tag == "forof":
+            _, var, it, body = node
+            seq = self.eval(it, env)
+            if isinstance(seq, str):
+                items = list(seq)
+            elif isinstance(seq, list):
+                items = list(seq)
+            else:
+                raise JSThrow(JSError(
+                    "TypeError", f"{js_typeof(seq)} is not iterable"))
+            for item in items:
+                if env.has(var):
+                    env.assign(var, item)
+                else:
+                    env.declare(var, item)
+                try:
+                    self.exec_block(body, env)
+                except _Break:
+                    break
+                except _Continue:
+                    continue
+        elif tag == "funcdecl":
+            _, name, params, body = node
+            env.declare(name, JSFunction(name, params, body, env))
+        elif tag == "break":
+            raise _Break()
+        elif tag == "continue":
+            raise _Continue()
+        elif tag == "throw":
+            raise JSThrow(self.eval(node[1], env))
+        else:
+            raise JSInterpError(f"unknown statement {tag}")
+
+    # ---- expressions ----
+    def eval(self, node, env):
+        tag = node[0]
+        if tag == "num":
+            return node[1]
+        if tag == "str":
+            return node[1]
+        if tag == "bool":
+            return node[1]
+        if tag == "null":
+            return None
+        if tag == "undef":
+            return UNDEFINED
+        if tag == "name":
+            return env.lookup(node[1])
+        if tag == "array":
+            return [self.eval(e, env) for e in node[1]]
+        if tag == "object":
+            return {k: self.eval(v, env) for k, v in node[1]}
+        if tag == "template":
+            out = []
+            for kind, payload in node[1]:
+                if kind == "str":
+                    out.append(payload)
+                else:
+                    out.append(to_string(self.eval(payload, env)))
+            return "".join(out)
+        if tag == "regex":
+            return JSRegex(node[1], node[2])
+        if tag == "funcexpr":
+            return JSFunction(node[1], node[2], node[3], env)
+        if tag == "cond":
+            return (self.eval(node[2], env) if truthy(self.eval(node[1], env))
+                    else self.eval(node[3], env))
+        if tag == "and":
+            left = self.eval(node[1], env)
+            return self.eval(node[2], env) if truthy(left) else left
+        if tag == "or":
+            left = self.eval(node[1], env)
+            return left if truthy(left) else self.eval(node[2], env)
+        if tag == "not":
+            return not truthy(self.eval(node[1], env))
+        if tag == "neg":
+            return -to_number(self.eval(node[1], env))
+        if tag == "typeof":
+            inner = node[1]
+            if inner[0] == "name" and not env.has(inner[1]):
+                return "undefined"
+            return js_typeof(self.eval(inner, env))
+        if tag == "eq":
+            _, op, l, r = node
+            res = strict_eq(self.eval(l, env), self.eval(r, env))
+            return res if op == "===" else not res
+        if tag == "rel":
+            _, op, l, r = node
+            return js_compare(op, self.eval(l, env), self.eval(r, env))
+        if tag == "bin":
+            _, op, l, r = node
+            a, b = self.eval(l, env), self.eval(r, env)
+            if op == "+":
+                return js_add(a, b)
+            return js_arith(op, a, b)
+        if tag == "assign":
+            return self._assign(node, env)
+        if tag == "postinc":
+            target = node[1]
+            old = to_number(self.eval(target, env))
+            self._store(target, old + 1, env)
+            return old
+        if tag == "member":
+            return self._member(self.eval(node[1], env), node[2])
+        if tag == "index":
+            obj = self.eval(node[1], env)
+            key = self.eval(node[2], env)
+            return self._get_index(obj, key)
+        if tag == "call":
+            return self._eval_call(node, env)
+        if tag == "new":
+            _, callee, args = node
+            kind = self.eval(callee, env)
+            if kind in ("TypeError", "Error"):
+                msg = to_string(self.eval(args[0], env)) if args else ""
+                return JSError(kind, msg)
+            raise JSInterpError("`new` supports only Error/TypeError")
+        raise JSInterpError(f"unknown expression {tag}")
+
+    def _assign(self, node, env):
+        _, op, target, rhs = node
+        value = self.eval(rhs, env)
+        if op != "=":
+            current = self.eval(target, env)
+            base = op[0]
+            if base == "+":
+                value = js_add(current, value)
+            else:
+                value = js_arith(base, current, value)
+        self._store(target, value, env)
+        return value
+
+    def _store(self, target, value, env):
+        tag = target[0]
+        if tag == "name":
+            env.assign(target[1], value)
+        elif tag == "index":
+            obj = self.eval(target[1], env)
+            key = self.eval(target[2], env)
+            if isinstance(obj, list):
+                x = to_number(key)
+                if math.isnan(x) or math.isinf(x) or not x.is_integer():
+                    raise JSInterpError(
+                        "non-integer array index assignment unsupported")
+                idx = int(x)
+                if idx == len(obj):
+                    obj.append(value)
+                elif 0 <= idx < len(obj):
+                    obj[idx] = value
+                else:
+                    raise JSInterpError(
+                        "sparse array assignment unsupported")
+            elif isinstance(obj, dict):
+                obj[key if isinstance(key, str) else to_string(key)] = value
+            else:
+                raise JSThrow(JSError(
+                    "TypeError", "assignment to non-object property"))
+        elif tag == "member":
+            obj = self.eval(target[1], env)
+            if isinstance(obj, dict):
+                obj[target[2]] = value
+            else:
+                raise JSThrow(JSError(
+                    "TypeError", "member assignment on non-object"))
+        else:
+            raise JSInterpError("invalid store target")
+
+    # ---- property & method dispatch ----
+    def _get_index(self, obj, key):
+        if isinstance(obj, list):
+            if isinstance(key, str):
+                return self._member(obj, key)
+            idx = to_number(key)
+            if not float(idx).is_integer():
+                return UNDEFINED
+            idx = int(idx)
+            if 0 <= idx < len(obj):
+                return obj[idx]
+            return UNDEFINED
+        if isinstance(obj, str):
+            idx = to_number(key) if not isinstance(key, str) else None
+            if idx is not None and float(idx).is_integer() \
+                    and 0 <= int(idx) < len(obj):
+                return obj[int(idx)]
+            if isinstance(key, str):
+                return self._member(obj, key)
+            return UNDEFINED
+        if isinstance(obj, dict):
+            k = key if isinstance(key, str) else to_string(key)
+            return obj.get(k, UNDEFINED)
+        if obj is None or obj is UNDEFINED:
+            raise JSThrow(JSError(
+                "TypeError",
+                f"cannot read properties of {to_string(obj)}"))
+        raise JSInterpError(f"indexing {type(obj).__name__} unsupported")
+
+    def _member(self, obj, name):
+        if obj is None or obj is UNDEFINED:
+            raise JSThrow(JSError(
+                "TypeError",
+                f"cannot read properties of {to_string(obj)} "
+                f"(reading '{name}')"))
+        if isinstance(obj, dict):
+            return obj.get(name, UNDEFINED)
+        if isinstance(obj, list):
+            if name == "length":
+                return float(len(obj))
+            return _BoundMethod(obj, name)
+        if isinstance(obj, str):
+            if name == "length":
+                return float(len(obj))
+            return _BoundMethod(obj, name)
+        if isinstance(obj, JSRegex):
+            return _BoundMethod(obj, name)
+        if isinstance(obj, JSError):
+            if name == "message":
+                return obj.message
+            raise JSInterpError(f"Error property {name} unsupported")
+        if hasattr(obj, "call") and name == "call":
+            return obj.call
+        props = getattr(obj, "props", None)
+        if props is not None and name in props:
+            return props[name]
+        raise JSInterpError(
+            f"property {name!r} on {type(obj).__name__} unsupported")
+
+    def _eval_call(self, node, env):
+        _, callee, arg_nodes = node
+        args = [self.eval(a, env) for a in arg_nodes]
+        fn = self.eval(callee, env)
+        return self.call_function(fn, args)
+
+    def call_function(self, fn, args):
+        if isinstance(fn, JSFunction):
+            local = Env(fn.env)
+            for i, p in enumerate(fn.params):
+                local.declare(p, args[i] if i < len(args) else UNDEFINED)
+            try:
+                self.exec_block(fn.body, local)
+            except _Return as r:
+                return r.value
+            return UNDEFINED
+        if isinstance(fn, _BoundMethod):
+            return fn(self, args)
+        if callable(fn):
+            return fn(*args)
+        raise JSThrow(JSError("TypeError",
+                              f"{to_string(fn)} is not a function"))
+
+
+class _BoundMethod:
+    """String/array/regex prototype methods — exactly the set the emitted
+    subset and the prelude use; anything else raises loudly."""
+
+    def __init__(self, obj, name):
+        self.obj = obj
+        self.name = name
+
+    def __call__(self, interp, args):
+        o, name = self.obj, self.name
+        if isinstance(o, str):
+            return self._string(interp, o, name, args)
+        if isinstance(o, list):
+            return self._array(interp, o, name, args)
+        if isinstance(o, JSRegex):
+            if name == "test":
+                return o.rx.search(to_string(args[0])) is not None
+            raise JSInterpError(f"regex method {name} unsupported")
+        raise JSInterpError(f"method {name} on {type(o).__name__}")
+
+    @staticmethod
+    def _string(interp, s, name, args):
+        if name == "trim":
+            # JS trim removes WhiteSpace+LineTerminator; Python strip's
+            # default set is a superset match for ASCII space/tab/newline
+            return s.strip()
+        if name == "toLowerCase":
+            return s.lower()
+        if name == "toUpperCase":
+            return s.upper()
+        if name == "startsWith":
+            return s.startswith(to_string(args[0]))
+        if name == "endsWith":
+            return s.endswith(to_string(args[0]))
+        if name == "includes":
+            return to_string(args[0]) in s
+        if name == "split":
+            sep = args[0] if args else UNDEFINED
+            if sep is UNDEFINED:
+                return [s]
+            sep = to_string(sep)
+            if sep == "":
+                return list(s)
+            return s.split(sep)
+        if name == "slice":
+            return _BoundMethod._slice(s, args)
+        raise JSInterpError(f"string method {name} unsupported")
+
+    @staticmethod
+    def _array(interp, arr, name, args):
+        if name == "push":
+            arr.extend(args)
+            return float(len(arr))
+        if name == "includes":
+            # SameValueZero, not strict equality: JS includes FINDS NaN
+            needle = args[0]
+            nan_needle = isinstance(needle, float) and math.isnan(needle)
+            return any(
+                strict_eq(e, needle)
+                or (nan_needle and isinstance(e, float) and math.isnan(e))
+                for e in arr
+            )
+        if name == "join":
+            sep = "," if not args or args[0] is UNDEFINED \
+                else to_string(args[0])
+            return sep.join(
+                "" if e is None or e is UNDEFINED else to_string(e)
+                for e in arr
+            )
+        if name == "sort":
+            if args:
+                raise JSInterpError("sort comparator unsupported")
+            # default JS sort: lexicographic on ToString, undefined last
+            arr.sort(key=lambda e: (e is UNDEFINED, to_string(e)))
+            return arr
+        if name == "slice":
+            return _BoundMethod._slice(arr, args)
+        raise JSInterpError(f"array method {name} unsupported")
+
+    @staticmethod
+    def _slice(seq, args):
+        n = len(seq)
+
+        def clamp(v, default):
+            if v is UNDEFINED:
+                return default
+            x = to_number(v)
+            if math.isnan(x):
+                return 0  # ToIntegerOrInfinity(NaN) is +0 in JS
+            if math.isinf(x):
+                return n if x > 0 else 0
+            i = int(x)
+            if i < 0:
+                i += n
+            return max(0, min(n, i))
+
+        lo = clamp(args[0] if len(args) > 0 else UNDEFINED, 0)
+        hi = clamp(args[1] if len(args) > 1 else UNDEFINED, n)
+        if hi < lo:
+            hi = lo
+        return seq[lo:hi]
+
+
+def run_js(source: str) -> dict:
+    """Execute a generated logic.js; returns the KOLogic export table as
+    {name: JSFunction} plus a caller. Entry point for the differential
+    tests."""
+    interp = Interpreter()
+    genv = interp.run(source)
+    exports = genv.lookup("KOLogic")
+    if not isinstance(exports, dict):
+        raise JSInterpError("KOLogic export table missing")
+    return {"interpreter": interp, "exports": exports}
+
+
+def call_export(runtime: dict, name: str, *args):
+    """Call an exported function with Python values (already JS-shaped:
+    floats/strs/bools/lists/dicts/None)."""
+    fn = runtime["exports"].get(name)
+    if fn is None:
+        raise JSInterpError(f"KOLogic.{name} is not exported")
+    return runtime["interpreter"].call_function(fn, list(args))

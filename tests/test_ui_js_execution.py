@@ -1,0 +1,300 @@
+"""The shipped JS, EXECUTED (VERDICT r4 #3 / missing #2).
+
+Runs the generated ``/ui/logic.js`` — prelude included — through the
+strict tree-walking JS interpreter (``ui/jsinterp.py``) and replays the
+ENTIRE ``test_ui_logic`` parity grid against it, differentially against
+the Python originals. A transpiler bug that produces valid-but-
+semantically-different JS (number formatting, truthiness, sort order,
+string coercion) now fails CI even though the Python twin passes.
+
+The grid is not duplicated here: a recorder plugin captures every PUBLIC
+call the parity tests make (tests/ui_call_recorder.py), so new parity
+cases become differential cases automatically.
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import math
+import os
+import subprocess
+import sys
+
+import pytest
+
+from kubeoperator_tpu.ui import logic
+from kubeoperator_tpu.ui.jsinterp import (
+    UNDEFINED,
+    Interpreter,
+    JSThrow,
+    call_export,
+    run_js,
+)
+from kubeoperator_tpu.ui.transpile import generate_logic_js
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------- helpers ----
+def js_equivalent(py, js, path="$"):
+    """Structural equality between a Python result and a JS result.
+    int/float compare by value; bool is NOT a number; JS undefined is
+    accepted where Python has None (implicit returns)."""
+    if js is UNDEFINED:
+        js = None
+    if isinstance(py, bool) or isinstance(js, bool):
+        assert isinstance(py, bool) and isinstance(js, bool) and py == js, \
+            f"{path}: {py!r} vs {js!r}"
+        return
+    if isinstance(py, (int, float)) or isinstance(js, (int, float)):
+        assert isinstance(py, (int, float)) and isinstance(js, (int, float)), \
+            f"{path}: {py!r} vs {js!r}"
+        if isinstance(py, float) and math.isnan(py):
+            assert isinstance(js, float) and math.isnan(js), \
+                f"{path}: {py!r} vs {js!r}"
+            return
+        assert float(py) == float(js), f"{path}: {py!r} vs {js!r}"
+        return
+    if py is None or js is None:
+        assert py is None and js is None, f"{path}: {py!r} vs {js!r}"
+        return
+    if isinstance(py, str) or isinstance(js, str):
+        assert py == js, f"{path}: {py!r} vs {js!r}"
+        return
+    if isinstance(py, (list, tuple)):
+        assert isinstance(js, list), f"{path}: {py!r} vs {js!r}"
+        assert len(py) == len(js), f"{path}: len {len(py)} vs {len(js)}"
+        for i, (a, b) in enumerate(zip(py, js)):
+            js_equivalent(a, b, f"{path}[{i}]")
+        return
+    if isinstance(py, dict):
+        assert isinstance(js, dict), f"{path}: {py!r} vs {js!r}"
+        assert set(py) == set(js), \
+            f"{path}: keys {sorted(py)} vs {sorted(js)}"
+        for k in py:
+            js_equivalent(py[k], js[k], f"{path}.{k}")
+        return
+    raise AssertionError(f"{path}: unexpected type {type(py).__name__}")
+
+
+@pytest.fixture(scope="module")
+def js_runtime():
+    return run_js(generate_logic_js())
+
+
+@pytest.fixture(scope="module")
+def recorded_grid(tmp_path_factory):
+    """Run the parity grid once in a subprocess with the recorder plugin
+    and return the captured (fn, args) cases."""
+    log = tmp_path_factory.mktemp("uigrid") / "calls.json"
+    env = dict(os.environ, KO_UI_CALL_LOG=str(log))
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest", "tests/test_ui_logic.py", "-q",
+         "-p", "tests.ui_call_recorder", "--no-header", "-x"],
+        cwd=REPO_ROOT, env=env, capture_output=True, text=True, timeout=600,
+    )
+    assert proc.returncode == 0, \
+        f"parity grid failed under recorder:\n{proc.stdout[-3000:]}"
+    cases = json.loads(log.read_text())
+    # the grid is substantial — if recording collapses, the differential
+    # suite would silently shrink to nothing
+    assert len(cases) >= 200, f"only {len(cases)} recorded calls"
+    assert len({c["fn"] for c in cases}) >= 40, "too few functions covered"
+    return cases
+
+
+# ------------------------------------------------------------------ tests ----
+class TestGeneratedJsExecutes:
+    def test_whole_file_parses_and_evaluates(self, js_runtime):
+        """The complete generated file — prelude, consts, 53 functions,
+        export table, globalThis hookup — executes under JS semantics."""
+        exports = js_runtime["exports"]
+        expected = {f.__name__ for f in logic.PUBLIC}
+        assert expected <= set(exports)
+
+    def test_entire_parity_grid_differential(self, js_runtime, recorded_grid):
+        """Every call the test_ui_logic grid makes, replayed through the
+        interpreted logic.js and compared against the Python original."""
+        failures = []
+        for case in recorded_grid:
+            name, args = case["fn"], case["args"]
+            py_fn = getattr(logic, name)
+            py_err = js_err = None
+            py_result = js_result = None
+            try:
+                py_result = py_fn(*copy.deepcopy(args))
+            except Exception as e:       # noqa: BLE001 - parity on errors
+                py_err = type(e).__name__
+            try:
+                js_result = call_export(js_runtime, name,
+                                        *copy.deepcopy(args))
+            except JSThrow as e:
+                js_err = str(e)
+            try:
+                if (py_err is None) != (js_err is None):
+                    raise AssertionError(
+                        f"divergent error behavior: py={py_err} js={js_err}")
+                if py_err is None:
+                    js_equivalent(py_result, js_result)
+            except AssertionError as e:
+                failures.append(f"{name}({json.dumps(args)[:120]}): {e}")
+        assert not failures, (
+            f"{len(failures)}/{len(recorded_grid)} divergences:\n"
+            + "\n".join(failures[:20])
+        )
+
+
+class TestGateCatchesMutations:
+    def test_prelude_mutation_fails_the_differential(self, recorded_grid):
+        """Prove the gate bites: a single prelude regression (parse_int
+        accepting garbage digits the way a sloppy rewrite might) must
+        produce divergences against the Python originals across the
+        recorded grid — if this passes silently, the differential is
+        decorative."""
+        mutated = generate_logic_js().replace(
+            'return /^-?[0-9]+$/.test(t) ? parseInt(t, 10) : null;',
+            'return parseInt(t, 10);',
+        )
+        assert 'return parseInt(t, 10);' in mutated
+        rt = run_js(mutated)
+        divergences = 0
+        for case in recorded_grid:
+            name, args = case["fn"], case["args"]
+            py_fn = getattr(logic, name)
+            try:
+                py_result = py_fn(*copy.deepcopy(args))
+                py_err = None
+            except Exception:            # noqa: BLE001
+                py_err = True
+            try:
+                js_result = call_export(rt, name, *copy.deepcopy(args))
+                js_err = None
+            except JSThrow:
+                js_err = True
+            if (py_err is None) != (js_err is None):
+                divergences += 1
+                continue
+            if py_err is None:
+                try:
+                    js_equivalent(py_result, js_result)
+                except AssertionError:
+                    divergences += 1
+        assert divergences > 0, (
+            "a mutated prelude sailed through the entire grid — the "
+            "differential gate is not sensitive enough"
+        )
+
+
+class TestInterpreterSemantics:
+    """The interpreter must be a JS, not a Python: pin the exact semantic
+    divergences it exists to model, so a regression toward Python
+    semantics (which would blind the differential gate) fails here."""
+
+    def run(self, src):
+        interp = Interpreter()
+        env = interp.run(src)
+        return env
+
+    def test_number_formatting_is_js(self):
+        env = self.run('const a = String(5.0); const b = String(2.5);'
+                       'const c = "" + 16;')
+        assert env.lookup("a") == "5"        # not "5.0"
+        assert env.lookup("b") == "2.5"
+        assert env.lookup("c") == "16"
+
+    def test_empty_array_and_object_are_truthy(self):
+        env = self.run('const a = [] ? 1 : 2; const b = {} ? 1 : 2;'
+                       'const c = "" ? 1 : 2;')
+        assert env.lookup("a") == 1
+        assert env.lookup("b") == 1
+        assert env.lookup("c") == 2          # "" stays falsy
+
+    def test_strict_equality_is_strict(self):
+        env = self.run('const a = (1 === true) ? 1 : 0;'
+                       'const b = ("1" === 1) ? 1 : 0;'
+                       'const c = (null === undefined) ? 1 : 0;')
+        assert env.lookup("a") == 0
+        assert env.lookup("b") == 0
+        assert env.lookup("c") == 0
+
+    def test_division_is_float_and_by_zero_is_infinity(self):
+        env = self.run('const a = 1 / 2; const b = 1 / 0; const c = 0 / 0;')
+        assert env.lookup("a") == 0.5
+        assert env.lookup("b") == math.inf
+        assert math.isnan(env.lookup("c"))
+
+    def test_default_sort_is_lexicographic(self):
+        env = self.run('const a = [10, 9, 1].sort();')
+        assert env.lookup("a") == [1, 10, 9]  # ToString order, the JS trap
+
+    def test_missing_property_is_undefined_not_keyerror(self):
+        env = self.run('const o = {"a": 1}; const b = o["zzz"];'
+                       'const c = typeof o["zzz"];')
+        assert env.lookup("b") is UNDEFINED
+        assert env.lookup("c") == "undefined"
+
+    def test_string_plus_number_concatenates(self):
+        env = self.run('const a = "v" + 1; const b = 1 + 2 + "x";')
+        assert env.lookup("a") == "v1"
+        assert env.lookup("b") == "3x"
+
+    def test_template_literal_tostrings_like_js(self):
+        env = self.run('const x = 4.0; const a = `n=${x} b=${true} '
+                       'u=${undefined}`;')
+        assert env.lookup("a") == "n=4 b=true u=undefined"
+
+    def test_prelude_rt_num_throws_typeerror_on_string(self):
+        src = ('function f(x) { if (typeof x !== "number") '
+               '{ throw new TypeError("num() needs a number"); } return x; }'
+               'let r; let caught; caught = 0;'
+               'r = f(3);')
+        env = self.run(src)
+        assert env.lookup("r") == 3
+        with pytest.raises(JSThrow, match="num"):
+            self.run('function f(x) { if (typeof x !== "number") '
+                     '{ throw new TypeError("num() needs a number"); } '
+                     'return x; } const r = f("s");')
+
+    def test_compound_divide_and_floor_handle_zero_like_js(self):
+        env = self.run('let a = 5; a /= 0; const b = Math.floor(1 / 0);'
+                       'const c = Math.floor(0 / 0);')
+        assert env.lookup("a") == math.inf      # not ZeroDivisionError
+        assert env.lookup("b") == math.inf      # not OverflowError
+        assert math.isnan(env.lookup("c"))
+
+    def test_constructor_calls_distinguish_missing_from_undefined(self):
+        env = self.run('const a = String(undefined); const b = String();'
+                       'const c = Number(undefined); const d = Number();')
+        assert env.lookup("a") == "undefined"
+        assert env.lookup("b") == ""
+        assert math.isnan(env.lookup("c"))
+        assert env.lookup("d") == 0
+
+    def test_small_number_formatting_follows_ecma_dtoa(self):
+        env = self.run('const a = String(0.00001); const b = String(1e-7);'
+                       'const c = String(1e21); const d = String(123.456);')
+        assert env.lookup("a") == "0.00001"     # decimal down to 1e-6
+        assert env.lookup("b") == "1e-7"        # unpadded exponent
+        assert env.lookup("c") == "1e+21"
+        assert env.lookup("d") == "123.456"
+
+    def test_nan_propagation_min_max_and_includes_samevaluezero(self):
+        env = self.run('const a = Math.min(1, 0 / 0);'
+                       'const b = [0 / 0].includes(0 / 0);'
+                       'const c = [1, 2].includes(0 / 0);')
+        assert math.isnan(env.lookup("a"))      # JS propagates NaN
+        assert env.lookup("b") is True          # SameValueZero finds NaN
+        assert env.lookup("c") is False
+
+    def test_strict_grammar_rejects_unknown_constructs(self):
+        from kubeoperator_tpu.ui.jsinterp import JSInterpError
+
+        for bad in (
+            "const a = x => x;",             # arrow functions not in subset
+            "const a = 1 == 1;",             # loose equality banned
+            "label: for (;;) { break label; }",
+            "async function f() {}",
+        ):
+            with pytest.raises(JSInterpError):
+                self.run(bad)

@@ -1,0 +1,64 @@
+"""Pytest plugin: record every call the ``test_ui_logic`` parity grid
+makes into ``kubeoperator_tpu.ui.logic``'s PUBLIC functions.
+
+Loaded with ``-p tests.ui_call_recorder`` by the differential JS-execution
+suite (tests/test_ui_js_execution.py): the recorded (function, args) pairs
+ARE the parity grid, kept in sync with test_ui_logic automatically — a new
+parity case there becomes a new differential case against the generated
+logic.js without anyone remembering to copy it.
+
+Wraps at pytest_configure (before test collection imports the module), so
+both ``logic.fn(...)`` and ``from ...logic import fn`` call sites record.
+Calls whose args are not JSON-representable are skipped (none today).
+"""
+
+from __future__ import annotations
+
+import copy
+import functools
+import json
+import os
+
+_CALLS: list = []
+_SEEN: set = set()
+
+
+def _jsonable(x) -> bool:
+    try:
+        json.dumps(x)
+        return True
+    except (TypeError, ValueError):
+        return False
+
+
+def pytest_configure(config):
+    from kubeoperator_tpu.ui import logic
+
+    wrapped = []
+    for fn in logic.PUBLIC:
+        name = fn.__name__
+
+        def make(fn=fn, name=name):
+            @functools.wraps(fn)
+            def rec(*args):
+                if _jsonable(args):
+                    key = (name, json.dumps(args, sort_keys=True))
+                    if key not in _SEEN:       # dedupe identical cases
+                        _SEEN.add(key)
+                        _CALLS.append(
+                            {"fn": name, "args": copy.deepcopy(list(args))})
+                return fn(*args)
+            return rec
+
+        setattr(logic, name, make())
+        wrapped.append(name)
+    # PUBLIC itself must keep pointing at the wrappers so transpilation
+    # inputs (function __name__ lookups) still resolve
+    logic.PUBLIC = [getattr(logic, n) for n in wrapped]
+
+
+def pytest_unconfigure(config):
+    path = os.environ.get("KO_UI_CALL_LOG")
+    if path:
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(_CALLS, f)
