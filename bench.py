@@ -53,6 +53,21 @@ def prior_run_comparison(result: dict, here: str | None = None) -> dict | None:
                 # ~2% is known tunnel/clock variance (MXU rerun
                 # rationale); past 1% it is a WATCH signal, not proof
                 out["headline_watch"] = delta < -1.0
+                # r4->r5 estimator change: prior rounds reported
+                # max-of-draws (noise-inflated); this round reports the
+                # median. A cross-protocol delta is definitional, not a
+                # regression — say so right where the delta is read.
+                prev_protocol = prev_details.get("mxu_headline_protocol")
+                cur_protocol = result["details"].get("mxu_headline_protocol")
+                if cur_protocol and prev_protocol != cur_protocol:
+                    out["headline_delta_note"] = (
+                        "cross-protocol comparison: prior round used a "
+                        "different headline estimator; see "
+                        "mxu_headline_protocol and ops/matmul.py findings"
+                    )
+                    # a definitional delta must not trip the regression
+                    # boolean — consumers key on the flag, not the prose
+                    out["headline_watch"] = False
             detail_deltas = {}
             for key in ("hbm_triad_gbps", "dma_read_gbps", "train_mfu_pct",
                         "train_model_tflops_per_s"):
@@ -140,42 +155,55 @@ def main() -> int:
             "vs_baseline": round(best / envelope, 3),
         }
     else:
-        # sweep matmul sizes: bigger operands amortize loop/readback overhead
-        # and raise MXU occupancy, but VMEM pressure varies by generation —
-        # measure, don't guess, and report the best sustained rate
-        # iteration counts sized so hi-run device time is ~100s of ms —
-        # differential timing cancels constant relay RTT, but only a device
-        # time >> RTT jitter keeps the delta noise-free (a 27ms run behind a
-        # tunnel measured 1.3x datasheet peak; physically impossible)
+        # Sweep matmul sizes: bigger operands amortize loop/readback
+        # overhead and raise MXU occupancy — measure, don't guess. Each
+        # measurement is now MEDIAN-of-7 differential draws over a wide
+        # span (lo=iters, hi=4*iters): the r4 "rerun droop" root-cause
+        # (ops/matmul.py findings) showed short spans amplify tunnel RTT
+        # jitter into a 9-18% band whose MAX the old best-of headline
+        # cherry-picked — r4's 193.2 was the top of that noise band; the
+        # honest stable median is ~175. Expect the r4->r5 headline delta
+        # to read ~-10%: that is the estimator correction, not a chip or
+        # framework regression (r5's median sits inside r4's own recorded
+        # band [173.3, 193.2]).
+        # lo iteration counts sized so the DELTA span (3*lo) is ~1s of
+        # device time per shape — the first r5 run showed 2048/4096 at
+        # shorter spans still carrying 28% bands (and convexity biasing
+        # their medians UP), while 8192's ~1.1s span sat at 2.8%
         best_m = None
-        best_size_iters = None
-        for size, iters in ((2048, 3000), (4096, 400), (8192, 60)):
-            m = mxu_matmul_tflops(size=size, iters=iters)
-            details[f"mxu_tflops_{size}"] = round(m.tflops, 1)
+        for size, lo_iters in ((2048, 3400), (4096, 860), (8192, 60)):
+            m = mxu_matmul_tflops(size=size, iters=lo_iters)
+            details[f"mxu_tflops_{size}"] = m.tflops
+            details[f"mxu_band_{size}"] = list(m.tflops_band)
             if best_m is None or m.tflops > best_m.tflops:
                 best_m = m
-                best_size_iters = (size, iters)
-        # the headline is max-of-sweep; one repeat of the winning shape
-        # halves run-to-run downside (clock/thermal/tunnel variance showed
-        # ~2% swings between full bench runs) without re-paying the sweep
-        m = mxu_matmul_tflops(size=best_size_iters[0],
-                              iters=best_size_iters[1])
-        details[f"mxu_tflops_{best_size_iters[0]}_rerun"] = round(m.tflops, 1)
-        # headline variance band: the winning shape's two draws — the
-        # honest way to read a run-over-run delta (VERDICT r3 weak #2)
-        details["mxu_headline_band"] = sorted(
-            [round(best_m.tflops, 1), round(m.tflops, 1)])
-        if m.tflops > best_m.tflops:
-            best_m = m
-        # best-of-2 with the spread recorded: the r4 sweep showed ±4%
-        # run-to-run tunnel variance at a ~670-720 plateau (ops/hbm.py
-        # ceiling analysis) — a single draw reads as drift
-        h1 = hbm_bandwidth_gbps(size_mb=256, iters=200)
-        h2 = hbm_bandwidth_gbps(size_mb=256, iters=200)
-        details["hbm_triad_gbps"] = round(max(h1.gbps, h2.gbps), 1)
-        details["hbm_triad_band_gbps"] = [
-            round(min(h1.gbps, h2.gbps), 1), round(max(h1.gbps, h2.gbps), 1),
-        ]
+        details["mxu_headline_band"] = list(best_m.tflops_band)
+        details["mxu_headline_band_pct"] = round(best_m.band_pct, 1)
+        # 2x the documented 2-4% tunnel variance: a wider band means the
+        # tunnel was unusually noisy and the headline deserves suspicion
+        details["mxu_band_blowout"] = best_m.band_pct > 5.0
+        details["mxu_headline_protocol"] = (
+            "median of 7 wide-span differential draws (r5); r4 and "
+            "earlier reported max-of-draws over a short-span estimator "
+            "(noise-inflated ~+10%)"
+        )
+        # median-of-3 with the spread recorded (same estimator honesty as
+        # the MXU headline): the r4 best-of-2 printed an impossible 885
+        # GB/s (> the 819 datasheet) when one draw caught tunnel jitter —
+        # the median stays at the real ~670-720 plateau (ops/hbm.py
+        # ceiling analysis)
+        from statistics import median as _median
+
+        hs = [hbm_bandwidth_gbps(size_mb=256, iters=200).gbps
+              for _ in range(3)]
+        details["hbm_triad_gbps"] = round(_median(hs), 1)
+        details["hbm_triad_band_gbps"] = [round(min(hs), 1),
+                                          round(max(hs), 1)]
+        if _median(hs) > gen.hbm_gbps_per_chip * 1.05:
+            details["hbm_triad_note"] = (
+                "median exceeds the datasheet envelope — tunnel-jitter "
+                "noise, not bandwidth; treat as ~ceiling"
+            )
         # manual-DMA peak read bandwidth (double-buffered pallas stream) —
         # reported beside the triad so both the fused-XLA sustained number
         # and the copy-engine ceiling are visible (VERDICT r1 item 5)

@@ -230,12 +230,35 @@ class Handlers:
         })
 
     async def audit_log(self, request):
+        from kubeoperator_tpu.utils.errors import ValidationError
+
         _require_admin(request)
-        limit = int(request.query.get("limit", "200"))
+        try:
+            limit = int(request.query.get("limit", "200") or 200)
+        except ValueError:
+            # same contract as the events feed: bad input is a 400 with
+            # the field named, not an ERR_INTERNAL 500
+            raise ValidationError("limit must be an integer")
+        limit = max(1, min(limit, 1000))
         rows = await run_sync(request, self.s.repos.audit.tail, limit)
         return json_response([r.to_dict() for r in rows])
 
     async def metrics_endpoint(self, request):
+        # /metrics is session-auth-exempt (scrapers have no session), which
+        # leaves cluster names/phases readable by anyone reaching the port.
+        # server.metrics_token gates it without relying on network
+        # placement alone (ADVICE r4): prometheus sends it via the scrape
+        # config's `authorization: credentials:` field. Empty = open,
+        # matching the compose's internal-network default.
+        token = self.s.config.get("server.metrics_token", "")
+        if token:
+            import hmac
+
+            got = request.headers.get("Authorization", "")
+            # constant-time compare: the knob exists precisely for ports
+            # reachable by untrusted networks — no timing oracle
+            if not hmac.compare_digest(got, f"Bearer {token}"):
+                return web.Response(status=401, text="metrics token required")
         text = await run_sync(request, self.metrics.render, self.s)
         return web.Response(
             text=text, content_type="text/plain", charset="utf-8"

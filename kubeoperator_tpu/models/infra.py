@@ -148,13 +148,12 @@ class Plan(Entity):
     def validate(self) -> None:
         if not self.name:
             raise ValidationError("plan name required")
-        # shared RFC1123 gate: plan names become TPU-VM instance prefixes
-        # and K8s object names — the wizard already rejects this
-        # client-side, and accept-side drift here was a real parity hole
-        # (r4: the server took "x x" and would only explode at apply time)
-        from kubeoperator_tpu.models.base import validate_dns_label
-
-        validate_dns_label(self.name, "plan name")
+        # The RFC1123 name-format gate lives at the SERVICE boundary
+        # (PlanService.create / rename-on-update), not here: plans
+        # persisted before the r4 tightening (e.g. "x x") must stay
+        # loadable, updatable under their existing name, and usable by
+        # cluster create — retroactive schema validation would strand
+        # them with no migration path (ADVICE r4).
         provider = PlanProvider(self.provider)
         if self.accelerator not in ("none", "tpu"):
             # "no GPU package in the build" starts at the schema [BASELINE].

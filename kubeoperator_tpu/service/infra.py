@@ -170,6 +170,23 @@ class PlanService(_Crud):
         if plan.has_tpu() and plan.worker_count == 0:
             plan.worker_count = plan.topology().total_hosts
 
+    def create(self, plan: Plan):
+        # RFC1123 enforced on NEW names only (plan names become TPU-VM
+        # instance prefixes + K8s object names); legacy rows persisted
+        # under the old rules are grandfathered on update-in-place
+        from kubeoperator_tpu.models.base import validate_dns_label
+
+        validate_dns_label(plan.name, "plan name")
+        return super().create(plan)
+
+    def update(self, plan: Plan):
+        from kubeoperator_tpu.models.base import validate_dns_label
+
+        existing = self.repo.get(plan.id)
+        if plan.name != existing.name:   # rename = a new name: full gate
+            validate_dns_label(plan.name, "plan name")
+        return super().update(plan)
+
     def delete(self, name: str) -> None:
         plan = self.repo.get_by_name(name)
         clusters = [c for c in self.repos.clusters.list()

@@ -174,6 +174,11 @@ def to_string(v) -> str:
     raise JSInterpError(f"ToString on {type(v).__name__}")
 
 
+_JS_DECIMAL_RE = _re.compile(
+    r"[+-]?(?:[0-9]+\.?[0-9]*|\.[0-9]+)(?:[eE][+-]?[0-9]+)?$"
+)
+
+
 def to_number(v) -> float:
     if isinstance(v, bool):
         return 1.0 if v else 0.0
@@ -184,13 +189,29 @@ def to_number(v) -> float:
     if v is UNDEFINED:
         return math.nan
     if isinstance(v, str):
+        # ECMAScript StringNumericLiteral, NOT Python float() grammar:
+        # "1_5"/"inf"/"nan" are NaN in JS, "0x10" is 16, only the exact
+        # word "Infinity" is infinite
         t = v.strip()
         if t == "":
             return 0.0
-        try:
-            return float(t)
-        except ValueError:
+        if "_" in t:  # Python literal separators are not JS
             return math.nan
+        sign = 1.0
+        body = t
+        if body[0] in "+-":
+            sign = -1.0 if body[0] == "-" else 1.0
+            body = body[1:]
+        if body == "Infinity":
+            return sign * math.inf
+        if len(body) > 2 and body[0] == "0" and body[1] in "xXoObB":
+            try:  # non-decimal literals take no sign in JS
+                return float(int(t, 0)) if t is body else math.nan
+            except ValueError:
+                return math.nan
+        if _JS_DECIMAL_RE.fullmatch(t):
+            return float(t)
+        return math.nan
     return math.nan  # objects (no valueOf support needed)
 
 
@@ -1236,9 +1257,16 @@ class Interpreter:
     def _get_index(self, obj, key):
         if isinstance(obj, list):
             if isinstance(key, str):
+                # JS canonicalizes numeric string keys: arr["1"] IS arr[1]
+                # (Object.keys over an array yields string indices)
+                if _re.fullmatch(r"-?[0-9]+", key):
+                    idx = int(key)
+                    if 0 <= idx < len(obj):
+                        return obj[idx]
+                    return UNDEFINED
                 return self._member(obj, key)
             idx = to_number(key)
-            if not float(idx).is_integer():
+            if math.isnan(idx) or not float(idx).is_integer():
                 return UNDEFINED
             idx = int(idx)
             if 0 <= idx < len(obj):

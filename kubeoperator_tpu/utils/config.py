@@ -25,6 +25,10 @@ DEFAULTS: dict[str, Any] = {
         "bind_host": "127.0.0.1",
         "bind_port": 8080,
         "session_ttl_s": 3600,
+        # when set, GET /metrics requires `Authorization: Bearer <token>`
+        # — the knob for deployments that cannot guarantee the metrics
+        # port stays inside the deployment network (ADVICE r4)
+        "metrics_token": "",
     },
     "db": {
         # SQLite stands in for the reference's MySQL (SURVEY.md §7.1 allows

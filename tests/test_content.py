@@ -415,6 +415,37 @@ def test_storage_components_wire_a_single_default_class():
         assert "is-default-class" not in text, role
 
 
+def test_vsphere_csi_controller_rbac_is_scoped_not_cluster_admin():
+    """ADVICE r4 (medium): a compromised CSI controller pod must stay a
+    storage problem, not a cluster takeover — the controller binds to a
+    scoped ClusterRole mirroring upstream vsphere-csi-driver, never to
+    the built-in cluster-admin."""
+    path = os.path.join(ROLES, "component-vsphere-csi", "templates",
+                        "vsphere-csi-driver.yaml.j2")
+    docs = [d for d in yaml.safe_load_all(
+        open(path, encoding="utf-8").read()
+        .replace("{{", "'{{").replace("}}", "}}'")) if d]
+    binding = next(d for d in docs if d.get("kind") == "ClusterRoleBinding"
+                   and d["metadata"]["name"] == "vsphere-csi-controller")
+    assert binding["roleRef"]["name"] == "vsphere-csi-controller"
+    role = next(d for d in docs if d.get("kind") == "ClusterRole"
+                and d["metadata"]["name"] == "vsphere-csi-controller")
+    # the storage-duty surface, nothing wider: no wildcard verbs/groups,
+    # no secrets access, and PV/attachment write powers present
+    flat = []
+    for rule in role["rules"]:
+        assert "*" not in rule.get("verbs", []), rule
+        assert "*" not in rule.get("resources", []), rule
+        assert "*" not in rule.get("apiGroups", ["x"]), rule
+        flat.extend(rule.get("resources", []))
+    assert "secrets" not in flat
+    assert "persistentvolumes" in flat and "volumeattachments" in flat
+    # no binding to the built-in role anywhere outside comments
+    code_lines = [l for l in open(path, encoding="utf-8")
+                  if not l.lstrip().startswith("#")]
+    assert not any("cluster-admin" in l for l in code_lines)
+
+
 def test_storage_default_include_expands_with_vars_in_simulation():
     """The include_tasks + vars plumbing works end-to-end in the simulator:
     the shared task appears in the component playbook's stream with the

@@ -247,6 +247,27 @@ class TestPlanTpuCreate:
         # all reservations released once hosts persisted
         assert svc.clusters._reserved_ips == set()
 
+    def test_legacy_plan_names_grandfathered_new_names_gated(self, svc):
+        """RFC1123 plan-name enforcement is a service-boundary gate on NEW
+        names (create/rename); rows persisted under the old rules stay
+        loadable and updatable in place (ADVICE r4: retroactive schema
+        validation stranded legacy plans with no migration path)."""
+        # a legacy row written before the r4 tightening
+        legacy = svc.repos.plans.save(Plan(name="x x", provider="bare_metal"))
+        # update-in-place under the existing name: accepted
+        legacy.worker_count = 2
+        updated = svc.plans.update(legacy)
+        assert updated.worker_count == 2
+        # rename to another non-conforming name: rejected
+        legacy.name = "still bad"
+        with pytest.raises(ValidationError, match="plan name"):
+            svc.plans.update(legacy)
+        # creating a NEW bad name: rejected at the service boundary
+        with pytest.raises(ValidationError, match="plan name"):
+            svc.plans.create(Plan(name="New Plan", provider="bare_metal"))
+        # model-level validate alone no longer blocks the legacy row
+        svc.repos.plans.get(updated.id).validate()
+
     def test_delete_plan_cluster_destroys_and_unbinds(self, svc):
         make_tpu_plan(svc)
         svc.clusters.create("gone", provision_mode="plan",

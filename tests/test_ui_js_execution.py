@@ -287,6 +287,22 @@ class TestInterpreterSemantics:
         assert env.lookup("b") is True          # SameValueZero finds NaN
         assert env.lookup("c") is False
 
+    def test_numeric_string_coercion_follows_js_not_python(self):
+        env = self.run('const a = Number("1_5"); const b = Number("inf");'
+                       'const c = Number("0x10"); const d = Number("Infinity");'
+                       'const e = Number("-2.5e1");')
+        assert math.isnan(env.lookup("a"))      # Python would parse 15
+        assert math.isnan(env.lookup("b"))      # only "Infinity" is valid
+        assert env.lookup("c") == 16
+        assert env.lookup("d") == math.inf
+        assert env.lookup("e") == -25.0
+
+    def test_array_numeric_string_index_is_element_access(self):
+        env = self.run('const a = [5, 6]; const b = a["1"];'
+                       'const k = Object.keys(a); const c = a[k[0]];')
+        assert env.lookup("b") == 6             # arr["1"] === arr[1]
+        assert env.lookup("c") == 5             # Object.keys round-trip
+
     def test_strict_grammar_rejects_unknown_constructs(self):
         from kubeoperator_tpu.ui.jsinterp import JSInterpError
 

@@ -93,17 +93,22 @@ class TestPlanValidateParity:
     def test_name_parity_including_invalid_labels(self):
         """r4 regression: the client rejected "x x" while the server
         accepted it (only valid names ever rode the grid) — plan names
-        become TPU-VM instance prefixes, so both sides must gate."""
+        become TPU-VM instance prefixes, so both sides must gate. The
+        server-side gate lives at the ACCEPT boundary (validate_dns_label
+        in PlanService.create; r5 moved it out of Plan.validate so legacy
+        rows stay loadable), so that is what the wizard must mirror."""
+        from kubeoperator_tpu.models.base import validate_dns_label
+
         for name, ok in (("p1", True), ("x x", False), ("Bad_Name", False),
                          ("-edge", False), ("a" * 64, False),
                          ("ok-name", True)):
             form = {"name": name, "provider": "bare_metal",
                     "master_count": 1, "worker_count": 1}
             client_ok = logic.plan_form_errors(form, CATALOG) == []
-            plan = Plan(name=name, provider="bare_metal",
-                        master_count=1, worker_count=1)
             try:
-                plan.validate()
+                validate_dns_label(name, "plan name")
+                Plan(name=name, provider="bare_metal",
+                     master_count=1, worker_count=1).validate()
                 server_ok = True
             except Exception:
                 server_ok = False
