@@ -59,19 +59,49 @@ UNDEFINED = _Undefined()
 
 
 class JSFunction:
-    def __init__(self, name, params, body, env):
+    def __init__(self, name, params, body, env, is_async=False):
         self.name = name or "(anonymous)"
         self.params = params
         self.body = body
         self.env = env
+        self.is_async = is_async
+
+
+class JSPromise:
+    """Synchronous promise model: async work in this interpreter completes
+    eagerly (fetch is a blocking bridge, timers are an explicit queue), so
+    a promise is always settled the moment it exists. `.then/.catch/
+    .finally` run their callbacks immediately — deterministic, which is
+    exactly what a CI gate wants."""
+
+    __slots__ = ("state", "value")
+
+    def __init__(self, state: str, value):
+        self.state = state            # "fulfilled" | "rejected"
+        self.value = value
+
+    @classmethod
+    def resolve(cls, v):
+        if isinstance(v, JSPromise):
+            return v
+        return cls("fulfilled", v)
+
+    @classmethod
+    def reject(cls, err):
+        return cls("rejected", err)
+
+    def __repr__(self):
+        return f"Promise<{self.state}: {self.value!r}>"
 
 
 class JSRegex:
     def __init__(self, pattern: str, flags: str):
-        if flags:
+        if set(flags) - {"g", "i"}:
             raise JSInterpError(f"regex flags unsupported: /{pattern}/{flags}")
         self.pattern = pattern
-        self.rx = _re.compile(pattern)
+        self.flags = flags
+        self.rx = _re.compile(pattern,
+                              _re.IGNORECASE if "i" in flags else 0)
 
 
 class JSError:
@@ -289,9 +319,28 @@ def js_compare(op: str, a, b):
     return pa >= pb
 
 
+# member/index/call chain node tags (optional-chaining short-circuit unit)
+_CHAIN_TAGS = frozenset(
+    {"member", "optmember", "index", "call", "optcall", "optmethod"})
+_SHORT = object()   # sentinel: a `?.` saw null/undefined — kill the chain
+
+_STRING_METHODS = frozenset({
+    "trim", "toLowerCase", "toUpperCase", "startsWith", "endsWith",
+    "includes", "split", "slice", "replace", "padStart", "repeat",
+    "indexOf", "charAt",
+})
+_ARRAY_METHODS = frozenset({
+    "push", "includes", "join", "sort", "slice", "map", "forEach",
+    "filter", "find", "some", "concat", "indexOf",
+})
+_PROMISE_METHODS = frozenset({"then", "catch", "finally"})
+
+
 # ------------------------------------------------------------- tokenizer ----
+# longest-match-first; "?." before "?", "..." before ".", "=>" before "="
 _PUNCT = [
-    "===", "!==", "<=", ">=", "&&", "||", "++", "+=", "-=", "*=", "/=",
+    "===", "!==", "...", "<=", ">=", "&&", "||", "??", "?.", "=>",
+    "++", "+=", "-=", "*=", "/=",
     "{", "}", "(", ")", "[", "]", ";", ",", ":", "?", ".", "<", ">",
     "=", "+", "-", "*", "/", "!",
 ]
@@ -299,8 +348,10 @@ _PUNCT = [
 _KEYWORDS = {
     "function", "return", "if", "else", "for", "while", "break", "continue",
     "let", "const", "var", "new", "throw", "typeof", "of", "true", "false",
-    "null", "undefined",
+    "null", "undefined", "try", "catch", "finally",
 }
+# `async`/`await` are contextual (identifiers in the spec too) — handled in
+# the parser so logic.js identifiers are unaffected.
 
 _ID_RE = _re.compile(r"[A-Za-z_$][A-Za-z0-9_$]*")
 _NUM_RE = _re.compile(r"(?:[0-9]+\.[0-9]*|\.[0-9]+|[0-9]+)(?:[eE][+-]?[0-9]+)?")
@@ -528,9 +579,21 @@ class Parser:
 
     def statement(self):
         t = self.peek()
+        if t.kind == "punct" and t.value == "{":
+            # bare block statement (scoping) — like real JS, block wins
+            # over object-literal in statement position
+            return ("block", self.block())
+        if t.kind == "id" and t.value == "async" \
+                and self.peek(1).kind == "kw" \
+                and self.peek(1).value == "function":
+            self.next()
+            node = self.function_decl()
+            return ("funcdecl", node[1], node[2], node[3], True)  # is_async
         if t.kind == "kw":
             if t.value == "function":
                 return self.function_decl()
+            if t.value == "try":
+                return self.try_stmt()
             if t.value in ("let", "const", "var"):
                 return self.var_decl()
             if t.value == "return":
@@ -613,18 +676,54 @@ class Parser:
         kind = self.next().value
         decls = []
         while True:
-            name = self.eat("id").value
-            init = None
-            if self.at("punct", "="):
-                self.next()
-                init = self.assignment_expr()
-            decls.append((name, init))
+            if self.at("punct", "[") or self.at("punct", "{"):
+                pattern = self.binding_pattern()
+                self.eat("punct", "=")
+                decls.append((pattern, self.assignment_expr()))
+            else:
+                name = self.eat("id").value
+                init = None
+                if self.at("punct", "="):
+                    self.next()
+                    init = self.assignment_expr()
+                decls.append((name, init))
             if self.at("punct", ","):
                 self.next()
                 continue
             break
         self.semi()
         return ("vardecl", kind, decls)
+
+    def binding_pattern(self):
+        """Simple destructuring patterns: [a, b] / {a, b} (no defaults,
+        no nesting, no rest — all the emitted/hand-written code uses)."""
+        open_tok = self.next().value
+        close = "]" if open_tok == "[" else "}"
+        names = []
+        while not self.at("punct", close):
+            names.append(self.eat("id").value)
+            if self.at("punct", ","):
+                self.next()
+        self.next()
+        return ("arraypat" if open_tok == "[" else "objpat", names)
+
+    def try_stmt(self):
+        self.eat("kw", "try")
+        body = self.block()
+        catch_name, catch_body, finally_body = None, None, None
+        if self.at("kw", "catch"):
+            self.next()
+            if self.at("punct", "("):
+                self.next()
+                catch_name = self.eat("id").value
+                self.eat("punct", ")")
+            catch_body = self.block()   # optional catch binding supported
+        if self.at("kw", "finally"):
+            self.next()
+            finally_body = self.block()
+        if catch_body is None and finally_body is None:
+            raise JSInterpError("try needs catch or finally")
+        return ("try", body, catch_name, catch_body, finally_body)
 
     def if_stmt(self):
         self.eat("kw", "if")
@@ -644,6 +743,12 @@ class Parser:
     def for_stmt(self):
         self.eat("kw", "for")
         self.eat("punct", "(")
+        # optional let/const/var prefix: `for (const c of ...)`,
+        # `for (let i = 0; ...)`
+        decl_kind = None
+        if self.peek().kind == "kw" and self.peek().value in (
+                "let", "const", "var"):
+            decl_kind = self.next().value
         # for (x of expr)  |  for (init; test; update)
         if self.peek().kind == "id" and self.peek(1).kind == "kw" \
                 and self.peek(1).value == "of":
@@ -654,7 +759,13 @@ class Parser:
             return ("forof", var, it, self.body_or_block())
         init = None
         if not self.at("punct", ";"):
-            init = ("expr", self.expression())
+            if decl_kind is not None:
+                name = self.eat("id").value
+                self.eat("punct", "=")
+                init = ("vardecl_nosemi", decl_kind,
+                        [(name, self.assignment_expr())])
+            else:
+                init = ("expr", self.expression())
         self.eat("punct", ";")
         test = None if self.at("punct", ";") else self.expression()
         self.eat("punct", ";")
@@ -667,6 +778,9 @@ class Parser:
         return self.assignment_expr()
 
     def assignment_expr(self):
+        arrow = self._try_parse_arrow()
+        if arrow is not None:
+            return arrow
         left = self.conditional()
         t = self.peek()
         if t.kind == "punct" and t.value in ("=", "+=", "-=", "*=", "/="):
@@ -677,8 +791,69 @@ class Parser:
             return ("assign", t.value, left, right)
         return left
 
+    def _try_parse_arrow(self):
+        """Arrow-function lookahead: `x => …`, `(a, b) => …`, optionally
+        prefixed with the contextual keyword `async`."""
+        start = self.i
+        is_async = False
+        if self.at("id", "async") and (
+            self.peek(1).kind == "id"
+            or (self.peek(1).kind == "punct" and self.peek(1).value == "(")
+        ):
+            # only commit to async-arrow if an arrow actually follows
+            save = self.i
+            self.next()
+            node = self._try_parse_arrow_core(True)
+            if node is not None:
+                return node
+            self.i = save
+            return None
+        node = self._try_parse_arrow_core(False)
+        if node is None:
+            self.i = start
+        return node
+
+    def _try_parse_arrow_core(self, is_async):
+        start = self.i
+        params = None
+        if self.peek().kind == "id" and self.peek(1).kind == "punct" \
+                and self.peek(1).value == "=>":
+            params = [self.next().value]
+        elif self.at("punct", "("):
+            # scan to the matching ')' and require '=>' right after
+            depth = 0
+            j = self.i
+            while j < len(self.toks):
+                tk = self.toks[j]
+                if tk.kind == "punct" and tk.value == "(":
+                    depth += 1
+                elif tk.kind == "punct" and tk.value == ")":
+                    depth -= 1
+                    if depth == 0:
+                        break
+                j += 1
+            after = self.toks[j + 1] if j + 1 < len(self.toks) else None
+            if not (after and after.kind == "punct" and after.value == "=>"):
+                self.i = start
+                return None
+            self.next()
+            params = []
+            while not self.at("punct", ")"):
+                params.append(self.eat("id").value)
+                if self.at("punct", ","):
+                    self.next()
+            self.next()
+        else:
+            return None
+        self.eat("punct", "=>")
+        if self.at("punct", "{"):
+            body = self.block()
+        else:
+            body = [("return", self.assignment_expr())]
+        return ("arrow", params, body, is_async)
+
     def conditional(self):
-        cond = self.logical_or()
+        cond = self.nullish()
         if self.at("punct", "?"):
             self.next()
             a = self.assignment_expr()
@@ -686,6 +861,13 @@ class Parser:
             b = self.assignment_expr()
             return ("cond", cond, a, b)
         return cond
+
+    def nullish(self):
+        left = self.logical_or()
+        while self.at("punct", "??"):
+            self.next()
+            left = ("nullish", left, self.logical_or())
+        return left
 
     def logical_or(self):
         left = self.logical_and()
@@ -741,25 +923,36 @@ class Parser:
         if t.kind == "kw" and t.value == "typeof":
             self.next()
             return ("typeof", self.unary())
+        if t.kind == "id" and t.value == "await":
+            self.next()
+            return ("await", self.unary())
         if t.kind == "kw" and t.value == "new":
             self.next()
             callee = self.postfix(no_call=True)
             self.eat("punct", "(")
             args = self.arg_list()
-            return ("new", callee, args)
+            # member/call chains continue after a new-expression:
+            # `new Date(ms).toLocaleTimeString()`
+            return self._postfix_ops(("new", callee, args))
         return self.postfix()
 
     def arg_list(self):
         args = []
         while not self.at("punct", ")"):
-            args.append(self.assignment_expr())
+            if self.at("punct", "..."):
+                self.next()
+                args.append(("spread", self.assignment_expr()))
+            else:
+                args.append(self.assignment_expr())
             if self.at("punct", ","):
                 self.next()
         self.next()
         return args
 
     def postfix(self, no_call=False):
-        e = self.primary()
+        return self._postfix_ops(self.primary(), no_call)
+
+    def _postfix_ops(self, e, no_call=False):
         while True:
             if self.at("punct", "."):
                 self.next()
@@ -767,6 +960,21 @@ class Parser:
                 if name.kind not in ("id", "kw"):
                     raise JSInterpError(f"bad property {name.value!r}")
                 e = ("member", e, name.value)
+                continue
+            if self.at("punct", "?."):
+                self.next()
+                if self.at("punct", "("):       # fn?.(args)
+                    self.next()
+                    e = ("optcall", e, self.arg_list())
+                    continue
+                name = self.next()
+                if name.kind not in ("id", "kw"):
+                    raise JSInterpError(f"bad property {name.value!r}")
+                if self.at("punct", "("):       # o?.m(args): short-circuits
+                    self.next()
+                    e = ("optmethod", e, name.value, self.arg_list())
+                else:
+                    e = ("optmember", e, name.value)
                 continue
             if self.at("punct", "["):
                 self.next()
@@ -829,7 +1037,11 @@ class Parser:
             if t.value == "[":
                 elts = []
                 while not self.at("punct", "]"):
-                    elts.append(self.assignment_expr())
+                    if self.at("punct", "..."):
+                        self.next()
+                        elts.append(("spread", self.assignment_expr()))
+                    else:
+                        elts.append(self.assignment_expr())
                     if self.at("punct", ","):
                         self.next()
                 self.next()
@@ -837,11 +1049,30 @@ class Parser:
             if t.value == "{":
                 pairs = []
                 while not self.at("punct", "}"):
+                    if self.at("punct", "..."):   # {...expr} object spread
+                        self.next()
+                        pairs.append((None, ("objspread",
+                                             self.assignment_expr())))
+                        if self.at("punct", ","):
+                            self.next()
+                        continue
                     k = self.next()
-                    if k.kind not in ("id", "str", "kw"):
+                    if k.kind == "punct" and k.value == "[":
+                        key_expr = self.assignment_expr()  # computed key
+                        self.eat("punct", "]")
+                        self.eat("punct", ":")
+                        pairs.append((("computed", key_expr),
+                                      self.assignment_expr()))
+                    elif k.kind not in ("id", "str", "kw"):
                         raise JSInterpError(f"bad object key {k.value!r}")
-                    self.eat("punct", ":")
-                    pairs.append((k.value, self.assignment_expr()))
+                    elif self.at("punct", ":"):
+                        self.next()
+                        pairs.append((k.value, self.assignment_expr()))
+                    elif k.kind == "id":          # shorthand {a, b}
+                        pairs.append((k.value, ("name", k.value)))
+                    else:
+                        raise JSInterpError(
+                            f"object key {k.value!r} needs a value")
                     if self.at("punct", ","):
                         self.next()
                 self.next()
@@ -1009,6 +1240,71 @@ class Interpreter:
         g.declare("TypeError", "TypeError")   # constructor tag for `new`
         g.declare("Error", "Error")
         g.declare("globalThis", {})
+
+        def _promise_all(promises):
+            if not isinstance(promises, list):
+                raise JSThrow(JSError("TypeError",
+                                      "Promise.all needs an array"))
+            out = []
+            for p in promises:
+                p = JSPromise.resolve(p)
+                if p.state == "rejected":
+                    return p
+                out.append(p.value)
+            return JSPromise("fulfilled", out)
+
+        g.declare("Promise", {
+            "all": native(_promise_all),
+            "resolve": native(lambda v=UNDEFINED: JSPromise.resolve(v)),
+            "reject": native(lambda v=UNDEFINED: JSPromise.reject(v)),
+        })
+
+        def _json_stringify(v=UNDEFINED, _replacer=UNDEFINED,
+                            _indent=UNDEFINED):
+            import json as _json
+
+            def conv(x):
+                if x is UNDEFINED:
+                    return None
+                if isinstance(x, float) and x.is_integer() \
+                        and abs(x) < 2**53:
+                    return int(x)
+                if isinstance(x, list):
+                    return [conv(e) for e in x]
+                if isinstance(x, dict):
+                    return {k: conv(val) for k, val in x.items()
+                            if val is not UNDEFINED}
+                return x
+
+            if v is UNDEFINED:
+                return UNDEFINED
+            # real JS runtimes emit compact separators and raw unicode
+            return _json.dumps(conv(v), separators=(",", ":"),
+                               ensure_ascii=False)
+
+        def _json_parse(s):
+            import json as _json
+
+            def conv(x):
+                if isinstance(x, list):
+                    return [conv(e) for e in x]
+                if isinstance(x, dict):
+                    return {k: conv(val) for k, val in x.items()}
+                if isinstance(x, bool) or x is None:
+                    return x
+                if isinstance(x, (int, float)):
+                    return float(x)
+                return x
+
+            try:
+                return conv(_json.loads(to_string(s)))
+            except ValueError as e:
+                raise JSThrow(JSError("Error", f"JSON.parse: {e}"))
+
+        g.declare("JSON", {
+            "parse": native(_json_parse),
+            "stringify": native(_json_stringify),
+        })
         # note: `window` stays undeclared — `typeof window` must yield
         # "undefined" exactly like a non-browser JS runtime
 
@@ -1034,9 +1330,11 @@ class Interpreter:
         # may be declared later in the file)
         for node in program:
             if node[0] == "funcdecl":
-                _, name, params, body = node
+                name, params, body = node[1], node[2], node[3]
+                is_async = len(node) > 4 and node[4]
                 self.globals.declare(
-                    name, JSFunction(name, params, body, self.globals))
+                    name,
+                    JSFunction(name, params, body, self.globals, is_async))
         for node in program:
             if node[0] != "funcdecl":
                 self.exec_stmt(node, self.globals)
@@ -1051,10 +1349,23 @@ class Interpreter:
         tag = node[0]
         if tag == "expr":
             self.eval(node[1], env)
-        elif tag == "vardecl":
+        elif tag in ("vardecl", "vardecl_nosemi"):
             for name, init in node[2]:
-                env.declare(
-                    name, UNDEFINED if init is None else self.eval(init, env))
+                value = UNDEFINED if init is None else self.eval(init, env)
+                if isinstance(name, tuple):     # destructuring pattern
+                    kind, names = name
+                    if kind == "arraypat":
+                        for i, n in enumerate(names):
+                            env.declare(n, self._get_index(value, float(i)))
+                    else:                        # objpat
+                        for n in names:
+                            env.declare(
+                                n,
+                                value.get(n, UNDEFINED)
+                                if isinstance(value, dict) else UNDEFINED,
+                            )
+                else:
+                    env.declare(name, value)
         elif tag == "return":
             raise _Return(
                 UNDEFINED if node[1] is None else self.eval(node[1], env))
@@ -1097,19 +1408,40 @@ class Interpreter:
                 raise JSThrow(JSError(
                     "TypeError", f"{js_typeof(seq)} is not iterable"))
             for item in items:
-                if env.has(var):
-                    env.assign(var, item)
-                else:
-                    env.declare(var, item)
+                # per-iteration binding like `for (const c of …)`: closures
+                # created in the body capture THIS iteration's value, not
+                # the loop's final one (app.js wires one handler per card)
+                iter_env = Env(env)
+                iter_env.declare(var, item)
                 try:
-                    self.exec_block(body, env)
+                    self.exec_block(body, iter_env)
                 except _Break:
                     break
                 except _Continue:
                     continue
         elif tag == "funcdecl":
-            _, name, params, body = node
-            env.declare(name, JSFunction(name, params, body, env))
+            name, params, body = node[1], node[2], node[3]
+            is_async = len(node) > 4 and node[4]
+            env.declare(name, JSFunction(name, params, body, env, is_async))
+        elif tag == "try":
+            _, body, catch_name, catch_body, finally_body = node
+            # Python's try/finally gives the JS completion semantics for
+            # free: finally runs on return/break/continue AND on a throw
+            # escaping the catch block itself
+            try:
+                try:
+                    self.exec_block(body, env)
+                except JSThrow as e:
+                    if catch_body is None:
+                        raise
+                    if catch_name:
+                        env.declare(catch_name, e.value)
+                    self.exec_block(catch_body, env)
+            finally:
+                if finally_body is not None:
+                    self.exec_block(finally_body, env)
+        elif tag == "block":
+            self.exec_block(node[1], env)
         elif tag == "break":
             raise _Break()
         elif tag == "continue":
@@ -1135,9 +1467,34 @@ class Interpreter:
         if tag == "name":
             return env.lookup(node[1])
         if tag == "array":
-            return [self.eval(e, env) for e in node[1]]
+            out = []
+            for e in node[1]:
+                if e[0] == "spread":
+                    v = self.eval(e[1], env)
+                    if not isinstance(v, list):
+                        raise JSThrow(JSError(
+                            "TypeError", "spread of non-iterable"))
+                    out.extend(v)
+                else:
+                    out.append(self.eval(e, env))
+            return out
         if tag == "object":
-            return {k: self.eval(v, env) for k, v in node[1]}
+            out = {}
+            for k, v in node[1]:
+                if k is None and v[0] == "objspread":   # {...expr}
+                    src = self.eval(v[1], env)
+                    if isinstance(src, dict):
+                        out.update(src)
+                    elif src is not None and src is not UNDEFINED:
+                        raise JSInterpError(
+                            "object spread of non-object unsupported")
+                    continue
+                if isinstance(k, tuple) and k[0] == "computed":
+                    key = to_string(self.eval(k[1], env))
+                else:
+                    key = k
+                out[key] = self.eval(v, env)
+            return out
         if tag == "template":
             out = []
             for kind, payload in node[1]:
@@ -1150,6 +1507,23 @@ class Interpreter:
             return JSRegex(node[1], node[2])
         if tag == "funcexpr":
             return JSFunction(node[1], node[2], node[3], env)
+        if tag == "arrow":
+            return JSFunction(None, node[1], node[2], env, is_async=node[3])
+        if tag == "await":
+            v = self.eval(node[1], env)
+            if isinstance(v, JSPromise):
+                if v.state == "rejected":
+                    raise JSThrow(v.value)
+                return v.value
+            return v
+        if tag == "nullish":
+            left = self.eval(node[1], env)
+            if left is None or left is UNDEFINED:
+                return self.eval(node[2], env)
+            return left
+        if tag in _CHAIN_TAGS:
+            v = self._chain_value(node, env)
+            return UNDEFINED if v is _SHORT else v
         if tag == "cond":
             return (self.eval(node[2], env) if truthy(self.eval(node[1], env))
                     else self.eval(node[3], env))
@@ -1188,21 +1562,21 @@ class Interpreter:
             old = to_number(self.eval(target, env))
             self._store(target, old + 1, env)
             return old
-        if tag == "member":
-            return self._member(self.eval(node[1], env), node[2])
-        if tag == "index":
-            obj = self.eval(node[1], env)
-            key = self.eval(node[2], env)
-            return self._get_index(obj, key)
-        if tag == "call":
-            return self._eval_call(node, env)
         if tag == "new":
             _, callee, args = node
             kind = self.eval(callee, env)
             if kind in ("TypeError", "Error"):
                 msg = to_string(self.eval(args[0], env)) if args else ""
                 return JSError(kind, msg)
-            raise JSInterpError("`new` supports only Error/TypeError")
+            ctor = getattr(kind, "js_construct", None)
+            if ctor is None and isinstance(kind, dict):
+                ctor = kind.get("__construct__")
+            if ctor is not None:
+                return self.call_function(
+                    ctor, self._eval_args(args, env))
+            raise JSInterpError(
+                "`new` target has no constructor (Error/TypeError/"
+                "host __construct__ only)")
         raise JSInterpError(f"unknown expression {tag}")
 
     def _assign(self, node, env):
@@ -1300,13 +1674,21 @@ class Interpreter:
         if isinstance(obj, list):
             if name == "length":
                 return float(len(obj))
-            return _BoundMethod(obj, name)
+            # non-method property on an array reads undefined in JS (so
+            # `x.message || fallback` falls through instead of yielding a
+            # truthy bound method)
+            return _BoundMethod(obj, name) if name in _ARRAY_METHODS \
+                else UNDEFINED
         if isinstance(obj, str):
             if name == "length":
                 return float(len(obj))
-            return _BoundMethod(obj, name)
+            return _BoundMethod(obj, name) if name in _STRING_METHODS \
+                else UNDEFINED
         if isinstance(obj, JSRegex):
-            return _BoundMethod(obj, name)
+            return _BoundMethod(obj, name) if name == "test" else UNDEFINED
+        if isinstance(obj, JSPromise):
+            return _BoundMethod(obj, name) if name in _PROMISE_METHODS \
+                else UNDEFINED
         if isinstance(obj, JSError):
             if name == "message":
                 return obj.message
@@ -1319,9 +1701,59 @@ class Interpreter:
         raise JSInterpError(
             f"property {name!r} on {type(obj).__name__} unsupported")
 
+    def _chain_value(self, node, env):
+        """Evaluate a member/index/call chain with JS optional-chaining
+        semantics: one nullish base at a `?.` short-circuits the WHOLE
+        remaining chain (`a?.b.c` is undefined when a is null, it does not
+        throw on `.c`)."""
+        tag = node[0]
+        if tag not in _CHAIN_TAGS:
+            return self.eval(node, env)
+        base = self._chain_value(node[1], env)
+        if base is _SHORT:
+            return _SHORT
+        if tag == "member":
+            return self._member(base, node[2])
+        if tag == "optmember":
+            if base is None or base is UNDEFINED:
+                return _SHORT
+            return self._member(base, node[2])
+        if tag == "index":
+            return self._get_index(base, self.eval(node[2], env))
+        if tag == "call":
+            return self.call_function(base, self._eval_args(node[2], env))
+        if tag == "optcall":
+            if base is None or base is UNDEFINED:
+                return _SHORT
+            return self.call_function(base, self._eval_args(node[2], env))
+        if tag == "optmethod":
+            if base is None or base is UNDEFINED:
+                return _SHORT
+            fn = self._member(base, node[2])
+            if fn is None or fn is UNDEFINED:
+                # JS: o?.m() with o non-null but m missing THROWS — the
+                # optionality guards o, not m
+                raise JSThrow(JSError(
+                    "TypeError", f"{node[2]} is not a function"))
+            return self.call_function(fn, self._eval_args(node[3], env))
+        raise JSInterpError(f"unknown chain op {tag}")
+
+    def _eval_args(self, arg_nodes, env):
+        args = []
+        for a in arg_nodes:
+            if a[0] == "spread":
+                v = self.eval(a[1], env)
+                if not isinstance(v, list):
+                    raise JSThrow(JSError(
+                        "TypeError", "spread of non-iterable"))
+                args.extend(v)
+            else:
+                args.append(self.eval(a, env))
+        return args
+
     def _eval_call(self, node, env):
         _, callee, arg_nodes = node
-        args = [self.eval(a, env) for a in arg_nodes]
+        args = self._eval_args(arg_nodes, env)
         fn = self.eval(callee, env)
         return self.call_function(fn, args)
 
@@ -1330,6 +1762,17 @@ class Interpreter:
             local = Env(fn.env)
             for i, p in enumerate(fn.params):
                 local.declare(p, args[i] if i < len(args) else UNDEFINED)
+            if fn.is_async:
+                # synchronous promise model: the body runs to completion
+                # now; a throw becomes a rejected promise
+                try:
+                    try:
+                        self.exec_block(fn.body, local)
+                        return JSPromise.resolve(UNDEFINED)
+                    except _Return as r:
+                        return JSPromise.resolve(r.value)
+                except JSThrow as e:
+                    return JSPromise.reject(e.value)
             try:
                 self.exec_block(fn.body, local)
             except _Return as r:
@@ -1361,7 +1804,34 @@ class _BoundMethod:
             if name == "test":
                 return o.rx.search(to_string(args[0])) is not None
             raise JSInterpError(f"regex method {name} unsupported")
+        if isinstance(o, JSPromise):
+            return self._promise(interp, o, name, args)
         raise JSInterpError(f"method {name} on {type(o).__name__}")
+
+    @staticmethod
+    def _promise(interp, p, name, args):
+        cb = args[0] if args else UNDEFINED
+        if name == "then":
+            if p.state == "fulfilled" and cb is not UNDEFINED:
+                try:
+                    return JSPromise.resolve(
+                        interp.call_function(cb, [p.value]))
+                except JSThrow as e:
+                    return JSPromise.reject(e.value)
+            return p
+        if name == "catch":
+            if p.state == "rejected" and cb is not UNDEFINED:
+                try:
+                    return JSPromise.resolve(
+                        interp.call_function(cb, [p.value]))
+                except JSThrow as e:
+                    return JSPromise.reject(e.value)
+            return p
+        if name == "finally":
+            if cb is not UNDEFINED:
+                interp.call_function(cb, [])
+            return p
+        raise JSInterpError(f"promise method {name} unsupported")
 
     @staticmethod
     def _string(interp, s, name, args):
@@ -1389,6 +1859,39 @@ class _BoundMethod:
             return s.split(sep)
         if name == "slice":
             return _BoundMethod._slice(s, args)
+        if name == "replace":
+            pat, repl = args[0], args[1]
+
+            def apply(match_text):
+                if isinstance(repl, str):
+                    return repl  # no $-substitution patterns in our files
+                return to_string(interp.call_function(repl, [match_text]))
+
+            if isinstance(pat, JSRegex):
+                count = 0 if "g" in pat.flags else 1
+                return pat.rx.sub(lambda m: apply(m.group(0)), s,
+                                  count=count)
+            # string pattern: JS replaces the FIRST occurrence only
+            pat_s = to_string(pat)
+            idx = s.find(pat_s)
+            if idx == -1:
+                return s
+            return s[:idx] + apply(pat_s) + s[idx + len(pat_s):]
+        if name == "padStart":
+            width = int(to_number(args[0]))
+            fill = to_string(args[1]) if len(args) > 1 else " "
+            need = width - len(s)
+            if need <= 0 or fill == "":   # empty fill: JS returns s as-is
+                return s
+            pad = (fill * (need // len(fill) + 1))[:need]
+            return pad + s
+        if name == "repeat":
+            return s * int(to_number(args[0]))
+        if name == "indexOf":
+            return float(s.find(to_string(args[0])))
+        if name == "charAt":
+            i = int(to_number(args[0]))
+            return s[i] if 0 <= i < len(s) else ""
         raise JSInterpError(f"string method {name} unsupported")
 
     @staticmethod
@@ -1420,6 +1923,40 @@ class _BoundMethod:
             return arr
         if name == "slice":
             return _BoundMethod._slice(arr, args)
+        if name in ("map", "forEach", "filter", "find", "some"):
+            cb = args[0]
+            if name == "map":
+                return [interp.call_function(cb, [e, float(i)])
+                        for i, e in enumerate(arr)]
+            if name == "forEach":
+                for i, e in enumerate(arr):
+                    interp.call_function(cb, [e, float(i)])
+                return UNDEFINED
+            if name == "filter":
+                return [e for i, e in enumerate(arr)
+                        if truthy(interp.call_function(cb, [e, float(i)]))]
+            if name == "find":
+                for i, e in enumerate(arr):
+                    if truthy(interp.call_function(cb, [e, float(i)])):
+                        return e
+                return UNDEFINED
+            for i, e in enumerate(arr):
+                if truthy(interp.call_function(cb, [e, float(i)])):
+                    return True
+            return False
+        if name == "concat":
+            out = list(arr)
+            for a in args:
+                if isinstance(a, list):
+                    out.extend(a)
+                else:
+                    out.append(a)
+            return out
+        if name == "indexOf":
+            for i, e in enumerate(arr):
+                if strict_eq(e, args[0]):
+                    return float(i)
+            return -1.0
         raise JSInterpError(f"array method {name} unsupported")
 
     @staticmethod

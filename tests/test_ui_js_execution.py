@@ -287,6 +287,59 @@ class TestInterpreterSemantics:
         assert env.lookup("b") is True          # SameValueZero finds NaN
         assert env.lookup("c") is False
 
+    def test_forof_closures_capture_per_iteration_bindings(self):
+        """`for (const c of …)` creates a binding per iteration — app.js
+        wires one open/delete handler per cluster card; all capturing the
+        final value would act on the wrong cluster."""
+        env = self.run('''
+            const fns = [];
+            for (const c of ["a", "b", "c"]) { fns.push(() => c); }
+            const got = fns.map((f) => f());
+        ''')
+        assert env.lookup("got") == ["a", "b", "c"]
+
+    def test_try_finally_runs_on_return_and_rethrow(self):
+        env = self.run('''
+            let log = [];
+            function f() {
+              try { return 1; } finally { log.push("fin"); }
+            }
+            const r = f();
+            function g() {
+              try { throw new Error("x"); }
+              catch (e) { throw new Error("y"); }
+              finally { log.push("fin2"); }
+            }
+            let caught = "";
+            try { g(); } catch (e) { caught = e.message; }
+        ''')
+        assert env.lookup("r") == 1
+        assert env.lookup("log") == ["fin", "fin2"]
+        assert env.lookup("caught") == "y"
+
+    def test_optional_chain_short_circuits_whole_chain(self):
+        env = self.run('''
+            const n = null;
+            const a = n?.b.c;
+            const o = { x: 1 };
+            let threw = "";
+            try { o?.missing(); } catch (e) { threw = e.message; }
+        ''')
+        assert env.lookup("a") is UNDEFINED    # no throw on .c
+        assert "not a function" in env.lookup("threw")
+
+    def test_json_stringify_is_compact_and_unicode(self):
+        env = self.run('const s = JSON.stringify({a: 1, b: "中文"});')
+        assert env.lookup("s") == '{"a":1,"b":"中文"}'
+
+    def test_non_method_property_on_string_is_undefined(self):
+        """app.js relies on `data.message || resp.statusText` falling
+        through when the error body is a plain string."""
+        env = self.run('const s = "oops"; const m = s.message ?? "fb";'
+                       'const t = s.message || "fallback";')
+        assert env.lookup("m") == "fb"
+        assert env.lookup("t") == "fallback"
+
     def test_numeric_string_coercion_follows_js_not_python(self):
         env = self.run('const a = Number("1_5"); const b = Number("inf");'
                        'const c = Number("0x10"); const d = Number("Infinity");'
@@ -304,13 +357,24 @@ class TestInterpreterSemantics:
         assert env.lookup("c") == 5             # Object.keys round-trip
 
     def test_strict_grammar_rejects_unknown_constructs(self):
+        """Arrows/async/optional-chaining joined the subset for app.js
+        execution; everything still outside it must fail loudly, never
+        silently mis-execute."""
         from kubeoperator_tpu.ui.jsinterp import JSInterpError
 
         for bad in (
-            "const a = x => x;",             # arrow functions not in subset
             "const a = 1 == 1;",             # loose equality banned
             "label: for (;;) { break label; }",
-            "async function f() {}",
+            "class Foo {}",
+            "function* gen() { yield 1; }",
+            "const a = [2, 1].sort((x, y) => x - y);",  # comparator unsupported
+            "with (Math) { floor(1.5); }",
         ):
             with pytest.raises(JSInterpError):
                 self.run(bad)
+        # an unknown METHOD is not a grammar error — it reads undefined
+        # and throws a faithful JS TypeError at the call, like a browser
+        from kubeoperator_tpu.ui.jsinterp import JSThrow
+
+        with pytest.raises(JSThrow, match="not a function"):
+            self.run("const a = `x`.matchAll(/x/g);")
