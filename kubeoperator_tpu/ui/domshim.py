@@ -331,11 +331,16 @@ class BrowserHarness:
             fired += 1
         return fired
 
-    def push_sse(self, es: dict, data: str, event: str = "message"):
-        """Deliver a server-sent event to an interpreted EventSource."""
+    def push_sse(self, es: dict, data: str = "", event: str = "message"):
+        """Deliver a server-sent event (or error/open) to an interpreted
+        EventSource: `on<event>` property first, then addEventListener
+        registrations — like the real object."""
+        if es.get("readyState") == 2.0:
+            return  # real EventSources drop events after close()
         payload = {"data": data}
-        if event == "message" and es.get("onmessage"):
-            self.interp.call_function(es["onmessage"], [payload])
+        prop = es.get("on" + event)
+        if prop not in (None, UNDEFINED):
+            self.interp.call_function(prop, [payload])
         for fn in es["__handlers__"].get(event, []):
             self.interp.call_function(fn, [payload])
 

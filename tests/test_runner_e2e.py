@@ -153,6 +153,32 @@ class TestNorthStarOverGrpcRunner:
         assert svc.clusters.get("manual-grpc").status.phase == "Ready"
 
 
+class TestConcurrentCreatesOverOneRunner:
+    def test_three_parallel_creates_share_the_runner(self, grpc_stack):
+        """§5.2 across the process boundary: concurrent cluster creates
+        multiplex one runner's gRPC server (parallel Run/Watch streams);
+        every phase of every cluster lands in the remote registry and all
+        clusters reach Ready."""
+        svc, _proc, _port = grpc_stack
+        from kubeoperator_tpu.models import ClusterSpec
+
+        svc.credentials.create(Credential(name="ssh", password="pw"))
+        for i in range(6):
+            svc.hosts.register(f"ch{i}", f"10.1.0.{i+1}", "ssh")
+        for c in range(3):
+            svc.clusters.create(
+                f"storm-{c}", spec=ClusterSpec(worker_count=1),
+                host_names=[f"ch{2*c}", f"ch{2*c+1}"], wait=False,
+            )
+        svc.clusters.wait_all(timeout_s=120)
+        phases = 0
+        for c in range(3):
+            cluster = svc.clusters.get(f"storm-{c}")
+            assert cluster.status.phase == "Ready", cluster.status.message
+            phases += len(cluster.status.conditions)
+        assert svc.executor.task_stats()["started_total"] == phases
+
+
 class TestRunnerKillResumeDrill:
     def test_kill_mid_create_then_retry_on_restarted_runner(self, tmp_path):
         port = _free_port()

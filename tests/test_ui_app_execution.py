@@ -162,6 +162,96 @@ class TestDeleteFlow:
             services.clusters.get("demo")
 
 
+class TestSseStreamGlue:
+    """The trickiest client logic — SSE cursor carry, reconnect backoff,
+    gap markers — executed from the genuine app.js bytes. Events are
+    pushed into the interpreted EventSource stubs; the terminal session
+    itself is created over the real REST API (a real /bin/bash PTY)."""
+
+    def _open_detail(self, h):
+        login(h)
+        card = h.element("#cluster-list")["__children__"][0]
+        h.fire(card["querySelector"]("[data-open]"), "click")
+
+    def test_log_stream_appends_filtered_lines(self, console):
+        h, _ = console
+        self._open_detail(h)
+        es = next(e for e in h.event_sources if "/logs?" in e["url"])
+        assert "/api/v1/clusters/demo/logs?follow=1" == es["url"]
+        h.element("#d-log-filter")["value"] = "etcd"
+        h.push_sse(es, '{"line": "TASK [etcd] install etcd"}')
+        h.push_sse(es, '{"line": "TASK [cni] calico manifests"}')
+        h.push_sse(es, '{"line": "ok: etcd healthy"}')
+        box = h.element("#d-logs")["textContent"]
+        # the filter ran per-line through interpreted logic.js
+        assert "install etcd" in box and "etcd healthy" in box
+        assert "calico" not in box
+        h.push_sse(es, "", event="end")
+        assert es["readyState"] == 2.0  # closed by the end handler
+
+    def test_terminal_stream_cursor_reconnect_and_gap(self, console):
+        h, _ = console
+        self._open_detail(h)
+        h.click("#d-term-open")  # real POST -> real PTY session
+        assert h.element("#d-term")["hidden"] is False
+        assert h.element("#d-term-open")["disabled"] is True
+        es1 = next(e for e in h.event_sources if "/output?" in e["url"])
+        assert "after=-1" in es1["url"]
+        h.push_sse(es1, '{"data": "shell$ ", "seq": 7}')
+        h.push_sse(es1, '{"data": "ls\\n", "seq": 8}')
+        out = h.element("#d-term-out")["textContent"]
+        assert out == "shell$ ls\n"
+        # scrollback-cap gap renders a marker, never a silent splice
+        h.push_sse(es1, '{"missed": 3}', event="gap")
+        assert "3 output chunk(s) dropped" in \
+            h.element("#d-term-out")["textContent"]
+        # idle-timeout end (alive) -> immediate reconnect CARRYING the
+        # cursor, so nothing replays
+        h.push_sse(es1, '{"alive": true}', event="end")
+        es2 = [e for e in h.event_sources if "/output?" in e["url"]][-1]
+        assert es2 is not es1 and "after=8" in es2["url"]
+        # dead shell -> stop: no further stream, button re-enabled
+        h.push_sse(es2, '{"alive": false}', event="end")
+        assert [e for e in h.event_sources if "/output?" in e["url"]][-1] \
+            is es2
+        assert h.element("#d-term-open")["disabled"] is False
+
+    def test_terminal_error_backoff_reconnects_then_gives_up(self, console):
+        h, _ = console
+        self._open_detail(h)
+        h.click("#d-term-open")
+        streams = lambda: [e for e in h.event_sources
+                           if "/output?" in e["url"]]
+        first = len(streams())
+        # each error schedules a backed-off reconnect timer; flushing it
+        # opens the next stream — 5 retries, then stop
+        oneshots = lambda: [t for t in h.timers if not t["repeat"]]
+        for i in range(5):
+            h.push_sse(streams()[-1], "", event="error")
+            retry = oneshots()
+            assert len(retry) == 1
+            assert retry[0]["ms"] == 500.0 * (i + 1)   # backed-off
+            h.flush_timers()
+            assert len(streams()) == first + i + 1
+        h.push_sse(streams()[-1], "", event="error")
+        assert oneshots() == []                    # gave up
+        assert h.element("#d-term-open")["disabled"] is False
+
+    def test_closing_detail_cancels_streams_and_timers(self, console):
+        h, _ = console
+        self._open_detail(h)
+        h.click("#d-term-open")
+        term = [e for e in h.event_sources if "/output?" in e["url"]][-1]
+        h.push_sse(term, "", event="error")        # pending retry timer
+        assert h.timers
+        h.click("#d-back")
+        # an orphaned reconnect must never resurrect and steal the next
+        # terminal's stream (app.js closeDetail contract)
+        assert not any(t for t in h.timers if not t["repeat"])
+        log = next(e for e in h.event_sources if "/logs?" in e["url"])
+        assert log["readyState"] == 2.0
+
+
 class TestI18nToggle:
     def test_language_switch_relabels_registered_nodes(self, console):
         h, _ = console
