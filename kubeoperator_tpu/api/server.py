@@ -39,6 +39,24 @@ AUTH_EXEMPT = {("POST", "/api/v1/auth/login"), ("GET", "/api/v1/version"),
 
 
 # ---------------------------------------------------------------- helpers ----
+def require_fields(body, *names: str) -> tuple:
+    """Pull required JSON body fields; a missing/empty field or a
+    non-object body is a 400 with the field named — never a KeyError/
+    AttributeError surfacing as ERR_INTERNAL (the whole class, fixed
+    once, not per-endpoint)."""
+    from kubeoperator_tpu.utils.errors import ValidationError
+
+    if not isinstance(body, dict):
+        raise ValidationError("request body must be a JSON object")
+    values = []
+    for name in names:
+        value = body.get(name)
+        if value is None or value == "":
+            raise ValidationError(f"body needs {name!r}")
+        values.append(value)
+    return tuple(values)
+
+
 def json_response(data, status: int = 200) -> web.Response:
     return web.json_response(data, status=status, dumps=functools.partial(
         json.dumps, default=str))
@@ -557,8 +575,9 @@ class Handlers:
     # ---- upgrade (§3.4) ----
     async def upgrade(self, request):
         body = await request.json()
+        (version,) = require_fields(body, "version")
         cluster = await run_sync(request, self.s.upgrades.upgrade,
-                                 request.match_info["name"], body["version"])
+                                 request.match_info["name"], version)
         return json_response(cluster.to_public_dict())
 
     # ---- backup (§3.5) ----
@@ -598,8 +617,9 @@ class Handlers:
 
     async def restore(self, request):
         body = await request.json()
+        (file_name,) = require_fields(body, "file")
         await run_sync(request, self.s.backups.restore,
-                       request.match_info["name"], body["file"])
+                       request.match_info["name"], file_name)
         return json_response({"ok": True})
 
     async def app_backup(self, request):
@@ -612,8 +632,9 @@ class Handlers:
 
     async def app_restore(self, request):
         body = await request.json()
+        (backup,) = require_fields(body, "backup")
         await run_sync(request, self.s.backups.app_restore,
-                       request.match_info["name"], body["backup"])
+                       request.match_info["name"], backup)
         return json_response({"ok": True})
 
     async def backup_strategy(self, request):
@@ -624,9 +645,10 @@ class Handlers:
                 strategy.to_public_dict() if strategy else None
             )
         body = await request.json()
+        (account,) = require_fields(body, "account")
         strategy = await run_sync(
             request, self.s.backups.set_strategy,
-            request.match_info["name"], body["account"],
+            request.match_info["name"], account,
             body.get("cron", "0 3 * * *"), body.get("save_num", 7),
             body.get("enabled", True),
         )
@@ -678,8 +700,9 @@ class Handlers:
 
     async def install_component(self, request):
         body = await request.json()
+        (component,) = require_fields(body, "component")
         comp = await run_sync(request, self.s.components.install,
-                              request.match_info["name"], body["component"],
+                              request.match_info["name"], component,
                               body.get("vars"))
         return json_response(comp.to_public_dict(), status=201)
 

@@ -20,6 +20,7 @@ from kubeoperator_tpu.parallel.topology import GENERATIONS
 BUNDLED_MANIFESTS = (
     "calico-crds.yaml",
     "metrics-server.yaml",
+    "node-problem-detector.yaml",
     "ingress-nginx.yaml",
     "traefik.yaml",
     "jobset-controller.yaml",

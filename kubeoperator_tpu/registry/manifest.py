@@ -77,6 +77,10 @@ def bundle_manifest() -> dict:
         "images/grafana.tar",
         "images/loki.tar",
         f"images/kube-bench-{COMPONENT_VERSIONS['kube_bench']}.tar",
+        # consumed-as-artifact like metrics-server: the prebuilt manifest
+        # carries its own image tag, so no pin is CLAIMED here — a pin the
+        # applied manifest doesn't consume would be drift, not truth
+        "images/node-problem-detector.tar",
         "images/nfs-subdir-external-provisioner.tar",
         f"images/vsphere-csi-driver-{COMPONENT_VERSIONS['vsphere_csi']}.tar",
         f"images/vsphere-csi-syncer-{COMPONENT_VERSIONS['vsphere_csi']}.tar",

@@ -111,6 +111,39 @@ class TestPlatformMetrics:
         finally:
             services.config._data["server"]["metrics_token"] = ""
 
+    def test_component_install_malformed_body_is_400(self, client):
+        """POST components without the 'component' field must 400 with
+        the field named, not KeyError into ERR_INTERNAL (found by a live
+        console drive)."""
+        base, http, services = client
+        services.credentials.create(__import__(
+            "kubeoperator_tpu.models", fromlist=["Credential"]
+        ).Credential(name="cmpssh", password="pw"))
+        for i in range(2):
+            services.hosts.register(f"cmp{i}", f"10.8.0.{i+1}", "cmpssh")
+        from kubeoperator_tpu.models import ClusterSpec
+
+        services.clusters.create(
+            "cmp", spec=ClusterSpec(worker_count=1),
+            host_names=["cmp0", "cmp1"], wait=True,
+        )
+        r = http.post(f"{base}/api/v1/clusters/cmp/components",
+                      json={"nope": 1})
+        assert r.status_code == 400
+        assert "component" in r.json()["message"]
+        # the whole input class (require_fields): non-object bodies and
+        # sibling endpoints' missing fields are 400s too, never 500s
+        r = http.post(f"{base}/api/v1/clusters/cmp/components", json=[1])
+        assert r.status_code == 400
+        for path, body in (
+            (f"{base}/api/v1/clusters/cmp/upgrade", {}),
+            (f"{base}/api/v1/clusters/cmp/restore", {}),
+            (f"{base}/api/v1/clusters/cmp/app-restore", {}),
+            (f"{base}/api/v1/clusters/cmp/backup-strategy", {}),
+        ):
+            resp = http.post(path, json=body)
+            assert resp.status_code == 400, (path, resp.status_code)
+
     def test_audit_limit_rejects_garbage_with_400(self, client):
         """GET /api/v1/audit?limit=abc is a 400 with the field named, not
         an ERR_INTERNAL 500 (ADVICE r4); valid limits clamp to 1..1000."""

@@ -179,6 +179,55 @@ class TestConcurrentCreatesOverOneRunner:
         assert svc.executor.task_stats()["started_total"] == phases
 
 
+class TestDayTwoOverGrpcRunner:
+    def test_upgrade_backup_restore_cross_the_boundary(self, grpc_stack):
+        """Day-2 depth across the process boundary: the upgrade's
+        attestation marker and the restore's data sentinel both originate
+        in the RUNNER process, stream back over Watch, and are parsed by
+        the server-side post hooks — the full marker contract crossing
+        gRPC, not an in-process shortcut."""
+        svc, _proc, _port = grpc_stack
+        from kubeoperator_tpu.models import BackupAccount, ClusterSpec
+
+        svc.credentials.create(Credential(name="ssh", password="pw"))
+        for i in range(2):
+            svc.hosts.register(f"d2h{i}", f"10.2.0.{i+1}", "ssh")
+        svc.clusters.create(
+            "d2", spec=ClusterSpec(worker_count=1),
+            host_names=["d2h0", "d2h1"], wait=True,
+        )
+        baseline_tasks = svc.executor.task_stats()["started_total"]
+
+        # upgrade: masters/workers/verify phases run remotely; the
+        # KO_TPU_UPGRADE_VERIFY attestation crosses the stream
+        from kubeoperator_tpu.registry.manifest import SUPPORTED_K8S_VERSIONS
+
+        cluster = svc.clusters.get("d2")
+        from_v = cluster.spec.k8s_version
+        idx = SUPPORTED_K8S_VERSIONS.index(from_v)
+        to_v = SUPPORTED_K8S_VERSIONS[idx + 1]
+        svc.upgrades.upgrade("d2", to_v)
+        cluster = svc.clusters.get("d2")
+        assert cluster.spec.k8s_version == to_v != from_v
+        assert cluster.status.condition("upgrade-verify").status == "OK"
+
+        # backup writes the sentinel remotely; restore reads it back
+        # remotely and restore_verify_post matches it server-side
+        svc.backups.create_account(BackupAccount(
+            name="acct", type="local", bucket="b",
+            vars={"dir": "/tmp"},
+        ))
+        record = svc.backups.run_backup("d2", "acct")
+        assert record.status == "Uploaded" and record.has_sentinel
+        svc.backups.restore("d2", record.name)
+        cluster = svc.clusters.get("d2")
+        assert cluster.status.condition("restore-verify").status == "OK"
+
+        done_tasks = svc.executor.task_stats()["started_total"]
+        assert done_tasks > baseline_tasks  # all of it ran in the runner
+        assert svc.executor._tasks == {}    # none of it ran in-process
+
+
 class TestRunnerKillResumeDrill:
     def test_kill_mid_create_then_retry_on_restarted_runner(self, tmp_path):
         port = _free_port()

@@ -843,6 +843,26 @@ class TestComponents:
         with pytest.raises(ValidationError):
             svc.components.install("comp", "gpu")
 
+    def test_node_problem_detector_install_and_uninstall(self, svc):
+        """Upstream-addon parity: npd installs from the bundled manifest,
+        its verify task gates on detector conditions (not pod Running),
+        and uninstall runs the declared manifest teardown."""
+        names = register_fleet(svc, 2)
+        svc.clusters.create("npd", spec=ClusterSpec(worker_count=1),
+                            host_names=names, wait=True)
+        component = svc.components.install("npd", "node-problem-detector")
+        assert component.status == "Installed"
+        logs = "\n".join(
+            rec.line for rec in svc.repos.task_logs.find(
+                cluster_id=svc.clusters.get("npd").id)
+        )
+        assert "apply node-problem-detector manifests" in logs
+        svc.components.uninstall("npd", "node-problem-detector")
+        assert "node-problem-detector" not in [
+            c.name for c in svc.components.list("npd")
+            if c.status == "Installed"
+        ]
+
     def test_observability_components_run_their_operational_tasks(self, svc):
         """The monitoring/ingress roles are operations, not bare helm
         one-liners: datasource provisioning, admin-secret generation path,
