@@ -11,6 +11,7 @@ from kubeoperator_tpu.adm.phases import (
     backup_phases,
     cert_renew_phases,
     encryption_rotate_phases,
+    etcd_maintenance_phases,
     create_phases,
     reset_phases,
     restore_phases,
@@ -23,5 +24,5 @@ __all__ = [
     "AdmContext", "ClusterAdm", "Phase",
     "create_phases", "upgrade_phases", "scale_up_phases", "scale_down_phases",
     "backup_phases", "restore_phases", "reset_phases", "cert_renew_phases",
-    "encryption_rotate_phases",
+    "encryption_rotate_phases", "etcd_maintenance_phases",
 ]

@@ -322,6 +322,47 @@ def restore_phases() -> list[Phase]:
     ]
 
 
+ETCD_MAINT_MARKER = "KO_TPU_ETCD_MAINT"
+
+
+def etcd_maintenance_post(
+    ctx: AdmContext, result: TaskResult, lines: list[str]
+) -> None:
+    """Maintenance is done when the WHOLE quorum answers healthy after the
+    serial defrag — attested, not assumed from rc. The parsed sizes ride
+    back to the service via ctx (the one channel a post hook has) so the
+    completion event can say what the operation achieved."""
+    data = parse_marker_json(ETCD_MAINT_MARKER, lines)
+    if data is None:
+        raise PhaseError(
+            "etcd-maintenance", "no maintenance attestation in phase output"
+        )
+    if data.get("healthy") is not True:
+        raise PhaseError(
+            "etcd-maintenance",
+            "quorum unhealthy after defrag — member(s) did not recover",
+        )
+    try:
+        members = int(data.get("members"))
+    except (TypeError, ValueError):
+        raise PhaseError(
+            "etcd-maintenance", f"malformed attestation: {data!r}"
+        )
+    expected = len([n for n in ctx.nodes if n.role == "master"])
+    if expected and members != expected:
+        raise PhaseError(
+            "etcd-maintenance",
+            f"attestation covers {members} members, cluster has {expected}",
+        )
+    ctx.extra_vars["__etcd_maint_result__"] = data
+
+
+def etcd_maintenance_phases() -> list[Phase]:
+    """Day-2 etcd defrag + alarm clear (playbook 26)."""
+    return [Phase("etcd-maintenance", "26-etcd-maintenance.yml",
+                  post=etcd_maintenance_post)]
+
+
 def encryption_rotate_phases() -> list[Phase]:
     """Day-2 secrets-at-rest key rotation (content playbook 25; pairs with
     the pki role's initial secretbox generation)."""

@@ -496,6 +496,11 @@ class Handlers:
                                  request.match_info["name"], False)
         return json_response(cluster.to_public_dict(), status=202)
 
+    async def etcd_maintenance(self, request):
+        cluster = await run_sync(request, self.s.clusters.etcd_maintenance,
+                                 request.match_info["name"], False)
+        return json_response(cluster.to_public_dict(), status=202)
+
     async def cluster_kubeconfig(self, request):
         cluster = await run_sync(request, self.s.clusters.get,
                                  request.match_info["name"])
@@ -1043,6 +1048,8 @@ def create_app(services: Services) -> web.Application:
                cluster_guard(h.rotate_encryption, manage))
     r.add_post("/api/v1/clusters/{name}/renew-certs",
                cluster_guard(h.renew_certs, manage))
+    r.add_post("/api/v1/clusters/{name}/etcd-maintenance",
+               cluster_guard(h.etcd_maintenance, manage))
     r.add_post("/api/v1/clusters/{name}/backup",
                cluster_guard(h.run_backup, manage))
     r.add_get("/api/v1/clusters/{name}/backups",

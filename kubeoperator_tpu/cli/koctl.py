@@ -182,6 +182,8 @@ class LocalClient:
                 return pub(s.clusters.rotate_encryption_key(name, wait=False))
             case ("POST", ["clusters", name, "renew-certs"]):
                 return pub(s.clusters.renew_certs(name, wait=False))
+            case ("POST", ["clusters", name, "etcd-maintenance"]):
+                return pub(s.clusters.etcd_maintenance(name, wait=False))
             case ("POST", ["clusters", name, "backup"]):
                 return pub(s.backups.run_backup(name, body.get("account", "")))
             case ("GET", ["clusters", name, "backups"]):
@@ -505,6 +507,10 @@ def cmd_cluster(client, args) -> int:
     if args.cluster_cmd == "renew-certs":
         _print(client.call("POST",
                            f"/api/v1/clusters/{args.name}/renew-certs"))
+        return 0
+    if args.cluster_cmd == "etcd-maint":
+        _print(client.call("POST",
+                           f"/api/v1/clusters/{args.name}/etcd-maintenance"))
         return 0
     if args.cluster_cmd == "backup":
         _print(client.call("POST", f"/api/v1/clusters/{args.name}/backup",
@@ -861,7 +867,7 @@ def build_parser() -> argparse.ArgumentParser:
     create.add_argument("--quiet", action="store_true")
     create.add_argument("--timeout", type=float, default=3600.0)
     for name in ("status", "delete", "logs", "events", "health",
-                 "renew-certs", "rotate-encryption", "trace"):
+                 "renew-certs", "rotate-encryption", "etcd-maint", "trace"):
         sp = csub.add_parser(name)
         sp.add_argument("name")
         if name == "logs":

@@ -19,6 +19,7 @@ from kubeoperator_tpu.adm import (
     cert_renew_phases,
     create_phases,
     encryption_rotate_phases,
+    etcd_maintenance_phases,
     reset_phases,
     scale_down_phases,
 )
@@ -341,71 +342,90 @@ class ClusterService:
         self._spawn(cluster.id, work, wait, pre_start=admit)
         return self.repos.clusters.get(cluster.id)
 
-    def renew_certs(self, name: str, wait: bool = False) -> Cluster:
-        """Day-2 PKI rotation (content playbook 24): rotate every
-        kubeadm-managed control-plane cert, masters serially. The rotation
-        replaces admin.conf, so the stored kubeconfig is refreshed from the
-        re-fetched copy afterwards."""
+    def _run_day2(self, name: str, *, action: str, require_msg: str,
+                  phases_fn, on_success, fail_reason: str,
+                  wait: bool) -> "Cluster":
+        """Shared scaffold for Ready-gated day-2 operations (cert renewal,
+        key rotation, etcd maintenance): one copy of the guard +
+        PhaseError/Exception handling + event emission + wait-reraise, so
+        a fix to the error path cannot be applied to some operations and
+        missed in others. `on_success(ctx)` returns (reason, message) and
+        may do the operation's post-work (e.g. kubeconfig refresh)."""
         cluster = self.get(name)
-        cluster.require_managed("cert renewal")
+        cluster.require_managed(action)
         if cluster.status.phase != ClusterPhaseStatus.READY.value:
-            raise ValidationError("cert renewal requires a Ready cluster")
+            raise ValidationError(require_msg)
         plan = self.repos.plans.get(cluster.plan_id) if cluster.plan_id else None
 
         def work():
             try:
                 ctx = self._context(cluster, plan)
-                self.adm.run(ctx, cert_renew_phases())
-                self._store_kubeconfig(cluster)
-                self.repos.clusters.save(cluster)
-                self.events.emit(cluster.id, "Normal", "CertsRenewed",
-                                 f"cluster {name} control-plane certs rotated")
+                self.adm.run(ctx, phases_fn())
+                reason, message = on_success(ctx)
+                self.events.emit(cluster.id, "Normal", reason, message)
             except PhaseError as e:
-                self.events.emit(cluster.id, "Warning", "CertRenewFailed",
+                self.events.emit(cluster.id, "Warning", fail_reason,
                                  f"phase {e.phase}: {e.message}")
                 if wait:
                     raise
             except Exception as e:
-                self.events.emit(cluster.id, "Warning", "CertRenewFailed", str(e))
+                self.events.emit(cluster.id, "Warning", fail_reason, str(e))
                 if wait:
                     raise
 
         self._spawn(cluster.id, work, wait)
         return self.repos.clusters.get(cluster.id)
+
+    def renew_certs(self, name: str, wait: bool = False) -> Cluster:
+        """Day-2 PKI rotation (content playbook 24): rotate every
+        kubeadm-managed control-plane cert, masters serially. The rotation
+        replaces admin.conf, so the stored kubeconfig is refreshed from the
+        re-fetched copy afterwards."""
+        def done(ctx):
+            self._store_kubeconfig(ctx.cluster)
+            self.repos.clusters.save(ctx.cluster)
+            return ("CertsRenewed",
+                    f"cluster {name} control-plane certs rotated")
+
+        return self._run_day2(
+            name, action="cert renewal",
+            require_msg="cert renewal requires a Ready cluster",
+            phases_fn=cert_renew_phases, on_success=done,
+            fail_reason="CertRenewFailed", wait=wait)
+
+    def etcd_maintenance(self, name: str, wait: bool = False) -> Cluster:
+        """Day-2 etcd defrag + alarm clear (content playbook 26): members
+        defragmented serially with a health gate between them; completion
+        rides the KO_TPU_ETCD_MAINT attestation (quorum healthy + member
+        count), and the event reports the observed db sizes."""
+        def done(ctx):
+            data = ctx.extra_vars.get("__etcd_maint_result__", {})
+            sizes = data.get("db_size_bytes") or []
+            detail = (f"db sizes {sizes} bytes"
+                      if sizes else "sizes unavailable (simulated)")
+            return ("EtcdMaintenanceDone",
+                    f"{data.get('members', '?')} member(s) defragmented, "
+                    f"alarms cleared; {detail}")
+
+        return self._run_day2(
+            name, action="etcd maintenance",
+            require_msg="etcd maintenance requires a Ready cluster",
+            phases_fn=etcd_maintenance_phases, on_success=done,
+            fail_reason="EtcdMaintenanceFailed", wait=wait)
 
     def rotate_encryption_key(self, name: str, wait: bool = False) -> Cluster:
         """Day-2 secrets-at-rest key rotation (content playbook 25): prepend
         a fresh secretbox key on every apiserver (old keys kept for
         decryption), restart them, then rewrite all secrets so they
         re-encrypt under the new key."""
-        cluster = self.get(name)
-        cluster.require_managed("encryption key rotation")
-        if cluster.status.phase != ClusterPhaseStatus.READY.value:
-            raise ValidationError("key rotation requires a Ready cluster")
-        plan = self.repos.plans.get(cluster.plan_id) if cluster.plan_id else None
-
-        def work():
-            try:
-                ctx = self._context(cluster, plan)
-                self.adm.run(ctx, encryption_rotate_phases())
-                self.repos.clusters.save(cluster)
-                self.events.emit(
-                    cluster.id, "Normal", "EncryptionKeyRotated",
-                    f"cluster {name} secrets-at-rest key rotated")
-            except PhaseError as e:
-                self.events.emit(cluster.id, "Warning",
-                                 "EncryptionKeyRotateFailed",
-                                 f"phase {e.phase}: {e.message}")
-                if wait:
-                    raise
-            except Exception as e:
-                self.events.emit(cluster.id, "Warning",
-                                 "EncryptionKeyRotateFailed", str(e))
-                if wait:
-                    raise
-
-        self._spawn(cluster.id, work, wait)
-        return self.repos.clusters.get(cluster.id)
+        return self._run_day2(
+            name, action="encryption key rotation",
+            require_msg="key rotation requires a Ready cluster",
+            phases_fn=encryption_rotate_phases,
+            on_success=lambda ctx: (
+                "EncryptionKeyRotated",
+                f"cluster {name} secrets-at-rest key rotated"),
+            fail_reason="EncryptionKeyRotateFailed", wait=wait)
 
     def delete(self, name: str, wait: bool = False) -> None:
         cluster = self.get(name)

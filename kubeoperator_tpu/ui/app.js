@@ -74,6 +74,7 @@ const I18N = {
     kubeconfig: "Kubeconfig", details: "Details",
     scale_slices: "＋ Add slices",
     renew_certs: "Renew certs", rotate_key: "Rotate secrets key",
+    etcd_maint: "etcd maintenance",
     import_cluster: "Import cluster",
     backup_schedule: "Schedule", retention: "Keep (count)", enabled: "Enabled",
     recover: "Recover", sign_out: "Sign out",
@@ -144,6 +145,7 @@ const I18N = {
     kubeconfig: "Kubeconfig", details: "详情",
     scale_slices: "＋ 扩容切片",
     renew_certs: "轮换证书", rotate_key: "轮换加密密钥",
+    etcd_maint: "etcd 维护",
     import_cluster: "导入集群",
     backup_schedule: "定时策略", retention: "保留份数", enabled: "启用",
     recover: "修复", sign_out: "退出登录",
@@ -388,7 +390,8 @@ async function openCluster(name) {
         ${me?.is_admin ? `<button id="d-kubeconfig">${t("kubeconfig")}</button>` : ""}
         ${me?.is_admin && !imported ? `
         <button id="d-renew-certs" class="ghost">${t("renew_certs")}</button>
-        <button id="d-rotate-key" class="ghost">${t("rotate_key")}</button>` : ""}
+        <button id="d-rotate-key" class="ghost">${t("rotate_key")}</button>
+        <button id="d-etcd-maint" class="ghost">${t("etcd_maint")}</button>` : ""}
         <button id="d-back">${t("back")}</button>
       </div>
     </div>
@@ -479,6 +482,12 @@ async function openCluster(name) {
     $("#d-rotate-key").addEventListener("click", async () => {
       if (!confirm(`${t("rotate_key")} — ${name}?`)) return;
       await api("POST", `/api/v1/clusters/${name}/rotate-encryption`);
+      openCluster(name);
+    });
+    $("#d-etcd-maint").addEventListener("click", async () => {
+      // NOSPACE recovery: defrag members serially + clear alarms
+      if (!confirm(`${t("etcd_maint")} — ${name}?`)) return;
+      await api("POST", `/api/v1/clusters/${name}/etcd-maintenance`);
       openCluster(name);
     });
   }

@@ -149,7 +149,10 @@ class BrowserHarness:
         handlers' promises resolve eagerly. Rejected handler promises are
         surfaced — a swallowed crash must fail the test."""
         results = []
-        for fn in el["__handlers__"].get(event, []):
+        # snapshot: a handler that re-renders (openCluster) re-registers
+        # listeners mid-dispatch; the real DOM never fires a listener
+        # added during the same event dispatch
+        for fn in list(el["__handlers__"].get(event, [])):
             r = self.interp.call_function(
                 fn, [payload if payload is not None else {}])
             if isinstance(r, JSPromise) and r.state == "rejected":

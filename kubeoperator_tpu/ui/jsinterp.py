@@ -1237,6 +1237,15 @@ class Interpreter:
             lambda *a: "" if not a else to_string(a[0]),
         ))
         g.declare("parseInt", native(self._parse_int))
+        g.declare("Boolean", _Callable(
+            "Boolean", lambda *a: truthy(a[0]) if a else False))
+
+        def _encode_uri_component(s=UNDEFINED):
+            import urllib.parse
+
+            return urllib.parse.quote(to_string(s), safe="!'()*-._~")
+
+        g.declare("encodeURIComponent", native(_encode_uri_component))
         g.declare("TypeError", "TypeError")   # constructor tag for `new`
         g.declare("Error", "Error")
         g.declare("globalThis", {})

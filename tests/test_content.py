@@ -1079,6 +1079,38 @@ def test_restore_verify_carries_restore_shaped_attestation():
     assert plays[0]["roles"] == ["restore-verify"]
 
 
+def test_etcd_maintenance_is_serial_with_health_gate():
+    """Defrag blocks the member: the playbook must run members one at a
+    time with a health gate between them, and the attestation must come
+    from a separate non-serial play (run_once in a serial play fires once
+    per batch)."""
+    with open(os.path.join(PLAYBOOKS, "26-etcd-maintenance.yml"),
+              encoding="utf-8") as f:
+        plays = yaml.safe_load(f)
+    assert plays[0]["serial"] == 1
+    assert plays[0]["roles"] == ["etcd-maintenance"]
+    assert "serial" not in plays[1]
+    assert plays[1]["roles"] == ["etcd-maintenance-report"]
+
+    tasks = _role_tasks("etcd-maintenance")
+    names = [t["name"] for t in tasks]
+    defrag = names.index("defragment this member")
+    gate = names.index("wait for this member healthy before the next one")
+    assert defrag < gate
+    assert tasks[gate]["retries"] >= 3
+    assert "alarm disarm" in str(tasks[names.index("clear standing alarms")])
+
+    report = _role_tasks("etcd-maintenance-report")
+    rnames = [t["name"] for t in report]
+    rep = report[rnames.index("report etcd maintenance")]
+    assert "KO_TPU_ETCD_MAINT" in str(rep)
+    for reg in ("ko_maint_health.rc", "ko_maint_sizes.stdout"):
+        assert reg in str(rep), reg
+    # no attestation beats a fake one: the size collection hard-fails
+    sizes = report[rnames.index("collect per-member db sizes")]
+    assert not sizes.get("ignore_errors")
+
+
 def test_reset_leaves_no_network_or_storage_residue():
     """A half reset poisons the NEXT cluster: CNI interfaces, ipvs tables,
     and rook's hostpath must all go; operator-owned firewall rules must

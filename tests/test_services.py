@@ -640,6 +640,32 @@ class TestEncryptionRotation:
         events = svc.events.list(cluster.id)
         assert any(e.reason == "EncryptionKeyRotated" for e in events)
 
+    def test_etcd_maintenance_runs_and_reports(self, svc):
+        """Day-2 defrag: serial member pass + attestation gate, the event
+        carries what the operation achieved; non-Ready clusters refused."""
+        names = register_fleet(svc, 3)
+        svc.clusters.create("maint", spec=ClusterSpec(worker_count=2),
+                            host_names=names, wait=True)
+        svc.clusters.etcd_maintenance("maint", wait=True)
+        cluster = svc.clusters.get("maint")
+        assert cluster.status.condition("etcd-maintenance").status == "OK"
+        events = {e.reason: e.message for e in svc.events.list(cluster.id)}
+        assert "EtcdMaintenanceDone" in events
+        assert "defragmented" in events["EtcdMaintenanceDone"]
+        # repeat runs are not a silent no-op (conditions reset)
+        svc.clusters.etcd_maintenance("maint", wait=True)
+        cluster = svc.clusters.get("maint")
+        assert cluster.status.condition("etcd-maintenance").status == "OK"
+
+    def test_etcd_maintenance_requires_ready(self, svc):
+        names = register_fleet(svc, 3)
+        svc.clusters.debug_extra_vars = {"__fail_at_task__": "install etcd"}
+        with pytest.raises(PhaseError):
+            svc.clusters.create("maint-bad", spec=ClusterSpec(worker_count=2),
+                                host_names=names, wait=True)
+        with pytest.raises(ValidationError):
+            svc.clusters.etcd_maintenance("maint-bad", wait=True)
+
     def test_rotation_requires_ready(self, svc):
         names = register_fleet(svc, 2)
         svc.clusters.debug_extra_vars = {"__fail_at_task__": "start etcd"}
