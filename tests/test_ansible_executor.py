@@ -197,6 +197,43 @@ class TestShimmedPipelineE2E:
         assert cap["env"]["ANSIBLE_HOST_KEY_CHECKING"] == "False"
         assert cap["env"]["ANSIBLE_ROLES_PATH"].endswith("roles")
 
+    @pytest.mark.parametrize("marker,payload", [
+        ("KO_TPU_SMOKE_RESULT",
+         {"gbps": 84.3, "chips": 16, "note": 'say "hi" \\ twice',
+          "train": {"ok": True, "losses": [2.1, 1.3]}}),
+        ("KO_TPU_UPGRADE_VERIFY",
+         {"target": "v1.30.6", "node_versions": ["v1.30.6"],
+          "nodes_ready": True, "path": "C:\\x"}),
+        ("KO_TPU_RESTORE_VERIFY",
+         {"sentinel": "etcd-demo.db", "k8s_version": "v1.30.6",
+          "node_count": 3, "etcd_healthy": True}),
+        ("KO_TPU_ETCD_MAINT",
+         {"members": 3, "db_size_bytes": [1, 2], "healthy": True}),
+    ])
+    def test_marker_contract_through_real_callback_replay(
+        self, shimmed_ansible, monkeypatch, marker, payload
+    ):
+        """VERDICT r4 #7, the shim-suite half: each marker rides the REAL
+        AnsibleExecutor pipeline (fork -> stream -> watch) through the
+        default callback's JSON-escaped debug-msg form — awkward payload
+        content included — and parse_marker_json recovers it exactly."""
+        from kubeoperator_tpu.adm.phases import parse_marker_json
+
+        raw = f"{marker} {json.dumps(payload)}"
+        monkeypatch.setenv("KO_SHIM_SCENARIO", "marker")
+        monkeypatch.setenv("KO_SHIM_MARKER_MSG", raw)
+        ex = self._executor()
+        task_id = ex.run(TaskSpec(
+            playbook="05-etcd.yml", inventory=_inventory(),
+            extra_vars={"k8s_version": "v1.29.4"},
+        ))
+        lines = list(ex.watch(task_id, timeout_s=60))
+        assert ex.result(task_id).ok
+        # the escaped form is what actually crossed the stream
+        assert any('"msg"' in line and marker in line for line in lines)
+        assert not any(raw in line for line in lines)  # never bare
+        assert parse_marker_json(marker, lines) == payload
+
     def test_failing_host_recap(self, shimmed_ansible, monkeypatch):
         monkeypatch.setenv("KO_SHIM_SCENARIO", "failed_host")
         ex = self._executor()
