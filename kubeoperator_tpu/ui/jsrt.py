@@ -24,8 +24,12 @@ _INT_RE = re.compile(r"-?[0-9]+")
 
 
 def parse_int(s):
-    """Strict base-10 int parse; None on anything else (JS: regex + parseInt)."""
-    t = str(s).strip()
+    """Strict base-10 int parse; None on anything else (JS: regex + parseInt).
+
+    Stringifies via to_str, not builtin str: the JS twin does String(s),
+    so parse_int(64.0) must see "64" (an int) on both sides — Python's
+    "64.0" would answer None while the browser answered 64 (r5 fuzz)."""
+    t = to_str(s).strip()
     if _INT_RE.fullmatch(t):
         return int(t)
     return None
@@ -109,28 +113,37 @@ def esc(x):
     Integral floats stringify WITHOUT the trailing .0 (JS has one number
     type: String(85.0) is "85") so a Python-side test can never pin output
     the browser would render differently."""
-    if x is None:
-        s = ""
-    elif x is True:
-        s = "true"
-    elif x is False:
-        s = "false"
-    elif isinstance(x, float) and not math.isinf(x) and not math.isnan(x) \
-            and x == math.floor(x) and abs(x) < 1e15:
-        s = str(int(x))
-    else:
-        s = str(x)
+    # one formatter: everything except the None->'' special case routes
+    # through to_str so esc and the browser-side String() cannot drift
+    s = "" if x is None else to_str(x)
     return (s.replace("&", "&amp;").replace("<", "&lt;")
              .replace(">", "&gt;").replace('"', "&quot;")
              .replace("'", "&#39;"))
 
 
 def to_str(x):
-    """str() twin: JS String(null) is 'null', so both sides map None->'None'."""
+    """The `_rt.str` twin (the prelude maps null/undefined to 'None' on
+    purpose — a Python-ism both sides share). Everything else follows JS
+    String(): numbers via the ECMAScript Number::toString algorithm
+    (delegated to jsinterp.num_to_string — ONE formatter to keep in
+    lock-step, not three approximations), arrays as join(','), objects as
+    '[object Object]'. The r5 seeded differential fuzz caught the builtin
+    -str() divergences this closes (String(100.0) is '100', not '100.0';
+    String(['a']) is 'a')."""
     if x is None:
         return "None"
     if x is True:
         return "true"
     if x is False:
         return "false"
+    if isinstance(x, (int, float)):
+        from kubeoperator_tpu.ui.jsinterp import num_to_string
+
+        return num_to_string(float(x))
+    if isinstance(x, str):
+        return x
+    if isinstance(x, list):
+        return ",".join("" if e is None else to_str(e) for e in x)
+    if isinstance(x, dict):
+        return "[object Object]"
     return str(x)

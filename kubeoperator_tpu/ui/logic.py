@@ -30,7 +30,7 @@ DNS_ALNUM = "abcdefghijklmnopqrstuvwxyz0123456789"
 def dns_label_ok(name):
     """RFC1123 label: the rule cluster/plan names must satisfy to become
     K8s object names and TPU-VM instance prefixes."""
-    n = str(name)
+    n = jsrt.to_str(name)
     if len(n) < 1 or len(n) > 63:
         return False
     i = 0
@@ -47,7 +47,7 @@ def dns_label_ok(name):
 def parse_mesh(text):
     """'4x4' / '2x2x4' -> [4, 4] / [2, 2, 4]; None if unparseable.
     Mirrors parallel/topology.py parse_ici_mesh (x / unicode-times)."""
-    parts = str(text).lower().split("×")
+    parts = jsrt.to_str(text).lower().split("×")
     joined = "x".join(parts)
     dims = []
     for p in joined.split("x"):
@@ -69,9 +69,9 @@ def mesh_product(dims):
 
 def catalog_entry(catalog, tpu_type):
     """Row of /api/v1/plans-tpu-catalog for an accelerator type, or None."""
-    want = str(tpu_type).strip().lower()
+    want = jsrt.to_str(tpu_type).strip().lower()
     for row in catalog:
-        if str(jsrt.get(row, "accelerator_type", "")).lower() == want:
+        if jsrt.to_str(jsrt.get(row, "accelerator_type", "")).lower() == want:
             return row
     return None
 
@@ -97,20 +97,20 @@ def plan_form_errors(form, catalog):
     browser can check before POST /api/v1/plans. Returns a list of error
     strings; empty means the server would accept the same fields."""
     errors = []
-    name = str(jsrt.get(form, "name", "")).strip()
+    name = jsrt.to_str(jsrt.get(form, "name", "")).strip()
     if name == "":
         errors.append("plan name required")
     elif not dns_label_ok(name):
         errors.append(f"plan name {name} must be a lowercase DNS label")
 
-    provider = str(jsrt.get(form, "provider", "")).strip()
+    provider = jsrt.to_str(jsrt.get(form, "provider", "")).strip()
     masters = jsrt.parse_int(jsrt.get(form, "master_count", 1))
     if masters is None or masters < 1:
         errors.append("plan needs >= 1 master")
     elif not jsrt.contains([1, 3, 5], masters):
         errors.append("HA requires 1, 3 or 5 masters")
 
-    if provider != "bare_metal" and str(jsrt.get(form, "region", "")).strip() == "":
+    if provider != "bare_metal" and jsrt.to_str(jsrt.get(form, "region", "")).strip() == "":
         errors.append("IaaS plans must reference a region")
 
     accelerator = jsrt.get(form, "accelerator", "none")
@@ -121,7 +121,7 @@ def plan_form_errors(form, catalog):
 
     if provider != "gcp_tpu_vm":
         errors.append("TPU plans require the gcp_tpu_vm provider")
-    tpu_type = str(jsrt.get(form, "tpu_type", "")).strip()
+    tpu_type = jsrt.to_str(jsrt.get(form, "tpu_type", "")).strip()
     if tpu_type == "":
         errors.append("TPU plan needs tpu_type (e.g. 'v5e-16')")
         return errors
@@ -135,7 +135,7 @@ def plan_form_errors(form, catalog):
         errors.append("num_slices must be >= 1")
         slices = 1
 
-    topology = str(jsrt.get(form, "slice_topology", "")).strip()
+    topology = jsrt.to_str(jsrt.get(form, "slice_topology", "")).strip()
     if topology != "":
         dims = parse_mesh(topology)
         chips = jsrt.get(entry, "chips", 0)
@@ -174,15 +174,15 @@ def wizard_errors(mode, name, plan_name, hosts_csv, workers):
     button) while invalid. Manual mode mirrors the service-side rule that a
     cluster needs >= 1 reachable host and a sane worker count."""
     errors = []
-    if not dns_label_ok(str(name).strip()):
+    if not dns_label_ok(jsrt.to_str(name).strip()):
         errors.append("cluster name must be a lowercase DNS label (1-63 chars)")
     if mode == "plan":
-        if str(plan_name).strip() == "":
+        if jsrt.to_str(plan_name).strip() == "":
             errors.append("select a deploy plan")
         return errors
     hosts = []
     seen_dup = False
-    for part in str(hosts_csv).split(","):
+    for part in jsrt.to_str(hosts_csv).split(","):
         h = part.strip()
         if h != "":
             if jsrt.contains(hosts, h):
@@ -224,13 +224,20 @@ def spec_choice_errors(cni, runtime, proxy_mode, ingress):
     tampered DOM) must reject exactly what the server would."""
     choices = spec_choices()
     errors = []
-    if not jsrt.contains(choices["cni"], str(cni)):
+    # stringify ONCE at the top: f-strings transpile to template literals
+    # whose ToString differs from Python str() on None/floats — raw params
+    # in messages would produce 'unknown cni None' vs 'unknown cni null'
+    cni = jsrt.to_str(cni)
+    runtime = jsrt.to_str(runtime)
+    proxy_mode = jsrt.to_str(proxy_mode)
+    ingress = jsrt.to_str(ingress)
+    if not jsrt.contains(choices["cni"], cni):
         errors.append(f"unknown cni {cni}")
-    if not jsrt.contains(choices["runtime"], str(runtime)):
+    if not jsrt.contains(choices["runtime"], runtime):
         errors.append(f"unknown runtime {runtime}")
-    if not jsrt.contains(choices["kube_proxy_mode"], str(proxy_mode)):
+    if not jsrt.contains(choices["kube_proxy_mode"], proxy_mode):
         errors.append(f"unknown kube_proxy_mode {proxy_mode}")
-    if not jsrt.contains(choices["ingress"], str(ingress)):
+    if not jsrt.contains(choices["ingress"], ingress):
         errors.append(f"unknown ingress {ingress}")
     return errors
 
@@ -241,9 +248,9 @@ def import_form_errors(name, kubeconfig):
     (Full YAML parsing stays server-side; this catches the obvious paste
     mistakes before the POST.)"""
     errors = []
-    if not dns_label_ok(str(name).strip()):
+    if not dns_label_ok(jsrt.to_str(name).strip()):
         errors.append("cluster name must be a lowercase DNS label (1-63 chars)")
-    text = str(kubeconfig).strip()
+    text = jsrt.to_str(kubeconfig).strip()
     if text == "":
         errors.append("paste the cluster's kubeconfig")
     elif not jsrt.contains(text, "clusters:"):
@@ -255,12 +262,12 @@ def filter_log_lines(lines, query):
     """Log-viewer filter: case-insensitive substring over raw lines. The
     viewer keeps the full line buffer and re-renders through this, so
     clearing the query restores everything."""
-    q = str(query).strip().lower()
+    q = jsrt.to_str(query).strip().lower()
     if q == "":
         return lines
     out = []
     for line in lines:
-        if jsrt.contains(str(line).lower(), q):
+        if jsrt.contains(jsrt.to_str(line).lower(), q):
             out.append(line)
     return out
 
@@ -268,14 +275,14 @@ def filter_log_lines(lines, query):
 def filter_rows(rows, query, fields):
     """Shared table search: case-insensitive substring across the named
     fields; empty query returns everything (filter-reset semantics)."""
-    q = str(query).strip().lower()
+    q = jsrt.to_str(query).strip().lower()
     if q == "":
         return rows
     out = []
     for row in rows:
         hay = ""
         for f in fields:
-            hay = hay + str(jsrt.get(row, f, "")) + " "
+            hay = hay + jsrt.to_str(jsrt.get(row, f, "")) + " "
         if jsrt.contains(hay.lower(), q):
             out.append(row)
     return out
@@ -314,7 +321,7 @@ def k8s_minor(version):
     """'v1.28.15' -> 28; None when unparseable. Mirrors
     service/upgrade.py _minor (lstrip('v') there strips chars, but every
     supported version has a single leading 'v')."""
-    v = str(version).strip()
+    v = jsrt.to_str(version).strip()
     if v.startswith("v"):
         v = v[1:]
     parts = v.split(".")
@@ -328,6 +335,9 @@ def upgrade_errors(current, target, supported):
     the supported bundle, strictly newer, and exactly one minor hop. The
     dialog disables Upgrade while this returns errors."""
     errors = []
+    # stringify once: raw params in f-strings diverge across runtimes
+    current = jsrt.to_str(current)
+    target = jsrt.to_str(target)
     if not jsrt.contains(supported, target):
         errors.append(f"{target} is not in the supported bundle")
         return errors
@@ -352,7 +362,7 @@ def cluster_attention_score(cluster):
     function of the cluster's stored status (phase, per-phase conditions,
     smoke gate) so the overview ranks without N live health probes."""
     status = jsrt.get(cluster, "status", {})
-    phase = str(jsrt.get(status, "phase", ""))
+    phase = jsrt.to_str(jsrt.get(status, "phase", ""))
     score = 0
     if phase == "Failed":
         score = score + 100
@@ -361,7 +371,7 @@ def cluster_attention_score(cluster):
                       "Terminating"], phase):
         score = score + 30
     for c in jsrt.get(status, "conditions", []):
-        cstatus = str(jsrt.get(c, "status", ""))
+        cstatus = jsrt.to_str(jsrt.get(c, "status", ""))
         if cstatus == "Failed":
             score = score + 25
         if cstatus == "Running":
@@ -380,7 +390,7 @@ def rank_clusters(clusters):
         rows.append({
             "cluster": c,
             "score": cluster_attention_score(c),
-            "name": str(jsrt.get(c, "name", "")),
+            "name": jsrt.to_str(jsrt.get(c, "name", "")),
         })
     out = []
     while len(rows) > 0:
@@ -499,14 +509,14 @@ def completed_cis_scans(scans):
     checks and must not participate in drift comparison."""
     done = []
     for s in scans:
-        st = str(jsrt.get(s, "status", ""))
+        st = jsrt.to_str(jsrt.get(s, "status", ""))
         if st == "Passed" or st == "Warn" or st == "Failed":
             done.append(s)
     return done
 
 
 def _check_key(c):
-    return str(jsrt.get(c, "id", "")) + "@" + str(jsrt.get(c, "node", ""))
+    return jsrt.to_str(jsrt.get(c, "id", "")) + "@" + jsrt.to_str(jsrt.get(c, "node", ""))
 
 
 def cis_delta(latest, previous):
@@ -573,12 +583,12 @@ def event_rollup(events, now_s, window_s):
         ts = jsrt.num(jsrt.get(e, "created_at", 0))
         if jsrt.num(now_s) - ts > jsrt.num(window_s):
             continue
-        if str(jsrt.get(e, "type", "")) == "Warning":
+        if jsrt.to_str(jsrt.get(e, "type", "")) == "Warning":
             warnings = warnings + 1
-            r = str(jsrt.get(e, "reason", ""))
+            r = jsrt.to_str(jsrt.get(e, "reason", ""))
             found = False
             for row in reasons:
-                if str(jsrt.get(row, "reason", "")) == r:
+                if jsrt.to_str(jsrt.get(row, "reason", "")) == r:
                     row["count"] = jsrt.num(jsrt.get(row, "count", 0)) + 1
                     found = True
             if not found:
@@ -656,7 +666,7 @@ def component_vars_from_form(fields, raw):
             # transpiled subset has no `is`, and == True is portable)
             out[key] = jsrt.kind(value) == "bool" and value == True  # noqa: E712
             continue
-        s = "" if value is None else str(value).strip()
+        s = "" if value is None else jsrt.to_str(value).strip()
         if s == "":
             if f["required"]:
                 errors.append(key + " is required")
@@ -718,7 +728,7 @@ def provider_vars_from_form(spec_fields, raw):
     for f in spec_fields:
         key = jsrt.get(f, "key", "")
         value = jsrt.get(raw, key, None)
-        s = "" if value is None else str(value).strip()
+        s = "" if value is None else jsrt.to_str(value).strip()
         if s == "":
             if jsrt.get(f, "required", False):
                 errors.append(key + " is required")

@@ -145,6 +145,114 @@ class TestGeneratedJsExecutes:
         )
 
 
+class TestShippedFilesParse:
+    def test_app_js_parses_under_the_real_grammar(self):
+        """Stronger than the naive-lexer shape gate: the shipped app.js
+        must PARSE under the strict JS grammar (the same one that
+        executes it) — a syntax error or out-of-subset construct fails
+        here with a position, before any flow test runs."""
+        import os
+
+        from kubeoperator_tpu.ui.jsinterp import Parser, tokenize
+
+        path = os.path.join(REPO_ROOT, "kubeoperator_tpu", "ui", "app.js")
+        with open(path, encoding="utf-8") as f:
+            Parser(tokenize(f.read())).parse_program()
+
+    def test_generated_logic_js_parses(self):
+        from kubeoperator_tpu.ui.jsinterp import Parser, tokenize
+
+        Parser(tokenize(generate_logic_js())).parse_program()
+
+
+class TestSeededDifferentialFuzz:
+    """Beyond the recorded grid: seeded random JS-shaped inputs through a
+    set of pure logic functions, interpreted-JS vs Python, to catch
+    coercion/semantics divergences no hand-written case thought of."""
+
+    def _gen(self, rng, depth=0):
+        kind = rng.randrange(8 if depth < 2 else 6)
+        if kind == 0:
+            return rng.choice([
+                "", "4x4", "2x2x4", "x", "0x4", " 4x4 ", "v5e-16",
+                "-1", "16", "4×4", "a b", "demo-1", "UPPER", "4x4x",
+                'with "quotes"', "back\\slash", "中文", "1e3", "0.5",
+            ])
+        if kind == 1:
+            return float(rng.choice([0, 1, -1, 4, 16, 63, 64, 100, 2.5]))
+        if kind == 2:
+            return rng.choice([True, False])
+        if kind == 3:
+            return None
+        if kind in (4, 5):
+            return rng.randrange(-5, 100)
+        if kind == 6:
+            return [self._gen(rng, depth + 1)
+                    for _ in range(rng.randrange(4))]
+        return {f"k{i}": self._gen(rng, depth + 1)
+                for i in range(rng.randrange(4))}
+
+    def test_fuzz_pure_functions(self, js_runtime):
+        import random
+
+        from kubeoperator_tpu.ui.jsinterp import JSThrow
+
+        rng = random.Random(20260730)   # fixed seed: deterministic CI
+        cases = {
+            "dns_label_ok": lambda: (self._gen(rng),),
+            "parse_mesh": lambda: (self._gen(rng),),
+            "mesh_product": lambda: ([rng.randrange(1, 6)
+                                      for _ in range(rng.randrange(1, 4))],),
+            "k8s_minor": lambda: (rng.choice(
+                ["v1.30.6", "v1.29", "bogus", "", "v2", "1.30"]),),
+            "paginate": lambda: (
+                [float(i) for i in range(rng.randrange(0, 40))],
+                self._gen(rng), self._gen(rng)),
+            "filter_log_lines": lambda: (
+                [rng.choice(["TASK [etcd] x", "ok: [m1]", "fatal: boom"])
+                 for _ in range(rng.randrange(6))],
+                rng.choice(["", "etcd", "FATAL", "x y"])),
+            "i18n_next": lambda: (rng.choice(["en", "zh", "fr", ""]),),
+            # validation functions fed raw garbage: their error MESSAGES
+            # interpolate inputs, the divergence class the jsrt.to_str
+            # stringify-once discipline exists for
+            "spec_choice_errors": lambda: tuple(
+                self._gen(rng) for _ in range(4)),
+            "upgrade_errors": lambda: (
+                self._gen(rng), self._gen(rng),
+                ["v1.29.10", "v1.30.6", "v1.31.1"]),
+        }
+        import copy
+
+        from kubeoperator_tpu.ui import logic
+
+        checked = divergences = 0
+        for _ in range(400):
+            name = rng.choice(list(cases))
+            args = cases[name]()
+            py_err = js_err = None
+            py = js = None
+            try:
+                py = getattr(logic, name)(*copy.deepcopy(args))
+            except Exception:            # noqa: BLE001
+                py_err = True
+            try:
+                js = call_export(js_runtime, name, *copy.deepcopy(args))
+            except JSThrow:
+                js_err = True
+            if (py_err is None) != (js_err is None):
+                divergences += 1
+                continue
+            if py_err is None:
+                try:
+                    js_equivalent(py, js)
+                except AssertionError:
+                    divergences += 1
+            checked += 1
+        assert checked > 300
+        assert divergences == 0, f"{divergences} fuzz divergences"
+
+
 class TestGateCatchesMutations:
     def test_prelude_mutation_fails_the_differential(self, recorded_grid):
         """Prove the gate bites: a single prelude regression (parse_int
