@@ -28,11 +28,23 @@ def parse_int(s):
 
     Stringifies via to_str, not builtin str: the JS twin does String(s),
     so parse_int(64.0) must see "64" (an int) on both sides — Python's
-    "64.0" would answer None while the browser answered 64 (r5 fuzz)."""
+    "64.0" would answer None while the browser answered 64 (r5 fuzz).
+
+    parseInt returns a DOUBLE: digits beyond 2^53 round (…93 -> …92) and
+    enormous literals become Infinity. Python's exact bigints here would
+    make the twins disagree on the value (and later overflow float()); so
+    round through a double like the browser does, returning int where the
+    double is integral-and-safe."""
     t = to_str(s).strip()
-    if _INT_RE.fullmatch(t):
-        return int(t)
-    return None
+    if not _INT_RE.fullmatch(t):
+        return None
+    try:
+        d = float(int(t))   # exact parse, then double rounding (parseInt)
+    except OverflowError:   # beyond double range: JS says ±Infinity
+        return math.inf if not t.startswith("-") else -math.inf
+    if math.isinf(d) or abs(d) >= 2.0 ** 53:
+        return d
+    return int(d)
 
 
 def contains(container, item):
@@ -132,18 +144,13 @@ def to_str(x):
     String(['a']) is 'a')."""
     if x is None:
         return "None"
-    if x is True:
-        return "true"
-    if x is False:
-        return "false"
-    if isinstance(x, (int, float)):
-        from kubeoperator_tpu.ui.jsinterp import num_to_string
+    if isinstance(x, int) and not isinstance(x, bool):
+        # Python bigints exceed the double range JS numbers live in;
+        # clamp through a double the way every JS number already has been
+        try:
+            x = float(x)
+        except OverflowError:
+            return "Infinity" if x > 0 else "-Infinity"
+    from kubeoperator_tpu.ui.jsinterp import to_string
 
-        return num_to_string(float(x))
-    if isinstance(x, str):
-        return x
-    if isinstance(x, list):
-        return ",".join("" if e is None else to_str(e) for e in x)
-    if isinstance(x, dict):
-        return "[object Object]"
-    return str(x)
+    return to_string(x)
