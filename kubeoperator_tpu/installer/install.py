@@ -69,6 +69,7 @@ COMPOSE_TEMPLATE = {
             "ports": ["9090:9090"],
             "volumes": [
                 "{data_dir}/observability/prometheus.yml:/etc/prometheus/prometheus.yml:ro",
+                "{data_dir}/observability/ko-tpu-alerts.yml:/etc/prometheus/ko-tpu-alerts.yml:ro",
             ],
             "profiles": ["observability"],
             "depends_on": ["ko-server"],

@@ -23,6 +23,7 @@ import yaml
 
 PROMETHEUS_CONFIG = {
     "global": {"scrape_interval": "15s", "evaluation_interval": "15s"},
+    "rule_files": ["/etc/prometheus/ko-tpu-alerts.yml"],
     "scrape_configs": [
         {
             "job_name": "ko-server",
@@ -33,6 +34,101 @@ PROMETHEUS_CONFIG = {
             ],
         },
     ],
+}
+
+# Shipped alert rules over the same ko_tpu_* families the dashboard uses —
+# the platform doesn't just graph itself, it pages on the states an
+# operator must act on. Every expr references metric names api/metrics.py
+# actually exports (CI cross-checks the names), and every rule carries a
+# runbook-style description.
+ALERT_RULES = {
+    "groups": [
+        {
+            "name": "ko-tpu-platform",
+            "rules": [
+                {
+                    "alert": "KoServerDown",
+                    "expr": 'up{job="ko-server"} == 0',
+                    "for": "2m",
+                    "labels": {"severity": "critical"},
+                    "annotations": {
+                        "summary": "ko-server is not answering scrapes",
+                        "description": "The platform API is down; no "
+                                       "cluster operation can run.",
+                    },
+                },
+                {
+                    "alert": "KoRunnerUnreachable",
+                    "expr": "ko_tpu_executor_up == 0",
+                    "for": "2m",
+                    "labels": {"severity": "critical"},
+                    "annotations": {
+                        "summary": "ko-runner is unreachable from "
+                                   "ko-server",
+                        "description": "executor.backend=grpc cannot reach "
+                                       "the runner; phases cannot execute. "
+                                       "Check the ko-runner container "
+                                       "(compose restarts it; /healthz "
+                                       "reports executor_ok).",
+                    },
+                },
+                {
+                    "alert": "KoClustersFailed",
+                    "expr": 'ko_tpu_clusters{phase="Failed"} > 0',
+                    "for": "5m",
+                    "labels": {"severity": "warning"},
+                    "annotations": {
+                        "summary": "one or more clusters sit in Failed",
+                        "description": "Conditions are resumable: inspect "
+                                       "the failed phase and `koctl "
+                                       "cluster retry <name>`.",
+                    },
+                },
+                {
+                    "alert": "KoApiServerErrors",
+                    "expr": 'sum(rate(ko_tpu_http_requests_total'
+                            '{code=~"5.."}[5m])) > 0.1',
+                    "for": "10m",
+                    "labels": {"severity": "warning"},
+                    "annotations": {
+                        "summary": "sustained 5xx rate on the platform API",
+                        "description": "More than 0.1 errors/s for 10m — "
+                                       "check the ko-server log.",
+                    },
+                },
+                {
+                    "alert": "KoSmokeBandwidthRegression",
+                    "expr": 'ko_tpu_smoke_gbps{simulated="false"} > 0 and '
+                            'ko_tpu_smoke_gbps{simulated="false"} < 40',
+                    "for": "1m",
+                    "labels": {"severity": "warning"},
+                    "annotations": {
+                        "summary": "a TPU cluster's measured psum "
+                                   "bandwidth is far below the v5e "
+                                   "envelope",
+                        "description": "Re-run the smoke gate (`koctl "
+                                       "cluster health` recovery or a "
+                                       "slice re-gate) and check ICI "
+                                       "health via `koctl tpu diag`.",
+                    },
+                },
+                {
+                    "alert": "KoTerminalScrollbackDropping",
+                    "expr": "rate(ko_tpu_terminal_dropped_chunks_total"
+                            "[10m]) > 1",
+                    "for": "10m",
+                    "labels": {"severity": "info"},
+                    "annotations": {
+                        "summary": "terminal scrollback is dropping "
+                                   "chunks at a sustained rate",
+                        "description": "A flooding child process is "
+                                       "outpacing readers; the console "
+                                       "shows gap markers.",
+                    },
+                },
+            ],
+        }
+    ]
 }
 
 DATASOURCE_CONFIG = {
@@ -131,6 +227,7 @@ def write_observability(data_dir: str) -> dict:
 
     paths = {
         "prometheus": os.path.join(obs, "prometheus.yml"),
+        "alerts": os.path.join(obs, "ko-tpu-alerts.yml"),
         "datasource": os.path.join(prov, "datasources", "ko-tpu.yml"),
         "provider": os.path.join(prov, "dashboards", "ko-tpu.yml"),
         "dashboard": os.path.join(dash_dir, "ko-tpu-platform.json"),
@@ -144,6 +241,24 @@ def write_observability(data_dir: str) -> dict:
 
     _write(paths["prometheus"],
            lambda f: yaml.safe_dump(PROMETHEUS_CONFIG, f, sort_keys=False))
+    _write(paths["alerts"],
+           lambda f: yaml.safe_dump(ALERT_RULES, f, sort_keys=False))
+    # Migration for PRESERVED configs: a prometheus.yml from a pre-alerts
+    # install keeps every operator edit but never loaded rules — the
+    # rendered-and-mounted alerts file would be silently inactive forever.
+    # Add ONLY the missing rule_files entry; touch nothing else.
+    try:
+        with open(paths["prometheus"], encoding="utf-8") as f:
+            existing = yaml.safe_load(f) or {}
+        rule_files = existing.get("rule_files") or []
+        if "/etc/prometheus/ko-tpu-alerts.yml" not in rule_files:
+            existing["rule_files"] = rule_files + [
+                "/etc/prometheus/ko-tpu-alerts.yml"]
+            with open(paths["prometheus"], "w", encoding="utf-8") as f:
+                yaml.safe_dump(existing, f, sort_keys=False)
+    except yaml.YAMLError:
+        # an operator config we cannot parse is not ours to rewrite
+        pass
     _write(paths["datasource"],
            lambda f: yaml.safe_dump(DATASOURCE_CONFIG, f, sort_keys=False))
     _write(paths["provider"],

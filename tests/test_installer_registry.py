@@ -82,6 +82,70 @@ class TestInstaller:
         assert any("provisioning" in v for v in services["grafana"]["volumes"])
         assert any("dashboards" in v for v in services["grafana"]["volumes"])
 
+    def test_alert_rules_reference_real_metric_families(
+        self, tmp_path, client
+    ):
+        """The shipped alert rules page on states an operator must act on —
+        and every expr references a family the LIVE /metrics endpoint
+        actually exports (a renamed metric cannot silently orphan its
+        alert). prometheus.yml loads the rule file and the compose mounts
+        it."""
+        import re as _re
+
+        import requests
+
+        target = tmp_path / "opt"
+        compose_path = render_bundle(str(target))
+        compose = yaml.safe_load(open(compose_path))
+        data = target / "data" / "observability"
+
+        rules = yaml.safe_load(open(data / "ko-tpu-alerts.yml"))
+        all_rules = [r for g in rules["groups"] for r in g["rules"]]
+        assert len(all_rules) >= 5
+        base, http, services_stack = client
+        live = requests.get(f"{base}/metrics").text
+        # EXACT family names from the exposition's TYPE lines — substring
+        # matching would let a renamed family silently orphan its alert
+        families = set(_re.findall(r"^# TYPE (\S+)", live, _re.MULTILINE))
+        for rule in all_rules:
+            assert rule["labels"]["severity"] in ("critical", "warning",
+                                                  "info")
+            assert rule["annotations"]["summary"]
+            assert rule["annotations"]["description"]
+            for name in set(_re.findall(r"ko_tpu_[a-z_]+", rule["expr"])):
+                assert name in families, (rule["alert"], name)
+        # the runner alert exists: a dead executor is the one failure that
+        # silently stops every cluster operation
+        assert any(r["alert"] == "KoRunnerUnreachable" for r in all_rules)
+
+        prom_cfg = yaml.safe_load(open(data / "prometheus.yml"))
+        assert "/etc/prometheus/ko-tpu-alerts.yml" in prom_cfg["rule_files"]
+        prom_svc = compose["services"]["prometheus"]
+        assert any("ko-tpu-alerts.yml" in v for v in prom_svc["volumes"])
+
+    def test_preserved_prometheus_config_gains_rule_files(self, tmp_path):
+        """Upgrade migration: a pre-alerts install's preserved
+        prometheus.yml keeps every operator edit but must gain the
+        rule_files entry — otherwise the rendered-and-mounted alerts file
+        is silently inactive forever."""
+        target = tmp_path / "opt"
+        render_bundle(str(target))
+        prom_path = target / "data" / "observability" / "prometheus.yml"
+        # simulate a pre-alerts install with an operator-tuned interval
+        legacy = {"global": {"scrape_interval": "7s"},
+                  "scrape_configs": [{"job_name": "custom"}]}
+        prom_path.write_text(yaml.safe_dump(legacy))
+        render_bundle(str(target))   # upgrade re-render
+        migrated = yaml.safe_load(prom_path.read_text())
+        assert migrated["global"]["scrape_interval"] == "7s"   # preserved
+        assert migrated["scrape_configs"] == [{"job_name": "custom"}]
+        assert migrated["rule_files"] == [
+            "/etc/prometheus/ko-tpu-alerts.yml"]
+        # idempotent: a third render adds nothing twice
+        render_bundle(str(target))
+        again = yaml.safe_load(prom_path.read_text())
+        assert again["rule_files"] == ["/etc/prometheus/ko-tpu-alerts.yml"]
+
     def test_install_without_docker_degrades(self, tmp_path):
         result = install(str(tmp_path / "opt"), start=True)
         assert result["started"] is False
