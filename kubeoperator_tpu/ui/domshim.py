@@ -137,22 +137,39 @@ class BrowserHarness:
             "remove": _native(lambda: None),
             "focus": _native(lambda: None),
             "click": _native(lambda: self.fire(el, "click")),
-            "showModal": _native(lambda: el.__setitem__("__open__", True)),
-            "close": _native(lambda: el.__setitem__("__open__", False)),
+            "open": False,   # real <dialog> exposes .open after showModal
+            "showModal": _native(lambda: self._show_modal(el)),
+            "close": _native(lambda: el.__setitem__("open", False)),
             "setAttribute": _native(
                 lambda k, v: el.__setitem__(to_string(k), v)),
         })
         return el
 
+    @staticmethod
+    def _show_modal(el: dict) -> None:
+        if el.get("open"):
+            # model the real DOM: re-showModal on an open dialog throws —
+            # the guard in app.js exists for this, and dropping it must
+            # fail the harness the way it would fail a browser
+            raise JSThrow(JSError(
+                "Error", "InvalidStateError: dialog is already open"))
+        el["open"] = True
+
     def fire(self, el: dict, event: str, payload=None):
-        """Invoke an element's registered handlers synchronously; async
-        handlers' promises resolve eagerly. Rejected handler promises are
-        surfaced — a swallowed crash must fail the test."""
+        """Invoke an element's handlers synchronously — both
+        addEventListener registrations and the `on<event>` property form
+        app.js uses for dialog buttons; async handlers' promises resolve
+        eagerly. Rejected handler promises are surfaced — a swallowed
+        crash must fail the test."""
         results = []
+        handlers = list(el["__handlers__"].get(event, []))
+        prop = el.get("on" + event)
+        if prop not in (None, UNDEFINED):
+            handlers.append(prop)
         # snapshot: a handler that re-renders (openCluster) re-registers
         # listeners mid-dispatch; the real DOM never fires a listener
         # added during the same event dispatch
-        for fn in list(el["__handlers__"].get(event, [])):
+        for fn in handlers:
             r = self.interp.call_function(
                 fn, [payload if payload is not None else {}])
             if isinstance(r, JSPromise) and r.state == "rejected":

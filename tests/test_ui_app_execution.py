@@ -165,7 +165,7 @@ class TestWizardCreateFlow:
             services.hosts.register(f"h{i}", f"10.7.0.{i+1}", "ssh")
         login(h)
         h.click("#new-cluster-btn")
-        assert h.element("#wizard").get("__open__") is True
+        assert h.element("#wizard")["open"] is True
         from kubeoperator_tpu.ui import logic
 
         choices = logic.spec_choices()
@@ -186,7 +186,7 @@ class TestWizardCreateFlow:
         assert h.element("#wz-create")["disabled"] is False
         h.click("#wz-create")
         assert h.element("#wz-error")["textContent"] == ""
-        assert h.element("#wizard").get("__open__") is False
+        assert h.element("#wizard")["open"] is False
         services.clusters.wait_all(timeout_s=60)
         cluster = services.clusters.get("from-console")
         assert cluster.status.phase == "Ready"
@@ -213,7 +213,7 @@ class TestWizardCreateFlow:
         h.click("#wz-create")     # "demo" already exists (fixture cluster)
         err = h.element("#wz-error")["textContent"]
         assert err != ""          # the 409 message rendered in the dialog
-        assert h.element("#wizard").get("__open__") is True  # stays open
+        assert h.element("#wizard")["open"] is True  # stays open
 
 
 class TestDeleteFlow:
@@ -323,6 +323,78 @@ class TestSseStreamGlue:
         assert not any(t for t in h.timers if not t["repeat"])
         log = next(e for e in h.event_sources if "/logs?" in e["url"])
         assert log["readyState"] == 2.0
+
+
+class TestObjDialogFlows:
+    """The generic dialog glue (objDialog): field rendering, client-side
+    validation gating the save, server errors landing in the dialog —
+    executed from the genuine bytes against the live API."""
+
+    def _save(self, h):
+        h.click("#obj-save")   # fire() dispatches onclick properties too
+
+    def test_upgrade_dialog_gates_on_one_minor_hop_then_upgrades(
+        self, console
+    ):
+        h, services = console
+        # pin the cluster to the OLDEST supported version so both a
+        # two-hop (blocked) and a one-hop (allowed) target exist above it
+        from kubeoperator_tpu.version import SUPPORTED_K8S_VERSIONS
+
+        demo = services.clusters.get("demo")
+        demo.spec.k8s_version = SUPPORTED_K8S_VERSIONS[0]
+        services.repos.clusters.save(demo)
+        login(h)
+        card = h.element("#cluster-list")["__children__"][0]
+        h.fire(card["querySelector"]("[data-open]"), "click")
+        h.click("#d-upgrade")
+        assert h.element("#obj-dialog")["open"] is True
+        # the select was rendered from the real /version payload
+        assert "<option" in h.element("#obj-fields")["innerHTML"]
+        current = SUPPORTED_K8S_VERSIONS[0]
+        idx = 0
+        # two-minor hop: client-side gate blocks, dialog stays open, no POST
+        h.element("#obj-version")["value"] = SUPPORTED_K8S_VERSIONS[idx + 2]
+        self._save(h)
+        assert "minor" in h.element("#obj-error")["textContent"]
+        assert h.element("#obj-dialog")["open"] is True
+        assert services.clusters.get("demo").spec.k8s_version == current
+        # one-minor hop: POST fires, upgrade runs, dialog closes
+        h.element("#obj-version")["value"] = SUPPORTED_K8S_VERSIONS[idx + 1]
+        self._save(h)
+        services.clusters.wait_all(timeout_s=60)
+        assert h.element("#obj-dialog")["open"] is False
+        upgraded = services.clusters.get("demo")
+        assert upgraded.spec.k8s_version == SUPPORTED_K8S_VERSIONS[idx + 1]
+        assert upgraded.status.condition("upgrade-verify").status == "OK"
+
+    def test_register_host_dialog_round_trips(self, console):
+        h, services = console
+        login(h)
+        h.click("#register-host-btn")
+        h.element("#obj-name")["value"] = "dlg-host"
+        h.element("#obj-ip")["value"] = "10.7.0.99"
+        h.element("#obj-credential")["value"] = "ssh"
+        h.element("#obj-port")["value"] = "22"
+        self._save(h)
+        assert h.element("#obj-dialog")["open"] is False
+        host = services.repos.hosts.get_by_name("dlg-host")
+        assert host.ip == "10.7.0.99"
+
+    def test_server_error_renders_in_dialog_and_keeps_it_open(
+        self, console
+    ):
+        h, services = console
+        login(h)
+        h.click("#register-host-btn")
+        h.element("#obj-name")["value"] = "h0"   # already registered
+        h.element("#obj-ip")["value"] = "10.7.0.50"
+        h.element("#obj-credential")["value"] = "ssh"
+        h.element("#obj-port")["value"] = "22"
+        self._save(h)
+        # the server's conflict message landed in the dialog, still open
+        assert h.element("#obj-error")["textContent"] != ""
+        assert h.element("#obj-dialog")["open"] is True
 
 
 class TestI18nToggle:
