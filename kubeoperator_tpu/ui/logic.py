@@ -425,8 +425,13 @@ def smoke_trend(history):
     for h in history:
         g = jsrt.get(h, "gbps", None)
         if g is not None:
-            vals.append(g)
-            sims.append(jsrt.get(h, "simulated", False) == True)
+            # numeric assertion on BOTH runtimes: Python would raise on a
+            # `>` against garbage while JS silently compares (NaN rules) —
+            # jsrt.num throws identically on each side, so bad trend data
+            # fails loudly everywhere instead of diverging (r5 multi-seed
+            # fuzz finding)
+            vals.append(jsrt.num(g))
+            sims.append(jsrt.get(h, "simulated", False) is True)
     if len(vals) == 0:
         return {"last_gbps": None, "delta_pct": None, "bars": [], "sim": []}
     peak = 0.0
@@ -466,9 +471,9 @@ def tpu_panel(cluster, expected_chips):
         "chips_ok": chips_ok,
         "gbps": jsrt.get(status, "smoke_gbps", 0),
         "passed": passed,
-        "simulated": simulated == True,
+        "simulated": simulated is True,
         "trend": trend,
-        "ok": chips_ok and (chips == 0 or passed == True),
+        "ok": chips_ok and (chips == 0 or passed is True),
     }
 
 
@@ -663,8 +668,8 @@ def component_vars_from_form(fields, raw):
         value = jsrt.get(raw, key, None)
         if f["type"] == "bool":
             # checkbox: anything but literal true means unchecked (the
-            # transpiled subset has no `is`, and == True is portable)
-            out[key] = jsrt.kind(value) == "bool" and value == True  # noqa: E712
+            # `is True` transpiles to === true: strict on both sides
+            out[key] = jsrt.kind(value) == "bool" and value is True
             continue
         s = "" if value is None else jsrt.to_str(value).strip()
         if s == "":
@@ -1316,7 +1321,7 @@ def render_tpu_panel(panel, labels):
         for i in range(len(bars)):
             height = max(jsrt.num(bars[i]), 6)
             bar_cls = ""
-            if i < len(sims) and sims[i] == True:
+            if i < len(sims) and sims[i] is True:
                 bar_cls = "sim"
             cells.append(f'<i class="{bar_cls}" '
                          f'style="height:{jsrt.esc(height)}%"></i>')

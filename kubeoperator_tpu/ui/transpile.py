@@ -353,10 +353,20 @@ class _FunctionEmitter:
         if isinstance(op, ast.NotIn):
             return f"!_rt.contains({r}, {l})"
         if isinstance(op, (ast.Is, ast.IsNot)):
-            # only `is None` / `is not None` make it through review
-            if not (isinstance(right, ast.Constant) and right.value is None):
-                raise _err(node, "`is` only supported against None")
-            return f"({l} {'===' if isinstance(op, ast.Is) else '!=='} null)"
+            # `is None` -> ===/!== null, and `is True/False` -> ===/!==
+            # true/false: Python identity on those singletons is EXACTLY
+            # JS strict equality. `== True` is NOT (Python: 1 == True is
+            # True; JS: 1 === true is false) — the r5 review caught that
+            # divergence shipping in smoke_trend's simulated flag.
+            if isinstance(right, ast.Constant) and right.value is None:
+                sym = "===" if isinstance(op, ast.Is) else "!=="
+                return f"({l} {sym} null)"
+            if isinstance(right, ast.Constant) and isinstance(
+                    right.value, bool):
+                lit = "true" if right.value else "false"
+                sym = "===" if isinstance(op, ast.Is) else "!=="
+                return f"({l} {sym} {lit})"
+            raise _err(node, "`is` only supported against None/True/False")
         sym = _CMP_MAP.get(type(op))
         if sym is None:
             raise _err(node, f"unsupported comparison {type(op).__name__}")

@@ -221,6 +221,26 @@ class TestSeededDifferentialFuzz:
             "upgrade_errors": lambda: (
                 self._gen(rng), self._gen(rng),
                 ["v1.29.10", "v1.30.6", "v1.31.1"]),
+            "cluster_attention_score": lambda: ({"status": {
+                "phase": rng.choice(["Ready", "Failed", "Deploying",
+                                     self._gen(rng)]),
+                "conditions": [], "smoke_history": []}},),
+            # every field fuzzed — a generator that pins all-but-one field
+            # cannot catch divergences in the pinned ones (the r5 review
+            # confirmed simulated=1 diverging while 'simulated': False
+            # sailed through)
+            "smoke_trend": lambda: ([
+                {"ts": 1.0, "gbps": self._gen(rng), "chips": 16,
+                 "passed": self._gen(rng), "simulated": self._gen(rng)}
+                for _ in range(rng.randrange(3))],),
+            # now/window spread so the out-of-window filter branch runs
+            "event_rollup": lambda: ([
+                {"type": rng.choice(["Normal", "Warning", self._gen(rng)]),
+                 "created_at": float(rng.randrange(0, 200000)),
+                 "reason": "R", "message": "m"}
+                for _ in range(rng.randrange(4))],
+                float(rng.randrange(0, 200000)),
+                rng.choice([3600, 86400])),
         }
         import copy
 
