@@ -1,0 +1,73 @@
+"""ko-analyze — static analysis over the platform's artifacts and code.
+
+Two engines, one report:
+
+* `artifacts` — cross-artifact linter resolving every reference between
+  playbooks, roles, templates, the offline bundle contract, SQL
+  migrations, and TPU plan topology (rules KO-X001..KO-X008).
+* `astcheck` — project-rule AST checker over the python package itself
+  (rules KO-P001..KO-P005: repository layering, non-blocking handlers,
+  lock discipline, mutable defaults, bare excepts).
+
+`run_analysis()` is the single entry point `koctl lint`, the
+`/api/v1/analysis` endpoint, and the tier-1 static gate
+(tests/test_static_gate.py) all share. docs/analysis.md documents every
+rule id and how to add one.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+from kubeoperator_tpu.analysis.artifacts import ARTIFACT_RULES, AnalysisContext
+from kubeoperator_tpu.analysis.astcheck import AST_RULES, run_ast_rules
+from kubeoperator_tpu.analysis.report import (
+    ERROR,
+    RULES,
+    WARNING,
+    Finding,
+    Report,
+    RuleSpec,
+)
+
+__all__ = [
+    "ERROR", "WARNING", "Finding", "Report", "RuleSpec", "RULES",
+    "default_root", "run_analysis",
+]
+
+
+def default_root() -> str:
+    """The installed package dir — content/, repository/migrations/ and all
+    platform python live under it, so it IS the analysis universe."""
+    return os.path.dirname(os.path.abspath(__file__)).rsplit(os.sep, 1)[0]
+
+
+def run_analysis(root: str | None = None, plan_files=(),
+                 rule_ids=None) -> Report:
+    """Run the selected rules (default: all registered) over `root`.
+
+    Internal analyzer failures propagate as exceptions — the CLI maps them
+    to exit code 2; a gate must never mistake a crashed analyzer for a
+    clean tree.
+    """
+    root = os.path.abspath(root or default_root())
+    start = time.perf_counter()
+    ctx = AnalysisContext(root=root, plan_files=tuple(plan_files))
+    report = Report(root=root)
+    for rule_id, rule_fn in ARTIFACT_RULES.items():
+        if rule_ids is not None and rule_id not in rule_ids:
+            continue
+        report.extend(rule_fn(ctx))
+        report.rules_run.append(rule_id)
+    ast_selected = [
+        rid for rid in AST_RULES if rule_ids is None or rid in rule_ids
+    ]
+    if ast_selected:
+        findings, scanned = run_ast_rules(root, set(ast_selected))
+        report.extend(findings)
+        report.rules_run.extend(ast_selected)
+        report.files_scanned += scanned
+    report.files_scanned += ctx.files_scanned
+    report.runtime_s = time.perf_counter() - start
+    return report

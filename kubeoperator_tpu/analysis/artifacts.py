@@ -1,0 +1,599 @@
+"""Cross-artifact linter: the declarative surface checked as a whole.
+
+The platform's correctness lives mostly in artifacts no interpreter ever
+parses until a deploy is already running — phase playbooks, 40+ content
+roles, jinja manifest templates, the offline bundle contract, SQL
+migrations, TPU plan topology. Each rule here resolves one cross-artifact
+reference class statically so a broken reference dies in `koctl lint` / CI,
+not at phase 7 of a real cluster create.
+
+Every rule is a pure function (AnalysisContext) -> list[Finding] and takes
+optional injection parameters so tests can aim it at fixture trees without
+stubbing imports.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from dataclasses import dataclass
+
+import yaml
+
+from kubeoperator_tpu.analysis.report import Finding
+
+
+@dataclass
+class AnalysisContext:
+    """Where the artifacts live. `root` is the package dir (the default) or
+    a fixture tree shaped like one; reported paths are relative to its
+    parent so they read `kubeoperator_tpu/content/...` in real runs.
+
+    File text is cached per path: several rules walk the same content tree,
+    and the cache keeps that one read per file — which also makes
+    `files_scanned` count files, not reads."""
+
+    root: str
+    plan_files: tuple = ()
+    files_scanned: int = 0
+
+    def __post_init__(self) -> None:
+        self._text_cache: dict = {}
+        self._content_lines: list | None = None
+
+    @property
+    def content_dir(self) -> str:
+        return os.path.join(self.root, "content")
+
+    @property
+    def roles_dir(self) -> str:
+        return os.path.join(self.content_dir, "roles")
+
+    @property
+    def playbooks_dir(self) -> str:
+        return os.path.join(self.content_dir, "playbooks")
+
+    @property
+    def migrations_dir(self) -> str:
+        return os.path.join(self.root, "repository", "migrations")
+
+    def rel(self, path: str) -> str:
+        return os.path.relpath(path, os.path.dirname(self.root) or ".")
+
+    def roles(self) -> list:
+        if not os.path.isdir(self.roles_dir):
+            return []
+        return sorted(
+            d for d in os.listdir(self.roles_dir)
+            if os.path.isdir(os.path.join(self.roles_dir, d))
+        )
+
+    def playbooks(self) -> list:
+        if not os.path.isdir(self.playbooks_dir):
+            return []
+        return sorted(
+            f for f in os.listdir(self.playbooks_dir) if f.endswith(".yml")
+        )
+
+    def content_lines(self) -> list:
+        """(path, lines) for every content text file — the tree is walked
+        and each file split ONCE, shared by the line-scanning rules
+        (KO-X005/X007/X008)."""
+        if self._content_lines is None:
+            self._content_lines = [
+                (path, self.read(path).splitlines())
+                for path in _iter_content_text_files(self)
+            ]
+        return self._content_lines
+
+    def load_yaml(self, path: str):
+        return yaml.safe_load(self.read(path))
+
+    def read(self, path: str) -> str:
+        if path not in self._text_cache:
+            with open(path, encoding="utf-8") as f:
+                self._text_cache[path] = f.read()
+            self.files_scanned += 1
+        return self._text_cache[path]
+
+
+def _task_module_arg(task: dict, *modules: str):
+    """Fetch a module's args from a task dict, tolerating both bare
+    (`template:`) and FQCN (`ansible.builtin.template:`) spellings."""
+    for mod in modules:
+        for key in (mod, f"ansible.builtin.{mod}"):
+            if key in task:
+                return task[key]
+    return None
+
+
+def _iter_role_task_files(ctx: AnalysisContext):
+    for role in ctx.roles():
+        tasks_dir = os.path.join(ctx.roles_dir, role, "tasks")
+        if not os.path.isdir(tasks_dir):
+            continue
+        for fn in sorted(os.listdir(tasks_dir)):
+            if fn.endswith((".yml", ".yaml")):
+                yield role, os.path.join(tasks_dir, fn)
+
+
+# ---------------------------------------------------------------- KO-X001 ---
+def check_role_resolution(ctx: AnalysisContext) -> list:
+    """Playbook `roles:` entries resolve to real roles; every role has an
+    entry point. Dangling roles are the classic drift: a role rename that
+    missed one playbook fails at runtime with ansible's least helpful
+    error."""
+    findings: list = []
+    known = set(ctx.roles())
+    for role in sorted(known):
+        main = os.path.join(ctx.roles_dir, role, "tasks", "main.yml")
+        if not os.path.exists(main):
+            findings.append(Finding(
+                "KO-X001", ctx.rel(os.path.join(ctx.roles_dir, role)), 0,
+                f"role {role!r} has no tasks/main.yml entry point",
+            ))
+    for pb in ctx.playbooks():
+        path = os.path.join(ctx.playbooks_dir, pb)
+        try:
+            plays = ctx.load_yaml(path) or []
+        except yaml.YAMLError as e:
+            findings.append(Finding(
+                "KO-X001", ctx.rel(path), 0, f"unparseable playbook: {e}"
+            ))
+            continue
+        if not isinstance(plays, list):
+            continue  # shape findings belong to KO-X003
+        for play in plays:
+            if not isinstance(play, dict):
+                continue
+            for entry in play.get("roles") or []:
+                name = entry.get("role") if isinstance(entry, dict) else entry
+                if not isinstance(name, str):
+                    continue
+                if name not in known or not os.path.exists(os.path.join(
+                        ctx.roles_dir, name, "tasks", "main.yml")):
+                    findings.append(Finding(
+                        "KO-X001", ctx.rel(path), 0,
+                        f"playbook references missing role {name!r}",
+                    ))
+    return findings
+
+
+# ---------------------------------------------------------------- KO-X002 ---
+# literal filenames worth resolving when they appear inside a jinja
+# expression (the tpu-smoke-test conditional src pattern)
+_LITERAL_CANDIDATE_RE = re.compile(
+    r"'([\w.-]+\.(?:j2|yml|yaml|py|sh|conf|cfg|toml|repo))'"
+)
+
+
+def _src_candidates(src: str) -> tuple:
+    """(candidates, computed): literal filenames to resolve, and whether the
+    source is runtime-computed (jinja with no literal file candidates —
+    exempt, the linter cannot know the rendered value)."""
+    if "{{" not in src and "{%" not in src:
+        return (src,), False
+    candidates = tuple(_LITERAL_CANDIDATE_RE.findall(src))
+    return candidates, not candidates
+
+
+def check_file_resolution(ctx: AnalysisContext) -> list:
+    """template/copy/script sources and include_tasks targets resolve on
+    disk. Search path mirrors ansible's: templates/ for the template
+    module, files/ then templates/ for copy/script, the including file's
+    dir for include_tasks (which also covers the repo's cross-role
+    `../../role/tasks/x.yml` composition idiom)."""
+    findings: list = []
+    for role, path in _iter_role_task_files(ctx):
+        try:
+            tasks = ctx.load_yaml(path) or []
+        except yaml.YAMLError as e:
+            findings.append(Finding(
+                "KO-X002", ctx.rel(path), 0, f"unparseable task file: {e}"
+            ))
+            continue
+        role_dir = os.path.join(ctx.roles_dir, role)
+        for task in tasks if isinstance(tasks, list) else []:
+            if not isinstance(task, dict):
+                continue
+            for modules, search in (
+                (("template",), ("templates",)),
+                (("copy", "script"), ("files", "templates")),
+            ):
+                args = _task_module_arg(task, *modules)
+                src = args.get("src") if isinstance(args, dict) else None
+                if not isinstance(src, str) or src.startswith("/"):
+                    continue  # node-absolute paths live on the target host
+                candidates, computed = _src_candidates(src)
+                if computed:
+                    continue
+                for cand in candidates:
+                    if cand.startswith("/"):
+                        continue
+                    if not any(
+                        os.path.exists(os.path.join(role_dir, d, cand))
+                        for d in search
+                    ):
+                        findings.append(Finding(
+                            "KO-X002", ctx.rel(path), 0,
+                            f"role {role!r}: src {cand!r} not found under "
+                            f"{' or '.join(search)}/",
+                        ))
+            inc = _task_module_arg(task, "include_tasks", "import_tasks")
+            target = inc.get("file") if isinstance(inc, dict) else inc
+            if isinstance(target, str) and "{{" not in target:
+                resolved = os.path.normpath(
+                    os.path.join(os.path.dirname(path), target)
+                )
+                if not os.path.exists(resolved):
+                    findings.append(Finding(
+                        "KO-X002", ctx.rel(path), 0,
+                        f"role {role!r}: include_tasks target {target!r} "
+                        f"does not exist",
+                    ))
+    return findings
+
+
+# ---------------------------------------------------------------- KO-X003 ---
+def _default_referenced_playbooks() -> dict:
+    """Playbooks the python layer launches, by referencing symbol — the adm
+    phase lists plus the component catalog."""
+    import kubeoperator_tpu.adm.phases as phases_mod
+    from kubeoperator_tpu.models.component import COMPONENT_CATALOG
+
+    refs: dict = {}
+    for name in dir(phases_mod):
+        if name.endswith("_phases") and not name.startswith("_"):
+            for phase in getattr(phases_mod, name)():
+                refs.setdefault(phase.playbook, set()).add(
+                    f"adm/phases.py:{name}"
+                )
+    for comp, entry in COMPONENT_CATALOG.items():
+        for key in ("playbook", "uninstall_playbook"):
+            if entry.get(key):
+                refs.setdefault(entry[key], set()).add(
+                    f"models/component.py:{comp}"
+                )
+    refs.setdefault("component-uninstall.yml", {"models/component.py"})
+    return refs
+
+
+def check_phase_playbooks(ctx: AnalysisContext, referenced: dict | None = None
+                          ) -> list:
+    findings: list = []
+    present = set(ctx.playbooks())
+    referenced = (_default_referenced_playbooks()
+                  if referenced is None else referenced)
+    for playbook, sources in sorted(referenced.items()):
+        if playbook not in present:
+            findings.append(Finding(
+                "KO-X003", ctx.rel(ctx.playbooks_dir), 0,
+                f"playbook {playbook!r} (referenced by "
+                f"{', '.join(sorted(sources))}) is missing",
+            ))
+    for pb in sorted(present):
+        path = os.path.join(ctx.playbooks_dir, pb)
+        try:
+            plays = ctx.load_yaml(path)
+        except yaml.YAMLError:
+            continue  # reported by KO-X001
+        if not isinstance(plays, list) or not plays:
+            findings.append(Finding(
+                "KO-X003", ctx.rel(path), 0,
+                "playbook must be a non-empty list of plays",
+            ))
+            continue
+        for play in plays:
+            if not isinstance(play, dict) or "hosts" not in play:
+                findings.append(Finding(
+                    "KO-X003", ctx.rel(path), 0,
+                    "play is missing its hosts: pattern",
+                ))
+    return findings
+
+
+# ---------------------------------------------------------------- KO-X004 ---
+def _catalog_sizes(gen) -> list:
+    sizes = set(gen.single_host_chip_sizes) | {16, 32, 64, 128, 256}
+    return sorted(
+        s for s in sizes
+        if s <= gen.max_chips
+        and (s in gen.single_host_chip_sizes or s % gen.chips_per_host == 0)
+    )
+
+
+def check_plan_topology(ctx: AnalysisContext) -> list:
+    """The topology math everything downstream treats as ground truth: every
+    selectable catalog shape must resolve and self-validate (mesh product ==
+    chips, host math), and any plan YAML passed with --plan must survive the
+    full Plan.validate() (provider capability + derived host count)."""
+    from kubeoperator_tpu.models.infra import PLAN_FIELDS, Plan
+    from kubeoperator_tpu.parallel.topology import GENERATIONS, parse_accelerator_type
+    from kubeoperator_tpu.utils.errors import KoError
+
+    findings: list = []
+    topo_file = "kubeoperator_tpu/parallel/topology.py"
+    for gen in GENERATIONS.values():
+        for chips in _catalog_sizes(gen):
+            name = f"{gen.name}-{gen.suffix_from_chips(chips)}"
+            try:
+                topo = parse_accelerator_type(name)
+            except KoError as e:
+                findings.append(Finding(
+                    "KO-X004", topo_file, 0,
+                    f"catalog shape {name}: {e.message}",
+                ))
+                continue
+            import math
+
+            if math.prod(topo.ici_mesh) != topo.chips:
+                findings.append(Finding(
+                    "KO-X004", topo_file, 0,
+                    f"{name}: derived mesh {topo.gcp_topology} has "
+                    f"{math.prod(topo.ici_mesh)} chips, slice has "
+                    f"{topo.chips}",
+                ))
+            if (topo.hosts_per_slice > 1
+                    and topo.hosts_per_slice * gen.chips_per_host
+                    != topo.chips):
+                findings.append(Finding(
+                    "KO-X004", topo_file, 0,
+                    f"{name}: {topo.hosts_per_slice} hosts x "
+                    f"{gen.chips_per_host} chips/host != {topo.chips}",
+                ))
+        if not gen.default_runtime_version:
+            findings.append(Finding(
+                "KO-X004", topo_file, 0,
+                f"generation {gen.name} has no default runtime version",
+            ))
+
+    for plan_file in ctx.plan_files:
+        try:
+            doc = ctx.load_yaml(plan_file)
+        except (OSError, yaml.YAMLError) as e:
+            findings.append(Finding(
+                "KO-X004", plan_file, 0, f"unreadable plan file: {e}"
+            ))
+            continue
+        plans = doc.get("plans", [doc]) if isinstance(doc, dict) else []
+        if not isinstance(plans, list) or not plans:
+            findings.append(Finding(
+                "KO-X004", plan_file, 0, "no plan mapping in file"
+            ))
+            continue
+        for raw in plans:
+            if not isinstance(raw, dict):
+                continue
+            name = str(raw.get("name", "") or "<unnamed>")
+            # TypeError/ValueError too, not just KoError: a dirty plan file
+            # (master_count: "three") is a FINDING (exit 1), never an
+            # analyzer crash (exit 2 means the gate itself is broken)
+            try:
+                plan = Plan(**{k: raw[k] for k in PLAN_FIELDS if k in raw})
+                plan.validate()
+                if plan.has_tpu():
+                    plan.topology().validate()
+            except KoError as e:
+                findings.append(Finding(
+                    "KO-X004", plan_file, 0, f"plan {name}: {e.message}",
+                ))
+            except (TypeError, ValueError) as e:
+                findings.append(Finding(
+                    "KO-X004", plan_file, 0,
+                    f"plan {name}: malformed plan mapping: {e}",
+                ))
+    return findings
+
+
+# ---------------------------------------------------------------- KO-X005 ---
+# `{{ registry_url ... }}/path/to/image:{{ tag_var ... }}` (or literal tag)
+_IMAGE_REF_RE = re.compile(
+    r"\{\{\s*registry_(?:url|host)[^}]*\}\}/"
+    r"(?P<path>[A-Za-z0-9._/-]+):"
+    r"(?P<tag>\{\{\s*(?P<tagvar>[A-Za-z_][A-Za-z0-9_]*)[^}]*\}\}|[\w.-]+)"
+)
+
+
+def _iter_content_text_files(ctx: AnalysisContext):
+    for base, _dirs, files in os.walk(ctx.content_dir):
+        for fn in sorted(files):
+            if fn.endswith((".yml", ".yaml", ".j2", ".toml", ".repo")):
+                yield os.path.join(base, fn)
+
+
+def check_image_pins(ctx: AnalysisContext, contract: dict | None = None,
+                     artifacts: list | None = None) -> list:
+    """Every image reference a template renders must be declared in the
+    offline bundle's image contract with the tag var the contract pins, and
+    the contract's tarball must be in the bundle manifest — so an air-gapped
+    cluster can never be told to pull an image the bundle doesn't carry."""
+    if contract is None:
+        from kubeoperator_tpu.registry.manifest import TEMPLATED_IMAGES
+
+        contract = TEMPLATED_IMAGES
+    if artifacts is None:
+        from kubeoperator_tpu.registry.manifest import bundle_manifest
+
+        artifacts = bundle_manifest()["artifacts"]
+    findings: list = []
+    for path, lines in ctx.content_lines():
+        for lineno, line in enumerate(lines, 1):
+            for m in _IMAGE_REF_RE.finditer(line):
+                image = m.group("path")
+                entry = contract.get(image)
+                if entry is None:
+                    findings.append(Finding(
+                        "KO-X005", ctx.rel(path), lineno,
+                        f"image {image!r} is not in the offline bundle "
+                        f"image contract (registry/manifest.py "
+                        f"TEMPLATED_IMAGES)",
+                    ))
+                    continue
+                tag_var, tarball = entry
+                rendered_var = m.group("tagvar")
+                if rendered_var != tag_var:
+                    got = rendered_var or f"literal {m.group('tag')!r}"
+                    findings.append(Finding(
+                        "KO-X005", ctx.rel(path), lineno,
+                        f"image {image!r} tag renders from {got}; the "
+                        f"bundle contract pins it via {tag_var!r}",
+                    ))
+                if tarball not in artifacts:
+                    findings.append(Finding(
+                        "KO-X005", ctx.rel(path), lineno,
+                        f"image {image!r}: contract tarball {tarball!r} is "
+                        f"missing from the bundle manifest",
+                    ))
+    return findings
+
+
+# ---------------------------------------------------------------- KO-X006 ---
+def check_migrations(ctx: AnalysisContext) -> list:
+    """Migration files must form an unbroken, unambiguous 001..N sequence of
+    complete SQL: a gap or duplicate number silently skips (or re-skips)
+    DDL at boot, and an incomplete trailing statement would die mid-
+    transaction on the next fresh install."""
+    findings: list = []
+    mig_dir = ctx.migrations_dir
+    if not os.path.isdir(mig_dir):
+        return findings
+    # the boot runner's OWN naming/splitting rules — importing them (not
+    # copying) is the point: the linter validates exactly the contract
+    # Database.migrate() executes
+    from kubeoperator_tpu.repository.db import (
+        _MIGRATION_RE,
+        _split_statements,
+        statement_is_complete,
+    )
+
+    seen: dict = {}
+    numbers: list = []
+    for fname in sorted(os.listdir(mig_dir)):
+        path = os.path.join(mig_dir, fname)
+        if not os.path.isfile(path):
+            continue
+        m = _MIGRATION_RE.match(fname)
+        if not m:
+            findings.append(Finding(
+                "KO-X006", ctx.rel(path), 0,
+                "migration name must match NNN_slug.sql (the boot runner "
+                "ignores anything else, so this file would never apply)",
+            ))
+            continue
+        version = m.group(1)
+        if version in seen:
+            findings.append(Finding(
+                "KO-X006", ctx.rel(path), 0,
+                f"duplicate migration number {version} (also {seen[version]}); "
+                f"only one of them will ever be recorded as applied",
+            ))
+        else:
+            seen[version] = fname
+            numbers.append(int(version))
+        statements = _split_statements(ctx.read(path))
+        if not statements:
+            findings.append(Finding(
+                "KO-X006", ctx.rel(path), 0, "migration contains no SQL"
+            ))
+        for stmt in statements:
+            if not statement_is_complete(stmt):
+                findings.append(Finding(
+                    "KO-X006", ctx.rel(path), 0,
+                    f"incomplete SQL statement (missing ';'?): "
+                    f"{stmt.splitlines()[0][:60]!r}",
+                ))
+    expected = list(range(1, len(numbers) + 1))
+    if numbers and sorted(numbers) != expected:
+        missing = sorted(set(expected) - set(numbers))
+        findings.append(Finding(
+            "KO-X006", ctx.rel(mig_dir), 0,
+            f"migration numbering has gaps: missing "
+            f"{', '.join(f'{n:03d}' for n in missing)}",
+        ))
+    return findings
+
+
+# ---------------------------------------------------------------- KO-X007 ---
+_MANIFEST_REF_RE = re.compile(r"/opt/ko-manifests/([\w.-]+)")
+
+
+def check_manifest_refs(ctx: AnalysisContext, bundled: tuple | None = None,
+                        generated: tuple | None = None) -> list:
+    """Files roles apply from /opt/ko-manifests/ must be bundle-shipped, and
+    every generated manifest must be listed as bundled — drift in either
+    direction strands a role (apply of a file the installer never wrote) or
+    the bundle (a generator whose output nothing ships)."""
+    if bundled is None:
+        from kubeoperator_tpu.registry.k8s_manifests import BUNDLED_MANIFESTS
+
+        bundled = BUNDLED_MANIFESTS
+    if generated is None:
+        from kubeoperator_tpu.registry.k8s_manifests import GENERATED
+
+        generated = tuple(GENERATED)
+    findings: list = []
+    for path, lines in ctx.content_lines():
+        for lineno, line in enumerate(lines, 1):
+            for name in _MANIFEST_REF_RE.findall(line):
+                if name not in bundled:
+                    findings.append(Finding(
+                        "KO-X007", ctx.rel(path), lineno,
+                        f"/opt/ko-manifests/{name} is not in "
+                        f"BUNDLED_MANIFESTS — the installer never ships it",
+                    ))
+    for name in generated:
+        if name not in bundled:
+            findings.append(Finding(
+                "KO-X007", "kubeoperator_tpu/registry/k8s_manifests.py", 0,
+                f"generated manifest {name!r} is not listed in "
+                f"BUNDLED_MANIFESTS",
+            ))
+    return findings
+
+
+# ---------------------------------------------------------------- KO-X008 ---
+_VERSION_VAR_RE = re.compile(
+    r"\{\{\s*([A-Za-z_][A-Za-z0-9_]*_version)(?![A-Za-z0-9_])([^}]*)\}\}"
+)
+
+
+def _default_supplied_version_vars() -> frozenset:
+    from kubeoperator_tpu.registry.manifest import COMPONENT_VERSIONS
+
+    return frozenset(
+        {f"{k}_version" for k in COMPONENT_VERSIONS}
+        | {"tpu_runtime_version", "k8s_version"}
+    )
+
+
+def check_version_vars(ctx: AnalysisContext, supplied: frozenset | None = None
+                       ) -> list:
+    """Every `*_version` var content consumes must be supplied by the
+    engine's extra-vars contract or carry an inline default — otherwise the
+    template renders an AnsibleUndefined into a manifest on a real node."""
+    if supplied is None:
+        supplied = _default_supplied_version_vars()
+    findings: list = []
+    for path, lines in ctx.content_lines():
+        for lineno, line in enumerate(lines, 1):
+            for var, rest in _VERSION_VAR_RE.findall(line):
+                if var in supplied or "default(" in rest:
+                    continue
+                findings.append(Finding(
+                    "KO-X008", ctx.rel(path), lineno,
+                    f"version var {var!r} is not supplied by the extra-vars "
+                    f"contract and has no inline default",
+                ))
+    return findings
+
+
+ARTIFACT_RULES = {
+    "KO-X001": check_role_resolution,
+    "KO-X002": check_file_resolution,
+    "KO-X003": check_phase_playbooks,
+    "KO-X004": check_plan_topology,
+    "KO-X005": check_image_pins,
+    "KO-X006": check_migrations,
+    "KO-X007": check_manifest_refs,
+    "KO-X008": check_version_vars,
+}

@@ -1,0 +1,326 @@
+"""Project-rule AST checker over the python package itself.
+
+These are not style rules — each encodes a correctness invariant this
+codebase relies on and has been bitten by elsewhere: the repository layer
+owns the database handle, request handlers never block the event loop, and
+a field protected by a lock in one method is protected everywhere (the
+lock-discipline rule is a lightweight write-write race detector aimed at
+executor/base.py, terminal/manager.py and the api layer's shared state).
+
+Every rule is a pure function (root_dir) -> list[Finding]; the scanner
+parses each file once and hands the same tree to all selected rules.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from kubeoperator_tpu.analysis.report import Finding
+
+# dirs under the package root that are not platform python code: content/
+# carries node-side payload scripts, __pycache__ is noise
+_SKIP_DIRS = {"content", "__pycache__"}
+
+
+def iter_python_files(root: str):
+    for base, dirs, files in os.walk(root):
+        dirs[:] = sorted(d for d in dirs if d not in _SKIP_DIRS)
+        for fn in sorted(files):
+            if fn.endswith(".py"):
+                yield os.path.join(base, fn)
+
+
+def _rel(root: str, path: str) -> str:
+    return os.path.relpath(path, os.path.dirname(root) or ".")
+
+
+# ---------------------------------------------------------------- KO-P001 ---
+def check_repo_layering(root: str, tree: ast.AST, path: str) -> list:
+    """sqlite3 may be touched only under repository/ — every other layer
+    goes through Repositories, so schema, locking, and transaction scope
+    stay in one place."""
+    rel = _rel(root, path)
+    if "repository" in os.path.relpath(path, root).split(os.sep)[:-1]:
+        return []
+    findings: list = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            names = [a.name for a in node.names]
+        elif isinstance(node, ast.ImportFrom):
+            names = [node.module or ""]
+        else:
+            continue
+        for name in names:
+            if name == "sqlite3" or name.startswith("sqlite3."):
+                findings.append(Finding(
+                    "KO-P001", rel, node.lineno,
+                    "sqlite3 imported outside the repository layer — DB "
+                    "access goes through kubeoperator_tpu.repository",
+                ))
+    return findings
+
+
+# ---------------------------------------------------------------- KO-P002 ---
+_BLOCKING_CALLS = {
+    ("time", frozenset({"sleep"})),
+    ("_time", frozenset({"sleep"})),
+    ("subprocess", frozenset({
+        "run", "call", "check_call", "check_output", "Popen",
+    })),
+    ("requests", frozenset({
+        "get", "post", "put", "delete", "head", "request", "Session",
+    })),
+    ("_requests", frozenset({
+        "get", "post", "put", "delete", "head", "request",
+    })),
+    ("os", frozenset({"system"})),
+}
+
+
+def _blocking_call_name(call: ast.Call) -> str | None:
+    func = call.func
+    if not isinstance(func, ast.Attribute) or not isinstance(func.value, ast.Name):
+        return None
+    for base, attrs in _BLOCKING_CALLS:
+        if func.value.id == base and func.attr in attrs:
+            return f"{func.value.id}.{func.attr}"
+    return None
+
+
+class _AsyncBodyScanner(ast.NodeVisitor):
+    """Walk an async function's own body, NOT descending into nested
+    function defs: a sync closure defined inside a handler is the run_sync
+    off-load idiom (it executes on a worker thread), and nested async defs
+    get their own top-level visit."""
+
+    def __init__(self) -> None:
+        self.calls: list = []
+
+    def visit_FunctionDef(self, node):  # noqa: N802 — do not descend
+        pass
+
+    def visit_AsyncFunctionDef(self, node):  # noqa: N802 — own visit
+        pass
+
+    def visit_Call(self, node):  # noqa: N802
+        name = _blocking_call_name(node)
+        if name:
+            self.calls.append((node.lineno, name))
+        self.generic_visit(node)
+
+
+def check_blocking_handlers(root: str, tree: ast.AST, path: str) -> list:
+    findings: list = []
+    rel = _rel(root, path)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.AsyncFunctionDef):
+            continue
+        scanner = _AsyncBodyScanner()
+        for stmt in node.body:
+            scanner.visit(stmt)
+        for lineno, name in scanner.calls:
+            findings.append(Finding(
+                "KO-P002", rel, lineno,
+                f"blocking {name}() inside async {node.name}() — this "
+                f"stalls the event loop; off-load via run_sync/to_thread",
+            ))
+    return findings
+
+
+# ---------------------------------------------------------------- KO-P003 ---
+_LOCK_FACTORIES = {"Lock", "RLock", "Condition", "Semaphore",
+                   "BoundedSemaphore"}
+
+
+def _self_attr(node) -> str | None:
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+# `_lock`, `lock`, `_ops_lock`, `write_lock`, ... — NOT `lock_timeout`
+_LOCK_NAME_RE = re.compile(r"^_?(?:[a-z0-9_]+_)?lock$")
+
+
+def _lock_attrs_of_class(cls: ast.ClassDef) -> set:
+    """Attributes assigned a threading lock/condition anywhere in the
+    class, plus lock-NAMED attributes regardless of what they're assigned
+    (`self._lock = lock` injection / aliasing would otherwise exempt the
+    whole class from the race detector)."""
+    locks: set = set()
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Assign):
+            continue
+        factory = ""
+        if isinstance(node.value, ast.Call):
+            func = node.value.func
+            factory = (func.attr if isinstance(func, ast.Attribute)
+                       else func.id if isinstance(func, ast.Name) else "")
+        for target in node.targets:
+            attr = _self_attr(target)
+            if attr and (factory in _LOCK_FACTORIES
+                         or _LOCK_NAME_RE.match(attr)):
+                locks.add(attr)
+    return locks
+
+
+class _LockWriteScanner(ast.NodeVisitor):
+    """Record self-attribute writes, split by whether a `with self.<lock>`
+    is lexically held. Nested function defs are skipped: a closure runs on
+    whatever thread calls it, so its writes can't be attributed here."""
+
+    def __init__(self, lock_attrs: set) -> None:
+        self.lock_attrs = lock_attrs
+        self.held = 0
+        self.inside: dict = {}
+        self.outside: dict = {}
+
+    def visit_FunctionDef(self, node):  # noqa: N802
+        pass
+
+    def visit_AsyncFunctionDef(self, node):  # noqa: N802
+        pass
+
+    def visit_With(self, node):  # noqa: N802
+        holds = any(
+            _self_attr(item.context_expr) in self.lock_attrs
+            for item in node.items
+        )
+        if holds:
+            self.held += 1
+        self.generic_visit(node)
+        if holds:
+            self.held -= 1
+
+    def _record(self, target, lineno: int) -> None:
+        attr = _self_attr(target)
+        if attr and attr not in self.lock_attrs:
+            bucket = self.inside if self.held else self.outside
+            bucket.setdefault(attr, []).append(lineno)
+
+    def visit_Assign(self, node):  # noqa: N802
+        for target in node.targets:
+            self._record(target, node.lineno)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node):  # noqa: N802
+        self._record(node.target, node.lineno)
+        self.generic_visit(node)
+
+
+def check_lock_discipline(root: str, tree: ast.AST, path: str) -> list:
+    """Flag fields written both under a held lock and bare. Exemptions by
+    convention: __init__ (no concurrency before construction completes)
+    and *_locked methods (documented as called with the lock held)."""
+    findings: list = []
+    rel = _rel(root, path)
+    for cls in ast.walk(tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        lock_attrs = _lock_attrs_of_class(cls)
+        if not lock_attrs:
+            continue
+        inside: dict = {}
+        outside: dict = {}
+        for method in cls.body:
+            if not isinstance(method, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if method.name == "__init__" or method.name.endswith("_locked"):
+                continue
+            scanner = _LockWriteScanner(lock_attrs)
+            for stmt in method.body:
+                scanner.visit(stmt)
+            for attr, lines in scanner.inside.items():
+                inside.setdefault(attr, []).extend(
+                    (method.name, ln) for ln in lines)
+            for attr, lines in scanner.outside.items():
+                outside.setdefault(attr, []).extend(
+                    (method.name, ln) for ln in lines)
+        for attr in sorted(set(inside) & set(outside)):
+            locked_at = ", ".join(
+                f"{m}:{ln}" for m, ln in sorted(inside[attr])[:3])
+            bare_method, bare_line = sorted(outside[attr])[0]
+            findings.append(Finding(
+                "KO-P003", rel, bare_line,
+                f"{cls.name}.{attr} is written under "
+                f"{'/'.join(sorted(lock_attrs))} ({locked_at}) but bare in "
+                f"{bare_method}() — a write-write race",
+            ))
+    return findings
+
+
+# ---------------------------------------------------------------- KO-P004 ---
+def _is_mutable_default(node) -> bool:
+    if isinstance(node, (ast.List, ast.Dict, ast.Set)):
+        return True
+    return (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+            and node.func.id in {"list", "dict", "set", "bytearray"}
+            and not node.args and not node.keywords)
+
+
+def check_mutable_defaults(root: str, tree: ast.AST, path: str) -> list:
+    findings: list = []
+    rel = _rel(root, path)
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+            continue
+        defaults = list(node.args.defaults) + [
+            d for d in node.args.kw_defaults if d is not None
+        ]
+        for default in defaults:
+            if _is_mutable_default(default):
+                name = getattr(node, "name", "<lambda>")
+                findings.append(Finding(
+                    "KO-P004", rel, default.lineno,
+                    f"mutable default argument on {name}() — one shared "
+                    f"instance aliases across every call",
+                ))
+    return findings
+
+
+# ---------------------------------------------------------------- KO-P005 ---
+def check_bare_except(root: str, tree: ast.AST, path: str) -> list:
+    findings: list = []
+    rel = _rel(root, path)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ExceptHandler) and node.type is None:
+            findings.append(Finding(
+                "KO-P005", rel, node.lineno,
+                "bare except: swallows KeyboardInterrupt/SystemExit — "
+                "catch Exception or narrower",
+            ))
+    return findings
+
+
+AST_RULES = {
+    "KO-P001": check_repo_layering,
+    "KO-P002": check_blocking_handlers,
+    "KO-P003": check_lock_discipline,
+    "KO-P004": check_mutable_defaults,
+    "KO-P005": check_bare_except,
+}
+
+
+def run_ast_rules(root: str, rule_ids=None) -> tuple:
+    """Parse each package file once, apply the selected rules to the shared
+    tree. Returns (findings, files_scanned). A syntactically broken file
+    raises — the gate must hard-fail (exit 2), not report it as a lint
+    finding that a --format json consumer might filter away."""
+    selected = {
+        rid: fn for rid, fn in AST_RULES.items()
+        if rule_ids is None or rid in rule_ids
+    }
+    findings: list = []
+    scanned = 0
+    for path in iter_python_files(root):
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+        tree = ast.parse(source, filename=path)
+        scanned += 1
+        for fn in selected.values():
+            findings.extend(fn(root, tree, path))
+    return findings, scanned

@@ -1,0 +1,213 @@
+"""ko-analyze report model + rule registry.
+
+A Finding is one defect at one location; a Report is an ordered, counted,
+machine-readable collection of them. The RULES registry is the single place
+a rule id, its severity, and its one-line contract live — `koctl lint`
+renders it for --help, docs/analysis.md documents it, and the engines
+(artifacts.py / astcheck.py) attach findings to it. Adding a rule without
+registering it here is itself an internal error (the engines refuse unknown
+ids), so the docs can never silently lag the checker.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+
+from kubeoperator_tpu.version import __version__
+
+ERROR = "error"
+WARNING = "warning"
+
+
+@dataclass(frozen=True)
+class RuleSpec:
+    """One registered rule: identity + default severity + contract."""
+
+    id: str            # stable id, e.g. "KO-X001"
+    name: str          # short slug, e.g. "role-resolution"
+    kind: str          # "artifact" | "ast"
+    severity: str      # default severity of its findings
+    summary: str       # one line: what must hold
+
+
+# ---------------------------------------------------------------- registry --
+RULES: dict[str, RuleSpec] = {
+    spec.id: spec
+    for spec in (
+        # ---- cross-artifact rules (artifacts.py) ----
+        RuleSpec(
+            "KO-X001", "role-resolution", "artifact", ERROR,
+            "every role a playbook or cross-role include references exists "
+            "under content/roles/ with a tasks/main.yml",
+        ),
+        RuleSpec(
+            "KO-X002", "file-resolution", "artifact", ERROR,
+            "every template/copy/script src: in role tasks resolves inside "
+            "the role's templates/ or files/ dir (node-absolute paths and "
+            "runtime-computed sources are exempt; literal candidates inside "
+            "jinja conditionals are each checked)",
+        ),
+        RuleSpec(
+            "KO-X003", "phase-playbook", "artifact", ERROR,
+            "every playbook the adm phase lists and the component catalog "
+            "name exists under content/playbooks/ and parses as a list of "
+            "plays with hosts",
+        ),
+        RuleSpec(
+            "KO-X004", "plan-topology", "artifact", ERROR,
+            "TPU plans and every catalog slice shape are topology-consistent "
+            "(mesh axis product == slice chip count, derived host math, "
+            "provider capability: accelerator=tpu requires gcp_tpu_vm)",
+        ),
+        RuleSpec(
+            "KO-X005", "image-pin", "artifact", ERROR,
+            "every container image a content template renders is declared in "
+            "the offline bundle image contract (registry/manifest.py "
+            "TEMPLATED_IMAGES) with the tag var the contract pins, and its "
+            "tarball is listed in the bundle manifest",
+        ),
+        RuleSpec(
+            "KO-X006", "migration-order", "artifact", ERROR,
+            "SQL migrations under repository/migrations/ are named "
+            "NNN_slug.sql, numbered strictly sequentially from 001 with no "
+            "gaps or duplicates, and every statement is complete SQL",
+        ),
+        RuleSpec(
+            "KO-X007", "manifest-ref", "artifact", ERROR,
+            "every /opt/ko-manifests/<file> a role applies is listed in "
+            "BUNDLED_MANIFESTS, and every generated manifest is bundled",
+        ),
+        RuleSpec(
+            "KO-X008", "version-var", "artifact", ERROR,
+            "every *_version jinja var content consumes is supplied by the "
+            "extra-vars contract (COMPONENT_VERSIONS pins, TPU topology "
+            "vars, k8s_version) or carries an inline | default()",
+        ),
+        # ---- project-rule AST checks (astcheck.py) ----
+        RuleSpec(
+            "KO-P001", "repo-layering", "ast", ERROR,
+            "DB access only through the repository layer: sqlite3 is "
+            "imported/used nowhere outside kubeoperator_tpu/repository/",
+        ),
+        RuleSpec(
+            "KO-P002", "blocking-handler", "ast", ERROR,
+            "no blocking call (time.sleep, subprocess.*, requests.*, "
+            "os.system) lexically inside an async function — API handlers "
+            "must off-load sync work via run_sync (sync closures defined "
+            "inside the handler are exempt: they run on the executor)",
+        ),
+        RuleSpec(
+            "KO-P003", "lock-discipline", "ast", ERROR,
+            "a self attribute written inside a `with self.<lock>:` block in "
+            "one method must not also be written outside any lock in "
+            "another (a lightweight write-write race detector; __init__ and "
+            "*_locked helper methods are exempt by convention)",
+        ),
+        RuleSpec(
+            "KO-P004", "mutable-default", "ast", ERROR,
+            "no mutable default argument (list/dict/set literal or "
+            "constructor) on any function — shared-instance aliasing bugs",
+        ),
+        RuleSpec(
+            "KO-P005", "bare-except", "ast", WARNING,
+            "no bare `except:` handler — it swallows KeyboardInterrupt and "
+            "SystemExit; catch Exception (or narrower) instead",
+        ),
+    )
+}
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation at one location."""
+
+    rule: str          # rule id from RULES
+    file: str          # path relative to the analysis root's parent
+    line: int          # 1-based; 0 = whole-file/whole-artifact finding
+    message: str
+    severity: str = ""  # defaults to the rule's registered severity
+
+    def __post_init__(self) -> None:
+        if self.rule not in RULES:
+            raise ValueError(f"finding references unregistered rule {self.rule}")
+        if not self.severity:
+            object.__setattr__(self, "severity", RULES[self.rule].severity)
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "name": RULES[self.rule].name,
+            "severity": self.severity,
+            "file": self.file,
+            "line": self.line,
+            "message": self.message,
+        }
+
+
+@dataclass
+class Report:
+    """The analyzer's output: findings + run metadata, JSON-stable."""
+
+    root: str
+    findings: list[Finding] = field(default_factory=list)
+    rules_run: list[str] = field(default_factory=list)
+    runtime_s: float = 0.0
+    files_scanned: int = 0
+
+    def extend(self, findings: list[Finding]) -> None:
+        self.findings.extend(findings)
+
+    @property
+    def errors(self) -> list[Finding]:
+        return [f for f in self.findings if f.severity == ERROR]
+
+    @property
+    def warnings(self) -> list[Finding]:
+        return [f for f in self.findings if f.severity == WARNING]
+
+    def exit_code(self) -> int:
+        """Tooling contract: 0 clean, 1 error findings (warnings alone stay
+        0 so advisory rules can land before their fixes do), 2 is reserved
+        for internal analyzer failure and raised by the CLI wrapper."""
+        return 1 if self.errors else 0
+
+    def sorted_findings(self) -> list[Finding]:
+        return sorted(
+            self.findings, key=lambda f: (f.file, f.line, f.rule, f.message)
+        )
+
+    def to_dict(self) -> dict:
+        return {
+            "analyzer": "ko-analyze",
+            "version": __version__,
+            "root": self.root,
+            "rules_run": sorted(self.rules_run),
+            "files_scanned": self.files_scanned,
+            "runtime_s": round(self.runtime_s, 3),
+            "counts": {
+                "error": len(self.errors),
+                "warning": len(self.warnings),
+            },
+            "findings": [f.to_dict() for f in self.sorted_findings()],
+        }
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), indent=2)
+
+    def render_text(self) -> str:
+        """Human-readable finding list + one-line summary (koctl default)."""
+        lines = []
+        for f in self.sorted_findings():
+            where = f"{f.file}:{f.line}" if f.line else f.file
+            lines.append(
+                f"{f.severity.upper():7s} {f.rule} [{RULES[f.rule].name}] "
+                f"{where}: {f.message}"
+            )
+        lines.append(
+            f"ko-analyze: {len(self.errors)} error(s), "
+            f"{len(self.warnings)} warning(s) across "
+            f"{len(self.rules_run)} rules, {self.files_scanned} files "
+            f"({self.runtime_s:.2f}s)"
+        )
+        return "\n".join(lines)

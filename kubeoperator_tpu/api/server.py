@@ -224,6 +224,7 @@ class Handlers:
         from kubeoperator_tpu.api.metrics import MetricsRegistry
 
         self.metrics = MetricsRegistry()
+        self._analysis_cache: dict | None = None
 
     async def bundle_manifest_view(self, request):
         """Version-management screen data (reference parity: the console's
@@ -246,6 +247,22 @@ class Handlers:
             "artifact_counts": by_kind,
             "artifact_total": len(manifest.get("artifacts", [])),
         })
+
+    async def analysis_report(self, request):
+        """ko-analyze over the running platform's own installed tree — the
+        console's static-health view (same JSON as `koctl lint --format
+        json`). Admin-gated: findings name internal file paths. Cached per
+        process after the first call (the installed tree cannot change
+        under a running server), `?fresh=1` forces a re-run."""
+        _require_admin(request)
+        from kubeoperator_tpu.analysis import run_analysis
+
+        if request.query.get("fresh") == "1":
+            self._analysis_cache = None
+        if self._analysis_cache is None:
+            report = await run_sync(request, run_analysis)
+            self._analysis_cache = report.to_dict()
+        return json_response(self._analysis_cache)
 
     async def audit_log(self, request):
         from kubeoperator_tpu.utils.errors import ValidationError
@@ -1018,6 +1035,7 @@ def create_app(services: Services) -> web.Application:
     r.add_post("/api/v1/ldap/sync", h.ldap_sync)
     r.add_get("/api/v1/audit", h.audit_log)
     r.add_get("/api/v1/bundle-manifest", h.bundle_manifest_view)
+    r.add_get("/api/v1/analysis", h.analysis_report)
 
     view, manage = Role.VIEWER, Role.MANAGER
     r.add_get("/api/v1/clusters", h.list_clusters)
@@ -1116,12 +1134,10 @@ def create_app(services: Services) -> web.Application:
                               str(body.get("name", "")).strip())
         return json_response(plan.to_public_dict(), status=201)
 
+    from kubeoperator_tpu.models.infra import PLAN_FIELDS
+
     r.add_post("/api/v1/plans/{name}/clone", admin_guard(clone_plan))
-    h._crud_routes(app, "/api/v1/plans", services.plans, Plan,
-                   ("name", "provider", "region_id", "zone_ids",
-                    "master_count", "worker_count", "vars", "accelerator",
-                    "tpu_type", "slice_topology", "num_slices",
-                    "tpu_runtime_version"))
+    h._crud_routes(app, "/api/v1/plans", services.plans, Plan, PLAN_FIELDS)
     async def list_hosts(request):
         hosts = await run_sync(request, services.hosts.list)
         return json_response([x.to_public_dict() for x in hosts])

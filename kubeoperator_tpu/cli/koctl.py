@@ -220,14 +220,10 @@ class LocalClient:
                 return pub(s.plans.list())
             case ("POST", ["plans"]):
                 from kubeoperator_tpu.models import Plan
+                from kubeoperator_tpu.models.infra import PLAN_FIELDS
 
-                fields = (
-                    "name provider region_id zone_ids master_count "
-                    "worker_count vars accelerator tpu_type slice_topology "
-                    "num_slices tpu_runtime_version"
-                ).split()
                 return pub(s.plans.create(Plan(**{
-                    k: body[k] for k in fields if k in body
+                    k: body[k] for k in PLAN_FIELDS if k in body
                 })))
             case ("GET", ["plans", name]):
                 return pub(s.plans.get(name))
@@ -810,6 +806,42 @@ def cmd_tpu_diag(args) -> int:
     return 0
 
 
+def cmd_lint(args) -> int:
+    """ko-analyze over the installed package (or --root): cross-artifact
+    linter + project-rule AST checker. Exit codes are a tooling contract:
+    0 clean (warnings allowed), 1 error findings, 2 the analyzer itself
+    failed — so CI can distinguish "dirty tree" from "broken gate"."""
+    from kubeoperator_tpu.analysis import RULES, run_analysis
+
+    if args.list_rules:
+        for spec in sorted(RULES.values(), key=lambda s: s.id):
+            print(f"{spec.id}  {spec.severity:7s} [{spec.name}] "
+                  f"{spec.summary}")
+        return 0
+    rule_ids = None
+    if args.rules:
+        rule_ids = {r.strip() for r in args.rules.split(",") if r.strip()}
+        unknown = rule_ids - set(RULES)
+        if unknown:
+            print(f"error: unknown rule id(s): {', '.join(sorted(unknown))} "
+                  f"(see `koctl lint --list-rules`)", file=sys.stderr)
+            return 2
+    try:
+        report = run_analysis(
+            root=args.root or None,
+            plan_files=tuple(args.plan or ()),
+            rule_ids=rule_ids,
+        )
+    except Exception as e:  # internal analyzer failure, NOT a dirty tree
+        print(f"ko-analyze internal error: {e}", file=sys.stderr)
+        return 2
+    if args.format == "json":
+        print(report.to_json())
+    else:
+        print(report.render_text())
+    return report.exit_code()
+
+
 def cmd_server(args) -> int:
     from kubeoperator_tpu.api import run_server
     from kubeoperator_tpu.service import build_services
@@ -979,6 +1011,38 @@ def build_parser() -> argparse.ArgumentParser:
     diag_p.add_argument("--profile-dir", default="",
                         help="capture an XLA profiler trace of the suite")
 
+    lint_p = sub.add_parser(
+        "lint",
+        help="static analysis: cross-artifact linter + project-rule AST "
+             "checker (the tier-1 CI gate; see docs/analysis.md)",
+        description=(
+            "Run ko-analyze over the platform: resolves every playbook/"
+            "role/template/bundle/migration cross-reference and enforces "
+            "the project AST rules (repository layering, non-blocking "
+            "handlers, lock discipline). Exit codes: 0 clean, 1 error "
+            "findings, 2 internal analyzer error. Rule ids and how to add "
+            "one: docs/analysis.md."
+        ),
+    )
+    lint_p.add_argument(
+        "--plan", action="append", metavar="FILE",
+        help="also validate plan YAML(s) (a `koctl apply` document or a "
+             "single plan mapping) against provider + TPU topology "
+             "capabilities; repeatable",
+    )
+    lint_p.add_argument("--format", choices=["text", "json"], default="text",
+                        help="report format (json is the machine contract)")
+    lint_p.add_argument("--rules", default="",
+                        help="comma-separated rule ids to run (default all)")
+    lint_p.add_argument("--root", default="",
+                        help="read content/ and migrations from this tree "
+                             "instead of the installed package (file-based "
+                             "rules only: python-side contracts — phase "
+                             "lists, image/version pins, catalogs — still "
+                             "come from the installed kubeoperator_tpu)")
+    lint_p.add_argument("--list-rules", action="store_true",
+                        help="print every registered rule id and exit")
+
     audit_p = sub.add_parser("audit", help="operation audit trail "
                                            "(who did what, newest first)")
     audit_p.add_argument("-n", "--limit", type=int, default=50)
@@ -1010,6 +1074,8 @@ def main(argv: list[str] | None = None) -> int:
         return 0
     if args.cmd == "server":
         return cmd_server(args)
+    if args.cmd == "lint":
+        return cmd_lint(args)
     if args.cmd == "install":
         from kubeoperator_tpu.installer import install
 

@@ -105,6 +105,18 @@ class Zone(Entity):
             raise ValidationError("zone must belong to a region")
 
 
+# The plan schema's settable-field whitelist — the ONE list every surface
+# that builds a Plan from user input consumes (REST create route, koctl
+# local transport, `koctl lint --plan`). A new Plan field added here reaches
+# all of them at once; a field added to the dataclass but not here is
+# deliberately not user-settable.
+PLAN_FIELDS: tuple[str, ...] = (
+    "name", "provider", "region_id", "zone_ids", "master_count",
+    "worker_count", "vars", "accelerator", "tpu_type", "slice_topology",
+    "num_slices", "tpu_runtime_version",
+)
+
+
 @dataclass
 class Plan(Entity):
     """Deploy plan — instance shapes/counts + accelerator topology.

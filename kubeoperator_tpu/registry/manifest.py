@@ -46,6 +46,69 @@ COMPONENT_VERSIONS = {
 }
 
 
+def _pinned_tar(basename: str, version_key: str) -> str:
+    return f"images/{basename}-{COMPONENT_VERSIONS[version_key]}.tar"
+
+
+# Images the content templates render pull references for, keyed by the repo
+# path under the offline registry (what appears after `{{ registry_url }}/`).
+# Value = (tag var the template MUST render the tag from, bundled tarball).
+# Single source of truth shared by bundle_manifest() and the ko-analyze
+# image-pin rule (KO-X005): a template referencing an image absent here, or
+# rendering its tag from any other var, fails `koctl lint` — so an
+# air-gapped cluster can never be told to pull something the bundle doesn't
+# carry, and the tag a manifest renders is exactly the tag the registry
+# serves.
+TEMPLATED_IMAGES: dict[str, tuple[str, str]] = {
+    "pause": ("pause_version", _pinned_tar("pause", "pause")),
+    "calico/cni": ("calico_version", _pinned_tar("calico-cni", "calico")),
+    "calico/node": ("calico_version", _pinned_tar("calico-node", "calico")),
+    "calico/kube-controllers": (
+        "calico_version", _pinned_tar("calico-kube-controllers", "calico")),
+    "flannel/flannel": ("flannel_version", _pinned_tar("flannel", "flannel")),
+    "flannel/flannel-cni-plugin": (
+        "flannel_cni_plugin_version",
+        _pinned_tar("flannel-cni-plugin", "flannel_cni_plugin")),
+    "dns/k8s-dns-node-cache": (
+        "node_local_dns_version",
+        _pinned_tar("node-local-dns", "node_local_dns")),
+    "aquasec/kube-bench": (
+        "kube_bench_version", _pinned_tar("kube-bench", "kube_bench")),
+    "ceph/ceph": ("ceph_version", _pinned_tar("ceph", "ceph")),
+    "csi/vsphere-csi-driver": (
+        "vsphere_csi_version",
+        _pinned_tar("vsphere-csi-driver", "vsphere_csi")),
+    "csi/vsphere-csi-syncer": (
+        "vsphere_csi_version",
+        _pinned_tar("vsphere-csi-syncer", "vsphere_csi")),
+    # TPU path (replaces nvidia-device-plugin / dcgm / nccl-tests images)
+    "ko-tpu/tpu-device-plugin": (
+        "tpu_device_plugin_version", "images/ko-tpu-device-plugin-v1.0.tar"),
+    "ko-tpu/jax-runtime": (
+        "tpu_runtime_version", f"images/ko-tpu-jax-runtime-{__version__}.tar"),
+}
+
+# consumed-as-artifact images: the prebuilt manifest or chart carries its
+# own image tag, so no pin is CLAIMED here — a pin the applied manifest
+# doesn't consume would be drift, not truth
+_PREBUILT_IMAGE_TARS = (
+    "images/cilium.tar",
+    "images/metrics-server.tar",
+    "images/ingress-nginx.tar",
+    "images/traefik.tar",
+    "images/prometheus.tar",
+    "images/grafana.tar",
+    "images/loki.tar",
+    "images/node-problem-detector.tar",
+    "images/nfs-subdir-external-provisioner.tar",
+    f"images/rook-ceph-operator-{COMPONENT_VERSIONS['rook']}.tar",
+    "images/velero.tar",
+    "images/istiod.tar",
+    "images/istio-proxyv2.tar",
+    "images/jobset-controller.tar",
+)
+
+
 def bundle_manifest() -> dict:
     """Everything an air-gapped install must be able to serve."""
     k8s_debs = []
@@ -64,36 +127,10 @@ def bundle_manifest() -> dict:
                     "cri-tools", "socat", "conntrack", "ipset", "ipvsadm",
                     "chrony")
     ]
-    images = [
-        f"images/pause-{COMPONENT_VERSIONS['pause']}.tar",
-        f"images/calico-node-{COMPONENT_VERSIONS['calico']}.tar",
-        f"images/flannel-{COMPONENT_VERSIONS['flannel']}.tar",
-        f"images/node-local-dns-{COMPONENT_VERSIONS['node_local_dns']}.tar",
-        "images/cilium.tar",
-        "images/metrics-server.tar",
-        "images/ingress-nginx.tar",
-        "images/traefik.tar",
-        "images/prometheus.tar",
-        "images/grafana.tar",
-        "images/loki.tar",
-        f"images/kube-bench-{COMPONENT_VERSIONS['kube_bench']}.tar",
-        # consumed-as-artifact like metrics-server: the prebuilt manifest
-        # carries its own image tag, so no pin is CLAIMED here — a pin the
-        # applied manifest doesn't consume would be drift, not truth
-        "images/node-problem-detector.tar",
-        "images/nfs-subdir-external-provisioner.tar",
-        f"images/vsphere-csi-driver-{COMPONENT_VERSIONS['vsphere_csi']}.tar",
-        f"images/vsphere-csi-syncer-{COMPONENT_VERSIONS['vsphere_csi']}.tar",
-        f"images/rook-ceph-operator-{COMPONENT_VERSIONS['rook']}.tar",
-        f"images/ceph-{COMPONENT_VERSIONS['ceph']}.tar",
-        "images/velero.tar",
-        "images/istiod.tar",
-        "images/istio-proxyv2.tar",
-        # TPU path (replaces nvidia-device-plugin / dcgm / nccl-tests images)
-        f"images/ko-tpu-device-plugin-v1.0.tar",
-        "images/jobset-controller.tar",
-        f"images/ko-tpu-jax-runtime-{__version__}.tar",
-    ]
+    images = sorted(
+        {tar for _var, tar in TEMPLATED_IMAGES.values()}
+        | set(_PREBUILT_IMAGE_TARS)
+    )
     wheels = [
         f"pypi/jax_tpu-{pin}-{runtime}.whl"
         for runtime, pin in sorted(JAX_PIN_PER_RUNTIME.items())

@@ -23,6 +23,13 @@ MIGRATIONS_DIR = os.path.join(os.path.dirname(__file__), "migrations")
 _MIGRATION_RE = re.compile(r"^(\d{3})_[\w-]+\.sql$")
 
 
+def statement_is_complete(stmt: str) -> bool:
+    """Whether `stmt` is one complete SQL statement (';'-terminated) —
+    exposed so the analysis layer's migration rule (KO-X006) can validate
+    SQL without importing sqlite3 itself (its own repo-layering rule)."""
+    return sqlite3.complete_statement(stmt)
+
+
 def _split_statements(script: str) -> list[str]:
     """Split a SQL script into complete statements (';'-aware via
     sqlite3.complete_statement, so literals containing ';' survive)."""

@@ -112,8 +112,11 @@ class TerminalSession:
             os.write(self._master, data)
 
     def read_since(self, after_seq: int = -1) -> list[tuple[int, bytes]]:
-        self.last_active = now_ts()
+        # last_active under the lock: write() updates it while holding it,
+        # and a torn bare write here could push an in-use session past the
+        # idle reaper's cutoff (ko-analyze KO-P003)
         with self._lock:
+            self.last_active = now_ts()
             return [(s, d) for s, d in self._chunks if s > after_seq]
 
     def missed_since(self, after_seq: int = -1) -> int:
@@ -134,8 +137,8 @@ class TerminalSession:
         """(missed, chunks) under ONE lock hold — the poll/SSE handlers use
         this, not two separate calls, so a drop landing between a gap query
         and the read can never be spliced with an undercounted gap."""
-        self.last_active = now_ts()
         with self._lock:
+            self.last_active = now_ts()
             return (
                 self._missed_locked(after_seq),
                 [(s, d) for s, d in self._chunks if s > after_seq],

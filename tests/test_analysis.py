@@ -1,0 +1,672 @@
+"""ko-analyze unit suite: every rule proven able to FIRE on a failing
+fixture and to stay quiet on the matching clean one, the JSON report
+contract (golden test), the koctl lint exit-code contract, and the
+/api/v1/analysis endpoint. The complementary whole-repo zero-error gate
+lives in tests/test_static_gate.py."""
+
+import json
+import textwrap
+
+import pytest
+import requests
+
+from kubeoperator_tpu.analysis import RULES, Finding, Report, run_analysis
+from kubeoperator_tpu.analysis.artifacts import (
+    AnalysisContext,
+    check_file_resolution,
+    check_image_pins,
+    check_manifest_refs,
+    check_migrations,
+    check_phase_playbooks,
+    check_plan_topology,
+    check_role_resolution,
+    check_version_vars,
+)
+from kubeoperator_tpu.analysis.astcheck import run_ast_rules
+
+
+def make_tree(tmp_path, files: dict) -> str:
+    """Materialize a fixture package tree; returns its root (package dir)."""
+    root = tmp_path / "fixturepkg"
+    for rel, content in files.items():
+        path = root / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(content))
+    return str(root)
+
+
+GOOD_ROLE = {
+    "content/roles/alpha/tasks/main.yml": """\
+        - name: render a template
+          ansible.builtin.template:
+            src: alpha.conf.j2
+            dest: /etc/alpha.conf
+        """,
+    "content/roles/alpha/templates/alpha.conf.j2": "x={{ cluster_name }}\n",
+    "content/playbooks/01-alpha.yml": """\
+        - name: alpha
+          hosts: all
+          roles:
+            - alpha
+        """,
+}
+
+
+def ctx_for(tmp_path, files: dict) -> AnalysisContext:
+    return AnalysisContext(root=make_tree(tmp_path, files))
+
+
+class TestRoleResolution:  # KO-X001
+    def test_clean_tree_is_quiet(self, tmp_path):
+        assert check_role_resolution(ctx_for(tmp_path, GOOD_ROLE)) == []
+
+    def test_fires_on_dangling_role(self, tmp_path):
+        files = dict(GOOD_ROLE)
+        files["content/playbooks/02-ghost.yml"] = """\
+            - hosts: all
+              roles: [ghost]
+            """
+        findings = check_role_resolution(ctx_for(tmp_path, files))
+        assert [f.rule for f in findings] == ["KO-X001"]
+        assert "ghost" in findings[0].message
+
+    def test_fires_on_role_without_entry_point(self, tmp_path):
+        files = dict(GOOD_ROLE)
+        files["content/roles/empty/templates/x.j2"] = "x"
+        findings = check_role_resolution(ctx_for(tmp_path, files))
+        assert any("no tasks/main.yml" in f.message for f in findings)
+
+
+class TestFileResolution:  # KO-X002
+    def test_clean_tree_is_quiet(self, tmp_path):
+        assert check_file_resolution(ctx_for(tmp_path, GOOD_ROLE)) == []
+
+    def test_fires_on_missing_template_src(self, tmp_path):
+        files = dict(GOOD_ROLE)
+        files["content/roles/alpha/tasks/main.yml"] = """\
+            - ansible.builtin.template:
+                src: missing.conf.j2
+                dest: /etc/x
+            """
+        findings = check_file_resolution(ctx_for(tmp_path, files))
+        assert [f.rule for f in findings] == ["KO-X002"]
+        assert "missing.conf.j2" in findings[0].message
+
+    def test_jinja_literal_candidates_each_checked(self, tmp_path):
+        # the tpu-smoke-test conditional-src idiom: both branches must exist
+        files = dict(GOOD_ROLE)
+        files["content/roles/alpha/tasks/main.yml"] = """\
+            - ansible.builtin.template:
+                src: "{{ 'a.yaml.j2' if flag else 'b.yaml.j2' }}"
+                dest: /etc/x
+            """
+        files["content/roles/alpha/templates/a.yaml.j2"] = "a"
+        findings = check_file_resolution(ctx_for(tmp_path, files))
+        assert len(findings) == 1 and "b.yaml.j2" in findings[0].message
+
+    def test_absolute_and_computed_srcs_exempt(self, tmp_path):
+        files = dict(GOOD_ROLE)
+        files["content/roles/alpha/tasks/main.yml"] = """\
+            - ansible.builtin.copy:
+                src: /etc/kubernetes/admin.conf
+                dest: /root/kc
+            - ansible.builtin.template:
+                src: "{{ pki_cache_dest | default('/var/pki/') }}{{ item }}"
+                dest: /etc/x
+            """
+        assert check_file_resolution(ctx_for(tmp_path, files)) == []
+
+    def test_fires_on_broken_cross_role_include(self, tmp_path):
+        files = dict(GOOD_ROLE)
+        files["content/roles/alpha/tasks/main.yml"] = """\
+            - ansible.builtin.include_tasks: ../../beta/tasks/evict.yml
+            """
+        findings = check_file_resolution(ctx_for(tmp_path, files))
+        assert len(findings) == 1 and "evict.yml" in findings[0].message
+
+    def test_copy_src_found_in_files_dir(self, tmp_path):
+        files = dict(GOOD_ROLE)
+        files["content/roles/alpha/tasks/main.yml"] = """\
+            - ansible.builtin.copy:
+                src: payload.py
+                dest: /opt/payload.py
+            """
+        files["content/roles/alpha/files/payload.py"] = "print(1)\n"
+        assert check_file_resolution(ctx_for(tmp_path, files)) == []
+
+
+class TestPhasePlaybooks:  # KO-X003
+    def test_fires_on_missing_referenced_playbook(self, tmp_path):
+        ctx = ctx_for(tmp_path, GOOD_ROLE)
+        findings = check_phase_playbooks(
+            ctx, referenced={"99-ghost.yml": {"adm/phases.py:create_phases"}}
+        )
+        assert [f.rule for f in findings] == ["KO-X003"]
+        assert "99-ghost.yml" in findings[0].message
+
+    def test_fires_on_playbook_shape(self, tmp_path):
+        files = dict(GOOD_ROLE)
+        files["content/playbooks/03-bad.yml"] = "just: a-mapping\n"
+        files["content/playbooks/04-nohosts.yml"] = "- roles: [alpha]\n"
+        findings = check_phase_playbooks(
+            ctx_for(tmp_path, files), referenced={}
+        )
+        messages = "\n".join(f.message for f in findings)
+        assert "non-empty list of plays" in messages
+        assert "hosts" in messages
+
+    def test_real_references_resolve(self, tmp_path):
+        """Against the REAL package: every adm phase + catalog playbook
+        exists (injection-free path of the rule)."""
+        from kubeoperator_tpu.analysis import default_root
+
+        ctx = AnalysisContext(root=default_root())
+        assert check_phase_playbooks(ctx) == []
+
+
+class TestPlanTopology:  # KO-X004
+    def test_catalog_and_generations_clean(self, tmp_path):
+        ctx = ctx_for(tmp_path, {})
+        assert check_plan_topology(ctx) == []
+
+    def test_fires_on_mesh_chip_mismatch(self, tmp_path):
+        plan = tmp_path / "plan.yaml"
+        plan.write_text(json.dumps({
+            "plans": [{
+                "name": "bad-mesh", "provider": "gcp_tpu_vm",
+                "region_id": "r1", "accelerator": "tpu",
+                "tpu_type": "v5e-16", "slice_topology": "4x5",
+                "worker_count": 0,
+            }]
+        }))
+        ctx = AnalysisContext(root=make_tree(tmp_path, {}),
+                              plan_files=(str(plan),))
+        findings = check_plan_topology(ctx)
+        assert len(findings) == 1 and "bad-mesh" in findings[0].message
+
+    def test_fires_on_provider_capability(self, tmp_path):
+        plan = tmp_path / "plan.yaml"
+        plan.write_text(json.dumps({
+            "name": "tpu-on-vsphere", "provider": "vsphere",
+            "region_id": "r1", "accelerator": "tpu", "tpu_type": "v5e-16",
+        }))
+        ctx = AnalysisContext(root=make_tree(tmp_path, {}),
+                              plan_files=(str(plan),))
+        findings = check_plan_topology(ctx)
+        assert any("gcp_tpu_vm" in f.message for f in findings)
+
+    def test_malformed_plan_is_a_finding_not_a_crash(self, tmp_path):
+        """Exit-code contract regression: dirty user input (empty `plans:`
+        key, non-int master_count) must land as KO-X004 findings (exit 1),
+        never crash the analyzer (exit 2 = broken gate)."""
+        empty = tmp_path / "empty.yaml"
+        empty.write_text("plans:\n")
+        dirty = tmp_path / "dirty.yaml"
+        dirty.write_text(json.dumps({
+            "name": "typed-wrong", "provider": "bare_metal",
+            "master_count": "three",
+        }))
+        ctx = AnalysisContext(root=make_tree(tmp_path, {}),
+                              plan_files=(str(empty), str(dirty)))
+        findings = check_plan_topology(ctx)
+        assert len(findings) == 2
+        assert any("no plan mapping" in f.message for f in findings)
+        assert any("malformed plan mapping" in f.message
+                   and "typed-wrong" in f.message for f in findings)
+
+    def test_valid_plan_is_quiet(self, tmp_path):
+        plan = tmp_path / "plan.yaml"
+        plan.write_text(json.dumps({
+            "name": "good", "provider": "gcp_tpu_vm", "region_id": "r1",
+            "accelerator": "tpu", "tpu_type": "v5e-16",
+            "slice_topology": "4x4", "worker_count": 4,
+        }))
+        ctx = AnalysisContext(root=make_tree(tmp_path, {}),
+                              plan_files=(str(plan),))
+        assert check_plan_topology(ctx) == []
+
+
+CONTRACT = {"good/image": ("good_version", "images/good-1.0.tar")}
+ARTIFACTS = ["images/good-1.0.tar"]
+
+
+class TestImagePins:  # KO-X005
+    def _ctx(self, tmp_path, template: str) -> AnalysisContext:
+        return ctx_for(tmp_path, {
+            "content/roles/r/templates/x.yaml.j2": template,
+            "content/roles/r/tasks/main.yml": "- ansible.builtin.debug:\n"
+                                              "    msg: x\n",
+        })
+
+    def test_contract_image_is_quiet(self, tmp_path):
+        ctx = self._ctx(tmp_path, 'image: "{{ registry_url | default(\'r\') '
+                                  '}}/good/image:{{ good_version }}"\n')
+        assert check_image_pins(ctx, CONTRACT, ARTIFACTS) == []
+
+    def test_fires_on_uncontracted_image(self, tmp_path):
+        ctx = self._ctx(tmp_path, 'image: "{{ registry_url }}/rogue/thing:'
+                                  '{{ good_version }}"\n')
+        findings = check_image_pins(ctx, CONTRACT, ARTIFACTS)
+        assert len(findings) == 1 and "rogue/thing" in findings[0].message
+
+    def test_fires_on_tag_var_drift(self, tmp_path):
+        ctx = self._ctx(tmp_path, 'image: "{{ registry_host }}/good/image:'
+                                  '{{ other_version }}"\n')
+        findings = check_image_pins(ctx, CONTRACT, ARTIFACTS)
+        assert len(findings) == 1 and "good_version" in findings[0].message
+
+    def test_fires_on_literal_tag(self, tmp_path):
+        ctx = self._ctx(tmp_path,
+                        'image: "{{ registry_url }}/good/image:v9.9"\n')
+        findings = check_image_pins(ctx, CONTRACT, ARTIFACTS)
+        assert len(findings) == 1 and "literal" in findings[0].message
+
+    def test_fires_on_missing_tarball(self, tmp_path):
+        ctx = self._ctx(tmp_path, 'image: "{{ registry_url }}/good/image:'
+                                  '{{ good_version }}"\n')
+        findings = check_image_pins(ctx, CONTRACT, artifacts=[])
+        assert len(findings) == 1 and "tarball" in findings[0].message
+
+    def test_real_contract_covers_real_templates(self):
+        """Against the REAL package: templates ↔ TEMPLATED_IMAGES ↔ bundle
+        manifest agree (the drift this rule exists to catch)."""
+        from kubeoperator_tpu.analysis import default_root
+
+        ctx = AnalysisContext(root=default_root())
+        assert check_image_pins(ctx) == []
+
+
+class TestMigrations:  # KO-X006
+    GOOD = {
+        "repository/migrations/001_init.sql": "CREATE TABLE a (x TEXT);\n",
+        "repository/migrations/002_more.sql":
+            "ALTER TABLE a ADD COLUMN y TEXT;\n",
+    }
+
+    def test_clean_sequence_is_quiet(self, tmp_path):
+        assert check_migrations(ctx_for(tmp_path, self.GOOD)) == []
+
+    def test_fires_on_gap(self, tmp_path):
+        files = {k: v for k, v in self.GOOD.items() if "002" not in k}
+        files["repository/migrations/003_late.sql"] = "CREATE TABLE b (x);\n"
+        findings = check_migrations(ctx_for(tmp_path, files))
+        assert len(findings) == 1 and "002" in findings[0].message
+
+    def test_fires_on_bad_name(self, tmp_path):
+        files = dict(self.GOOD)
+        files["repository/migrations/03_short.sql"] = "CREATE TABLE b (x);\n"
+        findings = check_migrations(ctx_for(tmp_path, files))
+        assert any("NNN_slug.sql" in f.message for f in findings)
+
+    def test_fires_on_incomplete_sql(self, tmp_path):
+        files = dict(self.GOOD)
+        files["repository/migrations/003_trunc.sql"] = \
+            "CREATE TABLE c (x TEXT)\n"  # no terminating ';'
+        findings = check_migrations(ctx_for(tmp_path, files))
+        assert any("incomplete SQL" in f.message for f in findings)
+
+    def test_fires_on_empty_migration(self, tmp_path):
+        files = dict(self.GOOD)
+        files["repository/migrations/003_empty.sql"] = "-- nothing\n"
+        findings = check_migrations(ctx_for(tmp_path, files))
+        assert any("no SQL" in f.message for f in findings)
+
+
+class TestManifestRefs:  # KO-X007
+    def test_fires_on_unbundled_ref(self, tmp_path):
+        ctx = ctx_for(tmp_path, {
+            "content/roles/r/tasks/main.yml":
+                "- ansible.builtin.command: kubectl apply -f "
+                "/opt/ko-manifests/ghost.yaml\n",
+        })
+        findings = check_manifest_refs(ctx, bundled=("real.yaml",),
+                                       generated=())
+        assert len(findings) == 1 and "ghost.yaml" in findings[0].message
+
+    def test_fires_on_unbundled_generated(self, tmp_path):
+        ctx = ctx_for(tmp_path, {})
+        findings = check_manifest_refs(ctx, bundled=("real.yaml",),
+                                       generated=("orphan.yaml",))
+        assert len(findings) == 1 and "orphan.yaml" in findings[0].message
+
+    def test_bundled_ref_is_quiet(self, tmp_path):
+        ctx = ctx_for(tmp_path, {
+            "content/roles/r/tasks/main.yml":
+                "- ansible.builtin.command: kubectl apply -f "
+                "/opt/ko-manifests/real.yaml\n",
+        })
+        assert check_manifest_refs(ctx, bundled=("real.yaml",),
+                                   generated=("real.yaml",)) == []
+
+
+class TestVersionVars:  # KO-X008
+    def test_supplied_and_defaulted_are_quiet(self, tmp_path):
+        ctx = ctx_for(tmp_path, {
+            "content/roles/r/tasks/main.yml":
+                "- ansible.builtin.debug:\n"
+                "    msg: \"{{ known_version }} "
+                "{{ other_version | default('1.0') }}\"\n",
+        })
+        assert check_version_vars(ctx, supplied=frozenset({"known_version"})
+                                  ) == []
+
+    def test_fires_on_unsupplied_var(self, tmp_path):
+        ctx = ctx_for(tmp_path, {
+            "content/roles/r/templates/x.yaml.j2":
+                "tag: {{ mystery_version }}\n",
+        })
+        findings = check_version_vars(ctx, supplied=frozenset())
+        assert [f.rule for f in findings] == ["KO-X008"]
+        assert "mystery_version" in findings[0].message
+
+    def test_longer_identifier_is_not_a_version_var(self, tmp_path):
+        # regression: `ko_node_versions.stdout_lines` must not match as
+        # `ko_node_version` + junk (the greedy-backtrack false positive)
+        ctx = ctx_for(tmp_path, {
+            "content/roles/r/tasks/main.yml":
+                "- ansible.builtin.debug:\n"
+                "    msg: \"{{ ko_node_versions.stdout_lines | tojson }}\"\n",
+        })
+        assert check_version_vars(ctx, supplied=frozenset()) == []
+
+
+# --------------------------------------------------------------- AST rules --
+def ast_findings(tmp_path, source: str, rule: str, rel="mod.py"):
+    root = make_tree(tmp_path, {rel: source})
+    findings, _scanned = run_ast_rules(root, {rule})
+    return findings
+
+
+class TestRepoLayering:  # KO-P001
+    def test_fires_outside_repository(self, tmp_path):
+        findings = ast_findings(
+            tmp_path, "import sqlite3\n", "KO-P001", rel="service/x.py")
+        assert [f.rule for f in findings] == ["KO-P001"]
+
+    def test_quiet_inside_repository(self, tmp_path):
+        assert ast_findings(tmp_path, "import sqlite3\n", "KO-P001",
+                            rel="repository/db.py") == []
+
+    def test_from_import_fires_too(self, tmp_path):
+        findings = ast_findings(
+            tmp_path, "from sqlite3 import connect\n", "KO-P001",
+            rel="api/x.py")
+        assert len(findings) == 1
+
+
+class TestBlockingHandler:  # KO-P002
+    def test_fires_on_sleep_in_async(self, tmp_path):
+        src = """\
+            import time
+            async def handler(request):
+                time.sleep(1)
+            """
+        findings = ast_findings(tmp_path, textwrap.dedent(src), "KO-P002")
+        assert len(findings) == 1 and "time.sleep" in findings[0].message
+
+    def test_fires_on_subprocess_and_requests(self, tmp_path):
+        src = """\
+            import subprocess, requests
+            async def handler(request):
+                subprocess.run(["ls"])
+                requests.get("http://x")
+            """
+        findings = ast_findings(tmp_path, textwrap.dedent(src), "KO-P002")
+        assert len(findings) == 2
+
+    def test_sync_closure_is_exempt(self, tmp_path):
+        # the run_sync off-load idiom: blocking work inside a nested sync
+        # def executes on a worker thread, not the event loop
+        src = """\
+            import time
+            async def handler(request):
+                def gather():
+                    time.sleep(1)
+                    return 1
+                return await run_sync(request, gather)
+            """
+        assert ast_findings(tmp_path, textwrap.dedent(src), "KO-P002") == []
+
+    def test_sync_function_is_exempt(self, tmp_path):
+        src = """\
+            import time
+            def poll():
+                time.sleep(1)
+            """
+        assert ast_findings(tmp_path, textwrap.dedent(src), "KO-P002") == []
+
+
+LOCKED_CLASS = """\
+    import threading
+
+    class Buffered:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.count = 0
+
+        def add(self):
+            with self._lock:
+                self.count += 1
+    """
+
+
+class TestLockDiscipline:  # KO-P003
+    def test_consistent_class_is_quiet(self, tmp_path):
+        assert ast_findings(
+            tmp_path, textwrap.dedent(LOCKED_CLASS), "KO-P003") == []
+
+    def test_fires_on_mixed_write(self, tmp_path):
+        src = textwrap.dedent(LOCKED_CLASS) + (
+            "    def reset(self):\n"
+            "        self.count = 0\n"
+        )
+        findings = ast_findings(tmp_path, src, "KO-P003")
+        assert len(findings) == 1
+        assert "Buffered.count" in findings[0].message
+        assert "reset" in findings[0].message
+
+    def test_init_and_locked_suffix_exempt(self, tmp_path):
+        src = textwrap.dedent(LOCKED_CLASS) + (
+            "    def _reset_locked(self):\n"
+            "        self.count = 0\n"
+        )
+        assert ast_findings(tmp_path, src, "KO-P003") == []
+
+    def test_injected_lock_still_detected(self, tmp_path):
+        # `self._lock = lock` (injection/aliasing) carries no Lock() call —
+        # the lock-NAMED fallback must still arm the detector
+        src = """\
+            class Shared:
+                def __init__(self, lock):
+                    self._lock = lock
+                    self.n = 0
+                def bump(self):
+                    with self._lock:
+                        self.n += 1
+                def reset(self):
+                    self.n = 0
+            """
+        findings = ast_findings(tmp_path, textwrap.dedent(src), "KO-P003")
+        assert len(findings) == 1 and "Shared.n" in findings[0].message
+
+    def test_class_without_lock_is_skipped(self, tmp_path):
+        src = """\
+            class Plain:
+                def a(self):
+                    self.x = 1
+                def b(self):
+                    self.x = 2
+            """
+        assert ast_findings(tmp_path, textwrap.dedent(src), "KO-P003") == []
+
+
+class TestMutableDefault:  # KO-P004
+    def test_fires_on_list_and_dict_literal(self, tmp_path):
+        src = "def f(a=[], b={}):\n    return a, b\n"
+        findings = ast_findings(tmp_path, src, "KO-P004")
+        assert len(findings) == 2
+
+    def test_fires_on_constructor_default(self, tmp_path):
+        findings = ast_findings(
+            tmp_path, "def f(a=dict()):\n    return a\n", "KO-P004")
+        assert len(findings) == 1
+
+    def test_quiet_on_immutable_defaults(self, tmp_path):
+        src = "def f(a=None, b=(), c='x', d=0):\n    return a, b, c, d\n"
+        assert ast_findings(tmp_path, src, "KO-P004") == []
+
+
+class TestBareExcept:  # KO-P005
+    def test_fires_as_warning(self, tmp_path):
+        src = "try:\n    x = 1\nexcept:\n    pass\n"
+        findings = ast_findings(tmp_path, src, "KO-P005")
+        assert len(findings) == 1 and findings[0].severity == "warning"
+
+    def test_typed_except_is_quiet(self, tmp_path):
+        src = "try:\n    x = 1\nexcept Exception:\n    pass\n"
+        assert ast_findings(tmp_path, src, "KO-P005") == []
+
+
+# ------------------------------------------------------------ report model --
+class TestReport:
+    def test_unknown_rule_id_rejected(self):
+        with pytest.raises(ValueError):
+            Finding("KO-NOPE", "f.py", 1, "x")
+
+    def test_severity_defaults_from_registry(self):
+        f = Finding("KO-P005", "f.py", 1, "x")
+        assert f.severity == "warning"
+        assert Finding("KO-X001", "f.py", 1, "x").severity == "error"
+
+    def test_exit_code_contract(self):
+        r = Report(root="/x")
+        assert r.exit_code() == 0
+        r.extend([Finding("KO-P005", "f.py", 1, "warn-only")])
+        assert r.exit_code() == 0          # warnings alone stay green
+        r.extend([Finding("KO-X001", "f.py", 1, "boom")])
+        assert r.exit_code() == 1
+
+    def test_registry_meets_issue_contract(self):
+        """≥ 8 rule ids, ≥ 4 cross-artifact, ≥ 4 AST."""
+        kinds = [spec.kind for spec in RULES.values()]
+        assert len(RULES) >= 8
+        assert kinds.count("artifact") >= 4
+        assert kinds.count("ast") >= 4
+
+    def test_golden_json_report(self, tmp_path):
+        """The machine-readable contract: exact shape, stable ordering,
+        runtime excluded (non-deterministic)."""
+        from kubeoperator_tpu.version import __version__
+
+        root = make_tree(tmp_path, {
+            "content/roles/alpha/tasks/main.yml": (
+                "- ansible.builtin.template:\n"
+                "    src: missing.conf.j2\n"
+                "    dest: /etc/x\n"
+            ),
+            "content/playbooks/01-a.yml": (
+                "- hosts: all\n  roles: [ghost]\n"
+            ),
+        })
+        report = run_analysis(root=root, rule_ids={"KO-X001", "KO-X002"})
+        got = report.to_dict()
+        assert got.pop("runtime_s") >= 0
+        assert got.pop("files_scanned") > 0
+        assert got.pop("root") == root
+        assert got == {
+            "analyzer": "ko-analyze",
+            "version": __version__,
+            "rules_run": ["KO-X001", "KO-X002"],
+            "counts": {"error": 2, "warning": 0},
+            "findings": [
+                {
+                    "rule": "KO-X001",
+                    "name": "role-resolution",
+                    "severity": "error",
+                    "file": "fixturepkg/content/playbooks/01-a.yml",
+                    "line": 0,
+                    "message": "playbook references missing role 'ghost'",
+                },
+                {
+                    "rule": "KO-X002",
+                    "name": "file-resolution",
+                    "severity": "error",
+                    "file": "fixturepkg/content/roles/alpha/tasks/main.yml",
+                    "line": 0,
+                    "message": "role 'alpha': src 'missing.conf.j2' not "
+                               "found under templates/",
+                },
+            ],
+        }
+        # and the JSON round-trips
+        assert json.loads(report.to_json())["counts"]["error"] == 2
+
+
+# ----------------------------------------------------------------- koctl ----
+class TestKoctlLint:
+    def _run(self, argv):
+        from kubeoperator_tpu.cli.koctl import main
+
+        return main(argv)
+
+    def test_exit_0_on_clean_tree(self, capsys):
+        assert self._run(["lint"]) == 0
+        assert "0 error(s)" in capsys.readouterr().out
+
+    def test_exit_1_on_findings(self, tmp_path, capsys):
+        root = make_tree(tmp_path, {
+            "content/playbooks/01-a.yml": "- hosts: all\n  roles: [ghost]\n",
+        })
+        assert self._run(["lint", "--root", root,
+                          "--rules", "KO-X001"]) == 1
+        assert "ghost" in capsys.readouterr().out
+
+    def test_exit_2_on_unknown_rule(self, capsys):
+        assert self._run(["lint", "--rules", "KO-NOPE"]) == 2
+        assert "unknown rule" in capsys.readouterr().err
+
+    def test_exit_2_on_internal_error(self, tmp_path, capsys):
+        # a syntactically broken python file must crash the analyzer (2),
+        # never read as a clean tree (0)
+        root = make_tree(tmp_path, {"broken.py": "def f(:\n"})
+        assert self._run(["lint", "--root", root,
+                          "--rules", "KO-P004"]) == 2
+        assert "internal error" in capsys.readouterr().err
+
+    def test_json_format_and_plan_flag(self, tmp_path, capsys):
+        plan = tmp_path / "p.yaml"
+        plan.write_text(json.dumps({
+            "name": "bad", "provider": "gcp_tpu_vm", "region_id": "r",
+            "accelerator": "tpu", "tpu_type": "v5e-16",
+            "slice_topology": "4x5",
+        }))
+        rc = self._run(["lint", "--plan", str(plan), "--format", "json",
+                        "--rules", "KO-X004"])
+        report = json.loads(capsys.readouterr().out)
+        assert rc == 1
+        assert report["counts"]["error"] == 1
+        assert report["findings"][0]["rule"] == "KO-X004"
+
+    def test_list_rules(self, capsys):
+        assert self._run(["lint", "--list-rules"]) == 0
+        out = capsys.readouterr().out
+        for rule_id in RULES:
+            assert rule_id in out
+
+
+# ------------------------------------------------------------------- API ----
+class TestAnalysisEndpoint:
+    def test_requires_admin(self, server):
+        base, _services = server
+        assert requests.get(f"{base}/api/v1/analysis").status_code == 401
+
+    def test_reports_clean_platform(self, client):
+        base, http, _services = client
+        resp = http.get(f"{base}/api/v1/analysis")
+        assert resp.status_code == 200
+        report = resp.json()
+        assert report["analyzer"] == "ko-analyze"
+        assert report["counts"]["error"] == 0
+        assert len(report["rules_run"]) == len(RULES)
+        # second call serves the process cache (same payload, fast path)
+        assert http.get(f"{base}/api/v1/analysis").json() == report

@@ -1,0 +1,52 @@
+"""The tier-1 static gate: ko-analyze over the WHOLE installed package must
+report zero errors, permanently.
+
+This is the CI face of `koctl lint` — the same entry point, the same rules,
+the same tree a deploy would consume. Any PR that introduces a dangling
+role reference, an unpinned image, a migration gap, a blocking call on a
+handler path, or a mixed-lock write fails HERE, before it can fail on a
+real cluster. If a new rule legitimately needs a grace period, register it
+with severity "warning" (warnings don't fail the gate) rather than
+weakening this assertion.
+
+The gate also enforces the analyzer's own operational budget: the whole
+run must stay comfortably under ~5 s on CPU so it is cheap enough to run
+on every commit (PERF.md records the measured number per round).
+"""
+
+import time
+
+from kubeoperator_tpu.analysis import RULES, run_analysis
+
+
+def test_analyzer_reports_zero_errors_over_repo():
+    start = time.perf_counter()
+    report = run_analysis()
+    elapsed = time.perf_counter() - start
+
+    # every registered rule ran — a rule silently dropping out of the run
+    # set would turn this gate into a rubber stamp
+    assert sorted(report.rules_run) == sorted(RULES)
+    # the run actually covered the tree (content + package python)
+    assert report.files_scanned > 150, report.files_scanned
+
+    errors = report.errors
+    assert not errors, (
+        "ko-analyze found errors in the tree — fix them (or, for a "
+        "deliberately advisory rule, register it as warning severity):\n"
+        + "\n".join(
+            f"  {f.rule} {f.file}:{f.line}: {f.message}"
+            for f in sorted(errors, key=lambda f: (f.file, f.line))
+        )
+    )
+    assert report.exit_code() == 0
+    # operational budget: the gate must stay cheap (PERF.md)
+    assert elapsed < 5.0, f"analyzer took {elapsed:.2f}s (budget 5s)"
+
+
+def test_cli_gate_exit_code_is_zero(capsys):
+    """The exact invocation ROADMAP.md documents for future sessions."""
+    from kubeoperator_tpu.cli.koctl import main
+
+    assert main(["lint"]) == 0
+    assert "0 error(s)" in capsys.readouterr().out
