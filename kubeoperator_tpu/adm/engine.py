@@ -10,14 +10,23 @@ condition rows for free (SURVEY.md §5.1).
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass, field
 from typing import Callable
 
-from kubeoperator_tpu.executor.base import Executor, TaskResult
+from kubeoperator_tpu.executor.base import (
+    Executor,
+    FailureKind,
+    TaskResult,
+    TaskStatus,
+    classify_result,
+)
 from kubeoperator_tpu.models import Cluster, Credential, Host, Node, Plan
 from kubeoperator_tpu.models.cluster import ConditionStatus
 from kubeoperator_tpu.executor.inventory import build_inventory
-from kubeoperator_tpu.utils.errors import PhaseError
+from kubeoperator_tpu.resilience.policy import RetryPolicy
+from kubeoperator_tpu.utils.errors import ExecutorError, PhaseError, ValidationError
+from kubeoperator_tpu.utils.ids import now_ts
 from kubeoperator_tpu.utils.logging import get_logger
 
 log = get_logger("adm")
@@ -30,14 +39,23 @@ NODELOCALDNS_IP = "169.254.20.10"
 
 def _cluster_dns_ip(service_cidr: str) -> str:
     """kube-dns service ClusterIP: tenth address of the service range (the
-    kubeadm convention). nodelocaldns forwards cache misses here."""
+    kubeadm convention). nodelocaldns forwards cache misses here.
+
+    An unparseable CIDR raises instead of silently handing every node the
+    10.96.0.10 default — a cluster deployed with DNS pointing into a range
+    it doesn't own fails in ways far harder to diagnose than this error
+    (ClusterSpec.validate normally rejects the spec first; this is the
+    backstop for specs that bypassed it, e.g. hand-edited rows)."""
     import ipaddress
 
     try:
         net = ipaddress.ip_network(service_cidr, strict=False)
-        return str(net.network_address + 10)
-    except ValueError:
-        return "10.96.0.10"
+    except ValueError as e:
+        raise ValidationError(
+            f"service_cidr {service_cidr!r} is not a valid CIDR — refusing "
+            f"to fall back to a default cluster DNS IP: {e}"
+        )
+    return str(net.network_address + 10)
 
 
 def platform_vars_from_config(config) -> dict:
@@ -165,10 +183,28 @@ class AdmContext:
 
 
 class ClusterAdm:
-    """Runs an ordered phase list against a context, resumably."""
+    """Runs an ordered phase list against a context, resumably and — for
+    TRANSIENT failures — self-healingly.
 
-    def __init__(self, executor: Executor) -> None:
+    `policy` governs in-phase auto-retry: a failed attempt classified
+    TRANSIENT (unreachable hosts, deadlines, killed runner processes) is
+    retried with exponential backoff up to `policy.max_attempts` before the
+    phase halts; PERMANENT failures (genuinely failed tasks, post-hook
+    vetoes) halt immediately for operator attention. `rng` (an explicitly
+    seeded random.Random, or None) feeds backoff jitter; `sleep` is
+    injectable so tests run the retry loop at full speed."""
+
+    def __init__(
+        self,
+        executor: Executor,
+        policy: RetryPolicy | None = None,
+        rng=None,
+        sleep: Callable[[float], None] = time.sleep,
+    ) -> None:
         self.executor = executor
+        self.policy = policy or RetryPolicy()
+        self.rng = rng
+        self._sleep = sleep
 
     def run(self, ctx: AdmContext, phases: list[Phase]) -> None:
         """Execute phases in order; on failure raise PhaseError leaving the
@@ -211,19 +247,137 @@ class ClusterAdm:
         status = cluster.status
         log.info("cluster %s: phase %s starting (%s)",
                  cluster.name, phase.name, phase.playbook)
-        status.upsert_condition(phase.name, ConditionStatus.RUNNING)
-        ctx.save_cluster(cluster)
+        # the phase deadline bounds ALL attempts + backoff spans together;
+        # upsert keeps started_at across in-phase retries, so the condition's
+        # duration_s honestly includes the backoff the phase spent
+        deadline = self.policy.deadline_from(now_ts())
+        attempts = 0
+        total_backoff = 0.0
+
+        def stamp(cond) -> None:
+            cond.attempts = attempts
+            cond.backoff_s = round(total_backoff, 3)
+
+        while True:
+            attempts += 1
+            stamp(status.upsert_condition(phase.name, ConditionStatus.RUNNING))
+            ctx.save_cluster(cluster)
+
+            try:
+                result, lines = self._attempt(ctx, phase, deadline)
+                if result.ok and phase.post is not None:
+                    # post-hooks parse phase output (e.g. smoke-test GB/s)
+                    # and may veto success by raising PhaseError — a
+                    # deliberate judgment about output, never retried.
+                    phase.post(ctx, result, lines)
+            except PhaseError as e:
+                cond = status.upsert_condition(
+                    phase.name, ConditionStatus.FAILED, e.message)
+                stamp(cond)
+                cond.classification = FailureKind.PERMANENT.value
+                ctx.save_cluster(cluster)
+                raise
+            except Exception as e:
+                # Anything else (post-hook bug, runner crash) must still
+                # land the condition in Failed — a condition stuck at
+                # Running would wedge resumability forever.
+                cond = status.upsert_condition(
+                    phase.name, ConditionStatus.FAILED, str(e))
+                stamp(cond)
+                cond.classification = FailureKind.PERMANENT.value
+                ctx.save_cluster(cluster)
+                raise PhaseError(phase.name, str(e)) from e
+
+            if result.ok:
+                cond = status.upsert_condition(phase.name, ConditionStatus.OK)
+                stamp(cond)
+                cond.classification = ""
+                ctx.save_cluster(cluster)
+                log.info("cluster %s: phase %s OK (%.1fs, attempt %d)",
+                         cluster.name, phase.name,
+                         status.condition(phase.name).duration_s, attempts)
+                return
+
+            classification = (result.classification or classify_result(result)
+                              or FailureKind.PERMANENT.value)
+            retryable = (
+                classification == FailureKind.TRANSIENT.value
+                and attempts < self.policy.max_attempts
+            )
+            delay = self.policy.backoff_s(attempts, self.rng) if retryable else 0.0
+            if retryable and deadline is not None \
+                    and now_ts() + delay >= deadline:
+                # no room left for another attempt inside the phase deadline
+                retryable = False
+            if not retryable:
+                cond = status.upsert_condition(
+                    phase.name, ConditionStatus.FAILED, result.message)
+                stamp(cond)
+                cond.classification = classification
+                ctx.save_cluster(cluster)
+                raise PhaseError(
+                    phase.name,
+                    f"{result.message} [{classification.lower()}, "
+                    f"attempt {attempts}/{self.policy.max_attempts}]",
+                )
+
+            total_backoff += delay
+            cond = status.upsert_condition(
+                phase.name, ConditionStatus.RUNNING,
+                f"attempt {attempts}/{self.policy.max_attempts} failed "
+                f"({classification.lower()}: {result.message}); retrying "
+                f"in {delay:.1f}s",
+            )
+            stamp(cond)
+            cond.classification = classification
+            ctx.save_cluster(cluster)
+            log.warning(
+                "cluster %s: phase %s attempt %d/%d failed (%s: %s); "
+                "retrying in %.1fs", cluster.name, phase.name, attempts,
+                self.policy.max_attempts, classification, result.message,
+                delay,
+            )
+            if delay > 0:
+                self._sleep(delay)
+
+    def _attempt(
+        self, ctx: AdmContext, phase: Phase, deadline: float | None
+    ) -> tuple[TaskResult, list[str]]:
+        """One executor run of the phase playbook, streamed to the log sink.
+        When the phase deadline expires mid-stream the task is cancelled
+        cooperatively (kill hooks fire in process backends), so a hung
+        playbook surfaces as a TRANSIENT deadline failure instead of
+        wedging the deploy."""
+        # executor-scoped platform vars (tier 1 → tier 3, SURVEY.md §5.6):
+        # the service container stamps the configured offline-registry
+        # address onto its executor, so every phase in that stack renders
+        # content against the right registry — lowest precedence, and
+        # scoped per Services instance (no process-global state).
+        extra_vars = {
+            **getattr(self.executor, "platform_vars", {}),
+            **ctx.build_extra_vars(),
+        }
+        def transient_result(task_id: str, message: str) -> TaskResult:
+            # executor-layer outage (runner process down/restarting): the
+            # task never produced an honest result, so synthesize one the
+            # retry loop can classify — this is what lets a deploy ride out
+            # a runner restart instead of halting PERMANENT on an RPC error
+            return TaskResult(
+                task_id=task_id, status=TaskStatus.FAILED.value, rc=-1,
+                message=message,
+                classification=FailureKind.TRANSIENT.value,
+            )
+
+        if deadline is not None and deadline - now_ts() <= 0:
+            # same TRANSIENT deadline label whether the budget ran out
+            # between attempts or mid-stream — the loop's deadline check
+            # turns this into the final halt
+            return transient_result("", (
+                f"phase {phase.name} deadline "
+                f"({self.policy.phase_deadline_s:g}s) exhausted before "
+                f"attempt could start")), []
 
         try:
-            # executor-scoped platform vars (tier 1 → tier 3, SURVEY.md §5.6):
-            # the service container stamps the configured offline-registry
-            # address onto its executor, so every phase in that stack renders
-            # content against the right registry — lowest precedence, and
-            # scoped per Services instance (no process-global state).
-            extra_vars = {
-                **getattr(self.executor, "platform_vars", {}),
-                **ctx.build_extra_vars(),
-            }
             task_id = self.executor.run_playbook(
                 phase.playbook,
                 ctx.inventory(),
@@ -231,35 +385,42 @@ class ClusterAdm:
                 tags=list(phase.tags),
                 limit="new-workers" if phase.limit_new_nodes else "",
             )
-            lines: list[str] = []
-            for line in self.executor.watch(task_id):
+        except ExecutorError as e:
+            return transient_result("", f"executor unavailable: {e.message}"), []
+        lines: list[str] = []
+        try:
+            watch_kw = {}
+            if deadline is not None:
+                watch_kw["timeout_s"] = max(deadline - now_ts(), 0.001)
+            for line in self.executor.watch(task_id, **watch_kw):
                 lines.append(line)
                 ctx.log_sink(task_id, line)
             result = self.executor.result(task_id)
-            if result.ok and phase.post is not None:
-                # post-hooks parse phase output (e.g. smoke-test GB/s) and may
-                # veto success by raising PhaseError.
-                phase.post(ctx, result, lines)
-        except PhaseError as e:
-            status.upsert_condition(phase.name, ConditionStatus.FAILED, e.message)
-            ctx.save_cluster(cluster)
-            raise
-        except Exception as e:
-            # Anything else (watch timeout, post-hook bug, runner crash) must
-            # still land the condition in Failed — a condition stuck at
-            # Running would wedge resumability forever.
-            status.upsert_condition(phase.name, ConditionStatus.FAILED, str(e))
-            ctx.save_cluster(cluster)
-            raise PhaseError(phase.name, str(e)) from e
-
-        if result.ok:
-            status.upsert_condition(phase.name, ConditionStatus.OK)
-            ctx.save_cluster(cluster)
-            log.info("cluster %s: phase %s OK (%.1fs)", cluster.name, phase.name,
-                     status.condition(phase.name).duration_s)
-        else:
-            status.upsert_condition(
-                phase.name, ConditionStatus.FAILED, result.message
-            )
-            ctx.save_cluster(cluster)
-            raise PhaseError(phase.name, result.message)
+        except ExecutorError as e:
+            # deadline hit OR the stream/boundary broke mid-task: reap the
+            # task so nothing keeps running behind the deploy's back, then
+            # hand the loop a TRANSIENT failure to classify/retry
+            if deadline is not None and now_ts() >= deadline:
+                reason = (f"phase {phase.name} exceeded its "
+                          f"{self.policy.phase_deadline_s:g}s deadline")
+            else:
+                reason = f"phase {phase.name} task stream failed: {e.message}"
+            try:
+                result = self.executor.cancel(task_id, reason=reason)
+            except ExecutorError:
+                result = transient_result(task_id, reason)
+            if result.ok:
+                # the task actually FINISHED ok — only the stream died. A
+                # post-hook must never parse truncated output, so replay the
+                # buffered stream (cheap: the task is done); if even that
+                # fails, retry the attempt rather than judge partial lines.
+                try:
+                    replay = list(self.executor.watch(task_id, timeout_s=30.0))
+                    for line in replay[len(lines):]:   # sink only the tail
+                        ctx.log_sink(task_id, line)
+                    lines = replay
+                except ExecutorError:
+                    result = transient_result(task_id, reason)
+            if not result.ok:
+                ctx.log_sink(task_id, f"CANCELLED: {reason}")
+        return result, lines

@@ -296,12 +296,74 @@ def check_bare_except(root: str, tree: ast.AST, path: str) -> list:
     return findings
 
 
+# ---------------------------------------------------------------- KO-P006 ---
+_SUBPROCESS_FNS = frozenset({
+    "run", "Popen", "call", "check_call", "check_output",
+})
+_P006_WAIVER = "KO-P006: waived"
+
+
+def check_subprocess_timeouts(root: str, tree: ast.AST, path: str) -> list:
+    """Every subprocess.run/Popen/check_* call outside terminal/ must pass
+    a timeout= — an un-deadlined child process is exactly how a hung
+    external binary wedges a deploy forever (the resilience layer's
+    cooperative-cancel contract assumes every blocking child is bounded).
+
+    terminal/ is exempt wholesale: interactive shells live as long as the
+    user does. Elsewhere a call that genuinely cannot take timeout=
+    (Popen with its own kill hook) is waived with a `# KO-P006: waived —
+    <reason>` comment on the call line or the line above it."""
+    parts = os.path.relpath(path, root).split(os.sep)[:-1]
+    if "terminal" in parts:
+        return []
+    candidates: list = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if not isinstance(func, ast.Attribute) or \
+                not isinstance(func.value, ast.Name):
+            continue
+        if func.value.id not in ("subprocess", "_subprocess") or \
+                func.attr not in _SUBPROCESS_FNS:
+            continue
+        if any(kw.arg == "timeout" for kw in node.keywords):
+            continue
+        candidates.append(node)
+    if not candidates:
+        return []
+    with open(path, encoding="utf-8") as f:
+        source_lines = f.read().splitlines()
+
+    def waived(lineno: int) -> bool:
+        # waiver comment on the call line or on any of the 3 lines above
+        # (multi-line call heads push the comment up)
+        lo = max(lineno - 4, 0)
+        return any(
+            _P006_WAIVER in line for line in source_lines[lo:lineno]
+        )
+
+    findings: list = []
+    rel = _rel(root, path)
+    for node in candidates:
+        if waived(node.lineno):
+            continue
+        findings.append(Finding(
+            "KO-P006", rel, node.lineno,
+            f"subprocess.{node.func.attr}() without timeout= — a hung "
+            f"child wedges its caller forever; pass timeout= or waive "
+            f"with `# {_P006_WAIVER} — <reason>`",
+        ))
+    return findings
+
+
 AST_RULES = {
     "KO-P001": check_repo_layering,
     "KO-P002": check_blocking_handlers,
     "KO-P003": check_lock_discipline,
     "KO-P004": check_mutable_defaults,
     "KO-P005": check_bare_except,
+    "KO-P006": check_subprocess_timeouts,
 }
 
 

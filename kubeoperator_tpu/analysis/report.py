@@ -114,6 +114,12 @@ RULES: dict[str, RuleSpec] = {
             "no bare `except:` handler — it swallows KeyboardInterrupt and "
             "SystemExit; catch Exception (or narrower) instead",
         ),
+        RuleSpec(
+            "KO-P006", "subprocess-timeout", "ast", ERROR,
+            "every subprocess.run/Popen/check_* call outside terminal/ "
+            "passes timeout= (or carries a `# KO-P006: waived — <reason>` "
+            "comment) — an un-deadlined child process wedges its caller",
+        ),
     )
 }
 

@@ -311,7 +311,14 @@ def _poll_to_ready(client, name: str, timeout_s: float, quiet: bool) -> int:
             if key not in seen and cond["status"] != "Unknown":
                 seen.add(key)
                 if not quiet:
-                    print(f"  phase {cond['name']}: {cond['status']}"
+                    # resilience trail: show retries and the failure class
+                    # so an unattended deploy's recovery work stays visible
+                    extra = ""
+                    if cond.get("attempts", 0) > 1:
+                        extra += f" [attempts={cond['attempts']}]"
+                    if cond["status"] == "Failed" and cond.get("classification"):
+                        extra += f" [{cond['classification'].lower()}]"
+                    print(f"  phase {cond['name']}: {cond['status']}{extra}"
                           + (f" ({cond['message']})" if cond.get("message") else ""))
         phase = status.get("phase")
         if phase == "Ready":
@@ -842,6 +849,150 @@ def cmd_lint(args) -> int:
     return report.exit_code()
 
 
+def _chaos_soak_once(args, base_dir: str) -> dict:
+    """One seeded soak pass: an in-process stack (simulation executor under
+    a ChaosExecutor, FakeProvisioner) deploys `--deploys` TPU clusters
+    end-to-end while faults are injected; failed deploys are retried the
+    way an unattended operator loop would. Returns the structural trace
+    (no timestamps) so two passes with one seed can be diffed bytewise."""
+    import shutil
+
+    from kubeoperator_tpu.models import Plan, Region, Zone
+    from kubeoperator_tpu.service import build_services
+    from kubeoperator_tpu.utils.config import load_config
+
+    os.makedirs(base_dir, exist_ok=True)
+    config = load_config(path="/nonexistent", env={}, overrides={
+        "db": {"path": os.path.join(base_dir, "soak.db")},
+        "logging": {"level": "WARNING"},   # retries still log; phases don't
+        "executor": {"backend": "simulation"},
+        "provisioner": {"work_dir": os.path.join(base_dir, "tf")},
+        "cron": {"backup_enabled": False, "health_check_interval_s": 0,
+                 "event_sync_interval_s": 0},
+        "cluster": {"kubeconfig_dir": os.path.join(base_dir, "kc")},
+        "chaos": {
+            "enabled": True,
+            "seed": args.seed,
+            "unreachable_rate": args.unreachable_rate,
+            "process_death_rate": args.process_death_rate,
+            "slow_stream_rate": args.slow_stream_rate,
+            "slow_stream_delay_s": 0.005,
+        },
+        "resilience": {
+            "max_attempts": args.max_attempts,
+            "backoff_base_s": args.backoff_s,
+            "backoff_max_s": max(args.backoff_s * 4, args.backoff_s),
+            "jitter_ratio": 0.1,
+        },
+    })
+    services = build_services(config, simulate=True)
+    deploys = []
+    try:
+        region = services.regions.create(Region(
+            name="chaos-region", provider="gcp_tpu_vm",
+            vars={"project": "chaos", "name": "us-central1"},
+        ))
+        zone = services.zones.create(Zone(
+            name="chaos-zone", region_id=region.id,
+            vars={"gcp_zone": "us-central1-a"},
+        ))
+        services.plans.create(Plan(
+            name="chaos-v5e-16", provider="gcp_tpu_vm", region_id=region.id,
+            zone_ids=[zone.id], accelerator="tpu", tpu_type="v5e-16",
+            worker_count=0,
+        ))
+        for i in range(args.deploys):
+            name = f"chaos-{i}"
+            rounds = 0
+            while True:
+                rounds += 1
+                try:
+                    if rounds == 1:
+                        services.clusters.create(
+                            name, provision_mode="plan",
+                            plan_name="chaos-v5e-16", wait=True)
+                    else:
+                        services.clusters.retry(name, wait=True)
+                except KoError:
+                    pass   # Failed state recorded; the loop decides below
+                cluster = services.clusters.get(name)
+                if cluster.status.phase == "Ready" \
+                        or rounds >= args.max_retry_rounds:
+                    break
+            trace = cluster.status.trace()
+            deploys.append({
+                "cluster": name,
+                "final_phase": cluster.status.phase,
+                "operator_rounds": rounds,
+                "spans": [
+                    {k: s[k] for k in
+                     ("name", "status", "attempts", "classification")}
+                    for s in trace["spans"]
+                ],
+            })
+        chaos = services.executor   # the ChaosExecutor wrapper
+        report = {
+            "seed": args.seed,
+            "deploys": deploys,
+            "all_ready": all(d["final_phase"] == "Ready" for d in deploys),
+            "injections": [
+                {"playbook": inj.playbook, "kind": inj.kind, "host": inj.host}
+                for inj in chaos.injections
+            ],
+            "injection_summary": chaos.injection_summary(),
+            "retries_total": sum(
+                max(s["attempts"] - 1, 0)
+                for d in deploys for s in d["spans"]
+            ),
+        }
+    finally:
+        services.close()
+        shutil.rmtree(base_dir, ignore_errors=True)
+    return report
+
+
+def cmd_chaos_soak(args) -> int:
+    """Seeded chaos soak (docs/resilience.md): prove deploys ride through
+    injected faults unattended, and that a seed reproduces bit-identical
+    fault/retry traces. Exit 0 = every deploy reached Ready (and, with
+    --verify-determinism, both passes matched)."""
+    import tempfile
+    import time as _time
+
+    t0 = _time.monotonic()
+    with tempfile.TemporaryDirectory(prefix="ko-chaos-") as base:
+        report = _chaos_soak_once(args, os.path.join(base, "pass1"))
+        if args.verify_determinism:
+            second = _chaos_soak_once(args, os.path.join(base, "pass2"))
+            report["deterministic"] = (
+                report["deploys"] == second["deploys"]
+                and report["injections"] == second["injections"]
+            )
+    report["runtime_s"] = round(_time.monotonic() - t0, 3)
+    ok = report["all_ready"] and report.get("deterministic", True)
+    if args.format == "json":
+        _print(report)
+    else:
+        s = report["injection_summary"]
+        print(f"chaos-soak: seed={report['seed']} "
+              f"deploys={len(report['deploys'])} "
+              f"injections={s['total']} {s['by_kind']} "
+              f"retries={report['retries_total']}")
+        for d in report["deploys"]:
+            retried = [f"{sp['name']}x{sp['attempts']}"
+                       for sp in d["spans"] if sp["attempts"] > 1]
+            print(f"  {d['cluster']}: {d['final_phase']} "
+                  f"(operator rounds {d['operator_rounds']}"
+                  + (f", retried {' '.join(retried)}" if retried else "")
+                  + ")")
+        if args.verify_determinism:
+            print(f"  deterministic across two runs: "
+                  f"{report['deterministic']}")
+        print(f"  runtime {report['runtime_s']}s — "
+              + ("OK" if ok else "FAILED"))
+    return 0 if ok else 1
+
+
 def cmd_server(args) -> int:
     from kubeoperator_tpu.api import run_server
     from kubeoperator_tpu.service import build_services
@@ -1043,6 +1194,34 @@ def build_parser() -> argparse.ArgumentParser:
     lint_p.add_argument("--list-rules", action="store_true",
                         help="print every registered rule id and exit")
 
+    soak_p = sub.add_parser(
+        "chaos-soak",
+        help="seeded fault-injection soak over an in-process stack",
+        description=(
+            "Deploy N TPU clusters end-to-end through a ChaosExecutor "
+            "(simulation backend, no server/SSH/cloud) while unreachable-"
+            "host, process-death and slow-stream faults are injected from "
+            "a seeded RNG; failed deploys are retried like an unattended "
+            "operator loop. Exit 0 = every deploy reached Ready (and the "
+            "trace reproduced, with --verify-determinism). Recipes: "
+            "docs/resilience.md."
+        ),
+    )
+    soak_p.add_argument("--seed", type=int, default=1)
+    soak_p.add_argument("--deploys", type=int, default=3)
+    soak_p.add_argument("--unreachable-rate", type=float, default=0.15)
+    soak_p.add_argument("--process-death-rate", type=float, default=0.05)
+    soak_p.add_argument("--slow-stream-rate", type=float, default=0.0)
+    soak_p.add_argument("--max-attempts", type=int, default=3,
+                        help="phase retry budget (resilience.max_attempts)")
+    soak_p.add_argument("--backoff-s", type=float, default=0.01,
+                        help="backoff base; soak default is fast")
+    soak_p.add_argument("--max-retry-rounds", type=int, default=5,
+                        help="operator-level retry() rounds per deploy")
+    soak_p.add_argument("--verify-determinism", action="store_true",
+                        help="run the soak twice and diff the traces")
+    soak_p.add_argument("--format", choices=["text", "json"], default="text")
+
     audit_p = sub.add_parser("audit", help="operation audit trail "
                                            "(who did what, newest first)")
     audit_p.add_argument("-n", "--limit", type=int, default=50)
@@ -1076,6 +1255,8 @@ def main(argv: list[str] | None = None) -> int:
         return cmd_server(args)
     if args.cmd == "lint":
         return cmd_lint(args)
+    if args.cmd == "chaos-soak":
+        return cmd_chaos_soak(args)
     if args.cmd == "install":
         from kubeoperator_tpu.installer import install
 

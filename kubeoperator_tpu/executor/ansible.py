@@ -19,7 +19,9 @@ import tempfile
 import yaml
 
 from kubeoperator_tpu.executor.base import (
+    CANCELLED_RC,
     Executor,
+    FailureKind,
     HostStats,
     TaskSpec,
     TaskStatus,
@@ -92,6 +94,9 @@ class AnsibleExecutor(Executor):
     def _execute(self, spec: TaskSpec, state: _TaskState) -> None:
         with tempfile.TemporaryDirectory(prefix="ko-task-") as workdir:
             argv, env = self._materialize(spec, workdir)
+            # KO-P006: waived — Popen takes no timeout; the deadline is the
+            # cooperative-cancel kill hook registered right below, which the
+            # phase engine fires when a playbook outlives its phase deadline
             proc = subprocess.Popen(
                 argv,
                 stdout=subprocess.PIPE,
@@ -100,6 +105,7 @@ class AnsibleExecutor(Executor):
                 env=env,
                 cwd=self.project_dir,
             )
+            state.on_cancel(proc.kill)
             in_recap = False
             assert proc.stdout is not None
             for line in proc.stdout:
@@ -111,7 +117,13 @@ class AnsibleExecutor(Executor):
                 if in_recap and ":" in line:
                     self._parse_recap_line(line, state)
             rc = proc.wait()
-            if rc == 0:
+            if state.cancelled:
+                state.finish(
+                    TaskStatus.FAILED, rc=CANCELLED_RC,
+                    message=state.cancel_reason,
+                    classification=FailureKind.TRANSIENT.value,
+                )
+            elif rc == 0:
                 state.finish(TaskStatus.SUCCESS, rc=0)
             else:
                 state.finish(

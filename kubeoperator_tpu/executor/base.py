@@ -25,6 +25,49 @@ class TaskStatus(str, Enum):
     FAILED = "Failed"
 
 
+class FailureKind(str, Enum):
+    """What a FAILED TaskResult means for the retry layer (resilience/):
+    TRANSIENT failures (unreachable hosts, timeouts, killed processes) are
+    worth automatic retry; PERMANENT failures (a task genuinely failed on a
+    reachable host) halt the phase for operator attention."""
+
+    TRANSIENT = "Transient"
+    PERMANENT = "Permanent"
+
+
+# rc values that mean "the process died, not the playbook": 124 is the
+# runner's own cancel/deadline code (timeout(1) convention), 137/143 are
+# 128+SIGKILL/SIGTERM, negatives are raw signal deaths from Popen.wait,
+# and ansible reserves 4 for unreachable-host failures.
+TRANSIENT_RCS = frozenset({4, 124, 137, 143})
+
+# the runner's cancel/deadline rc (timeout(1) convention)
+CANCELLED_RC = 124
+
+# ansible's unreachable-host exit code — the ONE definition the classifier,
+# the FakeExecutor script path and the ChaosExecutor injector all share
+UNREACHABLE_RC = 4
+
+
+def classify_result(result: "TaskResult") -> str:
+    """Default failure classification for a finished TaskResult. Backends
+    can override by passing an explicit classification to finish()."""
+    if result.status != TaskStatus.FAILED.value:
+        return ""
+    # host_stats values are HostStats in-process but plain dicts across the
+    # gRPC runner boundary — classify both shapes identically
+    def unreachable(hs) -> int:
+        if isinstance(hs, dict):
+            return int(hs.get("unreachable", 0) or 0)
+        return int(getattr(hs, "unreachable", 0) or 0)
+
+    if any(unreachable(hs) for hs in result.host_stats.values()):
+        return FailureKind.TRANSIENT.value
+    if result.rc < 0 or result.rc in TRANSIENT_RCS:
+        return FailureKind.TRANSIENT.value
+    return FailureKind.PERMANENT.value
+
+
 @dataclass
 class TaskSpec:
     """One unit of execution — a named playbook from the project dir, or an
@@ -65,32 +108,89 @@ class TaskResult:
     host_stats: dict = field(default_factory=dict)  # host -> HostStats
     started_at: float = 0.0
     finished_at: float = 0.0
+    # FailureKind value for FAILED results ("" while pending/success) —
+    # the retry layer's routing signal
+    classification: str = ""
 
     @property
     def ok(self) -> bool:
         return self.status == TaskStatus.SUCCESS.value
 
+    @property
+    def transient(self) -> bool:
+        return self.classification == FailureKind.TRANSIENT.value
+
 
 class _TaskState:
-    """Buffered line stream + completion latch for one task."""
+    """Buffered line stream + completion latch + cancel flag for one task."""
 
     def __init__(self, task_id: str) -> None:
         self.result = TaskResult(task_id=task_id)
         self.lines: list[str] = []
         self.cond = threading.Condition()
         self.done = threading.Event()
+        # cooperative cancel: backends poll `cancelled` between tasks/lines;
+        # process-forking backends additionally register a kill hook so a
+        # hung child can't wedge a deploy forever
+        self.cancel_event = threading.Event()
+        self.cancel_reason = ""
+        self._kill_hooks: list = []
+
+    @property
+    def cancelled(self) -> bool:
+        return self.cancel_event.is_set()
+
+    def on_cancel(self, hook) -> None:
+        """Register a best-effort kill hook (e.g. proc.kill). Runs at most
+        once; if the task is already cancelled, runs immediately — closing
+        the register-after-cancel race."""
+        run_now = False
+        with self.cond:
+            if self.cancel_event.is_set():
+                run_now = True
+            else:
+                self._kill_hooks.append(hook)
+        if run_now:
+            try:
+                hook()
+            except Exception:
+                pass
+
+    def cancel(self, reason: str = "") -> None:
+        with self.cond:
+            if self.done.is_set() or self.cancel_event.is_set():
+                return
+            self.cancel_reason = reason or "cancelled"
+            self.cancel_event.set()
+            hooks, self._kill_hooks = self._kill_hooks, []
+            self.cond.notify_all()
+        for hook in hooks:
+            try:
+                hook()
+            except Exception:
+                pass
 
     def emit(self, line: str) -> None:
         with self.cond:
+            if self.done.is_set():
+                return   # late output from a force-finished task
             self.lines.append(line.rstrip("\n"))
             self.cond.notify_all()
 
-    def finish(self, status: TaskStatus, rc: int, message: str = "") -> None:
-        self.result.status = status.value
-        self.result.rc = rc
-        self.result.message = message
-        self.result.finished_at = now_ts()
+    def finish(self, status: TaskStatus, rc: int, message: str = "",
+               classification: str = "") -> None:
+        """Idempotent: the FIRST finish wins. A backend thread landing after
+        a deadline force-finish must not overwrite the recorded outcome."""
         with self.cond:
+            if self.done.is_set():
+                return
+            self.result.status = status.value
+            self.result.rc = rc
+            self.result.message = message
+            self.result.finished_at = now_ts()
+            self.result.classification = (
+                classification or classify_result(self.result)
+            )
             self.done.set()
             self.cond.notify_all()
 
@@ -188,6 +288,23 @@ class Executor(abc.ABC):
         state = self._state(task_id)
         if not state.done.wait(timeout_s):
             raise ExecutorError(message=f"task {task_id} timed out")
+        return state.result
+
+    def cancel(self, task_id: str, reason: str = "",
+               grace_s: float = 5.0) -> TaskResult:
+        """Cooperative cancel: flag the task, fire registered kill hooks,
+        and — if the backend thread still hasn't finished after `grace_s` —
+        force-finish the result as a TRANSIENT deadline failure so a hung
+        playbook can never wedge the calling deploy. The abandoned daemon
+        thread may linger; its late emit/finish calls are no-ops."""
+        state = self._state(task_id)
+        state.cancel(reason)
+        if not state.done.wait(grace_s):
+            state.finish(
+                TaskStatus.FAILED, rc=CANCELLED_RC,
+                message=reason or f"task {task_id} cancelled",
+                classification=FailureKind.TRANSIENT.value,
+            )
         return state.result
 
     def task_stats(self) -> dict:

@@ -3,7 +3,9 @@
 Records every (playbook, inventory, extra_vars) call so adm-flow tests can
 assert phase ordering and vars contracts without SSH or clusters; outcomes
 are scripted per playbook name (default: success). `fail_times` lets a test
-script "fail twice then succeed" to exercise resume/retry paths.
+script "fail twice then succeed" to exercise resume/retry paths, and
+`unreachable_hosts` makes those scripted failures look like lost SSH
+(unreachable recap, rc 4) so TRANSIENT classification is testable.
 """
 
 from __future__ import annotations
@@ -12,6 +14,7 @@ from collections import defaultdict
 from dataclasses import dataclass, field
 
 from kubeoperator_tpu.executor.base import (
+    UNREACHABLE_RC,
     Executor,
     HostStats,
     TaskSpec,
@@ -26,6 +29,9 @@ class ScriptedOutcome:
     success: bool = True
     lines: list[str] = field(default_factory=list)
     fail_times: int = 0   # fail this many runs, then apply `success`
+    # hosts to report UNREACHABLE (instead of failed) on failing runs —
+    # drives the TRANSIENT classification path; empty = task failure
+    unreachable_hosts: list[str] = field(default_factory=list)
 
 
 class FakeExecutor(Executor):
@@ -33,33 +39,58 @@ class FakeExecutor(Executor):
         super().__init__()
         self.calls: list[TaskSpec] = []
         self.outcomes: dict[str, ScriptedOutcome] = {}
-        self._runs: dict[str, int] = defaultdict(int)
+        # attempt counters keyed by (playbook, limit): a scale-up retrying
+        # against a different host subset must NOT inherit the create
+        # flow's attempt count for the same playbook
+        self._runs: dict[tuple, int] = defaultdict(int)
 
     def script(self, playbook: str, **kw) -> ScriptedOutcome:
         out = ScriptedOutcome(**kw)
         self.outcomes[playbook] = out
         return out
 
+    def runs_of(self, playbook: str, limit: str = "") -> int:
+        """Attempt count for one (playbook, limit) execution stream."""
+        return self._runs[(playbook, limit)]
+
     def _execute(self, spec: TaskSpec, state: _TaskState) -> None:
         self.calls.append(spec)
         name = spec.playbook or f"adhoc:{spec.adhoc_module}"
         outcome = self.outcomes.get(name, ScriptedOutcome())
-        self._runs[name] += 1
-        attempt = self._runs[name]
+        key = (name, spec.limit)
+        self._runs[key] += 1
+        attempt = self._runs[key]
         success = outcome.success and attempt > outcome.fail_times
 
         state.emit(f"PLAY [{name}] " + "*" * 40)
         for line in outcome.lines:
             state.emit(line)
         hosts = inventory_host_names(spec.inventory) or ["localhost"]
+        unreachable = set(outcome.unreachable_hosts) if not success else set()
         for h in hosts:
-            stats = HostStats(ok=3, changed=1, failed=0 if success else 1)
+            if h in unreachable:
+                state.emit(
+                    f"fatal: [{h}]: UNREACHABLE! => {{\"msg\": \"Failed to "
+                    f"connect to the host via ssh (scripted)\"}}"
+                )
+                stats = HostStats(unreachable=1)
+            else:
+                stats = HostStats(
+                    ok=3, changed=1,
+                    failed=0 if success or unreachable else 1,
+                )
             state.result.host_stats[h] = stats
             state.emit(
-                f"{h} : ok={stats.ok} changed={stats.changed} failed={stats.failed}"
+                f"{h} : ok={stats.ok} changed={stats.changed} "
+                f"failed={stats.failed} unreachable={stats.unreachable}"
             )
         if success:
             state.finish(TaskStatus.SUCCESS, rc=0)
+        elif unreachable:
+            state.finish(
+                TaskStatus.FAILED, rc=UNREACHABLE_RC,
+                message=f"scripted unreachable {name} (attempt {attempt})",
+            )
         else:
             state.emit(f"fatal: scripted failure for {name} (attempt {attempt})")
             state.finish(TaskStatus.FAILED, rc=2, message=f"scripted failure {name}")

@@ -62,6 +62,10 @@ class _Handler(grpc.GenericRpcHandler):
             return grpc.unary_unary_rpc_method_handler(
                 self._stats, request_deserializer=_loads, response_serializer=_dumps
             )
+        if method == f"/{SERVICE}/Cancel":
+            return grpc.unary_unary_rpc_method_handler(
+                self._cancel, request_deserializer=_loads, response_serializer=_dumps
+            )
         return None
 
     def _run(self, request: dict, context) -> dict:
@@ -89,6 +93,17 @@ class _Handler(grpc.GenericRpcHandler):
         # /healthz reach the REMOTE task registry, not the client's empty one
         return self.executor.task_stats()
 
+    def _cancel(self, request: dict, context) -> dict:
+        r = self.executor.cancel(
+            request["task_id"], reason=request.get("reason", ""),
+            grace_s=float(request.get("grace_s", 5.0)),
+        )
+        log.info("runner: task %s cancelled (%s)", request["task_id"],
+                 request.get("reason", ""))
+        d = r.__dict__.copy()
+        d["host_stats"] = {h: s.__dict__ for h, s in r.host_stats.items()}
+        return d
+
 
 def serve(
     executor: Executor, bind: str = "127.0.0.1:8790", max_workers: int = 16
@@ -107,7 +122,11 @@ class RunnerClient(Executor):
 
     def __init__(self, target: str = "127.0.0.1:8790") -> None:
         super().__init__()
-        self.channel = grpc.insecure_channel(target)
+        self.target = target
+        self._connect()
+
+    def _connect(self) -> None:
+        self.channel = grpc.insecure_channel(self.target)
         self._run_rpc = self.channel.unary_unary(
             f"/{SERVICE}/Run", request_serializer=_dumps, response_deserializer=_loads
         )
@@ -120,6 +139,20 @@ class RunnerClient(Executor):
         self._stats_rpc = self.channel.unary_unary(
             f"/{SERVICE}/Stats", request_serializer=_dumps, response_deserializer=_loads
         )
+        self._cancel_rpc = self.channel.unary_unary(
+            f"/{SERVICE}/Cancel", request_serializer=_dumps,
+            response_deserializer=_loads,
+        )
+
+    def _reconnect(self) -> None:
+        """Dial a fresh channel. A channel that watched its server die can
+        wedge a subchannel in shutdown (observed as UNAVAILABLE 'FD
+        Shutdown' persisting after the server is back); rebuilding is the
+        reliable way out for a restart-riding retry. The old channel is
+        deliberately NOT closed: concurrent deploy threads may have
+        in-flight watch streams riding it, and close() would abort them —
+        healthy streams keep their channel alive; a dead one is GC'd."""
+        self._connect()
 
     # How long Run tolerates an UNAVAILABLE runner before giving up. The
     # compose ships ko-runner with `restart: always`; a task submitted
@@ -148,6 +181,9 @@ class RunnerClient(Executor):
                 code = e.code() if hasattr(e, "code") else None
                 if (code == grpc.StatusCode.UNAVAILABLE
                         and _time.monotonic() < deadline):
+                    # dial fresh before retrying: see _reconnect — a stale
+                    # channel can stay UNAVAILABLE after the server is back
+                    self._reconnect()
                     _time.sleep(0.3)
                     continue
                 raise ExecutorError(message=f"runner RPC failed: {e}") from e
@@ -183,6 +219,22 @@ class RunnerClient(Executor):
         for _ in self.watch(task_id, timeout_s):
             pass
         return self.result(task_id)
+
+    def cancel(self, task_id: str, reason: str = "",
+               grace_s: float = 5.0) -> TaskResult:
+        """Cancel lives in the runner process where the task threads are;
+        the RPC blocks through the server-side grace window."""
+        try:
+            d = self._cancel_rpc(
+                {"task_id": task_id, "reason": reason, "grace_s": grace_s},
+                timeout=grace_s + 10.0,
+            )
+        except grpc.RpcError as e:
+            raise ExecutorError(message=f"runner cancel failed: {e}") from e
+        d["host_stats"] = {
+            h: HostStats(**s) for h, s in d.get("host_stats", {}).items()
+        }
+        return TaskResult(**d)
 
     def _execute(self, spec, state):  # pragma: no cover - remote only
         raise NotImplementedError

@@ -22,7 +22,9 @@ import yaml
 
 
 from kubeoperator_tpu.executor.base import (
+    CANCELLED_RC,
     Executor,
+    FailureKind,
     HostStats,
     TaskSpec,
     TaskStatus,
@@ -300,6 +302,15 @@ class SimulationExecutor(Executor):
                 play_tasks, os.path.join(self.project_dir, "playbooks")
             ))
             for task in tasks:
+                if state.cancelled:
+                    state.emit("fatal: run cancelled by the platform "
+                               f"({state.cancel_reason})")
+                    state.finish(
+                        TaskStatus.FAILED, rc=CANCELLED_RC,
+                        message=state.cancel_reason,
+                        classification=FailureKind.TRANSIENT.value,
+                    )
+                    return
                 tname = str(task.get("name", "unnamed task"))
 
                 def _ctx_for(h: str) -> dict:

@@ -150,7 +150,18 @@ def install(target_dir: str, start: bool = True) -> dict:
     result = {"compose": compose_path, "started": False}
     cmd = _compose_cmd()
     if start and cmd:
-        subprocess.run([*cmd, "-f", compose_path, "up", "-d"], check=True)
+        # image pulls on a cold host dominate; 10 min bounds even those
+        try:
+            subprocess.run([*cmd, "-f", compose_path, "up", "-d"],
+                           check=True, timeout=600)
+        except subprocess.TimeoutExpired as e:
+            from kubeoperator_tpu.utils.errors import KoError
+
+            raise KoError(
+                message="compose up timed out after 600s — check the "
+                        "docker daemon / registry reachability and re-run "
+                        "`koctl install`"
+            ) from e
         result["started"] = True
     elif start:
         result["note"] = (
@@ -186,8 +197,14 @@ def uninstall(target_dir: str, purge_data: bool = False) -> dict:
     cmd = _compose_cmd()
     stopped = False
     if cmd and os.path.exists(compose_path):
-        subprocess.run([*cmd, "-f", compose_path, "down"], check=False)
-        stopped = True
+        try:
+            subprocess.run([*cmd, "-f", compose_path, "down"], check=False,
+                           timeout=300)
+            stopped = True
+        except subprocess.TimeoutExpired:
+            # same tolerance as check=False: a wedged compose must not
+            # block the rest of the uninstall (incl. --purge)
+            stopped = False
     if purge_data:
         shutil.rmtree(target_dir, ignore_errors=True)
     return {"stopped": stopped, "purged": purge_data}

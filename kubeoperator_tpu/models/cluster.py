@@ -117,6 +117,13 @@ class ClusterStatusCondition:
     order_index: int = 0
     started_at: float = 0.0
     finished_at: float = 0.0
+    # resilience trail (adm/engine.py retry loop): how many executor
+    # attempts this phase consumed, the last failure's TRANSIENT/PERMANENT
+    # classification, and the total backoff the retries slept — kept so the
+    # create-to-Ready trace stays honest about where wall-clock went
+    attempts: int = 0
+    classification: str = ""
+    backoff_s: float = 0.0
 
     @property
     def duration_s(self) -> float:
@@ -202,6 +209,9 @@ class ClusterStatus:
             "started_at": c.started_at,
             "finished_at": c.finished_at,
             "duration_s": round(c.duration_s, 3) if c.duration_s else None,
+            "attempts": c.attempts,
+            "classification": c.classification or None,
+            "backoff_s": round(c.backoff_s, 3) if c.backoff_s else 0.0,
         } for c in sorted(self.conditions, key=lambda c: c.order_index)]
         started = [s["started_at"] for s in spans if s["started_at"]]
         finished = [s["finished_at"] for s in spans if s["finished_at"]]

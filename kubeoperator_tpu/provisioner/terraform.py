@@ -19,6 +19,7 @@ import subprocess
 import jinja2
 
 from kubeoperator_tpu.models import Host, Plan, Region, Zone
+from kubeoperator_tpu.resilience.policy import RetryPolicy, retry_call
 from kubeoperator_tpu.utils.errors import ProvisionerError
 from kubeoperator_tpu.utils.logging import get_logger
 
@@ -125,10 +126,19 @@ class TerraformProvisioner:
         terraform_bin: str = "terraform",
         templates_dir: str = TEMPLATES_DIR,
         timeout_s: float = 3600,
+        retry_policy: RetryPolicy | None = None,
     ) -> None:
         self.work_dir = work_dir
         self.terraform_bin = terraform_bin
         self.timeout_s = timeout_s
+        # IaaS calls are the most transient layer of all: timeouts retry
+        # with backoff (terraform apply/destroy are idempotent by design —
+        # a re-apply reconciles whatever the timed-out run half-created).
+        # Non-timeout failures (bad credentials, quota, template bugs)
+        # surface immediately.
+        self.retry_policy = retry_policy or RetryPolicy(
+            max_attempts=2, backoff_base_s=5.0, jitter_ratio=0.0,
+        )
         self.env = jinja2.Environment(
             loader=jinja2.FileSystemLoader(templates_dir),
             undefined=jinja2.StrictUndefined,
@@ -187,25 +197,41 @@ class TerraformProvisioner:
                 timeout=self.timeout_s,
             )
         except subprocess.TimeoutExpired as e:
-            raise ProvisionerError(
+            err = ProvisionerError(
                 message=f"{' '.join(cmd)} timed out after {self.timeout_s:g}s"
-            ) from e
+            )
+            err.transient = True   # the retry layer's routing signal
+            raise err from e
         if proc.returncode != 0:
             raise ProvisionerError(
                 message=f"{' '.join(cmd)} failed: {proc.stderr[-2000:]}"
             )
         return proc.stdout
 
+    def _run_retry(self, cluster_dir: str, *args: str) -> str:
+        """_run under the retry policy: timeouts back off and re-run (the
+        command set here — init/apply/destroy — is idempotent), everything
+        else raises straight through."""
+        return retry_call(
+            lambda: self._run(cluster_dir, *args),
+            policy=self.retry_policy,
+            is_transient=lambda e: getattr(e, "transient", False),
+            on_retry=lambda attempt, e, delay: log.warning(
+                "terraform attempt %d/%d timed out (%s); retrying in %.1fs",
+                attempt, self.retry_policy.max_attempts, e, delay,
+            ),
+        )
+
     def apply(self, cluster_dir: str) -> None:
-        self._run(cluster_dir, "init", "-input=false", "-no-color")
-        self._run(
+        self._run_retry(cluster_dir, "init", "-input=false", "-no-color")
+        self._run_retry(
             cluster_dir, "apply", "-auto-approve", "-input=false", "-no-color"
         )
 
     def destroy(self, cluster_dir: str) -> None:
         # init first: the delete flow may run on a fresh disk/re-rendered dir
-        self._run(cluster_dir, "init", "-input=false", "-no-color")
-        self._run(
+        self._run_retry(cluster_dir, "init", "-input=false", "-no-color")
+        self._run_retry(
             cluster_dir, "destroy", "-auto-approve", "-input=false", "-no-color"
         )
 

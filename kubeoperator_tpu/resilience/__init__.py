@@ -1,0 +1,31 @@
+"""Resilience layer: retry policy, failure classification, seeded chaos.
+
+The deploy pipeline's job is surviving the messy middle of cluster
+lifecycle operations — flaky SSH, unreachable hosts, half-applied phases
+(PAPER.md §3.1). This package gives every consumer of the execution stack
+one shared vocabulary for that:
+
+  * RetryPolicy        — max attempts, exponential backoff with seeded
+                         jitter, per-phase deadline (policy.py)
+  * retry_call         — generic retry-with-backoff wrapper used by the
+                         provisioner's IaaS calls (policy.py)
+  * ChaosExecutor      — a seeded fault-injection wrapper over any inner
+                         executor: unreachable recaps, slow streams,
+                         mid-phase process death, fail-N-then-succeed
+                         (chaos.py); surfaced as `koctl chaos-soak` and
+                         the `chaos.*` config block
+
+Failure classification itself (TRANSIENT vs PERMANENT) lives in
+executor/base.py next to TaskResult, because every backend finishes tasks
+through that module; this package consumes it.
+"""
+
+from kubeoperator_tpu.resilience.policy import (
+    RetryPolicy,
+    retry_call,
+    retry_wiring,
+)
+from kubeoperator_tpu.resilience.chaos import ChaosConfig, ChaosExecutor
+
+__all__ = ["RetryPolicy", "retry_call", "retry_wiring",
+           "ChaosConfig", "ChaosExecutor"]

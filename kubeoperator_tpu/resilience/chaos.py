@@ -1,0 +1,220 @@
+"""ChaosExecutor — seeded fault injection over any inner executor.
+
+Wraps a real backend (simulation, ansible, fake) and decides per submitted
+task whether to inject a fault instead of (or around) delegating:
+
+  * unreachable    — the task "reaches" no further than an ansible
+                     unreachable-host recap (rc 4, unreachable=1): the
+                     flaky-SSH shape, classified TRANSIENT
+  * process-death  — the runner process dies mid-phase (rc 137, partial
+                     output, no recap): the killed-engine shape the
+                     resume-under-crash tests re-enter from
+  * slow-stream    — the task succeeds but every output line is delayed,
+                     which is how phase deadlines get exercised
+  * fail-N-then-succeed — scripted per (playbook, limit) via fail_times(),
+                     for exact retry-count assertions
+
+Determinism contract: ALL entropy comes from the `random.Random` passed in
+(no ambient time/os entropy — `Date.now`-style seeding is exactly what
+makes chaos runs unreproducible). One draw is consumed per submitted task
+regardless of which rates are enabled, so a given seed produces the same
+injection sequence whatever the rate mix. `koctl chaos-soak` runs the same
+seed twice and diffs the traces to prove it.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+from kubeoperator_tpu.executor.base import (
+    UNREACHABLE_RC,
+    Executor,
+    FailureKind,
+    HostStats,
+    TaskSpec,
+    TaskStatus,
+    _TaskState,
+)
+from kubeoperator_tpu.executor.inventory import inventory_host_names
+
+KILLED_RC = 137         # 128 + SIGKILL: process death mid-phase
+
+
+@dataclass
+class ChaosConfig:
+    """The `chaos.*` config block (utils/config.py DEFAULTS)."""
+
+    unreachable_rate: float = 0.0
+    process_death_rate: float = 0.0
+    slow_stream_rate: float = 0.0
+    slow_stream_delay_s: float = 0.02
+    max_injections: int = 0    # 0 = unbounded
+
+    @classmethod
+    def from_config(cls, config, section: str = "chaos") -> "ChaosConfig":
+        base = cls()
+        return cls(
+            unreachable_rate=float(config.get(
+                f"{section}.unreachable_rate", base.unreachable_rate)),
+            process_death_rate=float(config.get(
+                f"{section}.process_death_rate", base.process_death_rate)),
+            slow_stream_rate=float(config.get(
+                f"{section}.slow_stream_rate", base.slow_stream_rate)),
+            slow_stream_delay_s=float(config.get(
+                f"{section}.slow_stream_delay_s", base.slow_stream_delay_s)),
+            max_injections=int(config.get(
+                f"{section}.max_injections", base.max_injections)),
+        )
+
+
+class _SlowState:
+    """Emit-delaying proxy over a _TaskState (slow-stream injection)."""
+
+    def __init__(self, state: _TaskState, delay_s: float) -> None:
+        self._state = state
+        self._delay_s = delay_s
+
+    def emit(self, line: str) -> None:
+        time.sleep(self._delay_s)
+        self._state.emit(line)
+
+    def __getattr__(self, name):
+        return getattr(self._state, name)
+
+
+@dataclass
+class Injection:
+    """Audit row for one injected fault (the soak report's raw material)."""
+
+    task_id: str
+    playbook: str
+    kind: str
+    host: str = ""
+
+
+class ChaosExecutor(Executor):
+    """Executor facade injecting seeded faults around an inner backend.
+
+    The wrapper owns the task registry (run/watch/result/cancel all ride
+    the base class); the inner executor is used purely as an _execute
+    engine, so any backend slots in unmodified.
+    """
+
+    def __init__(self, inner: Executor, rng, config: ChaosConfig | None = None):
+        super().__init__()
+        self.inner = inner
+        self.rng = rng
+        self.config = config or ChaosConfig()
+        self.injections: list[Injection] = []
+        self._scripted: dict[tuple, list] = {}
+
+    # ---- scripting (deterministic sequences for tests/recipes) ----
+    def fail_times(self, playbook: str, times: int,
+                   kind: str = "unreachable", limit: str = "") -> None:
+        """Queue `times` injected failures of `kind` for the next runs of
+        (playbook, limit), after which runs delegate to the inner backend —
+        the fail-N-then-succeed shape retry tests assert exact counts on.
+        Keyed by (playbook, limit) so a scale-up retrying against a
+        different host subset never inherits the create-flow's queue."""
+        key = (playbook, limit)
+        self._scripted.setdefault(key, []).extend([kind] * times)
+
+    # ---- fault selection ----
+    def _next_fault(self, spec: TaskSpec) -> tuple:
+        """Returns (kind|None, frac): `frac` ∈ [0,1) is derived from the
+        SAME single draw (the within-band remainder) and seeds any
+        secondary choice a fault needs (victim host), so no fault ever
+        consumes a second draw — the per-task draw sequence stays
+        independent of the rate mix, as the module contract promises.
+        Scripted faults consume no draw and get frac 0.0."""
+        key = (spec.playbook or f"adhoc:{spec.adhoc_module}", spec.limit)
+        queue = self._scripted.get(key)
+        if queue:
+            return queue.pop(0), 0.0
+        cfg = self.config
+        # ONE draw per submitted task, spent whether or not a fault fires
+        draw = self.rng.random()
+        if cfg.max_injections and len(self.injections) >= cfg.max_injections:
+            return None, 0.0
+        for kind, rate in (
+            ("unreachable", cfg.unreachable_rate),
+            ("process-death", cfg.process_death_rate),
+            ("slow-stream", cfg.slow_stream_rate),
+        ):
+            if draw < rate:
+                return kind, draw / rate
+            draw -= rate
+        return None, 0.0
+
+    # ---- execution ----
+    def _execute(self, spec: TaskSpec, state: _TaskState) -> None:
+        name = spec.playbook or f"adhoc:{spec.adhoc_module}"
+        fault, frac = self._next_fault(spec)
+        if fault == "unreachable":
+            self._inject_unreachable(name, spec, state, frac)
+            return
+        if fault == "process-death":
+            self._inject_process_death(name, spec, state)
+            return
+        if fault == "slow-stream":
+            self.injections.append(Injection(
+                task_id=state.result.task_id, playbook=name,
+                kind="slow-stream",
+            ))
+            state.emit(f"CHAOS [slow-stream] {name}: "
+                       f"+{self.config.slow_stream_delay_s:g}s/line")
+            self.inner._execute(
+                spec, _SlowState(state, self.config.slow_stream_delay_s))
+            return
+        self.inner._execute(spec, state)
+
+    def _inject_unreachable(
+        self, name: str, spec: TaskSpec, state: _TaskState, frac: float = 0.0
+    ) -> None:
+        hosts = inventory_host_names(spec.inventory) or ["localhost"]
+        victim = hosts[min(int(frac * len(hosts)), len(hosts) - 1)]
+        self.injections.append(Injection(
+            task_id=state.result.task_id, playbook=name,
+            kind="unreachable", host=victim,
+        ))
+        state.emit(f"PLAY [{name}] " + "*" * 40)
+        state.emit(
+            f"fatal: [{victim}]: UNREACHABLE! => {{\"changed\": false, "
+            f"\"msg\": \"Failed to connect to the host via ssh (chaos)\", "
+            f"\"unreachable\": true}}"
+        )
+        state.emit("PLAY RECAP " + "*" * 50)
+        for h in hosts:
+            stats = HostStats(unreachable=1 if h == victim else 0)
+            state.result.host_stats[h] = stats
+            state.emit(f"{h} : ok=0 changed=0 unreachable="
+                       f"{stats.unreachable} failed=0 skipped=0")
+        state.finish(
+            TaskStatus.FAILED, rc=UNREACHABLE_RC,
+            message=f"host {victim} unreachable (chaos)",
+        )
+
+    def _inject_process_death(
+        self, name: str, spec: TaskSpec, state: _TaskState
+    ) -> None:
+        self.injections.append(Injection(
+            task_id=state.result.task_id, playbook=name, kind="process-death",
+        ))
+        state.emit(f"PLAY [{name}] " + "*" * 40)
+        state.emit("TASK [chaos : partial output before the runner dies] "
+                   + "*" * 20)
+        # no recap, no per-host stats: exactly what a SIGKILLed
+        # ansible-playbook leaves behind
+        state.finish(
+            TaskStatus.FAILED, rc=KILLED_RC,
+            message=f"runner process killed mid-phase running {name} (chaos)",
+            classification=FailureKind.TRANSIENT.value,
+        )
+
+    # ---- observability ----
+    def injection_summary(self) -> dict:
+        by_kind: dict[str, int] = {}
+        for inj in self.injections:
+            by_kind[inj.kind] = by_kind.get(inj.kind, 0) + 1
+        return {"total": len(self.injections), "by_kind": by_kind}

@@ -1,0 +1,117 @@
+"""RetryPolicy — how many times, how long between, how long at most.
+
+One policy object is shared by every layer that retries: the phase engine
+(ClusterAdm auto-retries TRANSIENT phase failures), guided recovery
+(service/health.py re-runs phases under the same policy), and the
+terraform provisioner (IaaS timeouts are the most transient layer of all).
+
+Determinism contract: jitter entropy is NEVER ambient. A policy computes
+backoff from an explicitly-passed `random.Random`; with no RNG the backoff
+is the pure exponential. That is what lets `koctl chaos-soak` prove two
+seeded runs produce byte-identical attempt traces.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Callable
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Per-phase retry envelope.
+
+    max_attempts counts the initial try: 3 means "one try + up to two
+    retries". phase_deadline_s bounds the WHOLE phase including backoff
+    spans (0 = no deadline beyond the executor's own watch timeout).
+    """
+
+    max_attempts: int = 3
+    backoff_base_s: float = 1.0
+    backoff_factor: float = 2.0
+    backoff_max_s: float = 30.0
+    jitter_ratio: float = 0.1       # +/- fraction of the computed delay
+    phase_deadline_s: float = 0.0   # 0 = unbounded (executor default only)
+
+    def backoff_s(self, attempt: int, rng=None) -> float:
+        """Delay after failed attempt N (1-based), capped and jittered.
+
+        `rng` is a random.Random (or None for the pure exponential); the
+        caller owns the seed so traces stay reproducible.
+        """
+        if attempt < 1:
+            attempt = 1
+        delay = min(
+            self.backoff_base_s * (self.backoff_factor ** (attempt - 1)),
+            self.backoff_max_s,
+        )
+        if rng is not None and self.jitter_ratio > 0:
+            delay *= 1.0 + self.jitter_ratio * (2.0 * rng.random() - 1.0)
+        return max(delay, 0.0)
+
+    def deadline_from(self, start_ts: float) -> float | None:
+        return start_ts + self.phase_deadline_s if self.phase_deadline_s else None
+
+    @classmethod
+    def from_config(cls, config, section: str = "resilience") -> "RetryPolicy":
+        """Build from the `resilience.*` config block (utils/config.py
+        DEFAULTS); unknown/absent keys keep the dataclass defaults."""
+        base = cls()
+        return cls(
+            max_attempts=int(config.get(
+                f"{section}.max_attempts", base.max_attempts)),
+            backoff_base_s=float(config.get(
+                f"{section}.backoff_base_s", base.backoff_base_s)),
+            backoff_factor=float(config.get(
+                f"{section}.backoff_factor", base.backoff_factor)),
+            backoff_max_s=float(config.get(
+                f"{section}.backoff_max_s", base.backoff_max_s)),
+            jitter_ratio=float(config.get(
+                f"{section}.jitter_ratio", base.jitter_ratio)),
+            phase_deadline_s=float(config.get(
+                f"{section}.phase_deadline_s", base.phase_deadline_s)),
+        )
+
+
+def retry_wiring(config) -> tuple:
+    """The ONE place the `resilience.*` config block becomes the
+    (RetryPolicy, jitter RNG) pair every phase-running service shares —
+    so retry behavior cannot drift between entry points (create, scale,
+    upgrade, backup, components, CIS, guided recovery)."""
+    import random
+
+    return (
+        RetryPolicy.from_config(config),
+        random.Random(int(config.get("resilience.jitter_seed", 0))),
+    )
+
+
+def retry_call(
+    fn: Callable,
+    *,
+    policy: RetryPolicy,
+    is_transient: Callable[[Exception], bool],
+    on_retry: Callable[[int, Exception, float], None] | None = None,
+    rng=None,
+    sleep: Callable[[float], None] = time.sleep,
+):
+    """Call `fn()` under the policy, retrying exceptions `is_transient`
+    accepts. Non-transient exceptions and the final exhausted attempt
+    re-raise unchanged, so callers' typed-error contracts survive.
+
+    `on_retry(attempt, exc, delay_s)` fires before each backoff sleep —
+    the hook layers use for events/logging."""
+    attempt = 0
+    while True:
+        attempt += 1
+        try:
+            return fn()
+        except Exception as e:
+            if attempt >= policy.max_attempts or not is_transient(e):
+                raise
+            delay = policy.backoff_s(attempt, rng)
+            if on_retry is not None:
+                on_retry(attempt, e, delay)
+            if delay > 0:
+                sleep(delay)

@@ -26,10 +26,11 @@ def _check_k8s_name(value: str, what: str) -> None:
 
 
 class BackupService:
-    def __init__(self, repos: Repositories, executor: Executor, events):
+    def __init__(self, repos: Repositories, executor: Executor, events,
+                 retry_policy=None, retry_rng=None):
         self.repos = repos
         self.events = events
-        self.adm = ClusterAdm(executor)
+        self.adm = ClusterAdm(executor, policy=retry_policy, rng=retry_rng)
 
     # ---- accounts ----
     def create_account(self, account: BackupAccount) -> BackupAccount:

@@ -57,13 +57,26 @@ class ClusterService:
         provisioner: TerraformProvisioner,
         events,
         config: Config,
+        retry_policy=None,
+        retry_rng=None,
     ) -> None:
         self.repos = repos
         self.executor = executor
         self.provisioner = provisioner
         self.events = events
         self.config = config
-        self.adm = ClusterAdm(executor)
+        # phase retry envelope (resilience.* config block): TRANSIENT
+        # failures auto-retry with seeded-jitter backoff before halting.
+        # The container passes the stack-wide pair; direct construction
+        # (tests) falls back per-argument so an explicit policy is never
+        # silently replaced just because the rng was omitted.
+        if retry_policy is None or retry_rng is None:
+            from kubeoperator_tpu.resilience import retry_wiring
+
+            policy_fb, rng_fb = retry_wiring(config)
+            retry_policy = retry_policy if retry_policy is not None else policy_fb
+            retry_rng = retry_rng if retry_rng is not None else rng_fb
+        self.adm = ClusterAdm(executor, policy=retry_policy, rng=retry_rng)
         self._ops: dict[str, threading.Thread] = {}
         self._ops_lock = threading.Lock()
         # static-IP pool reservations: addresses allocated at render time but

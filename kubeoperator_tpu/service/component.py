@@ -70,10 +70,11 @@ def _check_vars_inert(vars: dict, origin: str, redact: bool = False,
 
 
 class ComponentService:
-    def __init__(self, repos: Repositories, executor: Executor, events):
+    def __init__(self, repos: Repositories, executor: Executor, events,
+                 retry_policy=None, retry_rng=None):
         self.repos = repos
         self.events = events
-        self.adm = ClusterAdm(executor)
+        self.adm = ClusterAdm(executor, policy=retry_policy, rng=retry_rng)
 
     def catalog(self) -> dict:
         return {k: dict(v) for k, v in COMPONENT_CATALOG.items()}

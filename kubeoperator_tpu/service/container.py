@@ -69,15 +69,35 @@ class Services:
         self.ldap = LdapService(repos, config)
         self.users = UserService(repos, config, ldap=self.ldap)
         self.projects = ProjectService(repos)
+        # ONE retry policy + jitter RNG for every phase-running service
+        # (create, scale, upgrade, backup, components, CIS, guided
+        # recovery), so transient-failure behavior cannot drift between
+        # entry points
+        from kubeoperator_tpu.resilience import retry_wiring
+
+        retry_policy, retry_rng = retry_wiring(config)
         self.clusters = ClusterService(
-            repos, executor, provisioner, self.events, config
+            repos, executor, provisioner, self.events, config,
+            retry_policy=retry_policy, retry_rng=retry_rng,
         )
-        self.nodes = NodeService(repos, executor, provisioner, self.events)
-        self.upgrades = UpgradeService(repos, executor, self.events)
-        self.backups = BackupService(repos, executor, self.events)
-        self.health = HealthService(repos, executor, self.events)
-        self.components = ComponentService(repos, executor, self.events)
-        self.cis = CisService(repos, executor, self.events)
+        self.nodes = NodeService(repos, executor, provisioner, self.events,
+                                 retry_policy=retry_policy,
+                                 retry_rng=retry_rng)
+        self.upgrades = UpgradeService(repos, executor, self.events,
+                                       retry_policy=retry_policy,
+                                       retry_rng=retry_rng)
+        self.backups = BackupService(repos, executor, self.events,
+                                     retry_policy=retry_policy,
+                                     retry_rng=retry_rng)
+        self.health = HealthService(repos, executor, self.events,
+                                    retry_policy=retry_policy,
+                                    retry_rng=retry_rng)
+        self.components = ComponentService(repos, executor, self.events,
+                                           retry_policy=retry_policy,
+                                           retry_rng=retry_rng)
+        self.cis = CisService(repos, executor, self.events,
+                              retry_policy=retry_policy,
+                              retry_rng=retry_rng)
         self.cron = CronService(self)
         from kubeoperator_tpu.terminal import TerminalManager
 
@@ -107,6 +127,31 @@ def build_services(
         config.get("executor.project_dir"),
         runner_address=config.get("executor.runner_address"),
     )
+    if config.get("chaos.enabled", False):
+        # seeded fault injection (resilience/chaos.py): the stack behaves
+        # identically to production EXCEPT tasks randomly fail in transient
+        # ways — the harness `koctl chaos-soak` builds on. The wrapper owns
+        # the task registry; the configured backend runs underneath.
+        if backend == "grpc":
+            # RunnerClient executes remotely and has no in-process _execute
+            # for the wrapper to delegate to — fail at build time, not with
+            # an opaque NotImplementedError on the first phase
+            from kubeoperator_tpu.utils.errors import ValidationError
+
+            raise ValidationError(
+                "chaos.enabled requires an in-process executor backend "
+                "(simulation/ansible/fake), not executor.backend=grpc — "
+                "enable chaos on the ko-runner process instead"
+            )
+        import random
+
+        from kubeoperator_tpu.resilience import ChaosConfig, ChaosExecutor
+
+        executor = ChaosExecutor(
+            executor,
+            rng=random.Random(int(config.get("chaos.seed", 1))),
+            config=ChaosConfig.from_config(config),
+        )
     if simulate is None:
         simulate = not terraform_available(
             config.get("provisioner.terraform_bin", "terraform")
@@ -122,9 +167,16 @@ def build_services(
             f"provisioner.timeout_s must be a number of seconds, "
             f"got {raw_timeout!r}"
         )
+    from kubeoperator_tpu.resilience import RetryPolicy
+
     provisioner = prov_cls(
         work_dir=config.get("provisioner.work_dir", "terraform_runs"),
         terraform_bin=config.get("provisioner.terraform_bin", "terraform"),
         timeout_s=timeout_s,
+        retry_policy=RetryPolicy(
+            max_attempts=int(config.get("provisioner.retry_max_attempts", 2)),
+            backoff_base_s=float(config.get("provisioner.retry_backoff_s", 5)),
+            jitter_ratio=0.0,
+        ),
     )
     return Services(config, repos, executor, provisioner)

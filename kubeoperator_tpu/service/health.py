@@ -51,11 +51,15 @@ RECOVERY_ACTIONS = {
 
 
 class HealthService:
-    def __init__(self, repos: Repositories, executor: Executor, events):
+    def __init__(self, repos: Repositories, executor: Executor, events,
+                 retry_policy=None, retry_rng=None):
         self.repos = repos
         self.executor = executor
         self.events = events
-        self.adm = ClusterAdm(executor)
+        # guided recovery re-runs phases under the SAME retry policy the
+        # create flow uses (wired by the service container), so a recovery
+        # rides through the same transient faults a create would
+        self.adm = ClusterAdm(executor, policy=retry_policy, rng=retry_rng)
 
     def check(self, cluster_name: str) -> HealthReport:
         """Adhoc-probe the cluster through the executor boundary. Imported

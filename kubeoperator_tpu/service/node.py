@@ -20,12 +20,13 @@ log = get_logger("service.node")
 
 
 class NodeService:
-    def __init__(self, repos: Repositories, executor: Executor, provisioner, events):
+    def __init__(self, repos: Repositories, executor: Executor, provisioner,
+                 events, retry_policy=None, retry_rng=None):
         self.repos = repos
         self.executor = executor
         self.provisioner = provisioner
         self.events = events
-        self.adm = ClusterAdm(executor)
+        self.adm = ClusterAdm(executor, policy=retry_policy, rng=retry_rng)
 
     def list(self, cluster_name: str) -> list[Node]:
         cluster = self.repos.clusters.get_by_name(cluster_name)

@@ -26,10 +26,11 @@ def parse_cis_result(lines: list[str]) -> dict | None:
 
 
 class CisService:
-    def __init__(self, repos: Repositories, executor: Executor, events):
+    def __init__(self, repos: Repositories, executor: Executor, events,
+                 retry_policy=None, retry_rng=None):
         self.repos = repos
         self.events = events
-        self.adm = ClusterAdm(executor)
+        self.adm = ClusterAdm(executor, policy=retry_policy, rng=retry_rng)
 
     def run_scan(self, cluster_name: str) -> CisScan:
         cluster = self.repos.clusters.get_by_name(cluster_name)

@@ -19,10 +19,11 @@ def _minor(version: str) -> int:
 
 
 class UpgradeService:
-    def __init__(self, repos: Repositories, executor: Executor, events):
+    def __init__(self, repos: Repositories, executor: Executor, events,
+                 retry_policy=None, retry_rng=None):
         self.repos = repos
         self.events = events
-        self.adm = ClusterAdm(executor)
+        self.adm = ClusterAdm(executor, policy=retry_policy, rng=retry_rng)
 
     def validate_hop(self, current: str, target: str) -> None:
         if target not in SUPPORTED_K8S_VERSIONS:

@@ -48,6 +48,41 @@ DEFAULTS: dict[str, Any] = {
         "terraform_bin": "terraform",
         "work_dir": "terraform_runs",
         "timeout_s": 3600,
+        # retries for TIMED-OUT terraform commands only (idempotent
+        # init/apply/destroy); other failures never retry
+        "retry_max_attempts": 2,
+        "retry_backoff_s": 5,
+    },
+    "resilience": {
+        # phase-engine retry envelope (docs/resilience.md): TRANSIENT
+        # failures (unreachable hosts, deadlines, killed runners) auto-retry
+        # with exponential backoff before the phase halts; PERMANENT
+        # failures halt immediately.
+        "max_attempts": 3,
+        "backoff_base_s": 1.0,
+        "backoff_factor": 2.0,
+        "backoff_max_s": 30.0,
+        "jitter_ratio": 0.1,
+        # fixed jitter seed: retry spacing stays reproducible run-to-run;
+        # operators who want decorrelated backoff across servers set a
+        # distinct seed per instance
+        "jitter_seed": 0,
+        # wall-clock budget for one phase INCLUDING retries/backoff;
+        # 0 = only the executor's own watch timeout applies
+        "phase_deadline_s": 0,
+    },
+    "chaos": {
+        # seeded fault injection over the executor (resilience/chaos.py);
+        # exercised standalone via `koctl chaos-soak`. Never enable on a
+        # production stack — it exists to prove deploys ride through
+        # injected faults unattended.
+        "enabled": False,
+        "seed": 1,
+        "unreachable_rate": 0.0,
+        "process_death_rate": 0.0,
+        "slow_stream_rate": 0.0,
+        "slow_stream_delay_s": 0.02,
+        "max_injections": 0,
     },
     "registry": {
         # nexus-equivalent offline artifact registry (SURVEY.md §1 "Offline

@@ -527,6 +527,62 @@ class TestBareExcept:  # KO-P005
         assert ast_findings(tmp_path, src, "KO-P005") == []
 
 
+class TestSubprocessTimeout:  # KO-P006
+    def test_fires_on_run_without_timeout(self, tmp_path):
+        src = (
+            "import subprocess\n"
+            "def f():\n"
+            "    subprocess.run(['x'], check=True)\n"
+        )
+        findings = ast_findings(tmp_path, src, "KO-P006",
+                                rel="installer/x.py")
+        assert [f.rule for f in findings] == ["KO-P006"]
+        assert findings[0].severity == "error"
+        assert "timeout" in findings[0].message
+
+    def test_fires_on_popen_and_check_output(self, tmp_path):
+        src = (
+            "import subprocess\n"
+            "def f():\n"
+            "    subprocess.Popen(['x'])\n"
+            "    subprocess.check_output(['y'])\n"
+        )
+        findings = ast_findings(tmp_path, src, "KO-P006", rel="service/x.py")
+        assert len(findings) == 2
+
+    def test_timeout_kwarg_is_quiet(self, tmp_path):
+        src = (
+            "import subprocess\n"
+            "def f():\n"
+            "    subprocess.run(['x'], timeout=30)\n"
+            "    subprocess.check_call(['y'], timeout=5.0)\n"
+        )
+        assert ast_findings(tmp_path, src, "KO-P006",
+                            rel="service/x.py") == []
+
+    def test_terminal_dir_is_exempt(self, tmp_path):
+        src = (
+            "import subprocess\n"
+            "def f():\n"
+            "    subprocess.Popen(['sh'])\n"
+        )
+        assert ast_findings(tmp_path, src, "KO-P006",
+                            rel="terminal/manager.py") == []
+
+    def test_waiver_comment_is_quiet(self, tmp_path):
+        src = (
+            "import subprocess\n"
+            "def f():\n"
+            "    # KO-P006: waived — Popen has a cooperative kill hook\n"
+            "    proc = subprocess.Popen(\n"
+            "        ['x'],\n"
+            "    )\n"
+            "    return proc\n"
+        )
+        assert ast_findings(tmp_path, src, "KO-P006",
+                            rel="executor/x.py") == []
+
+
 # ------------------------------------------------------------ report model --
 class TestReport:
     def test_unknown_rule_id_rejected(self):

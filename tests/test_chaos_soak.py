@@ -1,0 +1,88 @@
+"""Chaos soak (ISSUE 2 satellite): the `koctl chaos-soak` harness drives
+seeded fault-injected deploys end-to-end through the real service stack
+(simulation executor under a ChaosExecutor + FakeProvisioner).
+
+Two tiers:
+  * tier-1 smoke — ONE injected-fault deploy end-to-end, fast, runs on
+    every commit inside the 870s budget;
+  * slow soak — multi-deploy, runs the whole soak twice and asserts the
+    fault/retry trace is bit-identical (the determinism acceptance gate).
+"""
+
+import json
+
+import pytest
+
+from kubeoperator_tpu.cli.koctl import main
+
+
+def run_soak(capsys, *extra: str) -> tuple[int, dict]:
+    rc = main(["chaos-soak", "--format", "json", *extra])
+    return rc, json.loads(capsys.readouterr().out)
+
+
+def test_tier1_smoke_one_injected_fault_deploy(capsys):
+    """One seeded deploy rides through injected faults unattended and
+    reaches Ready; the trace exposes the attempt/classification trail."""
+    rc, report = run_soak(
+        capsys,
+        "--seed", "1", "--deploys", "1",
+        "--unreachable-rate", "0.30", "--process-death-rate", "0.10",
+    )
+    assert rc == 0
+    assert report["all_ready"] is True
+    deploy = report["deploys"][0]
+    assert deploy["final_phase"] == "Ready"
+    # faults actually fired and were retried through — a quiet run would
+    # mean the smoke proves nothing (seed 1 at these rates injects; if a
+    # future seed change makes it quiet, bump the rates)
+    assert report["injection_summary"]["total"] >= 1
+    assert report["retries_total"] >= 1
+    # every span carries the resilience bookkeeping
+    for span in deploy["spans"]:
+        assert span["attempts"] >= 1
+        assert "classification" in span
+
+
+def test_tier1_smoke_exhausted_retries_halt_cleanly(capsys):
+    """Rates high enough to exhaust a 1-attempt budget: the soak reports
+    Failed deploys honestly (exit 1) instead of wedging or lying."""
+    rc, report = run_soak(
+        capsys,
+        "--seed", "3", "--deploys", "1",
+        "--unreachable-rate", "0.95",
+        "--max-attempts", "1", "--max-retry-rounds", "1",
+    )
+    assert rc == 1
+    assert report["all_ready"] is False
+    assert report["deploys"][0]["final_phase"] == "Failed"
+    failed = [s for s in report["deploys"][0]["spans"]
+              if s["status"] == "Failed"]
+    assert failed and failed[0]["classification"] == "Transient"
+
+
+@pytest.mark.slow
+def test_soak_is_deterministic_and_rides_through(capsys):
+    """The full acceptance gate: a multi-deploy soak under mixed fault
+    rates ends all-Ready, and an identical seed reproduces the exact
+    deploy traces AND injection sequence (no ambient entropy anywhere in
+    the path)."""
+    rc, report = run_soak(
+        capsys,
+        "--seed", "42", "--deploys", "3",
+        "--unreachable-rate", "0.20", "--process-death-rate", "0.08",
+        "--slow-stream-rate", "0.05",
+        "--verify-determinism",
+    )
+    assert rc == 0
+    assert report["all_ready"] is True
+    assert report["deterministic"] is True
+    assert report["injection_summary"]["total"] >= 3
+    # a different seed must explore a different schedule
+    rc2, second = run_soak(
+        capsys,
+        "--seed", "43", "--deploys", "3",
+        "--unreachable-rate", "0.20", "--process-death-rate", "0.08",
+        "--slow-stream-rate", "0.05",
+    )
+    assert second["injections"] != report["injections"]

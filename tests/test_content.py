@@ -342,10 +342,17 @@ def test_ipvs_and_nodelocaldns_variants_in_simulation():
 
 
 def test_cluster_dns_ip_derivation():
+    import pytest as _pytest
+
     from kubeoperator_tpu.adm.engine import _cluster_dns_ip
+    from kubeoperator_tpu.utils.errors import ValidationError
+
     assert _cluster_dns_ip("10.96.0.0/16") == "10.96.0.10"
     assert _cluster_dns_ip("172.20.0.0/20") == "172.20.0.10"
-    assert _cluster_dns_ip("garbage") == "10.96.0.10"   # safe fallback
+    # an invalid CIDR must raise, not silently hand every node the
+    # 10.96.0.10 default from a range the cluster may not own
+    with _pytest.raises(ValidationError, match="not a valid CIDR"):
+        _cluster_dns_ip("garbage")
 
 
 def test_component_image_tags_pinned_by_offline_manifest():

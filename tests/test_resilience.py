@@ -1,0 +1,523 @@
+"""Resilience layer (ISSUE 2): retry policy math, failure classification,
+cooperative cancel/deadline, phase auto-retry with condition bookkeeping,
+seeded chaos injection, provisioner timeout retry, and resume-under-crash.
+"""
+
+import random
+import threading
+import time
+
+import pytest
+
+from kubeoperator_tpu.adm import ClusterAdm, create_phases
+from kubeoperator_tpu.adm.engine import Phase
+from kubeoperator_tpu.executor import FakeExecutor
+from kubeoperator_tpu.executor.base import (
+    CANCELLED_RC,
+    Executor,
+    FailureKind,
+    HostStats,
+    TaskResult,
+    TaskStatus,
+    classify_result,
+)
+from kubeoperator_tpu.resilience import (
+    ChaosConfig,
+    ChaosExecutor,
+    RetryPolicy,
+    retry_call,
+)
+from kubeoperator_tpu.utils.errors import PhaseError, ValidationError
+
+from tests.test_adm import make_ctx
+
+NO_SLEEP = lambda s: None  # noqa: E731 — retry loops at full speed in tests
+
+
+def fast_policy(**kw) -> RetryPolicy:
+    base = dict(max_attempts=3, backoff_base_s=0.0, jitter_ratio=0.0)
+    base.update(kw)
+    return RetryPolicy(**base)
+
+
+# ---------------------------------------------------------------- policy ----
+class TestRetryPolicy:
+    def test_backoff_is_exponential_and_capped(self):
+        p = RetryPolicy(backoff_base_s=1.0, backoff_factor=2.0,
+                        backoff_max_s=5.0, jitter_ratio=0.0)
+        assert [p.backoff_s(n) for n in (1, 2, 3, 4)] == [1.0, 2.0, 4.0, 5.0]
+
+    def test_jitter_requires_explicit_rng_and_is_seeded(self):
+        p = RetryPolicy(backoff_base_s=10.0, jitter_ratio=0.2)
+        # no RNG -> pure exponential (no ambient entropy, ever)
+        assert p.backoff_s(1) == 10.0
+        a = [p.backoff_s(1, random.Random(42)) for _ in range(3)]
+        b = [p.backoff_s(1, random.Random(42)) for _ in range(3)]
+        assert a == b                      # same seed, same spacing
+        assert all(8.0 <= x <= 12.0 for x in a)
+        assert any(x != 10.0 for x in a)   # jitter actually applied
+
+    def test_from_config_reads_resilience_block(self):
+        from kubeoperator_tpu.utils.config import load_config
+
+        config = load_config(path="/nonexistent", env={}, overrides={
+            "resilience": {"max_attempts": 7, "backoff_base_s": 0.25,
+                           "phase_deadline_s": 90},
+        })
+        p = RetryPolicy.from_config(config)
+        assert (p.max_attempts, p.backoff_base_s, p.phase_deadline_s) == \
+            (7, 0.25, 90.0)
+        assert p.backoff_factor == 2.0   # untouched keys keep defaults
+
+    def test_retry_call_retries_transient_only(self):
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                e = RuntimeError("timeout")
+                e.transient = True
+                raise e
+            return "ok"
+
+        assert retry_call(
+            flaky, policy=fast_policy(),
+            is_transient=lambda e: getattr(e, "transient", False),
+            sleep=NO_SLEEP,
+        ) == "ok"
+        assert len(calls) == 3
+
+        with pytest.raises(ValueError):
+            retry_call(
+                lambda: (_ for _ in ()).throw(ValueError("permanent")),
+                policy=fast_policy(),
+                is_transient=lambda e: getattr(e, "transient", False),
+                sleep=NO_SLEEP,
+            )
+
+    def test_retry_call_exhaustion_reraises_original(self):
+        def always():
+            e = RuntimeError("still down")
+            e.transient = True
+            raise e
+
+        with pytest.raises(RuntimeError, match="still down"):
+            retry_call(always, policy=fast_policy(max_attempts=2),
+                       is_transient=lambda e: True, sleep=NO_SLEEP)
+
+
+# -------------------------------------------------------- classification ----
+class TestClassification:
+    def _result(self, rc=2, unreachable=0, status=TaskStatus.FAILED.value):
+        return TaskResult(
+            task_id="t", status=status, rc=rc,
+            host_stats={"h1": HostStats(unreachable=unreachable)},
+        )
+
+    def test_success_is_unclassified(self):
+        assert classify_result(
+            self._result(rc=0, status=TaskStatus.SUCCESS.value)) == ""
+
+    def test_failed_task_is_permanent(self):
+        assert classify_result(self._result(rc=2)) == \
+            FailureKind.PERMANENT.value
+
+    def test_unreachable_hosts_are_transient(self):
+        assert classify_result(self._result(rc=2, unreachable=1)) == \
+            FailureKind.TRANSIENT.value
+
+    @pytest.mark.parametrize("rc", [4, 124, 137, 143, -9, -15])
+    def test_killed_or_timed_out_rcs_are_transient(self, rc):
+        assert classify_result(self._result(rc=rc)) == \
+            FailureKind.TRANSIENT.value
+
+    def test_dict_shaped_host_stats_classify_identically(self):
+        # the gRPC runner boundary serializes HostStats to plain dicts
+        r = TaskResult(task_id="t", status=TaskStatus.FAILED.value, rc=2,
+                       host_stats={"h1": {"unreachable": 1}})
+        assert classify_result(r) == FailureKind.TRANSIENT.value
+
+    def test_fake_executor_unreachable_script(self):
+        ex = FakeExecutor()
+        ex.script("01-base.yml", fail_times=1, unreachable_hosts=["m1"])
+        tid = ex.run_playbook("01-base.yml",
+                              {"all": {"hosts": {"m1": {}, "w1": {}}}})
+        r = ex.wait(tid)
+        assert not r.ok and r.rc == 4 and r.transient
+        assert r.host_stats["m1"].unreachable == 1
+        assert r.host_stats["w1"].unreachable == 0
+
+
+# ---------------------------------------------------- fake executor keying --
+class TestFakeExecutorRunKeying:
+    def test_runs_keyed_by_playbook_and_limit(self):
+        """A scale-up retry against a different host subset must not
+        inherit the create-flow's attempt count for the same playbook."""
+        ex = FakeExecutor()
+        ex.script("08-kube-worker.yml", fail_times=1)
+        inv = {"all": {"hosts": {"w1": {}}}}
+        # create flow (no limit): fails once, then succeeds
+        assert not ex.wait(ex.run_playbook("08-kube-worker.yml", inv)).ok
+        assert ex.wait(ex.run_playbook("08-kube-worker.yml", inv)).ok
+        # scale-up stream (limit) starts its own count: first run FAILS
+        # (old global counter would have leaked the create flow's attempts)
+        tid = ex.run_playbook("08-kube-worker.yml", inv, limit="new-workers")
+        assert not ex.wait(tid).ok
+        assert ex.runs_of("08-kube-worker.yml") == 2
+        assert ex.runs_of("08-kube-worker.yml", "new-workers") == 1
+
+
+# ------------------------------------------------------ cooperative cancel --
+class _HangingExecutor(Executor):
+    """Cooperative hang: loops forever until cancelled, then finishes."""
+
+    def __init__(self, cooperative=True):
+        super().__init__()
+        self.cooperative = cooperative
+
+    def _execute(self, spec, state):
+        state.emit("hanging...")
+        while True:
+            if self.cooperative and state.cancelled:
+                state.finish(TaskStatus.FAILED, rc=CANCELLED_RC,
+                             message=state.cancel_reason,
+                             classification=FailureKind.TRANSIENT.value)
+                return
+            if not self.cooperative and state.done.is_set():
+                return   # force-finished from outside; unwedge the thread
+            time.sleep(0.005)
+
+
+class TestCancel:
+    def test_cooperative_cancel_finishes_transient(self):
+        ex = _HangingExecutor()
+        tid = ex.run_playbook("p.yml", {})
+        result = ex.cancel(tid, reason="deadline", grace_s=2.0)
+        assert not result.ok and result.rc == CANCELLED_RC
+        assert result.transient and "deadline" in result.message
+
+    def test_uncooperative_task_is_force_finished(self):
+        """A backend that never checks the flag cannot wedge the caller:
+        after the grace window the result is finished FOR it, and the
+        backend's late finish/emit calls are dropped."""
+        ex = _HangingExecutor(cooperative=False)
+        tid = ex.run_playbook("p.yml", {})
+        result = ex.cancel(tid, reason="hung playbook", grace_s=0.05)
+        assert not result.ok and result.transient
+        assert result.rc == CANCELLED_RC
+        # idempotent finish: a second cancel / late finish changes nothing
+        ex.cancel(tid, reason="again", grace_s=0.01)
+        assert ex.result(tid).message == result.message
+
+    def test_kill_hook_runs_even_when_registered_after_cancel(self):
+        ex = _HangingExecutor()
+        tid = ex.run_playbook("p.yml", {})
+        state = ex._state(tid)
+        state.cancel("now")
+        fired = threading.Event()
+        state.on_cancel(fired.set)
+        assert fired.is_set()
+
+
+# ------------------------------------------------------- phase auto-retry ---
+class TestPhaseRetry:
+    def test_transient_failure_retries_then_succeeds(self):
+        ex = FakeExecutor()
+        ex.script("05-etcd.yml", fail_times=2, unreachable_hosts=["m1"])
+        ctx = make_ctx(tpu=False)
+        slept = []
+        adm = ClusterAdm(ex, policy=fast_policy(backoff_base_s=0.1),
+                         sleep=slept.append)
+        adm.run(ctx, create_phases())
+        cond = ctx.cluster.status.condition("etcd")
+        assert cond.status == "OK"
+        assert cond.attempts == 3
+        assert cond.classification == ""          # cleared on success
+        assert cond.backoff_s == pytest.approx(0.3, abs=0.01)
+        assert slept == [0.1, 0.2]                # exponential, jitter-free
+        assert ex.runs_of("05-etcd.yml") == 3
+        # untouched phases record a single attempt
+        assert ctx.cluster.status.condition("base").attempts == 1
+
+    def test_permanent_failure_halts_without_retry(self):
+        ex = FakeExecutor()
+        ex.script("05-etcd.yml", fail_times=1)   # failed task, reachable
+        ctx = make_ctx(tpu=False)
+        adm = ClusterAdm(ex, policy=fast_policy(), sleep=NO_SLEEP)
+        with pytest.raises(PhaseError) as ei:
+            adm.run(ctx, create_phases())
+        assert ei.value.phase == "etcd"
+        cond = ctx.cluster.status.condition("etcd")
+        assert cond.status == "Failed"
+        assert cond.attempts == 1                 # no auto-retry burned
+        assert cond.classification == FailureKind.PERMANENT.value
+        assert ex.runs_of("05-etcd.yml") == 1
+
+    def test_transient_past_max_attempts_halts_with_trail(self):
+        ex = FakeExecutor()
+        ex.script("05-etcd.yml", fail_times=99, unreachable_hosts=["m1"])
+        ctx = make_ctx(tpu=False)
+        adm = ClusterAdm(ex, policy=fast_policy(max_attempts=2),
+                         sleep=NO_SLEEP)
+        with pytest.raises(PhaseError, match="transient, attempt 2/2"):
+            adm.run(ctx, create_phases())
+        cond = ctx.cluster.status.condition("etcd")
+        assert cond.status == "Failed"
+        assert cond.attempts == 2
+        assert cond.classification == FailureKind.TRANSIENT.value
+        assert ctx.cluster.status.first_unfinished() == "etcd"
+
+    def test_attempts_surface_in_status_json_and_trace(self):
+        """API satellite: the resilience trail rides the public status
+        dict (conditions) AND the /trace spans."""
+        ex = FakeExecutor()
+        ex.script("05-etcd.yml", fail_times=1, unreachable_hosts=["m1"])
+        ctx = make_ctx(tpu=False)
+        ClusterAdm(ex, policy=fast_policy(), sleep=NO_SLEEP).run(
+            ctx, create_phases())
+        status = ctx.cluster.to_public_dict()["status"]
+        etcd = next(c for c in status["conditions"] if c["name"] == "etcd")
+        assert etcd["attempts"] == 2
+        assert "classification" in etcd and "backoff_s" in etcd
+        span = next(s for s in ctx.cluster.status.trace()["spans"]
+                    if s["name"] == "etcd")
+        assert span["attempts"] == 2
+        assert span["classification"] is None     # succeeded in the end
+
+    def test_phase_deadline_cancels_hung_playbook(self):
+        ex = _HangingExecutor()
+        ctx = make_ctx(tpu=False)
+        adm = ClusterAdm(
+            ex,
+            policy=fast_policy(max_attempts=1, phase_deadline_s=0.3),
+            sleep=NO_SLEEP,
+        )
+        t0 = time.monotonic()
+        with pytest.raises(PhaseError) as ei:
+            adm.run(ctx, [Phase("base", "01-base.yml")])
+        assert time.monotonic() - t0 < 5.0        # did not wedge
+        assert "deadline" in ei.value.message
+        cond = ctx.cluster.status.condition("base")
+        assert cond.status == "Failed"
+        assert cond.classification == FailureKind.TRANSIENT.value
+
+    def test_deadline_bounds_retries_too(self):
+        """Backoff that would overrun the phase deadline halts instead of
+        sleeping past it."""
+        ex = FakeExecutor()
+        ex.script("01-base.yml", fail_times=99, unreachable_hosts=["m1"])
+        ctx = make_ctx(tpu=False)
+        adm = ClusterAdm(
+            ex,
+            policy=RetryPolicy(max_attempts=10, backoff_base_s=30.0,
+                               jitter_ratio=0.0, phase_deadline_s=1.0),
+            sleep=NO_SLEEP,
+        )
+        with pytest.raises(PhaseError):
+            adm.run(ctx, [Phase("base", "01-base.yml")])
+        # only one attempt ran: the 30s backoff would overrun the deadline
+        assert ctx.cluster.status.condition("base").attempts == 1
+
+
+# ----------------------------------------------------------------- chaos ----
+def chaos_over_fake(seed=7, **cfg) -> ChaosExecutor:
+    return ChaosExecutor(FakeExecutor(), rng=random.Random(seed),
+                         config=ChaosConfig(**cfg))
+
+
+class TestChaosExecutor:
+    def test_unreachable_injection_shape(self):
+        chaos = chaos_over_fake()
+        chaos.fail_times("01-base.yml", 1, kind="unreachable")
+        inv = {"all": {"hosts": {"m1": {}, "w1": {}}}}
+        r = chaos.wait(chaos.run_playbook("01-base.yml", inv))
+        assert not r.ok and r.rc == 4 and r.transient
+        assert sum(hs.unreachable for hs in r.host_stats.values()) == 1
+        assert chaos.injection_summary() == {
+            "total": 1, "by_kind": {"unreachable": 1}}
+        # next run delegates to the inner backend and succeeds
+        assert chaos.wait(chaos.run_playbook("01-base.yml", inv)).ok
+
+    def test_process_death_injection_shape(self):
+        chaos = chaos_over_fake()
+        chaos.fail_times("01-base.yml", 1, kind="process-death")
+        r = chaos.wait(chaos.run_playbook("01-base.yml", {}))
+        assert not r.ok and r.rc == 137 and r.transient
+        assert r.host_stats == {}      # died before any recap
+        assert "killed mid-phase" in r.message
+
+    def test_scripted_queue_keyed_by_playbook_and_limit(self):
+        chaos = chaos_over_fake()
+        chaos.fail_times("08-kube-worker.yml", 1, limit="")
+        inv = {"all": {"hosts": {"w1": {}}}}
+        # the scale-up stream (limit set) is NOT hit by the create queue
+        assert chaos.wait(chaos.run_playbook(
+            "08-kube-worker.yml", inv, limit="new-workers")).ok
+        assert not chaos.wait(chaos.run_playbook(
+            "08-kube-worker.yml", inv)).ok
+
+    def test_rate_based_injection_is_seed_deterministic(self):
+        inv = {"all": {"hosts": {"m1": {}, "w1": {}}}}
+
+        def trace(seed):
+            chaos = chaos_over_fake(seed=seed, unreachable_rate=0.4)
+            out = []
+            for i in range(12):
+                r = chaos.wait(chaos.run_playbook("01-base.yml", inv))
+                out.append((r.status, r.rc))
+            return out, [(i.playbook, i.kind, i.host)
+                         for i in chaos.injections]
+
+        assert trace(123) == trace(123)
+        assert trace(123) != trace(321)    # different seed, different run
+        # and faults actually fired at this rate
+        assert trace(123)[1]
+
+    def test_slow_stream_still_succeeds(self):
+        chaos = chaos_over_fake(slow_stream_delay_s=0.001)
+        chaos.fail_times("01-base.yml", 1, kind="slow-stream")
+        r = chaos.wait(chaos.run_playbook("01-base.yml", {}))
+        assert r.ok
+        assert chaos.injection_summary()["by_kind"] == {"slow-stream": 1}
+
+
+# --------------------------------------------------- deploy-level flows -----
+class TestChaosDeploy:
+    def test_unreachable_retry_succeed_deploy(self):
+        """Acceptance shape 1: unreachable → retry → succeed, end-to-end
+        through create_phases, deterministic across two identical runs."""
+        def run_once():
+            chaos = chaos_over_fake(seed=11)
+            chaos.fail_times("05-etcd.yml", 1, kind="unreachable")
+            chaos.fail_times("09-network.yml", 2, kind="process-death")
+            ctx = make_ctx(tpu=False)
+            ClusterAdm(chaos, policy=fast_policy(), sleep=NO_SLEEP).run(
+                ctx, create_phases())
+            return [(c.name, c.status, c.attempts, c.classification)
+                    for c in ctx.cluster.status.conditions]
+
+        first, second = run_once(), run_once()
+        assert first == second
+        by_name = dict((n, (s, a, c)) for n, s, a, c in first)
+        assert by_name["etcd"] == ("OK", 2, "")
+        assert by_name["network"] == ("OK", 3, "")
+
+    def test_fail_past_max_attempts_halts_deploy(self):
+        """Acceptance shape 2: fail-past-max-attempts → halt, resumable."""
+        chaos = chaos_over_fake(seed=11)
+        chaos.fail_times("05-etcd.yml", 5, kind="unreachable")
+        ctx = make_ctx(tpu=False)
+        adm = ClusterAdm(chaos, policy=fast_policy(max_attempts=3),
+                         sleep=NO_SLEEP)
+        with pytest.raises(PhaseError) as ei:
+            adm.run(ctx, create_phases())
+        assert ei.value.phase == "etcd"
+        assert ctx.cluster.status.first_unfinished() == "etcd"
+        cond = ctx.cluster.status.condition("etcd")
+        assert (cond.attempts, cond.classification) == \
+            (3, FailureKind.TRANSIENT.value)
+
+    def test_resume_under_crash_reenters_with_history(self):
+        """Satellite: the engine 'dies' mid-phase (chaos process-death
+        exhausts the attempt budget, the way a killed runner does), the
+        halt leaves the failed condition's attempt trail persisted, and a
+        re-entered run skips completed phases, re-runs ONLY the failed
+        one, and rides through the remaining injected death."""
+        chaos = chaos_over_fake(seed=5)
+        chaos.fail_times("07-kube-master.yml", 3, kind="process-death")
+        ctx = make_ctx(tpu=False)
+        saves = []
+        ctx.save_cluster = lambda c: saves.append(True)
+        adm = ClusterAdm(chaos, policy=fast_policy(max_attempts=2),
+                         sleep=NO_SLEEP)
+        with pytest.raises(PhaseError):
+            adm.run(ctx, create_phases())
+
+        # crash state: failed condition carries the attempt history, and it
+        # was persisted (save_cluster ran on the transition)
+        cond = ctx.cluster.status.condition("kube-master")
+        assert cond.status == "Failed"
+        assert (cond.attempts, cond.classification) == \
+            (2, FailureKind.TRANSIENT.value)
+        assert cond.message and saves
+        assert ctx.cluster.status.first_unfinished() == "kube-master"
+        done_before = [c.name for c in ctx.cluster.status.conditions
+                       if c.status == "OK"]
+
+        # re-enter: completed phases skipped, failed phase re-runs, third
+        # injected death is ridden out by the retry budget
+        adm.run(ctx, create_phases())
+        assert ctx.cluster.status.first_unfinished() is None
+        inner = chaos.inner
+        for name in done_before:
+            playbook = next(p.playbook for p in create_phases()
+                            if p.name == name)
+            assert inner.runs_of(playbook) == 1   # not re-run on resume
+        cond = ctx.cluster.status.condition("kube-master")
+        assert cond.status == "OK"
+        assert cond.attempts == 2   # death nr.3, then the clean attempt
+
+
+# ------------------------------------------------------- provisioner --------
+class TestProvisionerRetry:
+    def _flaky(self, provisioner, timeouts: int):
+        from kubeoperator_tpu.utils.errors import ProvisionerError
+
+        calls = []
+
+        def _run(cluster_dir, *args):
+            calls.append(args[0])
+            if len(calls) <= timeouts:
+                e = ProvisionerError(message=f"terraform {args[0]} timed out")
+                e.transient = True
+                raise e
+            return ""
+
+        provisioner._run = _run
+        return calls
+
+    def _prov(self, attempts=3):
+        from kubeoperator_tpu.provisioner import TerraformProvisioner
+
+        return TerraformProvisioner(retry_policy=RetryPolicy(
+            max_attempts=attempts, backoff_base_s=0.0, jitter_ratio=0.0))
+
+    def test_apply_retries_timeouts(self):
+        prov = self._prov()
+        calls = self._flaky(prov, timeouts=2)
+        prov.apply("/tmp/unused")
+        # init timed out twice, third try + apply succeeded
+        assert calls == ["init", "init", "init", "apply"]
+
+    def test_non_timeout_failure_does_not_retry(self):
+        from kubeoperator_tpu.utils.errors import ProvisionerError
+
+        prov = self._prov()
+        calls = []
+
+        def _run(cluster_dir, *args):
+            calls.append(args[0])
+            raise ProvisionerError(message="quota exceeded")
+
+        prov._run = _run
+        with pytest.raises(ProvisionerError, match="quota"):
+            prov.destroy("/tmp/unused")
+        assert calls == ["init"]
+
+    def test_exhausted_timeouts_reraise(self):
+        from kubeoperator_tpu.utils.errors import ProvisionerError
+
+        prov = self._prov(attempts=2)
+        calls = self._flaky(prov, timeouts=99)
+        with pytest.raises(ProvisionerError, match="timed out"):
+            prov.apply("/tmp/unused")
+        assert calls == ["init", "init"]
+
+
+# ------------------------------------------------------------ dns satellite -
+def test_cluster_dns_ip_rejects_invalid_cidr():
+    from kubeoperator_tpu.adm.engine import _cluster_dns_ip
+
+    with pytest.raises(ValidationError, match="not a valid CIDR"):
+        _cluster_dns_ip("not-a-cidr")
+    assert _cluster_dns_ip("10.96.0.0/16") == "10.96.0.10"
