@@ -93,6 +93,10 @@ class AdmContext:
     # sinks wired by the service layer
     log_sink: Callable[[str, str], None] = lambda task_id, line: None
     save_cluster: Callable[[Cluster], None] = lambda cluster: None
+    # operation-journal progress hook (resilience/journal.py attach): the
+    # engine reports every phase transition (name, Running|OK|Failed) so
+    # the durable op row always knows how far the operation got
+    on_phase: Callable[[str, str], None] = lambda name, status: None
 
     @classmethod
     def for_cluster(cls, repos, cluster: Cluster, plan: Plan | None = None,
@@ -262,6 +266,7 @@ class ClusterAdm:
             attempts += 1
             stamp(status.upsert_condition(phase.name, ConditionStatus.RUNNING))
             ctx.save_cluster(cluster)
+            ctx.on_phase(phase.name, ConditionStatus.RUNNING.value)
 
             try:
                 result, lines = self._attempt(ctx, phase, deadline)
@@ -276,6 +281,7 @@ class ClusterAdm:
                 stamp(cond)
                 cond.classification = FailureKind.PERMANENT.value
                 ctx.save_cluster(cluster)
+                ctx.on_phase(phase.name, ConditionStatus.FAILED.value)
                 raise
             except Exception as e:
                 # Anything else (post-hook bug, runner crash) must still
@@ -286,6 +292,7 @@ class ClusterAdm:
                 stamp(cond)
                 cond.classification = FailureKind.PERMANENT.value
                 ctx.save_cluster(cluster)
+                ctx.on_phase(phase.name, ConditionStatus.FAILED.value)
                 raise PhaseError(phase.name, str(e)) from e
 
             if result.ok:
@@ -293,6 +300,7 @@ class ClusterAdm:
                 stamp(cond)
                 cond.classification = ""
                 ctx.save_cluster(cluster)
+                ctx.on_phase(phase.name, ConditionStatus.OK.value)
                 log.info("cluster %s: phase %s OK (%.1fs, attempt %d)",
                          cluster.name, phase.name,
                          status.condition(phase.name).duration_s, attempts)
@@ -315,6 +323,7 @@ class ClusterAdm:
                 stamp(cond)
                 cond.classification = classification
                 ctx.save_cluster(cluster)
+                ctx.on_phase(phase.name, ConditionStatus.FAILED.value)
                 raise PhaseError(
                     phase.name,
                     f"{result.message} [{classification.lower()}, "

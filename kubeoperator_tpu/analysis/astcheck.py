@@ -357,6 +357,72 @@ def check_subprocess_timeouts(root: str, tree: ast.AST, path: str) -> list:
     return findings
 
 
+# ---------------------------------------------------------------- KO-P007 ---
+# the phases that mean "a controller owns this cluster" — kept in sync with
+# resilience/journal.py IN_FLIGHT_PHASES (enum NAMES here, VALUES below,
+# so both the `ClusterPhaseStatus.X[.value]` and string-literal spellings
+# of an in-flight write are caught)
+_INFLIGHT_NAMES = frozenset({
+    "PROVISIONING", "DEPLOYING", "SCALING", "UPGRADING", "TERMINATING",
+})
+_INFLIGHT_VALUES = frozenset({
+    "Provisioning", "Deploying", "Scaling", "Upgrading", "Terminating",
+})
+# the sanctioned writers: the phase engine and the journal helper
+_P007_ALLOWED_DIRS = ("adm",)
+_P007_ALLOWED_FILES = frozenset({os.path.join("resilience", "journal.py")})
+
+
+def _mentions_inflight_phase(value: ast.AST) -> str | None:
+    """The in-flight phase an expression names, if any: matches
+    `ClusterPhaseStatus.DEPLOYING` (with or without `.value`) and the bare
+    string literal "Deploying" — the two ways code spells the write."""
+    for sub in ast.walk(value):
+        if isinstance(sub, ast.Attribute) and sub.attr in _INFLIGHT_NAMES \
+                and isinstance(sub.value, ast.Name) \
+                and sub.value.id == "ClusterPhaseStatus":
+            return sub.attr
+        if isinstance(sub, ast.Constant) and isinstance(sub.value, str) \
+                and sub.value in _INFLIGHT_VALUES:
+            return sub.value
+    return None
+
+
+def check_phase_write_discipline(root: str, tree: ast.AST, path: str) -> list:
+    """In-flight ClusterPhaseStatus writes (`<x>.phase = Deploying/...`)
+    are allowed only in adm/ and the operation-journal helper. Everywhere
+    else a bare in-flight flip would put a cluster into "a controller owns
+    me" without the durable journal record the boot reconciler needs — the
+    exact amnesia this repo's crash-safety layer exists to end. Route the
+    write through OperationJournal.open/set_phase instead."""
+    relpath = os.path.relpath(path, root)
+    parts = relpath.split(os.sep)
+    if parts[0] in _P007_ALLOWED_DIRS or relpath in _P007_ALLOWED_FILES:
+        return []
+    findings: list = []
+    rel = _rel(root, path)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets, value = [node.target], node.value
+        else:
+            continue
+        if not any(isinstance(t, ast.Attribute) and t.attr == "phase"
+                   for t in targets):
+            continue
+        phase = _mentions_inflight_phase(value)
+        if phase is None:
+            continue
+        findings.append(Finding(
+            "KO-P007", rel, node.lineno,
+            f"in-flight phase {phase!r} assigned outside adm/ and the "
+            f"operation journal — a crash here strands the cluster with no "
+            f"journal record; use OperationJournal.open/set_phase",
+        ))
+    return findings
+
+
 AST_RULES = {
     "KO-P001": check_repo_layering,
     "KO-P002": check_blocking_handlers,
@@ -364,6 +430,7 @@ AST_RULES = {
     "KO-P004": check_mutable_defaults,
     "KO-P005": check_bare_except,
     "KO-P006": check_subprocess_timeouts,
+    "KO-P007": check_phase_write_discipline,
 }
 
 

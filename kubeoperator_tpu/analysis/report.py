@@ -120,6 +120,14 @@ RULES: dict[str, RuleSpec] = {
             "passes timeout= (or carries a `# KO-P006: waived — <reason>` "
             "comment) — an un-deadlined child process wedges its caller",
         ),
+        RuleSpec(
+            "KO-P007", "phase-write-discipline", "ast", ERROR,
+            "in-flight ClusterPhaseStatus assignments (Provisioning/"
+            "Deploying/Scaling/Upgrading/Terminating) happen only in adm/ "
+            "and resilience/journal.py — phase flips must ride the "
+            "journaled path so a controller crash always leaves a "
+            "sweepable operation record",
+        ),
     )
 }
 

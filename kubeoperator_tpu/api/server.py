@@ -682,6 +682,24 @@ class Handlers:
                                 request.match_info["name"])
         return json_response(report.to_dict())
 
+    async def cluster_operations(self, request):
+        """Operation-journal history (newest first, incl. interrupted ops
+        swept by the boot reconciler) — `koctl cluster operations`."""
+        def gather():
+            cluster = self.s.clusters.get(request.match_info["name"])
+            limit = int(request.query.get("limit", 50))
+            return [op.to_dict()
+                    for op in self.s.journal.history(cluster.id, limit)]
+
+        return json_response(await run_sync(request, gather))
+
+    async def watchdog_status(self, request):
+        return json_response(await run_sync(request, self.s.watchdog.status))
+
+    async def watchdog_reset(self, request):
+        return json_response(await run_sync(
+            request, self.s.watchdog.reset, request.match_info["name"]))
+
     async def recover(self, request):
         body = await request.json()
         await run_sync(request, self.s.health.recover,
@@ -1084,8 +1102,13 @@ def create_app(services: Services) -> web.Application:
                cluster_guard(h.backup_strategy, manage))
     r.add_get("/api/v1/clusters/{name}/health",
               cluster_guard(h.health, view))
+    r.add_get("/api/v1/clusters/{name}/operations",
+              cluster_guard(h.cluster_operations, view))
     r.add_post("/api/v1/clusters/{name}/recover",
                cluster_guard(h.recover, manage))
+    r.add_get("/api/v1/watchdog", admin_guard(h.watchdog_status))
+    r.add_post("/api/v1/watchdog/{name}/reset",
+               admin_guard(h.watchdog_reset))
     r.add_get("/api/v1/clusters/{name}/components",
               cluster_guard(h.list_components, view))
     r.add_post("/api/v1/clusters/{name}/components",

@@ -196,6 +196,15 @@ class LocalClient:
                 return {"ok": True}
             case ("GET", ["clusters", name, "health"]):
                 return s.health.check(name).to_dict()
+            case ("GET", ["clusters", name, "operations"]):
+                cluster = s.clusters.get(name)
+                limit = int(body.get("limit", 50))
+                return [op.to_dict()
+                        for op in s.journal.history(cluster.id, limit)]
+            case ("GET", ["watchdog"]):
+                return s.watchdog.status()
+            case ("POST", ["watchdog", name, "reset"]):
+                return s.watchdog.reset(name)
             case ("GET", ["clusters", name, "events"]):
                 return pub(s.events.list(s.clusters.get(name).id))
             case ("POST", ["clusters", name, "cis-scans"]):
@@ -483,6 +492,28 @@ def cmd_cluster(client, args) -> int:
         if not args.no_wait:
             return _poll_to_ready(client, args.name, args.timeout, False)
         return 0
+    if args.cluster_cmd == "operations":
+        ops = client.call(
+            "GET",
+            f"/api/v1/clusters/{args.name}/operations?limit={args.limit}")
+        if args.json:
+            _print(ops)
+            return 0
+        from datetime import datetime
+
+        for op in ops:
+            when = datetime.fromtimestamp(op.get("created_at", 0)).isoformat(
+                sep=" ", timespec="seconds")
+            phase = op.get("phase") or "-"
+            if op.get("phase_status"):
+                phase += f":{op['phase_status']}"
+            resume = (f" resume={op['resume_phase']}"
+                      if op.get("resume_phase") else "")
+            message = op.get("message") or ""
+            print(f"{when}  {op.get('kind', '?'):18s} "
+                  f"{op.get('status', '?'):11s} {phase:24s}{resume}"
+                  + (f"  {message}" if message else ""))
+        return 0
     if args.cluster_cmd == "recover":
         client.call("POST", f"/api/v1/clusters/{args.name}/recover",
                     {"probe": args.probe})
@@ -660,6 +691,37 @@ def cmd_notify(client, args) -> int:
         return 0
     print(f"{args.channel}: FAILED — {result.get('error')}")
     return 1
+
+
+def cmd_watchdog(client, args) -> int:
+    """Auto-remediation circuit state (docs/resilience.md): `status` shows
+    per-cluster circuit/budget/flaps; `reset` is the ONE way an open
+    circuit closes again."""
+    if args.watchdog_cmd == "status":
+        rows = client.call("GET", "/api/v1/watchdog")
+        if args.json:
+            _print(rows)
+            return 0
+        if not rows:
+            print("no managed clusters")
+            return 0
+        print(f"{'CLUSTER':20s} {'PHASE':12s} {'CIRCUIT':8s} "
+              f"{'DEGRADED':9s} {'BUDGET':7s} {'FLAPS':6s} REASON")
+        for r in rows:
+            print(f"{r['cluster']:20s} {r['phase']:12s} {r['circuit']:8s} "
+                  f"{'yes' if r['degraded'] else 'no':9s} "
+                  f"{r['budget_left']}/{r['budget']:<5d} "
+                  f"{r['flaps']:<6d} {r.get('opened_reason') or '-'}")
+        # exit 1 when any circuit is open: scripts can alert on it
+        return 1 if any(r["circuit"] == "open" for r in rows) else 0
+    if args.watchdog_cmd == "reset":
+        result = client.call(
+            "POST", f"/api/v1/watchdog/{args.name}/reset")
+        print(f"watchdog circuit for {args.name}: "
+              f"{result['circuit']}"
+              + (" (was open)" if result.get("was_open") else ""))
+        return 0
+    raise SystemExit(f"unknown watchdog command {args.watchdog_cmd}")
 
 
 def cmd_apply(client, args) -> int:
@@ -1076,6 +1138,13 @@ def build_parser() -> argparse.ArgumentParser:
     rec = csub.add_parser("recover")
     rec.add_argument("name")
     rec.add_argument("probe", help="failed probe name from `cluster health`")
+    ops_p = csub.add_parser(
+        "operations",
+        help="operation-journal history (incl. interrupted ops)")
+    ops_p.add_argument("name")
+    ops_p.add_argument("-n", "--limit", type=int, default=50)
+    ops_p.add_argument("--json", action="store_true",
+                       help="machine-readable output")
     cis = csub.add_parser("cis-scan")
     cis.add_argument("name")
     cis.add_argument("--list", action="store_true",
@@ -1115,6 +1184,18 @@ def build_parser() -> argparse.ArgumentParser:
 
     apply_p = sub.add_parser("apply", help="apply a setup YAML")
     apply_p.add_argument("-f", "--file", required=True)
+
+    watchdog_p = sub.add_parser(
+        "watchdog", help="auto-remediation circuit breaker verbs")
+    wsub = watchdog_p.add_subparsers(dest="watchdog_cmd", required=True)
+    w_status = wsub.add_parser(
+        "status", help="per-cluster circuit state + remediation budget "
+                       "(exit 1 if any circuit is open)")
+    w_status.add_argument("--json", action="store_true",
+                          help="machine-readable output")
+    w_reset = wsub.add_parser(
+        "reset", help="close an open circuit (the only way it closes)")
+    w_reset.add_argument("name")
 
     ba = sub.add_parser("backup-account", help="backup endpoint verbs")
     basub = ba.add_subparsers(dest="ba_cmd", required=True)
@@ -1316,6 +1397,8 @@ def main(argv: list[str] | None = None) -> int:
         return cmd_component(client, args)
     if args.cmd == "apply":
         return cmd_apply(client, args)
+    if args.cmd == "watchdog":
+        return cmd_watchdog(client, args)
     if args.cmd == "backup-account":
         if args.ba_cmd == "list":
             _print(client.call("GET", "/api/v1/backup-accounts"))

@@ -31,6 +31,7 @@ from kubeoperator_tpu.models.backup import BackupAccount, BackupFile, BackupStra
 from kubeoperator_tpu.models.tenancy import Project, ProjectMember, Role, User
 from kubeoperator_tpu.models.event import AuditRecord, Event, Message, Setting, TaskLogChunk
 from kubeoperator_tpu.models.component import ClusterComponent
+from kubeoperator_tpu.models.operation import Operation, OperationStatus
 from kubeoperator_tpu.models.security import CisCheck, CisScan
 
 __all__ = [
@@ -42,5 +43,6 @@ __all__ = [
     "Project", "ProjectMember", "Role", "User",
     "AuditRecord", "Event", "Message", "Setting", "TaskLogChunk",
     "ClusterComponent",
+    "Operation", "OperationStatus",
     "CisCheck", "CisScan",
 ]

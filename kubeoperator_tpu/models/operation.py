@@ -1,0 +1,48 @@
+"""Operation — one journaled lifecycle operation (the crash-safety record).
+
+The reference platform keeps lifecycle state only on the cluster row, which
+makes a controller restart a stranding event: a cluster stuck `Deploying`
+with no running goroutine behind it. The operation journal is the durable
+"what was in flight" record — opened before a phase loop starts, updated
+per phase, closed on success/failure — so the boot reconciler
+(service/reconcile.py) can distinguish "operation running elsewhere" from
+"operation orphaned by a dead controller" and act.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from enum import Enum
+
+from kubeoperator_tpu.models.base import Entity
+
+
+class OperationStatus(str, Enum):
+    RUNNING = "Running"          # journal open; a controller claims this op
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"            # closed honestly by the controller
+    INTERRUPTED = "Interrupted"  # orphaned open op swept at boot
+
+
+@dataclass
+class Operation(Entity):
+    """One journal row. `kind` is the operation verb ("create",
+    "slice-scale", "upgrade", "backup", ...); `phase`/`phase_status` track
+    the last adm phase transition seen, so the row always knows how far the
+    operation got; `resume_phase` preserves the re-entry point (the first
+    pending condition) when the reconciler marks an orphan Interrupted."""
+
+    cluster_id: str = ""
+    cluster_name: str = ""       # survives cluster deletion (terminate ops)
+    kind: str = ""
+    status: str = OperationStatus.RUNNING.value
+    phase: str = ""              # last adm phase name seen ("" = pre-phase)
+    phase_status: str = ""       # Running | OK | Failed for `phase`
+    message: str = ""
+    resume_phase: str = ""       # re-entry point preserved on interruption
+    vars: dict = field(default_factory=dict)   # op inputs (upgrade target...)
+    finished_at: float = 0.0
+
+    @property
+    def open(self) -> bool:
+        return self.status == OperationStatus.RUNNING.value

@@ -1,0 +1,16 @@
+-- Operation journal (crash-safe lifecycle record): one row per lifecycle
+-- operation (create, scale, upgrade, backup, recovery, terminate, ...),
+-- opened BEFORE the phase loop starts and closed on success/failure — so a
+-- controller killed mid-operation leaves a durable open row the boot
+-- reconciler can sweep instead of a cluster stranded in an in-flight phase.
+CREATE TABLE IF NOT EXISTS operations (
+    id TEXT PRIMARY KEY,
+    cluster_id TEXT NOT NULL,
+    kind TEXT NOT NULL,
+    status TEXT NOT NULL,
+    data TEXT NOT NULL,
+    created_at REAL NOT NULL,
+    updated_at REAL NOT NULL
+);
+CREATE INDEX IF NOT EXISTS idx_operations_cluster ON operations (cluster_id);
+CREATE INDEX IF NOT EXISTS idx_operations_status ON operations (status);

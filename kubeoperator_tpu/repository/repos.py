@@ -23,6 +23,7 @@ from kubeoperator_tpu.models import (
     Host,
     Message,
     Node,
+    Operation,
     Plan,
     Project,
     ProjectMember,
@@ -317,6 +318,26 @@ class ComponentRepo(EntityRepo[ClusterComponent]):
     table, entity, columns = "components", ClusterComponent, ("cluster_id", "name")
 
 
+class OperationRepo(EntityRepo[Operation]):
+    """Operation journal rows (models/operation.py). `status` is mirrored so
+    the boot reconciler's open-op sweep is one indexed query, not a
+    hydrate-everything scan."""
+
+    table, entity, columns = "operations", Operation, (
+        "cluster_id", "kind", "status",
+    )
+
+    def history(self, cluster_id: str, limit: int = 50) -> list[Operation]:
+        """Newest-first journal history, capped IN SQL (the journal grows
+        with every operation forever; rowid tiebreak keeps bursts stable)."""
+        rows = self.db.query(
+            f"SELECT data FROM {self.table} WHERE cluster_id=? "
+            f"ORDER BY created_at DESC, rowid DESC LIMIT ?",
+            (cluster_id, max(1, min(limit, 1000))),
+        )
+        return [self._hydrate(r["data"]) for r in rows]
+
+
 class CisScanRepo(EntityRepo[CisScan]):
     table, entity, columns = "cis_scans", CisScan, ("cluster_id", "status")
 
@@ -348,6 +369,7 @@ class Repositories:
         self.messages = MessageRepo(db)
         self.task_logs = TaskLogChunkRepo(db)
         self.components = ComponentRepo(db)
+        self.operations = OperationRepo(db)
         self.cis_scans = CisScanRepo(db)
         self.settings = SettingRepo(db)
         self.audit = AuditRepo(db)

@@ -11,9 +11,17 @@ one shared vocabulary for that:
                          provisioner's IaaS calls (policy.py)
   * ChaosExecutor      — a seeded fault-injection wrapper over any inner
                          executor: unreachable recaps, slow streams,
-                         mid-phase process death, fail-N-then-succeed
+                         mid-phase process death, fail-N-then-succeed,
+                         one-shot controller death (`die_at_phase`)
                          (chaos.py); surfaced as `koctl chaos-soak` and
                          the `chaos.*` config block
+  * OperationJournal   — the crash-safe operation record every
+                         phase-running service writes through; the ONE
+                         in-flight phase writer outside adm/ (journal.py,
+                         analyzer rule KO-P007)
+  * CircuitBreaker     — remediation budget / cooldown / flap detection
+                         bounding the health watchdog's auto-remediation
+                         (watchdog.py; driven by service/watchdog.py)
 
 Failure classification itself (TRANSIENT vs PERMANENT) lives in
 executor/base.py next to TaskResult, because every backend finishes tasks
@@ -25,7 +33,25 @@ from kubeoperator_tpu.resilience.policy import (
     retry_call,
     retry_wiring,
 )
-from kubeoperator_tpu.resilience.chaos import ChaosConfig, ChaosExecutor
+from kubeoperator_tpu.resilience.chaos import (
+    ChaosConfig,
+    ChaosExecutor,
+    ControllerDeath,
+)
+from kubeoperator_tpu.resilience.journal import (
+    IN_FLIGHT_PHASES,
+    OperationJournal,
+    default_journal,
+)
+from kubeoperator_tpu.resilience.watchdog import (
+    CIRCUIT_CLOSED,
+    CIRCUIT_OPEN,
+    CircuitBreaker,
+    WatchdogConfig,
+)
 
 __all__ = ["RetryPolicy", "retry_call", "retry_wiring",
-           "ChaosConfig", "ChaosExecutor"]
+           "ChaosConfig", "ChaosExecutor", "ControllerDeath",
+           "IN_FLIGHT_PHASES", "OperationJournal", "default_journal",
+           "CIRCUIT_CLOSED", "CIRCUIT_OPEN", "CircuitBreaker",
+           "WatchdogConfig"]

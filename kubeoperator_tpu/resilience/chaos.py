@@ -13,6 +13,13 @@ task whether to inject a fault instead of (or around) delegating:
                      which is how phase deadlines get exercised
   * fail-N-then-succeed — scripted per (playbook, limit) via fail_times(),
                      for exact retry-count assertions
+  * die-at-phase   — the CONTROLLER (not the runner) dies the moment the
+                     named playbook is submitted: ControllerDeath derives
+                     from BaseException so it tears straight through the
+                     phase engine and every service except-handler without
+                     closing conditions or the operation journal — the
+                     `kill -9` shape the boot reconciler
+                     (service/reconcile.py) exists to sweep
 
 Determinism contract: ALL entropy comes from the `random.Random` passed in
 (no ambient time/os entropy — `Date.now`-style seeding is exactly what
@@ -41,6 +48,16 @@ from kubeoperator_tpu.executor.inventory import inventory_host_names
 KILLED_RC = 137         # 128 + SIGKILL: process death mid-phase
 
 
+class ControllerDeath(BaseException):
+    """Simulated `kill -9` of the CONTROLLER process itself.
+
+    Deliberately a BaseException: a real SIGKILL runs no except-handlers,
+    so this must skip the phase engine's condition bookkeeping and the
+    service layer's journal-close paths the same way — the cluster stays
+    in its in-flight phase with an open journal op, which is exactly the
+    crash state tests/test_reconcile.py hands the boot reconciler."""
+
+
 @dataclass
 class ChaosConfig:
     """The `chaos.*` config block (utils/config.py DEFAULTS)."""
@@ -50,6 +67,10 @@ class ChaosConfig:
     slow_stream_rate: float = 0.0
     slow_stream_delay_s: float = 0.02
     max_injections: int = 0    # 0 = unbounded
+    # one-shot controller-death crash point: the playbook whose SUBMISSION
+    # kills the controller (cleared after firing so the rebooted stack can
+    # get past the phase it died at)
+    die_at_phase: str = ""
 
     @classmethod
     def from_config(cls, config, section: str = "chaos") -> "ChaosConfig":
@@ -65,6 +86,8 @@ class ChaosConfig:
                 f"{section}.slow_stream_delay_s", base.slow_stream_delay_s)),
             max_injections=int(config.get(
                 f"{section}.max_injections", base.max_injections)),
+            die_at_phase=str(config.get(
+                f"{section}.die_at_phase", base.die_at_phase) or ""),
         )
 
 
@@ -108,6 +131,24 @@ class ChaosExecutor(Executor):
         self.config = config or ChaosConfig()
         self.injections: list[Injection] = []
         self._scripted: dict[tuple, list] = {}
+
+    # ---- controller-death crash point ----
+    def run(self, spec: TaskSpec, task_id: str | None = None) -> str:
+        """Intercept SUBMISSION (not execution): the controller dies on its
+        own thread, before any task exists — matching a real crash, where
+        the phase condition was already persisted Running and the journal
+        op is still open. One-shot: the knob clears itself so the revived
+        controller's resume gets past this phase."""
+        if self.config.die_at_phase and \
+                spec.playbook == self.config.die_at_phase:
+            self.config.die_at_phase = ""
+            self.injections.append(Injection(
+                task_id="", playbook=spec.playbook, kind="controller-death",
+            ))
+            raise ControllerDeath(
+                f"simulated controller death submitting {spec.playbook}"
+            )
+        return super().run(spec, task_id)
 
     # ---- scripting (deterministic sequences for tests/recipes) ----
     def fail_times(self, playbook: str, times: int,

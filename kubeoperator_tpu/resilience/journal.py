@@ -1,0 +1,111 @@
+"""OperationJournal — the crash-safe operation record every phase-running
+service writes through.
+
+Contract (enforced by analyzer rule KO-P007): this module and the phase
+engine (adm/) are the ONLY code allowed to put a cluster into an in-flight
+phase (Provisioning/Deploying/Scaling/Upgrading/Terminating). Routing every
+in-flight transition through here is what guarantees the durable journal
+always knows what was running when the controller dies: the operation row
+is opened BEFORE the cluster leaves its resting phase, updated per adm
+phase transition, and closed on success/failure. A `kill -9` therefore
+leaves an open `Running` op next to the stranded cluster row — exactly the
+pair the boot reconciler (service/reconcile.py) sweeps.
+"""
+
+from __future__ import annotations
+
+from kubeoperator_tpu.models import Cluster, Operation, OperationStatus
+from kubeoperator_tpu.models.cluster import ClusterPhaseStatus
+from kubeoperator_tpu.utils.ids import now_ts
+from kubeoperator_tpu.utils.logging import get_logger
+
+log = get_logger("resilience.journal")
+
+# the phases that mean "a controller owns this cluster right now" — a
+# cluster found in one of these at boot with no live operation is stranded
+IN_FLIGHT_PHASES = frozenset({
+    ClusterPhaseStatus.PROVISIONING.value,
+    ClusterPhaseStatus.DEPLOYING.value,
+    ClusterPhaseStatus.SCALING.value,
+    ClusterPhaseStatus.UPGRADING.value,
+    ClusterPhaseStatus.TERMINATING.value,
+})
+
+
+def default_journal(repos, journal=None) -> "OperationJournal":
+    """Service-constructor fallback, in ONE place: the container injects a
+    single shared journal; direct construction (tests) gets a private one
+    over the same repos — either way the durable record is the same table."""
+    return journal if journal is not None else OperationJournal(repos)
+
+
+class OperationJournal:
+    def __init__(self, repos) -> None:
+        self.repos = repos
+
+    # ---- lifecycle ----
+    def open(self, cluster: Cluster, kind: str,
+             phase: ClusterPhaseStatus | None = None,
+             vars: dict | None = None, message: str = "") -> Operation:
+        """Open the durable record FIRST, then (optionally) flip the cluster
+        into its in-flight phase — in that order, so there is no window
+        where a crash leaves an in-flight cluster with no journal entry."""
+        op = Operation(
+            cluster_id=cluster.id, cluster_name=cluster.name, kind=kind,
+            vars=dict(vars or {}), message=message,
+        )
+        self.repos.operations.save(op)
+        if phase is not None:
+            self.set_phase(cluster, phase)
+        return op
+
+    def set_phase(self, cluster: Cluster,
+                  phase: ClusterPhaseStatus) -> None:
+        """The journaled in-flight phase write (KO-P007's sanctioned path)."""
+        cluster.status.phase = phase.value
+        self.repos.clusters.save(cluster)
+
+    def progress(self, op: Operation, phase_name: str,
+                 phase_status: str) -> None:
+        """Per-phase progress from the adm engine (via AdmContext.on_phase):
+        the journal row tracks how far the operation got, so an interrupted
+        op reads 'died during kube-master', not just 'died'."""
+        op.phase = phase_name
+        op.phase_status = phase_status
+        self.repos.operations.save(op)
+
+    def attach(self, op: Operation, ctx) -> None:
+        """Wire an AdmContext's phase hook to this op's progress record."""
+        ctx.on_phase = lambda name, status: self.progress(op, name, status)
+
+    def close(self, op: Operation, ok: bool, message: str = "") -> Operation:
+        op.status = (OperationStatus.SUCCEEDED.value if ok
+                     else OperationStatus.FAILED.value)
+        op.message = message
+        op.finished_at = now_ts()
+        self.repos.operations.save(op)
+        return op
+
+    def interrupt(self, op: Operation, resume_phase: str = "",
+                  message: str = "") -> Operation:
+        """Boot-reconciler verdict for an orphaned open op: the controller
+        that owned it is gone. Preserves the resume point so the retry path
+        re-enters exactly where the dead controller stopped."""
+        op.status = OperationStatus.INTERRUPTED.value
+        op.resume_phase = resume_phase
+        op.message = message or "controller died while this operation ran"
+        op.finished_at = now_ts()
+        self.repos.operations.save(op)
+        log.warning("operation %s (%s on %s) marked interrupted; resume at %r",
+                    op.id, op.kind, op.cluster_name, resume_phase)
+        return op
+
+    # ---- queries ----
+    def open_ops(self, cluster_id: str | None = None) -> list[Operation]:
+        where = {"status": OperationStatus.RUNNING.value}
+        if cluster_id is not None:
+            where["cluster_id"] = cluster_id
+        return self.repos.operations.find(**where)
+
+    def history(self, cluster_id: str, limit: int = 50) -> list[Operation]:
+        return self.repos.operations.history(cluster_id, limit)

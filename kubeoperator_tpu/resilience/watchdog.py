@@ -1,0 +1,153 @@
+"""Watchdog policy primitives: remediation budget + circuit breaker.
+
+The watchdog (service/watchdog.py) escalates failed cron health probes to
+the already-existing guided-recovery actions. Unbounded, that is a
+remediation storm generator — a permanently-broken cluster would get the
+same phase re-run every tick forever. This module is the pure state
+machine that bounds it:
+
+  * budget    — at most `remediation_budget` remediations per `window_s`
+                per cluster; exhausting it OPENS the circuit
+  * cooldown  — at least `cooldown_s` between remediations per cluster
+  * flap      — a cluster that degrades again within `window_s` of a
+                successful remediation `flap_threshold` times is flapping
+                (remediation "works" but doesn't stick) → circuit OPENS
+
+An open circuit stops all automatic remediation for that cluster and is
+closed only by an explicit operator reset (`koctl watchdog reset`) — the
+watchdog escalated, a human owns the cluster now. State is a plain dict so
+the service layer can persist it (settings repo) across controller
+restarts; all time comes from the caller, so tests drive the clock.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+CIRCUIT_CLOSED = "closed"
+CIRCUIT_OPEN = "open"
+
+
+@dataclass(frozen=True)
+class WatchdogConfig:
+    """The `watchdog.*` config block (utils/config.py DEFAULTS)."""
+
+    enabled: bool = True
+    remediation_budget: int = 3
+    window_s: float = 3600.0
+    cooldown_s: float = 300.0
+    flap_threshold: int = 3
+
+    @classmethod
+    def from_config(cls, config, section: str = "watchdog") -> "WatchdogConfig":
+        base = cls()
+        return cls(
+            enabled=bool(config.get(f"{section}.enabled", base.enabled)),
+            remediation_budget=int(config.get(
+                f"{section}.remediation_budget", base.remediation_budget)),
+            window_s=float(config.get(f"{section}.window_s", base.window_s)),
+            cooldown_s=float(config.get(
+                f"{section}.cooldown_s", base.cooldown_s)),
+            flap_threshold=int(config.get(
+                f"{section}.flap_threshold", base.flap_threshold)),
+        )
+
+
+def new_state() -> dict:
+    """Fresh per-cluster breaker state (persisted verbatim as a settings
+    row, so every field must stay JSON-plain)."""
+    return {
+        "state": CIRCUIT_CLOSED,
+        "remediations": [],          # timestamps of remediation attempts
+        "last_remediation_ts": 0.0,
+        "last_remediation_ok": False,
+        "flaps": 0,                  # degraded-again-after-success count
+        "opened_at": 0.0,
+        "opened_reason": "",
+    }
+
+
+class CircuitBreaker:
+    """Decision core over one cluster's state dict. The service layer owns
+    persistence and the actual remediation side effects; this class only
+    answers "may I remediate now?" and tracks the transitions."""
+
+    def __init__(self, cfg: WatchdogConfig, state: dict) -> None:
+        self.cfg = cfg
+        self.state = state
+
+    @property
+    def is_open(self) -> bool:
+        return self.state["state"] == CIRCUIT_OPEN
+
+    def _window(self, now: float) -> list[float]:
+        kept = [t for t in self.state["remediations"]
+                if now - t < self.cfg.window_s]
+        self.state["remediations"] = kept
+        return kept
+
+    def budget_left(self, now: float) -> int:
+        return max(0, self.cfg.remediation_budget - len(self._window(now)))
+
+    def cooldown_remaining(self, now: float) -> float:
+        # keyed off the remediation list, not a "last" scalar: a timestamp
+        # of 0.0 is a valid time in tests and must not read as "never"
+        rem = self.state["remediations"]
+        if not rem:
+            return 0.0
+        return max(0.0, self.cfg.cooldown_s - (now - max(rem)))
+
+    def admit(self, now: float) -> tuple[bool, str]:
+        """May a remediation run now? Returns (allowed, reason-if-not).
+        Opening on an exhausted budget/flap happens HERE, so the breaker
+        opens on the first degraded tick past the limit — before another
+        remediation fires, never after."""
+        if self.is_open:
+            return False, "circuit open"
+        if self.state["flaps"] >= self.cfg.flap_threshold:
+            self.trip(now, f"flap detected: degraded again within "
+                           f"{self.cfg.window_s:g}s of a successful "
+                           f"remediation {self.state['flaps']} times")
+            return False, "circuit open"
+        if self.cooldown_remaining(now) > 0:
+            return False, "cooldown"
+        if self.budget_left(now) <= 0:
+            self.trip(now, f"remediation budget exhausted "
+                           f"({self.cfg.remediation_budget} per "
+                           f"{self.cfg.window_s:g}s)")
+            return False, "circuit open"
+        return True, ""
+
+    def record(self, now: float, ok: bool) -> None:
+        self.state["remediations"].append(now)
+        self.state["last_remediation_ts"] = now
+        self.state["last_remediation_ok"] = bool(ok)
+
+    def note_degraded(self, now: float) -> None:
+        """A degradation observed AFTER a successful remediation inside the
+        window is a flap — remediation keeps 'working' without sticking."""
+        if self.state["last_remediation_ok"] and \
+                now - self.state["last_remediation_ts"] < self.cfg.window_s:
+            self.state["flaps"] += 1
+            # one flap credit per remediation, not per degraded tick
+            self.state["last_remediation_ok"] = False
+
+    def note_healthy(self, now: float) -> None:
+        """A full quiet window after the last remediation clears the flap
+        streak — the cluster genuinely recovered."""
+        rem = self.state["remediations"]
+        last = max(rem) if rem else self.state["last_remediation_ts"]
+        if not rem or now - last >= self.cfg.window_s:
+            self.state["flaps"] = 0
+
+    def trip(self, now: float, reason: str) -> None:
+        if self.is_open:
+            return
+        self.state["state"] = CIRCUIT_OPEN
+        self.state["opened_at"] = now
+        self.state["opened_reason"] = reason
+
+    def reset(self) -> None:
+        """Operator reset: back to a fresh closed breaker."""
+        self.state.clear()
+        self.state.update(new_state())

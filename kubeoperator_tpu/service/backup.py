@@ -27,10 +27,13 @@ def _check_k8s_name(value: str, what: str) -> None:
 
 class BackupService:
     def __init__(self, repos: Repositories, executor: Executor, events,
-                 retry_policy=None, retry_rng=None):
+                 retry_policy=None, retry_rng=None, journal=None):
         self.repos = repos
         self.events = events
         self.adm = ClusterAdm(executor, policy=retry_policy, rng=retry_rng)
+        from kubeoperator_tpu.resilience import default_journal
+
+        self.journal = default_journal(repos, journal)
 
     # ---- accounts ----
     def create_account(self, account: BackupAccount) -> BackupAccount:
@@ -201,16 +204,20 @@ class BackupService:
                             name=fname, has_sentinel=True)
         self.repos.backup_files.save(record)
         ctx = self._context(cluster, account, fname)
+        op = self.journal.open(cluster, "backup", vars={"file": fname})
+        self.journal.attach(op, ctx)
         try:
             self.adm.run(ctx, backup_phases())
         except PhaseError as e:
             record.status = "Failed"
             record.message = e.message
             self.repos.backup_files.save(record)
+            self.journal.close(op, ok=False, message=e.message)
             self.events.emit(cluster.id, "Warning", "BackupFailed", e.message)
             raise
         record.status = "Uploaded"
         self.repos.backup_files.save(record)
+        self.journal.close(op, ok=True)
         self._prune(cluster.id)
         self.events.emit(cluster.id, "Normal", "BackupDone",
                          f"etcd snapshot {fname} -> {account.name}")
@@ -226,6 +233,8 @@ class BackupService:
         record = files[0]
         account = self.repos.backup_accounts.get(record.account_id)
         ctx = self._context(cluster, account, file_name)
+        op = self.journal.open(cluster, "restore", vars={"file": file_name})
+        self.journal.attach(op, ctx)
         # legacy snapshots (taken before sentinel support) cannot contain
         # the sentinel key — restore_verify_post skips that one check for
         # them instead of condemning every old backup as unrestorable
@@ -233,8 +242,10 @@ class BackupService:
         try:
             self.adm.run(ctx, restore_phases())
         except PhaseError as e:
+            self.journal.close(op, ok=False, message=e.message)
             self.events.emit(cluster.id, "Warning", "RestoreFailed", e.message)
             raise
+        self.journal.close(op, ok=True)
         record.status = "Restored"
         self.repos.backup_files.save(record)
         self.events.emit(cluster.id, "Normal", "RestoreDone",

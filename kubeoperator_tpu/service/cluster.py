@@ -59,6 +59,7 @@ class ClusterService:
         config: Config,
         retry_policy=None,
         retry_rng=None,
+        journal=None,
     ) -> None:
         self.repos = repos
         self.executor = executor
@@ -77,6 +78,13 @@ class ClusterService:
             retry_policy = retry_policy if retry_policy is not None else policy_fb
             retry_rng = retry_rng if retry_rng is not None else rng_fb
         self.adm = ClusterAdm(executor, policy=retry_policy, rng=retry_rng)
+        # crash-safe operation journal: every operation opens a durable op
+        # row before its phase loop and every in-flight phase flip goes
+        # through the journal helper (KO-P007), so a dead controller always
+        # leaves a sweepable record behind
+        from kubeoperator_tpu.resilience import default_journal
+
+        self.journal = default_journal(repos, journal)
         self._ops: dict[str, threading.Thread] = {}
         self._ops_lock = threading.Lock()
         # static-IP pool reservations: addresses allocated at render time but
@@ -286,12 +294,19 @@ class ClusterService:
         )
         shrinking = num_slices < plan.num_slices
 
+        op = None
+
         def admit():
             # persisted synchronously post-admission: the caller's very next
             # status poll must see Scaling (not a stale Ready), and a
-            # ConflictError must leave plan/cluster untouched
-            cluster.status.phase = ClusterPhaseStatus.SCALING.value
-            self.repos.clusters.save(cluster)
+            # ConflictError must leave plan/cluster untouched. The journal
+            # op opens first, so no crash window has an in-flight cluster
+            # without a durable record.
+            nonlocal op
+            op = self.journal.open(
+                cluster, "slice-scale", phase=ClusterPhaseStatus.SCALING,
+                vars={"num_slices": num_slices},
+            )
             self.events.emit(
                 cluster.id, "Normal", "SliceScaleStarted",
                 f"scaling {name} to {num_slices}x {plan.tpu_type} "
@@ -310,6 +325,7 @@ class ClusterService:
                         if h.tpu_chips > 0 and h.tpu_slice_id >= num_slices
                     ]
                     ctx = self._context(cluster, plan)
+                    self.journal.attach(op, ctx)
                     for host in sorted(leaving, key=lambda h: h.name):
                         nodes = self.repos.nodes.find(
                             cluster_id=cluster.id, name=host.name)
@@ -329,16 +345,18 @@ class ClusterService:
                     new_topo.is_multihost or new_topo.is_multislice
                 )
                 self.repos.clusters.save(cluster)
-                self._provision(cluster, plan)
-                cluster.status.phase = ClusterPhaseStatus.DEPLOYING.value
-                self.repos.clusters.save(cluster)
+                self._provision(cluster, plan, op=op)
+                self.journal.set_phase(cluster, ClusterPhaseStatus.DEPLOYING)
                 ctx = self._context(cluster, plan)
+                self.journal.attach(op, ctx)
                 self.adm.run(ctx, create_phases())
                 self._finish_ready(cluster)
+                self.journal.close(op, ok=True)
             except PhaseError as e:
                 cluster.status.phase = ClusterPhaseStatus.FAILED.value
                 cluster.status.message = e.message
                 self.repos.clusters.save(cluster)
+                self.journal.close(op, ok=False, message=e.message)
                 self.events.emit(cluster.id, "Warning", "SliceScaleFailed",
                                  f"phase {e.phase}: {e.message}")
                 if wait:
@@ -347,6 +365,7 @@ class ClusterService:
                 cluster.status.phase = ClusterPhaseStatus.FAILED.value
                 cluster.status.message = str(e)
                 self.repos.clusters.save(cluster)
+                self.journal.close(op, ok=False, message=str(e))
                 self.events.emit(cluster.id, "Warning", "SliceScaleFailed",
                                  str(e))
                 if wait:
@@ -355,38 +374,50 @@ class ClusterService:
         self._spawn(cluster.id, work, wait, pre_start=admit)
         return self.repos.clusters.get(cluster.id)
 
-    def _run_day2(self, name: str, *, action: str, require_msg: str,
-                  phases_fn, on_success, fail_reason: str,
+    def _run_day2(self, name: str, *, action: str, kind: str,
+                  require_msg: str, phases_fn, on_success, fail_reason: str,
                   wait: bool) -> "Cluster":
         """Shared scaffold for Ready-gated day-2 operations (cert renewal,
         key rotation, etcd maintenance): one copy of the guard +
         PhaseError/Exception handling + event emission + wait-reraise, so
         a fix to the error path cannot be applied to some operations and
         missed in others. `on_success(ctx)` returns (reason, message) and
-        may do the operation's post-work (e.g. kubeconfig refresh)."""
+        may do the operation's post-work (e.g. kubeconfig refresh).
+        `kind` names the journal op — day-2 ops never leave Ready, so an
+        interrupted one shows up in the journal without stranding the
+        cluster in an in-flight phase."""
         cluster = self.get(name)
         cluster.require_managed(action)
         if cluster.status.phase != ClusterPhaseStatus.READY.value:
             raise ValidationError(require_msg)
         plan = self.repos.plans.get(cluster.plan_id) if cluster.plan_id else None
+        op = None
+
+        def admit():
+            nonlocal op
+            op = self.journal.open(cluster, kind)
 
         def work():
             try:
                 ctx = self._context(cluster, plan)
+                self.journal.attach(op, ctx)
                 self.adm.run(ctx, phases_fn())
                 reason, message = on_success(ctx)
+                self.journal.close(op, ok=True)
                 self.events.emit(cluster.id, "Normal", reason, message)
             except PhaseError as e:
+                self.journal.close(op, ok=False, message=e.message)
                 self.events.emit(cluster.id, "Warning", fail_reason,
                                  f"phase {e.phase}: {e.message}")
                 if wait:
                     raise
             except Exception as e:
+                self.journal.close(op, ok=False, message=str(e))
                 self.events.emit(cluster.id, "Warning", fail_reason, str(e))
                 if wait:
                     raise
 
-        self._spawn(cluster.id, work, wait)
+        self._spawn(cluster.id, work, wait, pre_start=admit)
         return self.repos.clusters.get(cluster.id)
 
     def renew_certs(self, name: str, wait: bool = False) -> Cluster:
@@ -401,7 +432,7 @@ class ClusterService:
                     f"cluster {name} control-plane certs rotated")
 
         return self._run_day2(
-            name, action="cert renewal",
+            name, action="cert renewal", kind="renew-certs",
             require_msg="cert renewal requires a Ready cluster",
             phases_fn=cert_renew_phases, on_success=done,
             fail_reason="CertRenewFailed", wait=wait)
@@ -421,7 +452,7 @@ class ClusterService:
                     f"alarms cleared; {detail}")
 
         return self._run_day2(
-            name, action="etcd maintenance",
+            name, action="etcd maintenance", kind="etcd-maintenance",
             require_msg="etcd maintenance requires a Ready cluster",
             phases_fn=etcd_maintenance_phases, on_success=done,
             fail_reason="EtcdMaintenanceFailed", wait=wait)
@@ -433,6 +464,7 @@ class ClusterService:
         re-encrypt under the new key."""
         return self._run_day2(
             name, action="encryption key rotation",
+            kind="rotate-encryption-key",
             require_msg="key rotation requires a Ready cluster",
             phases_fn=encryption_rotate_phases,
             on_success=lambda ctx: (
@@ -442,12 +474,20 @@ class ClusterService:
 
     def delete(self, name: str, wait: bool = False) -> None:
         cluster = self.get(name)
-        cluster.status.phase = ClusterPhaseStatus.TERMINATING.value
-        self.repos.clusters.save(cluster)
+        op = None
+
+        def admit():
+            # post-admission so a ConflictError can't leave a phantom
+            # Terminating phase (or an open journal op) behind; still
+            # synchronous, so the caller's next poll sees Terminating
+            nonlocal op
+            op = self.journal.open(cluster, "terminate",
+                                   phase=ClusterPhaseStatus.TERMINATING)
 
         def work():
             try:
                 ctx = self._context(cluster)
+                self.journal.attach(op, ctx)
                 if ctx.nodes:
                     try:
                         self.adm.run(ctx, reset_phases())
@@ -470,16 +510,18 @@ class ClusterService:
                 cluster.status.phase = ClusterPhaseStatus.TERMINATED.value
                 self.repos.clusters.save(cluster)
                 self.repos.clusters.delete(cluster.id)
+                self.journal.close(op, ok=True)
                 self.events.emit(cluster.id, "Normal", "ClusterDeleted",
                                  f"cluster {name} deleted")
             except Exception as e:
                 cluster.status.phase = ClusterPhaseStatus.FAILED.value
                 cluster.status.message = f"delete failed: {e}"
                 self.repos.clusters.save(cluster)
+                self.journal.close(op, ok=False, message=str(e))
                 self.events.emit(cluster.id, "Warning", "ClusterDeleteFailed", str(e))
                 raise
 
-        self._spawn(cluster.id, work, wait)
+        self._spawn(cluster.id, work, wait, pre_start=admit)
 
     # ---- internals ----
     def _check_manual_hosts(
@@ -528,10 +570,13 @@ class ClusterService:
             host.cluster_id = ""
             self.repos.hosts.save(host)
 
-    def _provision(self, cluster: Cluster, plan: Plan) -> None:
-        """Terraform leg of §3.1 (plan mode only)."""
-        cluster.status.phase = ClusterPhaseStatus.PROVISIONING.value
-        self.repos.clusters.save(cluster)
+    def _provision(self, cluster: Cluster, plan: Plan, op=None) -> None:
+        """Terraform leg of §3.1 (plan mode only). `op` is the owning
+        journal operation; the terraform leg is recorded as a synthetic
+        'provision' phase so an interrupted op can say it died in IaaS."""
+        self.journal.set_phase(cluster, ClusterPhaseStatus.PROVISIONING)
+        if op is not None:
+            self.journal.progress(op, "provision", "Running")
         region = self.repos.regions.get(plan.region_id)
         zones = [self.repos.zones.get(z) for z in plan.zone_ids]
         # Static-IP pool conflict check: every address any Host already
@@ -600,6 +645,8 @@ class ClusterService:
             # addresses are free again) — either way the reservation is done
             with self._ip_lock:
                 self._reserved_ips -= allocated
+        if op is not None:
+            self.journal.progress(op, "provision", "OK")
         self.events.emit(
             cluster.id, "Normal", "Provisioned",
             f"{len(hosts)} machines provisioned via {plan.provider}",
@@ -645,22 +692,32 @@ class ClusterService:
 
     def _launch(self, cluster: Cluster, plan: Plan | None, wait: bool,
                 force_provision: bool = False) -> Cluster:
+        op = None
+
+        def admit():
+            # the journal op is the durable "a controller owns this
+            # cluster" claim; opened post-admission, before any phase work
+            nonlocal op
+            op = self.journal.open(cluster, "create")
+
         def work():
             try:
                 if plan is not None and (
                     force_provision
                     or not self.repos.nodes.find(cluster_id=cluster.id)
                 ):
-                    self._provision(cluster, plan)
-                cluster.status.phase = ClusterPhaseStatus.DEPLOYING.value
-                self.repos.clusters.save(cluster)
+                    self._provision(cluster, plan, op=op)
+                self.journal.set_phase(cluster, ClusterPhaseStatus.DEPLOYING)
                 ctx = self._context(cluster, plan)
+                self.journal.attach(op, ctx)
                 self.adm.run(ctx, create_phases())
                 self._finish_ready(cluster)
+                self.journal.close(op, ok=True)
             except PhaseError as e:
                 cluster.status.phase = ClusterPhaseStatus.FAILED.value
                 cluster.status.message = e.message
                 self.repos.clusters.save(cluster)
+                self.journal.close(op, ok=False, message=e.message)
                 self.events.emit(cluster.id, "Warning", "ClusterCreateFailed",
                                  f"phase {e.phase}: {e.message}")
                 if wait:
@@ -669,11 +726,60 @@ class ClusterService:
                 cluster.status.phase = ClusterPhaseStatus.FAILED.value
                 cluster.status.message = str(e)
                 self.repos.clusters.save(cluster)
+                self.journal.close(op, ok=False, message=str(e))
                 self.events.emit(cluster.id, "Warning", "ClusterCreateFailed", str(e))
                 if wait:
                     raise
 
-        self._spawn(cluster.id, work, wait)
+        self._spawn(cluster.id, work, wait, pre_start=admit)
+        return self.repos.clusters.get(cluster.id)
+
+    def reprovision(self, name: str) -> Cluster:
+        """Terraform re-apply alone (no phase re-run): heal the machine
+        fleet of a plan-mode cluster in place. `_provision` reconciles
+        machines by name, so this is a no-op on a complete fleet and
+        re-creates preempted/deleted ones — the watchdog's remediation for
+        a TPU slice whose allocatable chips dropped below the plan
+        topology. Synchronous, and registered like any other operation so
+        it can never race a running create/scale."""
+        cluster = self.get(name)
+        cluster.require_managed("reprovision")
+        if cluster.provision_mode != ProvisionMode.PLAN.value:
+            raise ValidationError(
+                "reprovision applies to plan-mode clusters only"
+            )
+        if cluster.status.phase != ClusterPhaseStatus.READY.value:
+            # a Failed cluster resumes through retry() (phases too), never
+            # through a bare fleet reconcile that would fake a Ready flip
+            raise ValidationError(
+                f"cluster {name} is {cluster.status.phase}; reprovision "
+                f"heals Ready clusters (use retry for Failed ones)"
+            )
+        plan = self.repos.plans.get(cluster.plan_id)
+        op = None
+
+        def admit():
+            nonlocal op
+            op = self.journal.open(cluster, "reprovision")
+
+        def work():
+            try:
+                self._provision(cluster, plan, op=op)
+                cluster.status.phase = ClusterPhaseStatus.READY.value
+                self.repos.clusters.save(cluster)
+                self.journal.close(op, ok=True)
+                self.events.emit(cluster.id, "Normal", "Reprovisioned",
+                                 f"machine fleet of {name} reconciled")
+            except Exception as e:
+                cluster.status.phase = ClusterPhaseStatus.FAILED.value
+                cluster.status.message = str(e)
+                self.repos.clusters.save(cluster)
+                self.journal.close(op, ok=False, message=str(e))
+                self.events.emit(cluster.id, "Warning", "ReprovisionFailed",
+                                 str(e))
+                raise
+
+        self._spawn(cluster.id, work, wait=True, pre_start=admit)
         return self.repos.clusters.get(cluster.id)
 
     def _store_kubeconfig(self, cluster: Cluster) -> None:

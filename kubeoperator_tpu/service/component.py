@@ -71,10 +71,13 @@ def _check_vars_inert(vars: dict, origin: str, redact: bool = False,
 
 class ComponentService:
     def __init__(self, repos: Repositories, executor: Executor, events,
-                 retry_policy=None, retry_rng=None):
+                 retry_policy=None, retry_rng=None, journal=None):
         self.repos = repos
         self.events = events
         self.adm = ClusterAdm(executor, policy=retry_policy, rng=retry_rng)
+        from kubeoperator_tpu.resilience import default_journal
+
+        self.journal = default_journal(repos, journal)
 
     def catalog(self) -> dict:
         return {k: dict(v) for k, v in COMPONENT_CATALOG.items()}
@@ -156,13 +159,18 @@ class ComponentService:
 
         playbook = entry["playbook"]
         ctx = self._context(cluster, component, secret_vars)
+        op = self.journal.open(cluster, "component-install",
+                               vars={"component": component_name})
+        self.journal.attach(op, ctx)
         try:
             self.adm.run(ctx, [Phase(f"component-{component_name}", playbook)])
         except PhaseError as e:
             component.status = "Failed"
             component.message = e.message
             self.repos.components.save(component)
+            self.journal.close(op, ok=False, message=e.message)
             raise
+        self.journal.close(op, ok=True)
         component.status = "Installed"
         component.message = ""
         self.repos.components.save(component)
@@ -190,6 +198,9 @@ class ComponentService:
             component.status = "Uninstalling"
             self.repos.components.save(component)
             ctx = self._context(cluster, component)
+            op = self.journal.open(cluster, "component-uninstall",
+                                   vars={"component": component_name})
+            self.journal.attach(op, ctx)
             unlabel: list = [list(pair) for pair in teardown.get("unlabel", [])]
             if "unlabel_var" in teardown:
                 # label applied to a VAR-driven namespace list at install
@@ -220,10 +231,12 @@ class ComponentService:
                 component.status = "UninstallFailed"
                 component.message = e.message
                 self.repos.components.save(component)
+                self.journal.close(op, ok=False, message=e.message)
                 self.events.emit(
                     cluster.id, "Warning", "ComponentUninstallFailed",
                     f"{component_name} teardown failed: {e.message}")
                 raise
+            self.journal.close(op, ok=True)
         component.status = "Uninstalled"
         component.message = ""
         self.repos.components.save(component)

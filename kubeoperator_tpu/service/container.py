@@ -72,36 +72,58 @@ class Services:
         # ONE retry policy + jitter RNG for every phase-running service
         # (create, scale, upgrade, backup, components, CIS, guided
         # recovery), so transient-failure behavior cannot drift between
-        # entry points
-        from kubeoperator_tpu.resilience import retry_wiring
+        # entry points — and ONE operation journal, so every phase loop
+        # writes the same durable in-flight record the boot reconciler
+        # sweeps after a controller crash
+        from kubeoperator_tpu.resilience import OperationJournal, retry_wiring
 
         retry_policy, retry_rng = retry_wiring(config)
+        self.journal = OperationJournal(repos)
         self.clusters = ClusterService(
             repos, executor, provisioner, self.events, config,
             retry_policy=retry_policy, retry_rng=retry_rng,
+            journal=self.journal,
         )
         self.nodes = NodeService(repos, executor, provisioner, self.events,
                                  retry_policy=retry_policy,
-                                 retry_rng=retry_rng)
+                                 retry_rng=retry_rng, journal=self.journal)
         self.upgrades = UpgradeService(repos, executor, self.events,
                                        retry_policy=retry_policy,
-                                       retry_rng=retry_rng)
+                                       retry_rng=retry_rng,
+                                       journal=self.journal)
         self.backups = BackupService(repos, executor, self.events,
                                      retry_policy=retry_policy,
-                                     retry_rng=retry_rng)
+                                     retry_rng=retry_rng,
+                                     journal=self.journal)
         self.health = HealthService(repos, executor, self.events,
                                     retry_policy=retry_policy,
-                                    retry_rng=retry_rng)
+                                    retry_rng=retry_rng,
+                                    journal=self.journal)
         self.components = ComponentService(repos, executor, self.events,
                                            retry_policy=retry_policy,
-                                           retry_rng=retry_rng)
+                                           retry_rng=retry_rng,
+                                           journal=self.journal)
         self.cis = CisService(repos, executor, self.events,
                               retry_policy=retry_policy,
-                              retry_rng=retry_rng)
+                              retry_rng=retry_rng, journal=self.journal)
+        from kubeoperator_tpu.service.watchdog import WatchdogService
+
+        self.watchdog = WatchdogService(repos, self.health, self.events,
+                                        config, clusters=self.clusters)
         self.cron = CronService(self)
         from kubeoperator_tpu.terminal import TerminalManager
 
         self.terminals = TerminalManager(repos, config)
+
+        # boot reconciliation LAST, once every service exists: sweep
+        # operations orphaned by the previous controller's death and (per
+        # resilience.reconcile.auto_resume) re-enter their resume paths —
+        # no operation thread can be running yet, so every open journal op
+        # is by construction an orphan
+        from kubeoperator_tpu.service.reconcile import ReconcileService
+
+        self.reconciler = ReconcileService(self)
+        self.boot_report = self.reconciler.boot_sweep()
 
     def close(self) -> None:
         self.cron.stop()

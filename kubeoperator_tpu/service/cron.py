@@ -113,11 +113,30 @@ class CronService:
                     # adhoc probe/sync paths would fail every tick forever
                     continue
                 try:
-                    self.services.health.check(cluster.name)
+                    report = self.services.health.check(cluster.name)
                     actions.append(f"health:{cluster.name}")
                 except Exception as e:
+                    # a probe that cannot even RUN is itself degradation:
+                    # event + status condition, never just a log line
                     log.warning("health check failed for %s: %s",
                                 cluster.name, e)
+                    try:
+                        self.services.watchdog.note_check_error(
+                            cluster, str(e))
+                    except Exception:
+                        # e.g. the cluster row vanished mid-check; the
+                        # recording is best-effort, the tick must go on
+                        log.exception("could not record health-check "
+                                      "error for %s", cluster.name)
+                    continue
+                # failed probes escalate to guided recovery under the
+                # per-cluster circuit breaker (service/watchdog.py)
+                try:
+                    actions.extend(
+                        self.services.watchdog.observe(cluster, report))
+                except Exception:
+                    log.exception("watchdog pass failed for %s",
+                                  cluster.name)
 
         # drift/event monitoring: pull managed clusters' K8s events
         interval = float(cfg.get("cron.event_sync_interval_s", 300))

@@ -4,9 +4,13 @@ Probes: API server /healthz, node Ready set, etcd endpoint health, and —
 TPU-specific, before any smoke test is trusted — device-plugin allocatable
 chips vs the plan topology (SURVEY.md §5.3 'TPU-specific probes').
 Each probe maps to a guided recovery action (re-run the matching adm phase).
+The cron watchdog (service/watchdog.py) drives the same actions
+automatically under a circuit breaker.
 """
 
 from __future__ import annotations
+
+import re
 
 from dataclasses import dataclass, field
 
@@ -47,12 +51,39 @@ RECOVERY_ACTIONS = {
     "etcd": ("05-etcd.yml", "etcd"),
     "tpu-device-plugin": ("16-tpu-runtime.yml", "tpu-runtime"),
     "tpu-smoke": ("17-tpu-smoke-test.yml", "tpu-smoke-test"),
+    # a chips-vs-plan shortfall usually means a preempted slice: the full
+    # remediation is terraform reprovision + this phase (the watchdog runs
+    # both); the manual `koctl cluster recover` path re-runs the phase
+    "tpu-chips": ("16-tpu-runtime.yml", "tpu-runtime"),
 }
+
+# allocatable TPU chips across the fleet, one integer per node line — the
+# preempted-slice detector's raw input (jsonpath keeps it kubectl-version
+# agnostic; missing resources render as empty lines)
+TPU_CHIPS_CMD = (
+    "kubectl --kubeconfig /etc/kubernetes/admin.conf get nodes "
+    "-o jsonpath='{range .items[*]}{.status.allocatable.google\\.com/tpu}"
+    "{\"\\n\"}{end}'"
+)
+
+
+def parse_chip_count(lines: list[str]) -> int | None:
+    """Sum the standalone integers in adhoc probe output (one per node).
+    None = no per-node numbers surfaced at all — simulation backends and
+    chip-less output are 'unknown', which must never read as 0 chips and
+    trigger a phantom slice remediation."""
+    total, seen = 0, False
+    for line in lines:
+        m = re.fullmatch(r"(\d+)", line.strip())
+        if m:
+            total += int(m.group(1))
+            seen = True
+    return total if seen else None
 
 
 class HealthService:
     def __init__(self, repos: Repositories, executor: Executor, events,
-                 retry_policy=None, retry_rng=None):
+                 retry_policy=None, retry_rng=None, journal=None):
         self.repos = repos
         self.executor = executor
         self.events = events
@@ -60,6 +91,9 @@ class HealthService:
         # create flow uses (wired by the service container), so a recovery
         # rides through the same transient faults a create would
         self.adm = ClusterAdm(executor, policy=retry_policy, rng=retry_rng)
+        from kubeoperator_tpu.resilience import default_journal
+
+        self.journal = default_journal(repos, journal)
 
     def check(self, cluster_name: str) -> HealthReport:
         """Adhoc-probe the cluster through the executor boundary. Imported
@@ -93,6 +127,9 @@ class HealthService:
                 detail=result.message if not result.ok else "",
                 recovery=RECOVERY_ACTIONS.get(name, ("", ""))[1],
             ))
+        chips_probe = self._probe_tpu_chips(cluster, inv)
+        if chips_probe is not None:
+            probes.append(chips_probe)
 
         healthy = all(p.ok for p in probes)
         report = HealthReport(cluster=cluster_name, healthy=healthy,
@@ -102,6 +139,42 @@ class HealthService:
             self.events.emit(cluster.id, "Warning", "HealthDegraded",
                              f"failed probes: {bad}")
         return report
+
+    def _probe_tpu_chips(self, cluster, inv) -> ProbeResult | None:
+        """TPU preempted-slice detector (SURVEY.md §5.3): allocatable chips
+        across the fleet vs the plan topology. Fewer chips than the plan
+        promises means a slice lost machines (GCE preemption, host crash) —
+        the one TPU failure mode a green apiserver probe hides completely.
+        Unknown counts (simulation backends, kubectl without the resource)
+        stay ok: a missing NUMBER must never read as missing CHIPS."""
+        if not cluster.spec.tpu_enabled or not cluster.plan_id:
+            return None
+        plan = self.repos.plans.get(cluster.plan_id)
+        if not plan.has_tpu():
+            return None
+        expected = plan.topology().total_chips
+        task_id = self.executor.run_adhoc("command", TPU_CHIPS_CMD, inv,
+                                          pattern="kube-master")
+        result = self.executor.wait(task_id, timeout_s=120)
+        if not result.ok:
+            return ProbeResult(name="tpu-chips", ok=False,
+                               detail=result.message,
+                               recovery="tpu-chips")
+        chips = parse_chip_count(list(self.executor.watch(task_id)))
+        if chips is None:
+            return ProbeResult(
+                name="tpu-chips", ok=True,
+                detail="allocatable chip count unavailable (simulated?)",
+            )
+        if chips < expected:
+            return ProbeResult(
+                name="tpu-chips", ok=False,
+                detail=f"{chips}/{expected} chips allocatable — slice "
+                       f"preempted or device plugin degraded",
+                recovery="tpu-chips",
+            )
+        return ProbeResult(name="tpu-chips", ok=True,
+                           detail=f"{chips}/{expected} chips allocatable")
 
     def _check_via_kubeconfig(self, cluster) -> HealthReport:
         """Local kubectl probes against the imported cluster's apiserver.
@@ -154,8 +227,16 @@ class HealthService:
             self.repos.plans.get(cluster.plan_id) if cluster.plan_id else None
         )
         ctx = AdmContext.for_cluster(self.repos, cluster, plan)
+        op = self.journal.open(cluster, "recovery",
+                               vars={"probe": probe_name})
+        self.journal.attach(op, ctx)
         post = smoke_post if condition == "tpu-smoke-test" else None
-        self.adm.run(ctx, [Phase(condition, playbook, post=post)])
+        try:
+            self.adm.run(ctx, [Phase(condition, playbook, post=post)])
+        except PhaseError as e:
+            self.journal.close(op, ok=False, message=e.message)
+            raise
+        self.journal.close(op, ok=True)
         self.events.emit(cluster.id, "Normal", "Recovered",
                          f"recovery phase {condition} completed")
 

@@ -21,12 +21,15 @@ log = get_logger("service.node")
 
 class NodeService:
     def __init__(self, repos: Repositories, executor: Executor, provisioner,
-                 events, retry_policy=None, retry_rng=None):
+                 events, retry_policy=None, retry_rng=None, journal=None):
         self.repos = repos
         self.executor = executor
         self.provisioner = provisioner
         self.events = events
         self.adm = ClusterAdm(executor, policy=retry_policy, rng=retry_rng)
+        from kubeoperator_tpu.resilience import default_journal
+
+        self.journal = default_journal(repos, journal)
 
     def list(self, cluster_name: str) -> list[Node]:
         cluster = self.repos.clusters.get_by_name(cluster_name)
@@ -55,24 +58,30 @@ class NodeService:
             self.repos.nodes.save(node)
             new_nodes.append(node)
 
-        cluster.status.phase = ClusterPhaseStatus.SCALING.value
-        self.repos.clusters.save(cluster)
+        # ctx before open: no fallible call between the journal/phase flip
+        # and the try that guarantees a close
         ctx = self._context(cluster)
         ctx.new_node_names = {n.name for n in new_nodes}
+        op = self.journal.open(cluster, "node-scale-up",
+                               phase=ClusterPhaseStatus.SCALING,
+                               vars={"hosts": list(host_names)})
+        self.journal.attach(op, ctx)
         try:
             self.adm.run(ctx, scale_up_phases())
-        except PhaseError:
+        except PhaseError as e:
             for node in new_nodes:
                 node.status = "Failed"
                 self.repos.nodes.save(node)
             cluster.status.phase = ClusterPhaseStatus.FAILED.value
             self.repos.clusters.save(cluster)
+            self.journal.close(op, ok=False, message=e.message)
             raise
         for node in new_nodes:
             node.status = "Ready"
             self.repos.nodes.save(node)
         cluster.status.phase = ClusterPhaseStatus.READY.value
         self.repos.clusters.save(cluster)
+        self.journal.close(op, ok=True)
         self.events.emit(cluster.id, "Normal", "NodesJoined",
                          f"{len(new_nodes)} workers joined {cluster_name}")
         return new_nodes
@@ -93,19 +102,22 @@ class NodeService:
         if len(workers) <= 1:
             raise ValidationError("cannot remove the last worker")
 
-        cluster.status.phase = ClusterPhaseStatus.SCALING.value
-        self.repos.clusters.save(cluster)
-        node.status = "Draining"
-        self.repos.nodes.save(node)
         ctx = self._context(cluster)
         ctx.extra_vars["leaving_node"] = node.name
+        op = self.journal.open(cluster, "node-scale-down",
+                               phase=ClusterPhaseStatus.SCALING,
+                               vars={"node": node_name})
+        self.journal.attach(op, ctx)
+        node.status = "Draining"
+        self.repos.nodes.save(node)
         try:
             self.adm.run(ctx, scale_down_phases())
-        except PhaseError:
+        except PhaseError as e:
             node.status = "Failed"
             self.repos.nodes.save(node)
             cluster.status.phase = ClusterPhaseStatus.FAILED.value
             self.repos.clusters.save(cluster)
+            self.journal.close(op, ok=False, message=e.message)
             raise
         host = self.repos.hosts.get(node.host_id)
         host.cluster_id = ""
@@ -113,6 +125,7 @@ class NodeService:
         self.repos.nodes.delete(node.id)
         cluster.status.phase = ClusterPhaseStatus.READY.value
         self.repos.clusters.save(cluster)
+        self.journal.close(op, ok=True)
         self.events.emit(cluster.id, "Normal", "NodeRemoved",
                          f"node {node_name} drained and removed")
 

@@ -1,0 +1,177 @@
+"""ReconcileService — the boot-time sweep that makes controller death
+routine instead of an operator page.
+
+Lifecycle operations run on threads inside the service container; a
+`kill -9` (or OOM, or node loss) of the controller mid-create leaves the
+cluster stranded in an in-flight phase (`Deploying`/`Scaling`/...) with no
+thread behind it — before this PR, forever. The operation journal
+(resilience/journal.py) records what was in flight; this service runs at
+container start (service/container.py), when by construction NO operation
+thread can exist yet, so every open journal op and every in-flight cluster
+is an orphan:
+
+  1. every open (`Running`) journal op is marked `Interrupted`, preserving
+     the resume point (the cluster's first pending condition);
+  2. every cluster in an in-flight phase flips to `Failed` with the resume
+     point in its status message (pre-journal rows get a synthetic
+     interrupted op, so the journal history is complete going forward);
+  3. with `resilience.reconcile.auto_resume` on, interrupted operations
+     whose resume path is safe re-enter automatically: create-shaped ops
+     through `ClusterService.retry` (terraform re-apply reconciles the
+     fleet, the phase engine re-enters at the first non-OK condition) and
+     terminations through `ClusterService.delete`. Everything else
+     (upgrade, backup, day-2, components) stays Failed for the operator —
+     those verbs need their original arguments and human judgment.
+"""
+
+from __future__ import annotations
+
+from kubeoperator_tpu.models import OperationStatus
+from kubeoperator_tpu.models.cluster import ClusterPhaseStatus, ConditionStatus
+from kubeoperator_tpu.resilience import IN_FLIGHT_PHASES
+from kubeoperator_tpu.utils.logging import get_logger
+
+log = get_logger("service.reconcile")
+
+# interrupted op kinds that re-enter safely through the existing resume
+# paths: retry() for anything create-shaped, delete() for terminations
+AUTO_RESUME_RETRY = frozenset({"create", "slice-scale", "reprovision"})
+AUTO_RESUME_DELETE = frozenset({"terminate"})
+
+
+def resume_point(cluster) -> str:
+    """First pending OPERATION condition — the re-entry point a retry
+    uses. The watchdog's `health` degradation marker is observability,
+    not a phase: a Failed 'health' row must never masquerade as where an
+    interrupted operation stopped."""
+    from kubeoperator_tpu.service.watchdog import HEALTH_CONDITION
+
+    for cond in sorted(cluster.status.conditions,
+                       key=lambda c: c.order_index):
+        if cond.name == HEALTH_CONDITION:
+            continue
+        if cond.status != ConditionStatus.OK.value:
+            return cond.name
+    return ""
+
+
+class ReconcileService:
+    def __init__(self, services) -> None:
+        self.services = services
+
+    def boot_sweep(self) -> list[dict]:
+        """Sweep orphans; returns one record per reconciled cluster/op so
+        callers (container boot log, tests) can see what happened."""
+        cfg = self.services.config
+        if not cfg.get("resilience.reconcile.enabled", True):
+            return []
+        repos = self.services.repos
+        journal = self.services.clusters.journal
+        results: list[dict] = []
+
+        # 1. orphaned open ops — at boot, every open op is an orphan
+        open_ops = repos.operations.find(
+            status=OperationStatus.RUNNING.value)
+        swept_clusters: set[str] = set()
+        for op in open_ops:
+            cluster = None
+            try:
+                cluster = repos.clusters.get(op.cluster_id)
+            except Exception:
+                pass  # terminate op whose cluster row is already gone
+            resume = resume_point(cluster) if cluster else ""
+            journal.interrupt(
+                op, resume_phase=resume,
+                message=f"controller restart: {op.kind} was in flight"
+                + (f" (phase {op.phase})" if op.phase else ""),
+            )
+            results.append({
+                "cluster": op.cluster_name, "op": op.id, "kind": op.kind,
+                "resume_phase": op.resume_phase,
+            })
+            if cluster is not None:
+                swept_clusters.add(cluster.id)
+                self._strand(cluster, op.resume_phase)
+
+        # 2. in-flight clusters with NO open op (pre-journal rows, or a
+        # journal write that never landed): synthesize the interrupted op
+        # so the durable record still says what happened
+        for phase in sorted(IN_FLIGHT_PHASES):
+            for cluster in repos.clusters.find(phase=phase):
+                if cluster.id in swept_clusters:
+                    continue
+                resume = resume_point(cluster)
+                op = journal.open(cluster, "unknown")
+                journal.interrupt(
+                    op, resume_phase=resume,
+                    message=f"controller restart: cluster found {phase} "
+                            f"with no journaled operation",
+                )
+                self._strand(cluster, resume)
+                swept_clusters.add(cluster.id)
+                results.append({
+                    "cluster": cluster.name, "op": op.id, "kind": "unknown",
+                    "resume_phase": resume,
+                })
+
+        if results:
+            log.warning("boot reconcile: %d interrupted operation(s) swept",
+                        len(results))
+        if cfg.get("resilience.reconcile.auto_resume", False):
+            for record in results:
+                record["resumed"] = self._auto_resume(record)
+        return results
+
+    def _strand(self, cluster, resume_phase: str) -> None:
+        """Flip an orphaned in-flight cluster to Failed, resume point
+        preserved — the same resting state a phase failure leaves, so every
+        existing retry path applies unchanged."""
+        # Initializing counts when an op was open: a crash in the window
+        # between journal.open and the first phase flip must not leave a
+        # forever-Initializing row either
+        strandable = IN_FLIGHT_PHASES | {
+            ClusterPhaseStatus.INITIALIZING.value}
+        if cluster.status.phase not in strandable:
+            # day-2/backup op died on a Ready cluster: the journal records
+            # the interruption, the cluster needs no phase surgery
+            return
+        was = cluster.status.phase
+        cluster.status.phase = ClusterPhaseStatus.FAILED.value
+        cluster.status.message = (
+            f"operation interrupted by controller restart (was {was})"
+            + (f"; resume at phase {resume_phase!r}" if resume_phase else "")
+        )
+        self.services.repos.clusters.save(cluster)
+        self.services.events.emit(
+            cluster.id, "Warning", "OperationInterrupted",
+            f"cluster {cluster.name}: {cluster.status.message}",
+        )
+
+    def _auto_resume(self, record: dict) -> bool:
+        """Re-enter the existing resume path for one swept op (async — the
+        container finishes booting while resumes run). Failures surface as
+        events, never abort the boot."""
+        name, kind = record["cluster"], record["kind"]
+        try:
+            if kind in AUTO_RESUME_RETRY or (
+                kind == "unknown"
+                and self.services.clusters.get(name).plan_id
+            ):
+                self.services.clusters.retry(name, wait=False)
+            elif kind in AUTO_RESUME_DELETE:
+                self.services.clusters.delete(name, wait=False)
+            else:
+                return False
+        except Exception as e:
+            log.warning("auto-resume of %s on %s failed: %s", kind, name, e)
+            try:
+                cluster = self.services.repos.clusters.get_by_name(name)
+                self.services.events.emit(
+                    cluster.id, "Warning", "AutoResumeFailed",
+                    f"{kind} on {name}: {e}")
+            except Exception:
+                pass
+            return False
+        log.info("auto-resumed %s on %s after controller restart",
+                 kind, name)
+        return True

@@ -27,10 +27,13 @@ def parse_cis_result(lines: list[str]) -> dict | None:
 
 class CisService:
     def __init__(self, repos: Repositories, executor: Executor, events,
-                 retry_policy=None, retry_rng=None):
+                 retry_policy=None, retry_rng=None, journal=None):
         self.repos = repos
         self.events = events
         self.adm = ClusterAdm(executor, policy=retry_policy, rng=retry_rng)
+        from kubeoperator_tpu.resilience import default_journal
+
+        self.journal = default_journal(repos, journal)
 
     def run_scan(self, cluster_name: str) -> CisScan:
         cluster = self.repos.clusters.get_by_name(cluster_name)
@@ -64,13 +67,17 @@ class CisService:
 
         plan = self.repos.plans.get(cluster.plan_id) if cluster.plan_id else None
         ctx = AdmContext.for_cluster(self.repos, cluster, plan)
+        op = self.journal.open(cluster, "cis-scan")
+        self.journal.attach(op, ctx)
         try:
             self.adm.run(ctx, [Phase("cis-scan", "50-cis-scan.yml", post=post)])
         except PhaseError as e:
             scan.status = "Error"
             scan.message = e.message
             self.repos.cis_scans.save(scan)
+            self.journal.close(op, ok=False, message=e.message)
             raise
+        self.journal.close(op, ok=True)
         scan.status = scan.grade()
         self.repos.cis_scans.save(scan)
         if scan.status == "Failed":

@@ -20,10 +20,13 @@ def _minor(version: str) -> int:
 
 class UpgradeService:
     def __init__(self, repos: Repositories, executor: Executor, events,
-                 retry_policy=None, retry_rng=None):
+                 retry_policy=None, retry_rng=None, journal=None):
         self.repos = repos
         self.events = events
         self.adm = ClusterAdm(executor, policy=retry_policy, rng=retry_rng)
+        from kubeoperator_tpu.resilience import default_journal
+
+        self.journal = default_journal(repos, journal)
 
     def validate_hop(self, current: str, target: str) -> None:
         if target not in SUPPORTED_K8S_VERSIONS:
@@ -44,24 +47,33 @@ class UpgradeService:
         cluster = self.repos.clusters.get_by_name(cluster_name)
         cluster.require_managed("upgrade")
         self.validate_hop(cluster.spec.k8s_version, target_version)
-        cluster.status.phase = ClusterPhaseStatus.UPGRADING.value
-        self.repos.clusters.save(cluster)
+        # context built BEFORE the journal opens: nothing fallible may sit
+        # between the op/phase flip and the close-guaranteeing try below,
+        # or a plain exception strands an open op with a live controller
         ctx = AdmContext.for_cluster(
             self.repos, cluster,
             self.repos.plans.get(cluster.plan_id) if cluster.plan_id else None,
             {"target_k8s_version": target_version},
         )
+        # journal carries the target version, so an interrupted upgrade's
+        # resume (re-issuing the same upgrade) needs no out-of-band memory
+        op = self.journal.open(cluster, "upgrade",
+                               phase=ClusterPhaseStatus.UPGRADING,
+                               vars={"target_version": target_version})
+        self.journal.attach(op, ctx)
         try:
             self.adm.run(ctx, upgrade_phases())
         except PhaseError as e:
             cluster.status.phase = ClusterPhaseStatus.FAILED.value
             cluster.status.message = e.message
             self.repos.clusters.save(cluster)
+            self.journal.close(op, ok=False, message=e.message)
             self.events.emit(cluster.id, "Warning", "UpgradeFailed", e.message)
             raise
         cluster.spec.k8s_version = target_version
         cluster.status.phase = ClusterPhaseStatus.READY.value
         self.repos.clusters.save(cluster)
+        self.journal.close(op, ok=True)
         self.events.emit(cluster.id, "Normal", "UpgradeDone",
                          f"{cluster_name} upgraded to {target_version}")
         return cluster

@@ -1,0 +1,228 @@
+"""WatchdogService — cron health probes escalate to guided recovery under
+a per-cluster circuit breaker.
+
+Before this PR `CronService` probed every Ready cluster on a timer and
+could only log failures; `HealthService.recover` existed but was invoked
+exclusively by a human. The watchdog closes that loop: a failed probe is
+recorded as a cluster event AND a `health` status condition (the UI/API
+show degradation without grepping logs), then remediated automatically by
+re-running the probe's guided-recovery phase — bounded by the
+`CircuitBreaker` (resilience/watchdog.py) so a permanently-broken cluster
+escalates exactly once instead of generating a remediation storm.
+
+TPU-specific remediation: a failed `tpu-chips` probe (allocatable chips <
+plan topology — a preempted slice) first reconciles the machine fleet via
+terraform (`ClusterService.reprovision`) and then re-runs the tpu-runtime
+phase, because a preempted TPU VM needs a machine before a device plugin.
+
+Breaker state persists in the settings repo (`watchdog/<cluster_id>`
+rows), so budgets, flap streaks and open circuits survive controller
+restarts — consistent with the journal's crash-safety posture. An open
+circuit is closed only by `koctl watchdog reset`.
+"""
+
+from __future__ import annotations
+
+import time
+
+from kubeoperator_tpu.models import Setting
+from kubeoperator_tpu.models.cluster import ConditionStatus
+from kubeoperator_tpu.resilience.watchdog import (
+    CircuitBreaker,
+    WatchdogConfig,
+    new_state,
+)
+from kubeoperator_tpu.utils.logging import get_logger
+
+log = get_logger("service.watchdog")
+
+# the degradation marker condition the watchdog maintains on the cluster
+# status; dropped again once the cluster probes healthy, and excluded from
+# resume-point math (it is observability, not a phase)
+HEALTH_CONDITION = "health"
+
+
+class WatchdogService:
+    def __init__(self, repos, health, events, config, clusters=None,
+                 now=time.time) -> None:
+        self.repos = repos
+        self.health = health
+        self.events = events
+        self.clusters = clusters
+        self.cfg = WatchdogConfig.from_config(config)
+        self.now = now
+
+    # ---- breaker state persistence ----
+    def _setting_name(self, cluster_id: str) -> str:
+        return f"watchdog/{cluster_id}"
+
+    def _load(self, cluster_id: str) -> tuple[Setting, CircuitBreaker]:
+        name = self._setting_name(cluster_id)
+        try:
+            row = self.repos.settings.get_by_name(name)
+        except Exception:
+            row = Setting(name=name, vars=new_state())
+        state = new_state()
+        state.update(row.vars or {})
+        row.vars = state
+        return row, CircuitBreaker(self.cfg, state)
+
+    def _save(self, row: Setting) -> None:
+        self.repos.settings.save(row)
+
+    # ---- cron integration ----
+    def observe(self, cluster, report) -> list[str]:
+        """One watchdog pass over a fresh HealthReport (called from the
+        cron health tick). Returns action tags for the tick log."""
+        actions: list[str] = []
+        # re-fetch: the caller's snapshot predates the (slow) probes, and
+        # saving it back would clobber any phase/condition writes an
+        # operation thread made meanwhile (lost-update race). If an
+        # operation started mid-probe, its phases own the row now — the
+        # report is stale, skip this pass entirely.
+        cluster = self.repos.clusters.get(cluster.id)
+        if cluster.status.phase != "Ready":
+            return actions
+        row, breaker = self._load(cluster.id)
+        now = self.now()
+        if report.healthy:
+            self._clear_condition(cluster)
+            breaker.note_healthy(now)
+            self._save(row)
+            return actions
+
+        # degradation is durable state, not a log line: status condition +
+        # (already-emitted) HealthDegraded event
+        failed = [p for p in report.probes if not p.ok]
+        self._mark_condition(cluster, failed)
+        breaker.note_degraded(now)
+        if not self.cfg.enabled:
+            self._save(row)
+            return actions
+
+        allowed, why = breaker.admit(now)
+        if not allowed:
+            if breaker.is_open and not row.vars.get("escalated"):
+                # exactly ONE escalation per open circuit: the Warning
+                # event rides the message-center fan-out to admins
+                row.vars["escalated"] = True
+                self.events.emit(
+                    cluster.id, "Warning", "WatchdogCircuitOpen",
+                    f"watchdog circuit OPEN for {cluster.name}: "
+                    f"{breaker.state['opened_reason']}; automatic "
+                    f"remediation stopped — investigate, then "
+                    f"`koctl watchdog reset {cluster.name}`",
+                )
+                actions.append(f"watchdog-open:{cluster.name}")
+            self._save(row)
+            return actions
+
+        # remediate ONE failed probe per tick (serial remediation: fix one
+        # thing, let the next tick re-probe) — the first with an action
+        target = next((p for p in failed if p.recovery), None)
+        if target is None:
+            self._save(row)
+            return actions
+        ok = self._remediate(cluster, target)
+        breaker.record(now, ok)
+        self._save(row)
+        actions.append(
+            f"watchdog-remediate:{cluster.name}:{target.name}:"
+            f"{'ok' if ok else 'failed'}")
+        return actions
+
+    def note_check_error(self, cluster, error: str) -> None:
+        """A health check that RAISED (unreachable inventory, executor
+        outage) used to vanish into log.warning — record it durably."""
+        self.events.emit(cluster.id, "Warning", "HealthCheckError",
+                         f"health check failed for {cluster.name}: {error}")
+        # same stale-snapshot discipline as observe(): only mark a row no
+        # operation claimed while the failing check ran
+        cluster = self.repos.clusters.get(cluster.id)
+        if cluster.status.phase != "Ready":
+            return
+
+        class _Probe:
+            name = "health-check"
+            detail = error
+        self._mark_condition(cluster, [_Probe()])
+
+    # ---- remediation ----
+    def _remediate(self, cluster, probe) -> bool:
+        log.info("watchdog: remediating %s on %s", probe.name, cluster.name)
+        try:
+            if probe.name == "tpu-chips" and self.clusters is not None:
+                # preempted slice: machines first, device plugin second
+                self.clusters.reprovision(cluster.name)
+            self.health.recover(cluster.name, probe.name)
+            return True
+        except Exception as e:
+            self.events.emit(
+                cluster.id, "Warning", "WatchdogRemediationFailed",
+                f"automatic recovery of probe {probe.name} on "
+                f"{cluster.name} failed: {e}",
+            )
+            return False
+
+    # ---- status condition bookkeeping ----
+    def _mark_condition(self, cluster, failed_probes) -> None:
+        detail = ", ".join(
+            f"{p.name}" + (f" ({p.detail})" if p.detail else "")
+            for p in failed_probes
+        )
+        cluster.status.upsert_condition(
+            HEALTH_CONDITION, ConditionStatus.FAILED,
+            f"failed probes: {detail}"[:500],
+        )
+        self.repos.clusters.save(cluster)
+
+    def _clear_condition(self, cluster) -> None:
+        if cluster.status.condition(HEALTH_CONDITION) is not None:
+            cluster.status.reset_conditions([HEALTH_CONDITION])
+            self.repos.clusters.save(cluster)
+
+    # ---- operator surface ----
+    def status(self) -> list[dict]:
+        """Per-cluster circuit state for `koctl watchdog status` / the API:
+        budget left, cooldown, flap streak, open reason."""
+        now = self.now()
+        out: list[dict] = []
+        for cluster in self.repos.clusters.list():
+            if cluster.provision_mode == "imported":
+                continue
+            _row, breaker = self._load(cluster.id)
+            cond = cluster.status.condition(HEALTH_CONDITION)
+            out.append({
+                "cluster": cluster.name,
+                "phase": cluster.status.phase,
+                "circuit": breaker.state["state"],
+                "opened_reason": breaker.state["opened_reason"] or None,
+                "degraded": bool(
+                    cond is not None
+                    and cond.status == ConditionStatus.FAILED.value),
+                "budget": self.cfg.remediation_budget,
+                "budget_left": breaker.budget_left(now),
+                "cooldown_remaining_s": round(
+                    breaker.cooldown_remaining(now), 1),
+                "flaps": breaker.state["flaps"],
+                "last_remediation_ts": breaker.state["last_remediation_ts"]
+                or None,
+            })
+        return out
+
+    def reset(self, cluster_name: str) -> dict:
+        """Operator reset: close the circuit, zero the budget window and
+        flap streak. The ONLY way an open circuit closes — by design."""
+        cluster = self.repos.clusters.get_by_name(cluster_name)
+        row, breaker = self._load(cluster.id)
+        was_open = breaker.is_open
+        breaker.reset()
+        row.vars = breaker.state
+        self._save(row)
+        if was_open:
+            self.events.emit(
+                cluster.id, "Normal", "WatchdogCircuitReset",
+                f"watchdog circuit for {cluster_name} reset by operator",
+            )
+        return {"cluster": cluster_name, "circuit": breaker.state["state"],
+                "was_open": was_open}

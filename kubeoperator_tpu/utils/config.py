@@ -70,6 +70,27 @@ DEFAULTS: dict[str, Any] = {
         # wall-clock budget for one phase INCLUDING retries/backoff;
         # 0 = only the executor's own watch timeout applies
         "phase_deadline_s": 0,
+        # boot reconciler (service/reconcile.py): sweep clusters stranded
+        # in in-flight phases by a dead controller against the operation
+        # journal at container start
+        "reconcile": {
+            "enabled": True,
+            # re-enter the existing resume paths automatically (create/
+            # slice-scale -> retry, terminate -> delete); off = stranded
+            # clusters flip to Failed with the resume point preserved and
+            # wait for the operator
+            "auto_resume": False,
+        },
+    },
+    "watchdog": {
+        # escalate failed cron health probes to guided recovery under a
+        # per-cluster circuit breaker (service/watchdog.py,
+        # docs/resilience.md "Journal, reconciler, watchdog")
+        "enabled": True,
+        "remediation_budget": 3,   # remediations per window per cluster
+        "window_s": 3600,
+        "cooldown_s": 300,         # min gap between remediations
+        "flap_threshold": 3,       # degrade-after-successful-fix count
     },
     "chaos": {
         # seeded fault injection over the executor (resilience/chaos.py);
@@ -83,6 +104,10 @@ DEFAULTS: dict[str, Any] = {
         "slow_stream_rate": 0.0,
         "slow_stream_delay_s": 0.02,
         "max_injections": 0,
+        # one-shot controller-death crash point (playbook name): the
+        # submission of that playbook raises ControllerDeath through the
+        # whole stack — the kill-the-controller drill's trigger
+        "die_at_phase": "",
     },
     "registry": {
         # nexus-equivalent offline artifact registry (SURVEY.md §1 "Offline

@@ -583,6 +583,63 @@ class TestSubprocessTimeout:  # KO-P006
                             rel="executor/x.py") == []
 
 
+class TestPhaseWriteDiscipline:  # KO-P007
+    def test_fires_on_enum_inflight_write_outside_adm(self, tmp_path):
+        src = (
+            "from kubeoperator_tpu.models.cluster import ClusterPhaseStatus\n"
+            "def f(cluster):\n"
+            "    cluster.status.phase = ClusterPhaseStatus.DEPLOYING.value\n"
+        )
+        findings = ast_findings(tmp_path, src, "KO-P007",
+                                rel="service/x.py")
+        assert [f.rule for f in findings] == ["KO-P007"]
+        assert findings[0].severity == "error"
+        assert "DEPLOYING" in findings[0].message
+        assert "OperationJournal" in findings[0].message
+
+    def test_fires_on_string_literal_inflight_write(self, tmp_path):
+        src = (
+            "def f(cluster):\n"
+            "    cluster.status.phase = 'Terminating'\n"
+        )
+        findings = ast_findings(tmp_path, src, "KO-P007",
+                                rel="api/x.py")
+        assert [f.rule for f in findings] == ["KO-P007"]
+
+    def test_resting_phase_writes_are_quiet(self, tmp_path):
+        src = (
+            "from kubeoperator_tpu.models.cluster import ClusterPhaseStatus\n"
+            "def f(cluster):\n"
+            "    cluster.status.phase = ClusterPhaseStatus.READY.value\n"
+            "    cluster.status.phase = ClusterPhaseStatus.FAILED.value\n"
+            "    cluster.status.phase = 'Terminated'\n"
+        )
+        assert ast_findings(tmp_path, src, "KO-P007",
+                            rel="service/x.py") == []
+
+    def test_adm_and_journal_are_sanctioned_writers(self, tmp_path):
+        src = (
+            "from kubeoperator_tpu.models.cluster import ClusterPhaseStatus\n"
+            "def f(cluster):\n"
+            "    cluster.status.phase = ClusterPhaseStatus.SCALING.value\n"
+        )
+        assert ast_findings(tmp_path, src, "KO-P007",
+                            rel="adm/engine.py") == []
+        assert ast_findings(tmp_path, src, "KO-P007",
+                            rel="resilience/journal.py") == []
+
+    def test_reads_and_comparisons_are_quiet(self, tmp_path):
+        src = (
+            "def f(cluster, repos):\n"
+            "    if cluster.status.phase == 'Deploying':\n"
+            "        return repos.clusters.find(phase='Scaling')\n"
+            "    was = cluster.status.phase\n"
+            "    return was\n"
+        )
+        assert ast_findings(tmp_path, src, "KO-P007",
+                            rel="service/x.py") == []
+
+
 # ------------------------------------------------------------ report model --
 class TestReport:
     def test_unknown_rule_id_rejected(self):

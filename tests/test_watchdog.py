@@ -1,0 +1,336 @@
+"""Watchdog: circuit-breaker math, cron escalation to guided recovery,
+degradation events/conditions, flap detection, TPU slice remediation
+(ISSUE 3 tentpole piece 3 + satellite 1).
+"""
+
+import random
+
+import pytest
+
+from kubeoperator_tpu.executor import FakeExecutor
+from kubeoperator_tpu.models import ClusterSpec
+from kubeoperator_tpu.resilience import (
+    CIRCUIT_OPEN,
+    ChaosConfig,
+    ChaosExecutor,
+    CircuitBreaker,
+    WatchdogConfig,
+)
+from kubeoperator_tpu.resilience.watchdog import new_state
+from kubeoperator_tpu.service import build_services
+from kubeoperator_tpu.utils.config import load_config
+
+from tests.test_reconcile import register_fleet
+
+
+def stack(tmp_path, watchdog=None, health_interval=300):
+    config = load_config(path="/nonexistent", env={}, overrides={
+        "db": {"path": str(tmp_path / "wd.db")},
+        "logging": {"level": "ERROR"},
+        "executor": {"backend": "fake"},
+        "provisioner": {"work_dir": str(tmp_path / "tf")},
+        "cron": {"backup_enabled": False, "event_sync_interval_s": 0,
+                 "health_check_interval_s": health_interval},
+        "cluster": {"kubeconfig_dir": str(tmp_path / "kc")},
+        "watchdog": {"cooldown_s": 0, "window_s": 3600,
+                     **(watchdog or {})},
+    })
+    return build_services(config, simulate=True)
+
+
+def ready_cluster(svc, name="wd"):
+    names = register_fleet(svc, 2)
+    svc.clusters.create(name, spec=ClusterSpec(worker_count=1),
+                        host_names=names, wait=True)
+    return svc.clusters.get(name)
+
+
+def tick_health(svc):
+    """One cron health pass (interval satisfied by resetting the stamp)."""
+    svc.cron._health_last = 0.0
+    return svc.cron.tick()
+
+
+# ----------------------------------------------------------- breaker math ---
+class TestCircuitBreaker:
+    def cb(self, **kw):
+        return CircuitBreaker(WatchdogConfig(**kw), new_state())
+
+    def test_budget_exhaustion_trips_exactly_at_the_limit(self):
+        cb = self.cb(remediation_budget=3, cooldown_s=0)
+        for t in (10.0, 20.0, 30.0):
+            allowed, _ = cb.admit(t)
+            assert allowed
+            cb.record(t, ok=False)
+        allowed, why = cb.admit(40.0)
+        assert not allowed and why == "circuit open"
+        assert cb.is_open and "budget exhausted" in cb.state["opened_reason"]
+
+    def test_budget_window_slides(self):
+        cb = self.cb(remediation_budget=2, window_s=100.0, cooldown_s=0)
+        cb.record(0.0, ok=False)
+        cb.record(10.0, ok=False)
+        assert not cb.admit(50.0)[0]          # window full -> opens? no:
+        # exhausting the budget trips the breaker; reset and verify a
+        # fresh breaker admits once the window slid past the old entries
+        cb2 = self.cb(remediation_budget=2, window_s=100.0, cooldown_s=0)
+        cb2.record(0.0, ok=False)
+        cb2.record(10.0, ok=False)
+        assert cb2.admit(120.0)[0]            # both outside the window now
+
+    def test_cooldown_blocks_without_tripping(self):
+        cb = self.cb(remediation_budget=5, cooldown_s=60.0)
+        assert cb.admit(0.0)[0]
+        cb.record(0.0, ok=True)
+        allowed, why = cb.admit(30.0)
+        assert not allowed and why == "cooldown"
+        assert not cb.is_open
+        assert cb.admit(61.0)[0]
+
+    def test_flap_detection_opens_circuit(self):
+        cb = self.cb(flap_threshold=2, cooldown_s=0)
+        for t in (0.0, 100.0):
+            assert cb.admit(t)[0]
+            cb.record(t, ok=True)             # remediation "succeeds"
+            cb.note_degraded(t + 50.0)        # ...but degrades right back
+        cb.admit(250.0)
+        assert cb.is_open and "flap" in cb.state["opened_reason"]
+
+    def test_healthy_window_clears_flap_streak(self):
+        cb = self.cb(flap_threshold=2, window_s=100.0, cooldown_s=0)
+        cb.record(0.0, ok=True)
+        cb.note_degraded(10.0)
+        assert cb.state["flaps"] == 1
+        cb.note_healthy(200.0)                # full window of quiet
+        assert cb.state["flaps"] == 0
+
+    def test_reset_closes_and_zeroes(self):
+        cb = self.cb(remediation_budget=1, cooldown_s=0)
+        cb.record(0.0, ok=False)
+        cb.admit(1.0)
+        assert cb.is_open
+        cb.reset()
+        assert not cb.is_open and cb.state["remediations"] == []
+        assert cb.admit(2.0)[0]
+
+
+# -------------------------------------------------- degradation recording ---
+class TestDegradationRecording:
+    def test_failed_probe_lands_event_and_condition_then_clears(
+            self, tmp_path):
+        svc = stack(tmp_path)
+        try:
+            cluster = ready_cluster(svc)
+            fake = svc.executor
+            fake.script("adhoc:command", success=False)
+            actions = tick_health(svc)
+            assert any(a.startswith("watchdog-remediate:wd") for a in actions)
+            cluster = svc.clusters.get("wd")
+            cond = cluster.status.condition("health")
+            assert cond is not None and cond.status == "Failed"
+            assert "apiserver" in cond.message
+            reasons = [e.reason for e in svc.events.list(cluster.id)]
+            assert "HealthDegraded" in reasons
+            # probes heal -> the degradation marker is dropped again
+            fake.script("adhoc:command", success=True)
+            tick_health(svc)
+            assert svc.clusters.get("wd").status.condition("health") is None
+        finally:
+            svc.close()
+
+    def test_check_exception_is_recorded_not_swallowed(self, tmp_path):
+        svc = stack(tmp_path)
+        try:
+            cluster = ready_cluster(svc)
+
+            def boom(name):
+                raise RuntimeError("inventory exploded")
+
+            svc.health.check = boom
+            tick_health(svc)
+            cluster = svc.clusters.get("wd")
+            reasons = [e.reason for e in svc.events.list(cluster.id)]
+            assert "HealthCheckError" in reasons
+            cond = cluster.status.condition("health")
+            assert cond is not None and "inventory exploded" in cond.message
+        finally:
+            svc.close()
+
+
+# ------------------------------------------------------- watchdog drills ----
+class TestWatchdogDrills:
+    def test_seeded_chaos_degradation_converges_back_to_healthy(
+            self, tmp_path):
+        """Acceptance drill 1: a seeded chaos fault degrades a Ready
+        cluster; the watchdog remediates via guided recovery and the next
+        tick converges back to healthy."""
+        svc = stack(tmp_path)
+        try:
+            cluster = ready_cluster(svc)
+            # wrap the stack's executor in seeded chaos AFTER create so the
+            # deploy itself is clean; one unreachable adhoc = one failed
+            # probe on the next health tick
+            chaos = ChaosExecutor(svc.executor, rng=random.Random(7),
+                                  config=ChaosConfig())
+            chaos.fail_times("adhoc:command", 1, kind="unreachable")
+            svc.health.executor = chaos
+            svc.executor = chaos
+
+            actions = tick_health(svc)
+            assert any("watchdog-remediate:wd:apiserver:ok" in a
+                       for a in actions)
+            cluster = svc.clusters.get("wd")
+            assert cluster.status.condition("health").status == "Failed"
+            reasons = [e.reason for e in svc.events.list(cluster.id)]
+            assert "Recovered" in reasons          # guided recovery ran
+            # remediation is journaled like any other operation
+            kinds = [o.kind for o in svc.journal.history(cluster.id)]
+            assert "recovery" in kinds
+
+            tick_health(svc)                       # chaos queue drained
+            cluster = svc.clusters.get("wd")
+            assert cluster.status.condition("health") is None
+            row = next(r for r in svc.watchdog.status()
+                       if r["cluster"] == "wd")
+            assert row["circuit"] == "closed" and not row["degraded"]
+        finally:
+            svc.close()
+
+    def test_permanent_failure_opens_circuit_with_one_escalation(
+            self, tmp_path):
+        """Acceptance drill 2: a permanently-failing probe opens the
+        circuit within the budget — no remediation storm, exactly one
+        escalation event — and `reset` closes it again."""
+        svc = stack(tmp_path, watchdog={"remediation_budget": 2})
+        try:
+            cluster = ready_cluster(svc)
+            svc.executor.script("adhoc:command", success=False)
+            remediations = 0
+            for _ in range(6):                     # well past the budget
+                actions = tick_health(svc)
+                remediations += sum(
+                    1 for a in actions if "watchdog-remediate" in a)
+            assert remediations == 2               # the budget, exactly
+            row = next(r for r in svc.watchdog.status()
+                       if r["cluster"] == "wd")
+            assert row["circuit"] == CIRCUIT_OPEN
+            assert row["budget_left"] == 0
+            escalations = [e for e in svc.events.list(cluster.id)
+                           if e.reason == "WatchdogCircuitOpen"]
+            assert len(escalations) == 1           # exactly one, ever
+            # escalation reached the message center (admin notify fan-out)
+            admins = [u for u in svc.repos.users.list() if u.is_admin]
+            if admins:
+                inbox = svc.messages.inbox(admins[0].id)
+                assert any("WatchdogCircuitOpen" in m.title for m in inbox)
+
+            result = svc.watchdog.reset("wd")
+            assert result["was_open"] is True
+            row = next(r for r in svc.watchdog.status()
+                       if r["cluster"] == "wd")
+            assert row["circuit"] == "closed"
+            assert row["budget_left"] == 2
+        finally:
+            svc.close()
+
+    def test_breaker_state_survives_controller_restart(self, tmp_path):
+        svc = stack(tmp_path, watchdog={"remediation_budget": 1})
+        try:
+            ready_cluster(svc)
+            svc.executor.script("adhoc:command", success=False)
+            for _ in range(3):
+                tick_health(svc)
+            assert next(r for r in svc.watchdog.status()
+                        if r["cluster"] == "wd")["circuit"] == CIRCUIT_OPEN
+        finally:
+            svc.close()
+        svc2 = stack(tmp_path, watchdog={"remediation_budget": 1})
+        try:
+            row = next(r for r in svc2.watchdog.status()
+                       if r["cluster"] == "wd")
+            assert row["circuit"] == CIRCUIT_OPEN   # persisted, not reset
+        finally:
+            svc2.close()
+
+    def test_watchdog_disabled_records_but_never_remediates(self, tmp_path):
+        svc = stack(tmp_path, watchdog={"enabled": False})
+        try:
+            cluster = ready_cluster(svc)
+            svc.executor.script("adhoc:command", success=False)
+            actions = tick_health(svc)
+            assert not any("watchdog-remediate" in a for a in actions)
+            # degradation is still recorded (satellite 1)
+            assert svc.clusters.get("wd").status.condition("health") \
+                .status == "Failed"
+        finally:
+            svc.close()
+
+
+# ------------------------------------------------------- TPU slice probe ----
+class TestTpuSliceWatch:
+    def test_chip_shortfall_fails_probe_and_compound_remediation(
+            self, tmp_path, monkeypatch):
+        """A v5e-16 plan promises 16 chips; the probe seeing fewer fails
+        as tpu-chips, and the watchdog's remediation reprovisions the
+        fleet BEFORE re-running the tpu-runtime phase."""
+        from kubeoperator_tpu.adm.phases import SMOKE_MARKER
+
+        from tests.test_reconcile import seed_tpu_plan
+
+        svc = stack(tmp_path)
+        try:
+            seed_tpu_plan(svc)
+            svc.executor.script("17-tpu-smoke-test.yml", lines=[
+                f'{SMOKE_MARKER} {{"gbps": 84.0, "chips": 16}}'])
+            svc.clusters.create("tpu", provision_mode="plan",
+                                plan_name="tpu-v5e-16", wait=True)
+            assert svc.clusters.get("tpu").status.phase == "Ready"
+            # adhoc output: 2 allocatable chips across the fleet (< 16)
+            svc.executor.script("adhoc:command", lines=["2"])
+            report = svc.health.check("tpu")
+            probe = next(p for p in report.probes if p.name == "tpu-chips")
+            assert not probe.ok and "2/16" in probe.detail
+
+            calls = []
+            monkeypatch.setattr(
+                svc.clusters, "reprovision",
+                lambda name: calls.append(("reprovision", name)))
+            monkeypatch.setattr(
+                svc.health, "recover",
+                lambda name, probe_name: calls.append(("recover",
+                                                       probe_name)))
+            tick_health(svc)
+            assert ("reprovision", "tpu") in calls
+            assert ("recover", "tpu-chips") in calls
+            assert calls.index(("reprovision", "tpu")) < \
+                calls.index(("recover", "tpu-chips"))
+        finally:
+            svc.close()
+
+    def test_unknown_chip_count_stays_healthy(self, tmp_path):
+        """Simulation/fake backends surface no per-node numbers: unknown
+        must never read as 0 chips and trigger phantom remediation."""
+        from kubeoperator_tpu.adm.phases import SMOKE_MARKER
+
+        from tests.test_reconcile import seed_tpu_plan
+
+        svc = stack(tmp_path)
+        try:
+            seed_tpu_plan(svc)
+            svc.executor.script("17-tpu-smoke-test.yml", lines=[
+                f'{SMOKE_MARKER} {{"gbps": 84.0, "chips": 16}}'])
+            svc.clusters.create("tpu2", provision_mode="plan",
+                                plan_name="tpu-v5e-16", wait=True)
+            report = svc.health.check("tpu2")
+            probe = next(p for p in report.probes if p.name == "tpu-chips")
+            assert probe.ok and "unavailable" in probe.detail
+        finally:
+            svc.close()
+
+    def test_parse_chip_count(self):
+        from kubeoperator_tpu.service.health import parse_chip_count
+
+        assert parse_chip_count(["4", "4", "4", "4"]) == 16
+        assert parse_chip_count(["ADHOC [command] x", "8", ""]) == 8
+        assert parse_chip_count(["h | SUCCESS => {}", "no digits"]) is None
+        assert parse_chip_count([]) is None
