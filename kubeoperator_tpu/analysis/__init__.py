@@ -1,13 +1,22 @@
 """ko-analyze — static analysis over the platform's artifacts and code.
 
-Two engines, one report:
+The v2 engine, four rule layers over one report:
 
 * `artifacts` — cross-artifact linter resolving every reference between
   playbooks, roles, templates, the offline bundle contract, SQL
-  migrations, and TPU plan topology (rules KO-X001..KO-X008).
-* `astcheck` — project-rule AST checker over the python package itself
-  (rules KO-P001..KO-P005: repository layering, non-blocking handlers,
-  lock discipline, mutable defaults, bare excepts).
+  migrations, and TPU plan topology (KO-X001..KO-X008).
+* `astcheck` — per-file project AST rules (KO-P001..KO-P007; KO-P003
+  retired in favour of KO-P008).
+* `flow` — project-wide dataflow rules over the symbol index: guarded-by
+  inference (KO-P008) and exception-flow discipline (KO-P009).
+* `contracts` — cross-layer contract rules over the same index: the
+  config-key contract (KO-X009) and REST/CLI surface parity (KO-X010).
+
+`index.py` is the substrate: each package python file is parsed once per
+run, reduced to serializable facts, and cached by content hash so a warm
+`koctl lint` re-parses only what changed — that is how the gate stays
+inside its 5 s budget as rules multiply. `sarif.py` adds SARIF 2.1.0
+output and the checked-in waiver/baseline file.
 
 `run_analysis()` is the single entry point `koctl lint`, the
 `/api/v1/analysis` endpoint, and the tier-1 static gate
@@ -17,11 +26,26 @@ rule id and how to add one.
 
 from __future__ import annotations
 
+import ast
 import os
 import time
 
 from kubeoperator_tpu.analysis.artifacts import ARTIFACT_RULES, AnalysisContext
-from kubeoperator_tpu.analysis.astcheck import AST_RULES, run_ast_rules
+from kubeoperator_tpu.analysis.astcheck import AST_RULES
+from kubeoperator_tpu.analysis.contracts import (
+    check_config_contract,
+    check_surface_parity,
+)
+from kubeoperator_tpu.analysis.flow import check_exception_flow, check_guarded_by
+from kubeoperator_tpu.analysis.index import (
+    AnalysisCache,
+    FileFacts,
+    ProjectIndex,
+    extract_file_facts,
+    file_sha,
+    iter_python_files,
+    tree_sha,
+)
 from kubeoperator_tpu.analysis.report import (
     ERROR,
     RULES,
@@ -30,11 +54,23 @@ from kubeoperator_tpu.analysis.report import (
     Report,
     RuleSpec,
 )
+from kubeoperator_tpu.analysis.sarif import (
+    apply_waivers,
+    load_waivers,
+    to_sarif,
+    to_sarif_json,
+)
 
 __all__ = [
     "ERROR", "WARNING", "Finding", "Report", "RuleSpec", "RULES",
-    "default_root", "run_analysis",
+    "default_root", "run_analysis", "to_sarif", "to_sarif_json",
 ]
+
+# project-wide rules that consume the index rather than one file's tree
+FLOW_PROJECT_RULES = ("KO-P008",)
+CONTRACT_RULES = ("KO-X009", "KO-X010")
+# per-file flow rules cached alongside the astcheck per-file rules
+PER_FILE_FLOW_RULES = ("KO-P009",)
 
 
 def default_root() -> str:
@@ -43,31 +79,166 @@ def default_root() -> str:
     return os.path.dirname(os.path.abspath(__file__)).rsplit(os.sep, 1)[0]
 
 
+def default_waivers_path(root: str) -> str:
+    return os.path.join(root, "analysis", "waivers.yaml")
+
+
+def _run_artifact_rules(report: Report, root: str, plan_files: tuple,
+                        selected: set, cache: AnalysisCache | None,
+                        changed: set | None = None,
+                        git_head: str = "") -> None:
+    chosen = [rid for rid in ARTIFACT_RULES if rid in selected]
+    if not chosen:
+        return
+    full_set = len(chosen) == len(ARTIFACT_RULES)
+    entry = None
+    t_sha = ""
+    if cache is not None and full_set:
+        # --changed fast path around the whole-tree hash, taken only when
+        # the cache's recorded git state can vouch for it (same HEAD,
+        # clean-at-save, clean-now, no plan files then or now)
+        if changed is not None and not plan_files:
+            entry = cache.artifact_fast_entry(git_head, changed, root)
+        if entry is None:
+            t_sha = tree_sha(root)
+            for pf in plan_files:
+                t_sha += file_sha(pf) if os.path.exists(pf) else "<missing>"
+            entry = cache.artifact_lookup(t_sha)
+    if entry is not None:
+        for rid in chosen:
+            report.extend([Finding.from_dict(d)
+                           for d in entry["findings"].get(rid, [])])
+            report.rules_run.append(rid)
+        report.files_scanned += entry.get("files_scanned", 0)
+        report.cache_hits += 1
+        return
+    ctx = AnalysisContext(root=root, plan_files=tuple(plan_files))
+    by_rule: dict = {}
+    for rid in chosen:
+        findings = ARTIFACT_RULES[rid](ctx)
+        by_rule[rid] = [f.to_dict() for f in findings]
+        report.extend(findings)
+        report.rules_run.append(rid)
+    report.files_scanned += ctx.files_scanned
+    if cache is not None and full_set:
+        cache.artifact_store(t_sha, by_rule, ctx.files_scanned,
+                             plans=plan_files)
+
+
+def _per_file_rules(selected: set) -> dict:
+    """rule id -> (root, tree, path, source) -> findings, for every
+    selected per-file rule (astcheck + per-file flow)."""
+    rules: dict = {}
+    for rid, fn in AST_RULES.items():
+        if rid in selected:
+            rules[rid] = (lambda root, tree, path, source, _fn=fn:
+                          _fn(root, tree, path))
+    if "KO-P009" in selected:
+        rules["KO-P009"] = (
+            lambda root, tree, path, source:
+            check_exception_flow(root, tree, path, source))
+    return rules
+
+
+def _run_python_rules(report: Report, root: str, selected: set,
+                      cache: AnalysisCache | None,
+                      changed: set | None) -> ProjectIndex:
+    """One walk serves the per-file rules AND builds the project index.
+    A syntactically broken file raises — the gate must hard-fail (exit 2),
+    not report it as a lint finding a --format json consumer might filter
+    away."""
+    per_file = _per_file_rules(selected)
+    index = ProjectIndex(root=root)
+    parent = os.path.dirname(root) or "."
+    live_rels: set = set()
+    for path in iter_python_files(root):
+        rel = os.path.relpath(path, parent)
+        live_rels.add(rel)
+        report.files_scanned += 1
+        entry = None
+        if cache is not None:
+            entry = cache.lookup(rel, file_sha(path))
+            if entry is not None and \
+                    not set(per_file) <= set(entry["findings"]):
+                entry = None    # cached run covered fewer rules
+        if entry is not None:
+            index.files[rel] = FileFacts.from_dict(entry["facts"])
+            for rid in per_file:
+                report.extend([Finding.from_dict(d)
+                               for d in entry["findings"][rid]])
+            continue
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+        tree = ast.parse(source, filename=path)
+        facts = extract_file_facts(tree, rel)
+        index.files[rel] = facts
+        findings_by_rule: dict = {}
+        for rid, fn in per_file.items():
+            findings = fn(root, tree, path, source)
+            findings_by_rule[rid] = [f.to_dict() for f in findings]
+            report.extend(findings)
+        if cache is not None:
+            cache.store(rel, file_sha(path), facts, findings_by_rule)
+    if cache is not None and changed is None:
+        cache.prune(live_rels)
+    report.rules_run.extend(sorted(per_file))
+    return index
+
+
 def run_analysis(root: str | None = None, plan_files=(),
-                 rule_ids=None) -> Report:
+                 rule_ids=None, *, cache_dir: str | None = None,
+                 changed: set | None = None, git_head: str = "",
+                 waivers_path: str | None = None) -> Report:
     """Run the selected rules (default: all registered) over `root`.
+
+    `cache_dir` enables the content-hash incremental cache (koctl lint
+    passes its default; the tier-1 gate runs cold on purpose so the
+    recorded budget stays honest). Every python file is always verified
+    by content hash — cheap, and 'git status clean' cannot prove cache
+    freshness. `changed` + `git_head` (`koctl lint --changed`) let the
+    cache skip the whole-tree artifact hash when the recorded git state
+    vouches for it. Waivers load from `analysis/waivers.yaml` under the
+    root unless overridden.
 
     Internal analyzer failures propagate as exceptions — the CLI maps them
     to exit code 2; a gate must never mistake a crashed analyzer for a
     clean tree.
     """
     root = os.path.abspath(root or default_root())
+    selected = set(RULES) if rule_ids is None else set(rule_ids)
     start = time.perf_counter()
-    ctx = AnalysisContext(root=root, plan_files=tuple(plan_files))
+    cache = AnalysisCache(cache_dir, root) if cache_dir else None
     report = Report(root=root)
-    for rule_id, rule_fn in ARTIFACT_RULES.items():
-        if rule_ids is not None and rule_id not in rule_ids:
-            continue
-        report.extend(rule_fn(ctx))
-        report.rules_run.append(rule_id)
-    ast_selected = [
-        rid for rid in AST_RULES if rule_ids is None or rid in rule_ids
+
+    _run_artifact_rules(report, root, tuple(plan_files), selected, cache,
+                        changed, git_head)
+    index = _run_python_rules(report, root, selected, cache, changed)
+
+    if "KO-P008" in selected:
+        report.extend(check_guarded_by(index))
+        report.rules_run.append("KO-P008")
+    if "KO-X009" in selected:
+        report.extend(check_config_contract(index))
+        report.rules_run.append("KO-X009")
+    if "KO-X010" in selected:
+        report.extend(check_surface_parity(index))
+        report.rules_run.append("KO-X010")
+
+    waivers = load_waivers(waivers_path or default_waivers_path(root))
+    report.findings, unused = apply_waivers(report.findings, waivers)
+    # a waiver is stale only if the rule it baselines actually RAN and
+    # still produced nothing it matches — a --rules subset must not flag
+    # every other rule's waivers
+    report.unused_waivers = [
+        f"{w.rule} file={w.file or '*'} contains={w.contains or '*'}"
+        for w in unused if w.rule in selected
     ]
-    if ast_selected:
-        findings, scanned = run_ast_rules(root, set(ast_selected))
-        report.extend(findings)
-        report.rules_run.extend(ast_selected)
-        report.files_scanned += scanned
-    report.files_scanned += ctx.files_scanned
+
+    if cache is not None:
+        report.cache_hits += cache.hits
+        report.cache_misses += cache.misses
+        cache.record_git_state(
+            git_head if changed is not None else "", changed or set(), root)
+        cache.save()
     report.runtime_s = time.perf_counter() - start
     return report

@@ -2,20 +2,22 @@
 
 These are not style rules — each encodes a correctness invariant this
 codebase relies on and has been bitten by elsewhere: the repository layer
-owns the database handle, request handlers never block the event loop, and
-a field protected by a lock in one method is protected everywhere (the
-lock-discipline rule is a lightweight write-write race detector aimed at
-executor/base.py, terminal/manager.py and the api layer's shared state).
+owns the database handle, request handlers never block the event loop,
+child processes are deadlined, and in-flight phase flips ride the journal.
+(Lock discipline moved to the project-wide guarded-by engine, KO-P008 in
+flow.py; exception-flow discipline is KO-P009 there too.)
 
-Every rule is a pure function (root_dir) -> list[Finding]; the scanner
-parses each file once and hands the same tree to all selected rules.
+Every rule is a pure function (root, tree, path) -> list[Finding]; the
+scanner parses each file once and hands the same tree to all selected
+rules — in the v2 engine that shared parse happens in run_analysis's
+index walk (analysis/index.py), with run_ast_rules kept as the
+fixture-test entry point.
 """
 
 from __future__ import annotations
 
 import ast
 import os
-import re
 
 from kubeoperator_tpu.analysis.report import Finding
 
@@ -129,127 +131,10 @@ def check_blocking_handlers(root: str, tree: ast.AST, path: str) -> list:
     return findings
 
 
-# ---------------------------------------------------------------- KO-P003 ---
-_LOCK_FACTORIES = {"Lock", "RLock", "Condition", "Semaphore",
-                   "BoundedSemaphore"}
-
-
-def _self_attr(node) -> str | None:
-    if isinstance(node, ast.Attribute) and \
-            isinstance(node.value, ast.Name) and node.value.id == "self":
-        return node.attr
-    return None
-
-
-# `_lock`, `lock`, `_ops_lock`, `write_lock`, ... — NOT `lock_timeout`
-_LOCK_NAME_RE = re.compile(r"^_?(?:[a-z0-9_]+_)?lock$")
-
-
-def _lock_attrs_of_class(cls: ast.ClassDef) -> set:
-    """Attributes assigned a threading lock/condition anywhere in the
-    class, plus lock-NAMED attributes regardless of what they're assigned
-    (`self._lock = lock` injection / aliasing would otherwise exempt the
-    whole class from the race detector)."""
-    locks: set = set()
-    for node in ast.walk(cls):
-        if not isinstance(node, ast.Assign):
-            continue
-        factory = ""
-        if isinstance(node.value, ast.Call):
-            func = node.value.func
-            factory = (func.attr if isinstance(func, ast.Attribute)
-                       else func.id if isinstance(func, ast.Name) else "")
-        for target in node.targets:
-            attr = _self_attr(target)
-            if attr and (factory in _LOCK_FACTORIES
-                         or _LOCK_NAME_RE.match(attr)):
-                locks.add(attr)
-    return locks
-
-
-class _LockWriteScanner(ast.NodeVisitor):
-    """Record self-attribute writes, split by whether a `with self.<lock>`
-    is lexically held. Nested function defs are skipped: a closure runs on
-    whatever thread calls it, so its writes can't be attributed here."""
-
-    def __init__(self, lock_attrs: set) -> None:
-        self.lock_attrs = lock_attrs
-        self.held = 0
-        self.inside: dict = {}
-        self.outside: dict = {}
-
-    def visit_FunctionDef(self, node):  # noqa: N802
-        pass
-
-    def visit_AsyncFunctionDef(self, node):  # noqa: N802
-        pass
-
-    def visit_With(self, node):  # noqa: N802
-        holds = any(
-            _self_attr(item.context_expr) in self.lock_attrs
-            for item in node.items
-        )
-        if holds:
-            self.held += 1
-        self.generic_visit(node)
-        if holds:
-            self.held -= 1
-
-    def _record(self, target, lineno: int) -> None:
-        attr = _self_attr(target)
-        if attr and attr not in self.lock_attrs:
-            bucket = self.inside if self.held else self.outside
-            bucket.setdefault(attr, []).append(lineno)
-
-    def visit_Assign(self, node):  # noqa: N802
-        for target in node.targets:
-            self._record(target, node.lineno)
-        self.generic_visit(node)
-
-    def visit_AugAssign(self, node):  # noqa: N802
-        self._record(node.target, node.lineno)
-        self.generic_visit(node)
-
-
-def check_lock_discipline(root: str, tree: ast.AST, path: str) -> list:
-    """Flag fields written both under a held lock and bare. Exemptions by
-    convention: __init__ (no concurrency before construction completes)
-    and *_locked methods (documented as called with the lock held)."""
-    findings: list = []
-    rel = _rel(root, path)
-    for cls in ast.walk(tree):
-        if not isinstance(cls, ast.ClassDef):
-            continue
-        lock_attrs = _lock_attrs_of_class(cls)
-        if not lock_attrs:
-            continue
-        inside: dict = {}
-        outside: dict = {}
-        for method in cls.body:
-            if not isinstance(method, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                continue
-            if method.name == "__init__" or method.name.endswith("_locked"):
-                continue
-            scanner = _LockWriteScanner(lock_attrs)
-            for stmt in method.body:
-                scanner.visit(stmt)
-            for attr, lines in scanner.inside.items():
-                inside.setdefault(attr, []).extend(
-                    (method.name, ln) for ln in lines)
-            for attr, lines in scanner.outside.items():
-                outside.setdefault(attr, []).extend(
-                    (method.name, ln) for ln in lines)
-        for attr in sorted(set(inside) & set(outside)):
-            locked_at = ", ".join(
-                f"{m}:{ln}" for m, ln in sorted(inside[attr])[:3])
-            bare_method, bare_line = sorted(outside[attr])[0]
-            findings.append(Finding(
-                "KO-P003", rel, bare_line,
-                f"{cls.name}.{attr} is written under "
-                f"{'/'.join(sorted(lock_attrs))} ({locked_at}) but bare in "
-                f"{bare_method}() — a write-write race",
-            ))
-    return findings
+# KO-P003 (single-file lock-discipline) retired: superseded by the
+# project-wide guarded-by inference KO-P008 in flow.py, which propagates
+# lock-held context through self-calls and inheritance instead of only
+# reading the lexical `with` nesting of one method at a time.
 
 
 # ---------------------------------------------------------------- KO-P004 ---
@@ -426,7 +311,6 @@ def check_phase_write_discipline(root: str, tree: ast.AST, path: str) -> list:
 AST_RULES = {
     "KO-P001": check_repo_layering,
     "KO-P002": check_blocking_handlers,
-    "KO-P003": check_lock_discipline,
     "KO-P004": check_mutable_defaults,
     "KO-P005": check_bare_except,
     "KO-P006": check_subprocess_timeouts,

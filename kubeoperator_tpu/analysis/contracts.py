@@ -1,0 +1,300 @@
+"""Contract rules over the project index: the code-side agreements that
+grew in PRs 2-3 and that no per-file syntactic pass can see.
+
+* KO-X009 (config-key contract): the `utils/config.py DEFAULTS` tree is
+  the single declaration of the process config surface. Three directions
+  must agree: every literal `config.get("a.b.c")` in the package resolves
+  in DEFAULTS (a typo'd key silently reads its fallback forever); every
+  DEFAULTS leaf is read somewhere (a dead key documents a knob that does
+  nothing); every dotted key a docs knob table names exists in DEFAULTS,
+  and the resilience/chaos/watchdog blocks are fully documented.
+
+* KO-X010 (surface parity): the platform deliberately ships parallel
+  surfaces — REST routes in api/server.py, the koctl CLI's REST calls,
+  and koctl --local's in-process dispatch. Every koctl call must resolve
+  to a registered route AND a local dispatch case (same commands, two
+  transports), every local dispatch case must shadow a real route, and
+  every top-level koctl command must be documented.
+
+Both rules take injectable parameters so tests can aim them at fixture
+indexes without touching the installed package's contracts.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+from kubeoperator_tpu.analysis.index import ProjectIndex
+from kubeoperator_tpu.analysis.report import Finding
+
+# -------------------------------------------------------------- KO-X009 ----
+_DOC_KEY_RE = re.compile(r"`([a-z0-9_]+(?:\.[a-z0-9_]+)+)`")
+_PURE_KEY_RE = re.compile(r"^[a-z0-9_]+(\.[a-z0-9_]+)+$")
+
+# the config blocks the docs knob tables must cover completely (the
+# resilience layer's contract — ISSUE 4 scope)
+DOC_REQUIRED_SECTIONS = ("resilience", "chaos", "watchdog")
+
+
+def _defaults_from_tree(root: str) -> dict | None:
+    """The DEFAULTS literal parsed out of the ANALYZED tree's
+    utils/config.py (pure-literal dict, so ast.literal_eval suffices).
+    None when it can't be read — the caller falls back to the installed
+    package's import, which is identical for the default root."""
+    import ast
+
+    path = os.path.join(root, "utils", "config.py")
+    try:
+        with open(path, encoding="utf-8") as f:
+            tree = ast.parse(f.read())
+        for node in tree.body:
+            targets = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets = [node.target]
+            if any(isinstance(t, ast.Name) and t.id == "DEFAULTS"
+                   for t in targets):
+                value = ast.literal_eval(node.value)
+                return value if isinstance(value, dict) else None
+    except (OSError, SyntaxError, ValueError):
+        pass
+    return None
+
+
+def _flatten(tree: dict, prefix: str = "") -> set:
+    """Leaf keys of a nested dict as dotted paths."""
+    out: set = set()
+    for key, value in tree.items():
+        dotted = f"{prefix}{key}"
+        if isinstance(value, dict) and value:
+            out |= _flatten(value, dotted + ".")
+        else:
+            out.add(dotted)
+    return out
+
+
+def _resolves(key: str, defaults: dict) -> bool:
+    """A read may target a leaf OR an interior mapping node."""
+    node = defaults
+    for part in key.split("."):
+        if not isinstance(node, dict) or part not in node:
+            return False
+        node = node[part]
+    return True
+
+
+def _doc_table_keys(docs_dir: str) -> list:
+    """[(key, file, line)] for every pure-dotted backticked key inside a
+    markdown KNOB table (a table whose header row mentions 'default') —
+    the scoping that keeps prose like `db.statement_is_complete` from
+    reading as a config key."""
+    out: list = []
+    if not os.path.isdir(docs_dir):
+        return out
+    for fn in sorted(os.listdir(docs_dir)):
+        if not fn.endswith(".md"):
+            continue
+        path = os.path.join(docs_dir, fn)
+        with open(path, encoding="utf-8") as f:
+            lines = f.read().splitlines()
+        in_knob_table = False
+        for i, line in enumerate(lines, start=1):
+            stripped = line.strip()
+            if not stripped.startswith("|"):
+                in_knob_table = False
+                continue
+            if set(stripped) <= {"|", "-", ":", " "}:
+                continue   # the separator row
+            is_header = i < len(lines) and \
+                set(lines[i].strip()) <= {"|", "-", ":", " "} and \
+                lines[i].strip().startswith("|")
+            if is_header:
+                # a knob table is one whose HEADER row says "default" —
+                # body rows that merely contain the word (KO-P004's
+                # "mutable default") must not arm the scan
+                in_knob_table = "default" in stripped.lower()
+                continue
+            if not in_knob_table:
+                continue
+            for match in _DOC_KEY_RE.finditer(stripped):
+                key = match.group(1)
+                if _PURE_KEY_RE.match(key):
+                    out.append((key, os.path.join("docs", fn), i))
+    return out
+
+
+def check_config_contract(
+    index: ProjectIndex,
+    defaults: dict | None = None,
+    docs_dir: str | None = None,
+    doc_required_sections: tuple = DOC_REQUIRED_SECTIONS,
+) -> list:
+    """KO-X009 — see the module docstring."""
+    if defaults is None:
+        # live mode: the analyzed tree's own config surface. A fixture /
+        # --root tree that ships no utils/config.py has no config surface
+        # to check — skip rather than drown it in dead-key findings for
+        # knobs it never declared. When the tree HAS one, its DEFAULTS
+        # literal is read from THAT file (a --root checkout is checked
+        # against its own declarations, not the installed analyzer's).
+        if not any(rel.replace(os.sep, "/").endswith("utils/config.py")
+                   for rel in index.files):
+            return []
+        defaults = _defaults_from_tree(index.root)
+        if defaults is None:
+            from kubeoperator_tpu.utils.config import DEFAULTS as defaults
+    if docs_dir is None:
+        docs_dir = os.path.join(os.path.dirname(index.root), "docs")
+
+    findings: list = []
+    leaves = _flatten(defaults)
+    reads = index.config_reads()
+    read_keys = {key for key, _rel, _line in reads}
+
+    # 1) every read resolves in DEFAULTS
+    for key, rel, line in reads:
+        if not _resolves(key, defaults):
+            findings.append(Finding(
+                "KO-X009", rel, line,
+                f"config key {key!r} is read but not declared in "
+                f"utils/config.py DEFAULTS — a typo here silently reads "
+                f"the call-site fallback forever; declare the key (with "
+                f"its default) or fix the spelling",
+            ))
+
+    # 2) every DEFAULTS leaf is read somewhere (dead-knob detector).
+    # A read of an interior node (`config.section`-style dotted prefix)
+    # covers all leaves under it.
+    config_rel = ""
+    for rel in index.files:
+        if rel.replace(os.sep, "/").endswith("utils/config.py"):
+            config_rel = rel
+    for leaf in sorted(leaves):
+        covered = leaf in read_keys or any(
+            leaf.startswith(key + ".") for key in read_keys)
+        if not covered:
+            findings.append(Finding(
+                "KO-X009", config_rel or "utils/config.py", 0,
+                f"DEFAULTS key {leaf!r} is never read by any "
+                f"config.get() — a knob that does nothing; wire it or "
+                f"delete it",
+            ))
+
+    # 3) docs knob tables agree with DEFAULTS
+    doc_keys = _doc_table_keys(docs_dir)
+    for key, rel, line in doc_keys:
+        if not _resolves(key, defaults):
+            findings.append(Finding(
+                "KO-X009", rel, line,
+                f"docs knob table names {key!r} which does not exist in "
+                f"utils/config.py DEFAULTS — stale or typo'd documentation",
+            ))
+    documented = {key for key, _rel, _line in doc_keys}
+    for section in doc_required_sections:
+        for leaf in sorted(leaves):
+            if leaf.split(".")[0] == section and leaf not in documented:
+                findings.append(Finding(
+                    "KO-X009", config_rel or "utils/config.py", 0,
+                    f"{leaf!r} ({section}.* block) has no row in any docs "
+                    f"knob table — the resilience-layer contract requires "
+                    f"every knob documented (docs/resilience.md)",
+                ))
+    return findings
+
+
+# -------------------------------------------------------------- KO-X010 ----
+def _template_match(a: str, b: str) -> bool:
+    """Segment-wise route template equality; any {placeholder} matches any
+    other {placeholder}."""
+    sa, sb = a.strip("/").split("/"), b.strip("/").split("/")
+    if len(sa) != len(sb):
+        return False
+    for x, y in zip(sa, sb):
+        if x.startswith("{") and y.startswith("{"):
+            continue
+        if x != y:
+            return False
+    return True
+
+
+def _matches_any(method: str, template: str, surface: list) -> bool:
+    return any(m == method and _template_match(template, t)
+               for m, t, _line, _rel in surface)
+
+
+def check_surface_parity(
+    index: ProjectIndex,
+    docs_text: str | None = None,
+) -> list:
+    """KO-X010 — see the module docstring. `docs_text` is the concatenated
+    documentation corpus (README + docs/*.md); None loads it from the
+    tree next to the analysis root."""
+    findings: list = []
+    routes = index.surface("routes")
+    rest_calls = index.surface("rest_calls")
+    dispatch = index.surface("dispatch")
+    commands = index.surface("commands")
+
+    # 1) every koctl REST call resolves to a registered server route
+    if routes:
+        for method, template, line, rel in rest_calls:
+            if not _matches_any(method, template, routes):
+                findings.append(Finding(
+                    "KO-X010", rel, line,
+                    f"CLI calls {method} {template} but api/server.py "
+                    f"registers no matching route — the REST transport "
+                    f"404s where --local might work",
+                ))
+
+    # 2) every koctl REST call has a --local dispatch case (two
+    # transports, same commands)
+    if dispatch:
+        for method, template, line, rel in rest_calls:
+            if not _matches_any(method, template, dispatch):
+                findings.append(Finding(
+                    "KO-X010", rel, line,
+                    f"CLI calls {method} {template} but LocalClient."
+                    f"_dispatch has no matching case — `--local` dies "
+                    f"with 'no route' on a command REST serves",
+                ))
+
+    # 3) every --local dispatch case shadows a real REST route (a
+    # local-only verb means the REST surface silently lagged)
+    if routes:
+        for method, template, line, rel in dispatch:
+            if not _matches_any(method, template, routes):
+                findings.append(Finding(
+                    "KO-X010", rel, line,
+                    f"LocalClient dispatches {method} {template} but "
+                    f"api/server.py registers no such route — the local "
+                    f"transport grew a verb REST does not serve",
+                ))
+
+    # 4) every top-level koctl command is documented
+    if commands:
+        if docs_text is None:
+            parent = os.path.dirname(index.root)
+            chunks: list = []
+            for candidate in [os.path.join(parent, "README.md")]:
+                if os.path.exists(candidate):
+                    with open(candidate, encoding="utf-8") as f:
+                        chunks.append(f.read())
+            docs_dir = os.path.join(parent, "docs")
+            if os.path.isdir(docs_dir):
+                for fn in sorted(os.listdir(docs_dir)):
+                    if fn.endswith(".md"):
+                        with open(os.path.join(docs_dir, fn),
+                                  encoding="utf-8") as f:
+                            chunks.append(f.read())
+            docs_text = "\n".join(chunks)
+        for name, line, rel in commands:
+            if f"koctl {name}" not in docs_text:
+                findings.append(Finding(
+                    "KO-X010", rel, line,
+                    f"koctl subcommand {name!r} appears in no "
+                    f"documentation (README.md / docs/*.md must mention "
+                    f"`koctl {name}`) — undocumented operator surface",
+                ))
+    return findings

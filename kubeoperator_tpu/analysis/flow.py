@@ -1,0 +1,523 @@
+"""Per-function dataflow + the two flow rule families.
+
+`_PathInterp` is a small abstract interpreter over a function body: it
+pushes a finite set of abstract states through every statement, modelling
+branches (all arms), loops (to a fixed point — the lattice is tiny),
+`try/except/finally` (handlers see the union of states reachable anywhere
+in the try body; finallies run on every path), `return`, `raise`, `break`
+and `continue`. That is exactly enough machinery for:
+
+* KO-P009 (exception-flow discipline):
+  - a `journal.open()` whose result stays function-local must reach a
+    `close()`/`interrupt()` on every path that completes normally —
+    exiting by EXCEPTION is fine (propagation IS the reraise the journal
+    contract allows: the op stays open for the boot reconciler to sweep),
+    but a `return` or fall-off-the-end with the op still open is a leak
+    that records the operation as Running forever. Ownership transfers
+    stop the tracking: `return op`, `nonlocal`/`global` targets, storing
+    into an attribute/subscript.
+  - no handler that catches `BaseException` (explicitly or via a bare
+    `except:`) may swallow it: chaos `ControllerDeath` derives from
+    BaseException precisely so it tears through the stack like a real
+    SIGKILL; a swallower turns the kill-the-controller drill into a
+    silent no-op. The handler must re-raise on some path (or carry a
+    `# KO-P009: waived — <reason>` comment).
+
+* KO-P008 (guarded-by inference) — not an interpreter client but the
+  same module's other half: infer each attribute's lock set from its
+  write sites PROJECT-WIDE over the index's ClassFacts, propagating
+  lock-held context through self-calls to a fixed point and joining
+  subclasses with the base class that owns the lock. Supersedes the
+  retired single-file KO-P003 heuristic.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from kubeoperator_tpu.analysis.index import ProjectIndex, _dotted
+from kubeoperator_tpu.analysis.report import Finding
+
+_P009_WAIVER = "KO-P009: waived"
+
+
+# =========================================================================
+# the interpreter
+# =========================================================================
+class BlockResult:
+    """States leaving a statement block, by exit kind. Each kind holds a
+    set of frozensets (the abstract states)."""
+
+    def __init__(self) -> None:
+        self.normal: set = set()
+        self.raised: set = set()
+        self.breaks: set = set()
+        self.continues: set = set()
+
+
+class _PathInterp:
+    """Pushes sets of frozenset-states through a function body.
+
+    The client provides `transfer(stmt, state) -> state` for straight-line
+    effects and `on_exit(kind, state, node)` called at `return` sites and
+    function fall-off. `raise` exits are NOT reported — propagating an
+    exception is a legal exit for every current client."""
+
+    def __init__(self, transfer, on_exit, escape=None) -> None:
+        self.transfer = transfer
+        self.on_exit = on_exit
+        self.escape = escape or (lambda stmt, state: state)
+
+    def run(self, body: list, entry: frozenset) -> None:
+        result = self.exec_block(body, {entry})
+        for state in result.normal:
+            self.on_exit("end", state, None)
+
+    # ---- core ----
+    def exec_block(self, stmts: list, states: set) -> BlockResult:
+        result = BlockResult()
+        current = set(states)
+        for stmt in stmts:
+            if not current:
+                break
+            step = self.exec_stmt(stmt, current)
+            result.raised |= step.raised
+            result.breaks |= step.breaks
+            result.continues |= step.continues
+            current = step.normal
+        result.normal = current
+        return result
+
+    def exec_stmt(self, stmt, states: set) -> BlockResult:
+        result = BlockResult()
+        if isinstance(stmt, ast.Return):
+            for state in states:
+                self.on_exit("return", state, stmt)
+            return result
+        if isinstance(stmt, ast.Raise):
+            result.raised |= states
+            return result
+        if isinstance(stmt, ast.Break):
+            result.breaks |= states
+            return result
+        if isinstance(stmt, ast.Continue):
+            result.continues |= states
+            return result
+        if isinstance(stmt, ast.If):
+            body = self.exec_block(stmt.body, states)
+            orelse = self.exec_block(stmt.orelse, states)
+            return self._merge(body, orelse)
+        if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+            return self._exec_loop(stmt, states)
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            # structural, like If/Try: the body is interpreted statement
+            # by statement — handing the whole With to `transfer` would
+            # let its ast.walk see a CONDITIONAL close deep in the body
+            # and untrack the op on every path
+            return self.exec_block(stmt.body, states)
+        if isinstance(stmt, ast.Try):
+            return self._exec_try(stmt, states)
+        if isinstance(stmt, ast.Match):
+            out = BlockResult()
+            matched_all = False
+            for case in stmt.cases:
+                arm = self.exec_block(case.body, states)
+                out = self._merge(out, arm)
+                if isinstance(case.pattern, ast.MatchAs) and \
+                        case.pattern.pattern is None:
+                    matched_all = True
+            if not matched_all:
+                out.normal |= states   # no arm may match
+            return out
+        # straight-line statement: apply the transfer function. Any
+        # statement may ALSO raise — modelled at the try level, where the
+        # union of in-body states feeds the handlers.
+        result.normal = {self.transfer(stmt, s) for s in states}
+        return result
+
+    def _merge(self, a: BlockResult, b: BlockResult) -> BlockResult:
+        out = BlockResult()
+        out.normal = a.normal | b.normal
+        out.raised = a.raised | b.raised
+        out.breaks = a.breaks | b.breaks
+        out.continues = a.continues | b.continues
+        return out
+
+    def _exec_loop(self, stmt, states: set) -> BlockResult:
+        result = BlockResult()
+        seen: set = set(states)     # zero-iteration path
+        frontier = set(states)
+        for _ in range(8):          # tiny lattice: converges in 2-3
+            step = self.exec_block(stmt.body, frontier)
+            result.raised |= step.raised
+            new = (step.normal | step.continues) - seen
+            result.normal |= step.breaks
+            seen |= new
+            if not new:
+                break
+            frontier = new
+        orelse = self.exec_block(stmt.orelse, seen)
+        result.raised |= orelse.raised
+        result.normal |= orelse.normal
+        result.breaks |= orelse.breaks
+        result.continues |= orelse.continues
+        return result
+
+    def _exec_try(self, stmt: ast.Try, states: set) -> BlockResult:
+        body = self.exec_block(stmt.body, states)
+        # any state reachable anywhere inside the try body may be live
+        # when an exception transfers to a handler
+        inflight = set(states) | body.normal | body.raised
+        handled = BlockResult()
+        for handler in stmt.handlers:
+            arm = self.exec_block(handler.body, inflight)
+            handled = self._merge(handled, arm)
+        orelse = self.exec_block(stmt.orelse, body.normal)
+        out = BlockResult()
+        out.normal = handled.normal | orelse.normal
+        # body raises survive only if some exception type has no handler;
+        # conservatively keep them — a missed close on a propagating path
+        # is allowed anyway, so over-keeping raised states is harmless
+        out.raised = handled.raised | orelse.raised | body.raised
+        out.breaks = body.breaks | handled.breaks | orelse.breaks
+        out.continues = body.continues | handled.continues | orelse.continues
+        if stmt.finalbody:
+            final_in = (out.normal | out.raised | out.breaks | out.continues)
+            # the finally body's effects apply to every path; run it once
+            # per incoming state and substitute the results per exit kind
+            out.normal = self._through_final(stmt.finalbody, out.normal)
+            out.raised = self._through_final(stmt.finalbody, out.raised)
+            out.breaks = self._through_final(stmt.finalbody, out.breaks)
+            out.continues = self._through_final(stmt.finalbody, out.continues)
+            # return-through-finally: ast.Return inside try already called
+            # on_exit before the finally's transfer could run. Clients that
+            # need exact return-through-finally modelling register closes
+            # seen in ANY finally via `escape` pre-pass instead.
+            del final_in
+        return out
+
+    def _through_final(self, finalbody: list, states: set) -> set:
+        if not states:
+            return states
+        step = self.exec_block(finalbody, states)
+        return step.normal | step.raised
+
+
+# =========================================================================
+# KO-P009 — exception-flow discipline
+# =========================================================================
+def _call_of(node):
+    """(receiver_dotted, attr) for a call expression, else None."""
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+        return _dotted(node.func.value), node.func.attr
+    return None
+
+
+def _is_journal_receiver(receiver: str) -> bool:
+    return receiver.split(".")[-1].endswith("journal")
+
+
+_CLOSERS = {"close", "interrupt"}
+
+
+def _stmt_call(stmt):
+    """The top-level call of an Expr/Assign statement, if any."""
+    if isinstance(stmt, ast.Expr):
+        return stmt.value if isinstance(stmt.value, ast.Call) else None
+    if isinstance(stmt, ast.Assign):
+        return stmt.value if isinstance(stmt.value, ast.Call) else None
+    return None
+
+
+def _journal_open_findings(func, rel: str, rule: str) -> list:
+    """Flag function-local journal ops that can complete normally while
+    still open. See the module docstring for the ownership rules."""
+    nonlocals: set = set()
+    for stmt in ast.walk(func):
+        if isinstance(stmt, (ast.Nonlocal, ast.Global)):
+            nonlocals.update(stmt.names)
+
+    # does this function even open a journal op into a local name?
+    opens = False
+    for node in ast.walk(func):
+        call = _call_of(node)
+        if call and call[1] == "open" and _is_journal_receiver(call[0]):
+            opens = True
+    if not opens:
+        return []
+
+    # a close anywhere in ANY finally body covers return-through-finally
+    # (the interpreter reports returns before applying the finally)
+    finally_closed: set = set()
+    for node in ast.walk(func):
+        if isinstance(node, ast.Try) and node.finalbody:
+            for sub in ast.walk(ast.Module(body=node.finalbody,
+                                           type_ignores=[])):
+                call = _call_of(sub)
+                if call and call[1] in _CLOSERS and \
+                        _is_journal_receiver(call[0]) and sub.args and \
+                        isinstance(sub.args[0], ast.Name):
+                    finally_closed.add(sub.args[0].id)
+
+    findings: list = []
+    reported: set = set()
+
+    def transfer(stmt, state: frozenset) -> frozenset:
+        out = set(state)
+        # assignment of an open() result
+        if isinstance(stmt, ast.Assign):
+            call = _call_of(stmt.value)
+            if call and call[1] == "open" and _is_journal_receiver(call[0]):
+                target = stmt.targets[0]
+                if isinstance(target, ast.Name) and \
+                        target.id not in nonlocals:
+                    out.add((target.id, stmt.value.lineno))
+                # nonlocal / attribute / tuple targets: ownership escapes
+                return frozenset(out)
+            # reassigning a tracked name to something else: stop tracking
+            for target in stmt.targets:
+                if isinstance(target, ast.Name):
+                    out = {(n, ln) for n, ln in out if n != target.id}
+                # storing a tracked op into an attribute/subscript:
+                # ownership escapes
+                elif isinstance(target, (ast.Attribute, ast.Subscript)) and \
+                        isinstance(stmt.value, ast.Name):
+                    out = {(n, ln) for n, ln in out
+                           if n != stmt.value.id}
+        # close()/interrupt() on a tracked name
+        for node in ast.walk(stmt):
+            call = _call_of(node)
+            if call and call[1] in _CLOSERS and \
+                    _is_journal_receiver(call[0]) and node.args and \
+                    isinstance(node.args[0], ast.Name):
+                out = {(n, ln) for n, ln in out if n != node.args[0].id}
+        return frozenset(out)
+
+    def on_exit(kind, state: frozenset, node) -> None:
+        open_ops = set(state)
+        if kind == "return" and node is not None and \
+                isinstance(node.value, ast.Name):
+            # `return op` — ownership transfers to the caller
+            open_ops = {(n, ln) for n, ln in open_ops
+                        if n != node.value.id}
+        for name, line in open_ops:
+            if name in finally_closed or (name, line) in reported:
+                continue
+            reported.add((name, line))
+            findings.append(Finding(
+                rule, rel, line,
+                f"journal op {name!r} opened in {func.name}() can complete "
+                f"normally without close()/interrupt() — the operation row "
+                f"stays Running forever; close on every non-raising path "
+                f"or hand ownership out (return/nonlocal/store)",
+            ))
+
+    _PathInterp(transfer, on_exit).run(func.body, frozenset())
+    return findings
+
+
+def _mentions_base_exception(type_node) -> bool:
+    if type_node is None:
+        return True     # bare except:
+    for sub in ast.walk(type_node):
+        if isinstance(sub, ast.Name) and sub.id == "BaseException":
+            return True
+        if isinstance(sub, ast.Attribute) and sub.attr == "BaseException":
+            return True
+    return False
+
+
+def _swallow_findings(tree: ast.AST, rel: str, rule: str,
+                      source_lines: list) -> list:
+    """`except BaseException` / bare `except:` handlers that never
+    re-raise. KO-P005 warns on the bare spelling for style; THIS rule is
+    the error-tier teeth: swallowing BaseException also swallows chaos
+    ControllerDeath, KeyboardInterrupt and SystemExit."""
+    findings: list = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if not _mentions_base_exception(node.type):
+            continue
+        reraises = any(isinstance(sub, ast.Raise)
+                       for sub in ast.walk(node))
+        if reraises:
+            continue
+        lo = max(node.lineno - 2, 0)
+        waived = any(_P009_WAIVER in line
+                     for line in source_lines[lo:node.lineno + 1])
+        if waived:
+            continue
+        findings.append(Finding(
+            rule, rel, node.lineno,
+            "handler catches BaseException and never re-raises — it would "
+            "swallow chaos ControllerDeath (and KeyboardInterrupt/"
+            "SystemExit); re-raise, narrow to Exception, or waive with "
+            f"`# {_P009_WAIVER} — <reason>`",
+        ))
+    return findings
+
+
+def check_exception_flow(root: str, tree: ast.AST, path: str,
+                         source: str | None = None) -> list:
+    """KO-P009 entry point, per file (same signature family as astcheck
+    rules, plus the source text for waiver comments)."""
+    rel = os.path.relpath(path, os.path.dirname(root) or ".")
+    if source is None:
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+    lines = source.splitlines()
+    findings = _swallow_findings(tree, rel, "KO-P009", lines)
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            findings.extend(_journal_open_findings(node, rel, "KO-P009"))
+    return findings
+
+
+# =========================================================================
+# KO-P008 — guarded-by inference over the project index
+# =========================================================================
+def _lock_families(index: ProjectIndex) -> list:
+    """Group each lock-owning class with its subclasses (single-level name
+    resolution over the whole project): the subclass writes against the
+    base class's lock discipline. Returns [(family_name, lock_attrs,
+    [ClassFacts...])]."""
+    classes = index.all_classes()
+    by_name: dict = {}
+    for cls in classes:
+        by_name.setdefault(cls.name, cls)
+    families = []
+    for cls in classes:
+        if not cls.lock_attrs:
+            continue
+        members = [cls]
+        for other in classes:
+            if other is cls:
+                continue
+            # walk up `other`'s base chain looking for cls
+            seen = set()
+            base_names = list(other.bases)
+            while base_names:
+                base = base_names.pop()
+                if base in seen:
+                    break
+                seen.add(base)
+                if base == cls.name:
+                    members.append(other)
+                    break
+                parent = by_name.get(base)
+                if parent is not None:
+                    base_names.extend(parent.bases)
+        families.append((cls.name, set(cls.lock_attrs), members))
+    return families
+
+
+def _exempt_method(name: str) -> bool:
+    # conventions carried over from KO-P003: no concurrency before
+    # __init__ completes; *_locked methods document "called with lock held"
+    return name == "__init__" or name.endswith("_locked")
+
+
+def check_guarded_by(index: ProjectIndex) -> list:
+    """Infer each attribute's lock set from its write sites and flag mixed
+    guarded/bare access, interprocedurally:
+
+    * lock-held context propagates through `self.method()` calls to a
+      fixed point — a private helper only ever invoked under the lock is
+      guarded even with no lexical `with` of its own;
+    * subclasses join the base class family, so an Executor subclass
+      writing a base-guarded field bare is caught across files;
+    * closure writes participate but never inherit the enclosing
+      method's lexical lock (they run on whichever thread calls them).
+    """
+    findings: list = []
+    for family_name, lock_attrs, members in _lock_families(index):
+        # ---- collect per-method facts across the family ----
+        methods: dict = {}            # name -> [(ClassFacts, MethodFacts)]
+        for cls in members:
+            for mname, mfacts in cls.methods.items():
+                methods.setdefault(mname, []).append((cls, mfacts))
+
+        # ---- fixed point: which methods can run with the lock held on
+        # every observed entry, which can run bare ----
+        # entry contexts: public methods (no leading _) get an implicit
+        # bare seed (any thread may call them); private methods start
+        # EMPTY — empty means "no entry known yet", never "bare": a
+        # premature bare would stick (sets only grow) and flag correctly
+        # locked multi-level helper chains. A call edge contributes
+        # {"locked"} when the call site lexically holds the lock, else it
+        # forwards the caller's own (currently known) entry contexts.
+        locked_entry: dict = {}       # name -> {"locked", "bare"} contexts
+        for mname in methods:
+            locked_entry[mname] = set()
+            if not mname.startswith("_") or _exempt_method(mname):
+                locked_entry[mname].add("bare")
+        changed = True
+        iters = 0
+        while changed and iters < 50:
+            changed = False
+            iters += 1
+            for mname, impls in methods.items():
+                for _cls, mfacts in impls:
+                    caller_ctxs = set(locked_entry[mname])
+                    for callee, locks, _line in mfacts.self_calls:
+                        if callee not in locked_entry:
+                            continue
+                        if set(locks) & lock_attrs:
+                            ctxs = {"locked"}
+                        else:
+                            # entry-"locked" means called-with-lock-held,
+                            # so the whole body (incl. this call) runs
+                            # under it; bare forwards as bare
+                            ctxs = caller_ctxs
+                        before = set(locked_entry[callee])
+                        locked_entry[callee] |= ctxs
+                        if locked_entry[callee] != before:
+                            changed = True
+
+        # a private method nobody in the family calls: unknown external
+        # caller — treat as bare-capable (conservative)
+        for mname, ctxs in locked_entry.items():
+            if not ctxs:
+                ctxs.add("bare")
+
+        # ---- classify write sites ----
+        guarded: dict = {}    # attr -> [(file, method, line)]
+        bare: dict = {}
+        for mname, impls in methods.items():
+            if _exempt_method(mname):
+                continue
+            entry_bare = "bare" in locked_entry[mname]
+            for cls, mfacts in impls:
+                for w in mfacts.writes:
+                    lexically = bool(set(w.locks) & lock_attrs)
+                    if lexically:
+                        guarded.setdefault(w.attr, []).append(
+                            (cls.file, mname, w.line))
+                    elif w.in_closure:
+                        # a closure write with no lexical lock: context
+                        # unknown — bare if the attr is guarded elsewhere
+                        bare.setdefault(w.attr, []).append(
+                            (cls.file, mname, w.line))
+                    elif not entry_bare:
+                        # every observed entry holds the lock
+                        guarded.setdefault(w.attr, []).append(
+                            (cls.file, mname, w.line))
+                    else:
+                        bare.setdefault(w.attr, []).append(
+                            (cls.file, mname, w.line))
+
+        for attr in sorted(set(guarded) & set(bare)):
+            locked_at = ", ".join(
+                f"{m}:{ln}" for _f, m, ln in sorted(guarded[attr])[:3])
+            for file, method, line in sorted(bare[attr]):
+                findings.append(Finding(
+                    "KO-P008", file, line,
+                    f"{family_name}.{attr} is lock-guarded at {locked_at} "
+                    f"but written bare in {method}() — a write-write race; "
+                    f"take {'/'.join(sorted(lock_attrs))} or rename the "
+                    f"helper *_locked if it is documented as "
+                    f"called-with-lock-held",
+                ))
+    return findings

@@ -1,0 +1,678 @@
+"""ProjectIndex — the v2 engine's shared substrate: every package python
+file parsed ONCE, reduced to serializable per-file facts, cached by content
+hash.
+
+Three consumers ride the same walk:
+
+* per-file rules (astcheck KO-P001..P007 minus the retired P003, flow
+  KO-P009) run against the freshly parsed tree and their findings are
+  cached next to the facts;
+* the guarded-by engine (flow.py KO-P008) consumes `ClassFacts` — lock
+  attributes, per-method attribute writes with the lexically-held lock
+  set, and the self-call graph — joined PROJECT-WIDE so inheritance and
+  call-context propagation cross file boundaries;
+* the contract rules (contracts.py KO-X009/KO-X010) consume the config
+  read sites and the REST/CLI surface facts.
+
+The cache is the reason full-repo `koctl lint` stays inside the tier-1
+gate's 5 s budget as rules multiply: a warm run re-hashes files (cheap)
+and re-runs only the project-wide joins (pure in-memory); only changed
+files are re-parsed. `--changed` goes one step further and trusts git for
+the unchanged set.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import json
+import os
+import re
+from dataclasses import dataclass, field
+
+from kubeoperator_tpu.version import __version__
+
+# Cache format version: bump when fact extraction changes shape, so a stale
+# cache from an older analyzer can never masquerade as fresh facts.
+CACHE_SCHEMA = 3
+
+_SKIP_DIRS = {"content", "__pycache__"}
+
+_LOCK_FACTORIES = {"Lock", "RLock", "Condition", "Semaphore",
+                   "BoundedSemaphore"}
+# `_lock`, `lock`, `_ops_lock`, `write_lock`, ... — NOT `lock_timeout`
+_LOCK_NAME_RE = re.compile(r"^_?(?:[a-z0-9_]+_)?lock$")
+
+_CONFIG_KEY_RE = re.compile(r"^[a-z0-9_]+(\.[a-z0-9_]+)+$")
+# receiver names that mean "the process config object"
+_CONFIG_RECEIVERS = {"config", "cfg"}
+
+
+def iter_python_files(root: str):
+    for base, dirs, files in os.walk(root):
+        dirs[:] = sorted(d for d in dirs if d not in _SKIP_DIRS)
+        for fn in sorted(files):
+            if fn.endswith(".py"):
+                yield os.path.join(base, fn)
+
+
+def file_sha(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        h.update(f.read())
+    return h.hexdigest()
+
+
+def _self_attr(node) -> str | None:
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _dotted(node) -> str:
+    """Best-effort dotted spelling of an expression (`self.s.config` ->
+    "self.s.config"); "" when any link is not a Name/Attribute."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+# ------------------------------------------------------------ class facts --
+@dataclass
+class WriteSite:
+    """One `self.<attr> = ...` (or augmented) write inside a method."""
+
+    attr: str
+    line: int
+    locks: tuple     # lock attr names lexically held at the write
+    in_closure: bool  # written from a nested def (runs on a caller thread)
+
+    def to_dict(self) -> dict:
+        return {"attr": self.attr, "line": self.line,
+                "locks": list(self.locks), "in_closure": self.in_closure}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "WriteSite":
+        return cls(d["attr"], d["line"], tuple(d["locks"]), d["in_closure"])
+
+
+@dataclass
+class MethodFacts:
+    name: str
+    line: int
+    writes: list = field(default_factory=list)       # [WriteSite]
+    self_calls: list = field(default_factory=list)   # [(callee, locks, line)]
+
+    def to_dict(self) -> dict:
+        return {"name": self.name, "line": self.line,
+                "writes": [w.to_dict() for w in self.writes],
+                "self_calls": [[c, list(l), ln]
+                               for c, l, ln in self.self_calls]}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "MethodFacts":
+        m = cls(d["name"], d["line"])
+        m.writes = [WriteSite.from_dict(w) for w in d["writes"]]
+        m.self_calls = [(c, tuple(l), ln) for c, l, ln in d["self_calls"]]
+        return m
+
+
+@dataclass
+class ClassFacts:
+    name: str
+    file: str        # path relative to the analysis root's parent
+    line: int
+    bases: list = field(default_factory=list)
+    lock_attrs: list = field(default_factory=list)
+    methods: dict = field(default_factory=dict)      # name -> MethodFacts
+
+    def to_dict(self) -> dict:
+        return {"name": self.name, "file": self.file, "line": self.line,
+                "bases": self.bases, "lock_attrs": self.lock_attrs,
+                "methods": {k: m.to_dict() for k, m in self.methods.items()}}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ClassFacts":
+        c = cls(d["name"], d["file"], d["line"], d["bases"], d["lock_attrs"])
+        c.methods = {k: MethodFacts.from_dict(m)
+                     for k, m in d["methods"].items()}
+        return c
+
+
+class _MethodScanner(ast.NodeVisitor):
+    """Collect writes + self-calls for one method, tracking the lexically
+    held lock set through `with self.<lock>:` blocks. Nested defs ARE
+    descended into (unlike the retired KO-P003): a closure's bare write to
+    a guarded field races no matter which thread runs it — but the site is
+    marked `in_closure` so the inference can treat its lock context as
+    unknown rather than inheriting the enclosing method's."""
+
+    def __init__(self, lock_attrs: set) -> None:
+        self.lock_attrs = lock_attrs
+        self.held: list = []
+        self.closure_depth = 0
+        self.writes: list = []
+        self.self_calls: list = []
+
+    def _locks(self) -> tuple:
+        return tuple(sorted(set(self.held)))
+
+    def visit_FunctionDef(self, node):  # noqa: N802
+        self.closure_depth += 1
+        # a closure starts with NO inherited lock: it runs when called,
+        # not where it was defined
+        saved, self.held = self.held, []
+        for stmt in node.body:
+            self.visit(stmt)
+        self.held = saved
+        self.closure_depth -= 1
+
+    visit_AsyncFunctionDef = visit_FunctionDef  # noqa: N815
+
+    def visit_With(self, node):  # noqa: N802
+        holds = [a for item in node.items
+                 if (a := _self_attr(item.context_expr)) in self.lock_attrs]
+        self.held.extend(holds)
+        for stmt in node.body:
+            self.visit(stmt)
+        for item in node.items:
+            self.visit(item.context_expr)
+        for _ in holds:
+            self.held.pop()
+
+    def _record(self, target, lineno: int) -> None:
+        attr = _self_attr(target)
+        if attr and attr not in self.lock_attrs:
+            self.writes.append(WriteSite(
+                attr, lineno, self._locks(), self.closure_depth > 0))
+
+    def visit_Assign(self, node):  # noqa: N802
+        for target in node.targets:
+            self._record(target, node.lineno)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node):  # noqa: N802
+        self._record(node.target, node.lineno)
+        self.generic_visit(node)
+
+    def visit_Call(self, node):  # noqa: N802
+        callee = _self_attr(node.func)
+        if callee:
+            self.self_calls.append((callee, self._locks(), node.lineno))
+        self.generic_visit(node)
+
+
+def _lock_attrs_of_class(cls: ast.ClassDef) -> set:
+    """Attributes assigned a threading lock/condition anywhere in the
+    class, plus lock-NAMED attributes regardless of what they're assigned
+    (`self._lock = lock` injection / aliasing must still arm the
+    detector)."""
+    locks: set = set()
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Assign):
+            continue
+        factory = ""
+        if isinstance(node.value, ast.Call):
+            func = node.value.func
+            factory = (func.attr if isinstance(func, ast.Attribute)
+                       else func.id if isinstance(func, ast.Name) else "")
+        for target in node.targets:
+            attr = _self_attr(target)
+            if attr and (factory in _LOCK_FACTORIES
+                         or _LOCK_NAME_RE.match(attr)):
+                locks.add(attr)
+    return locks
+
+
+def _class_facts(cls: ast.ClassDef, rel: str) -> ClassFacts:
+    facts = ClassFacts(
+        name=cls.name, file=rel, line=cls.lineno,
+        bases=[_dotted(b).rsplit(".", 1)[-1]
+               for b in cls.bases if _dotted(b)],
+        lock_attrs=sorted(_lock_attrs_of_class(cls)),
+    )
+    for method in cls.body:
+        if not isinstance(method, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        scanner = _MethodScanner(set(facts.lock_attrs))
+        for stmt in method.body:
+            scanner.visit(stmt)
+        m = MethodFacts(method.name, method.lineno)
+        m.writes = scanner.writes
+        m.self_calls = scanner.self_calls
+        facts.methods[method.name] = m
+    return facts
+
+
+# ----------------------------------------------------------- config reads --
+def _section_defaults(tree: ast.AST) -> dict:
+    """Map each function's name -> its `section` keyword default, for
+    resolving the `config.get(f"{section}.key", ...)` from_config idiom."""
+    out: dict = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        args = node.args
+        names = [a.arg for a in args.args]
+        for name, default in zip(reversed(names), reversed(args.defaults)):
+            if name == "section" and isinstance(default, ast.Constant) \
+                    and isinstance(default.value, str):
+                out[node.name] = default.value
+        for arg, default in zip(args.kwonlyargs, args.kw_defaults):
+            if arg.arg == "section" and isinstance(default, ast.Constant) \
+                    and isinstance(default.value, str):
+                out[node.name] = default.value
+    return out
+
+
+def _resolve_key(arg, section_default: str | None) -> str | None:
+    """The dotted config key an expression names, if statically known."""
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return arg.value if _CONFIG_KEY_RE.match(arg.value) else None
+    if isinstance(arg, ast.JoinedStr) and section_default:
+        # f"{section}.rest" — exactly one formatted value, a Name 'section'
+        parts: list[str] = []
+        for value in arg.values:
+            if isinstance(value, ast.Constant):
+                parts.append(str(value.value))
+            elif isinstance(value, ast.FormattedValue) and \
+                    isinstance(value.value, ast.Name) and \
+                    value.value.id == "section":
+                parts.append(section_default)
+            else:
+                return None
+        key = "".join(parts)
+        return key if _CONFIG_KEY_RE.match(key) else None
+    return None
+
+
+def _config_reads(tree: ast.AST) -> list:
+    """[(dotted_key, line)] for every statically-resolvable config read:
+    `<...>.config.get("a.b.c", ...)` / `cfg.get("a.b", ...)` / the
+    from_config `f"{section}.key"` idiom."""
+    sections = _section_defaults(tree)
+    reads: list = []
+    # parent function tracking: walk functions explicitly
+    def scan(node, func_name: str | None):
+        for child in ast.iter_child_nodes(node):
+            name = func_name
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                name = child.name
+            if isinstance(child, ast.Call) and \
+                    isinstance(child.func, ast.Attribute) and \
+                    child.func.attr == "get" and child.args:
+                receiver = _dotted(child.func.value)
+                if receiver.rsplit(".", 1)[-1] in _CONFIG_RECEIVERS:
+                    key = _resolve_key(
+                        child.args[0],
+                        sections.get(name or "", None) if name else None)
+                    if key:
+                        reads.append((key, child.lineno))
+            scan(child, name)
+
+    scan(tree, None)
+    return reads
+
+
+# ---------------------------------------------------------- surface facts --
+_ROUTE_ADDERS = {"add_get": "GET", "add_post": "POST", "add_put": "PUT",
+                 "add_delete": "DELETE"}
+
+
+def _fstring_template(node) -> str | None:
+    """Normalize a route path expression to a template: constants verbatim,
+    each formatted value -> "{p}". Query strings are stripped."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        text = node.value
+    elif isinstance(node, ast.JoinedStr):
+        parts = []
+        for value in node.values:
+            if isinstance(value, ast.Constant):
+                parts.append(str(value.value))
+            elif isinstance(value, ast.FormattedValue):
+                parts.append("{p}")
+            else:
+                return None
+        text = "".join(parts)
+    else:
+        return None
+    return text.partition("?")[0]
+
+
+def _surface_facts(tree: ast.AST) -> dict:
+    """REST/CLI surface facts for KO-X010, extracted generically so the
+    same walk serves api/server.py (routes), cli/koctl.py (rest calls +
+    local dispatch + top-level commands) and fixture files alike."""
+    routes: list = []        # [(method, template, line)]
+    rest_calls: list = []    # [(method, template, line)]
+    dispatch: list = []      # [(method, template, line)]
+    commands: list = []      # [(name, line)] — top-level koctl subcommands
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if not isinstance(func, ast.Attribute):
+            continue
+        # routes: r.add_get("/api/v1/...", handler) on any receiver
+        if func.attr in _ROUTE_ADDERS and node.args:
+            template = _fstring_template(node.args[0])
+            if template and template.startswith("/api/"):
+                routes.append((_ROUTE_ADDERS[func.attr], template,
+                               node.lineno))
+        # the CRUD helper: h._crud_routes(app, "/api/v1/plans", ...)
+        elif func.attr == "_crud_routes" and len(node.args) >= 2:
+            template = _fstring_template(node.args[1])
+            if template and template.startswith("/api/"):
+                for method, suffix in (("GET", ""), ("POST", ""),
+                                       ("GET", "/{name}"),
+                                       ("DELETE", "/{name}")):
+                    routes.append((method, template + suffix, node.lineno))
+        # transport calls: client.call("GET", f"/api/v1/...")
+        elif func.attr == "call" and len(node.args) >= 2 and \
+                isinstance(node.args[0], ast.Constant):
+            method = node.args[0].value
+            template = _fstring_template(node.args[1])
+            if isinstance(method, str) and template and \
+                    template.startswith("/api/"):
+                rest_calls.append((method, template, node.lineno))
+        # top-level koctl subcommands: sub.add_parser("name", ...)
+        elif func.attr == "add_parser" and node.args and \
+                isinstance(func.value, ast.Name) and func.value.id == "sub" \
+                and isinstance(node.args[0], ast.Constant):
+            commands.append((node.args[0].value, node.lineno))
+    # LocalClient._dispatch match-case patterns
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Match):
+            continue
+        for case in node.cases:
+            pattern = case.pattern
+            if not isinstance(pattern, ast.MatchSequence) or \
+                    len(pattern.patterns) != 2:
+                continue
+            method_pat, parts_pat = pattern.patterns
+            if not (isinstance(method_pat, ast.MatchValue)
+                    and isinstance(method_pat.value, ast.Constant)
+                    and isinstance(parts_pat, ast.MatchSequence)):
+                continue
+            segments = []
+            ok = True
+            for part in parts_pat.patterns:
+                if isinstance(part, ast.MatchValue) and \
+                        isinstance(part.value, ast.Constant):
+                    segments.append(str(part.value.value))
+                elif isinstance(part, ast.MatchAs) and part.pattern is None \
+                        and part.name:
+                    segments.append("{p}")
+                else:
+                    ok = False
+                    break
+            if ok:
+                dispatch.append((
+                    method_pat.value.value,
+                    "/api/v1/" + "/".join(segments),
+                    case.pattern.lineno,
+                ))
+    return {"routes": routes, "rest_calls": rest_calls,
+            "dispatch": dispatch, "commands": commands}
+
+
+# -------------------------------------------------------------- file facts --
+@dataclass
+class FileFacts:
+    """Everything the project-wide rules need from one file — JSON-plain so
+    a warm cache run never re-parses the file."""
+
+    rel: str
+    classes: list = field(default_factory=list)     # [ClassFacts]
+    config_reads: list = field(default_factory=list)  # [(key, line)]
+    surface: dict = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return {"rel": self.rel,
+                "classes": [c.to_dict() for c in self.classes],
+                "config_reads": [list(r) for r in self.config_reads],
+                "surface": self.surface}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "FileFacts":
+        f = cls(d["rel"])
+        f.classes = [ClassFacts.from_dict(c) for c in d["classes"]]
+        f.config_reads = [tuple(r) for r in d["config_reads"]]
+        f.surface = d["surface"]
+        return f
+
+
+def extract_file_facts(tree: ast.AST, rel: str) -> FileFacts:
+    facts = FileFacts(rel=rel)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            facts.classes.append(_class_facts(node, rel))
+    facts.config_reads = _config_reads(tree)
+    facts.surface = _surface_facts(tree)
+    return facts
+
+
+# ------------------------------------------------------------------- cache --
+def default_cache_dir() -> str:
+    base = os.environ.get("KO_ANALYZE_CACHE_DIR")
+    if base:
+        return base
+    xdg = os.environ.get("XDG_CACHE_HOME",
+                         os.path.join(os.path.expanduser("~"), ".cache"))
+    return os.path.join(xdg, "ko-analyze")
+
+
+class AnalysisCache:
+    """Content-hash incremental cache: per python file {sha, facts,
+    findings-by-rule}; one whole-tree entry for the artifact rules.
+
+    Keyed by analyzer version + schema so upgrades self-invalidate. All
+    failures degrade to a cold run — a broken cache must never break the
+    gate (exit 2) or, worse, fake a clean one."""
+
+    def __init__(self, cache_dir: str, root: str) -> None:
+        self.root = root
+        os.makedirs(cache_dir, exist_ok=True)
+        digest = hashlib.sha256(
+            os.path.abspath(root).encode()).hexdigest()[:16]
+        self.path = os.path.join(cache_dir, f"index-{digest}.json")
+        self.data: dict = {"schema": CACHE_SCHEMA, "version": __version__,
+                           "files": {}, "artifacts": {}}
+        self.hits = 0
+        self.misses = 0
+        try:
+            with open(self.path, encoding="utf-8") as f:
+                loaded = json.load(f)
+            if loaded.get("schema") == CACHE_SCHEMA and \
+                    loaded.get("version") == __version__:
+                self.data = loaded
+        except (OSError, ValueError):
+            pass
+
+    # ---- per-file ----
+    def lookup(self, rel: str, sha: str) -> dict | None:
+        """Entry for `rel` if its content hash still matches. There is
+        deliberately NO trust-without-hashing mode: the cache is not
+        keyed to git state, so 'git status clean' cannot prove an entry
+        fresh (commit/branch-switch/revert all change content without
+        dirtying the worktree) — and hashing the package is ~30 ms."""
+        entry = self.data["files"].get(rel)
+        if entry is None or entry["sha"] != sha:
+            self.misses += 1
+            return None
+        self.hits += 1
+        return entry
+
+    def store(self, rel: str, sha: str, facts: FileFacts,
+              findings_by_rule: dict) -> None:
+        previous = self.data["files"].get(rel)
+        if previous is not None and previous.get("sha") == sha:
+            # a --rules subset run must not shrink a fuller entry: merge
+            # per-rule findings for the same content hash
+            findings_by_rule = {**previous.get("findings", {}),
+                                **findings_by_rule}
+        self.data["files"][rel] = {
+            "sha": sha,
+            "facts": facts.to_dict(),
+            "findings": findings_by_rule,
+        }
+
+    def prune(self, live_rels: set) -> None:
+        """Drop entries for deleted files so their cached findings can't
+        haunt future reports."""
+        for rel in list(self.data["files"]):
+            if rel not in live_rels:
+                del self.data["files"][rel]
+
+    # ---- whole-tree artifact entry ----
+    def artifact_lookup(self, tree_sha: str) -> dict | None:
+        entry = self.data["artifacts"]
+        if entry.get("sha") != tree_sha:
+            return None
+        return entry
+
+    def artifact_fast_entry(self, git_head: str, changed: set,
+                            root: str) -> dict | None:
+        """The --changed shortcut around the whole-tree hash. Sound only
+        when git can vouch for the artifact inputs: the cache was saved
+        at the SAME commit with a then-clean package tree, nothing under
+        the package is dirty now, and the cached entry was built without
+        --plan files (whose findings would otherwise replay into a
+        plan-less run)."""
+        meta = self.data.get("git") or {}
+        entry = self.data["artifacts"]
+        if (not git_head or meta.get("head") != git_head
+                or meta.get("dirty")                       # dirty at save
+                or entry.get("plans") != []
+                or entry.get("findings") is None
+                or any(p.startswith(root + os.sep) for p in changed)):
+            return None
+        return entry
+
+    def artifact_store(self, tree_sha: str, findings_by_rule: dict,
+                       files_scanned: int, plans: tuple = ()) -> None:
+        self.data["artifacts"] = {"sha": tree_sha,
+                                  "findings": findings_by_rule,
+                                  "files_scanned": files_scanned,
+                                  "plans": sorted(plans)}
+
+    def record_git_state(self, git_head: str, changed: set,
+                         root: str) -> None:
+        """Called only when the run actually asked git (--changed): pin
+        the cache to (HEAD, dirty-under-root). Runs that didn't ask git
+        clear the pin instead — an unknown state must never vouch."""
+        if git_head:
+            self.data["git"] = {
+                "head": git_head,
+                "dirty": sorted(os.path.relpath(p, os.path.dirname(root))
+                                for p in changed
+                                if p.startswith(root + os.sep)),
+            }
+        else:
+            self.data.pop("git", None)
+
+    def save(self) -> None:
+        tmp = self.path + ".tmp"
+        try:
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(self.data, f)
+            os.replace(tmp, self.path)
+        except OSError:
+            pass
+
+
+def tree_sha(root: str) -> str:
+    """One hash over every non-cache file under root (names + contents):
+    the artifact rules' invalidation key. Content files are few thousand
+    small text files; this stays ~100 ms."""
+    h = hashlib.sha256()
+    for base, dirs, files in os.walk(root):
+        dirs[:] = sorted(d for d in dirs if d != "__pycache__")
+        for fn in sorted(files):
+            path = os.path.join(base, fn)
+            h.update(os.path.relpath(path, root).encode())
+            try:
+                with open(path, "rb") as f:
+                    h.update(hashlib.sha256(f.read()).digest())
+            except OSError:
+                h.update(b"<unreadable>")
+    return h.hexdigest()
+
+
+# ------------------------------------------------------------------- index --
+@dataclass
+class ProjectIndex:
+    """The project-wide join surface: all per-file facts, by rel path."""
+
+    root: str
+    files: dict = field(default_factory=dict)   # rel -> FileFacts
+
+    def all_classes(self) -> list:
+        return [c for f in self.files.values() for c in f.classes]
+
+    def config_reads(self) -> list:
+        """[(key, rel, line)] across the project."""
+        return [(key, rel, line)
+                for rel, facts in sorted(self.files.items())
+                for key, line in facts.config_reads]
+
+    def surface(self, what: str) -> list:
+        """[(method/name, template/line, rel, line)] for one surface kind
+        across the project ('routes' | 'rest_calls' | 'dispatch' |
+        'commands')."""
+        out = []
+        for rel, facts in sorted(self.files.items()):
+            for row in facts.surface.get(what, ()):
+                out.append((*row, rel))
+        return out
+
+
+def git_head(repo_dir: str) -> str:
+    """Current HEAD commit sha, or "" when git state is unreadable."""
+    import subprocess
+
+    try:
+        out = subprocess.run(
+            ["git", "-C", repo_dir, "rev-parse", "HEAD"],
+            capture_output=True, text=True, timeout=10,
+        )
+    except (OSError, subprocess.TimeoutExpired):
+        return ""
+    return out.stdout.strip() if out.returncode == 0 else ""
+
+
+def git_changed_files(repo_dir: str) -> set | None:
+    """Paths (absolute) git reports as modified/added/untracked, or None
+    when git state can't be read (not a repo, no git binary) — callers
+    must fall back to a full scan, never assume 'nothing changed'."""
+    import subprocess
+
+    try:
+        out = subprocess.run(
+            ["git", "-C", repo_dir, "status", "--porcelain"],
+            capture_output=True, text=True, timeout=10,
+        )
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    if out.returncode != 0:
+        return None
+    top = subprocess.run(
+        ["git", "-C", repo_dir, "rev-parse", "--show-toplevel"],
+        capture_output=True, text=True, timeout=10,
+    )
+    if top.returncode != 0:
+        return None
+    base = top.stdout.strip()
+    changed = set()
+    for line in out.stdout.splitlines():
+        if len(line) < 4:
+            continue
+        path = line[3:].split(" -> ")[-1].strip().strip('"')
+        changed.add(os.path.abspath(os.path.join(base, path)))
+    return changed

@@ -98,13 +98,6 @@ RULES: dict[str, RuleSpec] = {
             "inside the handler are exempt: they run on the executor)",
         ),
         RuleSpec(
-            "KO-P003", "lock-discipline", "ast", ERROR,
-            "a self attribute written inside a `with self.<lock>:` block in "
-            "one method must not also be written outside any lock in "
-            "another (a lightweight write-write race detector; __init__ and "
-            "*_locked helper methods are exempt by convention)",
-        ),
-        RuleSpec(
             "KO-P004", "mutable-default", "ast", ERROR,
             "no mutable default argument (list/dict/set literal or "
             "constructor) on any function — shared-instance aliasing bugs",
@@ -128,6 +121,38 @@ RULES: dict[str, RuleSpec] = {
             "journaled path so a controller crash always leaves a "
             "sweepable operation record",
         ),
+        # ---- project-wide flow rules (flow.py, over index.py facts) ----
+        RuleSpec(
+            "KO-P008", "guarded-by", "flow", ERROR,
+            "each attribute's lock set is inferred from its write sites "
+            "project-wide (lock context propagates through self-calls and "
+            "inheritance); an attribute guarded at one write site must "
+            "not be written bare at another — supersedes the retired "
+            "single-file KO-P003 heuristic",
+        ),
+        RuleSpec(
+            "KO-P009", "exception-flow", "flow", ERROR,
+            "a journal open() owned by a function must reach close()/"
+            "interrupt() on every normally-completing path (exception "
+            "propagation is the sanctioned reraise), and no handler "
+            "catching BaseException may swallow it — chaos "
+            "ControllerDeath must tear through like a real SIGKILL",
+        ),
+        # ---- contract rules (contracts.py, over index.py facts) ----
+        RuleSpec(
+            "KO-X009", "config-contract", "contract", ERROR,
+            "every literal config.get() key resolves in utils/config.py "
+            "DEFAULTS, every DEFAULTS leaf is read somewhere, and docs "
+            "knob tables match (resilience/chaos/watchdog blocks fully "
+            "documented)",
+        ),
+        RuleSpec(
+            "KO-X010", "surface-parity", "contract", ERROR,
+            "every koctl REST call resolves to a registered api/server.py "
+            "route AND a LocalClient dispatch case, every local dispatch "
+            "case shadows a real route, and every top-level koctl command "
+            "is documented",
+        ),
     )
 }
 
@@ -141,6 +166,7 @@ class Finding:
     line: int          # 1-based; 0 = whole-file/whole-artifact finding
     message: str
     severity: str = ""  # defaults to the rule's registered severity
+    waived: str = ""    # waiver justification; non-empty = suppressed
 
     def __post_init__(self) -> None:
         if self.rule not in RULES:
@@ -149,7 +175,7 @@ class Finding:
             object.__setattr__(self, "severity", RULES[self.rule].severity)
 
     def to_dict(self) -> dict:
-        return {
+        out = {
             "rule": self.rule,
             "name": RULES[self.rule].name,
             "severity": self.severity,
@@ -157,6 +183,17 @@ class Finding:
             "line": self.line,
             "message": self.message,
         }
+        if self.waived:
+            out["waived"] = self.waived
+        return out
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Finding":
+        """Inverse of to_dict minus the derived `name` — the incremental
+        cache round-trips findings through JSON."""
+        return cls(rule=d["rule"], file=d["file"], line=d["line"],
+                   message=d["message"], severity=d["severity"],
+                   waived=d.get("waived", ""))
 
 
 @dataclass
@@ -168,17 +205,28 @@ class Report:
     rules_run: list[str] = field(default_factory=list)
     runtime_s: float = 0.0
     files_scanned: int = 0
+    cache_hits: int = 0
+    cache_misses: int = 0
+    unused_waivers: list[str] = field(default_factory=list)
 
     def extend(self, findings: list[Finding]) -> None:
         self.findings.extend(findings)
 
     @property
     def errors(self) -> list[Finding]:
-        return [f for f in self.findings if f.severity == ERROR]
+        """Error-severity findings that are NOT waived — the exit-code
+        set. Waived findings stay visible but never fail the gate."""
+        return [f for f in self.findings
+                if f.severity == ERROR and not f.waived]
 
     @property
     def warnings(self) -> list[Finding]:
-        return [f for f in self.findings if f.severity == WARNING]
+        return [f for f in self.findings
+                if f.severity == WARNING and not f.waived]
+
+    @property
+    def waived(self) -> list[Finding]:
+        return [f for f in self.findings if f.waived]
 
     def exit_code(self) -> int:
         """Tooling contract: 0 clean, 1 error findings (warnings alone stay
@@ -202,7 +250,9 @@ class Report:
             "counts": {
                 "error": len(self.errors),
                 "warning": len(self.warnings),
+                "waived": len(self.waived),
             },
+            "unused_waivers": list(self.unused_waivers),
             "findings": [f.to_dict() for f in self.sorted_findings()],
         }
 
@@ -214,14 +264,21 @@ class Report:
         lines = []
         for f in self.sorted_findings():
             where = f"{f.file}:{f.line}" if f.line else f.file
+            tag = "WAIVED " if f.waived else f"{f.severity.upper():7s}"
             lines.append(
-                f"{f.severity.upper():7s} {f.rule} [{RULES[f.rule].name}] "
+                f"{tag} {f.rule} [{RULES[f.rule].name}] "
                 f"{where}: {f.message}"
+                + (f" [waived: {f.waived}]" if f.waived else "")
             )
+        for desc in self.unused_waivers:
+            lines.append(f"STALE   waiver matches nothing: {desc}")
+        waived = f", {len(self.waived)} waived" if self.waived else ""
+        cache = (f", cache {self.cache_hits}h/{self.cache_misses}m"
+                 if self.cache_hits or self.cache_misses else "")
         lines.append(
             f"ko-analyze: {len(self.errors)} error(s), "
-            f"{len(self.warnings)} warning(s) across "
+            f"{len(self.warnings)} warning(s){waived} across "
             f"{len(self.rules_run)} rules, {self.files_scanned} files "
-            f"({self.runtime_s:.2f}s)"
+            f"({self.runtime_s:.2f}s{cache})"
         )
         return "\n".join(lines)

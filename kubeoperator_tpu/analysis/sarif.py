@@ -1,0 +1,167 @@
+"""SARIF 2.1.0 serialization + the checked-in waiver/baseline file.
+
+SARIF is the interchange format CI annotators and editors consume
+(github code-scanning, VS Code SARIF viewer); emitting it makes the gate's
+findings land as PR annotations instead of a log to grep. One run, one
+tool (`ko-analyze`), every registered rule in the driver's rule table so
+`ruleIndex` references resolve.
+
+Waivers are the baseline mechanism that lets a warning-tier rule land at
+ERROR severity before the tree is fully clean: a finding matched by a
+waiver keeps its text but stops counting toward the exit code, and SARIF
+carries it as a suppressed result with the waiver's justification. Every
+waiver MUST have a reason — an unexplained suppression is how baselines
+rot. Unused waivers are reported so stale entries get deleted.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import json
+import os
+from dataclasses import dataclass
+
+import yaml
+
+from kubeoperator_tpu.analysis.report import ERROR, RULES, WARNING, Finding
+from kubeoperator_tpu.version import __version__
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = "https://json.schemastore.org/sarif-2.1.0.json"
+
+_LEVELS = {ERROR: "error", WARNING: "warning"}
+
+
+# ------------------------------------------------------------------ waivers --
+@dataclass(frozen=True)
+class Waiver:
+    """One baseline entry: which findings it suppresses and WHY."""
+
+    rule: str
+    reason: str
+    file: str = ""        # fnmatch pattern over the finding's rel path
+    contains: str = ""    # substring of the finding's message
+
+    def matches(self, finding: Finding) -> bool:
+        if finding.rule != self.rule:
+            return False
+        if self.file and not fnmatch.fnmatch(
+                finding.file.replace(os.sep, "/"), self.file):
+            return False
+        if self.contains and self.contains not in finding.message:
+            return False
+        return True
+
+
+def load_waivers(path: str) -> list:
+    """Parse the waiver file. Malformed entries raise — a waiver that
+    silently fails to parse would un-suppress (or worse, a future format
+    drift could over-suppress); the CLI maps the raise to exit 2."""
+    if not os.path.exists(path):
+        return []
+    with open(path, encoding="utf-8") as f:
+        doc = yaml.safe_load(f) or {}
+    waivers: list = []
+    for i, entry in enumerate(doc.get("waivers", [])):
+        if not isinstance(entry, dict):
+            raise ValueError(f"waiver #{i} is not a mapping")
+        rule = entry.get("rule", "")
+        reason = str(entry.get("reason", "")).strip()
+        if rule not in RULES:
+            raise ValueError(f"waiver #{i} names unknown rule {rule!r}")
+        if not reason:
+            raise ValueError(
+                f"waiver #{i} ({rule}) has no reason — every suppression "
+                f"must carry its justification in-repo")
+        waivers.append(Waiver(rule=rule, reason=reason,
+                              file=str(entry.get("file", "")),
+                              contains=str(entry.get("contains", ""))))
+    return waivers
+
+
+def apply_waivers(findings: list, waivers: list) -> tuple:
+    """Returns (findings-with-waived-marked, unused_waivers). Matching
+    findings get their `waived` reason set (Report then excludes them from
+    the exit code); Waiver objects that matched nothing are returned so
+    the caller can report the ones whose rule actually ran as stale."""
+    used: set = set()
+    out: list = []
+    for finding in findings:
+        waived_by = next((w for w in waivers if w.matches(finding)), None)
+        if waived_by is not None:
+            used.add(waived_by)
+            finding = Finding(
+                rule=finding.rule, file=finding.file, line=finding.line,
+                message=finding.message, severity=finding.severity,
+                waived=waived_by.reason,
+            )
+        out.append(finding)
+    return out, [w for w in waivers if w not in used]
+
+
+# ------------------------------------------------------------------- SARIF --
+def to_sarif(report) -> dict:
+    """Render a Report as a SARIF 2.1.0 log (dict; json.dumps-ready)."""
+    rule_ids = sorted(RULES)
+    rule_index = {rid: i for i, rid in enumerate(rule_ids)}
+    results = []
+    for finding in report.sorted_findings():
+        result = {
+            "ruleId": finding.rule,
+            "ruleIndex": rule_index[finding.rule],
+            "level": "note" if finding.waived
+                     else _LEVELS.get(finding.severity, "warning"),
+            "message": {"text": finding.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {
+                        "uri": finding.file.replace(os.sep, "/"),
+                        "uriBaseId": "SRCROOT",
+                    },
+                    # line 0 means whole-artifact: SARIF regions are
+                    # 1-based, so omit the region entirely there
+                    **({"region": {"startLine": finding.line}}
+                       if finding.line else {}),
+                },
+            }],
+        }
+        if finding.waived:
+            result["suppressions"] = [{
+                "kind": "external",
+                "justification": finding.waived,
+            }]
+        results.append(result)
+    src_root = os.path.dirname(os.path.abspath(report.root))
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [{
+            "tool": {"driver": {
+                "name": "ko-analyze",
+                "version": __version__,
+                "informationUri":
+                    "https://github.com/ghl1024/KubeOperator",
+                "rules": [{
+                    "id": rid,
+                    "name": RULES[rid].name,
+                    "shortDescription": {"text": RULES[rid].summary},
+                    "defaultConfiguration": {
+                        "level": _LEVELS.get(RULES[rid].severity,
+                                             "warning"),
+                    },
+                } for rid in rule_ids],
+            }},
+            "originalUriBaseIds": {
+                "SRCROOT": {"uri": "file://" + src_root.rstrip("/") + "/"},
+            },
+            "invocations": [{
+                "executionSuccessful": True,
+                "exitCode": report.exit_code(),
+            }],
+            "results": results,
+        }],
+    }
+
+
+def to_sarif_json(report) -> str:
+    return json.dumps(to_sarif(report), indent=2)

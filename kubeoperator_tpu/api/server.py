@@ -224,7 +224,7 @@ class Handlers:
         from kubeoperator_tpu.api.metrics import MetricsRegistry
 
         self.metrics = MetricsRegistry()
-        self._analysis_cache: dict | None = None
+        self._analysis_cache: tuple | None = None  # (plain dict, sarif dict)
 
     async def bundle_manifest_view(self, request):
         """Version-management screen data (reference parity: the console's
@@ -251,18 +251,22 @@ class Handlers:
     async def analysis_report(self, request):
         """ko-analyze over the running platform's own installed tree — the
         console's static-health view (same JSON as `koctl lint --format
-        json`). Admin-gated: findings name internal file paths. Cached per
-        process after the first call (the installed tree cannot change
-        under a running server), `?fresh=1` forces a re-run."""
+        json`; `?format=sarif` returns SARIF 2.1.0 for CI annotators).
+        Admin-gated: findings name internal file paths. Cached per process
+        after the first call (the installed tree cannot change under a
+        running server), `?fresh=1` forces a re-run."""
         _require_admin(request)
-        from kubeoperator_tpu.analysis import run_analysis
+        from kubeoperator_tpu.analysis import run_analysis, to_sarif
 
         if request.query.get("fresh") == "1":
             self._analysis_cache = None
         if self._analysis_cache is None:
             report = await run_sync(request, run_analysis)
-            self._analysis_cache = report.to_dict()
-        return json_response(self._analysis_cache)
+            self._analysis_cache = (report.to_dict(), to_sarif(report))
+        plain, sarif = self._analysis_cache
+        if request.query.get("format") == "sarif":
+            return json_response(sarif)
+        return json_response(plain)
 
     async def audit_log(self, request):
         from kubeoperator_tpu.utils.errors import ValidationError

@@ -877,10 +877,21 @@ def cmd_tpu_diag(args) -> int:
 
 def cmd_lint(args) -> int:
     """ko-analyze over the installed package (or --root): cross-artifact
-    linter + project-rule AST checker. Exit codes are a tooling contract:
-    0 clean (warnings allowed), 1 error findings, 2 the analyzer itself
-    failed — so CI can distinguish "dirty tree" from "broken gate"."""
-    from kubeoperator_tpu.analysis import RULES, run_analysis
+    linter, project AST rules, and the v2 dataflow/contract engine. Exit
+    codes are a tooling contract: 0 clean (warnings allowed), 1 error
+    findings, 2 the analyzer itself failed — so CI can distinguish
+    "dirty tree" from "broken gate"."""
+    from kubeoperator_tpu.analysis import (
+        RULES,
+        default_root,
+        run_analysis,
+        to_sarif_json,
+    )
+    from kubeoperator_tpu.analysis.index import (
+        default_cache_dir,
+        git_changed_files,
+        git_head,
+    )
 
     if args.list_rules:
         for spec in sorted(RULES.values(), key=lambda s: s.id):
@@ -895,17 +906,40 @@ def cmd_lint(args) -> int:
             print(f"error: unknown rule id(s): {', '.join(sorted(unknown))} "
                   f"(see `koctl lint --list-rules`)", file=sys.stderr)
             return 2
+    cache_dir = None if args.no_cache else (
+        args.cache_dir or default_cache_dir())
+    changed = None
+    head = ""
+    if args.changed:
+        # pre-commit fast path: let the cache skip the whole-tree
+        # artifact hash when git vouches for it (same HEAD as the cache's
+        # last save, clean then and now). Ask git about the ANALYZED
+        # tree, not the cwd — lint run from an unrelated repo must not
+        # trust a stale cache. Unreadable git state falls back to a full
+        # (still cached) run: "couldn't ask git" must never read as
+        # "nothing changed".
+        lint_root = args.root or default_root()
+        changed = git_changed_files(lint_root)
+        head = git_head(lint_root)
+        if changed is None:
+            print("koctl lint --changed: git state unreadable, "
+                  "running a full scan", file=sys.stderr)
     try:
         report = run_analysis(
             root=args.root or None,
             plan_files=tuple(args.plan or ()),
             rule_ids=rule_ids,
+            cache_dir=cache_dir,
+            changed=changed,
+            git_head=head,
         )
     except Exception as e:  # internal analyzer failure, NOT a dirty tree
         print(f"ko-analyze internal error: {e}", file=sys.stderr)
         return 2
     if args.format == "json":
         print(report.to_json())
+    elif args.format == "sarif":
+        print(to_sarif_json(report))
     else:
         print(report.render_text())
     return report.exit_code()
@@ -1262,10 +1296,22 @@ def build_parser() -> argparse.ArgumentParser:
              "single plan mapping) against provider + TPU topology "
              "capabilities; repeatable",
     )
-    lint_p.add_argument("--format", choices=["text", "json"], default="text",
-                        help="report format (json is the machine contract)")
+    lint_p.add_argument("--format", choices=["text", "json", "sarif"],
+                        default="text",
+                        help="report format (json is the machine contract; "
+                             "sarif is SARIF 2.1.0 for CI annotators)")
     lint_p.add_argument("--rules", default="",
                         help="comma-separated rule ids to run (default all)")
+    lint_p.add_argument("--changed", action="store_true",
+                        help="git-assisted pre-commit mode: skip the "
+                             "whole-tree artifact hash when git vouches "
+                             "nothing moved (python files always verify "
+                             "by content hash against the cache)")
+    lint_p.add_argument("--no-cache", action="store_true",
+                        help="disable the content-hash incremental cache")
+    lint_p.add_argument("--cache-dir", default="",
+                        help="cache directory (default: "
+                             "$XDG_CACHE_HOME/ko-analyze)")
     lint_p.add_argument("--root", default="",
                         help="read content/ and migrations from this tree "
                              "instead of the installed package (file-based "

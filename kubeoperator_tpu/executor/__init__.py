@@ -32,6 +32,7 @@ def make_executor(
     backend: str = "auto",
     project_dir: str | None = None,
     runner_address: str | None = None,
+    fork_limit: int = 32,
 ) -> Executor:
     """Backend factory honoring config `executor.backend` (auto|ansible|
     simulation|fake|grpc).
@@ -53,7 +54,8 @@ def make_executor(
     if backend == "auto":
         backend = "ansible" if ansible_available() else "simulation"
     if backend == "ansible":
-        return AnsibleExecutor(project_dir=project_dir)
+        return AnsibleExecutor(project_dir=project_dir,
+                               fork_limit=fork_limit)
     if backend == "simulation":
         return SimulationExecutor(project_dir=project_dir)
     if backend == "fake":

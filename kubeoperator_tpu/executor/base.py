@@ -203,6 +203,11 @@ class Executor(abc.ABC):
     process doesn't accumulate every playbook's buffered output forever.
     """
 
+    # default watch/wait deadline when the caller passes none; the service
+    # container overrides it per instance from `executor.task_timeout_s`
+    # so operators can bound every un-deadlined task from app.yaml
+    task_timeout_s: float = 7200.0
+
     def __init__(self, max_retained: int = 256) -> None:
         self._tasks: dict[str, _TaskState] = {}
         self._order: list[str] = []
@@ -262,8 +267,13 @@ class Executor(abc.ABC):
             )
         )
 
-    def watch(self, task_id: str, timeout_s: float = 7200.0) -> Iterator[str]:
-        """Yield output lines until the task finishes (kobe WatchResult)."""
+    def watch(self, task_id: str,
+              timeout_s: float | None = None) -> Iterator[str]:
+        """Yield output lines until the task finishes (kobe WatchResult).
+        `None` means the configured per-task ceiling (`executor.
+        task_timeout_s`, stamped onto the instance by build_services)."""
+        if timeout_s is None:
+            timeout_s = self.task_timeout_s
         state = self._state(task_id)
         idx = 0
         deadline = now_ts() + timeout_s
@@ -284,8 +294,11 @@ class Executor(abc.ABC):
     def result(self, task_id: str) -> TaskResult:
         return self._state(task_id).result
 
-    def wait(self, task_id: str, timeout_s: float = 7200.0) -> TaskResult:
+    def wait(self, task_id: str,
+             timeout_s: float | None = None) -> TaskResult:
         state = self._state(task_id)
+        if timeout_s is None:
+            timeout_s = self.task_timeout_s
         if not state.done.wait(timeout_s):
             raise ExecutorError(message=f"task {task_id} timed out")
         return state.result

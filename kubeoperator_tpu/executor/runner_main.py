@@ -45,6 +45,20 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--max-workers", type=int, default=16)
     p.add_argument(
+        "--fork-limit", type=int,
+        default=int(env.get("KO_TPU_RUNNER_FORK_LIMIT", "32") or 32),
+        help="ansible --forks (mirrors server-side executor.fork_limit)",
+    )
+    p.add_argument(
+        "--task-timeout-s", type=float,
+        default=float(env.get("KO_TPU_RUNNER_TASK_TIMEOUT_S", "7200")
+                      or 7200),
+        help="default watch/wait ceiling for un-deadlined tasks (mirrors "
+             "server-side executor.task_timeout_s — the server's knob "
+             "bounds only its RPC deadline; the task itself is watched "
+             "HERE)",
+    )
+    p.add_argument(
         "--task-delay-s", type=float,
         default=float(env.get("KO_TPU_RUNNER_TASK_DELAY_S", "0") or 0),
         help="simulation pacing (tests/demos); ignored by other backends",
@@ -74,7 +88,9 @@ def main(argv: list[str] | None = None) -> int:
             project_dir=args.project_dir, task_delay_s=args.task_delay_s
         )
     else:
-        executor = make_executor(backend, args.project_dir)
+        executor = make_executor(backend, args.project_dir,
+                                 fork_limit=args.fork_limit)
+    executor.task_timeout_s = args.task_timeout_s
 
     server = serve(executor, bind=args.bind, max_workers=args.max_workers)
     log.info(

@@ -188,7 +188,10 @@ class RunnerClient(Executor):
                     continue
                 raise ExecutorError(message=f"runner RPC failed: {e}") from e
 
-    def watch(self, task_id: str, timeout_s: float = 7200.0) -> Iterator[str]:
+    def watch(self, task_id: str,
+              timeout_s: float | None = None) -> Iterator[str]:
+        if timeout_s is None:
+            timeout_s = self.task_timeout_s
         try:
             for msg in self._watch_rpc({"task_id": task_id}, timeout=timeout_s):
                 yield msg["line"]
@@ -215,7 +218,8 @@ class RunnerClient(Executor):
         except grpc.RpcError as e:
             raise ExecutorError(message=f"runner unreachable: {e}") from e
 
-    def wait(self, task_id: str, timeout_s: float = 7200.0) -> TaskResult:
+    def wait(self, task_id: str,
+             timeout_s: float | None = None) -> TaskResult:
         for _ in self.watch(task_id, timeout_s):
             pass
         return self.result(task_id)

@@ -143,12 +143,20 @@ def build_services(
     )
     db = Database(config.get("db.path", "ko_tpu.db"))
     repos = Repositories(db)
+    from kubeoperator_tpu.utils.i18n import set_default_locale
+
+    set_default_locale(config.get("i18n.default_locale", "en-US"))
     backend = config.get("executor.backend", "auto")
     executor = make_executor(
         backend,
         config.get("executor.project_dir"),
         runner_address=config.get("executor.runner_address"),
+        fork_limit=int(config.get("executor.fork_limit", 32)),
     )
+    # the default watch/wait ceiling for un-deadlined tasks — applied
+    # below, after any chaos wrapping, because the outermost executor is
+    # the one whose task registry watch/wait consult
+    task_timeout_s = float(config.get("executor.task_timeout_s", 7200))
     if config.get("chaos.enabled", False):
         # seeded fault injection (resilience/chaos.py): the stack behaves
         # identically to production EXCEPT tasks randomly fail in transient
@@ -174,6 +182,7 @@ def build_services(
             rng=random.Random(int(config.get("chaos.seed", 1))),
             config=ChaosConfig.from_config(config),
         )
+    executor.task_timeout_s = task_timeout_s
     if simulate is None:
         simulate = not terraform_available(
             config.get("provisioner.terraform_bin", "terraform")

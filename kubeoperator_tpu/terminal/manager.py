@@ -114,7 +114,7 @@ class TerminalSession:
     def read_since(self, after_seq: int = -1) -> list[tuple[int, bytes]]:
         # last_active under the lock: write() updates it while holding it,
         # and a torn bare write here could push an in-use session past the
-        # idle reaper's cutoff (ko-analyze KO-P003)
+        # idle reaper's cutoff (ko-analyze KO-P008 guarded-by)
         with self._lock:
             self.last_active = now_ts()
             return [(s, d) for s, d in self._chunks if s > after_seq]

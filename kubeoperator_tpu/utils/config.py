@@ -42,7 +42,10 @@ DEFAULTS: dict[str, Any] = {
         "runner_address": "127.0.0.1:8790",
         "project_dir": None,  # defaults to bundled content/ dir
         "fork_limit": 32,
-        "task_timeout_s": 3600,
+        # default watch/wait ceiling for tasks with no explicit deadline
+        # (Executor.task_timeout_s); matches the historical hard-coded
+        # 7200 so declaring the knob changed no behavior
+        "task_timeout_s": 7200,
     },
     "provisioner": {
         "terraform_bin": "terraform",
@@ -111,9 +114,37 @@ DEFAULTS: dict[str, Any] = {
     },
     "registry": {
         # nexus-equivalent offline artifact registry (SURVEY.md §1 "Offline
-        # registry"); consumed as an artifact, addressed by URL.
+        # registry"); consumed as an artifact, addressed by URL. The
+        # architecture list is NOT a knob: the bundle's contents are fixed
+        # at build time (registry/manifest.py ARCHITECTURES).
         "url": "http://127.0.0.1:8081",
-        "architectures": ["amd64", "arm64"],
+    },
+    "terminal": {
+        # web-terminal sessions (terminal/manager.py): the shell runs as
+        # the server process, so opening is admin-only unless the operator
+        # extends it to project managers explicitly
+        "shell": "/bin/bash",
+        "max_sessions": 16,
+        "idle_timeout_s": 900,
+        "allow_project_managers": False,
+    },
+    "notify": {
+        # message-center bootstrap tier (service/notify.py): app.yaml
+        # values seed the channels; the stored 'notify' settings row holds
+        # runtime overrides and always wins
+        "smtp": {
+            "enabled": False,
+            "host": "localhost",
+            "port": 25,
+            "username": "",
+            "password": "",
+            "from": "ko-tpu@localhost",
+            "tls": False,
+        },
+        "webhook": {
+            "url": "",
+            "headers": {},
+        },
     },
     "cron": {
         "backup_enabled": True,

@@ -52,12 +52,24 @@ CATALOG: dict[str, dict[str, str]] = {
 DEFAULT_LOCALE = "en-US"
 
 
+def set_default_locale(locale: str) -> None:
+    """Process-wide fallback locale (`i18n.default_locale`), applied at
+    service-container boot. Unknown locales keep en-US — a typo'd config
+    value must not make every message render as its bare code."""
+    global DEFAULT_LOCALE
+    if locale in CATALOG:
+        DEFAULT_LOCALE = locale
+
+
 class _SafeDict(dict):
     def __missing__(self, key: str) -> str:  # leave unknown placeholders visible
         return "{" + key + "}"
 
 
-def translate(code: str, locale: str = DEFAULT_LOCALE, **args: object) -> str:
+def translate(code: str, locale: str | None = None, **args: object) -> str:
+    # resolved at CALL time (not bound at def time) so the configured
+    # i18n.default_locale applies to callers that pass no locale
+    locale = locale or DEFAULT_LOCALE
     table = CATALOG.get(locale) or CATALOG[DEFAULT_LOCALE]
     template = table.get(code) or CATALOG[DEFAULT_LOCALE].get(code) or code
     return template.format_map(_SafeDict(**{k: str(v) for k, v in args.items()}))

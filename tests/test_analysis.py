@@ -4,7 +4,9 @@ contract (golden test), the koctl lint exit-code contract, and the
 /api/v1/analysis endpoint. The complementary whole-repo zero-error gate
 lives in tests/test_static_gate.py."""
 
+import ast
 import json
+import os
 import textwrap
 
 import pytest
@@ -436,6 +438,13 @@ class TestBlockingHandler:  # KO-P002
         assert ast_findings(tmp_path, textwrap.dedent(src), "KO-P002") == []
 
 
+def flow_findings(tmp_path, files: dict, rule: str):
+    """Run one project-wide rule (KO-P008/P009/X009/X010 ride
+    run_analysis, not run_ast_rules) over a fixture tree."""
+    root = make_tree(tmp_path, files)
+    return run_analysis(root=root, rule_ids={rule}).findings
+
+
 LOCKED_CLASS = """\
     import threading
 
@@ -450,18 +459,18 @@ LOCKED_CLASS = """\
     """
 
 
-class TestLockDiscipline:  # KO-P003
+class TestGuardedBy:  # KO-P008 (supersedes the retired KO-P003)
     def test_consistent_class_is_quiet(self, tmp_path):
-        assert ast_findings(
-            tmp_path, textwrap.dedent(LOCKED_CLASS), "KO-P003") == []
+        assert flow_findings(
+            tmp_path, {"mod.py": LOCKED_CLASS}, "KO-P008") == []
 
     def test_fires_on_mixed_write(self, tmp_path):
         src = textwrap.dedent(LOCKED_CLASS) + (
             "    def reset(self):\n"
             "        self.count = 0\n"
         )
-        findings = ast_findings(tmp_path, src, "KO-P003")
-        assert len(findings) == 1
+        findings = flow_findings(tmp_path, {"mod.py": src}, "KO-P008")
+        assert [f.rule for f in findings] == ["KO-P008"]
         assert "Buffered.count" in findings[0].message
         assert "reset" in findings[0].message
 
@@ -470,7 +479,7 @@ class TestLockDiscipline:  # KO-P003
             "    def _reset_locked(self):\n"
             "        self.count = 0\n"
         )
-        assert ast_findings(tmp_path, src, "KO-P003") == []
+        assert flow_findings(tmp_path, {"mod.py": src}, "KO-P008") == []
 
     def test_injected_lock_still_detected(self, tmp_path):
         # `self._lock = lock` (injection/aliasing) carries no Lock() call —
@@ -486,7 +495,7 @@ class TestLockDiscipline:  # KO-P003
                 def reset(self):
                     self.n = 0
             """
-        findings = ast_findings(tmp_path, textwrap.dedent(src), "KO-P003")
+        findings = flow_findings(tmp_path, {"mod.py": src}, "KO-P008")
         assert len(findings) == 1 and "Shared.n" in findings[0].message
 
     def test_class_without_lock_is_skipped(self, tmp_path):
@@ -497,7 +506,227 @@ class TestLockDiscipline:  # KO-P003
                 def b(self):
                     self.x = 2
             """
-        assert ast_findings(tmp_path, textwrap.dedent(src), "KO-P003") == []
+        assert flow_findings(tmp_path, {"mod.py": src}, "KO-P008") == []
+
+    def test_private_helper_called_under_lock_is_guarded(self, tmp_path):
+        # interprocedural: _bump has no lexical `with` but every observed
+        # entry holds the lock — the retired KO-P003 could not see this
+        src = textwrap.dedent(LOCKED_CLASS) + (
+            "    def _bump(self):\n"
+            "        self.count += 1\n"
+            "    def locked_path(self):\n"
+            "        with self._lock:\n"
+            "            self._bump()\n"
+        )
+        assert flow_findings(tmp_path, {"mod.py": src}, "KO-P008") == []
+
+    def test_two_level_locked_chain_is_quiet(self, tmp_path):
+        # regression: the fixed point must not seed a premature 'bare'
+        # context while a caller's own entry is still unknown — a
+        # correctly-locked api -> _a -> _b chain (declaration order
+        # putting _a before api) was falsely flagged
+        src = """\
+            import threading
+
+            class Chain:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.count = 0
+
+                def _a(self):
+                    self._b()
+
+                def _b(self):
+                    self.count += 1
+
+                def api(self):
+                    with self._lock:
+                        self._a()
+
+                def other(self):
+                    with self._lock:
+                        self.count = 0
+            """
+        assert flow_findings(tmp_path, {"mod.py": src}, "KO-P008") == []
+
+    def test_fires_across_files_on_subclass_bare_write(self, tmp_path):
+        # the base class owns the lock in one file; the subclass writes
+        # the guarded field bare in another — only a PROJECT-wide join
+        # can see the pair
+        child = """\
+            from .mod import Buffered
+
+            class Child(Buffered):
+                def reset(self):
+                    self.count = 0
+            """
+        findings = flow_findings(
+            tmp_path, {"mod.py": LOCKED_CLASS, "sub/child.py": child},
+            "KO-P008")
+        assert len(findings) == 1
+        assert "Buffered.count" in findings[0].message
+        assert findings[0].file.endswith(os.path.join("sub", "child.py"))
+
+    def test_closure_write_counts_as_bare(self, tmp_path):
+        # a nested def runs on whichever thread calls it — it never
+        # inherits the enclosing method's lexical lock
+        src = textwrap.dedent(LOCKED_CLASS) + (
+            "    def spawn(self):\n"
+            "        def work():\n"
+            "            self.count = 0\n"
+            "        return work\n"
+        )
+        findings = flow_findings(tmp_path, {"mod.py": src}, "KO-P008")
+        assert len(findings) == 1 and "spawn" in findings[0].message
+
+
+class TestExceptionFlow:  # KO-P009
+    def test_fires_on_journal_open_leak(self, tmp_path):
+        src = """\
+            class S:
+                def run(self, cluster):
+                    op = self.journal.open(cluster, "backup")
+                    self.adm.run(cluster)
+                    return {"ok": True}
+            """
+        findings = flow_findings(tmp_path, {"svc.py": src}, "KO-P009")
+        assert [f.rule for f in findings] == ["KO-P009"]
+        assert "close()/interrupt()" in findings[0].message
+
+    def test_close_on_all_paths_is_quiet(self, tmp_path):
+        src = """\
+            class S:
+                def run(self, cluster):
+                    op = self.journal.open(cluster, "backup")
+                    try:
+                        self.adm.run(cluster)
+                    except Exception as e:
+                        self.journal.close(op, ok=False, message=str(e))
+                        raise
+                    self.journal.close(op, ok=True)
+            """
+        assert flow_findings(tmp_path, {"svc.py": src}, "KO-P009") == []
+
+    def test_exception_propagation_is_the_sanctioned_reraise(self, tmp_path):
+        # adm.run may raise between open and close: the op STAYS open for
+        # the boot reconciler — that path must not be flagged, only the
+        # normal-completion leak is
+        src = """\
+            class S:
+                def run(self, cluster):
+                    op = self.journal.open(cluster, "x")
+                    try:
+                        self.adm.run(cluster)
+                    except ValueError:
+                        self.journal.close(op, ok=False)
+                        raise
+                    self.journal.close(op, ok=True)
+            """
+        assert flow_findings(tmp_path, {"svc.py": src}, "KO-P009") == []
+
+    def test_close_in_finally_is_quiet(self, tmp_path):
+        src = """\
+            class S:
+                def run(self, cluster):
+                    op = self.journal.open(cluster, "x")
+                    try:
+                        self.adm.run(cluster)
+                    finally:
+                        self.journal.close(op, ok=True)
+            """
+        assert flow_findings(tmp_path, {"svc.py": src}, "KO-P009") == []
+
+    def test_conditional_close_inside_with_still_fires(self, tmp_path):
+        # regression: a close() reachable only on ONE branch must not
+        # satisfy the other just because both sit inside a `with` block
+        src = """\
+            class S:
+                def run(self, cluster, cond):
+                    op = self.journal.open(cluster, "x")
+                    with self._lock:
+                        if cond:
+                            self.journal.close(op, ok=True)
+                    return None
+            """
+        findings = flow_findings(tmp_path, {"svc.py": src}, "KO-P009")
+        assert len(findings) == 1
+
+    def test_unconditional_close_inside_with_is_quiet(self, tmp_path):
+        src = """\
+            class S:
+                def run(self, cluster):
+                    op = self.journal.open(cluster, "x")
+                    with self._lock:
+                        self.journal.close(op, ok=True)
+            """
+        assert flow_findings(tmp_path, {"svc.py": src}, "KO-P009") == []
+
+    def test_swallowing_handler_then_leak_fires(self, tmp_path):
+        src = """\
+            class S:
+                def run(self, cluster):
+                    op = self.journal.open(cluster, "x")
+                    try:
+                        self.adm.run(cluster)
+                    except Exception:
+                        return None
+                    self.journal.close(op, ok=True)
+            """
+        findings = flow_findings(tmp_path, {"svc.py": src}, "KO-P009")
+        assert len(findings) == 1
+
+    def test_ownership_escape_stops_tracking(self, tmp_path):
+        # the admit()-closure idiom: `nonlocal op` hands the op to the
+        # work() closure that closes it — and `return op` hands it to the
+        # caller (journal.open itself does exactly that)
+        src = """\
+            class S:
+                def admit(self, cluster):
+                    op = None
+                    def inner():
+                        nonlocal op
+                        op = self.journal.open(cluster, "x")
+                    inner()
+
+                def make(self, cluster):
+                    op = self.journal.open(cluster, "x")
+                    return op
+            """
+        assert flow_findings(tmp_path, {"svc.py": src}, "KO-P009") == []
+
+    def test_fires_on_base_exception_swallow(self, tmp_path):
+        src = """\
+            def f(self):
+                try:
+                    self.work()
+                except BaseException:
+                    return None
+            """
+        findings = flow_findings(tmp_path, {"svc.py": src}, "KO-P009")
+        assert len(findings) == 1
+        assert "ControllerDeath" in findings[0].message
+
+    def test_reraising_base_exception_handler_is_quiet(self, tmp_path):
+        src = """\
+            def f(self):
+                try:
+                    self.work()
+                except BaseException:
+                    self.rollback()
+                    raise
+            """
+        assert flow_findings(tmp_path, {"svc.py": src}, "KO-P009") == []
+
+    def test_waiver_comment_quiets_swallow(self, tmp_path):
+        src = """\
+            def f(self):
+                try:
+                    self.work()
+                # KO-P009: waived — top-level cron loop must survive anything
+                except BaseException:
+                    pass
+            """
+        assert flow_findings(tmp_path, {"svc.py": src}, "KO-P009") == []
 
 
 class TestMutableDefault:  # KO-P004
@@ -640,6 +869,398 @@ class TestPhaseWriteDiscipline:  # KO-P007
                             rel="service/x.py") == []
 
 
+# ------------------------------------------------------- contract rules ----
+def index_for(tmp_path, files: dict):
+    """Build a ProjectIndex over a fixture tree (the injection path the
+    contract rules expose for tests)."""
+    from kubeoperator_tpu.analysis.index import (
+        ProjectIndex,
+        extract_file_facts,
+        iter_python_files,
+    )
+
+    root = make_tree(tmp_path, files)
+    index = ProjectIndex(root=root)
+    parent = os.path.dirname(root)
+    for path in iter_python_files(root):
+        rel = os.path.relpath(path, parent)
+        with open(path, encoding="utf-8") as f:
+            index.files[rel] = extract_file_facts(
+                ast.parse(f.read()), rel)
+    return index
+
+
+FIX_DEFAULTS = {
+    "server": {"port": 8080},
+    "resilience": {"max_attempts": 3, "reconcile": {"enabled": True}},
+}
+
+
+class TestConfigContract:  # KO-X009
+    def test_agreeing_surface_is_quiet(self, tmp_path):
+        from kubeoperator_tpu.analysis.contracts import check_config_contract
+
+        index = index_for(tmp_path, {"svc.py": """\
+            def build(config):
+                a = config.get("server.port", 8080)
+                b = config.get("resilience.max_attempts", 3)
+                c = config.get("resilience.reconcile.enabled", True)
+            """})
+        docs = tmp_path / "docs"
+        docs.mkdir()
+        (docs / "resilience.md").write_text(
+            "| knob | default | meaning |\n|---|---|---|\n"
+            "| `resilience.max_attempts` | 3 | tries |\n"
+            "| `resilience.reconcile.enabled` | true | sweep |\n")
+        assert check_config_contract(
+            index, defaults=FIX_DEFAULTS, docs_dir=str(docs),
+            doc_required_sections=("resilience",)) == []
+
+    def test_fires_on_typod_read(self, tmp_path):
+        from kubeoperator_tpu.analysis.contracts import check_config_contract
+
+        index = index_for(tmp_path, {"svc.py": """\
+            def build(config):
+                return config.get("server.prot", 8080)
+            """})
+        findings = check_config_contract(
+            index, defaults=FIX_DEFAULTS, docs_dir=str(tmp_path / "none"),
+            doc_required_sections=())
+        assert any("server.prot" in f.message and "not declared"
+                   in f.message for f in findings)
+
+    def test_fires_on_dead_defaults_key(self, tmp_path):
+        from kubeoperator_tpu.analysis.contracts import check_config_contract
+
+        index = index_for(tmp_path, {"svc.py": """\
+            def build(config):
+                return config.get("server.port", 8080)
+            """})
+        findings = check_config_contract(
+            index, defaults=FIX_DEFAULTS, docs_dir=str(tmp_path / "none"),
+            doc_required_sections=())
+        assert any("never read" in f.message
+                   and "resilience.max_attempts" in f.message
+                   for f in findings)
+
+    def test_section_fstring_idiom_resolves(self, tmp_path):
+        from kubeoperator_tpu.analysis.contracts import check_config_contract
+
+        index = index_for(tmp_path, {"svc.py": """\
+            def from_config(config, section: str = "resilience"):
+                a = config.get(f"{section}.max_attempts", 3)
+                b = config.get(f"{section}.reconcile.enabled", True)
+                c = config.get("server.port", 1)
+            """})
+        assert check_config_contract(
+            index, defaults=FIX_DEFAULTS, docs_dir=str(tmp_path / "none"),
+            doc_required_sections=()) == []
+
+    def test_fires_on_stale_docs_key_and_undocumented_block(self, tmp_path):
+        from kubeoperator_tpu.analysis.contracts import check_config_contract
+
+        index = index_for(tmp_path, {"svc.py": """\
+            def build(config):
+                a = config.get("server.port", 1)
+                b = config.get("resilience.max_attempts", 3)
+                c = config.get("resilience.reconcile.enabled", True)
+            """})
+        docs = tmp_path / "docs"
+        docs.mkdir()
+        (docs / "x.md").write_text(
+            "| knob | default |\n|---|---|\n"
+            "| `resilience.max_attemps` | 3 |\n")   # typo'd row
+        findings = check_config_contract(
+            index, defaults=FIX_DEFAULTS, docs_dir=str(docs),
+            doc_required_sections=("resilience",))
+        assert any("max_attemps" in f.message and "stale or typo" in f.message
+                   for f in findings)
+        # and the real knobs have no row -> coverage findings
+        assert any("resilience.max_attempts" in f.message
+                   and "no row" in f.message for f in findings)
+
+    def test_prose_backticks_are_not_knob_rows(self, tmp_path):
+        # `db.statement_is_complete`-style prose in a NON-knob table (no
+        # "default" header) must not read as a config key
+        from kubeoperator_tpu.analysis.contracts import _doc_table_keys
+
+        docs = tmp_path / "docs"
+        docs.mkdir()
+        (docs / "x.md").write_text(
+            "| id | invariant |\n|---|---|\n"
+            "| X1 | see `db.statement_is_complete` and mutable default |\n")
+        assert _doc_table_keys(str(docs)) == []
+
+
+SERVER_FIX = """\
+    def create_app(app, h):
+        r = app.router
+        r.add_get("/api/v1/clusters", h.list_clusters)
+        r.add_post("/api/v1/clusters", h.create_cluster)
+        r.add_get("/api/v1/clusters/{name}/status", h.status)
+        h._crud_routes(app, "/api/v1/plans", None, None, ())
+    """
+
+KOCTL_FIX = """\
+    class LocalClient:
+        def _dispatch(self, s, method, parts, body):
+            match (method, parts):
+                case ("GET", ["clusters"]):
+                    return []
+                case ("POST", ["clusters"]):
+                    return {}
+                case ("GET", ["clusters", name, "status"]):
+                    return {}
+                case ("GET", ["plans"]):
+                    return []
+
+    def cmd(client, args):
+        client.call("GET", "/api/v1/clusters")
+        client.call("POST", "/api/v1/clusters", {})
+        client.call("GET", f"/api/v1/clusters/{args.name}/status")
+        client.call("GET", "/api/v1/plans")
+    """
+
+
+class TestSurfaceParity:  # KO-X010
+    def _findings(self, tmp_path, server=SERVER_FIX, koctl=KOCTL_FIX,
+                  docs_text: str = ""):
+        from kubeoperator_tpu.analysis.contracts import check_surface_parity
+
+        index = index_for(tmp_path, {"api/server.py": server,
+                                     "cli/koctl.py": koctl})
+        return check_surface_parity(index, docs_text=docs_text)
+
+    def test_parity_is_quiet(self, tmp_path):
+        assert self._findings(tmp_path) == []
+
+    def test_fires_on_cli_call_without_route(self, tmp_path):
+        koctl = KOCTL_FIX + (
+            "    client.call(\"POST\", "
+            "f\"/api/v1/clusters/{args.name}/frobnicate\")\n")
+        findings = self._findings(tmp_path, koctl=koctl)
+        assert any("registers no matching route" in f.message
+                   for f in findings)
+        # ... and no --local case either
+        assert any("no matching case" in f.message for f in findings)
+
+    def test_fires_on_local_only_dispatch(self, tmp_path):
+        koctl = KOCTL_FIX.replace(
+            "                case (\"GET\", [\"plans\"]):\n"
+            "                    return []\n",
+            "                case (\"GET\", [\"plans\"]):\n"
+            "                    return []\n"
+            "                case (\"POST\", [\"plans\", name, \"shadow\"]):\n"
+            "                    return {}\n")
+        findings = self._findings(tmp_path, koctl=koctl)
+        assert any("local transport grew a verb" in f.message
+                   for f in findings)
+
+    def test_crud_helper_expands_to_four_routes(self, tmp_path):
+        # DELETE /api/v1/plans/{name} only exists through _crud_routes —
+        # a call and a dispatch case against it must both resolve
+        koctl = KOCTL_FIX.replace(
+            "                case (\"GET\", [\"plans\"]):\n"
+            "                    return []\n",
+            "                case (\"GET\", [\"plans\"]):\n"
+            "                    return []\n"
+            "                case (\"DELETE\", [\"plans\", name]):\n"
+            "                    return {}\n").rstrip(" ") + (
+            "        client.call(\"DELETE\", "
+            "f\"/api/v1/plans/{args.name}\")\n")
+        assert self._findings(tmp_path, koctl=koctl) == []
+
+    def test_fires_on_undocumented_command(self, tmp_path):
+        koctl = KOCTL_FIX.rstrip(" ") + (
+            "\n"
+            "    def build_parser(sub):\n"
+            "        sub.add_parser(\"frotz\")\n"
+            "        sub.add_parser(\"lint\")\n")
+        findings = self._findings(tmp_path, koctl=koctl,
+                                  docs_text="run `koctl lint` often")
+        assert any("'frotz'" in f.message for f in findings)
+        assert all("'lint'" not in f.message for f in findings)
+
+
+# -------------------------------------------------------- waivers + SARIF --
+class TestWaiversAndSarif:
+    def _dirty_root(self, tmp_path):
+        return make_tree(tmp_path, {
+            "content/playbooks/01-a.yml": "- hosts: all\n  roles: [ghost]\n",
+        })
+
+    def test_waiver_suppresses_exit_code_but_keeps_finding(self, tmp_path):
+        root = self._dirty_root(tmp_path)
+        waivers = tmp_path / "waivers.yaml"
+        waivers.write_text(
+            "waivers:\n"
+            "  - rule: KO-X001\n"
+            "    contains: ghost\n"
+            "    reason: fixture role lands in the next PR\n")
+        report = run_analysis(root=root, rule_ids={"KO-X001"},
+                              waivers_path=str(waivers))
+        assert report.exit_code() == 0
+        assert len(report.waived) == 1
+        assert report.waived[0].waived.startswith("fixture role")
+
+    def test_waiver_without_reason_is_an_internal_error(self, tmp_path):
+        root = self._dirty_root(tmp_path)
+        waivers = tmp_path / "waivers.yaml"
+        waivers.write_text("waivers:\n  - rule: KO-X001\n")
+        with pytest.raises(ValueError):
+            run_analysis(root=root, rule_ids={"KO-X001"},
+                         waivers_path=str(waivers))
+
+    def test_stale_waiver_is_reported(self, tmp_path):
+        root = make_tree(tmp_path, {"m.py": "x = 1\n"})
+        waivers = tmp_path / "waivers.yaml"
+        waivers.write_text(
+            "waivers:\n"
+            "  - rule: KO-X001\n"
+            "    contains: long-gone\n"
+            "    reason: fixed ages ago\n")
+        report = run_analysis(root=root, rule_ids={"KO-X001", "KO-P004"},
+                              waivers_path=str(waivers))
+        assert report.exit_code() == 0
+        assert len(report.unused_waivers) == 1
+        # ... but a waiver for a rule that did NOT run is not judged
+        report = run_analysis(root=root, rule_ids={"KO-P004"},
+                              waivers_path=str(waivers))
+        assert report.unused_waivers == []
+
+    def test_golden_sarif_report(self, tmp_path):
+        """SARIF 2.1.0 contract: schema/version pinned, driver rule table
+        complete, one result per finding with a physical location, waived
+        findings carried as suppressed notes — and the document
+        round-trips through json."""
+        from kubeoperator_tpu.analysis import to_sarif, to_sarif_json
+
+        root = self._dirty_root(tmp_path)
+        waivers = tmp_path / "waivers.yaml"
+        waivers.write_text(
+            "waivers:\n"
+            "  - rule: KO-X003\n"
+            "    contains: 99-ghost\n"
+            "    reason: exercised by the golden test\n")
+        report = run_analysis(root=root, rule_ids={"KO-X001"},
+                              waivers_path=str(waivers))
+        doc = to_sarif(report)
+        assert doc["version"] == "2.1.0"
+        assert doc["$schema"].endswith("sarif-2.1.0.json")
+        run = doc["runs"][0]
+        driver = run["tool"]["driver"]
+        assert driver["name"] == "ko-analyze"
+        assert sorted(r["id"] for r in driver["rules"]) == sorted(RULES)
+        assert run["invocations"][0]["exitCode"] == 1
+        [result] = run["results"]
+        assert result["ruleId"] == "KO-X001"
+        assert result["level"] == "error"
+        assert "ghost" in result["message"]["text"]
+        location = result["locations"][0]["physicalLocation"]
+        assert location["artifactLocation"]["uri"].endswith("01-a.yml")
+        assert location["artifactLocation"]["uriBaseId"] == "SRCROOT"
+        assert "region" not in location        # line 0 = whole artifact
+        # rule metadata resolves through ruleIndex
+        assert driver["rules"][result["ruleIndex"]]["id"] == "KO-X001"
+        # waived finding -> suppressed note
+        waived_report = run_analysis(root=root, rule_ids={"KO-X001"},
+                                     waivers_path=str(waivers))
+        assert json.loads(to_sarif_json(waived_report))["runs"][0][
+            "results"][0]["level"] == "error"
+
+    def test_sarif_suppression_for_waived_finding(self, tmp_path):
+        from kubeoperator_tpu.analysis import to_sarif
+
+        root = self._dirty_root(tmp_path)
+        waivers = tmp_path / "waivers.yaml"
+        waivers.write_text(
+            "waivers:\n"
+            "  - rule: KO-X001\n"
+            "    contains: ghost\n"
+            "    reason: fixture role lands in the next PR\n")
+        report = run_analysis(root=root, rule_ids={"KO-X001"},
+                              waivers_path=str(waivers))
+        [result] = to_sarif(report)["runs"][0]["results"]
+        assert result["level"] == "note"
+        assert result["suppressions"][0]["justification"].startswith(
+            "fixture role")
+
+
+# ------------------------------------------------------ incremental cache --
+class TestIncrementalCache:
+    def test_warm_run_reuses_and_matches(self, tmp_path):
+        root = make_tree(tmp_path, {
+            "svc.py": "def f(a=[]):\n    return a\n",   # KO-P004 firing
+            "content/playbooks/01-a.yml": "- hosts: all\n  roles: [ghost]\n",
+        })
+        cache = str(tmp_path / "cache")
+        cold = run_analysis(root=root, cache_dir=cache)
+        warm = run_analysis(root=root, cache_dir=cache)
+        assert cold.cache_hits == 0 and cold.cache_misses > 0
+        assert warm.cache_hits > 0 and warm.cache_misses == 0
+        assert ([f.to_dict() for f in cold.sorted_findings()]
+                == [f.to_dict() for f in warm.sorted_findings()])
+
+    def test_edited_file_invalidates_only_itself(self, tmp_path):
+        files = {
+            "a.py": "def f():\n    return 1\n",
+            "b.py": "def g():\n    return 2\n",
+        }
+        root = make_tree(tmp_path, files)
+        cache = str(tmp_path / "cache")
+        run_analysis(root=root, cache_dir=cache)
+        (tmp_path / "fixturepkg" / "a.py").write_text(
+            "def f(a=[]):\n    return a\n")
+        report = run_analysis(root=root, cache_dir=cache)
+        assert any(f.rule == "KO-P004" for f in report.findings)
+        # b.py came from cache; a.py (changed) plus the artifact tree
+        # entry re-ran
+        assert report.cache_hits >= 1
+
+    def test_changed_mode_never_trusts_git_over_content(self, tmp_path):
+        # --changed may skip the whole-tree artifact hash, but python
+        # files ALWAYS verify by content hash: an edit is caught even
+        # when the caller's changed-set wrongly omits the file (commit/
+        # branch-switch/revert leave git status clean while content
+        # diverges from the cache)
+        root = make_tree(tmp_path, {
+            "a.py": "def f():\n    return 1\n",
+            "b.py": "def g():\n    return 2\n",
+        })
+        cache = str(tmp_path / "cache")
+        run_analysis(root=root, cache_dir=cache)
+        (tmp_path / "fixturepkg" / "a.py").write_text(
+            "def f(a=[]):\n    return a\n")
+        report = run_analysis(root=root, cache_dir=cache, changed=set(),
+                              git_head="deadbeef")
+        assert any(f.rule == "KO-P004" and f.file.endswith("a.py")
+                   for f in report.findings)
+
+    def test_changed_artifact_fast_path_requires_git_vouching(self, tmp_path):
+        root = make_tree(tmp_path, {
+            "a.py": "def f():\n    return 1\n",
+            "content/playbooks/01-a.yml": "- hosts: all\n  roles: [ghost]\n",
+        })
+        cache = str(tmp_path / "cache")
+        # prime WITH git state recorded (a --changed run at head h1,
+        # clean tree)
+        first = run_analysis(root=root, cache_dir=cache, changed=set(),
+                             git_head="h1")
+        assert any(f.rule == "KO-X001" for f in first.findings)
+        # same head, still clean: fast path reuses the artifact entry
+        warm = run_analysis(root=root, cache_dir=cache, changed=set(),
+                            git_head="h1")
+        assert any(f.rule == "KO-X001" for f in warm.findings)
+        # the playbook is FIXED but the caller claims a clean tree at a
+        # NEW head (the commit scenario): head mismatch must force the
+        # hash path and drop the stale finding
+        (tmp_path / "fixturepkg" / "content" / "playbooks"
+         / "01-a.yml").write_text("- hosts: all\n  roles: []\n")
+        fixed = run_analysis(root=root, cache_dir=cache, changed=set(),
+                             git_head="h2")
+        assert not any(f.rule == "KO-X001" for f in fixed.findings)
+
+
 # ------------------------------------------------------------ report model --
 class TestReport:
     def test_unknown_rule_id_rejected(self):
@@ -690,7 +1311,8 @@ class TestReport:
             "analyzer": "ko-analyze",
             "version": __version__,
             "rules_run": ["KO-X001", "KO-X002"],
-            "counts": {"error": 2, "warning": 0},
+            "counts": {"error": 2, "warning": 0, "waived": 0},
+            "unused_waivers": [],
             "findings": [
                 {
                     "rule": "KO-X001",
