@@ -23,7 +23,9 @@ from kubeoperator_tpu.executor.base import (
 )
 from kubeoperator_tpu.models import Cluster, Credential, Host, Node, Plan
 from kubeoperator_tpu.models.cluster import ConditionStatus
+from kubeoperator_tpu.models.span import SpanKind, SpanStatus
 from kubeoperator_tpu.executor.inventory import build_inventory
+from kubeoperator_tpu.observability.tracing import NullTracer, trace_context
 from kubeoperator_tpu.resilience.policy import RetryPolicy
 from kubeoperator_tpu.utils.errors import ExecutorError, PhaseError, ValidationError
 from kubeoperator_tpu.utils.ids import now_ts
@@ -97,6 +99,9 @@ class AdmContext:
     # engine reports every phase transition (name, Running|OK|Failed) so
     # the durable op row always knows how far the operation got
     on_phase: Callable[[str, str], None] = lambda name, status: None
+    # span producer for this operation (journal.attach wires the real
+    # Tracer; the default NullTracer keeps untraced runs at zero overhead)
+    tracer: object = field(default_factory=NullTracer)
 
     @classmethod
     def for_cluster(cls, repos, cluster: Cluster, plan: Plan | None = None,
@@ -257,6 +262,16 @@ class ClusterAdm:
         deadline = self.policy.deadline_from(now_ts())
         attempts = 0
         total_backoff = 0.0
+        tracer = ctx.tracer
+        # the phase span absorbs the condition's wall-clock role in the
+        # trace tree: condition rows stay the resumability contract, the
+        # span tree is the drill-down (docs/observability.md). A
+        # ControllerDeath tears through WITHOUT closing spans — Running
+        # spans next to the open journal op are the crash evidence.
+        phase_span = tracer.start_span(
+            phase.name, SpanKind.PHASE, parent_id=tracer.root_id,
+            attrs={"playbook": phase.playbook},
+        )
 
         def stamp(cond) -> None:
             cond.attempts = attempts
@@ -267,9 +282,23 @@ class ClusterAdm:
             stamp(status.upsert_condition(phase.name, ConditionStatus.RUNNING))
             ctx.save_cluster(cluster)
             ctx.on_phase(phase.name, ConditionStatus.RUNNING.value)
+            # retries are SIBLING attempt spans under the phase, each
+            # carrying its FailureKind — the waterfall shows the retry
+            # storm, not just the final outcome
+            attempt_span = tracer.start_span(
+                f"attempt-{attempts}", SpanKind.ATTEMPT,
+                parent_id=phase_span.id, attrs={"attempt": attempts},
+            )
 
             try:
-                result, lines = self._attempt(ctx, phase, deadline)
+                result, lines = self._attempt(
+                    ctx, phase, deadline,
+                    trace=(trace_context(tracer.trace_id, attempt_span.id)
+                           if tracer.enabled else {}),
+                )
+                # task + host spans the executor built (possibly on the
+                # far side of the runner RPC) land in the tree here
+                tracer.record_payload(result.spans)
                 if result.ok and phase.post is not None:
                     # post-hooks parse phase output (e.g. smoke-test GB/s)
                     # and may veto success by raising PhaseError — a
@@ -282,6 +311,11 @@ class ClusterAdm:
                 cond.classification = FailureKind.PERMANENT.value
                 ctx.save_cluster(cluster)
                 ctx.on_phase(phase.name, ConditionStatus.FAILED.value)
+                tracer.end_span(attempt_span, SpanStatus.FAILED, {
+                    "classification": FailureKind.PERMANENT.value,
+                    "message": e.message})
+                tracer.end_span(phase_span, SpanStatus.FAILED,
+                                {"attempts": attempts})
                 raise
             except Exception as e:
                 # Anything else (post-hook bug, runner crash) must still
@@ -293,6 +327,11 @@ class ClusterAdm:
                 cond.classification = FailureKind.PERMANENT.value
                 ctx.save_cluster(cluster)
                 ctx.on_phase(phase.name, ConditionStatus.FAILED.value)
+                tracer.end_span(attempt_span, SpanStatus.FAILED, {
+                    "classification": FailureKind.PERMANENT.value,
+                    "message": str(e)})
+                tracer.end_span(phase_span, SpanStatus.FAILED,
+                                {"attempts": attempts})
                 raise PhaseError(phase.name, str(e)) from e
 
             if result.ok:
@@ -301,6 +340,9 @@ class ClusterAdm:
                 cond.classification = ""
                 ctx.save_cluster(cluster)
                 ctx.on_phase(phase.name, ConditionStatus.OK.value)
+                tracer.end_span(attempt_span, SpanStatus.OK)
+                tracer.end_span(phase_span, SpanStatus.OK,
+                                {"attempts": attempts})
                 log.info("cluster %s: phase %s OK (%.1fs, attempt %d)",
                          cluster.name, phase.name,
                          status.condition(phase.name).duration_s, attempts)
@@ -308,6 +350,9 @@ class ClusterAdm:
 
             classification = (result.classification or classify_result(result)
                               or FailureKind.PERMANENT.value)
+            tracer.end_span(attempt_span, SpanStatus.FAILED, {
+                "classification": classification, "rc": result.rc,
+                "message": result.message})
             retryable = (
                 classification == FailureKind.TRANSIENT.value
                 and attempts < self.policy.max_attempts
@@ -324,6 +369,8 @@ class ClusterAdm:
                 cond.classification = classification
                 ctx.save_cluster(cluster)
                 ctx.on_phase(phase.name, ConditionStatus.FAILED.value)
+                tracer.end_span(phase_span, SpanStatus.FAILED, {
+                    "attempts": attempts, "classification": classification})
                 raise PhaseError(
                     phase.name,
                     f"{result.message} [{classification.lower()}, "
@@ -350,7 +397,8 @@ class ClusterAdm:
                 self._sleep(delay)
 
     def _attempt(
-        self, ctx: AdmContext, phase: Phase, deadline: float | None
+        self, ctx: AdmContext, phase: Phase, deadline: float | None,
+        trace: dict | None = None,
     ) -> tuple[TaskResult, list[str]]:
         """One executor run of the phase playbook, streamed to the log sink.
         When the phase deadline expires mid-stream the task is cancelled
@@ -393,6 +441,10 @@ class ClusterAdm:
                 extra_vars,
                 tags=list(phase.tags),
                 limit="new-workers" if phase.limit_new_nodes else "",
+                # trace context rides the TaskSpec so the executor (local
+                # or behind the runner RPC) mints task/host spans into
+                # this attempt's subtree
+                trace=dict(trace or {}),
             )
         except ExecutorError as e:
             return transient_result("", f"executor unavailable: {e.message}"), []

@@ -36,7 +36,11 @@ from kubeoperator_tpu.analysis.contracts import (
     check_config_contract,
     check_surface_parity,
 )
-from kubeoperator_tpu.analysis.flow import check_exception_flow, check_guarded_by
+from kubeoperator_tpu.analysis.flow import (
+    check_exception_flow,
+    check_guarded_by,
+    check_span_discipline,
+)
 from kubeoperator_tpu.analysis.index import (
     AnalysisCache,
     FileFacts,
@@ -70,7 +74,7 @@ __all__ = [
 FLOW_PROJECT_RULES = ("KO-P008",)
 CONTRACT_RULES = ("KO-X009", "KO-X010")
 # per-file flow rules cached alongside the astcheck per-file rules
-PER_FILE_FLOW_RULES = ("KO-P009",)
+PER_FILE_FLOW_RULES = ("KO-P009", "KO-P010")
 
 
 def default_root() -> str:
@@ -137,6 +141,10 @@ def _per_file_rules(selected: set) -> dict:
         rules["KO-P009"] = (
             lambda root, tree, path, source:
             check_exception_flow(root, tree, path, source))
+    if "KO-P010" in selected:
+        rules["KO-P010"] = (
+            lambda root, tree, path, source:
+            check_span_discipline(root, tree, path, source))
     return rules
 
 

@@ -23,6 +23,22 @@ and `continue`. That is exactly enough machinery for:
     silent no-op. The handler must re-raise on some path (or carry a
     `# KO-P009: waived — <reason>` comment).
 
+* KO-P010 (span discipline) — the tracing layer's analog of KO-P009's
+  journal tooth, over the same interpreter:
+  - a `tracer.start_span(...)` (any receiver) whose result stays
+    function-local must reach an `end_span(...)` naming it on every
+    normally-completing path. Exiting by exception is fine — a Running
+    span next to an interrupted operation is crash EVIDENCE, exactly
+    like an open journal op — but a `return` or fall-off-the-end with
+    the span still open leaks a span that reads Running forever and
+    corrupts every duration histogram built over it. The same ownership
+    escapes apply (`return span`, `nonlocal`, storing into an
+    attribute/subscript).
+  - `tracer.span(...)` (the context-manager form, receiver ending in
+    `tracer`) must actually be used as a `with` context expression —
+    called bare, the span starts and nothing ever ends it.
+  Waive a deliberate leak with `# KO-P010: waived — <reason>`.
+
 * KO-P008 (guarded-by inference) — not an interpreter client but the
   same module's other half: infer each attribute's lock set from its
   write sites PROJECT-WIDE over the index's ClassFacts, propagating
@@ -146,7 +162,15 @@ class _PathInterp:
 
     def _exec_loop(self, stmt, states: set) -> BlockResult:
         result = BlockResult()
-        seen: set = set(states)     # zero-iteration path
+        # `while True:` (literal) has no zero-iteration path and never
+        # exhausts: its ONLY normal exits are breaks — without this, a
+        # span/journal obligation opened before an infinite retry loop
+        # that exits by return/raise inside the body would be flagged on
+        # a fall-through path that cannot execute
+        infinite = (isinstance(stmt, ast.While)
+                    and isinstance(stmt.test, ast.Constant)
+                    and bool(stmt.test.value))
+        seen: set = set(states)     # zero-iteration path (finite loops)
         frontier = set(states)
         for _ in range(8):          # tiny lattice: converges in 2-3
             step = self.exec_block(stmt.body, frontier)
@@ -157,7 +181,7 @@ class _PathInterp:
             if not new:
                 break
             frontier = new
-        orelse = self.exec_block(stmt.orelse, seen)
+        orelse = self.exec_block(stmt.orelse, set() if infinite else seen)
         result.raised |= orelse.raised
         result.normal |= orelse.normal
         result.breaks |= orelse.breaks
@@ -372,6 +396,151 @@ def check_exception_flow(root: str, tree: ast.AST, path: str,
     for node in ast.walk(tree):
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             findings.extend(_journal_open_findings(node, rel, "KO-P009"))
+    return findings
+
+
+# =========================================================================
+# KO-P010 — span discipline
+# =========================================================================
+_P010_WAIVER = "KO-P010: waived"
+
+
+def _waived_near(source_lines: list, lineno: int, marker: str) -> bool:
+    lo = max(lineno - 3, 0)
+    return any(marker in line for line in source_lines[lo:lineno + 1])
+
+
+def _span_open_findings(func, rel: str, source_lines: list) -> list:
+    """Flag function-local `start_span` results that can complete normally
+    while still open — the journal-leak analysis (same interpreter, same
+    ownership rules) retargeted at the tracing layer; see the module
+    docstring."""
+    nonlocals: set = set()
+    for stmt in ast.walk(func):
+        if isinstance(stmt, (ast.Nonlocal, ast.Global)):
+            nonlocals.update(stmt.names)
+
+    def is_open(node) -> bool:
+        call = _call_of(node)
+        return bool(call and call[1] == "start_span")
+
+    if not any(is_open(node) for node in ast.walk(func)):
+        return []
+
+    # an end_span anywhere in ANY finally body covers return-through-finally
+    finally_closed: set = set()
+    for node in ast.walk(func):
+        if isinstance(node, ast.Try) and node.finalbody:
+            for sub in ast.walk(ast.Module(body=node.finalbody,
+                                           type_ignores=[])):
+                call = _call_of(sub)
+                if call and call[1] == "end_span" and sub.args and \
+                        isinstance(sub.args[0], ast.Name):
+                    finally_closed.add(sub.args[0].id)
+
+    findings: list = []
+    reported: set = set()
+
+    def transfer(stmt, state: frozenset) -> frozenset:
+        out = set(state)
+        if isinstance(stmt, ast.Assign):
+            if is_open(stmt.value):
+                target = stmt.targets[0]
+                if isinstance(target, ast.Name) and \
+                        target.id not in nonlocals and \
+                        not _waived_near(source_lines, stmt.lineno,
+                                         _P010_WAIVER):
+                    out.add((target.id, stmt.value.lineno))
+                # nonlocal / attribute / tuple targets: ownership escapes
+                return frozenset(out)
+            # reassigning a tracked name (incl. a FRESH start_span into the
+            # same name each loop iteration — the new one replaces the old
+            # obligation); storing a tracked span into an attribute or
+            # subscript hands ownership out
+            for target in stmt.targets:
+                if isinstance(target, ast.Name):
+                    out = {(n, ln) for n, ln in out if n != target.id}
+                elif isinstance(target, (ast.Attribute, ast.Subscript)) and \
+                        isinstance(stmt.value, ast.Name):
+                    out = {(n, ln) for n, ln in out
+                           if n != stmt.value.id}
+        # end_span(...) on a tracked name
+        for node in ast.walk(stmt):
+            call = _call_of(node)
+            if call and call[1] == "end_span" and node.args and \
+                    isinstance(node.args[0], ast.Name):
+                out = {(n, ln) for n, ln in out if n != node.args[0].id}
+        return frozenset(out)
+
+    def on_exit(kind, state: frozenset, node) -> None:
+        open_spans = set(state)
+        if kind == "return" and node is not None and \
+                isinstance(node.value, ast.Name):
+            # `return span` — ownership transfers to the caller
+            open_spans = {(n, ln) for n, ln in open_spans
+                          if n != node.value.id}
+        for name, line in open_spans:
+            if name in finally_closed or (name, line) in reported:
+                continue
+            reported.add((name, line))
+            findings.append(Finding(
+                "KO-P010", rel, line,
+                f"span {name!r} started in {func.name}() can complete "
+                f"normally without end_span() — it reads Running forever "
+                f"and corrupts the duration histograms; end it on every "
+                f"non-raising path, hand ownership out "
+                f"(return/nonlocal/store), or waive with "
+                f"`# {_P010_WAIVER} — <reason>`",
+            ))
+
+    _PathInterp(transfer, on_exit).run(func.body, frozenset())
+    return findings
+
+
+def _bare_span_cm_findings(tree: ast.AST, rel: str,
+                           source_lines: list) -> list:
+    """`tracer.span(...)` (the context-manager form) called OUTSIDE a
+    `with` item: the span starts, nothing ever ends it."""
+    with_exprs: set = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                with_exprs.add(id(item.context_expr))
+    findings: list = []
+    for node in ast.walk(tree):
+        call = _call_of(node)
+        if not call or call[1] != "span":
+            continue
+        receiver = call[0]
+        if not receiver.split(".")[-1].endswith("tracer"):
+            continue
+        if id(node) in with_exprs:
+            continue
+        if _waived_near(source_lines, node.lineno, _P010_WAIVER):
+            continue
+        findings.append(Finding(
+            "KO-P010", rel, node.lineno,
+            f"{receiver}.span(...) is a context manager but is not the "
+            f"context expression of a `with` — the span starts and never "
+            f"ends; use `with {receiver}.span(...):`, or start_span/"
+            f"end_span explicitly, or waive with "
+            f"`# {_P010_WAIVER} — <reason>`",
+        ))
+    return findings
+
+
+def check_span_discipline(root: str, tree: ast.AST, path: str,
+                          source: str | None = None) -> list:
+    """KO-P010 entry point, per file (same signature family as KO-P009)."""
+    rel = os.path.relpath(path, os.path.dirname(root) or ".")
+    if source is None:
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+    lines = source.splitlines()
+    findings = _bare_span_cm_findings(tree, rel, lines)
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            findings.extend(_span_open_findings(node, rel, lines))
     return findings
 
 

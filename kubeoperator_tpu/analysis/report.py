@@ -138,6 +138,15 @@ RULES: dict[str, RuleSpec] = {
             "catching BaseException may swallow it — chaos "
             "ControllerDeath must tear through like a real SIGKILL",
         ),
+        RuleSpec(
+            "KO-P010", "span-discipline", "flow", ERROR,
+            "every tracer.start_span() result reaches end_span() on all "
+            "normally-completing paths (exception exits leave the span "
+            "Running as crash evidence, like an open journal op), and "
+            "the tracer.span(...) context-manager form is actually used "
+            "in a `with` — a leaked span reads Running forever and "
+            "corrupts the duration histograms",
+        ),
         # ---- contract rules (contracts.py, over index.py facts) ----
         RuleSpec(
             "KO-X009", "config-contract", "contract", ERROR,

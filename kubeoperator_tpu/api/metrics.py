@@ -3,13 +3,19 @@
 VERDICT r3 missing #5 — the installer bundles grafana, but the platform
 could not observe itself. This registry is the data source: process-lifetime
 counters (HTTP requests, SSE consumers) updated by the API layer, plus
-scrape-time collectors that read the live stack (clusters by phase, phase
-durations from condition spans, executor task stats and queue depth,
-terminal sessions, smoke bandwidth with its honesty label).
+scrape-time collectors that read the live stack (clusters by phase,
+phase/task duration HISTOGRAMS off the span store with trace-id exemplars,
+journal ops by status, watchdog circuit state, executor task stats and
+queue depth, terminal sessions, smoke bandwidth with its honesty label).
 
 Exposition format reference: prometheus.io/docs/instrumenting/exposition_formats
 (text format 0.0.4) — counters end in `_total`, label values escape
-backslash/quote/newline, HELP/TYPE precede each family.
+backslash/quote/newline, HELP/TYPE precede each family. When the scraper
+negotiates OpenMetrics (`Accept: application/openmetrics-text`) the same
+families render with OpenMetrics counter naming (`# TYPE x counter` +
+`x_total` series), `# {trace_id="..."} v` exemplars on histogram buckets,
+and the terminating `# EOF` — classic 0.0.4 output stays exemplar-free
+because its parsers reject them.
 """
 
 from __future__ import annotations
@@ -17,19 +23,33 @@ from __future__ import annotations
 import threading
 import time
 
+# explicit histogram buckets for operation latencies: sub-second retries
+# through half-hour phases; chosen once here so dashboards can hard-code
+# the `le` grid
+DURATION_BUCKETS_S = (
+    0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+    60.0, 120.0, 300.0, 600.0, 1800.0,
+)
+
 
 def _escape(value: str) -> str:
     return (str(value).replace("\\", "\\\\").replace('"', '\\"')
             .replace("\n", "\\n"))
 
 
-def _fmt(name: str, labels: dict | None, value) -> str:
+def _fmt(name: str, labels: dict | None, value, exemplar: tuple | None = None,
+         openmetrics: bool = False) -> str:
     if labels:
         inner = ",".join(
             f'{k}="{_escape(v)}"' for k, v in sorted(labels.items())
         )
-        return f"{name}{{{inner}}} {value}"
-    return f"{name} {value}"
+        row = f"{name}{{{inner}}} {value}"
+    else:
+        row = f"{name} {value}"
+    if exemplar is not None and openmetrics:
+        trace_id, observed = exemplar
+        row += f' # {{trace_id="{_escape(trace_id)}"}} {observed}'
+    return row
 
 
 class MetricsRegistry:
@@ -53,19 +73,62 @@ class MetricsRegistry:
             self._sse_consumers += 1
 
     def sse_finished(self) -> None:
+        # clamped at 0: a double-finish (e.g. an exception path running a
+        # finally twice, or a finish with no matching start) must read as
+        # "zero consumers", never as a negative gauge that poisons every
+        # dashboard sum it joins
         with self._lock:
-            self._sse_consumers -= 1
+            self._sse_consumers = max(self._sse_consumers - 1, 0)
 
     # ---- exposition ----
-    def render(self, services) -> str:
+    def render(self, services, openmetrics: bool = False) -> str:
         from kubeoperator_tpu.version import __version__
 
         out: list[str] = []
 
         def family(name: str, mtype: str, help_: str, rows: list[str]):
-            out.append(f"# HELP {name} {help_}")
-            out.append(f"# TYPE {name} {mtype}")
+            # OpenMetrics names a counter family WITHOUT the _total suffix
+            # (the series keep it); classic 0.0.4 uses the suffixed name
+            header = name
+            if openmetrics and mtype == "counter" and name.endswith("_total"):
+                header = name[: -len("_total")]
+            out.append(f"# HELP {header} {help_}")
+            out.append(f"# TYPE {header} {mtype}")
             out.extend(rows)
+
+        def histogram(name: str, help_: str, label: str,
+                      rows: list[tuple]) -> None:
+            """One histogram family from (label_value, duration_s,
+            trace_id) observations: cumulative explicit buckets + _sum +
+            _count per label value, each bucket carrying the LAST
+            observation that landed in it as its trace-id exemplar."""
+            by_label: dict[str, list[tuple]] = {}
+            for value, duration, trace_id in rows:
+                by_label.setdefault(value, []).append((duration, trace_id))
+            lines: list[str] = []
+            for value in sorted(by_label):
+                observations = by_label[value]
+                lower = float("-inf")
+                for le in (*DURATION_BUCKETS_S, float("inf")):
+                    cumulative = sum(1 for d, _ in observations if d <= le)
+                    # the exemplar is the LAST observation landing in this
+                    # bucket's own (lower, le] band — `le` rows themselves
+                    # stay cumulative, per the histogram contract
+                    in_band = [(d, t) for d, t in observations
+                               if lower < d <= le and t]
+                    exemplar = ((in_band[-1][1], round(in_band[-1][0], 6))
+                                if in_band else None)
+                    le_text = "+Inf" if le == float("inf") else f"{le:g}"
+                    lines.append(_fmt(
+                        f"{name}_bucket", {label: value, "le": le_text},
+                        cumulative, exemplar, openmetrics))
+                    lower = le
+                lines.append(_fmt(
+                    f"{name}_sum", {label: value},
+                    round(sum(d for d, _ in observations), 6)))
+                lines.append(_fmt(
+                    f"{name}_count", {label: value}, len(observations)))
+            family(name, "histogram", help_, lines)
 
         with self._lock:
             http = dict(self._http)
@@ -94,30 +157,45 @@ class MetricsRegistry:
                [_fmt("ko_tpu_clusters", {"phase": p}, n)
                 for p, n in sorted(by_phase.items())])
 
-        # phase durations from condition spans (SURVEY §5.1: the native
-        # trace) — sum+count per phase name lets dashboards chart averages
-        span_sum: dict[str, float] = {}
-        span_count: dict[str, int] = {}
-        for c in clusters:
-            for cond in c.status.conditions:
-                if cond.finished_at and cond.started_at:
-                    d = cond.finished_at - cond.started_at
-                    span_sum[cond.name] = span_sum.get(cond.name, 0.0) + d
-                    span_count[cond.name] = span_count.get(cond.name, 0) + 1
-        # gauges, not counters: recomputed over RETAINED clusters each
-        # scrape, so a cluster delete lowers them — rate()/increase()
-        # would misread that as a counter reset. sum/count still chart
-        # the average cleanly.
-        family("ko_tpu_phase_duration_seconds_sum", "gauge",
-               "Seconds spent in each adm phase, summed over retained "
-               "clusters' condition spans.",
-               [_fmt("ko_tpu_phase_duration_seconds_sum", {"phase": p},
-                     round(s, 3))
-                for p, s in sorted(span_sum.items())])
-        family("ko_tpu_phase_duration_seconds_count", "gauge",
-               "Completed phase runs recorded on retained clusters.",
-               [_fmt("ko_tpu_phase_duration_seconds_count", {"phase": p}, n)
-                for p, n in sorted(span_count.items())])
+        # operation-latency histograms off the span store (indexed SQL on
+        # the mirrored columns, no JSON hydration): phase spans labeled by
+        # phase name, task spans by playbook. Exemplar trace ids link a
+        # slow bucket straight to `koctl trace`.
+        histogram(
+            "ko_tpu_phase_duration_seconds",
+            "Adm phase wall-clock from persisted phase spans "
+            "(docs/observability.md), by phase name.",
+            "phase", services.repos.spans.duration_rows("phase"))
+        histogram(
+            "ko_tpu_task_duration_seconds",
+            "Executor task wall-clock from persisted task spans, by "
+            "playbook.",
+            "playbook", services.repos.spans.duration_rows("task"))
+
+        # journal + watchdog state (the robustness layer's own gauges)
+        ops_by_status = services.repos.operations.count_by_status()
+        family("ko_tpu_operations", "gauge",
+               "Journal operations by status (Running = in flight right "
+               "now; Interrupted = swept by the boot reconciler).",
+               [_fmt("ko_tpu_operations", {"status": s}, n)
+                for s, n in sorted(ops_by_status.items())])
+        try:
+            watchdog_rows = services.watchdog.status()
+        except Exception:
+            watchdog_rows = None
+        if watchdog_rows is not None:
+            family("ko_tpu_watchdog_circuit_open", "gauge",
+                   "1 when the cluster's auto-remediation circuit is open "
+                   "(koctl watchdog reset closes it).",
+                   [_fmt("ko_tpu_watchdog_circuit_open",
+                         {"cluster": r["cluster"]},
+                         1 if r["circuit"] == "open" else 0)
+                    for r in watchdog_rows])
+            family("ko_tpu_watchdog_budget_left", "gauge",
+                   "Remediations left in the cluster's current window.",
+                   [_fmt("ko_tpu_watchdog_budget_left",
+                         {"cluster": r["cluster"]}, r["budget_left"])
+                    for r in watchdog_rows])
 
         try:
             stats = services.executor.task_stats()
@@ -164,4 +242,6 @@ class MetricsRegistry:
                "Latest psum smoke bandwidth per TPU cluster (simulated "
                "label marks ko_simulation-fabricated values).", smoke_rows)
 
+        if openmetrics:
+            out.append("# EOF")
         return "\n".join(out) + "\n"

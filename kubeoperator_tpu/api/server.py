@@ -298,7 +298,18 @@ class Handlers:
             # reachable by untrusted networks — no timing oracle
             if not hmac.compare_digest(got, f"Bearer {token}"):
                 return web.Response(status=401, text="metrics token required")
-        text = await run_sync(request, self.metrics.render, self.s)
+        # OpenMetrics negotiation: exemplar-bearing exposition (trace ids
+        # on histogram buckets) only for scrapers that ask for it — the
+        # classic 0.0.4 text parser rejects exemplars
+        openmetrics = "application/openmetrics-text" in \
+            request.headers.get("Accept", "")
+        text = await run_sync(request, self.metrics.render, self.s,
+                              openmetrics)
+        if openmetrics:
+            return web.Response(
+                text=text, charset="utf-8",
+                content_type="application/openmetrics-text",
+            )
         return web.Response(
             text=text, content_type="text/plain", charset="utf-8"
         )
@@ -946,13 +957,51 @@ class Handlers:
         return json_response(await run_sync(request, gather))
 
     async def cluster_trace(self, request):
-        """Create-to-Ready wall-clock as a native trace (SURVEY.md §5.1:
-        the BASELINE metric is literally a span over the adm phases)."""
-        cluster = await run_sync(request, self.s.clusters.get,
-                                 request.match_info["name"])
-        return json_response(
-            {"cluster": cluster.name, **cluster.status.trace()}
-        )
+        """Create-to-Ready wall-clock summary (SURVEY.md §5.1: the
+        BASELINE metric is a span over the adm phases). Since the span
+        store landed this is the THIN view: phase-level rows from the
+        condition spans plus a pointer at the newest operation's full
+        five-level tree (`/operations/{id}/trace`, `koctl trace`)."""
+        def gather():
+            cluster = self.s.clusters.get(request.match_info["name"])
+            ops = self.s.journal.history(cluster.id, 1)
+            latest = ops[0] if ops else None
+            return {
+                "cluster": cluster.name,
+                **cluster.status.trace(),
+                "latest_operation": (
+                    {"id": latest.id, "kind": latest.kind,
+                     "status": latest.status, "trace_id": latest.trace_id,
+                     "trace": f"/api/v1/clusters/{cluster.name}"
+                              f"/operations/{latest.id}/trace"}
+                    if latest is not None else None),
+            }
+
+        return json_response(await run_sync(request, gather))
+
+    async def operation_trace(self, request):
+        """The full five-level span tree of ONE journal operation
+        (operation → phase → attempt → task → host), self-time and the
+        critical path annotated — what `koctl trace` renders."""
+        from kubeoperator_tpu.observability import span_tree
+
+        def gather():
+            cluster = self.s.clusters.get(request.match_info["name"])
+            op = self.s.journal.operation(request.match_info["op"])
+            if op.cluster_id != cluster.id:
+                raise NotFoundError(kind="operation",
+                                    name=request.match_info["op"])
+            tree = span_tree(self.s.journal.spans_of(op.id))
+            return {
+                "cluster": cluster.name,
+                "operation": op.id,
+                "kind": op.kind,
+                "status": op.status,
+                "trace_id": op.trace_id,
+                "tree": tree,
+            }
+
+        return json_response(await run_sync(request, gather))
 
     async def sync_cluster_events(self, request):
         from kubeoperator_tpu.adm import AdmContext
@@ -1126,6 +1175,8 @@ def create_app(services: Services) -> web.Application:
                cluster_guard(h.sync_cluster_events, manage))
     r.add_get("/api/v1/clusters/{name}/trace",
               cluster_guard(h.cluster_trace, view))
+    r.add_get("/api/v1/clusters/{name}/operations/{op}/trace",
+              cluster_guard(h.operation_trace, view))
     r.add_post("/api/v1/clusters/{name}/cis-scans",
                cluster_guard(h.run_cis_scan, manage))
     r.add_get("/api/v1/clusters/{name}/cis-scans",

@@ -160,7 +160,34 @@ class LocalClient:
             case ("POST", ["clusters", name, "retry"]):
                 return pub(s.clusters.retry(name, wait=False))
             case ("GET", ["clusters", name, "trace"]):
-                return s.clusters.get(name).status.trace()
+                cluster = s.clusters.get(name)
+                ops = s.journal.history(cluster.id, 1)
+                latest = ops[0] if ops else None
+                return {
+                    "cluster": cluster.name,
+                    **cluster.status.trace(),
+                    "latest_operation": (
+                        {"id": latest.id, "kind": latest.kind,
+                         "status": latest.status,
+                         "trace_id": latest.trace_id,
+                         "trace": f"/api/v1/clusters/{cluster.name}"
+                                  f"/operations/{latest.id}/trace"}
+                        if latest is not None else None),
+                }
+            case ("GET", ["clusters", name, "operations", op_id, "trace"]):
+                from kubeoperator_tpu.observability import span_tree
+                from kubeoperator_tpu.utils.errors import NotFoundError
+
+                cluster = s.clusters.get(name)
+                op = s.journal.operation(op_id)
+                if op.cluster_id != cluster.id:
+                    raise NotFoundError(kind="operation", name=op_id)
+                return {
+                    "cluster": cluster.name, "operation": op.id,
+                    "kind": op.kind, "status": op.status,
+                    "trace_id": op.trace_id,
+                    "tree": span_tree(s.journal.spans_of(op.id)),
+                }
             case ("GET", ["clusters", name, "logs"]):
                 cluster = s.clusters.get(name)
                 chunks = s.repos.task_logs.find(cluster_id=cluster.id)
@@ -693,6 +720,47 @@ def cmd_notify(client, args) -> int:
     return 1
 
 
+def cmd_trace(client, args) -> int:
+    """End-to-end operation trace (docs/observability.md): pick the newest
+    journal operation of the cluster (or the one `--op` names, by id or by
+    newest-first index) and render its persisted
+    operation→phase→attempt→task→host span tree as an aligned waterfall —
+    self-time per node, `*` marking the critical path. `--json` emits the
+    raw tree the REST endpoint serves."""
+    ops = client.call(
+        "GET", f"/api/v1/clusters/{args.name}/operations?limit=50")
+    if not ops:
+        print(f"no journaled operations for {args.name}", file=sys.stderr)
+        return 1
+    op_id = args.op
+    if op_id and op_id.isdigit():
+        index = int(op_id)
+        if index >= len(ops):
+            print(f"--op {index}: only {len(ops)} operations journaled",
+                  file=sys.stderr)
+            return 1
+        op_id = ops[index]["id"]
+    elif not op_id:
+        op_id = ops[0]["id"]
+    data = client.call(
+        "GET", f"/api/v1/clusters/{args.name}/operations/{op_id}/trace")
+    if args.json:
+        _print(data)
+        return 0
+    tree = data.get("tree")
+    if not tree:
+        print(f"operation {op_id} has no persisted spans "
+              f"(observability.tracing disabled, or the trace was pruned)",
+              file=sys.stderr)
+        return 1
+    from kubeoperator_tpu.observability import render_waterfall
+
+    print(f"cluster {args.name}  operation {data['kind']}/{op_id}  "
+          f"trace {data.get('trace_id') or '-'}")
+    print(render_waterfall(tree))
+    return 0 if data.get("status") != "Failed" else 1
+
+
 def cmd_watchdog(client, args) -> int:
     """Auto-remediation circuit state (docs/resilience.md): `status` shows
     per-cluster circuit/budget/flaps; `reset` is the ONE way an open
@@ -1219,6 +1287,18 @@ def build_parser() -> argparse.ArgumentParser:
     apply_p = sub.add_parser("apply", help="apply a setup YAML")
     apply_p.add_argument("-f", "--file", required=True)
 
+    trace_p = sub.add_parser(
+        "trace",
+        help="operation trace waterfall: the persisted operation→phase→"
+             "attempt→task→host span tree (docs/observability.md)")
+    trace_p.add_argument("name")
+    trace_p.add_argument("--op", default="",
+                         help="operation id (or newest-first index); "
+                              "default: the newest journaled operation")
+    trace_p.add_argument("--json", action="store_true",
+                         help="emit the raw span tree instead of the "
+                              "waterfall")
+
     watchdog_p = sub.add_parser(
         "watchdog", help="auto-remediation circuit breaker verbs")
     wsub = watchdog_p.add_subparsers(dest="watchdog_cmd", required=True)
@@ -1437,6 +1517,8 @@ def main(argv: list[str] | None = None) -> int:
         return 0
     if args.cmd == "cluster":
         return cmd_cluster(client, args)
+    if args.cmd == "trace":
+        return cmd_trace(client, args)
     if args.cmd == "plan":
         return cmd_plan(client, args)
     if args.cmd == "component":

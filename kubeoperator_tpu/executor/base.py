@@ -82,6 +82,12 @@ class TaskSpec:
     extra_vars: dict = field(default_factory=dict)  # the ClusterSpec vars contract
     tags: list = field(default_factory=list)
     limit: str = ""                    # host-pattern limit (scale-up joins)
+    # trace context (observability/tracing.py trace_context): trace_id +
+    # parent_span_id. Rides the spec VERBATIM across the gRPC runner
+    # boundary (the runner protocol serializes the whole spec), so a
+    # remote runner's task/host spans stitch into the caller's tree.
+    # Empty dict = untraced task, zero span overhead.
+    trace: dict = field(default_factory=dict)
 
     def validate(self) -> None:
         if bool(self.playbook) == bool(self.adhoc_module):
@@ -111,6 +117,11 @@ class TaskResult:
     # FailureKind value for FAILED results ("" while pending/success) —
     # the retry layer's routing signal
     classification: str = ""
+    # task + per-host span payloads (plain dicts) built at finish() when
+    # the spec carried a trace context — the engine persists them into the
+    # operation's span tree. Crosses the Result RPC as-is, which is how a
+    # REMOTE runner's spans reach the controller's span store.
+    spans: list = field(default_factory=list)
 
     @property
     def ok(self) -> bool:
@@ -127,6 +138,10 @@ class _TaskState:
     def __init__(self, task_id: str) -> None:
         self.result = TaskResult(task_id=task_id)
         self.lines: list[str] = []
+        # trace context + display name, stamped by Executor.run before the
+        # backend thread starts; finish() turns them into span payloads
+        self.trace: dict = {}
+        self.spec_name = ""
         self.cond = threading.Condition()
         self.done = threading.Event()
         # cooperative cancel: backends poll `cancelled` between tasks/lines;
@@ -191,8 +206,59 @@ class _TaskState:
             self.result.classification = (
                 classification or classify_result(self.result)
             )
+            self._build_spans_locked()
             self.done.set()
             self.cond.notify_all()
+
+    def _build_spans_locked(self) -> None:
+        """Materialize the task + per-host span payloads onto the result
+        (called with `cond` held, right before the done latch). Pure dict
+        assembly — no IO, no imports beyond ids — so every backend,
+        including a remote runner with no DB, can produce spans; the
+        CALLER'S tracer persists them. Kind literals match models/span.py
+        SpanKind (the executor deliberately does not import the model)."""
+        trace = self.trace
+        if not trace.get("trace_id"):
+            return
+        result = self.result
+        task_span_id = new_id()
+        ok = result.status == TaskStatus.SUCCESS.value
+        spans = [{
+            "id": task_span_id,
+            "trace_id": trace["trace_id"],
+            "parent_id": trace.get("parent_span_id", ""),
+            "name": self.spec_name or result.task_id,
+            "kind": "task",
+            "status": "OK" if ok else "Failed",
+            "started_at": result.started_at,
+            "finished_at": result.finished_at,
+            "attrs": {
+                "task_id": result.task_id,
+                "rc": result.rc,
+                "classification": result.classification,
+                "message": result.message,
+            },
+        }]
+        for host, hs in sorted(result.host_stats.items()):
+            # HostStats in-process, plain dicts across the runner boundary
+            stats = dict(hs) if isinstance(hs, dict) else dict(hs.__dict__)
+            bad = (stats.get("failed", 0) or 0) \
+                + (stats.get("unreachable", 0) or 0)
+            spans.append({
+                "id": new_id(),
+                "trace_id": trace["trace_id"],
+                "parent_id": task_span_id,
+                "name": host,
+                "kind": "host",
+                "status": "Failed" if bad else "OK",
+                # per-host timing is not tracked (ansible recaps aren't
+                # timestamped); the host span inherits the task window and
+                # carries the recap numbers as attrs
+                "started_at": result.started_at,
+                "finished_at": result.finished_at,
+                "attrs": stats,
+            })
+        result.spans = spans
 
 
 class Executor(abc.ABC):
@@ -228,6 +294,8 @@ class Executor(abc.ABC):
         spec.validate()
         task_id = task_id or new_id()
         state = _TaskState(task_id)
+        state.trace = dict(spec.trace or {})
+        state.spec_name = spec.playbook or f"adhoc:{spec.adhoc_module}"
         with self._lock:
             if task_id in self._tasks:
                 return task_id

@@ -70,9 +70,20 @@ class _Handler(grpc.GenericRpcHandler):
 
     def _run(self, request: dict, context) -> dict:
         # client-generated idempotency key (absent from legacy clients):
-        # a retried Run whose first attempt WAS delivered dedupes here
+        # a retried Run whose first attempt WAS delivered dedupes here.
+        # The spec's `trace` field (trace id + parent span id) arrives in
+        # the same request — the runner-boundary trace propagation: this
+        # process's task/host spans are minted with the CALLER'S trace id
+        # and ride back over the Result RPC (TaskResult.spans), so remote
+        # execution stitches into the controller's span tree. Unknown
+        # keys are dropped, not TypeErrors: a NEWER controller talking to
+        # this runner during a rolling upgrade must degrade to untraced
+        # tasks, never fail every phase.
         task_id = request.pop("task_id", None)
-        spec = TaskSpec(**request)
+        spec = TaskSpec(**{
+            k: v for k, v in request.items()
+            if k in TaskSpec.__dataclass_fields__
+        })
         task_id = self.executor.run(spec, task_id=task_id)
         log.info("runner: task %s started (%s)", task_id,
                  spec.playbook or spec.adhoc_module)
@@ -173,6 +184,12 @@ class RunnerClient(Executor):
         import time as _time
 
         request = dict(spec.__dict__, task_id=task_id or new_id())
+        if not request.get("trace"):
+            # wire-compat with pre-tracing runners: an UNTRACED task must
+            # not carry the (empty) field an older TaskSpec would reject —
+            # so disabling observability.tracing is always a working
+            # mixed-version configuration
+            request.pop("trace", None)
         deadline = _time.monotonic() + self.connect_retry_s
         while True:
             try:
@@ -203,6 +220,15 @@ class RunnerClient(Executor):
             d = self._result_rpc({"task_id": task_id})
         except grpc.RpcError as e:
             raise ExecutorError(message=f"runner result failed: {e}") from e
+        return self._hydrate_result(d)
+
+    @staticmethod
+    def _hydrate_result(d: dict) -> TaskResult:
+        """Result-wire tolerance, mirroring the server's Run side: fields
+        a NEWER runner adds (as `spans` once was) are dropped, not
+        TypeErrors — mixed versions degrade, never fail."""
+        d = {k: v for k, v in d.items()
+             if k in TaskResult.__dataclass_fields__}
         d["host_stats"] = {
             h: HostStats(**s) for h, s in d.get("host_stats", {}).items()
         }
@@ -235,10 +261,7 @@ class RunnerClient(Executor):
             )
         except grpc.RpcError as e:
             raise ExecutorError(message=f"runner cancel failed: {e}") from e
-        d["host_stats"] = {
-            h: HostStats(**s) for h, s in d.get("host_stats", {}).items()
-        }
-        return TaskResult(**d)
+        return self._hydrate_result(d)
 
     def _execute(self, spec, state):  # pragma: no cover - remote only
         raise NotImplementedError

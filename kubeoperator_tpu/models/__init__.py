@@ -33,6 +33,7 @@ from kubeoperator_tpu.models.event import AuditRecord, Event, Message, Setting, 
 from kubeoperator_tpu.models.component import ClusterComponent
 from kubeoperator_tpu.models.operation import Operation, OperationStatus
 from kubeoperator_tpu.models.security import CisCheck, CisScan
+from kubeoperator_tpu.models.span import Span, SpanKind, SpanStatus
 
 __all__ = [
     "Entity",
@@ -45,4 +46,5 @@ __all__ = [
     "ClusterComponent",
     "Operation", "OperationStatus",
     "CisCheck", "CisScan",
+    "Span", "SpanKind", "SpanStatus",
 ]

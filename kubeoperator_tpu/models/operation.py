@@ -42,6 +42,9 @@ class Operation(Entity):
     resume_phase: str = ""       # re-entry point preserved on interruption
     vars: dict = field(default_factory=dict)   # op inputs (upgrade target...)
     finished_at: float = 0.0
+    # observability: the span tree's trace id ("" = op predates tracing or
+    # it was disabled); the root span's id is the operation id itself
+    trace_id: str = ""
 
     @property
     def open(self) -> bool:

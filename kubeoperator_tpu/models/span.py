@@ -1,0 +1,65 @@
+"""Span — one timed node of an operation's trace tree (docs/observability.md).
+
+The span tree is the drill-down the journal (models/operation.py) cannot
+give: an Operation row says *what* ran and how far it got; its spans say
+where the wall-clock went, five levels deep::
+
+    operation            (root; span id == the journal operation id)
+      └── phase          (one per adm phase the engine entered)
+            └── attempt  (one per executor attempt, retries are siblings)
+                  └── task        (the executor run, possibly remote)
+                        └── host  (per-host recap of that run)
+
+Spans are persisted rows (migration 006), keyed by the owning journal
+operation id — a trace survives the controller that produced it, and a
+crash mid-operation leaves the spans recorded so far (status Running)
+as evidence of where it died.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from kubeoperator_tpu.models.base import Entity
+
+
+class SpanKind:
+    """The five levels of the tree, outermost first."""
+
+    OPERATION = "operation"
+    PHASE = "phase"
+    ATTEMPT = "attempt"
+    TASK = "task"
+    HOST = "host"
+
+    ORDER = (OPERATION, PHASE, ATTEMPT, TASK, HOST)
+
+
+class SpanStatus:
+    RUNNING = "Running"   # started, not finished (or the owner crashed)
+    OK = "OK"
+    FAILED = "Failed"
+
+
+@dataclass
+class Span(Entity):
+    """One trace node. `attrs` carries level-specific facts: FailureKind +
+    rc + attempt count on attempt/task spans, the ansible recap numbers on
+    host spans — never secrets (attrs surface verbatim over the API)."""
+
+    trace_id: str = ""      # one id per operation; propagated over the RPC
+    parent_id: str = ""     # "" = root (the operation span)
+    op_id: str = ""         # owning journal operation (migration 005 row)
+    cluster_id: str = ""
+    name: str = ""          # phase name / playbook / host name
+    kind: str = SpanKind.PHASE
+    status: str = SpanStatus.RUNNING
+    started_at: float = 0.0
+    finished_at: float = 0.0
+    attrs: dict = field(default_factory=dict)
+
+    @property
+    def duration_s(self) -> float:
+        if self.started_at and self.finished_at:
+            return self.finished_at - self.started_at
+        return 0.0

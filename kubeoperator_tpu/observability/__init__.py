@@ -1,0 +1,40 @@
+"""Observability layer: durable operation traces, structured logs, and the
+latency histograms `/metrics` serves (docs/observability.md).
+
+The span tree is the platform's answer to "why did this create take 11
+minutes": one persisted `operation → phase → attempt → task → host` tree
+per journal operation, stitched across the gRPC runner boundary, rendered
+by `koctl trace` and `GET /clusters/{name}/operations/{id}/trace`, and
+feeding the phase/task duration histograms with trace-id exemplars.
+
+* tracing.py — `Tracer`/`NullTracer`, span-tree building, the waterfall
+  renderer, and the `TaskSpec.trace` wire context.
+* logging.py — JSON log records carrying `trace_id`/`op_id`/`cluster`/
+  `phase`, bound per worker thread by the journal/engine.
+
+Config: the `observability.*` block (utils/config.py DEFAULTS; analyzer
+rule KO-X009 keeps the knob table in docs/observability.md honest).
+Span discipline is enforced by analyzer rule KO-P010.
+"""
+
+from kubeoperator_tpu.observability.tracing import (
+    NullTracer,
+    Tracer,
+    mark_critical_path,
+    new_trace_id,
+    render_waterfall,
+    span_tree,
+    trace_context,
+)
+from kubeoperator_tpu.observability.logging import (
+    JsonLogFormatter,
+    bind_trace,
+    clear_trace,
+    current_trace,
+)
+
+__all__ = [
+    "NullTracer", "Tracer", "mark_critical_path", "new_trace_id",
+    "render_waterfall", "span_tree", "trace_context",
+    "JsonLogFormatter", "bind_trace", "clear_trace", "current_trace",
+]

@@ -1,0 +1,73 @@
+"""Structured JSON logging with trace correlation.
+
+`JsonLogFormatter` turns every `ko_tpu.*` record into one JSON object per
+line (ts/level/logger/message plus any bound trace context), switchable via
+the `observability.json_logs` knob — the shape log shippers ingest without
+a grok pattern, and the bridge between the log stream and the span store:
+a record carrying `trace_id` links straight to `koctl trace`.
+
+The context is a ContextVar bound per worker thread by the journal/engine
+(`bind_trace` at operation attach, phase updates as the engine advances),
+so every log line emitted under an operation — service layer, adm engine,
+executor client — carries the ids an operator needs to correlate it,
+without any call site passing them explicitly.
+
+Deliberately stdlib-only and import-light: utils/logging.py imports this
+lazily at setup time, and nothing here imports the platform back.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import json
+import logging
+import time
+
+# one context var holding a small dict; each worker thread gets its own
+# copy (contextvars are per-thread for plain threads)
+_TRACE_CTX: contextvars.ContextVar[dict | None] = contextvars.ContextVar(
+    "ko_tpu_trace_ctx", default=None
+)
+
+_CTX_FIELDS = ("trace_id", "op_id", "cluster", "phase")
+
+
+def bind_trace(**fields) -> None:
+    """Merge fields (trace_id/op_id/cluster/phase) into the current
+    thread's log context; unknown fields are dropped, None values clear."""
+    current = dict(_TRACE_CTX.get() or {})
+    for key, value in fields.items():
+        if key not in _CTX_FIELDS:
+            continue
+        if value is None:
+            current.pop(key, None)
+        else:
+            current[key] = value
+    _TRACE_CTX.set(current or None)
+
+
+def clear_trace() -> None:
+    _TRACE_CTX.set(None)
+
+
+def current_trace() -> dict:
+    return dict(_TRACE_CTX.get() or {})
+
+
+class JsonLogFormatter(logging.Formatter):
+    """One JSON object per record. Keys are stable and flat so shippers
+    can index them; exception text rides an `exc` field."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        out: dict = {
+            "ts": round(record.created, 3),
+            "time": time.strftime(
+                "%Y-%m-%dT%H:%M:%S", time.localtime(record.created)),
+            "level": record.levelname,
+            "logger": record.name,
+            "message": record.getMessage(),
+        }
+        out.update(current_trace())
+        if record.exc_info:
+            out["exc"] = self.formatException(record.exc_info)
+        return json.dumps(out, default=str)

@@ -1,0 +1,352 @@
+"""Durable operation tracing — the span tree behind `koctl trace`.
+
+Dependency-free by design (stdlib + the platform's own models/repos): the
+tracer writes `Span` rows (models/span.py, migration 006) through
+`repos.spans`, keyed by the owning journal operation, so a trace survives
+both the controller that produced it and any crash mid-operation.
+
+Producer side
+    * `OperationJournal.open()` starts the root *operation* span (its id
+      IS the operation id, so close/interrupt can finish it without any
+      extra bookkeeping) and hands services a `Tracer` via
+      `journal.attach` → `AdmContext.tracer`.
+    * The adm engine opens *phase* and *attempt* spans (engine.py); the
+      trace context (trace id + attempt span id) rides `TaskSpec.trace`
+      into the executor — across the gRPC runner boundary unchanged,
+      because the runner protocol serializes the whole spec — and the
+      executor's `_TaskState.finish` materializes *task* + *host* span
+      payloads into `TaskResult.spans`, which the engine persists here.
+
+Consumer side
+    * `span_tree()` joins one operation's rows into a nested tree with
+      per-node self-time and the critical path marked (the chain of
+      children that finished last at every level — the spans to look at
+      first when asking "why did this take 11 minutes").
+    * `render_waterfall()` renders that tree as an aligned text waterfall
+      for `koctl trace`; the REST endpoint returns the tree as JSON.
+
+Span-discipline contract (analyzer rule KO-P010): a manually started span
+(`tracer.start_span(...)`) must reach `tracer.end_span(...)` on every
+normally-completing path — exiting by exception is allowed (the span stays
+Running as crash evidence, exactly like a journal op). Prefer the
+`with tracer.span(...)` form, which closes structurally.
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+
+from kubeoperator_tpu.models.span import Span, SpanKind, SpanStatus
+from kubeoperator_tpu.utils.ids import new_id, now_ts
+from kubeoperator_tpu.utils.logging import get_logger
+
+log = get_logger("observability.tracing")
+
+
+def new_trace_id() -> str:
+    return new_id()
+
+
+def trace_context(trace_id: str, parent_span_id: str) -> dict:
+    """The wire shape `TaskSpec.trace` carries across the runner RPC."""
+    return {"trace_id": trace_id, "parent_span_id": parent_span_id}
+
+
+class NullTracer:
+    """No-op tracer: the default on every AdmContext, and what a disabled
+    `observability.tracing` knob injects — instrumented code never has to
+    null-check. `enabled` is the one flag the engine may consult to skip
+    building payloads entirely."""
+
+    enabled = False
+    trace_id = ""
+    root_id = ""
+
+    def start_span(self, name: str, kind: str, parent_id: str = "",
+                   attrs: dict | None = None) -> Span:
+        return Span(name=name, kind=kind)
+
+    def end_span(self, span: Span, status: str = SpanStatus.OK,
+                 attrs: dict | None = None) -> Span:
+        return span
+
+    @contextmanager
+    def span(self, name: str, kind: str, parent_id: str = "",
+             attrs: dict | None = None):
+        yield self.start_span(name, kind, parent_id, attrs)
+
+    def record_payload(self, span_dicts: list) -> None:
+        pass
+
+
+class Tracer(NullTracer):
+    """Persisting tracer bound to ONE journal operation.
+
+    Durability granularity is the PHASE boundary, matching the journal
+    row's own progress writes: phase-kind spans hit the database the
+    moment they start (so a `kill -9` mid-phase leaves a Running phase
+    span next to the open operation row — the crash evidence an operator
+    drilling into an Interrupted op wants), while attempt/task/host spans
+    buffer in memory and land in ONE transaction when their phase ends.
+    Anything finer-grained costs a SQLite commit per span and measurably
+    slows deploys (the tier-1 tracing-overhead budget pins this).
+
+    `max_spans` bounds the tree (a pathological retry loop must not grow
+    a trace without limit); spans past the cap are counted, not stored,
+    and the truncation is recorded on the root span so the waterfall can
+    SAY it is incomplete instead of silently looking complete."""
+
+    enabled = True
+
+    def __init__(self, spans_repo, *, trace_id: str, op_id: str,
+                 cluster_id: str, max_spans: int = 2000) -> None:
+        self.spans = spans_repo
+        self.trace_id = trace_id
+        self.op_id = op_id
+        self.root_id = op_id      # root span id == operation id, by contract
+        self.cluster_id = cluster_id
+        self.max_spans = max_spans
+        self._admitted: set = set()   # span ids under the cap
+        self._dropped_ids: set = set()
+        self._buffer: dict = {}       # span id -> Span, pending one flush
+
+    # ---- lifecycle ----
+    def start_span(self, name: str, kind: str, parent_id: str = "",
+                   attrs: dict | None = None) -> Span:
+        span = Span(
+            trace_id=self.trace_id, parent_id=parent_id, op_id=self.op_id,
+            cluster_id=self.cluster_id, name=name, kind=kind,
+            status=SpanStatus.RUNNING, started_at=now_ts(),
+            attrs=dict(attrs or {}),
+        )
+        self._save(span)
+        return span
+
+    def end_span(self, span: Span, status: str = SpanStatus.OK,
+                 attrs: dict | None = None) -> Span:
+        span.finished_at = now_ts()
+        span.status = status
+        if attrs:
+            span.attrs.update(attrs)
+        self._save(span)
+        return span
+
+    def flush(self) -> None:
+        """Land the buffered spans in one transaction (best-effort: span
+        IO must never fail the operation it describes)."""
+        if not self._buffer:
+            return
+        batch, self._buffer = list(self._buffer.values()), {}
+        try:
+            self.spans.save_many(batch)
+        except Exception:
+            log.exception("span flush failed (trace %s)", self.trace_id)
+
+    @contextmanager
+    def span(self, name: str, kind: str, parent_id: str = "",
+             attrs: dict | None = None):
+        """Structural form: ends OK on normal exit, Failed on exception —
+        and re-raises, so tracing can never change control flow."""
+        span = self.start_span(name, kind, parent_id, attrs)
+        try:
+            yield span
+        except BaseException as e:
+            self.end_span(span, SpanStatus.FAILED, {"error": str(e)})
+            raise
+        self.end_span(span)
+
+    def record_payload(self, span_dicts: list) -> None:
+        """Persist executor-produced span payloads (TaskResult.spans):
+        already-finished task/host spans carrying the propagated trace id,
+        re-stamped with this operation's identity. One transaction for the
+        whole batch."""
+        spans: list[Span] = []
+        for d in span_dicts or []:
+            if not isinstance(d, dict):
+                continue
+            span = Span.from_dict(d)
+            span.op_id = self.op_id
+            span.cluster_id = self.cluster_id
+            span.trace_id = span.trace_id or self.trace_id
+            if self._admit(span.id):
+                self._buffer[span.id] = span
+
+    # ---- internals ----
+    def _admit(self, span_id: str) -> bool:
+        """Cap check keyed by span id: updates of an already-admitted span
+        always pass (end_span of a live span is never a new row), and a
+        DROPPED span's end can never resurrect it through the upsert —
+        nor count as a second drop."""
+        if span_id in self._admitted:
+            return True
+        if span_id in self._dropped_ids:
+            return False
+        if len(self._admitted) >= self.max_spans:
+            self._dropped_ids.add(span_id)
+            return False
+        self._admitted.add(span_id)
+        return True
+
+    def _save(self, span: Span) -> None:
+        if not self._admit(span.id):
+            return
+        self._buffer[span.id] = span
+        # phase STARTS (and the rare directly-produced operation span)
+        # are the durability points: starting phase N+1 lands phase N's
+        # whole subtree in the same transaction, and close() flushes the
+        # final one — one commit per phase, total
+        if span.kind in (SpanKind.OPERATION, SpanKind.PHASE) \
+                and not span.finished_at:
+            self.flush()
+
+    def note_truncation(self, root: Span) -> None:
+        """Stamp the drop count onto the root span at close time, so a
+        capped trace is visibly capped."""
+        if self._dropped_ids:
+            root.attrs["spans_dropped"] = len(self._dropped_ids)
+
+
+# ======================================================================
+# consumer side: tree building + rendering
+# ======================================================================
+def span_tree(spans: list) -> dict | None:
+    """Join one operation's spans into a nested tree.
+
+    Returns the root node (kind=operation) as a plain dict:
+    {id, name, kind, status, started_at, finished_at, duration_s, self_s,
+     critical, attrs, children: [...]}, children start-ordered. Spans whose
+    parent is missing (dropped by the cap, or written by a crashed
+    producer) attach to the root so nothing silently disappears. None when
+    the list is empty."""
+    if not spans:
+        return None
+    nodes: dict[str, dict] = {}
+    for s in spans:
+        nodes[s.id] = {
+            "id": s.id, "name": s.name, "kind": s.kind, "status": s.status,
+            "started_at": s.started_at, "finished_at": s.finished_at,
+            "duration_s": round(s.duration_s, 3) if s.duration_s else None,
+            "attrs": dict(s.attrs), "children": [],
+        }
+    root_span = next(
+        (s for s in spans
+         if s.kind == SpanKind.OPERATION and not s.parent_id), None)
+    if root_span is not None:
+        root = nodes[root_span.id]
+    else:
+        # no operation span (e.g. a pre-observability op row): synthesize
+        # one so consumers always get the same shape
+        root = {
+            "id": "", "name": "(no operation span)",
+            "kind": SpanKind.OPERATION, "status": "", "started_at": 0.0,
+            "finished_at": 0.0, "duration_s": None, "attrs": {},
+            "children": [],
+        }
+    for s in spans:
+        if root_span is not None and s.id == root_span.id:
+            continue
+        node = nodes[s.id]
+        parent = nodes.get(s.parent_id)
+        if parent is None or parent is node:
+            # orphan (capped tree / crashed producer): attach to the root
+            # with a flag, so nothing silently disappears from the render
+            if s.parent_id and s.parent_id != root["id"]:
+                node["attrs"].setdefault("orphaned", True)
+            root["children"].append(node)
+        else:
+            parent["children"].append(node)
+    _finalize(root)
+    mark_critical_path(root)
+    return root
+
+
+def _finalize(node: dict) -> None:
+    """Depth-first: self-time (duration minus the union of child windows)
+    and the critical path (at every level, the child that finished last)."""
+    children = node["children"]
+    children.sort(key=lambda c: (c["started_at"], c["name"]))
+    for child in children:
+        _finalize(child)
+    # self time: subtract the merged child intervals from the node window
+    if node["started_at"] and node["finished_at"]:
+        covered = 0.0
+        intervals = sorted(
+            (c["started_at"], c["finished_at"]) for c in children
+            if c["started_at"] and c["finished_at"]
+        )
+        cursor = node["started_at"]
+        for lo, hi in intervals:
+            lo = max(lo, cursor)
+            hi = min(hi, node["finished_at"])
+            if hi > lo:
+                covered += hi - lo
+                cursor = hi
+        node["self_s"] = round(
+            max(node["finished_at"] - node["started_at"] - covered, 0.0), 3)
+    else:
+        node["self_s"] = None
+    node["critical"] = False
+
+
+def mark_critical_path(root: dict) -> None:
+    """Walk from the root, at each node descending into the child whose
+    finish stamp is latest — the chain an operator must shorten to shorten
+    the operation."""
+    node = root
+    while node is not None:
+        node["critical"] = True
+        finished = [c for c in node["children"] if c["finished_at"]]
+        node = (max(finished, key=lambda c: c["finished_at"])
+                if finished else None)
+
+
+def render_waterfall(root: dict, width: int = 40) -> str:
+    """Aligned text waterfall over a span tree (plain dicts, so the CLI can
+    render straight from the REST JSON). `*` marks the critical path."""
+    t0 = root["started_at"] or min(
+        (c["started_at"] for c in root["children"] if c["started_at"]),
+        default=0.0)
+    t1 = root["finished_at"] or max(
+        (c["finished_at"] for c in root["children"] if c["finished_at"]),
+        default=t0)
+    total = max(t1 - t0, 1e-9)
+    mark_critical_path(root)
+
+    lines = [
+        f"operation {root['name'] or '?'}  status={root['status'] or '?'}  "
+        f"total={root['duration_s'] if root['duration_s'] is not None else round(total, 3)}s"
+        + (f"  [TRUNCATED: {root['attrs']['spans_dropped']} spans dropped]"
+           if root['attrs'].get("spans_dropped") else "")
+    ]
+
+    def emit(node: dict, depth: int) -> None:
+        label = ("  " * depth) + f"{node['kind']}:{node['name']}"
+        dur = (f"{node['duration_s']:.3f}s" if node["duration_s"] is not None
+               else node["status"] or "-")
+        self_s = (f" self={node['self_s']:.3f}s"
+                  if node.get("self_s") is not None and node["children"]
+                  else "")
+        extras = ""
+        attrs = node["attrs"]
+        if attrs.get("classification"):
+            extras += f" [{str(attrs['classification']).lower()}]"
+        if attrs.get("attempt"):
+            extras += f" [attempt {attrs['attempt']}]"
+        bar = ""
+        if node["started_at"] and node["finished_at"]:
+            lo = int((node["started_at"] - t0) / total * width)
+            hi = max(int((node["finished_at"] - t0) / total * width), lo + 1)
+            bar = " " * lo + "█" * (hi - lo)
+        crit = "*" if node.get("critical") else " "
+        status = "✗" if node["status"] == SpanStatus.FAILED else " "
+        lines.append(
+            f"{crit}{status}{label:<46.46s} {dur:>9s}{self_s:<14s} "
+            f"|{bar:<{width}s}|{extras}"
+        )
+        for child in node["children"]:
+            emit(child, depth + 1)
+
+    for child in root["children"]:
+        emit(child, 0)
+    lines.append("(* = critical path, ✗ = failed span)")
+    return "\n".join(lines)

@@ -1,0 +1,23 @@
+-- Operation trace spans (docs/observability.md): one row per node of the
+-- operation -> phase -> attempt -> task -> host tree, keyed by the owning
+-- journal operation (005_operations.sql). Written live as spans start and
+-- finish, so a controller killed mid-operation leaves the spans recorded
+-- so far (status Running) as evidence of where the wall-clock stopped.
+CREATE TABLE IF NOT EXISTS spans (
+    id TEXT PRIMARY KEY,
+    trace_id TEXT NOT NULL,
+    parent_id TEXT NOT NULL,
+    op_id TEXT NOT NULL,
+    cluster_id TEXT NOT NULL,
+    kind TEXT NOT NULL,
+    name TEXT NOT NULL,
+    status TEXT NOT NULL,
+    started_at REAL NOT NULL,
+    finished_at REAL NOT NULL,
+    data TEXT NOT NULL,
+    created_at REAL NOT NULL,
+    updated_at REAL NOT NULL
+);
+CREATE INDEX IF NOT EXISTS idx_spans_op ON spans (op_id);
+CREATE INDEX IF NOT EXISTS idx_spans_cluster ON spans (cluster_id);
+CREATE INDEX IF NOT EXISTS idx_spans_kind ON spans (kind);

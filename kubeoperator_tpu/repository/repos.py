@@ -29,6 +29,7 @@ from kubeoperator_tpu.models import (
     ProjectMember,
     Region,
     Setting,
+    Span,
     TaskLogChunk,
     User,
     Zone,
@@ -337,6 +338,92 @@ class OperationRepo(EntityRepo[Operation]):
         )
         return [self._hydrate(r["data"]) for r in rows]
 
+    def count_by_status(self) -> dict[str, int]:
+        """Journal rows by status, computed IN SQL on the mirrored column —
+        the /metrics journal gauge must not hydrate the whole history per
+        scrape."""
+        rows = self.db.query(
+            f"SELECT status, COUNT(*) AS n FROM {self.table} "
+            f"GROUP BY status"
+        )
+        return {r["status"]: int(r["n"]) for r in rows}
+
+
+class SpanRepo(EntityRepo[Span]):
+    """Operation trace spans (models/span.py). Timing fields are mirrored
+    into real columns so the scrape-time histogram collectors and the trace
+    endpoint run on indexed SQL, never a hydrate-everything scan."""
+
+    table, entity, columns = "spans", Span, (
+        "trace_id", "parent_id", "op_id", "cluster_id", "kind", "name",
+        "status", "started_at", "finished_at",
+    )
+
+    def save_many(self, spans: Iterable[Span]) -> None:
+        """Batch-upsert in ONE transaction — the executor hands back a
+        task span plus one span per host at the end of every attempt, and
+        a deploy must not pay a transaction per host for them."""
+        spans = list(spans)
+        if not spans:
+            return
+        cols = ["id", *self.columns, "data", "created_at", "updated_at"]
+        updates = ",".join(f"{c}=excluded.{c}" for c in cols if c != "id")
+        with self.db.tx() as conn:
+            conn.executemany(
+                f"INSERT INTO {self.table} ({','.join(cols)}) "
+                f"VALUES ({','.join('?' for _ in cols)}) "
+                f"ON CONFLICT(id) DO UPDATE SET {updates}",
+                [
+                    (
+                        s.id,
+                        *[self._column_value(s, c) for c in self.columns],
+                        json.dumps(s.to_dict()), s.created_at, s.updated_at,
+                    )
+                    for s in spans
+                ],
+            )
+
+    def for_operation(self, op_id: str) -> list[Span]:
+        """Every span of one operation, start-ordered (rowid tiebreak keeps
+        same-timestamp siblings stable)."""
+        rows = self.db.query(
+            f"SELECT data FROM {self.table} WHERE op_id=? "
+            f"ORDER BY started_at, rowid",
+            (op_id,),
+        )
+        return [self._hydrate(r["data"]) for r in rows]
+
+    def duration_rows(self, kind: str) -> list[tuple]:
+        """(name, duration_s, trace_id) for every FINISHED span of `kind` —
+        the histogram collectors' raw material, straight off the mirrored
+        columns (no JSON hydration on the scrape path)."""
+        rows = self.db.query(
+            f"SELECT name, finished_at - started_at AS d, trace_id "
+            f"FROM {self.table} "
+            f"WHERE kind=? AND started_at > 0 AND finished_at > 0 "
+            f"ORDER BY rowid",
+            (kind,),
+        )
+        return [(r["name"], float(r["d"]), r["trace_id"]) for r in rows]
+
+    def prune_to_operations(self, keep: int) -> int:
+        """Bounded trace store: keep spans of the newest `keep` operations
+        (by the operations table's own ordering) and drop the rest — the
+        span tree of a two-month-old create is journal history, not a
+        debugging artifact worth its disk."""
+        if keep < 1:
+            return 0
+        # cursor rowcount, NOT before/after COUNT(*) scans: this runs on
+        # every operation close, on the operation's worker thread
+        with self.db.tx() as conn:
+            cur = conn.execute(
+                f"DELETE FROM {self.table} WHERE op_id NOT IN ("
+                f"SELECT id FROM operations "
+                f"ORDER BY created_at DESC, rowid DESC LIMIT ?)",
+                (keep,),
+            )
+            return max(cur.rowcount, 0)
+
 
 class CisScanRepo(EntityRepo[CisScan]):
     table, entity, columns = "cis_scans", CisScan, ("cluster_id", "status")
@@ -370,6 +457,7 @@ class Repositories:
         self.task_logs = TaskLogChunkRepo(db)
         self.components = ComponentRepo(db)
         self.operations = OperationRepo(db)
+        self.spans = SpanRepo(db)
         self.cis_scans = CisScanRepo(db)
         self.settings = SettingRepo(db)
         self.audit = AuditRepo(db)

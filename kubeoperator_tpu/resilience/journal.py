@@ -10,12 +10,27 @@ is opened BEFORE the cluster leaves its resting phase, updated per adm
 phase transition, and closed on success/failure. A `kill -9` therefore
 leaves an open `Running` op next to the stranded cluster row — exactly the
 pair the boot reconciler (service/reconcile.py) sweeps.
+
+The journal is also the trace anchor (docs/observability.md): open()
+mints the operation's trace id and root span (the root span id IS the
+operation id), attach() hands the adm engine a Tracer bound to the op, and
+close()/interrupt() finish the root span — so every operation leaves one
+durable `operation → phase → attempt → task → host` tree behind, keyed by
+the same id the journal row carries.
 """
 
 from __future__ import annotations
 
 from kubeoperator_tpu.models import Cluster, Operation, OperationStatus
 from kubeoperator_tpu.models.cluster import ClusterPhaseStatus
+from kubeoperator_tpu.models.span import Span, SpanKind, SpanStatus
+from kubeoperator_tpu.observability import (
+    NullTracer,
+    Tracer,
+    bind_trace,
+    clear_trace,
+    new_trace_id,
+)
 from kubeoperator_tpu.utils.ids import now_ts
 from kubeoperator_tpu.utils.logging import get_logger
 
@@ -40,8 +55,16 @@ def default_journal(repos, journal=None) -> "OperationJournal":
 
 
 class OperationJournal:
-    def __init__(self, repos) -> None:
+    def __init__(self, repos, tracing: bool = True,
+                 max_spans_per_op: int = 2000,
+                 retain_operations: int = 200) -> None:
         self.repos = repos
+        self.tracing = tracing
+        self.max_spans_per_op = max_spans_per_op
+        self.retain_operations = retain_operations
+        # one live Tracer per open op, so attach() and close() share the
+        # same span-budget accounting; entries drop at close/interrupt
+        self._tracers: dict[str, Tracer] = {}
 
     # ---- lifecycle ----
     def open(self, cluster: Cluster, kind: str,
@@ -53,11 +76,36 @@ class OperationJournal:
         op = Operation(
             cluster_id=cluster.id, cluster_name=cluster.name, kind=kind,
             vars=dict(vars or {}), message=message,
+            trace_id=new_trace_id() if self.tracing else "",
         )
         self.repos.operations.save(op)
+        if self.tracing:
+            # root span id == operation id, by contract: close/interrupt
+            # (possibly in a different process after a crash+reboot) can
+            # always find it without extra bookkeeping
+            self.repos.spans.save(Span(
+                id=op.id, trace_id=op.trace_id, parent_id="", op_id=op.id,
+                cluster_id=cluster.id, name=kind, kind=SpanKind.OPERATION,
+                status=SpanStatus.RUNNING, started_at=now_ts(),
+                attrs={"cluster": cluster.name},
+            ))
         if phase is not None:
             self.set_phase(cluster, phase)
         return op
+
+    def tracer_for(self, op: Operation):
+        """The op's span producer: a persisting Tracer while tracing is on
+        and the op carries a trace id, else the shared NullTracer."""
+        if not self.tracing or not op.trace_id:
+            return NullTracer()
+        tracer = self._tracers.get(op.id)
+        if tracer is None:
+            tracer = Tracer(
+                self.repos.spans, trace_id=op.trace_id, op_id=op.id,
+                cluster_id=op.cluster_id, max_spans=self.max_spans_per_op,
+            )
+            self._tracers[op.id] = tracer
+        return tracer
 
     def set_phase(self, cluster: Cluster,
                   phase: ClusterPhaseStatus) -> None:
@@ -72,11 +120,19 @@ class OperationJournal:
         op reads 'died during kube-master', not just 'died'."""
         op.phase = phase_name
         op.phase_status = phase_status
+        # log correlation: every record the worker thread emits from here
+        # on names the phase it was in (observability/logging.py)
+        bind_trace(phase=phase_name)
         self.repos.operations.save(op)
 
     def attach(self, op: Operation, ctx) -> None:
-        """Wire an AdmContext's phase hook to this op's progress record."""
+        """Wire an AdmContext's phase hook to this op's progress record and
+        hand the engine the op's tracer. Runs on the operation's worker
+        thread, so the log trace context binds to the right thread."""
         ctx.on_phase = lambda name, status: self.progress(op, name, status)
+        ctx.tracer = self.tracer_for(op)
+        bind_trace(trace_id=op.trace_id or None, op_id=op.id,
+                   cluster=op.cluster_name)
 
     def close(self, op: Operation, ok: bool, message: str = "") -> Operation:
         op.status = (OperationStatus.SUCCEEDED.value if ok
@@ -84,6 +140,14 @@ class OperationJournal:
         op.message = message
         op.finished_at = now_ts()
         self.repos.operations.save(op)
+        self._finish_root(op, SpanStatus.OK if ok else SpanStatus.FAILED,
+                          message)
+        # unbind the log context bound at attach: close() runs on the
+        # thread that ran the op (incl. wait=True callers like the
+        # watchdog's cron thread and aiohttp's run_sync pool), and a
+        # REUSED thread must not stamp later, unrelated records with this
+        # operation's trace_id/cluster
+        clear_trace()
         return op
 
     def interrupt(self, op: Operation, resume_phase: str = "",
@@ -96,9 +160,37 @@ class OperationJournal:
         op.message = message or "controller died while this operation ran"
         op.finished_at = now_ts()
         self.repos.operations.save(op)
+        self._finish_root(op, SpanStatus.FAILED, op.message)
         log.warning("operation %s (%s on %s) marked interrupted; resume at %r",
                     op.id, op.kind, op.cluster_name, resume_phase)
+        clear_trace()   # same thread-reuse hygiene as close()
         return op
+
+    def _finish_root(self, op: Operation, status: str, message: str) -> None:
+        """Finish the operation's root span (best-effort: tracing is
+        diagnostics and must never fail the close it describes) and apply
+        span retention."""
+        if not self.tracing or not op.trace_id:
+            return
+        tracer = self._tracers.pop(op.id, None)
+        if tracer is not None:
+            tracer.flush()   # land any spans still buffered past the
+            # last phase boundary before the tree is read back
+        try:
+            root = self.repos.spans.get(op.id)
+        except Exception:
+            return  # root span dropped/never written — nothing to finish
+        root.status = status
+        root.finished_at = op.finished_at
+        if message:
+            root.attrs["message"] = message
+        if tracer is not None:
+            tracer.note_truncation(root)
+        try:
+            self.repos.spans.save(root)
+            self.repos.spans.prune_to_operations(self.retain_operations)
+        except Exception:
+            log.exception("root span close failed for op %s", op.id)
 
     # ---- queries ----
     def open_ops(self, cluster_id: str | None = None) -> list[Operation]:
@@ -109,3 +201,11 @@ class OperationJournal:
 
     def history(self, cluster_id: str, limit: int = 50) -> list[Operation]:
         return self.repos.operations.history(cluster_id, limit)
+
+    def operation(self, op_id: str) -> Operation:
+        return self.repos.operations.get(op_id)
+
+    def spans_of(self, op_id: str) -> list:
+        """The op's persisted span tree rows, start-ordered — the trace
+        endpoint's and `koctl trace`'s data source."""
+        return self.repos.spans.for_operation(op_id)

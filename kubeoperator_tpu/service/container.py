@@ -78,7 +78,17 @@ class Services:
         from kubeoperator_tpu.resilience import OperationJournal, retry_wiring
 
         retry_policy, retry_rng = retry_wiring(config)
-        self.journal = OperationJournal(repos)
+        # the journal is also the trace anchor (docs/observability.md):
+        # every operation it opens gets a durable span tree under the
+        # observability.* knobs
+        self.journal = OperationJournal(
+            repos,
+            tracing=bool(config.get("observability.tracing", True)),
+            max_spans_per_op=int(
+                config.get("observability.max_spans_per_op", 2000)),
+            retain_operations=int(
+                config.get("observability.retain_operations", 200)),
+        )
         self.clusters = ClusterService(
             repos, executor, provisioner, self.events, config,
             retry_policy=retry_policy, retry_rng=retry_rng,
@@ -139,7 +149,8 @@ def build_services(
     the binaries exist, simulation otherwise (air-gapped demo parity)."""
     config = config or load_config()
     setup_logging(
-        config.get("logging.level", "INFO"), config.get("logging.dir")
+        config.get("logging.level", "INFO"), config.get("logging.dir"),
+        json_logs=bool(config.get("observability.json_logs", False)),
     )
     db = Database(config.get("db.path", "ko_tpu.db"))
     repos = Repositories(db)

@@ -166,6 +166,22 @@ DEFAULTS: dict[str, Any] = {
         "level": "INFO",
         "dir": None,  # None -> stderr only
     },
+    "observability": {
+        # operation tracing (observability/tracing.py, docs/observability.md):
+        # persist one operation→phase→attempt→task→host span tree per
+        # journal operation, rendered by `koctl trace` and feeding the
+        # /metrics duration histograms
+        "tracing": True,
+        # bound per trace: a pathological retry loop must not grow a span
+        # tree without limit (the root span records how many were dropped)
+        "max_spans_per_op": 2000,
+        # span retention: keep the trees of the newest N journal
+        # operations, prune the rest at operation close
+        "retain_operations": 200,
+        # structured JSON log records (one object per line, carrying
+        # trace_id/op_id/cluster/phase) instead of the human text format
+        "json_logs": False,
+    },
     "i18n": {
         "default_locale": "en-US",
     },

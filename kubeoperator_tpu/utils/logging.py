@@ -12,14 +12,33 @@ import os
 import sys
 
 
-def setup_logging(level: str = "INFO", log_dir: str | None = None) -> logging.Logger:
-    root = logging.getLogger("ko_tpu")
-    root.setLevel(getattr(logging, level.upper(), logging.INFO))
-    if root.handlers:  # idempotent across repeated service construction
-        return root
-    fmt = logging.Formatter(
+def _formatter(json_logs: bool) -> logging.Formatter:
+    if json_logs:
+        # lazy import: observability/logging.py is stdlib-only, but going
+        # through it here (not at module import) keeps utils.logging free
+        # of package-import cycles
+        from kubeoperator_tpu.observability.logging import JsonLogFormatter
+
+        return JsonLogFormatter()
+    return logging.Formatter(
         "%(asctime)s %(levelname)-7s %(name)s: %(message)s", "%H:%M:%S"
     )
+
+
+def setup_logging(level: str = "INFO", log_dir: str | None = None,
+                  json_logs: bool = False) -> logging.Logger:
+    """`json_logs` (the `observability.json_logs` knob) switches every
+    handler to one-JSON-object-per-line records carrying the bound trace
+    context (observability/logging.py)."""
+    root = logging.getLogger("ko_tpu")
+    root.setLevel(getattr(logging, level.upper(), logging.INFO))
+    fmt = _formatter(json_logs)
+    if root.handlers:  # idempotent across repeated service construction —
+        # but the format MODE follows the latest config: a stack rebuilt
+        # with json_logs flipped must not keep emitting the old shape
+        for handler in root.handlers:
+            handler.setFormatter(fmt)
+        return root
     sh = logging.StreamHandler(sys.stderr)
     sh.setFormatter(fmt)
     root.addHandler(sh)

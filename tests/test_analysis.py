@@ -729,6 +729,123 @@ class TestExceptionFlow:  # KO-P009
         assert flow_findings(tmp_path, {"svc.py": src}, "KO-P009") == []
 
 
+class TestSpanDiscipline:  # KO-P010
+    def test_fires_on_span_leak(self, tmp_path):
+        src = """\
+            class E:
+                def run_phase(self, ctx, tracer):
+                    span = tracer.start_span("etcd", "phase")
+                    self.work(ctx)
+                    return True
+            """
+        findings = flow_findings(tmp_path, {"eng.py": src}, "KO-P010")
+        assert [f.rule for f in findings] == ["KO-P010"]
+        assert "end_span" in findings[0].message
+
+    def test_end_on_all_paths_is_quiet(self, tmp_path):
+        src = """\
+            class E:
+                def run_phase(self, ctx, tracer):
+                    span = tracer.start_span("etcd", "phase")
+                    try:
+                        self.work(ctx)
+                    except Exception as e:
+                        tracer.end_span(span, "Failed", {"error": str(e)})
+                        raise
+                    tracer.end_span(span)
+            """
+        assert flow_findings(tmp_path, {"eng.py": src}, "KO-P010") == []
+
+    def test_exception_exit_leaves_span_running_quietly(self, tmp_path):
+        # propagation is sanctioned: a Running span next to an interrupted
+        # op is crash evidence, exactly like an open journal row
+        src = """\
+            class E:
+                def run_phase(self, ctx, tracer):
+                    span = tracer.start_span("etcd", "phase")
+                    self.work(ctx)
+                    tracer.end_span(span)
+            """
+        assert flow_findings(tmp_path, {"eng.py": src}, "KO-P010") == []
+
+    def test_while_true_retry_loop_shape_is_quiet(self, tmp_path):
+        # the adm engine's own shape: spans opened before/inside an
+        # infinite retry loop whose only exits are return/raise — the
+        # interpreter must not invent a zero-iteration fall-through
+        src = """\
+            class E:
+                def run_phase(self, ctx, tracer):
+                    phase_span = tracer.start_span("etcd", "phase")
+                    while True:
+                        attempt = tracer.start_span("a", "attempt")
+                        ok = self.attempt(ctx)
+                        if ok:
+                            tracer.end_span(attempt)
+                            tracer.end_span(phase_span)
+                            return
+                        tracer.end_span(attempt, "Failed")
+                        if not self.retryable(ctx):
+                            tracer.end_span(phase_span, "Failed")
+                            raise RuntimeError("halt")
+            """
+        assert flow_findings(tmp_path, {"eng.py": src}, "KO-P010") == []
+
+    def test_ownership_escape_stops_tracking(self, tmp_path):
+        src = """\
+            class E:
+                def begin(self, tracer):
+                    span = tracer.start_span("x", "phase")
+                    return span
+
+                def stash(self, tracer):
+                    span = tracer.start_span("x", "phase")
+                    self._open = span
+            """
+        assert flow_findings(tmp_path, {"eng.py": src}, "KO-P010") == []
+
+    def test_end_in_finally_is_quiet(self, tmp_path):
+        src = """\
+            class E:
+                def run_phase(self, ctx, tracer):
+                    span = tracer.start_span("etcd", "phase")
+                    try:
+                        self.work(ctx)
+                    finally:
+                        tracer.end_span(span)
+            """
+        assert flow_findings(tmp_path, {"eng.py": src}, "KO-P010") == []
+
+    def test_fires_on_bare_context_manager_call(self, tmp_path):
+        src = """\
+            class E:
+                def run(self, ctx):
+                    ctx.tracer.span("etcd", "phase")
+                    self.work(ctx)
+            """
+        findings = flow_findings(tmp_path, {"eng.py": src}, "KO-P010")
+        assert len(findings) == 1
+        assert "context expression" in findings[0].message
+
+    def test_with_context_manager_is_quiet(self, tmp_path):
+        src = """\
+            class E:
+                def run(self, ctx):
+                    with ctx.tracer.span("etcd", "phase"):
+                        self.work(ctx)
+            """
+        assert flow_findings(tmp_path, {"eng.py": src}, "KO-P010") == []
+
+    def test_waiver_comment_quiets_leak(self, tmp_path):
+        src = """\
+            class E:
+                def run_phase(self, ctx, tracer):
+                    # KO-P010: waived — span closed by the watchdog sweep
+                    span = tracer.start_span("etcd", "phase")
+                    self.work(ctx)
+            """
+        assert flow_findings(tmp_path, {"eng.py": src}, "KO-P010") == []
+
+
 class TestMutableDefault:  # KO-P004
     def test_fires_on_list_and_dict_literal(self, tmp_path):
         src = "def f(a=[], b={}):\n    return a, b\n"

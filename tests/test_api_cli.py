@@ -1033,7 +1033,7 @@ def test_metrics_output_is_valid_prometheus_exposition(client):
             parts = line.split()
             assert parts[2] == current_family, (
                 f"TYPE for {parts[2]} does not follow its HELP")
-            assert parts[3] in ("counter", "gauge"), line
+            assert parts[3] in ("counter", "gauge", "histogram"), line
             typed[parts[2]] = parts[3]
             continue
         assert not line.startswith("#"), f"unknown comment: {line}"
@@ -1052,12 +1052,19 @@ def test_metrics_output_is_valid_prometheus_exposition(client):
             f"sample {metric} outside its family block {current_family}")
         assert series not in seen_series, f"duplicate series: {line}"
         seen_series.add(series)
-    # counters follow the naming convention (sum/count pairs are declared
-    # gauges on purpose — see api/metrics.py rationale)
+    # counters follow the naming convention; histograms (the span-store
+    # duration families, docs/observability.md) expose the full
+    # bucket/sum/count triple
     for family, mtype in typed.items():
         if mtype == "counter":
             assert family.endswith("_total"), (
                 f"counter {family} must end in _total")
+        if mtype == "histogram":
+            names = {series.partition("{")[0] for series in seen_series}
+            suffixes = {n[len(family):] for n in names
+                        if n.startswith(family)}
+            assert suffixes in (set(), {"_bucket", "_sum", "_count"}), (
+                f"histogram {family} series mismatch: {suffixes}")
     assert len(typed) >= 10
 
     # the linter itself must reject the malformed shapes it claims to

@@ -1,0 +1,581 @@
+"""End-to-end operation tracing + metrics exposition (docs/observability.md).
+
+The acceptance drill (ISSUE 5): a simulated TPU cluster create through a
+chaos-wrapped FakeExecutor with ONE injected transient retry must leave one
+persisted span tree showing all five levels (operation/phase/attempt/task/
+host), the retried attempt as a sibling span carrying its FailureKind, and
+`/metrics` histogram buckets for the same run — plus the runner-RPC drill:
+a remote executor's task spans carry the caller's propagated trace id.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import re
+
+import pytest
+
+from kubeoperator_tpu.models import Credential, Plan, Region, Zone
+from kubeoperator_tpu.models.span import SpanKind, SpanStatus
+from kubeoperator_tpu.service import build_services
+from kubeoperator_tpu.utils.config import load_config
+
+
+def _services(tmp_path, **extra_overrides):
+    overrides = {
+        "db": {"path": str(tmp_path / "obs.db")},
+        "logging": {"level": "WARNING"},
+        "executor": {"backend": "fake"},
+        "provisioner": {"work_dir": str(tmp_path / "tf")},
+        "cron": {"backup_enabled": False, "health_check_interval_s": 0,
+                 "event_sync_interval_s": 0},
+        "cluster": {"kubeconfig_dir": str(tmp_path / "kc")},
+        # fast retries: the chaos-injected transient failure must not
+        # sleep a real backoff in CI
+        "resilience": {"backoff_base_s": 0.001, "backoff_max_s": 0.002},
+    }
+    for key, value in extra_overrides.items():
+        overrides.setdefault(key, {}).update(value)
+    config = load_config(path="/nonexistent", env={}, overrides=overrides)
+    return build_services(config, simulate=True)
+
+
+def _tpu_plan(services, name="obs-v5e-16"):
+    region = services.regions.create(Region(
+        name="obs-region", provider="gcp_tpu_vm",
+        vars={"project": "obs", "name": "us-central1"}))
+    zone = services.zones.create(Zone(
+        name="obs-zone", region_id=region.id,
+        vars={"gcp_zone": "us-central1-a"}))
+    services.plans.create(Plan(
+        name=name, provider="gcp_tpu_vm", region_id=region.id,
+        zone_ids=[zone.id], accelerator="tpu", tpu_type="v5e-16",
+        num_slices=1, worker_count=0))
+    return name
+
+
+# ======================================================================
+# the acceptance drill
+# ======================================================================
+class TestAcceptance:
+    @pytest.fixture()
+    def traced_create(self, tmp_path):
+        """One simulated TPU create (chaos-wrapped FakeExecutor, one
+        scripted transient unreachable on the etcd phase) plus its journal
+        op and spans; shared by the tree/CLI/metrics assertions."""
+        services = _services(tmp_path, chaos={"enabled": True, "seed": 7})
+        _tpu_plan(services)
+        # ChaosExecutor wraps the FakeExecutor; ONE scripted transient
+        # fault on etcd, then delegate — deterministic single retry
+        services.executor.fail_times("05-etcd.yml", 1, kind="unreachable")
+        # the FakeExecutor doesn't execute playbook content, so the smoke
+        # gate's marker line is scripted like test_adm does
+        services.executor.inner.script(
+            "17-tpu-smoke-test.yml",
+            lines=['KO_TPU_SMOKE_RESULT {"gbps": 84.3, "chips": 16, '
+                   '"passed": true, "simulated": true}'])
+        cluster = services.clusters.create(
+            "obs-acc", provision_mode="plan", plan_name="obs-v5e-16",
+            wait=True)
+        assert cluster.status.phase == "Ready"
+        op = services.journal.history(cluster.id, 1)[0]
+        spans = services.journal.spans_of(op.id)
+        yield services, cluster, op, spans
+        services.close()
+
+    def test_tree_has_all_five_levels_and_sibling_retry(self, traced_create):
+        services, cluster, op, spans = traced_create
+        assert op.status == "Succeeded" and op.trace_id
+        by_kind = {}
+        for s in spans:
+            by_kind.setdefault(s.kind, []).append(s)
+        for kind in SpanKind.ORDER:
+            assert by_kind.get(kind), f"no {kind} spans persisted"
+        # one trace, rooted at the operation id
+        assert {s.trace_id for s in spans} == {op.trace_id}
+        root = next(s for s in spans if s.kind == SpanKind.OPERATION)
+        assert root.id == op.id and root.status == SpanStatus.OK
+
+        # the retried phase has TWO sibling attempts under ONE phase span;
+        # the failed one carries its FailureKind attribute
+        etcd = next(s for s in by_kind[SpanKind.PHASE] if s.name == "etcd")
+        attempts = [s for s in by_kind[SpanKind.ATTEMPT]
+                    if s.parent_id == etcd.id]
+        assert len(attempts) == 2
+        failed = next(s for s in attempts if s.status == SpanStatus.FAILED)
+        ok = next(s for s in attempts if s.status == SpanStatus.OK)
+        assert failed.attrs["classification"] == "Transient"
+        assert failed.started_at <= ok.started_at
+
+        # task + host spans hang off the attempts with executor attrs
+        tasks = [s for s in by_kind[SpanKind.TASK]
+                 if s.parent_id in {a.id for a in attempts}]
+        assert len(tasks) == 2 and all(t.name == "05-etcd.yml"
+                                       for t in tasks)
+        failed_task = next(t for t in tasks
+                           if t.parent_id == failed.id)
+        assert failed_task.attrs["classification"] == "Transient"
+        hosts = [s for s in by_kind[SpanKind.HOST]
+                 if s.parent_id == failed_task.id]
+        assert hosts, "no host spans under the failed task"
+        assert any(h.attrs.get("unreachable") for h in hosts)
+
+    def test_koctl_trace_json_shows_the_tree(self, traced_create, capsys,
+                                             monkeypatch):
+        services, cluster, op, spans = traced_create
+        import kubeoperator_tpu.cli.koctl as koctl
+
+        client = koctl.LocalClient.__new__(koctl.LocalClient)
+        client.services = services
+        monkeypatch.setattr(koctl, "LocalClient", lambda: client)
+
+        assert koctl.main(["--local", "trace", "obs-acc", "--json"]) == 0
+        data = json.loads(capsys.readouterr().out)
+        assert data["operation"] == op.id
+        assert data["trace_id"] == op.trace_id
+        tree = data["tree"]
+
+        def kinds(node, out):
+            out.add(node["kind"])
+            for child in node["children"]:
+                kinds(child, out)
+            return out
+
+        assert kinds(tree, set()) == set(SpanKind.ORDER)
+        # the waterfall renders too, with the critical path marked
+        assert koctl.main(["--local", "trace", "obs-acc"]) == 0
+        text = capsys.readouterr().out
+        assert "phase:etcd" in text and "attempt:attempt-2" in text
+        assert "[transient]" in text
+        assert "*" in text  # critical path marker
+        # thin summary still serves, pointing at the full tree
+        summary = client.call("GET", "/api/v1/clusters/obs-acc/trace")
+        assert summary["latest_operation"]["id"] == op.id
+
+    def test_metrics_histograms_cover_the_run(self, traced_create):
+        services, cluster, op, spans = traced_create
+        from kubeoperator_tpu.api.metrics import MetricsRegistry
+
+        text = MetricsRegistry().render(services)
+        # phase-duration histogram buckets for the traced run, per phase
+        assert re.search(
+            r'ko_tpu_phase_duration_seconds_bucket\{le="\+Inf",'
+            r'phase="etcd"\} 1', text)
+        assert 'ko_tpu_phase_duration_seconds_count{phase="etcd"} 1' in text
+        # the retried phase produced TWO task observations
+        assert ('ko_tpu_task_duration_seconds_count{playbook="05-etcd.yml"}'
+                ' 2') in text
+        # journal gauge sees the closed op
+        assert 'ko_tpu_operations{status="Succeeded"} 1' in text
+        # OpenMetrics negotiation adds trace-id exemplars linking the
+        # buckets back to THIS run's trace
+        om = MetricsRegistry().render(services, openmetrics=True)
+        assert f'# {{trace_id="{op.trace_id}"}}' in om
+        assert om.rstrip().endswith("# EOF")
+
+    def test_interrupted_create_leaves_running_spans(self, tmp_path):
+        """ControllerDeath (chaos die_at_phase) must tear through WITHOUT
+        closing spans: Running phase span next to the open journal op is
+        the crash evidence the reconciler story builds on."""
+        from kubeoperator_tpu.resilience import ControllerDeath
+
+        services = _services(
+            tmp_path, chaos={"enabled": True, "die_at_phase": "05-etcd.yml"})
+        _tpu_plan(services, name="obs-die")
+        with pytest.raises(ControllerDeath):
+            services.clusters.create(
+                "obs-die-c", provision_mode="plan", plan_name="obs-die",
+                wait=True)
+        cluster = services.clusters.get("obs-die-c")
+        op = services.journal.history(cluster.id, 1)[0]
+        assert op.status == "Running"      # journal op still open
+        spans = services.journal.spans_of(op.id)
+        etcd = next(s for s in spans
+                    if s.kind == SpanKind.PHASE and s.name == "etcd")
+        assert etcd.status == SpanStatus.RUNNING
+        assert not etcd.finished_at
+        services.close()
+
+
+# ======================================================================
+# trace propagation across the runner RPC
+# ======================================================================
+class TestRunnerBoundary:
+    def test_remote_task_spans_carry_propagated_trace_id(self, tmp_path):
+        """The gRPC runner drill: the far side mints task/host spans with
+        the CALLER'S trace id and they ride back over the Result RPC."""
+        import socket
+
+        from kubeoperator_tpu.executor.fake import FakeExecutor
+        from kubeoperator_tpu.executor.runner_service import (
+            RunnerClient,
+            serve,
+        )
+        from kubeoperator_tpu.observability import trace_context
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        server = serve(FakeExecutor(), f"127.0.0.1:{port}")
+        try:
+            client = RunnerClient(f"127.0.0.1:{port}")
+            task_id = client.run_playbook(
+                "05-etcd.yml",
+                {"all": {"hosts": {"rh0": {}, "rh1": {}}}},
+                {},
+                trace=trace_context("trace-abc", "attempt-span-1"),
+            )
+            result = client.wait(task_id, timeout_s=30)
+            assert result.ok
+            kinds = {d["kind"] for d in result.spans}
+            assert kinds == {"task", "host"}
+            assert all(d["trace_id"] == "trace-abc" for d in result.spans)
+            task_span = next(d for d in result.spans if d["kind"] == "task")
+            assert task_span["parent_id"] == "attempt-span-1"
+            host_spans = [d for d in result.spans if d["kind"] == "host"]
+            assert {d["name"] for d in host_spans} == {"rh0", "rh1"}
+            assert all(d["parent_id"] == task_span["id"]
+                       for d in host_spans)
+        finally:
+            server.stop(grace=None)
+
+    def test_untraced_task_builds_no_spans(self):
+        from kubeoperator_tpu.executor.fake import FakeExecutor
+
+        ex = FakeExecutor()
+        task_id = ex.run_playbook("05-etcd.yml",
+                                  {"all": {"hosts": {"h": {}}}}, {})
+        assert ex.wait(task_id, timeout_s=10).spans == []
+
+
+# ======================================================================
+# tracer + tree unit behavior
+# ======================================================================
+class TestTracer:
+    def test_span_cap_counts_drops_on_root(self, tmp_path):
+        from kubeoperator_tpu.models import Cluster
+        from kubeoperator_tpu.repository import Database, Repositories
+        from kubeoperator_tpu.resilience import OperationJournal
+
+        repos = Repositories(Database(str(tmp_path / "cap.db")))
+        journal = OperationJournal(repos, max_spans_per_op=3)
+        cluster = Cluster(name="cap")
+        repos.clusters.save(cluster)
+        op = journal.open(cluster, "create")
+        tracer = journal.tracer_for(op)
+        spans = [tracer.start_span(f"p{i}", SpanKind.PHASE,
+                                   parent_id=tracer.root_id)
+                 for i in range(6)]
+        for span in spans:
+            tracer.end_span(span)
+        journal.close(op, ok=True)
+        root = repos.spans.get(op.id)
+        # the root span is written by the journal, outside the tracer's
+        # budget; 6 phase starts against a cap of 3 drop 3
+        assert root.attrs["spans_dropped"] == 3
+        assert len(repos.spans.for_operation(op.id)) == 1 + 3
+
+    def test_retention_prunes_old_operations(self, tmp_path):
+        from kubeoperator_tpu.models import Cluster
+        from kubeoperator_tpu.repository import Database, Repositories
+        from kubeoperator_tpu.resilience import OperationJournal
+
+        repos = Repositories(Database(str(tmp_path / "ret.db")))
+        journal = OperationJournal(repos, retain_operations=2)
+        cluster = Cluster(name="ret")
+        repos.clusters.save(cluster)
+        ops = []
+        for i in range(4):
+            op = journal.open(cluster, f"op-{i}")
+            journal.close(op, ok=True)
+            ops.append(op)
+        kept = {s.op_id for s in repos.spans.list()}
+        assert kept == {ops[2].id, ops[3].id}
+
+    def test_tree_self_time_and_critical_path(self):
+        from kubeoperator_tpu.models import Span
+        from kubeoperator_tpu.observability import span_tree
+
+        t0 = 1000.0   # realistic epoch base: 0.0 means "no timestamp"
+        spans = [
+            Span(id="root", op_id="root", kind=SpanKind.OPERATION,
+                 name="create", status="OK", started_at=t0,
+                 finished_at=t0 + 10.0),
+            Span(id="p1", parent_id="root", op_id="root",
+                 kind=SpanKind.PHASE, name="fast", status="OK",
+                 started_at=t0, finished_at=t0 + 2.0),
+            Span(id="p2", parent_id="root", op_id="root",
+                 kind=SpanKind.PHASE, name="slow", status="OK",
+                 started_at=t0 + 2.0, finished_at=t0 + 9.0),
+            Span(id="a1", parent_id="p2", op_id="root",
+                 kind=SpanKind.ATTEMPT, name="attempt-1", status="OK",
+                 started_at=t0 + 2.5, finished_at=t0 + 8.5),
+        ]
+        tree = span_tree(spans)
+        assert tree["id"] == "root"
+        # 10s window minus children covering [0,2]+[2,9] = 1s self
+        assert math.isclose(tree["self_s"], 1.0, abs_tol=1e-6)
+        slow = next(c for c in tree["children"] if c["name"] == "slow")
+        fast = next(c for c in tree["children"] if c["name"] == "fast")
+        # critical path: root -> slow (finished last) -> its attempt
+        assert tree["critical"] and slow["critical"]
+        assert slow["children"][0]["critical"]
+        assert not fast["critical"]
+
+    def test_tree_orphans_attach_to_root_flagged(self):
+        from kubeoperator_tpu.models import Span
+        from kubeoperator_tpu.observability import span_tree
+
+        spans = [
+            Span(id="root", op_id="root", kind=SpanKind.OPERATION,
+                 name="create", status="OK", started_at=1000.0,
+                 finished_at=1005.0),
+            Span(id="lost", parent_id="gone", op_id="root",
+                 kind=SpanKind.TASK, name="x", status="OK",
+                 started_at=1001.0, finished_at=1002.0),
+        ]
+        tree = span_tree(spans)
+        assert len(tree["children"]) == 1
+        assert tree["children"][0]["attrs"]["orphaned"] is True
+
+    def test_null_tracer_is_free_and_inert(self, tmp_path):
+        """Tracing disabled: no spans rows, no trace ids, zero executor
+        payloads — the knob really turns the subsystem off."""
+        services = _services(tmp_path,
+                             observability={"tracing": False})
+        services.credentials.create(Credential(name="ssh", password="pw"))
+        for i in range(2):
+            services.hosts.register(f"nt{i}", f"10.9.0.{i+1}", "ssh")
+        from kubeoperator_tpu.models import ClusterSpec
+
+        cluster = services.clusters.create(
+            "nt", spec=ClusterSpec(worker_count=1),
+            host_names=["nt0", "nt1"], wait=True)
+        assert cluster.status.phase == "Ready"
+        op = services.journal.history(cluster.id, 1)[0]
+        assert op.trace_id == ""
+        assert services.repos.spans.list() == []
+        services.close()
+
+
+# ======================================================================
+# structured logging
+# ======================================================================
+class TestJsonLogging:
+    def test_formatter_carries_bound_trace_context(self):
+        import logging as _logging
+
+        from kubeoperator_tpu.observability import (
+            JsonLogFormatter,
+            bind_trace,
+            clear_trace,
+        )
+
+        record = _logging.LogRecord(
+            "ko_tpu.adm", _logging.INFO, __file__, 1,
+            "phase %s OK", ("etcd",), None)
+        try:
+            bind_trace(trace_id="t-1", op_id="o-1", cluster="demo",
+                       phase="etcd", bogus="dropped")
+            out = json.loads(JsonLogFormatter().format(record))
+        finally:
+            clear_trace()
+        assert out["message"] == "phase etcd OK"
+        assert out["trace_id"] == "t-1" and out["op_id"] == "o-1"
+        assert out["cluster"] == "demo" and out["phase"] == "etcd"
+        assert "bogus" not in out
+        # cleared context leaves records untouched
+        out2 = json.loads(JsonLogFormatter().format(record))
+        assert "trace_id" not in out2
+
+    def test_setup_logging_mode_follows_latest_config(self):
+        import logging as _logging
+
+        from kubeoperator_tpu.observability import JsonLogFormatter
+        from kubeoperator_tpu.utils.logging import setup_logging
+
+        root = setup_logging("INFO", json_logs=True)
+        try:
+            assert all(isinstance(h.formatter, JsonLogFormatter)
+                       for h in root.handlers)
+            root = setup_logging("INFO", json_logs=False)
+            assert not any(isinstance(h.formatter, JsonLogFormatter)
+                           for h in root.handlers)
+        finally:
+            setup_logging("INFO", json_logs=False)
+            _logging.getLogger("ko_tpu").setLevel(_logging.WARNING)
+
+
+# ======================================================================
+# Prometheus exposition contract
+# ======================================================================
+class _StubRepo:
+    """Deterministic stand-ins for the scrape-time collectors."""
+
+    def __init__(self):
+        import types
+
+        self.clusters = types.SimpleNamespace(list=lambda: [])
+        self.spans = types.SimpleNamespace(
+            duration_rows=lambda kind: {
+                "phase": [("etcd", 0.12, "trace-1"),
+                          ("etcd", 3.4, "trace-2"),
+                          ("base", 0.7, "trace-1")],
+                "task": [("05-etcd.yml", 0.11, "trace-1")],
+            }[kind])
+        self.operations = types.SimpleNamespace(
+            count_by_status=lambda: {"Succeeded": 2, "Running": 1})
+
+
+class _StubServices:
+    def __init__(self):
+        import types
+
+        self.repos = _StubRepo()
+        self.watchdog = types.SimpleNamespace(status=lambda: [
+            {"cluster": "demo", "circuit": "open", "budget_left": 0},
+        ])
+        self.executor = types.SimpleNamespace(task_stats=lambda: {
+            "started_total": 4, "by_status": {"Success": 4}})
+        self.terminals = types.SimpleNamespace(stats=lambda: {
+            "sessions": 0, "dropped_chunks_total": 0})
+
+
+def _parse_exposition(text: str, openmetrics: bool):
+    """Minimal 0.0.4/OpenMetrics parser: returns {family: (type, [row])}
+    and enforces the shape contracts the golden test rides on."""
+    families: dict = {}
+    help_seen: set = set()
+    current = None
+    row_re = re.compile(
+        r'^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)'
+        r'(?:\{(?P<labels>[^}]*)\})? (?P<value>[^ #]+)'
+        r'(?P<exemplar> # \{[^}]*\} [^ ]+)?$')
+    for line in text.splitlines():
+        if line == "# EOF":
+            assert openmetrics, "# EOF only belongs to OpenMetrics output"
+            continue
+        if line.startswith("# HELP "):
+            help_seen.add(line.split()[2])
+            continue
+        if line.startswith("# TYPE "):
+            _, _, name, mtype = line.split(None, 3)
+            # HELP precedes TYPE for the same family
+            assert name in help_seen, f"TYPE before HELP for {name}"
+            assert name not in families, f"duplicate family {name}"
+            families[name] = (mtype, [])
+            current = name
+            continue
+        assert not line.startswith("#"), f"unexpected comment: {line}"
+        m = row_re.match(line)
+        assert m, f"unparseable sample row: {line!r}"
+        if m.group("exemplar"):
+            assert openmetrics, f"exemplar in classic output: {line!r}"
+        assert current is not None, f"sample before any TYPE: {line!r}"
+        name = m.group("name")
+        mtype = families[current][0]
+        suffixes = {"histogram": ("_bucket", "_sum", "_count"),
+                    "counter": ("_total", ""), "gauge": ("",)}[mtype]
+        assert any(name == current + s for s in suffixes) or \
+            name == current, f"sample {name} outside family {current}"
+        float(m.group("value"))
+        families[current][1].append(
+            (name, m.group("labels") or "", float(m.group("value"))))
+    return families
+
+
+class TestExposition:
+    def test_escaping(self):
+        from kubeoperator_tpu.api.metrics import _fmt
+
+        row = _fmt("m", {"x": 'a"b\\c\nd'}, 1)
+        assert row == 'm{x="a\\"b\\\\c\\nd"} 1'
+
+    def test_golden_families_and_shapes(self):
+        from kubeoperator_tpu.api.metrics import MetricsRegistry
+
+        registry = MetricsRegistry()
+        registry.observe_http("GET", 200)
+        text = registry.render(_StubServices())
+        families = _parse_exposition(text, openmetrics=False)
+        # counters end _total (classic naming keeps the suffix in TYPE)
+        for name, (mtype, _rows) in families.items():
+            if mtype == "counter":
+                assert name.endswith("_total"), name
+        assert families["ko_tpu_phase_duration_seconds"][0] == "histogram"
+        assert families["ko_tpu_operations"][0] == "gauge"
+        assert 'ko_tpu_http_requests_total{code="200",method="GET"} 1' \
+            in text
+        assert 'ko_tpu_watchdog_circuit_open{cluster="demo"} 1' in text
+
+    def test_histogram_buckets_monotone_and_inf_equals_count(self):
+        from kubeoperator_tpu.api.metrics import MetricsRegistry
+
+        text = MetricsRegistry().render(_StubServices())
+        families = _parse_exposition(text, openmetrics=False)
+        rows = families["ko_tpu_phase_duration_seconds"][1]
+        by_label: dict = {}
+        for name, labels, value in rows:
+            if name.endswith("_bucket"):
+                phase = re.search(r'phase="([^"]*)"', labels).group(1)
+                le = re.search(r'le="([^"]*)"', labels).group(1)
+                by_label.setdefault(phase, []).append((le, value))
+        counts = {l.split('"')[-2]: v for name, l, v in rows
+                  if name.endswith("_count")
+                  for l in [re.search(r'phase="[^"]*"', l).group(0)]}
+        for phase, buckets in by_label.items():
+            values = [v for _le, v in buckets]   # already in le order
+            assert values == sorted(values), f"{phase} not monotone"
+            le, inf_value = buckets[-1]
+            assert le == "+Inf"
+            assert inf_value == counts[phase]
+        # etcd observations land in the right buckets: 0.12 -> le 0.25,
+        # 3.4 -> le 5
+        etcd = dict(by_label["etcd"])
+        assert etcd["0.1"] == 0 and etcd["0.25"] == 1
+        assert etcd["2.5"] == 1 and etcd["5"] == 2
+
+    def test_openmetrics_roundtrip_with_exemplars(self):
+        from kubeoperator_tpu.api.metrics import MetricsRegistry
+
+        registry = MetricsRegistry()
+        registry.observe_http("GET", 200)
+        text = registry.render(_StubServices(), openmetrics=True)
+        assert text.rstrip().endswith("# EOF")
+        families = _parse_exposition(text, openmetrics=True)
+        # OpenMetrics counter family drops the _total suffix in TYPE
+        assert "ko_tpu_http_requests" in families
+        assert families["ko_tpu_http_requests"][0] == "counter"
+        # exemplars present on populated buckets, carrying trace ids
+        assert '# {trace_id="trace-2"} 3.4' in text
+        assert '# {trace_id="trace-1"} 0.12' in text
+
+
+class TestMetricsRegressions:
+    def test_sse_finished_clamps_at_zero(self):
+        from kubeoperator_tpu.api.metrics import MetricsRegistry
+
+        registry = MetricsRegistry()
+        registry.sse_started()
+        registry.sse_finished()
+        registry.sse_finished()   # unbalanced finish must clamp, not go -1
+        text = registry.render(_StubServices())
+        assert "ko_tpu_sse_consumers 0" in text
+        registry.sse_started()
+        assert "ko_tpu_sse_consumers 1" in registry.render(_StubServices())
+
+    def test_http_counter_records_raising_handlers(self, client):
+        """A handler that raises (KoError 404 here) must still land an
+        http_requests_total row — error rates are exactly what the
+        counter exists to show."""
+        import requests
+
+        base, http, services = client
+        resp = http.get(f"{base}/api/v1/clusters/definitely-not-here")
+        assert resp.status_code == 404
+        text = requests.get(f"{base}/metrics").text
+        row = next(l for l in text.splitlines()
+                   if l.startswith("ko_tpu_http_requests_total{")
+                   and 'code="404"' in l)
+        assert float(row.split()[-1]) >= 1

@@ -96,3 +96,63 @@ def test_cli_gate_exit_code_is_zero(capsys):
 
     assert main(["lint"]) == 0
     assert "0 error(s)" in capsys.readouterr().out
+
+
+def _timed_simulated_create(tmp_path, tag: str, tracing: bool) -> float:
+    """One 3-node simulated create (SimulationExecutor with a small
+    per-task delay so the measurement is dominated by stable sleeps, not
+    scheduler noise); returns wall-clock seconds."""
+    from kubeoperator_tpu.models import ClusterSpec, Credential
+    from kubeoperator_tpu.service import build_services
+    from kubeoperator_tpu.utils.config import load_config
+
+    config = load_config(path="/nonexistent", env={}, overrides={
+        "db": {"path": str(tmp_path / f"{tag}.db")},
+        "logging": {"level": "WARNING"},
+        "executor": {"backend": "simulation"},
+        "provisioner": {"work_dir": str(tmp_path / f"tf-{tag}")},
+        "cron": {"backup_enabled": False, "health_check_interval_s": 0,
+                 "event_sync_interval_s": 0},
+        "cluster": {"kubeconfig_dir": str(tmp_path / f"kc-{tag}")},
+        "observability": {"tracing": tracing},
+    })
+    services = build_services(config, simulate=True)
+    try:
+        services.executor.task_delay_s = 0.004
+        services.credentials.create(Credential(name=f"c{tag}",
+                                               password="pw"))
+        for i in range(3):
+            services.hosts.register(f"h{tag}{i}", f"10.77.{ord(tag[-1]) % 250}.{i + 1}",
+                                    f"c{tag}")
+        start = time.perf_counter()
+        cluster = services.clusters.create(
+            f"perf-{tag}", spec=ClusterSpec(worker_count=2),
+            host_names=[f"h{tag}{i}" for i in range(3)], wait=True)
+        elapsed = time.perf_counter() - start
+        assert cluster.status.phase == "Ready"
+        if tracing:
+            op = services.journal.history(cluster.id, 1)[0]
+            assert services.journal.spans_of(op.id), \
+                "traced run persisted no spans — the 'on' leg measured nothing"
+        else:
+            assert services.repos.spans.list() == []
+        return elapsed
+    finally:
+        services.close()
+
+
+def test_tracing_overhead_stays_under_budget(tmp_path):
+    """The observability layer's operational budget (PERF.md): a 3-node
+    simulated create with tracing ON must stay within 5% wall-clock of the
+    same create with tracing OFF. Best-of-2 per mode filters scheduler
+    noise; a small absolute floor keeps a sub-millisecond delta on a fast
+    machine from flapping the ratio."""
+    off = min(_timed_simulated_create(tmp_path, f"off{i}", False)
+              for i in range(2))
+    on = min(_timed_simulated_create(tmp_path, f"on{i}", True)
+             for i in range(2))
+    delta = on - off
+    assert delta < max(0.05 * off, 0.06), (
+        f"tracing overhead {delta:.3f}s on a {off:.3f}s create "
+        f"(>{max(0.05 * off, 0.06):.3f}s budget)"
+    )
