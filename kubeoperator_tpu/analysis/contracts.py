@@ -33,9 +33,10 @@ _DOC_KEY_RE = re.compile(r"`([a-z0-9_]+(?:\.[a-z0-9_]+)+)`")
 _PURE_KEY_RE = re.compile(r"^[a-z0-9_]+(\.[a-z0-9_]+)+$")
 
 # the config blocks the docs knob tables must cover completely (the
-# resilience layer's contract, extended to the observability block —
-# docs/resilience.md + docs/observability.md)
-DOC_REQUIRED_SECTIONS = ("resilience", "chaos", "watchdog", "observability")
+# resilience layer's contract, extended to the observability and fleet
+# blocks — docs/resilience.md + docs/observability.md)
+DOC_REQUIRED_SECTIONS = ("resilience", "chaos", "watchdog", "observability",
+                         "fleet")
 
 
 def _defaults_from_tree(root: str) -> dict | None:

@@ -179,6 +179,25 @@ class MetricsRegistry:
                "now; Interrupted = swept by the boot reconciler).",
                [_fmt("ko_tpu_operations", {"status": s}, n)
                 for s, n in sorted(ops_by_status.items())])
+        # fleet rollout waves by outcome (docs/resilience.md "Fleet
+        # operations"): fleet ops are few (one row per rollout ever), so
+        # hydrating them per scrape is in the noise
+        from kubeoperator_tpu.fleet import FLEET_UPGRADE_KIND
+
+        waves_by_outcome: dict[str, int] = {}
+        for fleet_op in services.repos.operations.find(
+                kind=FLEET_UPGRADE_KIND):
+            for wave in fleet_op.vars.get("waves", []):
+                outcome = str(wave.get("outcome", "pending"))
+                waves_by_outcome[outcome] = \
+                    waves_by_outcome.get(outcome, 0) + 1
+        family("ko_tpu_fleet_waves", "gauge",
+               "Fleet rollout waves by outcome (promoted / canary-blocked "
+               "/ rolled-back / failed / aborted / pending) across all "
+               "journaled fleet operations.",
+               [_fmt("ko_tpu_fleet_waves", {"outcome": o}, n)
+                for o, n in sorted(waves_by_outcome.items())])
+
         try:
             watchdog_rows = services.watchdog.status()
         except Exception:

@@ -715,6 +715,40 @@ class Handlers:
         return json_response(await run_sync(
             request, self.s.watchdog.reset, request.match_info["name"]))
 
+    # ---- fleet rollouts (docs/resilience.md "Fleet operations") ----
+    async def fleet_upgrade(self, request):
+        from kubeoperator_tpu.fleet import upgrade_kwargs
+
+        body = await request.json()
+        (target,) = require_fields(body, "target")
+        result = await run_sync(
+            request, self.s.fleet.upgrade, target,
+            wait=False, **upgrade_kwargs(body))
+        return json_response(result, status=202)
+
+    async def fleet_operations(self, request):
+        return json_response(await run_sync(request, self.s.fleet.list_ops))
+
+    async def fleet_operation(self, request):
+        return json_response(await run_sync(
+            request, self.s.fleet.status, request.match_info["op"]))
+
+    async def fleet_pause(self, request):
+        return json_response(await run_sync(
+            request, self.s.fleet.pause, request.match_info["op"]))
+
+    async def fleet_resume(self, request):
+        return json_response(await run_sync(
+            request, self.s.fleet.resume, request.match_info["op"]))
+
+    async def fleet_abort(self, request):
+        return json_response(await run_sync(
+            request, self.s.fleet.abort, request.match_info["op"]))
+
+    async def fleet_trace(self, request):
+        return json_response(await run_sync(
+            request, self.s.fleet.trace, request.match_info["op"]))
+
     async def recover(self, request):
         body = await request.json()
         await run_sync(request, self.s.health.recover,
@@ -1162,6 +1196,20 @@ def create_app(services: Services) -> web.Application:
     r.add_get("/api/v1/watchdog", admin_guard(h.watchdog_status))
     r.add_post("/api/v1/watchdog/{name}/reset",
                admin_guard(h.watchdog_reset))
+    # fleet rollouts are platform-level operations (they touch many
+    # clusters across projects), so the whole surface is admin-gated
+    r.add_post("/api/v1/fleet/upgrade", admin_guard(h.fleet_upgrade))
+    r.add_get("/api/v1/fleet/operations", admin_guard(h.fleet_operations))
+    r.add_get("/api/v1/fleet/operations/{op}",
+              admin_guard(h.fleet_operation))
+    r.add_post("/api/v1/fleet/operations/{op}/pause",
+               admin_guard(h.fleet_pause))
+    r.add_post("/api/v1/fleet/operations/{op}/resume",
+               admin_guard(h.fleet_resume))
+    r.add_post("/api/v1/fleet/operations/{op}/abort",
+               admin_guard(h.fleet_abort))
+    r.add_get("/api/v1/fleet/operations/{op}/trace",
+              admin_guard(h.fleet_trace))
     r.add_get("/api/v1/clusters/{name}/components",
               cluster_guard(h.list_components, view))
     r.add_post("/api/v1/clusters/{name}/components",

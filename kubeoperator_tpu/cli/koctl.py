@@ -232,6 +232,23 @@ class LocalClient:
                 return s.watchdog.status()
             case ("POST", ["watchdog", name, "reset"]):
                 return s.watchdog.reset(name)
+            case ("POST", ["fleet", "upgrade"]):
+                from kubeoperator_tpu.fleet import upgrade_kwargs
+
+                return s.fleet.upgrade(
+                    body["target"], wait=False, **upgrade_kwargs(body))
+            case ("GET", ["fleet", "operations"]):
+                return s.fleet.list_ops()
+            case ("GET", ["fleet", "operations", op_id]):
+                return s.fleet.status(op_id)
+            case ("POST", ["fleet", "operations", op_id, "pause"]):
+                return s.fleet.pause(op_id)
+            case ("POST", ["fleet", "operations", op_id, "resume"]):
+                return s.fleet.resume(op_id)
+            case ("POST", ["fleet", "operations", op_id, "abort"]):
+                return s.fleet.abort(op_id)
+            case ("GET", ["fleet", "operations", op_id, "trace"]):
+                return s.fleet.trace(op_id)
             case ("GET", ["clusters", name, "events"]):
                 return pub(s.events.list(s.clusters.get(name).id))
             case ("POST", ["clusters", name, "cis-scans"]):
@@ -792,6 +809,153 @@ def cmd_watchdog(client, args) -> int:
     raise SystemExit(f"unknown watchdog command {args.watchdog_cmd}")
 
 
+def _fleet_resolve_ref(client, op_ref: str) -> str:
+    """An explicit op id passes through; no ref = the newest fleet op
+    (resolved through the list endpoint so both transports behave the
+    same)."""
+    if op_ref:
+        return op_ref
+    ops = client.call("GET", "/api/v1/fleet/operations")
+    if not ops:
+        raise SystemExit("error: no fleet operations journaled")
+    return ops[0]["id"]
+
+
+def _print_fleet_op(op: dict) -> None:
+    waves = " ".join(
+        f"[{'C' if w['canary'] else w['index']}:"
+        f"{len(w['clusters'])}:{w['outcome']}]"
+        for w in op.get("waves", []))
+    breaker = op.get("breaker", {})
+    print(f"fleet {op['id']}  {op['status']:11s} -> "
+          f"{op['target_version']}  waves {waves}")
+    print(f"  completed {len(op.get('completed', []))}"
+          f"/{len(op.get('clusters', []))}"
+          f"  failed {len(op.get('failed', {}))}"
+          f"  rolled-back {len(op.get('rolled_back', []))}"
+          f"  circuit {breaker.get('circuit', '?')}"
+          + (f" ({breaker['opened_reason']})"
+             if breaker.get("opened_reason") else ""))
+    for name, why in op.get("failed", {}).items():
+        print(f"  failed {name}: {why}")
+    if op.get("message"):
+        print(f"  {op['message']}")
+
+
+def _poll_fleet(client, op_id: str, timeout_s: float, quiet: bool) -> int:
+    """Poll one rollout to a terminal state, narrating wave outcomes as
+    they settle. Exit 0 only on Succeeded (Paused/Interrupted are 1 — a
+    script waiting on a rollout must not read a parked one as done)."""
+    deadline = time.time() + timeout_s
+    seen: set[str] = set()
+    while time.time() < deadline:
+        op = client.call("GET", f"/api/v1/fleet/operations/{op_id}")
+        for w in op.get("waves", []):
+            key = f"{w['index']}:{w['outcome']}"
+            if w["outcome"] != "pending" and key not in seen:
+                seen.add(key)
+                if not quiet:
+                    kind = "canary" if w["canary"] else "wave"
+                    print(f"  {kind} {w['index']} "
+                          f"({len(w['clusters'])} clusters): "
+                          f"{w['outcome']}")
+        if op["status"] != "Running":
+            if not quiet:
+                _print_fleet_op(op)
+            return 0 if op["status"] == "Succeeded" else 1
+        time.sleep(1.0)
+    print(f"timed out waiting for fleet op {op_id}", file=sys.stderr)
+    return 2
+
+
+def cmd_fleet(client, args) -> int:
+    """Fleet rollout verbs (docs/resilience.md "Fleet operations"): wave-
+    based rolling upgrades with canary gates, a failure-budget breaker and
+    auto-rollback; `status`/`pause`/`resume`/`abort` manage the journaled
+    fleet op, `trace` renders the rollout's single stitched span tree."""
+    if args.fleet_cmd == "upgrade":
+        body: dict = {"target": args.target}
+        if args.selector:
+            # the planner's parser: a typo'd key dies HERE with the key
+            # named (the server re-validates for the REST body path)
+            from kubeoperator_tpu.fleet import parse_selector
+
+            try:
+                body["selector"] = parse_selector(args.selector)
+            except KoError as e:
+                raise SystemExit(f"error: {e.message}")
+        for flag in ("wave_size", "max_unavailable", "canary"):
+            value = getattr(args, flag)
+            if value is not None:
+                body[flag] = value
+        op = client.call("POST", "/api/v1/fleet/upgrade", body)
+        if args.json and args.no_wait:
+            _print(op)
+            return 0
+        print(f"fleet upgrade {op['id']}: {len(op['clusters'])} clusters "
+              f"-> {op['target_version']} in {len(op['waves'])} wave(s)")
+        for name, reason in op.get("skipped", []):
+            print(f"  skipped {name}: {reason}")
+        if args.no_wait:
+            return 0
+        return _poll_fleet(client, op["id"], args.timeout, quiet=False)
+    if args.fleet_cmd == "status":
+        if not args.op:
+            ops = client.call("GET", "/api/v1/fleet/operations")
+            if args.json:
+                _print(ops)
+            elif not ops:
+                print("no fleet operations journaled")
+            else:
+                for op in ops:
+                    _print_fleet_op(op)
+            # same exit contract as the single-op form, --json or not:
+            # scripts read the code, not the rendering
+            return 1 if any(o["status"] == "Failed" for o in ops) else 0
+        op = client.call(
+            "GET", f"/api/v1/fleet/operations/{args.op}")
+        if args.json:
+            _print(op)
+        else:
+            _print_fleet_op(op)
+        return 1 if op["status"] == "Failed" else 0
+    if args.fleet_cmd == "pause":
+        op_id = _fleet_resolve_ref(client, args.op)
+        _print(client.call(
+            "POST", f"/api/v1/fleet/operations/{op_id}/pause"))
+        return 0
+    if args.fleet_cmd == "resume":
+        op_id = _fleet_resolve_ref(client, args.op)
+        _print(client.call(
+            "POST", f"/api/v1/fleet/operations/{op_id}/resume"))
+        return 0
+    if args.fleet_cmd == "abort":
+        op_id = _fleet_resolve_ref(client, args.op)
+        _print(client.call(
+            "POST", f"/api/v1/fleet/operations/{op_id}/abort"))
+        return 0
+    if args.fleet_cmd == "trace":
+        op_id = _fleet_resolve_ref(client, args.op)
+        data = client.call(
+            "GET", f"/api/v1/fleet/operations/{op_id}/trace")
+        if args.json:
+            _print(data)
+            return 0
+        tree = data.get("tree")
+        if not tree:
+            print(f"fleet op {op_id} has no persisted spans "
+                  f"(observability.tracing disabled, or the trace was "
+                  f"pruned)", file=sys.stderr)
+            return 1
+        from kubeoperator_tpu.observability import render_waterfall
+
+        print(f"fleet operation {data['kind']}/{op_id}  "
+              f"trace {data.get('trace_id') or '-'}")
+        print(render_waterfall(tree))
+        return 0 if data.get("status") != "Failed" else 1
+    raise SystemExit(f"unknown fleet command {args.fleet_cmd}")
+
+
 def cmd_apply(client, args) -> int:
     """Declarative setup: apply a YAML of credentials/regions/zones/plans/
     hosts/backup-accounts (koctl's bulk bootstrap; no upstream analog but
@@ -1115,14 +1279,272 @@ def _chaos_soak_once(args, base_dir: str) -> dict:
     return report
 
 
+def _fleet_stack(args, base_dir: str, db_path: str, die_at_phase: str = ""):
+    """One service stack for the fleet drill: simulation executor under a
+    seeded ChaosExecutor over a REUSABLE on-disk DB (building a second
+    stack on the same path is the controlled 'controller reboot')."""
+    from kubeoperator_tpu.service import build_services
+    from kubeoperator_tpu.utils.config import load_config
+
+    config = load_config(path="/nonexistent", env={}, overrides={
+        "db": {"path": db_path},
+        "logging": {"level": "ERROR"},
+        "executor": {"backend": "simulation"},
+        "provisioner": {"work_dir": os.path.join(base_dir, "tf")},
+        "cron": {"backup_enabled": False, "health_check_interval_s": 0,
+                 "event_sync_interval_s": 0},
+        "cluster": {"kubeconfig_dir": os.path.join(base_dir, "kc")},
+        "chaos": {"enabled": True, "seed": args.seed,
+                  "die_at_phase": die_at_phase},
+        "resilience": {"max_attempts": 2, "backoff_base_s": 0.01,
+                       "backoff_max_s": 0.05},
+    })
+    return build_services(config, simulate=True)
+
+
+def _fleet_tree_outcomes(trace: dict) -> dict:
+    """{wave span name: outcome attr} read from the STITCHED span tree —
+    the drill asserts behavior from the trace, not only the journal."""
+    outcomes: dict = {}
+
+    def walk(node):
+        if node.get("kind") == "wave" and \
+                str(node.get("name", "")).startswith("wave-"):
+            outcomes[node["name"]] = node.get("attrs", {}).get("outcome")
+        for child in node.get("children", []):
+            walk(child)
+
+    if trace.get("tree"):
+        walk(trace["tree"])
+    return outcomes
+
+
+def cmd_fleet_soak(args) -> int:
+    """Deterministic fleet-scale chaos drill (`koctl chaos-soak --fleet`,
+    docs/resilience.md): over >= --clusters simulated TPU clusters, one
+    seeded run proves the three fleet-robustness behaviors — each asserted
+    from the journal rows AND the single stitched trace tree:
+
+      (a) canary-block     — an unreachable fault in the canary's health
+                             gate blocks promotion; no later wave runs
+      (b) mid-wave rollback — gate faults past the failure budget open the
+                             fleet breaker; the in-flight wave's upgraded
+                             clusters are re-journaled as rollback child
+                             ops back to their recorded versions
+      (c) death + resume   — ControllerDeath mid-wave strands the fleet op;
+                             a rebooted stack sweeps it to Interrupted and
+                             `fleet resume` finishes WITHOUT re-running
+                             completed clusters
+    """
+    import tempfile
+    import time as _time
+
+    from kubeoperator_tpu.models import Plan, Region, Zone
+    from kubeoperator_tpu.resilience import ControllerDeath
+    from kubeoperator_tpu.version import (
+        DEFAULT_K8S_VERSION,
+        SUPPORTED_K8S_VERSIONS,
+    )
+
+    t0 = _time.monotonic()
+    hop = SUPPORTED_K8S_VERSIONS.index(DEFAULT_K8S_VERSION) + 1
+    if hop >= len(SUPPORTED_K8S_VERSIONS):
+        # routine bundle maintenance can make the default the newest
+        # supported version — a clear refusal, not a raw IndexError
+        raise SystemExit(
+            "error: fleet soak needs an upgrade hop above the default "
+            f"version, but {DEFAULT_K8S_VERSION} is the newest supported")
+    target = SUPPORTED_K8S_VERSIONS[hop]
+    total = max(args.clusters, 9)
+    base_n = total // 3
+    groups = {"a": base_n, "b": base_n, "c": total - 2 * base_n}
+    checks: list[dict] = []
+
+    def check(name: str, ok, detail: str = "") -> None:
+        checks.append({"check": name, "ok": bool(ok), "detail": detail})
+
+    # the drill spans three stacks (the death scenario reboots one);
+    # the injection ledger aggregates across all of them
+    injected = {"total": 0, "by_kind": {}}
+
+    def tally(executor) -> None:
+        summary = executor.injection_summary()
+        injected["total"] += summary["total"]
+        for kind, count in summary["by_kind"].items():
+            injected["by_kind"][kind] = \
+                injected["by_kind"].get(kind, 0) + count
+
+    with tempfile.TemporaryDirectory(prefix="ko-fleet-soak-") as base:
+        db_path = os.path.join(base, "fleet.db")
+        svc = _fleet_stack(args, base, db_path)
+        region = svc.regions.create(Region(
+            name="soak-region", provider="gcp_tpu_vm",
+            vars={"project": "soak", "name": "us-central1"}))
+        zone = svc.zones.create(Zone(
+            name="soak-zone", region_id=region.id,
+            vars={"gcp_zone": "us-central1-a"}))
+        svc.plans.create(Plan(
+            name="soak-v5e-16", provider="gcp_tpu_vm", region_id=region.id,
+            zone_ids=[zone.id], accelerator="tpu", tpu_type="v5e-16",
+            worker_count=0))
+        for group, count in groups.items():
+            for i in range(count):
+                svc.clusters.create(
+                    f"soak-{group}-{i:02d}", provision_mode="plan",
+                    plan_name="soak-v5e-16", wait=True)
+        original = DEFAULT_K8S_VERSION
+        ops = svc.repos.operations
+
+        # ---- (a) canary gate failure blocks promotion ----
+        svc.executor.fail_at("adhoc:command", [1])
+        op_a = svc.fleet.upgrade(
+            target, selector={"name": "soak-a-*"}, canary=1,
+            wave_size=max(groups["a"] - 1, 1), max_unavailable=1, wait=True)
+        trace_a = svc.fleet.trace(op_a["id"])
+        check("a: fleet op Failed", op_a["status"] == "Failed",
+              op_a["message"])
+        check("a: canary wave blocked",
+              op_a["waves"][0]["outcome"] == "canary-blocked")
+        check("a: later waves never ran",
+              all(w["outcome"] == "pending" for w in op_a["waves"][1:]))
+        check("a: exactly one child op (the canary upgrade)",
+              [o.kind for o in ops.children(op_a["id"])] == ["upgrade"])
+        untouched = [f"soak-a-{i:02d}" for i in range(1, groups["a"])]
+        check("a: non-canary clusters untouched", all(
+            svc.clusters.get(n).spec.k8s_version == original
+            for n in untouched))
+        check("a: trace tree says canary-blocked",
+              _fleet_tree_outcomes(trace_a).get("wave-0")
+              == "canary-blocked")
+
+        # ---- (b) budget trip rolls the in-flight wave back ----
+        # gates probe 5 adhocs per TPU cluster: submission 1 fails the
+        # FIRST cluster's gate, 6 the SECOND's -> 2 unavailable > budget 1
+        svc.executor.fail_at("adhoc:command", [1, 6])
+        op_b = svc.fleet.upgrade(
+            target, selector={"name": "soak-b-*"}, canary=0,
+            wave_size=3, max_unavailable=1, wait=True)
+        trace_b = svc.fleet.trace(op_b["id"])
+        rolled = [f"soak-b-{i:02d}" for i in range(2)]
+        check("b: fleet op Failed", op_b["status"] == "Failed",
+              op_b["message"])
+        check("b: wave rolled back",
+              op_b["waves"][0]["outcome"] == "rolled-back")
+        check("b: breaker open with reason",
+              op_b["breaker"]["circuit"] == "open"
+              and "budget exceeded" in (op_b["breaker"]["opened_reason"]
+                                        or ""))
+        kinds_b = sorted(o.kind for o in ops.children(op_b["id"]))
+        check("b: 2 upgrades re-journaled as 2 rollbacks",
+              kinds_b == ["rollback", "rollback", "upgrade", "upgrade"],
+              str(kinds_b))
+        check("b: rolled-back clusters restored", all(
+            svc.clusters.get(n).spec.k8s_version == original
+            for n in rolled), str(op_b["rolled_back"]))
+        check("b: rest of the wave untouched", all(
+            svc.clusters.get(f"soak-b-{i:02d}").spec.k8s_version == original
+            for i in range(2, groups["b"])))
+        check("b: trace tree says rolled-back",
+              _fleet_tree_outcomes(trace_b).get("wave-0") == "rolled-back")
+        tally(svc.executor)
+        svc.close()
+
+        # ---- (c) controller death mid-wave, reboot, resume ----
+        # canary + wave of 3: submission 3 of upgrade-prepare is the
+        # SECOND wave-1 cluster -> death lands mid-wave with 2 clusters
+        # (canary + one wave-1) already completed
+        svc = _fleet_stack(args, base, db_path,
+                           die_at_phase="20-upgrade-prepare.yml#3")
+        died = False
+        try:
+            svc.fleet.upgrade(
+                target, selector={"name": "soak-c-*"}, canary=1,
+                wave_size=3, max_unavailable=1, wait=True)
+        except ControllerDeath:
+            died = True
+        check("c: controller death fired mid-wave", died)
+        open_fleet = [o for o in svc.repos.operations.find(
+            kind="fleet-upgrade", status="Running")]
+        check("c: fleet op left open by the crash", len(open_fleet) == 1)
+        op_c_id = open_fleet[0].id if open_fleet else ""
+        tally(svc.executor)
+        svc.close()
+
+        svc = _fleet_stack(args, base, db_path)   # the reboot
+        swept = {r["op"]: r for r in svc.boot_report}
+        check("c: boot sweep interrupted the fleet op",
+              swept.get(op_c_id, {}).get("kind") == "fleet-upgrade"
+              and swept.get(op_c_id, {}).get("resume_phase") == "wave-1",
+              str(svc.boot_report))
+        completed_before = set(
+            svc.fleet.status(op_c_id)["completed"])
+        svc.fleet.resume(op_c_id, wait=True)
+        op_c = svc.fleet.status(op_c_id)
+        trace_c = svc.fleet.trace(op_c_id)
+        check("c: rollout finished Succeeded after resume",
+              op_c["status"] == "Succeeded", op_c["message"])
+        check("c: every cluster at the target", all(
+            svc.clusters.get(f"soak-c-{i:02d}").spec.k8s_version == target
+            for i in range(groups["c"])))
+        children_c = svc.repos.operations.children(op_c_id)
+        per_cluster: dict = {}
+        for child in children_c:
+            per_cluster.setdefault(child.cluster_name, []).append(
+                child.status)
+        check("c: completed clusters were NOT re-run", all(
+            len(per_cluster.get(n, [])) == 1 for n in completed_before),
+            str({n: per_cluster.get(n) for n in completed_before}))
+        interrupted_cluster = [
+            n for n, statuses in per_cluster.items()
+            if "Interrupted" in statuses]
+        check("c: the mid-flight cluster was re-run to success",
+              len(interrupted_cluster) == 1
+              and "Succeeded" in per_cluster[interrupted_cluster[0]],
+              str(per_cluster))
+        outcomes_c = _fleet_tree_outcomes(trace_c)
+        check("c: one stitched tree with every wave promoted",
+              trace_c.get("tree") is not None and outcomes_c
+              and all(o == "promoted" for o in outcomes_c.values()),
+              str(outcomes_c))
+        tally(svc.executor)
+        svc.close()
+
+    ok = all(c["ok"] for c in checks)
+    report = {
+        "seed": args.seed,
+        "clusters": total,
+        "target": target,
+        "checks": checks,
+        "injection_summary": injected,
+        "ok": ok,
+        "runtime_s": round(_time.monotonic() - t0, 3),
+    }
+    if args.format == "json":
+        _print(report)
+    else:
+        print(f"fleet chaos-soak: seed={args.seed} clusters={total} "
+              f"-> {target}")
+        for c in checks:
+            mark = "ok " if c["ok"] else "FAIL"
+            print(f"  [{mark}] {c['check']}"
+                  + (f" — {c['detail']}" if c["detail"] and not c["ok"]
+                     else ""))
+        print(f"  runtime {report['runtime_s']}s — "
+              + ("OK" if ok else "FAILED"))
+    return 0 if ok else 1
+
+
 def cmd_chaos_soak(args) -> int:
     """Seeded chaos soak (docs/resilience.md): prove deploys ride through
     injected faults unattended, and that a seed reproduces bit-identical
     fault/retry traces. Exit 0 = every deploy reached Ready (and, with
-    --verify-determinism, both passes matched)."""
+    --verify-determinism, both passes matched). `--fleet` switches to the
+    fleet-scale drill (canary-block / wave-rollback / death-resume)."""
     import tempfile
     import time as _time
 
+    if args.fleet:
+        return cmd_fleet_soak(args)
     t0 = _time.monotonic()
     with tempfile.TemporaryDirectory(prefix="ko-chaos-") as base:
         report = _chaos_soak_once(args, os.path.join(base, "pass1"))
@@ -1299,6 +1721,55 @@ def build_parser() -> argparse.ArgumentParser:
                          help="emit the raw span tree instead of the "
                               "waterfall")
 
+    fleet_p = sub.add_parser(
+        "fleet",
+        help="fleet-wide wave-based rolling upgrades with canary gates "
+             "and circuit-broken auto-rollback (docs/resilience.md)")
+    fsub = fleet_p.add_subparsers(dest="fleet_cmd", required=True)
+    f_up = fsub.add_parser(
+        "upgrade",
+        help="roll the matching clusters to --target: canaries first, "
+             "waves gated on the watchdog health probes, the in-flight "
+             "wave auto-rolled-back when the failure budget trips")
+    f_up.add_argument("--target", required=True,
+                      help="target k8s version (one minor hop per cluster)")
+    f_up.add_argument("--selector", action="append", metavar="key=value",
+                      help="cluster filter: name=<glob>, project=, plan=, "
+                           "version=; repeatable (AND)")
+    f_up.add_argument("--wave-size", type=int, default=None,
+                      help="clusters per wave (default: fleet.wave_size)")
+    f_up.add_argument("--max-unavailable", type=int, default=None,
+                      help="failed clusters tolerated before the fleet "
+                           "breaker opens (default: fleet.max_unavailable)")
+    f_up.add_argument("--canary", type=int, default=None,
+                      help="clusters upgraded and gated before any wave "
+                           "(default: fleet.canary)")
+    f_up.add_argument("--no-wait", action="store_true")
+    f_up.add_argument("--json", action="store_true",
+                      help="with --no-wait: emit the accepted op as JSON")
+    f_up.add_argument("--timeout", type=float, default=7200.0)
+    f_status = fsub.add_parser(
+        "status", help="rollout state: waves, completed/failed/rolled-back "
+                       "clusters, breaker (exit 1 if any listed op Failed)")
+    f_status.add_argument("op", nargs="?", default="",
+                          help="fleet op id (or unique prefix); "
+                               "default: list all")
+    f_status.add_argument("--json", action="store_true")
+    for verb, help_text in (
+            ("pause", "park the rollout at the next cluster boundary"),
+            ("resume", "re-enter a Paused/Interrupted rollout "
+                       "(completed clusters are not re-run)"),
+            ("abort", "stop the rollout and close its op Failed")):
+        f_verb = fsub.add_parser(verb, help=help_text)
+        f_verb.add_argument("op", nargs="?", default="",
+                            help="fleet op id; default: the newest")
+    f_trace = fsub.add_parser(
+        "trace", help="the rollout's single stitched span tree "
+                      "(fleet -> wave -> cluster op -> phase ...)")
+    f_trace.add_argument("op", nargs="?", default="",
+                         help="fleet op id; default: the newest")
+    f_trace.add_argument("--json", action="store_true")
+
     watchdog_p = sub.add_parser(
         "watchdog", help="auto-remediation circuit breaker verbs")
     wsub = watchdog_p.add_subparsers(dest="watchdog_cmd", required=True)
@@ -1427,6 +1898,13 @@ def build_parser() -> argparse.ArgumentParser:
                         help="operator-level retry() rounds per deploy")
     soak_p.add_argument("--verify-determinism", action="store_true",
                         help="run the soak twice and diff the traces")
+    soak_p.add_argument("--fleet", action="store_true",
+                        help="run the fleet-scale drill instead: canary-"
+                             "block, mid-wave rollback and controller-"
+                             "death resume over a simulated fleet, each "
+                             "asserted from the journal + span tree")
+    soak_p.add_argument("--clusters", type=int, default=21,
+                        help="fleet size for --fleet (floored at 9)")
     soak_p.add_argument("--format", choices=["text", "json"], default="text")
 
     audit_p = sub.add_parser("audit", help="operation audit trail "
@@ -1527,6 +2005,8 @@ def main(argv: list[str] | None = None) -> int:
         return cmd_apply(client, args)
     if args.cmd == "watchdog":
         return cmd_watchdog(client, args)
+    if args.cmd == "fleet":
+        return cmd_fleet(client, args)
     if args.cmd == "backup-account":
         if args.ba_cmd == "list":
             _print(client.call("GET", "/api/v1/backup-accounts"))

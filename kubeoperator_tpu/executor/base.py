@@ -286,11 +286,21 @@ class Executor(abc.ABC):
 
     # ---- public contract (kobe parity) ----
     def run(self, spec: TaskSpec, task_id: str | None = None) -> str:
-        """Submit a task. `task_id` is an optional caller-chosen idempotency
-        key (the gRPC client sends one): resubmitting an id that is already
-        registered returns it WITHOUT launching again, which makes
-        Run-with-retry safe across a runner restart — a delivered-but-
-        unacknowledged Run cannot double-launch a playbook."""
+        """Submit a task. `task_id` is an optional caller-chosen dedup key
+        (the gRPC client sends one): resubmitting an id that is already
+        registered returns it WITHOUT launching again, which makes a
+        retried Run safe against a LOST RESPONSE on a live runner — the
+        delivered-but-unacknowledged task is found in the registry instead
+        of launching twice.
+
+        That is the WHOLE guarantee. The registry is in-memory and bounded
+        (`max_retained`, oldest-first eviction), so a runner restart — or
+        eviction of a long-retained id — forgets the task, and a resend
+        after either launches the playbook AGAIN. Durable exactly-once is
+        a non-goal here; callers that need replay safety across process
+        death fence at a higher layer (the operation journal's resume path
+        re-enters at the first pending condition rather than replaying
+        delivered runs)."""
         spec.validate()
         task_id = task_id or new_id()
         state = _TaskState(task_id)

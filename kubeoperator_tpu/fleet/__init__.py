@@ -1,0 +1,43 @@
+"""Fleet orchestration — wave-based rolling upgrades over many clusters.
+
+The per-cluster layers (journal, retries, watchdog probes, span trees) stop
+at one cluster's boundary; a real TPU operator upgrades hundreds. This
+package is the engine that fans a single rollout over the fleet while
+reusing every one of those primitives instead of re-inventing them:
+
+  * planner.py  — selector → eligible clusters → canary wave + N-sized
+                  waves (pure functions, unit-testable wave math)
+  * gates.py    — the post-upgrade health gate: the PR-3 watchdog probes
+                  (tpu-chips included) evaluated after a cluster's upgrade
+                  settles, plus the cluster's watchdog circuit state
+  * engine.py   — the wave scheduler: canaries first, promotion gated per
+                  wave, per-cluster child ops journaled under the fleet
+                  op's trace, pause/abort at cluster boundaries, and the
+                  failure-budget breaker (resilience/fleet.py) that trips
+                  mid-wave
+  * rollback.py — re-journal the tripped wave's upgraded clusters as
+                  `rollback` child ops back to their recorded versions
+
+The fleet op itself is a journal row (resilience/journal.py open_fleet):
+a controller killed mid-rollout leaves an open fleet op whose `vars` carry
+the full resumable state — the boot reconciler sweeps it to Interrupted and
+`koctl fleet resume` re-enters without re-running completed clusters.
+"""
+
+from kubeoperator_tpu.fleet.engine import FLEET_UPGRADE_KIND, FleetEngine
+from kubeoperator_tpu.fleet.gates import GateResult, evaluate_gate
+from kubeoperator_tpu.fleet.planner import (
+    SELECTOR_KEYS,
+    eligible_clusters,
+    optional_int,
+    parse_selector,
+    plan_waves,
+    upgrade_kwargs,
+    validate_selector,
+)
+from kubeoperator_tpu.fleet.rollback import rollback_wave
+
+__all__ = ["FLEET_UPGRADE_KIND", "FleetEngine", "GateResult",
+           "evaluate_gate", "SELECTOR_KEYS", "eligible_clusters",
+           "optional_int", "parse_selector", "plan_waves",
+           "rollback_wave", "upgrade_kwargs", "validate_selector"]

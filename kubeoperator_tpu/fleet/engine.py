@@ -1,0 +1,312 @@
+"""The fleet wave scheduler: one engine run drives ONE fleet operation.
+
+Execution order is strictly deterministic — waves in planner order,
+clusters inside a wave in sorted-name order, upgrades and gates serial —
+because the seeded chaos drill (`koctl chaos-soak --fleet`) replays a
+rollout against an injection sequence and must meet the same faults at
+the same steps every run.
+
+State discipline: everything the engine learns lands in the fleet op's
+`vars` (completed / failed / rolled_back / per-wave `upgraded` lists, the
+breaker state dict) and is SAVED at every cluster boundary, so the row is
+always a resume point. A `ControllerDeath` (BaseException) mid-cluster
+tears straight through — open fleet op + open child op + Running spans
+are exactly the crash evidence the boot reconciler sweeps; the resumed
+engine re-enters at the first cluster not yet recorded as done.
+
+Trace shape (one tree per rollout, `koctl fleet trace`):
+
+    operation fleet-upgrade          (root; span id == fleet op id)
+      └── phase wave-N               (one per wave the engine entered)
+            └── operation upgrade    (child op root, journal.open stitched)
+                  └── phase ...      (the ordinary per-cluster tree)
+            └── operation rollback   (when the breaker tripped the wave)
+"""
+
+from __future__ import annotations
+
+import time
+
+from kubeoperator_tpu.fleet.gates import evaluate_gate
+from kubeoperator_tpu.fleet.rollback import rollback_wave
+from kubeoperator_tpu.models.span import SpanKind, SpanStatus
+from kubeoperator_tpu.observability import trace_context
+from kubeoperator_tpu.resilience.fleet import fleet_breaker, note_unavailable
+from kubeoperator_tpu.utils.errors import KoError
+from kubeoperator_tpu.utils.logging import get_logger
+
+log = get_logger("fleet.engine")
+
+FLEET_UPGRADE_KIND = "fleet-upgrade"
+
+# wave outcomes: pending waves re-run on resume, everything else is settled
+WAVE_PENDING = "pending"
+WAVE_PROMOTED = "promoted"
+WAVE_CANARY_BLOCKED = "canary-blocked"
+WAVE_ROLLED_BACK = "rolled-back"
+WAVE_FAILED = "failed"          # budget tripped, auto_rollback off
+WAVE_ABORTED = "aborted"
+_SETTLED = frozenset({WAVE_PROMOTED, WAVE_CANARY_BLOCKED,
+                      WAVE_ROLLED_BACK, WAVE_FAILED, WAVE_ABORTED})
+
+# engine-run outcomes for waves that did NOT settle this run
+_PARKED_PAUSE = "paused"
+
+
+class FleetEngine:
+    """Drives one fleet op to a terminal (or parked) state. Constructed per
+    run by FleetService; `pause_event`/`abort_event` are the in-process
+    operator signals, observed at cluster boundaries only — a cluster
+    upgrade is never interrupted halfway by an operator verb."""
+
+    def __init__(self, services, op, pause_event, abort_event,
+                 now=time.time) -> None:
+        self.s = services
+        self.op = op
+        self.journal = services.journal
+        self.pause_event = pause_event
+        self.abort_event = abort_event
+        self.now = now
+
+    # ---- persistence helpers ----
+    def _save(self) -> None:
+        self.s.repos.operations.save(self.op)
+
+    def _close(self, ok: bool, message: str) -> None:
+        self.journal.close(self.op, ok=ok, message=message)
+
+    def _park_paused(self, wave_index: int) -> None:
+        from kubeoperator_tpu.models import OperationStatus
+
+        self.pause_event.clear()
+        self.op.status = OperationStatus.PAUSED.value
+        self.op.message = (f"paused by operator during wave {wave_index}; "
+                           f"`koctl fleet resume` continues")
+        self._save()
+        # land buffered span ends NOW: a clean pause that loses its wave
+        # span's end to a process exit would read as live work on a
+        # parked rollout — and resume's stale-span sweep would then
+        # relabel the operator's pause as a crash
+        self.journal.tracer_for(self.op).flush()
+        log.info("fleet op %s paused at wave %d", self.op.id, wave_index)
+
+    # ---- main loop ----
+    def run(self, wait: bool = False) -> None:
+        """Run every pending wave. With `wait`, unexpected engine errors
+        re-raise after the op is closed (the synchronous caller wants the
+        traceback); thread callers get an honestly-Failed op either way."""
+        op = self.op
+        v = op.vars
+        tracer = self.journal.tracer_for(op)
+        try:
+            for wave in v["waves"]:
+                if wave["outcome"] in _SETTLED:
+                    continue
+                v["current_wave"] = wave["index"]
+                if self.abort_event.is_set():
+                    self._settle_abort()
+                    return
+                if self.pause_event.is_set():
+                    self._park_paused(wave["index"])
+                    return
+                self.journal.progress(op, f"wave-{wave['index']}", "Running")
+                wave_span = tracer.start_span(
+                    f"wave-{wave['index']}", SpanKind.WAVE,
+                    parent_id=tracer.root_id,
+                    attrs={"canary": bool(wave["canary"]),
+                           "clusters": len(wave["clusters"])},
+                )
+                outcome = self._run_wave(wave, wave_span, tracer)
+                tracer.end_span(
+                    wave_span,
+                    SpanStatus.OK if outcome in (WAVE_PROMOTED, _PARKED_PAUSE)
+                    else SpanStatus.FAILED,
+                    {"outcome": outcome},
+                )
+                if outcome == _PARKED_PAUSE:
+                    self._park_paused(wave["index"])
+                    return
+                wave["outcome"] = outcome
+                self.journal.progress(
+                    op, f"wave-{wave['index']}",
+                    "OK" if outcome == WAVE_PROMOTED else "Failed")
+                if outcome == WAVE_ABORTED:
+                    self._settle_abort()
+                    return
+                if outcome == WAVE_CANARY_BLOCKED:
+                    self._close(False, self._blocked_message())
+                    return
+                if outcome in (WAVE_ROLLED_BACK, WAVE_FAILED):
+                    reason = v["breaker"].get("opened_reason", "")
+                    self._close(False, (
+                        f"fleet breaker open — wave {wave['index']} "
+                        + ("rolled back" if outcome == WAVE_ROLLED_BACK
+                           else "left Failed (auto_rollback off)")
+                        + (f": {reason}" if reason else "")))
+                    return
+            done = len(v["completed"])
+            self._close(
+                ok=not v["failed"],
+                message=f"{done}/{len(v['clusters'])} clusters upgraded to "
+                        f"{v['target_version']}"
+                        + (f"; {len(v['failed'])} failed within budget"
+                           if v["failed"] else ""))
+        except KoError as e:
+            self._close(False, f"fleet engine halted: {e.message}")
+            if wait:
+                raise
+        except Exception as e:
+            # engine bug / repo outage — never a silent open op. A
+            # ControllerDeath is a BaseException and deliberately skips
+            # this: the open op IS the crash record.
+            log.exception("fleet op %s: engine error", op.id)
+            self._close(False, f"fleet engine error: {e}")
+            if wait:
+                raise
+
+    # ---- one wave ----
+    def _run_wave(self, wave: dict, wave_span, tracer) -> str:
+        v = self.op.vars
+        target = v["target_version"]
+        breaker = fleet_breaker(v["max_unavailable"], v["breaker"])
+        v["breaker"] = breaker.state
+        wave.setdefault("upgraded", [])
+        # resume edges: a crash can land AFTER a wave reached its verdict
+        # (canary failed / breaker tripped mid-rollback) but BEFORE the op
+        # closed — the wave is still `pending` then, and re-entering it
+        # must finish settling that verdict, never roll forward under an
+        # open breaker or past a failed canary
+        if wave["canary"] and any(n in v["failed"]
+                                  for n in wave["clusters"]):
+            return WAVE_CANARY_BLOCKED
+        if breaker.state["state"] == "open":
+            return self._trip_wave(wave, wave_span, tracer)
+        for name in wave["clusters"]:
+            if name in v["completed"] or name in v["failed"] \
+                    or name in v["rolled_back"]:
+                continue
+            if self.abort_event.is_set():
+                return WAVE_ABORTED
+            if self.pause_event.is_set():
+                return _PARKED_PAUSE
+            ok, why = self._upgrade_one(name, wave, wave_span, tracer)
+            if ok and v["gate_health"]:
+                ok, why = self._gate_one(name)
+            if ok:
+                v["completed"].append(name)
+                self._save()
+                continue
+            v["failed"][name] = why
+            tripped = note_unavailable(breaker, self.now(), name, why)
+            self._save()
+            self._emit(name, "Warning", "FleetClusterUnavailable",
+                       f"fleet upgrade to {target}: {name} unavailable "
+                       f"({why})")
+            if wave["canary"]:
+                # canaries are the blast radius the operator chose —
+                # promotion is blocked on the FIRST canary failure,
+                # whatever the budget says
+                return WAVE_CANARY_BLOCKED
+            if tripped:
+                return self._trip_wave(wave, wave_span, tracer)
+        return WAVE_PROMOTED
+
+    def _upgrade_one(self, name: str, wave: dict, wave_span,
+                     tracer) -> tuple[bool, str]:
+        v = self.op.vars
+        target = v["target_version"]
+        try:
+            # the get sits INSIDE the try: a cluster deleted mid-rollout
+            # is an unavailable cluster for the budget to judge, not an
+            # engine halt that bypasses breaker and rollback
+            cluster = self.s.clusters.get(name)
+            if cluster.spec.k8s_version == target:
+                # resume edge: the controller died after this upgrade
+                # landed but before `completed` was saved — done is done,
+                # re-gate only
+                if name not in wave["upgraded"]:
+                    wave["upgraded"].append(name)
+                return True, ""
+            self.s.upgrades.upgrade(
+                name, target, links=self._links(wave_span, tracer))
+            wave["upgraded"].append(name)
+            self._save()
+            return True, ""
+        except KoError as e:
+            return False, f"upgrade failed: {e.message}"
+        except Exception as e:
+            return False, f"upgrade failed: {e}"
+
+    def _gate_one(self, name: str) -> tuple[bool, str]:
+        try:
+            cluster = self.s.clusters.get(name)
+        except KoError as e:
+            # deleted between upgrade and gate: unavailable, not a halt
+            return False, f"health gate failed: {e.message}"
+        gate = evaluate_gate(self.s.health, self.s.watchdog, name,
+                             cluster.id)
+        self.op.vars.setdefault("gates", {})[name] = gate.to_dict()
+        if gate.ok:
+            return True, ""
+        return False, (f"health gate failed "
+                       f"({', '.join(gate.failed_probes)}): {gate.detail}")
+
+    def _trip_wave(self, wave: dict, wave_span, tracer) -> str:
+        """The breaker just opened: undo this wave (when auto_rollback is
+        on) and stop the rollout."""
+        v = self.op.vars
+        if not v["auto_rollback"]:
+            return WAVE_FAILED
+        names = [n for n in wave["upgraded"] if n not in v["rolled_back"]]
+        results = rollback_wave(
+            self.s.upgrades, names, v["original_versions"],
+            links_for=lambda _name: self._links(wave_span, tracer))
+        for r in results:
+            name = r["cluster"]
+            if r["ok"]:
+                v["rolled_back"].append(name)
+                if name in v["completed"]:
+                    v["completed"].remove(name)
+                self._emit(name, "Warning", "FleetWaveRolledBack",
+                           f"fleet breaker open: {name} rolled back to "
+                           f"{r['version']}")
+            else:
+                v["failed"][name] = (f"rollback to {r['version']} failed: "
+                                     f"{r['message']}")
+        self._save()
+        return WAVE_ROLLED_BACK
+
+    # ---- bits ----
+    def _links(self, wave_span, tracer) -> dict:
+        links: dict = {"parent_op_id": self.op.id}
+        if tracer.enabled:
+            links["trace"] = trace_context(self.op.trace_id, wave_span.id)
+        return links
+
+    def _settle_abort(self) -> None:
+        """Abort settles EVERY wave that has not run: `pending` means
+        'will run on resume', and an aborted op never resumes — leaving
+        later waves pending would read as live work on a closed op (and
+        the service-side stale-abort path already marks them all)."""
+        self.abort_event.clear()
+        for wave in self.op.vars["waves"]:
+            if wave.get("outcome", WAVE_PENDING) == WAVE_PENDING:
+                wave["outcome"] = WAVE_ABORTED
+        self._close(False, "aborted by operator")
+
+    def _blocked_message(self) -> str:
+        v = self.op.vars
+        failed = ", ".join(f"{n} ({why})" for n, why in v["failed"].items())
+        return (f"canary gate blocked promotion to "
+                f"{v['target_version']}: {failed}"[:500])
+
+    def _emit(self, cluster_name: str, etype: str, reason: str,
+              message: str) -> None:
+        """Cluster-scoped event, best-effort (the cluster may be mid-flip
+        or even deleted; fleet bookkeeping never fails on an event)."""
+        try:
+            cluster = self.s.clusters.get(cluster_name)
+            self.s.events.emit(cluster.id, etype, reason, message)
+        except Exception:
+            log.warning("fleet event %s for %s not recorded",
+                        reason, cluster_name)

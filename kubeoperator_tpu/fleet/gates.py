@@ -1,0 +1,71 @@
+"""The post-upgrade health gate a wave's promotion rides on.
+
+"Upgrade succeeded" (rc 0 + verify attestation) and "cluster healthy" are
+different facts: the upgrade can verify green while the device plugin lost
+its chips to a preemption that landed mid-rollout. So after each cluster's
+upgrade settles the gate re-runs the PR-3 watchdog probes — apiserver,
+node set, etcd, and for TPU clusters the device plugin + the
+allocatable-chips-vs-plan-topology probe — through `HealthService.check`,
+and additionally refuses clusters whose watchdog circuit is open (a
+cluster the watchdog already gave up remediating is not a cluster to
+promote a rollout on).
+
+A gate that cannot probe is a FAILED gate, never a pass: an unreachable
+fleet is exactly the condition a rollout must stop on.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from kubeoperator_tpu.resilience.watchdog import CIRCUIT_OPEN
+from kubeoperator_tpu.utils.logging import get_logger
+
+log = get_logger("fleet.gates")
+
+
+@dataclass
+class GateResult:
+    cluster: str
+    ok: bool
+    failed_probes: list = field(default_factory=list)
+    detail: str = ""
+
+    def to_dict(self) -> dict:
+        return {"cluster": self.cluster, "ok": self.ok,
+                "failed_probes": list(self.failed_probes),
+                "detail": self.detail}
+
+
+def evaluate_gate(health, watchdog, cluster_name: str,
+                  cluster_id: str) -> GateResult:
+    """One gate evaluation. `health`/`watchdog` are the container's
+    services; the watchdog circuit check comes first because it needs no
+    probes at all."""
+    try:
+        if watchdog is not None and \
+                watchdog.circuit_state(cluster_id) == CIRCUIT_OPEN:
+            return GateResult(
+                cluster=cluster_name, ok=False,
+                failed_probes=["watchdog-circuit"],
+                detail="watchdog circuit open — remediation already "
+                       "escalated to an operator",
+            )
+        report = health.check(cluster_name)
+    except Exception as e:
+        # probes raised (inventory unreachable, executor outage): record
+        # the WHY, fail the gate — an unprobeable cluster is not healthy
+        log.warning("fleet gate: health check of %s raised: %s",
+                    cluster_name, e)
+        return GateResult(cluster=cluster_name, ok=False,
+                          failed_probes=["health-check"], detail=str(e))
+    failed = [p for p in report.probes if not p.ok]
+    if failed:
+        return GateResult(
+            cluster=cluster_name, ok=False,
+            failed_probes=[p.name for p in failed],
+            detail="; ".join(
+                f"{p.name}" + (f": {p.detail}" if p.detail else "")
+                for p in failed)[:500],
+        )
+    return GateResult(cluster=cluster_name, ok=True)

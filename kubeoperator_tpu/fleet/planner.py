@@ -1,0 +1,167 @@
+"""Fleet rollout planning: selector → eligible clusters → canary + waves.
+
+Pure functions over repository data — no threads, no journal writes — so
+the wave math (`tests/test_fleet.py`) pins exact splits without a stack.
+Cluster order is ALWAYS sorted-by-name: the canary set, wave membership
+and upgrade order inside a wave must be deterministic for a given fleet,
+or the seeded chaos drill could never reproduce a rollout.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+
+from kubeoperator_tpu.utils.errors import ValidationError
+
+# `--selector key=value` keys `koctl fleet upgrade` accepts; `name` is an
+# fnmatch glob, the rest are exact matches
+SELECTOR_KEYS = ("name", "project", "plan", "version")
+
+
+def parse_selector(pairs: list[str] | None) -> dict:
+    """key=value pairs → selector dict; unknown keys and bare words die
+    here with the key named, not as a silently-empty fleet."""
+    selector: dict = {}
+    for pair in pairs or []:
+        key, sep, value = str(pair).partition("=")
+        if not sep or not value:
+            raise ValidationError(
+                f"selector needs key=value, got {pair!r}")
+        selector[key] = value
+    return validate_selector(selector)
+
+
+def validate_selector(selector: dict) -> dict:
+    """Reject unknown selector keys LOUDLY. `_matches` ignores keys it
+    doesn't know, so without this gate a typo'd key (`nme=prod-*`) would
+    filter nothing and the rollout would fan out over the ENTIRE fleet —
+    the one mistake a fleet verb must never let through. Every selector
+    entry path (CLI pairs, REST body, direct service calls) runs this."""
+    for key, value in selector.items():
+        if key not in SELECTOR_KEYS:
+            raise ValidationError(
+                f"unknown selector key {key!r} "
+                f"(one of {', '.join(SELECTOR_KEYS)})")
+        # a REST body can carry any JSON type here; fnmatch over a
+        # non-string pattern is a TypeError (500), not the 400 every
+        # other malformed field answers
+        if not isinstance(value, str) or not value:
+            raise ValidationError(
+                f"selector {key!r} needs a non-empty string value, "
+                f"got {value!r}")
+    return selector
+
+
+def optional_int(key: str, value) -> int | None:
+    """Coerce an optional rollout knob from a transport body (REST JSON or
+    the local dispatch): None passes through, bools and non-integral
+    floats are malformed input — int() would silently truncate 1.9 to a
+    TIGHTER budget than the caller sent. One implementation for both
+    transports (KO-X010 parity is behavioral, not just route-shaped)."""
+    if value is None:
+        return None
+    if isinstance(value, bool) or (
+            isinstance(value, float) and not value.is_integer()):
+        raise ValidationError(f"{key} must be an integer")
+    try:
+        return int(value)
+    except (TypeError, ValueError):
+        raise ValidationError(f"{key} must be an integer")
+
+
+def upgrade_kwargs(body: dict) -> dict:
+    """The body→`FleetService.upgrade` translation BOTH transports share
+    (REST handler and `LocalClient._dispatch`): a rollout knob added to
+    one place reaches both, which is the behavioral half of the KO-X010
+    parity contract."""
+    selector = body.get("selector") or {}
+    if not isinstance(selector, dict):
+        raise ValidationError("selector must be an object")
+    return {
+        "selector": selector,
+        "wave_size": optional_int("wave_size", body.get("wave_size")),
+        "max_unavailable": optional_int(
+            "max_unavailable", body.get("max_unavailable")),
+        "canary": optional_int("canary", body.get("canary")),
+    }
+
+
+def validate_rollout(wave_size: int, max_unavailable: int,
+                     canary: int) -> None:
+    if wave_size < 1:
+        raise ValidationError("wave-size must be >= 1")
+    if max_unavailable < 0:
+        raise ValidationError("max-unavailable must be >= 0")
+    if canary < 0:
+        raise ValidationError("canary must be >= 0")
+
+
+def _matches(cluster, selector: dict, plan_names: dict,
+             project_names: dict) -> bool:
+    if "name" in selector and \
+            not fnmatch.fnmatchcase(cluster.name, selector["name"]):
+        return False
+    if "project" in selector and \
+            project_names.get(cluster.project_id, "") != selector["project"]:
+        return False
+    if "plan" in selector and \
+            plan_names.get(cluster.plan_id, "") != selector["plan"]:
+        return False
+    if "version" in selector and \
+            cluster.spec.k8s_version != selector["version"]:
+        return False
+    return True
+
+
+def eligible_clusters(repos, selector: dict, target_version: str,
+                      hop_check) -> tuple[list, list]:
+    """(eligible cluster names sorted, skipped [(name, reason)]).
+
+    Eligible = managed, Ready, selector-matched, not already at the target,
+    and a legal upgrade hop away (`hop_check(current, target)` returns a
+    skip reason or None — the UpgradeService's one-minor-hop gate, injected
+    so this module never imports the service layer)."""
+    plan_names = {p.id: p.name for p in repos.plans.list()}
+    project_names = {p.id: p.name for p in repos.projects.list()}
+    eligible: list[str] = []
+    skipped: list[tuple[str, str]] = []
+    for cluster in sorted(repos.clusters.list(), key=lambda c: c.name):
+        if not _matches(cluster, selector, plan_names, project_names):
+            continue   # outside the selector: not part of this fleet at all
+        if cluster.provision_mode == "imported":
+            skipped.append((cluster.name, "imported (not managed)"))
+            continue
+        if cluster.status.phase != "Ready":
+            skipped.append(
+                (cluster.name, f"phase {cluster.status.phase} (not Ready)"))
+            continue
+        if cluster.spec.k8s_version == target_version:
+            skipped.append((cluster.name, f"already at {target_version}"))
+            continue
+        reason = hop_check(cluster.spec.k8s_version, target_version)
+        if reason:
+            skipped.append((cluster.name, reason))
+            continue
+        eligible.append(cluster.name)
+    return eligible, skipped
+
+
+def plan_waves(names: list[str], wave_size: int, canary: int) -> list[dict]:
+    """Split an ordered cluster list into the rollout's waves:
+    `[{index, canary, clusters}]` — the canary wave (first `canary`
+    clusters) leads when canary > 0, then chunks of `wave_size`. A canary
+    count >= the fleet simply makes the whole fleet the canary wave."""
+    validate_rollout(wave_size, 0, canary)
+    waves: list[dict] = []
+    head = min(canary, len(names))
+    if head:
+        waves.append({"index": 0, "canary": True,
+                      "clusters": list(names[:head])})
+    rest = list(names[head:])
+    for i in range(0, len(rest), wave_size):
+        waves.append({
+            "index": len(waves),
+            "canary": False,
+            "clusters": rest[i:i + wave_size],
+        })
+    return waves

@@ -1,0 +1,53 @@
+"""Wave rollback: undo the tripped wave's upgrades, journaled honestly.
+
+When the fleet breaker opens mid-wave, every cluster this wave already
+upgraded (gate-passed or not — the wave is the atomic promotion unit) goes
+back to the version the planner recorded for it before the rollout
+touched anything. Each rollback is a real journaled child operation
+(kind `rollback`, linked to the fleet op and stitched into its trace) run
+through the same adm upgrade phases — including the verify attestation
+against the ROLLBACK target — so "we rolled back" is a provable statement
+about cluster state, not a status-field flip.
+
+A rollback that itself fails leaves the cluster Failed with its journal
+row telling the story; the fleet op's report carries the per-cluster
+outcome either way. Nothing here raises past the engine — a half-finished
+rollback sweep must still close the fleet op honestly.
+"""
+
+from __future__ import annotations
+
+from kubeoperator_tpu.utils.errors import KoError
+from kubeoperator_tpu.utils.logging import get_logger
+
+log = get_logger("fleet.rollback")
+
+
+def rollback_wave(upgrades, names: list[str], original_versions: dict,
+                  links_for) -> list[dict]:
+    """Roll `names` back to their recorded versions via
+    `UpgradeService.rollback`. `links_for(cluster_name)` supplies the
+    journal/trace linkage dict for each child op. Returns one result row
+    per cluster: {cluster, ok, version, message}."""
+    results: list[dict] = []
+    for name in names:
+        version = original_versions.get(name, "")
+        if not version:
+            results.append({"cluster": name, "ok": False, "version": "",
+                            "message": "no recorded pre-rollout version"})
+            continue
+        try:
+            upgrades.rollback(name, version, links=links_for(name))
+            results.append({"cluster": name, "ok": True,
+                            "version": version, "message": ""})
+        except KoError as e:
+            log.warning("fleet rollback of %s to %s failed: %s",
+                        name, version, e.message)
+            results.append({"cluster": name, "ok": False,
+                            "version": version, "message": e.message})
+        except Exception as e:
+            log.warning("fleet rollback of %s to %s failed: %s",
+                        name, version, e)
+            results.append({"cluster": name, "ok": False,
+                            "version": version, "message": str(e)})
+    return results

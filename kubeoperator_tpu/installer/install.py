@@ -31,14 +31,23 @@ COMPOSE_TEMPLATE = {
                 "KO_TPU_EXECUTOR__BACKEND": "grpc",
                 "KO_TPU_EXECUTOR__RUNNER_ADDRESS": "ko-runner:8790",
             },
-            # /healthz answers 503 when the state store is dead — compose
-            # restarts a server that cannot read state
+            # SELF-only healthcheck: /healthz's overall status also turns
+            # 503 when ko-runner is unreachable (`executor_ok: false`),
+            # and compose restarting ko-server for a fault in a DIFFERENT
+            # container fixes nothing — so the check reads the body's `db`
+            # field (this container's own state store) and leaves runner
+            # outages to the KoRunnerUnreachable alert on
+            # ko_tpu_executor_up (observability profile)
             "healthcheck": {
                 "test": ["CMD-SHELL",
-                         "python3 -c \"import urllib.request,sys; "
-                         "sys.exit(0 if urllib.request.urlopen("
-                         "'http://127.0.0.1:8080/healthz', timeout=4)"
-                         ".status == 200 else 1)\""],
+                         "python3 -c \"import json,sys,urllib.request,"
+                         "urllib.error\n"
+                         "try:\n"
+                         "    r = urllib.request.urlopen("
+                         "'http://127.0.0.1:8080/healthz', timeout=4)\n"
+                         "except urllib.error.HTTPError as e:\n"
+                         "    r = e\n"
+                         "sys.exit(0 if json.load(r).get('db') else 1)\""],
                 "interval": "30s", "timeout": "5s", "retries": 3,
             },
             "depends_on": ["ko-runner", "ko-registry"],

@@ -21,9 +21,17 @@ import os
 
 import yaml
 
+from kubeoperator_tpu.utils.logging import get_logger
+
+log = get_logger("installer.observability")
+
+# where the compose bundle mounts the rendered alert rules inside the
+# prometheus container (installer/install.py volumes)
+ALERTS_MOUNT = "/etc/prometheus/ko-tpu-alerts.yml"
+
 PROMETHEUS_CONFIG = {
     "global": {"scrape_interval": "15s", "evaluation_interval": "15s"},
-    "rule_files": ["/etc/prometheus/ko-tpu-alerts.yml"],
+    "rule_files": [ALERTS_MOUNT],
     "scrape_configs": [
         {
             "job_name": "ko-server",
@@ -246,19 +254,7 @@ def write_observability(data_dir: str) -> dict:
     # Migration for PRESERVED configs: a prometheus.yml from a pre-alerts
     # install keeps every operator edit but never loaded rules — the
     # rendered-and-mounted alerts file would be silently inactive forever.
-    # Add ONLY the missing rule_files entry; touch nothing else.
-    try:
-        with open(paths["prometheus"], encoding="utf-8") as f:
-            existing = yaml.safe_load(f) or {}
-        rule_files = existing.get("rule_files") or []
-        if "/etc/prometheus/ko-tpu-alerts.yml" not in rule_files:
-            existing["rule_files"] = rule_files + [
-                "/etc/prometheus/ko-tpu-alerts.yml"]
-            with open(paths["prometheus"], "w", encoding="utf-8") as f:
-                yaml.safe_dump(existing, f, sort_keys=False)
-    except yaml.YAMLError:
-        # an operator config we cannot parse is not ours to rewrite
-        pass
+    _ensure_rule_files(paths["prometheus"])
     _write(paths["datasource"],
            lambda f: yaml.safe_dump(DATASOURCE_CONFIG, f, sort_keys=False))
     _write(paths["provider"],
@@ -266,3 +262,51 @@ def write_observability(data_dir: str) -> dict:
     _write(paths["dashboard"],
            lambda f: json.dump(PLATFORM_DASHBOARD, f, indent=2))
     return paths
+
+
+def _ensure_rule_files(path: str) -> None:
+    """Add the missing `rule_files` entry to a preserved prometheus.yml
+    with a minimal TEXT-level append — never a yaml.safe_dump round-trip,
+    which would silently drop the operator's comments and anchors (advisor
+    round 5). Only the no-`rule_files`-key-at-all case is safely editable
+    as text (a new top-level block appended at EOF); a file that already
+    has its own rule_files list is the operator's formatting to own, so
+    that case logs a warning instead of rewriting their file."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+        existing = yaml.safe_load(text) or {}
+    except (OSError, yaml.YAMLError):
+        return  # an operator config we cannot parse is not ours to rewrite
+    if not isinstance(existing, dict):
+        return
+    if ALERTS_MOUNT in (existing.get("rule_files") or []):
+        return
+    if "rule_files" in existing:
+        log.warning(
+            "prometheus.yml has a rule_files list without %s — the "
+            "rendered alert rules will not load; add the entry manually "
+            "(the installer will not rewrite an operator-edited list)",
+            ALERTS_MOUNT)
+        return
+    appended = (
+        text + ("" if text.endswith("\n") else "\n")
+        + "\n# added by ko-tpu install: load the rendered alert rules\n"
+        + f"rule_files:\n- {ALERTS_MOUNT}\n"
+    )
+    # verify the append parses back with the entry in place before
+    # committing it — e.g. a file ending inside a block scalar would
+    # swallow the new lines, and writing that would corrupt the config
+    try:
+        reparsed = yaml.safe_load(appended)
+    except yaml.YAMLError:
+        reparsed = None
+    if not isinstance(reparsed, dict) or \
+            ALERTS_MOUNT not in (reparsed.get("rule_files") or []):
+        log.warning(
+            "could not append rule_files to prometheus.yml (unexpected "
+            "layout); add %s manually so the alert rules load",
+            ALERTS_MOUNT)
+        return
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(appended)

@@ -22,6 +22,9 @@ class OperationStatus(str, Enum):
     SUCCEEDED = "Succeeded"
     FAILED = "Failed"            # closed honestly by the controller
     INTERRUPTED = "Interrupted"  # orphaned open op swept at boot
+    # fleet ops only: parked by the operator mid-rollout; resumable state
+    # (remaining waves, completed clusters) preserved in `vars`
+    PAUSED = "Paused"
 
 
 @dataclass
@@ -41,6 +44,9 @@ class Operation(Entity):
     message: str = ""
     resume_phase: str = ""       # re-entry point preserved on interruption
     vars: dict = field(default_factory=dict)   # op inputs (upgrade target...)
+    # fleet linkage (migration 007): a per-cluster op launched by a fleet
+    # rollout carries its fleet op's id; "" = a standalone operation
+    parent_op_id: str = ""
     finished_at: float = 0.0
     # observability: the span tree's trace id ("" = op predates tracing or
     # it was disabled); the root span's id is the operation id itself

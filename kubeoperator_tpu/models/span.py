@@ -24,9 +24,14 @@ from kubeoperator_tpu.models.base import Entity
 
 
 class SpanKind:
-    """The five levels of the tree, outermost first."""
+    """The five levels of the tree, outermost first. WAVE sits outside the
+    per-cluster ladder: a fleet rollout's wave spans group child-operation
+    trees under the fleet op — a distinct kind so wave wall-clock (the sum
+    of many cluster upgrades) can never leak into the adm-phase duration
+    histogram, which selects spans by kind."""
 
     OPERATION = "operation"
+    WAVE = "wave"           # fleet rollouts only: fleet op → wave → child op
     PHASE = "phase"
     ATTEMPT = "attempt"
     TASK = "task"

@@ -78,6 +78,9 @@ class NullTracer:
     def record_payload(self, span_dicts: list) -> None:
         pass
 
+    def flush(self) -> None:
+        pass
+
 
 class Tracer(NullTracer):
     """Persisting tracer bound to ONE journal operation.
@@ -191,12 +194,12 @@ class Tracer(NullTracer):
         if not self._admit(span.id):
             return
         self._buffer[span.id] = span
-        # phase STARTS (and the rare directly-produced operation span)
-        # are the durability points: starting phase N+1 lands phase N's
-        # whole subtree in the same transaction, and close() flushes the
-        # final one — one commit per phase, total
-        if span.kind in (SpanKind.OPERATION, SpanKind.PHASE) \
-                and not span.finished_at:
+        # phase/wave STARTS (and the rare directly-produced operation
+        # span) are the durability points: starting phase N+1 lands phase
+        # N's whole subtree in the same transaction, and close() flushes
+        # the final one — one commit per phase, total
+        if span.kind in (SpanKind.OPERATION, SpanKind.WAVE,
+                         SpanKind.PHASE) and not span.finished_at:
             self.flush()
 
     def note_truncation(self, root: Span) -> None:
@@ -228,9 +231,15 @@ def span_tree(spans: list) -> dict | None:
             "duration_s": round(s.duration_s, 3) if s.duration_s else None,
             "attrs": dict(s.attrs), "children": [],
         }
+    # the root is the operation span whose parent lies OUTSIDE this span
+    # set — "" for a standalone op, a fleet wave span id for a rollout's
+    # child op viewed on its own (`koctl trace <cluster>`): either way it
+    # roots its own tree here
+    ids = set(nodes)
     root_span = next(
         (s for s in spans
-         if s.kind == SpanKind.OPERATION and not s.parent_id), None)
+         if s.kind == SpanKind.OPERATION
+         and (not s.parent_id or s.parent_id not in ids)), None)
     if root_span is not None:
         root = nodes[root_span.id]
     else:

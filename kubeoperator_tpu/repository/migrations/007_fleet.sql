@@ -1,0 +1,8 @@
+-- Fleet operations (docs/resilience.md "Fleet operations"): a fleet op is
+-- itself a journal row (005_operations.sql) with an empty cluster_id; its
+-- per-cluster child operations (upgrade / rollback) link back through
+-- parent_op_id, so "which clusters did this rollout touch" is one indexed
+-- query and the boot reconciler can sweep an interrupted rollout together
+-- with its stranded child op.
+ALTER TABLE operations ADD COLUMN parent_op_id TEXT NOT NULL DEFAULT '';
+CREATE INDEX IF NOT EXISTS idx_operations_parent ON operations (parent_op_id);

@@ -325,8 +325,13 @@ class OperationRepo(EntityRepo[Operation]):
     hydrate-everything scan."""
 
     table, entity, columns = "operations", Operation, (
-        "cluster_id", "kind", "status",
+        "cluster_id", "kind", "status", "parent_op_id",
     )
+
+    def children(self, parent_op_id: str) -> list[Operation]:
+        """A fleet op's per-cluster child ops, in launch order (the
+        indexed parent link from migration 007)."""
+        return self.find(parent_op_id=parent_op_id)
 
     def history(self, cluster_id: str, limit: int = 50) -> list[Operation]:
         """Newest-first journal history, capped IN SQL (the journal grows
@@ -393,6 +398,17 @@ class SpanRepo(EntityRepo[Span]):
         )
         return [self._hydrate(r["data"]) for r in rows]
 
+    def for_trace(self, trace_id: str) -> list[Span]:
+        """Every span of one TRACE, across operations — a fleet rollout's
+        child ops share the fleet op's trace id, so this is how the whole
+        fleet → wave → cluster → phase waterfall comes back as ONE tree."""
+        rows = self.db.query(
+            f"SELECT data FROM {self.table} WHERE trace_id=? "
+            f"ORDER BY started_at, rowid",
+            (trace_id,),
+        )
+        return [self._hydrate(r["data"]) for r in rows]
+
     def duration_rows(self, kind: str) -> list[tuple]:
         """(name, duration_s, trace_id) for every FINISHED span of `kind` —
         the histogram collectors' raw material, straight off the mirrored
@@ -410,16 +426,40 @@ class SpanRepo(EntityRepo[Span]):
         """Bounded trace store: keep spans of the newest `keep` operations
         (by the operations table's own ordering) and drop the rest — the
         span tree of a two-month-old create is journal history, not a
-        debugging artifact worth its disk."""
+        debugging artifact worth its disk.
+
+        Live ops are NEVER pruned, however old: a fleet rollout over more
+        clusters than `keep` closes a child op (→ this prune) hundreds of
+        times while its own root/wave spans and earliest child subtrees
+        are the oldest rows in the store — and a resumable op's spans are
+        what `journal.reopen` re-arms. Open/parked/interrupted ops and
+        the children stitched under them are one retention unit.
+
+        The Interrupted exemption is FLEET-scope only (cluster_id = '',
+        the open_fleet marker): only fleet ops are ever reopened — a
+        per-cluster op swept to Interrupted at boot is superseded by a
+        fresh op on retry, and exempting those would let every crash
+        loop grow the span store without bound."""
         if keep < 1:
             return 0
+
+        def live(alias: str) -> str:
+            return (f"{alias}status IN ('Running', 'Paused') "
+                    f"OR ({alias}status = 'Interrupted' "
+                    f"AND {alias}cluster_id = '')")
+
         # cursor rowcount, NOT before/after COUNT(*) scans: this runs on
         # every operation close, on the operation's worker thread
         with self.db.tx() as conn:
             cur = conn.execute(
                 f"DELETE FROM {self.table} WHERE op_id NOT IN ("
                 f"SELECT id FROM operations "
-                f"ORDER BY created_at DESC, rowid DESC LIMIT ?)",
+                f"ORDER BY created_at DESC, rowid DESC LIMIT ?) "
+                f"AND op_id NOT IN ("
+                f"SELECT id FROM operations WHERE {live('')}) "
+                f"AND op_id NOT IN ("
+                f"SELECT o.id FROM operations o JOIN operations p "
+                f"ON o.parent_op_id = p.id WHERE {live('p.')})",
                 (keep,),
             )
             return max(cur.rowcount, 0)

@@ -22,6 +22,10 @@ one shared vocabulary for that:
   * CircuitBreaker     — remediation budget / cooldown / flap detection
                          bounding the health watchdog's auto-remediation
                          (watchdog.py; driven by service/watchdog.py)
+  * FleetConfig /      — the `fleet.*` rollout posture and the per-fleet-op
+    fleet_breaker        failure-budget breaker (a CircuitBreaker reuse)
+                         behind wave-based rolling upgrades (fleet.py;
+                         driven by service/fleet.py + kubeoperator_tpu/fleet/)
 
 Failure classification itself (TRANSIENT vs PERMANENT) lives in
 executor/base.py next to TaskResult, because every backend finishes tasks
@@ -49,9 +53,15 @@ from kubeoperator_tpu.resilience.watchdog import (
     CircuitBreaker,
     WatchdogConfig,
 )
+from kubeoperator_tpu.resilience.fleet import (
+    FleetConfig,
+    fleet_breaker,
+    note_unavailable,
+)
 
 __all__ = ["RetryPolicy", "retry_call", "retry_wiring",
            "ChaosConfig", "ChaosExecutor", "ControllerDeath",
            "IN_FLIGHT_PHASES", "OperationJournal", "default_journal",
            "CIRCUIT_CLOSED", "CIRCUIT_OPEN", "CircuitBreaker",
-           "WatchdogConfig"]
+           "WatchdogConfig", "FleetConfig", "fleet_breaker",
+           "note_unavailable"]

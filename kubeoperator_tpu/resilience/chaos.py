@@ -69,7 +69,10 @@ class ChaosConfig:
     max_injections: int = 0    # 0 = unbounded
     # one-shot controller-death crash point: the playbook whose SUBMISSION
     # kills the controller (cleared after firing so the rebooted stack can
-    # get past the phase it died at)
+    # get past the phase it died at). An optional `#N` suffix
+    # ("21-upgrade-masters.yml#3") defers death to the Nth submission of
+    # that playbook — how a fleet drill kills the controller mid-WAVE,
+    # after earlier clusters already ran the same phase
     die_at_phase: str = ""
 
     @classmethod
@@ -131,6 +134,9 @@ class ChaosExecutor(Executor):
         self.config = config or ChaosConfig()
         self.injections: list[Injection] = []
         self._scripted: dict[tuple, list] = {}
+        self._counters: dict[tuple, int] = {}    # submissions seen per key
+        self._scheduled: dict[tuple, dict] = {}  # key -> {abs index: kind}
+        self._death_submissions = 0   # submissions of the doomed playbook
 
     # ---- controller-death crash point ----
     def run(self, spec: TaskSpec, task_id: str | None = None) -> str:
@@ -138,16 +144,25 @@ class ChaosExecutor(Executor):
         own thread, before any task exists — matching a real crash, where
         the phase condition was already persisted Running and the journal
         op is still open. One-shot: the knob clears itself so the revived
-        controller's resume gets past this phase."""
-        if self.config.die_at_phase and \
-                spec.playbook == self.config.die_at_phase:
-            self.config.die_at_phase = ""
-            self.injections.append(Injection(
-                task_id="", playbook=spec.playbook, kind="controller-death",
-            ))
-            raise ControllerDeath(
-                f"simulated controller death submitting {spec.playbook}"
-            )
+        controller's resume gets past this phase. The optional `#N` suffix
+        counts submissions of the doomed playbook and fires on the Nth —
+        submissions 1..N-1 run normally."""
+        if self.config.die_at_phase:
+            doomed, _, nth = self.config.die_at_phase.partition("#")
+            if spec.playbook == doomed:
+                self._death_submissions += 1
+                target = int(nth) if nth.isdigit() else 1
+                if self._death_submissions >= target:
+                    self.config.die_at_phase = ""
+                    self.injections.append(Injection(
+                        task_id="", playbook=spec.playbook,
+                        kind="controller-death",
+                    ))
+                    raise ControllerDeath(
+                        f"simulated controller death submitting "
+                        f"{spec.playbook} (submission "
+                        f"{self._death_submissions})"
+                    )
         return super().run(spec, task_id)
 
     # ---- scripting (deterministic sequences for tests/recipes) ----
@@ -161,6 +176,21 @@ class ChaosExecutor(Executor):
         key = (playbook, limit)
         self._scripted.setdefault(key, []).extend([kind] * times)
 
+    def fail_at(self, playbook: str, submissions, kind: str = "unreachable",
+                limit: str = "") -> None:
+        """Schedule faults for SPECIFIC future submissions of
+        (playbook, limit): `submissions` are 1-indexed counting from now,
+        so `fail_at("adhoc:command", [6])` hits the 6th adhoc submitted
+        after this call while 1-5 run clean. The fleet drill's precision
+        tool — "fail the SECOND cluster's health gate" is unreachable with
+        a plain fail-the-next-N queue, because the first cluster's gate
+        would consume it. Like fail_times, consumes no RNG draw."""
+        key = (playbook, limit)
+        base = self._counters.get(key, 0)
+        slots = self._scheduled.setdefault(key, {})
+        for n in submissions:
+            slots[base + int(n)] = kind
+
     # ---- fault selection ----
     def _next_fault(self, spec: TaskSpec) -> tuple:
         """Returns (kind|None, frac): `frac` ∈ [0,1) is derived from the
@@ -170,6 +200,11 @@ class ChaosExecutor(Executor):
         independent of the rate mix, as the module contract promises.
         Scripted faults consume no draw and get frac 0.0."""
         key = (spec.playbook or f"adhoc:{spec.adhoc_module}", spec.limit)
+        count = self._counters.get(key, 0) + 1
+        self._counters[key] = count
+        scheduled = self._scheduled.get(key)
+        if scheduled and count in scheduled:
+            return scheduled.pop(count), 0.0
         queue = self._scripted.get(key)
         if queue:
             return queue.pop(0), 0.0

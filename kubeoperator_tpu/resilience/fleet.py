@@ -1,0 +1,93 @@
+"""Fleet rollout policy: the `fleet.*` config block and the per-fleet-op
+failure-budget breaker.
+
+A fleet upgrade (service/fleet.py, `koctl fleet upgrade`) promotes waves
+of clusters only while the fleet-wide unavailability stays inside
+`max_unavailable`. The budget state machine deliberately REUSES the
+watchdog's `CircuitBreaker` (resilience/watchdog.py) rather than growing a
+second one: a fleet op's breaker is the same JSON-plain state dict
+(persisted inside the fleet op's `vars`, so it survives controller
+restarts exactly like the watchdog's settings rows), tripped explicitly by
+the wave scheduler when unavailable clusters EXCEED the budget. An open
+circuit means the in-flight wave rolls back and the rollout halts — only a
+fresh `koctl fleet upgrade` (operator judgment, like `watchdog reset`)
+starts a new one.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from kubeoperator_tpu.resilience.watchdog import (
+    CircuitBreaker,
+    WatchdogConfig,
+    new_state,
+)
+
+# the budget never slides within one rollout: a fleet op's failure budget
+# is per-operation, not per-hour — so the breaker window is effectively
+# infinite relative to any real rollout
+BREAKER_WINDOW_S = 10 * 365 * 24 * 3600.0
+
+
+@dataclass(frozen=True)
+class FleetConfig:
+    """The `fleet.*` config block (utils/config.py DEFAULTS) — the default
+    rollout posture; `koctl fleet upgrade` flags override per operation."""
+
+    wave_size: int = 5
+    max_unavailable: int = 1
+    canary: int = 1
+    gate_health: bool = True
+    auto_rollback: bool = True
+
+    @classmethod
+    def from_config(cls, config, section: str = "fleet") -> "FleetConfig":
+        base = cls()
+        return cls(
+            wave_size=int(config.get(
+                f"{section}.wave_size", base.wave_size)),
+            max_unavailable=int(config.get(
+                f"{section}.max_unavailable", base.max_unavailable)),
+            canary=int(config.get(f"{section}.canary", base.canary)),
+            gate_health=bool(config.get(
+                f"{section}.gate_health", base.gate_health)),
+            auto_rollback=bool(config.get(
+                f"{section}.auto_rollback", base.auto_rollback)),
+        )
+
+
+def fleet_breaker(max_unavailable: int, state: dict | None = None
+                  ) -> CircuitBreaker:
+    """The per-fleet-op breaker over a (possibly persisted) state dict.
+    `remediation_budget` doubles as the unavailability budget so
+    `budget_left()` keeps meaning "failures still tolerated"; the wave
+    scheduler records each unavailable cluster and trips explicitly via
+    `note_unavailable` — never through admit()'s remediation semantics."""
+    cfg = WatchdogConfig(
+        enabled=True,
+        remediation_budget=max(int(max_unavailable), 0),
+        window_s=BREAKER_WINDOW_S,
+        cooldown_s=0.0,
+        flap_threshold=10 ** 9,   # flap detection is a watchdog concern
+    )
+    return CircuitBreaker(cfg, state if state is not None else new_state())
+
+
+def note_unavailable(breaker: CircuitBreaker, now: float,
+                     cluster_name: str, why: str) -> bool:
+    """Record one unavailable cluster against the fleet budget; opens the
+    circuit the moment the count EXCEEDS `max_unavailable` (so a budget of
+    M tolerates exactly M unavailable clusters, and M=0 trips on the
+    first). Returns True when the circuit is (now) open."""
+    breaker.record(now, ok=False)
+    unavailable = len(breaker.state["remediations"])
+    budget = breaker.cfg.remediation_budget
+    if unavailable > budget:
+        breaker.trip(
+            now,
+            f"fleet failure budget exceeded: {unavailable} clusters "
+            f"unavailable > max-unavailable {budget} "
+            f"(latest: {cluster_name}: {why})",
+        )
+    return breaker.is_open

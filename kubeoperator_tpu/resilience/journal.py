@@ -69,14 +69,26 @@ class OperationJournal:
     # ---- lifecycle ----
     def open(self, cluster: Cluster, kind: str,
              phase: ClusterPhaseStatus | None = None,
-             vars: dict | None = None, message: str = "") -> Operation:
+             vars: dict | None = None, message: str = "",
+             trace: dict | None = None, parent_op_id: str = "") -> Operation:
         """Open the durable record FIRST, then (optionally) flip the cluster
         into its in-flight phase — in that order, so there is no window
-        where a crash leaves an in-flight cluster with no journal entry."""
+        where a crash leaves an in-flight cluster with no journal entry.
+
+        `trace` (the `trace_context` wire shape) stitches this op into an
+        EXISTING trace instead of minting one: a fleet rollout hands each
+        per-cluster child op its own trace id + the wave span to hang the
+        child's root span under, so `koctl fleet trace` renders the whole
+        rollout as a single tree. `parent_op_id` is the durable journal-row
+        side of the same link (migration 007)."""
+        trace = trace or {}
+        trace_id = str(trace.get("trace_id", "") or "")
+        parent_span_id = str(trace.get("parent_span_id", "") or "")
         op = Operation(
             cluster_id=cluster.id, cluster_name=cluster.name, kind=kind,
             vars=dict(vars or {}), message=message,
-            trace_id=new_trace_id() if self.tracing else "",
+            parent_op_id=parent_op_id,
+            trace_id=(trace_id or new_trace_id()) if self.tracing else "",
         )
         self.repos.operations.save(op)
         if self.tracing:
@@ -84,13 +96,77 @@ class OperationJournal:
             # (possibly in a different process after a crash+reboot) can
             # always find it without extra bookkeeping
             self.repos.spans.save(Span(
-                id=op.id, trace_id=op.trace_id, parent_id="", op_id=op.id,
-                cluster_id=cluster.id, name=kind, kind=SpanKind.OPERATION,
-                status=SpanStatus.RUNNING, started_at=now_ts(),
-                attrs={"cluster": cluster.name},
+                id=op.id, trace_id=op.trace_id, parent_id=parent_span_id,
+                op_id=op.id, cluster_id=cluster.id, name=kind,
+                kind=SpanKind.OPERATION, status=SpanStatus.RUNNING,
+                started_at=now_ts(), attrs={"cluster": cluster.name},
             ))
         if phase is not None:
             self.set_phase(cluster, phase)
+        return op
+
+    def open_fleet(self, kind: str, vars: dict | None = None,
+                   message: str = "") -> Operation:
+        """Open a FLEET-scope journal op: no single cluster owns it
+        (empty cluster_id), the cluster_name slot carries the fleet
+        marker so history listings stay readable. Same crash-safety
+        contract as open(): the row lands before any wave work starts,
+        so a dead controller leaves an open fleet op the boot reconciler
+        sweeps to a resumable Interrupted state."""
+        op = Operation(
+            cluster_id="", cluster_name="(fleet)", kind=kind,
+            vars=dict(vars or {}), message=message,
+            trace_id=new_trace_id() if self.tracing else "",
+        )
+        self.repos.operations.save(op)
+        if self.tracing:
+            self.repos.spans.save(Span(
+                id=op.id, trace_id=op.trace_id, parent_id="", op_id=op.id,
+                cluster_id="", name=kind, kind=SpanKind.OPERATION,
+                status=SpanStatus.RUNNING, started_at=now_ts(),
+                attrs={"scope": "fleet"},
+            ))
+        return op
+
+    def reopen(self, op: Operation, message: str = "") -> Operation:
+        """Resume an Interrupted/Paused fleet op: back to Running with the
+        preserved `vars` state intact, and the root span re-armed so the
+        eventual close stamps the REAL end of the rollout (a resumed
+        rollout is one operation, not two)."""
+        op.status = OperationStatus.RUNNING.value
+        op.finished_at = 0.0
+        op.message = message
+        self.repos.operations.save(op)
+        if self.tracing and op.trace_id:
+            try:
+                root = self.repos.spans.get(op.id)
+            except Exception:
+                return op   # root pruned: the rollout still resumes
+            root.status = SpanStatus.RUNNING
+            root.finished_at = 0.0
+            if message:
+                root.attrs["resumed"] = message
+            try:
+                self.repos.spans.save(root)
+            except Exception:
+                log.exception("root span reopen failed for op %s", op.id)
+            # settle stale Running WAVE spans: the crash evidence has
+            # served its purpose once the rollout resumes — the re-run
+            # wave opens a fresh sibling span, and a forever-Running twin
+            # under a Succeeded rollout would read as live work
+            try:
+                stale = [s for s in self.repos.spans.for_operation(op.id)
+                         if s.kind == SpanKind.WAVE
+                         and s.status == SpanStatus.RUNNING]
+                for s in stale:
+                    s.status = SpanStatus.FAILED
+                    s.finished_at = now_ts()
+                    s.attrs["outcome"] = "interrupted"
+                if stale:
+                    self.repos.spans.save_many(stale)
+            except Exception:
+                log.exception("stale wave-span sweep failed for op %s",
+                              op.id)
         return op
 
     def tracer_for(self, op: Operation):

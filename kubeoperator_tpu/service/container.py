@@ -120,6 +120,12 @@ class Services:
 
         self.watchdog = WatchdogService(repos, self.health, self.events,
                                         config, clusters=self.clusters)
+        # fleet orchestration rides on everything above: journaled child
+        # ops through UpgradeService, gates through health+watchdog, all
+        # stitched under one fleet op/trace (docs/resilience.md)
+        from kubeoperator_tpu.service.fleet import FleetService
+
+        self.fleet = FleetService(self)
         self.cron = CronService(self)
         from kubeoperator_tpu.terminal import TerminalManager
 
@@ -138,6 +144,7 @@ class Services:
     def close(self) -> None:
         self.cron.stop()
         self.terminals.shutdown()
+        self.fleet.wait_all()
         self.clusters.wait_all()
         self.repos.db.close()
 

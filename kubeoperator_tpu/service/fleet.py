@@ -1,0 +1,350 @@
+"""FleetService — the operator face of wave-based rolling upgrades.
+
+`koctl fleet upgrade --target <ver> --wave-size N --max-unavailable M
+--canary K [--selector k=v ...]` lands here: plan the rollout
+(fleet/planner.py), open ONE durable fleet op (journal.open_fleet) whose
+`vars` carry the whole resumable state, and hand it to the wave scheduler
+(fleet/engine.py) on a worker thread. `status`/`pause`/`resume`/`abort`
+operate on that op; `trace` returns the rollout's single stitched span
+tree (fleet → wave → per-cluster child op → phase → ...).
+
+Pause/abort are cluster-boundary signals: the in-memory events are the
+live channel to a running engine, the op row is the durable truth. A
+controller death mid-rollout leaves the op open; the boot reconciler
+(service/reconcile.py) sweeps it to Interrupted with the state intact and
+`fleet resume` (or `resilience.reconcile.auto_resume`) re-enters without
+re-running completed clusters.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from kubeoperator_tpu.fleet import (
+    FLEET_UPGRADE_KIND,
+    FleetEngine,
+    eligible_clusters,
+    plan_waves,
+)
+from kubeoperator_tpu.fleet.planner import (
+    validate_rollout,
+    validate_selector,
+)
+from kubeoperator_tpu.models import Operation, OperationStatus
+from kubeoperator_tpu.resilience.fleet import FleetConfig, fleet_breaker
+from kubeoperator_tpu.resilience.watchdog import new_state
+from kubeoperator_tpu.utils.errors import (
+    KoError,
+    NotFoundError,
+    ValidationError,
+)
+from kubeoperator_tpu.utils.logging import get_logger
+from kubeoperator_tpu.version import SUPPORTED_K8S_VERSIONS
+
+log = get_logger("service.fleet")
+
+
+class FleetService:
+    def __init__(self, services) -> None:
+        self.s = services
+        self.repos = services.repos
+        self.journal = services.journal
+        self.cfg = FleetConfig.from_config(services.config)
+        self._lock = threading.Lock()
+        self._threads: dict[str, threading.Thread] = {}
+        self._signals: dict[str, tuple[threading.Event, threading.Event]] = {}
+        # one-rollout-at-a-time reservation: set atomically BEFORE planning
+        # starts, cleared when the engine thread registers (or the launch
+        # fails) — closes the check-then-act window where two concurrent
+        # upgrade()/resume() calls both see "no live rollout" and start
+        # two interleaving engines
+        self._claimed = False
+
+    # ---- rollout launch ----
+    def upgrade(self, target_version: str, selector: dict | None = None,
+                wave_size: int | None = None,
+                max_unavailable: int | None = None,
+                canary: int | None = None, wait: bool = False) -> dict:
+        if target_version not in SUPPORTED_K8S_VERSIONS:
+            raise ValidationError(
+                f"target {target_version!r} not in supported bundle "
+                f"{SUPPORTED_K8S_VERSIONS}")
+        wave_size = self.cfg.wave_size if wave_size is None else wave_size
+        max_unavailable = (self.cfg.max_unavailable
+                           if max_unavailable is None else max_unavailable)
+        canary = self.cfg.canary if canary is None else canary
+        validate_rollout(wave_size, max_unavailable, canary)
+        selector = validate_selector(dict(selector or {}))
+
+        def hop_check(current: str, target: str) -> str | None:
+            try:
+                self.s.upgrades.validate_hop(current, target)
+            except KoError as e:
+                return e.message
+            return None
+
+        # claim the rollout slot BEFORE planning: the claim + live-thread
+        # check are one atomic step, so two concurrent upgrade()/resume()
+        # calls can never both pass (one rollout at a time — two engines
+        # interleaving upgrades over overlapping selectors is an operator
+        # hazard, not a feature)
+        self._claim_rollout()
+        try:
+            eligible, skipped = eligible_clusters(
+                self.repos, selector, target_version, hop_check)
+            if not eligible:
+                raise ValidationError(
+                    "no eligible clusters for this rollout"
+                    + (f" (skipped: "
+                       f"{'; '.join(f'{n}: {r}' for n, r in skipped)})"
+                       if skipped else ""))
+            # one list pass, not a per-name get_by_name fan-out: a rollout
+            # over hundreds of clusters should not open with N queries
+            eligible_set = set(eligible)
+            originals = {
+                c.name: c.spec.k8s_version
+                for c in self.repos.clusters.list()
+                if c.name in eligible_set
+            }
+            waves = plan_waves(eligible, wave_size, canary)
+            for wave in waves:
+                wave["outcome"] = "pending"
+                wave["upgraded"] = []
+            op = self.journal.open_fleet(FLEET_UPGRADE_KIND, vars={
+                "target_version": target_version,
+                "selector": selector,
+                "wave_size": wave_size,
+                "max_unavailable": max_unavailable,
+                "canary": canary,
+                "gate_health": self.cfg.gate_health,
+                "auto_rollback": self.cfg.auto_rollback,
+                "clusters": eligible,
+                "skipped": [[n, r] for n, r in skipped],
+                "original_versions": originals,
+                "waves": waves,
+                "completed": [],
+                "failed": {},
+                "rolled_back": [],
+                "gates": {},
+                "breaker": new_state(),
+                "current_wave": 0,
+            }, message=f"rolling {len(eligible)} clusters to "
+                       f"{target_version} in {len(waves)} wave(s)")
+        except BaseException:
+            self._release_claim()
+            raise
+        log.info("fleet op %s: %d clusters -> %s (%d waves, canary %d, "
+                 "max-unavailable %d)", op.id, len(eligible),
+                 target_version, len(waves), canary, max_unavailable)
+        self._start(op, wait)
+        return self.describe(self.repos.operations.get(op.id))
+
+    def _claim_rollout(self) -> None:
+        with self._lock:
+            # ANY registered thread counts as live, started or not:
+            # `_start` registers before `thread.start()`, so an is_alive
+            # probe would let a second claim slip through the not-yet-
+            # started window and run two engines at once (entries are
+            # popped in guarded()'s finally, so none is ever stale)
+            if self._claimed or self._threads:
+                raise ValidationError(
+                    "another fleet rollout is still running "
+                    "(`koctl fleet status`); pause or abort it first")
+            self._claimed = True
+
+    def _release_claim(self) -> None:
+        with self._lock:
+            self._claimed = False
+
+    def _start(self, op: Operation, wait: bool) -> None:
+        """Hand the claimed slot to the engine: registering the thread and
+        releasing the claim happen under ONE lock hold, so there is no
+        instant where neither the claim nor a live thread guards the
+        slot."""
+        pause, abort = threading.Event(), threading.Event()
+        engine = FleetEngine(self.s, op, pause, abort)
+
+        def guarded():
+            try:
+                engine.run(wait=wait)
+            finally:
+                with self._lock:
+                    self._threads.pop(op.id, None)
+                    self._signals.pop(op.id, None)
+
+        thread = (threading.current_thread() if wait else threading.Thread(
+            target=guarded, daemon=True, name=f"fleet-{op.id[:8]}"))
+        with self._lock:
+            self._signals[op.id] = (pause, abort)
+            self._threads[op.id] = thread
+            self._claimed = False
+        if wait:
+            guarded()
+        else:
+            thread.start()
+
+    def _live_rollouts(self) -> list[str]:
+        with self._lock:
+            return [op_id for op_id, t in self._threads.items()
+                    if t.is_alive()]
+
+    # ---- operator verbs ----
+    def resolve(self, op_ref: str = "") -> Operation:
+        """An op by exact id, unique id prefix (>= 6 chars), or — with no
+        ref — the newest fleet op."""
+        if op_ref:
+            # exact-id fast path: `koctl fleet upgrade` polls status by
+            # id once per second — that tick must not hydrate every
+            # historical rollout's vars blob just to match one row
+            try:
+                op = self.repos.operations.get(op_ref)
+                if op.kind == FLEET_UPGRADE_KIND:
+                    return op
+            except NotFoundError:
+                pass
+        ops = self.repos.operations.find(kind=FLEET_UPGRADE_KIND)
+        if not op_ref:
+            if not ops:
+                raise NotFoundError(kind="fleet operation", name="(latest)")
+            return ops[-1]
+        matches = [op for op in ops if op.id == op_ref]
+        if not matches and len(op_ref) >= 6:
+            matches = [op for op in ops if op.id.startswith(op_ref)]
+        if len(matches) == 1:
+            return matches[0]
+        if len(matches) > 1:
+            raise ValidationError(
+                f"fleet op ref {op_ref!r} is ambiguous "
+                f"({len(matches)} matches)")
+        raise NotFoundError(kind="fleet operation", name=op_ref)
+
+    def list_ops(self) -> list[dict]:
+        ops = self.repos.operations.find(kind=FLEET_UPGRADE_KIND)
+        return [self.describe(op) for op in reversed(ops)]
+
+    def describe(self, op: Operation) -> dict:
+        v = op.vars
+        breaker = fleet_breaker(int(v.get("max_unavailable", 0)),
+                                dict(v.get("breaker") or new_state()))
+        unavailable = len(breaker.state["remediations"])
+        return {
+            "id": op.id,
+            "kind": op.kind,
+            "status": op.status,
+            "message": op.message,
+            "target_version": v.get("target_version", ""),
+            "selector": v.get("selector", {}),
+            "wave_size": v.get("wave_size"),
+            "max_unavailable": v.get("max_unavailable"),
+            "canary": v.get("canary"),
+            "clusters": list(v.get("clusters", [])),
+            "skipped": [list(row) for row in v.get("skipped", [])],
+            "waves": [
+                {"index": w["index"], "canary": w["canary"],
+                 "clusters": list(w["clusters"]),
+                 "outcome": w.get("outcome", "pending")}
+                for w in v.get("waves", [])
+            ],
+            "current_wave": v.get("current_wave", 0),
+            "completed": list(v.get("completed", [])),
+            "failed": dict(v.get("failed", {})),
+            "rolled_back": list(v.get("rolled_back", [])),
+            "breaker": {
+                "circuit": breaker.state["state"],
+                "opened_reason": breaker.state["opened_reason"] or None,
+                "unavailable": unavailable,
+                "budget_left": max(
+                    0, breaker.cfg.remediation_budget - unavailable),
+            },
+            "trace_id": op.trace_id,
+            "created_at": op.created_at,
+            "finished_at": op.finished_at or None,
+        }
+
+    def status(self, op_ref: str = "") -> dict:
+        return self.describe(self.resolve(op_ref))
+
+    def pause(self, op_ref: str = "") -> dict:
+        op = self.resolve(op_ref)
+        if op.status != OperationStatus.RUNNING.value:
+            raise ValidationError(
+                f"fleet op {op.id} is {op.status}; only a Running rollout "
+                f"pauses")
+        with self._lock:
+            signals = self._signals.get(op.id)
+        if signals is None:
+            raise ValidationError(
+                f"fleet op {op.id} has no live engine in this process "
+                f"(it will be swept to Interrupted at next boot)")
+        signals[0].set()
+        return {"id": op.id, "pause_requested": True,
+                "note": "takes effect at the next cluster boundary"}
+
+    def resume(self, op_ref: str = "", wait: bool = False) -> dict:
+        op = self.resolve(op_ref)
+        if op.status not in (OperationStatus.PAUSED.value,
+                             OperationStatus.INTERRUPTED.value):
+            raise ValidationError(
+                f"fleet op {op.id} is {op.status}; only Paused/Interrupted "
+                f"rollouts resume")
+        self._claim_rollout()
+        try:
+            self.journal.reopen(
+                op, message=f"resumed after {op.status.lower()} at wave "
+                            f"{op.vars.get('current_wave', 0)}")
+        except BaseException:
+            self._release_claim()
+            raise
+        self._start(op, wait)
+        return self.describe(self.repos.operations.get(op.id))
+
+    def abort(self, op_ref: str = "") -> dict:
+        op = self.resolve(op_ref)
+        if op.status == OperationStatus.RUNNING.value:
+            with self._lock:
+                signals = self._signals.get(op.id)
+            if signals is not None:
+                signals[1].set()
+                return {"id": op.id, "abort_requested": True,
+                        "note": "takes effect at the next cluster boundary"}
+            # running row, no engine: a stale strand — close it honestly
+        elif op.status not in (OperationStatus.PAUSED.value,
+                               OperationStatus.INTERRUPTED.value):
+            raise ValidationError(
+                f"fleet op {op.id} is {op.status}; nothing to abort")
+        for wave in op.vars.get("waves", []):
+            if wave.get("outcome", "pending") == "pending":
+                wave["outcome"] = "aborted"
+        self.journal.close(op, ok=False, message="aborted by operator")
+        return {"id": op.id, "aborted": True}
+
+    def trace(self, op_ref: str = "") -> dict:
+        """The rollout's single stitched span tree: fleet root → waves →
+        per-cluster child op trees, fetched by the shared trace id."""
+        from kubeoperator_tpu.observability import span_tree
+
+        op = self.resolve(op_ref)
+        spans = (self.repos.spans.for_trace(op.trace_id)
+                 if op.trace_id else [])
+        return {
+            "operation": op.id,
+            "kind": op.kind,
+            "status": op.status,
+            "trace_id": op.trace_id,
+            "tree": span_tree(spans),
+        }
+
+    def wait_all(self, timeout_s: float = 30.0) -> None:
+        """Join live engine threads (graceful-shutdown hook, mirroring
+        ClusterService.wait_all)."""
+        deadline = time.monotonic() + timeout_s
+        with self._lock:
+            threads = list(self._threads.values())
+        for thread in threads:
+            if thread is threading.current_thread():
+                continue
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            thread.join(remaining)

@@ -37,6 +37,9 @@ log = get_logger("service.reconcile")
 # paths: retry() for anything create-shaped, delete() for terminations
 AUTO_RESUME_RETRY = frozenset({"create", "slice-scale", "reprovision"})
 AUTO_RESUME_DELETE = frozenset({"terminate"})
+# fleet rollouts resume through FleetService.resume: the op's own `vars`
+# carry the remaining waves, so no original arguments are needed
+AUTO_RESUME_FLEET = frozenset({"fleet-upgrade"})
 
 
 def resume_point(cluster) -> str:
@@ -74,6 +77,25 @@ class ReconcileService:
             status=OperationStatus.RUNNING.value)
         swept_clusters: set[str] = set()
         for op in open_ops:
+            if op.kind in AUTO_RESUME_FLEET:
+                # fleet op: no single cluster to strand; the resumable
+                # state (remaining waves, completed clusters) is already
+                # durable in op.vars — the sweep just names the wave it
+                # died in. Its per-cluster child op is swept by this same
+                # loop like any other orphan.
+                wave = op.vars.get("current_wave", 0)
+                journal.interrupt(
+                    op, resume_phase=f"wave-{wave}",
+                    message=f"controller restart: fleet rollout was in "
+                            f"flight (wave {wave}); `koctl fleet resume` "
+                            f"continues without re-running completed "
+                            f"clusters",
+                )
+                results.append({
+                    "cluster": op.cluster_name, "op": op.id,
+                    "kind": op.kind, "resume_phase": op.resume_phase,
+                })
+                continue
             cluster = None
             try:
                 cluster = repos.clusters.get(op.cluster_id)
@@ -153,6 +175,11 @@ class ReconcileService:
         events, never abort the boot."""
         name, kind = record["cluster"], record["kind"]
         try:
+            if kind in AUTO_RESUME_FLEET:
+                self.services.fleet.resume(record["op"], wait=False)
+                log.info("auto-resumed fleet rollout %s after controller "
+                         "restart", record["op"])
+                return True
             if kind in AUTO_RESUME_RETRY or (
                 kind == "unknown"
                 and self.services.clusters.get(name).plan_id

@@ -1,5 +1,16 @@
 """UpgradeService — K8s version upgrade (SURVEY.md §3.4): one-minor-hop gate,
-then adm upgrade phases (masters serial, workers rolling)."""
+then adm upgrade phases (masters serial, workers rolling).
+
+Fleet integration (docs/resilience.md "Fleet operations"): a rollout's
+per-cluster upgrades pass `links` — the fleet op id plus a trace context
+pointing at the wave span — so each child op journals under the fleet op
+(migration 007) and its spans stitch into the rollout's single tree.
+`rollback` is the fleet breaker's undo verb: the same upgrade phases run
+back to the version the rollout recorded, with the verify attestation
+checked against the ROLLBACK target — deliberately exempt from the
+one-minor-hop direction gate, because undoing the hop just made is the
+sanctioned downgrade.
+"""
 
 from __future__ import annotations
 
@@ -43,10 +54,44 @@ class UpgradeService:
                 f"({current} -> {target} is {hop})"
             )
 
-    def upgrade(self, cluster_name: str, target_version: str):
+    def upgrade(self, cluster_name: str, target_version: str,
+                links: dict | None = None):
         cluster = self.repos.clusters.get_by_name(cluster_name)
         cluster.require_managed("upgrade")
         self.validate_hop(cluster.spec.k8s_version, target_version)
+        return self._run_version_phases(
+            cluster, target_version, kind="upgrade",
+            fail_reason="UpgradeFailed", done_reason="UpgradeDone",
+            links=links)
+
+    def rollback(self, cluster_name: str, to_version: str,
+                 links: dict | None = None):
+        """Fleet-wave undo: re-run the upgrade phases back to
+        `to_version`. Only the bundle-membership half of the hop gate
+        applies — direction is inverted by design, and the distance is
+        bounded by construction (the rollout recorded the version this
+        cluster ran one hop ago)."""
+        if to_version not in SUPPORTED_K8S_VERSIONS:
+            raise UpgradeError(
+                message=f"{to_version} not in supported bundle "
+                f"{SUPPORTED_K8S_VERSIONS}"
+            )
+        cluster = self.repos.clusters.get_by_name(cluster_name)
+        cluster.require_managed("rollback")
+        if cluster.spec.k8s_version == to_version:
+            return cluster   # nothing to undo (upgrade never landed)
+        return self._run_version_phases(
+            cluster, to_version, kind="rollback",
+            fail_reason="RollbackFailed", done_reason="RolledBack",
+            links=links)
+
+    def _run_version_phases(self, cluster, target_version: str, *,
+                            kind: str, fail_reason: str, done_reason: str,
+                            links: dict | None):
+        """The shared journaled phase run behind upgrade AND rollback —
+        both move the cluster to `target_version` through the same adm
+        phases; only the journal kind and event vocabulary differ."""
+        links = links or {}
         # context built BEFORE the journal opens: nothing fallible may sit
         # between the op/phase flip and the close-guaranteeing try below,
         # or a plain exception strands an open op with a live controller
@@ -55,11 +100,13 @@ class UpgradeService:
             self.repos.plans.get(cluster.plan_id) if cluster.plan_id else None,
             {"target_k8s_version": target_version},
         )
-        # journal carries the target version, so an interrupted upgrade's
-        # resume (re-issuing the same upgrade) needs no out-of-band memory
-        op = self.journal.open(cluster, "upgrade",
+        # journal carries the target version, so an interrupted run's
+        # resume (re-issuing the same verb) needs no out-of-band memory
+        op = self.journal.open(cluster, kind,
                                phase=ClusterPhaseStatus.UPGRADING,
-                               vars={"target_version": target_version})
+                               vars={"target_version": target_version},
+                               trace=links.get("trace"),
+                               parent_op_id=links.get("parent_op_id", ""))
         self.journal.attach(op, ctx)
         try:
             self.adm.run(ctx, upgrade_phases())
@@ -68,12 +115,12 @@ class UpgradeService:
             cluster.status.message = e.message
             self.repos.clusters.save(cluster)
             self.journal.close(op, ok=False, message=e.message)
-            self.events.emit(cluster.id, "Warning", "UpgradeFailed", e.message)
+            self.events.emit(cluster.id, "Warning", fail_reason, e.message)
             raise
         cluster.spec.k8s_version = target_version
         cluster.status.phase = ClusterPhaseStatus.READY.value
         self.repos.clusters.save(cluster)
         self.journal.close(op, ok=True)
-        self.events.emit(cluster.id, "Normal", "UpgradeDone",
-                         f"{cluster_name} upgraded to {target_version}")
+        self.events.emit(cluster.id, "Normal", done_reason,
+                         f"{cluster.name} now at {target_version}")
         return cluster

@@ -181,6 +181,12 @@ class WatchdogService:
             cluster.status.reset_conditions([HEALTH_CONDITION])
             self.repos.clusters.save(cluster)
 
+    def circuit_state(self, cluster_id: str) -> str:
+        """One cluster's circuit state ("closed"/"open") without the full
+        status() sweep — the fleet gate's cheap integration point."""
+        _row, breaker = self._load(cluster_id)
+        return breaker.state["state"]
+
     # ---- operator surface ----
     def status(self) -> list[dict]:
         """Per-cluster circuit state for `koctl watchdog status` / the API:

@@ -1330,7 +1330,12 @@ class Interpreter:
         m = _re.match(r"[+-]?[0-9]+", t)
         if not m:
             return math.nan
-        return float(int(m.group(0)))
+        try:
+            return float(int(m.group(0)))
+        except OverflowError:
+            # past double range a browser's parseInt answers ±Infinity —
+            # the Python host must not crash where JS would coerce
+            return -math.inf if m.group(0).startswith("-") else math.inf
 
     # ---- program ----
     def run(self, source: str) -> Env:

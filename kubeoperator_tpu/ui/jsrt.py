@@ -10,7 +10,9 @@ helper's semantics here behaviorally.
 
 Deliberate deviations from plain Python, chosen for portability:
 * ``parse_int`` is stricter than ``int()`` (no '+4', no '_', no unicode
-  digits) because the JS twin uses ``/^-?\\d+$/``.
+  digits) because the JS twin uses ``/^-?\\d+$/`` — and it returns
+  ``int | float | None``, never plain ``int | None``: parseInt parity
+  means 2^53+ digit strings round through a double and overflow is ±inf.
 * ``round2`` uses floor(x*100+0.5)/100 — identical in both languages,
   unlike Python's banker's rounding.
 """
@@ -23,8 +25,14 @@ import re
 _INT_RE = re.compile(r"-?[0-9]+")
 
 
-def parse_int(s):
+def parse_int(s) -> int | float | None:
     """Strict base-10 int parse; None on anything else (JS: regex + parseInt).
+
+    The return type is honestly ``int | float | None``, NOT ``int | None``:
+    digit strings at or past 2^53 come back as the rounded DOUBLE the
+    browser would produce, and overflow beyond double range is ±inf.
+    Callers doing arithmetic (division, slicing) must clamp or reject the
+    float band — `logic.paginate` crashes on `rows[nan:]` otherwise.
 
     Stringifies via to_str, not builtin str: the JS twin does String(s),
     so parse_int(64.0) must see "64" (an int) on both sides — Python's

@@ -482,7 +482,12 @@ def paginate(rows, page, page_size):
     scale installs have hundreds of hosts/events; full-table re-render
     does not survive that."""
     size = jsrt.parse_int(page_size)
-    if size is None or size < 1:
+    if size is None or size < 1 or size > 100000:
+        # parse_int is int|float|None (parseInt parity): 2^53+ digit
+        # strings arrive as doubles and overflow as ±inf, and an inf size
+        # turns the // arithmetic below into nan (Python would then crash
+        # slicing rows[nan:]). Any "page size" past this clamp is garbage
+        # input — both runtimes fall back to the default identically.
         size = 25
     total = len(rows)
     pages = (total + size - 1) // size
@@ -680,7 +685,10 @@ def component_vars_from_form(fields, raw):
             continue
         if f["type"] == "number":
             n = jsrt.parse_int(s)
-            if n is None:
+            if n is None or n >= 9007199254740992 or n <= -9007199254740992:
+                # parse_int is int|float|None: past-2^53 digit strings
+                # come back as lossy doubles (±inf on overflow), and a
+                # rounded replica/port count must never ride into vars
                 errors.append(key + " must be an integer")
             else:
                 out[key] = n

@@ -95,6 +95,28 @@ DEFAULTS: dict[str, Any] = {
         "cooldown_s": 300,         # min gap between remediations
         "flap_threshold": 3,       # degrade-after-successful-fix count
     },
+    "fleet": {
+        # fleet rollout policy (service/fleet.py, docs/resilience.md
+        # "Fleet operations"): wave-based rolling upgrades over many
+        # clusters with canary gates and circuit-broken auto-rollback.
+        # CLI flags (`koctl fleet upgrade --wave-size ...`) override these
+        # per-operation; the block is the fleet-wide default posture.
+        "wave_size": 5,
+        # fleet-wide unavailability tolerance: clusters left unavailable
+        # (failed upgrade or failed post-upgrade health gate) beyond this
+        # count trip the per-fleet-op circuit breaker, which rolls the
+        # in-flight wave back
+        "max_unavailable": 1,
+        "canary": 1,
+        # evaluate the watchdog health probes (tpu-chips included) after
+        # each cluster's upgrade settles; a failed gate counts against
+        # max_unavailable (and blocks promotion outright for canaries)
+        "gate_health": True,
+        # re-journal the in-flight wave's upgraded clusters as `rollback`
+        # child ops when the breaker opens; off = the wave is left Failed
+        # for the operator
+        "auto_rollback": True,
+    },
     "chaos": {
         # seeded fault injection over the executor (resilience/chaos.py);
         # exercised standalone via `koctl chaos-soak`. Never enable on a

@@ -1,0 +1,810 @@
+"""Fleet operations (ISSUE 6 tentpole): wave-based rolling upgrades with
+canary gates, a failure-budget breaker with auto-rollback, and fleet-op
+crash-safety through the journal + boot reconciler.
+
+Tiers:
+  * pure wave math / selector / breaker tests — no stack at all;
+  * tier-1 drills over SMALL simulated fleets (3 TPU-plan clusters):
+    canary-block, mid-wave rollback, controller-death resume, plus the
+    API/CLI surfaces;
+  * slow: the >=20-cluster `koctl chaos-soak --fleet` acceptance matrix,
+    all three behaviors asserted from the journal + stitched span tree in
+    one seeded run.
+"""
+
+import json
+
+import pytest
+
+from kubeoperator_tpu.fleet import (
+    FLEET_UPGRADE_KIND,
+    eligible_clusters,
+    parse_selector,
+    plan_waves,
+)
+from kubeoperator_tpu.models import OperationStatus
+from kubeoperator_tpu.resilience import ControllerDeath
+from kubeoperator_tpu.resilience.fleet import fleet_breaker, note_unavailable
+from kubeoperator_tpu.service import build_services
+from kubeoperator_tpu.utils.config import load_config
+from kubeoperator_tpu.utils.errors import KoError, ValidationError
+
+from tests.test_reconcile import seed_tpu_plan
+
+TARGET = "v1.30.6"          # one minor hop up from the default v1.29.10
+ORIGINAL = "v1.29.10"
+# health gates probe 5 adhocs per TPU-plan cluster (apiserver, nodes,
+# etcd, tpu-device-plugin, tpu-chips) — the fail_at arithmetic below
+# leans on this, and _probe_count pins it against drift
+GATE_PROBES = 5
+
+
+def stack(tmp_path, db="fleet.db", chaos=None, fleet=None, reconcile=None):
+    config = load_config(path="/nonexistent", env={}, overrides={
+        "db": {"path": str(tmp_path / db)},
+        "logging": {"level": "ERROR"},
+        "executor": {"backend": "simulation"},
+        "provisioner": {"work_dir": str(tmp_path / "tf")},
+        "cron": {"backup_enabled": False, "health_check_interval_s": 0,
+                 "event_sync_interval_s": 0},
+        "cluster": {"kubeconfig_dir": str(tmp_path / "kc")},
+        "chaos": {"enabled": True, **(chaos or {})},
+        "fleet": fleet or {},
+        "resilience": {"max_attempts": 2, "backoff_base_s": 0.01,
+                       "backoff_max_s": 0.05,
+                       "reconcile": reconcile or {}},
+    })
+    return build_services(config, simulate=True)
+
+
+def make_fleet(svc, n=3, prefix="fl"):
+    seed_tpu_plan(svc)
+    names = []
+    for i in range(n):
+        name = f"{prefix}-{i:02d}"
+        svc.clusters.create(name, provision_mode="plan",
+                            plan_name="tpu-v5e-16", wait=True)
+        names.append(name)
+    return names
+
+
+def child_kinds(svc, op_id):
+    return sorted(o.kind for o in svc.repos.operations.children(op_id))
+
+
+# ---------------------------------------------------------------- planning --
+class TestWaveMath:
+    def test_canary_leads_then_fixed_waves(self):
+        names = [f"c{i}" for i in range(8)]
+        waves = plan_waves(names, wave_size=3, canary=2)
+        assert [(w["canary"], w["clusters"]) for w in waves] == [
+            (True, ["c0", "c1"]),
+            (False, ["c2", "c3", "c4"]),
+            (False, ["c5", "c6", "c7"]),
+        ]
+        assert [w["index"] for w in waves] == [0, 1, 2]
+
+    def test_no_canary_and_ragged_tail(self):
+        waves = plan_waves(["a", "b", "c", "d", "e"], wave_size=2, canary=0)
+        assert [w["clusters"] for w in waves] == [
+            ["a", "b"], ["c", "d"], ["e"]]
+        assert not any(w["canary"] for w in waves)
+
+    def test_canary_bigger_than_fleet_is_one_canary_wave(self):
+        waves = plan_waves(["a", "b"], wave_size=5, canary=10)
+        assert len(waves) == 1 and waves[0]["canary"]
+        assert waves[0]["clusters"] == ["a", "b"]
+
+    def test_validation_rejects_nonsense(self):
+        with pytest.raises(ValidationError):
+            plan_waves(["a"], wave_size=0, canary=0)
+        with pytest.raises(ValidationError):
+            plan_waves(["a"], wave_size=1, canary=-1)
+
+    def test_selector_parse(self):
+        assert parse_selector(["name=prod-*", "version=v1.29.10"]) == {
+            "name": "prod-*", "version": "v1.29.10"}
+        with pytest.raises(ValidationError):
+            parse_selector(["bogus-key=x"])
+        with pytest.raises(ValidationError):
+            parse_selector(["name"])
+
+    def test_selector_values_must_be_strings(self):
+        """A REST body can put any JSON type in a selector value; a
+        non-string name pattern must die as a ValidationError (→ 400),
+        not crash fnmatch with a TypeError (→ 500)."""
+        from kubeoperator_tpu.fleet import validate_selector
+
+        with pytest.raises(ValidationError, match="non-empty string"):
+            validate_selector({"name": 123})
+        with pytest.raises(ValidationError, match="non-empty string"):
+            validate_selector({"version": None})
+        with pytest.raises(ValidationError, match="non-empty string"):
+            validate_selector({"name": ""})
+
+    def test_unknown_selector_key_is_rejected_not_ignored(self, tmp_path):
+        """_matches ignores keys it doesn't know, so a typo'd selector key
+        reaching the planner would match EVERY cluster — the service must
+        reject it before any wave math runs (the fan-out-over-the-whole-
+        fleet mistake a fleet verb can never allow)."""
+        svc = stack(tmp_path)
+        try:
+            make_fleet(svc, 1)
+            with pytest.raises(ValidationError, match="nme"):
+                svc.fleet.upgrade(TARGET, selector={"nme": "fl-*"})
+        finally:
+            svc.close()
+
+
+class TestFleetBreaker:
+    def test_budget_m_tolerates_exactly_m(self):
+        breaker = fleet_breaker(2)
+        assert not note_unavailable(breaker, 1.0, "a", "gate")
+        assert not note_unavailable(breaker, 2.0, "b", "gate")
+        assert breaker.budget_left(2.5) == 0
+        assert note_unavailable(breaker, 3.0, "c", "gate")
+        assert "budget exceeded" in breaker.state["opened_reason"]
+
+    def test_budget_zero_trips_on_first_failure(self):
+        breaker = fleet_breaker(0)
+        assert note_unavailable(breaker, 1.0, "a", "upgrade failed")
+
+    def test_state_round_trips_as_plain_json(self):
+        breaker = fleet_breaker(1)
+        note_unavailable(breaker, 1.0, "a", "x")
+        revived = fleet_breaker(1, json.loads(json.dumps(breaker.state)))
+        assert not revived.is_open
+        assert note_unavailable(revived, 2.0, "b", "y")
+
+
+# ------------------------------------------------------------ tier-1 drills -
+class TestFleetRollout:
+    def test_probe_count_contract(self, tmp_path):
+        """The fail_at arithmetic in the drills (and the --fleet soak)
+        assumes GATE_PROBES adhoc submissions per TPU gate — pin it."""
+        svc = stack(tmp_path)
+        try:
+            make_fleet(svc, 1)
+            before = svc.executor._counters.get(("adhoc:command", ""), 0)
+            report = svc.health.check("fl-00")
+            after = svc.executor._counters.get(("adhoc:command", ""), 0)
+            assert report.healthy
+            assert after - before == GATE_PROBES
+        finally:
+            svc.close()
+
+    def test_happy_rollout_promotes_all_waves_and_stitches_one_trace(
+            self, tmp_path):
+        svc = stack(tmp_path)
+        try:
+            names = make_fleet(svc, 3)
+            op = svc.fleet.upgrade(TARGET, selector={"name": "fl-*"},
+                                   wave_size=1, canary=1,
+                                   max_unavailable=0, wait=True)
+            op = svc.fleet.status(op["id"])
+            assert op["status"] == "Succeeded"
+            assert [w["outcome"] for w in op["waves"]] == ["promoted"] * 3
+            assert op["completed"] == names
+            assert all(svc.clusters.get(n).spec.k8s_version == TARGET
+                       for n in names)
+            # one child upgrade op per cluster, linked to the fleet op
+            children = svc.repos.operations.children(op["id"])
+            assert sorted(c.cluster_name for c in children) == names
+            assert all(c.kind == "upgrade"
+                       and c.status == OperationStatus.SUCCEEDED.value
+                       for c in children)
+            # ONE stitched trace: fleet root -> wave spans -> child op
+            # trees (phases and below), all under the fleet trace id
+            trace = svc.fleet.trace(op["id"])
+            tree = trace["tree"]
+            assert tree["kind"] == "operation" and tree["id"] == op["id"]
+            wave_names = [c["name"] for c in tree["children"]
+                          if c["kind"] == "wave"]
+            assert wave_names == ["wave-0", "wave-1", "wave-2"]
+            for wave_node in tree["children"]:
+                ops_under = [c for c in wave_node["children"]
+                             if c["kind"] == "operation"]
+                assert len(ops_under) == 1
+                assert any(g["kind"] == "phase"
+                           for g in ops_under[0]["children"])
+            # the per-cluster view still renders rooted at the child op
+            cluster = svc.clusters.get("fl-00")
+            child = [c for c in children if c.cluster_name == "fl-00"][0]
+            from kubeoperator_tpu.observability import span_tree
+
+            sub = span_tree(svc.journal.spans_of(child.id))
+            assert sub["id"] == child.id and sub["kind"] == "operation"
+            # fleet metrics family counts the promoted waves
+            from kubeoperator_tpu.api.metrics import MetricsRegistry
+
+            text = MetricsRegistry().render(svc)
+            assert 'ko_tpu_fleet_waves{outcome="promoted"} 3' in text
+            # wave spans are kind=wave, NOT kind=phase: whole-wave
+            # wall-clock must never pollute the adm-phase histogram
+            assert 'phase="wave-0"' not in text
+        finally:
+            svc.close()
+
+    def test_canary_gate_failure_blocks_promotion(self, tmp_path):
+        svc = stack(tmp_path)
+        try:
+            names = make_fleet(svc, 3)
+            # first adhoc after this point = the canary's first gate probe
+            svc.executor.fail_at("adhoc:command", [1])
+            op = svc.fleet.upgrade(TARGET, selector={"name": "fl-*"},
+                                   wave_size=2, canary=1,
+                                   max_unavailable=1, wait=True)
+            op = svc.fleet.status(op["id"])
+            assert op["status"] == "Failed"
+            assert op["waves"][0]["outcome"] == "canary-blocked"
+            assert op["waves"][1]["outcome"] == "pending"   # never ran
+            assert list(op["failed"]) == [names[0]]
+            assert "health gate failed" in op["failed"][names[0]]
+            # only the canary was touched; it kept its upgrade (blocked,
+            # not rolled back — canaries are the chosen blast radius)
+            assert child_kinds(svc, op["id"]) == ["upgrade"]
+            assert svc.clusters.get(names[0]).spec.k8s_version == TARGET
+            assert all(svc.clusters.get(n).spec.k8s_version == ORIGINAL
+                       for n in names[1:])
+            # journaled evidence: the fleet op row says canary-blocked
+            row = svc.repos.operations.get(op["id"])
+            assert row.status == OperationStatus.FAILED.value
+            assert "canary gate blocked" in row.message
+        finally:
+            svc.close()
+
+    def test_budget_trip_rolls_back_the_inflight_wave(self, tmp_path):
+        svc = stack(tmp_path)
+        try:
+            names = make_fleet(svc, 3)
+            # no canary; wave-0 = all three. Gate probes: submissions 1-5
+            # belong to fl-00's gate, 6-10 to fl-01's — failing 1 and 6
+            # makes two clusters unavailable > max_unavailable 1
+            svc.executor.fail_at("adhoc:command", [1, GATE_PROBES + 1])
+            op = svc.fleet.upgrade(TARGET, selector={"name": "fl-*"},
+                                   wave_size=3, canary=0,
+                                   max_unavailable=1, wait=True)
+            op = svc.fleet.status(op["id"])
+            assert op["status"] == "Failed"
+            assert op["waves"][0]["outcome"] == "rolled-back"
+            assert op["breaker"]["circuit"] == "open"
+            assert "budget exceeded" in op["breaker"]["opened_reason"]
+            # both upgraded clusters were re-journaled as rollback child
+            # ops and are back at the original version; fl-02 never ran
+            assert child_kinds(svc, op["id"]) == [
+                "rollback", "rollback", "upgrade", "upgrade"]
+            assert op["rolled_back"] == [names[0], names[1]]
+            assert all(svc.clusters.get(n).spec.k8s_version == ORIGINAL
+                       for n in names)
+            rollbacks = [o for o in svc.repos.operations.children(op["id"])
+                         if o.kind == "rollback"]
+            assert all(o.status == OperationStatus.SUCCEEDED.value
+                       for o in rollbacks)
+            # rollback child ops stitched into the SAME trace
+            assert all(o.trace_id == op["trace_id"] for o in rollbacks)
+            events = [e.reason for c in names[:2]
+                      for e in svc.events.list(svc.clusters.get(c).id)]
+            assert "FleetWaveRolledBack" in events
+        finally:
+            svc.close()
+
+    def test_controller_death_midwave_resume_skips_completed(
+            self, tmp_path):
+        """The acceptance drill shape, small: die during the SECOND
+        wave-1 upgrade (canary + one wave-1 cluster already done), reboot
+        on the same DB, resume, and prove completed clusters did not
+        re-run — from the journal's parent-linked child ops."""
+        svc = stack(tmp_path,
+                    chaos={"die_at_phase": "20-upgrade-prepare.yml#3"})
+        try:
+            names = make_fleet(svc, 3)
+            with pytest.raises(ControllerDeath):
+                svc.fleet.upgrade(TARGET, selector={"name": "fl-*"},
+                                  wave_size=2, canary=1,
+                                  max_unavailable=0, wait=True)
+            open_ops = svc.repos.operations.find(
+                kind=FLEET_UPGRADE_KIND,
+                status=OperationStatus.RUNNING.value)
+            assert len(open_ops) == 1
+            op_id = open_ops[0].id
+            # the stranded state: fleet op open, child op open, cluster
+            # in-flight — exactly what the boot reconciler exists for
+            assert svc.clusters.get(names[2]).status.phase == "Upgrading"
+        finally:
+            svc.close()
+
+        svc2 = stack(tmp_path)
+        try:
+            swept = {r["op"]: r for r in svc2.boot_report}
+            assert swept[op_id]["kind"] == FLEET_UPGRADE_KIND
+            assert swept[op_id]["resume_phase"] == "wave-1"
+            row = svc2.repos.operations.get(op_id)
+            assert row.status == OperationStatus.INTERRUPTED.value
+            # state preserved: canary + first wave-1 cluster completed
+            before = svc2.fleet.status(op_id)
+            assert before["completed"] == [names[0], names[1]]
+
+            svc2.fleet.resume(op_id, wait=True)
+            op = svc2.fleet.status(op_id)
+            assert op["status"] == "Succeeded"
+            assert all(svc2.clusters.get(n).spec.k8s_version == TARGET
+                       for n in names)
+            per_cluster: dict = {}
+            for child in svc2.repos.operations.children(op_id):
+                per_cluster.setdefault(child.cluster_name,
+                                       []).append(child.status)
+            # completed clusters were NOT re-run; the mid-flight one was
+            assert per_cluster[names[0]] == ["Succeeded"]
+            assert per_cluster[names[1]] == ["Succeeded"]
+            assert sorted(per_cluster[names[2]]) == [
+                "Interrupted", "Succeeded"]
+            # one stitched tree across death + resume: every wave
+            # promoted, and the interrupted child op still visible in it
+            trace = svc2.fleet.trace(op_id)
+            wave_outcomes = [
+                c["attrs"].get("outcome") for c in
+                trace["tree"]["children"] if c["kind"] == "wave"]
+            assert wave_outcomes.count("promoted") >= 2
+            statuses = {c["attrs"].get("cluster"): c["status"] for c in
+                        _walk_ops(trace["tree"])}
+            assert statuses.get(names[2]) in ("Failed", "OK")
+            # resume settles the crash-stranded wave span: the tree of a
+            # Succeeded rollout never shows a forever-Running wave twin
+            wave_spans = [s for s in svc2.repos.spans.for_operation(op_id)
+                          if s.kind == "wave"]
+            assert all(s.status != "Running" for s in wave_spans)
+            assert any(s.attrs.get("outcome") == "interrupted"
+                       for s in wave_spans)
+        finally:
+            svc2.close()
+
+    def _slow_gates(self, svc, delay_s=0.3):
+        """Stretch each post-upgrade gate so an operator verb issued right
+        after launch deterministically lands BEFORE the rollout finishes
+        (pause/abort are cluster-boundary signals)."""
+        import time as _time
+
+        orig = svc.health.check
+
+        def slow_check(name):
+            _time.sleep(delay_s)
+            return orig(name)
+
+        svc.health.check = slow_check
+
+    def test_pause_parks_and_resume_continues(self, tmp_path):
+        svc = stack(tmp_path)
+        try:
+            names = make_fleet(svc, 3)
+            self._slow_gates(svc)
+            op = svc.fleet.upgrade(TARGET, selector={"name": "fl-*"},
+                                   wave_size=1, canary=0,
+                                   max_unavailable=0, wait=False)
+            svc.fleet.pause(op["id"])
+            svc.fleet.wait_all()
+            row = svc.repos.operations.get(op["id"])
+            assert row.status == OperationStatus.PAUSED.value
+            paused = svc.fleet.status(op["id"])
+            done_at_pause = list(paused["completed"])
+            # parking flushed the tracer: a clean pause leaves NO wave
+            # span stranded Running in the DB (a process exit while
+            # Paused must not turn the pause into crash evidence)
+            assert all(s.status != "Running"
+                       for s in svc.repos.spans.for_operation(op["id"])
+                       if s.kind == "wave")
+            # paused is a resting state: resume finishes the rest without
+            # re-running what completed before the pause
+            svc.fleet.resume(op["id"], wait=True)
+            op2 = svc.fleet.status(op["id"])
+            assert op2["status"] == "Succeeded"
+            assert op2["completed"] == names
+            per_cluster: dict = {}
+            for child in svc.repos.operations.children(op["id"]):
+                per_cluster.setdefault(child.cluster_name,
+                                       []).append(child.status)
+            assert all(statuses == ["Succeeded"]
+                       for statuses in per_cluster.values()), per_cluster
+            assert set(done_at_pause) <= set(op2["completed"])
+        finally:
+            svc.close()
+
+    def test_abort_closes_failed_and_marks_pending_waves(self, tmp_path):
+        svc = stack(tmp_path)
+        try:
+            make_fleet(svc, 2)
+            self._slow_gates(svc)
+            op = svc.fleet.upgrade(TARGET, selector={"name": "fl-*"},
+                                   wave_size=1, canary=0,
+                                   max_unavailable=0, wait=False)
+            svc.fleet.pause(op["id"])
+            svc.fleet.wait_all()
+            result = svc.fleet.abort(op["id"])
+            assert result.get("aborted") or result.get("abort_requested")
+            svc.fleet.wait_all()
+            row = svc.repos.operations.get(op["id"])
+            assert row.status == OperationStatus.FAILED.value
+            assert "aborted by operator" in row.message
+            assert all(w.get("outcome") != "pending"
+                       for w in row.vars["waves"])
+        finally:
+            svc.close()
+
+    def _craft_fleet_op(self, svc, waves, **vars_over):
+        """A fleet op row in an arbitrary mid-flight state: the resume
+        edges below land a crash BETWEEN a wave's verdict and the op
+        closing, which no amount of chaos timing reaches deterministically
+        from the outside."""
+        names = [n for w in waves for n in w["clusters"]]
+        base = {
+            "target_version": TARGET, "selector": {"name": "fl-*"},
+            "wave_size": 3, "max_unavailable": 0, "canary": 0,
+            "gate_health": False, "auto_rollback": True,
+            "clusters": names, "skipped": [],
+            "original_versions": {n: ORIGINAL for n in names},
+            "waves": waves, "completed": [], "failed": {},
+            "rolled_back": [], "gates": {},
+            "breaker": json.loads(json.dumps(
+                fleet_breaker(0, None).state)),
+            "current_wave": 0,
+        }
+        base.update(vars_over)
+        return svc.journal.open_fleet(FLEET_UPGRADE_KIND, vars=base)
+
+    def _run_engine(self, svc, op):
+        import threading
+
+        from kubeoperator_tpu.fleet import FleetEngine
+
+        FleetEngine(svc, op, threading.Event(), threading.Event()).run(
+            wait=True)
+        return svc.repos.operations.get(op.id)
+
+    def test_resume_with_open_breaker_finishes_rollback_not_forward(
+            self, tmp_path):
+        """Crash AFTER the breaker tripped mid-rollback, BEFORE the op
+        closed: the wave is still `pending`, two clusters are upgraded
+        (one already rolled back) — re-entering the wave must finish the
+        rollback, never upgrade the remaining cluster under an open
+        breaker and promote the tripped wave."""
+        svc = stack(tmp_path)
+        try:
+            names = make_fleet(svc, 3)
+            for n in names[:2]:
+                svc.upgrades.upgrade(n, TARGET)
+            breaker = fleet_breaker(0, None)
+            note_unavailable(breaker, 0.0, names[2], "gate failed")
+            assert breaker.state["state"] == "open"
+            op = self._craft_fleet_op(
+                svc,
+                [{"index": 0, "canary": False, "clusters": list(names),
+                  "outcome": "pending",
+                  "upgraded": [names[0], names[1]]}],
+                completed=[names[0]],
+                failed={names[2]: "gate failed"},
+                rolled_back=[names[1]],
+                breaker=breaker.state)
+            # names[1] pre-recorded as rolled back (version restored)
+            svc.upgrades.rollback(names[1], ORIGINAL)
+            row = self._run_engine(svc, op)
+            assert row.status == OperationStatus.FAILED.value
+            assert row.vars["waves"][0]["outcome"] == "rolled-back"
+            # the not-yet-rolled-back upgrade was undone; nothing new ran
+            assert svc.clusters.get(names[0]).spec.k8s_version == ORIGINAL
+            assert svc.clusters.get(names[1]).spec.k8s_version == ORIGINAL
+            assert sorted(row.vars["rolled_back"]) == sorted(names[:2])
+        finally:
+            svc.close()
+
+    def test_resume_with_failed_canary_stays_blocked(self, tmp_path):
+        """Crash after a canary failed its gate but before the op closed:
+        re-entering the canary wave must re-reach canary-blocked, not
+        skip the failed canary and promote an empty wave."""
+        svc = stack(tmp_path)
+        try:
+            names = make_fleet(svc, 2)
+            op = self._craft_fleet_op(
+                svc,
+                [{"index": 0, "canary": True, "clusters": [names[0]],
+                  "outcome": "pending", "upgraded": []},
+                 {"index": 1, "canary": False, "clusters": [names[1]],
+                  "outcome": "pending", "upgraded": []}],
+                max_unavailable=1,
+                failed={names[0]: "health gate failed"},
+                breaker=fleet_breaker(1, None).state)
+            row = self._run_engine(svc, op)
+            assert row.status == OperationStatus.FAILED.value
+            assert row.vars["waves"][0]["outcome"] == "canary-blocked"
+            assert row.vars["waves"][1]["outcome"] == "pending"
+            assert svc.clusters.get(names[1]).spec.k8s_version == ORIGINAL
+        finally:
+            svc.close()
+
+    def test_cluster_deleted_midrollout_is_budgeted_not_a_halt(
+            self, tmp_path):
+        """A cluster deleted after planning is an UNAVAILABLE cluster the
+        failure budget judges — not a NotFoundError that halts the engine
+        past the breaker and rollback machinery."""
+        svc = stack(tmp_path)
+        try:
+            names = make_fleet(svc, 1)
+            op = self._craft_fleet_op(
+                svc,
+                [{"index": 0, "canary": False,
+                  "clusters": [names[0], "ghost-00"],
+                  "outcome": "pending", "upgraded": []}],
+                max_unavailable=1,
+                breaker=fleet_breaker(1, None).state)
+            row = self._run_engine(svc, op)
+            # the live cluster still upgraded; the ghost landed in
+            # `failed` within budget — the wave promoted
+            assert row.vars["waves"][0]["outcome"] == "promoted"
+            assert svc.clusters.get(names[0]).spec.k8s_version == TARGET
+            assert "upgrade failed" in row.vars["failed"]["ghost-00"]
+        finally:
+            svc.close()
+
+    def test_engine_abort_settles_every_pending_wave(self, tmp_path):
+        """The ENGINE-side abort path (abort observed at a wave boundary,
+        not the service's stale-strand path) must also settle every
+        not-yet-run wave: `pending` means 'runs on resume', and an aborted
+        op never resumes — a closed op may not advertise live work."""
+        import threading
+
+        from kubeoperator_tpu.fleet import FleetEngine
+
+        svc = stack(tmp_path)
+        try:
+            make_fleet(svc, 3)
+            self._slow_gates(svc)
+            op = svc.fleet.upgrade(TARGET, selector={"name": "fl-*"},
+                                   wave_size=1, canary=0,
+                                   max_unavailable=0, wait=False)
+            svc.fleet.pause(op["id"])
+            svc.fleet.wait_all()
+            row = svc.repos.operations.get(op["id"])
+            assert row.status == OperationStatus.PAUSED.value
+            pause, abort = threading.Event(), threading.Event()
+            abort.set()
+            FleetEngine(svc, row, pause, abort).run(wait=True)
+            row = svc.repos.operations.get(op["id"])
+            assert row.status == OperationStatus.FAILED.value
+            assert "aborted by operator" in row.message
+            outcomes = [w["outcome"] for w in row.vars["waves"]]
+            assert "pending" not in outcomes
+            assert outcomes.count("aborted") >= 2, outcomes
+        finally:
+            svc.close()
+
+    def test_claim_refuses_while_registered_thread_not_yet_started(
+            self, tmp_path):
+        """`_start` registers the engine thread BEFORE thread.start():
+        the claim must treat any registered entry as live, or a second
+        upgrade() landing in that window (claim released, thread not yet
+        alive) would run two engines at once."""
+        import threading
+
+        svc = stack(tmp_path)
+        try:
+            make_fleet(svc, 1)
+            unstarted = threading.Thread(target=lambda: None)
+            svc.fleet._threads["op-x"] = unstarted
+            try:
+                with pytest.raises(ValidationError, match="still running"):
+                    svc.fleet.upgrade(TARGET, selector={"name": "fl-*"})
+            finally:
+                svc.fleet._threads.pop("op-x")
+        finally:
+            svc.close()
+
+    def test_resolve_exact_id_skips_full_history_hydrate(self, tmp_path):
+        """The poll tick resolves by exact id once per second: it must hit
+        the one-row get, never hydrate every historical rollout's vars."""
+        svc = stack(tmp_path)
+        try:
+            make_fleet(svc, 1)
+            op = svc.fleet.upgrade(TARGET, selector={"name": "fl-*"},
+                                   wave_size=1, canary=0,
+                                   max_unavailable=0, wait=True)
+
+            def no_find(**kw):
+                raise AssertionError(
+                    "exact-id resolve ran a full-history find()")
+
+            orig = svc.repos.operations.find
+            svc.repos.operations.find = no_find
+            try:
+                assert svc.fleet.resolve(op["id"]).id == op["id"]
+            finally:
+                svc.repos.operations.find = orig
+        finally:
+            svc.close()
+
+    def test_selector_and_eligibility(self, tmp_path):
+        svc = stack(tmp_path)
+        try:
+            make_fleet(svc, 2)
+            # an already-at-target cluster and a non-matching name are
+            # planned around, not failed on
+            done = svc.clusters.get("fl-00")
+            done.spec.k8s_version = TARGET
+            svc.repos.clusters.save(done)
+
+            def hop_check(current, target):
+                try:
+                    svc.upgrades.validate_hop(current, target)
+                except KoError as e:
+                    return e.message
+                return None
+
+            eligible, skipped = eligible_clusters(
+                svc.repos, {"name": "fl-*"}, TARGET, hop_check)
+            assert eligible == ["fl-01"]
+            assert [s[0] for s in skipped] == ["fl-00"]
+            with pytest.raises(KoError):
+                svc.fleet.upgrade(TARGET, selector={"name": "nope-*"})
+        finally:
+            svc.close()
+
+
+def _walk_ops(node):
+    """Child-operation nodes of a stitched fleet tree."""
+    out = []
+    for child in node.get("children", []):
+        if child["kind"] == "operation":
+            out.append(child)
+        out.extend(_walk_ops(child))
+    return out
+
+
+# ------------------------------------------------------------- API surface --
+class TestFleetApi:
+    def test_fleet_rest_surface(self, client):
+        base, session, services = client
+        make_fleet(services, 2, prefix="api")
+        resp = session.post(f"{base}/api/v1/fleet/upgrade", json={
+            "target": TARGET, "selector": {"name": "api-*"},
+            "wave_size": 1, "canary": 0, "max_unavailable": 0,
+        })
+        assert resp.status_code == 202
+        op = resp.json()
+        assert op["status"] in ("Running", "Succeeded")
+        services.fleet.wait_all()
+
+        resp = session.get(f"{base}/api/v1/fleet/operations")
+        assert resp.status_code == 200 and len(resp.json()) == 1
+        resp = session.get(f"{base}/api/v1/fleet/operations/{op['id']}")
+        detail = resp.json()
+        assert detail["status"] == "Succeeded"
+        assert detail["completed"] == ["api-00", "api-01"]
+        resp = session.get(
+            f"{base}/api/v1/fleet/operations/{op['id']}/trace")
+        tree = resp.json()["tree"]
+        assert tree["id"] == op["id"]
+        # bad input is a 400 with the field named, not a 500
+        resp = session.post(f"{base}/api/v1/fleet/upgrade", json={})
+        assert resp.status_code == 400
+        resp = session.post(f"{base}/api/v1/fleet/upgrade", json={
+            "target": TARGET, "wave_size": "lots"})
+        assert resp.status_code == 400
+        # a non-string selector value is malformed input, not a crash in
+        # fnmatch (would surface as a 500)
+        resp = session.post(f"{base}/api/v1/fleet/upgrade", json={
+            "target": TARGET, "selector": {"name": 123}})
+        assert resp.status_code == 400
+        # a non-integral number is rejected, not silently truncated to a
+        # tighter budget than the client sent
+        resp = session.post(f"{base}/api/v1/fleet/upgrade", json={
+            "target": TARGET, "max_unavailable": 1.9})
+        assert resp.status_code == 400
+        # /metrics exposes the wave-outcome family
+        resp = session.get(f"{base}/metrics")
+        assert 'ko_tpu_fleet_waves{outcome="promoted"}' in resp.text
+    # (the `client` fixture's stack runs the simulation executor, so the
+    # rollout above is a REAL two-cluster upgrade over the REST surface)
+
+
+class TestKoctlSurface:
+    def test_fleet_cli_local_transport(self, tmp_path, capsys, monkeypatch):
+        from kubeoperator_tpu.cli import koctl
+
+        monkeypatch.setenv("KO_TPU_CONFIG", "/nonexistent")
+        monkeypatch.setenv("KO_TPU_DB__PATH", str(tmp_path / "cli.db"))
+        monkeypatch.setenv("KO_TPU_EXECUTOR__BACKEND", "simulation")
+        monkeypatch.setenv("KO_TPU_PROVISIONER__WORK_DIR",
+                           str(tmp_path / "tf"))
+        monkeypatch.setenv("KO_TPU_CLUSTER__KUBECONFIG_DIR",
+                           str(tmp_path / "kc"))
+        monkeypatch.setenv("KO_TPU_LOGGING__LEVEL", "ERROR")
+
+        client = koctl.LocalClient()
+        svc = client.services
+        try:
+            make_fleet(svc, 2, prefix="cli")
+            args = koctl.build_parser().parse_args(
+                ["--local", "fleet", "upgrade", "--target", TARGET,
+                 "--selector", "name=cli-*", "--wave-size", "1",
+                 "--canary", "0", "--max-unavailable", "0"])
+            assert koctl.cmd_fleet(client, args) == 0
+            out = capsys.readouterr().out
+            assert "wave 0" in out and "promoted" in out
+
+            args = koctl.build_parser().parse_args(
+                ["--local", "fleet", "status", "--json"])
+            assert koctl.cmd_fleet(client, args) == 0
+            ops = json.loads(capsys.readouterr().out)
+            assert len(ops) == 1 and ops[0]["status"] == "Succeeded"
+
+            args = koctl.build_parser().parse_args(
+                ["--local", "fleet", "trace"])
+            assert koctl.cmd_fleet(client, args) == 0
+            out = capsys.readouterr().out
+            assert "wave-0" in out and "operation:upgrade" in out
+
+            # KO-X010 parity with the REST handler: the local transport
+            # rejects non-integral numbers instead of truncating them
+            with pytest.raises(SystemExit, match="must be an integer"):
+                client.call("POST", "/api/v1/fleet/upgrade", {
+                    "target": TARGET, "wave_size": 2.9})
+        finally:
+            svc.close()
+
+    def test_fleet_status_json_exit_code_matches_text(self, capsys):
+        """`fleet status --json` (list form) carries the SAME exit
+        contract as the text form: a script reads the code, not the
+        rendering, and a Failed rollout must not exit 0 under --json."""
+        from kubeoperator_tpu.cli import koctl
+
+        class _StubClient:
+            def call(self, method, path, body=None):
+                return [{"status": "Failed"}]
+
+        args = koctl.build_parser().parse_args(["fleet", "status", "--json"])
+        assert koctl.cmd_fleet(_StubClient(), args) == 1
+        assert json.loads(capsys.readouterr().out) == [{"status": "Failed"}]
+
+
+# ------------------------------------------------- the acceptance matrix ----
+@pytest.mark.slow
+def test_fleet_chaos_soak_matrix(capsys):
+    """Acceptance drill: `koctl chaos-soak --fleet` over >= 20 simulated
+    clusters proves, with one fixed seed, (a) canary-block, (b) mid-wave
+    auto-rollback and (c) controller-death resume without re-running
+    completed clusters — every check asserted inside the drill from the
+    journal rows and the single stitched trace tree."""
+    from kubeoperator_tpu.cli.koctl import main
+
+    rc = main(["chaos-soak", "--fleet", "--clusters", "21",
+               "--format", "json"])
+    report = json.loads(capsys.readouterr().out)
+    assert rc == 0, report
+    assert report["ok"] is True
+    assert report["clusters"] >= 20
+    failed = [c for c in report["checks"] if not c["ok"]]
+    assert failed == []
+    # all three scenario families are present in the check list
+    prefixes = {c["check"][:2] for c in report["checks"]}
+    assert {"a:", "b:", "c:"} <= prefixes
+
+
+@pytest.mark.slow
+def test_fleet_soak_is_seed_stable(capsys):
+    """The drill is DETERMINISTIC: the scripted faults and the seeded RNG
+    make two identical invocations produce identical check lists."""
+    from kubeoperator_tpu.cli.koctl import main
+
+    rc1 = main(["chaos-soak", "--fleet", "--clusters", "9",
+                "--format", "json"])
+    first = json.loads(capsys.readouterr().out)
+    rc2 = main(["chaos-soak", "--fleet", "--clusters", "9",
+                "--format", "json"])
+    second = json.loads(capsys.readouterr().out)
+    assert rc1 == rc2 == 0
+
+    def shape(report):
+        # op ids inside `detail` strings are random per run; the CHECK
+        # OUTCOMES and the injection ledger are the determinism contract
+        return [(c["check"], c["ok"]) for c in report["checks"]]
+
+    assert shape(first) == shape(second)
+    assert first["injection_summary"] == second["injection_summary"]
+    assert first["injection_summary"]["total"] >= 3   # faults actually fired

@@ -20,9 +20,14 @@ class TestInstaller:
                                  "prometheus", "grafana"}
         assert services["ko-server"]["depends_on"] == ["ko-runner",
                                                        "ko-registry"]
-        # ko-server is health-gated on its own /healthz (503 = dead DB)
+        # ko-server is health-gated on its OWN state store only: the check
+        # reads /healthz's `db` field, because the endpoint's overall 503
+        # also fires when ko-runner (a different container) is down, and
+        # restarting ko-server for that would fix nothing
         hc = services["ko-server"]["healthcheck"]
         assert "/healthz" in hc["test"][1] and hc["retries"] >= 3
+        assert ".get('db')" in hc["test"][1]
+        assert "HTTPError" in hc["test"][1]   # a 503 body must still parse
         # the compose topology is TRUTHFUL (VERDICT r4 #1): ko-server routes
         # phases to the ko-runner container over gRPC, and the runner
         # container actually runs the runner-service entrypoint
@@ -131,20 +136,49 @@ class TestInstaller:
         target = tmp_path / "opt"
         render_bundle(str(target))
         prom_path = target / "data" / "observability" / "prometheus.yml"
-        # simulate a pre-alerts install with an operator-tuned interval
-        legacy = {"global": {"scrape_interval": "7s"},
-                  "scrape_configs": [{"job_name": "custom"}]}
-        prom_path.write_text(yaml.safe_dump(legacy))
+        # simulate a pre-alerts install with an operator-tuned interval,
+        # a COMMENT and an ANCHOR — the things a yaml.safe_dump round-trip
+        # would silently destroy (the migration must be a text-level edit)
+        legacy_text = (
+            "# tuned by ops: keep the short interval\n"
+            "global:\n"
+            "  scrape_interval: &ival 7s\n"
+            "  evaluation_interval: *ival\n"
+            "scrape_configs:\n"
+            "- job_name: custom\n"
+        )
+        prom_path.write_text(legacy_text)
         render_bundle(str(target))   # upgrade re-render
-        migrated = yaml.safe_load(prom_path.read_text())
+        migrated_text = prom_path.read_text()
+        migrated = yaml.safe_load(migrated_text)
         assert migrated["global"]["scrape_interval"] == "7s"   # preserved
         assert migrated["scrape_configs"] == [{"job_name": "custom"}]
         assert migrated["rule_files"] == [
             "/etc/prometheus/ko-tpu-alerts.yml"]
+        # the operator's comment and anchor SURVIVED the migration
+        assert "# tuned by ops: keep the short interval" in migrated_text
+        assert "&ival" in migrated_text
         # idempotent: a third render adds nothing twice
         render_bundle(str(target))
         again = yaml.safe_load(prom_path.read_text())
         assert again["rule_files"] == ["/etc/prometheus/ko-tpu-alerts.yml"]
+
+    def test_operator_owned_rule_files_list_is_not_rewritten(self, tmp_path):
+        """A preserved config that already has its OWN rule_files list
+        (without our entry) is the operator's formatting to own: the
+        installer warns instead of splicing into their file."""
+        target = tmp_path / "opt"
+        render_bundle(str(target))
+        prom_path = target / "data" / "observability" / "prometheus.yml"
+        own_text = (
+            "global:\n"
+            "  scrape_interval: 30s\n"
+            "rule_files:\n"
+            "- /etc/prometheus/my-rules.yml   # ops-owned\n"
+        )
+        prom_path.write_text(own_text)
+        render_bundle(str(target))
+        assert prom_path.read_text() == own_text   # untouched, byte-for-byte
 
     def test_install_without_docker_degrades(self, tmp_path):
         result = install(str(tmp_path / "opt"), start=True)

@@ -293,6 +293,73 @@ class TestTracer:
         kept = {s.op_id for s in repos.spans.list()}
         assert kept == {ops[2].id, ops[3].id}
 
+    def test_retention_never_prunes_live_ops_or_their_children(
+            self, tmp_path):
+        """A fleet rollout over more clusters than `retain_operations`
+        closes a child op (→ a prune) per cluster while the fleet op —
+        the OLDEST row in the store — is still Running: its root/wave
+        spans and the earliest child subtrees must survive, or the
+        stitched trace breaks at exactly the scale fleets exist for."""
+        from kubeoperator_tpu.models import Cluster
+        from kubeoperator_tpu.repository import Database, Repositories
+        from kubeoperator_tpu.resilience import OperationJournal
+
+        repos = Repositories(Database(str(tmp_path / "live.db")))
+        journal = OperationJournal(repos, retain_operations=2)
+        cluster = Cluster(name="live")
+        repos.clusters.save(cluster)
+        fleet_op = journal.open_fleet("fleet-upgrade", vars={})
+        children = []
+        for i in range(4):
+            child = journal.open(cluster, f"upgrade-{i}")
+            child.parent_op_id = fleet_op.id
+            repos.operations.save(child)
+            journal.close(child, ok=True)   # each close runs the prune
+            children.append(child)
+        kept = {s.op_id for s in repos.spans.list()}
+        # the Running fleet op and EVERY child stitched under it kept,
+        # despite sitting far past the retain-2 horizon
+        assert fleet_op.id in kept
+        assert {c.id for c in children} <= kept
+        # once the fleet op closes, normal retention applies again: a
+        # fresh standalone op's close prunes the now-terminal tree
+        journal.close(fleet_op, ok=True)
+        for i in range(3):
+            op = journal.open(cluster, f"later-{i}")
+            journal.close(op, ok=True)
+        kept = {s.op_id for s in repos.spans.list()}
+        assert fleet_op.id not in kept
+        assert not ({c.id for c in children} & kept)
+
+    def test_retention_interrupted_exemption_is_fleet_scope_only(
+            self, tmp_path):
+        """Only fleet ops (cluster_id '') are ever journal.reopen'd; a
+        per-cluster op swept to Interrupted at boot is superseded by a
+        fresh op on retry — exempting it would let a crash-looping
+        controller grow the span store without bound."""
+        from kubeoperator_tpu.models import Cluster, OperationStatus
+        from kubeoperator_tpu.repository import Database, Repositories
+        from kubeoperator_tpu.resilience import OperationJournal
+
+        repos = Repositories(Database(str(tmp_path / "intr.db")))
+        journal = OperationJournal(repos, retain_operations=2)
+        cluster = Cluster(name="intr")
+        repos.clusters.save(cluster)
+        stranded = journal.open(cluster, "create")
+        stranded.status = OperationStatus.INTERRUPTED.value
+        repos.operations.save(stranded)
+        fleet_op = journal.open_fleet("fleet-upgrade", vars={})
+        fleet_op.status = OperationStatus.INTERRUPTED.value
+        repos.operations.save(fleet_op)
+        for i in range(3):
+            op = journal.open(cluster, f"later-{i}")
+            journal.close(op, ok=True)
+        kept = {s.op_id for s in repos.spans.list()}
+        # the resumable (fleet) Interrupted op survives; the superseded
+        # per-cluster strand ages out with the retention window
+        assert fleet_op.id in kept
+        assert stranded.id not in kept
+
     def test_tree_self_time_and_critical_path(self):
         from kubeoperator_tpu.models import Span
         from kubeoperator_tpu.observability import span_tree
@@ -425,7 +492,9 @@ class _StubRepo:
                 "task": [("05-etcd.yml", 0.11, "trace-1")],
             }[kind])
         self.operations = types.SimpleNamespace(
-            count_by_status=lambda: {"Succeeded": 2, "Running": 1})
+            count_by_status=lambda: {"Succeeded": 2, "Running": 1},
+            # the fleet-waves collector scans fleet ops; none journaled
+            find=lambda **kw: [])
 
 
 class _StubServices:

@@ -600,6 +600,20 @@ class TestTablePaging:
         junk = logic.paginate(rows, "x", "y")
         assert junk["page"] == 1 and len(junk["rows"]) == 25
 
+    def test_paginate_survives_parse_int_float_band(self):
+        """parse_int is int|float|None (parseInt parity): a 400-digit page
+        size comes back ±inf and used to turn the page arithmetic into nan
+        — Python then crashed slicing rows[nan:]. Both the overflow and
+        the lossy-double band must fall back to defaults."""
+        rows = list(range(53))
+        huge = "9" * 400                       # parse_int -> inf
+        page = logic.paginate(rows, 1, huge)
+        assert page["rows"] == list(range(25)) and page["pages"] == 3
+        lossy = str(2 ** 60)                   # parse_int -> float 2^60
+        page = logic.paginate(rows, lossy, lossy)
+        assert page["page"] == 3               # clamped to the last page
+        assert page["rows"] == list(range(50, 53))
+
     def test_filter_hosts_across_fields(self):
         hosts = [
             {"name": "tpu-w0", "ip": "10.0.0.7", "status": "Ready",
@@ -967,6 +981,13 @@ class TestComponentForm:
         raw["ceph_pool_replicas"] = "two"
         assert any("ceph_pool_replicas" in e for e in
                    logic.component_vars_from_form(fields, raw)["errors"])
+        # ...and reject the parse_int float band (2^53+ digit strings
+        # round through a double; ±inf on overflow): a lossy replica
+        # count must never ride into vars as a float
+        for lossy in (str(2 ** 60), "9" * 400):
+            raw["ceph_pool_replicas"] = lossy
+            assert any("ceph_pool_replicas" in e for e in
+                       logic.component_vars_from_form(fields, raw)["errors"])
 
     def test_required_empty_field_errors_before_any_network_call(self):
         entry = _catalog_entry_as_json("nfs-provisioner")
