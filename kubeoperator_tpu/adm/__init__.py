@@ -1,11 +1,13 @@
 """ClusterAdm — the resumable phase state-machine (SURVEY.md §2.1 row 1c).
 
-Pure orchestration: knows phase *order* and *conditions*, delegates every
-side effect to the executor/provisioner. One ClusterStatusCondition row per
-phase; a failed operation re-enters at the first non-OK condition
-(SURVEY.md §3.1).
+Pure orchestration: knows phase *dependencies* and *conditions*, delegates
+every side effect to the executor/provisioner. One ClusterStatusCondition
+row per phase; a failed operation re-enters at the unfinished frontier —
+the first non-OK condition serially, every non-OK DAG node concurrently
+(adm/dag.py, docs/scheduler.md).
 """
 
+from kubeoperator_tpu.adm.dag import SchedulerConfig, scheduler_wiring
 from kubeoperator_tpu.adm.engine import AdmContext, ClusterAdm, Phase
 from kubeoperator_tpu.adm.phases import (
     backup_phases,
@@ -21,7 +23,8 @@ from kubeoperator_tpu.adm.phases import (
 )
 
 __all__ = [
-    "AdmContext", "ClusterAdm", "Phase",
+    "AdmContext", "ClusterAdm", "Phase", "SchedulerConfig",
+    "scheduler_wiring",
     "create_phases", "upgrade_phases", "scale_up_phases", "scale_down_phases",
     "backup_phases", "restore_phases", "reset_phases", "cert_renew_phases",
     "encryption_rotate_phases", "etcd_maintenance_phases",

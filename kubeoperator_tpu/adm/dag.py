@@ -1,0 +1,315 @@
+"""Phase dependency DAG + the bounded-concurrency scheduler behind it.
+
+`Phase.after` (adm/engine.py) turns a phase family from an ordered list
+into a dependency DAG: an edge `after=("pki",)` means the phase may not
+start until `pki` has landed OK. The engine keeps its serial loop for
+families that declare no edges (every non-create verb, until their DAGs
+are declared) and for `scheduler.max_concurrent_phases=1`; for everything
+else `ClusterAdm.run` hands the family to `DagScheduler`, which launches
+ready phases onto a bounded thread pool in deterministic (declaration)
+order.
+
+Contract (validated here at run time and statically by analyzer rule
+KO-X011):
+
+  * every `after` edge resolves to a phase declared in the SAME family;
+  * every edge points BACKWARD — a phase depends only on earlier-declared
+    phases, so declaration order is always a valid topological order and
+    the serial fallback executes the exact same graph;
+  * names are unique, which (with the backward-edge rule) makes the
+    ready-order a pure function of the declaration order: deterministic,
+    whatever the thread interleaving did to completion timing.
+
+Disabled phases (Phase.enabled false for this context) are spliced out of
+the graph: an edge through a disabled phase is rewired to that phase's own
+dependencies (an external-LB create drops `lb`, so `kube-master` falls
+through to `lb`'s own `base` edge).
+
+Failure semantics mirror the serial engine per ISSUE 7: a phase failure
+(after its own in-phase retry budget is spent — `RetryPolicy` lives one
+level down, in `_run_phase`) stops NEW launches but never cancels a
+healthy sibling branch already running; when the pool drains, the
+first-declared failure is re-raised. A BaseException (chaos
+ControllerDeath) is re-raised with priority once in-flight siblings
+settle — the engine cannot SIGKILL a sibling thread, so "settle" is the
+closest honest analogue of a crash; the dying phase's condition stays
+Running, which is exactly the crash evidence the boot reconciler sweeps.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Callable
+
+from kubeoperator_tpu.utils.errors import ValidationError
+from kubeoperator_tpu.utils.logging import get_logger
+
+log = get_logger("adm.dag")
+
+
+@dataclass(frozen=True)
+class SchedulerConfig:
+    """The `scheduler.*` config block (utils/config.py DEFAULTS)."""
+
+    # phases running at once per operation; 1 = the historical serial loop
+    max_concurrent_phases: int = 1
+    # task-output lines buffered per sink flush (1 = commit every line,
+    # the pre-DAG behavior; the batch is what keeps the log store off the
+    # create path's critical path — docs/scheduler.md)
+    log_flush_lines: int = 64
+
+    @classmethod
+    def from_config(cls, config, section: str = "scheduler") -> "SchedulerConfig":
+        base = cls()
+        return cls(
+            max_concurrent_phases=int(config.get(
+                f"{section}.max_concurrent_phases",
+                base.max_concurrent_phases)),
+            log_flush_lines=int(config.get(
+                f"{section}.log_flush_lines", base.log_flush_lines)),
+        )
+
+
+def scheduler_wiring(config) -> "SchedulerConfig":
+    """The ONE place the `scheduler.*` block becomes the SchedulerConfig
+    every phase-running service hands its ClusterAdm — the concurrency
+    posture cannot drift between entry points (same pattern as
+    resilience.retry_wiring)."""
+    return SchedulerConfig.from_config(config)
+
+
+# ---------------------------------------------------------------- validate --
+def validate_family(phases) -> list[str]:
+    """Contract violations for one phase family (empty list = valid).
+
+    Returns human-readable messages instead of raising so analyzer rule
+    KO-X011 can turn each into a Finding; `build_edges` raises on the
+    same set."""
+    problems: list[str] = []
+    seen: dict[str, int] = {}
+    for i, p in enumerate(phases):
+        if p.name in seen:
+            problems.append(
+                f"phase {p.name!r} is declared twice (positions "
+                f"{seen[p.name]} and {i}) — duplicate names make the "
+                f"ready-order ambiguous")
+        else:
+            seen[p.name] = i
+    for i, p in enumerate(phases):
+        for dep in p.after:
+            if dep == p.name:
+                problems.append(f"phase {p.name!r} depends on itself")
+            elif dep not in seen:
+                problems.append(
+                    f"phase {p.name!r} has after-edge to {dep!r}, which is "
+                    f"not declared in this family")
+            elif seen[dep] > i:
+                # backward-edges-only is the determinism AND acyclicity
+                # guarantee: declaration order stays a topological order,
+                # so the serial fallback and the DAG run the same graph
+                problems.append(
+                    f"phase {p.name!r} depends on later-declared {dep!r} — "
+                    f"edges must point backward so declaration order "
+                    f"remains a valid serial schedule")
+    return problems
+
+
+def build_edges(phases) -> dict[str, set[str]]:
+    """Effective dependency sets for the ACTIVE phases of a family.
+
+    `phases` is the enabled subset in declaration order; edges to phases
+    missing from it (disabled for this context) are rewired transitively
+    to the missing phase's own dependencies — callers pass the FULL family
+    via each Phase's declared `after`, and disabled splicing happens here
+    against the active name set. Raises ValidationError on a family that
+    breaks the DAG contract."""
+    problems = validate_family(phases)
+    if problems:
+        raise ValidationError(
+            "phase family breaks the DAG contract (KO-X011): "
+            + "; ".join(problems))
+    return {p.name: set(p.after) for p in phases}
+
+
+def project_edges(family, active_names: set[str]) -> dict[str, set[str]]:
+    """Dependency sets restricted to `active_names`, splicing disabled
+    phases out transitively. `family` is the FULL declared phase list (the
+    splice needs the disabled phases' own edges)."""
+    problems = validate_family(family)
+    if problems:
+        raise ValidationError(
+            "phase family breaks the DAG contract (KO-X011): "
+            + "; ".join(problems))
+    declared = {p.name: tuple(p.after) for p in family}
+
+    def resolve(dep: str, seen: frozenset) -> set[str]:
+        if dep in active_names:
+            return {dep}
+        out: set[str] = set()
+        for d in declared.get(dep, ()):
+            if d not in seen:   # backward edges make cycles impossible;
+                out |= resolve(d, seen | {dep})   # belt-and-braces anyway
+        return out
+
+    edges: dict[str, set[str]] = {}
+    for p in family:
+        if p.name not in active_names:
+            continue
+        deps: set[str] = set()
+        for dep in declared[p.name]:
+            deps |= resolve(dep, frozenset({p.name}))
+        edges[p.name] = deps
+    return edges
+
+
+def _finish_times(durations: dict[str, float],
+                  edges: dict[str, set[str]]) -> dict[str, float]:
+    """Earliest-possible finish time per phase at measured durations:
+    own duration plus the latest dependency finish (dependencies without
+    a measured duration contribute nothing — they ran in another context
+    or not at all)."""
+    memo: dict[str, float] = {}
+
+    def finish(name: str) -> float:
+        if name not in memo:
+            memo[name] = durations.get(name, 0.0) + max(
+                (finish(d) for d in edges.get(name, ()) if d in durations),
+                default=0.0)
+        return memo[name]
+
+    for name in durations:
+        finish(name)
+    return memo
+
+
+def critical_lower_bound(durations: dict[str, float],
+                         edges: dict[str, set[str]]) -> float:
+    """Longest dependency chain through the DAG using measured per-phase
+    durations — the wall-clock floor no scheduler can beat without
+    changing the graph. `koctl trace --critical-path` quotes remaining
+    headroom against this."""
+    return max(_finish_times(durations, edges).values(), default=0.0)
+
+
+def binding_chain(durations: dict[str, float],
+                  edges: dict[str, set[str]]) -> list[str]:
+    """The argmax dependency chain behind `critical_lower_bound`, in
+    execution order — the phases an operator must shorten (or re-edge)
+    to lower the DAG floor itself."""
+    if not durations:
+        return []
+    memo = _finish_times(durations, edges)
+    chain = [max(sorted(durations), key=memo.__getitem__)]
+    while True:
+        deps = [d for d in edges.get(chain[-1], ()) if d in durations]
+        if not deps:
+            break
+        chain.append(max(sorted(deps), key=memo.__getitem__))
+    chain.reverse()
+    return chain
+
+
+# ---------------------------------------------------------------- schedule --
+class DagScheduler:
+    """Runs one phase family's active DAG on a bounded worker pool.
+
+    The coordinator thread owns all scheduling state under one condition
+    variable; workers only run `run_phase` and report back. Launch order
+    among simultaneously-ready phases is declaration order — the
+    deterministic ready-order the KO-X011 contract promises."""
+
+    def __init__(self, phases, edges: dict[str, set[str]],
+                 max_concurrent: int,
+                 on_frontier: Callable[[dict], None] | None = None) -> None:
+        self.phases = list(phases)
+        self.edges = edges
+        self.max_concurrent = max(int(max_concurrent), 1)
+        self.on_frontier = on_frontier or (lambda frontier: None)
+        self._order = {p.name: i for i, p in enumerate(self.phases)}
+
+    def run(self, run_phase: Callable, completed: set[str]) -> None:
+        """Execute every phase not already in `completed` (resume skips
+        OK conditions exactly like the serial loop). `run_phase(phase)`
+        raises PhaseError when the phase halts after its retry budget."""
+        cv = threading.Condition()
+        done: set[str] = set(completed)
+        running: set[str] = set()
+        pending = [p for p in self.phases if p.name not in done]
+        failures: list[tuple[int, BaseException]] = []
+        failed_names: set[str] = set()
+        fatal: list[BaseException] = []
+
+        def worker(phase) -> None:
+            try:
+                run_phase(phase)
+            except Exception as e:
+                with cv:
+                    failures.append((self._order[phase.name], e))
+                    failed_names.add(phase.name)
+                    running.discard(phase.name)
+                    cv.notify_all()
+                return
+            except BaseException as e:   # KO-P009: waived — ControllerDeath
+                # is transported to the coordinating thread, which re-raises
+                # it below with crash semantics intact (condition left
+                # Running, journal op left open)
+                with cv:
+                    fatal.append(e)
+                    running.discard(phase.name)
+                    cv.notify_all()
+                return
+            with cv:
+                done.add(phase.name)
+                running.discard(phase.name)
+                cv.notify_all()
+
+        last_frontier: dict | None = None
+        with cv:
+            while True:
+                halted = bool(failures or fatal)
+                if not halted:
+                    ready = [
+                        p for p in pending
+                        if self.edges.get(p.name, set()) <= done
+                    ]
+                    for p in ready:
+                        if len(running) >= self.max_concurrent:
+                            break
+                        pending.remove(p)
+                        running.add(p.name)
+                        threading.Thread(
+                            target=worker, args=(p,), daemon=True,
+                            name=f"adm-phase-{p.name}",
+                        ).start()
+                # the durable resume frontier: what is in flight plus what
+                # the DAG still owes (never-launched AND failed nodes — a
+                # retry re-enters both) — persisted (journal op vars) on
+                # every change, so an interrupted op quotes the exact node
+                # set a retry will re-enter. Suppressed once a fatal
+                # (ControllerDeath) landed: a dead controller does no
+                # post-crash bookkeeping, so the pre-crash frontier with
+                # the dying phase still listed as running IS the record.
+                frontier = {
+                    "running": sorted(running),
+                    "pending": sorted(
+                        {p.name for p in pending} | failed_names),
+                }
+                if frontier != last_frontier and not fatal:
+                    last_frontier = frontier
+                    self.on_frontier(frontier)
+                if not running and (halted or not pending):
+                    break
+                if not halted and not running and pending:
+                    # unreachable after validate_family; defensive so a
+                    # regression deadlocks loudly instead of silently
+                    raise ValidationError(
+                        "phase DAG wedged: no phase ready, none running, "
+                        + ", ".join(p.name for p in pending) + " pending")
+                cv.wait()
+
+        if fatal:
+            raise fatal[0]
+        if failures:
+            failures.sort(key=lambda pair: pair[0])
+            raise failures[0][1]

@@ -10,10 +10,12 @@ condition rows for free (SURVEY.md §5.1).
 
 from __future__ import annotations
 
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Callable
 
+from kubeoperator_tpu.adm.dag import DagScheduler, SchedulerConfig, project_edges
 from kubeoperator_tpu.executor.base import (
     Executor,
     FailureKind,
@@ -71,7 +73,13 @@ def platform_vars_from_config(config) -> dict:
 
 @dataclass(frozen=True)
 class Phase:
-    """One ordered step of an operation."""
+    """One step of an operation.
+
+    `after` turns the family from an ordered list into a dependency DAG
+    (adm/dag.py, analyzer rule KO-X011): names of EARLIER-declared phases
+    in the same family this one must wait for. A family with no edges
+    keeps the historical strictly-serial schedule; declaration order is
+    always a valid serial schedule either way (edges point backward)."""
 
     name: str                         # condition name, e.g. "etcd"
     playbook: str                     # content playbook file
@@ -79,6 +87,7 @@ class Phase:
     tags: tuple[str, ...] = ()
     limit_new_nodes: bool = False     # restrict to the joining nodes (scale-up)
     post: Callable[["AdmContext", TaskResult, list[str]], None] | None = None
+    after: tuple[str, ...] = ()       # DAG edges (adm/dag.py)
 
 
 @dataclass
@@ -94,11 +103,21 @@ class AdmContext:
     extra_vars: dict = field(default_factory=dict)
     # sinks wired by the service layer
     log_sink: Callable[[str, str], None] = lambda task_id, line: None
+    # batched form of log_sink (one store transaction per chunk instead of
+    # per line — the create path's dominant IO cost); when left None the
+    # engine falls back to per-line log_sink calls
+    log_sink_many: Callable[[str, list], None] | None = None
     save_cluster: Callable[[Cluster], None] = lambda cluster: None
     # operation-journal progress hook (resilience/journal.py attach): the
     # engine reports every phase transition (name, Running|OK|Failed) so
-    # the durable op row always knows how far the operation got
+    # the durable op row always knows how far the operation got. Under a
+    # concurrent DAG run the Running reports carry the deterministic
+    # composite label of everything in flight ("base+pki")
     on_phase: Callable[[str, str], None] = lambda name, status: None
+    # DAG resume frontier ({"running": [...], "pending": [...]}) persisted
+    # into the journal op's vars on every launch wave, so an interrupted
+    # concurrent create says exactly which nodes were in flight
+    on_frontier: Callable[[dict], None] = lambda frontier: None
     # span producer for this operation (journal.attach wires the real
     # Tracer; the default NullTracer keeps untraced runs at zero overhead)
     tracer: object = field(default_factory=NullTracer)
@@ -119,6 +138,9 @@ class AdmContext:
             extra_vars=extra_vars or {},
             log_sink=lambda task_id, line: repos.task_logs.append(
                 cluster.id, task_id, [line]
+            ),
+            log_sink_many=lambda task_id, lines: repos.task_logs.append(
+                cluster.id, task_id, lines
             ),
             save_cluster=lambda c: repos.clusters.save(c),
         )
@@ -191,8 +213,62 @@ class AdmContext:
         return ev
 
 
+class _CompositeReporter:
+    """on_phase wrapper for concurrent DAG runs: Running reports carry the
+    deterministic composite label of everything in flight (sorted,
+    '+'-joined — "base+pki"), terminal reports carry the finishing phase's
+    own name. The journal op row therefore always names the full in-flight
+    set, satisfying the KO-P007 discipline's "the durable record knows
+    what was running" intent under concurrency."""
+
+    def __init__(self, on_phase: Callable[[str, str], None]) -> None:
+        self._on_phase = on_phase
+        self._running: set[str] = set()
+        self._lock = threading.Lock()
+
+    def __call__(self, name: str, status_value: str) -> None:
+        with self._lock:
+            if status_value == ConditionStatus.RUNNING.value:
+                self._running.add(name)
+                label = "+".join(sorted(self._running))
+            else:
+                self._running.discard(name)
+                label = name
+            self._on_phase(label, status_value)
+
+
+class _LogBatcher:
+    """Chunked task-output sink: buffers streamed lines and lands them in
+    batched store transactions (`scheduler.log_flush_lines` per commit)
+    instead of one per line — the per-line commits were the create path's
+    single largest cost. Falls back to per-line log_sink when the context
+    wires no batch sink (hand-built AdmContexts in tests)."""
+
+    def __init__(self, ctx: "AdmContext", task_id: str,
+                 flush_lines: int) -> None:
+        self._many = ctx.log_sink_many
+        self._single = ctx.log_sink
+        self._task_id = task_id
+        self._n = max(int(flush_lines), 1)
+        self._buf: list[str] = []
+
+    def add(self, lines: list) -> None:
+        if self._many is None:
+            for line in lines:
+                self._single(self._task_id, line)
+            return
+        self._buf.extend(lines)
+        if len(self._buf) >= self._n:
+            self.flush()
+
+    def flush(self) -> None:
+        if self._many is not None and self._buf:
+            batch, self._buf = self._buf, []
+            self._many(self._task_id, batch)
+
+
 class ClusterAdm:
-    """Runs an ordered phase list against a context, resumably and — for
+    """Runs a phase family against a context, resumably and — for
     TRANSIENT failures — self-healingly.
 
     `policy` governs in-phase auto-retry: a failed attempt classified
@@ -201,7 +277,14 @@ class ClusterAdm:
     phase halts; PERMANENT failures (genuinely failed tasks, post-hook
     vetoes) halt immediately for operator attention. `rng` (an explicitly
     seeded random.Random, or None) feeds backoff jitter; `sleep` is
-    injectable so tests run the retry loop at full speed."""
+    injectable so tests run the retry loop at full speed.
+
+    `scheduler` (the `scheduler.*` config block) governs HOW the family
+    executes: families that declare `Phase.after` edges run as a
+    dependency DAG on a bounded worker pool when `max_concurrent_phases`
+    allows; edge-less families — and everything when
+    `max_concurrent_phases=1`, the direct-construction default — keep the
+    historical strictly-serial loop (docs/scheduler.md)."""
 
     def __init__(
         self,
@@ -209,22 +292,26 @@ class ClusterAdm:
         policy: RetryPolicy | None = None,
         rng=None,
         sleep: Callable[[float], None] = time.sleep,
+        scheduler: SchedulerConfig | None = None,
     ) -> None:
         self.executor = executor
         self.policy = policy or RetryPolicy()
         self.rng = rng
         self._sleep = sleep
+        self.scheduler = scheduler or SchedulerConfig()
 
     def run(self, ctx: AdmContext, phases: list[Phase]) -> None:
-        """Execute phases in order; on failure raise PhaseError leaving the
+        """Execute the family; on failure raise PhaseError leaving the
         failed condition in place so the next run re-enters there.
 
         Resume semantics: if any of this operation's phases is unfinished
         (Unknown/Running/Failed), this is a retry — completed phases are
-        skipped and execution re-enters at the first unfinished one. If all
-        phases are OK (a *previous* run of this operation completed), the
-        conditions are reset and the operation runs fresh — so a second
-        scale-up or backup is never a silent no-op."""
+        skipped and execution re-enters at the unfinished frontier (the
+        first unfinished phase serially; every unfinished DAG node
+        concurrently). If all phases are OK (a *previous* run of this
+        operation completed), the conditions are reset and the operation
+        runs fresh — so a second scale-up or backup is never a silent
+        no-op."""
         status = ctx.cluster.status
         active = [p for p in phases if p.enabled(ctx)]
         names = [p.name for p in active]
@@ -243,6 +330,11 @@ class ClusterAdm:
                 status.upsert_condition(p.name, ConditionStatus.UNKNOWN)
         ctx.save_cluster(ctx.cluster)
 
+        if self.scheduler.max_concurrent_phases > 1 \
+                and any(p.after for p in phases):
+            self._run_dag(ctx, phases, active)
+            return
+
         for p in active:
             cond = status.condition(p.name)
             if cond is not None and cond.status == ConditionStatus.OK.value:
@@ -251,7 +343,70 @@ class ClusterAdm:
                 continue
             self._run_phase(ctx, p)
 
-    def _run_phase(self, ctx: AdmContext, phase: Phase) -> None:
+    def _run_dag(self, ctx: AdmContext, family: list[Phase],
+                 active: list[Phase]) -> None:
+        """Concurrent path: schedule the active phases' dependency DAG on
+        a bounded pool. Same observable contract as the serial loop —
+        conditions, retries, spans (phase spans become siblings under the
+        operation root), journal progress (composite labels), PhaseError
+        on halt — plus the resume frontier persisted via ctx.on_frontier."""
+        from kubeoperator_tpu.observability import bind_trace
+
+        status = ctx.cluster.status
+        completed = set()
+        for p in active:
+            cond = status.condition(p.name)
+            if cond is not None and cond.status == ConditionStatus.OK.value:
+                log.info("cluster %s: phase %s already OK, skipping",
+                         ctx.cluster.name, p.name)
+                completed.add(p.name)
+        edges = project_edges(family, {p.name for p in active})
+        # ONE lock per operation serializes status mutation + persist +
+        # journal progress across this run's phase threads; phases on
+        # OTHER clusters (other ctx) share nothing and stay unserialized
+        lock = threading.Lock()
+        report = _CompositeReporter(ctx.on_phase)
+        tracer = ctx.tracer
+
+        def run_one(phase: Phase) -> None:
+            # phase worker threads are fresh: re-bind the log trace
+            # context the service bound on the operation's own thread
+            if getattr(tracer, "enabled", False):
+                bind_trace(trace_id=tracer.trace_id or None,
+                           op_id=getattr(tracer, "op_id", None),
+                           cluster=ctx.cluster.name)
+            self._run_phase(ctx, phase, lock=lock, report=report)
+
+        def record_frontier(frontier: dict) -> None:
+            # under the SAME per-operation lock as the workers' journal
+            # progress writes: frontier saves and phase/status saves
+            # mutate one Operation row, and an unserialized coordinator
+            # write could persist a torn phase/phase_status pair
+            with lock:
+                ctx.on_frontier(frontier)
+
+        try:
+            DagScheduler(
+                active, edges, self.scheduler.max_concurrent_phases,
+                on_frontier=record_frontier,
+            ).run(run_one, completed)
+        except PhaseError as e:
+            # siblings have settled (the scheduler drains before raising):
+            # re-stamp the journal's phase pointer at the halting phase so
+            # the durable record names the failure deterministically, not
+            # whichever healthy sibling happened to finish last
+            report(e.phase, ConditionStatus.FAILED.value)
+            raise
+
+    def _run_phase(self, ctx: AdmContext, phase: Phase,
+                   lock: threading.Lock | None = None,
+                   report: Callable[[str, str], None] | None = None) -> None:
+        # `lock` serializes condition mutation + save + journal progress
+        # against sibling DAG phases of the SAME operation (serial runs
+        # pass none and pay one uncontended lock); `report` lets the DAG
+        # path substitute composite-label progress reporting
+        guard = lock if lock is not None else threading.Lock()
+        report = report if report is not None else ctx.on_phase
         cluster = ctx.cluster
         status = cluster.status
         log.info("cluster %s: phase %s starting (%s)",
@@ -279,9 +434,11 @@ class ClusterAdm:
 
         while True:
             attempts += 1
-            stamp(status.upsert_condition(phase.name, ConditionStatus.RUNNING))
-            ctx.save_cluster(cluster)
-            ctx.on_phase(phase.name, ConditionStatus.RUNNING.value)
+            with guard:
+                stamp(status.upsert_condition(
+                    phase.name, ConditionStatus.RUNNING))
+                ctx.save_cluster(cluster)
+                report(phase.name, ConditionStatus.RUNNING.value)
             # retries are SIBLING attempt spans under the phase, each
             # carrying its FailureKind — the waterfall shows the retry
             # storm, not just the final outcome
@@ -303,14 +460,18 @@ class ClusterAdm:
                     # post-hooks parse phase output (e.g. smoke-test GB/s)
                     # and may veto success by raising PhaseError — a
                     # deliberate judgment about output, never retried.
-                    phase.post(ctx, result, lines)
+                    # Under the guard: hooks mutate shared cluster status
+                    # (smoke history) a sibling phase may be persisting.
+                    with guard:
+                        phase.post(ctx, result, lines)
             except PhaseError as e:
-                cond = status.upsert_condition(
-                    phase.name, ConditionStatus.FAILED, e.message)
-                stamp(cond)
-                cond.classification = FailureKind.PERMANENT.value
-                ctx.save_cluster(cluster)
-                ctx.on_phase(phase.name, ConditionStatus.FAILED.value)
+                with guard:
+                    cond = status.upsert_condition(
+                        phase.name, ConditionStatus.FAILED, e.message)
+                    stamp(cond)
+                    cond.classification = FailureKind.PERMANENT.value
+                    ctx.save_cluster(cluster)
+                    report(phase.name, ConditionStatus.FAILED.value)
                 tracer.end_span(attempt_span, SpanStatus.FAILED, {
                     "classification": FailureKind.PERMANENT.value,
                     "message": e.message})
@@ -321,12 +482,13 @@ class ClusterAdm:
                 # Anything else (post-hook bug, runner crash) must still
                 # land the condition in Failed — a condition stuck at
                 # Running would wedge resumability forever.
-                cond = status.upsert_condition(
-                    phase.name, ConditionStatus.FAILED, str(e))
-                stamp(cond)
-                cond.classification = FailureKind.PERMANENT.value
-                ctx.save_cluster(cluster)
-                ctx.on_phase(phase.name, ConditionStatus.FAILED.value)
+                with guard:
+                    cond = status.upsert_condition(
+                        phase.name, ConditionStatus.FAILED, str(e))
+                    stamp(cond)
+                    cond.classification = FailureKind.PERMANENT.value
+                    ctx.save_cluster(cluster)
+                    report(phase.name, ConditionStatus.FAILED.value)
                 tracer.end_span(attempt_span, SpanStatus.FAILED, {
                     "classification": FailureKind.PERMANENT.value,
                     "message": str(e)})
@@ -335,11 +497,13 @@ class ClusterAdm:
                 raise PhaseError(phase.name, str(e)) from e
 
             if result.ok:
-                cond = status.upsert_condition(phase.name, ConditionStatus.OK)
-                stamp(cond)
-                cond.classification = ""
-                ctx.save_cluster(cluster)
-                ctx.on_phase(phase.name, ConditionStatus.OK.value)
+                with guard:
+                    cond = status.upsert_condition(
+                        phase.name, ConditionStatus.OK)
+                    stamp(cond)
+                    cond.classification = ""
+                    ctx.save_cluster(cluster)
+                    report(phase.name, ConditionStatus.OK.value)
                 tracer.end_span(attempt_span, SpanStatus.OK)
                 tracer.end_span(phase_span, SpanStatus.OK,
                                 {"attempts": attempts})
@@ -363,12 +527,13 @@ class ClusterAdm:
                 # no room left for another attempt inside the phase deadline
                 retryable = False
             if not retryable:
-                cond = status.upsert_condition(
-                    phase.name, ConditionStatus.FAILED, result.message)
-                stamp(cond)
-                cond.classification = classification
-                ctx.save_cluster(cluster)
-                ctx.on_phase(phase.name, ConditionStatus.FAILED.value)
+                with guard:
+                    cond = status.upsert_condition(
+                        phase.name, ConditionStatus.FAILED, result.message)
+                    stamp(cond)
+                    cond.classification = classification
+                    ctx.save_cluster(cluster)
+                    report(phase.name, ConditionStatus.FAILED.value)
                 tracer.end_span(phase_span, SpanStatus.FAILED, {
                     "attempts": attempts, "classification": classification})
                 raise PhaseError(
@@ -378,15 +543,16 @@ class ClusterAdm:
                 )
 
             total_backoff += delay
-            cond = status.upsert_condition(
-                phase.name, ConditionStatus.RUNNING,
-                f"attempt {attempts}/{self.policy.max_attempts} failed "
-                f"({classification.lower()}: {result.message}); retrying "
-                f"in {delay:.1f}s",
-            )
-            stamp(cond)
-            cond.classification = classification
-            ctx.save_cluster(cluster)
+            with guard:
+                cond = status.upsert_condition(
+                    phase.name, ConditionStatus.RUNNING,
+                    f"attempt {attempts}/{self.policy.max_attempts} failed "
+                    f"({classification.lower()}: {result.message}); retrying "
+                    f"in {delay:.1f}s",
+                )
+                stamp(cond)
+                cond.classification = classification
+                ctx.save_cluster(cluster)
             log.warning(
                 "cluster %s: phase %s attempt %d/%d failed (%s: %s); "
                 "retrying in %.1fs", cluster.name, phase.name, attempts,
@@ -449,18 +615,23 @@ class ClusterAdm:
         except ExecutorError as e:
             return transient_result("", f"executor unavailable: {e.message}"), []
         lines: list[str] = []
+        # pipelined sink: the stream is consumed in chunks and landed in
+        # batched store transactions, so a slow log store never barriers
+        # line-by-line on the create path (docs/scheduler.md)
+        sink = _LogBatcher(ctx, task_id, self.scheduler.log_flush_lines)
         try:
             watch_kw = {}
             if deadline is not None:
                 watch_kw["timeout_s"] = max(deadline - now_ts(), 0.001)
-            for line in self.executor.watch(task_id, **watch_kw):
-                lines.append(line)
-                ctx.log_sink(task_id, line)
+            for chunk in self.executor.watch_chunks(task_id, **watch_kw):
+                lines.extend(chunk)
+                sink.add(chunk)
             result = self.executor.result(task_id)
         except ExecutorError as e:
             # deadline hit OR the stream/boundary broke mid-task: reap the
             # task so nothing keeps running behind the deploy's back, then
             # hand the loop a TRANSIENT failure to classify/retry
+            sink.flush()   # everything streamed so far is honest output
             if deadline is not None and now_ts() >= deadline:
                 reason = (f"phase {phase.name} exceeded its "
                           f"{self.policy.phase_deadline_s:g}s deadline")
@@ -477,11 +648,11 @@ class ClusterAdm:
                 # fails, retry the attempt rather than judge partial lines.
                 try:
                     replay = list(self.executor.watch(task_id, timeout_s=30.0))
-                    for line in replay[len(lines):]:   # sink only the tail
-                        ctx.log_sink(task_id, line)
+                    sink.add(replay[len(lines):])   # sink only the tail
                     lines = replay
                 except ExecutorError:
                     result = transient_result(task_id, reason)
             if not result.ok:
                 ctx.log_sink(task_id, f"CANCELLED: {reason}")
+        sink.flush()
         return result, lines

@@ -258,21 +258,53 @@ def restore_verify_post(
 
 
 def create_phases() -> list[Phase]:
+    """The one family with a declared dependency DAG (adm/dag.py,
+    docs/scheduler.md): `after` edges encode the real data dependencies
+    the span critical path exposed, so the scheduler can overlap
+    prep-phase branches the serial list used to barrier on —
+
+      * `pki` (cert generation on the deploy host) and the `pki`→`etcd`
+        chain need no node prep, so they overlap `base`→`runtime`;
+      * `lb` (haproxy/keepalived statics) needs only `base`;
+      * `kube-master` is the join point: runtime + etcd + certs (+ lb
+        when enabled — disabled phases splice out transitively);
+      * `tpu-runtime` needs the CNI (`network`) but NOT `post` addons,
+        so the TPU branch overlaps post/addons;
+      * `tpu-smoke-test` gates on `tpu-runtime` alone (the device plugin
+        + JobSet land there).
+
+    Declaration order remains a valid serial schedule (edges point
+    backward, enforced by KO-X011) — `scheduler.max_concurrent_phases=1`
+    runs exactly the historical order."""
     return [
         Phase("base", "01-base.yml"),
-        Phase("runtime", "02-runtime.yml"),
+        Phase("runtime", "02-runtime.yml", after=("base",)),
         Phase("pki", "03-pki.yml"),
-        Phase("etcd", "05-etcd.yml"),
+        Phase("etcd", "05-etcd.yml", after=("pki",)),
         Phase("lb", "06-lb.yml",
-              enabled=lambda ctx: ctx.cluster.spec.lb_mode == "internal"),
-        Phase("kube-master", "07-kube-master.yml"),
-        Phase("kube-worker", "08-kube-worker.yml"),
-        Phase("network", "09-network.yml"),
-        Phase("post", "10-post.yml"),
-        Phase("tpu-runtime", "16-tpu-runtime.yml", enabled=_tpu),
+              enabled=lambda ctx: ctx.cluster.spec.lb_mode == "internal",
+              after=("base",)),
+        Phase("kube-master", "07-kube-master.yml",
+              after=("runtime", "etcd", "lb")),
+        Phase("kube-worker", "08-kube-worker.yml", after=("kube-master",)),
+        Phase("network", "09-network.yml", after=("kube-worker",)),
+        Phase("post", "10-post.yml", after=("network",)),
+        Phase("tpu-runtime", "16-tpu-runtime.yml", enabled=_tpu,
+              after=("network",)),
         Phase("tpu-smoke-test", "17-tpu-smoke-test.yml", enabled=_tpu,
-              post=smoke_post),
+              post=smoke_post, after=("tpu-runtime",)),
     ]
+
+
+def family_for_kind(kind: str) -> list[Phase] | None:
+    """The phase family a journaled operation kind runs, for consumers
+    reasoning about a FINISHED op's DAG from its kind alone (`koctl
+    trace --critical-path` quotes the DAG lower bound against it). None
+    for kinds whose family declares no `after` edges yet — their floor
+    is the serial sum. Grow this map as more families gain DAGs."""
+    if kind in ("create", "slice-scale"):
+        return create_phases()
+    return None
 
 
 def upgrade_phases() -> list[Phase]:

@@ -587,6 +587,41 @@ def check_version_vars(ctx: AnalysisContext, supplied: frozenset | None = None
     return findings
 
 
+# ---------------------------------------------------------------- KO-X011 ---
+def _default_phase_families() -> dict:
+    """{family name: [Phase, ...]} for every adm phase family — the same
+    `*_phases` enumeration KO-X003 uses for playbook references."""
+    import kubeoperator_tpu.adm.phases as phases_mod
+
+    return {
+        name: getattr(phases_mod, name)()
+        for name in dir(phases_mod)
+        if name.endswith("_phases") and not name.startswith("_")
+    }
+
+
+def check_phase_dags(ctx: AnalysisContext, families: dict | None = None
+                     ) -> list:
+    """KO-X011 — the DAG contract the scheduler (adm/dag.py) relies on,
+    enforced before a bad edge can deadlock or misorder a live create:
+    every `Phase.after` edge resolves to an EARLIER-declared phase in the
+    same family (backward edges ⇒ acyclic ⇒ declaration order stays a
+    valid serial schedule ⇒ ready-order is a deterministic function of
+    declaration order), and names are unique. `families` is injectable so
+    tests can aim the rule at fixture families."""
+    from kubeoperator_tpu.adm.dag import validate_family
+
+    findings: list = []
+    families = (_default_phase_families() if families is None else families)
+    for fam_name in sorted(families):
+        for problem in validate_family(families[fam_name]):
+            findings.append(Finding(
+                "KO-X011", "kubeoperator_tpu/adm/phases.py", 0,
+                f"phase family {fam_name}: {problem}",
+            ))
+    return findings
+
+
 ARTIFACT_RULES = {
     "KO-X001": check_role_resolution,
     "KO-X002": check_file_resolution,
@@ -596,4 +631,5 @@ ARTIFACT_RULES = {
     "KO-X006": check_migrations,
     "KO-X007": check_manifest_refs,
     "KO-X008": check_version_vars,
+    "KO-X011": check_phase_dags,
 }

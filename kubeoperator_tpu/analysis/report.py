@@ -84,6 +84,14 @@ RULES: dict[str, RuleSpec] = {
             "extra-vars contract (COMPONENT_VERSIONS pins, TPU topology "
             "vars, k8s_version) or carries an inline | default()",
         ),
+        RuleSpec(
+            "KO-X011", "dag-contract", "artifact", ERROR,
+            "every adm phase family is a valid dependency DAG: each "
+            "Phase.after edge resolves to an earlier-declared phase in the "
+            "SAME family (backward edges make the graph acyclic and keep "
+            "declaration order a valid serial schedule), names are unique, "
+            "and the ready-order is therefore deterministic",
+        ),
         # ---- project-rule AST checks (astcheck.py) ----
         RuleSpec(
             "KO-P001", "repo-layering", "ast", ERROR,

@@ -774,8 +774,76 @@ def cmd_trace(client, args) -> int:
 
     print(f"cluster {args.name}  operation {data['kind']}/{op_id}  "
           f"trace {data.get('trace_id') or '-'}")
-    print(render_waterfall(tree))
+    if getattr(args, "critical_path", False):
+        _print_critical_path(tree, data.get("kind") or "")
+    else:
+        print(render_waterfall(tree))
     return 0 if data.get("status") != "Failed" else 1
+
+
+def _print_critical_path(tree: dict, kind: str = "") -> None:
+    """`koctl trace --critical-path`: just the chain an operator must
+    shorten to shorten the operation — each node with its self-time —
+    plus the theoretical DAG lower bound (the longest dependency chain
+    through the phase DAG at measured durations: the floor no scheduler
+    can beat without changing the graph) and the remaining headroom
+    against it, so perf work can quote both from one command."""
+    from kubeoperator_tpu.adm.dag import (
+        binding_chain,
+        critical_lower_bound,
+        project_edges,
+    )
+    from kubeoperator_tpu.adm.phases import family_for_kind
+    from kubeoperator_tpu.observability import critical_chain
+
+    chain = critical_chain(tree)
+    print(f"critical path (finished-last chain, {len(chain)} of "
+          f"{_count_nodes(tree)} spans):")
+    for node in chain:
+        dur = (f"{node['duration_s']:.3f}s"
+               if node.get("duration_s") is not None
+               else node.get("status") or "-")
+        self_s = (f"  self={node['self_s']:.3f}s"
+                  if node.get("self_s") is not None else "")
+        label = f"{node['kind']}:{node['name']}"
+        print(f"  {label:<40.40s} {dur:>9s}{self_s}")
+
+    # phase durations over the WHOLE tree (off-path branches count toward
+    # the bound: the longest chain may not be the one that finished last)
+    phases = [c for c in tree.get("children", [])
+              if c.get("kind") == "phase"]
+    durations = {c["name"]: c["duration_s"] or 0.0 for c in phases}
+    if not durations:
+        return
+    # the bound is quoted against the PHASE window (max finish − min
+    # start), not the operation total: provisioning and close-out have no
+    # DAG to schedule, so including them would overstate the headroom
+    starts = [c["started_at"] for c in phases if c.get("started_at")]
+    ends = [c["finished_at"] for c in phases if c.get("finished_at")]
+    window = (max(ends) - min(starts)) if starts and ends else 0.0
+    # the op's kind names the family it ran (phases.py); the subset check
+    # guards against a tree whose phase names drifted from today's family
+    family = family_for_kind(kind)
+    if family is not None and set(durations) <= {p.name for p in family}:
+        edges = project_edges(family, set(durations))
+        bound = critical_lower_bound(durations, edges)
+        chain_txt = "→".join(binding_chain(durations, edges))
+        label = f"theoretical DAG lower bound {bound:.3f}s ({chain_txt})"
+    else:
+        # family without a declared DAG: serial sum IS the floor
+        bound = sum(durations.values())
+        label = ("serial lower bound (no DAG declared for this family) "
+                 f"{bound:.3f}s")
+    line = label
+    if window:
+        headroom = max(window - bound, 0.0)
+        line += (f"; phase window {window:.3f}s; remaining headroom "
+                 f"{headroom:.3f}s ({headroom / window * 100:.0f}%)")
+    print(line)
+
+
+def _count_nodes(tree: dict) -> int:
+    return 1 + sum(_count_nodes(c) for c in tree.get("children", []))
 
 
 def cmd_watchdog(client, args) -> int:
@@ -1263,10 +1331,16 @@ def _chaos_soak_once(args, base_dir: str) -> dict:
             "seed": args.seed,
             "deploys": deploys,
             "all_ready": all(d["final_phase"] == "Ready" for d in deploys),
-            "injections": [
-                {"playbook": inj.playbook, "kind": inj.kind, "host": inj.host}
-                for inj in chaos.injections
-            ],
+            # sorted, not submission-ordered: per-key draws make the
+            # injection MULTISET a pure function of the seed, but under
+            # the phase-DAG scheduler the wall-clock append order is
+            # whatever the thread interleaving did — sorting is what lets
+            # --verify-determinism diff two passes bit-for-bit
+            "injections": sorted(
+                ({"playbook": inj.playbook, "kind": inj.kind,
+                  "host": inj.host} for inj in chaos.injections),
+                key=lambda d: (d["playbook"], d["kind"], d["host"]),
+            ),
             "injection_summary": chaos.injection_summary(),
             "retries_total": sum(
                 max(s["attempts"] - 1, 0)
@@ -1720,6 +1794,10 @@ def build_parser() -> argparse.ArgumentParser:
     trace_p.add_argument("--json", action="store_true",
                          help="emit the raw span tree instead of the "
                               "waterfall")
+    trace_p.add_argument("--critical-path", action="store_true",
+                         help="print only the critical path with per-node "
+                              "self-time, plus the theoretical DAG lower "
+                              "bound and remaining headroom")
 
     fleet_p = sub.add_parser(
         "fleet",

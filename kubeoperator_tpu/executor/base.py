@@ -350,6 +350,18 @@ class Executor(abc.ABC):
         """Yield output lines until the task finishes (kobe WatchResult).
         `None` means the configured per-task ceiling (`executor.
         task_timeout_s`, stamped onto the instance by build_services)."""
+        for chunk in self.watch_chunks(task_id, timeout_s):
+            yield from chunk
+
+    def watch_chunks(self, task_id: str,
+                     timeout_s: float | None = None) -> Iterator[list]:
+        """`watch` in its natural batch granularity: every wakeup yields
+        the list of lines that accumulated since the last one, so a
+        consumer persisting the stream (the adm engine's log sink) can
+        commit per chunk instead of per line. The dispatch stays
+        pipelined: the producing backend thread never waits on the
+        consumer, and a phase's tail output is drained in one yield
+        instead of line-by-line round-trips."""
         if timeout_s is None:
             timeout_s = self.task_timeout_s
         state = self._state(task_id)
@@ -365,7 +377,8 @@ class Executor(abc.ABC):
                 new_lines = state.lines[idx:]
                 idx = len(state.lines)
                 finished = state.done.is_set() and idx >= len(state.lines)
-            yield from new_lines
+            if new_lines:
+                yield new_lines
             if finished:
                 return
 

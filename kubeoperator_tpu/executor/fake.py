@@ -10,6 +10,7 @@ script "fail twice then succeed" to exercise resume/retry paths, and
 
 from __future__ import annotations
 
+import threading
 from collections import defaultdict
 from dataclasses import dataclass, field
 
@@ -43,6 +44,10 @@ class FakeExecutor(Executor):
         # against a different host subset must NOT inherit the create
         # flow's attempt count for the same playbook
         self._runs: dict[tuple, int] = defaultdict(int)
+        # concurrent DAG phases submit simultaneously: the run ledger
+        # (calls + attempt counters) mutates under one lock so recorded
+        # runs can never interleave into a torn count
+        self._ledger_lock = threading.Lock()
 
     def script(self, playbook: str, **kw) -> ScriptedOutcome:
         out = ScriptedOutcome(**kw)
@@ -51,15 +56,17 @@ class FakeExecutor(Executor):
 
     def runs_of(self, playbook: str, limit: str = "") -> int:
         """Attempt count for one (playbook, limit) execution stream."""
-        return self._runs[(playbook, limit)]
+        with self._ledger_lock:
+            return self._runs[(playbook, limit)]
 
     def _execute(self, spec: TaskSpec, state: _TaskState) -> None:
-        self.calls.append(spec)
         name = spec.playbook or f"adhoc:{spec.adhoc_module}"
-        outcome = self.outcomes.get(name, ScriptedOutcome())
         key = (name, spec.limit)
-        self._runs[key] += 1
-        attempt = self._runs[key]
+        with self._ledger_lock:
+            self.calls.append(spec)
+            self._runs[key] += 1
+            attempt = self._runs[key]
+        outcome = self.outcomes.get(name, ScriptedOutcome())
         success = outcome.success and attempt > outcome.fail_times
 
         state.emit(f"PLAY [{name}] " + "*" * 40)

@@ -215,6 +215,15 @@ class RunnerClient(Executor):
         except grpc.RpcError as e:
             raise ExecutorError(message=f"runner watch failed: {e}") from e
 
+    def watch_chunks(self, task_id: str,
+                     timeout_s: float | None = None) -> Iterator[list]:
+        """The tasks live in the runner process, so the base class's
+        registry-backed chunking doesn't apply; the WatchResult RPC is
+        already one message per line, which IS this stream's natural
+        chunk granularity."""
+        for line in self.watch(task_id, timeout_s):
+            yield [line]
+
     def result(self, task_id: str) -> TaskResult:
         try:
             d = self._result_rpc({"task_id": task_id})

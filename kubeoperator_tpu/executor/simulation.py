@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import functools
 import os
+import threading
 import time
 
 import jinja2
@@ -43,6 +44,56 @@ def _jinja_env() -> "jinja2.Environment":
 def _strict_jinja_env() -> "jinja2.Environment":
     return jinja2.Environment(undefined=jinja2.StrictUndefined)
 
+
+# compiled-template caches: content re-renders the same msg/when/loop
+# strings once per task per host per phase, and jinja compilation was a
+# visible slice of simulated-create wall-clock. lru_cache doubles as the
+# thread-safety story — concurrent DAG phases share compiled templates,
+# and jinja2 Template.render is itself thread-safe.
+@functools.lru_cache(maxsize=4096)
+def _compiled(source: str) -> "jinja2.Template":
+    return _jinja_env().from_string(source)
+
+
+@functools.lru_cache(maxsize=2048)
+def _compiled_when(expr: str) -> "jinja2.Template":
+    return _jinja_env().from_string("{% if " + expr + " %}1{% endif %}")
+
+
+@functools.lru_cache(maxsize=2048)
+def _compiled_expr(expr: str):
+    return _jinja_env().compile_expression(expr, undefined_to_none=False)
+
+
+@functools.lru_cache(maxsize=1024)
+def _compiled_strict(source: str) -> "jinja2.Template":
+    return _strict_jinja_env().from_string(source)
+
+
+# parsed-YAML file cache, keyed by path and validated by mtime/size on
+# every hit: playbooks and role task files are re-read for every phase of
+# every deploy, and a fleet-scale soak loads the same few dozen files
+# thousands of times. Entries are treated as IMMUTABLE by all consumers
+# (expansion copies task dicts before modifying them); the lock makes the
+# check-and-fill safe under concurrent DAG phase submission.
+_yaml_lock = threading.Lock()
+_yaml_cache: dict[str, tuple] = {}   # path -> (mtime_ns, size, parsed)
+
+
+def _load_yaml_cached(path: str):
+    st = os.stat(path)
+    key = (st.st_mtime_ns, st.st_size)
+    with _yaml_lock:
+        hit = _yaml_cache.get(path)
+        if hit is not None and hit[0] == key:
+            return hit[1]
+    with open(path, encoding="utf-8") as f:
+        parsed = yaml.safe_load(f)
+    with _yaml_lock:
+        _yaml_cache[path] = (key, parsed)
+    return parsed
+
+
 DEFAULT_PROJECT_DIR = os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "content"
 )
@@ -61,8 +112,7 @@ class SimulationExecutor(Executor):
         path = os.path.join(self.project_dir, "playbooks", name)
         if not os.path.exists(path):
             raise ExecutorError(message=f"playbook {name} not found in project dir")
-        with open(path, encoding="utf-8") as f:
-            plays = yaml.safe_load(f) or []
+        plays = _load_yaml_cached(path) or []
         if not isinstance(plays, list):
             raise ExecutorError(message=f"playbook {name} must be a list of plays")
         return plays
@@ -71,8 +121,7 @@ class SimulationExecutor(Executor):
         path = os.path.join(self.project_dir, "roles", role, "tasks", "main.yml")
         if not os.path.exists(path):
             return [{"name": f"{role} : (no tasks file)"}]
-        with open(path, encoding="utf-8") as f:
-            tasks = yaml.safe_load(f) or []
+        tasks = _load_yaml_cached(path) or []
         tasks = [t if isinstance(t, dict) else {"name": str(t)} for t in tasks]
         return self._expand_includes(tasks, os.path.dirname(path))
 
@@ -108,8 +157,7 @@ class SimulationExecutor(Executor):
                 raise ExecutorError(
                     message=f"include_tasks file {fname!r} not found in {base_dir}"
                 )
-            with open(path, encoding="utf-8") as f:
-                sub = yaml.safe_load(f) or []
+            sub = _load_yaml_cached(path) or []
             sub = [t if isinstance(t, dict) else {"name": str(t)} for t in sub]
             inc_when = task.get("when")
             inc_vars = task.get("vars") or {}
@@ -145,7 +193,7 @@ class SimulationExecutor(Executor):
         if not isinstance(module, dict) or "msg" not in module:
             return None
         try:
-            return _jinja_env().from_string(str(module["msg"])).render(**context)
+            return _compiled(str(module["msg"])).render(**context)
         except jinja2.TemplateError:
             return str(module["msg"])
 
@@ -164,9 +212,7 @@ class SimulationExecutor(Executor):
         conds = cond if isinstance(cond, list) else [cond]
         expr = " and ".join(f"({c})" for c in conds)
         try:
-            rendered = _jinja_env().from_string(
-                "{% if " + expr + " %}1{% endif %}"
-            ).render(**context)
+            rendered = _compiled_when(expr).render(**context)
         except jinja2.TemplateError as e:
             # unparseable condition: run the task (visible coverage) but
             # warn LOUDLY in the stream — a `when:` typo that passes
@@ -193,8 +239,7 @@ class SimulationExecutor(Executor):
             for item in raw:
                 if isinstance(item, str) and "{{" in item:
                     try:
-                        out.append(
-                            _jinja_env().from_string(item).render(**context))
+                        out.append(_compiled(item).render(**context))
                     except jinja2.TemplateError:
                         out.append(item)
                 else:
@@ -203,8 +248,7 @@ class SimulationExecutor(Executor):
         text = str(raw).strip()
         if text.startswith("{{") and text.endswith("}}"):
             try:
-                value = _jinja_env().compile_expression(
-                    text[2:-2], undefined_to_none=False)(**context)
+                value = _compiled_expr(text[2:-2])(**context)
             except Exception as e:
                 if warn is not None:
                     warn(f"[WARNING]: unresolvable loop: {raw!r} on task "
@@ -233,9 +277,7 @@ class SimulationExecutor(Executor):
             # StrictUndefined: a dest the simulation can't fully resolve
             # (loop `item`, registered vars) must be skipped, not written to
             # a half-rendered path
-            dest = _strict_jinja_env().from_string(
-                str(module["dest"])
-            ).render(**context)
+            dest = _compiled_strict(str(module["dest"])).render(**context)
             # only materialize absolute file dests (dir-shaped or relative
             # dests are not the platform-consumed kubeconfig contract)
             if not dest or dest.endswith("/") or not os.path.isabs(dest):
@@ -337,7 +379,7 @@ class SimulationExecutor(Executor):
                     for k, v in (task.get("vars") or {}).items():
                         if isinstance(v, str) and "{{" in v:
                             try:
-                                v = _jinja_env().from_string(v).render(**ctx)
+                                v = _compiled(v).render(**ctx)
                             except jinja2.TemplateError:
                                 pass
                         tvars[k] = v
@@ -371,8 +413,7 @@ class SimulationExecutor(Executor):
                 if "{{" in tname:
                     # real ansible renders templated task names in its output
                     try:
-                        tname = _jinja_env().from_string(tname).render(
-                            **host_ctxs[active[0]])
+                        tname = _compiled(tname).render(**host_ctxs[active[0]])
                     except jinja2.TemplateError:
                         pass
                 state.emit(f"TASK [{tname}] " + "*" * 40)

@@ -20,6 +20,7 @@ Span discipline is enforced by analyzer rule KO-P010.
 from kubeoperator_tpu.observability.tracing import (
     NullTracer,
     Tracer,
+    critical_chain,
     mark_critical_path,
     new_trace_id,
     render_waterfall,
@@ -34,7 +35,8 @@ from kubeoperator_tpu.observability.logging import (
 )
 
 __all__ = [
-    "NullTracer", "Tracer", "mark_critical_path", "new_trace_id",
+    "NullTracer", "Tracer", "critical_chain", "mark_critical_path",
+    "new_trace_id",
     "render_waterfall", "span_tree", "trace_context",
     "JsonLogFormatter", "bind_trace", "clear_trace", "current_trace",
 ]

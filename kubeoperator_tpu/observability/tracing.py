@@ -34,6 +34,7 @@ Running as crash evidence, exactly like a journal op). Prefer the
 
 from __future__ import annotations
 
+import threading
 from contextlib import contextmanager
 
 from kubeoperator_tpu.models.span import Span, SpanKind, SpanStatus
@@ -112,6 +113,9 @@ class Tracer(NullTracer):
         self._admitted: set = set()   # span ids under the cap
         self._dropped_ids: set = set()
         self._buffer: dict = {}       # span id -> Span, pending one flush
+        # concurrent DAG phases share this op's tracer: the buffer and
+        # cap accounting mutate under one lock (sqlite serializes itself)
+        self._lock = threading.Lock()
 
     # ---- lifecycle ----
     def start_span(self, name: str, kind: str, parent_id: str = "",
@@ -137,9 +141,10 @@ class Tracer(NullTracer):
     def flush(self) -> None:
         """Land the buffered spans in one transaction (best-effort: span
         IO must never fail the operation it describes)."""
-        if not self._buffer:
-            return
-        batch, self._buffer = list(self._buffer.values()), {}
+        with self._lock:
+            if not self._buffer:
+                return
+            batch, self._buffer = list(self._buffer.values()), {}
         try:
             self.spans.save_many(batch)
         except Exception:
@@ -163,7 +168,6 @@ class Tracer(NullTracer):
         already-finished task/host spans carrying the propagated trace id,
         re-stamped with this operation's identity. One transaction for the
         whole batch."""
-        spans: list[Span] = []
         for d in span_dicts or []:
             if not isinstance(d, dict):
                 continue
@@ -171,15 +175,16 @@ class Tracer(NullTracer):
             span.op_id = self.op_id
             span.cluster_id = self.cluster_id
             span.trace_id = span.trace_id or self.trace_id
-            if self._admit(span.id):
-                self._buffer[span.id] = span
+            with self._lock:
+                if self._admit_locked(span.id):
+                    self._buffer[span.id] = span
 
     # ---- internals ----
-    def _admit(self, span_id: str) -> bool:
-        """Cap check keyed by span id: updates of an already-admitted span
-        always pass (end_span of a live span is never a new row), and a
-        DROPPED span's end can never resurrect it through the upsert —
-        nor count as a second drop."""
+    def _admit_locked(self, span_id: str) -> bool:
+        """Cap check keyed by span id (call with `_lock` held): updates of
+        an already-admitted span always pass (end_span of a live span is
+        never a new row), and a DROPPED span's end can never resurrect it
+        through the upsert — nor count as a second drop."""
         if span_id in self._admitted:
             return True
         if span_id in self._dropped_ids:
@@ -191,9 +196,10 @@ class Tracer(NullTracer):
         return True
 
     def _save(self, span: Span) -> None:
-        if not self._admit(span.id):
-            return
-        self._buffer[span.id] = span
+        with self._lock:
+            if not self._admit_locked(span.id):
+                return
+            self._buffer[span.id] = span
         # phase/wave STARTS (and the rare directly-produced operation
         # span) are the durability points: starting phase N+1 lands phase
         # N's whole subtree in the same transaction, and close() flushes
@@ -205,8 +211,9 @@ class Tracer(NullTracer):
     def note_truncation(self, root: Span) -> None:
         """Stamp the drop count onto the root span at close time, so a
         capped trace is visibly capped."""
-        if self._dropped_ids:
-            root.attrs["spans_dropped"] = len(self._dropped_ids)
+        with self._lock:
+            if self._dropped_ids:
+                root.attrs["spans_dropped"] = len(self._dropped_ids)
 
 
 # ======================================================================
@@ -307,6 +314,20 @@ def mark_critical_path(root: dict) -> None:
         finished = [c for c in node["children"] if c["finished_at"]]
         node = (max(finished, key=lambda c: c["finished_at"])
                 if finished else None)
+
+
+def critical_chain(root: dict) -> list[dict]:
+    """The critical path as a flat list, root first — the chain of nodes
+    that finished last at every level. Re-marks the tree, so it works on
+    plain REST JSON as well as freshly-built trees."""
+    mark_critical_path(root)
+    out: list[dict] = []
+    node: dict | None = root
+    while node is not None:
+        out.append(node)
+        node = next(
+            (c for c in node.get("children", []) if c.get("critical")), None)
+    return out
 
 
 def render_waterfall(root: dict, width: int = 40) -> str:

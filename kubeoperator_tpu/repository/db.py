@@ -52,7 +52,8 @@ class Database:
     """Process-wide SQLite handle, safe for the server's mixed
     event-loop + worker-thread usage (WAL + serialized access)."""
 
-    def __init__(self, path: str = "ko_tpu.db") -> None:
+    def __init__(self, path: str = "ko_tpu.db",
+                 synchronous: str = "NORMAL") -> None:
         self.path = path
         self._lock = threading.RLock()
         self._conn = sqlite3.connect(
@@ -60,6 +61,16 @@ class Database:
         )
         self._conn.row_factory = sqlite3.Row
         self._conn.execute("PRAGMA journal_mode=WAL")
+        # `db.synchronous` (utils/config.py DEFAULTS): NORMAL is the
+        # standard WAL pairing — durability ordering is preserved (WAL is
+        # sequential, so a crash can only lose a SUFFIX of commits, never
+        # reorder the journal's open-before-phase-flip invariant), and a
+        # process crash loses nothing; per-commit fsync under FULL was
+        # ~25% of create-to-Ready wall-clock (PERF.md round 11)
+        if str(synchronous).upper() not in ("NORMAL", "FULL"):
+            raise ValueError(
+                f"db.synchronous must be NORMAL or FULL, got {synchronous!r}")
+        self._conn.execute(f"PRAGMA synchronous={synchronous.upper()}")
         self._conn.execute("PRAGMA foreign_keys=ON")
         self.migrate()
 

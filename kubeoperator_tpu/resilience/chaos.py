@@ -23,14 +23,23 @@ task whether to inject a fault instead of (or around) delegating:
 
 Determinism contract: ALL entropy comes from the `random.Random` passed in
 (no ambient time/os entropy — `Date.now`-style seeding is exactly what
-makes chaos runs unreproducible). One draw is consumed per submitted task
-regardless of which rates are enabled, so a given seed produces the same
-injection sequence whatever the rate mix. `koctl chaos-soak` runs the same
-seed twice and diffs the traces to prove it.
+makes chaos runs unreproducible). Each (playbook, limit) submission stream
+gets its OWN deterministic RNG derived from that seed, and one draw is
+consumed per submission of that key regardless of which rates are enabled
+— so the injection decision for "the Nth run of 05-etcd.yml" is a pure
+function of (seed, key, N). That per-key derivation is what keeps seeded
+runs reproducible under the phase-DAG scheduler: concurrent phases submit
+in nondeterministic wall-clock order, but no interleaving can reassign
+another key's draws. (`chaos.max_injections` is the one global, and thus
+order-sensitive, bound — leave it 0 when verifying determinism over a
+concurrent schedule.) `koctl chaos-soak --verify-determinism` runs the
+same seed twice and diffs the traces to prove it.
 """
 
 from __future__ import annotations
 
+import random
+import threading
 import time
 from dataclasses import dataclass, field
 
@@ -137,6 +146,22 @@ class ChaosExecutor(Executor):
         self._counters: dict[tuple, int] = {}    # submissions seen per key
         self._scheduled: dict[tuple, dict] = {}  # key -> {abs index: kind}
         self._death_submissions = 0   # submissions of the doomed playbook
+        # per-key deterministic draw streams, all derived from the ONE
+        # seed the caller passed: concurrent DAG phases may submit in any
+        # wall-clock order without reassigning another key's draws
+        self._stream_base = rng.getrandbits(64)
+        self._streams: dict[tuple, random.Random] = {}
+        # the fault ledger + counters mutate under one lock so concurrent
+        # submissions can never tear a count or interleave the audit list
+        self._ledger_lock = threading.RLock()
+
+    def _stream(self, key: tuple) -> random.Random:
+        """The key's own seeded RNG (call with `_ledger_lock` held)."""
+        stream = self._streams.get(key)
+        if stream is None:
+            stream = random.Random(f"{self._stream_base}/{key[0]}|{key[1]}")
+            self._streams[key] = stream
+        return stream
 
     # ---- controller-death crash point ----
     def run(self, spec: TaskSpec, task_id: str | None = None) -> str:
@@ -147,22 +172,23 @@ class ChaosExecutor(Executor):
         controller's resume gets past this phase. The optional `#N` suffix
         counts submissions of the doomed playbook and fires on the Nth —
         submissions 1..N-1 run normally."""
-        if self.config.die_at_phase:
-            doomed, _, nth = self.config.die_at_phase.partition("#")
-            if spec.playbook == doomed:
-                self._death_submissions += 1
-                target = int(nth) if nth.isdigit() else 1
-                if self._death_submissions >= target:
-                    self.config.die_at_phase = ""
-                    self.injections.append(Injection(
-                        task_id="", playbook=spec.playbook,
-                        kind="controller-death",
-                    ))
-                    raise ControllerDeath(
-                        f"simulated controller death submitting "
-                        f"{spec.playbook} (submission "
-                        f"{self._death_submissions})"
-                    )
+        with self._ledger_lock:
+            if self.config.die_at_phase:
+                doomed, _, nth = self.config.die_at_phase.partition("#")
+                if spec.playbook == doomed:
+                    self._death_submissions += 1
+                    target = int(nth) if nth.isdigit() else 1
+                    if self._death_submissions >= target:
+                        self.config.die_at_phase = ""
+                        self.injections.append(Injection(
+                            task_id="", playbook=spec.playbook,
+                            kind="controller-death",
+                        ))
+                        raise ControllerDeath(
+                            f"simulated controller death submitting "
+                            f"{spec.playbook} (submission "
+                            f"{self._death_submissions})"
+                        )
         return super().run(spec, task_id)
 
     # ---- scripting (deterministic sequences for tests/recipes) ----
@@ -174,7 +200,8 @@ class ChaosExecutor(Executor):
         Keyed by (playbook, limit) so a scale-up retrying against a
         different host subset never inherits the create-flow's queue."""
         key = (playbook, limit)
-        self._scripted.setdefault(key, []).extend([kind] * times)
+        with self._ledger_lock:
+            self._scripted.setdefault(key, []).extend([kind] * times)
 
     def fail_at(self, playbook: str, submissions, kind: str = "unreachable",
                 limit: str = "") -> None:
@@ -186,33 +213,38 @@ class ChaosExecutor(Executor):
         a plain fail-the-next-N queue, because the first cluster's gate
         would consume it. Like fail_times, consumes no RNG draw."""
         key = (playbook, limit)
-        base = self._counters.get(key, 0)
-        slots = self._scheduled.setdefault(key, {})
-        for n in submissions:
-            slots[base + int(n)] = kind
+        with self._ledger_lock:
+            base = self._counters.get(key, 0)
+            slots = self._scheduled.setdefault(key, {})
+            for n in submissions:
+                slots[base + int(n)] = kind
 
     # ---- fault selection ----
     def _next_fault(self, spec: TaskSpec) -> tuple:
         """Returns (kind|None, frac): `frac` ∈ [0,1) is derived from the
         SAME single draw (the within-band remainder) and seeds any
         secondary choice a fault needs (victim host), so no fault ever
-        consumes a second draw — the per-task draw sequence stays
-        independent of the rate mix, as the module contract promises.
+        consumes a second draw — the per-key draw sequence stays
+        independent of the rate mix AND of how concurrent phases
+        interleave their submissions, as the module contract promises.
         Scripted faults consume no draw and get frac 0.0."""
         key = (spec.playbook or f"adhoc:{spec.adhoc_module}", spec.limit)
-        count = self._counters.get(key, 0) + 1
-        self._counters[key] = count
-        scheduled = self._scheduled.get(key)
-        if scheduled and count in scheduled:
-            return scheduled.pop(count), 0.0
-        queue = self._scripted.get(key)
-        if queue:
-            return queue.pop(0), 0.0
-        cfg = self.config
-        # ONE draw per submitted task, spent whether or not a fault fires
-        draw = self.rng.random()
-        if cfg.max_injections and len(self.injections) >= cfg.max_injections:
-            return None, 0.0
+        with self._ledger_lock:
+            count = self._counters.get(key, 0) + 1
+            self._counters[key] = count
+            scheduled = self._scheduled.get(key)
+            if scheduled and count in scheduled:
+                return scheduled.pop(count), 0.0
+            queue = self._scripted.get(key)
+            if queue:
+                return queue.pop(0), 0.0
+            cfg = self.config
+            # ONE draw per submission of this key, spent whether or not a
+            # fault fires — the key's stream never sees another key's load
+            draw = self._stream(key).random()
+            if cfg.max_injections \
+                    and len(self.injections) >= cfg.max_injections:
+                return None, 0.0
         for kind, rate in (
             ("unreachable", cfg.unreachable_rate),
             ("process-death", cfg.process_death_rate),
@@ -234,10 +266,11 @@ class ChaosExecutor(Executor):
             self._inject_process_death(name, spec, state)
             return
         if fault == "slow-stream":
-            self.injections.append(Injection(
-                task_id=state.result.task_id, playbook=name,
-                kind="slow-stream",
-            ))
+            with self._ledger_lock:
+                self.injections.append(Injection(
+                    task_id=state.result.task_id, playbook=name,
+                    kind="slow-stream",
+                ))
             state.emit(f"CHAOS [slow-stream] {name}: "
                        f"+{self.config.slow_stream_delay_s:g}s/line")
             self.inner._execute(
@@ -250,10 +283,11 @@ class ChaosExecutor(Executor):
     ) -> None:
         hosts = inventory_host_names(spec.inventory) or ["localhost"]
         victim = hosts[min(int(frac * len(hosts)), len(hosts) - 1)]
-        self.injections.append(Injection(
-            task_id=state.result.task_id, playbook=name,
-            kind="unreachable", host=victim,
-        ))
+        with self._ledger_lock:
+            self.injections.append(Injection(
+                task_id=state.result.task_id, playbook=name,
+                kind="unreachable", host=victim,
+            ))
         state.emit(f"PLAY [{name}] " + "*" * 40)
         state.emit(
             f"fatal: [{victim}]: UNREACHABLE! => {{\"changed\": false, "
@@ -274,9 +308,11 @@ class ChaosExecutor(Executor):
     def _inject_process_death(
         self, name: str, spec: TaskSpec, state: _TaskState
     ) -> None:
-        self.injections.append(Injection(
-            task_id=state.result.task_id, playbook=name, kind="process-death",
-        ))
+        with self._ledger_lock:
+            self.injections.append(Injection(
+                task_id=state.result.task_id, playbook=name,
+                kind="process-death",
+            ))
         state.emit(f"PLAY [{name}] " + "*" * 40)
         state.emit("TASK [chaos : partial output before the runner dies] "
                    + "*" * 20)
@@ -290,7 +326,9 @@ class ChaosExecutor(Executor):
 
     # ---- observability ----
     def injection_summary(self) -> dict:
+        with self._ledger_lock:
+            snapshot = list(self.injections)
         by_kind: dict[str, int] = {}
-        for inj in self.injections:
+        for inj in snapshot:
             by_kind[inj.kind] = by_kind.get(inj.kind, 0) + 1
-        return {"total": len(self.injections), "by_kind": by_kind}
+        return {"total": len(snapshot), "by_kind": by_kind}

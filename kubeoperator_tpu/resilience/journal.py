@@ -201,11 +201,25 @@ class OperationJournal:
         bind_trace(phase=phase_name)
         self.repos.operations.save(op)
 
+    def record_frontier(self, op: Operation, frontier: dict) -> None:
+        """Persist the DAG scheduler's resume frontier ({"running": [...],
+        "pending": [...]}) into the op's vars — the concurrent analogue of
+        `resume_phase`, written on every launch wave so an interrupted op
+        says exactly which DAG nodes were in flight (and the reconciler's
+        Interrupted verdict can quote them). Same durable-state-in-vars
+        pattern fleet waves use."""
+        op.vars["frontier"] = {
+            "running": list(frontier.get("running", [])),
+            "pending": list(frontier.get("pending", [])),
+        }
+        self.repos.operations.save(op)
+
     def attach(self, op: Operation, ctx) -> None:
         """Wire an AdmContext's phase hook to this op's progress record and
         hand the engine the op's tracer. Runs on the operation's worker
         thread, so the log trace context binds to the right thread."""
         ctx.on_phase = lambda name, status: self.progress(op, name, status)
+        ctx.on_frontier = lambda frontier: self.record_frontier(op, frontier)
         ctx.tracer = self.tracer_for(op)
         bind_trace(trace_id=op.trace_id or None, op_id=op.id,
                    cluster=op.cluster_name)

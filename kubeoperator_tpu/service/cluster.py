@@ -60,6 +60,7 @@ class ClusterService:
         retry_policy=None,
         retry_rng=None,
         journal=None,
+        scheduler=None,
     ) -> None:
         self.repos = repos
         self.executor = executor
@@ -77,7 +78,15 @@ class ClusterService:
             policy_fb, rng_fb = retry_wiring(config)
             retry_policy = retry_policy if retry_policy is not None else policy_fb
             retry_rng = retry_rng if retry_rng is not None else rng_fb
-        self.adm = ClusterAdm(executor, policy=retry_policy, rng=retry_rng)
+        # phase-DAG scheduler posture (scheduler.* block): this service has
+        # the config in hand, so direct construction gets the configured
+        # concurrency too, not the serial engine default
+        if scheduler is None:
+            from kubeoperator_tpu.adm import scheduler_wiring
+
+            scheduler = scheduler_wiring(config)
+        self.adm = ClusterAdm(executor, policy=retry_policy, rng=retry_rng,
+                              scheduler=scheduler)
         # crash-safe operation journal: every operation opens a durable op
         # row before its phase loop and every in-flight phase flip goes
         # through the journal helper (KO-P007), so a dead controller always

@@ -71,10 +71,12 @@ def _check_vars_inert(vars: dict, origin: str, redact: bool = False,
 
 class ComponentService:
     def __init__(self, repos: Repositories, executor: Executor, events,
-                 retry_policy=None, retry_rng=None, journal=None):
+                 retry_policy=None, retry_rng=None, journal=None,
+                 scheduler=None):
         self.repos = repos
         self.events = events
-        self.adm = ClusterAdm(executor, policy=retry_policy, rng=retry_rng)
+        self.adm = ClusterAdm(executor, policy=retry_policy, rng=retry_rng,
+                              scheduler=scheduler)
         from kubeoperator_tpu.resilience import default_journal
 
         self.journal = default_journal(repos, journal)

@@ -75,9 +75,15 @@ class Services:
         # entry points — and ONE operation journal, so every phase loop
         # writes the same durable in-flight record the boot reconciler
         # sweeps after a controller crash
+        from kubeoperator_tpu.adm import scheduler_wiring
         from kubeoperator_tpu.resilience import OperationJournal, retry_wiring
 
         retry_policy, retry_rng = retry_wiring(config)
+        # ONE phase-DAG scheduler posture (scheduler.* config block) for
+        # every phase-running service, same pattern as the retry pair:
+        # families with declared Phase.after edges run concurrently up to
+        # max_concurrent_phases, everything else stays serial
+        scheduler = scheduler_wiring(config)
         # the journal is also the trace anchor (docs/observability.md):
         # every operation it opens gets a durable span tree under the
         # observability.* knobs
@@ -92,30 +98,36 @@ class Services:
         self.clusters = ClusterService(
             repos, executor, provisioner, self.events, config,
             retry_policy=retry_policy, retry_rng=retry_rng,
-            journal=self.journal,
+            journal=self.journal, scheduler=scheduler,
         )
         self.nodes = NodeService(repos, executor, provisioner, self.events,
                                  retry_policy=retry_policy,
-                                 retry_rng=retry_rng, journal=self.journal)
+                                 retry_rng=retry_rng, journal=self.journal,
+                                 scheduler=scheduler)
         self.upgrades = UpgradeService(repos, executor, self.events,
                                        retry_policy=retry_policy,
                                        retry_rng=retry_rng,
-                                       journal=self.journal)
+                                       journal=self.journal,
+                                       scheduler=scheduler)
         self.backups = BackupService(repos, executor, self.events,
                                      retry_policy=retry_policy,
                                      retry_rng=retry_rng,
-                                     journal=self.journal)
+                                     journal=self.journal,
+                                     scheduler=scheduler)
         self.health = HealthService(repos, executor, self.events,
                                     retry_policy=retry_policy,
                                     retry_rng=retry_rng,
-                                    journal=self.journal)
+                                    journal=self.journal,
+                                    scheduler=scheduler)
         self.components = ComponentService(repos, executor, self.events,
                                            retry_policy=retry_policy,
                                            retry_rng=retry_rng,
-                                           journal=self.journal)
+                                           journal=self.journal,
+                                           scheduler=scheduler)
         self.cis = CisService(repos, executor, self.events,
                               retry_policy=retry_policy,
-                              retry_rng=retry_rng, journal=self.journal)
+                              retry_rng=retry_rng, journal=self.journal,
+                              scheduler=scheduler)
         from kubeoperator_tpu.service.watchdog import WatchdogService
 
         self.watchdog = WatchdogService(repos, self.health, self.events,
@@ -159,7 +171,8 @@ def build_services(
         config.get("logging.level", "INFO"), config.get("logging.dir"),
         json_logs=bool(config.get("observability.json_logs", False)),
     )
-    db = Database(config.get("db.path", "ko_tpu.db"))
+    db = Database(config.get("db.path", "ko_tpu.db"),
+                  synchronous=str(config.get("db.synchronous", "NORMAL")))
     repos = Repositories(db)
     from kubeoperator_tpu.utils.i18n import set_default_locale
 

@@ -83,14 +83,16 @@ def parse_chip_count(lines: list[str]) -> int | None:
 
 class HealthService:
     def __init__(self, repos: Repositories, executor: Executor, events,
-                 retry_policy=None, retry_rng=None, journal=None):
+                 retry_policy=None, retry_rng=None, journal=None,
+                 scheduler=None):
         self.repos = repos
         self.executor = executor
         self.events = events
         # guided recovery re-runs phases under the SAME retry policy the
         # create flow uses (wired by the service container), so a recovery
         # rides through the same transient faults a create would
-        self.adm = ClusterAdm(executor, policy=retry_policy, rng=retry_rng)
+        self.adm = ClusterAdm(executor, policy=retry_policy, rng=retry_rng,
+                              scheduler=scheduler)
         from kubeoperator_tpu.resilience import default_journal
 
         self.journal = default_journal(repos, journal)

@@ -21,12 +21,14 @@ log = get_logger("service.node")
 
 class NodeService:
     def __init__(self, repos: Repositories, executor: Executor, provisioner,
-                 events, retry_policy=None, retry_rng=None, journal=None):
+                 events, retry_policy=None, retry_rng=None, journal=None,
+                 scheduler=None):
         self.repos = repos
         self.executor = executor
         self.provisioner = provisioner
         self.events = events
-        self.adm = ClusterAdm(executor, policy=retry_policy, rng=retry_rng)
+        self.adm = ClusterAdm(executor, policy=retry_policy, rng=retry_rng,
+                              scheduler=scheduler)
         from kubeoperator_tpu.resilience import default_journal
 
         self.journal = default_journal(repos, journal)

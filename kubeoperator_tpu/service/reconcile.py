@@ -102,10 +102,19 @@ class ReconcileService:
             except Exception:
                 pass  # terminate op whose cluster row is already gone
             resume = resume_point(cluster) if cluster else ""
+            # a concurrent (DAG) op also persisted its full launch
+            # frontier in op.vars["frontier"] (journal.record_frontier):
+            # resume_phase stays the compact first-pending-condition
+            # contract, the vars carry the whole in-flight set — `koctl
+            # cluster operations --json` shows both
+            frontier = (op.vars or {}).get("frontier") or {}
+            in_flight = sorted(frontier.get("running", []))
+            detail = (f"; DAG frontier was {'+'.join(in_flight)}"
+                      if len(in_flight) > 1 else "")
             journal.interrupt(
                 op, resume_phase=resume,
                 message=f"controller restart: {op.kind} was in flight"
-                + (f" (phase {op.phase})" if op.phase else ""),
+                + (f" (phase {op.phase})" if op.phase else "") + detail,
             )
             results.append({
                 "cluster": op.cluster_name, "op": op.id, "kind": op.kind,

@@ -34,6 +34,14 @@ DEFAULTS: dict[str, Any] = {
         # SQLite stands in for the reference's MySQL (SURVEY.md §7.1 allows
         # SQLite-or-MySQL); ":memory:" for tests.
         "path": "ko_tpu.db",
+        # fsync posture, the standard WAL pairing (docs/scheduler.md):
+        # NORMAL fsyncs at WAL checkpoints, not per commit — a PROCESS
+        # crash (the reconciler's whole threat model) loses nothing, and
+        # WAL's sequential ordering keeps the journal's open-before-flip
+        # invariant even across a power loss, which can only drop a
+        # SUFFIX of commits. FULL restores a per-commit fsync for
+        # deployments that must not lose the tail on power loss.
+        "synchronous": "NORMAL",
     },
     "executor": {
         # "auto": ansible binary if present, else the built-in local engine;
@@ -46,6 +54,17 @@ DEFAULTS: dict[str, Any] = {
         # (Executor.task_timeout_s); matches the historical hard-coded
         # 7200 so declaring the knob changed no behavior
         "task_timeout_s": 7200,
+    },
+    "scheduler": {
+        # phase-DAG scheduler (adm/dag.py, docs/scheduler.md): how many
+        # phases of ONE operation may run at once. Applies to families
+        # that declare Phase.after edges (create); edge-less families run
+        # serially regardless. 1 = the historical strictly-serial engine.
+        "max_concurrent_phases": 4,
+        # task-output lines buffered per log-store commit on the phase
+        # stream (1 = commit every line, the pre-DAG behavior; higher
+        # batches keep the log store off the create critical path)
+        "log_flush_lines": 64,
     },
     "provisioner": {
         "terraform_bin": "terraform",

@@ -53,25 +53,28 @@ CONFIG_NAMES = [
 ]
 
 
-def build_stack(base_dir: str, real_terraform: bool):
+def build_stack(base_dir: str, real_terraform: bool,
+                max_concurrent_phases: int | None = None):
     """Service stack over the simulation executor; plan-mode configs run
-    the REAL TerraformProvisioner against the PATH-shimmed binary."""
+    the REAL TerraformProvisioner against the PATH-shimmed binary.
+    `max_concurrent_phases` overrides the scheduler.* default so the
+    matrix can record serial-vs-DAG pairs (None = configured default)."""
     from kubeoperator_tpu.service import build_services
     from kubeoperator_tpu.utils.config import load_config
 
     os.makedirs(base_dir, exist_ok=True)
-    config = load_config(
-        path="/nonexistent",
-        env={},
-        overrides={
-            "db": {"path": os.path.join(base_dir, "svc.db")},
-            "executor": {"backend": "simulation"},
-            "provisioner": {"work_dir": os.path.join(base_dir, "tfruns"),
-                            "timeout_s": 60},
-            "cron": {"health_check_interval_s": 0},
-            "cluster": {"kubeconfig_dir": os.path.join(base_dir, "kc")},
-        },
-    )
+    overrides = {
+        "db": {"path": os.path.join(base_dir, "svc.db")},
+        "executor": {"backend": "simulation"},
+        "provisioner": {"work_dir": os.path.join(base_dir, "tfruns"),
+                        "timeout_s": 60},
+        "cron": {"health_check_interval_s": 0},
+        "cluster": {"kubeconfig_dir": os.path.join(base_dir, "kc")},
+    }
+    if max_concurrent_phases is not None:
+        overrides["scheduler"] = {
+            "max_concurrent_phases": max_concurrent_phases}
+    config = load_config(path="/nonexistent", env={}, overrides=overrides)
     return build_services(config, simulate=not real_terraform)
 
 
@@ -164,27 +167,104 @@ def _timed(fn, *args, **kw):
     }
 
 
-def run_matrix() -> dict:
-    """All five configs; returns {config_name: metrics}."""
-    os.environ["PATH"] = SHIM_DIR + os.pathsep + os.environ["PATH"]
-    os.environ.pop("KO_SHIM_TF_SCENARIO", None)
+def _critical_path_text(svc, cluster) -> str:
+    """The newest operation's `koctl trace --critical-path` rendering —
+    captured per scheduler mode so PERF.md can commit a before/after
+    critical-path trace of the widest config."""
+    import contextlib
+    import io
+
+    from kubeoperator_tpu.cli.koctl import _print_critical_path
+    from kubeoperator_tpu.observability import span_tree
+
+    op = svc.journal.history(cluster.id, 1)[0]
+    tree = span_tree(svc.journal.spans_of(op.id))
+    if tree is None:
+        return "(no spans persisted)"
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        _print_critical_path(tree, op.kind)
+    return buf.getvalue().rstrip()
+
+
+# per-task pacing for the scheduler-comparison passes: models the remote
+# task latency (SSH round-trips, package installs, kubelet restarts) the
+# unpaced simulation zeroes out. With zero task latency every phase is
+# pure controller CPU, which the GIL serializes — the regime where NO
+# phase scheduler can win; real deploy phases are dominated by waiting on
+# nodes, which is exactly what concurrent phases overlap.
+PACED_TASK_DELAY_S = 0.004
+
+
+def _run_pass(base: str, max_concurrent_phases: int | None,
+              task_delay_s: float = 0.0, configs=None) -> tuple:
+    """One matrix pass under the given scheduler posture; returns
+    ({config: metrics}, widest-config critical-path text)."""
     results: dict[str, dict] = {}
-    with tempfile.TemporaryDirectory(prefix="ko-perf-") as base:
-        svc = build_stack(os.path.join(base, "manual"), real_terraform=False)
+    configs = CONFIG_NAMES if configs is None else configs
+    if "manual-cpu-1x1" in configs:
+        svc = build_stack(os.path.join(base, "manual"), real_terraform=False,
+                          max_concurrent_phases=max_concurrent_phases)
         try:
+            svc.executor.task_delay_s = task_delay_s
             results["manual-cpu-1x1"] = _timed(run_manual_cpu, svc)
         finally:
             svc.close()
-        svc = build_stack(os.path.join(base, "plans"), real_terraform=True)
-        try:
+    svc = build_stack(os.path.join(base, "plans"), real_terraform=True,
+                      max_concurrent_phases=max_concurrent_phases)
+    trace_text = ""
+    try:
+        svc.executor.task_delay_s = task_delay_s
+        if "vsphere-ha-3m3w" in configs:
             results["vsphere-ha-3m3w"] = _timed(run_vsphere_ha, svc)
+        if "tpu-v5e-4" in configs:
             results["tpu-v5e-4"] = _timed(run_tpu, svc, "v5e-4")
+        if "tpu-v5e-16" in configs:
             results["tpu-v5e-16"] = _timed(run_tpu, svc, "v5e-16")
+        if "tpu-v5p-64-x2" in configs:
             results["tpu-v5p-64-x2"] = _timed(run_tpu, svc, "v5p-64",
                                               num_slices=2)
-        finally:
-            svc.close()
-    return results
+            trace_text = _critical_path_text(
+                svc, svc.clusters.get("perf-v5p-64-x2"))
+    finally:
+        svc.close()
+    return results, trace_text
+
+
+def run_matrix() -> tuple:
+    """Three passes over the five configs:
+
+      1. a WARMUP create (discarded) so the simulation executor's parsed-
+         YAML/compiled-template caches are hot for every measured pass —
+         without it the first pass pays cold parses and any cross-pass
+         comparison measures cache warmth, not the scheduler;
+      2. the headline pass (configured DAG scheduler, no pacing): the
+         round-over-round `wall_s` regression trace, comparable with
+         rounds 1–10;
+      3. paced serial + paced DAG passes (PACED_TASK_DELAY_S per task,
+         max_concurrent_phases=1 vs default): the scheduler comparison
+         under modelled task latency, recorded per config as
+         `paced_serial_s`/`paced_dag_s` with the widest config's
+         before/after critical-path traces.
+
+    Returns ({config_name: metrics}, traces)."""
+    os.environ["PATH"] = SHIM_DIR + os.pathsep + os.environ["PATH"]
+    os.environ.pop("KO_SHIM_TF_SCENARIO", None)
+    with tempfile.TemporaryDirectory(prefix="ko-perf-") as base:
+        _run_pass(os.path.join(base, "warm"), None,
+                  configs=("tpu-v5e-4",))   # warms every create playbook
+        results, _ = _run_pass(os.path.join(base, "dag"), None)
+        paced_serial, serial_trace = _run_pass(
+            os.path.join(base, "pserial"), 1, PACED_TASK_DELAY_S)
+        paced_dag, dag_trace = _run_pass(
+            os.path.join(base, "pdag"), None, PACED_TASK_DELAY_S)
+    for name, metrics in results.items():
+        if name in paced_serial:
+            metrics["paced_serial_s"] = paced_serial[name]["wall_s"]
+        if name in paced_dag:
+            metrics["paced_dag_s"] = paced_dag[name]["wall_s"]
+    traces = {"serial": serial_trace, "dag": dag_trace}
+    return results, traces
 
 
 # -------------------------------------------------------------- artifacts ----
@@ -198,7 +278,7 @@ def current_round(default: int = 5) -> int:
         return default
 
 
-def write_artifacts(results: dict, round_no: int) -> None:
+def _load_history() -> dict:
     hist_path = os.path.join(REPO_ROOT, "PERF.json")
     history: dict = {"metric": "create-to-Ready wall-clock (s) per "
                                "BASELINE config", "rounds": {}}
@@ -208,8 +288,30 @@ def write_artifacts(results: dict, round_no: int) -> None:
                 history = json.load(f)
         except ValueError:
             pass
-    history.setdefault("rounds", {})[str(round_no)] = results
-    with open(hist_path, "w", encoding="utf-8") as f:
+    history.setdefault("rounds", {})
+    return history
+
+
+def resolve_round(explicit: int | None = None) -> int:
+    """The round a fresh run records under: an explicit --round wins;
+    otherwise the newest of (PROGRESS.jsonl round, highest round already
+    in PERF.json) — so re-running the matrix refreshes the LATEST round
+    instead of silently overwriting an older committed baseline."""
+    if explicit is not None:
+        return explicit
+    rounds = [current_round()]
+    rounds += [int(k) for k in _load_history()["rounds"]]
+    return max(rounds)
+
+
+def write_artifacts(results: dict, round_no: int,
+                    traces: dict | None = None) -> None:
+    history = _load_history()
+    history["rounds"][str(round_no)] = results
+    if traces:
+        history.setdefault("traces", {})[str(round_no)] = traces
+    with open(os.path.join(REPO_ROOT, "PERF.json"), "w",
+              encoding="utf-8") as f:
         json.dump(history, f, indent=2)
 
     prev = None
@@ -227,17 +329,27 @@ def write_artifacts(results: dict, round_no: int) -> None:
         "provision, phase engine, smoke gate — with no SSH/package time, so",
         "rounds are comparable as a control-plane regression trace).",
         "`phases_s` is the phase-span portion from the cluster's /trace.",
+        "Since round 11 each round ALSO runs a paced serial-vs-DAG pair",
+        "(per-task delay modelling the remote task latency the unpaced",
+        "simulation zeroes out — with zero task latency phases are pure",
+        "controller CPU, which the GIL serializes and no scheduler can",
+        "overlap): `paced serial` is `scheduler.max_concurrent_phases=1`",
+        "(the pre-DAG engine), `paced DAG` the default scheduler",
+        "(docs/scheduler.md), and `DAG cut` their same-machine same-round",
+        "ratio. The `prev round` delta spans rounds (and possibly",
+        "machines).",
         "",
         f"## round {round_no}",
         "",
-        "| config | wall-clock (s) | phases (s) | phases | smoke chips |"
-        " prev round (s) | delta |",
-        "|---|---|---|---|---|---|---|",
+        "| config | wall-clock (s) | phases (s) | phases | smoke chips | "
+        "paced serial (s) | paced DAG (s) | DAG cut | prev round (s) | "
+        "delta |",
+        "|---|---|---|---|---|---|---|---|---|---|",
     ]
     for name in CONFIG_NAMES:
         m = results.get(name)
         if m is None:
-            lines.append(f"| {name} | — | — | — | — | — | — |")
+            lines.append(f"| {name} | — | — | — | — | — | — | — | — | — |")
             continue
         prev_wall = (prev or {}).get(name, {}).get("wall_s")
         if prev_wall:
@@ -245,26 +357,61 @@ def write_artifacts(results: dict, round_no: int) -> None:
             prev_txt = f"{prev_wall:.3f}"
         else:
             delta, prev_txt = "n/a", "n/a"
+        p_serial, p_dag = m.get("paced_serial_s"), m.get("paced_dag_s")
+        if p_serial and p_dag:
+            serial_txt, dag_txt = f"{p_serial:.3f}", f"{p_dag:.3f}"
+            cut = f"{(p_serial - p_dag) / p_serial * 100:.1f}%"
+        else:
+            serial_txt = dag_txt = "—"
+            cut = "n/a"
         chips = m["smoke_chips"] if m["smoke_chips"] else "—"
         lines.append(
             f"| {name} | {m['wall_s']:.3f} | {m['phases_s']:.3f} | "
-            f"{m['phases']} | {chips} | {prev_txt} | {delta} |"
+            f"{m['phases']} | {chips} | {serial_txt} | {dag_txt} | {cut} | "
+            f"{prev_txt} | {delta} |"
         )
+    if traces:
+        lines += [
+            "",
+            "### tpu-v5p-64-x2 critical path, before/after "
+            "(`koctl trace --critical-path`, paced passes)",
+            "",
+            "Serial engine (`scheduler.max_concurrent_phases=1`):",
+            "",
+            "```",
+            traces.get("serial", "(not captured)"),
+            "```",
+            "",
+            "Phase-DAG scheduler (default `max_concurrent_phases=4`):",
+            "",
+            "```",
+            traces.get("dag", "(not captured)"),
+            "```",
+        ]
     lines += [
         "",
         "History (all rounds) lives in `PERF.json`; CI drives the same five",
         "configs in `tests/test_baseline_matrix.py` so no BASELINE config",
-        "can regress to never-executed again.",
+        "can regress to never-executed again, and the tier-1 budget test in",
+        "`tests/test_static_gate.py` pins the DAG scheduler's ≥25% win over",
+        "serial on the widest simulated config.",
         "",
     ]
     with open(os.path.join(REPO_ROOT, "PERF.md"), "w", encoding="utf-8") as f:
         f.write("\n".join(lines))
 
 
-def main() -> int:
-    results = run_matrix()
-    round_no = current_round()
-    write_artifacts(results, round_no)
+def main(argv: list | None = None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--round", type=int, default=None,
+                        help="round number to record under (default: "
+                             "newest of PROGRESS.jsonl / PERF.json)")
+    args = parser.parse_args(argv)
+    results, traces = run_matrix()
+    round_no = resolve_round(args.round)
+    write_artifacts(results, round_no, traces)
     print(json.dumps({"round": round_no, "results": results}, indent=2))
     return 0
 

@@ -166,6 +166,62 @@ class TestPhasePlaybooks:  # KO-X003
         assert check_phase_playbooks(ctx) == []
 
 
+class TestPhaseDags:  # KO-X011
+    def _fam(self, *phases):
+        from kubeoperator_tpu.adm import Phase
+
+        return {"fixture_phases": [Phase(n, f"{n}.yml", after=a)
+                                   for n, a in phases]}
+
+    def test_fires_on_unknown_edge(self, tmp_path):
+        from kubeoperator_tpu.analysis.artifacts import check_phase_dags
+
+        findings = check_phase_dags(
+            ctx_for(tmp_path, GOOD_ROLE),
+            families=self._fam(("base", ()), ("etcd", ("ghost",))))
+        assert [f.rule for f in findings] == ["KO-X011"]
+        assert "ghost" in findings[0].message
+        assert "fixture_phases" in findings[0].message
+
+    def test_fires_on_forward_edge_and_self_cycle(self, tmp_path):
+        """A forward edge is how a cycle (or a nondeterministic serial
+        order) would have to enter — both shapes fire."""
+        from kubeoperator_tpu.analysis.artifacts import check_phase_dags
+
+        findings = check_phase_dags(
+            ctx_for(tmp_path, GOOD_ROLE),
+            families=self._fam(("a", ("b",)), ("b", ()), ("c", ("c",))))
+        messages = "\n".join(f.message for f in findings)
+        assert "later-declared" in messages
+        assert "depends on itself" in messages
+
+    def test_fires_on_duplicate_name(self, tmp_path):
+        from kubeoperator_tpu.analysis.artifacts import check_phase_dags
+
+        findings = check_phase_dags(
+            ctx_for(tmp_path, GOOD_ROLE),
+            families=self._fam(("a", ()), ("a", ())))
+        assert findings and "declared twice" in findings[0].message
+
+    def test_quiet_on_valid_dag(self, tmp_path):
+        from kubeoperator_tpu.analysis.artifacts import check_phase_dags
+
+        assert check_phase_dags(
+            ctx_for(tmp_path, GOOD_ROLE),
+            families=self._fam(
+                ("base", ()), ("runtime", ("base",)),
+                ("join", ("base", "runtime")))) == []
+
+    def test_real_families_are_valid_dags(self):
+        """Against the REAL package: every *_phases family satisfies the
+        contract the scheduler relies on (injection-free path)."""
+        from kubeoperator_tpu.analysis import default_root
+        from kubeoperator_tpu.analysis.artifacts import check_phase_dags
+
+        ctx = AnalysisContext(root=default_root())
+        assert check_phase_dags(ctx) == []
+
+
 class TestPlanTopology:  # KO-X004
     def test_catalog_and_generations_clean(self, tmp_path):
         ctx = ctx_for(tmp_path, {})

@@ -1,0 +1,328 @@
+"""Phase-DAG scheduler (ISSUE 7 tentpole): graph validation, genuine
+concurrency, serial-semantics preservation (retries, conditions, journal
+composite labels, spans), sibling-branch survival, and the crash drills —
+`die_at_phase` on a concurrent phase leaves honest crash evidence the
+boot reconciler resumes from, and the seeded chaos soak stays
+deterministic with `max_concurrent_phases>1`.
+"""
+
+import threading
+
+import pytest
+
+from kubeoperator_tpu.adm import (
+    ClusterAdm,
+    Phase,
+    SchedulerConfig,
+    create_phases,
+)
+from kubeoperator_tpu.adm.dag import (
+    binding_chain,
+    critical_lower_bound,
+    project_edges,
+    validate_family,
+)
+from kubeoperator_tpu.executor.fake import FakeExecutor
+from kubeoperator_tpu.models import OperationStatus
+from kubeoperator_tpu.utils.errors import PhaseError, ValidationError
+
+from tests.test_adm import make_ctx
+from tests.test_reconcile import seed_tpu_plan, stack
+
+SMOKE_LINE = 'KO_TPU_SMOKE_RESULT {"gbps": 84.3, "chips": 16}'
+
+DAG = SchedulerConfig(max_concurrent_phases=4)
+
+
+# ---------------------------------------------------------------- graph -----
+class TestValidation:
+    def test_create_family_is_valid(self):
+        assert validate_family(create_phases()) == []
+
+    def test_unknown_edge(self):
+        problems = validate_family([
+            Phase("a", "a.yml"), Phase("b", "b.yml", after=("ghost",))])
+        assert len(problems) == 1 and "ghost" in problems[0]
+
+    def test_forward_edge_and_self_dep(self):
+        problems = validate_family([
+            Phase("a", "a.yml", after=("b",)), Phase("b", "b.yml"),
+            Phase("c", "c.yml", after=("c",))])
+        text = "\n".join(problems)
+        assert "later-declared" in text and "depends on itself" in text
+
+    def test_duplicate_name(self):
+        problems = validate_family([Phase("a", "a.yml"),
+                                    Phase("a", "a2.yml")])
+        assert problems and "declared twice" in problems[0]
+
+    def test_project_edges_raises_on_bad_family(self):
+        with pytest.raises(ValidationError, match="KO-X011"):
+            project_edges([Phase("a", "a.yml", after=("nope",))], {"a"})
+
+    def test_disabled_phase_splices_transitively(self):
+        """An edge through a disabled phase rewires to ITS dependencies —
+        the external-LB create drops `lb`, so kube-master falls through
+        to lb's own `base` edge."""
+        family = create_phases()
+        active = {p.name for p in family} - {"lb"}
+        edges = project_edges(family, active)
+        assert edges["kube-master"] == {"runtime", "etcd", "base"}
+        # with lb enabled the direct edge stands
+        edges = project_edges(family, {p.name for p in family})
+        assert edges["kube-master"] == {"runtime", "etcd", "lb"}
+
+    def test_lower_bound_and_binding_chain(self):
+        durations = {"a": 1.0, "b": 2.0, "c": 3.0, "d": 1.0}
+        edges = {"a": set(), "b": {"a"}, "c": set(), "d": {"b", "c"}}
+        # chains: a→b→d = 4.0, c→d = 4.0 ... max(a+b, c)+d = 4.0
+        assert critical_lower_bound(durations, edges) == 4.0
+        assert binding_chain(durations, edges) == ["a", "b", "d"]
+
+
+# ---------------------------------------------------------- concurrency -----
+class BarrierFake(FakeExecutor):
+    """Blocks `parties` concurrent _execute calls on one barrier: the test
+    fails fast (timeout) unless that many phases are genuinely in flight
+    at the same wall-clock moment."""
+
+    def __init__(self, parties: int) -> None:
+        super().__init__()
+        self.barrier = threading.Barrier(parties, timeout=30.0)
+        self.rendezvous: set = set()
+
+    def _execute(self, spec, state):
+        if spec.playbook in ("01-base.yml", "03-pki.yml"):
+            self.rendezvous.add(spec.playbook)
+            self.barrier.wait()
+        super()._execute(spec, state)
+
+
+class TestConcurrentExecution:
+    def test_independent_phases_overlap_and_ledger_stays_exact(self):
+        """base and pki meet at a barrier (provably simultaneous), and
+        the FakeExecutor run ledger records every submission exactly once
+        — the thread-safety regression for concurrent submission."""
+        ex = BarrierFake(parties=2)
+        ex.script("17-tpu-smoke-test.yml", lines=[SMOKE_LINE])
+        ctx = make_ctx(tpu=True)
+        ClusterAdm(ex, scheduler=DAG).run(ctx, create_phases())
+        assert ex.rendezvous == {"01-base.yml", "03-pki.yml"}
+        assert all(c.status == "OK" for c in ctx.cluster.status.conditions)
+        for p in create_phases():
+            assert ex.runs_of(p.playbook) == 1, p.playbook
+        assert len(ex.calls) == len(create_phases())
+
+    def test_serial_default_keeps_declaration_order(self):
+        """Direct construction (no scheduler config) stays bit-for-bit
+        the historical serial engine, DAG edges or not."""
+        ex = FakeExecutor()
+        ex.script("17-tpu-smoke-test.yml", lines=[SMOKE_LINE])
+        ctx = make_ctx(tpu=True)
+        ClusterAdm(ex).run(ctx, create_phases())
+        assert ex.playbooks_run() == [p.playbook for p in create_phases()]
+
+    def test_composite_labels_and_frontier(self):
+        reports, frontiers = [], []
+        ex = FakeExecutor()
+        ex.script("17-tpu-smoke-test.yml", lines=[SMOKE_LINE])
+        ctx = make_ctx(tpu=True)
+        ctx.on_phase = lambda n, s: reports.append((n, s))
+        ctx.on_frontier = lambda f: frontiers.append(f)
+        ClusterAdm(ex, scheduler=DAG).run(ctx, create_phases())
+        # Running reports carry sorted composite labels while >1 in flight
+        running = [n for n, s in reports if s == "Running"]
+        assert any("+" in label for label in running)
+        for label in running:
+            parts = label.split("+")
+            assert parts == sorted(parts)
+        # terminal reports carry the phase's own name
+        terminal = [n for n, s in reports if s != "Running"]
+        assert all("+" not in n for n in terminal)
+        # the frontier drained to empty exactly once, at the end
+        assert frontiers[-1] == {"running": [], "pending": []}
+        assert frontiers.count({"running": [], "pending": []}) == 1
+
+    def test_resume_reenters_only_unfinished_frontier(self):
+        """OK DAG nodes are skipped on retry; every non-OK node re-runs
+        — the concurrent generalization of resume-at-failed-phase."""
+        ex = FakeExecutor()
+        ex.script("17-tpu-smoke-test.yml", lines=[SMOKE_LINE])
+        ex.script("05-etcd.yml", fail_times=1)
+        ctx = make_ctx(tpu=True)
+        adm = ClusterAdm(ex, scheduler=DAG)
+        with pytest.raises(PhaseError) as ei:
+            adm.run(ctx, create_phases())
+        assert ei.value.phase == "etcd"
+        # downstream of etcd never ran; independent branches did
+        assert ex.runs_of("07-kube-master.yml") == 0
+        assert ex.runs_of("01-base.yml") == 1
+
+        adm.run(ctx, create_phases())
+        assert all(c.status == "OK" for c in ctx.cluster.status.conditions)
+        assert ex.runs_of("01-base.yml") == 1      # not re-run
+        assert ex.runs_of("05-etcd.yml") == 2      # re-entered
+
+
+# ------------------------------------------------------ failure semantics ---
+class TestBranchIsolation:
+    def test_transient_branch_retries_without_cancelling_siblings(self):
+        """A TRANSIENT failure in one branch retries inside its own phase
+        while healthy siblings run to completion — and the whole create
+        still succeeds once the retry budget covers the fault."""
+        from kubeoperator_tpu.resilience import RetryPolicy
+
+        ex = FakeExecutor()
+        ex.script("17-tpu-smoke-test.yml", lines=[SMOKE_LINE])
+        ex.script("03-pki.yml", fail_times=2,
+                  unreachable_hosts=["m1"])   # TRANSIENT twice, then OK
+        ctx = make_ctx(tpu=True)
+        adm = ClusterAdm(
+            ex, policy=RetryPolicy(max_attempts=3, backoff_base_s=0.0,
+                                   jitter_ratio=0.0),
+            scheduler=DAG)
+        adm.run(ctx, create_phases())
+        status = ctx.cluster.status
+        assert all(c.status == "OK" for c in status.conditions)
+        cond = status.condition("pki")
+        assert cond.attempts == 3
+        assert ex.runs_of("03-pki.yml") == 3
+        assert ex.runs_of("01-base.yml") == 1   # sibling branch untouched
+
+    def test_permanent_failure_halts_new_launches_but_not_siblings(self):
+        """pki fails PERMANENT; base (already running) completes OK, the
+        etcd branch downstream of pki never launches, and the engine
+        raises the pki failure after the pool drains."""
+        ex = BarrierFake(parties=2)   # base+pki provably simultaneous
+        ex.script("03-pki.yml", success=False)
+        ctx = make_ctx(tpu=True)
+        with pytest.raises(PhaseError) as ei:
+            ClusterAdm(ex, scheduler=DAG).run(ctx, create_phases())
+        assert ei.value.phase == "pki"
+        status = ctx.cluster.status
+        assert status.condition("base").status == "OK"
+        assert status.condition("pki").status == "Failed"
+        assert ex.runs_of("05-etcd.yml") == 0
+        # never-launched nodes stay Unknown — the resume frontier
+        assert status.condition("kube-master").status == "Unknown"
+
+    def test_first_declared_failure_wins_deterministically(self):
+        """Two branches fail; the engine re-raises the FIRST-declared
+        phase's failure whatever order the threads landed in."""
+        ex = FakeExecutor()
+        ex.script("01-base.yml", success=False)
+        ex.script("03-pki.yml", success=False)
+        ctx = make_ctx(tpu=True)
+        with pytest.raises(PhaseError) as ei:
+            ClusterAdm(ex, scheduler=DAG).run(ctx, create_phases())
+        assert ei.value.phase == "base"
+
+
+# ----------------------------------------------------------- crash drills ---
+class TestConcurrentCrashAndResume:
+    def test_die_at_concurrent_phase_leaves_evidence_and_resumes(
+        self, tmp_path
+    ):
+        """ControllerDeath at the submission of a concurrent phase
+        (etcd, launched while the base→runtime branch is live): the dying
+        phase's condition stays Running (crash evidence), the journal op
+        stays open with the frontier persisted in vars, and the rebooted
+        reconciler resumes WITHOUT re-running completed DAG nodes."""
+        from kubeoperator_tpu.resilience import ControllerDeath
+
+        svc = stack(tmp_path, chaos={"die_at_phase": "05-etcd.yml"})
+        try:
+            assert svc.clusters.adm.scheduler.max_concurrent_phases > 1
+            seed_tpu_plan(svc)
+            with pytest.raises(ControllerDeath):
+                svc.clusters.create("dagcrash", provision_mode="plan",
+                                    plan_name="tpu-v5e-16", wait=True)
+            cluster = svc.clusters.get("dagcrash")
+            assert cluster.status.phase == "Deploying"
+            assert cluster.status.condition("etcd").status == "Running"
+            open_ops = svc.journal.open_ops(cluster.id)
+            assert len(open_ops) == 1
+            frontier = open_ops[0].vars.get("frontier")
+            assert frontier and "etcd" in frontier["running"]
+        finally:
+            svc.close()
+
+        svc2 = stack(tmp_path, reconcile={"auto_resume": True})
+        try:
+            cluster = svc2.clusters.wait_for("dagcrash", timeout_s=300)
+            assert cluster.status.phase == "Ready"
+            history = svc2.journal.history(cluster.id)
+            assert [o.status for o in history] == [
+                OperationStatus.SUCCEEDED.value,
+                OperationStatus.INTERRUPTED.value,
+            ]
+            # completed DAG nodes were NOT re-run: pki ran once across
+            # both lives (once pre-crash, zero post-crash) — count the
+            # pki condition's attempts on the resumed run
+            assert cluster.status.condition("pki").attempts == 1
+            # the resumed op's frontier drained
+            assert history[0].vars["frontier"] == {
+                "running": [], "pending": []}
+        finally:
+            svc2.close()
+
+    def test_completed_nodes_not_rerun_after_crash(self, tmp_path):
+        """Sharper resume assertion over the resumed op's SPAN TREE: the
+        rebooted create opens a fresh journal op, so any phase it ran
+        left a phase span there — completed DAG nodes must not appear."""
+        from kubeoperator_tpu.resilience import ControllerDeath
+
+        svc = stack(tmp_path, chaos={"die_at_phase": "09-network.yml"})
+        try:
+            seed_tpu_plan(svc)
+            with pytest.raises(ControllerDeath):
+                svc.clusters.create("dagcrash2", provision_mode="plan",
+                                    plan_name="tpu-v5e-16", wait=True)
+            done_before = {
+                c.name for c in
+                svc.clusters.get("dagcrash2").status.conditions
+                if c.status == "OK"}
+            # everything upstream of network completed before the crash
+            assert {"base", "runtime", "pki", "etcd",
+                    "kube-master", "kube-worker"} <= done_before
+        finally:
+            svc.close()
+
+        svc2 = stack(tmp_path, reconcile={"auto_resume": True})
+        try:
+            cluster = svc2.clusters.wait_for("dagcrash2", timeout_s=300)
+            assert cluster.status.phase == "Ready"
+            resumed = svc2.journal.history(cluster.id)[0]
+            assert resumed.status == OperationStatus.SUCCEEDED.value
+            rerun = {s.name for s in svc2.journal.spans_of(resumed.id)
+                     if s.kind == "phase"}
+            assert rerun, "resumed op persisted no phase spans"
+            assert rerun.isdisjoint(done_before), (
+                f"completed DAG nodes re-run after resume: "
+                f"{sorted(rerun & done_before)}")
+        finally:
+            svc2.close()
+
+
+# ------------------------------------------------------ chaos determinism ---
+def test_chaos_soak_deterministic_with_concurrent_phases(capsys):
+    """The acceptance drill: a seeded soak under the DEFAULT scheduler
+    (max_concurrent_phases>1 — asserted, so a config regression can't
+    quietly re-serialize it) passes --verify-determinism: same seed, two
+    passes, bit-identical deploy traces and injection multiset."""
+    import json
+
+    from kubeoperator_tpu.cli.koctl import main
+    from kubeoperator_tpu.utils.config import load_config
+
+    assert int(load_config(path="/nonexistent", env={}).get(
+        "scheduler.max_concurrent_phases")) > 1
+    rc = main(["chaos-soak", "--format", "json",
+               "--seed", "7", "--deploys", "2",
+               "--unreachable-rate", "0.25", "--process-death-rate", "0.10",
+               "--verify-determinism"])
+    report = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert report["deterministic"] is True
+    assert report["all_ready"] is True
+    assert report["injection_summary"]["total"] >= 1
