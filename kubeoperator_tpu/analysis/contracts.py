@@ -33,11 +33,11 @@ _DOC_KEY_RE = re.compile(r"`([a-z0-9_]+(?:\.[a-z0-9_]+)+)`")
 _PURE_KEY_RE = re.compile(r"^[a-z0-9_]+(\.[a-z0-9_]+)+$")
 
 # the config blocks the docs knob tables must cover completely (the
-# resilience layer's contract, extended to the observability, fleet and
-# scheduler blocks — docs/resilience.md + docs/observability.md +
-# docs/scheduler.md)
+# resilience layer's contract, extended to the observability, fleet,
+# scheduler and lease blocks — docs/resilience.md + docs/observability.md
+# + docs/scheduler.md)
 DOC_REQUIRED_SECTIONS = ("resilience", "chaos", "watchdog", "observability",
-                         "fleet", "scheduler")
+                         "fleet", "scheduler", "lease")
 
 
 def _defaults_from_tree(root: str) -> dict | None:

@@ -216,6 +216,30 @@ class MetricsRegistry:
                          {"cluster": r["cluster"]}, r["budget_left"])
                     for r in watchdog_rows])
 
+        # controller leases (docs/resilience.md "Controller leases"): the
+        # multi-controller ownership surface — who owns what from THIS
+        # replica's viewpoint, and how stale its own heartbeats run.
+        # getattr-guarded like the watchdog rows: hand-built test stubs
+        # and pre-lease stacks simply omit the family.
+        leases = getattr(services, "leases", None)
+        if leases is not None and leases.enabled:
+            counts = leases.state_counts()
+            family("ko_tpu_controller_leases", "gauge",
+                   "Controller leases by state from this replica's "
+                   "viewpoint (held = ours and live; foreign = a live "
+                   "peer's; expired = past deadline, sweepable by the "
+                   "lease sweep).",
+                   [_fmt("ko_tpu_controller_leases", {"state": s}, n)
+                    for s, n in sorted(counts.items())])
+            age = leases.max_heartbeat_age_s()
+            family("ko_tpu_controller_lease_heartbeat_age_seconds", "gauge",
+                   "Seconds since the oldest renewal among leases this "
+                   "replica holds live (0 when it holds none); growth "
+                   "toward lease.ttl_s means the heartbeat tick is "
+                   "stalling.",
+                   [_fmt("ko_tpu_controller_lease_heartbeat_age_seconds",
+                         None, round(age, 3) if age is not None else 0)])
+
         try:
             stats = services.executor.task_stats()
         except Exception:

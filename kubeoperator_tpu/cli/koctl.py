@@ -1608,15 +1608,88 @@ def cmd_fleet_soak(args) -> int:
     return 0 if ok else 1
 
 
+def cmd_controller_soak(args) -> int:
+    """`koctl chaos-soak --controllers N` (docs/resilience.md "Controller
+    leases"): the multi-controller kill drill. A replica holding >=3
+    in-flight creates plus a fleet wave dies via ControllerDeath; within
+    one lease TTL a peer claims and resumes every orphaned op (exactly
+    once, zero double-runs), and a post-mortem write from the dead
+    replica's epoch is rejected as a fencing event. Every assertion reads
+    journal rows and span trees."""
+    import tempfile
+
+    from kubeoperator_tpu.cli import loadtest as lt
+
+    with tempfile.TemporaryDirectory(prefix="ko-controller-soak-") as base:
+        report = lt.run_controller_soak(
+            controllers=args.controllers, base_dir=base,
+            lease_ttl_s=args.lease_ttl)
+    if args.format == "json":
+        _print(report)
+    else:
+        print(f"controller chaos-soak: {report['controllers']} replicas, "
+              f"lease ttl {report['lease_ttl_s']}s -> {report['target']}")
+        lt.print_checks(report["checks"])
+        print(f"  runtime {report['runtime_s']}s — "
+              + ("OK" if report["ok"] else "FAILED"))
+    return 0 if report["ok"] else 1
+
+
+def cmd_loadtest(args) -> int:
+    """`koctl loadtest` (docs/resilience.md "Controller leases"): drive
+    many concurrent simulated operations across N in-process controller
+    replicas sharing one WAL db, audit the journal for lost/duplicated
+    rows, and report ops/s + latency percentiles. Exit 0 = every check
+    passed."""
+    import tempfile
+
+    from kubeoperator_tpu.cli import loadtest as lt
+
+    if args.record_perf:
+        result = lt.record_perf(args)
+        if args.format == "json":
+            _print(result)
+        else:
+            for n in sorted(result["rows"], key=int):
+                row = result["rows"][n]
+                print(f"  {n} replica(s): {row['ops']} ops @ "
+                      f"{row['concurrency']} workers -> "
+                      f"{row['ops_per_s']} ops/s, p50 {row['p50_s']}s, "
+                      f"p99 {row['p99_s']}s")
+            print(f"  PERF loadtest row updated (round {result['round']})")
+        return 0 if result["ok"] else 1
+    with tempfile.TemporaryDirectory(prefix="ko-loadtest-") as base:
+        report = lt.run_loadtest(
+            ops=args.ops, replicas=args.replicas,
+            concurrency=args.concurrency, lease_ttl_s=args.lease_ttl,
+            base_dir=base, kill_replica_after=args.kill_replica_after)
+    if args.format == "json":
+        _print(report)
+    else:
+        print(f"loadtest: {report['ops']} ops across {report['replicas']} "
+              f"replica(s), concurrency {report['concurrency']}")
+        print(f"  {report['ops_per_s']} ops/s; p50 {report['p50_s']}s "
+              f"p95 {report['p95_s']}s p99 {report['p99_s']}s; "
+              f"{report['metrics_scrapes']} metrics scrapes; "
+              f"outcomes {report['outcomes']}")
+        lt.print_checks(report["checks"])
+        print(f"  runtime {report['wall_s']}s — "
+              + ("OK" if report["ok"] else "FAILED"))
+    return 0 if report["ok"] else 1
+
+
 def cmd_chaos_soak(args) -> int:
     """Seeded chaos soak (docs/resilience.md): prove deploys ride through
     injected faults unattended, and that a seed reproduces bit-identical
     fault/retry traces. Exit 0 = every deploy reached Ready (and, with
     --verify-determinism, both passes matched). `--fleet` switches to the
-    fleet-scale drill (canary-block / wave-rollback / death-resume)."""
+    fleet-scale drill (canary-block / wave-rollback / death-resume);
+    `--controllers N` to the multi-replica controller-death drill."""
     import tempfile
     import time as _time
 
+    if args.controllers:
+        return cmd_controller_soak(args)
     if args.fleet:
         return cmd_fleet_soak(args)
     t0 = _time.monotonic()
@@ -1983,7 +2056,54 @@ def build_parser() -> argparse.ArgumentParser:
                              "asserted from the journal + span tree")
     soak_p.add_argument("--clusters", type=int, default=21,
                         help="fleet size for --fleet (floored at 9)")
+    soak_p.add_argument("--controllers", type=int, default=0,
+                        help="run the multi-controller kill drill instead: "
+                             "N in-process replicas share one WAL db, one "
+                             "dies (ControllerDeath) holding >=3 creates "
+                             "plus a fleet wave, and a peer's lease sweep "
+                             "must claim + resume every orphan exactly "
+                             "once with stale-epoch writes fenced "
+                             "(floored at 2)")
+    soak_p.add_argument("--lease-ttl", type=float, default=2.0,
+                        help="lease TTL for --controllers (seconds)")
     soak_p.add_argument("--format", choices=["text", "json"], default="text")
+
+    load_p = sub.add_parser(
+        "loadtest",
+        help="multi-controller load harness: N in-process replicas share "
+             "one WAL db and drive concurrent simulated operations "
+             "(docs/resilience.md)",
+        description=(
+            "Build N full controller replicas (distinct lease."
+            "controller_id, one shared WAL SQLite file) and drive many "
+            "concurrent simulated operations round-robin across them "
+            "while a scraper renders /metrics. The journal is audited "
+            "afterwards: every operation exactly once, nothing lost, "
+            "nothing duplicated; ops/s and latency percentiles reported. "
+            "--kill-replica-after additionally murders one replica "
+            "mid-run and requires the survivors' lease sweep to resume "
+            "every orphan. --record-perf runs the PERF matrix (1 and 3 "
+            "replicas) and updates PERF.md/PERF.json like perf_matrix."
+        ),
+    )
+    load_p.add_argument("--ops", type=int, default=500,
+                        help="concurrent simulated operations to drive")
+    load_p.add_argument("--replicas", type=int, default=2)
+    load_p.add_argument("--concurrency", type=int, default=32,
+                        help="driver worker threads")
+    load_p.add_argument("--lease-ttl", type=float, default=5.0)
+    load_p.add_argument("--kill-replica-after", type=int, default=None,
+                        metavar="N",
+                        help="kill replica 0 (ControllerDeath) once N ops "
+                             "have been driven; survivors must claim and "
+                             "resume every orphan")
+    load_p.add_argument("--record-perf", action="store_true",
+                        help="run at 1 and 3 replicas and commit the "
+                             "ops/s + p99 row to PERF.json/PERF.md")
+    load_p.add_argument("--round", type=int, default=None,
+                        help="PERF round to record under (default: the "
+                             "newest, like perf_matrix)")
+    load_p.add_argument("--format", choices=["text", "json"], default="text")
 
     audit_p = sub.add_parser("audit", help="operation audit trail "
                                            "(who did what, newest first)")
@@ -2020,6 +2140,8 @@ def main(argv: list[str] | None = None) -> int:
         return cmd_lint(args)
     if args.cmd == "chaos-soak":
         return cmd_chaos_soak(args)
+    if args.cmd == "loadtest":
+        return cmd_loadtest(args)
     if args.cmd == "install":
         from kubeoperator_tpu.installer import install
 

@@ -1,0 +1,699 @@
+"""Multi-controller load + failover harness (`koctl loadtest`,
+`koctl chaos-soak --controllers N`).
+
+Both commands build the same thing: N **in-process controller replicas** —
+full `build_services` stacks with distinct stable `lease.controller_id`s —
+sharing ONE WAL SQLite file, exactly the multi-controller topology the
+lease layer (resilience/lease.py, docs/resilience.md "Controller leases")
+exists for. In-process replicas are the honest simulation tier: every
+replica has its own `Database` handle (its own sqlite connection), so WAL
+write contention, `busy_timeout` queuing, lease CAS races and epoch
+fencing are all real; only the process boundary is folded away, which is
+also what keeps the drills deterministic and CI-runnable.
+
+`koctl loadtest` drives many concurrent simulated operations (manual-mode
+single-host cluster creates, the cheapest full journal+phase+trace path)
+round-robin across the replicas while a scraper thread renders /metrics,
+then audits the journal: every submitted operation must appear exactly
+once, nothing lost, nothing duplicated, p50/p99 latency and ops/s
+reported. `--kill-replica-after K` additionally murders one replica once
+K ops have been driven (ChaosExecutor.die_now — every in-flight op thread
+dies at its next submission, the SIGKILL shape) and requires the
+survivors to claim and resume every orphan through the lease sweep.
+
+`koctl chaos-soak --controllers N` is the acceptance drill: a replica
+holding ≥3 in-flight creates PLUS a fleet wave dies mid-wave; within one
+lease TTL a peer claims and resumes every orphaned op (each exactly once,
+zero double-runs, completed fleet clusters not re-run), and a post-mortem
+write from the dead replica's epoch is rejected and surfaced as a fencing
+event. Assertions read journal rows and span trees, never return codes.
+"""
+
+from __future__ import annotations
+
+import ipaddress
+import os
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+from kubeoperator_tpu.utils.errors import KoError
+from kubeoperator_tpu.utils.logging import get_logger
+
+log = get_logger("cli.loadtest")
+
+
+def _percentile(sorted_vals: list[float], pct: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(int(round((pct / 100.0) * (len(sorted_vals) - 1))),
+              len(sorted_vals) - 1)
+    return sorted_vals[idx]
+
+
+def _host_ip(i: int) -> str:
+    return str(ipaddress.ip_address("10.100.0.1") + i)
+
+
+class ReplicaPool:
+    """N full service stacks over one shared db file, each a distinct
+    controller replica; owns the heartbeat pump and the kill switch."""
+
+    def __init__(self, base_dir: str, n: int, lease_ttl_s: float,
+                 serial_scheduler: bool = False,
+                 config_extra: dict | None = None) -> None:
+        from kubeoperator_tpu.service import build_services
+        from kubeoperator_tpu.utils.config import load_config
+
+        self.base_dir = base_dir
+        self.lease_ttl_s = lease_ttl_s
+        self.db_path = os.path.join(base_dir, "shared.db")
+        self.replicas = []
+        self.alive: list[bool] = []
+        self._hb_stop = threading.Event()
+        self._hb_thread: threading.Thread | None = None
+        # a killed replica's op threads die with ControllerDeath by
+        # design (the SIGKILL shape never runs handlers); keep the
+        # expected deaths out of stderr while the harness runs
+        from kubeoperator_tpu.resilience import ControllerDeath
+
+        self._prev_excepthook = threading.excepthook
+
+        def quiet_hook(hook_args) -> None:
+            if isinstance(hook_args.exc_value, ControllerDeath):
+                log.info("op thread died with its replica: %s",
+                         hook_args.exc_value)
+                return
+            self._prev_excepthook(hook_args)
+
+        threading.excepthook = quiet_hook
+        for i in range(n):
+            overrides = {
+                "db": {"path": self.db_path},
+                "logging": {"level": "ERROR"},
+                "executor": {"backend": "simulation"},
+                "provisioner": {"work_dir": os.path.join(base_dir, "tf")},
+                "cron": {"backup_enabled": False,
+                         "health_check_interval_s": 0,
+                         "event_sync_interval_s": 0},
+                "cluster": {"kubeconfig_dir": os.path.join(base_dir, "kc")},
+                # chaos wrapper with every rate at 0: injects nothing, but
+                # arms the die_now() kill switch on each replica
+                "chaos": {"enabled": True, "seed": 1,
+                          "slow_stream_delay_s": 0.05},
+                "lease": {"enabled": True,
+                          "controller_id": f"replica-{i}",
+                          "ttl_s": lease_ttl_s,
+                          "heartbeat_interval_s": max(lease_ttl_s / 4, 0.05)},
+                "resilience": {
+                    "max_attempts": 2, "backoff_base_s": 0.01,
+                    "backoff_max_s": 0.05,
+                    # survivors must re-enter orphaned work on their own
+                    "reconcile": {"auto_resume": True},
+                },
+            }
+            if serial_scheduler:
+                overrides["scheduler"] = {"max_concurrent_phases": 1}
+            for section, values in (config_extra or {}).items():
+                overrides.setdefault(section, {}).update(values)
+            config = load_config(path="/nonexistent", env={},
+                                 overrides=overrides)
+            self.replicas.append(build_services(config, simulate=True))
+            self.alive.append(True)
+
+    def __getitem__(self, idx: int):
+        return self.replicas[idx]
+
+    def __len__(self) -> int:
+        return len(self.replicas)
+
+    def alive_replicas(self) -> list:
+        return [r for r, a in zip(self.replicas, self.alive) if a]
+
+    def start_heartbeats(self) -> None:
+        """Pump lease renewals for ALIVE replicas only — a killed replica
+        stops heartbeating by definition, which is precisely the evidence
+        the lease sweep acts on."""
+        def pump() -> None:
+            interval = max(self.lease_ttl_s / 4.0, 0.05)
+            while not self._hb_stop.wait(interval):
+                for replica, alive in zip(self.replicas, self.alive):
+                    if alive:
+                        try:
+                            replica.leases.heartbeat()
+                        except Exception:
+                            log.exception("heartbeat pump failed")
+
+        self._hb_thread = threading.Thread(target=pump, daemon=True)
+        self._hb_thread.start()
+
+    def kill(self, idx: int) -> None:
+        """Simulated SIGKILL of one replica: heartbeats stop NOW and every
+        in-flight op thread dies (ControllerDeath) at its next executor
+        submission — open journal ops + Running spans + an expiring lease
+        are exactly what a real dead controller leaves behind."""
+        self.alive[idx] = False
+        self.replicas[idx].executor.die_now(
+            f"replica-{idx} killed by the harness")
+
+    def wait_dead_threads(self, idx: int, timeout_s: float = 30.0) -> None:
+        self.replicas[idx].clusters.wait_all(timeout_s)
+        self.replicas[idx].fleet.wait_all(timeout_s)
+
+    def wait_leases_expired(self, timeout_s: float = 30.0) -> bool:
+        """Block until every lease of every DEAD replica has expired (db
+        clock) — 'within one lease TTL' is the failover promise."""
+        dead_ids = {f"replica-{i}" for i, a in enumerate(self.alive)
+                    if not a}
+        if not dead_ids:
+            return True
+        repo = self.replicas[0].repos.leases
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            now = repo.db_now()
+            rows = [r for r in self.replicas[0].repos.db.query(
+                "SELECT controller_id, heartbeat_deadline "
+                "FROM controller_leases")
+                if r["controller_id"] in dead_ids
+                and r["heartbeat_deadline"] >= now]
+            if not rows:
+                return True
+            time.sleep(min(self.lease_ttl_s / 10.0, 0.2))
+        return False
+
+    def close(self) -> None:
+        threading.excepthook = self._prev_excepthook
+        self._hb_stop.set()
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=2)
+        for replica, alive in zip(self.replicas, self.alive):
+            try:
+                if alive:
+                    replica.close()
+                else:
+                    # a dead replica's op threads already died; just drop
+                    # its db handle (close() would wait on nothing anyway)
+                    replica.cron.stop()
+                    replica.terminals.shutdown()
+                    replica.repos.db.close()
+            except Exception:
+                log.exception("replica close failed")
+
+
+def _seed_hosts(replica, count: int, prefix: str = "lt") -> list[str]:
+    """Credential + one manual-mode host per future cluster (the cheapest
+    full-stack operation is a single-host manual create)."""
+    from kubeoperator_tpu.models import Credential
+
+    try:
+        replica.credentials.create(Credential(name="lt-ssh", password="pw"))
+    except KoError:
+        pass   # another replica seeded it
+    names = []
+    for i in range(count):
+        name = f"{prefix}-host-{i:04d}"
+        replica.hosts.register(name, _host_ip(i), "lt-ssh")
+        names.append(name)
+    return names
+
+
+def _settle(pool: ReplicaPool, deadline_s: float) -> bool:
+    """Wait until no journal op is Running on the shared db (resumed work
+    included). Survivor replicas keep sweeping while we wait, so orphans
+    claimed late still converge."""
+    repos = pool.replicas[0].repos
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        for replica in pool.alive_replicas():
+            try:
+                replica.reconciler.lease_sweep()
+            except Exception:
+                log.exception("settle-phase lease sweep failed")
+        running = repos.operations.find(status="Running")
+        if not running:
+            return True
+        time.sleep(0.1)
+    return False
+
+
+# --------------------------------------------------------------- loadtest ---
+def run_loadtest(*, ops: int, replicas: int, concurrency: int,
+                 lease_ttl_s: float, base_dir: str,
+                 kill_replica_after: int | None = None,
+                 scrape_interval_s: float = 0.2,
+                 settle_timeout_s: float = 120.0) -> dict:
+    """One loadtest pass; returns the report dict (see module docstring).
+    The caller owns base_dir's lifetime."""
+    from kubeoperator_tpu.api.metrics import MetricsRegistry
+    from kubeoperator_tpu.models import ClusterSpec
+    from kubeoperator_tpu.resilience import ControllerDeath, StaleEpochError
+
+    os.makedirs(base_dir, exist_ok=True)
+    pool = ReplicaPool(base_dir, replicas, lease_ttl_s)
+    checks: list[dict] = []
+
+    def check(name: str, ok, detail: str = "") -> None:
+        checks.append({"check": name, "ok": bool(ok), "detail": detail})
+
+    try:
+        _seed_hosts(pool[0], ops)
+        pool.start_heartbeats()
+        latencies: list[float] = []
+        outcomes: dict[str, int] = {"ok": 0, "killed": 0, "failed": 0}
+        lat_lock = threading.Lock()
+        completed = 0
+        started = 0
+        killed_idx: int | None = None
+        kill_lock = threading.Lock()
+
+        def maybe_kill() -> None:
+            # triggered on op STARTS, not completions: with ops <=
+            # concurrency every op is in flight at once and the batch can
+            # finish its submissions before the Nth completion lands — a
+            # completion-based kill would fire into an idle replica and
+            # orphan nothing. Keyed on starts, the victim always still has
+            # in-flight work (each op is many executor submissions), so
+            # the drill's failover scenario materializes at any
+            # ops/concurrency ratio.
+            nonlocal killed_idx
+            if kill_replica_after is None:
+                return
+            with kill_lock:
+                if killed_idx is None and started >= kill_replica_after:
+                    killed_idx = 0
+                    pool.kill(0)
+                    log.warning("loadtest: killed replica-0 after %d "
+                                "driven ops", started)
+
+        def one_op(i: int) -> None:
+            nonlocal completed, started
+            with kill_lock:
+                started += 1
+            maybe_kill()
+            # route around dead replicas; the kill itself still catches
+            # ops already in flight on the victim
+            candidates = [j for j, a in enumerate(pool.alive) if a]
+            replica = pool[candidates[i % len(candidates)]]
+            name = f"lt-{i:04d}"
+            t0 = time.perf_counter()
+            try:
+                replica.clusters.create(
+                    name, spec=ClusterSpec(worker_count=0),
+                    host_names=[f"lt-host-{i:04d}"], wait=True)
+                with lat_lock:
+                    latencies.append(time.perf_counter() - t0)
+                    outcomes["ok"] += 1
+                    completed += 1
+            except (ControllerDeath, StaleEpochError):
+                # the replica died under this op (or lost the cluster to a
+                # survivor's claim while dying — the fence raced the kill);
+                # either way the survivor's sweep resumes it
+                with lat_lock:
+                    outcomes["killed"] += 1
+            except KoError as e:
+                log.warning("loadtest op %s failed: %s", name, e)
+                with lat_lock:
+                    outcomes["failed"] += 1
+
+        # metrics scraper riding along: render must survive concurrent
+        # journal/lease churn on every replica
+        scrape_stop = threading.Event()
+        scrapes = {"count": 0, "errors": 0}
+
+        def scraper() -> None:
+            registry = MetricsRegistry()
+            while not scrape_stop.wait(scrape_interval_s):
+                for replica in pool.alive_replicas():
+                    try:
+                        text = registry.render(replica)
+                        assert "ko_tpu_controller_leases" in text
+                        scrapes["count"] += 1
+                    except Exception:
+                        scrapes["errors"] += 1
+                        log.exception("metrics scrape failed")
+
+        scrape_thread = threading.Thread(target=scraper, daemon=True)
+        scrape_thread.start()
+        t_start = time.perf_counter()
+        with ThreadPoolExecutor(max_workers=concurrency) as driver:
+            list(driver.map(one_op, range(ops)))
+        drive_wall = time.perf_counter() - t_start
+
+        # failover: orphans of the killed replica come back via the
+        # survivors' lease sweep once the dead leases expire
+        if killed_idx is not None:
+            check("dead replica's leases expired within the TTL window",
+                  pool.wait_leases_expired(
+                      timeout_s=max(lease_ttl_s * 10, 10.0)))
+        settled = _settle(pool, settle_timeout_s)
+        wall = time.perf_counter() - t_start
+        scrape_stop.set()
+        scrape_thread.join(timeout=2)
+
+        # ---- journal integrity audit ----
+        repos = pool[0].repos
+        expected = {f"lt-{i:04d}" for i in range(ops)}
+        by_cluster: dict[str, list] = {}
+        for op in repos.operations.find(kind="create"):
+            if op.cluster_name in expected:
+                by_cluster.setdefault(op.cluster_name, []).append(op)
+        missing = sorted(expected - set(by_cluster))
+        dup_success = sorted(
+            n for n, rows in by_cluster.items()
+            if sum(1 for o in rows if o.status == "Succeeded") > 1)
+        unfinished = sorted(
+            n for n in expected
+            if not any(o.status == "Succeeded"
+                       for o in by_cluster.get(n, [])))
+        def phase_of(name: str) -> str:
+            # a cluster row that never landed is the audit's own target
+            # defect — report it as not-Ready, don't crash the report
+            try:
+                return pool[0].repos.clusters.get_by_name(name).status.phase
+            except KoError:
+                return "(missing)"
+
+        not_ready = sorted(
+            n for n in expected if phase_of(n) != "Ready")
+        check("every op settled (no Running journal rows left)", settled)
+        check("zero lost journal rows", not missing,
+              f"missing: {missing[:5]}")
+        check("zero duplicated journal rows (one Succeeded create per "
+              "cluster)", not dup_success, f"dups: {dup_success[:5]}")
+        check("every cluster converged Ready", not not_ready,
+              f"not ready: {not_ready[:5]}")
+        check("every submitted op Succeeded (killed ones via resume)",
+              not unfinished, f"unfinished: {unfinished[:5]}")
+        check("metrics scrapes ran clean",
+              scrapes["count"] > 0 and scrapes["errors"] == 0,
+              str(scrapes))
+        if killed_idx is not None:
+            interrupted = [o for rows in by_cluster.values() for o in rows
+                           if o.status == "Interrupted"]
+            resumed_twice = sorted(
+                n for n, rows in by_cluster.items()
+                if any(o.status == "Interrupted" for o in rows)
+                and sum(1 for o in rows
+                        if o.status in ("Succeeded", "Running")) > 1)
+            check("controller death orphaned at least one op",
+                  len(interrupted) >= 1, f"{len(interrupted)} interrupted")
+            check("each orphan resumed exactly once", not resumed_twice,
+                  f"double-resumed: {resumed_twice[:5]}")
+
+        latencies.sort()
+        report = {
+            "ops": ops,
+            "replicas": replicas,
+            "concurrency": concurrency,
+            "lease_ttl_s": lease_ttl_s,
+            "outcomes": outcomes,
+            "killed_replica": killed_idx,
+            "wall_s": round(wall, 3),
+            "drive_wall_s": round(drive_wall, 3),
+            "ops_per_s": round(outcomes["ok"] / drive_wall, 2)
+            if drive_wall > 0 else 0.0,
+            "p50_s": round(_percentile(latencies, 50), 4),
+            "p95_s": round(_percentile(latencies, 95), 4),
+            "p99_s": round(_percentile(latencies, 99), 4),
+            "metrics_scrapes": scrapes["count"],
+            "checks": checks,
+            "ok": all(c["ok"] for c in checks),
+        }
+        return report
+    finally:
+        pool.close()
+
+
+def record_perf(args) -> dict:
+    """`--record-perf`: run the matrix the PERF.md loadtest row promises —
+    the SAME op volume at 1 and 3 replicas — and commit ops/s + p99 via
+    perf_matrix.record_loadtest (same --round semantics as the baseline
+    matrix)."""
+    import tempfile
+
+    try:
+        import perf_matrix
+    except ImportError as e:
+        raise SystemExit(
+            "--record-perf needs the repo root on sys.path "
+            f"(run from the checkout): {e}")
+
+    rows: dict = {}
+    reports: dict = {}
+    for n in (1, 3):
+        with tempfile.TemporaryDirectory(
+                prefix=f"ko-loadtest-r{n}-") as base:
+            report = run_loadtest(
+                ops=args.ops, replicas=n, concurrency=args.concurrency,
+                lease_ttl_s=args.lease_ttl, base_dir=base)
+        reports[str(n)] = report
+        rows[str(n)] = {
+            "ops": report["ops"],
+            "concurrency": report["concurrency"],
+            "ops_per_s": report["ops_per_s"],
+            "p50_s": report["p50_s"],
+            "p99_s": report["p99_s"],
+            "ok": report["ok"],
+        }
+    round_no = perf_matrix.record_loadtest(
+        rows, getattr(args, "round", None))
+    return {"round": round_no, "rows": rows, "reports": reports,
+            "ok": all(r["ok"] for r in reports.values())}
+
+
+# ----------------------------------------------- controller-death soak ------
+def run_controller_soak(*, controllers: int, base_dir: str,
+                        lease_ttl_s: float = 2.0,
+                        settle_timeout_s: float = 120.0) -> dict:
+    """The kill drill (`koctl chaos-soak --controllers N`) — see the module
+    docstring for the scenario; every assertion reads journal rows or span
+    trees."""
+    from kubeoperator_tpu.models import ClusterSpec
+    from kubeoperator_tpu.models.span import SpanKind, SpanStatus
+    from kubeoperator_tpu.resilience import StaleEpochError
+    from kubeoperator_tpu.version import (
+        DEFAULT_K8S_VERSION,
+        SUPPORTED_K8S_VERSIONS,
+    )
+
+    t0 = time.monotonic()
+    controllers = max(controllers, 2)
+    hop = SUPPORTED_K8S_VERSIONS.index(DEFAULT_K8S_VERSION) + 1
+    if hop >= len(SUPPORTED_K8S_VERSIONS):
+        raise SystemExit(
+            "error: the controller soak needs an upgrade hop above the "
+            f"default version, but {DEFAULT_K8S_VERSION} is the newest "
+            f"supported")
+    target = SUPPORTED_K8S_VERSIONS[hop]
+
+    os.makedirs(base_dir, exist_ok=True)
+    # serial scheduler on every replica: the slow-stream holds below pin
+    # the victims inside phase 1 deterministically, which a concurrent
+    # DAG's sibling launches would dilute
+    pool = ReplicaPool(base_dir, controllers, lease_ttl_s,
+                       serial_scheduler=True)
+    checks: list[dict] = []
+
+    def check(name: str, ok, detail: str = "") -> None:
+        checks.append({"check": name, "ok": bool(ok), "detail": detail})
+
+    try:
+        victim, peer = pool[0], pool[1]
+        repos = peer.repos   # shared db: any replica's view is THE view
+        fleet_n, victims_n = 6, 3
+        _seed_hosts(victim, fleet_n + victims_n, prefix="cs")
+        pool.start_heartbeats()
+
+        # fleet targets: Ready manual clusters at the default version
+        for i in range(fleet_n):
+            victim.clusters.create(
+                f"cs-f-{i:02d}", spec=ClusterSpec(worker_count=0),
+                host_names=[f"cs-host-{i:04d}"], wait=True)
+
+        # hold the victims' first phase open: scripted slow-stream on the
+        # first 3 submissions of 01-base.yml gives a wide deterministic
+        # window in which all three creates are journaled and mid-phase
+        victim.executor.fail_times("01-base.yml", victims_n,
+                                   kind="slow-stream")
+        # and the SECOND upgrade-prepare (the first wave-1 cluster, after
+        # the canary) so the controller dies genuinely mid-wave
+        victim.executor.fail_at("20-upgrade-prepare.yml", [2],
+                                kind="slow-stream")
+
+        for i in range(victims_n):
+            victim.clusters.create(
+                f"cs-v-{i}", spec=ClusterSpec(worker_count=0),
+                host_names=[f"cs-host-{fleet_n + i:04d}"], wait=False)
+        fleet_desc = victim.fleet.upgrade(
+            target, selector={"name": "cs-f-*"}, canary=1, wave_size=3,
+            max_unavailable=1, wait=False)
+        fleet_id = fleet_desc["id"]
+
+        # arm the kill once the drill is demonstrably mid-flight: all 3
+        # creates journaled Running, the canary completed, and the wave-1
+        # child upgrade submitted
+        deadline = time.monotonic() + 60
+        armed = False
+        while time.monotonic() < deadline:
+            open_creates = [o for o in repos.operations.find(
+                kind="create", status="Running")
+                if o.cluster_name.startswith("cs-v-")]
+            status = victim.fleet.status(fleet_id)
+            children = repos.operations.children(fleet_id)
+            if (len(open_creates) == victims_n and status["completed"]
+                    and len(children) >= 2):
+                armed = True
+                break
+            time.sleep(0.02)
+        completed_before = list(victim.fleet.status(fleet_id)["completed"])
+        check("kill armed mid-flight (3 open creates, canary done, "
+              "wave-1 child submitted)", armed,
+              f"completed={completed_before}")
+
+        pool.kill(0)
+        pool.wait_dead_threads(0, timeout_s=60)
+
+        orphans = repos.operations.find(status="Running")
+        orphan_ids = {o.id for o in orphans}
+        orphan_creates = [o for o in orphans if o.kind == "create"]
+        check("replica death stranded >= 3 creates + the fleet op",
+              len(orphan_creates) >= victims_n
+              and any(o.kind == "fleet-upgrade" for o in orphans),
+              str(sorted((o.kind, o.cluster_name) for o in orphans)))
+        # crash evidence: the dead ops' span trees still show Running
+        # phase spans (nothing closed them — the SIGKILL shape)
+        running_phase_spans = [
+            s for o in orphan_creates
+            for s in peer.journal.spans_of(o.id)
+            if s.kind == SpanKind.PHASE and s.status == SpanStatus.RUNNING]
+        check("span trees show Running phase spans as crash evidence",
+              len(running_phase_spans) >= 1,
+              f"{len(running_phase_spans)} running phase spans")
+
+        check("dead replica's leases expired within the TTL window",
+              pool.wait_leases_expired(
+                  timeout_s=max(lease_ttl_s * 10, 10.0)))
+        swept = peer.reconciler.lease_sweep()
+        swept_ids = {r["op"] for r in swept}
+        check("lease sweep re-claimed every orphan exactly once",
+              swept_ids >= orphan_ids
+              and len(swept) == len({r["op"] for r in swept}),
+              f"swept={len(swept)} orphans={len(orphan_ids)}")
+        check("sweep records name the dead controller", all(
+            r.get("from_controller") == "replica-0" for r in swept),
+            str(swept[:2]))
+
+        settled = _settle(pool, settle_timeout_s)
+        check("every resumed op settled", settled)
+
+        # ---- exactly-once resume / zero double-runs, from the journal ----
+        double_runs: list[str] = []
+        resume_counts: dict[str, int] = {}
+        for i in range(victims_n):
+            name = f"cs-v-{i}"
+            rows = [o for o in repos.operations.find(kind="create")
+                    if o.cluster_name == name]
+            interrupted = [o for o in rows if o.status == "Interrupted"]
+            succeeded = [o for o in rows if o.status == "Succeeded"]
+            resume_counts[name] = len(succeeded)
+            # zero concurrent double-runs: the successor opened only after
+            # the sweep closed the orphan (journal timestamps prove no
+            # overlap), and exactly one successor ever ran
+            for orphan in interrupted:
+                for successor in succeeded:
+                    if successor.created_at < orphan.finished_at:
+                        double_runs.append(name)
+        check("each orphaned create resumed exactly once",
+              all(n == 1 for n in resume_counts.values()),
+              str(resume_counts))
+        check("zero concurrent double-runs (successor opened after the "
+              "orphan closed)", not double_runs, str(double_runs))
+        not_ready = [f"cs-v-{i}" for i in range(victims_n)
+                     if peer.clusters.get(f"cs-v-{i}").status.phase
+                     != "Ready"]
+        check("every victim cluster converged Ready", not not_ready,
+              str(not_ready))
+        # resumed ops leave healthy span trees (root OK) — the successor's
+        # tree, not the orphan's
+        resumed_roots = []
+        for i in range(victims_n):
+            rows = [o for o in repos.operations.find(kind="create")
+                    if o.cluster_name == f"cs-v-{i}"
+                    and o.status == "Succeeded"]
+            for op in rows:
+                spans = {s.id: s for s in peer.journal.spans_of(op.id)}
+                root = spans.get(op.id)
+                resumed_roots.append(
+                    root is not None and root.status == SpanStatus.OK)
+        check("successor span trees closed OK", all(resumed_roots)
+              and len(resumed_roots) == victims_n, str(resumed_roots))
+
+        # ---- fleet wave: resumed exactly once, completed not re-run ----
+        fleet_op = repos.operations.get(fleet_id)
+        fleet_status = peer.fleet.status(fleet_id)
+        check("fleet rollout finished Succeeded after failover",
+              fleet_op.status == "Succeeded", fleet_op.message)
+        check("every fleet cluster at the target version", all(
+            peer.clusters.get(f"cs-f-{i:02d}").spec.k8s_version == target
+            for i in range(fleet_n)), str(fleet_status["completed"]))
+        per_cluster: dict[str, list] = {}
+        for child in repos.operations.children(fleet_id):
+            per_cluster.setdefault(child.cluster_name, []).append(
+                child.status)
+        check("clusters completed before the kill were NOT re-run", all(
+            len(per_cluster.get(n, [])) == 1 for n in completed_before),
+            str({n: per_cluster.get(n) for n in completed_before}))
+        interrupted_children = [n for n, st in per_cluster.items()
+                                if "Interrupted" in st]
+        check("the mid-wave cluster was re-run to success exactly once",
+              len(interrupted_children) == 1
+              and per_cluster[interrupted_children[0]].count("Succeeded")
+              == 1,
+              str(per_cluster))
+
+        # ---- fencing: a post-mortem write from the dead epoch ----
+        dead_op = next(o for o in (
+            repos.operations.get(oid) for oid in orphan_ids)
+            if o.kind == "create")
+        phase_before = repos.operations.get(dead_op.id).phase
+        fenced = False
+        try:
+            victim.journal.progress(dead_op, "zombie-write", "Running")
+        except StaleEpochError:
+            fenced = True
+        check("post-mortem write from the dead epoch rejected", fenced)
+        check("fencing surfaced as an event on the dead replica",
+              len(victim.leases.fencing_events) >= 1
+              and victim.leases.fencing_events[-1].epoch
+              < victim.leases.fencing_events[-1].current_epoch,
+              str(victim.leases.fencing_events[-1:]))
+        check("journal row untouched by the rejected write",
+              repos.operations.get(dead_op.id).phase == phase_before
+              and repos.operations.get(dead_op.id).phase != "zombie-write")
+
+        # the lease gauge renders across replicas
+        from kubeoperator_tpu.api.metrics import MetricsRegistry
+
+        text = MetricsRegistry().render(peer)
+        check("ko_tpu_controller_leases gauge exported",
+              "ko_tpu_controller_leases{" in text
+              and "ko_tpu_controller_lease_heartbeat_age_seconds" in text)
+    finally:
+        pool.close()
+
+    return {
+        "controllers": controllers,
+        "lease_ttl_s": lease_ttl_s,
+        "target": target,
+        "checks": checks,
+        "ok": all(c["ok"] for c in checks),
+        "runtime_s": round(time.monotonic() - t0, 3),
+    }
+
+
+def print_checks(checks: list[dict]) -> None:
+    for c in checks:
+        mark = "ok " if c["ok"] else "FAIL"
+        print(f"  [{mark}] {c['check']}"
+              + (f" — {c['detail']}" if c["detail"] and not c["ok"]
+                 else ""))

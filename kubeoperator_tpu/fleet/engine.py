@@ -70,7 +70,9 @@ class FleetEngine:
 
     # ---- persistence helpers ----
     def _save(self) -> None:
-        self.s.repos.operations.save(self.op)
+        # fenced: a fenced-out engine (lease lost, successor resuming this
+        # rollout elsewhere) must not clobber the successor's wave ledger
+        self.journal.save_vars(self.op)
 
     def _close(self, ok: bool, message: str) -> None:
         self.journal.close(self.op, ok=ok, message=message)

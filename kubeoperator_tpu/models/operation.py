@@ -47,6 +47,13 @@ class Operation(Entity):
     # fleet linkage (migration 007): a per-cluster op launched by a fleet
     # rollout carries its fleet op's id; "" = a standalone operation
     parent_op_id: str = ""
+    # lease fencing (migration 008, resilience/lease.py): the controller
+    # replica that claimed this op's resource and the lease epoch the
+    # claim was made under. Every later journal/status write re-verifies
+    # the epoch is still current; 0 = unfenced (op predates leases, or
+    # leasing is off)
+    controller_id: str = ""
+    lease_epoch: int = 0
     finished_at: float = 0.0
     # observability: the span tree's trace id ("" = op predates tracing or
     # it was disabled); the root span's id is the operation id itself

@@ -54,6 +54,14 @@ import jax
 import jax.numpy as jnp
 
 
+def _round_tflops(v: float) -> float:
+    """1-decimal readability at TPU scale (hundreds of TFLOP/s), but keep
+    sub-0.05 CPU-tier measurements nonzero: a loaded CI host's differential
+    estimate can land below 0.05 TFLOP/s, and round(v, 1) == 0.0 would
+    erase a real positive measurement."""
+    return round(v, 1) if v >= 1.0 else round(v, 4)
+
+
 @dataclass(frozen=True)
 class MatmulResult:
     size: int
@@ -137,7 +145,7 @@ def mxu_matmul_tflops(
     est = median(draws)
     return MatmulResult(
         size=size, dtype=jnp.dtype(dtype).name, iters=iters,
-        time_s=flops / est / 1e12, tflops=round(est, 1),
-        tflops_band=(round(min(draws), 1), round(max(draws), 1)),
-        trials=tuple(round(d, 1) for d in draws),
+        time_s=flops / est / 1e12, tflops=_round_tflops(est),
+        tflops_band=(_round_tflops(min(draws)), _round_tflops(max(draws))),
+        trials=tuple(_round_tflops(d) for d in draws),
     )

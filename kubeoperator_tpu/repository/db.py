@@ -12,6 +12,7 @@ import os
 import re
 import sqlite3
 import threading
+import time
 from contextlib import contextmanager
 from typing import Iterator
 
@@ -50,17 +51,36 @@ def _split_statements(script: str) -> list[str]:
 
 class Database:
     """Process-wide SQLite handle, safe for the server's mixed
-    event-loop + worker-thread usage (WAL + serialized access)."""
+    event-loop + worker-thread usage (WAL + serialized access), and for
+    MULTI-HANDLE access: N controller replicas (separate Database
+    instances, possibly separate processes) share one WAL file for the
+    lease-based control plane (docs/resilience.md "Controller leases"), so
+    a second writer must queue politely instead of failing immediately —
+    `busy_timeout` + BEGIN IMMEDIATE + a bounded locked-retry below."""
+
+    # bounded retry on "database is locked" around BEGIN IMMEDIATE: the
+    # busy handler waits busy_timeout_ms per attempt, so the worst case is
+    # _LOCKED_RETRIES * busy_timeout before a writer gives up honestly
+    _LOCKED_RETRIES = 5
+    _LOCKED_BACKOFF_S = 0.05
 
     def __init__(self, path: str = "ko_tpu.db",
-                 synchronous: str = "NORMAL") -> None:
+                 synchronous: str = "NORMAL",
+                 busy_timeout_ms: int = 5000) -> None:
         self.path = path
         self._lock = threading.RLock()
+        self._tx_depth = 0  # nesting depth of tx() scopes (under _lock)
         self._conn = sqlite3.connect(
             path, check_same_thread=False, isolation_level=None
         )
         self._conn.row_factory = sqlite3.Row
         self._conn.execute("PRAGMA journal_mode=WAL")
+        # `db.busy_timeout_ms`: how long THIS handle's statements block on
+        # another handle's write lock before raising "database is locked".
+        # The pre-lease default of 0 made any second writer on the file
+        # fail instantly — fatal under multi-replica WAL access.
+        self._conn.execute(
+            f"PRAGMA busy_timeout={max(int(busy_timeout_ms), 0)}")
         # `db.synchronous` (utils/config.py DEFAULTS): NORMAL is the
         # standard WAL pairing — durability ordering is preserved (WAL is
         # sequential, so a crash can only lose a SUFFIX of commits, never
@@ -76,15 +96,56 @@ class Database:
 
     @contextmanager
     def tx(self) -> Iterator[sqlite3.Connection]:
-        """Serialized transaction scope."""
+        """Serialized transaction scope.
+
+        BEGIN IMMEDIATE, not deferred: the write lock is taken AT BEGIN,
+        where the busy handler applies — a deferred tx upgrading to write
+        mid-body can hit SQLITE_BUSY(_SNAPSHOT) that no busy_timeout will
+        retry, which is exactly the failure interleaved writers on one WAL
+        file would see constantly. BEGIN itself gets a bounded retry on
+        top of the per-attempt busy_timeout; once BEGIN succeeds the tx
+        body owns the write lock and cannot hit "locked" from a peer.
+
+        NESTABLE: an inner tx() under an already-open scope joins the
+        outer transaction (the RLock makes the re-entry safe; only the
+        outermost frame BEGINs/COMMITs). This is what lets a lease-epoch
+        fence check and the journal write it guards commit ATOMICALLY —
+        the journal wraps both in one tx() so no peer's CAS takeover can
+        land between check and write (resilience/journal.py). An exception
+        anywhere inside rolls back the WHOLE outermost transaction; a
+        caller that catches an inner failure and keeps writing would
+        commit a half-failed tx, so inner frames must let errors
+        propagate (the fence's StaleEpochError, a BaseException, does)."""
         with self._lock:
-            self._conn.execute("BEGIN")
+            outermost = self._tx_depth == 0
+            if outermost:
+                self._begin_immediate()
+            self._tx_depth += 1
             try:
                 yield self._conn
             except BaseException:
-                self._conn.execute("ROLLBACK")
+                self._tx_depth -= 1
+                if outermost:
+                    self._conn.execute("ROLLBACK")
                 raise
-            self._conn.execute("COMMIT")
+            self._tx_depth -= 1
+            if outermost:
+                self._conn.execute("COMMIT")
+
+    def _begin_immediate(self) -> None:
+        for attempt in range(self._LOCKED_RETRIES):
+            try:
+                self._conn.execute("BEGIN IMMEDIATE")
+                return
+            except sqlite3.OperationalError as e:
+                if "locked" not in str(e) and "busy" not in str(e):
+                    raise
+                if attempt == self._LOCKED_RETRIES - 1:
+                    raise
+                log.warning(
+                    "database %s locked by another writer; retry %d/%d",
+                    self.path, attempt + 1, self._LOCKED_RETRIES)
+                time.sleep(self._LOCKED_BACKOFF_S * (attempt + 1))
 
     def query(self, sql: str, params: tuple = ()) -> list[sqlite3.Row]:
         with self._lock:

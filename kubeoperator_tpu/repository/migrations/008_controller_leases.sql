@@ -1,0 +1,22 @@
+-- Controller leases: fenced cluster ownership for the multi-controller
+-- control plane (resilience/lease.py, docs/resilience.md "Controller
+-- leases"). One row per leased resource (a cluster id, or a fleet op id
+-- for fleet-scope operations). `epoch` is the fencing token: it is
+-- monotonic per resource — bumped ONLY when ownership changes hands — and
+-- every journal/status write carries the epoch it was claimed under, so a
+-- controller that lost its lease mid-operation (GC pause, partition,
+-- zombie thread after a crash) can never corrupt the successor's journal.
+-- Rows are never deleted (release just expires the deadline), which is
+-- what keeps the epoch monotonic across owners.
+CREATE TABLE controller_leases (
+    resource            TEXT PRIMARY KEY,
+    controller_id       TEXT NOT NULL,
+    epoch               INTEGER NOT NULL,
+    -- both stamped from the DATABASE clock (julianday('now')), never a
+    -- replica's local clock: expiry must mean the same instant to every
+    -- replica sharing the file
+    heartbeat_deadline  REAL NOT NULL,
+    renewed_at          REAL NOT NULL
+);
+CREATE INDEX idx_leases_controller ON controller_leases(controller_id);
+CREATE INDEX idx_leases_deadline ON controller_leases(heartbeat_deadline);

@@ -473,6 +473,173 @@ class SettingRepo(EntityRepo[Setting]):
     table, entity, columns = "settings", Setting, ("name",)
 
 
+# the database's own clock as epoch seconds — every lease comparison uses
+# THIS expression, never a replica's time.time(): expiry must mean the same
+# instant to every replica sharing the file, whatever their local clocks do
+DB_NOW_SQL = "(julianday('now') - 2440587.5) * 86400.0"
+
+# lease resources currently backed by a Running operation (a cluster id,
+# or the op's own id for fleet-scope ops) — the ONE definition shared by
+# the heartbeat's re-arm rule and the release guard below, so the two can
+# never disagree about what counts as live work
+RUNNING_RESOURCES_SQL = (
+    "(SELECT cluster_id FROM operations WHERE status = 'Running' "
+    " UNION "
+    " SELECT id FROM operations WHERE status = 'Running')"
+)
+
+
+class LeaseRepo:
+    """Controller leases (migration 008) — NOT an EntityRepo: lease rows
+    are plain columns mutated by single-statement compare-and-swaps, so
+    two replicas racing on one file resolve inside SQLite itself, with no
+    read-modify-write window for them to interleave in.
+
+    `epoch` is the fencing token: monotonic per resource, bumped only when
+    ownership CHANGES HANDS (a same-controller re-claim is a renewal). The
+    journal stamps every operation with the epoch it was claimed under and
+    rejects writes whose epoch is no longer current (resilience/lease.py).
+    Rows are never deleted — release just zeroes the deadline — which is
+    what keeps epochs monotonic across successive owners."""
+
+    table = "controller_leases"
+
+    def __init__(self, db: Database) -> None:
+        self.db = db
+
+    def db_now(self) -> float:
+        """The db clock (epoch seconds) — the ONE time source leases use."""
+        return float(self.db.query(f"SELECT {DB_NOW_SQL} AS t")[0]["t"])
+
+    def claim(self, resource: str, controller_id: str,
+              ttl_s: float) -> dict | None:
+        """One CAS: win if the lease is free (no row), expired, or already
+        ours (a renewal — epoch unchanged). A takeover from ANOTHER
+        controller bumps the epoch, fencing the previous holder's writes.
+        Returns the lease row on a win, None if a live foreign holder kept
+        it."""
+        with self.db.tx() as conn:
+            cur = conn.execute(
+                f"INSERT INTO {self.table} "
+                f"(resource, controller_id, epoch, heartbeat_deadline, "
+                f" renewed_at) "
+                f"VALUES (?, ?, 1, {DB_NOW_SQL} + ?, {DB_NOW_SQL}) "
+                f"ON CONFLICT(resource) DO UPDATE SET "
+                # RHS reads the PRE-update row, so the epoch bump sees the
+                # old controller_id whatever the SET order
+                f"  epoch = {self.table}.epoch + "
+                f"    ({self.table}.controller_id != excluded.controller_id), "
+                f"  controller_id = excluded.controller_id, "
+                f"  heartbeat_deadline = excluded.heartbeat_deadline, "
+                f"  renewed_at = excluded.renewed_at "
+                f"WHERE {self.table}.controller_id = excluded.controller_id "
+                f"   OR {self.table}.heartbeat_deadline < {DB_NOW_SQL}",
+                (resource, controller_id, ttl_s),
+            )
+            if cur.rowcount < 1:
+                return None   # a live foreign holder won the CAS
+            row = conn.execute(
+                f"SELECT * FROM {self.table} WHERE resource=?",
+                (resource,),
+            ).fetchone()
+        return dict(row)
+
+    def renew(self, controller_id: str, ttl_s: float) -> int:
+        """Heartbeat: extend every lease this controller holds, in one
+        statement however many clusters it owns. Live leases renew
+        unconditionally; an EXPIRED lease re-arms only while a Running
+        operation still backs it — a stalled heartbeat (long cron tick, GC
+        pause) must not forfeit a healthy in-flight op to a peer's sweep,
+        but idle expired leases stay down (a revived replica's heartbeat
+        must never resurrect stale ownership of clusters nothing is
+        running on, which would refuse peers' future claims). The WHERE on
+        controller_id makes this CAS-safe: if a peer's sweep already took
+        the resource over, the row's controller changed and this statement
+        cannot touch it. Released leases (deadline zeroed) are excluded by
+        the deadline > 0 guard."""
+        with self.db.tx() as conn:
+            cur = conn.execute(
+                f"UPDATE {self.table} SET "
+                f"  heartbeat_deadline = {DB_NOW_SQL} + ?, "
+                f"  renewed_at = {DB_NOW_SQL} "
+                f"WHERE controller_id = ? "
+                f"  AND (heartbeat_deadline >= {DB_NOW_SQL} "
+                f"       OR (heartbeat_deadline > 0 "
+                f"           AND resource IN {RUNNING_RESOURCES_SQL}))",
+                (ttl_s, controller_id),
+            )
+            return max(cur.rowcount, 0)
+
+    def release(self, resource: str, controller_id: str, epoch: int) -> bool:
+        """Expire our own lease at operation close. CAS on (controller,
+        epoch): a successor's lease is never touched by a late release
+        from the replica it fenced out. The NOT-IN guard keeps a release
+        from zeroing a lease a RUNNING operation rides: the reconciler's
+        settle-release races its own auto-resume engines' re-opens (a
+        resumed fleet rollout re-claims its wave clusters asynchronously),
+        and a same-controller re-claim keeps the epoch, so the (controller,
+        epoch) CAS alone cannot tell 'my stale sweep claim' from 'my
+        engine's live re-claim' — the journal can: open() commits its
+        claim and its Running row in one transaction."""
+        with self.db.tx() as conn:
+            cur = conn.execute(
+                f"UPDATE {self.table} SET heartbeat_deadline = 0 "
+                f"WHERE resource=? AND controller_id=? AND epoch=? "
+                f"  AND resource NOT IN {RUNNING_RESOURCES_SQL}",
+                (resource, controller_id, epoch),
+            )
+            return cur.rowcount > 0
+
+    def get(self, resource: str) -> dict | None:
+        rows = self.db.query(
+            f"SELECT *, heartbeat_deadline >= {DB_NOW_SQL} AS live "
+            f"FROM {self.table} WHERE resource=?",
+            (resource,),
+        )
+        return dict(rows[0]) if rows else None
+
+    def current_epoch(self, resource: str) -> int:
+        """The fencing check's read: the resource's current epoch (0 when
+        no lease row exists — nothing to fence against)."""
+        rows = self.db.query(
+            f"SELECT epoch FROM {self.table} WHERE resource=?", (resource,))
+        return int(rows[0]["epoch"]) if rows else 0
+
+    def expired(self) -> list[dict]:
+        """Every lease past its deadline (released rows included — the
+        sweep filters by whether open operations exist behind them)."""
+        return [dict(r) for r in self.db.query(
+            f"SELECT * FROM {self.table} "
+            f"WHERE heartbeat_deadline < {DB_NOW_SQL} ORDER BY resource")]
+
+    def state_counts(self, controller_id: str) -> dict[str, int]:
+        """{held, foreign, expired} from this controller's viewpoint — the
+        /metrics gauge's raw material, one indexed pass in SQL."""
+        rows = self.db.query(
+            f"SELECT CASE "
+            f"  WHEN heartbeat_deadline < {DB_NOW_SQL} THEN 'expired' "
+            f"  WHEN controller_id = ? THEN 'held' "
+            f"  ELSE 'foreign' END AS state, COUNT(*) AS n "
+            f"FROM {self.table} GROUP BY state",
+            (controller_id,),
+        )
+        counts = {"held": 0, "foreign": 0, "expired": 0}
+        for r in rows:
+            counts[r["state"]] = int(r["n"])
+        return counts
+
+    def max_heartbeat_age_s(self, controller_id: str) -> float | None:
+        """Oldest heartbeat age (db-now − renewed_at) across the leases
+        this controller still holds live; None when it holds none."""
+        rows = self.db.query(
+            f"SELECT MAX({DB_NOW_SQL} - renewed_at) AS age FROM {self.table} "
+            f"WHERE controller_id = ? AND heartbeat_deadline >= {DB_NOW_SQL}",
+            (controller_id,),
+        )
+        age = rows[0]["age"] if rows else None
+        return float(age) if age is not None else None
+
+
 class Repositories:
     """One bundle handed to every service (the reference injects repos into
     services the same way, SURVEY.md §2.1 row 1b)."""
@@ -501,3 +668,4 @@ class Repositories:
         self.cis_scans = CisScanRepo(db)
         self.settings = SettingRepo(db)
         self.audit = AuditRepo(db)
+        self.leases = LeaseRepo(db)

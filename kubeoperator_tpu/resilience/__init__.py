@@ -26,6 +26,12 @@ one shared vocabulary for that:
     fleet_breaker        failure-budget breaker (a CircuitBreaker reuse)
                          behind wave-based rolling upgrades (fleet.py;
                          driven by service/fleet.py + kubeoperator_tpu/fleet/)
+  * LeaseManager /     — fenced cluster ownership for N controller replicas
+    StaleEpochError      sharing one WAL db: single-statement CAS claims
+                         with monotonic fencing epochs, heartbeat renewal
+                         on the cron tick, stale-epoch write rejection
+                         (lease.py; expired leases swept by
+                         service/reconcile.py's lease sweep)
 
 Failure classification itself (TRANSIENT vs PERMANENT) lives in
 executor/base.py next to TaskResult, because every backend finishes tasks
@@ -58,10 +64,18 @@ from kubeoperator_tpu.resilience.fleet import (
     fleet_breaker,
     note_unavailable,
 )
+from kubeoperator_tpu.resilience.lease import (
+    FencingEvent,
+    LeaseConfig,
+    LeaseManager,
+    StaleEpochError,
+    lease_wiring,
+)
 
 __all__ = ["RetryPolicy", "retry_call", "retry_wiring",
            "ChaosConfig", "ChaosExecutor", "ControllerDeath",
            "IN_FLIGHT_PHASES", "OperationJournal", "default_journal",
            "CIRCUIT_CLOSED", "CIRCUIT_OPEN", "CircuitBreaker",
            "WatchdogConfig", "FleetConfig", "fleet_breaker",
-           "note_unavailable"]
+           "note_unavailable", "FencingEvent", "LeaseConfig",
+           "LeaseManager", "StaleEpochError", "lease_wiring"]

@@ -146,6 +146,7 @@ class ChaosExecutor(Executor):
         self._counters: dict[tuple, int] = {}    # submissions seen per key
         self._scheduled: dict[tuple, dict] = {}  # key -> {abs index: kind}
         self._death_submissions = 0   # submissions of the doomed playbook
+        self._dead = ""               # die_now(): permanent death reason
         # per-key deterministic draw streams, all derived from the ONE
         # seed the caller passed: concurrent DAG phases may submit in any
         # wall-clock order without reassigning another key's draws
@@ -173,6 +174,19 @@ class ChaosExecutor(Executor):
         counts submissions of the doomed playbook and fires on the Nth —
         submissions 1..N-1 run normally."""
         with self._ledger_lock:
+            if self._dead:
+                # die_now() mode: the whole REPLICA is dead, not one phase
+                # — every operation thread of this stack dies at its next
+                # submission, which is how an in-process drill SIGKILLs a
+                # controller that has several ops and a fleet wave in
+                # flight at once (the one-shot die_at_phase below kills
+                # exactly one thread and clears itself)
+                self.injections.append(Injection(
+                    task_id="", playbook=spec.playbook
+                    or f"adhoc:{spec.adhoc_module}",
+                    kind="controller-death",
+                ))
+                raise ControllerDeath(self._dead)
             if self.config.die_at_phase:
                 doomed, _, nth = self.config.die_at_phase.partition("#")
                 if spec.playbook == doomed:
@@ -190,6 +204,18 @@ class ChaosExecutor(Executor):
                             f"{self._death_submissions})"
                         )
         return super().run(spec, task_id)
+
+    def die_now(self, reason: str = "simulated controller death "
+                                    "(replica killed)") -> None:
+        """Flip the wrapper into PERMANENT controller-death mode: every
+        subsequent submission on any thread raises ControllerDeath. The
+        multi-replica drills' kill switch — a real SIGKILL takes every
+        in-flight operation of the process down with it, so the simulated
+        one must too. There is deliberately no way to revive: a killed
+        replica's work comes back only through a peer's lease sweep (or a
+        fresh stack's boot sweep)."""
+        with self._ledger_lock:
+            self._dead = reason
 
     # ---- scripting (deterministic sequences for tests/recipes) ----
     def fail_times(self, playbook: str, times: int,

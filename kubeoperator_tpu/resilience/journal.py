@@ -17,9 +17,22 @@ operation id), attach() hands the adm engine a Tracer bound to the op, and
 close()/interrupt() finish the root span — so every operation leaves one
 durable `operation → phase → attempt → task → host` tree behind, keyed by
 the same id the journal row carries.
+
+Multi-controller fencing (resilience/lease.py, docs/resilience.md
+"Controller leases"): when a LeaseManager is wired in, open()/open_fleet()
+claim the operation's resource (the cluster id; the op id for fleet-scope
+ops) and stamp the claim's epoch onto the op row, and EVERY later write
+through this module — progress, frontier, phase flips, attached cluster
+saves, close — re-verifies that epoch is still current. A controller that
+lost its lease mid-operation gets StaleEpochError (a BaseException, like
+ControllerDeath) instead of corrupting the successor's journal.
+interrupt() is deliberately unfenced: it is the SWEEPING successor's verb,
+run under a newer epoch than the dead op ever carried.
 """
 
 from __future__ import annotations
+
+from contextlib import contextmanager
 
 from kubeoperator_tpu.models import Cluster, Operation, OperationStatus
 from kubeoperator_tpu.models.cluster import ClusterPhaseStatus
@@ -57,14 +70,62 @@ def default_journal(repos, journal=None) -> "OperationJournal":
 class OperationJournal:
     def __init__(self, repos, tracing: bool = True,
                  max_spans_per_op: int = 2000,
-                 retain_operations: int = 200) -> None:
+                 retain_operations: int = 200,
+                 leases=None) -> None:
         self.repos = repos
         self.tracing = tracing
         self.max_spans_per_op = max_spans_per_op
         self.retain_operations = retain_operations
+        # fenced ownership (resilience/lease.py LeaseManager): None =
+        # direct construction (tests, single-writer stacks) — unfenced,
+        # bit-identical to the pre-lease journal
+        self.leases = leases
         # one live Tracer per open op, so attach() and close() share the
         # same span-budget accounting; entries drop at close/interrupt
         self._tracers: dict[str, Tracer] = {}
+
+    # ---- lease fencing ----
+    @staticmethod
+    def resource_of(op: Operation) -> str:
+        """The lease resource an op's writes are fenced on: its cluster,
+        or — for fleet-scope ops (cluster_id == "") — the op id itself."""
+        return op.cluster_id or op.id
+
+    def _claim(self, op: Operation) -> None:
+        """Claim the op's resource and stamp the fencing token onto the
+        row (raises ConflictError when a LIVE peer holds the lease — the
+        cross-replica one-op-per-cluster guard)."""
+        if self.leases is None:
+            return
+        row = self.leases.claim(self.resource_of(op))
+        if row is not None:
+            op.controller_id = str(row["controller_id"])
+            op.lease_epoch = int(row["epoch"])
+
+    def _fence(self, op: Operation, what: str) -> None:
+        """Reject the write if the op's claim epoch is no longer current
+        (raises StaleEpochError, a BaseException — see module docstring)."""
+        if self.leases is not None and op.lease_epoch:
+            self.leases.verify(self.resource_of(op), op.lease_epoch,
+                               what=what)
+
+    @contextmanager
+    def _fenced(self, op: Operation, what: str):
+        """Fence check + the write(s) it guards in ONE transaction: the
+        epoch read and the journal write commit atomically under the db
+        write lock, so a peer's CAS takeover (its own BEGIN IMMEDIATE)
+        can never land between check and write. A bare _fence() before a
+        separate save would be check-then-act — a fenced-out writer could
+        still clobber the successor's row in the gap."""
+        with self.repos.operations.db.tx():
+            self._fence(op, what)
+            yield
+
+    def _release(self, op: Operation) -> None:
+        """Expire our lease at operation close (CAS'd on our epoch, so a
+        successor's newer lease is never touched)."""
+        if self.leases is not None and op.lease_epoch:
+            self.leases.release(self.resource_of(op), op.lease_epoch)
 
     # ---- lifecycle ----
     def open(self, cluster: Cluster, kind: str,
@@ -90,7 +151,15 @@ class OperationJournal:
             parent_op_id=parent_op_id,
             trace_id=(trace_id or new_trace_id()) if self.tracing else "",
         )
-        self.repos.operations.save(op)
+        # claim + Running row in ONE transaction: a live peer's lease
+        # refuses the op outright (ConflictError, nothing saved) — the
+        # cross-replica one-op-per-cluster guard — and the atomicity is
+        # load-bearing the other way too: LeaseRepo.release's not-while-
+        # running guard can only trust the journal if a claim is never
+        # visible without its Running row (or vice versa)
+        with self.repos.operations.db.tx():
+            self._claim(op)
+            self.repos.operations.save(op)
         if self.tracing:
             # root span id == operation id, by contract: close/interrupt
             # (possibly in a different process after a crash+reboot) can
@@ -118,7 +187,12 @@ class OperationJournal:
             vars=dict(vars or {}), message=message,
             trace_id=new_trace_id() if self.tracing else "",
         )
-        self.repos.operations.save(op)
+        # fleet-scope lease keyed by the op's own id (no single cluster
+        # owns a rollout); claim + Running row in one transaction, same
+        # atomicity contract as open()
+        with self.repos.operations.db.tx():
+            self._claim(op)
+            self.repos.operations.save(op)
         if self.tracing:
             self.repos.spans.save(Span(
                 id=op.id, trace_id=op.trace_id, parent_id="", op_id=op.id,
@@ -133,10 +207,16 @@ class OperationJournal:
         preserved `vars` state intact, and the root span re-armed so the
         eventual close stamps the REAL end of the rollout (a resumed
         rollout is one operation, not two)."""
-        op.status = OperationStatus.RUNNING.value
-        op.finished_at = 0.0
-        op.message = message
-        self.repos.operations.save(op)
+        # re-claim on resume: the resuming replica may not be the one that
+        # opened the rollout — a takeover bumps the epoch, fencing any late
+        # writes from the previous owner's threads. One transaction with
+        # the Running flip, same atomicity contract as open()
+        with self.repos.operations.db.tx():
+            self._claim(op)
+            op.status = OperationStatus.RUNNING.value
+            op.finished_at = 0.0
+            op.message = message
+            self.repos.operations.save(op)
         if self.tracing and op.trace_id:
             try:
                 root = self.repos.spans.get(op.id)
@@ -184,8 +264,16 @@ class OperationJournal:
         return tracer
 
     def set_phase(self, cluster: Cluster,
-                  phase: ClusterPhaseStatus) -> None:
-        """The journaled in-flight phase write (KO-P007's sanctioned path)."""
+                  phase: ClusterPhaseStatus,
+                  op: Operation | None = None) -> None:
+        """The journaled in-flight phase write (KO-P007's sanctioned path).
+        `op` is the owning operation when the caller has one in hand —
+        passing it fences the flip with the op's lease epoch."""
+        if op is not None:
+            with self._fenced(op, f"phase flip to {phase.value}"):
+                cluster.status.phase = phase.value
+                self.repos.clusters.save(cluster)
+            return
         cluster.status.phase = phase.value
         self.repos.clusters.save(cluster)
 
@@ -194,12 +282,13 @@ class OperationJournal:
         """Per-phase progress from the adm engine (via AdmContext.on_phase):
         the journal row tracks how far the operation got, so an interrupted
         op reads 'died during kube-master', not just 'died'."""
-        op.phase = phase_name
-        op.phase_status = phase_status
+        with self._fenced(op, f"progress {phase_name}={phase_status}"):
+            op.phase = phase_name
+            op.phase_status = phase_status
+            self.repos.operations.save(op)
         # log correlation: every record the worker thread emits from here
         # on names the phase it was in (observability/logging.py)
         bind_trace(phase=phase_name)
-        self.repos.operations.save(op)
 
     def record_frontier(self, op: Operation, frontier: dict) -> None:
         """Persist the DAG scheduler's resume frontier ({"running": [...],
@@ -208,28 +297,58 @@ class OperationJournal:
         says exactly which DAG nodes were in flight (and the reconciler's
         Interrupted verdict can quote them). Same durable-state-in-vars
         pattern fleet waves use."""
-        op.vars["frontier"] = {
-            "running": list(frontier.get("running", [])),
-            "pending": list(frontier.get("pending", [])),
-        }
-        self.repos.operations.save(op)
+        with self._fenced(op, "frontier save"):
+            op.vars["frontier"] = {
+                "running": list(frontier.get("running", [])),
+                "pending": list(frontier.get("pending", [])),
+            }
+            self.repos.operations.save(op)
+
+    def save_vars(self, op: Operation) -> None:
+        """Fenced raw op-row save for engines that keep resumable state in
+        `op.vars` (the fleet wave scheduler persists its whole wave ledger
+        this way at every cluster boundary) — same epoch fence as every
+        other journal write, so a fenced-out engine cannot clobber the
+        state a successor is resuming from."""
+        with self._fenced(op, "op vars save"):
+            self.repos.operations.save(op)
 
     def attach(self, op: Operation, ctx) -> None:
         """Wire an AdmContext's phase hook to this op's progress record and
         hand the engine the op's tracer. Runs on the operation's worker
-        thread, so the log trace context binds to the right thread."""
+        thread, so the log trace context binds to the right thread.
+
+        Under a lease, the context's cluster-save sink is wrapped with the
+        same epoch fence the journal writes run — so the adm engine's
+        per-phase condition/status saves are rejected too once this
+        replica loses the cluster (the "fenced progress writes" half of
+        the contract; journal progress rides on_phase and is fenced in
+        progress() itself)."""
         ctx.on_phase = lambda name, status: self.progress(op, name, status)
         ctx.on_frontier = lambda frontier: self.record_frontier(op, frontier)
+        if self.leases is not None and op.lease_epoch:
+            save = ctx.save_cluster
+
+            def fenced_save(cluster) -> None:
+                with self._fenced(op, "cluster status save"):
+                    save(cluster)
+
+            ctx.save_cluster = fenced_save
         ctx.tracer = self.tracer_for(op)
         bind_trace(trace_id=op.trace_id or None, op_id=op.id,
                    cluster=op.cluster_name)
 
     def close(self, op: Operation, ok: bool, message: str = "") -> Operation:
-        op.status = (OperationStatus.SUCCEEDED.value if ok
-                     else OperationStatus.FAILED.value)
-        op.message = message
-        op.finished_at = now_ts()
-        self.repos.operations.save(op)
+        # a close from a fenced-out replica must not overwrite the verdict
+        # the successor's journal now owns (its sweep already closed or
+        # resumed this op) — reject it like any other stale write
+        with self._fenced(op, f"close ok={ok}"):
+            op.status = (OperationStatus.SUCCEEDED.value if ok
+                         else OperationStatus.FAILED.value)
+            op.message = message
+            op.finished_at = now_ts()
+            self.repos.operations.save(op)
+        self._release(op)
         self._finish_root(op, SpanStatus.OK if ok else SpanStatus.FAILED,
                           message)
         # unbind the log context bound at attach: close() runs on the

@@ -1,0 +1,210 @@
+"""LeaseManager — fenced cluster ownership for a multi-controller control
+plane (docs/resilience.md "Controller leases").
+
+Every robustness primitive before this assumed ONE controller process in
+front of one SQLite file; a controller crash paused the whole fleet until
+that process rebooted. This module is the ownership layer that lets N
+controller replicas share the file safely:
+
+  * each replica has a STABLE controller id (survives restarts — a
+    rebooted replica must recognize its own orphaned leases) and claims a
+    resource (a cluster id, or a fleet op id) with a single-statement
+    compare-and-swap (repository/repos.py LeaseRepo.claim);
+  * a claim bumps the lease `epoch` ONLY when ownership changes hands —
+    the epoch is the fencing token. The operation journal stamps every op
+    with the epoch it was claimed under, and `verify()` rejects any
+    journal/status write whose epoch is no longer current, so a replica
+    that lost its lease mid-phase (GC pause, partition, zombie thread
+    after a simulated SIGKILL) cannot corrupt the successor's journal;
+  * held leases are renewed on the cron heartbeat tick; a lease whose
+    deadline passes without renewal is DEAD-controller evidence, and the
+    reconciler's lease sweep (service/reconcile.py) claims it, interrupts
+    the orphaned ops, and (under `resilience.reconcile.auto_resume`)
+    resumes them on the claiming replica;
+  * all expiry comparisons run against the DATABASE clock
+    (repository/repos.py DB_NOW_SQL), never a replica's time.time() —
+    replicas with skewed local clocks must still agree on which leases
+    are live.
+
+`StaleEpochError` derives from BaseException for the same reason chaos
+`ControllerDeath` does: a fenced-out writer is, by definition, a process
+the rest of the system already declared dead. The error must tear through
+the phase engine and every service except-handler WITHOUT running their
+condition/journal bookkeeping — the successor owns those rows now — and is
+caught only at operation-thread boundaries, where it is logged as the
+fencing event it is.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from dataclasses import dataclass
+
+from kubeoperator_tpu.utils.errors import ConflictError
+from kubeoperator_tpu.utils.logging import get_logger
+
+log = get_logger("resilience.lease")
+
+
+class StaleEpochError(BaseException):
+    """A journal/status write carried a lease epoch that is no longer
+    current: the writer lost its lease and a successor claimed the
+    resource. Deliberately a BaseException — see the module docstring."""
+
+    def __init__(self, resource: str, epoch: int, current: int,
+                 what: str = "") -> None:
+        self.resource = resource
+        self.epoch = epoch
+        self.current = current
+        self.what = what
+        super().__init__(
+            f"stale lease epoch {epoch} for {resource!r} (current {current})"
+            + (f" rejected: {what}" if what else "")
+        )
+
+
+@dataclass
+class FencingEvent:
+    """Audit row for one rejected stale-epoch write — the drill's proof
+    that a dead replica's post-mortem write was refused."""
+
+    resource: str
+    epoch: int
+    current_epoch: int
+    what: str
+
+
+@dataclass
+class LeaseConfig:
+    """The `lease.*` config block (utils/config.py DEFAULTS)."""
+
+    enabled: bool = True
+    # "" = hostname. MUST be stable across restarts of the same replica
+    # (a rebooted controller sweeps its own leases at boot) and UNIQUE
+    # across replicas (set lease.controller_id per replica in any
+    # multi-controller deployment).
+    controller_id: str = ""
+    ttl_s: float = 60.0
+    heartbeat_interval_s: float = 10.0
+
+    @classmethod
+    def from_config(cls, config) -> "LeaseConfig":
+        base = cls()
+        return cls(
+            enabled=bool(config.get("lease.enabled", base.enabled)),
+            controller_id=str(
+                config.get("lease.controller_id", "") or ""),
+            ttl_s=float(config.get("lease.ttl_s", base.ttl_s)),
+            heartbeat_interval_s=float(config.get(
+                "lease.heartbeat_interval_s", base.heartbeat_interval_s)),
+        )
+
+
+class LeaseManager:
+    """One per Services stack. `repo` is the Repositories.leases CAS repo;
+    everything here is policy over those single-statement primitives."""
+
+    def __init__(self, repo, config: LeaseConfig | None = None) -> None:
+        self.repo = repo
+        self.config = config or LeaseConfig()
+        self.controller_id = (self.config.controller_id
+                              or socket.gethostname())
+        # rejected stale writes, kept in memory for the drill/operator
+        # surface; the durable side is the journal rows the write did NOT
+        # change
+        self.fencing_events: list[FencingEvent] = []
+        self._events_lock = threading.Lock()
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.config.enabled)
+
+    # ---- ownership ----
+    def claim(self, resource: str) -> dict | None:
+        """Claim (or renew) the resource for this controller; raises
+        ConflictError when a LIVE peer holds it — the cross-replica
+        analogue of the per-process one-op-per-cluster registry."""
+        if not self.enabled:
+            return None
+        row = self.try_claim(resource)
+        if row is None:
+            holder = self.repo.get(resource) or {}
+            raise ConflictError(
+                kind="controller-lease", name=resource,
+                message=(
+                    f"resource {resource!r} is leased by controller "
+                    f"{holder.get('controller_id', '?')!r} (epoch "
+                    f"{holder.get('epoch', '?')}); a live replica owns it"
+                ),
+            )
+        return row
+
+    def try_claim(self, resource: str) -> dict | None:
+        """CAS claim; None when a live foreign holder kept the lease."""
+        if not self.enabled:
+            return None
+        row = self.repo.claim(resource, self.controller_id,
+                              self.config.ttl_s)
+        if row is not None and row["epoch"] > 1:
+            log.info("lease %s claimed by %s at epoch %d", resource,
+                     self.controller_id, row["epoch"])
+        return row
+
+    def heartbeat(self) -> int:
+        """Renew every unexpired lease this controller holds (the cron
+        tick's call). Returns how many were renewed."""
+        if not self.enabled:
+            return 0
+        return self.repo.renew(self.controller_id, self.config.ttl_s)
+
+    def release(self, resource: str, epoch: int) -> bool:
+        """Expire our lease at operation close; a successor's lease (newer
+        epoch / other controller) is never touched."""
+        if not self.enabled:
+            return False
+        return self.repo.release(resource, self.controller_id, int(epoch))
+
+    # ---- fencing ----
+    def verify(self, resource: str, epoch: int, what: str = "") -> None:
+        """The fencing check every journal/status write runs: the write's
+        epoch must still be the resource's CURRENT epoch. Epoch 0 marks an
+        op that predates leases (or a stack with leasing off) — unfenced
+        by contract."""
+        if not self.enabled or not epoch:
+            return
+        current = self.repo.current_epoch(resource)
+        if current == int(epoch):
+            return
+        event = FencingEvent(resource=resource, epoch=int(epoch),
+                             current_epoch=current, what=what)
+        with self._events_lock:
+            self.fencing_events.append(event)
+        log.warning(
+            "FENCED stale-epoch write on %s: epoch %d is no longer current "
+            "(%d)%s — this replica lost its lease; a successor owns the "
+            "journal now", resource, epoch, current,
+            f" [{what}]" if what else "")
+        raise StaleEpochError(resource, int(epoch), current, what)
+
+    # ---- introspection ----
+    def holder(self, resource: str) -> dict | None:
+        """The lease row (with a `live` flag) or None."""
+        return self.repo.get(resource) if self.enabled else None
+
+    def expired(self) -> list[dict]:
+        return self.repo.expired() if self.enabled else []
+
+    def state_counts(self) -> dict[str, int]:
+        return (self.repo.state_counts(self.controller_id) if self.enabled
+                else {"held": 0, "foreign": 0, "expired": 0})
+
+    def max_heartbeat_age_s(self) -> float | None:
+        return (self.repo.max_heartbeat_age_s(self.controller_id)
+                if self.enabled else None)
+
+
+def lease_wiring(config, repos) -> LeaseManager:
+    """Container hook (same pattern as retry_wiring/scheduler_wiring): ONE
+    LeaseManager per stack, over the shared Repositories.leases repo."""
+    return LeaseManager(repos.leases, LeaseConfig.from_config(config))

@@ -355,25 +355,28 @@ class ClusterService:
                 )
                 self.repos.clusters.save(cluster)
                 self._provision(cluster, plan, op=op)
-                self.journal.set_phase(cluster, ClusterPhaseStatus.DEPLOYING)
+                self.journal.set_phase(cluster, ClusterPhaseStatus.DEPLOYING,
+                                       op=op)
                 ctx = self._context(cluster, plan)
                 self.journal.attach(op, ctx)
                 self.adm.run(ctx, create_phases())
-                self._finish_ready(cluster)
+                self._finish_ready(cluster, op=op)
                 self.journal.close(op, ok=True)
             except PhaseError as e:
-                cluster.status.phase = ClusterPhaseStatus.FAILED.value
                 cluster.status.message = e.message
-                self.repos.clusters.save(cluster)
+                self.journal.set_phase(cluster,
+                                       ClusterPhaseStatus.FAILED,
+                                       op=op)
                 self.journal.close(op, ok=False, message=e.message)
                 self.events.emit(cluster.id, "Warning", "SliceScaleFailed",
                                  f"phase {e.phase}: {e.message}")
                 if wait:
                     raise
             except Exception as e:
-                cluster.status.phase = ClusterPhaseStatus.FAILED.value
                 cluster.status.message = str(e)
-                self.repos.clusters.save(cluster)
+                self.journal.set_phase(cluster,
+                                       ClusterPhaseStatus.FAILED,
+                                       op=op)
                 self.journal.close(op, ok=False, message=str(e))
                 self.events.emit(cluster.id, "Warning", "SliceScaleFailed",
                                  str(e))
@@ -523,9 +526,10 @@ class ClusterService:
                 self.events.emit(cluster.id, "Normal", "ClusterDeleted",
                                  f"cluster {name} deleted")
             except Exception as e:
-                cluster.status.phase = ClusterPhaseStatus.FAILED.value
                 cluster.status.message = f"delete failed: {e}"
-                self.repos.clusters.save(cluster)
+                self.journal.set_phase(cluster,
+                                       ClusterPhaseStatus.FAILED,
+                                       op=op)
                 self.journal.close(op, ok=False, message=str(e))
                 self.events.emit(cluster.id, "Warning", "ClusterDeleteFailed", str(e))
                 raise
@@ -583,7 +587,8 @@ class ClusterService:
         """Terraform leg of §3.1 (plan mode only). `op` is the owning
         journal operation; the terraform leg is recorded as a synthetic
         'provision' phase so an interrupted op can say it died in IaaS."""
-        self.journal.set_phase(cluster, ClusterPhaseStatus.PROVISIONING)
+        self.journal.set_phase(cluster, ClusterPhaseStatus.PROVISIONING,
+                               op=op)
         if op is not None:
             self.journal.progress(op, "provision", "Running")
         region = self.repos.regions.get(plan.region_id)
@@ -716,25 +721,28 @@ class ClusterService:
                     or not self.repos.nodes.find(cluster_id=cluster.id)
                 ):
                     self._provision(cluster, plan, op=op)
-                self.journal.set_phase(cluster, ClusterPhaseStatus.DEPLOYING)
+                self.journal.set_phase(cluster, ClusterPhaseStatus.DEPLOYING,
+                                       op=op)
                 ctx = self._context(cluster, plan)
                 self.journal.attach(op, ctx)
                 self.adm.run(ctx, create_phases())
-                self._finish_ready(cluster)
+                self._finish_ready(cluster, op=op)
                 self.journal.close(op, ok=True)
             except PhaseError as e:
-                cluster.status.phase = ClusterPhaseStatus.FAILED.value
                 cluster.status.message = e.message
-                self.repos.clusters.save(cluster)
+                self.journal.set_phase(cluster,
+                                       ClusterPhaseStatus.FAILED,
+                                       op=op)
                 self.journal.close(op, ok=False, message=e.message)
                 self.events.emit(cluster.id, "Warning", "ClusterCreateFailed",
                                  f"phase {e.phase}: {e.message}")
                 if wait:
                     raise
             except Exception as e:
-                cluster.status.phase = ClusterPhaseStatus.FAILED.value
                 cluster.status.message = str(e)
-                self.repos.clusters.save(cluster)
+                self.journal.set_phase(cluster,
+                                       ClusterPhaseStatus.FAILED,
+                                       op=op)
                 self.journal.close(op, ok=False, message=str(e))
                 self.events.emit(cluster.id, "Warning", "ClusterCreateFailed", str(e))
                 if wait:
@@ -774,15 +782,16 @@ class ClusterService:
         def work():
             try:
                 self._provision(cluster, plan, op=op)
-                cluster.status.phase = ClusterPhaseStatus.READY.value
-                self.repos.clusters.save(cluster)
+                self.journal.set_phase(cluster, ClusterPhaseStatus.READY,
+                                       op=op)
                 self.journal.close(op, ok=True)
                 self.events.emit(cluster.id, "Normal", "Reprovisioned",
                                  f"machine fleet of {name} reconciled")
             except Exception as e:
-                cluster.status.phase = ClusterPhaseStatus.FAILED.value
                 cluster.status.message = str(e)
-                self.repos.clusters.save(cluster)
+                self.journal.set_phase(cluster,
+                                       ClusterPhaseStatus.FAILED,
+                                       op=op)
                 self.journal.close(op, ok=False, message=str(e))
                 self.events.emit(cluster.id, "Warning", "ReprovisionFailed",
                                  str(e))
@@ -803,11 +812,14 @@ class ClusterService:
             with open(kc_path, encoding="utf-8") as f:
                 cluster.kubeconfig = f.read()
 
-    def _finish_ready(self, cluster: Cluster) -> None:
+    def _finish_ready(self, cluster: Cluster, op=None) -> None:
+        # the Ready flip rides the fenced set_phase path: a replica that
+        # finished its last phase but lost the lease must not clobber the
+        # cluster row a successor is resuming (journal.close alone would
+        # fence too late — after this write already landed)
         self._store_kubeconfig(cluster)
-        cluster.status.phase = ClusterPhaseStatus.READY.value
         cluster.status.message = ""
-        self.repos.clusters.save(cluster)
+        self.journal.set_phase(cluster, ClusterPhaseStatus.READY, op=op)
         detail = ""
         if cluster.spec.tpu_enabled:
             sim = " simulated" if cluster.status.smoke_simulated else ""
@@ -829,9 +841,22 @@ class ClusterService:
         flip, a persisted plan change) goes there — inside the thread it
         races the first poll, before admission it leaks on ConflictError.
         A pre_start failure releases the registration."""
+        from kubeoperator_tpu.resilience import StaleEpochError
+
         def guarded():
             try:
                 work()
+            except StaleEpochError as e:
+                # the lease fence killed a zombie operation thread: this
+                # replica lost the cluster and a successor owns the
+                # journal now — nothing here may write another byte (the
+                # service error paths would clobber the successor's rows,
+                # which is exactly what the fence exists to stop). Logged
+                # and dropped at the thread boundary; the LeaseManager
+                # recorded the fencing event.
+                log.warning("operation thread fenced out: %s", e)
+                if wait:
+                    raise
             finally:
                 with self._ops_lock:
                     self._ops.pop(cluster_id, None)

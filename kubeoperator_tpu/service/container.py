@@ -76,7 +76,11 @@ class Services:
         # writes the same durable in-flight record the boot reconciler
         # sweeps after a controller crash
         from kubeoperator_tpu.adm import scheduler_wiring
-        from kubeoperator_tpu.resilience import OperationJournal, retry_wiring
+        from kubeoperator_tpu.resilience import (
+            OperationJournal,
+            lease_wiring,
+            retry_wiring,
+        )
 
         retry_policy, retry_rng = retry_wiring(config)
         # ONE phase-DAG scheduler posture (scheduler.* config block) for
@@ -84,6 +88,12 @@ class Services:
         # families with declared Phase.after edges run concurrently up to
         # max_concurrent_phases, everything else stays serial
         scheduler = scheduler_wiring(config)
+        # ONE lease manager per replica (lease.* config block): fenced
+        # cluster ownership for the multi-controller control plane — every
+        # journal op claims its cluster under this replica's stable id and
+        # carries the claim's epoch as a fencing token
+        # (docs/resilience.md "Controller leases")
+        self.leases = lease_wiring(config, repos)
         # the journal is also the trace anchor (docs/observability.md):
         # every operation it opens gets a durable span tree under the
         # observability.* knobs
@@ -94,6 +104,7 @@ class Services:
                 config.get("observability.max_spans_per_op", 2000)),
             retain_operations=int(
                 config.get("observability.retain_operations", 200)),
+            leases=self.leases,
         )
         self.clusters = ClusterService(
             repos, executor, provisioner, self.events, config,
@@ -172,7 +183,8 @@ def build_services(
         json_logs=bool(config.get("observability.json_logs", False)),
     )
     db = Database(config.get("db.path", "ko_tpu.db"),
-                  synchronous=str(config.get("db.synchronous", "NORMAL")))
+                  synchronous=str(config.get("db.synchronous", "NORMAL")),
+                  busy_timeout_ms=int(config.get("db.busy_timeout_ms", 5000)))
     repos = Repositories(db)
     from kubeoperator_tpu.utils.i18n import set_default_locale
 

@@ -62,6 +62,7 @@ class CronService:
         self._last_tick: datetime | None = None
         self._health_last = 0.0
         self._event_sync_last = 0.0
+        self._lease_last = 0.0
 
     def start(self) -> None:
         if self._thread is not None:
@@ -167,8 +168,41 @@ class CronService:
                                 cluster.name, e)
         return actions
 
+    # ---- lease heartbeat + sweep (public for tests/drills) ----
+    def lease_tick(self) -> list[str]:
+        """Multi-controller upkeep, on the loop's 10s cadence rather than
+        the 1-minute cron grid (a lease TTL is seconds, not minutes):
+        renew every lease this replica holds, then sweep leases whose
+        holder stopped heartbeating — the claiming side of controller
+        failover (service/reconcile.py lease_sweep). Rate-limited by
+        `lease.heartbeat_interval_s`."""
+        actions: list[str] = []
+        leases = getattr(self.services, "leases", None)
+        if leases is None or not leases.enabled:
+            return actions
+        interval = leases.config.heartbeat_interval_s
+        now = time.time()
+        if now - self._lease_last < interval:
+            return actions
+        self._lease_last = now
+        try:
+            renewed = leases.heartbeat()
+            if renewed:
+                actions.append(f"lease-renew:{renewed}")
+        except Exception:
+            log.exception("lease heartbeat failed")
+        try:
+            for record in self.services.reconciler.lease_sweep():
+                actions.append(
+                    "lease-sweep:"
+                    f"{record.get('cluster') or record.get('op')}")
+        except Exception:
+            log.exception("lease sweep failed")
+        return actions
+
     def _loop(self) -> None:
         while not self._stop.wait(10.0):
+            self.lease_tick()
             now = datetime.now().replace(second=0, microsecond=0)
             if self._last_tick is None:
                 self._last_tick = now - timedelta(minutes=1)

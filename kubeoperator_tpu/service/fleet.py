@@ -166,8 +166,18 @@ class FleetService:
         engine = FleetEngine(self.s, op, pause, abort)
 
         def guarded():
+            from kubeoperator_tpu.resilience import StaleEpochError
+
             try:
                 engine.run(wait=wait)
+            except StaleEpochError as e:
+                # fenced-out engine: this replica lost the rollout's lease
+                # and a successor resumed it elsewhere — the engine thread
+                # must die WITHOUT touching the op row (the successor owns
+                # the wave ledger now); see resilience/lease.py
+                log.warning("fleet engine fenced out: %s", e)
+                if wait:
+                    raise
             finally:
                 with self._lock:
                     self._threads.pop(op.id, None)

@@ -1,14 +1,16 @@
-"""ReconcileService — the boot-time sweep that makes controller death
-routine instead of an operator page.
+"""ReconcileService — the sweeps that make controller death routine
+instead of an operator page.
 
 Lifecycle operations run on threads inside the service container; a
 `kill -9` (or OOM, or node loss) of the controller mid-create leaves the
 cluster stranded in an in-flight phase (`Deploying`/`Scaling`/...) with no
 thread behind it — before this PR, forever. The operation journal
-(resilience/journal.py) records what was in flight; this service runs at
-container start (service/container.py), when by construction NO operation
-thread can exist yet, so every open journal op and every in-flight cluster
-is an orphan:
+(resilience/journal.py) records what was in flight; two sweeps turn that
+record into recovery:
+
+* **Boot sweep** (container start): when by construction no operation
+  thread exists in THIS process, every open journal op this replica owns
+  is an orphan:
 
   1. every open (`Running`) journal op is marked `Interrupted`, preserving
      the resume point (the cluster's first pending condition);
@@ -18,10 +20,26 @@ is an orphan:
   3. with `resilience.reconcile.auto_resume` on, interrupted operations
      whose resume path is safe re-enter automatically: create-shaped ops
      through `ClusterService.retry` (terraform re-apply reconciles the
-     fleet, the phase engine re-enters at the first non-OK condition) and
-     terminations through `ClusterService.delete`. Everything else
-     (upgrade, backup, day-2, components) stays Failed for the operator —
-     those verbs need their original arguments and human judgment.
+     fleet, the phase engine re-enters at the first non-OK condition),
+     terminations through `ClusterService.delete`, and fleet rollouts
+     through `FleetService.resume` (their `vars` carry the waves).
+     Everything else (upgrade, backup, day-2, components) stays Failed for
+     the operator — those verbs need their original arguments and human
+     judgment.
+
+  Multi-controller posture (resilience/lease.py): an open op whose lease
+  is live and held by a DIFFERENT controller is NOT an orphan — a peer
+  replica is running it right now — so the boot sweep skips it.
+
+* **Lease sweep** (`lease_sweep`, the cron lease tick): the failover half
+  of the multi-controller contract. A lease whose holder stopped
+  heartbeating past its TTL is dead-controller evidence; this replica
+  CLAIMS the resource first (the CAS bumps the fencing epoch, so any
+  zombie thread of the dead controller is rejected from here on), then
+  interrupts the orphaned ops exactly like the boot sweep and auto-resumes
+  them under the same knob. Our own expired leases are skipped — in this
+  process the op thread may simply be slow, and the next heartbeat re-arms
+  them; only a FOREIGN dead controller's work is taken over.
 """
 
 from __future__ import annotations
@@ -62,67 +80,98 @@ class ReconcileService:
     def __init__(self, services) -> None:
         self.services = services
 
+    # ---- shared per-op sweep ----
+    def _sweep_one(self, op, cause: str) -> dict:
+        """Interrupt ONE orphaned open op (fleet-scope or per-cluster),
+        preserving its resume point; returns the sweep record. `cause`
+        names who declared the owner dead ("controller restart" for the
+        boot sweep, "controller <id> lease expired" for failover)."""
+        repos = self.services.repos
+        journal = self.services.clusters.journal
+        if op.kind in AUTO_RESUME_FLEET or not op.cluster_id:
+            # fleet op: no single cluster to strand; the resumable state
+            # (remaining waves, completed clusters) is already durable in
+            # op.vars — the sweep just names the wave it died in. Its
+            # per-cluster child ops are swept like any other orphan.
+            wave = op.vars.get("current_wave", 0)
+            journal.interrupt(
+                op, resume_phase=f"wave-{wave}",
+                message=f"{cause}: fleet rollout was in flight "
+                        f"(wave {wave}); `koctl fleet resume` continues "
+                        f"without re-running completed clusters",
+            )
+            return {
+                "cluster": op.cluster_name, "op": op.id, "kind": op.kind,
+                "resume_phase": op.resume_phase,
+            }
+        cluster = None
+        try:
+            cluster = repos.clusters.get(op.cluster_id)
+        except Exception:
+            pass  # terminate op whose cluster row is already gone
+        resume = resume_point(cluster) if cluster else ""
+        # a concurrent (DAG) op also persisted its full launch frontier in
+        # op.vars["frontier"] (journal.record_frontier): resume_phase stays
+        # the compact first-pending-condition contract, the vars carry the
+        # whole in-flight set — `koctl cluster operations --json` shows both
+        frontier = (op.vars or {}).get("frontier") or {}
+        in_flight = sorted(frontier.get("running", []))
+        detail = (f"; DAG frontier was {'+'.join(in_flight)}"
+                  if len(in_flight) > 1 else "")
+        journal.interrupt(
+            op, resume_phase=resume,
+            message=f"{cause}: {op.kind} was in flight"
+            + (f" (phase {op.phase})" if op.phase else "") + detail,
+        )
+        if cluster is not None:
+            self._strand(cluster, op.resume_phase)
+        return {
+            "cluster": op.cluster_name, "op": op.id, "kind": op.kind,
+            "resume_phase": op.resume_phase,
+            "_cluster_id": cluster.id if cluster is not None else "",
+        }
+
+    # ---- boot sweep ----
     def boot_sweep(self) -> list[dict]:
-        """Sweep orphans; returns one record per reconciled cluster/op so
-        callers (container boot log, tests) can see what happened."""
+        """Sweep orphans at container start; returns one record per
+        reconciled cluster/op so callers (container boot log, tests) can
+        see what happened."""
         cfg = self.services.config
         if not cfg.get("resilience.reconcile.enabled", True):
             return []
         repos = self.services.repos
         journal = self.services.clusters.journal
+        leases = getattr(self.services, "leases", None)
+        fencing = leases is not None and leases.enabled
         results: list[dict] = []
+        claims: dict[str, int] = {}
 
         # 1. orphaned open ops — at boot, every open op is an orphan
+        # UNLESS a live peer replica's lease says it is running elsewhere
         open_ops = repos.operations.find(
             status=OperationStatus.RUNNING.value)
         swept_clusters: set[str] = set()
         for op in open_ops:
-            if op.kind in AUTO_RESUME_FLEET:
-                # fleet op: no single cluster to strand; the resumable
-                # state (remaining waves, completed clusters) is already
-                # durable in op.vars — the sweep just names the wave it
-                # died in. Its per-cluster child op is swept by this same
-                # loop like any other orphan.
-                wave = op.vars.get("current_wave", 0)
-                journal.interrupt(
-                    op, resume_phase=f"wave-{wave}",
-                    message=f"controller restart: fleet rollout was in "
-                            f"flight (wave {wave}); `koctl fleet resume` "
-                            f"continues without re-running completed "
-                            f"clusters",
-                )
-                results.append({
-                    "cluster": op.cluster_name, "op": op.id,
-                    "kind": op.kind, "resume_phase": op.resume_phase,
-                })
-                continue
-            cluster = None
-            try:
-                cluster = repos.clusters.get(op.cluster_id)
-            except Exception:
-                pass  # terminate op whose cluster row is already gone
-            resume = resume_point(cluster) if cluster else ""
-            # a concurrent (DAG) op also persisted its full launch
-            # frontier in op.vars["frontier"] (journal.record_frontier):
-            # resume_phase stays the compact first-pending-condition
-            # contract, the vars carry the whole in-flight set — `koctl
-            # cluster operations --json` shows both
-            frontier = (op.vars or {}).get("frontier") or {}
-            in_flight = sorted(frontier.get("running", []))
-            detail = (f"; DAG frontier was {'+'.join(in_flight)}"
-                      if len(in_flight) > 1 else "")
-            journal.interrupt(
-                op, resume_phase=resume,
-                message=f"controller restart: {op.kind} was in flight"
-                + (f" (phase {op.phase})" if op.phase else "") + detail,
-            )
-            results.append({
-                "cluster": op.cluster_name, "op": op.id, "kind": op.kind,
-                "resume_phase": op.resume_phase,
-            })
-            if cluster is not None:
-                swept_clusters.add(cluster.id)
-                self._strand(cluster, op.resume_phase)
+            resource = op.cluster_id or op.id
+            if fencing:
+                holder = leases.holder(resource)
+                if holder and holder.get("live") \
+                        and holder["controller_id"] != leases.controller_id:
+                    log.info(
+                        "boot reconcile: op %s (%s) is leased by live "
+                        "controller %s — not an orphan, skipping",
+                        op.id, op.kind, holder["controller_id"])
+                    continue
+                claimed = leases.try_claim(resource)
+                if claimed is None:
+                    continue   # a peer won the resource between checks
+                claims.setdefault(resource, int(claimed["epoch"]))
+            record = self._sweep_one(op, "controller restart")
+            record["_resource"] = resource
+            cluster_id = record.pop("_cluster_id", "")
+            if cluster_id:
+                swept_clusters.add(cluster_id)
+            results.append(record)
 
         # 2. in-flight clusters with NO open op (pre-journal rows, or a
         # journal write that never landed): synthesize the interrupted op
@@ -131,6 +180,20 @@ class ReconcileService:
             for cluster in repos.clusters.find(phase=phase):
                 if cluster.id in swept_clusters:
                     continue
+                if fencing:
+                    holder = leases.holder(cluster.id)
+                    if holder and holder.get("live") \
+                            and holder["controller_id"] \
+                            != leases.controller_id:
+                        continue   # a live peer owns this cluster
+                    # claim BEFORE open (part 1's idiom): if a peer takes
+                    # the cluster between the holder check and here, lose
+                    # the race quietly — a raising claim inside open()
+                    # would abort this replica's whole boot. Once this CAS
+                    # wins, open()'s own claim is a same-controller
+                    # renewal and cannot conflict.
+                    if leases.try_claim(cluster.id) is None:
+                        continue
                 resume = resume_point(cluster)
                 op = journal.open(cluster, "unknown")
                 journal.interrupt(
@@ -140,18 +203,96 @@ class ReconcileService:
                 )
                 self._strand(cluster, resume)
                 swept_clusters.add(cluster.id)
-                results.append({
+                record = {
                     "cluster": cluster.name, "op": op.id, "kind": "unknown",
                     "resume_phase": resume,
-                })
+                }
+                if op.lease_epoch:
+                    record["_resource"] = cluster.id
+                    claims.setdefault(cluster.id, op.lease_epoch)
+                results.append(record)
 
         if results:
             log.warning("boot reconcile: %d interrupted operation(s) swept",
                         len(results))
-        if cfg.get("resilience.reconcile.auto_resume", False):
-            for record in results:
-                record["resumed"] = self._auto_resume(record)
+        self._resume_and_settle_claims(results, claims, leases)
         return results
+
+    # ---- lease sweep (controller failover) ----
+    def lease_sweep(self) -> list[dict]:
+        """Take over the work of controllers that stopped heartbeating:
+        claim each expired FOREIGN lease first (the CAS bumps the fencing
+        epoch, so the dead controller's zombie threads are rejected from
+        this instant on), then interrupt + optionally resume the orphaned
+        ops behind it. Runs on the cron lease tick; also callable directly
+        by drills/tests."""
+        cfg = self.services.config
+        leases = getattr(self.services, "leases", None)
+        if leases is None or not leases.enabled:
+            return []
+        if not cfg.get("resilience.reconcile.enabled", True):
+            return []
+        repos = self.services.repos
+        open_ops = repos.operations.find(
+            status=OperationStatus.RUNNING.value)
+        if not open_ops:
+            return []
+        by_resource: dict[str, list] = {}
+        for op in open_ops:
+            by_resource.setdefault(op.cluster_id or op.id, []).append(op)
+
+        results: list[dict] = []
+        claims: dict[str, int] = {}
+        for row in leases.expired():
+            resource = row["resource"]
+            ops = by_resource.get(resource)
+            if not ops:
+                continue   # released/idle lease: nothing running behind it
+            dead = row["controller_id"]
+            if dead == leases.controller_id:
+                # OUR lease expired while the op thread may still be alive
+                # in this very process (stalled heartbeat, long GC): that
+                # is not an orphan — the next heartbeat re-arms it. Only a
+                # FOREIGN dead controller's work is taken over.
+                continue
+            claimed = leases.try_claim(resource)
+            if claimed is None:
+                continue   # the holder revived, or a peer won the takeover
+            claims[resource] = int(claimed["epoch"])
+            log.warning(
+                "lease sweep: controller %s stopped heartbeating; "
+                "re-claimed %s (epoch %d) with %d open op(s)",
+                dead, resource, claimed["epoch"], len(ops))
+            for op in ops:
+                record = self._sweep_one(
+                    op, f"controller {dead} lease expired")
+                record.pop("_cluster_id", "")
+                record["_resource"] = resource
+                record["from_controller"] = dead
+                results.append(record)
+        self._resume_and_settle_claims(results, claims, leases)
+        return results
+
+    def _resume_and_settle_claims(self, results: list[dict],
+                                  claims: dict[str, int], leases) -> None:
+        """Auto-resume swept records (under the knob), then release the
+        sweep's claims on resources nothing resumed on — a lease must mean
+        'work is owned here', never dangle behind an op the operator still
+        has to restart by hand. Resumed resources keep the claim: the
+        resume path's journal.open renews it under the same epoch."""
+        auto = self.services.config.get(
+            "resilience.reconcile.auto_resume", False)
+        resumed_resources: set[str] = set()
+        for record in results:
+            if auto:
+                record["resumed"] = self._auto_resume(record)
+                if record.get("resumed") and record.get("_resource"):
+                    resumed_resources.add(record["_resource"])
+        for resource, epoch in claims.items():
+            if resource not in resumed_resources:
+                leases.release(resource, epoch)
+        for record in results:
+            record.pop("_resource", None)
 
     def _strand(self, cluster, resume_phase: str) -> None:
         """Flip an orphaned in-flight cluster to Failed, resume point

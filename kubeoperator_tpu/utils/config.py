@@ -42,6 +42,30 @@ DEFAULTS: dict[str, Any] = {
         # SUFFIX of commits. FULL restores a per-commit fsync for
         # deployments that must not lose the tail on power loss.
         "synchronous": "NORMAL",
+        # how long a statement blocks on ANOTHER handle's write lock
+        # before "database is locked" (sqlite busy handler): N controller
+        # replicas share one WAL file, so a second writer must queue, not
+        # fail instantly (docs/resilience.md "Controller leases")
+        "busy_timeout_ms": 5000,
+    },
+    "lease": {
+        # lease-based multi-controller ownership (resilience/lease.py,
+        # docs/resilience.md "Controller leases"): each replica claims
+        # clusters/fleet ops via CAS lease rows and fences every journal
+        # write with the claim's epoch. Safe (and on) for single-replica
+        # stacks too — one replica simply always wins its own claims.
+        "enabled": True,
+        # stable per-replica identity ("" = hostname). MUST be unique per
+        # replica AND stable across that replica's restarts — a rebooted
+        # controller recognizes (and sweeps) its own orphaned leases by id
+        "controller_id": "",
+        # heartbeat_deadline horizon per renewal; a lease idle past this
+        # is dead-controller evidence the lease sweep may take over
+        "ttl_s": 60.0,
+        # renewal cadence on the cron scheduler's loop (10s granularity);
+        # keep several heartbeats inside one TTL so a single missed tick
+        # never forfeits ownership
+        "heartbeat_interval_s": 10.0,
     },
     "executor": {
         # "auto": ansible binary if present, else the built-in local engine;

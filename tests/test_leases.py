@@ -1,0 +1,436 @@
+"""Controller leases: fenced multi-controller ownership
+(resilience/lease.py, migration 008, docs/resilience.md "Controller
+leases").
+
+Covers the lease CAS win/lose races across two REAL `Database` handles on
+one WAL file, interleaved cross-handle journal writes under the
+busy_timeout posture, the clock contract (expiry follows the DATABASE
+clock, never a replica's time.time), epoch fencing end-to-end through the
+journal, the lease-aware boot sweep + failover lease sweep, and — the CI
+satellites — a tier-1 2-replica mini-loadtest with one injected
+controller death plus the full `chaos-soak --controllers` kill drill,
+each under a time budget.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from kubeoperator_tpu.models import Cluster, Operation
+from kubeoperator_tpu.repository import Database, Repositories
+from kubeoperator_tpu.resilience import (
+    LeaseConfig,
+    LeaseManager,
+    OperationJournal,
+    StaleEpochError,
+)
+from kubeoperator_tpu.utils.errors import ConflictError
+
+
+def manager(repos, controller_id: str, ttl_s: float = 30.0) -> LeaseManager:
+    return LeaseManager(repos.leases, LeaseConfig(
+        enabled=True, controller_id=controller_id, ttl_s=ttl_s))
+
+
+class TestLeaseCAS:
+    def test_claim_renew_foreign_takeover_release(self, tmp_db):
+        repos = Repositories(Database(tmp_db))
+        a, b = manager(repos, "rep-a"), manager(repos, "rep-b")
+        row = a.try_claim("c1")
+        assert row["epoch"] == 1 and row["controller_id"] == "rep-a"
+        # same-controller re-claim is a renewal: epoch unchanged
+        assert a.try_claim("c1")["epoch"] == 1
+        # a live foreign holder keeps the lease
+        assert b.try_claim("c1") is None
+        with pytest.raises(ConflictError):
+            b.claim("c1")
+        # release expires the deadline but KEEPS the epoch row
+        assert a.release("c1", 1)
+        # takeover bumps the fencing epoch
+        assert b.try_claim("c1")["epoch"] == 2
+        assert repos.leases.current_epoch("c1") == 2
+
+    def test_release_is_cas_on_epoch(self, tmp_db):
+        repos = Repositories(Database(tmp_db))
+        a, b = manager(repos, "rep-a"), manager(repos, "rep-b")
+        a.try_claim("c1")
+        a.release("c1", 1)
+        b.try_claim("c1")          # epoch 2, rep-b's lease
+        # a late release from the fenced-out epoch must not touch it
+        assert not a.release("c1", 1)
+        assert repos.leases.get("c1")["live"] == 1
+
+    def test_state_counts_and_heartbeat_age(self, tmp_db):
+        repos = Repositories(Database(tmp_db))
+        a, b = manager(repos, "rep-a"), manager(repos, "rep-b")
+        a.try_claim("mine")
+        b.try_claim("theirs")
+        a.try_claim("gone")
+        a.release("gone", repos.leases.current_epoch("gone"))
+        assert a.state_counts() == {"held": 1, "foreign": 1, "expired": 1}
+        assert b.state_counts() == {"held": 1, "foreign": 1, "expired": 1}
+        age = a.max_heartbeat_age_s()
+        assert age is not None and 0 <= age < 5
+        assert manager(repos, "rep-c").max_heartbeat_age_s() is None
+
+    def test_heartbeat_renews_only_unexpired(self, tmp_db):
+        repos = Repositories(Database(tmp_db))
+        a = manager(repos, "rep-a")
+        a.try_claim("live")
+        # an expired lease with NO running work behind it stays down: a
+        # revived replica's heartbeat must never resurrect stale ownership
+        # of an idle resource (it would refuse peers' future claims)
+        repos.leases.claim("stale", "rep-a", ttl_s=-5.0)
+        assert a.heartbeat() == 1
+        assert {r["resource"] for r in repos.leases.expired()} == {"stale"}
+
+    def test_heartbeat_rearms_expired_lease_backed_by_running_op(
+            self, tmp_db):
+        """A stalled heartbeat (long cron tick, GC pause) expires the
+        lease while the op thread is alive and healthy — the next
+        heartbeat must re-arm it so a peer's sweep does not take over a
+        live operation. CAS-safe: once a peer HAS claimed, the re-arm
+        cannot touch the row."""
+        repos = Repositories(Database(tmp_db))
+        a, b = manager(repos, "rep-a"), manager(repos, "rep-b")
+        repos.operations.save(Operation(
+            cluster_id="c1", cluster_name="c1", kind="create",
+            status="Running"))
+        repos.leases.claim("c1", "rep-a", ttl_s=-5.0)  # expired, work live
+        assert a.heartbeat() == 1                      # re-armed
+        row = repos.leases.get("c1")
+        assert row["live"] == 1 and row["epoch"] == 1
+        assert b.try_claim("c1") is None               # ownership kept
+        # but once a peer's sweep claimed it, the old holder's heartbeat
+        # is fenced out by the controller_id CAS
+        repos.leases.claim("c1", "rep-a", ttl_s=-5.0)
+        assert b.try_claim("c1")["epoch"] == 2
+        assert a.heartbeat() == 0
+        assert repos.leases.get("c1")["controller_id"] == "rep-b"
+
+
+class TestCrossHandleContention:
+    """Two Database instances on ONE file — the real multi-replica WAL
+    posture, not two references to one handle."""
+
+    def test_lease_cas_race_exactly_one_winner(self, tmp_db):
+        db_a, db_b = Database(tmp_db), Database(tmp_db)
+        repos_a, repos_b = Repositories(db_a), Repositories(db_b)
+        wins: list[str] = []
+        barrier = threading.Barrier(2)
+
+        def contend(repo, who: str) -> None:
+            barrier.wait()
+            for _ in range(20):
+                if repo.claim("contested", who, 30.0) is not None:
+                    wins.append(who)
+
+        ta = threading.Thread(target=contend, args=(repos_a.leases, "A"))
+        tb = threading.Thread(target=contend, args=(repos_b.leases, "B"))
+        ta.start(); tb.start(); ta.join(10); tb.join(10)
+        # exactly one controller ever won: the loser's 20 CAS attempts all
+        # saw a live foreign lease (re-claims by the winner are renewals)
+        assert len(set(wins)) == 1 and len(wins) == 20
+        assert repos_a.leases.current_epoch("contested") == 1
+        db_a.close(); db_b.close()
+
+    def test_interleaved_journal_writes_two_handles(self, tmp_db):
+        db_a, db_b = Database(tmp_db), Database(tmp_db)
+        repos_a, repos_b = Repositories(db_a), Repositories(db_b)
+        barrier = threading.Barrier(2)
+        errors: list[BaseException] = []
+
+        def writer(repos, tag: str) -> None:
+            try:
+                barrier.wait()
+                for i in range(40):
+                    op = Operation(cluster_id=f"{tag}-{i}",
+                                   cluster_name=f"{tag}-{i}", kind="create")
+                    repos.operations.save(op)
+                    op.phase = "etcd"
+                    repos.operations.save(op)
+            except BaseException as e:   # surfaces "database is locked"
+                errors.append(e)
+
+        ta = threading.Thread(target=writer, args=(repos_a, "a"))
+        tb = threading.Thread(target=writer, args=(repos_b, "b"))
+        ta.start(); tb.start(); ta.join(30); tb.join(30)
+        assert not errors, errors
+        rows = repos_a.operations.find(kind="create")
+        assert len(rows) == 80
+        assert all(op.phase == "etcd" for op in rows)
+        db_a.close(); db_b.close()
+
+    def test_busy_timeout_pragma_applied(self, tmp_db):
+        db = Database(tmp_db, busy_timeout_ms=1234)
+        assert db.query("PRAGMA busy_timeout")[0][0] == 1234
+        db.close()
+
+
+class TestClockContract:
+    """Lease expiry compares against the DATABASE clock, never a
+    replica's time.time() — replicas with skewed clocks must agree."""
+
+    def test_expiry_ignores_wild_local_clock(self, tmp_db, monkeypatch):
+        repos = Repositories(Database(tmp_db))
+        repos.leases.claim("c1", "rep-a", ttl_s=30.0)
+        # a replica whose local clock jumped a thousand years must still
+        # see the lease as live…
+        monkeypatch.setattr(time, "time", lambda: 4e13)
+        assert repos.leases.expired() == []
+        assert repos.leases.get("c1")["live"] == 1
+        # …and one whose clock runs in 1970 must still see a negative-TTL
+        # lease as expired
+        monkeypatch.setattr(time, "time", lambda: 0.0)
+        repos.leases.claim("c2", "rep-a", ttl_s=-5.0)
+        assert {r["resource"] for r in repos.leases.expired()} == {"c2"}
+
+    def test_db_now_is_wall_clock_shaped(self, tmp_db):
+        repos = Repositories(Database(tmp_db))
+        # sanity pin, not a skew test: on an unskewed host the db clock and
+        # the python clock agree to within seconds
+        assert abs(repos.leases.db_now() - time.time()) < 30
+
+
+class TestJournalFencing:
+    def _stack(self, tmp_db, controller_id="rep-a", ttl_s=30.0):
+        repos = Repositories(Database(tmp_db))
+        leases = manager(repos, controller_id, ttl_s)
+        journal = OperationJournal(repos, tracing=False, leases=leases)
+        return repos, leases, journal
+
+    def _cluster(self, repos, name="demo") -> Cluster:
+        return repos.clusters.save(Cluster(name=name))
+
+    def test_open_claims_and_stamps_epoch(self, tmp_db):
+        repos, leases, journal = self._stack(tmp_db)
+        cluster = self._cluster(repos)
+        op = journal.open(cluster, "create")
+        assert op.controller_id == "rep-a" and op.lease_epoch == 1
+        assert repos.operations.get(op.id).lease_epoch == 1
+        journal.progress(op, "etcd", "Running")   # current epoch: accepted
+        journal.close(op, ok=True)
+        # close released the lease (deadline 0, epoch kept)
+        assert leases.state_counts()["expired"] == 1
+
+    def test_open_refuses_live_foreign_lease(self, tmp_db):
+        repos, _leases, journal = self._stack(tmp_db)
+        cluster = self._cluster(repos)
+        other = manager(repos, "rep-b")
+        other.try_claim(cluster.id)
+        with pytest.raises(ConflictError):
+            journal.open(cluster, "create")
+
+    def test_stale_epoch_write_rejected_and_surfaced(self, tmp_db):
+        repos, leases, journal = self._stack(tmp_db, ttl_s=-1.0)
+        cluster = self._cluster(repos)
+        op = journal.open(cluster, "create")      # epoch 1, born expired
+        taker = manager(repos, "rep-b")
+        assert taker.try_claim(cluster.id)["epoch"] == 2
+        with pytest.raises(StaleEpochError):
+            journal.progress(op, "zombie", "Running")
+        with pytest.raises(StaleEpochError):
+            journal.save_vars(op)
+        with pytest.raises(StaleEpochError):
+            journal.close(op, ok=True)
+        # the row is untouched and still open; the fencing events recorded
+        row = repos.operations.get(op.id)
+        assert row.phase != "zombie" and row.status == "Running"
+        assert len(leases.fencing_events) == 3
+        event = leases.fencing_events[0]
+        assert event.epoch == 1 and event.current_epoch == 2
+
+    def test_attach_fences_cluster_saves(self, tmp_db):
+        repos, _leases, journal = self._stack(tmp_db, ttl_s=-1.0)
+        cluster = self._cluster(repos)
+        op = journal.open(cluster, "create")
+
+        class Ctx:
+            save_cluster = staticmethod(lambda c: None)
+            on_phase = None
+            on_frontier = None
+            tracer = None
+
+        ctx = Ctx()
+        journal.attach(op, ctx)
+        ctx.save_cluster(cluster)                 # epoch current: passes
+        manager(repos, "rep-b").try_claim(cluster.id)
+        with pytest.raises(StaleEpochError):
+            ctx.save_cluster(cluster)
+
+    def test_epoch_zero_ops_stay_unfenced(self, tmp_db):
+        """Pre-lease journal rows (epoch 0) are unfenced by contract —
+        leases arriving in an upgrade must not brick in-flight history."""
+        repos, _leases, journal = self._stack(tmp_db)
+        cluster = self._cluster(repos)
+        op = Operation(cluster_id=cluster.id, cluster_name=cluster.name,
+                       kind="create")
+        repos.operations.save(op)
+        journal.progress(op, "etcd", "Running")   # no epoch, no fence
+        assert repos.operations.get(op.id).phase == "etcd"
+
+
+def _build_stack(tmp_path, db_name, controller_id, ttl_s=30.0,
+                 auto_resume=False, extra=None):
+    from kubeoperator_tpu.service import build_services
+    from kubeoperator_tpu.utils.config import load_config
+
+    overrides = {
+        "db": {"path": str(tmp_path / db_name)},
+        "logging": {"level": "ERROR"},
+        "executor": {"backend": "simulation"},
+        "provisioner": {"work_dir": str(tmp_path / "tf")},
+        "cron": {"backup_enabled": False, "health_check_interval_s": 0,
+                 "event_sync_interval_s": 0},
+        "cluster": {"kubeconfig_dir": str(tmp_path / "kc")},
+        "lease": {"enabled": True, "controller_id": controller_id,
+                  "ttl_s": ttl_s, "heartbeat_interval_s": 0.05},
+        "resilience": {"reconcile": {"auto_resume": auto_resume}},
+    }
+    for section, values in (extra or {}).items():
+        overrides.setdefault(section, {}).update(values)
+    config = load_config(path="/nonexistent", env={}, overrides=overrides)
+    return build_services(config, simulate=True)
+
+
+class TestSweepLeaseAwareness:
+    def test_boot_sweep_skips_live_peer_ops(self, tmp_path):
+        """An open op whose lease a LIVE peer holds is not an orphan: a
+        second replica booting on the shared file must leave it alone —
+        and must sweep it once the lease expires (via lease_sweep)."""
+        a = _build_stack(tmp_path, "shared.db", "replica-a", ttl_s=30.0)
+        cluster = a.repos.clusters.save(Cluster(name="peer-owned"))
+        op = a.journal.open(cluster, "create")
+        try:
+            b = _build_stack(tmp_path, "shared.db", "replica-b",
+                             ttl_s=30.0)
+            try:
+                assert b.boot_report == []
+                assert b.repos.operations.get(op.id).status == "Running"
+                # now the peer "dies": expire its lease behind its back
+                b.repos.db.execute(
+                    "UPDATE controller_leases SET heartbeat_deadline=0 "
+                    "WHERE resource=?", (cluster.id,))
+                swept = b.reconciler.lease_sweep()
+                assert [r["op"] for r in swept] == [op.id]
+                assert swept[0]["from_controller"] == "replica-a"
+                assert b.repos.operations.get(op.id).status == "Interrupted"
+                # takeover bumped the fencing epoch
+                assert b.repos.leases.current_epoch(cluster.id) == 2
+            finally:
+                b.close()
+        finally:
+            a.close()
+
+    def test_boot_sweep_still_sweeps_own_orphans(self, tmp_path):
+        """A rebooted replica (same stable controller id) recognizes its
+        own leases and sweeps its own orphans — the single-controller
+        restart story is unchanged by leasing."""
+        a = _build_stack(tmp_path, "shared.db", "replica-a")
+        cluster = a.repos.clusters.save(Cluster(name="mine"))
+        op = a.journal.open(cluster, "create")
+        a.close()
+        a2 = _build_stack(tmp_path, "shared.db", "replica-a")
+        try:
+            assert [r["op"] for r in a2.boot_report] == [op.id]
+            assert a2.repos.operations.get(op.id).status == "Interrupted"
+        finally:
+            a2.close()
+
+    def test_lease_sweep_skips_own_expired_leases(self, tmp_path):
+        """Our own expired lease mid-run is a stalled heartbeat, not an
+        orphan — the op thread may be alive in this very process."""
+        a = _build_stack(tmp_path, "own.db", "replica-a", ttl_s=-1.0)
+        try:
+            cluster = a.repos.clusters.save(Cluster(name="slow"))
+            op = a.journal.open(cluster, "create")
+            assert a.reconciler.lease_sweep() == []
+            assert a.repos.operations.get(op.id).status == "Running"
+        finally:
+            a.close()
+
+    def test_cron_lease_tick_heartbeats_and_sweeps(self, tmp_path):
+        a = _build_stack(tmp_path, "tick.db", "replica-a")
+        try:
+            cluster = a.repos.clusters.save(Cluster(name="ticked"))
+            a.journal.open(cluster, "create")
+            actions = a.cron.lease_tick()
+            assert any(t.startswith("lease-renew:") for t in actions)
+            # rate-limited: an immediate second tick is a no-op
+            assert a.cron.lease_tick() == []
+        finally:
+            a.close()
+
+
+class TestLeaseMetrics:
+    def test_lease_gauges_render_and_parse(self, tmp_path):
+        from kubeoperator_tpu.api.metrics import MetricsRegistry
+
+        a = _build_stack(tmp_path, "metrics.db", "replica-a")
+        try:
+            cluster = a.repos.clusters.save(Cluster(name="gauged"))
+            a.journal.open(cluster, "create")
+            text = MetricsRegistry().render(a)
+            assert 'ko_tpu_controller_leases{state="held"} 1' in text
+            assert "# TYPE ko_tpu_controller_leases gauge" in text
+            age_row = next(
+                line for line in text.splitlines()
+                if line.startswith(
+                    "ko_tpu_controller_lease_heartbeat_age_seconds"))
+            assert float(age_row.split()[-1]) >= 0
+        finally:
+            a.close()
+
+
+class TestLoadHarness:
+    def test_tier1_mini_loadtest_with_controller_death(self, tmp_path):
+        """The CI satellite: a 2-replica mini-loadtest with one injected
+        controller death, under a time budget. Exercises the whole
+        contract — WAL contention, lease claims, the kill, expiry, the
+        survivors' sweep + resume, the journal-integrity audit."""
+        from kubeoperator_tpu.cli.loadtest import run_loadtest
+
+        t0 = time.monotonic()
+        report = run_loadtest(
+            ops=16, replicas=2, concurrency=8, lease_ttl_s=1.0,
+            base_dir=str(tmp_path / "lt"), kill_replica_after=4,
+            settle_timeout_s=60.0)
+        wall = time.monotonic() - t0
+        failed = [c for c in report["checks"] if not c["ok"]]
+        assert report["ok"], failed
+        assert report["killed_replica"] == 0
+        assert report["ops_per_s"] > 0 and report["p99_s"] > 0
+        assert wall < 90, f"mini-loadtest blew its time budget: {wall:.1f}s"
+
+    def test_kill_drill_acceptance(self, tmp_path):
+        """The acceptance drill (`koctl chaos-soak --controllers 2`): a
+        replica dies holding >=3 in-flight creates plus a fleet wave;
+        within one lease TTL a peer claims and resumes every orphan
+        exactly once (zero double-runs), and a post-mortem write from the
+        dead epoch is rejected as a fencing event — asserted from journal
+        rows and span trees inside run_controller_soak."""
+        from kubeoperator_tpu.cli.loadtest import run_controller_soak
+
+        report = run_controller_soak(
+            controllers=2, base_dir=str(tmp_path / "soak"),
+            lease_ttl_s=1.5, settle_timeout_s=90.0)
+        failed = [c for c in report["checks"] if not c["ok"]]
+        assert report["ok"], failed
+        assert len(report["checks"]) >= 18
+        assert report["runtime_s"] < 90
+
+    @pytest.mark.slow
+    def test_full_loadtest_three_replicas(self, tmp_path):
+        """The PERF-shaped pass at reduced scale: 3 replicas, journal
+        audit must come back clean with zero lost/duplicated rows."""
+        from kubeoperator_tpu.cli.loadtest import run_loadtest
+
+        report = run_loadtest(
+            ops=120, replicas=3, concurrency=24, lease_ttl_s=5.0,
+            base_dir=str(tmp_path / "lt3"))
+        failed = [c for c in report["checks"] if not c["ok"]]
+        assert report["ok"], failed
+        assert report["outcomes"]["ok"] == 120
