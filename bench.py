@@ -148,6 +148,28 @@ def main() -> int:
         details["ring_attention_tflops"] = bench_ring_attention(
             seq_per_device=1024, iters=6).to_dict()["tflops"]
         envelope = 2.0 * gen.ici_gbps_per_link
+        # sharded-training workload sweep (ISSUE 9, the multi-chip
+        # successor of the single-chip train bench): per-axis scaling
+        # efficiency + MFU over the visible mesh, through the same
+        # pjit/shard_map seam tenants get. Own try-block: a workload
+        # regression must not sink the interconnect headline.
+        try:
+            from kubeoperator_tpu.workloads.harness import run_sweep
+
+            sw = run_sweep(steps=4,
+                           peak_tflops_per_chip=gen.bf16_tflops_per_chip,
+                           ici_envelope_gbps=envelope)
+            details["workload_sweep_ok"] = sw["ok"]
+            keep = ("axis", "devices", "mode", "steps_per_s",
+                    "model_tflops_per_s", "scaling_efficiency_pct",
+                    "mfu_pct")
+            details["workload_rows"] = [
+                {k: r[k] for k in keep if k in r} for r in sw["rows"]]
+        except Exception as e:
+            # a REAL False, not a truthy "error: ..." string — consumers
+            # key `if details["workload_sweep_ok"]` and must see failure
+            details["workload_sweep_ok"] = False
+            details["workload_sweep_error"] = f"{type(e).__name__}: {e}"
         result = {
             "metric": "psum_allreduce_busbw_gbps",
             "value": round(best, 2),

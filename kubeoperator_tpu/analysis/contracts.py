@@ -34,10 +34,10 @@ _PURE_KEY_RE = re.compile(r"^[a-z0-9_]+(\.[a-z0-9_]+)+$")
 
 # the config blocks the docs knob tables must cover completely (the
 # resilience layer's contract, extended to the observability, fleet,
-# scheduler and lease blocks — docs/resilience.md + docs/observability.md
-# + docs/scheduler.md)
+# scheduler, lease and workloads blocks — docs/resilience.md +
+# docs/observability.md + docs/scheduler.md + docs/workloads.md)
 DOC_REQUIRED_SECTIONS = ("resilience", "chaos", "watchdog", "observability",
-                         "fleet", "scheduler", "lease")
+                         "fleet", "scheduler", "lease", "workloads")
 
 
 def _defaults_from_tree(root: str) -> dict | None:

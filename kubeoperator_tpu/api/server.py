@@ -749,6 +749,27 @@ class Handlers:
         return json_response(await run_sync(
             request, self.s.fleet.trace, request.match_info["op"]))
 
+    # ---- tenant workloads (docs/workloads.md) ----
+    async def workload_train(self, request):
+        from kubeoperator_tpu.service.workload import train_kwargs
+
+        body = await request.json() if request.can_read_body else {}
+        result = await run_sync(
+            request, self.s.workloads.train, **train_kwargs(body))
+        return json_response(result, status=201)
+
+    async def workload_operations(self, request):
+        return json_response(
+            await run_sync(request, self.s.workloads.list_ops))
+
+    async def workload_operation(self, request):
+        return json_response(await run_sync(
+            request, self.s.workloads.status, request.match_info["op"]))
+
+    async def workload_trace(self, request):
+        return json_response(await run_sync(
+            request, self.s.workloads.trace, request.match_info["op"]))
+
     async def recover(self, request):
         body = await request.json()
         await run_sync(request, self.s.health.recover,
@@ -1208,6 +1229,13 @@ def create_app(services: Services) -> web.Application:
                admin_guard(h.fleet_resume))
     r.add_post("/api/v1/fleet/operations/{op}/abort",
                admin_guard(h.fleet_abort))
+    r.add_post("/api/v1/workloads/train", admin_guard(h.workload_train))
+    r.add_get("/api/v1/workloads/operations",
+              admin_guard(h.workload_operations))
+    r.add_get("/api/v1/workloads/operations/{op}",
+              admin_guard(h.workload_operation))
+    r.add_get("/api/v1/workloads/operations/{op}/trace",
+              admin_guard(h.workload_trace))
     r.add_get("/api/v1/fleet/operations/{op}/trace",
               admin_guard(h.fleet_trace))
     r.add_get("/api/v1/clusters/{name}/components",

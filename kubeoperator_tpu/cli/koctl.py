@@ -249,6 +249,16 @@ class LocalClient:
                 return s.fleet.abort(op_id)
             case ("GET", ["fleet", "operations", op_id, "trace"]):
                 return s.fleet.trace(op_id)
+            case ("POST", ["workloads", "train"]):
+                from kubeoperator_tpu.service.workload import train_kwargs
+
+                return s.workloads.train(**train_kwargs(body))
+            case ("GET", ["workloads", "operations"]):
+                return s.workloads.list_ops()
+            case ("GET", ["workloads", "operations", op_id]):
+                return s.workloads.status(op_id)
+            case ("GET", ["workloads", "operations", op_id, "trace"]):
+                return s.workloads.trace(op_id)
             case ("GET", ["clusters", name, "events"]):
                 return pub(s.events.list(s.clusters.get(name).id))
             case ("POST", ["clusters", name, "cis-scans"]):
@@ -1022,6 +1032,90 @@ def cmd_fleet(client, args) -> int:
         print(render_waterfall(tree))
         return 0 if data.get("status") != "Failed" else 1
     raise SystemExit(f"unknown fleet command {args.fleet_cmd}")
+
+
+def _format_mesh(mesh: dict) -> str:
+    """Render {axis: length} as "data=4,fsdp=2" — the display twin of
+    parallel.mesh.format_axes, kept local because importing that module
+    would pull jax into every CLI invocation."""
+    return ",".join(f"{a}={s}" for a, s in (mesh or {}).items())
+
+
+def cmd_workload(client, args) -> int:
+    """Tenant workload verbs (docs/workloads.md): `train` runs sharded
+    training on the visible devices as a journaled operation (partition
+    rules -> pjit/shard_map compile seam -> descending-loss verdict),
+    `list` shows the journaled runs, `trace` renders a run's
+    operation -> step-window waterfall."""
+    if args.wl_cmd == "train":
+        body: dict = {}
+        if args.plan:
+            body["plan"] = args.plan
+        if args.mesh:
+            body["mesh"] = args.mesh
+        if args.steps is not None:
+            body["steps"] = args.steps
+        if args.mode:
+            body["mode"] = args.mode
+        op = client.call("POST", "/api/v1/workloads/train", body)
+        result = op.get("result") or {}
+        ok = bool(result.get("ok"))
+        if args.json:
+            _print(op)
+            return 0 if ok else 1
+        mesh = _format_mesh(op.get("mesh"))
+        print(f"workload {op['id']}: mesh {mesh} "
+              f"({result.get('devices', '?')} device(s), "
+              f"{result.get('mode', '?')} path)")
+        losses = result.get("losses") or []
+        if losses:
+            print(f"  loss {losses[0]} -> {losses[-1]} over "
+                  f"{result.get('steps')} steps  "
+                  f"({result.get('steps_per_s')} steps/s, "
+                  f"{result.get('model_tflops_per_s')} model TFLOP/s"
+                  + (f", {result['mfu_pct']}% MFU"
+                     if result.get("mfu_pct") is not None else "")
+                  + ")")
+        print(f"  {op.get('message', '')}")
+        print(f"  waterfall: koctl workload trace {op['id'][:8]}")
+        return 0 if ok else 1
+    if args.wl_cmd == "list":
+        ops = client.call("GET", "/api/v1/workloads/operations")
+        if args.json:
+            _print(ops)
+        elif not ops:
+            print("no workload operations journaled")
+        else:
+            for op in ops:
+                print(f"{op['id'][:8]}  {op['status']:11s} "
+                      f"{_format_mesh(op.get('mesh')):24s} "
+                      f"{op.get('message', '')}")
+        return 1 if any(o["status"] == "Failed" for o in ops) else 0
+    if args.wl_cmd == "trace":
+        op_ref = args.op
+        if not op_ref:
+            ops = client.call("GET", "/api/v1/workloads/operations")
+            if not ops:
+                raise SystemExit("no workload operations journaled")
+            op_ref = ops[0]["id"]      # list is newest-first
+        data = client.call(
+            "GET", f"/api/v1/workloads/operations/{op_ref}/trace")
+        if args.json:
+            _print(data)
+            return 0
+        tree = data.get("tree")
+        if not tree:
+            print(f"workload op {data.get('operation')} has no persisted "
+                  f"spans (observability.tracing disabled, or the trace "
+                  f"was pruned)", file=sys.stderr)
+            return 1
+        from kubeoperator_tpu.observability import render_waterfall
+
+        print(f"workload operation {data['kind']}/{data['operation']}  "
+              f"trace {data.get('trace_id') or '-'}")
+        print(render_waterfall(tree))
+        return 0 if data.get("status") != "Failed" else 1
+    raise SystemExit(f"unknown workload command {args.wl_cmd}")
 
 
 def cmd_apply(client, args) -> int:
@@ -1921,6 +2015,42 @@ def build_parser() -> argparse.ArgumentParser:
                          help="fleet op id; default: the newest")
     f_trace.add_argument("--json", action="store_true")
 
+    workload_p = sub.add_parser(
+        "workload",
+        help="tenant workload verbs: journaled sharded training over the "
+             "visible devices (docs/workloads.md)")
+    wlsub = workload_p.add_subparsers(dest="wl_cmd", required=True)
+    wl_train = wlsub.add_parser(
+        "train",
+        help="run sharded training as a journaled op: partition rules -> "
+             "pjit/shard_map compile seam -> descending-loss verdict, "
+             "with per-run step-window spans")
+    wl_train.add_argument("--plan", default="",
+                          help="pin the run to a TPU deploy plan's "
+                               "topology (device count + MFU datasheet "
+                               "peak); default: whatever is visible")
+    wl_train.add_argument("--mesh", default="", metavar="data=4,fsdp=2",
+                          help="mesh axis spec over (data, fsdp, tp); "
+                               "default: workloads.mesh, or every visible "
+                               "device on the data axis")
+    wl_train.add_argument("--steps", type=int, default=None,
+                          help="train steps (default: workloads.steps)")
+    wl_train.add_argument("--mode", default="",
+                          choices=["", "auto", "pjit", "shard_map"],
+                          help="compile seam: auto prefers pjit when "
+                               "explicit shardings exist "
+                               "(default: workloads.mode)")
+    wl_train.add_argument("--json", action="store_true")
+    wl_list = wlsub.add_parser(
+        "list", help="journaled workload runs, newest first "
+                     "(exit 1 if any listed run Failed)")
+    wl_list.add_argument("--json", action="store_true")
+    wl_trace = wlsub.add_parser(
+        "trace", help="a run's operation -> step-window span waterfall")
+    wl_trace.add_argument("op", nargs="?", default="",
+                          help="workload op id; default: the newest")
+    wl_trace.add_argument("--json", action="store_true")
+
     watchdog_p = sub.add_parser(
         "watchdog", help="auto-remediation circuit breaker verbs")
     wsub = watchdog_p.add_subparsers(dest="watchdog_cmd", required=True)
@@ -2207,6 +2337,8 @@ def main(argv: list[str] | None = None) -> int:
         return cmd_watchdog(client, args)
     if args.cmd == "fleet":
         return cmd_fleet(client, args)
+    if args.cmd == "workload":
+        return cmd_workload(client, args)
     if args.cmd == "backup-account":
         if args.ba_cmd == "list":
             _print(client.call("GET", "/api/v1/backup-accounts"))

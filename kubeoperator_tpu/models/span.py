@@ -32,6 +32,10 @@ class SpanKind:
 
     OPERATION = "operation"
     WAVE = "wave"           # fleet rollouts only: fleet op → wave → child op
+    WINDOW = "window"       # workload ops only: op → step-window (compile /
+    #                         steps) — like WAVE, a distinct kind so tenant
+    #                         workload wall-clock never leaks into the
+    #                         adm-phase duration histogram
     PHASE = "phase"
     ATTEMPT = "attempt"
     TASK = "task"

@@ -205,6 +205,7 @@ class Tracer(NullTracer):
         # N's whole subtree in the same transaction, and close() flushes
         # the final one — one commit per phase, total
         if span.kind in (SpanKind.OPERATION, SpanKind.WAVE,
+                         SpanKind.WINDOW,
                          SpanKind.PHASE) and not span.finished_at:
             self.flush()
 

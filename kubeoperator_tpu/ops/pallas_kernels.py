@@ -39,6 +39,18 @@ AXIS = "devices"
 COLS = 1024        # lane-aligned
 CHUNK_ROWS = 256   # f32 tile-aligned (multiple of 8)
 
+# jax renamed TPUCompilerParams -> CompilerParams across the versions this
+# image family ships; resolve once so the collective kernels build on both
+_COMPILER_PARAMS = getattr(pltpu, "CompilerParams", None) \
+    or getattr(pltpu, "TPUCompilerParams")
+
+
+def _axis_size(name: str):
+    """jax.lax.axis_size is newer than this image family's oldest jax;
+    psum(1, axis) is the portable spelling of the same value."""
+    size = getattr(jax.lax, "axis_size", None)
+    return size(name) if size is not None else jax.lax.psum(1, name)
+
 
 # ------------------------------------------------------------ DMA stream ----
 def _dma_read_kernel(seed_ref, hbm_ref, out_ref):
@@ -153,7 +165,7 @@ def _ring_all_gather_kernel(local_ref, out_ref, comm_ref, send_sem, recv_sem,
     ICI link this diagnostic exists to expose. `flow_control` is False only
     under interpret mode (lockstep emulation; remote semaphore_signal is
     not implemented there)."""
-    ndev = jax.lax.axis_size(AXIS)
+    ndev = _axis_size(AXIS)
     my_id = jax.lax.axis_index(AXIS)
     chunk = local_ref.shape[0]
 
@@ -221,7 +233,7 @@ def ring_all_gather(x, mesh=None, interpret: bool | None = None):
                 pltpu.SemaphoreType.REGULAR,
             ],
             interpret=interpret,
-            compiler_params=pltpu.CompilerParams(collective_id=0),
+            compiler_params=_COMPILER_PARAMS(collective_id=0),
         )(v)
 
     x = jax.device_put(x, NamedSharding(mesh, P(AXIS, None)))
@@ -261,7 +273,7 @@ def bench_ring_all_gather(
                 pltpu.SemaphoreType.REGULAR,
             ],
             interpret=interpret,
-            compiler_params=pltpu.CompilerParams(collective_id=0),
+            compiler_params=_COMPILER_PARAMS(collective_id=0),
         )(v)
 
     @partial(jax.jit, static_argnums=(1,))

@@ -10,6 +10,7 @@ their meshes exclusively through here.
 
 from __future__ import annotations
 
+from dataclasses import dataclass
 from typing import Sequence
 
 import jax
@@ -18,6 +19,117 @@ from jax.experimental import mesh_utils
 
 from kubeoperator_tpu.parallel.topology import SliceTopology
 from kubeoperator_tpu.utils.errors import TopologyError
+
+
+@dataclass(frozen=True)
+class MeshSpec:
+    """Declarative named-axis mesh description — THE way callers say what
+    mesh they want (ordered ``(name, length)`` pairs), decoupled from how
+    devices get arranged (`build_mesh` below). The validation net's
+    factored (dp, pp, sp, tp) mesh, the train smoke, and the workloads
+    subsystem's (data, fsdp, tp) meshes all route through here, so there
+    is exactly one mesh-building path to harden.
+
+    Parse form (the `--mesh` CLI flag): ``"data=4,fsdp=2"`` — ordered,
+    ``name=length`` pairs, omitted axes absent (not size-1: axis names in
+    the spec are a promise to the step function). One axis may be ``-1``
+    when `parse` is given `n_devices`: it absorbs whatever the named axes
+    leave over, the same convention as numpy reshape."""
+
+    axes: tuple[tuple[str, int], ...]
+
+    def __post_init__(self) -> None:
+        names = [n for n, _ in self.axes]
+        if not names:
+            raise TopologyError("mesh spec needs at least one axis")
+        if len(set(names)) != len(names):
+            raise TopologyError(f"duplicate mesh axis names in {names}")
+        for name, length in self.axes:
+            if not isinstance(length, int) or length <= 0:
+                raise TopologyError(
+                    f"mesh axis {name!r} needs a positive integer length, "
+                    f"got {length!r}")
+
+    @property
+    def axis_names(self) -> tuple[str, ...]:
+        return tuple(n for n, _ in self.axes)
+
+    @property
+    def axis_lengths(self) -> tuple[int, ...]:
+        return tuple(s for _, s in self.axes)
+
+    @property
+    def total_devices(self) -> int:
+        return int(np.prod(self.axis_lengths))
+
+    @classmethod
+    def parse(cls, text: str, axis_names: Sequence[str] | None = None,
+              n_devices: int | None = None) -> "MeshSpec":
+        """``"data=4,fsdp=2"`` → MeshSpec. `axis_names`, when given, is the
+        closed set of axes the workload understands — a typo'd axis is an
+        error naming the allowed set, not a silently dead dimension."""
+        pairs: list[tuple[str, int]] = []
+        fill_at = None
+        for part in [p.strip() for p in text.split(",") if p.strip()]:
+            name, eq, raw = part.partition("=")
+            name = name.strip()
+            try:
+                length = int(raw.strip()) if eq else 0
+            except ValueError:
+                length = 0
+            if not eq or (length <= 0 and length != -1):
+                raise TopologyError(
+                    f"mesh spec part {part!r} must look like 'data=4' "
+                    f"(or 'data=-1' to absorb the remaining devices)")
+            if axis_names is not None and name not in axis_names:
+                raise TopologyError(
+                    f"unknown mesh axis {name!r} (allowed: "
+                    f"{', '.join(axis_names)})")
+            if any(n == name for n, _ in pairs):
+                raise TopologyError(f"mesh axis {name!r} given twice")
+            if length == -1:
+                if fill_at is not None:
+                    raise TopologyError("only one mesh axis may be -1")
+                fill_at = len(pairs)
+                length = 0   # patched below
+            pairs.append((name, length))
+        if not pairs:
+            raise TopologyError("empty mesh spec (want e.g. 'data=4,tp=2')")
+        if fill_at is not None:
+            if n_devices is None:
+                raise TopologyError(
+                    f"mesh axis {pairs[fill_at][0]!r}=-1 needs a known "
+                    f"device count to fill against")
+            rest = int(np.prod([s for _, s in pairs if s]))
+            if rest == 0 or n_devices % rest:
+                raise TopologyError(
+                    f"cannot fill {pairs[fill_at][0]!r}: {n_devices} "
+                    f"devices not divisible by the named axes ({rest})")
+            pairs[fill_at] = (pairs[fill_at][0], n_devices // rest)
+        return cls(axes=tuple(pairs))
+
+    def build(self, devices: Sequence[jax.Device] | None = None
+              ) -> jax.sharding.Mesh:
+        """Materialize over `devices` (default: exactly the first
+        `total_devices` visible ones — a sweep over sub-meshes must not
+        require the caller to slice the device list per shape)."""
+        if devices is None:
+            devices = jax.devices()[: self.total_devices]
+        return build_mesh(self.axis_names, self.axis_lengths, devices)
+
+    def describe(self) -> dict:
+        """The JSON face ({axis: length}, insertion-ordered)."""
+        return {n: s for n, s in self.axes}
+
+    def __str__(self) -> str:
+        return format_axes(self.describe())
+
+
+def format_axes(axes: dict) -> str:
+    """{axis: length} → the canonical ``"data=4,fsdp=2"`` string — the
+    inverse of MeshSpec.parse, shared by every surface that renders a
+    mesh (CLI, harness rows, PERF.md sections)."""
+    return ",".join(f"{n}={s}" for n, s in axes.items())
 
 
 def build_mesh(

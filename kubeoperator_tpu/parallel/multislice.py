@@ -78,6 +78,18 @@ def initialize_from_env() -> None:
         return
     import jax
 
+    if "cpu" in os.environ.get("JAX_PLATFORMS", "").lower():
+        # XLA's plain CPU client refuses cross-process computations
+        # ("Multiprocess computations aren't implemented on the CPU
+        # backend"); the gloo-backed collectives client is what makes a
+        # CPU fleet a real multi-process mesh. Must be set before the
+        # backend initializes — which is why it lives here, ahead of the
+        # first jax op. TPU processes never take this branch: ICI/DCN
+        # collectives are libtpu's job.
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except (AttributeError, ValueError):
+            pass   # older jaxlib without the option: single-host CPU only
     jax.distributed.initialize(
         coordinator_address=addr,
         num_processes=nproc,

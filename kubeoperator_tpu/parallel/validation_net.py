@@ -130,12 +130,18 @@ def analytic_train_flops(mesh, cfg: NetConfig | None = None) -> float:
     return 3.0 * per_device * n_devices             # fwd + 2x bwd
 
 
+def mesh_spec_for(n_devices: int):
+    """The validation net's factored (dp, pp, sp, tp) axes as a declarative
+    MeshSpec — the single mesh-building path (parallel/mesh.py)."""
+    from kubeoperator_tpu.parallel.mesh import MeshSpec
+
+    dp, pp, sp, tp = axis_sizes(n_devices)
+    return MeshSpec(axes=(("dp", dp), ("pp", pp), ("sp", sp), ("tp", tp)))
+
+
 def build_mesh_for(devices):
     """(dp, pp, sp, tp) mesh over an explicit device list."""
-    from kubeoperator_tpu.parallel.mesh import build_mesh
-
-    dp, pp, sp, tp = axis_sizes(len(devices))
-    return build_mesh(("dp", "pp", "sp", "tp"), (dp, pp, sp, tp), devices)
+    return mesh_spec_for(len(devices)).build(list(devices))
 
 
 def param_specs(mesh):

@@ -354,6 +354,15 @@ class OperationRepo(EntityRepo[Operation]):
         return {r["status"]: int(r["n"]) for r in rows}
 
 
+# platform-scope (cluster_id == '') op kinds with a RESUME path: their
+# Interrupted rows are parked work whose span trees `journal.reopen`
+# re-arms, so the span prune must not collect them. Mirrors the
+# service-layer contract (fleet/engine.py FLEET_UPGRADE_KIND +
+# reconcile.py AUTO_RESUME_FLEET) — the repository layer cannot import
+# either without inverting the layering, and tests pin the agreement.
+RESUMABLE_SCOPED_KINDS = ("fleet-upgrade",)
+
+
 class SpanRepo(EntityRepo[Span]):
     """Operation trace spans (models/span.py). Timing fields are mirrored
     into real columns so the scrape-time histogram collectors and the trace
@@ -435,18 +444,23 @@ class SpanRepo(EntityRepo[Span]):
         what `journal.reopen` re-arms. Open/parked/interrupted ops and
         the children stitched under them are one retention unit.
 
-        The Interrupted exemption is FLEET-scope only (cluster_id = '',
-        the open_fleet marker): only fleet ops are ever reopened — a
+        The Interrupted exemption covers RESUMABLE kinds only (today:
+        fleet rollouts — `journal.reopen` re-arms their spans, so
+        pruning a parked rollout would lose the tree resume needs). A
         per-cluster op swept to Interrupted at boot is superseded by a
-        fresh op on retry, and exempting those would let every crash
-        loop grow the span store without bound."""
+        fresh op on retry, and a platform-scope WORKLOAD op never
+        resumes at all (re-running the workload is the recovery) —
+        exempting either would let a crash loop grow the span store
+        without bound."""
         if keep < 1:
             return 0
 
         def live(alias: str) -> str:
+            kinds = ", ".join(f"'{k}'" for k in RESUMABLE_SCOPED_KINDS)
             return (f"{alias}status IN ('Running', 'Paused') "
                     f"OR ({alias}status = 'Interrupted' "
-                    f"AND {alias}cluster_id = '')")
+                    f"AND {alias}cluster_id = '' "
+                    f"AND {alias}kind IN ({kinds}))")
 
         # cursor rowcount, NOT before/after COUNT(*) scans: this runs on
         # every operation close, on the operation's worker thread

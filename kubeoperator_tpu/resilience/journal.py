@@ -60,6 +60,41 @@ IN_FLIGHT_PHASES = frozenset({
 })
 
 
+def resolve_op_ref(repos, kind: str, op_ref: str = "",
+                   label: str = "operation") -> Operation:
+    """An op of `kind` by exact id, unique id prefix (>= 6 chars), or —
+    with no ref — the newest one. THE resolution contract for op-scoped
+    operator verbs (fleet + workload services both delegate here, so the
+    exact-id fast path and the prefix/ambiguity rules cannot drift).
+
+    The exact-id fast path matters operationally: poll loops resolve by
+    id once per second, and that tick must not hydrate every historical
+    op's vars blob just to match one row."""
+    from kubeoperator_tpu.utils.errors import NotFoundError, ValidationError
+
+    if op_ref:
+        try:
+            op = repos.operations.get(op_ref)
+            if op.kind == kind:
+                return op
+        except NotFoundError:
+            pass
+    ops = repos.operations.find(kind=kind)
+    if not op_ref:
+        if not ops:
+            raise NotFoundError(kind=label, name="(latest)")
+        return ops[-1]
+    matches = [op for op in ops if op.id == op_ref]
+    if not matches and len(op_ref) >= 6:
+        matches = [op for op in ops if op.id.startswith(op_ref)]
+    if len(matches) == 1:
+        return matches[0]
+    if len(matches) > 1:
+        raise ValidationError(
+            f"{label} ref {op_ref!r} is ambiguous ({len(matches)} matches)")
+    raise NotFoundError(kind=label, name=op_ref)
+
+
 def default_journal(repos, journal=None) -> "OperationJournal":
     """Service-constructor fallback, in ONE place: the container injects a
     single shared journal; direct construction (tests) gets a private one
@@ -182,14 +217,26 @@ class OperationJournal:
         contract as open(): the row lands before any wave work starts,
         so a dead controller leaves an open fleet op the boot reconciler
         sweeps to a resumable Interrupted state."""
+        return self.open_scoped(kind, vars=vars, message=message,
+                                scope="fleet")
+
+    def open_scoped(self, kind: str, vars: dict | None = None,
+                    message: str = "", scope: str = "fleet") -> Operation:
+        """Open a platform-scope journal op — an operation no single
+        cluster owns (fleet rollouts, tenant workloads): empty
+        cluster_id, the ``(scope)`` marker in the cluster_name slot so
+        history listings stay readable, the root span tagged with the
+        scope. Crash-safety and lease contracts match open(); the lease
+        resource is the op's own id (resource_of), so fencing works the
+        same as for cluster ops."""
         op = Operation(
-            cluster_id="", cluster_name="(fleet)", kind=kind,
+            cluster_id="", cluster_name=f"({scope})", kind=kind,
             vars=dict(vars or {}), message=message,
             trace_id=new_trace_id() if self.tracing else "",
         )
-        # fleet-scope lease keyed by the op's own id (no single cluster
-        # owns a rollout); claim + Running row in one transaction, same
-        # atomicity contract as open()
+        # op-scope lease keyed by the op's own id (no single cluster owns
+        # it); claim + Running row in one transaction, same atomicity
+        # contract as open()
         with self.repos.operations.db.tx():
             self._claim(op)
             self.repos.operations.save(op)
@@ -198,7 +245,7 @@ class OperationJournal:
                 id=op.id, trace_id=op.trace_id, parent_id="", op_id=op.id,
                 cluster_id="", name=kind, kind=SpanKind.OPERATION,
                 status=SpanStatus.RUNNING, started_at=now_ts(),
-                attrs={"scope": "fleet"},
+                attrs={"scope": scope},
             ))
         return op
 

@@ -149,6 +149,11 @@ class Services:
         from kubeoperator_tpu.service.fleet import FleetService
 
         self.fleet = FleetService(self)
+        # tenant workloads ride the same journal/trace/lease spine: a
+        # training run is a platform operation like any other
+        from kubeoperator_tpu.service.workload import WorkloadService
+
+        self.workloads = WorkloadService(self)
         self.cron = CronService(self)
         from kubeoperator_tpu.terminal import TerminalManager
 

@@ -36,7 +36,6 @@ from kubeoperator_tpu.resilience.fleet import FleetConfig, fleet_breaker
 from kubeoperator_tpu.resilience.watchdog import new_state
 from kubeoperator_tpu.utils.errors import (
     KoError,
-    NotFoundError,
     ValidationError,
 )
 from kubeoperator_tpu.utils.logging import get_logger
@@ -201,33 +200,13 @@ class FleetService:
 
     # ---- operator verbs ----
     def resolve(self, op_ref: str = "") -> Operation:
-        """An op by exact id, unique id prefix (>= 6 chars), or — with no
-        ref — the newest fleet op."""
-        if op_ref:
-            # exact-id fast path: `koctl fleet upgrade` polls status by
-            # id once per second — that tick must not hydrate every
-            # historical rollout's vars blob just to match one row
-            try:
-                op = self.repos.operations.get(op_ref)
-                if op.kind == FLEET_UPGRADE_KIND:
-                    return op
-            except NotFoundError:
-                pass
-        ops = self.repos.operations.find(kind=FLEET_UPGRADE_KIND)
-        if not op_ref:
-            if not ops:
-                raise NotFoundError(kind="fleet operation", name="(latest)")
-            return ops[-1]
-        matches = [op for op in ops if op.id == op_ref]
-        if not matches and len(op_ref) >= 6:
-            matches = [op for op in ops if op.id.startswith(op_ref)]
-        if len(matches) == 1:
-            return matches[0]
-        if len(matches) > 1:
-            raise ValidationError(
-                f"fleet op ref {op_ref!r} is ambiguous "
-                f"({len(matches)} matches)")
-        raise NotFoundError(kind="fleet operation", name=op_ref)
+        """A fleet op by exact id, unique id prefix, or — with no ref —
+        the newest one (the shared journal resolution contract, incl.
+        the exact-id fast path the 1 Hz status poll leans on)."""
+        from kubeoperator_tpu.resilience.journal import resolve_op_ref
+
+        return resolve_op_ref(self.repos, FLEET_UPGRADE_KIND, op_ref,
+                              label="fleet operation")
 
     def list_ops(self) -> list[dict]:
         ops = self.repos.operations.find(kind=FLEET_UPGRADE_KIND)

@@ -89,17 +89,24 @@ class ReconcileService:
         repos = self.services.repos
         journal = self.services.clusters.journal
         if op.kind in AUTO_RESUME_FLEET or not op.cluster_id:
-            # fleet op: no single cluster to strand; the resumable state
+            # platform-scope op (fleet rollout, tenant workload): no
+            # single cluster to strand. A fleet op's resumable state
             # (remaining waves, completed clusters) is already durable in
-            # op.vars — the sweep just names the wave it died in. Its
-            # per-cluster child ops are swept like any other orphan.
-            wave = op.vars.get("current_wave", 0)
-            journal.interrupt(
-                op, resume_phase=f"wave-{wave}",
-                message=f"{cause}: fleet rollout was in flight "
-                        f"(wave {wave}); `koctl fleet resume` continues "
-                        f"without re-running completed clusters",
-            )
+            # op.vars — the sweep just names the wave it died in; its
+            # per-cluster child ops are swept like any other orphan. A
+            # workload op has no resume path: re-running the workload is
+            # the recovery, and the interrupt says so.
+            if op.kind in AUTO_RESUME_FLEET:
+                wave = op.vars.get("current_wave", 0)
+                resume = f"wave-{wave}"
+                msg = (f"{cause}: fleet rollout was in flight "
+                       f"(wave {wave}); `koctl fleet resume` continues "
+                       f"without re-running completed clusters")
+            else:
+                resume = ""
+                msg = (f"{cause}: {op.kind} was in flight; re-run the "
+                       f"operation (platform-scope ops do not resume)")
+            journal.interrupt(op, resume_phase=resume, message=msg)
             return {
                 "cluster": op.cluster_name, "op": op.id, "kind": op.kind,
                 "resume_phase": op.resume_phase,

@@ -1,0 +1,237 @@
+"""WorkloadService — sharded training as a first-class platform workload.
+
+`koctl workload train --plan <plan> --mesh data=4,fsdp=2` lands here: the
+(data, fsdp, tp) mesh is parsed through the declarative MeshSpec, the
+partition-rule engine produces the layout (and its coverage report), and
+the run executes as a JOURNALED operation — so a tenant training run
+inherits everything cluster operations already have: the durable journal
+row (PR 3), the persisted span tree with step-window spans under the op
+root (`koctl workload trace` waterfalls, PR 5), and lease fencing in
+multi-controller stacks (PR 8) for free, because every one of those
+rides the journal the run writes through.
+
+Scope: like a fleet rollout, a workload op belongs to the PLATFORM, not
+to one cluster (`cluster_id == ""`, marker ``(workload)``); the lease
+resource is the op's own id. Orphaned workload ops sweep to Interrupted
+at boot with no resume path — re-running the workload IS the recovery
+(training state is the tenant's checkpoint problem, not the journal's).
+
+`--plan` pins the run to a deploy plan's TPU topology: the visible
+device count must match the plan, and the plan's generation supplies the
+MFU datasheet peak and ICI envelope context. Without a plan the run uses
+whatever devices are visible (the tier-1 path: 8 host-platform CPU
+devices).
+"""
+
+from __future__ import annotations
+
+from kubeoperator_tpu.models import Operation
+from kubeoperator_tpu.models.span import Span, SpanKind, SpanStatus
+from kubeoperator_tpu.utils.errors import KoError, ValidationError
+from kubeoperator_tpu.utils.logging import get_logger
+
+log = get_logger("service.workload")
+
+WORKLOAD_TRAIN_KIND = "workload-train"
+
+
+def train_kwargs(body: dict) -> dict:
+    """The body→`WorkloadService.train` translation BOTH transports share
+    (REST handler and `LocalClient._dispatch`) — the behavioral half of
+    the KO-X010 parity contract, same pattern as fleet's
+    `upgrade_kwargs`."""
+    from kubeoperator_tpu.fleet.planner import optional_int
+
+    return {
+        "plan": str(body.get("plan", "") or ""),
+        "mesh": str(body.get("mesh", "") or ""),
+        "steps": optional_int("steps", body.get("steps")),
+        "mode": str(body.get("mode", "") or ""),
+    }
+
+
+class WorkloadService:
+    def __init__(self, services) -> None:
+        self.s = services
+        self.repos = services.repos
+        self.journal = services.journal
+        cfg = services.config
+        self.default_steps = int(cfg.get("workloads.steps", 4))
+        self.default_mesh = str(cfg.get("workloads.mesh", "") or "")
+        self.default_mode = str(cfg.get("workloads.mode", "auto"))
+        self.peak_override = float(
+            cfg.get("workloads.peak_tflops_per_chip", 0.0))
+
+    # ---- the workload verb ----
+    def train(self, plan: str = "", mesh: str = "", steps: int | None = None,
+              mode: str = "") -> dict:
+        """One sharded training run as a journaled operation; returns the
+        op description including the run result and rule coverage."""
+        import jax
+
+        from kubeoperator_tpu.parallel.mesh import MeshSpec
+        from kubeoperator_tpu.workloads.harness import run_training
+        from kubeoperator_tpu.workloads.partition import explain_rules
+        from kubeoperator_tpu.workloads.step import (
+            WORKLOAD_AXES,
+            default_rules,
+            param_shapes,
+        )
+
+        steps = self.default_steps if steps is None else int(steps)
+        if steps < 2:
+            raise ValidationError(
+                "workload train needs steps >= 2 — a single step has no "
+                "loss pair for the descending-loss verdict")
+        mode = str(mode or self.default_mode)
+        if mode not in ("auto", "pjit", "shard_map"):
+            raise ValidationError(
+                f"workload mode {mode!r} not in (auto, pjit, shard_map)")
+
+        devices = list(jax.devices())
+        peak = self.peak_override or None
+        envelope = None
+        if plan:
+            row = self.s.plans.get(plan)    # NotFoundError names the plan
+            if not row.has_tpu():
+                raise ValidationError(
+                    f"plan {plan!r} has no TPU topology — `workload train` "
+                    f"is the sharded TPU workload")
+            topo = row.topology()
+            if len(devices) != topo.jax_device_count:
+                raise ValidationError(
+                    f"plan {plan!r} ({topo.accelerator_type}) expects "
+                    f"{topo.jax_device_count} devices, "
+                    f"{len(devices)} visible")
+            peak = peak or topo.generation.bf16_tflops_per_chip
+            envelope = topo.theoretical_allreduce_busbw_gbps()
+
+        mesh_text = str(mesh or self.default_mesh)
+        if mesh_text:
+            spec = MeshSpec.parse(mesh_text, axis_names=WORKLOAD_AXES,
+                                  n_devices=len(devices))
+            missing = tuple((a, 1) for a in WORKLOAD_AXES
+                            if a not in spec.axis_names)
+            if missing:
+                spec = MeshSpec(axes=spec.axes + missing)
+        else:
+            spec = MeshSpec(axes=(("data", len(devices)), ("fsdp", 1),
+                                  ("tp", 1)))
+        if spec.total_devices > len(devices):
+            raise ValidationError(
+                f"mesh {spec} needs {spec.total_devices} devices, "
+                f"{len(devices)} visible")
+
+        op = self.journal.open_scoped(
+            WORKLOAD_TRAIN_KIND,
+            vars={"plan": plan, "mesh": spec.describe(), "steps": steps,
+                  "mode": mode},
+            message=f"sharded train on mesh {spec} "
+                    f"({spec.total_devices} device(s))",
+            scope="workload",
+        )
+        log.info("workload op %s: mesh %s, %d steps, mode %s",
+                 op.id, spec, steps, mode)
+        try:
+            mesh_obj = spec.build(devices[: spec.total_devices])
+            run = run_training(mesh_obj, steps=steps, mode=mode)
+            windows = run.pop("windows", [])
+            self._record_windows(op, windows)
+            if run["mode"] == "pjit":
+                run["rules"] = explain_rules(default_rules(), param_shapes())
+            if peak:
+                run["mfu_pct"] = round(
+                    100.0 * run["model_tflops_per_s"]
+                    / (peak * run["devices"]), 3)
+                run["peak_tflops_per_chip"] = peak
+            if envelope:
+                run["ici_envelope_gbps"] = envelope
+            op.vars["result"] = run
+            self.journal.save_vars(op)
+            self.journal.close(
+                op, ok=bool(run["ok"]),
+                message=(f"loss {run['losses'][0]} -> {run['losses'][-1]} "
+                         f"in {run['steps']} steps "
+                         f"({run['steps_per_s']} steps/s, {run['mode']})")
+                if run["ok"] else
+                (f"training unhealthy: finite={run['finite']} "
+                 f"descending={run['descending']}"),
+            )
+        except KoError as e:
+            self.journal.close(op, ok=False, message=e.message)
+            raise
+        except Exception as e:
+            # jax/XLA failures surface as a failed journaled op, then as a
+            # clean API error — never a raw traceback through the CLI
+            self.journal.close(op, ok=False,
+                               message=f"{type(e).__name__}: {e}")
+            raise KoError(
+                f"workload train failed ({type(e).__name__}): {e}") from e
+        return self.describe(self.repos.operations.get(op.id))
+
+    def _record_windows(self, op: Operation, windows: list) -> None:
+        """Persist the run's named wall-clock windows (compile / steps) as
+        WINDOW spans under the op root — the step-window layer of the
+        trace tree. Ridden through the tracer's payload path (the same
+        road executor-produced task spans take), so the span cap and
+        NullTracer-off behavior apply unchanged."""
+        tracer = self.journal.tracer_for(op)
+        payloads = []
+        for w in windows:
+            payloads.append(Span(
+                trace_id=op.trace_id, parent_id=op.id, op_id=op.id,
+                cluster_id="", name=str(w.get("name", "window")),
+                kind=SpanKind.WINDOW, status=SpanStatus.OK,
+                started_at=float(w.get("start", 0.0)),
+                finished_at=float(w.get("end", 0.0)),
+                attrs=dict(w.get("attrs") or {}),
+            ).to_dict())
+        tracer.record_payload(payloads)
+        tracer.flush()
+
+    # ---- queries ----
+    def resolve(self, op_ref: str = "") -> Operation:
+        """A workload op by exact id, unique id prefix, or — with no
+        ref — the newest one (the shared journal resolution contract)."""
+        from kubeoperator_tpu.resilience.journal import resolve_op_ref
+
+        return resolve_op_ref(self.repos, WORKLOAD_TRAIN_KIND, op_ref,
+                              label="workload operation")
+
+    def describe(self, op: Operation) -> dict:
+        v = op.vars
+        return {
+            "id": op.id,
+            "kind": op.kind,
+            "status": op.status,
+            "message": op.message,
+            "plan": v.get("plan", ""),
+            "mesh": v.get("mesh", {}),
+            "steps": v.get("steps"),
+            "mode": v.get("mode", ""),
+            "result": v.get("result"),
+            "trace_id": op.trace_id,
+            "created_at": op.created_at,
+            "finished_at": op.finished_at or None,
+        }
+
+    def list_ops(self) -> list[dict]:
+        ops = self.repos.operations.find(kind=WORKLOAD_TRAIN_KIND)
+        return [self.describe(op) for op in reversed(ops)]
+
+    def status(self, op_ref: str = "") -> dict:
+        return self.describe(self.resolve(op_ref))
+
+    def trace(self, op_ref: str = "") -> dict:
+        """The workload op's span tree: operation root → step windows —
+        the `koctl workload trace` waterfall source."""
+        from kubeoperator_tpu.observability import span_tree
+
+        op = self.resolve(op_ref)
+        return {
+            "operation": op.id,
+            "kind": op.kind,
+            "status": op.status,
+            "trace_id": op.trace_id,
+            "tree": span_tree(self.journal.spans_of(op.id)),
+        }

@@ -160,6 +160,24 @@ DEFAULTS: dict[str, Any] = {
         # for the operator
         "auto_rollback": True,
     },
+    "workloads": {
+        # sharded-training tenant workload defaults (service/workload.py,
+        # docs/workloads.md); `koctl workload train` flags override these
+        # per-run.
+        # train steps per run (>= 2: the descending-loss verdict needs a
+        # loss pair)
+        "steps": 4,
+        # default mesh axis spec ("data=4,fsdp=2" form); "" = every
+        # visible device on the data axis
+        "mesh": "",
+        # compile seam posture: auto = pjit when the partition rules
+        # produced explicit shardings, shard_map otherwise; pjit /
+        # shard_map force one path (the parity drill runs both)
+        "mode": "auto",
+        # MFU denominator override in TFLOP/s per chip (0 = the plan
+        # generation's datasheet peak; CPU runs report no MFU)
+        "peak_tflops_per_chip": 0,
+    },
     "chaos": {
         # seeded fault injection over the executor (resilience/chaos.py);
         # exercised standalone via `koctl chaos-soak`. Never enable on a

@@ -1,0 +1,42 @@
+"""Sharded-training workloads — first-class platform tenant workloads.
+
+Layers (docs/workloads.md):
+
+* `partition` — the partition-rule engine: ordered (regex,
+  PartitionSpec) rules over /-joined param-tree paths, shard/gather fns,
+  and the `explain_rules` coverage report;
+* `step` — the (data, fsdp, tp) train step behind ONE `compile_step`
+  seam: pjit when explicit shardings exist, shard_map fallback;
+* `harness` — the per-axis scaling-efficiency / MFU sweep behind
+  bench.py's one-line JSON contract.
+
+`service/workload.py` runs these as journaled platform operations
+(`koctl workload train`), inheriting the operations journal, span trees
+and lease fencing.
+"""
+
+from kubeoperator_tpu.workloads.partition import (
+    PartitionError,
+    explain_rules,
+    make_shard_and_gather_fns,
+    match_partition_rules,
+    tree_paths,
+)
+from kubeoperator_tpu.workloads.step import (
+    WORKLOAD_AXES,
+    compile_step,
+    default_rules,
+    make_train_step,
+)
+
+__all__ = [
+    "PartitionError",
+    "WORKLOAD_AXES",
+    "compile_step",
+    "default_rules",
+    "explain_rules",
+    "make_shard_and_gather_fns",
+    "match_partition_rules",
+    "make_train_step",
+    "tree_paths",
+]

@@ -1,0 +1,219 @@
+"""Scaling-efficiency harness: sweep mesh shapes over the visible
+devices, report per-axis scaling efficiency, step time, and MFU.
+
+The multi-chip successor to the single-chip train bench (ROADMAP item 5,
+BENCH_r05's 83.5% MFU): for each workload axis (data, fsdp, tp) the
+harness runs the SAME train step on meshes that grow only that axis and
+compares achieved model TFLOP/s against perfect linear scaling from the
+1-device baseline —
+
+    efficiency(axis, n) = achieved_tflops(n) / (n · achieved_tflops(1))
+
+One basis for every axis, because the axes scale differently on purpose:
+data/fsdp weak-scale the batch (per-device batch fixed, global FLOPs grow
+n×) while tp strong-scales the FFN (global FLOPs fixed, per-device share
+shrinks) — achieved-FLOP throughput is the number that makes them
+comparable. MFU rides alongside whenever the caller supplies the
+generation's datasheet peak (real chips; CPU tier-1 runs report
+efficiency only), with the ICI envelope quoted for context — on
+hardware, the gap between an axis's efficiency curve and 100% IS the
+collective traffic that axis pushes through the ICI.
+
+Emits bench.py's one-line machine contract:
+
+    KO_TPU_WORKLOAD_RESULT {"ok": true, "rows": [...], ...}
+
+Timing discipline matches ops/train_smoke.py: compile outside the timed
+window, steps dispatched asynchronously, ONE scalar fetch that data-
+depends on the last parameter update as the end fence — relay RTT cannot
+masquerade as step time.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+from kubeoperator_tpu.parallel.mesh import MeshSpec, format_axes
+from kubeoperator_tpu.parallel.validation_net import NetConfig
+from kubeoperator_tpu.workloads.step import (
+    WORKLOAD_AXES,
+    analytic_step_flops,
+    build_batch,
+    compile_step,
+    default_rules,
+    init_params,
+    make_train_step,
+    param_shapes,
+)
+
+# per-run row keys the platform promises (docs/workloads.md "Harness
+# metrics schema"); tests schema-validate every emitted row against this
+ROW_SCHEMA = ("axis", "devices", "mesh", "mode", "steps", "steps_per_s",
+              "model_tflops_per_s", "scaling_efficiency_pct", "losses",
+              "ok")
+
+
+def run_training(mesh, cfg: NetConfig | None = None, steps: int = 4,
+                 mode: str = "auto", rules=None, seed: int = 0) -> dict:
+    """One training run on one mesh: compile, step, fence, judge.
+
+    Returns the full per-run record including ``windows`` — named
+    (compile / steps) wall-clock windows the service layer persists as
+    the operation's step-window spans (the harness stays tracer-free)."""
+    import jax
+
+    cfg = cfg or NetConfig()
+    t_open = time.time()
+    step_fn, specs, used = make_train_step(mesh, cfg, rules=rules, mode=mode)
+    params = init_params(mesh, cfg, seed=seed, specs=specs)
+    x = build_batch(mesh, cfg, seed=seed + 1)
+    # first call compiles AND is step 1; fence it out of the timed window
+    loss, params = step_fn(params, x)
+    device_losses = [loss]
+    float(jax.device_get(loss))
+    float(jax.device_get(params["step"]))        # compile the end fence too
+    t_compiled = time.time()
+    t0 = time.perf_counter()
+    for _ in range(max(steps - 1, 0)):
+        loss, params = step_fn(params, x)
+        device_losses.append(loss)
+    # the end fence: a scalar that data-depends on the LAST update
+    float(jax.device_get(params["step"]))
+    dt = time.perf_counter() - t0
+    t_done = time.time()
+
+    losses = [float(jax.device_get(l)) for l in device_losses]
+    finite = all(l == l and abs(l) != float("inf") for l in losses)
+    descending = losses[-1] < losses[0] if len(losses) > 1 else True
+    steps_per_s = round((len(losses) - 1) / dt, 3) if dt > 0 else 0.0
+    tflops = round(steps_per_s * analytic_step_flops(mesh, cfg) / 1e12, 4)
+    mesh_shape = {a: int(mesh.shape[a]) for a in mesh.axis_names}
+    return {
+        "ok": finite and descending,
+        "finite": finite,
+        "descending": descending,
+        "losses": [round(l, 6) for l in losses],
+        "steps": len(losses),
+        "steps_per_s": steps_per_s,
+        "model_tflops_per_s": tflops,
+        "mode": used,
+        "devices": int(mesh.devices.size),
+        "mesh": mesh_shape,
+        "windows": [
+            {"name": "compile", "start": t_open, "end": t_compiled,
+             "attrs": {"mode": used, "mesh": format_axes(mesh_shape)}},
+            {"name": "steps", "start": t_compiled, "end": t_done,
+             "attrs": {"steps": len(losses),
+                       "steps_per_s": steps_per_s}},
+        ],
+    }
+
+
+def sweep_specs(n_devices: int, axes=WORKLOAD_AXES) -> list[MeshSpec]:
+    """The sweep plan: the 1-device baseline, then each axis in `axes`
+    grown alone through the powers of two up to `n_devices` (other axes
+    1) — per-AXIS curves, not a cross-product; the cross-product is a
+    layout search, not a scaling measurement. Every spec carries ALL
+    workload axes (the step contract); `axes` only picks which get
+    grown."""
+    base = {name: 1 for name in WORKLOAD_AXES}
+    specs = [MeshSpec(axes=tuple(base.items()))]
+    for axis in axes:
+        n = 2
+        while n <= n_devices:
+            shape = dict(base)
+            shape[axis] = n
+            specs.append(MeshSpec(axes=tuple(shape.items())))
+            n *= 2
+    return specs
+
+
+def run_sweep(devices=None, cfg: NetConfig | None = None, steps: int = 4,
+              mode: str = "auto", peak_tflops_per_chip: float | None = None,
+              ici_envelope_gbps: float | None = None,
+              axes=WORKLOAD_AXES) -> dict:
+    """The scaling sweep (module docstring). Returns the BENCH report:
+    ``rows`` carry one ROW_SCHEMA record per swept mesh, `baseline` the
+    1-device run every efficiency is measured against."""
+    import jax
+
+    cfg = cfg or NetConfig()
+    devices = list(devices) if devices is not None else list(jax.devices())
+    n = len(devices)
+    rows: list[dict] = []
+    baseline_tflops = None
+    ok = True
+    for spec in sweep_specs(n, axes):
+        if spec.total_devices > n:
+            continue
+        mesh = spec.build(devices[: spec.total_devices])
+        run = run_training(mesh, cfg, steps=steps, mode=mode)
+        grown = [a for a, s in spec.axes if s > 1]
+        row = {
+            "axis": grown[0] if grown else "baseline",
+            "devices": run["devices"],
+            "mesh": run["mesh"],
+            "mode": run["mode"],
+            "steps": run["steps"],
+            "steps_per_s": run["steps_per_s"],
+            "model_tflops_per_s": run["model_tflops_per_s"],
+            "losses": run["losses"],
+            "ok": run["ok"],
+        }
+        if baseline_tflops is None:
+            baseline_tflops = run["model_tflops_per_s"]
+            row["scaling_efficiency_pct"] = 100.0
+        else:
+            ideal = baseline_tflops * run["devices"]
+            row["scaling_efficiency_pct"] = round(
+                100.0 * run["model_tflops_per_s"] / ideal, 1) \
+                if ideal > 0 else 0.0
+        if peak_tflops_per_chip:
+            row["mfu_pct"] = round(
+                100.0 * run["model_tflops_per_s"]
+                / (peak_tflops_per_chip * run["devices"]), 3)
+        ok = ok and run["ok"]
+        rows.append(row)
+    report = {
+        "ok": ok,
+        "devices": n,
+        "axes": list(axes),
+        "baseline": rows[0] if rows else None,
+        "rows": rows,
+        "config": {
+            "d_model": cfg.d_model, "d_ff": cfg.d_ff, "heads": cfg.heads,
+            "b_local": cfg.b_local, "s_local": cfg.s_local,
+            "dtype": cfg.dtype, "steps": steps,
+        },
+    }
+    if peak_tflops_per_chip:
+        report["peak_tflops_per_chip"] = peak_tflops_per_chip
+    if ici_envelope_gbps:
+        # context for reading the efficiency columns on hardware: the
+        # per-axis gap to 100% is collective traffic on this envelope
+        report["ici_envelope_gbps"] = ici_envelope_gbps
+    return report
+
+
+def main() -> int:
+    """Job entrypoint (mirrors train_smoke.main): bootstrap
+    jax.distributed from the env contract, sweep, emit the marker line."""
+    from kubeoperator_tpu.parallel.multislice import initialize_from_env
+    from kubeoperator_tpu.parallel.topology import generation_for_device
+
+    initialize_from_env()
+    import jax
+
+    gen = generation_for_device(jax.devices()[0])
+    report = run_sweep(
+        peak_tflops_per_chip=gen.bf16_tflops_per_chip if gen else None,
+        ici_envelope_gbps=2.0 * gen.ici_gbps_per_link if gen else None,
+    )
+    print("KO_TPU_WORKLOAD_RESULT " + json.dumps(report), flush=True)
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

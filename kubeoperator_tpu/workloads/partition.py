@@ -1,0 +1,161 @@
+"""Partition-rule engine: regex → PartitionSpec over a named param tree.
+
+The reusable sharding plumbing for every JAX workload the cluster hosts
+(ISSUE 9 / ROADMAP item 5). A workload declares its layout as an ORDERED
+list of ``(regex, PartitionSpec)`` rules; the engine matches each rule
+against the ``/``-joined path of every parameter in the tree and returns
+a matching pytree of specs. Three contracts, all load-bearing:
+
+* **Scalars are never partitioned** — a 0-d (or 1-element) leaf gets
+  ``PartitionSpec()`` before any rule is consulted, so step counters and
+  schedules can live in the param tree without rule noise.
+* **First match wins** — rules are ordered, so a specific rule placed
+  above a catch-all claims its params and nothing else does. Ordering is
+  part of the layout, not an implementation detail.
+* **Unmatched params are a hard error naming the offending path** — a
+  new parameter silently falling back to "replicated" is how a model
+  quietly loses its memory budget; the engine refuses instead, and
+  `explain_rules` is the diagnostic that shows exactly which rule claimed
+  what and which rules never fired.
+
+Pattern source: SNIPPETS.md [2] (`match_partition_rules` + shard/gather
+fns); re-grounded on jax.tree_util's path API rather than a hand-rolled
+tree walk so Flax-style nested dicts, lists and dataclass trees all name
+their leaves the same way.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, Sequence
+
+import numpy as np
+
+from kubeoperator_tpu.utils.errors import ValidationError
+
+
+class PartitionError(ValidationError):
+    """A param tree and a rule list that don't agree (unmatched param,
+    malformed rule). ValidationError subclass so the API surface maps it
+    to a 400, not a 500 — a bad layout is the caller's input."""
+
+
+Rules = Sequence[tuple[str, Any]]
+
+
+def _key_str(entry) -> str:
+    """One path entry → its bare name (DictKey('wqkv') → 'wqkv',
+    SequenceKey(2) → '2', GetAttrKey('w') → 'w')."""
+    for attr in ("key", "idx", "name"):
+        if hasattr(entry, attr):
+            return str(getattr(entry, attr))
+    return str(entry)
+
+
+def tree_paths(tree) -> list[tuple[str, Any]]:
+    """``[(path, leaf)]`` with ``/``-joined path names, the naming contract
+    every rule regex is written against."""
+    import jax
+
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return [("/".join(_key_str(k) for k in path), leaf)
+            for path, leaf in flat]
+
+
+def _is_scalar(leaf) -> bool:
+    shape = np.shape(leaf)
+    return len(shape) == 0 or int(np.prod(shape)) == 1
+
+
+def match_partition_rules(rules: Rules, params):
+    """Pytree of PartitionSpec mirroring `params` (see module docstring
+    for the three contracts). `params` may be real arrays or a
+    `jax.eval_shape` tree — only shapes are consulted."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    compiled = [(pattern, re.compile(pattern), spec)
+                for pattern, spec in rules]
+
+    def spec_for(path: str, leaf):
+        if _is_scalar(leaf):
+            return P()
+        for _, regex, spec in compiled:
+            if regex.search(path) is not None:
+                return spec
+        raise PartitionError(
+            f"no partition rule matches param {path!r} "
+            f"(shape {tuple(np.shape(leaf))}); add a rule or rename — "
+            f"silent replication is not a fallback")
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    specs = [spec_for("/".join(_key_str(k) for k in path), leaf)
+             for path, leaf in flat]
+    return jax.tree_util.tree_unflatten(treedef, specs)
+
+
+def explain_rules(rules: Rules, params) -> dict:
+    """Rule-coverage report — the diagnostic face of the engine:
+
+    ``claims``       ordered ``{path: {rule, spec, scalar}}`` — which rule
+                     claimed each param (rule is ``"(scalar)"`` for the
+                     scalar exemption, ``None`` for an unmatched param);
+    ``unmatched``    paths no rule claimed (`match_partition_rules` would
+                     raise on these);
+    ``unused_rules`` rule patterns that never fired — dead layout rules
+                     are usually a renamed param about to replicate.
+    """
+    def spec_json(spec) -> list:
+        # P(("data","fsdp"), None) → [["data","fsdp"], None]: tuple axis
+        # groups become lists so the report is JSON-clean verbatim
+        return [list(e) if isinstance(e, tuple) else e for e in spec]
+
+    compiled = [(pattern, re.compile(pattern), spec)
+                for pattern, spec in rules]
+    claims: dict[str, dict] = {}
+    fired: set[str] = set()
+    unmatched: list[str] = []
+    for path, leaf in tree_paths(params):
+        if _is_scalar(leaf):
+            claims[path] = {"rule": "(scalar)", "spec": [], "scalar": True}
+            continue
+        for pattern, regex, spec in compiled:
+            if regex.search(path) is not None:
+                fired.add(pattern)
+                claims[path] = {"rule": pattern, "spec": spec_json(spec),
+                                "scalar": False}
+                break
+        else:
+            claims[path] = {"rule": None, "spec": None, "scalar": False}
+            unmatched.append(path)
+    return {
+        "claims": claims,
+        "unmatched": unmatched,
+        "unused_rules": [pattern for pattern, _ in rules
+                         if pattern not in fired],
+    }
+
+
+def make_shard_and_gather_fns(
+    mesh, specs
+) -> tuple[Callable[[Any], Any], Callable[[Any], Any]]:
+    """(shard_fn, gather_fn) over whole trees: shard places host arrays
+    onto `mesh` per the spec tree (device_put with NamedSharding — XLA
+    moves each shard where it lives, no full-array replication step);
+    gather pulls every leaf back to a single host numpy tree (the
+    checkpoint/inspection direction)."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    def shard_fn(tree):
+        return jax.tree_util.tree_map(
+            lambda leaf, spec: jax.device_put(
+                leaf, NamedSharding(mesh, spec)),
+            tree, specs,
+        )
+
+    def gather_fn(tree):
+        return jax.tree_util.tree_map(
+            lambda leaf: np.asarray(jax.device_get(leaf)), tree)
+
+    return shard_fn, gather_fn

@@ -1,0 +1,289 @@
+"""The sharded-training tenant workload: a (data, fsdp, tp) train step
+with ONE compile seam — pjit when explicit shardings exist, shard_map
+fallback (SNIPPETS.md [3]).
+
+Model: the validation net's dense stage (parallel/validation_net.py —
+rms-norm → causal multi-head attention → megatron-shape FFN → readout)
+written in GLOBAL-array form over the net's own `NetConfig` dims. Global
+form is what makes the seam real: the same pure-jnp step body compiles
+under BOTH paths —
+
+* **pjit** (preferred, when the partition-rule engine produced explicit
+  shardings): `jax.jit` with NamedShardings in/out; GSPMD inserts the
+  collectives the layout implies (fsdp all-gathers, tp partial-sum
+  reduce), so the tp/fsdp axes do real tensor/param sharding.
+* **shard_map** (fallback, no shardings): map-style data parallelism —
+  params replicated, the batch sharded over the (data, fsdp) axes, loss
+  and grads psum'd explicitly. tp ranks replicate compute; that is the
+  documented trade of the fallback, not a bug — a workload that wants
+  tensor parallelism writes rules and gets pjit.
+
+The validation net's pp/sp families (pipeline ppermute, ring attention,
+MoE all_to_all) are deliberately NOT here: they are written against
+per-device collectives and live in validation_net's shard_map-only step.
+This module is the GSPMD face the platform schedules as a tenant
+workload; both consume the same `NetConfig` and the same mesh-building
+path (parallel/mesh.py MeshSpec).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from kubeoperator_tpu.parallel.validation_net import NetConfig
+from kubeoperator_tpu.workloads.partition import (
+    PartitionError,
+    make_shard_and_gather_fns,
+    match_partition_rules,
+)
+
+# the workload's declarative mesh axes (SNIPPETS.md [1] MeshConfig
+# pattern): data — pure batch parallelism; fsdp — batch AND param
+# sharding (ZeRO-3 style); tp — tensor parallelism over the FFN
+WORKLOAD_AXES = ("data", "fsdp", "tp")
+# the axes that shard the batch (and join the loss/grad reductions)
+DATA_AXES = ("data", "fsdp")
+
+
+def default_rules():
+    """The workload's layout as ordered (regex, PartitionSpec) rules —
+    the partition-rule engine's input, and the exemplar every future
+    tenant workload copies. First match wins; `w_head` could fall to a
+    catch-all but is named so `explain_rules` reads as documentation."""
+    from jax.sharding import PartitionSpec as P
+
+    return (
+        (r"wqkv$", P("fsdp", None)),        # ZeRO-3: rows sharded on fsdp
+        (r"w_in$", P(None, "tp")),          # megatron col-parallel
+        (r"w_out$", P("tp", None)),         # megatron row-parallel
+        (r"w_head$", P(None, None)),        # replicated readout
+    )
+
+
+def param_shapes(cfg: NetConfig | None = None) -> dict:
+    """ShapeDtypeStruct tree — the abstract params the rule engine and
+    `compile_step` consult without materializing a single weight."""
+    import jax
+
+    cfg = cfg or NetConfig()
+    d, f = cfg.d_model, cfg.d_ff
+    dt = cfg.np_dtype()
+    shapes = {
+        "wqkv": (d, 3 * d),
+        "w_in": (d, f),
+        "w_out": (f, d),
+        "w_head": (d, d),
+        # a non-trainable scalar rides the tree on purpose: the rule
+        # engine's scalar exemption is part of the workload contract
+        "step": (),
+    }
+    return {
+        name: jax.ShapeDtypeStruct(
+            shape, np.float32 if name == "step" else np.dtype(dt))
+        for name, shape in shapes.items()
+    }
+
+
+def build_host_params(cfg: NetConfig | None = None, seed: int = 0) -> dict:
+    """numpy param tree (host-built, backend-hermetic like the validation
+    net: no op lands on a default backend the caller didn't choose)."""
+    cfg = cfg or NetConfig()
+    rng = np.random.default_rng(seed)
+    out = {}
+    for name, sds in param_shapes(cfg).items():
+        if name == "step":
+            out[name] = np.zeros((), np.float32)
+        else:
+            out[name] = (rng.standard_normal(sds.shape) * 0.05).astype(
+                sds.dtype)
+    return out
+
+
+def init_params(mesh, cfg: NetConfig | None = None, seed: int = 0,
+                specs=None):
+    """Host params placed onto `mesh`: per the spec tree when given
+    (pjit path), replicated otherwise (shard_map path). Values are
+    identical either way — placement is layout, not math."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    cfg = cfg or NetConfig()
+    host = build_host_params(cfg, seed)
+    if specs is None:
+        specs = jax.tree_util.tree_map(lambda _: P(), host)
+    shard_fn, _ = make_shard_and_gather_fns(mesh, specs)
+    return shard_fn(host)
+
+
+def build_batch(mesh, cfg: NetConfig | None = None, seed: int = 1):
+    """Global [b_local·data·fsdp, seq, d_model] batch, sharded over the
+    (data, fsdp) axes. Weak scaling on the batch axes: per-device batch
+    stays `cfg.b_local` whatever the mesh shape."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    cfg = cfg or NetConfig()
+    data = int(mesh.shape["data"])
+    fsdp = int(mesh.shape["fsdp"])
+    rng = np.random.default_rng(seed)
+    host = rng.standard_normal(
+        (cfg.b_local * data * fsdp, cfg.s_local, cfg.d_model)
+    ).astype(cfg.np_dtype())
+    return jax.device_put(
+        host, NamedSharding(mesh, P(DATA_AXES, None, None)))
+
+
+def _forward(p, x, cfg: NetConfig):
+    """The dense stage in global form (see module docstring)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    d, h = cfg.d_model, cfg.heads
+    dh = d // h
+    bsz, seq = x.shape[0], x.shape[1]
+
+    def rms(v):
+        return v * lax.rsqrt(
+            jnp.mean((v * v).astype(jnp.float32), axis=-1, keepdims=True)
+            + 1e-6
+        ).astype(v.dtype)
+
+    qkv = rms(x) @ p["wqkv"]
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+
+    def heads4(t):
+        return t.reshape(bsz, seq, h, dh)
+
+    logits = jnp.einsum("bqhe,bkhe->bhqk", heads4(q), heads4(k)) \
+        .astype(jnp.float32) / np.sqrt(dh)
+    causal = np.tril(np.ones((seq, seq), np.float32))
+    logits = jnp.where(causal.astype(bool), logits, -1e30)
+    attn = jax.nn.softmax(logits, axis=-1).astype(x.dtype)
+    att = jnp.einsum("bhqk,bkhe->bqhe", attn, heads4(v)) \
+        .reshape(bsz, seq, d)
+    hx = x + att
+    ff = jax.nn.gelu(rms(hx) @ p["w_in"]) @ p["w_out"]
+    hx = hx + ff
+    return hx @ p["w_head"]
+
+
+def _sgd(p, grads, lr):
+    import jax
+
+    new_p = jax.tree_util.tree_map(lambda a, g: a - lr * g, p, grads)
+    # the scalar rides outside the gradient flow: a plain step counter,
+    # proving scalars cross both compile paths unpartitioned
+    new_p["step"] = p["step"] + 1.0
+    return new_p
+
+
+def analytic_step_flops(mesh, cfg: NetConfig | None = None) -> float:
+    """Model FLOPs for one global step from the architecture alone
+    (matmuls at 2·m·n·k, full-matrix attention per the standard MFU
+    convention, backward as 2× forward) — converts measured steps/s into
+    achieved model TFLOP/s the same way validation_net does."""
+    cfg = cfg or NetConfig()
+    data = int(mesh.shape["data"])
+    fsdp = int(mesh.shape["fsdp"])
+    b = cfg.b_local * data * fsdp
+    s, d, f = cfg.s_local, cfg.d_model, cfg.d_ff
+    fwd = (
+        6 * b * s * d * d          # qkv projection [d -> 3d]
+        + 4 * b * s * s * d        # attention: qk^T + av
+        + 2 * b * s * d * f        # FFN in
+        + 2 * b * s * f * d        # FFN out
+        + 2 * b * s * d * d        # readout head
+    )
+    return 3.0 * fwd
+
+
+def compile_step(mesh, cfg: NetConfig | None = None, specs=None,
+                 mode: str = "auto", lr: float | None = None):
+    """THE compile seam (SNIPPETS.md [3]): returns ``(step_fn, used)``
+    where ``step_fn(params, x) -> (loss, new_params)`` and ``used`` is
+    the path actually compiled. ``mode`` is ``auto`` (prefer pjit when
+    explicit shardings exist, else shard_map), or a forced ``pjit`` /
+    ``shard_map``."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from kubeoperator_tpu.parallel.mesh import shard_map_compat
+
+    cfg = cfg or NetConfig()
+    lr = cfg.lr if lr is None else lr
+    for axis in WORKLOAD_AXES:
+        if axis not in mesh.shape:
+            raise PartitionError(
+                f"workload mesh must carry the {WORKLOAD_AXES} axes, "
+                f"got {tuple(mesh.axis_names)}")
+    if mode == "auto":
+        mode = "pjit" if specs is not None else "shard_map"
+    data = int(mesh.shape["data"])
+    fsdp = int(mesh.shape["fsdp"])
+    denom = float(cfg.b_local * data * fsdp * cfg.s_local * cfg.d_model)
+
+    def loss_fn(p, xb):
+        y = _forward(p, xb, cfg).astype(jnp.float32)
+        return jnp.sum(y * y) / denom
+
+    if mode == "pjit":
+        if specs is None:
+            raise PartitionError(
+                "compile mode 'pjit' needs explicit shardings — run the "
+                "partition rules first, or use mode 'shard_map'")
+
+        def global_step(p, xb):
+            loss, grads = jax.value_and_grad(loss_fn)(p, xb)
+            return loss, _sgd(p, grads, lr)
+
+        param_sh = jax.tree_util.tree_map(
+            lambda s: NamedSharding(mesh, s), specs)
+        x_sh = NamedSharding(mesh, P(DATA_AXES, None, None))
+        loss_sh = NamedSharding(mesh, P())
+        return jax.jit(
+            global_step,
+            in_shardings=(param_sh, x_sh),
+            out_shardings=(loss_sh, param_sh),
+        ), "pjit"
+
+    if mode != "shard_map":
+        raise PartitionError(
+            f"unknown compile mode {mode!r} (auto|pjit|shard_map)")
+
+    def local_step(p, xb):
+        # params replicated, xb is this device's (data, fsdp) batch
+        # shard; each local term is already divided by the GLOBAL count,
+        # so the psum of partial losses/grads IS the global mean — the
+        # same value the pjit path computes, modulo summation order
+        loss, grads = jax.value_and_grad(loss_fn)(p, xb)
+        loss = lax.psum(loss, DATA_AXES)
+        grads = lax.psum(grads, DATA_AXES)
+        return loss, _sgd(p, grads, lr)
+
+    fn = shard_map_compat(
+        local_step, mesh,
+        in_specs=(P(), P(DATA_AXES, None, None)),
+        out_specs=(P(), P()),
+    )
+    return jax.jit(fn), "shard_map"
+
+
+def make_train_step(mesh, cfg: NetConfig | None = None, rules=None,
+                    mode: str = "auto", lr: float | None = None):
+    """Rules → specs → compiled step, in one call: returns
+    ``(step_fn, specs_or_None, used_mode)``. `specs` is None exactly when
+    the shard_map fallback compiled (no explicit shardings exist)."""
+    cfg = cfg or NetConfig()
+    if mode == "shard_map":
+        specs = None
+    else:
+        specs = match_partition_rules(
+            rules if rules is not None else default_rules(),
+            param_shapes(cfg))
+    step, used = compile_step(mesh, cfg, specs=specs, mode=mode, lr=lr)
+    if used == "shard_map":
+        specs = None
+    return step, specs, used

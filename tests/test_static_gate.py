@@ -185,6 +185,34 @@ def test_dag_scheduler_beats_serial_on_widest_config():
     )
 
 
+def test_workload_sweep_stays_under_budget():
+    """The sharded-training harness's operational budget (ISSUE 9 /
+    PERF.md workloads section): the full 8-device CPU mesh sweep — the
+    1-device baseline plus every (data, fsdp, tp) power-of-two point,
+    ten pjit compiles in all — must stay cheap enough to run in tier-1
+    on every commit. Measured ~3s on the round-12 machine; the 45s
+    ceiling absorbs a badly loaded CI host without letting a compile-
+    path regression (e.g. the seam silently recompiling per step) hide."""
+    from kubeoperator_tpu.workloads.harness import ROW_SCHEMA, run_sweep
+
+    start = time.perf_counter()
+    report = run_sweep(steps=3)
+    elapsed = time.perf_counter() - start
+    assert report["ok"], report
+    assert report["devices"] == 8, "conftest pins 8 host-platform devices"
+    # per-axis coverage: every workload axis contributes rows up to the
+    # full device count
+    by_axis = {}
+    for row in report["rows"]:
+        for key in ROW_SCHEMA:
+            assert key in row, f"row missing {key}: {row}"
+        by_axis.setdefault(row["axis"], []).append(row["devices"])
+    for axis in ("data", "fsdp", "tp"):
+        assert by_axis.get(axis) == [2, 4, 8], by_axis
+    assert elapsed < 45.0, (
+        f"workload sweep took {elapsed:.1f}s (budget 45s)")
+
+
 def test_tracing_overhead_stays_under_budget(tmp_path):
     """The observability layer's operational budget (PERF.md): a 3-node
     simulated create with tracing ON must stay within 5% wall-clock of the

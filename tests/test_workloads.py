@@ -1,0 +1,493 @@
+"""Sharded-training workload subsystem (ISSUE 9 tentpole).
+
+Tiers:
+  * pure partition-rule engine tests — no devices at all;
+  * MeshSpec parse contract (the one mesh-building path);
+  * compile-seam drills on the 8-device CPU mesh: pjit-vs-shard_map
+    parity on the SAME step (identical final loss), scalar ride-along,
+    harness row schema;
+  * the platform acceptance drill: `koctl workload train` as a journaled
+    op with a step-window span tree, both transports, KO-X010 parity
+    behavior, boot-sweep of an orphaned workload op.
+"""
+
+import json
+import math
+
+import numpy as np
+import pytest
+
+from kubeoperator_tpu.parallel.mesh import MeshSpec
+from kubeoperator_tpu.utils.errors import TopologyError
+from kubeoperator_tpu.workloads.partition import (
+    PartitionError,
+    explain_rules,
+    make_shard_and_gather_fns,
+    match_partition_rules,
+    tree_paths,
+)
+
+
+def P(*args):
+    from jax.sharding import PartitionSpec
+
+    return PartitionSpec(*args)
+
+
+# ---------------------------------------------------------------- engine ----
+class TestPartitionRules:
+    def test_paths_are_slash_joined_across_containers(self):
+        tree = {"block": {"w": np.ones((2, 2)),
+                          "stack": [np.ones((2,)), np.ones((3,))]}}
+        assert [p for p, _ in tree_paths(tree)] == [
+            "block/stack/0", "block/stack/1", "block/w"]
+
+    def test_rules_fire_and_ordering_wins(self):
+        params = {"attn": {"wqkv": np.ones((4, 12))},
+                  "mlp": {"w_in": np.ones((4, 8))}}
+        # first match wins: the catch-all below the specific rule never
+        # claims wqkv even though it also matches
+        rules = ((r"wqkv$", P("fsdp", None)), (r".*", P(None, "tp")))
+        specs = match_partition_rules(rules, params)
+        assert specs["attn"]["wqkv"] == P("fsdp", None)
+        assert specs["mlp"]["w_in"] == P(None, "tp")
+        # flipped order: the catch-all shadows everything — ordering is
+        # part of the layout, not noise
+        flipped = match_partition_rules(
+            ((r".*", P(None, "tp")), (r"wqkv$", P("fsdp", None))), params)
+        assert flipped["attn"]["wqkv"] == P(None, "tp")
+
+    def test_scalars_are_never_partitioned(self):
+        params = {"w": np.ones((4, 4)), "step": np.zeros(()),
+                  "one_element": np.ones((1, 1))}
+        specs = match_partition_rules(((r".*", P("data", None)),), params)
+        assert specs["step"] == P()
+        assert specs["one_element"] == P()
+        assert specs["w"] == P("data", None)
+
+    def test_unmatched_param_error_names_the_path(self):
+        params = {"attn": {"wqkv": np.ones((4, 12))},
+                  "brand_new": np.ones((4, 4))}
+        with pytest.raises(PartitionError) as err:
+            match_partition_rules(((r"wqkv$", P("fsdp", None)),), params)
+        assert "brand_new" in str(err.value)
+        assert "(4, 4)" in str(err.value)
+
+    def test_explain_rules_coverage_report(self):
+        params = {"wqkv": np.ones((4, 12)), "w_in": np.ones((4, 8)),
+                  "step": np.zeros(()), "orphan": np.ones((2, 2))}
+        rules = ((r"wqkv$", P(("data", "fsdp"), None)),
+                 (r"w_in$", P(None, "tp")),
+                 (r"never_fires$", P("tp", None)))
+        report = explain_rules(rules, params)
+        # golden shape: the full claims map, JSON-clean verbatim
+        assert report == {
+            "claims": {
+                "orphan": {"rule": None, "spec": None, "scalar": False},
+                "step": {"rule": "(scalar)", "spec": [], "scalar": True},
+                "w_in": {"rule": r"w_in$", "spec": [None, "tp"],
+                         "scalar": False},
+                "wqkv": {"rule": r"wqkv$",
+                         "spec": [["data", "fsdp"], None],
+                         "scalar": False},
+            },
+            "unmatched": ["orphan"],
+            "unused_rules": [r"never_fires$"],
+        }
+        json.dumps(report)   # the report is an API payload — must encode
+
+    def test_shard_and_gather_round_trip(self):
+        import jax
+
+        mesh = MeshSpec.parse("data=2,fsdp=2,tp=2").build()
+        host = {"w": np.arange(16, dtype=np.float32).reshape(4, 4),
+                "s": np.float32(7)}
+        specs = match_partition_rules(((r"w$", P("data", None)),), host)
+        shard_fn, gather_fn = make_shard_and_gather_fns(mesh, specs)
+        placed = shard_fn(host)
+        assert placed["w"].sharding.spec == P("data", None)
+        back = gather_fn(placed)
+        np.testing.assert_array_equal(back["w"], host["w"])
+        assert float(back["s"]) == 7.0
+        assert isinstance(back["w"], np.ndarray)
+
+
+# -------------------------------------------------------------- mesh spec ----
+class TestMeshSpec:
+    def test_parse_and_build(self):
+        spec = MeshSpec.parse("data=2,fsdp=2,tp=2")
+        assert spec.axis_names == ("data", "fsdp", "tp")
+        assert spec.total_devices == 8
+        mesh = spec.build()
+        assert dict(mesh.shape) == {"data": 2, "fsdp": 2, "tp": 2}
+        assert str(spec) == "data=2,fsdp=2,tp=2"
+
+    def test_fill_axis_absorbs_remaining_devices(self):
+        spec = MeshSpec.parse("data=-1,tp=2", n_devices=8)
+        assert spec.describe() == {"data": 4, "tp": 2}
+        with pytest.raises(TopologyError):
+            MeshSpec.parse("data=-1,tp=3", n_devices=8)   # 8 % 3
+        with pytest.raises(TopologyError):
+            MeshSpec.parse("data=-1,tp=-1", n_devices=8)  # one fill only
+        with pytest.raises(TopologyError):
+            MeshSpec.parse("data=-1")                      # no device count
+
+    def test_malformed_specs_die_naming_the_problem(self):
+        with pytest.raises(TopologyError, match="data"):
+            MeshSpec.parse("data=zero")
+        with pytest.raises(TopologyError, match="twice"):
+            MeshSpec.parse("data=2,data=4")
+        with pytest.raises(TopologyError, match="allowed"):
+            MeshSpec.parse("dp=4", axis_names=("data", "fsdp", "tp"))
+        with pytest.raises(TopologyError):
+            MeshSpec.parse("")
+
+    def test_validation_net_routes_through_mesh_spec(self):
+        """The dedupe contract: the validation net's factored mesh IS a
+        MeshSpec — one mesh-building path for every consumer."""
+        from kubeoperator_tpu.parallel.validation_net import mesh_spec_for
+
+        spec = mesh_spec_for(8)
+        assert isinstance(spec, MeshSpec)
+        assert spec.describe() == {"dp": 1, "pp": 2, "sp": 2, "tp": 2}
+        assert spec.total_devices == 8
+
+
+# ------------------------------------------------------------ compile seam ----
+class TestCompileSeam:
+    def test_pjit_and_shard_map_reach_identical_final_loss(self):
+        """The parity drill: the SAME step body under both compile paths
+        on a 2x2 CPU mesh — finite, descending, and the same final loss
+        (float-tolerance: the two paths order their reductions
+        differently, nothing more)."""
+        from kubeoperator_tpu.workloads.step import (
+            build_batch,
+            init_params,
+            make_train_step,
+        )
+
+        losses = {}
+        for mode in ("pjit", "shard_map"):
+            mesh = MeshSpec.parse("data=2,fsdp=2,tp=1").build()
+            step, specs, used = make_train_step(mesh, mode=mode)
+            assert used == mode
+            assert (specs is None) == (mode == "shard_map")
+            p = init_params(mesh, specs=specs)
+            x = build_batch(mesh)
+            run = []
+            for _ in range(6):
+                loss, p = step(p, x)
+                run.append(float(loss))
+            assert all(math.isfinite(l) for l in run)
+            assert run[-1] < run[0]
+            losses[mode] = run
+        assert losses["pjit"][-1] == pytest.approx(
+            losses["shard_map"][-1], rel=1e-5, abs=1e-7)
+
+    def test_auto_prefers_pjit_with_rules_and_falls_back_without(self):
+        from kubeoperator_tpu.workloads.step import compile_step
+
+        mesh = MeshSpec.parse("data=2,fsdp=2,tp=2").build()
+        _, used = compile_step(mesh, specs=None, mode="auto")
+        assert used == "shard_map"
+        from kubeoperator_tpu.workloads.step import (
+            default_rules,
+            param_shapes,
+        )
+
+        specs = match_partition_rules(default_rules(), param_shapes())
+        _, used = compile_step(mesh, specs=specs, mode="auto")
+        assert used == "pjit"
+        with pytest.raises(PartitionError, match="pjit"):
+            compile_step(mesh, specs=None, mode="pjit")
+        with pytest.raises(PartitionError, match="axes"):
+            compile_step(MeshSpec.parse("dp=8").build())
+
+    def test_scalar_rides_both_paths_unpartitioned(self):
+        """The step counter crosses both compile paths and counts."""
+        from kubeoperator_tpu.workloads.step import (
+            build_batch,
+            init_params,
+            make_train_step,
+        )
+        import jax
+
+        for mode in ("pjit", "shard_map"):
+            mesh = MeshSpec.parse("data=2,fsdp=1,tp=1").build()
+            step, specs, _ = make_train_step(mesh, mode=mode)
+            p = init_params(mesh, specs=specs)
+            x = build_batch(mesh)
+            for _ in range(3):
+                _, p = step(p, x)
+            assert float(jax.device_get(p["step"])) == 3.0
+
+
+# ---------------------------------------------------------------- harness ----
+class TestHarness:
+    def test_run_training_record_shape(self):
+        from kubeoperator_tpu.workloads.harness import run_training
+
+        mesh = MeshSpec.parse("data=2,fsdp=1,tp=1").build()
+        run = run_training(mesh, steps=3)
+        assert run["ok"] and run["finite"] and run["descending"]
+        assert run["steps"] == 3 and len(run["losses"]) == 3
+        assert run["mesh"] == {"data": 2, "fsdp": 1, "tp": 1}
+        assert [w["name"] for w in run["windows"]] == ["compile", "steps"]
+        for w in run["windows"]:
+            assert w["end"] >= w["start"] > 0
+
+    def test_sweep_rows_have_documented_schema(self):
+        """Per-axis efficiency rows carry exactly the documented schema
+        (docs/workloads.md); baseline pegs 100%."""
+        from kubeoperator_tpu.workloads.harness import ROW_SCHEMA, run_sweep
+
+        report = run_sweep(steps=2, axes=("data", "tp"))
+        assert report["ok"] is True
+        assert report["axes"] == ["data", "tp"]
+        assert report["baseline"]["axis"] == "baseline"
+        assert report["baseline"]["scaling_efficiency_pct"] == 100.0
+        for row in report["rows"]:
+            for key in ROW_SCHEMA:
+                assert key in row, f"row missing {key}"
+            assert row["scaling_efficiency_pct"] >= 0
+        json.dumps(report)   # the bench one-line contract must encode
+        # MFU column appears exactly when a datasheet peak is supplied
+        assert "mfu_pct" not in report["rows"][0]
+        with_peak = run_sweep(steps=2, axes=("data",),
+                              peak_tflops_per_chip=197.0,
+                              ici_envelope_gbps=800.0)
+        assert all("mfu_pct" in r for r in with_peak["rows"])
+        assert with_peak["ici_envelope_gbps"] == 800.0
+
+
+# ----------------------------------------------------- platform integration --
+def workload_stack(tmp_path, db="wl.db"):
+    from kubeoperator_tpu.service import build_services
+    from kubeoperator_tpu.utils.config import load_config
+
+    config = load_config(path="/nonexistent", env={}, overrides={
+        "db": {"path": str(tmp_path / db)},
+        "logging": {"level": "ERROR"},
+        "executor": {"backend": "simulation"},
+        "provisioner": {"work_dir": str(tmp_path / "tf")},
+        "cron": {"backup_enabled": False, "health_check_interval_s": 0,
+                 "event_sync_interval_s": 0},
+        "cluster": {"kubeconfig_dir": str(tmp_path / "kc")},
+    })
+    return build_services(config, simulate=True)
+
+
+class TestWorkloadService:
+    def test_train_is_a_journaled_op_with_step_window_spans(self, tmp_path):
+        """The ISSUE 9 acceptance drill: on the 8-device CPU mesh,
+        `workload train` completes as a journaled op with a span tree
+        (operation -> compile/steps windows), descending finite losses,
+        and the partition-rule coverage report riding the result."""
+        from kubeoperator_tpu.models import OperationStatus
+        from kubeoperator_tpu.observability import span_tree
+
+        svc = workload_stack(tmp_path)
+        try:
+            out = svc.workloads.train(mesh="data=4,fsdp=2", steps=4)
+            assert out["status"] == OperationStatus.SUCCEEDED.value
+            assert out["mesh"] == {"data": 4, "fsdp": 2, "tp": 1}
+            result = out["result"]
+            assert result["ok"] and result["mode"] == "pjit"
+            assert result["devices"] == 8
+            assert result["losses"][-1] < result["losses"][0]
+            # rule coverage rides the result: every param claimed, no
+            # dead rules in the default layout
+            assert result["rules"]["unmatched"] == []
+            assert result["rules"]["unused_rules"] == []
+            # journal row is the durable truth
+            op = svc.journal.operation(out["id"])
+            assert op.kind == "workload-train"
+            assert op.cluster_id == "" and op.cluster_name == "(workload)"
+            # span tree: op root + the two step windows
+            tree = span_tree(svc.journal.spans_of(op.id))
+            assert tree["id"] == op.id
+            windows = {n["name"]: n for n in tree["children"]}
+            assert set(windows) == {"compile", "steps"}
+            assert all(n["kind"] == "window" for n in windows.values())
+            assert windows["steps"]["attrs"]["steps"] == 4
+            # trace surface renders the same tree
+            trace = svc.workloads.trace(out["id"][:8])
+            assert trace["tree"]["id"] == op.id
+        finally:
+            svc.close()
+
+    def test_both_modes_reach_identical_final_loss_through_the_service(
+            self, tmp_path):
+        """The acceptance criterion's parity half, driven END TO END
+        through the platform surface (not the library): same final loss
+        from both compile paths on the same 8-device mesh."""
+        svc = workload_stack(tmp_path)
+        try:
+            finals = {}
+            for mode in ("pjit", "shard_map"):
+                out = svc.workloads.train(mesh="data=2,fsdp=2,tp=2",
+                                          steps=4, mode=mode)
+                result = out["result"]
+                assert result["ok"] and result["mode"] == mode
+                finals[mode] = result["losses"][-1]
+            assert finals["pjit"] == pytest.approx(
+                finals["shard_map"], rel=1e-5, abs=1e-7)
+        finally:
+            svc.close()
+
+    def test_validation_and_failure_paths(self, tmp_path):
+        from kubeoperator_tpu.models import OperationStatus
+        from kubeoperator_tpu.utils.errors import (
+            NotFoundError,
+            ValidationError,
+        )
+        from tests.test_reconcile import seed_tpu_plan
+
+        svc = workload_stack(tmp_path)
+        try:
+            with pytest.raises(ValidationError, match="steps"):
+                svc.workloads.train(steps=1)
+            with pytest.raises(ValidationError, match="mode"):
+                svc.workloads.train(mode="jit")
+            with pytest.raises(TopologyError, match="allowed"):
+                svc.workloads.train(mesh="dp=8")
+            with pytest.raises(ValidationError, match="devices"):
+                svc.workloads.train(mesh="data=16")
+            with pytest.raises(NotFoundError):
+                svc.workloads.train(plan="no-such-plan")
+            # a plan whose topology disagrees with the visible devices is
+            # a 400 naming both counts, not a confusing mesh error later
+            seed_tpu_plan(svc)   # tpu-v5e-16: expects 16 devices, 8 here
+            with pytest.raises(ValidationError, match="16"):
+                svc.workloads.train(plan="tpu-v5e-16")
+            # none of the rejected calls left a journal strand
+            assert svc.repos.operations.find(kind="workload-train") == []
+        finally:
+            svc.close()
+
+    def test_interrupted_workload_spans_do_not_ride_the_fleet_exemption(
+            self, tmp_path):
+        """Review hardening: the span prune exempts Interrupted
+        PLATFORM-scope ops because fleet rollouts resume through their
+        trees — workload ops never resume, so a crash-looping controller
+        must not grow the span store one permanently-Interrupted workload
+        trace per crash. Also pins the repository-layer kind list against
+        the service-layer contract it mirrors (layering forbids the
+        import)."""
+        from kubeoperator_tpu.fleet import FLEET_UPGRADE_KIND
+        from kubeoperator_tpu.repository.repos import RESUMABLE_SCOPED_KINDS
+        from kubeoperator_tpu.service.reconcile import AUTO_RESUME_FLEET
+
+        assert set(RESUMABLE_SCOPED_KINDS) == set(AUTO_RESUME_FLEET) \
+            == {FLEET_UPGRADE_KIND}
+
+        svc = workload_stack(tmp_path)
+        try:
+            journal = svc.journal
+            fleet_op = journal.open_fleet(FLEET_UPGRADE_KIND)
+            journal.interrupt(fleet_op, resume_phase="wave-0")
+            wl_op = journal.open_scoped("workload-train", scope="workload")
+            journal.interrupt(wl_op)
+            newest = svc.workloads.train(mesh="data=2", steps=2)
+            assert svc.repos.spans.for_operation(wl_op.id)
+
+            svc.repos.spans.prune_to_operations(keep=1)
+            # the resumable fleet trace survives outside the keep window;
+            # the unresumable workload trace does not
+            assert svc.repos.spans.for_operation(fleet_op.id)
+            assert svc.repos.spans.for_operation(wl_op.id) == []
+            assert svc.repos.spans.for_operation(newest["id"])
+        finally:
+            svc.close()
+
+    def test_orphaned_workload_op_is_swept_at_boot(self, tmp_path):
+        """Controller dies mid-train: the open workload op is an orphan
+        the boot reconciler sweeps to Interrupted — with the workload
+        wording (re-run), not the fleet resume wording."""
+        from kubeoperator_tpu.models import OperationStatus
+
+        svc = workload_stack(tmp_path)
+        op_id = svc.journal.open_scoped(
+            "workload-train", vars={"mesh": {"data": 8}},
+            scope="workload").id
+        svc.close()   # hard stop: op row still Running
+
+        svc2 = workload_stack(tmp_path)
+        try:
+            op = svc2.journal.operation(op_id)
+            assert op.status == OperationStatus.INTERRUPTED.value
+            assert "re-run" in op.message
+            assert op.resume_phase == ""
+            swept = [r for r in svc2.boot_report if r.get("op") == op_id]
+            assert swept and swept[0]["kind"] == "workload-train"
+        finally:
+            svc2.close()
+
+
+class TestWorkloadSurfaces:
+    def test_rest_surface(self, client):
+        base, session, services = client
+        resp = session.post(f"{base}/api/v1/workloads/train", json={
+            "mesh": "data=2,fsdp=2", "steps": 3})
+        assert resp.status_code == 201
+        op = resp.json()
+        assert op["status"] == "Succeeded"
+        assert op["result"]["mesh"] == {"data": 2, "fsdp": 2, "tp": 1}
+
+        resp = session.get(f"{base}/api/v1/workloads/operations")
+        assert resp.status_code == 200 and len(resp.json()) == 1
+        resp = session.get(
+            f"{base}/api/v1/workloads/operations/{op['id']}")
+        assert resp.json()["status"] == "Succeeded"
+        resp = session.get(
+            f"{base}/api/v1/workloads/operations/{op['id']}/trace")
+        assert resp.json()["tree"]["id"] == op["id"]
+        # bad input is a 400 with the field named, not a 500 — and a
+        # non-integral steps is rejected, not truncated (KO-X010 parity
+        # with the local transport below)
+        resp = session.post(f"{base}/api/v1/workloads/train",
+                            json={"steps": 1.9})
+        assert resp.status_code == 400
+        resp = session.post(f"{base}/api/v1/workloads/train",
+                            json={"mesh": "dp=4"})
+        assert resp.status_code == 400
+
+    def test_cli_local_transport(self, tmp_path, capsys, monkeypatch):
+        from kubeoperator_tpu.cli import koctl
+
+        monkeypatch.setenv("KO_TPU_CONFIG", "/nonexistent")
+        monkeypatch.setenv("KO_TPU_DB__PATH", str(tmp_path / "cli.db"))
+        monkeypatch.setenv("KO_TPU_EXECUTOR__BACKEND", "simulation")
+        monkeypatch.setenv("KO_TPU_PROVISIONER__WORK_DIR",
+                           str(tmp_path / "tf"))
+        monkeypatch.setenv("KO_TPU_CLUSTER__KUBECONFIG_DIR",
+                           str(tmp_path / "kc"))
+        monkeypatch.setenv("KO_TPU_LOGGING__LEVEL", "ERROR")
+
+        lc = koctl.LocalClient()
+        try:
+            args = koctl.build_parser().parse_args(
+                ["--local", "workload", "train", "--mesh", "data=4,fsdp=2",
+                 "--steps", "3", "--json"])
+            assert koctl.cmd_workload(lc, args) == 0
+            op = json.loads(capsys.readouterr().out)
+            assert op["status"] == "Succeeded"
+            assert op["result"]["mode"] == "pjit"
+
+            args = koctl.build_parser().parse_args(
+                ["--local", "workload", "list"])
+            assert koctl.cmd_workload(lc, args) == 0
+            assert "Succeeded" in capsys.readouterr().out
+
+            args = koctl.build_parser().parse_args(
+                ["--local", "workload", "trace"])
+            assert koctl.cmd_workload(lc, args) == 0
+            out = capsys.readouterr().out
+            assert "window:compile" in out and "window:steps" in out
+
+            # KO-X010 behavioral parity: the local transport rejects a
+            # non-integral steps exactly like the REST handler
+            with pytest.raises(SystemExit, match="integer"):
+                lc.call("POST", "/api/v1/workloads/train", {"steps": 1.9})
+        finally:
+            lc.services.close()
