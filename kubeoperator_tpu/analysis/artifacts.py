@@ -622,6 +622,109 @@ def check_phase_dags(ctx: AnalysisContext, families: dict | None = None
     return findings
 
 
+# ---------------------------------------------------------------- KO-X012 ---
+_MEGASCALE_VAR = "MEGASCALE_COORDINATOR_ADDRESS"
+_JOBSET_KIND_RE = re.compile(r"^\s*kind:\s*JobSet\s*$", re.MULTILINE)
+
+
+def _multislice_plan_decls(ctx: AnalysisContext) -> list:
+    """(plan file, plan name, num_slices) for every --plan plan declaring
+    num_slices > 1 (malformed files are KO-X004's problem, not ours)."""
+    out = []
+    for plan_file in ctx.plan_files:
+        try:
+            doc = ctx.load_yaml(plan_file)
+        except (OSError, yaml.YAMLError):
+            continue
+        plans = doc.get("plans", [doc]) if isinstance(doc, dict) else []
+        if not isinstance(plans, list):
+            continue
+        for raw in plans:
+            if not isinstance(raw, dict):
+                continue
+            try:
+                n = int(raw.get("num_slices", 1))
+            except (TypeError, ValueError):
+                continue
+            if n > 1:
+                out.append((plan_file,
+                            str(raw.get("name") or "<unnamed>"), n))
+    return out
+
+
+def check_multislice_launch(ctx: AnalysisContext, plans: list | None = None
+                            ) -> list:
+    """KO-X012 — the multislice launch contract: a plan declaring
+    ``num_slices > 1`` is a promise that the content layer can LAUNCH
+    DCN-connected slices, which means (a) a JobSet manifest template
+    exists (``kind: JobSet``), (b) some role task actually references it
+    as a launch path, and (c) the template wires the megascale
+    coordinator var — without `MEGASCALE_COORDINATOR_ADDRESS` the slices
+    boot as N independent single-slice runtimes and every cross-slice
+    collective hangs, a failure mode only visible minutes into a real
+    workload. Every existing JobSet template is held to (c) regardless of
+    plans, so stripping the megascale block from the smoke JobSet fires
+    even with no --plan file in hand."""
+    findings: list = []
+    jobset_templates: list = []     # (role, filename, path, text)
+    for role in ctx.roles():
+        tdir = os.path.join(ctx.roles_dir, role, "templates")
+        if not os.path.isdir(tdir):
+            continue
+        for fn in sorted(os.listdir(tdir)):
+            if not fn.endswith((".j2", ".yml", ".yaml")):
+                continue
+            path = os.path.join(tdir, fn)
+            text = ctx.read(path)
+            if _JOBSET_KIND_RE.search(text):
+                jobset_templates.append((role, fn, path, text))
+
+    megascale_ok = False
+    for _role, _fn, path, text in jobset_templates:
+        if _MEGASCALE_VAR in text:
+            megascale_ok = True
+        else:
+            findings.append(Finding(
+                "KO-X012", ctx.rel(path), 0,
+                f"JobSet launch template renders no {_MEGASCALE_VAR} — a "
+                f"num_slices > 1 plan would boot its slices as "
+                f"disconnected single-slice runtimes",
+            ))
+
+    referenced = False
+    if jobset_templates:
+        names = {fn for _role, fn, _path, _text in jobset_templates}
+        for _role, task_file in _iter_role_task_files(ctx):
+            text = ctx.read(task_file)
+            if any(name in text for name in names):
+                referenced = True
+                break
+        if not referenced:
+            findings.append(Finding(
+                "KO-X012", ctx.rel(ctx.roles_dir), 0,
+                "a JobSet launch template exists but no role task "
+                "references it — the multislice launch path is dead code",
+            ))
+
+    plans = _multislice_plan_decls(ctx) if plans is None else plans
+    for plan_file, plan_name, n in plans:
+        if not jobset_templates:
+            findings.append(Finding(
+                "KO-X012", plan_file, 0,
+                f"plan {plan_name} declares num_slices={n} but the "
+                f"content tree has no JobSet launch template "
+                f"(kind: JobSet) to schedule its slices with",
+            ))
+        elif not (megascale_ok and referenced):
+            findings.append(Finding(
+                "KO-X012", plan_file, 0,
+                f"plan {plan_name} declares num_slices={n} but the "
+                f"JobSet launch path is not fully wired (megascale "
+                f"coordinator var or role-task reference missing)",
+            ))
+    return findings
+
+
 ARTIFACT_RULES = {
     "KO-X001": check_role_resolution,
     "KO-X002": check_file_resolution,
@@ -632,4 +735,5 @@ ARTIFACT_RULES = {
     "KO-X007": check_manifest_refs,
     "KO-X008": check_version_vars,
     "KO-X011": check_phase_dags,
+    "KO-X012": check_multislice_launch,
 }

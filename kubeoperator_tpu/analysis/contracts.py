@@ -37,7 +37,8 @@ _PURE_KEY_RE = re.compile(r"^[a-z0-9_]+(\.[a-z0-9_]+)+$")
 # scheduler, lease and workloads blocks — docs/resilience.md +
 # docs/observability.md + docs/scheduler.md + docs/workloads.md)
 DOC_REQUIRED_SECTIONS = ("resilience", "chaos", "watchdog", "observability",
-                         "fleet", "scheduler", "lease", "workloads")
+                         "fleet", "scheduler", "lease", "workloads",
+                         "slicepool")
 
 
 def _defaults_from_tree(root: str) -> dict | None:

@@ -92,6 +92,14 @@ RULES: dict[str, RuleSpec] = {
             "declaration order a valid serial schedule), names are unique, "
             "and the ready-order is therefore deterministic",
         ),
+        RuleSpec(
+            "KO-X012", "multislice-launch", "artifact", ERROR,
+            "a plan declaring num_slices > 1 requires the JobSet launch "
+            "contract: a kind: JobSet template exists, a role task "
+            "references it, and it wires MEGASCALE_COORDINATOR_ADDRESS — "
+            "every existing JobSet template is held to the megascale-var "
+            "requirement regardless of plans",
+        ),
         # ---- project-rule AST checks (astcheck.py) ----
         RuleSpec(
             "KO-P001", "repo-layering", "ast", ERROR,

@@ -481,10 +481,8 @@ class Handlers:
         return json_response(cluster.to_public_dict())
 
     async def cluster_status(self, request):
-        cluster = await run_sync(request, self.s.clusters.get,
-                                 request.match_info["name"])
-        data = cluster.to_public_dict()["status"]
-        data["total_duration_s"] = cluster.status.total_duration_s()
+        data = await run_sync(request, self.s.clusters.status_payload,
+                              request.match_info["name"])
         return json_response(data)
 
     async def delete_cluster(self, request):
@@ -516,6 +514,22 @@ class Handlers:
         cluster = await run_sync(request, self.s.clusters.scale_slices,
                                  request.match_info["name"], raw, False)
         return json_response(cluster.to_public_dict(), status=202)
+
+    async def replace_slice(self, request):
+        body = await request.json()
+        raw = body.get("slice_id")
+        if isinstance(raw, bool) or not isinstance(raw, int) or raw < 0:
+            from kubeoperator_tpu.utils.errors import ValidationError
+
+            raise ValidationError("slice_id must be a non-negative integer")
+        cluster = await run_sync(request, self.s.clusters.replace_slice,
+                                 request.match_info["name"], raw, False)
+        return json_response(cluster.to_public_dict(), status=202)
+
+    async def cluster_slices(self, request):
+        data = await run_sync(request, self.s.clusters.slice_status,
+                              request.match_info["name"])
+        return json_response(data)
 
     async def rotate_encryption(self, request):
         cluster = await run_sync(
@@ -1174,6 +1188,10 @@ def create_app(services: Services) -> web.Application:
     r.add_post("/api/v1/clusters/import", h.import_cluster)
     r.add_post("/api/v1/clusters/{name}/scale-slices",
                cluster_guard(h.scale_slices, manage))
+    r.add_post("/api/v1/clusters/{name}/replace-slice",
+               cluster_guard(h.replace_slice, manage))
+    r.add_get("/api/v1/clusters/{name}/slices",
+              cluster_guard(h.cluster_slices, view))
     r.add_post("/api/v1/clusters/{name}/retry",
                cluster_guard(h.retry_cluster, manage))
     r.add_get("/api/v1/clusters/{name}/kubeconfig",

@@ -150,10 +150,7 @@ class LocalClient:
             case ("GET", ["clusters", name]):
                 return pub(s.clusters.get(name))
             case ("GET", ["clusters", name, "status"]):
-                cluster = s.clusters.get(name)
-                data = pub(cluster)["status"]
-                data["total_duration_s"] = cluster.status.total_duration_s()
-                return data
+                return s.clusters.status_payload(name)
             case ("DELETE", ["clusters", name]):
                 s.clusters.delete(name, wait=True)
                 return {"ok": True}
@@ -203,6 +200,11 @@ class LocalClient:
             case ("POST", ["clusters", name, "scale-slices"]):
                 return pub(s.clusters.scale_slices(
                     name, int(body.get("num_slices", 0)), wait=False))
+            case ("POST", ["clusters", name, "replace-slice"]):
+                return pub(s.clusters.replace_slice(
+                    name, int(body.get("slice_id", -1)), wait=False))
+            case ("GET", ["clusters", name, "slices"]):
+                return s.clusters.slice_status(name)
             case ("POST", ["clusters", name, "upgrade"]):
                 return pub(s.upgrades.upgrade(name, body["version"]))
             case ("POST", ["clusters", name, "rotate-encryption"]):
@@ -546,6 +548,38 @@ def cmd_cluster(client, args) -> int:
         if not args.no_wait:
             return _poll_to_ready(client, args.name, args.timeout, False)
         return 0
+    if args.cluster_cmd == "replace-slice":
+        client.call("POST", f"/api/v1/clusters/{args.name}/replace-slice",
+                    {"slice_id": args.slice})
+        if not args.no_wait:
+            return _poll_to_ready(client, args.name, args.timeout, False)
+        print(f"slice {args.slice} replacement on {args.name} accepted")
+        return 0
+    if args.cluster_cmd == "slices":
+        report = client.call("GET", f"/api/v1/clusters/{args.name}/slices")
+        degraded = [s for s in report["slices"] if s["health"] != "ok"]
+        if args.json:
+            _print(report)
+            return 1 if degraded else 0
+        print(f"{report['cluster']}: {report['accelerator_type']} "
+              f"x{report['num_slices']} ({report['total_chips']} chips)")
+        for s in report["slices"]:
+            mark = "ok " if s["health"] == "ok" else "DEGRADED"
+            hosts = ",".join(s["hosts"]) or "(no hosts)"
+            print(f"  [{mark}] slice {s['slice_id']}: "
+                  f"{len(s['hosts'])}/{s['expected_hosts']} hosts "
+                  f"({s['expected_chips']} chips expected) {hosts}"
+                  + (f" — {s['detail']}" if s["detail"] else ""))
+        if report["events"]:
+            from datetime import datetime
+
+            print("  incidents (newest first):")
+            for e in report["events"][:10]:
+                when = datetime.fromtimestamp(e["ts"]).isoformat(
+                    sep=" ", timespec="seconds")
+                print(f"    {when}  slice {e['slice_id']:>2}  "
+                      f"{e['kind']:9s} {e['detail']}")
+        return 1 if degraded else 0
     if args.cluster_cmd == "operations":
         ops = client.call(
             "GET",
@@ -1702,6 +1736,253 @@ def cmd_fleet_soak(args) -> int:
     return 0 if ok else 1
 
 
+def _preemption_soak_once(args, base_dir: str) -> tuple[list, dict]:
+    """One seeded preemption-drill pass (docs/resilience.md "Slice
+    preemption"): a 2x v5e-4 cluster loses slice 1 to a scripted GCE
+    preemption; the per-slice probe must attribute it within ONE watchdog
+    tick, the slice pool must drain → degrade (the workload's
+    compile_step re-shard actually runs on the surviving mesh, losses
+    pinned against a from-scratch N−1 run) → reprovision → restore, all
+    as one journaled op under lease fencing — and a stale-epoch write
+    from the drained slice's era must be rejected. Returns (checks,
+    structural-summary) so --verify-determinism can diff two passes."""
+    from kubeoperator_tpu.models import Plan, Region, Zone
+    from kubeoperator_tpu.resilience import StaleEpochError, lease_wiring
+    from kubeoperator_tpu.service import build_services
+    from kubeoperator_tpu.utils.config import load_config
+
+    checks: list[dict] = []
+
+    def check(name: str, ok, detail: str = "") -> None:
+        checks.append({"check": name, "ok": bool(ok), "detail": detail})
+
+    os.makedirs(base_dir, exist_ok=True)
+    config = load_config(path="/nonexistent", env={}, overrides={
+        "db": {"path": os.path.join(base_dir, "soak.db")},
+        "logging": {"level": "ERROR"},
+        "executor": {"backend": "simulation"},
+        "provisioner": {"work_dir": os.path.join(base_dir, "tf")},
+        # health interval must be ON (the drill drives ticks by resetting
+        # the stamp); 0 would disable the watchdog pass entirely
+        "cron": {"backup_enabled": False, "health_check_interval_s": 300,
+                 "event_sync_interval_s": 0},
+        "cluster": {"kubeconfig_dir": os.path.join(base_dir, "kc")},
+        "chaos": {"enabled": True, "seed": args.seed},
+        "watchdog": {"cooldown_s": 0},
+        "lease": {"controller_id": "preempt-drill-a"},
+    })
+    svc = build_services(config, simulate=True)
+    structure: dict = {}
+    try:
+        region = svc.regions.create(Region(
+            name="preempt-region", provider="gcp_tpu_vm",
+            vars={"project": "preempt", "name": "us-central1"}))
+        zone = svc.zones.create(Zone(
+            name="preempt-zone", region_id=region.id,
+            vars={"gcp_zone": "us-central1-a"}))
+        svc.plans.create(Plan(
+            name="preempt-v5e-4-x2", provider="gcp_tpu_vm",
+            region_id=region.id, zone_ids=[zone.id], accelerator="tpu",
+            tpu_type="v5e-4", num_slices=2, worker_count=0))
+        svc.clusters.create("preempt", provision_mode="plan",
+                            plan_name="preempt-v5e-4-x2", wait=True)
+        cluster = svc.clusters.get("preempt")
+        check("cluster Ready at 2x v5e-4 (8 chips)",
+              cluster.status.phase == "Ready"
+              and cluster.status.smoke_chips == 8,
+              f"{cluster.status.phase}/{cluster.status.smoke_chips}")
+
+        # ---- the preemption: slice 1's machines vanish from the probe --
+        chaos = svc.executor
+        chaos.preempt_slice(1, at_submission=1)
+
+        # ONE watchdog tick: detect (per-slice attribution) AND remediate
+        # (replace_slice runs synchronously under the breaker)
+        svc.cron._health_last = 0.0
+        actions = svc.cron.tick()
+        check("detected + replaced within one watchdog tick",
+              any(a == "watchdog-remediate:preempt:tpu-chips:ok"
+                  for a in actions), str(actions))
+        cluster = svc.clusters.get("preempt")
+        check("cluster Ready again after replacement",
+              cluster.status.phase == "Ready", cluster.status.phase)
+
+        # ---- journal evidence: one slice-replace op, end to end --------
+        history = svc.journal.history(cluster.id, 50)
+        replaces = [o for o in history if o.kind == "slice-replace"]
+        check("exactly one Succeeded slice-replace op",
+              len(replaces) == 1 and replaces[0].status == "Succeeded",
+              str([(o.kind, o.status) for o in history]))
+        op = replaces[0] if replaces else None
+        degraded = (op.vars.get("degraded") if op else None) or {}
+        check("degraded-mesh plan shrank the data axis (data=2 -> 1)",
+              degraded.get("shrunk_axis") == "data"
+              and degraded.get("degraded_mesh") == "data=1,fsdp=4,tp=1"
+              and degraded.get("full_mesh") == "data=2,fsdp=4,tp=1",
+              str(degraded.get("degraded_mesh")))
+        envs = degraded.get("host_envs") or []
+        check("survivor env contract re-emitted (1 host, no megascale)",
+              len(envs) == 1
+              and envs[0].get("KO_TPU_NUM_PROCESSES") == "1"
+              and "MEGASCALE_NUM_SLICES" not in envs[0], str(envs))
+        reshard = degraded.get("reshard") or {}
+        check("workload continued on the degraded mesh (4 devices)",
+              reshard.get("ran") and reshard.get("ok")
+              and reshard.get("devices") == 4,
+              str({k: reshard.get(k) for k in ("ran", "ok", "devices",
+                                               "reason")}))
+
+        # ---- loss parity: degraded continuation == from-scratch N−1 ----
+        import jax
+
+        from kubeoperator_tpu.parallel.mesh import MeshSpec
+        from kubeoperator_tpu.workloads.harness import run_training
+
+        spec = MeshSpec.parse(degraded["degraded_mesh"])
+        fresh = run_training(
+            spec.build(jax.devices()[:spec.total_devices]),
+            steps=int(reshard.get("steps", 0) or 0),
+            mode="auto", seed=int(reshard.get("seed", 0)))
+        check("loss parity pinned vs a from-scratch degraded run",
+              fresh["losses"] == reshard.get("losses"),
+              f"{fresh['losses']} vs {reshard.get('losses')}")
+
+        # ---- incident ledger: the five-step lifecycle, in order --------
+        ledger = list(reversed(svc.slicepool.history(cluster.id)))
+        kinds = [e.kind for e in ledger]
+        check("ledger rides detected->drained->degraded->replaced->restored",
+              kinds == ["detected", "drained", "degraded", "replaced",
+                        "restored"], str(kinds))
+        check("ledger rows join the journal op", op is not None and all(
+            e.op_id == op.id for e in ledger if e.kind != "detected"),
+            str([(e.kind, e.op_id) for e in ledger]))
+
+        # ---- one stitched span tree ------------------------------------
+        from kubeoperator_tpu.observability import span_tree
+
+        tree = span_tree(svc.journal.spans_of(op.id)) if op else None
+        names: set = set()
+
+        def walk(node):
+            names.add(node.get("name"))
+            for child in node.get("children", []):
+                walk(child)
+
+        if tree:
+            walk(tree)
+        check("span tree roots the replace op with re-shard windows",
+              tree is not None and tree.get("id") == op.id
+              and {"reshard-compile", "reshard-steps"} <= names
+              and "tpu-smoke-test" in names, str(sorted(
+                  n for n in names if isinstance(n, str))[:20]))
+
+        # ---- per-slice condition cleared + probe sees the full mesh ----
+        # the watchdog owns the degradation markers and drops them when
+        # the cluster next probes healthy — drive that tick
+        svc.cron._health_last = 0.0
+        svc.cron.tick()
+        cluster = svc.clusters.get("preempt")
+        check("per-slice degradation marker cleared once healthy again",
+              cluster.status.condition("health/slice-1") is None
+              and cluster.status.condition("health") is None,
+              str([c.name for c in cluster.status.conditions]))
+        report = svc.health.check("preempt")
+        probe = next((p for p in report.probes if p.name == "tpu-chips"),
+                     None)
+        check("probe sees the restored 8/8 chips per slice",
+              probe is not None and probe.ok and "8/8" in probe.detail
+              and not (probe.slices or {}).get("short"),
+              getattr(probe, "detail", "(no probe)"))
+
+        # ---- lease fencing: a write from the drained slice's era -------
+        peer_cfg = load_config(path="/nonexistent", env={}, overrides={
+            "lease": {"controller_id": "preempt-drill-b"}})
+        peer = lease_wiring(peer_cfg, svc.repos)
+        peer.claim(cluster.id)   # ownership changes hands: epoch bumps
+        phase_before = svc.repos.operations.get(op.id).phase
+        fenced = False
+        try:
+            svc.journal.progress(op, "zombie-write", "Running")
+        except StaleEpochError:
+            fenced = True
+        check("stale-epoch write from the drained era rejected", fenced)
+        check("fencing surfaced as an event",
+              len(svc.leases.fencing_events) >= 1
+              and svc.leases.fencing_events[-1].epoch
+              < svc.leases.fencing_events[-1].current_epoch,
+              str(svc.leases.fencing_events[-1:]))
+        check("journal row untouched by the rejected write",
+              svc.repos.operations.get(op.id).phase == phase_before
+              and phase_before != "zombie-write")
+
+        structure = {
+            "ledger": kinds,
+            "degraded_mesh": degraded.get("degraded_mesh"),
+            "shrunk_axis": degraded.get("shrunk_axis"),
+            "losses": reshard.get("losses"),
+            "injections": sorted(
+                (inj.kind, inj.host) for inj in chaos.injections),
+        }
+    finally:
+        svc.close()
+    return checks, structure
+
+
+def cmd_preemption_soak(args) -> int:
+    """`koctl chaos-soak --preemption`: the multislice preemption drill
+    (detect → degrade → replace → restore), asserted from journal rows
+    and the stitched span tree; --verify-determinism runs two seeded
+    passes and diffs the structural summary."""
+    import shutil
+    import tempfile
+    import time as _time
+
+    # the drill's 2x v5e-4 plan wants 8 virtual CPU devices, pinned
+    # BEFORE the first jax import (same discipline as perf_matrix)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flag = "--xla_force_host_platform_device_count=8"
+    if flag not in os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "") + " " + flag).strip()
+    t0 = _time.monotonic()
+    with tempfile.TemporaryDirectory(prefix="ko-preempt-soak-") as base:
+        checks, structure = _preemption_soak_once(
+            args, os.path.join(base, "pass1"))
+        deterministic = None
+        if args.verify_determinism:
+            checks2, structure2 = _preemption_soak_once(
+                args, os.path.join(base, "pass2"))
+            deterministic = (structure == structure2
+                             and [c["ok"] for c in checks]
+                             == [c["ok"] for c in checks2])
+        shutil.rmtree(base, ignore_errors=True)
+    ok = all(c["ok"] for c in checks) and deterministic in (None, True)
+    report = {
+        "seed": args.seed,
+        "checks": checks,
+        "structure": structure,
+        "runtime_s": round(_time.monotonic() - t0, 3),
+    }
+    if deterministic is not None:
+        report["deterministic"] = deterministic
+    if args.format == "json":
+        _print(report)
+    else:
+        print(f"preemption chaos-soak: seed={args.seed} "
+              f"mesh {structure.get('degraded_mesh')} "
+              f"(shrunk {structure.get('shrunk_axis')})")
+        for c in checks:
+            mark = "ok " if c["ok"] else "FAIL"
+            print(f"  [{mark}] {c['check']}"
+                  + (f" — {c['detail']}" if c["detail"] and not c["ok"]
+                     else ""))
+        if deterministic is not None:
+            print(f"  deterministic across two runs: {deterministic}")
+        print(f"  runtime {report['runtime_s']}s — "
+              + ("OK" if ok else "FAILED"))
+    return 0 if ok else 1
+
+
 def cmd_controller_soak(args) -> int:
     """`koctl chaos-soak --controllers N` (docs/resilience.md "Controller
     leases"): the multi-controller kill drill. A replica holding >=3
@@ -1778,7 +2059,8 @@ def cmd_chaos_soak(args) -> int:
     fault/retry traces. Exit 0 = every deploy reached Ready (and, with
     --verify-determinism, both passes matched). `--fleet` switches to the
     fleet-scale drill (canary-block / wave-rollback / death-resume);
-    `--controllers N` to the multi-replica controller-death drill."""
+    `--controllers N` to the multi-replica controller-death drill;
+    `--preemption` to the multislice slice-preemption drill."""
     import tempfile
     import time as _time
 
@@ -1786,6 +2068,8 @@ def cmd_chaos_soak(args) -> int:
         return cmd_controller_soak(args)
     if args.fleet:
         return cmd_fleet_soak(args)
+    if args.preemption:
+        return cmd_preemption_soak(args)
     t0 = _time.monotonic()
     with tempfile.TemporaryDirectory(prefix="ko-chaos-") as base:
         report = _chaos_soak_once(args, os.path.join(base, "pass1"))
@@ -1896,6 +2180,22 @@ def build_parser() -> argparse.ArgumentParser:
     sslices.add_argument("--slices", type=int, required=True)
     sslices.add_argument("--timeout", type=int, default=1800)
     sslices.add_argument("--no-wait", action="store_true")
+    rslice = csub.add_parser(
+        "replace-slice",
+        help="drain a preempted slice, keep training on the survivors' "
+             "degraded mesh, reprovision and restore (docs/resilience.md "
+             "\"Slice preemption\")")
+    rslice.add_argument("name")
+    rslice.add_argument("--slice", type=int, required=True,
+                        help="slice id to replace (see `cluster slices`)")
+    rslice.add_argument("--timeout", type=int, default=1800)
+    rslice.add_argument("--no-wait", action="store_true")
+    slices_p = csub.add_parser(
+        "slices",
+        help="per-slice posture + incident ledger (exit 1 if any slice "
+             "is degraded)")
+    slices_p.add_argument("name")
+    slices_p.add_argument("--json", action="store_true")
     scale = csub.add_parser("scale")
     scale.add_argument("name")
     scale.add_argument("--add", default="")
@@ -2184,6 +2484,15 @@ def build_parser() -> argparse.ArgumentParser:
                              "block, mid-wave rollback and controller-"
                              "death resume over a simulated fleet, each "
                              "asserted from the journal + span tree")
+    soak_p.add_argument("--preemption", action="store_true",
+                        help="run the multislice preemption drill "
+                             "instead: a slice vanishes, the per-slice "
+                             "probe attributes it within one watchdog "
+                             "tick, and the slice pool drains -> keeps "
+                             "training on the degraded mesh (loss parity "
+                             "pinned) -> reprovisions -> restores, all "
+                             "proven from journal rows + one span tree "
+                             "with lease fencing intact")
     soak_p.add_argument("--clusters", type=int, default=21,
                         help="fleet size for --fleet (floored at 9)")
     soak_p.add_argument("--controllers", type=int, default=0,

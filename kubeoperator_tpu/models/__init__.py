@@ -10,11 +10,13 @@ slice_topology/ici_mesh as first-class fields.
 
 from kubeoperator_tpu.models.base import Entity
 from kubeoperator_tpu.models.infra import (
+    SLICE_EVENT_KINDS,
     Credential,
     Host,
     Plan,
     PlanProvider,
     Region,
+    SliceEvent,
     Zone,
 )
 from kubeoperator_tpu.models.cluster import (
@@ -38,6 +40,7 @@ from kubeoperator_tpu.models.span import Span, SpanKind, SpanStatus
 __all__ = [
     "Entity",
     "Region", "Zone", "Plan", "PlanProvider", "Host", "Credential",
+    "SliceEvent", "SLICE_EVENT_KINDS",
     "Cluster", "ClusterSpec", "ClusterStatus", "ClusterStatusCondition",
     "ClusterPhaseStatus", "Node", "NodeRole", "ProvisionMode",
     "BackupAccount", "BackupFile", "BackupStrategy",

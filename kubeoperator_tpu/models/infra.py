@@ -224,3 +224,37 @@ class Host(Entity):
             raise ValidationError("host name required")
         if not self.ip:
             raise ValidationError(f"host {self.name}: ip required")
+
+
+# the slice-incident lifecycle the pool ledgers, in causal order — shared
+# by the drill's assertions and `koctl cluster slices` rendering
+SLICE_EVENT_KINDS: tuple[str, ...] = (
+    "detected", "drained", "degraded", "replaced", "restored",
+)
+
+
+@dataclass
+class SliceEvent(Entity):
+    """One row of the per-slice incident ledger (migration 009): the slice
+    pool's durable record of a preemption riding detect → drain → degrade
+    → replace → restore (resilience/slicepool.py). Kept separate from the
+    operation journal on purpose — an operation is one controller's unit
+    of work, while an incident spans the watchdog's detection, the
+    replace operation, and the restore verdict, possibly across
+    controllers; the op_id column is the join."""
+
+    cluster_id: str = ""
+    slice_id: int = 0
+    kind: str = ""       # one of SLICE_EVENT_KINDS
+    op_id: str = ""      # owning journal operation ("" for detection rows)
+    detail: str = ""
+
+    def validate(self) -> None:
+        if not self.cluster_id:
+            raise ValidationError("slice event needs a cluster_id")
+        if self.kind not in SLICE_EVENT_KINDS:
+            raise ValidationError(
+                f"slice event kind {self.kind!r} not in {SLICE_EVENT_KINDS}"
+            )
+        if self.slice_id < 0:
+            raise ValidationError("slice_id must be >= 0")

@@ -1,0 +1,213 @@
+"""2-slice DCN psum smoke over real OS processes — the multislice smoke
+gate (ROADMAP item 4, ISSUE 10 satellite 1).
+
+The MULTICHIP matrix stopped at 8-device single-slice meshes; this module
+is the gate that proves the MULTISLICE bootstrap end to end with TWO
+processes per slice, so both boundary classes exist in one run:
+
+  * the DCN (slice) boundary — the `dcn` mesh axis falls exactly on the
+    slice_id the env contract assigned, and a psum over it crosses slices;
+  * the intra-slice host boundary — each slice spans two OS processes, so
+    an `ici_0` psum crosses processes WITHOUT crossing slices.
+
+Workers are pure-CPU JAX runtimes wired through the SAME env contract the
+JobSet templates in (`parallel.multislice.host_envs` → per-rank
+`initialize_from_env`, gloo collectives on CPU), i.e. the exact bootstrap
+a real multislice JobSet ships — only libtpu's DCN transport is folded
+away. Consumed by tests/test_distributed.py (the tier-1 gate) and
+`perf_matrix.py --multislice` (the committed PERF row).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+from kubeoperator_tpu.parallel.multislice import host_envs
+from kubeoperator_tpu.parallel.topology import parse_accelerator_type
+
+REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+RESULT_MARKER = "KO_TPU_DCN_SMOKE"
+
+# Per-rank worker: bootstrap from the env contract FIRST, build the
+# (dcn, ici_0) mesh from the declared geometry, assert the dcn axis lands
+# on the slice boundary, then prove one cross-slice and one cross-host
+# collective. Geometry arrives via env (KO_TPU_SMOKE_*) so the same source
+# serves any slices × procs-per-slice shape.
+WORKER_SRC = """
+import json, os
+slice_id = int(os.environ["KO_TPU_SLICE_ID"])
+num_slices = int(os.environ["KO_TPU_SMOKE_SLICES"])
+procs_per_slice = int(os.environ["KO_TPU_SMOKE_PROCS_PER_SLICE"])
+local_devices = int(os.environ["KO_TPU_SMOKE_LOCAL_DEVICES"])
+if num_slices > 1:
+    assert os.environ["MEGASCALE_NUM_SLICES"] == str(num_slices)
+    assert int(os.environ["MEGASCALE_SLICE_ID"]) == slice_id
+
+from kubeoperator_tpu.parallel.multislice import initialize_from_env
+initialize_from_env()
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+from kubeoperator_tpu.parallel.mesh import build_mesh, shard_map_compat
+
+procs = num_slices * procs_per_slice
+per_slice = procs_per_slice * local_devices
+assert jax.process_count() == procs, jax.process_count()
+assert jax.device_count() == procs * local_devices, jax.device_count()
+
+# devices are process-major, so reshaping to (dcn, ici_0) puts each
+# slice's processes in one dcn row — assert it rather than assume it
+mesh = build_mesh(("dcn", "ici_0"), (num_slices, per_slice))
+local = set(jax.local_devices())
+for dcn_idx in range(num_slices):
+    for dev in mesh.devices[dcn_idx].flat:
+        if dev in local:
+            assert dcn_idx == slice_id, (dcn_idx, slice_id)
+
+# cross-slice: slice s contributes s+1 -> sum(1..N) everywhere
+arr_d = jax.make_array_from_callback(
+    (num_slices,), NamedSharding(mesh, P("dcn")),
+    lambda idx: np.full((1,), float(slice_id + 1), np.float32))
+dcn_sum = jax.jit(shard_map_compat(
+    lambda a: jax.lax.psum(a, "dcn"), mesh, in_specs=P("dcn"),
+    out_specs=P()))(arr_d)
+
+# cross-host inside the slice: ici_0 position i contributes i+1; the
+# axis spans this slice's TWO processes, so the psum crosses a process
+# boundary without crossing the slice boundary
+arr_h = jax.make_array_from_callback(
+    (per_slice,), NamedSharding(mesh, P("ici_0")),
+    lambda idx: np.full((1,), float(idx[0].start + 1), np.float32))
+ici_sum = jax.jit(shard_map_compat(
+    lambda a: jax.lax.psum(a, "ici_0"), mesh, in_specs=P("ici_0"),
+    out_specs=P()))(arr_h)
+
+print("{marker} " + json.dumps({
+    "rank": jax.process_index(),
+    "slice": slice_id,
+    "dcn_psum": float(np.asarray(dcn_sum)[0]),
+    "ici_psum": float(np.asarray(ici_sum)[0]),
+}), flush=True)
+""".replace("{marker}", RESULT_MARKER)
+
+
+def _free_port_pair() -> int:
+    """A port whose SUCCESSOR is also free: the multislice env contract
+    hands out port for jax.distributed and port+1 for the megascale
+    coordinator, so both must be bindable (and 65535 — whose successor
+    cannot exist — must never be returned)."""
+    for _attempt in range(32):
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+            if port >= 65535:
+                continue
+            with socket.socket() as s2:
+                try:
+                    s2.bind(("127.0.0.1", port + 1))
+                except OSError:
+                    continue
+                return port
+    raise RuntimeError("no free adjacent port pair found for the "
+                       "multislice coordinator contract")
+
+
+def _worker_env(base_env: dict, extra: dict, local_devices: int) -> dict:
+    """Pure-CPU env for one worker: scrub the image's TPU-tunnel plumbing
+    (its sitecustomize registers a remote backend whenever those are set),
+    force the virtual CPU device count, and put the repo on PYTHONPATH."""
+    env = {
+        k: v for k, v in base_env.items()
+        if not k.startswith(("PALLAS_AXON", "AXON_", "TPU_", "MEGASCALE"))
+        and k != "XLA_FLAGS"
+    }
+    env.update(extra)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={local_devices}")
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def run_dcn_smoke(tpu_type: str = "v5p-16", num_slices: int = 2,
+                  local_devices: int = 2, timeout_s: float = 300.0) -> dict:
+    """Run the multislice smoke gate: one OS process per host of
+    `tpu_type` × `num_slices` (v5p-16 ⇒ 2 hosts/slice ⇒ two processes
+    per slice), each a pure-CPU JAX runtime bootstrapped from the
+    host_envs contract. Returns the machine report (`ok`, per-boundary
+    psum values, wall time) the test gate and the PERF row both consume."""
+    topo = parse_accelerator_type(tpu_type, num_slices=num_slices)
+    envs = host_envs(topo, "127.0.0.1", port=_free_port_pair())
+    procs_per_slice = topo.hosts_per_slice
+    expected_dcn = float(sum(range(1, num_slices + 1)))
+    per_slice = procs_per_slice * local_devices
+    expected_ici = float(sum(range(1, per_slice + 1)))
+
+    t0 = time.monotonic()
+    workers = []
+    for henv in envs:
+        extra = dict(henv.to_env())
+        extra.update({
+            "KO_TPU_SMOKE_SLICES": str(num_slices),
+            "KO_TPU_SMOKE_PROCS_PER_SLICE": str(procs_per_slice),
+            "KO_TPU_SMOKE_LOCAL_DEVICES": str(local_devices),
+        })
+        workers.append(subprocess.Popen(  # KO-P006: waived — communicate(timeout=) below bounds every worker, and the finally block kills stragglers
+            [sys.executable, "-c", WORKER_SRC],
+            env=_worker_env(dict(os.environ), extra, local_devices),
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        ))
+    results, errors = [], []
+    try:
+        for proc in workers:
+            out, err = proc.communicate(timeout=timeout_s)
+            if proc.returncode != 0:
+                errors.append(err[-2000:])
+                continue
+            for line in out.splitlines():
+                if line.startswith(RESULT_MARKER):
+                    results.append(json.loads(line[len(RESULT_MARKER):]))
+    finally:
+        for proc in workers:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+    wall_s = time.monotonic() - t0
+    ok = (
+        not errors
+        and len(results) == len(envs)
+        and all(r["dcn_psum"] == expected_dcn for r in results)
+        and all(r["ici_psum"] == expected_ici for r in results)
+    )
+    return {
+        "ok": ok,
+        "tpu_type": tpu_type,
+        "num_slices": num_slices,
+        "processes": len(envs),
+        "procs_per_slice": procs_per_slice,
+        "local_devices": local_devices,
+        "global_devices": len(envs) * local_devices,
+        "dcn_psum": sorted({r["dcn_psum"] for r in results}),
+        "ici_psum": sorted({r["ici_psum"] for r in results}),
+        "expected_dcn_psum": expected_dcn,
+        "expected_ici_psum": expected_ici,
+        "errors": errors,
+        "wall_s": round(wall_s, 3),
+    }
+
+
+def main() -> int:
+    report = run_dcn_smoke()
+    print(RESULT_MARKER + "_REPORT " + json.dumps(report), flush=True)
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

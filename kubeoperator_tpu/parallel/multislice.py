@@ -8,6 +8,14 @@ the env-var contract those manifests template in, plus the in-process
 
 No NCCL/MPI anywhere: ICI carries intra-slice collectives, DCN (megascale)
 carries inter-slice — both via XLA.
+
+Preemption is THE multislice fault (ROADMAP item 4): a slice vanishes and
+the surviving N−1 must keep training at reduced scale instead of stalling
+until terraform rebuilds the machines. `degraded_mesh_spec` is the planner
+for that — it maps the workload's (data, fsdp, tp) layout onto the
+survivors (data-axis shrink first) — and `survivor_host_envs` re-emits the
+bootstrap contract for the surviving hosts; both are consumed by
+resilience/slicepool.py's replace-slice flow.
 """
 
 from __future__ import annotations
@@ -16,6 +24,7 @@ import os
 from dataclasses import dataclass
 
 from kubeoperator_tpu.parallel.topology import SliceTopology
+from kubeoperator_tpu.utils.errors import TopologyError
 
 
 @dataclass(frozen=True)
@@ -44,10 +53,33 @@ class HostEnv:
         return env
 
 
+def _check_env_contract(topo: SliceTopology, coordinator_host: str,
+                        port: int, multislice: bool) -> None:
+    """Validate the env-contract inputs LOUDLY: a malformed topology or
+    coordinator used to yield an empty/garbage env list that the JobSet
+    templated without complaint — the workers then hung in
+    jax.distributed.initialize with nothing pointing at the real cause."""
+    if not str(coordinator_host or "").strip():
+        raise TopologyError("host_envs needs a non-empty coordinator_host")
+    if not 1 <= int(port) <= 65535:
+        raise TopologyError(f"coordinator port {port} outside 1..65535")
+    if multislice and port + 1 > 65535:
+        # the megascale (DCN) coordinator is the NEXT port by contract
+        raise TopologyError(
+            f"multislice needs port+1 for the megascale coordinator; "
+            f"{port}+1 exceeds 65535")
+    if topo.total_hosts == 0:
+        raise TopologyError(
+            f"{topo.accelerator_type}: topology resolves to 0 hosts "
+            f"({topo.chips} chips is neither a single-host shape nor a "
+            f"multiple of {topo.generation.chips_per_host} chips/host)")
+
+
 def host_envs(
     topo: SliceTopology, coordinator_host: str, port: int = 8476
 ) -> list[HostEnv]:
     """Env blocks for every host process across the (multi)slice, rank 0 first."""
+    _check_env_contract(topo, coordinator_host, port, topo.is_multislice)
     total = topo.total_hosts
     envs = []
     for rank in range(total):
@@ -64,6 +96,91 @@ def host_envs(
             )
         )
     return envs
+
+
+def survivor_host_envs(
+    topo: SliceTopology, coordinator_host: str, port: int = 8476,
+    lost_slices: tuple[int, ...] = (),
+) -> list[HostEnv]:
+    """Env blocks for the hosts of the SURVIVING slices after a preemption:
+    the degraded-mesh relaunch contract. Ranks are contiguous over the
+    survivors and slice ids are remapped ordinally (0..S-1) — the env
+    contract describes the mesh the workers will actually build, not the
+    fleet the plan promised; MEGASCALE_* drops away when one slice
+    survives (it is a single-slice run until the pool restores)."""
+    lost = set(int(s) for s in lost_slices)
+    for sid in lost:
+        if not 0 <= sid < topo.num_slices:
+            raise TopologyError(
+                f"lost slice {sid} outside 0..{topo.num_slices - 1}")
+    survivors = [s for s in range(topo.num_slices) if s not in lost]
+    if not survivors:
+        raise TopologyError("no surviving slices to re-emit envs for")
+    multislice = len(survivors) > 1
+    _check_env_contract(topo, coordinator_host, port, multislice)
+    total = len(survivors) * topo.hosts_per_slice
+    envs = []
+    for ordinal, _slice in enumerate(survivors):
+        for worker in range(topo.hosts_per_slice):
+            rank = ordinal * topo.hosts_per_slice + worker
+            envs.append(HostEnv(
+                coordinator_address=f"{coordinator_host}:{port}",
+                num_processes=total,
+                process_id=rank,
+                slice_id=ordinal,
+                num_slices=len(survivors),
+                megascale_coordinator=(
+                    f"{coordinator_host}:{port + 1}" if multislice else None
+                ),
+            ))
+    return envs
+
+
+def degraded_mesh_spec(spec, num_slices: int, lost: int = 1):
+    """The degraded-mesh planner (ROADMAP item 4): given the workload's
+    (data, fsdp, tp) MeshSpec laid out over `num_slices` DCN-connected
+    slices and `lost` of them preempted, emit the MeshSpec the surviving
+    ``num_slices - lost`` slices re-shard onto, plus the axis that
+    absorbed the shrink.
+
+    Shrink policy, in order:
+
+      * **data first** — pure batch parallelism scales freely; losing a
+        slice is losing batch throughput, nothing else.
+      * **fsdp second** — ZeRO-style param sharding can re-gather onto
+        fewer ranks (the re-shard is a layout change, not a math change).
+      * **tp never** — tensor-parallel factors the MODEL; shrinking it
+        changes every rank's shard shapes in ways the rule set did not
+        declare, so a layout whose only DCN-spanning axis is tp cannot
+        re-shard and the caller must treat the preemption as an outage.
+
+    An axis only absorbs the shrink when it divides evenly (length scaled
+    by survivors/num_slices stays a positive integer); otherwise the next
+    candidate is tried. TopologyError when no rule-set-compatible axis
+    can re-shard."""
+    from kubeoperator_tpu.parallel.mesh import MeshSpec
+
+    if num_slices < 2:
+        raise TopologyError(
+            "degraded_mesh_spec needs a multislice layout (num_slices >= 2)")
+    if not 1 <= lost < num_slices:
+        raise TopologyError(
+            f"lost slices must be 1..{num_slices - 1}, got {lost}")
+    survivors = num_slices - lost
+    for axis in ("data", "fsdp"):
+        for name, length in spec.axes:
+            if name != axis:
+                continue
+            scaled = length * survivors
+            if scaled % num_slices == 0 and scaled // num_slices >= 1:
+                new_axes = tuple(
+                    (n, scaled // num_slices if n == axis else s)
+                    for n, s in spec.axes)
+                return MeshSpec(axes=new_axes), axis
+    raise TopologyError(
+        f"mesh {spec} cannot re-shard onto {survivors}/{num_slices} "
+        f"slices: no (data, fsdp) axis divides by the slice loss and tp "
+        f"is never shrunk (it factors the model, not the batch)")
 
 
 def initialize_from_env() -> None:

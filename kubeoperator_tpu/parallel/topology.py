@@ -261,6 +261,17 @@ class SliceTopology:
     def bf16_tflops_total(self) -> float:
         return self.generation.bf16_tflops_per_chip * self.total_chips
 
+    def with_slices(self, num_slices: int) -> "SliceTopology":
+        """The same generation/slice shape at a different slice count —
+        the slice pool's degraded/full topology pair (a preempted slice
+        leaves the survivors running exactly this, one slice short)."""
+        topo = SliceTopology(
+            generation=self.generation, chips=self.chips,
+            ici_mesh=self.ici_mesh, num_slices=num_slices,
+        )
+        topo.validate()
+        return topo
+
     def theoretical_allreduce_busbw_gbps(self) -> float:
         """Upper bound on all-reduce bus bandwidth over the ICI mesh.
 

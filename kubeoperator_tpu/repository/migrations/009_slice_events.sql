@@ -1,0 +1,19 @@
+-- Per-slice incident ledger (docs/resilience.md "Slice preemption"): one
+-- row per slice-lifecycle event (detected / drained / degraded / replaced
+-- / restored), written by the slice pool (resilience/slicepool.py) and the
+-- watchdog's detection path. Separate from the operations journal because
+-- an incident spans detection + the replace operation + the restore
+-- verdict, possibly across controllers; op_id joins back to the journal.
+CREATE TABLE IF NOT EXISTS slice_events (
+    id TEXT PRIMARY KEY,
+    cluster_id TEXT NOT NULL,
+    slice_id INTEGER NOT NULL,
+    kind TEXT NOT NULL,
+    op_id TEXT NOT NULL,
+    data TEXT NOT NULL,
+    created_at REAL NOT NULL,
+    updated_at REAL NOT NULL
+);
+CREATE INDEX IF NOT EXISTS idx_slice_events_cluster
+    ON slice_events (cluster_id);
+CREATE INDEX IF NOT EXISTS idx_slice_events_op ON slice_events (op_id);

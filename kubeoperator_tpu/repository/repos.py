@@ -29,6 +29,7 @@ from kubeoperator_tpu.models import (
     ProjectMember,
     Region,
     Setting,
+    SliceEvent,
     Span,
     TaskLogChunk,
     User,
@@ -487,6 +488,25 @@ class SettingRepo(EntityRepo[Setting]):
     table, entity, columns = "settings", Setting, ("name",)
 
 
+class SliceEventRepo(EntityRepo[SliceEvent]):
+    """Per-slice incident ledger rows (migration 009) — find() by
+    cluster/slice/kind/op rides the mirrored columns; rows are
+    append-only in practice (the pool never rewrites history)."""
+
+    table, entity, columns = (
+        "slice_events", SliceEvent,
+        ("cluster_id", "slice_id", "kind", "op_id"),
+    )
+
+    def for_cluster(self, cluster_id: str, limit: int = 100) -> list[SliceEvent]:
+        rows = self.db.query(
+            f"SELECT data FROM {self.table} WHERE cluster_id=? "
+            f"ORDER BY created_at DESC, rowid DESC LIMIT ?",
+            (cluster_id, int(limit)),
+        )
+        return [self._hydrate(r["data"]) for r in rows]
+
+
 # the database's own clock as epoch seconds — every lease comparison uses
 # THIS expression, never a replica's time.time(): expiry must mean the same
 # instant to every replica sharing the file, whatever their local clocks do
@@ -681,5 +701,6 @@ class Repositories:
         self.spans = SpanRepo(db)
         self.cis_scans = CisScanRepo(db)
         self.settings = SettingRepo(db)
+        self.slice_events = SliceEventRepo(db)
         self.audit = AuditRepo(db)
         self.leases = LeaseRepo(db)

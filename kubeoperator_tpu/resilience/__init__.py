@@ -26,6 +26,12 @@ one shared vocabulary for that:
     fleet_breaker        failure-budget breaker (a CircuitBreaker reuse)
                          behind wave-based rolling upgrades (fleet.py;
                          driven by service/fleet.py + kubeoperator_tpu/fleet/)
+  * SlicePool          — preemption-aware slice remediation: the per-slice
+                         incident ledger (migration 009) plus degraded-mesh
+                         planning/re-shard behind
+                         ClusterService.replace_slice and the watchdog's
+                         tpu-chips routing (slicepool.py; drilled by
+                         `koctl chaos-soak --preemption`)
   * LeaseManager /     — fenced cluster ownership for N controller replicas
     StaleEpochError      sharing one WAL db: single-statement CAS claims
                          with monotonic fencing epochs, heartbeat renewal
@@ -71,6 +77,11 @@ from kubeoperator_tpu.resilience.lease import (
     StaleEpochError,
     lease_wiring,
 )
+from kubeoperator_tpu.resilience.slicepool import (
+    SlicePool,
+    SlicePoolConfig,
+    mesh_spec_for_slices,
+)
 
 __all__ = ["RetryPolicy", "retry_call", "retry_wiring",
            "ChaosConfig", "ChaosExecutor", "ControllerDeath",
@@ -78,4 +89,5 @@ __all__ = ["RetryPolicy", "retry_call", "retry_wiring",
            "CIRCUIT_CLOSED", "CIRCUIT_OPEN", "CircuitBreaker",
            "WatchdogConfig", "FleetConfig", "fleet_breaker",
            "note_unavailable", "FencingEvent", "LeaseConfig",
-           "LeaseManager", "StaleEpochError", "lease_wiring"]
+           "LeaseManager", "StaleEpochError", "lease_wiring",
+           "SlicePool", "SlicePoolConfig", "mesh_spec_for_slices"]

@@ -13,6 +13,12 @@ task whether to inject a fault instead of (or around) delegating:
                      which is how phase deadlines get exercised
   * fail-N-then-succeed — scripted per (playbook, limit) via fail_times(),
                      for exact retry-count assertions
+  * slice-preemption — scripted `preempt_slice(slice_id, at_submission)`:
+                     the tpu-chips probe's view loses every node of one
+                     slice (synthesized truthfully from the task's own
+                     inventory vars), healing when the replacement flow's
+                     restore phase is next submitted — the GCE-reclaims-
+                     a-slice shape `koctl chaos-soak --preemption` drills
   * die-at-phase   — the CONTROLLER (not the runner) dies the moment the
                      named playbook is submitted: ControllerDeath derives
                      from BaseException so it tears straight through the
@@ -55,6 +61,11 @@ from kubeoperator_tpu.executor.base import (
 from kubeoperator_tpu.executor.inventory import inventory_host_names
 
 KILLED_RC = 137         # 128 + SIGKILL: process death mid-phase
+
+# the jsonpath fragment the tpu-chips probe command carries
+# (service/health.py TPU_CHIPS_CMD): how the wrapper recognizes a chip
+# probe without importing the service layer
+TPU_PROBE_MARKER = "allocatable.google"
 
 
 class ControllerDeath(BaseException):
@@ -147,6 +158,14 @@ class ChaosExecutor(Executor):
         self._scheduled: dict[tuple, dict] = {}  # key -> {abs index: kind}
         self._death_submissions = 0   # submissions of the doomed playbook
         self._dead = ""               # die_now(): permanent death reason
+        # slice-preemption state (preempt_slice): once any preemption is
+        # configured the wrapper answers tpu-chips probes itself with
+        # truthful per-slice output synthesized from the task's inventory
+        # — the preempted slice's nodes simply stop appearing, exactly
+        # what kubectl shows after GCE reclaims the machines
+        self._preemptions: dict[int, dict] = {}
+        self._probe_submissions = 0
+        self._probe_synth = False
         # per-key deterministic draw streams, all derived from the ONE
         # seed the caller passed: concurrent DAG phases may submit in any
         # wall-clock order without reassigning another key's draws
@@ -203,6 +222,17 @@ class ChaosExecutor(Executor):
                             f"{spec.playbook} (submission "
                             f"{self._death_submissions})"
                         )
+            # slice heal: the restore leg's runtime playbook brings the
+            # preempted slice's machines back into the probe's view — the
+            # moment the replacement flow re-runs it, the preemption ends
+            if spec.playbook and self._preemptions:
+                for sid, p in list(self._preemptions.items()):
+                    if p["active"] and spec.playbook == p["heal_on"]:
+                        del self._preemptions[sid]
+                        self.injections.append(Injection(
+                            task_id="", playbook=spec.playbook,
+                            kind="slice-heal", host=f"slice-{sid}",
+                        ))
         return super().run(spec, task_id)
 
     def die_now(self, reason: str = "simulated controller death "
@@ -245,6 +275,60 @@ class ChaosExecutor(Executor):
             for n in submissions:
                 slots[base + int(n)] = kind
 
+    def preempt_slice(self, slice_id: int, at_submission: int = 1,
+                      heal_on: str = "16-tpu-runtime.yml") -> None:
+        """Schedule a SLICE PREEMPTION: from the `at_submission`-th
+        tpu-chips probe counted from now (1-indexed, like fail_at), the
+        probe output loses every node of `slice_id` — the GCE-reclaimed-
+        machines shape the per-slice detector attributes. The preemption
+        heals when `heal_on` (the tpu-runtime phase by default) is next
+        submitted, because that is the replacement flow's restore leg
+        running over the re-provisioned machines. Scripted and
+        deterministic: consumes no RNG draw, like fail_times/fail_at."""
+        with self._ledger_lock:
+            self._probe_synth = True
+            self._preemptions[int(slice_id)] = {
+                "from": self._probe_submissions + max(int(at_submission), 1),
+                "active": False,
+                "heal_on": heal_on,
+            }
+
+    def _probe_lines(self, spec: TaskSpec) -> list | None:
+        """Synthesized tpu-chips probe output, or None to delegate to the
+        inner backend (no preemption ever configured). Output mirrors the
+        real jsonpath contract: one '<slice-id>=<chips>' line per TPU
+        node still standing (from the task's own inventory vars), a bare
+        '=' for label-less nodes, and NOTHING for the preempted
+        slice's nodes — their machines are gone from the apiserver."""
+        with self._ledger_lock:
+            if not self._probe_synth:
+                return None
+            self._probe_submissions += 1
+            n = self._probe_submissions
+            lost = set()
+            for sid, p in self._preemptions.items():
+                if not p["active"] and n >= p["from"]:
+                    p["active"] = True
+                    self.injections.append(Injection(
+                        task_id="", playbook="adhoc:command",
+                        kind="slice-preempt", host=f"slice-{sid}",
+                    ))
+                if p["active"]:
+                    lost.add(sid)
+        lines = []
+        hosts = (spec.inventory or {}).get("all", {}).get("hosts", {})
+        for name in sorted(hosts):
+            hv = hosts[name] or {}
+            chips = int(hv.get("tpu_chips", 0) or 0)
+            if chips <= 0:
+                lines.append("=")    # master/no-TPU node: empty fields
+                continue
+            sid = int(hv.get("tpu_slice_id", 0) or 0)
+            if sid in lost:
+                continue
+            lines.append(f"{sid}={chips}")
+        return lines
+
     # ---- fault selection ----
     def _next_fault(self, spec: TaskSpec) -> tuple:
         """Returns (kind|None, frac): `frac` ∈ [0,1) is derived from the
@@ -284,6 +368,14 @@ class ChaosExecutor(Executor):
     # ---- execution ----
     def _execute(self, spec: TaskSpec, state: _TaskState) -> None:
         name = spec.playbook or f"adhoc:{spec.adhoc_module}"
+        if spec.adhoc_module and TPU_PROBE_MARKER in (spec.adhoc_args or ""):
+            lines = self._probe_lines(spec)
+            if lines is not None:
+                state.emit(f"ADHOC [{spec.adhoc_module}] (chaos slice view)")
+                for line in lines:
+                    state.emit(line)
+                state.finish(TaskStatus.SUCCESS, rc=0)
+                return
         fault, frac = self._next_fault(spec)
         if fault == "unreachable":
             self._inject_unreachable(name, spec, state, frac)

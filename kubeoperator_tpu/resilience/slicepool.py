@@ -1,0 +1,213 @@
+"""SlicePool — preemption-aware slice remediation (ROADMAP item 4).
+
+Preemption is THE TPU-native fault: a whole slice of machines vanishes
+mid-training. Before this module the watchdog's only answer was an
+in-place whole-cluster ``reprovision`` — correct, but an outage: the
+workload stalls until terraform rebuilds the machines and the runtime
+phase re-runs. The slice pool turns that into graceful degradation:
+
+  detect   — the per-slice ``tpu-chips`` probe (service/health.py) names
+             WHICH slice is short; the watchdog ledgers the detection.
+  drain    — the lost slice's hosts leave the cluster (scale-down phases,
+             node/host rows deleted) so the scheduler stops counting them.
+  degrade  — `parallel.multislice.degraded_mesh_spec` re-plans the
+             workload's (data, fsdp, tp) layout onto the survivors
+             (data-axis shrink first), `survivor_host_envs` re-emits the
+             bootstrap contract, and — when enough local devices exist —
+             the workload's ``compile_step`` re-shard actually RUNS on the
+             degraded mesh: steps continue at reduced scale, and the
+             recorded losses pin parity against a from-scratch N−1 run.
+  replace  — terraform re-apply recreates the lost slice's machines
+             (ClusterService._provision reconciles by name).
+  restore  — the full phase list re-runs (kubeadm joins are creates:-
+             guarded) and the smoke gate re-proves the FULL topology.
+
+Every step is ledgered in the ``slice_events`` table (migration 009) and
+the whole replace flow is ONE journaled operation, so the incident is
+provable from journal rows + one span tree after the fact — which is
+exactly what `koctl chaos-soak --preemption` asserts. The watchdog drives
+replacement under its existing circuit breaker, so a flapping preemption
+escalates once instead of thrashing terraform forever.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from kubeoperator_tpu.models import SliceEvent
+from kubeoperator_tpu.utils.logging import get_logger
+
+log = get_logger("resilience.slicepool")
+
+
+@dataclass(frozen=True)
+class SlicePoolConfig:
+    """The `slicepool.*` config block (utils/config.py DEFAULTS)."""
+
+    enabled: bool = True
+    reshard: bool = True
+    reshard_steps: int = 4
+    reshard_seed: int = 0
+
+    @classmethod
+    def from_config(cls, config,
+                    section: str = "slicepool") -> "SlicePoolConfig":
+        base = cls()
+        return cls(
+            enabled=bool(config.get(f"{section}.enabled", base.enabled)),
+            reshard=bool(config.get(f"{section}.reshard", base.reshard)),
+            reshard_steps=int(config.get(
+                f"{section}.reshard_steps", base.reshard_steps)),
+            reshard_seed=int(config.get(
+                f"{section}.reshard_seed", base.reshard_seed)),
+        )
+
+
+def mesh_spec_for_slices(topo):
+    """The canonical (data, fsdp, tp) layout for a (multi)slice topology:
+    the DCN-spanning data axis carries one entry per slice, fsdp spans one
+    slice's chips, tp stays 1 — the exemplar layout whose data axis
+    `degraded_mesh_spec` shrinks naturally (N slices → N−1). Workloads
+    with their own layouts feed those through the planner instead; this is
+    the pool's default when no workload declared one."""
+    from kubeoperator_tpu.parallel.mesh import MeshSpec
+
+    return MeshSpec(axes=(
+        ("data", topo.num_slices), ("fsdp", topo.chips), ("tp", 1),
+    ))
+
+
+class SlicePool:
+    """Slice-incident ledger + degraded-mesh planning/re-shard, shared by
+    the watchdog's detection path and ClusterService.replace_slice. Pure
+    bookkeeping and planning — phase execution (drain playbooks,
+    terraform) and event emission stay in the cluster service where the
+    journal lives."""
+
+    def __init__(self, repos, config) -> None:
+        self.repos = repos
+        self.cfg = SlicePoolConfig.from_config(config)
+
+    @property
+    def enabled(self) -> bool:
+        return self.cfg.enabled
+
+    # ---- ledger ----
+    def note(self, cluster, slice_id: int, kind: str, op=None,
+             detail: str = "") -> SliceEvent:
+        """Append one incident row (detected/drained/degraded/replaced/
+        restored). Durable and append-only: the drill and `koctl cluster
+        slices` read the incident back from here, not from log lines."""
+        event = SliceEvent(
+            cluster_id=cluster.id, slice_id=int(slice_id), kind=kind,
+            op_id=getattr(op, "id", "") or "", detail=detail[:500],
+        )
+        event.validate()
+        self.repos.slice_events.save(event)
+        return event
+
+    def history(self, cluster_id: str, limit: int = 100) -> list:
+        return self.repos.slice_events.for_cluster(cluster_id, limit)
+
+    # ---- degraded-mesh planning + re-shard ----
+    def degrade(self, cluster, topo, slice_id: int, op, journal) -> dict:
+        """The degrade leg of a slice replacement: plan the survivors'
+        mesh, re-emit the bootstrap env contract, and run the in-process
+        re-shard proof when the controller has enough local devices.
+        Returns the JSON record replace_slice persists in
+        ``op.vars["degraded"]``."""
+        from kubeoperator_tpu.parallel.multislice import (
+            degraded_mesh_spec,
+            survivor_host_envs,
+        )
+
+        full_spec = mesh_spec_for_slices(topo)
+        degraded_spec, shrunk_axis = degraded_mesh_spec(
+            full_spec, topo.num_slices)
+        coordinator = self._survivor_coordinator(cluster, slice_id)
+        envs = survivor_host_envs(topo, coordinator,
+                                  lost_slices=(int(slice_id),))
+        record = {
+            "lost_slice": int(slice_id),
+            "surviving_slices": topo.num_slices - 1,
+            "full_mesh": str(full_spec),
+            "degraded_mesh": str(degraded_spec),
+            "shrunk_axis": shrunk_axis,
+            "host_envs": [e.to_env() for e in envs],
+            "reshard": self._reshard(degraded_spec, op, journal),
+        }
+        return record
+
+    def _survivor_coordinator(self, cluster, lost_slice: int) -> str:
+        """Rank-0 coordinator for the degraded relaunch: the first
+        surviving TPU host by (slice, worker, name). Falls back to the
+        relaunch JobSet's OWN rank-0 pod DNS name — ``slice-0`` here is
+        the degraded JobSet's first replicatedJob ORDINAL (survivors are
+        remapped ordinally by survivor_host_envs), i.e. always a
+        surviving physical slice, never the preempted one — so the env
+        contract never silently emits empty even on a cluster whose host
+        rows are not yet synced."""
+        hosts = sorted(
+            (h for h in self.repos.hosts.find(cluster_id=cluster.id)
+             if h.tpu_chips > 0 and h.tpu_slice_id != int(lost_slice)),
+            key=lambda h: (h.tpu_slice_id, h.tpu_worker_id, h.name),
+        )
+        if hosts:
+            return hosts[0].ip or hosts[0].name
+        return f"ko-tpu-smoke-{cluster.name}-slice-0-0-0.ko-tpu-smoke"
+
+    def _reshard(self, degraded_spec, op, journal) -> dict:
+        """Run the workload's compile_step on the degraded mesh — the
+        'steps continue at reduced scale' proof. Uses the controller's
+        local devices (the tier-1/drill path; on hardware the JobSet
+        relaunch with the emitted host_envs is the real continuation, and
+        a mesh bigger than the local device set records an honest
+        'deferred' instead of faking a run). Losses are seeded so the
+        drill can pin parity against a from-scratch N−1 run."""
+        if not self.cfg.reshard:
+            return {"ran": False, "reason": "slicepool.reshard disabled"}
+        import jax
+
+        devices = list(jax.devices())
+        needed = degraded_spec.total_devices
+        if needed > len(devices):
+            return {
+                "ran": False,
+                "reason": f"needs {needed} devices, {len(devices)} visible "
+                          f"locally — re-shard deferred to the workload "
+                          f"relaunch (host_envs emitted)",
+            }
+        from kubeoperator_tpu.workloads.harness import run_training
+
+        run = run_training(
+            degraded_spec.build(devices[:needed]),
+            steps=self.cfg.reshard_steps, mode="auto",
+            seed=self.cfg.reshard_seed,
+        )
+        windows = run.pop("windows", [])
+        self._record_windows(op, journal, windows)
+        run["ran"] = True
+        run["seed"] = self.cfg.reshard_seed
+        return run
+
+    def _record_windows(self, op, journal, windows: list) -> None:
+        """Persist the re-shard's compile/steps wall-clock windows as
+        WINDOW spans under the replace op's root — the degrade leg's
+        entry in the stitched tree (same payload road the workload
+        service's step windows ride, so cap/NullTracer behavior match)."""
+        from kubeoperator_tpu.models.span import Span, SpanKind, SpanStatus
+
+        tracer = journal.tracer_for(op)
+        payloads = []
+        for w in windows:
+            payloads.append(Span(
+                trace_id=op.trace_id, parent_id=op.id, op_id=op.id,
+                cluster_id=op.cluster_id,
+                name=f"reshard-{w.get('name', 'window')}",
+                kind=SpanKind.WINDOW, status=SpanStatus.OK,
+                started_at=float(w.get("start", 0.0)),
+                finished_at=float(w.get("end", 0.0)),
+                attrs=dict(w.get("attrs") or {}),
+            ).to_dict())
+        tracer.record_payload(payloads)
+        tracer.flush()

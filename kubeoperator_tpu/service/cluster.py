@@ -61,6 +61,7 @@ class ClusterService:
         retry_rng=None,
         journal=None,
         scheduler=None,
+        slicepool=None,
     ) -> None:
         self.repos = repos
         self.executor = executor
@@ -94,6 +95,10 @@ class ClusterService:
         from kubeoperator_tpu.resilience import default_journal
 
         self.journal = default_journal(repos, journal)
+        # preemption-aware slice pool (resilience/slicepool.py): the
+        # container injects the shared instance; direct construction
+        # builds a private one lazily in replace_slice over the same repos
+        self.slicepool = slicepool
         self._ops: dict[str, threading.Thread] = {}
         self._ops_lock = threading.Lock()
         # static-IP pool reservations: addresses allocated at render time but
@@ -114,6 +119,69 @@ class ClusterService:
 
     def get(self, name: str) -> Cluster:
         return self.repos.clusters.get_by_name(name)
+
+    def status_payload(self, name: str) -> dict:
+        """The status-JSON face BOTH transports serve (REST handler and
+        LocalClient dispatch — KO-X010 behavioral parity): the persisted
+        status plus total duration, and — for TPU plans — the resolved
+        slice topology block, so `num_slices`/per-slice math is first-
+        class in status output instead of a plan-table join away."""
+        cluster = self.get(name)
+        data = cluster.to_public_dict()["status"]
+        data["total_duration_s"] = cluster.status.total_duration_s()
+        if cluster.spec.tpu_enabled and cluster.plan_id:
+            try:
+                plan = self.repos.plans.get(cluster.plan_id)
+                if plan.has_tpu():
+                    data["topology"] = plan.topology().to_dict()
+            except (NotFoundError, ValidationError):
+                pass   # plan deleted under the cluster: status still serves
+        return data
+
+    def slice_status(self, name: str) -> dict:
+        """Per-slice posture + incident ledger (`koctl cluster slices`):
+        which hosts each slice holds, whether the watchdog currently marks
+        it degraded, and the slice_events history newest-first."""
+        from kubeoperator_tpu.models.cluster import ConditionStatus
+        from kubeoperator_tpu.service.watchdog import SLICE_CONDITION_PREFIX
+
+        cluster = self.get(name)
+        if not cluster.spec.tpu_enabled or not cluster.plan_id:
+            raise ValidationError(
+                f"cluster {name} has no TPU plan — slice status applies "
+                f"to TPU plan clusters")
+        plan = self.repos.plans.get(cluster.plan_id)
+        topo = plan.topology()
+        by_slice: dict[int, list[str]] = {}
+        for h in self.repos.hosts.find(cluster_id=cluster.id):
+            if h.tpu_chips > 0:
+                by_slice.setdefault(h.tpu_slice_id, []).append(h.name)
+        slices = []
+        for sid in range(topo.num_slices):
+            cond = cluster.status.condition(
+                f"{SLICE_CONDITION_PREFIX}{sid}")
+            degraded = (cond is not None
+                        and cond.status == ConditionStatus.FAILED.value)
+            slices.append({
+                "slice_id": sid,
+                "hosts": sorted(by_slice.get(sid, [])),
+                "expected_hosts": topo.hosts_per_slice,
+                "expected_chips": topo.chips,
+                "health": "degraded" if degraded else "ok",
+                "detail": cond.message if cond is not None else "",
+            })
+        events = [{
+            "ts": e.created_at, "slice_id": e.slice_id, "kind": e.kind,
+            "op_id": e.op_id, "detail": e.detail,
+        } for e in self.repos.slice_events.for_cluster(cluster.id)]
+        return {
+            "cluster": cluster.name,
+            "accelerator_type": topo.accelerator_type,
+            "num_slices": topo.num_slices,
+            "total_chips": topo.total_chips,
+            "slices": slices,
+            "events": events,
+        }
 
     def create(
         self,
@@ -335,14 +403,7 @@ class ClusterService:
                     ]
                     ctx = self._context(cluster, plan)
                     self.journal.attach(op, ctx)
-                    for host in sorted(leaving, key=lambda h: h.name):
-                        nodes = self.repos.nodes.find(
-                            cluster_id=cluster.id, name=host.name)
-                        if nodes:
-                            ctx.extra_vars["leaving_node"] = host.name
-                            self.adm.run(ctx, scale_down_phases())
-                            self.repos.nodes.delete(nodes[0].id)
-                        self.repos.hosts.delete(host.id)
+                    self._drain_tpu_hosts(cluster, ctx, leaving)
                 # plan changes AFTER shrink-drains, BEFORE terraform: the
                 # re-render needs the new count to create (or destroy) the
                 # right machines
@@ -385,6 +446,23 @@ class ClusterService:
 
         self._spawn(cluster.id, work, wait, pre_start=admit)
         return self.repos.clusters.get(cluster.id)
+
+    def _drain_tpu_hosts(self, cluster: Cluster, ctx: AdmContext,
+                         leaving: list[Host]) -> int:
+        """Drain + deregister TPU hosts, name-ordered: the ONE copy of the
+        drain protocol (scale-down phases per host that still has a node
+        row, then node+host deletion) shared by slice scale-down and
+        slice replacement. Returns how many hosts left."""
+        for host in sorted(leaving, key=lambda h: h.name):
+            nodes = self.repos.nodes.find(cluster_id=cluster.id,
+                                          name=host.name)
+            if nodes:
+                ctx.extra_vars["leaving_node"] = host.name
+                self.adm.run(ctx, scale_down_phases())
+                self.repos.nodes.delete(nodes[0].id)
+            self.repos.hosts.delete(host.id)
+        ctx.extra_vars.pop("leaving_node", None)
+        return len(leaving)
 
     def _run_day2(self, name: str, *, action: str, kind: str,
                   require_msg: str, phases_fn, on_success, fail_reason: str,
@@ -745,6 +823,132 @@ class ClusterService:
                                        op=op)
                 self.journal.close(op, ok=False, message=str(e))
                 self.events.emit(cluster.id, "Warning", "ClusterCreateFailed", str(e))
+                if wait:
+                    raise
+
+        self._spawn(cluster.id, work, wait, pre_start=admit)
+        return self.repos.clusters.get(cluster.id)
+
+    def replace_slice(self, name: str, slice_id: int,
+                      wait: bool = True) -> Cluster:
+        """Preemption-aware slice replacement (docs/resilience.md "Slice
+        preemption"): one journaled operation riding drain → degrade →
+        replace → restore. The lost slice's hosts are drained out of the
+        cluster, the slice pool re-plans the workload's (data, fsdp, tp)
+        mesh onto the survivors and proves the compile_step re-shard
+        (graceful degradation — steps continue at reduced scale, not an
+        outage), then terraform recreates the slice's machines and the
+        full phase list re-gates Ready on the restored topology. Driven
+        automatically by the watchdog's tpu-chips routing (under its
+        circuit breaker, so a flapping preemption escalates once) and
+        manually via `koctl cluster replace-slice`. A replacement that
+        dies mid-way resumes through retry() like any create-shaped op."""
+        from kubeoperator_tpu.resilience.slicepool import SlicePool
+
+        cluster = self.get(name)
+        cluster.require_managed("slice replacement")
+        if cluster.provision_mode != ProvisionMode.PLAN.value \
+                or not cluster.spec.tpu_enabled:
+            raise ValidationError(
+                "slice replacement applies to plan-mode TPU clusters only")
+        if cluster.status.phase not in (
+            ClusterPhaseStatus.READY.value, ClusterPhaseStatus.FAILED.value
+        ):
+            raise ValidationError(
+                f"cluster {name} is {cluster.status.phase}; slice "
+                f"replacement needs Ready or Failed")
+        plan = self.repos.plans.get(cluster.plan_id)
+        topo = plan.topology()
+        if not topo.is_multislice:
+            raise ValidationError(
+                f"plan {plan.name} is single-slice; a preempted slice "
+                f"heals via reprovision, there is nothing to drain onto")
+        slice_id = int(slice_id)
+        if not 0 <= slice_id < topo.num_slices:
+            raise ValidationError(
+                f"slice_id {slice_id} outside 0..{topo.num_slices - 1}")
+        pool = self.slicepool if self.slicepool is not None \
+            else SlicePool(self.repos, self.config)
+        op = None
+
+        def admit():
+            nonlocal op
+            op = self.journal.open(
+                cluster, "slice-replace",
+                phase=ClusterPhaseStatus.SCALING,
+                vars={"slice_id": slice_id},
+            )
+            self.events.emit(
+                cluster.id, "Normal", "SliceReplaceStarted",
+                f"replacing slice {slice_id} of {name} "
+                f"({topo.accelerator_type} x{topo.num_slices})",
+            )
+
+        def work():
+            try:
+                # ---- drain: the lost slice's hosts leave the cluster ----
+                self.journal.progress(op, "drain", "Running")
+                ctx = self._context(cluster, plan)
+                self.journal.attach(op, ctx)
+                leaving = [
+                    h for h in self.repos.hosts.find(cluster_id=cluster.id)
+                    if h.tpu_chips > 0 and h.tpu_slice_id == slice_id
+                ]
+                drained = self._drain_tpu_hosts(cluster, ctx, leaving)
+                self.journal.progress(op, "drain", "OK")
+                pool.note(cluster, slice_id, "drained", op,
+                          detail=f"{drained} host(s) drained")
+
+                # ---- degrade: survivors keep training at reduced scale --
+                self.journal.progress(op, "degrade", "Running")
+                degraded = pool.degrade(cluster, topo, slice_id, op,
+                                        self.journal)
+                op.vars["degraded"] = degraded
+                self.journal.save_vars(op)
+                self.journal.progress(op, "degrade", "OK")
+                pool.note(
+                    cluster, slice_id, "degraded", op,
+                    detail=f"mesh {degraded['full_mesh']} -> "
+                           f"{degraded['degraded_mesh']} "
+                           f"(shrunk {degraded['shrunk_axis']})")
+
+                # ---- replace: terraform recreates the slice machines ----
+                self._provision(cluster, plan, op=op)
+                pool.note(cluster, slice_id, "replaced", op,
+                          detail="machine fleet reconciled via terraform")
+
+                # ---- restore: full phase re-run re-gates the topology ---
+                self.journal.set_phase(cluster, ClusterPhaseStatus.DEPLOYING,
+                                       op=op)
+                ctx = self._context(cluster, plan)
+                self.journal.attach(op, ctx)
+                self.adm.run(ctx, create_phases())
+                self._finish_ready(cluster, op=op)
+                pool.note(cluster, slice_id, "restored", op,
+                          detail=f"full mesh {degraded['full_mesh']} "
+                                 f"restored, smoke re-gated")
+                self.journal.close(op, ok=True)
+                self.events.emit(
+                    cluster.id, "Normal", "SliceReplaced",
+                    f"slice {slice_id} of {name} replaced; full "
+                    f"{topo.total_chips}-chip mesh restored",
+                )
+            except PhaseError as e:
+                cluster.status.message = e.message
+                self.journal.set_phase(cluster, ClusterPhaseStatus.FAILED,
+                                       op=op)
+                self.journal.close(op, ok=False, message=e.message)
+                self.events.emit(cluster.id, "Warning", "SliceReplaceFailed",
+                                 f"phase {e.phase}: {e.message}")
+                if wait:
+                    raise
+            except Exception as e:
+                cluster.status.message = str(e)
+                self.journal.set_phase(cluster, ClusterPhaseStatus.FAILED,
+                                       op=op)
+                self.journal.close(op, ok=False, message=str(e))
+                self.events.emit(cluster.id, "Warning", "SliceReplaceFailed",
+                                 str(e))
                 if wait:
                     raise
 

@@ -106,10 +106,18 @@ class Services:
                 config.get("observability.retain_operations", 200)),
             leases=self.leases,
         )
+        # ONE slice pool (slicepool.* config block): the per-slice incident
+        # ledger + degraded-mesh planner behind replace_slice and the
+        # watchdog's preemption routing (docs/resilience.md "Slice
+        # preemption")
+        from kubeoperator_tpu.resilience import SlicePool
+
+        self.slicepool = SlicePool(repos, config)
         self.clusters = ClusterService(
             repos, executor, provisioner, self.events, config,
             retry_policy=retry_policy, retry_rng=retry_rng,
             journal=self.journal, scheduler=scheduler,
+            slicepool=self.slicepool,
         )
         self.nodes = NodeService(repos, executor, provisioner, self.events,
                                  retry_policy=retry_policy,
@@ -142,7 +150,8 @@ class Services:
         from kubeoperator_tpu.service.watchdog import WatchdogService
 
         self.watchdog = WatchdogService(repos, self.health, self.events,
-                                        config, clusters=self.clusters)
+                                        config, clusters=self.clusters,
+                                        slicepool=self.slicepool)
         # fleet orchestration rides on everything above: journaled child
         # ops through UpgradeService, gates through health+watchdog, all
         # stitched under one fleet op/trace (docs/resilience.md)

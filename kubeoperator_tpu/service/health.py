@@ -28,6 +28,13 @@ class ProbeResult:
     ok: bool
     detail: str = ""
     recovery: str = ""   # suggested action key
+    # per-slice attribution (tpu-chips probe on multislice plans):
+    # {"short": [slice ids below their expected chip count],
+    #  "per_slice": {slice id: allocatable chips},
+    #  "expected_per_slice": chips one healthy slice carries}
+    # None = the probe has no slice-level story (non-TPU probes, or
+    # label-less output where only the fleet total is known)
+    slices: dict | None = None
 
 
 @dataclass
@@ -57,28 +64,70 @@ RECOVERY_ACTIONS = {
     "tpu-chips": ("16-tpu-runtime.yml", "tpu-runtime"),
 }
 
-# allocatable TPU chips across the fleet, one integer per node line — the
-# preempted-slice detector's raw input (jsonpath keeps it kubectl-version
-# agnostic; missing resources render as empty lines)
+# allocatable TPU chips across the fleet, one "<slice-id>=<chips>" pair per
+# node line — the preempted-slice detector's raw input (jsonpath keeps it
+# kubectl-version agnostic; missing labels/resources render as empty
+# fields). The ko.tpu/slice-id label is what upgrades the probe from "the
+# fleet is short" to "SLICE 2 is short": the same label the JobSet
+# nodeSelector pins pods with, stamped by the tpu-runtime role. The "="
+# separator (not whitespace) is load-bearing: a labelled node whose
+# allocatable is MISSING (device plugin down) renders "9=", which must
+# never be readable as a bare 9-chip count — whitespace separators
+# collapse exactly that way once a transport strips line edges.
 TPU_CHIPS_CMD = (
     "kubectl --kubeconfig /etc/kubernetes/admin.conf get nodes "
-    "-o jsonpath='{range .items[*]}{.status.allocatable.google\\.com/tpu}"
+    "-o jsonpath='{range .items[*]}{.metadata.labels.ko\\.tpu/slice-id}"
+    "{\"=\"}{.status.allocatable.google\\.com/tpu}"
     "{\"\\n\"}{end}'"
 )
 
 
 def parse_chip_count(lines: list[str]) -> int | None:
-    """Sum the standalone integers in adhoc probe output (one per node).
+    """Fleet-total fallback: sum every chip count in the probe output.
     None = no per-node numbers surfaced at all — simulation backends and
     chip-less output are 'unknown', which must never read as 0 chips and
     trigger a phantom slice remediation."""
-    total, seen = 0, False
+    per_slice, unattributed, seen = parse_slice_chips(lines)
+    if not seen:
+        return None
+    return sum(per_slice.values()) + unattributed
+
+
+def parse_slice_chips(lines: list[str]) -> tuple[dict, int, bool]:
+    """Per-slice chip attribution from the adhoc probe output: returns
+    ``(per_slice, unattributed, seen)`` where `per_slice` maps slice id →
+    allocatable chips summed over that slice's nodes, `unattributed`
+    totals chip counts on nodes carrying no slice label (pre-label
+    fleets, manual nodes), and `seen` is False when no number surfaced
+    anywhere (unknown ≠ zero — the phantom-remediation guard).
+
+    Line shapes tolerated, because adhoc output interleaves executor
+    banners with the jsonpath payload:
+
+      * ``"1=4"`` — slice 1, 4 chips (the labelled contract)
+      * ``"9="``  — slice 9's node standing but NO allocatable (device
+                    plugin down): counted as slice 9 at 0 chips — real
+                    evidence of a dead slice, never a phantom 9-chip
+                    count (the reason the separator is "=", not space)
+      * ``"=4"``  — 4 chips, no label (unlabelled node)
+      * ``"4"``   — legacy bare count (pre-"=" output), unattributed
+      * ``"="`` / banner text — ignored (masters: no label, no TPU)
+    """
+    per_slice: dict[int, int] = {}
+    unattributed, seen = 0, False
     for line in lines:
-        m = re.fullmatch(r"(\d+)", line.strip())
+        text = line.strip()
+        m = re.fullmatch(r"(\d+)=(\d*)", text)
         if m:
-            total += int(m.group(1))
+            sid = int(m.group(1))
+            per_slice[sid] = per_slice.get(sid, 0) + int(m.group(2) or 0)
             seen = True
-    return total if seen else None
+            continue
+        m = re.fullmatch(r"=?(\d+)", text)
+        if m:
+            unattributed += int(m.group(1))
+            seen = True
+    return per_slice, unattributed, seen
 
 
 class HealthService:
@@ -154,7 +203,8 @@ class HealthService:
         plan = self.repos.plans.get(cluster.plan_id)
         if not plan.has_tpu():
             return None
-        expected = plan.topology().total_chips
+        topo = plan.topology()
+        expected = topo.total_chips
         task_id = self.executor.run_adhoc("command", TPU_CHIPS_CMD, inv,
                                           pattern="kube-master")
         result = self.executor.wait(task_id, timeout_s=120)
@@ -162,21 +212,54 @@ class HealthService:
             return ProbeResult(name="tpu-chips", ok=False,
                                detail=result.message,
                                recovery="tpu-chips")
-        chips = parse_chip_count(list(self.executor.watch(task_id)))
-        if chips is None:
+        per_slice, unattributed, seen = parse_slice_chips(
+            list(self.executor.watch(task_id)))
+        if not seen:
             return ProbeResult(
                 name="tpu-chips", ok=True,
                 detail="allocatable chip count unavailable (simulated?)",
             )
-        if chips < expected:
+        chips = sum(per_slice.values()) + unattributed
+        # per-slice attribution: each slice owes hosts_per_slice ×
+        # chips/host (== topo.chips). Only meaningful when EVERY chip-
+        # bearing node carried a slice label: on a partially-labelled
+        # fleet the unattributed chips could belong to any slice, so a
+        # "missing" slice may just be an unlabelled healthy one — and
+        # replacement draining a healthy slice is worse than the
+        # whole-fleet recovery the total-only verdict falls back to.
+        slices = None
+        if per_slice and not unattributed:
+            short = sorted(
+                sid for sid in range(topo.num_slices)
+                if per_slice.get(sid, 0) < topo.chips)
+            slices = {
+                "short": short,
+                "per_slice": {str(k): v
+                              for k, v in sorted(per_slice.items())},
+                "expected_per_slice": topo.chips,
+            }
+        # verdict: the fleet total OR any attributed short slice fails the
+        # probe. The slice term matters when totals BALANCE anyway — a
+        # stale duplicate node double-counting one slice must not let a
+        # genuinely dead slice read as a healthy fleet.
+        if chips < expected or (slices and slices["short"]):
+            which = ""
+            if slices and slices["short"]:
+                got = ", ".join(
+                    f"slice {sid}: "
+                    f"{per_slice.get(sid, 0)}/{topo.chips}"
+                    for sid in slices["short"])
+                which = f" ({got})"
             return ProbeResult(
                 name="tpu-chips", ok=False,
                 detail=f"{chips}/{expected} chips allocatable — slice "
-                       f"preempted or device plugin degraded",
+                       f"preempted or device plugin degraded{which}",
                 recovery="tpu-chips",
+                slices=slices,
             )
         return ProbeResult(name="tpu-chips", ok=True,
-                           detail=f"{chips}/{expected} chips allocatable")
+                           detail=f"{chips}/{expected} chips allocatable",
+                           slices=slices)
 
     def _check_via_kubeconfig(self, cluster) -> HealthReport:
         """Local kubectl probes against the imported cluster's apiserver.

@@ -53,7 +53,8 @@ log = get_logger("service.reconcile")
 
 # interrupted op kinds that re-enter safely through the existing resume
 # paths: retry() for anything create-shaped, delete() for terminations
-AUTO_RESUME_RETRY = frozenset({"create", "slice-scale", "reprovision"})
+AUTO_RESUME_RETRY = frozenset({"create", "slice-scale", "reprovision",
+                               "slice-replace"})
 AUTO_RESUME_DELETE = frozenset({"terminate"})
 # fleet rollouts resume through FleetService.resume: the op's own `vars`
 # carry the remaining waves, so no original arguments are needed
@@ -65,11 +66,11 @@ def resume_point(cluster) -> str:
     uses. The watchdog's `health` degradation marker is observability,
     not a phase: a Failed 'health' row must never masquerade as where an
     interrupted operation stopped."""
-    from kubeoperator_tpu.service.watchdog import HEALTH_CONDITION
+    from kubeoperator_tpu.service.watchdog import is_health_condition
 
     for cond in sorted(cluster.status.conditions,
                        key=lambda c: c.order_index):
-        if cond.name == HEALTH_CONDITION:
+        if is_health_condition(cond.name):
             continue
         if cond.status != ConditionStatus.OK.value:
             return cond.name

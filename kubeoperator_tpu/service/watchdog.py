@@ -40,16 +40,58 @@ log = get_logger("service.watchdog")
 # status; dropped again once the cluster probes healthy, and excluded from
 # resume-point math (it is observability, not a phase)
 HEALTH_CONDITION = "health"
+# per-slice degradation markers ("health/slice-2"): the tpu-chips probe's
+# slice attribution persisted as one condition PER short slice, so the
+# status JSON names which slice is preempted instead of one boolean.
+# Same observability-not-a-phase exclusion as the aggregate marker.
+SLICE_CONDITION_PREFIX = HEALTH_CONDITION + "/slice-"
+
+
+def is_health_condition(name: str) -> bool:
+    """True for every watchdog-owned condition (aggregate + per-slice) —
+    the ONE predicate resume-point math and condition sweeps share."""
+    return name == HEALTH_CONDITION or name.startswith(SLICE_CONDITION_PREFIX)
+
+
+def classify_remediation_error(e: BaseException) -> str:
+    """FailureKind for a remediation that RAISED: the same transient/
+    permanent vocabulary the phase engine uses (executor/base.py), applied
+    at the watchdog boundary so a TRANSIENT terraform timeout retries on
+    the next tick under the existing policy instead of burning the
+    circuit budget the way a genuinely broken cluster does. An exception
+    already carrying a `classification` (PhaseError from a classified
+    task failure) is trusted verbatim; otherwise the message is matched
+    against the transient shapes the retry layer recognizes — terraform
+    timeouts/state-lock contention, unreachable hosts, killed runners.
+    Anything unrecognized stays PERMANENT: a quota or credential failure
+    must burn budget, not retry forever as 'weather'."""
+    from kubeoperator_tpu.executor.base import FailureKind
+
+    kind = getattr(e, "classification", "")
+    if kind in (FailureKind.TRANSIENT.value, FailureKind.PERMANENT.value):
+        return kind
+    text = str(e).lower()
+    transient_markers = ("timed out", "timeout", "unreachable", "killed",
+                        "connection refused", "temporarily", "state lock")
+    if any(marker in text for marker in transient_markers):
+        return FailureKind.TRANSIENT.value
+    return FailureKind.PERMANENT.value
 
 
 class WatchdogService:
     def __init__(self, repos, health, events, config, clusters=None,
-                 now=time.time) -> None:
+                 slicepool=None, now=time.time) -> None:
         self.repos = repos
         self.health = health
         self.events = events
         self.clusters = clusters
+        self.slicepool = slicepool
         self.cfg = WatchdogConfig.from_config(config)
+        # consecutive TRANSIENT remediation failures tolerated before they
+        # start counting against the circuit budget (satellite: a flaky
+        # terraform timeout is weather, a STREAK of them is a failure)
+        self.transient_streak_limit = int(
+            config.get("watchdog.transient_streak", 3))
         self.now = now
 
     # ---- breaker state persistence ----
@@ -123,12 +165,30 @@ class WatchdogService:
         if target is None:
             self._save(row)
             return actions
-        ok = self._remediate(cluster, target)
-        breaker.record(now, ok)
+        ok, kind = self._remediate(cluster, target)
+        from kubeoperator_tpu.executor.base import FailureKind
+
+        if not ok and kind == FailureKind.TRANSIENT.value:
+            # transient infrastructure weather (terraform timeout, an
+            # unreachable blip the phase retries already fought): retry
+            # next tick WITHOUT burning the circuit budget — but a streak
+            # of "transient" failures is a real failure wearing weather's
+            # clothes, so past the streak limit they start counting
+            row.vars["transient_streak"] = \
+                int(row.vars.get("transient_streak", 0)) + 1
+            if row.vars["transient_streak"] >= self.transient_streak_limit:
+                breaker.record(now, False)
+                row.vars["transient_streak"] = 0
+                verdict = "failed"
+            else:
+                verdict = "transient"
+        else:
+            row.vars["transient_streak"] = 0
+            breaker.record(now, ok)
+            verdict = "ok" if ok else "failed"
         self._save(row)
         actions.append(
-            f"watchdog-remediate:{cluster.name}:{target.name}:"
-            f"{'ok' if ok else 'failed'}")
+            f"watchdog-remediate:{cluster.name}:{target.name}:{verdict}")
         return actions
 
     def note_check_error(self, cluster, error: str) -> None:
@@ -148,20 +208,55 @@ class WatchdogService:
         self._mark_condition(cluster, [_Probe()])
 
     # ---- remediation ----
-    def _remediate(self, cluster, probe) -> bool:
+    def _remediate(self, cluster, probe) -> tuple[bool, str]:
+        """Run one probe's remediation; returns (ok, FailureKind-on-fail).
+        tpu-chips routing: a multislice plan with per-slice attribution
+        goes through the slice pool's replace-slice flow (drain → degrade
+        → reprovision → restore, docs/resilience.md "Slice preemption");
+        everything else keeps the whole-fleet reprovision + phase re-run."""
         log.info("watchdog: remediating %s on %s", probe.name, cluster.name)
         try:
             if probe.name == "tpu-chips" and self.clusters is not None:
+                short = (getattr(probe, "slices", None) or {}).get("short")
+                if short and self.slicepool is not None \
+                        and self.slicepool.enabled \
+                        and self._is_multislice(cluster):
+                    # slice-attributed preemption: re-schedule work off
+                    # the lost slice instead of only rebuilding under it.
+                    # One slice per tick, same serial-remediation posture
+                    # as the probe loop; detection is ledgered before the
+                    # journaled replace op so the incident survives even
+                    # a replace that dies immediately.
+                    sid = int(short[0])
+                    self.slicepool.note(
+                        cluster, sid, "detected",
+                        detail=probe.detail[:300])
+                    self.clusters.replace_slice(cluster.name, sid,
+                                                wait=True)
+                    return True, ""
                 # preempted slice: machines first, device plugin second
                 self.clusters.reprovision(cluster.name)
             self.health.recover(cluster.name, probe.name)
-            return True
+            return True, ""
         except Exception as e:
+            kind = classify_remediation_error(e)
             self.events.emit(
                 cluster.id, "Warning", "WatchdogRemediationFailed",
                 f"automatic recovery of probe {probe.name} on "
-                f"{cluster.name} failed: {e}",
+                f"{cluster.name} failed ({kind.lower()}): {e}",
             )
+            return False, kind
+
+    def _is_multislice(self, cluster) -> bool:
+        """True when the cluster's plan declares num_slices > 1 — the
+        precondition for slice-granular remediation (a single-slice plan
+        has nothing to drain onto)."""
+        if not cluster.plan_id:
+            return False
+        try:
+            plan = self.repos.plans.get(cluster.plan_id)
+            return plan.has_tpu() and plan.topology().is_multislice
+        except Exception:
             return False
 
     # ---- status condition bookkeeping ----
@@ -174,11 +269,45 @@ class WatchdogService:
             HEALTH_CONDITION, ConditionStatus.FAILED,
             f"failed probes: {detail}"[:500],
         )
+        # per-slice markers from the tpu-chips attribution: one FAILED
+        # condition per short slice, and stale markers for slices that
+        # came back dropped in the same save — the status JSON always
+        # says exactly which slices are degraded RIGHT NOW. The stale
+        # sweep runs ONLY when this tick actually produced slice-level
+        # evidence: a failing probe that lost attribution (a fresh
+        # unlabelled node downgraded it to the total-only verdict) says
+        # nothing about slices, and dropping a standing marker on no
+        # evidence would print a still-preempted slice as [ok].
+        short_now: set[str] = set()
+        have_attribution = False
+        for p in failed_probes:
+            slices = getattr(p, "slices", None)
+            if slices is None:
+                continue
+            have_attribution = True
+            per_slice = slices.get("per_slice") or {}
+            expected = slices.get("expected_per_slice")
+            for sid in slices.get("short") or ():
+                name = f"{SLICE_CONDITION_PREFIX}{sid}"
+                short_now.add(name)
+                cluster.status.upsert_condition(
+                    name, ConditionStatus.FAILED,
+                    f"{per_slice.get(str(sid), 0)}/{expected} chips "
+                    f"allocatable — slice preempted",
+                )
+        if have_attribution:
+            stale = [c.name for c in cluster.status.conditions
+                     if c.name.startswith(SLICE_CONDITION_PREFIX)
+                     and c.name not in short_now]
+            if stale:
+                cluster.status.reset_conditions(stale)
         self.repos.clusters.save(cluster)
 
     def _clear_condition(self, cluster) -> None:
-        if cluster.status.condition(HEALTH_CONDITION) is not None:
-            cluster.status.reset_conditions([HEALTH_CONDITION])
+        owned = [c.name for c in cluster.status.conditions
+                 if is_health_condition(c.name)]
+        if owned:
+            cluster.status.reset_conditions(owned)
             self.repos.clusters.save(cluster)
 
     def circuit_state(self, cluster_id: str) -> str:
@@ -198,6 +327,12 @@ class WatchdogService:
                 continue
             _row, breaker = self._load(cluster.id)
             cond = cluster.status.condition(HEALTH_CONDITION)
+            degraded_slices = sorted(
+                int(c.name[len(SLICE_CONDITION_PREFIX):])
+                for c in cluster.status.conditions
+                if c.name.startswith(SLICE_CONDITION_PREFIX)
+                and c.status == ConditionStatus.FAILED.value
+                and c.name[len(SLICE_CONDITION_PREFIX):].isdigit())
             out.append({
                 "cluster": cluster.name,
                 "phase": cluster.status.phase,
@@ -206,6 +341,7 @@ class WatchdogService:
                 "degraded": bool(
                     cond is not None
                     and cond.status == ConditionStatus.FAILED.value),
+                "degraded_slices": degraded_slices,
                 "budget": self.cfg.remediation_budget,
                 "budget_left": breaker.budget_left(now),
                 "cooldown_remaining_s": round(

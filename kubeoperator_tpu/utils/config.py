@@ -137,6 +137,28 @@ DEFAULTS: dict[str, Any] = {
         "window_s": 3600,
         "cooldown_s": 300,         # min gap between remediations
         "flap_threshold": 3,       # degrade-after-successful-fix count
+        # consecutive TRANSIENT remediation failures (terraform timeout,
+        # unreachable blip) tolerated before they count against the
+        # circuit budget — weather retries free, a streak of it doesn't
+        "transient_streak": 3,
+    },
+    "slicepool": {
+        # preemption-aware slice replacement (resilience/slicepool.py,
+        # docs/resilience.md "Slice preemption"): the watchdog routes a
+        # slice-attributed tpu-chips failure on a multislice plan through
+        # drain -> degrade -> reprovision -> restore instead of a blind
+        # whole-cluster reprovision; off = the pre-pool compound
+        # remediation (reprovision + tpu-runtime re-run)
+        "enabled": True,
+        # run the in-process degraded-mesh re-shard proof during the
+        # degrade leg (needs the degraded mesh's device count visible
+        # locally; larger meshes record an honest "deferred")
+        "reshard": True,
+        # train steps for the re-shard proof (>= 2 for the loss pair)
+        "reshard_steps": 4,
+        # seed for the re-shard run — pinned so the drill can compare
+        # losses against a from-scratch degraded run bit-for-bit
+        "reshard_seed": 0,
     },
     "fleet": {
         # fleet rollout policy (service/fleet.py, docs/resilience.md

@@ -398,6 +398,36 @@ def write_artifacts(results: dict, round_no: int,
                 f"| {n} | {row['ops']} | {row['concurrency']} | "
                 f"{row['ops_per_s']:.1f} | {row['p50_s']:.3f} | "
                 f"{row['p99_s']:.3f} |")
+    # multislice DCN smoke rows (`perf_matrix.py --multislice`,
+    # docs/resilience.md "Slice preemption"): rendered from the newest
+    # multislice round — the matrix's first rows beyond 8-device
+    # single-slice meshes
+    multislice_rounds = history.get("multislice") or {}
+    if multislice_rounds:
+        ms_round = str(max(int(k) for k in multislice_rounds))
+        lines += [
+            "",
+            f"## multislice (round {ms_round})",
+            "",
+            "2-slice DCN psum smoke (`python perf_matrix.py "
+            "--multislice`, ops/dcn_smoke.py): one pure-CPU OS process",
+            "per TPU host wired through the JobSet's host_envs contract "
+            "(gloo collectives), TWO processes per slice — one seeded",
+            "run proves a dcn-axis psum across the slice boundary AND an "
+            "ici-axis psum across the processes inside one slice.",
+            "",
+            "| topology | slices | procs (per slice) | devices | "
+            "dcn psum | ici psum | ok | wall (s) |",
+            "|---|---|---|---|---|---|---|---|",
+        ]
+        for row in multislice_rounds[ms_round].get("rows", []):
+            lines.append(
+                f"| {row['tpu_type']} | {row['num_slices']} | "
+                f"{row['processes']} ({row['procs_per_slice']}) | "
+                f"{row['global_devices']} | "
+                f"{row['dcn_psum']}/{row['expected_dcn_psum']} | "
+                f"{row['ici_psum']}/{row['expected_ici_psum']} | "
+                f"{'yes' if row['ok'] else 'NO'} | {row['wall_s']} |")
     # sharded-training workload sweep rows (`perf_matrix.py --workloads`,
     # docs/workloads.md): rendered from the newest workloads round so the
     # three harnesses never clobber each other's sections
@@ -486,13 +516,19 @@ def run_workloads() -> dict:
     return {"ok": report["ok"], "devices": report["devices"], "rows": rows}
 
 
-def record_workloads(report: dict, round_no: int | None = None) -> int:
-    """`perf_matrix.py --workloads` hook (same shape as record_loadtest):
-    save the sweep under its round in PERF.json, then re-render PERF.md
-    around the newest committed matrix round."""
+def _record_section(key: str, payload, round_no: int | None = None) -> int:
+    """The ONE save-history-and-re-render hook behind every auxiliary
+    harness (`--workloads`, `--multislice`, `koctl loadtest
+    --record-perf`): save the payload under its round in PERF.json, then
+    re-render PERF.md around the newest committed matrix round — the
+    baseline table regenerates verbatim from history, so the harnesses
+    never clobber each other's sections. With no matrix history yet
+    (fresh checkout) the render is skipped rather than persisting a
+    phantom empty round as the future baseline; PERF.json already
+    carries the section rows."""
     round_no = resolve_round(round_no)
     history = _load_history()
-    history.setdefault("workloads", {})[str(round_no)] = report
+    history.setdefault(key, {})[str(round_no)] = payload
     with open(os.path.join(REPO_ROOT, "PERF.json"), "w",
               encoding="utf-8") as f:
         json.dump(history, f, indent=2)
@@ -502,30 +538,46 @@ def record_workloads(report: dict, round_no: int | None = None) -> int:
         write_artifacts(matrix_rounds[str(newest)], newest,
                         (history.get("traces") or {}).get(str(newest)))
     return round_no
+
+
+def record_workloads(report: dict, round_no: int | None = None) -> int:
+    """`perf_matrix.py --workloads` hook."""
+    return _record_section("workloads", report, round_no)
+
+
+def run_multislice() -> dict:
+    """The CI face of the multislice smoke gate (ISSUE 10 satellite 1):
+    the 2 × v5p-16 two-processes-per-slice DCN psum over pure-CPU
+    workers — the same runner the tier-1 gate in tests/test_distributed
+    drives, committed here as a PERF row so the multislice bootstrap has
+    a round-over-round trace like everything else."""
+    from kubeoperator_tpu.ops.dcn_smoke import run_dcn_smoke
+
+    report = run_dcn_smoke(tpu_type="v5p-16", num_slices=2,
+                           local_devices=2)
+    row = {k: report[k] for k in (
+        "tpu_type", "num_slices", "processes", "procs_per_slice",
+        "global_devices", "expected_dcn_psum", "expected_ici_psum",
+        "ok", "wall_s")}
+    # psum sets render as their single expected value when clean
+    row["dcn_psum"] = (report["dcn_psum"][0]
+                       if len(report["dcn_psum"]) == 1 else
+                       str(report["dcn_psum"]))
+    row["ici_psum"] = (report["ici_psum"][0]
+                       if len(report["ici_psum"]) == 1 else
+                       str(report["ici_psum"]))
+    return {"ok": report["ok"], "rows": [row]}
+
+
+def record_multislice(report: dict, round_no: int | None = None) -> int:
+    """`perf_matrix.py --multislice` hook."""
+    return _record_section("multislice", report, round_no)
 
 
 def record_loadtest(rows: dict, round_no: int | None = None) -> int:
-    """`koctl loadtest --record-perf` hook: save the loadtest rows (keyed
-    by replica count) under their round in PERF.json, then re-render
-    PERF.md around the newest committed matrix round — the baseline table
-    regenerates verbatim from history, so the two harnesses never clobber
-    each other's sections."""
-    round_no = resolve_round(round_no)
-    history = _load_history()
-    history.setdefault("loadtest", {})[str(round_no)] = rows
-    with open(os.path.join(REPO_ROOT, "PERF.json"), "w",
-              encoding="utf-8") as f:
-        json.dump(history, f, indent=2)
-    matrix_rounds = history.get("rounds") or {}
-    if matrix_rounds:
-        # re-render PERF.md around the newest committed matrix round; with
-        # no matrix history yet (fresh checkout) skip the render rather
-        # than persist a phantom empty round as the future baseline —
-        # PERF.json above already carries the loadtest rows
-        newest = max(int(k) for k in matrix_rounds)
-        write_artifacts(matrix_rounds[str(newest)], newest,
-                        (history.get("traces") or {}).get(str(newest)))
-    return round_no
+    """`koctl loadtest --record-perf` hook (rows keyed by replica
+    count)."""
+    return _record_section("loadtest", rows, round_no)
 
 
 def main(argv: list | None = None) -> int:
@@ -539,7 +591,17 @@ def main(argv: list | None = None) -> int:
                         help="run ONLY the sharded-training workload "
                              "sweep (8 virtual CPU devices) and record "
                              "its rows under the round")
+    parser.add_argument("--multislice", action="store_true",
+                        help="run ONLY the 2-slice DCN psum smoke "
+                             "(4 CPU worker processes, 2 per slice) and "
+                             "record its row under the round")
     args = parser.parse_args(argv)
+    if args.multislice:
+        report = run_multislice()
+        round_no = record_multislice(report, args.round)
+        print(json.dumps({"round": round_no, "multislice": report},
+                         indent=2))
+        return 0 if report["ok"] else 1
     if args.workloads:
         report = run_workloads()
         round_no = record_workloads(report, args.round)

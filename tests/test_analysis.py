@@ -222,6 +222,99 @@ class TestPhaseDags:  # KO-X011
         assert check_phase_dags(ctx) == []
 
 
+MULTISLICE_TREE = {
+    "content/roles/tpu-smoke-test/tasks/main.yml": """\
+        - name: render smoke job manifest
+          ansible.builtin.template:
+            src: "{{ 'smoke-jobset.yaml.j2' if (tpu_num_slices | default(1) | int > 1) else 'smoke-job.yaml.j2' }}"
+            dest: /etc/kubernetes/addons/tpu-smoke.yaml
+        """,
+    "content/roles/tpu-smoke-test/templates/smoke-job.yaml.j2":
+        "kind: Job\n",
+    "content/roles/tpu-smoke-test/templates/smoke-jobset.yaml.j2": """\
+        apiVersion: jobset.x-k8s.io/v1alpha2
+        kind: JobSet
+        spec:
+          env:
+            - name: MEGASCALE_COORDINATOR_ADDRESS
+              value: "coord:8477"
+            - name: MEGASCALE_NUM_SLICES
+              value: "{{ tpu_num_slices }}"
+        """,
+}
+
+
+class TestMultisliceLaunch:  # KO-X012
+    def _check(self, tmp_path, files, plans=None, plan_files=()):
+        from kubeoperator_tpu.analysis.artifacts import (
+            check_multislice_launch,
+        )
+
+        ctx = AnalysisContext(root=make_tree(tmp_path, files),
+                              plan_files=tuple(plan_files))
+        return check_multislice_launch(ctx, plans=plans)
+
+    def _plan_file(self, tmp_path, num_slices=2):
+        plan = tmp_path / "ms-plan.yaml"
+        plan.write_text(json.dumps({"plans": [{
+            "name": "ms", "provider": "gcp_tpu_vm", "accelerator": "tpu",
+            "tpu_type": "v5e-16", "num_slices": num_slices,
+        }]}))
+        return str(plan)
+
+    def test_quiet_on_wired_tree(self, tmp_path):
+        assert self._check(tmp_path, MULTISLICE_TREE) == []
+
+    def test_quiet_on_wired_tree_with_multislice_plan(self, tmp_path):
+        findings = self._check(
+            tmp_path, MULTISLICE_TREE,
+            plan_files=[self._plan_file(tmp_path)])
+        assert findings == []
+
+    def test_fires_on_jobset_without_megascale_var(self, tmp_path):
+        files = dict(MULTISLICE_TREE)
+        files["content/roles/tpu-smoke-test/templates/"
+              "smoke-jobset.yaml.j2"] = (
+            "apiVersion: jobset.x-k8s.io/v1alpha2\nkind: JobSet\n")
+        findings = self._check(tmp_path, files)
+        assert [f.rule for f in findings] == ["KO-X012"]
+        assert "MEGASCALE_COORDINATOR_ADDRESS" in findings[0].message
+
+    def test_fires_on_unreferenced_jobset_template(self, tmp_path):
+        files = dict(MULTISLICE_TREE)
+        files["content/roles/tpu-smoke-test/tasks/main.yml"] = """\
+            - name: render only the single-host job
+              ansible.builtin.template:
+                src: smoke-job.yaml.j2
+                dest: /etc/kubernetes/addons/tpu-smoke.yaml
+            """
+        findings = self._check(tmp_path, files)
+        assert findings and "dead code" in findings[0].message
+
+    def test_multislice_plan_over_tree_without_jobset_fires(self, tmp_path):
+        plan_file = self._plan_file(tmp_path)
+        findings = self._check(tmp_path, GOOD_ROLE,
+                               plan_files=[plan_file])
+        assert [f.rule for f in findings] == ["KO-X012"]
+        assert "num_slices=2" in findings[0].message
+        assert findings[0].file == plan_file
+
+    def test_single_slice_plan_stays_quiet(self, tmp_path):
+        findings = self._check(
+            tmp_path, GOOD_ROLE,
+            plan_files=[self._plan_file(tmp_path, num_slices=1)])
+        assert findings == []
+
+    def test_real_tree_quiet(self):
+        from kubeoperator_tpu.analysis import default_root
+        from kubeoperator_tpu.analysis.artifacts import (
+            check_multislice_launch,
+        )
+
+        ctx = AnalysisContext(root=default_root())
+        assert check_multislice_launch(ctx) == []
+
+
 class TestPlanTopology:  # KO-X004
     def test_catalog_and_generations_clean(self, tmp_path):
         ctx = ctx_for(tmp_path, {})

@@ -292,6 +292,50 @@ def test_eight_process_multihost_multislice_bootstrap():
     assert sorted(results) == ["3.0 10.0"] * 8
 
 
+def test_two_procs_per_slice_dcn_smoke_gate():
+    """The multislice smoke gate (ISSUE 10 satellite 1): 2 × v5p-16 =
+    two slices × TWO processes each, so one run proves BOTH boundary
+    classes — a dcn-axis psum across slices and an ici_0 psum across the
+    OS processes inside one slice. Rides ops/dcn_smoke.py, the same
+    runner `perf_matrix.py --multislice` commits a PERF row from."""
+    from kubeoperator_tpu.ops.dcn_smoke import run_dcn_smoke
+
+    report = run_dcn_smoke(tpu_type="v5p-16", num_slices=2,
+                           local_devices=2)
+    assert report["ok"], report["errors"] or report
+    assert report["processes"] == 4 and report["procs_per_slice"] == 2
+    assert report["dcn_psum"] == [3.0]        # 1.0 + 2.0 across DCN
+    assert report["ici_psum"] == [10.0]       # 1+2+3+4 across the slice
+
+
+def test_host_envs_hardening_rejects_malformed_contracts():
+    """Satellite 2: a malformed topology/coordinator must die loudly at
+    env-emission time, not as an empty env list the JobSet templates in
+    silently (workers then hang in jax.distributed.initialize)."""
+    from kubeoperator_tpu.parallel.topology import SliceTopology, GENERATIONS
+    from kubeoperator_tpu.utils.errors import TopologyError
+
+    topo = parse_accelerator_type("v5e-16", num_slices=2)
+    with pytest.raises(TopologyError, match="coordinator_host"):
+        host_envs(topo, "")
+    with pytest.raises(TopologyError, match="coordinator_host"):
+        host_envs(topo, "   ")
+    with pytest.raises(TopologyError, match="1..65535"):
+        host_envs(topo, "10.0.0.2", port=0)
+    with pytest.raises(TopologyError, match="megascale"):
+        host_envs(topo, "10.0.0.2", port=65535)   # port+1 overflows
+    # single-slice may sit AT 65535 (no megascale port needed)
+    single = host_envs(parse_accelerator_type("v5e-16"), "10.0.0.2",
+                       port=65535)
+    assert single[0].to_env()["KO_TPU_COORDINATOR_ADDRESS"].endswith(":65535")
+    # an unvalidated direct construction that resolves to 0 hosts
+    # (v5p 2-chip shape: not single-host, not a multiple of 4/host)
+    broken = SliceTopology(generation=GENERATIONS["v5p"], chips=2,
+                           ici_mesh=(1, 1, 2))
+    with pytest.raises(TopologyError, match="0 hosts"):
+        host_envs(broken, "10.0.0.2")
+
+
 def test_multislice_host_env_contract():
     """The env blocks the JobSet templates in, for a multi-host multislice
     (2 x v5e-16 = 8 host processes): global ranks are contiguous, slice_id

@@ -1,0 +1,626 @@
+"""Preemption-aware multislice (ISSUE 10): degraded-mesh planner math,
+survivor env re-emission, per-slice tpu-chips attribution (mixed
+single-host/multi-host generations incl. the off-by-one at exactly one
+missing host), the chaos preemption knob, the journaled replace-slice
+flow, watchdog routing + transient classification, and the end-to-end
+`chaos-soak --preemption` drill."""
+
+import argparse
+import random
+
+import pytest
+
+from kubeoperator_tpu.models import ClusterSpec, Plan, Region, Zone
+from kubeoperator_tpu.parallel.mesh import MeshSpec
+from kubeoperator_tpu.parallel.multislice import (
+    degraded_mesh_spec,
+    survivor_host_envs,
+)
+from kubeoperator_tpu.parallel.topology import parse_accelerator_type
+from kubeoperator_tpu.resilience import ChaosConfig, ChaosExecutor
+from kubeoperator_tpu.resilience.slicepool import mesh_spec_for_slices
+from kubeoperator_tpu.service import build_services
+from kubeoperator_tpu.utils.config import load_config
+from kubeoperator_tpu.utils.errors import TopologyError, ValidationError
+
+
+# ------------------------------------------------- degraded-mesh planner ---
+class TestDegradedMeshPlanner:
+    def test_data_axis_shrinks_first(self):
+        spec = MeshSpec(axes=(("data", 4), ("fsdp", 2), ("tp", 1)))
+        degraded, axis = degraded_mesh_spec(spec, num_slices=4)
+        assert axis == "data"
+        assert str(degraded) == "data=3,fsdp=2,tp=1"
+
+    def test_indivisible_data_falls_through_to_fsdp(self):
+        spec = MeshSpec(axes=(("data", 3), ("fsdp", 4), ("tp", 2)))
+        degraded, axis = degraded_mesh_spec(spec, num_slices=2)
+        assert axis == "fsdp"
+        assert str(degraded) == "data=3,fsdp=2,tp=2"
+
+    def test_tp_never_shrinks(self):
+        spec = MeshSpec(axes=(("data", 1), ("fsdp", 1), ("tp", 8)))
+        with pytest.raises(TopologyError, match="cannot re-shard"):
+            degraded_mesh_spec(spec, num_slices=2)
+
+    def test_multi_slice_loss(self):
+        spec = MeshSpec(axes=(("data", 8), ("fsdp", 4), ("tp", 1)))
+        degraded, axis = degraded_mesh_spec(spec, num_slices=4, lost=2)
+        assert axis == "data" and str(degraded) == "data=4,fsdp=4,tp=1"
+
+    def test_bounds(self):
+        spec = MeshSpec(axes=(("data", 2), ("fsdp", 1), ("tp", 1)))
+        with pytest.raises(TopologyError, match="num_slices >= 2"):
+            degraded_mesh_spec(spec, num_slices=1)
+        with pytest.raises(TopologyError, match="lost slices"):
+            degraded_mesh_spec(spec, num_slices=2, lost=2)
+        with pytest.raises(TopologyError, match="lost slices"):
+            degraded_mesh_spec(spec, num_slices=2, lost=0)
+
+    def test_canonical_layout_composes_with_planner(self):
+        topo = parse_accelerator_type("v5e-16", num_slices=4)
+        full = mesh_spec_for_slices(topo)
+        assert str(full) == "data=4,fsdp=16,tp=1"
+        assert full.total_devices == topo.jax_device_count == 64
+        degraded, axis = degraded_mesh_spec(full, topo.num_slices)
+        assert axis == "data" and degraded.total_devices == 48
+
+    def test_with_slices_helper(self):
+        topo = parse_accelerator_type("v5p-64", num_slices=3)
+        smaller = topo.with_slices(2)
+        assert smaller.num_slices == 2 and smaller.chips == topo.chips
+        with pytest.raises(TopologyError):
+            topo.with_slices(0)
+
+
+# --------------------------------------------------- survivor env contract --
+class TestSurvivorEnvs:
+    def test_two_slices_lose_one_drops_megascale(self):
+        topo = parse_accelerator_type("v5e-16", num_slices=2)
+        envs = survivor_host_envs(topo, "10.0.0.2", lost_slices=(0,))
+        assert len(envs) == 4                      # one surviving slice
+        assert [e.process_id for e in envs] == [0, 1, 2, 3]
+        assert all(e.slice_id == 0 and e.num_slices == 1 for e in envs)
+        assert all("MEGASCALE_NUM_SLICES" not in e.to_env() for e in envs)
+
+    def test_three_slices_lose_middle_remaps_ordinally(self):
+        topo = parse_accelerator_type("v5p-16", num_slices=3)  # 2 hosts/sl
+        envs = survivor_host_envs(topo, "10.0.0.2", lost_slices=(1,))
+        assert len(envs) == 4
+        assert [e.slice_id for e in envs] == [0, 0, 1, 1]
+        blocks = [e.to_env() for e in envs]
+        assert all(b["MEGASCALE_NUM_SLICES"] == "2" for b in blocks)
+        assert all(b["KO_TPU_NUM_PROCESSES"] == "4" for b in blocks)
+
+    def test_bounds(self):
+        topo = parse_accelerator_type("v5e-4", num_slices=2)
+        with pytest.raises(TopologyError, match="outside"):
+            survivor_host_envs(topo, "10.0.0.2", lost_slices=(5,))
+        with pytest.raises(TopologyError, match="no surviving"):
+            survivor_host_envs(topo, "10.0.0.2", lost_slices=(0, 1))
+
+
+# ------------------------------------------------ per-slice probe math -----
+def probe_stack(tmp_path):
+    config = load_config(path="/nonexistent", env={}, overrides={
+        "db": {"path": str(tmp_path / "probe.db")},
+        "logging": {"level": "ERROR"},
+        "executor": {"backend": "fake"},
+        "provisioner": {"work_dir": str(tmp_path / "tf")},
+        "cron": {"backup_enabled": False, "event_sync_interval_s": 0,
+                 "health_check_interval_s": 300},
+        "cluster": {"kubeconfig_dir": str(tmp_path / "kc")},
+        "watchdog": {"cooldown_s": 0},
+    })
+    return build_services(config, simulate=True)
+
+
+def seed_plan(svc, name, tpu_type, num_slices=1):
+    from kubeoperator_tpu.utils.errors import NotFoundError
+
+    try:
+        region = svc.regions.get("pr")
+    except (NotFoundError, Exception):
+        regions = [r for r in svc.repos.regions.list() if r.name == "pr"]
+        if regions:
+            region = regions[0]
+        else:
+            region = svc.regions.create(Region(
+                name="pr", provider="gcp_tpu_vm",
+                vars={"project": "p", "name": "us-central1"}))
+    zones = [z for z in svc.repos.zones.list() if z.name == "pz"]
+    zone = zones[0] if zones else svc.zones.create(Zone(
+        name="pz", region_id=region.id, vars={"gcp_zone": "us-central1-a"}))
+    svc.plans.create(Plan(
+        name=name, provider="gcp_tpu_vm", region_id=region.id,
+        zone_ids=[zone.id], accelerator="tpu", tpu_type=tpu_type,
+        num_slices=num_slices, worker_count=0))
+
+
+def create_tpu_cluster(svc, name, plan_name, chips):
+    from kubeoperator_tpu.adm.phases import SMOKE_MARKER
+
+    svc.executor.script("17-tpu-smoke-test.yml", lines=[
+        f'{SMOKE_MARKER} {{"gbps": 84.0, "chips": {chips}}}'])
+    svc.clusters.create(name, provision_mode="plan", plan_name=plan_name,
+                        wait=True)
+    assert svc.clusters.get(name).status.phase == "Ready"
+
+
+class TestPerSliceProbeMath:
+    def test_parse_slice_chips_shapes(self):
+        from kubeoperator_tpu.service.health import parse_slice_chips
+
+        per, extra, seen = parse_slice_chips(
+            ["ADHOC [command] x", "0=4", "0=4", "1=4", "=", "8", ""])
+        assert per == {0: 8, 1: 4} and extra == 8 and seen
+        per, extra, seen = parse_slice_chips(["banner", "no digits"])
+        assert per == {} and extra == 0 and not seen
+        # a labelled node whose allocatable is MISSING (device plugin
+        # down) is slice evidence at 0 chips — NEVER a phantom
+        # "<slice-id>"-chip unattributed count
+        per, extra, seen = parse_slice_chips(["9=", "0=4"])
+        assert per == {9: 0, 0: 4} and extra == 0 and seen
+        # unlabelled node with chips keeps its "=4" shape distinct
+        per, extra, seen = parse_slice_chips(["=4"])
+        assert per == {} and extra == 4 and seen
+
+    def test_device_plugin_down_attributes_the_dead_slice(self, tmp_path):
+        """The review scenario: slice 1's node stands but its device
+        plugin died ('1='). The probe must fail, attribute slice 1, and
+        keep the fleet total honest (4/8, not 4+1 phantom chips)."""
+        svc = probe_stack(tmp_path)
+        try:
+            seed_plan(svc, "p-plugdown", "v5e-4", num_slices=2)
+            create_tpu_cluster(svc, "plug", "p-plugdown", 8)
+            svc.executor.script("adhoc:command", lines=["0=4", "1="])
+            probe = next(p for p in svc.health.check("plug").probes
+                         if p.name == "tpu-chips")
+            assert not probe.ok and "4/8" in probe.detail
+            assert probe.slices["short"] == [1]
+            assert probe.slices["per_slice"] == {"0": 4, "1": 0}
+        finally:
+            svc.close()
+
+    def test_single_slice_v5e16_full_and_one_missing_host(self, tmp_path):
+        """v5e-16: 4 multi-host workers x 4 chips. Exactly one missing
+        host is the off-by-one band: 12/16 must FAIL and attribute slice
+        0; exactly 16 must pass with no short slices."""
+        svc = probe_stack(tmp_path)
+        try:
+            seed_plan(svc, "p-v5e16", "v5e-16")
+            create_tpu_cluster(svc, "v5e", "p-v5e16", 16)
+            svc.executor.script("adhoc:command",
+                                lines=["0=4", "0=4", "0=4", "0=4"])
+            probe = next(p for p in svc.health.check("v5e").probes
+                         if p.name == "tpu-chips")
+            assert probe.ok and probe.slices["short"] == []
+            # one host's 4 chips gone
+            svc.executor.script("adhoc:command",
+                                lines=["0=4", "0=4", "0=4"])
+            probe = next(p for p in svc.health.check("v5e").probes
+                         if p.name == "tpu-chips")
+            assert not probe.ok and "12/16" in probe.detail
+            assert probe.slices["short"] == [0]
+            assert probe.slices["expected_per_slice"] == 16
+        finally:
+            svc.close()
+
+    def test_multislice_v5p64x2_attributes_the_short_slice(self, tmp_path):
+        """v5p-64 x2: 2 slices x 8 hosts x 4 chips. One missing host in
+        slice 1 (28/32) attributes slice 1 and ONLY slice 1."""
+        svc = probe_stack(tmp_path)
+        try:
+            seed_plan(svc, "p-v5p64x2", "v5p-64", num_slices=2)
+            create_tpu_cluster(svc, "v5p", "p-v5p64x2", 64)
+            lines = ["0=4"] * 8 + ["1=4"] * 7
+            svc.executor.script("adhoc:command", lines=lines)
+            probe = next(p for p in svc.health.check("v5p").probes
+                         if p.name == "tpu-chips")
+            assert not probe.ok
+            assert "60/64" in probe.detail and "slice 1: 28/32" in probe.detail
+            assert probe.slices["short"] == [1]
+            assert probe.slices["per_slice"] == {"0": 32, "1": 28}
+            # a vanished WHOLE slice: no lines at all for slice 0
+            svc.executor.script("adhoc:command", lines=["1=4"] * 8)
+            probe = next(p for p in svc.health.check("v5p").probes
+                         if p.name == "tpu-chips")
+            assert not probe.ok and probe.slices["short"] == [0]
+        finally:
+            svc.close()
+
+    def test_unlabelled_output_falls_back_to_total_only(self, tmp_path):
+        svc = probe_stack(tmp_path)
+        try:
+            seed_plan(svc, "p-v5e16b", "v5e-16")
+            create_tpu_cluster(svc, "v5eb", "p-v5e16b", 16)
+            svc.executor.script("adhoc:command", lines=["4", "4", "4"])
+            probe = next(p for p in svc.health.check("v5eb").probes
+                         if p.name == "tpu-chips")
+            assert not probe.ok and "12/16" in probe.detail
+            assert probe.slices is None      # no attribution claimed
+        finally:
+            svc.close()
+
+    def test_partially_labelled_fleet_claims_no_attribution(self, tmp_path):
+        """Mixed labelling must NOT attribute: the unattributed chips
+        could belong to the 'missing' slice, and replacement draining a
+        healthy-but-unlabelled slice is worse than the whole-fleet
+        recovery the total-only verdict falls back to."""
+        svc = probe_stack(tmp_path)
+        try:
+            seed_plan(svc, "p-v5e4x2m", "v5e-4", num_slices=2)
+            create_tpu_cluster(svc, "mixed", "p-v5e4x2m", 8)
+            # slice 1 labelled + healthy, 4 chips unlabelled (slice 0's
+            # node lost its label, not its chips): 8/8 total but slice 0
+            # looks absent from the labelled view
+            svc.executor.script("adhoc:command", lines=["1=4", "4"])
+            probe = next(p for p in svc.health.check("mixed").probes
+                         if p.name == "tpu-chips")
+            assert probe.ok and probe.slices is None
+            # genuinely short AND partially labelled: fail, but with the
+            # whole-fleet recovery (no slice attribution to act on)
+            svc.executor.script("adhoc:command", lines=["1=4", "2"])
+            probe = next(p for p in svc.health.check("mixed").probes
+                         if p.name == "tpu-chips")
+            assert not probe.ok and probe.slices is None
+        finally:
+            svc.close()
+
+    def test_balanced_total_with_dead_slice_still_fails(self, tmp_path):
+        """A stale duplicate node double-counting slice 0 can balance the
+        fleet total while slice 1 is dead — the attributed short slice
+        must fail the probe anyway."""
+        svc = probe_stack(tmp_path)
+        try:
+            seed_plan(svc, "p-dup", "v5e-4", num_slices=2)
+            create_tpu_cluster(svc, "dup", "p-dup", 8)
+            svc.executor.script("adhoc:command", lines=["0=4", "0=4", "1="])
+            probe = next(p for p in svc.health.check("dup").probes
+                         if p.name == "tpu-chips")
+            assert not probe.ok and probe.slices["short"] == [1]
+        finally:
+            svc.close()
+
+    def test_watchdog_persists_and_clears_per_slice_conditions(
+            self, tmp_path):
+        svc = probe_stack(tmp_path)
+        try:
+            seed_plan(svc, "p-v5e4x2", "v5e-4", num_slices=2)
+            create_tpu_cluster(svc, "ms", "p-v5e4x2", 8)
+            # slice 1 short; block remediation so the condition persists
+            svc.executor.script("adhoc:command", lines=["0=4", "1=2"])
+            report = svc.health.check("ms")
+            cluster = svc.clusters.get("ms")
+            svc.watchdog.cfg = svc.watchdog.cfg.__class__(enabled=False)
+            svc.watchdog.observe(cluster, report)
+            cluster = svc.clusters.get("ms")
+            cond = cluster.status.condition("health/slice-1")
+            assert cond is not None and cond.status == "Failed"
+            assert "2/4 chips" in cond.message
+            assert cluster.status.condition("health/slice-0") is None
+            row = next(r for r in svc.watchdog.status()
+                       if r["cluster"] == "ms")
+            assert row["degraded_slices"] == [1]
+            # a failing tick WITHOUT attribution (fresh unlabelled node
+            # downgraded the probe to total-only) must not sweep the
+            # standing marker — no slice-level evidence arrived
+            svc.executor.script("adhoc:command", lines=["4"])
+            svc.watchdog.observe(svc.clusters.get("ms"),
+                                 svc.health.check("ms"))
+            cluster = svc.clusters.get("ms")
+            assert cluster.status.condition("health/slice-1") is not None
+            # healthy again -> aggregate AND slice markers drop
+            svc.executor.script("adhoc:command", lines=["0=4", "1=4"])
+            svc.watchdog.observe(svc.clusters.get("ms"),
+                                 svc.health.check("ms"))
+            cluster = svc.clusters.get("ms")
+            assert cluster.status.condition("health") is None
+            assert cluster.status.condition("health/slice-1") is None
+        finally:
+            svc.close()
+
+    def test_per_slice_conditions_never_mask_resume_point(self, tmp_path):
+        from kubeoperator_tpu.service.reconcile import resume_point
+
+        svc = probe_stack(tmp_path)
+        try:
+            seed_plan(svc, "p-v5e4x2c", "v5e-4", num_slices=2)
+            create_tpu_cluster(svc, "rp", "p-v5e4x2c", 8)
+            cluster = svc.clusters.get("rp")
+            from kubeoperator_tpu.models.cluster import ConditionStatus
+
+            cluster.status.upsert_condition(
+                "health/slice-1", ConditionStatus.FAILED, "preempted")
+            cluster.status.upsert_condition(
+                "health", ConditionStatus.FAILED, "degraded")
+            assert resume_point(cluster) == ""   # all phases OK
+        finally:
+            svc.close()
+
+
+# --------------------------------------------- transient classification ----
+class TestTransientClassification:
+    def test_classifier(self):
+        from kubeoperator_tpu.service.watchdog import (
+            classify_remediation_error,
+        )
+        from kubeoperator_tpu.utils.errors import (
+            PhaseError,
+            ProvisionerError,
+        )
+
+        assert classify_remediation_error(
+            ProvisionerError(message="terraform apply timed out after 60s")
+        ) == "Transient"
+        assert classify_remediation_error(
+            RuntimeError("host tpu-0 unreachable")) == "Transient"
+        assert classify_remediation_error(
+            PhaseError("etcd", "task failed on reachable host")
+        ) == "Permanent"
+        err = PhaseError("etcd", "whatever")
+        err.classification = "Transient"
+        assert classify_remediation_error(err) == "Transient"
+
+    def test_transient_failure_does_not_burn_budget(self, tmp_path):
+        """Satellite 3: a TRANSIENT terraform timeout retries on the next
+        tick under the policy instead of burning the circuit budget; a
+        STREAK of them eventually counts."""
+        from kubeoperator_tpu.utils.errors import ProvisionerError
+
+        svc = probe_stack(tmp_path)
+        try:
+            seed_plan(svc, "p-v5e16t", "v5e-16")
+            create_tpu_cluster(svc, "tr", "p-v5e16t", 16)
+            svc.executor.script("adhoc:command", lines=["8"])  # 8/16
+
+            def flaky(name):
+                raise ProvisionerError(
+                    message="terraform apply timed out after 1s")
+
+            svc.clusters.reprovision = flaky
+            cluster = svc.clusters.get("tr")
+            now = [1000.0]
+            svc.watchdog.now = lambda: now[0]
+            # two transient failures: budget untouched
+            for i in range(2):
+                now[0] += 10
+                actions = svc.watchdog.observe(cluster,
+                                               svc.health.check("tr"))
+                assert any(a.endswith(":transient") for a in actions), actions
+            row = next(r for r in svc.watchdog.status()
+                       if r["cluster"] == "tr")
+            assert row["budget_left"] == svc.watchdog.cfg.remediation_budget
+            # the third consecutive transient crosses the streak limit
+            now[0] += 10
+            actions = svc.watchdog.observe(cluster, svc.health.check("tr"))
+            assert any(a.endswith(":failed") for a in actions), actions
+            row = next(r for r in svc.watchdog.status()
+                       if r["cluster"] == "tr")
+            assert row["budget_left"] \
+                == svc.watchdog.cfg.remediation_budget - 1
+        finally:
+            svc.close()
+
+
+# ------------------------------------------------------ chaos preemption ---
+class TestChaosPreemption:
+    def probe_spec(self):
+        from kubeoperator_tpu.executor.base import TaskSpec
+
+        inv = {"all": {"hosts": {
+            "tpu-a": {"tpu_slice_id": 0, "tpu_chips": 4},
+            "tpu-b": {"tpu_slice_id": 1, "tpu_chips": 4},
+            "master": {},
+        }, "children": {}}}
+        return TaskSpec(
+            adhoc_module="command",
+            adhoc_args="kubectl get nodes -o jsonpath="
+                       "'{.status.allocatable.google\\.com/tpu}'",
+            inventory=inv, limit="kube-master")
+
+    def chaos(self):
+        from kubeoperator_tpu.executor import FakeExecutor
+
+        return ChaosExecutor(FakeExecutor(), rng=random.Random(7),
+                             config=ChaosConfig())
+
+    def run_probe(self, chaos):
+        task_id = chaos.run(self.probe_spec())
+        chaos.wait(task_id, timeout_s=5)
+        return list(chaos.watch(task_id))
+
+    def test_preemption_activates_at_submission_and_heals(self):
+        from kubeoperator_tpu.executor.base import TaskSpec
+        from kubeoperator_tpu.service.health import parse_slice_chips
+
+        chaos = self.chaos()
+        chaos.preempt_slice(1, at_submission=2)
+        # submission 1: still healthy, both slices reported
+        per, _extra, seen = parse_slice_chips(self.run_probe(chaos))
+        assert seen and per == {0: 4, 1: 4}
+        # submission 2: slice 1's machines are gone
+        per, _extra, seen = parse_slice_chips(self.run_probe(chaos))
+        assert seen and per == {0: 4}
+        assert any(i.kind == "slice-preempt" and i.host == "slice-1"
+                   for i in chaos.injections)
+        # the restore leg's playbook heals it
+        pb_id = chaos.run(TaskSpec(playbook="16-tpu-runtime.yml",
+                                   inventory={"all": {"hosts": {}}}))
+        chaos.wait(pb_id, timeout_s=5)
+        per, _extra, seen = parse_slice_chips(self.run_probe(chaos))
+        assert seen and per == {0: 4, 1: 4}
+        assert any(i.kind == "slice-heal" for i in chaos.injections)
+
+    def test_probe_delegates_when_no_preemption_configured(self):
+        chaos = self.chaos()
+        lines = self.run_probe(chaos)
+        # FakeExecutor's generic adhoc output: no per-slice numbers
+        from kubeoperator_tpu.service.health import parse_slice_chips
+
+        assert not parse_slice_chips(lines)[2]
+
+
+# ------------------------------------------------- replace-slice flow ------
+def sim_stack(tmp_path, **overrides):
+    config = load_config(path="/nonexistent", env={}, overrides={
+        "db": {"path": str(tmp_path / "sim.db")},
+        "logging": {"level": "ERROR"},
+        "executor": {"backend": "simulation"},
+        "provisioner": {"work_dir": str(tmp_path / "tf")},
+        "cron": {"backup_enabled": False, "event_sync_interval_s": 0,
+                 "health_check_interval_s": 300},
+        "cluster": {"kubeconfig_dir": str(tmp_path / "kc")},
+        "watchdog": {"cooldown_s": 0},
+        **overrides,
+    })
+    return build_services(config, simulate=True)
+
+
+class TestReplaceSliceFlow:
+    def test_validations(self, tmp_path):
+        svc = sim_stack(tmp_path)
+        try:
+            # manual CPU cluster: not a TPU plan
+            from tests.test_reconcile import register_fleet
+
+            names = register_fleet(svc, 2)
+            svc.clusters.create("cpu", spec=ClusterSpec(worker_count=1),
+                                host_names=names, wait=True)
+            with pytest.raises(ValidationError, match="plan-mode TPU"):
+                svc.clusters.replace_slice("cpu", 0)
+            # single-slice TPU plan: nothing to drain onto
+            seed_plan(svc, "p-single", "v5e-4")
+            svc.clusters.create("single", provision_mode="plan",
+                                plan_name="p-single", wait=True)
+            with pytest.raises(ValidationError, match="single-slice"):
+                svc.clusters.replace_slice("single", 0)
+            # multislice: slice id bounds
+            seed_plan(svc, "p-multi", "v5e-4", num_slices=2)
+            svc.clusters.create("multi", provision_mode="plan",
+                                plan_name="p-multi", wait=True)
+            with pytest.raises(ValidationError, match="outside"):
+                svc.clusters.replace_slice("multi", 7)
+        finally:
+            svc.close()
+
+    def test_replace_slice_end_to_end(self, tmp_path):
+        """Direct operator-invoked replacement (no chaos): drain →
+        degrade (re-shard ran, losses descending) → reprovision →
+        restore, one journaled op, ledger complete, hosts back."""
+        svc = sim_stack(tmp_path)
+        try:
+            seed_plan(svc, "p-rep", "v5e-4", num_slices=2)
+            svc.clusters.create("rep", provision_mode="plan",
+                                plan_name="p-rep", wait=True)
+            before_hosts = {
+                (h.name, h.tpu_slice_id)
+                for h in svc.repos.hosts.find(
+                    cluster_id=svc.clusters.get("rep").id)
+                if h.tpu_chips > 0}
+            svc.clusters.replace_slice("rep", 1, wait=True)
+            cluster = svc.clusters.get("rep")
+            assert cluster.status.phase == "Ready"
+            ops = [o for o in svc.journal.history(cluster.id, 20)
+                   if o.kind == "slice-replace"]
+            assert len(ops) == 1 and ops[0].status == "Succeeded"
+            degraded = ops[0].vars["degraded"]
+            assert degraded["shrunk_axis"] == "data"
+            assert degraded["degraded_mesh"] == "data=1,fsdp=4,tp=1"
+            reshard = degraded["reshard"]
+            assert reshard["ran"] and reshard["ok"] and reshard["descending"]
+            kinds = [e.kind for e in
+                     reversed(svc.slicepool.history(cluster.id))]
+            assert kinds == ["drained", "degraded", "replaced", "restored"]
+            after_hosts = {
+                (h.name, h.tpu_slice_id)
+                for h in svc.repos.hosts.find(cluster_id=cluster.id)
+                if h.tpu_chips > 0}
+            assert after_hosts == before_hosts   # fleet fully restored
+            # slices surface: everything ok, ledger visible
+            report = svc.clusters.slice_status("rep")
+            assert [s["health"] for s in report["slices"]] == ["ok", "ok"]
+            assert [e["kind"] for e in report["events"]][0] == "restored"
+        finally:
+            svc.close()
+
+    def test_reshard_defers_honestly_when_mesh_exceeds_devices(
+            self, tmp_path):
+        """A degraded mesh bigger than the visible device set must record
+        'deferred', never fake a run (v5p-64 x2 → 32-device degraded
+        mesh vs the 8 virtual CPU devices)."""
+        svc = sim_stack(tmp_path)
+        try:
+            seed_plan(svc, "p-big", "v5p-64", num_slices=2)
+            svc.clusters.create("big", provision_mode="plan",
+                                plan_name="p-big", wait=True)
+            svc.clusters.replace_slice("big", 0, wait=True)
+            cluster = svc.clusters.get("big")
+            assert cluster.status.phase == "Ready"
+            op = next(o for o in svc.journal.history(cluster.id, 20)
+                      if o.kind == "slice-replace")
+            reshard = op.vars["degraded"]["reshard"]
+            assert reshard["ran"] is False
+            assert "deferred" in reshard["reason"]
+        finally:
+            svc.close()
+
+    def test_replace_surfaces_ride_both_transports(self, client):
+        """REST surface: POST replace-slice validates the body, GET
+        slices serves the posture (KO-X010 keeps LocalClient in
+        lockstep; the dispatch case is exercised by the drill)."""
+        base, session, services = client
+        seed_plan(services, "p-api", "v5e-4", num_slices=2)
+        services.clusters.create("api-ms", provision_mode="plan",
+                                 plan_name="p-api", wait=True)
+        resp = session.post(
+            f"{base}/api/v1/clusters/api-ms/replace-slice",
+            json={"slice_id": "one"})
+        assert resp.status_code == 400
+        resp = session.post(
+            f"{base}/api/v1/clusters/api-ms/replace-slice",
+            json={"slice_id": True})
+        assert resp.status_code == 400
+        resp = session.get(f"{base}/api/v1/clusters/api-ms/slices")
+        assert resp.status_code == 200
+        body = resp.json()
+        assert body["num_slices"] == 2
+        assert [s["slice_id"] for s in body["slices"]] == [0, 1]
+        # status JSON surfaces the topology block (num_slices first-class)
+        resp = session.get(f"{base}/api/v1/clusters/api-ms/status")
+        assert resp.json()["topology"]["num_slices"] == 2
+        resp = session.post(
+            f"{base}/api/v1/clusters/api-ms/replace-slice",
+            json={"slice_id": 1})
+        assert resp.status_code == 202
+        services.clusters.wait_for("api-ms", timeout_s=120)
+        assert services.clusters.get("api-ms").status.phase == "Ready"
+
+
+# ------------------------------------------------------------- the drill ---
+def drill_args(seed=1, verify=False):
+    return argparse.Namespace(seed=seed, format="json",
+                              verify_determinism=verify)
+
+
+class TestPreemptionDrill:
+    def test_drill_green(self, tmp_path):
+        from kubeoperator_tpu.cli.koctl import _preemption_soak_once
+
+        checks, structure = _preemption_soak_once(
+            drill_args(seed=1), str(tmp_path / "drill"))
+        failed = [c for c in checks if not c["ok"]]
+        assert not failed, failed
+        assert structure["ledger"] == [
+            "detected", "drained", "degraded", "replaced", "restored"]
+        assert structure["degraded_mesh"] == "data=1,fsdp=4,tp=1"
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("seed", [2, 3, 7])
+    def test_drill_green_across_seeds(self, tmp_path, seed):
+        from kubeoperator_tpu.cli.koctl import _preemption_soak_once
+
+        checks, _structure = _preemption_soak_once(
+            drill_args(seed=seed), str(tmp_path / f"drill-{seed}"))
+        failed = [c for c in checks if not c["ok"]]
+        assert not failed, failed
