@@ -308,6 +308,90 @@ def check_phase_write_discipline(root: str, tree: ast.AST, path: str) -> list:
     return findings
 
 
+# ---------------------------------------------------------------- KO-P011 ---
+_P011_WAIVER = "KO-P011: waived"
+# write-capable builtin-open modes; a mode that cannot be proven a write
+# (non-constant) is left quiet — the rule must never cry wolf on reads
+_P011_WRITE_CHARS = set("wax+")
+
+
+def _p011_candidates(tree: ast.AST) -> list:
+    """(lineno, description) for every durable-write call made OUTSIDE an
+    atomic_* helper: builtin open() in a write mode, Path-style
+    .write_text/.write_bytes, and file-form json.dump."""
+    out: list = []
+
+    def visit(node, in_atomic: bool) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            in_atomic = in_atomic or node.name.lstrip("_") \
+                .startswith("atomic_")
+        if isinstance(node, ast.Call) and not in_atomic:
+            func = node.func
+            if isinstance(func, ast.Name) and func.id == "open":
+                mode = None
+                if len(node.args) > 1:
+                    mode = node.args[1]
+                for kw in node.keywords:
+                    if kw.arg == "mode":
+                        mode = kw.value
+                if isinstance(mode, ast.Constant) \
+                        and isinstance(mode.value, str) \
+                        and set(mode.value) & _P011_WRITE_CHARS:
+                    out.append((node.lineno,
+                                f"open(..., {mode.value!r})"))
+            elif isinstance(func, ast.Attribute) \
+                    and func.attr in ("write_text", "write_bytes"):
+                out.append((node.lineno, f".{func.attr}(...)"))
+            elif isinstance(func, ast.Attribute) and func.attr == "dump" \
+                    and isinstance(func.value, ast.Name) \
+                    and func.value.id == "json":
+                out.append((node.lineno, "json.dump(...)"))
+        for child in ast.iter_child_nodes(node):
+            visit(child, in_atomic)
+
+    visit(tree, False)
+    return out
+
+
+def check_checkpoint_atomic_writes(root: str, tree: ast.AST,
+                                   path: str) -> list:
+    """Checkpoint-persistence modules (any `checkpoint.py` in the
+    package) must route every durable write through the tmp+rename
+    helper — a checkpoint's whole value is that a crash mid-save can
+    never produce a half-written shard a restore would trust, and one
+    bare `open(path, "w")` re-opens exactly that window. Functions named
+    `atomic_*`/`_atomic_*` ARE the helper (they own the tmp+`os.replace`
+    dance); everything else writes through them or carries a
+    `# KO-P011: waived — <reason>` comment."""
+    if os.path.basename(path) != "checkpoint.py":
+        return []
+    candidates = _p011_candidates(tree)
+    if not candidates:
+        return []
+    with open(path, encoding="utf-8") as f:
+        source_lines = f.read().splitlines()
+
+    def waived(lineno: int) -> bool:
+        lo = max(lineno - 4, 0)
+        return any(_P011_WAIVER in line
+                   for line in source_lines[lo:lineno])
+
+    rel = _rel(root, path)
+    findings: list = []
+    for lineno, desc in candidates:
+        if waived(lineno):
+            continue
+        findings.append(Finding(
+            "KO-P011", rel, lineno,
+            f"{desc} writes checkpoint bytes without the tmp+rename "
+            f"helper — a crash mid-write leaves a torn file a restore "
+            f"could trust; route through atomic_write_bytes/"
+            f"atomic_write_json or waive with `# {_P011_WAIVER} — "
+            f"<reason>`",
+        ))
+    return findings
+
+
 AST_RULES = {
     "KO-P001": check_repo_layering,
     "KO-P002": check_blocking_handlers,
@@ -315,6 +399,7 @@ AST_RULES = {
     "KO-P005": check_bare_except,
     "KO-P006": check_subprocess_timeouts,
     "KO-P007": check_phase_write_discipline,
+    "KO-P011": check_checkpoint_atomic_writes,
 }
 
 

@@ -34,11 +34,12 @@ _PURE_KEY_RE = re.compile(r"^[a-z0-9_]+(\.[a-z0-9_]+)+$")
 
 # the config blocks the docs knob tables must cover completely (the
 # resilience layer's contract, extended to the observability, fleet,
-# scheduler, lease and workloads blocks — docs/resilience.md +
-# docs/observability.md + docs/scheduler.md + docs/workloads.md)
+# scheduler, lease, workloads, slicepool and checkpoint blocks —
+# docs/resilience.md + docs/observability.md + docs/scheduler.md +
+# docs/workloads.md)
 DOC_REQUIRED_SECTIONS = ("resilience", "chaos", "watchdog", "observability",
                          "fleet", "scheduler", "lease", "workloads",
-                         "slicepool")
+                         "slicepool", "checkpoint")
 
 
 def _defaults_from_tree(root: str) -> dict | None:

@@ -130,6 +130,15 @@ RULES: dict[str, RuleSpec] = {
             "comment) — an un-deadlined child process wedges its caller",
         ),
         RuleSpec(
+            "KO-P011", "atomic-write", "ast", ERROR,
+            "checkpoint-persistence modules (any package checkpoint.py) "
+            "route every durable write — open() in a write mode, "
+            ".write_text/.write_bytes, file-form json.dump — through the "
+            "tmp+rename atomic helper (functions named atomic_*), or "
+            "carry a `# KO-P011: waived — <reason>` comment; a bare "
+            "write re-opens the torn-checkpoint crash window",
+        ),
+        RuleSpec(
             "KO-P007", "phase-write-discipline", "ast", ERROR,
             "in-flight ClusterPhaseStatus assignments (Provisioning/"
             "Deploying/Scaling/Upgrading/Terminating) happen only in adm/ "

@@ -776,6 +776,10 @@ class Handlers:
         return json_response(
             await run_sync(request, self.s.workloads.list_ops))
 
+    async def workload_checkpoints(self, request):
+        return json_response(
+            await run_sync(request, self.s.workloads.checkpoints))
+
     async def workload_operation(self, request):
         return json_response(await run_sync(
             request, self.s.workloads.status, request.match_info["op"]))
@@ -1248,6 +1252,8 @@ def create_app(services: Services) -> web.Application:
     r.add_post("/api/v1/fleet/operations/{op}/abort",
                admin_guard(h.fleet_abort))
     r.add_post("/api/v1/workloads/train", admin_guard(h.workload_train))
+    r.add_get("/api/v1/workloads/checkpoints",
+              admin_guard(h.workload_checkpoints))
     r.add_get("/api/v1/workloads/operations",
               admin_guard(h.workload_operations))
     r.add_get("/api/v1/workloads/operations/{op}",

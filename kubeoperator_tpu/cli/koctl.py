@@ -255,6 +255,8 @@ class LocalClient:
                 from kubeoperator_tpu.service.workload import train_kwargs
 
                 return s.workloads.train(**train_kwargs(body))
+            case ("GET", ["workloads", "checkpoints"]):
+                return s.workloads.checkpoints()
             case ("GET", ["workloads", "operations"]):
                 return s.workloads.list_ops()
             case ("GET", ["workloads", "operations", op_id]):
@@ -1091,6 +1093,10 @@ def cmd_workload(client, args) -> int:
             body["steps"] = args.steps
         if args.mode:
             body["mode"] = args.mode
+        if args.resume:
+            body["resume"] = True
+        if args.checkpoint:
+            body["checkpoint"] = args.checkpoint
         op = client.call("POST", "/api/v1/workloads/train", body)
         result = op.get("result") or {}
         ok = bool(result.get("ok"))
@@ -1110,6 +1116,13 @@ def cmd_workload(client, args) -> int:
                   + (f", {result['mfu_pct']}% MFU"
                      if result.get("mfu_pct") is not None else "")
                   + ")")
+        if op.get("resumed_from"):
+            print(f"  resumed from checkpoint {op['resumed_from'][:8]}")
+        ckpt = op.get("checkpoint")
+        if ckpt:
+            print(f"  checkpoint {ckpt['id'][:8]} saved at step "
+                  f"{ckpt['step']}/{ckpt.get('target_steps', '?')} "
+                  f"({ckpt.get('bytes', 0)} bytes)")
         print(f"  {op.get('message', '')}")
         print(f"  waterfall: koctl workload trace {op['id'][:8]}")
         return 0 if ok else 1
@@ -1125,6 +1138,19 @@ def cmd_workload(client, args) -> int:
                       f"{_format_mesh(op.get('mesh')):24s} "
                       f"{op.get('message', '')}")
         return 1 if any(o["status"] == "Failed" for o in ops) else 0
+    if args.wl_cmd == "checkpoints":
+        rows = client.call("GET", "/api/v1/workloads/checkpoints")
+        if args.json:
+            _print(rows)
+        elif not rows:
+            print("no checkpoints indexed")
+        else:
+            for c in rows:
+                print(f"{c['id'][:8]}  {c['status']:9s} "
+                      f"step {c['step']}/{c.get('target_steps', '?'):<6} "
+                      f"{_format_mesh(c.get('mesh')):20s} "
+                      f"{c.get('bytes', 0)} bytes  (op {c['op_id'][:8]})")
+        return 0
     if args.wl_cmd == "trace":
         op_ref = args.op
         if not op_ref:
@@ -1928,11 +1954,234 @@ def _preemption_soak_once(args, base_dir: str) -> tuple[list, dict]:
     return checks, structure
 
 
+def _notice_soak_once(args, base_dir: str) -> tuple[list, dict]:
+    """The kill-mid-train preemption-NOTICE scenario (ISSUE 11,
+    docs/resilience.md "Preemption notices"): a workload is training on
+    a 2x v5e-4 cluster when a 30 s maintenance notice lands on slice 1.
+    The orderly path must run BEFORE the chips vanish —
+
+      notice   — the tpu-notice probe attributes the warning to slice 1
+                 within one watchdog tick (the tick fires mid-train, at
+                 a step boundary);
+      drain    — the running workload checkpoints the REAL TrainState
+                 (params + adamw moments + step counter) and closes
+                 "drained";
+      replace  — the next tick drives the slice replacement; the degrade
+                 leg RESUMES the checkpoint on the survivor mesh;
+      resume   — `workload train --resume` restores the checkpoint on
+                 the restored full mesh and finishes the run.
+
+    Loss parity is pinned against an UNINTERRUPTED run: drained losses +
+    resumed losses must equal the straight-through run bit-for-bit, all
+    proven from journal rows, the checkpoint index, the slice ledger,
+    and ONE stitched span tree. Returns (checks, structural-summary)."""
+    from kubeoperator_tpu.models import Plan, Region, Zone
+    from kubeoperator_tpu.service import build_services
+    from kubeoperator_tpu.utils.config import load_config
+
+    checks: list[dict] = []
+
+    def check(name: str, ok, detail: str = "") -> None:
+        checks.append({"check": name, "ok": bool(ok), "detail": detail})
+
+    os.makedirs(base_dir, exist_ok=True)
+    config = load_config(path="/nonexistent", env={}, overrides={
+        "db": {"path": os.path.join(base_dir, "soak.db")},
+        "logging": {"level": "ERROR"},
+        "executor": {"backend": "simulation"},
+        "provisioner": {"work_dir": os.path.join(base_dir, "tf")},
+        "cron": {"backup_enabled": False, "health_check_interval_s": 300,
+                 "event_sync_interval_s": 0},
+        "cluster": {"kubeconfig_dir": os.path.join(base_dir, "kc")},
+        "chaos": {"enabled": True, "seed": args.seed},
+        "watchdog": {"cooldown_s": 0},
+        "lease": {"controller_id": "notice-drill-a"},
+    })
+    svc = build_services(config, simulate=True)
+    structure: dict = {}
+    steps_total = 6
+    notice_at_step = 2
+    try:
+        region = svc.regions.create(Region(
+            name="notice-region", provider="gcp_tpu_vm",
+            vars={"project": "notice", "name": "us-central1"}))
+        zone = svc.zones.create(Zone(
+            name="notice-zone", region_id=region.id,
+            vars={"gcp_zone": "us-central1-a"}))
+        svc.plans.create(Plan(
+            name="notice-v5e-4-x2", provider="gcp_tpu_vm",
+            region_id=region.id, zone_ids=[zone.id], accelerator="tpu",
+            tpu_type="v5e-4", num_slices=2, worker_count=0))
+        svc.clusters.create("preempt", provision_mode="plan",
+                            plan_name="notice-v5e-4-x2", wait=True)
+        cluster = svc.clusters.get("preempt")
+        check("cluster Ready at 2x v5e-4 (8 chips)",
+              cluster.status.phase == "Ready"
+              and cluster.status.smoke_chips == 8,
+              f"{cluster.status.phase}/{cluster.status.smoke_chips}")
+
+        # ---- the uninterrupted reference run (library, same seed) -----
+        import jax
+
+        from kubeoperator_tpu.parallel.mesh import MeshSpec
+        from kubeoperator_tpu.workloads.harness import run_training
+
+        ref_spec = MeshSpec.parse("data=2,fsdp=4,tp=1")
+        reference = run_training(
+            ref_spec.build(jax.devices()[:8]), steps=steps_total,
+            mode="auto", seed=0)
+
+        # ---- train; the notice lands mid-run at a step boundary --------
+        chaos = svc.executor
+        tick_actions: list = []
+
+        def hook(completed, _loss):
+            if completed == notice_at_step:
+                chaos.notice_preemption(1, at_probe=1)
+                svc.cron._health_last = 0.0
+                tick_actions.append(svc.cron.tick())
+
+        svc.workloads.step_hook = hook
+        drained_op = svc.workloads.train(mesh="data=2,fsdp=4",
+                                         steps=steps_total)
+        svc.workloads.step_hook = None
+        check("notice attributed + drain requested within one mid-train "
+              "tick",
+              any("watchdog-remediate:preempt:tpu-notice:ok" in a
+                  for a in tick_actions), str(tick_actions))
+        result1 = drained_op.get("result") or {}
+        ckpt = drained_op.get("checkpoint") or {}
+        check("workload drained at the notice step with a real checkpoint",
+              drained_op["status"] == "Succeeded"
+              and drained_op["drained"]
+              and result1.get("end_step") == notice_at_step
+              and ckpt.get("step") == notice_at_step
+              and ckpt.get("target_steps") == steps_total,
+              f"{drained_op['status']} end_step="
+              f"{result1.get('end_step')} ckpt={ckpt}")
+        check("checkpoint carries the full TrainState on disk",
+              ckpt and os.path.isfile(
+                  os.path.join(ckpt.get("dir", ""), "manifest.json")),
+              str(ckpt.get("dir")))
+
+        # ---- the chips never vanished: this is the ORDERLY path --------
+        report = svc.health.check("preempt")
+        chips = next((p for p in report.probes if p.name == "tpu-chips"),
+                     None)
+        # no preempt_slice was ever scripted, so the chips probe rides
+        # the plain simulation backend (count unknown, verdict ok) — the
+        # point is it NEVER failed: the chips were present throughout
+        check("chips probe healthy after the drain (notice beat the loss)",
+              chips is not None and chips.ok
+              and not (chips.slices or {}).get("short"),
+              getattr(chips, "detail", "(no probe)"))
+        check("no slice-preempt injection fired (only the notice)",
+              not any(i.kind == "slice-preempt" for i in chaos.injections)
+              and any(i.kind == "maintenance-notice"
+                      for i in chaos.injections),
+              str(sorted({i.kind for i in chaos.injections})))
+
+        # ---- tick 2: nothing running -> replace the noticed slice ------
+        svc.cron._health_last = 0.0
+        actions2 = svc.cron.tick()
+        check("second tick drives the slice replacement",
+              any("watchdog-remediate:preempt:tpu-notice:ok" in a
+                  for a in actions2), str(actions2))
+        cluster = svc.clusters.get("preempt")
+        check("cluster Ready again after replacement",
+              cluster.status.phase == "Ready", cluster.status.phase)
+        history = svc.journal.history(cluster.id, 50)
+        replaces = [o for o in history if o.kind == "slice-replace"]
+        check("exactly one Succeeded slice-replace op",
+              len(replaces) == 1 and replaces[0].status == "Succeeded",
+              str([(o.kind, o.status) for o in history]))
+        rep_op = replaces[0] if replaces else None
+        degraded = (rep_op.vars.get("degraded") if rep_op else None) or {}
+        reshard = degraded.get("reshard") or {}
+        check("degrade leg RESUMED the checkpoint on the survivor mesh",
+              reshard.get("ran") and reshard.get("ok")
+              and reshard.get("resumed_from") == ckpt.get("id")
+              and reshard.get("start_step") == notice_at_step,
+              str({k: reshard.get(k) for k in (
+                  "ran", "ok", "resumed_from", "start_step", "reason")}))
+
+        # ---- resume on the restored full mesh; loss parity -------------
+        resumed_op = svc.workloads.train(resume=True)
+        result2 = resumed_op.get("result") or {}
+        check("resume restored real step/optimizer state",
+              resumed_op["status"] == "Succeeded"
+              and resumed_op.get("resumed_from") == ckpt.get("id")
+              and result2.get("start_step") == notice_at_step
+              and result2.get("end_step") == steps_total,
+              f"{result2.get('start_step')}->{result2.get('end_step')} "
+              f"from {resumed_op.get('resumed_from', '')[:8]}")
+        stitched_losses = (result1.get("losses") or []) \
+            + (result2.get("losses") or [])
+        check("loss parity: drained+resumed == uninterrupted, bit-for-bit",
+              stitched_losses == reference["losses"]
+              and len(stitched_losses) == steps_total,
+              f"{stitched_losses} vs {reference['losses']}")
+
+        # ---- ledger: the notice lifecycle, in order --------------------
+        ledger = list(reversed(svc.slicepool.history(cluster.id)))
+        kinds = [e.kind for e in ledger]
+        check("ledger rides notice->drained->degraded->replaced->restored",
+              kinds == ["notice", "drained", "degraded", "replaced",
+                        "restored"], str(kinds))
+
+        # ---- ONE stitched span tree: train -> drain ckpt -> resume -----
+        from kubeoperator_tpu.observability import span_tree
+
+        tree = span_tree(svc.repos.spans.for_trace(
+            drained_op["trace_id"]))
+        names: list = []
+
+        def walk(node, depth=0):
+            names.append((depth, node.get("name")))
+            for child in node.get("children", []):
+                walk(child, depth + 1)
+
+        if tree:
+            walk(tree)
+        flat = [n for _d, n in names]
+        child_ops = [n for d, n in names
+                     if d == 1 and n == "workload-train"]
+        check("one stitched tree: drained op roots the resumed op with "
+              "checkpoint windows",
+              tree is not None and tree.get("id") == drained_op["id"]
+              and "checkpoint-save" in flat
+              and "checkpoint-restore" in flat
+              and len(child_ops) == 1,
+              str(flat))
+
+        # ---- watchdog hygiene: conditions cleared once healthy ---------
+        svc.cron._health_last = 0.0
+        svc.cron.tick()
+        cluster = svc.clusters.get("preempt")
+        check("health conditions cleared once the notice healed",
+              cluster.status.condition("health") is None,
+              str([c.name for c in cluster.status.conditions]))
+
+        structure = {
+            "ledger": kinds,
+            "losses": stitched_losses,
+            "reference": reference["losses"],
+            "checkpoint_step": ckpt.get("step"),
+            "injections": sorted(
+                (inj.kind, inj.host) for inj in chaos.injections),
+        }
+    finally:
+        svc.close()
+    return checks, structure
+
+
 def cmd_preemption_soak(args) -> int:
-    """`koctl chaos-soak --preemption`: the multislice preemption drill
-    (detect → degrade → replace → restore), asserted from journal rows
-    and the stitched span tree; --verify-determinism runs two seeded
-    passes and diffs the structural summary."""
+    """`koctl chaos-soak --preemption`: the multislice preemption drills —
+    the hard-loss scenario (detect → degrade → replace → restore) AND the
+    notice scenario (notice → checkpoint → drain → replace → resume,
+    ISSUE 11), asserted from journal rows and the stitched span trees;
+    --verify-determinism runs two seeded passes and diffs the structural
+    summaries."""
     import shutil
     import tempfile
     import time as _time
@@ -1945,13 +2194,23 @@ def cmd_preemption_soak(args) -> int:
         os.environ["XLA_FLAGS"] = (
             os.environ.get("XLA_FLAGS", "") + " " + flag).strip()
     t0 = _time.monotonic()
+
+    def one_pass(base: str) -> tuple[list, dict]:
+        loss_checks, loss_structure = _preemption_soak_once(
+            args, os.path.join(base, "loss"))
+        notice_checks, notice_structure = _notice_soak_once(
+            args, os.path.join(base, "notice"))
+        merged = (
+            [dict(c, check=f"[loss] {c['check']}") for c in loss_checks]
+            + [dict(c, check=f"[notice] {c['check']}")
+               for c in notice_checks])
+        return merged, {"loss": loss_structure, "notice": notice_structure}
+
     with tempfile.TemporaryDirectory(prefix="ko-preempt-soak-") as base:
-        checks, structure = _preemption_soak_once(
-            args, os.path.join(base, "pass1"))
+        checks, structure = one_pass(os.path.join(base, "pass1"))
         deterministic = None
         if args.verify_determinism:
-            checks2, structure2 = _preemption_soak_once(
-                args, os.path.join(base, "pass2"))
+            checks2, structure2 = one_pass(os.path.join(base, "pass2"))
             deterministic = (structure == structure2
                              and [c["ok"] for c in checks]
                              == [c["ok"] for c in checks2])
@@ -1968,9 +2227,12 @@ def cmd_preemption_soak(args) -> int:
     if args.format == "json":
         _print(report)
     else:
+        loss_structure = structure.get("loss") or {}
         print(f"preemption chaos-soak: seed={args.seed} "
-              f"mesh {structure.get('degraded_mesh')} "
-              f"(shrunk {structure.get('shrunk_axis')})")
+              f"mesh {loss_structure.get('degraded_mesh')} "
+              f"(shrunk {loss_structure.get('shrunk_axis')}); "
+              f"notice scenario checkpoint at step "
+              f"{(structure.get('notice') or {}).get('checkpoint_step')}")
         for c in checks:
             mark = "ok " if c["ok"] else "FAIL"
             print(f"  [{mark}] {c['check']}"
@@ -2340,11 +2602,27 @@ def build_parser() -> argparse.ArgumentParser:
                           help="compile seam: auto prefers pjit when "
                                "explicit shardings exist "
                                "(default: workloads.mode)")
+    wl_train.add_argument("--resume", action="store_true",
+                          help="restore the full TrainState (params + "
+                               "optimizer moments + step counter) from "
+                               "the latest complete checkpoint and "
+                               "continue the exact trajectory "
+                               "(docs/workloads.md \"Checkpoints\")")
+    wl_train.add_argument("--checkpoint", default="", metavar="ID",
+                          help="resume from a specific checkpoint id "
+                               "(or unique >=6-char prefix) instead of "
+                               "the newest complete one")
     wl_train.add_argument("--json", action="store_true")
     wl_list = wlsub.add_parser(
         "list", help="journaled workload runs, newest first "
                      "(exit 1 if any listed run Failed)")
     wl_list.add_argument("--json", action="store_true")
+    wl_ckpts = wlsub.add_parser(
+        "checkpoints",
+        help="the checkpoint index, newest first: id, step/target, "
+             "mesh, size, lifecycle status (complete/pruned/swept) — "
+             "the --resume picker")
+    wl_ckpts.add_argument("--json", action="store_true")
     wl_trace = wlsub.add_parser(
         "trace", help="a run's operation -> step-window span waterfall")
     wl_trace.add_argument("op", nargs="?", default="",

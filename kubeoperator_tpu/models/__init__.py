@@ -32,6 +32,7 @@ from kubeoperator_tpu.models.cluster import (
 from kubeoperator_tpu.models.backup import BackupAccount, BackupFile, BackupStrategy
 from kubeoperator_tpu.models.tenancy import Project, ProjectMember, Role, User
 from kubeoperator_tpu.models.event import AuditRecord, Event, Message, Setting, TaskLogChunk
+from kubeoperator_tpu.models.checkpoint import CHECKPOINT_STATUSES, Checkpoint
 from kubeoperator_tpu.models.component import ClusterComponent
 from kubeoperator_tpu.models.operation import Operation, OperationStatus
 from kubeoperator_tpu.models.security import CisCheck, CisScan
@@ -47,6 +48,7 @@ __all__ = [
     "Project", "ProjectMember", "Role", "User",
     "AuditRecord", "Event", "Message", "Setting", "TaskLogChunk",
     "ClusterComponent",
+    "Checkpoint", "CHECKPOINT_STATUSES",
     "Operation", "OperationStatus",
     "CisCheck", "CisScan",
     "Span", "SpanKind", "SpanStatus",

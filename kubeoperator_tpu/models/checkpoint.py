@@ -1,0 +1,48 @@
+"""Checkpoint — the DB index row over one on-disk training checkpoint
+(migration 010; the files themselves live under `checkpoint.dir`,
+workloads/checkpoint.py owns their format).
+
+A row exists ONLY for complete checkpoints: the workload service inserts
+it after the manifest landed (manifest-last is the on-disk completeness
+bit, the row is the queryable mirror). `manifest_sha` ties the row to
+the exact manifest bytes it indexed, so a directory swapped under a row
+fails verification instead of restoring silently-wrong state. Rows whose
+directories disappear are marked `swept` at boot rather than deleted —
+the journal-grade audit trail ("what did the op checkpoint, and where
+did it go") outlives the disk space."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from kubeoperator_tpu.models.base import Entity
+from kubeoperator_tpu.utils.errors import ValidationError
+
+# lifecycle: complete (restorable) -> pruned (retention) | swept (dir
+# vanished / torn debris removed at boot)
+CHECKPOINT_STATUSES: tuple[str, ...] = ("complete", "pruned", "swept")
+
+
+@dataclass
+class Checkpoint(Entity):
+    op_id: str = ""          # workload op that saved it (journal join)
+    kind: str = "workload-train"
+    step: int = 0            # TrainState step counter at save time
+    target_steps: int = 0    # the run's intended total (resume math)
+    dir: str = ""            # on-disk checkpoint directory
+    manifest_sha: str = ""   # sha256 of the manifest this row indexed
+    mesh: dict = field(default_factory=dict)   # {axis: length} at save
+    total_bytes: int = 0
+    status: str = "complete"
+
+    def validate(self) -> None:
+        if not self.op_id:
+            raise ValidationError("checkpoint needs the owning op_id")
+        if not self.dir:
+            raise ValidationError("checkpoint needs its directory path")
+        if self.step < 0:
+            raise ValidationError("checkpoint step must be >= 0")
+        if self.status not in CHECKPOINT_STATUSES:
+            raise ValidationError(
+                f"checkpoint status {self.status!r} not in "
+                f"{CHECKPOINT_STATUSES}")

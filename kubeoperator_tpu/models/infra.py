@@ -227,9 +227,12 @@ class Host(Entity):
 
 
 # the slice-incident lifecycle the pool ledgers, in causal order — shared
-# by the drill's assertions and `koctl cluster slices` rendering
+# by the drill's assertions and `koctl cluster slices` rendering.
+# "notice" is the ISSUE-11 pre-incident entry: a maintenance NOTICE
+# arrived ~30 s before the chips vanish, and the checkpoint+drain flow
+# ran on the warning instead of after the loss.
 SLICE_EVENT_KINDS: tuple[str, ...] = (
-    "detected", "drained", "degraded", "replaced", "restored",
+    "notice", "detected", "drained", "degraded", "replaced", "restored",
 )
 
 

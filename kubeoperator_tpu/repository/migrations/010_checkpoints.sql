@@ -1,0 +1,19 @@
+-- Training-checkpoint index (docs/workloads.md "Checkpoints"): one row
+-- per COMPLETE on-disk checkpoint (the manifest-last file contract is
+-- the source of truth for completeness; this table is the queryable
+-- mirror the resume/failover paths use to find "the latest complete
+-- checkpoint" without scanning directories). op_id joins back to the
+-- workload operation that saved it; rows outlive their directories
+-- (status flips to pruned/swept) as an audit trail.
+CREATE TABLE IF NOT EXISTS checkpoints (
+    id TEXT PRIMARY KEY,
+    op_id TEXT NOT NULL,
+    step INTEGER NOT NULL,
+    status TEXT NOT NULL,
+    data TEXT NOT NULL,
+    created_at REAL NOT NULL,
+    updated_at REAL NOT NULL
+);
+CREATE INDEX IF NOT EXISTS idx_checkpoints_op ON checkpoints (op_id);
+CREATE INDEX IF NOT EXISTS idx_checkpoints_status
+    ON checkpoints (status, created_at);

@@ -15,6 +15,7 @@ from kubeoperator_tpu.models import (
     BackupAccount,
     BackupFile,
     BackupStrategy,
+    Checkpoint,
     CisScan,
     Cluster,
     ClusterComponent,
@@ -488,6 +489,41 @@ class SettingRepo(EntityRepo[Setting]):
     table, entity, columns = "settings", Setting, ("name",)
 
 
+class CheckpointRepo(EntityRepo[Checkpoint]):
+    """Training-checkpoint index rows (migration 010). Only COMPLETE
+    checkpoints are restorable; latest_complete() is the one query the
+    resume paths (workload --resume, the slice pool's degrade leg, the
+    reconciler's orphan sweep) share, so "latest" can never mean
+    different rows to different layers."""
+
+    table, entity, columns = (
+        "checkpoints", Checkpoint, ("op_id", "step", "status"),
+    )
+
+    def latest_complete(self, op_id: str = "") -> Checkpoint | None:
+        """Newest complete checkpoint — of one op when `op_id` is given,
+        across all workload ops otherwise. Save-order by (created_at,
+        rowid) so two checkpoints inside one clock tick stay ordered."""
+        clauses, params = ["status = 'complete'"], []
+        if op_id:
+            clauses.append("op_id = ?")
+            params.append(op_id)
+        rows = self.db.query(
+            f"SELECT data FROM {self.table} WHERE {' AND '.join(clauses)} "
+            f"ORDER BY created_at DESC, rowid DESC LIMIT 1",
+            tuple(params),
+        )
+        return self._hydrate(rows[0]["data"]) if rows else None
+
+    def complete(self) -> list[Checkpoint]:
+        """All complete checkpoints, OLDEST first (the retention pruner
+        walks this from the front)."""
+        rows = self.db.query(
+            f"SELECT data FROM {self.table} WHERE status = 'complete' "
+            f"ORDER BY created_at, rowid")
+        return [self._hydrate(r["data"]) for r in rows]
+
+
 class SliceEventRepo(EntityRepo[SliceEvent]):
     """Per-slice incident ledger rows (migration 009) — find() by
     cluster/slice/kind/op rides the mirrored columns; rows are
@@ -702,5 +738,6 @@ class Repositories:
         self.cis_scans = CisScanRepo(db)
         self.settings = SettingRepo(db)
         self.slice_events = SliceEventRepo(db)
+        self.checkpoints = CheckpointRepo(db)
         self.audit = AuditRepo(db)
         self.leases = LeaseRepo(db)

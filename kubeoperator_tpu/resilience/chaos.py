@@ -66,6 +66,9 @@ KILLED_RC = 137         # 128 + SIGKILL: process death mid-phase
 # (service/health.py TPU_CHIPS_CMD): how the wrapper recognizes a chip
 # probe without importing the service layer
 TPU_PROBE_MARKER = "allocatable.google"
+# likewise for the maintenance-notice probe (TPU_NOTICE_CMD): the
+# annotation name is the recognizable fragment
+TPU_NOTICE_MARKER = "upcoming-maintenance"
 
 
 class ControllerDeath(BaseException):
@@ -166,6 +169,12 @@ class ChaosExecutor(Executor):
         self._preemptions: dict[int, dict] = {}
         self._probe_submissions = 0
         self._probe_synth = False
+        # maintenance-notice state (notice_preemption): scripted like
+        # preempt_slice but answering the tpu-notice probe — the 30 s
+        # warning BEFORE the machines vanish; heals on the same restore
+        # phase (replaced machines carry no stale metadata event)
+        self._notices: dict[int, dict] = {}
+        self._notice_submissions = 0
         # per-key deterministic draw streams, all derived from the ONE
         # seed the caller passed: concurrent DAG phases may submit in any
         # wall-clock order without reassigning another key's draws
@@ -233,6 +242,16 @@ class ChaosExecutor(Executor):
                             task_id="", playbook=spec.playbook,
                             kind="slice-heal", host=f"slice-{sid}",
                         ))
+            # notice heal: replaced machines carry no stale metadata
+            # maintenance event, so the restore phase clears the notice
+            if spec.playbook and self._notices:
+                for sid, n in list(self._notices.items()):
+                    if n["active"] and spec.playbook == n["heal_on"]:
+                        del self._notices[sid]
+                        self.injections.append(Injection(
+                            task_id="", playbook=spec.playbook,
+                            kind="notice-heal", host=f"slice-{sid}",
+                        ))
         return super().run(spec, task_id)
 
     def die_now(self, reason: str = "simulated controller death "
@@ -292,6 +311,59 @@ class ChaosExecutor(Executor):
                 "active": False,
                 "heal_on": heal_on,
             }
+
+    def notice_preemption(self, slice_id: int, at_probe: int = 1,
+                          event: str = "TERMINATE_ON_HOST",
+                          heal_on: str = "16-tpu-runtime.yml") -> None:
+        """Schedule a MAINTENANCE NOTICE: from the `at_probe`-th
+        tpu-notice probe counted from now (1-indexed, like fail_at), the
+        probe sees `event` pending on every node of `slice_id` — the
+        ~30 s warning GCE posts to the metadata server before reclaiming
+        the machines. The notice heals when `heal_on` (the restore leg's
+        tpu-runtime phase) is next submitted: replaced machines carry no
+        stale event. Scripted and deterministic: consumes no RNG draw,
+        like preempt_slice — and independent of it, so a drill can pin
+        the orderly notice→checkpoint→drain path with the chips still
+        present throughout."""
+        with self._ledger_lock:
+            self._notices[int(slice_id)] = {
+                "from": self._notice_submissions + max(int(at_probe), 1),
+                "active": False,
+                "event": str(event),
+                "heal_on": heal_on,
+            }
+
+    def _notice_lines(self, spec: TaskSpec) -> list | None:
+        """Synthesized tpu-notice probe output, or None to delegate (no
+        notice ever configured). Mirrors the jsonpath contract: one
+        '<slice-id>=<event>' line per TPU node, NONE when that node's
+        slice has no pending event, a bare '=' for label-less nodes."""
+        with self._ledger_lock:
+            if not self._notices:
+                return None
+            self._notice_submissions += 1
+            n = self._notice_submissions
+            pending: dict[int, str] = {}
+            for sid, notice in self._notices.items():
+                if not notice["active"] and n >= notice["from"]:
+                    notice["active"] = True
+                    self.injections.append(Injection(
+                        task_id="", playbook="adhoc:command",
+                        kind="maintenance-notice", host=f"slice-{sid}",
+                    ))
+                if notice["active"]:
+                    pending[sid] = notice["event"]
+        lines = []
+        hosts = (spec.inventory or {}).get("all", {}).get("hosts", {})
+        for name in sorted(hosts):
+            hv = hosts[name] or {}
+            chips = int(hv.get("tpu_chips", 0) or 0)
+            if chips <= 0:
+                lines.append("=")    # master/no-TPU node: empty fields
+                continue
+            sid = int(hv.get("tpu_slice_id", 0) or 0)
+            lines.append(f"{sid}={pending.get(sid, 'NONE')}")
+        return lines
 
     def _probe_lines(self, spec: TaskSpec) -> list | None:
         """Synthesized tpu-chips probe output, or None to delegate to the
@@ -372,6 +444,15 @@ class ChaosExecutor(Executor):
             lines = self._probe_lines(spec)
             if lines is not None:
                 state.emit(f"ADHOC [{spec.adhoc_module}] (chaos slice view)")
+                for line in lines:
+                    state.emit(line)
+                state.finish(TaskStatus.SUCCESS, rc=0)
+                return
+        if spec.adhoc_module and TPU_NOTICE_MARKER in (spec.adhoc_args or ""):
+            lines = self._notice_lines(spec)
+            if lines is not None:
+                state.emit(f"ADHOC [{spec.adhoc_module}] "
+                           f"(chaos maintenance view)")
                 for line in lines:
                     state.emit(line)
                 state.finish(TaskStatus.SUCCESS, rc=0)

@@ -221,18 +221,29 @@ class OperationJournal:
                                 scope="fleet")
 
     def open_scoped(self, kind: str, vars: dict | None = None,
-                    message: str = "", scope: str = "fleet") -> Operation:
+                    message: str = "", scope: str = "fleet",
+                    trace: dict | None = None,
+                    parent_op_id: str = "") -> Operation:
         """Open a platform-scope journal op — an operation no single
         cluster owns (fleet rollouts, tenant workloads): empty
         cluster_id, the ``(scope)`` marker in the cluster_name slot so
         history listings stay readable, the root span tagged with the
         scope. Crash-safety and lease contracts match open(); the lease
         resource is the op's own id (resource_of), so fencing works the
-        same as for cluster ops."""
+        same as for cluster ops.
+
+        `trace`/`parent_op_id` stitch this op into an EXISTING trace the
+        way open() does for fleet children — a checkpoint-resumed
+        workload op hangs under the original run's root span, so the
+        whole interrupted-then-resumed life renders as ONE waterfall."""
+        trace = trace or {}
+        trace_id = str(trace.get("trace_id", "") or "")
+        parent_span_id = str(trace.get("parent_span_id", "") or "")
         op = Operation(
             cluster_id="", cluster_name=f"({scope})", kind=kind,
             vars=dict(vars or {}), message=message,
-            trace_id=new_trace_id() if self.tracing else "",
+            parent_op_id=parent_op_id,
+            trace_id=(trace_id or new_trace_id()) if self.tracing else "",
         )
         # op-scope lease keyed by the op's own id (no single cluster owns
         # it); claim + Running row in one transaction, same atomicity
@@ -242,10 +253,10 @@ class OperationJournal:
             self.repos.operations.save(op)
         if self.tracing:
             self.repos.spans.save(Span(
-                id=op.id, trace_id=op.trace_id, parent_id="", op_id=op.id,
-                cluster_id="", name=kind, kind=SpanKind.OPERATION,
-                status=SpanStatus.RUNNING, started_at=now_ts(),
-                attrs={"scope": scope},
+                id=op.id, trace_id=op.trace_id, parent_id=parent_span_id,
+                op_id=op.id, cluster_id="", name=kind,
+                kind=SpanKind.OPERATION, status=SpanStatus.RUNNING,
+                started_at=now_ts(), attrs={"scope": scope},
             ))
         return op
 

@@ -162,8 +162,15 @@ class SlicePool:
         local devices (the tier-1/drill path; on hardware the JobSet
         relaunch with the emitted host_envs is the real continuation, and
         a mesh bigger than the local device set records an honest
-        'deferred' instead of faking a run). Losses are seeded so the
-        drill can pin parity against a from-scratch N−1 run."""
+        'deferred' instead of faking a run).
+
+        Durable-training integration (ISSUE 11): when a COMPLETE
+        checkpoint exists, the degraded run RESUMES the real
+        step/optimizer state from it — a preempted tenant keeps its
+        training history through the failover, not just its devices.
+        The restore window rides the span tree as `reshard-restore`.
+        Without a checkpoint the run is seeded from scratch (the drill
+        pins parity against a from-scratch N−1 run either way)."""
         if not self.cfg.reshard:
             return {"ran": False, "reason": "slicepool.reshard disabled"}
         import jax
@@ -179,16 +186,52 @@ class SlicePool:
             }
         from kubeoperator_tpu.workloads.harness import run_training
 
+        state, resumed_from, seed = self._restore_latest(op, journal)
         run = run_training(
             degraded_spec.build(devices[:needed]),
             steps=self.cfg.reshard_steps, mode="auto",
-            seed=self.cfg.reshard_seed,
+            seed=seed, state=state,
         )
         windows = run.pop("windows", [])
         self._record_windows(op, journal, windows)
         run["ran"] = True
-        run["seed"] = self.cfg.reshard_seed
+        run["seed"] = seed
+        if resumed_from:
+            run["resumed_from"] = resumed_from
         return run
+
+    def _restore_latest(self, op, journal) -> tuple:
+        """(host_state|None, checkpoint_id, seed) from the newest
+        complete checkpoint; (None, "", reshard_seed) when none exists
+        or the restore fails — a corrupt checkpoint must degrade the
+        proof to from-scratch, never fail the slice replacement. The
+        seed is the checkpoint's own batch seed when resuming, so the
+        continued trajectory is the tenant's, not the drill's."""
+        import time as _time
+
+        from kubeoperator_tpu.workloads.checkpoint import (
+            CheckpointError,
+            restore_checkpoint,
+        )
+        from kubeoperator_tpu.workloads.step import train_state_shapes
+
+        row = self.repos.checkpoints.latest_complete()
+        if row is None:
+            return None, "", self.cfg.reshard_seed
+        t0 = _time.time()
+        try:
+            state, manifest = restore_checkpoint(row.dir,
+                                                 train_state_shapes())
+        except CheckpointError as e:
+            log.warning("degrade leg: checkpoint %s unusable (%s); "
+                        "re-shard runs from scratch", row.id[:8], e)
+            return None, "", self.cfg.reshard_seed
+        self._record_windows(op, journal, [{
+            "name": "restore", "start": t0, "end": _time.time(),
+            "attrs": {"checkpoint": row.id, "step": row.step,
+                      "bytes": manifest.get("total_bytes", 0)},
+        }])
+        return state, row.id, int(manifest.get("seed", 0))
 
     def _record_windows(self, op, journal, windows: list) -> None:
         """Persist the re-shard's compile/steps wall-clock windows as

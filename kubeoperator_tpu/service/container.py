@@ -163,6 +163,15 @@ class Services:
         from kubeoperator_tpu.service.workload import WorkloadService
 
         self.workloads = WorkloadService(self)
+        # the preemption-NOTICE handler drains running workloads (they
+        # checkpoint at the next step boundary) before the watchdog
+        # drives the slice replacement — wired after construction because
+        # the watchdog is built before the workload service exists
+        self.watchdog.workloads = self.workloads
+        # torn-checkpoint sweep BEFORE anything can resume: directories a
+        # dead controller left without a manifest are debris, never a
+        # restore source (docs/workloads.md "Checkpoints")
+        self.checkpoint_sweep_report = self.workloads.sweep_torn()
         self.cron = CronService(self)
         from kubeoperator_tpu.terminal import TerminalManager
 
@@ -183,6 +192,7 @@ class Services:
         self.terminals.shutdown()
         self.fleet.wait_all()
         self.clusters.wait_all()
+        self.workloads.wait_all()
         self.repos.db.close()
 
 

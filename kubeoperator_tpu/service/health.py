@@ -62,6 +62,10 @@ RECOVERY_ACTIONS = {
     # remediation is terraform reprovision + this phase (the watchdog runs
     # both); the manual `koctl cluster recover` path re-runs the phase
     "tpu-chips": ("16-tpu-runtime.yml", "tpu-runtime"),
+    # a maintenance NOTICE is pre-incident: the watchdog's real response
+    # is checkpoint+drain+replace (service/watchdog.py _remediate_notice);
+    # the guided-recovery phase here is only the manual fallback
+    "tpu-notice": ("16-tpu-runtime.yml", "tpu-runtime"),
 }
 
 # allocatable TPU chips across the fleet, one "<slice-id>=<chips>" pair per
@@ -80,6 +84,46 @@ TPU_CHIPS_CMD = (
     "{\"=\"}{.status.allocatable.google\\.com/tpu}"
     "{\"\\n\"}{end}'"
 )
+
+# upcoming TPU maintenance per node, one "<slice-id>=<event>" pair per
+# line — the 30-second-warning detector's raw input (ISSUE 11). The
+# tpu-runtime role mirrors each VM's metadata `maintenance-event` value
+# (TERMINATE_ON_HOST ≈ 30 s before GCE reclaims the machines) into the
+# ko.tpu/upcoming-maintenance node annotation; this probe reads it back
+# with the same "=" separator discipline as TPU_CHIPS_CMD (an annotated
+# node with an EMPTY value renders "3=", which must never read as an
+# event). NONE / empty / missing all mean "no notice".
+TPU_NOTICE_CMD = (
+    "kubectl --kubeconfig /etc/kubernetes/admin.conf get nodes "
+    "-o jsonpath='{range .items[*]}{.metadata.labels.ko\\.tpu/slice-id}"
+    "{\"=\"}{.metadata.annotations.ko\\.tpu/upcoming-maintenance}"
+    "{\"\\n\"}{end}'"
+)
+
+# the metadata-event values that mean "these machines are about to go"
+NOTICE_EVENTS = frozenset({"TERMINATE_ON_HOST", "MIGRATE_ON_HOST"})
+
+
+def parse_slice_notices(lines: list[str]) -> tuple[dict[int, str], int]:
+    """``(per_slice, unattributed)`` from the notice probe's output:
+    slice id → pending maintenance event for labelled nodes, plus a
+    COUNT of events on unlabelled nodes. An unlabelled node's warning
+    names no slice, but it is still a warning — dropping it would waste
+    the checkpoint+drain window exactly the way the chips probe's
+    mixed-labelling hardening (PR 10) exists to prevent; the caller
+    drains on it and falls back to whole-fleet recovery. NONE/empty
+    values and non-matching banner lines are ignored."""
+    notices: dict[int, str] = {}
+    unattributed = 0
+    for line in lines:
+        m = re.fullmatch(r"(\d*)=([A-Z_]+)", line.strip())
+        if not m or m.group(2) not in NOTICE_EVENTS:
+            continue
+        if m.group(1):
+            notices.setdefault(int(m.group(1)), m.group(2))
+        else:
+            unattributed += 1
+    return notices, unattributed
 
 
 def parse_chip_count(lines: list[str]) -> int | None:
@@ -181,6 +225,9 @@ class HealthService:
         chips_probe = self._probe_tpu_chips(cluster, inv)
         if chips_probe is not None:
             probes.append(chips_probe)
+        notice_probe = self._probe_tpu_notice(cluster, inv)
+        if notice_probe is not None:
+            probes.append(notice_probe)
 
         healthy = all(p.ok for p in probes)
         report = HealthReport(cluster=cluster_name, healthy=healthy,
@@ -260,6 +307,47 @@ class HealthService:
         return ProbeResult(name="tpu-chips", ok=True,
                            detail=f"{chips}/{expected} chips allocatable",
                            slices=slices)
+
+    def _probe_tpu_notice(self, cluster, inv) -> ProbeResult | None:
+        """TPU maintenance-notice detector (ISSUE 11): a pending
+        TERMINATE_ON_HOST event on any slice means GCE reclaims those
+        machines in ~30 s — the one warning window in which an orderly
+        checkpoint+drain is still possible. Runs on multislice TPU plans
+        (the watchdog's notice remediation is slice-granular); no events
+        — or no parsable output at all (simulation backends) — is
+        healthy: a missing ANNOTATION must never read as a pending
+        preemption."""
+        if not cluster.spec.tpu_enabled or not cluster.plan_id:
+            return None
+        plan = self.repos.plans.get(cluster.plan_id)
+        if not plan.has_tpu() or not plan.topology().is_multislice:
+            return None
+        task_id = self.executor.run_adhoc("command", TPU_NOTICE_CMD, inv,
+                                          pattern="kube-master")
+        result = self.executor.wait(task_id, timeout_s=120)
+        if not result.ok:
+            return ProbeResult(name="tpu-notice", ok=False,
+                               detail=result.message,
+                               recovery="tpu-notice")
+        notices, unattributed = parse_slice_notices(
+            list(self.executor.watch(task_id)))
+        if not notices and not unattributed:
+            return ProbeResult(name="tpu-notice", ok=True,
+                               detail="no maintenance notices pending")
+        parts = [f"slice {sid}: {event}"
+                 for sid, event in sorted(notices.items())]
+        if unattributed:
+            parts.append(f"{unattributed} unlabelled node(s)")
+        return ProbeResult(
+            name="tpu-notice", ok=False,
+            detail=f"maintenance notice — {', '.join(parts)}; machines "
+                   f"vanish in ~30s, checkpoint+drain window open",
+            recovery="tpu-notice",
+            slices={"noticed": sorted(notices),
+                    "unattributed": unattributed,
+                    "events": {str(k): v
+                               for k, v in sorted(notices.items())}},
+        )
 
     def _check_via_kubeconfig(self, cluster) -> HealthReport:
         """Local kubectl probes against the imported cluster's apiserver.

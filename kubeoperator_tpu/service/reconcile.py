@@ -59,6 +59,12 @@ AUTO_RESUME_DELETE = frozenset({"terminate"})
 # fleet rollouts resume through FleetService.resume: the op's own `vars`
 # carry the remaining waves, so no original arguments are needed
 AUTO_RESUME_FLEET = frozenset({"fleet-upgrade"})
+# workload-train ops resume through WorkloadService.train(resume=True):
+# the latest COMPLETE checkpoint carries the real step/optimizer state,
+# so a controller death mid-train costs at most the steps since the last
+# save — the resume opens a NEW op stitched into the original's trace
+# (the old op's spans are not re-armed, unlike fleet reopen)
+AUTO_RESUME_WORKLOAD = frozenset({"workload-train"})
 
 
 def resume_point(cluster) -> str:
@@ -95,14 +101,29 @@ class ReconcileService:
             # (remaining waves, completed clusters) is already durable in
             # op.vars — the sweep just names the wave it died in; its
             # per-cluster child ops are swept like any other orphan. A
-            # workload op has no resume path: re-running the workload is
-            # the recovery, and the interrupt says so.
+            # workload op resumes from its latest COMPLETE checkpoint
+            # when one exists (real step/optimizer state, ISSUE 11);
+            # without one, re-running the workload is the recovery.
             if op.kind in AUTO_RESUME_FLEET:
                 wave = op.vars.get("current_wave", 0)
                 resume = f"wave-{wave}"
                 msg = (f"{cause}: fleet rollout was in flight "
                        f"(wave {wave}); `koctl fleet resume` continues "
                        f"without re-running completed clusters")
+            elif op.kind in AUTO_RESUME_WORKLOAD:
+                ckpt = self._workload_checkpoint(op)
+                if ckpt is not None:
+                    resume = f"checkpoint:{ckpt.id[:8]}"
+                    msg = (f"{cause}: {op.kind} was in flight; "
+                           f"checkpoint {ckpt.id[:8]} (step {ckpt.step}"
+                           f"/{ckpt.target_steps}) is complete — "
+                           f"`koctl workload train --resume --checkpoint "
+                           f"{ckpt.id[:8]}` restores the real "
+                           f"step/optimizer state")
+                else:
+                    resume = ""
+                    msg = (f"{cause}: {op.kind} was in flight with no "
+                           f"complete checkpoint; re-run the operation")
             else:
                 resume = ""
                 msg = (f"{cause}: {op.kind} was in flight; re-run the "
@@ -138,6 +159,16 @@ class ReconcileService:
             "resume_phase": op.resume_phase,
             "_cluster_id": cluster.id if cluster is not None else "",
         }
+
+    def _workload_checkpoint(self, op):
+        """The orphaned workload op's restorable state: its own newest
+        complete checkpoint, else the newest complete one overall (the
+        op may have died before its first save while an earlier run's
+        checkpoint still carries the tenant's state). None = nothing to
+        resume from."""
+        repos = self.services.repos
+        return (repos.checkpoints.latest_complete(op_id=op.id)
+                or repos.checkpoints.latest_complete())
 
     # ---- boot sweep ----
     def boot_sweep(self) -> list[dict]:
@@ -337,6 +368,18 @@ class ReconcileService:
                 self.services.fleet.resume(record["op"], wait=False)
                 log.info("auto-resumed fleet rollout %s after controller "
                          "restart", record["op"])
+                return True
+            if kind in AUTO_RESUME_WORKLOAD:
+                resume_phase = record.get("resume_phase") or ""
+                if not resume_phase.startswith("checkpoint:"):
+                    return False   # no complete checkpoint: nothing to do
+                ref = resume_phase.split(":", 1)[1]
+                # async like every other resume verb: the sweep thread
+                # also carries the lease heartbeat — blocking it behind
+                # a compile+train could fence this very controller
+                self.services.workloads.resume_from(ref, wait=False)
+                log.info("auto-resuming workload %s from checkpoint %s "
+                         "after controller restart", record["op"], ref)
                 return True
             if kind in AUTO_RESUME_RETRY or (
                 kind == "unknown"

@@ -80,12 +80,16 @@ def classify_remediation_error(e: BaseException) -> str:
 
 class WatchdogService:
     def __init__(self, repos, health, events, config, clusters=None,
-                 slicepool=None, now=time.time) -> None:
+                 slicepool=None, workloads=None, now=time.time) -> None:
         self.repos = repos
         self.health = health
         self.events = events
         self.clusters = clusters
         self.slicepool = slicepool
+        # the tenant-workload service (wired post-construction by the
+        # container): the preemption-NOTICE handler's checkpoint+drain
+        # lever — None means notices degrade to plain probe failures
+        self.workloads = workloads
         self.cfg = WatchdogConfig.from_config(config)
         # consecutive TRANSIENT remediation failures tolerated before they
         # start counting against the circuit budget (satellite: a flaky
@@ -216,6 +220,8 @@ class WatchdogService:
         everything else keeps the whole-fleet reprovision + phase re-run."""
         log.info("watchdog: remediating %s on %s", probe.name, cluster.name)
         try:
+            if probe.name == "tpu-notice" and self.clusters is not None:
+                return self._remediate_notice(cluster, probe)
             if probe.name == "tpu-chips" and self.clusters is not None:
                 short = (getattr(probe, "slices", None) or {}).get("short")
                 if short and self.slicepool is not None \
@@ -246,6 +252,62 @@ class WatchdogService:
                 f"{cluster.name} failed ({kind.lower()}): {e}",
             )
             return False, kind
+
+    def _remediate_notice(self, cluster, probe) -> tuple[bool, str]:
+        """The preemption-NOTICE flow (docs/resilience.md "Preemption
+        notices"): a maintenance notice gives ~30 s of warning BEFORE the
+        slice's chips vanish, and the platform spends that warning on an
+        orderly checkpoint+drain instead of an after-the-fact rebuild:
+
+          tick 1 — a workload is training: `request_drain` makes its
+                   step loop checkpoint at the next step boundary and
+                   close "drained". No terraform yet: the checkpoint must
+                   land while the chips still exist.
+          tick 2 — nothing left running: drive the slice replacement
+                   (drain → degrade → replace → restore) for the noticed
+                   slice; the degrade leg resumes the saved state on the
+                   survivor mesh (resilience/slicepool.py).
+
+        Both ticks run under the SAME circuit breaker budget as every
+        other remediation — a flapping notice escalates once."""
+        slices = getattr(probe, "slices", None) or {}
+        noticed = slices.get("noticed") or []
+        unattributed = int(slices.get("unattributed") or 0)
+        if not noticed and not unattributed:
+            # notice probe failed without any parsed event (probe error
+            # shape — unreachable master, kubectl failure): nothing
+            # orderly to do — let the generic recovery handle it
+            self.health.recover(cluster.name, probe.name)
+            return True, ""
+        sid = int(noticed[0]) if noticed else None
+        if sid is not None and self.slicepool is not None \
+                and self.slicepool.enabled:
+            # one ledger row per notice incident, not per tick: the
+            # notice stays active across the drain tick and the replace
+            # tick, and a second "notice" row would misread as a second
+            # preemption warning
+            latest = next(
+                (e for e in self.slicepool.history(cluster.id, limit=20)
+                 if e.slice_id == sid), None)
+            if latest is None or latest.kind != "notice":
+                self.slicepool.note(
+                    cluster, sid, "notice",
+                    detail=f"maintenance notice: {probe.detail}"[:300])
+        if self.workloads is not None and self.workloads.has_running():
+            where = (f"slice {sid}" if sid is not None
+                     else f"{unattributed} unlabelled node(s)")
+            self.workloads.request_drain(
+                f"preemption notice on {where} of {cluster.name}")
+            return True, ""
+        if sid is not None and self._is_multislice(cluster):
+            self.clusters.replace_slice(cluster.name, sid, wait=True)
+        else:
+            # the noticed machines cannot be named (unlabelled nodes) or
+            # there is no slice to drain onto — rebuild the fleet in
+            # place once the (checkpointed) workload is out of the way;
+            # the checkpoint is still the recovery point
+            self.clusters.reprovision(cluster.name)
+        return True, ""
 
     def _is_multislice(self, cluster) -> bool:
         """True when the cluster's plan declares num_slices > 1 — the

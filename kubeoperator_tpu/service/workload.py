@@ -12,9 +12,19 @@ rides the journal the run writes through.
 
 Scope: like a fleet rollout, a workload op belongs to the PLATFORM, not
 to one cluster (`cluster_id == ""`, marker ``(workload)``); the lease
-resource is the op's own id. Orphaned workload ops sweep to Interrupted
-at boot with no resume path — re-running the workload IS the recovery
-(training state is the tenant's checkpoint problem, not the journal's).
+resource is the op's own id.
+
+Durable training (ISSUE 11): every completed (or drained) run saves a
+sharded, content-hashed checkpoint of the FULL TrainState — params plus
+adamw optimizer state — through workloads/checkpoint.py, indexed by
+`CheckpointRepo`. `train --resume [--checkpoint id]` restores the real
+step/optimizer state and continues the exact trajectory (the resumed op
+stitches into the original run's trace, so the interrupted life renders
+as one waterfall); a preemption NOTICE (service/watchdog.py) calls
+`request_drain`, the step loop checkpoints at the next boundary, and the
+op closes "drained" with a restorable checkpoint — BEFORE the chips
+vanish. Orphaned workload ops sweep to Interrupted at boot naming the
+latest complete checkpoint as the resume point (service/reconcile.py).
 
 `--plan` pins the run to a deploy plan's TPU topology: the visible
 device count must match the plan, and the plan's generation supplies the
@@ -25,9 +35,18 @@ devices).
 
 from __future__ import annotations
 
-from kubeoperator_tpu.models import Operation
+import os
+import shutil
+import threading
+import time
+
+from kubeoperator_tpu.models import Checkpoint, Operation
 from kubeoperator_tpu.models.span import Span, SpanKind, SpanStatus
-from kubeoperator_tpu.utils.errors import KoError, ValidationError
+from kubeoperator_tpu.utils.errors import (
+    KoError,
+    NotFoundError,
+    ValidationError,
+)
 from kubeoperator_tpu.utils.logging import get_logger
 
 log = get_logger("service.workload")
@@ -42,11 +61,16 @@ def train_kwargs(body: dict) -> dict:
     `upgrade_kwargs`."""
     from kubeoperator_tpu.fleet.planner import optional_int
 
+    resume = body.get("resume", False)
+    if not isinstance(resume, bool):
+        raise ValidationError("resume must be a boolean")
     return {
         "plan": str(body.get("plan", "") or ""),
         "mesh": str(body.get("mesh", "") or ""),
         "steps": optional_int("steps", body.get("steps")),
         "mode": str(body.get("mode", "") or ""),
+        "resume": resume,
+        "checkpoint": str(body.get("checkpoint", "") or ""),
     }
 
 
@@ -61,32 +85,141 @@ class WorkloadService:
         self.default_mode = str(cfg.get("workloads.mode", "auto"))
         self.peak_override = float(
             cfg.get("workloads.peak_tflops_per_chip", 0.0))
+        # durable-training checkpoints (checkpoint.* DEFAULTS block)
+        self.ckpt_enabled = bool(cfg.get("checkpoint.enabled", True))
+        self.ckpt_keep = max(int(cfg.get("checkpoint.keep", 5)), 1)
+        self.ckpt_dir = self._resolve_ckpt_dir(
+            str(cfg.get("checkpoint.dir", "") or ""),
+            str(cfg.get("db.path", "") or ""))
+        # cooperative drain: the preemption-notice path sets the event
+        # (request_drain) and the step loop checkpoints at the next step
+        # boundary; step_hook is the per-step seam drills/integrations
+        # compose onto the same boundary (called before the drain check)
+        self._drain = threading.Event()
+        self._drain_reason = ""
+        self.step_hook = None
+        # background resume threads (the reconciler's auto-resume path):
+        # joined by wait_all() at container close, like cluster op threads
+        self._threads: list[threading.Thread] = []
+
+    @staticmethod
+    def _resolve_ckpt_dir(configured: str, db_path: str) -> str:
+        """`checkpoint.dir`, defaulting to a `checkpoints/` dir NEXT TO
+        the SQLite file — the index rows and the shard files share fate
+        (one tmp stack, one data dir), and test stacks inherit isolation
+        from their tmp db paths for free. :memory: stacks fall back to
+        ./checkpoints."""
+        if configured:
+            return configured
+        if db_path and db_path != ":memory:":
+            return os.path.join(os.path.dirname(db_path) or ".",
+                                "checkpoints")
+        return "checkpoints"
+
+    # ---- cooperative drain (preemption notice integration) ----
+    def request_drain(self, reason: str = "drain requested") -> None:
+        """Ask the running train loop to checkpoint and stop at the next
+        step boundary (the preemption-notice handler's verb). Safe to
+        call with nothing running — the flag is consumed per-run."""
+        self._drain_reason = reason
+        self._drain.set()
+        log.info("workload drain requested: %s", reason)
+
+    def has_running(self) -> bool:
+        """A workload-train journal op is currently Running — the
+        journal-row truth the notice handler consults (not thread state:
+        journal rows survive whatever the threads do)."""
+        from kubeoperator_tpu.models import OperationStatus
+
+        return bool(self.repos.operations.find(
+            kind=WORKLOAD_TRAIN_KIND,
+            status=OperationStatus.RUNNING.value))
+
+    def _on_step(self, completed: int, loss) -> bool:
+        hook = self.step_hook
+        if hook is not None:
+            hook(completed, loss)
+        return self._drain.is_set()
+
+    def resume_from(self, checkpoint: str = "", wait: bool = True):
+        """Resume the latest (or named) complete checkpoint. With
+        `wait=False` the run happens on a background thread — the
+        reconciler's auto-resume posture: a boot or lease sweep must not
+        block its own thread (which also carries the lease heartbeat
+        tick) behind a compile+train. Failures on the thread surface as
+        a Failed journal op plus a log line, same as any train."""
+        if wait:
+            return self.train(resume=True, checkpoint=checkpoint)
+
+        def run() -> None:
+            try:
+                self.train(resume=True, checkpoint=checkpoint)
+            except Exception as e:
+                log.warning("background workload resume (checkpoint %r) "
+                            "failed: %s", checkpoint, e)
+
+        t = threading.Thread(
+            target=run, daemon=True,
+            name=f"workload-resume-{checkpoint or 'latest'}")
+        self._threads.append(t)
+        t.start()
+        return None
+
+    def wait_all(self, timeout_s: float = 120.0) -> None:
+        """Join background resume threads (container close)."""
+        for t in self._threads:
+            t.join(timeout_s)
+        self._threads = [t for t in self._threads if t.is_alive()]
 
     # ---- the workload verb ----
     def train(self, plan: str = "", mesh: str = "", steps: int | None = None,
-              mode: str = "") -> dict:
+              mode: str = "", resume: bool = False,
+              checkpoint: str = "") -> dict:
         """One sharded training run as a journaled operation; returns the
-        op description including the run result and rule coverage."""
+        op description including the run result, rule coverage, and the
+        checkpoint it saved. With `resume`, the run restores the full
+        TrainState (params + optimizer moments + step counter) from the
+        named (or latest) complete checkpoint and continues the exact
+        trajectory — default step count is what the original run had
+        left, default mesh is the checkpoint's."""
         import jax
 
         from kubeoperator_tpu.parallel.mesh import MeshSpec
+        from kubeoperator_tpu.workloads.checkpoint import restore_checkpoint
         from kubeoperator_tpu.workloads.harness import run_training
         from kubeoperator_tpu.workloads.partition import explain_rules
         from kubeoperator_tpu.workloads.step import (
             WORKLOAD_AXES,
             default_rules,
-            param_shapes,
+            train_state_shapes,
         )
 
-        steps = self.default_steps if steps is None else int(steps)
-        if steps < 2:
-            raise ValidationError(
-                "workload train needs steps >= 2 — a single step has no "
-                "loss pair for the descending-loss verdict")
         mode = str(mode or self.default_mode)
         if mode not in ("auto", "pjit", "shard_map"):
             raise ValidationError(
                 f"workload mode {mode!r} not in (auto, pjit, shard_map)")
+        if checkpoint and not resume:
+            raise ValidationError(
+                "--checkpoint names a resume source; pass resume=true "
+                "with it")
+        ckpt_row = self._resolve_checkpoint(checkpoint) if resume else None
+
+        if steps is None:
+            if resume:
+                # finish what the interrupted run had left (never less
+                # than one step — a fully-finished checkpoint still
+                # proves restore with one extra step)
+                steps = max(ckpt_row.target_steps - ckpt_row.step, 1)
+            else:
+                steps = self.default_steps
+        else:
+            steps = int(steps)
+        if steps < (1 if resume else 2):
+            raise ValidationError(
+                "workload train needs steps >= 2 — a single step has no "
+                "loss pair for the descending-loss verdict"
+                if not resume else
+                "workload train --resume needs steps >= 1")
 
         devices = list(jax.devices())
         peak = self.peak_override or None
@@ -107,6 +240,12 @@ class WorkloadService:
             envelope = topo.theoretical_allreduce_busbw_gbps()
 
         mesh_text = str(mesh or self.default_mesh)
+        if not mesh_text and resume and ckpt_row.mesh:
+            # same mesh, same trajectory: resuming onto the checkpoint's
+            # own layout is the loss-parity default; an explicit --mesh
+            # (the degraded-mesh path) overrides it
+            mesh_text = ",".join(f"{a}={n}"
+                                 for a, n in ckpt_row.mesh.items())
         if mesh_text:
             spec = MeshSpec.parse(mesh_text, axis_names=WORKLOAD_AXES,
                                   n_devices=len(devices))
@@ -122,23 +261,53 @@ class WorkloadService:
                 f"mesh {spec} needs {spec.total_devices} devices, "
                 f"{len(devices)} visible")
 
+        op_vars = {"plan": plan, "mesh": spec.describe(), "steps": steps,
+                   "mode": mode}
+        trace = None
+        parent_op_id = ""
+        if resume:
+            op_vars["resumed_from"] = ckpt_row.id
+            parent_op_id = ckpt_row.op_id
+            trace = self._trace_of(ckpt_row.op_id)
         op = self.journal.open_scoped(
             WORKLOAD_TRAIN_KIND,
-            vars={"plan": plan, "mesh": spec.describe(), "steps": steps,
-                  "mode": mode},
-            message=f"sharded train on mesh {spec} "
-                    f"({spec.total_devices} device(s))",
-            scope="workload",
+            vars=op_vars,
+            message=(f"resume from checkpoint {ckpt_row.id[:8]} "
+                     f"(step {ckpt_row.step}) on mesh {spec}" if resume
+                     else f"sharded train on mesh {spec} "
+                          f"({spec.total_devices} device(s))"),
+            scope="workload", trace=trace, parent_op_id=parent_op_id,
         )
-        log.info("workload op %s: mesh %s, %d steps, mode %s",
-                 op.id, spec, steps, mode)
+        log.info("workload op %s: mesh %s, %d steps, mode %s%s",
+                 op.id, spec, steps, mode,
+                 f", resuming {ckpt_row.id[:8]}" if resume else "")
+        self._drain.clear()
         try:
             mesh_obj = spec.build(devices[: spec.total_devices])
-            run = run_training(mesh_obj, steps=steps, mode=mode)
+            state = None
+            seed = 0
+            if resume:
+                t_restore = time.time()
+                state, manifest = restore_checkpoint(
+                    ckpt_row.dir, train_state_shapes())
+                seed = int(manifest.get("seed", 0))
+                self._record_windows(op, [{
+                    "name": "checkpoint-restore", "start": t_restore,
+                    "end": time.time(),
+                    "attrs": {"checkpoint": ckpt_row.id,
+                              "step": ckpt_row.step,
+                              "bytes": manifest.get("total_bytes", 0)},
+                }])
+            run = run_training(mesh_obj, steps=steps, mode=mode, seed=seed,
+                               state=state, on_step=self._on_step,
+                               return_state=True)
+            final_state = run.pop("state", None)
+            drained = bool(run.get("stopped_early"))
             windows = run.pop("windows", [])
             self._record_windows(op, windows)
             if run["mode"] == "pjit":
-                run["rules"] = explain_rules(default_rules(), param_shapes())
+                run["rules"] = explain_rules(default_rules(),
+                                             train_state_shapes())
             if peak:
                 run["mfu_pct"] = round(
                     100.0 * run["model_tflops_per_s"]
@@ -146,17 +315,45 @@ class WorkloadService:
                 run["peak_tflops_per_chip"] = peak
             if envelope:
                 run["ici_envelope_gbps"] = envelope
+            target_steps = (max(ckpt_row.target_steps, run["end_step"])
+                            if resume else steps)
+            if self.ckpt_enabled:
+                saved = self._save_checkpoint(
+                    op, final_state, run, seed=seed,
+                    target_steps=target_steps)
+                run["checkpoint"] = saved
+            if resume:
+                run["resumed_from"] = ckpt_row.id
+            if drained:
+                run["drained"] = True
+                run["drain_reason"] = self._drain_reason
             op.vars["result"] = run
             self.journal.save_vars(op)
-            self.journal.close(
-                op, ok=bool(run["ok"]),
-                message=(f"loss {run['losses'][0]} -> {run['losses'][-1]} "
-                         f"in {run['steps']} steps "
-                         f"({run['steps_per_s']} steps/s, {run['mode']})")
-                if run["ok"] else
-                (f"training unhealthy: finite={run['finite']} "
-                 f"descending={run['descending']}"),
-            )
+            if drained:
+                message = (
+                    f"drained at step {run['end_step']}"
+                    + (f"/{target_steps}" if target_steps else "")
+                    + f" ({self._drain_reason}); "
+                    + (f"checkpoint {run['checkpoint']['id'][:8]} saved — "
+                       f"resume with `koctl workload train --resume`"
+                       if run.get("checkpoint") else
+                       "checkpointing disabled — state lost"))
+                # a drain is the platform doing its job, not a failure:
+                # the op succeeds iff the partial losses were healthy
+                self.journal.close(op, ok=bool(run["finite"]),
+                                   message=message)
+            else:
+                self.journal.close(
+                    op, ok=bool(run["ok"]),
+                    message=(f"loss {run['losses'][0]} -> "
+                             f"{run['losses'][-1]} "
+                             f"in {run['steps']} steps "
+                             f"({run['steps_per_s']} steps/s, "
+                             f"{run['mode']})")
+                    if run["ok"] else
+                    (f"training unhealthy: finite={run['finite']} "
+                     f"descending={run['descending']}"),
+                )
         except KoError as e:
             self.journal.close(op, ok=False, message=e.message)
             raise
@@ -167,6 +364,9 @@ class WorkloadService:
                                message=f"{type(e).__name__}: {e}")
             raise KoError(
                 f"workload train failed ({type(e).__name__}): {e}") from e
+        finally:
+            self._drain.clear()
+            self._drain_reason = ""
         return self.describe(self.repos.operations.get(op.id))
 
     def _record_windows(self, op: Operation, windows: list) -> None:
@@ -189,6 +389,135 @@ class WorkloadService:
         tracer.record_payload(payloads)
         tracer.flush()
 
+    # ---- checkpoints ----
+    def _trace_of(self, op_id: str) -> dict | None:
+        """The trace-context wire shape stitching a resumed op under the
+        original run's root span; None (fresh trace) when the original
+        op or its trace is gone — resume must work even after prune."""
+        try:
+            orig = self.repos.operations.get(op_id)
+        except NotFoundError:
+            return None
+        if not orig.trace_id:
+            return None
+        return {"trace_id": orig.trace_id, "parent_span_id": orig.id}
+
+    def _resolve_checkpoint(self, ref: str = "") -> Checkpoint:
+        """A COMPLETE checkpoint by exact id, unique >=6-char prefix, or
+        — with no ref — the newest one (the journal's op-ref resolution
+        contract, applied to checkpoint rows). "Latest" is
+        `CheckpointRepo.latest_complete` — the ONE query the slice pool
+        and reconciler also use, so it can never mean different rows to
+        different layers."""
+        if not ref:
+            row = self.repos.checkpoints.latest_complete()
+            if row is None:
+                raise NotFoundError(kind="checkpoint", name="(latest)")
+            return row
+        rows = self.repos.checkpoints.complete()
+        matches = [c for c in rows if c.id == ref]
+        if not matches and len(ref) >= 6:
+            matches = [c for c in rows if c.id.startswith(ref)]
+        if len(matches) == 1:
+            return matches[0]
+        if len(matches) > 1:
+            raise ValidationError(
+                f"checkpoint ref {ref!r} is ambiguous "
+                f"({len(matches)} matches)")
+        raise NotFoundError(kind="checkpoint", name=ref)
+
+    def _save_checkpoint(self, op: Operation, final_state, run: dict,
+                         seed: int, target_steps: int) -> dict | None:
+        """Gather the final TrainState to host, write the sharded
+        checkpoint (manifest last), index it, prune past retention, and
+        persist the `checkpoint-save` window span. Returns the summary
+        riding the run result, or None when there was no state."""
+        import jax
+        import numpy as np
+
+        from kubeoperator_tpu.workloads.checkpoint import (
+            manifest_sha,
+            save_checkpoint,
+        )
+
+        if final_state is None:
+            return None
+        t_save = time.time()
+        host = jax.tree_util.tree_map(
+            lambda l: np.asarray(jax.device_get(l)), final_state)
+        manifest = save_checkpoint(
+            self.ckpt_dir, host, step=run["end_step"],
+            target_steps=target_steps, mesh=run["mesh"], op_id=op.id,
+            losses=run["losses"], seed=seed)
+        row = Checkpoint(
+            id=manifest["id"], op_id=op.id, step=run["end_step"],
+            target_steps=target_steps, dir=manifest["dir"],
+            manifest_sha=manifest_sha(manifest), mesh=dict(run["mesh"]),
+            total_bytes=int(manifest["total_bytes"]), status="complete")
+        row.validate()
+        self.repos.checkpoints.save(row)
+        self._prune_checkpoints(keep_id=row.id)
+        self._record_windows(op, [{
+            "name": "checkpoint-save", "start": t_save,
+            "end": time.time(),
+            "attrs": {"checkpoint": row.id, "step": row.step,
+                      "bytes": row.total_bytes},
+        }])
+        return {"id": row.id, "step": row.step,
+                "target_steps": target_steps, "dir": row.dir,
+                "bytes": row.total_bytes}
+
+    def _prune_checkpoints(self, keep_id: str = "") -> int:
+        """Retention: keep the newest `checkpoint.keep` complete
+        checkpoints (the just-saved one always survives), delete the
+        rest's directories and flip their rows to `pruned` — rows stay
+        as the audit trail."""
+        rows = self.repos.checkpoints.complete()   # oldest first
+        excess = len(rows) - self.ckpt_keep
+        pruned = 0
+        for row in rows:
+            if excess <= 0:
+                break
+            if row.id == keep_id:
+                continue
+            shutil.rmtree(row.dir, ignore_errors=True)
+            row.status = "pruned"
+            self.repos.checkpoints.save(row)
+            excess -= 1
+            pruned += 1
+        return pruned
+
+    def sweep_torn(self) -> list[str]:
+        """Boot hygiene (ControllerDeath mid-save): remove checkpoint
+        directories without a complete manifest, and flip index rows
+        whose directories vanished to `swept`. Called by the service
+        container at boot, before anything tries to resume."""
+        from kubeoperator_tpu.workloads.checkpoint import (
+            MANIFEST_NAME,
+            sweep_torn,
+        )
+
+        removed = sweep_torn(self.ckpt_dir)
+        for row in self.repos.checkpoints.complete():
+            if not os.path.isfile(os.path.join(row.dir, MANIFEST_NAME)):
+                row.status = "swept"
+                self.repos.checkpoints.save(row)
+                log.warning("checkpoint %s swept: directory %s no longer "
+                            "holds a manifest", row.id[:8], row.dir)
+        return removed
+
+    def checkpoints(self) -> list[dict]:
+        """Checkpoint index rows, newest first — `koctl workload
+        checkpoints` / GET /api/v1/workloads/checkpoints, the --resume
+        picker and the drill's audit surface."""
+        rows = self.repos.checkpoints.find()
+        return [{
+            "id": c.id, "op_id": c.op_id, "step": c.step,
+            "target_steps": c.target_steps, "mesh": c.mesh,
+            "bytes": c.total_bytes, "status": c.status,
+            "created_at": c.created_at,
+        } for c in reversed(rows)]
+
     # ---- queries ----
     def resolve(self, op_ref: str = "") -> Operation:
         """A workload op by exact id, unique id prefix, or — with no
@@ -200,6 +529,7 @@ class WorkloadService:
 
     def describe(self, op: Operation) -> dict:
         v = op.vars
+        result = v.get("result") or {}
         return {
             "id": op.id,
             "kind": op.kind,
@@ -210,6 +540,13 @@ class WorkloadService:
             "steps": v.get("steps"),
             "mode": v.get("mode", ""),
             "result": v.get("result"),
+            # checkpoint fields first-class in status/list JSON (ISSUE 11
+            # satellite 1): what this run saved, what it resumed from,
+            # and whether a preemption notice drained it
+            "checkpoint": result.get("checkpoint"),
+            "resumed_from": v.get("resumed_from")
+            or result.get("resumed_from"),
+            "drained": bool(result.get("drained")),
             "trace_id": op.trace_id,
             "created_at": op.created_at,
             "finished_at": op.finished_at or None,

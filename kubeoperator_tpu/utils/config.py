@@ -200,6 +200,23 @@ DEFAULTS: dict[str, Any] = {
         # generation's datasheet peak; CPU runs report no MFU)
         "peak_tflops_per_chip": 0,
     },
+    "checkpoint": {
+        # durable-training checkpoints (workloads/checkpoint.py,
+        # docs/workloads.md "Checkpoints"): sharded, content-hashed,
+        # manifest-last save/restore of the full TrainState (params +
+        # adamw optimizer state), written at the end of every `koctl
+        # workload train` run and on preemption-notice drains; `--resume`
+        # and the slice pool's degrade leg restore from the latest
+        # complete one.
+        "enabled": True,
+        # checkpoint root directory; "" = a `checkpoints/` dir next to
+        # the SQLite database file (tests and drills inherit their tmp
+        # stacks' isolation automatically)
+        "dir": "",
+        # retention: keep the newest N complete checkpoints, prune the
+        # rest (directory deleted, row flipped to `pruned`)
+        "keep": 5,
+    },
     "chaos": {
         # seeded fault injection over the executor (resilience/chaos.py);
         # exercised standalone via `koctl chaos-soak`. Never enable on a

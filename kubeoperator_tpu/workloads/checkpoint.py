@@ -1,0 +1,319 @@
+"""Sharded training checkpoints: content-hashed per-shard files written
+atomically, manifest last (ISSUE 11 tentpole layer 2).
+
+Layout on disk — one directory per checkpoint:
+
+    <root>/<checkpoint-id>/
+        params-wqkv-3fa9c1d2.npy      # one file per TrainState leaf,
+        opt-0-mu-wqkv-88ab01ef.npy    # named by its /-joined tree path
+        ...                           # + the first 8 hex of its sha256
+        manifest.json                 # written LAST — its presence IS
+                                      # the completeness bit
+
+Three contracts, all load-bearing:
+
+* **Atomic writes** (analyzer rule KO-P011): every durable byte goes
+  through `atomic_write_bytes` — tmp file in the SAME directory, fsync,
+  `os.replace`. A crash mid-write leaves a `.tmp-*` turd, never a
+  half-written shard a reader could mistake for data.
+* **Manifest last**: the manifest names every shard file WITH its sha256
+  and is written only after every shard landed. A directory without a
+  readable manifest is therefore not a checkpoint — restore ignores it
+  and the boot sweep (`sweep_torn`) deletes it. ControllerDeath at ANY
+  point of a save yields either the previous complete checkpoint or a
+  sweepable turd, never a torn restore source.
+* **Gather/re-place mesh portability**: shards hold the GATHERED global
+  leaves (host numpy, the `make_shard_and_gather_fns` fetch direction),
+  so a checkpoint saved on ``data=4`` restores onto ``data=2`` — or any
+  mesh the partition specs fit — by re-placing the global arrays.
+  Restore validates shapes/dtypes against the live TrainState template
+  (`train_state_shapes`), so a checkpoint from a different model config
+  fails loudly naming the first mismatched leaf.
+
+The DB side (`CheckpointRepo`, migration 010) indexes completed
+checkpoints by workload op; this module owns only the files.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import os
+import shutil
+
+import numpy as np
+
+from kubeoperator_tpu.utils.errors import KoError
+from kubeoperator_tpu.utils.ids import new_id, now_ts
+from kubeoperator_tpu.utils.logging import get_logger
+
+log = get_logger("workloads.checkpoint")
+
+MANIFEST_NAME = "manifest.json"
+CHECKPOINT_FORMAT = 1
+
+
+class CheckpointError(KoError):
+    """A checkpoint directory that cannot be trusted (missing/corrupt
+    shard, manifest/template mismatch) or a save that cannot proceed."""
+
+
+# ---------------------------------------------------------------- writes ----
+def atomic_write_bytes(path: str, data: bytes) -> None:
+    """THE durable-write helper (KO-P011's one sanctioned writer): write
+    to a tmp file in the target's own directory, flush+fsync, then
+    `os.replace` — the write is visible either whole or not at all, and
+    the tmp name carries a recognizable `.tmp-` marker the torn-sweep
+    removes."""
+    tmp = f"{path}.tmp-{os.getpid()}-{new_id()[:8]}"
+    # KO-P011: waived — this IS the tmp+rename helper itself
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def atomic_write_json(path: str, obj: dict) -> None:
+    atomic_write_bytes(
+        path, json.dumps(obj, indent=1, sort_keys=True).encode("utf-8"))
+
+
+def leaf_to_bytes(arr) -> bytes:
+    """One leaf in .npy form (dtype + shape ride inside the format)."""
+    buf = io.BytesIO()
+    np.save(buf, np.asarray(arr), allow_pickle=False)
+    return buf.getvalue()
+
+
+def leaf_from_bytes(data: bytes):
+    return np.load(io.BytesIO(data), allow_pickle=False)
+
+
+def _tree_paths(tree):
+    from kubeoperator_tpu.workloads.partition import tree_paths
+
+    return tree_paths(tree)
+
+
+def _shard_filename(path: str, sha: str) -> str:
+    return f"{path.replace('/', '-')}-{sha[:8]}.npy"
+
+
+# ----------------------------------------------------------------- save ----
+def save_checkpoint(root_dir: str, state_host, *, step: int,
+                    target_steps: int = 0, mesh: dict | None = None,
+                    op_id: str = "", losses=(), seed: int = 0,
+                    checkpoint_id: str = "") -> dict:
+    """Write one complete checkpoint of a HOST (gathered numpy) TrainState
+    under `root_dir`; returns the manifest (which carries the checkpoint
+    id and directory). Every shard is content-hashed and written via the
+    atomic helper; the manifest lands strictly last."""
+    ckpt_id = checkpoint_id or new_id()
+    directory = os.path.join(root_dir, ckpt_id)
+    os.makedirs(directory, exist_ok=True)
+    leaves = []
+    for path, leaf in _tree_paths(state_host):
+        data = leaf_to_bytes(leaf)
+        sha = hashlib.sha256(data).hexdigest()
+        fname = _shard_filename(path, sha)
+        atomic_write_bytes(os.path.join(directory, fname), data)
+        leaves.append({
+            "path": path,
+            "file": fname,
+            "sha256": sha,
+            "shape": list(np.shape(leaf)),
+            "dtype": str(np.asarray(leaf).dtype),
+            "bytes": len(data),
+        })
+    manifest = {
+        "format": CHECKPOINT_FORMAT,
+        "id": ckpt_id,
+        "dir": directory,
+        "op_id": op_id,
+        "step": int(step),
+        "target_steps": int(target_steps),
+        "mesh": dict(mesh or {}),
+        "seed": int(seed),
+        "losses": [float(l) for l in losses],
+        "leaves": leaves,
+        "total_bytes": sum(l["bytes"] for l in leaves),
+        "created_at": now_ts(),
+    }
+    atomic_write_json(os.path.join(directory, MANIFEST_NAME), manifest)
+    return manifest
+
+
+def manifest_sha(manifest: dict) -> str:
+    """Stable content hash of a manifest (the DB row's integrity column:
+    a row whose directory was swapped under it fails verification)."""
+    return hashlib.sha256(
+        json.dumps(manifest, sort_keys=True).encode("utf-8")).hexdigest()
+
+
+# -------------------------------------------------------------- restore ----
+def load_manifest(directory: str) -> dict:
+    """The directory's manifest, or CheckpointError when absent/unreadable
+    — an absent manifest is the torn-save signature, and a torn save is
+    BY DESIGN not a checkpoint."""
+    path = os.path.join(directory, MANIFEST_NAME)
+    try:
+        with open(path, encoding="utf-8") as f:
+            manifest = json.load(f)
+    except (OSError, ValueError) as e:
+        raise CheckpointError(
+            f"{directory} holds no readable {MANIFEST_NAME} ({e}) — a "
+            f"save died before completing; this directory is not a "
+            f"checkpoint") from None
+    if manifest.get("format") != CHECKPOINT_FORMAT:
+        raise CheckpointError(
+            f"{directory}: unsupported checkpoint format "
+            f"{manifest.get('format')!r} (this build reads "
+            f"{CHECKPOINT_FORMAT})")
+    return manifest
+
+
+def restore_checkpoint(directory: str, like) -> tuple:
+    """Read a complete checkpoint back as a HOST TrainState shaped like
+    `like` (an abstract `train_state_shapes()` tree — the template that
+    supplies the treedef and validates compatibility). Returns
+    ``(state_host, manifest)``.
+
+    Every shard file is re-hashed against the manifest (bit-rot or a
+    half-synced copy fails loudly), the leaf set must match the template
+    exactly (a checkpoint from another model config names the first
+    mismatch), and shapes/dtypes are checked leaf-by-leaf. Mesh freedom
+    is the point: shards are gathered GLOBAL arrays, so the caller may
+    re-place them onto any mesh whose specs fit (`degraded_mesh_spec`
+    survivors included)."""
+    import jax
+
+    manifest = load_manifest(directory)
+    by_path = {l["path"]: l for l in manifest["leaves"]}
+    flat, treedef = jax.tree_util.tree_flatten_with_path(like)
+    from kubeoperator_tpu.workloads.partition import _key_str
+
+    template_paths = ["/".join(_key_str(k) for k in path)
+                      for path, _leaf in flat]
+    missing = [p for p in template_paths if p not in by_path]
+    extra = [p for p in by_path if p not in set(template_paths)]
+    if missing or extra:
+        raise CheckpointError(
+            f"{directory} does not match the live TrainState: "
+            f"missing leaves {missing[:3]}, unexpected {extra[:3]} — "
+            f"checkpoint and workload disagree about the model")
+    leaves = []
+    for path_str, (_path, tmpl) in zip(template_paths, flat):
+        entry = by_path[path_str]
+        fpath = os.path.join(directory, entry["file"])
+        try:
+            with open(fpath, "rb") as f:
+                data = f.read()
+        except OSError as e:
+            raise CheckpointError(
+                f"{directory}: shard {entry['file']} unreadable ({e})"
+            ) from None
+        sha = hashlib.sha256(data).hexdigest()
+        if sha != entry["sha256"]:
+            raise CheckpointError(
+                f"{directory}: shard {entry['file']} content hash "
+                f"mismatch (manifest {entry['sha256'][:8]}, file "
+                f"{sha[:8]}) — refusing to restore corrupt state")
+        arr = leaf_from_bytes(data)
+        if list(arr.shape) != list(tmpl.shape) \
+                or str(arr.dtype) != str(np.dtype(tmpl.dtype)):
+            raise CheckpointError(
+                f"{directory}: leaf {path_str} is "
+                f"{arr.shape}/{arr.dtype}, the live TrainState wants "
+                f"{tuple(tmpl.shape)}/{np.dtype(tmpl.dtype)} — model "
+                f"config mismatch")
+        leaves.append(arr)
+    return jax.tree_util.tree_unflatten(treedef, leaves), manifest
+
+
+def verify_checkpoint(directory: str) -> dict:
+    """Hash-verify every shard against the manifest without building a
+    state tree (the perf harness / repo integrity path). Returns the
+    manifest; raises CheckpointError on any mismatch."""
+    manifest = load_manifest(directory)
+    for entry in manifest["leaves"]:
+        fpath = os.path.join(directory, entry["file"])
+        try:
+            with open(fpath, "rb") as f:
+                data = f.read()
+        except OSError as e:
+            raise CheckpointError(
+                f"{directory}: shard {entry['file']} unreadable ({e})"
+            ) from None
+        if hashlib.sha256(data).hexdigest() != entry["sha256"]:
+            raise CheckpointError(
+                f"{directory}: shard {entry['file']} failed hash "
+                f"verification")
+    return manifest
+
+
+# ---------------------------------------------------------------- sweep ----
+# a save takes seconds; a manifest-less directory YOUNGER than this may
+# be a PEER replica's save still in flight (N controllers share the
+# checkpoint dir next to their shared SQLite file), so the boot sweep
+# must not rmtree it out from under them. Anything older is debris.
+TORN_MIN_AGE_S = 900.0
+
+
+def _dir_age_s(directory: str) -> float:
+    """Seconds since the NEWEST write anywhere in the directory (the
+    directory itself counts: an empty dir's own mtime is its age)."""
+    newest = os.path.getmtime(directory)
+    for fn in os.listdir(directory):
+        try:
+            newest = max(newest,
+                         os.path.getmtime(os.path.join(directory, fn)))
+        except OSError:
+            pass
+    return max(now_ts() - newest, 0.0)
+
+
+def sweep_torn(root_dir: str, min_age_s: float = TORN_MIN_AGE_S) -> list[str]:
+    """Boot hygiene: delete checkpoint directories a dead controller left
+    WITHOUT a readable manifest (the torn-save signature) plus any
+    stranded `.tmp-*` files inside complete ones. Returns the removed
+    paths. Restore never trusts these anyway (load_manifest refuses);
+    the sweep just reclaims the disk and keeps `koctl workload` listings
+    honest.
+
+    `min_age_s` is the multi-replica guard: a manifest-less directory
+    whose newest write is younger than this is treated as a PEER's save
+    still in flight, not debris — a booting replica must never rmtree a
+    live sibling's shards out from under its manifest write. Tests pass
+    0 to sweep their own fresh turds immediately."""
+    removed: list[str] = []
+    if not os.path.isdir(root_dir):
+        return removed
+    for name in sorted(os.listdir(root_dir)):
+        directory = os.path.join(root_dir, name)
+        if not os.path.isdir(directory):
+            continue
+        try:
+            load_manifest(directory)
+        except CheckpointError:
+            if _dir_age_s(directory) < min_age_s:
+                log.info("checkpoint dir %s has no manifest but was "
+                         "written recently — possibly a peer's in-flight "
+                         "save, leaving it", directory)
+                continue
+            shutil.rmtree(directory, ignore_errors=True)
+            removed.append(directory)
+            log.warning("swept torn checkpoint %s (no complete manifest)",
+                        directory)
+            continue
+        if _dir_age_s(directory) < min_age_s:
+            continue
+        for fn in sorted(os.listdir(directory)):
+            if ".tmp-" in fn:
+                try:
+                    os.unlink(os.path.join(directory, fn))
+                    removed.append(os.path.join(directory, fn))
+                except OSError:
+                    pass
+    return removed

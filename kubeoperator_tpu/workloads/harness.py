@@ -37,13 +37,14 @@ import time
 
 from kubeoperator_tpu.parallel.mesh import MeshSpec, format_axes
 from kubeoperator_tpu.parallel.validation_net import NetConfig
+from kubeoperator_tpu.workloads.partition import make_shard_and_gather_fns
 from kubeoperator_tpu.workloads.step import (
     WORKLOAD_AXES,
     analytic_step_flops,
     build_batch,
     compile_step,
     default_rules,
-    init_params,
+    init_train_state,
     make_train_step,
     param_shapes,
 )
@@ -56,31 +57,72 @@ ROW_SCHEMA = ("axis", "devices", "mesh", "mode", "steps", "steps_per_s",
 
 
 def run_training(mesh, cfg: NetConfig | None = None, steps: int = 4,
-                 mode: str = "auto", rules=None, seed: int = 0) -> dict:
+                 mode: str = "auto", rules=None, seed: int = 0,
+                 state=None, on_step=None, return_state: bool = False) -> dict:
     """One training run on one mesh: compile, step, fence, judge.
 
     Returns the full per-run record including ``windows`` — named
     (compile / steps) wall-clock windows the service layer persists as
-    the operation's step-window spans (the harness stays tracer-free)."""
+    the operation's step-window spans (the harness stays tracer-free).
+
+    Durable-training seams (ISSUE 11):
+
+    * ``state`` — a pre-placed TrainState ``{"params", "opt"}`` to
+      CONTINUE from (a restored checkpoint) instead of seeding fresh;
+      the batch is still built from ``seed``, so a resumed run walks the
+      exact trajectory the uninterrupted run would have (the loss-parity
+      contract the preemption drill pins).
+    * ``on_step(completed, loss)`` — called after every step with the
+      count of steps completed IN THIS RUN and the (device) loss; a
+      truthy return stops the run at this step boundary — the
+      cooperative checkpoint+drain hook the preemption-notice path pulls.
+      The loss argument is un-fetched; callers that block in the hook
+      (watchdog ticks) accept that the timed window then includes their
+      own work.
+    * ``return_state`` — ride the final (device) TrainState back on the
+      record under ``"state"`` so the caller can checkpoint it; the key
+      is not JSON and is popped before anything persists the record.
+
+    ``start_step``/``end_step`` in the record come from the state's own
+    step counter, so a resumed run says where in the workload's life it
+    ran, not just how many steps this process took."""
     import jax
 
     cfg = cfg or NetConfig()
     t_open = time.time()
     step_fn, specs, used = make_train_step(mesh, cfg, rules=rules, mode=mode)
-    params = init_params(mesh, cfg, seed=seed, specs=specs)
+    if state is None:
+        state = init_train_state(mesh, cfg, seed=seed, specs=specs)
+    else:
+        # a restored HOST TrainState: place it onto THIS mesh per the
+        # compiled layout (replicated for shard_map) — the re-place half
+        # of the checkpoint contract, which is also what lets a
+        # checkpoint saved on data=4 continue on a degraded data=2 mesh
+        from jax.sharding import PartitionSpec as P
+
+        place_specs = specs if specs is not None else \
+            jax.tree_util.tree_map(lambda _: P(), state)
+        shard_fn, _ = make_shard_and_gather_fns(mesh, place_specs)
+        state = shard_fn(state)
+    start_step = int(float(jax.device_get(state["params"]["step"])))
     x = build_batch(mesh, cfg, seed=seed + 1)
     # first call compiles AND is step 1; fence it out of the timed window
-    loss, params = step_fn(params, x)
+    loss, state = step_fn(state, x)
     device_losses = [loss]
     float(jax.device_get(loss))
-    float(jax.device_get(params["step"]))        # compile the end fence too
+    float(jax.device_get(state["params"]["step"]))  # compile the end fence too
     t_compiled = time.time()
+    stopped = bool(on_step and on_step(1, loss))
     t0 = time.perf_counter()
-    for _ in range(max(steps - 1, 0)):
-        loss, params = step_fn(params, x)
-        device_losses.append(loss)
+    if not stopped:
+        for _ in range(max(steps - 1, 0)):
+            loss, state = step_fn(state, x)
+            device_losses.append(loss)
+            if on_step and on_step(len(device_losses), loss):
+                stopped = True
+                break
     # the end fence: a scalar that data-depends on the LAST update
-    float(jax.device_get(params["step"]))
+    end_step = int(float(jax.device_get(state["params"]["step"])))
     dt = time.perf_counter() - t0
     t_done = time.time()
 
@@ -90,12 +132,15 @@ def run_training(mesh, cfg: NetConfig | None = None, steps: int = 4,
     steps_per_s = round((len(losses) - 1) / dt, 3) if dt > 0 else 0.0
     tflops = round(steps_per_s * analytic_step_flops(mesh, cfg) / 1e12, 4)
     mesh_shape = {a: int(mesh.shape[a]) for a in mesh.axis_names}
-    return {
+    record = {
         "ok": finite and descending,
         "finite": finite,
         "descending": descending,
         "losses": [round(l, 6) for l in losses],
         "steps": len(losses),
+        "start_step": start_step,
+        "end_step": end_step,
+        "stopped_early": stopped,
         "steps_per_s": steps_per_s,
         "model_tflops_per_s": tflops,
         "mode": used,
@@ -109,6 +154,9 @@ def run_training(mesh, cfg: NetConfig | None = None, steps: int = 4,
                        "steps_per_s": steps_per_s}},
         ],
     }
+    if return_state:
+        record["state"] = state
+    return record
 
 
 def sweep_specs(n_devices: int, axes=WORKLOAD_AXES) -> list[MeshSpec]:

@@ -18,6 +18,17 @@ under BOTH paths —
   documented trade of the fallback, not a bug — a workload that wants
   tensor parallelism writes rules and gets pjit.
 
+Training state (ISSUE 11): the step closes over a REAL optax adamw
+optimizer and the unit the seam compiles over is the full TrainState
+tree ``{"params": ..., "opt": ...}`` — mu/nu moment trees mirror the
+param tree leaf-for-leaf, so the SAME partition rules that shard
+``params/wqkv`` shard ``opt/0/mu/wqkv`` (the regex engine matches the
+``/``-joined path suffix), and the adamw ``count`` scalar rides the
+engine's scalar exemption exactly like the non-trainable step counter.
+One rule list therefore lays out params AND optimizer state; that is
+what makes the sharded checkpoint (workloads/checkpoint.py) a faithful
+resume point instead of a params-only snapshot.
+
 The validation net's pp/sp families (pipeline ppermute, ring attention,
 MoE all_to_all) are deliberately NOT here: they are written against
 per-device collectives and live in validation_net's shard_map-only step.
@@ -43,6 +54,13 @@ from kubeoperator_tpu.workloads.partition import (
 WORKLOAD_AXES = ("data", "fsdp", "tp")
 # the axes that shard the batch (and join the loss/grad reductions)
 DATA_AXES = ("data", "fsdp")
+
+# adamw scale for THIS workload: NetConfig.lr is the validation net's
+# SGD-family step (0.1 at the default dims), an order of magnitude too
+# hot for adam's normalized updates — 1e-2 descends monotonically on the
+# default config, which the harness's descending-loss verdict requires
+ADAMW_LR = 1e-2
+ADAMW_WEIGHT_DECAY = 1e-4
 
 
 def default_rules():
@@ -99,16 +117,57 @@ def build_host_params(cfg: NetConfig | None = None, seed: int = 0) -> dict:
     return out
 
 
-def init_params(mesh, cfg: NetConfig | None = None, seed: int = 0,
-                specs=None):
-    """Host params placed onto `mesh`: per the spec tree when given
+def make_optimizer(lr: float | None = None):
+    """THE workload optimizer: optax adamw whose weight decay is masked
+    off the tree's scalars (the non-trainable step counter must neither
+    decay nor accumulate moments — its gradient is structurally zero, so
+    masking decay is the whole exemption). Constructed in one place so
+    the step, the state-shape derivation, and checkpoint restore can
+    never disagree about the optimizer's state structure."""
+    import jax
+    import optax
+
+    def no_scalar_decay(params):
+        return jax.tree_util.tree_map(lambda l: len(l.shape) > 0, params)
+
+    return optax.adamw(ADAMW_LR if lr is None else lr,
+                       weight_decay=ADAMW_WEIGHT_DECAY,
+                       mask=no_scalar_decay)
+
+
+def train_state_shapes(cfg: NetConfig | None = None) -> dict:
+    """Abstract TrainState tree ``{"params", "opt"}`` — what the rule
+    engine lays out and `explain_rules` reports over: the adamw mu/nu
+    trees surface here with the SAME leaf names as the params (matched
+    by the same rules), and `opt/0/count` is a 0-d leaf the scalar
+    exemption claims. Derived via `jax.eval_shape` so no weight is ever
+    materialized."""
+    import jax
+
+    cfg = cfg or NetConfig()
+    params = param_shapes(cfg)
+    return {"params": params,
+            "opt": jax.eval_shape(make_optimizer().init, params)}
+
+
+def build_host_state(cfg: NetConfig | None = None, seed: int = 0) -> dict:
+    """numpy TrainState (host-built, backend-hermetic): seeded params +
+    the optimizer's real zero-initialized state."""
+    cfg = cfg or NetConfig()
+    params = build_host_params(cfg, seed)
+    return {"params": params, "opt": make_optimizer().init(params)}
+
+
+def init_train_state(mesh, cfg: NetConfig | None = None, seed: int = 0,
+                     specs=None):
+    """Host TrainState placed onto `mesh`: per the spec tree when given
     (pjit path), replicated otherwise (shard_map path). Values are
     identical either way — placement is layout, not math."""
     import jax
     from jax.sharding import PartitionSpec as P
 
     cfg = cfg or NetConfig()
-    host = build_host_params(cfg, seed)
+    host = build_host_state(cfg, seed)
     if specs is None:
         specs = jax.tree_util.tree_map(lambda _: P(), host)
     shard_fn, _ = make_shard_and_gather_fns(mesh, specs)
@@ -168,14 +227,18 @@ def _forward(p, x, cfg: NetConfig):
     return hx @ p["w_head"]
 
 
-def _sgd(p, grads, lr):
-    import jax
+def _apply_update(optimizer, state, grads):
+    """adamw update over the TrainState: moments/count advance inside the
+    compiled step, and the non-trainable scalar counter rides outside the
+    gradient flow — proving scalars cross both compile paths
+    unpartitioned AND unoptimized."""
+    import optax
 
-    new_p = jax.tree_util.tree_map(lambda a, g: a - lr * g, p, grads)
-    # the scalar rides outside the gradient flow: a plain step counter,
-    # proving scalars cross both compile paths unpartitioned
-    new_p["step"] = p["step"] + 1.0
-    return new_p
+    updates, new_opt = optimizer.update(grads, state["opt"],
+                                        state["params"])
+    new_p = optax.apply_updates(state["params"], updates)
+    new_p["step"] = state["params"]["step"] + 1.0
+    return {"params": new_p, "opt": new_opt}
 
 
 def analytic_step_flops(mesh, cfg: NetConfig | None = None) -> float:
@@ -201,10 +264,12 @@ def analytic_step_flops(mesh, cfg: NetConfig | None = None) -> float:
 def compile_step(mesh, cfg: NetConfig | None = None, specs=None,
                  mode: str = "auto", lr: float | None = None):
     """THE compile seam (SNIPPETS.md [3]): returns ``(step_fn, used)``
-    where ``step_fn(params, x) -> (loss, new_params)`` and ``used`` is
-    the path actually compiled. ``mode`` is ``auto`` (prefer pjit when
-    explicit shardings exist, else shard_map), or a forced ``pjit`` /
-    ``shard_map``."""
+    where ``step_fn(state, x) -> (loss, new_state)`` over the TrainState
+    tree ``{"params", "opt"}`` and ``used`` is the path actually
+    compiled. ``specs`` is the TrainState spec tree from the partition
+    rules — params AND optimizer state shard under the one seam. ``mode``
+    is ``auto`` (prefer pjit when explicit shardings exist, else
+    shard_map), or a forced ``pjit`` / ``shard_map``."""
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -213,12 +278,19 @@ def compile_step(mesh, cfg: NetConfig | None = None, specs=None,
     from kubeoperator_tpu.parallel.mesh import shard_map_compat
 
     cfg = cfg or NetConfig()
-    lr = cfg.lr if lr is None else lr
+    optimizer = make_optimizer(lr)
     for axis in WORKLOAD_AXES:
         if axis not in mesh.shape:
             raise PartitionError(
                 f"workload mesh must carry the {WORKLOAD_AXES} axes, "
                 f"got {tuple(mesh.axis_names)}")
+    if specs is not None and (not isinstance(specs, dict)
+                              or set(specs) != {"params", "opt"}):
+        raise PartitionError(
+            "compile_step shards the full TrainState: specs must be the "
+            "{'params', 'opt'} tree from "
+            "match_partition_rules(rules, train_state_shapes()) — a "
+            "params-only spec tree leaves the optimizer state unlaid-out")
     if mode == "auto":
         mode = "pjit" if specs is not None else "shard_map"
     data = int(mesh.shape["data"])
@@ -235,33 +307,35 @@ def compile_step(mesh, cfg: NetConfig | None = None, specs=None,
                 "compile mode 'pjit' needs explicit shardings — run the "
                 "partition rules first, or use mode 'shard_map'")
 
-        def global_step(p, xb):
-            loss, grads = jax.value_and_grad(loss_fn)(p, xb)
-            return loss, _sgd(p, grads, lr)
+        def global_step(state, xb):
+            loss, grads = jax.value_and_grad(loss_fn)(state["params"], xb)
+            return loss, _apply_update(optimizer, state, grads)
 
-        param_sh = jax.tree_util.tree_map(
+        state_sh = jax.tree_util.tree_map(
             lambda s: NamedSharding(mesh, s), specs)
         x_sh = NamedSharding(mesh, P(DATA_AXES, None, None))
         loss_sh = NamedSharding(mesh, P())
         return jax.jit(
             global_step,
-            in_shardings=(param_sh, x_sh),
-            out_shardings=(loss_sh, param_sh),
+            in_shardings=(state_sh, x_sh),
+            out_shardings=(loss_sh, state_sh),
         ), "pjit"
 
     if mode != "shard_map":
         raise PartitionError(
             f"unknown compile mode {mode!r} (auto|pjit|shard_map)")
 
-    def local_step(p, xb):
-        # params replicated, xb is this device's (data, fsdp) batch
+    def local_step(state, xb):
+        # state replicated, xb is this device's (data, fsdp) batch
         # shard; each local term is already divided by the GLOBAL count,
         # so the psum of partial losses/grads IS the global mean — the
-        # same value the pjit path computes, modulo summation order
-        loss, grads = jax.value_and_grad(loss_fn)(p, xb)
+        # same value the pjit path computes, modulo summation order. The
+        # optimizer then applies identical psum'd grads on every rank, so
+        # the replicated moments stay bit-identical across ranks.
+        loss, grads = jax.value_and_grad(loss_fn)(state["params"], xb)
         loss = lax.psum(loss, DATA_AXES)
         grads = lax.psum(grads, DATA_AXES)
-        return loss, _sgd(p, grads, lr)
+        return loss, _apply_update(optimizer, state, grads)
 
     fn = shard_map_compat(
         local_step, mesh,
@@ -273,16 +347,18 @@ def compile_step(mesh, cfg: NetConfig | None = None, specs=None,
 
 def make_train_step(mesh, cfg: NetConfig | None = None, rules=None,
                     mode: str = "auto", lr: float | None = None):
-    """Rules → specs → compiled step, in one call: returns
-    ``(step_fn, specs_or_None, used_mode)``. `specs` is None exactly when
-    the shard_map fallback compiled (no explicit shardings exist)."""
+    """Rules → TrainState specs → compiled step, in one call: returns
+    ``(step_fn, specs_or_None, used_mode)``. `specs` covers params AND
+    optimizer state (matched against `train_state_shapes`), and is None
+    exactly when the shard_map fallback compiled (no explicit shardings
+    exist)."""
     cfg = cfg or NetConfig()
     if mode == "shard_map":
         specs = None
     else:
         specs = match_partition_rules(
             rules if rules is not None else default_rules(),
-            param_shapes(cfg))
+            train_state_shapes(cfg))
     step, used = compile_step(mesh, cfg, specs=specs, mode=mode, lr=lr)
     if used == "shard_map":
         specs = None

@@ -456,6 +456,32 @@ def write_artifacts(results: dict, round_no: int,
                 f"{row['mode']} | {row['steps_per_s']} | "
                 f"{row['model_tflops_per_s']} | "
                 f"{row['scaling_efficiency_pct']}% |")
+    # durable-training checkpoint rows (`perf_matrix.py --checkpoint`,
+    # docs/workloads.md "Checkpoints"): rendered from the newest round
+    checkpoint_rounds = history.get("checkpoint") or {}
+    if checkpoint_rounds:
+        ck_round = str(max(int(k) for k in checkpoint_rounds))
+        lines += [
+            "",
+            f"## checkpoint (round {ck_round})",
+            "",
+            "Sharded TrainState checkpoint save/verify/restore "
+            "(`python perf_matrix.py --checkpoint`): the tier-1",
+            "8-device mesh's full params+adamw state written as "
+            "content-hashed per-leaf shards (manifest last), hash-",
+            "verified, and restored — the durable-training path's "
+            "round-over-round throughput trace.",
+            "",
+            "| leaves | MB | save (s) | save MB/s | verify (s) | "
+            "restore (s) | restore MB/s | exact |",
+            "|---|---|---|---|---|---|---|---|",
+        ]
+        for row in checkpoint_rounds[ck_round].get("rows", []):
+            lines.append(
+                f"| {row['leaves']} | {row['mbytes']} | {row['save_s']} | "
+                f"{row['save_mb_s']} | {row['verify_s']} | "
+                f"{row['restore_s']} | {row['restore_mb_s']} | "
+                f"{'yes' if row['round_trip_exact'] else 'NO'} |")
     if traces:
         lines += [
             "",
@@ -574,6 +600,75 @@ def record_multislice(report: dict, round_no: int | None = None) -> int:
     return _record_section("multislice", report, round_no)
 
 
+def run_checkpoint() -> dict:
+    """The CI face of the durable-training checkpoint path (ISSUE 11):
+    save + hash-verify + restore one full TrainState (params + adamw
+    state) on the tier-1 8-device mesh, committed as throughput rows so
+    the sharded-checkpoint path has a round-over-round regression trace
+    like everything else. Wall-clock numbers are tmpfs-or-disk local
+    I/O + sha256 — the shard/gather math itself is the workload
+    subsystem's, measured by --workloads."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flag = "--xla_force_host_platform_device_count=8"
+    if flag not in os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "") + " " + flag).strip()
+    import tempfile
+    import time as _time
+
+    import jax
+    import numpy as np
+
+    from kubeoperator_tpu.parallel.mesh import MeshSpec
+    from kubeoperator_tpu.workloads.checkpoint import (
+        restore_checkpoint,
+        save_checkpoint,
+        verify_checkpoint,
+    )
+    from kubeoperator_tpu.workloads.harness import run_training
+    from kubeoperator_tpu.workloads.step import train_state_shapes
+
+    mesh = MeshSpec.parse("data=2,fsdp=4,tp=1").build()
+    run = run_training(mesh, steps=2, mode="auto", seed=0,
+                       return_state=True)
+    state = run.pop("state")
+    host = jax.tree_util.tree_map(
+        lambda l: np.asarray(jax.device_get(l)), state)
+    with tempfile.TemporaryDirectory(prefix="ko-ckpt-perf-") as root:
+        t0 = _time.perf_counter()
+        manifest = save_checkpoint(root, host, step=2, target_steps=2,
+                                   mesh=run["mesh"], seed=0)
+        save_s = _time.perf_counter() - t0
+        t0 = _time.perf_counter()
+        verify_checkpoint(manifest["dir"])
+        verify_s = _time.perf_counter() - t0
+        t0 = _time.perf_counter()
+        back, _man = restore_checkpoint(manifest["dir"],
+                                        train_state_shapes())
+        restore_s = _time.perf_counter() - t0
+        exact = all(
+            np.array_equal(a, b)
+            for a, b in zip(jax.tree_util.tree_leaves(host),
+                            jax.tree_util.tree_leaves(back)))
+    mb = manifest["total_bytes"] / 1e6
+    row = {
+        "leaves": len(manifest["leaves"]),
+        "mbytes": round(mb, 3),
+        "save_s": round(save_s, 4),
+        "save_mb_s": round(mb / save_s, 1) if save_s > 0 else 0.0,
+        "verify_s": round(verify_s, 4),
+        "restore_s": round(restore_s, 4),
+        "restore_mb_s": round(mb / restore_s, 1) if restore_s > 0 else 0.0,
+        "round_trip_exact": exact,
+    }
+    return {"ok": exact, "rows": [row]}
+
+
+def record_checkpoint(report: dict, round_no: int | None = None) -> int:
+    """`perf_matrix.py --checkpoint` hook."""
+    return _record_section("checkpoint", report, round_no)
+
+
 def record_loadtest(rows: dict, round_no: int | None = None) -> int:
     """`koctl loadtest --record-perf` hook (rows keyed by replica
     count)."""
@@ -595,7 +690,18 @@ def main(argv: list | None = None) -> int:
                         help="run ONLY the 2-slice DCN psum smoke "
                              "(4 CPU worker processes, 2 per slice) and "
                              "record its row under the round")
+    parser.add_argument("--checkpoint", action="store_true",
+                        help="run ONLY the sharded-checkpoint "
+                             "save/verify/restore throughput pass "
+                             "(8 virtual CPU devices) and record its "
+                             "row under the round")
     args = parser.parse_args(argv)
+    if args.checkpoint:
+        report = run_checkpoint()
+        round_no = record_checkpoint(report, args.round)
+        print(json.dumps({"round": round_no, "checkpoint": report},
+                         indent=2))
+        return 0 if report["ok"] else 1
     if args.multislice:
         report = run_multislice()
         round_no = record_multislice(report, args.round)

@@ -1135,6 +1135,93 @@ class TestPhaseWriteDiscipline:  # KO-P007
                             rel="service/x.py") == []
 
 
+class TestAtomicWriteDiscipline:  # KO-P011
+    def test_fires_on_bare_write_open_in_checkpoint_module(self, tmp_path):
+        src = (
+            "def save(path, data):\n"
+            "    with open(path, 'wb') as f:\n"
+            "        f.write(data)\n"
+        )
+        findings = ast_findings(tmp_path, src, "KO-P011",
+                                rel="workloads/checkpoint.py")
+        assert [f.rule for f in findings] == ["KO-P011"]
+        assert "tmp+rename" in findings[0].message
+
+    def test_fires_on_write_text_and_json_dump(self, tmp_path):
+        src = (
+            "import json\n"
+            "def save(path, obj, p):\n"
+            "    p.write_text('x')\n"
+            "    with open(path) as f:\n"
+            "        json.dump(obj, f)\n"
+        )
+        findings = ast_findings(tmp_path, src, "KO-P011",
+                                rel="workloads/checkpoint.py")
+        assert [f.rule for f in findings] == ["KO-P011", "KO-P011"]
+
+    def test_atomic_helper_and_reads_are_quiet(self, tmp_path):
+        src = (
+            "import os\n"
+            "def atomic_write_bytes(path, data):\n"
+            "    tmp = path + '.tmp'\n"
+            "    with open(tmp, 'wb') as f:\n"
+            "        f.write(data)\n"
+            "        os.fsync(f.fileno())\n"
+            "    os.replace(tmp, path)\n"
+            "def _atomic_json(path, blob):\n"
+            "    with open(path + '.t', 'w') as f:\n"
+            "        f.write(blob)\n"
+            "def load(path):\n"
+            "    with open(path, 'rb') as f:\n"
+            "        return f.read()\n"
+            "def save(path, data):\n"
+            "    atomic_write_bytes(path, data)\n"
+        )
+        assert ast_findings(tmp_path, src, "KO-P011",
+                            rel="workloads/checkpoint.py") == []
+
+    def test_non_checkpoint_modules_and_waivers_are_exempt(self, tmp_path):
+        src = (
+            "def save(path, data):\n"
+            "    with open(path, 'w') as f:\n"
+            "        f.write(data)\n"
+        )
+        assert ast_findings(tmp_path, src, "KO-P011",
+                            rel="service/x.py") == []
+        waived = (
+            "def save(path, data):\n"
+            "    # KO-P011: waived — debug dump, never restored from\n"
+            "    with open(path, 'w') as f:\n"
+            "        f.write(data)\n"
+        )
+        assert ast_findings(tmp_path, waived, "KO-P011",
+                            rel="workloads/checkpoint.py") == []
+        # a mode that cannot be PROVEN a write stays quiet
+        dynamic = (
+            "def touch(path, mode):\n"
+            "    with open(path, mode) as f:\n"
+            "        return f\n"
+        )
+        assert ast_findings(tmp_path, dynamic, "KO-P011",
+                            rel="workloads/checkpoint.py") == []
+
+    def test_real_checkpoint_module_is_clean(self):
+        """The shipped workloads/checkpoint.py must satisfy its own rule
+        (the helper itself is exempt by name)."""
+        import kubeoperator_tpu
+        from kubeoperator_tpu.analysis.astcheck import (
+            check_checkpoint_atomic_writes,
+        )
+        import ast as _ast
+        import os as _os
+
+        root = _os.path.dirname(kubeoperator_tpu.__file__)
+        path = _os.path.join(root, "workloads", "checkpoint.py")
+        with open(path, encoding="utf-8") as f:
+            tree = _ast.parse(f.read())
+        assert check_checkpoint_atomic_writes(root, tree, path) == []
+
+
 # ------------------------------------------------------- contract rules ----
 def index_for(tmp_path, files: dict):
     """Build a ProjectIndex over a fixture tree (the injection path the

@@ -1,0 +1,449 @@
+"""Durable training checkpoints (ISSUE 11): the atomic-write contract,
+the ControllerDeath-at-every-save-step crash matrix, torn-checkpoint
+hygiene, index/retention, the `--resume` surfaces on both transports,
+and the reconciler's checkpoint-aware orphan sweep."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from kubeoperator_tpu.resilience.chaos import ControllerDeath
+from kubeoperator_tpu.service import build_services
+from kubeoperator_tpu.utils.config import load_config
+from kubeoperator_tpu.utils.errors import NotFoundError, ValidationError
+from kubeoperator_tpu.workloads import checkpoint as cp
+
+
+def host_state(seed=0):
+    from kubeoperator_tpu.workloads.step import build_host_state
+
+    return build_host_state(seed=seed)
+
+
+# ---------------------------------------------------------- file layer -----
+class TestAtomicWrites:
+    def test_atomic_write_lands_whole_and_leaves_no_tmp(self, tmp_path):
+        path = str(tmp_path / "x.bin")
+        cp.atomic_write_bytes(path, b"abc123")
+        assert open(path, "rb").read() == b"abc123"
+        assert [f for f in os.listdir(tmp_path) if ".tmp-" in f] == []
+
+    def test_manifest_is_written_last_and_is_the_completeness_bit(
+            self, tmp_path):
+        man = cp.save_checkpoint(str(tmp_path), host_state(), step=1)
+        files = sorted(os.listdir(man["dir"]))
+        assert "manifest.json" in files
+        assert len(files) == len(man["leaves"]) + 1
+        # removing the manifest makes the directory a NON-checkpoint
+        os.unlink(os.path.join(man["dir"], "manifest.json"))
+        with pytest.raises(cp.CheckpointError, match="not a"):
+            cp.load_manifest(man["dir"])
+
+    def test_round_trip_is_bit_exact_including_optimizer_state(
+            self, tmp_path):
+        import jax
+
+        from kubeoperator_tpu.workloads.step import train_state_shapes
+
+        state = host_state(seed=3)
+        man = cp.save_checkpoint(str(tmp_path), state, step=0)
+        back, man2 = cp.restore_checkpoint(man["dir"],
+                                           train_state_shapes())
+        assert man2["id"] == man["id"]
+        for a, b in zip(jax.tree_util.tree_leaves(state),
+                        jax.tree_util.tree_leaves(back)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_corrupt_shard_and_model_mismatch_fail_loudly(self, tmp_path):
+        from kubeoperator_tpu.workloads.step import train_state_shapes
+
+        man = cp.save_checkpoint(str(tmp_path), host_state(), step=0)
+        shard = os.path.join(man["dir"], man["leaves"][3]["file"])
+        blob = bytearray(open(shard, "rb").read())
+        blob[-1] ^= 1
+        open(shard, "wb").write(bytes(blob))
+        with pytest.raises(cp.CheckpointError, match="hash"):
+            cp.restore_checkpoint(man["dir"], train_state_shapes())
+        with pytest.raises(cp.CheckpointError, match="hash"):
+            cp.verify_checkpoint(man["dir"])
+        # a template from a different model names the mismatch instead
+        # of restoring silently-wrong state
+        man2 = cp.save_checkpoint(str(tmp_path), host_state(), step=0)
+        like = train_state_shapes()
+        like["params"]["brand_new"] = like["params"]["wqkv"]
+        with pytest.raises(cp.CheckpointError, match="brand_new"):
+            cp.restore_checkpoint(man2["dir"], like)
+
+    def test_sweep_torn_removes_manifestless_dirs_only(self, tmp_path):
+        complete = cp.save_checkpoint(str(tmp_path), host_state(), step=0)
+        torn = tmp_path / "torn-save"
+        torn.mkdir()
+        (torn / "params-w-deadbeef.npy").write_bytes(b"partial")
+        (torn / "params-x-deadbeef.npy.tmp-99").write_bytes(b"mid-write")
+        removed = cp.sweep_torn(str(tmp_path), min_age_s=0)
+        assert str(torn) in removed
+        assert not torn.exists()
+        assert os.path.isfile(os.path.join(complete["dir"],
+                                           "manifest.json"))
+
+    def test_sweep_leaves_a_peers_fresh_inflight_save_alone(self, tmp_path):
+        """Multi-replica guard: a manifest-less directory written
+        RECENTLY may be a live peer's save mid-flight (N controllers
+        share the checkpoint dir next to their shared SQLite file) —
+        the default-age sweep must not rmtree it; an aged one is
+        debris."""
+        fresh = tmp_path / "peer-inflight"
+        fresh.mkdir()
+        (fresh / "params-w-cafe.npy").write_bytes(b"shard")
+        assert cp.sweep_torn(str(tmp_path)) == []
+        assert fresh.exists()
+        # age every path older than the guard: now it is debris
+        old = 1_000_000.0
+        os.utime(fresh / "params-w-cafe.npy", (old, old))
+        os.utime(fresh, (old, old))
+        assert str(fresh) in cp.sweep_torn(str(tmp_path))
+        assert not fresh.exists()
+
+
+class TestCrashAtEverySaveStep:
+    def test_controller_death_never_leaves_a_restorable_torn_checkpoint(
+            self, tmp_path, monkeypatch):
+        """The ISSUE 11 regression matrix: kill (ControllerDeath, the
+        BaseException a real SIGKILL simulates) at EVERY atomic-write
+        boundary of a save. After each crash the directory must be
+        either absent from restore's view (no manifest → torn, swept at
+        boot) or fully complete — never a half-checkpoint a restore
+        trusts."""
+        state = host_state()
+        # count the writes of one clean save: N shards + 1 manifest
+        clean_dir = tmp_path / "clean"
+        man = cp.save_checkpoint(str(clean_dir), state, step=1)
+        total_writes = len(man["leaves"]) + 1
+        real_write = cp.atomic_write_bytes
+
+        for die_at in range(1, total_writes + 1):
+            root = tmp_path / f"crash-{die_at}"
+            calls = {"n": 0}
+
+            def dying_write(path, data, _die_at=die_at, _calls=calls):
+                _calls["n"] += 1
+                if _calls["n"] == _die_at:
+                    raise ControllerDeath(
+                        f"simulated death at save write {_die_at}")
+                real_write(path, data)
+
+            monkeypatch.setattr(cp, "atomic_write_bytes", dying_write)
+            with pytest.raises(ControllerDeath):
+                cp.save_checkpoint(str(root), state, step=1)
+            monkeypatch.setattr(cp, "atomic_write_bytes", real_write)
+
+            subdirs = [d for d in os.listdir(root)
+                       if os.path.isdir(os.path.join(root, d))]
+            assert len(subdirs) == 1
+            directory = os.path.join(root, subdirs[0])
+            # the manifest is strictly LAST and the dying write raised
+            # BEFORE the rename, so at every crash point — mid-shards or
+            # at the manifest itself — no manifest exists: restore
+            # refuses the directory and the boot sweep removes it
+            # (min_age_s=0: this test IS the dead controller, no peers)
+            with pytest.raises(cp.CheckpointError):
+                cp.load_manifest(directory)
+            removed = cp.sweep_torn(str(root), min_age_s=0)
+            assert directory in removed
+            assert not os.path.isdir(directory)
+
+    def test_death_after_manifest_rename_is_a_complete_checkpoint(
+            self, tmp_path):
+        """The only crash point AFTER which the save is durable: the
+        manifest landed. verify + restore must both succeed."""
+        from kubeoperator_tpu.workloads.step import train_state_shapes
+
+        man = cp.save_checkpoint(str(tmp_path), host_state(), step=2)
+        # (a crash here loses only the in-memory return value)
+        assert cp.verify_checkpoint(man["dir"])["step"] == 2
+        state, _ = cp.restore_checkpoint(man["dir"], train_state_shapes())
+        assert float(state["params"]["step"]) == 0.0  # saved pre-train
+
+
+# ------------------------------------------------------- service layer -----
+def wl_stack(tmp_path, db="ck.db", **overrides):
+    config = load_config(path="/nonexistent", env={}, overrides={
+        "db": {"path": str(tmp_path / db)},
+        "logging": {"level": "ERROR"},
+        "executor": {"backend": "simulation"},
+        "provisioner": {"work_dir": str(tmp_path / "tf")},
+        "cron": {"backup_enabled": False, "health_check_interval_s": 0,
+                 "event_sync_interval_s": 0},
+        "cluster": {"kubeconfig_dir": str(tmp_path / "kc")},
+        **overrides,
+    })
+    return build_services(config, simulate=True)
+
+
+class TestServiceCheckpoints:
+    def test_checkpoint_dir_defaults_next_to_the_db(self, tmp_path):
+        svc = wl_stack(tmp_path)
+        try:
+            assert svc.workloads.ckpt_dir == str(tmp_path / "checkpoints")
+        finally:
+            svc.close()
+
+    def test_every_run_saves_and_indexes_a_checkpoint(self, tmp_path):
+        svc = wl_stack(tmp_path)
+        try:
+            out = svc.workloads.train(mesh="data=2,fsdp=2", steps=3)
+            ckpt = out["checkpoint"]
+            assert ckpt["step"] == 3 and ckpt["target_steps"] == 3
+            row = svc.repos.checkpoints.get(ckpt["id"])
+            assert row.status == "complete" and row.op_id == out["id"]
+            assert row.manifest_sha
+            # index surface, newest first
+            listed = svc.workloads.checkpoints()
+            assert listed[0]["id"] == ckpt["id"]
+        finally:
+            svc.close()
+
+    def test_retention_prunes_directories_keeps_rows(self, tmp_path):
+        svc = wl_stack(tmp_path, **{"checkpoint": {"keep": 2}})
+        try:
+            ids = [svc.workloads.train(mesh="data=2", steps=2)
+                   ["checkpoint"]["id"] for _ in range(4)]
+            rows = {c.id: c for c in svc.repos.checkpoints.find()}
+            assert [rows[i].status for i in ids] == [
+                "pruned", "pruned", "complete", "complete"]
+            for i in ids[:2]:
+                assert not os.path.isdir(rows[i].dir)
+            for i in ids[2:]:
+                assert os.path.isfile(os.path.join(rows[i].dir,
+                                                   "manifest.json"))
+        finally:
+            svc.close()
+
+    def test_resume_validation_and_resolution(self, tmp_path):
+        svc = wl_stack(tmp_path)
+        try:
+            with pytest.raises(ValidationError, match="resume"):
+                svc.workloads.train(checkpoint="abc123")
+            with pytest.raises(NotFoundError):
+                svc.workloads.train(resume=True)   # nothing saved yet
+            out = svc.workloads.train(mesh="data=2,fsdp=2", steps=2)
+            with pytest.raises(NotFoundError):
+                svc.workloads.train(resume=True, checkpoint="ffffff")
+            # resume by >=6-char prefix; mesh defaults to the ckpt's own
+            res = svc.workloads.train(
+                resume=True, checkpoint=out["checkpoint"]["id"][:8],
+                steps=1)
+            assert res["mesh"] == out["mesh"]
+            assert res["resumed_from"] == out["checkpoint"]["id"]
+            # a disabled store still trains, saves nothing
+            svc.workloads.ckpt_enabled = False
+            bare = svc.workloads.train(mesh="data=2", steps=2)
+            assert bare["checkpoint"] is None
+        finally:
+            svc.close()
+
+    def test_boot_sweeps_torn_dirs_and_flips_vanished_rows(self, tmp_path):
+        svc = wl_stack(tmp_path)
+        out = svc.workloads.train(mesh="data=2", steps=2)
+        ckpt = out["checkpoint"]
+        # a dead controller's torn save + a row whose dir vanished; the
+        # torn dir is AGED past the peer guard so the boot sweep owns it
+        torn = os.path.join(svc.workloads.ckpt_dir, "torn-xyz")
+        os.makedirs(torn)
+        with open(os.path.join(torn, "params-w-feed.npy"), "wb") as f:
+            f.write(b"partial")
+        old = 1_000_000.0
+        os.utime(os.path.join(torn, "params-w-feed.npy"), (old, old))
+        os.utime(torn, (old, old))
+        import shutil
+
+        shutil.rmtree(ckpt["dir"])
+        svc.close()
+
+        svc2 = wl_stack(tmp_path)
+        try:
+            assert torn in svc2.checkpoint_sweep_report
+            assert not os.path.isdir(torn)
+            assert svc2.repos.checkpoints.get(ckpt["id"]).status == "swept"
+            assert svc2.repos.checkpoints.latest_complete() is None
+        finally:
+            svc2.close()
+
+    def test_drain_closes_op_succeeded_with_resume_pointer(self, tmp_path):
+        svc = wl_stack(tmp_path)
+        try:
+            svc.workloads.step_hook = lambda i, loss: (
+                svc.workloads.request_drain("test") if i == 1 else None)
+            out = svc.workloads.train(mesh="data=2,fsdp=2", steps=5)
+            svc.workloads.step_hook = None
+            assert out["status"] == "Succeeded" and out["drained"]
+            assert "resume" in out["message"]
+            assert out["checkpoint"]["step"] == 1
+            assert out["checkpoint"]["target_steps"] == 5
+            # the drain flag is consumed: the next run trains fully
+            again = svc.workloads.train(mesh="data=2,fsdp=2", steps=2)
+            assert not again["drained"]
+        finally:
+            svc.close()
+
+
+class TestReconcilerResume:
+    def test_orphan_with_checkpoint_names_it_and_auto_resumes(
+            self, tmp_path):
+        """Controller dies mid-train AFTER a checkpoint landed: the boot
+        sweep names the checkpoint as the resume point, and with
+        auto_resume on the workload resumes to completion by itself —
+        real step/optimizer state, not a re-seed."""
+        from kubeoperator_tpu.models import OperationStatus
+
+        svc = wl_stack(tmp_path)
+        drained = None
+        try:
+            svc.workloads.step_hook = lambda i, loss: (
+                svc.workloads.request_drain("pretend notice")
+                if i == 2 else None)
+            drained = svc.workloads.train(mesh="data=2,fsdp=2", steps=6)
+        finally:
+            svc.workloads.step_hook = None
+        # now strand an open op (the controller "dies" holding it)
+        orphan_id = svc.journal.open_scoped(
+            "workload-train", vars={"mesh": {"data": 2, "fsdp": 2}},
+            scope="workload").id
+        svc.close()
+
+        svc2 = wl_stack(
+            tmp_path, **{"resilience": {"reconcile": {"auto_resume": True}}})
+        try:
+            op = svc2.journal.operation(orphan_id)
+            assert op.status == OperationStatus.INTERRUPTED.value
+            ckpt_id = drained["checkpoint"]["id"]
+            assert op.resume_phase == f"checkpoint:{ckpt_id[:8]}"
+            assert "--resume" in op.message
+            swept = [r for r in svc2.boot_report if r["op"] == orphan_id]
+            assert swept and swept[0]["resumed"] is True
+            # the resume runs on a BACKGROUND thread (boot must not
+            # block behind a compile+train); join it before asserting
+            svc2.workloads.wait_all()
+            # the auto-resume finished the run: 6 total steps reached
+            resumed_ops = [
+                o for o in svc2.repos.operations.find(
+                    kind="workload-train")
+                if o.vars.get("resumed_from") == ckpt_id]
+            assert len(resumed_ops) == 1
+            result = resumed_ops[0].vars["result"]
+            assert result["start_step"] == 2 and result["end_step"] == 6
+            assert resumed_ops[0].status == "Succeeded"
+        finally:
+            svc2.close()
+
+    def test_orphan_without_checkpoint_keeps_rerun_wording(self, tmp_path):
+        from kubeoperator_tpu.models import OperationStatus
+
+        svc = wl_stack(tmp_path)
+        orphan_id = svc.journal.open_scoped(
+            "workload-train", scope="workload").id
+        svc.close()
+        svc2 = wl_stack(
+            tmp_path, **{"resilience": {"reconcile": {"auto_resume": True}}})
+        try:
+            op = svc2.journal.operation(orphan_id)
+            assert op.status == OperationStatus.INTERRUPTED.value
+            assert op.resume_phase == ""
+            assert "re-run" in op.message
+            swept = [r for r in svc2.boot_report if r["op"] == orphan_id]
+            assert swept and swept[0]["resumed"] is False
+        finally:
+            svc2.close()
+
+
+# ------------------------------------------------------------ surfaces -----
+class TestResumeSurfaces:
+    def test_rest_resume_and_checkpoint_fields(self, client):
+        base, session, services = client
+        resp = session.post(f"{base}/api/v1/workloads/train", json={
+            "mesh": "data=2,fsdp=2", "steps": 3})
+        assert resp.status_code == 201
+        op = resp.json()
+        assert op["checkpoint"]["step"] == 3
+        assert op["resumed_from"] is None and op["drained"] is False
+        resp = session.post(f"{base}/api/v1/workloads/train", json={
+            "resume": True, "steps": 1})
+        assert resp.status_code == 201
+        resumed = resp.json()
+        assert resumed["resumed_from"] == op["checkpoint"]["id"]
+        assert resumed["result"]["start_step"] == 3
+        # list JSON carries the checkpoint fields
+        resp = session.get(f"{base}/api/v1/workloads/operations")
+        listed = resp.json()
+        assert listed[0]["resumed_from"] == op["checkpoint"]["id"]
+        assert listed[1]["checkpoint"]["id"] == op["checkpoint"]["id"]
+        # the checkpoint index surface, newest first
+        resp = session.get(f"{base}/api/v1/workloads/checkpoints")
+        assert resp.status_code == 200
+        index = resp.json()
+        assert [c["status"] for c in index] == ["complete", "complete"]
+        assert index[1]["id"] == op["checkpoint"]["id"]
+        # bad bodies are 400s naming the field (KO-X010 parity below)
+        resp = session.post(f"{base}/api/v1/workloads/train",
+                            json={"resume": "yes"})
+        assert resp.status_code == 400
+        resp = session.post(f"{base}/api/v1/workloads/train",
+                            json={"checkpoint": "abc123"})
+        assert resp.status_code == 400
+
+    def test_cli_local_resume_parity(self, tmp_path, capsys, monkeypatch):
+        from kubeoperator_tpu.cli import koctl
+
+        monkeypatch.setenv("KO_TPU_CONFIG", "/nonexistent")
+        monkeypatch.setenv("KO_TPU_DB__PATH", str(tmp_path / "cli.db"))
+        monkeypatch.setenv("KO_TPU_EXECUTOR__BACKEND", "simulation")
+        monkeypatch.setenv("KO_TPU_PROVISIONER__WORK_DIR",
+                           str(tmp_path / "tf"))
+        monkeypatch.setenv("KO_TPU_CLUSTER__KUBECONFIG_DIR",
+                           str(tmp_path / "kc"))
+        monkeypatch.setenv("KO_TPU_LOGGING__LEVEL", "ERROR")
+
+        lc = koctl.LocalClient()
+        try:
+            args = koctl.build_parser().parse_args(
+                ["--local", "workload", "train", "--mesh", "data=2,fsdp=2",
+                 "--steps", "3", "--json"])
+            assert koctl.cmd_workload(lc, args) == 0
+            op = json.loads(capsys.readouterr().out)
+            assert op["checkpoint"]["step"] == 3
+
+            args = koctl.build_parser().parse_args(
+                ["--local", "workload", "train", "--resume", "--json"])
+            assert koctl.cmd_workload(lc, args) == 0
+            resumed = json.loads(capsys.readouterr().out)
+            assert resumed["resumed_from"] == op["checkpoint"]["id"]
+            assert resumed["result"]["start_step"] == 3
+
+            # the index listing on the local transport (KO-X010 parity
+            # with GET /api/v1/workloads/checkpoints)
+            args = koctl.build_parser().parse_args(
+                ["--local", "workload", "checkpoints"])
+            assert koctl.cmd_workload(lc, args) == 0
+            listing = capsys.readouterr().out
+            assert op["checkpoint"]["id"][:8] in listing
+            assert "complete" in listing
+
+            # human output names the checkpoint + resume source
+            args = koctl.build_parser().parse_args(
+                ["--local", "workload", "train", "--resume",
+                 "--checkpoint", op["checkpoint"]["id"][:8],
+                 "--steps", "1"])
+            assert koctl.cmd_workload(lc, args) == 0
+            text = capsys.readouterr().out
+            assert "resumed from checkpoint" in text
+            assert "checkpoint" in text
+
+            # KO-X010 behavioral parity: the local transport rejects a
+            # non-boolean resume exactly like the REST handler
+            with pytest.raises(SystemExit, match="boolean"):
+                lc.call("POST", "/api/v1/workloads/train",
+                        {"resume": "yes"})
+        finally:
+            lc.services.close()

@@ -217,6 +217,28 @@ def test_workload_sweep_stays_under_budget():
         f"workload sweep took {elapsed:.1f}s (budget 45s)")
 
 
+def test_checkpoint_round_trip_stays_under_budget():
+    """The durable-training path's operational budget (ISSUE 11 /
+    PERF.md checkpoint section): save + hash-verify + restore of the
+    full 8-device TrainState (params + adamw state, ~0.4 MB as 16
+    content-hashed shards with per-file fsync) must stay cheap enough
+    that checkpoint-on-every-run and checkpoint-on-notice are free in
+    tier-1. Measured ~0.05s wall on the round-11 machine; the 10s
+    ceiling absorbs a loaded CI host's fsync latency without letting an
+    accidental per-leaf recompile or re-gather hide."""
+    from perf_matrix import run_checkpoint
+
+    start = time.perf_counter()
+    report = run_checkpoint()
+    elapsed = time.perf_counter() - start
+    assert report["ok"], report
+    row = report["rows"][0]
+    assert row["round_trip_exact"] is True
+    assert row["leaves"] == 16, row   # params(5) + adamw mu/nu/count
+    assert elapsed < 10.0, (
+        f"checkpoint round trip took {elapsed:.1f}s (budget 10s)")
+
+
 def test_tracing_overhead_stays_under_budget(tmp_path):
     """The observability layer's operational budget (PERF.md): a 3-node
     simulated create with tracing ON must stay within 5% wall-clock of the

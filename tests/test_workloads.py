@@ -159,10 +159,11 @@ class TestCompileSeam:
         """The parity drill: the SAME step body under both compile paths
         on a 2x2 CPU mesh — finite, descending, and the same final loss
         (float-tolerance: the two paths order their reductions
-        differently, nothing more)."""
+        differently, nothing more). The unit under the seam is the FULL
+        TrainState: params + adamw optimizer state advance together."""
         from kubeoperator_tpu.workloads.step import (
             build_batch,
-            init_params,
+            init_train_state,
             make_train_step,
         )
 
@@ -172,11 +173,11 @@ class TestCompileSeam:
             step, specs, used = make_train_step(mesh, mode=mode)
             assert used == mode
             assert (specs is None) == (mode == "shard_map")
-            p = init_params(mesh, specs=specs)
+            state = init_train_state(mesh, specs=specs)
             x = build_batch(mesh)
             run = []
             for _ in range(6):
-                loss, p = step(p, x)
+                loss, state = step(state, x)
                 run.append(float(loss))
             assert all(math.isfinite(l) for l in run)
             assert run[-1] < run[0]
@@ -193,21 +194,30 @@ class TestCompileSeam:
         from kubeoperator_tpu.workloads.step import (
             default_rules,
             param_shapes,
+            train_state_shapes,
         )
 
-        specs = match_partition_rules(default_rules(), param_shapes())
+        specs = match_partition_rules(default_rules(),
+                                      train_state_shapes())
         _, used = compile_step(mesh, specs=specs, mode="auto")
         assert used == "pjit"
         with pytest.raises(PartitionError, match="pjit"):
             compile_step(mesh, specs=None, mode="pjit")
         with pytest.raises(PartitionError, match="axes"):
             compile_step(MeshSpec.parse("dp=8").build())
+        # a params-only spec tree (the pre-optimizer layout) is refused
+        # with guidance, not a confusing jit structure error
+        with pytest.raises(PartitionError, match="TrainState"):
+            compile_step(mesh, specs=match_partition_rules(
+                default_rules(), param_shapes()), mode="pjit")
 
     def test_scalar_rides_both_paths_unpartitioned(self):
-        """The step counter crosses both compile paths and counts."""
+        """The step counter crosses both compile paths and counts — and
+        adamw's weight decay is masked off it (a decayed counter would
+        drift below the integer step index)."""
         from kubeoperator_tpu.workloads.step import (
             build_batch,
-            init_params,
+            init_train_state,
             make_train_step,
         )
         import jax
@@ -215,11 +225,79 @@ class TestCompileSeam:
         for mode in ("pjit", "shard_map"):
             mesh = MeshSpec.parse("data=2,fsdp=1,tp=1").build()
             step, specs, _ = make_train_step(mesh, mode=mode)
-            p = init_params(mesh, specs=specs)
+            state = init_train_state(mesh, specs=specs)
             x = build_batch(mesh)
             for _ in range(3):
-                _, p = step(p, x)
-            assert float(jax.device_get(p["step"])) == 3.0
+                _, state = step(state, x)
+            assert float(jax.device_get(state["params"]["step"])) == 3.0
+
+    def test_optimizer_state_rides_the_partition_rules(self):
+        """ISSUE 11 tentpole layer 1: the SAME rule list lays out params
+        AND adamw mu/nu (path-suffix matching), the adamw `count` scalar
+        rides the scalar exemption, and explain_rules covers the full
+        TrainState tree with no unmatched leaves."""
+        from jax.sharding import PartitionSpec
+
+        from kubeoperator_tpu.workloads.step import (
+            default_rules,
+            train_state_shapes,
+        )
+
+        shapes = train_state_shapes()
+        report = explain_rules(default_rules(), shapes)
+        assert report["unmatched"] == []
+        assert report["unused_rules"] == []
+        claims = report["claims"]
+        # moments claimed by the same rules as their params
+        assert claims["opt/0/mu/wqkv"]["rule"] == r"wqkv$"
+        assert claims["opt/0/nu/w_in"]["rule"] == r"w_in$"
+        assert claims["params/wqkv"]["rule"] == r"wqkv$"
+        # the adamw count scalar is exempt, like the step counter
+        assert claims["opt/0/count"]["rule"] == "(scalar)"
+        assert claims["params/step"]["rule"] == "(scalar)"
+        # and the spec TREE mirrors: mu/nu shard exactly like params
+        specs = match_partition_rules(default_rules(), shapes)
+        assert specs["opt"][0].mu["wqkv"] == PartitionSpec("fsdp", None)
+        assert specs["opt"][0].nu["w_out"] == PartitionSpec("tp", None)
+        assert specs["opt"][0].count == PartitionSpec()
+
+    def test_moments_actually_advance_and_checkpoint_restores_them(self):
+        """The optimizer state is REAL state: mu/nu move off zero, count
+        counts, and a save/restore round trip resumes the exact
+        trajectory (the durable-training parity contract at the library
+        level)."""
+        import os
+        import tempfile
+
+        import jax
+
+        from kubeoperator_tpu.workloads.checkpoint import (
+            restore_checkpoint,
+            save_checkpoint,
+        )
+        from kubeoperator_tpu.workloads.harness import run_training
+        from kubeoperator_tpu.workloads.step import train_state_shapes
+
+        mesh = MeshSpec.parse("data=2,fsdp=2,tp=1").build()
+        full = run_training(mesh, steps=6, mode="auto", seed=0)
+        part = run_training(mesh, steps=3, mode="auto", seed=0,
+                            return_state=True)
+        state = part.pop("state")
+        host = jax.tree_util.tree_map(
+            lambda l: np.asarray(jax.device_get(l)), state)
+        assert float(host["opt"][0].count) == 3.0
+        assert float(np.abs(host["opt"][0].mu["wqkv"]).max()) > 0.0
+        with tempfile.TemporaryDirectory() as root:
+            man = save_checkpoint(root, host, step=3, target_steps=6,
+                                  mesh=part["mesh"], seed=0)
+            assert os.path.isfile(os.path.join(man["dir"],
+                                               "manifest.json"))
+            back, _man = restore_checkpoint(man["dir"],
+                                            train_state_shapes())
+        resumed = run_training(mesh, steps=3, mode="auto", seed=0,
+                               state=back)
+        assert resumed["start_step"] == 3 and resumed["end_step"] == 6
+        assert part["losses"] + resumed["losses"] == full["losses"]
 
 
 # ---------------------------------------------------------------- harness ----
@@ -303,13 +381,16 @@ class TestWorkloadService:
             op = svc.journal.operation(out["id"])
             assert op.kind == "workload-train"
             assert op.cluster_id == "" and op.cluster_name == "(workload)"
-            # span tree: op root + the two step windows
+            # span tree: op root + the step windows + the checkpoint-save
+            # window (every completed run checkpoints, ISSUE 11)
             tree = span_tree(svc.journal.spans_of(op.id))
             assert tree["id"] == op.id
             windows = {n["name"]: n for n in tree["children"]}
-            assert set(windows) == {"compile", "steps"}
+            assert set(windows) == {"compile", "steps", "checkpoint-save"}
             assert all(n["kind"] == "window" for n in windows.values())
             assert windows["steps"]["attrs"]["steps"] == 4
+            assert windows["checkpoint-save"]["attrs"]["checkpoint"] \
+                == out["checkpoint"]["id"]
             # trace surface renders the same tree
             trace = svc.workloads.trace(out["id"][:8])
             assert trace["tree"]["id"] == op.id
