@@ -198,6 +198,26 @@ class MetricsRegistry:
                [_fmt("ko_tpu_fleet_waves", {"outcome": o}, n)
                 for o, n in sorted(waves_by_outcome.items())])
 
+        # workload queue (docs/workloads.md "Queue and preemption"):
+        # entries by state off the mirrored column, and the queue-wait
+        # distribution by priority class (dispatch start - submission).
+        # getattr-guarded like the watchdog rows for hand-built stubs.
+        queue_repo = getattr(services.repos, "workload_queue", None)
+        if queue_repo is not None:
+            queue_counts = queue_repo.counts_by_state()
+            family("ko_tpu_workload_queue", "gauge",
+                   "Workload-queue entries by state (pending / placed / "
+                   "running / drained / done / failed / cancelled).",
+                   [_fmt("ko_tpu_workload_queue", {"state": s}, n)
+                    for s, n in sorted(queue_counts.items())])
+            histogram(
+                "ko_tpu_workload_queue_wait_seconds",
+                "Queue wait (first dispatch minus submission) per "
+                "dispatched entry, by priority class.",
+                "priority",
+                [(cls, wait, "") for cls, wait
+                 in queue_repo.wait_rows()])
+
         try:
             watchdog_rows = services.watchdog.status()
         except Exception:

@@ -777,8 +777,32 @@ class Handlers:
             await run_sync(request, self.s.workloads.list_ops))
 
     async def workload_checkpoints(self, request):
+        tenant = str(request.query.get("tenant", "") or "")
         return json_response(
-            await run_sync(request, self.s.workloads.checkpoints))
+            await run_sync(request, self.s.workloads.checkpoints, tenant))
+
+    # ---- workload queue (docs/workloads.md "Queue and preemption") ----
+    async def workload_submit(self, request):
+        from kubeoperator_tpu.service.queue import submit_kwargs
+
+        body = await request.json() if request.can_read_body else {}
+        result = await run_sync(
+            request, self.s.workload_queue.submit, **submit_kwargs(body))
+        return json_response(result, status=201)
+
+    async def workload_queue(self, request):
+        return json_response(
+            await run_sync(request, self.s.workload_queue.queue_view))
+
+    async def workload_queue_entry(self, request):
+        return json_response(await run_sync(
+            request, self.s.workload_queue.status,
+            request.match_info["entry"]))
+
+    async def workload_queue_cancel(self, request):
+        return json_response(await run_sync(
+            request, self.s.workload_queue.cancel,
+            request.match_info["entry"]))
 
     async def workload_operation(self, request):
         return json_response(await run_sync(
@@ -1252,6 +1276,12 @@ def create_app(services: Services) -> web.Application:
     r.add_post("/api/v1/fleet/operations/{op}/abort",
                admin_guard(h.fleet_abort))
     r.add_post("/api/v1/workloads/train", admin_guard(h.workload_train))
+    r.add_post("/api/v1/workloads/queue", admin_guard(h.workload_submit))
+    r.add_get("/api/v1/workloads/queue", admin_guard(h.workload_queue))
+    r.add_get("/api/v1/workloads/queue/{entry}",
+              admin_guard(h.workload_queue_entry))
+    r.add_post("/api/v1/workloads/queue/{entry}/cancel",
+               admin_guard(h.workload_queue_cancel))
     r.add_get("/api/v1/workloads/checkpoints",
               admin_guard(h.workload_checkpoints))
     r.add_get("/api/v1/workloads/operations",

@@ -255,8 +255,19 @@ class LocalClient:
                 from kubeoperator_tpu.service.workload import train_kwargs
 
                 return s.workloads.train(**train_kwargs(body))
+            case ("POST", ["workloads", "queue"]):
+                from kubeoperator_tpu.service.queue import submit_kwargs
+
+                return s.workload_queue.submit(**submit_kwargs(body))
+            case ("GET", ["workloads", "queue"]):
+                return s.workload_queue.queue_view()
+            case ("GET", ["workloads", "queue", entry]):
+                return s.workload_queue.status(entry)
+            case ("POST", ["workloads", "queue", entry, "cancel"]):
+                return s.workload_queue.cancel(entry)
             case ("GET", ["workloads", "checkpoints"]):
-                return s.workloads.checkpoints()
+                return s.workloads.checkpoints(
+                    str(body.get("tenant", "") or ""))
             case ("GET", ["workloads", "operations"]):
                 return s.workloads.list_ops()
             case ("GET", ["workloads", "operations", op_id]):
@@ -1077,12 +1088,101 @@ def _format_mesh(mesh: dict) -> str:
     return ",".join(f"{a}={s}" for a, s in (mesh or {}).items())
 
 
+def _format_entry(e: dict) -> str:
+    """One queue-entry row for the human `workload queue` listing."""
+    extras = []
+    if e.get("placement"):
+        extras.append("on " + "+".join(e["placement"]))
+    if e.get("preemptions"):
+        extras.append(f"preempted x{len(e['preemptions'])}")
+    if e.get("queue_wait_s") is not None:
+        extras.append(f"waited {e['queue_wait_s']}s")
+    return (f"{e['id'][:8]}  {e['state']:9s} {e['priority']:9s} "
+            f"{(e.get('tenant') or '-'):12s} {e['kind']:5s} "
+            f"{(e.get('mesh') or '(default)'):20s} "
+            + ("  ".join(extras)))
+
+
 def cmd_workload(client, args) -> int:
     """Tenant workload verbs (docs/workloads.md): `train` runs sharded
     training on the visible devices as a journaled operation (partition
-    rules -> pjit/shard_map compile seam -> descending-loss verdict),
-    `list` shows the journaled runs, `trace` renders a run's
-    operation -> step-window waterfall."""
+    rules -> pjit/shard_map compile seam -> descending-loss verdict);
+    `submit`/`queue`/`cancel`/`sweep` drive the workload QUEUE (gang
+    scheduling + priority preemption over the slice pool); `list` shows
+    the journaled runs, `trace` renders a run's operation -> step-window
+    waterfall."""
+    if args.wl_cmd in ("submit", "sweep"):
+        body: dict = {"wait": not args.no_wait}
+        if args.wl_cmd == "sweep":
+            body["kind"] = "sweep"
+        else:
+            if args.plan:
+                body["plan"] = args.plan
+            if args.mesh:
+                body["mesh"] = args.mesh
+            if args.mode:
+                body["mode"] = args.mode
+            if args.priority:
+                body["priority"] = args.priority
+        if args.steps is not None:
+            body["steps"] = args.steps
+        if args.tenant:
+            body["tenant"] = args.tenant
+        entry = client.call("POST", "/api/v1/workloads/queue", body)
+        if args.json:
+            _print(entry)
+        else:
+            print(f"workload {entry['id']}: {entry['kind']} queued at "
+                  f"{entry['priority']}"
+                  + (f" for tenant {entry['tenant']}"
+                     if entry.get("tenant") else ""))
+            print(f"  state {entry['state']}"
+                  + (f" ({entry.get('message')})"
+                     if entry.get("message") else ""))
+            if entry.get("preemptions"):
+                for p in entry["preemptions"]:
+                    print(f"  {p.get('kind', 'drained')} by "
+                          f"{(p.get('by') or '?')[:8]}"
+                          + (f" at step {p['step']}" if p.get("step")
+                             is not None else "")
+                          + (f", checkpoint {p['checkpoint'][:8]}"
+                             if p.get("checkpoint") else ""))
+            if entry.get("run_ops"):
+                print(f"  run op(s): "
+                      + " ".join(o[:8] for o in entry["run_ops"]))
+                print(f"  waterfall: koctl workload trace "
+                      f"{entry['run_ops'][-1][:8]}")
+        return 1 if entry["state"] == "failed" else 0
+    if args.wl_cmd == "queue":
+        view = client.call("GET", "/api/v1/workloads/queue")
+        if args.json:
+            _print(view)
+            return 1 if any(e["state"] == "failed"
+                            for e in view["entries"]) else 0
+        cap = view["capacity"]
+        print(f"capacity: {cap['slices']} slice(s) x "
+              f"{cap['chips_per_slice']} chip(s) "
+              f"({len(cap['free'])} free, source {cap['source']})")
+        if not view["entries"]:
+            print("queue is empty")
+        for e in view["entries"]:
+            print(_format_entry(e))
+        return 1 if any(e["state"] == "failed"
+                        for e in view["entries"]) else 0
+    if args.wl_cmd == "cancel":
+        from urllib.parse import quote
+
+        entry = client.call(
+            "POST",
+            f"/api/v1/workloads/queue/{quote(args.entry, safe='')}/cancel")
+        if args.json:
+            _print(entry)
+        else:
+            print(f"workload {entry['id'][:8]}: {entry['state']}"
+                  + (" (drain requested; it checkpoints at the next "
+                     "step boundary)" if entry["state"] == "running"
+                     else ""))
+        return 0
     if args.wl_cmd == "train":
         body: dict = {}
         if args.plan:
@@ -1097,6 +1197,8 @@ def cmd_workload(client, args) -> int:
             body["resume"] = True
         if args.checkpoint:
             body["checkpoint"] = args.checkpoint
+        if args.tenant:
+            body["tenant"] = args.tenant
         op = client.call("POST", "/api/v1/workloads/train", body)
         result = op.get("result") or {}
         ok = bool(result.get("ok"))
@@ -1139,7 +1241,12 @@ def cmd_workload(client, args) -> int:
                       f"{op.get('message', '')}")
         return 1 if any(o["status"] == "Failed" for o in ops) else 0
     if args.wl_cmd == "checkpoints":
-        rows = client.call("GET", "/api/v1/workloads/checkpoints")
+        path = "/api/v1/workloads/checkpoints"
+        if args.tenant:
+            from urllib.parse import quote
+
+            path += f"?tenant={quote(args.tenant, safe='')}"
+        rows = client.call("GET", path)
         if args.json:
             _print(rows)
         elif not rows:
@@ -1147,6 +1254,7 @@ def cmd_workload(client, args) -> int:
         else:
             for c in rows:
                 print(f"{c['id'][:8]}  {c['status']:9s} "
+                      f"{(c.get('tenant') or '-'):12s} "
                       f"step {c['step']}/{c.get('target_steps', '?'):<6} "
                       f"{_format_mesh(c.get('mesh')):20s} "
                       f"{c.get('bytes', 0)} bytes  (op {c['op_id'][:8]})")
@@ -2245,6 +2353,283 @@ def cmd_preemption_soak(args) -> int:
     return 0 if ok else 1
 
 
+def _queue_soak_once(args, base_dir: str) -> tuple[list, dict]:
+    """The mixed-priority queue drill (ISSUE 12, docs/workloads.md
+    "Queue and preemption"): 3 queued workloads share a 2-slice pool
+    through one priority preemption —
+
+      alice  (low,    1 slice, 6 steps) — running when the others arrive
+      bob    (normal, 1 slice, 3 steps) — fits the second slice
+      carol  (high,   1 slice, 3 steps) — blocked; preempts alice via
+             the PR-11 drain protocol (checkpoint at the next step
+             boundary), runs, and alice auto-resumes from her checkpoint
+
+    Every eviction and resume is proven from journal rows (entry ops,
+    child run ops, the preemption ledger in op vars) and ONE stitched
+    span tree per tenant; alice's drained+resumed loss trajectory must
+    match an uninterrupted run bit-for-bit. Returns (checks,
+    structural-summary) for --verify-determinism."""
+    from kubeoperator_tpu.models import Plan, Region, Zone
+    from kubeoperator_tpu.service import build_services
+    from kubeoperator_tpu.utils.config import load_config
+
+    checks: list[dict] = []
+
+    def check(name: str, ok, detail: str = "") -> None:
+        checks.append({"check": name, "ok": bool(ok), "detail": detail})
+
+    os.makedirs(base_dir, exist_ok=True)
+    config = load_config(path="/nonexistent", env={}, overrides={
+        "db": {"path": os.path.join(base_dir, "soak.db")},
+        "logging": {"level": "ERROR"},
+        "executor": {"backend": "simulation"},
+        "provisioner": {"work_dir": os.path.join(base_dir, "tf")},
+        "cron": {"backup_enabled": False, "health_check_interval_s": 300,
+                 "event_sync_interval_s": 0},
+        "cluster": {"kubeconfig_dir": os.path.join(base_dir, "kc")},
+        "lease": {"controller_id": "queue-drill-a"},
+    })
+    svc = build_services(config, simulate=True)
+    structure: dict = {}
+    steps_total = 6
+    preempt_at_step = 2
+    try:
+        region = svc.regions.create(Region(
+            name="queue-region", provider="gcp_tpu_vm",
+            vars={"project": "queue", "name": "us-central1"}))
+        zone = svc.zones.create(Zone(
+            name="queue-zone", region_id=region.id,
+            vars={"gcp_zone": "us-central1-a"}))
+        svc.plans.create(Plan(
+            name="queue-v5e-4-x2", provider="gcp_tpu_vm",
+            region_id=region.id, zone_ids=[zone.id], accelerator="tpu",
+            tpu_type="v5e-4", num_slices=2, worker_count=0))
+        svc.clusters.create("pool", provision_mode="plan",
+                            plan_name="queue-v5e-4-x2", wait=True)
+        cluster = svc.clusters.get("pool")
+        cap = svc.workload_queue.capacity()
+        check("cluster Ready; pool derives 2x 4-chip slices from it",
+              cluster.status.phase == "Ready" and cap["slices"] == 2
+              and cap["chips_per_slice"] == 4
+              and cap["source"] == "clusters",
+              f"{cluster.status.phase} {cap}")
+
+        # ---- the uninterrupted reference run (library, same seed) -----
+        import jax
+
+        from kubeoperator_tpu.parallel.mesh import MeshSpec
+        from kubeoperator_tpu.workloads.harness import run_training
+
+        reference = run_training(
+            MeshSpec.parse("data=1,fsdp=4,tp=1").build(jax.devices()[:4]),
+            steps=steps_total, mode="auto", seed=0)
+
+        # ---- alice runs; bob + carol arrive mid-run at a boundary ------
+        fired = {"done": False}
+
+        def hook(completed, _loss):
+            if completed == preempt_at_step and not fired["done"]:
+                fired["done"] = True
+                svc.workload_queue.submit(
+                    mesh="data=1,fsdp=4", steps=3, tenant="bob",
+                    priority="normal", wait=True)
+                svc.workload_queue.submit(
+                    mesh="data=1,fsdp=4", steps=3, tenant="carol",
+                    priority="high", wait=True)
+
+        svc.workloads.step_hook = hook
+        svc.workload_queue.submit(
+            mesh="data=1,fsdp=4", steps=steps_total, tenant="alice",
+            priority="low", wait=True)
+        svc.workloads.step_hook = None
+
+        entries = {e["tenant"]: e for e in svc.workload_queue.entries()}
+        check("all three entries finished done",
+              all(entries[t]["state"] == "done"
+                  for t in ("alice", "bob", "carol")),
+              str({t: entries.get(t, {}).get("state")
+                   for t in ("alice", "bob", "carol")}))
+        alice, bob, carol = (entries.get(t, {})
+                             for t in ("alice", "bob", "carol"))
+        led = alice.get("preemptions") or []
+        check("alice evicted exactly once, by carol, at the drain "
+              "boundary, with a checkpoint",
+              len(led) == 1 and led[0]["kind"] == "drained"
+              and led[0]["by"] == carol.get("id")
+              and led[0]["step"] == preempt_at_step
+              and bool(led[0]["checkpoint"]),
+              str(led))
+        check("alice ran twice (drained run + resumed run), the "
+              "others once",
+              len(alice.get("run_ops") or []) == 2
+              and len(bob.get("run_ops") or []) == 1
+              and len(carol.get("run_ops") or []) == 1,
+              str({t: len(entries[t].get("run_ops") or [])
+                   for t in entries}))
+
+        # ---- eviction/resume order proven from journal rows ------------
+        ops = svc.repos.operations
+        train_ops = sorted(ops.find(kind="workload-train"),
+                           key=lambda o: (o.created_at, o.id))
+        order = [(o.vars.get("tenant", ""),
+                  (o.vars.get("result") or {}).get("start_step"))
+                 for o in train_ops]
+        check("journal order: alice -> carol (preemptor) -> bob -> "
+              "alice resumed from step 2",
+              order == [("alice", 0), ("carol", 0), ("bob", 0),
+                        ("alice", preempt_at_step)], str(order))
+        check("every run op Succeeded and stitched under its entry op",
+              all(o.status == "Succeeded" for o in train_ops)
+              and all(o.parent_op_id == entries[o.vars["tenant"]]["op_id"]
+                      for o in train_ops),
+              str([(o.vars.get("tenant"), o.status, o.parent_op_id[:8])
+                   for o in train_ops]))
+        drained_op = train_ops[0] if train_ops else None
+        check("alice's first run closed 'drained', not Failed",
+              drained_op is not None
+              and (drained_op.vars.get("result") or {}).get("drained")
+              and "drained" in drained_op.message,
+              getattr(drained_op, "message", "(none)"))
+
+        # ---- loss parity: drained + resumed == uninterrupted -----------
+        losses: list = []
+        for op_id in alice.get("run_ops") or []:
+            losses += (ops.get(op_id).vars.get("result")
+                       or {}).get("losses") or []
+        check("alice's drained+resumed losses == uninterrupted run, "
+              "bit-for-bit",
+              losses == reference["losses"]
+              and len(losses) == steps_total,
+              f"{losses} vs {reference['losses']}")
+
+        # ---- ONE stitched tree per tenant ------------------------------
+        from kubeoperator_tpu.observability import span_tree
+
+        tree = span_tree(svc.repos.spans.for_trace(
+            ops.get(alice["op_id"]).trace_id))
+        names: list = []
+
+        def walk(node, depth=0):
+            names.append((depth, node.get("name")))
+            for child in node.get("children", []):
+                walk(child, depth + 1)
+
+        if tree:
+            walk(tree)
+        flat = [n for _d, n in names]
+        check("alice's tree: entry root -> queue-wait, two run ops, "
+              "preempt marker, checkpoint save+restore",
+              tree is not None and tree.get("id") == alice.get("op_id")
+              and flat.count("workload-train") == 2
+              and "queue-wait" in flat and "preempt" in flat
+              and "checkpoint-save" in flat
+              and "checkpoint-restore" in flat,
+              str(flat))
+
+        # ---- per-tenant checkpoint namespaces --------------------------
+        rows = svc.workloads.checkpoints(tenant="alice")
+        check("alice's checkpoints live in her namespace "
+              "(<dir>/alice/...)",
+              rows and all(r["tenant"] == "alice" for r in rows)
+              and all(os.sep + "alice" + os.sep
+                      in svc.repos.checkpoints.get(r["id"]).dir
+                      for r in rows),
+              str([(r["tenant"], r["step"]) for r in rows]))
+        check("tenant filter isolates namespaces",
+              {r["tenant"] for r in svc.workloads.checkpoints()}
+              == {"alice", "bob", "carol"}
+              and all(r["tenant"] == "bob"
+                      for r in svc.workloads.checkpoints(tenant="bob")),
+              str({r["tenant"]
+                   for r in svc.workloads.checkpoints()}))
+
+        # ---- priority order + queue-wait metrics -----------------------
+        check("carol (high) dispatched before bob (normal) despite "
+              "arriving later",
+              carol.get("started_at") and bob.get("started_at")
+              and carol["started_at"] <= bob["started_at"],
+              f"carol {carol.get('started_at')} vs "
+              f"bob {bob.get('started_at')}")
+        from kubeoperator_tpu.api.metrics import MetricsRegistry
+
+        exposition = MetricsRegistry().render(svc)
+        check("queue metrics: state gauge + wait histogram exported",
+              'ko_tpu_workload_queue{state="done"} 3' in exposition
+              and "ko_tpu_workload_queue_wait_seconds_count" in exposition,
+              "(families present)" if "ko_tpu_workload_queue"
+              in exposition else "(missing)")
+
+        structure = {
+            "states": {t: entries[t]["state"] for t in sorted(entries)},
+            "ledger": [(p["kind"], p.get("step"))
+                       for p in (alice.get("preemptions") or [])],
+            "order": order,
+            "losses": losses,
+            "reference": reference["losses"],
+            "checkpoint_tenants": sorted(
+                {r["tenant"] for r in svc.workloads.checkpoints()}),
+        }
+    finally:
+        svc.close()
+    return checks, structure
+
+
+def cmd_queue_soak(args) -> int:
+    """`koctl chaos-soak --queue`: the workload-queue drill — 3 queued
+    workloads of mixed priority share 2 slices through one priority
+    preemption, proven from journal rows and stitched span trees;
+    --verify-determinism runs two seeded passes and diffs the
+    structural summaries bit-for-bit."""
+    import shutil
+    import tempfile
+    import time as _time
+
+    # the drill's 2x v5e-4 pool wants 8 virtual CPU devices, pinned
+    # BEFORE the first jax import (same discipline as perf_matrix)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flag = "--xla_force_host_platform_device_count=8"
+    if flag not in os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "") + " " + flag).strip()
+    t0 = _time.monotonic()
+    with tempfile.TemporaryDirectory(prefix="ko-queue-soak-") as base:
+        checks, structure = _queue_soak_once(
+            args, os.path.join(base, "pass1"))
+        deterministic = None
+        if args.verify_determinism:
+            checks2, structure2 = _queue_soak_once(
+                args, os.path.join(base, "pass2"))
+            deterministic = (structure == structure2
+                             and [c["ok"] for c in checks]
+                             == [c["ok"] for c in checks2])
+        shutil.rmtree(base, ignore_errors=True)
+    ok = all(c["ok"] for c in checks) and deterministic in (None, True)
+    report = {
+        "seed": args.seed,
+        "checks": checks,
+        "structure": structure,
+        "runtime_s": round(_time.monotonic() - t0, 3),
+    }
+    if deterministic is not None:
+        report["deterministic"] = deterministic
+    if args.format == "json":
+        _print(report)
+    else:
+        print(f"queue chaos-soak: states "
+              f"{structure.get('states')} order "
+              f"{[t for t, _s in structure.get('order', [])]}")
+        for c in checks:
+            mark = "ok " if c["ok"] else "FAIL"
+            print(f"  [{mark}] {c['check']}"
+                  + (f" — {c['detail']}" if c["detail"] and not c["ok"]
+                     else ""))
+        if deterministic is not None:
+            print(f"  deterministic across two runs: {deterministic}")
+        print(f"  runtime {report['runtime_s']}s — "
+              + ("OK" if ok else "FAILED"))
+    return 0 if ok else 1
+
+
 def cmd_controller_soak(args) -> int:
     """`koctl chaos-soak --controllers N` (docs/resilience.md "Controller
     leases"): the multi-controller kill drill. A replica holding >=3
@@ -2332,6 +2717,8 @@ def cmd_chaos_soak(args) -> int:
         return cmd_fleet_soak(args)
     if args.preemption:
         return cmd_preemption_soak(args)
+    if args.queue:
+        return cmd_queue_soak(args)
     t0 = _time.monotonic()
     with tempfile.TemporaryDirectory(prefix="ko-chaos-") as base:
         report = _chaos_soak_once(args, os.path.join(base, "pass1"))
@@ -2612,16 +2999,74 @@ def build_parser() -> argparse.ArgumentParser:
                           help="resume from a specific checkpoint id "
                                "(or unique >=6-char prefix) instead of "
                                "the newest complete one")
+    wl_train.add_argument("--tenant", default="", metavar="NAME",
+                          help="checkpoint namespace: saves land under "
+                               "<checkpoint.dir>/<tenant>/ with "
+                               "per-tenant retention; --resume resolves "
+                               "inside the namespace")
     wl_train.add_argument("--json", action="store_true")
+    wl_submit = wlsub.add_parser(
+        "submit",
+        help="queue a training workload as a tenant: gang scheduling "
+             "places the WHOLE requested mesh on slice-pool capacity, "
+             "priority preemption checkpoint-drains lower-priority "
+             "victims (docs/workloads.md \"Queue and preemption\")")
+    wl_submit.add_argument("--plan", default="",
+                           help="pin to a TPU deploy plan's topology")
+    wl_submit.add_argument("--mesh", default="", metavar="data=4,fsdp=2",
+                           help="requested mesh over (data, fsdp, tp); "
+                                "the gang is its whole device count")
+    wl_submit.add_argument("--steps", type=int, default=None,
+                           help="train steps (default: workloads.steps)")
+    wl_submit.add_argument("--mode", default="",
+                           choices=["", "auto", "pjit", "shard_map"])
+    wl_submit.add_argument("--priority", default="",
+                           choices=["", "high", "normal", "low",
+                                    "scavenger"],
+                           help="priority class (default: "
+                                "queue.priority_default); higher "
+                                "classes preempt strictly lower ones")
+    wl_submit.add_argument("--tenant", default="", metavar="NAME",
+                           help="tenant name: accounting label + "
+                                "checkpoint namespace")
+    wl_submit.add_argument("--no-wait", action="store_true",
+                           help="enqueue and return; the engine "
+                                "dispatches in the background")
+    wl_submit.add_argument("--json", action="store_true")
+    wl_queue = wlsub.add_parser(
+        "queue",
+        help="the workload queue: slice-pool capacity plus every entry "
+             "(state, priority, placement, preemptions; exit 1 if any "
+             "entry failed)")
+    wl_queue.add_argument("--json", action="store_true")
+    wl_cancel = wlsub.add_parser(
+        "cancel",
+        help="cancel a queue entry (a running entry checkpoint-drains "
+             "at its next step boundary first — no state is lost)")
+    wl_cancel.add_argument("entry", help="entry id or >=6-char prefix")
+    wl_cancel.add_argument("--json", action="store_true")
+    wl_sweep = wlsub.add_parser(
+        "sweep",
+        help="queue the scaling-efficiency sweep as a scavenger-class "
+             "tenant: it runs as a journaled op when the whole pool is "
+             "free and never displaces a tenant workload")
+    wl_sweep.add_argument("--steps", type=int, default=None,
+                          help="train steps per swept mesh "
+                               "(default: workloads.steps)")
+    wl_sweep.add_argument("--tenant", default="", metavar="NAME")
+    wl_sweep.add_argument("--no-wait", action="store_true")
+    wl_sweep.add_argument("--json", action="store_true")
     wl_list = wlsub.add_parser(
         "list", help="journaled workload runs, newest first "
                      "(exit 1 if any listed run Failed)")
     wl_list.add_argument("--json", action="store_true")
     wl_ckpts = wlsub.add_parser(
         "checkpoints",
-        help="the checkpoint index, newest first: id, step/target, "
-             "mesh, size, lifecycle status (complete/pruned/swept) — "
-             "the --resume picker")
+        help="the checkpoint index, newest first: id, tenant, "
+             "step/target, mesh, size, lifecycle status "
+             "(complete/pruned/swept) — the --resume picker")
+    wl_ckpts.add_argument("--tenant", default="", metavar="NAME",
+                          help="only this tenant's namespace")
     wl_ckpts.add_argument("--json", action="store_true")
     wl_trace = wlsub.add_parser(
         "trace", help="a run's operation -> step-window span waterfall")
@@ -2771,6 +3216,15 @@ def build_parser() -> argparse.ArgumentParser:
                              "pinned) -> reprovisions -> restores, all "
                              "proven from journal rows + one span tree "
                              "with lease fencing intact")
+    soak_p.add_argument("--queue", action="store_true",
+                        help="run the workload-queue drill instead: 3 "
+                             "queued workloads of mixed priority share "
+                             "2 slices through one priority preemption "
+                             "(checkpoint-drain, gang re-placement, "
+                             "auto-resume), every eviction and resume "
+                             "proven from journal rows and one stitched "
+                             "span tree per tenant, loss parity pinned "
+                             "bit-for-bit")
     soak_p.add_argument("--clusters", type=int, default=21,
                         help="fleet size for --fleet (floored at 9)")
     soak_p.add_argument("--controllers", type=int, default=0,

@@ -34,6 +34,14 @@ from kubeoperator_tpu.models.tenancy import Project, ProjectMember, Role, User
 from kubeoperator_tpu.models.event import AuditRecord, Event, Message, Setting, TaskLogChunk
 from kubeoperator_tpu.models.checkpoint import CHECKPOINT_STATUSES, Checkpoint
 from kubeoperator_tpu.models.component import ClusterComponent
+from kubeoperator_tpu.models.workload import (
+    ACTIVE_STATES,
+    PRIORITY_CLASSES,
+    QUEUE_STATES,
+    TERMINAL_STATES,
+    QueueEntry,
+    priority_of,
+)
 from kubeoperator_tpu.models.operation import Operation, OperationStatus
 from kubeoperator_tpu.models.security import CisCheck, CisScan
 from kubeoperator_tpu.models.span import Span, SpanKind, SpanStatus
@@ -49,6 +57,8 @@ __all__ = [
     "AuditRecord", "Event", "Message", "Setting", "TaskLogChunk",
     "ClusterComponent",
     "Checkpoint", "CHECKPOINT_STATUSES",
+    "QueueEntry", "PRIORITY_CLASSES", "QUEUE_STATES", "ACTIVE_STATES",
+    "TERMINAL_STATES", "priority_of",
     "Operation", "OperationStatus",
     "CisCheck", "CisScan",
     "Span", "SpanKind", "SpanStatus",

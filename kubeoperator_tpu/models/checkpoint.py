@@ -27,6 +27,8 @@ CHECKPOINT_STATUSES: tuple[str, ...] = ("complete", "pruned", "swept")
 class Checkpoint(Entity):
     op_id: str = ""          # workload op that saved it (journal join)
     kind: str = "workload-train"
+    tenant: str = ""         # namespace: files live under <dir>/<tenant>/,
+    #                          retention and resume resolve per tenant
     step: int = 0            # TrainState step counter at save time
     target_steps: int = 0    # the run's intended total (resume math)
     dir: str = ""            # on-disk checkpoint directory

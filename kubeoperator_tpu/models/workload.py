@@ -1,0 +1,109 @@
+"""QueueEntry — one tenant workload waiting for (or holding) slice-pool
+capacity (migration 011; docs/workloads.md "Queue and preemption").
+
+The queue makes workloads first-class tenants instead of ad-hoc `koctl
+workload train` invocations: every submission is BOTH a queue row (this
+entity — the queryable mirror the scheduler sorts and the metrics gauge
+counts) and a platform-scope journal operation (`op_id` — the durable
+truth that inherits lease fencing, the boot reconciler, and the span
+tree). The row carries the requested gang (mesh → slices), the priority
+class, and the tenant name; the scheduler moves it through
+
+    pending → placed → running → done
+                  ↘ running → drained → pending   (priority preemption:
+                                                   checkpoint+drain, then
+                                                   auto-resume)
+
+with `cancelled`/`failed` as the operator/error exits. `preemptions`
+ledgers every eviction (who preempted, at which step, which checkpoint
+carries the state) so the drill can prove the whole life from rows.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from kubeoperator_tpu.models.base import Entity
+from kubeoperator_tpu.utils.errors import ValidationError
+
+# strict classes, strict order — the scheduler never compares raw ints
+# across releases, it compares these. `scavenger` exists for platform
+# housekeeping gangs (the `workload sweep` verb submits there), below
+# every tenant class, preemptible-by-everything.
+PRIORITY_CLASSES: dict[str, int] = {
+    "high": 30,
+    "normal": 20,
+    "low": 10,
+    "scavenger": 0,
+}
+
+QUEUE_STATES: tuple[str, ...] = (
+    "pending",    # admitted, waiting for its whole gang to fit
+    "placed",     # capacity reserved (placement names the slices)
+    "running",    # dispatched through WorkloadService
+    "drained",    # checkpoint+drained by a preemption; about to re-queue
+    "done",       # run finished, entry op closed Succeeded
+    "failed",     # run raised / unhealthy, entry op closed Failed
+    "cancelled",  # operator cancel (a running entry drains first)
+)
+
+# states that hold capacity (their `placement` names real slices)
+ACTIVE_STATES: tuple[str, ...] = ("placed", "running")
+# terminal states (entry op closed; the row is history)
+TERMINAL_STATES: tuple[str, ...] = ("done", "failed", "cancelled")
+
+
+def priority_of(priority_class: str) -> int:
+    """The class's rank, or ValidationError naming the legal classes —
+    the one place a priority string becomes a number."""
+    try:
+        return PRIORITY_CLASSES[priority_class]
+    except KeyError:
+        raise ValidationError(
+            f"priority class {priority_class!r} not in "
+            f"{tuple(PRIORITY_CLASSES)}") from None
+
+
+@dataclass
+class QueueEntry(Entity):
+    op_id: str = ""            # the entry's journal op (platform scope)
+    tenant: str = ""           # checkpoint namespace + accounting label
+    kind: str = "train"        # train | sweep
+    priority_class: str = "normal"
+    priority: int = 20         # mirrored rank (priority_of at submit)
+    state: str = "pending"
+    plan: str = ""             # optional deploy-plan pin (train only)
+    mesh: str = ""             # requested mesh axis spec text
+    steps: int = 0
+    mode: str = ""
+    devices: int = 0           # mesh device count (gang size source)
+    slices_needed: int = 0     # recomputed against the pool per schedule
+    placement: list = field(default_factory=list)   # slice ids held
+    preemptions: list = field(default_factory=list)  # eviction ledger
+    preempted_by: str = ""     # live marker while a drain is in flight
+    checkpoint: str = ""       # latest drained checkpoint (resume source)
+    run_ops: list = field(default_factory=list)      # child run op ids
+    started_at: float = 0.0    # first dispatch (queue-wait metric end)
+    finished_at: float = 0.0
+    cancel_requested: bool = False   # operator cancel of a running entry:
+    #                                  drain first, then `cancelled`
+    message: str = ""
+
+    def validate(self) -> None:
+        priority_of(self.priority_class)
+        if self.kind not in ("train", "sweep"):
+            raise ValidationError(
+                f"queue entry kind {self.kind!r} not in ('train', 'sweep')")
+        if self.state not in QUEUE_STATES:
+            raise ValidationError(
+                f"queue entry state {self.state!r} not in {QUEUE_STATES}")
+        if not self.op_id:
+            raise ValidationError("queue entry needs its journal op_id")
+
+    @property
+    def active(self) -> bool:
+        return self.state in ACTIVE_STATES
+
+    @property
+    def terminal(self) -> bool:
+        return self.state in TERMINAL_STATES

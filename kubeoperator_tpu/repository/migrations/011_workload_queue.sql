@@ -1,0 +1,28 @@
+-- Workload queue (docs/workloads.md "Queue and preemption"): one row per
+-- queued tenant workload — the queryable mirror of the entry's journal
+-- op. The scheduler's pending pick ("highest priority, FIFO within
+-- class") and the /metrics state gauge run on the mirrored columns;
+-- started_at is mirrored so the queue-wait histogram is one SQL pass
+-- (started_at - created_at), no JSON hydration on the scrape path.
+CREATE TABLE IF NOT EXISTS workload_queue (
+    id TEXT PRIMARY KEY,
+    op_id TEXT NOT NULL,
+    tenant TEXT NOT NULL,
+    priority_class TEXT NOT NULL,
+    priority INTEGER NOT NULL,
+    state TEXT NOT NULL,
+    started_at REAL NOT NULL,
+    data TEXT NOT NULL,
+    created_at REAL NOT NULL,
+    updated_at REAL NOT NULL
+);
+CREATE INDEX IF NOT EXISTS idx_workload_queue_state
+    ON workload_queue (state, priority, created_at);
+CREATE INDEX IF NOT EXISTS idx_workload_queue_op ON workload_queue (op_id);
+-- Per-tenant checkpoint namespaces (ISSUE 12 satellite): mirror the
+-- owning tenant onto the checkpoint index so per-tenant retention and
+-- `workload checkpoints --tenant` filter in SQL. Existing rows predate
+-- tenancy and read as the unnamed namespace ('').
+ALTER TABLE checkpoints ADD COLUMN tenant TEXT NOT NULL DEFAULT '';
+CREATE INDEX IF NOT EXISTS idx_checkpoints_tenant
+    ON checkpoints (tenant, status, created_at);

@@ -28,6 +28,7 @@ from kubeoperator_tpu.models import (
     Plan,
     Project,
     ProjectMember,
+    QueueEntry,
     Region,
     Setting,
     SliceEvent,
@@ -360,9 +361,11 @@ class OperationRepo(EntityRepo[Operation]):
 # Interrupted rows are parked work whose span trees `journal.reopen`
 # re-arms, so the span prune must not collect them. Mirrors the
 # service-layer contract (fleet/engine.py FLEET_UPGRADE_KIND +
-# reconcile.py AUTO_RESUME_FLEET) — the repository layer cannot import
-# either without inverting the layering, and tests pin the agreement.
-RESUMABLE_SCOPED_KINDS = ("fleet-upgrade",)
+# reconcile.py AUTO_RESUME_FLEET/AUTO_RESUME_QUEUE; queue entry ops are
+# re-armed by WorkloadQueueService.recover) — the repository layer cannot
+# import either without inverting the layering, and tests pin the
+# agreement.
+RESUMABLE_SCOPED_KINDS = ("fleet-upgrade", "workload-queued")
 
 
 class SpanRepo(EntityRepo[Span]):
@@ -497,17 +500,23 @@ class CheckpointRepo(EntityRepo[Checkpoint]):
     different rows to different layers."""
 
     table, entity, columns = (
-        "checkpoints", Checkpoint, ("op_id", "step", "status"),
+        "checkpoints", Checkpoint, ("op_id", "tenant", "step", "status"),
     )
 
-    def latest_complete(self, op_id: str = "") -> Checkpoint | None:
+    def latest_complete(self, op_id: str = "",
+                        tenant: str | None = None) -> Checkpoint | None:
         """Newest complete checkpoint — of one op when `op_id` is given,
-        across all workload ops otherwise. Save-order by (created_at,
-        rowid) so two checkpoints inside one clock tick stay ordered."""
+        of one TENANT namespace when `tenant` is given (None = any;
+        "" = the unnamed namespace), across everything otherwise.
+        Save-order by (created_at, rowid) so two checkpoints inside one
+        clock tick stay ordered."""
         clauses, params = ["status = 'complete'"], []
         if op_id:
             clauses.append("op_id = ?")
             params.append(op_id)
+        if tenant is not None:
+            clauses.append("tenant = ?")
+            params.append(tenant)
         rows = self.db.query(
             f"SELECT data FROM {self.table} WHERE {' AND '.join(clauses)} "
             f"ORDER BY created_at DESC, rowid DESC LIMIT 1",
@@ -515,13 +524,73 @@ class CheckpointRepo(EntityRepo[Checkpoint]):
         )
         return self._hydrate(rows[0]["data"]) if rows else None
 
-    def complete(self) -> list[Checkpoint]:
+    def complete(self, tenant: str | None = None) -> list[Checkpoint]:
         """All complete checkpoints, OLDEST first (the retention pruner
-        walks this from the front)."""
+        walks this from the front). `tenant` scopes to one namespace —
+        retention is per tenant, so one tenant's churn can never prune
+        another's rows."""
+        clauses, params = ["status = 'complete'"], []
+        if tenant is not None:
+            clauses.append("tenant = ?")
+            params.append(tenant)
         rows = self.db.query(
-            f"SELECT data FROM {self.table} WHERE status = 'complete' "
-            f"ORDER BY created_at, rowid")
+            f"SELECT data FROM {self.table} WHERE {' AND '.join(clauses)} "
+            f"ORDER BY created_at, rowid", tuple(params))
         return [self._hydrate(r["data"]) for r in rows]
+
+
+class WorkloadQueueRepo(EntityRepo[QueueEntry]):
+    """Workload-queue entries (migration 011) — the scheduler's queryable
+    mirror of the entry journal ops. The scheduler's pending pick and the
+    metrics families run on mirrored columns; the entry's full state
+    (placement, preemption ledger, run ops) rides the JSON document and
+    the op's vars."""
+
+    table, entity, columns = (
+        "workload_queue", QueueEntry,
+        ("op_id", "tenant", "priority_class", "priority", "state",
+         "started_at"),
+    )
+
+    def pending(self) -> list[QueueEntry]:
+        """Schedulable entries in dispatch order: highest priority class
+        first, FIFO within a class (rowid tiebreak for same-tick
+        bursts)."""
+        rows = self.db.query(
+            f"SELECT data FROM {self.table} WHERE state = 'pending' "
+            f"ORDER BY priority DESC, created_at ASC, rowid ASC")
+        return [self._hydrate(r["data"]) for r in rows]
+
+    def active(self) -> list[QueueEntry]:
+        """Entries holding capacity (placed/running), oldest first."""
+        rows = self.db.query(
+            f"SELECT data FROM {self.table} "
+            f"WHERE state IN ('placed', 'running') "
+            f"ORDER BY created_at ASC, rowid ASC")
+        return [self._hydrate(r["data"]) for r in rows]
+
+    def by_op(self, op_id: str) -> QueueEntry | None:
+        rows = self.find(op_id=op_id)
+        return rows[0] if rows else None
+
+    def counts_by_state(self) -> dict[str, int]:
+        """Entries by state, computed IN SQL on the mirrored column — the
+        `ko_tpu_workload_queue` gauge must not hydrate queue history per
+        scrape."""
+        rows = self.db.query(
+            f"SELECT state, COUNT(*) AS n FROM {self.table} "
+            f"GROUP BY state")
+        return {r["state"]: int(r["n"]) for r in rows}
+
+    def wait_rows(self) -> list[tuple]:
+        """(priority_class, queue_wait_seconds) for every entry that was
+        dispatched at least once — the queue-wait histogram's raw
+        material, straight off the mirrored columns."""
+        rows = self.db.query(
+            f"SELECT priority_class, started_at - created_at AS w "
+            f"FROM {self.table} WHERE started_at > 0 ORDER BY rowid")
+        return [(r["priority_class"], max(float(r["w"]), 0.0))
+                for r in rows]
 
 
 class SliceEventRepo(EntityRepo[SliceEvent]):
@@ -739,5 +808,6 @@ class Repositories:
         self.settings = SettingRepo(db)
         self.slice_events = SliceEventRepo(db)
         self.checkpoints = CheckpointRepo(db)
+        self.workload_queue = WorkloadQueueRepo(db)
         self.audit = AuditRepo(db)
         self.leases = LeaseRepo(db)

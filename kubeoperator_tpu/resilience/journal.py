@@ -60,26 +60,33 @@ IN_FLIGHT_PHASES = frozenset({
 })
 
 
-def resolve_op_ref(repos, kind: str, op_ref: str = "",
+def resolve_op_ref(repos, kind, op_ref: str = "",
                    label: str = "operation") -> Operation:
-    """An op of `kind` by exact id, unique id prefix (>= 6 chars), or —
-    with no ref — the newest one. THE resolution contract for op-scoped
-    operator verbs (fleet + workload services both delegate here, so the
-    exact-id fast path and the prefix/ambiguity rules cannot drift).
+    """An op of `kind` (one kind name, or a tuple of kinds — the
+    workload surface spans train + sweep ops) by exact id, unique id
+    prefix (>= 6 chars), or — with no ref — the newest one. THE
+    resolution contract for op-scoped operator verbs (fleet + workload
+    services both delegate here, so the exact-id fast path and the
+    prefix/ambiguity rules cannot drift).
 
     The exact-id fast path matters operationally: poll loops resolve by
     id once per second, and that tick must not hydrate every historical
     op's vars blob just to match one row."""
     from kubeoperator_tpu.utils.errors import NotFoundError, ValidationError
 
+    kinds = (kind,) if isinstance(kind, str) else tuple(kind)
     if op_ref:
         try:
             op = repos.operations.get(op_ref)
-            if op.kind == kind:
+            if op.kind in kinds:
                 return op
         except NotFoundError:
             pass
-    ops = repos.operations.find(kind=kind)
+    ops: list[Operation] = []
+    for one in kinds:
+        ops.extend(repos.operations.find(kind=one))
+    if len(kinds) > 1:
+        ops.sort(key=lambda o: (o.created_at, o.id))
     if not op_ref:
         if not ops:
             raise NotFoundError(kind=label, name="(latest)")
@@ -320,6 +327,31 @@ class OperationJournal:
             )
             self._tracers[op.id] = tracer
         return tracer
+
+    def record_windows(self, op: Operation, windows: list,
+                       name_prefix: str = "") -> None:
+        """Persist named wall-clock windows ({name, start, end, attrs})
+        as WINDOW spans under the op root — the step-window layer of the
+        trace tree, shared by the workload service (compile/steps/
+        checkpoint windows), the slice pool's re-shard proof, and the
+        workload queue's scheduler decisions. Ridden through the
+        tracer's payload path (the same road executor-produced task
+        spans take), so the span cap and NullTracer-off behavior apply
+        unchanged."""
+        tracer = self.tracer_for(op)
+        payloads = []
+        for w in windows:
+            payloads.append(Span(
+                trace_id=op.trace_id, parent_id=op.id, op_id=op.id,
+                cluster_id=op.cluster_id,
+                name=f"{name_prefix}{w.get('name', 'window')}",
+                kind=SpanKind.WINDOW, status=SpanStatus.OK,
+                started_at=float(w.get("start", 0.0)),
+                finished_at=float(w.get("end", 0.0)),
+                attrs=dict(w.get("attrs") or {}),
+            ).to_dict())
+        tracer.record_payload(payloads)
+        tracer.flush()
 
     def set_phase(self, cluster: Cluster,
                   phase: ClusterPhaseStatus,

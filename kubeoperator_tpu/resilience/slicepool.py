@@ -236,21 +236,7 @@ class SlicePool:
     def _record_windows(self, op, journal, windows: list) -> None:
         """Persist the re-shard's compile/steps wall-clock windows as
         WINDOW spans under the replace op's root — the degrade leg's
-        entry in the stitched tree (same payload road the workload
-        service's step windows ride, so cap/NullTracer behavior match)."""
-        from kubeoperator_tpu.models.span import Span, SpanKind, SpanStatus
-
-        tracer = journal.tracer_for(op)
-        payloads = []
-        for w in windows:
-            payloads.append(Span(
-                trace_id=op.trace_id, parent_id=op.id, op_id=op.id,
-                cluster_id=op.cluster_id,
-                name=f"reshard-{w.get('name', 'window')}",
-                kind=SpanKind.WINDOW, status=SpanStatus.OK,
-                started_at=float(w.get("start", 0.0)),
-                finished_at=float(w.get("end", 0.0)),
-                attrs=dict(w.get("attrs") or {}),
-            ).to_dict())
-        tracer.record_payload(payloads)
-        tracer.flush()
+        entry in the stitched tree (the shared `journal.record_windows`
+        road, so cap/NullTracer behavior match every other window
+        producer)."""
+        journal.record_windows(op, windows, name_prefix="reshard-")

@@ -172,6 +172,14 @@ class Services:
         # dead controller left without a manifest are debris, never a
         # restore source (docs/workloads.md "Checkpoints")
         self.checkpoint_sweep_report = self.workloads.sweep_torn()
+        # the workload QUEUE rides the workload service: submissions are
+        # journaled platform ops, gang scheduling packs them onto
+        # slice-pool capacity, priority preemption drains victims through
+        # the checkpoint machinery above (docs/workloads.md "Queue and
+        # preemption")
+        from kubeoperator_tpu.service.queue import WorkloadQueueService
+
+        self.workload_queue = WorkloadQueueService(self)
         self.cron = CronService(self)
         from kubeoperator_tpu.terminal import TerminalManager
 
@@ -192,6 +200,7 @@ class Services:
         self.terminals.shutdown()
         self.fleet.wait_all()
         self.clusters.wait_all()
+        self.workload_queue.wait_all()
         self.workloads.wait_all()
         self.repos.db.close()
 

@@ -1,0 +1,656 @@
+"""WorkloadQueueService — workloads as first-class queued tenants
+(ISSUE 12 tentpole; docs/workloads.md "Queue and preemption").
+
+`koctl workload submit` lands here: the request becomes a queue entry
+(models/workload.py QueueEntry, migration 011) AND a platform-scope
+journal operation (PR-9 `open_scoped`), so admission, placement,
+preemption and completion are all provable from journal rows and one
+stitched span tree — and lease fencing (PR 8), the boot reconciler, and
+controller-death failover apply to queue state unchanged, because queue
+state IS journal state.
+
+The scheduler (workloads/queue.py holds the pure decisions) packs whole
+gangs onto slice-pool capacity — an entry runs only when its ENTIRE
+requested mesh fits — and implements priority preemption over the PR-11
+drain protocol: a high-priority arrival that cannot fit picks the
+lowest-priority capacity holder, `request_drain`s it (the victim
+checkpoints the full TrainState at its next step boundary and closes
+"drained"), takes the freed slices, and the victim re-enters the queue
+and auto-resumes from its checkpoint when capacity returns. A victim
+that never started (merely `placed`) is displaced back to pending with
+no drain — it has no state to save.
+
+Dispatch is cooperative and SERIAL in-process: one physical run executes
+at a time on the controller's local devices (the tier-1/drill reality —
+on hardware the dispatch leg is a per-slice JobSet launch and runs truly
+parallel), while the PLACEMENT ledger is what the gang check guards.
+`submit(wait=True)` drives the engine loop on the caller's thread until
+the queue has no runnable work; a submission arriving mid-run (another
+thread, or a step hook) only enqueues and updates the scheduling state —
+the owning engine loop picks it up at the next boundary.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+
+from kubeoperator_tpu.models import (
+    TERMINAL_STATES,
+    OperationStatus,
+    QueueEntry,
+    priority_of,
+)
+from kubeoperator_tpu.utils.errors import (
+    NotFoundError,
+    ValidationError,
+)
+from kubeoperator_tpu.utils.ids import now_ts
+from kubeoperator_tpu.utils.logging import get_logger
+from kubeoperator_tpu.workloads.queue import (
+    SlicePoolView,
+    SliceSlot,
+    plan_schedule,
+    slices_needed,
+)
+
+log = get_logger("service.queue")
+
+QUEUE_ENTRY_KIND = "workload-queued"
+
+_TENANT_RE = re.compile(r"^[a-z0-9][a-z0-9_-]{0,62}$")
+
+
+def submit_kwargs(body: dict) -> dict:
+    """The body→`WorkloadQueueService.submit` translation BOTH transports
+    share (REST handler and `LocalClient._dispatch`) — the behavioral
+    half of the KO-X010 parity contract, same pattern as
+    `workload.train_kwargs`."""
+    from kubeoperator_tpu.fleet.planner import optional_int
+
+    wait = body.get("wait", False)
+    if not isinstance(wait, bool):
+        raise ValidationError("wait must be a boolean")
+    return {
+        "plan": str(body.get("plan", "") or ""),
+        "mesh": str(body.get("mesh", "") or ""),
+        "steps": optional_int("steps", body.get("steps")),
+        "mode": str(body.get("mode", "") or ""),
+        "priority": str(body.get("priority", "") or ""),
+        "tenant": str(body.get("tenant", "") or ""),
+        "kind": str(body.get("kind", "") or "train"),
+        "wait": wait,
+    }
+
+
+class WorkloadQueueService:
+    def __init__(self, services) -> None:
+        self.s = services
+        self.repos = services.repos
+        self.journal = services.journal
+        self.workloads = services.workloads
+        cfg = services.config
+        self.priority_default = str(
+            cfg.get("queue.priority_default", "normal"))
+        self.cfg_slices = int(cfg.get("queue.slices", 0))
+        self.cfg_chips = int(cfg.get("queue.chips_per_slice", 0))
+        self.preempt = bool(cfg.get("queue.preempt", True))
+        self.max_entries = max(int(cfg.get("queue.max_entries", 64)), 1)
+        # engine state, all guarded by _lock: one dispatch loop owns
+        # physical execution at a time; _running_id names the entry whose
+        # train is live so the scheduler can route a drain at it
+        self._lock = threading.RLock()
+        self._engine_active = False
+        self._running_id = ""
+        self._threads: list[threading.Thread] = []
+
+    # ------------------------------------------------------------ submit ----
+    def submit(self, plan: str = "", mesh: str = "",
+               steps: int | None = None, mode: str = "",
+               priority: str = "", tenant: str = "", kind: str = "train",
+               wait: bool = True) -> dict:
+        """Admit one workload into the queue as a journaled platform op
+        and run a scheduling pass; with `wait`, drive the dispatch engine
+        until the queue has no runnable work (the CLI's synchronous
+        posture — the entry has usually reached a terminal state by
+        return). Validation happens BEFORE the journal op opens, so a
+        rejected submission leaves no strand."""
+        import jax
+
+        from kubeoperator_tpu.parallel.mesh import MeshSpec
+        from kubeoperator_tpu.workloads.step import WORKLOAD_AXES
+
+        kind = kind or "train"
+        if kind not in ("train", "sweep"):
+            raise ValidationError(
+                f"queue entry kind {kind!r} not in ('train', 'sweep')")
+        priority = priority or (
+            "scavenger" if kind == "sweep" else self.priority_default)
+        rank = priority_of(priority)
+        if kind == "sweep" and rank != priority_of("scavenger"):
+            raise ValidationError(
+                "workload sweep runs at the scavenger class — it must "
+                "never displace a tenant workload")
+        if tenant and not _TENANT_RE.match(tenant):
+            raise ValidationError(
+                f"tenant {tenant!r} must match {_TENANT_RE.pattern} "
+                f"(it names a checkpoint directory)")
+        counts = self.repos.workload_queue.counts_by_state()
+        live = sum(n for state, n in counts.items()
+                   if state not in TERMINAL_STATES)
+        if live >= self.max_entries:
+            raise ValidationError(
+                f"queue is full ({live}/{self.max_entries} live "
+                f"entries; queue.max_entries)")
+        if plan:
+            row = self.s.plans.get(plan)   # NotFoundError names the plan
+            if not row.has_tpu():
+                raise ValidationError(
+                    f"plan {plan!r} has no TPU topology")
+        n_local = len(jax.devices())
+        if kind == "sweep":
+            devices = n_local          # the sweep wants the whole pool
+            mesh = ""
+        elif mesh:
+            spec = MeshSpec.parse(mesh, axis_names=WORKLOAD_AXES,
+                                  n_devices=n_local)
+            devices = spec.total_devices
+        else:
+            devices = n_local
+        steps = int(steps) if steps is not None else int(
+            self.s.config.get("workloads.steps", 4))
+        if steps < 2:
+            raise ValidationError("queued workloads need steps >= 2")
+
+        op = self.journal.open_scoped(
+            QUEUE_ENTRY_KIND,
+            message=(f"queued {kind} ({priority}"
+                     + (f", tenant {tenant}" if tenant else "")
+                     + f", {devices} device(s))"),
+            scope="workload")
+        entry = QueueEntry(
+            op_id=op.id, tenant=tenant, kind=kind,
+            priority_class=priority, priority=rank, plan=plan, mesh=mesh,
+            steps=steps, mode=mode, devices=devices)
+        entry.validate()
+        self.repos.workload_queue.save(entry)
+        self._sync_op(entry, op=op)
+        log.info("workload %s queued: %s %s priority=%s tenant=%s "
+                 "devices=%d", entry.id[:8], kind, mesh or "(default)",
+                 priority, tenant or "-", devices)
+        self.schedule()
+        # the engine always gets a kick; `wait` only picks the caller's
+        # thread (CLI) vs a background one (REST). When a loop is already
+        # live — including THIS thread's own, for submissions made from a
+        # running train's step hook — process() returns immediately and
+        # the owning loop picks the entry up at its next boundary.
+        self.process(wait=wait)
+        return self.status(entry.id)
+
+    # ---------------------------------------------------------- capacity ----
+    def pool_view(self) -> tuple[SlicePoolView, str]:
+        """The schedulable slice pool: `queue.slices`/`chips_per_slice`
+        when pinned, else every Ready TPU cluster's slices, else one
+        virtual slice over the locally visible devices (the bare tier-1
+        stack — a queue on an empty platform still runs workloads, like
+        `workload train` always has)."""
+        import jax
+
+        slots: list[SliceSlot] = []
+        source = "config"
+        if self.cfg_slices > 0:
+            chips = self.cfg_chips or max(
+                len(jax.devices()) // self.cfg_slices, 1)
+            slots = [SliceSlot(f"pool/{i}", chips)
+                     for i in range(self.cfg_slices)]
+        else:
+            for cluster in self.repos.clusters.find(phase="Ready"):
+                if not cluster.plan_id:
+                    continue
+                try:
+                    plan = self.repos.plans.get(cluster.plan_id)
+                    if not plan.has_tpu():
+                        continue
+                    topo = plan.topology()
+                except Exception:
+                    continue
+                for i in range(topo.num_slices):
+                    slots.append(SliceSlot(f"{cluster.name}/{i}",
+                                           topo.chips))
+            source = "clusters"
+            if not slots:
+                slots = [SliceSlot("local/0", len(jax.devices()))]
+                source = "local"
+        view = SlicePoolView(slots=slots)
+        for e in self.repos.workload_queue.active():
+            if e.placement:
+                view.holders[e.id] = list(e.placement)
+        return view, source
+
+    def capacity(self) -> dict:
+        """The operator's capacity view (`koctl workload queue`
+        header)."""
+        view, source = self.pool_view()
+        return {
+            "slices": view.total,
+            "chips_per_slice": view.chips_per_slice,
+            "free": view.free_slices(),
+            "held": {k: v for k, v in sorted(view.holders.items())},
+            "source": source,
+        }
+
+    # ---------------------------------------------------------- schedule ----
+    def schedule(self) -> dict:
+        """One scheduling pass (pure decisions in workloads/queue.py):
+        place whole gangs by priority, and — when the head pending entry
+        is blocked — evict the cheapest strictly-lower-priority victim
+        set: a drain for the victim that is physically running (the
+        PR-11 checkpoint+drain protocol), a displacement for one that
+        merely holds a reservation. Safe to call from any thread,
+        including a running train's step hook (it mutates state only;
+        dispatch belongs to the engine loop)."""
+        with self._lock:
+            pending = self.repos.workload_queue.pending()
+            active = self.repos.workload_queue.active()
+            view, _source = self.pool_view()
+            decision = plan_schedule(pending, active, view,
+                                     preempt=self.preempt)
+            placed_ids = []
+            for entry in pending:
+                placement = decision.placements.get(entry.id)
+                if placement is None:
+                    continue
+                entry.placement = list(placement)
+                entry.slices_needed = len(placement)
+                entry.state = "placed"
+                self.repos.workload_queue.save(entry)
+                self._sync_op(entry)
+                placed_ids.append(entry.id)
+            head = next((e for e in pending
+                         if e.id not in decision.placements), None)
+            for victim_id in decision.victims:
+                self._evict(victim_id, by=head)
+            return {"placed": placed_ids,
+                    "victims": list(decision.victims)}
+
+    def _evict(self, victim_id: str, by) -> None:
+        """Enact one eviction decision (under _lock, via schedule)."""
+        try:
+            victim = self.repos.workload_queue.get(victim_id)
+        except NotFoundError:
+            return
+        by_id = by.id if by is not None else ""
+        if victim.state == "running":
+            if victim.preempted_by:
+                return   # a drain is already in flight for it
+            if victim.id != self._running_id:
+                # the engine is between states (or the row is a crash
+                # strand the reconciler owns): marking preempted_by with
+                # no drain to back it would block every later pass —
+                # leave it, the next schedule pass retries
+                return
+            victim.preempted_by = by_id
+            self.repos.workload_queue.save(victim)
+            self._sync_op(victim)
+            self.workloads.request_drain(
+                f"preempted by workload {by_id[:8]} "
+                f"({by.priority_class})" if by is not None
+                else "preempted")
+            return
+        if victim.state == "placed":
+            # never started: displace the reservation, nothing to drain
+            victim.placement = []
+            victim.state = "pending"
+            victim.preemptions = list(victim.preemptions) + [{
+                "kind": "displaced", "by": by_id, "at": now_ts(),
+            }]
+            self.repos.workload_queue.save(victim)
+            self._sync_op(victim)
+            log.info("workload %s displaced by %s before it started",
+                     victim.id[:8], by_id[:8])
+
+    # ------------------------------------------------------------ engine ----
+    def process(self, wait: bool = True):
+        """The dispatch loop: schedule, run the highest-priority placed
+        entry to its next terminal/drained state, repeat until nothing is
+        runnable. Exactly one loop owns execution at a time; a second
+        caller returns immediately (its entry is already in the state
+        the owning loop consumes). `wait=False` runs the loop on a
+        background thread (the REST submit path and the reconciler's
+        recovery kick)."""
+        if not wait:
+            with self._lock:
+                if self._engine_active:
+                    return None   # a live loop will pick the work up
+                t = threading.Thread(target=self._process_guarded,
+                                     daemon=True, name="workload-queue")
+                self._threads.append(t)
+            t.start()
+            return None
+        return self._process_guarded()
+
+    def _process_guarded(self):
+        from kubeoperator_tpu.resilience.lease import StaleEpochError
+
+        with self._lock:
+            if self._engine_active:
+                return {"dispatched": 0, "engine": "busy"}
+            self._engine_active = True
+        dispatched = 0
+        try:
+            while True:
+                self.schedule()
+                entry = self._next_placed()
+                if entry is None:
+                    break
+                self._run_one(entry)
+                dispatched += 1
+        except StaleEpochError as e:
+            # fenced out mid-dispatch: a peer owns this queue state now —
+            # stop cleanly, the new owner's engine continues the work
+            log.warning("workload-queue engine fenced out: %s", e)
+        finally:
+            with self._lock:
+                self._engine_active = False
+        return {"dispatched": dispatched}
+
+    def _next_placed(self) -> QueueEntry | None:
+        placed = [e for e in self.repos.workload_queue.active()
+                  if e.state == "placed"]
+        placed.sort(key=lambda e: (-e.priority, e.created_at, e.id))
+        return placed[0] if placed else None
+
+    def _run_one(self, entry: QueueEntry) -> None:
+        """Dispatch one placed entry through the existing WorkloadService
+        seam and fold the outcome back into queue state. The run op
+        stitches under the entry op (one trace per tenant workload life:
+        queue-wait → run → drain → resume)."""
+        op = self.repos.operations.get(entry.op_id)
+        first_dispatch = entry.started_at == 0.0
+        if first_dispatch:
+            entry.started_at = now_ts()
+            self.journal.record_windows(op, [{
+                "name": "queue-wait", "start": entry.created_at,
+                "end": entry.started_at,
+                "attrs": {"priority": entry.priority_class,
+                          "tenant": entry.tenant,
+                          "slices": len(entry.placement)},
+            }])
+        with self._lock:
+            # _running_id and the persisted `running` flip TOGETHER
+            # under the scheduler's lock: a concurrent schedule() either
+            # sees `placed` (and may displace) or running-with-an-engine
+            # (and can route a drain) — never a running row no drain can
+            # reach
+            self._running_id = entry.id
+            entry.state = "running"
+            self.repos.workload_queue.save(entry)
+            self._sync_op(entry, op=op)
+        trace = ({"trace_id": op.trace_id, "parent_span_id": op.id}
+                 if op.trace_id else None)
+        try:
+            if entry.kind == "sweep":
+                run_desc = self.workloads.sweep(
+                    steps=entry.steps, tenant=entry.tenant,
+                    trace=trace, parent_op_id=entry.op_id)
+            elif entry.checkpoint:
+                # a previously-drained victim: restore its own checkpoint
+                # and finish the remaining steps (train's resume math)
+                run_desc = self.workloads.train(
+                    resume=True, checkpoint=entry.checkpoint,
+                    mesh=entry.mesh, mode=entry.mode,
+                    tenant=entry.tenant, trace=trace,
+                    parent_op_id=entry.op_id)
+            else:
+                run_desc = self.workloads.train(
+                    plan=entry.plan, mesh=entry.mesh, steps=entry.steps,
+                    mode=entry.mode, tenant=entry.tenant, trace=trace,
+                    parent_op_id=entry.op_id)
+        except Exception as e:
+            with self._lock:
+                self._running_id = ""
+            entry = self.repos.workload_queue.get(entry.id)
+            entry.placement = []
+            entry.preempted_by = ""
+            self._finish(entry, "failed", f"{type(e).__name__}: {e}")
+            return
+        finally:
+            with self._lock:
+                self._running_id = ""
+        # reload: a scheduling pass during the run may have marked a
+        # preemption (preempted_by) or a cancel on the row
+        entry = self.repos.workload_queue.get(entry.id)
+        entry.run_ops = list(entry.run_ops) + [run_desc["id"]]
+        result = run_desc.get("result") or {}
+        if run_desc.get("checkpoint"):
+            entry.checkpoint = run_desc["checkpoint"]["id"]
+        if result.get("drained"):
+            self._handle_drained(entry, run_desc, result)
+            return
+        entry.preempted_by = ""
+        entry.placement = []
+        if run_desc["status"] == "Succeeded" and (
+                result.get("ok") or entry.kind == "sweep"):
+            self._finish(entry, "done", run_desc.get("message", ""))
+        else:
+            self._finish(entry, "failed",
+                         run_desc.get("message", "run unhealthy"))
+
+    def _handle_drained(self, entry: QueueEntry, run_desc: dict,
+                        result: dict) -> None:
+        """A run that checkpoint+drained mid-flight: a preemption victim
+        re-enters the queue (and auto-resumes from its checkpoint when
+        capacity returns), a cancel target finishes `cancelled`. Either
+        way the eviction is ledgered on the entry and as a span in the
+        stitched trace."""
+        op = self.repos.operations.get(entry.op_id)
+        ckpt = (run_desc.get("checkpoint") or {}).get("id", "")
+        record = {
+            "kind": "drained",
+            "by": entry.preempted_by,
+            "reason": result.get("drain_reason", ""),
+            "step": result.get("end_step"),
+            "checkpoint": ckpt,
+            "run_op": run_desc["id"],
+            "at": now_ts(),
+        }
+        entry.preemptions = list(entry.preemptions) + [record]
+        entry.checkpoint = ckpt or entry.checkpoint
+        entry.placement = []
+        entry.preempted_by = ""
+        self.journal.record_windows(op, [{
+            "name": "preempt", "start": now_ts(), "end": now_ts(),
+            "attrs": {k: v for k, v in record.items()
+                      if k not in ("at",) and v not in ("", None)},
+        }])
+        if entry.cancel_requested:
+            self._finish(entry, "cancelled",
+                         "cancelled by operator (drained at step "
+                         f"{result.get('end_step')})")
+            return
+        entry.state = "drained"
+        self.repos.workload_queue.save(entry)
+        self._sync_op(entry, op=op)
+        # straight back into the queue: the checkpoint carries the state,
+        # the scheduler re-places it when capacity returns
+        entry.state = "pending"
+        self.repos.workload_queue.save(entry)
+        self._sync_op(entry, op=op)
+        log.info("workload %s drained at step %s (checkpoint %s); "
+                 "re-queued", entry.id[:8], result.get("end_step"),
+                 ckpt[:8] if ckpt else "-")
+
+    # ------------------------------------------------------------ cancel ----
+    def cancel(self, ref: str) -> dict:
+        """Cancel a queue entry: pending/placed entries finish
+        `cancelled` immediately; a running entry gets the drain protocol
+        (checkpoint at the next step boundary, THEN cancelled) so even a
+        cancel never loses tenant state."""
+        entry = self.resolve(ref)
+        if entry.terminal:
+            raise ValidationError(
+                f"queue entry {entry.id[:8]} already finished "
+                f"({entry.state})")
+        with self._lock:
+            if entry.state == "running" and entry.id == self._running_id:
+                # a LIVE run: drain first (checkpoint at the next step
+                # boundary), the engine finishes the cancel when the
+                # drained run returns
+                entry.cancel_requested = True
+                self.repos.workload_queue.save(entry)
+                self._sync_op(entry)
+                self.workloads.request_drain("cancelled by operator")
+                return self.describe(entry)
+        # pending/placed — or a crash-stranded "running" row with no
+        # engine behind it (its op is Interrupted): nothing is live,
+        # finish the cancel directly
+        entry.placement = []
+        self._finish(entry, "cancelled", "cancelled by operator")
+        return self.status(entry.id)
+
+    # ---------------------------------------------------------- recovery ----
+    def recover(self, op_id: str = "", wait: bool = False) -> list[str]:
+        """Boot/lease-sweep recovery (service/reconcile.py): re-arm
+        Interrupted queue-entry ops (`journal.reopen` — same resumable
+        contract as fleet rollouts), put their entries back to pending
+        (a previously-drained victim keeps its checkpoint and resumes
+        from it), and kick the engine. Returns the re-queued entry
+        ids."""
+        ops = [o for o in self.repos.operations.find(
+            kind=QUEUE_ENTRY_KIND,
+            status=OperationStatus.INTERRUPTED.value)
+            if not op_id or o.id == op_id]
+        requeued: list[str] = []
+        for op in ops:
+            entry = self.repos.workload_queue.by_op(op.id)
+            if entry is None or entry.terminal:
+                continue
+            self.journal.reopen(
+                op, message="re-queued after controller restart")
+            entry.state = "pending"
+            entry.placement = []
+            entry.preempted_by = ""
+            self.repos.workload_queue.save(entry)
+            self._sync_op(entry, op=op)
+            requeued.append(entry.id)
+            log.info("queue entry %s (%s) re-queued after interruption",
+                     entry.id[:8], entry.kind)
+        if requeued:
+            self.process(wait=wait)
+        return requeued
+
+    def wait_all(self, timeout_s: float = 300.0) -> None:
+        """Join background engine threads (container close)."""
+        with self._lock:
+            threads = list(self._threads)
+        for t in threads:
+            t.join(timeout_s)
+        with self._lock:
+            self._threads = [t for t in self._threads if t.is_alive()]
+
+    # ----------------------------------------------------------- queries ----
+    def resolve(self, ref: str) -> QueueEntry:
+        """A queue entry by exact id or unique >=6-char prefix (the
+        journal's op-ref resolution contract, applied to queue rows)."""
+        if not ref:
+            rows = self.repos.workload_queue.list()
+            if not rows:
+                raise NotFoundError(kind="queue entry", name="(latest)")
+            return rows[-1]
+        try:
+            return self.repos.workload_queue.get(ref)
+        except NotFoundError:
+            pass
+        rows = self.repos.workload_queue.list()
+        matches = ([e for e in rows if e.id.startswith(ref)]
+                   if len(ref) >= 6 else [])
+        if len(matches) == 1:
+            return matches[0]
+        if len(matches) > 1:
+            raise ValidationError(
+                f"queue entry ref {ref!r} is ambiguous "
+                f"({len(matches)} matches)")
+        raise NotFoundError(kind="queue entry", name=ref)
+
+    def describe(self, entry: QueueEntry) -> dict:
+        try:
+            op_status = self.repos.operations.get(entry.op_id).status
+        except NotFoundError:
+            op_status = ""
+        wait_s = (round(entry.started_at - entry.created_at, 3)
+                  if entry.started_at else None)
+        return {
+            "id": entry.id,
+            "op_id": entry.op_id,
+            "op_status": op_status,
+            "tenant": entry.tenant,
+            "kind": entry.kind,
+            "priority": entry.priority_class,
+            "state": entry.state,
+            "plan": entry.plan,
+            "mesh": entry.mesh,
+            "steps": entry.steps,
+            "mode": entry.mode,
+            "devices": entry.devices,
+            "placement": list(entry.placement),
+            "preemptions": list(entry.preemptions),
+            "preempted_by": entry.preempted_by,
+            "checkpoint": entry.checkpoint,
+            "run_ops": list(entry.run_ops),
+            "submitted_at": entry.created_at,
+            "started_at": entry.started_at or None,
+            "finished_at": entry.finished_at or None,
+            "queue_wait_s": wait_s,
+            "message": entry.message,
+        }
+
+    def entries(self) -> list[dict]:
+        rows = self.repos.workload_queue.list()
+        return [self.describe(e) for e in reversed(rows)]
+
+    def status(self, ref: str = "") -> dict:
+        return self.describe(self.resolve(ref))
+
+    def queue_view(self) -> dict:
+        """`koctl workload queue` / GET /api/v1/workloads/queue: the
+        capacity header plus every entry, newest first."""
+        return {"capacity": self.capacity(), "entries": self.entries()}
+
+    # ----------------------------------------------------------- plumbing ---
+    def _sync_op(self, entry: QueueEntry, op=None) -> None:
+        """Mirror the entry's scheduler-visible state into its journal
+        op's vars — the durable half of the queue contract (fenced like
+        every journal write, so a fenced-out scheduler cannot clobber a
+        successor's queue state)."""
+        if op is None:
+            op = self.repos.operations.get(entry.op_id)
+        op.vars["entry"] = {
+            "state": entry.state,
+            "tenant": entry.tenant,
+            "kind": entry.kind,
+            "priority": entry.priority_class,
+            "mesh": entry.mesh,
+            "devices": entry.devices,
+            "placement": list(entry.placement),
+            "preemptions": list(entry.preemptions),
+            "preempted_by": entry.preempted_by,
+            "checkpoint": entry.checkpoint,
+            "run_ops": list(entry.run_ops),
+            "cancel_requested": entry.cancel_requested,
+        }
+        self.journal.save_vars(op)
+
+    def _finish(self, entry: QueueEntry, state: str,
+                message: str = "") -> None:
+        entry.state = state
+        entry.message = message
+        entry.finished_at = now_ts()
+        entry.cancel_requested = False
+        self.repos.workload_queue.save(entry)
+        op = self.repos.operations.get(entry.op_id)
+        self._sync_op(entry, op=op)
+        if op.open:
+            self.journal.close(op, ok=(state == "done"),
+                               message=message or state)
+        log.info("queue entry %s finished: %s (%s)", entry.id[:8], state,
+                 message)

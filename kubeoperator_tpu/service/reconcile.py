@@ -65,6 +65,12 @@ AUTO_RESUME_FLEET = frozenset({"fleet-upgrade"})
 # save — the resume opens a NEW op stitched into the original's trace
 # (the old op's spans are not re-armed, unlike fleet reopen)
 AUTO_RESUME_WORKLOAD = frozenset({"workload-train"})
+# queue-entry ops re-enter through WorkloadQueueService.recover: the
+# entry goes back to `pending` with its checkpoint (if a drain landed
+# one) intact, the entry op is REOPENED (journal.reopen, the fleet
+# contract — its spans are the tenant's whole stitched life), and the
+# engine re-dispatches under normal gang/priority rules
+AUTO_RESUME_QUEUE = frozenset({"workload-queued"})
 
 
 def resume_point(cluster) -> str:
@@ -110,6 +116,26 @@ class ReconcileService:
                 msg = (f"{cause}: fleet rollout was in flight "
                        f"(wave {wave}); `koctl fleet resume` continues "
                        f"without re-running completed clusters")
+            elif op.kind in AUTO_RESUME_QUEUE:
+                state = (op.vars.get("entry") or {}).get("state", "?")
+                ckpt = (op.vars.get("entry") or {}).get("checkpoint", "")
+                resume = "queue"
+                msg = (f"{cause}: queued workload was {state}; it "
+                       f"re-enters the queue as pending"
+                       + (f" and resumes from checkpoint {ckpt[:8]}"
+                          if ckpt else "")
+                       + " when the engine next dispatches")
+            elif op.kind in AUTO_RESUME_WORKLOAD \
+                    and self._queue_dispatched(op):
+                # a run the QUEUE dispatched: its entry op is being
+                # re-queued by the AUTO_RESUME_QUEUE path above, and the
+                # engine re-dispatches (resuming the entry's checkpoint)
+                # under normal gang/priority rules — a second, un-queued
+                # resume here would race it on the same devices
+                resume = ""
+                msg = (f"{cause}: queue-dispatched {op.kind} was in "
+                       f"flight; its queue entry re-queues and resumes "
+                       f"it — no standalone resume")
             elif op.kind in AUTO_RESUME_WORKLOAD:
                 ckpt = self._workload_checkpoint(op)
                 if ckpt is not None:
@@ -132,6 +158,7 @@ class ReconcileService:
             return {
                 "cluster": op.cluster_name, "op": op.id, "kind": op.kind,
                 "resume_phase": op.resume_phase,
+                "tenant": str((op.vars or {}).get("tenant", "") or ""),
             }
         cluster = None
         try:
@@ -160,15 +187,32 @@ class ReconcileService:
             "_cluster_id": cluster.id if cluster is not None else "",
         }
 
+    def _queue_dispatched(self, op) -> bool:
+        """Whether a workload op was dispatched by the queue (its parent
+        is a queue-entry op) — those resume through the queue, never
+        standalone."""
+        from kubeoperator_tpu.service.queue import QUEUE_ENTRY_KIND
+
+        if not op.parent_op_id:
+            return False
+        try:
+            parent = self.services.repos.operations.get(op.parent_op_id)
+        except Exception:
+            return False
+        return parent.kind == QUEUE_ENTRY_KIND
+
     def _workload_checkpoint(self, op):
         """The orphaned workload op's restorable state: its own newest
-        complete checkpoint, else the newest complete one overall (the
-        op may have died before its first save while an earlier run's
-        checkpoint still carries the tenant's state). None = nothing to
-        resume from."""
+        complete checkpoint, else the newest complete one in the SAME
+        tenant namespace (the op may have died before its first save
+        while an earlier run's checkpoint still carries the tenant's
+        state — but never another tenant's: the resume paths' isolation
+        contract applies to the fallback too). None = nothing to resume
+        from."""
         repos = self.services.repos
+        tenant = str((op.vars or {}).get("tenant", "") or "")
         return (repos.checkpoints.latest_complete(op_id=op.id)
-                or repos.checkpoints.latest_complete())
+                or repos.checkpoints.latest_complete(tenant=tenant))
 
     # ---- boot sweep ----
     def boot_sweep(self) -> list[dict]:
@@ -369,6 +413,13 @@ class ReconcileService:
                 log.info("auto-resumed fleet rollout %s after controller "
                          "restart", record["op"])
                 return True
+            if kind in AUTO_RESUME_QUEUE:
+                requeued = self.services.workload_queue.recover(
+                    op_id=record["op"], wait=False)
+                if requeued:
+                    log.info("re-queued workload entry for op %s after "
+                             "controller restart", record["op"])
+                return bool(requeued)
             if kind in AUTO_RESUME_WORKLOAD:
                 resume_phase = record.get("resume_phase") or ""
                 if not resume_phase.startswith("checkpoint:"):
@@ -377,7 +428,8 @@ class ReconcileService:
                 # async like every other resume verb: the sweep thread
                 # also carries the lease heartbeat — blocking it behind
                 # a compile+train could fence this very controller
-                self.services.workloads.resume_from(ref, wait=False)
+                self.services.workloads.resume_from(
+                    ref, tenant=record.get("tenant", ""), wait=False)
                 log.info("auto-resuming workload %s from checkpoint %s "
                          "after controller restart", record["op"], ref)
                 return True

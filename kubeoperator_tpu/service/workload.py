@@ -41,7 +41,6 @@ import threading
 import time
 
 from kubeoperator_tpu.models import Checkpoint, Operation
-from kubeoperator_tpu.models.span import Span, SpanKind, SpanStatus
 from kubeoperator_tpu.utils.errors import (
     KoError,
     NotFoundError,
@@ -52,6 +51,7 @@ from kubeoperator_tpu.utils.logging import get_logger
 log = get_logger("service.workload")
 
 WORKLOAD_TRAIN_KIND = "workload-train"
+WORKLOAD_SWEEP_KIND = "workload-sweep"
 
 
 def train_kwargs(body: dict) -> dict:
@@ -71,6 +71,7 @@ def train_kwargs(body: dict) -> dict:
         "mode": str(body.get("mode", "") or ""),
         "resume": resume,
         "checkpoint": str(body.get("checkpoint", "") or ""),
+        "tenant": str(body.get("tenant", "") or ""),
     }
 
 
@@ -88,6 +89,9 @@ class WorkloadService:
         # durable-training checkpoints (checkpoint.* DEFAULTS block)
         self.ckpt_enabled = bool(cfg.get("checkpoint.enabled", True))
         self.ckpt_keep = max(int(cfg.get("checkpoint.keep", 5)), 1)
+        # periodic mid-run saves every N step boundaries (0 = end-of-run
+        # and drain saves only) — rides the on_step boundary seam
+        self.ckpt_every = max(int(cfg.get("checkpoint.every_steps", 0)), 0)
         self.ckpt_dir = self._resolve_ckpt_dir(
             str(cfg.get("checkpoint.dir", "") or ""),
             str(cfg.get("db.path", "") or ""))
@@ -141,19 +145,24 @@ class WorkloadService:
             hook(completed, loss)
         return self._drain.is_set()
 
-    def resume_from(self, checkpoint: str = "", wait: bool = True):
+    def resume_from(self, checkpoint: str = "", tenant: str = "",
+                    wait: bool = True):
         """Resume the latest (or named) complete checkpoint. With
         `wait=False` the run happens on a background thread — the
         reconciler's auto-resume posture: a boot or lease sweep must not
         block its own thread (which also carries the lease heartbeat
-        tick) behind a compile+train. Failures on the thread surface as
-        a Failed journal op plus a log line, same as any train."""
+        tick) behind a compile+train. `tenant` keeps the resumed run in
+        the interrupted op's namespace (resolution AND the new run's own
+        saves). Failures on the thread surface as a Failed journal op
+        plus a log line, same as any train."""
         if wait:
-            return self.train(resume=True, checkpoint=checkpoint)
+            return self.train(resume=True, checkpoint=checkpoint,
+                              tenant=tenant)
 
         def run() -> None:
             try:
-                self.train(resume=True, checkpoint=checkpoint)
+                self.train(resume=True, checkpoint=checkpoint,
+                           tenant=tenant)
             except Exception as e:
                 log.warning("background workload resume (checkpoint %r) "
                             "failed: %s", checkpoint, e)
@@ -174,14 +183,24 @@ class WorkloadService:
     # ---- the workload verb ----
     def train(self, plan: str = "", mesh: str = "", steps: int | None = None,
               mode: str = "", resume: bool = False,
-              checkpoint: str = "") -> dict:
+              checkpoint: str = "", tenant: str = "",
+              trace: dict | None = None, parent_op_id: str = "") -> dict:
         """One sharded training run as a journaled operation; returns the
         op description including the run result, rule coverage, and the
         checkpoint it saved. With `resume`, the run restores the full
         TrainState (params + optimizer moments + step counter) from the
         named (or latest) complete checkpoint and continues the exact
         trajectory — default step count is what the original run had
-        left, default mesh is the checkpoint's."""
+        left, default mesh is the checkpoint's.
+
+        `tenant` scopes the run's checkpoints to the tenant's namespace
+        (`<checkpoint.dir>/<tenant>/`, per-tenant retention) and resume
+        resolution to that tenant's rows. `trace`/`parent_op_id` stitch
+        the run op under an EXISTING trace — the workload queue hands
+        each dispatched run its entry op here, so a preempted tenant's
+        whole life (queue wait → run → drain → resume) renders as ONE
+        waterfall; when omitted, a resume stitches under the checkpoint's
+        own op as before."""
         import jax
 
         from kubeoperator_tpu.parallel.mesh import MeshSpec
@@ -202,7 +221,8 @@ class WorkloadService:
             raise ValidationError(
                 "--checkpoint names a resume source; pass resume=true "
                 "with it")
-        ckpt_row = self._resolve_checkpoint(checkpoint) if resume else None
+        ckpt_row = (self._resolve_checkpoint(checkpoint, tenant=tenant)
+                    if resume else None)
 
         if steps is None:
             if resume:
@@ -263,12 +283,13 @@ class WorkloadService:
 
         op_vars = {"plan": plan, "mesh": spec.describe(), "steps": steps,
                    "mode": mode}
-        trace = None
-        parent_op_id = ""
+        if tenant:
+            op_vars["tenant"] = tenant
         if resume:
             op_vars["resumed_from"] = ckpt_row.id
-            parent_op_id = ckpt_row.op_id
-            trace = self._trace_of(ckpt_row.op_id)
+            if not parent_op_id:
+                parent_op_id = ckpt_row.op_id
+                trace = trace or self._trace_of(ckpt_row.op_id)
         op = self.journal.open_scoped(
             WORKLOAD_TRAIN_KIND,
             vars=op_vars,
@@ -298,9 +319,42 @@ class WorkloadService:
                               "step": ckpt_row.step,
                               "bytes": manifest.get("total_bytes", 0)},
                 }])
+            target_planned = (ckpt_row.target_steps if resume else steps)
+
+            def periodic_save(completed: int, live_state) -> None:
+                # checkpoint.every_steps (ISSUE 12 satellite): a durable
+                # mid-run save at the step boundary — same write path,
+                # index row, and per-tenant retention as every other
+                # checkpoint, so a crash between boundaries costs at
+                # most every_steps steps
+                if not self.ckpt_enabled:
+                    return
+                import jax
+                import numpy as np
+
+                t_save = time.time()
+                host = jax.tree_util.tree_map(
+                    lambda l: np.asarray(jax.device_get(l)), live_state)
+                step_now = int(float(np.asarray(host["params"]["step"])))
+                saved = self._write_checkpoint(
+                    op, host, step=step_now,
+                    target_steps=max(target_planned, step_now),
+                    mesh=spec.describe(), seed=seed, losses=(),
+                    tenant=tenant)
+                self._record_windows(op, [{
+                    "name": "checkpoint-save", "start": t_save,
+                    "end": time.time(),
+                    "attrs": {"checkpoint": saved["id"],
+                              "step": step_now, "periodic": True,
+                              "bytes": saved["bytes"]},
+                }])
+
             run = run_training(mesh_obj, steps=steps, mode=mode, seed=seed,
                                state=state, on_step=self._on_step,
-                               return_state=True)
+                               return_state=True,
+                               checkpoint_every=self.ckpt_every,
+                               on_checkpoint=(periodic_save
+                                              if self.ckpt_every else None))
             final_state = run.pop("state", None)
             drained = bool(run.get("stopped_early"))
             windows = run.pop("windows", [])
@@ -320,7 +374,7 @@ class WorkloadService:
             if self.ckpt_enabled:
                 saved = self._save_checkpoint(
                     op, final_state, run, seed=seed,
-                    target_steps=target_steps)
+                    target_steps=target_steps, tenant=tenant)
                 run["checkpoint"] = saved
             if resume:
                 run["resumed_from"] = ckpt_row.id
@@ -369,25 +423,69 @@ class WorkloadService:
             self._drain_reason = ""
         return self.describe(self.repos.operations.get(op.id))
 
+    def sweep(self, steps: int | None = None, tenant: str = "",
+              trace: dict | None = None, parent_op_id: str = "") -> dict:
+        """The scaling-efficiency sweep (workloads/harness.run_sweep) as
+        a JOURNALED operation — PR-9 residue closed: the sweep used to
+        run ad-hoc (bench.py / perf_matrix), leaving no durable record.
+        The workload queue submits it as a `scavenger`-class tenant, so
+        it only runs when the whole pool is free and never displaces a
+        paying workload; `trace`/`parent_op_id` stitch it under its
+        queue entry like any dispatched run. Returns the op description
+        with the per-axis rows in the result."""
+        from kubeoperator_tpu.workloads.harness import run_sweep
+
+        steps = int(steps) if steps is not None else self.default_steps
+        if steps < 2:
+            raise ValidationError(
+                "workload sweep needs steps >= 2 — each swept mesh needs "
+                "a loss pair for its health verdict")
+        op_vars: dict = {"steps": steps}
+        if tenant:
+            op_vars["tenant"] = tenant
+        op = self.journal.open_scoped(
+            WORKLOAD_SWEEP_KIND, vars=op_vars,
+            message=f"scaling-efficiency sweep ({steps} steps per mesh)",
+            scope="workload", trace=trace, parent_op_id=parent_op_id)
+        t0 = time.time()
+        try:
+            report = run_sweep(steps=steps, peak_tflops_per_chip=(
+                self.peak_override or None))
+            self._record_windows(op, [{
+                "name": "sweep", "start": t0, "end": time.time(),
+                "attrs": {"meshes": len(report["rows"]),
+                          "devices": report["devices"]},
+            }])
+            op.vars["result"] = {
+                "ok": report["ok"], "devices": report["devices"],
+                "rows": report["rows"], "axes": report["axes"],
+            }
+            self.journal.save_vars(op)
+            best = max((r["model_tflops_per_s"] for r in report["rows"]),
+                       default=0.0)
+            self.journal.close(
+                op, ok=bool(report["ok"]),
+                message=(f"swept {len(report['rows'])} meshes over "
+                         f"{report['devices']} devices "
+                         f"(best {best} model TFLOP/s)")
+                if report["ok"] else "sweep produced unhealthy runs")
+        except KoError as e:
+            self.journal.close(op, ok=False, message=e.message)
+            raise
+        except Exception as e:
+            self.journal.close(op, ok=False,
+                               message=f"{type(e).__name__}: {e}")
+            raise KoError(
+                f"workload sweep failed ({type(e).__name__}): {e}") from e
+        return self.describe(self.repos.operations.get(op.id))
+
     def _record_windows(self, op: Operation, windows: list) -> None:
-        """Persist the run's named wall-clock windows (compile / steps) as
-        WINDOW spans under the op root — the step-window layer of the
-        trace tree. Ridden through the tracer's payload path (the same
-        road executor-produced task spans take), so the span cap and
-        NullTracer-off behavior apply unchanged."""
-        tracer = self.journal.tracer_for(op)
-        payloads = []
-        for w in windows:
-            payloads.append(Span(
-                trace_id=op.trace_id, parent_id=op.id, op_id=op.id,
-                cluster_id="", name=str(w.get("name", "window")),
-                kind=SpanKind.WINDOW, status=SpanStatus.OK,
-                started_at=float(w.get("start", 0.0)),
-                finished_at=float(w.get("end", 0.0)),
-                attrs=dict(w.get("attrs") or {}),
-            ).to_dict())
-        tracer.record_payload(payloads)
-        tracer.flush()
+        """Persist the run's named wall-clock windows (compile / steps /
+        checkpoint-save/-restore) as WINDOW spans under the op root —
+        the step-window layer of the trace tree (the shared
+        `journal.record_windows` road, so cap/NullTracer behavior match
+        every other window producer)."""
+        self.journal.record_windows(op, windows)
 
     # ---- checkpoints ----
     def _trace_of(self, op_id: str) -> dict | None:
@@ -402,19 +500,24 @@ class WorkloadService:
             return None
         return {"trace_id": orig.trace_id, "parent_span_id": orig.id}
 
-    def _resolve_checkpoint(self, ref: str = "") -> Checkpoint:
+    def _resolve_checkpoint(self, ref: str = "",
+                            tenant: str = "") -> Checkpoint:
         """A COMPLETE checkpoint by exact id, unique >=6-char prefix, or
         — with no ref — the newest one (the journal's op-ref resolution
         contract, applied to checkpoint rows). "Latest" is
         `CheckpointRepo.latest_complete` — the ONE query the slice pool
         and reconciler also use, so it can never mean different rows to
-        different layers."""
+        different layers. A `tenant` scopes both forms to that tenant's
+        namespace — tenant A's `--resume` must never pick up tenant B's
+        state, however fresh."""
+        scope = tenant if tenant else None
         if not ref:
-            row = self.repos.checkpoints.latest_complete()
+            row = self.repos.checkpoints.latest_complete(tenant=scope)
             if row is None:
-                raise NotFoundError(kind="checkpoint", name="(latest)")
+                label = f"(latest:{tenant})" if tenant else "(latest)"
+                raise NotFoundError(kind="checkpoint", name=label)
             return row
-        rows = self.repos.checkpoints.complete()
+        rows = self.repos.checkpoints.complete(tenant=scope)
         matches = [c for c in rows if c.id == ref]
         if not matches and len(ref) >= 6:
             matches = [c for c in rows if c.id.startswith(ref)]
@@ -426,8 +529,43 @@ class WorkloadService:
                 f"({len(matches)} matches)")
         raise NotFoundError(kind="checkpoint", name=ref)
 
+    def _tenant_root(self, tenant: str) -> str:
+        """The tenant's checkpoint namespace: `<checkpoint.dir>/<tenant>/`
+        (the bare root for untenanted runs — pre-queue layouts keep
+        working unchanged)."""
+        return os.path.join(self.ckpt_dir, tenant) if tenant \
+            else self.ckpt_dir
+
+    def _write_checkpoint(self, op: Operation, host, *, step: int,
+                          target_steps: int, mesh: dict, seed: int,
+                          losses, tenant: str = "") -> dict:
+        """Write + index one HOST TrainState checkpoint (manifest last)
+        into the tenant's namespace and apply that tenant's retention.
+        The one write path end-of-run, drain, and periodic saves share."""
+        from kubeoperator_tpu.workloads.checkpoint import (
+            manifest_sha,
+            save_checkpoint,
+        )
+
+        manifest = save_checkpoint(
+            self._tenant_root(tenant), host, step=step,
+            target_steps=target_steps, mesh=mesh, op_id=op.id,
+            losses=losses, seed=seed)
+        row = Checkpoint(
+            id=manifest["id"], op_id=op.id, tenant=tenant, step=step,
+            target_steps=target_steps, dir=manifest["dir"],
+            manifest_sha=manifest_sha(manifest), mesh=dict(mesh),
+            total_bytes=int(manifest["total_bytes"]), status="complete")
+        row.validate()
+        self.repos.checkpoints.save(row)
+        self._prune_checkpoints(keep_id=row.id, tenant=tenant)
+        return {"id": row.id, "step": row.step,
+                "target_steps": target_steps, "dir": row.dir,
+                "bytes": row.total_bytes}
+
     def _save_checkpoint(self, op: Operation, final_state, run: dict,
-                         seed: int, target_steps: int) -> dict | None:
+                         seed: int, target_steps: int,
+                         tenant: str = "") -> dict | None:
         """Gather the final TrainState to host, write the sharded
         checkpoint (manifest last), index it, prune past retention, and
         persist the `checkpoint-save` window span. Returns the summary
@@ -435,44 +573,32 @@ class WorkloadService:
         import jax
         import numpy as np
 
-        from kubeoperator_tpu.workloads.checkpoint import (
-            manifest_sha,
-            save_checkpoint,
-        )
-
         if final_state is None:
             return None
         t_save = time.time()
         host = jax.tree_util.tree_map(
             lambda l: np.asarray(jax.device_get(l)), final_state)
-        manifest = save_checkpoint(
-            self.ckpt_dir, host, step=run["end_step"],
-            target_steps=target_steps, mesh=run["mesh"], op_id=op.id,
-            losses=run["losses"], seed=seed)
-        row = Checkpoint(
-            id=manifest["id"], op_id=op.id, step=run["end_step"],
-            target_steps=target_steps, dir=manifest["dir"],
-            manifest_sha=manifest_sha(manifest), mesh=dict(run["mesh"]),
-            total_bytes=int(manifest["total_bytes"]), status="complete")
-        row.validate()
-        self.repos.checkpoints.save(row)
-        self._prune_checkpoints(keep_id=row.id)
+        saved = self._write_checkpoint(
+            op, host, step=run["end_step"], target_steps=target_steps,
+            mesh=run["mesh"], seed=seed, losses=run["losses"],
+            tenant=tenant)
         self._record_windows(op, [{
             "name": "checkpoint-save", "start": t_save,
             "end": time.time(),
-            "attrs": {"checkpoint": row.id, "step": row.step,
-                      "bytes": row.total_bytes},
+            "attrs": {"checkpoint": saved["id"], "step": saved["step"],
+                      "bytes": saved["bytes"]},
         }])
-        return {"id": row.id, "step": row.step,
-                "target_steps": target_steps, "dir": row.dir,
-                "bytes": row.total_bytes}
+        return saved
 
-    def _prune_checkpoints(self, keep_id: str = "") -> int:
+    def _prune_checkpoints(self, keep_id: str = "",
+                           tenant: str = "") -> int:
         """Retention: keep the newest `checkpoint.keep` complete
-        checkpoints (the just-saved one always survives), delete the
-        rest's directories and flip their rows to `pruned` — rows stay
-        as the audit trail."""
-        rows = self.repos.checkpoints.complete()   # oldest first
+        checkpoints OF THIS TENANT's namespace (the just-saved one
+        always survives), delete the rest's directories and flip their
+        rows to `pruned` — rows stay as the audit trail. Per-tenant
+        scoping is the isolation contract: one tenant's churn can never
+        prune another's rows."""
+        rows = self.repos.checkpoints.complete(tenant=tenant)
         excess = len(rows) - self.ckpt_keep
         pruned = 0
         for row in rows:
@@ -506,13 +632,17 @@ class WorkloadService:
                             "holds a manifest", row.id[:8], row.dir)
         return removed
 
-    def checkpoints(self) -> list[dict]:
+    def checkpoints(self, tenant: str = "") -> list[dict]:
         """Checkpoint index rows, newest first — `koctl workload
-        checkpoints` / GET /api/v1/workloads/checkpoints, the --resume
-        picker and the drill's audit surface."""
-        rows = self.repos.checkpoints.find()
+        checkpoints [--tenant]` / GET /api/v1/workloads/checkpoints,
+        the --resume picker and the drill's audit surface. `tenant`
+        filters to one namespace; "" lists everything (the platform
+        operator's view)."""
+        rows = (self.repos.checkpoints.find(tenant=tenant) if tenant
+                else self.repos.checkpoints.find())
         return [{
-            "id": c.id, "op_id": c.op_id, "step": c.step,
+            "id": c.id, "op_id": c.op_id, "tenant": c.tenant,
+            "step": c.step,
             "target_steps": c.target_steps, "mesh": c.mesh,
             "bytes": c.total_bytes, "status": c.status,
             "created_at": c.created_at,
@@ -520,12 +650,14 @@ class WorkloadService:
 
     # ---- queries ----
     def resolve(self, op_ref: str = "") -> Operation:
-        """A workload op by exact id, unique id prefix, or — with no
-        ref — the newest one (the shared journal resolution contract)."""
+        """A workload op — train or sweep — by exact id, unique id
+        prefix, or — with no ref — the newest one (the shared journal
+        resolution contract)."""
         from kubeoperator_tpu.resilience.journal import resolve_op_ref
 
-        return resolve_op_ref(self.repos, WORKLOAD_TRAIN_KIND, op_ref,
-                              label="workload operation")
+        return resolve_op_ref(
+            self.repos, (WORKLOAD_TRAIN_KIND, WORKLOAD_SWEEP_KIND),
+            op_ref, label="workload operation")
 
     def describe(self, op: Operation) -> dict:
         v = op.vars
@@ -535,6 +667,7 @@ class WorkloadService:
             "kind": op.kind,
             "status": op.status,
             "message": op.message,
+            "tenant": v.get("tenant", ""),
             "plan": v.get("plan", ""),
             "mesh": v.get("mesh", {}),
             "steps": v.get("steps"),
@@ -553,7 +686,9 @@ class WorkloadService:
         }
 
     def list_ops(self) -> list[dict]:
-        ops = self.repos.operations.find(kind=WORKLOAD_TRAIN_KIND)
+        ops = (self.repos.operations.find(kind=WORKLOAD_TRAIN_KIND)
+               + self.repos.operations.find(kind=WORKLOAD_SWEEP_KIND))
+        ops.sort(key=lambda o: (o.created_at, o.id))
         return [self.describe(op) for op in reversed(ops)]
 
     def status(self, op_ref: str = "") -> dict:

@@ -200,6 +200,29 @@ DEFAULTS: dict[str, Any] = {
         # generation's datasheet peak; CPU runs report no MFU)
         "peak_tflops_per_chip": 0,
     },
+    "queue": {
+        # workload queue: gang scheduling + priority preemption over the
+        # slice pool (service/queue.py, docs/workloads.md "Queue and
+        # preemption"). `koctl workload submit` flags override the
+        # per-entry values; this block is the pool posture.
+        # default priority class for submissions that name none
+        # (high/normal/low/scavenger; `workload sweep` always enters at
+        # scavenger)
+        "priority_default": "normal",
+        # pin the pool to N schedulable slices (0 = derive from Ready
+        # TPU clusters' topologies, falling back to one virtual slice
+        # over the locally visible devices)
+        "slices": 0,
+        # chips per pinned slice (0 = derive: local devices / slices)
+        "chips_per_slice": 0,
+        # allow a blocked higher-priority gang to checkpoint-drain
+        # strictly-lower-priority holders; off = strict FIFO-by-priority
+        # waiting, nothing is ever evicted
+        "preempt": True,
+        # admission bound on live (non-terminal) entries — a runaway
+        # submitter gets a clean 400, not an unbounded journal
+        "max_entries": 64,
+    },
     "checkpoint": {
         # durable-training checkpoints (workloads/checkpoint.py,
         # docs/workloads.md "Checkpoints"): sharded, content-hashed,
@@ -213,9 +236,15 @@ DEFAULTS: dict[str, Any] = {
         # the SQLite database file (tests and drills inherit their tmp
         # stacks' isolation automatically)
         "dir": "",
-        # retention: keep the newest N complete checkpoints, prune the
-        # rest (directory deleted, row flipped to `pruned`)
+        # retention: keep the newest N complete checkpoints PER TENANT
+        # namespace, prune the rest (directory deleted, row flipped to
+        # `pruned`)
         "keep": 5,
+        # periodic mid-run saves every N completed step boundaries
+        # (0 = save only at end-of-run and on drains); rides the same
+        # on_step boundary the drain protocol uses, so a crash between
+        # boundaries costs at most every_steps steps
+        "every_steps": 0,
     },
     "chaos": {
         # seeded fault injection over the executor (resilience/chaos.py);

@@ -8,11 +8,15 @@ Layers (docs/workloads.md):
 * `step` — the (data, fsdp, tp) train step behind ONE `compile_step`
   seam: pjit when explicit shardings exist, shard_map fallback;
 * `harness` — the per-axis scaling-efficiency / MFU sweep behind
-  bench.py's one-line JSON contract.
+  bench.py's one-line JSON contract;
+* `queue` — the workload queue's pure decision layer: whole-gang slice
+  placement and priority-preemption victim choice.
 
 `service/workload.py` runs these as journaled platform operations
 (`koctl workload train`), inheriting the operations journal, span trees
-and lease fencing.
+and lease fencing; `service/queue.py` schedules them as queued tenants
+(gang scheduling + priority preemption, docs/workloads.md "Queue and
+preemption").
 """
 
 from kubeoperator_tpu.workloads.partition import (

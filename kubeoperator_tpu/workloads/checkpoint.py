@@ -274,13 +274,20 @@ def _dir_age_s(directory: str) -> float:
     return max(now_ts() - newest, 0.0)
 
 
-def sweep_torn(root_dir: str, min_age_s: float = TORN_MIN_AGE_S) -> list[str]:
+def sweep_torn(root_dir: str, min_age_s: float = TORN_MIN_AGE_S,
+               _depth: int = 0) -> list[str]:
     """Boot hygiene: delete checkpoint directories a dead controller left
     WITHOUT a readable manifest (the torn-save signature) plus any
     stranded `.tmp-*` files inside complete ones. Returns the removed
     paths. Restore never trusts these anyway (load_manifest refuses);
     the sweep just reclaims the disk and keeps `koctl workload` listings
     honest.
+
+    Tenant namespaces (`<root>/<tenant>/<checkpoint-id>/`) are swept
+    per-namespace: a manifest-less directory that CONTAINS
+    subdirectories is a namespace, not a torn save — the sweep recurses
+    one level into it instead of deleting a whole tenant's history as
+    "debris". Only the top level recurses (checkpoint dirs never nest).
 
     `min_age_s` is the multi-replica guard: a manifest-less directory
     whose newest write is younger than this is treated as a PEER's save
@@ -297,6 +304,14 @@ def sweep_torn(root_dir: str, min_age_s: float = TORN_MIN_AGE_S) -> list[str]:
         try:
             load_manifest(directory)
         except CheckpointError:
+            if _depth == 0 and any(
+                    os.path.isdir(os.path.join(directory, child))
+                    for child in os.listdir(directory)):
+                # a tenant namespace: sweep INSIDE it, never the
+                # namespace itself (one tenant's torn debris must not
+                # take a sibling checkpoint with it)
+                removed.extend(sweep_torn(directory, min_age_s, _depth=1))
+                continue
             if _dir_age_s(directory) < min_age_s:
                 log.info("checkpoint dir %s has no manifest but was "
                          "written recently — possibly a peer's in-flight "

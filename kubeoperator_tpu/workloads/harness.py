@@ -58,7 +58,8 @@ ROW_SCHEMA = ("axis", "devices", "mesh", "mode", "steps", "steps_per_s",
 
 def run_training(mesh, cfg: NetConfig | None = None, steps: int = 4,
                  mode: str = "auto", rules=None, seed: int = 0,
-                 state=None, on_step=None, return_state: bool = False) -> dict:
+                 state=None, on_step=None, return_state: bool = False,
+                 checkpoint_every: int = 0, on_checkpoint=None) -> dict:
     """One training run on one mesh: compile, step, fence, judge.
 
     Returns the full per-run record including ``windows`` — named
@@ -82,6 +83,14 @@ def run_training(mesh, cfg: NetConfig | None = None, steps: int = 4,
     * ``return_state`` — ride the final (device) TrainState back on the
       record under ``"state"`` so the caller can checkpoint it; the key
       is not JSON and is popped before anything persists the record.
+    * ``checkpoint_every`` / ``on_checkpoint(completed, state)`` — the
+      periodic mid-run checkpoint seam (`checkpoint.every_steps`): every
+      N completed steps the live (device) TrainState is handed to the
+      callback at the same step boundary the drain check uses. The
+      callback's work (gather + disk) runs INSIDE the timed steps window
+      — periodic durability is honest wall-clock, not free — and must
+      not mutate the state (a save is a read). The final step is skipped
+      (the end-of-run save already covers it).
 
     ``start_step``/``end_step`` in the record come from the state's own
     step counter, so a resumed run says where in the workload's life it
@@ -112,15 +121,27 @@ def run_training(mesh, cfg: NetConfig | None = None, steps: int = 4,
     float(jax.device_get(loss))
     float(jax.device_get(state["params"]["step"]))  # compile the end fence too
     t_compiled = time.time()
+
+    def periodic(completed: int) -> None:
+        # the mid-run checkpoint boundary: after the drain check so a
+        # drain-triggered save (the service's) never doubles with a
+        # periodic one at the same step, and never on the final step
+        # (the end-of-run save covers it)
+        if on_checkpoint and checkpoint_every > 0 and completed < steps \
+                and completed % checkpoint_every == 0:
+            on_checkpoint(completed, state)
+
     stopped = bool(on_step and on_step(1, loss))
     t0 = time.perf_counter()
     if not stopped:
+        periodic(1)   # inside the timed window, like every later save
         for _ in range(max(steps - 1, 0)):
             loss, state = step_fn(state, x)
             device_losses.append(loss)
             if on_step and on_step(len(device_losses), loss):
                 stopped = True
                 break
+            periodic(len(device_losses))
     # the end fence: a scalar that data-depends on the LAST update
     end_step = int(float(jax.device_get(state["params"]["step"])))
     dt = time.perf_counter() - t0

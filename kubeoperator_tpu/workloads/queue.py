@@ -1,0 +1,153 @@
+"""Gang scheduling and priority preemption over the slice pool — the
+pure decision layer (ISSUE 12 tentpole; docs/workloads.md "Queue and
+preemption").
+
+Everything here is arithmetic over plain data so the scheduler's
+decisions are unit-testable without a database or a mesh:
+
+* **Slices, not chips, are the placement unit.** A workload's requested
+  mesh is converted to a gang size with `slices_needed` (whole slices,
+  rounded up); `SlicePoolView` names the concrete slices and who holds
+  them. This matches the failure domain: preemption takes a slice, so
+  packing at sub-slice granularity would put two tenants in one blast
+  radius.
+* **Gang semantics**: `plan_schedule` places an entry only when its
+  WHOLE gang fits — there is no partial placement, ever. Scheduling is
+  strict-priority with FIFO inside a class and NO backfill: when the
+  head entry cannot fit, nothing behind it is placed either. Backfill
+  would keep the pool busy but can starve wide gangs forever — a queue
+  that may run multi-slice trainings chooses head-of-line blocking over
+  that (the starvation trade is documented in docs/workloads.md).
+* **Priority preemption**: when the head entry still cannot fit,
+  `choose_victims` picks the cheapest set of strictly-LOWER-priority
+  holders to evict — lowest priority class first, youngest submission
+  first within a class (the entry that has been running longest keeps
+  its slices longest). Equal priority never preempts: two `normal`
+  tenants queue honestly behind each other.
+
+The service layer (service/queue.py) owns all state, journals, and the
+actual drain/dispatch; it calls these functions with snapshots.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from kubeoperator_tpu.models.workload import priority_of
+
+
+def slices_needed(devices: int, chips_per_slice: int) -> int:
+    """Whole slices a `devices`-chip mesh occupies (ceiling division;
+    a zero-device request still occupies one slice — a gang is never
+    empty)."""
+    chips = max(int(chips_per_slice), 1)
+    return max(-(-int(devices) // chips), 1)
+
+
+@dataclass(frozen=True)
+class SliceSlot:
+    """One schedulable slice of the pool."""
+
+    slice_id: str   # "cluster/0" for real slices, "local/0" for virtual
+    chips: int
+
+
+@dataclass
+class SlicePoolView:
+    """A snapshot of pool capacity + current holders, built by the
+    service per scheduling pass. `holders` maps entry id → the slice ids
+    its placement pins."""
+
+    slots: list[SliceSlot] = field(default_factory=list)
+    holders: dict[str, list[str]] = field(default_factory=dict)
+
+    @property
+    def total(self) -> int:
+        return len(self.slots)
+
+    @property
+    def chips_per_slice(self) -> int:
+        """The pool's slice granularity (the minimum over slots, so a
+        mixed-generation pool never over-promises a slice)."""
+        return min((s.chips for s in self.slots), default=0)
+
+    def free_slices(self) -> list[str]:
+        held = {sid for ids in self.holders.values() for sid in ids}
+        return [s.slice_id for s in self.slots if s.slice_id not in held]
+
+    def place(self, entry_id: str, count: int) -> list[str] | None:
+        """Reserve `count` free slices for `entry_id` — all or nothing
+        (THE gang rule). Returns the placement, or None when the whole
+        gang does not fit."""
+        free = self.free_slices()
+        if count > len(free):
+            return None
+        placement = free[:count]
+        self.holders[entry_id] = placement
+        return placement
+
+    def release(self, entry_id: str) -> None:
+        self.holders.pop(entry_id, None)
+
+
+def choose_victims(entries, needed: int, free: int, priority: int) -> list:
+    """The preemption decision: the cheapest set of strictly-lower-
+    priority capacity holders whose eviction (plus the already-free
+    slices) lets a `needed`-slice gang of rank `priority` fit. Victim
+    order is lowest priority class first, YOUNGEST submission first
+    within a class — the longest-running workload of a class is evicted
+    last. Returns [] when no legal victim set exists (the arrival waits
+    like anyone else).
+
+    `entries` are the active (placed/running) QueueEntry snapshots; only
+    their priority/created_at/placement sizes are consulted."""
+    if needed <= free:
+        return []
+    candidates = sorted(
+        (e for e in entries if e.priority < priority and e.placement),
+        key=lambda e: (e.priority, -e.created_at),
+    )
+    victims, reclaim = [], free
+    for entry in candidates:
+        victims.append(entry)
+        reclaim += len(entry.placement)
+        if reclaim >= needed:
+            return victims
+    return []   # even evicting every lower-priority holder is not enough
+
+
+@dataclass(frozen=True)
+class ScheduleDecision:
+    """One pass's verdict, returned to the service to enact:
+    `placements` — entry id → slice ids to reserve now (whole gangs);
+    `victims` — active entry ids to evict (checkpoint+drain if running,
+    displace if merely placed) so the blocked head entry fits on a later
+    pass; empty when nothing was blocked or no legal victim set exists."""
+
+    placements: dict = field(default_factory=dict)
+    victims: tuple = ()
+
+
+def plan_schedule(pending, active, pool: SlicePoolView,
+                  preempt: bool = True) -> ScheduleDecision:
+    """One scheduling pass. `pending` is already in dispatch order
+    (priority desc, FIFO within class); `active` are the placed/running
+    entries whose placements are registered in `pool.holders`. Places
+    whole gangs until the head entry no longer fits; then — with
+    `preempt` — nominates victims for the blocked head. No backfill past
+    a blocked head (module docstring)."""
+    placements: dict = {}
+    chips = pool.chips_per_slice
+    for entry in pending:
+        needed = slices_needed(entry.devices, chips)
+        placed = pool.place(entry.id, needed)
+        if placed is not None:
+            placements[entry.id] = placed
+            continue
+        victims: tuple = ()
+        if preempt:
+            victims = tuple(v.id for v in choose_victims(
+                active, needed, len(pool.free_slices()),
+                priority_of(entry.priority_class)))
+        return ScheduleDecision(placements=placements, victims=victims)
+    return ScheduleDecision(placements=placements)

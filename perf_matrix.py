@@ -482,6 +482,33 @@ def write_artifacts(results: dict, round_no: int,
                 f"{row['save_mb_s']} | {row['verify_s']} | "
                 f"{row['restore_s']} | {row['restore_mb_s']} | "
                 f"{'yes' if row['round_trip_exact'] else 'NO'} |")
+    # workload-queue throughput rows (`perf_matrix.py --queue`,
+    # docs/workloads.md "Queue and preemption"): rendered from the
+    # newest round like the other single-section harnesses
+    queue_rounds = history.get("queue") or {}
+    if queue_rounds:
+        q_round = str(max(int(k) for k in queue_rounds))
+        lines += [
+            "",
+            f"## queue (round {q_round})",
+            "",
+            "Workload-queue throughput (`python perf_matrix.py "
+            "--queue`): admission rate over a 2x4-chip virtual pool,",
+            "end-to-end dispatch of the queued gangs, mean queue wait, "
+            "and the priority-preemption round trip (eviction ->",
+            "checkpoint+drain -> preemptor runs -> victim resumed to "
+            "completion) on the tier-1 8-device CPU mesh.",
+            "",
+            "| entries | submit/s | dispatch/s | mean wait (s) | "
+            "preempt round-trip (s) | ok |",
+            "|---|---|---|---|---|---|",
+        ]
+        for row in queue_rounds[q_round].get("rows", []):
+            lines.append(
+                f"| {row['entries']} | {row['submit_per_s']} | "
+                f"{row['dispatch_per_s']} | {row['mean_wait_s']} | "
+                f"{row['preempt_round_trip_s']} | "
+                f"{'yes' if row['ok'] else 'NO'} |")
     if traces:
         lines += [
             "",
@@ -669,6 +696,102 @@ def record_checkpoint(report: dict, round_no: int | None = None) -> int:
     return _record_section("checkpoint", report, round_no)
 
 
+def run_queue() -> dict:
+    """The CI face of the workload queue (ISSUE 12): admission +
+    dispatch throughput and the preemption round trip over a 2x4-chip
+    virtual pool on the tier-1 8-device CPU mesh. Two measured phases:
+
+    1. N small train gangs are submitted while the engine is held, then
+       the engine drains them — submit/s is pure admission (journal op +
+       queue row + scheduling pass), dispatch/s is end-to-end runs.
+    2. The drill's preemption scenario (low-priority 6-step victim,
+       high-priority arrival at step 2) — the round trip is eviction →
+       checkpoint+drain → preemptor runs → victim resumed to done,
+       measured from the victim's own preemption ledger."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flag = "--xla_force_host_platform_device_count=8"
+    if flag not in os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "") + " " + flag).strip()
+    import tempfile
+    import time as _time
+
+    from kubeoperator_tpu.service import build_services
+    from kubeoperator_tpu.utils.config import load_config
+
+    entries_n = 6
+    with tempfile.TemporaryDirectory(prefix="ko-queue-perf-") as base:
+        config = load_config(path="/nonexistent", env={}, overrides={
+            "db": {"path": os.path.join(base, "q.db")},
+            "logging": {"level": "ERROR"},
+            "executor": {"backend": "simulation"},
+            "provisioner": {"work_dir": os.path.join(base, "tf")},
+            "cron": {"backup_enabled": False, "event_sync_interval_s": 0},
+            "cluster": {"kubeconfig_dir": os.path.join(base, "kc")},
+            "queue": {"slices": 2, "chips_per_slice": 4},
+        })
+        svc = build_services(config, simulate=True)
+        try:
+            queue = svc.workload_queue
+            # phase 1 — admission with the engine held (the submissions
+            # must measure enqueue cost, not ride the first train)
+            with queue._lock:
+                queue._engine_active = True
+            t0 = _time.perf_counter()
+            for i in range(entries_n):
+                queue.submit(mesh="data=1,fsdp=4", steps=2,
+                             tenant=f"perf{i}", wait=True)
+            submit_s = _time.perf_counter() - t0
+            with queue._lock:
+                queue._engine_active = False
+            t0 = _time.perf_counter()
+            queue.process()
+            dispatch_s = _time.perf_counter() - t0
+            states = [e["state"] for e in queue.entries()]
+            waits = [w for _cls, w in
+                     svc.repos.workload_queue.wait_rows()]
+            # phase 2 — the preemption round trip
+            fired = {"done": False}
+
+            def hook(completed, _loss):
+                if completed == 2 and not fired["done"]:
+                    fired["done"] = True
+                    queue.submit(mesh="data=1,fsdp=4", steps=2,
+                                 tenant="preemptor", priority="high",
+                                 wait=True)
+
+            svc.workloads.step_hook = hook
+            queue.submit(mesh="data=2,fsdp=4", steps=6, tenant="victim",
+                         priority="low", wait=True)
+            svc.workloads.step_hook = None
+            victim = next(e for e in queue.entries()
+                          if e["tenant"] == "victim")
+            led = victim["preemptions"]
+            round_trip = (round(victim["finished_at"] - led[0]["at"], 4)
+                          if led and victim["finished_at"] else None)
+            ok = (all(s == "done" for s in states)
+                  and victim["state"] == "done" and bool(led))
+        finally:
+            svc.close()
+    row = {
+        "entries": entries_n,
+        "submit_per_s": round(entries_n / submit_s, 1)
+        if submit_s > 0 else 0.0,
+        "dispatch_per_s": round(entries_n / dispatch_s, 2)
+        if dispatch_s > 0 else 0.0,
+        "mean_wait_s": round(sum(waits) / len(waits), 4)
+        if waits else 0.0,
+        "preempt_round_trip_s": round_trip,
+        "ok": ok,
+    }
+    return {"ok": ok, "rows": [row]}
+
+
+def record_queue(report: dict, round_no: int | None = None) -> int:
+    """`perf_matrix.py --queue` hook."""
+    return _record_section("queue", report, round_no)
+
+
 def record_loadtest(rows: dict, round_no: int | None = None) -> int:
     """`koctl loadtest --record-perf` hook (rows keyed by replica
     count)."""
@@ -695,7 +818,18 @@ def main(argv: list | None = None) -> int:
                              "save/verify/restore throughput pass "
                              "(8 virtual CPU devices) and record its "
                              "row under the round")
+    parser.add_argument("--queue", action="store_true",
+                        help="run ONLY the workload-queue throughput "
+                             "pass (admission + dispatch + preemption "
+                             "round trip over a 2x4-chip virtual pool) "
+                             "and record its row under the round")
     args = parser.parse_args(argv)
+    if args.queue:
+        report = run_queue()
+        round_no = record_queue(report, args.round)
+        print(json.dumps({"round": round_no, "queue": report},
+                         indent=2))
+        return 0 if report["ok"] else 1
     if args.checkpoint:
         report = run_checkpoint()
         round_no = record_checkpoint(report, args.round)

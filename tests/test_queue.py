@@ -1,0 +1,711 @@
+"""Workload queue: gang scheduling + priority preemption (ISSUE 12).
+
+Tiers:
+  * pure decision layer (workloads/queue.py) — no devices, no DB:
+    slices math, all-or-nothing gang placement, victim choice, the
+    no-backfill contract;
+  * queue entry model + repository ordering contracts;
+  * service drills on the 8-device CPU mesh over a 2x4-chip virtual
+    pool: submit→done lifecycle, the mixed-priority preemption scenario
+    (checkpoint-drain + auto-resume with bit-exact loss parity),
+    displacement of never-started victims, cancel-with-drain, the
+    scavenger sweep tenant, admission bounds;
+  * satellites: per-tenant checkpoint namespaces/retention/sweep,
+    periodic `checkpoint.every_steps` saves, boot recovery of
+    interrupted queue entries, queue metrics families.
+"""
+
+import os
+import time
+
+import pytest
+
+from kubeoperator_tpu.models import QueueEntry, priority_of
+from kubeoperator_tpu.utils.errors import NotFoundError, ValidationError
+from kubeoperator_tpu.workloads.queue import (
+    SlicePoolView,
+    SliceSlot,
+    choose_victims,
+    plan_schedule,
+    slices_needed,
+)
+
+
+# ---------------------------------------------------------- pure decisions --
+class TestDecisionLayer:
+    def test_slices_needed_rounds_up_whole_slices(self):
+        assert slices_needed(4, 4) == 1
+        assert slices_needed(5, 4) == 2
+        assert slices_needed(8, 4) == 2
+        assert slices_needed(1, 4) == 1
+        assert slices_needed(0, 4) == 1     # a gang is never empty
+        assert slices_needed(8, 0) == 8     # degenerate chips floor at 1
+
+    def test_gang_placement_is_all_or_nothing(self):
+        pool = SlicePoolView(slots=[SliceSlot("a/0", 4),
+                                    SliceSlot("a/1", 4)])
+        assert pool.place("w1", 1) == ["a/0"]
+        assert pool.place("w2", 2) is None          # whole gang or nothing
+        assert pool.free_slices() == ["a/1"]        # no partial reservation
+        assert pool.place("w2", 1) == ["a/1"]
+        pool.release("w1")
+        assert pool.free_slices() == ["a/0"]
+
+    def _entry(self, eid, priority_class, created, placement=()):
+        e = QueueEntry(op_id="op", priority_class=priority_class,
+                       priority=priority_of(priority_class),
+                       placement=list(placement))
+        e.id = eid
+        e.created_at = created
+        return e
+
+    def test_victims_lowest_class_first_youngest_first(self):
+        old_low = self._entry("old-low", "low", 1.0, ["s0"])
+        new_low = self._entry("new-low", "low", 2.0, ["s1"])
+        normal = self._entry("norm", "normal", 0.5, ["s2"])
+        victims = choose_victims([old_low, new_low, normal], needed=1,
+                                 free=0, priority=priority_of("high"))
+        assert [v.id for v in victims] == ["new-low"]
+        # a 3-slice gang takes both lows before touching normal
+        victims = choose_victims([old_low, new_low, normal], needed=3,
+                                 free=0, priority=priority_of("high"))
+        assert [v.id for v in victims] == ["new-low", "old-low", "norm"]
+
+    def test_equal_priority_never_preempts(self):
+        holder = self._entry("h", "normal", 1.0, ["s0"])
+        assert choose_victims([holder], needed=1, free=0,
+                              priority=priority_of("normal")) == []
+
+    def test_insufficient_victims_means_nobody_is_evicted(self):
+        holder = self._entry("h", "low", 1.0, ["s0"])
+        # needs 3, eviction frees only 1 → wait, don't thrash
+        assert choose_victims([holder], needed=3, free=1,
+                              priority=priority_of("high")) == []
+
+    def test_plan_schedule_no_backfill_past_blocked_head(self):
+        pool = SlicePoolView(slots=[SliceSlot("a/0", 4),
+                                    SliceSlot("a/1", 4)])
+        wide = self._entry("wide", "high", 1.0)
+        wide.devices = 12                      # 3 slices: cannot ever fit
+        small = self._entry("small", "low", 2.0)
+        small.devices = 4
+        decision = plan_schedule([wide, small], [], pool, preempt=True)
+        # the small entry must NOT jump the blocked head
+        assert decision.placements == {}
+        assert decision.victims == ()
+
+    def test_plan_schedule_places_whole_gangs_and_names_victims(self):
+        pool = SlicePoolView(slots=[SliceSlot("a/0", 4),
+                                    SliceSlot("a/1", 4)])
+        low = self._entry("low", "low", 1.0, ["a/0", "a/1"])
+        pool.holders["low"] = ["a/0", "a/1"]
+        high = self._entry("high", "high", 2.0)
+        high.devices = 8
+        decision = plan_schedule([high], [low], pool, preempt=True)
+        assert decision.placements == {}
+        assert decision.victims == ("low",)
+        # preemption off: the high entry just waits
+        pool2 = SlicePoolView(slots=[SliceSlot("a/0", 4),
+                                     SliceSlot("a/1", 4)],
+                              holders={"low": ["a/0", "a/1"]})
+        decision = plan_schedule([high], [low], pool2, preempt=False)
+        assert decision.victims == ()
+
+
+# ------------------------------------------------------------ model + repo --
+class TestModelAndRepo:
+    def test_entry_validation(self):
+        entry = QueueEntry(op_id="op")
+        entry.validate()
+        with pytest.raises(ValidationError):
+            QueueEntry(op_id="op", priority_class="vip").validate()
+        with pytest.raises(ValidationError):
+            QueueEntry(op_id="op", state="parked").validate()
+        with pytest.raises(ValidationError):
+            QueueEntry(op_id="op", kind="render").validate()
+        with pytest.raises(ValidationError):
+            QueueEntry(op_id="").validate()
+
+    def test_priority_of_names_the_legal_classes(self):
+        assert priority_of("high") > priority_of("normal") > \
+            priority_of("low") > priority_of("scavenger")
+        with pytest.raises(ValidationError):
+            priority_of("urgent")
+
+    def test_pending_order_is_priority_then_fifo(self, tmp_db):
+        from kubeoperator_tpu.repository import Database, Repositories
+
+        repos = Repositories(Database(tmp_db))
+        for i, cls in enumerate(("low", "high", "normal", "high")):
+            e = QueueEntry(op_id=f"op{i}", priority_class=cls,
+                           priority=priority_of(cls))
+            e.id = f"e{i}"
+            e.created_at = float(i)
+            repos.workload_queue.save(e)
+        assert [e.id for e in repos.workload_queue.pending()] == \
+            ["e1", "e3", "e2", "e0"]
+        counts = repos.workload_queue.counts_by_state()
+        assert counts == {"pending": 4}
+        repos.db.close()
+
+
+# ------------------------------------------------------------ service tier --
+def queue_stack(tmp_path, db="q.db", **extra):
+    from kubeoperator_tpu.service import build_services
+    from kubeoperator_tpu.utils.config import load_config
+
+    overrides = {
+        "db": {"path": str(tmp_path / db)},
+        "logging": {"level": "ERROR"},
+        "executor": {"backend": "simulation"},
+        "provisioner": {"work_dir": str(tmp_path / "tf")},
+        "cron": {"backup_enabled": False, "health_check_interval_s": 0,
+                 "event_sync_interval_s": 0},
+        "cluster": {"kubeconfig_dir": str(tmp_path / "kc")},
+        "queue": {"slices": 2, "chips_per_slice": 4},
+    }
+    for key, value in extra.items():
+        overrides.setdefault(key, {}).update(value)
+    config = load_config(path="/nonexistent", env={}, overrides=overrides)
+    return build_services(config, simulate=True)
+
+
+class TestQueueService:
+    def test_submit_runs_to_done_with_queue_wait_span(self, tmp_path):
+        svc = queue_stack(tmp_path)
+        try:
+            entry = svc.workload_queue.submit(
+                mesh="data=1,fsdp=4", steps=3, tenant="alice", wait=True)
+            assert entry["state"] == "done"
+            assert entry["queue_wait_s"] is not None
+            assert len(entry["run_ops"]) == 1
+            # entry op closed Succeeded; run op stitched underneath
+            op = svc.repos.operations.get(entry["op_id"])
+            assert op.status == "Succeeded"
+            run_op = svc.repos.operations.get(entry["run_ops"][0])
+            assert run_op.parent_op_id == entry["op_id"]
+            assert run_op.trace_id == op.trace_id
+            names = {s.name for s in svc.repos.spans.for_trace(op.trace_id)}
+            assert "queue-wait" in names
+            # queue state mirrored into the journal op's vars
+            assert op.vars["entry"]["state"] == "done"
+            # per-tenant namespace: the checkpoint landed under alice/
+            row = svc.repos.checkpoints.latest_complete(tenant="alice")
+            assert row is not None
+            assert os.sep + "alice" + os.sep in row.dir
+        finally:
+            svc.close()
+
+    def test_mixed_priority_preemption_with_loss_parity(self, tmp_path):
+        """The tentpole drill in unit form: alice (low, 6 steps) is
+        running both-slices-free; bob (normal) fills the second slice;
+        carol (high) arrives blocked and preempts alice via the drain
+        protocol. Alice's drained+resumed losses must equal an
+        uninterrupted run bit-for-bit."""
+        import jax
+
+        from kubeoperator_tpu.parallel.mesh import MeshSpec
+        from kubeoperator_tpu.workloads.harness import run_training
+
+        svc = queue_stack(tmp_path)
+        try:
+            reference = run_training(
+                MeshSpec.parse("data=1,fsdp=4,tp=1").build(
+                    jax.devices()[:4]),
+                steps=6, mode="auto", seed=0)
+            fired = {"done": False}
+
+            def hook(completed, _loss):
+                if completed == 2 and not fired["done"]:
+                    fired["done"] = True
+                    svc.workload_queue.submit(
+                        mesh="data=1,fsdp=4", steps=3, tenant="bob",
+                        priority="normal", wait=True)
+                    svc.workload_queue.submit(
+                        mesh="data=1,fsdp=4", steps=3, tenant="carol",
+                        priority="high", wait=True)
+
+            svc.workloads.step_hook = hook
+            svc.workload_queue.submit(
+                mesh="data=1,fsdp=4", steps=6, tenant="alice",
+                priority="low", wait=True)
+            svc.workloads.step_hook = None
+
+            entries = {e["tenant"]: e
+                       for e in svc.workload_queue.entries()}
+            assert all(entries[t]["state"] == "done"
+                       for t in ("alice", "bob", "carol"))
+            alice, carol = entries["alice"], entries["carol"]
+            led = alice["preemptions"]
+            assert len(led) == 1 and led[0]["kind"] == "drained"
+            assert led[0]["by"] == carol["id"]
+            assert led[0]["step"] == 2 and led[0]["checkpoint"]
+            assert len(alice["run_ops"]) == 2
+            # dispatch order proven from journal rows: victim, preemptor,
+            # normal, victim-resumed
+            ops = svc.repos.operations
+            train_ops = sorted(ops.find(kind="workload-train"),
+                               key=lambda o: (o.created_at, o.id))
+            assert [(o.vars.get("tenant"),
+                     (o.vars.get("result") or {}).get("start_step"))
+                    for o in train_ops] == [
+                ("alice", 0), ("carol", 0), ("bob", 0), ("alice", 2)]
+            # loss parity, bit for bit
+            losses = []
+            for op_id in alice["run_ops"]:
+                losses += ops.get(op_id).vars["result"]["losses"]
+            assert losses == reference["losses"]
+            # one stitched tree: entry root → both runs + preempt marker
+            from kubeoperator_tpu.observability import span_tree
+
+            tree = span_tree(svc.repos.spans.for_trace(
+                ops.get(alice["op_id"]).trace_id))
+            assert tree["id"] == alice["op_id"]
+            flat = []
+
+            def walk(node):
+                flat.append(node["name"])
+                for child in node.get("children", []):
+                    walk(child)
+
+            walk(tree)
+            assert flat.count("workload-train") == 2
+            for name in ("queue-wait", "preempt", "checkpoint-save",
+                         "checkpoint-restore"):
+                assert name in flat, flat
+        finally:
+            svc.close()
+
+    def test_placed_victim_is_displaced_not_drained(self, tmp_path):
+        """A victim that never started has no state to save: eviction is
+        a displacement (back to pending, ledger kind `displaced`), and
+        the high-priority gang takes the whole pool."""
+        svc = queue_stack(tmp_path)
+        try:
+            queue = svc.workload_queue
+            with queue._lock:
+                queue._engine_active = True   # hold dispatch
+            low = queue.submit(mesh="data=1,fsdp=4", steps=2,
+                               tenant="low", priority="low", wait=True)
+            assert queue.status(low["id"])["state"] == "placed"
+            high = queue.submit(mesh="data=2,fsdp=4", steps=2,
+                                tenant="high", priority="high", wait=True)
+            low_now = queue.status(low["id"])
+            assert low_now["state"] == "pending"
+            assert low_now["preemptions"][0]["kind"] == "displaced"
+            assert low_now["preemptions"][0]["by"] == high["id"]
+            with queue._lock:
+                queue._engine_active = False
+            queue.process()
+            entries = {e["tenant"]: e for e in queue.entries()}
+            assert entries["high"]["state"] == "done"
+            assert entries["low"]["state"] == "done"
+            # the high gang held BOTH slices
+            assert entries["high"]["started_at"] <= \
+                entries["low"]["started_at"]
+        finally:
+            svc.close()
+
+    def test_cancel_pending_and_cancel_running_via_drain(self, tmp_path):
+        svc = queue_stack(tmp_path)
+        try:
+            queue = svc.workload_queue
+            with queue._lock:
+                queue._engine_active = True
+            entry = queue.submit(mesh="data=1,fsdp=4", steps=2,
+                                 tenant="t1", wait=True)
+            cancelled = queue.cancel(entry["id"][:8])
+            assert cancelled["state"] == "cancelled"
+            op = svc.repos.operations.get(entry["op_id"])
+            assert op.status == "Failed"     # closed, not dangling
+            with queue._lock:
+                queue._engine_active = False
+            with pytest.raises(ValidationError):
+                queue.cancel(entry["id"])    # already terminal
+
+            # cancel mid-run: drain first, checkpoint kept
+            fired = {"done": False}
+
+            def hook(completed, _loss):
+                if completed == 2 and not fired["done"]:
+                    fired["done"] = True
+                    running = next(e for e in queue.entries()
+                                   if e["state"] == "running")
+                    queue.cancel(running["id"])
+
+            svc.workloads.step_hook = hook
+            victim = queue.submit(mesh="data=1,fsdp=4", steps=6,
+                                  tenant="t2", wait=True)
+            svc.workloads.step_hook = None
+            assert victim["state"] == "cancelled"
+            assert victim["checkpoint"]      # drain saved real state
+            assert victim["preemptions"][0]["kind"] == "drained"
+        finally:
+            svc.close()
+
+    def test_sweep_is_a_scavenger_journaled_tenant(self, tmp_path):
+        svc = queue_stack(tmp_path)
+        try:
+            entry = svc.workload_queue.submit(kind="sweep", steps=2,
+                                              wait=True)
+            assert entry["state"] == "done"
+            assert entry["priority"] == "scavenger"
+            assert entry["devices"] == 8     # the sweep wants the pool
+            run_op = svc.repos.operations.get(entry["run_ops"][0])
+            assert run_op.kind == "workload-sweep"
+            assert run_op.status == "Succeeded"
+            assert run_op.parent_op_id == entry["op_id"]
+            rows = run_op.vars["result"]["rows"]
+            assert rows and all("scaling_efficiency_pct" in r
+                                for r in rows)
+            # a sweep may not outrank tenants
+            with pytest.raises(ValidationError):
+                svc.workload_queue.submit(kind="sweep", priority="high")
+        finally:
+            svc.close()
+
+    def test_admission_and_validation(self, tmp_path):
+        svc = queue_stack(tmp_path, queue={"max_entries": 1})
+        try:
+            queue = svc.workload_queue
+            with queue._lock:
+                queue._engine_active = True
+            # bad inputs are rejected BEFORE any journal op opens
+            with pytest.raises(ValidationError, match="tenant"):
+                queue.submit(tenant="Bad/../Name", wait=True)
+            with pytest.raises(ValidationError):
+                queue.submit(priority="vip", wait=True)
+            with pytest.raises(NotFoundError):
+                queue.submit(plan="no-such-plan", wait=True)
+            with pytest.raises(ValidationError):
+                queue.submit(kind="render", wait=True)
+            assert not svc.repos.operations.find(
+                kind="workload-queued"), "rejections must not strand ops"
+            queue.submit(mesh="data=1,fsdp=4", steps=2, wait=True)
+            with pytest.raises(ValidationError, match="queue is full"):
+                queue.submit(mesh="data=1,fsdp=4", steps=2, wait=True)
+        finally:
+            svc.close()
+
+    def test_boot_recovery_requeues_interrupted_entries(self, tmp_path):
+        """Controller death with a live queue: the boot reconciler
+        sweeps the open entry op to Interrupted, and — with auto_resume
+        on — `recover` reopens the op (same trace), re-queues the entry
+        as pending, and the engine dispatches it to done."""
+        svc = queue_stack(tmp_path)
+        try:
+            queue = svc.workload_queue
+            with queue._lock:
+                queue._engine_active = True   # entry never dispatches
+            entry = queue.submit(mesh="data=1,fsdp=4", steps=2,
+                                 tenant="t1", wait=True)
+        finally:
+            svc.close()
+        svc2 = queue_stack(
+            tmp_path, resilience={"reconcile": {"auto_resume": True}})
+        try:
+            assert any(r["op"] == entry["op_id"]
+                       for r in svc2.boot_report)
+            svc2.workload_queue.wait_all()
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                state = svc2.workload_queue.status(entry["id"])["state"]
+                if state == "done":
+                    break
+                time.sleep(0.2)
+            final = svc2.workload_queue.status(entry["id"])
+            assert final["state"] == "done", final
+            op = svc2.repos.operations.get(entry["op_id"])
+            assert op.status == "Succeeded"
+            # the whole life — queue, interruption, recovery, run — is
+            # one trace
+            run_op = svc2.repos.operations.get(final["run_ops"][0])
+            assert run_op.trace_id == op.trace_id
+        finally:
+            svc2.close()
+
+    def test_crash_mid_run_resumes_through_queue_only(self, tmp_path):
+        """Review hardening: a controller death mid-DISPATCHED-run
+        leaves TWO open ops (the entry + its child run). Recovery must
+        flow through the queue alone — the child run op sweeps to
+        Interrupted with the queue-dispatched wording and NO standalone
+        `workloads.resume_from` fires, else two trains race the same
+        devices outside the gang contract."""
+        from kubeoperator_tpu.resilience.chaos import ControllerDeath
+
+        svc = queue_stack(tmp_path)
+        try:
+            def hook(completed, _loss):
+                if completed == 2:
+                    raise ControllerDeath("queue drill")
+
+            svc.workloads.step_hook = hook
+            with pytest.raises(ControllerDeath):
+                svc.workload_queue.submit(
+                    mesh="data=1,fsdp=4", steps=6, tenant="alice",
+                    priority="low", wait=True)
+        finally:
+            svc.workloads.step_hook = None
+            svc.close()
+        svc2 = queue_stack(
+            tmp_path, resilience={"reconcile": {"auto_resume": True}})
+        try:
+            records = {r["op"]: r for r in svc2.boot_report}
+            child = [r for r in records.values()
+                     if r["kind"] == "workload-train"]
+            assert len(child) == 1
+            assert not child[0].get("resumed")   # queue owns recovery
+            child_op = svc2.repos.operations.get(child[0]["op"])
+            assert child_op.status == "Interrupted"
+            assert "queue-dispatched" in child_op.message
+            svc2.workload_queue.wait_all()
+            deadline = time.time() + 60
+            entry = None
+            while time.time() < deadline:
+                entry = svc2.workload_queue.entries()[0]
+                if entry["state"] == "done":
+                    break
+                time.sleep(0.2)
+            assert entry and entry["state"] == "done", entry
+            # every live run the recovery produced went through the
+            # queue (stitched under the entry op) — no stray resume
+            succeeded = [o for o in svc2.repos.operations.find(
+                kind="workload-train") if o.status == "Succeeded"]
+            assert succeeded
+            assert all(o.parent_op_id == entry["op_id"]
+                       for o in succeeded)
+        finally:
+            svc2.close()
+
+    def test_orphan_fallback_checkpoint_is_tenant_scoped(self, tmp_path):
+        """Review hardening: the reconciler's fallback 'newest complete
+        checkpoint' for an orphaned workload op must stay inside the
+        op's tenant namespace — tenant A's auto-resume must never
+        restore tenant B's TrainState, however fresh."""
+        svc = queue_stack(tmp_path)
+        try:
+            svc.workloads.train(mesh="data=1,fsdp=4", steps=2,
+                                tenant="bob")
+            alice_op = svc.journal.open_scoped(
+                "workload-train", vars={"tenant": "alice"},
+                scope="workload")
+            assert svc.reconciler._workload_checkpoint(alice_op) is None
+            bob_op = svc.journal.open_scoped(
+                "workload-train", vars={"tenant": "bob"},
+                scope="workload")
+            row = svc.reconciler._workload_checkpoint(bob_op)
+            assert row is not None and row.tenant == "bob"
+            svc.journal.interrupt(alice_op)
+            svc.journal.interrupt(bob_op)
+        finally:
+            svc.close()
+
+    def test_sweep_ops_resolve_in_list_and_trace(self, tmp_path):
+        """Review hardening: the trace hint `workload sweep` prints must
+        work — sweep ops resolve through the same workload surface as
+        train ops."""
+        svc = queue_stack(tmp_path)
+        try:
+            entry = svc.workload_queue.submit(kind="sweep", steps=2,
+                                              wait=True)
+            sweep_op = entry["run_ops"][0]
+            assert svc.workloads.status(sweep_op)["kind"] \
+                == "workload-sweep"
+            assert any(o["kind"] == "workload-sweep"
+                       for o in svc.workloads.list_ops())
+            trace = svc.workloads.trace(sweep_op[:8])
+            assert trace["tree"]["id"] == entry["op_id"] or \
+                trace["operation"] == sweep_op
+        finally:
+            svc.close()
+
+    def test_cli_local_transport_parity(self, tmp_path, capsys,
+                                        monkeypatch):
+        """KO-X010's behavioral half for the queue surface: submit /
+        queue / cancel / sweep / checkpoints --tenant through the CLI's
+        local transport, same translation the REST handlers use."""
+        import json
+
+        from kubeoperator_tpu.cli import koctl
+
+        monkeypatch.setenv("KO_TPU_CONFIG", "/nonexistent")
+        monkeypatch.setenv("KO_TPU_DB__PATH", str(tmp_path / "cli.db"))
+        monkeypatch.setenv("KO_TPU_EXECUTOR__BACKEND", "simulation")
+        monkeypatch.setenv("KO_TPU_PROVISIONER__WORK_DIR",
+                           str(tmp_path / "tf"))
+        monkeypatch.setenv("KO_TPU_CLUSTER__KUBECONFIG_DIR",
+                           str(tmp_path / "kc"))
+        monkeypatch.setenv("KO_TPU_LOGGING__LEVEL", "ERROR")
+        monkeypatch.setenv("KO_TPU_QUEUE__SLICES", "2")
+        monkeypatch.setenv("KO_TPU_QUEUE__CHIPS_PER_SLICE", "4")
+
+        lc = koctl.LocalClient()
+        try:
+            args = koctl.build_parser().parse_args(
+                ["--local", "workload", "submit", "--mesh",
+                 "data=1,fsdp=4", "--steps", "2", "--tenant", "alice",
+                 "--priority", "low", "--json"])
+            assert koctl.cmd_workload(lc, args) == 0
+            entry = json.loads(capsys.readouterr().out)
+            assert entry["state"] == "done"
+            assert entry["tenant"] == "alice"
+
+            args = koctl.build_parser().parse_args(
+                ["--local", "workload", "queue"])
+            assert koctl.cmd_workload(lc, args) == 0
+            out = capsys.readouterr().out
+            assert "capacity: 2 slice(s)" in out and "done" in out
+
+            args = koctl.build_parser().parse_args(
+                ["--local", "workload", "sweep", "--steps", "2",
+                 "--json"])
+            assert koctl.cmd_workload(lc, args) == 0
+            sweep = json.loads(capsys.readouterr().out)
+            assert sweep["kind"] == "sweep"
+            assert sweep["priority"] == "scavenger"
+
+            args = koctl.build_parser().parse_args(
+                ["--local", "workload", "checkpoints", "--tenant",
+                 "alice", "--json"])
+            assert koctl.cmd_workload(lc, args) == 0
+            rows = json.loads(capsys.readouterr().out)
+            assert rows and all(r["tenant"] == "alice" for r in rows)
+
+            # cancel a terminal entry: clean error, not a stack trace
+            with pytest.raises(SystemExit, match="already finished"):
+                lc.call(
+                    "POST",
+                    f"/api/v1/workloads/queue/{entry['id']}/cancel")
+            # KO-X010 behavioral parity: strict bool on `wait`
+            with pytest.raises(SystemExit, match="boolean"):
+                lc.call("POST", "/api/v1/workloads/queue",
+                        {"wait": "yes"})
+        finally:
+            lc.services.close()
+
+    def test_queue_metrics_families(self, tmp_path):
+        from kubeoperator_tpu.api.metrics import MetricsRegistry
+
+        svc = queue_stack(tmp_path)
+        try:
+            svc.workload_queue.submit(mesh="data=1,fsdp=4", steps=2,
+                                      tenant="t1", priority="high",
+                                      wait=True)
+            text = MetricsRegistry().render(svc)
+            assert 'ko_tpu_workload_queue{state="done"} 1' in text
+            assert ('ko_tpu_workload_queue_wait_seconds_count'
+                    '{priority="high"}') in text
+        finally:
+            svc.close()
+
+
+# -------------------------------------------------------------- satellites --
+class TestTenantCheckpoints:
+    def test_per_tenant_retention_is_isolated(self, tmp_path):
+        """checkpoint.keep=1 with two alternating tenants: each tenant
+        keeps its own newest checkpoint — one tenant's churn can never
+        prune another's rows."""
+        svc = queue_stack(tmp_path, checkpoint={"keep": 1})
+        try:
+            for tenant in ("alice", "bob", "alice"):
+                svc.workloads.train(mesh="data=1,fsdp=4", steps=2,
+                                    tenant=tenant)
+            alice_rows = svc.repos.checkpoints.complete(tenant="alice")
+            bob_rows = svc.repos.checkpoints.complete(tenant="bob")
+            assert len(alice_rows) == 1 and len(bob_rows) == 1
+            assert os.path.isdir(bob_rows[0].dir)
+            # alice's first checkpoint was pruned; its row survives as
+            # the audit trail
+            pruned = [c for c in svc.repos.checkpoints.find(
+                tenant="alice") if c.status == "pruned"]
+            assert len(pruned) == 1
+        finally:
+            svc.close()
+
+    def test_tenant_resume_never_picks_another_namespace(self, tmp_path):
+        svc = queue_stack(tmp_path)
+        try:
+            svc.workloads.train(mesh="data=1,fsdp=4", steps=2,
+                                tenant="alice")
+            with pytest.raises(NotFoundError):
+                svc.workloads.train(resume=True, tenant="bob")
+            resumed = svc.workloads.train(resume=True, tenant="alice")
+            assert resumed["status"] == "Succeeded"
+        finally:
+            svc.close()
+
+    def test_sweep_torn_recurses_namespaces_not_deleting_them(
+            self, tmp_path):
+        from kubeoperator_tpu.workloads.checkpoint import (
+            save_checkpoint,
+            sweep_torn,
+        )
+
+        root = tmp_path / "ckpts"
+        tenant_dir = root / "alice"
+        tenant_dir.mkdir(parents=True)
+        # a complete checkpoint + a torn sibling inside the namespace
+        save_checkpoint(str(tenant_dir), {"params": {"w": [1.0]}},
+                        step=1)
+        torn = tenant_dir / "torn-child"
+        torn.mkdir()
+        (torn / "shard.npy.tmp-1-abc").write_bytes(b"x")
+        removed = sweep_torn(str(root), min_age_s=0)
+        assert str(torn) in removed
+        assert tenant_dir.is_dir()           # the namespace survives
+        assert len(list(tenant_dir.iterdir())) == 1   # the complete one
+
+    def test_checkpoints_listing_filters_by_tenant(self, tmp_path):
+        svc = queue_stack(tmp_path)
+        try:
+            svc.workloads.train(mesh="data=1,fsdp=4", steps=2,
+                                tenant="alice")
+            svc.workloads.train(mesh="data=1,fsdp=4", steps=2)
+            all_rows = svc.workloads.checkpoints()
+            assert {r["tenant"] for r in all_rows} == {"alice", ""}
+            alice = svc.workloads.checkpoints(tenant="alice")
+            assert len(alice) == 1 and alice[0]["tenant"] == "alice"
+        finally:
+            svc.close()
+
+
+class TestPeriodicCheckpoints:
+    def test_every_steps_saves_mid_run_without_changing_losses(
+            self, tmp_path):
+        """checkpoint.every_steps=2 on a 6-step run: mid-run saves land
+        at steps 2 and 4 plus the end-of-run save at 6, all indexed and
+        restorable — and the trajectory is untouched (a save is a
+        read)."""
+        import jax
+
+        from kubeoperator_tpu.parallel.mesh import MeshSpec
+        from kubeoperator_tpu.workloads.harness import run_training
+
+        svc = queue_stack(tmp_path, checkpoint={"every_steps": 2})
+        try:
+            reference = run_training(
+                MeshSpec.parse("data=1,fsdp=4,tp=1").build(
+                    jax.devices()[:4]),
+                steps=6, mode="auto", seed=0)
+            op = svc.workloads.train(mesh="data=1,fsdp=4", steps=6)
+            assert op["result"]["losses"] == reference["losses"]
+            steps = sorted(c.step for c in
+                           svc.repos.checkpoints.complete())
+            assert steps == [2, 4, 6]
+            # the periodic saves are marked in the span tree
+            spans = svc.repos.spans.for_operation(op["id"])
+            periodic = [s for s in spans if s.name == "checkpoint-save"
+                        and s.attrs.get("periodic")]
+            assert len(periodic) == 2
+            # a mid-run checkpoint is a real restore source
+            mid = next(c for c in svc.repos.checkpoints.complete()
+                       if c.step == 2)
+            resumed = svc.workloads.train(resume=True,
+                                          checkpoint=mid.id)
+            assert resumed["result"]["start_step"] == 2
+            assert resumed["result"]["end_step"] == 6
+            assert (op["result"]["losses"][:2]
+                    + resumed["result"]["losses"]
+                    == reference["losses"])
+        finally:
+            svc.close()

@@ -457,10 +457,15 @@ class TestWorkloadService:
         import)."""
         from kubeoperator_tpu.fleet import FLEET_UPGRADE_KIND
         from kubeoperator_tpu.repository.repos import RESUMABLE_SCOPED_KINDS
-        from kubeoperator_tpu.service.reconcile import AUTO_RESUME_FLEET
+        from kubeoperator_tpu.service.queue import QUEUE_ENTRY_KIND
+        from kubeoperator_tpu.service.reconcile import (
+            AUTO_RESUME_FLEET,
+            AUTO_RESUME_QUEUE,
+        )
 
-        assert set(RESUMABLE_SCOPED_KINDS) == set(AUTO_RESUME_FLEET) \
-            == {FLEET_UPGRADE_KIND}
+        assert set(RESUMABLE_SCOPED_KINDS) \
+            == set(AUTO_RESUME_FLEET) | set(AUTO_RESUME_QUEUE) \
+            == {FLEET_UPGRADE_KIND, QUEUE_ENTRY_KIND}
 
         svc = workload_stack(tmp_path)
         try:
