@@ -38,7 +38,6 @@ Running, which is exactly the crash evidence the boot reconciler sweeps.
 
 from __future__ import annotations
 
-import threading
 from dataclasses import dataclass
 from typing import Callable
 
@@ -214,10 +213,12 @@ def binding_chain(durations: dict[str, float],
 class DagScheduler:
     """Runs one phase family's active DAG on a bounded worker pool.
 
-    The coordinator thread owns all scheduling state under one condition
-    variable; workers only run `run_phase` and report back. Launch order
-    among simultaneously-ready phases is declaration order — the
-    deterministic ready-order the KO-X011 contract promises."""
+    The coordinator loop (launch bookkeeping, settle transport, fatal
+    semantics) is the shared `adm/pool.py BoundedPool`; this class keeps
+    the DAG policy — ready = dependency set satisfied, launch order among
+    simultaneously-ready phases is declaration order (the deterministic
+    ready-order the KO-X011 contract promises), the first phase failure
+    stops NEW launches but never cancels a healthy sibling branch."""
 
     def __init__(self, phases, edges: dict[str, set[str]],
                  max_concurrent: int,
@@ -232,84 +233,58 @@ class DagScheduler:
         """Execute every phase not already in `completed` (resume skips
         OK conditions exactly like the serial loop). `run_phase(phase)`
         raises PhaseError when the phase halts after its retry budget."""
-        cv = threading.Condition()
+        from kubeoperator_tpu.adm.pool import BoundedPool
+
         done: set[str] = set(completed)
-        running: set[str] = set()
         pending = [p for p in self.phases if p.name not in done]
         failures: list[tuple[int, BaseException]] = []
         failed_names: set[str] = set()
-        fatal: list[BaseException] = []
+        state = {"last_frontier": None}
 
-        def worker(phase) -> None:
-            try:
-                run_phase(phase)
-            except Exception as e:
-                with cv:
-                    failures.append((self._order[phase.name], e))
-                    failed_names.add(phase.name)
-                    running.discard(phase.name)
-                    cv.notify_all()
-                return
-            except BaseException as e:   # KO-P009: waived — ControllerDeath
-                # is transported to the coordinating thread, which re-raises
-                # it below with crash semantics intact (condition left
-                # Running, journal op left open)
-                with cv:
-                    fatal.append(e)
-                    running.discard(phase.name)
-                    cv.notify_all()
-                return
-            with cv:
+        def schedule(view):
+            if failures:
+                return []
+            ready = [p for p in pending
+                     if self.edges.get(p.name, set()) <= done]
+            launches = ready[:view.free]
+            for p in launches:
+                pending.remove(p)
+            if not launches and not view.running and pending:
+                # unreachable after validate_family; defensive so a
+                # regression deadlocks loudly instead of silently
+                raise ValidationError(
+                    "phase DAG wedged: no phase ready, none running, "
+                    + ", ".join(p.name for p in pending) + " pending")
+            return launches
+
+        def settle(phase, _result, error) -> None:
+            if error is not None:
+                failures.append((self._order[phase.name], error))
+                failed_names.add(phase.name)
+            else:
                 done.add(phase.name)
-                running.discard(phase.name)
-                cv.notify_all()
 
-        last_frontier: dict | None = None
-        with cv:
-            while True:
-                halted = bool(failures or fatal)
-                if not halted:
-                    ready = [
-                        p for p in pending
-                        if self.edges.get(p.name, set()) <= done
-                    ]
-                    for p in ready:
-                        if len(running) >= self.max_concurrent:
-                            break
-                        pending.remove(p)
-                        running.add(p.name)
-                        threading.Thread(
-                            target=worker, args=(p,), daemon=True,
-                            name=f"adm-phase-{p.name}",
-                        ).start()
-                # the durable resume frontier: what is in flight plus what
-                # the DAG still owes (never-launched AND failed nodes — a
-                # retry re-enters both) — persisted (journal op vars) on
-                # every change, so an interrupted op quotes the exact node
-                # set a retry will re-enter. Suppressed once a fatal
-                # (ControllerDeath) landed: a dead controller does no
-                # post-crash bookkeeping, so the pre-crash frontier with
-                # the dying phase still listed as running IS the record.
-                frontier = {
-                    "running": sorted(running),
-                    "pending": sorted(
-                        {p.name for p in pending} | failed_names),
-                }
-                if frontier != last_frontier and not fatal:
-                    last_frontier = frontier
-                    self.on_frontier(frontier)
-                if not running and (halted or not pending):
-                    break
-                if not halted and not running and pending:
-                    # unreachable after validate_family; defensive so a
-                    # regression deadlocks loudly instead of silently
-                    raise ValidationError(
-                        "phase DAG wedged: no phase ready, none running, "
-                        + ", ".join(p.name for p in pending) + " pending")
-                cv.wait()
+        def on_turn(view) -> None:
+            # the durable resume frontier: what is in flight plus what
+            # the DAG still owes (never-launched AND failed nodes — a
+            # retry re-enters both) — persisted (journal op vars) on
+            # every change, so an interrupted op quotes the exact node
+            # set a retry will re-enter. The pool suppresses this once a
+            # fatal (ControllerDeath) landed: a dead controller does no
+            # post-crash bookkeeping, so the pre-crash frontier with the
+            # dying phase still listed as running IS the record.
+            frontier = {
+                "running": sorted(p.name for p in view.running),
+                "pending": sorted(
+                    {p.name for p in pending} | failed_names),
+            }
+            if frontier != state["last_frontier"]:
+                state["last_frontier"] = frontier
+                self.on_frontier(frontier)
 
-        if fatal:
-            raise fatal[0]
+        BoundedPool(self.max_concurrent, "adm-phase").run(
+            schedule, run_phase, settle, on_turn=on_turn)
+
         if failures:
             failures.sort(key=lambda pair: pair[0])
             raise failures[0][1]

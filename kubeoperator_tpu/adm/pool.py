@@ -1,0 +1,132 @@
+"""BoundedPool — the deterministic bounded-concurrency coordinator the
+phase-DAG scheduler (adm/dag.py) and the fleet wave engine
+(fleet/engine.py) both run on.
+
+Extracted from `DagScheduler` (ISSUE 13): the coordinator loop — launch
+in caller-chosen deterministic order onto a bounded set of worker
+threads, report every settle back on the coordinator thread, stop new
+launches the moment the caller's policy says so, let running siblings
+settle, and transport BaseExceptions (chaos `ControllerDeath`, lease
+`StaleEpochError`) with crash semantics intact — is policy-free here.
+What differs between consumers is POLICY, and that stays with them:
+
+  * the DAG scheduler launches phases whose dependency sets are
+    satisfied and halts on the first phase failure;
+  * the fleet wave engine launches clusters in sorted-name order and
+    halts when the live unavailability budget trips, a canary fails, or
+    the operator signals pause/abort.
+
+Contract:
+
+  * `schedule(view)` runs on the coordinator thread, initially and after
+    every settle, and returns the items to launch NOW (at most
+    `view.free` of them; excess is an error). Returning nothing while
+    workers run means "wait for a settle"; returning nothing with
+    nothing running ends the run.
+  * `work(item)` is the worker-thread body. Its return value (or the
+    `Exception` it raised) is handed to `settle` — workers touch no
+    shared state themselves.
+  * `settle(item, result, error)` runs on the coordinator thread after
+    each worker finishes, BEFORE the next `schedule` call — the verdict
+    it records is what the next scheduling decision sees.
+  * a `BaseException` from `work` is FATAL: `settle` is skipped for that
+    item, no new launches happen, running siblings settle normally, and
+    the first fatal re-raises from `run()` — the closest honest analogue
+    of a crash, since a coordinator cannot SIGKILL a sibling thread.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable
+
+from kubeoperator_tpu.utils.logging import get_logger
+
+log = get_logger("adm.pool")
+
+
+class PoolView:
+    """What `schedule`/`on_turn` may consult: the free slot count and the
+    items currently running (a snapshot — the coordinator owns the real
+    set). Neither callback runs once a fatal has landed, so there is
+    deliberately no fatal flag here."""
+
+    __slots__ = ("free", "running")
+
+    def __init__(self, free: int, running: list) -> None:
+        self.free = free
+        self.running = running
+
+
+class BoundedPool:
+    """One bounded worker pool run. Construct per use; `run` drives the
+    coordinator loop to completion on the calling thread."""
+
+    def __init__(self, max_concurrent: int,
+                 thread_prefix: str = "pool") -> None:
+        self.max_concurrent = max(int(max_concurrent), 1)
+        self.thread_prefix = thread_prefix
+
+    def run(self, schedule: Callable, work: Callable,
+            settle: Callable, on_turn: Callable | None = None) -> None:
+        """Drive the pool until `schedule` yields nothing and every
+        worker settled. `on_turn(view)` (optional) runs once per
+        coordinator turn after launches — the frontier-persistence hook;
+        suppressed once a fatal landed (a dead controller does no
+        post-crash bookkeeping)."""
+        cv = threading.Condition()
+        running: list = []                  # items in flight, launch order
+        inbox: list[tuple] = []             # (item, result, error) to settle
+        fatal: list[BaseException] = []
+
+        def worker(item) -> None:
+            try:
+                result = work(item)
+            except Exception as e:
+                with cv:
+                    inbox.append((item, None, e))
+                    cv.notify_all()
+                return
+            except BaseException as e:   # KO-P009: waived — ControllerDeath/
+                # StaleEpochError transported to the coordinator, which
+                # re-raises below with crash semantics intact
+                with cv:
+                    fatal.append(e)
+                    running.remove(item)
+                    cv.notify_all()
+                return
+            with cv:
+                inbox.append((item, result, None))
+                cv.notify_all()
+
+        with cv:
+            while True:
+                # settle everything that arrived, in arrival order, before
+                # the next scheduling decision — settle() verdicts feed it
+                while inbox:
+                    item, result, error = inbox.pop(0)
+                    running.remove(item)
+                    settle(item, result, error)
+                free = self.max_concurrent - len(running)
+                launches = [] if fatal else list(schedule(
+                    PoolView(free, list(running))))
+                if len(launches) > free:
+                    raise RuntimeError(
+                        f"{self.thread_prefix}: schedule returned "
+                        f"{len(launches)} launches for {free} free slots")
+                for item in launches:
+                    running.append(item)
+                    label = getattr(item, "name", item)
+                    threading.Thread(
+                        target=worker, args=(item,), daemon=True,
+                        name=f"{self.thread_prefix}-{label}",
+                    ).start()
+                if on_turn is not None and not fatal:
+                    on_turn(PoolView(self.max_concurrent - len(running),
+                                     list(running)))
+                if not running and not inbox:
+                    break
+                cv.wait()
+
+        if fatal:
+            raise fatal[0]

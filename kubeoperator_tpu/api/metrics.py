@@ -180,23 +180,35 @@ class MetricsRegistry:
                [_fmt("ko_tpu_operations", {"status": s}, n)
                 for s, n in sorted(ops_by_status.items())])
         # fleet rollout waves by outcome (docs/resilience.md "Fleet
-        # operations"): fleet ops are few (one row per rollout ever), so
-        # hydrating them per scrape is in the noise
+        # operations"), off the MIRRORED summary digests (migration 012)
+        # — a 1000-rollout history must not hydrate every op's wave
+        # ledger per scrape (ops predating the digest contribute nothing)
         from kubeoperator_tpu.fleet import FLEET_UPGRADE_KIND
 
         waves_by_outcome: dict[str, int] = {}
-        for fleet_op in services.repos.operations.find(
-                kind=FLEET_UPGRADE_KIND):
-            for wave in fleet_op.vars.get("waves", []):
-                outcome = str(wave.get("outcome", "pending"))
-                waves_by_outcome[outcome] = \
-                    waves_by_outcome.get(outcome, 0) + 1
+        fleet_in_flight = 0
+        # getattr-guarded like the queue rows: exposition tests hand in
+        # stub repos without the full OperationRepo surface
+        summaries = getattr(services.repos.operations, "summaries",
+                            lambda kind: [])
+        for row in summaries(FLEET_UPGRADE_KIND):
+            digest = row["summary"]
+            for outcome, n in (digest.get("wave_outcomes") or {}).items():
+                waves_by_outcome[str(outcome)] = \
+                    waves_by_outcome.get(str(outcome), 0) + int(n)
+            if row["status"] == "Running":
+                fleet_in_flight += int(digest.get("in_flight", 0) or 0)
         family("ko_tpu_fleet_waves", "gauge",
                "Fleet rollout waves by outcome (promoted / canary-blocked "
                "/ rolled-back / failed / aborted / pending) across all "
                "journaled fleet operations.",
                [_fmt("ko_tpu_fleet_waves", {"outcome": o}, n)
                 for o, n in sorted(waves_by_outcome.items())])
+        family("ko_tpu_fleet_inflight_clusters", "gauge",
+               "Clusters upgrading/gating right now across Running fleet "
+               "rollouts (the concurrent wave engine's live lanes).",
+               [_fmt("ko_tpu_fleet_inflight_clusters", {},
+                     fleet_in_flight)])
 
         # workload queue (docs/workloads.md "Queue and preemption"):
         # entries by state off the mirrored column, and the queue-wait

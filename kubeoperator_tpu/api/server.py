@@ -743,6 +743,13 @@ class Handlers:
     async def fleet_operations(self, request):
         return json_response(await run_sync(request, self.s.fleet.list_ops))
 
+    async def fleet_drift(self, request):
+        from kubeoperator_tpu.fleet.planner import drift_kwargs
+
+        return json_response(await run_sync(
+            request, self.s.fleet.drift,
+            **drift_kwargs(dict(request.query))))
+
     async def fleet_operation(self, request):
         return json_response(await run_sync(
             request, self.s.fleet.status, request.match_info["op"]))
@@ -1266,6 +1273,7 @@ def create_app(services: Services) -> web.Application:
     # fleet rollouts are platform-level operations (they touch many
     # clusters across projects), so the whole surface is admin-gated
     r.add_post("/api/v1/fleet/upgrade", admin_guard(h.fleet_upgrade))
+    r.add_get("/api/v1/fleet/drift", admin_guard(h.fleet_drift))
     r.add_get("/api/v1/fleet/operations", admin_guard(h.fleet_operations))
     r.add_get("/api/v1/fleet/operations/{op}",
               admin_guard(h.fleet_operation))

@@ -239,6 +239,10 @@ class LocalClient:
 
                 return s.fleet.upgrade(
                     body["target"], wait=False, **upgrade_kwargs(body))
+            case ("GET", ["fleet", "drift"]):
+                from kubeoperator_tpu.fleet.planner import drift_kwargs
+
+                return s.fleet.drift(**drift_kwargs(body))
             case ("GET", ["fleet", "operations"]):
                 return s.fleet.list_ops()
             case ("GET", ["fleet", "operations", op_id]):
@@ -950,6 +954,8 @@ def _print_fleet_op(op: dict) -> None:
     waves = " ".join(
         f"[{'C' if w['canary'] else w['index']}:"
         f"{len(w['clusters'])}:{w['outcome']}]"
+        + (f"(up {'+'.join(w['frontier']['running'])})"
+           if w.get("frontier", {}).get("running") else "")
         for w in op.get("waves", []))
     breaker = op.get("breaker", {})
     print(f"fleet {op['id']}  {op['status']:11s} -> "
@@ -960,11 +966,29 @@ def _print_fleet_op(op: dict) -> None:
           f"  rolled-back {len(op.get('rolled_back', []))}"
           f"  circuit {breaker.get('circuit', '?')}"
           + (f" ({breaker['opened_reason']})"
-             if breaker.get("opened_reason") else ""))
+             if breaker.get("opened_reason") else "")
+          + (f"  concurrency {op['max_concurrent']}"
+             if op.get("max_concurrent", 1) != 1 else ""))
     for name, why in op.get("failed", {}).items():
         print(f"  failed {name}: {why}")
     if op.get("message"):
         print(f"  {op['message']}")
+
+
+def _print_fleet_summary(row: dict) -> None:
+    """One history line from the mirrored summary digest — the LIST form
+    never hydrates a rollout's vars (constant-cost at 1000 rollouts);
+    `fleet status <op>` shows the full ledger for one."""
+    outcomes = row.get("wave_outcomes") or {}
+    waves = " ".join(f"{o}:{n}" for o, n in sorted(outcomes.items()))
+    print(f"fleet {row['id']}  {row['status']:11s} -> "
+          f"{row.get('target_version', '?'):10s} "
+          f"completed {row.get('completed', '?')}"
+          f"/{row.get('clusters', '?')}"
+          f"  failed {row.get('failed', '?')}"
+          f"  rolled-back {row.get('rolled_back', '?')}"
+          f"  circuit {row.get('circuit', '?')}"
+          + (f"  waves {waves}" if waves else ""))
 
 
 def _poll_fleet(client, op_id: str, timeout_s: float, quiet: bool) -> int:
@@ -1009,7 +1033,8 @@ def cmd_fleet(client, args) -> int:
                 body["selector"] = parse_selector(args.selector)
             except KoError as e:
                 raise SystemExit(f"error: {e.message}")
-        for flag in ("wave_size", "max_unavailable", "canary"):
+        for flag in ("wave_size", "max_unavailable", "canary",
+                     "max_concurrent"):
             value = getattr(args, flag)
             if value is not None:
                 body[flag] = value
@@ -1033,7 +1058,7 @@ def cmd_fleet(client, args) -> int:
                 print("no fleet operations journaled")
             else:
                 for op in ops:
-                    _print_fleet_op(op)
+                    _print_fleet_summary(op)
             # same exit contract as the single-op form, --json or not:
             # scripts read the code, not the rendering
             return 1 if any(o["status"] == "Failed" for o in ops) else 0
@@ -1078,6 +1103,46 @@ def cmd_fleet(client, args) -> int:
               f"trace {data.get('trace_id') or '-'}")
         print(render_waterfall(tree))
         return 0 if data.get("status") != "Failed" else 1
+    if args.fleet_cmd == "drift":
+        from urllib.parse import quote
+
+        path = "/api/v1/fleet/drift"
+        params = []
+        if args.target:
+            params.append(f"target={quote(args.target, safe='')}")
+        if args.selector:
+            from kubeoperator_tpu.fleet import parse_selector
+
+            try:
+                selector = parse_selector(args.selector)
+            except KoError as e:
+                raise SystemExit(f"error: {e.message}")
+            params.extend(f"{k}={quote(v, safe='')}"
+                          for k, v in selector.items())
+        if params:
+            path += "?" + "&".join(params)
+        report = client.call("GET", path)
+        if args.json:
+            _print(report)
+        else:
+            print(f"fleet drift vs {report['target_version']}: "
+                  f"{report['checked']} checked, "
+                  f"{report['in_sync']} in sync, "
+                  f"{len(report['drifted'])} drifted")
+            for row in report["drifted"]:
+                kinds = ", ".join(
+                    f"{f['kind']} {f['observed']}!={f['expected']}"
+                    if f["kind"] != "health"
+                    else f"health {'+'.join(f['observed'])}"
+                    for f in row["findings"])
+                rem = row.get("remediation") or {}
+                print(f"  {row['cluster']}: {kinds}"
+                      + (f"  -> {rem.get('action')}" if rem else ""))
+            for name, reason in report.get("skipped", []):
+                print(f"  skipped {name}: {reason}")
+        # exit 1 when anything drifted: scripts alert on it (read-only —
+        # nothing was queued)
+        return 1 if report["drifted"] else 0
     raise SystemExit(f"unknown fleet command {args.fleet_cmd}")
 
 
@@ -1655,26 +1720,55 @@ def _fleet_tree_outcomes(trace: dict) -> dict:
     return outcomes
 
 
-def cmd_fleet_soak(args) -> int:
-    """Deterministic fleet-scale chaos drill (`koctl chaos-soak --fleet`,
-    docs/resilience.md): over >= --clusters simulated TPU clusters, one
-    seeded run proves the three fleet-robustness behaviors — each asserted
-    from the journal rows AND the single stitched trace tree:
+def _lanes_overlap(trace: dict, wave_name: str) -> bool:
+    """Whether the named wave span's child OP lanes overlap in time —
+    the trace-side proof a concurrent wave really ran clusters in
+    parallel."""
+    lanes = []
 
-      (a) canary-block     — an unreachable fault in the canary's health
-                             gate blocks promotion; no later wave runs
-      (b) mid-wave rollback — gate faults past the failure budget open the
-                             fleet breaker; the in-flight wave's upgraded
-                             clusters are re-journaled as rollback child
-                             ops back to their recorded versions
-      (c) death + resume   — ControllerDeath mid-wave strands the fleet op;
-                             a rebooted stack sweeps it to Interrupted and
-                             `fleet resume` finishes WITHOUT re-running
-                             completed clusters
-    """
-    import tempfile
+    def walk(node):
+        if node.get("kind") == "wave" and node.get("name") == wave_name:
+            for child in node.get("children", []):
+                if child.get("kind") == "operation":
+                    lanes.append((child.get("started_at", 0.0),
+                                  child.get("finished_at", 0.0)))
+        for child in node.get("children", []):
+            walk(child)
+
+    if trace.get("tree"):
+        walk(trace["tree"])
+    lanes.sort()
+    return any(lanes[i][1] > lanes[i + 1][0] and lanes[i + 1][1]
+               for i in range(len(lanes) - 1))
+
+
+def _fleet_soak_once(args, base: str) -> dict:
+    """One seeded pass of the fleet drill (docs/resilience.md): over
+    >= --clusters simulated TPU clusters, prove the fleet-robustness
+    behaviors under the CONCURRENT wave engine — each asserted from the
+    journal rows AND the stitched trace trees:
+
+      (a) canary-block     — a canary's failed health gate blocks
+                             promotion; no later wave runs
+      (b) live budget      — failures within max_unavailable promote
+                             (deaths the budget absorbs); the wave that
+                             EXCEEDS it trips the breaker mid-wave,
+                             running siblings settle, the whole wave
+                             rolls back; later waves never run
+      (c) death + resume   — ControllerDeath mid-CONCURRENT-wave strands
+                             the fleet op; a rebooted stack sweeps it to
+                             Interrupted and `fleet resume` finishes
+                             WITHOUT re-running completed clusters
+
+    Every fault is scripted per CLUSTER (ChaosExecutor.fail_hosts /
+    die_at_phase@glob — keyed on the cluster's own host names), so the
+    same clusters fail the same way whatever the thread interleaving
+    did; the `canonical` sub-report is what --verify-determinism diffs
+    bit-for-bit."""
     import time as _time
 
+    from kubeoperator_tpu.fleet import plan_waves
+    from kubeoperator_tpu.fleet.drill import seed_clone_fleet
     from kubeoperator_tpu.models import Plan, Region, Zone
     from kubeoperator_tpu.resilience import ControllerDeath
     from kubeoperator_tpu.version import (
@@ -1683,6 +1777,7 @@ def cmd_fleet_soak(args) -> int:
     )
 
     t0 = _time.monotonic()
+    os.makedirs(base, exist_ok=True)
     hop = SUPPORTED_K8S_VERSIONS.index(DEFAULT_K8S_VERSION) + 1
     if hop >= len(SUPPORTED_K8S_VERSIONS):
         # routine bundle maintenance can make the default the newest
@@ -1692,15 +1787,25 @@ def cmd_fleet_soak(args) -> int:
             f"version, but {DEFAULT_K8S_VERSION} is the newest supported")
     target = SUPPORTED_K8S_VERSIONS[hop]
     total = max(args.clusters, 9)
-    base_n = total // 3
-    groups = {"a": base_n, "b": base_n, "c": total - 2 * base_n}
+    # group sizing: (c) upgrades EVERY cluster it holds, so it stays
+    # modest; (b) needs two 2+-cluster waves (absorbed death + a 2-fault
+    # trip); (a) takes the rest — post-verdict waves never run, which is
+    # exactly the point (blocked promotion / tripped budget)
+    c_n = min(24, max(3, total // 3))
+    a_n = max(2, (total - c_n) // 3)
+    b_n = total - c_n - a_n
+    groups = {"a": a_n, "b": b_n, "c": c_n}
+    canary_n = min(4, max(1, a_n // 2))
+    wave_b = min(8, max(2, b_n // 2))
+    wave_c = min(8, max(1, c_n - 1))
+    original = DEFAULT_K8S_VERSION
     checks: list[dict] = []
 
     def check(name: str, ok, detail: str = "") -> None:
         checks.append({"check": name, "ok": bool(ok), "detail": detail})
 
-    # the drill spans three stacks (the death scenario reboots one);
-    # the injection ledger aggregates across all of them
+    # the drill spans two stacks (the death scenario reboots one); the
+    # injection ledger aggregates across both
     injected = {"total": 0, "by_kind": {}}
 
     def tally(executor) -> None:
@@ -1710,161 +1815,228 @@ def cmd_fleet_soak(args) -> int:
             injected["by_kind"][kind] = \
                 injected["by_kind"].get(kind, 0) + count
 
-    with tempfile.TemporaryDirectory(prefix="ko-fleet-soak-") as base:
-        db_path = os.path.join(base, "fleet.db")
-        svc = _fleet_stack(args, base, db_path)
-        region = svc.regions.create(Region(
-            name="soak-region", provider="gcp_tpu_vm",
-            vars={"project": "soak", "name": "us-central1"}))
-        zone = svc.zones.create(Zone(
-            name="soak-zone", region_id=region.id,
-            vars={"gcp_zone": "us-central1-a"}))
-        svc.plans.create(Plan(
-            name="soak-v5e-16", provider="gcp_tpu_vm", region_id=region.id,
-            zone_ids=[zone.id], accelerator="tpu", tpu_type="v5e-16",
-            worker_count=0))
-        for group, count in groups.items():
-            for i in range(count):
-                svc.clusters.create(
-                    f"soak-{group}-{i:02d}", provision_mode="plan",
-                    plan_name="soak-v5e-16", wait=True)
-        original = DEFAULT_K8S_VERSION
-        ops = svc.repos.operations
+    db_path = os.path.join(base, "fleet.db")
+    svc = _fleet_stack(args, base, db_path)
+    region = svc.regions.create(Region(
+        name="soak-region", provider="gcp_tpu_vm",
+        vars={"project": "soak", "name": "us-central1"}))
+    zone = svc.zones.create(Zone(
+        name="soak-zone", region_id=region.id,
+        vars={"gcp_zone": "us-central1-a"}))
+    svc.plans.create(Plan(
+        name="soak-v5e-16", provider="gcp_tpu_vm", region_id=region.id,
+        zone_ids=[zone.id], accelerator="tpu", tpu_type="v5e-16",
+        worker_count=0))
+    names = seed_clone_fleet(svc, "soak-v5e-16", groups)
+    ops = svc.repos.operations
 
-        # ---- (a) canary gate failure blocks promotion ----
-        svc.executor.fail_at("adhoc:command", [1])
-        op_a = svc.fleet.upgrade(
-            target, selector={"name": "soak-a-*"}, canary=1,
-            wave_size=max(groups["a"] - 1, 1), max_unavailable=1, wait=True)
-        trace_a = svc.fleet.trace(op_a["id"])
-        check("a: fleet op Failed", op_a["status"] == "Failed",
-              op_a["message"])
-        check("a: canary wave blocked",
-              op_a["waves"][0]["outcome"] == "canary-blocked")
-        check("a: later waves never ran",
-              all(w["outcome"] == "pending" for w in op_a["waves"][1:]))
-        check("a: exactly one child op (the canary upgrade)",
-              [o.kind for o in ops.children(op_a["id"])] == ["upgrade"])
-        untouched = [f"soak-a-{i:02d}" for i in range(1, groups["a"])]
-        check("a: non-canary clusters untouched", all(
-            svc.clusters.get(n).spec.k8s_version == original
-            for n in untouched))
-        check("a: trace tree says canary-blocked",
-              _fleet_tree_outcomes(trace_a).get("wave-0")
-              == "canary-blocked")
+    # ---- (a) canary gate failure blocks a CONCURRENT canary wave ----
+    bad_canary = names["a"][1] if canary_n > 1 else names["a"][0]
+    svc.executor.fail_hosts("adhoc:command", f"{bad_canary}-*", [1])
+    op_a = svc.fleet.upgrade(
+        target, selector={"name": "soak-a-*"}, canary=canary_n,
+        wave_size=wave_b, max_unavailable=1,
+        max_concurrent=max(canary_n, 2), wait=True)
+    op_a = svc.fleet.status(op_a["id"])
+    check("a: fleet op Failed", op_a["status"] == "Failed",
+          op_a["message"])
+    check("a: canary wave blocked",
+          op_a["waves"][0]["outcome"] == "canary-blocked")
+    check("a: later waves never ran",
+          all(w["outcome"] == "pending" for w in op_a["waves"][1:]))
+    check("a: the scripted canary is the failed one",
+          list(op_a["failed"]) == [bad_canary]
+          and "health gate failed" in op_a["failed"][bad_canary],
+          str(op_a["failed"]))
+    check("a: every launched child was a canary upgrade",
+          all(o.kind == "upgrade"
+              and o.cluster_name in names["a"][:canary_n]
+              for o in ops.children(op_a["id"])),
+          str([o.cluster_name for o in ops.children(op_a["id"])]))
+    check("a: non-canary clusters untouched", all(
+        svc.clusters.get(n).spec.k8s_version == original
+        for n in names["a"][canary_n:]))
+    check("a: trace tree says canary-blocked",
+          _fleet_tree_outcomes(svc.fleet.trace(op_a["id"]))
+          .get("wave-0") == "canary-blocked")
 
-        # ---- (b) budget trip rolls the in-flight wave back ----
-        # gates probe 5 adhocs per TPU cluster: submission 1 fails the
-        # FIRST cluster's gate, 6 the SECOND's -> 2 unavailable > budget 1
-        svc.executor.fail_at("adhoc:command", [1, 6])
-        op_b = svc.fleet.upgrade(
-            target, selector={"name": "soak-b-*"}, canary=0,
-            wave_size=3, max_unavailable=1, wait=True)
-        trace_b = svc.fleet.trace(op_b["id"])
-        rolled = [f"soak-b-{i:02d}" for i in range(2)]
-        check("b: fleet op Failed", op_b["status"] == "Failed",
-              op_b["message"])
-        check("b: wave rolled back",
-              op_b["waves"][0]["outcome"] == "rolled-back")
-        check("b: breaker open with reason",
-              op_b["breaker"]["circuit"] == "open"
-              and "budget exceeded" in (op_b["breaker"]["opened_reason"]
-                                        or ""))
-        kinds_b = sorted(o.kind for o in ops.children(op_b["id"]))
-        check("b: 2 upgrades re-journaled as 2 rollbacks",
-              kinds_b == ["rollback", "rollback", "upgrade", "upgrade"],
-              str(kinds_b))
-        check("b: rolled-back clusters restored", all(
-            svc.clusters.get(n).spec.k8s_version == original
-            for n in rolled), str(op_b["rolled_back"]))
-        check("b: rest of the wave untouched", all(
-            svc.clusters.get(f"soak-b-{i:02d}").spec.k8s_version == original
-            for i in range(2, groups["b"])))
-        check("b: trace tree says rolled-back",
-              _fleet_tree_outcomes(trace_b).get("wave-0") == "rolled-back")
-        tally(svc.executor)
-        svc.close()
+    # ---- (b) the LIVE budget: absorbed deaths, then a mid-wave trip ----
+    waves_b = plan_waves(names["b"], wave_b, 0)
+    w0, w1 = waves_b[0]["clusters"], waves_b[1]["clusters"]
+    absorbed = [w0[1]]                      # within budget: promotes
+    trippers = [w1[0], w1[-1]]              # 3 > 2: trips mid-wave
+    for name in absorbed + trippers:
+        svc.executor.fail_hosts("adhoc:command", f"{name}-*", [1])
+    op_b = svc.fleet.upgrade(
+        target, selector={"name": "soak-b-*"}, canary=0,
+        wave_size=wave_b, max_unavailable=2, max_concurrent=wave_b,
+        wait=True)
+    op_b = svc.fleet.status(op_b["id"])
+    trace_b = svc.fleet.trace(op_b["id"])
+    check("b: fleet op Failed", op_b["status"] == "Failed",
+          op_b["message"])
+    check("b: wave 0 promoted with the absorbed death",
+          op_b["waves"][0]["outcome"] == "promoted"
+          and absorbed[0] in op_b["failed"],
+          str(op_b["waves"][0]))
+    check("b: wave 1 tripped the live budget and rolled back",
+          op_b["waves"][1]["outcome"] == "rolled-back")
+    check("b: breaker open with reason",
+          op_b["breaker"]["circuit"] == "open"
+          and "budget exceeded" in (op_b["breaker"]["opened_reason"]
+                                    or ""))
+    check("b: later waves never ran",
+          all(w["outcome"] == "pending" for w in op_b["waves"][2:]))
+    check("b: the failed set is exactly the scripted set",
+          sorted(op_b["failed"]) == sorted(absorbed + trippers),
+          str(sorted(op_b["failed"])))
+    # wave 1 launched WHOLE (wave_size == max_concurrent), so the entire
+    # wave upgraded before the trip settled — and the rollback leg
+    # re-journaled every one of them
+    check("b: the whole tripped wave rolled back",
+          sorted(op_b["rolled_back"]) == sorted(w1),
+          str(sorted(op_b["rolled_back"])))
+    check("b: tripped wave back at the original version", all(
+        svc.clusters.get(n).spec.k8s_version == original for n in w1))
+    check("b: promoted wave kept the target", all(
+        svc.clusters.get(n).spec.k8s_version == target
+        for n in w0 if n not in absorbed))
+    check("b: unlaunched waves untouched", all(
+        svc.clusters.get(n).spec.k8s_version == original
+        for w in waves_b[2:] for n in w["clusters"]))
+    kinds_b = [o.kind for o in ops.children(op_b["id"])]
+    check("b: one rollback child per tripped-wave cluster",
+          kinds_b.count("rollback") == len(w1)
+          and kinds_b.count("upgrade") == len(w0) + len(w1),
+          str(sorted(kinds_b)))
+    check("b: trace tree says rolled-back",
+          _fleet_tree_outcomes(trace_b).get("wave-1") == "rolled-back")
+    check("b: concurrent lanes overlap in the promoted wave",
+          _lanes_overlap(trace_b, "wave-0"))
+    tally(svc.executor)
+    svc.close()
 
-        # ---- (c) controller death mid-wave, reboot, resume ----
-        # canary + wave of 3: submission 3 of upgrade-prepare is the
-        # SECOND wave-1 cluster -> death lands mid-wave with 2 clusters
-        # (canary + one wave-1) already completed
-        svc = _fleet_stack(args, base, db_path,
-                           die_at_phase="20-upgrade-prepare.yml#3")
-        died = False
-        try:
-            svc.fleet.upgrade(
-                target, selector={"name": "soak-c-*"}, canary=1,
-                wave_size=3, max_unavailable=1, wait=True)
-        except ControllerDeath:
-            died = True
-        check("c: controller death fired mid-wave", died)
-        open_fleet = [o for o in svc.repos.operations.find(
-            kind="fleet-upgrade", status="Running")]
-        check("c: fleet op left open by the crash", len(open_fleet) == 1)
-        op_c_id = open_fleet[0].id if open_fleet else ""
-        tally(svc.executor)
-        svc.close()
+    # ---- (c) controller death mid-CONCURRENT-wave, reboot, resume ----
+    waves_c = plan_waves(names["c"], wave_c, 1)
+    victim = waves_c[1]["clusters"][min(1, wave_c - 1)]
+    svc = _fleet_stack(
+        args, base, db_path,
+        die_at_phase=f"20-upgrade-prepare.yml@{victim}-*")
+    died = False
+    try:
+        svc.fleet.upgrade(
+            target, selector={"name": "soak-c-*"}, canary=1,
+            wave_size=wave_c, max_unavailable=1,
+            max_concurrent=min(wave_c, 8), wait=True)
+    except ControllerDeath:
+        died = True
+    check("c: controller death fired mid-wave", died)
+    open_fleet = [o for o in svc.repos.operations.find(
+        kind="fleet-upgrade", status="Running")]
+    check("c: fleet op left open by the crash", len(open_fleet) == 1)
+    op_c_id = open_fleet[0].id if open_fleet else ""
+    frontier = {}
+    if open_fleet:
+        for w in open_fleet[0].vars.get("waves", []):
+            if w.get("frontier", {}).get("running"):
+                frontier = w["frontier"]
+    check("c: persisted frontier names the dying cluster in flight",
+          victim in frontier.get("running", []), str(frontier))
+    tally(svc.executor)
+    svc.close()
 
-        svc = _fleet_stack(args, base, db_path)   # the reboot
-        swept = {r["op"]: r for r in svc.boot_report}
-        check("c: boot sweep interrupted the fleet op",
-              swept.get(op_c_id, {}).get("kind") == "fleet-upgrade"
-              and swept.get(op_c_id, {}).get("resume_phase") == "wave-1",
-              str(svc.boot_report))
-        completed_before = set(
-            svc.fleet.status(op_c_id)["completed"])
-        svc.fleet.resume(op_c_id, wait=True)
-        op_c = svc.fleet.status(op_c_id)
-        trace_c = svc.fleet.trace(op_c_id)
-        check("c: rollout finished Succeeded after resume",
-              op_c["status"] == "Succeeded", op_c["message"])
-        check("c: every cluster at the target", all(
-            svc.clusters.get(f"soak-c-{i:02d}").spec.k8s_version == target
-            for i in range(groups["c"])))
-        children_c = svc.repos.operations.children(op_c_id)
-        per_cluster: dict = {}
-        for child in children_c:
-            per_cluster.setdefault(child.cluster_name, []).append(
-                child.status)
-        check("c: completed clusters were NOT re-run", all(
-            len(per_cluster.get(n, [])) == 1 for n in completed_before),
-            str({n: per_cluster.get(n) for n in completed_before}))
-        interrupted_cluster = [
-            n for n, statuses in per_cluster.items()
-            if "Interrupted" in statuses]
-        check("c: the mid-flight cluster was re-run to success",
-              len(interrupted_cluster) == 1
-              and "Succeeded" in per_cluster[interrupted_cluster[0]],
-              str(per_cluster))
-        outcomes_c = _fleet_tree_outcomes(trace_c)
-        check("c: one stitched tree with every wave promoted",
-              trace_c.get("tree") is not None and outcomes_c
-              and all(o == "promoted" for o in outcomes_c.values()),
-              str(outcomes_c))
-        tally(svc.executor)
-        svc.close()
+    svc = _fleet_stack(args, base, db_path)   # the reboot
+    swept = {r["op"]: r for r in svc.boot_report}
+    check("c: boot sweep interrupted the fleet op",
+          swept.get(op_c_id, {}).get("kind") == "fleet-upgrade"
+          and swept.get(op_c_id, {}).get("resume_phase") == "wave-1",
+          str(svc.boot_report))
+    completed_before = set(svc.fleet.status(op_c_id)["completed"])
+    svc.fleet.resume(op_c_id, wait=True)
+    op_c = svc.fleet.status(op_c_id)
+    trace_c = svc.fleet.trace(op_c_id)
+    check("c: rollout finished Succeeded after resume",
+          op_c["status"] == "Succeeded", op_c["message"])
+    check("c: every cluster at the target", all(
+        svc.clusters.get(n).spec.k8s_version == target
+        for n in names["c"]))
+    per_cluster: dict = {}
+    for child in svc.repos.operations.children(op_c_id):
+        per_cluster.setdefault(child.cluster_name, []).append(child.status)
+    check("c: completed clusters were NOT re-run", all(
+        len(per_cluster.get(n, [])) == 1 for n in completed_before),
+        str({n: per_cluster.get(n) for n in completed_before}))
+    check("c: the dying cluster was re-run to success",
+          sorted(per_cluster.get(victim, [])) == [
+              "Interrupted", "Succeeded"],
+          str(per_cluster.get(victim)))
+    outcomes_c = _fleet_tree_outcomes(trace_c)
+    check("c: one stitched tree with every wave promoted",
+          trace_c.get("tree") is not None and outcomes_c
+          and all(o == "promoted" for o in outcomes_c.values()),
+          str(outcomes_c))
+    tally(svc.executor)
+    svc.close()
 
     ok = all(c["ok"] for c in checks)
-    report = {
+    return {
         "seed": args.seed,
         "clusters": total,
+        "groups": groups,
         "target": target,
+        "max_concurrent": {"a": max(canary_n, 2), "b": wave_b,
+                           "c": min(wave_c, 8)},
         "checks": checks,
         "injection_summary": injected,
         "ok": ok,
+        # what --verify-determinism diffs bit-for-bit: verdicts and
+        # scripted-fault accounting only — details carry per-pass op ids
+        "canonical": {
+            "verdicts": [(c["check"], c["ok"]) for c in checks],
+            "injections": injected,
+            "groups": groups,
+            "target": target,
+        },
         "runtime_s": round(_time.monotonic() - t0, 3),
     }
+
+
+def cmd_fleet_soak(args) -> int:
+    """`koctl chaos-soak --fleet [--clusters N] [--verify-determinism]`:
+    the fleet-scale drill over the CONCURRENT wave engine — canary
+    block, the live unavailability budget (absorbed deaths + a mid-wave
+    trip with sibling settling + rollback), and ControllerDeath
+    mid-concurrent-wave with crash-resume; with --verify-determinism the
+    whole drill runs twice and the canonical reports must match
+    bit-for-bit (per-cluster fault scripting makes the verdicts a pure
+    function of the seed+fleet, whatever the thread interleaving did)."""
+    import tempfile
+    import time as _time
+
+    t0 = _time.monotonic()
+    with tempfile.TemporaryDirectory(prefix="ko-fleet-soak-") as base:
+        report = _fleet_soak_once(args, os.path.join(base, "pass1"))
+        if args.verify_determinism:
+            second = _fleet_soak_once(args, os.path.join(base, "pass2"))
+            report["deterministic"] = (
+                report["canonical"] == second["canonical"])
+    report["runtime_s"] = round(_time.monotonic() - t0, 3)
+    ok = report["ok"] and report.get("deterministic", True)
     if args.format == "json":
         _print(report)
     else:
-        print(f"fleet chaos-soak: seed={args.seed} clusters={total} "
-              f"-> {target}")
-        for c in checks:
+        print(f"fleet chaos-soak: seed={report['seed']} "
+              f"clusters={report['clusters']} {report['groups']} "
+              f"-> {report['target']} "
+              f"(concurrency {report['max_concurrent']})")
+        for c in report["checks"]:
             mark = "ok " if c["ok"] else "FAIL"
             print(f"  [{mark}] {c['check']}"
                   + (f" — {c['detail']}" if c["detail"] and not c["ok"]
                      else ""))
+        if args.verify_determinism:
+            print(f"  deterministic across two runs: "
+                  f"{report['deterministic']}")
         print(f"  runtime {report['runtime_s']}s — "
               + ("OK" if ok else "FAILED"))
     return 0 if ok else 1
@@ -2938,6 +3110,10 @@ def build_parser() -> argparse.ArgumentParser:
     f_up.add_argument("--canary", type=int, default=None,
                       help="clusters upgraded and gated before any wave "
                            "(default: fleet.canary)")
+    f_up.add_argument("--max-concurrent", type=int, default=None,
+                      help="clusters upgrading+gating at once inside a "
+                           "wave; max-unavailable stays a LIVE budget "
+                           "(default: fleet.max_concurrent_clusters)")
     f_up.add_argument("--no-wait", action="store_true")
     f_up.add_argument("--json", action="store_true",
                       help="with --no-wait: emit the accepted op as JSON")
@@ -2963,6 +3139,19 @@ def build_parser() -> argparse.ArgumentParser:
     f_trace.add_argument("op", nargs="?", default="",
                          help="fleet op id; default: the newest")
     f_trace.add_argument("--json", action="store_true")
+    f_drift = fsub.add_parser(
+        "drift",
+        help="READ-ONLY drift detection: observed version/health vs the "
+             "plan across the fleet, with the would-be remediation set "
+             "as JSON (exit 1 when anything drifted)")
+    f_drift.add_argument("--target", default="",
+                         help="expected k8s version (default: the newest "
+                              "rollout's target)")
+    f_drift.add_argument("--selector", action="append",
+                         metavar="key=value",
+                         help="cluster filter: name=<glob>, project=, "
+                              "plan=, version=; repeatable (AND)")
+    f_drift.add_argument("--json", action="store_true")
 
     workload_p = sub.add_parser(
         "workload",

@@ -1,0 +1,72 @@
+"""Drill/benchmark fleet seeding — shared by `koctl chaos-soak --fleet`,
+`perf_matrix.py --fleet`, and the tier-1 budget tests.
+
+A 200-cluster soak (or a paced wave benchmark) exercises the UPGRADE
+path at scale; paying a full simulated create per cluster would dominate
+its runtime and measure nothing new. `seed_clone_fleet` runs ONE real
+simulated create (inventory, node rows, Ready gate — the template) and
+row-level-clones it for everyone else: cluster + host + node rows with
+ids/names/ips rewritten, so every clone upgrades, gates, and probes
+exactly like a really-created cluster.
+"""
+
+from __future__ import annotations
+
+from kubeoperator_tpu.utils.ids import new_id
+
+
+def seed_clone_fleet(svc, plan_name: str, groups: dict,
+                     prefix: str = "soak",
+                     template: str = "soak-tpl") -> dict:
+    """Create `template` through the real simulated create, then clone
+    it into `{group: count}` Ready clusters named
+    `<prefix>-<group>-<index:03d>`. Returns {group: [names]} (sorted,
+    planner order)."""
+    svc.clusters.create(template, provision_mode="plan",
+                        plan_name=plan_name, wait=True)
+    repos = svc.repos
+    seedc = repos.clusters.get_by_name(template)
+    seed_hosts = repos.hosts.find(cluster_id=seedc.id)
+    seed_nodes = repos.nodes.find(cluster_id=seedc.id)
+    names: dict = {}
+    serial = 0
+    for group in sorted(groups):
+        names[group] = []
+        for i in range(groups[group]):
+            serial += 1
+            name = f"{prefix}-{group}-{i:03d}"
+            names[group].append(name)
+            clone = type(seedc).from_dict(seedc.to_dict())
+            clone.id = new_id()
+            clone.name = name
+            repos.clusters.save(clone)
+            host_map: dict = {}
+            for host in seed_hosts:
+                h2 = type(host).from_dict(host.to_dict())
+                h2.id = new_id()
+                h2.name = host.name.replace(template, name, 1)
+                h2.ip = (f"10.{(serial >> 8) & 255}.{serial & 255}."
+                         f"{len(host_map) + 1}")
+                h2.cluster_id = clone.id
+                repos.hosts.save(h2)
+                host_map[host.id] = h2
+            for node in seed_nodes:
+                n2 = type(node).from_dict(node.to_dict())
+                n2.id = new_id()
+                n2.name = node.name.replace(template, name, 1)
+                n2.cluster_id = clone.id
+                n2.host_id = host_map[node.host_id].id
+                repos.nodes.save(n2)
+    return names
+
+
+def wave_span_seconds(svc, op_id: str, wave_name: str = "wave-0") -> float:
+    """The named wave span's wall-clock from the rollout's stitched
+    trace — the benchmark compares WAVE windows, not rollout wall-clock,
+    so planning/journal overhead can't dilute the scheduler's own
+    ratio."""
+    for span in svc.repos.spans.for_operation(op_id):
+        if span.kind == "wave" and span.name == wave_name:
+            if span.finished_at and span.started_at:
+                return float(span.finished_at - span.started_at)
+    return 0.0

@@ -1,33 +1,56 @@
 """The fleet wave scheduler: one engine run drives ONE fleet operation.
 
-Execution order is strictly deterministic — waves in planner order,
-clusters inside a wave in sorted-name order, upgrades and gates serial —
-because the seeded chaos drill (`koctl chaos-soak --fleet`) replays a
-rollout against an injection sequence and must meet the same faults at
-the same steps every run.
+Waves run in planner order, strictly one at a time; INSIDE a wave,
+clusters upgrade and gate CONCURRENTLY on the shared bounded worker pool
+(`adm/pool.py BoundedPool`, the same coordinator the phase-DAG scheduler
+runs on) under `fleet.max_concurrent_clusters` — 1 is the historical
+serial loop, bit-identical. Launch order is always sorted-name order
+(the planner's contract), so wave membership and per-cluster verdicts
+stay deterministic whatever the thread interleaving did to completion
+timing; the ledger lists are kept in canonical sorted order so a
+concurrent rollout journals the same final state the serial one did.
+
+`max_unavailable` is a LIVE budget: the breaker trips the moment a
+settling cluster pushes the unavailable count past it — new launches
+stop immediately, running siblings settle (finish, or fail their retry
+budgets and join the unavailable set), and only then does the rollback
+leg run, exactly as in the serial engine. Canary failures and operator
+pause/abort stop new launches the same way; pause/abort remain
+cluster-boundary signals (a cluster upgrade is never interrupted
+halfway).
 
 State discipline: everything the engine learns lands in the fleet op's
 `vars` (completed / failed / rolled_back / per-wave `upgraded` lists, the
-breaker state dict) and is SAVED at every cluster boundary, so the row is
-always a resume point. A `ControllerDeath` (BaseException) mid-cluster
-tears straight through — open fleet op + open child op + Running spans
-are exactly the crash evidence the boot reconciler sweeps; the resumed
-engine re-enters at the first cluster not yet recorded as done.
+breaker state dict, and the per-cluster wave `frontier` — who is in
+flight, who was never launched) and is SAVED at every cluster boundary,
+so the row is always a resume point. A `ControllerDeath` (BaseException)
+mid-cluster tears straight through — open fleet op + open child op +
+Running spans are exactly the crash evidence the boot reconciler sweeps;
+the resumed engine re-enters at the first cluster not yet recorded as
+done, and the persisted frontier names the set that was in flight.
 
-Trace shape (one tree per rollout, `koctl fleet trace`):
+Trace shape (one tree per rollout, `koctl fleet trace`): wave spans now
+contain one OVERLAPPING child-op lane per concurrently-upgrading
+cluster.
 
     operation fleet-upgrade          (root; span id == fleet op id)
       └── phase wave-N               (one per wave the engine entered)
             └── operation upgrade    (child op root, journal.open stitched)
                   └── phase ...      (the ordinary per-cluster tree)
+            └── operation upgrade    (a sibling lane, overlapping)
             └── operation rollback   (when the breaker tripped the wave)
 """
 
 from __future__ import annotations
 
+import bisect
+import threading
 import time
 
+from kubeoperator_tpu.adm.pool import BoundedPool
+
 from kubeoperator_tpu.fleet.gates import evaluate_gate
+from kubeoperator_tpu.fleet.planner import rollout_summary
 from kubeoperator_tpu.fleet.rollback import rollback_wave
 from kubeoperator_tpu.models.span import SpanKind, SpanStatus
 from kubeoperator_tpu.observability import trace_context
@@ -67,14 +90,25 @@ class FleetEngine:
         self.pause_event = pause_event
         self.abort_event = abort_event
         self.now = now
+        # every op.vars mutation AND its fenced save happen under this
+        # lock: concurrent cluster workers must never tear the ledger
+        # mid-serialization (json.dumps over a dict a sibling is growing)
+        self._ledger_lock = threading.RLock()
 
     # ---- persistence helpers ----
     def _save(self) -> None:
         # fenced: a fenced-out engine (lease lost, successor resuming this
-        # rollout elsewhere) must not clobber the successor's wave ledger
-        self.journal.save_vars(self.op)
+        # rollout elsewhere) must not clobber the successor's wave ledger.
+        # The summary digest rides every save, so `fleet status` over the
+        # history answers from the mirrored column without hydrating vars
+        with self._ledger_lock:
+            self.op.summary = rollout_summary(self.op.vars)
+            self.journal.save_vars(self.op)
 
     def _close(self, ok: bool, message: str) -> None:
+        # the close writes the op row: refresh the mirrored digest so the
+        # history listing reflects the final ledger
+        self.op.summary = rollout_summary(self.op.vars)
         self.journal.close(self.op, ok=ok, message=message)
 
     def _park_paused(self, wave_index: int) -> None:
@@ -183,24 +217,66 @@ class FleetEngine:
             return WAVE_CANARY_BLOCKED
         if breaker.state["state"] == "open":
             return self._trip_wave(wave, wave_span, tracer)
-        for name in wave["clusters"]:
-            if name in v["completed"] or name in v["failed"] \
-                    or name in v["rolled_back"]:
-                continue
+
+        # the wave's launch queue, sorted-name order (planner contract);
+        # resume skips everything already settled in the ledger
+        todo = [n for n in wave["clusters"]
+                if n not in v["completed"] and n not in v["failed"]
+                and n not in v["rolled_back"]]
+        # verdict["wave"]: the first halting verdict wins the wave —
+        # canary-block/trip (settle side) over abort over pause (launch
+        # side); `error` transports an unexpected engine exception out of
+        # a worker with serial-loop parity (halt, settle siblings, raise)
+        verdict: dict = {"wave": None, "error": None}
+        state: dict = {"frontier": None}
+
+        def schedule(view):
+            if verdict["wave"] is not None or verdict["error"] is not None:
+                return []
+            if not todo:
+                # nothing left to launch: a fully-dispatched wave settles
+                # to its own verdict — pause/abort only gate LAUNCHES
+                # (serial parity: the old loop never re-checked the
+                # events after the last cluster started)
+                return []
             if self.abort_event.is_set():
-                return WAVE_ABORTED
+                verdict["wave"] = WAVE_ABORTED
+                return []
             if self.pause_event.is_set():
-                return _PARKED_PAUSE
+                verdict["wave"] = _PARKED_PAUSE
+                return []
+            launches = todo[:view.free]
+            del todo[:len(launches)]
+            return launches
+
+        def work(name):
             ok, why = self._upgrade_one(name, wave, wave_span, tracer)
             if ok and v["gate_health"]:
                 ok, why = self._gate_one(name)
-            if ok:
-                v["completed"].append(name)
+            return ok, why
+
+        def settle(name, result, error) -> None:
+            if error is not None:
+                # engine bug / repo outage mid-cluster: same contract as
+                # the serial loop, where it propagated out of the wave —
+                # stop new launches, let siblings settle, re-raise below
+                if verdict["error"] is None:
+                    verdict["error"] = error
+                return
+            ok, why = result
+            with self._ledger_lock:
+                if ok:
+                    if name not in v["completed"]:
+                        bisect.insort(v["completed"], name)
+                    self._save()
+                    return
+                # canonical sorted ledger: a concurrent wave's settle
+                # order is timing, not truth — the journaled verdict must
+                # not depend on it
+                v["failed"][name] = why
+                v["failed"] = dict(sorted(v["failed"].items()))
+                tripped = note_unavailable(breaker, self.now(), name, why)
                 self._save()
-                continue
-            v["failed"][name] = why
-            tripped = note_unavailable(breaker, self.now(), name, why)
-            self._save()
             self._emit(name, "Warning", "FleetClusterUnavailable",
                        f"fleet upgrade to {target}: {name} unavailable "
                        f"({why})")
@@ -208,9 +284,40 @@ class FleetEngine:
                 # canaries are the blast radius the operator chose —
                 # promotion is blocked on the FIRST canary failure,
                 # whatever the budget says
-                return WAVE_CANARY_BLOCKED
-            if tripped:
-                return self._trip_wave(wave, wave_span, tracer)
+                if verdict["wave"] not in (WAVE_CANARY_BLOCKED,):
+                    verdict["wave"] = WAVE_CANARY_BLOCKED
+            elif tripped and verdict["wave"] in (None, WAVE_ABORTED,
+                                                 _PARKED_PAUSE):
+                # the LIVE budget: tripping mid-wave stops new launches
+                # now; the rollback leg waits for the siblings to settle
+                verdict["wave"] = "tripped"
+
+        def on_turn(view) -> None:
+            # per-cluster frontier, the wave-level analogue of the DAG
+            # scheduler's resume frontier: persisted on every change so
+            # an interrupted op names exactly who was in flight and who
+            # was never launched. Suppressed by the pool after a fatal —
+            # the pre-crash frontier IS the crash record.
+            frontier = {"running": sorted(view.running),
+                        "pending": sorted(todo)}
+            if frontier != state["frontier"]:
+                state["frontier"] = frontier
+                with self._ledger_lock:
+                    wave["frontier"] = frontier
+                    self._save()
+
+        pool = BoundedPool(max(int(v.get("max_concurrent", 1)), 1),
+                           f"fleet-wave{wave['index']}")
+        pool.run(schedule, work, settle, on_turn=on_turn)
+
+        if verdict["error"] is not None:
+            raise verdict["error"]
+        if verdict["wave"] == WAVE_CANARY_BLOCKED:
+            return WAVE_CANARY_BLOCKED
+        if verdict["wave"] == "tripped":
+            return self._trip_wave(wave, wave_span, tracer)
+        if verdict["wave"] in (WAVE_ABORTED, _PARKED_PAUSE):
+            return verdict["wave"]
         return WAVE_PROMOTED
 
     def _upgrade_one(self, name: str, wave: dict, wave_span,
@@ -226,13 +333,17 @@ class FleetEngine:
                 # resume edge: the controller died after this upgrade
                 # landed but before `completed` was saved — done is done,
                 # re-gate only
-                if name not in wave["upgraded"]:
-                    wave["upgraded"].append(name)
+                with self._ledger_lock:
+                    if name not in wave["upgraded"]:
+                        bisect.insort(wave["upgraded"], name)
                 return True, ""
             self.s.upgrades.upgrade(
                 name, target, links=self._links(wave_span, tracer))
-            wave["upgraded"].append(name)
-            self._save()
+            # sorted insert (not append): the rollback leg and the drill
+            # read this list, and concurrent completion order is timing
+            with self._ledger_lock:
+                bisect.insort(wave["upgraded"], name)
+                self._save()
             return True, ""
         except KoError as e:
             return False, f"upgrade failed: {e.message}"
@@ -247,7 +358,8 @@ class FleetEngine:
             return False, f"health gate failed: {e.message}"
         gate = evaluate_gate(self.s.health, self.s.watchdog, name,
                              cluster.id)
-        self.op.vars.setdefault("gates", {})[name] = gate.to_dict()
+        with self._ledger_lock:
+            self.op.vars.setdefault("gates", {})[name] = gate.to_dict()
         if gate.ok:
             return True, ""
         return False, (f"health gate failed "

@@ -83,17 +83,69 @@ def upgrade_kwargs(body: dict) -> dict:
         "max_unavailable": optional_int(
             "max_unavailable", body.get("max_unavailable")),
         "canary": optional_int("canary", body.get("canary")),
+        "max_concurrent": optional_int(
+            "max_concurrent", body.get("max_concurrent")),
+    }
+
+
+def drift_kwargs(body: dict) -> dict:
+    """The body→`FleetService.drift` translation both transports share
+    (the REST handler reads it off query params, the local dispatch off
+    the same keys) — KO-X010 behavioral parity for the read-only drift
+    verb. Selector keys ride flat (`?name=prod-*`), like the CLI flags."""
+    selector = {k: body[k] for k in SELECTOR_KEYS if body.get(k)}
+    nested = body.get("selector")
+    if nested is not None:
+        if not isinstance(nested, dict):
+            raise ValidationError("selector must be an object")
+        selector.update(nested)
+    return {
+        "target_version": str(body.get("target", "") or ""),
+        "selector": selector,
     }
 
 
 def validate_rollout(wave_size: int, max_unavailable: int,
-                     canary: int) -> None:
+                     canary: int, max_concurrent: int = 1) -> None:
     if wave_size < 1:
         raise ValidationError("wave-size must be >= 1")
     if max_unavailable < 0:
         raise ValidationError("max-unavailable must be >= 0")
     if canary < 0:
         raise ValidationError("canary must be >= 0")
+    if max_concurrent < 1:
+        raise ValidationError("max-concurrent must be >= 1")
+
+
+def rollout_summary(v: dict) -> dict:
+    """The compact digest of a rollout's vars the journal mirrors into
+    the operations row's `summary` column (migration 012): everything
+    `fleet status`'s LIST form and the 1 Hz poll header need, none of the
+    per-cluster detail — so a 1000-rollout history answers without
+    hydrating a single historical vars blob. Maintained by the engine at
+    every ledger save; counts only, no cluster names (the full ledger
+    stays in vars)."""
+    waves = v.get("waves", [])
+    outcomes: dict[str, int] = {}
+    in_flight = 0
+    for w in waves:
+        o = w.get("outcome", "pending")
+        outcomes[o] = outcomes.get(o, 0) + 1
+        in_flight += len((w.get("frontier") or {}).get("running", []))
+    breaker = v.get("breaker") or {}
+    return {
+        "in_flight": in_flight,
+        "target_version": v.get("target_version", ""),
+        "clusters": len(v.get("clusters", [])),
+        "waves": len(waves),
+        "wave_outcomes": dict(sorted(outcomes.items())),
+        "current_wave": v.get("current_wave", 0),
+        "completed": len(v.get("completed", [])),
+        "failed": len(v.get("failed", {})),
+        "rolled_back": len(v.get("rolled_back", [])),
+        "circuit": str(breaker.get("state", "closed")),
+        "max_concurrent": int(v.get("max_concurrent", 1) or 1),
+    }
 
 
 def _matches(cluster, selector: dict, plan_names: dict,
@@ -144,6 +196,89 @@ def eligible_clusters(repos, selector: dict, target_version: str,
             continue
         eligible.append(cluster.name)
     return eligible, skipped
+
+
+def detect_drift(repos, selector: dict, target_version: str,
+                 hop_check, health_failed) -> dict:
+    """Fleet-wide drift detection (READ-ONLY — the inventory half of
+    ROADMAP item 4): compare every managed cluster's observed version and
+    health against the plan (the rollout target + Ready-and-healthy) and
+    emit the would-be remediation set as plain JSON. Nothing is queued:
+    the operator (or a future auto-queue leg) decides.
+
+    `hop_check(current, target)` returns a skip reason or None (the
+    upgrade service's one-minor-hop gate, injected like
+    eligible_clusters); `health_failed(cluster)` returns the cluster's
+    standing failed health-condition names (the watchdog's markers,
+    injected so this module never imports the service layer)."""
+    plan_names = {p.id: p.name for p in repos.plans.list()}
+    project_names = {p.id: p.name for p in repos.projects.list()}
+    checked = 0
+    in_sync = 0
+    drifted: list[dict] = []
+    skipped: list[list] = []
+    for cluster in sorted(repos.clusters.list(), key=lambda c: c.name):
+        if not _matches(cluster, selector, plan_names, project_names):
+            continue
+        if cluster.provision_mode == "imported":
+            skipped.append([cluster.name, "imported (not managed)"])
+            continue
+        checked += 1
+        findings: list[dict] = []
+        remediation: dict | None = None
+        phase = cluster.status.phase
+        version = cluster.spec.k8s_version
+        if phase != "Ready":
+            findings.append({"kind": "phase", "observed": phase,
+                             "expected": "Ready"})
+            remediation = (
+                {"action": "retry", "detail": f"cluster is {phase}; "
+                 f"`koctl cluster retry {cluster.name}` re-enters at the "
+                 f"first pending phase"}
+                if phase == "Failed" else
+                {"action": "wait", "detail": f"cluster is {phase}; an "
+                 f"operation is in flight — re-check when it settles"})
+        bad_probes = list(health_failed(cluster))
+        if bad_probes:
+            findings.append({"kind": "health", "observed": bad_probes,
+                             "expected": "healthy"})
+            if remediation is None:
+                remediation = {
+                    "action": "recover",
+                    "detail": "failed health markers: "
+                              + ", ".join(bad_probes)
+                              + " — the watchdog escalates under its "
+                                "budget; `koctl watchdog status` shows "
+                                "the circuit"}
+        if target_version and version != target_version:
+            findings.append({"kind": "version", "observed": version,
+                             "expected": target_version})
+            if remediation is None:
+                reason = hop_check(version, target_version)
+                remediation = (
+                    {"action": "manual", "detail": reason} if reason else
+                    {"action": "upgrade",
+                     "detail": f"`koctl fleet upgrade --target "
+                               f"{target_version} --selector "
+                               f"name={cluster.name}`"})
+        if findings:
+            drifted.append({"cluster": cluster.name,
+                            "findings": findings,
+                            "remediation": remediation})
+        else:
+            in_sync += 1
+    return {
+        "target_version": target_version,
+        "selector": selector,
+        "checked": checked,
+        "in_sync": in_sync,
+        "skipped": skipped,
+        "drifted": drifted,
+        "remediations": [
+            {"cluster": d["cluster"], **(d["remediation"] or {})}
+            for d in drifted
+        ],
+    }
 
 
 def plan_waves(names: list[str], wave_size: int, canary: int) -> list[dict]:

@@ -58,6 +58,12 @@ class Operation(Entity):
     # observability: the span tree's trace id ("" = op predates tracing or
     # it was disabled); the root span's id is the operation id itself
     trace_id: str = ""
+    # constant-cost history (migration 012): a compact JSON digest of the
+    # op's vars (counts, never per-cluster detail) maintained by engines
+    # that keep large resumable state in vars — mirrored into a real
+    # column so history listings and the latest-op poll never hydrate the
+    # vars blob. {} = the op carries no digest (most per-cluster ops)
+    summary: dict = field(default_factory=dict)
 
     @property
     def open(self) -> bool:

@@ -88,6 +88,12 @@ class QueueEntry(Entity):
     cancel_requested: bool = False   # operator cancel of a running entry:
     #                                  drain first, then `cancelled`
     message: str = ""
+    # priority aging (queue.aging_after_s): when the entry last promoted
+    # a class (0 = never aged; the next deadline counts from created_at),
+    # and the promotion ledger [{"from", "to", "at"}] — the audit trail
+    # the repo-ordering tests read
+    aged_at: float = 0.0
+    agings: list = field(default_factory=list)
 
     def validate(self) -> None:
         priority_of(self.priority_class)

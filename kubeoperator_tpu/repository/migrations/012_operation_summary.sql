@@ -1,0 +1,12 @@
+-- Fleet at 1000 (docs/resilience.md "Fleet operations"): `fleet status`
+-- and the 1 Hz poll must answer over a 1000-rollout history without
+-- hydrating every historical op's vars blob (a fleet op's vars carry the
+-- whole wave ledger — hundreds of cluster names each). `summary` mirrors
+-- a compact JSON digest (fleet/planner.py rollout_summary: counts +
+-- circuit state only) maintained by the wave engine at every ledger
+-- save; '' = the op predates the column or carries no digest. The
+-- (kind, created_at) index makes newest-of-kind resolution one indexed
+-- probe — the same mirrored-column trick as workload_queue (011).
+ALTER TABLE operations ADD COLUMN summary TEXT NOT NULL DEFAULT '';
+CREATE INDEX IF NOT EXISTS idx_operations_kind
+    ON operations (kind, created_at);

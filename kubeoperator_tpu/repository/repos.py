@@ -325,16 +325,69 @@ class ComponentRepo(EntityRepo[ClusterComponent]):
 class OperationRepo(EntityRepo[Operation]):
     """Operation journal rows (models/operation.py). `status` is mirrored so
     the boot reconciler's open-op sweep is one indexed query, not a
-    hydrate-everything scan."""
+    hydrate-everything scan; `summary` (migration 012) mirrors the compact
+    vars digest engines maintain, so history listings and latest-op
+    resolution never hydrate historical vars blobs."""
 
     table, entity, columns = "operations", Operation, (
-        "cluster_id", "kind", "status", "parent_op_id",
+        "cluster_id", "kind", "status", "parent_op_id", "summary",
     )
+
+    def _column_value(self, obj: Operation, column: str) -> object:
+        if column == "summary":   # dict → TEXT mirror ('' = no digest)
+            return json.dumps(obj.summary) if obj.summary else ""
+        return super()._column_value(obj, column)
 
     def children(self, parent_op_id: str) -> list[Operation]:
         """A fleet op's per-cluster child ops, in launch order (the
         indexed parent link from migration 007)."""
         return self.find(parent_op_id=parent_op_id)
+
+    def latest(self, kinds) -> Operation | None:
+        """Newest op of the given kind(s) — ONE indexed probe (the
+        (kind, created_at) index from migration 012) hydrating ONE row,
+        however long the journal history is. The id tiebreak matches the
+        (created_at, id) sort resolve_op_ref's slow path used."""
+        kinds = (kinds,) if isinstance(kinds, str) else tuple(kinds)
+        marks = ",".join("?" for _ in kinds)
+        rows = self.db.query(
+            f"SELECT data FROM {self.table} WHERE kind IN ({marks}) "
+            f"ORDER BY created_at DESC, id DESC LIMIT 1", kinds)
+        return self._hydrate(rows[0]["data"]) if rows else None
+
+    def find_id_prefix(self, kinds, prefix: str) -> list[Operation]:
+        """Ops of the given kind(s) whose id starts with `prefix`, IN SQL
+        — prefix resolution must not hydrate the whole history to match
+        one row. LIKE special characters are escaped (op ids are hex, but
+        the ref comes from the operator)."""
+        kinds = (kinds,) if isinstance(kinds, str) else tuple(kinds)
+        marks = ",".join("?" for _ in kinds)
+        escaped = (prefix.replace("\\", "\\\\").replace("%", "\\%")
+                   .replace("_", "\\_"))
+        rows = self.db.query(
+            f"SELECT data FROM {self.table} WHERE kind IN ({marks}) "
+            f"AND id LIKE ? ESCAPE '\\' ORDER BY created_at, id",
+            (*kinds, escaped + "%"))
+        return [self._hydrate(r["data"]) for r in rows]
+
+    def summaries(self, kind: str, limit: int = 1000) -> list[dict]:
+        """Newest-first history digests straight off the mirrored
+        columns — id/status/summary/timestamps, NO vars hydration. The
+        constant-cost backing of `fleet status`'s list form; rows whose
+        engine predates the summary column carry an empty digest."""
+        rows = self.db.query(
+            f"SELECT id, status, summary, created_at, updated_at "
+            f"FROM {self.table} WHERE kind=? "
+            f"ORDER BY created_at DESC, id DESC LIMIT ?",
+            (kind, max(1, min(limit, 10000))))
+        out: list[dict] = []
+        for r in rows:
+            digest = json.loads(r["summary"]) if r["summary"] else {}
+            out.append({"id": r["id"], "status": r["status"],
+                        "summary": digest,
+                        "created_at": float(r["created_at"]),
+                        "updated_at": float(r["updated_at"])})
+        return out
 
     def history(self, cluster_id: str, limit: int = 50) -> list[Operation]:
         """Newest-first journal history, capped IN SQL (the journal grows

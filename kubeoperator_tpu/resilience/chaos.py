@@ -159,6 +159,11 @@ class ChaosExecutor(Executor):
         self._scripted: dict[tuple, list] = {}
         self._counters: dict[tuple, int] = {}    # submissions seen per key
         self._scheduled: dict[tuple, dict] = {}  # key -> {abs index: kind}
+        # host-glob streams (fail_hosts / die_at_phase@glob): keyed by
+        # ("hosts", playbook, glob), counting only submissions whose
+        # inventory matches — per-cluster determinism under concurrency
+        self._host_counters: dict[tuple, int] = {}
+        self._host_scheduled: dict[tuple, dict] = {}
         self._death_submissions = 0   # submissions of the doomed playbook
         self._dead = ""               # die_now(): permanent death reason
         # slice-preemption state (preempt_slice): once any preemption is
@@ -217,20 +222,31 @@ class ChaosExecutor(Executor):
                 raise ControllerDeath(self._dead)
             if self.config.die_at_phase:
                 doomed, _, nth = self.config.die_at_phase.partition("#")
+                # optional `@glob` suffix ("20-upgrade-prepare.yml@fl-02-*"):
+                # die only when the doomed playbook's INVENTORY matches the
+                # host glob — names the exact CLUSTER a concurrent fleet
+                # wave dies on, where global `#N` counting would be racy
+                doomed, _, host_glob = doomed.partition("@")
                 if spec.playbook == doomed:
-                    self._death_submissions += 1
-                    target = int(nth) if nth.isdigit() else 1
-                    if self._death_submissions >= target:
-                        self.config.die_at_phase = ""
-                        self.injections.append(Injection(
-                            task_id="", playbook=spec.playbook,
-                            kind="controller-death",
-                        ))
-                        raise ControllerDeath(
-                            f"simulated controller death submitting "
-                            f"{spec.playbook} (submission "
-                            f"{self._death_submissions})"
-                        )
+                    import fnmatch
+
+                    matched = not host_glob or any(
+                        fnmatch.fnmatchcase(h, host_glob)
+                        for h in inventory_host_names(spec.inventory))
+                    if matched:
+                        self._death_submissions += 1
+                        target = int(nth) if nth.isdigit() else 1
+                        if self._death_submissions >= target:
+                            self.config.die_at_phase = ""
+                            self.injections.append(Injection(
+                                task_id="", playbook=spec.playbook,
+                                kind="controller-death",
+                            ))
+                            raise ControllerDeath(
+                                f"simulated controller death submitting "
+                                f"{spec.playbook} (submission "
+                                f"{self._death_submissions})"
+                            )
             # slice heal: the restore leg's runtime playbook brings the
             # preempted slice's machines back into the probe's view — the
             # moment the replacement flow re-runs it, the preemption ends
@@ -293,6 +309,57 @@ class ChaosExecutor(Executor):
             slots = self._scheduled.setdefault(key, {})
             for n in submissions:
                 slots[base + int(n)] = kind
+
+    def fail_hosts(self, playbook: str, host_glob: str, submissions,
+                   kind: str = "unreachable") -> None:
+        """Schedule faults for specific future submissions of `playbook`
+        whose INVENTORY contains a host matching `host_glob` — the
+        per-cluster precision tool for CONCURRENT fleet waves. Global
+        submission counting (`fail_at`) is order-sensitive once sibling
+        clusters submit the same playbook concurrently; host names carry
+        the cluster name ("<cluster>-master-1"), so a (playbook, glob)
+        stream counts ONE cluster's own serial submissions and no thread
+        interleaving can reassign its slots. `submissions` are 1-indexed
+        counting from now within that stream. Consumes no RNG draw."""
+        key = ("hosts", playbook, host_glob)
+        with self._ledger_lock:
+            base = self._host_counters.get(key, 0)
+            slots = self._host_scheduled.setdefault(key, {})
+            for n in submissions:
+                slots[base + int(n)] = kind
+
+    def _host_scripted_fault(self, name: str, spec: TaskSpec):
+        """The host-glob stream's verdict for one submission (call with
+        `_ledger_lock` held): every matching (playbook, glob) stream's
+        counter advances, every stream's slot scheduled at its new count
+        is consumed, and the first consumed slot (sorted key order)
+        fires — a submission carries ONE fault, so when two globs
+        schedule the same submission the sorted-first stream wins and
+        the other's slot is deliberately spent, never left dangling at a
+        count the stream has already passed. Host faults take precedence
+        over the global fail_at/fail_times queues (the more specific
+        script wins). None = no host-scripted fault."""
+        if not self._host_scheduled:
+            return None
+        import fnmatch
+
+        hosts = inventory_host_names(spec.inventory)
+        fault = None
+        for key in sorted(self._host_scheduled):
+            _marker, playbook, glob = key
+            if playbook != name:
+                continue
+            if not any(fnmatch.fnmatchcase(h, glob) for h in hosts):
+                continue
+            count = self._host_counters.get(key, 0) + 1
+            self._host_counters[key] = count
+            # consume EVERY stream's slot for this submission, fire the
+            # first — an unconsumed slot at a passed count would dangle
+            # forever (counters only grow)
+            fired = self._host_scheduled[key].pop(count, None)
+            if fault is None and fired is not None:
+                fault = fired
+        return fault
 
     def preempt_slice(self, slice_id: int, at_submission: int = 1,
                       heal_on: str = "16-tpu-runtime.yml") -> None:
@@ -414,6 +481,16 @@ class ChaosExecutor(Executor):
         with self._ledger_lock:
             count = self._counters.get(key, 0) + 1
             self._counters[key] = count
+            # host-glob streams advance for EVERY matching submission,
+            # whether or not another script fires for it — their counts
+            # must stay a pure function of the cluster's own submission
+            # order, independent of sibling scripts. A host-scripted
+            # fault WINS over the global queues: its slot was consumed
+            # above, so preferring a global fault here would silently
+            # lose it (the stream's counter never revisits a count)
+            host_fault = self._host_scripted_fault(key[0], spec)
+            if host_fault is not None:
+                return host_fault, 0.0
             scheduled = self._scheduled.get(key)
             if scheduled and count in scheduled:
                 return scheduled.pop(count), 0.0

@@ -40,6 +40,11 @@ class FleetConfig:
     canary: int = 1
     gate_health: bool = True
     auto_rollback: bool = True
+    # clusters upgrading+gating at once INSIDE a wave (adm/pool.py
+    # BoundedPool); 1 = the historical serial loop, bit-identical —
+    # max_unavailable stays a LIVE budget at any setting (trip mid-wave →
+    # new launches stop → running siblings settle → rollback)
+    max_concurrent_clusters: int = 1
 
     @classmethod
     def from_config(cls, config, section: str = "fleet") -> "FleetConfig":
@@ -54,6 +59,9 @@ class FleetConfig:
                 f"{section}.gate_health", base.gate_health)),
             auto_rollback=bool(config.get(
                 f"{section}.auto_rollback", base.auto_rollback)),
+            max_concurrent_clusters=int(config.get(
+                f"{section}.max_concurrent_clusters",
+                base.max_concurrent_clusters)),
         )
 
 

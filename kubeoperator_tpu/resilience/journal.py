@@ -82,18 +82,16 @@ def resolve_op_ref(repos, kind, op_ref: str = "",
                 return op
         except NotFoundError:
             pass
-    ops: list[Operation] = []
-    for one in kinds:
-        ops.extend(repos.operations.find(kind=one))
-    if len(kinds) > 1:
-        ops.sort(key=lambda o: (o.created_at, o.id))
+    # constant-cost at 1000 historical ops (ISSUE 13): the latest pick is
+    # one indexed probe and prefix matching happens IN SQL — neither path
+    # hydrates the history's vars blobs, however long it grows
     if not op_ref:
-        if not ops:
+        latest = repos.operations.latest(kinds)
+        if latest is None:
             raise NotFoundError(kind=label, name="(latest)")
-        return ops[-1]
-    matches = [op for op in ops if op.id == op_ref]
-    if not matches and len(op_ref) >= 6:
-        matches = [op for op in ops if op.id.startswith(op_ref)]
+        return latest
+    matches = (repos.operations.find_id_prefix(kinds, op_ref)
+               if len(op_ref) >= 6 else [])
     if len(matches) == 1:
         return matches[0]
     if len(matches) > 1:

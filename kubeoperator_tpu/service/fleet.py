@@ -28,6 +28,8 @@ from kubeoperator_tpu.fleet import (
     plan_waves,
 )
 from kubeoperator_tpu.fleet.planner import (
+    detect_drift,
+    rollout_summary,
     validate_rollout,
     validate_selector,
 )
@@ -64,7 +66,9 @@ class FleetService:
     def upgrade(self, target_version: str, selector: dict | None = None,
                 wave_size: int | None = None,
                 max_unavailable: int | None = None,
-                canary: int | None = None, wait: bool = False) -> dict:
+                canary: int | None = None,
+                max_concurrent: int | None = None,
+                wait: bool = False) -> dict:
         if target_version not in SUPPORTED_K8S_VERSIONS:
             raise ValidationError(
                 f"target {target_version!r} not in supported bundle "
@@ -73,7 +77,9 @@ class FleetService:
         max_unavailable = (self.cfg.max_unavailable
                            if max_unavailable is None else max_unavailable)
         canary = self.cfg.canary if canary is None else canary
-        validate_rollout(wave_size, max_unavailable, canary)
+        max_concurrent = (self.cfg.max_concurrent_clusters
+                          if max_concurrent is None else max_concurrent)
+        validate_rollout(wave_size, max_unavailable, canary, max_concurrent)
         selector = validate_selector(dict(selector or {}))
 
         def hop_check(current: str, target: str) -> str | None:
@@ -116,6 +122,7 @@ class FleetService:
                 "wave_size": wave_size,
                 "max_unavailable": max_unavailable,
                 "canary": canary,
+                "max_concurrent": max_concurrent,
                 "gate_health": self.cfg.gate_health,
                 "auto_rollback": self.cfg.auto_rollback,
                 "clusters": eligible,
@@ -130,12 +137,17 @@ class FleetService:
                 "current_wave": 0,
             }, message=f"rolling {len(eligible)} clusters to "
                        f"{target_version} in {len(waves)} wave(s)")
+            # first summary digest BEFORE the engine starts: the history
+            # listing answers from the mirrored column from op #1
+            op.summary = rollout_summary(op.vars)
+            self.journal.save_vars(op)
         except BaseException:
             self._release_claim()
             raise
         log.info("fleet op %s: %d clusters -> %s (%d waves, canary %d, "
-                 "max-unavailable %d)", op.id, len(eligible),
-                 target_version, len(waves), canary, max_unavailable)
+                 "max-unavailable %d, max-concurrent %d)", op.id,
+                 len(eligible), target_version, len(waves), canary,
+                 max_unavailable, max_concurrent)
         self._start(op, wait)
         return self.describe(self.repos.operations.get(op.id))
 
@@ -209,8 +221,69 @@ class FleetService:
                               label="fleet operation")
 
     def list_ops(self) -> list[dict]:
-        ops = self.repos.operations.find(kind=FLEET_UPGRADE_KIND)
-        return [self.describe(op) for op in reversed(ops)]
+        """The rollout history, newest first — CONSTANT-COST at 1000
+        historical rollouts: rows come straight off the operations
+        table's mirrored columns (id/status/summary digest, migration
+        012), no vars hydration. The digest carries counts only; `fleet
+        status <op>` hydrates exactly the one op it describes."""
+        rows = self.repos.operations.summaries(FLEET_UPGRADE_KIND)
+        out = []
+        for row in rows:
+            digest = row["summary"]
+            out.append({
+                "id": row["id"],
+                "kind": FLEET_UPGRADE_KIND,
+                "status": row["status"],
+                "created_at": row["created_at"],
+                "updated_at": row["updated_at"],
+                **digest,
+            })
+        return out
+
+    def drift(self, target_version: str = "",
+              selector: dict | None = None) -> dict:
+        """`koctl fleet drift`: READ-ONLY fleet-wide drift detection —
+        observed version/health vs the plan, with the would-be
+        remediation set as JSON (nothing queued; the auto-queue leg is a
+        future PR). The default target is the newest rollout's — one
+        indexed probe, not a history hydration."""
+        selector = validate_selector(dict(selector or {}))
+        if not target_version:
+            latest = self.repos.operations.latest(FLEET_UPGRADE_KIND)
+            if latest is None:
+                raise ValidationError(
+                    "no rollout history to infer a target from; pass "
+                    "--target explicitly")
+            target_version = str(latest.vars.get("target_version", ""))
+        if target_version and \
+                target_version not in SUPPORTED_K8S_VERSIONS:
+            raise ValidationError(
+                f"target {target_version!r} not in supported bundle "
+                f"{SUPPORTED_K8S_VERSIONS}")
+
+        def hop_check(current: str, target: str) -> str | None:
+            try:
+                self.s.upgrades.validate_hop(current, target)
+            except KoError as e:
+                return e.message
+            return None
+
+        def health_failed(cluster) -> list[str]:
+            # standing watchdog health markers on the cluster row — a
+            # READ of recorded state, never a live probe fan-out (drift
+            # over 1000 clusters must not run 5000 adhocs)
+            from kubeoperator_tpu.models.cluster import ConditionStatus
+            from kubeoperator_tpu.service.watchdog import (
+                is_health_condition,
+            )
+
+            return sorted(
+                c.name for c in cluster.status.conditions
+                if is_health_condition(c.name)
+                and c.status == ConditionStatus.FAILED.value)
+
+        return detect_drift(self.repos, selector, target_version,
+                            hop_check, health_failed)
 
     def describe(self, op: Operation) -> dict:
         v = op.vars
@@ -227,12 +300,18 @@ class FleetService:
             "wave_size": v.get("wave_size"),
             "max_unavailable": v.get("max_unavailable"),
             "canary": v.get("canary"),
+            "max_concurrent": v.get("max_concurrent", 1),
             "clusters": list(v.get("clusters", [])),
             "skipped": [list(row) for row in v.get("skipped", [])],
             "waves": [
                 {"index": w["index"], "canary": w["canary"],
                  "clusters": list(w["clusters"]),
-                 "outcome": w.get("outcome", "pending")}
+                 "outcome": w.get("outcome", "pending"),
+                 # the per-cluster frontier: who is in flight / never
+                 # launched in this wave right now (concurrent lanes)
+                 **({"frontier": w["frontier"]} if w.get("frontier")
+                    and (w["frontier"].get("running")
+                         or w["frontier"].get("pending")) else {})}
                 for w in v.get("waves", [])
             ],
             "current_wave": v.get("current_wave", 0),
@@ -305,6 +384,7 @@ class FleetService:
         for wave in op.vars.get("waves", []):
             if wave.get("outcome", "pending") == "pending":
                 wave["outcome"] = "aborted"
+        op.summary = rollout_summary(op.vars)
         self.journal.close(op, ok=False, message="aborted by operator")
         return {"id": op.id, "aborted": True}
 

@@ -50,6 +50,7 @@ from kubeoperator_tpu.utils.logging import get_logger
 from kubeoperator_tpu.workloads.queue import (
     SlicePoolView,
     SliceSlot,
+    plan_aging,
     plan_schedule,
     slices_needed,
 )
@@ -96,6 +97,7 @@ class WorkloadQueueService:
         self.cfg_chips = int(cfg.get("queue.chips_per_slice", 0))
         self.preempt = bool(cfg.get("queue.preempt", True))
         self.max_entries = max(int(cfg.get("queue.max_entries", 64)), 1)
+        self.aging_after_s = float(cfg.get("queue.aging_after_s", 0))
         # engine state, all guarded by _lock: one dispatch loop owns
         # physical execution at a time; _running_id names the entry whose
         # train is live so the scheduler can route a drain at it
@@ -250,6 +252,7 @@ class WorkloadQueueService:
         including a running train's step hook (it mutates state only;
         dispatch belongs to the engine loop)."""
         with self._lock:
+            self._apply_aging()
             pending = self.repos.workload_queue.pending()
             active = self.repos.workload_queue.active()
             view, _source = self.pool_view()
@@ -272,6 +275,32 @@ class WorkloadQueueService:
                 self._evict(victim_id, by=head)
             return {"placed": placed_ids,
                     "victims": list(decision.victims)}
+
+    def _apply_aging(self) -> None:
+        """Priority aging (under _lock, via schedule): promote starved
+        pending entries one class per elapsed `queue.aging_after_s`
+        interval (pure decisions in workloads/queue.py plan_aging). The
+        promotion is ledgered on the entry and mirrored into its journal
+        op like every other scheduler-visible state change; created_at is
+        untouched, so FIFO-within-class holds unchanged for everyone
+        else."""
+        if self.aging_after_s <= 0:
+            return
+        now = now_ts()
+        for entry, promoted in plan_aging(
+                self.repos.workload_queue.pending(), now,
+                self.aging_after_s):
+            was = entry.priority_class
+            entry.priority_class = promoted
+            entry.priority = priority_of(promoted)
+            entry.aged_at = now
+            entry.agings = list(entry.agings) + [{
+                "from": was, "to": promoted, "at": now,
+            }]
+            self.repos.workload_queue.save(entry)
+            self._sync_op(entry)
+            log.info("queue entry %s aged %s -> %s after %.0fs pending",
+                     entry.id[:8], was, promoted, now - entry.created_at)
 
     def _evict(self, victim_id: str, by) -> None:
         """Enact one eviction decision (under _lock, via schedule)."""
@@ -595,6 +624,7 @@ class WorkloadQueueService:
             "placement": list(entry.placement),
             "preemptions": list(entry.preemptions),
             "preempted_by": entry.preempted_by,
+            "agings": list(entry.agings),
             "checkpoint": entry.checkpoint,
             "run_ops": list(entry.run_ops),
             "submitted_at": entry.created_at,
@@ -629,6 +659,7 @@ class WorkloadQueueService:
             "tenant": entry.tenant,
             "kind": entry.kind,
             "priority": entry.priority_class,
+            "agings": list(entry.agings),
             "mesh": entry.mesh,
             "devices": entry.devices,
             "placement": list(entry.placement),

@@ -113,9 +113,19 @@ class ReconcileService:
             if op.kind in AUTO_RESUME_FLEET:
                 wave = op.vars.get("current_wave", 0)
                 resume = f"wave-{wave}"
+                # the concurrent engine persists a per-cluster frontier
+                # on the wave: name the lanes that were mid-upgrade
+                in_flight = []
+                for w in op.vars.get("waves", []):
+                    if w.get("index") == wave:
+                        in_flight = sorted(
+                            (w.get("frontier") or {}).get("running", []))
                 msg = (f"{cause}: fleet rollout was in flight "
-                       f"(wave {wave}); `koctl fleet resume` continues "
-                       f"without re-running completed clusters")
+                       f"(wave {wave}"
+                       + (f"; {'+'.join(in_flight)} mid-upgrade"
+                          if in_flight else "")
+                       + "); `koctl fleet resume` continues "
+                         "without re-running completed clusters")
             elif op.kind in AUTO_RESUME_QUEUE:
                 state = (op.vars.get("entry") or {}).get("state", "?")
                 ckpt = (op.vars.get("entry") or {}).get("checkpoint", "")

@@ -181,6 +181,14 @@ DEFAULTS: dict[str, Any] = {
         # child ops when the breaker opens; off = the wave is left Failed
         # for the operator
         "auto_rollback": True,
+        # clusters upgrading+gating at once INSIDE a wave (the shared
+        # adm/pool.py bounded worker pool): 1 = the historical serial
+        # loop; raising it makes wave wall-clock approach
+        # wave_size/max_concurrent while max_unavailable stays a LIVE
+        # budget (a mid-wave trip stops new launches, lets running
+        # siblings settle, then rolls back). `--max-concurrent` overrides
+        # per rollout.
+        "max_concurrent_clusters": 1,
     },
     "workloads": {
         # sharded-training tenant workload defaults (service/workload.py,
@@ -222,6 +230,13 @@ DEFAULTS: dict[str, Any] = {
         # admission bound on live (non-terminal) entries — a runaway
         # submitter gets a clean 400, not an unbounded journal
         "max_entries": 64,
+        # priority aging for starvation-sensitive pools (PR-12 residue):
+        # a pending entry promotes ONE class (scavenger→low→normal→high)
+        # each time it has waited this many seconds since submission (or
+        # its last promotion); it enters the new class at its original
+        # submission time, so FIFO-within-class is otherwise unchanged.
+        # 0 = off. Sweeps never age — the scavenger contract holds.
+        "aging_after_s": 0,
     },
     "checkpoint": {
         # durable-training checkpoints (workloads/checkpoint.py,

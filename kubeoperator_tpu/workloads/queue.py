@@ -33,7 +33,42 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 
-from kubeoperator_tpu.models.workload import priority_of
+from kubeoperator_tpu.models.workload import PRIORITY_CLASSES, priority_of
+
+# the aging ladder, lowest rank first — a starved entry promotes one
+# rung per elapsed `queue.aging_after_s` interval, capped at the top
+_CLASS_LADDER = sorted(PRIORITY_CLASSES, key=PRIORITY_CLASSES.__getitem__)
+
+
+def next_class(priority_class: str) -> str | None:
+    """The class one rung up the aging ladder (None at the top)."""
+    i = _CLASS_LADDER.index(priority_class)
+    return _CLASS_LADDER[i + 1] if i + 1 < len(_CLASS_LADDER) else None
+
+
+def plan_aging(pending, now: float, after_s: float) -> list[tuple]:
+    """Priority-aging decisions for one scheduling pass (ISSUE 13
+    satellite; `queue.aging_after_s`): [(entry, new_class)] for every
+    PENDING entry that has waited `after_s` seconds since submission (or
+    since its last promotion) — one class per deadline, never past the
+    top, and NEVER for sweeps (the scavenger contract: housekeeping runs
+    only when everything else is idle). Everything else about the order
+    is untouched: a promoted entry keeps its created_at, so it enters
+    the new class at its original submission position and
+    FIFO-within-class holds for everyone."""
+    if after_s <= 0:
+        return []
+    decisions: list[tuple] = []
+    for entry in pending:
+        if entry.kind == "sweep":
+            continue
+        promoted = next_class(entry.priority_class)
+        if promoted is None:
+            continue
+        basis = entry.aged_at or entry.created_at
+        if now - basis >= after_s:
+            decisions.append((entry, promoted))
+    return decisions
 
 
 def slices_needed(devices: int, chips_per_slice: int) -> int:

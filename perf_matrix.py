@@ -509,6 +509,37 @@ def write_artifacts(results: dict, round_no: int,
                 f"{row['dispatch_per_s']} | {row['mean_wait_s']} | "
                 f"{row['preempt_round_trip_s']} | "
                 f"{'yes' if row['ok'] else 'NO'} |")
+    # fleet wave-throughput rows (`perf_matrix.py --fleet`,
+    # docs/resilience.md "Fleet operations"): rendered from the newest
+    # round like the other single-section harnesses
+    fleet_rounds = history.get("fleet") or {}
+    if fleet_rounds:
+        f_round = str(max(int(k) for k in fleet_rounds))
+        lines += [
+            "",
+            f"## fleet (round {f_round})",
+            "",
+            "Paced serial-vs-concurrent fleet wave (`python "
+            "perf_matrix.py --fleet`): one wave of simulated v5e-16",
+            "clusters upgraded+gated serially "
+            "(`fleet.max_concurrent_clusters=1`) vs concurrently, with "
+            "per-task pacing",
+            "modelling the remote node work an upgrade waits on; "
+            "compared on the WAVE span window from the stitched trace.",
+            "",
+            "| wave | concurrency | pace (s/task) | serial wave (s) | "
+            "concurrent wave (s) | speedup | serial cl/s | "
+            "concurrent cl/s | ok |",
+            "|---|---|---|---|---|---|---|---|---|",
+        ]
+        for row in fleet_rounds[f_round].get("rows", []):
+            lines.append(
+                f"| {row['wave_size']} | {row['max_concurrent']} | "
+                f"{row['task_delay_s']} | {row['serial_wave_s']} | "
+                f"{row['concurrent_wave_s']} | {row['speedup']}x | "
+                f"{row['serial_clusters_per_s']} | "
+                f"{row['concurrent_clusters_per_s']} | "
+                f"{'yes' if row['ok'] else 'NO'} |")
     if traces:
         lines += [
             "",
@@ -792,6 +823,113 @@ def record_queue(report: dict, round_no: int | None = None) -> int:
     return _record_section("queue", report, round_no)
 
 
+# per-task pacing for the fleet wave benchmark: models the REMOTE work a
+# cluster upgrade actually waits on (SSH round-trips, apt/kubeadm runs,
+# kubelet restarts). Larger than PACED_TASK_DELAY_S because an upgrade
+# phase's tasks are long-running node operations, not the create path's
+# fine-grained steps — and because the GIL serializes the simulated
+# tasks' CPU, which at 4 ms/task would let controller CPU dominate the
+# window no wave scheduler can overlap.
+PACED_FLEET_TASK_DELAY_S = 0.05
+
+
+def run_fleet(wave_size: int = 8, max_concurrent: int = 8) -> dict:
+    """The CI face of the concurrent wave engine (ISSUE 13): a paced
+    serial-vs-concurrent fleet wave over `wave_size` simulated v5e-16
+    clusters. Two rollouts on one stack (disjoint cluster groups, same
+    paced executor): `fleet.max_concurrent_clusters=1` (the historical
+    serial loop) vs `max_concurrent`. Compared on the WAVE span window
+    from the stitched trace — planning and journal overhead can't dilute
+    the scheduler's own ratio. The definition-of-done: speedup near
+    min(wave_size, max_concurrent)."""
+    import tempfile
+    import time as _time
+
+    from kubeoperator_tpu.fleet.drill import (
+        seed_clone_fleet,
+        wave_span_seconds,
+    )
+    from kubeoperator_tpu.models import Plan, Region, Zone
+    from kubeoperator_tpu.service import build_services
+    from kubeoperator_tpu.utils.config import load_config
+    from kubeoperator_tpu.version import (
+        DEFAULT_K8S_VERSION,
+        SUPPORTED_K8S_VERSIONS,
+    )
+
+    hop = SUPPORTED_K8S_VERSIONS.index(DEFAULT_K8S_VERSION) + 1
+    if hop >= len(SUPPORTED_K8S_VERSIONS):
+        return {"ok": False, "rows": [],
+                "error": "no upgrade hop above the default version"}
+    target = SUPPORTED_K8S_VERSIONS[hop]
+    with tempfile.TemporaryDirectory(prefix="ko-fleet-perf-") as base:
+        config = load_config(path="/nonexistent", env={}, overrides={
+            "db": {"path": os.path.join(base, "fleet.db")},
+            "logging": {"level": "ERROR"},
+            "executor": {"backend": "simulation"},
+            "provisioner": {"work_dir": os.path.join(base, "tf")},
+            "cron": {"backup_enabled": False,
+                     "health_check_interval_s": 0,
+                     "event_sync_interval_s": 0},
+            "cluster": {"kubeconfig_dir": os.path.join(base, "kc")},
+        })
+        svc = build_services(config, simulate=True)
+        try:
+            region = svc.regions.create(Region(
+                name="perf-region", provider="gcp_tpu_vm",
+                vars={"project": "perf", "name": "us-central1"}))
+            zone = svc.zones.create(Zone(
+                name="perf-zone", region_id=region.id,
+                vars={"gcp_zone": "us-central1-a"}))
+            svc.plans.create(Plan(
+                name="perf-v5e-16", provider="gcp_tpu_vm",
+                region_id=region.id, zone_ids=[zone.id],
+                accelerator="tpu", tpu_type="v5e-16", worker_count=0))
+            seed_clone_fleet(svc, "perf-v5e-16",
+                             {"s": wave_size, "p": wave_size},
+                             prefix="perf", template="perf-tpl")
+            svc.executor.task_delay_s = PACED_FLEET_TASK_DELAY_S
+            t0 = _time.perf_counter()
+            op_s = svc.fleet.upgrade(
+                target, selector={"name": "perf-s-*"}, canary=0,
+                wave_size=wave_size, max_unavailable=0,
+                max_concurrent=1, wait=True)
+            serial_wall = _time.perf_counter() - t0
+            t0 = _time.perf_counter()
+            op_p = svc.fleet.upgrade(
+                target, selector={"name": "perf-p-*"}, canary=0,
+                wave_size=wave_size, max_unavailable=0,
+                max_concurrent=max_concurrent, wait=True)
+            conc_wall = _time.perf_counter() - t0
+            serial_s = wave_span_seconds(svc, op_s["id"]) or serial_wall
+            conc_s = wave_span_seconds(svc, op_p["id"]) or conc_wall
+            ok = (svc.fleet.status(op_s["id"])["status"] == "Succeeded"
+                  and svc.fleet.status(op_p["id"])["status"]
+                  == "Succeeded")
+        finally:
+            svc.close()
+    speedup = serial_s / conc_s if conc_s > 0 else 0.0
+    row = {
+        "wave_size": wave_size,
+        "max_concurrent": max_concurrent,
+        "task_delay_s": PACED_FLEET_TASK_DELAY_S,
+        "serial_wave_s": round(serial_s, 3),
+        "concurrent_wave_s": round(conc_s, 3),
+        "speedup": round(speedup, 2),
+        "serial_clusters_per_s": round(wave_size / serial_s, 2)
+        if serial_s > 0 else 0.0,
+        "concurrent_clusters_per_s": round(wave_size / conc_s, 2)
+        if conc_s > 0 else 0.0,
+        "ok": ok,
+    }
+    return {"ok": ok, "rows": [row]}
+
+
+def record_fleet(report: dict, round_no: int | None = None) -> int:
+    """`perf_matrix.py --fleet` hook."""
+    return _record_section("fleet", report, round_no)
+
+
 def record_loadtest(rows: dict, round_no: int | None = None) -> int:
     """`koctl loadtest --record-perf` hook (rows keyed by replica
     count)."""
@@ -823,7 +961,19 @@ def main(argv: list | None = None) -> int:
                              "pass (admission + dispatch + preemption "
                              "round trip over a 2x4-chip virtual pool) "
                              "and record its row under the round")
+    parser.add_argument("--fleet", action="store_true",
+                        help="run ONLY the paced serial-vs-concurrent "
+                             "fleet wave benchmark (one wave of "
+                             "simulated clusters, wave-span windows "
+                             "compared) and record its row under the "
+                             "round")
     args = parser.parse_args(argv)
+    if args.fleet:
+        report = run_fleet()
+        round_no = record_fleet(report, args.round)
+        print(json.dumps({"round": round_no, "fleet": report},
+                         indent=2))
+        return 0 if report["ok"] else 1
     if args.queue:
         report = run_queue()
         round_no = record_queue(report, args.round)

@@ -646,6 +646,417 @@ class TestFleetRollout:
             svc.close()
 
 
+# ------------------------------------------------------- concurrent waves ---
+class TestConcurrentWaves:
+    """ISSUE 13 tentpole: clusters inside a wave upgrade and gate in
+    parallel under `fleet.max_concurrent_clusters`, with max_unavailable
+    as a LIVE budget and every PR-6 contract intact."""
+
+    def test_barrier_proven_overlap_with_exact_ledger(self, tmp_path):
+        """All four wave members must be in flight AT ONCE (a
+        threading.Barrier(4) inside the upgrade seam would dead-time-out
+        under any serial engine) — and the journaled ledger afterwards is
+        exactly the serial one: sorted completed list, sorted per-wave
+        upgraded list, empty frontier."""
+        import threading
+
+        svc = stack(tmp_path, fleet={"max_concurrent_clusters": 4})
+        try:
+            names = make_fleet(svc, 4)
+            barrier = threading.Barrier(4, timeout=30)
+            orig = svc.upgrades.upgrade
+
+            def barriered(name, target, **kw):
+                barrier.wait()   # proves 4 concurrent lanes
+                return orig(name, target, **kw)
+
+            svc.upgrades.upgrade = barriered
+            op = svc.fleet.upgrade(TARGET, selector={"name": "fl-*"},
+                                   wave_size=4, canary=0,
+                                   max_unavailable=0, wait=True)
+            op = svc.fleet.status(op["id"])
+            assert op["status"] == "Succeeded"
+            assert op["completed"] == names          # canonical sorted
+            row = svc.repos.operations.get(op["id"])
+            wave = row.vars["waves"][0]
+            assert wave["outcome"] == "promoted"
+            assert wave["upgraded"] == names         # canonical sorted
+            assert wave["frontier"] == {"running": [], "pending": []}
+            # the stitched trace shows overlapping child-op lanes
+            spans = svc.repos.spans.for_trace(row.trace_id)
+            lanes = sorted(
+                (s.started_at, s.finished_at) for s in spans
+                if s.kind == "operation" and s.id != row.id)
+            assert len(lanes) == 4
+            assert any(lanes[i][1] > lanes[i + 1][0]
+                       for i in range(len(lanes) - 1))
+        finally:
+            svc.close()
+
+    def test_breaker_trips_midwave_then_siblings_settle(self, tmp_path):
+        """The LIVE budget: the first failure trips the breaker
+        (max_unavailable=0) while two slow siblings are still upgrading —
+        new launches stop (the 5th/6th clusters never run), the running
+        siblings SETTLE (their upgrades land), and only then does the
+        rollback leg undo the whole upgraded set."""
+        import threading
+        import time as _time
+
+        svc = stack(tmp_path, fleet={"max_concurrent_clusters": 4})
+        try:
+            names = make_fleet(svc, 6)
+            launched: list = []
+            release = threading.Event()
+            orig = svc.upgrades.upgrade
+
+            def scripted(name, target, **kw):
+                launched.append(name)
+                if name == names[0]:
+                    # fail fast: trips the budget while siblings run
+                    raise KoError(message="scripted upgrade failure")
+                release.wait(30)       # slow siblings, still in flight
+                _time.sleep(0.05)      # settle strictly after the trip
+                return orig(name, target, **kw)
+
+            svc.upgrades.upgrade = scripted
+
+            # release the siblings once the breaker has opened
+            def release_when_open():
+                deadline = _time.monotonic() + 30
+                while _time.monotonic() < deadline:
+                    ops = svc.repos.operations.find(kind=FLEET_UPGRADE_KIND)
+                    if ops and (ops[-1].vars.get("breaker", {})
+                                .get("state") == "open"):
+                        release.set()
+                        return
+                    _time.sleep(0.01)
+                release.set()
+
+            watcher = threading.Thread(target=release_when_open,
+                                       daemon=True)
+            watcher.start()
+            op = svc.fleet.upgrade(TARGET, selector={"name": "fl-*"},
+                                   wave_size=4, canary=0,
+                                   max_unavailable=0, wait=True)
+            watcher.join(30)
+            op = svc.fleet.status(op["id"])
+            assert op["status"] == "Failed"
+            assert op["waves"][0]["outcome"] == "rolled-back"
+            assert op["breaker"]["circuit"] == "open"
+            # the tripping cluster failed; the three slow siblings
+            # settled (their upgrades landed) and were rolled back
+            assert list(op["failed"]) == [names[0]]
+            assert op["rolled_back"] == names[1:4]
+            assert all(svc.clusters.get(n).spec.k8s_version == ORIGINAL
+                       for n in names[:4])
+            # the live budget stopped NEW launches: wave-1 never ran
+            assert sorted(launched) == names[:4]
+            assert op["waves"][1]["outcome"] == "pending"
+            assert all(svc.clusters.get(n).spec.k8s_version == ORIGINAL
+                       for n in names[4:])
+        finally:
+            svc.close()
+
+    def test_controller_death_mid_concurrent_wave_resumes_to_verdict(
+            self, tmp_path):
+        """ControllerDeath lands on ONE lane of a concurrent wave (the
+        `@host-glob` crash point): siblings settle, the fleet op is left
+        open with the dying cluster named in the persisted per-cluster
+        frontier, and a rebooted stack resumes to the recorded verdict
+        without re-running completed clusters."""
+        svc = stack(
+            tmp_path,
+            chaos={"die_at_phase": "20-upgrade-prepare.yml@fl-02-*"},
+            fleet={"max_concurrent_clusters": 4})
+        try:
+            names = make_fleet(svc, 4)
+            with pytest.raises(ControllerDeath):
+                svc.fleet.upgrade(TARGET, selector={"name": "fl-*"},
+                                  wave_size=4, canary=0,
+                                  max_unavailable=0, wait=True)
+            open_ops = svc.repos.operations.find(
+                kind=FLEET_UPGRADE_KIND,
+                status=OperationStatus.RUNNING.value)
+            assert len(open_ops) == 1
+            op_id = open_ops[0].id
+            frontier = open_ops[0].vars["waves"][0].get("frontier", {})
+            assert "fl-02" in frontier.get("running", [])
+        finally:
+            svc.close()
+
+        svc2 = stack(tmp_path)
+        try:
+            swept = {r["op"]: r for r in svc2.boot_report}
+            assert swept[op_id]["resume_phase"] == "wave-0"
+            svc2.fleet.resume(op_id, wait=True)
+            op = svc2.fleet.status(op_id)
+            assert op["status"] == "Succeeded"
+            assert op["completed"] == names
+            assert all(svc2.clusters.get(n).spec.k8s_version == TARGET
+                       for n in names)
+            per_cluster: dict = {}
+            for child in svc2.repos.operations.children(op_id):
+                per_cluster.setdefault(child.cluster_name,
+                                       []).append(child.status)
+            # the dying lane was re-run; completed siblings were not
+            assert sorted(per_cluster["fl-02"]) == [
+                "Interrupted", "Succeeded"]
+            assert all(per_cluster[n] == ["Succeeded"]
+                       for n in names if n != "fl-02"), per_cluster
+        finally:
+            svc2.close()
+
+    def test_pause_after_full_dispatch_does_not_park_a_finished_wave(
+            self, tmp_path):
+        """Serial parity: pause/abort gate LAUNCHES only. A pause that
+        lands after the wave's last cluster already launched must let
+        the in-flight clusters settle and the wave promote — never park
+        a rollout with nothing left to run in its wave."""
+        import threading
+
+        svc = stack(tmp_path, fleet={"max_concurrent_clusters": 2})
+        try:
+            names = make_fleet(svc, 2)
+            both_launched = threading.Barrier(3, timeout=30)
+            release = threading.Event()
+            orig = svc.upgrades.upgrade
+
+            def gated(name, target, **kw):
+                both_launched.wait()
+                release.wait(30)
+                return orig(name, target, **kw)
+
+            svc.upgrades.upgrade = gated
+            op = svc.fleet.upgrade(TARGET, selector={"name": "fl-*"},
+                                   wave_size=2, canary=0,
+                                   max_unavailable=0, wait=False)
+            both_launched.wait()        # todo is empty from here on
+            svc.fleet.pause(op["id"])
+            release.set()
+            svc.fleet.wait_all()
+            row = svc.repos.operations.get(op["id"])
+            assert row.status == OperationStatus.SUCCEEDED.value
+            assert row.vars["waves"][0]["outcome"] == "promoted"
+            assert row.vars["completed"] == names
+        finally:
+            svc.close()
+
+    def test_serial_default_is_unchanged(self, tmp_path):
+        """`fleet.max_concurrent_clusters` defaults to 1: the pool
+        degenerates to the historical serial loop — launch order is
+        strictly sorted and no two upgrades ever overlap."""
+        import threading
+
+        svc = stack(tmp_path)
+        try:
+            names = make_fleet(svc, 3)
+            in_flight = []
+            overlap = []
+            lock = threading.Lock()
+            orig = svc.upgrades.upgrade
+
+            def tracked(name, target, **kw):
+                with lock:
+                    in_flight.append(name)
+                    if len(in_flight) > 1:
+                        overlap.append(list(in_flight))
+                try:
+                    return orig(name, target, **kw)
+                finally:
+                    with lock:
+                        in_flight.remove(name)
+
+            svc.upgrades.upgrade = tracked
+            op = svc.fleet.upgrade(TARGET, selector={"name": "fl-*"},
+                                   wave_size=3, canary=0,
+                                   max_unavailable=0, wait=True)
+            assert svc.fleet.status(op["id"])["status"] == "Succeeded"
+            assert overlap == []
+        finally:
+            svc.close()
+
+    def test_max_concurrent_validation(self, tmp_path):
+        svc = stack(tmp_path)
+        try:
+            make_fleet(svc, 1)
+            with pytest.raises(ValidationError, match="max-concurrent"):
+                svc.fleet.upgrade(TARGET, selector={"name": "fl-*"},
+                                  max_concurrent=0)
+        finally:
+            svc.close()
+
+
+# ------------------------------------------------- constant-cost history ----
+class TestConstantCostHistory:
+    def _seed_history(self, svc, n=1000):
+        """n historical fleet ops with FAT vars blobs (the shape a real
+        1000-cluster rollout's ledger has) + mirrored summary digests."""
+        from kubeoperator_tpu.fleet.planner import rollout_summary
+        from kubeoperator_tpu.models import Operation
+
+        fat_vars = {
+            "target_version": TARGET,
+            "clusters": [f"cl-{i:04d}" for i in range(200)],
+            "completed": [f"cl-{i:04d}" for i in range(200)],
+            "failed": {}, "rolled_back": [],
+            "waves": [{"index": w, "canary": False, "outcome": "promoted",
+                       "clusters": [f"cl-{(w * 8 + j):04d}"
+                                    for j in range(8)]}
+                      for w in range(25)],
+            "breaker": json.loads(json.dumps(fleet_breaker(1).state)),
+            "current_wave": 24, "max_concurrent": 8,
+        }
+        for i in range(n):
+            op = Operation(cluster_id="", cluster_name="(fleet)",
+                           kind=FLEET_UPGRADE_KIND, status="Succeeded",
+                           vars=fat_vars)
+            op.id = f"hist-{i:06d}"
+            op.created_at = float(i)
+            op.summary = rollout_summary(fat_vars)
+            svc.repos.operations.save(op)
+
+    def test_fleet_status_over_1000_rollouts_hydrates_no_history(
+            self, tmp_path):
+        """The acceptance bound: `fleet status` (list form), the no-ref
+        resolve, and the single-op status over a 1000-rollout history
+        must hydrate AT MOST the one op they describe — never the
+        history's vars blobs."""
+        from kubeoperator_tpu.repository.repos import OperationRepo
+
+        svc = stack(tmp_path)
+        try:
+            self._seed_history(svc, 1000)
+            hydrated = []
+            orig = OperationRepo._hydrate
+
+            def counting(self_repo, blob):
+                hydrated.append(1)
+                return orig(self_repo, blob)
+
+            OperationRepo._hydrate = counting
+            try:
+                rows = svc.fleet.list_ops()
+                assert len(rows) == 1000
+                assert rows[0]["id"] == "hist-000999"   # newest first
+                assert rows[0]["completed"] == 200      # digest, not vars
+                assert len(hydrated) == 0               # NO hydration
+                latest = svc.fleet.resolve("")
+                assert latest.id == "hist-000999"
+                status = svc.fleet.status("")
+                assert status["target_version"] == TARGET
+                # resolve + status each hydrate exactly the one row
+                assert len(hydrated) <= 3, len(hydrated)
+                # prefix resolution is IN SQL too
+                hydrated.clear()
+                assert svc.fleet.resolve("hist-000421").id == "hist-000421"
+                assert len(hydrated) <= 1
+            finally:
+                OperationRepo._hydrate = orig
+        finally:
+            svc.close()
+
+    def test_summary_digest_rides_every_engine_save(self, tmp_path):
+        """A real rollout maintains the mirrored digest: after the run
+        the summaries() row says what describe() says, without touching
+        vars."""
+        svc = stack(tmp_path)
+        try:
+            make_fleet(svc, 2)
+            op = svc.fleet.upgrade(TARGET, selector={"name": "fl-*"},
+                                   wave_size=1, canary=0,
+                                   max_unavailable=0, wait=True)
+            row = svc.repos.operations.summaries(FLEET_UPGRADE_KIND)[0]
+            assert row["id"] == op["id"]
+            assert row["status"] == "Succeeded"
+            assert row["summary"]["completed"] == 2
+            assert row["summary"]["clusters"] == 2
+            assert row["summary"]["wave_outcomes"] == {"promoted": 2}
+            assert row["summary"]["circuit"] == "closed"
+        finally:
+            svc.close()
+
+
+# --------------------------------------------------------------- drift ------
+class TestFleetDrift:
+    def test_drift_detects_version_phase_and_health(self, tmp_path):
+        svc = stack(tmp_path)
+        try:
+            names = make_fleet(svc, 3)
+            # names[0]: in sync (upgrade it for real)
+            svc.upgrades.upgrade(names[0], TARGET)
+            # names[1]: version drift, Ready -> upgrade remediation
+            # names[2]: version drift AND phase drift (Failed)
+            broken = svc.clusters.get(names[2])
+            broken.status.phase = "Failed"
+            svc.repos.clusters.save(broken)
+            ops_before = len(svc.repos.operations.list())
+
+            report = svc.fleet.drift(target_version=TARGET)
+            assert report["target_version"] == TARGET
+            assert report["checked"] == 3
+            assert report["in_sync"] == 1
+            drifted = {d["cluster"]: d for d in report["drifted"]}
+            assert set(drifted) == {names[1], names[2]}
+            kinds1 = [f["kind"] for f in drifted[names[1]]["findings"]]
+            assert kinds1 == ["version"]
+            assert drifted[names[1]]["remediation"]["action"] == "upgrade"
+            kinds2 = [f["kind"] for f in drifted[names[2]]["findings"]]
+            assert set(kinds2) == {"phase", "version"}
+            assert drifted[names[2]]["remediation"]["action"] == "retry"
+            # the remediation set rides flat, one row per drifted cluster
+            assert [r["cluster"] for r in report["remediations"]] == \
+                sorted(drifted)
+            # READ-ONLY: nothing was journaled or queued
+            assert len(svc.repos.operations.list()) == ops_before
+        finally:
+            svc.close()
+
+    def test_drift_health_marker_and_default_target(self, tmp_path):
+        from kubeoperator_tpu.models.cluster import (
+            ClusterStatusCondition,
+            ConditionStatus,
+        )
+
+        svc = stack(tmp_path)
+        try:
+            names = make_fleet(svc, 1)
+            # a standing watchdog health marker = health drift
+            sick = svc.clusters.get(names[0])
+            sick.status.conditions.append(ClusterStatusCondition(
+                name="health/slice-1",
+                status=ConditionStatus.FAILED.value,
+                order_index=99))
+            svc.repos.clusters.save(sick)
+            # no rollout history and no --target: a clear refusal
+            with pytest.raises(ValidationError, match="no rollout history"):
+                svc.fleet.drift()
+            # with history, the newest rollout's target is the default
+            svc.fleet.upgrade(TARGET, selector={"name": "fl-*"},
+                              wave_size=1, canary=0, max_unavailable=1,
+                              wait=True)
+            report = svc.fleet.drift()
+            assert report["target_version"] == TARGET
+            drifted = {d["cluster"]: d for d in report["drifted"]}
+            assert names[0] in drifted
+            finding_kinds = {f["kind"]
+                             for f in drifted[names[0]]["findings"]}
+            assert "health" in finding_kinds
+            rem = drifted[names[0]]["remediation"]
+            assert rem["action"] in ("recover", "upgrade")
+        finally:
+            svc.close()
+
+    def test_drift_selector_is_validated(self, tmp_path):
+        svc = stack(tmp_path)
+        try:
+            make_fleet(svc, 1)
+            with pytest.raises(ValidationError, match="nme"):
+                svc.fleet.drift(target_version=TARGET,
+                                selector={"nme": "fl-*"})
+        finally:
+            svc.close()
+
+
 def _walk_ops(node):
     """Child-operation nodes of a stitched fleet tree."""
     out = []
@@ -699,6 +1110,15 @@ class TestFleetApi:
         # /metrics exposes the wave-outcome family
         resp = session.get(f"{base}/metrics")
         assert 'ko_tpu_fleet_waves{outcome="promoted"}' in resp.text
+        # the read-only drift verb: everything upgraded above, so the
+        # fleet is in sync vs the rollout's own target (query-param
+        # selector + inferred target both exercise drift_kwargs)
+        resp = session.get(f"{base}/api/v1/fleet/drift?name=api-*")
+        assert resp.status_code == 200
+        report = resp.json()
+        assert report["target_version"] == TARGET
+        assert report["checked"] == 2 and report["in_sync"] == 2
+        assert report["drifted"] == []
     # (the `client` fixture's stack runs the simulation executor, so the
     # rollout above is a REAL two-cluster upgrade over the REST surface)
 
@@ -745,6 +1165,24 @@ class TestKoctlSurface:
             with pytest.raises(SystemExit, match="must be an integer"):
                 client.call("POST", "/api/v1/fleet/upgrade", {
                     "target": TARGET, "wave_size": 2.9})
+
+            # `koctl fleet drift`: in sync after the rollout (exit 0),
+            # drifted (exit 1) once a cluster falls behind
+            args = koctl.build_parser().parse_args(
+                ["--local", "fleet", "drift", "--json"])
+            assert koctl.cmd_fleet(client, args) == 0
+            report = json.loads(capsys.readouterr().out)
+            assert report["in_sync"] == 2 and report["drifted"] == []
+            stale = svc.clusters.get("cli-00")
+            stale.spec.k8s_version = "v1.29.10"
+            svc.repos.clusters.save(stale)
+            args = koctl.build_parser().parse_args(
+                ["--local", "fleet", "drift",
+                 "--selector", "name=cli-*"])
+            assert koctl.cmd_fleet(client, args) == 1
+            out = capsys.readouterr().out
+            assert "1 drifted" in out and "cli-00" in out \
+                and "upgrade" in out
         finally:
             svc.close()
 
@@ -808,3 +1246,27 @@ def test_fleet_soak_is_seed_stable(capsys):
     assert shape(first) == shape(second)
     assert first["injection_summary"] == second["injection_summary"]
     assert first["injection_summary"]["total"] >= 3   # faults actually fired
+
+
+@pytest.mark.slow
+def test_fleet_soak_scales_to_200_deterministically(capsys):
+    """The ISSUE 13 acceptance bound: `chaos-soak --fleet --clusters 200
+    --verify-determinism` — a ≥200-cluster CONCURRENT soak (deaths,
+    canary block, live-budget mid-wave rollback, ControllerDeath resume)
+    whose canonical reports match bit-for-bit across two passes, under a
+    slow-test time budget (measured ~8s on the round-12 machine; the
+    ceiling absorbs a badly loaded CI host)."""
+    import time as _time
+
+    from kubeoperator_tpu.cli.koctl import main
+
+    t0 = _time.monotonic()
+    rc = main(["chaos-soak", "--fleet", "--clusters", "200",
+               "--verify-determinism", "--format", "json"])
+    elapsed = _time.monotonic() - t0
+    report = json.loads(capsys.readouterr().out)
+    assert rc == 0, report
+    assert report["ok"] is True
+    assert report["deterministic"] is True
+    assert report["clusters"] >= 200
+    assert elapsed < 300.0, f"scaled soak took {elapsed:.1f}s"

@@ -709,3 +709,120 @@ class TestPeriodicCheckpoints:
                     == reference["losses"])
         finally:
             svc.close()
+
+
+# ----------------------------------------------------------- priority aging -
+class TestPriorityAging:
+    """`queue.aging_after_s` (ISSUE 13 satellite): starved pending
+    entries promote one class per elapsed deadline; everything else
+    about the dispatch order — FIFO within a class on created_at — is
+    untouched."""
+
+    def _pending(self, eid, cls, created, kind="train", aged_at=0.0):
+        e = QueueEntry(op_id=f"op-{eid}", kind=kind, priority_class=cls,
+                       priority=priority_of(cls))
+        e.id = eid
+        e.created_at = created
+        e.aged_at = aged_at
+        return e
+
+    def test_plan_aging_promotes_one_class_after_deadline(self):
+        from kubeoperator_tpu.workloads.queue import plan_aging
+
+        starved = self._pending("s", "low", created=0.0)
+        fresh = self._pending("f", "low", created=95.0)
+        decisions = plan_aging([starved, fresh], now=100.0, after_s=60.0)
+        assert [(e.id, cls) for e, cls in decisions] == [("s", "normal")]
+        # a second deadline counts from the LAST promotion, not creation
+        starved.priority_class = "normal"
+        starved.aged_at = 100.0
+        assert plan_aging([starved], now=120.0, after_s=60.0) == []
+        assert [(e.id, cls) for e, cls in plan_aging(
+            [starved], now=161.0, after_s=60.0)] == [("s", "high")]
+
+    def test_plan_aging_never_ages_sweeps_or_past_the_top(self):
+        from kubeoperator_tpu.workloads.queue import plan_aging
+
+        sweep = self._pending("sw", "scavenger", 0.0, kind="sweep")
+        top = self._pending("t", "high", 0.0)
+        assert plan_aging([sweep, top], now=1e6, after_s=1.0) == []
+
+    def test_plan_aging_disabled_by_default(self):
+        from kubeoperator_tpu.workloads.queue import plan_aging
+
+        starved = self._pending("s", "low", created=0.0)
+        assert plan_aging([starved], now=1e6, after_s=0) == []
+
+    def test_repo_order_fifo_within_class_unchanged_by_aging(self, tmp_db):
+        """The repo-ordering contract under aging: a promoted entry
+        keeps its created_at, so it enters the new class at its original
+        submission position — and entries aging never touched keep the
+        exact pre-aging order."""
+        from kubeoperator_tpu.repository import Database, Repositories
+
+        repos = Repositories(Database(tmp_db))
+        # two normals (FIFO between them), one starved low OLDER than
+        # both, one fresh low
+        for eid, cls, created in (
+                ("n1", "normal", 10.0), ("n2", "normal", 20.0),
+                ("starved", "low", 1.0), ("fresh-low", "low", 25.0)):
+            repos.workload_queue.save(self._pending(eid, cls, created))
+        assert [e.id for e in repos.workload_queue.pending()] == \
+            ["n1", "n2", "starved", "fresh-low"]
+        # promote the starved low exactly as the service does
+        from kubeoperator_tpu.workloads.queue import plan_aging
+
+        for entry, cls in plan_aging(repos.workload_queue.pending(),
+                                     now=100.0, after_s=60.0):
+            if entry.id != "starved":
+                continue
+            entry.priority_class = cls
+            entry.priority = priority_of(cls)
+            entry.aged_at = 100.0
+            repos.workload_queue.save(entry)
+        # the promoted entry sorts INTO the normal class at its original
+        # submission time (oldest first); n1/n2 FIFO untouched, the
+        # fresh low untouched at the back
+        assert [e.id for e in repos.workload_queue.pending()] == \
+            ["starved", "n1", "n2", "fresh-low"]
+        repos.db.close()
+
+    def test_service_applies_aging_and_ledgers_it(self, tmp_path):
+        """End-to-end: a pending entry older than the knob promotes on
+        the next scheduling pass, the promotion is ledgered on the entry
+        AND mirrored into its journal op, and the mirrored priority
+        column moves with it."""
+        svc = queue_stack(tmp_path, queue={"aging_after_s": 30.0})
+        try:
+            # hold the engine and fill the whole 2-slice pool first, so
+            # the low-priority submission stays PENDING (aging only
+            # touches pending entries)
+            with svc.workload_queue._lock:
+                svc.workload_queue._engine_active = True
+            svc.workload_queue.submit(
+                mesh="data=2,fsdp=4", steps=2, tenant="blocker",
+                priority="normal", wait=True)
+            entry = svc.workload_queue.submit(
+                mesh="data=1,fsdp=4", steps=2, tenant="aged",
+                priority="low", wait=True)
+            row = svc.repos.workload_queue.get(entry["id"])
+            assert row.state == "pending"
+            # backdate the submission past the aging deadline
+            row.created_at -= 60.0
+            svc.repos.workload_queue.save(row)
+            svc.workload_queue.schedule()
+            row = svc.repos.workload_queue.get(entry["id"])
+            assert row.priority_class == "normal"
+            assert row.priority == priority_of("normal")
+            assert row.agings and row.agings[0]["from"] == "low" \
+                and row.agings[0]["to"] == "normal"
+            op = svc.repos.operations.get(row.op_id)
+            assert op.vars["entry"]["priority"] == "normal"
+            assert op.vars["entry"]["agings"] == row.agings
+            # release the engine: the aged entry still dispatches to done
+            with svc.workload_queue._lock:
+                svc.workload_queue._engine_active = False
+            svc.workload_queue.process(wait=True)
+            assert svc.workload_queue.status(entry["id"])["state"] == "done"
+        finally:
+            svc.close()
