@@ -392,6 +392,50 @@ def check_checkpoint_atomic_writes(root: str, tree: ast.AST,
     return findings
 
 
+# ---------------------------------------------------------------- KO-P012 ---
+# the one sanctioned event writer: observability/events.py emit_event()
+# (the journal's fenced paths and every service route through it)
+_P012_ALLOWED_FILES = frozenset({
+    os.path.join("observability", "events.py"),
+})
+
+
+def check_event_discipline(root: str, tree: ast.AST, path: str) -> list:
+    """Bus-event emission (`<anything>.events.save(...)` /
+    `.events.save_many(...)`) happens only inside observability/events.py
+    — everywhere else a state-transition writer must route through
+    `emit_event` / the journal's event verbs, which is what guarantees
+    (a) every event commits in the same transaction as the state change
+    it describes and (b) a fenced-out writer cannot narrate state it no
+    longer owns. An ad-hoc EventRepo save would silently break both."""
+    relpath = os.path.relpath(path, root)
+    if relpath in _P012_ALLOWED_FILES:
+        return []
+    findings: list = []
+    rel = _rel(root, path)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if not isinstance(func, ast.Attribute) or \
+                func.attr not in ("save", "save_many"):
+            continue
+        target = func.value
+        is_events = (
+            (isinstance(target, ast.Attribute) and target.attr == "events")
+            or (isinstance(target, ast.Name) and target.id == "events")
+        )
+        if is_events:
+            findings.append(Finding(
+                "KO-P012", rel, node.lineno,
+                "ad-hoc event write outside the emission funnel — route "
+                "through observability.events.emit_event (or the "
+                "journal's event verbs) so the row commits in the same "
+                "transaction as the state change it describes",
+            ))
+    return findings
+
+
 AST_RULES = {
     "KO-P001": check_repo_layering,
     "KO-P002": check_blocking_handlers,
@@ -400,6 +444,7 @@ AST_RULES = {
     "KO-P006": check_subprocess_timeouts,
     "KO-P007": check_phase_write_discipline,
     "KO-P011": check_checkpoint_atomic_writes,
+    "KO-P012": check_event_discipline,
 }
 
 

@@ -139,6 +139,15 @@ RULES: dict[str, RuleSpec] = {
             "write re-opens the torn-checkpoint crash window",
         ),
         RuleSpec(
+            "KO-P012", "event-discipline", "ast", ERROR,
+            "bus-event writes (`.events.save`/`.events.save_many`) "
+            "happen only inside observability/events.py — every "
+            "state-transition writer routes through emit_event / the "
+            "journal's event verbs, so each event commits in the same "
+            "transaction as the state change it describes and a "
+            "fenced-out writer cannot narrate state it no longer owns",
+        ),
+        RuleSpec(
             "KO-P007", "phase-write-discipline", "ast", ERROR,
             "in-flight ClusterPhaseStatus assignments (Provisioning/"
             "Deploying/Scaling/Upgrading/Terminating) happen only in adm/ "

@@ -230,6 +230,39 @@ class MetricsRegistry:
                 [(cls, wait, "") for cls, wait
                  in queue_repo.wait_rows()])
 
+        # live telemetry (docs/observability.md "Events and live
+        # telemetry"): the event bus by kind, per-step training wall-
+        # clock by tenant, and each op's latest loss — all off mirrored
+        # columns. getattr-guarded like the queue rows for hand-built
+        # exposition stubs; sample cardinality is bounded by op
+        # retention (samples prune with their op's spans).
+        events_repo = getattr(services.repos, "events", None)
+        if events_repo is not None and hasattr(events_repo,
+                                               "counts_by_kind"):
+            family("ko_tpu_events_total", "counter",
+                   "Durable event-bus rows by kind (retention-bounded: "
+                   "rate() absorbs prune resets like process restarts).",
+                   [_fmt("ko_tpu_events_total",
+                         {"kind": k or "legacy"}, n)
+                    for k, n in sorted(
+                        events_repo.counts_by_kind().items())])
+        samples_repo = getattr(services.repos, "metric_samples", None)
+        if samples_repo is not None:
+            histogram(
+                "ko_tpu_workload_step_seconds",
+                "Per-step training wall-clock from persisted metric "
+                "samples, by tenant ('' = untenanted runs).",
+                "tenant",
+                [(tenant, step_s, "") for tenant, step_s
+                 in samples_repo.step_rows()])
+            family("ko_tpu_workload_loss", "gauge",
+                   "Latest per-op training loss from the metric-sample "
+                   "ring (one series per retained workload op).",
+                   [_fmt("ko_tpu_workload_loss",
+                         {"op": op_id[:8], "tenant": tenant}, loss)
+                    for op_id, tenant, _step, loss
+                    in samples_repo.latest_losses()])
+
         try:
             watchdog_rows = services.watchdog.status()
         except Exception:

@@ -1031,17 +1031,161 @@ class Handlers:
         }
         return [c for c in clusters if c.project_id in member_of]
 
+    def _event_stream_params(self, request) -> dict:
+        """Parse + authorize the event-STREAM form of /api/v1/events
+        (follow / kind / tenant / cluster / after). Platform-wide
+        streaming is admin-only (bus events cross project boundaries); a
+        non-admin may follow ONE cluster they can see. Returns the
+        `EventRepo.since` kwargs plus the starting cursor."""
+        from kubeoperator_tpu.utils.errors import (
+            ForbiddenError,
+            ValidationError,
+        )
+
+        query = request.query
+        cluster_id: str | None = None
+        if query.get("cluster"):
+            cluster = self.s.clusters.get(query["cluster"])
+            cluster_id = cluster.id
+        user = request.get("user")
+        if user is not None and not user.is_admin:
+            if cluster_id is None:
+                raise ForbiddenError(
+                    action="streaming platform-wide events (pass "
+                           "?cluster= or ask an admin)")
+            member_of = {c.id for c in self._visible_clusters(user)}
+            if cluster_id not in member_of:
+                raise ForbiddenError(action="streaming another "
+                                            "project's events")
+        # `Last-Event-ID` (the SSE reconnect contract) wins over the
+        # `after` query param — a dropped console resumes exactly where
+        # its last received frame's id left off
+        raw = request.headers.get("Last-Event-ID",
+                                  query.get("after", "0")) or "0"
+        try:
+            after = int(raw)
+        except ValueError:
+            raise ValidationError("event cursor must be an integer rowid")
+        return {
+            "after": max(after, 0),
+            "kind": str(query.get("kind", "") or ""),
+            "tenant": str(query.get("tenant", "") or ""),
+            "cluster_id": cluster_id,
+        }
+
+    @staticmethod
+    def _event_row(rowid: int, event) -> dict:
+        row = event.to_public_dict()
+        row["stream_id"] = rowid
+        return row
+
+    # SSE posture shared by every follow stream: poll cadence, the idle
+    # window after which the stream honestly ends, and the keep-alive
+    # comment cadence that proves liveness through buffering proxies
+    _SSE_POLL_S = 0.25
+    _SSE_IDLE_END_S = 30.0
+    _SSE_KEEPALIVE_S = 5.0
+
+    async def _sse_follow(self, request, fetch, *, event_name=None,
+                          end_payload=None, live=None):
+        """Generic SSE pump: `fetch()` (run off-loop) returns a list of
+        (rowid, json-serializable row [, name]) frames; each frame is
+        written as `id:`/`event:`/`data:` lines, idle gaps emit
+        keep-alive comments, and the stream closes with `event: end`
+        after the idle window (or the moment `live()` turns false —
+        e.g. a watched op reaching a terminal state)."""
+        resp = web.StreamResponse(headers={
+            "Content-Type": "text/event-stream",
+            "Cache-Control": "no-cache",
+        })
+        await resp.prepare(request)
+        self.metrics.sse_started()
+        try:
+            idle = 0.0
+            since_keepalive = 0.0
+            while idle < self._SSE_IDLE_END_S:
+                frames = await run_sync(request, fetch)
+                if frames:
+                    idle = 0.0
+                    since_keepalive = 0.0
+                    for rowid, row, *name in frames:
+                        kind = (name[0] if name else event_name) or ""
+                        chunk = f"id: {rowid}\n"
+                        if kind:
+                            chunk += f"event: {kind}\n"
+                        chunk += f"data: {json.dumps(row, default=str)}\n\n"
+                        await resp.write(chunk.encode())
+                else:
+                    if live is not None:
+                        if not await run_sync(request, live):
+                            break
+                        # a LIVE op holds its stream open however long
+                        # a compile/step goes quiet — the idle end is
+                        # for streams with no liveness signal (events),
+                        # never a watch on running work
+                        idle = 0.0
+                    else:
+                        idle += self._SSE_POLL_S
+                    since_keepalive += self._SSE_POLL_S
+                    if since_keepalive >= self._SSE_KEEPALIVE_S:
+                        since_keepalive = 0.0
+                        await resp.write(b": keep-alive\n\n")
+                    await asyncio.sleep(self._SSE_POLL_S)
+            if callable(end_payload):
+                # resolved at END time, so the payload reports the final
+                # status/cursor, not the stream-open snapshot
+                end_payload = await run_sync(request, end_payload)
+            await resp.write(
+                b"event: end\ndata: "
+                + json.dumps(end_payload or {}, default=str).encode()
+                + b"\n\n")
+        finally:
+            self.metrics.sse_finished()
+        return resp
+
     async def all_events(self, request):
-        """Cross-cluster activity feed scoped to the caller's visibility
-        (same membership filter as the cluster list). One call replaces the
-        console's per-cluster fan-out; `total` rides along so the client
-        can SAY when the feed is truncated instead of presenting a capped
-        sample as the whole fleet."""
+        """The platform event surface, two forms:
+
+        * the legacy cross-cluster activity FEED (no stream params):
+          newest-first rows scoped to the caller's visibility, `total`
+          riding along so a truncated feed says so — unchanged for the
+          console.
+        * the event STREAM (`?follow=1`, or any of kind/tenant/cluster/
+          after): bus rows in rowid order with `Last-Event-ID` resume —
+          a dropped console replays nothing and misses nothing, because
+          the cursor is the sqlite rowid every row carries as its SSE
+          `id:` line (docs/observability.md "Events and live
+          telemetry")."""
         from kubeoperator_tpu.utils.errors import ValidationError
+
+        query = request.query
+        streaming = (query.get("follow") == "1"
+                     or any(query.get(k) for k in
+                            ("kind", "tenant", "cluster", "after")))
+        if streaming:
+            params = await run_sync(request, self._event_stream_params,
+                                    request)
+            cursor = {"after": params.pop("after")}
+
+            def fetch():
+                rows, cursor["after"] = self.s.repos.events.since(
+                    cursor["after"], **params)
+                return [(rowid, self._event_row(rowid, e), e.kind or
+                         "event") for rowid, e in rows]
+
+            if query.get("follow") == "1":
+                return await self._sse_follow(
+                    request, fetch,
+                    end_payload=lambda: {"cursor": cursor["after"]})
+            rows = await run_sync(request, fetch)
+            return json_response({
+                "events": [row for _id, row, _kind in rows],
+                "cursor": cursor["after"],
+            })
 
         user = request["user"]
         try:
-            limit = int(request.query.get("limit", "500") or 500)
+            limit = int(query.get("limit", "500") or 500)
         except ValueError:
             raise ValidationError("limit must be an integer")
         limit = max(1, min(limit, 2000))
@@ -1059,6 +1203,48 @@ class Handlers:
             return {"events": rows, "total": total}
 
         return json_response(await run_sync(request, gather))
+
+    async def workload_metrics(self, request):
+        """Per-step training telemetry for one workload op: the JSON
+        tail past `?after=<rowid>`, or — with `?follow=1` — an SSE
+        stream of samples that ends (event: end, carrying the op's
+        terminal status) once the run closes. The live console behind
+        `koctl workload watch`."""
+        from kubeoperator_tpu.utils.errors import ValidationError
+
+        op_ref = request.match_info["op"]
+        raw = request.headers.get("Last-Event-ID",
+                                  request.query.get("after", "0")) or "0"
+        try:
+            after = max(int(raw), 0)
+        except ValueError:
+            raise ValidationError("metrics cursor must be an integer "
+                                  "rowid")
+        if request.query.get("follow") != "1":
+            return json_response(await run_sync(
+                request, self.s.workloads.metrics, op_ref, after))
+        op = await run_sync(request, self.s.workloads.resolve, op_ref)
+        cursor = {"after": after}
+
+        def fetch():
+            rows, cursor["after"] = self.s.repos.metric_samples.since(
+                op.id, cursor["after"])
+            return [(rowid, {
+                "step": s.step, "kind": s.kind, "loss": s.loss,
+                "step_s": s.step_s, "steps_per_s": s.steps_per_s,
+                "tflops": s.tflops, "mfu_pct": s.mfu_pct,
+                "attrs": dict(s.attrs), "ts": s.created_at,
+            }, "sample") for rowid, s in rows]
+
+        def live():
+            return self.s.repos.operations.get(op.id).open
+
+        def end_payload():
+            return {"status": self.s.repos.operations.get(op.id).status,
+                    "cursor": cursor["after"]}
+
+        return await self._sse_follow(request, fetch, live=live,
+                                      end_payload=end_payload)
 
     async def cluster_trace(self, request):
         """Create-to-Ready wall-clock summary (SURVEY.md §5.1: the
@@ -1298,6 +1484,8 @@ def create_app(services: Services) -> web.Application:
               admin_guard(h.workload_operation))
     r.add_get("/api/v1/workloads/operations/{op}/trace",
               admin_guard(h.workload_trace))
+    r.add_get("/api/v1/workloads/operations/{op}/metrics",
+              admin_guard(h.workload_metrics))
     r.add_get("/api/v1/fleet/operations/{op}/trace",
               admin_guard(h.fleet_trace))
     r.add_get("/api/v1/clusters/{name}/components",

@@ -278,6 +278,39 @@ class LocalClient:
                 return s.workloads.status(op_id)
             case ("GET", ["workloads", "operations", op_id, "trace"]):
                 return s.workloads.trace(op_id)
+            case ("GET", ["workloads", "operations", op_id, "metrics"]):
+                return s.workloads.metrics(
+                    op_id, int(body.get("after", 0) or 0))
+            case ("GET", ["events"]):
+                # the event stream's local face (the REST form adds SSE
+                # on top of the same read): stream params select the
+                # rowid-cursor read, no params the legacy activity feed
+                stream_keys = ("kind", "tenant", "cluster", "after",
+                               "follow")
+                if any(body.get(k) for k in stream_keys):
+                    cluster_id = (s.clusters.get(body["cluster"]).id
+                                  if body.get("cluster") else None)
+                    rows, cursor = s.repos.events.since(
+                        int(body.get("after", 0) or 0),
+                        kind=str(body.get("kind", "") or ""),
+                        cluster_id=cluster_id,
+                        tenant=str(body.get("tenant", "") or ""))
+                    return {
+                        "events": [
+                            {**e.to_public_dict(), "stream_id": rowid}
+                            for rowid, e in rows],
+                        "cursor": cursor,
+                    }
+                names = {c.id: c.name for c in s.clusters.list(None)}
+                limit = max(1, min(int(body.get("limit", 500) or 500),
+                                   2000))
+                feed = []
+                for e in s.repos.events.find_recent(names, limit):
+                    row = e.to_public_dict()
+                    row["cluster"] = names.get(e.cluster_id, "")
+                    feed.append(row)
+                return {"events": feed,
+                        "total": s.repos.events.count_for(names)}
             case ("GET", ["clusters", name, "events"]):
                 return pub(s.events.list(s.clusters.get(name).id))
             case ("POST", ["clusters", name, "cis-scans"]):
@@ -875,6 +908,25 @@ def _print_critical_path(tree: dict, kind: str = "") -> None:
               if c.get("kind") == "phase"]
     durations = {c["name"]: c["duration_s"] or 0.0 for c in phases}
     if not durations:
+        # non-phase families (workload ops): quote the WINDOW chain —
+        # compile / steps / checkpoint-* wall-clock with the serial sum
+        # as the floor, instead of refusing the verb
+        windows = [c for c in tree.get("children", [])
+                   if c.get("kind") == "window"]
+        if not windows:
+            return
+        total = sum(c["duration_s"] or 0.0 for c in windows)
+        parts = " + ".join(
+            f"{c['name']} {c['duration_s'] or 0.0:.3f}s" for c in windows)
+        print(f"window chain ({len(windows)} windows): {parts}")
+        op_total = tree.get("duration_s") or 0.0
+        line = f"serial window floor {total:.3f}s"
+        if op_total:
+            overhead = max(op_total - total, 0.0)
+            line += (f"; operation total {op_total:.3f}s; outside the "
+                     f"windows {overhead:.3f}s "
+                     f"({overhead / op_total * 100:.0f}%)")
+        print(line)
         return
     # the bound is quoted against the PHASE window (max finish − min
     # start), not the operation total: provisioning and close-out have no
@@ -905,6 +957,108 @@ def _print_critical_path(tree: dict, kind: str = "") -> None:
 
 def _count_nodes(tree: dict) -> int:
     return 1 + sum(_count_nodes(c) for c in tree.get("children", []))
+
+
+def _event_line(row: dict) -> str:
+    """One stream row for the human `koctl events` tail."""
+    when = time.strftime("%H:%M:%S",
+                         time.localtime(float(row.get("created_at", 0))))
+    kind = row.get("kind") or "legacy"
+    who = row.get("tenant") or (row.get("op_id") or "")[:8] or "-"
+    return (f"{when}  {kind:20s} {who:12s} "
+            f"{row.get('message') or row.get('reason', '')}")
+
+
+def _events_path(args, after: int) -> str:
+    """The stream form of GET /api/v1/events (always carries `after`, so
+    both transports answer with the rowid-cursor shape)."""
+    from urllib.parse import quote
+
+    params = [f"after={after}"]
+    for key in ("kind", "tenant", "cluster"):
+        value = getattr(args, key, "") or ""
+        if value:
+            params.append(f"{key}={quote(value, safe='')}")
+    return "/api/v1/events?" + "&".join(params)
+
+
+def _follow_events_sse(client, args, after: int) -> None:
+    """REST tail of the event stream: the server's SSE endpoint, frames
+    printed as they land. `id:` lines carry the rowid cursor, so a
+    reconnecting tail would resume via Last-Event-ID — this simple CLI
+    tail just exits when the server ends the stream (30s idle)."""
+    url = client.base + _events_path(args, after) + "&follow=1"
+    with client.http.get(url, stream=True, timeout=600) as resp:
+        if resp.status_code >= 400:
+            try:
+                message = resp.json().get("message", resp.status_code)
+            except ValueError:
+                message = resp.status_code
+            raise SystemExit(f"error: {message}")
+        name = ""
+        for raw in resp.iter_lines(decode_unicode=True):
+            if raw is None:
+                continue
+            if raw.startswith("event: "):
+                name = raw[7:].strip()
+                continue
+            if not raw.startswith("data: "):
+                continue
+            if name == "end":
+                return
+            try:
+                print(_event_line(json.loads(raw[6:])), flush=True)
+            except ValueError:
+                continue
+            name = ""
+
+
+def _follow_events_local(client, args, after: int) -> None:
+    """Local-transport tail: poll the stream read with its rowid cursor.
+    Exits after the same 30s idle window the SSE form has — both
+    transports mean the same thing by --follow."""
+    idle = 0.0
+    while idle < 30.0:
+        data = client.call("GET", _events_path(args, after))
+        if data["events"]:
+            idle = 0.0
+            after = data["cursor"]
+            for row in data["events"]:
+                print(_event_line(row), flush=True)
+        else:
+            idle += 0.5
+        time.sleep(0.5)
+
+
+def cmd_events(client, args) -> int:
+    """`koctl events [--follow]` — the live platform event stream
+    (docs/observability.md "Events and live telemetry"): every journal
+    transition, queue state change, watchdog escalation, slice incident
+    and fleet wave verdict, in stream order with rowid cursors.
+    `--kind queue.` follows a whole family; `--tenant`/`--cluster`
+    scope the tail."""
+    after = max(int(args.after or 0), 0)
+    if not args.follow:
+        data = client.call("GET", _events_path(args, after))
+        if args.json:
+            _print(data)
+            return 0
+        if not data["events"]:
+            print("no events past cursor "
+                  f"{after} (bus retention: observability.retain_events)")
+            return 0
+        for row in data["events"]:
+            print(_event_line(row))
+        print(f"cursor: {data['cursor']} (resume with --after)")
+        return 0
+    try:
+        if isinstance(client, RestClient):
+            _follow_events_sse(client, args, after)
+        else:
+            _follow_events_local(client, args, after)
+    except KeyboardInterrupt:
+        pass
+    return 0
 
 
 def cmd_watchdog(client, args) -> int:
@@ -1168,6 +1322,76 @@ def _format_entry(e: dict) -> str:
             + ("  ".join(extras)))
 
 
+def _sample_line(s: dict) -> str:
+    """One metric sample for the live `workload watch` tail."""
+    if s.get("kind") == "checkpoint":
+        attrs = s.get("attrs") or {}
+        return (f"  step {s['step']:>5}  checkpoint "
+                f"{(attrs.get('checkpoint') or '?')[:8]} saved "
+                f"({attrs.get('bytes', 0)} bytes)")
+    line = f"  step {s['step']:>5}  loss {s['loss']:.6f}"
+    if s.get("steps_per_s"):
+        line += f"  {s['steps_per_s']} steps/s"
+    if s.get("tflops"):
+        line += f"  {s['tflops']} TFLOP/s"
+    if s.get("mfu_pct"):
+        line += f"  {s['mfu_pct']}% MFU"
+    return line
+
+
+def _watch_workload_sse(client, op_ref: str) -> int:
+    """REST `workload watch`: ride the metrics endpoint's SSE follow
+    stream; the end frame carries the op's terminal status."""
+    url = (f"{client.base}/api/v1/workloads/operations/{op_ref}/metrics"
+           f"?follow=1")
+    status = ""
+    with client.http.get(url, stream=True, timeout=600) as resp:
+        if resp.status_code >= 400:
+            try:
+                message = resp.json().get("message", resp.status_code)
+            except ValueError:
+                message = resp.status_code
+            raise SystemExit(f"error: {message}")
+        name = ""
+        for raw in resp.iter_lines(decode_unicode=True):
+            if raw is None:
+                continue
+            if raw.startswith("event: "):
+                name = raw[7:].strip()
+                continue
+            if not raw.startswith("data: "):
+                continue
+            try:
+                payload = json.loads(raw[6:])
+            except ValueError:
+                continue
+            if name == "end":
+                status = payload.get("status", "")
+                break
+            print(_sample_line(payload), flush=True)
+            name = ""
+    print(f"workload {op_ref[:8]}: {status or '(stream ended)'}")
+    return 0 if status == "Succeeded" else 1
+
+
+def _watch_workload_poll(client, op_ref: str) -> int:
+    """Local-transport `workload watch`: poll the metrics read with its
+    rowid cursor until the op leaves Running — the fallback posture the
+    docs promise when there is no SSE server to ride."""
+    after = 0
+    while True:
+        data = client.call(
+            "GET",
+            f"/api/v1/workloads/operations/{op_ref}/metrics?after={after}")
+        after = data["cursor"]
+        for s in data["samples"]:
+            print(_sample_line(s), flush=True)
+        if not data["live"]:
+            print(f"workload {data['operation'][:8]}: {data['status']}")
+            return 0 if data["status"] == "Succeeded" else 1
+        time.sleep(0.5)
+
+
 def cmd_workload(client, args) -> int:
     """Tenant workload verbs (docs/workloads.md): `train` runs sharded
     training on the visible devices as a journaled operation (partition
@@ -1324,6 +1548,19 @@ def cmd_workload(client, args) -> int:
                       f"{_format_mesh(c.get('mesh')):20s} "
                       f"{c.get('bytes', 0)} bytes  (op {c['op_id'][:8]})")
         return 0
+    if args.wl_cmd == "watch":
+        op_ref = args.op
+        if not op_ref:
+            ops = client.call("GET", "/api/v1/workloads/operations")
+            if not ops:
+                raise SystemExit("no workload operations journaled")
+            op_ref = ops[0]["id"]      # list is newest-first
+        try:
+            if isinstance(client, RestClient):
+                return _watch_workload_sse(client, op_ref)
+            return _watch_workload_poll(client, op_ref)
+        except KeyboardInterrupt:
+            return 0
     if args.wl_cmd == "trace":
         op_ref = args.op
         if not op_ref:
@@ -1346,7 +1583,13 @@ def cmd_workload(client, args) -> int:
 
         print(f"workload operation {data['kind']}/{data['operation']}  "
               f"trace {data.get('trace_id') or '-'}")
-        print(render_waterfall(tree))
+        if getattr(args, "critical_path", False):
+            # workload ops quote their WINDOW chain (compile / steps /
+            # checkpoint) with self-times — same verb as cluster traces,
+            # no refusal on non-phase families
+            _print_critical_path(tree, data.get("kind") or "")
+        else:
+            print(render_waterfall(tree))
         return 0 if data.get("status") != "Failed" else 1
     raise SystemExit(f"unknown workload command {args.wl_cmd}")
 
@@ -2731,6 +2974,47 @@ def _queue_soak_once(args, base_dir: str) -> tuple[list, dict]:
               "(families present)" if "ko_tpu_workload_queue"
               in exposition else "(missing)")
 
+        # ---- the story FROM THE EVENT STREAM alone ---------------------
+        # (the GET /api/v1/events surface — no journal or span reads):
+        # alice's whole preemption life must reconstruct from bus rows,
+        # and the normalized story rides the structural summary so
+        # --verify-determinism diffs it bit-for-bit across seeded passes
+        from kubeoperator_tpu.models import Event
+        from kubeoperator_tpu.observability import queue_story
+
+        stream_client = LocalClient.__new__(LocalClient)
+        stream_client.services = svc
+        feed = stream_client.call("GET", "/api/v1/events?after=0")
+        bus = [Event.from_dict(row) for row in feed["events"]]
+        story = queue_story(bus, tenant="alice")
+        # ids (entry/checkpoint uuids) are pass-local; normalize them to
+        # presence so the story is seed-stable
+        story_norm = [{
+            "kind": r["kind"], "state": r.get("state"),
+            "step": r.get("step"),
+            "by": bool(r.get("by")), "checkpoint": bool(r.get("checkpoint")),
+        } for r in story]
+        expected_story = [
+            ("queue.submit", "pending"), ("queue.place", "placed"),
+            ("queue.preempt", "running"), ("queue.drain", "drained"),
+            ("queue.resume", "pending"), ("queue.place", "placed"),
+            ("queue.done", "done"),
+        ]
+        check("alice's full story reconstructs from GET /api/v1/events "
+              "alone: submit -> place -> preempt -> drain -> resume -> "
+              "done",
+              [(r["kind"], r["state"]) for r in story_norm]
+              == expected_story
+              and story_norm[3]["step"] == preempt_at_step
+              and story_norm[3]["checkpoint"]
+              and story_norm[2]["by"],
+              str(story_norm))
+        check("every queue event rode the stream with a resumable rowid "
+              "cursor",
+              feed["cursor"] > 0
+              and all(row.get("stream_id") for row in feed["events"]),
+              str(feed.get("cursor")))
+
         structure = {
             "states": {t: entries[t]["state"] for t in sorted(entries)},
             "ledger": [(p["kind"], p.get("step"))
@@ -2740,6 +3024,7 @@ def _queue_soak_once(args, base_dir: str) -> tuple[list, dict]:
             "reference": reference["losses"],
             "checkpoint_tenants": sorted(
                 {r["tenant"] for r in svc.workloads.checkpoints()}),
+            "story": story_norm,
         }
     finally:
         svc.close()
@@ -3262,6 +3547,39 @@ def build_parser() -> argparse.ArgumentParser:
     wl_trace.add_argument("op", nargs="?", default="",
                           help="workload op id; default: the newest")
     wl_trace.add_argument("--json", action="store_true")
+    wl_trace.add_argument("--critical-path", action="store_true",
+                          help="print only the finished-last chain plus "
+                               "the compile/steps/checkpoint WINDOW "
+                               "quote with self-times")
+    wl_watch = wlsub.add_parser(
+        "watch",
+        help="live per-step telemetry of a run: loss / steps-per-s / "
+             "TFLOP/s / MFU lines plus checkpoint-save markers as they "
+             "land (SSE against a server; cursor polling on --local)")
+    wl_watch.add_argument("op", nargs="?", default="",
+                          help="workload op id; default: the newest")
+
+    events_p = sub.add_parser(
+        "events",
+        help="the live platform event stream (journal transitions, "
+             "queue state changes, watchdog escalations, slice "
+             "incidents, fleet wave verdicts) with rowid cursors")
+    events_p.add_argument("--follow", "-f", action="store_true",
+                          help="tail the stream (SSE against a server; "
+                               "cursor polling on --local); exits after "
+                               "30s idle like `cluster logs -f`")
+    events_p.add_argument("--kind", default="", metavar="KIND",
+                          help="one kind (op.close), or a family with a "
+                               "trailing dot (queue.)")
+    events_p.add_argument("--tenant", default="", metavar="NAME",
+                          help="only this tenant's events")
+    events_p.add_argument("--cluster", default="", metavar="NAME",
+                          help="only this cluster's events")
+    events_p.add_argument("--after", type=int, default=0,
+                          metavar="CURSOR",
+                          help="resume past this stream cursor (the "
+                               "`cursor:` the last listing printed)")
+    events_p.add_argument("--json", action="store_true")
 
     watchdog_p = sub.add_parser(
         "watchdog", help="auto-remediation circuit breaker verbs")
@@ -3569,6 +3887,8 @@ def main(argv: list[str] | None = None) -> int:
         return cmd_fleet(client, args)
     if args.cmd == "workload":
         return cmd_workload(client, args)
+    if args.cmd == "events":
+        return cmd_events(client, args)
     if args.cmd == "backup-account":
         if args.ba_cmd == "list":
             _print(client.call("GET", "/api/v1/backup-accounts"))

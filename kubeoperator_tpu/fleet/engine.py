@@ -53,7 +53,7 @@ from kubeoperator_tpu.fleet.gates import evaluate_gate
 from kubeoperator_tpu.fleet.planner import rollout_summary
 from kubeoperator_tpu.fleet.rollback import rollback_wave
 from kubeoperator_tpu.models.span import SpanKind, SpanStatus
-from kubeoperator_tpu.observability import trace_context
+from kubeoperator_tpu.observability import EventKind, trace_context
 from kubeoperator_tpu.resilience.fleet import fleet_breaker, note_unavailable
 from kubeoperator_tpu.utils.errors import KoError
 from kubeoperator_tpu.utils.logging import get_logger
@@ -162,7 +162,21 @@ class FleetEngine:
                 if outcome == _PARKED_PAUSE:
                     self._park_paused(wave["index"])
                     return
-                wave["outcome"] = outcome
+                # the verdict commits WITH its bus event: the wave ledger
+                # save and the fleet.wave row land in one fenced tx, so
+                # the event stream can never narrate a verdict the
+                # journal lacks
+                with self._ledger_lock:
+                    wave["outcome"] = outcome
+                    self.op.summary = rollout_summary(v)
+                    self.journal.save_vars(op, event=(
+                        EventKind.FLEET_WAVE,
+                        f"wave {wave['index']} "
+                        f"({len(wave['clusters'])} clusters): {outcome}",
+                        {"wave": wave["index"],
+                         "canary": bool(wave["canary"]),
+                         "clusters": len(wave["clusters"]),
+                         "outcome": outcome}))
                 self.journal.progress(
                     op, f"wave-{wave['index']}",
                     "OK" if outcome == WAVE_PROMOTED else "Failed")

@@ -31,7 +31,14 @@ from kubeoperator_tpu.models.cluster import (
 )
 from kubeoperator_tpu.models.backup import BackupAccount, BackupFile, BackupStrategy
 from kubeoperator_tpu.models.tenancy import Project, ProjectMember, Role, User
-from kubeoperator_tpu.models.event import AuditRecord, Event, Message, Setting, TaskLogChunk
+from kubeoperator_tpu.models.event import (
+    AuditRecord,
+    Event,
+    Message,
+    MetricSample,
+    Setting,
+    TaskLogChunk,
+)
 from kubeoperator_tpu.models.checkpoint import CHECKPOINT_STATUSES, Checkpoint
 from kubeoperator_tpu.models.component import ClusterComponent
 from kubeoperator_tpu.models.workload import (
@@ -54,7 +61,8 @@ __all__ = [
     "ClusterPhaseStatus", "Node", "NodeRole", "ProvisionMode",
     "BackupAccount", "BackupFile", "BackupStrategy",
     "Project", "ProjectMember", "Role", "User",
-    "AuditRecord", "Event", "Message", "Setting", "TaskLogChunk",
+    "AuditRecord", "Event", "Message", "MetricSample", "Setting",
+    "TaskLogChunk",
     "ClusterComponent",
     "Checkpoint", "CHECKPOINT_STATUSES",
     "QueueEntry", "PRIORITY_CLASSES", "QUEUE_STATES", "ACTIVE_STATES",

@@ -15,13 +15,47 @@ from kubeoperator_tpu.models.base import Entity
 
 @dataclass
 class Event(Entity):
-    """Cluster-scoped audit/event row (create started, phase failed, backup
-    done, health degraded, smoke test result...)."""
+    """One durable platform-telemetry event (docs/observability.md
+    "Events and live telemetry").
+
+    Grown (migration 013) from the cluster-scoped UI timeline row into
+    the event BUS record: every journal transition, watchdog escalation,
+    fencing rejection, slice incident, queue state change and fleet wave
+    verdict lands one of these, written in the SAME transaction as the
+    state change it describes and streamed over `GET /api/v1/events`
+    (sqlite rowid = the SSE cursor). Legacy timeline rows are bus events
+    with an empty `kind`."""
 
     cluster_id: str = ""
     type: str = "Normal"       # Normal | Warning
     reason: str = ""           # stable machine-readable reason code
     message: str = ""          # human text (pre-localized by i18n at read time)
+    # live-telemetry bus fields (migration 013); "" = legacy timeline row
+    kind: str = ""             # stream key ("op.open", "queue.preempt", ...)
+    op_id: str = ""            # owning journal operation, when one exists
+    trace_id: str = ""         # the op's trace — joins straight to koctl trace
+    tenant: str = ""           # tenant namespace for workload/queue events
+    payload: dict = field(default_factory=dict)   # structured facts, never secrets
+
+
+@dataclass
+class MetricSample(Entity):
+    """One per-step training telemetry point of a workload operation
+    (migration 013): fed from the train loop's on_step seam, buffered on
+    the op's tracer and flushed with the span buffer, ring-bounded per op
+    (`observability.max_samples_per_op` keeps the NEWEST rows). `kind`
+    distinguishes step samples from checkpoint-save markers."""
+
+    op_id: str = ""
+    step: int = 0
+    kind: str = "step"         # step | checkpoint
+    tenant: str = ""
+    loss: float = 0.0
+    step_s: float = 0.0        # wall-clock of this step (0 on markers)
+    steps_per_s: float = 0.0
+    tflops: float = 0.0        # achieved model TFLOP/s (0 = unknown)
+    mfu_pct: float = 0.0       # 0 = no datasheet peak known
+    attrs: dict = field(default_factory=dict)
 
 
 @dataclass

@@ -10,7 +10,13 @@ feeding the phase/task duration histograms with trace-id exemplars.
 * tracing.py — `Tracer`/`NullTracer`, span-tree building, the waterfall
   renderer, and the `TaskSpec.trace` wire context.
 * logging.py — JSON log records carrying `trace_id`/`op_id`/`cluster`/
-  `phase`, bound per worker thread by the journal/engine.
+  `phase` (plus `tenant`/`workload_op` on dispatched tenant runs), bound
+  per worker thread by the journal/engine.
+* events.py — the durable event bus: `emit_event()` is the ONE emission
+  funnel (analyzer rule KO-P012) every state-transition writer routes
+  through, committing each event in the same transaction as the state
+  change it describes; `GET /api/v1/events` streams the rows back with
+  rowid cursors.
 
 Config: the `observability.*` block (utils/config.py DEFAULTS; analyzer
 rule KO-X009 keeps the knob table in docs/observability.md honest).
@@ -33,10 +39,16 @@ from kubeoperator_tpu.observability.logging import (
     clear_trace,
     current_trace,
 )
+from kubeoperator_tpu.observability.events import (
+    EventKind,
+    emit_event,
+    queue_story,
+)
 
 __all__ = [
     "NullTracer", "Tracer", "critical_chain", "mark_critical_path",
     "new_trace_id",
     "render_waterfall", "span_tree", "trace_context",
     "JsonLogFormatter", "bind_trace", "clear_trace", "current_trace",
+    "EventKind", "emit_event", "queue_story",
 ]

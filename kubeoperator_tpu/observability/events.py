@@ -1,0 +1,128 @@
+"""The durable event bus — live platform telemetry's write side
+(docs/observability.md "Events and live telemetry").
+
+One table (the `events` rows migration 013 extended), one writer:
+`emit_event()` is THE helper every state-transition writer routes event
+emission through — the operation journal for its own lifecycle
+transitions (open/phase/close/interrupt/resume) and fencing rejections,
+the workload queue for submit/place/preempt/drain/resume, the fleet
+engine for wave verdicts, the watchdog for escalations, the slice pool
+for incident-ledger rows, and the legacy cluster timeline
+(service/event.py) for everything it always emitted. Analyzer rule
+KO-P012 (`event-discipline`) enforces the funnel: no ad-hoc
+`repos.events.save(...)` outside this module.
+
+Same-transaction contract: emit_event writes through the nestable
+`db.tx()` scope, so a caller that already holds the transaction of the
+state change it describes (the journal's fenced-write path) lands the
+event row ATOMICALLY with that change — a fenced-out writer whose
+transaction rolls back takes its event with it, and an observer can
+never see a state change without its event or vice versa.
+
+The read side is `EventRepo.since()` (rowid = the stream cursor the SSE
+feed resumes on via `Last-Event-ID`); `queue_story()` is the shared
+reducer that reconstructs a tenant workload's life (submit → place →
+preempt → drain → resume → done) from the stream alone — what the
+chaos-soak `--queue` drill diffs bit-for-bit under
+`--verify-determinism`.
+"""
+
+from __future__ import annotations
+
+from kubeoperator_tpu.models import Event
+from kubeoperator_tpu.observability.logging import current_trace
+from kubeoperator_tpu.utils.logging import get_logger
+
+log = get_logger("observability.events")
+
+
+class EventKind:
+    """The bus vocabulary. Dotted streams: a trailing-'.' filter selects
+    a whole family (`kind=queue.` follows every queue transition)."""
+
+    # journal lifecycle (resilience/journal.py — the fenced choke point)
+    OP_OPEN = "op.open"
+    OP_PHASE = "op.phase"
+    OP_CLOSE = "op.close"
+    OP_INTERRUPT = "op.interrupt"
+    OP_RESUME = "op.resume"
+    # a fenced-out writer's rejected stale-epoch write (resilience/lease.py)
+    FENCE_REJECTED = "fence.rejected"
+    # watchdog escalations (service/watchdog.py)
+    WATCHDOG_ESCALATE = "watchdog.escalate"
+    WATCHDOG_REMEDIATION = "watchdog.remediation"
+    # per-slice incident ledger (resilience/slicepool.py); the full kind
+    # is "slice.<ledger kind>" — slice.detected, slice.drained, ...
+    SLICE_PREFIX = "slice."
+    # workload queue state changes (service/queue.py)
+    QUEUE_SUBMIT = "queue.submit"
+    QUEUE_PLACE = "queue.place"
+    QUEUE_PREEMPT = "queue.preempt"
+    QUEUE_DRAIN = "queue.drain"
+    QUEUE_RESUME = "queue.resume"
+    QUEUE_DONE = "queue.done"
+    # fleet wave verdicts (fleet/engine.py)
+    FLEET_WAVE = "fleet.wave"
+    # legacy cluster-timeline rows routed through service/event.py
+    CLUSTER_EVENT = "cluster.event"
+
+
+def emit_event(repos, kind: str, *, cluster_id: str = "", op_id: str = "",
+               trace_id: str = "", tenant: str = "", type_: str = "Normal",
+               reason: str = "", message: str = "",
+               payload: dict | None = None) -> Event:
+    """Write one bus event — THE emission funnel (KO-P012).
+
+    Joins the caller's open transaction when there is one (nestable
+    db.tx), which is how journal-path events commit atomically with the
+    state change they describe. Correlation ids not passed explicitly
+    are stamped from the calling thread's bound log context
+    (observability/logging.py), so a dispatched tenant run's events
+    carry trace/op/tenant without every call site threading them."""
+    ctx = current_trace()
+    event = Event(
+        cluster_id=cluster_id, type=type_, reason=reason,
+        message=message, kind=kind,
+        op_id=op_id or str(ctx.get("workload_op") or ctx.get("op_id")
+                           or ""),
+        trace_id=trace_id or str(ctx.get("trace_id") or ""),
+        tenant=tenant or str(ctx.get("tenant") or ""),
+        payload=dict(payload or {}),
+    )
+    with repos.db.tx():
+        repos.events.save(event)
+    return event
+
+
+# the queue-entry life in stream order — the reducer's verdict alphabet
+QUEUE_STORY_KINDS = (
+    EventKind.QUEUE_SUBMIT, EventKind.QUEUE_PLACE, EventKind.QUEUE_PREEMPT,
+    EventKind.QUEUE_DRAIN, EventKind.QUEUE_RESUME, EventKind.QUEUE_DONE,
+)
+
+
+def queue_story(events, tenant: str = "") -> list[dict]:
+    """Reconstruct a tenant workload's queue life FROM THE EVENT STREAM
+    alone — no journal or span reads. Input is any iterable of bus
+    events (already stream-ordered, as `since()` returns them); output
+    is the compact story the chaos-soak --queue drill asserts on and
+    diffs across seeded passes:
+
+        [{"kind": "queue.submit", "tenant": "alice", "state": ...,
+          "step": ...}, ...]
+
+    Steps/states ride from each event's payload when present, so the
+    story says not just THAT alice drained but at which step."""
+    story: list[dict] = []
+    for event in events:
+        if event.kind not in QUEUE_STORY_KINDS:
+            continue
+        if tenant and event.tenant != tenant:
+            continue
+        row = {"kind": event.kind, "tenant": event.tenant}
+        for key in ("state", "step", "by", "checkpoint", "priority"):
+            value = event.payload.get(key)
+            if value not in (None, ""):
+                row[key] = value
+        story.append(row)
+    return story

@@ -29,12 +29,14 @@ _TRACE_CTX: contextvars.ContextVar[dict | None] = contextvars.ContextVar(
     "ko_tpu_trace_ctx", default=None
 )
 
-_CTX_FIELDS = ("trace_id", "op_id", "cluster", "phase")
+_CTX_FIELDS = ("trace_id", "op_id", "cluster", "phase", "tenant",
+               "workload_op")
 
 
 def bind_trace(**fields) -> None:
-    """Merge fields (trace_id/op_id/cluster/phase) into the current
-    thread's log context; unknown fields are dropped, None values clear."""
+    """Merge fields (trace_id/op_id/cluster/phase, plus tenant/
+    workload_op for dispatched tenant runs) into the current thread's
+    log context; unknown fields are dropped, None values clear."""
     current = dict(_TRACE_CTX.get() or {})
     for key, value in fields.items():
         if key not in _CTX_FIELDS:

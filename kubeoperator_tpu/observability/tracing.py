@@ -79,6 +79,9 @@ class NullTracer:
     def record_payload(self, span_dicts: list) -> None:
         pass
 
+    def record_samples(self, samples: list) -> None:
+        pass
+
     def flush(self) -> None:
         pass
 
@@ -103,13 +106,20 @@ class Tracer(NullTracer):
     enabled = True
 
     def __init__(self, spans_repo, *, trace_id: str, op_id: str,
-                 cluster_id: str, max_spans: int = 2000) -> None:
+                 cluster_id: str, max_spans: int = 2000,
+                 samples_repo=None, max_samples: int = 512) -> None:
         self.spans = spans_repo
         self.trace_id = trace_id
         self.op_id = op_id
         self.root_id = op_id      # root span id == operation id, by contract
         self.cluster_id = cluster_id
         self.max_spans = max_spans
+        # per-step telemetry ring (docs/observability.md "Events and live
+        # telemetry"): samples buffer beside the spans and land in the
+        # SAME flush, bounded to the newest `max_samples` rows per op
+        self.samples_repo = samples_repo
+        self.max_samples = max_samples
+        self._sample_buffer: list = []
         self._admitted: set = set()   # span ids under the cap
         self._dropped_ids: set = set()
         self._buffer: dict = {}       # span id -> Span, pending one flush
@@ -138,15 +148,38 @@ class Tracer(NullTracer):
         self._save(span)
         return span
 
-    def flush(self) -> None:
-        """Land the buffered spans in one transaction (best-effort: span
-        IO must never fail the operation it describes)."""
+    def record_samples(self, samples: list) -> None:
+        """Buffer per-step MetricSample rows beside the span buffer; they
+        land together at the next flush, stamped with this op's identity.
+        The ring bound is enforced repo-side at flush (keep the NEWEST
+        max_samples rows), so a long run's live tail always streams."""
+        if self.samples_repo is None:
+            return
         with self._lock:
-            if not self._buffer:
+            for sample in samples or []:
+                sample.op_id = self.op_id
+                self._sample_buffer.append(sample)
+
+    def flush(self) -> None:
+        """Land the buffered spans + metric samples in one transaction
+        (best-effort: telemetry IO must never fail the operation it
+        describes)."""
+        with self._lock:
+            if not self._buffer and not self._sample_buffer:
                 return
             batch, self._buffer = list(self._buffer.values()), {}
+            samples, self._sample_buffer = self._sample_buffer, []
         try:
-            self.spans.save_many(batch)
+            if samples:
+                # one tx() for both halves: span batch and sample batch
+                # commit together, one fsync per boundary
+                with self.spans.db.tx():
+                    self.spans.save_many(batch)
+                    self.samples_repo.save_many(samples)
+                    self.samples_repo.prune_ring(self.op_id,
+                                                 self.max_samples)
+            else:
+                self.spans.save_many(batch)
         except Exception:
             log.exception("span flush failed (trace %s)", self.trace_id)
 

@@ -1,0 +1,38 @@
+-- Live platform telemetry (docs/observability.md "Events and live
+-- telemetry"). Two halves:
+--
+-- 1. The events table (001) grows from a cluster-scoped UI timeline into
+--    the durable EVENT BUS: every journal transition (op open/phase/
+--    close/interrupt), watchdog escalation, fencing rejection, slice
+--    incident, queue state change and fleet wave verdict lands one
+--    structured row, written in the SAME transaction as the state change
+--    it describes. `kind` is the machine-readable stream key
+--    ('' = a legacy row predating the bus); op_id/tenant mirror the
+--    correlation ids so the SSE feed's filters run on indexed SQL, and
+--    sqlite's rowid is the stream cursor (`Last-Event-ID`).
+ALTER TABLE events ADD COLUMN kind TEXT NOT NULL DEFAULT '';
+ALTER TABLE events ADD COLUMN op_id TEXT NOT NULL DEFAULT '';
+ALTER TABLE events ADD COLUMN tenant TEXT NOT NULL DEFAULT '';
+CREATE INDEX IF NOT EXISTS idx_events_kind ON events (kind, created_at);
+
+-- 2. Per-step training telemetry: a bounded ring of metric samples per
+--    workload operation (loss / step wall-clock / steps-per-s / TFLOP/s /
+--    MFU, plus checkpoint-save markers), fed from the train loop's
+--    on_step seam and flushed with the span buffer. loss/step_s mirror
+--    into real columns so the /metrics histograms scrape without JSON
+--    hydration; the ring keeps the NEWEST observability.max_samples_per_op
+--    rows per op.
+CREATE TABLE IF NOT EXISTS metric_samples (
+    id TEXT PRIMARY KEY,
+    op_id TEXT NOT NULL,
+    step INTEGER NOT NULL,
+    kind TEXT NOT NULL,
+    tenant TEXT NOT NULL,
+    loss REAL NOT NULL,
+    step_s REAL NOT NULL,
+    data TEXT NOT NULL,
+    created_at REAL NOT NULL,
+    updated_at REAL NOT NULL
+);
+CREATE INDEX IF NOT EXISTS idx_metric_samples_op
+    ON metric_samples (op_id, step);

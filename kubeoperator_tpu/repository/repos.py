@@ -23,6 +23,7 @@ from kubeoperator_tpu.models import (
     Event,
     Host,
     Message,
+    MetricSample,
     Node,
     Operation,
     Plan,
@@ -80,6 +81,31 @@ class EntityRepo(Generic[E]):
                 raise ConflictError(kind=self.table, name=getattr(obj, "name", obj.id))
             raise
         return obj
+
+    def save_many(self, objs: Iterable[E]) -> None:
+        """Batch-upsert in ONE transaction — the path every high-volume
+        writer shares (executor span batches, metric-sample flushes): a
+        flush must not pay a transaction per row. No touch(): batch
+        producers stamp their own timestamps."""
+        objs = list(objs)
+        if not objs:
+            return
+        cols = ["id", *self.columns, "data", "created_at", "updated_at"]
+        updates = ",".join(f"{c}=excluded.{c}" for c in cols if c != "id")
+        with self.db.tx() as conn:
+            conn.executemany(
+                f"INSERT INTO {self.table} ({','.join(cols)}) "
+                f"VALUES ({','.join('?' for _ in cols)}) "
+                f"ON CONFLICT(id) DO UPDATE SET {updates}",
+                [
+                    (
+                        o.id,
+                        *[self._column_value(o, c) for c in self.columns],
+                        json.dumps(o.to_dict()), o.created_at, o.updated_at,
+                    )
+                    for o in objs
+                ],
+            )
 
     def get(self, id: str) -> E:
         rows = self.db.query(f"SELECT data FROM {self.table} WHERE id=?", (id,))
@@ -225,13 +251,101 @@ class AuditRepo(EntityRepo[AuditRecord]):
 
 
 class EventRepo(EntityRepo[Event]):
-    table, entity, columns = "events", Event, ("cluster_id",)
+    """The durable event bus (migration 013 grew the 001 timeline table).
+    sqlite's rowid is the stream cursor: insertion order == stream order,
+    so `since()` is the one read the SSE feed, `koctl events --follow`
+    and the chaos drills' story reconstruction all share."""
+
+    table, entity, columns = (
+        "events", Event, ("cluster_id", "kind", "op_id", "tenant"),
+    )
+
+    def since(self, after_rowid: int = 0, *, kind: str = "",
+              cluster_id: str | None = None, tenant: str = "",
+              limit: int = 500) -> tuple[list[tuple[int, Event]], int]:
+        """Stream read: events past `after_rowid` in stream order, capped
+        IN SQL, filtered on the mirrored columns. `kind` matches exactly
+        or — with a trailing '.' — as a prefix ("queue." selects the
+        whole queue stream). Returns ([(rowid, event), ...], new_cursor);
+        the cursor is unchanged when nothing new landed, so a poll loop
+        can hand it straight back."""
+        clauses, params = ["rowid > ?"], [int(after_rowid)]
+        if kind:
+            if kind.endswith("."):
+                clauses.append("kind LIKE ? ESCAPE '\\'")
+                params.append(kind.replace("\\", "\\\\")
+                              .replace("%", "\\%").replace("_", "\\_")
+                              + "%")
+            else:
+                clauses.append("kind = ?")
+                params.append(kind)
+        if cluster_id is not None:
+            clauses.append("cluster_id = ?")
+            params.append(cluster_id)
+        if tenant:
+            clauses.append("tenant = ?")
+            params.append(tenant)
+        rows = self.db.query(
+            f"SELECT rowid, data FROM {self.table} "
+            f"WHERE {' AND '.join(clauses)} ORDER BY rowid LIMIT ?",
+            (*params, max(1, min(int(limit), 5000))),
+        )
+        out = [(int(r["rowid"]), self._hydrate(r["data"])) for r in rows]
+        return out, (out[-1][0] if out else int(after_rowid))
+
+    def counts_by_kind(self) -> dict[str, int]:
+        """Events by kind, computed IN SQL on the mirrored column — the
+        `ko_tpu_events_total` family must not hydrate the bus per
+        scrape. Legacy pre-bus rows group under ''."""
+        rows = self.db.query(
+            f"SELECT kind, COUNT(*) AS n FROM {self.table} GROUP BY kind")
+        return {r["kind"]: int(r["n"]) for r in rows}
+
+    def prune(self, keep: int) -> int:
+        """Bounded bus: drop STREAM rows past the newest `keep`, by rowid
+        (stream order), never a created_at cutoff — timestamp ties at the
+        boundary must not take rows the bound promised to keep. TIMELINE
+        rows are exempt: chatty op.*/queue.* traffic must never evict an
+        older cluster's human history (create/backup/escalation trail),
+        which was retained forever before the bus existed. Cursor
+        semantics survive pruning: rowids only grow, so a resumed
+        `Last-Event-ID` past the pruned range replays nothing stale."""
+        if keep < 1:
+            return 0
+        with self.db.tx() as conn:
+            cur = conn.execute(
+                f"DELETE FROM {self.table} "
+                f"WHERE NOT {self.TIMELINE_WHERE} AND rowid NOT IN ("
+                f"SELECT rowid FROM {self.table} "
+                f"WHERE NOT {self.TIMELINE_WHERE} "
+                f"ORDER BY rowid DESC LIMIT ?)",
+                (int(keep),),
+            )
+            return max(cur.rowcount, 0)
+
+    # the legacy TIMELINE subset of the bus: human-raised cluster rows
+    # (pre-bus rows, the cluster.event stream, watchdog escalations) —
+    # the UI feed and `koctl cluster events` keep their pre-bus signal
+    # instead of drowning in per-phase op.* rows, which stay reachable
+    # through the stream surface (`since`, kind filters)
+    TIMELINE_WHERE = ("(kind IN ('', 'cluster.event') "
+                      "OR kind LIKE 'watchdog.%')")
+
+    def timeline(self, cluster_id: str) -> list[Event]:
+        """One cluster's human timeline rows, oldest first (the
+        EventService.list contract, pre-bus shape)."""
+        rows = self.db.query(
+            f"SELECT data FROM {self.table} WHERE cluster_id=? "
+            f"AND {self.TIMELINE_WHERE} ORDER BY created_at, rowid",
+            (cluster_id,),
+        )
+        return [self._hydrate(r["data"]) for r in rows]
 
     def find_recent(self, cluster_ids: Iterable[str],
                     limit: int) -> list[Event]:
-        """Newest-first feed across clusters, capped IN SQL — the activity
-        endpoint must not hydrate every event ever emitted just to keep
-        the newest few hundred."""
+        """Newest-first TIMELINE feed across clusters, capped IN SQL —
+        the activity endpoint must not hydrate every event ever emitted
+        just to keep the newest few hundred."""
         ids = list(cluster_ids)
         if not ids or limit < 1:
             return []
@@ -239,6 +353,7 @@ class EventRepo(EntityRepo[Event]):
         rows = self.db.query(
             f"SELECT data FROM {self.table} "
             f"WHERE cluster_id IN ({placeholders}) "
+            f"AND {self.TIMELINE_WHERE} "
             f"ORDER BY created_at DESC LIMIT ?",
             (*ids, limit),
         )
@@ -251,7 +366,8 @@ class EventRepo(EntityRepo[Event]):
         placeholders = ",".join("?" for _ in ids)
         rows = self.db.query(
             f"SELECT COUNT(*) AS n FROM {self.table} "
-            f"WHERE cluster_id IN ({placeholders})",
+            f"WHERE cluster_id IN ({placeholders}) "
+            f"AND {self.TIMELINE_WHERE}",
             tuple(ids),
         )
         return int(rows[0]["n"])
@@ -431,30 +547,6 @@ class SpanRepo(EntityRepo[Span]):
         "status", "started_at", "finished_at",
     )
 
-    def save_many(self, spans: Iterable[Span]) -> None:
-        """Batch-upsert in ONE transaction — the executor hands back a
-        task span plus one span per host at the end of every attempt, and
-        a deploy must not pay a transaction per host for them."""
-        spans = list(spans)
-        if not spans:
-            return
-        cols = ["id", *self.columns, "data", "created_at", "updated_at"]
-        updates = ",".join(f"{c}=excluded.{c}" for c in cols if c != "id")
-        with self.db.tx() as conn:
-            conn.executemany(
-                f"INSERT INTO {self.table} ({','.join(cols)}) "
-                f"VALUES ({','.join('?' for _ in cols)}) "
-                f"ON CONFLICT(id) DO UPDATE SET {updates}",
-                [
-                    (
-                        s.id,
-                        *[self._column_value(s, c) for c in self.columns],
-                        json.dumps(s.to_dict()), s.created_at, s.updated_at,
-                    )
-                    for s in spans
-                ],
-            )
-
     def for_operation(self, op_id: str) -> list[Span]:
         """Every span of one operation, start-ordered (rowid tiebreak keeps
         same-timestamp siblings stable)."""
@@ -533,6 +625,87 @@ class SpanRepo(EntityRepo[Span]):
                 f"SELECT o.id FROM operations o JOIN operations p "
                 f"ON o.parent_op_id = p.id WHERE {live('p.')})",
                 (keep,),
+            )
+            return max(cur.rowcount, 0)
+
+
+class MetricSampleRepo(EntityRepo[MetricSample]):
+    """Per-step training telemetry rows (migration 013). loss/step_s are
+    mirrored into real columns so the scrape-time histogram collectors
+    and the live metrics endpoint run on indexed SQL; sqlite rowid is the
+    follow-stream cursor, exactly like the event bus."""
+
+    table, entity, columns = (
+        "metric_samples", MetricSample,
+        ("op_id", "step", "kind", "tenant", "loss", "step_s"),
+    )
+
+    def since(self, op_id: str, after_rowid: int = 0,
+              limit: int = 2000) -> tuple[list[tuple[int, MetricSample]], int]:
+        """Follow-stream read for one op: samples past `after_rowid` in
+        stream order. Returns ([(rowid, sample), ...], new_cursor)."""
+        rows = self.db.query(
+            f"SELECT rowid, data FROM {self.table} "
+            f"WHERE op_id = ? AND rowid > ? ORDER BY rowid LIMIT ?",
+            (op_id, int(after_rowid), max(1, min(int(limit), 10000))),
+        )
+        out = [(int(r["rowid"]), self._hydrate(r["data"])) for r in rows]
+        return out, (out[-1][0] if out else int(after_rowid))
+
+    def step_rows(self) -> list[tuple]:
+        """(tenant, step_s) for every step sample — the
+        `ko_tpu_workload_step_seconds` histogram's raw material, straight
+        off the mirrored columns (no JSON hydration on the scrape
+        path)."""
+        rows = self.db.query(
+            f"SELECT tenant, step_s FROM {self.table} "
+            f"WHERE kind = 'step' AND step_s > 0 ORDER BY rowid")
+        return [(r["tenant"], float(r["step_s"])) for r in rows]
+
+    def latest_losses(self) -> list[tuple]:
+        """(op_id, tenant, step, loss) of each op's NEWEST step sample —
+        the `ko_tpu_workload_loss` gauge's raw material, one indexed
+        group-by (cardinality bounded by op retention: samples prune
+        with their op's spans)."""
+        rows = self.db.query(
+            f"SELECT op_id, tenant, step, loss, MAX(rowid) "
+            f"FROM {self.table} WHERE kind = 'step' GROUP BY op_id")
+        return [(r["op_id"], r["tenant"], int(r["step"]), float(r["loss"]))
+                for r in rows]
+
+    def prune_ring(self, op_id: str, keep: int) -> int:
+        """The per-op ring bound: keep the NEWEST `keep` rows of one op
+        (a long train's live tail matters; its hour-old samples do not).
+        Called from the tracer's flush path, so it must be one cheap
+        indexed DELETE."""
+        if keep < 1:
+            return 0
+        with self.db.tx() as conn:
+            cur = conn.execute(
+                f"DELETE FROM {self.table} WHERE op_id = ? "
+                f"AND rowid NOT IN ("
+                f"SELECT rowid FROM {self.table} WHERE op_id = ? "
+                f"ORDER BY rowid DESC LIMIT ?)",
+                (op_id, op_id, int(keep)),
+            )
+            return max(cur.rowcount, 0)
+
+    def prune_to_operations(self, keep: int) -> int:
+        """Retention twin of SpanRepo.prune_to_operations: samples of
+        operations older than the newest `keep` are history, not live
+        telemetry. Runs on the same close path; Running/Paused ops are
+        never pruned (their watch streams are live)."""
+        if keep < 1:
+            return 0
+        with self.db.tx() as conn:
+            cur = conn.execute(
+                f"DELETE FROM {self.table} WHERE op_id NOT IN ("
+                f"SELECT id FROM operations "
+                f"ORDER BY created_at DESC, rowid DESC LIMIT ?) "
+                f"AND op_id NOT IN ("
+                f"SELECT id FROM operations "
+                f"WHERE status IN ('Running', 'Paused'))",
+                (int(keep),),
             )
             return max(cur.rowcount, 0)
 
@@ -857,6 +1030,7 @@ class Repositories:
         self.components = ComponentRepo(db)
         self.operations = OperationRepo(db)
         self.spans = SpanRepo(db)
+        self.metric_samples = MetricSampleRepo(db)
         self.cis_scans = CisScanRepo(db)
         self.settings = SettingRepo(db)
         self.slice_events = SliceEventRepo(db)

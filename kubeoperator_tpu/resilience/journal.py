@@ -38,10 +38,12 @@ from kubeoperator_tpu.models import Cluster, Operation, OperationStatus
 from kubeoperator_tpu.models.cluster import ClusterPhaseStatus
 from kubeoperator_tpu.models.span import Span, SpanKind, SpanStatus
 from kubeoperator_tpu.observability import (
+    EventKind,
     NullTracer,
     Tracer,
     bind_trace,
     clear_trace,
+    emit_event,
     new_trace_id,
 )
 from kubeoperator_tpu.utils.ids import now_ts
@@ -111,11 +113,23 @@ class OperationJournal:
     def __init__(self, repos, tracing: bool = True,
                  max_spans_per_op: int = 2000,
                  retain_operations: int = 200,
+                 events_enabled: bool = True,
+                 retain_events: int = 5000,
+                 max_samples_per_op: int = 512,
                  leases=None) -> None:
         self.repos = repos
         self.tracing = tracing
+        # the live-telemetry master switch (observability.events): off =
+        # the journal emits no bus events and workload runs record no
+        # samples — the pre-bus stack, bit-identical
+        self.events_enabled = events_enabled
         self.max_spans_per_op = max_spans_per_op
         self.retain_operations = retain_operations
+        # event-bus + metric-sample retention (observability.retain_events
+        # / observability.max_samples_per_op), applied on the same close
+        # path as span retention
+        self.retain_events = retain_events
+        self.max_samples_per_op = max_samples_per_op
         # fenced ownership (resilience/lease.py LeaseManager): None =
         # direct construction (tests, single-writer stacks) — unfenced,
         # bit-identical to the pre-lease journal
@@ -156,10 +170,43 @@ class OperationJournal:
         write lock, so a peer's CAS takeover (its own BEGIN IMMEDIATE)
         can never land between check and write. A bare _fence() before a
         separate save would be check-then-act — a fenced-out writer could
-        still clobber the successor's row in the gap."""
-        with self.repos.operations.db.tx():
-            self._fence(op, what)
-            yield
+        still clobber the successor's row in the gap.
+
+        A rejected write leaves a `fence.rejected` BUS event behind — in
+        its OWN transaction, after the guarded one rolled back: the
+        fenced-out writer must not emit the state-change event (same-tx
+        atomicity guarantees that), but the rejection itself is exactly
+        the telemetry an operator watching a takeover wants."""
+        from kubeoperator_tpu.resilience.lease import StaleEpochError
+
+        try:
+            with self.repos.operations.db.tx():
+                self._fence(op, what)
+                yield
+        except StaleEpochError as e:
+            try:
+                self._emit(op, EventKind.FENCE_REJECTED, type_="Warning",
+                           message=str(e), payload={"what": what,
+                                                    "epoch": e.epoch,
+                                                    "current": e.current})
+            except Exception:
+                log.exception("fence.rejected event write failed for "
+                              "op %s", op.id)
+            raise
+
+    # ---- event bus (observability/events.py is the one write funnel) ----
+    def _emit(self, op: Operation, kind: str, message: str = "",
+              payload: dict | None = None, type_: str = "Normal") -> None:
+        """One bus event carrying the op's correlation ids. Called inside
+        the transaction of the state change it describes (open/progress/
+        close/...), so event and state commit atomically."""
+        if not self.events_enabled:
+            return
+        emit_event(
+            self.repos, kind, cluster_id=op.cluster_id, op_id=op.id,
+            trace_id=op.trace_id, tenant=str(op.vars.get("tenant", "")),
+            type_=type_, reason=op.kind, message=message, payload=payload,
+        )
 
     def _release(self, op: Operation) -> None:
         """Expire our lease at operation close (CAS'd on our epoch, so a
@@ -200,6 +247,11 @@ class OperationJournal:
         with self.repos.operations.db.tx():
             self._claim(op)
             self.repos.operations.save(op)
+            # the op.open bus event commits WITH the Running row: an
+            # event-stream consumer can never see an op that has no
+            # open event, or vice versa
+            self._emit(op, EventKind.OP_OPEN, message=message or kind,
+                       payload={"kind": kind, "cluster": cluster.name})
         if self.tracing:
             # root span id == operation id, by contract: close/interrupt
             # (possibly in a different process after a crash+reboot) can
@@ -251,11 +303,13 @@ class OperationJournal:
             trace_id=(trace_id or new_trace_id()) if self.tracing else "",
         )
         # op-scope lease keyed by the op's own id (no single cluster owns
-        # it); claim + Running row in one transaction, same atomicity
-        # contract as open()
+        # it); claim + Running row + op.open event in one transaction,
+        # same atomicity contract as open()
         with self.repos.operations.db.tx():
             self._claim(op)
             self.repos.operations.save(op)
+            self._emit(op, EventKind.OP_OPEN, message=message or kind,
+                       payload={"kind": kind, "scope": scope})
         if self.tracing:
             self.repos.spans.save(Span(
                 id=op.id, trace_id=op.trace_id, parent_id=parent_span_id,
@@ -280,6 +334,8 @@ class OperationJournal:
             op.finished_at = 0.0
             op.message = message
             self.repos.operations.save(op)
+            self._emit(op, EventKind.OP_RESUME, message=message,
+                       payload={"kind": op.kind})
         if self.tracing and op.trace_id:
             try:
                 root = self.repos.spans.get(op.id)
@@ -322,9 +378,23 @@ class OperationJournal:
             tracer = Tracer(
                 self.repos.spans, trace_id=op.trace_id, op_id=op.id,
                 cluster_id=op.cluster_id, max_spans=self.max_spans_per_op,
+                samples_repo=self.repos.metric_samples,
+                max_samples=self.max_samples_per_op,
             )
             self._tracers[op.id] = tracer
         return tracer
+
+    def record_samples(self, op: Operation, samples: list) -> None:
+        """Persist per-step MetricSample rows under the op — the live
+        half of workload telemetry (`workload watch` reads them back by
+        rowid cursor while the run is still stepping). Ridden through
+        the op's tracer buffer and flushed immediately: one commit per
+        step boundary, spans included, NullTracer drops everything."""
+        if not self.events_enabled:
+            return
+        tracer = self.tracer_for(op)
+        tracer.record_samples(samples)
+        tracer.flush()
 
     def record_windows(self, op: Operation, windows: list,
                        name_prefix: str = "") -> None:
@@ -374,6 +444,10 @@ class OperationJournal:
             op.phase = phase_name
             op.phase_status = phase_status
             self.repos.operations.save(op)
+            self._emit(op, EventKind.OP_PHASE,
+                       message=f"{phase_name}: {phase_status}",
+                       payload={"phase": phase_name,
+                                "status": phase_status})
         # log correlation: every record the worker thread emits from here
         # on names the phase it was in (observability/logging.py)
         bind_trace(phase=phase_name)
@@ -392,14 +466,22 @@ class OperationJournal:
             }
             self.repos.operations.save(op)
 
-    def save_vars(self, op: Operation) -> None:
+    def save_vars(self, op: Operation, event: tuple | None = None) -> None:
         """Fenced raw op-row save for engines that keep resumable state in
         `op.vars` (the fleet wave scheduler persists its whole wave ledger
         this way at every cluster boundary) — same epoch fence as every
         other journal write, so a fenced-out engine cannot clobber the
-        state a successor is resuming from."""
+        state a successor is resuming from.
+
+        `event` — an optional `(kind, message, payload)` bus event that
+        commits IN THE SAME transaction as the vars save: how the queue's
+        state transitions (submit/place/preempt/drain/resume) land
+        atomically with the durable queue state they describe."""
         with self._fenced(op, "op vars save"):
             self.repos.operations.save(op)
+            if event is not None:
+                kind, message, payload = event
+                self._emit(op, kind, message=message, payload=payload)
 
     def attach(self, op: Operation, ctx) -> None:
         """Wire an AdmContext's phase hook to this op's progress record and
@@ -436,9 +518,13 @@ class OperationJournal:
             op.message = message
             op.finished_at = now_ts()
             self.repos.operations.save(op)
+            self._emit(op, EventKind.OP_CLOSE, message=message,
+                       type_="Normal" if ok else "Warning",
+                       payload={"kind": op.kind, "status": op.status})
         self._release(op)
         self._finish_root(op, SpanStatus.OK if ok else SpanStatus.FAILED,
                           message)
+        self._prune_telemetry()
         # unbind the log context bound at attach: close() runs on the
         # thread that ran the op (incl. wait=True callers like the
         # watchdog's cron thread and aiohttp's run_sync pool), and a
@@ -456,8 +542,17 @@ class OperationJournal:
         op.resume_phase = resume_phase
         op.message = message or "controller died while this operation ran"
         op.finished_at = now_ts()
-        self.repos.operations.save(op)
+        # deliberately unfenced, like the save (module docstring) — but
+        # still one transaction: verdict row + op.interrupt event commit
+        # together
+        with self.repos.operations.db.tx():
+            self.repos.operations.save(op)
+            self._emit(op, EventKind.OP_INTERRUPT, type_="Warning",
+                       message=op.message,
+                       payload={"kind": op.kind,
+                                "resume_phase": resume_phase})
         self._finish_root(op, SpanStatus.FAILED, op.message)
+        self._prune_telemetry()
         log.warning("operation %s (%s on %s) marked interrupted; resume at %r",
                     op.id, op.kind, op.cluster_name, resume_phase)
         clear_trace()   # same thread-reuse hygiene as close()
@@ -488,6 +583,18 @@ class OperationJournal:
             self.repos.spans.prune_to_operations(self.retain_operations)
         except Exception:
             log.exception("root span close failed for op %s", op.id)
+
+    def _prune_telemetry(self) -> None:
+        """Event-bus + metric-sample retention, on the same close path as
+        span retention (and independent of the tracing knob — events
+        emit whether or not spans do). Best-effort like every telemetry
+        write."""
+        try:
+            self.repos.events.prune(self.retain_events)
+            self.repos.metric_samples.prune_to_operations(
+                self.retain_operations)
+        except Exception:
+            log.exception("telemetry retention prune failed")
 
     # ---- queries ----
     def open_ops(self, cluster_id: str | None = None) -> list[Operation]:

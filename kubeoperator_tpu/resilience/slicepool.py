@@ -87,6 +87,9 @@ class SlicePool:
     def __init__(self, repos, config) -> None:
         self.repos = repos
         self.cfg = SlicePoolConfig.from_config(config)
+        # live-telemetry master switch: off = ledger rows only, no bus
+        # events (matches the journal's observability.events posture)
+        self.bus_events = bool(config.get("observability.events", True))
 
     @property
     def enabled(self) -> bool:
@@ -103,7 +106,26 @@ class SlicePool:
             op_id=getattr(op, "id", "") or "", detail=detail[:500],
         )
         event.validate()
-        self.repos.slice_events.save(event)
+        # ledger row + its bus event in ONE transaction (the same-tx
+        # contract every state-transition writer holds): a consumer of
+        # the event stream can never see an incident the ledger lacks
+        from kubeoperator_tpu.observability import emit_event
+
+        if not self.bus_events:
+            self.repos.slice_events.save(event)
+            return event
+        with self.repos.db.tx():
+            self.repos.slice_events.save(event)
+            emit_event(
+                self.repos, f"slice.{kind}", cluster_id=cluster.id,
+                op_id=event.op_id,
+                type_="Warning" if kind in ("detected", "notice")
+                else "Normal",
+                reason=f"Slice{kind.capitalize()}",
+                message=f"slice {slice_id} of {cluster.name}: {kind}"
+                        + (f" — {detail[:200]}" if detail else ""),
+                payload={"slice_id": int(slice_id), "ledger": kind,
+                         "cluster": cluster.name})
         return event
 
     def history(self, cluster_id: str, limit: int = 100) -> list:

@@ -104,6 +104,11 @@ class Services:
                 config.get("observability.max_spans_per_op", 2000)),
             retain_operations=int(
                 config.get("observability.retain_operations", 200)),
+            events_enabled=bool(config.get("observability.events", True)),
+            retain_events=int(
+                config.get("observability.retain_events", 5000)),
+            max_samples_per_op=int(
+                config.get("observability.max_samples_per_op", 512)),
             leases=self.leases,
         )
         # ONE slice pool (slicepool.* config block): the per-slice incident

@@ -27,10 +27,19 @@ class EventService:
         self.repos = repos
         self._subscribers: list[Callable[[Event], None]] = []
 
-    def emit(self, cluster_id: str, type_: str, reason: str, message: str) -> Event:
-        event = Event(cluster_id=cluster_id, type=type_, reason=reason,
-                      message=message)
-        self.repos.events.save(event)
+    def emit(self, cluster_id: str, type_: str, reason: str, message: str,
+             kind: str = "", payload: dict | None = None) -> Event:
+        """Raise one cluster event. Every row rides the durable event bus
+        (observability/events.py emit_event — the KO-P012 funnel);
+        `kind` names the bus stream for structured producers (watchdog
+        escalations pass theirs), defaulting to the legacy timeline
+        stream."""
+        from kubeoperator_tpu.observability import EventKind, emit_event
+
+        event = emit_event(
+            self.repos, kind or EventKind.CLUSTER_EVENT,
+            cluster_id=cluster_id, type_=type_, reason=reason,
+            message=message, payload=payload)
         log.info("event %s/%s: %s", type_, reason, message)
         for sub in self._subscribers:
             try:
@@ -43,7 +52,10 @@ class EventService:
         self._subscribers.append(fn)
 
     def list(self, cluster_id: str) -> list[Event]:
-        return self.repos.events.find(cluster_id=cluster_id)
+        # the TIMELINE subset (repo TIMELINE_WHERE): journal-path bus
+        # rows (op.*/queue.*/...) stay on the stream surface, the
+        # cluster timeline keeps its pre-bus human signal
+        return self.repos.events.timeline(cluster_id)
 
     # dedup horizon: a warning that recurs after being quiet this long is a
     # NEW incident and must re-notify (permanent (reason, message) dedup

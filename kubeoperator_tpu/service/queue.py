@@ -45,6 +45,7 @@ from kubeoperator_tpu.utils.errors import (
     NotFoundError,
     ValidationError,
 )
+from kubeoperator_tpu.observability import EventKind
 from kubeoperator_tpu.utils.ids import now_ts
 from kubeoperator_tpu.utils.logging import get_logger
 from kubeoperator_tpu.workloads.queue import (
@@ -166,6 +167,7 @@ class WorkloadQueueService:
 
         op = self.journal.open_scoped(
             QUEUE_ENTRY_KIND,
+            vars={"tenant": tenant} if tenant else None,
             message=(f"queued {kind} ({priority}"
                      + (f", tenant {tenant}" if tenant else "")
                      + f", {devices} device(s))"),
@@ -176,7 +178,11 @@ class WorkloadQueueService:
             steps=steps, mode=mode, devices=devices)
         entry.validate()
         self.repos.workload_queue.save(entry)
-        self._sync_op(entry, op=op)
+        self._sync_op(entry, op=op, event=(
+            EventKind.QUEUE_SUBMIT,
+            f"{kind} submitted at {priority}",
+            {"state": entry.state, "priority": priority,
+             "devices": devices}))
         log.info("workload %s queued: %s %s priority=%s tenant=%s "
                  "devices=%d", entry.id[:8], kind, mesh or "(default)",
                  priority, tenant or "-", devices)
@@ -267,7 +273,11 @@ class WorkloadQueueService:
                 entry.slices_needed = len(placement)
                 entry.state = "placed"
                 self.repos.workload_queue.save(entry)
-                self._sync_op(entry)
+                self._sync_op(entry, event=(
+                    EventKind.QUEUE_PLACE,
+                    f"placed on {'+'.join(placement)}",
+                    {"state": entry.state,
+                     "placement": list(placement)}))
                 placed_ids.append(entry.id)
             head = next((e for e in pending
                          if e.id not in decision.placements), None)
@@ -320,7 +330,11 @@ class WorkloadQueueService:
                 return
             victim.preempted_by = by_id
             self.repos.workload_queue.save(victim)
-            self._sync_op(victim)
+            self._sync_op(victim, event=(
+                EventKind.QUEUE_PREEMPT,
+                f"preemption requested by {by_id[:8]}",
+                {"state": victim.state, "by": by_id,
+                 "mode": "drain"}))
             self.workloads.request_drain(
                 f"preempted by workload {by_id[:8]} "
                 f"({by.priority_class})" if by is not None
@@ -334,7 +348,11 @@ class WorkloadQueueService:
                 "kind": "displaced", "by": by_id, "at": now_ts(),
             }]
             self.repos.workload_queue.save(victim)
-            self._sync_op(victim)
+            self._sync_op(victim, event=(
+                EventKind.QUEUE_PREEMPT,
+                f"displaced by {by_id[:8]} before it started",
+                {"state": victim.state, "by": by_id,
+                 "mode": "displaced"}))
             log.info("workload %s displaced by %s before it started",
                      victim.id[:8], by_id[:8])
 
@@ -499,12 +517,21 @@ class WorkloadQueueService:
             return
         entry.state = "drained"
         self.repos.workload_queue.save(entry)
-        self._sync_op(entry, op=op)
+        self._sync_op(entry, op=op, event=(
+            EventKind.QUEUE_DRAIN,
+            f"drained at step {result.get('end_step')}"
+            + (f" (checkpoint {ckpt[:8]})" if ckpt else ""),
+            {"state": entry.state, "step": result.get("end_step"),
+             "by": record["by"], "checkpoint": ckpt}))
         # straight back into the queue: the checkpoint carries the state,
         # the scheduler re-places it when capacity returns
         entry.state = "pending"
         self.repos.workload_queue.save(entry)
-        self._sync_op(entry, op=op)
+        self._sync_op(entry, op=op, event=(
+            EventKind.QUEUE_RESUME,
+            "re-queued; resumes from its checkpoint when capacity "
+            "returns",
+            {"state": entry.state, "checkpoint": entry.checkpoint}))
         log.info("workload %s drained at step %s (checkpoint %s); "
                  "re-queued", entry.id[:8], result.get("end_step"),
                  ckpt[:8] if ckpt else "-")
@@ -560,7 +587,10 @@ class WorkloadQueueService:
             entry.placement = []
             entry.preempted_by = ""
             self.repos.workload_queue.save(entry)
-            self._sync_op(entry, op=op)
+            self._sync_op(entry, op=op, event=(
+                EventKind.QUEUE_RESUME,
+                "re-queued after controller restart",
+                {"state": entry.state, "checkpoint": entry.checkpoint}))
             requeued.append(entry.id)
             log.info("queue entry %s (%s) re-queued after interruption",
                      entry.id[:8], entry.kind)
@@ -647,11 +677,15 @@ class WorkloadQueueService:
         return {"capacity": self.capacity(), "entries": self.entries()}
 
     # ----------------------------------------------------------- plumbing ---
-    def _sync_op(self, entry: QueueEntry, op=None) -> None:
+    def _sync_op(self, entry: QueueEntry, op=None,
+                 event: tuple | None = None) -> None:
         """Mirror the entry's scheduler-visible state into its journal
         op's vars — the durable half of the queue contract (fenced like
         every journal write, so a fenced-out scheduler cannot clobber a
-        successor's queue state)."""
+        successor's queue state). `event` — an optional `(kind, message,
+        payload)` bus event committing in the SAME fenced transaction as
+        the vars save, so the event stream can never disagree with the
+        durable queue state it narrates."""
         if op is None:
             op = self.repos.operations.get(entry.op_id)
         op.vars["entry"] = {
@@ -669,7 +703,7 @@ class WorkloadQueueService:
             "run_ops": list(entry.run_ops),
             "cancel_requested": entry.cancel_requested,
         }
-        self.journal.save_vars(op)
+        self.journal.save_vars(op, event=event)
 
     def _finish(self, entry: QueueEntry, state: str,
                 message: str = "") -> None:
@@ -679,7 +713,9 @@ class WorkloadQueueService:
         entry.cancel_requested = False
         self.repos.workload_queue.save(entry)
         op = self.repos.operations.get(entry.op_id)
-        self._sync_op(entry, op=op)
+        self._sync_op(entry, op=op, event=(
+            EventKind.QUEUE_DONE, message or state,
+            {"state": state}))
         if op.open:
             self.journal.close(op, ok=(state == "done"),
                                message=message or state)

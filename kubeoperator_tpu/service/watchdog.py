@@ -152,12 +152,17 @@ class WatchdogService:
                 # exactly ONE escalation per open circuit: the Warning
                 # event rides the message-center fan-out to admins
                 row.vars["escalated"] = True
+                from kubeoperator_tpu.observability import EventKind
+
                 self.events.emit(
                     cluster.id, "Warning", "WatchdogCircuitOpen",
                     f"watchdog circuit OPEN for {cluster.name}: "
                     f"{breaker.state['opened_reason']}; automatic "
                     f"remediation stopped — investigate, then "
                     f"`koctl watchdog reset {cluster.name}`",
+                    kind=EventKind.WATCHDOG_ESCALATE,
+                    payload={"cluster": cluster.name,
+                             "reason": breaker.state["opened_reason"]},
                 )
                 actions.append(f"watchdog-open:{cluster.name}")
             self._save(row)
@@ -246,10 +251,15 @@ class WatchdogService:
             return True, ""
         except Exception as e:
             kind = classify_remediation_error(e)
+            from kubeoperator_tpu.observability import EventKind
+
             self.events.emit(
                 cluster.id, "Warning", "WatchdogRemediationFailed",
                 f"automatic recovery of probe {probe.name} on "
                 f"{cluster.name} failed ({kind.lower()}): {e}",
+                kind=EventKind.WATCHDOG_REMEDIATION,
+                payload={"cluster": cluster.name, "probe": probe.name,
+                         "classification": kind},
             )
             return False, kind
 

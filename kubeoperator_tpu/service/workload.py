@@ -54,6 +54,54 @@ WORKLOAD_TRAIN_KIND = "workload-train"
 WORKLOAD_SWEEP_KIND = "workload-sweep"
 
 
+class _StepSampler:
+    """Per-step training telemetry producer riding the `on_step` seam
+    (workloads/harness.py run_training): measures the wall-clock between
+    step boundaries, fetches the device loss, and lands ONE MetricSample
+    per step through the op's tracer — samples flush with the span
+    buffer, one commit per boundary, so `koctl workload watch` reads a
+    live tail while the run is still stepping. The loss fetch blocks the
+    step loop by design (the harness documents that hook cost rides the
+    timed window); the tier-1 overhead budget pins the whole layer under
+    5%. A NullTracer (tracing off) never constructs one of these."""
+
+    def __init__(self, journal, op, *, flops_per_step: float,
+                 peak_tflops_per_chip: float | None, devices: int,
+                 tenant: str = "", base_step: int = 0) -> None:
+        self.journal = journal
+        self.op = op
+        self.flops = float(flops_per_step)
+        self.peak = peak_tflops_per_chip or 0.0
+        self.devices = max(int(devices), 1)
+        self.tenant = tenant
+        self.base_step = int(base_step)
+        self._last: float | None = None
+
+    def __call__(self, completed: int, loss) -> None:
+        import jax
+
+        from kubeoperator_tpu.models import MetricSample
+
+        now = time.perf_counter()
+        # the first boundary follows the compile, not a step — its
+        # wall-clock is not a step time, so it reports 0 (unknown)
+        step_s = (now - self._last) if self._last is not None else 0.0
+        self._last = now
+        steps_per_s = round(1.0 / step_s, 3) if step_s > 0 else 0.0
+        tflops = (round(self.flops * steps_per_s / 1e12, 4)
+                  if steps_per_s else 0.0)
+        mfu = (round(100.0 * tflops / (self.peak * self.devices), 3)
+               if self.peak and tflops else 0.0)
+        self.journal.record_samples(self.op, [MetricSample(
+            op_id=self.op.id, step=self.base_step + int(completed),
+            kind="step", tenant=self.tenant,
+            loss=float(jax.device_get(loss)),
+            step_s=round(step_s, 6), steps_per_s=steps_per_s,
+            tflops=tflops, mfu_pct=mfu,
+        )])
+
+
+
 def train_kwargs(body: dict) -> dict:
     """The body→`WorkloadService.train` translation BOTH transports share
     (REST handler and `LocalClient._dispatch`) — the behavioral half of
@@ -302,6 +350,14 @@ class WorkloadService:
         log.info("workload op %s: mesh %s, %d steps, mode %s%s",
                  op.id, spec, steps, mode,
                  f", resuming {ckpt_row.id[:8]}" if resume else "")
+        # log + event correlation for the whole run: every record this
+        # thread emits (and every bus event stamped from the bound
+        # context) names the tenant and the workload op — journal.close
+        # clears the binding with the rest of the trace context
+        from kubeoperator_tpu.observability import bind_trace
+
+        bind_trace(trace_id=op.trace_id or None, op_id=op.id,
+                   workload_op=op.id, tenant=tenant or None)
         self._drain.clear()
         try:
             mesh_obj = spec.build(devices[: spec.total_devices])
@@ -349,8 +405,28 @@ class WorkloadService:
                               "bytes": saved["bytes"]},
                 }])
 
+            # per-step telemetry (docs/observability.md "Events and live
+            # telemetry"): one MetricSample per step boundary through the
+            # op's tracer — only when tracing is on (the NullTracer path
+            # must not pay a device_get per step)
+            from kubeoperator_tpu.workloads.step import analytic_step_flops
+
+            sampler = (_StepSampler(
+                self.journal, op,
+                flops_per_step=analytic_step_flops(mesh_obj),
+                peak_tflops_per_chip=peak,
+                devices=spec.total_devices, tenant=tenant,
+                base_step=(ckpt_row.step if resume else 0),
+            ) if (self.journal.events_enabled
+                  and self.journal.tracer_for(op).enabled) else None)
+
+            def on_step(completed: int, loss) -> bool:
+                if sampler is not None:
+                    sampler(completed, loss)
+                return self._on_step(completed, loss)
+
             run = run_training(mesh_obj, steps=steps, mode=mode, seed=seed,
-                               state=state, on_step=self._on_step,
+                               state=state, on_step=on_step,
                                return_state=True,
                                checkpoint_every=self.ckpt_every,
                                on_checkpoint=(periodic_save
@@ -447,6 +523,10 @@ class WorkloadService:
             WORKLOAD_SWEEP_KIND, vars=op_vars,
             message=f"scaling-efficiency sweep ({steps} steps per mesh)",
             scope="workload", trace=trace, parent_op_id=parent_op_id)
+        from kubeoperator_tpu.observability import bind_trace
+
+        bind_trace(trace_id=op.trace_id or None, op_id=op.id,
+                   workload_op=op.id, tenant=tenant or None)
         t0 = time.time()
         try:
             report = run_sweep(steps=steps, peak_tflops_per_chip=(
@@ -559,6 +639,14 @@ class WorkloadService:
         row.validate()
         self.repos.checkpoints.save(row)
         self._prune_checkpoints(keep_id=row.id, tenant=tenant)
+        # checkpoint-save marker in the metric stream: `workload watch`
+        # shows saves inline with the loss tail (NullTracer drops it)
+        from kubeoperator_tpu.models import MetricSample
+
+        self.journal.record_samples(op, [MetricSample(
+            op_id=op.id, step=step, kind="checkpoint", tenant=tenant,
+            attrs={"checkpoint": row.id, "bytes": row.total_bytes},
+        )])
         return {"id": row.id, "step": row.step,
                 "target_steps": target_steps, "dir": row.dir,
                 "bytes": row.total_bytes}
@@ -693,6 +781,29 @@ class WorkloadService:
 
     def status(self, op_ref: str = "") -> dict:
         return self.describe(self.resolve(op_ref))
+
+    def metrics(self, op_ref: str = "", after: int = 0) -> dict:
+        """The op's per-step telemetry tail past cursor `after` (sqlite
+        rowid, same contract as the event stream) — the data source for
+        `GET /workloads/operations/{op}/metrics` and `koctl workload
+        watch`. `live` says whether more samples may still arrive."""
+        op = self.resolve(op_ref)
+        rows, cursor = self.repos.metric_samples.since(op.id, int(after))
+        return {
+            "operation": op.id,
+            "kind": op.kind,
+            "status": op.status,
+            "tenant": op.vars.get("tenant", ""),
+            "cursor": cursor,
+            "live": op.open,
+            "samples": [{
+                "id": rowid, "step": s.step, "kind": s.kind,
+                "loss": s.loss, "step_s": s.step_s,
+                "steps_per_s": s.steps_per_s, "tflops": s.tflops,
+                "mfu_pct": s.mfu_pct, "attrs": dict(s.attrs),
+                "ts": s.created_at,
+            } for rowid, s in rows],
+        }
 
     def trace(self, op_ref: str = "") -> dict:
         """The workload op's span tree: operation root → step windows —

@@ -344,6 +344,18 @@ DEFAULTS: dict[str, Any] = {
         # span retention: keep the trees of the newest N journal
         # operations, prune the rest at operation close
         "retain_operations": 200,
+        # live telemetry master switch: journal/queue/fleet/slice bus
+        # events AND per-step metric samples (legacy cluster-timeline
+        # rows keep writing either way — they predate the bus). The
+        # tier-1 overhead budget pins on-vs-off under 5%.
+        "events": True,
+        # durable event bus (observability/events.py, migration 013):
+        # keep the newest N bus rows — rowids only grow, so a pruned
+        # stream's `Last-Event-ID` cursors stay valid
+        "retain_events": 5000,
+        # per-op metric-sample RING bound (newest rows win): the live
+        # telemetry a long train's `workload watch` tails
+        "max_samples_per_op": 512,
         # structured JSON log records (one object per line, carrying
         # trace_id/op_id/cluster/phase) instead of the human text format
         "json_logs": False,

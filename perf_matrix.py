@@ -509,6 +509,38 @@ def write_artifacts(results: dict, round_no: int,
                 f"{row['dispatch_per_s']} | {row['mean_wait_s']} | "
                 f"{row['preempt_round_trip_s']} | "
                 f"{'yes' if row['ok'] else 'NO'} |")
+    # live-telemetry rows (`perf_matrix.py --events`,
+    # docs/observability.md "Events and live telemetry"): rendered from
+    # the newest round like the other single-section harnesses
+    events_rounds = history.get("events") or {}
+    if events_rounds:
+        ev_round = str(max(int(k) for k in events_rounds))
+        lines += [
+            "",
+            f"## events (round {ev_round})",
+            "",
+            "Live-telemetry layer (`python perf_matrix.py --events`): "
+            "the same 3-node simulated create timed with",
+            "`observability.events` on vs off (the bus's whole cost on "
+            "the hottest journaled path), and the follow-stream",
+            "fanout — N reader stacks tailing ONE WAL file's event "
+            "stream with the SSE endpoint's rowid-cursor read while a",
+            "writer replica drives creates (every reader must drain the "
+            "identical stream).",
+            "",
+            "| create, events on (s) | events off (s) | overhead | "
+            "bus rows/create | readers | stream rows | "
+            "fanout rows/s | ok |",
+            "|---|---|---|---|---|---|---|---|",
+        ]
+        for row in events_rounds[ev_round].get("rows", []):
+            lines.append(
+                f"| {row['events_on_create_s']} | "
+                f"{row['events_off_create_s']} | "
+                f"{row['overhead_pct']}% | "
+                f"{row['event_rows_per_create']} | {row['readers']} | "
+                f"{row['stream_rows']} | {row['fanout_rows_per_s']} | "
+                f"{'yes' if row['ok'] else 'NO'} |")
     # fleet wave-throughput rows (`perf_matrix.py --fleet`,
     # docs/resilience.md "Fleet operations"): rendered from the newest
     # round like the other single-section harnesses
@@ -930,6 +962,138 @@ def record_fleet(report: dict, round_no: int | None = None) -> int:
     return _record_section("fleet", report, round_no)
 
 
+def run_events(readers: int = 4, fanout_creates: int = 3) -> dict:
+    """The CI face of the live-telemetry layer (ISSUE 14): two measured
+    phases committed as a PERF "events" row.
+
+    1. Event-write overhead — the same 3-node simulated create timed
+       with `observability.events` on vs off (best-of-2 per mode, small
+       per-task pacing so stable sleeps dominate): the bus's whole cost
+       on the hottest journaled path, as a percentage.
+    2. Follow-stream fanout — the loadtest ReplicaPool topology (N+1
+       full stacks over ONE WAL file): replica 0 drives simulated
+       creates while N reader threads, each on its OWN replica's
+       Database handle, tail the event stream with the same
+       `EventRepo.since` rowid-cursor read the SSE endpoint serves —
+       real WAL read concurrency under a live writer. Every reader must
+       drain the same final stream (nothing lost, nothing duplicated);
+       the row reports aggregate delivered rows/s."""
+    import tempfile
+    import threading
+    import time as _time
+
+    from kubeoperator_tpu.cli.loadtest import ReplicaPool, _host_ip
+    from kubeoperator_tpu.models import ClusterSpec, Credential
+    from kubeoperator_tpu.service import build_services
+    from kubeoperator_tpu.utils.config import load_config
+
+    def timed_create(base: str, tag: str, events_on: bool) -> tuple:
+        config = load_config(path="/nonexistent", env={}, overrides={
+            "db": {"path": os.path.join(base, f"{tag}.db")},
+            "logging": {"level": "ERROR"},
+            "executor": {"backend": "simulation"},
+            "provisioner": {"work_dir": os.path.join(base, f"tf-{tag}")},
+            "cron": {"backup_enabled": False,
+                     "health_check_interval_s": 0,
+                     "event_sync_interval_s": 0},
+            "cluster": {"kubeconfig_dir": os.path.join(base, f"kc-{tag}")},
+            "observability": {"events": events_on},
+        })
+        svc = build_services(config, simulate=True)
+        try:
+            svc.executor.task_delay_s = 0.004
+            svc.credentials.create(Credential(name=f"c{tag}",
+                                              password="pw"))
+            for i in range(3):
+                svc.hosts.register(f"h{tag}{i}", _host_ip(i + 1), f"c{tag}")
+            t0 = _time.perf_counter()
+            cluster = svc.clusters.create(
+                f"ev-{tag}", spec=ClusterSpec(worker_count=2),
+                host_names=[f"h{tag}{i}" for i in range(3)], wait=True)
+            elapsed = _time.perf_counter() - t0
+            ready = cluster.status.phase == "Ready"
+            rows, _cursor = svc.repos.events.since(0, limit=5000)
+            # journal-path bus rows only: legacy cluster-timeline rows
+            # (kind cluster.event) write whether or not the bus is on
+            bus = len([1 for _r, e in rows
+                       if e.kind and e.kind != "cluster.event"])
+        finally:
+            svc.close()
+        return elapsed, bus, ready
+
+    ok = True
+    with tempfile.TemporaryDirectory(prefix="ko-events-perf-") as base:
+        on_runs = [timed_create(base, f"on{i}", True) for i in range(2)]
+        off_runs = [timed_create(base, f"off{i}", False) for i in range(2)]
+        ok = ok and all(r[2] for r in on_runs + off_runs)
+        # events-off stacks must emit NO bus-kind rows at all
+        ok = ok and all(r[1] == 0 for r in off_runs)
+        on_s = min(r[0] for r in on_runs)
+        off_s = min(r[0] for r in off_runs)
+        event_rows = max(r[1] for r in on_runs)
+
+        # ---- phase 2: follow-stream fanout over one WAL file ----------
+        pool_dir = os.path.join(base, "pool")
+        os.makedirs(pool_dir, exist_ok=True)
+        pool = ReplicaPool(pool_dir, readers + 1, lease_ttl_s=5.0)
+        counts = [0] * readers
+        stop = threading.Event()
+
+        def tail(idx: int) -> None:
+            cursor = 0
+            repo = pool[idx + 1].repos.events
+            while True:
+                rows, cursor = repo.since(cursor, limit=1000)
+                counts[idx] += len(rows)
+                if not rows and stop.is_set():
+                    return
+                if not rows:
+                    _time.sleep(0.01)
+
+        threads = [threading.Thread(target=tail, args=(i,), daemon=True)
+                   for i in range(readers)]
+        for t in threads:
+            t.start()
+        writer = pool[0]
+        writer.credentials.create(Credential(name="ev-fan",
+                                             password="pw"))
+        for i in range(fanout_creates):
+            writer.hosts.register(f"fan{i}", _host_ip(100 + i), "ev-fan")
+        t0 = _time.perf_counter()
+        for i in range(fanout_creates):
+            writer.clusters.create(f"fan-{i}",
+                                   spec=ClusterSpec(worker_count=0),
+                                   host_names=[f"fan{i}"], wait=True)
+        stop.set()
+        for t in threads:
+            t.join(30.0)
+        fan_wall = _time.perf_counter() - t0
+        total, _ = writer.repos.events.since(0, limit=5000)
+        stream_rows = len(total)
+        # every reader drained the same stream — nothing lost, nothing
+        # duplicated by the cursor contract
+        ok = ok and all(c == stream_rows for c in counts)
+        pool.close()
+    overhead = ((on_s - off_s) / off_s * 100.0) if off_s > 0 else 0.0
+    row = {
+        "events_on_create_s": round(on_s, 3),
+        "events_off_create_s": round(off_s, 3),
+        "overhead_pct": round(overhead, 1),
+        "event_rows_per_create": event_rows,
+        "readers": readers,
+        "stream_rows": stream_rows,
+        "fanout_rows_per_s": round(stream_rows * readers / fan_wall, 1)
+        if fan_wall > 0 else 0.0,
+        "ok": ok,
+    }
+    return {"ok": ok, "rows": [row]}
+
+
+def record_events(report: dict, round_no: int | None = None) -> int:
+    """`perf_matrix.py --events` hook."""
+    return _record_section("events", report, round_no)
+
+
 def record_loadtest(rows: dict, round_no: int | None = None) -> int:
     """`koctl loadtest --record-perf` hook (rows keyed by replica
     count)."""
@@ -961,6 +1125,13 @@ def main(argv: list | None = None) -> int:
                              "pass (admission + dispatch + preemption "
                              "round trip over a 2x4-chip virtual pool) "
                              "and record its row under the round")
+    parser.add_argument("--events", action="store_true",
+                        help="run ONLY the live-telemetry pass "
+                             "(event-write overhead on a simulated "
+                             "create, events on vs off, plus N "
+                             "concurrent follow-stream readers over one "
+                             "WAL file) and record its row under the "
+                             "round")
     parser.add_argument("--fleet", action="store_true",
                         help="run ONLY the paced serial-vs-concurrent "
                              "fleet wave benchmark (one wave of "
@@ -968,6 +1139,12 @@ def main(argv: list | None = None) -> int:
                              "compared) and record its row under the "
                              "round")
     args = parser.parse_args(argv)
+    if args.events:
+        report = run_events()
+        round_no = record_events(report, args.round)
+        print(json.dumps({"round": round_no, "events": report},
+                         indent=2))
+        return 0 if report["ok"] else 1
     if args.fleet:
         report = run_fleet()
         round_no = record_fleet(report, args.round)

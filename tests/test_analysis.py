@@ -1222,6 +1222,57 @@ class TestAtomicWriteDiscipline:  # KO-P011
         assert check_checkpoint_atomic_writes(root, tree, path) == []
 
 
+class TestEventDiscipline:  # KO-P012
+    def test_fires_on_adhoc_event_save_in_service(self, tmp_path):
+        src = (
+            "def emit(self, ev):\n"
+            "    self.repos.events.save(ev)\n"
+        )
+        findings = ast_findings(tmp_path, src, "KO-P012",
+                                rel="service/x.py")
+        assert [f.rule for f in findings] == ["KO-P012"]
+        assert "emit_event" in findings[0].message
+
+    def test_fires_on_save_many_and_bare_name(self, tmp_path):
+        src = (
+            "def flush(repos, batch):\n"
+            "    repos.events.save_many(batch)\n"
+            "def sneak(events, ev):\n"
+            "    events.save(ev)\n"
+        )
+        findings = ast_findings(tmp_path, src, "KO-P012",
+                                rel="resilience/journal.py")
+        assert [f.rule for f in findings] == ["KO-P012", "KO-P012"]
+
+    def test_quiet_in_the_funnel_module_and_for_other_repos(
+            self, tmp_path):
+        funnel = (
+            "def emit_event(repos, kind):\n"
+            "    repos.events.save(kind)\n"
+        )
+        assert ast_findings(tmp_path, funnel, "KO-P012",
+                            rel="observability/events.py") == []
+        other = (
+            "def note(self, row):\n"
+            "    self.repos.slice_events.save(row)\n"
+            "    self.repos.operations.save(row)\n"
+            "def route(self, repos, kind):\n"
+            "    from x import emit_event\n"
+            "    emit_event(repos, kind)\n"
+        )
+        assert ast_findings(tmp_path, other, "KO-P012",
+                            rel="service/x.py") == []
+
+    def test_real_tree_has_one_sanctioned_writer(self):
+        """The shipped package satisfies its own funnel contract: every
+        `.events.save` call lives in observability/events.py."""
+        import kubeoperator_tpu
+
+        root = os.path.dirname(kubeoperator_tpu.__file__)
+        findings, _scanned = run_ast_rules(root, {"KO-P012"})
+        assert findings == [], [f"{f.file}:{f.line}" for f in findings]
+
+
 # ------------------------------------------------------- contract rules ----
 def index_for(tmp_path, files: dict):
     """Build a ProjectIndex over a fixture tree (the injection path the

@@ -1,0 +1,566 @@
+"""Live platform telemetry (ISSUE 14, docs/observability.md "Events and
+live telemetry"): the durable event bus (same-transaction emission, rowid
+cursors, retention), per-step metric samples (ring bound, live tail), the
+SSE wire format of `GET /api/v1/events` (id/event/data framing, keep-alive
+comments, `Last-Event-ID` replay, filter params), and the workload metrics
+surface behind `koctl workload watch`.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import pytest
+import requests
+
+from kubeoperator_tpu.models import Event, MetricSample, Operation
+from kubeoperator_tpu.observability import (
+    EventKind,
+    bind_trace,
+    clear_trace,
+    emit_event,
+    queue_story,
+)
+from kubeoperator_tpu.repository import Database, Repositories
+from kubeoperator_tpu.service import build_services
+from kubeoperator_tpu.utils.config import load_config
+
+
+@pytest.fixture()
+def repos(tmp_path):
+    db = Database(str(tmp_path / "bus.db"))
+    yield Repositories(db)
+    db.close()
+
+
+def _services(tmp_path, **extra):
+    overrides = {
+        "db": {"path": str(tmp_path / "events.db")},
+        "logging": {"level": "WARNING"},
+        "executor": {"backend": "fake"},
+        "provisioner": {"work_dir": str(tmp_path / "tf")},
+        "cron": {"backup_enabled": False, "health_check_interval_s": 0,
+                 "event_sync_interval_s": 0},
+        "cluster": {"kubeconfig_dir": str(tmp_path / "kc")},
+    }
+    for key, value in extra.items():
+        overrides.setdefault(key, {}).update(value)
+    config = load_config(path="/nonexistent", env={}, overrides=overrides)
+    return build_services(config, simulate=True)
+
+
+# ======================================================================
+# the bus: emit funnel, cursors, retention
+# ======================================================================
+class TestEventBus:
+    def test_emit_stamps_bound_context(self, repos):
+        """Correlation ids not passed explicitly come from the calling
+        thread's log context — how a dispatched tenant run's events
+        carry tenant/op without threading them through every site."""
+        bind_trace(trace_id="t-1", tenant="alice", workload_op="op-9")
+        try:
+            event = emit_event(repos, EventKind.QUEUE_SUBMIT,
+                               message="queued")
+        finally:
+            clear_trace()
+        row = repos.events.get(event.id)
+        assert row.kind == "queue.submit"
+        assert row.tenant == "alice"
+        assert row.op_id == "op-9"
+        assert row.trace_id == "t-1"
+        # explicit args always win over the bound context
+        explicit = emit_event(repos, EventKind.OP_CLOSE, op_id="op-x",
+                              tenant="bob")
+        assert repos.events.get(explicit.id).op_id == "op-x"
+
+    def test_since_cursor_and_filters(self, repos):
+        for kind, tenant in ((EventKind.QUEUE_SUBMIT, "alice"),
+                             (EventKind.QUEUE_PLACE, "alice"),
+                             (EventKind.OP_OPEN, ""),
+                             (EventKind.QUEUE_DONE, "bob")):
+            emit_event(repos, kind, tenant=tenant, cluster_id="c1")
+        rows, cursor = repos.events.since(0)
+        assert [e.kind for _r, e in rows] == [
+            "queue.submit", "queue.place", "op.open", "queue.done"]
+        # rowids strictly grow — the stream order IS the cursor order
+        rowids = [r for r, _e in rows]
+        assert rowids == sorted(rowids)
+        assert cursor == rowids[-1]
+        # cursor resume: nothing replays, nothing is missed
+        again, cursor2 = repos.events.since(cursor)
+        assert again == [] and cursor2 == cursor
+        emit_event(repos, EventKind.OP_CLOSE)
+        fresh, _ = repos.events.since(cursor)
+        assert [e.kind for _r, e in fresh] == ["op.close"]
+        # exact-kind and trailing-dot family filters
+        exact, _ = repos.events.since(0, kind="queue.place")
+        assert [e.kind for _r, e in exact] == ["queue.place"]
+        family, _ = repos.events.since(0, kind="queue.")
+        assert [e.kind for _r, e in family] == [
+            "queue.submit", "queue.place", "queue.done"]
+        mine, _ = repos.events.since(0, tenant="alice")
+        assert len(mine) == 2 and all(e.tenant == "alice"
+                                      for _r, e in mine)
+
+    def test_prune_keeps_newest_and_cursors_stay_valid(self, repos):
+        # two timeline rows FIRST — the oldest rows in the table, the
+        # first candidates a naive rowid prune would take
+        emit_event(repos, EventKind.CLUSTER_EVENT, cluster_id="c1",
+                   reason="ClusterCreated", message="human history")
+        emit_event(repos, "watchdog.escalate", cluster_id="c1",
+                   message="circuit open")
+        for i in range(10):
+            emit_event(repos, EventKind.OP_PHASE, message=f"p{i}")
+        rows, _ = repos.events.since(0, kind="op.phase")
+        mid_cursor = rows[6][0]
+        assert repos.events.prune(keep=3) == 7
+        left, _ = repos.events.since(0, kind="op.phase")
+        assert [e.message for _r, e in left] == ["p7", "p8", "p9"]
+        # an in-flight cursor survives the prune: rowids only grow, so
+        # resuming past the pruned range replays exactly the kept tail
+        tail, _ = repos.events.since(mid_cursor, kind="op.phase")
+        assert [e.message for _r, e in tail] == ["p7", "p8", "p9"]
+        # timeline rows are retention-EXEMPT: chatty op.* traffic must
+        # never evict an older cluster's human history
+        assert [e.reason for e in repos.events.timeline("c1")] \
+            == ["ClusterCreated", ""]
+        assert repos.events.count_for(["c1"]) == 2
+
+    def test_queue_story_reducer(self):
+        events = [
+            Event(kind="queue.submit", tenant="a",
+                  payload={"state": "pending", "priority": "low"}),
+            Event(kind="op.open", tenant="a"),       # not a story kind
+            Event(kind="queue.drain", tenant="a",
+                  payload={"state": "drained", "step": 2,
+                           "checkpoint": "ck1"}),
+            Event(kind="queue.done", tenant="b",
+                  payload={"state": "done"}),
+        ]
+        story = queue_story(events, tenant="a")
+        assert [r["kind"] for r in story] == ["queue.submit",
+                                              "queue.drain"]
+        assert story[1]["step"] == 2 and story[1]["checkpoint"] == "ck1"
+        everyone = queue_story(events)
+        assert [r["tenant"] for r in everyone] == ["a", "a", "b"]
+
+
+class TestJournalEmission:
+    def test_operation_life_emits_bus_events(self, tmp_path):
+        """A journaled cluster create leaves op.open → op.phase* →
+        op.close on the stream, each carrying the op's ids — and the
+        LEGACY timeline surfaces stay phase-spam-free."""
+        from kubeoperator_tpu.models import Credential
+
+        svc = _services(tmp_path)
+        try:
+            svc.credentials.create(Credential(name="ev-ssh",
+                                              password="pw"))
+            for i in range(2):
+                svc.hosts.register(f"ev-h{i}", f"10.90.0.{i + 1}",
+                                   "ev-ssh")
+            cluster = svc.clusters.create(
+                "ev-acc", host_names=["ev-h0", "ev-h1"], wait=True)
+            assert cluster.status.phase == "Ready"
+            op = svc.journal.history(cluster.id, 1)[0]
+            rows, _ = svc.repos.events.since(0)
+            mine = [e for _r, e in rows if e.op_id == op.id]
+            kinds = [e.kind for e in mine]
+            assert kinds[0] == "op.open"
+            assert kinds[-1] == "op.close"
+            assert kinds.count("op.phase") >= 3
+            assert all(e.cluster_id == cluster.id for e in mine)
+            assert all(e.trace_id == op.trace_id for e in mine)
+            # timeline surfaces exclude the journal stream
+            timeline_kinds = {e.kind for e in svc.events.list(cluster.id)}
+            assert not any(k.startswith("op.") for k in timeline_kinds)
+            feed = svc.repos.events.find_recent({cluster.id: "ev-acc"},
+                                                100)
+            assert not any(e.kind.startswith("op.") for e in feed)
+        finally:
+            svc.close()
+
+    def test_events_off_is_the_pre_bus_stack(self, tmp_path):
+        from kubeoperator_tpu.models import Credential
+
+        svc = _services(tmp_path, observability={"events": False})
+        try:
+            svc.credentials.create(Credential(name="off-ssh",
+                                              password="pw"))
+            for i in range(2):
+                svc.hosts.register(f"off-h{i}", f"10.91.0.{i + 1}",
+                                   "off-ssh")
+            svc.clusters.create("ev-off", host_names=["off-h0", "off-h1"],
+                                wait=True)
+            rows, _ = svc.repos.events.since(0)
+            assert not any(e.kind.startswith("op.") for _r, e in rows)
+            # the legacy timeline still writes (it predates the bus)
+            cluster = svc.clusters.get("ev-off")
+            assert svc.events.list(cluster.id)
+        finally:
+            svc.close()
+
+    def test_fenced_writer_emits_no_state_event_only_the_rejection(
+            self, tmp_path):
+        """The same-tx contract under fencing: a stale-epoch writer's
+        state change AND its event roll back together; the rejection
+        itself lands as `fence.rejected` (own transaction, after the
+        rollback)."""
+        from kubeoperator_tpu.resilience.journal import OperationJournal
+        from kubeoperator_tpu.resilience.lease import StaleEpochError
+
+        db = Database(str(tmp_path / "fence.db"))
+        repos = Repositories(db)
+
+        class FakeLeases:
+            stale = False
+
+            def claim(self, resource):
+                return {"controller_id": "me", "epoch": 1}
+
+            def verify(self, resource, epoch, what=""):
+                if self.stale:
+                    raise StaleEpochError(resource, epoch, 2, what)
+
+            def release(self, resource, epoch):
+                return True
+
+        leases = FakeLeases()
+        journal = OperationJournal(repos, leases=leases)
+        op = journal.open_scoped("workload-queued", scope="workload")
+        rows, cursor = repos.events.since(0)
+        assert [e.kind for _r, e in rows] == ["op.open"]
+        leases.stale = True
+        with pytest.raises(StaleEpochError):
+            journal.save_vars(op, event=(EventKind.QUEUE_PLACE,
+                                         "placed", {"state": "placed"}))
+        rows, _ = repos.events.since(cursor)
+        kinds = [e.kind for _r, e in rows]
+        assert "queue.place" not in kinds, \
+            "a fenced-out writer's state event must roll back"
+        assert kinds == ["fence.rejected"]
+        rejection = rows[0][1]
+        assert rejection.type == "Warning"
+        assert rejection.payload["epoch"] == 1
+        assert rejection.payload["current"] == 2
+        db.close()
+
+
+# ======================================================================
+# per-step metric samples
+# ======================================================================
+class TestMetricSamples:
+    def test_ring_keeps_the_newest(self, repos):
+        repos.metric_samples.save_many([
+            MetricSample(op_id="op-1", step=i, loss=float(i))
+            for i in range(10)])
+        assert repos.metric_samples.prune_ring("op-1", keep=4) == 6
+        rows, cursor = repos.metric_samples.since("op-1", 0)
+        assert [s.step for _r, s in rows] == [6, 7, 8, 9]
+        # the follow cursor keeps working past the ring prune
+        repos.metric_samples.save_many([MetricSample(op_id="op-1",
+                                                     step=10)])
+        fresh, _ = repos.metric_samples.since("op-1", cursor)
+        assert [s.step for _r, s in fresh] == [10]
+
+    def test_prune_to_operations_spares_running_ops(self, repos):
+        old = Operation(kind="workload-train", status="Succeeded")
+        live = Operation(kind="workload-train")
+        repos.operations.save(old)
+        time.sleep(0.01)
+        repos.operations.save(live)   # newest; `old` falls past keep=1
+        live.status = "Running"
+        repos.operations.save(live)
+        repos.metric_samples.save_many(
+            [MetricSample(op_id=old.id, step=1),
+             MetricSample(op_id=live.id, step=1)])
+        repos.metric_samples.prune_to_operations(keep=1)
+        assert repos.metric_samples.since(old.id, 0)[0] == []
+        assert len(repos.metric_samples.since(live.id, 0)[0]) == 1
+
+    def test_train_records_live_samples_and_metrics_surface(
+            self, tmp_path):
+        """The 8-device train feeds one step sample per boundary plus a
+        checkpoint marker, and WorkloadService.metrics serves the tail
+        with a resumable cursor — the `workload watch` contract."""
+        svc = _services(tmp_path)
+        try:
+            out = svc.workloads.train(mesh="data=1,fsdp=4", steps=3,
+                                      tenant="alice")
+            assert out["result"]["ok"]
+            data = svc.workloads.metrics()
+            steps = [s for s in data["samples"] if s["kind"] == "step"]
+            marks = [s for s in data["samples"]
+                     if s["kind"] == "checkpoint"]
+            assert [s["step"] for s in steps] == [1, 2, 3]
+            assert steps[0]["loss"] > 0
+            # boundary 1 follows the compile — honest 0 (unknown) rate;
+            # later boundaries carry real step wall-clock and rates
+            assert steps[0]["steps_per_s"] == 0
+            assert all(s["steps_per_s"] > 0 for s in steps[1:])
+            assert all(s["step_s"] > 0 for s in steps[1:])
+            assert marks and marks[0]["attrs"]["checkpoint"]
+            assert data["tenant"] == "alice"
+            assert data["live"] is False
+            assert data["cursor"] > 0
+            # cursor tail: nothing replays
+            again = svc.workloads.metrics(after=data["cursor"])
+            assert again["samples"] == []
+        finally:
+            svc.close()
+
+    def test_tracing_off_records_no_samples(self, tmp_path):
+        svc = _services(tmp_path, observability={"tracing": False})
+        try:
+            svc.workloads.train(mesh="data=1,fsdp=4", steps=2)
+            assert svc.workloads.metrics()["samples"] == []
+        finally:
+            svc.close()
+
+
+# ======================================================================
+# SSE wire format (golden) + surfaces over a live server
+# ======================================================================
+def _shrink_sse(monkeypatch):
+    """Tighten the SSE posture so the golden test sees keep-alives and
+    the end frame inside CI seconds (class attrs: instances follow)."""
+    from kubeoperator_tpu.api.server import Handlers
+
+    monkeypatch.setattr(Handlers, "_SSE_KEEPALIVE_S", 0.3)
+    monkeypatch.setattr(Handlers, "_SSE_IDLE_END_S", 1.2)
+
+
+def _sse_frames(resp) -> list:
+    """Parse an SSE byte stream into frames:
+    [{"id": ..., "event": ..., "data": ..., "comments": [...]}, ...]."""
+    frames, current, comments = [], {}, []
+    for raw in resp.iter_lines(decode_unicode=True):
+        if raw is None:
+            continue
+        if raw == "":
+            if current:
+                frames.append(current)
+                current = {}
+            continue
+        if raw.startswith(":"):
+            comments.append(raw)
+            continue
+        key, _, value = raw.partition(": ")
+        current[key] = value
+    if current:
+        frames.append(current)
+    return frames, comments
+
+
+class TestEventStreamAPI:
+    def _seed(self, services, n=3):
+        ids = []
+        for i in range(n):
+            event = emit_event(
+                services.repos, EventKind.QUEUE_SUBMIT, tenant=f"t{i}",
+                message=f"seed {i}", payload={"state": "pending"})
+            ids.append(event.id)
+        return ids
+
+    def test_golden_sse_framing(self, client, monkeypatch):
+        """The wire format, pinned: one `id:`/`event:`/`data:` frame per
+        event (id = the rowid cursor, event = the kind), keep-alive
+        COMMENT lines while idle, and a terminating `event: end` frame
+        carrying the final cursor."""
+        base, http, services = client
+        _shrink_sse(monkeypatch)
+        self._seed(services, 2)
+        with http.get(f"{base}/api/v1/events?follow=1", stream=True,
+                      timeout=30) as resp:
+            assert resp.status_code == 200
+            assert resp.headers["Content-Type"].startswith(
+                "text/event-stream")
+            frames, comments = _sse_frames(resp)
+        data_frames = [f for f in frames if f.get("event") != "end"]
+        assert len(data_frames) == 2
+        for frame in data_frames:
+            assert int(frame["id"]) > 0
+            assert frame["event"] == "queue.submit"
+            payload = json.loads(frame["data"])
+            assert payload["stream_id"] == int(frame["id"])
+            assert payload["payload"]["state"] == "pending"
+        # rowid ids strictly increase along the stream
+        assert [int(f["id"]) for f in data_frames] == sorted(
+            int(f["id"]) for f in data_frames)
+        # idle keep-alive comments, then the honest end frame
+        assert any(c.startswith(": keep-alive") for c in comments)
+        end = [f for f in frames if f.get("event") == "end"]
+        assert len(end) == 1
+        assert json.loads(end[0]["data"])["cursor"] == \
+            int(data_frames[-1]["id"])
+
+    def test_last_event_id_resumes_exactly(self, client, monkeypatch):
+        """`Last-Event-ID` replay-from-cursor: a reconnecting consumer
+        replays nothing it saw and misses nothing that landed."""
+        base, http, services = client
+        _shrink_sse(monkeypatch)
+        self._seed(services, 3)
+        rows, _ = services.repos.events.since(0)
+        seen_rowid = rows[0][0]
+        with http.get(f"{base}/api/v1/events?follow=1", stream=True,
+                      timeout=30,
+                      headers={"Last-Event-ID": str(seen_rowid)}) as resp:
+            frames, _ = _sse_frames(resp)
+        replayed = [int(f["id"]) for f in frames
+                    if f.get("event") != "end"]
+        assert replayed == [r for r, _e in rows[1:]]
+
+    def test_filters_and_json_cursor_form(self, client):
+        base, http, services = client
+        self._seed(services, 2)
+        emit_event(services.repos, EventKind.OP_CLOSE, tenant="t0")
+        # kind family filter
+        data = http.get(f"{base}/api/v1/events?after=0&kind=queue.")\
+            .json()
+        assert data["events"]
+        assert all(e["kind"].startswith("queue.") for e in data["events"])
+        assert data["cursor"] >= max(e["stream_id"]
+                                     for e in data["events"])
+        # tenant filter crosses kinds
+        mine = http.get(f"{base}/api/v1/events?after=0&tenant=t0").json()
+        assert {e["kind"] for e in mine["events"]} == {"queue.submit",
+                                                       "op.close"}
+        # the legacy feed shape survives untouched (no stream params)
+        legacy = http.get(f"{base}/api/v1/events").json()
+        assert set(legacy) == {"events", "total"}
+
+    def test_platform_stream_is_admin_only(self, server):
+        base, services = server
+        services.users.create("viewer", password="viewerpw1")
+        session = requests.Session()
+        token = session.post(
+            f"{base}/api/v1/auth/login",
+            json={"username": "viewer", "password": "viewerpw1"},
+        ).json()["token"]
+        session.headers["Authorization"] = f"Bearer {token}"
+        resp = session.get(f"{base}/api/v1/events?after=0")
+        assert resp.status_code == 403
+
+    def test_workload_metrics_endpoint_json_and_follow(
+            self, client, monkeypatch):
+        """The watch surface: the JSON tail with its cursor, and the SSE
+        follow form that ends with the op's terminal status the moment
+        the run is no longer live."""
+        base, http, services = client
+        _shrink_sse(monkeypatch)
+        op = Operation(kind="workload-train", status="Succeeded",
+                       vars={"tenant": "alice"})
+        services.repos.operations.save(op)
+        services.repos.metric_samples.save_many([
+            MetricSample(op_id=op.id, step=i, kind="step",
+                         loss=2.0 - i * 0.1, step_s=0.05,
+                         steps_per_s=20.0, tflops=1.5, mfu_pct=40.0)
+            for i in (1, 2)])
+        data = http.get(
+            f"{base}/api/v1/workloads/operations/{op.id}/metrics").json()
+        assert [s["step"] for s in data["samples"]] == [1, 2]
+        assert data["live"] is False and data["tenant"] == "alice"
+        with http.get(
+                f"{base}/api/v1/workloads/operations/{op.id}/metrics"
+                f"?follow=1", stream=True, timeout=30) as resp:
+            frames, _ = _sse_frames(resp)
+        samples = [f for f in frames if f.get("event") == "sample"]
+        assert [json.loads(f["data"])["step"] for f in samples] == [1, 2]
+        end = [f for f in frames if f.get("event") == "end"][0]
+        # a closed op ends the stream immediately with its verdict
+        assert json.loads(end["data"])["status"] == "Succeeded"
+
+    def test_watch_stream_outlives_idle_while_op_is_live(
+            self, client, monkeypatch):
+        """A RUNNING op holds its watch stream open past the idle window
+        (a >30s compile/step must not end the stream as 'Running'); the
+        stream ends with the real verdict once the op closes."""
+        import threading
+
+        base, http, services = client
+        _shrink_sse(monkeypatch)
+        op = Operation(kind="workload-train")   # status defaults Running
+        services.repos.operations.save(op)
+
+        def close_later():
+            time.sleep(3.0)   # > 2x the shrunken idle window
+            fresh = services.repos.operations.get(op.id)
+            fresh.status = "Succeeded"
+            services.repos.operations.save(fresh)
+
+        threading.Thread(target=close_later, daemon=True).start()
+        start = time.monotonic()
+        with http.get(
+                f"{base}/api/v1/workloads/operations/{op.id}/metrics"
+                f"?follow=1", stream=True, timeout=30) as resp:
+            frames, comments = _sse_frames(resp)
+        assert time.monotonic() - start >= 2.5, \
+            "stream idled out while the op was still live"
+        end = [f for f in frames if f.get("event") == "end"][0]
+        assert json.loads(end["data"])["status"] == "Succeeded"
+        # keep-alive comments flowed while the live stream sat quiet
+        assert any(c.startswith(": keep-alive") for c in comments)
+
+
+# ======================================================================
+# the CLI faces (local transport)
+# ======================================================================
+class TestCli:
+    def _local(self, services):
+        import kubeoperator_tpu.cli.koctl as koctl
+
+        client = koctl.LocalClient.__new__(koctl.LocalClient)
+        client.services = services
+        return koctl, client
+
+    def test_koctl_events_listing_and_cursor(self, tmp_path, capsys):
+        svc = _services(tmp_path)
+        try:
+            emit_event(svc.repos, EventKind.QUEUE_SUBMIT, tenant="alice",
+                       message="queued at low")
+            koctl, client = self._local(svc)
+            args = type("A", (), {"follow": False, "kind": "",
+                                  "tenant": "alice", "cluster": "",
+                                  "after": 0, "json": False})
+            assert koctl.cmd_events(client, args) == 0
+            out = capsys.readouterr().out
+            assert "queue.submit" in out and "alice" in out
+            assert "cursor:" in out
+        finally:
+            svc.close()
+
+    def test_koctl_workload_watch_poll(self, tmp_path, capsys):
+        svc = _services(tmp_path)
+        try:
+            svc.workloads.train(mesh="data=1,fsdp=4", steps=3,
+                                tenant="w")
+            koctl, client = self._local(svc)
+            args = type("A", (), {"wl_cmd": "watch", "op": ""})
+            assert koctl.cmd_workload(client, args) == 0
+            out = capsys.readouterr().out
+            assert "loss" in out and "steps/s" in out
+            assert "checkpoint" in out
+            assert "Succeeded" in out
+        finally:
+            svc.close()
+
+    def test_workload_trace_critical_path_quotes_windows(
+            self, tmp_path, capsys):
+        """The satellite: `koctl workload trace --critical-path` quotes
+        the compile/steps/checkpoint WINDOW chain instead of refusing a
+        non-phase family."""
+        svc = _services(tmp_path)
+        try:
+            svc.workloads.train(mesh="data=1,fsdp=4", steps=2)
+            koctl, client = self._local(svc)
+            args = type("A", (), {"wl_cmd": "trace", "op": "",
+                                  "json": False, "critical_path": True})
+            assert koctl.cmd_workload(client, args) == 0
+            out = capsys.readouterr().out
+            assert "critical path" in out
+            assert "window chain" in out
+            assert "compile" in out and "steps" in out
+            assert "serial window floor" in out
+        finally:
+            svc.close()

@@ -495,6 +495,15 @@ class _StubRepo:
             count_by_status=lambda: {"Succeeded": 2, "Running": 1},
             # the fleet-waves collector scans fleet ops; none journaled
             find=lambda **kw: [])
+        # live-telemetry collectors (docs/observability.md "Events and
+        # live telemetry"): bus rows by kind, per-step samples
+        self.events = types.SimpleNamespace(
+            counts_by_kind=lambda: {"op.open": 3, "op.close": 3,
+                                    "queue.preempt": 1, "": 2})
+        self.metric_samples = types.SimpleNamespace(
+            step_rows=lambda: [("alice", 0.04), ("alice", 0.21),
+                               ("", 0.05)],
+            latest_losses=lambda: [("op-abcdef12", "alice", 4, 1.25)])
 
 
 class _StubServices:
@@ -577,6 +586,18 @@ class TestExposition:
         assert 'ko_tpu_http_requests_total{code="200",method="GET"} 1' \
             in text
         assert 'ko_tpu_watchdog_circuit_open{cluster="demo"} 1' in text
+        # the live-telemetry families (ISSUE 14): bus counter by kind
+        # (pre-bus rows grouped under "legacy"), per-step wall-clock
+        # histogram by tenant, and each op's latest loss
+        assert families["ko_tpu_events_total"][0] == "counter"
+        assert 'ko_tpu_events_total{kind="queue.preempt"} 1' in text
+        assert 'ko_tpu_events_total{kind="legacy"} 2' in text
+        assert families["ko_tpu_workload_step_seconds"][0] == "histogram"
+        assert 'ko_tpu_workload_step_seconds_count{tenant="alice"} 2' \
+            in text
+        assert families["ko_tpu_workload_loss"][0] == "gauge"
+        assert ('ko_tpu_workload_loss{op="op-abcde",tenant="alice"} 1.25'
+                in text)
 
     def test_histogram_buckets_monotone_and_inf_equals_count(self):
         from kubeoperator_tpu.api.metrics import MetricsRegistry

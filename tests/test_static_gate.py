@@ -102,7 +102,8 @@ def test_cli_gate_exit_code_is_zero(capsys):
     assert "0 error(s)" in capsys.readouterr().out
 
 
-def _timed_simulated_create(tmp_path, tag: str, tracing: bool) -> float:
+def _timed_simulated_create(tmp_path, tag: str, tracing: bool,
+                            events: bool = True) -> float:
     """One 3-node simulated create (SimulationExecutor with a small
     per-task delay so the measurement is dominated by stable sleeps, not
     scheduler noise); returns wall-clock seconds."""
@@ -118,7 +119,7 @@ def _timed_simulated_create(tmp_path, tag: str, tracing: bool) -> float:
         "cron": {"backup_enabled": False, "health_check_interval_s": 0,
                  "event_sync_interval_s": 0},
         "cluster": {"kubeconfig_dir": str(tmp_path / f"kc-{tag}")},
-        "observability": {"tracing": tracing},
+        "observability": {"tracing": tracing, "events": events},
     })
     services = build_services(config, simulate=True)
     try:
@@ -140,6 +141,11 @@ def _timed_simulated_create(tmp_path, tag: str, tracing: bool) -> float:
                 "traced run persisted no spans — the 'on' leg measured nothing"
         else:
             assert services.repos.spans.list() == []
+        # both budget legs must measure what they claim: journal bus
+        # events present exactly when the knob is on
+        bus_rows, _ = services.repos.events.since(0, kind="op.")
+        assert bool(bus_rows) == events, \
+            f"events={events} but bus rows={len(bus_rows)}"
         return elapsed
     finally:
         services.close()
@@ -286,6 +292,67 @@ def test_concurrent_wave_beats_serial_at_wave_size_4():
         f"{row['concurrent_wave_s']}s; budget ≥2x at wave_size=4)")
     assert elapsed < 120.0, (
         f"fleet wave benchmark took {elapsed:.1f}s (budget 120s)")
+
+
+def _timed_train(tmp_path, tag: str, events: bool) -> float:
+    """One 8-device train (tier-1 CPU mesh) with the live-telemetry
+    switch toggled; asserts each leg measured what it claims (samples
+    present exactly when the knob is on)."""
+    from kubeoperator_tpu.service import build_services
+    from kubeoperator_tpu.utils.config import load_config
+
+    config = load_config(path="/nonexistent", env={}, overrides={
+        "db": {"path": str(tmp_path / f"wl-{tag}.db")},
+        "logging": {"level": "WARNING"},
+        "executor": {"backend": "simulation"},
+        "provisioner": {"work_dir": str(tmp_path / f"wl-tf-{tag}")},
+        "cron": {"backup_enabled": False, "health_check_interval_s": 0,
+                 "event_sync_interval_s": 0},
+        "cluster": {"kubeconfig_dir": str(tmp_path / f"wl-kc-{tag}")},
+        "observability": {"events": events},
+    })
+    services = build_services(config, simulate=True)
+    try:
+        start = time.perf_counter()
+        out = services.workloads.train(mesh="data=2,fsdp=4", steps=4)
+        elapsed = time.perf_counter() - start
+        assert out["result"]["ok"]
+        samples = services.workloads.metrics(out["id"])["samples"]
+        assert bool(samples) == events, \
+            f"events={events} but {len(samples)} samples recorded"
+        return elapsed
+    finally:
+        services.close()
+
+
+def test_live_telemetry_overhead_stays_under_budget(tmp_path):
+    """The event bus + metric samples' operational budget (ISSUE 14 /
+    PERF.md events section), the PR-5 tracing budget's twin: the same
+    simulated create and the same 8-device train with
+    `observability.events` on must stay within 5% wall-clock of off.
+    Best-of-2 per mode filters scheduler noise; absolute floors keep
+    sub-scale deltas (and the train's compile-time jitter) from
+    flapping the ratio."""
+    create_off = min(_timed_simulated_create(tmp_path, f"eoff{i}", True,
+                                             events=False)
+                     for i in range(2))
+    create_on = min(_timed_simulated_create(tmp_path, f"eon{i}", True,
+                                            events=True)
+                    for i in range(2))
+    delta = create_on - create_off
+    assert delta < max(0.05 * create_off, 0.06), (
+        f"event-bus overhead {delta:.3f}s on a {create_off:.3f}s create "
+        f"(>{max(0.05 * create_off, 0.06):.3f}s budget)")
+
+    train_off = min(_timed_train(tmp_path, f"off{i}", False)
+                    for i in range(2))
+    train_on = min(_timed_train(tmp_path, f"on{i}", True)
+                   for i in range(2))
+    delta = train_on - train_off
+    assert delta < max(0.05 * train_off, 0.25), (
+        f"per-step telemetry overhead {delta:.3f}s on a "
+        f"{train_off:.3f}s train "
+        f"(>{max(0.05 * train_off, 0.25):.3f}s budget)")
 
 
 def test_tracing_overhead_stays_under_budget(tmp_path):
