@@ -64,6 +64,7 @@ from kubeoperator_tpu.analysis.sarif import (
     to_sarif,
     to_sarif_json,
 )
+from kubeoperator_tpu.analysis.sqlrules import SQL_RULES, check_sql_rules
 
 __all__ = [
     "ERROR", "WARNING", "Finding", "Report", "RuleSpec", "RULES",
@@ -73,6 +74,9 @@ __all__ = [
 # project-wide rules that consume the index rather than one file's tree
 FLOW_PROJECT_RULES = ("KO-P008",)
 CONTRACT_RULES = ("KO-X009", "KO-X010")
+# SQL rules (sqlrules.py SQL_RULES) run fresh every run over the cached
+# per-file facts + the migration fold — so `--changed` naturally re-checks
+# SQL when a .sql file changes (migrations are never behind the fast path)
 # per-file flow rules cached alongside the astcheck per-file rules
 PER_FILE_FLOW_RULES = ("KO-P009", "KO-P010")
 
@@ -231,6 +235,9 @@ def run_analysis(root: str | None = None, plan_files=(),
     if "KO-X010" in selected:
         report.extend(check_surface_parity(index))
         report.rules_run.append("KO-X010")
+    if selected & set(SQL_RULES):
+        report.extend(check_sql_rules(index, root, selected))
+        report.rules_run.extend(sorted(selected & set(SQL_RULES)))
 
     waivers = load_waivers(waivers_path or default_waivers_path(root))
     report.findings, unused = apply_waivers(report.findings, waivers)

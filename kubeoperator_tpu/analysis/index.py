@@ -34,7 +34,7 @@ from kubeoperator_tpu.version import __version__
 
 # Cache format version: bump when fact extraction changes shape, so a stale
 # cache from an older analyzer can never masquerade as fresh facts.
-CACHE_SCHEMA = 3
+CACHE_SCHEMA = 4
 
 _SKIP_DIRS = {"content", "__pycache__"}
 
@@ -432,12 +432,14 @@ class FileFacts:
     classes: list = field(default_factory=list)     # [ClassFacts]
     config_reads: list = field(default_factory=list)  # [(key, line)]
     surface: dict = field(default_factory=dict)
+    sql: dict = field(default_factory=dict)  # sqlmodel.extract_sql_facts
 
     def to_dict(self) -> dict:
         return {"rel": self.rel,
                 "classes": [c.to_dict() for c in self.classes],
                 "config_reads": [list(r) for r in self.config_reads],
-                "surface": self.surface}
+                "surface": self.surface,
+                "sql": self.sql}
 
     @classmethod
     def from_dict(cls, d: dict) -> "FileFacts":
@@ -445,16 +447,22 @@ class FileFacts:
         f.classes = [ClassFacts.from_dict(c) for c in d["classes"]]
         f.config_reads = [tuple(r) for r in d["config_reads"]]
         f.surface = d["surface"]
+        f.sql = d.get("sql", {})
         return f
 
 
 def extract_file_facts(tree: ast.AST, rel: str) -> FileFacts:
+    # local import: sqlmodel imports repository.db for the seam values,
+    # and index.py must stay importable before the package fully loads
+    from kubeoperator_tpu.analysis.sqlmodel import extract_sql_facts
+
     facts = FileFacts(rel=rel)
     for node in ast.walk(tree):
         if isinstance(node, ast.ClassDef):
             facts.classes.append(_class_facts(node, rel))
     facts.config_reads = _config_reads(tree)
     facts.surface = _surface_facts(tree)
+    facts.sql = extract_sql_facts(tree, rel)
     return facts
 
 

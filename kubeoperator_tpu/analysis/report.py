@@ -181,6 +181,36 @@ RULES: dict[str, RuleSpec] = {
             "in a `with` — a leaked span reads Running forever and "
             "corrupts the duration histograms",
         ),
+        # ---- SQL rules (sqlrules.py, over the sqlmodel substrate) ----
+        RuleSpec(
+            "KO-S001", "schema-conformance", "sql", ERROR,
+            "every table/column a resolved SQL statement references — and "
+            "every repo-class mirror-column declaration — exists in the "
+            "schema model folded from migrations 001..NNN; typos and "
+            "queries against never-created columns fail the gate",
+        ),
+        RuleSpec(
+            "KO-S002", "dialect-portability", "sql", ERROR,
+            "no SQLite-only construct (julianday/datetime/strftime, "
+            "INSERT OR REPLACE/IGNORE, PRAGMA, bare rowid) outside the "
+            "sanctioned seams: the DB_NOW_SQL clock seam, the ROWID_SQL "
+            "stream-cursor seam, PRAGMAs inside repository/db.py — "
+            "anything else must be ANSI-ish or carry a waiver naming its "
+            "Postgres translation",
+        ),
+        RuleSpec(
+            "KO-S003", "index-coverage", "sql", ERROR,
+            "positive filter predicates on the hot mirrored-column tables "
+            "(operations, events, workload_queue, metric_samples) are "
+            "led by a declared index — an unindexed scan on a bus-scale "
+            "table is a perf regression, not a style nit",
+        ),
+        RuleSpec(
+            "KO-S004", "migration-discipline", "sql", ERROR,
+            "migrations are strictly additive DDL (CREATE TABLE, CREATE "
+            "INDEX, ALTER TABLE ADD COLUMN only) and nothing references "
+            "a table or column before the migration that creates it",
+        ),
         # ---- contract rules (contracts.py, over index.py facts) ----
         RuleSpec(
             "KO-X009", "config-contract", "contract", ERROR,

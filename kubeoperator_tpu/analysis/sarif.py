@@ -73,6 +73,12 @@ def load_waivers(path: str) -> list:
             raise ValueError(
                 f"waiver #{i} ({rule}) has no reason — every suppression "
                 f"must carry its justification in-repo")
+        if rule == "KO-S002" and "postgres" not in reason.lower():
+            raise ValueError(
+                f"waiver #{i} (KO-S002) must name the Postgres "
+                f"translation of the waived SQLite-ism in its reason — "
+                f"a dialect waiver without a migration plan is how the "
+                f"Postgres seam rots")
         waivers.append(Waiver(rule=rule, reason=reason,
                               file=str(entry.get("file", "")),
                               contains=str(entry.get("contains", ""))))

@@ -1,0 +1,631 @@
+"""SQL substrate for the KO-S rule family: the migration-derived schema
+model + the python-side SQL statement extractor.
+
+Two halves, consumed by sqlrules.py:
+
+* `build_schema_model()` folds repository/migrations/NNN_*.sql in order —
+  CREATE TABLE / CREATE INDEX / ALTER TABLE ADD COLUMN — into ONE schema
+  model (tables with ordered columns, every index including the implicit
+  UNIQUE/PRIMARY KEY ones), recording which migration introduced each
+  piece. The fold itself validates migration discipline (KO-S004's raw
+  material): only additive statement forms are allowed, and nothing may
+  reference a table/column before the migration that creates it. A golden
+  test pins this model against live PRAGMA introspection of a freshly
+  migrated database, so model and reality can never drift.
+
+* `extract_sql_facts()` reduces one parsed python file to every SQL
+  string that reaches a Database execute/query call site, resolved
+  through class attributes (`self.table`, including the
+  `table, entity, columns = ...` tuple-unpack idiom), module constants,
+  the sanctioned dialect seams (db.py DB_NOW_SQL / ROWID_SQL — recorded
+  per statement, excluded from the dialect scan), `'sep'.join(...)` over
+  literal-element clause lists (superset semantics: every conditional
+  append lands in the resolved text), and placeholder-generator joins.
+  Statements with an unresolvable fragment are marked `dynamic`: the
+  conformance/coverage rules skip them, the dialect rule still scans
+  their literal fragments. Rides the PR-4 per-file fact index, so a warm
+  run re-extracts nothing.
+
+Layering: like the KO-X006 migration rule, SQL splitting goes through
+repository/db.py's exported helpers — this module never imports sqlite3
+itself (its own repo-layering rule, KO-P001).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+
+from kubeoperator_tpu.repository.db import (
+    DB_NOW_SQL,
+    ROWID_SQL,
+    _MIGRATION_RE,
+    statement_is_complete,
+)
+
+# the sanctioned dialect seams: interpolating one of these names into a
+# SQL f-string is the contract (docs/resilience.md "SQL contract"); the
+# constant's VALUE lands in the resolved text for schema checking but is
+# excluded from the dialect scan
+SEAM_VALUES = {"DB_NOW_SQL": DB_NOW_SQL, "ROWID_SQL": ROWID_SQL}
+
+# marker substituted for an unresolvable f-string fragment — never valid
+# SQL, so a dynamic statement can't accidentally parse as clean
+DYNAMIC_MARK = "\x00?\x00"
+
+_SQL_KEYWORDS = frozenset("""
+    select from where and or not in is null order by group having limit
+    offset desc asc as on join left right inner outer cross delete insert
+    into values update set conflict do nothing union all case when then
+    else end like escape between exists distinct pragma create table if
+    index primary key unique references default begin immediate exclusive
+    deferred commit rollback alter add column drop rename to text integer
+    real blob collate
+""".split())
+
+_STRING_RE = re.compile(r"'(?:[^']|'')*'")
+_IDENT_RE = re.compile(r"[A-Za-z_]\w*")
+_TABLE_REF_RE = re.compile(r"\b(?:FROM|INTO|JOIN|UPDATE)\s+([A-Za-z_]\w*)",
+                           re.IGNORECASE)
+_AS_RE = re.compile(r"\bAS\s+([A-Za-z_]\w*)", re.IGNORECASE)
+_QUALIFIED_RE = re.compile(r"\b([A-Za-z_]\w*)\.([A-Za-z_]\w*)")
+
+
+def mask_strings(sql: str) -> str:
+    """Replace SQL string-literal contents with '' so literal text can't
+    masquerade as identifiers."""
+    return _STRING_RE.sub("''", sql)
+
+
+def strip_sql_comments(sql: str) -> str:
+    """Drop `-- ...` comments (line-wise; a `--` after an odd number of
+    quotes is inside a string literal and survives)."""
+    out = []
+    for line in sql.splitlines():
+        pos = line.find("--")
+        while pos != -1:
+            if line[:pos].count("'") % 2 == 0:
+                line = line[:pos]
+                break
+            pos = line.find("--", pos + 1)
+        out.append(line)
+    return "\n".join(out)
+
+
+# ------------------------------------------------------------ schema model --
+@dataclass
+class TableModel:
+    name: str
+    columns: list = field(default_factory=list)      # ordered column names
+    created_in: str = ""                             # migration version
+    column_origin: dict = field(default_factory=dict)  # col -> version
+
+
+@dataclass
+class IndexModel:
+    name: str
+    table: str
+    columns: list
+    unique: bool
+    origin: str        # "c" CREATE INDEX | "u" UNIQUE constraint | "pk"
+    created_in: str
+
+
+@dataclass
+class SchemaModel:
+    """The folded migration state: what exists after NNN migrations."""
+
+    tables: dict = field(default_factory=dict)    # name -> TableModel
+    indexes: dict = field(default_factory=dict)   # name -> IndexModel
+
+    def table_indexes(self, table: str) -> list:
+        return [i for i in self.indexes.values() if i.table == table]
+
+    def has_column(self, table: str, column: str) -> bool:
+        t = self.tables.get(table)
+        return t is not None and column in t.columns
+
+
+_CREATE_TABLE_RE = re.compile(
+    r"^CREATE\s+TABLE\s+(?:IF\s+NOT\s+EXISTS\s+)?([A-Za-z_]\w*)\s*\((.*)\)"
+    r"\s*;?\s*$", re.IGNORECASE | re.DOTALL)
+_CREATE_INDEX_RE = re.compile(
+    r"^CREATE\s+(UNIQUE\s+)?INDEX\s+(?:IF\s+NOT\s+EXISTS\s+)?"
+    r"([A-Za-z_]\w*)\s+ON\s+([A-Za-z_]\w*)\s*\(([^)]*)\)\s*;?\s*$",
+    re.IGNORECASE | re.DOTALL)
+_ALTER_ADD_RE = re.compile(
+    r"^ALTER\s+TABLE\s+([A-Za-z_]\w*)\s+ADD\s+COLUMN\s+([A-Za-z_]\w*)",
+    re.IGNORECASE)
+_REFERENCES_RE = re.compile(
+    r"\bREFERENCES\s+([A-Za-z_]\w*)\s*\(\s*([A-Za-z_]\w*)\s*\)",
+    re.IGNORECASE)
+_TABLE_CONSTRAINT_HEADS = frozenset(
+    {"unique", "primary", "foreign", "check", "constraint"})
+
+
+def _split_top_level_commas(body: str) -> list:
+    parts, depth, buf = [], 0, ""
+    for ch in body:
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+        if ch == "," and depth == 0:
+            parts.append(buf.strip())
+            buf = ""
+        else:
+            buf += ch
+    if buf.strip():
+        parts.append(buf.strip())
+    return parts
+
+
+def iter_migration_statements(migrations_dir: str):
+    """Yield (version, fname, statement_text, start_line) across every
+    NNN_slug.sql in lexical order — the same split the boot runner applies
+    (line-tracked so findings can point at the statement)."""
+    for fname in sorted(os.listdir(migrations_dir)):
+        if not _MIGRATION_RE.match(fname):
+            continue
+        version = fname[:3]
+        with open(os.path.join(migrations_dir, fname),
+                  encoding="utf-8") as f:
+            lines = f.read().splitlines()
+        buf, start = "", 0
+        for n, line in enumerate(lines, 1):
+            stripped = line.strip()
+            if not buf and (not stripped or stripped.startswith("--")):
+                continue
+            if not buf:
+                start = n
+            buf += line + "\n"
+            if statement_is_complete(buf):
+                yield version, fname, buf.strip(), start
+                buf = ""
+        if buf.strip():
+            yield version, fname, buf.strip(), start
+
+
+def _fold_create_table(model: SchemaModel, name: str, body: str,
+                       version: str, problems: list, where: tuple) -> None:
+    table = TableModel(name=name, created_in=version)
+    for item in _split_top_level_commas(body):
+        head_m = re.match(r"[A-Za-z_]\w*", item)
+        head = head_m.group(0).lower() if head_m else ""
+        if head in _TABLE_CONSTRAINT_HEADS:
+            m = re.match(r"^(UNIQUE|PRIMARY\s+KEY)\s*\(([^)]*)\)",
+                         item, re.IGNORECASE)
+            if m:
+                cols = [c.split()[0] for c in m.group(2).split(",") if c.split()]
+                origin = "pk" if m.group(1).upper().startswith("P") else "u"
+                iname = f"{name}.{origin}.{'+'.join(cols)}"
+                model.indexes[iname] = IndexModel(
+                    iname, name, cols, True, origin, version)
+            continue
+        col = item.split()[0]
+        table.columns.append(col)
+        table.column_origin[col] = version
+        rest = item[len(col):]
+        if re.search(r"\bPRIMARY\s+KEY\b", rest, re.IGNORECASE):
+            model.indexes[f"{name}.pk.{col}"] = IndexModel(
+                f"{name}.pk.{col}", name, [col], True, "pk", version)
+        elif re.search(r"\bUNIQUE\b", rest, re.IGNORECASE):
+            model.indexes[f"{name}.u.{col}"] = IndexModel(
+                f"{name}.u.{col}", name, [col], True, "u", version)
+        for rm in _REFERENCES_RE.finditer(rest):
+            rt, rc = rm.group(1), rm.group(2)
+            if rt != name and not model.has_column(rt, rc):
+                problems.append((*where,
+                                 f"column {name}.{col} REFERENCES {rt}({rc}) "
+                                 f"before any migration creates it"))
+    model.tables[name] = table
+
+
+def build_schema_model(migrations_dir: str) -> tuple:
+    """Fold every migration into (SchemaModel, discipline_problems).
+
+    Problems are (fname, line, message) rows — KO-S004's findings:
+    non-additive statement forms (DROP / RENAME / other ALTERs / DML),
+    and any statement referencing a table or column before the migration
+    that creates it.
+    """
+    model = SchemaModel()
+    # migration-000 bootstrap: db.py creates the version ledger itself,
+    # before any migration runs — it is part of the schema contract
+    model.tables["schema_migrations"] = TableModel(
+        name="schema_migrations", columns=["version", "applied_at"],
+        created_in="000",
+        column_origin={"version": "000", "applied_at": "000"})
+    model.indexes["schema_migrations.pk.version"] = IndexModel(
+        "schema_migrations.pk.version", "schema_migrations",
+        ["version"], True, "pk", "000")
+    problems: list = []
+    if not os.path.isdir(migrations_dir):
+        return model, problems
+    for version, fname, raw, line in iter_migration_statements(migrations_dir):
+        stmt = strip_sql_comments(raw).strip()
+        where = (fname, line)
+        m = _CREATE_TABLE_RE.match(stmt)
+        if m:
+            name = m.group(1)
+            if name in model.tables and \
+                    not re.search(r"IF\s+NOT\s+EXISTS", stmt, re.IGNORECASE):
+                problems.append((*where,
+                                 f"CREATE TABLE {name} duplicates a table "
+                                 f"created in migration "
+                                 f"{model.tables[name].created_in}"))
+            _fold_create_table(model, name, m.group(2), version, problems,
+                               where)
+            continue
+        m = _CREATE_INDEX_RE.match(stmt)
+        if m:
+            unique, iname, table = bool(m.group(1)), m.group(2), m.group(3)
+            cols = [c.split()[0] for c in m.group(4).split(",") if c.split()]
+            if table not in model.tables:
+                problems.append((*where,
+                                 f"CREATE INDEX {iname} references table "
+                                 f"{table} before any migration creates it"))
+            else:
+                missing = [c for c in cols
+                           if not model.has_column(table, c)]
+                if missing:
+                    problems.append(
+                        (*where,
+                         f"CREATE INDEX {iname} references column(s) "
+                         f"{', '.join(missing)} of {table} before the "
+                         f"migration that creates them"))
+            model.indexes[iname] = IndexModel(iname, table, cols, unique,
+                                              "c", version)
+            continue
+        m = _ALTER_ADD_RE.match(stmt)
+        if m:
+            table, col = m.group(1), m.group(2)
+            if table not in model.tables:
+                problems.append((*where,
+                                 f"ALTER TABLE {table} before any migration "
+                                 f"creates it"))
+            else:
+                model.tables[table].columns.append(col)
+                model.tables[table].column_origin[col] = version
+            continue
+        head = " ".join(stmt.split()[:3]).upper()
+        problems.append((*where,
+                         f"statement form not allowed in migrations "
+                         f"(additive DDL only — CREATE TABLE, CREATE INDEX, "
+                         f"ALTER TABLE ADD COLUMN): {head} ..."))
+    return model, problems
+
+
+# ----------------------------------------------------- statement tokenizing --
+def parse_statement(text: str) -> dict:
+    """Light lexical reduction of one resolved SQL statement: head verb,
+    referenced tables (+ alias map), AS-defined aliases, qualified and
+    bare identifier references — the raw material for KO-S001/KO-S003."""
+    masked = mask_strings(text)
+    words = masked.split()
+    head = words[0].upper() if words else ""
+    tables, alias_map = [], {}
+    for m in _TABLE_REF_RE.finditer(masked):
+        name = m.group(1)
+        if name.lower() in _SQL_KEYWORDS:      # "DO UPDATE SET ..."
+            continue
+        if name not in tables:
+            tables.append(name)
+        after = masked[m.end():].lstrip()
+        am = _IDENT_RE.match(after)
+        if am and am.group(0).lower() not in _SQL_KEYWORDS:
+            alias_map[am.group(0)] = name
+    as_aliases = {m.group(1) for m in _AS_RE.finditer(masked)}
+    qualified = [(q, c) for q, c in
+                 ((m.group(1), m.group(2))
+                  for m in _QUALIFIED_RE.finditer(masked))]
+    qualified_spans = {m.start(2) for m in _QUALIFIED_RE.finditer(masked)}
+    columns = []
+    for m in _IDENT_RE.finditer(masked):
+        word = m.group(0)
+        low = word.lower()
+        rest = masked[m.end():].lstrip()
+        before = masked[:m.start()].rstrip()
+        if low in _SQL_KEYWORDS or rest.startswith("("):
+            continue                            # keyword or function call
+        if before.endswith(".") or m.start() in qualified_spans \
+                or rest.startswith("."):
+            continue                            # part of a qualified ref
+        if word in tables or word in alias_map or word in as_aliases:
+            continue
+        if DYNAMIC_MARK in text:
+            continue
+        columns.append((word, m.start()))
+    return {"head": head, "tables": tables, "alias_map": alias_map,
+            "as_aliases": as_aliases, "qualified": qualified,
+            "columns": columns, "masked": masked}
+
+
+# -------------------------------------------------- python fact extraction --
+_DB_RECEIVERS = frozenset({"db", "conn", "_conn", "cur"})
+_EXEC_METHODS = frozenset({"query", "execute", "executemany"})
+_MAX_DEPTH = 12
+
+
+def _receiver_tail(node) -> str:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+def _seam_name(node) -> str:
+    """The seam constant an expression names, or ''. Accepts the bare
+    imported Name and any dotted spelling ending in the seam name."""
+    tail = node.attr if isinstance(node, ast.Attribute) else \
+        (node.id if isinstance(node, ast.Name) else "")
+    return tail if tail in SEAM_VALUES else ""
+
+
+def _class_str_attrs(cls: ast.ClassDef) -> dict:
+    """Class-level string/str-tuple attributes, covering both plain
+    assignment and the `table, entity, columns = ...` unpack idiom."""
+    attrs: dict = {}
+
+    def record(name: str, value) -> None:
+        if isinstance(value, ast.Constant) and isinstance(value.value, str):
+            attrs[name] = value.value
+        elif isinstance(value, (ast.Tuple, ast.List)) and all(
+                isinstance(e, ast.Constant) and isinstance(e.value, str)
+                for e in value.elts):
+            attrs[name] = [e.value for e in value.elts]
+
+    for stmt in cls.body:
+        if isinstance(stmt, ast.AnnAssign) and stmt.value is not None and \
+                isinstance(stmt.target, ast.Name):
+            record(stmt.target.id, stmt.value)
+        elif isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+            target = stmt.targets[0]
+            if isinstance(target, ast.Name):
+                record(target.id, stmt.value)
+            elif isinstance(target, ast.Tuple) and \
+                    isinstance(stmt.value, ast.Tuple) and \
+                    len(target.elts) == len(stmt.value.elts):
+                for t, v in zip(target.elts, stmt.value.elts):
+                    if isinstance(t, ast.Name):
+                        record(t.id, v)
+    return attrs
+
+
+class _Resolved:
+    """Accumulator for one resolved SQL expression: the full text (seams
+    substituted by their SQL), the literal-only text (seam spans blanked
+    — KO-S002's scan surface), seams used, and whether any fragment was
+    unresolvable (dynamic)."""
+
+    def __init__(self) -> None:
+        self.text = ""
+        self.literal = ""
+        self.seams: list = []
+        self.dynamic = False
+
+    def add_literal(self, s: str) -> None:
+        self.text += s
+        self.literal += s
+
+    def add_seam(self, name: str) -> None:
+        self.text += SEAM_VALUES[name]
+        self.literal += " "
+        if name not in self.seams:
+            self.seams.append(name)
+
+    def add_dynamic(self) -> None:
+        self.text += DYNAMIC_MARK
+        self.literal += " "
+        self.dynamic = True
+
+    def merge(self, other: "_Resolved") -> None:
+        self.text += other.text
+        self.literal += other.literal
+        for s in other.seams:
+            if s not in self.seams:
+                self.seams.append(s)
+        self.dynamic = self.dynamic or other.dynamic
+
+
+class _FunctionEnv:
+    """Single-assignment local bindings + clause-list appends for one
+    function body (superset semantics: conditional appends all count)."""
+
+    def __init__(self, func) -> None:
+        self.bindings: dict = {}
+        self.appends: dict = {}
+        for node in ast.walk(func):
+            if isinstance(node, ast.Assign):
+                pairs = []
+                if len(node.targets) == 1 and \
+                        isinstance(node.targets[0], ast.Tuple) and \
+                        isinstance(node.value, ast.Tuple) and \
+                        len(node.targets[0].elts) == len(node.value.elts):
+                    pairs = list(zip(node.targets[0].elts, node.value.elts))
+                else:
+                    for target in node.targets:
+                        pairs.append((target, node.value))
+                for t, v in pairs:
+                    if isinstance(t, ast.Name):
+                        self.bindings[t.id] = (
+                            "POISON" if t.id in self.bindings else v)
+            elif isinstance(node, ast.AugAssign) and \
+                    isinstance(node.target, ast.Name):
+                self.bindings[node.target.id] = "POISON"
+            elif isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "append" and \
+                    isinstance(node.func.value, ast.Name) and node.args:
+                self.appends.setdefault(node.func.value.id,
+                                        []).append(node.args[0])
+
+
+class _SqlExtractor:
+    def __init__(self, tree: ast.AST, rel: str) -> None:
+        self.rel = rel
+        self.module_consts: dict = {}
+        self.statements: list = []
+        self.classes: list = []
+        if isinstance(tree, ast.Module):
+            for node in tree.body:
+                if isinstance(node, ast.Assign) and \
+                        len(node.targets) == 1 and \
+                        isinstance(node.targets[0], ast.Name) and \
+                        isinstance(node.value, ast.Constant) and \
+                        isinstance(node.value.value, str):
+                    self.module_consts[node.targets[0].id] = node.value.value
+        self.tree = tree
+
+    # ---- expression resolution ----
+    def _resolve(self, node, cls_attrs: dict, env: _FunctionEnv,
+                 depth: int = 0) -> _Resolved:
+        out = _Resolved()
+        if depth > _MAX_DEPTH:
+            out.add_dynamic()
+            return out
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            out.add_literal(node.value)
+            return out
+        if isinstance(node, ast.JoinedStr):
+            for value in node.values:
+                if isinstance(value, ast.Constant):
+                    out.add_literal(str(value.value))
+                elif isinstance(value, ast.FormattedValue):
+                    out.merge(self._resolve_fragment(
+                        value.value, cls_attrs, env, depth + 1))
+            return out
+        out.add_dynamic()
+        return out
+
+    def _resolve_fragment(self, expr, cls_attrs: dict, env: _FunctionEnv,
+                          depth: int) -> _Resolved:
+        out = _Resolved()
+        if depth > _MAX_DEPTH:
+            out.add_dynamic()
+            return out
+        seam = _seam_name(expr)
+        if seam:
+            out.add_seam(seam)
+            return out
+        if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+            out.add_literal(expr.value)
+            return out
+        if isinstance(expr, ast.Name):
+            bound = env.bindings.get(expr.id)
+            if bound is not None and bound != "POISON":
+                return self._resolve_fragment(bound, cls_attrs, env,
+                                              depth + 1)
+            if bound is None and expr.id in self.module_consts:
+                out.add_literal(self.module_consts[expr.id])
+                return out
+            out.add_dynamic()
+            return out
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name) and expr.value.id == "self":
+            value = cls_attrs.get(expr.attr)
+            if isinstance(value, str) and value:
+                out.add_literal(value)
+                return out
+            out.add_dynamic()     # absent, empty ('' table) or non-str
+            return out
+        if isinstance(expr, ast.JoinedStr):
+            return self._resolve(expr, cls_attrs, env, depth + 1)
+        if isinstance(expr, ast.Call) and \
+                isinstance(expr.func, ast.Attribute) and \
+                expr.func.attr == "join" and len(expr.args) == 1 and \
+                isinstance(expr.func.value, ast.Constant) and \
+                isinstance(expr.func.value.value, str):
+            return self._resolve_join(expr.func.value.value, expr.args[0],
+                                      cls_attrs, env, depth + 1)
+        out.add_dynamic()
+        return out
+
+    def _resolve_join(self, sep: str, arg, cls_attrs: dict,
+                      env: _FunctionEnv, depth: int) -> _Resolved:
+        out = _Resolved()
+        # `','.join('?' for _ in xs)` — a placeholder list: one marker
+        # stands in for N (schema/coverage-neutral either way)
+        if isinstance(arg, ast.GeneratorExp) and \
+                isinstance(arg.elt, ast.Constant) and \
+                isinstance(arg.elt.value, str):
+            out.add_literal(arg.elt.value)
+            return out
+        elements = None
+        if isinstance(arg, (ast.List, ast.Tuple)):
+            elements = list(arg.elts)
+        elif isinstance(arg, ast.Name):
+            bound = env.bindings.get(arg.id)
+            if isinstance(bound, (ast.List, ast.Tuple)):
+                elements = list(bound.elts) + env.appends.get(arg.id, [])
+        if elements is None:
+            out.add_dynamic()
+            return out
+        for i, element in enumerate(elements):
+            if i:
+                out.add_literal(sep)
+            out.merge(self._resolve_fragment(element, cls_attrs, env, depth))
+        return out
+
+    # ---- walk ----
+    def run(self) -> dict:
+        self._scan_body(self.tree.body
+                        if isinstance(self.tree, ast.Module) else [],
+                        cls_attrs={}, cls_name="")
+        return {"statements": self.statements, "classes": self.classes}
+
+    def _scan_body(self, body, cls_attrs: dict, cls_name: str) -> None:
+        for node in body:
+            if isinstance(node, ast.ClassDef):
+                attrs = _class_str_attrs(node)
+                table = attrs.get("table")
+                if isinstance(table, str) and table:
+                    columns = attrs.get("columns")
+                    self.classes.append({
+                        "class": node.name, "line": node.lineno,
+                        "table": table,
+                        "columns": columns
+                        if isinstance(columns, list) else None,
+                    })
+                self._scan_body(node.body, attrs, node.name)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._scan_function(node, cls_attrs, cls_name)
+            else:
+                self._scan_calls(node, cls_attrs, _FunctionEnv(node),
+                                 cls_name, "")
+
+    def _scan_function(self, func, cls_attrs: dict, cls_name: str) -> None:
+        env = _FunctionEnv(func)
+        for stmt in func.body:
+            self._scan_calls(stmt, cls_attrs, env, cls_name, func.name)
+
+    def _scan_calls(self, node, cls_attrs: dict, env: _FunctionEnv,
+                    cls_name: str, func_name: str) -> None:
+        for child in ast.walk(node):
+            if not (isinstance(child, ast.Call)
+                    and isinstance(child.func, ast.Attribute)
+                    and child.func.attr in _EXEC_METHODS
+                    and child.args
+                    and _receiver_tail(child.func.value) in _DB_RECEIVERS):
+                continue
+            resolved = self._resolve(child.args[0], cls_attrs, env)
+            if not resolved.text and not resolved.dynamic:
+                continue        # not a string expression at all
+            via = ".".join(p for p in (cls_name, func_name) if p)
+            self.statements.append({
+                "text": resolved.text,
+                "literal": resolved.literal,
+                "line": child.lineno,
+                "seams": resolved.seams,
+                "dynamic": resolved.dynamic,
+                "via": via,
+            })
+
+
+def extract_sql_facts(tree: ast.AST, rel: str) -> dict:
+    """{statements: [...], classes: [...]} for one parsed file — JSON-plain
+    so the per-file fact cache round-trips it."""
+    return _SqlExtractor(tree, rel).run()

@@ -1,0 +1,298 @@
+"""KO-S001..KO-S004 — the SQL rule family over the sqlmodel substrate.
+
+The Postgres seam's enforcement layer (ROADMAP item 1): every statement
+the extractor resolves is held to the migration-derived schema
+(KO-S001), scanned for SQLite-isms outside the sanctioned seams
+(KO-S002), and — on the hot mirrored-column tables — checked for index
+coverage (KO-S003). The migration fold itself reports discipline
+violations (KO-S004). All four run fresh each run: the corpus is 14
+small .sql files plus facts the per-file cache already holds, so
+`koctl lint --changed` re-checks SQL whenever a migration OR a
+statement-bearing python file changes, at no measurable cost.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+from kubeoperator_tpu.analysis.report import Finding
+from kubeoperator_tpu.analysis.sqlmodel import (
+    DYNAMIC_MARK,
+    SchemaModel,
+    build_schema_model,
+    iter_migration_statements,
+    mask_strings,
+    parse_statement,
+    strip_sql_comments,
+)
+
+SQL_RULES = ("KO-S001", "KO-S002", "KO-S003", "KO-S004")
+
+# KO-S003's universe: the mirrored-column tables the queue, event bus and
+# metric-sample subsystems read at bus scale (PR-12..14)
+HOT_TABLES = frozenset(
+    {"operations", "events", "workload_queue", "metric_samples"})
+
+# the one file whose PRAGMA statements are sanctioned: the handle itself
+_PRAGMA_SEAM_SUFFIX = "repository/db.py"
+
+# statement heads KO-S001/S003 have nothing to say about
+_SKIP_HEADS = frozenset(
+    {"BEGIN", "COMMIT", "ROLLBACK", "PRAGMA", "CREATE", "ALTER", "DROP"})
+
+_DIALECT_PATTERNS = (
+    (re.compile(r"\bjulianday\s*\(", re.IGNORECASE),
+     "julianday() is SQLite-only clock SQL — interpolate the DB_NOW_SQL "
+     "seam (repository/db.py) instead"),
+    (re.compile(r"\bdatetime\s*\(", re.IGNORECASE),
+     "datetime() is SQLite-only clock SQL — interpolate the DB_NOW_SQL "
+     "seam (repository/db.py) instead"),
+    (re.compile(r"\bstrftime\s*\(", re.IGNORECASE),
+     "strftime() is SQLite-only clock SQL — interpolate the DB_NOW_SQL "
+     "seam (repository/db.py) instead"),
+    (re.compile(r"\bINSERT\s+OR\s+(?:REPLACE|IGNORE)\b", re.IGNORECASE),
+     "INSERT OR REPLACE/IGNORE is SQLite-only — use ANSI "
+     "INSERT ... ON CONFLICT"),
+    (re.compile(r"\bPRAGMA\b", re.IGNORECASE),
+     "PRAGMA is SQLite-only and sanctioned only inside repository/db.py"),
+    (re.compile(r"(?<![\w.'])rowid\b", re.IGNORECASE),
+     "bare rowid is SQLite-only — interpolate the ROWID_SQL cursor seam "
+     "(repository/db.py) instead"),
+)
+
+# the four mirrored columns EVERY EntityRepo table carries beyond its
+# declared mirror tuple (repos.py save() writes them unconditionally)
+_ENTITY_BASE_COLUMNS = ("id", "data", "created_at", "updated_at")
+
+_PREDICATE_RE = re.compile(
+    r"(?<![\w.])([A-Za-z_]\w*)\s*(?:=|!=|<>|>=|<=|>|<)(?!=)")
+_IN_LIKE_RE = re.compile(
+    r"(?<![\w.])(NOT\s+)?([A-Za-z_]\w*)\s+(?:NOT\s+)?(?:IN|LIKE)\b",
+    re.IGNORECASE)
+
+
+def _statement_caption(st: dict) -> str:
+    """First ~60 chars of the statement for finding messages."""
+    text = " ".join(st["text"].replace(DYNAMIC_MARK, "<dyn>").split())
+    return text if len(text) <= 60 else text[:57] + "..."
+
+
+def _migrations_dir(root: str) -> str:
+    return os.path.join(root, "repository", "migrations")
+
+
+def _migration_rel(root: str, fname: str) -> str:
+    parent = os.path.dirname(root) or "."
+    return os.path.relpath(os.path.join(_migrations_dir(root), fname),
+                           parent)
+
+
+# ------------------------------------------------------------------ KO-S001 --
+def _check_conformance_statement(model: SchemaModel, rel: str,
+                                 st: dict) -> list:
+    parsed = parse_statement(st["text"])
+    if parsed["head"] not in ("SELECT", "INSERT", "UPDATE", "DELETE") \
+            or not parsed["tables"]:
+        return []
+    findings = []
+    caption = _statement_caption(st)
+    known_tables = []
+    for table in parsed["tables"]:
+        if table not in model.tables:
+            findings.append(Finding(
+                "KO-S001", rel, st["line"],
+                f"references table `{table}` that no migration creates "
+                f"(statement: {caption})"))
+        else:
+            known_tables.append(table)
+    known_cols = {"rowid"}
+    for table in known_tables:
+        known_cols.update(model.tables[table].columns)
+    insert_target = parsed["tables"][0] \
+        if parsed["head"] == "INSERT" else ""
+    for col, _pos in parsed["columns"]:
+        if col not in known_cols and col not in parsed["as_aliases"]:
+            findings.append(Finding(
+                "KO-S001", rel, st["line"],
+                f"references column `{col}` that exists in none of "
+                f"{', '.join(parsed['tables'])} (statement: {caption})"))
+    for qualifier, col in parsed["qualified"]:
+        if qualifier == "excluded":
+            table = insert_target
+        elif qualifier in parsed["alias_map"]:
+            table = parsed["alias_map"][qualifier]
+        elif qualifier in model.tables:
+            table = qualifier
+        else:
+            continue
+        if table in model.tables and col != "rowid" and \
+                not model.has_column(table, col):
+            findings.append(Finding(
+                "KO-S001", rel, st["line"],
+                f"references column `{table}.{col}` that no migration "
+                f"creates (statement: {caption})"))
+    return findings
+
+
+def _check_repo_class_mirror(model: SchemaModel, rel: str, rc: dict) -> list:
+    findings = []
+    table = rc["table"]
+    if table not in model.tables:
+        findings.append(Finding(
+            "KO-S001", rel, rc["line"],
+            f"repo class {rc['class']} maps table `{table}` that no "
+            f"migration creates"))
+        return findings
+    if rc["columns"] is None:
+        return findings
+    for col in tuple(rc["columns"]) + _ENTITY_BASE_COLUMNS:
+        if not model.has_column(table, col):
+            findings.append(Finding(
+                "KO-S001", rel, rc["line"],
+                f"repo class {rc['class']} mirrors column `{col}` that "
+                f"table `{table}` does not declare in any migration"))
+    return findings
+
+
+# ------------------------------------------------------------------ KO-S002 --
+def _dialect_findings(literal: str, rel: str, line: int,
+                      pragma_sanctioned: bool) -> list:
+    findings = []
+    masked = mask_strings(literal)
+    for pattern, message in _DIALECT_PATTERNS:
+        if not pattern.search(masked):
+            continue
+        if pragma_sanctioned and message.startswith("PRAGMA"):
+            continue
+        findings.append(Finding("KO-S002", rel, line, message))
+    return findings
+
+
+# ------------------------------------------------------------------ KO-S003 --
+def _predicate_columns(parsed: dict) -> set:
+    """Positive filter/range predicate columns in a resolved statement.
+
+    SET-clause assignments are masked out (an UPDATE's `col = ?` writes,
+    not filters), `NOT IN` is negative, and a predicate whose innermost
+    paren group contains OR can't be served by one index probe — skip it
+    rather than demand an index that wouldn't be used."""
+    masked = parsed["masked"]
+    # mask SET ... (up to WHERE) — both UPDATE and DO UPDATE SET forms
+    def _blank(m: re.Match) -> str:
+        return " " * (m.end() - m.start())
+    masked = re.sub(r"\bSET\b.*?(?=\bWHERE\b|$)", _blank, masked,
+                    flags=re.IGNORECASE | re.DOTALL)
+    # innermost paren span for every position, for the OR-group test
+    spans: dict = {}
+    stack: list = []
+    for i, ch in enumerate(masked):
+        if ch == "(":
+            stack.append(i)
+        elif ch == ")" and stack:
+            start = stack.pop()
+            for j in range(start, i + 1):
+                spans.setdefault(j, (start, i))
+
+    def in_or_group(pos: int) -> bool:
+        span = spans.get(pos)
+        if span is None:
+            return False
+        return bool(re.search(r"\bOR\b", masked[span[0]:span[1]],
+                              re.IGNORECASE))
+
+    cols = set()
+    for m in _PREDICATE_RE.finditer(masked):
+        word = m.group(1)
+        if word.lower() in ("where", "and", "or", "on", "when", "then",
+                            "set", "values"):
+            continue
+        if not in_or_group(m.start(1)):
+            cols.add(word)
+    for m in _IN_LIKE_RE.finditer(masked):
+        if m.group(1):                      # NOT col IN — negative
+            continue
+        word = m.group(2)
+        if word.upper() == "NOT":           # col NOT IN — negative
+            continue
+        if word.lower() in ("where", "and", "or"):
+            continue
+        if not in_or_group(m.start(2)):
+            cols.add(word)
+    return cols
+
+
+def _check_index_coverage(model: SchemaModel, rel: str, st: dict) -> list:
+    parsed = parse_statement(st["text"])
+    if parsed["head"] not in ("SELECT", "DELETE", "UPDATE") \
+            or not parsed["tables"]:
+        return []
+    hot = [t for t in parsed["tables"]
+           if t in HOT_TABLES and t in model.tables]
+    if not hot:
+        return []
+    hot_columns = set()
+    for table in hot:
+        hot_columns.update(model.tables[table].columns)
+    predicates = _predicate_columns(parsed)
+    if "rowid" in predicates:
+        return []       # cursor reads ride the ROWID_SQL stream contract
+    predicates &= hot_columns
+    if not predicates:
+        return []       # full-table aggregation by design (counts, prune)
+    leading = {idx.columns[0]
+               for table in hot for idx in model.table_indexes(table)}
+    if predicates & leading:
+        return []
+    return [Finding(
+        "KO-S003", rel, st["line"],
+        f"hot-table query filters on {', '.join(sorted(predicates))} but "
+        f"no index on {', '.join(hot)} leads with any of them — add a "
+        f"migration index (statement: {_statement_caption(st)})")]
+
+
+# ------------------------------------------------------------------ driver --
+def check_sql_rules(index, root: str, selected=None) -> list:
+    """Run the selected KO-S rules over the migration fold + the python
+    statement corpus carried by the per-file fact index."""
+    selected = set(SQL_RULES) if selected is None else \
+        set(selected) & set(SQL_RULES)
+    if not selected:
+        return []
+    migrations_dir = _migrations_dir(root)
+    model, problems = build_schema_model(migrations_dir)
+    findings: list = []
+
+    if "KO-S004" in selected:
+        for fname, line, message in problems:
+            findings.append(Finding("KO-S004", _migration_rel(root, fname),
+                                    line, message))
+
+    if "KO-S002" in selected and os.path.isdir(migrations_dir):
+        # migrations are DDL the Postgres backend replays verbatim — the
+        # dialect rule holds them to the same ANSI-ish bar as statements
+        for _version, fname, raw, line in \
+                iter_migration_statements(migrations_dir):
+            findings.extend(_dialect_findings(
+                strip_sql_comments(raw), _migration_rel(root, fname), line,
+                pragma_sanctioned=False))
+
+    for rel in sorted(index.files):
+        sql = getattr(index.files[rel], "sql", None) or {}
+        posix_rel = rel.replace(os.sep, "/")
+        pragma_ok = posix_rel.endswith(_PRAGMA_SEAM_SUFFIX)
+        for st in sql.get("statements", ()):
+            if "KO-S002" in selected:
+                findings.extend(_dialect_findings(
+                    st["literal"], rel, st["line"], pragma_ok))
+            if st["dynamic"]:
+                continue
+            if "KO-S001" in selected:
+                findings.extend(
+                    _check_conformance_statement(model, rel, st))
+            if "KO-S003" in selected:
+                findings.extend(_check_index_coverage(model, rel, st))
+        if "KO-S001" in selected:
+            for rc in sql.get("classes", ()):
+                findings.extend(_check_repo_class_mirror(model, rel, rc))
+    return findings

@@ -23,6 +23,26 @@ log = get_logger("repository")
 MIGRATIONS_DIR = os.path.join(os.path.dirname(__file__), "migrations")
 _MIGRATION_RE = re.compile(r"^(\d{3})_[\w-]+\.sql$")
 
+# ---- sanctioned dialect seams (docs/resilience.md "SQL contract") ----
+# The ONLY two places SQLite-specific SQL may appear in a statement; the
+# KO-S002 dialect rule enforces that every other statement stays ANSI-ish,
+# so a Postgres backend (ROADMAP item 1) swaps exactly these expressions.
+#
+# DB_NOW_SQL — the database's own clock as epoch seconds. Every lease
+# comparison and the migration ledger stamp use THIS expression, never a
+# replica's time.time(): expiry must mean the same instant to every
+# replica sharing the file, whatever their local clocks do.
+# Postgres translation: extract(epoch from clock_timestamp()).
+DB_NOW_SQL = "(julianday('now') - 2440587.5) * 86400.0"
+
+# ROWID_SQL — the monotonic insertion-order cursor column backing every
+# stream read (event bus Last-Event-ID, metric-sample follow, log tails)
+# and every same-timestamp tiebreak/prune. SQLite's implicit rowid IS
+# that cursor (insertion order == stream order under one writer file).
+# Postgres translation: a bigserial column (rowids only grow, so resumed
+# cursors replay nothing stale — the contract the SSE feed documents).
+ROWID_SQL = "rowid"
+
 
 def statement_is_complete(stmt: str) -> bool:
     """Whether `stmt` is one complete SQL statement (';'-terminated) —
@@ -181,7 +201,7 @@ class Database:
                 for stmt in _split_statements(script):
                     conn.execute(stmt)
                 conn.execute(
-                    "INSERT INTO schema_migrations VALUES (?, strftime('%s','now'))",
+                    f"INSERT INTO schema_migrations VALUES (?, {DB_NOW_SQL})",
                     (m.group(1),),
                 )
             log.info("applied migration %s", fname)

@@ -1,0 +1,15 @@
+-- SQL contract (docs/resilience.md "SQL contract"): indexes the KO-S003
+-- index-coverage rule surfaced on the hot telemetry tables.
+--
+-- metric_samples: the step histogram (step_rows) and the loss gauge
+-- (latest_losses) both filter on kind = 'step' every /metrics scrape,
+-- but the only index led with op_id — a full scan per scrape at
+-- bus-scale row counts. (kind, step_s) serves the histogram's
+-- kind + step_s > 0 predicate pair directly.
+CREATE INDEX IF NOT EXISTS idx_metric_samples_kind
+    ON metric_samples (kind, step_s);
+
+-- workload_queue: the queue-wait histogram (wait_rows) filters on
+-- started_at > 0 per scrape; no index led with it.
+CREATE INDEX IF NOT EXISTS idx_workload_queue_started
+    ON workload_queue (started_at);

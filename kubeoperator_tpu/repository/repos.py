@@ -39,7 +39,7 @@ from kubeoperator_tpu.models import (
     Zone,
 )
 from kubeoperator_tpu.models.base import Entity
-from kubeoperator_tpu.repository.db import Database
+from kubeoperator_tpu.repository.db import DB_NOW_SQL, ROWID_SQL, Database
 from kubeoperator_tpu.utils.errors import ConflictError, NotFoundError
 
 E = TypeVar("E", bound=Entity)
@@ -227,7 +227,7 @@ class AuditRepo(EntityRepo[AuditRecord]):
         and the order must still be deterministic."""
         rows = self.db.query(
             f"SELECT data FROM {self.table} "
-            f"ORDER BY created_at DESC, rowid DESC LIMIT ?",
+            f"ORDER BY created_at DESC, {ROWID_SQL} DESC LIMIT ?",
             (max(1, min(limit, 1000)),),
         )
         return [self.entity.from_dict(json.loads(r[0])) for r in rows]
@@ -242,9 +242,9 @@ class AuditRepo(EntityRepo[AuditRecord]):
         if excess <= 0:
             return 0
         self.db.execute(
-            f"DELETE FROM {self.table} WHERE rowid IN ("
-            f"SELECT rowid FROM {self.table} "
-            f"ORDER BY created_at ASC, rowid ASC LIMIT ?)",
+            f"DELETE FROM {self.table} WHERE {ROWID_SQL} IN ("
+            f"SELECT {ROWID_SQL} FROM {self.table} "
+            f"ORDER BY created_at ASC, {ROWID_SQL} ASC LIMIT ?)",
             (excess,),
         )
         return excess
@@ -269,7 +269,7 @@ class EventRepo(EntityRepo[Event]):
         whole queue stream). Returns ([(rowid, event), ...], new_cursor);
         the cursor is unchanged when nothing new landed, so a poll loop
         can hand it straight back."""
-        clauses, params = ["rowid > ?"], [int(after_rowid)]
+        clauses, params = [f"{ROWID_SQL} > ?"], [int(after_rowid)]
         if kind:
             if kind.endswith("."):
                 clauses.append("kind LIKE ? ESCAPE '\\'")
@@ -286,8 +286,8 @@ class EventRepo(EntityRepo[Event]):
             clauses.append("tenant = ?")
             params.append(tenant)
         rows = self.db.query(
-            f"SELECT rowid, data FROM {self.table} "
-            f"WHERE {' AND '.join(clauses)} ORDER BY rowid LIMIT ?",
+            f"SELECT {ROWID_SQL}, data FROM {self.table} "
+            f"WHERE {' AND '.join(clauses)} ORDER BY {ROWID_SQL} LIMIT ?",
             (*params, max(1, min(int(limit), 5000))),
         )
         out = [(int(r["rowid"]), self._hydrate(r["data"])) for r in rows]
@@ -315,10 +315,10 @@ class EventRepo(EntityRepo[Event]):
         with self.db.tx() as conn:
             cur = conn.execute(
                 f"DELETE FROM {self.table} "
-                f"WHERE NOT {self.TIMELINE_WHERE} AND rowid NOT IN ("
-                f"SELECT rowid FROM {self.table} "
+                f"WHERE NOT {self.TIMELINE_WHERE} AND {ROWID_SQL} NOT IN ("
+                f"SELECT {ROWID_SQL} FROM {self.table} "
                 f"WHERE NOT {self.TIMELINE_WHERE} "
-                f"ORDER BY rowid DESC LIMIT ?)",
+                f"ORDER BY {ROWID_SQL} DESC LIMIT ?)",
                 (int(keep),),
             )
             return max(cur.rowcount, 0)
@@ -336,7 +336,7 @@ class EventRepo(EntityRepo[Event]):
         EventService.list contract, pre-bus shape)."""
         rows = self.db.query(
             f"SELECT data FROM {self.table} WHERE cluster_id=? "
-            f"AND {self.TIMELINE_WHERE} ORDER BY created_at, rowid",
+            f"AND {self.TIMELINE_WHERE} ORDER BY created_at, {ROWID_SQL}",
             (cluster_id,),
         )
         return [self._hydrate(r["data"]) for r in rows]
@@ -425,8 +425,8 @@ class TaskLogChunkRepo(EntityRepo[TaskLogChunk]):
         """Cluster-wide stream cursor on sqlite rowid: O(new rows) per poll
         (insertion order == stream order). Returns (chunks, last_rowid)."""
         rows = self.db.query(
-            "SELECT rowid, data FROM task_log_chunks "
-            "WHERE cluster_id=? AND rowid>? ORDER BY rowid",
+            f"SELECT {ROWID_SQL}, data FROM task_log_chunks "
+            f"WHERE cluster_id=? AND {ROWID_SQL}>? ORDER BY {ROWID_SQL}",
             (cluster_id, after_rowid),
         )
         chunks = [self._hydrate(r["data"]) for r in rows]
@@ -510,7 +510,7 @@ class OperationRepo(EntityRepo[Operation]):
         with every operation forever; rowid tiebreak keeps bursts stable)."""
         rows = self.db.query(
             f"SELECT data FROM {self.table} WHERE cluster_id=? "
-            f"ORDER BY created_at DESC, rowid DESC LIMIT ?",
+            f"ORDER BY created_at DESC, {ROWID_SQL} DESC LIMIT ?",
             (cluster_id, max(1, min(limit, 1000))),
         )
         return [self._hydrate(r["data"]) for r in rows]
@@ -552,7 +552,7 @@ class SpanRepo(EntityRepo[Span]):
         same-timestamp siblings stable)."""
         rows = self.db.query(
             f"SELECT data FROM {self.table} WHERE op_id=? "
-            f"ORDER BY started_at, rowid",
+            f"ORDER BY started_at, {ROWID_SQL}",
             (op_id,),
         )
         return [self._hydrate(r["data"]) for r in rows]
@@ -563,7 +563,7 @@ class SpanRepo(EntityRepo[Span]):
         fleet → wave → cluster → phase waterfall comes back as ONE tree."""
         rows = self.db.query(
             f"SELECT data FROM {self.table} WHERE trace_id=? "
-            f"ORDER BY started_at, rowid",
+            f"ORDER BY started_at, {ROWID_SQL}",
             (trace_id,),
         )
         return [self._hydrate(r["data"]) for r in rows]
@@ -576,7 +576,7 @@ class SpanRepo(EntityRepo[Span]):
             f"SELECT name, finished_at - started_at AS d, trace_id "
             f"FROM {self.table} "
             f"WHERE kind=? AND started_at > 0 AND finished_at > 0 "
-            f"ORDER BY rowid",
+            f"ORDER BY {ROWID_SQL}",
             (kind,),
         )
         return [(r["name"], float(r["d"]), r["trace_id"]) for r in rows]
@@ -618,7 +618,7 @@ class SpanRepo(EntityRepo[Span]):
             cur = conn.execute(
                 f"DELETE FROM {self.table} WHERE op_id NOT IN ("
                 f"SELECT id FROM operations "
-                f"ORDER BY created_at DESC, rowid DESC LIMIT ?) "
+                f"ORDER BY created_at DESC, {ROWID_SQL} DESC LIMIT ?) "
                 f"AND op_id NOT IN ("
                 f"SELECT id FROM operations WHERE {live('')}) "
                 f"AND op_id NOT IN ("
@@ -645,8 +645,8 @@ class MetricSampleRepo(EntityRepo[MetricSample]):
         """Follow-stream read for one op: samples past `after_rowid` in
         stream order. Returns ([(rowid, sample), ...], new_cursor)."""
         rows = self.db.query(
-            f"SELECT rowid, data FROM {self.table} "
-            f"WHERE op_id = ? AND rowid > ? ORDER BY rowid LIMIT ?",
+            f"SELECT {ROWID_SQL}, data FROM {self.table} "
+            f"WHERE op_id = ? AND {ROWID_SQL} > ? ORDER BY {ROWID_SQL} LIMIT ?",
             (op_id, int(after_rowid), max(1, min(int(limit), 10000))),
         )
         out = [(int(r["rowid"]), self._hydrate(r["data"])) for r in rows]
@@ -659,7 +659,7 @@ class MetricSampleRepo(EntityRepo[MetricSample]):
         path)."""
         rows = self.db.query(
             f"SELECT tenant, step_s FROM {self.table} "
-            f"WHERE kind = 'step' AND step_s > 0 ORDER BY rowid")
+            f"WHERE kind = 'step' AND step_s > 0 ORDER BY {ROWID_SQL}")
         return [(r["tenant"], float(r["step_s"])) for r in rows]
 
     def latest_losses(self) -> list[tuple]:
@@ -668,7 +668,7 @@ class MetricSampleRepo(EntityRepo[MetricSample]):
         group-by (cardinality bounded by op retention: samples prune
         with their op's spans)."""
         rows = self.db.query(
-            f"SELECT op_id, tenant, step, loss, MAX(rowid) "
+            f"SELECT op_id, tenant, step, loss, MAX({ROWID_SQL}) "
             f"FROM {self.table} WHERE kind = 'step' GROUP BY op_id")
         return [(r["op_id"], r["tenant"], int(r["step"]), float(r["loss"]))
                 for r in rows]
@@ -683,9 +683,9 @@ class MetricSampleRepo(EntityRepo[MetricSample]):
         with self.db.tx() as conn:
             cur = conn.execute(
                 f"DELETE FROM {self.table} WHERE op_id = ? "
-                f"AND rowid NOT IN ("
-                f"SELECT rowid FROM {self.table} WHERE op_id = ? "
-                f"ORDER BY rowid DESC LIMIT ?)",
+                f"AND {ROWID_SQL} NOT IN ("
+                f"SELECT {ROWID_SQL} FROM {self.table} WHERE op_id = ? "
+                f"ORDER BY {ROWID_SQL} DESC LIMIT ?)",
                 (op_id, op_id, int(keep)),
             )
             return max(cur.rowcount, 0)
@@ -701,7 +701,7 @@ class MetricSampleRepo(EntityRepo[MetricSample]):
             cur = conn.execute(
                 f"DELETE FROM {self.table} WHERE op_id NOT IN ("
                 f"SELECT id FROM operations "
-                f"ORDER BY created_at DESC, rowid DESC LIMIT ?) "
+                f"ORDER BY created_at DESC, {ROWID_SQL} DESC LIMIT ?) "
                 f"AND op_id NOT IN ("
                 f"SELECT id FROM operations "
                 f"WHERE status IN ('Running', 'Paused'))",
@@ -745,7 +745,7 @@ class CheckpointRepo(EntityRepo[Checkpoint]):
             params.append(tenant)
         rows = self.db.query(
             f"SELECT data FROM {self.table} WHERE {' AND '.join(clauses)} "
-            f"ORDER BY created_at DESC, rowid DESC LIMIT 1",
+            f"ORDER BY created_at DESC, {ROWID_SQL} DESC LIMIT 1",
             tuple(params),
         )
         return self._hydrate(rows[0]["data"]) if rows else None
@@ -761,7 +761,7 @@ class CheckpointRepo(EntityRepo[Checkpoint]):
             params.append(tenant)
         rows = self.db.query(
             f"SELECT data FROM {self.table} WHERE {' AND '.join(clauses)} "
-            f"ORDER BY created_at, rowid", tuple(params))
+            f"ORDER BY created_at, {ROWID_SQL}", tuple(params))
         return [self._hydrate(r["data"]) for r in rows]
 
 
@@ -784,7 +784,7 @@ class WorkloadQueueRepo(EntityRepo[QueueEntry]):
         bursts)."""
         rows = self.db.query(
             f"SELECT data FROM {self.table} WHERE state = 'pending' "
-            f"ORDER BY priority DESC, created_at ASC, rowid ASC")
+            f"ORDER BY priority DESC, created_at ASC, {ROWID_SQL} ASC")
         return [self._hydrate(r["data"]) for r in rows]
 
     def active(self) -> list[QueueEntry]:
@@ -792,7 +792,7 @@ class WorkloadQueueRepo(EntityRepo[QueueEntry]):
         rows = self.db.query(
             f"SELECT data FROM {self.table} "
             f"WHERE state IN ('placed', 'running') "
-            f"ORDER BY created_at ASC, rowid ASC")
+            f"ORDER BY created_at ASC, {ROWID_SQL} ASC")
         return [self._hydrate(r["data"]) for r in rows]
 
     def by_op(self, op_id: str) -> QueueEntry | None:
@@ -814,7 +814,7 @@ class WorkloadQueueRepo(EntityRepo[QueueEntry]):
         material, straight off the mirrored columns."""
         rows = self.db.query(
             f"SELECT priority_class, started_at - created_at AS w "
-            f"FROM {self.table} WHERE started_at > 0 ORDER BY rowid")
+            f"FROM {self.table} WHERE started_at > 0 ORDER BY {ROWID_SQL}")
         return [(r["priority_class"], max(float(r["w"]), 0.0))
                 for r in rows]
 
@@ -832,16 +832,16 @@ class SliceEventRepo(EntityRepo[SliceEvent]):
     def for_cluster(self, cluster_id: str, limit: int = 100) -> list[SliceEvent]:
         rows = self.db.query(
             f"SELECT data FROM {self.table} WHERE cluster_id=? "
-            f"ORDER BY created_at DESC, rowid DESC LIMIT ?",
+            f"ORDER BY created_at DESC, {ROWID_SQL} DESC LIMIT ?",
             (cluster_id, int(limit)),
         )
         return [self._hydrate(r["data"]) for r in rows]
 
 
-# the database's own clock as epoch seconds — every lease comparison uses
-# THIS expression, never a replica's time.time(): expiry must mean the same
-# instant to every replica sharing the file, whatever their local clocks do
-DB_NOW_SQL = "(julianday('now') - 2440587.5) * 86400.0"
+# DB_NOW_SQL / ROWID_SQL (imported above, re-exported here for the lease
+# and stream consumers that always lived off this module) are the two
+# sanctioned dialect seams — db.py holds the definitions and
+# docs/resilience.md "SQL contract" names their Postgres translations.
 
 # lease resources currently backed by a Running operation (a cluster id,
 # or the op's own id for fleet-scope ops) — the ONE definition shared by

@@ -1486,6 +1486,320 @@ class TestSurfaceParity:  # KO-X010
         assert all("'lint'" not in f.message for f in findings)
 
 
+# ------------------------------------------------- SQL rules (KO-S family) --
+SQL_MIGRATION_001 = """\
+    CREATE TABLE operations (
+        id TEXT PRIMARY KEY,
+        data TEXT,
+        created_at REAL,
+        updated_at REAL,
+        kind TEXT,
+        status TEXT
+    );
+    CREATE INDEX idx_operations_kind ON operations (kind, created_at);
+    """
+
+SQL_CLEAN_REPO_PY = """\
+    ROWID_SQL = "rowid"
+    DB_NOW_SQL = "(julianday('now') - 2440587.5) * 86400.0"
+
+    class OperationRepo:
+        table, entity, columns = "operations", None, ("kind", "status")
+
+        def latest(self, db):
+            return db.query(
+                f"SELECT data FROM operations WHERE kind = ? "
+                f"ORDER BY created_at DESC, {ROWID_SQL} DESC LIMIT 1")
+    """
+
+SQL_FIXTURE = {
+    "repository/migrations/001_init.sql": SQL_MIGRATION_001,
+    "repository/repos.py": SQL_CLEAN_REPO_PY,
+}
+
+
+def sql_findings(tmp_path, files: dict, rule: str):
+    return flow_findings(tmp_path, files, rule)
+
+
+class TestSchemaConformance:  # KO-S001
+    def test_clean_fixture_is_quiet(self, tmp_path):
+        assert sql_findings(tmp_path, SQL_FIXTURE, "KO-S001") == []
+
+    def test_fires_on_column_typo(self, tmp_path):
+        files = dict(SQL_FIXTURE)
+        files["svc.py"] = """\
+            def broken(db):
+                return db.query("SELECT statuz FROM operations")
+            """
+        findings = sql_findings(tmp_path, files, "KO-S001")
+        assert [f.rule for f in findings] == ["KO-S001"]
+        assert "`statuz`" in findings[0].message
+
+    def test_fires_on_unknown_table(self, tmp_path):
+        files = dict(SQL_FIXTURE)
+        files["svc.py"] = """\
+            def broken(db):
+                db.execute("DELETE FROM operatons WHERE id = ?")
+            """
+        findings = sql_findings(tmp_path, files, "KO-S001")
+        assert any("table `operatons`" in f.message for f in findings)
+
+    def test_fires_on_repo_mirror_drift(self, tmp_path):
+        files = dict(SQL_FIXTURE)
+        files["repository/repos.py"] = SQL_CLEAN_REPO_PY.replace(
+            '("kind", "status")', '("kind", "status", "tenant")')
+        findings = sql_findings(tmp_path, files, "KO-S001")
+        assert any("mirrors column `tenant`" in f.message for f in findings)
+
+    def test_dynamic_statements_are_skipped(self, tmp_path):
+        files = dict(SQL_FIXTURE)
+        files["svc.py"] = """\
+            def fancy(db, table):
+                return db.query(f"SELECT whatever FROM {table}")
+            """
+        assert sql_findings(tmp_path, files, "KO-S001") == []
+
+
+class TestDialectPortability:  # KO-S002
+    def test_seamed_fixture_is_quiet(self, tmp_path):
+        assert sql_findings(tmp_path, SQL_FIXTURE, "KO-S002") == []
+
+    def test_fires_on_inline_julianday(self, tmp_path):
+        files = dict(SQL_FIXTURE)
+        files["svc.py"] = """\
+            def stamp(db):
+                db.execute(
+                    "UPDATE operations SET updated_at = julianday('now')")
+            """
+        findings = sql_findings(tmp_path, files, "KO-S002")
+        assert [f.rule for f in findings] == ["KO-S002"]
+        assert "DB_NOW_SQL" in findings[0].message
+
+    def test_fires_on_bare_rowid_and_insert_or(self, tmp_path):
+        files = dict(SQL_FIXTURE)
+        files["svc.py"] = """\
+            def bad(db):
+                db.query("SELECT rowid FROM operations")
+                db.execute("INSERT OR REPLACE INTO operations VALUES (?)")
+            """
+        rules = [f.message for f in sql_findings(tmp_path, files, "KO-S002")]
+        assert any("ROWID_SQL" in m for m in rules)
+        assert any("ON CONFLICT" in m for m in rules)
+
+    def test_pragma_sanctioned_only_in_db_py(self, tmp_path):
+        files = dict(SQL_FIXTURE)
+        files["repository/db.py"] = """\
+            def init(conn):
+                conn.execute("PRAGMA journal_mode=WAL")
+            """
+        assert sql_findings(tmp_path, files, "KO-S002") == []
+        files["svc.py"] = """\
+            def tweak(db):
+                db.execute("PRAGMA journal_mode=WAL")
+            """
+        findings = sql_findings(tmp_path, files, "KO-S002")
+        assert any("sanctioned only inside repository/db.py" in f.message
+                   for f in findings)
+
+    def test_fires_on_sqlite_clock_in_migration(self, tmp_path):
+        files = dict(SQL_FIXTURE)
+        files["repository/migrations/002_clock.sql"] = """\
+            ALTER TABLE operations ADD COLUMN stamped_at REAL
+                DEFAULT (strftime('%s','now'));
+            """
+        findings = sql_findings(tmp_path, files, "KO-S002")
+        assert any(f.file.endswith("002_clock.sql") for f in findings)
+
+    def test_seam_interpolation_is_not_a_literal(self, tmp_path):
+        # the resolved seam VALUE contains julianday/rowid, but the scan
+        # runs over the literal-only text — the seam is the sanction
+        files = dict(SQL_FIXTURE)
+        files["svc.py"] = """\
+            DB_NOW_SQL = "unused-here"
+
+            def expire(db):
+                db.execute(
+                    f"DELETE FROM operations WHERE created_at < {DB_NOW_SQL}")
+            """
+        assert sql_findings(tmp_path, files, "KO-S002") == []
+
+
+class TestIndexCoverage:  # KO-S003
+    def test_indexed_predicate_is_quiet(self, tmp_path):
+        assert sql_findings(tmp_path, SQL_FIXTURE, "KO-S003") == []
+
+    def test_fires_on_unindexed_hot_predicate(self, tmp_path):
+        files = dict(SQL_FIXTURE)
+        files["svc.py"] = """\
+            def scan(db):
+                return db.query(
+                    "SELECT data FROM operations WHERE status = ?")
+            """
+        findings = sql_findings(tmp_path, files, "KO-S003")
+        assert [f.rule for f in findings] == ["KO-S003"]
+        assert "status" in findings[0].message
+
+    def test_rowid_cursor_reads_are_exempt(self, tmp_path):
+        files = dict(SQL_FIXTURE)
+        files["svc.py"] = """\
+            ROWID_SQL = "rowid"
+
+            def follow(db, after):
+                return db.query(
+                    f"SELECT data FROM operations WHERE {ROWID_SQL} > ? "
+                    f"AND status = ?")
+            """
+        assert sql_findings(tmp_path, files, "KO-S003") == []
+
+    def test_full_table_aggregations_are_exempt(self, tmp_path):
+        files = dict(SQL_FIXTURE)
+        files["svc.py"] = """\
+            def counts(db):
+                return db.query(
+                    "SELECT kind, COUNT(*) AS n FROM operations "
+                    "GROUP BY kind")
+            """
+        assert sql_findings(tmp_path, files, "KO-S003") == []
+
+    def test_cold_tables_are_exempt(self, tmp_path):
+        files = dict(SQL_FIXTURE)
+        files["repository/migrations/002_cold.sql"] = """\
+            CREATE TABLE audit_log (id TEXT PRIMARY KEY, actor TEXT);
+            """
+        files["svc.py"] = """\
+            def audit(db):
+                return db.query(
+                    "SELECT id FROM audit_log WHERE actor = ?")
+            """
+        assert sql_findings(tmp_path, files, "KO-S003") == []
+
+
+class TestMigrationDiscipline:  # KO-S004
+    def test_additive_migrations_are_quiet(self, tmp_path):
+        files = dict(SQL_FIXTURE)
+        files["repository/migrations/002_more.sql"] = """\
+            ALTER TABLE operations ADD COLUMN tenant TEXT;
+            CREATE INDEX idx_operations_tenant ON operations (tenant);
+            """
+        assert sql_findings(tmp_path, files, "KO-S004") == []
+
+    def test_fires_on_destructive_statement(self, tmp_path):
+        files = dict(SQL_FIXTURE)
+        files["repository/migrations/002_drop.sql"] = """\
+            DROP TABLE operations;
+            """
+        findings = sql_findings(tmp_path, files, "KO-S004")
+        assert [f.rule for f in findings] == ["KO-S004"]
+        assert "additive DDL only" in findings[0].message
+
+    def test_fires_on_index_before_column_exists(self, tmp_path):
+        files = dict(SQL_FIXTURE)
+        files["repository/migrations/002_early.sql"] = """\
+            CREATE INDEX idx_operations_tenant ON operations (tenant);
+            """
+        findings = sql_findings(tmp_path, files, "KO-S004")
+        assert any("before the migration that creates them" in f.message
+                   for f in findings)
+
+    def test_fires_on_alter_of_unknown_table(self, tmp_path):
+        files = dict(SQL_FIXTURE)
+        files["repository/migrations/002_ghost.sql"] = """\
+            ALTER TABLE ghosts ADD COLUMN ectoplasm TEXT;
+            """
+        findings = sql_findings(tmp_path, files, "KO-S004")
+        assert any("before any migration creates it" in f.message
+                   for f in findings)
+
+
+class TestSqlModelGolden:
+    def test_model_matches_live_pragma_introspection(self, tmp_path):
+        """The migration-derived model IS the schema: every table, every
+        column in declared order, every named index, and every implicit
+        UNIQUE/PRIMARY KEY auto-index must match what a freshly-migrated
+        database reports via PRAGMA — the model and reality cannot
+        drift."""
+        from kubeoperator_tpu.analysis.sqlmodel import build_schema_model
+        from kubeoperator_tpu.repository.db import MIGRATIONS_DIR, Database
+
+        model, problems = build_schema_model(MIGRATIONS_DIR)
+        assert problems == []
+        db = Database(path=str(tmp_path / "golden.db"))
+        try:
+            live_tables = {
+                r["name"] for r in db.query(
+                    "SELECT name FROM sqlite_master WHERE type='table'")
+                if not r["name"].startswith("sqlite_")}
+            assert set(model.tables) == live_tables
+            for table in sorted(live_tables):
+                live_cols = [r["name"] for r in
+                             db.query(f"PRAGMA table_info({table})")]
+                assert model.tables[table].columns == live_cols, table
+                live_named, live_auto = {}, []
+                for row in db.query(f"PRAGMA index_list({table})"):
+                    cols = [c["name"] for c in
+                            db.query(f"PRAGMA index_info({row['name']})")]
+                    if row["name"].startswith("sqlite_autoindex_"):
+                        live_auto.append(tuple(cols))
+                    else:
+                        live_named[row["name"]] = (bool(row["unique"]),
+                                                   tuple(cols))
+                model_named = {
+                    i.name: (i.unique, tuple(i.columns))
+                    for i in model.table_indexes(table) if i.origin == "c"}
+                assert model_named == live_named, table
+                model_auto = sorted(
+                    tuple(i.columns) for i in model.table_indexes(table)
+                    if i.origin in ("u", "pk"))
+                assert sorted(live_auto) == model_auto, table
+        finally:
+            db.close()
+
+    def test_changed_sql_file_rules_rerun(self, tmp_path):
+        """`koctl lint --changed` contract for .sql inputs: the SQL rules
+        never ride the cache fast path, so editing a migration re-checks
+        the fold even when the caller's changed-set vouches for git
+        state."""
+        root = make_tree(tmp_path, SQL_FIXTURE)
+        cache = str(tmp_path / "cache")
+        first = run_analysis(root=root, cache_dir=cache, changed=set(),
+                             git_head="h1")
+        assert not any(f.rule.startswith("KO-S") for f in first.findings)
+        (tmp_path / "fixturepkg" / "repository" / "migrations"
+         / "002_drop.sql").write_text("DROP TABLE operations;\n")
+        report = run_analysis(
+            root=root, cache_dir=cache,
+            changed={"repository/migrations/002_drop.sql"}, git_head="h1")
+        assert any(f.rule == "KO-S004" for f in report.findings)
+
+    def test_s002_waiver_must_name_postgres_translation(self, tmp_path):
+        files = dict(SQL_FIXTURE)
+        files["svc.py"] = """\
+            def bad(db):
+                db.query("SELECT rowid FROM operations")
+            """
+        root = make_tree(tmp_path, files)
+        waivers = tmp_path / "waivers.yaml"
+        waivers.write_text(
+            "waivers:\n"
+            "  - rule: KO-S002\n"
+            "    contains: rowid\n"
+            "    reason: legacy cursor read\n")
+        with pytest.raises(ValueError, match="Postgres"):
+            run_analysis(root=root, rule_ids={"KO-S002"},
+                         waivers_path=str(waivers))
+        waivers.write_text(
+            "waivers:\n"
+            "  - rule: KO-S002\n"
+            "    contains: rowid\n"
+            "    reason: cursor read; postgres translation is a "
+            "bigserial ordinal column\n")
+        report = run_analysis(root=root, rule_ids={"KO-S002"},
+                              waivers_path=str(waivers))
+        assert report.exit_code() == 0
+        assert len(report.waived) == 1
+
+
 # -------------------------------------------------------- waivers + SARIF --
 class TestWaiversAndSarif:
     def _dirty_root(self, tmp_path):

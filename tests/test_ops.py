@@ -161,15 +161,27 @@ class TestLongContextWorkload:
         assert verify_ring_attention(causal=False) is True
 
     def test_bench_ring_attention_reports_sane_numbers(self):
+        # The raw tflops is always > 0 (time_per_iter is clamped to a
+        # positive floor), but to_dict() rounds to 3 decimals — at this
+        # tiny shape (~4.2 MFLOP) a loaded CI host can stretch an iter
+        # past ~4 ms and round the DICT value to 0.0. Assert the rounding
+        # CONTRACT (dict == round(raw, 3)) instead of a raw dict
+        # threshold, and retry once so a single load spike can't leave
+        # the weaker rounded-to-zero leg as the only evidence.
         from kubeoperator_tpu.ops import bench_ring_attention
 
         r = bench_ring_attention(seq_per_device=32, heads=2, head_dim=8,
                                  iters=2, trials=1)
+        if r.to_dict()["tflops"] == 0.0:    # under load: retry once
+            r = bench_ring_attention(seq_per_device=32, heads=2,
+                                     head_dim=8, iters=2, trials=1)
         d = r.to_dict()
         assert d["n_devices"] == 8
         assert d["seq_global"] == 256
-        assert d["tflops"] > 0
-        assert d["time_per_iter_s"] > 0
+        assert r.tflops > 0
+        assert r.time_per_iter_s > 0
+        assert d["tflops"] == round(r.tflops, 3)
+        assert d["time_per_iter_s"] == round(r.time_per_iter_s, 6)
 
     def test_smoke_includes_ring_attention_gate(self):
         from kubeoperator_tpu.ops.psum_smoke import run_smoke

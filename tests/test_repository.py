@@ -29,6 +29,44 @@ def test_migrations_apply_once(tmp_db):
     db.close()
 
 
+def test_migration_ledger_stamps_db_epoch_seconds(tmp_db):
+    """The schema_migrations applied_at stamp rides the DB_NOW_SQL seam
+    (KO-S002 fix: it was an inline strftime('%s','now')) — it must be
+    epoch SECONDS from the database's own clock, same unit as every
+    other timestamp in the file."""
+    import time
+
+    db = Database(tmp_db)
+    try:
+        rows = db.query("SELECT applied_at FROM schema_migrations")
+        assert rows
+        now = time.time()
+        for r in rows:
+            assert abs(r["applied_at"] - now) < 3600, r["applied_at"]
+    finally:
+        db.close()
+
+
+def test_hot_metric_and_queue_scans_use_migration_014_indexes(tmp_db):
+    """KO-S003 regression fix (migration 014): the /metrics scrape's
+    kind-filtered metric_samples reads and the queue-wait started_at
+    read must be index-served, not full scans."""
+    db = Database(tmp_db)
+    try:
+        def plan(sql):
+            return " ".join(r["detail"] for r in
+                            db.query(f"EXPLAIN QUERY PLAN {sql}"))
+
+        assert "idx_metric_samples_kind" in plan(
+            "SELECT step_s FROM metric_samples "
+            "WHERE kind = 'step' AND step_s > 0")
+        assert "idx_workload_queue_started" in plan(
+            "SELECT started_at, created_at FROM workload_queue "
+            "WHERE started_at > 0")
+    finally:
+        db.close()
+
+
 def test_crud_round_trip(repos):
     p = Plan(name="tpu-v5e-16", provider="gcp_tpu_vm", region_id="r1",
              accelerator="tpu", tpu_type="v5e-16", worker_count=0)

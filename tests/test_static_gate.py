@@ -45,10 +45,11 @@ def test_analyzer_reports_zero_errors_over_repo():
     # waivers are deleted, not accumulated
     assert report.unused_waivers == [], report.unused_waivers
     # operational budget: the gate must stay cheap (PERF.md). 7s, not 5:
-    # the 21-rule cold run measures ~4.4s on this machine class, and the
-    # old 5s ceiling left so little headroom that an end-of-suite run
-    # (page cache churned, WAL checkpoints pending) flaked at 5.3s — the
-    # budget exists to catch a pathological rule, not scheduler noise
+    # the 27-rule cold run (KO-S SQL family included) measures ~5.1s on
+    # this machine class, and the pre-PR-7 5s ceiling left so little
+    # headroom that an end-of-suite run (page cache churned, WAL
+    # checkpoints pending) flaked — the budget exists to catch a
+    # pathological rule, not scheduler noise
     assert elapsed < 7.0, f"analyzer took {elapsed:.2f}s (budget 7s)"
 
 
