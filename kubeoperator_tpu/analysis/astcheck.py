@@ -436,6 +436,107 @@ def check_event_discipline(root: str, tree: ast.AST, path: str) -> list:
     return findings
 
 
+# ---------------------------------------------------------------- KO-P013 ---
+# vocabulary cache: root -> (kinds frozenset, prefixes tuple); parsing
+# observability/events.py once per analyzed tree, not once per file
+_P013_VOCAB: dict = {}
+
+
+def _event_kind_vocabulary(root: str) -> tuple:
+    """The EventKind class's string constants parsed out of the ANALYZED
+    tree's observability/events.py: (exact kinds, allowed prefixes).
+    Names ending `_PREFIX` declare an open dotted family ("slice." —
+    slice.detected, slice.drained, ...) rather than one exact kind. A
+    tree that ships no events.py falls back to the installed package's
+    vocabulary (fixture trees are checked against the real alphabet)."""
+    if root in _P013_VOCAB:
+        return _P013_VOCAB[root]
+    kinds: set = set()
+    prefixes: list = []
+    path = os.path.join(root, "observability", "events.py")
+    try:
+        with open(path, encoding="utf-8") as f:
+            tree = ast.parse(f.read())
+    except (OSError, SyntaxError):
+        tree = None
+    class_node = None
+    if tree is not None:
+        for node in tree.body:
+            if isinstance(node, ast.ClassDef) and node.name == "EventKind":
+                class_node = node
+    if class_node is not None:
+        for stmt in class_node.body:
+            if not isinstance(stmt, ast.Assign):
+                continue
+            value = stmt.value
+            if not (isinstance(value, ast.Constant)
+                    and isinstance(value.value, str)):
+                continue
+            for target in stmt.targets:
+                if not isinstance(target, ast.Name):
+                    continue
+                if target.id.endswith("_PREFIX"):
+                    prefixes.append(value.value)
+                else:
+                    kinds.add(value.value)
+    else:
+        from kubeoperator_tpu.observability.events import EventKind
+
+        for name in vars(EventKind):
+            value = getattr(EventKind, name)
+            if name.startswith("_") or not isinstance(value, str):
+                continue
+            if name.endswith("_PREFIX"):
+                prefixes.append(value)
+            else:
+                kinds.add(value)
+    vocab = (frozenset(kinds), tuple(prefixes))
+    _P013_VOCAB[root] = vocab
+    return vocab
+
+
+def check_event_kind_discipline(root: str, tree: ast.AST,
+                                path: str) -> list:
+    """Every LITERAL event kind reaching `emit_event(...)` (second
+    positional or `kind=`) must resolve in the EventKind vocabulary —
+    exactly, or under a declared `*_PREFIX` dotted family. A typo'd kind
+    string would stream events no filter, story reducer, or dashboard
+    ever selects: silently lost telemetry, which is worse than no
+    telemetry. Computed kinds (EventKind attributes, f-strings, variables)
+    pass — the vocabulary class is the one place they resolve from."""
+    findings: list = []
+    rel = _rel(root, path)
+    kinds, prefixes = _event_kind_vocabulary(root)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        name = func.id if isinstance(func, ast.Name) else (
+            func.attr if isinstance(func, ast.Attribute) else "")
+        if name != "emit_event":
+            continue
+        kind_arg = None
+        if len(node.args) >= 2:
+            kind_arg = node.args[1]
+        for kw in node.keywords:
+            if kw.arg == "kind":
+                kind_arg = kw.value
+        if not (isinstance(kind_arg, ast.Constant)
+                and isinstance(kind_arg.value, str)):
+            continue
+        kind = kind_arg.value
+        if kind in kinds or any(kind.startswith(p) for p in prefixes):
+            continue
+        findings.append(Finding(
+            "KO-P013", rel, node.lineno,
+            f"event kind {kind!r} does not resolve in the EventKind "
+            f"vocabulary (observability/events.py) — a typo here "
+            f"streams events no filter or story reducer ever selects; "
+            f"add the kind to EventKind (or use an existing member)",
+        ))
+    return findings
+
+
 AST_RULES = {
     "KO-P001": check_repo_layering,
     "KO-P002": check_blocking_handlers,
@@ -445,6 +546,7 @@ AST_RULES = {
     "KO-P007": check_phase_write_discipline,
     "KO-P011": check_checkpoint_atomic_writes,
     "KO-P012": check_event_discipline,
+    "KO-P013": check_event_kind_discipline,
 }
 
 

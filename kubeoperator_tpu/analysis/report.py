@@ -148,6 +148,15 @@ RULES: dict[str, RuleSpec] = {
             "fenced-out writer cannot narrate state it no longer owns",
         ),
         RuleSpec(
+            "KO-P013", "event-kind", "ast", ERROR,
+            "every literal event kind reaching emit_event() resolves in "
+            "the EventKind vocabulary (observability/events.py) — "
+            "exactly, or under a declared *_PREFIX dotted family; a "
+            "typo'd kind streams events no filter, story reducer, or "
+            "dashboard ever selects (computed kinds pass — EventKind "
+            "attributes are the sanctioned spelling)",
+        ),
+        RuleSpec(
             "KO-P007", "phase-write-discipline", "ast", ERROR,
             "in-flight ClusterPhaseStatus assignments (Provisioning/"
             "Deploying/Scaling/Upgrading/Terminating) happen only in adm/ "

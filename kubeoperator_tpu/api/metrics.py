@@ -210,6 +210,34 @@ class MetricsRegistry:
                [_fmt("ko_tpu_fleet_inflight_clusters", {},
                      fleet_in_flight)])
 
+        # convergence controller (docs/resilience.md "Fleet
+        # convergence"): the last tick's verdict as a one-hot gauge plus
+        # the drifted-cluster count, off the controller op's persisted
+        # summary (no drift re-detection per scrape). getattr-guarded:
+        # exposition tests hand in stubs without the converge service.
+        converge = getattr(services, "converge", None)
+        if converge is not None:
+            last = (converge.status() or {}).get("last") or {}
+            if not last:
+                verdict = "idle"
+            elif last.get("converged"):
+                verdict = "converged"
+            else:
+                verdict = "drifting"
+            family("ko_tpu_fleet_convergence", "gauge",
+                   "Convergence controller verdict from its last tick "
+                   "(one-hot: idle = never ticked, converged = zero "
+                   "actionable drift, drifting = remediation pending).",
+                   [_fmt("ko_tpu_fleet_convergence", {"verdict": v},
+                         1 if v == verdict else 0)
+                    for v in ("idle", "converged", "drifting")])
+            family("ko_tpu_fleet_drifted_clusters", "gauge",
+                   "Clusters the last convergence tick found drifted "
+                   "(version skew, failed phase, or standing health "
+                   "markers).",
+                   [_fmt("ko_tpu_fleet_drifted_clusters", {},
+                         int(last.get("drifted", 0) or 0))])
+
         # workload queue (docs/workloads.md "Queue and preemption"):
         # entries by state off the mirrored column, and the queue-wait
         # distribution by priority class (dispatch start - submission).

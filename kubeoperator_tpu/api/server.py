@@ -750,6 +750,17 @@ class Handlers:
             request, self.s.fleet.drift,
             **drift_kwargs(dict(request.query))))
 
+    async def fleet_converge_status(self, request):
+        return json_response(await run_sync(
+            request, self.s.converge.status))
+
+    async def fleet_converge_run(self, request):
+        from kubeoperator_tpu.fleet import converge_kwargs
+
+        body = await request.json() if request.can_read_body else {}
+        return json_response(await run_sync(
+            request, self.s.converge.run_once, **converge_kwargs(body)))
+
     async def fleet_operation(self, request):
         return json_response(await run_sync(
             request, self.s.fleet.status, request.match_info["op"]))
@@ -1460,6 +1471,8 @@ def create_app(services: Services) -> web.Application:
     # clusters across projects), so the whole surface is admin-gated
     r.add_post("/api/v1/fleet/upgrade", admin_guard(h.fleet_upgrade))
     r.add_get("/api/v1/fleet/drift", admin_guard(h.fleet_drift))
+    r.add_get("/api/v1/fleet/converge", admin_guard(h.fleet_converge_status))
+    r.add_post("/api/v1/fleet/converge", admin_guard(h.fleet_converge_run))
     r.add_get("/api/v1/fleet/operations", admin_guard(h.fleet_operations))
     r.add_get("/api/v1/fleet/operations/{op}",
               admin_guard(h.fleet_operation))

@@ -243,6 +243,12 @@ class LocalClient:
                 from kubeoperator_tpu.fleet.planner import drift_kwargs
 
                 return s.fleet.drift(**drift_kwargs(body))
+            case ("GET", ["fleet", "converge"]):
+                return s.converge.status()
+            case ("POST", ["fleet", "converge"]):
+                from kubeoperator_tpu.fleet import converge_kwargs
+
+                return s.converge.run_once(**converge_kwargs(body))
             case ("GET", ["fleet", "operations"]):
                 return s.fleet.list_ops()
             case ("GET", ["fleet", "operations", op_id]):
@@ -1297,6 +1303,57 @@ def cmd_fleet(client, args) -> int:
         # exit 1 when anything drifted: scripts alert on it (read-only —
         # nothing was queued)
         return 1 if report["drifted"] else 0
+    if args.fleet_cmd == "converge":
+        if args.once:
+            body = {"dry_run": bool(args.dry_run)}
+            result = client.call("POST", "/api/v1/fleet/converge", body)
+            if args.json:
+                _print(result)
+            else:
+                print(f"converge tick {result['tick']}"
+                      + (" (dry-run)" if result.get("dry_run") else "")
+                      + f": {result['checked']} checked, "
+                      f"{result['drifted']} drifted, "
+                      f"{result['actionable']} actionable, "
+                      f"{result['acted']} acted")
+                for action in result.get("actions", []):
+                    print(f"  {action['action']} {action['cluster']} "
+                          f"(attempt {action['attempt']})")
+                for skip in result.get("skips", []):
+                    print(f"  skipped {skip['cluster']} "
+                          f"({skip['action']}: {skip['reason']})")
+            # exit 0 once the fleet has zero actionable drift — the
+            # scriptable "loop me until converged" contract
+            return 0 if result.get("converged") else 1
+        status = client.call("GET", "/api/v1/fleet/converge")
+        if args.json:
+            _print(status)
+            return 0
+        last = status.get("last") or {}
+        print(f"convergence controller: "
+              f"{'enabled' if status['enabled'] else 'disabled'} "
+              f"(every {status['interval_s']:.0f}s, "
+              f"<= {status['max_actions_per_tick']} action(s)/tick at "
+              f"{status['priority']}, cooldown {status['cooldown_s']:.0f}s, "
+              f"max {status['max_attempts']} attempt(s))")
+        if last:
+            print(f"last tick {last.get('tick')}: "
+                  f"{last.get('drifted', 0)} drifted, "
+                  f"{last.get('actionable', 0)} actionable, "
+                  f"{last.get('acted', 0)} acted"
+                  + (" — converged" if last.get("converged") else ""))
+        else:
+            print("no ticks yet (`koctl fleet converge --once`, or set "
+                  "converge.enabled)")
+        for row in status.get("outstanding", []):
+            print(f"  outstanding: {row['action']} {row['cluster']}")
+        ledger = status.get("ledger") or {}
+        for name in sorted(ledger):
+            entry = ledger[name]
+            print(f"  ledger {name}: {entry.get('attempts', 0)} "
+                  f"attempt(s) of {entry.get('action', '?')}"
+                  + (" ESCALATED" if entry.get("escalated") else ""))
+        return 0
     raise SystemExit(f"unknown fleet command {args.fleet_cmd}")
 
 
@@ -1923,14 +1980,17 @@ def _chaos_soak_once(args, base_dir: str) -> dict:
     return report
 
 
-def _fleet_stack(args, base_dir: str, db_path: str, die_at_phase: str = ""):
+def _fleet_stack(args, base_dir: str, db_path: str, die_at_phase: str = "",
+                 extra: dict | None = None):
     """One service stack for the fleet drill: simulation executor under a
     seeded ChaosExecutor over a REUSABLE on-disk DB (building a second
-    stack on the same path is the controlled 'controller reboot')."""
+    stack on the same path is the controlled 'controller reboot').
+    `extra` merges per-section overrides on top (the convergence drill
+    rides the same stack with its own converge/lease posture)."""
     from kubeoperator_tpu.service import build_services
     from kubeoperator_tpu.utils.config import load_config
 
-    config = load_config(path="/nonexistent", env={}, overrides={
+    overrides = {
         "db": {"path": db_path},
         "logging": {"level": "ERROR"},
         "executor": {"backend": "simulation"},
@@ -1942,7 +2002,10 @@ def _fleet_stack(args, base_dir: str, db_path: str, die_at_phase: str = ""):
                   "die_at_phase": die_at_phase},
         "resilience": {"max_attempts": 2, "backoff_base_s": 0.01,
                        "backoff_max_s": 0.05},
-    })
+    }
+    for section, values in (extra or {}).items():
+        overrides[section] = {**overrides.get(section, {}), **values}
+    config = load_config(path="/nonexistent", env={}, overrides=overrides)
     return build_services(config, simulate=True)
 
 
@@ -2272,6 +2335,317 @@ def cmd_fleet_soak(args) -> int:
               f"clusters={report['clusters']} {report['groups']} "
               f"-> {report['target']} "
               f"(concurrency {report['max_concurrent']})")
+        for c in report["checks"]:
+            mark = "ok " if c["ok"] else "FAIL"
+            print(f"  [{mark}] {c['check']}"
+                  + (f" — {c['detail']}" if c["detail"] and not c["ok"]
+                     else ""))
+        if args.verify_determinism:
+            print(f"  deterministic across two runs: "
+                  f"{report['deterministic']}")
+        print(f"  runtime {report['runtime_s']}s — "
+              + ("OK" if ok else "FAILED"))
+    return 0 if ok else 1
+
+
+def _converge_soak_once(args, base: str) -> dict:
+    """One seeded pass of the convergence drill (docs/resilience.md
+    "Fleet convergence"): a fleet seeded with every drift species the
+    controller must tell apart —
+
+      ahead    — already at the hop target; the peer whose recorded
+                 version the no-history target inference reads
+      behind   — version drift; remediated via batched fleet upgrades
+                 under the live unavailability budget
+      strand   — Failed phase (a swept mid-upgrade crash posture);
+                 retried back to Ready, THEN upgraded
+      circuit  — version drift behind an OPEN watchdog circuit;
+                 operator-owned, never auto-remediated
+      broken   — every upgrade scripted to die in its first playbook;
+                 attempts exhaust and the cluster lands in `manual`
+
+    — then `converge.run_once()` loops until zero actionable drift,
+    within a tick budget derived from the fleet size and the per-tick
+    action cap. A closing leg hands the controller op's lease to a peer
+    replica and pins that the stale controller's next tick writes
+    NOTHING (StaleEpochError + one durable fence.rejected event). The
+    `canonical` sub-report (verdicts + the converge_story narrative) is
+    what --verify-determinism diffs bit-for-bit."""
+    import time as _time
+
+    from kubeoperator_tpu.fleet.drill import seed_clone_fleet
+    from kubeoperator_tpu.models import Plan, Region, Setting, Zone
+    from kubeoperator_tpu.observability import EventKind, converge_story
+    from kubeoperator_tpu.resilience import StaleEpochError, lease_wiring
+    from kubeoperator_tpu.resilience.watchdog import (
+        new_state as fresh_circuit_state,
+    )
+    from kubeoperator_tpu.utils.config import load_config
+    from kubeoperator_tpu.version import (
+        DEFAULT_K8S_VERSION,
+        SUPPORTED_K8S_VERSIONS,
+    )
+
+    t0 = _time.monotonic()
+    os.makedirs(base, exist_ok=True)
+    hop = SUPPORTED_K8S_VERSIONS.index(DEFAULT_K8S_VERSION) + 1
+    if hop >= len(SUPPORTED_K8S_VERSIONS):
+        raise SystemExit(
+            "error: converge soak needs an upgrade hop above the default "
+            f"version, but {DEFAULT_K8S_VERSION} is the newest supported")
+    target = SUPPORTED_K8S_VERSIONS[hop]
+    original = DEFAULT_K8S_VERSION
+    total = max(args.clusters, 12)
+    strand_n = 2
+    groups = {"ahead": 1, "broken": 1, "circuit": 1, "strand": strand_n,
+              "behind": total - 3 - strand_n}
+    # per-tick action cap: small enough that convergence takes several
+    # ticks (the batching behavior under test), large enough that the
+    # tick budget stays sane at 200 clusters
+    max_actions = max(5, min(50, (total + 3) // 4))
+    max_attempts = 2
+    # remediable clusters (everything but ahead/circuit, + the template),
+    # one action each, plus the strand retry round, the broken cluster's
+    # failing attempts and slack for mixed-batch verdicts
+    remediable = groups["behind"] + strand_n + 1 + 1
+    tick_budget = -(-remediable // max_actions) + strand_n \
+        + max_attempts + 4
+
+    checks: list[dict] = []
+
+    def check(name: str, ok, detail: str = "") -> None:
+        checks.append({"check": name, "ok": bool(ok), "detail": detail})
+
+    db_path = os.path.join(base, "converge.db")
+    svc = _fleet_stack(args, base, db_path, extra={
+        # run_once drives the loop synchronously (deterministic ticks);
+        # the cron auto-kick stays off so no background tick races it
+        "converge": {"enabled": False, "cooldown_s": 0,
+                     "max_actions_per_tick": max_actions,
+                     "max_attempts": max_attempts},
+        # short lease TTL so the fencing leg's peer takeover needs a
+        # ~2s expiry wait, not a minute; harmless mid-drill — fencing
+        # is epoch-based, heartbeats re-arm Running-op leases, and the
+        # sweep never takes over this controller's OWN expired leases
+        "lease": {"ttl_s": 1.5},
+        # a 200-cluster fleet's create/upgrade stream would prune a
+        # 5000-row retained bus out from under the story assertion
+        "observability": {"retain_events": 500000},
+    })
+    try:
+        region = svc.regions.create(Region(
+            name="conv-region", provider="gcp_tpu_vm",
+            vars={"project": "conv", "name": "us-central1"}))
+        zone = svc.zones.create(Zone(
+            name="conv-zone", region_id=region.id,
+            vars={"gcp_zone": "us-central1-a"}))
+        svc.plans.create(Plan(
+            name="conv-v5e-16", provider="gcp_tpu_vm", region_id=region.id,
+            zone_ids=[zone.id], accelerator="tpu", tpu_type="v5e-16",
+            worker_count=0))
+        names = seed_clone_fleet(svc, "conv-v5e-16", groups,
+                                 prefix="conv", template="conv-tpl")
+        repos = svc.repos
+
+        # ---- seed the drift species ----
+        ahead = names["ahead"][0]
+        row = repos.clusters.get_by_name(ahead)
+        row.spec.k8s_version = target
+        repos.clusters.save(row)
+        for name in names["strand"]:
+            row = repos.clusters.get_by_name(name)
+            row.status.phase = "Failed"
+            repos.clusters.save(row)
+        circ = names["circuit"][0]
+        circ_row = repos.clusters.get_by_name(circ)
+        state = fresh_circuit_state()
+        state.update({"state": "open", "opened_at": 1.0,
+                      "opened_reason": "drill-tripped"})
+        repos.settings.save(Setting(name=f"watchdog/{circ_row.id}",
+                                    vars=state))
+        # every future upgrade of the broken cluster dies in its first
+        # playbook (a failed health GATE would leave the new version in
+        # place within the wave budget — only a failed upgrade op keeps
+        # the cluster genuinely behind), so its attempts exhaust
+        broken = names["broken"][0]
+        svc.executor.fail_hosts("20-upgrade-prepare.yml", f"{broken}-*",
+                                list(range(1, 201)))
+
+        # ---- satellite pin: no rollout history, target inferred ----
+        pre = svc.fleet.drift()
+        check("no-history target inferred from fleet-recorded versions",
+              pre.get("inferred") is False
+              and pre.get("target_version") == target,
+              f"inferred={pre.get('inferred')!r} "
+              f"target={pre.get('target_version')!r}")
+
+        # ---- the convergence loop ----
+        last: dict = {}
+        for _ in range(tick_budget):
+            last = svc.converge.run_once()
+            if last.get("converged"):
+                break
+        ticks_used = int(last.get("tick", 0))
+        check("converged to zero actionable drift within the tick budget",
+              last.get("converged") is True,
+              f"ticks={ticks_used} budget={tick_budget} last={last}")
+
+        # ---- remediation outcomes ----
+        at_target = (names["ahead"] + names["behind"] + names["strand"]
+                     + ["conv-tpl"])
+        stale = [n for n in at_target
+                 if svc.clusters.get(n).spec.k8s_version != target]
+        check("every remediable cluster at the target", not stale,
+              str(stale))
+        check("stranded clusters retried back to Ready", all(
+            svc.clusters.get(n).status.phase == "Ready"
+            for n in names["strand"]))
+        ledger = svc.converge.status().get("ledger", {})
+        check("permanently-failing cluster escalated to manual",
+              bool(ledger.get(broken, {}).get("escalated")),
+              str(ledger.get(broken)))
+        check("escalated cluster left at the original version",
+              svc.clusters.get(broken).spec.k8s_version == original)
+        check("open-circuit cluster never auto-remediated",
+              svc.clusters.get(circ).spec.k8s_version == original
+              and svc.watchdog.circuit_state(circ_row.id) == "open")
+
+        # ---- the budget + circuit discipline, from the journal ----
+        tripped = []
+        for op in repos.operations.find(kind="fleet-upgrade"):
+            for wave in op.vars.get("waves", []):
+                if wave.get("outcome") in ("rolled-back", "failed"):
+                    tripped.append((op.id, wave.get("index"),
+                                    wave.get("outcome")))
+        check("no remediation rollout tripped the live unavailability "
+              "budget", not tripped, str(tripped))
+
+        # ---- the story, from the event stream alone ----
+        conv_events, cursor = [], 0
+        while True:
+            rows, cursor2 = repos.events.since(
+                cursor, kind="fleet.converge.", limit=10000)
+            if not rows:
+                break
+            conv_events.extend(e for _r, e in rows)
+            cursor = cursor2
+        story = converge_story(conv_events)
+        acted_on = {line.get("cluster") for line in story
+                    if line.get("kind") == EventKind.CONVERGE_ACT}
+        check("circuit-open cluster appears only as a skip, never an act",
+              circ not in acted_on and any(
+                  line.get("kind") == EventKind.CONVERGE_SKIP
+                  and line.get("cluster") == circ
+                  and line.get("reason") == "circuit-open"
+                  for line in story))
+        check("story narrates the full loop from the bus alone",
+              any(line.get("kind") == EventKind.CONVERGE_CONVERGED
+                  for line in story)
+              and sum(1 for line in story
+                      if line.get("kind") == EventKind.CONVERGE_TICK)
+              == ticks_used, f"{len(story)} story lines")
+
+        # ---- lease fencing: a stale controller tick writes NOTHING ----
+        op_id = str(last.get("op_id", ""))
+        # stop the cron heartbeat (this controller "dies"), let the
+        # short-TTL lease expire, then a peer replica claims the
+        # controller op — the CAS bumps the fencing epoch
+        svc.cron.stop()
+        deadline = _time.monotonic() + 30.0
+        peer_cfg = load_config(path="/nonexistent", env={}, overrides={
+            "lease": {"controller_id": "converge-drill-b"}})
+        peer = lease_wiring(peer_cfg, repos)
+        claimed = None
+        while claimed is None and _time.monotonic() < deadline:
+            claimed = peer.try_claim(op_id)
+            if claimed is None:
+                _time.sleep(0.2)
+        check("peer replica took the controller lease over",
+              claimed is not None and int(claimed.get("epoch", 0)) > 1,
+              str(claimed))
+        ticks_before = int(repos.operations.get(op_id).vars.get("ticks", 0))
+        events_before = len(conv_events)
+        fenced = False
+        try:
+            svc.converge.run_once()
+        except StaleEpochError:
+            fenced = True
+        check("stale-epoch converge tick rejected", fenced)
+        rows, _cur = repos.events.since(cursor, kind="fleet.converge.",
+                                        limit=10000)
+        check("fenced tick wrote zero converge events",
+              not rows and len(conv_events) == events_before,
+              str([e.kind for _r, e in rows]))
+        check("fenced tick left the controller ledger untouched",
+              int(repos.operations.get(op_id).vars.get("ticks", 0))
+              == ticks_before)
+        frows, _cur = repos.events.since(
+            0, kind=EventKind.FENCE_REJECTED, limit=10000)
+        check("fencing pinned as a durable event", len(frows) >= 1)
+
+        injected = svc.executor.injection_summary()
+    finally:
+        svc.close()
+
+    ok = all(c["ok"] for c in checks)
+    return {
+        "seed": args.seed,
+        "clusters": total,
+        "groups": groups,
+        "target": target,
+        "ticks": ticks_used,
+        "tick_budget": tick_budget,
+        "max_actions_per_tick": max_actions,
+        "checks": checks,
+        "story_lines": len(story),
+        "injection_summary": injected,
+        "ok": ok,
+        # what --verify-determinism diffs bit-for-bit: the verdicts AND
+        # the whole event-stream narrative (converge_story strips
+        # timestamps/op ids, so the reduction is a pure function of the
+        # seeded fleet)
+        "canonical": {
+            "verdicts": [(c["check"], c["ok"]) for c in checks],
+            "story": story,
+            "groups": groups,
+            "target": target,
+            "ticks": ticks_used,
+        },
+        "runtime_s": round(_time.monotonic() - t0, 3),
+    }
+
+
+def cmd_converge_soak(args) -> int:
+    """`koctl chaos-soak --converge [--clusters N] [--verify-determinism]`:
+    the continuous-convergence drill — a fleet seeded with mixed drift
+    (stale versions, a tripped circuit, mid-upgrade strands, a
+    permanently-failing cluster) converges to zero actionable drift
+    within budgeted ticks through the real remediation queue, the
+    permanently-broken cluster lands in `manual`, the open circuit is
+    never touched, and a fenced-out stale controller tick writes
+    nothing; with --verify-determinism the whole drill runs twice and
+    the canonical reports (verdicts + converge_story) must match
+    bit-for-bit."""
+    import tempfile
+    import time as _time
+
+    t0 = _time.monotonic()
+    with tempfile.TemporaryDirectory(prefix="ko-converge-soak-") as base:
+        report = _converge_soak_once(args, os.path.join(base, "pass1"))
+        if args.verify_determinism:
+            second = _converge_soak_once(args, os.path.join(base, "pass2"))
+            report["deterministic"] = (
+                report["canonical"] == second["canonical"])
+    report["runtime_s"] = round(_time.monotonic() - t0, 3)
+    ok = report["ok"] and report.get("deterministic", True)
+    if args.format == "json":
+        _print(report)
+    else:
+        print(f"converge chaos-soak: seed={report['seed']} "
+              f"clusters={report['clusters']} {report['groups']} "
+              f"-> {report['target']} in {report['ticks']} tick(s) "
+              f"(budget {report['tick_budget']}, "
+              f"{report['max_actions_per_tick']} actions/tick)")
         for c in report["checks"]:
             mark = "ok " if c["ok"] else "FAIL"
             print(f"  [{mark}] {c['check']}"
@@ -3172,6 +3546,8 @@ def cmd_chaos_soak(args) -> int:
         return cmd_controller_soak(args)
     if args.fleet:
         return cmd_fleet_soak(args)
+    if args.converge:
+        return cmd_converge_soak(args)
     if args.preemption:
         return cmd_preemption_soak(args)
     if args.queue:
@@ -3437,6 +3813,22 @@ def build_parser() -> argparse.ArgumentParser:
                          help="cluster filter: name=<glob>, project=, "
                               "plan=, version=; repeatable (AND)")
     f_drift.add_argument("--json", action="store_true")
+    f_converge = fsub.add_parser(
+        "converge",
+        help="the convergence controller: continuous drift "
+             "auto-remediation through the workload queue "
+             "(docs/resilience.md \"Fleet convergence\"); default shows "
+             "controller status, --once runs one tick now")
+    f_converge.add_argument("--once", action="store_true",
+                            help="run one synchronous convergence tick "
+                                 "(works with converge.enabled off; "
+                                 "exit 0 once zero actionable drift)")
+    f_converge.add_argument("--dry-run", action="store_true",
+                            help="with --once: plan and narrate, submit "
+                                 "nothing")
+    f_converge.add_argument("--status", action="store_true",
+                            help="show controller status (the default)")
+    f_converge.add_argument("--json", action="store_true")
 
     workload_p = sub.add_parser(
         "workload",
@@ -3714,6 +4106,17 @@ def build_parser() -> argparse.ArgumentParser:
                              "block, mid-wave rollback and controller-"
                              "death resume over a simulated fleet, each "
                              "asserted from the journal + span tree")
+    soak_p.add_argument("--converge", action="store_true",
+                        help="run the continuous-convergence drill "
+                             "instead: a fleet seeded with mixed drift "
+                             "(stale versions, an open circuit, "
+                             "mid-upgrade strands, a permanently-"
+                             "failing cluster) converges to zero "
+                             "actionable drift through the remediation "
+                             "queue within budgeted ticks; the broken "
+                             "cluster lands in `manual` and a stale-"
+                             "epoch controller tick is fenced to zero "
+                             "writes")
     soak_p.add_argument("--preemption", action="store_true",
                         help="run the multislice preemption drill "
                              "instead: a slice vanishes, the per-slice "
@@ -3733,7 +4136,8 @@ def build_parser() -> argparse.ArgumentParser:
                              "span tree per tenant, loss parity pinned "
                              "bit-for-bit")
     soak_p.add_argument("--clusters", type=int, default=21,
-                        help="fleet size for --fleet (floored at 9)")
+                        help="fleet size for --fleet (floored at 9) / "
+                             "--converge (floored at 12)")
     soak_p.add_argument("--controllers", type=int, default=0,
                         help="run the multi-controller kill drill instead: "
                              "N in-process replicas share one WAL db, one "

@@ -17,6 +17,9 @@ reusing every one of those primitives instead of re-inventing them:
                   mid-wave
   * rollback.py — re-journal the tripped wave's upgraded clusters as
                   `rollback` child ops back to their recorded versions
+  * converge.py — convergence planning: drift remediation set → one
+                  tick's prioritized, budget-bounded action batch (pure
+                  decisions; service/converge.py executes them)
 
 The fleet op itself is a journal row (resilience/journal.py open_fleet):
 a controller killed mid-rollout leaves an open fleet op whose `vars` carry
@@ -24,6 +27,14 @@ the full resumable state — the boot reconciler sweeps it to Interrupted and
 `koctl fleet resume` re-enters without re-running completed clusters.
 """
 
+from kubeoperator_tpu.fleet.converge import (
+    ConvergeConfig,
+    converge_kwargs,
+    ledger_gc,
+    note_attempt,
+    note_escalated,
+    plan_tick,
+)
 from kubeoperator_tpu.fleet.engine import FLEET_UPGRADE_KIND, FleetEngine
 from kubeoperator_tpu.fleet.gates import GateResult, evaluate_gate
 from kubeoperator_tpu.fleet.planner import (
@@ -40,4 +51,6 @@ from kubeoperator_tpu.fleet.rollback import rollback_wave
 __all__ = ["FLEET_UPGRADE_KIND", "FleetEngine", "GateResult",
            "evaluate_gate", "SELECTOR_KEYS", "eligible_clusters",
            "optional_int", "parse_selector", "plan_waves",
-           "rollback_wave", "upgrade_kwargs", "validate_selector"]
+           "rollback_wave", "upgrade_kwargs", "validate_selector",
+           "ConvergeConfig", "converge_kwargs", "ledger_gc",
+           "note_attempt", "note_escalated", "plan_tick"]

@@ -1,0 +1,219 @@
+"""Convergence planning — pure functions from a drift remediation set to
+one tick's action batch (docs/resilience.md "Fleet convergence").
+
+`detect_drift` (planner.py) says WHAT is wrong; this module decides WHAT
+TO DO ABOUT IT THIS TICK, and nothing else: no journal writes, no
+threads, no repos — `tests/test_converge.py` pins the whole decision
+table without a stack. The service layer (service/converge.py) feeds it
+the remediation set, the persisted attempt ledger, and the live-world
+gates (open circuits, outstanding work, a running rollout) and executes
+whatever comes back.
+
+Determinism is the contract everything above leans on: for a given
+remediation set + ledger + gates, the plan is bit-identical — actions
+sort by (action urgency, cluster name), every skip lands in the plan
+with its reason, and nothing reads clocks or randomness beyond the
+`now` the caller passes in. That is what lets the chaos-soak
+`--converge` drill diff two seeded 200-cluster runs bit-for-bit.
+
+The ledger is a JSON-plain dict (persisted inside the controller op's
+vars, so it survives controller restarts like every other durable
+state): `{cluster: {"attempts": int, "last_at": float, "action": str,
+"escalated": bool}}`. Cooldown and max-attempts read it; a cluster whose
+attempts are exhausted is escalated to `manual` — permanently-broken
+clusters page an operator instead of looping forever.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+# actionable remediation verbs in urgency order: a Failed cluster blocks
+# everything else on it (retry first), standing health conditions next
+# (recover), version skew last (upgrade — the slowest, most disruptive
+# verb). `wait` and `manual` are observations, not actions.
+ACTION_PRIORITY = ("retry", "recover", "upgrade")
+PASSIVE_ACTIONS = ("wait", "manual")
+
+# skip reasons the planner emits (the event stream's `reason` alphabet)
+SKIP_COOLDOWN = "cooldown"
+SKIP_BUDGET = "tick-budget"
+SKIP_OUTSTANDING = "outstanding"
+SKIP_CIRCUIT = "circuit-open"
+SKIP_ROLLOUT = "rollout-live"
+SKIP_ESCALATED = "attempts-exhausted"
+SKIP_PASSIVE = "passive"
+
+
+@dataclass(frozen=True)
+class ConvergeConfig:
+    """The `converge.*` config block (utils/config.py DEFAULTS) — the
+    controller posture; there are deliberately no per-call overrides:
+    convergence is a standing policy, not a one-shot verb."""
+
+    enabled: bool = False
+    interval_s: float = 60.0
+    max_actions_per_tick: int = 5
+    cooldown_s: float = 300.0
+    max_attempts: int = 3
+    priority: str = "scavenger"
+
+    @classmethod
+    def from_config(cls, config,
+                    section: str = "converge") -> "ConvergeConfig":
+        base = cls()
+        return cls(
+            enabled=bool(config.get(f"{section}.enabled", base.enabled)),
+            interval_s=float(config.get(
+                f"{section}.interval_s", base.interval_s)),
+            max_actions_per_tick=int(config.get(
+                f"{section}.max_actions_per_tick",
+                base.max_actions_per_tick)),
+            cooldown_s=float(config.get(
+                f"{section}.cooldown_s", base.cooldown_s)),
+            max_attempts=int(config.get(
+                f"{section}.max_attempts", base.max_attempts)),
+            priority=str(config.get(f"{section}.priority", base.priority)),
+        )
+
+
+def _urgency(action: str) -> int:
+    try:
+        return ACTION_PRIORITY.index(action)
+    except ValueError:
+        return len(ACTION_PRIORITY)
+
+
+def plan_tick(remediations: list, ledger: dict, cfg: ConvergeConfig,
+              now: float, outstanding=(), circuit_open=(),
+              rollout_live: bool = False) -> dict:
+    """One tick's decision: remediation set → `{"actions", "skips",
+    "escalations", "actionable"}`.
+
+    * `remediations` — `detect_drift`'s `[{cluster, action, detail}]`.
+    * `ledger` — the persisted per-cluster attempt record (read-only
+      here; the service applies `note_attempt` for every action it
+      actually submits).
+    * `outstanding` — `(cluster, action)` pairs already queued or in
+      flight; re-planning them would double-submit (the converge × queue
+      dedup contract).
+    * `circuit_open` — clusters whose watchdog circuit is open: the
+      operator owns them (`koctl watchdog reset`), remediation must not
+      fight the breaker.
+    * `rollout_live` — a fleet rollout is already running; `upgrade`
+      actions wait for it (one rollout at a time is FleetService law).
+
+    Actions come back sorted by (urgency, cluster) and truncated to
+    `max_actions_per_tick`; every non-acted remediation lands in `skips`
+    with its reason, so the event stream narrates the WHOLE decision,
+    not just the work. `escalations` lists clusters newly out of
+    attempts — the service marks their ledger rows escalated (their
+    future ticks skip as `attempts-exhausted`, their drift verdict
+    becomes `manual`). `actionable` counts remediations the controller
+    still owns — zero means converged (escalated, passive and
+    circuit-open clusters are the operator's, not the controller's:
+    an open breaker is an explicit hands-off signal)."""
+    outstanding = set(tuple(pair) for pair in outstanding)
+    circuit_open = set(circuit_open)
+    actions: list[dict] = []
+    skips: list[dict] = []
+    escalations: list[str] = []
+    actionable = 0
+    ordered = sorted(remediations,
+                     key=lambda r: (_urgency(str(r.get("action", ""))),
+                                    str(r.get("cluster", ""))))
+    for rem in ordered:
+        cluster = str(rem.get("cluster", ""))
+        action = str(rem.get("action", ""))
+        row = {"cluster": cluster, "action": action,
+               "detail": str(rem.get("detail", ""))}
+        entry = dict(ledger.get(cluster) or {})
+        if action in PASSIVE_ACTIONS or action not in ACTION_PRIORITY:
+            skips.append({**row, "reason": SKIP_PASSIVE})
+            continue
+        if entry.get("escalated"):
+            skips.append({**row, "reason": SKIP_ESCALATED})
+            continue
+        attempts = int(entry.get("attempts", 0))
+        if attempts >= cfg.max_attempts:
+            escalations.append(cluster)
+            skips.append({**row, "reason": SKIP_ESCALATED})
+            continue
+        if cluster in circuit_open:
+            skips.append({**row, "reason": SKIP_CIRCUIT})
+            continue
+        actionable += 1
+        if (cluster, action) in outstanding:
+            skips.append({**row, "reason": SKIP_OUTSTANDING})
+            continue
+        if action == "upgrade" and rollout_live:
+            skips.append({**row, "reason": SKIP_ROLLOUT})
+            continue
+        last_at = float(entry.get("last_at", 0.0))
+        if last_at and now - last_at < cfg.cooldown_s:
+            skips.append({**row, "reason": SKIP_COOLDOWN})
+            continue
+        if len(actions) >= max(cfg.max_actions_per_tick, 0):
+            skips.append({**row, "reason": SKIP_BUDGET})
+            continue
+        actions.append({**row, "attempt": attempts + 1})
+    return {"actions": actions, "skips": skips,
+            "escalations": escalations, "actionable": actionable}
+
+
+def note_attempt(ledger: dict, cluster: str, action: str,
+                 now: float) -> dict:
+    """Record one submitted remediation against the ledger (the service
+    calls this for every action it actually executes, then persists the
+    ledger with the same fenced save as the tick's event)."""
+    entry = dict(ledger.get(cluster) or {})
+    entry["attempts"] = int(entry.get("attempts", 0)) + 1
+    entry["last_at"] = float(now)
+    entry["action"] = action
+    entry.setdefault("escalated", False)
+    ledger[cluster] = entry
+    return entry
+
+
+def note_escalated(ledger: dict, cluster: str) -> dict:
+    """Flip a cluster's ledger row to escalated — out of attempts, owned
+    by the operator until the row is cleared (`ledger_gc` clears it the
+    tick after the cluster stops drifting)."""
+    entry = dict(ledger.get(cluster) or {})
+    entry["escalated"] = True
+    ledger[cluster] = entry
+    return entry
+
+
+def ledger_gc(ledger: dict, drifted_clusters) -> list[str]:
+    """Drop ledger rows for clusters that no longer drift — a cluster
+    that converged (or that an operator fixed by hand) starts its next
+    incident with a fresh attempt budget. Returns the cleared names
+    (sorted, for the tick event)."""
+    drifted = set(drifted_clusters)
+    cleared = sorted(name for name in ledger if name not in drifted)
+    for name in cleared:
+        del ledger[name]
+    return cleared
+
+
+def converge_kwargs(body: dict) -> dict:
+    """The body→`ConvergeService.run_once` translation both transports
+    share (REST POST handler and `LocalClient._dispatch`) — the
+    behavioral half of KO-X010 parity, mirroring `drift_kwargs`. The
+    only knob a single tick takes is `dry_run`: plan and narrate but
+    submit nothing."""
+    dry_run = body.get("dry_run", False)
+    if not isinstance(dry_run, bool):
+        raise_validation = True
+        # accept the query-param string forms the REST GET/POST surface
+        # carries ("true"/"false"/"1"/"0")
+        if isinstance(dry_run, str) and \
+                dry_run.lower() in ("true", "false", "1", "0", ""):
+            dry_run = dry_run.lower() in ("true", "1")
+            raise_validation = False
+        if raise_validation:
+            from kubeoperator_tpu.utils.errors import ValidationError
+
+            raise ValidationError("dry_run must be a boolean")
+    return {"dry_run": dry_run}

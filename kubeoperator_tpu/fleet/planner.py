@@ -14,8 +14,11 @@ import fnmatch
 from kubeoperator_tpu.utils.errors import ValidationError
 
 # `--selector key=value` keys `koctl fleet upgrade` accepts; `name` is an
-# fnmatch glob, the rest are exact matches
-SELECTOR_KEYS = ("name", "project", "plan", "version")
+# fnmatch glob, `names` a comma-separated EXACT cluster list (how the
+# convergence controller aims a rollout at precisely the clusters its
+# plan chose — a glob could accidentally widen the batch), the rest are
+# exact matches
+SELECTOR_KEYS = ("name", "names", "project", "plan", "version")
 
 
 def parse_selector(pairs: list[str] | None) -> dict:
@@ -152,6 +155,9 @@ def _matches(cluster, selector: dict, plan_names: dict,
              project_names: dict) -> bool:
     if "name" in selector and \
             not fnmatch.fnmatchcase(cluster.name, selector["name"]):
+        return False
+    if "names" in selector and \
+            cluster.name not in selector["names"].split(","):
         return False
     if "project" in selector and \
             project_names.get(cluster.project_id, "") != selector["project"]:

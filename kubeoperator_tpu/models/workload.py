@@ -68,7 +68,7 @@ def priority_of(priority_class: str) -> int:
 class QueueEntry(Entity):
     op_id: str = ""            # the entry's journal op (platform scope)
     tenant: str = ""           # checkpoint namespace + accounting label
-    kind: str = "train"        # train | sweep
+    kind: str = "train"        # train | sweep | remediation
     priority_class: str = "normal"
     priority: int = 20         # mirrored rank (priority_of at submit)
     state: str = "pending"
@@ -97,9 +97,13 @@ class QueueEntry(Entity):
 
     def validate(self) -> None:
         priority_of(self.priority_class)
-        if self.kind not in ("train", "sweep"):
+        # `remediation` entries are the convergence controller's ledgered
+        # housekeeping (service/converge.py): zero-slice gangs that ride
+        # the queue for ordering/audit, never for capacity
+        if self.kind not in ("train", "sweep", "remediation"):
             raise ValidationError(
-                f"queue entry kind {self.kind!r} not in ('train', 'sweep')")
+                f"queue entry kind {self.kind!r} not in "
+                f"('train', 'sweep', 'remediation')")
         if self.state not in QUEUE_STATES:
             raise ValidationError(
                 f"queue entry state {self.state!r} not in {QUEUE_STATES}")

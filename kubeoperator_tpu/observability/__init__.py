@@ -41,6 +41,7 @@ from kubeoperator_tpu.observability.logging import (
 )
 from kubeoperator_tpu.observability.events import (
     EventKind,
+    converge_story,
     emit_event,
     queue_story,
 )
@@ -50,5 +51,5 @@ __all__ = [
     "new_trace_id",
     "render_waterfall", "span_tree", "trace_context",
     "JsonLogFormatter", "bind_trace", "clear_trace", "current_trace",
-    "EventKind", "emit_event", "queue_story",
+    "EventKind", "converge_story", "emit_event", "queue_story",
 ]

@@ -63,6 +63,15 @@ class EventKind:
     QUEUE_DONE = "queue.done"
     # fleet wave verdicts (fleet/engine.py)
     FLEET_WAVE = "fleet.wave"
+    # convergence controller decisions (service/converge.py,
+    # docs/resilience.md "Fleet convergence"): tick ran / plan computed /
+    # action submitted / cluster skipped (cooldown, open circuit,
+    # attempts exhausted) / fleet reached zero actionable drift
+    CONVERGE_TICK = "fleet.converge.tick"
+    CONVERGE_PLAN = "fleet.converge.plan"
+    CONVERGE_ACT = "fleet.converge.act"
+    CONVERGE_SKIP = "fleet.converge.skip"
+    CONVERGE_CONVERGED = "fleet.converge.converged"
     # legacy cluster-timeline rows routed through service/event.py
     CLUSTER_EVENT = "cluster.event"
 
@@ -99,6 +108,36 @@ QUEUE_STORY_KINDS = (
     EventKind.QUEUE_SUBMIT, EventKind.QUEUE_PLACE, EventKind.QUEUE_PREEMPT,
     EventKind.QUEUE_DRAIN, EventKind.QUEUE_RESUME, EventKind.QUEUE_DONE,
 )
+
+
+# the convergence life in stream order — tick → plan → act/skip →
+# converged; the chaos-soak --converge drill's reducer alphabet
+CONVERGE_STORY_KINDS = (
+    EventKind.CONVERGE_TICK, EventKind.CONVERGE_PLAN,
+    EventKind.CONVERGE_ACT, EventKind.CONVERGE_SKIP,
+    EventKind.CONVERGE_CONVERGED,
+)
+
+
+def converge_story(events) -> list[dict]:
+    """Reconstruct the fleet's convergence narrative FROM THE EVENT
+    STREAM alone — no journal, settings, or span reads. Mirrors
+    `queue_story`: input is stream-ordered bus events, output the
+    compact story `koctl chaos-soak --converge` asserts on and diffs
+    bit-for-bit across seeded passes (no timestamps, no op ids)."""
+    story: list[dict] = []
+    for event in events:
+        if event.kind not in CONVERGE_STORY_KINDS:
+            continue
+        row = {"kind": event.kind}
+        for key in ("tick", "cluster", "action", "reason", "drifted",
+                    "actionable", "planned", "acted", "skipped",
+                    "attempt", "verdict"):
+            value = event.payload.get(key)
+            if value not in (None, ""):
+                row[key] = value
+        story.append(row)
+    return story
 
 
 def queue_story(events, tenant: str = "") -> list[dict]:

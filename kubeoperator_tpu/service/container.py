@@ -185,6 +185,14 @@ class Services:
         from kubeoperator_tpu.service.queue import WorkloadQueueService
 
         self.workload_queue = WorkloadQueueService(self)
+        # the convergence controller closes the drift loop: detect_drift's
+        # remediation set, re-run every `converge.interval_s`, becomes
+        # journaled remediation-tenant queue entries executed through the
+        # fleet/retry/recovery verbs above (docs/resilience.md "Fleet
+        # convergence"); the cron loop kicks it, always off-thread
+        from kubeoperator_tpu.service.converge import ConvergeService
+
+        self.converge = ConvergeService(self)
         self.cron = CronService(self)
         from kubeoperator_tpu.terminal import TerminalManager
 
@@ -203,6 +211,7 @@ class Services:
     def close(self) -> None:
         self.cron.stop()
         self.terminals.shutdown()
+        self.converge.wait_all()
         self.fleet.wait_all()
         self.clusters.wait_all()
         self.workload_queue.wait_all()

@@ -1,0 +1,479 @@
+"""ConvergeService — continuous fleet convergence: drift auto-remediation
+through the workload queue (docs/resilience.md "Fleet convergence").
+
+`koctl fleet drift` has always SAID what is wrong; this controller DOES
+something about it, on a cadence, through machinery that already exists:
+
+* each tick re-runs `detect_drift` and hands the remediation set to the
+  pure planner (fleet/converge.py) together with the persisted attempt
+  ledger and the live-world gates — open watchdog circuits, remediation
+  work already queued, a running fleet rollout;
+* every action the plan admits is submitted as a ledgered queue entry
+  under the `remediation` tenant (WorkloadQueueService.submit_remediation
+  — zero-slice gangs at `converge.priority`, scavenger by default, so
+  housekeeping never starves tenant training), and executed through the
+  existing verbs: upgrades ride `FleetService.upgrade` (live
+  max_unavailable budget, canary gates, auto-rollback — the controller
+  adds NO second rollout engine), retries re-enter at the first pending
+  phase (`ClusterService.retry`), recoveries run the watchdog's guided
+  escalation under its circuit budget;
+* the whole decision lands on the event bus — `fleet.converge.tick /
+  plan / act / skip / converged` — via the journal's fenced same-tx
+  save, so the convergence story reconstructs from the stream alone
+  (`observability.converge_story`, what `koctl chaos-soak --converge`
+  diffs bit-for-bit).
+
+Durability and fencing: the controller's state (attempt ledger, tick
+counter) lives in ONE long-lived platform-scope journal op
+(`fleet-converge`, scope `converge`). Each replica claims that op's
+lease when it first ticks; a successor's takeover bumps the epoch, and
+the old replica's next tick dies on its FIRST fenced save with
+StaleEpochError — zero writes, one `fence.rejected` event (the drill
+pins exactly this). A tick kicked from the cron loop runs on its own
+worker thread (`maybe_kick`), so a slow drift pass or a waited rollout
+can never starve the lease heartbeat.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from kubeoperator_tpu.fleet.converge import (
+    SKIP_BUDGET,
+    SKIP_PASSIVE,
+    ConvergeConfig,
+    ledger_gc,
+    note_attempt,
+    note_escalated,
+    plan_tick,
+)
+from kubeoperator_tpu.models import TERMINAL_STATES
+from kubeoperator_tpu.models.cluster import ConditionStatus
+from kubeoperator_tpu.observability import EventKind
+from kubeoperator_tpu.utils.errors import (
+    ConflictError,
+    KoError,
+    NotFoundError,
+    ValidationError,
+)
+from kubeoperator_tpu.utils.ids import now_ts
+from kubeoperator_tpu.utils.logging import get_logger
+
+log = get_logger("service.converge")
+
+CONVERGE_OP_KIND = "fleet-converge"
+
+# tick-batch submit failures ride the skip stream under this reason (the
+# planner's alphabet plus one service-layer entry)
+SKIP_SUBMIT_FAILED = "submit-failed"
+
+
+class ConvergeService:
+    def __init__(self, services) -> None:
+        self.s = services
+        self.repos = services.repos
+        self.journal = services.journal
+        self.cfg = ConvergeConfig.from_config(services.config)
+        # one tick at a time per process (run_once and the cron worker
+        # serialize here); _op is THIS replica's claimed controller op —
+        # deliberately cached in memory so a peer's takeover fences our
+        # next save instead of being silently re-read
+        self._tick_lock = threading.Lock()
+        self._lock = threading.Lock()
+        self._op = None
+        self._last_kick = 0.0
+        self._threads: list[threading.Thread] = []
+
+    # ------------------------------------------------------ controller op ----
+    def _controller_op(self):
+        """THE controller op — one durable `fleet-converge` journal row
+        holding the attempt ledger and tick counter. First tick of a
+        replica: adopt the newest existing op (`reopen` re-claims its
+        lease — ConflictError while a LIVE peer holds it, an epoch bump
+        when taking over from a dead one) or open a fresh one. Cached per
+        replica afterwards: the cached epoch is the fencing token."""
+        with self._lock:
+            if self._op is not None:
+                return self._op
+            op = self.repos.operations.latest(CONVERGE_OP_KIND)
+            if op is None:
+                op = self.journal.open_scoped(
+                    CONVERGE_OP_KIND,
+                    vars={"ledger": {}, "ticks": 0,
+                          "tenant": "remediation"},
+                    message="fleet convergence controller",
+                    scope="converge")
+            else:
+                op = self.journal.reopen(
+                    op, message="convergence controller attached")
+            self._op = op
+            return op
+
+    def _peek_op(self):
+        """Read-only view of the controller op for status()/metrics —
+        never claims, never reopens."""
+        with self._lock:
+            cached = self._op
+        try:
+            if cached is not None:
+                return self.repos.operations.get(cached.id)
+            return self.repos.operations.latest(CONVERGE_OP_KIND)
+        except NotFoundError:
+            return None
+
+    # ------------------------------------------------------------- gates ----
+    def _outstanding(self) -> list[tuple]:
+        """(cluster, action) pairs already ledgered on the queue and not
+        yet terminal — the dedup gate: a remediation in flight is not
+        re-submitted next tick. Batched upgrade entries expand to one
+        pair per cluster."""
+        pairs: list[tuple] = []
+        for entry in self.repos.workload_queue.list():
+            if entry.kind != "remediation" or entry.state in TERMINAL_STATES:
+                continue
+            try:
+                rem = dict(self.repos.operations.get(entry.op_id)
+                           .vars.get("remediation") or {})
+            except NotFoundError:
+                continue
+            action = str(rem.get("action", ""))
+            clusters = list(rem.get("clusters") or [])
+            if not clusters and rem.get("cluster"):
+                clusters = [str(rem["cluster"])]
+            pairs.extend((c, action) for c in clusters)
+        return pairs
+
+    def _circuit_open(self, drifted_clusters) -> list[str]:
+        """Drifted clusters whose watchdog circuit is open — the breaker
+        is an explicit hands-off signal remediation must respect."""
+        open_names: list[str] = []
+        for name in drifted_clusters:
+            try:
+                cluster = self.repos.clusters.get_by_name(name)
+            except NotFoundError:
+                continue
+            if self.s.watchdog.circuit_state(cluster.id) == "open":
+                open_names.append(name)
+        return sorted(open_names)
+
+    # -------------------------------------------------------------- tick ----
+    def run_once(self, dry_run: bool = False) -> dict:
+        """One synchronous convergence tick (`koctl fleet converge
+        --once`, POST /api/v1/fleet/converge, and the drill's loop). The
+        explicit verb works with `converge.enabled` off — the knob gates
+        only the cron auto-tick. `dry_run` plans and narrates but
+        submits nothing."""
+        with self._tick_lock:
+            return self._tick(dry_run=dry_run)
+
+    def _tick(self, dry_run: bool = False) -> dict:
+        op = self._controller_op()
+        drift = self.s.fleet.drift()
+        remediations = list(drift.get("remediations", []))
+        drifted_names = [d["cluster"] for d in drift.get("drifted", [])]
+        ledger = dict(op.vars.get("ledger") or {})
+        cleared = ledger_gc(ledger, drifted_names)
+        plan = plan_tick(
+            remediations, ledger, self.cfg, now=now_ts(),
+            outstanding=self._outstanding(),
+            circuit_open=self._circuit_open(drifted_names),
+            rollout_live=bool(self.s.fleet._live_rollouts()))
+        for cluster in plan["escalations"]:
+            note_escalated(ledger, cluster)
+        tick_no = int(op.vars.get("ticks", 0)) + 1
+        converged = plan["actionable"] == 0
+
+        # FIRST write of the tick: the fenced tick event. A stale-epoch
+        # replica dies exactly here — StaleEpochError, zero writes, one
+        # fence.rejected event from the journal (the drill's fencing pin).
+        op.vars["ticks"] = tick_no
+        op.vars["ledger"] = ledger
+        self._save(op, EventKind.CONVERGE_TICK,
+                   f"tick {tick_no}: {len(drifted_names)} drifted, "
+                   f"{plan['actionable']} actionable",
+                   {"tick": tick_no, "checked": drift.get("checked", 0),
+                    "drifted": len(drifted_names),
+                    "actionable": plan["actionable"],
+                    "planned": len(plan["actions"]),
+                    "skipped": len(plan["skips"]),
+                    "cleared": cleared,
+                    "target": drift.get("target_version", ""),
+                    "dry_run": dry_run})
+
+        skip_counts: dict[str, int] = {}
+        for skip in plan["skips"]:
+            reason = skip["reason"]
+            skip_counts[reason] = skip_counts.get(reason, 0) + 1
+        self._save(op, EventKind.CONVERGE_PLAN,
+                   f"tick {tick_no}: planned {len(plan['actions'])} "
+                   f"action(s)",
+                   {"tick": tick_no,
+                    "actions": [{"cluster": a["cluster"],
+                                 "action": a["action"],
+                                 "attempt": a["attempt"]}
+                                for a in plan["actions"]],
+                    "skip_counts": dict(sorted(skip_counts.items())),
+                    "escalations": list(plan["escalations"])})
+
+        # narrate the load-bearing skips individually; tick-budget and
+        # passive skips stay aggregate-only on the tick/plan events — a
+        # 200-cluster backlog must not write 195 skip rows per tick into
+        # a 5000-row retained stream
+        for skip in plan["skips"]:
+            if skip["reason"] in (SKIP_BUDGET, SKIP_PASSIVE):
+                continue
+            self._save(op, EventKind.CONVERGE_SKIP,
+                       f"tick {tick_no}: {skip['cluster']} skipped "
+                       f"({skip['reason']})",
+                       {"tick": tick_no, "cluster": skip["cluster"],
+                        "action": skip["action"],
+                        "reason": skip["reason"]})
+
+        acted, failed_submits = self._enact(
+            op, plan["actions"], ledger, tick_no,
+            target=str(drift.get("target_version", "")),
+            dry_run=dry_run)
+
+        op.vars["last"] = {
+            "tick": tick_no, "at": now_ts(), "dry_run": dry_run,
+            "target": drift.get("target_version", ""),
+            "checked": drift.get("checked", 0),
+            "in_sync": drift.get("in_sync", 0),
+            "drifted": len(drifted_names),
+            "actionable": plan["actionable"],
+            "planned": len(plan["actions"]),
+            "acted": acted, "failed_submits": failed_submits,
+            "skip_counts": dict(sorted(skip_counts.items())),
+            "escalations": list(plan["escalations"]),
+            "converged": converged,
+        }
+        if converged:
+            self._save(op, EventKind.CONVERGE_CONVERGED,
+                       f"tick {tick_no}: zero actionable drift "
+                       f"({drift.get('in_sync', 0)}/"
+                       f"{drift.get('checked', 0)} in sync)",
+                       {"tick": tick_no, "verdict": "converged",
+                        "drifted": len(drifted_names),
+                        "checked": drift.get("checked", 0)})
+        else:
+            self.journal.save_vars(op)
+        log.info("converge tick %d: drifted=%d actionable=%d acted=%d "
+                 "skipped=%d%s", tick_no, len(drifted_names),
+                 plan["actionable"], acted, len(plan["skips"]),
+                 " (dry-run)" if dry_run else "")
+        return {**op.vars["last"], "op_id": op.id,
+                "actions": plan["actions"], "skips": plan["skips"]}
+
+    def _save(self, op, kind: str, message: str, payload: dict) -> None:
+        """One fenced controller write: vars + bus event in the same
+        transaction (`journal.save_vars` — the event can never disagree
+        with the durable ledger it narrates)."""
+        self.journal.save_vars(op, event=(kind, message, payload))
+
+    def _enact(self, op, actions: list, ledger: dict, tick_no: int,
+               target: str, dry_run: bool) -> tuple[int, int]:
+        """Submit the tick's action batch to the queue: retries and
+        recoveries one entry per cluster, upgrades ONE batched entry for
+        the whole tick (a single rollout over an exact `names` selector —
+        the budget/canary machinery shines with the full batch, and one
+        rollout at a time is FleetService law). Returns (acted,
+        failed_submits)."""
+        if dry_run or not actions:
+            return 0, 0
+        acted = 0
+        failed = 0
+        upgrades = [a for a in actions if a["action"] == "upgrade"]
+        singles = [a for a in actions if a["action"] != "upgrade"]
+        now = now_ts()
+        for action in singles:
+            try:
+                self.s.workload_queue.submit_remediation(
+                    action["cluster"], action["action"],
+                    detail=action.get("detail", ""),
+                    priority=self.cfg.priority, kick=False)
+            except KoError as e:
+                failed += 1
+                note_attempt(ledger, action["cluster"],
+                             action["action"], now)
+                self._save(op, EventKind.CONVERGE_SKIP,
+                           f"tick {tick_no}: {action['cluster']} "
+                           f"{action['action']} submit failed: "
+                           f"{e.message}",
+                           {"tick": tick_no, "cluster": action["cluster"],
+                            "action": action["action"],
+                            "reason": SKIP_SUBMIT_FAILED})
+                continue
+            acted += 1
+            note_attempt(ledger, action["cluster"], action["action"], now)
+            self._save(op, EventKind.CONVERGE_ACT,
+                       f"tick {tick_no}: {action['action']} "
+                       f"{action['cluster']} (attempt "
+                       f"{action['attempt']})",
+                       {"tick": tick_no, "cluster": action["cluster"],
+                        "action": action["action"],
+                        "attempt": action["attempt"]})
+        if upgrades:
+            names = sorted(a["cluster"] for a in upgrades)
+            try:
+                self.s.workload_queue.submit_remediation(
+                    names[0], "upgrade",
+                    detail=f"fleet rollout of {len(names)} cluster(s) "
+                           f"to {target}",
+                    priority=self.cfg.priority, kick=False,
+                    payload={"clusters": names, "target": target})
+            except KoError as e:
+                failed += len(upgrades)
+                for action in upgrades:
+                    note_attempt(ledger, action["cluster"], "upgrade", now)
+                self._save(op, EventKind.CONVERGE_SKIP,
+                           f"tick {tick_no}: upgrade batch submit "
+                           f"failed: {e.message}",
+                           {"tick": tick_no, "cluster": names[0],
+                            "action": "upgrade",
+                            "reason": SKIP_SUBMIT_FAILED})
+            else:
+                for action in upgrades:
+                    acted += 1
+                    note_attempt(ledger, action["cluster"], "upgrade", now)
+                    self._save(op, EventKind.CONVERGE_ACT,
+                               f"tick {tick_no}: upgrade "
+                               f"{action['cluster']} -> {target} "
+                               f"(attempt {action['attempt']})",
+                               {"tick": tick_no,
+                                "cluster": action["cluster"],
+                                "action": "upgrade",
+                                "attempt": action["attempt"]})
+        if acted:
+            # one engine drive for the whole batch, on THIS thread (the
+            # tick already runs off the cron loop — see maybe_kick)
+            self.s.workload_queue.process(wait=True)
+        return acted, failed
+
+    # ----------------------------------------------------------- execute ----
+    def execute(self, rem: dict) -> dict:
+        """Run one queued remediation entry's verb — called by the queue
+        engine (`WorkloadQueueService._run_remediation`), never directly.
+        All three verbs are the EXISTING machinery; the controller adds
+        decisions, not mechanisms."""
+        action = str(rem.get("action", ""))
+        cluster = str(rem.get("cluster", ""))
+        if action == "retry":
+            self.s.clusters.retry(cluster, wait=True)
+            row = self.s.clusters.get(cluster)
+            ok = row.status.phase == "Ready"
+            return {"ok": ok,
+                    "message": f"retry: {cluster} -> {row.status.phase}"}
+        if action == "recover":
+            row = self.s.clusters.get(cluster)
+            report = self.s.health.check(cluster)
+            if not report.healthy:
+                # the watchdog's guided escalation, under its own circuit
+                # budget; then re-probe for the verdict
+                self.s.watchdog.observe(row, report)
+                report = self.s.health.check(cluster)
+                row = self.s.clusters.get(cluster)
+            bad = sorted(
+                c.name for c in row.status.conditions
+                if self._health_marker(c))
+            return {"ok": report.healthy and not bad,
+                    "message": (f"recover: {cluster} healthy"
+                                if report.healthy and not bad else
+                                f"recover: {cluster} still degraded "
+                                f"({', '.join(bad) or 'probe failed'})")}
+        if action == "upgrade":
+            clusters = list(rem.get("clusters") or ([cluster] if cluster
+                                                    else []))
+            target = str(rem.get("target", ""))
+            desc = self.s.fleet.upgrade(
+                target, selector={"names": ",".join(sorted(clusters))},
+                wait=True)
+            ok = desc.get("status") == "Succeeded"
+            return {"ok": ok,
+                    "message": f"upgrade to {target}: {desc.get('status')}"
+                               f" ({len(desc.get('completed', []))}/"
+                               f"{len(clusters)} upgraded)"}
+        raise ValidationError(f"unknown remediation action {action!r}")
+
+    @staticmethod
+    def _health_marker(condition) -> bool:
+        from kubeoperator_tpu.service.watchdog import is_health_condition
+
+        return (is_health_condition(condition.name)
+                and condition.status == ConditionStatus.FAILED.value)
+
+    # --------------------------------------------------------- cron kick ----
+    def maybe_kick(self) -> bool:
+        """The cron loop's integration point (CronService._loop): when
+        enabled and `converge.interval_s` has elapsed, start ONE tick on
+        a worker thread and return immediately. The cron thread never
+        waits on a tick — the lease heartbeat must keep its cadence no
+        matter how slow a drift pass or a waited rollout is."""
+        if not self.cfg.enabled:
+            return False
+        now = time.monotonic()
+        with self._lock:
+            if any(t.is_alive() for t in self._threads):
+                return False
+            if self._last_kick and now - self._last_kick \
+                    < self.cfg.interval_s:
+                return False
+            self._last_kick = now
+            thread = threading.Thread(target=self._tick_guarded,
+                                      daemon=True, name="fleet-converge")
+            self._threads = [t for t in self._threads if t.is_alive()]
+            self._threads.append(thread)
+        thread.start()
+        return True
+
+    def _tick_guarded(self) -> None:
+        from kubeoperator_tpu.resilience.lease import StaleEpochError
+
+        try:
+            self.run_once()
+        except StaleEpochError as e:
+            # fenced out: a successor replica owns convergence now — this
+            # replica's controller op cache is poison, drop it so a later
+            # legitimate re-attach re-claims cleanly
+            log.warning("converge tick fenced out: %s", e)
+            with self._lock:
+                self._op = None
+        except ConflictError as e:
+            log.warning("converge tick skipped: %s", e)
+        except Exception:
+            log.exception("converge tick failed")
+
+    def wait_all(self, timeout_s: float = 60.0) -> None:
+        """Join worker ticks (container close)."""
+        with self._lock:
+            threads = list(self._threads)
+        for t in threads:
+            t.join(timeout_s)
+        with self._lock:
+            self._threads = [t for t in self._threads if t.is_alive()]
+
+    # ------------------------------------------------------------ status ----
+    def status(self) -> dict:
+        """`koctl fleet converge` / GET /api/v1/fleet/converge: the
+        controller posture, the last tick's summary, the attempt ledger,
+        and the remediation work still on the queue. Read-only — never
+        claims the controller op."""
+        op = self._peek_op()
+        outstanding = [{"cluster": c, "action": a}
+                       for c, a in sorted(set(self._outstanding()))]
+        return {
+            "enabled": self.cfg.enabled,
+            "interval_s": self.cfg.interval_s,
+            "max_actions_per_tick": self.cfg.max_actions_per_tick,
+            "cooldown_s": self.cfg.cooldown_s,
+            "max_attempts": self.cfg.max_attempts,
+            "priority": self.cfg.priority,
+            "op_id": op.id if op is not None else "",
+            "op_status": op.status if op is not None else "",
+            "ticks": int(op.vars.get("ticks", 0)) if op is not None else 0,
+            "ledger": dict(op.vars.get("ledger") or {})
+            if op is not None else {},
+            "last": dict(op.vars.get("last") or {})
+            if op is not None else {},
+            "outstanding": outstanding,
+        }

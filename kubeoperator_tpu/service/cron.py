@@ -200,9 +200,26 @@ class CronService:
             log.exception("lease sweep failed")
         return actions
 
+    def converge_tick(self) -> bool:
+        """Kick the convergence controller on the loop's 10s cadence —
+        `maybe_kick` rate-limits to `converge.interval_s` and starts the
+        tick on ITS OWN worker thread, so this call returns in
+        microseconds and the lease heartbeat above never waits behind a
+        drift pass or a remediation rollout (the heartbeat-starvation
+        regression test pins exactly this)."""
+        converge = getattr(self.services, "converge", None)
+        if converge is None:
+            return False
+        try:
+            return converge.maybe_kick()
+        except Exception:
+            log.exception("converge kick failed")
+            return False
+
     def _loop(self) -> None:
         while not self._stop.wait(10.0):
             self.lease_tick()
+            self.converge_tick()
             now = datetime.now().replace(second=0, microsecond=0)
             if self._last_tick is None:
                 self._last_tick = now - timedelta(minutes=1)

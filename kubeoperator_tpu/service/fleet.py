@@ -244,17 +244,33 @@ class FleetService:
               selector: dict | None = None) -> dict:
         """`koctl fleet drift`: READ-ONLY fleet-wide drift detection —
         observed version/health vs the plan, with the would-be
-        remediation set as JSON (nothing queued; the auto-queue leg is a
-        future PR). The default target is the newest rollout's — one
-        indexed probe, not a history hydration."""
+        remediation set as JSON (nothing queued here; the convergence
+        controller, service/converge.py, is the auto-queue leg). The
+        default target is the newest rollout's — one indexed probe, not
+        a history hydration. With NO rollout history the verb no longer
+        raises: it falls back to the newest version the fleet's own
+        cluster specs record (version-skew-only detection — clusters
+        behind their peers), marked `inferred: false` in the payload so
+        a consumer knows no operator or rollout ever named that target.
+        The explicit `--target` path is unchanged."""
         selector = validate_selector(dict(selector or {}))
+        inferred: bool | None = None
         if not target_version:
             latest = self.repos.operations.latest(FLEET_UPGRADE_KIND)
-            if latest is None:
-                raise ValidationError(
-                    "no rollout history to infer a target from; pass "
-                    "--target explicitly")
-            target_version = str(latest.vars.get("target_version", ""))
+            if latest is not None:
+                target_version = str(latest.vars.get("target_version", ""))
+                inferred = True
+            else:
+                inferred = False
+                present = {c.spec.k8s_version
+                           for c in self.repos.clusters.list()
+                           if c.provision_mode != "imported"}
+                ranked = [v for v in SUPPORTED_K8S_VERSIONS
+                          if v in present]
+                # no managed clusters at a bundled version = no skew to
+                # measure; detect_drift with an empty target still
+                # reports phase/health drift
+                target_version = ranked[-1] if ranked else ""
         if target_version and \
                 target_version not in SUPPORTED_K8S_VERSIONS:
             raise ValidationError(
@@ -282,8 +298,11 @@ class FleetService:
                 if is_health_condition(c.name)
                 and c.status == ConditionStatus.FAILED.value)
 
-        return detect_drift(self.repos, selector, target_version,
-                            hop_check, health_failed)
+        result = detect_drift(self.repos, selector, target_version,
+                              hop_check, health_failed)
+        if inferred is not None:
+            result["inferred"] = inferred
+        return result
 
     def describe(self, op: Operation) -> dict:
         v = op.vars

@@ -60,6 +60,11 @@ log = get_logger("service.queue")
 
 QUEUE_ENTRY_KIND = "workload-queued"
 
+# the accounting tenant the convergence controller's remediation entries
+# ledger under (service/converge.py) — platform housekeeping, visibly
+# separate from every real tenant in `koctl workload queue`
+REMEDIATION_TENANT = "remediation"
+
 _TENANT_RE = re.compile(r"^[a-z0-9][a-z0-9_-]{0,62}$")
 
 
@@ -193,6 +198,58 @@ class WorkloadQueueService:
         # running train's step hook — process() returns immediately and
         # the owning loop picks the entry up at its next boundary.
         self.process(wait=wait)
+        return self.status(entry.id)
+
+    def submit_remediation(self, cluster: str, action: str,
+                           detail: str = "", priority: str = "",
+                           payload: dict | None = None,
+                           kick: bool = True,
+                           wait: bool = False) -> dict:
+        """Admit one convergence remediation as ledgered queue work
+        (service/converge.py — the controller's ONLY write path into the
+        fleet). Remediation entries are zero-slice gangs under the
+        `remediation` tenant: they ride the queue for ordering, audit and
+        the event stream, never for capacity — they cannot block, preempt
+        or be preempted (workloads/queue.py). `kick=False` lets the
+        controller batch a tick's submissions and drive the engine
+        once."""
+        priority = priority or "scavenger"
+        rank = priority_of(priority)
+        if action not in ("retry", "recover", "upgrade"):
+            raise ValidationError(
+                f"remediation action {action!r} not in "
+                f"('retry', 'recover', 'upgrade')")
+        counts = self.repos.workload_queue.counts_by_state()
+        live = sum(n for state, n in counts.items()
+                   if state not in TERMINAL_STATES)
+        if live >= self.max_entries:
+            raise ValidationError(
+                f"queue is full ({live}/{self.max_entries} live "
+                f"entries; queue.max_entries)")
+        remediation = {"cluster": cluster, "action": action,
+                       "detail": detail, **dict(payload or {})}
+        op = self.journal.open_scoped(
+            QUEUE_ENTRY_KIND,
+            vars={"tenant": REMEDIATION_TENANT,
+                  "remediation": remediation},
+            message=f"remediation {action} for {cluster} ({priority})",
+            scope="workload")
+        entry = QueueEntry(
+            op_id=op.id, tenant=REMEDIATION_TENANT, kind="remediation",
+            priority_class=priority, priority=rank,
+            steps=0, devices=0)
+        entry.validate()
+        self.repos.workload_queue.save(entry)
+        self._sync_op(entry, op=op, event=(
+            EventKind.QUEUE_SUBMIT,
+            f"remediation {action} for {cluster} submitted at {priority}",
+            {"state": entry.state, "priority": priority,
+             "cluster": cluster, "action": action}))
+        log.info("remediation %s queued: %s %s priority=%s",
+                 entry.id[:8], action, cluster, priority)
+        self.schedule()
+        if kick:
+            self.process(wait=wait)
         return self.status(entry.id)
 
     # ---------------------------------------------------------- capacity ----
@@ -433,6 +490,9 @@ class WorkloadQueueService:
             entry.state = "running"
             self.repos.workload_queue.save(entry)
             self._sync_op(entry, op=op)
+        if entry.kind == "remediation":
+            self._run_remediation(entry)
+            return
         trace = ({"trace_id": op.trace_id, "parent_span_id": op.id}
                  if op.trace_id else None)
         try:
@@ -482,6 +542,33 @@ class WorkloadQueueService:
         else:
             self._finish(entry, "failed",
                          run_desc.get("message", "run unhealthy"))
+
+    def _run_remediation(self, entry: QueueEntry) -> None:
+        """Dispatch one remediation entry through the convergence
+        controller's execute seam (retry / recover / fleet-upgrade batch)
+        and fold the verdict back into queue state. The entry op closes
+        done/failed like any run; the converge tick's own attempt ledger
+        and events are the controller's (service/converge.py)."""
+        rem = dict(self.repos.operations.get(entry.op_id)
+                   .vars.get("remediation") or {})
+        converge = getattr(self.s, "converge", None)
+        try:
+            if converge is None:
+                raise ValidationError(
+                    "no convergence controller is wired for remediation "
+                    "entries")
+            result = converge.execute(rem)
+            ok = bool(result.get("ok"))
+            message = str(result.get("message", ""))
+        except Exception as e:
+            ok, message = False, f"{type(e).__name__}: {e}"
+        finally:
+            with self._lock:
+                self._running_id = ""
+        entry = self.repos.workload_queue.get(entry.id)
+        entry.placement = []
+        entry.preempted_by = ""
+        self._finish(entry, "done" if ok else "failed", message)
 
     def _handle_drained(self, entry: QueueEntry, run_desc: dict,
                         result: dict) -> None:

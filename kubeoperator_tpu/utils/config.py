@@ -190,6 +190,36 @@ DEFAULTS: dict[str, Any] = {
         # per rollout.
         "max_concurrent_clusters": 1,
     },
+    "converge": {
+        # continuous fleet convergence (service/converge.py,
+        # docs/resilience.md "Fleet convergence"): each tick re-runs the
+        # drift detector and submits the remediation set as journaled ops
+        # through the existing machinery — upgrades ride the fleet
+        # rollout engine (live max_unavailable budget, canary gates,
+        # auto-rollback), retries/recoveries ride the journal retry and
+        # guided-recovery verbs. Off by default: drift detection stays
+        # read-only until an operator opts the controller in.
+        "enabled": False,
+        # seconds between convergence ticks on the cron loop's cadence
+        # (the tick itself runs OFF the cron thread so it can never
+        # starve the lease heartbeat)
+        "interval_s": 60,
+        # actions submitted per tick across the whole fleet — the
+        # controller's own blast-radius bound on top of the rollout
+        # engine's max_unavailable budget
+        "max_actions_per_tick": 5,
+        # per-cluster quiet period after an attempted remediation; the
+        # same cluster is not re-acted-on until this much time has passed
+        "cooldown_s": 300,
+        # remediation attempts per cluster before the controller stops
+        # retrying and escalates the cluster to `manual` (a permanently
+        # broken cluster must page an operator, not loop forever)
+        "max_attempts": 3,
+        # priority class remediation work is ledgered at on the workload
+        # queue's tenant ledger (scavenger by default so housekeeping
+        # never starves tenant training; promotable to low/normal/high)
+        "priority": "scavenger",
+    },
     "workloads": {
         # sharded-training tenant workload defaults (service/workload.py,
         # docs/workloads.md); `koctl workload train` flags override these

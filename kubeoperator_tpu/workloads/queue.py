@@ -60,7 +60,10 @@ def plan_aging(pending, now: float, after_s: float) -> list[tuple]:
         return []
     decisions: list[tuple] = []
     for entry in pending:
-        if entry.kind == "sweep":
+        # sweeps honour the scavenger contract; remediation entries keep
+        # whatever class `converge.priority` ledgered them at — aging a
+        # housekeeping verb above tenant work would invert the policy
+        if entry.kind in ("sweep", "remediation"):
             continue
         promoted = next_class(entry.priority_class)
         if promoted is None:
@@ -174,7 +177,13 @@ def plan_schedule(pending, active, pool: SlicePoolView,
     placements: dict = {}
     chips = pool.chips_per_slice
     for entry in pending:
-        needed = slices_needed(entry.devices, chips)
+        # remediation entries are zero-slice gangs: they ride the queue
+        # for ordering and audit, not capacity — always placeable, never
+        # a head-of-line blocker, never a preemptor (choose_victims only
+        # fires when a gang fails to fit) and never a victim
+        # (choose_victims requires a truthy placement)
+        needed = 0 if entry.kind == "remediation" \
+            else slices_needed(entry.devices, chips)
         placed = pool.place(entry.id, needed)
         if placed is not None:
             placements[entry.id] = placed

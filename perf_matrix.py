@@ -572,6 +572,59 @@ def write_artifacts(results: dict, round_no: int,
                 f"{row['serial_clusters_per_s']} | "
                 f"{row['concurrent_clusters_per_s']} | "
                 f"{'yes' if row['ok'] else 'NO'} |")
+    # analyzer gate rows (`perf_matrix.py --analyzer`,
+    # docs/analysis.md): rendered from the newest round like the other
+    # single-section harnesses
+    analyzer_rounds = history.get("analyzer") or {}
+    if analyzer_rounds:
+        a_round = str(max(int(k) for k in analyzer_rounds))
+        lines += [
+            "",
+            f"## analyzer (round {a_round})",
+            "",
+            "ko-analyze full-tree run with the KO-S SQL family enabled "
+            "(schema model folded from the migrations + extracted",
+            "statements across repository/api/cli; the SQL rules run "
+            "fresh each run over cached per-file facts, so they cost",
+            "the same warm or cold).",
+            "",
+            "| rules | files | cold (s) | warm cache (s) | "
+            "gate budget (s) | ok |",
+            "|---|---|---|---|---|---|",
+        ]
+        for row in analyzer_rounds[a_round].get("rows", []):
+            lines.append(
+                f"| {row['rules']} | {row['files']} | {row['cold_s']} | "
+                f"{row['warm_s']} | {row['budget']} | "
+                f"{'yes' if row['ok'] else 'NO'} |")
+    # convergence-controller rows (`perf_matrix.py --converge`,
+    # docs/resilience.md "Fleet convergence"): rendered from the newest
+    # round like the other single-section harnesses
+    converge_rounds = history.get("converge") or {}
+    if converge_rounds:
+        c_round = str(max(int(k) for k in converge_rounds))
+        lines += [
+            "",
+            f"## converge (round {c_round})",
+            "",
+            "Convergence controller (`python perf_matrix.py "
+            "--converge`): a fleet of simulated v5e-16 clusters, all",
+            "but one a version hop behind, driven to zero actionable "
+            "drift by `converge.run_once()` ticks through the",
+            "remediation queue (batched fleet upgrades under the live "
+            "unavailability budget).",
+            "",
+            "| clusters | backlog | actions/tick cap | ticks | actions "
+            "| actions/tick | mean tick (s) | clusters/s | ok |",
+            "|---|---|---|---|---|---|---|---|---|",
+        ]
+        for row in converge_rounds[c_round].get("rows", []):
+            lines.append(
+                f"| {row['clusters']} | {row['backlog']} | "
+                f"{row['max_actions_per_tick']} | {row['ticks']} | "
+                f"{row['actions_total']} | {row['actions_per_tick']} | "
+                f"{row['mean_tick_s']} | {row['clusters_per_s']} | "
+                f"{'yes' if row['ok'] else 'NO'} |")
     if traces:
         lines += [
             "",
@@ -962,6 +1015,144 @@ def record_fleet(report: dict, round_no: int | None = None) -> int:
     return _record_section("fleet", report, round_no)
 
 
+def run_converge(clusters: int = 20, max_actions: int = 8) -> dict:
+    """The CI face of the convergence controller (service/converge.py):
+    a fleet of `clusters` simulated v5e-16 clusters, all but one a full
+    version hop behind, driven to zero actionable drift by
+    `converge.run_once()` ticks (the one ahead cluster is the peer the
+    no-history target inference reads). Measures ticks-to-convergence,
+    remediation actions per tick and clusters remediated per second —
+    the budget the tier-1 gate pins is 'a 20-cluster backlog converges
+    deterministically in ceil(backlog/cap)+1 ticks under a CI-safe
+    wall-clock'."""
+    import tempfile
+    import time as _time
+
+    from kubeoperator_tpu.fleet.drill import seed_clone_fleet
+    from kubeoperator_tpu.models import Plan, Region, Zone
+    from kubeoperator_tpu.service import build_services
+    from kubeoperator_tpu.utils.config import load_config
+    from kubeoperator_tpu.version import (
+        DEFAULT_K8S_VERSION,
+        SUPPORTED_K8S_VERSIONS,
+    )
+
+    hop = SUPPORTED_K8S_VERSIONS.index(DEFAULT_K8S_VERSION) + 1
+    if hop >= len(SUPPORTED_K8S_VERSIONS):
+        return {"ok": False, "rows": [],
+                "error": "no upgrade hop above the default version"}
+    target = SUPPORTED_K8S_VERSIONS[hop]
+    ticks = 0
+    tick_walls: list[float] = []
+    actions_total = 0
+    with tempfile.TemporaryDirectory(prefix="ko-converge-perf-") as base:
+        config = load_config(path="/nonexistent", env={}, overrides={
+            "db": {"path": os.path.join(base, "converge.db")},
+            "logging": {"level": "ERROR"},
+            "executor": {"backend": "simulation"},
+            "provisioner": {"work_dir": os.path.join(base, "tf")},
+            "cron": {"backup_enabled": False,
+                     "health_check_interval_s": 0,
+                     "event_sync_interval_s": 0},
+            "cluster": {"kubeconfig_dir": os.path.join(base, "kc")},
+            "converge": {"enabled": False, "cooldown_s": 0,
+                         "max_actions_per_tick": max_actions},
+        })
+        svc = build_services(config, simulate=True)
+        try:
+            region = svc.regions.create(Region(
+                name="perf-region", provider="gcp_tpu_vm",
+                vars={"project": "perf", "name": "us-central1"}))
+            zone = svc.zones.create(Zone(
+                name="perf-zone", region_id=region.id,
+                vars={"gcp_zone": "us-central1-a"}))
+            svc.plans.create(Plan(
+                name="perf-v5e-16", provider="gcp_tpu_vm",
+                region_id=region.id, zone_ids=[zone.id],
+                accelerator="tpu", tpu_type="v5e-16", worker_count=0))
+            names = seed_clone_fleet(
+                svc, "perf-v5e-16", {"a": 1, "b": clusters - 1},
+                prefix="perf", template="perf-tpl")
+            row = svc.repos.clusters.get_by_name(names["a"][0])
+            row.spec.k8s_version = target
+            svc.repos.clusters.save(row)
+            # the template rides along as one more behind cluster
+            backlog = clusters - 1 + 1
+            tick_limit = -(-backlog // max_actions) + 2
+            converged = False
+            t_all = _time.perf_counter()
+            for _ in range(tick_limit):
+                t0 = _time.perf_counter()
+                last = svc.converge.run_once()
+                tick_walls.append(_time.perf_counter() - t0)
+                ticks += 1
+                actions_total += int(last.get("acted", 0))
+                if last.get("converged"):
+                    converged = True
+                    break
+            total_s = _time.perf_counter() - t_all
+            stale = [n for n in names["b"] + ["perf-tpl"]
+                     if svc.clusters.get(n).spec.k8s_version != target]
+            ok = converged and not stale
+        finally:
+            svc.close()
+    row = {
+        "clusters": clusters,
+        "backlog": backlog,
+        "max_actions_per_tick": max_actions,
+        "ticks": ticks,
+        "actions_total": actions_total,
+        "actions_per_tick": round(actions_total / ticks, 2)
+        if ticks else 0.0,
+        "mean_tick_s": round(sum(tick_walls) / len(tick_walls), 3)
+        if tick_walls else 0.0,
+        "clusters_per_s": round(backlog / total_s, 2)
+        if total_s > 0 else 0.0,
+        "ok": ok,
+    }
+    return {"ok": ok, "rows": [row]}
+
+
+def record_converge(report: dict, round_no: int | None = None) -> int:
+    """`perf_matrix.py --converge` hook."""
+    return _record_section("converge", report, round_no)
+
+
+def run_analyzer() -> dict:
+    """The static gate's cost row (`koctl lint` / docs/analysis.md): one
+    cold full-tree ko-analyze run into a throwaway cache, then a warm
+    re-run over the same cache — the two numbers the tier-1 budget tests
+    in tests/test_static_gate.py gate (7s cold / 1.5s warm)."""
+    import tempfile
+    import time as _time
+
+    from kubeoperator_tpu.analysis import RULES, run_analysis
+
+    with tempfile.TemporaryDirectory(prefix="ko-analyze-perf-") as base:
+        cache_dir = os.path.join(base, "cache")
+        t0 = _time.perf_counter()
+        report = run_analysis(cache_dir=cache_dir)
+        cold_s = _time.perf_counter() - t0
+        t0 = _time.perf_counter()
+        warm = run_analysis(cache_dir=cache_dir)
+        warm_s = _time.perf_counter() - t0
+    ok = report.exit_code() == 0 and warm.exit_code() == 0
+    row = {
+        "rules": len(RULES),
+        "files": report.files_scanned,
+        "cold_s": round(cold_s, 1),
+        "warm_s": round(warm_s, 1),
+        "budget": "7.0 cold / 1.5 warm",
+        "ok": ok,
+    }
+    return {"ok": ok, "rows": [row]}
+
+
+def record_analyzer(report: dict, round_no: int | None = None) -> int:
+    """`perf_matrix.py --analyzer` hook."""
+    return _record_section("analyzer", report, round_no)
+
+
 def run_events(readers: int = 4, fanout_creates: int = 3) -> dict:
     """The CI face of the live-telemetry layer (ISSUE 14): two measured
     phases committed as a PERF "events" row.
@@ -1138,7 +1329,31 @@ def main(argv: list | None = None) -> int:
                              "simulated clusters, wave-span windows "
                              "compared) and record its row under the "
                              "round")
+    parser.add_argument("--converge", action="store_true",
+                        help="run ONLY the convergence-controller "
+                             "benchmark (a version-behind fleet driven "
+                             "to zero actionable drift by converge "
+                             "ticks; ticks-to-convergence and "
+                             "actions/tick) and record its row under "
+                             "the round")
+    parser.add_argument("--analyzer", action="store_true",
+                        help="run ONLY the static-gate cost pass (one "
+                             "cold full-tree ko-analyze run + one warm "
+                             "cache re-run) and record its row under "
+                             "the round")
     args = parser.parse_args(argv)
+    if args.analyzer:
+        report = run_analyzer()
+        round_no = record_analyzer(report, args.round)
+        print(json.dumps({"round": round_no, "analyzer": report},
+                         indent=2))
+        return 0 if report["ok"] else 1
+    if args.converge:
+        report = run_converge()
+        round_no = record_converge(report, args.round)
+        print(json.dumps({"round": round_no, "converge": report},
+                         indent=2))
+        return 0 if report["ok"] else 1
     if args.events:
         report = run_events()
         round_no = record_events(report, args.round)

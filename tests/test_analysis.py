@@ -1273,6 +1273,72 @@ class TestEventDiscipline:  # KO-P012
         assert findings == [], [f"{f.file}:{f.line}" for f in findings]
 
 
+class TestEventKindDiscipline:  # KO-P013
+    def test_fires_on_typoed_literal_kind(self, tmp_path):
+        src = (
+            "def note(self, repos):\n"
+            "    emit_event(repos, 'fleet.convrge.tick', message='x')\n"
+        )
+        findings = ast_findings(tmp_path, src, "KO-P013",
+                                rel="service/x.py")
+        assert [f.rule for f in findings] == ["KO-P013"]
+        assert "fleet.convrge.tick" in findings[0].message
+
+    def test_fires_on_kind_keyword_and_method_form(self, tmp_path):
+        src = (
+            "def note(self, repos):\n"
+            "    obs.emit_event(repos, kind='queue.sumbit')\n"
+        )
+        findings = ast_findings(tmp_path, src, "KO-P013",
+                                rel="service/x.py")
+        assert [f.rule for f in findings] == ["KO-P013"]
+
+    def test_quiet_on_vocabulary_members_and_prefix_families(
+            self, tmp_path):
+        src = (
+            "def note(self, repos, k):\n"
+            "    emit_event(repos, 'queue.submit')\n"
+            "    emit_event(repos, 'fleet.converge.tick')\n"
+            # SLICE_PREFIX declares the open dotted family
+            "    emit_event(repos, 'slice.detected')\n"
+            # computed kinds resolve FROM the vocabulary class — pass
+            "    emit_event(repos, EventKind.CONVERGE_ACT)\n"
+            "    emit_event(repos, k)\n"
+            "    emit_event(repos, f'slice.{k}')\n"
+            # other callables are not the funnel
+            "    record_event(repos, 'totally.bogus')\n"
+        )
+        assert ast_findings(tmp_path, src, "KO-P013",
+                            rel="service/x.py") == []
+
+    def test_vocabulary_reads_the_analyzed_tree_not_the_package(
+            self, tmp_path):
+        """A --root tree shipping its OWN EventKind is checked against
+        that alphabet: kinds the installed package never heard of pass,
+        and `*_PREFIX` members declare families."""
+        root = make_tree(tmp_path, {
+            "observability/events.py":
+                "class EventKind:\n"
+                "    CUSTOM = 'my.kind'\n"
+                "    FAM_PREFIX = 'fam.'\n",
+            "service/x.py":
+                "def note(repos):\n"
+                "    emit_event(repos, 'my.kind')\n"
+                "    emit_event(repos, 'fam.anything')\n"
+                "    emit_event(repos, 'queue.submit')\n",
+        })
+        findings, _scanned = run_ast_rules(root, {"KO-P013"})
+        assert [f.rule for f in findings] == ["KO-P013"]
+        assert "queue.submit" in findings[0].message
+
+    def test_real_tree_speaks_only_the_vocabulary(self):
+        import kubeoperator_tpu
+
+        root = os.path.dirname(kubeoperator_tpu.__file__)
+        findings, _scanned = run_ast_rules(root, {"KO-P013"})
+        assert findings == [], [f"{f.file}:{f.line}" for f in findings]
+
+
 # ------------------------------------------------------- contract rules ----
 def index_for(tmp_path, files: dict):
     """Build a ProjectIndex over a fixture tree (the injection path the

@@ -1,0 +1,536 @@
+"""Continuous fleet convergence (ISSUE 17): drift auto-remediation
+through the workload queue.
+
+Tiers:
+  * pure planner (fleet/converge.py) — the whole per-tick decision
+    table with no stack: urgency order, passive skips, cooldown,
+    tick budget, outstanding dedup, circuit/rollout gates, escalation,
+    bit-for-bit determinism;
+  * converge x queue contracts at the decision layer — a remediation
+    entry is a zero-slice gang: placeable anywhere, never a preemptor,
+    never aged;
+  * service drills over SMALL simulated fleets: a mixed-species fleet
+    ticked to convergence, dry-run, outstanding dedup across ticks,
+    permanent-failure escalation to `manual`, the fenced zero-write
+    stale-epoch tick, and the heartbeat-starvation regression (a
+    stalled tick never blocks the cron loop's lease heartbeat).
+
+The >=12-cluster all-species acceptance run lives in `koctl chaos-soak
+--converge` (tests/test_chaos_soak.py + the slow marker); the paced
+ticks-to-convergence row in tests/test_static_gate.py + PERF.md.
+"""
+
+import threading
+import time
+
+import pytest
+
+from kubeoperator_tpu.fleet.converge import (
+    ACTION_PRIORITY,
+    PASSIVE_ACTIONS,
+    SKIP_BUDGET,
+    SKIP_CIRCUIT,
+    SKIP_COOLDOWN,
+    SKIP_ESCALATED,
+    SKIP_OUTSTANDING,
+    SKIP_PASSIVE,
+    SKIP_ROLLOUT,
+    ConvergeConfig,
+    converge_kwargs,
+    ledger_gc,
+    note_attempt,
+    note_escalated,
+    plan_tick,
+)
+from kubeoperator_tpu.models import QueueEntry, Setting, priority_of
+from kubeoperator_tpu.observability import EventKind, converge_story
+from kubeoperator_tpu.service import build_services
+from kubeoperator_tpu.utils.config import load_config
+from kubeoperator_tpu.utils.errors import ValidationError
+from kubeoperator_tpu.workloads.queue import (
+    SlicePoolView,
+    SliceSlot,
+    plan_aging,
+    plan_schedule,
+)
+
+from tests.test_fleet import ORIGINAL, TARGET, make_fleet
+
+CFG = ConvergeConfig(max_actions_per_tick=5, cooldown_s=300.0,
+                     max_attempts=3)
+
+
+def rem(cluster, action, detail=""):
+    return {"cluster": cluster, "action": action, "detail": detail}
+
+
+# ---------------------------------------------------------- pure planner --
+class TestPlanTick:
+    def test_urgency_order_then_cluster_name(self):
+        plan = plan_tick(
+            [rem("z", "retry"), rem("a", "upgrade"), rem("m", "recover"),
+             rem("b", "retry")],
+            {}, CFG, now=1000.0)
+        assert [(a["cluster"], a["action"]) for a in plan["actions"]] == [
+            ("b", "retry"), ("z", "retry"), ("m", "recover"),
+            ("a", "upgrade")]
+        assert all(a["attempt"] == 1 for a in plan["actions"])
+        assert plan["actionable"] == 4 and plan["skips"] == []
+
+    def test_passive_and_unknown_actions_never_act(self):
+        plan = plan_tick(
+            [rem("a", "wait"), rem("b", "manual"), rem("c", "reboot")],
+            {}, CFG, now=1000.0)
+        assert plan["actions"] == [] and plan["actionable"] == 0
+        assert [s["reason"] for s in plan["skips"]] == [SKIP_PASSIVE] * 3
+        assert set(PASSIVE_ACTIONS) == {"wait", "manual"}
+        assert "reboot" not in ACTION_PRIORITY
+
+    def test_tick_budget_cuts_after_priority_sort(self):
+        cfg = ConvergeConfig(max_actions_per_tick=2, cooldown_s=0)
+        plan = plan_tick(
+            [rem("c3", "upgrade"), rem("c1", "retry"), rem("c2", "retry")],
+            {}, cfg, now=1000.0)
+        assert [a["cluster"] for a in plan["actions"]] == ["c1", "c2"]
+        assert [s for s in plan["skips"]
+                if s["reason"] == SKIP_BUDGET][0]["cluster"] == "c3"
+        # budget-skipped work still counts as actionable: not converged
+        assert plan["actionable"] == 3
+
+    def test_cooldown_skips_recently_acted_cluster(self):
+        ledger = {"a": {"attempts": 1, "last_at": 900.0}}
+        plan = plan_tick([rem("a", "retry"), rem("b", "retry")],
+                         ledger, CFG, now=1000.0)
+        assert [a["cluster"] for a in plan["actions"]] == ["b"]
+        assert plan["skips"][0]["reason"] == SKIP_COOLDOWN
+        # past the window the cluster acts again, attempt number advanced
+        plan = plan_tick([rem("a", "retry")], ledger, CFG, now=1300.0)
+        assert plan["actions"][0] == {
+            "cluster": "a", "action": "retry", "detail": "", "attempt": 2}
+
+    def test_outstanding_dedup_is_per_cluster_and_action(self):
+        plan = plan_tick(
+            [rem("a", "retry"), rem("b", "retry")],
+            {}, CFG, now=1000.0, outstanding=[("a", "retry")])
+        assert [a["cluster"] for a in plan["actions"]] == ["b"]
+        skip = plan["skips"][0]
+        assert (skip["cluster"], skip["reason"]) == ("a", SKIP_OUTSTANDING)
+        # in-flight work is still unconverged drift
+        assert plan["actionable"] == 2
+        # a DIFFERENT action on the same cluster is not deduped
+        plan = plan_tick([rem("a", "recover")], {}, CFG, now=1000.0,
+                         outstanding=[("a", "retry")])
+        assert [a["action"] for a in plan["actions"]] == ["recover"]
+
+    def test_open_circuit_is_operator_owned_not_actionable(self):
+        plan = plan_tick([rem("a", "upgrade"), rem("b", "upgrade")],
+                         {}, CFG, now=1000.0, circuit_open=["a"])
+        assert [a["cluster"] for a in plan["actions"]] == ["b"]
+        assert plan["skips"][0]["reason"] == SKIP_CIRCUIT
+        # the breaker hands the cluster to the operator: with only `a`
+        # drifted the fleet still counts as converged
+        solo = plan_tick([rem("a", "upgrade")], {}, CFG, now=1000.0,
+                         circuit_open=["a"])
+        assert solo["actionable"] == 0
+
+    def test_live_rollout_parks_upgrades_but_not_retries(self):
+        plan = plan_tick([rem("a", "upgrade"), rem("b", "retry")],
+                         {}, CFG, now=1000.0, rollout_live=True)
+        assert [(a["cluster"], a["action"]) for a in plan["actions"]] == [
+            ("b", "retry")]
+        assert plan["skips"][0]["reason"] == SKIP_ROLLOUT
+        assert plan["actionable"] == 2
+
+    def test_exhausted_attempts_escalate_exactly_once(self):
+        ledger = {"a": {"attempts": 3, "last_at": 1.0}}
+        plan = plan_tick([rem("a", "retry")], ledger, CFG, now=1000.0)
+        assert plan["escalations"] == ["a"]
+        assert plan["skips"][0]["reason"] == SKIP_ESCALATED
+        assert plan["actionable"] == 0
+        # once the ledger row is marked, later ticks skip WITHOUT
+        # re-escalating (the service marks it via note_escalated)
+        note_escalated(ledger, "a")
+        plan = plan_tick([rem("a", "retry")], ledger, CFG, now=1000.0)
+        assert plan["escalations"] == []
+        assert plan["skips"][0]["reason"] == SKIP_ESCALATED
+
+    def test_plan_is_deterministic_whatever_the_input_order(self):
+        rems = [rem(f"c{i}", action)
+                for i, action in enumerate(
+                    ["upgrade", "retry", "recover", "upgrade", "retry"])]
+        ledger = {"c1": {"attempts": 1, "last_at": 999.0}}
+        cfg = ConvergeConfig(max_actions_per_tick=3, cooldown_s=10)
+        first = plan_tick(rems, dict(ledger), cfg, now=1000.0,
+                          outstanding=[("c2", "recover")])
+        second = plan_tick(list(reversed(rems)), dict(ledger), cfg,
+                           now=1000.0, outstanding=[("c2", "recover")])
+        assert first == second
+
+    def test_ledger_helpers(self):
+        ledger = {}
+        entry = note_attempt(ledger, "a", "retry", 10.0)
+        assert entry == {"attempts": 1, "last_at": 10.0,
+                         "action": "retry", "escalated": False}
+        note_attempt(ledger, "a", "upgrade", 20.0)
+        assert ledger["a"]["attempts"] == 2
+        assert ledger["a"]["action"] == "upgrade"
+        note_attempt(ledger, "b", "retry", 20.0)
+        # gc clears rows for clusters that stopped drifting — fresh
+        # attempt budget for the next incident
+        assert ledger_gc(ledger, ["b"]) == ["a"]
+        assert set(ledger) == {"b"}
+
+    def test_converge_kwargs_parity_translation(self):
+        assert converge_kwargs({}) == {"dry_run": False}
+        assert converge_kwargs({"dry_run": True}) == {"dry_run": True}
+        assert converge_kwargs({"dry_run": "true"}) == {"dry_run": True}
+        assert converge_kwargs({"dry_run": "0"}) == {"dry_run": False}
+        with pytest.raises(ValidationError):
+            converge_kwargs({"dry_run": 3})
+
+    def test_config_from_config_reads_the_converge_block(self):
+        config = load_config(path="/nonexistent", env={}, overrides={
+            "converge": {"enabled": True, "max_actions_per_tick": 9,
+                         "cooldown_s": 7, "max_attempts": 1,
+                         "priority": "low"}})
+        cfg = ConvergeConfig.from_config(config)
+        assert cfg.enabled and cfg.max_actions_per_tick == 9
+        assert cfg.cooldown_s == 7.0 and cfg.max_attempts == 1
+        assert cfg.priority == "low"
+
+
+# ------------------------------------------- converge x queue decisions --
+def queue_entry(eid, kind, priority_class, devices=0, placement=()):
+    e = QueueEntry(op_id=f"op-{eid}", priority_class=priority_class,
+                   priority=priority_of(priority_class), kind=kind,
+                   devices=devices, placement=list(placement))
+    e.id = eid
+    e.created_at = 1.0
+    return e
+
+
+class TestRemediationQueueContract:
+    def test_remediation_is_zero_slice_and_always_placeable(self):
+        pool = SlicePoolView(slots=[SliceSlot("a/0", 4)])
+        holder = queue_entry("train", "train", "low", devices=4,
+                             placement=["a/0"])
+        pool.place("train", 1)
+        decision = plan_schedule(
+            [queue_entry("fix", "remediation", "scavenger")],
+            [holder], pool)
+        assert decision.placements == {"fix": []}
+        assert decision.victims == ()
+
+    def test_promoted_priority_remediation_preempts_nothing(self):
+        """Satellite: a remediation ledgered at a promoted class rides
+        ordering only — even at `high` against a full pool held by `low`
+        tenants it places as a zero-slice gang instead of nominating
+        victims (choose_victims only fires when a gang fails to fit)."""
+        pool = SlicePoolView(slots=[SliceSlot("a/0", 4),
+                                    SliceSlot("a/1", 4)])
+        holders = [
+            queue_entry("t1", "train", "low", devices=4,
+                        placement=["a/0"]),
+            queue_entry("t2", "train", "low", devices=4,
+                        placement=["a/1"]),
+        ]
+        pool.place("t1", 1), pool.place("t2", 1)
+        decision = plan_schedule(
+            [queue_entry("fix", "remediation", "high")], holders, pool)
+        assert decision.placements == {"fix": []}
+        assert decision.victims == ()
+
+    def test_aging_never_promotes_remediation_entries(self):
+        waiting = [queue_entry("fix", "remediation", "scavenger"),
+                   queue_entry("t", "train", "low")]
+        decisions = plan_aging(waiting, now=1000.0, after_s=10.0)
+        assert [(e.id, cls) for e, cls in decisions] == [("t", "normal")]
+
+
+# ------------------------------------------------------- service drills --
+def stack(tmp_path, db="converge.db", converge=None, lease=None,
+          fleet=None):
+    config = load_config(path="/nonexistent", env={}, overrides={
+        "db": {"path": str(tmp_path / db)},
+        "logging": {"level": "ERROR"},
+        "executor": {"backend": "simulation"},
+        "provisioner": {"work_dir": str(tmp_path / "tf")},
+        "cron": {"backup_enabled": False, "health_check_interval_s": 0,
+                 "event_sync_interval_s": 0},
+        "cluster": {"kubeconfig_dir": str(tmp_path / "kc")},
+        "chaos": {"enabled": True},
+        "fleet": fleet or {},
+        "resilience": {"max_attempts": 2, "backoff_base_s": 0.01,
+                       "backoff_max_s": 0.05},
+        "converge": {"cooldown_s": 0, "max_actions_per_tick": 10,
+                     **(converge or {})},
+        "lease": lease or {},
+    })
+    return build_services(config, simulate=True)
+
+
+def converge_events(svc, after=0):
+    rows, cursor = svc.repos.events.since(after, kind="fleet.converge.",
+                                          limit=10000)
+    return [event for _rowid, event in rows], cursor
+
+
+class TestConvergeService:
+    def test_mixed_fleet_ticks_to_convergence(self, tmp_path):
+        svc = stack(tmp_path)
+        names = make_fleet(svc, 4, prefix="cv")
+        repos = svc.repos
+        # species: cv-00 ahead (the inference peer), cv-01 behind,
+        # cv-02 stranded Failed, cv-03 behind with an OPEN circuit
+        ahead = repos.clusters.get_by_name(names[0])
+        ahead.spec.k8s_version = TARGET
+        repos.clusters.save(ahead)
+        strand = repos.clusters.get_by_name(names[2])
+        strand.status.phase = "Failed"
+        repos.clusters.save(strand)
+        circ = repos.clusters.get_by_name(names[3])
+        repos.settings.save(Setting(
+            name=f"watchdog/{circ.id}",
+            vars={"state": "open", "remediations": [], "flaps": 0,
+                  "opened_at": 1.0, "opened_reason": "test-tripped",
+                  "last_remediation_ts": 0.0,
+                  "last_remediation_ok": True}))
+
+        reports = []
+        for _ in range(5):
+            report = svc.converge.run_once()
+            reports.append(report)
+            if report["converged"]:
+                break
+        assert reports[-1]["converged"], reports[-1]
+        # no-history inference picked the ahead cluster's version
+        assert reports[0]["target"] == TARGET
+        for name in names[:3]:
+            row = repos.clusters.get_by_name(name)
+            assert row.spec.k8s_version == TARGET, name
+            assert row.status.phase == "Ready", name
+        # the open circuit is an explicit hands-off signal
+        untouched = repos.clusters.get_by_name(names[3])
+        assert untouched.spec.k8s_version == ORIGINAL
+        assert svc.watchdog.circuit_state(untouched.id) == "open"
+
+        events, _ = converge_events(svc)
+        story = converge_story(events)
+        kinds = {line["kind"] for line in story}
+        assert EventKind.CONVERGE_CONVERGED in kinds
+        circuit_lines = [line for line in story
+                         if line.get("cluster") == names[3]]
+        assert circuit_lines and all(
+            line["kind"] == EventKind.CONVERGE_SKIP
+            and line["reason"] == SKIP_CIRCUIT for line in circuit_lines)
+        # one tick event per run_once, ledger gc'd once converged
+        assert len([e for e in events
+                    if e.kind == EventKind.CONVERGE_TICK]) == len(reports)
+        status = svc.converge.status()
+        assert status["ticks"] == len(reports)
+        assert status["last"]["converged"] is True
+        assert status["outstanding"] == []
+        # the one-hot verdict gauge reads the persisted tick summary
+        # (the circuit-open cluster still counts as drifted — it is the
+        # operator's, not the controller's)
+        from kubeoperator_tpu.api.metrics import MetricsRegistry
+
+        text = MetricsRegistry().render(svc)
+        assert 'ko_tpu_fleet_convergence{verdict="converged"} 1' in text
+        assert 'ko_tpu_fleet_convergence{verdict="drifting"} 0' in text
+        assert "ko_tpu_fleet_drifted_clusters 1" in text
+
+    def test_dry_run_plans_but_writes_no_remediation(self, tmp_path):
+        svc = stack(tmp_path)
+        make_fleet(svc, 2, prefix="dr")
+        ahead = svc.repos.clusters.get_by_name("dr-00")
+        ahead.spec.k8s_version = TARGET
+        svc.repos.clusters.save(ahead)
+        report = svc.converge.run_once(dry_run=True)
+        assert report["planned"] == 1 and report["acted"] == 0
+        assert not report["converged"]
+        assert [e for e in svc.repos.workload_queue.list()
+                if e.kind == "remediation"] == []
+        behind = svc.repos.clusters.get_by_name("dr-01")
+        assert behind.spec.k8s_version == ORIGINAL
+        # the dry tick still narrates (and is flagged as dry)
+        events, _ = converge_events(svc)
+        tick = [e for e in events
+                if e.kind == EventKind.CONVERGE_TICK][0]
+        assert tick.payload["dry_run"] is True
+
+    def test_outstanding_remediation_not_resubmitted(self, tmp_path):
+        """Satellite: converge x queue dedup — work already ledgered on
+        the queue is skipped (cluster+action), not double-submitted."""
+        svc = stack(tmp_path)
+        make_fleet(svc, 2, prefix="dd")
+        ahead = svc.repos.clusters.get_by_name("dd-00")
+        ahead.spec.k8s_version = TARGET
+        svc.repos.clusters.save(ahead)
+        svc.workload_queue.submit_remediation(
+            "dd-01", "upgrade", priority="scavenger", kick=False,
+            payload={"clusters": ["dd-01"], "target": TARGET})
+        before = [e for e in svc.repos.workload_queue.list()
+                  if e.kind == "remediation"]
+        assert len(before) == 1
+        report = svc.converge.run_once()
+        skip = [s for s in report["skips"] if s["cluster"] == "dd-01"]
+        assert skip and skip[0]["reason"] == SKIP_OUTSTANDING
+        assert report["acted"] == 0
+        after = [e for e in svc.repos.workload_queue.list()
+                 if e.kind == "remediation"]
+        assert len(after) == 1 and after[0].id == before[0].id
+
+    def test_permanent_failure_escalates_to_manual(self, tmp_path):
+        svc = stack(tmp_path, converge={"max_attempts": 1})
+        names = make_fleet(svc, 2, prefix="esc")
+        ahead = svc.repos.clusters.get_by_name(names[0])
+        ahead.spec.k8s_version = TARGET
+        svc.repos.clusters.save(ahead)
+        # every upgrade of esc-01 dies in its first playbook, so the
+        # cluster genuinely stays behind (an absorbed health-gate fault
+        # would leave the new version applied)
+        svc.executor.fail_hosts("20-upgrade-prepare.yml", f"{names[1]}-*",
+                                list(range(1, 50)))
+        reports = []
+        for _ in range(4):
+            report = svc.converge.run_once()
+            reports.append(report)
+            if report["converged"]:
+                break
+        assert reports[-1]["converged"]
+        assert any(names[1] in r["escalations"] for r in reports)
+        broken = svc.repos.clusters.get_by_name(names[1])
+        assert broken.spec.k8s_version == ORIGINAL
+        ledger = svc.converge.status()["ledger"]
+        assert ledger[names[1]]["escalated"] is True
+        assert ledger[names[1]]["attempts"] == 1
+        events, _ = converge_events(svc)
+        assert any(e.kind == EventKind.CONVERGE_SKIP
+                   and e.payload.get("reason") == SKIP_ESCALATED
+                   for e in events)
+
+    def test_fenced_stale_tick_writes_nothing(self, tmp_path):
+        """A replica that lost the controller lease dies on its FIRST
+        fenced save: StaleEpochError, zero converge writes, one durable
+        fence.rejected event from the journal."""
+        from kubeoperator_tpu.resilience import StaleEpochError, lease_wiring
+
+        svc = stack(tmp_path, lease={"ttl_s": 0.4})
+        make_fleet(svc, 2, prefix="fn")
+        ahead = svc.repos.clusters.get_by_name("fn-00")
+        ahead.spec.k8s_version = TARGET
+        svc.repos.clusters.save(ahead)
+        report = svc.converge.run_once()
+        op_id = report["op_id"]
+        ticks_before = svc.converge.status()["ticks"]
+        _events, cursor = converge_events(svc)
+
+        # the controller stops heartbeating; a peer replica takes the
+        # lease over at a bumped epoch once the TTL lapses
+        peer = lease_wiring(
+            load_config(path="/nonexistent", env={}, overrides={
+                "lease": {"controller_id": "converge-peer",
+                          "ttl_s": 0.4}}),
+            svc.repos)
+        deadline = time.monotonic() + 10.0
+        claim = None
+        while time.monotonic() < deadline:
+            claim = peer.try_claim(op_id)
+            if claim is not None:
+                break
+            time.sleep(0.1)
+        assert claim is not None and claim["epoch"] > 1, claim
+
+        with pytest.raises(StaleEpochError):
+            svc.converge.run_once()
+        events_after, _ = converge_events(svc, after=cursor)
+        assert events_after == []
+        assert svc.converge.status()["ticks"] == ticks_before
+        fence_rows, _ = svc.repos.events.since(
+            0, kind=EventKind.FENCE_REJECTED)
+        assert fence_rows
+
+    def test_stalled_tick_never_blocks_lease_heartbeat(self, tmp_path):
+        """Satellite: the cron loop's converge kick starts the tick on a
+        worker thread and returns immediately — the lease heartbeat
+        keeps its cadence while a drift pass stalls indefinitely."""
+        svc = stack(tmp_path,
+                    converge={"enabled": True, "interval_s": 0},
+                    lease={"ttl_s": 30.0, "heartbeat_interval_s": 0.0})
+        make_fleet(svc, 1, prefix="hb")
+        svc.converge.run_once()   # claim the controller op's lease
+        stalled = threading.Event()
+        unstall = threading.Event()
+        real_drift = svc.fleet.drift
+
+        def slow_drift(*args, **kwargs):
+            stalled.set()
+            assert unstall.wait(30.0)
+            return real_drift(*args, **kwargs)
+
+        svc.fleet.drift = slow_drift
+        try:
+            t0 = time.monotonic()
+            assert svc.cron.converge_tick() is True
+            kick_elapsed = time.monotonic() - t0
+            assert kick_elapsed < 1.0, kick_elapsed
+            assert stalled.wait(10.0)
+            # the tick is now wedged mid-drift; the heartbeat must not be
+            for _ in range(3):
+                t0 = time.monotonic()
+                svc.cron.lease_tick()
+                assert time.monotonic() - t0 < 1.0
+                time.sleep(0.05)
+            age = svc.leases.max_heartbeat_age_s()
+            assert age is not None and age < 5.0, age
+            # no second tick piles up behind the stalled one
+            assert svc.cron.converge_tick() is False
+        finally:
+            svc.fleet.drift = real_drift
+            unstall.set()
+            svc.converge.wait_all()
+
+
+# ------------------------------------------------- the acceptance drill --
+@pytest.mark.slow
+def test_converge_soak_is_deterministic(capsys):
+    """`koctl chaos-soak --converge --verify-determinism`: the minimum
+    mixed-species fleet converges with every check green and the
+    canonical report (verdicts + converge_story) identical across two
+    seeded passes."""
+    import json
+
+    from kubeoperator_tpu.cli.koctl import main
+
+    rc = main(["chaos-soak", "--converge", "--clusters", "12",
+               "--verify-determinism", "--format", "json"])
+    report = json.loads(capsys.readouterr().out)
+    assert rc == 0, report
+    assert report["ok"] is True
+    assert report["deterministic"] is True
+    failed = [c for c in report["checks"] if not c["ok"]]
+    assert failed == []
+
+
+@pytest.mark.slow
+def test_converge_soak_scales_to_200(capsys):
+    """The ISSUE 17 acceptance bound: a 200-cluster drill converges
+    through batched remediation rollouts with the permanently-failing
+    cluster in `manual`, the open circuit untouched, and the fencing
+    leg green."""
+    import json
+
+    from kubeoperator_tpu.cli.koctl import main
+
+    rc = main(["chaos-soak", "--converge", "--clusters", "200",
+               "--format", "json"])
+    report = json.loads(capsys.readouterr().out)
+    assert rc == 0, report
+    assert report["ok"] is True
+    assert report["clusters"] == 200
+    assert report["ticks"] <= report["tick_budget"]
+    names = [c["check"] for c in report["checks"]]
+    assert any("manual" in n for n in names)
+    assert any("circuit" in n for n in names)
+    assert any("fence" in n or "fenced" in n for n in names)

@@ -1027,15 +1027,20 @@ class TestFleetDrift:
                 status=ConditionStatus.FAILED.value,
                 order_index=99))
             svc.repos.clusters.save(sick)
-            # no rollout history and no --target: a clear refusal
-            with pytest.raises(ValidationError, match="no rollout history"):
-                svc.fleet.drift()
+            # no rollout history and no --target: the verb no longer
+            # refuses — it infers the target from the fleet's own
+            # recorded versions and says so in the payload
+            report = svc.fleet.drift()
+            assert report["inferred"] is False
+            assert report["target_version"] == ORIGINAL
+            assert names[0] in {d["cluster"] for d in report["drifted"]}
             # with history, the newest rollout's target is the default
             svc.fleet.upgrade(TARGET, selector={"name": "fl-*"},
                               wave_size=1, canary=0, max_unavailable=1,
                               wait=True)
             report = svc.fleet.drift()
             assert report["target_version"] == TARGET
+            assert report["inferred"] is True
             drifted = {d["cluster"]: d for d in report["drifted"]}
             assert names[0] in drifted
             finding_kinds = {f["kind"]

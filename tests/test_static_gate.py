@@ -295,6 +295,32 @@ def test_concurrent_wave_beats_serial_at_wave_size_4():
         f"fleet wave benchmark took {elapsed:.1f}s (budget 120s)")
 
 
+def test_converge_drill_deterministic_under_budget():
+    """The convergence controller's operational budget (ISSUE 17 /
+    PERF.md converge section): ticking a 20-cluster version-drift
+    backlog to zero actionable drift through the queue + fleet engine
+    must stay tier-1 cheap, land in the expected tick count
+    (ceil(backlog / per-tick cap) + the converged tick — the batching
+    contract), and plan deterministically. Measured ~2s on the round-11
+    machine; the 120s ceiling absorbs a loaded CI host without letting
+    a per-tick full-journal hydrate or an unbatched-rollout regression
+    hide."""
+    from perf_matrix import run_converge
+
+    start = time.perf_counter()
+    report = run_converge(clusters=20, max_actions=8)
+    elapsed = time.perf_counter() - start
+    assert report["ok"], report
+    row = report["rows"][0]
+    assert row["backlog"] == 20, row
+    assert row["actions_total"] == row["backlog"], row
+    expected_ticks = -(-row["backlog"] // row["max_actions_per_tick"]) + 1
+    assert row["ticks"] == expected_ticks, row
+    assert row["clusters_per_s"] > 0, row
+    assert elapsed < 120.0, (
+        f"converge drill took {elapsed:.1f}s (budget 120s)")
+
+
 def _timed_train(tmp_path, tag: str, events: bool) -> float:
     """One 8-device train (tier-1 CPU mesh) with the live-telemetry
     switch toggled; asserts each leg measured what it claims (samples
