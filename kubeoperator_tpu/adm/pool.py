@@ -66,6 +66,19 @@ class BoundedPool:
                  thread_prefix: str = "pool") -> None:
         self.max_concurrent = max(int(max_concurrent), 1)
         self.thread_prefix = thread_prefix
+        self._cv: threading.Condition | None = None   # live only in run()
+
+    def kick(self) -> None:
+        """Wake the coordinator so `schedule` is re-consulted NOW — for
+        consumers whose scheduling inputs change from OUTSIDE the pool
+        while every launched worker is still busy (a new workload-queue
+        submission must not wait for the next settle to be considered).
+        No-op before `run` starts or after it returns. Callers must not
+        hold locks the coordinator's callbacks acquire."""
+        cv = self._cv
+        if cv is not None:
+            with cv:
+                cv.notify_all()
 
     def run(self, schedule: Callable, work: Callable,
             settle: Callable, on_turn: Callable | None = None) -> None:
@@ -75,6 +88,7 @@ class BoundedPool:
         suppressed once a fatal landed (a dead controller does no
         post-crash bookkeeping)."""
         cv = threading.Condition()
+        self._cv = cv                       # expose for kick()
         running: list = []                  # items in flight, launch order
         inbox: list[tuple] = []             # (item, result, error) to settle
         fatal: list[BaseException] = []
@@ -99,34 +113,38 @@ class BoundedPool:
                 inbox.append((item, result, None))
                 cv.notify_all()
 
-        with cv:
-            while True:
-                # settle everything that arrived, in arrival order, before
-                # the next scheduling decision — settle() verdicts feed it
-                while inbox:
-                    item, result, error = inbox.pop(0)
-                    running.remove(item)
-                    settle(item, result, error)
-                free = self.max_concurrent - len(running)
-                launches = [] if fatal else list(schedule(
-                    PoolView(free, list(running))))
-                if len(launches) > free:
-                    raise RuntimeError(
-                        f"{self.thread_prefix}: schedule returned "
-                        f"{len(launches)} launches for {free} free slots")
-                for item in launches:
-                    running.append(item)
-                    label = getattr(item, "name", item)
-                    threading.Thread(
-                        target=worker, args=(item,), daemon=True,
-                        name=f"{self.thread_prefix}-{label}",
-                    ).start()
-                if on_turn is not None and not fatal:
-                    on_turn(PoolView(self.max_concurrent - len(running),
-                                     list(running)))
-                if not running and not inbox:
-                    break
-                cv.wait()
+        try:
+            with cv:
+                while True:
+                    # settle everything that arrived, in arrival order,
+                    # before the next scheduling decision — settle()
+                    # verdicts feed it
+                    while inbox:
+                        item, result, error = inbox.pop(0)
+                        running.remove(item)
+                        settle(item, result, error)
+                    free = self.max_concurrent - len(running)
+                    launches = [] if fatal else list(schedule(
+                        PoolView(free, list(running))))
+                    if len(launches) > free:
+                        raise RuntimeError(
+                            f"{self.thread_prefix}: schedule returned "
+                            f"{len(launches)} launches for {free} free slots")
+                    for item in launches:
+                        running.append(item)
+                        label = getattr(item, "name", item)
+                        threading.Thread(
+                            target=worker, args=(item,), daemon=True,
+                            name=f"{self.thread_prefix}-{label}",
+                        ).start()
+                    if on_turn is not None and not fatal:
+                        on_turn(PoolView(self.max_concurrent - len(running),
+                                         list(running)))
+                    if not running and not inbox:
+                        break
+                    cv.wait()
+        finally:
+            self._cv = None
 
         if fatal:
             raise fatal[0]

@@ -537,6 +537,62 @@ def check_event_kind_discipline(root: str, tree: ast.AST,
     return findings
 
 
+# ---------------------------------------------------------------- KO-P014 ---
+_P014_WAIVER = "KO-P014: waived"
+
+
+def check_thread_discipline(root: str, tree: ast.AST, path: str) -> list:
+    """Service-layer code (any file under `service/`) may not construct
+    raw threads: concurrency there rides the shared `adm/pool.py
+    BoundedPool` (deterministic launch order, fatal-BaseException crash
+    semantics, settle-in-arrival-order), and the few legitimate non-pool
+    threads — engine hosts, the cron loop, fire-and-forget dispatches —
+    funnel through `utils/threads.spawn` so every one is named and
+    daemonized. A bare `threading.Thread(...)` bypasses both: an
+    anonymous undaemonized thread that outlives close() and swallows
+    BaseExceptions the pool would surface. Genuinely special cases carry
+    a `# KO-P014: waived — <reason>` comment."""
+    relpath = os.path.relpath(path, root)
+    if not relpath.startswith("service" + os.sep):
+        return []
+    findings: list = []
+    candidates: list = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        bare = (
+            (isinstance(func, ast.Attribute) and func.attr == "Thread"
+             and isinstance(func.value, ast.Name)
+             and func.value.id == "threading")
+            or (isinstance(func, ast.Name) and func.id == "Thread")
+        )
+        if bare:
+            candidates.append(node.lineno)
+    if not candidates:
+        return []
+    with open(path, encoding="utf-8") as f:
+        source_lines = f.read().splitlines()
+
+    def waived(lineno: int) -> bool:
+        lo = max(lineno - 4, 0)
+        return any(_P014_WAIVER in line
+                   for line in source_lines[lo:lineno])
+
+    rel = _rel(root, path)
+    for lineno in candidates:
+        if waived(lineno):
+            continue
+        findings.append(Finding(
+            "KO-P014", rel, lineno,
+            "bare threading.Thread in the service layer — run the work "
+            "on the shared adm/pool.py BoundedPool, or spawn the thread "
+            "through utils/threads.spawn (named + daemonized), or waive "
+            f"with `# {_P014_WAIVER} — <reason>`",
+        ))
+    return findings
+
+
 AST_RULES = {
     "KO-P001": check_repo_layering,
     "KO-P002": check_blocking_handlers,
@@ -547,6 +603,7 @@ AST_RULES = {
     "KO-P011": check_checkpoint_atomic_writes,
     "KO-P012": check_event_discipline,
     "KO-P013": check_event_kind_discipline,
+    "KO-P014": check_thread_discipline,
 }
 
 

@@ -39,7 +39,8 @@ _PURE_KEY_RE = re.compile(r"^[a-z0-9_]+(\.[a-z0-9_]+)+$")
 # docs/workloads.md)
 DOC_REQUIRED_SECTIONS = ("resilience", "chaos", "watchdog", "observability",
                          "fleet", "scheduler", "lease", "workloads",
-                         "slicepool", "checkpoint", "queue", "converge")
+                         "slicepool", "checkpoint", "queue", "converge",
+                         "serve")
 
 
 def _defaults_from_tree(root: str) -> dict | None:

@@ -157,6 +157,14 @@ RULES: dict[str, RuleSpec] = {
             "attributes are the sanctioned spelling)",
         ),
         RuleSpec(
+            "KO-P014", "thread-discipline", "ast", ERROR,
+            "service-layer code never constructs a bare threading.Thread "
+            "— concurrency rides the shared adm/pool.py BoundedPool, and "
+            "the few legitimate non-pool threads funnel through "
+            "utils/threads.spawn (named + daemonized), or carry a "
+            "`# KO-P014: waived — <reason>` comment",
+        ),
+        RuleSpec(
             "KO-P007", "phase-write-discipline", "ast", ERROR,
             "in-flight ClusterPhaseStatus assignments (Provisioning/"
             "Deploying/Scaling/Upgrading/Terminating) happen only in adm/ "

@@ -250,6 +250,19 @@ class MetricsRegistry:
                    "running / drained / done / failed / cancelled).",
                    [_fmt("ko_tpu_workload_queue", {"state": s}, n)
                     for s, n in sorted(queue_counts.items())])
+            # the concurrent engine's live lanes, split by class AND verb
+            # (training vs serving) — the capacity question "who holds the
+            # pool right now" the serial gauge above cannot answer.
+            # getattr-guarded: pre-serve stubs omit the family.
+            running_counts = getattr(queue_repo, "running_counts", None)
+            if running_counts is not None:
+                family("ko_tpu_workload_queue_running", "gauge",
+                       "Running queue lanes by priority class and workload "
+                       "kind (the concurrent dispatch engine's live gangs).",
+                       [_fmt("ko_tpu_workload_queue_running",
+                             {"priority": cls, "kind": kind}, n)
+                        for (cls, kind), n
+                        in sorted(running_counts().items())])
             histogram(
                 "ko_tpu_workload_queue_wait_seconds",
                 "Queue wait (first dispatch minus submission) per "
@@ -283,6 +296,18 @@ class MetricsRegistry:
                 "tenant",
                 [(tenant, step_s, "") for tenant, step_s
                  in samples_repo.step_rows()])
+            # serving request latency (docs/workloads.md "Serving"): the
+            # SLO surface per tenant, off the same mirrored sample
+            # columns. hasattr-guarded: pre-serve sample stubs omit it.
+            if hasattr(samples_repo, "request_rows"):
+                histogram(
+                    "ko_tpu_workload_request_seconds",
+                    "Per-request serving latency from persisted metric "
+                    "samples, by tenant — the SLO distribution "
+                    "(docs/workloads.md \"Serving\").",
+                    "tenant",
+                    [(tenant, latency_s, "") for tenant, latency_s
+                     in samples_repo.request_rows()])
             family("ko_tpu_workload_loss", "gauge",
                    "Latest per-op training loss from the metric-sample "
                    "ring (one series per retained workload op).",

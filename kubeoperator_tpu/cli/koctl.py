@@ -1386,6 +1386,19 @@ def _sample_line(s: dict) -> str:
         return (f"  step {s['step']:>5}  checkpoint "
                 f"{(attrs.get('checkpoint') or '?')[:8]} saved "
                 f"({attrs.get('bytes', 0)} bytes)")
+    if s.get("kind") == "request":
+        # serving lane: the live SLO view — per-request latency vs the
+        # objective (docs/workloads.md "Serving")
+        attrs = s.get("attrs") or {}
+        latency_ms = float(s.get("step_s") or 0) * 1000.0
+        line = (f"  req  {s['step']:>5}  latency {latency_ms:.1f}ms")
+        if s.get("steps_per_s"):
+            line += f"  {s['steps_per_s']} req/s"
+        slo_ms = attrs.get("slo_ms")
+        if slo_ms:
+            verdict = "ok" if latency_ms <= float(slo_ms) else "MISS"
+            line += f"  slo {float(slo_ms):.0f}ms {verdict}"
+        return line
     line = f"  step {s['step']:>5}  loss {s['loss']:.6f}"
     if s.get("steps_per_s"):
         line += f"  {s['steps_per_s']} steps/s"
@@ -1462,6 +1475,12 @@ def cmd_workload(client, args) -> int:
         if args.wl_cmd == "sweep":
             body["kind"] = "sweep"
         else:
+            if args.kind:
+                body["kind"] = args.kind
+            if args.requests is not None:
+                body["requests"] = args.requests
+            if args.slo_ms is not None:
+                body["slo_ms"] = args.slo_ms
             if args.plan:
                 body["plan"] = args.plan
             if args.mesh:
@@ -3461,6 +3480,442 @@ def cmd_queue_soak(args) -> int:
     return 0 if ok else 1
 
 
+def _serve_soak_once(args, base_dir: str) -> tuple[list, dict]:
+    """The serving-class drill (ISSUE 18, docs/workloads.md "Serving"):
+    a training tenant and a latency-class server share a 2-slice pool
+    through a flapping slice —
+
+      sierra/train (normal, 2 slices, 4 steps) — pre-trains the model
+             whose checkpoint the server restores
+      sierra/serve (high,   2 slices, 6 requests) — the latency class
+      tina/train   (low,    1 slice,  6 steps) — arrives while the
+             server holds the whole pool
+      uma/train    (normal, 1 slice,  3 steps) — the post-chaos health
+             probe
+
+    The script loses ONE slice twice: first under the server (which
+    re-shards onto the survivor and keeps answering — degrade, never
+    drop), then — after the slice returns and tina lands on it — under
+    tina (checkpoint+drain at her next boundary, resume when it returns
+    again). All four queue lives reconstruct from the event bus alone;
+    tina's drained+resumed losses and the server's response digests must
+    be bit-for-bit stable across seeded passes."""
+    import threading
+    import time as _time
+
+    from kubeoperator_tpu.models import Plan, Region, Zone
+    from kubeoperator_tpu.service import build_services
+    from kubeoperator_tpu.utils.config import load_config
+
+    checks: list[dict] = []
+
+    def check(name: str, ok, detail: str = "") -> None:
+        checks.append({"check": name, "ok": bool(ok), "detail": detail})
+
+    os.makedirs(base_dir, exist_ok=True)
+    config = load_config(path="/nonexistent", env={}, overrides={
+        "db": {"path": os.path.join(base_dir, "soak.db")},
+        "logging": {"level": "ERROR"},
+        "executor": {"backend": "simulation"},
+        "provisioner": {"work_dir": os.path.join(base_dir, "tf")},
+        "cron": {"backup_enabled": False, "health_check_interval_s": 300,
+                 "event_sync_interval_s": 0},
+        "cluster": {"kubeconfig_dir": os.path.join(base_dir, "kc")},
+        "lease": {"controller_id": "serve-drill-a"},
+        "queue": {"max_concurrent": 2},
+    })
+    svc = build_services(config, simulate=True)
+    structure: dict = {}
+    serve_requests = 6
+    tina_steps = 6
+    drain_at_step = 2
+    try:
+        region = svc.regions.create(Region(
+            name="serve-region", provider="gcp_tpu_vm",
+            vars={"project": "serve", "name": "us-central1"}))
+        zone = svc.zones.create(Zone(
+            name="serve-zone", region_id=region.id,
+            vars={"gcp_zone": "us-central1-a"}))
+        svc.plans.create(Plan(
+            name="serve-v5e-4-x2", provider="gcp_tpu_vm",
+            region_id=region.id, zone_ids=[zone.id], accelerator="tpu",
+            tpu_type="v5e-4", num_slices=2, worker_count=0))
+        svc.clusters.create("pool", provision_mode="plan",
+                            plan_name="serve-v5e-4-x2", wait=True)
+        cap = svc.workload_queue.capacity()
+        check("pool derives 2x 4-chip slices; two dispatch lanes",
+              cap["slices"] == 2 and cap["chips_per_slice"] == 4
+              and svc.workload_queue.max_concurrent == 2, str(cap))
+
+        # ---- sierra pre-trains the model the server will restore -------
+        svc.workload_queue.submit(
+            mesh="data=2,fsdp=4", steps=4, tenant="sierra",
+            priority="normal", wait=True)
+        ckpt_row = svc.repos.checkpoints.latest_complete(tenant="sierra")
+        check("pre-training left sierra a COMPLETE checkpoint recording "
+              "the serve gang's mesh",
+              ckpt_row is not None and ckpt_row.mesh.get("data") == 2
+              and ckpt_row.mesh.get("fsdp") == 4,
+              str(getattr(ckpt_row, "mesh", None)))
+
+        # ---- reference runs (library, same seeds, no queue) ------------
+        import jax
+
+        from kubeoperator_tpu.parallel.mesh import MeshSpec
+        from kubeoperator_tpu.workloads.checkpoint import (
+            restore_checkpoint,
+        )
+        from kubeoperator_tpu.workloads.harness import run_training
+        from kubeoperator_tpu.workloads.serve import run_serving
+        from kubeoperator_tpu.workloads.step import train_state_shapes
+
+        ref_train = run_training(
+            MeshSpec.parse("data=1,fsdp=4,tp=1").build(jax.devices()[:4]),
+            steps=tina_steps, mode="auto", seed=0)
+        state, manifest = restore_checkpoint(
+            ckpt_row.dir, train_state_shapes())
+        ref_serve = run_serving(
+            MeshSpec.parse("data=2,fsdp=4,tp=1").build(jax.devices()[:8]),
+            params=state["params"], requests=serve_requests, mode="auto",
+            seed=int(manifest.get("seed", 0)))
+
+        # ---- the scripted flapping slice, clocked by the server --------
+        # phases: 0 submit -> 1 slice lost under server (degrades) ->
+        # 2 slice back, tina lands on it and drains when it flaps again
+        # (her own step hook is the deterministic trigger) -> 3 restored,
+        # tina resumes. The serve lane's request hook is the clock, so
+        # every transition lands at an exact request/step boundary in
+        # BOTH passes.
+        sync = {"phase": 0, "slice": "", "concurrent": False,
+                "running_scrape": False, "drain_fired": False}
+        from kubeoperator_tpu.api.metrics import MetricsRegistry
+
+        def rows_by_key():
+            out = {}
+            for row in svc.workload_queue.entries():
+                out["serve" if row["kind"] == "serve"
+                    else row["tenant"]] = row
+            return out
+
+        def request_hook(served: int, _latency_s: float):
+            if served == 2 and sync["phase"] == 0:
+                server = rows_by_key()["serve"]
+                sync["slice"] = (server["placement"] or [""])[-1]
+                sync["phase"] = 1
+                svc.workload_queue.preempt_slice(sync["slice"])
+            elif served == 3 and sync["phase"] == 1:
+                sync["phase"] = 2
+                svc.workload_queue.restore_slice(sync["slice"])
+            elif served == 4 and sync["phase"] == 2:
+                # tina is landing on the returned slice; hold the next
+                # answer until she drains (her step hook flaps the slice
+                # again), recording the both-lanes-live evidence
+                deadline = _time.monotonic() + 180
+                while _time.monotonic() < deadline:
+                    rows = rows_by_key()
+                    tina = rows.get("tina") or {}
+                    if (tina.get("state") == "running"
+                            and rows["serve"]["state"] == "running"):
+                        sync["concurrent"] = True
+                        if not sync["running_scrape"]:
+                            text = MetricsRegistry().render(svc)
+                            sync["running_scrape"] = (
+                                'ko_tpu_workload_queue_running{'
+                                'kind="serve",priority="high"} 1' in text
+                                and 'ko_tpu_workload_queue_running{'
+                                'kind="train",priority="low"} 1' in text)
+                    if (tina.get("state") == "pending"
+                            and tina.get("checkpoint")
+                            and tina.get("preemptions")):
+                        break
+                    _time.sleep(0.02)
+                sync["phase"] = 3
+                svc.workload_queue.restore_slice(sync["slice"])
+            return None
+
+        def step_hook(completed, _loss):
+            if (completed == drain_at_step and sync["phase"] == 2
+                    and not sync["drain_fired"]):
+                sync["drain_fired"] = True
+                tina = rows_by_key().get("tina") or {}
+                held = (tina.get("placement") or [sync["slice"]])[0]
+                svc.workload_queue.preempt_slice(held)
+            return None
+
+        svc.workloads.request_hook = request_hook
+        svc.workloads.step_hook = step_hook
+        svc.workload_queue.submit(
+            mesh="data=2,fsdp=4", kind="serve", tenant="sierra",
+            priority="high", requests=serve_requests, slo_ms=750.0,
+            wait=False)
+        svc.workload_queue.submit(
+            mesh="data=1,fsdp=4", steps=tina_steps, tenant="tina",
+            priority="low", wait=False)
+        from kubeoperator_tpu.models import TERMINAL_STATES
+
+        deadline = _time.monotonic() + 300
+        while _time.monotonic() < deadline:
+            rows = rows_by_key()
+            if (rows.get("serve", {}).get("state") in TERMINAL_STATES
+                    and rows.get("tina", {}).get("state")
+                    in TERMINAL_STATES):
+                break
+            _time.sleep(0.05)
+        svc.workloads.request_hook = None
+        svc.workloads.step_hook = None
+        for t in threading.enumerate():
+            if t.name.startswith("workload-queue") and t is not \
+                    threading.current_thread():
+                t.join(timeout=60)
+
+        # ---- post-chaos health probe: the pool schedules clean ---------
+        svc.workload_queue.submit(
+            mesh="data=1,fsdp=4", steps=3, tenant="uma",
+            priority="normal", wait=True)
+
+        rows = rows_by_key()
+        server, tina, uma = rows["serve"], rows["tina"], rows["uma"]
+        ops = svc.repos.operations
+        check("all four queue lives finished done",
+              all(rows[k]["state"] == "done"
+                  for k in ("sierra", "serve", "tina", "uma")),
+              str({k: rows[k]["state"] for k in sorted(rows)}))
+
+        # ---- degrade, never drop ---------------------------------------
+        led = server.get("preemptions") or []
+        run_result = ((ops.get((server.get("run_ops") or [""])[0])
+                       .vars.get("result")) or {}
+                      if server.get("run_ops") else {})
+        check("slice loss DEGRADED the server onto the survivor — one "
+              "ledger row, no drain, the entry never left running",
+              len(led) == 1 and led[0]["kind"] == "degraded"
+              and led[0]["slice"] == sync["slice"]
+              and len(led[0]["survivors"]) == 1
+              and len(server.get("run_ops") or []) == 1,
+              str(led))
+        check("the degraded server answered EVERY request on the "
+              "smaller mesh",
+              run_result.get("served") == serve_requests
+              and run_result.get("degraded") is True
+              and not run_result.get("drained")
+              and run_result.get("finite")
+              and run_result.get("checkpoint_restored") == ckpt_row.id,
+              str({k: run_result.get(k) for k in
+                   ("served", "degraded", "drained", "finite")}))
+        # digests compare bit-for-bit vs the reference only BEFORE the
+        # reshard (a smaller data axis serves smaller request batches);
+        # after it they must stay finite and in the reference's band,
+        # and the cross-PASS bit-for-bit guarantee rides the structure
+        # diff under --verify-determinism
+        outputs = run_result.get("outputs") or []
+        import numpy as np
+
+        pre = outputs[:2] == ref_serve["outputs"][:2]
+        post = (len(outputs) == serve_requests
+                and np.isfinite(outputs).all()
+                and np.allclose(outputs, ref_serve["outputs"],
+                                rtol=0.25))
+        check("response digests: bit-for-bit vs the undegraded "
+              "reference before the reshard, finite and in-band after "
+              "it",
+              pre and post,
+              f"{outputs} vs {ref_serve['outputs']}")
+
+        # ---- the training lane drained + resumed around the flap -------
+        tled = tina.get("preemptions") or []
+        check("tina drained at her step-2 boundary, fenced to the lost "
+              "slice, with a checkpoint",
+              len(tled) == 1 and tled[0]["kind"] == "drained"
+              and tled[0]["step"] == drain_at_step
+              and tled[0]["by"] == f"slice:{sync['slice']}"
+              and bool(tled[0]["checkpoint"]), str(tled))
+        losses: list = []
+        for op_id in tina.get("run_ops") or []:
+            losses += (ops.get(op_id).vars.get("result")
+                       or {}).get("losses") or []
+        check("tina ran twice; drained+resumed losses == uninterrupted "
+              "run, bit-for-bit",
+              len(tina.get("run_ops") or []) == 2
+              and losses == ref_train["losses"]
+              and len(losses) == tina_steps,
+              f"{losses} vs {ref_train['losses']}")
+        check("both lanes were PHYSICALLY live at once, and the live "
+              "scrape showed the running gauge per kind",
+              sync["concurrent"] and sync["running_scrape"],
+              str(sync))
+        check("post-chaos probe: uma scheduled and finished on the "
+              "restored pool; nothing is lost",
+              uma["state"] == "done"
+              and not svc.workload_queue.capacity()["lost"],
+              str(svc.workload_queue.capacity()))
+
+        # ---- the serve trace: restore -> compile -> reshard compile ----
+        from kubeoperator_tpu.observability import span_tree
+
+        tree = span_tree(svc.repos.spans.for_trace(
+            ops.get(server["op_id"]).trace_id))
+        flat: list = []
+
+        def walk(node):
+            flat.append(node.get("name"))
+            for child in node.get("children", []):
+                walk(child)
+
+        if tree:
+            walk(tree)
+        check("server trace: entry root -> queue-wait, serve run, "
+              "checkpoint-restore, TWO serve compiles (initial + "
+              "degraded reshard)",
+              tree is not None and "queue-wait" in flat
+              and "workload-serve" in flat
+              and "checkpoint-restore" in flat
+              and flat.count("serve-compile") == 2, str(flat))
+
+        # ---- all four stories FROM THE EVENT STREAM alone --------------
+        from kubeoperator_tpu.models import Event
+        from kubeoperator_tpu.observability import queue_story
+
+        stream_client = LocalClient.__new__(LocalClient)
+        stream_client.services = svc
+        feed = stream_client.call("GET", "/api/v1/events?after=0")
+        bus = [Event.from_dict(row) for row in feed["events"]]
+
+        def norm(rows):
+            return [{
+                "kind": r["kind"], "state": r.get("state"),
+                "workload": r.get("workload"),
+                "step": r.get("step"), "by": bool(r.get("by")),
+                "checkpoint": bool(r.get("checkpoint")),
+                "survivors": len(r.get("survivors") or []),
+                "mesh": r.get("mesh"),
+            } for r in rows]
+
+        sierra_rows = queue_story(bus, tenant="sierra")
+        splits = [i for i, r in enumerate(sierra_rows)
+                  if r["kind"] == "queue.submit"]
+        stories = {
+            "sierra-train": norm(sierra_rows[:splits[1]])
+            if len(splits) > 1 else [],
+            "sierra-serve": norm(sierra_rows[splits[1]:])
+            if len(splits) > 1 else [],
+            "tina": norm(queue_story(bus, tenant="tina")),
+            "uma": norm(queue_story(bus, tenant="uma")),
+        }
+        shapes = {k: [(r["kind"], r["state"]) for r in v]
+                  for k, v in stories.items()}
+        check("four stories reconstruct from GET /api/v1/events alone: "
+              "train done, serve degraded-not-dropped, tina's "
+              "drain/resume life, uma clean",
+              shapes["sierra-train"] == [
+                  ("queue.submit", "pending"), ("queue.place", "placed"),
+                  ("queue.done", "done")]
+              and shapes["sierra-serve"] == [
+                  ("queue.submit", "pending"), ("queue.place", "placed"),
+                  ("queue.degrade", "running"), ("queue.done", "done")]
+              and shapes["tina"] == [
+                  ("queue.submit", "pending"), ("queue.place", "placed"),
+                  ("queue.preempt", "running"), ("queue.drain", "drained"),
+                  ("queue.resume", "pending"), ("queue.place", "placed"),
+                  ("queue.done", "done")]
+              and shapes["uma"] == [
+                  ("queue.submit", "pending"), ("queue.place", "placed"),
+                  ("queue.done", "done")]
+              and stories["sierra-serve"][0]["workload"] == "serve"
+              and stories["sierra-serve"][2]["survivors"] == 1
+              and bool(stories["sierra-serve"][2]["mesh"])
+              and stories["tina"][3]["step"] == drain_at_step
+              and stories["tina"][3]["checkpoint"], str(shapes))
+
+        # ---- the serving SLO rode the metric bus ------------------------
+        exposition = MetricsRegistry().render(svc)
+        check("exposition: per-request latency histogram for the "
+              "serving tenant + queue state gauge count all four done",
+              f'ko_tpu_workload_request_seconds_count{{tenant="sierra"}}'
+              f' {serve_requests}' in exposition
+              and 'ko_tpu_workload_queue{state="done"} 4' in exposition,
+              "(families present)"
+              if "ko_tpu_workload_request_seconds" in exposition
+              else "(missing)")
+
+        structure = {
+            "states": {k: rows[k]["state"] for k in sorted(rows)},
+            "server_ledger": [(p["kind"], len(p.get("survivors") or []))
+                              for p in led],
+            "tina_ledger": [(p["kind"], p.get("step"), p.get("by"))
+                            for p in tled],
+            "served": run_result.get("served"),
+            "degraded_mesh": run_result.get("mesh"),
+            "outputs": outputs,
+            "reference_outputs": ref_serve["outputs"],
+            "losses": losses,
+            "reference": ref_train["losses"],
+            "concurrent": sync["concurrent"],
+            "running_scrape": sync["running_scrape"],
+            "stories": stories,
+        }
+    finally:
+        svc.close()
+    return checks, structure
+
+
+def cmd_serve_soak(args) -> int:
+    """`koctl chaos-soak --serve`: the serving-class drill — a training
+    tenant and a latency-class server share a 2-slice pool through a
+    flapping slice; the server degrades onto the survivor (never
+    dropped), the trainer checkpoints+drains and resumes, and all four
+    queue lives reconstruct from the event bus alone.
+    --verify-determinism runs two seeded passes and diffs the structural
+    summaries (response digests included) bit-for-bit."""
+    import shutil
+    import tempfile
+    import time as _time
+
+    # the drill's 2x v5e-4 pool wants 8 virtual CPU devices, pinned
+    # BEFORE the first jax import (same discipline as perf_matrix)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flag = "--xla_force_host_platform_device_count=8"
+    if flag not in os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "") + " " + flag).strip()
+    t0 = _time.monotonic()
+    with tempfile.TemporaryDirectory(prefix="ko-serve-soak-") as base:
+        checks, structure = _serve_soak_once(
+            args, os.path.join(base, "pass1"))
+        deterministic = None
+        if args.verify_determinism:
+            checks2, structure2 = _serve_soak_once(
+                args, os.path.join(base, "pass2"))
+            deterministic = (structure == structure2
+                             and [c["ok"] for c in checks]
+                             == [c["ok"] for c in checks2])
+        shutil.rmtree(base, ignore_errors=True)
+    ok = all(c["ok"] for c in checks) and deterministic in (None, True)
+    report = {
+        "seed": args.seed,
+        "checks": checks,
+        "structure": structure,
+        "runtime_s": round(_time.monotonic() - t0, 3),
+    }
+    if deterministic is not None:
+        report["deterministic"] = deterministic
+    if args.format == "json":
+        _print(report)
+    else:
+        print(f"serve chaos-soak: states {structure.get('states')} "
+              f"served {structure.get('served')} on "
+              f"{structure.get('degraded_mesh')}")
+        for c in checks:
+            mark = "ok " if c["ok"] else "FAIL"
+            print(f"  [{mark}] {c['check']}"
+                  + (f" — {c['detail']}" if c["detail"] and not c["ok"]
+                     else ""))
+        if deterministic is not None:
+            print(f"  deterministic across two runs: {deterministic}")
+        print(f"  runtime {report['runtime_s']}s — "
+              + ("OK" if ok else "FAILED"))
+    return 0 if ok else 1
+
+
 def cmd_controller_soak(args) -> int:
     """`koctl chaos-soak --controllers N` (docs/resilience.md "Controller
     leases"): the multi-controller kill drill. A replica holding >=3
@@ -3552,6 +4007,8 @@ def cmd_chaos_soak(args) -> int:
         return cmd_preemption_soak(args)
     if args.queue:
         return cmd_queue_soak(args)
+    if args.serve:
+        return cmd_serve_soak(args)
     t0 = _time.monotonic()
     with tempfile.TemporaryDirectory(prefix="ko-chaos-") as base:
         report = _chaos_soak_once(args, os.path.join(base, "pass1"))
@@ -3873,10 +4330,29 @@ def build_parser() -> argparse.ArgumentParser:
     wl_train.add_argument("--json", action="store_true")
     wl_submit = wlsub.add_parser(
         "submit",
-        help="queue a training workload as a tenant: gang scheduling "
-             "places the WHOLE requested mesh on slice-pool capacity, "
-             "priority preemption checkpoint-drains lower-priority "
-             "victims (docs/workloads.md \"Queue and preemption\")")
+        help="queue a training or serving workload as a tenant: gang "
+             "scheduling places the WHOLE requested mesh on slice-pool "
+             "capacity, priority preemption checkpoint-drains "
+             "lower-priority victims (docs/workloads.md \"Queue and "
+             "preemption\", \"Serving\")")
+    wl_submit.add_argument("--kind", default="",
+                           choices=["", "train", "serve"],
+                           help="workload verb: train (default) is a "
+                                "finite run; serve restores the tenant's "
+                                "newest complete checkpoint and answers "
+                                "batched requests under an SLO — a slice "
+                                "preemption degrades it onto survivors "
+                                "instead of killing it")
+    wl_submit.add_argument("--requests", type=int, default=None,
+                           metavar="N",
+                           help="serve only: batched requests to answer "
+                                "before settling (default: "
+                                "serve.requests)")
+    wl_submit.add_argument("--slo-ms", type=float, default=None,
+                           metavar="MS",
+                           help="serve only: per-request latency "
+                                "objective in milliseconds (default: "
+                                "serve.slo_ms; 0 = report-only)")
     wl_submit.add_argument("--plan", default="",
                            help="pin to a TPU deploy plan's topology")
     wl_submit.add_argument("--mesh", default="", metavar="data=4,fsdp=2",
@@ -4134,6 +4610,17 @@ def build_parser() -> argparse.ArgumentParser:
                              "auto-resume), every eviction and resume "
                              "proven from journal rows and one stitched "
                              "span tree per tenant, loss parity pinned "
+                             "bit-for-bit")
+    soak_p.add_argument("--serve", action="store_true",
+                        help="run the serving-class drill instead: a "
+                             "training tenant and a latency-class "
+                             "server share a 2-slice pool through a "
+                             "flapping slice — the server re-shards "
+                             "onto the survivor (degrade, never drop), "
+                             "the trainer checkpoint-drains and "
+                             "resumes, all four queue lives "
+                             "reconstructed from the event bus alone, "
+                             "response digests and loss parity pinned "
                              "bit-for-bit")
     soak_p.add_argument("--clusters", type=int, default=21,
                         help="fleet size for --fleet (floored at 9) / "

@@ -68,7 +68,7 @@ def priority_of(priority_class: str) -> int:
 class QueueEntry(Entity):
     op_id: str = ""            # the entry's journal op (platform scope)
     tenant: str = ""           # checkpoint namespace + accounting label
-    kind: str = "train"        # train | sweep | remediation
+    kind: str = "train"        # train | serve | sweep | remediation
     priority_class: str = "normal"
     priority: int = 20         # mirrored rank (priority_of at submit)
     state: str = "pending"
@@ -88,6 +88,11 @@ class QueueEntry(Entity):
     cancel_requested: bool = False   # operator cancel of a running entry:
     #                                  drain first, then `cancelled`
     message: str = ""
+    # serving gangs only (docs/workloads.md "Serving"): how many batched
+    # requests the server answers before closing, and the per-request
+    # latency SLO its tier promises (0 = serve.* config defaults)
+    requests: int = 0
+    slo_ms: float = 0.0
     # priority aging (queue.aging_after_s): when the entry last promoted
     # a class (0 = never aged; the next deadline counts from created_at),
     # and the promotion ledger [{"from", "to", "at"}] — the audit trail
@@ -100,10 +105,14 @@ class QueueEntry(Entity):
         # `remediation` entries are the convergence controller's ledgered
         # housekeeping (service/converge.py): zero-slice gangs that ride
         # the queue for ordering/audit, never for capacity
-        if self.kind not in ("train", "sweep", "remediation"):
+        # `serve` entries are latency-class gangs (docs/workloads.md
+        # "Serving"): they restore a checkpoint, hold the compiled
+        # forward resident, and answer requests — training is preempted
+        # before serving ever is (choose_victims orders kinds)
+        if self.kind not in ("train", "serve", "sweep", "remediation"):
             raise ValidationError(
                 f"queue entry kind {self.kind!r} not in "
-                f"('train', 'sweep', 'remediation')")
+                f"('train', 'serve', 'sweep', 'remediation')")
         if self.state not in QUEUE_STATES:
             raise ValidationError(
                 f"queue entry state {self.state!r} not in {QUEUE_STATES}")

@@ -61,6 +61,9 @@ class EventKind:
     QUEUE_DRAIN = "queue.drain"
     QUEUE_RESUME = "queue.resume"
     QUEUE_DONE = "queue.done"
+    # a serving gang re-sharded onto its surviving slices after a slice
+    # preemption — degraded, NOT dropped (service/queue.py preempt_slice)
+    QUEUE_DEGRADE = "queue.degrade"
     # fleet wave verdicts (fleet/engine.py)
     FLEET_WAVE = "fleet.wave"
     # convergence controller decisions (service/converge.py,
@@ -106,7 +109,8 @@ def emit_event(repos, kind: str, *, cluster_id: str = "", op_id: str = "",
 # the queue-entry life in stream order — the reducer's verdict alphabet
 QUEUE_STORY_KINDS = (
     EventKind.QUEUE_SUBMIT, EventKind.QUEUE_PLACE, EventKind.QUEUE_PREEMPT,
-    EventKind.QUEUE_DRAIN, EventKind.QUEUE_RESUME, EventKind.QUEUE_DONE,
+    EventKind.QUEUE_DEGRADE, EventKind.QUEUE_DRAIN, EventKind.QUEUE_RESUME,
+    EventKind.QUEUE_DONE,
 )
 
 
@@ -159,7 +163,8 @@ def queue_story(events, tenant: str = "") -> list[dict]:
         if tenant and event.tenant != tenant:
             continue
         row = {"kind": event.kind, "tenant": event.tenant}
-        for key in ("state", "step", "by", "checkpoint", "priority"):
+        for key in ("state", "step", "by", "checkpoint", "priority",
+                    "workload", "slice", "survivors", "mesh"):
             value = event.payload.get(key)
             if value not in (None, ""):
                 row[key] = value

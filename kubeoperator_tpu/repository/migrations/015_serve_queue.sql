@@ -1,0 +1,5 @@
+-- Concurrent gang dispatch + the serve verb (docs/workloads.md
+-- "Serving"): mirror the entry kind so kind-aware scheduler queries and
+-- the per-priority running gauge stay in SQL. Existing rows predate the
+-- column and read as 'train' — exactly what they all were.
+ALTER TABLE workload_queue ADD COLUMN kind TEXT NOT NULL DEFAULT 'train';

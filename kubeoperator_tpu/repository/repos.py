@@ -662,6 +662,16 @@ class MetricSampleRepo(EntityRepo[MetricSample]):
             f"WHERE kind = 'step' AND step_s > 0 ORDER BY {ROWID_SQL}")
         return [(r["tenant"], float(r["step_s"])) for r in rows]
 
+    def request_rows(self) -> list[tuple]:
+        """(tenant, request_latency_s) for every serving request sample —
+        the `ko_tpu_workload_request_seconds` histogram's raw material
+        (docs/workloads.md "Serving"); idx_metric_samples_kind serves the
+        kind + step_s predicate pair exactly as it does for steps."""
+        rows = self.db.query(
+            f"SELECT tenant, step_s FROM {self.table} "
+            f"WHERE kind = 'request' AND step_s > 0 ORDER BY {ROWID_SQL}")
+        return [(r["tenant"], float(r["step_s"])) for r in rows]
+
     def latest_losses(self) -> list[tuple]:
         """(op_id, tenant, step, loss) of each op's NEWEST step sample —
         the `ko_tpu_workload_loss` gauge's raw material, one indexed
@@ -775,7 +785,7 @@ class WorkloadQueueRepo(EntityRepo[QueueEntry]):
     table, entity, columns = (
         "workload_queue", QueueEntry,
         ("op_id", "tenant", "priority_class", "priority", "state",
-         "started_at"),
+         "started_at", "kind"),
     )
 
     def pending(self) -> list[QueueEntry]:
@@ -817,6 +827,18 @@ class WorkloadQueueRepo(EntityRepo[QueueEntry]):
             f"FROM {self.table} WHERE started_at > 0 ORDER BY {ROWID_SQL}")
         return [(r["priority_class"], max(float(r["w"]), 0.0))
                 for r in rows]
+
+    def running_counts(self) -> dict[tuple, int]:
+        """(priority_class, kind) → live running-entry count, computed
+        IN SQL on the mirrored columns (idx_workload_queue_state leads
+        with state) — the gauge's per-priority `running` dimension must
+        not hydrate the queue per scrape."""
+        rows = self.db.query(
+            f"SELECT priority_class, kind, COUNT(*) AS n "
+            f"FROM {self.table} WHERE state = 'running' "
+            f"GROUP BY priority_class, kind")
+        return {(r["priority_class"], r["kind"]): int(r["n"])
+                for r in rows}
 
 
 class SliceEventRepo(EntityRepo[SliceEvent]):

@@ -44,6 +44,7 @@ from kubeoperator_tpu.utils.errors import (
     ValidationError,
 )
 from kubeoperator_tpu.utils.logging import get_logger
+from kubeoperator_tpu.utils.threads import spawn
 from kubeoperator_tpu.version import DEFAULT_K8S_VERSION
 
 log = get_logger("service.cluster")
@@ -1066,7 +1067,8 @@ class ClusterService:
                     self._ops.pop(cluster_id, None)
 
         thread = (threading.current_thread() if wait
-                  else threading.Thread(target=guarded, daemon=True))
+                  else spawn(f"cluster-op-{cluster_id[:8]}", guarded,
+                             start=False))
         # check + register under ONE lock hold, or two concurrent calls both
         # pass the check and race each other on the same cluster
         with self._ops_lock:

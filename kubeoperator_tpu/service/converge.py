@@ -59,14 +59,19 @@ from kubeoperator_tpu.utils.errors import (
 )
 from kubeoperator_tpu.utils.ids import now_ts
 from kubeoperator_tpu.utils.logging import get_logger
+from kubeoperator_tpu.utils.threads import spawn
 
 log = get_logger("service.converge")
 
 CONVERGE_OP_KIND = "fleet-converge"
 
 # tick-batch submit failures ride the skip stream under this reason (the
-# planner's alphabet plus one service-layer entry)
+# planner's alphabet plus two service-layer entries)
 SKIP_SUBMIT_FAILED = "submit-failed"
+# a failed batch rollout never REACHED these clusters (canary block or
+# mid-wave abort before their wave) — their ledger attempt is refunded,
+# so one poisoned batchmate cannot burn an innocent's escalation budget
+SKIP_CANARY_BLOCKED = "canary-blocked"
 
 
 class ConvergeService:
@@ -84,6 +89,10 @@ class ConvergeService:
         self._op = None
         self._last_kick = 0.0
         self._threads: list[threading.Thread] = []
+        # clusters a failed batch rollout never reached, reported by
+        # execute() (queue lane threads) and drained by the tick after
+        # its engine drive — the attempt-refund handshake
+        self._untouched: list[str] = []
 
     # ------------------------------------------------------ controller op ----
     def _controller_op(self):
@@ -348,7 +357,31 @@ class ConvergeService:
             # one engine drive for the whole batch, on THIS thread (the
             # tick already runs off the cron loop — see maybe_kick)
             self.s.workload_queue.process(wait=True)
+            self._refund_untouched(op, ledger, tick_no)
         return acted, failed
+
+    def _refund_untouched(self, op, ledger: dict, tick_no: int) -> None:
+        """Give back the ledger attempt of every cluster a FAILED batch
+        rollout never reached (execute() reports them): a canary block
+        is the poisoned batchmate's failure, not theirs — without the
+        refund, one permanently-broken cluster burns its whole batch's
+        escalation budget and healthy clusters end up `manual` at the
+        wrong version."""
+        with self._lock:
+            names, self._untouched = sorted(set(self._untouched)), []
+        if not names:
+            return
+        for name in names:
+            row = ledger.get(name)
+            if row and not row.get("escalated") \
+                    and int(row.get("attempts", 0)) > 0:
+                row["attempts"] = int(row["attempts"]) - 1
+        self._save(op, EventKind.CONVERGE_SKIP,
+                   f"tick {tick_no}: batch rollout never reached "
+                   f"{len(names)} cluster(s); attempt refunded",
+                   {"tick": tick_no, "action": "upgrade",
+                    "reason": SKIP_CANARY_BLOCKED,
+                    "refunded": names})
 
     # ----------------------------------------------------------- execute ----
     def execute(self, rem: dict) -> dict:
@@ -389,6 +422,18 @@ class ConvergeService:
                 target, selector={"names": ",".join(sorted(clusters))},
                 wait=True)
             ok = desc.get("status") == "Succeeded"
+            if not ok:
+                # a canary block or mid-wave abort stops the rollout
+                # before later waves ever run: batchmates that neither
+                # completed nor failed were never attempted — report
+                # them so the tick refunds their ledger attempt
+                touched = set(desc.get("completed", [])) \
+                    | set(desc.get("failed", {}))
+                untouched = sorted(n for n in clusters
+                                   if n not in touched)
+                if untouched:
+                    with self._lock:
+                        self._untouched.extend(untouched)
             return {"ok": ok,
                     "message": f"upgrade to {target}: {desc.get('status')}"
                                f" ({len(desc.get('completed', []))}/"
@@ -419,8 +464,8 @@ class ConvergeService:
                     < self.cfg.interval_s:
                 return False
             self._last_kick = now
-            thread = threading.Thread(target=self._tick_guarded,
-                                      daemon=True, name="fleet-converge")
+            thread = spawn("fleet-converge", self._tick_guarded,
+                           start=False)
             self._threads = [t for t in self._threads if t.is_alive()]
             self._threads.append(thread)
         thread.start()

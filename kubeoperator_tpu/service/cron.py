@@ -12,6 +12,7 @@ import time
 from datetime import datetime, timedelta
 
 from kubeoperator_tpu.utils.logging import get_logger
+from kubeoperator_tpu.utils.threads import spawn
 
 log = get_logger("service.cron")
 
@@ -67,8 +68,7 @@ class CronService:
     def start(self) -> None:
         if self._thread is not None:
             return
-        self._thread = threading.Thread(target=self._loop, daemon=True)
-        self._thread.start()
+        self._thread = spawn("cron-scheduler", self._loop)
         log.info("cron scheduler started")
 
     def stop(self) -> None:

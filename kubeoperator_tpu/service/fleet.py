@@ -41,6 +41,7 @@ from kubeoperator_tpu.utils.errors import (
     ValidationError,
 )
 from kubeoperator_tpu.utils.logging import get_logger
+from kubeoperator_tpu.utils.threads import spawn
 from kubeoperator_tpu.version import SUPPORTED_K8S_VERSIONS
 
 log = get_logger("service.fleet")
@@ -194,8 +195,8 @@ class FleetService:
                     self._threads.pop(op.id, None)
                     self._signals.pop(op.id, None)
 
-        thread = (threading.current_thread() if wait else threading.Thread(
-            target=guarded, daemon=True, name=f"fleet-{op.id[:8]}"))
+        thread = (threading.current_thread() if wait
+                  else spawn(f"fleet-{op.id[:8]}", guarded, start=False))
         with self._lock:
             self._signals[op.id] = (pause, abort)
             self._threads[op.id] = thread

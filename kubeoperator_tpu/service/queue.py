@@ -20,14 +20,31 @@ and auto-resumes from its checkpoint when capacity returns. A victim
 that never started (merely `placed`) is displaced back to pending with
 no drain — it has no state to save.
 
-Dispatch is cooperative and SERIAL in-process: one physical run executes
-at a time on the controller's local devices (the tier-1/drill reality —
-on hardware the dispatch leg is a per-slice JobSet launch and runs truly
-parallel), while the PLACEMENT ledger is what the gang check guards.
-`submit(wait=True)` drives the engine loop on the caller's thread until
-the queue has no runnable work; a submission arriving mid-run (another
-thread, or a step hook) only enqueues and updates the scheduling state —
-the owning engine loop picks it up at the next boundary.
+Dispatch is CONCURRENT with per-run fault isolation (ISSUE 18): the
+engine drives an `adm/pool.py BoundedPool` of `queue.max_concurrent`
+lanes, so every placed gang launches as its own worker lane while ONE
+coordinator owns every scheduling decision. `self._running` is the
+per-entry run ledger (entry id → its op id), flipped together with the
+persisted `running` state under the scheduler lock, so a preemption can
+route a TARGETED drain at exactly one lane: two victims drain
+concurrently and each checkpoints and re-queues independently, and a
+chaos `ControllerDeath` on one lane lets siblings settle while the boot
+reconciler recovers every lane to its recorded verdict. A submission
+arriving mid-flight enqueues, runs a scheduling pass, and `kick`s the
+coordinator so free lanes fill without waiting for the next settle.
+`submit(wait=True)` still drives the engine on the caller's thread
+(the CLI's synchronous posture) — with the default
+`queue.max_concurrent = 1` the engine is bit-for-bit the old serial
+cooperative loop.
+
+The `serve` kind is the second workload verb (docs/workloads.md
+"Serving"): a latency-class gang that restores a tenant checkpoint and
+answers requests. Training is always preempted before serving
+(workloads/queue.py choose_victims orders kinds), and a slice
+preemption under a live server DEGRADES it onto the survivors
+(`preempt_slice` → `request_degrade` → re-shard at reduced throughput)
+instead of dropping the entry — drain is the fallback only when no
+survivable layout exists.
 """
 
 from __future__ import annotations
@@ -45,9 +62,11 @@ from kubeoperator_tpu.utils.errors import (
     NotFoundError,
     ValidationError,
 )
+from kubeoperator_tpu.adm.pool import BoundedPool
 from kubeoperator_tpu.observability import EventKind
 from kubeoperator_tpu.utils.ids import now_ts
 from kubeoperator_tpu.utils.logging import get_logger
+from kubeoperator_tpu.utils.threads import spawn
 from kubeoperator_tpu.workloads.queue import (
     SlicePoolView,
     SliceSlot,
@@ -78,6 +97,13 @@ def submit_kwargs(body: dict) -> dict:
     wait = body.get("wait", False)
     if not isinstance(wait, bool):
         raise ValidationError("wait must be a boolean")
+    slo = body.get("slo_ms")
+    if slo is not None:
+        try:
+            slo = float(slo)
+        except (TypeError, ValueError):
+            raise ValidationError(
+                f"slo_ms must be a number, got {slo!r}") from None
     return {
         "plan": str(body.get("plan", "") or ""),
         "mesh": str(body.get("mesh", "") or ""),
@@ -86,6 +112,8 @@ def submit_kwargs(body: dict) -> dict:
         "priority": str(body.get("priority", "") or ""),
         "tenant": str(body.get("tenant", "") or ""),
         "kind": str(body.get("kind", "") or "train"),
+        "requests": optional_int("requests", body.get("requests")),
+        "slo_ms": slo,
         "wait": wait,
     }
 
@@ -104,18 +132,25 @@ class WorkloadQueueService:
         self.preempt = bool(cfg.get("queue.preempt", True))
         self.max_entries = max(int(cfg.get("queue.max_entries", 64)), 1)
         self.aging_after_s = float(cfg.get("queue.aging_after_s", 0))
-        # engine state, all guarded by _lock: one dispatch loop owns
-        # physical execution at a time; _running_id names the entry whose
-        # train is live so the scheduler can route a drain at it
+        self.max_concurrent = max(
+            int(cfg.get("queue.max_concurrent", 1)), 1)
+        # engine state, all guarded by _lock: one coordinator owns every
+        # scheduling decision at a time; _running is the per-entry run
+        # ledger (entry id → its op id, the dispatch key a targeted
+        # drain/degrade routes at) so N concurrent lanes each stay
+        # individually reachable
         self._lock = threading.RLock()
         self._engine_active = False
-        self._running_id = ""
+        self._running: dict[str, str] = {}
+        self._pool: BoundedPool | None = None   # live only while driving
+        self._lost_slices: set[str] = set()     # preempted out of the pool
         self._threads: list[threading.Thread] = []
 
     # ------------------------------------------------------------ submit ----
     def submit(self, plan: str = "", mesh: str = "",
                steps: int | None = None, mode: str = "",
                priority: str = "", tenant: str = "", kind: str = "train",
+               requests: int | None = None, slo_ms: float | None = None,
                wait: bool = True) -> dict:
         """Admit one workload into the queue as a journaled platform op
         and run a scheduling pass; with `wait`, drive the dispatch engine
@@ -129,9 +164,19 @@ class WorkloadQueueService:
         from kubeoperator_tpu.workloads.step import WORKLOAD_AXES
 
         kind = kind or "train"
-        if kind not in ("train", "sweep"):
+        if kind not in ("train", "serve", "sweep"):
             raise ValidationError(
-                f"queue entry kind {kind!r} not in ('train', 'sweep')")
+                f"queue entry kind {kind!r} not in "
+                f"('train', 'serve', 'sweep')")
+        if requests is not None and int(requests) < 1:
+            raise ValidationError("workload serve needs requests >= 1")
+        if slo_ms is not None and float(slo_ms) < 0:
+            raise ValidationError("slo_ms must be >= 0")
+        if kind != "serve" and (requests is not None
+                                or slo_ms is not None):
+            raise ValidationError(
+                "requests/slo_ms are serving-tier knobs — only a "
+                "kind='serve' entry takes them")
         priority = priority or (
             "scavenger" if kind == "sweep" else self.priority_default)
         rank = priority_of(priority)
@@ -155,6 +200,18 @@ class WorkloadQueueService:
             if not row.has_tpu():
                 raise ValidationError(
                     f"plan {plan!r} has no TPU topology")
+        ckpt_row = None
+        if kind == "serve":
+            # serving starts FROM a model: admission fails fast when the
+            # tenant's index holds nothing complete to restore
+            ckpt_row = self.repos.checkpoints.latest_complete(
+                tenant=tenant or None)
+            if ckpt_row is None:
+                raise ValidationError(
+                    "workload serve needs a COMPLETE checkpoint"
+                    + (f" for tenant {tenant!r}" if tenant else "")
+                    + " in the index — train first (serving restores a "
+                    "model, it does not train one)")
         n_local = len(jax.devices())
         if kind == "sweep":
             devices = n_local          # the sweep wants the whole pool
@@ -163,12 +220,21 @@ class WorkloadQueueService:
             spec = MeshSpec.parse(mesh, axis_names=WORKLOAD_AXES,
                                   n_devices=n_local)
             devices = spec.total_devices
+        elif ckpt_row is not None and ckpt_row.mesh:
+            # an unpinned server sizes its gang from the checkpoint's
+            # recorded mesh — the layout it will actually restore onto
+            devices = 1
+            for n in ckpt_row.mesh.values():
+                devices *= int(n)
         else:
             devices = n_local
-        steps = int(steps) if steps is not None else int(
-            self.s.config.get("workloads.steps", 4))
-        if steps < 2:
-            raise ValidationError("queued workloads need steps >= 2")
+        if kind == "serve":
+            steps = 0   # a server answers requests, it has no step count
+        else:
+            steps = int(steps) if steps is not None else int(
+                self.s.config.get("workloads.steps", 4))
+            if steps < 2:
+                raise ValidationError("queued workloads need steps >= 2")
 
         op = self.journal.open_scoped(
             QUEUE_ENTRY_KIND,
@@ -180,14 +246,16 @@ class WorkloadQueueService:
         entry = QueueEntry(
             op_id=op.id, tenant=tenant, kind=kind,
             priority_class=priority, priority=rank, plan=plan, mesh=mesh,
-            steps=steps, mode=mode, devices=devices)
+            steps=steps, mode=mode, devices=devices,
+            requests=int(requests) if requests is not None else 0,
+            slo_ms=float(slo_ms) if slo_ms is not None else 0.0)
         entry.validate()
         self.repos.workload_queue.save(entry)
         self._sync_op(entry, op=op, event=(
             EventKind.QUEUE_SUBMIT,
             f"{kind} submitted at {priority}",
             {"state": entry.state, "priority": priority,
-             "devices": devices}))
+             "workload": kind, "devices": devices}))
         log.info("workload %s queued: %s %s priority=%s tenant=%s "
                  "devices=%d", entry.id[:8], kind, mesh or "(default)",
                  priority, tenant or "-", devices)
@@ -286,6 +354,11 @@ class WorkloadQueueService:
             if not slots:
                 slots = [SliceSlot("local/0", len(jax.devices()))]
                 source = "local"
+        if self._lost_slices:
+            # a preempted slice is OUT of the schedulable pool until
+            # restore_slice returns it — nothing new places onto it
+            slots = [s for s in slots
+                     if s.slice_id not in self._lost_slices]
         view = SlicePoolView(slots=slots)
         for e in self.repos.workload_queue.active():
             if e.placement:
@@ -301,6 +374,7 @@ class WorkloadQueueService:
             "chips_per_slice": view.chips_per_slice,
             "free": view.free_slices(),
             "held": {k: v for k, v in sorted(view.holders.items())},
+            "lost": sorted(self._lost_slices),
             "source": source,
         }
 
@@ -379,8 +453,8 @@ class WorkloadQueueService:
         if victim.state == "running":
             if victim.preempted_by:
                 return   # a drain is already in flight for it
-            if victim.id != self._running_id:
-                # the engine is between states (or the row is a crash
+            if victim.id not in self._running:
+                # the lane is between states (or the row is a crash
                 # strand the reconciler owns): marking preempted_by with
                 # no drain to back it would block every later pass —
                 # leave it, the next schedule pass retries
@@ -392,10 +466,13 @@ class WorkloadQueueService:
                 f"preemption requested by {by_id[:8]}",
                 {"state": victim.state, "by": by_id,
                  "mode": "drain"}))
+            # TARGETED at this victim's lane: concurrent siblings keep
+            # running — fault isolation is the whole point of the ledger
             self.workloads.request_drain(
                 f"preempted by workload {by_id[:8]} "
                 f"({by.priority_class})" if by is not None
-                else "preempted")
+                else "preempted",
+                target=victim.op_id)
             return
         if victim.state == "placed":
             # never started: displace the reservation, nothing to drain
@@ -415,23 +492,42 @@ class WorkloadQueueService:
 
     # ------------------------------------------------------------ engine ----
     def process(self, wait: bool = True):
-        """The dispatch loop: schedule, run the highest-priority placed
-        entry to its next terminal/drained state, repeat until nothing is
-        runnable. Exactly one loop owns execution at a time; a second
-        caller returns immediately (its entry is already in the state
-        the owning loop consumes). `wait=False` runs the loop on a
-        background thread (the REST submit path and the reconciler's
-        recovery kick)."""
+        """The dispatch engine: schedule, launch every placed gang onto
+        the bounded lane pool (at most `queue.max_concurrent` physically
+        live at once), fold each lane's verdict as it settles, repeat
+        until nothing is runnable. Exactly one engine owns dispatch at a
+        time; a second caller kicks the live coordinator (so its work is
+        considered NOW, not at the next settle) and returns. `wait=False`
+        runs the engine on a background thread (the REST submit path and
+        the reconciler's recovery kick)."""
         if not wait:
             with self._lock:
                 if self._engine_active:
-                    return None   # a live loop will pick the work up
-                t = threading.Thread(target=self._process_guarded,
-                                     daemon=True, name="workload-queue")
-                self._threads.append(t)
+                    t = None   # a live engine will pick the work up
+                else:
+                    t = spawn("workload-queue", self._process_guarded,
+                              start=False)
+                    self._threads.append(t)
+            if t is None:
+                self._kick()
+                return None
             t.start()
             return None
-        return self._process_guarded()
+        out = self._process_guarded()
+        if isinstance(out, dict) and out.get("engine") == "busy":
+            self._kick()
+        return out
+
+    def _kick(self) -> None:
+        """Wake the live coordinator (if any) so it re-consults the
+        scheduler immediately. Never called under `_lock` held by THIS
+        frame's caller chain while also needed by the coordinator —
+        the pool ref is snapshotted under `_lock`, the kick happens
+        outside it (BoundedPool.kick's lock-ordering contract)."""
+        with self._lock:
+            pool = self._pool
+        if pool is not None:
+            pool.kick()
 
     def _process_guarded(self):
         from kubeoperator_tpu.resilience.lease import StaleEpochError
@@ -441,37 +537,83 @@ class WorkloadQueueService:
                 return {"dispatched": 0, "engine": "busy"}
             self._engine_active = True
         dispatched = 0
+        retired = False
         try:
             while True:
-                self.schedule()
-                entry = self._next_placed()
-                if entry is None:
-                    break
-                self._run_one(entry)
-                dispatched += 1
+                dispatched += self._drive_pool()
+                with self._lock:
+                    # retire ATOMICALLY with the no-work check: a submit
+                    # saves its row before consulting _engine_active, so
+                    # either this check sees the row (loop again) or the
+                    # submitter sees a retired engine (and becomes it) —
+                    # a row can never fall between engines
+                    self.schedule()
+                    if not any(e.state == "placed"
+                               for e in self.repos.workload_queue
+                               .active()):
+                        self._engine_active = False
+                        retired = True
+                        break
         except StaleEpochError as e:
             # fenced out mid-dispatch: a peer owns this queue state now —
             # stop cleanly, the new owner's engine continues the work
             log.warning("workload-queue engine fenced out: %s", e)
         finally:
-            with self._lock:
-                self._engine_active = False
+            if not retired:
+                with self._lock:
+                    self._engine_active = False
         return {"dispatched": dispatched}
 
-    def _next_placed(self) -> QueueEntry | None:
-        placed = [e for e in self.repos.workload_queue.active()
-                  if e.state == "placed"]
-        placed.sort(key=lambda e: (-e.priority, e.created_at, e.id))
-        return placed[0] if placed else None
+    def _drive_pool(self) -> int:
+        """One BoundedPool run: the coordinator loop launches every
+        placed gang (in priority order, capped at the free lanes),
+        blocks until all lanes settle and the scheduler has nothing
+        placed. `schedule` runs under `_lock` — the same lock `_evict`
+        and `cancel` route targeted drains under — so the ledger flip to
+        `running` and the persisted state flip are one atomic step per
+        lane."""
+        pool = BoundedPool(self.max_concurrent, "workload-queue")
+        launched = {"n": 0}
 
-    def _run_one(self, entry: QueueEntry) -> None:
-        """Dispatch one placed entry through the existing WorkloadService
-        seam and fold the outcome back into queue state. The run op
-        stitches under the entry op (one trace per tenant workload life:
-        queue-wait → run → drain → resume)."""
+        def schedule_cb(view):
+            with self._lock:
+                self.schedule()
+                placed = [e for e in self.repos.workload_queue.active()
+                          if e.state == "placed"]
+                placed.sort(key=lambda e: (-e.priority, e.created_at,
+                                           e.id))
+                launches = placed[:view.free]
+                for entry in launches:
+                    self._mark_running(entry)
+                launched["n"] += len(launches)
+                return launches
+
+        def settle_cb(entry, result, error):
+            # _run_one folds its own outcome (including failures) into
+            # queue state; an error surfacing HERE means the fold itself
+            # broke — log it loudly, the reconciler owns the strand
+            if error is not None:
+                log.error("queue lane %s failed to settle: %s: %s",
+                          entry.id[:8], type(error).__name__, error)
+
+        with self._lock:
+            self._pool = pool
+        try:
+            pool.run(schedule_cb, self._run_one, settle_cb)
+        finally:
+            with self._lock:
+                self._pool = None
+        return launched["n"]
+
+    def _mark_running(self, entry: QueueEntry) -> None:
+        """Flip one placed entry to `running` (under `_lock`, via the
+        coordinator's schedule callback): the ledger entry and the
+        persisted state flip TOGETHER, so a concurrent schedule() either
+        sees `placed` (and may displace) or running-with-a-lane (and can
+        route a targeted drain) — never a running row no drain can
+        reach."""
         op = self.repos.operations.get(entry.op_id)
-        first_dispatch = entry.started_at == 0.0
-        if first_dispatch:
+        if entry.started_at == 0.0:
             entry.started_at = now_ts()
             self.journal.record_windows(op, [{
                 "name": "queue-wait", "start": entry.created_at,
@@ -480,16 +622,21 @@ class WorkloadQueueService:
                           "tenant": entry.tenant,
                           "slices": len(entry.placement)},
             }])
-        with self._lock:
-            # _running_id and the persisted `running` flip TOGETHER
-            # under the scheduler's lock: a concurrent schedule() either
-            # sees `placed` (and may displace) or running-with-an-engine
-            # (and can route a drain) — never a running row no drain can
-            # reach
-            self._running_id = entry.id
-            entry.state = "running"
-            self.repos.workload_queue.save(entry)
-            self._sync_op(entry, op=op)
+        self._running[entry.id] = entry.op_id
+        entry.name = entry.id[:8]   # BoundedPool's lane-thread label
+        entry.state = "running"
+        self.repos.workload_queue.save(entry)
+        self._sync_op(entry, op=op)
+
+    def _run_one(self, entry: QueueEntry) -> None:
+        """One lane's body (worker thread): dispatch the running entry
+        through the existing WorkloadService seam and fold the outcome
+        back into queue state. The run op stitches under the entry op
+        (one trace per tenant workload life: queue-wait → run → drain →
+        resume). A chaos BaseException (ControllerDeath) escapes the
+        fold entirely — the entry stays `running` with a Running op, the
+        exact strand boot recovery re-queues."""
+        op = self.repos.operations.get(entry.op_id)
         if entry.kind == "remediation":
             self._run_remediation(entry)
             return
@@ -500,6 +647,16 @@ class WorkloadQueueService:
                 run_desc = self.workloads.sweep(
                     steps=entry.steps, tenant=entry.tenant,
                     trace=trace, parent_op_id=entry.op_id)
+            elif entry.kind == "serve":
+                # a (re-)dispatched server restores the tenant's latest
+                # complete checkpoint — serving state IS the checkpoint,
+                # so re-dispatch after a drain needs no resume math
+                run_desc = self.workloads.serve(
+                    mesh=entry.mesh, requests=entry.requests or None,
+                    mode=entry.mode,
+                    slo_ms=entry.slo_ms or None,
+                    tenant=entry.tenant, trace=trace,
+                    parent_op_id=entry.op_id)
             elif entry.checkpoint:
                 # a previously-drained victim: restore its own checkpoint
                 # and finish the remaining steps (train's resume math)
@@ -514,16 +671,16 @@ class WorkloadQueueService:
                     mode=entry.mode, tenant=entry.tenant, trace=trace,
                     parent_op_id=entry.op_id)
         except Exception as e:
-            with self._lock:
-                self._running_id = ""
             entry = self.repos.workload_queue.get(entry.id)
             entry.placement = []
             entry.preempted_by = ""
             self._finish(entry, "failed", f"{type(e).__name__}: {e}")
             return
         finally:
+            # off the ledger BEFORE folding: a drain can no longer reach
+            # this lane, and a re-queued self must not race its own pop
             with self._lock:
-                self._running_id = ""
+                self._running.pop(entry.id, None)
         # reload: a scheduling pass during the run may have marked a
         # preemption (preempted_by) or a cancel on the row
         entry = self.repos.workload_queue.get(entry.id)
@@ -564,7 +721,7 @@ class WorkloadQueueService:
             ok, message = False, f"{type(e).__name__}: {e}"
         finally:
             with self._lock:
-                self._running_id = ""
+                self._running.pop(entry.id, None)
         entry = self.repos.workload_queue.get(entry.id)
         entry.placement = []
         entry.preempted_by = ""
@@ -635,21 +792,165 @@ class WorkloadQueueService:
                 f"queue entry {entry.id[:8]} already finished "
                 f"({entry.state})")
         with self._lock:
-            if entry.state == "running" and entry.id == self._running_id:
-                # a LIVE run: drain first (checkpoint at the next step
-                # boundary), the engine finishes the cancel when the
+            if entry.state == "running" and entry.id in self._running:
+                # a LIVE lane: drain it (targeted — concurrent siblings
+                # keep running), the lane finishes the cancel when the
                 # drained run returns
                 entry.cancel_requested = True
                 self.repos.workload_queue.save(entry)
                 self._sync_op(entry)
-                self.workloads.request_drain("cancelled by operator")
+                self.workloads.request_drain("cancelled by operator",
+                                             target=entry.op_id)
                 return self.describe(entry)
         # pending/placed — or a crash-stranded "running" row with no
-        # engine behind it (its op is Interrupted): nothing is live,
+        # lane behind it (its op is Interrupted): nothing is live,
         # finish the cancel directly
         entry.placement = []
         self._finish(entry, "cancelled", "cancelled by operator")
+        # a freed reservation may unblock a waiting gang
+        self._kick()
         return self.status(entry.id)
+
+    # --------------------------------------------------- slice preemption ---
+    def preempt_slice(self, slice_id: str) -> dict:
+        """A slice is being taken (chaos drill / maintenance): pull it
+        from the schedulable pool and settle every gang that holds it —
+        degrade-not-die for servers, checkpoint+drain for training,
+        displace for reservations that never started.
+
+        * a RUNNING **serve** lane re-shards onto its surviving slices
+          (`parallel.multislice.degraded_mesh_spec` → `request_degrade`
+          → the server re-compiles at its next request boundary) and
+          the entry STAYS running at reduced throughput — the queue
+          never drops it;
+        * a RUNNING **train** lane (or a server with no survivable
+          layout) gets the targeted drain protocol: checkpoint at the
+          next boundary, re-queue, auto-resume when capacity returns;
+        * a merely **placed** holder is displaced back to pending.
+
+        `restore_slice` returns the slice and kicks the engine."""
+        actions: list[dict] = []
+        with self._lock:
+            if slice_id in self._lost_slices:
+                return {"slice": slice_id, "actions": actions}
+            self._lost_slices.add(slice_id)
+            for entry in self.repos.workload_queue.active():
+                if slice_id not in entry.placement:
+                    continue
+                if (entry.state == "running" and entry.kind == "serve"
+                        and entry.id in self._running):
+                    survivors = [s for s in entry.placement
+                                 if s != slice_id]
+                    spec = self._degraded_spec(
+                        entry, len(entry.placement), len(survivors))
+                    if survivors and spec is not None and \
+                            self.workloads.request_degrade(
+                                entry.op_id, spec):
+                        entry.placement = survivors
+                        entry.preemptions = list(entry.preemptions) + [{
+                            "kind": "degraded", "slice": slice_id,
+                            "survivors": list(survivors),
+                            "at": now_ts(),
+                        }]
+                        self.repos.workload_queue.save(entry)
+                        self._sync_op(entry, event=(
+                            EventKind.QUEUE_DEGRADE,
+                            f"slice {slice_id} preempted; serving "
+                            f"degraded to {len(survivors)} slice(s) "
+                            f"({spec})",
+                            {"state": entry.state, "slice": slice_id,
+                             "survivors": list(survivors),
+                             "mesh": str(spec)}))
+                        log.info(
+                            "serve entry %s degraded to %d slice(s) "
+                            "after %s preemption", entry.id[:8],
+                            len(survivors), slice_id)
+                        actions.append({"entry": entry.id,
+                                        "action": "degraded",
+                                        "survivors": len(survivors)})
+                        continue
+                if entry.state == "running":
+                    if (entry.id in self._running
+                            and not entry.preempted_by):
+                        entry.preempted_by = f"slice:{slice_id}"
+                        self.repos.workload_queue.save(entry)
+                        self._sync_op(entry, event=(
+                            EventKind.QUEUE_PREEMPT,
+                            f"slice {slice_id} preempted under it; "
+                            f"draining",
+                            {"state": entry.state,
+                             "by": entry.preempted_by,
+                             "mode": "drain"}))
+                        self.workloads.request_drain(
+                            f"slice {slice_id} preempted",
+                            target=entry.op_id)
+                        actions.append({"entry": entry.id,
+                                        "action": "drain"})
+                    continue
+                # placed, never started: displace the reservation
+                entry.placement = []
+                entry.state = "pending"
+                entry.preemptions = list(entry.preemptions) + [{
+                    "kind": "displaced", "by": f"slice:{slice_id}",
+                    "at": now_ts(),
+                }]
+                self.repos.workload_queue.save(entry)
+                self._sync_op(entry, event=(
+                    EventKind.QUEUE_PREEMPT,
+                    f"displaced by slice {slice_id} preemption",
+                    {"state": entry.state, "by": f"slice:{slice_id}",
+                     "mode": "displaced"}))
+                actions.append({"entry": entry.id,
+                                "action": "displaced"})
+        self._kick()
+        return {"slice": slice_id, "actions": actions}
+
+    def restore_slice(self, slice_id: str, wait: bool = False) -> dict:
+        """The preempted slice returns: put it back in the schedulable
+        pool and (re)start the engine — drained victims re-place and
+        resume from their checkpoints."""
+        with self._lock:
+            was_lost = slice_id in self._lost_slices
+            self._lost_slices.discard(slice_id)
+        if was_lost:
+            self.schedule()
+            self.process(wait=wait)
+        return {"slice": slice_id, "restored": was_lost}
+
+    def _degraded_spec(self, entry: QueueEntry, num_slices: int,
+                       survivors: int):
+        """The MeshSpec a degraded server re-shards onto, or None when
+        the layout cannot shrink (single-slice gang, zero survivors, or
+        only `tp` spans slices) — the caller falls back to the drain
+        protocol."""
+        if num_slices < 2 or survivors < 1:
+            return None
+        from kubeoperator_tpu.parallel.mesh import MeshSpec
+        from kubeoperator_tpu.parallel.multislice import (
+            degraded_mesh_spec,
+        )
+        from kubeoperator_tpu.workloads.step import WORKLOAD_AXES
+
+        try:
+            if entry.mesh:
+                spec = MeshSpec.parse(
+                    entry.mesh, axis_names=WORKLOAD_AXES,
+                    n_devices=entry.devices or None)
+                missing = tuple((a, 1) for a in WORKLOAD_AXES
+                                if a not in spec.axis_names)
+                if missing:
+                    spec = MeshSpec(axes=spec.axes + missing)
+            else:
+                spec = MeshSpec(axes=(
+                    ("data", entry.devices), ("fsdp", 1), ("tp", 1)))
+            degraded, _axis = degraded_mesh_spec(
+                spec, num_slices, lost=num_slices - survivors)
+            return degraded
+        except Exception as e:
+            log.warning("serve entry %s cannot degrade (%s: %s); "
+                        "falling back to drain", entry.id[:8],
+                        type(e).__name__, e)
+            return None
 
     # ---------------------------------------------------------- recovery ----
     def recover(self, op_id: str = "", wait: bool = False) -> list[str]:
@@ -738,6 +1039,8 @@ class WorkloadQueueService:
             "steps": entry.steps,
             "mode": entry.mode,
             "devices": entry.devices,
+            "requests": entry.requests,
+            "slo_ms": entry.slo_ms,
             "placement": list(entry.placement),
             "preemptions": list(entry.preemptions),
             "preempted_by": entry.preempted_by,
@@ -783,6 +1086,8 @@ class WorkloadQueueService:
             "agings": list(entry.agings),
             "mesh": entry.mesh,
             "devices": entry.devices,
+            "requests": entry.requests,
+            "slo_ms": entry.slo_ms,
             "placement": list(entry.placement),
             "preemptions": list(entry.preemptions),
             "preempted_by": entry.preempted_by,

@@ -65,6 +65,12 @@ AUTO_RESUME_FLEET = frozenset({"fleet-upgrade"})
 # save — the resume opens a NEW op stitched into the original's trace
 # (the old op's spans are not re-armed, unlike fleet reopen)
 AUTO_RESUME_WORKLOAD = frozenset({"workload-train"})
+# run kinds the QUEUE dispatches as lanes (train + serve): an orphan
+# whose parent is a queue-entry op resumes through the queue path ONLY —
+# a `workload-serve` op never standalone-auto-resumes either way
+# (serving is stateless: the checkpoint IS its state; re-dispatch is
+# the resume)
+QUEUE_DISPATCHED_KINDS = frozenset({"workload-train", "workload-serve"})
 # queue-entry ops re-enter through WorkloadQueueService.recover: the
 # entry goes back to `pending` with its checkpoint (if a drain landed
 # one) intact, the entry op is REOPENED (journal.reopen, the fleet
@@ -135,7 +141,7 @@ class ReconcileService:
                        + (f" and resumes from checkpoint {ckpt[:8]}"
                           if ckpt else "")
                        + " when the engine next dispatches")
-            elif op.kind in AUTO_RESUME_WORKLOAD \
+            elif op.kind in QUEUE_DISPATCHED_KINDS \
                     and self._queue_dispatched(op):
                 # a run the QUEUE dispatched: its entry op is being
                 # re-queued by the AUTO_RESUME_QUEUE path above, and the
@@ -160,6 +166,14 @@ class ReconcileService:
                     resume = ""
                     msg = (f"{cause}: {op.kind} was in flight with no "
                            f"complete checkpoint; re-run the operation")
+            elif op.kind == "workload-serve":
+                # a standalone serving session holds no training state:
+                # the checkpoint it restored from IS its state, so
+                # re-submitting the server is the whole recovery
+                resume = ""
+                msg = (f"{cause}: serving session was in flight; the "
+                       f"checkpoint is its state — re-submit to serve "
+                       f"again")
             else:
                 resume = ""
                 msg = (f"{cause}: {op.kind} was in flight; re-run the "

@@ -52,6 +52,7 @@ log = get_logger("service.workload")
 
 WORKLOAD_TRAIN_KIND = "workload-train"
 WORKLOAD_SWEEP_KIND = "workload-sweep"
+WORKLOAD_SERVE_KIND = "workload-serve"
 
 
 class _StepSampler:
@@ -143,13 +144,22 @@ class WorkloadService:
         self.ckpt_dir = self._resolve_ckpt_dir(
             str(cfg.get("checkpoint.dir", "") or ""),
             str(cfg.get("db.path", "") or ""))
-        # cooperative drain: the preemption-notice path sets the event
-        # (request_drain) and the step loop checkpoints at the next step
-        # boundary; step_hook is the per-step seam drills/integrations
-        # compose onto the same boundary (called before the drain check)
-        self._drain = threading.Event()
-        self._drain_reason = ""
+        # serving defaults (serve.* DEFAULTS block, docs/workloads.md
+        # "Serving"): requests answered per session, and the per-request
+        # latency SLO the session's verdict is judged against (0 = no SLO)
+        self.serve_requests = max(int(cfg.get("serve.requests", 8)), 1)
+        self.serve_slo_ms = float(cfg.get("serve.slo_ms", 0.0))
+        # cooperative drain, PER RUN (ISSUE 18): concurrent dispatch means
+        # N live runs, so the drain flag is a registry keyed by the run's
+        # dispatch key (queue entry op id, or the run op's own id) — two
+        # victims draining concurrently each consume their OWN flag, and a
+        # serving run's degrade directives queue on its own control lane.
+        # step_hook/request_hook are the per-boundary seams drills compose
+        # onto (called before the drain check).
+        self._runs_lock = threading.Lock()
+        self._runs: dict[str, dict] = {}
         self.step_hook = None
+        self.request_hook = None
         # background resume threads (the reconciler's auto-resume path):
         # joined by wait_all() at container close, like cluster op threads
         self._threads: list[threading.Thread] = []
@@ -169,29 +179,76 @@ class WorkloadService:
         return "checkpoints"
 
     # ---- cooperative drain (preemption notice integration) ----
-    def request_drain(self, reason: str = "drain requested") -> None:
-        """Ask the running train loop to checkpoint and stop at the next
-        step boundary (the preemption-notice handler's verb). Safe to
-        call with nothing running — the flag is consumed per-run."""
-        self._drain_reason = reason
-        self._drain.set()
-        log.info("workload drain requested: %s", reason)
+    def _register_run(self, key: str, kind: str) -> dict:
+        """Open one run's drain/control lane under `key` (its dispatch
+        key: the queue entry's op id for dispatched runs, the run op's
+        own id otherwise). The record is the run's PRIVATE fault-isolation
+        surface — a sibling's drain or crash never touches it."""
+        rec = {"drain": threading.Event(), "reason": "", "kind": kind,
+               "control": []}
+        with self._runs_lock:
+            self._runs[key] = rec
+        return rec
+
+    def _unregister_run(self, key: str) -> None:
+        with self._runs_lock:
+            self._runs.pop(key, None)
+
+    def request_drain(self, reason: str = "drain requested",
+                      target: str = "") -> None:
+        """Ask a running loop to checkpoint and stop at its next
+        boundary. `target` names ONE run's dispatch key (the queue's
+        per-victim preemption path); empty target drains EVERY live run
+        — the preemption-notice/watchdog posture, where the chips under
+        all of them are about to vanish. Safe with nothing running."""
+        with self._runs_lock:
+            if target:
+                recs = ([self._runs[target]]
+                        if target in self._runs else [])
+            else:
+                recs = list(self._runs.values())
+        for rec in recs:
+            rec["reason"] = reason
+            rec["drain"].set()
+        log.info("workload drain requested (%s): %s",
+                 target[:8] if target else "all", reason)
+
+    def request_degrade(self, target: str, mesh) -> bool:
+        """Queue a ``("reshard", mesh)`` directive for ONE live serving
+        run (`target` = its dispatch key): at its next request boundary
+        the server re-compiles onto `mesh` (a built Mesh or a MeshSpec
+        over the survivors) and keeps answering at reduced throughput —
+        the degrade-not-die half of the slice-preemption contract.
+        Returns False when no such run is live (caller falls back to a
+        drain)."""
+        with self._runs_lock:
+            rec = self._runs.get(target)
+        if rec is None or rec["kind"] != "serve":
+            return False
+        rec["control"].append(("reshard", mesh))
+        log.info("workload degrade requested (%s): reshard onto %s",
+                 target[:8], mesh)
+        return True
 
     def has_running(self) -> bool:
-        """A workload-train journal op is currently Running — the
+        """A workload-train/-serve journal op is currently Running — the
         journal-row truth the notice handler consults (not thread state:
         journal rows survive whatever the threads do)."""
         from kubeoperator_tpu.models import OperationStatus
 
-        return bool(self.repos.operations.find(
-            kind=WORKLOAD_TRAIN_KIND,
-            status=OperationStatus.RUNNING.value))
+        return bool(
+            self.repos.operations.find(
+                kind=WORKLOAD_TRAIN_KIND,
+                status=OperationStatus.RUNNING.value)
+            or self.repos.operations.find(
+                kind=WORKLOAD_SERVE_KIND,
+                status=OperationStatus.RUNNING.value))
 
-    def _on_step(self, completed: int, loss) -> bool:
+    def _on_step(self, rec: dict, completed: int, loss) -> bool:
         hook = self.step_hook
         if hook is not None:
             hook(completed, loss)
-        return self._drain.is_set()
+        return rec["drain"].is_set()
 
     def resume_from(self, checkpoint: str = "", tenant: str = "",
                     wait: bool = True):
@@ -215,9 +272,10 @@ class WorkloadService:
                 log.warning("background workload resume (checkpoint %r) "
                             "failed: %s", checkpoint, e)
 
-        t = threading.Thread(
-            target=run, daemon=True,
-            name=f"workload-resume-{checkpoint or 'latest'}")
+        from kubeoperator_tpu.utils.threads import spawn
+
+        t = spawn(f"workload-resume-{checkpoint or 'latest'}", run,
+                  start=False)
         self._threads.append(t)
         t.start()
         return None
@@ -358,7 +416,10 @@ class WorkloadService:
 
         bind_trace(trace_id=op.trace_id or None, op_id=op.id,
                    workload_op=op.id, tenant=tenant or None)
-        self._drain.clear()
+        # the run's private drain lane: keyed by the dispatch key so the
+        # queue's targeted per-victim drains land on exactly this run
+        run_key = parent_op_id or op.id
+        rec = self._register_run(run_key, "train")
         try:
             mesh_obj = spec.build(devices[: spec.total_devices])
             state = None
@@ -423,7 +484,7 @@ class WorkloadService:
             def on_step(completed: int, loss) -> bool:
                 if sampler is not None:
                     sampler(completed, loss)
-                return self._on_step(completed, loss)
+                return self._on_step(rec, completed, loss)
 
             run = run_training(mesh_obj, steps=steps, mode=mode, seed=seed,
                                state=state, on_step=on_step,
@@ -456,14 +517,14 @@ class WorkloadService:
                 run["resumed_from"] = ckpt_row.id
             if drained:
                 run["drained"] = True
-                run["drain_reason"] = self._drain_reason
+                run["drain_reason"] = rec["reason"]
             op.vars["result"] = run
             self.journal.save_vars(op)
             if drained:
                 message = (
                     f"drained at step {run['end_step']}"
                     + (f"/{target_steps}" if target_steps else "")
-                    + f" ({self._drain_reason}); "
+                    + f" ({rec['reason']}); "
                     + (f"checkpoint {run['checkpoint']['id'][:8]} saved — "
                        f"resume with `koctl workload train --resume`"
                        if run.get("checkpoint") else
@@ -495,8 +556,164 @@ class WorkloadService:
             raise KoError(
                 f"workload train failed ({type(e).__name__}): {e}") from e
         finally:
-            self._drain.clear()
-            self._drain_reason = ""
+            self._unregister_run(run_key)
+        return self.describe(self.repos.operations.get(op.id))
+
+    def serve(self, mesh: str = "", requests: int | None = None,
+              mode: str = "", checkpoint: str = "",
+              slo_ms: float | None = None, tenant: str = "",
+              trace: dict | None = None, parent_op_id: str = "") -> dict:
+        """One serving session as a journaled operation (docs/
+        workloads.md "Serving"): restore the named (or the tenant's
+        latest) COMPLETE checkpoint — the checkpoint index is already a
+        content-hashed, per-tenant model registry — hold the compiled
+        forward fn resident through the serve compile seam, and answer
+        `requests` batched requests, emitting one `request` metric
+        sample per answer so `workload watch` shows the SLO live.
+
+        A targeted `request_drain` stops the server at the next request
+        boundary (the queue re-queues it exactly like a drained training
+        victim — restore is the resume). A `request_degrade` directive
+        re-shards it onto the surviving mesh WITHOUT stopping: reduced
+        throughput, same answers — the slice-preemption contract."""
+        import jax
+
+        from kubeoperator_tpu.parallel.mesh import MeshSpec
+        from kubeoperator_tpu.workloads.checkpoint import restore_checkpoint
+        from kubeoperator_tpu.workloads.serve import run_serving
+        from kubeoperator_tpu.workloads.step import (
+            WORKLOAD_AXES,
+            train_state_shapes,
+        )
+
+        mode = str(mode or self.default_mode)
+        if mode not in ("auto", "pjit", "shard_map"):
+            raise ValidationError(
+                f"workload mode {mode!r} not in (auto, pjit, shard_map)")
+        requests = (int(requests) if requests is not None
+                    else self.serve_requests)
+        if requests < 1:
+            raise ValidationError("workload serve needs requests >= 1")
+        slo = float(slo_ms) if slo_ms is not None else self.serve_slo_ms
+        # serving starts FROM a model: no checkpoint, no server
+        ckpt_row = self._resolve_checkpoint(checkpoint, tenant=tenant)
+
+        devices = list(jax.devices())
+        mesh_text = str(mesh or self.default_mesh)
+        if not mesh_text and ckpt_row.mesh:
+            mesh_text = ",".join(f"{a}={n}"
+                                 for a, n in ckpt_row.mesh.items())
+        if mesh_text:
+            spec = MeshSpec.parse(mesh_text, axis_names=WORKLOAD_AXES,
+                                  n_devices=len(devices))
+            missing = tuple((a, 1) for a in WORKLOAD_AXES
+                            if a not in spec.axis_names)
+            if missing:
+                spec = MeshSpec(axes=spec.axes + missing)
+        else:
+            spec = MeshSpec(axes=(("data", len(devices)), ("fsdp", 1),
+                                  ("tp", 1)))
+        if spec.total_devices > len(devices):
+            raise ValidationError(
+                f"mesh {spec} needs {spec.total_devices} devices, "
+                f"{len(devices)} visible")
+
+        op_vars = {"mesh": spec.describe(), "requests": requests,
+                   "mode": mode, "slo_ms": slo,
+                   "checkpoint_source": ckpt_row.id}
+        if tenant:
+            op_vars["tenant"] = tenant
+        op = self.journal.open_scoped(
+            WORKLOAD_SERVE_KIND, vars=op_vars,
+            message=(f"serve checkpoint {ckpt_row.id[:8]} "
+                     f"(step {ckpt_row.step}) on mesh {spec} "
+                     f"({requests} request(s))"),
+            scope="workload", trace=trace, parent_op_id=parent_op_id)
+        log.info("workload serve op %s: checkpoint %s, mesh %s, "
+                 "%d requests, slo %.1fms",
+                 op.id, ckpt_row.id[:8], spec, requests, slo)
+        from kubeoperator_tpu.observability import bind_trace
+
+        bind_trace(trace_id=op.trace_id or None, op_id=op.id,
+                   workload_op=op.id, tenant=tenant or None)
+        run_key = parent_op_id or op.id
+        rec = self._register_run(run_key, "serve")
+        try:
+            mesh_obj = spec.build(devices[: spec.total_devices])
+            t_restore = time.time()
+            state, manifest = restore_checkpoint(
+                ckpt_row.dir, train_state_shapes())
+            self._record_windows(op, [{
+                "name": "checkpoint-restore", "start": t_restore,
+                "end": time.time(),
+                "attrs": {"checkpoint": ckpt_row.id,
+                          "step": ckpt_row.step,
+                          "bytes": manifest.get("total_bytes", 0)},
+            }])
+
+            sampling = (self.journal.events_enabled
+                        and self.journal.tracer_for(op).enabled)
+
+            def on_request(served: int, latency_s: float):
+                if sampling:
+                    from kubeoperator_tpu.models import MetricSample
+
+                    self.journal.record_samples(op, [MetricSample(
+                        op_id=op.id, step=int(served), kind="request",
+                        tenant=tenant, step_s=round(float(latency_s), 6),
+                        steps_per_s=(round(1.0 / latency_s, 3)
+                                     if latency_s > 0 else 0.0),
+                        attrs=({"slo_ms": slo} if slo else {}),
+                    )])
+                hook = self.request_hook
+                directive = (hook(served, latency_s)
+                             if hook is not None else None)
+                # drain beats degrade: a stop directive is the queue
+                # taking the whole gang back, not a layout change
+                if rec["drain"].is_set():
+                    return ("stop", rec["reason"])
+                if directive:
+                    return directive
+                if rec["control"]:
+                    return rec["control"].pop(0)
+                return None
+
+            run = run_serving(
+                mesh_obj, params=state["params"], requests=requests,
+                mode=mode, slo_ms=slo, on_request=on_request,
+                seed=int(manifest.get("seed", 0)))
+            self._record_windows(op, run.pop("windows", []))
+            run["checkpoint_restored"] = ckpt_row.id
+            op.vars["result"] = run
+            self.journal.save_vars(op)
+            if run.get("drained"):
+                self.journal.close(
+                    op, ok=bool(run["finite"]),
+                    message=(f"drained after {run['served']}/{requests} "
+                             f"request(s) ({run['drain_reason']}); "
+                             f"re-dispatch restores checkpoint "
+                             f"{ckpt_row.id[:8]}"))
+            else:
+                self.journal.close(
+                    op, ok=bool(run["ok"]),
+                    message=(f"served {run['served']} request(s) at "
+                             f"{run['requests_per_s']} req/s "
+                             f"(p95 {run['latency_p95_ms']}ms"
+                             + (", degraded mesh" if run["degraded"]
+                                else "")
+                             + ")")
+                    if run["ok"] else
+                    f"serving unhealthy: finite={run['finite']}")
+        except KoError as e:
+            self.journal.close(op, ok=False, message=e.message)
+            raise
+        except Exception as e:
+            self.journal.close(op, ok=False,
+                               message=f"{type(e).__name__}: {e}")
+            raise KoError(
+                f"workload serve failed ({type(e).__name__}): {e}") from e
+        finally:
+            self._unregister_run(run_key)
         return self.describe(self.repos.operations.get(op.id))
 
     def sweep(self, steps: int | None = None, tenant: str = "",
@@ -738,13 +955,16 @@ class WorkloadService:
 
     # ---- queries ----
     def resolve(self, op_ref: str = "") -> Operation:
-        """A workload op — train or sweep — by exact id, unique id
-        prefix, or — with no ref — the newest one (the shared journal
-        resolution contract)."""
+        """A workload op — train, serve, or sweep — by exact id, unique
+        id prefix, or — with no ref — the newest one (the shared journal
+        resolution contract). Serve ops resolve here so `workload
+        status|trace` work on them (the PR-12 sweep lesson)."""
         from kubeoperator_tpu.resilience.journal import resolve_op_ref
 
         return resolve_op_ref(
-            self.repos, (WORKLOAD_TRAIN_KIND, WORKLOAD_SWEEP_KIND),
+            self.repos,
+            (WORKLOAD_TRAIN_KIND, WORKLOAD_SERVE_KIND,
+             WORKLOAD_SWEEP_KIND),
             op_ref, label="workload operation")
 
     def describe(self, op: Operation) -> dict:
@@ -775,6 +995,7 @@ class WorkloadService:
 
     def list_ops(self) -> list[dict]:
         ops = (self.repos.operations.find(kind=WORKLOAD_TRAIN_KIND)
+               + self.repos.operations.find(kind=WORKLOAD_SERVE_KIND)
                + self.repos.operations.find(kind=WORKLOAD_SWEEP_KIND))
         ops.sort(key=lambda o: (o.created_at, o.id))
         return [self.describe(op) for op in reversed(ops)]

@@ -267,6 +267,23 @@ DEFAULTS: dict[str, Any] = {
         # submission time, so FIFO-within-class is otherwise unchanged.
         # 0 = off. Sweeps never age — the scavenger contract holds.
         "aging_after_s": 0,
+        # dispatch lanes: how many placed gangs run PHYSICALLY
+        # concurrently (adm/pool.py BoundedPool; each lane is one run
+        # with its own targeted drain). 1 = the serial cooperative loop,
+        # bit-for-bit. Placement capacity is still the slice pool — this
+        # bounds simultaneous execution, not admission.
+        "max_concurrent": 1,
+    },
+    "serve": {
+        # serving workload defaults (service/workload.py serve,
+        # docs/workloads.md "Serving"); `koctl workload submit
+        # --kind serve` flags override per-entry.
+        # batched requests a server answers before closing its session
+        "requests": 8,
+        # per-request latency SLO in milliseconds the tier promises,
+        # judged on post-warmup p95 (0 = no SLO — the record still
+        # carries the percentiles)
+        "slo_ms": 0,
     },
     "checkpoint": {
         # durable-training checkpoints (workloads/checkpoint.py,

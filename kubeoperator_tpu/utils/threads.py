@@ -1,0 +1,29 @@
+"""Sanctioned thread creation — the one funnel service-layer code may
+spawn a raw thread through (analyzer rule KO-P014 `thread-discipline`,
+docs/analysis.md).
+
+Concurrency in this codebase rides the shared `adm/pool.py BoundedPool`
+(deterministic launch order, fatal-BaseException crash semantics). The
+few legitimate NON-pool threads — engine threads that themselves host a
+pool, the cron loop, fire-and-forget resume dispatches — funnel through
+`spawn()` so every one is named, daemonized, and greppable. A bare
+`threading.Thread(...)` anywhere under service/ is a KO-P014 finding:
+either the work belongs on a pool, or it belongs here.
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+def spawn(name: str, target, *, daemon: bool = True,
+          start: bool = True) -> threading.Thread:
+    """Create (and by default start) a named daemon thread.
+
+    `start=False` callers register the thread in their own tracking
+    structures under a lock BEFORE it runs (the cluster/fleet journaled-
+    op pattern); everyone else gets a running thread back."""
+    thread = threading.Thread(target=target, daemon=daemon, name=name)
+    if start:
+        thread.start()
+    return thread

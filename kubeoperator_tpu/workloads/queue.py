@@ -132,18 +132,20 @@ def choose_victims(entries, needed: int, free: int, priority: int) -> list:
     """The preemption decision: the cheapest set of strictly-lower-
     priority capacity holders whose eviction (plus the already-free
     slices) lets a `needed`-slice gang of rank `priority` fit. Victim
-    order is lowest priority class first, YOUNGEST submission first
-    within a class — the longest-running workload of a class is evicted
-    last. Returns [] when no legal victim set exists (the arrival waits
-    like anyone else).
+    order is lowest priority class first, TRAINING before SERVING within
+    a class (a drained training resumes from its checkpoint; a drained
+    server breaks its latency promise — the latency class is always the
+    last evicted), YOUNGEST submission first within a kind — the
+    longest-running workload of a class is evicted last. Returns [] when
+    no legal victim set exists (the arrival waits like anyone else).
 
     `entries` are the active (placed/running) QueueEntry snapshots; only
-    their priority/created_at/placement sizes are consulted."""
+    their priority/kind/created_at/placement sizes are consulted."""
     if needed <= free:
         return []
     candidates = sorted(
         (e for e in entries if e.priority < priority and e.placement),
-        key=lambda e: (e.priority, -e.created_at),
+        key=lambda e: (e.priority, e.kind == "serve", -e.created_at),
     )
     victims, reclaim = [], free
     for entry in candidates:

@@ -1,0 +1,264 @@
+"""Serving — the platform's second workload verb (docs/workloads.md
+"Serving"): restore a trained model, hold the compiled forward fn
+RESIDENT, and answer batched requests under a latency SLO.
+
+The seam discipline mirrors training exactly. `compile_forward` is
+`compile_step`'s forward-only twin — ONE compile seam, pjit when the
+partition rules produced explicit shardings, shard_map fallback
+otherwise — over the same `_forward` dense stage and the same partition
+rules (the tensors are the same tensors; serving changes what we do
+with them, not how they are laid out). `run_serving` is the harness:
+a deterministic seeded request stream, per-request latency samples, and
+an `on_request` hook that is the serving twin of training's `on_step`
+boundary — the drain protocol, the chaos drill's scripting, and the
+DEGRADE path all ride it.
+
+Degradation is the point (ISSUE 18): when a slice is preempted under a
+live server, the queue does not drop the entry — it hands the hook a
+``("reshard", degraded_mesh_spec_survivors)`` directive, the loop
+re-compiles the forward fn onto the surviving mesh and re-places the
+host params, and the server keeps answering at reduced throughput (the
+global batch shrinks with the mesh — weak scaling in reverse). A
+``("stop", reason)`` directive is the cooperative drain: the server
+stops at the next request boundary and the entry re-queues; restore is
+cheap because serving state is just the checkpoint.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from kubeoperator_tpu.parallel.validation_net import NetConfig
+from kubeoperator_tpu.workloads.partition import (
+    PartitionError,
+    make_shard_and_gather_fns,
+    match_partition_rules,
+)
+from kubeoperator_tpu.workloads.step import (
+    DATA_AXES,
+    WORKLOAD_AXES,
+    _forward,
+    build_batch,
+    build_host_params,
+    default_rules,
+    param_shapes,
+)
+
+
+def serve_rules():
+    """Partition rules for the forward-only param tree — the training
+    rules verbatim (same tensors, same layout); named separately so a
+    serving-specific layout can diverge without touching training."""
+    return default_rules()
+
+
+def compile_forward(mesh, cfg: NetConfig | None = None, specs=None,
+                    mode: str = "auto"):
+    """THE serve-side compile seam, `compile_step`'s forward-only twin:
+    returns ``(forward_fn, used)`` where ``forward_fn(params, x) -> y``
+    and ``used`` is the path actually compiled. ``specs`` is the
+    PARAMS-ONLY spec tree (serving carries no optimizer state); ``mode``
+    is ``auto`` (pjit when explicit shardings exist, else shard_map), or
+    a forced ``pjit`` / ``shard_map``."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from kubeoperator_tpu.parallel.mesh import shard_map_compat
+
+    cfg = cfg or NetConfig()
+    for axis in WORKLOAD_AXES:
+        if axis not in mesh.shape:
+            raise PartitionError(
+                f"serving mesh must carry the {WORKLOAD_AXES} axes, "
+                f"got {tuple(mesh.axis_names)}")
+    if mode == "auto":
+        mode = "pjit" if specs is not None else "shard_map"
+
+    if mode == "pjit":
+        if specs is None:
+            raise PartitionError(
+                "compile mode 'pjit' needs explicit shardings — run the "
+                "partition rules first, or use mode 'shard_map'")
+
+        def global_forward(p, xb):
+            return _forward(p, xb, cfg)
+
+        p_sh = jax.tree_util.tree_map(
+            lambda s: NamedSharding(mesh, s), specs)
+        x_sh = NamedSharding(mesh, P(DATA_AXES, None, None))
+        y_sh = NamedSharding(mesh, P(DATA_AXES, None, None))
+        return jax.jit(
+            global_forward,
+            in_shardings=(p_sh, x_sh),
+            out_shardings=y_sh,
+        ), "pjit"
+
+    if mode != "shard_map":
+        raise PartitionError(
+            f"unknown compile mode {mode!r} (auto|pjit|shard_map)")
+
+    def local_forward(p, xb):
+        # params replicated, xb is this device's (data, fsdp) batch
+        # shard; forward is per-example, so no collective is needed —
+        # the output stays sharded like the input
+        return _forward(p, xb, cfg)
+
+    fn = shard_map_compat(
+        local_forward, mesh,
+        in_specs=(P(), P(DATA_AXES, None, None)),
+        out_specs=P(DATA_AXES, None, None),
+    )
+    return jax.jit(fn), "shard_map"
+
+
+def make_forward(mesh, cfg: NetConfig | None = None, rules=None,
+                 mode: str = "auto"):
+    """Rules → param specs → compiled forward, in one call: returns
+    ``(forward_fn, specs_or_None, used_mode)`` — `make_train_step`'s
+    serving twin. `specs` is None exactly when shard_map compiled."""
+    cfg = cfg or NetConfig()
+    if mode == "shard_map":
+        specs = None
+    else:
+        specs = match_partition_rules(
+            rules if rules is not None else serve_rules(),
+            param_shapes(cfg))
+    fn, used = compile_forward(mesh, cfg, specs=specs, mode=mode)
+    if used == "shard_map":
+        specs = None
+    return fn, specs, used
+
+
+def run_serving(mesh, cfg: NetConfig | None = None, params=None,
+                requests: int = 8, mode: str = "auto", rules=None,
+                seed: int = 0, slo_ms: float = 0.0, on_request=None):
+    """Serve `requests` deterministic seeded batches on `mesh` and
+    return the session record. `params` is a HOST param tree (a restored
+    checkpoint's ``state["params"]``); absent, a seeded fresh tree
+    stands in (tests). After every answered request,
+    ``on_request(served, latency_s)`` may return a directive:
+
+      * falsy              — keep serving;
+      * ``("stop", why)``  — cooperative drain: stop NOW, record
+        ``drained``/``drain_reason`` so the queue's drain protocol
+        handles a server exactly like a training victim;
+      * ``("reshard", m)`` — degrade: re-compile onto mesh (or MeshSpec)
+        ``m``, re-place the params, keep serving at the smaller mesh's
+        throughput. The record notes ``degraded``.
+
+    Request latencies are measured to answer-on-host (the device_get is
+    the response). The first request compiles; the steady-state rate and
+    the SLO verdict exclude it — a server's SLO is a post-warmup
+    promise. ``outputs`` carries one deterministic digest per answered
+    request: the drill's bit-for-bit evidence that a degraded server
+    still computes the same function."""
+    import jax
+    import jax.numpy as jnp
+
+    cfg = cfg or NetConfig()
+    requests = max(int(requests), 1)
+    params_host = params if params is not None \
+        else build_host_params(cfg, seed)
+    windows: list[dict] = []
+
+    def place(target_mesh, degraded: bool):
+        t0 = time.time()
+        fn, specs, used = make_forward(target_mesh, cfg, rules=rules,
+                                       mode=mode)
+        if specs is None:
+            from jax.sharding import PartitionSpec as P
+
+            specs = jax.tree_util.tree_map(lambda _: P(), params_host)
+        shard_fn, _ = make_shard_and_gather_fns(target_mesh, specs)
+        placed = shard_fn(params_host)
+        windows.append({
+            "name": "serve-compile", "start": t0, "end": time.time(),
+            "attrs": {"mode": used,
+                      "devices": int(target_mesh.devices.size),
+                      "degraded": degraded},
+        })
+        return fn, placed, used
+
+    forward, params_dev, used = place(mesh, degraded=False)
+    served = 0
+    degraded = False
+    drained = False
+    drain_reason = ""
+    latencies_s: list[float] = []
+    outputs: list[float] = []
+    t_session = time.time()
+    wall0 = time.perf_counter()
+    for i in range(requests):
+        x = build_batch(mesh, cfg, seed=seed + 1000 + i)
+        t0 = time.perf_counter()
+        y = forward(params_dev, x)
+        # the digest IS the response read: normalized so it compares
+        # across mesh sizes only in finiteness, and bit-for-bit across
+        # identical passes of the drill
+        digest = float(jax.device_get(
+            jnp.sum(y.astype(jnp.float32) ** 2)) / y.size)
+        latency = time.perf_counter() - t0
+        served += 1
+        latencies_s.append(latency)
+        outputs.append(digest)
+        directive = on_request(served, latency) if on_request else None
+        if not directive:
+            continue
+        verb = directive[0] if isinstance(directive, tuple) else directive
+        if verb == "stop":
+            drained = True
+            drain_reason = (directive[1]
+                            if isinstance(directive, tuple)
+                            and len(directive) > 1 else "")
+            break
+        if verb == "reshard":
+            new_mesh = directive[1]
+            if hasattr(new_mesh, "build"):   # a MeshSpec over survivors
+                pool = list(np.asarray(mesh.devices).reshape(-1))
+                new_mesh = new_mesh.build(
+                    pool[: new_mesh.total_devices])
+            mesh = new_mesh
+            forward, params_dev, used = place(mesh, degraded=True)
+            degraded = True
+    elapsed = time.perf_counter() - wall0
+    windows.append({
+        "name": "serving", "start": t_session, "end": time.time(),
+        "attrs": {"served": served, "requests": requests,
+                  "degraded": degraded},
+    })
+
+    finite = bool(np.isfinite(outputs).all()) if outputs else False
+    lat_ms = [round(l * 1000.0, 3) for l in latencies_s]
+    steady = latencies_s[1:] if len(latencies_s) > 1 else latencies_s
+    steady_p95 = (round(float(np.percentile(steady, 95)) * 1000.0, 3)
+                  if steady else 0.0)
+    record = {
+        "ok": finite and served > 0,
+        "finite": finite,
+        "served": served,
+        "requests": requests,
+        "mode": used,
+        "devices": int(mesh.devices.size),
+        "mesh": {str(a): int(mesh.shape[a]) for a in mesh.axis_names},
+        "degraded": degraded,
+        "requests_per_s": (round(served / elapsed, 3)
+                           if elapsed > 0 else 0.0),
+        "steady_requests_per_s": (round(len(steady) / sum(steady), 3)
+                                  if steady and sum(steady) > 0 else 0.0),
+        "latency_p50_ms": (round(float(np.percentile(latencies_s, 50))
+                                 * 1000.0, 3) if latencies_s else 0.0),
+        "latency_p95_ms": steady_p95,
+        "slo_ms": float(slo_ms),
+        "slo_met": (steady_p95 <= float(slo_ms)
+                    if slo_ms and steady else True),
+        "outputs": outputs,
+        "windows": windows,
+        # the drain protocol's shared vocabulary (service/queue.py
+        # _handle_drained reads these off every run kind identically)
+        "drained": drained,
+        "drain_reason": drain_reason,
+        "end_step": served,
+    }
+    return record

@@ -498,16 +498,27 @@ def write_artifacts(results: dict, round_no: int,
             "and the priority-preemption round trip (eviction ->",
             "checkpoint+drain -> preemptor runs -> victim resumed to "
             "completion) on the tier-1 8-device CPU mesh.",
+            "The concurrency columns pin ISSUE 18's tentpole: 8 "
+            "identical paced gangs dispatched serially vs on the",
+            "4-lane BoundedPool engine (sleep-paced run bodies, so the "
+            "speedup isolates the dispatch engine itself), plus",
+            "the steady served-requests/s of a real serving session "
+            "(compile request excluded).",
             "",
             "| entries | submit/s | dispatch/s | mean wait (s) | "
-            "preempt round-trip (s) | ok |",
-            "|---|---|---|---|---|---|",
+            "preempt round-trip (s) | serial wall (s) | "
+            "pool-4 wall (s) | concurrent speedup | served req/s | ok |",
+            "|---|---|---|---|---|---|---|---|---|---|",
         ]
         for row in queue_rounds[q_round].get("rows", []):
             lines.append(
                 f"| {row['entries']} | {row['submit_per_s']} | "
                 f"{row['dispatch_per_s']} | {row['mean_wait_s']} | "
                 f"{row['preempt_round_trip_s']} | "
+                f"{row.get('serial_wall_s', '-')} | "
+                f"{row.get('pool_wall_s', '-')} | "
+                f"{row.get('concurrent_speedup_x', '-')}x | "
+                f"{row.get('served_req_per_s', '-')} | "
                 f"{'yes' if row['ok'] else 'NO'} |")
     # live-telemetry rows (`perf_matrix.py --events`,
     # docs/observability.md "Events and live telemetry"): rendered from
@@ -885,10 +896,26 @@ def run_queue() -> dict:
             led = victim["preemptions"]
             round_trip = (round(victim["finished_at"] - led[0]["at"], 4)
                           if led and victim["finished_at"] else None)
+            # phase 3 — a real serving session (ISSUE 18): restore a
+            # phase-1 tenant's checkpoint and answer 8 requests; the
+            # steady rate excludes the compile request (a server's SLO
+            # is a post-warmup promise)
+            queue.submit(kind="serve", tenant="perf0", requests=8,
+                         wait=True)
+            server = next(e for e in queue.entries()
+                          if e["kind"] == "serve")
+            serve_result = (svc.repos.operations
+                            .get(server["run_ops"][0]).vars
+                            .get("result") or {}) if server["run_ops"] \
+                else {}
+            served_per_s = serve_result.get("steady_requests_per_s", 0.0)
             ok = (all(s == "done" for s in states)
-                  and victim["state"] == "done" and bool(led))
+                  and victim["state"] == "done" and bool(led)
+                  and server["state"] == "done"
+                  and serve_result.get("served") == 8)
         finally:
             svc.close()
+    serial_wall, pool_wall, pool_n = _paced_dispatch_walls()
     row = {
         "entries": entries_n,
         "submit_per_s": round(entries_n / submit_s, 1)
@@ -898,9 +925,75 @@ def run_queue() -> dict:
         "mean_wait_s": round(sum(waits) / len(waits), 4)
         if waits else 0.0,
         "preempt_round_trip_s": round_trip,
+        "serial_wall_s": serial_wall,
+        "pool_wall_s": pool_wall,
+        "concurrent_speedup_x": (round(serial_wall / pool_wall, 2)
+                                 if pool_wall else 0.0),
+        "pool_lanes": pool_n,
+        "served_req_per_s": served_per_s,
         "ok": ok,
     }
     return {"ok": ok, "rows": [row]}
+
+
+def _paced_dispatch_walls(pool_n: int = 4, lanes: int = 8,
+                          pace_s: float = 0.25) -> tuple:
+    """Serial vs pool-`pool_n` dispatch wall time for `lanes` identical
+    paced gangs over a 4-slice virtual pool (ISSUE 18's concurrency
+    pin). The run body is a sleep-paced stub — the measurement isolates
+    the DISPATCH ENGINE (BoundedPool lanes, scheduling passes, ledger
+    and journal folds), not XLA step time, exactly like the fleet wave
+    benchmark's paced tasks. Returns (serial_wall_s, pool_wall_s,
+    pool_n)."""
+    import itertools
+    import tempfile
+    import time as _time
+
+    from kubeoperator_tpu.service import build_services
+    from kubeoperator_tpu.utils.config import load_config
+
+    with tempfile.TemporaryDirectory(prefix="ko-queue-pace-") as base:
+        config = load_config(path="/nonexistent", env={}, overrides={
+            "db": {"path": os.path.join(base, "q.db")},
+            "logging": {"level": "ERROR"},
+            "executor": {"backend": "simulation"},
+            "provisioner": {"work_dir": os.path.join(base, "tf")},
+            "cron": {"backup_enabled": False, "event_sync_interval_s": 0},
+            "cluster": {"kubeconfig_dir": os.path.join(base, "kc")},
+            "queue": {"slices": 4, "chips_per_slice": 4,
+                      "max_concurrent": 1},
+        })
+        svc = build_services(config, simulate=True)
+        try:
+            queue = svc.workload_queue
+            seq = itertools.count()
+
+            def paced_train(**_kw):
+                _time.sleep(pace_s)
+                return {"id": f"paced-{next(seq)}",
+                        "status": "Succeeded", "message": "paced",
+                        "result": {"ok": True}}
+
+            svc.workloads.train = paced_train
+
+            def timed_batch(max_concurrent: int, tag: str) -> float:
+                queue.max_concurrent = max_concurrent
+                with queue._lock:
+                    queue._engine_active = True
+                for i in range(lanes):
+                    queue.submit(mesh="data=1,fsdp=4", steps=2,
+                                 tenant=f"{tag}{i}", wait=True)
+                with queue._lock:
+                    queue._engine_active = False
+                t0 = _time.perf_counter()
+                queue.process()
+                return _time.perf_counter() - t0
+
+            serial_wall = timed_batch(1, "serial")
+            pool_wall = timed_batch(pool_n, "pool")
+        finally:
+            svc.close()
+    return round(serial_wall, 4), round(pool_wall, 4), pool_n
 
 
 def record_queue(report: dict, round_no: int | None = None) -> int:

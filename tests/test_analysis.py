@@ -1339,6 +1339,69 @@ class TestEventKindDiscipline:  # KO-P013
         assert findings == [], [f"{f.file}:{f.line}" for f in findings]
 
 
+class TestThreadDiscipline:  # KO-P014
+    def test_fires_on_bare_thread_in_service(self, tmp_path):
+        src = (
+            "import threading\n"
+            "def kick(self):\n"
+            "    t = threading.Thread(target=self._run, daemon=True)\n"
+            "    t.start()\n"
+        )
+        findings = ast_findings(tmp_path, src, "KO-P014",
+                                rel="service/x.py")
+        assert [f.rule for f in findings] == ["KO-P014"]
+        assert "utils/threads.spawn" in findings[0].message
+
+    def test_fires_on_bare_imported_name(self, tmp_path):
+        src = (
+            "from threading import Thread\n"
+            "def kick(self):\n"
+            "    Thread(target=self._run).start()\n"
+        )
+        findings = ast_findings(tmp_path, src, "KO-P014",
+                                rel="service/y.py")
+        assert [f.rule for f in findings] == ["KO-P014"]
+
+    def test_quiet_outside_service_and_through_spawn(self, tmp_path):
+        # the executor/pool layers OWN raw threads — out of scope
+        raw = (
+            "import threading\n"
+            "def launch(self):\n"
+            "    threading.Thread(target=self._run).start()\n"
+        )
+        assert ast_findings(tmp_path, raw, "KO-P014",
+                            rel="executor/base.py") == []
+        # service code routing through the funnel is the sanctioned form
+        funnel = (
+            "from kubeoperator_tpu.utils.threads import spawn\n"
+            "def kick(self):\n"
+            "    self._t = spawn('queue-engine', self._run)\n"
+            # non-Thread threading uses stay quiet
+            "lock = __import__('threading').Lock\n"
+        )
+        assert ast_findings(tmp_path, funnel, "KO-P014",
+                            rel="service/x.py") == []
+
+    def test_waiver_comment_suppresses(self, tmp_path):
+        src = (
+            "import threading\n"
+            "def kick(self):\n"
+            "    # KO-P014: waived — interop with a legacy harness\n"
+            "    threading.Thread(target=self._run).start()\n"
+        )
+        assert ast_findings(tmp_path, src, "KO-P014",
+                            rel="service/x.py") == []
+
+    def test_real_service_layer_is_clean(self):
+        """The shipped service/ package satisfies its own rule: every
+        thread rides the BoundedPool or the spawn funnel."""
+        import kubeoperator_tpu
+
+        root = os.path.dirname(kubeoperator_tpu.__file__)
+        findings, _scanned = run_ast_rules(root, {"KO-P014"})
+        assert findings == [], [f"{f.file}:{f.line}" for f in findings]
+
+
 # ------------------------------------------------------- contract rules ----
 def index_for(tmp_path, files: dict):
     """Build a ProjectIndex over a fixture tree (the injection path the

@@ -230,7 +230,8 @@ class TestConcurrentCrashAndResume:
         reconciler resumes WITHOUT re-running completed DAG nodes."""
         from kubeoperator_tpu.resilience import ControllerDeath
 
-        svc = stack(tmp_path, chaos={"die_at_phase": "05-etcd.yml"})
+        svc = stack(tmp_path, chaos={"die_at_phase": "05-etcd.yml"},
+                    scheduler={"max_concurrent_phases": 4})
         try:
             assert svc.clusters.adm.scheduler.max_concurrent_phases > 1
             seed_tpu_plan(svc)
@@ -247,7 +248,8 @@ class TestConcurrentCrashAndResume:
         finally:
             svc.close()
 
-        svc2 = stack(tmp_path, reconcile={"auto_resume": True})
+        svc2 = stack(tmp_path, reconcile={"auto_resume": True},
+                     scheduler={"max_concurrent_phases": 4})
         try:
             cluster = svc2.clusters.wait_for("dagcrash", timeout_s=300)
             assert cluster.status.phase == "Ready"
@@ -272,7 +274,8 @@ class TestConcurrentCrashAndResume:
         left a phase span there — completed DAG nodes must not appear."""
         from kubeoperator_tpu.resilience import ControllerDeath
 
-        svc = stack(tmp_path, chaos={"die_at_phase": "09-network.yml"})
+        svc = stack(tmp_path, chaos={"die_at_phase": "09-network.yml"},
+                    scheduler={"max_concurrent_phases": 4})
         try:
             seed_tpu_plan(svc)
             with pytest.raises(ControllerDeath):
@@ -288,7 +291,8 @@ class TestConcurrentCrashAndResume:
         finally:
             svc.close()
 
-        svc2 = stack(tmp_path, reconcile={"auto_resume": True})
+        svc2 = stack(tmp_path, reconcile={"auto_resume": True},
+                     scheduler={"max_concurrent_phases": 4})
         try:
             cluster = svc2.clusters.wait_for("dagcrash2", timeout_s=300)
             assert cluster.status.phase == "Ready"

@@ -826,3 +826,360 @@ class TestPriorityAging:
             assert svc.workload_queue.status(entry["id"])["state"] == "done"
         finally:
             svc.close()
+
+
+# ------------------------------------------------- concurrent dispatch ------
+class TestConcurrentDispatch:
+    """ISSUE 18 tentpole: dispatch rides the shared BoundedPool — gangs
+    run PHYSICALLY concurrently, each lane's faults stay its own, and
+    the per-entry run ledger under the scheduler lock is exact."""
+
+    def test_two_gangs_physically_concurrent_with_exact_ledger(
+            self, tmp_path):
+        """Barrier proof: with two lanes, two 1-slice gangs must be
+        inside their run bodies AT THE SAME TIME (a serial engine
+        deadlocks the barrier), and while they are, the `_running`
+        ledger holds exactly both entries and the live scrape exports
+        the per-kind running gauge."""
+        import threading
+
+        from kubeoperator_tpu.api.metrics import MetricsRegistry
+
+        svc = queue_stack(tmp_path, queue={"max_concurrent": 2})
+        try:
+            q = svc.workload_queue
+            barrier = threading.Barrier(2, timeout=30)
+            ledgers: dict = {}
+            scrape: dict = {}
+
+            def fake_train(tenant="", **_kw):
+                barrier.wait()        # passes ONLY if both lanes are live
+                with q._lock:
+                    ledgers[tenant] = dict(q._running)
+                if not scrape:
+                    scrape["text"] = MetricsRegistry().render(svc)
+                barrier.wait()        # release together
+                return {"id": f"run-{tenant}", "status": "Succeeded",
+                        "message": "", "result": {"ok": True}}
+
+            svc.workloads.train = fake_train
+            a = q.submit(mesh="data=1,fsdp=4", steps=2, tenant="a",
+                         wait=False)
+            b = q.submit(mesh="data=1,fsdp=4", steps=2, tenant="b",
+                         wait=False)
+            q.wait_all()
+            rows = {e["tenant"]: e for e in q.entries()}
+            assert rows["a"]["state"] == "done", rows["a"]
+            assert rows["b"]["state"] == "done", rows["b"]
+            expected = {a["id"]: a["op_id"], b["id"]: b["op_id"]}
+            assert ledgers["a"] == expected
+            assert ledgers["b"] == expected
+            with q._lock:
+                assert q._running == {}   # every lane retired its row
+            assert ('ko_tpu_workload_queue_running'
+                    '{kind="train",priority="normal"} 2'
+                    in scrape.get("text", ""))
+        finally:
+            svc.close()
+
+    def test_two_concurrent_drains_each_keep_their_own_checkpoint(
+            self, tmp_path):
+        """Two victims draining concurrently must each checkpoint and
+        re-queue independently — separate ledger rows, separate
+        tenant-scoped checkpoints — and both resume to done when their
+        slices return."""
+        svc = queue_stack(tmp_path, queue={"max_concurrent": 2})
+        try:
+            q = svc.workload_queue
+            fired = {"done": False}
+
+            def hook(completed, _loss):
+                if completed < 2 or fired["done"]:
+                    return
+                rows = q.entries()
+                if not all(e["state"] == "running" for e in rows):
+                    return   # fire only once BOTH lanes are live
+                fired["done"] = True
+                for e in rows:
+                    for s in e["placement"]:
+                        q.preempt_slice(s)
+
+            svc.workloads.step_hook = hook
+            q.submit(mesh="data=1,fsdp=4", steps=6, tenant="left",
+                     wait=False)
+            q.submit(mesh="data=1,fsdp=4", steps=6, tenant="right",
+                     wait=False)
+            deadline = time.time() + 120
+            while time.time() < deadline:
+                rows = {e["tenant"]: e for e in q.entries()}
+                if all(rows[t]["state"] == "pending"
+                       and rows[t]["checkpoint"]
+                       for t in ("left", "right")):
+                    break
+                time.sleep(0.05)
+            svc.workloads.step_hook = None
+            for s in q.capacity()["lost"]:
+                q.restore_slice(s)
+            q.process(wait=True)
+            q.wait_all()
+            rows = {e["tenant"]: e for e in q.entries()}
+            ckpts = {}
+            for t in ("left", "right"):
+                entry = rows[t]
+                assert entry["state"] == "done", entry
+                assert len(entry["run_ops"]) == 2      # drained + resumed
+                led = entry["preemptions"]
+                assert len(led) == 1 and led[0]["kind"] == "drained"
+                assert led[0]["by"].startswith("slice:")
+                assert led[0]["checkpoint"]
+                row = svc.repos.checkpoints.get(led[0]["checkpoint"])
+                assert row.tenant == t                 # own namespace
+                assert os.sep + t + os.sep in row.dir
+                ckpts[t] = led[0]["checkpoint"]
+            assert ckpts["left"] != ckpts["right"]
+        finally:
+            svc.workloads.step_hook = None
+            svc.close()
+
+    @pytest.mark.filterwarnings(
+        "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+    def test_controller_death_on_one_lane_leaves_sibling_settling(
+            self, tmp_path):
+        """Fault isolation: ControllerDeath (a BaseException) on one
+        lane is a crash strand — the sibling lane settles to done, the
+        dead lane's entry stays `running` with a Running op and no
+        ledger row — and boot recovery re-queues exactly that lane and
+        runs it to done."""
+        import threading
+
+        from kubeoperator_tpu.resilience.chaos import ControllerDeath
+
+        svc = queue_stack(tmp_path, queue={"max_concurrent": 2})
+        try:
+            q = svc.workload_queue
+            both_live = threading.Barrier(2, timeout=30)
+
+            def fake_train(tenant="", **_kw):
+                both_live.wait()
+                if tenant == "doomed":
+                    raise ControllerDeath("lane crash")
+                time.sleep(0.3)   # settles AFTER the sibling crashed
+                return {"id": "run-steady", "status": "Succeeded",
+                        "message": "", "result": {"ok": True}}
+
+            svc.workloads.train = fake_train
+            doomed = q.submit(mesh="data=1,fsdp=4", steps=2,
+                              tenant="doomed", wait=False)
+            q.submit(mesh="data=1,fsdp=4", steps=2, tenant="steady",
+                     wait=False)
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                rows = {e["tenant"]: e for e in q.entries()}
+                if rows["steady"]["state"] == "done":
+                    break
+                time.sleep(0.05)
+            q.wait_all()
+            rows = {e["tenant"]: e for e in q.entries()}
+            assert rows["steady"]["state"] == "done", rows["steady"]
+            assert rows["doomed"]["state"] == "running"   # the strand
+            assert rows["doomed"]["run_ops"] == []
+            assert svc.repos.operations.get(doomed["op_id"]).status \
+                == "Running"
+            with q._lock:
+                assert q._running == {}   # the finally popped the lane
+        finally:
+            svc.close()
+        svc2 = queue_stack(
+            tmp_path, resilience={"reconcile": {"auto_resume": True}})
+        try:
+            # frontier evidence: the boot sweep names exactly the dead
+            # lane's entry op, and recovery re-runs ONLY that lane
+            assert any(r["op"] == doomed["op_id"]
+                       for r in svc2.boot_report)
+            svc2.workload_queue.wait_all()
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                state = svc2.workload_queue.status(
+                    doomed["id"])["state"]
+                if state == "done":
+                    break
+                time.sleep(0.2)
+            rows = {e["tenant"]: e
+                    for e in svc2.workload_queue.entries()}
+            assert rows["doomed"]["state"] == "done", rows["doomed"]
+            assert len(rows["doomed"]["run_ops"]) == 1
+            assert rows["steady"]["state"] == "done"   # untouched
+        finally:
+            svc2.close()
+
+    def test_pool4_paced_dispatch_at_least_twice_serial(self, tmp_path):
+        """The tier-1 concurrency budget (ISSUE 18): 8 identical paced
+        gangs through the engine at pool 4 must finish at least 2x
+        faster than serially (perf_matrix --queue pins the full ~4x;
+        the test floor keeps CI headroom)."""
+        import itertools
+
+        svc = queue_stack(tmp_path, queue={"slices": 4,
+                                           "max_concurrent": 1})
+        try:
+            q = svc.workload_queue
+            pace_s = 0.15
+            seq = itertools.count()
+
+            def paced_train(**_kw):
+                time.sleep(pace_s)
+                return {"id": f"paced-{next(seq)}",
+                        "status": "Succeeded", "message": "",
+                        "result": {"ok": True}}
+
+            svc.workloads.train = paced_train
+
+            def timed_batch(max_concurrent, tag):
+                q.max_concurrent = max_concurrent
+                with q._lock:
+                    q._engine_active = True
+                for i in range(8):
+                    q.submit(mesh="data=1,fsdp=4", steps=2,
+                             tenant=f"{tag}{i}", wait=True)
+                with q._lock:
+                    q._engine_active = False
+                t0 = time.perf_counter()
+                q.process(wait=True)
+                return time.perf_counter() - t0
+
+            serial_wall = timed_batch(1, "serial")
+            pool_wall = timed_batch(4, "pool")
+            assert all(e["state"] == "done" for e in q.entries())
+            assert serial_wall >= 8 * pace_s            # truly serial
+            assert serial_wall / pool_wall >= 2.0, \
+                f"pool-4 speedup {serial_wall / pool_wall:.2f}x < 2x " \
+                f"(serial {serial_wall:.2f}s, pool {pool_wall:.2f}s)"
+        finally:
+            svc.close()
+
+
+# ------------------------------------------------------- the serving class --
+class TestServingClass:
+    """ISSUE 18 half (b): the `serve` verb — a latency-class gang that
+    restores a checkpointed model and answers requests under an SLO."""
+
+    def test_admission_requires_a_complete_checkpoint(self, tmp_path):
+        svc = queue_stack(tmp_path)
+        try:
+            with pytest.raises(ValidationError, match="COMPLETE"):
+                svc.workload_queue.submit(kind="serve", tenant="ghost",
+                                          wait=False)
+            with pytest.raises(ValidationError, match="serving-tier"):
+                svc.workload_queue.submit(mesh="data=1,fsdp=4", steps=2,
+                                          requests=4, wait=False)
+            with pytest.raises(ValidationError, match="requests"):
+                svc.workload_queue.submit(kind="serve", requests=0,
+                                          wait=False)
+            with pytest.raises(ValidationError, match="slo_ms"):
+                svc.workload_queue.submit(kind="serve", slo_ms=-1.0,
+                                          wait=False)
+            assert svc.workload_queue.entries() == []   # no strands
+        finally:
+            svc.close()
+
+    def test_serve_restores_checkpoint_and_emits_request_samples(
+            self, tmp_path):
+        """A served session: gang sized from the checkpoint's recorded
+        mesh, model restored by id, every request a metric sample, the
+        op resolvable through the workload surface (status/trace), and
+        the latency histogram exported."""
+        from kubeoperator_tpu.api.metrics import MetricsRegistry
+
+        svc = queue_stack(tmp_path)
+        try:
+            svc.workload_queue.submit(mesh="data=1,fsdp=4", steps=2,
+                                      tenant="m", wait=True)
+            ckpt = svc.repos.checkpoints.latest_complete(tenant="m")
+            entry = svc.workload_queue.submit(
+                kind="serve", tenant="m", requests=3, slo_ms=500.0,
+                priority="high", wait=True)
+            assert entry["state"] == "done"
+            assert entry["devices"] == 4      # sized from ckpt mesh
+            run_op = entry["run_ops"][0]
+            result = svc.repos.operations.get(run_op).vars["result"]
+            assert result["served"] == 3
+            assert result["checkpoint_restored"] == ckpt.id
+            assert not result["degraded"]
+            # request samples rode the metric bus
+            rows, _cursor = svc.repos.metric_samples.since(run_op)
+            samples = [s for _rid, s in rows if s.kind == "request"]
+            assert len(samples) == 3
+            assert all(s.attrs.get("slo_ms") == 500.0 for s in samples)
+            # the op resolves like any workload op (the PR-12 lesson)
+            assert svc.workloads.status(run_op)["kind"] \
+                == "workload-serve"
+            assert svc.workloads.trace(run_op[:8])["operation"] == run_op
+            text = MetricsRegistry().render(svc)
+            assert ('ko_tpu_workload_request_seconds_count'
+                    '{tenant="m"} 3') in text
+        finally:
+            svc.close()
+
+    def test_slice_preemption_degrades_server_without_dropping(
+            self, tmp_path):
+        """The degrade-not-die contract in unit form: losing one slice
+        under a running 2-slice server re-shards it onto the survivor
+        mid-session — same entry, one run op, every request answered."""
+        svc = queue_stack(tmp_path)
+        try:
+            q = svc.workload_queue
+            svc.workload_queue.submit(mesh="data=2,fsdp=4", steps=2,
+                                      tenant="m", wait=True)
+            fired = {"done": False}
+
+            def request_hook(served, _latency_s):
+                if served == 1 and not fired["done"]:
+                    fired["done"] = True
+                    server = next(e for e in q.entries()
+                                  if e["kind"] == "serve")
+                    q.preempt_slice(server["placement"][-1])
+                return None
+
+            svc.workloads.request_hook = request_hook
+            entry = q.submit(mesh="data=2,fsdp=4", kind="serve",
+                             tenant="m", requests=4, priority="high",
+                             wait=True)
+            assert entry["state"] == "done"
+            led = entry["preemptions"]
+            assert len(led) == 1 and led[0]["kind"] == "degraded"
+            assert len(led[0]["survivors"]) == 1
+            assert len(entry["run_ops"]) == 1      # never re-dispatched
+            result = svc.repos.operations.get(
+                entry["run_ops"][0]).vars["result"]
+            assert result["served"] == 4
+            assert result["degraded"] is True
+            assert result["finite"]
+            assert result["mesh"]["data"] == 1     # shrunk onto survivor
+        finally:
+            svc.workloads.request_hook = None
+            svc.close()
+
+    def test_victims_trains_before_servers_within_a_class(self):
+        """Preemption order: within the same priority class, training
+        (resumable from its checkpoint) is evicted before serving
+        (whose drain breaks a latency promise)."""
+        def entry(eid, kind, created):
+            e = QueueEntry(op_id="op", kind=kind, priority_class="low",
+                           priority=priority_of("low"),
+                           placement=["s" + eid])
+            e.id = eid
+            e.created_at = created
+            return e
+
+        train_old = entry("t-old", "train", 1.0)
+        train_new = entry("t-new", "train", 2.0)
+        server = entry("srv", "serve", 3.0)
+        victims = choose_victims([train_old, train_new, server],
+                                 needed=1, free=0,
+                                 priority=priority_of("high"))
+        assert [v.id for v in victims] == ["t-new"]
+        victims = choose_victims([train_old, train_new, server],
+                                 needed=3, free=0,
+                                 priority=priority_of("high"))
+        # both trains go before the server, youngest first within kind
+        assert [v.id for v in victims] == ["t-new", "t-old", "srv"]

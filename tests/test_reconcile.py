@@ -25,9 +25,17 @@ from kubeoperator_tpu.service import build_services
 from kubeoperator_tpu.utils.config import load_config
 
 
-def stack(tmp_path, db="journal.db", chaos=None, reconcile=None):
+def stack(tmp_path, db="journal.db", chaos=None, reconcile=None,
+          scheduler=None):
     """In-process service stack over a REUSABLE on-disk DB — building a
-    second stack on the same path is the 'controller reboot'."""
+    second stack on the same path is the 'controller reboot'.
+
+    `scheduler` defaults to the SERIAL phase engine: die_at_phase must
+    strand a DETERMINISTIC frontier for this module's resume-point
+    assertions — with the DAG scheduler, a sibling branch (runtime vs
+    etcd) may or may not have landed when death fires, and the swept
+    resume_phase races. Tests that exercise concurrency (test_dag's
+    crash drills) pass their own value."""
     config = load_config(path="/nonexistent", env={}, overrides={
         "db": {"path": str(tmp_path / db)},
         "logging": {"level": "ERROR"},
@@ -38,6 +46,7 @@ def stack(tmp_path, db="journal.db", chaos=None, reconcile=None):
         "cluster": {"kubeconfig_dir": str(tmp_path / "kc")},
         "chaos": {"enabled": True, **chaos} if chaos else {},
         "resilience": {"reconcile": reconcile or {}},
+        "scheduler": scheduler or {"max_concurrent_phases": 1},
     })
     return build_services(config, simulate=True)
 

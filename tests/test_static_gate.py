@@ -44,13 +44,14 @@ def test_analyzer_reports_zero_errors_over_repo():
     # every baseline entry still suppresses something real — stale
     # waivers are deleted, not accumulated
     assert report.unused_waivers == [], report.unused_waivers
-    # operational budget: the gate must stay cheap (PERF.md). 7s, not 5:
-    # the 27-rule cold run (KO-S SQL family included) measures ~5.1s on
-    # this machine class, and the pre-PR-7 5s ceiling left so little
-    # headroom that an end-of-suite run (page cache churned, WAL
-    # checkpoints pending) flaked — the budget exists to catch a
-    # pathological rule, not scheduler noise
-    assert elapsed < 7.0, f"analyzer took {elapsed:.2f}s (budget 7s)"
+    # operational budget: the gate must stay cheap (PERF.md). 10s, not 7:
+    # the 29-rule cold run (KO-S SQL family + KO-P014 thread discipline)
+    # measures ~5.7-6.6s on this machine class, and history shows a tight
+    # ceiling flakes at end-of-suite (page cache churned, WAL checkpoints
+    # pending) — the pre-PR-7 5s budget tripped that way, and the 7s one
+    # did too once the rule set grew. The budget exists to catch a
+    # pathological rule, not scheduler noise, so keep ~50% headroom.
+    assert elapsed < 10.0, f"analyzer took {elapsed:.2f}s (budget 10s)"
 
 
 def test_warm_cache_run_stays_under_budget(tmp_path):
