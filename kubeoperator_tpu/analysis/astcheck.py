@@ -593,6 +593,97 @@ def check_thread_discipline(root: str, tree: ast.AST, path: str) -> list:
     return findings
 
 
+# ---------------------------------------------------------------- KO-P015 ---
+# vocabulary cache: root -> frozenset of declared metric family names;
+# parsing api/metrics.py once per analyzed tree, not once per file
+_P015_VOCAB: dict = {}
+
+# the classic-format series suffixes a family name may legitimately grow
+# when a row is rendered by hand (histogram series, counter series)
+_P015_SUFFIXES = ("_bucket", "_sum", "_count", "_total")
+
+
+def _metric_family_vocabulary(root: str) -> frozenset:
+    """The METRIC_FAMILIES tuple parsed out of the ANALYZED tree's
+    api/metrics.py — the registry's one declared alphabet of exposition
+    family names. A tree that ships no metrics.py (fixture trees) falls
+    back to the installed package's vocabulary, mirroring KO-P013."""
+    if root in _P015_VOCAB:
+        return _P015_VOCAB[root]
+    names: set = set()
+    path = os.path.join(root, "api", "metrics.py")
+    try:
+        with open(path, encoding="utf-8") as f:
+            tree = ast.parse(f.read())
+    except (OSError, SyntaxError):
+        tree = None
+    assign = None
+    if tree is not None:
+        for node in tree.body:
+            if (isinstance(node, ast.Assign)
+                    and any(isinstance(t, ast.Name)
+                            and t.id == "METRIC_FAMILIES"
+                            for t in node.targets)):
+                assign = node
+    if assign is not None and isinstance(assign.value, (ast.Tuple, ast.List)):
+        for elt in assign.value.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                names.add(elt.value)
+    if not names:
+        from kubeoperator_tpu.api.metrics import METRIC_FAMILIES
+
+        names.update(METRIC_FAMILIES)
+    vocab = frozenset(names)
+    _P015_VOCAB[root] = vocab
+    return vocab
+
+
+def check_metric_name_discipline(root: str, tree: ast.AST,
+                                 path: str) -> list:
+    """Every LITERAL metric family name reaching the exposition registry
+    — the first positional or `name=` argument of a `family(...)`,
+    `histogram(...)` or `_fmt(...)` call — must resolve in the
+    METRIC_FAMILIES vocabulary (api/metrics.py): exactly, or as a
+    declared family plus a classic-format series suffix (_bucket, _sum,
+    _count, _total). A typo'd family name renders series no recording
+    rule, dashboard, or golden exposition test ever selects — silently
+    lost telemetry, the metric twin of KO-P013's event-kind rule.
+    Computed names (f-strings, variables, concatenation) pass — they
+    resolve from a vocabulary member at runtime."""
+    findings: list = []
+    rel = _rel(root, path)
+    vocab = _metric_family_vocabulary(root)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        fname = func.id if isinstance(func, ast.Name) else (
+            func.attr if isinstance(func, ast.Attribute) else "")
+        if fname not in ("family", "histogram", "_fmt"):
+            continue
+        name_arg = node.args[0] if node.args else None
+        for kw in node.keywords:
+            if kw.arg == "name":
+                name_arg = kw.value
+        if not (isinstance(name_arg, ast.Constant)
+                and isinstance(name_arg.value, str)):
+            continue
+        name = name_arg.value
+        if name in vocab or any(
+                name == member + suffix
+                for member in vocab for suffix in _P015_SUFFIXES):
+            continue
+        findings.append(Finding(
+            "KO-P015", rel, node.lineno,
+            f"metric family name {name!r} does not resolve in the "
+            f"METRIC_FAMILIES vocabulary (api/metrics.py) — a typo here "
+            f"renders series no dashboard or golden exposition test ever "
+            f"selects; add the family to METRIC_FAMILIES (or use a "
+            f"declared one)",
+        ))
+    return findings
+
+
 AST_RULES = {
     "KO-P001": check_repo_layering,
     "KO-P002": check_blocking_handlers,
@@ -604,6 +695,7 @@ AST_RULES = {
     "KO-P012": check_event_discipline,
     "KO-P013": check_event_kind_discipline,
     "KO-P014": check_thread_discipline,
+    "KO-P015": check_metric_name_discipline,
 }
 
 

@@ -157,6 +157,16 @@ RULES: dict[str, RuleSpec] = {
             "attributes are the sanctioned spelling)",
         ),
         RuleSpec(
+            "KO-P015", "metric-name", "ast", ERROR,
+            "every literal metric family name reaching the exposition "
+            "registry (family/histogram/_fmt first argument) resolves in "
+            "the METRIC_FAMILIES vocabulary (api/metrics.py) — exactly, "
+            "or as a declared family plus a classic series suffix "
+            "(_bucket/_sum/_count/_total); a typo'd family renders "
+            "series no dashboard or golden test ever selects (computed "
+            "names pass — they resolve from a member at runtime)",
+        ),
+        RuleSpec(
             "KO-P014", "thread-discipline", "ast", ERROR,
             "service-layer code never constructs a bare threading.Thread "
             "— concurrency rides the shared adm/pool.py BoundedPool, and "

@@ -31,6 +31,52 @@ DURATION_BUCKETS_S = (
     60.0, 120.0, 300.0, 600.0, 1800.0,
 )
 
+# The declared metric vocabulary: every family this registry exports, by
+# its exposition name. Analyzer rule KO-P015 (metric-name discipline,
+# docs/analysis.md) holds every LITERAL family name reaching the
+# registry to this list — exactly, or as a sanctioned series suffix
+# (_bucket/_sum/_count/_total) under a declared family — so a typo'd
+# name cannot mint a family no dashboard, alert, or golden test selects.
+METRIC_FAMILIES = (
+    "ko_tpu_info",
+    "ko_tpu_uptime_seconds",
+    "ko_tpu_http_requests_total",
+    "ko_tpu_sse_consumers",
+    "ko_tpu_sse_sessions",
+    "ko_tpu_sse_rows_delivered_total",
+    "ko_tpu_sse_lag_seconds",
+    "ko_tpu_clusters",
+    "ko_tpu_phase_duration_seconds",
+    "ko_tpu_task_duration_seconds",
+    "ko_tpu_operations",
+    "ko_tpu_fleet_waves",
+    "ko_tpu_fleet_inflight_clusters",
+    "ko_tpu_fleet_convergence",
+    "ko_tpu_fleet_drifted_clusters",
+    "ko_tpu_workload_queue",
+    "ko_tpu_workload_queue_running",
+    "ko_tpu_workload_queue_wait_seconds",
+    "ko_tpu_events_total",
+    "ko_tpu_workload_step_seconds",
+    "ko_tpu_workload_request_seconds",
+    "ko_tpu_workload_loss",
+    "ko_tpu_db_statement_seconds",
+    "ko_tpu_db_busy_retries_total",
+    "ko_tpu_db_lock_wait_seconds_total",
+    "ko_tpu_db_wal_bytes",
+    "ko_tpu_db_tx_depth",
+    "ko_tpu_watchdog_circuit_open",
+    "ko_tpu_watchdog_budget_left",
+    "ko_tpu_controller_leases",
+    "ko_tpu_controller_lease_heartbeat_age_seconds",
+    "ko_tpu_executor_up",
+    "ko_tpu_executor_tasks_started_total",
+    "ko_tpu_executor_tasks",
+    "ko_tpu_terminal_sessions",
+    "ko_tpu_terminal_dropped_chunks_total",
+    "ko_tpu_smoke_gbps",
+)
+
 
 def _escape(value: str) -> str:
     return (str(value).replace("\\", "\\\\").replace('"', '\\"')
@@ -61,6 +107,13 @@ class MetricsRegistry:
         self._started = time.time()
         self._http: dict[tuple[str, int], int] = {}
         self._sse_consumers = 0
+        # SSE session accounting by pump surface (logs / terminal /
+        # events / metrics): live sessions, rows delivered, and the last
+        # write-stall each surface saw — ROADMAP item 1's "thousands of
+        # concurrent SSE sessions" acceptance needs this denominator
+        self._sse_sessions: dict[str, int] = {}
+        self._sse_rows: dict[str, int] = {}
+        self._sse_lag_s: dict[str, float] = {}
 
     # ---- process counters (hot path: O(1) under a short lock) ----
     def observe_http(self, method: str, status: int) -> None:
@@ -68,17 +121,39 @@ class MetricsRegistry:
         with self._lock:
             self._http[key] = self._http.get(key, 0) + 1
 
-    def sse_started(self) -> None:
+    def sse_started(self, surface: str = "") -> None:
         with self._lock:
             self._sse_consumers += 1
+            if surface:
+                self._sse_sessions[surface] = \
+                    self._sse_sessions.get(surface, 0) + 1
 
-    def sse_finished(self) -> None:
+    def sse_finished(self, surface: str = "") -> None:
         # clamped at 0: a double-finish (e.g. an exception path running a
         # finally twice, or a finish with no matching start) must read as
         # "zero consumers", never as a negative gauge that poisons every
         # dashboard sum it joins
         with self._lock:
             self._sse_consumers = max(self._sse_consumers - 1, 0)
+            if surface:
+                self._sse_sessions[surface] = max(
+                    self._sse_sessions.get(surface, 0) - 1, 0)
+
+    def sse_rows_delivered(self, surface: str, n: int) -> None:
+        """`n` frames written to one session's socket (counted per write
+        batch, not per session close, so a long follow shows throughput
+        while it is still running)."""
+        if n <= 0:
+            return
+        with self._lock:
+            self._sse_rows[surface] = self._sse_rows.get(surface, 0) + n
+
+    def sse_write_lag(self, surface: str, seconds: float) -> None:
+        """Wall-clock one frame batch spent blocked in socket writes —
+        the slow-consumer signal: a reader that cannot drain its stream
+        backs this up long before frames drop."""
+        with self._lock:
+            self._sse_lag_s[surface] = seconds
 
     # ---- exposition ----
     def render(self, services, openmetrics: bool = False) -> str:
@@ -133,6 +208,9 @@ class MetricsRegistry:
         with self._lock:
             http = dict(self._http)
             sse = self._sse_consumers
+            sse_sessions = dict(self._sse_sessions)
+            sse_rows = dict(self._sse_rows)
+            sse_lag = dict(self._sse_lag_s)
         family("ko_tpu_info", "gauge", "Build info.",
                [_fmt("ko_tpu_info", {"version": __version__}, 1)])
         family("ko_tpu_uptime_seconds", "gauge",
@@ -147,6 +225,24 @@ class MetricsRegistry:
         family("ko_tpu_sse_consumers", "gauge",
                "Live SSE streams (log followers, event feeds, terminals).",
                [_fmt("ko_tpu_sse_consumers", None, sse)])
+        # per-surface session accounting (docs/observability.md
+        # "Control-plane DB telemetry"): which pump carries the fanout
+        family("ko_tpu_sse_sessions", "gauge",
+               "Live SSE sessions by pump surface (logs / terminal / "
+               "events / metrics).",
+               [_fmt("ko_tpu_sse_sessions", {"surface": s}, n)
+                for s, n in sorted(sse_sessions.items())])
+        family("ko_tpu_sse_rows_delivered_total", "counter",
+               "SSE frames written to consumer sockets since process "
+               "start, by pump surface.",
+               [_fmt("ko_tpu_sse_rows_delivered_total", {"surface": s}, n)
+                for s, n in sorted(sse_rows.items())])
+        family("ko_tpu_sse_lag_seconds", "gauge",
+               "Wall-clock the most recent frame batch spent blocked in "
+               "socket writes, by surface — the slow-consumer signal.",
+               [_fmt("ko_tpu_sse_lag_seconds", {"surface": s},
+                     round(v, 6))
+                for s, v in sorted(sse_lag.items())])
 
         # ---- scrape-time collectors over the live stack ----
         clusters = services.repos.clusters.list()
@@ -315,6 +411,66 @@ class MetricsRegistry:
                          {"op": op_id[:8], "tenant": tenant}, loss)
                     for op_id, tenant, _step, loss
                     in samples_repo.latest_losses()])
+
+        # control-plane DB flight recorder (docs/observability.md
+        # "Control-plane DB telemetry"): statement-level phase split off
+        # the Database handle's in-memory accumulator. getattr-guarded
+        # twice: exposition stubs carry no db, and a telemetry-off stack
+        # carries db.telemetry=None — both simply omit the families.
+        telemetry = getattr(getattr(services.repos, "db", None),
+                            "telemetry", None)
+        if telemetry is not None:
+            from kubeoperator_tpu.observability.dbtelemetry import (
+                DB_BUCKETS_S,
+            )
+
+            snap = telemetry.snapshot()
+            lines = []
+            for row in snap["statements"]:
+                for phase in sorted(row["phases"]):
+                    cell = row["phases"][phase]
+                    labels = {"stmt": row["stmt"], "phase": phase}
+                    cumulative = 0
+                    for le, band in zip((*DB_BUCKETS_S, float("inf")),
+                                        cell["buckets"]):
+                        cumulative += band
+                        le_text = ("+Inf" if le == float("inf")
+                                   else f"{le:g}")
+                        lines.append(_fmt(
+                            "ko_tpu_db_statement_seconds_bucket",
+                            {**labels, "le": le_text}, cumulative))
+                    lines.append(_fmt("ko_tpu_db_statement_seconds_sum",
+                                      labels, cell["sum_s"]))
+                    lines.append(_fmt("ko_tpu_db_statement_seconds_count",
+                                      labels, cell["count"]))
+            family("ko_tpu_db_statement_seconds", "histogram",
+                   "Control-plane statement wall-clock by stable "
+                   "statement id and phase (lock_wait = blocked at "
+                   "BEGIN IMMEDIATE, exec = statement execution, "
+                   "commit = outermost COMMIT); ids match `koctl db "
+                   "stats` and the KO-S statement model.", lines)
+            family("ko_tpu_db_busy_retries_total", "counter",
+                   "BEGIN IMMEDIATE attempts that hit another writer's "
+                   "lock past busy_timeout (each is a bounded-backoff "
+                   "retry; growth means WAL writer contention).",
+                   [_fmt("ko_tpu_db_busy_retries_total", None,
+                         snap["busy_retries"])])
+            family("ko_tpu_db_lock_wait_seconds_total", "counter",
+                   "Total wall-clock transactions spent blocked "
+                   "acquiring the write lock (the scaling wall's "
+                   "numerator — see PERF.md db rows).",
+                   [_fmt("ko_tpu_db_lock_wait_seconds_total", None,
+                         snap["lock_wait_s"])])
+            family("ko_tpu_db_wal_bytes", "gauge",
+                   "Size of the shared WAL file (growth between "
+                   "checkpoints bounds reader catch-up work).",
+                   [_fmt("ko_tpu_db_wal_bytes", None,
+                         snap["wal_bytes"])])
+            family("ko_tpu_db_tx_depth", "gauge",
+                   "High-watermark of nested tx() scopes this process "
+                   "has stacked (fence + journal write = 2).",
+                   [_fmt("ko_tpu_db_tx_depth", None,
+                         snap["tx_depth_max"])])
 
         try:
             watchdog_rows = services.watchdog.status()

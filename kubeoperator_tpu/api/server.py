@@ -15,6 +15,7 @@ from __future__ import annotations
 import asyncio
 import functools
 import json
+import time
 
 from aiohttp import web
 
@@ -267,6 +268,28 @@ class Handlers:
         if request.query.get("format") == "sarif":
             return json_response(sarif)
         return json_response(plain)
+
+    async def db_stats(self, request):
+        """The control-plane flight recorder's top-N statement table
+        (docs/observability.md "Control-plane DB telemetry"): per-
+        statement lock-wait/exec/commit totals and p99s, the lock-wait
+        share headline, busy retries and WAL size — `koctl db stats`
+        over both transports. Admin-gated like /analysis: statement
+        texts name internal schema. Telemetry off answers
+        `{"enabled": false}` instead of 404ing, so dashboards can tell
+        "knob off" from "endpoint missing"."""
+        from kubeoperator_tpu.utils.errors import ValidationError
+
+        _require_admin(request)
+        telemetry = getattr(self.s.repos.db, "telemetry", None)
+        if telemetry is None:
+            return json_response({"enabled": False, "statements": []})
+        try:
+            top = int(request.query.get("top", "10") or 10)
+        except ValueError:
+            raise ValidationError("top must be an integer")
+        return json_response(await run_sync(
+            request, telemetry.stats, max(1, min(top, 100))))
 
     async def audit_log(self, request):
         from kubeoperator_tpu.utils.errors import ValidationError
@@ -585,24 +608,28 @@ class Handlers:
             "Cache-Control": "no-cache",
         })
         await resp.prepare(request)
-        self.metrics.sse_started()
+        self.metrics.sse_started("logs")
         try:
             idle = 0.0
             while idle < 30.0:
                 chunks, cursor = await run_sync(request, fetch, cursor)
                 if chunks:
                     idle = 0.0
+                    write_t0 = time.monotonic()
                     for c in chunks:
                         await resp.write(
                             f"data: {json.dumps({'seq': c.seq, 'line': c.line})}\n\n"
                             .encode()
                         )
+                    self.metrics.sse_rows_delivered("logs", len(chunks))
+                    self.metrics.sse_write_lag(
+                        "logs", time.monotonic() - write_t0)
                 else:
                     idle += 0.5
                     await asyncio.sleep(0.5)
             await resp.write(b"event: end\ndata: {}\n\n")
         finally:
-            self.metrics.sse_finished()
+            self.metrics.sse_finished("logs")
         return resp
 
     # ---- nodes / scale (§3.3) ----
@@ -974,14 +1001,19 @@ class Handlers:
                 await resp.write(
                     f"event: gap\ndata: {json.dumps({'missed': missed})}\n\n"
                     .encode())
+            write_t0 = time.monotonic()
             for s, d in chunks:
                 payload = json.dumps(
                     {"seq": s, "data": d.decode("utf-8", "replace")}
                 )
                 await resp.write(f"data: {payload}\n\n".encode())
+            if chunks:
+                self.metrics.sse_rows_delivered("terminal", len(chunks))
+                self.metrics.sse_write_lag(
+                    "terminal", time.monotonic() - write_t0)
             return chunks[-1][0] if chunks else after_seq
 
-        self.metrics.sse_started()
+        self.metrics.sse_started("terminal")
         try:
             idle = 0.0
             while idle < 60.0 and session.alive:
@@ -1003,7 +1035,7 @@ class Handlers:
                 f"event: end\ndata: "
                 f"{json.dumps({'alive': session.alive})}\n\n".encode())
         finally:
-            self.metrics.sse_finished()
+            self.metrics.sse_finished("terminal")
         return resp
 
     async def terminal_resize(self, request):
@@ -1098,19 +1130,21 @@ class Handlers:
     _SSE_KEEPALIVE_S = 5.0
 
     async def _sse_follow(self, request, fetch, *, event_name=None,
-                          end_payload=None, live=None):
+                          end_payload=None, live=None, surface="events"):
         """Generic SSE pump: `fetch()` (run off-loop) returns a list of
         (rowid, json-serializable row [, name]) frames; each frame is
         written as `id:`/`event:`/`data:` lines, idle gaps emit
         keep-alive comments, and the stream closes with `event: end`
         after the idle window (or the moment `live()` turns false —
-        e.g. a watched op reaching a terminal state)."""
+        e.g. a watched op reaching a terminal state). `surface` labels
+        the session/rows/lag accounting in /metrics (the SSE fanout
+        denominator, docs/observability.md)."""
         resp = web.StreamResponse(headers={
             "Content-Type": "text/event-stream",
             "Cache-Control": "no-cache",
         })
         await resp.prepare(request)
-        self.metrics.sse_started()
+        self.metrics.sse_started(surface)
         try:
             idle = 0.0
             since_keepalive = 0.0
@@ -1119,6 +1153,7 @@ class Handlers:
                 if frames:
                     idle = 0.0
                     since_keepalive = 0.0
+                    write_t0 = time.monotonic()
                     for rowid, row, *name in frames:
                         kind = (name[0] if name else event_name) or ""
                         chunk = f"id: {rowid}\n"
@@ -1126,6 +1161,9 @@ class Handlers:
                             chunk += f"event: {kind}\n"
                         chunk += f"data: {json.dumps(row, default=str)}\n\n"
                         await resp.write(chunk.encode())
+                    self.metrics.sse_rows_delivered(surface, len(frames))
+                    self.metrics.sse_write_lag(
+                        surface, time.monotonic() - write_t0)
                 else:
                     if live is not None:
                         if not await run_sync(request, live):
@@ -1151,7 +1189,7 @@ class Handlers:
                 + json.dumps(end_payload or {}, default=str).encode()
                 + b"\n\n")
         finally:
-            self.metrics.sse_finished()
+            self.metrics.sse_finished(surface)
         return resp
 
     async def all_events(self, request):
@@ -1186,7 +1224,7 @@ class Handlers:
 
             if query.get("follow") == "1":
                 return await self._sse_follow(
-                    request, fetch,
+                    request, fetch, surface="events",
                     end_payload=lambda: {"cursor": cursor["after"]})
             rows = await run_sync(request, fetch)
             return json_response({
@@ -1255,7 +1293,8 @@ class Handlers:
                     "cursor": cursor["after"]}
 
         return await self._sse_follow(request, fetch, live=live,
-                                      end_payload=end_payload)
+                                      end_payload=end_payload,
+                                      surface="metrics")
 
     async def cluster_trace(self, request):
         """Create-to-Ready wall-clock summary (SURVEY.md §5.1: the
@@ -1408,6 +1447,7 @@ def create_app(services: Services) -> web.Application:
     r.add_get("/api/v1/audit", h.audit_log)
     r.add_get("/api/v1/bundle-manifest", h.bundle_manifest_view)
     r.add_get("/api/v1/analysis", h.analysis_report)
+    r.add_get("/api/v1/db/stats", h.db_stats)
 
     view, manage = Role.VIEWER, Role.MANAGER
     r.add_get("/api/v1/clusters", h.list_clusters)

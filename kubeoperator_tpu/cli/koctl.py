@@ -337,6 +337,15 @@ class LocalClient:
                 # local transport runs as the operator (admin-equivalent)
                 limit = int(body.get("limit", 200))
                 return [r.to_dict() for r in s.repos.audit.tail(limit)]
+            case ("GET", ["db", "stats"]):
+                # the flight recorder's top-N table (same payload the
+                # REST handler serves; docs/observability.md
+                # "Control-plane DB telemetry")
+                telemetry = getattr(s.repos.db, "telemetry", None)
+                if telemetry is None:
+                    return {"enabled": False, "statements": []}
+                top = int(body.get("top", 10) or 10)
+                return telemetry.stats(max(1, min(top, 100)))
             case ("GET", ["plans"]):
                 return pub(s.plans.list())
             case ("POST", ["plans"]):
@@ -1067,6 +1076,44 @@ def cmd_events(client, args) -> int:
     return 0
 
 
+def cmd_db(client, args) -> int:
+    """`koctl db stats [--json]` — the control-plane flight recorder's
+    top-N statement table (docs/observability.md "Control-plane DB
+    telemetry"): per-statement wall-clock split into lock-wait / exec /
+    commit, keyed by the stable 8-hex statement id the KO-S sqlmodel
+    derives from the resolved SQL text."""
+    top = max(1, min(int(args.top or 10), 100))
+    data = client.call("GET", f"/api/v1/db/stats?top={top}")
+    if args.json:
+        _print(data)
+        return 0
+    if not data.get("enabled", False):
+        print("db telemetry is off (observability.db_telemetry=false)")
+        return 0
+    share = data.get("lock_wait_share", 0.0)
+    print(f"db stats: {data.get('statement_count', 0)} statement(s), "
+          f"{data.get('total_s', 0.0):.3f}s recorded; "
+          f"lock-wait {data.get('lock_wait_s', 0.0):.3f}s "
+          f"({share * 100:.1f}% of db time)")
+    print(f"  busy retries {data.get('busy_retries', 0)}; "
+          f"tx depth max {data.get('tx_depth_max', 0)}; "
+          f"wal {data.get('wal_bytes', 0)} bytes")
+    rows = data.get("statements", [])
+    if not rows:
+        print("  (no statements recorded yet)")
+        return 0
+    print(f"  {'STMT':8s} {'COUNT':>6s} {'TOTAL':>9s} {'LOCKW':>9s} "
+          f"{'EXEC P99':>9s}  SURFACE / TEXT")
+    for r in rows:
+        exec_p99 = (r.get("p99_s") or {}).get("exec", 0.0)
+        where = r.get("surface") or "?"
+        text = r.get("text", "")
+        print(f"  {r['stmt']:8s} {r.get('count', 0):6d} "
+              f"{r.get('total_s', 0.0):8.3f}s {r.get('lock_wait_s', 0.0):8.3f}s "
+              f"{exec_p99:8.4f}s  {where} — {text}")
+    return 0
+
+
 def cmd_watchdog(client, args) -> int:
     """Auto-remediation circuit state (docs/resilience.md): `status` shows
     per-cluster circuit/budget/flaps; `reset` is the ONE way an open
@@ -1406,6 +1453,12 @@ def _sample_line(s: dict) -> str:
         line += f"  {s['tflops']} TFLOP/s"
     if s.get("mfu_pct"):
         line += f"  {s['mfu_pct']}% MFU"
+    attrs = s.get("attrs") or {}
+    if attrs.get("input_s") is not None and attrs.get("compute_s") is not None:
+        # the _StepSampler's step wall-clock split at the on_step seam:
+        # host-side input/dispatch vs device compute (docs/observability.md)
+        line += (f"  input {float(attrs['input_s']):.3f}s"
+                 f" + compute {float(attrs['compute_s']):.3f}s")
     return line
 
 
@@ -3963,7 +4016,9 @@ def cmd_loadtest(args) -> int:
                 print(f"  {n} replica(s): {row['ops']} ops @ "
                       f"{row['concurrency']} workers -> "
                       f"{row['ops_per_s']} ops/s, p50 {row['p50_s']}s, "
-                      f"p99 {row['p99_s']}s")
+                      f"p99 {row['p99_s']}s"
+                      + (f", lock-wait {row['lock_wait_share'] * 100:.1f}%"
+                         if "lock_wait_share" in row else ""))
             print(f"  PERF loadtest row updated (round {result['round']})")
         return 0 if result["ok"] else 1
     with tempfile.TemporaryDirectory(prefix="ko-loadtest-") as base:
@@ -3980,6 +4035,17 @@ def cmd_loadtest(args) -> int:
               f"p95 {report['p95_s']}s p99 {report['p99_s']}s; "
               f"{report['metrics_scrapes']} metrics scrapes; "
               f"outcomes {report['outcomes']}")
+        db = report.get("db")
+        if db:
+            # the flight recorder's contention verdict: how much of db
+            # time was spent blocked, and on which statements
+            print(f"  db: lock-wait {db['lock_wait_share'] * 100:.1f}% of "
+                  f"db time ({db['lock_wait_s']}s), "
+                  f"busy retries {db['busy_retries']}")
+            for r in db["top_contended"]:
+                print(f"    contended {r['stmt']}  "
+                      f"lock-wait {r['lock_wait_s']}s x{r['count']}  "
+                      f"{r['surface'] or '?'}")
         lt.print_checks(report["checks"])
         print(f"  runtime {report['wall_s']}s — "
               + ("OK" if report["ok"] else "FAILED"))
@@ -4678,6 +4744,15 @@ def build_parser() -> argparse.ArgumentParser:
                                            "(who did what, newest first)")
     audit_p.add_argument("-n", "--limit", type=int, default=50)
 
+    db_p = sub.add_parser("db", help="control-plane database telemetry")
+    dsub = db_p.add_subparsers(dest="db_cmd", required=True)
+    db_stats_p = dsub.add_parser(
+        "stats", help="flight-recorder top-N statement table "
+                      "(lock-wait / exec / commit split per statement id)")
+    db_stats_p.add_argument("--top", type=int, default=10,
+                            help="rows to show (1..100, default 10)")
+    db_stats_p.add_argument("--json", action="store_true")
+
     install_p = sub.add_parser("install", help="render/start the platform bundle")
     install_p.add_argument("--dir", default="/opt/ko-tpu")
     install_p.add_argument("--no-start", action="store_true")
@@ -4780,6 +4855,8 @@ def main(argv: list[str] | None = None) -> int:
         return cmd_workload(client, args)
     if args.cmd == "events":
         return cmd_events(client, args)
+    if args.cmd == "db":
+        return cmd_db(client, args)
     if args.cmd == "backup-account":
         if args.ba_cmd == "list":
             _print(client.call("GET", "/api/v1/backup-accounts"))

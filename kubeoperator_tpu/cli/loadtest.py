@@ -236,6 +236,49 @@ def _settle(pool: ReplicaPool, deadline_s: float) -> bool:
     return False
 
 
+def _db_contention(pool: ReplicaPool) -> dict | None:
+    """Merge every replica's flight-recorder snapshot (each replica is
+    its own `Database` handle over the shared WAL file, so each carries
+    its own lock-wait/exec/commit split) into ONE contention verdict:
+    the lock-wait share of all db time plus the top-3 contended
+    statement ids — the attribution the scaling-wall row in PERF.md
+    needs (docs/observability.md "Control-plane DB telemetry"). None
+    when `observability.db_telemetry` is off."""
+    merged: dict[str, dict] = {}
+    busy = 0
+    lock_wait = 0.0
+    enabled = False
+    for replica in pool.replicas:
+        telemetry = getattr(replica.repos.db, "telemetry", None)
+        if telemetry is None:
+            continue
+        enabled = True
+        snap = telemetry.snapshot()
+        busy += snap["busy_retries"]
+        lock_wait += snap["lock_wait_s"]
+        for r in snap["statements"]:
+            row = merged.setdefault(r["stmt"], {
+                "stmt": r["stmt"], "surface": r["surface"],
+                "count": 0, "total_s": 0.0, "lock_wait_s": 0.0})
+            row["count"] += r["count"]
+            row["total_s"] += r["total_s"]
+            row["lock_wait_s"] += r["lock_wait_s"]
+    if not enabled:
+        return None
+    total = sum(r["total_s"] for r in merged.values())
+    top = sorted(merged.values(),
+                 key=lambda r: (-r["lock_wait_s"], r["stmt"]))[:3]
+    return {
+        "lock_wait_s": round(lock_wait, 4),
+        "lock_wait_share": round(lock_wait / total, 4) if total else 0.0,
+        "busy_retries": busy,
+        "top_contended": [
+            {"stmt": r["stmt"], "surface": r["surface"],
+             "lock_wait_s": round(r["lock_wait_s"], 4),
+             "count": r["count"]} for r in top],
+    }
+
+
 # --------------------------------------------------------------- loadtest ---
 def run_loadtest(*, ops: int, replicas: int, concurrency: int,
                  lease_ttl_s: float, base_dir: str,
@@ -401,6 +444,7 @@ def run_loadtest(*, ops: int, replicas: int, concurrency: int,
                   f"double-resumed: {resumed_twice[:5]}")
 
         latencies.sort()
+        db = _db_contention(pool)
         report = {
             "ops": ops,
             "replicas": replicas,
@@ -416,6 +460,7 @@ def run_loadtest(*, ops: int, replicas: int, concurrency: int,
             "p95_s": round(_percentile(latencies, 95), 4),
             "p99_s": round(_percentile(latencies, 99), 4),
             "metrics_scrapes": scrapes["count"],
+            "db": db,
             "checks": checks,
             "ok": all(c["ok"] for c in checks),
         }
@@ -455,6 +500,10 @@ def record_perf(args) -> dict:
             "p99_s": report["p99_s"],
             "ok": report["ok"],
         }
+        if report.get("db"):
+            rows[str(n)]["lock_wait_share"] = \
+                report["db"]["lock_wait_share"]
+            rows[str(n)]["busy_retries"] = report["db"]["busy_retries"]
     round_no = perf_matrix.record_loadtest(
         rows, getattr(args, "round", None))
     return {"round": round_no, "rows": rows, "reports": reports,

@@ -1,0 +1,358 @@
+"""Control-plane DB flight recorder (docs/observability.md "Control-plane
+DB telemetry").
+
+ROADMAP item 1 names the wall — 3 controller replicas deliver 0.84x one
+replica's ops/s over one WAL file — but the loadtest's end-to-end p99
+cannot say WHERE inside `Database.tx` the time went. This recorder is the
+attribution instrument: every statement/transaction wall-clock is split
+into three phases and pinned to a stable statement id, so the loadtest
+report, `koctl db stats` and the `/metrics` histograms can all name the
+contended writer the Postgres seam PR must relieve.
+
+Phase split (the semantics `repository/db.py` records):
+
+* ``lock_wait`` — time blocked acquiring the write lock: the whole
+  BEGIN IMMEDIATE wall including the sqlite busy handler's waits and the
+  bounded locked-retry sleeps. Attributed to the FIRST statement the
+  transaction then executes (that statement is what the caller was
+  waiting to run; an empty tx books under ``(empty-tx)``).
+* ``exec`` — one statement's own execution wall inside the held lock
+  (or, for `Database.query`, the read's wall including any busy wait).
+* ``commit`` — the outermost COMMIT wall (WAL append + any fsync),
+  attributed to the same first statement as the tx's lock_wait.
+
+Statement-id contract: ``sha256(whitespace-normalized resolved text)[:8]``
+where "resolved text" is exactly what the KO-S sqlmodel extractor
+(analysis/sqlmodel.py, PR 16) resolves for that call site — seam
+constants substituted, formatting collapsed — so the id survives
+formatting churn and matches the analyzer's own statement model.
+Statements the extractor marks dynamic resolve by pattern (the dynamic
+hole matches any text). Runtime SQL the registry has never heard of gets
+an id over its own normalized text with surface "" — it still aggregates
+stably, it just has no repo surface to blame.
+
+The recorder is pure in-memory observation: bounded dict updates under
+one lock, no I/O, no SQL — `observability.db_telemetry` off restores the
+bit-identical pre-recorder code path, and the tier-1 budget pins the
+on-path under 5%.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import os
+import re
+import threading
+
+# Finer bucket grid than the operation-latency DURATION_BUCKETS_S:
+# control-plane statements live in the 50us..10ms band and the whole
+# point is seeing lock-wait tails grow past it under replica contention.
+DB_BUCKETS_S = (
+    0.00005, 0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005,
+    0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 10.0,
+)
+
+PHASES = ("lock_wait", "exec", "commit")
+
+# the two attribution fallbacks: a tx that committed without executing
+# anything, and the fold bucket the cardinality bound spills into
+EMPTY_TX = "(empty-tx)"
+OVERFLOW = "(other)"
+
+_WS_RE = re.compile(r"\s+")
+_PLACEHOLDER_RUN_RE = re.compile(r"\?(?:\s*,\s*\?)+")
+
+
+def normalize_sql(sql: str) -> str:
+    """The id-bearing normalization: collapse all whitespace runs, and
+    collapse placeholder lists (``?,?,?`` -> ``?``) — the extractor
+    resolves a joined placeholder generator to one ``?``, and a
+    statement's identity shouldn't hinge on its column count anyway."""
+    text = _WS_RE.sub(" ", str(sql)).strip()
+    return _PLACEHOLDER_RUN_RE.sub("?", text)
+
+
+def statement_id(normalized: str) -> str:
+    return hashlib.sha256(normalized.encode("utf-8")).hexdigest()[:8]
+
+
+class StatementRegistry:
+    """normalized resolved statement text -> (stmt id, repo surface),
+    built from the KO-S sqlmodel extractor over the package tree.
+
+    Built lazily on first resolve (snapshot/scrape time, never the
+    execute hot path): each python file that textually touches a db
+    receiver is parsed once and its `extract_sql_facts` statements keyed
+    by normalized resolved text. Statements with dynamic holes become
+    patterns (the hole matches anything) tried in declaration order."""
+
+    def __init__(self, root: str | None = None) -> None:
+        self._root = root
+        self._lock = threading.Lock()
+        self._exact: dict[str, tuple[str, str]] | None = None
+        self._patterns: list[tuple[re.Pattern, str, str]] = []
+        self._cache: dict[str, tuple[str, str]] = {}
+
+    def _build(self) -> None:
+        from kubeoperator_tpu.analysis.index import iter_python_files
+        from kubeoperator_tpu.analysis.sqlmodel import (
+            DYNAMIC_MARK,
+            extract_sql_facts,
+        )
+
+        root = self._root
+        if root is None:
+            import kubeoperator_tpu
+
+            root = os.path.dirname(os.path.abspath(
+                kubeoperator_tpu.__file__))
+        exact: dict[str, tuple[str, str]] = {}
+        patterns: list[tuple[re.Pattern, str, str]] = []
+        for path in iter_python_files(root):
+            try:
+                with open(path, encoding="utf-8") as f:
+                    source = f.read()
+            except OSError:
+                continue
+            # cheap textual gate before the parse: a file with no
+            # execute/query receiver call cannot contribute statements
+            if ".execute" not in source and ".query" not in source:
+                continue
+            try:
+                tree = ast.parse(source)
+            except SyntaxError:
+                continue
+            rel = os.path.relpath(path, os.path.dirname(root))
+            for stmt in extract_sql_facts(tree, rel)["statements"]:
+                text = normalize_sql(stmt["text"])
+                # a statement with no literal SQL at all (a pass-through
+                # wrapper like db.py's own recorder delegating `sql`) is
+                # a catch-all pattern, not a statement — skip it, or it
+                # would claim every runtime text
+                if not text.replace(DYNAMIC_MARK, "").strip():
+                    continue
+                sid = statement_id(text)
+                via = str(stmt.get("via") or "")
+                if DYNAMIC_MARK in text:
+                    pat = ".*?".join(
+                        re.escape(p) for p in text.split(DYNAMIC_MARK))
+                    patterns.append(
+                        (re.compile(f"^{pat}$", re.DOTALL), sid, via))
+                else:
+                    # first declaration wins; duplicates of the same text
+                    # share the id anyway, only the surface could differ
+                    exact.setdefault(text, (sid, via))
+        self._exact = exact
+        self._patterns = patterns
+
+    def resolve(self, sql: str) -> tuple[str, str]:
+        """(stmt id, surface) for one runtime statement text."""
+        text = normalize_sql(sql)
+        with self._lock:
+            if self._exact is None:
+                self._build()
+            hit = self._cache.get(text)
+            if hit is not None:
+                return hit
+            resolved = self._exact.get(text)
+            if resolved is None:
+                for pat, sid, via in self._patterns:
+                    if pat.match(text):
+                        resolved = (sid, via)
+                        break
+            if resolved is None:
+                # unknown to the model: stable over its own text, no
+                # surface — `koctl lint`'s KO-S extractor never saw it
+                resolved = (statement_id(text), "")
+            # bound the memo like the recorder bounds its keys
+            if len(self._cache) < 4096:
+                self._cache[text] = resolved
+            return resolved
+
+
+# process-wide default registry: the resolve tables depend only on the
+# installed package tree, so N Database handles (loadtest replicas) share
+# one build instead of walking the package N times at snapshot time
+_default_registry: StatementRegistry | None = None
+_default_registry_lock = threading.Lock()
+
+
+def default_registry() -> StatementRegistry:
+    global _default_registry
+    with _default_registry_lock:
+        if _default_registry is None:
+            _default_registry = StatementRegistry()
+        return _default_registry
+
+
+class DbTelemetry:
+    """Thread-safe in-memory accumulator one `Database` handle feeds.
+
+    Hot-path cost is one whitespace-collapse + dict update under a short
+    lock; statement texts are the keys (id resolution is deferred to
+    snapshot time so the execute path never touches the registry).
+    Cardinality is bounded by `max_statements` — the platform speaks ~65
+    statements, so the bound only matters if some dynamic caller starts
+    minting texts, and then the spill lands in ``(other)`` instead of
+    growing without limit."""
+
+    def __init__(self, path: str = "", max_statements: int = 256,
+                 registry: StatementRegistry | None = None) -> None:
+        self.path = path
+        self.max_statements = max(int(max_statements), 1)
+        self.registry = registry or default_registry()
+        self._lock = threading.Lock()
+        # text -> phase -> [count, sum_s, [bucket counts]]
+        self._stats: dict[str, dict[str, list]] = {}
+        self._busy_retries = 0
+        self._lock_wait_s = 0.0
+        self._tx_depth_max = 0
+
+    # ---- recording (the Database hot path) ----
+    def observe(self, sql: str, phase: str, seconds: float) -> None:
+        text = normalize_sql(sql)
+        with self._lock:
+            per = self._stats.get(text)
+            if per is None:
+                if len(self._stats) >= self.max_statements:
+                    text = OVERFLOW
+                per = self._stats.setdefault(text, {})
+            cell = per.get(phase)
+            if cell is None:
+                cell = per[phase] = [0, 0.0, [0] * (len(DB_BUCKETS_S) + 1)]
+            cell[0] += 1
+            cell[1] += seconds
+            for i, le in enumerate(DB_BUCKETS_S):
+                if seconds <= le:
+                    cell[2][i] += 1
+                    break
+            else:
+                cell[2][-1] += 1
+            if phase == "lock_wait":
+                self._lock_wait_s += seconds
+
+    def busy_retry(self) -> None:
+        with self._lock:
+            self._busy_retries += 1
+
+    def note_tx_depth(self, depth: int) -> None:
+        # high-watermark, not instantaneous: a scrape between txs would
+        # always read 0 from a live gauge; the watermark answers "how
+        # deep do the nested fence+journal scopes actually stack"
+        with self._lock:
+            if depth > self._tx_depth_max:
+                self._tx_depth_max = depth
+
+    # ---- reading (scrape / `koctl db stats` time) ----
+    def wal_bytes(self) -> int:
+        try:
+            return os.path.getsize(self.path + "-wal")
+        except OSError:
+            return 0
+
+    def snapshot(self) -> dict:
+        """Resolved per-statement rows + the handle-level counters; the
+        single read surface /metrics and stats() both render from."""
+        with self._lock:
+            stats = {text: {phase: [cell[0], cell[1], list(cell[2])]
+                            for phase, cell in per.items()}
+                     for text, per in self._stats.items()}
+            busy = self._busy_retries
+            lock_wait = self._lock_wait_s
+            depth = self._tx_depth_max
+        # merge by resolved id: two runtime texts can land on the same
+        # statement (a dynamic pattern matches both variants), and the
+        # exposition contract forbids duplicate {stmt,phase} series
+        merged: dict[str, dict] = {}
+        for text, per in stats.items():
+            if text in (EMPTY_TX, OVERFLOW):
+                sid, via = text, ""
+            else:
+                sid, via = self.registry.resolve(text)
+            slot = merged.setdefault(sid, {"surface": via, "text": text,
+                                           "per": {}})
+            for phase, cell in per.items():
+                have = slot["per"].get(phase)
+                if have is None:
+                    slot["per"][phase] = cell
+                else:
+                    have[0] += cell[0]
+                    have[1] += cell[1]
+                    have[2] = [a + b for a, b in zip(have[2], cell[2])]
+        rows = []
+        for sid, slot in merged.items():
+            per = slot["per"]
+            text = slot["text"]
+            total = sum(cell[1] for cell in per.values())
+            # executions, not phase observations: the exec phase counts
+            # one per run; an (empty-tx) row has no exec phase, so fall
+            # back to its widest phase
+            count = (per.get("exec") or
+                     max(per.values(), key=lambda c: c[0]))[0]
+            rows.append({
+                "stmt": sid, "surface": slot["surface"],
+                "text": text if len(text) <= 120 else text[:117] + "...",
+                "count": count,
+                "total_s": round(total, 6),
+                "lock_wait_s": round(per.get("lock_wait",
+                                             [0, 0.0])[1], 6),
+                "phases": {phase: {"count": cell[0],
+                                   "sum_s": round(cell[1], 6),
+                                   "buckets": cell[2]}
+                           for phase, cell in per.items()},
+            })
+        rows.sort(key=lambda r: (-r["total_s"], r["stmt"]))
+        return {
+            "statements": rows,
+            "busy_retries": busy,
+            "lock_wait_s": round(lock_wait, 6),
+            "tx_depth_max": depth,
+            "wal_bytes": self.wal_bytes(),
+        }
+
+    def stats(self, top: int = 10) -> dict:
+        """The `koctl db stats` / `GET /api/v1/db/stats` payload: top-N
+        statements by total time, with per-phase p99s off the bucket
+        grid and the lock-wait share headline."""
+        snap = self.snapshot()
+        total = sum(r["total_s"] for r in snap["statements"]) or 0.0
+        rows = []
+        for r in snap["statements"][:max(int(top), 1)]:
+            rows.append({
+                "stmt": r["stmt"], "surface": r["surface"],
+                "text": r["text"], "count": r["count"],
+                "total_s": r["total_s"],
+                "lock_wait_s": r["lock_wait_s"],
+                "p99_s": {phase: bucket_quantile(
+                    cell["buckets"], cell["count"], 0.99)
+                    for phase, cell in r["phases"].items()},
+            })
+        return {
+            "enabled": True,
+            "statements": rows,
+            "statement_count": len(snap["statements"]),
+            "total_s": round(total, 6),
+            "lock_wait_s": snap["lock_wait_s"],
+            "lock_wait_share": round(
+                snap["lock_wait_s"] / total, 4) if total else 0.0,
+            "busy_retries": snap["busy_retries"],
+            "tx_depth_max": snap["tx_depth_max"],
+            "wal_bytes": snap["wal_bytes"],
+        }
+
+
+def bucket_quantile(buckets: list, count: int, q: float) -> float:
+    """Quantile estimate off the DB_BUCKETS_S grid: the upper edge of the
+    bucket the q-th observation lands in (+Inf reports the last finite
+    edge — the grid's honest ceiling, not a fabricated tail)."""
+    if count <= 0:
+        return 0.0
+    rank = q * count
+    seen = 0
+    for i, n in enumerate(buckets):
+        seen += n
+        if seen >= rank:
+            return DB_BUCKETS_S[i] if i < len(DB_BUCKETS_S) \
+                else DB_BUCKETS_S[-1]
+    return DB_BUCKETS_S[-1]

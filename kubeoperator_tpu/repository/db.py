@@ -44,6 +44,65 @@ DB_NOW_SQL = "(julianday('now') - 2440587.5) * 86400.0"
 ROWID_SQL = "rowid"
 
 
+class _TxRecorder:
+    """The connection facade `tx()` yields while the flight recorder is
+    on: each execute/executemany is timed as phase ``exec``, and the
+    transaction's lock-wait (the BEGIN IMMEDIATE wall) plus its COMMIT
+    wall are attributed to the FIRST statement the tx executed — that
+    statement is what the caller was blocked waiting to run. Everything
+    else delegates to the real sqlite3 connection, so repo code using
+    cursors/lastrowid/total_changes is none the wiser. With the
+    `observability.db_telemetry` knob off this class is never
+    constructed and tx() yields the raw connection exactly as before."""
+
+    __slots__ = ("_conn", "_telemetry", "first_sql", "_pending_lock_s")
+
+    def __init__(self, conn, telemetry, lock_wait_s: float) -> None:
+        self._conn = conn
+        self._telemetry = telemetry
+        self.first_sql: str | None = None
+        self._pending_lock_s = lock_wait_s
+
+    def _note_first(self, sql: str) -> None:
+        if self.first_sql is None:
+            self.first_sql = sql
+            if self._pending_lock_s:
+                self._telemetry.observe(sql, "lock_wait",
+                                        self._pending_lock_s)
+                self._pending_lock_s = 0.0
+
+    def execute(self, sql, *args, **kwargs):
+        t0 = time.perf_counter()
+        cur = self._conn.execute(sql, *args, **kwargs)
+        self._telemetry.observe(sql, "exec", time.perf_counter() - t0)
+        self._note_first(sql)
+        return cur
+
+    def executemany(self, sql, *args, **kwargs):
+        t0 = time.perf_counter()
+        cur = self._conn.executemany(sql, *args, **kwargs)
+        self._telemetry.observe(sql, "exec", time.perf_counter() - t0)
+        self._note_first(sql)
+        return cur
+
+    def settle(self, commit_s: float | None = None) -> None:
+        """Close the tx's books: attribute the COMMIT wall (and any
+        lock-wait a statement never claimed — an empty tx) to the first
+        statement, or the `(empty-tx)` pseudo-statement."""
+        from kubeoperator_tpu.observability.dbtelemetry import EMPTY_TX
+
+        owner = self.first_sql if self.first_sql is not None else EMPTY_TX
+        if self._pending_lock_s:
+            self._telemetry.observe(owner, "lock_wait",
+                                    self._pending_lock_s)
+            self._pending_lock_s = 0.0
+        if commit_s is not None:
+            self._telemetry.observe(owner, "commit", commit_s)
+
+    def __getattr__(self, name):
+        return getattr(self._conn, name)
+
+
 def statement_is_complete(stmt: str) -> bool:
     """Whether `stmt` is one complete SQL statement (';'-terminated) —
     exposed so the analysis layer's migration rule (KO-X006) can validate
@@ -86,10 +145,16 @@ class Database:
 
     def __init__(self, path: str = "ko_tpu.db",
                  synchronous: str = "NORMAL",
-                 busy_timeout_ms: int = 5000) -> None:
+                 busy_timeout_ms: int = 5000,
+                 telemetry=None) -> None:
         self.path = path
+        # the control-plane flight recorder (observability/dbtelemetry.py,
+        # `observability.db_telemetry`): None = the recorder layer does
+        # not exist and every path below is bit-identical pre-recorder
+        self.telemetry = telemetry
         self._lock = threading.RLock()
         self._tx_depth = 0  # nesting depth of tx() scopes (under _lock)
+        self._tx_recorder: _TxRecorder | None = None  # live outermost tx
         self._conn = sqlite3.connect(
             path, check_same_thread=False, isolation_level=None
         )
@@ -139,37 +204,64 @@ class Database:
         with self._lock:
             outermost = self._tx_depth == 0
             if outermost:
-                self._begin_immediate()
+                lock_wait_s = self._begin_immediate()
+                if self.telemetry is not None:
+                    self._tx_recorder = _TxRecorder(
+                        self._conn, self.telemetry, lock_wait_s)
             self._tx_depth += 1
+            if self.telemetry is not None:
+                self.telemetry.note_tx_depth(self._tx_depth)
+            conn = (self._tx_recorder if self._tx_recorder is not None
+                    else self._conn)
             try:
-                yield self._conn
+                yield conn
             except BaseException:
                 self._tx_depth -= 1
                 if outermost:
+                    recorder, self._tx_recorder = self._tx_recorder, None
                     self._conn.execute("ROLLBACK")
+                    if recorder is not None:
+                        recorder.settle()   # books the unclaimed lock-wait
                 raise
             self._tx_depth -= 1
             if outermost:
+                recorder, self._tx_recorder = self._tx_recorder, None
+                t0 = time.perf_counter()
                 self._conn.execute("COMMIT")
+                if recorder is not None:
+                    recorder.settle(time.perf_counter() - t0)
 
-    def _begin_immediate(self) -> None:
+    def _begin_immediate(self) -> float:
+        """BEGIN IMMEDIATE with the bounded locked-retry; returns the
+        total wall spent acquiring the write lock (busy-handler waits +
+        backoff sleeps + the BEGIN itself) — the tx's lock_wait phase."""
+        t0 = time.perf_counter()
         for attempt in range(self._LOCKED_RETRIES):
             try:
                 self._conn.execute("BEGIN IMMEDIATE")
-                return
+                return time.perf_counter() - t0
             except sqlite3.OperationalError as e:
                 if "locked" not in str(e) and "busy" not in str(e):
                     raise
+                if self.telemetry is not None:
+                    self.telemetry.busy_retry()
                 if attempt == self._LOCKED_RETRIES - 1:
                     raise
                 log.warning(
                     "database %s locked by another writer; retry %d/%d",
                     self.path, attempt + 1, self._LOCKED_RETRIES)
                 time.sleep(self._LOCKED_BACKOFF_S * (attempt + 1))
+        return time.perf_counter() - t0
 
     def query(self, sql: str, params: tuple = ()) -> list[sqlite3.Row]:
+        if self.telemetry is None:
+            with self._lock:
+                return list(self._conn.execute(sql, params))
         with self._lock:
-            return list(self._conn.execute(sql, params))
+            t0 = time.perf_counter()
+            rows = list(self._conn.execute(sql, params))
+        self.telemetry.observe(sql, "exec", time.perf_counter() - t0)
+        return rows
 
     def execute(self, sql: str, params: tuple = ()) -> None:
         with self.tx() as conn:

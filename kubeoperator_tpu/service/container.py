@@ -229,9 +229,23 @@ def build_services(
         config.get("logging.level", "INFO"), config.get("logging.dir"),
         json_logs=bool(config.get("observability.json_logs", False)),
     )
+    telemetry = None
+    if config.get("observability.db_telemetry", True):
+        # the control-plane flight recorder (docs/observability.md
+        # "Control-plane DB telemetry"): statement-level lock-wait/exec/
+        # commit attribution this replica's /metrics and `koctl db stats`
+        # read back. Constructed BEFORE the Database so the migration
+        # runner's statements are recorded too.
+        from kubeoperator_tpu.observability.dbtelemetry import DbTelemetry
+
+        telemetry = DbTelemetry(
+            path=str(config.get("db.path", "ko_tpu.db")),
+            max_statements=int(config.get(
+                "observability.db_telemetry_max_statements", 256)))
     db = Database(config.get("db.path", "ko_tpu.db"),
                   synchronous=str(config.get("db.synchronous", "NORMAL")),
-                  busy_timeout_ms=int(config.get("db.busy_timeout_ms", 5000)))
+                  busy_timeout_ms=int(config.get("db.busy_timeout_ms", 5000)),
+                  telemetry=telemetry)
     repos = Repositories(db)
     from kubeoperator_tpu.utils.i18n import set_default_locale
 

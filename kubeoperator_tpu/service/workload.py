@@ -84,10 +84,19 @@ class _StepSampler:
         from kubeoperator_tpu.models import MetricSample
 
         now = time.perf_counter()
-        # the first boundary follows the compile, not a step — its
-        # wall-clock is not a step time, so it reports 0 (unknown)
-        step_s = (now - self._last) if self._last is not None else 0.0
-        self._last = now
+        # Step wall-clock splits in two at this seam: `input_s` is the
+        # host-side share (data/dispatch between the previous loss fetch
+        # returning and this boundary firing — async dispatch means the
+        # device may overlap it, but the host was *here*), `compute_s`
+        # is the blocking device_get, which rides the device until the
+        # step's result materializes. The first boundary follows the
+        # compile, not a step, so both halves report 0 (unknown).
+        input_s = (now - self._last) if self._last is not None else 0.0
+        loss_value = float(jax.device_get(loss))
+        fetched = time.perf_counter()
+        compute_s = (fetched - now) if self._last is not None else 0.0
+        self._last = fetched
+        step_s = input_s + compute_s
         steps_per_s = round(1.0 / step_s, 3) if step_s > 0 else 0.0
         tflops = (round(self.flops * steps_per_s / 1e12, 4)
                   if steps_per_s else 0.0)
@@ -96,9 +105,11 @@ class _StepSampler:
         self.journal.record_samples(self.op, [MetricSample(
             op_id=self.op.id, step=self.base_step + int(completed),
             kind="step", tenant=self.tenant,
-            loss=float(jax.device_get(loss)),
+            loss=loss_value,
             step_s=round(step_s, 6), steps_per_s=steps_per_s,
             tflops=tflops, mfu_pct=mfu,
+            attrs={"input_s": round(input_s, 6),
+                   "compute_s": round(compute_s, 6)},
         )])
 
 

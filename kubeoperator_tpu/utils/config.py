@@ -406,6 +406,17 @@ DEFAULTS: dict[str, Any] = {
         # structured JSON log records (one object per line, carrying
         # trace_id/op_id/cluster/phase) instead of the human text format
         "json_logs": False,
+        # control-plane DB flight recorder (observability/dbtelemetry.py,
+        # docs/observability.md "Control-plane DB telemetry"): statement-
+        # level lock-wait/exec/commit attribution behind Database.tx,
+        # exported as ko_tpu_db_* families and `koctl db stats`. Pure
+        # in-memory observation — off restores the bit-identical
+        # pre-recorder code path; the tier-1 budget pins on-path <5%
+        "db_telemetry": True,
+        # recorder cardinality bound: distinct statement texts retained
+        # before new ones fold into the "(other)" row — the platform
+        # speaks ~65 statements, so headroom here is for dynamic SQL
+        "db_telemetry_max_statements": 256,
     },
     "i18n": {
         "default_locale": "en-US",

@@ -389,15 +389,24 @@ def write_artifacts(results: dict, round_no: int,
             "`/metrics` scrapes. The journal is audited afterwards: zero "
             "lost rows, zero duplicated rows, every cluster Ready.",
             "",
-            "| replicas | ops | concurrency | ops/s | p50 (s) | p99 (s) |",
-            "|---|---|---|---|---|---|",
+            "The lock-wait column is the flight recorder's verdict "
+            "(docs/observability.md \"Control-plane DB telemetry\"): the",
+            "share of all db time the replicas spent blocked at BEGIN "
+            "IMMEDIATE — the scaling wall's attribution.",
+            "",
+            "| replicas | ops | concurrency | ops/s | p50 (s) | p99 (s) | "
+            "lock-wait | busy retries |",
+            "|---|---|---|---|---|---|---|---|",
         ]
         for n in sorted(loadtest_rounds[lt_round], key=int):
             row = loadtest_rounds[lt_round][n]
+            share = row.get("lock_wait_share")
             lines.append(
                 f"| {n} | {row['ops']} | {row['concurrency']} | "
                 f"{row['ops_per_s']:.1f} | {row['p50_s']:.3f} | "
-                f"{row['p99_s']:.3f} |")
+                f"{row['p99_s']:.3f} | "
+                f"{f'{share * 100:.1f}%' if share is not None else '—'} | "
+                f"{row.get('busy_retries', '—')} |")
     # multislice DCN smoke rows (`perf_matrix.py --multislice`,
     # docs/resilience.md "Slice preemption"): rendered from the newest
     # multislice round — the matrix's first rows beyond 8-device
@@ -635,6 +644,47 @@ def write_artifacts(results: dict, round_no: int,
                 f"{row['max_actions_per_tick']} | {row['ticks']} | "
                 f"{row['actions_total']} | {row['actions_per_tick']} | "
                 f"{row['mean_tick_s']} | {row['clusters_per_s']} | "
+                f"{'yes' if row['ok'] else 'NO'} |")
+    # control-plane db rows (`perf_matrix.py --db`,
+    # docs/observability.md "Control-plane DB telemetry"): rendered from
+    # the newest round like the other single-section harnesses
+    db_rounds = history.get("db") or {}
+    if db_rounds:
+        d_round = str(max(int(k) for k in db_rounds))
+        report = db_rounds[d_round]
+        lines += [
+            "",
+            f"## db (round {d_round})",
+            "",
+            "Control-plane DB flight recorder (`python perf_matrix.py "
+            "--db`): statement throughput by shape on one migrated",
+            "WAL handle (single-row tx insert / indexed read / "
+            "journal-style nested-tx batch), then the contention pair —",
+            "one writer thread per replica over ONE WAL file at 1 vs 3 "
+            "replicas, with the recorder's merged lock-wait p99",
+            "(time blocked at BEGIN IMMEDIATE) and lock-wait share "
+            "attributing the multi-controller scaling wall.",
+            "",
+            "| shape | statements | wall (s) | statements/s |",
+            "|---|---|---|---|",
+        ]
+        for row in report.get("rows", []):
+            lines.append(
+                f"| {row['shape']} | {row['statements']} | "
+                f"{row['wall_s']} | {row['statements_per_s']} |")
+        lines += [
+            "",
+            "| replicas | writers | statements | statements/s | "
+            "lock-wait p99 (s) | lock-wait share | busy retries | ok |",
+            "|---|---|---|---|---|---|---|---|",
+        ]
+        for row in report.get("contention", []):
+            lines.append(
+                f"| {row['replicas']} | {row['writers']} | "
+                f"{row['statements']} | {row['statements_per_s']} | "
+                f"{row['lock_wait_p99_s']} | "
+                f"{row['lock_wait_share'] * 100:.1f}% | "
+                f"{row['busy_retries']} | "
                 f"{'yes' if row['ok'] else 'NO'} |")
     if traces:
         lines += [
@@ -1378,6 +1428,160 @@ def record_events(report: dict, round_no: int | None = None) -> int:
     return _record_section("events", report, round_no)
 
 
+def run_db(ops: int = 300) -> dict:
+    """The CI face of the control-plane flight recorder (ISSUE 20):
+    statement throughput by shape on one migrated WAL handle, then the
+    contention pair the scaling-wall attribution needs — one writer
+    thread per replica over ONE WAL file at 1 vs 3 replicas, each
+    replica its own `Database` handle (its own sqlite connection), with
+    the recorder's merged lock-wait p99 and lock-wait share. The shapes
+    run raw SQL on a scratch table (they measure the db layer, not the
+    repos); the recorder aggregates them under its unknown-statement
+    fallback, which is exactly what the p99 merge reads."""
+    import tempfile
+    import threading
+    import time as _time
+
+    from kubeoperator_tpu.cli.loadtest import ReplicaPool
+    from kubeoperator_tpu.observability.dbtelemetry import bucket_quantile
+
+    _CREATE = ("CREATE TABLE IF NOT EXISTS perf_db "
+               "(id INTEGER PRIMARY KEY, v TEXT)")
+    _INSERT = "INSERT INTO perf_db (v) VALUES (?)"
+
+    def merged_lock_wait(pool) -> dict:
+        """Sum every replica's lock_wait phase cells: elementwise bucket
+        merge + counts, so the p99 is over ALL waits on the file."""
+        buckets = None
+        count = 0
+        lock_wait = 0.0
+        total = 0.0
+        busy = 0
+        for replica in pool.replicas:
+            telemetry = getattr(replica.repos.db, "telemetry", None)
+            if telemetry is None:
+                continue
+            snap = telemetry.snapshot()
+            busy += snap["busy_retries"]
+            lock_wait += snap["lock_wait_s"]
+            for r in snap["statements"]:
+                total += r["total_s"]
+                cell = r["phases"].get("lock_wait")
+                if cell is None:
+                    continue
+                count += cell["count"]
+                if buckets is None:
+                    buckets = list(cell["buckets"])
+                else:
+                    buckets = [a + b for a, b in
+                               zip(buckets, cell["buckets"])]
+        return {
+            "p99_s": bucket_quantile(buckets or [], count, 0.99),
+            "share": round(lock_wait / total, 4) if total else 0.0,
+            "busy_retries": busy,
+            "recorded": count > 0,
+        }
+
+    shape_rows = []
+    contention = []
+    ok = True
+    with tempfile.TemporaryDirectory(prefix="ko-db-perf-") as base:
+        # ---- phase 1: statements/s by shape, one handle, no rivals ----
+        shapes_dir = os.path.join(base, "shapes")
+        os.makedirs(shapes_dir, exist_ok=True)
+        pool = ReplicaPool(shapes_dir, 1, lease_ttl_s=5.0)
+        try:
+            db = pool[0].repos.db
+            with db.tx() as conn:
+                conn.execute(_CREATE)
+
+            def shape(name: str, statements: int, fn) -> None:
+                t0 = _time.perf_counter()
+                fn()
+                wall = _time.perf_counter() - t0
+                shape_rows.append({
+                    "shape": name, "statements": statements,
+                    "wall_s": round(wall, 3),
+                    "statements_per_s": round(statements / wall, 1)
+                    if wall > 0 else 0.0,
+                })
+
+            def tx_inserts() -> None:
+                for i in range(ops):
+                    with db.tx() as conn:
+                        conn.execute(_INSERT, (f"v{i}",))
+
+            def indexed_selects() -> None:
+                for i in range(ops):
+                    db.query("SELECT v FROM perf_db WHERE id = ?",
+                             (i + 1,))
+
+            batches = max(ops // 10, 1)
+
+            def nested_batches() -> None:
+                # the journal's shape: an outer scope with a nested
+                # fence/journal scope riding the same outermost tx
+                for i in range(batches):
+                    with db.tx() as conn:
+                        conn.execute(_INSERT, (f"outer{i}",))
+                        with db.tx() as inner:
+                            inner.executemany(
+                                _INSERT,
+                                [(f"b{i}-{j}",) for j in range(10)])
+
+            shape("tx-insert", ops, tx_inserts)
+            shape("indexed-select", ops, indexed_selects)
+            shape("nested-tx-batch", batches * 11, nested_batches)
+            ok = ok and getattr(db, "telemetry", None) is not None
+        finally:
+            pool.close()
+
+        # ---- phase 2: lock-wait p99 at 1 vs 3 replicas, one WAL file --
+        for n in (1, 3):
+            pool_dir = os.path.join(base, f"r{n}")
+            os.makedirs(pool_dir, exist_ok=True)
+            pool = ReplicaPool(pool_dir, n, lease_ttl_s=5.0)
+            try:
+                with pool[0].repos.db.tx() as conn:
+                    conn.execute(_CREATE)
+
+                def writer(idx: int) -> None:
+                    handle = pool[idx].repos.db
+                    for i in range(ops):
+                        with handle.tx() as conn:
+                            conn.execute(_INSERT, (f"w{idx}-{i}",))
+
+                threads = [threading.Thread(target=writer, args=(i,),
+                                            daemon=True)
+                           for i in range(n)]
+                t0 = _time.perf_counter()
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+                wall = _time.perf_counter() - t0
+                merged = merged_lock_wait(pool)
+                ok = ok and merged["recorded"]
+                contention.append({
+                    "replicas": n, "writers": n,
+                    "statements": n * ops,
+                    "statements_per_s": round(n * ops / wall, 1)
+                    if wall > 0 else 0.0,
+                    "lock_wait_p99_s": merged["p99_s"],
+                    "lock_wait_share": merged["share"],
+                    "busy_retries": merged["busy_retries"],
+                    "ok": merged["recorded"],
+                })
+            finally:
+                pool.close()
+    return {"ok": ok, "rows": shape_rows, "contention": contention}
+
+
+def record_db(report: dict, round_no: int | None = None) -> int:
+    """`perf_matrix.py --db` hook."""
+    return _record_section("db", report, round_no)
+
+
 def record_loadtest(rows: dict, round_no: int | None = None) -> int:
     """`koctl loadtest --record-perf` hook (rows keyed by replica
     count)."""
@@ -1429,12 +1633,23 @@ def main(argv: list | None = None) -> int:
                              "ticks; ticks-to-convergence and "
                              "actions/tick) and record its row under "
                              "the round")
+    parser.add_argument("--db", action="store_true",
+                        help="run ONLY the control-plane db pass "
+                             "(statement throughput by shape, then "
+                             "lock-wait p99 at 1 vs 3 replicas over one "
+                             "WAL file from the flight recorder) and "
+                             "record its rows under the round")
     parser.add_argument("--analyzer", action="store_true",
                         help="run ONLY the static-gate cost pass (one "
                              "cold full-tree ko-analyze run + one warm "
                              "cache re-run) and record its row under "
                              "the round")
     args = parser.parse_args(argv)
+    if args.db:
+        report = run_db()
+        round_no = record_db(report, args.round)
+        print(json.dumps({"round": round_no, "db": report}, indent=2))
+        return 0 if report["ok"] else 1
     if args.analyzer:
         report = run_analyzer()
         round_no = record_analyzer(report, args.round)

@@ -1402,6 +1402,70 @@ class TestThreadDiscipline:  # KO-P014
         assert findings == [], [f"{f.file}:{f.line}" for f in findings]
 
 
+class TestMetricNameDiscipline:  # KO-P015
+    def test_fires_on_typoed_family_literal(self, tmp_path):
+        src = (
+            "def render(self):\n"
+            "    family('ko_tpu_cluters', 'gauge', 'h', [])\n"
+        )
+        findings = ast_findings(tmp_path, src, "KO-P015",
+                                rel="api/x.py")
+        assert [f.rule for f in findings] == ["KO-P015"]
+        assert "ko_tpu_cluters" in findings[0].message
+
+    def test_fires_on_name_keyword_and_method_form(self, tmp_path):
+        src = (
+            "def render(self):\n"
+            "    self.histogram(name='ko_tpu_op_secnds', rows=[])\n"
+        )
+        findings = ast_findings(tmp_path, src, "KO-P015",
+                                rel="api/x.py")
+        assert [f.rule for f in findings] == ["KO-P015"]
+
+    def test_quiet_on_vocabulary_members_and_series_suffixes(
+            self, tmp_path):
+        src = (
+            "def render(self, n):\n"
+            "    family('ko_tpu_clusters', 'gauge', 'h', [])\n"
+            # hand-rendered classic-format series rows: a declared
+            # family plus _bucket/_sum/_count/_total still resolves
+            "    _fmt('ko_tpu_db_statement_seconds_bucket', None, n)\n"
+            "    _fmt('ko_tpu_db_statement_seconds_sum', None, n)\n"
+            # computed names resolve from a vocabulary member — pass
+            "    _fmt(name, None, n)\n"
+            "    _fmt(f'ko_tpu_{n}', None, n)\n"
+            # other callables are not the exposition funnel
+            "    emit('totally_bogus_family', n)\n"
+        )
+        assert ast_findings(tmp_path, src, "KO-P015",
+                            rel="api/x.py") == []
+
+    def test_vocabulary_reads_the_analyzed_tree_not_the_package(
+            self, tmp_path):
+        """A --root tree shipping its OWN METRIC_FAMILIES is checked
+        against that alphabet, not the installed package's."""
+        root = make_tree(tmp_path, {
+            "api/metrics.py":
+                "METRIC_FAMILIES = (\n"
+                "    'my_custom_family',\n"
+                ")\n",
+            "api/x.py":
+                "def render(self):\n"
+                "    family('my_custom_family', 'gauge', 'h', [])\n"
+                "    family('ko_tpu_clusters', 'gauge', 'h', [])\n",
+        })
+        findings, _scanned = run_ast_rules(root, {"KO-P015"})
+        assert [f.rule for f in findings] == ["KO-P015"]
+        assert "ko_tpu_clusters" in findings[0].message
+
+    def test_real_tree_speaks_only_the_vocabulary(self):
+        import kubeoperator_tpu
+
+        root = os.path.dirname(kubeoperator_tpu.__file__)
+        findings, _scanned = run_ast_rules(root, {"KO-P015"})
+        assert findings == [], [f"{f.file}:{f.line}" for f in findings]
+
+
 # ------------------------------------------------------- contract rules ----
 def index_for(tmp_path, files: dict):
     """Build a ProjectIndex over a fixture tree (the injection path the

@@ -86,3 +86,20 @@ def test_soak_is_deterministic_and_rides_through(capsys):
         "--slow-stream-rate", "0.05",
     )
     assert second["injections"] != report["injections"]
+
+
+@pytest.mark.slow
+def test_queue_soak_deterministic_with_db_telemetry_on(capsys):
+    """ISSUE 20's determinism gate: the flight recorder observes every
+    statement the queue drill issues, so two seeded passes must still
+    produce bit-identical structural summaries with the recorder at its
+    default (ON) — proof the telemetry path reads clocks but never
+    feeds them back into scheduling or persisted state."""
+    from kubeoperator_tpu.utils.config import DEFAULTS
+
+    # the premise: the recorder IS on by default, so this drill soaks it
+    assert DEFAULTS["observability"]["db_telemetry"] is True
+    rc, report = run_soak(capsys, "--queue", "--verify-determinism")
+    assert rc == 0
+    assert report["deterministic"] is True
+    assert all(c["ok"] for c in report["checks"])

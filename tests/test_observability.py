@@ -642,6 +642,122 @@ class TestExposition:
         assert '# {trace_id="trace-1"} 0.12' in text
 
 
+class TestDbTelemetryExposition:
+    """The control-plane flight recorder's exposition contract (ISSUE
+    20): statement histograms by (stmt, phase), handle counters/gauges,
+    and the families' absence when the stack carries no recorder."""
+
+    def _stub_with_recorder(self):
+        import types
+
+        from kubeoperator_tpu.observability.dbtelemetry import DbTelemetry
+
+        registry = types.SimpleNamespace(
+            resolve=lambda text: ("deadbeef", "Stub.surface"))
+        telemetry = DbTelemetry(path="/nonexistent/stub.db",
+                                registry=registry)
+        telemetry.observe("INSERT INTO t VALUES (?)", "lock_wait", 0.002)
+        telemetry.observe("INSERT INTO t VALUES (?)", "exec", 0.0001)
+        telemetry.observe("INSERT INTO t VALUES (?)", "exec", 0.3)
+        telemetry.observe("INSERT INTO t VALUES (?)", "commit", 0.004)
+        telemetry.observe("SELECT x FROM t", "exec", 0.00008)
+        telemetry.busy_retry()
+        telemetry.note_tx_depth(2)
+        services = _StubServices()
+        services.repos.db = types.SimpleNamespace(telemetry=telemetry)
+        return services
+
+    def test_db_families_render_with_shapes(self):
+        from kubeoperator_tpu.api.metrics import MetricsRegistry
+        from kubeoperator_tpu.observability.dbtelemetry import DB_BUCKETS_S
+
+        text = MetricsRegistry().render(self._stub_with_recorder())
+        families = _parse_exposition(text, openmetrics=False)
+        assert families["ko_tpu_db_statement_seconds"][0] == "histogram"
+        assert families["ko_tpu_db_busy_retries_total"][0] == "counter"
+        assert families["ko_tpu_db_lock_wait_seconds_total"][0] \
+            == "counter"
+        assert families["ko_tpu_db_wal_bytes"][0] == "gauge"
+        assert families["ko_tpu_db_tx_depth"][0] == "gauge"
+        assert "ko_tpu_db_busy_retries_total 1" in text
+        assert "ko_tpu_db_tx_depth 2" in text
+        # every (stmt, phase) series: buckets monotone, +Inf == _count
+        rows = families["ko_tpu_db_statement_seconds"][1]
+        by_series: dict = {}
+        counts: dict = {}
+        for name, labels, value in rows:
+            phase = re.search(r'phase="([^"]*)"', labels).group(1)
+            stmt = re.search(r'stmt="([^"]*)"', labels).group(1)
+            if name.endswith("_bucket"):
+                by_series.setdefault((stmt, phase), []).append(value)
+            elif name.endswith("_count"):
+                counts[(stmt, phase)] = value
+        assert by_series, "no histogram rows rendered"
+        for series, values in by_series.items():
+            assert values == sorted(values), f"{series} not monotone"
+            assert len(values) == len(DB_BUCKETS_S) + 1
+            assert values[-1] == counts[series]
+        # the stub resolves every text to one id, so all three exec
+        # observations must merge into a single series — duplicate
+        # {stmt,phase} label sets would break the exposition contract
+        assert counts[("deadbeef", "exec")] == 3
+
+    def test_db_families_absent_without_recorder(self):
+        from kubeoperator_tpu.api.metrics import MetricsRegistry
+
+        # the plain stub has no repos.db at all; a telemetry-off stack
+        # has db.telemetry None — both must omit the db families
+        import types
+
+        text = MetricsRegistry().render(_StubServices())
+        assert "ko_tpu_db_statement_seconds" not in text
+        services = _StubServices()
+        services.repos.db = types.SimpleNamespace(telemetry=None)
+        text = MetricsRegistry().render(services)
+        assert "ko_tpu_db_statement_seconds" not in text
+
+    def test_sse_session_accounting(self):
+        from kubeoperator_tpu.api.metrics import MetricsRegistry
+
+        registry = MetricsRegistry()
+        registry.sse_started("events")
+        registry.sse_started("events")
+        registry.sse_started("logs")
+        registry.sse_finished("events")
+        registry.sse_rows_delivered("events", 7)
+        registry.sse_rows_delivered("events", 3)
+        registry.sse_rows_delivered("events", 0)    # no-op
+        registry.sse_write_lag("events", 0.25)
+        text = registry.render(_StubServices())
+        assert 'ko_tpu_sse_sessions{surface="events"} 1' in text
+        assert 'ko_tpu_sse_sessions{surface="logs"} 1' in text
+        assert ('ko_tpu_sse_rows_delivered_total{surface="events"} 10'
+                in text)
+        assert 'ko_tpu_sse_lag_seconds{surface="events"} 0.25' in text
+        # the total consumer gauge still counts every surface
+        assert "ko_tpu_sse_consumers 2" in text
+        families = _parse_exposition(text, openmetrics=False)
+        assert families["ko_tpu_sse_sessions"][0] == "gauge"
+        assert families["ko_tpu_sse_rows_delivered_total"][0] == "counter"
+
+    def test_every_rendered_family_is_declared(self):
+        """The KO-P015 vocabulary is the exposition's alphabet: every
+        family the render emits must appear in METRIC_FAMILIES."""
+        from kubeoperator_tpu.api.metrics import (
+            METRIC_FAMILIES,
+            MetricsRegistry,
+        )
+
+        registry = MetricsRegistry()
+        registry.observe_http("GET", 200)
+        registry.sse_started("events")
+        text = registry.render(self._stub_with_recorder())
+        rendered = {line.split()[2] for line in text.splitlines()
+                    if line.startswith("# TYPE ")}
+        undeclared = rendered - set(METRIC_FAMILIES)
+        assert not undeclared, undeclared
+
+
 class TestMetricsRegressions:
     def test_sse_finished_clamps_at_zero(self):
         from kubeoperator_tpu.api.metrics import MetricsRegistry

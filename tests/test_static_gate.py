@@ -105,7 +105,8 @@ def test_cli_gate_exit_code_is_zero(capsys):
 
 
 def _timed_simulated_create(tmp_path, tag: str, tracing: bool,
-                            events: bool = True) -> float:
+                            events: bool = True,
+                            db_telemetry: bool = True) -> float:
     """One 3-node simulated create (SimulationExecutor with a small
     per-task delay so the measurement is dominated by stable sleeps, not
     scheduler noise); returns wall-clock seconds."""
@@ -121,7 +122,8 @@ def _timed_simulated_create(tmp_path, tag: str, tracing: bool,
         "cron": {"backup_enabled": False, "health_check_interval_s": 0,
                  "event_sync_interval_s": 0},
         "cluster": {"kubeconfig_dir": str(tmp_path / f"kc-{tag}")},
-        "observability": {"tracing": tracing, "events": events},
+        "observability": {"tracing": tracing, "events": events,
+                          "db_telemetry": db_telemetry},
     })
     services = build_services(config, simulate=True)
     try:
@@ -148,6 +150,14 @@ def _timed_simulated_create(tmp_path, tag: str, tracing: bool,
         bus_rows, _ = services.repos.events.since(0, kind="op.")
         assert bool(bus_rows) == events, \
             f"events={events} but bus rows={len(bus_rows)}"
+        # same contract for the flight recorder: a live DbTelemetry
+        # exactly when its knob is on, with statements already observed
+        telemetry = getattr(services.repos.db, "telemetry", None)
+        assert (telemetry is not None) == db_telemetry, \
+            f"db_telemetry={db_telemetry} but telemetry={telemetry!r}"
+        if telemetry is not None:
+            assert telemetry.snapshot()["statements"], \
+                "recorder on but no statements observed — measured nothing"
         return elapsed
     finally:
         services.close()
@@ -396,5 +406,26 @@ def test_tracing_overhead_stays_under_budget(tmp_path):
     delta = on - off
     assert delta < max(0.05 * off, 0.06), (
         f"tracing overhead {delta:.3f}s on a {off:.3f}s create "
+        f"(>{max(0.05 * off, 0.06):.3f}s budget)"
+    )
+
+
+def test_db_telemetry_overhead_stays_under_budget(tmp_path):
+    """The flight recorder's operational budget (ISSUE 20): a 3-node
+    simulated create with `observability.db_telemetry` ON must stay
+    within 5% wall-clock of the same create with the recorder OFF — the
+    hot path is two perf_counter reads and a dict update per statement,
+    with statement-id resolution deferred to scrape time. Best-of-2 per
+    mode filters scheduler noise; the absolute floor keeps sub-scale
+    deltas from flapping the ratio."""
+    off = min(_timed_simulated_create(tmp_path, f"toff{i}", False,
+                                      db_telemetry=False)
+              for i in range(2))
+    on = min(_timed_simulated_create(tmp_path, f"ton{i}", False,
+                                     db_telemetry=True)
+             for i in range(2))
+    delta = on - off
+    assert delta < max(0.05 * off, 0.06), (
+        f"db telemetry overhead {delta:.3f}s on a {off:.3f}s create "
         f"(>{max(0.05 * off, 0.06):.3f}s budget)"
     )
